@@ -1,0 +1,50 @@
+"""The smoke test: prints 42 (reference: examples/plus.py, README.rst:50-65).
+
+The reference placed constants 24.0 and 18.0 on two parameter-server tasks
+and added them on a worker via a remote gRPC session (plus.py:23-33).  The
+TPU-native version has no device strings and no remote session: the two
+addends live as shards of one global array — each resident on a different
+process — and the add is an XLA reduction over the mesh.
+
+Run (local backend, 2 processes):   python examples/plus.py
+Run (Mesos):                        python examples/plus.py zk://.../mesos
+"""
+
+import sys
+
+from tfmesos_tpu import cluster
+
+
+def compute(ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = ctx.mesh()  # one data-parallel axis over every chip in the slice
+    n = mesh.size
+    sharding = NamedSharding(mesh, P("dp"))
+
+    # Shard i of the global array carries addend i (24 then 18), like the
+    # reference's one-constant-per-ps-task placement; extra shards carry 0.
+    addends = [24.0, 18.0] + [0.0] * (n - 2) if n >= 2 else [42.0]
+
+    def shard_value(index):
+        start = index[0].start or 0
+        return np.asarray(addends[start:start + 1], dtype=np.float32)
+
+    arr = jax.make_array_from_callback((n,), sharding, shard_value)
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    return float(total)
+
+
+def main():
+    master = sys.argv[1] if len(sys.argv) > 1 else None
+    jobs = [dict(name="ps", num=1, cpus=0.5, mem=128.0),
+            dict(name="worker", num=1, cpus=0.5, mem=128.0)]
+    with cluster(jobs, master=master, quiet=True) as c:
+        print(int(c.run(compute)))
+
+
+if __name__ == "__main__":
+    main()

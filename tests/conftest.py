@@ -1,0 +1,14 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh BEFORE jax import.
+
+Multi-chip sharding is validated on virtual CPU devices (the driver's
+``dryrun_multichip`` does the same); nothing in tests/ touches real TPU.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TPUMESOS_LOGLEVEL", "WARNING")

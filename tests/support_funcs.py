@@ -1,0 +1,30 @@
+"""Functions shipped to Mode-A tasks by the integration tests (must be
+importable on the task side; the scheduler forwards sys.path)."""
+
+import os
+
+
+def ping(ctx, value):
+    return {"rank": ctx.rank, "world": ctx.world_size,
+            "job": f"{ctx.job_name}:{ctx.task_index}", "value": value}
+
+
+def read_env(ctx, name):
+    return os.environ.get(name)
+
+
+def sharded_sum(ctx, total):
+    """Distributed 'plus': a global array sharded across every process's
+    devices, reduced with an XLA collective — 42 the TPU way."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = ctx.mesh()
+    n = mesh.size
+    sharding = NamedSharding(mesh, P("dp"))
+    arr = jax.make_array_from_callback(
+        (n,), sharding, lambda idx: np.array([total / n], dtype=np.float32))
+    out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    return float(out)

@@ -1,0 +1,62 @@
+import json
+
+import pytest
+
+from tfmesos_tpu.cli import build_parser, forward_map, main, parse_mesh, parse_volumes
+
+
+def test_parser_full_flag_surface():
+    # The reference flag set (script/tfrun:11-33) must parse.
+    args = build_parser().parse_args([
+        "-w", "4", "-s", "2", "-m", "zk://zk/mesos", "-n", "myjob",
+        "-C", "MESOS", "-f", "-Cw", "2.5", "-Gw", "4", "-Mw", "2048",
+        "-Cs", "1.5", "-Gs", "0", "-Ms", "512", "-v",
+        "-V", "/data:/mnt/data", "-V", "/tmp:/tmp2", "-r", "tpu",
+        "--worker-logs", "*", "--gang", "--mesh", "dp=4,tp=2",
+        "--", "python", "train.py", "--ps_hosts", "{ps_hosts}"])
+    assert args.nworker == 4 and args.nserver == 2
+    assert args.worker_chips == 4 and args.worker_cpus == 2.5
+    assert args.cmd == ["--", "python", "train.py", "--ps_hosts", "{ps_hosts}"]
+    assert parse_volumes(args.volume) == {"/data": "/mnt/data", "/tmp": "/tmp2"}
+    assert parse_mesh(args.mesh) == {"dp": 4, "tp": 2}
+
+
+def test_forward_map():
+    assert forward_map("0", 4, "h:1") == {"worker:0": "h:1"}
+    assert forward_map("1,3", 4, "h:1") == {"worker:1": "h:1", "worker:3": "h:1"}
+    assert forward_map("*", 2, "h:1") == {"worker:0": "h:1", "worker:1": "h:1"}
+
+
+def test_bad_mesh_and_volume():
+    with pytest.raises(ValueError):
+        parse_mesh("dp4")
+    with pytest.raises(ValueError):
+        parse_volumes(["nodst"])
+
+
+def test_tfrun_end_to_end_forwards_logs(capfd):
+    """tfrun -w 2 -s 0 against the local backend: worker output arrives on
+    our stdout with the [job:idx] prefix (reference tfrun:101-112)."""
+    rc = main(["-w", "2", "-s", "0", "--worker-logs", "*", "--",
+               "echo", "task-{task_index}-of-{world_size}"])
+    assert rc == 0
+    out = capfd.readouterr().out
+    assert "[worker:0] task-0-of-2" in out
+    assert "[worker:1] task-1-of-2" in out
+
+
+def test_tfrun_extra_config_hooks(tmp_path, capfd):
+    """initializer/finalizer hooks run around the user cmd
+    (reference server.py:68-70, 105-109)."""
+    marker = tmp_path / "init-ran"
+    cfg = tmp_path / "extra.json"
+    cfg.write_text(json.dumps({
+        "initializer": f"touch {marker}",
+        "finalizer": f"test -f {marker} && echo FINAL >> {marker}",
+    }))
+    rc = main(["-w", "1", "-s", "0", "-e", str(cfg), "--worker-logs", "*",
+               "--", "cat", str(marker), "&&", "echo", "done-{job_name}"])
+    assert rc == 0
+    assert marker.exists()
+    assert "FINAL" in marker.read_text()
+    assert "[worker:0] done-worker" in capfd.readouterr().out

@@ -1,0 +1,60 @@
+import io
+import os
+import socket
+
+import pytest
+
+from tfmesos_tpu import logpump
+
+
+def _run_pump(monkeypatch, force_python):
+    if force_python:
+        monkeypatch.setattr(logpump, "_lib", None)
+        monkeypatch.setattr(logpump, "_lib_tried", True)
+    else:
+        if logpump._load() is None:
+            pytest.skip("native logpump not built")
+
+    r_fd, w_fd = os.pipe()
+    out_r, out_w = os.pipe()
+    fwd_a, fwd_b = socket.socketpair()
+    payload = b"line one\nline two\npartial tail"
+    os.write(w_fd, payload)
+    os.close(w_fd)
+
+    with os.fdopen(r_fd, "rb") as src, os.fdopen(out_w, "wb") as out:
+        logpump.pump_lines(src, out, fwd_a.fileno(), b"[worker:3] ")
+    fwd_a.close()
+
+    with os.fdopen(out_r, "rb") as f:
+        local = f.read()
+    chunks = []
+    while True:
+        b = fwd_b.recv(65536)
+        if not b:
+            break
+        chunks.append(b)
+    fwd_b.close()
+    return local, b"".join(chunks)
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_pump_mirrors_and_prefixes(monkeypatch, force_python):
+    local, forwarded = _run_pump(monkeypatch, force_python)
+    assert local == b"line one\nline two\npartial tail"
+    assert b"[worker:3] line one\n" in forwarded
+    assert b"[worker:3] line two\n" in forwarded
+    assert forwarded.endswith(b"partial tail")
+
+
+def test_pump_no_forward(monkeypatch):
+    r_fd, w_fd = os.pipe()
+    os.write(w_fd, b"just local\n")
+    os.close(w_fd)
+    buf = io.BytesIO()
+    with os.fdopen(r_fd, "rb") as src:
+        # BytesIO has no fileno: force the Python path.
+        monkeypatch.setattr(logpump, "_lib", None)
+        monkeypatch.setattr(logpump, "_lib_tried", True)
+        logpump.pump_lines(src, buf, -1, b"[x] ")
+    assert buf.getvalue() == b"just local\n"

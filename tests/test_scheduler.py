@@ -1,0 +1,232 @@
+import threading
+import time
+
+import pytest
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.backends import FOREVER, ResourceBackend
+from tfmesos_tpu.scheduler import ClusterError, MAX_FAILURE_COUNT, TPUMesosScheduler
+from tfmesos_tpu.spec import Job, Offer, TaskStatus
+
+
+class FakeBackend(ResourceBackend):
+    """Records scheduler decisions; optionally simulates the task side."""
+
+    def __init__(self, handshake=False):
+        self.launched = []
+        self.declined = []
+        self.suppress_count = 0
+        self.revive_count = 0
+        self.killed = []
+        self.handshake = handshake
+        self.scheduler = None
+        self.threads = []
+
+    def start(self, scheduler):
+        self.scheduler = scheduler
+        scheduler.on_registered({"backend": "fake"})
+
+    def stop(self):
+        pass
+
+    def launch(self, offer, task_infos):
+        self.launched.append((offer.id, [i["task_id"]["value"] for i in task_infos]))
+        if self.handshake:
+            for info in task_infos:
+                t = threading.Thread(target=_fake_task, daemon=True,
+                                     args=(info, self.scheduler.addr,
+                                           self.scheduler.token, self))
+                t.start()
+                self.threads.append(t)
+
+    def decline(self, offer, refuse_seconds=5.0):
+        self.declined.append((offer.id, refuse_seconds))
+
+    def suppress(self):
+        self.suppress_count += 1
+
+    def revive(self):
+        self.revive_count += 1
+
+    def kill(self, task_id):
+        self.killed.append(task_id)
+
+
+def _fake_task(task_info, addr, token, backend):
+    """Simulates the node runtime handshake + Mode A executor."""
+    task_id = task_info["task_id"]["value"]
+    sock = wire.connect(addr)
+    wire.send_msg(sock, {"op": "register", "task_id": task_id,
+                         "addr": "127.0.0.1:9999", "coord_port": 8476}, token)
+    config = wire.recv_msg(sock, token)
+    wire.send_msg(sock, "ok", token)
+    if config["cmd"] is not None:
+        sock.close()
+        time.sleep(0.05)
+        backend.scheduler.on_status(TaskStatus(task_id, "TASK_FINISHED"))
+        return
+    while True:
+        msg = wire.recv_msg(sock, token)
+        if msg.get("op") == "shutdown":
+            return
+        if msg.get("op") == "run":
+            wire.send_msg(sock, {"op": "result", "call_id": msg["call_id"],
+                                 "ok": True, "value": f"rank{config['rank']}"},
+                          token)
+
+
+def _scheduler(jobs, backend=None, **kw):
+    backend = backend or FakeBackend()
+    s = TPUMesosScheduler(jobs, backend=backend, quiet=True,
+                          start_timeout=10.0, **kw)
+    s.addr = "127.0.0.1:0"  # offer handling needs a rendezvous addr
+    backend.start(s)
+    return s, backend
+
+
+def offer(oid="o1", cpus=8.0, mem=8192.0, chips=0):
+    return Offer(id=oid, agent_id=f"agent-{oid}", hostname="h", cpus=cpus,
+                 mem=mem, chips=chips)
+
+
+def test_first_fit_partial_then_complete():
+    s, b = _scheduler([Job(name="worker", num=3, cpus=2.0, mem=1024.0)])
+    s.on_offers([offer("o1", cpus=5.0, mem=8192)])  # fits 2 of 3
+    assert len(b.launched) == 1
+    assert len(b.launched[0][1]) == 2
+    s.on_offers([offer("o2", cpus=8.0)])
+    assert len(b.launched) == 2
+    assert sum(len(ids) for _, ids in b.launched) == 3
+    # Fully placed: further offers are suppressed + declined forever
+    # (reference scheduler.py:229-232).
+    s.on_offers([offer("o3")])
+    assert b.suppress_count == 1
+    assert b.declined[-1] == ("o3", FOREVER)
+
+
+def test_decline_useless_offer():
+    s, b = _scheduler([Job(name="worker", num=1, cpus=4.0, mem=1024)])
+    s.on_offers([offer("small", cpus=1.0)])
+    assert b.launched == []
+    assert b.declined[0][0] == "small"
+
+
+def test_chips_dimension_respected():
+    s, b = _scheduler([Job(name="worker", num=2, cpus=1.0, mem=100, chips=4)])
+    s.on_offers([offer("nochips", chips=0)])
+    assert b.launched == []
+    s.on_offers([offer("tpu", chips=8)])
+    assert len(b.launched[0][1]) == 2
+
+
+def test_gang_scheduling_all_or_nothing():
+    s, b = _scheduler([Job(name="worker", num=4, cpus=2.0, mem=100)],
+                      gang_scheduling=True)
+    # Batch can only fit 2 of 4 → everything declined, nothing launched.
+    s.on_offers([offer("o1", cpus=4.0)])
+    assert b.launched == []
+    assert b.declined
+    # Batch fitting all 4 → launch.
+    s.on_offers([offer("o2", cpus=4.0), offer("o3", cpus=4.0)])
+    assert sum(len(ids) for _, ids in b.launched) == 4
+
+
+def test_prestart_failure_revives_with_fresh_id():
+    s, b = _scheduler([Job(name="worker", num=1, cpus=1.0, mem=100)])
+    s.on_offers([offer("o1")])
+    old_id = s.tasks[0].id
+    s.on_status(TaskStatus(old_id, "TASK_FAILED", message="oom"))
+    assert s.tasks[0].id != old_id
+    assert not s.tasks[0].offered
+    assert b.revive_count == 1
+
+
+def test_prestart_failure_budget_exhausted():
+    s, b = _scheduler([Job(name="worker", num=1, cpus=1.0, mem=100)])
+    for _ in range(MAX_FAILURE_COUNT):
+        s.on_offers([offer("o")])
+        s.on_status(TaskStatus(s.tasks[0].id, "TASK_FAILED"))
+    with pytest.raises(ClusterError):
+        s.finished()
+
+
+def test_poststart_failure_is_fatal():
+    s, b = _scheduler([Job(name="worker", num=2, cpus=1.0, mem=100)])
+    s.on_offers([offer("o")])
+    s.started = True
+    s.on_status(TaskStatus(s.tasks[0].id, "TASK_KILLED"))
+    with pytest.raises(ClusterError):
+        s.finished()
+
+
+def test_finished_any_job_complete():
+    # finished() is true when ANY job fully finished — workers done ends the
+    # run even though ps tasks never exit (reference scheduler.py:474-477).
+    s, b = _scheduler([Job(name="ps", num=1, cpus=1, mem=10),
+                       Job(name="worker", num=2, cpus=1, mem=10)])
+    s.on_offers([offer("o")])
+    s.started = True
+    workers = [t for t in s.tasks if t.job_name == "worker"]
+    s.on_status(TaskStatus(workers[0].id, "TASK_FINISHED"))
+    assert not s.finished()
+    s.on_status(TaskStatus(workers[1].id, "TASK_FINISHED"))
+    assert s.finished()
+
+
+def test_agent_lost_prestart_revives():
+    s, b = _scheduler([Job(name="worker", num=1, cpus=1, mem=10)])
+    s.on_offers([offer("o1")])
+    agent = s.tasks[0].agent_id
+    s.on_agent_lost(agent)
+    assert b.revive_count == 1
+    assert not s.tasks[0].offered
+
+
+def test_full_bringup_run_and_dispatch():
+    """End-to-end over real sockets with a simulated task side: rendezvous,
+    config broadcast, SPMD dispatch, teardown."""
+    backend = FakeBackend(handshake=True)
+    s = TPUMesosScheduler([Job(name="worker", num=3, cpus=1.0, mem=10.0)],
+                          backend=backend, quiet=True, start_timeout=15.0)
+
+    def feed_offers():
+        while not all(t.offered for t in s.tasks):
+            if s.addr and s.addr != "127.0.0.1:0":
+                s.on_offers([offer("oX", cpus=16.0)])
+            time.sleep(0.01)
+
+    feeder = threading.Thread(target=feed_offers, daemon=True)
+    feeder.start()
+    s.start()
+    try:
+        assert s.started
+        assert len(s.cluster_def["worker"]) == 3
+        assert set(s.targets) == {f"/job:worker/task:{i}" for i in range(3)}
+        results = s.run_all("tests.whatever:ignored_by_fake")
+        assert results == ["rank0", "rank1", "rank2"]
+        assert s.run("tests.whatever:ignored_by_fake") == "rank0"
+    finally:
+        s.stop()
+
+
+def test_mode_b_bringup_and_finish():
+    backend = FakeBackend(handshake=True)
+    s = TPUMesosScheduler([Job(name="worker", num=2, cpus=1.0, mem=10.0,
+                               cmd="echo hi")],
+                          backend=backend, quiet=True, start_timeout=15.0)
+
+    def feed_offers():
+        while not all(t.offered for t in s.tasks):
+            if s.addr and s.addr != "127.0.0.1:0":
+                s.on_offers([offer("oY", cpus=16.0)])
+            time.sleep(0.01)
+
+    threading.Thread(target=feed_offers, daemon=True).start()
+    s.start()
+    try:
+        deadline = time.time() + 10
+        while not s.finished():
+            assert time.time() < deadline, "tasks never finished"
+            time.sleep(0.02)
+    finally:
+        s.stop()

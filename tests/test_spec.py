@@ -1,0 +1,84 @@
+import pytest
+
+from tfmesos_tpu.spec import Job, Offer, Task, TaskStatus, normalize_jobs
+
+
+def test_normalize_jobs_variants():
+    # The reference accepts Job | dict | list of either (__init__.py:9-16).
+    j = Job(name="worker", num=2)
+    assert normalize_jobs(j) == [j]
+    [got] = normalize_jobs({"name": "ps", "num": 1, "chips": 4})
+    assert (got.name, got.num, got.chips) == ("ps", 1, 4)
+    got = normalize_jobs([j, {"name": "ps", "num": 1}])
+    assert [x.name for x in got] == ["worker", "ps"]
+    with pytest.raises(TypeError):
+        normalize_jobs([42])
+
+
+def test_job_validation():
+    with pytest.raises(ValueError):
+        Job(name="w", num=0)
+    with pytest.raises(ValueError):
+        Job(name="w", num=1, start=-1)
+
+
+def test_task_fit_and_take():
+    offer = Offer(id="o1", agent_id="a1", hostname="h", cpus=4.0, mem=4096, chips=8)
+    t = Task("worker", 0, cpus=2.0, mem=1024, chips=4)
+    assert t.fits(offer)
+    t.take_from(offer)
+    assert (offer.cpus, offer.mem, offer.chips) == (2.0, 3072, 4)
+    big = Task("worker", 1, cpus=2.0, mem=1024, chips=8)
+    assert not big.fits(offer)
+
+
+def test_task_reset_new_identity():
+    t = Task("worker", 0)
+    old_id = t.id
+    t.offered = True
+    t.addr = "1.2.3.4:5"
+    t.initialized = True
+    t.reset()
+    assert t.id != old_id
+    assert not t.offered and t.addr is None and not t.initialized
+
+
+def test_to_task_info_shape():
+    offer = Offer(id="o1", agent_id="agent-7", hostname="h", cpus=4, mem=4096, chips=8)
+    t = Task("worker", 3, cpus=2.0, mem=2048, chips=4)
+    info = t.to_task_info(offer, "10.0.0.1:5000", token="tok",
+                          env={"FOO": "bar"})
+    assert info["task_id"]["value"] == t.id
+    assert info["agent_id"]["value"] == "agent-7"
+    res = {r["name"]: r["scalar"]["value"] for r in info["resources"]}
+    assert res == {"cpus": 2.0, "mem": 2048.0, "tpus": 4.0}
+    assert "tfmesos_tpu.server" in info["command"]["value"]
+    assert "10.0.0.1:5000" in info["command"]["value"]
+    env = {v["name"]: v["value"] for v in info["command"]["environment"]["variables"]}
+    assert env["TPUMESOS_TOKEN"] == "tok"
+    assert env["FOO"] == "bar"
+    assert "PYTHONPATH" in env  # scheduler sys.path forwarded (scheduler.py:168-176)
+
+
+def test_to_task_info_container(monkeypatch):
+    offer = Offer(id="o", agent_id="a", hostname="h", cpus=1, mem=100)
+    t = Task("ps", 0, volumes={"/data": "/mnt/data"})
+    info = t.to_task_info(offer, "x:1", token="", docker_image="img:latest")
+    container = info["container"]
+    assert container["type"] == "MESOS"
+    assert container["mesos"]["image"]["docker"]["name"] == "img:latest"
+    paths = {(v["host_path"], v["container_path"], v["mode"])
+             for v in container["volumes"]}
+    # /etc/passwd + /etc/group always mounted RO (reference scheduler.py:133-139)
+    assert ("/etc/passwd", "/etc/passwd", "RO") in paths
+    assert ("/data", "/mnt/data", "RW") in paths
+    docker = t.to_task_info(offer, "x:1", token="", docker_image="img",
+                            containerizer_type="DOCKER", force_pull_image=True)
+    assert docker["container"]["type"] == "DOCKER"
+    assert docker["container"]["docker"]["force_pull_image"] is True
+
+
+def test_status_terminal():
+    assert TaskStatus("t", "TASK_FAILED").terminal
+    assert TaskStatus("t", "TASK_FINISHED").terminal
+    assert not TaskStatus("t", "TASK_RUNNING").terminal

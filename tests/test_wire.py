@@ -1,0 +1,91 @@
+import socket
+import threading
+
+import pytest
+
+from tfmesos_tpu import wire
+
+
+def _pair():
+    listener = wire.bind_ephemeral("127.0.0.1")
+    addr = wire.sock_addr(listener, advertise_host="127.0.0.1")
+    client = wire.connect(addr)
+    server, _ = listener.accept()
+    listener.close()
+    return client, server
+
+
+def test_roundtrip_plain():
+    c, s = _pair()
+    wire.send_msg(c, {"op": "register", "x": [1, 2, 3]})
+    assert wire.recv_msg(s) == {"op": "register", "x": [1, 2, 3]}
+    c.close(); s.close()
+
+
+def test_roundtrip_token():
+    token = wire.new_token()
+    c, s = _pair()
+    wire.send_msg(c, "hello", token)
+    assert wire.recv_msg(s, token) == "hello"
+    c.close(); s.close()
+
+
+def test_bad_token_rejected():
+    c, s = _pair()
+    wire.send_msg(c, "hello", "right-token")
+    with pytest.raises(wire.WireError):
+        wire.recv_msg(s, "wrong-token")
+    c.close(); s.close()
+
+
+def test_tampered_body_rejected():
+    token = wire.new_token()
+    frame = bytearray(wire.encode({"a": 1}, token))
+    frame[-1] ^= 0xFF
+    framer = wire.Framer(token)
+    with pytest.raises(wire.WireError):
+        framer.feed(bytes(frame))
+
+
+def test_framer_incremental_and_coalesced():
+    token = wire.new_token()
+    msgs = [{"i": i, "data": "x" * i} for i in range(5)]
+    stream = b"".join(wire.encode(m, token) for m in msgs)
+    framer = wire.Framer(token)
+    out = []
+    # Feed one byte at a time: exercises partial-frame buffering.
+    for b in stream[: len(stream) // 2]:
+        out.extend(framer.feed(bytes([b])))
+    # Then the rest at once: exercises multiple frames per feed.
+    out.extend(framer.feed(stream[len(stream) // 2:]))
+    assert out == msgs
+
+
+def test_oversized_frame_rejected():
+    framer = wire.Framer()
+    with pytest.raises(wire.WireError):
+        framer.feed(b"\xff\xff\xff\xff")
+
+
+def test_closed_connection_raises():
+    c, s = _pair()
+    c.close()
+    with pytest.raises(wire.WireError):
+        wire.recv_msg(s)
+    s.close()
+
+
+def test_concurrent_messages_ordered():
+    token = wire.new_token()
+    c, s = _pair()
+
+    def sender():
+        for i in range(100):
+            wire.send_msg(c, i, token)
+
+    t = threading.Thread(target=sender)
+    t.start()
+    got = [wire.recv_msg(s, token) for _ in range(100)]
+    t.join()
+    assert got == list(range(100))
+    c.close(); s.close()

@@ -1,0 +1,39 @@
+"""tfmesos_tpu — a TPU-native cluster framework with the capabilities of
+douban/tfmesos.
+
+A lightweight control plane that allocates resources (from a Mesos cluster or
+the local host), boots a ``jax.distributed`` runtime on them, and hands user
+code a GSPMD mesh — the TPU-era successor of the reference's ps/worker
+``tf.train.Server`` ClusterSpec (see SURVEY.md).
+
+Public surface mirrors the reference (tfmesos/__init__.py:7-22): the
+``cluster()`` context manager with identical jobs-normalization semantics.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from tfmesos_tpu.spec import Job, normalize_jobs
+from tfmesos_tpu.scheduler import ClusterError, TPUMesosScheduler
+
+__VERSION__ = "0.1.0"
+
+__all__ = ["cluster", "Job", "TPUMesosScheduler", "ClusterError", "__VERSION__"]
+
+
+@contextmanager
+def cluster(jobs, **kwargs):
+    """Bring up a cluster, yield the scheduler handle, always tear down.
+
+    ``jobs`` may be a Job, a dict of Job kwargs, or a list of either —
+    the reference's normalization contract (tfmesos/__init__.py:9-16).
+    Keyword arguments pass through to :class:`TPUMesosScheduler`.
+    """
+    jobs = normalize_jobs(jobs)
+    scheduler = TPUMesosScheduler(jobs, **kwargs)
+    scheduler.start()
+    try:
+        yield scheduler
+    finally:
+        scheduler.stop()

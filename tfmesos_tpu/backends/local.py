@@ -1,0 +1,165 @@
+"""Local subprocess backend: run a whole "cluster" on this host.
+
+The reference has no equivalent — its only path to a running cluster is a
+real Mesos master (SURVEY §4: the de-facto test was a live cluster).  This
+backend exists precisely to fix that: it synthesizes offers describing the
+local host and launches tasks as child processes, so the full control plane
+(rendezvous, config broadcast, Mode A/B node runtime, failure policy) is
+exercisable in CI with no Mesos and no TPU.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+from tfmesos_tpu.backends import ResourceBackend
+from tfmesos_tpu.spec import Offer, TaskStatus
+from tfmesos_tpu.utils.logging import get_logger
+
+
+class LocalBackend(ResourceBackend):
+    def __init__(self, cpus: Optional[float] = None, mem: float = 1 << 20,
+                 chips: int = 0, offer_interval: float = 0.05,
+                 inherit_env: bool = True,
+                 default_platform: Optional[str] = "cpu"):
+        # Co-located processes cannot share one TPU, so local children run on
+        # CPU unless the caller (or the environment) says otherwise.
+        self.default_platform = default_platform
+        # "cpus" here are scheduling slots, not a pinning claim: this backend
+        # exists to run many-task dev clusters on small hosts, so advertise a
+        # generous floor rather than the literal core count.
+        self.cpus = float(cpus if cpus is not None else max(os.cpu_count() or 1, 16))
+        self.mem = float(mem)
+        self.chips = chips
+        self.offer_interval = offer_interval
+        self.inherit_env = inherit_env
+        self.log = get_logger("tfmesos_tpu.local")
+
+        self._scheduler = None
+        self._suppressed = threading.Event()
+        self._shutdown = threading.Event()
+        self._offer_thread: Optional[threading.Thread] = None
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._in_use = [0.0, 0.0, 0]  # cpus, mem, chips
+        self._lock = threading.Lock()
+
+    # -- ResourceBackend ---------------------------------------------------
+
+    def start(self, scheduler) -> None:
+        self._scheduler = scheduler
+        scheduler.on_registered({"backend": "local", "cpus": self.cpus,
+                                 "mem": self.mem, "chips": self.chips})
+        self._offer_thread = threading.Thread(target=self._offer_loop,
+                                              name="local-offers", daemon=True)
+        self._offer_thread.start()
+
+    def _offer_loop(self) -> None:
+        while not self._shutdown.is_set():
+            if not self._suppressed.is_set():
+                with self._lock:
+                    free = Offer(
+                        id=str(uuid.uuid4()), agent_id="local",
+                        hostname="127.0.0.1",
+                        cpus=self.cpus - self._in_use[0],
+                        mem=self.mem - self._in_use[1],
+                        chips=self.chips - self._in_use[2],
+                    )
+                if free.cpus > 0 and free.mem > 0:
+                    try:
+                        self._scheduler.on_offers([free])
+                    except Exception as e:  # pragma: no cover - defensive
+                        self.log.exception("offer delivery failed: %s", e)
+            self._shutdown.wait(self.offer_interval)
+
+    def launch(self, offer: Offer, task_infos: Sequence[dict]) -> None:
+        for info in task_infos:
+            task_id = info["task_id"]["value"]
+            env = dict(os.environ) if self.inherit_env else {}
+            for var in info["command"]["environment"]["variables"]:
+                env[var["name"]] = var["value"]
+            if self.default_platform:
+                env.setdefault("JAX_PLATFORMS", self.default_platform)
+            cmd = info["command"]["value"]
+            argv = cmd if info["command"].get("shell") else shlex.split(cmd)
+            res = info["resources"]
+            used = [_res(res, "cpus"), _res(res, "mem"), int(_res(res, "tpus"))]
+            with self._lock:
+                for i in range(3):
+                    self._in_use[i] += used[i]
+            proc = subprocess.Popen(argv, shell=bool(info["command"].get("shell")),
+                                    env=env, start_new_session=True)
+            self._procs[task_id] = proc
+            self.log.info("launched local task %s pid=%d", task_id[:8], proc.pid)
+            self._scheduler.on_status(TaskStatus(task_id, "TASK_RUNNING",
+                                                 agent_id="local"))
+            threading.Thread(target=self._watch, args=(task_id, proc, used),
+                             name=f"watch-{task_id[:8]}", daemon=True).start()
+
+    def _watch(self, task_id: str, proc: subprocess.Popen, used) -> None:
+        rc = proc.wait()
+        with self._lock:
+            for i in range(3):
+                self._in_use[i] -= used[i]
+        if self._shutdown.is_set():
+            return
+        state = "TASK_FINISHED" if rc == 0 else "TASK_FAILED"
+        self._scheduler.on_status(
+            TaskStatus(task_id, state, message=f"exit code {rc}", agent_id="local"))
+
+    def decline(self, offer: Offer, refuse_seconds: float = 5.0) -> None:
+        pass  # synthetic offers; nothing to return
+
+    def suppress(self) -> None:
+        self._suppressed.set()
+
+    def revive(self) -> None:
+        self._suppressed.clear()
+
+    def kill(self, task_id: str) -> None:
+        proc = self._procs.get(task_id)
+        if proc is not None and proc.poll() is None:
+            _terminate(proc)
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                _terminate(proc)
+        deadline = time.monotonic() + 5.0
+        for proc in self._procs.values():
+            remaining = deadline - time.monotonic()
+            try:
+                proc.wait(timeout=max(0.0, remaining))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        if self._offer_thread is not None:
+            self._offer_thread.join(timeout=2.0)
+
+
+def _terminate(proc: subprocess.Popen) -> None:
+    # Tasks are session leaders (start_new_session=True) so Mode B shell
+    # children die with them.
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.terminate()
+        except ProcessLookupError:
+            pass
+
+
+def _res(resources: List[dict], name: str) -> float:
+    for r in resources:
+        if r["name"] == name:
+            return float(r["scalar"]["value"])
+    return 0.0

@@ -1,0 +1,214 @@
+"""``tfrun`` — the between-graph CLI (reference: script/tfrun).
+
+Keeps the reference's full flag surface (tfrun:11-33): ``-w`` workers and
+``-s`` servers (now mesh-axis sizes, per the north star), per-job resource
+flags, volumes, containerizer choice, extra-config JSON, and
+``--worker-logs`` log forwarding.  ``-Gw/-Gs`` count TPU chips instead of
+GPUs.  New flags: ``--gang`` (all-or-nothing placement for slice atomicity)
+and ``--mesh dp=4,tp=2`` (explicit mesh axes handed to tasks).
+
+The log collector reproduces tfrun:83-115: tasks named by ``--worker-logs``
+dial back and every line they print arrives on our stdout with a
+``[job:idx]`` prefix, while we poll ``cluster.finished()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import selectors
+import sys
+import time
+from typing import Dict, List, Optional
+
+from tfmesos_tpu import cluster, wire
+from tfmesos_tpu.spec import Job
+from tfmesos_tpu.utils.logging import get_logger
+
+log = get_logger("tfmesos_tpu.tfrun")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tfrun",
+        description="Run a distributed command on a TPU cluster scheduled "
+                    "via Mesos (or locally).")
+    p.add_argument("-w", "--nworker", type=int, required=True,
+                   help="number of worker tasks (data-parallel mesh axis)")
+    p.add_argument("-s", "--nserver", type=int, required=True,
+                   help="number of server tasks (0 for pure FSDP; kept for "
+                        "CLI parity — there are no parameter servers on TPU)")
+    p.add_argument("-m", "--master", type=str, default=None,
+                   help="Mesos master (host:port or zk://...); default env "
+                        "MESOS_MASTER, else local backend")
+    p.add_argument("-n", "--name", type=str, default=None, help="framework name")
+    p.add_argument("-C", "--containerizer_type", choices=["MESOS", "DOCKER"],
+                   default=None)
+    p.add_argument("-f", "--force_pull_image", action="store_true")
+    p.add_argument("-Cw", "--worker_cpus", type=float, default=1.0)
+    p.add_argument("-Gw", "--worker_chips", type=int, default=0,
+                   help="TPU chips per worker (was GPUs in the reference)")
+    p.add_argument("-Mw", "--worker_mem", type=float, default=1024.0)
+    p.add_argument("-Cs", "--server_cpus", type=float, default=1.0)
+    p.add_argument("-Gs", "--server_chips", type=int, default=0)
+    p.add_argument("-Ms", "--server_mem", type=float, default=1024.0)
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("-V", "--volume", action="append", default=[],
+                   metavar="SRC:DST", help="host->container mount (repeatable)")
+    p.add_argument("-r", "--role", type=str, default="*")
+    p.add_argument("-e", "--extra_config", type=str, default=None,
+                   metavar="FILE.json",
+                   help="JSON file with extra config (initializer/finalizer "
+                        "hooks etc.)")
+    p.add_argument("--worker-logs", type=str, default="0",
+                   help="comma-separated worker indices (or '*') whose output "
+                        "to collect; default chief only")
+    p.add_argument("--gang", action="store_true",
+                   help="all-or-nothing placement (TPU slice atomicity)")
+    p.add_argument("--mesh", type=str, default=None,
+                   help="explicit mesh axes, e.g. dp=4,tp=2")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="command to run on every task (placeholders: "
+                        "{ps_hosts} {worker_hosts} {job_name} {task_index} "
+                        "{rank} {world_size} {coordinator})")
+    return p
+
+
+def parse_mesh(spec: Optional[str]) -> Optional[Dict[str, int]]:
+    if not spec:
+        return None
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise ValueError(f"bad mesh axis {part!r}; want name=size")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def parse_volumes(volumes: List[str]) -> Dict[str, str]:
+    out = {}
+    for v in volumes:
+        src, _, dst = v.partition(":")
+        if not dst:
+            raise ValueError(f"bad volume {v!r}; want src:dst")
+        out[src] = dst
+    return out
+
+
+class LogCollector:
+    """Accepts task connections and splices their lines to stdout
+    (reference: tfrun:83-115 select loop)."""
+
+    def __init__(self) -> None:
+        self._listen = wire.bind_ephemeral()
+        self.addr = wire.sock_addr(self._listen)
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._listen, selectors.EVENT_READ, "accept")
+
+    def pump(self, timeout: float = 0.1) -> None:
+        for key, _ in self._sel.select(timeout=timeout):
+            if key.data == "accept":
+                conn, _ = self._listen.accept()
+                conn.setblocking(False)
+                self._sel.register(conn, selectors.EVENT_READ, "conn")
+                continue
+            try:
+                data = key.fileobj.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                self._sel.unregister(key.fileobj)
+                key.fileobj.close()
+                continue
+            sys.stdout.buffer.write(data)
+            sys.stdout.buffer.flush()
+
+    def close(self) -> None:
+        self.pump(timeout=0)  # drain anything already queued
+        for key in list(self._sel.get_map().values()):
+            if key.data == "conn":
+                key.fileobj.close()
+        self._sel.close()
+        self._listen.close()
+
+
+def forward_map(worker_logs: str, nworker: int, collector_addr: str) -> Dict[str, str]:
+    """--worker-logs '0' | '1,3' | '*' → forward_addresses (tfrun:89-94)."""
+    if worker_logs.strip() == "*":
+        return {f"worker:{i}": collector_addr for i in range(nworker)}
+    out = {}
+    for tok in worker_logs.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if not tok.isdigit():
+            raise ValueError(f"bad --worker-logs entry {tok!r}; want indices or '*'")
+        out[f"worker:{tok}"] = collector_addr
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd_parts = list(args.cmd)
+    if cmd_parts and cmd_parts[0] == "--":
+        cmd_parts = cmd_parts[1:]
+    if not cmd_parts:
+        print("tfrun: no command given", file=sys.stderr)
+        return 2
+    cmd = " ".join(cmd_parts)  # joined into one shell string (tfrun:36-37)
+
+    try:
+        mesh_axes = parse_mesh(args.mesh)
+        volumes = parse_volumes(args.volume)
+        forward_map(args.worker_logs, args.nworker, "validate:0")
+    except ValueError as e:
+        print(f"tfrun: {e}", file=sys.stderr)
+        return 2
+
+    extra_config = {}
+    if args.extra_config:
+        with open(args.extra_config) as f:
+            extra_config = json.load(f)
+
+    jobs = []
+    if args.nserver > 0:
+        jobs.append(Job(name="ps", num=args.nserver, cpus=args.server_cpus,
+                        mem=args.server_mem, chips=args.server_chips, cmd=cmd))
+    jobs.append(Job(name="worker", num=args.nworker, cpus=args.worker_cpus,
+                    mem=args.worker_mem, chips=args.worker_chips, cmd=cmd))
+
+    collector = LogCollector()
+    forward = forward_map(args.worker_logs, args.nworker, collector.addr)
+
+    from tfmesos_tpu.scheduler import ClusterError
+    try:
+        with cluster(jobs, master=args.master, name=args.name,
+                     quiet=not args.verbose,
+                     containerizer_type=args.containerizer_type,
+                     force_pull_image=args.force_pull_image,
+                     volumes=volumes,
+                     forward_addresses=forward,
+                     extra_config=extra_config, role=args.role,
+                     gang_scheduling=args.gang,
+                     mesh_axes=mesh_axes) as c:
+            while not c.finished():
+                collector.pump(timeout=0.1)
+            # final drain so lines racing the finish still land
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                collector.pump(timeout=0.1)
+    except ClusterError as e:
+        # Fail-fast is policy (reference scheduler.py:394-401); the CLI
+        # surfaces it as one line, not a stack trace.
+        print(f"tfrun: cluster failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        collector.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Child-stdout line pump with optional TCP forwarding.
+
+The reference's hottest control-plane loop: every byte of worker output
+transits a Python ``for l in iter(p.stdout.readline, b'')`` loop
+(server.py:99-102).  We provide a native C++ pump (``native/logpump.cpp``,
+loaded via ctypes) that splices child stdout → local stdout (+ forward
+socket, with a ``[job:idx]`` prefix) entirely in C, with a pure-Python
+fallback when the shared library hasn't been built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import BinaryIO, Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "native", "liblogpump.so")
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        if os.path.exists(_LIB_PATH):
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                lib.tpumesos_pump_lines.argtypes = [
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_char_p, ctypes.c_size_t,
+                ]
+                lib.tpumesos_pump_lines.restype = ctypes.c_int
+                _lib = lib
+            except OSError:
+                _lib = None
+    return _lib
+
+
+def pump_lines(src: BinaryIO, local_out: BinaryIO, forward_fd: int,
+               prefix: bytes) -> None:
+    """Pump ``src`` to ``local_out`` line by line until EOF; each line also
+    goes to ``forward_fd`` (if >= 0) with ``prefix`` prepended (reference
+    behavior: server.py:86-87, 99-102)."""
+    lib = _load()
+    if lib is not None:
+        local_out.flush()
+        rc = lib.tpumesos_pump_lines(src.fileno(), local_out.fileno(),
+                                     forward_fd, prefix, len(prefix))
+        if rc == 0:
+            return
+        # fall through to Python on native failure
+    for line in iter(src.readline, b""):
+        local_out.write(line)
+        local_out.flush()
+        if forward_fd >= 0:
+            try:
+                os.write(forward_fd, prefix + line)
+            except OSError:
+                forward_fd = -1

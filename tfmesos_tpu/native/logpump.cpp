@@ -1,0 +1,79 @@
+// Native line pump for Mode-B child stdout (see tfmesos_tpu/logpump.py).
+//
+// Replaces the reference's per-line Python loop (server.py:99-102) with a
+// splice loop in C++: read chunks from src_fd, mirror them verbatim to
+// out_fd, and retransmit complete lines (prefixed) to fwd_fd.  Partial lines
+// are buffered so the forwarded stream stays line-framed even when the child
+// writes in arbitrary chunks.
+//
+// Build: `make -C tfmesos_tpu/native` → liblogpump.so (loaded via ctypes).
+
+#include <cerrno>
+#include <cstring>
+#include <string>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// Write all of buf to fd, retrying on EINTR/partial writes.
+// Returns false on unrecoverable error.
+bool write_all(int fd, const char* buf, size_t len) {
+  while (len > 0) {
+    ssize_t n = ::write(fd, buf, len);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    buf += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" int tpumesos_pump_lines(int src_fd, int out_fd, int fwd_fd,
+                                   const char* prefix, size_t prefix_len) {
+  std::vector<char> chunk(1 << 16);
+  std::string pending;  // partial line awaiting its newline, for forwarding
+  bool fwd_ok = fwd_fd >= 0;
+
+  for (;;) {
+    ssize_t n = ::read(src_fd, chunk.data(), chunk.size());
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return 1;
+    }
+    if (n == 0) break;  // EOF: child closed stdout
+
+    if (!write_all(out_fd, chunk.data(), static_cast<size_t>(n))) return 1;
+
+    if (!fwd_ok) continue;
+    pending.append(chunk.data(), static_cast<size_t>(n));
+    size_t start = 0;
+    for (;;) {
+      size_t nl = pending.find('\n', start);
+      if (nl == std::string::npos) break;
+      std::string line;
+      line.reserve(prefix_len + (nl - start) + 1);
+      line.append(prefix, prefix_len);
+      line.append(pending, start, nl - start + 1);
+      if (!write_all(fwd_fd, line.data(), line.size())) {
+        fwd_ok = false;  // collector went away; keep local mirroring alive
+        break;
+      }
+      start = nl + 1;
+    }
+    pending.erase(0, start);
+  }
+
+  // Forward any trailing unterminated line.
+  if (fwd_ok && !pending.empty()) {
+    std::string line;
+    line.append(prefix, prefix_len);
+    line.append(pending);
+    write_all(fwd_fd, line.data(), line.size());
+  }
+  return 0;
+}

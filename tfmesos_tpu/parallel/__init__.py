@@ -1,0 +1,3 @@
+from tfmesos_tpu.parallel.mesh import MeshSpec, build_mesh, mesh_from_jobs
+
+__all__ = ["MeshSpec", "build_mesh", "mesh_from_jobs"]

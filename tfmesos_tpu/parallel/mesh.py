@@ -1,0 +1,103 @@
+"""Device-mesh construction: the GSPMD successor of the ps/worker ClusterSpec.
+
+The reference turns ``-s/-w`` job counts into a ``cluster_def`` of gRPC
+addresses (scheduler.py:288-318).  Here those counts become mesh axis sizes:
+the data-parallel axis replaces the worker set, and parameter sharding over
+the ``fsdp`` axis replaces parameter servers (north star in BASELINE.json).
+Richer axes — ``tp`` (tensor), ``pp`` (pipeline), ``sp`` (sequence/context),
+``ep`` (expert) — are first-class so the same mesh scales past the
+reference's PS world.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+# Canonical axis order: collectives that ride ICI fastest should be innermost
+# (contiguous device ids on a TPU slice share links); dp outermost so
+# cross-slice DCN traffic, if any, is pure gradient all-reduce.
+AXIS_ORDER = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+@dataclass
+class MeshSpec:
+    """An ordered mapping of axis name → size."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for name, size in self.axes.items():
+            if size < 1:
+                raise ValueError(f"axis {name!r} must have positive size, got {size}")
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axes.values()) if self.axes else 1
+
+    def ordered(self) -> List[str]:
+        known = [a for a in AXIS_ORDER if a in self.axes]
+        extra = [a for a in self.axes if a not in AXIS_ORDER]
+        return known + extra
+
+    def shape(self) -> List[int]:
+        return [self.axes[a] for a in self.ordered()]
+
+
+def build_mesh(axes: Optional[Dict[str, int]] = None, devices=None):
+    """Build a ``jax.sharding.Mesh`` over ``devices`` (default: all global
+    devices).
+
+    With ``axes=None`` the whole device set becomes one data-parallel axis —
+    the direct analogue of "N workers" in the reference.  Any one axis may be
+    given size -1 to absorb the remaining devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+
+    if not axes:
+        return Mesh(devices.reshape(n), ("dp",))
+
+    spec = dict(axes)
+    wildcards = [a for a, s in spec.items() if s == -1]
+    if len(wildcards) > 1:
+        raise ValueError(f"at most one axis may be -1, got {wildcards}")
+    if wildcards:
+        fixed = math.prod(s for s in spec.values() if s != -1)
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {spec}")
+        spec[wildcards[0]] = n // fixed
+
+    ms = MeshSpec(spec)
+    if ms.size != n:
+        raise ValueError(f"mesh {spec} wants {ms.size} devices, have {n}")
+    names = tuple(ms.ordered())
+    return Mesh(devices.reshape([spec[a] for a in names]), names)
+
+
+def mesh_from_jobs(jobs: Sequence, chips_per_task: int = 1) -> MeshSpec:
+    """Map the reference's job spec onto mesh axes (north star: ``-w`` →
+    data-parallel axis; ``-s`` > 0 enables parameter sharding, i.e. the PS
+    role collapses into FSDP).
+
+    Total devices = worker tasks × chips each.  When server/ps tasks exist,
+    the mesh gets an ``fsdp`` axis over which parameters shard; its size is
+    the full device count (pure FSDP) — matching "PS variables sharded over
+    all of ICI" rather than a literal ps count, which has no TPU meaning.
+    """
+    nworker = sum(j.num - j.start for j in jobs if j.name == "worker")
+    nps = sum(j.num - j.start for j in jobs if j.name == "ps")
+    if nworker == 0:  # generic jobs: everything data-parallel
+        total = sum((j.num - j.start) * max(1, chips_per_task) for j in jobs)
+        return MeshSpec({"dp": max(1, total)})
+    devices = nworker * max(1, chips_per_task)
+    if nps > 0:
+        return MeshSpec({"fsdp": devices})
+    return MeshSpec({"dp": devices})

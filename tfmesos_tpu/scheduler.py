@@ -1,0 +1,534 @@
+"""TPU cluster scheduler: offer matching, rendezvous, config broadcast.
+
+This is the analogue of the reference's ``TFMesosScheduler``
+(scheduler.py:180-481), re-designed rather than ported:
+
+* Resource acquisition goes through a pluggable :class:`ResourceBackend`
+  (Mesos v1 HTTP or local subprocesses) instead of hard-wiring pymesos.
+* The rendezvous loop is event-driven (``selectors``) instead of the
+  reference's 0.1s select poll (scheduler.py:322-323, 341-361).
+* The broadcast config carries everything a ``jax.distributed`` process needs
+  (rank, world size, coordinator address) in addition to the reference's
+  ``cluster_def`` map (scheduler.py:296-308), so between-graph PS replication
+  becomes a GSPMD mesh over ICI all-reduce.
+* The two-phase failure policy is preserved exactly: revive-with-new-uuid up
+  to ``MAX_FAILURE_COUNT`` before the cluster starts (scheduler.py:404-434),
+  fail-fast after (scheduler.py:394-401) — the right policy for a TPU mesh,
+  which cannot hot-swap members mid-program.
+* ``gang_scheduling=True`` additionally makes placement all-or-nothing across
+  an offer batch, matching TPU slice atomicity (a slice's topology fixes the
+  process count; partial bring-up is useless).
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import selectors
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.backends import FOREVER, ResourceBackend, first_fit
+from tfmesos_tpu.spec import Job, Offer, Task, TaskStatus
+from tfmesos_tpu.utils.logging import get_logger
+
+MAX_FAILURE_COUNT = 3  # reference: scheduler.py:181
+
+
+class ClusterError(RuntimeError):
+    """Fatal cluster failure (reference raises bare RuntimeError,
+    scheduler.py:394-401, 416-420, 445-457)."""
+
+
+class TPUMesosScheduler:
+    """Owns the task table and drives bring-up → run → teardown.
+
+    Constructor surface mirrors the reference's option set
+    (scheduler.py:183-221) with TPU-era renames: ``gpus``→``chips``,
+    ``protocol`` defaults to ``'xla'``.
+    """
+
+    def __init__(self, task_spec: List[Job], backend: Optional[ResourceBackend] = None,
+                 master: Optional[str] = None, name: Optional[str] = None,
+                 quiet: bool = False, volumes: Optional[Dict[str, str]] = None,
+                 containerizer_type: Optional[str] = None,
+                 force_pull_image: bool = False,
+                 forward_addresses: Optional[Dict[str, str]] = None,
+                 protocol: str = "xla", env: Optional[Dict[str, str]] = None,
+                 extra_config: Optional[Dict[str, Any]] = None,
+                 role: str = "*", mesh_axes: Optional[Dict[str, int]] = None,
+                 gang_scheduling: bool = False,
+                 start_timeout: float = 300.0):
+        self.task_spec = task_spec
+        self.master = master or os.environ.get("MESOS_MASTER")
+        # Default framework name mirrors scheduler.py:189-190.
+        self.name = name or f"[tpumesos] {getpass.getuser()} {' '.join(sys.argv)}"
+        self.quiet = quiet
+        self.volumes = volumes or {}
+        self.containerizer_type = containerizer_type
+        self.force_pull_image = force_pull_image
+        self.forward_addresses = forward_addresses or {}
+        self.protocol = protocol
+        self.extra_config = extra_config or {}
+        self.role = role
+        self.mesh_axes = mesh_axes
+        self.gang_scheduling = gang_scheduling
+        self.start_timeout = start_timeout
+        self.env = dict(env or {})
+
+        self.log = get_logger("tfmesos_tpu.scheduler", quiet=quiet)
+        self.token = wire.new_token()
+
+        # Expand Jobs into the task table (reference: scheduler.py:201-217).
+        # Creation order — jobs in declared order, indices ascending — IS the
+        # global rank order, the deterministic-rank precedent of the sorted
+        # cluster_def at scheduler.py:291-293.
+        self.tasks: List[Task] = []
+        for job in task_spec:
+            for task_index in range(job.start, job.num):
+                self.tasks.append(Task(job.name, task_index, cpus=job.cpus,
+                                       mem=job.mem, chips=job.chips,
+                                       cmd=job.cmd, volumes=self.volumes))
+
+        if backend is None:
+            backend = self._default_backend()
+        self.backend = backend
+
+        if not self.tasks:
+            raise ValueError("job spec expands to zero tasks")
+
+        self._lock = threading.RLock()
+        self.started = False
+        self._broadcasting = False
+        self._stopped = False
+        self._fatal: Optional[str] = None
+        self.task_failure_count: Dict[str, int] = {}
+        self.job_finished: Dict[str, int] = {}
+        self._listen: Optional[socket.socket] = None
+        self.addr: Optional[str] = None
+        self._call_id = 0
+
+    # -- backend selection -------------------------------------------------
+
+    def _default_backend(self) -> ResourceBackend:
+        if self.master in (None, "", "local"):
+            from tfmesos_tpu.backends.local import LocalBackend
+            return LocalBackend()
+        try:
+            from tfmesos_tpu.backends.mesos import MesosBackend
+        except ImportError as e:
+            raise ClusterError(f"Mesos backend unavailable: {e}") from e
+        return MesosBackend(self.master, framework_name=self.name, role=self.role)
+
+    # -- backend callback surface -----------------------------------------
+
+    def on_registered(self, info: Dict[str, Any]) -> None:
+        self.log.info("backend registered: %s", info)
+
+    def on_offers(self, offers: List[Offer]) -> None:
+        """Offer matching (reference resourceOffers, scheduler.py:223-277)."""
+        with self._lock:
+            if self._fatal or self._stopped:
+                for offer in offers:
+                    self.backend.decline(offer)
+                return
+            if all(task.offered for task in self.tasks):
+                self.backend.suppress()
+                for offer in offers:
+                    self.backend.decline(offer, refuse_seconds=FOREVER)
+                return
+
+            if self.gang_scheduling and not self._gang_fits(offers):
+                # TPU slice atomicity: refuse partial placement; short refusal
+                # so re-offers accumulate into a big enough batch.
+                for offer in offers:
+                    self.backend.decline(offer, refuse_seconds=1.0)
+                return
+
+            for offer in offers:
+                placed = first_fit(self.tasks, offer)
+                if not placed:
+                    self.backend.decline(offer)
+                    continue
+                infos = [t.to_task_info(offer, self.addr, self.token,
+                                        containerizer_type=self.containerizer_type,
+                                        force_pull_image=self.force_pull_image,
+                                        env=self.env)
+                         for t in placed]
+                self.log.info("launching %d task(s) on %s: %s",
+                              len(placed), offer.hostname, placed)
+                self.backend.launch(offer, infos)
+
+    def _gang_fits(self, offers: List[Offer]) -> bool:
+        """Would the *entire* remaining task set fit across this offer batch?"""
+        free = [[o.cpus, o.mem, o.chips] for o in offers]
+        for task in self.tasks:
+            if task.offered:
+                continue
+            for slot in free:
+                if slot[0] >= task.cpus and slot[1] >= task.mem and slot[2] >= task.chips:
+                    slot[0] -= task.cpus
+                    slot[1] -= task.mem
+                    slot[2] -= task.chips
+                    break
+            else:
+                return False
+        return True
+
+    def on_status(self, status: TaskStatus) -> None:
+        """Two-phase failure policy (reference statusUpdate,
+        scheduler.py:384-420)."""
+        with self._lock:
+            self.backend.acknowledge(status)
+            task = self._find_task(status.task_id)
+            if task is None:
+                if status.terminal and status.state != "TASK_FINISHED":
+                    # Update for a stale (revived) task id — ignore, as the
+                    # reference does for unknown ids.
+                    self.log.info("status for unknown task %s: %s",
+                                  status.task_id, status.state)
+                return
+            if not status.terminal:
+                return
+            if status.state == "TASK_FINISHED":
+                self.job_finished[task.job_name] = \
+                    self.job_finished.get(task.job_name, 0) + 1
+                self.log.info("task finished: %s (%d done in job %s)",
+                              task, self.job_finished[task.job_name], task.job_name)
+                return
+            if self.started or self._broadcasting:
+                # Post-start (or mid-broadcast, when peers may already be
+                # acting on their config): fail fast, whole-cluster abort
+                # (reference: scheduler.py:394-401).
+                self._set_fatal(f"task {task} terminated after cluster start: "
+                                f"{status.state} {status.message}")
+                return
+            # Pre-start: revive with a fresh uuid up to MAX_FAILURE_COUNT
+            # (reference: scheduler.py:404-434).
+            key = f"{task.job_name}:{task.task_index}"
+            self.task_failure_count[key] = self.task_failure_count.get(key, 0) + 1
+            if self.task_failure_count[key] >= MAX_FAILURE_COUNT:
+                self._set_fatal(f"task {task} failed {MAX_FAILURE_COUNT} times "
+                                f"during bring-up: {status.state} {status.message}")
+                return
+            self.log.warning("reviving task %s after %s (%s), attempt %d",
+                             task, status.state, status.message,
+                             self.task_failure_count[key] + 1)
+            task.reset()
+            self.backend.revive()
+
+    def on_agent_lost(self, agent_id: str) -> None:
+        """Reference slaveLost/executorLost (scheduler.py:445-453)."""
+        with self._lock:
+            if self.started:
+                self._set_fatal(f"agent lost: {agent_id}")
+            else:
+                for task in self.tasks:
+                    if task.agent_id == agent_id and not task.initialized:
+                        self.on_status(TaskStatus(task.id, "TASK_LOST",
+                                                  message="agent lost",
+                                                  agent_id=agent_id))
+
+    def on_error(self, message: str) -> None:
+        self._set_fatal(f"backend error: {message}")
+
+    def _set_fatal(self, message: str) -> None:
+        if self._fatal is None:
+            self._fatal = message
+            self.log.error("fatal: %s", message)
+
+    def _find_task(self, task_id: str) -> Optional[Task]:
+        for task in self.tasks:
+            if task.id == task_id:
+                return task
+        return None
+
+    # -- bring-up ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Bind rendezvous socket → start backend → event loop until every
+        task registers → broadcast cluster config (reference start(),
+        scheduler.py:320-369)."""
+        self._listen = wire.bind_ephemeral()
+        self.addr = wire.sock_addr(self._listen,
+                                   advertise_host=os.environ.get("TPUMESOS_ADVERTISE_HOST"))
+        self.log.info("rendezvous listening on %s", self.addr)
+        self.backend.start(self)
+
+        sel = selectors.DefaultSelector()
+        sel.register(self._listen, selectors.EVENT_READ, ("accept", None, None))
+        deadline = time.monotonic() + self.start_timeout
+        try:
+            while True:
+                with self._lock:
+                    if self._fatal:
+                        raise ClusterError(self._fatal)
+                    if all(t.initialized for t in self.tasks):
+                        break
+                if time.monotonic() > deadline:
+                    raise ClusterError(
+                        f"cluster bring-up timed out after {self.start_timeout}s; "
+                        f"uninitialized: "
+                        f"{[t for t in self.tasks if not t.initialized]}")
+                for key, _ in sel.select(timeout=0.5):
+                    kind, conn, framer = key.data
+                    if kind == "accept":
+                        conn, _ = self._listen.accept()
+                        conn.setblocking(False)
+                        sel.register(conn, selectors.EVENT_READ,
+                                     ("conn", conn, wire.Framer(self.token)))
+                        continue
+                    try:
+                        data = conn.recv(65536)
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    except OSError:
+                        data = b""
+                    if not data:
+                        sel.unregister(conn)
+                        if not self._connection_owned(conn):
+                            conn.close()
+                        continue
+                    try:
+                        msgs = framer.feed(data)
+                    except wire.WireError as e:
+                        self.log.warning("rejecting connection: %s", e)
+                        sel.unregister(conn)
+                        conn.close()
+                        continue
+                    for msg in msgs:
+                        if self._handle_register(conn, msg):
+                            sel.unregister(conn)
+            self._start_cluster()
+        except Exception:
+            self.stop()
+            raise
+        finally:
+            sel.close()
+
+    def _connection_owned(self, conn: socket.socket) -> bool:
+        return any(t.connection is conn for t in self.tasks)
+
+    def _handle_register(self, conn: socket.socket, msg: Any) -> bool:
+        """One task dialing back (reference: scheduler.py:341-361; task side
+        server.py:25-27).  Returns True when the connection is claimed by a
+        task and must leave the selector."""
+        if not (isinstance(msg, dict) and msg.get("op") == "register"):
+            self.log.warning("unexpected rendezvous message: %r", msg)
+            return False
+        task = self._find_task(msg.get("task_id", ""))
+        if task is None:
+            self.log.warning("registration from unknown/stale task id %s",
+                             msg.get("task_id"))
+            conn.close()
+            return True
+        with self._lock:
+            task.addr = msg["addr"]
+            task.coord_port = int(msg.get("coord_port") or 0)
+            task.connection = conn
+            task.initialized = True
+        self.log.info("task registered: %s", task)
+        return True
+
+    def _start_cluster(self) -> None:
+        """Broadcast per-task config and await acks (reference
+        _start_tf_cluster, scheduler.py:288-318).
+
+        The revive window closes here: once every task has registered and the
+        broadcast begins, peers may already be acting on their config, so a
+        task death during the broadcast is fatal (matching the reference,
+        where a socket error in _start_tf_cluster aborts bring-up).
+        """
+        with self._lock:
+            self._broadcasting = True
+            # Snapshot connections under the lock: the revive path can close
+            # and null task.connection from the status-watcher thread.
+            conns = [(task, task.connection) for task in self.tasks]
+            if any(conn is None for _, conn in conns):
+                raise ClusterError("task lost between registration and broadcast")
+            cluster_def = self.cluster_def
+
+        world_size = len(self.tasks)
+        rank0 = self.tasks[0]
+        coordinator = f"{rank0.addr.rsplit(':', 1)[0]}:{rank0.coord_port}"
+
+        for rank, (task, conn) in enumerate(conns):
+            conn.setblocking(True)
+            conn.settimeout(self.start_timeout)
+            config = {
+                "job_name": task.job_name,
+                "task_index": task.task_index,
+                "rank": rank,
+                "world_size": world_size,
+                "cpus": task.cpus,
+                "mem": task.mem,
+                "chips": task.chips,
+                "cmd": task.cmd,
+                "cwd": os.getcwd(),
+                "cluster_def": cluster_def,
+                "coordinator": coordinator,
+                "forward_addresses": self.forward_addresses,
+                "extra_config": self.extra_config,
+                "protocol": self.protocol,
+                "mesh_axes": self.mesh_axes,
+                "env": self.env,
+            }
+            try:
+                wire.send_msg(conn, config, self.token)
+            except OSError as e:
+                raise ClusterError(f"task {task} died during config broadcast: {e}")
+        for task, conn in conns:
+            try:
+                ack = wire.recv_msg(conn, self.token)
+            except (OSError, wire.WireError) as e:
+                raise ClusterError(f"task {task} died before acking: {e}")
+            if ack != "ok":
+                raise ClusterError(f"task {task} failed to ack: {ack!r}")
+            self.log.info("task %s ready", task)
+            if task.cmd is not None:
+                # Mode B: the control connection's job is done
+                # (reference closes here for both modes, scheduler.py:318;
+                # Mode A keeps it open as the SPMD dispatch channel).
+                conn.close()
+                task.connection = None
+        with self._lock:
+            self.started = True
+        self.log.info("cluster started: %d task(s), coordinator %s",
+                      world_size, coordinator)
+
+    # -- user-facing surface ----------------------------------------------
+
+    @property
+    def targets(self) -> Dict[str, str]:
+        """Session-target map, kept for API parity with the reference
+        (scheduler.py:279-286); the scheme reflects the data plane."""
+        return {
+            f"/job:{t.job_name}/task:{t.task_index}": f"{self.protocol}://{t.addr}"
+            for t in self.tasks
+        }
+
+    @property
+    def cluster_def(self) -> Dict[str, List[str]]:
+        return {
+            job.name: [t.addr for t in sorted(
+                (t for t in self.tasks if t.job_name == job.name),
+                key=lambda t: t.task_index)]
+            for job in self.task_spec
+        }
+
+    def run(self, func: Any, *args: Any, **kwargs: Any) -> Any:
+        """SPMD dispatch: run ``func`` on every Mode-A task, return rank 0's
+        result.
+
+        This is the TPU-native successor of the reference's in-graph mode:
+        where a TF driver placed ops with ``tf.device('/job:ps/task:0')`` and
+        ran them through a remote session (examples/plus.py:23-33), a JAX
+        driver ships one function that every process executes under the
+        ``jax.distributed`` runtime; sharding — not device strings — decides
+        placement.
+
+        ``func`` may be a callable (resolved by module+qualname on the task,
+        so it must be importable there — the scheduler's ``sys.path`` is
+        forwarded, reference precedent scheduler.py:168-176) or an explicit
+        ``"module:qualname"`` string.  Arguments must be JSON-serializable.
+        """
+        results = self.run_all(func, *args, **kwargs)
+        return results[0]
+
+    def run_all(self, func: Any, *args: Any, **kwargs: Any) -> List[Any]:
+        with self._lock:
+            if not self.started:
+                raise ClusterError("cluster not started")
+            if self._fatal:
+                raise ClusterError(self._fatal)
+            self._call_id += 1
+            call_id = self._call_id
+        spec = _func_spec(func)
+        mode_a = [t for t in self.tasks if t.cmd is None and t.connection is not None]
+        if not mode_a:
+            raise ClusterError("no in-graph (cmd=None) tasks to dispatch to")
+        msg = {"op": "run", "call_id": call_id, "func": spec,
+               "args": list(args), "kwargs": kwargs}
+        for task in mode_a:
+            wire.send_msg(task.connection, msg, self.token)
+        # Drain every task's reply before judging any of them: raising early
+        # would leave unread frames queued and desynchronize later calls.
+        results = []
+        errors = []
+        for task in mode_a:
+            reply = wire.recv_msg(task.connection, self.token)
+            if not (isinstance(reply, dict) and reply.get("call_id") == call_id):
+                raise ClusterError(f"bad reply from {task}: {reply!r}")
+            if not reply.get("ok"):
+                errors.append(f"on {task}:\n{reply.get('error')}")
+            results.append(reply.get("value"))
+        if errors:
+            raise ClusterError("remote failure " + "\n".join(errors))
+        return results
+
+    def finished(self) -> bool:
+        """True when any job has fully TASK_FINISHED (reference semantics —
+        all workers done ends the run even though ps tasks never exit,
+        scheduler.py:474-477)."""
+        with self._lock:
+            if self._fatal:
+                raise ClusterError(self._fatal)
+            return any(
+                self.job_finished.get(job.name, 0) >= (job.num - job.start)
+                for job in self.task_spec
+            )
+
+    def join(self, poll: float = 0.1) -> None:
+        """Block until ``finished()`` (tfrun's poll loop, tfrun:101-102)."""
+        while not self.finished():
+            time.sleep(poll)
+
+    def stop(self) -> None:
+        """Teardown (reference stop(), scheduler.py:459-472)."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        for task in self.tasks:
+            if task.connection is not None:
+                try:
+                    wire.send_msg(task.connection, {"op": "shutdown"}, self.token)
+                except OSError:
+                    pass
+                try:
+                    task.connection.close()
+                except OSError:
+                    pass
+                task.connection = None
+        self.backend.stop()
+        if self._listen is not None:
+            self._listen.close()
+            self._listen = None
+        self.log.info("scheduler stopped")
+
+
+def _func_spec(func: Any) -> dict:
+    if isinstance(func, str):
+        module, _, qualname = func.partition(":")
+        if not qualname:
+            raise ValueError(f"func string must be 'module:qualname', got {func!r}")
+        return {"module": module, "qualname": qualname, "path": None}
+    module = getattr(func, "__module__", None)
+    qualname = getattr(func, "__qualname__", None)
+    if not module or not qualname or "<locals>" in qualname:
+        raise ValueError(
+            f"{func!r} is not addressable as module:qualname; define it at "
+            f"module top level (lambdas/closures cannot be shipped)")
+    path = None
+    if module == "__main__":
+        main_mod = sys.modules.get("__main__")
+        path = getattr(main_mod, "__file__", None)
+        if path is None:
+            raise ValueError("cannot ship a __main__ function from an "
+                             "interactive session; use 'module:qualname'")
+        path = os.path.abspath(path)
+    return {"module": module, "qualname": qualname, "path": path}

@@ -1,0 +1,228 @@
+"""Node runtime: the process Mesos (or the local backend) launches per task.
+
+Bootstrap contract matches the reference (server.py:14-49): dial the
+scheduler's rendezvous address given on the command line, register, receive
+the cluster config, ack — then enter one of two modes:
+
+* **Mode A (in-graph successor)** — ``cmd is None``.  The reference started a
+  ``tf.train.Server`` and parked forever (server.py:51-66), serving remotely
+  placed ops.  There is no remote-session concept in JAX, so Mode A instead
+  joins the ``jax.distributed`` runtime and serves an SPMD executor loop on
+  the (kept-open) control connection: the driver ships a function reference,
+  every process runs it, rank 0's result returns to the driver.
+* **Mode B (between-graph)** — ``cmd`` set.  Exec the user command with the
+  env contract and ``{placeholder}`` substitution, pumping child stdout to
+  our stdout and optionally over TCP to the log collector, with
+  initializer/finalizer hooks — the reference behavior (server.py:67-113) on
+  the new transport.
+
+Usage: ``python -m tfmesos_tpu.server <task_id> <scheduler_addr>``
+(launch site: spec.Task.to_task_info; reference: scheduler.py:163-167).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import traceback
+from typing import Any, Dict, Optional
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.runtime import TaskContext, initialize, task_env
+from tfmesos_tpu.utils.logging import get_logger
+
+log = get_logger("tfmesos_tpu.server")
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print("usage: python -m tfmesos_tpu.server <task_id> <scheduler_addr>",
+              file=sys.stderr)
+        return 2
+    task_id, scheduler_addr = argv
+    token = os.environ.get(wire.TOKEN_ENV, "")
+
+    # Our own identity address (reference: server.py:18-21).  The listening
+    # socket is identity only; control flows over the dial-back connection.
+    listen = wire.bind_ephemeral()
+    addr = wire.sock_addr(listen, advertise_host=os.environ.get("TPUMESOS_ADVERTISE_HOST"))
+
+    # Reserve a port for the jax.distributed coordinator service; rank 0's
+    # reservation becomes the cluster coordinator address.
+    coord_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    coord_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    coord_sock.bind(("", 0))
+    coord_port = coord_sock.getsockname()[1]
+
+    sock = wire.connect(scheduler_addr)
+    wire.send_msg(sock, {"op": "register", "task_id": task_id, "addr": addr,
+                         "coord_port": coord_port}, token)
+    # The config broadcast only happens once EVERY task has registered, which
+    # can be long after our own registration (peers may still be waiting for
+    # resources) — so this wait gets its own generous timeout.
+    sock.settimeout(float(os.environ.get("TPUMESOS_HANDSHAKE_TIMEOUT", "300")))
+    config = wire.recv_msg(sock, token)
+    log.info("task %s registered as %s:%s rank=%s", task_id[:8],
+             config.get("job_name"), config.get("task_index"), config.get("rank"))
+
+    # Only Mode B forwards child output; Mode A has no child to pump.
+    forward_fd = _connect_forwarder(config) if config.get("cmd") is not None else None
+    wire.send_msg(sock, "ok", token)
+
+    coord_sock.close()  # free the reserved port just before anyone binds it
+    listen.close()
+
+    if config.get("cmd") is None:
+        return _run_executor(sock, config, token)
+    sock.close()
+    return _run_cmd(config, forward_fd)
+
+
+# -- Mode A: SPMD executor -------------------------------------------------
+
+
+def _run_executor(sock: socket.socket, config: Dict[str, Any], token: str) -> int:
+    ctx = TaskContext.from_config(config)
+    os.environ.update(task_env(config))
+    for key, value in (config.get("env") or {}).items():
+        os.environ[str(key)] = str(value)
+    if not ctx.extra_config.get("no_jax"):
+        initialize(ctx)
+    sock.settimeout(None)
+    while True:
+        try:
+            msg = wire.recv_msg(sock, token)
+        except (wire.WireError, OSError):
+            # Scheduler went away: teardown (reference Mode A parks until the
+            # Mesos executor kills it; our exit is graceful).
+            return 0
+        if not isinstance(msg, dict):
+            continue
+        op = msg.get("op")
+        if op == "shutdown":
+            return 0
+        if op != "run":
+            log.warning("unknown op %r", op)
+            continue
+        reply: Dict[str, Any] = {"op": "result", "call_id": msg.get("call_id")}
+        try:
+            func = _resolve_func(msg["func"])
+            value = func(ctx, *msg.get("args", ()), **msg.get("kwargs", {}))
+            try:
+                json.dumps(value)
+            except (TypeError, ValueError):
+                value = repr(value)
+            reply.update(ok=True, value=value)
+        except BaseException:
+            reply.update(ok=False, error=traceback.format_exc())
+        try:
+            wire.send_msg(sock, reply, token)
+        except OSError:
+            return 0
+
+
+def _resolve_func(spec: Dict[str, Any]):
+    module_name, qualname, path = spec["module"], spec["qualname"], spec.get("path")
+    if path:
+        # Function was defined in the driver's __main__ script: import that
+        # file as a module (shared-filesystem assumption, same as the
+        # reference's cwd forwarding, server.py:95-98).
+        loaded = sys.modules.get("__tpumesos_driver__")
+        if loaded is None or getattr(loaded, "__file__", None) != path:
+            mod_spec = importlib.util.spec_from_file_location("__tpumesos_driver__", path)
+            loaded = importlib.util.module_from_spec(mod_spec)
+            sys.modules["__tpumesos_driver__"] = loaded
+            mod_spec.loader.exec_module(loaded)
+        target = loaded
+    else:
+        target = importlib.import_module(module_name)
+    obj: Any = target
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+# -- Mode B: user command --------------------------------------------------
+
+
+class _SafeDict(dict):
+    """``str.format_map`` helper: leave unknown ``{placeholders}`` intact."""
+
+    def __missing__(self, key: str) -> str:
+        return "{" + key + "}"
+
+
+def _substitute_cmd(cmd: str, config: Dict[str, Any]) -> str:
+    """Reference placeholder contract (server.py:89-92) plus TPU-era keys."""
+    cluster_def = config.get("cluster_def") or {}
+    mapping = _SafeDict(
+        ps_hosts=",".join(cluster_def.get("ps", [])),
+        worker_hosts=",".join(cluster_def.get("worker", [])),
+        job_name=config.get("job_name", ""),
+        task_index=config.get("task_index", 0),
+        rank=config.get("rank", 0),
+        world_size=config.get("world_size", 1),
+        coordinator=config.get("coordinator", ""),
+    )
+    return cmd.format_map(mapping)
+
+
+def _connect_forwarder(config: Dict[str, Any]) -> Optional[socket.socket]:
+    """Dial the log collector if this task's logs were requested
+    (reference: server.py:41-46; collector side lives in cli.py)."""
+    forward = (config.get("forward_addresses") or {})
+    key = f"{config.get('job_name')}:{config.get('task_index')}"
+    target = forward.get(key) or forward.get("*")
+    if not target:
+        return None
+    try:
+        return wire.connect(target, timeout=10.0)
+    except OSError as e:
+        log.warning("cannot reach log collector %s: %s", target, e)
+        return None
+
+
+def _run_cmd(config: Dict[str, Any], forward_fd: Optional[socket.socket]) -> int:
+    extra = config.get("extra_config") or {}
+    env = dict(os.environ)
+    env.update(task_env(config))
+    for key, value in (config.get("env") or {}).items():
+        env[str(key)] = str(value)
+
+    initializer = extra.get("initializer")
+    if initializer:
+        subprocess.check_call(initializer, shell=True, env=env)
+
+    cmd = _substitute_cmd(config["cmd"], config)
+    cwd = config.get("cwd")
+    if cwd and not os.path.isdir(cwd):
+        cwd = None  # no shared filesystem; run where we are
+    log.info("exec: %s", cmd)
+    proc = subprocess.Popen(cmd, shell=True, env=env, cwd=cwd,
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    prefix = f"[{config.get('job_name')}:{config.get('task_index')}] ".encode()
+    from tfmesos_tpu.logpump import pump_lines
+    pump_lines(proc.stdout, sys.stdout.buffer,
+               forward_fd.fileno() if forward_fd else -1, prefix)
+    rc = proc.wait()
+
+    finalizer = extra.get("finalizer")
+    if finalizer:
+        subprocess.check_call(finalizer, shell=True, env=env)
+    if forward_fd is not None:
+        try:
+            forward_fd.close()
+        except OSError:
+            pass
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

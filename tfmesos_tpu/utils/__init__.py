@@ -1,0 +1,3 @@
+from tfmesos_tpu.utils.logging import setup_logger, get_logger
+
+__all__ = ["setup_logger", "get_logger"]

@@ -1,0 +1,30 @@
+"""Structured logging (reference: tfmesos/utils.py:18-27, console-only INFO).
+
+We keep the same one-call setup surface but emit a structured, parseable
+format and allow a level override via ``TPUMESOS_LOGLEVEL``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_FORMAT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+
+
+def setup_logger(logger: logging.Logger, quiet: bool = False) -> None:
+    if quiet:
+        return
+    if any(isinstance(h, logging.StreamHandler) for h in logger.handlers):
+        return
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    level = os.environ.get("TPUMESOS_LOGLEVEL", "INFO").upper()
+    logger.setLevel(getattr(logging, level, logging.INFO))
+
+
+def get_logger(name: str, quiet: bool = False) -> logging.Logger:
+    logger = logging.getLogger(name)
+    setup_logger(logger, quiet=quiet)
+    return logger

@@ -1,0 +1,145 @@
+"""Authenticated control-plane wire protocol.
+
+The reference framework (tfmesos/utils.py:6-15) frames messages as a 4-byte
+big-endian length followed by a *pickle* payload, unauthenticated.  That design
+is reproduced here in shape only: we keep the simple length-prefixed framing
+(so the control plane stays a handful of syscalls per message) but replace the
+encoding with JSON and add an HMAC-SHA256 tag keyed by a per-cluster token, so
+a task can only join the rendezvous if it was launched by our scheduler.
+
+Frame layout::
+
+    +----------------+----------------------+------------------+
+    | 4B len (BE)    | 32B HMAC-SHA256 tag  | JSON body (UTF8) |
+    +----------------+----------------------+------------------+
+
+``len`` counts tag + body.  When ``token`` is empty the tag is still present
+but computed with the empty key, keeping the frame layout static.
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import json
+import os
+import socket
+import struct
+from typing import Any, List, Optional
+
+_LEN = struct.Struct(">I")
+TAG_SIZE = hashlib.sha256().digest_size  # 32
+MAX_FRAME = 64 * 1024 * 1024  # sanity bound; control messages are tiny
+
+TOKEN_ENV = "TPUMESOS_TOKEN"
+
+
+class WireError(Exception):
+    """Malformed, oversized, or unauthenticated frame."""
+
+
+def new_token() -> str:
+    """Fresh per-cluster auth token (scheduler generates one per bring-up)."""
+    return os.urandom(16).hex()
+
+
+def _tag(token: str, body: bytes) -> bytes:
+    return hmac.new(token.encode("utf-8"), body, hashlib.sha256).digest()
+
+
+def encode(obj: Any, token: str = "") -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    tag = _tag(token, body)
+    return _LEN.pack(TAG_SIZE + len(body)) + tag + body
+
+
+def _decode_body(payload: bytes, token: str) -> Any:
+    if len(payload) < TAG_SIZE:
+        raise WireError("frame shorter than auth tag")
+    tag, body = payload[:TAG_SIZE], payload[TAG_SIZE:]
+    if not hmac.compare_digest(tag, _tag(token, body)):
+        raise WireError("bad auth tag")
+    try:
+        return json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"bad JSON body: {e}") from e
+
+
+def send_msg(sock: socket.socket, obj: Any, token: str = "") -> None:
+    sock.sendall(encode(obj, token))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise WireError("connection closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket, token: str = "") -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME:
+        raise WireError(f"frame of {length} bytes exceeds limit")
+    return _decode_body(_recv_exact(sock, length), token)
+
+
+class Framer:
+    """Incremental decoder for non-blocking / selector-driven loops.
+
+    The scheduler's rendezvous loop (the analogue of the reference's 0.1s
+    select poll, scheduler.py:341-361, but event-driven) feeds raw bytes in
+    and pulls complete decoded messages out.
+    """
+
+    def __init__(self, token: str = "") -> None:
+        self._token = token
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Any]:
+        self._buf.extend(data)
+        out = []
+        while True:
+            if len(self._buf) < _LEN.size:
+                break
+            (length,) = _LEN.unpack(bytes(self._buf[: _LEN.size]))
+            if length > MAX_FRAME:
+                raise WireError(f"frame of {length} bytes exceeds limit")
+            end = _LEN.size + length
+            if len(self._buf) < end:
+                break
+            payload = bytes(self._buf[_LEN.size : end])
+            del self._buf[:end]
+            out.append(_decode_body(payload, self._token))
+        return out
+
+
+def connect(addr: str, timeout: Optional[float] = 30.0) -> socket.socket:
+    """Dial a ``host:port`` string (the form used throughout the control plane)."""
+    host, port = addr.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.settimeout(timeout)
+    return sock
+
+
+def bind_ephemeral(host: str = "0.0.0.0") -> socket.socket:
+    """Bind a listening socket on an OS-assigned port (reference pattern at
+    scheduler.py:325-328 / server.py:18-21)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, 0))
+    sock.listen(128)
+    return sock
+
+
+def sock_addr(sock: socket.socket, advertise_host: Optional[str] = None) -> str:
+    host, port = sock.getsockname()[:2]
+    if advertise_host:
+        host = advertise_host
+    elif host in ("0.0.0.0", "::"):
+        host = socket.gethostbyname(socket.gethostname())
+    return f"{host}:{port}"
