@@ -1,0 +1,107 @@
+"""Project benchmark: mnist_replica steps/sec/chip (BASELINE.json metric).
+
+Runs the reference's canonical workload — the mnist_replica trainer at its
+published scale (batch 100, hidden 100, mnist_replica.py:70-73) — as a jit'd
+sync-SGD step on this host's accelerator, plus the flagship transformer as a
+secondary throughput probe, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+baseline is our own first measured value on the v5e-1 chip, recorded in
+BASELINE_SELF below; >1.0 means faster than round-1's framework.
+"""
+
+import json
+import time
+
+import numpy as np
+
+# Round-1 self-measured baseline on one v5e chip (steps/sec/chip for the
+# mnist_replica workload below).  Established 2026-07-28; see BASELINE.md.
+BASELINE_SELF = 22000.0
+
+
+def bench_mnist_replica(steps=600, warmup=100):
+    import jax
+    import optax
+    from tfmesos_tpu.models import mlp
+    from tfmesos_tpu.train import data as datalib
+
+    cfg = mlp.MLPConfig(hidden=100)
+    params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(0.01)  # reference lr (mnist_replica.py:71)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            lambda p: mlp.loss_fn(cfg, p, batch), has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    ds = datalib.SyntheticMNIST()
+    batch = {k: jax.device_put(v) for k, v in next(ds.batches(100)).items()}
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    n_chips = max(1, jax.device_count())
+    return steps / dt / n_chips, float(loss)
+
+
+def bench_transformer_tokens(iters=20):
+    import jax
+    import jax.numpy as jnp
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+        max_seq_len=1024, dtype=jnp.bfloat16)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    b, t = 8, 1024
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, t + 1), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+
+    grad_fn = jax.jit(jax.grad(
+        lambda p: transformer.loss_fn(cfg, p, {"tokens": tokens})[0]))
+    g = grad_fn(params)
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = grad_fn(params)
+    jax.block_until_ready(g)
+    dt = (time.perf_counter() - t0) / iters
+    return b * t / dt  # tokens/sec (fwd+bwd)
+
+
+def main():
+    import jax
+
+    value, final_loss = bench_mnist_replica()
+    tokens_per_sec = None
+    try:
+        tokens_per_sec = bench_transformer_tokens()
+    except Exception:
+        pass
+    out = {
+        "metric": "mnist_replica_steps_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "steps/s/chip",
+        "vs_baseline": round(value / BASELINE_SELF, 3),
+        "backend": jax.default_backend(),
+        "n_chips": jax.device_count(),
+        "final_loss": round(final_loss, 4),
+    }
+    if tokens_per_sec is not None:
+        out["transformer_tokens_per_sec"] = round(tokens_per_sec, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

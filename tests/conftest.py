@@ -1,7 +1,12 @@
-"""Test env: force JAX onto a virtual 8-device CPU mesh BEFORE jax import.
+"""Test env: force JAX onto a virtual 8-device CPU mesh BEFORE backends init.
 
 Multi-chip sharding is validated on virtual CPU devices (the driver's
 ``dryrun_multichip`` does the same); nothing in tests/ touches real TPU.
+
+Note: the JAX_PLATFORMS *env var* is not enough here — a site-installed PJRT
+plugin may override platform selection through ``jax.config`` at interpreter
+start, so we set the config explicitly (it wins as long as no backend has
+been initialized yet).
 """
 
 import os
@@ -12,3 +17,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("TPUMESOS_LOGLEVEL", "WARNING")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

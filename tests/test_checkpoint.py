@@ -1,0 +1,51 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tfmesos_tpu.models import mlp
+from tfmesos_tpu.train.checkpoint import CheckpointManager
+from tfmesos_tpu.train.trainer import TrainState, make_train_step
+from tfmesos_tpu.train import data as datalib
+
+
+def test_save_restore_roundtrip(tmp_path):
+    cfg = mlp.MLPConfig(in_dim=16, hidden=8, n_classes=4)
+    params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+    state = {"params": params, "step": jnp.asarray(7)}
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.latest_step() is None
+    mgr.save(7, state)
+    assert mgr.latest_step() == 7
+
+    like = jax.tree_util.tree_map(jnp.zeros_like, state)
+    restored = mgr.restore(like)
+    assert int(restored["step"]) == 7
+    np.testing.assert_allclose(np.asarray(restored["params"]["w1"]),
+                               np.asarray(params["w1"]))
+    mgr.close()
+
+
+def test_resume_training_continues(tmp_path):
+    cfg = mlp.MLPConfig(in_dim=16, hidden=8, n_classes=4)
+    ds = datalib.SyntheticMNIST(n_classes=4, dim=16)
+    opt = optax.sgd(0.1)
+    step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt)
+
+    params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+    gen = ds.batches(32)
+    for _ in range(5):
+        params, opt_state, m1 = step(params, opt_state, next(gen))
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(5, {"params": params, "opt_state": opt_state})
+
+    like = {"params": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "opt_state": jax.tree_util.tree_map(jnp.zeros_like, opt_state)}
+    restored = mgr.restore(like)
+    p2, o2 = restored["params"], restored["opt_state"]
+    p2, o2, m2 = step(p2, o2, next(gen))
+    assert np.isfinite(float(m2["loss"]))
+    mgr.close()

@@ -1,0 +1,166 @@
+"""Mesh, sharding, collectives, ring attention, pipeline — all on the
+8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tfmesos_tpu.parallel import MeshSpec, build_mesh, mesh_from_jobs
+from tfmesos_tpu.parallel import collectives as col
+from tfmesos_tpu.parallel.pipeline import (pipeline_apply, stack_stage_params,
+                                           stage_sharding_tree)
+from tfmesos_tpu.parallel.ring_attention import ring_attention
+from tfmesos_tpu.parallel.sharding import (batch_spec, fsdp_sharding_tree,
+                                           fsdp_spec)
+from tfmesos_tpu.ops.attention import mha_reference
+from tfmesos_tpu.spec import Job
+
+
+def test_virtual_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_mesh_spec_ordering_and_size():
+    ms = MeshSpec({"tp": 2, "dp": 2, "sp": 2})
+    assert ms.ordered() == ["dp", "sp", "tp"]  # canonical AXIS_ORDER
+    assert ms.size == 8
+
+
+def test_build_mesh_default_and_wildcard():
+    mesh = build_mesh()
+    assert mesh.axis_names == ("dp",) and mesh.size == 8
+    mesh = build_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    with pytest.raises(ValueError):
+        build_mesh({"dp": 3})
+    with pytest.raises(ValueError):
+        build_mesh({"dp": -1, "tp": -1})
+
+
+def test_mesh_from_jobs_north_star():
+    # -w → dp axis; -s > 0 collapses PS into FSDP (BASELINE.json north star).
+    assert mesh_from_jobs([Job(name="worker", num=4)]).axes == {"dp": 4}
+    spec = mesh_from_jobs([Job(name="ps", num=2), Job(name="worker", num=4)],
+                          chips_per_task=2)
+    assert spec.axes == {"fsdp": 8}
+
+
+def test_fsdp_spec_rules():
+    mesh = build_mesh({"fsdp": 8})
+    assert fsdp_spec((1024, 512), mesh) == P("fsdp", None)
+    assert fsdp_spec((512, 1024), mesh) == P(None, "fsdp")
+    assert fsdp_spec((100,), mesh) == P()          # too small: replicate
+    assert fsdp_spec((7, 1027), mesh) == P()       # nothing divisible
+    params = {"w": jnp.zeros((256, 128)), "b": jnp.zeros((128,))}
+    tree = fsdp_sharding_tree(params, mesh)
+    assert tree["w"].spec == P("fsdp", None)
+    assert tree["b"].spec == P()
+
+
+def test_batch_spec_variants():
+    assert batch_spec(build_mesh({"dp": 8})) == P(("dp",))
+    mesh = build_mesh({"dp": 2, "sp": 2, "tp": 2})
+    assert batch_spec(mesh, extra_dims=2) == P(("dp",), "sp", None)
+
+
+def test_collectives_roundtrip():
+    mesh = build_mesh({"dp": 8})
+
+    def f(x):
+        return (col.all_reduce_sum(x, "dp"), col.all_reduce_mean(x, "dp"),
+                col.ppermute_shift(x, "dp", 1),
+                col.axis_index("dp").reshape(1, 1))
+
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    s, m, rolled, idx = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P("dp"),
+        out_specs=(P("dp"), P("dp"), P("dp"), P("dp")), check_vma=False))(x)
+    np.testing.assert_allclose(s, np.full((8, 1), 28.0))
+    np.testing.assert_allclose(m, np.full((8, 1), 3.5))
+    np.testing.assert_allclose(rolled.ravel(), np.roll(np.arange(8), 1))
+    np.testing.assert_array_equal(idx.ravel(), np.arange(8))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    mesh = build_mesh({"sp": 8})
+    b, t, h, d = 2, 64, 2, 16
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, h, d), jnp.float32)
+
+    expected = mha_reference(q, k, v, causal=causal)
+    got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=causal))(
+        q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gradients_match():
+    mesh = build_mesh({"sp": 8})
+    b, t, h, d = 1, 32, 1, 8
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(s, (b, t, h, d)) for s in jax.random.split(key, 3))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, e in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_fallback_no_sp_axis():
+    mesh = build_mesh({"dp": 8})
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 1, 8))
+    out = ring_attention(q, q, q, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(mha_reference(q, q, q, causal=True)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_matches_sequential():
+    n_stages, mb = 4, 8
+    mesh = build_mesh({"pp": 4, "dp": 2})
+    key = jax.random.PRNGKey(2)
+    dim = 16
+
+    def stage_fn(params, h):
+        return jnp.tanh(h @ params["w"] + params["b"])
+
+    stages = []
+    for i in range(n_stages):
+        k1, key = jax.random.split(key)
+        stages.append({"w": jax.random.normal(k1, (dim, dim)) / np.sqrt(dim),
+                       "b": jnp.zeros((dim,))})
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(key, (mb * 2, dim))
+
+    expected = x
+    for s in stages:
+        expected = stage_fn(s, expected)
+
+    got = jax.jit(lambda p, x: pipeline_apply(stage_fn, p, x, mesh,
+                                              num_microbatches=mb))(stacked, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+    # sharding helper produces pp-leading specs
+    tree = stage_sharding_tree(stacked, mesh)
+    assert tree["w"].spec == P("pp", None, None)
+
+
+def test_pipeline_single_stage_shortcut():
+    mesh = build_mesh({"pp": 1, "dp": 8})
+    params = stack_stage_params([{"w": jnp.eye(4), "b": jnp.zeros(4)}])
+    x = jnp.ones((4, 4))
+    out = pipeline_apply(lambda p, h: h @ p["w"] + p["b"], params, x, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.ones((4, 4))))

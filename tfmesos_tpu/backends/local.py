@@ -82,10 +82,14 @@ class LocalBackend(ResourceBackend):
         for info in task_infos:
             task_id = info["task_id"]["value"]
             env = dict(os.environ) if self.inherit_env else {}
+            if self.default_platform:
+                # Override the *inherited* platform pin (a site-installed TPU
+                # plugin's env would make co-located processes fight over one
+                # chip) — but before the task-env merge, so an explicit
+                # JAX_PLATFORMS passed via the scheduler's env= still wins.
+                env["JAX_PLATFORMS"] = self.default_platform
             for var in info["command"]["environment"]["variables"]:
                 env[var["name"]] = var["value"]
-            if self.default_platform:
-                env.setdefault("JAX_PLATFORMS", self.default_platform)
             cmd = info["command"]["value"]
             argv = cmd if info["command"].get("shell") else shlex.split(cmd)
             res = info["resources"]

@@ -1,0 +1,49 @@
+"""The mnist_replica workload model (reference examples/mnist/mnist_replica.py).
+
+Same architecture scale as the reference trainer — one hidden layer
+(default 100 units, mnist_replica.py:70-73), softmax cross entropy — built
+as a pure-jax functional model whose gradients sync over the mesh instead of
+flowing through parameter servers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from tfmesos_tpu.ops.layers import cross_entropy_loss
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: int = 100      # reference default (mnist_replica.py:70)
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def init_params(cfg: MLPConfig, rng) -> Dict[str, Any]:
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (cfg.in_dim, cfg.hidden), cfg.dtype)
+        / jnp.sqrt(cfg.in_dim),
+        "b1": jnp.zeros((cfg.hidden,), cfg.dtype),
+        "w2": jax.random.normal(k2, (cfg.hidden, cfg.n_classes), cfg.dtype)
+        / jnp.sqrt(cfg.hidden),
+        "b2": jnp.zeros((cfg.n_classes,), cfg.dtype),
+    }
+
+
+def forward(cfg: MLPConfig, params, x):
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def loss_fn(cfg: MLPConfig, params, batch, mesh=None):
+    logits = forward(cfg, params, batch["image"])
+    loss = cross_entropy_loss(logits, batch["label"])
+    acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+    return loss, {"accuracy": acc}

@@ -1,0 +1,239 @@
+"""Flagship model: decoder-only transformer, TPU-first.
+
+Nothing like this exists in the reference (its largest workload is a
+2-layer MLP, SURVEY §2.5) — this is the model family that exercises every
+mesh axis the framework offers:
+
+* ``dp``/``fsdp`` — batch sharding + FSDP parameter sharding (the GSPMD
+  successor of parameter servers),
+* ``tp`` — Megatron-style tensor parallelism (heads/ff sharded, vocab-
+  parallel embedding/head),
+* ``sp`` — ring attention over the sequence (parallel/ring_attention.py),
+* ``pp`` — pipeline stages over layer groups (parallel/pipeline.py),
+* ``ep`` — expert-parallel MoE blocks.
+
+Design choices for the MXU/XLA: stacked per-layer parameters consumed by
+``lax.scan`` (one compiled block, L iterations), bf16 compute with fp32
+master params and fp32 softmax/normalization accumulation, static shapes
+throughout, optional ``jax.checkpoint`` rematerialization.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tfmesos_tpu.ops.attention import attend
+from tfmesos_tpu.ops.layers import cross_entropy_loss, rms_norm, rope, swiglu
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16          # compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32     # master params
+    remat: bool = False                # jax.checkpoint each block
+    # MoE (0 experts = dense):
+    n_experts: int = 0
+    top_k: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
+    d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    hd = cfg.n_heads * cfg.head_dim
+    keys = iter(jax.random.split(rng, 16))
+
+    def norm(shape, scale):
+        return (jax.random.normal(next(keys), shape, cfg.param_dtype)
+                * scale).astype(cfg.param_dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((l, d), cfg.param_dtype),
+        "wq": norm((l, d, hd), 1 / math.sqrt(d)),
+        "wk": norm((l, d, hd), 1 / math.sqrt(d)),
+        "wv": norm((l, d, hd), 1 / math.sqrt(d)),
+        "wo": norm((l, hd, d), 1 / math.sqrt(hd) / math.sqrt(2 * l)),
+        "mlp_norm": jnp.ones((l, d), cfg.param_dtype),
+    }
+    if cfg.n_experts:
+        e = cfg.n_experts
+        layers.update(
+            router=norm((l, d, e), 1 / math.sqrt(d)),
+            e_gate=norm((l, e, d, f), 1 / math.sqrt(d)),
+            e_up=norm((l, e, d, f), 1 / math.sqrt(d)),
+            e_down=norm((l, e, f, d), 1 / math.sqrt(f) / math.sqrt(2 * l)),
+        )
+    else:
+        layers.update(
+            w_gate=norm((l, d, f), 1 / math.sqrt(d)),
+            w_up=norm((l, d, f), 1 / math.sqrt(d)),
+            w_down=norm((l, f, d), 1 / math.sqrt(f) / math.sqrt(2 * l)),
+        )
+    return {
+        "embed": norm((cfg.vocab_size, d), 1.0),
+        "layers": layers,
+        "norm_f": jnp.ones((d,), cfg.param_dtype),
+        "head": norm((d, cfg.vocab_size), 1 / math.sqrt(d)),
+    }
+
+
+def _mlp(cfg: TransformerConfig, lp, h):
+    return swiglu(h, lp["w_gate"].astype(cfg.dtype),
+                  lp["w_up"].astype(cfg.dtype), lp["w_down"].astype(cfg.dtype))
+
+
+def _moe(cfg: TransformerConfig, lp, h):
+    """Top-k routed MoE, computed densely over the expert axis.
+
+    Every expert processes every token and the router mask zeroes the
+    unrouted ones — mathematically exact top-k routing whose weights shard
+    cleanly over ``ep``.  (A dispatch/all_to_all data path that skips the
+    masked compute is the standard optimization; this dense form trades
+    FLOPs for simplicity and perfect load balance.)
+    """
+    e = cfg.n_experts
+    logits = (h @ lp["router"].astype(cfg.dtype)).astype(jnp.float32)  # [B,T,E]
+    top_vals, top_idx = jax.lax.top_k(logits, cfg.top_k)
+    gates = jax.nn.softmax(top_vals, axis=-1)  # [B,T,k]
+    # mask[b,t,e] = gate weight if e is among the top-k for (b,t), else 0
+    mask = (jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
+            * gates[..., None]).sum(axis=-2)
+    g = jax.nn.silu(jnp.einsum("btd,edf->btef", h, lp["e_gate"].astype(cfg.dtype)))
+    u = jnp.einsum("btd,edf->btef", h, lp["e_up"].astype(cfg.dtype))
+    y = jnp.einsum("btef,efd->bted", g * u, lp["e_down"].astype(cfg.dtype))
+    return jnp.einsum("bted,bte->btd", y, mask.astype(cfg.dtype))
+
+
+def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions):
+    b, t, d = x.shape
+    h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
+    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    o = attend(q, k, v, mesh=mesh, causal=True)
+    x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
+    h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
+    x = x + (_moe(cfg, lp, h) if cfg.n_experts else _mlp(cfg, lp, h))
+    return x
+
+
+def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None):
+    """tokens [B, T] int32 → logits [B, T, V].
+
+    Sequence positions are global even when activations are sp-sharded:
+    ring attention receives the full logical sequence sharded along T, and
+    rope positions follow the global index.
+    """
+    b, t = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+
+    block = lambda x_, lp_, pos: _block(cfg, mesh, x_, lp_, pos)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        from tfmesos_tpu.parallel.pipeline import pipeline_apply
+        if cfg.n_layers % pp:
+            raise ValueError(f"{cfg.n_layers} layers not divisible into {pp} stages")
+        per = cfg.n_layers // pp
+        stacked = jax.tree_util.tree_map(
+            lambda p: p.reshape(pp, per, *p.shape[1:]), params["layers"])
+
+        # No nested mesh collectives inside a pipeline stage: attend runs
+        # per-device (pp composes with dp/fsdp batch sharding).
+        stage_block = lambda c, lp_, pos: _block(cfg, None, c, lp_, pos)
+        if cfg.remat:
+            stage_block = jax.checkpoint(stage_block)
+
+        def stage_fn(stage_params, h):
+            pos = jnp.broadcast_to(jnp.arange(h.shape[1], dtype=jnp.int32),
+                                   h.shape[:2])
+
+            def body(carry, lp):
+                return stage_block(carry, lp, pos), None
+            out, _ = jax.lax.scan(body, h, stage_params)
+            return out
+
+        x = pipeline_apply(stage_fn, stacked, x, mesh)
+    else:
+        def body(carry, lp):
+            return block(carry, lp, positions), None
+        x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
+    return x @ params["head"].astype(cfg.dtype)
+
+
+def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
+    """Next-token prediction: batch = {"tokens": [B, T+1]}."""
+    tokens = batch["tokens"]
+    logits = forward(cfg, params, tokens[:, :-1], mesh)
+    loss = cross_entropy_loss(logits, tokens[:, 1:])
+    return loss, {"perplexity": jnp.exp(loss)}
+
+
+def _filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axes the mesh doesn't have (size-1 axes included)."""
+    def keep(a):
+        if a is None:
+            return None
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in mesh.shape and mesh.shape[x] > 1)
+            return kept if kept else None
+        return a if a in mesh.shape and mesh.shape[a] > 1 else None
+    return P(*(keep(a) for a in spec))
+
+
+def partition_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
+    """PartitionSpec tree: Megatron-style tp, fsdp on the complementary dim,
+    ep over experts.  The layer-stack dim (dim 0) is left unsharded here;
+    the pp path re-shapes it into stages itself."""
+    layer = {
+        "attn_norm": P(None, None),
+        "wq": P(None, "fsdp", "tp"),
+        "wk": P(None, "fsdp", "tp"),
+        "wv": P(None, "fsdp", "tp"),
+        "wo": P(None, "tp", "fsdp"),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.n_experts:
+        layer.update(
+            router=P(None, "fsdp", None),
+            e_gate=P(None, "ep", "fsdp", "tp"),
+            e_up=P(None, "ep", "fsdp", "tp"),
+            e_down=P(None, "ep", "tp", "fsdp"),
+        )
+    else:
+        layer.update(
+            w_gate=P(None, "fsdp", "tp"),
+            w_up=P(None, "fsdp", "tp"),
+            w_down=P(None, "tp", "fsdp"),
+        )
+    tree = {
+        "embed": P("tp", "fsdp"),
+        "layers": layer,
+        "norm_f": P(None),
+        "head": P("fsdp", "tp"),
+    }
+    return jax.tree_util.tree_map(
+        lambda s: _filter_spec(s, mesh), tree,
+        is_leaf=lambda s: isinstance(s, P))

@@ -1,0 +1,207 @@
+"""Attention ops: reference MHA and a Pallas TPU flash-attention kernel.
+
+The reference framework has no kernels of its own (SURVEY §2.6) — its FLOPs
+live in TF's compiled runtime.  Ours live here: a blocked, online-softmax
+attention kernel tiled for the MXU (128-lane blocks, fp32 accumulation,
+causal blocks skipped entirely), with a plain-XLA reference implementation
+used as ground truth, as the CPU fallback, and to derive the backward pass.
+
+Layouts follow the JAX convention ``[batch, seq, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Plain-XLA scaled-dot-product attention (ground truth / fallback)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        scores = jnp.where(kpos > qpos, NEG_INF, scores)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class _FlashCfg(NamedTuple):
+    causal: bool
+    scale: float
+    block_q: int
+    block_k: int
+    interpret: bool
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, cfg: _FlashCfg, seq_len: int):
+    """One (batch, q-block, head) grid cell: stream K/V blocks with online
+    softmax.  Accumulation in fp32; output cast back at the end.
+
+    Refs are laid out ``[1, 1, T, D]`` — (seq, head_dim) must be the trailing
+    dims so blocks land on the TPU's (8, 128) tiling.
+    """
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * cfg.scale  # [bq, d]
+    bq, bk = cfg.block_q, cfg.block_k
+    qi = pl.program_id(1)
+    nk = seq_len // bk
+    if cfg.causal:
+        # Blocks strictly above the diagonal contribute nothing: bound the
+        # loop instead of masking them (halves the FLOPs on average).
+        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * bq, bk))
+
+    def body(j, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # [bk, d]
+        v_blk = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        if cfg.causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    d = q.shape[-1]
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, nk, body, (o0, m0, l0))
+    o_ref[0, 0, :, :] = (o / l).astype(o_ref.dtype)
+
+
+def _flash_forward(cfg: _FlashCfg, q, k, v):
+    b, t, h, d = q.shape
+    # [B, T, H, D] -> [B, H, T, D]: (seq, head_dim) trailing for TPU tiling.
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    grid = (b, t // cfg.block_q, h)
+    q_spec = pl.BlockSpec((1, 1, cfg.block_q, d),
+                          lambda bi, qi, hi: (bi, hi, qi, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, 1, k.shape[1], d),
+                           lambda bi, qi, hi: (bi, hi, 0, 0),
+                           memory_space=pltpu.VMEM)
+    kernel = functools.partial(_flash_kernel, cfg=cfg, seq_len=k.shape[1])
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=cfg.interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * t * k.shape[1] * d,
+            bytes_accessed=(q.size + k.size + v.size + q.size) * q.dtype.itemsize,
+            transcendentals=b * h * t * k.shape[1],
+        ),
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _FlashCfg, q, k, v):
+    return _flash_forward(cfg, q, k, v)
+
+
+def _flash_fwd(cfg, q, k, v):
+    return _flash_forward(cfg, q, k, v), (q, k, v)
+
+
+def _flash_bwd(cfg, res, g):
+    # Recompute backward through the reference formulation: XLA fuses it
+    # well, and it keeps the kernel's numerics out of the gradient path.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, cfg.causal, cfg.scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    use_pallas: Optional[bool] = None,
+                    interpret: bool = False):
+    """Blocked attention; Pallas kernel on TPU, reference math elsewhere.
+
+    ``use_pallas=None`` auto-selects: the kernel runs when the default
+    backend is TPU (or when ``interpret=True`` for tests) and shapes are
+    block-aligned; otherwise the XLA reference path runs — same numerics,
+    same signature, so model code never branches.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    t = q.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, k.shape[1])
+    # TPU tiling: a block's sublane dim must be a multiple of 8 OR span the
+    # whole array dim (Mosaic's equal-to-dim exception); clamping block to t
+    # satisfies the exception, so only the multi-block case needs 8-alignment.
+    aligned = (t % block_q == 0 and k.shape[1] % block_k == 0
+               and (block_q % 8 == 0 or block_q == t)
+               and (block_k % 8 == 0 or block_k == k.shape[1]))
+    if use_pallas is None:
+        on_tpu = jax.default_backend() == "tpu"
+        use_pallas = aligned and (on_tpu or interpret)
+    if not use_pallas:
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    cfg = _FlashCfg(causal=bool(causal), scale=float(scale),
+                    block_q=block_q, block_k=block_k, interpret=bool(interpret))
+    return _flash(cfg, q, k, v)
+
+
+def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
+                            scale: Optional[float] = None, **kw):
+    """Flash attention under explicit sharding: shard_map over the mesh's
+    batch axes (dp/fsdp) and head axis (tp) so each device runs the Pallas
+    kernel on its local [b_loc, T, h_loc, D] block.  Sequence stays
+    unsharded here — use ring attention when an ``sp`` axis exists."""
+    from jax.sharding import PartitionSpec as P
+
+    from tfmesos_tpu.parallel.sharding import data_axes
+
+    batch = data_axes(mesh)
+    heads = "tp" if "tp" in mesh.shape and mesh.shape["tp"] > 1 else None
+    spec = P(batch, None, heads, None)
+    if batch is None and heads is None:
+        return flash_attention(q, k, v, causal=causal, scale=scale, **kw)
+    fn = jax.shard_map(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal,
+                                           scale=scale, **kw),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def attend(q, k, v, mesh=None, causal: bool = True,
+           scale: Optional[float] = None, **kw):
+    """One attention entry point for model code: ring attention when the
+    mesh shards the sequence (``sp``), sharded flash kernel when it shards
+    batch/heads, plain flash/reference otherwise."""
+    if mesh is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1:
+        from tfmesos_tpu.parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
+    if mesh is not None:
+        return sharded_flash_attention(q, k, v, mesh, causal=causal,
+                                       scale=scale, **kw)
+    return flash_attention(q, k, v, causal=causal, scale=scale, **kw)

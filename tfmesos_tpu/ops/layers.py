@@ -1,0 +1,51 @@
+"""Elementwise/normalization building blocks.
+
+Kept as plain jnp functions — XLA fuses these into surrounding matmuls on
+TPU; a Pallas kernel would only pay off for exotic fusions the compiler
+misses (none here yet).  fp32 accumulation for the reductions, compute dtype
+preserved on the output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    """SwiGLU MLP: silu(x·Wg) ⊙ (x·Wu) · Wd."""
+    g = jax.nn.silu(x @ w_gate)
+    return (g * (x @ w_up)) @ w_down
+
+
+def rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding over the last (head_dim) axis.
+
+    ``x``: [..., T, H, D]; ``positions``: [..., T] int32.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def cross_entropy_loss(logits, labels, z_loss: float = 0.0):
+    """Mean softmax cross entropy in fp32; optional z-loss regularizer."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    loss = jnp.mean(logz - picked)
+    if z_loss:
+        loss = loss + z_loss * jnp.mean(logz ** 2)
+    return loss

@@ -1,0 +1,61 @@
+"""Collective helpers over the device mesh.
+
+The reference delegates all tensor traffic to TensorFlow's gRPC runtime
+(SURVEY §2.8); here the data plane is XLA collectives over ICI/DCN, and these
+helpers are the small vocabulary the rest of the framework uses.  Everything
+is a thin, named wrapper over ``jax.lax`` collectives so call sites read as
+intent ("average gradients over dp") rather than mechanics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+AxisName = Union[str, Sequence[str]]
+
+
+def all_reduce_sum(x, axis: AxisName):
+    return jax.lax.psum(x, axis_name=axis)
+
+
+def all_reduce_mean(x, axis: AxisName):
+    return jax.lax.pmean(x, axis_name=axis)
+
+
+def grad_sync(grads, axis: AxisName):
+    """Average a gradient pytree across the data-parallel axis — the GSPMD
+    successor of PS apply-gradients (reference mnist_replica.py:116-157)."""
+    return jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, axis_name=axis), grads)
+
+
+def all_gather(x, axis: AxisName, *, axis_index: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name=axis, axis=axis_index, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, axis_index: int = 0):
+    return jax.lax.psum_scatter(x, axis_name=axis, scatter_dimension=axis_index,
+                                tiled=True)
+
+
+def ppermute_shift(x, axis: str, shift: int = 1):
+    """Rotate values around a ring axis (the building block of ring attention
+    and pipeline transfer); ``shift=+1`` sends to the next-higher index."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis: str):
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return jax.lax.axis_size(axis)
+
+
+def barrier(axis: AxisName):
+    """Cheap cross-device barrier: reduce a scalar nobody reads."""
+    return jax.lax.psum(jnp.zeros((), jnp.float32), axis_name=axis)

@@ -1,0 +1,96 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe-style).
+
+Another axis the reference never had (SURVEY §2.7).  Layers are grouped into
+stages whose parameters are *stacked* along a leading dim and sharded over
+``pp`` — so each device holds one stage — and microbatches flow through the
+ring with one ``ppermute`` hop per tick.  All devices run every tick (SPMD);
+warm-up/drain bubbles are the usual GPipe cost, amortized by the microbatch
+count.  Composes with dp/fsdp (batch axes) since activations stay sharded on
+their batch dims.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tfmesos_tpu.parallel.collectives import ppermute_shift
+
+
+def stack_stage_params(stage_params: Sequence[Any]) -> Any:
+    """Stack per-stage parameter pytrees along a new leading 'pp' dim."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def stage_sharding_tree(stacked_params: Any, mesh: Mesh, axis: str = "pp") -> Any:
+    """Each leaf's leading (stage) dim sharded over ``axis``."""
+    return jax.tree_util.tree_map(
+        lambda p: NamedSharding(mesh, P(axis, *([None] * (p.ndim - 1)))),
+        stacked_params)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
+                   x, mesh: Mesh, axis: str = "pp",
+                   num_microbatches: int = None):
+    """Run ``x`` through the stage pipeline; returns the final activations.
+
+    ``stage_fn(params, h) -> h`` applies ONE stage (same activation shape in
+    and out).  ``stacked_params`` leaves have leading dim = number of stages.
+    ``x`` is ``[B, ...]``; it is split into microbatches along B.
+    """
+    n_stages = mesh.shape[axis]
+    if n_stages == 1:
+        params0 = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+        return stage_fn(params0, x)
+    m = num_microbatches or n_stages
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+
+    def local(params, xs):
+        params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), params)
+        stage = jax.lax.axis_index(axis)
+        micro = xs.reshape(m, b // m, *xs.shape[1:])
+        mb_shape = micro.shape[1:]
+
+        def tick(t, carry):
+            received, outputs = carry
+            idx = jnp.minimum(t, m - 1)
+            inject = jnp.where(t < m,
+                               jax.lax.dynamic_index_in_dim(micro, idx, 0,
+                                                            keepdims=False),
+                               jnp.zeros(mb_shape, xs.dtype))
+            h = jnp.where(stage == 0, inject, received)
+            out = stage_fn(params, h)
+            out_idx = t - (n_stages - 1)
+            write = (stage == n_stages - 1) & (out_idx >= 0)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(write, out,
+                          jax.lax.dynamic_index_in_dim(
+                              outputs, jnp.maximum(out_idx, 0), 0,
+                              keepdims=False)),
+                jnp.maximum(out_idx, 0), 0)
+            received = ppermute_shift(out, axis, 1)
+            return received, outputs
+
+        outputs0 = jnp.zeros((m,) + mb_shape, xs.dtype)
+        received0 = jnp.zeros(mb_shape, xs.dtype)
+        _, outputs = jax.lax.fori_loop(0, m + n_stages - 1, tick,
+                                       (received0, outputs0))
+        # Results live on the last stage; broadcast them to every stage so
+        # the caller sees a pp-replicated output.
+        outputs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name=axis)
+        return outputs.reshape(b, *xs.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(param_specs, P()), out_specs=P(),
+                       check_vma=False)
+    return fn(stacked_params, x)

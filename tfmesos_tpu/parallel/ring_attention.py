@@ -1,0 +1,90 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context support the reference never had (SURVEY §2.7: no sequence axis
+anywhere).  Sequences shard along time over ``sp``; each device computes
+blockwise attention of its query block against every key/value block as the
+K/V shards rotate around the ring via ``ppermute`` (one ICI hop per step),
+with the online-softmax accumulation of flash attention so nothing is ever
+materialized at full sequence length.  Memory per device is O(T/sp), compute
+overlaps the rotation, and causal masking is exact across shards.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tfmesos_tpu.parallel.collectives import ppermute_shift
+from tfmesos_tpu.parallel.sharding import data_axes
+
+
+def ring_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
+                         scale: Optional[float] = None):
+    """The per-device body; call inside ``shard_map`` with ``axis`` in scope.
+
+    Shapes (local): q/k/v ``[B, T/sp, H, D]``.  At ring step ``i`` this
+    device holds the K/V shard originally owned by ``(my_index - i) mod sp``,
+    so global causal masking only needs the owner index.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    sp = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+
+    qf = q.astype(jnp.float32) * scale
+    o = jnp.zeros((b, h, tq, d), jnp.float32)
+    m = jnp.full((b, h, tq, 1), float("-inf"), jnp.float32)
+    l = jnp.zeros((b, h, tq, 1), jnp.float32)
+
+    qpos = idx * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+
+    for step in range(sp):  # static trip count: sp is a mesh constant
+        src = (idx - step) % sp  # owner of the K/V shard we hold right now
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+        if causal:
+            kpos = src * tk + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+            s = jnp.where((kpos > qpos)[None, None], float("-inf"), s)
+        blockmax = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blockmax)
+        # Fully-masked blocks leave m_new at -inf; subtract a finite proxy so
+        # exp(-inf - finite) -> 0 instead of exp(-inf - -inf) -> nan.
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        corr = jnp.exp(m - m_safe)  # m=-inf gives 0: first block overwrites
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+        m = m_new
+        if step != sp - 1:
+            # Rotate K/V one hop around the ring (device i -> i+1).
+            k = ppermute_shift(k, axis, 1)
+            v = ppermute_shift(v, axis, 1)
+
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l).transpose(0, 2, 1, 3)  # [B, Tq, H, D]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Sharded entry point: q/k/v are global ``[B, T, H, D]`` arrays (or
+    tracers under jit) with T sharded over ``axis``.
+
+    Falls back to single-device flash/reference attention when the mesh has
+    no (non-trivial) ``axis`` — so model code calls this unconditionally.
+    """
+    if axis not in mesh.shape or mesh.shape[axis] == 1:
+        from tfmesos_tpu.ops.attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    spec = P(data_axes(mesh), axis, None, None)
+    fn = jax.shard_map(
+        lambda q_, k_, v_: ring_attention_local(q_, k_, v_, axis=axis,
+                                                causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,116 @@
+"""Parameter/batch sharding rules: PartitionSpec trees for a param pytree.
+
+The reference shards variables over parameter servers with
+``replica_device_setter`` round-robin (mnist.py:43, mnist_replica.py:116-119)
+and by hand with ``tf.device('/job:ps/task:k')`` (matrix_factorization.py:
+21-28).  The GSPMD equivalent is a PartitionSpec per parameter: FSDP shards
+each tensor's largest divisible axis over the ``fsdp`` mesh axis, and logical
+rules map named parameter axes onto ``tp``/``ep`` style mesh axes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def data_axes(mesh: Mesh):
+    """The mesh axes batch-like dims shard over (the single source of truth
+    for 'what counts as a data axis' — attention and batch specs share it)."""
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.shape
+                 and mesh.shape[a] > 1) or None
+
+
+def fsdp_spec(shape: Sequence[int], mesh: Mesh, axis: str = "fsdp",
+              min_size: int = 1024) -> P:
+    """FSDP rule for one tensor: shard the largest dimension divisible by the
+    axis size; leave small tensors replicated (sharding a 100-element bias
+    buys nothing and costs an all-gather)."""
+    if axis not in mesh.shape:
+        return P()
+    n = mesh.shape[axis]
+    if n == 1 or int(np.prod(shape or [1])) < min_size:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda d: shape[d], reverse=True)
+    for d in dims:
+        if shape[d] % n == 0:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def fsdp_sharding_tree(params: Any, mesh: Mesh, axis: str = "fsdp",
+                       min_size: int = 1024) -> Any:
+    """NamedSharding tree matching a parameter pytree."""
+    return jax.tree_util.tree_map(
+        lambda p: NamedSharding(mesh, fsdp_spec(p.shape, mesh, axis, min_size)),
+        params)
+
+
+def batch_spec(mesh: Mesh, *, extra_dims: int = 0) -> P:
+    """Batch sharding: leading dim over every data-like axis present
+    (``dp`` and/or ``fsdp``), optional sequence dim over ``sp``."""
+    dims = [data_axes(mesh)]
+    if extra_dims >= 1 and "sp" in mesh.shape and mesh.shape["sp"] > 1:
+        dims.append("sp")
+        extra_dims -= 1
+    dims.extend([None] * extra_dims)
+    return P(*dims)
+
+
+def batch_sharding(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh, extra_dims=extra_dims))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def make_global_batch(mesh: Mesh, batch: Dict[str, Any],
+                      replicate: bool = False) -> Dict[str, Any]:
+    """Assemble per-process host-local numpy arrays into global jax.Arrays.
+
+    In multi-controller JAX a jit over a multi-host mesh requires global
+    arrays — each process contributes its local shard (its slice of the
+    global batch) and the result's leading dim is the sum across processes.
+    With ``replicate=True`` every process must hold identical data (e.g. an
+    eval batch built from a shared seed).  Single-process: a cheap no-op
+    placement either way.
+    """
+    import jax
+
+    out = {}
+    for name, v in batch.items():
+        spec = P() if replicate else P(data_axes(mesh),
+                                       *([None] * (v.ndim - 1)))
+        sharding = NamedSharding(mesh, spec)
+        out[name] = jax.make_array_from_process_local_data(sharding, v)
+    return out
+
+
+def apply_rules(path_specs: Dict[str, P], params: Any, mesh: Mesh,
+                default: Optional[P] = None) -> Any:
+    """Map dotted-path substring rules onto a param pytree.
+
+    ``path_specs`` maps a substring of the flattened parameter path (e.g.
+    ``"attn.wq"``) to a PartitionSpec; first match wins, ``default`` (or
+    replication) otherwise.  This is the manual-placement successor of
+    ``tf.device('/job:ps/task:k')`` pins.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves, treedef = flat
+
+    def spec_for(path) -> P:
+        name = ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        for pattern, spec in path_specs.items():
+            if pattern in name:
+                return spec
+        return default if default is not None else P()
+
+    shardings = [NamedSharding(mesh, spec_for(path)) for path, _ in leaves]
+    return jax.tree_util.tree_unflatten(treedef, shardings)

@@ -1,0 +1,70 @@
+"""Deterministic synthetic datasets.
+
+This container has no network egress, so the reference's MNIST download
+(mnist_replica's input_data.read_data_sets) is replaced by a seeded
+generative MNIST stand-in: each class is a fixed random template in [0,1]^784
+plus noise, which a 1-hidden-layer MLP separates at the same scale/difficulty
+profile — giving a stable convergence gate (loss must fall, accuracy must
+rise) without shipping data.  LM token streams for the transformer come from
+a seeded Zipf-ish sampler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator
+
+import numpy as np
+
+
+@dataclass
+class SyntheticMNIST:
+    n_classes: int = 10
+    dim: int = 784
+    noise: float = 0.35
+    seed: int = 0
+
+    def __post_init__(self):
+        rng = np.random.RandomState(self.seed)
+        self.templates = rng.rand(self.n_classes, self.dim).astype(np.float32)
+
+    def batches(self, batch_size: int, seed: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+        rng = np.random.RandomState(seed)
+        while True:
+            labels = rng.randint(0, self.n_classes, size=batch_size)
+            images = self.templates[labels] + self.noise * rng.randn(
+                batch_size, self.dim).astype(np.float32)
+            yield {"image": np.clip(images, 0.0, 1.0).astype(np.float32),
+                   "label": labels.astype(np.int32)}
+
+    def eval_batch(self, batch_size: int = 1000, seed: int = 999):
+        return next(self.batches(batch_size, seed=seed))
+
+
+def token_batches(batch_size: int, seq_len: int, vocab_size: int,
+                  seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Endless [B, T+1] token batches with mild structure (bigram-ish) so a
+    language model has something learnable."""
+    rng = np.random.RandomState(seed)
+    # Zipf-ish unigram distribution + deterministic successor bias.
+    ranks = np.arange(1, vocab_size + 1)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    successor = rng.permutation(vocab_size)
+    while True:
+        base = rng.choice(vocab_size, size=(batch_size, seq_len + 1), p=probs)
+        # half the positions follow the deterministic successor of their
+        # predecessor: learnable signal
+        follow = rng.rand(batch_size, seq_len) < 0.5
+        for t in range(1, seq_len + 1):
+            base[:, t] = np.where(follow[:, t - 1], successor[base[:, t - 1]],
+                                  base[:, t])
+        yield {"tokens": base.astype(np.int32)}
+
+
+def nmf_matrix(rows: int, cols: int, rank: int, seed: int = 0) -> np.ndarray:
+    """Ground-truth low-rank non-negative matrix (reference workload shape:
+    matrix_factorization.py:53)."""
+    rng = np.random.RandomState(seed)
+    w = rng.rand(rows, rank).astype(np.float32)
+    h = rng.rand(rank, cols).astype(np.float32)
+    return w @ h / np.sqrt(rank)

@@ -1,0 +1,155 @@
+"""Sharded training loop machinery.
+
+The reference's training mechanics live in user scripts: per-worker sessions
+pushing gradients to parameter servers, `SyncReplicasOptimizer` for sync SGD,
+`Supervisor` for init/recovery (mnist_replica.py:116-210).  All of that
+collapses here into one jit'd step over a GSPMD mesh: params carry
+NamedShardings (FSDP/TP/etc.), the batch is sharded over the data axes, and
+XLA inserts the gradient all-reduce that parameter servers used to be.
+Sync-SGD is therefore the *default* semantics; async PS has no TPU analogue
+(and converges worse anyway).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tfmesos_tpu.parallel.sharding import batch_sharding, fsdp_sharding_tree
+from tfmesos_tpu.utils.logging import get_logger
+
+log = get_logger("tfmesos_tpu.trainer")
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: int = 0
+
+
+def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
+                    mesh: Optional[Mesh] = None,
+                    param_specs: Optional[Any] = None,
+                    batch_spec_tree: Optional[Any] = None,
+                    postprocess: Optional[Callable] = None):
+    """Build the jit'd train step.
+
+    ``loss_fn(params, batch) -> (loss, metrics)``.  With a mesh, params/opt
+    state are placed per ``param_specs`` (default: FSDP rules) and the batch
+    per ``batch_spec_tree`` (default: leading dim over data axes); buffers
+    are donated so params update in place.  ``postprocess`` (e.g. the NMF
+    non-negativity projection) runs on the updated params inside the step.
+    """
+
+    def step_fn(params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if postprocess is not None:
+            params = postprocess(params)
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        return params, opt_state, metrics
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def place(params, opt_state):
+        p_sh = (jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s),
+                                       param_specs,
+                                       is_leaf=lambda s: isinstance(s, P))
+                if param_specs is not None else fsdp_sharding_tree(params, mesh))
+        params = jax.device_put(params, p_sh)
+        # Optimizer moments mirror the param shardings (matched by path, not
+        # shape: e.g. wq/wo share a shape but carry transposed specs).
+        o_sh = _opt_shardings(opt_state, params, p_sh, mesh)
+        opt_state = jax.device_put(opt_state, o_sh)
+        return params, opt_state
+
+    data_sh = batch_sharding(mesh)
+
+    def sharded_step(params, opt_state, batch):
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, batch_spec_tree if batch_spec_tree is not None else data_sh),
+            batch)
+        return step_fn(params, opt_state, batch)
+
+    jitted = jax.jit(sharded_step, donate_argnums=(0, 1))
+    jitted.place = place  # type: ignore[attr-defined]
+    return jitted
+
+
+def _opt_shardings(opt_state, params, param_shardings, mesh):
+    """Sharding tree for an optax state: each moment leaf takes the sharding
+    of the parameter whose pytree path is a suffix of the leaf's own path
+    (optax moment trees — ``mu``/``nu`` etc. — mirror the params tree
+    exactly, nested under state wrappers).  Scalars/counters replicate.
+    Matching by path avoids aliasing distinct params that share a shape."""
+
+    def path_key(path):
+        return tuple(str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+                     for k in path)
+
+    p_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    s_leaves = jax.tree_util.tree_leaves(
+        param_shardings, is_leaf=lambda s: isinstance(s, NamedSharding))
+    by_path = {path_key(path): (leaf.shape, sh)
+               for (path, leaf), sh in zip(p_leaves, s_leaves)}
+    replicated = NamedSharding(mesh, P())
+
+    def assign(path, leaf):
+        key = path_key(path)
+        shape = getattr(leaf, "shape", ())
+        for i in range(len(key)):
+            hit = by_path.get(key[i:])
+            if hit and hit[0] == shape:
+                return hit[1]
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(assign, opt_state)
+
+
+@dataclass
+class TrainLoop:
+    """Step loop with timing — the measurement point for the project metric
+    (BASELINE.md: steps/sec/chip)."""
+
+    step_fn: Callable
+    state: TrainState
+    log_every: int = 50
+    name: str = "train"
+
+    def run(self, batches: Iterator[Dict[str, Any]], num_steps: int,
+            on_metrics: Optional[Callable[[int, Dict], None]] = None) -> Dict[str, Any]:
+        params, opt_state = self.state.params, self.state.opt_state
+        t_start = time.perf_counter()
+        metrics = {}
+        for i in range(num_steps):
+            batch = next(batches)
+            params, opt_state, metrics = self.step_fn(params, opt_state, batch)
+            if (i + 1) % self.log_every == 0 or i + 1 == num_steps:
+                metrics = {k: float(v) for k, v in metrics.items()}
+                if on_metrics:
+                    on_metrics(i + 1, metrics)
+                else:
+                    log.info("%s step %d: %s", self.name, i + 1,
+                             {k: round(v, 4) for k, v in metrics.items()})
+        jax.block_until_ready(params)
+        elapsed = time.perf_counter() - t_start
+        self.state = TrainState(params, opt_state, self.state.step + num_steps)
+        n_dev = max(1, jax.device_count())
+        return {
+            "elapsed_s": elapsed,
+            "steps_per_sec": num_steps / elapsed,
+            "steps_per_sec_per_chip": num_steps / elapsed / n_dev,
+            "final_metrics": metrics,
+        }
