@@ -26,33 +26,34 @@ def bench_mnist_replica(steps=600, warmup=100):
     import jax
     import optax
     from tfmesos_tpu.models import mlp
+    from tfmesos_tpu.parallel.mesh import build_mesh
+    from tfmesos_tpu.parallel.sharding import make_global_batch
     from tfmesos_tpu.train import data as datalib
+    from tfmesos_tpu.train.trainer import make_train_step
 
+    n_chips = max(1, jax.device_count())
+    mesh = build_mesh()  # every chip on a data-parallel axis
     cfg = mlp.MLPConfig(hidden=100)
     params = mlp.init_params(cfg, jax.random.PRNGKey(0))
     opt = optax.sgd(0.01)  # reference lr (mnist_replica.py:71)
-    opt_state = opt.init(params)
-
-    @jax.jit
-    def step(params, opt_state, batch):
-        (loss, aux), grads = jax.value_and_grad(
-            lambda p: mlp.loss_fn(cfg, p, batch), has_aux=True)(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt, mesh=mesh)
+    params, opt_state = step.place(params, opt.init(params))
 
     ds = datalib.SyntheticMNIST()
-    batch = {k: jax.device_put(v) for k, v in next(ds.batches(100)).items()}
+    # Reference batch 100, rounded so it shards evenly over the chips —
+    # the step really runs on all of them, so dividing by n_chips is honest.
+    local_bs = max(1, 100 // n_chips)
+    batch = make_global_batch(mesh, next(ds.batches(local_bs * n_chips)))
 
     for _ in range(warmup):
-        params, opt_state, loss = step(params, opt_state, batch)
+        params, opt_state, metrics = step(params, opt_state, batch)
     jax.block_until_ready(params)
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, batch)
+        params, opt_state, metrics = step(params, opt_state, batch)
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
-    n_chips = max(1, jax.device_count())
-    return steps / dt / n_chips, float(loss)
+    return steps / dt / n_chips, float(metrics["loss"])
 
 
 def bench_transformer_tokens(iters=20):

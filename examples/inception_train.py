@@ -1,0 +1,72 @@
+"""Inception-v3 distributed trainer (BASELINE.json config: "Inception-v3
+distributed_train (4 ps + 8 worker → 8-chip mesh)").
+
+Run under tfrun with the original's job shape — the 4 ps tasks survive as
+CLI surface and extra mesh members; parameters shard FSDP-style instead of
+living on ps processes:
+
+    python bin/tfrun -w 8 -s 4 --worker-logs 0 -- \
+        python examples/inception_train.py --steps 100 --batch_size 256
+
+``--tiny`` selects the test-scale config for CPU smoke runs.
+"""
+
+import argparse
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch_size", type=int, default=256, help="global batch")
+    p.add_argument("--learning_rate", type=float, default=0.045)
+    p.add_argument("--tiny", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import optax
+    from tfmesos_tpu import runtime
+    from tfmesos_tpu.models import inception
+    from tfmesos_tpu.parallel.sharding import make_global_batch
+    from tfmesos_tpu.train import data as datalib
+
+    ctx = runtime.initialize()
+    mesh = ctx.mesh()
+    cfg = (inception.InceptionConfig.tiny() if args.tiny
+           else inception.InceptionConfig())
+    if ctx.is_chief:
+        print(f"inception3: mesh={dict(mesh.shape)} "
+              f"devices={jax.device_count()}", flush=True)
+
+    state = inception.init_params(cfg, jax.random.PRNGKey(0))
+    # RMSProp as in the original inception distributed_train recipe.
+    opt = optax.rmsprop(args.learning_rate, decay=0.9, eps=1.0)
+    step = inception.make_train_step(cfg, opt, mesh=mesh)
+    state = step.place({"params": state["params"],
+                        "batch_stats": state["batch_stats"],
+                        "opt_state": opt.init(state["params"])})
+
+    local_bs = max(1, args.batch_size // max(1, ctx.world_size))
+    gen = datalib.image_batches(local_bs, cfg.image_size, cfg.num_classes,
+                                seed=100 + ctx.rank)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        batch = make_global_batch(mesh, next(gen))
+        state, metrics = step(state, batch)
+        if ctx.is_chief and (i + 1) % 20 == 0:
+            print(f"step {i + 1}: loss={float(metrics['loss']):.4f} "
+                  f"acc={float(metrics['accuracy']):.3f}", flush=True)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    if ctx.is_chief:
+        images_per_sec = args.steps * args.batch_size / dt
+        print(f"Training elapsed time: {dt:f} s", flush=True)
+        print(f"images/sec: {images_per_sec:.1f} "
+              f"(per chip: {images_per_sec / jax.device_count():.1f})",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

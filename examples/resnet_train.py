@@ -1,0 +1,70 @@
+"""ResNet-50 sync-SGD trainer (BASELINE.json config: "ResNet-50 ImageNet
+sync-SGD (no PS, pure ICI all-reduce, v5e-32)").
+
+Run under tfrun with workers only — no ps job, matching "no PS":
+
+    python bin/tfrun -w 8 -s 0 --worker-logs 0 -- \
+        python examples/resnet_train.py --steps 100 --batch_size 256
+
+Every process joins the GSPMD mesh; the gradient all-reduce rides ICI.
+``--tiny`` selects the test-scale config for CPU smoke runs.
+"""
+
+import argparse
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch_size", type=int, default=256, help="global batch")
+    p.add_argument("--learning_rate", type=float, default=0.1)
+    p.add_argument("--tiny", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import optax
+    from tfmesos_tpu import runtime
+    from tfmesos_tpu.models import resnet
+    from tfmesos_tpu.parallel.sharding import make_global_batch
+    from tfmesos_tpu.train import data as datalib
+
+    ctx = runtime.initialize()
+    mesh = ctx.mesh()
+    cfg = resnet.ResNetConfig.tiny() if args.tiny else resnet.ResNetConfig()
+    if ctx.is_chief:
+        print(f"resnet50: mesh={dict(mesh.shape)} devices={jax.device_count()}",
+              flush=True)
+
+    state = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(args.learning_rate, momentum=0.9, nesterov=True)
+    step = resnet.make_train_step(cfg, opt, mesh=mesh)
+    state = step.place({"params": state["params"],
+                        "batch_stats": state["batch_stats"],
+                        "opt_state": opt.init(state["params"])})
+
+    local_bs = max(1, args.batch_size // max(1, ctx.world_size))
+    gen = datalib.image_batches(local_bs, cfg.image_size, cfg.num_classes,
+                                seed=100 + ctx.rank)
+    t0 = time.perf_counter()
+    metrics = {}
+    for i in range(args.steps):
+        batch = make_global_batch(mesh, next(gen))
+        state, metrics = step(state, batch)
+        if ctx.is_chief and (i + 1) % 20 == 0:
+            print(f"step {i + 1}: loss={float(metrics['loss']):.4f} "
+                  f"acc={float(metrics['accuracy']):.3f}", flush=True)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    if ctx.is_chief:
+        images_per_sec = args.steps * args.batch_size / dt
+        print(f"Training elapsed time: {dt:f} s", flush=True)
+        print(f"images/sec: {images_per_sec:.1f} "
+              f"(per chip: {images_per_sec / jax.device_count():.1f})",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

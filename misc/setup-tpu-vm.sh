@@ -1,0 +1,25 @@
+#!/bin/bash
+# Provision a TPU-VM as a Mesos agent advertising its chips as the custom
+# scalar resource `tpus` (reference analogue: misc/setup-aws-g2.sh, which
+# installed CUDA + nvidia-docker on GPU agents — none of that exists here).
+set -euo pipefail
+
+MESOS_MASTER=${1:?usage: setup-tpu-vm.sh <mesos-master:port> [num-chips]}
+NUM_CHIPS=${2:-4}
+
+# 1. Mesos agent (distro package or your org's build).
+apt-get update && apt-get install -y mesos
+
+# 2. Advertise TPU chips as a custom resource; cpus/mem are auto-detected.
+mkdir -p /etc/mesos-agent
+echo "tpus:${NUM_CHIPS}" > /etc/mesos-agent/resources
+echo "docker,mesos" > /etc/mesos-agent/containerizers
+echo "${MESOS_MASTER}" > /etc/mesos-agent/master
+
+# 3. The MESOS containerizer needs the TPU device nodes plumbed into task
+#    containers; /dev/vfio and /dev/accel* must be world-accessible on the
+#    host (TPU-VM images ship them so by default).
+ls /dev/accel* >/dev/null
+
+systemctl restart mesos-agent
+echo "agent up: $(hostname) advertising tpus:${NUM_CHIPS} to ${MESOS_MASTER}"

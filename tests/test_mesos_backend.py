@@ -1,0 +1,242 @@
+"""MesosBackend against an in-process fake Mesos master speaking the v1
+HTTP API (chunked RecordIO event stream + recorded calls) — the recorded-
+offer fixture style testing SURVEY §3.4 calls for, with no Mesos install."""
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tfmesos_tpu.backends.mesos import (MesosBackend, RecordIOParser,
+                                        parse_master, parse_offer)
+from tfmesos_tpu.scheduler import TPUMesosScheduler
+from tfmesos_tpu.spec import Job
+
+
+def record(event: dict) -> bytes:
+    data = json.dumps(event).encode()
+    return f"{len(data)}\n".encode() + data
+
+
+class FakeMaster:
+    def __init__(self):
+        self.calls = []
+        self.subscribes = []
+        self.events: "queue.Queue[dict]" = queue.Queue()
+        master = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if body.get("type") == "SUBSCRIBE":
+                    master.subscribes.append(body)
+                    self.send_response(200)
+                    self.send_header("Mesos-Stream-Id", "stream-1")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    self._chunk(record({
+                        "type": "SUBSCRIBED",
+                        "subscribed": {"framework_id": {"value": "FW-1"},
+                                       "heartbeat_interval_seconds": 15},
+                    }))
+                    while True:
+                        try:
+                            event = master.events.get(timeout=0.1)
+                        except queue.Empty:
+                            if getattr(master, "_closing", False):
+                                return
+                            continue
+                        try:
+                            self._chunk(record(event))
+                        except (BrokenPipeError, ConnectionResetError):
+                            return
+                else:
+                    master.calls.append(body)
+                    self.send_response(202)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+            def _chunk(self, data: bytes):
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True  # don't let open subscribe streams block close
+
+        self.server = Server(("127.0.0.1", 0), Handler)
+        self._closing = False
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.server.server_port}"
+
+    def push(self, event: dict):
+        self.events.put(event)
+
+    def wait_call(self, call_type: str, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for call in self.calls:
+                if call.get("type") == call_type:
+                    return call
+            time.sleep(0.02)
+        raise AssertionError(
+            f"no {call_type} call; got {[c.get('type') for c in self.calls]}")
+
+    def close(self):
+        self._closing = True
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def mesos_offer(oid="o-1", cpus=8.0, mem=8192.0, tpus=0.0):
+    resources = [
+        {"name": "cpus", "type": "SCALAR", "scalar": {"value": cpus}},
+        {"name": "mem", "type": "SCALAR", "scalar": {"value": mem}},
+    ]
+    if tpus:
+        resources.append({"name": "tpus", "type": "SCALAR",
+                          "scalar": {"value": tpus}})
+    return {"id": {"value": oid}, "agent_id": {"value": "agent-1"},
+            "hostname": "tpu-vm-1", "resources": resources}
+
+
+# -- unit pieces -----------------------------------------------------------
+
+
+def test_recordio_parser_split_boundaries():
+    p = RecordIOParser()
+    stream = record({"a": 1}) + record({"b": "x" * 100}) + record({"c": 3})
+    out = []
+    for i in range(0, len(stream), 7):  # feed in awkward 7-byte slices
+        out.extend(p.feed(stream[i:i + 7]))
+    assert [json.loads(r) for r in out] == [{"a": 1}, {"b": "x" * 100},
+                                            {"c": 3}]
+
+
+def test_recordio_bad_length():
+    with pytest.raises(IOError):
+        RecordIOParser().feed(b"notanum\n{}")
+
+
+def test_parse_master_forms():
+    assert parse_master("10.0.0.1:5050") == ("10.0.0.1", 5050)
+    assert parse_master("10.0.0.1") == ("10.0.0.1", 5050)
+    assert parse_master("http://m.example:8080") == ("m.example", 8080)
+    with pytest.raises(ValueError):
+        parse_master("zk://zk1:2181/mesos")
+
+
+def test_parse_offer_resources_and_gpu_set():
+    raw = mesos_offer(tpus=4.0)
+    raw["resources"].append({"name": "gpus", "type": "SET",
+                             "set": {"item": ["uuid-a", "uuid-b"]}})
+    raw["attributes"] = [{"name": "zone", "type": "TEXT",
+                          "text": {"value": "us-central2-b"}}]
+    offer = parse_offer(raw)
+    assert (offer.cpus, offer.mem) == (8.0, 8192.0)
+    assert offer.chips == 6  # 4 tpus + 2-uuid gpu set (reference parity)
+    assert offer.attributes["zone"] == "us-central2-b"
+    assert offer.hostname == "tpu-vm-1"
+
+
+# -- protocol flow against the fake master ---------------------------------
+
+
+@pytest.fixture
+def master():
+    m = FakeMaster()
+    yield m
+    m.close()
+
+
+def _scheduler_on(master, jobs):
+    backend = MesosBackend(master.addr, framework_name="test-fw",
+                           reconnect_wait=0.1)
+    s = TPUMesosScheduler(jobs, backend=backend, quiet=True,
+                          start_timeout=10.0)
+    s.addr = "127.0.0.1:12345"  # rendezvous addr for to_task_info
+    backend.start(s)
+    return s, backend
+
+
+def test_subscribe_offer_launch_ack_revive_teardown(master):
+    s, backend = _scheduler_on(
+        master, [Job(name="worker", num=2, cpus=2.0, mem=1024.0, chips=4)])
+    assert backend.framework_id == "FW-1"
+    assert master.subscribes[0]["subscribe"]["framework_info"]["name"] == \
+        "test-fw"
+
+    # Offer big enough for both tasks → one ACCEPT with two TaskInfos.
+    master.push({"type": "OFFERS",
+                 "offers": {"offers": [mesos_offer(cpus=8, mem=8192,
+                                                   tpus=8.0)]}})
+    accept = master.wait_call("ACCEPT")
+    assert accept["framework_id"]["value"] == "FW-1"
+    infos = accept["accept"]["operations"][0]["launch"]["task_infos"]
+    assert len(infos) == 2
+    res = {r["name"]: r["scalar"]["value"] for r in infos[0]["resources"]}
+    assert res["tpus"] == 4.0
+    assert "tfmesos_tpu.server" in infos[0]["command"]["value"]
+
+    # RUNNING with a uuid → explicit ACKNOWLEDGE.
+    task_id = infos[0]["task_id"]["value"]
+    master.push({"type": "UPDATE", "update": {"status": {
+        "task_id": {"value": task_id}, "state": "TASK_RUNNING",
+        "agent_id": {"value": "agent-1"}, "uuid": "dXVpZA=="}}})
+    ack = master.wait_call("ACKNOWLEDGE")
+    assert ack["acknowledge"]["task_id"]["value"] == task_id
+    assert ack["acknowledge"]["uuid"] == "dXVpZA=="
+
+    # Pre-start failure → task revived with fresh id + REVIVE call.
+    master.push({"type": "UPDATE", "update": {"status": {
+        "task_id": {"value": task_id}, "state": "TASK_FAILED",
+        "agent_id": {"value": "agent-1"}, "uuid": "dXVpZA=="}}})
+    master.wait_call("REVIVE")
+    assert all(t.id != task_id for t in s.tasks)
+
+    # Useless offer → DECLINE.
+    master.push({"type": "OFFERS",
+                 "offers": {"offers": [mesos_offer("o-2", cpus=0.1)]}})
+    master.wait_call("DECLINE")
+
+    backend.stop()
+    master.wait_call("TEARDOWN")
+
+
+def test_error_event_is_fatal(master):
+    s, backend = _scheduler_on(master, [Job(name="w", num=1, cpus=1, mem=64)])
+    master.push({"type": "ERROR", "error": {"message": "framework removed"}})
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            s.finished()
+        except Exception:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("ERROR event did not become fatal")
+    backend.stop()
+
+
+def test_agent_failure_event(master):
+    s, backend = _scheduler_on(master, [Job(name="w", num=1, cpus=1, mem=64)])
+    master.push({"type": "OFFERS",
+                 "offers": {"offers": [mesos_offer(cpus=4)]}})
+    master.wait_call("ACCEPT")
+    master.push({"type": "FAILURE",
+                 "failure": {"agent_id": {"value": "agent-1"}}})
+    master.wait_call("REVIVE")  # pre-start agent loss revives the task
+    backend.stop()

@@ -48,7 +48,8 @@ class _null:
 def test_transformer_pp_matches_sequential():
     mesh = build_mesh({"pp": 2, "dp": 4})
     params = transformer.init_params(TINY, jax.random.PRNGKey(0))
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, TINY.vocab_size)
+    # batch must split into microbatches (=pp stages) x dp shards
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, TINY.vocab_size)
     ref = transformer.forward(TINY, params, tokens)
     got = jax.jit(lambda p, t: transformer.forward(TINY, p, t, mesh))(params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),

@@ -1,0 +1,315 @@
+"""Mesos backend: the v1 HTTP scheduler API, spoken directly.
+
+The reference rides pymesos (setup.py:51) for its Mesos session; we
+implement the protocol ourselves on the stdlib — a long-lived SUBSCRIBE
+stream of RecordIO-framed JSON events plus one-shot POST calls — so the
+framework has zero dependencies beyond JAX.  Protocol shape:
+
+* ``POST /api/v1/scheduler`` with a SUBSCRIBE call opens a chunked response
+  carrying ``<length>\\n<json>`` records (SUBSCRIBED, OFFERS, UPDATE,
+  FAILURE, ERROR, HEARTBEAT ...) and a ``Mesos-Stream-Id`` header.
+* Every subsequent call (ACCEPT/DECLINE/ACKNOWLEDGE/REVIVE/SUPPRESS/KILL/
+  TEARDOWN) is a separate POST carrying the framework id and stream id.
+
+TPU-era resource mapping: tasks request the custom scalar resource ``tpus``
+(chips on TPU-VM agents); ``gpus`` offers are also read into the same chips
+dimension for parity with the reference's GPU accounting, including the
+Mesos SET-type form (scheduler.py:244-250).
+
+The reference's semantics are preserved: explicit status acknowledgements,
+revive/suppress passthrough, decline with configurable refuse_seconds
+(FOREVER once placed), teardown on stop (scheduler.py:459-472).
+"""
+
+from __future__ import annotations
+
+import getpass
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.parse
+from typing import Any, Dict, List, Optional, Sequence
+
+from tfmesos_tpu.backends import ResourceBackend
+from tfmesos_tpu.spec import Offer, TaskStatus
+from tfmesos_tpu.utils.logging import get_logger
+
+API_PATH = "/api/v1/scheduler"
+
+
+class RecordIOParser:
+    """Incremental ``<length>\\n<bytes>`` record parser."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self._buf.extend(data)
+        out = []
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl < 0:
+                break
+            try:
+                length = int(bytes(self._buf[:nl]))
+            except ValueError:
+                raise IOError(f"bad RecordIO length {bytes(self._buf[:nl])!r}")
+            end = nl + 1 + length
+            if len(self._buf) < end:
+                break
+            out.append(bytes(self._buf[nl + 1:end]))
+            del self._buf[:end]
+        return out
+
+
+def parse_master(master: str) -> tuple:
+    """Accept ``host:port``, ``http://host:port``.  ``zk://`` URLs would need
+    a ZooKeeper client (the reference gets one transitively via pymesos,
+    SURVEY §1); resolve the leader out-of-band and pass host:port."""
+    if master.startswith("zk://"):
+        raise ValueError(
+            "zk:// master URLs are not resolved in-process; point at the "
+            "leading master's host:port (e.g. from `mesos-resolve`)")
+    if "//" in master:
+        parsed = urllib.parse.urlparse(master)
+        return parsed.hostname, parsed.port or 5050
+    host, _, port = master.partition(":")
+    return host, int(port or 5050)
+
+
+def parse_offer(raw: dict) -> Offer:
+    cpus = mem = 0.0
+    chips = 0
+    for res in raw.get("resources", []):
+        name, rtype = res.get("name"), res.get("type")
+        if name == "cpus" and rtype == "SCALAR":
+            cpus = float(res["scalar"]["value"])
+        elif name == "mem" and rtype == "SCALAR":
+            mem = float(res["scalar"]["value"])
+        elif name in ("tpus", "gpus"):
+            if rtype == "SCALAR":
+                chips += int(float(res["scalar"]["value"]))
+            elif rtype == "SET":  # nvidia-docker-era uuid sets (reference
+                chips += len(res["set"]["item"])  # scheduler.py:244-250)
+    attributes = {}
+    for attr in raw.get("attributes", []):
+        if attr.get("type") == "TEXT":
+            attributes[attr["name"]] = attr["text"]["value"]
+        elif attr.get("type") == "SCALAR":
+            attributes[attr["name"]] = str(attr["scalar"]["value"])
+    return Offer(id=raw["id"]["value"], agent_id=raw["agent_id"]["value"],
+                 hostname=raw.get("hostname", ""), cpus=cpus, mem=mem,
+                 chips=chips, attributes=attributes, raw=raw)
+
+
+class MesosBackend(ResourceBackend):
+    def __init__(self, master: str, framework_name: str = "tpumesos",
+                 role: str = "*", user: Optional[str] = None,
+                 failover_timeout: float = 3600.0,
+                 reconnect_wait: float = 2.0):
+        self.host, self.port = parse_master(master)
+        self.framework_name = framework_name
+        self.role = role
+        self.user = user if user is not None else getpass.getuser()
+        self.failover_timeout = failover_timeout
+        self.reconnect_wait = reconnect_wait
+        self.log = get_logger("tfmesos_tpu.mesos")
+
+        self._scheduler = None
+        self.framework_id: Optional[str] = None
+        self.stream_id: Optional[str] = None
+        self._shutdown = threading.Event()
+        self._subscribed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    # -- subscribe stream --------------------------------------------------
+
+    def start(self, scheduler) -> None:
+        self._scheduler = scheduler
+        self._thread = threading.Thread(target=self._subscribe_loop,
+                                        name="mesos-subscribe", daemon=True)
+        self._thread.start()
+        if not self._subscribed.wait(timeout=60.0):
+            raise RuntimeError(
+                f"could not subscribe to Mesos master at "
+                f"{self.host}:{self.port} within 60s")
+
+    def _subscribe_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                self._run_stream()
+            except Exception as e:
+                if self._shutdown.is_set():
+                    return
+                self.log.warning("subscribe stream broke: %s; reconnecting "
+                                 "in %.1fs", e, self.reconnect_wait)
+                time.sleep(self.reconnect_wait)
+
+    def _run_stream(self) -> None:
+        body: Dict[str, Any] = {
+            "type": "SUBSCRIBE",
+            "subscribe": {
+                "framework_info": {
+                    "user": self.user,
+                    "name": self.framework_name,
+                    "roles": [self.role],
+                    "failover_timeout": self.failover_timeout,
+                    "capabilities": [{"type": "MULTI_ROLE"}],
+                },
+            },
+        }
+        if self.framework_id:  # failover re-subscription keeps our tasks
+            body["framework_id"] = {"value": self.framework_id}
+            body["subscribe"]["framework_info"]["id"] = {
+                "value": self.framework_id}
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
+        self._conn = conn
+        conn.request("POST", API_PATH, body=json.dumps(body),
+                     headers={"Content-Type": "application/json",
+                              "Accept": "application/json"})
+        resp = conn.getresponse()
+        if resp.status in (302, 307):  # not the leading master
+            location = resp.getheader("Location", "")
+            raise IOError(f"master redirected to {location}; update master "
+                          f"address")
+        if resp.status != 200:
+            raise IOError(f"SUBSCRIBE failed: HTTP {resp.status} "
+                          f"{resp.read(200)!r}")
+        self.stream_id = resp.getheader("Mesos-Stream-Id")
+        parser = RecordIOParser()
+        while not self._shutdown.is_set():
+            chunk = resp.read1(65536)
+            if not chunk:
+                raise IOError("subscribe stream EOF")
+            for record in parser.feed(chunk):
+                self._dispatch(json.loads(record))
+
+    def _dispatch(self, event: Dict[str, Any]) -> None:
+        etype = event.get("type")
+        if etype == "SUBSCRIBED":
+            sub = event["subscribed"]
+            self.framework_id = sub["framework_id"]["value"]
+            self.log.info("subscribed: framework %s", self.framework_id)
+            self._subscribed.set()
+            self._scheduler.on_registered(
+                {"backend": "mesos", "framework_id": self.framework_id,
+                 "master": f"{self.host}:{self.port}"})
+        elif etype == "OFFERS":
+            offers = [parse_offer(o)
+                      for o in event["offers"].get("offers", [])]
+            if offers:
+                self._scheduler.on_offers(offers)
+        elif etype == "UPDATE":
+            status = event["update"]["status"]
+            self._scheduler.on_status(TaskStatus(
+                task_id=status["task_id"]["value"],
+                state=status["state"],
+                message=status.get("message", ""),
+                agent_id=status.get("agent_id", {}).get("value", ""),
+                uuid=status.get("uuid", ""),
+            ))
+        elif etype == "FAILURE":
+            failure = event.get("failure", {})
+            agent = failure.get("agent_id", {}).get("value")
+            if agent and not failure.get("executor_id"):
+                self._scheduler.on_agent_lost(agent)
+        elif etype == "ERROR":
+            self._scheduler.on_error(event.get("error", {}).get("message",
+                                                                "unknown"))
+        elif etype in ("HEARTBEAT", "RESCIND"):
+            pass
+        else:
+            self.log.debug("ignoring event %s", etype)
+
+    # -- calls -------------------------------------------------------------
+
+    def _call(self, body: Dict[str, Any]) -> None:
+        body = dict(body)
+        if self.framework_id:
+            body["framework_id"] = {"value": self.framework_id}
+        headers = {"Content-Type": "application/json"}
+        if self.stream_id:
+            headers["Mesos-Stream-Id"] = self.stream_id
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        try:
+            conn.request("POST", API_PATH, body=json.dumps(body),
+                         headers=headers)
+            resp = conn.getresponse()
+            data = resp.read(4096)
+            if resp.status not in (200, 202):
+                self.log.warning("call %s failed: HTTP %d %r",
+                                 body.get("type"), resp.status, data[:200])
+        finally:
+            conn.close()
+
+    def launch(self, offer: Offer, task_infos: Sequence[dict]) -> None:
+        self._call({
+            "type": "ACCEPT",
+            "accept": {
+                "offer_ids": [{"value": offer.id}],
+                "operations": [{
+                    "type": "LAUNCH",
+                    "launch": {"task_infos": list(task_infos)},
+                }],
+                "filters": {"refuse_seconds": 5.0},
+            },
+        })
+
+    def decline(self, offer: Offer, refuse_seconds: float = 5.0) -> None:
+        self._call({
+            "type": "DECLINE",
+            "decline": {"offer_ids": [{"value": offer.id}],
+                        "filters": {"refuse_seconds": float(refuse_seconds)}},
+        })
+
+    def suppress(self) -> None:
+        self._call({"type": "SUPPRESS"})
+
+    def revive(self) -> None:
+        self._call({"type": "REVIVE"})
+
+    def kill(self, task_id: str) -> None:
+        self._call({"type": "KILL", "kill": {"task_id": {"value": task_id}}})
+
+    def acknowledge(self, status: TaskStatus) -> None:
+        # Explicit acks are required on the v1 API whenever a status carries
+        # a uuid (the analogue of pymesos' implicit acks the reference used).
+        if not status.uuid or not status.agent_id:
+            return
+        self._call({
+            "type": "ACKNOWLEDGE",
+            "acknowledge": {
+                "agent_id": {"value": status.agent_id},
+                "task_id": {"value": status.task_id},
+                "uuid": status.uuid,
+            },
+        })
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        if self.framework_id:
+            try:
+                self._call({"type": "TEARDOWN"})
+            except Exception as e:  # master may already be gone
+                self.log.warning("teardown failed: %s", e)
+        if self._conn is not None:
+            # Wake the reader thread blocked in recv: a raw shutdown() on the
+            # socket interrupts it immediately, whereas HTTPConnection.close()
+            # would deadlock on the response buffer lock the reader holds
+            # (until the socket timeout fires, 60s later).
+            sock = getattr(self._conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass

@@ -205,6 +205,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # surfaces it as one line, not a stack trace.
         print(f"tfrun: cluster failed: {e}", file=sys.stderr)
         return 1
+    except (ValueError, RuntimeError) as e:
+        # Backend/config rejection (bad master URL, subscribe timeout, ...).
+        print(f"tfrun: {e}", file=sys.stderr)
+        return 2
     finally:
         collector.close()
     return 0

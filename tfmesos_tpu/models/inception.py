@@ -1,0 +1,252 @@
+"""Inception-v3 (BASELINE.json config: "Inception-v3 distributed_train,
+4 ps + 8 worker → 8-chip mesh").
+
+The original distributed_train placed variables on 4 parameter servers and
+replicated the tower over 8 workers; here the tower is one flax module and
+the "4 ps" role is FSDP parameter sharding over the 8-chip mesh (north-star
+mapping, SURVEY §2.7).  NHWC, bf16 compute, fp32 BN stats, optional aux
+head as in the original.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfmesos_tpu.ops.layers import cross_entropy_loss
+
+
+@dataclass(frozen=True)
+class InceptionConfig:
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    image_size: int = 299
+    width_mult: float = 1.0     # scales every channel count (tiny variants)
+    aux_head: bool = True
+
+    def ch(self, n: int) -> int:
+        return max(8, int(n * self.width_mult))
+
+    @staticmethod
+    def tiny():
+        return InceptionConfig(num_classes=10, dtype=jnp.float32,
+                               image_size=75, width_mult=0.125,
+                               aux_head=False)
+
+
+class BasicConv(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _pool(x, kind: str):
+    if kind == "max":
+        return nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    cfg: InceptionConfig
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(BasicConv, dtype=self.cfg.dtype)
+        ch = self.cfg.ch
+        b1 = c(ch(64), (1, 1))(x, train)
+        b2 = c(ch(48), (1, 1))(x, train)
+        b2 = c(ch(64), (5, 5))(b2, train)
+        b3 = c(ch(64), (1, 1))(x, train)
+        b3 = c(ch(96), (3, 3))(b3, train)
+        b3 = c(ch(96), (3, 3))(b3, train)
+        b4 = c(self.cfg.ch(self.pool_features), (1, 1))(_pool(x, "avg"), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):  # grid reduction 35 -> 17
+    cfg: InceptionConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(BasicConv, dtype=self.cfg.dtype)
+        ch = self.cfg.ch
+        b1 = c(ch(384), (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = c(ch(64), (1, 1))(x, train)
+        b2 = c(ch(96), (3, 3))(b2, train)
+        b2 = c(ch(96), (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    cfg: InceptionConfig
+    c7: int
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(BasicConv, dtype=self.cfg.dtype)
+        ch, c7 = self.cfg.ch, self.cfg.ch(self.c7)
+        b1 = c(ch(192), (1, 1))(x, train)
+        b2 = c(c7, (1, 1))(x, train)
+        b2 = c(c7, (1, 7))(b2, train)
+        b2 = c(ch(192), (7, 1))(b2, train)
+        b3 = c(c7, (1, 1))(x, train)
+        b3 = c(c7, (7, 1))(b3, train)
+        b3 = c(c7, (1, 7))(b3, train)
+        b3 = c(c7, (7, 1))(b3, train)
+        b3 = c(ch(192), (1, 7))(b3, train)
+        b4 = c(ch(192), (1, 1))(_pool(x, "avg"), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):  # grid reduction 17 -> 8
+    cfg: InceptionConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(BasicConv, dtype=self.cfg.dtype)
+        ch = self.cfg.ch
+        b1 = c(ch(192), (1, 1))(x, train)
+        b1 = c(ch(320), (3, 3), strides=(2, 2), padding="VALID")(b1, train)
+        b2 = c(ch(192), (1, 1))(x, train)
+        b2 = c(ch(192), (1, 7))(b2, train)
+        b2 = c(ch(192), (7, 1))(b2, train)
+        b2 = c(ch(192), (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    cfg: InceptionConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c = partial(BasicConv, dtype=self.cfg.dtype)
+        ch = self.cfg.ch
+        b1 = c(ch(320), (1, 1))(x, train)
+        b2 = c(ch(384), (1, 1))(x, train)
+        b2 = jnp.concatenate([c(ch(384), (1, 3))(b2, train),
+                              c(ch(384), (3, 1))(b2, train)], axis=-1)
+        b3 = c(ch(448), (1, 1))(x, train)
+        b3 = c(ch(384), (3, 3))(b3, train)
+        b3 = jnp.concatenate([c(ch(384), (1, 3))(b3, train),
+                              c(ch(384), (3, 1))(b3, train)], axis=-1)
+        b4 = c(ch(192), (1, 1))(_pool(x, "avg"), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    cfg: InceptionConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        c = partial(BasicConv, dtype=cfg.dtype)
+        ch = cfg.ch
+        x = x.astype(cfg.dtype)
+        # Stem
+        x = c(ch(32), (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = c(ch(32), (3, 3), padding="VALID")(x, train)
+        x = c(ch(64), (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = c(ch(80), (1, 1))(x, train)
+        x = c(ch(192), (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # Inception stacks
+        for pool_features in (32, 64, 64):
+            x = InceptionA(cfg, pool_features)(x, train)
+        x = InceptionB(cfg)(x, train)
+        aux = None
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(cfg, c7)(x, train)
+        if cfg.aux_head:
+            a = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+            a = c(ch(128), (1, 1))(a, train)
+            a = c(ch(768), (5, 5), padding="VALID")(a, train)
+            a = jnp.mean(a, axis=(1, 2))
+            aux = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                           param_dtype=jnp.float32, name="aux_logits")(a)
+        x = InceptionD(cfg)(x, train)
+        x = InceptionE(cfg)(x, train)
+        x = InceptionE(cfg)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="logits")(x)
+        return (logits, aux) if cfg.aux_head else logits
+
+
+def init_params(cfg: InceptionConfig, rng):
+    model = InceptionV3(cfg)
+    dummy = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    variables = model.init(rng, dummy, train=False)
+    return {"params": variables["params"],
+            "batch_stats": variables["batch_stats"]}
+
+
+def make_train_step(cfg: InceptionConfig, optimizer, mesh=None,
+                    aux_weight: float = 0.4):
+    """Train step with the original's auxiliary-classifier loss (weight 0.4),
+    BN stats threaded outside the gradient as in resnet.make_train_step."""
+    import optax
+
+    model = InceptionV3(cfg)
+
+    def step(state, batch):
+        if mesh is not None:
+            from tfmesos_tpu.parallel.sharding import batch_sharding
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, batch_sharding(mesh)), batch)
+
+        def lf(params):
+            out, updated = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                batch["image"], train=True, mutable=["batch_stats"])
+            logits, aux = out if cfg.aux_head else (out, None)
+            loss = cross_entropy_loss(logits, batch["label"])
+            if aux is not None:
+                loss = loss + aux_weight * cross_entropy_loss(aux,
+                                                              batch["label"])
+            acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"])
+                           .astype(jnp.float32))
+            return loss, (updated["batch_stats"], acc)
+
+        (loss, (batch_stats, acc)), grads = jax.value_and_grad(
+            lf, has_aux=True)(state["params"])
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "batch_stats": batch_stats,
+                 "opt_state": opt_state},
+                {"loss": loss, "accuracy": acc})
+
+    jitted = jax.jit(step, donate_argnums=(0,))
+    if mesh is not None:
+        from tfmesos_tpu.parallel.sharding import replicate_tree
+        jitted.place = lambda state: replicate_tree(mesh, state)
+    return jitted
+
+
+def eval_logits(cfg: InceptionConfig, state, images):
+    out = InceptionV3(cfg).apply(
+        {"params": state["params"], "batch_stats": state["batch_stats"]},
+        images, train=False)
+    return out[0] if cfg.aux_head else out

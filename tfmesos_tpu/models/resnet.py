@@ -1,0 +1,144 @@
+"""ResNet-50 (BASELINE.json config: "ResNet-50 ImageNet sync-SGD, no PS,
+pure ICI all-reduce").
+
+flax.linen implementation, TPU-first: NHWC layout (XLA's native conv layout
+on TPU), bf16 compute with fp32 batch-norm statistics, bottleneck v1.5
+(stride in the 3x3).  Data parallelism comes from the trainer's mesh — there
+is no PS variant, matching the BASELINE config's "no PS" phrasing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfmesos_tpu.ops.layers import cross_entropy_loss
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    image_size: int = 224
+
+    @staticmethod
+    def tiny():
+        """Test-scale variant (same code path, minutes→seconds)."""
+        return ResNetConfig(num_classes=10, stage_sizes=(1, 1), width=8,
+                            image_size=32, dtype=jnp.float32)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype, param_dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=cfg.dtype, param_dtype=jnp.float32)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=cfg.dtype,
+                                 param_dtype=jnp.float32)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(cfg.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(cfg.width * 2 ** i, strides, cfg.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x)
+        return x
+
+
+def init_params(cfg: ResNetConfig, rng):
+    model = ResNet(cfg)
+    dummy = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    variables = model.init(rng, dummy, train=False)
+    return {"params": variables["params"],
+            "batch_stats": variables["batch_stats"]}
+
+
+def make_train_step(cfg: ResNetConfig, optimizer, mesh=None):
+    """BatchNorm-aware train step: gradients flow through ``params`` only;
+    ``batch_stats`` thread through as non-differentiable state (they are
+    per-replica running stats — with data parallelism XLA keeps them local
+    and the all-reduce covers gradients only, the standard recipe).
+
+    With a mesh, call ``step.place(state)`` once to promote the host-local
+    state to mesh-replicated global arrays (pure data parallelism: params
+    replicated, batch sharded over the data axes); without it, a
+    multi-process run would mix host-local params with a global batch in
+    one jit, which JAX rejects."""
+    import optax
+
+    model = ResNet(cfg)
+
+    def step(state, batch):
+        if mesh is not None:
+            from tfmesos_tpu.parallel.sharding import batch_sharding
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, batch_sharding(mesh)), batch)
+        def lf(params):
+            logits, updated = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                batch["image"], train=True, mutable=["batch_stats"])
+            loss = cross_entropy_loss(logits, batch["label"])
+            acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"])
+                           .astype(jnp.float32))
+            return loss, (updated["batch_stats"], acc)
+
+        (loss, (batch_stats, acc)), grads = jax.value_and_grad(
+            lf, has_aux=True)(state["params"])
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {"params": params, "batch_stats": batch_stats,
+                     "opt_state": opt_state}
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    jitted = jax.jit(step, donate_argnums=(0,))
+    if mesh is not None:
+        from tfmesos_tpu.parallel.sharding import replicate_tree
+        jitted.place = lambda state: replicate_tree(mesh, state)
+    return jitted
+
+
+def eval_logits(cfg: ResNetConfig, state, images):
+    return ResNet(cfg).apply(
+        {"params": state["params"], "batch_stats": state["batch_stats"]},
+        images, train=False)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tfmesos_tpu.parallel.collectives import ppermute_shift
+from tfmesos_tpu.parallel.sharding import data_axes
 
 
 def stack_stage_params(stage_params: Sequence[Any]) -> Any:
@@ -46,14 +47,19 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
         params0 = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
         return stage_fn(params0, x)
     m = num_microbatches or n_stages
-    b = x.shape[0]
-    if b % m:
-        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    d_axes = data_axes(mesh)
+    dp_size = 1
+    for a in (d_axes or ()):
+        dp_size *= mesh.shape[a]
+    if x.shape[0] % (m * dp_size):
+        raise ValueError(f"batch {x.shape[0]} not divisible into {m} "
+                         f"microbatches x {dp_size} data shards")
 
     def local(params, xs):
         params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), params)
         stage = jax.lax.axis_index(axis)
-        micro = xs.reshape(m, b // m, *xs.shape[1:])
+        b_loc = xs.shape[0]
+        micro = xs.reshape(m, b_loc // m, *xs.shape[1:])
         mb_shape = micro.shape[1:]
 
         def tick(t, carry):
@@ -86,11 +92,14 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
         outputs = jax.lax.psum(
             jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
             axis_name=axis)
-        return outputs.reshape(b, *xs.shape[1:])
+        return outputs.reshape(b_loc, *xs.shape[1:])
 
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
+    # Activations shard over the data axes (each pipeline ring works on its
+    # batch shard) and replicate over pp, where the ring rotates them.
+    x_spec = P(data_axes(mesh), *([None] * (x.ndim - 1)))
     fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(param_specs, P()), out_specs=P(),
+                       in_specs=(param_specs, x_spec), out_specs=x_spec,
                        check_vma=False)
     return fn(stacked_params, x)

@@ -10,7 +10,7 @@ rules map named parameter axes onto ``tp``/``ep`` style mesh axes.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np
@@ -68,6 +68,20 @@ def batch_sharding(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def replicate_tree(mesh: Mesh, tree: Any) -> Any:
+    """Place a host-local pytree as mesh-replicated global arrays (valid in
+    multi-controller runs when every process holds identical values, e.g.
+    params built from a shared PRNG seed)."""
+    import jax
+
+    sharding = NamedSharding(mesh, P())
+
+    def put(x):
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree_util.tree_map(put, tree)
 
 
 def make_global_batch(mesh: Mesh, batch: Dict[str, Any],

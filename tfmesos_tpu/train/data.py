@@ -61,6 +61,30 @@ def token_batches(batch_size: int, seq_len: int, vocab_size: int,
         yield {"tokens": base.astype(np.int32)}
 
 
+def image_batches(batch_size: int, image_size: int, n_classes: int,
+                  seed: int = 0, dataset_seed: int = 1234,
+                  ) -> Iterator[Dict[str, np.ndarray]]:
+    """Synthetic labeled images: class-dependent low-frequency pattern +
+    noise (stands in for ImageNet in the vision trainers; no egress).
+
+    ``dataset_seed`` fixes the class→pattern mapping; ``seed`` only drives
+    the sample stream — so per-rank stream seeds decorrelate batches without
+    giving each data-parallel worker a different definition of the classes
+    (same split as SyntheticMNIST's templates vs batches)."""
+    freqs = np.random.RandomState(dataset_seed).rand(n_classes, 2) * 4 + 1
+    rng = np.random.RandomState(seed)
+    xs = np.linspace(0, np.pi, image_size, dtype=np.float32)
+    grid_x, grid_y = np.meshgrid(xs, xs)
+    while True:
+        labels = rng.randint(0, n_classes, size=batch_size)
+        base = np.sin(freqs[labels, 0, None, None] * grid_x[None]) * \
+            np.cos(freqs[labels, 1, None, None] * grid_y[None])
+        images = base[..., None] + 0.3 * rng.randn(
+            batch_size, image_size, image_size, 3).astype(np.float32)
+        yield {"image": images.astype(np.float32),
+               "label": labels.astype(np.int32)}
+
+
 def nmf_matrix(rows: int, cols: int, rank: int, seed: int = 0) -> np.ndarray:
     """Ground-truth low-rank non-negative matrix (reference workload shape:
     matrix_factorization.py:53)."""
