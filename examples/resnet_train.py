@@ -45,6 +45,7 @@ def main():
                         "opt_state": opt.init(state["params"])})
 
     local_bs = max(1, args.batch_size // max(1, ctx.world_size))
+    global_bs = local_bs * max(1, ctx.world_size)  # the batch actually trained
     gen = datalib.image_batches(local_bs, cfg.image_size, cfg.num_classes,
                                 seed=100 + ctx.rank)
     t0 = time.perf_counter()
@@ -58,7 +59,7 @@ def main():
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
     if ctx.is_chief:
-        images_per_sec = args.steps * args.batch_size / dt
+        images_per_sec = args.steps * global_bs / dt
         print(f"Training elapsed time: {dt:f} s", flush=True)
         print(f"images/sec: {images_per_sec:.1f} "
               f"(per chip: {images_per_sec / jax.device_count():.1f})",

@@ -13,6 +13,16 @@ def read_env(ctx, name):
     return os.environ.get(name)
 
 
+def runtime_topology(ctx):
+    """Regression probe for the silent-degradation bug: if distributed init
+    quietly fails, each process sees only its own devices and process_count
+    collapses to 1 while everything else still 'works'."""
+    import jax
+    return {"process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+            "world_size": ctx.world_size}
+
+
 def sharded_sum(ctx, total):
     """Distributed 'plus': a global array sharded across every process's
     devices, reduced with an XLA collective — 42 the TPU way."""

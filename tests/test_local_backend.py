@@ -50,5 +50,10 @@ def test_mode_a_distributed_jax_sharded_sum():
     jobs = Job(name="worker", num=2, cpus=1.0, mem=512.0)
     with cluster(jobs, backend=LocalBackend(), quiet=True,
                  start_timeout=120.0) as c:
+        # Guard against silent degradation into independent single-process
+        # runtimes (observed when a site PJRT plugin pinned the platform):
+        # the cluster must really be ONE runtime spanning both processes.
+        topo = c.run("support_funcs:runtime_topology")
+        assert topo["process_count"] == 2, topo
         results = c.run_all("support_funcs:sharded_sum", 42.0)
         assert results == [42.0, 42.0]

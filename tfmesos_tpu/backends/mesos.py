@@ -133,6 +133,7 @@ class MesosBackend(ResourceBackend):
                                         name="mesos-subscribe", daemon=True)
         self._thread.start()
         if not self._subscribed.wait(timeout=60.0):
+            self.stop()  # stop the reconnect loop; don't leak it behind the raise
             raise RuntimeError(
                 f"could not subscribe to Mesos master at "
                 f"{self.host}:{self.port} within 60s")
@@ -149,6 +150,19 @@ class MesosBackend(ResourceBackend):
                 time.sleep(self.reconnect_wait)
 
     def _run_stream(self) -> None:
+        try:
+            self._stream_once()
+        finally:
+            # We are the reader thread, so closing here cannot deadlock on
+            # the response buffer lock (unlike closing from stop()).
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+
+    def _stream_once(self) -> None:
         body: Dict[str, Any] = {
             "type": "SUBSCRIBE",
             "subscribe": {

@@ -203,46 +203,26 @@ def init_params(cfg: InceptionConfig, rng):
 
 def make_train_step(cfg: InceptionConfig, optimizer, mesh=None,
                     aux_weight: float = 0.4):
-    """Train step with the original's auxiliary-classifier loss (weight 0.4),
-    BN stats threaded outside the gradient as in resnet.make_train_step."""
-    import optax
+    """Train step with the original's auxiliary-classifier loss (weight 0.4)
+    via the shared BN-aware builder; FSDP param placement when the mesh has
+    an ``fsdp`` axis (the "4 ps" role, for real — call ``step.place``)."""
+    from tfmesos_tpu.train.trainer import make_bn_train_step
 
     model = InceptionV3(cfg)
 
-    def step(state, batch):
-        if mesh is not None:
-            from tfmesos_tpu.parallel.sharding import batch_sharding
-            batch = jax.tree_util.tree_map(
-                lambda x: jax.lax.with_sharding_constraint(
-                    x, batch_sharding(mesh)), batch)
+    def loss_and_stats(params, batch_stats, batch):
+        out, updated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"], train=True, mutable=["batch_stats"])
+        logits, aux = out if cfg.aux_head else (out, None)
+        loss = cross_entropy_loss(logits, batch["label"])
+        if aux is not None:
+            loss = loss + aux_weight * cross_entropy_loss(aux, batch["label"])
+        acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"])
+                       .astype(jnp.float32))
+        return loss, (updated["batch_stats"], {"accuracy": acc})
 
-        def lf(params):
-            out, updated = model.apply(
-                {"params": params, "batch_stats": state["batch_stats"]},
-                batch["image"], train=True, mutable=["batch_stats"])
-            logits, aux = out if cfg.aux_head else (out, None)
-            loss = cross_entropy_loss(logits, batch["label"])
-            if aux is not None:
-                loss = loss + aux_weight * cross_entropy_loss(aux,
-                                                              batch["label"])
-            acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"])
-                           .astype(jnp.float32))
-            return loss, (updated["batch_stats"], acc)
-
-        (loss, (batch_stats, acc)), grads = jax.value_and_grad(
-            lf, has_aux=True)(state["params"])
-        updates, opt_state = optimizer.update(grads, state["opt_state"],
-                                              state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        return ({"params": params, "batch_stats": batch_stats,
-                 "opt_state": opt_state},
-                {"loss": loss, "accuracy": acc})
-
-    jitted = jax.jit(step, donate_argnums=(0,))
-    if mesh is not None:
-        from tfmesos_tpu.parallel.sharding import replicate_tree
-        jitted.place = lambda state: replicate_tree(mesh, state)
-    return jitted
+    return make_bn_train_step(loss_and_stats, optimizer, mesh=mesh)
 
 
 def eval_logits(cfg: InceptionConfig, state, images):

@@ -93,49 +93,23 @@ def init_params(cfg: ResNetConfig, rng):
 
 
 def make_train_step(cfg: ResNetConfig, optimizer, mesh=None):
-    """BatchNorm-aware train step: gradients flow through ``params`` only;
-    ``batch_stats`` thread through as non-differentiable state (they are
-    per-replica running stats — with data parallelism XLA keeps them local
-    and the all-reduce covers gradients only, the standard recipe).
-
-    With a mesh, call ``step.place(state)`` once to promote the host-local
-    state to mesh-replicated global arrays (pure data parallelism: params
-    replicated, batch sharded over the data axes); without it, a
-    multi-process run would mix host-local params with a global batch in
-    one jit, which JAX rejects."""
-    import optax
+    """BatchNorm-aware train step via the shared builder: gradients through
+    ``params`` only, batch_stats threaded as state, FSDP param placement
+    when the mesh has an ``fsdp`` axis (call ``step.place(state)`` once)."""
+    from tfmesos_tpu.train.trainer import make_bn_train_step
 
     model = ResNet(cfg)
 
-    def step(state, batch):
-        if mesh is not None:
-            from tfmesos_tpu.parallel.sharding import batch_sharding
-            batch = jax.tree_util.tree_map(
-                lambda x: jax.lax.with_sharding_constraint(
-                    x, batch_sharding(mesh)), batch)
-        def lf(params):
-            logits, updated = model.apply(
-                {"params": params, "batch_stats": state["batch_stats"]},
-                batch["image"], train=True, mutable=["batch_stats"])
-            loss = cross_entropy_loss(logits, batch["label"])
-            acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"])
-                           .astype(jnp.float32))
-            return loss, (updated["batch_stats"], acc)
+    def loss_and_stats(params, batch_stats, batch):
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"], train=True, mutable=["batch_stats"])
+        loss = cross_entropy_loss(logits, batch["label"])
+        acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"])
+                       .astype(jnp.float32))
+        return loss, (updated["batch_stats"], {"accuracy": acc})
 
-        (loss, (batch_stats, acc)), grads = jax.value_and_grad(
-            lf, has_aux=True)(state["params"])
-        updates, opt_state = optimizer.update(grads, state["opt_state"],
-                                              state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        new_state = {"params": params, "batch_stats": batch_stats,
-                     "opt_state": opt_state}
-        return new_state, {"loss": loss, "accuracy": acc}
-
-    jitted = jax.jit(step, donate_argnums=(0,))
-    if mesh is not None:
-        from tfmesos_tpu.parallel.sharding import replicate_tree
-        jitted.place = lambda state: replicate_tree(mesh, state)
-    return jitted
+    return make_bn_train_step(loss_and_stats, optimizer, mesh=mesh)
 
 
 def eval_logits(cfg: ResNetConfig, state, images):

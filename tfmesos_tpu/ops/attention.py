@@ -51,8 +51,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, cfg: _FlashCfg, seq_len: int):
 
     Refs are laid out ``[1, 1, T, D]`` — (seq, head_dim) must be the trailing
     dims so blocks land on the TPU's (8, 128) tiling.
+
+    Operands stay in their input dtype (bf16 runs the MXU at full rate) with
+    fp32 accumulation via ``preferred_element_type``; softmax statistics are
+    fp32 throughout.
     """
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * cfg.scale  # [bq, d]
+    q = q_ref[0, 0, :, :]  # [bq, d], input dtype
     bq, bk = cfg.block_q, cfg.block_k
     qi = pl.program_id(1)
     nk = seq_len // bk
@@ -63,10 +67,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, cfg: _FlashCfg, seq_len: int):
 
     def body(j, carry):
         o, m, l = carry
-        k_blk = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # [bk, d]
-        v_blk = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(j * bk, bk), :]  # [bk, d]
+        v_blk = v_ref[0, 0, pl.ds(j * bk, bk), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [bq, bk]
+        s = s * cfg.scale
         if cfg.causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -76,7 +81,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, cfg: _FlashCfg, seq_len: int):
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         o_new = o * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return o_new, m_new, l_new
 
@@ -107,6 +112,8 @@ def _flash_forward(cfg: _FlashCfg, q, k, v):
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=cfg.interpret,
+        compiler_params=None if cfg.interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * t * k.shape[1] * d,
             bytes_accessed=(q.size + k.size + v.size + q.size) * q.dtype.itemsize,

@@ -70,18 +70,32 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def replicate_tree(mesh: Mesh, tree: Any) -> Any:
-    """Place a host-local pytree as mesh-replicated global arrays (valid in
-    multi-controller runs when every process holds identical values, e.g.
-    params built from a shared PRNG seed)."""
+def place_tree(mesh: Mesh, tree: Any, sharding_tree: Optional[Any] = None) -> Any:
+    """Place a host-local pytree as global arrays under the given shardings
+    (default: fully replicated).
+
+    Works in multi-controller runs where every process holds identical full
+    values (e.g. params from a shared PRNG seed): each process contributes
+    its addressable shards via ``make_array_from_callback`` slicing its own
+    copy, so both replicated and sharded placements assemble correctly.
+    """
     import jax
 
-    sharding = NamedSharding(mesh, P())
+    if sharding_tree is None:
+        rep = NamedSharding(mesh, P())
+        sharding_tree = jax.tree_util.tree_map(lambda _: rep, tree)
 
-    def put(x):
-        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+    def put(x, sh):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sh,
+                                            lambda idx: x[idx])
 
-    return jax.tree_util.tree_map(put, tree)
+    return jax.tree_util.tree_map(put, tree, sharding_tree)
+
+
+def replicate_tree(mesh: Mesh, tree: Any) -> Any:
+    """Mesh-replicated placement of a host-local pytree."""
+    return place_tree(mesh, tree)
 
 
 def make_global_batch(mesh: Mesh, batch: Dict[str, Any],

@@ -374,7 +374,7 @@ class TPUMesosScheduler:
                 "forward_addresses": self.forward_addresses,
                 "extra_config": self.extra_config,
                 "protocol": self.protocol,
-                "mesh_axes": self.mesh_axes,
+                "mesh_axes": self.mesh_axes or self._default_mesh_axes(),
                 "env": self.env,
             }
             try:
@@ -399,6 +399,14 @@ class TPUMesosScheduler:
             self.started = True
         self.log.info("cluster started: %d task(s), coordinator %s",
                       world_size, coordinator)
+
+    def _default_mesh_axes(self) -> Dict[str, int]:
+        """North-star mapping (BASELINE.json / SURVEY §2.7): ps jobs in the
+        spec mean "shard the parameters", so the whole device set becomes an
+        ``fsdp`` axis; workers-only means plain data parallelism.  -1 lets
+        the runtime absorb however many devices actually exist."""
+        has_ps = any(job.name == "ps" for job in self.task_spec)
+        return {"fsdp": -1} if has_ps else {"dp": -1}
 
     # -- user-facing surface ----------------------------------------------
 

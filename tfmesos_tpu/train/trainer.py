@@ -21,8 +21,10 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tfmesos_tpu.parallel.sharding import batch_sharding, fsdp_sharding_tree
+from tfmesos_tpu.parallel.sharding import (batch_sharding, fsdp_sharding_tree,
+                                           place_tree)
 from tfmesos_tpu.utils.logging import get_logger
+from tfmesos_tpu.utils.profiling import trace
 
 log = get_logger("tfmesos_tpu.trainer")
 
@@ -67,11 +69,11 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                                        param_specs,
                                        is_leaf=lambda s: isinstance(s, P))
                 if param_specs is not None else fsdp_sharding_tree(params, mesh))
-        params = jax.device_put(params, p_sh)
         # Optimizer moments mirror the param shardings (matched by path, not
         # shape: e.g. wq/wo share a shape but carry transposed specs).
         o_sh = _opt_shardings(opt_state, params, p_sh, mesh)
-        opt_state = jax.device_put(opt_state, o_sh)
+        params = place_tree(mesh, params, p_sh)
+        opt_state = place_tree(mesh, opt_state, o_sh)
         return params, opt_state
 
     data_sh = batch_sharding(mesh)
@@ -85,6 +87,52 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
 
     jitted = jax.jit(sharded_step, donate_argnums=(0, 1))
     jitted.place = place  # type: ignore[attr-defined]
+    return jitted
+
+
+def make_bn_train_step(loss_and_stats_fn, optimizer, mesh: Optional[Mesh] = None):
+    """Train step for models with non-differentiable collection state (batch
+    norm): gradients flow through ``params`` only; the extra state threads
+    through as data.
+
+    ``loss_and_stats_fn(params, batch_stats, batch) -> (loss,
+    (new_batch_stats, metrics))``.  State dict: ``{"params", "batch_stats",
+    "opt_state"}``.  With a mesh, ``step.place(state)`` gives params and
+    optimizer moments FSDP placement when the mesh has an ``fsdp`` axis
+    (replicated otherwise) and batch_stats replicated — the "ps role
+    collapses into parameter sharding" mapping, for real.
+    """
+    import optax
+
+    def step(state, batch):
+        if mesh is not None:
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, batch_sharding(mesh)), batch)
+
+        (loss, (batch_stats, metrics)), grads = jax.value_and_grad(
+            loss_and_stats_fn, has_aux=True)(state["params"],
+                                             state["batch_stats"], batch)
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        out_metrics = dict(metrics)
+        out_metrics["loss"] = loss
+        return ({"params": params, "batch_stats": batch_stats,
+                 "opt_state": opt_state}, out_metrics)
+
+    jitted = jax.jit(step, donate_argnums=(0,))
+    if mesh is not None:
+        def place(state):
+            p_sh = fsdp_sharding_tree(state["params"], mesh)
+            o_sh = _opt_shardings(state["opt_state"], state["params"], p_sh,
+                                  mesh)
+            return {
+                "params": place_tree(mesh, state["params"], p_sh),
+                "batch_stats": place_tree(mesh, state["batch_stats"]),
+                "opt_state": place_tree(mesh, state["opt_state"], o_sh),
+            }
+        jitted.place = place
     return jitted
 
 
@@ -133,16 +181,18 @@ class TrainLoop:
         params, opt_state = self.state.params, self.state.opt_state
         t_start = time.perf_counter()
         metrics = {}
-        for i in range(num_steps):
-            batch = next(batches)
-            params, opt_state, metrics = self.step_fn(params, opt_state, batch)
-            if (i + 1) % self.log_every == 0 or i + 1 == num_steps:
-                metrics = {k: float(v) for k, v in metrics.items()}
-                if on_metrics:
-                    on_metrics(i + 1, metrics)
-                else:
-                    log.info("%s step %d: %s", self.name, i + 1,
-                             {k: round(v, 4) for k, v in metrics.items()})
+        with trace():  # no-op unless TPUMESOS_TRACE_DIR is exported
+            for i in range(num_steps):
+                batch = next(batches)
+                params, opt_state, metrics = self.step_fn(params, opt_state,
+                                                          batch)
+                if (i + 1) % self.log_every == 0 or i + 1 == num_steps:
+                    metrics = {k: float(v) for k, v in metrics.items()}
+                    if on_metrics:
+                        on_metrics(i + 1, metrics)
+                    else:
+                        log.info("%s step %d: %s", self.name, i + 1,
+                                 {k: round(v, 4) for k, v in metrics.items()})
         jax.block_until_ready(params)
         elapsed = time.perf_counter() - t_start
         self.state = TrainState(params, opt_state, self.state.step + num_steps)

@@ -1,0 +1,41 @@
+"""Tracing/profiling hooks (SURVEY §5: absent in the reference; optional
+here).
+
+Thin wrappers over the JAX profiler so traces can be captured on any task
+and inspected with Perfetto/TensorBoard.  Enable globally by exporting
+``TPUMESOS_TRACE_DIR`` — the trainer and node runtime leave these off by
+default (profiling is opt-in; it perturbs step timing).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+TRACE_DIR_ENV = "TPUMESOS_TRACE_DIR"
+
+
+@contextmanager
+def trace(logdir: Optional[str] = None) -> Iterator[Optional[str]]:
+    """Capture a profiler trace for the enclosed block.
+
+    Yields the trace directory, or None (block still runs, untraced) when no
+    directory is configured — so call sites can wrap unconditionally.
+    """
+    logdir = logdir or os.environ.get(TRACE_DIR_ENV)
+    if not logdir:
+        yield None
+        return
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (shows up on the Perfetto timeline)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
