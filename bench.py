@@ -48,7 +48,6 @@ def bench_mnist_replica(steps=2000, warmup=100):
     local_bs = max(1, 100 // n_chips)
     batch = make_global_batch(mesh, next(ds.batches(local_bs * n_chips)))
 
-    import numpy as np
 
     for _ in range(warmup):
         params, opt_state, metrics = step(params, opt_state, batch)
@@ -77,7 +76,6 @@ def bench_transformer_tokens(iters=20):
     tokens = jax.random.randint(jax.random.PRNGKey(1), (b, t + 1), 0,
                                 cfg.vocab_size, dtype=jnp.int32)
 
-    import numpy as np
     import optax
 
     # Chain params through a real optimizer update each iteration so no

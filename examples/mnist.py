@@ -18,7 +18,6 @@ from tfmesos_tpu import cluster
 
 def train(ctx, steps=500, batch_size=100, lr=0.1):
     import jax
-    import numpy as np
     import optax
     from tfmesos_tpu.models import mlp
     from tfmesos_tpu.parallel.sharding import make_global_batch

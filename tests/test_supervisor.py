@@ -1,0 +1,107 @@
+"""Restart supervision: cluster failures retry and training resumes from
+the latest checkpoint; workload bugs do not retry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tfmesos_tpu.models import mlp
+from tfmesos_tpu.scheduler import ClusterError
+from tfmesos_tpu.train import data as datalib
+from tfmesos_tpu.train.checkpoint import CheckpointManager
+from tfmesos_tpu.train.supervisor import supervise
+from tfmesos_tpu.train.trainer import make_train_step
+
+
+def test_retries_cluster_errors_then_succeeds():
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        if i < 2:
+            raise ClusterError(f"task died (attempt {i})")
+        return "done"
+
+    result = supervise(attempt, max_restarts=3, restart_wait=0.01)
+    assert result.value == "done"
+    assert result.attempts == 3
+    assert calls == [0, 1, 2]
+
+
+def test_workload_bugs_do_not_retry():
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        raise ValueError("bug in user code")
+
+    with pytest.raises(ValueError):
+        supervise(attempt, max_restarts=3, restart_wait=0.01)
+    assert calls == [0]
+
+
+def test_remote_user_code_errors_do_not_retry():
+    from tfmesos_tpu.scheduler import RemoteError
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        raise RemoteError("dispatched function raised on task worker:0")
+
+    with pytest.raises(RemoteError):
+        supervise(attempt, max_restarts=3, restart_wait=0.01)
+    assert calls == [0]  # deterministic user-code failure: no restarts
+
+
+def test_restart_budget_exhausted():
+    def attempt(i):
+        raise ClusterError("always dying")
+
+    with pytest.raises(ClusterError):
+        supervise(attempt, max_restarts=2, restart_wait=0.01)
+
+
+def test_training_resumes_from_checkpoint_across_restarts(tmp_path):
+    """End-to-end restart semantics: a 30-step job whose cluster 'dies'
+    after 10 steps on the first attempt finishes with exactly 30 total
+    effective steps, not 40."""
+    cfg = mlp.MLPConfig(in_dim=16, hidden=8, n_classes=4)
+    ds = datalib.SyntheticMNIST(n_classes=4, dim=16)
+    opt = optax.sgd(0.1)
+    step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt)
+    total_steps, fail_at = 30, 10
+    steps_run = []
+
+    def attempt(i):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        try:
+            params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+            opt_state = opt.init(params)
+            start_step = 0
+            like = {"params": params, "opt_state": opt_state,
+                    "step": jnp.asarray(0)}
+            restored = mgr.restore(
+                jax.tree_util.tree_map(jnp.zeros_like, like))
+            if restored is not None:
+                params, opt_state = restored["params"], restored["opt_state"]
+                start_step = int(restored["step"])
+            gen = ds.batches(32, seed=7)
+            for s in range(start_step, total_steps):
+                params, opt_state, metrics = step(params, opt_state, next(gen))
+                steps_run.append(s)
+                if (s + 1) % 10 == 0:
+                    mgr.save(s + 1, {"params": params, "opt_state": opt_state,
+                                     "step": jnp.asarray(s + 1)})
+                if i == 0 and s + 1 == fail_at:
+                    raise ClusterError("simulated mid-training task death")
+            return float(metrics["loss"])
+        finally:
+            mgr.close()
+
+    result = supervise(attempt, max_restarts=2, restart_wait=0.01)
+    assert result.attempts == 2
+    assert len(steps_run) == total_steps  # 10 before death + 20 after resume
+    assert steps_run[fail_at] == fail_at  # resumed exactly where saved
+    assert np.isfinite(result.value)

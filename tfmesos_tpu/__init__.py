@@ -15,11 +15,12 @@ from __future__ import annotations
 from contextlib import contextmanager
 
 from tfmesos_tpu.spec import Job, normalize_jobs
-from tfmesos_tpu.scheduler import ClusterError, TPUMesosScheduler
+from tfmesos_tpu.scheduler import ClusterError, RemoteError, TPUMesosScheduler
 
 __VERSION__ = "0.1.0"
 
-__all__ = ["cluster", "Job", "TPUMesosScheduler", "ClusterError", "__VERSION__"]
+__all__ = ["cluster", "Job", "TPUMesosScheduler", "ClusterError",
+           "RemoteError", "__VERSION__"]
 
 
 @contextmanager

@@ -44,9 +44,15 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16          # compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32     # master params
     remat: bool = False                # jax.checkpoint each block
-    # MoE (0 experts = dense):
+    # MoE (0 experts = no MoE):
     n_experts: int = 0
     top_k: int = 2
+    # "dense": exact top-k, every expert computes everything (masked) —
+    # simple, shardable over ep as pure weight sharding.
+    # "switch": top-1 routing with capacity + real all_to_all token dispatch
+    # over the ep axis (parallel/moe.py) — the scalable path.
+    moe_impl: str = "dense"
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -97,6 +103,26 @@ def _mlp(cfg: TransformerConfig, lp, h):
                   lp["w_up"].astype(cfg.dtype), lp["w_down"].astype(cfg.dtype))
 
 
+def _moe_switch(cfg: TransformerConfig, mesh, lp, h):
+    """Expert-parallel switch MoE: flatten tokens and run the all_to_all
+    dispatch path (top-1, capacity-limited — not identical math to the
+    dense top-k path; choose per config).  Meshless calls use the
+    single-device reference with the SAME routing semantics, so a model
+    trained with moe_impl="switch" evaluates identically without a mesh."""
+    from tfmesos_tpu.parallel.moe import switch_moe, switch_moe_reference
+    b, t, d = h.shape
+    flat = h.reshape(b * t, d)
+    router = lp["router"].astype(cfg.dtype)
+    if mesh is None:
+        out = switch_moe_reference(flat, router, lp["e_gate"], lp["e_up"],
+                                   lp["e_down"],
+                                   capacity_factor=cfg.capacity_factor)
+    else:
+        out = switch_moe(flat, router, lp["e_gate"], lp["e_up"], lp["e_down"],
+                         mesh, capacity_factor=cfg.capacity_factor)
+    return out.reshape(b, t, d)
+
+
 def _moe(cfg: TransformerConfig, lp, h):
     """Top-k routed MoE, computed densely over the expert axis.
 
@@ -130,8 +156,15 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions):
     o = attend(q, k, v, mesh=mesh, causal=True)
     x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
-    x = x + (_moe(cfg, lp, h) if cfg.n_experts else _mlp(cfg, lp, h))
-    return x
+    if not cfg.n_experts:
+        ffn = _mlp(cfg, lp, h)
+    elif cfg.moe_impl == "switch":
+        # Same model function with or without a mesh (switch_moe falls back
+        # to its single-device reference when the ep axis is absent).
+        ffn = _moe_switch(cfg, mesh, lp, h)
+    else:
+        ffn = _moe(cfg, lp, h)
+    return x + ffn
 
 
 def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None):

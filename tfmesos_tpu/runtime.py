@@ -122,16 +122,16 @@ def initialize(ctx: Optional[TaskContext] = None) -> TaskContext:
     global _initialized
     if ctx is None:
         ctx = TaskContext.from_env()
+    # Make the env var authoritative even when a site-installed PJRT plugin
+    # pre-set the platform via jax.config at interpreter start (observed
+    # with the axon plugin: config beats JAX_PLATFORMS, so a multi-process
+    # CPU cluster would silently fall apart into single-device processes —
+    # and single-process runs would ignore a requested CPU platform too).
+    import jax
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        jax.config.update("jax_platforms", platforms)
     if ctx.world_size > 1 and not _initialized:
-        import jax
-        # Make the env var authoritative even when a site-installed PJRT
-        # plugin pre-set the platform via jax.config at interpreter start
-        # (observed with the axon plugin: config beats JAX_PLATFORMS, so a
-        # multi-process CPU cluster would silently fall apart into
-        # single-device processes).
-        platforms = os.environ.get("JAX_PLATFORMS")
-        if platforms:
-            jax.config.update("jax_platforms", platforms)
         jax.distributed.initialize(
             coordinator_address=ctx.coordinator,
             num_processes=ctx.world_size,

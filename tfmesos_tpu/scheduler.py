@@ -44,6 +44,11 @@ class ClusterError(RuntimeError):
     scheduler.py:394-401, 416-420, 445-457)."""
 
 
+class RemoteError(ClusterError):
+    """A dispatched function raised on a task — user-code failure, not
+    infrastructure death.  Restart supervision must NOT retry these."""
+
+
 class TPUMesosScheduler:
     """Owns the task table and drives bring-up → run → teardown.
 
@@ -475,7 +480,7 @@ class TPUMesosScheduler:
                 errors.append(f"on {task}:\n{reply.get('error')}")
             results.append(reply.get("value"))
         if errors:
-            raise ClusterError("remote failure " + "\n".join(errors))
+            raise RemoteError("remote failure " + "\n".join(errors))
         return results
 
     def finished(self) -> bool:

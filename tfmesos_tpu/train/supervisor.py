@@ -1,0 +1,64 @@
+"""Driver-level restart supervision.
+
+The reference's failure story ends at fail-fast: any task death after
+cluster start raises and tears everything down (scheduler.py:394-401), and
+SURVEY §5 notes the idiomatic TPU upgrade is *not* pretend-elasticity (a TPU
+mesh cannot hot-swap members mid-program) but automatic re-provision plus
+restart from checkpoint.  This supervisor is that upgrade: it re-runs a
+cluster bring-up + workload function until success, counting attempts, while
+the workload checkpoints through :class:`~tfmesos_tpu.train.checkpoint.
+CheckpointManager` and resumes from the latest step on each attempt.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from tfmesos_tpu.scheduler import ClusterError, RemoteError
+from tfmesos_tpu.utils.logging import get_logger
+
+log = get_logger("tfmesos_tpu.supervisor")
+
+
+@dataclass
+class SuperviseResult:
+    value: Any
+    attempts: int
+    elapsed_s: float
+
+
+def supervise(run_attempt: Callable[[int], Any], max_restarts: int = 3,
+              restart_wait: float = 5.0,
+              should_retry: Optional[Callable[[BaseException], bool]] = None,
+              ) -> SuperviseResult:
+    """Run ``run_attempt(attempt_index)`` until it returns, restarting on
+    cluster failure.
+
+    ``run_attempt`` owns the whole attempt: bring up a cluster, restore the
+    latest checkpoint, train, tear down (the ``cluster()`` context manager
+    handles teardown even on failure).  Only :class:`ClusterError` — i.e.
+    infrastructure death, the thing restarts can actually fix — triggers a
+    retry by default; workload bugs propagate immediately, including
+    exceptions raised by dispatched functions on tasks
+    (:class:`RemoteError`).  ``should_retry`` widens/narrows that policy.
+    """
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            value = run_attempt(attempt)
+            return SuperviseResult(value=value, attempts=attempt + 1,
+                                   elapsed_s=time.monotonic() - start)
+        except BaseException as e:
+            retry = (should_retry(e) if should_retry is not None
+                     else isinstance(e, ClusterError)
+                     and not isinstance(e, RemoteError))
+            if not retry or attempt >= max_restarts:
+                raise
+            attempt += 1
+            log.warning("attempt %d failed (%s: %s); restarting in %.1fs "
+                        "(%d restart(s) left)", attempt, type(e).__name__, e,
+                        restart_wait, max_restarts - attempt + 1)
+            time.sleep(restart_wait)

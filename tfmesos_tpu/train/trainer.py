@@ -181,18 +181,30 @@ class TrainLoop:
         params, opt_state = self.state.params, self.state.opt_state
         t_start = time.perf_counter()
         metrics = {}
-        with trace():  # no-op unless TPUMESOS_TRACE_DIR is exported
-            for i in range(num_steps):
-                batch = next(batches)
-                params, opt_state, metrics = self.step_fn(params, opt_state,
-                                                          batch)
-                if (i + 1) % self.log_every == 0 or i + 1 == num_steps:
-                    metrics = {k: float(v) for k, v in metrics.items()}
-                    if on_metrics:
-                        on_metrics(i + 1, metrics)
-                    else:
-                        log.info("%s step %d: %s", self.name, i + 1,
-                                 {k: round(v, 4) for k, v in metrics.items()})
+
+        def run_step(i):
+            nonlocal params, opt_state, metrics
+            batch = next(batches)
+            params, opt_state, metrics = self.step_fn(params, opt_state, batch)
+            if (i + 1) % self.log_every == 0 or i + 1 == num_steps:
+                metrics = {k: float(v) for k, v in metrics.items()}
+                if on_metrics:
+                    on_metrics(i + 1, metrics)
+                else:
+                    log.info("%s step %d: %s", self.name, i + 1,
+                             {k: round(v, 4) for k, v in metrics.items()})
+
+        # Profile a bounded window, not the whole run: an unbounded trace of
+        # a long job is multi-GB and unopenable.  No-op unless
+        # TPUMESOS_TRACE_DIR is exported.
+        import os
+        traced = min(num_steps,
+                     int(os.environ.get("TPUMESOS_TRACE_STEPS", "20")))
+        with trace():
+            for i in range(traced):
+                run_step(i)
+        for i in range(traced, num_steps):
+            run_step(i)
         jax.block_until_ready(params)
         elapsed = time.perf_counter() - t_start
         self.state = TrainState(params, opt_state, self.state.step + num_steps)
