@@ -1,0 +1,54 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfmesos_tpu.ops.quant import (dequantize_int8, quantize_int8,
+                                   quantize_int8_reference)
+
+
+def test_reference_roundtrip_error_bound():
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 256), jnp.float32)
+    values, scales = quantize_int8_reference(x)
+    assert values.dtype == jnp.int8 and scales.shape == (64, 1)
+    err = np.max(np.abs(np.asarray(dequantize_int8(values, scales) - x)))
+    # Max error is half a quantization step per row.
+    max_step = float(jnp.max(scales))
+    assert err <= max_step / 2 + 1e-6
+
+
+def test_pallas_kernel_matches_reference():
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 128), jnp.float32)
+    ref_v, ref_s = quantize_int8_reference(x)
+    got_v, got_s = quantize_int8(x, use_pallas=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
+    np.testing.assert_allclose(np.asarray(got_s), np.asarray(ref_s),
+                               rtol=1e-6)
+
+
+def test_stochastic_rounding_unbiased():
+    # A value exactly between two quantization levels must round both ways
+    # with the dither, averaging out to the true value.
+    x = jnp.full((8, 128), 0.5, jnp.float32)
+    x = x.at[:, 0].set(127.0)  # pins scale to 1.0 per row
+    totals = []
+    for seed in range(8):
+        # stochastic + interpret routes to the XLA path (the Pallas
+        # interpreter has no TPU PRNG); semantics are identical.
+        v, s = quantize_int8(x, stochastic=True, seed=seed, interpret=True)
+        totals.append(np.asarray(dequantize_int8(v, s))[:, 1:])
+    mean = np.mean(totals)
+    assert 0.3 < mean < 0.7  # deterministic rounding would give 0.0 or 1.0
+    assert np.std([np.mean(t) for t in totals]) > 0  # seeds differ
+
+
+def test_zero_rows_do_not_nan():
+    x = jnp.zeros((4, 128), jnp.float32)
+    v, s = quantize_int8(x, use_pallas=True, interpret=True)
+    assert np.all(np.asarray(v) == 0)
+    assert np.all(np.isfinite(np.asarray(s)))
+
+
+def test_rejects_bad_rank():
+    with pytest.raises(ValueError):
+        quantize_int8(jnp.zeros((2, 3, 4)))

@@ -15,6 +15,15 @@ def test_normalize_jobs_variants():
         normalize_jobs([42])
 
 
+def test_job_gpus_alias():
+    # Drop-in compat: reference job specs say gpus=, ours says chips=.
+    assert Job(name="w", num=1, gpus=4).chips == 4
+    [j] = normalize_jobs({"name": "w", "num": 2, "gpus": 2})
+    assert j.chips == 2
+    with pytest.raises(ValueError):
+        Job(name="w", num=1, gpus=1, chips=1)
+
+
 def test_job_validation():
     with pytest.raises(ValueError):
         Job(name="w", num=0)

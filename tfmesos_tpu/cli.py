@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "to collect; default chief only")
     p.add_argument("--gang", action="store_true",
                    help="all-or-nothing placement (TPU slice atomicity)")
+    p.add_argument("--restarts", type=int, default=0,
+                   help="auto-restart the whole cluster up to N times on any "
+                        "post-start task failure (a between-graph framework "
+                        "cannot tell a crashed command from dead "
+                        "infrastructure — both are TASK_FAILED); pair with "
+                        "workload checkpoints for resume. Default 0 = fail "
+                        "fast like the reference")
     p.add_argument("--mesh", type=str, default=None,
                    help="explicit mesh axes, e.g. dp=4,tp=2")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -184,7 +191,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     forward = forward_map(args.worker_logs, args.nworker, collector.addr)
 
     from tfmesos_tpu.scheduler import ClusterError
-    try:
+
+    def attempt(i):
+        # Retry messaging is the supervisor's job; no duplicate banner here.
         with cluster(jobs, master=args.master, name=args.name,
                      quiet=not args.verbose,
                      containerizer_type=args.containerizer_type,
@@ -200,6 +209,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             deadline = time.monotonic() + 1.0
             while time.monotonic() < deadline:
                 collector.pump(timeout=0.1)
+
+    try:
+        if args.restarts > 0:
+            from tfmesos_tpu.train.supervisor import supervise
+            supervise(attempt, max_restarts=args.restarts, restart_wait=2.0)
+        else:
+            attempt(0)
     except ClusterError as e:
         # Fail-fast is policy (reference scheduler.py:394-401); the CLI
         # surfaces it as one line, not a stack trace.

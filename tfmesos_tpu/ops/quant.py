@@ -1,0 +1,87 @@
+"""Int8 quantization kernels (Pallas), for activation/weight compression.
+
+Per-row absmax scaling: ``x ≈ values * scales[row]`` with int8 values.
+The TPU kernel optionally uses stochastic rounding (hardware PRNG) — the
+right choice when quantized tensors feed training — while the XLA reference
+path rounds to nearest.  HBM-bandwidth win: int8 halves bf16 traffic for
+communication-bound tensors (e.g. cross-DCN gradient exchange).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def quantize_int8_reference(x) -> Tuple[jax.Array, jax.Array]:
+    """Round-to-nearest per-row absmax quantization (ground truth)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    values = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return values.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_int8(values, scales):
+    return values.astype(jnp.float32) * scales
+
+
+def _quant_kernel(seed_ref, x_ref, values_ref, scales_ref, *, stochastic: bool):
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    scaled = x / scale
+    if stochastic:
+        pltpu.prng_seed(seed_ref[0])
+        bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
+        # Uniform dither in [-0.5, 0.5) then round == stochastic rounding.
+        # Mosaic has no uint32->f32 cast: drop to 24 bits via int32 first
+        # (top byte shifted out, so the sign bit is always clear).
+        bits24 = pltpu.bitcast(bits >> 8, jnp.int32)
+        dither = bits24.astype(jnp.float32) / jnp.float32(2 ** 24) - 0.5
+        scaled = scaled + dither
+    values_ref[:] = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
+    scales_ref[:] = scale
+
+
+def quantize_int8(x, stochastic: bool = False, seed: int = 0,
+                  use_pallas: bool = None, interpret: bool = False):
+    """Quantize ``[rows, cols]`` to (int8 values, fp32 per-row scales)."""
+    if x.ndim != 2:
+        raise ValueError(f"expected 2D input, got shape {x.shape}")
+    if use_pallas is None:
+        use_pallas = interpret or jax.default_backend() == "tpu"
+    if stochastic and interpret:
+        # The Pallas interpreter doesn't implement the TPU PRNG; the XLA
+        # path has identical semantics (uniform dither then round).
+        use_pallas = False
+    if not use_pallas:
+        if stochastic:
+            key = jax.random.PRNGKey(seed)
+            absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                             keepdims=True)
+            scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+            scaled = x.astype(jnp.float32) / scale
+            dither = jax.random.uniform(key, scaled.shape) - 0.5
+            values = jnp.clip(jnp.round(scaled + dither), -127, 127)
+            return values.astype(jnp.int8), scale.astype(jnp.float32)
+        return quantize_int8_reference(x)
+    rows, cols = x.shape
+    kernel = functools.partial(_quant_kernel, stochastic=stochastic)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                       pl.BlockSpec(memory_space=pltpu.VMEM)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((rows, cols), jnp.int8),
+                   jax.ShapeDtypeStruct((rows, 1), jnp.float32)],
+        interpret=interpret,
+    )(jnp.array([seed], jnp.int32), x)

@@ -452,7 +452,23 @@ class TPUMesosScheduler:
         results = self.run_all(func, *args, **kwargs)
         return results[0]
 
+    def run_on(self, ranks, func: Any, *args: Any, **kwargs: Any) -> List[Any]:
+        """Targeted dispatch to a subset of tasks by global rank — the
+        analogue of the reference's per-task op placement
+        (``tf.device('/job:ps/task:k')``, matrix_factorization.py:21-28).
+
+        Only for per-process work (IO, debugging, state inspection): a
+        function that enters an XLA collective must run on EVERY process or
+        the mesh deadlocks — use :meth:`run` / :meth:`run_all` for those.
+        Results come back in the order of ``ranks``; an unknown or
+        non-dispatchable rank is an error, not a silent skip.
+        """
+        return self._dispatch(func, args, kwargs, ranks=list(ranks))
+
     def run_all(self, func: Any, *args: Any, **kwargs: Any) -> List[Any]:
+        return self._dispatch(func, args, kwargs, ranks=None)
+
+    def _dispatch(self, func, args, kwargs, ranks) -> List[Any]:
         with self._lock:
             if not self.started:
                 raise ClusterError("cluster not started")
@@ -461,7 +477,17 @@ class TPUMesosScheduler:
             self._call_id += 1
             call_id = self._call_id
         spec = _func_spec(func)
-        mode_a = [t for t in self.tasks if t.cmd is None and t.connection is not None]
+        dispatchable = {rank: t for rank, t in enumerate(self.tasks)
+                        if t.cmd is None and t.connection is not None}
+        if ranks is None:
+            mode_a = list(dispatchable.values())
+        else:
+            bad = [r for r in ranks if r not in dispatchable]
+            if bad:
+                raise ClusterError(
+                    f"rank(s) {bad} are not connected in-graph tasks "
+                    f"(dispatchable: {sorted(dispatchable)})")
+            mode_a = [dispatchable[r] for r in ranks]  # request order
         if not mode_a:
             raise ClusterError("no in-graph (cmd=None) tasks to dispatch to")
         msg = {"op": "run", "call_id": call_id, "func": spec,

@@ -13,7 +13,7 @@ from __future__ import annotations
 import os
 import sys
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import InitVar, dataclass, field
 from typing import Any, Dict, List, Optional
 
 from tfmesos_tpu.wire import TOKEN_ENV as _TOKEN_ENV
@@ -24,7 +24,8 @@ class Job:
     """A homogeneous group of tasks (reference: scheduler.py:21-31).
 
     ``start`` supports launching a partial index range, exactly as the
-    reference allows (scheduler.py:29-31).
+    reference allows (scheduler.py:29-31).  ``gpus=`` is accepted as a
+    drop-in alias for ``chips`` so reference job specs work unchanged.
     """
 
     name: str
@@ -34,8 +35,13 @@ class Job:
     chips: int = 0
     cmd: Optional[str] = None
     start: int = 0
+    gpus: InitVar[Optional[int]] = None
 
-    def __post_init__(self) -> None:
+    def __post_init__(self, gpus: Optional[int] = None) -> None:
+        if gpus is not None:
+            if self.chips:
+                raise ValueError(f"job {self.name!r}: pass chips or gpus, not both")
+            self.chips = gpus
         if self.num <= 0:
             raise ValueError(f"job {self.name!r}: num must be positive, got {self.num}")
         if not 0 <= self.start < self.num:
