@@ -67,11 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="all-or-nothing placement (TPU slice atomicity)")
     p.add_argument("--restarts", type=int, default=0,
                    help="auto-restart the whole cluster up to N times on any "
-                        "post-start task failure (a between-graph framework "
-                        "cannot tell a crashed command from dead "
-                        "infrastructure — both are TASK_FAILED); pair with "
-                        "workload checkpoints for resume. Default 0 = fail "
-                        "fast like the reference")
+                        "cluster failure, bring-up or post-start (a "
+                        "between-graph framework cannot tell a crashed "
+                        "command from dead infrastructure — both are "
+                        "TASK_FAILED; bring-up already retries placement 3x "
+                        "per attempt). Pair with workload checkpoints for "
+                        "resume. Default 0 = fail fast like the reference")
     p.add_argument("--mesh", type=str, default=None,
                    help="explicit mesh axes, e.g. dp=4,tp=2")
     p.add_argument("cmd", nargs=argparse.REMAINDER,

@@ -45,7 +45,8 @@ class _FlashCfg(NamedTuple):
     interpret: bool
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, cfg: _FlashCfg, seq_len: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
+                  seq_len: int):
     """One (batch, q-block, head) grid cell: stream K/V blocks with online
     softmax.  Accumulation in fp32; output cast back at the end.
 
@@ -91,6 +92,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, cfg: _FlashCfg, seq_len: int):
     l0 = jnp.zeros((bq, 1), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, nk, body, (o0, m0, l0))
     o_ref[0, 0, :, :] = (o / l).astype(o_ref.dtype)
+    # Per-query logsumexp of the SCALED scores: the backward pass reuses it
+    # instead of re-sweeping Q.K^T (causal rows always hit the diagonal, so
+    # l > 0 here).
+    lse_ref[0, 0, :, :] = m + jnp.log(l)
 
 
 def _flash_forward(cfg: _FlashCfg, q, k, v):
@@ -104,13 +109,17 @@ def _flash_forward(cfg: _FlashCfg, q, k, v):
     kv_spec = pl.BlockSpec((1, 1, k.shape[1], d),
                            lambda bi, qi, hi: (bi, hi, 0, 0),
                            memory_space=pltpu.VMEM)
+    lse_spec = pl.BlockSpec((1, 1, cfg.block_q, 1),
+                            lambda bi, qi, hi: (bi, hi, qi, 0),
+                            memory_space=pltpu.VMEM)
     kernel = functools.partial(_flash_kernel, cfg=cfg, seq_len=k.shape[1])
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=[q_spec, lse_spec],
+        out_shape=[jax.ShapeDtypeStruct(qt.shape, q.dtype),
+                   jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32)],
         interpret=cfg.interpret,
         compiler_params=None if cfg.interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")),
@@ -120,26 +129,68 @@ def _flash_forward(cfg: _FlashCfg, q, k, v):
             transcendentals=b * h * t * k.shape[1],
         ),
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+def _mha_bwd_blockwise(cfg: _FlashCfg, q, k, v, o, lse, do):
+    """Analytical flash-attention backward, blockwise over K/V.
+
+    Never materializes the [T, T] probability matrix: per K-block
+    recomputation against the per-query logsumexp (``lse``, emitted by the
+    forward kernel), with the standard identities dv = pᵀ·do,
+    ds = p ⊙ (do·vᵀ − D), dq += ds·k, dk += dsᵀ·q where D = rowsum(do ⊙ o).
+    Memory is O(T·(D + block)) instead of the O(T²) a straight vjp of the
+    reference softmax costs.
+    """
+    in_dtype = q.dtype
+    # layout: [B,H,T,D] fp32 throughout
+    qf, kf, vf, of, dof = (x.transpose(0, 2, 1, 3).astype(jnp.float32)
+                           for x in (q, k, v, o, do))
+    qf = qf * cfg.scale
+    b, h, t, d = qf.shape
+    block_k = min(cfg.block_k, kf.shape[2])
+    nk = kf.shape[2] // block_k
+
+    delta = jnp.sum(dof * of, axis=-1, keepdims=True)        # [B,H,T,1]
+    kb = kf.reshape(b, h, nk, block_k, d)
+    vb = vf.reshape(b, h, nk, block_k, d)
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, block_k), 0)
+
+    def body(dq, j):
+        s = jnp.einsum("bhtd,bhkd->bhtk", qf, kb[:, :, j])
+        if cfg.causal:
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (t, block_k), 1)
+            s = jnp.where((kpos > qpos)[None, None], NEG_INF, s)
+        p = jnp.exp(s - lse)                                  # [B,H,T,bk]
+        dv_j = jnp.einsum("bhtk,bhtd->bhkd", p, dof)
+        dp = jnp.einsum("bhtd,bhkd->bhtk", dof, vb[:, :, j])
+        ds = p * (dp - delta)
+        dq = dq + jnp.einsum("bhtk,bhkd->bhtd", ds, kb[:, :, j]) * cfg.scale
+        dk_j = jnp.einsum("bhtk,bhtd->bhkd", ds, qf)  # qf pre-scaled
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(body, dq0, jnp.arange(nk))
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, nk * block_k, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, nk * block_k, d)
+    back = lambda x: x.transpose(0, 2, 1, 3).astype(in_dtype)
+    return back(dq), back(dk), back(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _flash(cfg: _FlashCfg, q, k, v):
-    return _flash_forward(cfg, q, k, v)
+    return _flash_forward(cfg, q, k, v)[0]
 
 
 def _flash_fwd(cfg, q, k, v):
-    return _flash_forward(cfg, q, k, v), (q, k, v)
+    o, lse = _flash_forward(cfg, q, k, v)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(cfg, res, g):
-    # Recompute backward through the reference formulation: XLA fuses it
-    # well, and it keeps the kernel's numerics out of the gradient path.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, cfg.causal, cfg.scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _mha_bwd_blockwise(cfg, q, k, v, o, lse, g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -170,6 +221,12 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     if use_pallas is None:
         on_tpu = jax.default_backend() == "tpu"
         use_pallas = aligned and (on_tpu or interpret)
+    elif use_pallas and not aligned:
+        # Fail fast on a forced-pallas misuse: silently running the kernel
+        # with non-dividing blocks would truncate keys (and their grads).
+        raise ValueError(
+            f"flash_attention(use_pallas=True): seq lens {t}/{k.shape[1]} "
+            f"not divisible by blocks ({block_q}, {block_k})")
     if not use_pallas:
         return mha_reference(q, k, v, causal=causal, scale=scale)
     cfg = _FlashCfg(causal=bool(causal), scale=float(scale),

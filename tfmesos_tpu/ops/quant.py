@@ -18,11 +18,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _absmax_scale(x):
+    """Shared scale rule (host paths and kernel alike): per-row absmax / 127
+    with zero rows pinned to scale 1.0."""
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    return jnp.where(absmax == 0, 1.0, absmax / 127.0)
+
+
 def quantize_int8_reference(x) -> Tuple[jax.Array, jax.Array]:
     """Round-to-nearest per-row absmax quantization (ground truth)."""
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
-    values = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    xf = x.astype(jnp.float32)
+    scale = _absmax_scale(xf)
+    values = jnp.clip(jnp.round(xf / scale), -127, 127)
     return values.astype(jnp.int8), scale.astype(jnp.float32)
 
 
@@ -32,11 +39,11 @@ def dequantize_int8(values, scales):
 
 def _quant_kernel(seed_ref, x_ref, values_ref, scales_ref, *, stochastic: bool):
     x = x_ref[:].astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    scale = _absmax_scale(x)
     scaled = x / scale
     if stochastic:
-        pltpu.prng_seed(seed_ref[0])
+        # Per-block seed so different row blocks draw different dither.
+        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
         bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
         # Uniform dither in [-0.5, 0.5) then round == stochastic rounding.
         # Mosaic has no uint32->f32 cast: drop to 24 bits via int32 first
@@ -46,6 +53,19 @@ def _quant_kernel(seed_ref, x_ref, values_ref, scales_ref, *, stochastic: bool):
         scaled = scaled + dither
     values_ref[:] = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
     scales_ref[:] = scale
+
+
+def _row_block(rows: int, cols: int, budget_elems: int = 512 * 1024) -> int:
+    """Largest divisor of ``rows`` whose fp32 block fits the VMEM budget
+    (~2MB input + pipelining headroom) — rows are independent, so any exact
+    split is valid and no remainder handling is needed."""
+    max_block = max(8, budget_elems // max(1, cols))
+    if rows <= max_block:
+        return rows
+    for candidate in range(max_block, 0, -1):
+        if rows % candidate == 0:
+            return candidate
+    return rows
 
 
 def quantize_int8(x, stochastic: bool = False, seed: int = 0,
@@ -60,26 +80,30 @@ def quantize_int8(x, stochastic: bool = False, seed: int = 0,
         # path has identical semantics (uniform dither then round).
         use_pallas = False
     if not use_pallas:
+        xf = x.astype(jnp.float32)
+        scale = _absmax_scale(xf)
+        scaled = xf / scale
         if stochastic:
-            key = jax.random.PRNGKey(seed)
-            absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
-                             keepdims=True)
-            scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
-            scaled = x.astype(jnp.float32) / scale
-            dither = jax.random.uniform(key, scaled.shape) - 0.5
-            values = jnp.clip(jnp.round(scaled + dither), -127, 127)
-            return values.astype(jnp.int8), scale.astype(jnp.float32)
-        return quantize_int8_reference(x)
+            dither = jax.random.uniform(jax.random.PRNGKey(seed),
+                                        scaled.shape) - 0.5
+            scaled = scaled + dither
+        values = jnp.clip(jnp.round(scaled), -127, 127)
+        return values.astype(jnp.int8), scale.astype(jnp.float32)
+
     rows, cols = x.shape
+    br = _row_block(rows, cols)
     kernel = functools.partial(_quant_kernel, stochastic=stochastic)
     return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-            out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
-                       pl.BlockSpec(memory_space=pltpu.VMEM)],
+            grid=(rows // br,),
+            in_specs=[pl.BlockSpec((br, cols), lambda i, *_: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=[pl.BlockSpec((br, cols), lambda i, *_: (i, 0),
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((br, 1), lambda i, *_: (i, 0),
+                                    memory_space=pltpu.VMEM)],
         ),
         out_shape=[jax.ShapeDtypeStruct((rows, cols), jnp.int8),
                    jax.ShapeDtypeStruct((rows, 1), jnp.float32)],
