@@ -45,6 +45,24 @@ def test_tfrun_end_to_end_forwards_logs(capfd):
     assert "[worker:1] task-1-of-2" in out
 
 
+def test_tfrun_restarts_recovers(tmp_path, capfd):
+    """--restarts re-provisions after a post-start failure; the retried
+    command succeeds (its checkpoint stand-in: a marker file)."""
+    marker = tmp_path / "attempt-marker"
+    cmd = f"test -f {marker} && echo RECOVERED || (touch {marker}; exit 3)"
+    rc = main(["-w", "1", "-s", "0", "--restarts", "2", "--worker-logs", "*",
+               "--", cmd])
+    assert rc == 0
+    assert "RECOVERED" in capfd.readouterr().out
+
+
+def test_tfrun_missing_extra_config(capfd):
+    rc = main(["-w", "1", "-s", "0", "-e", "/nonexistent-config.json",
+               "--", "echo", "hi"])
+    assert rc == 2
+    assert "cannot read extra config" in capfd.readouterr().err
+
+
 def test_tfrun_extra_config_hooks(tmp_path, capfd):
     """initializer/finalizer hooks run around the user cmd
     (reference server.py:68-70, 105-109)."""

@@ -209,6 +209,55 @@ def test_full_bringup_run_and_dispatch():
         s.stop()
 
 
+def test_concurrent_offers_and_statuses_race():
+    """Backend threads may deliver offers and statuses concurrently; the
+    scheduler's task table must stay consistent (each task launched at most
+    once per identity, revives produce fresh ids)."""
+    s, b = _scheduler([Job(name="worker", num=4, cpus=1.0, mem=10.0)])
+    stop = threading.Event()
+    errors = []
+
+    def offer_thread():
+        i = 0
+        while not stop.is_set():
+            try:
+                s.on_offers([offer(f"o{i}", cpus=2.0)])
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+            i += 1
+            time.sleep(0.0005)
+
+    def failure_thread():
+        while not stop.is_set():
+            with s._lock:
+                # Keep every identity under the fatal threshold so the
+                # revive/relaunch race stays live for the whole window.
+                offered = [t for t in s.tasks if t.offered and
+                           s.task_failure_count.get(
+                               f"{t.job_name}:{t.task_index}", 0) < 2]
+            for t in offered[:1]:
+                try:
+                    s.on_status(TaskStatus(t.id, "TASK_FAILED", message="x"))
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=offer_thread, daemon=True),
+               threading.Thread(target=failure_thread, daemon=True)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=2.0)
+    assert not errors
+    # Every launch's task ids were valid at launch time; the table still has
+    # exactly 4 logical tasks.
+    assert len(s.tasks) == 4
+    launched_ids = [tid for _, ids in b.launched for tid in ids]
+    assert len(launched_ids) == len(set(launched_ids))  # no double-launch
+
+
 def test_mode_b_bringup_and_finish():
     backend = FakeBackend(handshake=True)
     s = TPUMesosScheduler([Job(name="worker", num=2, cpus=1.0, mem=10.0,

@@ -178,8 +178,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     extra_config = {}
     if args.extra_config:
-        with open(args.extra_config) as f:
-            extra_config = json.load(f)
+        try:
+            with open(args.extra_config) as f:
+                extra_config = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"tfrun: cannot read extra config "
+                  f"{args.extra_config!r}: {e}", file=sys.stderr)
+            return 2
 
     jobs = []
     if args.nserver > 0:

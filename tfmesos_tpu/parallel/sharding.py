@@ -99,7 +99,8 @@ def replicate_tree(mesh: Mesh, tree: Any) -> Any:
 
 
 def make_global_batch(mesh: Mesh, batch: Dict[str, Any],
-                      replicate: bool = False) -> Dict[str, Any]:
+                      replicate: bool = False,
+                      batch_dim: int = 0) -> Dict[str, Any]:
     """Assemble per-process host-local numpy arrays into global jax.Arrays.
 
     In multi-controller JAX a jit over a multi-host mesh requires global
@@ -113,8 +114,14 @@ def make_global_batch(mesh: Mesh, batch: Dict[str, Any],
 
     out = {}
     for name, v in batch.items():
-        spec = P() if replicate else P(data_axes(mesh),
-                                       *([None] * (v.ndim - 1)))
+        if replicate or v.ndim <= batch_dim:
+            spec = P()  # scalars / low-rank leaves replicate
+        else:
+            # ``batch_dim`` selects which dim shards over the data axes
+            # (e.g. 1 for [steps_per_call, B, ...] stacked batches).
+            dims = [None] * v.ndim
+            dims[batch_dim] = data_axes(mesh)
+            spec = P(*dims)
         sharding = NamedSharding(mesh, spec)
         out[name] = jax.make_array_from_process_local_data(sharding, v)
     return out

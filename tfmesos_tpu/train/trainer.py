@@ -21,8 +21,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tfmesos_tpu.parallel.sharding import (batch_sharding, fsdp_sharding_tree,
-                                           place_tree)
+from tfmesos_tpu.parallel.sharding import (batch_sharding, data_axes,
+                                           fsdp_sharding_tree, place_tree)
 from tfmesos_tpu.utils.logging import get_logger
 from tfmesos_tpu.utils.profiling import trace
 
@@ -40,7 +40,8 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     mesh: Optional[Mesh] = None,
                     param_specs: Optional[Any] = None,
                     batch_spec_tree: Optional[Any] = None,
-                    postprocess: Optional[Callable] = None):
+                    postprocess: Optional[Callable] = None,
+                    steps_per_call: int = 1):
     """Build the jit'd train step.
 
     ``loss_fn(params, batch) -> (loss, metrics)``.  With a mesh, params/opt
@@ -48,9 +49,15 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     per ``batch_spec_tree`` (default: leading dim over data axes); buffers
     are donated so params update in place.  ``postprocess`` (e.g. the NMF
     non-negativity projection) runs on the updated params inside the step.
+
+    ``steps_per_call > 1`` compiles a ``lax.scan`` of that many optimizer
+    steps into ONE dispatch: batch leaves carry a leading ``[steps_per_call,
+    ...]`` dim and the host pays one round-trip per K steps — the dominant
+    cost for small models on remote-attached or latency-bound runtimes.
+    Returned metrics are the last step's.
     """
 
-    def step_fn(params, opt_state, batch):
+    def one_step(params, opt_state, batch):
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -60,6 +67,19 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         metrics = dict(metrics)
         metrics["loss"] = loss
         return params, opt_state, metrics
+
+    if steps_per_call == 1:
+        step_fn = one_step
+    else:
+        def step_fn(params, opt_state, batch):
+            def body(carry, micro):
+                p, o = carry
+                p, o, metrics = one_step(p, o, micro)
+                return (p, o), metrics
+            (params, opt_state), metrics = jax.lax.scan(
+                body, (params, opt_state), batch)
+            last = jax.tree_util.tree_map(lambda m: m[-1], metrics)
+            return params, opt_state, last
 
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=(0, 1))
@@ -76,13 +96,31 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         opt_state = place_tree(mesh, opt_state, o_sh)
         return params, opt_state
 
-    data_sh = batch_sharding(mesh)
+    bdim = 1 if steps_per_call > 1 else 0  # [K, B, ...] stacks shard on B
+
+    def lift_spec(sh):
+        """User-provided specs describe ONE step's batch; with a scanned
+        step, prepend the (unsharded) steps dim."""
+        if bdim == 0:
+            return sh
+        return NamedSharding(sh.mesh, P(None, *sh.spec))
+
+    user_spec_tree = (jax.tree_util.tree_map(
+        lift_spec, batch_spec_tree,
+        is_leaf=lambda s: isinstance(s, NamedSharding))
+        if batch_spec_tree is not None else None)
+
+    def constrain(x):
+        if user_spec_tree is not None:
+            return jax.lax.with_sharding_constraint(x, user_spec_tree)
+        dims = [None] * x.ndim
+        if x.ndim > bdim:
+            dims[bdim] = data_axes(mesh)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*dims)))
 
     def sharded_step(params, opt_state, batch):
-        batch = jax.tree_util.tree_map(
-            lambda x: jax.lax.with_sharding_constraint(
-                x, batch_spec_tree if batch_spec_tree is not None else data_sh),
-            batch)
+        batch = jax.tree_util.tree_map(constrain, batch)
         return step_fn(params, opt_state, batch)
 
     jitted = jax.jit(sharded_step, donate_argnums=(0, 1))
