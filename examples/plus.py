@@ -21,9 +21,9 @@ def compute(ctx):
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    mesh = ctx.mesh()  # one data-parallel axis over every chip in the slice
-    n = mesh.size
-    sharding = NamedSharding(mesh, P("dp"))
+    mesh = ctx.mesh()  # every chip in the slice on one axis (dp — or fsdp
+    n = mesh.size      # when the job spec has ps tasks)
+    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
 
     # Shard i of the global array carries addend i (24 then 18), like the
     # reference's one-constant-per-ps-task placement; extra shards carry 0.

@@ -33,7 +33,8 @@ def sharded_sum(ctx, total):
 
     mesh = ctx.mesh()
     n = mesh.size
-    sharding = NamedSharding(mesh, P("dp"))
+    # axis-agnostic: the default axis is dp, or fsdp when ps jobs exist
+    sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
     arr = jax.make_array_from_callback(
         (n,), sharding, lambda idx: np.array([total / n], dtype=np.float32))
     out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)

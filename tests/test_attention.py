@@ -79,6 +79,28 @@ def test_cpu_fallback_and_unaligned_shapes():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_attend_dispatch_on_dp_tp_mesh():
+    """attend() dispatch: the first mesh (has sp>1) takes the ring-attention
+    path; the second (dp/tp only) takes the shard_map flash path — both must
+    match the single-device reference."""
+    from tfmesos_tpu.ops.attention import attend
+    from tfmesos_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"dp": 2, "tp": 2, "sp": 2})
+    q, k, v = _qkv(b=4, t=32, h=4, d=16, seed=9)
+    expected = mha_reference(q, k, v, causal=True)
+    got = jax.jit(lambda q, k, v: attend(q, k, v, mesh=mesh, causal=True))(
+        q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+    mesh2 = build_mesh({"dp": 4, "tp": 2})
+    got2 = jax.jit(lambda q, k, v: attend(q, k, v, mesh=mesh2, causal=True))(
+        q, k, v)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_bf16_inputs():
     q, k, v = _qkv(dtype=jnp.bfloat16, t=128)
     got = flash_attention(q, k, v, causal=True, use_pallas=True, interpret=True)

@@ -45,9 +45,11 @@ def test_remote_exception_propagates():
 
 
 def test_mode_a_distributed_jax_sharded_sum():
-    """The 'plus' smoke test, TPU-native: 2 processes join one
-    jax.distributed runtime; a global sharded array reduces to 42."""
-    jobs = Job(name="worker", num=2, cpus=1.0, mem=512.0)
+    """The 'plus' smoke test, TPU-native: a ps + a worker process join one
+    jax.distributed runtime (ps jobs → fsdp default mesh axis — the exact
+    config examples/plus.py runs); a global sharded array reduces to 42."""
+    jobs = [Job(name="ps", num=1, cpus=1.0, mem=512.0),
+            Job(name="worker", num=1, cpus=1.0, mem=512.0)]
     with cluster(jobs, backend=LocalBackend(), quiet=True,
                  start_timeout=120.0) as c:
         # Guard against silent degradation into independent single-process
