@@ -36,6 +36,16 @@ def test_mode_a_dispatch_no_jax():
             ["0", "1", "2"]
 
 
+def test_mode_a_distributed_worker_only_dp_mesh():
+    """Workers-only spec: the dp-branch of the default mesh, across a real
+    2-process runtime (keeps both _default_mesh_axes branches covered)."""
+    with cluster(Job(name="worker", num=2, cpus=1.0, mem=512.0),
+                 backend=LocalBackend(), quiet=True, start_timeout=120.0) as c:
+        topo = c.run("support_funcs:runtime_topology")
+        assert topo["process_count"] == 2, topo
+        assert c.run("support_funcs:sharded_sum", 42.0) == 42.0
+
+
 def test_remote_exception_propagates():
     with cluster(Job(name="w", num=1, cpus=0.5, mem=64.0),
                  backend=LocalBackend(), quiet=True, start_timeout=60.0,
