@@ -11,13 +11,8 @@ been initialized yet).
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("TPUMESOS_LOGLEVEL", "WARNING")
 
-import jax  # noqa: E402
+from tfmesos_tpu.utils.platform import force_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_platform("cpu", min_host_devices=8)

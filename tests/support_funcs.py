@@ -39,3 +39,13 @@ def sharded_sum(ctx, total):
         (n,), sharding, lambda idx: np.array([total / n], dtype=np.float32))
     out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
     return float(out)
+
+
+def my_pid(ctx):
+    return os.getpid()
+
+
+def sleep_forever(ctx, seconds=60.0):
+    import time
+    time.sleep(seconds)
+    return "woke"

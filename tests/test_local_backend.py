@@ -69,3 +69,51 @@ def test_mode_a_distributed_jax_sharded_sum():
         assert topo["process_count"] == 2, topo
         results = c.run_all("support_funcs:sharded_sum", 42.0)
         assert results == [42.0, 42.0]
+
+
+def test_mode_a_task_killed_mid_dispatch_raises_cluster_error():
+    """SIGKILL a Mode-A task while a dispatched call is in flight: the
+    caller must see ClusterError (not a raw OSError/WireError), the cluster
+    must be marked fatal, and supervise() must treat it as retryable."""
+    import os
+    import signal
+    import threading
+
+    from tfmesos_tpu.scheduler import RemoteError
+    from tfmesos_tpu.train.supervisor import supervise
+
+    attempts = []
+
+    def run_attempt(attempt):
+        attempts.append(attempt)
+        if attempt >= 1:
+            return "recovered"
+        with cluster([Job(name="w", num=2, cpus=0.5, mem=64.0)],
+                     backend=LocalBackend(), quiet=True, start_timeout=60.0,
+                     extra_config={"no_jax": True}) as c:
+            pids = c.run_all("support_funcs:my_pid")
+            errs = []
+
+            def dispatch():
+                try:
+                    c.run_all("support_funcs:sleep_forever", 60.0)
+                except BaseException as e:  # noqa: BLE001 - recorded for asserts
+                    errs.append(e)
+
+            t = threading.Thread(target=dispatch)
+            t.start()
+            time.sleep(1.0)  # let the call get in flight
+            os.kill(pids[1], signal.SIGKILL)
+            t.join(timeout=30)
+            assert not t.is_alive(), "dispatch never unblocked after kill"
+            assert errs, "dispatch did not raise"
+            assert isinstance(errs[0], ClusterError), errs[0]
+            assert not isinstance(errs[0], RemoteError)
+            # The whole dispatch channel is poisoned: later calls fail fast.
+            with pytest.raises(ClusterError):
+                c.run("support_funcs:my_pid")
+            raise errs[0]
+
+    result = supervise(run_attempt, max_restarts=2, restart_wait=0.1)
+    assert result.value == "recovered"
+    assert result.attempts == 2

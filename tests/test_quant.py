@@ -52,3 +52,28 @@ def test_zero_rows_do_not_nan():
 def test_rejects_bad_rank():
     with pytest.raises(ValueError):
         quantize_int8(jnp.zeros((2, 3, 4)))
+
+
+def test_row_block_is_mosaic_legal():
+    # Mosaic rejects sublane blocks that are neither 8-aligned nor the full
+    # dim; interpret mode is laxer, so enforce the contract directly.
+    from tfmesos_tpu.ops.quant import _row_block
+
+    for rows, cols in [(3000, 1024), (4096, 512), (24, 8192), (1 << 14, 256)]:
+        br = _row_block(rows, cols)
+        assert br is not None and rows % br == 0
+        assert br == rows or br % 8 == 0, (rows, cols, br)
+    # Small inputs take the whole dim in one block (always legal).
+    assert _row_block(10, 8) == 10
+    # No 8-aligned exact split exists for odd row counts over budget.
+    assert _row_block(3001 * 257, 1024) is None
+
+
+def test_unaligned_rows_fall_back_to_xla():
+    # 3001 is prime, so no exact row split (aligned or not) exists under the
+    # VMEM budget; the Pallas path must silently defer to XLA rather than
+    # emit an illegal tiling.
+    x = jax.random.normal(jax.random.PRNGKey(2), (3001, 1024), jnp.float32)
+    ref_v, ref_s = quantize_int8_reference(x)
+    got_v, got_s = quantize_int8(x, use_pallas=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))

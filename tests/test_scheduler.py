@@ -279,3 +279,35 @@ def test_mode_b_bringup_and_finish():
             time.sleep(0.02)
     finally:
         s.stop()
+
+
+def test_token_transport_backend_mismatch_rejected():
+    import pytest
+
+    from tfmesos_tpu.backends.local import LocalBackend
+    from tfmesos_tpu.spec import Job
+    from tfmesos_tpu.scheduler import TPUMesosScheduler
+
+    jobs = [Job(name="w", num=1)]
+    with pytest.raises(ValueError, match="colocated"):
+        TPUMesosScheduler(jobs, backend=LocalBackend(),
+                          token_transport="secret")
+    with pytest.raises(ValueError, match="env|file|secret"):
+        TPUMesosScheduler(jobs, backend=LocalBackend(),
+                          token_transport="carrier-pigeon")
+
+
+def test_run_on_duplicate_ranks_rejected():
+    import pytest
+
+    from tfmesos_tpu import ClusterError, Job, cluster
+    from tfmesos_tpu.backends.local import LocalBackend
+
+    with cluster(Job(name="w", num=2, cpus=0.5, mem=64.0),
+                 backend=LocalBackend(), quiet=True, start_timeout=60.0,
+                 extra_config={"no_jax": True}) as c:
+        with pytest.raises(ClusterError, match="duplicate"):
+            c.run_on([0, 0], "support_funcs:ping", "x")
+        # The rejection happens before any send: the channel stays usable.
+        assert [r["rank"] for r in c.run_on([1, 0], "support_funcs:ping", "x")] \
+            == [1, 0]

@@ -91,3 +91,30 @@ def test_status_terminal():
     assert TaskStatus("t", "TASK_FAILED").terminal
     assert TaskStatus("t", "TASK_FINISHED").terminal
     assert not TaskStatus("t", "TASK_RUNNING").terminal
+
+
+def test_token_file_transport_keeps_token_out_of_env():
+    offer = Offer(id="o1", agent_id="a", hostname="h", cpus=4, mem=4096)
+    t = Task("worker", 0, cpus=1.0, mem=64)
+    info = t.to_task_info(offer, "10.0.0.1:5000", token="sekrit",
+                          token_file="/tmp/tok")
+    env = {v["name"]: v.get("value")
+           for v in info["command"]["environment"]["variables"]}
+    assert env["TPUMESOS_TOKEN_FILE"] == "/tmp/tok"
+    assert "TPUMESOS_TOKEN" not in env
+    assert "sekrit" not in str(info)
+
+
+def test_secret_token_transport_renders_mesos_secret():
+    import base64
+
+    offer = Offer(id="o1", agent_id="a", hostname="h", cpus=4, mem=4096)
+    t = Task("worker", 0, cpus=1.0, mem=64)
+    info = t.to_task_info(offer, "10.0.0.1:5000", token="sekrit",
+                          secret_token=True)
+    variables = info["command"]["environment"]["variables"]
+    plain = {v["name"]: v.get("value") for v in variables if "secret" not in v}
+    assert "TPUMESOS_TOKEN" not in plain
+    (sec,) = [v for v in variables if v.get("type") == "SECRET"]
+    assert sec["name"] == "TPUMESOS_TOKEN"
+    assert base64.b64decode(sec["secret"]["value"]["data"]) == b"sekrit"

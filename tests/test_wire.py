@@ -89,3 +89,14 @@ def test_concurrent_messages_ordered():
     t.join()
     assert got == list(range(100))
     c.close(); s.close()
+
+
+def test_load_token_prefers_file(tmp_path):
+    from tfmesos_tpu.wire import load_token
+
+    p = tmp_path / "tok"
+    p.write_text("file-token\n")
+    env = {"TPUMESOS_TOKEN": "env-token", "TPUMESOS_TOKEN_FILE": str(p)}
+    assert load_token(env) == "file-token"
+    assert load_token({"TPUMESOS_TOKEN": "env-token"}) == "env-token"
+    assert load_token({}) == ""

@@ -27,6 +27,10 @@ class ResourceBackend(abc.ABC):
     its own lock.
     """
 
+    #: True when launched tasks share the scheduler's filesystem (so secrets
+    #: can travel as mode-0600 files instead of state-visible env vars).
+    colocated = False
+
     @abc.abstractmethod
     def start(self, scheduler) -> None:
         """Connect and begin delivering events."""

@@ -25,6 +25,8 @@ from tfmesos_tpu.utils.logging import get_logger
 
 
 class LocalBackend(ResourceBackend):
+    colocated = True
+
     def __init__(self, cpus: Optional[float] = None, mem: float = 1 << 20,
                  chips: int = 0, offer_interval: float = 0.05,
                  inherit_env: bool = True,

@@ -55,17 +55,23 @@ def _quant_kernel(seed_ref, x_ref, values_ref, scales_ref, *, stochastic: bool):
     scales_ref[:] = scale
 
 
-def _row_block(rows: int, cols: int, budget_elems: int = 512 * 1024) -> int:
-    """Largest divisor of ``rows`` whose fp32 block fits the VMEM budget
-    (~2MB input + pipelining headroom) — rows are independent, so any exact
-    split is valid and no remainder handling is needed."""
+def _row_block(rows: int, cols: int, budget_elems: int = 512 * 1024):
+    """Largest 8-aligned divisor of ``rows`` whose fp32 block fits the VMEM
+    budget (~2MB input + pipelining headroom), or ``None`` if no legal
+    tiling exists (caller falls back to the XLA path).
+
+    Mosaic only accepts sublane dims that are multiples of 8 or equal to the
+    full array dim — interpret mode is laxer, so an unaligned block compiles
+    in tests but fails on real TPU.  Rows are independent, so any exact
+    split is valid and no remainder handling is needed.
+    """
     max_block = max(8, budget_elems // max(1, cols))
     if rows <= max_block:
-        return rows
-    for candidate in range(max_block, 0, -1):
+        return rows  # whole dim in one block — always legal
+    for candidate in range(max_block - max_block % 8, 7, -8):
         if rows % candidate == 0:
             return candidate
-    return rows
+    return None
 
 
 def quantize_int8(x, stochastic: bool = False, seed: int = 0,
@@ -79,6 +85,11 @@ def quantize_int8(x, stochastic: bool = False, seed: int = 0,
         # The Pallas interpreter doesn't implement the TPU PRNG; the XLA
         # path has identical semantics (uniform dither then round).
         use_pallas = False
+    rows, cols = x.shape
+    if use_pallas:
+        br = _row_block(rows, cols)
+        if br is None:
+            use_pallas = False  # no 8-aligned exact row split exists
     if not use_pallas:
         xf = x.astype(jnp.float32)
         scale = _absmax_scale(xf)
@@ -90,8 +101,6 @@ def quantize_int8(x, stochastic: bool = False, seed: int = 0,
         values = jnp.clip(jnp.round(scaled), -127, 127)
         return values.astype(jnp.int8), scale.astype(jnp.float32)
 
-    rows, cols = x.shape
-    br = _row_block(rows, cols)
     kernel = functools.partial(_quant_kernel, stochastic=stochastic)
     return pl.pallas_call(
         kernel,

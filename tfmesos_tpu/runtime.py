@@ -123,14 +123,12 @@ def initialize(ctx: Optional[TaskContext] = None) -> TaskContext:
     if ctx is None:
         ctx = TaskContext.from_env()
     # Make the env var authoritative even when a site-installed PJRT plugin
-    # pre-set the platform via jax.config at interpreter start (observed
-    # with the axon plugin: config beats JAX_PLATFORMS, so a multi-process
-    # CPU cluster would silently fall apart into single-device processes —
-    # and single-process runs would ignore a requested CPU platform too).
+    # pre-set the platform via jax.config at interpreter start (config beats
+    # JAX_PLATFORMS; without this a multi-process CPU cluster silently falls
+    # apart into single-device processes).
+    from tfmesos_tpu.utils.platform import force_platform
+    force_platform()
     import jax
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
-        jax.config.update("jax_platforms", platforms)
     if ctx.world_size > 1 and not _initialized:
         jax.distributed.initialize(
             coordinator_address=ctx.coordinator,

@@ -27,6 +27,7 @@ import os
 import selectors
 import socket
 import sys
+import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -67,7 +68,8 @@ class TPUMesosScheduler:
                  extra_config: Optional[Dict[str, Any]] = None,
                  role: str = "*", mesh_axes: Optional[Dict[str, int]] = None,
                  gang_scheduling: bool = False,
-                 start_timeout: float = 300.0):
+                 start_timeout: float = 300.0,
+                 token_transport: Optional[str] = None):
         self.task_spec = task_spec
         self.master = master or os.environ.get("MESOS_MASTER")
         # Default framework name mirrors scheduler.py:189-190.
@@ -102,6 +104,27 @@ class TPUMesosScheduler:
         if backend is None:
             backend = self._default_backend()
         self.backend = backend
+
+        # How tasks learn the HMAC token.  A plain env var is readable via
+        # Mesos state endpoints and /proc environ (advisor finding), so
+        # co-located backends default to a mode-0600 file; "secret" renders a
+        # Mesos SECRET-typed variable for clusters with a secret resolver.
+        colocated = getattr(backend, "colocated", False)
+        if token_transport is None:
+            token_transport = "file" if colocated else "env"
+        if token_transport not in ("env", "file", "secret"):
+            raise ValueError(f"token_transport must be env|file|secret, "
+                             f"got {token_transport!r}")
+        if token_transport == "file" and not colocated:
+            raise ValueError(
+                "token_transport='file' needs a colocated backend: a remote "
+                "task cannot read the scheduler's local token file")
+        if token_transport == "secret" and colocated:
+            raise ValueError(
+                "token_transport='secret' is a Mesos secret-resolver "
+                "feature; colocated backends use 'file' (the default)")
+        self.token_transport = token_transport
+        self._token_file: Optional[str] = None
 
         if not self.tasks:
             raise ValueError("job spec expands to zero tasks")
@@ -162,7 +185,10 @@ class TPUMesosScheduler:
                 infos = [t.to_task_info(offer, self.addr, self.token,
                                         containerizer_type=self.containerizer_type,
                                         force_pull_image=self.force_pull_image,
-                                        env=self.env)
+                                        env=self.env,
+                                        token_file=self._token_file,
+                                        secret_token=(self.token_transport
+                                                      == "secret"))
                          for t in placed]
                 self.log.info("launching %d task(s) on %s: %s",
                               len(placed), offer.hostname, placed)
@@ -262,6 +288,12 @@ class TPUMesosScheduler:
         self.addr = wire.sock_addr(self._listen,
                                    advertise_host=os.environ.get("TPUMESOS_ADVERTISE_HOST"))
         self.log.info("rendezvous listening on %s", self.addr)
+        if self.token_transport == "file":
+            # Must exist before the first launch: tasks read it at startup.
+            fd, path = tempfile.mkstemp(prefix="tpumesos-token-")
+            with os.fdopen(fd, "w") as f:  # mkstemp creates mode 0600
+                f.write(self.token)
+            self._token_file = path
         self.backend.start(self)
 
         sel = selectors.DefaultSelector()
@@ -400,6 +432,12 @@ class TPUMesosScheduler:
                 # Mode A keeps it open as the SPMD dispatch channel).
                 conn.close()
                 task.connection = None
+            else:
+                # The bring-up timeout must not outlive bring-up: dispatched
+                # functions run arbitrarily long (a whole training loop), so
+                # the dispatch channel blocks indefinitely; a SIGKILLed peer
+                # still surfaces promptly as EOF/ECONNRESET.
+                conn.settimeout(None)
         with self._lock:
             self.started = True
         self.log.info("cluster started: %d task(s), coordinator %s",
@@ -434,8 +472,10 @@ class TPUMesosScheduler:
         }
 
     def run(self, func: Any, *args: Any, **kwargs: Any) -> Any:
-        """SPMD dispatch: run ``func`` on every Mode-A task, return rank 0's
-        result.
+        """SPMD dispatch: run ``func`` on every Mode-A task and return the
+        result from the lowest-ranked in-graph task (global rank 0 whenever
+        rank 0 is a Mode-A task; in a mixed spec where rank 0 runs a cmd,
+        the first dispatchable rank after it).
 
         This is the TPU-native successor of the reference's in-graph mode:
         where a TF driver placed ops with ``tf.device('/job:ps/task:0')`` and
@@ -487,27 +527,108 @@ class TPUMesosScheduler:
                 raise ClusterError(
                     f"rank(s) {bad} are not connected in-graph tasks "
                     f"(dispatchable: {sorted(dispatchable)})")
+            if len(set(ranks)) != len(ranks):
+                raise ClusterError(
+                    f"duplicate rank(s) in {ranks}: each dispatch targets a "
+                    "rank at most once (call run_on again to repeat)")
             mode_a = [dispatchable[r] for r in ranks]  # request order
         if not mode_a:
             raise ClusterError("no in-graph (cmd=None) tasks to dispatch to")
         msg = {"op": "run", "call_id": call_id, "func": spec,
                "args": list(args), "kwargs": kwargs}
-        for task in mode_a:
-            wire.send_msg(task.connection, msg, self.token)
-        # Drain every task's reply before judging any of them: raising early
-        # would leave unread frames queued and desynchronize later calls.
+
+        def _fatal_dispatch(why: str) -> ClusterError:
+            # A dead peer or desynchronized channel poisons the whole SPMD
+            # dispatch path: survivors may hold queued frames for this
+            # call_id with no resync protocol, and a partially-delivered
+            # collective would deadlock the mesh.  Mark the cluster fatal so
+            # finished()/run() fail fast and supervise() can restart it.
+            with self._lock:
+                self._set_fatal(why)
+            return ClusterError(why)
+
+        task = None
+        try:
+            for task in mode_a:
+                wire.send_msg(task.connection, msg, self.token)
+            replies = self._drain_replies(mode_a, call_id, _fatal_dispatch)
+        except (OSError, wire.WireError) as e:
+            raise _fatal_dispatch(
+                f"task {task} lost during dispatch: {e}") from e
         results = []
         errors = []
         for task in mode_a:
-            reply = wire.recv_msg(task.connection, self.token)
-            if not (isinstance(reply, dict) and reply.get("call_id") == call_id):
-                raise ClusterError(f"bad reply from {task}: {reply!r}")
+            reply = replies[task.id]
             if not reply.get("ok"):
                 errors.append(f"on {task}:\n{reply.get('error')}")
             results.append(reply.get("value"))
         if errors:
             raise RemoteError("remote failure " + "\n".join(errors))
         return results
+
+    def _drain_replies(self, mode_a, call_id, _fatal_dispatch):
+        """Collect one reply per task, reading ALL connections concurrently.
+
+        A blocking per-rank read would leave the caller stuck on a survivor
+        (which may legitimately run for hours) while a dead peer's EOF goes
+        unnoticed; a selector surfaces any death — via socket EOF or the
+        status watcher flipping ``_fatal`` — within a poll interval.
+        """
+        replies: Dict[str, dict] = {}
+        sel = selectors.DefaultSelector()
+        framers = {task.id: wire.Framer(self.token) for task in mode_a}
+        try:
+            for task in mode_a:
+                try:
+                    task.connection.setblocking(False)
+                    sel.register(task.connection, selectors.EVENT_READ, task)
+                except OSError as e:
+                    # Attribute here: letting this escape to _dispatch's
+                    # catch-all would blame the send loop's last task.
+                    raise _fatal_dispatch(
+                        f"task {task} lost during dispatch: {e}") from e
+            while len(replies) < len(mode_a):
+                events = sel.select(timeout=0.5)
+                with self._lock:
+                    if self._fatal:
+                        raise ClusterError(self._fatal)
+                for key, _ in events:
+                    task = key.data
+                    try:
+                        data = key.fileobj.recv(1 << 16)
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    except OSError as e:
+                        raise _fatal_dispatch(
+                            f"task {task} lost during dispatch: {e}") from e
+                    if not data:
+                        raise _fatal_dispatch(
+                            f"task {task} died during dispatch (EOF)")
+                    try:
+                        msgs = framers[task.id].feed(data)
+                    except wire.WireError as e:
+                        raise _fatal_dispatch(
+                            f"bad frame from {task} during dispatch: {e}"
+                        ) from e
+                    for reply in msgs:
+                        if (task.id in replies
+                                or not (isinstance(reply, dict)
+                                        and reply.get("call_id") == call_id)):
+                            raise _fatal_dispatch(
+                                f"bad reply from {task}: {reply!r}")
+                        replies[task.id] = reply
+                    if task.id in replies:
+                        sel.unregister(key.fileobj)
+        finally:
+            sel.close()
+            for task in mode_a:
+                if task.connection is not None:
+                    try:
+                        task.connection.setblocking(True)
+                        task.connection.settimeout(None)
+                    except OSError:
+                        pass
+        return replies
 
     def finished(self) -> bool:
         """True when any job has fully TASK_FINISHED (reference semantics —
@@ -547,6 +668,12 @@ class TPUMesosScheduler:
         if self._listen is not None:
             self._listen.close()
             self._listen = None
+        if self._token_file is not None:
+            try:
+                os.unlink(self._token_file)
+            except OSError:
+                pass
+            self._token_file = None
         self.log.info("scheduler stopped")
 
 

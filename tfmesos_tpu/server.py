@@ -46,7 +46,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     task_id, scheduler_addr = argv
-    token = os.environ.get(wire.TOKEN_ENV, "")
+    token = wire.load_token()
 
     # Our own identity address (reference: server.py:18-21).  The listening
     # socket is identity only; control flows over the dial-back connection.

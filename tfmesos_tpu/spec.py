@@ -10,13 +10,15 @@ speaks the v1 HTTP API directly (no pymesos).
 
 from __future__ import annotations
 
+import base64
 import os
 import sys
 import uuid
 from dataclasses import InitVar, dataclass, field
 from typing import Any, Dict, List, Optional
 
-from tfmesos_tpu.wire import TOKEN_ENV as _TOKEN_ENV
+from tfmesos_tpu.wire import (TOKEN_ENV as _TOKEN_ENV,
+                              TOKEN_FILE_ENV as _TOKEN_FILE_ENV)
 
 
 @dataclass
@@ -171,7 +173,9 @@ class Task:
                      docker_image: Optional[str] = None,
                      containerizer_type: Optional[str] = None,
                      force_pull_image: bool = False,
-                     env: Optional[Dict[str, str]] = None) -> dict:
+                     env: Optional[Dict[str, str]] = None,
+                     token_file: Optional[str] = None,
+                     secret_token: bool = False) -> dict:
         """Render a Mesos v1 JSON ``TaskInfo`` (reference: scheduler.py:61-177).
 
         The launched command is our node runtime dialing back to the
@@ -184,7 +188,24 @@ class Task:
         # The reference overwrites PYTHONPATH with the scheduler's sys.path so
         # tasks resolve the same code (scheduler.py:168-176); keep that.
         env["PYTHONPATH"] = ":".join(sys.path)
-        env[_TOKEN_ENV] = token
+        # Token delivery, least-exposed transport first: a mode-0600 file
+        # (co-located backends), a Mesos SECRET-typed variable (clusters with
+        # a secret resolver; never shown in state endpoints), or — the
+        # documented fallback — a plain env var, which anyone able to read
+        # Mesos state or the agent's /proc can see.
+        secret_vars = []
+        if token_file:
+            env[_TOKEN_FILE_ENV] = token_file
+        elif secret_token:
+            secret_vars.append({
+                "name": _TOKEN_ENV,
+                "type": "SECRET",
+                "secret": {"type": "VALUE",
+                           "value": {"data": base64.b64encode(
+                               token.encode()).decode()}},
+            })
+        else:
+            env[_TOKEN_ENV] = token
 
         ti: dict = {
             "name": f"{self.job_name}:{self.task_index}",
@@ -201,7 +222,7 @@ class Task:
                 "environment": {
                     "variables": [
                         {"name": k, "value": str(v)} for k, v in sorted(env.items())
-                    ]
+                    ] + secret_vars
                 },
             },
         }

@@ -1,0 +1,52 @@
+"""Authoritative JAX platform selection.
+
+A site-installed PJRT plugin (observed with the axon TPU relay) can pin the
+platform through ``jax.config`` at interpreter start, which *beats* the
+``JAX_PLATFORMS`` env var.  Every place that needs a specific platform —
+tests (virtual CPU mesh), the driver's multi-chip dry run, and the node
+runtime honoring the env it was launched with — must therefore set the
+config explicitly before the first backend initialization.  This module is
+the single implementation of that workaround.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+_COUNT_RE = r"--xla_force_host_platform_device_count=(\d+)"
+
+
+def force_platform(platform: Optional[str] = None,
+                   min_host_devices: Optional[int] = None) -> None:
+    """Make platform selection authoritative over any site plugin pinning.
+
+    ``platform=None`` honors ``JAX_PLATFORMS`` from the environment (the node
+    runtime's contract); a string forces that platform and exports the env
+    var so child processes inherit it.  ``min_host_devices`` raises the
+    virtual host-device count in ``XLA_FLAGS`` if it is absent or smaller.
+
+    Only effective before the first backend init; callers that must be sure
+    should verify ``jax.devices()`` afterwards (``__graft_entry__`` re-execs
+    into a clean interpreter when the check fails).
+    """
+    if min_host_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(_COUNT_RE, flags)
+        if not m or int(m.group(1)) < min_host_devices:
+            flags = re.sub(r"\s*" + _COUNT_RE, "", flags)
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={min_host_devices}"
+            ).strip()
+    if platform is not None:
+        os.environ["JAX_PLATFORMS"] = platform
+    else:
+        platform = os.environ.get("JAX_PLATFORMS")
+    if platform:
+        import jax
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass  # backend already initialized; callers verify devices

@@ -32,6 +32,7 @@ TAG_SIZE = hashlib.sha256().digest_size  # 32
 MAX_FRAME = 64 * 1024 * 1024  # sanity bound; control messages are tiny
 
 TOKEN_ENV = "TPUMESOS_TOKEN"
+TOKEN_FILE_ENV = "TPUMESOS_TOKEN_FILE"
 
 
 class WireError(Exception):
@@ -41,6 +42,21 @@ class WireError(Exception):
 def new_token() -> str:
     """Fresh per-cluster auth token (scheduler generates one per bring-up)."""
     return os.urandom(16).hex()
+
+
+def load_token(environ=os.environ) -> str:
+    """Resolve the cluster token a task was launched with.
+
+    Prefers a mode-0600 token *file* (``TPUMESOS_TOKEN_FILE``) over the plain
+    env var: env vars leak through Mesos state endpoints and /proc environ,
+    so co-located backends deliver the secret out-of-band (advisor finding on
+    spec.py token delivery).
+    """
+    path = environ.get(TOKEN_FILE_ENV)
+    if path:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read().strip()
+    return environ.get(TOKEN_ENV, "")
 
 
 def _tag(token: str, body: bytes) -> bytes:
