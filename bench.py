@@ -1,15 +1,31 @@
-"""Project benchmark: mnist_replica steps/sec/chip (BASELINE.json metric).
+"""Project benchmark: mnist_replica steps/sec/chip (BASELINE.json metric),
+plus MFU and memory/interconnect-bandwidth accounting (BASELINE.md §north
+star).
 
 Runs the reference's canonical workload — the mnist_replica trainer at its
 published scale (batch 100, hidden 100, mnist_replica.py:70-73) — as a jit'd
-sync-SGD step on this host's accelerator, plus the flagship transformer as a
-secondary throughput probe, and prints ONE JSON line:
+sync-SGD step on this host's accelerator, the flagship transformer at
+T=2048, and a compute-dense transformer config sized so the MXU (not the
+VPU) bounds it, and prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "mfu_transformer": ..., "mfu_dense": ..., "allreduce_gbps": ...,
+     "hbm_gbps": ...}
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
-baseline is our own first measured value on the v5e-1 chip, recorded in
-BASELINE_SELF below; >1.0 means faster than round-1's framework.
+baseline is our own round-1 value measured by the driver under this same
+protocol (best-of-3, K fused steps per dispatch, timed region ends in a
+device-to-host fetch), recorded in BASELINE_SELF below; >1.0 means faster
+than round-1's framework, like for like.
+
+MFU = analytic matmul FLOPs / elapsed / per-chip peak.  Peaks are the
+published bf16 figures per device kind; an unknown kind falls back to the
+v5e number and reports which peak it assumed.
+
+Bandwidth: with >1 device, a psum sweep (1MB-256MB) reports achieved
+all-reduce algorithmic bandwidth vs the ICI roofline; on a single chip there
+is no ICI, so an HBM triad sweep reports memory bandwidth vs the HBM
+roofline instead (the roofline that actually bounds single-chip kernels).
 """
 
 import json
@@ -17,17 +33,69 @@ import time
 
 import numpy as np
 
-# Round-1 self-measured baseline on one v5e chip (steps/sec/chip for the
-# mnist_replica workload below), measured with the chained-steps +
-# final-host-fetch methodology.  Established 2026-07-28; see BASELINE.md.
-BASELINE_SELF = 1400.0
+# Round-1 value for bench_mnist_replica measured by the round driver on one
+# v5e chip under THIS protocol (BENCH_r01.json; see BASELINE.md for the
+# protocol history).  Relay latency jitters ±40% between runs — read
+# vs_baseline accordingly.
+BASELINE_SELF = 10429.09
+
+# Published peak bf16 matmul throughput per chip and HBM bandwidth, by
+# device kind string (jax.devices()[0].device_kind).
+PEAK_BF16 = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+}
+HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5": 2765.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+}
+# Per-link ICI bandwidth (GB/s, one direction) — v5e: 4 links x ~100GB/s
+# usable per chip; used only to contextualize the all-reduce number.
+ICI_GBPS = {"TPU v5 lite": 400.0, "TPU v4": 300.0, "TPU v5p": 600.0}
+
+
+def _device_kind():
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def _peak_flops():
+    kind = _device_kind()
+    return PEAK_BF16.get(kind, PEAK_BF16["TPU v5 lite"]), kind
+
+
+def mlp_flops_per_step(cfg, batch: int) -> float:
+    """Dense fwd+bwd ~= 6 FLOPs per weight per sample (2 fwd, 4 bwd)."""
+    w = 784 * cfg.hidden + cfg.hidden * 10
+    return 6.0 * w * batch
+
+
+def transformer_flops_per_token(cfg, t: int) -> float:
+    """Analytic matmul FLOPs per token, fwd+bwd (~3x forward).
+
+    Per layer: qkv+out projections 4·d², swiglu 3·d·d_ff; unembed d·vocab;
+    causal attention ≈ 2·T·d per layer per token (QKᵀ + PV at the average
+    causal length T/2).  Elementwise work (norms, rope, softmax) is excluded
+    — MFU measures MXU math against MXU peak.
+    """
+    per_layer_w = 4 * cfg.d_model ** 2 + 3 * cfg.d_model * cfg.d_ff
+    w = cfg.n_layers * per_layer_w + cfg.d_model * cfg.vocab_size
+    fwd = 2 * w + cfg.n_layers * 2 * t * cfg.d_model
+    return 3.0 * fwd
 
 
 def bench_mnist_replica(steps=2000, warmup=100):
-    # Protocol (round-1 final, see BASELINE.md): K=20 optimizer steps fused
-    # per dispatch via lax.scan; `steps` counts individual optimizer steps;
-    # the timed chain ends in a real host fetch.  main() runs this
-    # best-of-3 to shed remote-attach latency jitter.
+    # Protocol (final, see BASELINE.md): K=20 optimizer steps fused per
+    # dispatch via lax.scan; `steps` counts individual optimizer steps; the
+    # timed chain ends in a real host fetch.  main() runs this best-of-3 to
+    # shed remote-attach latency jitter.
     import jax
     import optax
     from tfmesos_tpu.models import mlp
@@ -41,8 +109,6 @@ def bench_mnist_replica(steps=2000, warmup=100):
     cfg = mlp.MLPConfig(hidden=100)
     params = mlp.init_params(cfg, jax.random.PRNGKey(0))
     opt = optax.sgd(0.01)  # reference lr (mnist_replica.py:71)
-    # K steps per dispatch: one host round-trip amortizes over a scanned
-    # block of optimizer steps — the TPU-first answer to dispatch latency.
     k = 20
     step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt, mesh=mesh,
                            steps_per_call=k)
@@ -73,45 +139,148 @@ def bench_mnist_replica(steps=2000, warmup=100):
     # remote-attached runtimes block_until_ready acks early).
     final_loss = float(np.asarray(metrics["loss"]))
     dt = time.perf_counter() - t0
-    return calls * k / dt / n_chips, final_loss
+    steps_per_sec = calls * k / dt / n_chips
+    peak, _ = _peak_flops()
+    mfu = mlp_flops_per_step(cfg, local_bs * n_chips) * calls * k / dt / (
+        n_chips * peak)
+    return steps_per_sec, final_loss, mfu
 
 
-def bench_transformer_tokens(iters=20):
+def _bench_transformer_config(cfg_kwargs, b, t, k, iters=3):
+    """Fused-scan transformer train-step timing; returns (tokens/s, mfu)."""
     import jax
     import jax.numpy as jnp
+    import optax
+    from jax import lax
     from tfmesos_tpu.models import transformer
 
-    cfg = transformer.TransformerConfig(
-        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
-        max_seq_len=1024, dtype=jnp.bfloat16)
+    cfg = transformer.TransformerConfig(max_seq_len=t, dtype=jnp.bfloat16,
+                                        **cfg_kwargs)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-    b, t = 8, 1024
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, t + 1), 0,
-                                cfg.vocab_size, dtype=jnp.int32)
-
-    import optax
-
-    # Chain params through a real optimizer update each iteration so no
-    # remote runtime can overlap/dedup the iterations, and finish with a
-    # host fetch (see bench_mnist_replica).
     opt = optax.sgd(1e-4)
     opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (k, b, t + 1), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
 
     @jax.jit
-    def step(params, opt_state):
-        loss, grads = jax.value_and_grad(
-            lambda p: transformer.loss_fn(cfg, p, {"tokens": tokens})[0])(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    def fused(params, opt_state, tokens):
+        def body(carry, tok):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(
+                lambda p: transformer.loss_fn(cfg, p, {"tokens": tok})[0]
+            )(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
 
-    params, opt_state, loss = step(params, opt_state)
-    float(loss)
-    t0 = time.perf_counter()
+        (params, opt_state), losses = lax.scan(body, (params, opt_state),
+                                               tokens)
+        return params, opt_state, losses[-1]
+
+    p, s, loss = fused(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    best = float("inf")
     for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state)
-    float(np.asarray(loss))
-    dt = (time.perf_counter() - t0) / iters
-    return b * t / dt  # tokens/sec (fwd+bwd+update)
+        t0 = time.perf_counter()
+        p, s, loss = fused(params, opt_state, tokens)
+        float(np.asarray(loss))  # real device-to-host fetch ends the chain
+        best = min(best, (time.perf_counter() - t0) / k)
+    peak, _ = _peak_flops()
+    tokens_per_sec = b * t / best
+    mfu = transformer_flops_per_token(cfg, t) * b * t / best / peak
+    return tokens_per_sec, mfu
+
+
+def bench_transformer_tokens():
+    """Flagship transformer (34M, d512) at T=2048, K=8 fused steps."""
+    return _bench_transformer_config(
+        dict(vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408),
+        b=8, t=2048, k=8)
+
+
+def bench_transformer_dense():
+    """Compute-dense config (d2048): the MXU-bound MFU probe.  The flagship's
+    d512 layers leave the step partly VPU/elementwise-bound; this config
+    shows the framework's ceiling when matmuls dominate."""
+    return _bench_transformer_config(
+        dict(vocab_size=8192, d_model=2048, n_layers=4, n_heads=16,
+             d_ff=5632),
+        b=4, t=2048, k=4)
+
+
+def bench_bandwidth():
+    """Achieved bandwidth vs roofline.
+
+    Multi-device: psum sweep (1MB-256MB fp32), algorithmic bytes/s =
+    2·(n−1)/n · size / time per all-reduce — the ICI utilization metric
+    BASELINE.md promises.  Single chip: there is no ICI, so report an HBM
+    triad (c = a + b: 3 moved bytes/element) against the HBM roofline.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    kind = _device_kind()
+    n = jax.device_count()
+    sizes = [1 << 20, 1 << 23, 1 << 26, 1 << 28]  # bytes: 1MB..256MB
+    out = {"allreduce_gbps": None, "hbm_gbps": None,
+           "ici_roofline_gbps": ICI_GBPS.get(kind),
+           "hbm_roofline_gbps": HBM_GBPS.get(kind)}
+
+    if n > 1:
+        mesh = Mesh(np.array(jax.devices()), ("x",))
+        best_gbps = {}
+        for size in sizes:
+            # `size` is the PER-RANK psum payload (the standard algorithmic
+            # bandwidth convention): each of the n rows lives on one device.
+            elems = size // 4
+            x = jnp.ones((n, elems), jnp.float32)
+            x = jax.device_put(x, NamedSharding(mesh, P("x")))
+            reps = 10
+
+            @jax.jit
+            def sweep(x):
+                def body(x, _):
+                    s = jax.shard_map(
+                        lambda v: lax.psum(v, "x"), mesh=mesh,
+                        in_specs=P("x"), out_specs=P("x"))(x)
+                    return s / n, None  # keep magnitude stable, chain deps
+                return lax.scan(body, x, None, length=reps)[0]
+
+            y = sweep(x)
+            jax.block_until_ready(y)
+            t0 = time.perf_counter()
+            y = sweep(x)
+            float(np.asarray(y[0, 0]))
+            dt = (time.perf_counter() - t0) / reps
+            algbw = 2 * (n - 1) / n * size / dt
+            best_gbps[size] = algbw / 1e9
+        out["allreduce_gbps"] = round(max(best_gbps.values()), 2)
+        out["allreduce_sweep"] = {f"{s >> 20}MB": round(g, 2)
+                                  for s, g in best_gbps.items()}
+    else:
+        size = 1 << 28  # 256MB per operand
+        elems = size // 4
+        a = jnp.ones((elems,), jnp.float32)
+        b = jnp.full((elems,), 2.0, jnp.float32)
+        reps = 20
+
+        @jax.jit
+        def triad(a, b):
+            def body(a, _):
+                return a * 0.5 + b, None
+            return lax.scan(body, a, None, length=reps)[0]
+
+        y = triad(a, b)
+        jax.block_until_ready(y)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            y = triad(a, b)
+            float(np.asarray(y[0]))
+            best = min(best, (time.perf_counter() - t0) / reps)
+        out["hbm_gbps"] = round(3 * size / best / 1e9, 1)
+    return out
 
 
 def main():
@@ -137,10 +306,8 @@ def main():
     runs = attempts(lambda: bench_mnist_replica(steps=800), "bench")
     if not runs:
         raise SystemExit("all benchmark runs failed")
-    value, final_loss = max(runs)
-    tokens_runs = attempts(lambda: bench_transformer_tokens(iters=10),
-                           "transformer bench")
-    tokens_per_sec = max(tokens_runs) if tokens_runs else None
+    value, final_loss, mlp_mfu = max(runs)
+    peak, kind = _peak_flops()
     out = {
         "metric": "mnist_replica_steps_per_sec_per_chip",
         "value": round(value, 2),
@@ -148,10 +315,26 @@ def main():
         "vs_baseline": round(value / BASELINE_SELF, 3),
         "backend": jax.default_backend(),
         "n_chips": jax.device_count(),
+        "device_kind": kind,
+        "peak_bf16_tflops": round(peak / 1e12, 1),
         "final_loss": round(final_loss, 4),
+        "mfu_mlp": round(mlp_mfu, 5),
     }
-    if tokens_per_sec is not None:
-        out["transformer_tokens_per_sec"] = round(tokens_per_sec, 1)
+
+    # One attempt each: compile dominates wall-clock for these, and each
+    # attempt already takes best-of-`iters` timings internally.
+    tr = attempts(bench_transformer_tokens, "transformer bench", n=1)
+    if tr:
+        toks, mfu = max(tr)
+        out["transformer_tokens_per_sec"] = round(toks, 1)
+        out["mfu_transformer"] = round(mfu, 4)
+    dense = attempts(bench_transformer_dense, "dense-mfu bench", n=1)
+    if dense:
+        _, mfu = max(dense)
+        out["mfu_dense"] = round(mfu, 4)
+    bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
+    if bw:
+        out.update(bw[0])
     print(json.dumps(out))
 
 

@@ -49,3 +49,40 @@ def sleep_forever(ctx, seconds=60.0):
     import time
     time.sleep(seconds)
     return "woke"
+
+
+def train_chunk(ctx, params, k, lr, seed):
+    """One dispatched chunk of k sync-SGD steps on the cluster mesh: batch
+    sharded over every process, grads reduced by GSPMD collectives (the
+    dp training loop a real driver runs via repeated cluster.run calls)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = ctx.mesh()
+    ax = mesh.axis_names[0]
+    repl = NamedSharding(mesh, P())
+    w = jax.device_put(jnp.asarray(np.asarray(params["w"], np.float32)), repl)
+    bs = 8 * mesh.size
+
+    @jax.jit
+    def step(w, x, y):
+        def loss_fn(w):
+            logits = x @ w
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - lr * g, loss
+
+    rng = np.random.RandomState(seed)
+    data_sh = NamedSharding(mesh, P(ax))
+    loss = None
+    for _ in range(k):
+        xb = rng.randn(bs, 16).astype(np.float32)
+        yb = (rng.randint(0, 4, size=bs)).astype(np.int32)
+        x = jax.make_array_from_callback((bs, 16), data_sh,
+                                         lambda idx: xb[idx])
+        y = jax.make_array_from_callback((bs,), data_sh, lambda idx: yb[idx])
+        w, loss = step(w, x, y)
+    return {"w": np.asarray(w).tolist(), "loss": float(loss)}

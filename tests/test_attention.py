@@ -51,8 +51,9 @@ def test_flash_gradient_via_recompute():
 
 @pytest.mark.parametrize("causal,block_k", [(False, 128), (True, 64)])
 def test_blockwise_backward_matches_reference(causal, block_k):
-    """The analytical O(T·block)-memory backward must equal the vjp of the
-    reference (which materializes the full T x T probabilities)."""
+    """The Pallas two-kernel backward (dq / dk+dv, O(T·block) memory) must
+    equal the vjp of the reference (which materializes the full T x T
+    probabilities)."""
     q, k, v = _qkv(b=1, t=256, h=2, d=32, seed=3)
 
     def loss_flash(q, k, v):
@@ -109,3 +110,54 @@ def test_bf16_inputs():
     np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
                                np.asarray(expected, dtype=np.float32),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_pick_block_legal_divisors():
+    from tfmesos_tpu.ops.attention import _pick_block
+
+    assert _pick_block(2048) == 512
+    assert _pick_block(1024) == 512
+    assert _pick_block(384) == 384
+    assert _pick_block(640) == 128   # 512 does not divide 640
+    assert _pick_block(100) == 100   # no 8-aligned divisor <= target: full dim
+    assert _pick_block(8) == 8
+
+
+def test_default_blocks_gradient_long_seq():
+    """t=1024 exercises the 512-block backward grid (multiple q/k blocks per
+    axis plus causal block skipping) in interpret mode."""
+    q, k, v = _qkv(b=1, t=1024, h=1, d=32, seed=5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, use_pallas=True,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, e in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_cross_attention_gradient():
+    """Asymmetric q/k lengths: the dq and dk/dv grids differ (t != tk)."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (1, 128, 2, 32), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 256, 2, 32), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 256, 2, 32), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, use_pallas=True,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, e in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-4, atol=2e-4)

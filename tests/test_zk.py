@@ -1,0 +1,150 @@
+"""zk:// master resolution against a fake ZooKeeper server speaking the
+same minimal jute frames the client sends (connect, getChildren, getData)."""
+
+import json
+import socket
+import struct
+import threading
+
+import pytest
+
+from tfmesos_tpu.backends.zk import parse_zk_url, resolve_master
+
+
+class FakeZK:
+    """Single-connection fake ensemble with Mesos master znodes."""
+
+    def __init__(self, znodes):
+        self.znodes = znodes  # {name: data-bytes}
+        self.requests = []
+        self.server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.server.bind(("127.0.0.1", 0))
+        self.server.listen(4)
+        self.port = self.server.getsockname()[1]
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _read_frame(self, conn):
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = conn.recv(4 - len(hdr))
+            if not chunk:
+                return None
+            hdr += chunk
+        (n,) = struct.unpack(">i", hdr)
+        data = b""
+        while len(data) < n:
+            data += conn.recv(n - len(data))
+        return data
+
+    def _send_frame(self, conn, payload):
+        conn.sendall(struct.pack(">i", len(payload)) + payload)
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            with conn:
+                # ConnectRequest -> ConnectResponse
+                req = self._read_frame(conn)
+                if req is None:
+                    continue
+                self._send_frame(
+                    conn, struct.pack(">iiq", 0, 10000, 1)
+                    + struct.pack(">i", 16) + b"\x00" * 16 + b"\x00")
+                while True:
+                    frame = self._read_frame(conn)
+                    if frame is None:
+                        break
+                    xid, op = struct.unpack(">ii", frame[:8])
+                    (plen,) = struct.unpack(">i", frame[8:12])
+                    path = frame[12:12 + plen].decode()
+                    self.requests.append((op, path))
+                    header = struct.pack(">iqi", xid, 1, 0)
+                    if op == 8:  # getChildren
+                        names = sorted(self.znodes)
+                        body = struct.pack(">i", len(names))
+                        for n in names:
+                            body += struct.pack(">i", len(n)) + n.encode()
+                        self._send_frame(conn, header + body)
+                    elif op == 4:  # getData
+                        name = path.rsplit("/", 1)[1]
+                        data = self.znodes.get(name)
+                        if data is None:
+                            self._send_frame(
+                                conn, struct.pack(">iqi", xid, 1, -101))
+                        else:
+                            self._send_frame(
+                                conn, header + struct.pack(">i", len(data))
+                                + data)
+
+    def close(self):
+        self.server.close()
+
+
+def _master_znode(ip, port):
+    return json.dumps({"address": {"ip": ip, "port": port},
+                       "hostname": ip}).encode()
+
+
+def test_parse_zk_url_forms():
+    servers, path = parse_zk_url("zk://a:2181,b:2182/mesos")
+    assert servers == [("a", 2181), ("b", 2182)]
+    assert path == "/mesos"
+    servers, path = parse_zk_url("zk://user:pw@a/mesos/sub/")
+    assert servers == [("a", 2181)]
+    assert path == "/mesos/sub"
+    with pytest.raises(ValueError):
+        parse_zk_url("zk://a:2181")  # no path
+    with pytest.raises(ValueError):
+        parse_zk_url("http://a:2181/mesos")
+
+
+def test_resolve_master_picks_lowest_sequence():
+    zk = FakeZK({
+        "json.info_0000000007": _master_znode("10.0.0.7", 5051),
+        "json.info_0000000003": _master_znode("10.0.0.3", 5050),
+        "log_replicas": b"not-a-master",  # non-master znode ignored
+    })
+    try:
+        master = resolve_master(f"zk://127.0.0.1:{zk.port}/mesos")
+        assert master == "10.0.0.3:5050"  # lowest sequence = leader
+        assert (8, "/mesos") in zk.requests
+        assert (4, "/mesos/json.info_0000000003") in zk.requests
+    finally:
+        zk.close()
+
+
+def test_resolve_master_falls_through_dead_servers():
+    zk = FakeZK({"json.info_0000000001": _master_znode("10.1.1.1", 5050)})
+    try:
+        # First ensemble member unreachable; second answers.
+        master = resolve_master(
+            f"zk://127.0.0.1:1,127.0.0.1:{zk.port}/mesos")
+        assert master == "10.1.1.1:5050"
+    finally:
+        zk.close()
+
+
+def test_resolve_master_no_masters_registered():
+    zk = FakeZK({"log_replicas": b"x"})
+    try:
+        with pytest.raises(IOError, match="json.info"):
+            resolve_master(f"zk://127.0.0.1:{zk.port}/mesos")
+    finally:
+        zk.close()
+
+
+def test_mesos_backend_accepts_zk_master():
+    """End-to-end: MesosBackend(zk://...) resolves the leader address."""
+    from tfmesos_tpu.backends.mesos import MesosBackend
+
+    zk = FakeZK({"json.info_0000000002": _master_znode("10.9.9.9", 5055)})
+    try:
+        backend = MesosBackend(f"zk://127.0.0.1:{zk.port}/mesos")
+        assert (backend.host, backend.port) == ("10.9.9.9", 5055)
+    finally:
+        zk.close()

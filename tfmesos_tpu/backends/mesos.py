@@ -65,13 +65,13 @@ class RecordIOParser:
 
 
 def parse_master(master: str) -> tuple:
-    """Accept ``host:port``, ``http://host:port``.  ``zk://`` URLs would need
-    a ZooKeeper client (the reference gets one transitively via pymesos,
-    SURVEY §1); resolve the leader out-of-band and pass host:port."""
+    """Accept ``host:port``, ``http://host:port``, or ``zk://.../mesos``
+    (resolved to the leading master through the minimal ZooKeeper client in
+    backends/zk.py — the reference gets the same capability transitively via
+    pymesos, SURVEY §1)."""
     if master.startswith("zk://"):
-        raise ValueError(
-            "zk:// master URLs are not resolved in-process; point at the "
-            "leading master's host:port (e.g. from `mesos-resolve`)")
+        from tfmesos_tpu.backends.zk import resolve_master
+        master = resolve_master(master)
     if "//" in master:
         parsed = urllib.parse.urlparse(master)
         return parsed.hostname, parsed.port or 5050
@@ -80,28 +80,40 @@ def parse_master(master: str) -> tuple:
 
 
 def parse_offer(raw: dict) -> Offer:
+    """Read cpus/mem plus accelerator chips.
+
+    Chips come from the ``tpus`` custom scalar resource, or — so a plain GPU
+    cluster still schedules — from a SCALAR ``gpus`` resource; either way the
+    offer records WHICH name supplied them (``chips_resource``) and the
+    TaskInfo requests chips under that same name, so launch cannot ask for a
+    resource the agent never advertised.  SET-type ``gpus`` (the reference's
+    nvidia-docker-v1 uuid lists, scheduler.py:244-250) have no valid scalar
+    request shape and no TPU analogue: they are ignored, not matched.
+    """
     cpus = mem = 0.0
-    chips = 0
+    tpus = gpus = 0
     for res in raw.get("resources", []):
         name, rtype = res.get("name"), res.get("type")
         if name == "cpus" and rtype == "SCALAR":
             cpus = float(res["scalar"]["value"])
         elif name == "mem" and rtype == "SCALAR":
             mem = float(res["scalar"]["value"])
-        elif name in ("tpus", "gpus"):
-            if rtype == "SCALAR":
-                chips += int(float(res["scalar"]["value"]))
-            elif rtype == "SET":  # nvidia-docker-era uuid sets (reference
-                chips += len(res["set"]["item"])  # scheduler.py:244-250)
+        elif name == "tpus" and rtype == "SCALAR":
+            tpus += int(float(res["scalar"]["value"]))
+        elif name == "gpus" and rtype == "SCALAR":
+            gpus += int(float(res["scalar"]["value"]))
     attributes = {}
     for attr in raw.get("attributes", []):
         if attr.get("type") == "TEXT":
             attributes[attr["name"]] = attr["text"]["value"]
         elif attr.get("type") == "SCALAR":
             attributes[attr["name"]] = str(attr["scalar"]["value"])
+    chips, chips_resource = (tpus, "tpus") if tpus or not gpus else (gpus,
+                                                                     "gpus")
     return Offer(id=raw["id"]["value"], agent_id=raw["agent_id"]["value"],
                  hostname=raw.get("hostname", ""), cpus=cpus, mem=mem,
-                 chips=chips, attributes=attributes, raw=raw)
+                 chips=chips, chips_resource=chips_resource,
+                 attributes=attributes, raw=raw)
 
 
 class MesosBackend(ResourceBackend):
@@ -187,8 +199,15 @@ class MesosBackend(ResourceBackend):
         resp = conn.getresponse()
         if resp.status in (302, 307):  # not the leading master
             location = resp.getheader("Location", "")
-            raise IOError(f"master redirected to {location}; update master "
-                          f"address")
+            host, port = self._parse_redirect(location)
+            if host:
+                # Follow the leader: update our target and let the
+                # reconnect loop re-subscribe there (reference parity: a
+                # zk:// framework always lands on the leader).
+                self.log.info("master redirected to %s:%d; following",
+                              host, port)
+                self.host, self.port = host, port
+            raise IOError(f"master redirected to {location}")
         if resp.status != 200:
             raise IOError(f"SUBSCRIBE failed: HTTP {resp.status} "
                           f"{resp.read(200)!r}")
@@ -201,6 +220,36 @@ class MesosBackend(ResourceBackend):
             for record in parser.feed(chunk):
                 self._dispatch(json.loads(record))
 
+    @staticmethod
+    def _parse_redirect(location: str):
+        """``//host:port[/path]`` or a full URL -> (host, port)."""
+        if not location:
+            return None, None
+        parsed = urllib.parse.urlparse(
+            location if "//" in location else f"//{location}")
+        return parsed.hostname, parsed.port or 5050
+
+    def _master_version(self, sub: Dict[str, Any]) -> Optional[str]:
+        """Master version from SUBSCRIBED metadata, else the /version
+        endpoint (reference probes the version at registration to pick a
+        containerizer, scheduler.py:378-382)."""
+        version = sub.get("master_info", {}).get("version")
+        if version:
+            return version
+        try:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=10)
+            try:
+                conn.request("GET", "/version")
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    return json.loads(resp.read(4096)).get("version")
+            finally:
+                conn.close()
+        except Exception as e:  # pure metadata; never fail bring-up on it
+            self.log.debug("/version probe failed: %s", e)
+        return None
+
     def _dispatch(self, event: Dict[str, Any]) -> None:
         etype = event.get("type")
         if etype == "SUBSCRIBED":
@@ -210,7 +259,8 @@ class MesosBackend(ResourceBackend):
             self._subscribed.set()
             self._scheduler.on_registered(
                 {"backend": "mesos", "framework_id": self.framework_id,
-                 "master": f"{self.host}:{self.port}"})
+                 "master": f"{self.host}:{self.port}",
+                 "master_version": self._master_version(sub)})
         elif etype == "OFFERS":
             offers = [parse_offer(o)
                       for o in event["offers"].get("offers", [])]

@@ -53,6 +53,13 @@ class TransformerConfig:
     # over the ep axis (parallel/moe.py) — the scalable path.
     moe_impl: str = "dense"
     capacity_factor: float = 1.25
+    # Switch-transformer aux weighting: load-balance at 1e-2, z-loss at 1e-3.
+    router_aux_weight: float = 0.01
+    router_z_weight: float = 1e-3
+    # Pipeline schedule: "gpipe", or "circular" with v>1 virtual stages per
+    # device (bubble shrinks ~v-fold; needs n_layers % (pp*v) == 0).
+    pp_schedule: str = "gpipe"
+    pp_virtual_stages: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -103,24 +110,33 @@ def _mlp(cfg: TransformerConfig, lp, h):
                   lp["w_up"].astype(cfg.dtype), lp["w_down"].astype(cfg.dtype))
 
 
+def _zero_aux():
+    z = jnp.zeros((), jnp.float32)
+    return {"load_balance_loss": z, "z_loss": z, "overflow_frac": z}
+
+
 def _moe_switch(cfg: TransformerConfig, mesh, lp, h):
     """Expert-parallel switch MoE: flatten tokens and run the all_to_all
-    dispatch path (top-1, capacity-limited — not identical math to the
-    dense top-k path; choose per config).  Meshless calls use the
-    single-device reference with the SAME routing semantics, so a model
-    trained with moe_impl="switch" evaluates identically without a mesh."""
+    dispatch path (top-k, capacity-limited — not identical math to the
+    dense path; choose per config).  Meshless calls use the single-device
+    reference with the SAME routing semantics, so a model trained with
+    moe_impl="switch" evaluates identically without a mesh.  Returns
+    (out, aux) — the router-health metrics loss_fn folds into training."""
     from tfmesos_tpu.parallel.moe import switch_moe, switch_moe_reference
     b, t, d = h.shape
     flat = h.reshape(b * t, d)
     router = lp["router"].astype(cfg.dtype)
     if mesh is None:
-        out = switch_moe_reference(flat, router, lp["e_gate"], lp["e_up"],
-                                   lp["e_down"],
-                                   capacity_factor=cfg.capacity_factor)
+        out, aux = switch_moe_reference(flat, router, lp["e_gate"],
+                                        lp["e_up"], lp["e_down"],
+                                        capacity_factor=cfg.capacity_factor,
+                                        top_k=cfg.top_k, return_aux=True)
     else:
-        out = switch_moe(flat, router, lp["e_gate"], lp["e_up"], lp["e_down"],
-                         mesh, capacity_factor=cfg.capacity_factor)
-    return out.reshape(b, t, d)
+        out, aux = switch_moe(flat, router, lp["e_gate"], lp["e_up"],
+                              lp["e_down"], mesh,
+                              capacity_factor=cfg.capacity_factor,
+                              top_k=cfg.top_k, return_aux=True)
+    return out.reshape(b, t, d), aux
 
 
 def _moe(cfg: TransformerConfig, lp, h):
@@ -130,19 +146,54 @@ def _moe(cfg: TransformerConfig, lp, h):
     unrouted ones — mathematically exact top-k routing whose weights shard
     cleanly over ``ep``.  (A dispatch/all_to_all data path that skips the
     masked compute is the standard optimization; this dense form trades
-    FLOPs for simplicity and perfect load balance.)
+    FLOPs for simplicity and zero token overflow.)  Returns (out, aux).
     """
     e = cfg.n_experts
     logits = (h @ lp["router"].astype(cfg.dtype)).astype(jnp.float32)  # [B,T,E]
     top_vals, top_idx = jax.lax.top_k(logits, cfg.top_k)
     gates = jax.nn.softmax(top_vals, axis=-1)  # [B,T,k]
     # mask[b,t,e] = gate weight if e is among the top-k for (b,t), else 0
-    mask = (jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
-            * gates[..., None]).sum(axis=-2)
+    onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
+    mask = (onehot * gates[..., None]).sum(axis=-2)
     g = jax.nn.silu(jnp.einsum("btd,edf->btef", h, lp["e_gate"].astype(cfg.dtype)))
     u = jnp.einsum("btd,edf->btef", h, lp["e_up"].astype(cfg.dtype))
     y = jnp.einsum("btef,efd->bted", g * u, lp["e_down"].astype(cfg.dtype))
-    return jnp.einsum("bted,bte->btd", y, mask.astype(cfg.dtype))
+    out = jnp.einsum("bted,bte->btd", y, mask.astype(cfg.dtype))
+    probs = jax.nn.softmax(logits, axis=-1)
+    f = jnp.sum(onehot, axis=(0, 1, 2)) / (onehot.shape[0] * onehot.shape[1]
+                                           * cfg.top_k)
+    aux = {
+        "load_balance_loss": e * jnp.sum(
+            f * jnp.mean(probs, axis=(0, 1))),
+        "z_loss": jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+        "overflow_frac": jnp.zeros((), jnp.float32),  # dense path drops none
+    }
+    return out, aux
+
+
+def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
+                     tp_axis: str = "tp"):
+    """Megatron-style block with MANUAL tp collectives, for use inside a
+    pipeline stage (nested shard_map is not allowed there, explicit psum
+    is).  ``lp`` leaves arrive as local tp shards: wq/wk/wv column-sharded
+    [d, hd/tp], wo row-sharded [hd/tp, d], w_gate/w_up [d, f/tp], w_down
+    [f/tp, d]; norms replicated.  One psum after each row-parallel matmul —
+    the textbook 2-collectives-per-block tp pattern."""
+    tp = jax.lax.axis_size(tp_axis)
+    heads_loc = cfg.n_heads // tp
+    b, t, _ = x.shape
+    h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
+    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
+    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
+    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    o = attend(q, k, v, mesh=None, causal=True)  # local heads
+    x = x + jax.lax.psum(o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype),
+                         tp_axis)
+    h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
+    ffn = _mlp(cfg, lp, h)                        # local d_ff shard
+    return x + jax.lax.psum(ffn, tp_axis)
 
 
 def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions):
@@ -157,18 +208,20 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions):
     x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     if not cfg.n_experts:
-        ffn = _mlp(cfg, lp, h)
+        ffn, aux = _mlp(cfg, lp, h), _zero_aux()
     elif cfg.moe_impl == "switch":
         # Same model function with or without a mesh (switch_moe falls back
         # to its single-device reference when the ep axis is absent).
-        ffn = _moe_switch(cfg, mesh, lp, h)
+        ffn, aux = _moe_switch(cfg, mesh, lp, h)
     else:
-        ffn = _moe(cfg, lp, h)
-    return x + ffn
+        ffn, aux = _moe(cfg, lp, h)
+    return x + ffn, aux
 
 
-def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None):
-    """tokens [B, T] int32 → logits [B, T, V].
+def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
+            return_aux: bool = False):
+    """tokens [B, T] int32 → logits [B, T, V] (plus per-layer-averaged router
+    aux metrics when ``return_aux``).
 
     Sequence positions are global even when activations are sp-sharded:
     ring attention receives the full logical sequence sharded along T, and
@@ -182,18 +235,42 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None)
     if cfg.remat:
         block = jax.checkpoint(block)
 
+    aux = _zero_aux()
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     if pp > 1:
         from tfmesos_tpu.parallel.pipeline import pipeline_apply
-        if cfg.n_layers % pp:
-            raise ValueError(f"{cfg.n_layers} layers not divisible into {pp} stages")
-        per = cfg.n_layers // pp
+        tp = mesh.shape.get("tp", 1)
+        n_chunks = pp * cfg.pp_virtual_stages
+        if cfg.n_layers % n_chunks:
+            raise ValueError(f"{cfg.n_layers} layers not divisible into "
+                             f"{n_chunks} pipeline chunks")
+        per = cfg.n_layers // n_chunks
         stacked = jax.tree_util.tree_map(
-            lambda p: p.reshape(pp, per, *p.shape[1:]), params["layers"])
+            lambda p: p.reshape(n_chunks, per, *p.shape[1:]),
+            params["layers"])
 
-        # No nested mesh collectives inside a pipeline stage: attend runs
-        # per-device (pp composes with dp/fsdp batch sharding).
-        stage_block = lambda c, lp_, pos: _block(cfg, None, c, lp_, pos)
+        # Stages compose with tp via MANUAL collectives (weights sharded
+        # over tp, one psum per row-parallel matmul) — nested shard_map is
+        # not allowed inside the pipeline's own shard_map.  Router aux is
+        # not threaded through the pipeline (it would ride the bubble); use
+        # the non-pp path when training with aux losses.
+        if tp > 1:
+            if cfg.n_experts:
+                raise ValueError("pp x tp with experts is not supported; "
+                                 "use ep without tp under pp")
+            stage_block = lambda c, lp_, pos: (
+                _block_manual_tp(cfg, c, lp_, pos), None)
+            partition = {
+                "attn_norm": P(None, None),
+                "mlp_norm": P(None, None),
+                "wq": P(None, None, "tp"), "wk": P(None, None, "tp"),
+                "wv": P(None, None, "tp"), "wo": P(None, "tp", None),
+                "w_gate": P(None, None, "tp"), "w_up": P(None, None, "tp"),
+                "w_down": P(None, "tp", None),
+            }
+        else:
+            stage_block = lambda c, lp_, pos: _block(cfg, None, c, lp_, pos)
+            partition = None
         if cfg.remat:
             stage_block = jax.checkpoint(stage_block)
 
@@ -202,26 +279,55 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None)
                                    h.shape[:2])
 
             def body(carry, lp):
-                return stage_block(carry, lp, pos), None
+                out, _ = stage_block(carry, lp, pos)
+                return out, None
             out, _ = jax.lax.scan(body, h, stage_params)
             return out
 
-        x = pipeline_apply(stage_fn, stacked, x, mesh)
+        x = pipeline_apply(stage_fn, stacked, x, mesh,
+                           param_partition=partition,
+                           schedule=cfg.pp_schedule,
+                           virtual_stages=cfg.pp_virtual_stages)
     else:
         def body(carry, lp):
-            return block(carry, lp, positions), None
-        x, _ = jax.lax.scan(body, x, params["layers"])
+            out, layer_aux = block(carry, lp, positions)
+            return out, layer_aux
+        x, stacked_aux = jax.lax.scan(body, x, params["layers"])
+        aux = jax.tree_util.tree_map(jnp.mean, stacked_aux)
 
     x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
-    return x @ params["head"].astype(cfg.dtype)
+    logits = x @ params["head"].astype(cfg.dtype)
+    return (logits, aux) if return_aux else logits
 
 
 def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
-    """Next-token prediction: batch = {"tokens": [B, T+1]}."""
+    """Next-token prediction: batch = {"tokens": [B, T+1]}.
+
+    With experts enabled, the router's auxiliary losses join the objective
+    (standard switch-transformer weighting) and the realized token-overflow
+    fraction is surfaced in the metrics."""
     tokens = batch["tokens"]
-    logits = forward(cfg, params, tokens[:, :-1], mesh)
+    logits, aux = forward(cfg, params, tokens[:, :-1], mesh, return_aux=True)
     loss = cross_entropy_loss(logits, tokens[:, 1:])
-    return loss, {"perplexity": jnp.exp(loss)}
+    metrics = {"perplexity": jnp.exp(loss)}
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if cfg.n_experts and pp > 1:
+        # aux is not threaded through the pipeline: zeros here are absence,
+        # not balance.  Refuse to train as if they were real rather than
+        # silently skip load balancing and report perfect metrics.
+        if cfg.router_aux_weight or cfg.router_z_weight:
+            raise ValueError(
+                "router aux losses are not available under pipeline "
+                "parallelism; train MoE without pp, or set "
+                "router_aux_weight=router_z_weight=0 to opt out")
+    elif cfg.n_experts:
+        loss = (loss
+                + cfg.router_aux_weight * aux["load_balance_loss"]
+                + cfg.router_z_weight * aux["z_loss"])
+        metrics.update(load_balance_loss=aux["load_balance_loss"],
+                       router_z_loss=aux["z_loss"],
+                       moe_overflow_frac=aux["overflow_frac"])
+    return loss, metrics
 
 
 def _filter_spec(spec: P, mesh: Mesh) -> P:

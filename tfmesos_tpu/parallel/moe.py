@@ -1,44 +1,79 @@
-"""Expert parallelism: switch-style MoE with a real all_to_all data path.
+"""Expert parallelism: switch/top-k MoE with a real all_to_all data path.
 
 The flagship transformer's default MoE computes every expert densely and
 masks (models/transformer.py:_moe) — exact but O(E) FLOPs.  This module is
-the scalable path: top-1 (switch) routing with a capacity limit, experts
-sharded over the ``ep`` mesh axis, and tokens physically exchanged with two
-``lax.all_to_all`` hops (dispatch to expert owners, combine back) so each
-device computes only its own experts.  This is the standard TPU MoE layout:
-the all_to_alls ride ICI and the per-expert matmuls stay dense and
-MXU-shaped ``[capacity, d] x [d, f]``.
+the scalable path: top-k routing (k=1 switch-style by default) with a
+capacity limit, experts sharded over the ``ep`` mesh axis, and tokens
+physically exchanged with two ``lax.all_to_all`` hops (dispatch to expert
+owners, combine back) so each device computes only its own experts.  This is
+the standard TPU MoE layout: the all_to_alls ride ICI and the per-expert
+matmuls stay dense and MXU-shaped ``[capacity, d] x [d, f]``.
 
 Semantics (shared by the naive reference and the sharded path, so they are
-bit-comparable in tests): token i goes to its argmax expert if it arrives
-within the expert's capacity (position by order within the batch), weighted
-by the router's softmax probability; overflow tokens pass through with a
-zero MoE contribution (the residual stream carries them).
+bit-comparable in tests): each token takes its top-k experts; an assignment
+lands if it arrives within the expert's capacity, with slot priority by
+choice rank then batch order (all first choices beat any second choice);
+kept assignments are weighted by the router probability (renormalized over
+the top-k when k > 1, raw switch-style when k == 1); dropped assignments
+contribute zero (the residual stream carries the token).
+
+Router health is surfaced rather than assumed: ``return_aux=True`` yields
+the standard auxiliary load-balance loss (E·Σ_e f_e·P_e — 1.0 at perfect
+balance), the router z-loss (mean log²-sum-exp, which keeps logits from
+drifting into saturation), and the realized token-overflow fraction.
 """
 
 from __future__ import annotations
 
 import math
-from typing import Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _routing(x, router_w, n_experts: int, capacity: int):
-    """Shared routing math: returns (dispatch [n, E, C], gates [n])."""
+def _routing(x, router_w, n_experts: int, capacity: int, top_k: int = 1
+             ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Shared routing math.
+
+    Returns ``combine`` [n, E, C] — fp32 gate weight of each kept
+    (token, expert, slot) assignment (the dispatch mask is ``combine > 0``)
+    — and the aux metrics dict.
+    """
     logits = (x @ router_w).astype(jnp.float32)              # [n, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                      # [n]
-    gate = jnp.max(probs, axis=-1)                           # [n]
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # [n, E]
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # slot per token
-    keep = (pos >= 0) & (pos < capacity)
-    dispatch = onehot[..., None] * jax.nn.one_hot(
-        jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
-        dtype=jnp.float32) * keep[..., None].astype(jnp.float32)  # [n, E, C]
-    return dispatch, gate
+    top_p, top_e = jax.lax.top_k(probs, top_k)               # [n, k]
+    if top_k == 1:
+        gates = top_p                                        # switch: raw prob
+    else:
+        gates = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(top_e, n_experts, dtype=jnp.float32)  # [n, k, E]
+    # Slot assignment with choice priority: cumsum in choice-major order so
+    # every token's first choice outranks any token's second choice.
+    n = x.shape[0]
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, n_experts)
+    pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0
+    pos = pos_flat.reshape(top_k, n, n_experts).transpose(1, 0, 2)  # [n,k,E]
+    keep = (pos >= 0.0) & (pos < capacity)
+    slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    combine = jnp.sum(
+        onehot[..., None]
+        * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        * keep[..., None].astype(jnp.float32)
+        * gates[..., None, None],
+        axis=1)                                              # [n, E, C]
+
+    # Aux stats over the PRE-capacity assignment (the load balance you want
+    # to fix is visible before the capacity limit starts dropping tokens).
+    f = jnp.sum(onehot, axis=(0, 1)) / (n * top_k)           # assignment frac
+    p_mean = jnp.mean(probs, axis=0)                         # mean router prob
+    aux = {
+        "load_balance_loss": n_experts * jnp.sum(f * p_mean),
+        "z_loss": jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+        "overflow_frac": 1.0 - jnp.sum(keep) / (n * top_k),
+    }
+    return combine, aux
 
 
 def _expert_ffn(tokens, w_gate, w_up, w_down, compute_dtype):
@@ -49,39 +84,44 @@ def _expert_ffn(tokens, w_gate, w_up, w_down, compute_dtype):
     return jnp.einsum("ecf,efd->ecd", g * u, w_down.astype(compute_dtype))
 
 
+def _capacity(n_tokens: int, n_experts: int, factor: float,
+              top_k: int = 1) -> int:
+    return max(1, math.ceil(n_tokens * top_k * factor / n_experts))
+
+
 def switch_moe_reference(x, router_w, w_gate, w_up, w_down,
-                         capacity_factor: float = 1.25):
-    """Naive single-device switch MoE (ground truth for the sharded path).
+                         capacity_factor: float = 1.25, top_k: int = 1,
+                         return_aux: bool = False):
+    """Naive single-device top-k MoE (ground truth for the sharded path).
 
     x: [n, d]; router_w: [d, E]; w_gate/w_up: [E, d, f]; w_down: [E, f, d].
     """
     n, d = x.shape
     e = router_w.shape[-1]
-    capacity = _capacity(n, e, capacity_factor)
-    dispatch, gate = _routing(x, router_w, e, capacity)
+    capacity = _capacity(n, e, capacity_factor, top_k)
+    combine, aux = _routing(x, router_w, e, capacity, top_k)
+    dispatch = (combine > 0.0).astype(jnp.float32)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, x.astype(jnp.float32))
     expert_out = _expert_ffn(expert_in, w_gate, w_up, w_down, x.dtype)
-    combined = jnp.einsum("nec,ecd->nd", dispatch,
-                          expert_out.astype(jnp.float32))
-    return (combined * gate[:, None]).astype(x.dtype)
-
-
-def _capacity(n_tokens: int, n_experts: int, factor: float) -> int:
-    return max(1, math.ceil(n_tokens * factor / n_experts))
+    out = jnp.einsum("nec,ecd->nd", combine,
+                     expert_out.astype(jnp.float32)).astype(x.dtype)
+    return (out, aux) if return_aux else out
 
 
 def switch_moe_local(x, router_w, w_gate, w_up, w_down, axis: str = "ep",
-                     capacity_factor: float = 1.25):
+                     capacity_factor: float = 1.25, top_k: int = 1):
     """Per-device body (call inside shard_map): tokens local [n_loc, d],
     experts local [E/ep, d, f]; two all_to_all hops move token blocks to
-    their expert owners and back."""
+    their expert owners and back.  Returns (out, aux) with aux scalars
+    averaged over the ``axis`` group (callers pmean the data axes)."""
     ep = jax.lax.axis_size(axis)
     n_loc, d = x.shape
     e_loc = w_gate.shape[0]
     e = e_loc * ep
-    capacity = _capacity(n_loc, e, capacity_factor)
+    capacity = _capacity(n_loc, e, capacity_factor, top_k)
 
-    dispatch, gate = _routing(x, router_w, e, capacity)      # [n, E, C]
+    combine, aux = _routing(x, router_w, e, capacity, top_k)  # [n, E, C]
+    dispatch = (combine > 0.0).astype(jnp.float32)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch,
                            x.astype(jnp.float32))            # [E, C, d]
 
@@ -106,25 +146,42 @@ def switch_moe_local(x, router_w, w_gate, w_up, w_down, axis: str = "ep",
     # [E, C, d] in global expert order for my local tokens.
     returned = returned.reshape(e, capacity, d)
 
-    combined = jnp.einsum("nec,ecd->nd", dispatch, returned)
-    return (combined * gate[:, None]).astype(x.dtype)
+    combined = jnp.einsum("nec,ecd->nd", combine, returned)
+    aux = {k: jax.lax.pmean(v, axis) for k, v in aux.items()}
+    return combined.astype(x.dtype), aux
 
 
 def switch_moe(x, router_w, w_gate, w_up, w_down, mesh: Mesh,
-               axis: str = "ep", capacity_factor: float = 1.25):
+               axis: str = "ep", capacity_factor: float = 1.25,
+               top_k: int = 1, return_aux: bool = False):
     """Sharded entry point: x [n, d] sharded over the data axes, experts
     sharded over ``axis``.  Falls back to the reference when the mesh has no
     (non-trivial) ``axis``."""
     if axis not in mesh.shape or mesh.shape[axis] == 1:
         return switch_moe_reference(x, router_w, w_gate, w_up, w_down,
-                                    capacity_factor)
+                                    capacity_factor, top_k=top_k,
+                                    return_aux=return_aux)
     from tfmesos_tpu.parallel.sharding import data_axes
-    dspec = P(data_axes(mesh), None)
+    batch = data_axes(mesh)
+    dspec = P(batch, None)
     espec = P(axis, None, None)
+    batch_names = (tuple(a for a in (batch if isinstance(batch, tuple)
+                                     else (batch,)) if a)
+                   if batch is not None else ())
+
+    def body(x_, r_, g_, u_, dn_):
+        out, aux = switch_moe_local(x_, r_, g_, u_, dn_, axis=axis,
+                                    capacity_factor=capacity_factor,
+                                    top_k=top_k)
+        if batch_names:
+            aux = {k: jax.lax.pmean(v, batch_names) for k, v in aux.items()}
+        return out, aux
+
     fn = jax.shard_map(
-        lambda x_, r_, g_, u_, dn_: switch_moe_local(
-            x_, r_, g_, u_, dn_, axis=axis, capacity_factor=capacity_factor),
-        mesh=mesh,
+        body, mesh=mesh,
         in_specs=(dspec, P(None, None), espec, espec, espec),
-        out_specs=dspec, check_vma=False)
-    return fn(x, router_w, w_gate, w_up, w_down)
+        out_specs=(dspec, {k: P() for k in ("load_balance_loss", "z_loss",
+                                            "overflow_frac")}),
+        check_vma=False)
+    out, aux = fn(x, router_w, w_gate, w_up, w_down)
+    return (out, aux) if return_aux else out

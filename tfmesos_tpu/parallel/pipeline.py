@@ -1,17 +1,31 @@
-"""Pipeline parallelism over the ``pp`` mesh axis (GPipe-style).
+"""Pipeline parallelism over the ``pp`` mesh axis.
 
 Another axis the reference never had (SURVEY §2.7).  Layers are grouped into
 stages whose parameters are *stacked* along a leading dim and sharded over
-``pp`` — so each device holds one stage — and microbatches flow through the
-ring with one ``ppermute`` hop per tick.  All devices run every tick (SPMD);
-warm-up/drain bubbles are the usual GPipe cost, amortized by the microbatch
-count.  Composes with dp/fsdp (batch axes) since activations stay sharded on
-their batch dims.
+``pp`` — so each device holds one stage (or ``virtual_stages`` chunks of
+one) — and microbatches flow through the ring with one ``ppermute`` hop per
+tick.  All devices run every tick (SPMD).
+
+Two schedules:
+
+* ``"gpipe"`` — fill/drain; bubble fraction (S−1)/(M+S−1).
+* ``"circular"`` — interleaved virtual stages: each device holds ``v``
+  round-robin layer chunks and every microbatch laps the ring ``v`` times,
+  shrinking the bubble to ≈(S−1)/(M·v) at the cost of v× more ppermute hops
+  (tiny activations vs. the per-chunk matmuls they overlap with).
+
+Composes with dp/fsdp (activations stay sharded on their batch dims) AND
+with tp: the stage body runs inside the full-mesh ``shard_map``, so it may
+freely use ``jax.lax.psum(..., "tp")``-style collectives, and
+``param_partition`` shards each stage's weights over non-pp axes
+(Megatron-style column/row splits).  What a stage must NOT do is open a
+nested ``shard_map`` — write manual-collective stage bodies instead
+(models/transformer.py:_block_manual_tp is the worked example).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -35,17 +49,36 @@ def stage_sharding_tree(stacked_params: Any, mesh: Mesh, axis: str = "pp") -> An
 
 def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
                    x, mesh: Mesh, axis: str = "pp",
-                   num_microbatches: int = None):
+                   num_microbatches: Optional[int] = None,
+                   param_partition: Optional[Any] = None,
+                   schedule: str = "gpipe", virtual_stages: int = 1):
     """Run ``x`` through the stage pipeline; returns the final activations.
 
-    ``stage_fn(params, h) -> h`` applies ONE stage (same activation shape in
-    and out).  ``stacked_params`` leaves have leading dim = number of stages.
-    ``x`` is ``[B, ...]``; it is split into microbatches along B.
+    ``stage_fn(params, h) -> h`` applies ONE stage chunk (same activation
+    shape in and out); it runs inside the mesh-wide shard_map and may use
+    manual collectives over non-pp axes.  ``stacked_params`` leaves have
+    leading dim = number of chunks (``pp`` for gpipe,
+    ``pp * virtual_stages`` for circular, in global layer order).  ``x`` is
+    ``[B, ...]``, split into microbatches along B.  ``param_partition``
+    (optional) is a pytree of PartitionSpecs for each leaf's NON-leading
+    dims, e.g. ``P("tp", None)`` to column-shard a weight over tp.
     """
     n_stages = mesh.shape[axis]
+    if schedule not in ("gpipe", "circular"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if virtual_stages > 1 and schedule != "circular":
+        # Silently running gpipe over pp*v chunks would apply only the
+        # first chunk on each device — wrong loss, no error.
+        raise ValueError("virtual_stages > 1 requires schedule='circular'")
+    v = virtual_stages if schedule == "circular" else 1
     if n_stages == 1:
-        params0 = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
-        return stage_fn(params0, x)
+        n_chunks = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        def chunk(i):
+            return jax.tree_util.tree_map(lambda p: p[i], stacked_params)
+        h = x
+        for i in range(n_chunks):
+            h = stage_fn(chunk(i), h)
+        return h
     m = num_microbatches or n_stages
     d_axes = data_axes(mesh)
     dp_size = 1
@@ -54,38 +87,59 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
     if x.shape[0] % (m * dp_size):
         raise ValueError(f"batch {x.shape[0]} not divisible into {m} "
                          f"microbatches x {dp_size} data shards")
+    if schedule == "circular":
+        if v < 1:
+            raise ValueError("virtual_stages must be >= 1")
+        if m % n_stages:
+            raise ValueError(f"circular schedule needs microbatches ({m}) "
+                             f"divisible by pp ({n_stages})")
+        # Chunk c of the round-robin assignment (device s runs chunks
+        # lap*pp + s) must land at the device's local index `lap` under
+        # contiguous sharding: permute global order [c] -> [s*v + lap].
+        perm = jnp.asarray([(i % n_stages) * v + i // n_stages
+                            for i in range(n_stages * v)]).argsort()
+        stacked_params = jax.tree_util.tree_map(
+            lambda p: jnp.take(p, perm, axis=0), stacked_params)
 
     def local(params, xs):
-        params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), params)
         stage = jax.lax.axis_index(axis)
         b_loc = xs.shape[0]
         micro = xs.reshape(m, b_loc // m, *xs.shape[1:])
         mb_shape = micro.shape[1:]
 
+        def chunk_params(lap):
+            # local leading dim is v (1 for gpipe): pick this lap's chunk
+            return jax.tree_util.tree_map(
+                lambda p: jax.lax.dynamic_index_in_dim(p, lap, 0,
+                                                       keepdims=False),
+                params)
+
         def tick(t, carry):
             received, outputs = carry
-            idx = jnp.minimum(t, m - 1)
-            inject = jnp.where(t < m,
-                               jax.lax.dynamic_index_in_dim(micro, idx, 0,
-                                                            keepdims=False),
-                               jnp.zeros(mb_shape, xs.dtype))
-            h = jnp.where(stage == 0, inject, received)
-            out = stage_fn(params, h)
-            out_idx = t - (n_stages - 1)
-            write = (stage == n_stages - 1) & (out_idx >= 0)
+            u = t - stage
+            r = jnp.where(u >= 0, u % n_stages, 0)
+            w = u - r
+            lap = jnp.where(u >= 0, (w % (n_stages * v)) // n_stages, 0)
+            mb = jnp.where(u >= 0, (w // (n_stages * v)) * n_stages + r, 0)
+            active = (u >= 0) & (mb < m)
+            inject = jax.lax.dynamic_index_in_dim(
+                micro, jnp.clip(mb, 0, m - 1), 0, keepdims=False)
+            h = jnp.where((stage == 0) & (lap == 0), inject, received)
+            out = stage_fn(chunk_params(lap), h)
+            emit = active & (stage == n_stages - 1) & (lap == v - 1)
+            out_idx = jnp.clip(mb, 0, m - 1)
             outputs = jax.lax.dynamic_update_index_in_dim(
                 outputs,
-                jnp.where(write, out,
-                          jax.lax.dynamic_index_in_dim(
-                              outputs, jnp.maximum(out_idx, 0), 0,
-                              keepdims=False)),
-                jnp.maximum(out_idx, 0), 0)
+                jnp.where(emit, out,
+                          jax.lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                                       keepdims=False)),
+                out_idx, 0)
             received = ppermute_shift(out, axis, 1)
             return received, outputs
 
         outputs0 = jnp.zeros((m,) + mb_shape, xs.dtype)
         received0 = jnp.zeros(mb_shape, xs.dtype)
-        _, outputs = jax.lax.fori_loop(0, m + n_stages - 1, tick,
+        _, outputs = jax.lax.fori_loop(0, m * v + n_stages - 1, tick,
                                        (received0, outputs0))
         # Results live on the last stage; broadcast them to every stage so
         # the caller sees a pp-replicated output.
@@ -94,10 +148,14 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
             axis_name=axis)
         return outputs.reshape(b_loc, *xs.shape[1:])
 
-    param_specs = jax.tree_util.tree_map(
-        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
+    if param_partition is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
+    else:
+        param_specs = jax.tree_util.tree_map(
+            lambda p, spec: P(axis, *spec), stacked_params, param_partition)
     # Activations shard over the data axes (each pipeline ring works on its
-    # batch shard) and replicate over pp, where the ring rotates them.
+    # batch shard) and replicate over pp/tp, where the ring/psum handle them.
     x_spec = P(data_axes(mesh), *([None] * (x.ndim - 1)))
     fn = jax.shard_map(local, mesh=mesh,
                        in_specs=(param_specs, x_spec), out_specs=x_spec,

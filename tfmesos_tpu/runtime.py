@@ -136,4 +136,18 @@ def initialize(ctx: Optional[TaskContext] = None) -> TaskContext:
             process_id=ctx.rank,
         )
         _initialized = True
+    # force_platform is best-effort (a plugin that already initialized a
+    # backend wins silently) — verify, because proceeding on the wrong
+    # platform is exactly the silent degradation this guard exists to stop.
+    # Checked only after distributed init: querying devices earlier would
+    # initialize the local backend and break jax.distributed.
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested:
+        allowed = [p.strip() for p in requested.split(",") if p.strip()]
+        got = jax.local_devices()[0].platform
+        if got not in allowed:
+            raise RuntimeError(
+                f"JAX_PLATFORMS={requested} was requested but the backend "
+                f"initialized as {got!r} — a site PJRT plugin pinned the "
+                "platform before runtime.initialize() ran")
     return ctx

@@ -156,6 +156,17 @@ class TPUMesosScheduler:
 
     def on_registered(self, info: Dict[str, Any]) -> None:
         self.log.info("backend registered: %s", info)
+        version = info.get("master_version")
+        if self.containerizer_type is None and version:
+            # Reference semantics (scheduler.py:378-382): Mesos >= 1.0 uses
+            # the unified MESOS containerizer, older masters need DOCKER.
+            try:
+                major = int(str(version).split(".")[0])
+            except ValueError:
+                return
+            self.containerizer_type = "MESOS" if major >= 1 else "DOCKER"
+            self.log.info("auto-detected containerizer %s (master %s)",
+                          self.containerizer_type, version)
 
     def on_offers(self, offers: List[Offer]) -> None:
         """Offer matching (reference resourceOffers, scheduler.py:223-277)."""

@@ -80,6 +80,9 @@ class Offer:
     cpus: float = 0.0
     mem: float = 0.0
     chips: int = 0
+    #: resource name the chips were advertised under ("tpus", or "gpus" on
+    #: GPU agents) — TaskInfo must request them by the same name.
+    chips_resource: str = "tpus"
     attributes: Dict[str, str] = field(default_factory=dict)
     raw: Optional[dict] = None
 
@@ -227,9 +230,11 @@ class Task:
             },
         }
         if self.chips:
-            # TPU chips are advertised as a custom scalar resource on TPU-VM
-            # agents (no GPU/nvidia isolator involved, per the north star).
-            ti["resources"].append(_scalar("tpus", float(self.chips)))
+            # Chips are requested under the SAME resource name the offer
+            # advertised ("tpus" on TPU-VM agents, "gpus" on GPU agents) —
+            # requesting a name the agent never offered would fail at launch.
+            ti["resources"].append(
+                _scalar(offer.chips_resource, float(self.chips)))
 
         image = docker_image or os.environ.get("DOCKER_IMAGE")
         if image:
