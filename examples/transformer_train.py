@@ -34,6 +34,14 @@ def main():
     p.add_argument("--moe", type=int, default=0,
                    help="number of experts (0 = dense); uses the switch "
                         "all_to_all path when the mesh has an ep axis")
+    p.add_argument("--top-k", type=int, default=1, dest="top_k",
+                   help="experts per token on the switch path")
+    p.add_argument("--pp-schedule", choices=["gpipe", "circular"],
+                   default="gpipe", dest="pp_schedule",
+                   help="pipeline schedule when the mesh has a pp axis")
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   dest="virtual_stages",
+                   help="interleaved chunks per pp device (circular only)")
     p.add_argument("--tiny", action="store_true")
     args = p.parse_args()
 
@@ -55,12 +63,17 @@ def main():
             vocab_size=256, d_model=64, n_layers=2,
             n_heads=max(4, 2 * mesh.shape.get("tp", 1)), d_ff=128,
             max_seq_len=args.seq_len, dtype=jnp.float32,
-            n_experts=args.moe, moe_impl="switch")
+            n_experts=args.moe, top_k=args.top_k, moe_impl="switch",
+            pp_schedule=args.pp_schedule,
+            pp_virtual_stages=args.virtual_stages)
         seq_len = min(args.seq_len, 64 * max(1, mesh.shape.get("sp", 1)))
     else:
         cfg = transformer.TransformerConfig(
             vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
-            max_seq_len=args.seq_len, n_experts=args.moe, moe_impl="switch")
+            max_seq_len=args.seq_len, n_experts=args.moe,
+            top_k=args.top_k, moe_impl="switch",
+            pp_schedule=args.pp_schedule,
+            pp_virtual_stages=args.virtual_stages)
         seq_len = args.seq_len
     if ctx.is_chief:
         print(f"transformer: mesh={dict(mesh.shape)} seq={seq_len} "

@@ -237,9 +237,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _step()
 
 
-def _mha_bwd_pallas(cfg: _FlashCfg, q, k, v, o, lse, do):
+def _mha_bwd_pallas(cfg: _FlashCfg, q, k, v, o, lse, do, out_dtype=None):
     """Mosaic backward: the standard two-kernel dq / dk+dv split, both
-    reusing the forward's stored logsumexp.
+    reusing the forward's stored logsumexp.  ``out_dtype`` overrides the
+    gradient dtype (callers that go on accumulating — ring attention —
+    take fp32 to avoid a round-trip through bf16 per partial).
 
     Grids put the reduction dimension innermost with ``arbitrary`` semantics
     so operand blocks pipeline (HBM→VMEM double-buffering) while the output
@@ -308,7 +310,8 @@ def _mha_bwd_pallas(cfg: _FlashCfg, q, k, v, o, lse, do):
             transcendentals=b * h * t * tk),
     )(qt, kt, vt, dot_, lse, delta)
 
-    back = lambda x, ref: x.transpose(0, 2, 1, 3).astype(ref.dtype)
+    back = lambda x, ref: x.transpose(0, 2, 1, 3).astype(
+        out_dtype or ref.dtype)
     return back(dq, q), back(dk, k), back(dv, v)
 
 

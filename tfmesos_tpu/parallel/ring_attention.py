@@ -7,10 +7,27 @@ K/V shards rotate around the ring via ``ppermute`` (one ICI hop per step),
 with the online-softmax accumulation of flash attention so nothing is ever
 materialized at full sequence length.  Memory per device is O(T/sp), compute
 overlaps the rotation, and causal masking is exact across shards.
+
+Two inner implementations:
+
+* ``impl="flash"`` (default on TPU) — each ring step runs the Pallas flash
+  kernels on the local shard pair and partial outputs merge through their
+  logsumexps; a custom VJP re-rotates K/V in the backward and feeds the
+  stored GLOBAL lse to the Mosaic dq/dkv kernels, so residual memory stays
+  O(T/sp) (plain autodiff of the ring would checkpoint per-step score
+  matrices — O(T²/sp)).
+* ``impl="xla"`` — the original einsum ring with online softmax; ground
+  truth and the CPU path.
+
+Causal structure across shards is the standard ring decomposition: step 0
+holds this device's own shard (true causal call); any later step holds a
+shard that is either fully visible (owner before us) or fully masked
+(owner after us), decided by one scalar — no per-element cross-shard masks.
 """
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -70,21 +87,136 @@ def ring_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
     return out.astype(q.dtype)
 
 
+def _flash_cfg(q, scale, causal, interpret):
+    from tfmesos_tpu.ops import attention as A
+    t = q.shape[1]
+    return A._FlashCfg(causal=causal, scale=scale,
+                       block_q=A._pick_block(t), block_k=A._pick_block(t),
+                       interpret=bool(interpret))
+
+
+def _merge(o_acc, lse_acc, o_i, lse_i):
+    """Merge two normalized partial attentions via their logsumexps.
+
+    o: [B, T, H, D]; lse: [B, H, T, 1].  exp(-inf − finite) = 0 handles
+    fully-masked partials.
+    """
+    lse_new = jnp.logaddexp(lse_acc, lse_i)
+    w_a = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1, 3)  # [B, T, H, 1]
+    w_i = jnp.exp(lse_i - lse_new).transpose(0, 2, 1, 3)
+    return o_acc * w_a + o_i * w_i, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis, causal, scale, interpret):
+    return _ring_flash_fwd(q, k, v, axis, causal, scale, interpret)[0]
+
+
+def _ring_flash_fwd(q, k, v, axis, causal, scale, interpret):
+    from tfmesos_tpu.ops import attention as A
+    sp = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    of = jnp.float32
+
+    o, lse = A._flash_forward(_flash_cfg(q, scale, causal, interpret),
+                              q, k, v)          # step 0: own shard, causal
+    o = o.astype(of)
+    cfg_full = _flash_cfg(q, scale, False, interpret)
+    kr, vr = k, v
+    for step in range(1, sp):
+        kr = ppermute_shift(kr, axis, 1)
+        vr = ppermute_shift(vr, axis, 1)
+        src = (idx - step) % sp  # owner of the shard we now hold
+        o_i, lse_i = A._flash_forward(cfg_full, q, kr, vr)
+        if causal:
+            visible = src < idx  # else: entirely in our future, masked
+            lse_i = jnp.where(visible, lse_i, -jnp.inf)
+            o_i = jnp.where(visible, o_i.astype(of), 0.0)
+        else:
+            o_i = o_i.astype(of)
+        o, lse = _merge(o, lse, o_i, lse_i)
+    out = o.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis, causal, scale, interpret, res, g):
+    """Re-rotate K/V and run the Mosaic backward per shard with the stored
+    GLOBAL logsumexp (p = exp(s·scale − lse) is then already normalized over
+    the full ring, so per-shard contributions just sum).  dk/dv accumulators
+    ride the ring with their shards; after sp total hops every contribution
+    is back on its owner."""
+    from tfmesos_tpu.ops import attention as A
+    q, k, v, out, lse = res
+    sp = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+
+    dq, dk, dv = A._mha_bwd_pallas(
+        _flash_cfg(q, scale, causal, interpret), q, k, v, out, lse, g,
+        out_dtype=jnp.float32)
+    cfg_full = _flash_cfg(q, scale, False, interpret)
+    kr, vr = k, v
+    for step in range(1, sp):
+        kr = ppermute_shift(kr, axis, 1)
+        vr = ppermute_shift(vr, axis, 1)
+        dk = ppermute_shift(dk, axis, 1)
+        dv = ppermute_shift(dv, axis, 1)
+        src = (idx - step) % sp
+        dqc, dkc, dvc = A._mha_bwd_pallas(cfg_full, q, kr, vr, out, lse, g,
+                                          out_dtype=jnp.float32)
+        if causal:
+            visible = (src < idx).astype(jnp.float32)
+            dqc = dqc * visible
+            dkc = dkc * visible
+            dvc = dvc * visible
+        dq = dq + dqc
+        dk = dk + dkc
+        dv = dv + dvc
+    # One final hop completes the full ring: contributions land home.
+    dk = ppermute_shift(dk, axis, 1)
+    dv = ppermute_shift(dv, axis, 1)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, impl: Optional[str] = None,
+                   interpret: bool = False):
     """Sharded entry point: q/k/v are global ``[B, T, H, D]`` arrays (or
     tracers under jit) with T sharded over ``axis``.
 
     Falls back to single-device flash/reference attention when the mesh has
     no (non-trivial) ``axis`` — so model code calls this unconditionally.
+    ``impl=None`` auto-selects: Pallas-inner ring on TPU (or when
+    ``interpret``), the einsum ring elsewhere.
     """
     if axis not in mesh.shape or mesh.shape[axis] == 1:
         from tfmesos_tpu.ops.attention import flash_attention
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               interpret=interpret,
+                               use_pallas=True if impl == "flash" else None)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    local_t = q.shape[1] // mesh.shape[axis]
+    if impl is None:
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "flash" if (on_tpu or interpret) and local_t % 8 == 0 else "xla"
+    elif impl == "flash":
+        from tfmesos_tpu.ops.attention import _pick_block
+        if _pick_block(local_t) > 1024:
+            # Mirror flash_attention's forced-pallas guard: fail fast with
+            # a clear error instead of an opaque Mosaic lowering failure.
+            raise ValueError(
+                f"ring_attention(impl='flash'): local shard length "
+                f"{local_t} has no Mosaic-legal block tiling")
     spec = P(data_axes(mesh), axis, None, None)
-    fn = jax.shard_map(
-        lambda q_, k_, v_: ring_attention_local(q_, k_, v_, axis=axis,
-                                                causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+    if impl == "flash":
+        body = lambda q_, k_, v_: _ring_flash(q_, k_, v_, axis, bool(causal),
+                                              float(scale), bool(interpret))
+    else:
+        body = lambda q_, k_, v_: ring_attention_local(
+            q_, k_, v_, axis=axis, causal=causal, scale=scale)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
     return fn(q, k, v)
