@@ -171,6 +171,18 @@ def _moe(cfg: TransformerConfig, lp, h):
     return out, aux
 
 
+def _ffn(cfg: TransformerConfig, mesh, lp, h):
+    """The block's feed-forward dispatch (dense / switch / dense-MoE) —
+    shared by the train and decode paths so they cannot drift."""
+    if not cfg.n_experts:
+        return _mlp(cfg, lp, h), _zero_aux()
+    if cfg.moe_impl == "switch":
+        # Same model function with or without a mesh (switch_moe falls back
+        # to its single-device reference when the ep axis is absent).
+        return _moe_switch(cfg, mesh, lp, h)
+    return _moe(cfg, lp, h)
+
+
 def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
                      tp_axis: str = "tp"):
     """Megatron-style block with MANUAL tp collectives, for use inside a
@@ -207,14 +219,7 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions):
     o = attend(q, k, v, mesh=mesh, causal=True)
     x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
-    if not cfg.n_experts:
-        ffn, aux = _mlp(cfg, lp, h), _zero_aux()
-    elif cfg.moe_impl == "switch":
-        # Same model function with or without a mesh (switch_moe falls back
-        # to its single-device reference when the ep axis is absent).
-        ffn, aux = _moe_switch(cfg, mesh, lp, h)
-    else:
-        ffn, aux = _moe(cfg, lp, h)
+    ffn, aux = _ffn(cfg, mesh, lp, h)
     return x + ffn, aux
 
 
@@ -298,6 +303,123 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
     x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
     logits = x @ params["head"].astype(cfg.dtype)
     return (logits, aux) if return_aux else logits
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               dtype=None) -> Dict[str, Any]:
+    """KV cache for autoregressive decoding: per-layer stacked K/V buffers
+    (consumed by the same ``lax.scan`` over layers the forward uses)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos):
+    """One block over a token chunk with cached history.
+
+    ``x``: [B, t, d] (t = chunk length; 1 in steady-state decode);
+    ``ck``/``cv``: [B, M, H, Dh] this layer's cache; ``positions``: [t]
+    global positions of the chunk; ``pos``: first chunk position (traced).
+    Queries at length t attend over the whole cache with an offset causal
+    mask — no flash kernel here, decode is bandwidth-bound at t=1.
+    """
+    b, t, _ = x.shape
+    m = ck.shape[1]
+    h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
+    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                                 cfg.head_dim)
+    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                                 cfg.head_dim)
+    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                                 cfg.head_dim)
+    pos_row = jnp.broadcast_to(positions, (b, t))
+    q = rope(q, pos_row, cfg.rope_theta)
+    k = rope(k, pos_row, cfg.rope_theta)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32)
+    s = s / math.sqrt(cfg.head_dim)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
+    s = jnp.where((kpos > positions[:, None])[None, None], -jnp.inf, s)
+    probs = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+    x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
+    h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
+    ffn, _ = _ffn(cfg, None, lp, h)
+    return x + ffn, ck, cv
+
+
+def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
+    """Advance decoding by a token chunk.
+
+    ``tokens``: [B, t] (the prompt at prefill, one token per step after);
+    ``pos``: first global position of the chunk (python int or traced).
+    Returns (logits [B, t, V], updated cache).  Single-process decode —
+    the training-side meshes (tp/sp/pp) do not apply to this path.
+
+    Exactness contract: dense and dense-MoE configs reproduce ``forward()``
+    logits bit-for-bit position by position.  Capacity-based switch MoE
+    routes per chunk (tokens only compete within one ``decode_step`` call),
+    so decode matches the training-time forward only up to capacity
+    overflow — exact whenever nothing overflows, which per-token steps
+    (n = B tokens) essentially never do.  That is the standard trade:
+    dropping tokens by batch-order competition at inference would be worse
+    than the mismatch.
+    """
+    t = tokens.shape[1]
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = pos + jnp.arange(t, dtype=jnp.int32)
+
+    def body(carry, layer):
+        lp, ck, cv = layer
+        out, ck, cv = _block_decode(cfg, carry, lp, ck, cv, positions, pos)
+        return out, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
+    logits = x @ params["head"].astype(cfg.dtype)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
+             rng=None, temperature: float = 0.0):
+    """Autoregressive generation: prefill the prompt in one pass, then one
+    fused scan step per token (KV cache, greedy or temperature sampling).
+
+    ``prompt``: [B, Tp] int32.  Returns [B, Tp + max_new_tokens].
+    """
+    b, tp = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, b, tp + max_new_tokens)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    logits, cache = decode_step(cfg, params, cache, prompt, 0)
+    rng, key = jax.random.split(rng)
+    tok = sample(logits[:, -1], key)
+
+    def body(carry, _):
+        cache, tok, pos, rng = carry
+        logits, cache = decode_step(cfg, params, cache, tok[:, None], pos)
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits[:, -1], key)
+        return (cache, nxt, pos + 1, rng), tok
+
+    (cache, tok, _, _), toks = jax.lax.scan(
+        body, (cache, tok, jnp.asarray(tp, jnp.int32), rng), None,
+        length=max_new_tokens - 1)
+    generated = jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), tok[:, None]], axis=1)
+    return jnp.concatenate([prompt, generated], axis=1)
 
 
 def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):

@@ -193,9 +193,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
     """
     if axis not in mesh.shape or mesh.shape[axis] == 1:
         from tfmesos_tpu.ops.attention import flash_attention
+        use_pallas = {None: None, "flash": True, "xla": False}[impl]
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               interpret=interpret,
-                               use_pallas=True if impl == "flash" else None)
+                               interpret=interpret, use_pallas=use_pallas)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     local_t = q.shape[1] // mesh.shape[axis]
