@@ -207,6 +207,31 @@ def bench_transformer_dense():
         b=4, t=2048, k=4)
 
 
+def bench_decode(batch=8, prompt_len=128, new_tokens=256):
+    """Autoregressive decode throughput on the flagship config (KV cache,
+    greedy): generated tokens per second across the batch."""
+    import jax
+    import jax.numpy as jnp
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+        max_seq_len=prompt_len + new_tokens, dtype=jnp.bfloat16)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab_size, dtype=jnp.int32)
+    gen = jax.jit(lambda p, t: transformer.generate(cfg, p, t, new_tokens))
+    out = gen(params, prompt)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = gen(params, prompt)
+        np.asarray(out[:, -1])  # real fetch ends the chain
+        best = min(best, time.perf_counter() - t0)
+    return batch * new_tokens / best
+
+
 def bench_bandwidth():
     """Achieved bandwidth vs roofline.
 
@@ -332,6 +357,9 @@ def main():
     if dense:
         _, mfu = max(dense)
         out["mfu_dense"] = round(mfu, 4)
+    dec = attempts(bench_decode, "decode bench", n=1)
+    if dec:
+        out["decode_tokens_per_sec"] = round(max(dec), 1)
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:
         out.update(bw[0])

@@ -337,12 +337,18 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos):
     k = rope(k, pos_row, cfg.rope_theta)
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32)
-    s = s / math.sqrt(cfg.head_dim)
-    kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
-    s = jnp.where((kpos > positions[:, None])[None, None], -jnp.inf, s)
-    probs = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+    if t > 1 and isinstance(pos, int) and pos == 0:
+        # Prefill from an empty cache: the chunk only attends to itself, so
+        # run the causal flash path instead of materializing a [t, M] score
+        # tensor over the (mostly empty) cache.
+        o = attend(q, k, v, mesh=None, causal=True)
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32)
+        s = s / math.sqrt(cfg.head_dim)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
+        s = jnp.where((kpos > positions[:, None])[None, None], -jnp.inf, s)
+        probs = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
     x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, _ = _ffn(cfg, None, lp, h)
@@ -358,7 +364,8 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
     the training-side meshes (tp/sp/pp) do not apply to this path.
 
     Exactness contract: dense and dense-MoE configs reproduce ``forward()``
-    logits bit-for-bit position by position.  Capacity-based switch MoE
+    logits position by position to numerical tolerance (the two paths use
+    different attention accumulation orders).  Capacity-based switch MoE
     routes per chunk (tokens only compete within one ``decode_step`` call),
     so decode matches the training-time forward only up to capacity
     overflow — exact whenever nothing overflows, which per-token steps

@@ -191,6 +191,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
     ``impl=None`` auto-selects: Pallas-inner ring on TPU (or when
     ``interpret``), the einsum ring elsewhere.
     """
+    if impl not in (None, "flash", "xla"):
+        raise ValueError(f"impl must be None, 'flash', or 'xla'; got {impl!r}")
     if axis not in mesh.shape or mesh.shape[axis] == 1:
         from tfmesos_tpu.ops.attention import flash_attention
         use_pallas = {None: None, "flash": True, "xla": False}[impl]

@@ -41,7 +41,8 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     param_specs: Optional[Any] = None,
                     batch_spec_tree: Optional[Any] = None,
                     postprocess: Optional[Callable] = None,
-                    steps_per_call: int = 1):
+                    steps_per_call: int = 1,
+                    grad_accum: int = 1):
     """Build the jit'd train step.
 
     ``loss_fn(params, batch) -> (loss, metrics)``.  With a mesh, params/opt
@@ -55,11 +56,49 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     ...]`` dim and the host pays one round-trip per K steps — the dominant
     cost for small models on remote-attached or latency-bound runtimes.
     Returned metrics are the last step's.
+
+    ``grad_accum > 1`` splits each step's batch into that many microbatches
+    and averages their gradients before the single optimizer update — the
+    full-batch step for losses that are per-example means (equal micro
+    sizes), at 1/grad_accum the activation memory, since each microbatch's
+    backward completes before the next begins.  Loss terms that are
+    *batch statistics* (e.g. MoE load-balance fractions) are computed per
+    microbatch, a slightly different objective.  Returned metrics are
+    microbatch means.  The per-step batch dim must divide evenly (and stay
+    divisible by the data-axis size).
     """
 
+    def grads_and_metrics(params, batch):
+        if grad_accum == 1:
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            return grads, loss, metrics
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape(grad_accum, x.shape[0] // grad_accum,
+                                *x.shape[1:]), batch)
+
+        def acc(carry, mb):
+            gsum, lsum = carry
+            (loss, metrics), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+            return (gsum, lsum + loss), metrics
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (gsum, lsum), metrics = jax.lax.scan(
+            acc, (zeros, jnp.zeros((), jnp.float32)), micro)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / grad_accum).astype(p.dtype), gsum, params)
+        # Microbatch MEANS for every metric, matching the reported loss
+        # (exp(mean loss) still differs from mean perplexity — means of
+        # nonlinear metrics are approximations either way).
+        mean_metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0),
+                                              metrics)
+        return grads, lsum / grad_accum, mean_metrics
+
     def one_step(params, opt_state, batch):
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch)
+        grads, loss, metrics = grads_and_metrics(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if postprocess is not None:
