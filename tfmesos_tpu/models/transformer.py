@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tfmesos_tpu.ops.attention import attend
+from tfmesos_tpu.ops.attention import attend, mha_reference
 from tfmesos_tpu.ops.layers import cross_entropy_loss, rms_norm, rope, swiglu
 
 
@@ -314,14 +314,29 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos):
+def cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, P]:
+    """PartitionSpecs for the KV cache: batch over the data axes, heads over
+    tp — the decode analogue of ``partition_specs``.  Place the cache (and
+    params) with these and jit ``decode_step(..., sharded=True)``: every op
+    is then a plain einsum, so GSPMD inserts the tp collectives — no manual
+    decode variant needed."""
+    from tfmesos_tpu.parallel.sharding import data_axes
+    spec = _filter_spec(P(None, data_axes(mesh), None, "tp", None), mesh)
+    return {"k": spec, "v": spec}
+
+
+def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
+                  sharded: bool = False):
     """One block over a token chunk with cached history.
 
     ``x``: [B, t, d] (t = chunk length; 1 in steady-state decode);
     ``ck``/``cv``: [B, M, H, Dh] this layer's cache; ``positions``: [t]
     global positions of the chunk; ``pos``: first chunk position (traced).
-    Queries at length t attend over the whole cache with an offset causal
-    mask — no flash kernel here, decode is bandwidth-bound at t=1.
+    A multi-token prefill from an empty cache attends chunk-to-chunk (flash
+    kernel when ``sharded=False``; a plain einsum when ``sharded=True`` so
+    GSPMD can partition it — a pallas_call under sharded jit cannot be);
+    steady-state queries run the dense einsum over the cache with an offset
+    causal mask — bandwidth-bound at t=1, no kernel needed.
     """
     b, t, _ = x.shape
     m = ck.shape[1]
@@ -338,10 +353,13 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos):
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
     if t > 1 and isinstance(pos, int) and pos == 0:
-        # Prefill from an empty cache: the chunk only attends to itself, so
-        # run the causal flash path instead of materializing a [t, M] score
-        # tensor over the (mostly empty) cache.
-        o = attend(q, k, v, mesh=None, causal=True)
+        # Prefill from an empty cache: the chunk only attends to itself —
+        # [t, t] instead of a [t, M] score tensor over the (mostly empty)
+        # cache.
+        if sharded:
+            o = mha_reference(q, k, v, causal=True)
+        else:
+            o = attend(q, k, v, mesh=None, causal=True)
     else:
         s = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32)
         s = s / math.sqrt(cfg.head_dim)
@@ -355,13 +373,20 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos):
     return x + ffn, ck, cv
 
 
-def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
+def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
+                sharded: bool = False):
     """Advance decoding by a token chunk.
 
     ``tokens``: [B, t] (the prompt at prefill, one token per step after);
     ``pos``: first global position of the chunk (python int or traced).
-    Returns (logits [B, t, V], updated cache).  Single-process decode —
-    the training-side meshes (tp/sp/pp) do not apply to this path.
+    Returns (logits [B, t, V], updated cache).
+
+    For multi-chip decode, pass ``sharded=True``, place the params per
+    ``partition_specs`` and the cache per ``cache_specs``, and jit: every
+    op is then a plain einsum GSPMD can partition (batch over the data
+    axes, heads over tp).  ``sharded=False`` (the ``generate`` path) may
+    use the Pallas flash kernel for the prefill chunk instead.  sp and pp
+    are training-side axes with no decode analogue here.
 
     Exactness contract: dense and dense-MoE configs reproduce ``forward()``
     logits position by position to numerical tolerance (the two paths use
@@ -379,7 +404,8 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos):
 
     def body(carry, layer):
         lp, ck, cv = layer
-        out, ck, cv = _block_decode(cfg, carry, lp, ck, cv, positions, pos)
+        out, ck, cv = _block_decode(cfg, carry, lp, ck, cv, positions, pos,
+                                    sharded=sharded)
         return out, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
