@@ -27,7 +27,6 @@ def main():
     import optax
     from tfmesos_tpu import runtime
     from tfmesos_tpu.models import resnet
-    from tfmesos_tpu.parallel.sharding import make_global_batch
     from tfmesos_tpu.train import data as datalib
 
     ctx = runtime.initialize()
@@ -46,13 +45,14 @@ def main():
 
     local_bs = max(1, args.batch_size // max(1, ctx.world_size))
     global_bs = local_bs * max(1, ctx.world_size)  # the batch actually trained
-    gen = datalib.image_batches(local_bs, cfg.image_size, cfg.num_classes,
-                                seed=100 + ctx.rank)
+    gen = datalib.prefetch(
+        datalib.image_batches(local_bs, cfg.image_size, cfg.num_classes,
+                                seed=100 + ctx.rank),
+        mesh=mesh)
     t0 = time.perf_counter()
     metrics = {}
     for i in range(args.steps):
-        batch = make_global_batch(mesh, next(gen))
-        state, metrics = step(state, batch)
+        state, metrics = step(state, next(gen))
         if ctx.is_chief and (i + 1) % 20 == 0:
             print(f"step {i + 1}: loss={float(metrics['loss']):.4f} "
                   f"acc={float(metrics['accuracy']):.3f}", flush=True)

@@ -52,7 +52,7 @@ def main():
     from tfmesos_tpu import runtime
     from tfmesos_tpu.cli import parse_mesh
     from tfmesos_tpu.models import transformer
-    from tfmesos_tpu.parallel.sharding import batch_spec, make_global_batch
+    from tfmesos_tpu.parallel.sharding import batch_spec
     from tfmesos_tpu.train import data as datalib
     from tfmesos_tpu.train.trainer import make_train_step
 
@@ -89,13 +89,13 @@ def main():
 
     local_bs = max(1, args.batch_size // max(1, ctx.world_size))
     global_bs = local_bs * max(1, ctx.world_size)
-    gen = datalib.token_batches(local_bs, seq_len, cfg.vocab_size,
-                                seed=100 + ctx.rank)
+    gen = datalib.prefetch(
+        datalib.token_batches(local_bs, seq_len, cfg.vocab_size,
+                              seed=100 + ctx.rank), mesh=mesh)
     t0 = time.perf_counter()
     metrics = {}
     for i in range(args.steps):
-        batch = make_global_batch(mesh, next(gen))
-        params, opt_state, metrics = step(params, opt_state, batch)
+        params, opt_state, metrics = step(params, opt_state, next(gen))
         if ctx.is_chief and (i + 1) % 10 == 0:
             print(f"step {i + 1}: loss={float(metrics['loss']):.4f} "
                   f"ppl={float(metrics['perplexity']):.2f}", flush=True)

@@ -49,3 +49,35 @@ def test_resume_training_continues(tmp_path):
     p2, o2, m2 = step(p2, o2, next(gen))
     assert np.isfinite(float(m2["loss"]))
     mgr.close()
+
+
+def test_async_save_then_wait(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    try:
+        state = {"w": jnp.arange(8, dtype=jnp.float32), "step": jnp.asarray(3)}
+        mgr.save(3, state, wait=False)
+        mgr.wait_until_finished()
+        restored = mgr.restore(jax.tree_util.tree_map(jnp.zeros_like, state))
+        assert int(restored["step"]) == 3
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(8, dtype=np.float32))
+    finally:
+        mgr.close()
+
+
+def test_prefetch_preserves_order_and_places():
+    from tfmesos_tpu.parallel.mesh import build_mesh
+    from tfmesos_tpu.train.data import prefetch
+
+    mesh = build_mesh({"dp": 8})
+    src = ({"x": np.full((8, 4), i, np.float32)} for i in range(5))
+    out = list(prefetch(src, mesh=mesh, depth=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert float(np.asarray(b["x"])[0, 0]) == i
+        assert not isinstance(b["x"], np.ndarray)  # placed on device

@@ -30,10 +30,23 @@ class CheckpointManager:
                                                  create=True),
         )
 
-    def save(self, step: int, state: Any) -> None:
+    def save(self, step: int, state: Any, wait: bool = True) -> None:
+        """Save ``state`` at ``step``.
+
+        ``wait=False`` returns as soon as the on-device buffers are staged
+        (Orbax writes asynchronously in the background), overlapping
+        checkpoint IO with the next training steps; call
+        :meth:`wait_until_finished` (or ``close``) before reading the
+        checkpoint back or exiting.
+        """
         self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+        log.info("saved checkpoint step=%d at %s%s", step, self.workdir,
+                 "" if wait else " (async)")
+
+    def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
-        log.info("saved checkpoint step=%d at %s", step, self.workdir)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

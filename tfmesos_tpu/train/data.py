@@ -92,3 +92,34 @@ def nmf_matrix(rows: int, cols: int, rank: int, seed: int = 0) -> np.ndarray:
     w = rng.rand(rows, rank).astype(np.float32)
     h = rng.rand(rank, cols).astype(np.float32)
     return w @ h / np.sqrt(rank)
+
+
+def prefetch(batches: Iterator, mesh=None, depth: int = 2,
+             batch_dim: int = 0) -> Iterator:
+    """Overlap host->device transfer with compute.
+
+    Wraps a host-side batch iterator: each batch is placed on the mesh (via
+    :func:`~tfmesos_tpu.parallel.sharding.make_global_batch`, or plain
+    ``device_put`` without a mesh) ``depth`` batches ahead of the consumer,
+    so the copy engine streams the next inputs while the current step runs —
+    the input-pipeline half of the reference's data story, which fed
+    ``sess.run`` feeds synchronously (mnist_replica.py:198-210).
+    """
+    import collections
+
+    import jax
+
+    from tfmesos_tpu.parallel.sharding import make_global_batch
+
+    def place(b):
+        if mesh is None:
+            return jax.tree_util.tree_map(jax.device_put, b)
+        return make_global_batch(mesh, b, batch_dim=batch_dim)
+
+    queue = collections.deque()
+    for batch in batches:
+        queue.append(place(batch))
+        if len(queue) > depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
