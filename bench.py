@@ -252,7 +252,7 @@ def bench_decode(batch=8, prompt_len=128, new_tokens=256):
     return batch * new_tokens / best
 
 
-def bench_bandwidth():
+def bench_bandwidth(sizes=None):
     """Achieved bandwidth vs roofline.
 
     Multi-device: psum sweep (1MB-256MB fp32), algorithmic bytes/s =
@@ -267,7 +267,8 @@ def bench_bandwidth():
 
     kind = _device_kind()
     n = jax.device_count()
-    sizes = [1 << 20, 1 << 23, 1 << 26, 1 << 28]  # bytes: 1MB..256MB
+    if sizes is None:
+        sizes = [1 << 20, 1 << 23, 1 << 26, 1 << 28]  # bytes: 1MB..256MB
     out = {"allreduce_gbps": None, "hbm_gbps": None,
            "ici_roofline_gbps": ICI_GBPS.get(kind),
            "hbm_roofline_gbps": HBM_GBPS.get(kind)}
@@ -301,10 +302,11 @@ def bench_bandwidth():
             algbw = 2 * (n - 1) / n * size / dt
             best_gbps[size] = algbw / 1e9
         out["allreduce_gbps"] = round(max(best_gbps.values()), 2)
-        out["allreduce_sweep"] = {f"{s >> 20}MB": round(g, 2)
+        label = lambda s: f"{s >> 20}MB" if s >= 1 << 20 else f"{s >> 10}KB"
+        out["allreduce_sweep"] = {label(s): round(g, 2)
                                   for s, g in best_gbps.items()}
     else:
-        size = 1 << 28  # 256MB per operand
+        size = sizes[-1]  # largest requested payload (default 256MB)
         elems = size // 4
         a = jnp.ones((elems,), jnp.float32)
         b = jnp.full((elems,), 2.0, jnp.float32)

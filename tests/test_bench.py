@@ -1,0 +1,46 @@
+"""Smoke the benchmark's code paths on the virtual CPU mesh.
+
+The driver runs ``bench.py`` unattended at the end of every round; a crash
+there silently loses the round's benchmark, so the cheap-to-compile paths
+(flops formulas, bandwidth sweep, decode loop, mnist trainer) get
+tiny-shape CI runs.  The two big transformer benches share
+``_bench_transformer_config`` with nothing CI-affordable to add — their
+compile alone outweighs this whole file.  Numbers on CPU are meaningless —
+only "runs and returns finite values" is asserted.
+"""
+
+import numpy as np
+
+import bench
+
+
+def test_flops_formulas():
+    from tfmesos_tpu.models import mlp, transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+        max_seq_len=2048)
+    per_tok = bench.transformer_flops_per_token(cfg, 2048)
+    # ~3x forward of ~2*params-ish: sanity band, not an exact constant.
+    assert 1e8 < per_tok < 1e9
+    assert bench.mlp_flops_per_step(mlp.MLPConfig(hidden=100), 100) == \
+        6 * (784 * 100 + 100 * 10) * 100
+
+
+def test_bandwidth_multi_device_path():
+    out = bench.bench_bandwidth(sizes=[1 << 18])
+    assert out["allreduce_gbps"] is not None and out["allreduce_gbps"] > 0
+    assert out["hbm_gbps"] is None  # n>1: the psum branch ran
+    assert all(v > 0 for v in out["allreduce_sweep"].values())
+
+
+def test_decode_bench_smoke():
+    toks = bench.bench_decode(batch=1, prompt_len=8, new_tokens=4)
+    assert np.isfinite(toks) and toks > 0
+
+
+def test_mnist_bench_smoke():
+    steps, loss, mfu = bench.bench_mnist_replica(steps=40, warmup=20)
+    assert np.isfinite(steps) and steps > 0
+    assert np.isfinite(loss)
+    assert 0 <= mfu < 1
