@@ -306,7 +306,7 @@ def bench_bandwidth(sizes=None):
         out["allreduce_sweep"] = {label(s): round(g, 2)
                                   for s, g in best_gbps.items()}
     else:
-        size = sizes[-1]  # largest requested payload (default 256MB)
+        size = max(sizes)  # largest requested payload (default 256MB)
         elems = size // 4
         a = jnp.ones((elems,), jnp.float32)
         b = jnp.full((elems,), 2.0, jnp.float32)
