@@ -227,7 +227,7 @@ def test_pipeline_composes_with_tp_collectives():
         stages.append({"w1": jax.random.normal(k1, (dim, dim)) / np.sqrt(dim),
                        "w2": jax.random.normal(k2, (dim, dim)) / np.sqrt(dim)})
     stacked = stack_stage_params(stages)
-    x = jax.random.normal(key, (mb * 2, dim))
+    x = jax.random.normal(key, (mb * 4, dim))
 
     # Sequential ground truth on unsharded weights.
     expected = x
@@ -269,3 +269,67 @@ def test_ring_attention_flash_impl_matches_reference(causal):
     for a, e in zip(gr, ge):
         np.testing.assert_allclose(np.asarray(a), np.asarray(e),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_with_aux_matches_sequential():
+    """with_aux: stage scalars are averaged over every chunk execution
+    (chunks x microbatches), matching the sequential per-microbatch mean."""
+    pp, mb, dim = 2, 4, 16
+    mesh = build_mesh({"pp": pp, "dp": 4})
+    key = jax.random.PRNGKey(7)
+
+    def stage_fn(params, h):
+        out = jnp.tanh(h @ params["w"])
+        return out, {"act_mean": jnp.mean(out.astype(jnp.float32))}
+
+    stages = []
+    for _ in range(pp):
+        k1, key = jax.random.split(key)
+        stages.append({"w": jax.random.normal(k1, (dim, dim)) / np.sqrt(dim)})
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(key, (mb * 4, dim))
+
+    # Sequential ground truth, per (chunk, microbatch) execution — the dp
+    # shards each see a quarter of the batch, so replicate that split too.
+    auxes = []
+    for shard in np.split(np.asarray(x), 4):
+        for piece in np.split(shard, mb):
+            h = jnp.asarray(piece)
+            for s in stages:
+                h, aux = stage_fn(s, h)
+                auxes.append(float(aux["act_mean"]))
+    expected_aux = float(np.mean(auxes))
+    expected = x
+    for s in stages:
+        expected = jnp.tanh(expected @ s["w"])
+
+    got, aux = jax.jit(lambda p, x: pipeline_apply(
+        stage_fn, p, x, mesh, num_microbatches=mb,
+        with_aux={"act_mean": 0.0}))(stacked, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux["act_mean"]), expected_aux,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_with_aux_inferred_structure():
+    """with_aux=True (no prototype) infers the aux tree for collective-free
+    stages; single-stage meshes take the sequential shortcut."""
+    mesh = build_mesh({"pp": 2, "dp": 4})
+    stacked = stack_stage_params(
+        [{"w": jnp.eye(8)} for _ in range(2)])
+    x = jnp.ones((8, 8))
+
+    def stage_fn(p, h):
+        return h @ p["w"], {"norm": jnp.sum(h.astype(jnp.float32) ** 2)}
+
+    out, aux = jax.jit(lambda p, x: pipeline_apply(
+        stage_fn, p, x, mesh, with_aux=True))(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    # every microbatch is all-ones [1, 8]: sum of squares = 8 everywhere
+    np.testing.assert_allclose(float(aux["norm"]), 8.0, rtol=1e-6)
+
+    mesh1 = build_mesh({"pp": 1, "dp": 8})
+    out1, aux1 = pipeline_apply(stage_fn, stacked, x, mesh1, with_aux=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(x))
+    np.testing.assert_allclose(float(aux1["norm"]), 64.0, rtol=1e-6)

@@ -139,7 +139,7 @@ def _moe_switch(cfg: TransformerConfig, mesh, lp, h):
     return out.reshape(b, t, d), aux
 
 
-def _moe(cfg: TransformerConfig, lp, h):
+def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None):
     """Top-k routed MoE, computed densely over the expert axis.
 
     Every expert processes every token and the router mask zeroes the
@@ -147,6 +147,12 @@ def _moe(cfg: TransformerConfig, lp, h):
     cleanly over ``ep``.  (A dispatch/all_to_all data path that skips the
     masked compute is the standard optimization; this dense form trades
     FLOPs for simplicity and zero token overflow.)  Returns (out, aux).
+
+    ``ep_axis`` enables the manual-collective form for pipeline stages:
+    expert weights arrive as local ``ep`` shards, the (replicated) router
+    picks over all E experts, each device computes only its local experts'
+    slice of the masked einsum and the partials ``psum`` over ``ep`` —
+    bitwise the same math as the GSPMD path.
     """
     e = cfg.n_experts
     logits = (h @ lp["router"].astype(cfg.dtype)).astype(jnp.float32)  # [B,T,E]
@@ -155,10 +161,16 @@ def _moe(cfg: TransformerConfig, lp, h):
     # mask[b,t,e] = gate weight if e is among the top-k for (b,t), else 0
     onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
     mask = (onehot * gates[..., None]).sum(axis=-2)
+    if ep_axis is not None:
+        e_loc = lp["e_gate"].shape[0]
+        idx = jax.lax.axis_index(ep_axis)
+        mask = jax.lax.dynamic_slice_in_dim(mask, idx * e_loc, e_loc, axis=-1)
     g = jax.nn.silu(jnp.einsum("btd,edf->btef", h, lp["e_gate"].astype(cfg.dtype)))
     u = jnp.einsum("btd,edf->btef", h, lp["e_up"].astype(cfg.dtype))
     y = jnp.einsum("btef,efd->bted", g * u, lp["e_down"].astype(cfg.dtype))
     out = jnp.einsum("bted,bte->btd", y, mask.astype(cfg.dtype))
+    if ep_axis is not None:
+        out = jax.lax.psum(out, ep_axis)
     probs = jax.nn.softmax(logits, axis=-1)
     f = jnp.sum(onehot, axis=(0, 1, 2)) / (onehot.shape[0] * onehot.shape[1]
                                            * cfg.top_k)
@@ -171,11 +183,25 @@ def _moe(cfg: TransformerConfig, lp, h):
     return out, aux
 
 
-def _ffn(cfg: TransformerConfig, mesh, lp, h):
+def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None):
     """The block's feed-forward dispatch (dense / switch / dense-MoE) —
-    shared by the train and decode paths so they cannot drift."""
+    shared by the train and decode paths so they cannot drift.
+
+    ``ep_axis`` selects the manual-collective MoE forms for use inside a
+    pipeline stage's shard_map body (tokens ep-replicated, expert weights
+    ep-sharded, outputs psum'd)."""
     if not cfg.n_experts:
         return _mlp(cfg, lp, h), _zero_aux()
+    if ep_axis is not None:
+        if cfg.moe_impl == "switch":
+            from tfmesos_tpu.parallel.moe import switch_moe_replicated_local
+            b, t, d = h.shape
+            out, aux = switch_moe_replicated_local(
+                h.reshape(b * t, d), lp["router"].astype(cfg.dtype),
+                lp["e_gate"], lp["e_up"], lp["e_down"], ep_axis=ep_axis,
+                capacity_factor=cfg.capacity_factor, top_k=cfg.top_k)
+            return out.reshape(b, t, d), aux
+        return _moe(cfg, lp, h, ep_axis=ep_axis)
     if cfg.moe_impl == "switch":
         # Same model function with or without a mesh (switch_moe falls back
         # to its single-device reference when the ep axis is absent).
@@ -208,7 +234,8 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     return x + jax.lax.psum(ffn, tp_axis)
 
 
-def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions):
+def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
+           ep_axis: Optional[str] = None):
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
@@ -219,7 +246,7 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions):
     o = attend(q, k, v, mesh=mesh, causal=True)
     x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
-    ffn, aux = _ffn(cfg, mesh, lp, h)
+    ffn, aux = _ffn(cfg, mesh, lp, h, ep_axis=ep_axis)
     return x + ffn, aux
 
 
@@ -256,9 +283,9 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
 
         # Stages compose with tp via MANUAL collectives (weights sharded
         # over tp, one psum per row-parallel matmul) — nested shard_map is
-        # not allowed inside the pipeline's own shard_map.  Router aux is
-        # not threaded through the pipeline (it would ride the bubble); use
-        # the non-pp path when training with aux losses.
+        # not allowed inside the pipeline's own shard_map.
+        ep = mesh.shape.get("ep", 1)
+        ep_axis = "ep" if (cfg.n_experts and ep > 1) else None
         if tp > 1:
             if cfg.n_experts:
                 raise ValueError("pp x tp with experts is not supported; "
@@ -274,25 +301,49 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
                 "w_down": P(None, "tp", None),
             }
         else:
-            stage_block = lambda c, lp_, pos: _block(cfg, None, c, lp_, pos)
+            stage_block = lambda c, lp_, pos: _block(cfg, None, c, lp_, pos,
+                                                     ep_axis=ep_axis)
+            # Expert weights shard over ep inside the stage (the router
+            # stays replicated so every device routes over all E experts).
             partition = None
+            if ep_axis:
+                partition = {
+                    "attn_norm": P(None, None), "mlp_norm": P(None, None),
+                    "wq": P(None, None, None), "wk": P(None, None, None),
+                    "wv": P(None, None, None), "wo": P(None, None, None),
+                    "router": P(None, None, None),
+                    "e_gate": P(None, "ep", None, None),
+                    "e_up": P(None, "ep", None, None),
+                    "e_down": P(None, "ep", None, None),
+                }
         if cfg.remat:
             stage_block = jax.checkpoint(stage_block)
+
+        # Router aux rides the pipeline when experts are on: stages return
+        # per-chunk aux means and pipeline_apply averages them over chunks
+        # x microbatches (the grad-accumulation estimator of the non-pp
+        # batch statistics).
+        with_aux = _zero_aux() if cfg.n_experts else False
 
         def stage_fn(stage_params, h):
             pos = jnp.broadcast_to(jnp.arange(h.shape[1], dtype=jnp.int32),
                                    h.shape[:2])
 
             def body(carry, lp):
-                out, _ = stage_block(carry, lp, pos)
-                return out, None
-            out, _ = jax.lax.scan(body, h, stage_params)
-            return out
+                out, layer_aux = stage_block(carry, lp, pos)
+                return out, layer_aux
+            out, stacked_aux = jax.lax.scan(body, h, stage_params)
+            if with_aux is False:
+                return out
+            return out, jax.tree_util.tree_map(jnp.mean, stacked_aux)
 
         x = pipeline_apply(stage_fn, stacked, x, mesh,
                            param_partition=partition,
                            schedule=cfg.pp_schedule,
-                           virtual_stages=cfg.pp_virtual_stages)
+                           virtual_stages=cfg.pp_virtual_stages,
+                           with_aux=with_aux)
+        if with_aux is not False:
+            x, aux = x
     else:
         def body(carry, lp):
             out, layer_aux = block(carry, lp, positions)
@@ -465,17 +516,10 @@ def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
     logits, aux = forward(cfg, params, tokens[:, :-1], mesh, return_aux=True)
     loss = cross_entropy_loss(logits, tokens[:, 1:])
     metrics = {"perplexity": jnp.exp(loss)}
-    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
-    if cfg.n_experts and pp > 1:
-        # aux is not threaded through the pipeline: zeros here are absence,
-        # not balance.  Refuse to train as if they were real rather than
-        # silently skip load balancing and report perfect metrics.
-        if cfg.router_aux_weight or cfg.router_z_weight:
-            raise ValueError(
-                "router aux losses are not available under pipeline "
-                "parallelism; train MoE without pp, or set "
-                "router_aux_weight=router_z_weight=0 to opt out")
-    elif cfg.n_experts:
+    if cfg.n_experts:
+        # Under pp the aux rides the pipeline per microbatch (gpipe-style
+        # estimator of the full-batch statistics); without pp it is the
+        # exact batch statistic.  Either way it joins the objective.
         loss = (loss
                 + cfg.router_aux_weight * aux["load_balance_loss"]
                 + cfg.router_z_weight * aux["z_loss"])

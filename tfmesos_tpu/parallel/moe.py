@@ -151,6 +151,41 @@ def switch_moe_local(x, router_w, w_gate, w_up, w_down, axis: str = "ep",
     return combined.astype(x.dtype), aux
 
 
+def switch_moe_replicated_local(x, router_w, w_gate, w_up, w_down,
+                                ep_axis: str = None,
+                                capacity_factor: float = 1.25,
+                                top_k: int = 1):
+    """Capacity MoE for ep-REPLICATED tokens (the pipeline-stage layout).
+
+    Inside ``pipeline_apply`` activations replicate over ``ep`` while the
+    expert weights shard over it, so no all_to_all is needed: every device
+    already holds every token, computes the capacity slots of its LOCAL
+    experts only, and the partial outputs ``psum`` over ``ep``.  Same
+    routing semantics as ``switch_moe_local`` (slot priority, capacity
+    drops, gate weighting); the router weight must be replicated so every
+    device sees the full [n, E] logits.  ``ep_axis=None`` runs all experts
+    locally (pp without ep).  Returns (out, aux); aux is identical across
+    the ep group by construction.
+    """
+    if not ep_axis:
+        return switch_moe_reference(x, router_w, w_gate, w_up, w_down,
+                                    capacity_factor, top_k=top_k,
+                                    return_aux=True)
+    n, d = x.shape
+    e_loc = w_gate.shape[0]
+    e = e_loc * jax.lax.axis_size(ep_axis)
+    capacity = _capacity(n, e, capacity_factor, top_k)
+    combine, aux = _routing(x, router_w, e, capacity, top_k)  # [n, E, C]
+    idx = jax.lax.axis_index(ep_axis)
+    combine = jax.lax.dynamic_slice_in_dim(combine, idx * e_loc, e_loc,
+                                           axis=1)           # [n, e_loc, C]
+    dispatch = (combine > 0.0).astype(jnp.float32)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x.astype(jnp.float32))
+    expert_out = _expert_ffn(expert_in, w_gate, w_up, w_down, x.dtype)
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out.astype(jnp.float32))
+    return jax.lax.psum(out, ep_axis).astype(x.dtype), aux
+
+
 def switch_moe(x, router_w, w_gate, w_up, w_down, mesh: Mesh,
                axis: str = "ep", capacity_factor: float = 1.25,
                top_k: int = 1, return_aux: bool = False):

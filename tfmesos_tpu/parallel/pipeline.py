@@ -51,7 +51,8 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
                    x, mesh: Mesh, axis: str = "pp",
                    num_microbatches: Optional[int] = None,
                    param_partition: Optional[Any] = None,
-                   schedule: str = "gpipe", virtual_stages: int = 1):
+                   schedule: str = "gpipe", virtual_stages: int = 1,
+                   with_aux: bool = False):
     """Run ``x`` through the stage pipeline; returns the final activations.
 
     ``stage_fn(params, h) -> h`` applies ONE stage chunk (same activation
@@ -62,6 +63,19 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
     ``[B, ...]``, split into microbatches along B.  ``param_partition``
     (optional) is a pytree of PartitionSpecs for each leaf's NON-leading
     dims, e.g. ``P("tp", None)`` to column-shard a weight over tp.
+
+    ``with_aux`` (default off) changes the stage contract to
+    ``stage_fn(params, h) -> (h, aux)`` where ``aux`` is a pytree of fp32
+    scalars (e.g. router-health metrics); the call then returns
+    ``(out, aux_mean)`` with each scalar averaged over every chunk
+    execution — all chunks × all microbatches × the data shards — i.e. the
+    microbatched analogue of the non-pp path's mean-over-layers-and-batch.
+    (Statistics that are nonlinear in the batch, like the load-balance
+    loss's fraction·probability product, are computed per microbatch and
+    averaged — the same estimator gradient accumulation uses.)  Pass the
+    aux pytree's *structure* (any pytree, values ignored) as ``with_aux``;
+    ``with_aux=True`` infers it by abstractly evaluating ``stage_fn``,
+    which only works for stage bodies free of manual collectives.
     """
     n_stages = mesh.shape[axis]
     if schedule not in ("gpipe", "circular"):
@@ -70,19 +84,30 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
         # Silently running gpipe over pp*v chunks would apply only the
         # first chunk on each device — wrong loss, no error.
         raise ValueError("virtual_stages > 1 requires schedule='circular'")
+    aux_proto = None
+    if with_aux is not False and with_aux is not True:
+        aux_proto, with_aux = with_aux, True
     v = virtual_stages if schedule == "circular" else 1
     if n_stages == 1:
         n_chunks = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
         def chunk(i):
             return jax.tree_util.tree_map(lambda p: p[i], stacked_params)
         h = x
+        if not with_aux:
+            for i in range(n_chunks):
+                h = stage_fn(chunk(i), h)
+            return h
+        auxes = []
         for i in range(n_chunks):
-            h = stage_fn(chunk(i), h)
-        return h
+            h, aux = stage_fn(chunk(i), h)
+            auxes.append(aux)
+        return h, jax.tree_util.tree_map(
+            lambda *xs: jnp.mean(jnp.stack(xs), axis=0), *auxes)
     m = num_microbatches or n_stages
     d_axes = data_axes(mesh)
+    d_axis_names = d_axes or ()
     dp_size = 1
-    for a in (d_axes or ()):
+    for a in d_axis_names:
         dp_size *= mesh.shape[a]
     if x.shape[0] % (m * dp_size):
         raise ValueError(f"batch {x.shape[0]} not divisible into {m} "
@@ -114,8 +139,12 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
                                                        keepdims=False),
                 params)
 
+        def run_stage(lap, h):
+            out = stage_fn(chunk_params(lap), h)
+            return out if with_aux else (out, {})
+
         def tick(t, carry):
-            received, outputs = carry
+            received, outputs, aux_acc = carry
             u = t - stage
             r = jnp.where(u >= 0, u % n_stages, 0)
             w = u - r
@@ -125,7 +154,12 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
             inject = jax.lax.dynamic_index_in_dim(
                 micro, jnp.clip(mb, 0, m - 1), 0, keepdims=False)
             h = jnp.where((stage == 0) & (lap == 0), inject, received)
-            out = stage_fn(chunk_params(lap), h)
+            out, aux = run_stage(lap, h)
+            # Inactive ticks run the stage on garbage; their aux is masked
+            # out (the activation path needs no mask — inactive outputs are
+            # never emitted and get overwritten as they ride the ring).
+            aux_acc = jax.tree_util.tree_map(
+                lambda acc, a: acc + jnp.where(active, a, 0.0), aux_acc, aux)
             emit = active & (stage == n_stages - 1) & (lap == v - 1)
             out_idx = jnp.clip(mb, 0, m - 1)
             outputs = jax.lax.dynamic_update_index_in_dim(
@@ -135,18 +169,35 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
                                                        keepdims=False)),
                 out_idx, 0)
             received = ppermute_shift(out, axis, 1)
-            return received, outputs
+            return received, outputs, aux_acc
 
         outputs0 = jnp.zeros((m,) + mb_shape, xs.dtype)
         received0 = jnp.zeros(mb_shape, xs.dtype)
-        _, outputs = jax.lax.fori_loop(0, m * v + n_stages - 1, tick,
-                                       (received0, outputs0))
+        aux0 = jax.tree_util.tree_map(
+            lambda _: jnp.zeros((), jnp.float32),
+            aux_proto if with_aux else {})
+        _, outputs, aux_acc = jax.lax.fori_loop(
+            0, m * v + n_stages - 1, tick, (received0, outputs0, aux0))
         # Results live on the last stage; broadcast them to every stage so
         # the caller sees a pp-replicated output.
         outputs = jax.lax.psum(
             jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
             axis_name=axis)
-        return outputs.reshape(b_loc, *xs.shape[1:])
+        out = outputs.reshape(b_loc, *xs.shape[1:])
+        if not with_aux:
+            return out
+        # Mean over every chunk execution: each of the m microbatches runs
+        # each of the n_stages*v chunks exactly once, spread over pp.
+        aux_mean = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, axis) / (m * n_stages * v), aux_acc)
+        # Average over the data shards (each ring works its own batch
+        # shard); any remaining axis (tp/ep) already holds identical values
+        # — stage bodies pmean/psum their collectives internally — so the
+        # replicated out_spec is sound.
+        if d_axis_names:
+            aux_mean = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, d_axis_names), aux_mean)
+        return out, aux_mean
 
     if param_partition is None:
         param_specs = jax.tree_util.tree_map(
@@ -157,7 +208,19 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
     # Activations shard over the data axes (each pipeline ring works on its
     # batch shard) and replicate over pp/tp, where the ring/psum handle them.
     x_spec = P(data_axes(mesh), *([None] * (x.ndim - 1)))
+    if with_aux:
+        if aux_proto is None:
+            # Infer the aux structure abstractly (collective-free stages
+            # only — pass the structure explicitly otherwise).
+            aux_proto = jax.eval_shape(
+                lambda p, h: stage_fn(
+                    jax.tree_util.tree_map(lambda q: q[0], p), h)[1],
+                stacked_params, jnp.zeros((x.shape[0] // (m * dp_size),)
+                                          + x.shape[1:], x.dtype))
+        out_specs = (x_spec, jax.tree_util.tree_map(lambda _: P(), aux_proto))
+    else:
+        out_specs = x_spec
     fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(param_specs, x_spec), out_specs=x_spec,
+                       in_specs=(param_specs, x_spec), out_specs=out_specs,
                        check_vma=False)
     return fn(stacked_params, x)
