@@ -74,7 +74,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "per attempt). Pair with workload checkpoints for "
                         "resume. Default 0 = fail fast like the reference")
     p.add_argument("--mesh", type=str, default=None,
-                   help="explicit mesh axes, e.g. dp=4,tp=2")
+                   help="explicit mesh axes, e.g. dp=4,tp=2; prefix an axis "
+                        "with dcn. to span pod slices over the data-center "
+                        "network, e.g. dcn.dp=2,dp=2,tp=4")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to run on every task (placeholders: "
                         "{ps_hosts} {worker_hosts} {job_name} {task_index} "

@@ -1,3 +1,4 @@
-from tfmesos_tpu.parallel.mesh import MeshSpec, build_mesh, mesh_from_jobs
+from tfmesos_tpu.parallel.mesh import (MeshSpec, build_hybrid_mesh,
+                                       build_mesh, mesh_from_jobs)
 
-__all__ = ["MeshSpec", "build_mesh", "mesh_from_jobs"]
+__all__ = ["MeshSpec", "build_hybrid_mesh", "build_mesh", "mesh_from_jobs"]
