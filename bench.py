@@ -211,11 +211,15 @@ def bench_transformer_dense():
         b=4, t=2048, k=4)
 
 
-def bench_decode(batch=8, prompt_len=128, new_tokens=256):
+def bench_decode(batch=8, prompt_len=128, new_tokens=256, quantized=False):
     """Steady-state decode throughput on the flagship config (KV cache,
     greedy): generated tokens per second across the batch.  The prompt is
     prefilled OUTSIDE the timed region — only the per-token scan is timed,
-    so the metric stays comparable if the prompt/new-token ratio changes."""
+    so the metric stays comparable if the prompt/new-token ratio changes.
+
+    ``quantized=True`` serves weight-only int8 params (per-row absmax,
+    ``transformer.quantize_params``): t=1 decode is weight-bandwidth-bound,
+    so halving the streamed bytes is the serving-side headline."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -225,6 +229,9 @@ def bench_decode(batch=8, prompt_len=128, new_tokens=256):
         vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
         max_seq_len=prompt_len + new_tokens, dtype=jnp.bfloat16)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    if quantized:
+        params = jax.jit(
+            lambda p: transformer.quantize_params(cfg, p))(params)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, cfg.vocab_size, dtype=jnp.int32)
     cache0 = transformer.init_cache(cfg, batch, prompt_len + new_tokens)
@@ -390,6 +397,10 @@ def main():
     dec = attempts(bench_decode, "decode bench", n=1)
     if dec:
         out["decode_tokens_per_sec"] = round(max(dec), 1)
+    dec8 = attempts(lambda: bench_decode(quantized=True),
+                    "int8 decode bench", n=1)
+    if dec8:
+        out["decode_int8_tokens_per_sec"] = round(max(dec8), 1)
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:
         out.update(bw[0])

@@ -27,6 +27,9 @@ def main():
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tiny", action="store_true")
+    p.add_argument("--int8", action="store_true",
+                   help="serve weight-only int8 params "
+                        "(transformer.quantize_params)")
     args = p.parse_args()
 
     import jax
@@ -46,6 +49,9 @@ def main():
             max_seq_len=args.prompt_len + args.new_tokens,
             dtype=jnp.bfloat16)
     params = transformer.init_params(cfg, jax.random.PRNGKey(args.seed))
+    if args.int8:
+        params = jax.jit(
+            lambda p_: transformer.quantize_params(cfg, p_))(params)
     prompt = jax.random.randint(
         jax.random.PRNGKey(args.seed + 1), (args.batch, args.prompt_len), 0,
         cfg.vocab_size, dtype=jnp.int32)

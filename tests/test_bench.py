@@ -44,3 +44,9 @@ def test_mnist_bench_smoke():
     assert np.isfinite(steps) and steps > 0
     assert np.isfinite(loss)
     assert 0 <= mfu < 1
+
+
+def test_decode_bench_int8_smoke():
+    toks = bench.bench_decode(batch=1, prompt_len=8, new_tokens=4,
+                              quantized=True)
+    assert np.isfinite(toks) and toks > 0

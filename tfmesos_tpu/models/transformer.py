@@ -30,6 +30,25 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tfmesos_tpu.ops.attention import attend, mha_reference
 from tfmesos_tpu.ops.layers import cross_entropy_loss, rms_norm, rope, swiglu
+from tfmesos_tpu.ops.quant import QTensor, quantize_tensor
+
+
+def _wt(p, dtype):
+    """Weight-on-use: dequantize an int8 :class:`QTensor` (the convert+scale
+    fuses into the consuming matmul — HBM streams int8) or cast a plain
+    array to the compute dtype."""
+    if isinstance(p, QTensor):
+        return p.dequantize(dtype)
+    return p.astype(dtype)
+
+
+def _embed_lookup(p, tokens, dtype):
+    """Embedding gather for plain or quantized tables: gather int8 rows and
+    their scales, then dequantize only the gathered rows."""
+    if isinstance(p, QTensor):
+        return (p.values[tokens].astype(dtype)
+                * p.scales[tokens].astype(dtype))
+    return p.astype(dtype)[tokens]
 
 
 @dataclass(frozen=True)
@@ -105,9 +124,48 @@ def init_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
     }
 
 
+#: weight leaves worth quantizing — the big matmul operands.  Norms are
+#: tiny and precision-critical; the router is tiny and decides routing.
+_QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+     "e_gate", "e_up", "e_down"})
+
+
+def _quantizable(cfg: TransformerConfig, key: str) -> bool:
+    """Which layer leaves quantize_params converts.  Switch-MoE expert
+    weights stay fp: the capacity-dispatch path (parallel/moe.py) consumes
+    raw arrays inside shard_map bodies, and re-plumbing QTensors through
+    its all_to_all hops buys little — switch decode is dominated by the
+    dense trunk it shares with everything else."""
+    if cfg.moe_impl == "switch" and key.startswith("e_"):
+        return False
+    return key in _QUANT_KEYS
+
+
+def quantize_params(cfg: TransformerConfig, params) -> Dict[str, Any]:
+    """Weight-only int8 quantization (per-row absmax, ``ops/quant.py``).
+
+    Returns a params tree where the embedding table, unembedding head, and
+    every per-layer projection/FFN/expert weight are :class:`QTensor`s;
+    norms and the router stay fp32.  The tree drops into ``forward``,
+    ``decode_step`` and ``generate`` unchanged — weights dequantize at the
+    consuming matmul, so HBM streams int8.  That is the serving win:
+    steady-state decode at t=1 is weight-bandwidth-bound, and int8 halves
+    the bytes per step vs bf16 (~4x vs these fp32 master params).
+    """
+    layers = {k: (quantize_tensor(v) if _quantizable(cfg, k) else v)
+              for k, v in params["layers"].items()}
+    return {
+        "embed": quantize_tensor(params["embed"]),
+        "layers": layers,
+        "norm_f": params["norm_f"],
+        "head": quantize_tensor(params["head"]),
+    }
+
+
 def _mlp(cfg: TransformerConfig, lp, h):
-    return swiglu(h, lp["w_gate"].astype(cfg.dtype),
-                  lp["w_up"].astype(cfg.dtype), lp["w_down"].astype(cfg.dtype))
+    return swiglu(h, _wt(lp["w_gate"], cfg.dtype),
+                  _wt(lp["w_up"], cfg.dtype), _wt(lp["w_down"], cfg.dtype))
 
 
 def _zero_aux():
@@ -162,12 +220,13 @@ def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None):
     onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
     mask = (onehot * gates[..., None]).sum(axis=-2)
     if ep_axis is not None:
-        e_loc = lp["e_gate"].shape[0]
+        eg = lp["e_gate"]
+        e_loc = (eg.values if isinstance(eg, QTensor) else eg).shape[0]
         idx = jax.lax.axis_index(ep_axis)
         mask = jax.lax.dynamic_slice_in_dim(mask, idx * e_loc, e_loc, axis=-1)
-    g = jax.nn.silu(jnp.einsum("btd,edf->btef", h, lp["e_gate"].astype(cfg.dtype)))
-    u = jnp.einsum("btd,edf->btef", h, lp["e_up"].astype(cfg.dtype))
-    y = jnp.einsum("btef,efd->bted", g * u, lp["e_down"].astype(cfg.dtype))
+    g = jax.nn.silu(jnp.einsum("btd,edf->btef", h, _wt(lp["e_gate"], cfg.dtype)))
+    u = jnp.einsum("btd,edf->btef", h, _wt(lp["e_up"], cfg.dtype))
+    y = jnp.einsum("btef,efd->bted", g * u, _wt(lp["e_down"], cfg.dtype))
     out = jnp.einsum("bted,bte->btd", y, mask.astype(cfg.dtype))
     if ep_axis is not None:
         out = jax.lax.psum(out, ep_axis)
@@ -221,13 +280,13 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     heads_loc = cfg.n_heads // tp
     b, t, _ = x.shape
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
-    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
-    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
-    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
+    q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
+    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
+    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     o = attend(q, k, v, mesh=None, causal=True)  # local heads
-    x = x + jax.lax.psum(o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype),
+    x = x + jax.lax.psum(o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype),
                          tp_axis)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn = _mlp(cfg, lp, h)                        # local d_ff shard
@@ -238,13 +297,13 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
            ep_axis: Optional[str] = None):
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
-    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     o = attend(q, k, v, mesh=mesh, causal=True)
-    x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
+    x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, aux = _ffn(cfg, mesh, lp, h, ep_axis=ep_axis)
     return x + ffn, aux
@@ -260,7 +319,7 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
     rope positions follow the global index.
     """
     b, t = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _embed_lookup(params["embed"], tokens, cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
 
     block = lambda x_, lp_, pos: _block(cfg, mesh, x_, lp_, pos)
@@ -352,7 +411,7 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
         aux = jax.tree_util.tree_map(jnp.mean, stacked_aux)
 
     x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
-    logits = x @ params["head"].astype(cfg.dtype)
+    logits = x @ _wt(params["head"], cfg.dtype)
     return (logits, aux) if return_aux else logits
 
 
@@ -392,12 +451,12 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     b, t, _ = x.shape
     m = ck.shape[1]
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
-    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
-                                                 cfg.head_dim)
-    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
-                                                 cfg.head_dim)
-    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
-                                                 cfg.head_dim)
+    q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                               cfg.head_dim)
+    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                               cfg.head_dim)
+    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                               cfg.head_dim)
     pos_row = jnp.broadcast_to(positions, (b, t))
     q = rope(q, pos_row, cfg.rope_theta)
     k = rope(k, pos_row, cfg.rope_theta)
@@ -418,7 +477,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
         s = jnp.where((kpos > positions[:, None])[None, None], -jnp.inf, s)
         probs = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
         o = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
-    x = x + o.reshape(b, t, -1) @ lp["wo"].astype(cfg.dtype)
+    x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, _ = _ffn(cfg, None, lp, h)
     return x + ffn, ck, cv
@@ -450,7 +509,7 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     than the mismatch.
     """
     t = tokens.shape[1]
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _embed_lookup(params["embed"], tokens, cfg.dtype)
     positions = pos + jnp.arange(t, dtype=jnp.int32)
 
     def body(carry, layer):
@@ -462,7 +521,7 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
-    logits = x @ params["head"].astype(cfg.dtype)
+    logits = x @ _wt(params["head"], cfg.dtype)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -575,3 +634,28 @@ def partition_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
     return jax.tree_util.tree_map(
         lambda s: _filter_spec(s, mesh), tree,
         is_leaf=lambda s: isinstance(s, P))
+
+
+def quantized_partition_specs(cfg: TransformerConfig, mesh: Mesh
+                              ) -> Dict[str, Any]:
+    """``partition_specs`` for a ``quantize_params`` tree: each quantized
+    leaf becomes a QTensor of specs — ``values`` takes the weight's spec,
+    ``scales`` the same minus the last dim (their trailing dim is 1, which
+    cannot shard).  Place qparams with this and multi-chip sharded decode
+    works exactly as with fp params (``decode_step(..., sharded=True)``).
+    """
+    specs = partition_specs(cfg, mesh)
+
+    def wrap(s):
+        parts = tuple(s)
+        scales = P(*(parts[:-1] + (None,))) if parts else P()
+        return QTensor(values=s, scales=scales)
+
+    layers = {k: (wrap(v) if _quantizable(cfg, k) else v)
+              for k, v in specs["layers"].items()}
+    return {
+        "embed": wrap(specs["embed"]),
+        "layers": layers,
+        "norm_f": specs["norm_f"],
+        "head": wrap(specs["head"]),
+    }

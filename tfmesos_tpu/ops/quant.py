@@ -10,7 +10,7 @@ communication-bound tensors (e.g. cross-DCN gradient exchange).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +72,33 @@ def _row_block(rows: int, cols: int, budget_elems: int = 512 * 1024):
         if rows % candidate == 0:
             return candidate
     return None
+
+
+class QTensor(NamedTuple):
+    """A weight stored as int8 values with fp32 scales over the last dim's
+    rows (``w ≈ values * scales``).  A NamedTuple, so it is a pytree —
+    QTensors travel through jit/scan/checkpoint like any array pair, and
+    model code can branch on ``isinstance`` at trace time."""
+
+    values: jax.Array  # int8, same shape as the original weight
+    scales: jax.Array  # fp32, original shape with the last dim = 1
+
+    def dequantize(self, dtype=jnp.float32):
+        """Materialize the approximated weight.  Under jit the convert+scale
+        fuses into the consuming matmul, so int8 (not fp) is what HBM
+        streams — the whole point for bandwidth-bound decode."""
+        return (self.values.astype(dtype)
+                * self.scales.astype(dtype))
+
+
+def quantize_tensor(w, stochastic: bool = False, seed: int = 0) -> QTensor:
+    """Quantize an N-D weight to a :class:`QTensor` (per-row absmax over the
+    last dim, rows = all leading dims flattened)."""
+    shape = w.shape
+    values, scales = quantize_int8(w.reshape(-1, shape[-1]),
+                                   stochastic=stochastic, seed=seed)
+    return QTensor(values.reshape(shape),
+                   scales.reshape(shape[:-1] + (1,)))
 
 
 def quantize_int8(x, stochastic: bool = False, seed: int = 0,
