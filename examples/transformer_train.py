@@ -42,6 +42,11 @@ def main():
     p.add_argument("--virtual-stages", type=int, default=1,
                    dest="virtual_stages",
                    help="interleaved chunks per pp device (circular only)")
+    p.add_argument("--data", type=str, default=None,
+                   help="path to a flat token file (TokenFileDataset "
+                        "format); default: the synthetic bigram stream")
+    p.add_argument("--data-dtype", type=str, default="uint16",
+                   dest="data_dtype", choices=["uint16", "uint32"])
     p.add_argument("--tiny", action="store_true")
     args = p.parse_args()
 
@@ -89,9 +94,23 @@ def main():
 
     local_bs = max(1, args.batch_size // max(1, ctx.world_size))
     global_bs = local_bs * max(1, ctx.world_size)
-    gen = datalib.prefetch(
-        datalib.token_batches(local_bs, seq_len, cfg.vocab_size,
-                              seed=100 + ctx.rank), mesh=mesh)
+    if args.data:
+        ds = datalib.TokenFileDataset(args.data, dtype=args.data_dtype)
+        # One full scan at startup: ids beyond the model's vocab would be
+        # silently clamped by the embedding gather on TPU — corrupt
+        # training with a plausible loss curve.  Fail loudly instead.
+        top = int(ds.tokens.max())
+        if top >= cfg.vocab_size:
+            raise SystemExit(
+                f"{args.data}: token id {top} >= model vocab "
+                f"{cfg.vocab_size}; re-tokenize or adjust the config")
+        stream = ds.batches(local_bs, seq_len, rank=ctx.rank,
+                            world_size=max(1, ctx.world_size),
+                            seed=100 + ctx.rank)
+    else:
+        stream = datalib.token_batches(local_bs, seq_len, cfg.vocab_size,
+                                       seed=100 + ctx.rank)
+    gen = datalib.prefetch(stream, mesh=mesh)
     t0 = time.perf_counter()
     metrics = {}
     for i in range(args.steps):

@@ -1,0 +1,71 @@
+"""TokenFileDataset: memmap format, windowing, and stripe sharding."""
+
+import numpy as np
+import pytest
+
+from tfmesos_tpu.train.data import TokenFileDataset
+
+
+def _write(tmp_path, n=4096, vocab=1000, dtype="uint16"):
+    path = str(tmp_path / "tokens.bin")
+    tokens = np.random.RandomState(0).randint(0, vocab, size=n)
+    TokenFileDataset.write(path, tokens, dtype=dtype)
+    return path, tokens
+
+
+def test_roundtrip_and_window_contents(tmp_path):
+    path, tokens = _write(tmp_path)
+    ds = TokenFileDataset(path)
+    batch = next(ds.batches(4, 16, seed=7))
+    assert batch["tokens"].shape == (4, 17)
+    assert batch["tokens"].dtype == np.int32
+    # every window is a verbatim slice of the file
+    flat = tokens.astype(np.int32)
+    for row in batch["tokens"]:
+        starts = np.flatnonzero(flat[:-16] == row[0])
+        assert any(np.array_equal(flat[s:s + 17], row) for s in starts)
+
+
+def test_determinism_and_dtype_uint32(tmp_path):
+    path = str(tmp_path / "big.bin")
+    tokens = np.arange(65000, 66000)  # crosses the uint16 boundary
+    TokenFileDataset.write(path, tokens, dtype="uint32")
+    ds = TokenFileDataset(path, dtype="uint32")
+    a = next(ds.batches(2, 8, seed=3))["tokens"]
+    b = next(ds.batches(2, 8, seed=3))["tokens"]
+    np.testing.assert_array_equal(a, b)
+    assert a.max() >= 65536  # uint32 values survive the roundtrip
+    # consecutive windows really are consecutive ints from the file
+    assert np.all(np.diff(a, axis=1) == 1)
+
+
+def test_rank_stripes_are_disjoint(tmp_path):
+    path, tokens = _write(tmp_path, n=1000)
+    ds = TokenFileDataset(path)
+    n = tokens.size
+    seen = []
+    for rank in range(4):
+        batch = next(ds.batches(8, 16, rank=rank, world_size=4, seed=rank))
+        lo, hi = n * rank // 4, n * (rank + 1) // 4
+        # locate each window's start in the rank's stripe
+        flat = tokens.astype(np.int32)
+        for row in batch["tokens"]:
+            matches = [s for s in range(lo, hi - 16)
+                       if np.array_equal(flat[s:s + 17], row)]
+            assert matches, f"rank {rank} window not from its stripe"
+        seen.append((lo, hi))
+    assert seen == sorted(seen) and all(a[1] <= b[0] for a, b in
+                                        zip(seen, seen[1:]))
+
+
+def test_errors(tmp_path):
+    path, _ = _write(tmp_path, n=64)
+    ds = TokenFileDataset(path)
+    with pytest.raises(ValueError, match="stripe"):
+        next(ds.batches(1, 63, rank=0, world_size=4))
+    with pytest.raises(ValueError, match="rank"):
+        next(ds.batches(1, 4, rank=4, world_size=4))
+    empty = str(tmp_path / "empty.bin")
+    TokenFileDataset.write(empty, np.array([1]))
+    with pytest.raises(ValueError, match="too few"):
+        TokenFileDataset(empty)
