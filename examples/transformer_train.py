@@ -42,6 +42,10 @@ def main():
     p.add_argument("--virtual-stages", type=int, default=1,
                    dest="virtual_stages",
                    help="interleaved chunks per pp device (circular only)")
+    p.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring",
+                   dest="sp_impl",
+                   help="sequence parallelism over the sp axis: ppermute "
+                        "ring or Ulysses all_to_all (heads %% sp == 0)")
     p.add_argument("--data", type=str, default=None,
                    help="path to a flat token file (TokenFileDataset "
                         "format); default: the synthetic bigram stream")
@@ -70,7 +74,7 @@ def main():
             max_seq_len=args.seq_len, dtype=jnp.float32,
             n_experts=args.moe, top_k=args.top_k, moe_impl="switch",
             pp_schedule=args.pp_schedule,
-            pp_virtual_stages=args.virtual_stages)
+            pp_virtual_stages=args.virtual_stages, sp_impl=args.sp_impl)
         seq_len = min(args.seq_len, 64 * max(1, mesh.shape.get("sp", 1)))
     else:
         cfg = transformer.TransformerConfig(
@@ -78,7 +82,7 @@ def main():
             max_seq_len=args.seq_len, n_experts=args.moe,
             top_k=args.top_k, moe_impl="switch",
             pp_schedule=args.pp_schedule,
-            pp_virtual_stages=args.virtual_stages)
+            pp_virtual_stages=args.virtual_stages, sp_impl=args.sp_impl)
         seq_len = args.seq_len
     if ctx.is_chief:
         print(f"transformer: mesh={dict(mesh.shape)} seq={seq_len} "

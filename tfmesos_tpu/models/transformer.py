@@ -79,6 +79,10 @@ class TransformerConfig:
     # device (bubble shrinks ~v-fold; needs n_layers % (pp*v) == 0).
     pp_schedule: str = "gpipe"
     pp_virtual_stages: int = 1
+    # Sequence parallelism over sp: "ring" (O(T/sp) memory, no head
+    # constraint) or "ulysses" (two all_to_alls, full-T flash locally;
+    # needs n_heads % sp == 0).  See parallel/ulysses.py for the trade.
+    sp_impl: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -302,7 +306,7 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
     v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    o = attend(q, k, v, mesh=mesh, causal=True)
+    o = attend(q, k, v, mesh=mesh, causal=True, sp_impl=cfg.sp_impl)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, aux = _ffn(cfg, mesh, lp, h, ep_axis=ep_axis)

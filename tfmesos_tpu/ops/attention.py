@@ -397,11 +397,19 @@ def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
 
 
 def attend(q, k, v, mesh=None, causal: bool = True,
-           scale: Optional[float] = None, **kw):
-    """One attention entry point for model code: ring attention when the
-    mesh shards the sequence (``sp``), sharded flash kernel when it shards
-    batch/heads, plain flash/reference otherwise."""
+           scale: Optional[float] = None, sp_impl: str = "ring", **kw):
+    """One attention entry point for model code: sequence parallelism when
+    the mesh shards the sequence (``sp``) — ring attention by default, or
+    Ulysses all-to-all with ``sp_impl="ulysses"`` — sharded flash kernel
+    when it shards batch/heads, plain flash/reference otherwise."""
     if mesh is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1:
+        if sp_impl == "ulysses":
+            from tfmesos_tpu.parallel.ulysses import ulysses_attention
+            return ulysses_attention(q, k, v, mesh, causal=causal,
+                                     scale=scale)
+        if sp_impl != "ring":
+            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', "
+                             f"got {sp_impl!r}")
         from tfmesos_tpu.parallel.ring_attention import ring_attention
         return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
     if mesh is not None:
