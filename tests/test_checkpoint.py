@@ -81,3 +81,31 @@ def test_prefetch_preserves_order_and_places():
     for i, b in enumerate(out):
         assert float(np.asarray(b["x"])[0, 0]) == i
         assert not isinstance(b["x"], np.ndarray)  # placed on device
+
+
+def test_quantized_params_checkpoint_roundtrip(tmp_path):
+    """QTensor params (int8 + scales, a NamedTuple pytree) survive an Orbax
+    save/restore — quantized serving artifacts checkpoint like any state."""
+    from tfmesos_tpu.models import transformer
+    from tfmesos_tpu.ops.quant import QTensor
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=32, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+        max_seq_len=8, dtype=jnp.float32)
+    qparams = transformer.quantize_params(
+        cfg, transformer.init_params(cfg, jax.random.PRNGKey(0)))
+
+    mgr = CheckpointManager(str(tmp_path / "q"))
+    mgr.save(1, {"qparams": qparams})
+    like = jax.tree_util.tree_map(jnp.zeros_like, {"qparams": qparams})
+    restored = mgr.restore(like)["qparams"]
+    mgr.close()
+
+    assert isinstance(restored["layers"]["wq"], QTensor)
+    assert restored["layers"]["wq"].values.dtype == jnp.int8
+    np.testing.assert_array_equal(
+        np.asarray(restored["layers"]["wq"].values),
+        np.asarray(qparams["layers"]["wq"].values))
+    np.testing.assert_allclose(
+        np.asarray(restored["embed"].scales),
+        np.asarray(qparams["embed"].scales))
