@@ -639,3 +639,63 @@ def test_quantized_switch_moe_generate_runs():
     prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
     out = transformer.generate(cfg, qparams, prompt, max_new_tokens=4)
     assert out.shape == (1, 8)
+
+
+def test_scan_unroll_matches_rolled():
+    """unroll=K is the same arithmetic as the rolled scan — bitwise-equal
+    params after the fused multi-step call."""
+    cfg = mlp.MLPConfig(in_dim=16, hidden=8, n_classes=4)
+    opt = optax.sgd(0.1)
+    base = mlp.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {"image": rng.rand(4, 4, 16).astype(np.float32),
+             "label": rng.randint(0, 4, size=(4, 4)).astype(np.int32)}
+
+    outs = []
+    for unroll in (1, 4):
+        step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt,
+                               steps_per_call=4, scan_unroll=unroll)
+        params, opt_state, metrics = step(
+            jax.tree_util.tree_map(jnp.copy, base), opt.init(base), batch)
+        outs.append((params, float(metrics["loss"])))
+    np.testing.assert_array_equal(np.asarray(outs[0][0]["w1"]),
+                                  np.asarray(outs[1][0]["w1"]))
+    assert outs[0][1] == outs[1][1]
+    with pytest.raises(ValueError, match="divide"):
+        make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt,
+                        steps_per_call=4, scan_unroll=3)
+
+
+def test_eval_step_and_evaluate():
+    from tfmesos_tpu.train.trainer import evaluate, make_eval_step
+
+    cfg = mlp.MLPConfig(hidden=16)
+    params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+    ds = datalib.SyntheticMNIST()
+    eval_step = make_eval_step(lambda p, b: mlp.loss_fn(cfg, p, b))
+    out = evaluate(eval_step, params, ds.batches(32, seed=5), num_batches=3)
+    assert set(out) >= {"loss", "accuracy"}
+    assert np.isfinite(out["loss"])
+
+
+def test_trainloop_metrics_jsonl(tmp_path):
+    import json as jsonlib
+
+    cfg = mlp.MLPConfig(in_dim=8, hidden=4, n_classes=2)
+    opt = optax.sgd(0.1)
+    params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+    step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt)
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            yield {"image": rng.rand(8, 8).astype(np.float32),
+                   "label": rng.randint(0, 2, size=8).astype(np.int32)}
+
+    path = str(tmp_path / "metrics.jsonl")
+    loop = TrainLoop(step, TrainState(params, opt.init(params)),
+                     log_every=2, metrics_path=path)
+    loop.run(batches(), num_steps=6)
+    lines = [jsonlib.loads(l) for l in open(path)]
+    assert [l["step"] for l in lines] == [2, 4, 6]
+    assert all("loss" in l and "wall_s" in l for l in lines)

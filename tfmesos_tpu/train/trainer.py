@@ -42,7 +42,8 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     batch_spec_tree: Optional[Any] = None,
                     postprocess: Optional[Callable] = None,
                     steps_per_call: int = 1,
-                    grad_accum: int = 1):
+                    grad_accum: int = 1,
+                    scan_unroll: int = 1):
     """Build the jit'd train step.
 
     ``loss_fn(params, batch) -> (loss, metrics)``.  With a mesh, params/opt
@@ -56,6 +57,12 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     ...]`` dim and the host pays one round-trip per K steps — the dominant
     cost for small models on remote-attached or latency-bound runtimes.
     Returned metrics are the last step's.
+
+    ``scan_unroll`` unrolls the fused-step ``lax.scan`` body that many
+    iterations (must divide ``steps_per_call``): for tiny models the
+    per-iteration scan overhead dominates the math, and unrolling lets XLA
+    fuse across consecutive optimizer steps — same arithmetic, fewer
+    kernel launches.  Leave at 1 for models whose step is compute-bound.
 
     ``grad_accum > 1`` splits each step's batch into that many microbatches
     and averages their gradients before the single optimizer update — the
@@ -107,6 +114,9 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
         metrics["loss"] = loss
         return params, opt_state, metrics
 
+    if scan_unroll < 1 or steps_per_call % scan_unroll:
+        raise ValueError(f"scan_unroll ({scan_unroll}) must divide "
+                         f"steps_per_call ({steps_per_call})")
     if steps_per_call == 1:
         step_fn = one_step
     else:
@@ -116,7 +126,7 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                 p, o, metrics = one_step(p, o, micro)
                 return (p, o), metrics
             (params, opt_state), metrics = jax.lax.scan(
-                body, (params, opt_state), batch)
+                body, (params, opt_state), batch, unroll=scan_unroll)
             last = jax.tree_util.tree_map(lambda m: m[-1], metrics)
             return params, opt_state, last
 
@@ -243,21 +253,64 @@ def _opt_shardings(opt_state, params, param_shardings, mesh):
     return jax.tree_util.tree_map_with_path(assign, opt_state)
 
 
+def make_eval_step(loss_fn: Callable, mesh: Optional[Mesh] = None):
+    """Jit'd forward-only step: ``loss_fn(params, batch) -> (loss,
+    metrics)`` becomes ``eval_step(params, batch) -> metrics`` (loss
+    included).  With a mesh, the batch is constrained onto the data axes
+    like the train step's."""
+
+    def step(params, batch):
+        if mesh is not None:
+            batch = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, batch_sharding(mesh)), batch)
+        loss, metrics = loss_fn(params, batch)
+        out = dict(metrics)
+        out["loss"] = loss
+        return out
+
+    return jax.jit(step)
+
+
+def evaluate(eval_step: Callable, params, batches: Iterator,
+             num_batches: int) -> Dict[str, float]:
+    """Run ``num_batches`` eval steps and return the metric means — the
+    validation half of the reference's trainers (mnist_replica.py:216-226
+    evaluated once at the end; this is the reusable form)."""
+    acc: Dict[str, list] = {}
+    for _ in range(num_batches):
+        # Keep device arrays: no host sync inside the loop, so batch N+1
+        # dispatches while batch N still runs (matters on remote-attached
+        # runtimes where each fetch is a full round-trip).
+        for k, v in eval_step(params, next(batches)).items():
+            acc.setdefault(k, []).append(v)
+    return {k: float(sum(jnp.stack(vs)) / num_batches)
+            for k, vs in acc.items()}
+
+
 @dataclass
 class TrainLoop:
     """Step loop with timing — the measurement point for the project metric
-    (BASELINE.md: steps/sec/chip)."""
+    (BASELINE.md: steps/sec/chip).
+
+    ``metrics_path`` appends one JSON line per logged step
+    (``{"step": N, "wall_s": ..., **metrics}``) — a machine-readable
+    training curve with no dashboard dependency."""
 
     step_fn: Callable
     state: TrainState
     log_every: int = 50
     name: str = "train"
+    metrics_path: Optional[str] = None
 
     def run(self, batches: Iterator[Dict[str, Any]], num_steps: int,
             on_metrics: Optional[Callable[[int, Dict], None]] = None) -> Dict[str, Any]:
+        import json
+
         params, opt_state = self.state.params, self.state.opt_state
         t_start = time.perf_counter()
         metrics = {}
+        sink = open(self.metrics_path, "a") if self.metrics_path else None
 
         def run_step(i):
             nonlocal params, opt_state, metrics
@@ -265,6 +318,12 @@ class TrainLoop:
             params, opt_state, metrics = self.step_fn(params, opt_state, batch)
             if (i + 1) % self.log_every == 0 or i + 1 == num_steps:
                 metrics = {k: float(v) for k, v in metrics.items()}
+                if sink:
+                    sink.write(json.dumps(
+                        {"step": self.state.step + i + 1,
+                         "wall_s": round(time.perf_counter() - t_start, 3),
+                         **metrics}) + "\n")
+                    sink.flush()
                 if on_metrics:
                     on_metrics(i + 1, metrics)
                 else:
@@ -277,12 +336,16 @@ class TrainLoop:
         import os
         traced = min(num_steps,
                      int(os.environ.get("TPUMESOS_TRACE_STEPS", "20")))
-        with trace():
-            for i in range(traced):
+        try:
+            with trace():
+                for i in range(traced):
+                    run_step(i)
+            for i in range(traced, num_steps):
                 run_step(i)
-        for i in range(traced, num_steps):
-            run_step(i)
-        jax.block_until_ready(params)
+            jax.block_until_ready(params)
+        finally:
+            if sink:
+                sink.close()
         elapsed = time.perf_counter() - t_start
         self.state = TrainState(params, opt_state, self.state.step + num_steps)
         n_dev = max(1, jax.device_count())
