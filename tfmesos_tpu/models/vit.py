@@ -1,0 +1,140 @@
+"""Vision Transformer (ViT-B/16 shape by default), TPU-first.
+
+Beyond the reference's model zoo (its largest vision nets are the ResNet-50
+and Inception-v3 re-dos; SURVEY §2.5) — a ViT rounds out the families on
+the architecture TPUs run best: patchify is one conv (MXU), the trunk is
+the same pre-norm attention/MLP stack as the flagship language model (the
+flash kernel applies unchanged since patch counts tile cleanly), and
+everything shards with the same tp/fsdp PartitionSpec vocabulary.
+
+Plain-jnp parameter dict like models/transformer.py (no framework module
+state — no batch norm anywhere), so the generic ``make_train_step`` works
+as-is with FSDP default shardings.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from tfmesos_tpu.ops.attention import flash_attention
+from tfmesos_tpu.ops.layers import cross_entropy_loss
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny():
+        """Test-scale variant (same code path)."""
+        return ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                         d_model=32, n_layers=2, n_heads=2, d_ff=64,
+                         dtype=jnp.float32)
+
+
+def init_params(cfg: ViTConfig, rng) -> Dict[str, Any]:
+    d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
+    p = cfg.patch_size
+    keys = iter(jax.random.split(rng, 12))
+
+    def norm(shape, scale):
+        return (jax.random.normal(next(keys), shape, cfg.param_dtype)
+                * scale).astype(cfg.param_dtype)
+
+    return {
+        # patchify = one dense over flattened p*p*3 pixels (== conv stride p)
+        "patch_w": norm((p * p * 3, d), 1 / math.sqrt(p * p * 3)),
+        "patch_b": jnp.zeros((d,), cfg.param_dtype),
+        "pos_embed": norm((cfg.n_patches + 1, d), 0.02),
+        "cls": jnp.zeros((d,), cfg.param_dtype),
+        "layers": {
+            "norm1": jnp.ones((l, d), cfg.param_dtype),
+            "wq": norm((l, d, d), 1 / math.sqrt(d)),
+            "wk": norm((l, d, d), 1 / math.sqrt(d)),
+            "wv": norm((l, d, d), 1 / math.sqrt(d)),
+            "wo": norm((l, d, d), 1 / math.sqrt(d) / math.sqrt(2 * l)),
+            "norm2": jnp.ones((l, d), cfg.param_dtype),
+            "w1": norm((l, d, f), 1 / math.sqrt(d)),
+            "b1": jnp.zeros((l, f), cfg.param_dtype),
+            "w2": norm((l, f, d), 1 / math.sqrt(f) / math.sqrt(2 * l)),
+            "b2": jnp.zeros((l, d), cfg.param_dtype),
+        },
+        "norm_f": jnp.ones((d,), cfg.param_dtype),
+        "head_w": norm((d, cfg.num_classes), 1 / math.sqrt(d)),
+        "head_b": jnp.zeros((cfg.num_classes,), cfg.param_dtype),
+    }
+
+
+def _layer_norm(x, w):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * w
+
+
+def _block(cfg: ViTConfig, x, lp):
+    b, t, d = x.shape
+    h = _layer_norm(x, lp["norm1"].astype(cfg.dtype))
+    q = (h @ lp["wq"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                                 cfg.head_dim)
+    k = (h @ lp["wk"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                                 cfg.head_dim)
+    v = (h @ lp["wv"].astype(cfg.dtype)).reshape(b, t, cfg.n_heads,
+                                                 cfg.head_dim)
+    o = flash_attention(q, k, v, causal=False)
+    x = x + o.reshape(b, t, d) @ lp["wo"].astype(cfg.dtype)
+    h = _layer_norm(x, lp["norm2"].astype(cfg.dtype))
+    h = jax.nn.gelu(h @ lp["w1"].astype(cfg.dtype)
+                    + lp["b1"].astype(cfg.dtype))
+    return x + h @ lp["w2"].astype(cfg.dtype) + lp["b2"].astype(cfg.dtype)
+
+
+def forward(cfg: ViTConfig, params, images):
+    """images [B, H, W, 3] (NHWC) -> logits [B, num_classes]."""
+    b = images.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    x = images.astype(cfg.dtype).reshape(b, g, p, g, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, p * p * 3)
+    x = x @ params["patch_w"].astype(cfg.dtype) \
+        + params["patch_b"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(params["cls"].astype(cfg.dtype), (b, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)
+
+    def body(carry, lp):
+        return _block(cfg, carry, lp), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layer_norm(x[:, 0], params["norm_f"].astype(cfg.dtype))
+    return x @ params["head_w"].astype(cfg.dtype) \
+        + params["head_b"].astype(cfg.dtype)
+
+
+def loss_fn(cfg: ViTConfig, params, batch, mesh=None):
+    logits = forward(cfg, params, batch["image"])
+    loss = cross_entropy_loss(logits, batch["label"])
+    acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"])
+                   .astype(jnp.float32))
+    return loss, {"accuracy": acc}
