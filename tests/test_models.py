@@ -699,3 +699,77 @@ def test_trainloop_metrics_jsonl(tmp_path):
     lines = [jsonlib.loads(l) for l in open(path)]
     assert [l["step"] for l in lines] == [2, 4, 6]
     assert all("loss" in l and "wall_s" in l for l in lines)
+
+
+GQA = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq_len=32, dtype=jnp.float32)
+
+
+def test_gqa_matches_mha_with_repeated_kv():
+    """GQA is exact: repeating each kv head over its query group in an MHA
+    model reproduces the GQA forward bit-for-bit."""
+    params = transformer.init_params(GQA, jax.random.PRNGKey(0))
+    mha_cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32)
+    rep = GQA.n_heads // GQA.kv_heads
+    dh = GQA.head_dim
+
+    def widen(w):  # [L, d, kv*dh] -> [L, d, H*dh], repeating per kv head
+        l, d, _ = w.shape
+        return jnp.repeat(w.reshape(l, d, GQA.kv_heads, dh), rep,
+                          axis=2).reshape(l, d, -1)
+
+    mha_params = jax.tree_util.tree_map(lambda x: x, params)
+    mha_params["layers"] = dict(params["layers"])
+    mha_params["layers"]["wk"] = widen(params["layers"]["wk"])
+    mha_params["layers"]["wv"] = widen(params["layers"]["wv"])
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    got = transformer.forward(GQA, params, tokens)
+    ref = transformer.forward(mha_cfg, mha_params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_decode_matches_forward_and_cache_shrinks():
+    params = transformer.init_params(GQA, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    full = transformer.forward(GQA, params, tokens)
+
+    cache = transformer.init_cache(GQA, 2, 16)
+    assert cache["k"].shape == (2, 2, 16, 2, GQA.head_dim)  # kv_heads=2
+
+    logits, cache = transformer.decode_step(GQA, params, cache, tokens, 0)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+    # incremental steps too
+    for i in range(12, 14):
+        nxt = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        logits, cache = transformer.decode_step(GQA, params, cache, nxt, i)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_gqa_generate_and_quantized():
+    params = transformer.init_params(GQA, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
+    out = transformer.generate(GQA, params, prompt, max_new_tokens=6)
+    assert out.shape == (1, 10)
+    qparams = transformer.quantize_params(GQA, params)
+    qout = transformer.generate(GQA, qparams, prompt, max_new_tokens=6)
+    assert qout.shape == (1, 10)
+
+
+def test_gqa_trains_on_sp_mesh():
+    mesh = build_mesh({"sp": 8})
+    params = transformer.init_params(GQA, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 64)
+    loss, _ = jax.jit(lambda p, b: transformer.loss_fn(GQA, p, b, mesh))(
+        params, {"tokens": tokens})
+    assert np.isfinite(float(loss))
+    with pytest.raises(ValueError, match="grouped-query"):
+        transformer.forward(
+            GQA, params,
+            jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64),
+            build_mesh({"pp": 2, "tp": 2, "dp": 2}))

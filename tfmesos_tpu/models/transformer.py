@@ -58,6 +58,11 @@ class TransformerConfig:
     n_layers: int = 8
     n_heads: int = 8
     d_ff: int = 2048
+    # Grouped-query attention: n_kv_heads < n_heads shares each K/V head
+    # across n_heads/n_kv_heads query heads — exact attention with a
+    # KV cache (and wk/wv) smaller by that factor, the standard serving
+    # memory/bandwidth win.  None = full multi-head attention.
+    n_kv_heads: Optional[int] = None
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16          # compute dtype (MXU-friendly)
@@ -88,10 +93,19 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+        if kv < 1 or self.n_heads % kv:
+            raise ValueError(f"n_heads ({self.n_heads}) must be a positive "
+                             f"multiple of n_kv_heads ({kv})")
+        return kv
+
 
 def init_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
     d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
     hd = cfg.n_heads * cfg.head_dim
+    kvd = cfg.kv_heads * cfg.head_dim
     keys = iter(jax.random.split(rng, 16))
 
     def norm(shape, scale):
@@ -101,8 +115,8 @@ def init_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
     layers = {
         "attn_norm": jnp.ones((l, d), cfg.param_dtype),
         "wq": norm((l, d, hd), 1 / math.sqrt(d)),
-        "wk": norm((l, d, hd), 1 / math.sqrt(d)),
-        "wv": norm((l, d, hd), 1 / math.sqrt(d)),
+        "wk": norm((l, d, kvd), 1 / math.sqrt(d)),
+        "wv": norm((l, d, kvd), 1 / math.sqrt(d)),
         "wo": norm((l, hd, d), 1 / math.sqrt(hd) / math.sqrt(2 * l)),
         "mlp_norm": jnp.ones((l, d), cfg.param_dtype),
     }
@@ -302,10 +316,18 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, cfg.kv_heads, cfg.head_dim)
+    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.kv_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
+    if cfg.kv_heads != cfg.n_heads:
+        # GQA: query head h reads kv head h // (H/KV).  Repeating up front
+        # keeps every attention impl (flash/ring/ulysses) unchanged; the
+        # training-time memory cost matches MHA, the KV-cache saving is
+        # realized in the decode path, which stores kv_heads only.
+        rep = cfg.n_heads // cfg.kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     o = attend(q, k, v, mesh=mesh, causal=True, sp_impl=cfg.sp_impl)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
@@ -353,6 +375,10 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
             if cfg.n_experts:
                 raise ValueError("pp x tp with experts is not supported; "
                                  "use ep without tp under pp")
+            if cfg.kv_heads != cfg.n_heads:
+                raise ValueError("pp x tp with grouped-query attention is "
+                                 "not supported; use GQA without tp under "
+                                 "pp (or full MHA)")
             stage_block = lambda c, lp_, pos: (
                 _block_manual_tp(cfg, c, lp_, pos), None)
             partition = {
@@ -424,7 +450,7 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     """KV cache for autoregressive decoding: per-layer stacked K/V buffers
     (consumed by the same ``lax.scan`` over layers the forward uses)."""
     dtype = dtype or cfg.dtype
-    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -433,8 +459,14 @@ def cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, P]:
     tp — the decode analogue of ``partition_specs``.  Place the cache (and
     params) with these and jit ``decode_step(..., sharded=True)``: every op
     is then a plain einsum, so GSPMD inserts the tp collectives — no manual
-    decode variant needed."""
+    decode variant needed.  With GQA the cache's head axis is ``kv_heads``,
+    so tp must divide it."""
     from tfmesos_tpu.parallel.sharding import data_axes
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and cfg.kv_heads % tp:
+        raise ValueError(
+            f"cache_specs: tp ({tp}) must divide kv_heads "
+            f"({cfg.kv_heads}) to shard the KV cache's head axis")
     spec = _filter_spec(P(None, data_axes(mesh), None, "tp", None), mesh)
     return {"k": spec, "v": spec}
 
@@ -457,30 +489,38 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads,
                                                cfg.head_dim)
-    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, cfg.n_heads,
+    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, cfg.kv_heads,
                                                cfg.head_dim)
-    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.n_heads,
+    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.kv_heads,
                                                cfg.head_dim)
     pos_row = jnp.broadcast_to(positions, (b, t))
     q = rope(q, pos_row, cfg.rope_theta)
     k = rope(k, pos_row, cfg.rope_theta)
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+    kv = cfg.kv_heads
+    g = cfg.n_heads // kv
     if t > 1 and isinstance(pos, int) and pos == 0:
         # Prefill from an empty cache: the chunk only attends to itself —
         # [t, t] instead of a [t, M] score tensor over the (mostly empty)
         # cache.
+        kf = jnp.repeat(k, g, axis=2) if g > 1 else k
+        vf = jnp.repeat(v, g, axis=2) if g > 1 else v
         if sharded:
-            o = mha_reference(q, k, v, causal=True)
+            o = mha_reference(q, kf, vf, causal=True)
         else:
-            o = attend(q, k, v, mesh=None, causal=True)
+            o = attend(q, kf, vf, mesh=None, causal=True)
     else:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32)
+        # Grouped einsum over the cache: the KV blocks stream from HBM
+        # once at kv_heads width — never materialized at n_heads.
+        q5 = q.reshape(b, t, kv, g, cfg.head_dim)
+        s = jnp.einsum("btkgd,bmkd->bkgtm", q5, ck).astype(jnp.float32)
         s = s / math.sqrt(cfg.head_dim)
         kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
-        s = jnp.where((kpos > positions[:, None])[None, None], -jnp.inf, s)
+        s = jnp.where((kpos > positions[:, None])[None, None, None],
+                      -jnp.inf, s)
         probs = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+        o = jnp.einsum("bkgtm,bmkd->btkgd", probs, cv)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, _ = _ffn(cfg, None, lp, h)
@@ -608,6 +648,11 @@ def partition_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
     """PartitionSpec tree: Megatron-style tp, fsdp on the complementary dim,
     ep over experts.  The layer-stack dim (dim 0) is left unsharded here;
     the pp path re-shapes it into stages itself."""
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and (cfg.kv_heads * cfg.head_dim) % tp:
+        raise ValueError(
+            f"partition_specs: tp ({tp}) must divide the GQA kv projection "
+            f"width ({cfg.kv_heads} kv heads x {cfg.head_dim})")
     layer = {
         "attn_norm": P(None, None),
         "wq": P(None, "fsdp", "tp"),
