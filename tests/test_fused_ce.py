@@ -1,0 +1,122 @@
+"""Fused head+cross-entropy (ops/layers.fused_linear_cross_entropy):
+chunked loss/grads must match the materialize-the-logits reference exactly
+(same fp32 reduction math, different grouping), and the transformer's
+loss_fn must auto-select it only where it is the right call.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfmesos_tpu.models import transformer
+from tfmesos_tpu.ops.layers import cross_entropy_loss, fused_linear_cross_entropy
+from tfmesos_tpu.parallel.mesh import build_mesh
+
+
+def _ref_loss(x, w, labels, z_loss=0.0):
+    logits = x @ w.astype(x.dtype)
+    return cross_entropy_loss(logits, labels, z_loss=z_loss)
+
+
+@pytest.mark.parametrize("z_loss", [0.0, 1e-3])
+@pytest.mark.parametrize("chunk", [7, 16, 1000])
+def test_fused_ce_matches_reference_loss_and_grads(z_loss, chunk):
+    d, v = 16, 37
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 8, d), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (d, v), jnp.float32) * 0.3
+    labels = jax.random.randint(jax.random.PRNGKey(2), (3, 8), 0, v)
+
+    ref, (dx_ref, dw_ref) = jax.value_and_grad(_ref_loss, argnums=(0, 1))(
+        x, w, labels, z_loss)
+    got, (dx, dw) = jax.value_and_grad(
+        lambda x_, w_: fused_linear_cross_entropy(x_, w_, labels, z_loss,
+                                                  chunk),
+        argnums=(0, 1))(x, w)
+
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ce_bf16_inputs_fp32_master_weight():
+    """The model path: bf16 hidden states, fp32 master head — compute runs
+    in bf16 (weight cast at the matmul, as the standard path does) but dw
+    accumulates fp32 and returns at the master dtype."""
+    d, v = 32, 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 6, d)).astype(jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (d, v), jnp.float32) * 0.2
+    labels = jax.random.randint(jax.random.PRNGKey(2), (4, 6), 0, v)
+
+    ref, (dx_ref, dw_ref) = jax.value_and_grad(_ref_loss, argnums=(0, 1))(
+        x, w, labels)
+    got, (dx, dw) = jax.value_and_grad(
+        lambda x_, w_: fused_linear_cross_entropy(x_, w_, labels),
+        argnums=(0, 1))(x, w)
+
+    assert dx.dtype == jnp.bfloat16 and dw.dtype == jnp.float32
+    np.testing.assert_allclose(float(got), float(ref), rtol=5e-3)
+    np.testing.assert_allclose(np.asarray(dx, dtype=np.float32),
+                               np.asarray(dx_ref, dtype=np.float32),
+                               rtol=0.1, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref),
+                               rtol=0.1, atol=5e-4)
+
+
+TINY = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    max_seq_len=32, dtype=jnp.float32)
+
+
+def test_loss_fn_fused_matches_standard():
+    params = transformer.init_params(TINY, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0,
+                                TINY.vocab_size)
+    batch = {"tokens": tokens}
+    import dataclasses
+    fused_cfg = dataclasses.replace(TINY, fused_ce=True, ce_chunk=8)
+    plain_cfg = dataclasses.replace(TINY, fused_ce=False)
+
+    l_fused, (g_fused,) = jax.value_and_grad(
+        lambda p: transformer.loss_fn(fused_cfg, p, batch)[0], argnums=(0,))(
+        params)
+    l_plain, (g_plain,) = jax.value_and_grad(
+        lambda p: transformer.loss_fn(plain_cfg, p, batch)[0], argnums=(0,))(
+        params)
+
+    np.testing.assert_allclose(float(l_fused), float(l_plain), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g_fused),
+                    jax.tree_util.tree_leaves(g_plain)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_use_fused_ce_auto_selection():
+    params = transformer.init_params(TINY, jax.random.PRNGKey(0))
+    assert transformer._use_fused_ce(TINY, params, None)
+    assert transformer._use_fused_ce(TINY, params, build_mesh({"dp": 8}))
+    assert transformer._use_fused_ce(
+        TINY, params, build_mesh({"dp": 4, "fsdp": 2}))
+    assert not transformer._use_fused_ce(
+        TINY, params, build_mesh({"dp": 4, "tp": 2}))
+    assert not transformer._use_fused_ce(
+        TINY, params, build_mesh({"sp": 8}))
+    # Size-1 axes don't count: a degenerate tp axis is still data-only.
+    assert transformer._use_fused_ce(
+        TINY, params, build_mesh({"dp": 8, "tp": 1}))
+    qparams = transformer.quantize_params(TINY, params)
+    assert not transformer._use_fused_ce(TINY, qparams, None)
+
+
+def test_fused_ce_on_dp_mesh_matches_single_device():
+    mesh = build_mesh({"dp": 8})
+    params = transformer.init_params(TINY, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                TINY.vocab_size)
+    batch = {"tokens": tokens}
+    ref = transformer.loss_fn(TINY, params, batch)[0]
+    got = jax.jit(lambda p, b: transformer.loss_fn(TINY, p, b, mesh)[0])(
+        params, batch)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)

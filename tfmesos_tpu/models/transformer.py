@@ -29,7 +29,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tfmesos_tpu.ops.attention import attend, mha_reference
-from tfmesos_tpu.ops.layers import cross_entropy_loss, rms_norm, rope, swiglu
+from tfmesos_tpu.ops.layers import (cross_entropy_loss,
+                                    fused_linear_cross_entropy, rms_norm,
+                                    rope, swiglu)
 from tfmesos_tpu.ops.quant import QTensor, quantize_tensor
 
 
@@ -88,6 +90,14 @@ class TransformerConfig:
     # constraint) or "ulysses" (two all_to_alls, full-T flash locally;
     # needs n_heads % sp == 0).  See parallel/ulysses.py for the trade.
     sp_impl: str = "ring"
+    # Fused head+cross-entropy (ops/layers.fused_linear_cross_entropy):
+    # never materializes the [B·T, V] logits through fwd+bwd.  None = auto:
+    # on for training losses whenever the mesh only shards data dims (or is
+    # absent) and the head is a plain array — under tp the head is vocab-
+    # parallel and the standard path's sharded logsumexp is the right
+    # shape, and a QTensor head stays on the dequantize-at-matmul path.
+    fused_ce: Optional[bool] = None
+    ce_chunk: int = 2048
 
     @property
     def head_dim(self) -> int:
@@ -338,7 +348,18 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
 def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
             return_aux: bool = False):
     """tokens [B, T] int32 → logits [B, T, V] (plus per-layer-averaged router
-    aux metrics when ``return_aux``).
+    aux metrics when ``return_aux``)."""
+    x, aux = forward_hidden(cfg, params, tokens, mesh)
+    logits = x @ _wt(params["head"], cfg.dtype)
+    return (logits, aux) if return_aux else logits
+
+
+def forward_hidden(cfg: TransformerConfig, params, tokens,
+                   mesh: Optional[Mesh] = None):
+    """The trunk: tokens [B, T] → (final-norm hidden states [B, T, d],
+    per-layer-averaged router aux metrics).  ``forward`` applies the
+    unembedding head on top; ``loss_fn`` may instead feed the hidden states
+    to the fused head+cross-entropy, which never materializes full logits.
 
     Sequence positions are global even when activations are sp-sharded:
     ring attention receives the full logical sequence sharded along T, and
@@ -440,9 +461,7 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
         x, stacked_aux = jax.lax.scan(body, x, params["layers"])
         aux = jax.tree_util.tree_map(jnp.mean, stacked_aux)
 
-    x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
-    logits = x @ _wt(params["head"], cfg.dtype)
-    return (logits, aux) if return_aux else logits
+    return rms_norm(x, params["norm_f"].astype(cfg.dtype)), aux
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
@@ -609,6 +628,20 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     return jnp.concatenate([prompt, generated], axis=1)
 
 
+def _use_fused_ce(cfg: TransformerConfig, params,
+                  mesh: Optional[Mesh]) -> bool:
+    if isinstance(params["head"], QTensor):
+        return False  # serving trees stay on the dequantize-at-matmul path
+    if cfg.fused_ce is not None:
+        return cfg.fused_ce
+    if mesh is None:
+        return True
+    # Auto-on only when every real mesh axis is a batch-like dim: the token
+    # chunks then split a dimension that is data-sharded anyway.  tp's
+    # vocab-parallel head and sp's sequence sharding want the standard path.
+    return all(a in ("dp", "fsdp") for a, s in mesh.shape.items() if s > 1)
+
+
 def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
     """Next-token prediction: batch = {"tokens": [B, T+1]}.
 
@@ -616,8 +649,16 @@ def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
     (standard switch-transformer weighting) and the realized token-overflow
     fraction is surfaced in the metrics."""
     tokens = batch["tokens"]
-    logits, aux = forward(cfg, params, tokens[:, :-1], mesh, return_aux=True)
-    loss = cross_entropy_loss(logits, tokens[:, 1:])
+    if _use_fused_ce(cfg, params, mesh):
+        x, aux = forward_hidden(cfg, params, tokens[:, :-1], mesh)
+        # Pass the master-dtype head: the op computes in x.dtype but
+        # accumulates dw in fp32 and returns it at the param dtype.
+        loss = fused_linear_cross_entropy(
+            x, params["head"], tokens[:, 1:], chunk=cfg.ce_chunk)
+    else:
+        logits, aux = forward(cfg, params, tokens[:, :-1], mesh,
+                              return_aux=True)
+        loss = cross_entropy_loss(logits, tokens[:, 1:])
     metrics = {"perplexity": jnp.exp(loss)}
     if cfg.n_experts:
         # Under pp the aux rides the pipeline per microbatch (gpipe-style

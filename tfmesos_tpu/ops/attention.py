@@ -41,7 +41,7 @@ def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
 def _pick_block(dim: int, target: int = 512) -> int:
     """Largest Mosaic-legal (8-aligned or full-dim) divisor of ``dim`` that
     is <= ``target``; falls back to the whole dim (always legal)."""
-    for c in (512, 384, 256, 128, 64, 32, 16, 8):
+    for c in (1024, 512, 384, 256, 128, 64, 32, 16, 8):
         if c <= min(dim, target) and dim % c == 0:
             return c
     return dim
@@ -334,7 +334,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int = 1024, block_k: int = 512,
                     use_pallas: Optional[bool] = None,
                     interpret: bool = False):
     """Blocked attention; Pallas kernel on TPU, reference math elsewhere.
