@@ -93,21 +93,67 @@ def test_loss_fn_fused_matches_standard():
                                    rtol=2e-5, atol=1e-6)
 
 
-def test_use_fused_ce_auto_selection():
+def test_fused_ce_mode_auto_selection():
     params = transformer.init_params(TINY, jax.random.PRNGKey(0))
-    assert transformer._use_fused_ce(TINY, params, None)
-    assert transformer._use_fused_ce(TINY, params, build_mesh({"dp": 8}))
-    assert transformer._use_fused_ce(
-        TINY, params, build_mesh({"dp": 4, "fsdp": 2}))
-    assert not transformer._use_fused_ce(
-        TINY, params, build_mesh({"dp": 4, "tp": 2}))
-    assert not transformer._use_fused_ce(
-        TINY, params, build_mesh({"sp": 8}))
+    mode = transformer._fused_ce_mode
+    assert mode(TINY, params, None) == "dense"
+    assert mode(TINY, params, build_mesh({"dp": 8})) == "dense"
+    assert mode(TINY, params, build_mesh({"dp": 4, "fsdp": 2})) == "dense"
+    assert mode(TINY, params, build_mesh({"dp": 4, "tp": 2})) == "tp"
+    assert mode(TINY, params, build_mesh({"sp": 8})) is None
+    assert mode(TINY, params, build_mesh({"pp": 2, "dp": 4})) is None
     # Size-1 axes don't count: a degenerate tp axis is still data-only.
-    assert transformer._use_fused_ce(
-        TINY, params, build_mesh({"dp": 8, "tp": 1}))
+    assert mode(TINY, params, build_mesh({"dp": 8, "tp": 1})) == "dense"
     qparams = transformer.quantize_params(TINY, params)
-    assert not transformer._use_fused_ce(TINY, qparams, None)
+    assert mode(TINY, qparams, None) is None
+
+
+def test_loss_fn_tp_mesh_matches_single_device():
+    """The vocab-parallel path through loss_fn: loss AND grads on a
+    dp x tp mesh must match the meshless (fused-dense) run."""
+    mesh = build_mesh({"dp": 4, "tp": 2})
+    params = transformer.init_params(TINY, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                TINY.vocab_size)
+    batch = {"tokens": tokens}
+    assert transformer._fused_ce_mode(TINY, params, mesh) == "tp"
+
+    ref, g_ref = jax.value_and_grad(
+        lambda p: transformer.loss_fn(TINY, p, batch)[0])(params)
+    got, g = jax.jit(jax.value_and_grad(
+        lambda p: transformer.loss_fn(TINY, p, batch, mesh)[0]))(params)
+
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g)[0],
+            jax.tree_util.tree_flatten_with_path(g_ref)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-5, err_msg=str(pa))
+
+
+@pytest.mark.parametrize("axes", [{"tp": 8}, {"dp": 2, "tp": 4},
+                                  {"dp": 2, "fsdp": 2, "tp": 2}])
+def test_vocab_parallel_ce_matches_reference(axes):
+    from tfmesos_tpu.ops.layers import vocab_parallel_cross_entropy
+    mesh = build_mesh(axes)
+    d, v = 16, 64
+    nb = axes.get("dp", 1) * axes.get("fsdp", 1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2 * nb, 8, d), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (d, v), jnp.float32) * 0.3
+    labels = jax.random.randint(jax.random.PRNGKey(2), (2 * nb, 8), 0, v)
+
+    ref, (dx_ref, dw_ref) = jax.value_and_grad(_ref_loss, argnums=(0, 1))(
+        x, w, labels, 1e-3)
+    got, (dx, dw) = jax.jit(jax.value_and_grad(
+        lambda x_, w_: vocab_parallel_cross_entropy(
+            x_, w_, labels, mesh, z_loss=1e-3, chunk=8),
+        argnums=(0, 1)))(x, w)
+
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_fused_ce_on_dp_mesh_matches_single_device():

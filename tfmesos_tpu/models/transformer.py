@@ -31,7 +31,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tfmesos_tpu.ops.attention import attend, mha_reference
 from tfmesos_tpu.ops.layers import (cross_entropy_loss,
                                     fused_linear_cross_entropy, rms_norm,
-                                    rope, swiglu)
+                                    rope, swiglu,
+                                    vocab_parallel_cross_entropy)
 from tfmesos_tpu.ops.quant import QTensor, quantize_tensor
 
 
@@ -90,12 +91,14 @@ class TransformerConfig:
     # constraint) or "ulysses" (two all_to_alls, full-T flash locally;
     # needs n_heads % sp == 0).  See parallel/ulysses.py for the trade.
     sp_impl: str = "ring"
-    # Fused head+cross-entropy (ops/layers.fused_linear_cross_entropy):
-    # never materializes the [B·T, V] logits through fwd+bwd.  None = auto:
-    # on for training losses whenever the mesh only shards data dims (or is
-    # absent) and the head is a plain array — under tp the head is vocab-
-    # parallel and the standard path's sharded logsumexp is the right
-    # shape, and a QTensor head stays on the dequantize-at-matmul path.
+    # Fused head+cross-entropy: never materializes the [B·T, V] logits
+    # through fwd+bwd.  None = auto (see _fused_ce_mode): the dense form
+    # (ops/layers.fused_linear_cross_entropy) on single-device and
+    # data-only meshes, the tp vocab-parallel form
+    # (vocab_parallel_cross_entropy) when tp divides the vocab; sp/pp/ep
+    # meshes and QTensor (serving) heads use the standard path.  True asks
+    # for fusion even where auto declines (the dense form, relying on
+    # GSPMD to partition the chunks); False disables fusion everywhere.
     fused_ce: Optional[bool] = None
     ce_chunk: int = 2048
 
@@ -628,18 +631,25 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     return jnp.concatenate([prompt, generated], axis=1)
 
 
-def _use_fused_ce(cfg: TransformerConfig, params,
-                  mesh: Optional[Mesh]) -> bool:
+def _fused_ce_mode(cfg: TransformerConfig, params,
+                   mesh: Optional[Mesh]) -> Optional[str]:
+    """Which fused head+CE path ``loss_fn`` takes: "dense" (single-device /
+    data-only meshes), "tp" (vocab-parallel over the tp axis), or None (the
+    standard materialize-the-logits path — sp shards the token dim the
+    chunking would cut across, pp computes the loss outside the pipeline
+    body, ep leaves activation replication to GSPMD)."""
     if isinstance(params["head"], QTensor):
-        return False  # serving trees stay on the dequantize-at-matmul path
-    if cfg.fused_ce is not None:
-        return cfg.fused_ce
+        return None  # serving trees stay on the dequantize-at-matmul path
+    if cfg.fused_ce is False:
+        return None
     if mesh is None:
-        return True
-    # Auto-on only when every real mesh axis is a batch-like dim: the token
-    # chunks then split a dimension that is data-sharded anyway.  tp's
-    # vocab-parallel head and sp's sequence sharding want the standard path.
-    return all(a in ("dp", "fsdp") for a, s in mesh.shape.items() if s > 1)
+        return "dense"
+    real = {a for a, s in mesh.shape.items() if s > 1}
+    if real <= {"dp", "fsdp"}:
+        return "dense"
+    if real <= {"dp", "fsdp", "tp"} and cfg.vocab_size % mesh.shape["tp"] == 0:
+        return "tp"
+    return "dense" if cfg.fused_ce else None
 
 
 def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
@@ -649,12 +659,17 @@ def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
     (standard switch-transformer weighting) and the realized token-overflow
     fraction is surfaced in the metrics."""
     tokens = batch["tokens"]
-    if _use_fused_ce(cfg, params, mesh):
+    mode = _fused_ce_mode(cfg, params, mesh)
+    if mode is not None:
         x, aux = forward_hidden(cfg, params, tokens[:, :-1], mesh)
-        # Pass the master-dtype head: the op computes in x.dtype but
-        # accumulates dw in fp32 and returns it at the param dtype.
-        loss = fused_linear_cross_entropy(
-            x, params["head"], tokens[:, 1:], chunk=cfg.ce_chunk)
+        # Pass the master-dtype head: the ops compute in x.dtype but
+        # accumulate dw in fp32 and return it at the param dtype.
+        if mode == "tp":
+            loss = vocab_parallel_cross_entropy(
+                x, params["head"], tokens[:, 1:], mesh, chunk=cfg.ce_chunk)
+        else:
+            loss = fused_linear_cross_entropy(
+                x, params["head"], tokens[:, 1:], chunk=cfg.ce_chunk)
     else:
         logits, aux = forward(cfg, params, tokens[:, :-1], mesh,
                               return_aux=True)

@@ -12,6 +12,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 
 def rms_norm(x, weight, eps: float = 1e-6):
@@ -146,3 +147,128 @@ def _flce_bwd(z_loss, chunk, res, g):
 
 
 fused_linear_cross_entropy.defvjp(_flce_fwd, _flce_bwd)
+
+
+def _vp_batch_axes(mesh):
+    """(data axes, total data-parallel degree) for the vocab-parallel CE."""
+    from tfmesos_tpu.parallel.sharding import data_axes
+
+    batch = data_axes(mesh)
+    nb = 1
+    for a in (batch or ()):
+        nb *= mesh.shape[a]
+    return batch, nb
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def vocab_parallel_cross_entropy(x, w, labels, mesh, axis: str = "tp",
+                                 z_loss: float = 0.0, chunk: int = 2048):
+    """``fused_linear_cross_entropy`` for a tensor-parallel (vocab-sharded)
+    unembedding: ``w`` [d, V] sharded over ``axis`` on its vocab dim, ``x``
+    [B, T, d] and ``labels`` [B, T] sharded over the data axes and
+    replicated over ``axis``.
+
+    Each device computes chunked logits against its own [d, V/tp] shard;
+    the softmax max / sum-exp / picked-label statistics psum over ``axis``
+    (the Megatron vocab-parallel pattern), so no device ever holds more
+    than a [chunk, V/tp] block — fwd or bwd.  The returned scalar is the
+    global-mean loss, identical math to the unfused path.
+
+    Forward and backward are each ONE explicit ``shard_map`` with all
+    cross-device sums written out (tp psums for the softmax statistics and
+    dx, data-axis psums for the loss and dw) — the custom VJP sits outside
+    the shard_maps, so no gradient ever flows through shard_map's implicit
+    replication/transpose rules.
+    """
+    loss, _ = _vp_fwd(x, w, labels, mesh, axis, z_loss, chunk)
+    return loss
+
+
+def _vp_fwd(x, w, labels, mesh, axis, z_loss, chunk):
+    if w.shape[-1] % mesh.shape[axis]:
+        raise ValueError(
+            f"vocab size {w.shape[-1]} must divide over {axis} "
+            f"({mesh.shape[axis]})")
+    batch, nb = _vp_batch_axes(mesh)
+
+    def local(xl, wl, ll):
+        xs, ls, n_loc = _flce_flatten(xl, ll, chunk)
+        wc = wl.astype(xl.dtype)
+        vloc = wl.shape[-1]
+        voff = jax.lax.axis_index(axis) * vloc
+
+        def body(acc, inp):
+            xc, lc = inp
+            logits = (xc @ wc).astype(jnp.float32)      # [c, Vloc]
+            m = jax.lax.pmax(jnp.max(logits, axis=-1), axis)
+            se = jax.lax.psum(
+                jnp.sum(jnp.exp(logits - m[:, None]), axis=-1), axis)
+            logz = m + jnp.log(se)
+            mine = (lc >= voff) & (lc < voff + vloc)
+            idx = jnp.clip(lc - voff, 0, vloc - 1)
+            picked = jax.lax.psum(
+                jnp.where(mine, jnp.take_along_axis(
+                    logits, idx[:, None], axis=-1)[:, 0], 0.0), axis)
+            s = jnp.sum(logz - picked)
+            if z_loss:
+                s = s + z_loss * jnp.sum(logz ** 2)
+            return acc + s, logz
+
+        total, logzs = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                    (xs, ls))
+        if batch:
+            total = jax.lax.psum(total, batch)          # global token sum
+        return total / (n_loc * nb), logzs
+
+    loss, logzs = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(batch, None, None), P(None, axis), P(batch, None)),
+        out_specs=(P(), P(batch, None)), check_vma=False)(x, w, labels)
+    return loss, (x, w, labels, logzs)
+
+
+def _vp_bwd(mesh, axis, z_loss, chunk, res, g):
+    x, w, labels, logzs = res
+    batch, nb = _vp_batch_axes(mesh)
+
+    def local(xl, wl, ll, logzs_l, gl):
+        xs, ls, n_loc = _flce_flatten(xl, ll, chunk)
+        wc = wl.astype(xl.dtype)
+        vloc = wl.shape[-1]
+        voff = jax.lax.axis_index(axis) * vloc
+        scale = gl / (n_loc * nb)
+
+        def body(dw_acc, inp):
+            xc, lc, logz = inp
+            logits = (xc @ wc).astype(jnp.float32)
+            p = jnp.exp(logits - logz[:, None])         # local softmax cols
+            if z_loss:
+                p = p * (1.0 + (2.0 * z_loss) * logz)[:, None]
+            mine = (lc >= voff) & (lc < voff + vloc)
+            idx = jnp.clip(lc - voff, 0, vloc - 1)
+            onehot = (jax.nn.one_hot(idx, vloc, dtype=jnp.float32)
+                      * mine[:, None].astype(jnp.float32))
+            dlogits = ((p - onehot) * scale).astype(xl.dtype)
+            # dx needs every vocab shard's path: psum over tp.
+            dx_c = jax.lax.psum(dlogits @ wc.T, axis)
+            dw_acc = dw_acc + jax.lax.dot_general(
+                xc, dlogits, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dw_acc, dx_c
+
+        dw, dxs = jax.lax.scan(
+            body, jnp.zeros(wl.shape, jnp.float32), (xs, ls, logzs_l))
+        if batch:
+            dw = jax.lax.psum(dw, batch)                # all tokens' sum
+        return dxs.reshape(xl.shape).astype(xl.dtype), dw.astype(wl.dtype)
+
+    dx, dw = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(batch, None, None), P(None, axis), P(batch, None),
+                  P(batch, None), P()),
+        out_specs=(P(batch, None, None), P(None, axis)),
+        check_vma=False)(x, w, labels, logzs, g)
+    return dx, dw, None
+
+
+vocab_parallel_cross_entropy.defvjp(_vp_fwd, _vp_bwd)
