@@ -25,6 +25,8 @@ def main():
     p.add_argument("--prompt-len", type=int, default=32, dest="prompt_len")
     p.add_argument("--new-tokens", type=int, default=64, dest="new_tokens")
     p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=None, dest="top_k")
+    p.add_argument("--top-p", type=float, default=None, dest="top_p")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tiny", action="store_true")
     p.add_argument("--int8", action="store_true",
@@ -58,7 +60,8 @@ def main():
 
     gen = jax.jit(lambda p_, t_: transformer.generate(
         cfg, p_, t_, args.new_tokens, rng=jax.random.PRNGKey(args.seed + 2),
-        temperature=args.temperature))
+        temperature=args.temperature, top_k=args.top_k,
+        top_p=args.top_p))
     out = gen(params, prompt)  # compile + warm
     jax.block_until_ready(out)
     t0 = time.perf_counter()

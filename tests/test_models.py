@@ -325,6 +325,58 @@ def test_generate_greedy_is_consistent():
     assert np.array_equal(np.asarray(out), seq)
 
 
+def test_sample_logits_top_k_top_p():
+    rng = jax.random.PRNGKey(0)
+    # A peaked distribution: token 3 dominates, then 7, then noise.
+    logits = jnp.array([0.0, 1.0, 0.5, 8.0, 0.2, 0.1, 0.3, 6.0] * 2
+                       ).reshape(2, 8)[:, :8]
+    keys = jax.random.split(rng, 200)
+
+    # temperature<=0 is exact argmax regardless of truncation knobs
+    out = transformer.sample_logits(logits, keys[0], temperature=0.0,
+                                    top_k=2, top_p=0.5)
+    np.testing.assert_array_equal(np.asarray(out), [3, 3])
+
+    # top_k=1 == greedy even at high temperature
+    for k in keys[:20]:
+        out = transformer.sample_logits(logits, k, temperature=5.0, top_k=1)
+        np.testing.assert_array_equal(np.asarray(out), [3, 3])
+
+    # top_k=2 only ever emits the two best tokens {3, 7}
+    draws = np.stack([np.asarray(transformer.sample_logits(
+        logits, k, temperature=3.0, top_k=2)) for k in keys])
+    assert set(np.unique(draws)) <= {3, 7}
+    assert len(set(np.unique(draws))) == 2  # and both actually occur
+
+    # tight top_p keeps only the dominating token; loose top_p ~ unfiltered
+    draws = np.stack([np.asarray(transformer.sample_logits(
+        logits, k, temperature=1.0, top_p=0.5)) for k in keys[:20]])
+    assert set(np.unique(draws)) == {3}
+    a = transformer.sample_logits(logits, keys[0], temperature=2.0)
+    b = transformer.sample_logits(logits, keys[0], temperature=2.0,
+                                  top_p=1.0, top_k=8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # degenerate knob values fail loudly, not with trace-time shape errors
+    with pytest.raises(ValueError):
+        transformer.sample_logits(logits, keys[0], top_k=0)
+    with pytest.raises(ValueError):
+        transformer.sample_logits(logits, keys[0], top_p=0.0)
+
+
+def test_generate_with_sampling_knobs():
+    cfg = TINY
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                                cfg.vocab_size)
+    out = transformer.generate(cfg, params, prompt, 6,
+                               rng=jax.random.PRNGKey(2), temperature=0.9,
+                               top_k=10, top_p=0.9)
+    assert out.shape == (2, 11)
+    assert (np.asarray(out) >= 0).all() and (
+        np.asarray(out) < cfg.vocab_size).all()
+
+
 def test_generate_moe_model():
     cfg = transformer.TransformerConfig(
         vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,

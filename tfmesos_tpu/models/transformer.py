@@ -591,10 +591,46 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     return logits, {"k": new_k, "v": new_v}
 
 
+def sample_logits(logits, key, temperature: float = 1.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """Sample token ids from ``logits`` [..., V]: greedy when
+    ``temperature <= 0``, else temperature sampling optionally truncated to
+    the ``top_k`` highest-logit tokens and/or the ``top_p`` nucleus (the
+    smallest set of tokens whose probability mass reaches ``top_p``; the
+    argmax token always survives).  Static shapes throughout — sorts and
+    masks, no dynamic gathers — so it scans/jits cleanly.
+    """
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # Keep tokens whose PRECEDING cumulative mass is < top_p (the
+        # first excluded token is the one that pushes the mass past it);
+        # the argmax's preceding mass is 0, so it always survives.
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        threshold = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                            axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
-             rng=None, temperature: float = 0.0):
+             rng=None, temperature: float = 0.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None):
     """Autoregressive generation: prefill the prompt in one pass, then one
-    fused scan step per token (KV cache, greedy or temperature sampling).
+    fused scan step per token (KV cache; greedy, temperature, top-k and/or
+    top-p nucleus sampling — see ``sample_logits``).
 
     ``prompt``: [B, Tp] int32.  Returns [B, Tp + max_new_tokens].
     """
@@ -606,11 +642,7 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     cache = init_cache(cfg, b, tp + max_new_tokens)
 
     def sample(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits.astype(jnp.float32) / temperature, axis=-1
-        ).astype(jnp.int32)
+        return sample_logits(logits, key, temperature, top_k, top_p)
 
     logits, cache = decode_step(cfg, params, cache, prompt, 0)
     rng, key = jax.random.split(rng)
