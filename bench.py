@@ -211,7 +211,8 @@ def bench_transformer_dense():
         b=4, t=2048, k=4)
 
 
-def bench_decode(batch=8, prompt_len=128, new_tokens=256, quantized=False):
+def bench_decode(batch=8, prompt_len=128, new_tokens=256, quantized=False,
+                 quantized_cache=False):
     """Steady-state decode throughput on the flagship config (KV cache,
     greedy): generated tokens per second across the batch.  The prompt is
     prefilled OUTSIDE the timed region — only the per-token scan is timed,
@@ -219,7 +220,9 @@ def bench_decode(batch=8, prompt_len=128, new_tokens=256, quantized=False):
 
     ``quantized=True`` serves weight-only int8 params (per-row absmax,
     ``transformer.quantize_params``): t=1 decode is weight-bandwidth-bound,
-    so halving the streamed bytes is the serving-side headline."""
+    so halving the streamed bytes is the serving-side headline.
+    ``quantized_cache=True`` additionally stores K/V as int8 — together
+    they are the full int8 serving configuration."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -234,7 +237,8 @@ def bench_decode(batch=8, prompt_len=128, new_tokens=256, quantized=False):
             lambda p: transformer.quantize_params(cfg, p))(params)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, cfg.vocab_size, dtype=jnp.int32)
-    cache0 = transformer.init_cache(cfg, batch, prompt_len + new_tokens)
+    cache0 = transformer.init_cache(cfg, batch, prompt_len + new_tokens,
+                                    quantized=quantized_cache)
     prefill = jax.jit(lambda p, c, t: transformer.decode_step(cfg, p, c, t, 0))
     logits, cache = prefill(params, cache0, prompt)
     tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
@@ -403,6 +407,14 @@ def main():
                     "int8 decode bench", n=1)
     if dec8:
         out["decode_int8_tokens_per_sec"] = round(max(dec8), 1)
+    dec8kv = attempts(
+        lambda: bench_decode(quantized=True, quantized_cache=True,
+                             prompt_len=1024, new_tokens=128),
+        "int8+int8kv decode bench", n=1)
+    if dec8kv:
+        # Long-prompt config: at 1k+ cached positions the cache bytes rival
+        # the weights', which is where the int8 KV cache earns its keep.
+        out["decode_int8_kv_tokens_per_sec"] = round(max(dec8kv), 1)
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:
         out.update(bw[0])

@@ -32,6 +32,8 @@ def main():
     p.add_argument("--int8", action="store_true",
                    help="serve weight-only int8 params "
                         "(transformer.quantize_params)")
+    p.add_argument("--int8-kv", action="store_true", dest="int8_kv",
+                   help="store the KV cache as int8 (per-position absmax)")
     args = p.parse_args()
 
     import jax
@@ -61,7 +63,7 @@ def main():
     gen = jax.jit(lambda p_, t_: transformer.generate(
         cfg, p_, t_, args.new_tokens, rng=jax.random.PRNGKey(args.seed + 2),
         temperature=args.temperature, top_k=args.top_k,
-        top_p=args.top_p))
+        top_p=args.top_p, quantized_cache=args.int8_kv))
     out = gen(params, prompt)  # compile + warm
     jax.block_until_ready(out)
     t0 = time.perf_counter()

@@ -468,21 +468,62 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
-               dtype=None) -> Dict[str, Any]:
+               dtype=None, quantized: bool = False) -> Dict[str, Any]:
     """KV cache for autoregressive decoding: per-layer stacked K/V buffers
-    (consumed by the same ``lax.scan`` over layers the forward uses)."""
+    (consumed by the same ``lax.scan`` over layers the forward uses).
+
+    ``quantized=True`` stores the cache as int8 :class:`QTensor`s with one
+    fp32 absmax scale per (layer, batch, position, head) — long-context
+    decode streams the whole cache every step, so halving its bytes vs
+    bf16 is the long-prompt analogue of weight-only int8.  Writes quantize
+    the incoming K/V chunk; reads dequantize at the attention einsum.
+    """
+    if quantized:
+        if dtype is not None:
+            raise ValueError("init_cache: dtype and quantized=True conflict "
+                             "(an int8 cache's dtypes are fixed)")
+        shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+        buf = QTensor(jnp.zeros(shape, jnp.int8),
+                      jnp.ones(shape[:-1] + (1,), jnp.float32))
+        return {"k": buf, "v": buf}
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, P]:
+def _cache_write(cache, chunk, pos):
+    """Insert a [B, t, H, Dh] K or V chunk at position ``pos`` of a cache
+    layer, quantizing on the way in when the cache is int8 (the same
+    per-row absmax rule as weight quantization — ops/quant.py)."""
+    if isinstance(cache, QTensor):
+        from tfmesos_tpu.ops.quant import quantize_int8_reference
+        vals, scale = quantize_int8_reference(chunk)
+        at = (0, pos, 0, 0)
+        return QTensor(
+            jax.lax.dynamic_update_slice(cache.values, vals, at),
+            jax.lax.dynamic_update_slice(cache.scales, scale, at))
+    return jax.lax.dynamic_update_slice(
+        cache, chunk.astype(cache.dtype), (0, pos, 0, 0))
+
+
+def _cache_read(cache, dtype):
+    """The [B, M, H, Dh] view attention consumes; int8 caches dequantize
+    here (the convert+scale fuses into the einsum, so HBM streams int8);
+    fp caches pass through at their own dtype (a caller-widened fp32
+    cache keeps fp32 attention math, as before)."""
+    return cache.dequantize(dtype) if isinstance(cache, QTensor) else cache
+
+
+def cache_specs(cfg: TransformerConfig, mesh: Mesh,
+                quantized: bool = False) -> Dict[str, Any]:
     """PartitionSpecs for the KV cache: batch over the data axes, heads over
     tp — the decode analogue of ``partition_specs``.  Place the cache (and
     params) with these and jit ``decode_step(..., sharded=True)``: every op
     is then a plain einsum, so GSPMD inserts the tp collectives — no manual
     decode variant needed.  With GQA the cache's head axis is ``kv_heads``,
-    so tp must divide it."""
+    so tp must divide it.  ``quantized=True`` mirrors an int8
+    ``init_cache``: each leaf becomes a QTensor of specs (scales share the
+    values' spec minus the head_dim entry)."""
     from tfmesos_tpu.parallel.sharding import data_axes
     tp = mesh.shape.get("tp", 1)
     if tp > 1 and cfg.kv_heads % tp:
@@ -490,6 +531,8 @@ def cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, P]:
             f"cache_specs: tp ({tp}) must divide kv_heads "
             f"({cfg.kv_heads}) to shard the KV cache's head axis")
     spec = _filter_spec(P(None, data_axes(mesh), None, "tp", None), mesh)
+    if quantized:
+        spec = _quantized_spec(spec)
     return {"k": spec, "v": spec}
 
 
@@ -507,7 +550,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     causal mask — bandwidth-bound at t=1, no kernel needed.
     """
     b, t, _ = x.shape
-    m = ck.shape[1]
+    m = (ck.values if isinstance(ck, QTensor) else ck).shape[1]
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads,
                                                cfg.head_dim)
@@ -518,8 +561,8 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     pos_row = jnp.broadcast_to(positions, (b, t))
     q = rope(q, pos_row, cfg.rope_theta)
     k = rope(k, pos_row, cfg.rope_theta)
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+    ck = _cache_write(ck, k, pos)
+    cv = _cache_write(cv, v, pos)
     kv = cfg.kv_heads
     g = cfg.n_heads // kv
     if t > 1 and isinstance(pos, int) and pos == 0:
@@ -534,15 +577,18 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
             o = attend(q, kf, vf, mesh=None, causal=True)
     else:
         # Grouped einsum over the cache: the KV blocks stream from HBM
-        # once at kv_heads width — never materialized at n_heads.
+        # once at kv_heads width (int8 when quantized) — never
+        # materialized at n_heads.
+        ck_r = _cache_read(ck, cfg.dtype)
+        cv_r = _cache_read(cv, cfg.dtype)
         q5 = q.reshape(b, t, kv, g, cfg.head_dim)
-        s = jnp.einsum("btkgd,bmkd->bkgtm", q5, ck).astype(jnp.float32)
+        s = jnp.einsum("btkgd,bmkd->bkgtm", q5, ck_r).astype(jnp.float32)
         s = s / math.sqrt(cfg.head_dim)
         kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
         s = jnp.where((kpos > positions[:, None])[None, None, None],
                       -jnp.inf, s)
-        probs = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
-        o = jnp.einsum("bkgtm,bmkd->btkgd", probs, cv)
+        probs = jax.nn.softmax(s, axis=-1).astype(cv_r.dtype)
+        o = jnp.einsum("bkgtm,bmkd->btkgd", probs, cv_r)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, _ = _ffn(cfg, None, lp, h)
@@ -627,10 +673,14 @@ def sample_logits(logits, key, temperature: float = 1.0,
 
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
-             top_k: Optional[int] = None, top_p: Optional[float] = None):
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             quantized_cache: bool = False):
     """Autoregressive generation: prefill the prompt in one pass, then one
     fused scan step per token (KV cache; greedy, temperature, top-k and/or
     top-p nucleus sampling — see ``sample_logits``).
+
+    ``quantized_cache`` stores K/V as int8 (``init_cache``) — combined
+    with ``quantize_params`` this is the full int8 serving config.
 
     ``prompt``: [B, Tp] int32.  Returns [B, Tp + max_new_tokens].
     """
@@ -639,7 +689,8 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         return prompt
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    cache = init_cache(cfg, b, tp + max_new_tokens)
+    cache = init_cache(cfg, b, tp + max_new_tokens,
+                       quantized=quantized_cache)
 
     def sample(logits, key):
         return sample_logits(logits, key, temperature, top_k, top_p)
@@ -720,6 +771,15 @@ def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
     return loss, metrics
 
 
+def _quantized_spec(s: P) -> QTensor:
+    """The PartitionSpec pair for a QTensor leaf: ``values`` takes the
+    weight's spec, ``scales`` the same minus the last dim (their trailing
+    dim is 1, which cannot shard)."""
+    parts = tuple(s)
+    return QTensor(values=s,
+                   scales=P(*(parts[:-1] + (None,))) if parts else P())
+
+
 def _filter_spec(spec: P, mesh: Mesh) -> P:
     """Drop axes the mesh doesn't have (size-1 axes included)."""
     def keep(a):
@@ -782,17 +842,11 @@ def quantized_partition_specs(cfg: TransformerConfig, mesh: Mesh
     works exactly as with fp params (``decode_step(..., sharded=True)``).
     """
     specs = partition_specs(cfg, mesh)
-
-    def wrap(s):
-        parts = tuple(s)
-        scales = P(*(parts[:-1] + (None,))) if parts else P()
-        return QTensor(values=s, scales=scales)
-
-    layers = {k: (wrap(v) if _quantizable(cfg, k) else v)
+    layers = {k: (_quantized_spec(v) if _quantizable(cfg, k) else v)
               for k, v in specs["layers"].items()}
     return {
-        "embed": wrap(specs["embed"]),
+        "embed": _quantized_spec(specs["embed"]),
         "layers": layers,
         "norm_f": specs["norm_f"],
-        "head": wrap(specs["head"]),
+        "head": _quantized_spec(specs["head"]),
     }
