@@ -28,6 +28,14 @@ def main():
     p.add_argument("--batch_size", type=int, default=8, help="global batch")
     p.add_argument("--seq_len", type=int, default=2048)
     p.add_argument("--learning_rate", type=float, default=3e-4)
+    p.add_argument("--warmup", type=int, default=0,
+                   help="linear LR warmup steps")
+    p.add_argument("--lr-schedule", choices=["constant", "cosine"],
+                   default="constant", dest="lr_schedule",
+                   help="decay after warmup: constant or cosine to 10%% "
+                        "of peak over --steps")
+    p.add_argument("--grad-clip", type=float, default=0.0, dest="grad_clip",
+                   help="global-norm gradient clipping (0 = off)")
     p.add_argument("--mesh", type=str, default=None,
                    help="override mesh axes, e.g. dp=2,sp=2,tp=2 (default: "
                         "cluster-provided or all-dp)")
@@ -89,7 +97,22 @@ def main():
               f"experts={cfg.n_experts}", flush=True)
 
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-    opt = optax.adamw(args.learning_rate, weight_decay=0.01)
+    if args.lr_schedule == "cosine" or args.warmup:
+        # warmup=0 starts at peak (no wasted lr=0 step); degenerate step
+        # counts clamp so the cosine window is always >= 1 step.
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=(0.0 if args.warmup else args.learning_rate),
+            peak_value=args.learning_rate,
+            warmup_steps=args.warmup,
+            decay_steps=max(args.steps, args.warmup + 1),
+            end_value=(args.learning_rate * 0.1
+                       if args.lr_schedule == "cosine"
+                       else args.learning_rate))
+    else:
+        lr = args.learning_rate
+    opt = optax.adamw(lr, weight_decay=0.01)
+    if args.grad_clip > 0:
+        opt = optax.chain(optax.clip_by_global_norm(args.grad_clip), opt)
     step = make_train_step(
         lambda p_, b_: transformer.loss_fn(cfg, p_, b_, mesh), opt, mesh=mesh,
         param_specs=transformer.partition_specs(cfg, mesh),
