@@ -50,6 +50,9 @@ def main():
     p.add_argument("--virtual-stages", type=int, default=1,
                    dest="virtual_stages",
                    help="interleaved chunks per pp device (circular only)")
+    p.add_argument("--kv-heads", type=int, default=None, dest="kv_heads",
+                   help="grouped-query attention: share each K/V head "
+                        "across n_heads/kv_heads query heads")
     p.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring",
                    dest="sp_impl",
                    help="sequence parallelism over the sp axis: ppermute "
@@ -81,7 +84,7 @@ def main():
             n_heads=max(4, 2 * mesh.shape.get("tp", 1)), d_ff=128,
             max_seq_len=args.seq_len, dtype=jnp.float32,
             n_experts=args.moe, top_k=args.top_k, moe_impl="switch",
-            pp_schedule=args.pp_schedule,
+            pp_schedule=args.pp_schedule, n_kv_heads=args.kv_heads,
             pp_virtual_stages=args.virtual_stages, sp_impl=args.sp_impl)
         seq_len = min(args.seq_len, 64 * max(1, mesh.shape.get("sp", 1)))
     else:
@@ -89,7 +92,7 @@ def main():
             vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
             max_seq_len=args.seq_len, n_experts=args.moe,
             top_k=args.top_k, moe_impl="switch",
-            pp_schedule=args.pp_schedule,
+            pp_schedule=args.pp_schedule, n_kv_heads=args.kv_heads,
             pp_virtual_stages=args.virtual_stages, sp_impl=args.sp_impl)
         seq_len = args.seq_len
     if ctx.is_chief:

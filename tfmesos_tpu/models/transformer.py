@@ -333,14 +333,10 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
     v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.kv_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    if cfg.kv_heads != cfg.n_heads:
-        # GQA: query head h reads kv head h // (H/KV).  Repeating up front
-        # keeps every attention impl (flash/ring/ulysses) unchanged; the
-        # training-time memory cost matches MHA, the KV-cache saving is
-        # realized in the decode path, which stores kv_heads only.
-        rep = cfg.n_heads // cfg.kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA (kv_heads < n_heads) flows through attend() at kv width: the
+    # flash kernels map q head h -> kv head h // (H/KV) in their index
+    # maps, so training never materializes the repeated K/V; the sp impls
+    # broadcast up internally.
     o = attend(q, k, v, mesh=mesh, causal=True, sp_impl=cfg.sp_impl)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
@@ -568,13 +564,11 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     if t > 1 and isinstance(pos, int) and pos == 0:
         # Prefill from an empty cache: the chunk only attends to itself —
         # [t, t] instead of a [t, M] score tensor over the (mostly empty)
-        # cache.
-        kf = jnp.repeat(k, g, axis=2) if g > 1 else k
-        vf = jnp.repeat(v, g, axis=2) if g > 1 else v
+        # cache.  GQA stays at kv width (both impls group internally).
         if sharded:
-            o = mha_reference(q, kf, vf, causal=True)
+            o = mha_reference(q, k, v, causal=True)
         else:
-            o = attend(q, kf, vf, mesh=None, causal=True)
+            o = attend(q, k, v, mesh=None, causal=True)
     else:
         # Grouped einsum over the cache: the KV blocks stream from HBM
         # once at kv_heads width (int8 when quantized) — never

@@ -25,9 +25,18 @@ NEG_INF = float("-inf")
 
 
 def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
-    """Plain-XLA scaled-dot-product attention (ground truth / fallback)."""
+    """Plain-XLA scaled-dot-product attention (ground truth / fallback).
+
+    Grouped-query attention is accepted directly: when ``k``/``v`` carry
+    fewer heads than ``q`` (q heads per kv head = H // KV), they are
+    broadcast up here — the kernels do the same mapping without
+    materializing the repeat."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
@@ -53,6 +62,7 @@ class _FlashCfg(NamedTuple):
     block_q: int
     block_k: int
     interpret: bool
+    q_per_kv: int = 1  # GQA group size (q heads per kv head); 1 = MHA
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
@@ -110,14 +120,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
 
 def _flash_forward(cfg: _FlashCfg, q, k, v):
     b, t, h, d = q.shape
+    g = h // k.shape[2]  # q heads per kv head (1 = plain MHA)
     # [B, T, H, D] -> [B, H, T, D]: (seq, head_dim) trailing for TPU tiling.
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     grid = (b, t // cfg.block_q, h)
     q_spec = pl.BlockSpec((1, 1, cfg.block_q, d),
                           lambda bi, qi, hi: (bi, hi, qi, 0),
                           memory_space=pltpu.VMEM)
+    # GQA without materializing the repeat: q head hi reads kv head hi//g
+    # straight from the narrow K/V arrays via the index map.
     kv_spec = pl.BlockSpec((1, 1, k.shape[1], d),
-                           lambda bi, qi, hi: (bi, hi, 0, 0),
+                           lambda bi, qi, hi: (bi, hi // g, 0, 0),
                            memory_space=pltpu.VMEM)
     lse_spec = pl.BlockSpec((1, 1, cfg.block_q, 1),
                             lambda bi, qi, hi: (bi, hi, qi, 0),
@@ -191,16 +204,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, cfg: _FlashCfg):
-    """dk and dv, one (batch, head, k-block, q-block) grid step; q innermost.
+    """dk and dv, one (batch, KV head, k-block, q-block x group) grid step;
+    the innermost dim runs the group's q heads for each q-block.
 
     Q/do/lse/Δ blocks stream while the dk/dv output blocks accumulate in
-    VMEM:  dv += pᵀ·do,  dk += dsᵀ·q·scale.  Under causality, q-blocks
+    VMEM:  dv += pᵀ·do,  dk += dsᵀ·q·scale.  With grouped-query attention
+    (``cfg.q_per_kv > 1``) this k-block's gradient sums over every query
+    head sharing the kv head — the group ride-along on the streamed dim
+    does that without a second reduction pass.  Under causality, q-blocks
     strictly before the diagonal see none of this k-block and are skipped.
     """
     bq, bk = cfg.block_q, cfg.block_k
-    ki, i = pl.program_id(2), pl.program_id(3)
+    ki, e = pl.program_id(2), pl.program_id(3)
+    i = e // cfg.q_per_kv  # q-block index (e also enumerates the group)
 
-    @pl.when(i == 0)
+    @pl.when(e == 0)
     def _init():
         dk_ref[0, 0, :, :] = jnp.zeros_like(dk_ref[0, 0, :, :])
         dv_ref[0, 0, :, :] = jnp.zeros_like(dv_ref[0, 0, :, :])
@@ -250,12 +268,13 @@ def _mha_bwd_pallas(cfg: _FlashCfg, q, k, v, o, lse, do, out_dtype=None):
     """
     b, t, h, d = q.shape
     tk = k.shape[1]
+    g = h // k.shape[2]  # q heads per kv head (1 = plain MHA)
     # The backward picks its own blocks: grid-step overhead dominates at the
     # forward's numbers (measured on v5e at B4/T2048/H8/D128 bf16: 128-blocks
     # run 1.8x slower than 512), and unlike the forward there is no online-
     # softmax state growing with block_q.
     bq, bk = _pick_block(t), _pick_block(tk)
-    cfg = cfg._replace(block_q=bq, block_k=bk)
+    cfg = cfg._replace(block_q=bq, block_k=bk, q_per_kv=g)
     # [B, T, H, D] -> [B, H, T, D]: (seq, head_dim) trailing for TPU tiling.
     qt, kt, vt, dot_ = (x.transpose(0, 2, 1, 3) for x in (q, k, v, do))
     # Δ = rowsum(do ⊙ o): one fused elementwise+reduce pass, cheaper as XLA
@@ -277,11 +296,17 @@ def _mha_bwd_pallas(cfg: _FlashCfg, q, k, v, o, lse, do, out_dtype=None):
                             lambda bi, hi, i, j: (bi, hi, j, 0),
                             memory_space=pltpu.VMEM)
 
-    # dq grid: q-blocks outer (accumulator), k-blocks streamed.
+    def kv_dq_spec(block, width):  # kv operand in the dq grid (GQA map)
+        return pl.BlockSpec((1, 1, block, width),
+                            lambda bi, hi, i, j: (bi, hi // g, j, 0),
+                            memory_space=pltpu.VMEM)
+
+    # dq grid: q-blocks outer (accumulator), k-blocks streamed; q head hi
+    # reads kv head hi // g.
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, cfg=cfg),
         grid=(b, h, t // bq, tk // bk),
-        in_specs=[outer_spec(bq, d), inner_spec(bk, d), inner_spec(bk, d),
+        in_specs=[outer_spec(bq, d), kv_dq_spec(bk, d), kv_dq_spec(bk, d),
                   outer_spec(bq, d), outer_spec(bq, 1), outer_spec(bq, 1)],
         out_specs=outer_spec(bq, d),
         out_shape=jax.ShapeDtypeStruct(qt.shape, jnp.float32),
@@ -293,13 +318,26 @@ def _mha_bwd_pallas(cfg: _FlashCfg, q, k, v, o, lse, do, out_dtype=None):
             transcendentals=b * h * t * tk),
     )(qt, kt, vt, dot_, lse, delta)
 
-    # dk/dv grid: k-blocks outer (accumulators), q-blocks streamed.
+    # dk/dv grid: one cell per KV head and k-block (accumulators); the
+    # streamed dim enumerates (q-block x group) pairs so a kv head's
+    # gradient sums over every q head sharing it.
+    def q_dkv_spec(block, width):  # q-side operands in the dkv grid
+        return pl.BlockSpec(
+            (1, 1, block, width),
+            lambda bi, hi, i, e: (bi, hi * g + e % g, e // g, 0),
+            memory_space=pltpu.VMEM)
+
+    def kv_dkv_spec(block, width):
+        return pl.BlockSpec((1, 1, block, width),
+                            lambda bi, hi, i, e: (bi, hi, i, 0),
+                            memory_space=pltpu.VMEM)
+
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, cfg=cfg),
-        grid=(b, h, tk // bk, t // bq),
-        in_specs=[inner_spec(bq, d), outer_spec(bk, d), outer_spec(bk, d),
-                  inner_spec(bq, d), inner_spec(bq, 1), inner_spec(bq, 1)],
-        out_specs=[outer_spec(bk, d), outer_spec(bk, d)],
+        grid=(b, h // g, tk // bk, (t // bq) * g),
+        in_specs=[q_dkv_spec(bq, d), kv_dkv_spec(bk, d), kv_dkv_spec(bk, d),
+                  q_dkv_spec(bq, d), q_dkv_spec(bq, 1), q_dkv_spec(bq, 1)],
+        out_specs=[kv_dkv_spec(bk, d), kv_dkv_spec(bk, d)],
         out_shape=[jax.ShapeDtypeStruct(kt.shape, jnp.float32),
                    jax.ShapeDtypeStruct(vt.shape, jnp.float32)],
         interpret=cfg.interpret,
@@ -343,9 +381,17 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     backend is TPU (or when ``interpret=True`` for tests) and shapes are
     block-aligned; otherwise the XLA reference path runs — same numerics,
     same signature, so model code never branches.
+
+    Grouped-query attention: ``k``/``v`` may carry ``H // g`` heads for any
+    integer ``g``; the kernels map q head ``h`` to kv head ``h // g`` via
+    their index maps, so the repeat is never materialized.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[2] % k.shape[2] or k.shape[2] != v.shape[2]:
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of kv heads "
+            f"({k.shape[2]}/{v.shape[2]}, which must agree)")
     t = q.shape[1]
     # Treat the block arguments as targets: run with the largest Mosaic-legal
     # (8-aligned or full-dim) divisor at or under each — so t=1280 still gets
@@ -385,6 +431,13 @@ def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
 
     batch = data_axes(mesh)
     heads = "tp" if "tp" in mesh.shape and mesh.shape["tp"] > 1 else None
+    if heads is not None and k.shape[2] % mesh.shape["tp"]:
+        # GQA/MQA with tp not dividing kv_heads: shard at full head width
+        # (tp | kv_heads is also exactly when per-shard h//g grouping stays
+        # aligned, so narrower K/V can only ride when it holds).
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     spec = P(batch, None, heads, None)
     if batch is None and heads is None:
         return flash_attention(q, k, v, causal=causal, scale=scale, **kw)
@@ -401,8 +454,16 @@ def attend(q, k, v, mesh=None, causal: bool = True,
     """One attention entry point for model code: sequence parallelism when
     the mesh shards the sequence (``sp``) — ring attention by default, or
     Ulysses all-to-all with ``sp_impl="ulysses"`` — sharded flash kernel
-    when it shards batch/heads, plain flash/reference otherwise."""
+    when it shards batch/heads, plain flash/reference otherwise.
+
+    Grouped-query K/V (fewer heads than q) pass straight through to the
+    flash/reference paths (head-index mapping, no repeat); the sp impls
+    work per-head, so GQA inputs are broadcast up for them here."""
     if mesh is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1:
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         if sp_impl == "ulysses":
             from tfmesos_tpu.parallel.ulysses import ulysses_attention
             return ulysses_attention(q, k, v, mesh, causal=causal,
