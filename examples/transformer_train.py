@@ -63,6 +63,11 @@ def main():
     p.add_argument("--data-dtype", type=str, default="uint16",
                    dest="data_dtype", choices=["uint16", "uint32"])
     p.add_argument("--tiny", action="store_true")
+    p.add_argument("--ckpt-dir", type=str, default=None, dest="ckpt_dir",
+                   help="Orbax checkpoint directory; restarting with the "
+                        "same dir resumes from the latest step (params, "
+                        "optimizer state AND the data stream position)")
+    p.add_argument("--ckpt-every", type=int, default=50, dest="ckpt_every")
     args = p.parse_args()
 
     import jax
@@ -122,6 +127,25 @@ def main():
         batch_spec_tree=NamedSharding(mesh, batch_spec(mesh, extra_dims=1)))
     params, opt_state = step.place(params, opt.init(params))
 
+    start_step = 0
+    ckpt = None
+    if args.ckpt_dir:
+        from tfmesos_tpu.train.checkpoint import CheckpointManager
+        ckpt = CheckpointManager(args.ckpt_dir)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            params, opt_state = ckpt.restore((params, opt_state))
+            start_step = latest
+            if ctx.is_chief:
+                print(f"resumed from step {start_step}", flush=True)
+            if start_step >= args.steps:
+                ckpt.close()
+                if ctx.is_chief:
+                    print(f"already trained to step {start_step} "
+                          f">= --steps {args.steps}; nothing to do",
+                          flush=True)
+                return 0
+
     local_bs = max(1, args.batch_size // max(1, ctx.world_size))
     global_bs = local_bs * max(1, ctx.world_size)
     if args.data:
@@ -136,22 +160,30 @@ def main():
                 f"{cfg.vocab_size}; re-tokenize or adjust the config")
         stream = ds.batches(local_bs, seq_len, rank=ctx.rank,
                             world_size=max(1, ctx.world_size),
-                            seed=100 + ctx.rank)
+                            seed=100 + ctx.rank, start_step=start_step)
     else:
         stream = datalib.token_batches(local_bs, seq_len, cfg.vocab_size,
-                                       seed=100 + ctx.rank)
+                                       seed=100 + ctx.rank,
+                                       start_step=start_step)
     gen = datalib.prefetch(stream, mesh=mesh)
     t0 = time.perf_counter()
     metrics = {}
-    for i in range(args.steps):
+    for i in range(start_step, args.steps):
         params, opt_state, metrics = step(params, opt_state, next(gen))
         if ctx.is_chief and (i + 1) % 10 == 0:
             print(f"step {i + 1}: loss={float(metrics['loss']):.4f} "
                   f"ppl={float(metrics['perplexity']):.2f}", flush=True)
+        if ckpt is not None and (i + 1) % args.ckpt_every == 0:
+            ckpt.save(i + 1, (params, opt_state), wait=False)
     final_loss = float(metrics["loss"])  # host fetch drains the chain
+    if ckpt is not None:
+        if start_step < args.steps:
+            ckpt.save(args.steps, (params, opt_state), wait=False)
+        ckpt.close()
     dt = time.perf_counter() - t0
     if ctx.is_chief:
-        tokens_per_sec = args.steps * global_bs * seq_len / dt
+        tokens_per_sec = max(0, args.steps - start_step) * global_bs \
+            * seq_len / dt
         print(f"Training elapsed time: {dt:f} s", flush=True)
         print(f"tokens/sec: {tokens_per_sec:.0f} "
               f"(per chip: {tokens_per_sec / jax.device_count():.0f})",

@@ -28,7 +28,7 @@ def test_roundtrip_and_window_contents(tmp_path):
 
 def test_determinism_and_dtype_uint32(tmp_path):
     path = str(tmp_path / "big.bin")
-    tokens = np.arange(65000, 66000)  # crosses the uint16 boundary
+    tokens = np.arange(66000, 67000)  # every value is past the uint16 range
     TokenFileDataset.write(path, tokens, dtype="uint32")
     ds = TokenFileDataset(path, dtype="uint32")
     a = next(ds.batches(2, 8, seed=3))["tokens"]
@@ -69,3 +69,38 @@ def test_errors(tmp_path):
     TokenFileDataset.write(empty, np.array([1]))
     with pytest.raises(ValueError, match="too few"):
         TokenFileDataset(empty)
+
+
+def test_start_step_fast_forward_matches_full_stream(tmp_path):
+    """Every generator resumed with start_step=k must reproduce exactly the
+    batches a fresh stream yields from position k on — the data half of
+    resume-from-checkpoint."""
+    from tfmesos_tpu.train.data import (SyntheticMNIST, image_batches,
+                                        token_batches)
+
+    def take(it, n):
+        return [next(it) for _ in range(n)]
+
+    def assert_streams_equal(fresh, resumed):
+        for a, b in zip(fresh, resumed):
+            for key in a:
+                np.testing.assert_array_equal(a[key], b[key])
+
+    ds = SyntheticMNIST(dim=16)
+    assert_streams_equal(take(ds.batches(4, seed=7), 5)[3:],
+                         take(ds.batches(4, seed=7, start_step=3), 2))
+
+    assert_streams_equal(
+        take(token_batches(2, 8, 64, seed=5), 5)[3:],
+        take(token_batches(2, 8, 64, seed=5, start_step=3), 2))
+
+    assert_streams_equal(
+        take(image_batches(2, 8, 4, seed=3), 4)[2:],
+        take(image_batches(2, 8, 4, seed=3, start_step=2), 2))
+
+    path = str(tmp_path / "toks.bin")
+    TokenFileDataset.write(path, np.arange(5000) % 251)
+    tfd = TokenFileDataset(path)
+    assert_streams_equal(
+        take(tfd.batches(2, 8, seed=11), 6)[4:],
+        take(tfd.batches(2, 8, seed=11, start_step=4), 2))

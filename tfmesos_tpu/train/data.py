@@ -17,6 +17,14 @@ from typing import Dict, Iterator
 import numpy as np
 
 
+def _step_rng(seed: int, step: int) -> np.random.RandomState:
+    """Independent RNG for (stream seed, step): seeding MT19937 with the
+    pair (array seeds hash all entries) makes any step reachable in O(1) —
+    resume never replays or regenerates skipped steps' draws."""
+    return np.random.RandomState(
+        np.array([seed & 0x7FFFFFFF, step], dtype=np.uint32))
+
+
 @dataclass
 class SyntheticMNIST:
     n_classes: int = 10
@@ -28,29 +36,42 @@ class SyntheticMNIST:
         rng = np.random.RandomState(self.seed)
         self.templates = rng.rand(self.n_classes, self.dim).astype(np.float32)
 
-    def batches(self, batch_size: int, seed: int = 1) -> Iterator[Dict[str, np.ndarray]]:
-        rng = np.random.RandomState(seed)
+    def batches(self, batch_size: int, seed: int = 1, start_step: int = 0
+                ) -> Iterator[Dict[str, np.ndarray]]:
+        """``start_step`` starts the stream at that step: each batch is
+        drawn from a per-step RNG (``_step_rng``), so a resumed run jumps
+        straight to the checkpointed step in O(1) and sees exactly the
+        batches a fresh run would from there — the data half of
+        resume-from-checkpoint."""
+        step = start_step
         while True:
+            rng = _step_rng(seed, step)
             labels = rng.randint(0, self.n_classes, size=batch_size)
             images = self.templates[labels] + self.noise * rng.randn(
                 batch_size, self.dim).astype(np.float32)
             yield {"image": np.clip(images, 0.0, 1.0).astype(np.float32),
                    "label": labels.astype(np.int32)}
+            step += 1
 
     def eval_batch(self, batch_size: int = 1000, seed: int = 999):
         return next(self.batches(batch_size, seed=seed))
 
 
 def token_batches(batch_size: int, seq_len: int, vocab_size: int,
-                  seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+                  seed: int = 0, start_step: int = 0
+                  ) -> Iterator[Dict[str, np.ndarray]]:
     """Endless [B, T+1] token batches with mild structure (bigram-ish) so a
-    language model has something learnable."""
-    rng = np.random.RandomState(seed)
-    # Zipf-ish unigram distribution + deterministic successor bias.
+    language model has something learnable.  ``start_step`` jumps straight
+    to that step (per-step RNG — see ``SyntheticMNIST.batches``)."""
+    # Zipf-ish unigram distribution + deterministic successor bias; the
+    # vocabulary structure comes from the base seed, not the step.
     ranks = np.arange(1, vocab_size + 1)
     probs = (1.0 / ranks) / np.sum(1.0 / ranks)
-    successor = rng.permutation(vocab_size)
+    successor = np.random.RandomState(seed).permutation(vocab_size)
+    step = start_step
     while True:
+        rng = _step_rng(seed, step)
+        step += 1
         base = rng.choice(vocab_size, size=(batch_size, seq_len + 1), p=probs)
         # half the positions follow the deterministic successor of their
         # predecessor: learnable signal
@@ -63,7 +84,7 @@ def token_batches(batch_size: int, seq_len: int, vocab_size: int,
 
 def image_batches(batch_size: int, image_size: int, n_classes: int,
                   seed: int = 0, dataset_seed: int = 1234,
-                  ) -> Iterator[Dict[str, np.ndarray]]:
+                  start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
     """Synthetic labeled images: class-dependent low-frequency pattern +
     noise (stands in for ImageNet in the vision trainers; no egress).
 
@@ -72,10 +93,12 @@ def image_batches(batch_size: int, image_size: int, n_classes: int,
     giving each data-parallel worker a different definition of the classes
     (same split as SyntheticMNIST's templates vs batches)."""
     freqs = np.random.RandomState(dataset_seed).rand(n_classes, 2) * 4 + 1
-    rng = np.random.RandomState(seed)
     xs = np.linspace(0, np.pi, image_size, dtype=np.float32)
     grid_x, grid_y = np.meshgrid(xs, xs)
+    step = start_step
     while True:
+        rng = _step_rng(seed, step)
+        step += 1
         labels = rng.randint(0, n_classes, size=batch_size)
         base = np.sin(freqs[labels, 0, None, None] * grid_x[None]) * \
             np.cos(freqs[labels, 1, None, None] * grid_y[None])
@@ -157,9 +180,12 @@ class TokenFileDataset:
         np.asarray(tokens).astype(np.dtype(dtype)).tofile(path)
 
     def batches(self, batch_size: int, seq_len: int, rank: int = 0,
-                world_size: int = 1, seed: int = None
+                world_size: int = 1, seed: int = None, start_step: int = 0
                 ) -> Iterator[Dict[str, np.ndarray]]:
-        """Endless [B, T+1] next-token batches from this rank's stripe."""
+        """Endless [B, T+1] next-token batches from this rank's stripe.
+
+        ``start_step`` starts at that step for exact O(1) resume (per-step
+        RNG; no skipped data is drawn or read)."""
         if not 0 <= rank < world_size:
             raise ValueError(f"rank {rank} outside world of {world_size}")
         n = self.tokens.size
@@ -169,9 +195,12 @@ class TokenFileDataset:
             raise ValueError(
                 f"stripe [{lo}, {hi}) of {self.path} shorter than one "
                 f"window ({seq_len + 1}); fewer ranks or a bigger file")
-        rng = np.random.RandomState(self.seed if seed is None else seed)
+        base_seed = self.seed if seed is None else seed
         starts_max = hi - (seq_len + 1)
+        step = start_step
         while True:
-            starts = rng.randint(lo, starts_max + 1, size=batch_size)
+            starts = _step_rng(base_seed, step).randint(
+                lo, starts_max + 1, size=batch_size)
+            step += 1
             batch = np.stack([self.tokens[s:s + seq_len + 1] for s in starts])
             yield {"tokens": batch.astype(np.int32)}
