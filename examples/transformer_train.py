@@ -130,6 +130,9 @@ def main():
     start_step = 0
     ckpt = None
     if args.ckpt_dir:
+        if args.ckpt_every < 1:
+            raise SystemExit(f"--ckpt-every must be >= 1, got "
+                             f"{args.ckpt_every}")
         from tfmesos_tpu.train.checkpoint import CheckpointManager
         ckpt = CheckpointManager(args.ckpt_dir)
         latest = ckpt.latest_step()
@@ -177,7 +180,7 @@ def main():
             ckpt.save(i + 1, (params, opt_state), wait=False)
     final_loss = float(metrics["loss"])  # host fetch drains the chain
     if ckpt is not None:
-        if start_step < args.steps:
+        if start_step < args.steps and args.steps % args.ckpt_every:
             ckpt.save(args.steps, (params, opt_state), wait=False)
         ckpt.close()
     dt = time.perf_counter() - t0

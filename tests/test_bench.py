@@ -50,3 +50,9 @@ def test_decode_bench_int8_smoke():
     toks = bench.bench_decode(batch=1, prompt_len=8, new_tokens=4,
                               quantized=True)
     assert np.isfinite(toks) and toks > 0
+
+
+def test_decode_bench_int8_kv_smoke():
+    toks = bench.bench_decode(batch=1, prompt_len=8, new_tokens=4,
+                              quantized=True, quantized_cache=True)
+    assert np.isfinite(toks) and toks > 0

@@ -20,6 +20,10 @@ def setup_logger(logger: logging.Logger, quiet: bool = False) -> None:
     handler = logging.StreamHandler()
     handler.setFormatter(logging.Formatter(_FORMAT))
     logger.addHandler(handler)
+    # We attach our own handler, so don't ALSO bubble up to the root
+    # handler third-party libs (absl/orbax) install — each record would
+    # print twice.
+    logger.propagate = False
     level = os.environ.get("TPUMESOS_LOGLEVEL", "INFO").upper()
     logger.setLevel(getattr(logging, level, logging.INFO))
 
