@@ -34,6 +34,7 @@ roofline instead (the roofline that actually bounds single-chip kernels).
 
 import json
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -345,11 +346,61 @@ def bench_bandwidth(sizes=None):
     return out
 
 
-def main():
-    import jax
+def _probe_device(timeout_s: float) -> Optional[str]:
+    """Confirm the accelerator answers before committing to the benches.
 
+    A wedged remote-attach relay HANGS jax backend init rather than
+    erroring (a killed client's claim can stay held upstream); probing in
+    a throwaway subprocess with a deadline turns an all-day hang into a
+    parseable failure line the driver can record."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    code = ("import jax, numpy as np; x = jax.numpy.ones((64, 64)); "
+            "print(float(np.asarray((x @ x).sum())))")
+    # Own session + killpg on timeout: the child's backend init may spawn
+    # helpers that inherit the pipes, and killing only the direct child
+    # would leave communicate() blocked on the helpers' open write ends —
+    # the exact hang this probe exists to prevent.
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            start_new_session=True)
+    try:
+        _, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return f"device probe hung for {timeout_s:.0f}s (relay wedged?)"
+    if proc.returncode != 0:
+        tail = stderr.decode(errors="replace").strip().splitlines()
+        return f"device probe failed rc={proc.returncode}: " + \
+            (tail[-1] if tail else "")
+    return None
+
+
+def main():
+    import os
     import sys
     import traceback
+
+    err = _probe_device(float(os.environ.get("TPUMESOS_BENCH_PROBE_TIMEOUT",
+                                             "300")))
+    if err is not None:
+        print(json.dumps({
+            "metric": "mnist_replica_steps_per_sec_per_chip",
+            "value": None, "unit": "steps/s/chip", "vs_baseline": None,
+            "error": err}), flush=True)
+        raise SystemExit(err)
+
+    import jax
 
     # Best-of-N: the remote-attach relay adds ±40% latency jitter between
     # runs; the max is the least-interference estimate of chip capability.

@@ -156,6 +156,36 @@ def test_vocab_parallel_ce_matches_reference(axes):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_vocab_parallel_ce_through_trainer_machinery():
+    """The tp fused-CE path composed with the full trainer stack:
+    make_train_step with steps_per_call > 1 AND grad_accum > 1 on a
+    dp x tp mesh must train (finite, decreasing-ish loss) — custom VJPs
+    inside shard_maps inside scan inside scan inside jit."""
+    import optax
+
+    from tfmesos_tpu.train.trainer import make_train_step
+
+    mesh = build_mesh({"dp": 4, "tp": 2})
+    params = transformer.init_params(TINY, jax.random.PRNGKey(0))
+    assert transformer._fused_ce_mode(TINY, params, mesh) == "tp"
+    opt = optax.adamw(3e-3)
+    step = make_train_step(
+        lambda p, b: transformer.loss_fn(TINY, p, b, mesh), opt, mesh=mesh,
+        param_specs=transformer.partition_specs(TINY, mesh),
+        steps_per_call=2, grad_accum=2)
+    params, opt_state = step.place(params, opt.init(params))
+
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(6):
+        batch = {"tokens": rng.randint(0, TINY.vocab_size,
+                                       size=(2, 8, 17)).astype(np.int32)}
+        params, opt_state, metrics = step(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
 def test_fused_ce_on_dp_mesh_matches_single_device():
     mesh = build_mesh({"dp": 8})
     params = transformer.init_params(TINY, jax.random.PRNGKey(0))
