@@ -358,7 +358,13 @@ def _probe_device(timeout_s: float) -> Optional[str]:
     import subprocess
     import sys
 
-    code = ("import jax, numpy as np; x = jax.numpy.ones((64, 64)); "
+    # The site PJRT plugin pins the platform via jax.config at interpreter
+    # start, so the JAX_PLATFORMS env var alone loses; re-assert it through
+    # the config so a deliberately CPU-forced bench run probes CPU.
+    code = ("import os, jax, numpy as np\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "x = jax.numpy.ones((64, 64))\n"
             "print(float(np.asarray((x @ x).sum())))")
     # Own session + killpg on timeout: the child's backend init may spawn
     # helpers that inherit the pipes, and killing only the direct child
