@@ -457,17 +457,18 @@ def attend(q, k, v, mesh=None, causal: bool = True,
     when it shards batch/heads, plain flash/reference otherwise.
 
     Grouped-query K/V (fewer heads than q) pass straight through to the
-    flash/reference paths (head-index mapping, no repeat); the sp impls
-    work per-head, so GQA inputs are broadcast up for them here."""
+    flash/reference paths (head-index mapping, no repeat) and to Ulysses
+    (narrow-width K/V all-to-all when sp divides kv_heads); the ring works
+    per-head, so GQA inputs are broadcast up for it here."""
     if mesh is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1:
-        if k.shape[2] != q.shape[2]:
-            rep = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
         if sp_impl == "ulysses":
             from tfmesos_tpu.parallel.ulysses import ulysses_attention
             return ulysses_attention(q, k, v, mesh, causal=causal,
                                      scale=scale)
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         if sp_impl != "ring":
             raise ValueError(f"sp_impl must be 'ring' or 'ulysses', "
                              f"got {sp_impl!r}")

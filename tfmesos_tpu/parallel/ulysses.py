@@ -5,10 +5,12 @@ attention — the reference has neither, SURVEY §2.7/§5).  Activations arrive
 sequence-sharded ``[B, T/sp, H, D]``; one ``all_to_all`` re-shards them from
 the sequence dim to the heads dim, so every device runs EXACT attention over
 the full sequence for its ``H/sp`` heads; a second ``all_to_all`` swaps the
-sharding back.  Per device that is two a2a hops per attention call versus
-the ring's ``sp`` ppermute hops — cheaper on ICI whenever heads divide
-evenly — while the flash kernel sees full-length sequences (its causal
-block skipping works globally, where the ring must mask per shard).
+sharding back.  Per device that is two a2a hops per attention call (three —
+q, stacked K/V, output — on the grouped-query path, which moves H/KV-fold
+fewer K/V bytes in exchange) versus the ring's ``sp`` ppermute hops —
+cheaper on ICI whenever heads divide evenly — while the flash kernel sees
+full-length sequences (its causal block skipping works globally, where the
+ring must mask per shard).
 
 Trade-offs vs ring attention (both exact):
 
@@ -49,11 +51,30 @@ def ulysses_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
     from tfmesos_tpu.ops.attention import flash_attention
 
     sp = jax.lax.axis_size(axis)
-    h = q.shape[2]
+    h, hk = q.shape[2], k.shape[2]
     if h % sp:
         raise ValueError(f"ulysses needs heads ({h}) divisible by the sp "
                          f"axis ({sp}); use ring attention instead")
 
+    if hk != h and hk % sp == 0:
+        # GQA at kv width: separate hops for q and the stacked K/V pair —
+        # the K/V a2a moves h/hk-fold fewer bytes, and splitting both head
+        # dims sp-ways keeps local grouping aligned with the global
+        # mapping (q head s·H/sp + j ↔ kv head s·KV/sp + j//g), which the
+        # GQA-native flash kernel consumes directly.
+        qh = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1,
+                                tiled=True)
+        kv = jax.lax.all_to_all(jnp.stack((k, v)), axis, split_axis=3,
+                                concat_axis=2, tiled=True)
+        o = flash_attention(qh, kv[0], kv[1], causal=causal, scale=scale,
+                            interpret=interpret, use_pallas=use_pallas)
+        return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    if hk != h:
+        # GQA with sp not dividing kv_heads: broadcast up first.
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
     # One stacked hop for q/k/v (dims shift by the stack dim), one for the
     # output — the documented two collectives per attention call.
     qkv = jax.lax.all_to_all(jnp.stack((q, k, v)), axis, split_axis=3,
