@@ -268,6 +268,57 @@ def bench_decode(batch=8, prompt_len=128, new_tokens=256, quantized=False,
     return batch * new_tokens / best
 
 
+def bench_attention(b=4, t=2048, h=8, d=128, reps=10):
+    """Flash-kernel vs XLA-reference attention, fwd+bwd, at the BASELINE.md
+    comparison shape (B4/T2048/H8/D128 bf16 causal).
+
+    Chained-scan protocol: ``reps`` dependent grad steps inside one jit,
+    timed region ends in a host fetch (the remote-attach relay acks
+    ``block_until_ready`` early, so independent calls mis-time).  Returns
+    (flash_ms, xla_ms) per fwd+bwd call.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from tfmesos_tpu.ops.attention import flash_attention, mha_reference
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, t, h, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, t, h, d), jnp.bfloat16)
+
+    def timed(attn):
+        # Differentiate w.r.t. q AND k AND v: the flash custom_vjp always
+        # runs both backward kernels, so a q-only cotangent would let
+        # autodiff dead-code the reference's dk/dv paths and bias the
+        # comparison.  dq+dk+dv are q-shaped, so their sum chains the scan.
+        g = jax.grad(lambda q_, k_, v_: jnp.sum(
+            attn(q_, k_, v_).astype(jnp.float32) ** 2), argnums=(0, 1, 2))
+
+        @jax.jit
+        def chain(q0):
+            def body(c, _):
+                dq, dk, dv = g(c, k, v)
+                return (dq + dk + dv).astype(jnp.bfloat16), None
+            out, _ = lax.scan(body, q0, None, length=reps)
+            return out
+
+        out = chain(q)
+        float(np.asarray(out[0, 0, 0, 0]))  # warm + drain
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = chain(q)
+            float(np.asarray(out[0, 0, 0, 0]))
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1000
+
+    flash_ms = timed(lambda q_, k_, v_: flash_attention(q_, k_, v_,
+                                                        causal=True))
+    xla_ms = timed(lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=True))
+    return flash_ms, xla_ms
+
+
 def bench_bandwidth(sizes=None):
     """Achieved bandwidth vs roofline.
 
@@ -472,6 +523,12 @@ def main():
         # Long-prompt config: at 1k+ cached positions the cache bytes rival
         # the weights', which is where the int8 KV cache earns its keep.
         out["decode_int8_kv_tokens_per_sec"] = round(max(dec8kv), 1)
+    attn = attempts(bench_attention, "attention kernel bench", n=1)
+    if attn:
+        flash_ms, xla_ms = attn[0]
+        out["flash_attn_fwdbwd_ms"] = round(flash_ms, 3)
+        out["xla_attn_fwdbwd_ms"] = round(xla_ms, 3)
+        out["flash_attn_speedup"] = round(xla_ms / flash_ms, 3)
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:
         out.update(bw[0])

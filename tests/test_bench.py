@@ -56,3 +56,9 @@ def test_decode_bench_int8_kv_smoke():
     toks = bench.bench_decode(batch=1, prompt_len=8, new_tokens=4,
                               quantized=True, quantized_cache=True)
     assert np.isfinite(toks) and toks > 0
+
+
+def test_attention_bench_smoke():
+    flash_ms, xla_ms = bench.bench_attention(b=1, t=128, h=2, d=32, reps=2)
+    assert np.isfinite(flash_ms) and flash_ms > 0
+    assert np.isfinite(xla_ms) and xla_ms > 0

@@ -74,6 +74,11 @@ class TransformerConfig:
     # MoE (0 experts = no MoE):
     n_experts: int = 0
     top_k: int = 2
+    # Shared experts (DeepSeek-style): this many always-on expert FFNs
+    # beside the routed ones — every token takes routed(top-k) + shared.
+    # Stored as ONE fused FFN of width n_shared_experts * d_ff (identical
+    # math to summing separate experts, one matmul).
+    n_shared_experts: int = 0
     # "dense": exact top-k, every expert computes everything (masked) —
     # simple, shardable over ep as pure weight sharding.
     # "switch": top-1 routing with capacity + real all_to_all token dispatch
@@ -116,6 +121,10 @@ class TransformerConfig:
 
 
 def init_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
+    if cfg.n_shared_experts and not cfg.n_experts:
+        raise ValueError(
+            "n_shared_experts requires n_experts > 0 — without routed "
+            "experts there is nothing to share beside; widen d_ff instead")
     d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
     hd = cfg.n_heads * cfg.head_dim
     kvd = cfg.kv_heads * cfg.head_dim
@@ -141,6 +150,14 @@ def init_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
             e_up=norm((l, e, d, f), 1 / math.sqrt(d)),
             e_down=norm((l, e, f, d), 1 / math.sqrt(f) / math.sqrt(2 * l)),
         )
+        if cfg.n_shared_experts:
+            sf = cfg.n_shared_experts * f
+            layers.update(
+                s_gate=norm((l, d, sf), 1 / math.sqrt(d)),
+                s_up=norm((l, d, sf), 1 / math.sqrt(d)),
+                s_down=norm((l, sf, d),
+                            1 / math.sqrt(sf) / math.sqrt(2 * l)),
+            )
     else:
         layers.update(
             w_gate=norm((l, d, f), 1 / math.sqrt(d)),
@@ -159,7 +176,7 @@ def init_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
 #: tiny and precision-critical; the router is tiny and decides routing.
 _QUANT_KEYS = frozenset(
     {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-     "e_gate", "e_up", "e_down"})
+     "e_gate", "e_up", "e_down", "s_gate", "s_up", "s_down"})
 
 
 def _quantizable(cfg: TransformerConfig, key: str) -> bool:
@@ -290,13 +307,23 @@ def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None):
                 h.reshape(b * t, d), lp["router"].astype(cfg.dtype),
                 lp["e_gate"], lp["e_up"], lp["e_down"], ep_axis=ep_axis,
                 capacity_factor=cfg.capacity_factor, top_k=cfg.top_k)
-            return out.reshape(b, t, d), aux
-        return _moe(cfg, lp, h, ep_axis=ep_axis)
-    if cfg.moe_impl == "switch":
+            out = out.reshape(b, t, d)
+        else:
+            out, aux = _moe(cfg, lp, h, ep_axis=ep_axis)
+    elif cfg.moe_impl == "switch":
         # Same model function with or without a mesh (switch_moe falls back
         # to its single-device reference when the ep axis is absent).
-        return _moe_switch(cfg, mesh, lp, h)
-    return _moe(cfg, lp, h)
+        out, aux = _moe_switch(cfg, mesh, lp, h)
+    else:
+        out, aux = _moe(cfg, lp, h)
+    if cfg.n_shared_experts:
+        # Always-on shared expert(s): dense FFN added to the routed output
+        # (the shared weights are ep-replicated, so this needs no
+        # collective under any path).
+        out = out + swiglu(h, _wt(lp["s_gate"], cfg.dtype),
+                           _wt(lp["s_up"], cfg.dtype),
+                           _wt(lp["s_down"], cfg.dtype))
+    return out, aux
 
 
 def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
@@ -425,6 +452,10 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
                     "e_up": P(None, "ep", None, None),
                     "e_down": P(None, "ep", None, None),
                 }
+                if cfg.n_shared_experts:
+                    partition.update(s_gate=P(None, None, None),
+                                     s_up=P(None, None, None),
+                                     s_down=P(None, None, None))
         if cfg.remat:
             stage_block = jax.checkpoint(stage_block)
 
@@ -810,6 +841,12 @@ def partition_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
             e_up=P(None, "ep", "fsdp", "tp"),
             e_down=P(None, "ep", "tp", "fsdp"),
         )
+        if cfg.n_shared_experts:
+            layer.update(
+                s_gate=P(None, "fsdp", "tp"),
+                s_up=P(None, "fsdp", "tp"),
+                s_down=P(None, "tp", "fsdp"),
+            )
     else:
         layer.update(
             w_gate=P(None, "fsdp", "tp"),
