@@ -632,6 +632,44 @@ def test_quantized_generate_runs():
     assert np.all(np.asarray(out[:, :4]) == np.asarray(prompt))
 
 
+def test_sliding_window_model_and_decode():
+    """window >= T reproduces full causal attention exactly; a small window
+    changes the logits; and the decode path (masked cache reads) matches
+    the windowed forward position by position."""
+    import dataclasses
+
+    full = TINY
+    wide = dataclasses.replace(TINY, window=64)    # > max_seq_len
+    narrow = dataclasses.replace(TINY, window=4)
+    params = transformer.init_params(full, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                full.vocab_size)
+
+    ref = transformer.forward(full, params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(transformer.forward(wide, params, tokens)),
+        np.asarray(ref), rtol=1e-5, atol=1e-6)
+    narrowed = transformer.forward(narrow, params, tokens)
+    assert np.abs(np.asarray(narrowed) - np.asarray(ref)).max() > 1e-3
+
+    # Decode: prefill + steady-state steps reproduce the windowed forward.
+    cache = transformer.init_cache(narrow, 2, 16)
+    logits, cache = transformer.decode_step(narrow, params, cache,
+                                            tokens[:, :12], 0)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(narrowed[:, :12]), rtol=2e-4,
+                               atol=2e-4)
+    for pos in range(12, 16):
+        step_logits, cache = transformer.decode_step(
+            narrow, params, cache, tokens[:, pos:pos + 1], pos)
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   np.asarray(narrowed[:, pos]), rtol=2e-4,
+                                   atol=2e-4)
+
+    out = transformer.generate(narrow, params, tokens[:, :4], 4)
+    assert out.shape == (2, 8)
+
+
 def test_quantized_kv_cache_decode_close_and_generate():
     """int8 KV cache: per-position absmax quantization keeps multi-step
     decode logits close to the fp-cache run, and generate() threads the

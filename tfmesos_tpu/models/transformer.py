@@ -67,6 +67,11 @@ class TransformerConfig:
     # memory/bandwidth win.  None = full multi-head attention.
     n_kv_heads: Optional[int] = None
     max_seq_len: int = 2048
+    # Sliding-window attention (Mistral-style): each query sees the last
+    # `window` positions only.  None = full causal attention.  The flash
+    # kernel bounds its k-loop to the window (O(T·W) work); decode masks
+    # cache reads the same way.  Does not compose with sp (ring/Ulysses).
+    window: Optional[int] = None
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16          # compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32     # master params
@@ -110,6 +115,11 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    def __post_init__(self):
+        if self.window is not None and self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window} "
+                             f"(use None for full causal attention)")
 
     @property
     def kv_heads(self) -> int:
@@ -343,7 +353,8 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    o = attend(q, k, v, mesh=None, causal=True)  # local heads
+    o = attend(q, k, v, mesh=None, causal=True,
+               window=cfg.window)  # local heads
     x = x + jax.lax.psum(o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype),
                          tp_axis)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
@@ -364,7 +375,8 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
     # flash kernels map q head h -> kv head h // (H/KV) in their index
     # maps, so training never materializes the repeated K/V; the sp impls
     # broadcast up internally.
-    o = attend(q, k, v, mesh=mesh, causal=True, sp_impl=cfg.sp_impl)
+    o = attend(q, k, v, mesh=mesh, causal=True, sp_impl=cfg.sp_impl,
+               window=cfg.window)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, aux = _ffn(cfg, mesh, lp, h, ep_axis=ep_axis)
@@ -597,9 +609,9 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
         # [t, t] instead of a [t, M] score tensor over the (mostly empty)
         # cache.  GQA stays at kv width (both impls group internally).
         if sharded:
-            o = mha_reference(q, k, v, causal=True)
+            o = mha_reference(q, k, v, causal=True, window=cfg.window)
         else:
-            o = attend(q, k, v, mesh=None, causal=True)
+            o = attend(q, k, v, mesh=None, causal=True, window=cfg.window)
     else:
         # Grouped einsum over the cache: the KV blocks stream from HBM
         # once at kv_heads width (int8 when quantized) — never
@@ -610,8 +622,10 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
         s = jnp.einsum("btkgd,bmkd->bkgtm", q5, ck_r).astype(jnp.float32)
         s = s / math.sqrt(cfg.head_dim)
         kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
-        s = jnp.where((kpos > positions[:, None])[None, None, None],
-                      -jnp.inf, s)
+        bad = kpos > positions[:, None]
+        if cfg.window is not None:
+            bad = bad | (kpos < positions[:, None] - (cfg.window - 1))
+        s = jnp.where(bad[None, None, None], -jnp.inf, s)
         probs = jax.nn.softmax(s, axis=-1).astype(cv_r.dtype)
         o = jnp.einsum("bkgtm,bmkd->btkgd", probs, cv_r)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)

@@ -24,15 +24,21 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float("-inf")
 
 
-def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
+def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None,
+                  window: Optional[int] = None):
     """Plain-XLA scaled-dot-product attention (ground truth / fallback).
 
     Grouped-query attention is accepted directly: when ``k``/``v`` carry
     fewer heads than ``q`` (q heads per kv head = H // KV), they are
     broadcast up here — the kernels do the same mapping without
-    materializing the repeat."""
+    materializing the repeat.
+
+    ``window`` (requires ``causal``): sliding-window attention — query i
+    sees keys [i-window+1, i] only."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
@@ -42,7 +48,10 @@ def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
         tq, tk = scores.shape[-2], scores.shape[-1]
         qpos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
         kpos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-        scores = jnp.where(kpos > qpos, NEG_INF, scores)
+        bad = kpos > qpos
+        if window is not None:
+            bad = bad | (kpos < qpos - (window - 1))
+        scores = jnp.where(bad, NEG_INF, scores)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -63,6 +72,7 @@ class _FlashCfg(NamedTuple):
     block_k: int
     interpret: bool
     q_per_kv: int = 1  # GQA group size (q heads per kv head); 1 = MHA
+    window: Optional[int] = None  # sliding window (causal only); None = full
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
@@ -81,10 +91,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
     bq, bk = cfg.block_q, cfg.block_k
     qi = pl.program_id(1)
     nk = seq_len // bk
+    lo = 0
     if cfg.causal:
         # Blocks strictly above the diagonal contribute nothing: bound the
         # loop instead of masking them (halves the FLOPs on average).
         nk = jnp.minimum(nk, pl.cdiv((qi + 1) * bq, bk))
+        if cfg.window is not None:
+            # Sliding window: blocks entirely below every query's window
+            # start also contribute nothing — total work is O(T·W).
+            lo = jnp.maximum(0, (qi * bq - (cfg.window - 1)) // bk)
 
     def body(j, carry):
         o, m, l = carry
@@ -96,10 +111,22 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
         if cfg.causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(kpos > qpos, NEG_INF, s)
+            bad = kpos > qpos
+            if cfg.window is not None:
+                bad = bad | (kpos < qpos - (cfg.window - 1))
+            s = jnp.where(bad, NEG_INF, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
+        if cfg.window is not None:
+            # A q row can be ENTIRELY outside the window in this k block
+            # (the loop's lo bound fits the block's lowest row, not all of
+            # them): m_new stays -inf there and exp(-inf - -inf) is NaN.
+            # Zero those entries explicitly — plain causal never hits this
+            # (block 0 is valid for every row).
+            p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
+            corr = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_new))
+        else:
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         o_new = o * corr + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
@@ -110,7 +137,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
     o0 = jnp.zeros((bq, d), jnp.float32)
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, nk, body, (o0, m0, l0))
+    o, m, l = jax.lax.fori_loop(lo, nk, body, (o0, m0, l0))
     o_ref[0, 0, :, :] = (o / l).astype(o_ref.dtype)
     # Per-query logsumexp of the SCALED scores: the backward pass reuses it
     # instead of re-sweeping Q.K^T (causal rows always hit the diagonal, so
@@ -187,7 +214,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if cfg.causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = jnp.where(kpos > qpos, 0.0, p)
+            bad = kpos > qpos
+            if cfg.window is not None:
+                bad = bad | (kpos < qpos - (cfg.window - 1))
+            p = jnp.where(bad, 0.0, p)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k_blk.dtype)
@@ -196,8 +226,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if cfg.causal:
-        # Blocks strictly above the causal diagonal contribute nothing.
-        pl.when(j * bk <= (qi + 1) * bq - 1)(_step)
+        # Blocks strictly above the causal diagonal (or entirely below the
+        # sliding window) contribute nothing.
+        live = j * bk <= (qi + 1) * bq - 1
+        if cfg.window is not None:
+            live = live & ((j + 1) * bk - 1 >= qi * bq - (cfg.window - 1))
+        pl.when(live)(_step)
     else:
         _step()
 
@@ -237,7 +271,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if cfg.causal:
             qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = jnp.where(kpos > qpos, 0.0, p)
+            bad = kpos > qpos
+            if cfg.window is not None:
+                bad = bad | (kpos < qpos - (cfg.window - 1))
+            p = jnp.where(bad, 0.0, p)
         dv_ref[0, 0, :, :] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -249,8 +286,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if cfg.causal:
-        # q-blocks strictly before the diagonal see none of this k-block.
-        pl.when((i + 1) * bq - 1 >= ki * bk)(_step)
+        # q-blocks strictly before the diagonal (or beyond the window's
+        # reach of this k-block) see none of it.
+        live = (i + 1) * bq - 1 >= ki * bk
+        if cfg.window is not None:
+            live = live & (i * bq <= (ki + 1) * bk - 1 + (cfg.window - 1))
+        pl.when(live)(_step)
     else:
         _step()
 
@@ -374,7 +415,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
                     block_q: int = 1024, block_k: int = 512,
                     use_pallas: Optional[bool] = None,
-                    interpret: bool = False):
+                    interpret: bool = False,
+                    window: Optional[int] = None):
     """Blocked attention; Pallas kernel on TPU, reference math elsewhere.
 
     ``use_pallas=None`` auto-selects: the kernel runs when the default
@@ -392,6 +434,11 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
         raise ValueError(
             f"q heads ({q.shape[2]}) must be a multiple of kv heads "
             f"({k.shape[2]}/{v.shape[2]}, which must agree)")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     t = q.shape[1]
     # Treat the block arguments as targets: run with the largest Mosaic-legal
     # (8-aligned or full-dim) divisor at or under each — so t=1280 still gets
@@ -413,9 +460,12 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
             f"have no Mosaic-legal block tiling at or under "
             f"({block_q}, {block_k})")
     if not use_pallas:
-        return mha_reference(q, k, v, causal=causal, scale=scale)
+        return mha_reference(q, k, v, causal=causal, scale=scale,
+                             window=window)
     cfg = _FlashCfg(causal=bool(causal), scale=float(scale),
-                    block_q=block_q, block_k=block_k, interpret=bool(interpret))
+                    block_q=block_q, block_k=block_k,
+                    interpret=bool(interpret),
+                    window=None if window is None else int(window))
     return _flash(cfg, q, k, v)
 
 
@@ -450,7 +500,8 @@ def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
 
 
 def attend(q, k, v, mesh=None, causal: bool = True,
-           scale: Optional[float] = None, sp_impl: str = "ring", **kw):
+           scale: Optional[float] = None, sp_impl: str = "ring",
+           window: Optional[int] = None, **kw):
     """One attention entry point for model code: sequence parallelism when
     the mesh shards the sequence (``sp``) — ring attention by default, or
     Ulysses all-to-all with ``sp_impl="ulysses"`` — sharded flash kernel
@@ -461,6 +512,10 @@ def attend(q, k, v, mesh=None, causal: bool = True,
     (narrow-width K/V all-to-all when sp divides kv_heads); the ring works
     per-head, so GQA inputs are broadcast up for it here."""
     if mesh is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1:
+        if window is not None:
+            raise ValueError(
+                "sliding-window attention does not compose with sequence "
+                "parallelism yet; drop the sp axis or the window")
         if sp_impl == "ulysses":
             from tfmesos_tpu.parallel.ulysses import ulysses_attention
             return ulysses_attention(q, k, v, mesh, causal=causal,
@@ -476,5 +531,6 @@ def attend(q, k, v, mesh=None, causal: bool = True,
         return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
     if mesh is not None:
         return sharded_flash_attention(q, k, v, mesh, causal=causal,
-                                       scale=scale, **kw)
-    return flash_attention(q, k, v, causal=causal, scale=scale, **kw)
+                                       scale=scale, window=window, **kw)
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           window=window, **kw)
