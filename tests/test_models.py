@@ -670,6 +670,40 @@ def test_sliding_window_model_and_decode():
     assert out.shape == (2, 8)
 
 
+def test_rolling_window_cache_is_window_sized_and_exact():
+    """Windowed configs keep an O(window) rolling cache: the buffer is
+    window-sized, and greedy generation far past the buffer length matches
+    teacher-forced windowed forward() logits step by step."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, window=4, max_seq_len=32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    cache = transformer.init_cache(cfg, 2, 32)
+    assert cache["k"].shape == (cfg.n_layers, 2, 4, 2, 16)  # 4 slots only
+
+    q8 = transformer.init_cache(cfg, 2, 32, quantized=True)
+    assert q8["k"].values.shape[2] == 4
+
+    # March a 24-token teacher-forced stream through the rolling cache and
+    # compare each step's logits to the windowed full-sequence forward.
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0,
+                                cfg.vocab_size)
+    ref = transformer.forward(cfg, params, tokens)
+    logits, cache = transformer.decode_step(cfg, params, cache,
+                                            tokens[:, :6], 0)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref[:, :6]),
+                               rtol=2e-4, atol=2e-4)
+    for pos in range(6, 24):  # wraps the 4-slot buffer many times
+        step_logits, cache = transformer.decode_step(
+            cfg, params, cache, tokens[:, pos:pos + 1], pos)
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   np.asarray(ref[:, pos]), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"pos {pos}")
+
+    out = transformer.generate(cfg, params, tokens[:, :6], 18)
+    assert out.shape == (2, 24)
+
+
 def test_quantized_kv_cache_decode_close_and_generate():
     """int8 KV cache: per-position absmax quantization keeps multi-step
     decode logits close to the fp-cache run, and generate() threads the

@@ -516,7 +516,15 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     decode streams the whole cache every step, so halving its bytes vs
     bf16 is the long-prompt analogue of weight-only int8.  Writes quantize
     the incoming K/V chunk; reads dequantize at the attention einsum.
+
+    With sliding-window attention (``cfg.window``) the buffer is a ROLLING
+    cache of ``window`` slots (slot = position mod window): a position's
+    slot is reclaimed exactly when it leaves the window, so memory and
+    per-step cache bandwidth are O(window) regardless of how long
+    generation runs.
     """
+    if cfg.window is not None:
+        max_len = min(max_len, cfg.window)
     if quantized:
         if dtype is not None:
             raise ValueError("init_cache: dtype and quantized=True conflict "
@@ -530,19 +538,40 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _cache_write(cache, chunk, pos):
+def _cache_write(cache, chunk, pos, rolling: bool = False):
     """Insert a [B, t, H, Dh] K or V chunk at position ``pos`` of a cache
     layer, quantizing on the way in when the cache is int8 (the same
-    per-row absmax rule as weight quantization — ops/quant.py)."""
+    per-row absmax rule as weight quantization — ops/quant.py).
+
+    ``rolling`` (window configs): position p writes slot p mod M — a
+    single-token step is one wrapped dynamic slice; a longer chunk
+    (prefill, static ``pos``) keeps its last M tokens via a modular
+    scatter.  Non-rolling caches keep the plain dynamic-slice write (which
+    supports traced multi-token positions — the buffer never wraps).
+    """
+    m = (cache.values if isinstance(cache, QTensor) else cache).shape[1]
+    t = chunk.shape[1]
+
+    def put(buf, x):
+        if not rolling:
+            return jax.lax.dynamic_update_slice(buf, x, (0, pos, 0, 0))
+        if t == 1:
+            return jax.lax.dynamic_update_slice(buf, x, (0, pos % m, 0, 0))
+        if not isinstance(pos, int):
+            raise ValueError("multi-token rolling-cache writes need a "
+                             "static position (prefill); decode rolls one "
+                             "token at a time")
+        if pos + t <= m:
+            return jax.lax.dynamic_update_slice(buf, x, (0, pos, 0, 0))
+        keep = x[:, -m:]
+        idx = (jnp.arange(pos + t - keep.shape[1], pos + t)) % m
+        return buf.at[:, idx].set(keep)
+
     if isinstance(cache, QTensor):
         from tfmesos_tpu.ops.quant import quantize_int8_reference
         vals, scale = quantize_int8_reference(chunk)
-        at = (0, pos, 0, 0)
-        return QTensor(
-            jax.lax.dynamic_update_slice(cache.values, vals, at),
-            jax.lax.dynamic_update_slice(cache.scales, scale, at))
-    return jax.lax.dynamic_update_slice(
-        cache, chunk.astype(cache.dtype), (0, pos, 0, 0))
+        return QTensor(put(cache.values, vals), put(cache.scales, scale))
+    return put(cache, chunk.astype(cache.dtype))
 
 
 def _cache_read(cache, dtype):
@@ -600,8 +629,9 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     pos_row = jnp.broadcast_to(positions, (b, t))
     q = rope(q, pos_row, cfg.rope_theta)
     k = rope(k, pos_row, cfg.rope_theta)
-    ck = _cache_write(ck, k, pos)
-    cv = _cache_write(cv, v, pos)
+    rolling = cfg.window is not None
+    ck = _cache_write(ck, k, pos, rolling=rolling)
+    cv = _cache_write(cv, v, pos, rolling=rolling)
     kv = cfg.kv_heads
     g = cfg.n_heads // kv
     if t > 1 and isinstance(pos, int) and pos == 0:
@@ -621,10 +651,22 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
         q5 = q.reshape(b, t, kv, g, cfg.head_dim)
         s = jnp.einsum("btkgd,bmkd->bkgtm", q5, ck_r).astype(jnp.float32)
         s = s / math.sqrt(cfg.head_dim)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
-        bad = kpos > positions[:, None]
         if cfg.window is not None:
-            bad = bad | (kpos < positions[:, None] - (cfg.window - 1))
+            # Rolling cache: slot j holds global position p - ((p - j) % M)
+            # (the latest position congruent to j not after p).  Negative
+            # slot positions are not yet written; everything resident is
+            # within the window when M == window.
+            if t > 1:
+                raise ValueError("chunked decode over a rolling windowed "
+                                 "cache is not supported; decode one token "
+                                 "per step after the prefill")
+            p0 = positions[0]
+            slot = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
+            spos = p0 - ((p0 - slot) % m)
+            bad = (spos < 0) | (spos < p0 - (cfg.window - 1))
+        else:
+            kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
+            bad = kpos > positions[:, None]
         s = jnp.where(bad[None, None, None], -jnp.inf, s)
         probs = jax.nn.softmax(s, axis=-1).astype(cv_r.dtype)
         o = jnp.einsum("bkgtm,bmkd->btkgd", probs, cv_r)
