@@ -16,6 +16,11 @@ VPU) bounds it.  Parse the LAST stdout JSON line:
 printed so an external timeout still leaves a parseable result; the final
 full line supersedes it)
 
+If the accelerator is unreachable (a wedged remote-attach relay hangs jax
+backend init — this lost round 2's entire benchmark), the probe retries a
+few times and then reruns the headline on CPU, emitting a real measured
+value tagged ``"degraded"`` instead of a useless ``value: null``.
+
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 baseline is our own round-1 value measured by the driver under this same
 protocol (best-of-3, K fused steps per dispatch, timed region ends in a
@@ -397,7 +402,7 @@ def bench_bandwidth(sizes=None):
     return out
 
 
-def _probe_device(timeout_s: float) -> Optional[str]:
+def _probe_device_once(timeout_s: float) -> Optional[str]:
     """Confirm the accelerator answers before committing to the benches.
 
     A wedged remote-attach relay HANGS jax backend init rather than
@@ -443,21 +448,56 @@ def _probe_device(timeout_s: float) -> Optional[str]:
     return None
 
 
+def _probe_device(attempt_timeout_s: float, attempts: int = 3,
+                  retry_sleep_s: float = 30.0) -> Optional[str]:
+    """Retrying probe: a wedged relay often clears when an upstream claim
+    lease expires (round 2 died on one 300s attempt), so spread several
+    shorter attempts over the budget before giving up."""
+    import sys
+    import time as _time
+
+    err = None
+    for i in range(max(1, attempts)):
+        if i:
+            _time.sleep(retry_sleep_s)
+        err = _probe_device_once(attempt_timeout_s)
+        if err is None:
+            return None
+        print(f"device probe attempt {i + 1}/{attempts}: {err}",
+              file=sys.stderr, flush=True)
+    return err
+
+
 def main():
     import os
     import sys
     import traceback
 
-    err = _probe_device(float(os.environ.get("TPUMESOS_BENCH_PROBE_TIMEOUT",
-                                             "300")))
+    err = _probe_device(
+        float(os.environ.get("TPUMESOS_BENCH_PROBE_TIMEOUT", "120")),
+        attempts=int(os.environ.get("TPUMESOS_BENCH_PROBE_ATTEMPTS", "3")))
+    degraded = None
     if err is not None:
-        print(json.dumps({
-            "metric": "mnist_replica_steps_per_sec_per_chip",
-            "value": None, "unit": "steps/s/chip", "vs_baseline": None,
-            "error": err}), flush=True)
-        raise SystemExit(err)
+        # The accelerator is unreachable (round 2 lost its whole benchmark
+        # to exactly this).  Fall back to CPU so the round still records a
+        # real measured number — marked degraded, never value:null.
+        degraded = err
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        cpu_err = _probe_device(60.0, attempts=1)
+        if cpu_err is not None:  # something deeper than the relay is broken
+            print(json.dumps({
+                "metric": "mnist_replica_steps_per_sec_per_chip",
+                "value": None, "unit": "steps/s/chip", "vs_baseline": None,
+                "error": f"{err}; cpu fallback also failed: {cpu_err}"}),
+                flush=True)
+            raise SystemExit(err)
 
     import jax
+
+    if degraded is not None:
+        # The site PJRT plugin pins the platform at interpreter start;
+        # re-assert CPU through the config so the env var actually wins.
+        jax.config.update("jax_platforms", "cpu")
 
     # Best-of-N: the remote-attach relay adds ±40% latency jitter between
     # runs; the max is the least-interference estimate of chip capability.
@@ -492,6 +532,16 @@ def main():
         "final_loss": round(final_loss, 4),
         "mfu_mlp": round(mlp_mfu, 5),
     }
+    if degraded is not None:
+        # CPU stand-in numbers: real, but not comparable to the TPU
+        # baseline — say so, null the TPU-relative ratio, and skip the
+        # accelerator-scale probes (a T=2048 transformer step on CPU
+        # would take minutes each).
+        out["degraded"] = f"cpu fallback: {degraded}"
+        out["vs_baseline"] = None
+        del out["peak_bf16_tflops"], out["mfu_mlp"]
+        print(json.dumps(out), flush=True)
+        return
     # The headline metric is in hand; the remaining probes each pay a heavy
     # XLA compile.  Print a parseable line NOW so an external timeout still
     # leaves a result — the final full line below supersedes it.

@@ -97,13 +97,21 @@ def test_fused_ce_mode_auto_selection():
     params = transformer.init_params(TINY, jax.random.PRNGKey(0))
     mode = transformer._fused_ce_mode
     assert mode(TINY, params, None) == "dense"
+    # Multi-device data-only meshes take the batch-sharded path: the dense
+    # chunking would cut every chunk across the dp sharding.  The shard_map
+    # needs the batch to divide over the data axes — indivisible (or
+    # unknown) batches keep the GSPMD dense route.
+    assert mode(TINY, params, build_mesh({"dp": 8}), batch_size=8) == "dp"
+    assert mode(TINY, params, build_mesh({"dp": 8}), batch_size=6) == "dense"
     assert mode(TINY, params, build_mesh({"dp": 8})) == "dense"
-    assert mode(TINY, params, build_mesh({"dp": 4, "fsdp": 2})) == "dense"
+    assert mode(TINY, params, build_mesh({"dp": 4, "fsdp": 2}),
+                batch_size=16) == "dp"
     assert mode(TINY, params, build_mesh({"dp": 4, "tp": 2})) == "tp"
     assert mode(TINY, params, build_mesh({"sp": 8})) is None
     assert mode(TINY, params, build_mesh({"pp": 2, "dp": 4})) is None
     # Size-1 axes don't count: a degenerate tp axis is still data-only.
-    assert mode(TINY, params, build_mesh({"dp": 8, "tp": 1})) == "dense"
+    assert mode(TINY, params, build_mesh({"dp": 8, "tp": 1}),
+                batch_size=8) == "dp"
     qparams = transformer.quantize_params(TINY, params)
     assert mode(TINY, qparams, None) is None
 
@@ -186,13 +194,48 @@ def test_vocab_parallel_ce_through_trainer_machinery():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.parametrize("axes", [{"dp": 8}, {"dp": 2, "fsdp": 4}])
+def test_dp_fused_ce_matches_reference(axes):
+    """The batch-sharded fused CE: loss AND grads on data-parallel meshes
+    must match the materialize-the-logits reference."""
+    from tfmesos_tpu.ops.layers import data_parallel_fused_cross_entropy
+    mesh = build_mesh(axes)
+    d, v = 16, 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 8, d), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (d, v), jnp.float32) * 0.3
+    labels = jax.random.randint(jax.random.PRNGKey(2), (16, 8), 0, v)
+
+    ref, (dx_ref, dw_ref) = jax.value_and_grad(_ref_loss, argnums=(0, 1))(
+        x, w, labels, 1e-3)
+    got, (dx, dw) = jax.jit(jax.value_and_grad(
+        lambda x_, w_: data_parallel_fused_cross_entropy(
+            x_, w_, labels, mesh, 1e-3, 8),
+        argnums=(0, 1)))(x, w)
+
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_fused_ce_on_dp_mesh_matches_single_device():
+    """loss_fn's auto "dp" route end to end: loss and grads on a dp mesh
+    must match the meshless (fused-dense) run."""
     mesh = build_mesh({"dp": 8})
     params = transformer.init_params(TINY, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
                                 TINY.vocab_size)
     batch = {"tokens": tokens}
-    ref = transformer.loss_fn(TINY, params, batch)[0]
-    got = jax.jit(lambda p, b: transformer.loss_fn(TINY, p, b, mesh)[0])(
-        params, batch)
+    assert transformer._fused_ce_mode(TINY, params, mesh,
+                                      batch_size=8) == "dp"
+    ref, g_ref = jax.value_and_grad(
+        lambda p: transformer.loss_fn(TINY, p, batch)[0])(params)
+    got, g = jax.jit(jax.value_and_grad(
+        lambda p: transformer.loss_fn(TINY, p, batch, mesh)[0]))(params)
     np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g)[0],
+            jax.tree_util.tree_flatten_with_path(g_ref)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-5, err_msg=str(pa))

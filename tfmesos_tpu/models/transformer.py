@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tfmesos_tpu.ops.attention import attend, mha_reference
 from tfmesos_tpu.ops.layers import (cross_entropy_loss,
+                                    data_parallel_fused_cross_entropy,
                                     fused_linear_cross_entropy, rms_norm,
                                     rope, swiglu,
                                     vocab_parallel_cross_entropy)
@@ -255,7 +256,8 @@ def _moe_switch(cfg: TransformerConfig, mesh, lp, h):
     return out.reshape(b, t, d), aux
 
 
-def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None):
+def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None,
+         tp_axis: Optional[str] = None):
     """Top-k routed MoE, computed densely over the expert axis.
 
     Every expert processes every token and the router mask zeroes the
@@ -268,7 +270,11 @@ def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None):
     expert weights arrive as local ``ep`` shards, the (replicated) router
     picks over all E experts, each device computes only its local experts'
     slice of the masked einsum and the partials ``psum`` over ``ep`` —
-    bitwise the same math as the GSPMD path.
+    bitwise the same math as the GSPMD path.  ``tp_axis`` additionally
+    shards every expert's FFN width (Megatron-per-expert: e_gate/e_up
+    column-sharded [e_loc, d, f/tp], e_down row-sharded [e_loc, f/tp, d]);
+    the e_down contraction then yields a partial sum and the same psum
+    covers both axes.
     """
     e = cfg.n_experts
     logits = (h @ lp["router"].astype(cfg.dtype)).astype(jnp.float32)  # [B,T,E]
@@ -286,8 +292,9 @@ def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None):
     u = jnp.einsum("btd,edf->btef", h, _wt(lp["e_up"], cfg.dtype))
     y = jnp.einsum("btef,efd->bted", g * u, _wt(lp["e_down"], cfg.dtype))
     out = jnp.einsum("bted,bte->btd", y, mask.astype(cfg.dtype))
-    if ep_axis is not None:
-        out = jax.lax.psum(out, ep_axis)
+    psum_axes = tuple(a for a in (ep_axis, tp_axis) if a is not None)
+    if psum_axes:
+        out = jax.lax.psum(out, psum_axes)
     probs = jax.nn.softmax(logits, axis=-1)
     f = jnp.sum(onehot, axis=(0, 1, 2)) / (onehot.shape[0] * onehot.shape[1]
                                            * cfg.top_k)
@@ -300,26 +307,29 @@ def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None):
     return out, aux
 
 
-def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None):
+def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None,
+         tp_axis: Optional[str] = None):
     """The block's feed-forward dispatch (dense / switch / dense-MoE) —
     shared by the train and decode paths so they cannot drift.
 
-    ``ep_axis`` selects the manual-collective MoE forms for use inside a
-    pipeline stage's shard_map body (tokens ep-replicated, expert weights
-    ep-sharded, outputs psum'd)."""
+    ``ep_axis``/``tp_axis`` select the manual-collective MoE forms for use
+    inside a pipeline stage's shard_map body (tokens replicated over
+    ep/tp, expert weights ep-sharded and/or width-sharded over tp,
+    outputs psum'd)."""
     if not cfg.n_experts:
         return _mlp(cfg, lp, h), _zero_aux()
-    if ep_axis is not None:
+    if ep_axis is not None or tp_axis is not None:
         if cfg.moe_impl == "switch":
             from tfmesos_tpu.parallel.moe import switch_moe_replicated_local
             b, t, d = h.shape
             out, aux = switch_moe_replicated_local(
                 h.reshape(b * t, d), lp["router"].astype(cfg.dtype),
                 lp["e_gate"], lp["e_up"], lp["e_down"], ep_axis=ep_axis,
-                capacity_factor=cfg.capacity_factor, top_k=cfg.top_k)
+                capacity_factor=cfg.capacity_factor, top_k=cfg.top_k,
+                tp_axis=tp_axis)
             out = out.reshape(b, t, d)
         else:
-            out, aux = _moe(cfg, lp, h, ep_axis=ep_axis)
+            out, aux = _moe(cfg, lp, h, ep_axis=ep_axis, tp_axis=tp_axis)
     elif cfg.moe_impl == "switch":
         # Same model function with or without a mesh (switch_moe falls back
         # to its single-device reference when the ep axis is absent).
@@ -327,30 +337,38 @@ def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None):
     else:
         out, aux = _moe(cfg, lp, h)
     if cfg.n_shared_experts:
-        # Always-on shared expert(s): dense FFN added to the routed output
-        # (the shared weights are ep-replicated, so this needs no
-        # collective under any path).
-        out = out + swiglu(h, _wt(lp["s_gate"], cfg.dtype),
-                           _wt(lp["s_up"], cfg.dtype),
-                           _wt(lp["s_down"], cfg.dtype))
+        # Always-on shared expert(s): dense FFN added to the routed output.
+        # The shared weights replicate over ep; under manual tp their width
+        # shards like the dense MLP's, so the partial needs its own psum.
+        shared = swiglu(h, _wt(lp["s_gate"], cfg.dtype),
+                        _wt(lp["s_up"], cfg.dtype),
+                        _wt(lp["s_down"], cfg.dtype))
+        if tp_axis is not None:
+            shared = jax.lax.psum(shared, tp_axis)
+        out = out + shared
     return out, aux
 
 
 def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
-                     tp_axis: str = "tp"):
+                     tp_axis: str = "tp", ep_axis: Optional[str] = None):
     """Megatron-style block with MANUAL tp collectives, for use inside a
     pipeline stage (nested shard_map is not allowed there, explicit psum
     is).  ``lp`` leaves arrive as local tp shards: wq/wk/wv column-sharded
-    [d, hd/tp], wo row-sharded [hd/tp, d], w_gate/w_up [d, f/tp], w_down
-    [f/tp, d]; norms replicated.  One psum after each row-parallel matmul —
-    the textbook 2-collectives-per-block tp pattern."""
+    [d, hd/tp] (wk/wv at kv width for GQA — requires tp | kv_heads so the
+    local h//g head grouping stays aligned), wo row-sharded [hd/tp, d],
+    w_gate/w_up [d, f/tp], w_down [f/tp, d]; norms replicated.  One psum
+    after each row-parallel matmul — the textbook 2-collectives-per-block
+    tp pattern.  With experts, the FFN half runs the manual-collective MoE
+    (``_ffn`` with tp/ep axes: expert widths tp-sharded, experts
+    ep-sharded).  Returns (x, aux)."""
     tp = jax.lax.axis_size(tp_axis)
     heads_loc = cfg.n_heads // tp
+    kv_loc = cfg.kv_heads // tp
     b, t, _ = x.shape
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
-    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
-    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
+    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, kv_loc, cfg.head_dim)
+    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, kv_loc, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     o = attend(q, k, v, mesh=None, causal=True,
@@ -358,8 +376,11 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     x = x + jax.lax.psum(o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype),
                          tp_axis)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
+    if cfg.n_experts:
+        ffn, aux = _ffn(cfg, None, lp, h, ep_axis=ep_axis, tp_axis=tp_axis)
+        return x + ffn, aux
     ffn = _mlp(cfg, lp, h)                        # local d_ff shard
-    return x + jax.lax.psum(ffn, tp_axis)
+    return x + jax.lax.psum(ffn, tp_axis), _zero_aux()
 
 
 def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
@@ -431,23 +452,36 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
         ep = mesh.shape.get("ep", 1)
         ep_axis = "ep" if (cfg.n_experts and ep > 1) else None
         if tp > 1:
-            if cfg.n_experts:
-                raise ValueError("pp x tp with experts is not supported; "
-                                 "use ep without tp under pp")
-            if cfg.kv_heads != cfg.n_heads:
-                raise ValueError("pp x tp with grouped-query attention is "
-                                 "not supported; use GQA without tp under "
-                                 "pp (or full MHA)")
-            stage_block = lambda c, lp_, pos: (
-                _block_manual_tp(cfg, c, lp_, pos), None)
+            if cfg.kv_heads % tp:
+                raise ValueError(
+                    f"pp x tp needs tp ({tp}) to divide kv_heads "
+                    f"({cfg.kv_heads}) so the local head grouping stays "
+                    f"aligned; lower tp or raise kv_heads")
+            stage_block = lambda c, lp_, pos: _block_manual_tp(
+                cfg, c, lp_, pos, ep_axis=ep_axis)
             partition = {
                 "attn_norm": P(None, None),
                 "mlp_norm": P(None, None),
                 "wq": P(None, None, "tp"), "wk": P(None, None, "tp"),
                 "wv": P(None, None, "tp"), "wo": P(None, "tp", None),
-                "w_gate": P(None, None, "tp"), "w_up": P(None, None, "tp"),
-                "w_down": P(None, "tp", None),
             }
+            if cfg.n_experts:
+                # Per-expert Megatron: FFN widths shard over tp, whole
+                # experts over ep (when present); the router replicates so
+                # every device routes over all E experts.
+                partition.update(
+                    router=P(None, None, None),
+                    e_gate=P(None, ep_axis, None, "tp"),
+                    e_up=P(None, ep_axis, None, "tp"),
+                    e_down=P(None, ep_axis, "tp", None))
+                if cfg.n_shared_experts:
+                    partition.update(s_gate=P(None, None, "tp"),
+                                     s_up=P(None, None, "tp"),
+                                     s_down=P(None, "tp", None))
+            else:
+                partition.update(w_gate=P(None, None, "tp"),
+                                 w_up=P(None, None, "tp"),
+                                 w_down=P(None, "tp", None))
         else:
             stage_block = lambda c, lp_, pos: _block(cfg, None, c, lp_, pos,
                                                      ep_axis=ep_axis)
@@ -530,9 +564,15 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
             raise ValueError("init_cache: dtype and quantized=True conflict "
                              "(an int8 cache's dtypes are fixed)")
         shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
-        buf = QTensor(jnp.zeros(shape, jnp.int8),
-                      jnp.ones(shape[:-1] + (1,), jnp.float32))
-        return {"k": buf, "v": buf}
+
+        def buf():
+            # Distinct buffers for k and v, matching the fp path — aliasing
+            # one QTensor for both halves would break if decode ever donates
+            # the cache (the same buffer donated twice).
+            return QTensor(jnp.zeros(shape, jnp.int8),
+                           jnp.ones(shape[:-1] + (1,), jnp.float32))
+
+        return {"k": buf(), "v": buf()}
     dtype = dtype or cfg.dtype
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
@@ -604,6 +644,21 @@ def cache_specs(cfg: TransformerConfig, mesh: Mesh,
     return {"k": spec, "v": spec}
 
 
+def _decode_kernel_kwargs(cfg: TransformerConfig, ck, m: int, t: int,
+                          sharded: bool):
+    """kwargs for ``flash_decode`` when the single-token kernel applies,
+    else None.  TPU only (a pallas_call under a GSPMD-sharded jit cannot
+    partition, so ``sharded`` decode keeps the einsum); fp caches (int8
+    stays on the fused dequantize-einsum); full buffers (rolling-window
+    caches address by slot); m large enough that the O(pos) HBM bound
+    beats the kernel's fixed cost."""
+    if (t == 1 and not sharded and cfg.window is None
+            and not isinstance(ck, QTensor) and m >= 512
+            and jax.default_backend() == "tpu"):
+        return {}
+    return None
+
+
 def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
                   sharded: bool = False):
     """One block over a token chunk with cached history.
@@ -642,6 +697,13 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
             o = mha_reference(q, k, v, causal=True, window=cfg.window)
         else:
             o = attend(q, k, v, mesh=None, causal=True, window=cfg.window)
+    elif (kernel_kw := _decode_kernel_kwargs(cfg, ck, m, t,
+                                             sharded)) is not None:
+        # Single-token flash-decode kernel: scalar-prefetched block bound
+        # caps per-step HBM traffic at O(pos) cache slots instead of the
+        # full buffer (ops/attention.flash_decode).
+        from tfmesos_tpu.ops.attention import flash_decode
+        o = flash_decode(q[:, 0], ck, cv, positions[0], **kernel_kw)[:, None]
     else:
         # Grouped einsum over the cache: the KV blocks stream from HBM
         # once at kv_heads width (int8 when quantized) — never
@@ -795,13 +857,15 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     return jnp.concatenate([prompt, generated], axis=1)
 
 
-def _fused_ce_mode(cfg: TransformerConfig, params,
-                   mesh: Optional[Mesh]) -> Optional[str]:
-    """Which fused head+CE path ``loss_fn`` takes: "dense" (single-device /
-    data-only meshes), "tp" (vocab-parallel over the tp axis), or None (the
-    standard materialize-the-logits path — sp shards the token dim the
-    chunking would cut across, pp computes the loss outside the pipeline
-    body, ep leaves activation replication to GSPMD)."""
+def _fused_ce_mode(cfg: TransformerConfig, params, mesh: Optional[Mesh],
+                   batch_size: Optional[int] = None) -> Optional[str]:
+    """Which fused head+CE path ``loss_fn`` takes: "dense" (single device),
+    "dp" (batch-sharded chunks on multi-device data-only meshes — the naive
+    dense chunking would cut every chunk across the dp sharding), "tp"
+    (vocab-parallel over the tp axis), or None (the standard
+    materialize-the-logits path — sp shards the token dim the chunking
+    would cut across, pp computes the loss outside the pipeline body, ep
+    leaves activation replication to GSPMD)."""
     if isinstance(params["head"], QTensor):
         return None  # serving trees stay on the dequantize-at-matmul path
     if cfg.fused_ce is False:
@@ -809,7 +873,17 @@ def _fused_ce_mode(cfg: TransformerConfig, params,
     if mesh is None:
         return "dense"
     real = {a for a, s in mesh.shape.items() if s > 1}
+    if not real:
+        return "dense"
     if real <= {"dp", "fsdp"}:
+        # The shard_map'd dp path needs the batch to divide over the data
+        # axes (the GSPMD dense route didn't); fall back when it doesn't
+        # (e.g. a final partial batch) or when the caller can't say.
+        nd = 1
+        for a in real:
+            nd *= mesh.shape[a]
+        if batch_size is not None and batch_size % nd == 0:
+            return "dp"
         return "dense"
     if real <= {"dp", "fsdp", "tp"} and cfg.vocab_size % mesh.shape["tp"] == 0:
         return "tp"
@@ -823,13 +897,16 @@ def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
     (standard switch-transformer weighting) and the realized token-overflow
     fraction is surfaced in the metrics."""
     tokens = batch["tokens"]
-    mode = _fused_ce_mode(cfg, params, mesh)
+    mode = _fused_ce_mode(cfg, params, mesh, batch_size=tokens.shape[0])
     if mode is not None:
         x, aux = forward_hidden(cfg, params, tokens[:, :-1], mesh)
         # Pass the master-dtype head: the ops compute in x.dtype but
         # accumulate dw in fp32 and return it at the param dtype.
         if mode == "tp":
             loss = vocab_parallel_cross_entropy(
+                x, params["head"], tokens[:, 1:], mesh, chunk=cfg.ce_chunk)
+        elif mode == "dp":
+            loss = data_parallel_fused_cross_entropy(
                 x, params["head"], tokens[:, 1:], mesh, chunk=cfg.ce_chunk)
         else:
             loss = fused_linear_cross_entropy(

@@ -24,6 +24,16 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float("-inf")
 
 
+def _check_gqa_heads(q, k, v):
+    """Every attention path shares one clear failure for bad GQA shapes
+    (e.g. 4 q heads over 3 kv heads would otherwise floor to rep=1 and die
+    later in an opaque einsum shape error)."""
+    if q.shape[2] % k.shape[2] or k.shape[2] != v.shape[2]:
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of kv heads "
+            f"({k.shape[2]}/{v.shape[2]}, which must agree)")
+
+
 def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None,
                   window: Optional[int] = None):
     """Plain-XLA scaled-dot-product attention (ground truth / fallback).
@@ -39,6 +49,7 @@ def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    _check_gqa_heads(q, k, v)
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
@@ -413,7 +424,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 1024, block_k: int = 512,
+                    block_q: int = 512, block_k: int = 512,
                     use_pallas: Optional[bool] = None,
                     interpret: bool = False,
                     window: Optional[int] = None):
@@ -430,10 +441,7 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if q.shape[2] % k.shape[2] or k.shape[2] != v.shape[2]:
-        raise ValueError(
-            f"q heads ({q.shape[2]}) must be a multiple of kv heads "
-            f"({k.shape[2]}/{v.shape[2]}, which must agree)")
+    _check_gqa_heads(q, k, v)
     if window is not None:
         if not causal:
             raise ValueError("window requires causal=True")
@@ -469,6 +477,146 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     return _flash(cfg, q, k, v)
 
 
+def _decode_reference(q, k_cache, v_cache, pos, scale):
+    """Dense masked attention of one query token over a KV cache (ground
+    truth / non-TPU path for ``flash_decode``).  Grouped einsum: the cache
+    streams at kv width, q heads grouped kv-major as [kv, g]."""
+    b, h, d = q.shape
+    kv = k_cache.shape[2]
+    g = h // kv
+    m = k_cache.shape[1]
+    q5 = q.reshape(b, kv, g, d)
+    s = jnp.einsum("bkgd,bmkd->bkgm", q5, k_cache).astype(jnp.float32)
+    s = s * scale
+    bad = jnp.arange(m, dtype=jnp.int32) > pos
+    s = jnp.where(bad[None, None, None], NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    o = jnp.einsum("bkgm,bmkd->bkgd", p, v_cache)
+    return o.reshape(b, h, d)
+
+
+def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, o_ref, o_acc, m_acc,
+                         l_acc, *, block_m: int, scale: float):
+    """One (batch, kv-head, m-block) grid step of single-token decode.
+
+    ``s_ref`` holds the scalar-prefetched pair (n_live_blocks, pos).  Blocks
+    past the bound are skipped AND their index map pins to the last live
+    block, so Mosaic's unchanged-index elision never DMAs them — HBM
+    traffic is O(pos), not O(max_len).  Online softmax accumulates across
+    the m grid dim in VMEM scratch; the normalized output writes once on
+    the final step.
+    """
+    j = pl.program_id(2)
+    nb = s_ref[0]
+    pos = s_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+
+    @pl.when(j < nb)
+    def _step():
+        q = q_ref[0, 0, :, :]                       # [g, d]
+        k_blk = k_ref[0, 0, :, :]                   # [bm, d]
+        v_blk = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * scale                               # [g, bm]
+        kpos = j * block_m + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(kpos > pos, NEG_INF, s)
+        m_prev, l_prev, o_prev = m_acc[...], l_acc[...], o_acc[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_acc[...] = m_new
+        l_acc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o_acc[...] = o_prev * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        # Block 0 always holds position 0 <= pos, so l > 0.
+        o_ref[0, 0, :, :] = (o_acc[...] / l_acc[...]).astype(o_ref.dtype)
+
+
+def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
+                 block_m: int = 512, use_pallas: Optional[bool] = None,
+                 interpret: bool = False):
+    """Single-token decode attention over a KV cache, bounded at ``pos``.
+
+    ``q``: [B, H, D] (the one new token's heads, kv-major groups);
+    ``k_cache``/``v_cache``: [B, M, KV, D] with positions [0..pos] written;
+    ``pos``: scalar int32 (traced OK — it rides the kernel's scalar
+    prefetch).  Returns [B, H, D].
+
+    The XLA einsum reads all M cache slots every step because ``pos`` is
+    traced; this kernel's grid maps the out-of-range m-blocks to the last
+    live block (never re-fetched), so per-step HBM traffic is
+    O(pos·kv·D) — the difference between serving a 32k-slot cache at
+    position 2k and paying for 32k.  GQA runs at cache width: the score
+    block is [g, block_m] per kv head, no materialized repeat.
+    """
+    b, h, d = q.shape
+    m, kv = k_cache.shape[1], k_cache.shape[2]
+    _check_gqa_heads(q[:, None], k_cache, v_cache)  # heads to axis 2
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    g = h // kv
+    block_m = _pick_block(m, block_m)
+    aligned = block_m <= 1024
+    if use_pallas is None:
+        on_tpu = jax.default_backend() == "tpu"
+        use_pallas = aligned and (on_tpu or interpret)
+    if not use_pallas:
+        return _decode_reference(q, k_cache, v_cache, pos, scale)
+
+    pos = jnp.asarray(pos, jnp.int32)
+    scalars = jnp.stack([pos // block_m + 1, pos])
+    if q.dtype != k_cache.dtype:
+        # e.g. bf16 queries over a caller-widened fp32 cache: the kernel's
+        # dots need one operand dtype (promote, matching the einsum path).
+        q = q.astype(jnp.promote_types(q.dtype, k_cache.dtype))
+        k_cache = k_cache.astype(q.dtype)
+    qt = q.reshape(b, kv, g, d)
+    # [B, M, KV, D] -> [B, KV, M, D]: (seq, head_dim) trailing for tiling.
+    kt = k_cache.transpose(0, 2, 1, 3)
+    vt = v_cache.transpose(0, 2, 1, 3)
+
+    q_spec = pl.BlockSpec((1, 1, g, d), lambda bi, hi, j, s: (bi, hi, 0, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_m, d),
+        lambda bi, hi, j, s: (bi, hi, jnp.minimum(j, s[0] - 1), 0),
+        memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, kv, m // block_m),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((g, d), jnp.float32),
+                        pltpu.VMEM((g, 1), jnp.float32),
+                        pltpu.VMEM((g, 1), jnp.float32)])
+    out = pl.pallas_call(
+        functools.partial(_flash_decode_kernel, block_m=block_m,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * m * d,
+            bytes_accessed=(k_cache.size + v_cache.size
+                            + 2 * q.size) * q.dtype.itemsize,
+            transcendentals=b * h * m),
+    )(scalars, qt, kt, vt)
+    return out.reshape(b, h, d)
+
+
 def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
                             scale: Optional[float] = None, **kw):
     """Flash attention under explicit sharding: shard_map over the mesh's
@@ -479,6 +627,7 @@ def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
 
     from tfmesos_tpu.parallel.sharding import data_axes
 
+    _check_gqa_heads(q, k, v)
     batch = data_axes(mesh)
     heads = "tp" if "tp" in mesh.shape and mesh.shape["tp"] > 1 else None
     if heads is not None and k.shape[2] % mesh.shape["tp"]:
@@ -511,6 +660,7 @@ def attend(q, k, v, mesh=None, causal: bool = True,
     flash/reference paths (head-index mapping, no repeat) and to Ulysses
     (narrow-width K/V all-to-all when sp divides kv_heads); the ring works
     per-head, so GQA inputs are broadcast up for it here."""
+    _check_gqa_heads(q, k, v)
     if mesh is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1:
         if window is not None:
             raise ValueError(

@@ -272,3 +272,93 @@ def _vp_bwd(mesh, axis, z_loss, chunk, res, g):
 
 
 vocab_parallel_cross_entropy.defvjp(_vp_fwd, _vp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def data_parallel_fused_cross_entropy(x, w, labels, mesh, z_loss: float = 0.0,
+                                      chunk: int = 2048):
+    """``fused_linear_cross_entropy`` for data-parallel meshes: ``x``
+    [B, T, d] and ``labels`` [B, T] batch-sharded over the data axes,
+    ``w`` [d, V] replicated (or fsdp-sharded — GSPMD gathers it at the
+    boundary exactly as the unfused head matmul would).
+
+    Each device runs the chunked scan over ITS OWN tokens only, so no
+    chunk ever cuts across the batch sharding (the naive chunked scan
+    flattens [B·T] in an order that interleaves devices' shards, forcing
+    GSPMD to reshard every step).  Loss and dw psum over the data axes;
+    dx stays local.  Same math as the dense form — only the reduction
+    grouping differs.
+    """
+    loss, _ = _dp_fwd(x, w, labels, mesh, z_loss, chunk)
+    return loss
+
+
+def _dp_fwd(x, w, labels, mesh, z_loss, chunk):
+    batch, nb = _vp_batch_axes(mesh)
+
+    def local(xl, wl, ll):
+        xs, ls, n_loc = _flce_flatten(xl, ll, chunk)
+        wc = wl.astype(xl.dtype)
+
+        def body(acc, inp):
+            xc, lc = inp
+            logits = (xc @ wc).astype(jnp.float32)      # [c, V]
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+            s = jnp.sum(logz - picked)
+            if z_loss:
+                s = s + z_loss * jnp.sum(logz ** 2)
+            return acc + s, logz
+
+        total, logzs = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                    (xs, ls))
+        if batch:
+            total = jax.lax.psum(total, batch)          # global token sum
+        return total / (n_loc * nb), logzs
+
+    loss, logzs = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(batch, None, None), P(None, None), P(batch, None)),
+        out_specs=(P(), P(batch, None)), check_vma=False)(x, w, labels)
+    return loss, (x, w, labels, logzs)
+
+
+def _dp_bwd(mesh, z_loss, chunk, res, g):
+    x, w, labels, logzs = res
+    batch, nb = _vp_batch_axes(mesh)
+
+    def local(xl, wl, ll, logzs_l, gl):
+        xs, ls, n_loc = _flce_flatten(xl, ll, chunk)
+        wc = wl.astype(xl.dtype)
+        scale = gl / (n_loc * nb)
+
+        def body(dw_acc, inp):
+            xc, lc, logz = inp
+            logits = (xc @ wc).astype(jnp.float32)
+            p = jnp.exp(logits - logz[:, None])
+            if z_loss:
+                p = p * (1.0 + (2.0 * z_loss) * logz)[:, None]
+            onehot = jax.nn.one_hot(lc, logits.shape[-1], dtype=jnp.float32)
+            dlogits = ((p - onehot) * scale).astype(xl.dtype)
+            dx_c = dlogits @ wc.T
+            dw_acc = dw_acc + jax.lax.dot_general(
+                xc, dlogits, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dw_acc, dx_c
+
+        dw, dxs = jax.lax.scan(
+            body, jnp.zeros(wl.shape, jnp.float32), (xs, ls, logzs_l))
+        if batch:
+            dw = jax.lax.psum(dw, batch)                # all tokens' sum
+        return dxs.reshape(xl.shape).astype(xl.dtype), dw.astype(wl.dtype)
+
+    dx, dw = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(batch, None, None), P(None, None), P(batch, None),
+                  P(batch, None), P()),
+        out_specs=(P(batch, None, None), P(None, None)),
+        check_vma=False)(x, w, labels, logzs, g)
+    return dx, dw, None
+
+
+data_parallel_fused_cross_entropy.defvjp(_dp_fwd, _dp_bwd)

@@ -154,7 +154,7 @@ def switch_moe_local(x, router_w, w_gate, w_up, w_down, axis: str = "ep",
 def switch_moe_replicated_local(x, router_w, w_gate, w_up, w_down,
                                 ep_axis: str = None,
                                 capacity_factor: float = 1.25,
-                                top_k: int = 1):
+                                top_k: int = 1, tp_axis: str = None):
     """Capacity MoE for ep-REPLICATED tokens (the pipeline-stage layout).
 
     Inside ``pipeline_apply`` activations replicate over ``ep`` while the
@@ -164,26 +164,31 @@ def switch_moe_replicated_local(x, router_w, w_gate, w_up, w_down,
     routing semantics as ``switch_moe_local`` (slot priority, capacity
     drops, gate weighting); the router weight must be replicated so every
     device sees the full [n, E] logits.  ``ep_axis=None`` runs all experts
-    locally (pp without ep).  Returns (out, aux); aux is identical across
-    the ep group by construction.
+    locally (pp without ep).  ``tp_axis`` additionally shards every
+    expert's FFN width (w_gate/w_up [e_loc, d, f/tp], w_down
+    [e_loc, f/tp, d]) — the w_down contraction yields a partial sum, so
+    one psum covers both axes.  Returns (out, aux); aux is identical
+    across the ep/tp groups by construction.
     """
-    if not ep_axis:
+    if not ep_axis and not tp_axis:
         return switch_moe_reference(x, router_w, w_gate, w_up, w_down,
                                     capacity_factor, top_k=top_k,
                                     return_aux=True)
     n, d = x.shape
     e_loc = w_gate.shape[0]
-    e = e_loc * jax.lax.axis_size(ep_axis)
+    e = e_loc * (jax.lax.axis_size(ep_axis) if ep_axis else 1)
     capacity = _capacity(n, e, capacity_factor, top_k)
     combine, aux = _routing(x, router_w, e, capacity, top_k)  # [n, E, C]
-    idx = jax.lax.axis_index(ep_axis)
-    combine = jax.lax.dynamic_slice_in_dim(combine, idx * e_loc, e_loc,
-                                           axis=1)           # [n, e_loc, C]
+    if ep_axis:
+        idx = jax.lax.axis_index(ep_axis)
+        combine = jax.lax.dynamic_slice_in_dim(combine, idx * e_loc, e_loc,
+                                               axis=1)       # [n, e_loc, C]
     dispatch = (combine > 0.0).astype(jnp.float32)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, x.astype(jnp.float32))
     expert_out = _expert_ffn(expert_in, w_gate, w_up, w_down, x.dtype)
     out = jnp.einsum("nec,ecd->nd", combine, expert_out.astype(jnp.float32))
-    return jax.lax.psum(out, ep_axis).astype(x.dtype), aux
+    psum_axes = tuple(a for a in (ep_axis, tp_axis) if a)
+    return jax.lax.psum(out, psum_axes).astype(x.dtype), aux
 
 
 def switch_moe(x, router_w, w_gate, w_up, w_down, mesh: Mesh,
