@@ -350,9 +350,29 @@ def test_flash_decode_bad_gqa_heads():
         flash_decode(q, kc, vc, 10)
 
 
-def test_decode_step_kernel_path_matches_dense():
+@pytest.mark.parametrize("pos", [0, 511, 700])
+def test_flash_decode_int8_cache(pos):
+    """QTensor caches: HBM streams int8 and the per-position scales fold
+    into the score/probability rows — bit-identical to dequantize-then-
+    attend."""
+    from tfmesos_tpu.ops.attention import _decode_reference, flash_decode
+    from tfmesos_tpu.ops.quant import quantize_tensor
+    q, kc, vc = _decode_inputs()
+    kq, vq = quantize_tensor(kc), quantize_tensor(vc)
+    ref = _decode_reference(q, kq.dequantize(jnp.float32),
+                            vq.dequantize(jnp.float32), pos,
+                            q.shape[-1] ** -0.5)
+    got = flash_decode(q, kq, vq, pos, use_pallas=True, interpret=True,
+                       block_m=256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_decode_step_kernel_path_matches_dense(quantized):
     """decode_step with the kernel gate forced open reproduces the dense
-    einsum path's logits (the auto gate only opens on TPU)."""
+    einsum path's logits, for fp and int8 caches alike (the auto gate only
+    opens on TPU)."""
     from tfmesos_tpu.models import transformer
 
     cfg = transformer.TransformerConfig(
@@ -361,14 +381,14 @@ def test_decode_step_kernel_path_matches_dense():
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
                                 cfg.vocab_size)
-    cache0 = transformer.init_cache(cfg, 2, 640)
+    cache0 = transformer.init_cache(cfg, 2, 640, quantized=quantized)
     logits, cache = transformer.decode_step(cfg, params, cache0, prompt, 0)
     tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
 
     ref_logits, _ = transformer.decode_step(cfg, params, cache, tok, 9)
 
     orig = transformer._decode_kernel_kwargs
-    transformer._decode_kernel_kwargs = lambda cfg_, ck, m, t, sharded: (
+    transformer._decode_kernel_kwargs = lambda cfg_, m, t, sharded: (
         {"use_pallas": True, "interpret": True} if t == 1 else None)
     try:
         got_logits, _ = transformer.decode_step(cfg, params, cache, tok, 9)

@@ -644,16 +644,15 @@ def cache_specs(cfg: TransformerConfig, mesh: Mesh,
     return {"k": spec, "v": spec}
 
 
-def _decode_kernel_kwargs(cfg: TransformerConfig, ck, m: int, t: int,
+def _decode_kernel_kwargs(cfg: TransformerConfig, m: int, t: int,
                           sharded: bool):
     """kwargs for ``flash_decode`` when the single-token kernel applies,
     else None.  TPU only (a pallas_call under a GSPMD-sharded jit cannot
-    partition, so ``sharded`` decode keeps the einsum); fp caches (int8
-    stays on the fused dequantize-einsum); full buffers (rolling-window
-    caches address by slot); m large enough that the O(pos) HBM bound
-    beats the kernel's fixed cost."""
-    if (t == 1 and not sharded and cfg.window is None
-            and not isinstance(ck, QTensor) and m >= 512
+    partition, so ``sharded`` decode keeps the einsum); fp or int8
+    QTensor caches (the kernel folds the int8 scales into the score
+    rows); full buffers (rolling-window caches address by slot); m large
+    enough that the O(pos) HBM bound beats the kernel's fixed cost."""
+    if (t == 1 and not sharded and cfg.window is None and m >= 512
             and jax.default_backend() == "tpu"):
         return {}
     return None
@@ -697,7 +696,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
             o = mha_reference(q, k, v, causal=True, window=cfg.window)
         else:
             o = attend(q, k, v, mesh=None, causal=True, window=cfg.window)
-    elif (kernel_kw := _decode_kernel_kwargs(cfg, ck, m, t,
+    elif (kernel_kw := _decode_kernel_kwargs(cfg, m, t,
                                              sharded)) is not None:
         # Single-token flash-decode kernel: scalar-prefetched block bound
         # caps per-step HBM traffic at O(pos) cache slots instead of the

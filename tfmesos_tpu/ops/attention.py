@@ -495,8 +495,8 @@ def _decode_reference(q, k_cache, v_cache, pos, scale):
     return o.reshape(b, h, d)
 
 
-def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, o_ref, o_acc, m_acc,
-                         l_acc, *, block_m: int, scale: float):
+def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
+                         scale: float, quantized: bool):
     """One (batch, kv-head, m-block) grid step of single-token decode.
 
     ``s_ref`` holds the scalar-prefetched pair (n_live_blocks, pos).  Blocks
@@ -505,7 +505,16 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, o_ref, o_acc, m_acc,
     traffic is O(pos), not O(max_len).  Online softmax accumulates across
     the m grid dim in VMEM scratch; the normalized output writes once on
     the final step.
+
+    ``quantized``: K/V refs are int8 with per-position fp32 scale refs
+    following them.  The scales fold into the score/probability rows
+    (k: s·kscale after the dot; v: (p·vscale)·v_int8), so the cache
+    streams from HBM at int8 width — the dequantize never touches HBM.
     """
+    if quantized:
+        ks_ref, vs_ref, o_ref, o_acc, m_acc, l_acc = rest
+    else:
+        o_ref, o_acc, m_acc, l_acc = rest
     j = pl.program_id(2)
     nb = s_ref[0]
     pos = s_ref[1]
@@ -521,9 +530,14 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, o_ref, o_acc, m_acc,
         q = q_ref[0, 0, :, :]                       # [g, d]
         k_blk = k_ref[0, 0, :, :]                   # [bm, d]
         v_blk = v_ref[0, 0, :, :]
+        if quantized:
+            k_blk = k_blk.astype(q.dtype)           # VMEM convert, not HBM
+            v_blk = v_blk.astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * scale                               # [g, bm]
+        if quantized:
+            s = s * ks_ref[0, 0, 0, :][None, :]     # per-position k scales
         kpos = j * block_m + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(kpos > pos, NEG_INF, s)
@@ -533,6 +547,8 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, o_ref, o_acc, m_acc,
         corr = jnp.exp(m_prev - m_new)
         m_acc[...] = m_new
         l_acc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            p = p * vs_ref[0, 0, 0, :][None, :]     # per-position v scales
         o_acc[...] = o_prev * corr + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -549,7 +565,9 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     """Single-token decode attention over a KV cache, bounded at ``pos``.
 
     ``q``: [B, H, D] (the one new token's heads, kv-major groups);
-    ``k_cache``/``v_cache``: [B, M, KV, D] with positions [0..pos] written;
+    ``k_cache``/``v_cache``: [B, M, KV, D] with positions [0..pos] written
+    — plain arrays, or int8 ``QTensor``s (per-position scales), in which
+    case HBM streams int8 and the scales fold into the score rows;
     ``pos``: scalar int32 (traced OK — it rides the kernel's scalar
     prefetch).  Returns [B, H, D].
 
@@ -560,9 +578,14 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     position 2k and paying for 32k.  GQA runs at cache width: the score
     block is [g, block_m] per kv head, no materialized repeat.
     """
+    from tfmesos_tpu.ops.quant import QTensor
+
+    quantized = isinstance(k_cache, QTensor)
+    kc = k_cache.values if quantized else k_cache
+    vc = v_cache.values if quantized else v_cache
     b, h, d = q.shape
-    m, kv = k_cache.shape[1], k_cache.shape[2]
-    _check_gqa_heads(q[:, None], k_cache, v_cache)  # heads to axis 2
+    m, kv = kc.shape[1], kc.shape[2]
+    _check_gqa_heads(q[:, None], kc, vc)  # heads to axis 2
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     g = h // kv
@@ -572,19 +595,22 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
         on_tpu = jax.default_backend() == "tpu"
         use_pallas = aligned and (on_tpu or interpret)
     if not use_pallas:
+        if quantized:
+            k_cache = k_cache.dequantize(q.dtype)
+            v_cache = v_cache.dequantize(q.dtype)
         return _decode_reference(q, k_cache, v_cache, pos, scale)
 
     pos = jnp.asarray(pos, jnp.int32)
     scalars = jnp.stack([pos // block_m + 1, pos])
-    if q.dtype != k_cache.dtype:
+    if not quantized and q.dtype != kc.dtype:
         # e.g. bf16 queries over a caller-widened fp32 cache: the kernel's
         # dots need one operand dtype (promote, matching the einsum path).
-        q = q.astype(jnp.promote_types(q.dtype, k_cache.dtype))
-        k_cache = k_cache.astype(q.dtype)
+        q = q.astype(jnp.promote_types(q.dtype, kc.dtype))
+        kc = kc.astype(q.dtype)
     qt = q.reshape(b, kv, g, d)
     # [B, M, KV, D] -> [B, KV, M, D]: (seq, head_dim) trailing for tiling.
-    kt = k_cache.transpose(0, 2, 1, 3)
-    vt = v_cache.transpose(0, 2, 1, 3)
+    kt = kc.transpose(0, 2, 1, 3)
+    vt = vc.transpose(0, 2, 1, 3)
 
     q_spec = pl.BlockSpec((1, 1, g, d), lambda bi, hi, j, s: (bi, hi, 0, 0),
                           memory_space=pltpu.VMEM)
@@ -592,17 +618,29 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
         (1, 1, block_m, d),
         lambda bi, hi, j, s: (bi, hi, jnp.minimum(j, s[0] - 1), 0),
         memory_space=pltpu.VMEM)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qt, kt, vt]
+    if quantized:
+        # Scales as [B, KV, 1, M]: positions on the lane dim, same pinned
+        # index map as their values.
+        sc_spec = pl.BlockSpec(
+            (1, 1, 1, block_m),
+            lambda bi, hi, j, s: (bi, hi, 0, jnp.minimum(j, s[0] - 1)),
+            memory_space=pltpu.VMEM)
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_cache.scales[..., 0].transpose(0, 2, 1)[:, :, None, :],
+                     v_cache.scales[..., 0].transpose(0, 2, 1)[:, :, None, :]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, kv, m // block_m),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((g, d), jnp.float32),
                         pltpu.VMEM((g, 1), jnp.float32),
                         pltpu.VMEM((g, 1), jnp.float32)])
     out = pl.pallas_call(
         functools.partial(_flash_decode_kernel, block_m=block_m,
-                          scale=float(scale)),
+                          scale=float(scale), quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
@@ -610,10 +648,10 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * m * d,
-            bytes_accessed=(k_cache.size + v_cache.size
-                            + 2 * q.size) * q.dtype.itemsize,
+            bytes_accessed=(kc.size * kc.dtype.itemsize * 2
+                            + 2 * q.size * q.dtype.itemsize),
             transcendentals=b * h * m),
-    )(scalars, qt, kt, vt)
+    )(scalars, *operands)
     return out.reshape(b, h, d)
 
 
