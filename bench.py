@@ -273,6 +273,66 @@ def bench_decode(batch=8, prompt_len=128, new_tokens=256, quantized=False,
     return batch * new_tokens / best
 
 
+def bench_decode_long_context(batch=4, max_len=16384, prompt_len=1024,
+                              new_tokens=64):
+    """Steady-state decode with a LONG cache buffer, early in generation —
+    the flash-decode kernel's case: its scalar-prefetched block bound reads
+    O(pos) cache slots while the XLA einsum pays for all ``max_len`` every
+    step.  Returns (kernel_tok_s, einsum_tok_s); their ratio is the
+    realized bandwidth saving (~max_len/pos bound at these shapes).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, n_kv_heads=8,
+        d_ff=1408, max_seq_len=max_len, dtype=jnp.bfloat16)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab_size, dtype=jnp.int32)
+    cache0 = transformer.init_cache(cfg, batch, max_len)
+    prefill = jax.jit(lambda p, c, t: transformer.decode_step(cfg, p, c, t, 0))
+    logits, cache = prefill(params, cache0, prompt)
+    tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def loop_with(gate):
+        from tfmesos_tpu.models import transformer as tr
+        orig = tr._decode_kernel_kwargs
+        tr._decode_kernel_kwargs = gate
+
+        @jax.jit
+        def decode_loop(params, cache, tok):
+            def body(carry, _):
+                cache, tok, pos = carry
+                logits, cache = tr.decode_step(cfg, params, cache,
+                                               tok[:, None], pos)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (cache, nxt, pos + 1), None
+            (cache, tok, _), _ = lax.scan(
+                body, (cache, tok, jnp.asarray(prompt_len, jnp.int32)), None,
+                length=new_tokens)
+            return tok
+        try:
+            out = decode_loop(params, cache, tok0)
+            jax.block_until_ready(out)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = decode_loop(params, cache, tok0)
+                np.asarray(out)
+                best = min(best, time.perf_counter() - t0)
+        finally:
+            tr._decode_kernel_kwargs = orig
+        return batch * new_tokens / best
+
+    from tfmesos_tpu.models import transformer as tr
+    kernel_gate = tr._decode_kernel_kwargs       # the real auto gate
+    einsum_gate = lambda *a, **k: None           # force the XLA einsum
+    return loop_with(kernel_gate), loop_with(einsum_gate)
+
+
 def bench_attention(b=4, t=2048, h=8, d=128, reps=10):
     """Flash-kernel vs XLA-reference attention, fwd+bwd, at the BASELINE.md
     comparison shape (B4/T2048/H8/D128 bf16 causal).
@@ -573,6 +633,14 @@ def main():
         # Long-prompt config: at 1k+ cached positions the cache bytes rival
         # the weights', which is where the int8 KV cache earns its keep.
         out["decode_int8_kv_tokens_per_sec"] = round(max(dec8kv), 1)
+    longctx = attempts(bench_decode_long_context, "long-context decode bench",
+                       n=1)
+    if longctx:
+        kern_tok, einsum_tok = longctx[0]
+        out["decode_longctx_tokens_per_sec"] = round(kern_tok, 1)
+        out["decode_longctx_einsum_tokens_per_sec"] = round(einsum_tok, 1)
+        out["decode_longctx_kernel_speedup"] = round(
+            kern_tok / einsum_tok, 3)
     attn = attempts(bench_attention, "attention kernel bench", n=1)
     if attn:
         flash_ms, xla_ms = attn[0]

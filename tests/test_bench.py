@@ -62,3 +62,10 @@ def test_attention_bench_smoke():
     flash_ms, xla_ms = bench.bench_attention(b=1, t=128, h=2, d=32, reps=2)
     assert np.isfinite(flash_ms) and flash_ms > 0
     assert np.isfinite(xla_ms) and xla_ms > 0
+
+
+def test_decode_long_context_bench_smoke():
+    kern, einsum = bench.bench_decode_long_context(
+        batch=1, max_len=512, prompt_len=32, new_tokens=4)
+    assert np.isfinite(kern) and kern > 0
+    assert np.isfinite(einsum) and einsum > 0
