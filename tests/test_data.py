@@ -104,3 +104,46 @@ def test_start_step_fast_forward_matches_full_stream(tmp_path):
     assert_streams_equal(
         take(tfd.batches(2, 8, seed=11), 6)[4:],
         take(tfd.batches(2, 8, seed=11, start_step=4), 2))
+
+
+def test_native_gather_matches_numpy(tmp_path):
+    """The C++ tokenloader (when built) must be bit-identical to the numpy
+    memmap path across dtypes, stripes, and resume offsets."""
+    import pytest
+
+    from tfmesos_tpu.train.data import _NativeTokenGather
+    if _NativeTokenGather.load() is None:
+        pytest.skip("libtokenloader.so not built")
+    for dtype in ("uint16", "uint32"):
+        path = str(tmp_path / f"toks_{dtype}.bin")
+        toks = np.random.RandomState(3).randint(0, 60000, size=20000)
+        TokenFileDataset.write(path, toks, dtype=dtype)
+        ds = TokenFileDataset(path, dtype=dtype)
+        for rank, ws, ss in [(0, 1, 0), (1, 2, 5)]:
+            g_np = ds.batches(4, 33, rank=rank, world_size=ws,
+                              start_step=ss, native=False)
+            g_cc = ds.batches(4, 33, rank=rank, world_size=ws,
+                              start_step=ss, native=True)
+            for _ in range(4):
+                a, b = next(g_np)["tokens"], next(g_cc)["tokens"]
+                assert b.dtype == np.int32
+                np.testing.assert_array_equal(a, b)
+
+
+def test_native_gather_rejects_bad_windows(tmp_path):
+    import pytest
+
+    from tfmesos_tpu.train.data import _NativeTokenGather
+    if _NativeTokenGather.load() is None:
+        pytest.skip("libtokenloader.so not built")
+    path = str(tmp_path / "toks.bin")
+    TokenFileDataset.write(path, np.arange(100))
+    loader = _NativeTokenGather(path, np.dtype("uint16"))
+    assert loader.n_tokens == 100
+    with pytest.raises(ValueError):
+        loader.gather(np.array([95]), 17)  # runs past the end
+    with pytest.raises(ValueError):
+        loader.gather(np.array([-1]), 4)
+    out = loader.gather(np.array([0, 83]), 17)
+    np.testing.assert_array_equal(out[0], np.arange(17))
+    loader.close()

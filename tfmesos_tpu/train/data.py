@@ -11,6 +11,7 @@ a seeded Zipf-ish sampler.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Dict, Iterator
 
@@ -148,6 +149,102 @@ def prefetch(batches: Iterator, mesh=None, depth: int = 2,
         yield queue.popleft()
 
 
+class _NativeTokenGather:
+    """ctypes wrapper over ``native/libtokenloader.so``: mmap + madvise
+    gather/convert of [B, T+1] token windows in C++, optionally on a
+    background thread (double-buffering against the train step).  Output
+    is bit-identical to the numpy memmap path.  ``load()`` returns None
+    when the library isn't built — callers fall back to numpy."""
+
+    _lib = None
+    _tried = False
+
+    @classmethod
+    def load(cls):
+        if not cls._tried:
+            cls._tried = True
+            import ctypes
+            path = os.path.join(os.path.dirname(__file__), os.pardir,
+                                "native", "libtokenloader.so")
+            if os.path.exists(path):
+                try:
+                    lib = ctypes.CDLL(path)
+                    lib.tl_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+                    lib.tl_open.restype = ctypes.c_void_p
+                    lib.tl_n_tokens.argtypes = [ctypes.c_void_p]
+                    lib.tl_n_tokens.restype = ctypes.c_int64
+                    ptr = ctypes.POINTER
+                    args = [ctypes.c_void_p, ptr(ctypes.c_int64),
+                            ctypes.c_int64, ctypes.c_int64,
+                            ptr(ctypes.c_int32)]
+                    lib.tl_gather.argtypes = args
+                    lib.tl_gather.restype = ctypes.c_int
+                    lib.tl_gather_async.argtypes = args
+                    lib.tl_gather_async.restype = ctypes.c_int
+                    lib.tl_wait.argtypes = [ctypes.c_void_p]
+                    lib.tl_wait.restype = ctypes.c_int
+                    lib.tl_close.argtypes = [ctypes.c_void_p]
+                    lib.tl_close.restype = None
+                    cls._lib = lib
+                except OSError:
+                    cls._lib = None
+        return cls._lib
+
+    def __init__(self, path: str, dtype: np.dtype):
+        import ctypes
+        self._ctypes = ctypes
+        self.lib = self.load()
+        if self.lib is None:
+            raise RuntimeError("libtokenloader.so not built")
+        self.handle = self.lib.tl_open(
+            os.fsencode(os.path.abspath(path)), int(dtype.itemsize))
+        if not self.handle:
+            raise RuntimeError(f"tl_open failed for {path}")
+        self.n_tokens = self.lib.tl_n_tokens(self.handle)
+        # Keep the in-flight gather's operands alive until wait().
+        self._inflight = None
+
+    def _ptrs(self, starts: np.ndarray, out: np.ndarray):
+        c = self._ctypes
+        return (starts.ctypes.data_as(c.POINTER(c.c_int64)),
+                len(starts), out.shape[1],
+                out.ctypes.data_as(c.POINTER(c.c_int32)))
+
+    def gather(self, starts: np.ndarray, t1: int) -> np.ndarray:
+        starts = np.ascontiguousarray(starts, np.int64)
+        out = np.empty((len(starts), t1), np.int32)
+        rc = self.lib.tl_gather(self.handle, *self._ptrs(starts, out))
+        if rc != 0:
+            raise ValueError(f"tl_gather rc={rc}")
+        return out
+
+    def gather_async(self, starts: np.ndarray, t1: int) -> None:
+        starts = np.ascontiguousarray(starts, np.int64)
+        out = np.empty((len(starts), t1), np.int32)
+        rc = self.lib.tl_gather_async(self.handle,
+                                      *self._ptrs(starts, out))
+        if rc != 0:
+            raise ValueError(f"tl_gather_async rc={rc}")
+        self._inflight = (starts, out)
+
+    def wait(self) -> np.ndarray:
+        starts, out = self._inflight
+        self.lib.tl_wait(self.handle)
+        self._inflight = None
+        return out
+
+    def close(self) -> None:
+        if getattr(self, "handle", None):
+            self.lib.tl_close(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 @dataclass
 class TokenFileDataset:
     """Memmap-backed token stream — the standard pretraining format: one
@@ -180,12 +277,19 @@ class TokenFileDataset:
         np.asarray(tokens).astype(np.dtype(dtype)).tofile(path)
 
     def batches(self, batch_size: int, seq_len: int, rank: int = 0,
-                world_size: int = 1, seed: int = None, start_step: int = 0
-                ) -> Iterator[Dict[str, np.ndarray]]:
+                world_size: int = 1, seed: int = None, start_step: int = 0,
+                native: bool = None) -> Iterator[Dict[str, np.ndarray]]:
         """Endless [B, T+1] next-token batches from this rank's stripe.
 
         ``start_step`` starts at that step for exact O(1) resume (per-step
-        RNG; no skipped data is drawn or read)."""
+        RNG; no skipped data is drawn or read).
+
+        ``native=None`` auto-uses the C++ gather (``libtokenloader.so``)
+        when built: the window copies + int32 convert run off the GIL with
+        the NEXT step's batch assembling on a background thread while the
+        current step trains — bit-identical output to the numpy path.
+        ``False`` forces numpy; ``True`` errors if the library is missing.
+        """
         if not 0 <= rank < world_size:
             raise ValueError(f"rank {rank} outside world of {world_size}")
         n = self.tokens.size
@@ -197,10 +301,32 @@ class TokenFileDataset:
                 f"window ({seq_len + 1}); fewer ranks or a bigger file")
         base_seed = self.seed if seed is None else seed
         starts_max = hi - (seq_len + 1)
-        step = start_step
-        while True:
-            starts = _step_rng(base_seed, step).randint(
+        t1 = seq_len + 1
+
+        def starts_for(step):
+            return _step_rng(base_seed, step).randint(
                 lo, starts_max + 1, size=batch_size)
+
+        loader = None
+        if native is not False:
+            try:
+                loader = _NativeTokenGather(self.path, np.dtype(self.dtype))
+            except RuntimeError:
+                if native:
+                    raise
+        if loader is None:
+            step = start_step
+            while True:
+                starts = starts_for(step)
+                step += 1
+                batch = np.stack([self.tokens[s:s + t1] for s in starts])
+                yield {"tokens": batch.astype(np.int32)}
+        # Double-buffered native path: step N's gather overlapped with the
+        # consumer's work on step N-1.
+        step = start_step
+        loader.gather_async(starts_for(step), t1)
+        while True:
+            batch = loader.wait()
             step += 1
-            batch = np.stack([self.tokens[s:s + seq_len + 1] for s in starts])
-            yield {"tokens": batch.astype(np.int32)}
+            loader.gather_async(starts_for(step), t1)
+            yield {"tokens": batch}
