@@ -396,3 +396,21 @@ def test_decode_step_kernel_path_matches_dense(quantized):
         transformer._decode_kernel_kwargs = orig
     np.testing.assert_allclose(np.asarray(got_logits),
                                np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_decode_ragged_positions():
+    """pos as a [B] vector: each row's block loop bounds independently —
+    the mixed-length serving case."""
+    from tfmesos_tpu.ops.attention import _decode_reference, flash_decode
+    q, kc, vc = _decode_inputs(b=3, m=1024, h=4, kv=2, d=32)
+    posv = jnp.array([7, 600, 1023], jnp.int32)
+    ref = _decode_reference(q, kc, vc, posv, q.shape[-1] ** -0.5)
+    for i, p in enumerate([7, 600, 1023]):   # vector ref == per-row scalar
+        ri = _decode_reference(q[i:i + 1], kc[i:i + 1], vc[i:i + 1], p,
+                               q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(np.asarray(ref[i:i + 1]), np.asarray(ri),
+                                   rtol=1e-6)
+    got = flash_decode(q, kc, vc, posv, use_pallas=True, interpret=True,
+                       block_m=256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

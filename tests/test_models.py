@@ -1066,3 +1066,110 @@ def test_gqa_trains_on_sp_mesh():
             mqa, mqa_params,
             jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64),
             build_mesh({"pp": 2, "tp": 2, "dp": 2}))
+
+
+def test_ragged_decode_step_matches_per_row():
+    """Per-row positions through decode_step: batched ragged decode equals
+    each row decoded alone at its own position (cache writes, attention
+    bounds, and rope all follow the row's position)."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    lens = [5, 9, 3]
+    b = len(lens)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (b, 12), 0,
+                              cfg.vocab_size)
+    ref_logits = []
+    for i, L in enumerate(lens):
+        c = transformer.init_cache(cfg, 1, 64)
+        _, c = transformer.decode_step(cfg, params, c, toks[i:i + 1, :L], 0)
+        lg, _ = transformer.decode_step(cfg, params, c,
+                                        toks[i:i + 1, L:L + 1], L)
+        ref_logits.append(np.asarray(lg[0, -1]))
+    cache = transformer.init_cache(cfg, b, 64)
+    _, cache = transformer.decode_step(cfg, params, cache,
+                                       toks[:, :max(lens)], 0)
+    lens_a = jnp.asarray(lens, jnp.int32)
+    nxt = jnp.take_along_axis(toks, lens_a[:, None], axis=1)
+    lg, cache = transformer.decode_step(cfg, params, cache, nxt, lens_a)
+    for i in range(b):
+        np.testing.assert_allclose(np.asarray(lg[i, -1]), ref_logits[i],
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ragged_generate_matches_per_row():
+    """generate(prompt_lens=...): each padded row's continuation equals
+    generating from its unpadded prompt alone, landing right after the
+    real prompt in the output."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    lens, new = [5, 9, 3], 6
+    b = len(lens)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (b, 9), 0,
+                              cfg.vocab_size)
+    out = transformer.generate(cfg, params, toks, new,
+                               prompt_lens=jnp.asarray(lens, jnp.int32))
+    for i, L in enumerate(lens):
+        ref = transformer.generate(cfg, params, toks[i:i + 1, :L], new)
+        np.testing.assert_array_equal(np.asarray(out[i, :L + new]),
+                                      np.asarray(ref[0]))
+
+
+def test_ragged_rejects_windowed_configs():
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, window=8)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    cache = transformer.init_cache(cfg, 2, 32)
+    tok = jnp.zeros((2, 1), jnp.int32)
+    with pytest.raises(ValueError, match="ragged"):
+        transformer.decode_step(cfg, params, cache, tok,
+                                jnp.array([1, 2], jnp.int32))
+
+
+SPEC_DRAFT = transformer.TransformerConfig(
+    vocab_size=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+    max_seq_len=128, dtype=jnp.float32)
+
+
+def test_speculative_generate_exactness():
+    """The speculative exactness property: output equals the target's own
+    greedy continuation for ANY draft model — an unrelated draft only
+    costs acceptance rate, never changes tokens."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = transformer.init_params(SPEC_DRAFT, jax.random.PRNGKey(7))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (3, 9), 0,
+                              cfg.vocab_size)
+    ref = np.asarray(transformer.generate(cfg, params, toks, 12))
+    for nd in (1, 4, 6):
+        spec = transformer.speculative_generate(
+            cfg, params, SPEC_DRAFT, dparams, toks, 12, n_draft=nd)
+        np.testing.assert_array_equal(np.asarray(spec), ref)
+    # Self-draft: every proposal accepted, same answer.
+    spec = transformer.speculative_generate(cfg, params, cfg, params,
+                                            toks, 12, n_draft=3)
+    np.testing.assert_array_equal(np.asarray(spec), ref)
+
+
+def test_speculative_generate_ragged():
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = transformer.init_params(SPEC_DRAFT, jax.random.PRNGKey(7))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (3, 9), 0,
+                              cfg.vocab_size)
+    lens = jnp.array([4, 9, 6], jnp.int32)
+    ref = np.asarray(transformer.generate(cfg, params, toks, 10,
+                                          prompt_lens=lens))
+    spec = np.asarray(transformer.speculative_generate(
+        cfg, params, SPEC_DRAFT, dparams, toks, 10, n_draft=4,
+        prompt_lens=lens))
+    for i, ln in enumerate([4, 9, 6]):
+        np.testing.assert_array_equal(spec[i, :ln + 10], ref[i, :ln + 10])

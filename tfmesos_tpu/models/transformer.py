@@ -583,6 +583,10 @@ def _cache_write(cache, chunk, pos, rolling: bool = False):
     layer, quantizing on the way in when the cache is int8 (the same
     per-row absmax rule as weight quantization — ops/quant.py).
 
+    ``pos`` may be a [B] vector (ragged serving: each row writes at its
+    own position — a vmapped per-row dynamic slice; non-rolling caches
+    only).
+
     ``rolling`` (window configs): position p writes slot p mod M — a
     single-token step is one wrapped dynamic slice; a longer chunk
     (prefill, static ``pos``) keeps its last M tokens via a modular
@@ -591,8 +595,16 @@ def _cache_write(cache, chunk, pos, rolling: bool = False):
     """
     m = (cache.values if isinstance(cache, QTensor) else cache).shape[1]
     t = chunk.shape[1]
+    ragged = getattr(pos, "ndim", 0) == 1
+    if ragged and rolling:
+        raise ValueError("ragged positions do not compose with rolling "
+                         "(windowed) caches")
 
     def put(buf, x):
+        if ragged:
+            return jax.vmap(
+                lambda b_, x_, p_: jax.lax.dynamic_update_slice(
+                    b_, x_, (p_,) + (0,) * (b_.ndim - 1)))(buf, x, pos)
         if not rolling:
             return jax.lax.dynamic_update_slice(buf, x, (0, pos, 0, 0))
         if t == 1:
@@ -663,8 +675,10 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     """One block over a token chunk with cached history.
 
     ``x``: [B, t, d] (t = chunk length; 1 in steady-state decode);
-    ``ck``/``cv``: [B, M, H, Dh] this layer's cache; ``positions``: [t]
-    global positions of the chunk; ``pos``: first chunk position (traced).
+    ``ck``/``cv``: [B, M, H, Dh] this layer's cache; ``positions``:
+    [B, t] per-row global positions of the chunk (rows differ in the
+    ragged case); ``pos``: first chunk position — scalar (python int or
+    traced) or [B] vector, as handed to ``_cache_write``.
     A multi-token prefill from an empty cache attends chunk-to-chunk (flash
     kernel when ``sharded=False``; a plain einsum when ``sharded=True`` so
     GSPMD can partition it — a pallas_call under sharded jit cannot be);
@@ -680,7 +694,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
                                                cfg.head_dim)
     v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.kv_heads,
                                                cfg.head_dim)
-    pos_row = jnp.broadcast_to(positions, (b, t))
+    pos_row = positions                                 # [b, t]
     q = rope(q, pos_row, cfg.rope_theta)
     k = rope(k, pos_row, cfg.rope_theta)
     rolling = cfg.window is not None
@@ -700,9 +714,10 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
                                              sharded)) is not None:
         # Single-token flash-decode kernel: scalar-prefetched block bound
         # caps per-step HBM traffic at O(pos) cache slots instead of the
-        # full buffer (ops/attention.flash_decode).
+        # full buffer, independently per row (ops/attention.flash_decode).
         from tfmesos_tpu.ops.attention import flash_decode
-        o = flash_decode(q[:, 0], ck, cv, positions[0], **kernel_kw)[:, None]
+        o = flash_decode(q[:, 0], ck, cv, positions[:, 0],
+                         **kernel_kw)[:, None]
     else:
         # Grouped einsum over the cache: the KV blocks stream from HBM
         # once at kv_heads width (int8 when quantized) — never
@@ -721,14 +736,15 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
                 raise ValueError("chunked decode over a rolling windowed "
                                  "cache is not supported; decode one token "
                                  "per step after the prefill")
-            p0 = positions[0]
+            p0 = positions[0, 0]    # rolling caches are never ragged
             slot = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
             spos = p0 - ((p0 - slot) % m)
             bad = (spos < 0) | (spos < p0 - (cfg.window - 1))
+            bad = bad[None]
         else:
             kpos = jax.lax.broadcasted_iota(jnp.int32, (t, m), 1)
-            bad = kpos > positions[:, None]
-        s = jnp.where(bad[None, None, None], -jnp.inf, s)
+            bad = kpos[None] > positions[:, :, None]    # [b, t, m]
+        s = jnp.where(bad[:, None, None], -jnp.inf, s)
         probs = jax.nn.softmax(s, axis=-1).astype(cv_r.dtype)
         o = jnp.einsum("bkgtm,bmkd->btkgd", probs, cv_r)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
@@ -742,7 +758,10 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     """Advance decoding by a token chunk.
 
     ``tokens``: [B, t] (the prompt at prefill, one token per step after);
-    ``pos``: first global position of the chunk (python int or traced).
+    ``pos``: first global position of the chunk (python int or traced), or
+    a [B] int32 vector for RAGGED batches — each row decodes at its own
+    position (mixed-length serving: cache writes, attention bounds, and
+    rope all follow the per-row position; not with windowed configs).
     Returns (logits [B, t, V], updated cache).
 
     For multi-chip decode, pass ``sharded=True``, place the params per
@@ -762,9 +781,16 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     dropping tokens by batch-order competition at inference would be worse
     than the mismatch.
     """
-    t = tokens.shape[1]
+    b, t = tokens.shape
     x = _embed_lookup(params["embed"], tokens, cfg.dtype)
-    positions = pos + jnp.arange(t, dtype=jnp.int32)
+    ragged = getattr(pos, "ndim", 0) == 1
+    if ragged and cfg.window is not None:
+        raise ValueError("ragged positions do not compose with "
+                         "sliding-window (rolling-cache) configs")
+    offs = jnp.arange(t, dtype=jnp.int32)
+    pos_arr = jnp.asarray(pos, jnp.int32)
+    positions = jnp.broadcast_to(
+        (pos_arr[:, None] if ragged else pos_arr) + offs, (b, t))
 
     def body(carry, layer):
         lp, ck, cv = layer
@@ -816,7 +842,7 @@ def sample_logits(logits, key, temperature: float = 1.0,
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
-             quantized_cache: bool = False):
+             quantized_cache: bool = False, prompt_lens=None):
     """Autoregressive generation: prefill the prompt in one pass, then one
     fused scan step per token (KV cache; greedy, temperature, top-k and/or
     top-p nucleus sampling — see ``sample_logits``).
@@ -825,6 +851,13 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     with ``quantize_params`` this is the full int8 serving config.
 
     ``prompt``: [B, Tp] int32.  Returns [B, Tp + max_new_tokens].
+
+    ``prompt_lens`` ([B] int32, optional) serves a RAGGED batch: row i's
+    real prompt is ``prompt[i, :prompt_lens[i]]`` (right-padding ignored —
+    causal attention plus per-row position bounds keep pad slots
+    invisible, and each row's generated tokens overwrite them in the
+    cache).  Row i's continuation lands at ``[lens[i], lens[i] +
+    max_new_tokens)`` of the returned array; later entries are padding.
     """
     b, tp = prompt.shape
     if max_new_tokens <= 0:
@@ -839,7 +872,16 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
 
     logits, cache = decode_step(cfg, params, cache, prompt, 0)
     rng, key = jax.random.split(rng)
-    tok = sample(logits[:, -1], key)
+    if prompt_lens is None:
+        next_logits = logits[:, -1]
+        pos0 = jnp.asarray(tp, jnp.int32)
+    else:
+        lens = jnp.asarray(prompt_lens, jnp.int32)
+        # Row i's next token follows its LAST REAL token, not the padding.
+        next_logits = jnp.take_along_axis(
+            logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+        pos0 = lens
+    tok = sample(next_logits, key)
 
     def body(carry, _):
         cache, tok, pos, rng = carry
@@ -849,11 +891,127 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         return (cache, nxt, pos + 1, rng), tok
 
     (cache, tok, _, _), toks = jax.lax.scan(
-        body, (cache, tok, jnp.asarray(tp, jnp.int32), rng), None,
+        body, (cache, tok, pos0, rng), None,
         length=max_new_tokens - 1)
     generated = jnp.concatenate(
         [jnp.moveaxis(toks, 0, 1), tok[:, None]], axis=1)
-    return jnp.concatenate([prompt, generated], axis=1)
+    if prompt_lens is None:
+        return jnp.concatenate([prompt, generated], axis=1)
+    # Scatter each row's continuation right after its real prompt.
+    out = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
+    idx = lens[:, None] + jnp.arange(max_new_tokens, dtype=jnp.int32)[None]
+    return _scatter_rows(out, idx, generated)
+
+
+def _scatter_rows(out, idx, vals, mode: Optional[str] = None):
+    """Row-wise scatter: ``out[i, idx[i]] = vals[i]`` (idx/vals may carry a
+    trailing per-row dim).  ``mode="drop"`` discards out-of-bounds entries
+    — the masked-write idiom (duplicate clipped indices have no defined
+    scatter winner, so masking via OOB indices is the safe form)."""
+    return jax.vmap(lambda o, i, v: o.at[i].set(v, mode=mode))(
+        out, idx, vals)
+
+
+def speculative_generate(cfg: TransformerConfig, params,
+                         draft_cfg: TransformerConfig, draft_params,
+                         prompt, max_new_tokens: int, n_draft: int = 4,
+                         prompt_lens=None):
+    """Greedy speculative decoding: a cheap DRAFT model proposes
+    ``n_draft`` tokens per round, the target model scores them all in ONE
+    chunked decode, and the leading run that matches the target's own
+    greedy choices commits (plus the target's correction token) — between
+    1 and ``n_draft + 1`` tokens per target dispatch.
+
+    Output is EXACTLY the target model's greedy continuation, whatever the
+    draft proposes (a bad draft only costs speed); both models run on the
+    ragged per-row position machinery, so each batch row accepts at its
+    own rate.  Greedy only — sampling acceptance needs the
+    rejection-sampling correction, which this does not implement.
+
+    ``prompt``: [B, Tp]; ``prompt_lens`` as in :func:`generate`.  Returns
+    [B, Tp + max_new_tokens] with row i's continuation at
+    ``[lens[i], lens[i] + max_new_tokens)``.
+    """
+    if cfg.window is not None or draft_cfg.window is not None:
+        raise ValueError("speculative decoding does not compose with "
+                         "sliding-window configs (rolling caches cannot "
+                         "be ragged)")
+    b, tp = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    k = int(n_draft)
+    if k < 1:
+        raise ValueError(f"n_draft must be >= 1, got {n_draft}")
+    # Slack: a row can overshoot to committed = max_new + k (pos =
+    # lens + max_new + k - 1) and, frozen, keeps verifying k+1-token
+    # chunks at that position — writes reach lens + max_new + 2k.
+    depth = tp + max_new_tokens + 2 * k + 1
+    cache = init_cache(cfg, b, depth)
+    draft_cache = init_cache(draft_cfg, b, depth)
+
+    logits, cache = decode_step(cfg, params, cache, prompt, 0)
+    _, draft_cache = decode_step(draft_cfg, draft_params, draft_cache,
+                                 prompt, 0)  # fills the draft's cache
+    if prompt_lens is None:
+        lens = jnp.full((b,), tp, jnp.int32)
+    else:
+        lens = jnp.asarray(prompt_lens, jnp.int32)
+    tok = jnp.argmax(jnp.take_along_axis(
+        logits, (lens - 1)[:, None, None], axis=1)[:, 0], -1).astype(jnp.int32)
+    # One committed token exists already (the prefill's argmax).
+    out = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
+    out = _scatter_rows(out, lens, tok)
+    limit = lens + max_new_tokens       # first out index past row's region
+
+    def round_(state):
+        cache, draft_cache, tok, pos, committed, out = state
+        active = committed < max_new_tokens
+
+        # Draft k tokens autoregressively (t=1 ragged steps).
+        def dstep(carry, _):
+            dcache, dtok, dpos = carry
+            lg, dcache = decode_step(draft_cfg, draft_params, dcache,
+                                     dtok[:, None], dpos)
+            nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+            return (dcache, nxt, dpos + 1), nxt
+
+        (draft_cache, _, _), drafts = jax.lax.scan(
+            dstep, (draft_cache, tok, pos), None, length=k)
+        drafts = jnp.moveaxis(drafts, 0, 1)             # [B, k]
+
+        # Target scores the whole drafted chunk in one ragged decode.
+        chunk = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, k+1]
+        lg, cache = decode_step(cfg, params, cache, chunk, pos)
+        g = jnp.argmax(lg, -1).astype(jnp.int32)        # [B, k+1] greedy
+        match = drafts == g[:, :k]                      # [B, k]
+        a = jnp.argmin(jnp.concatenate(
+            [match, jnp.zeros((b, 1), bool)], axis=1).astype(jnp.int32),
+            axis=1)                                     # leading-run length
+        n_commit = jnp.where(active, a + 1, 0)
+
+        # Commit g[0..a] right after each row's last committed token.
+        # Masked/overflow entries get an out-of-bounds index and drop —
+        # clipping instead would alias real indices, and duplicate scatter
+        # indices have no defined winner.
+        j = jnp.arange(k + 1, dtype=jnp.int32)[None]
+        idx = pos[:, None] + 1 + j
+        mask = (j < n_commit[:, None]) & (idx < limit[:, None])
+        out = _scatter_rows(out, jnp.where(mask, idx, out.shape[1]), g,
+                            mode="drop")
+
+        tok = jnp.where(active,
+                        jnp.take_along_axis(g, a[:, None], axis=1)[:, 0],
+                        tok)
+        pos = pos + n_commit
+        committed = committed + n_commit
+        return cache, draft_cache, tok, pos, committed, out
+
+    state = (cache, draft_cache, tok, lens, jnp.ones((b,), jnp.int32), out)
+    state = jax.lax.while_loop(
+        lambda s: jnp.any(s[4] < max_new_tokens), round_, state)
+    return state[5]
 
 
 def _fused_ce_mode(cfg: TransformerConfig, params, mesh: Optional[Mesh],

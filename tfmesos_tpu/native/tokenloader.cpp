@@ -36,13 +36,18 @@ void gather(const Loader *ld, const int64_t *starts, int64_t b, int64_t t1,
             int32_t *out) {
   const int64_t db = ld->dtype_bytes;
   const long page = sysconf(_SC_PAGESIZE);
+  // Hint ALL windows before copying any: the kernel reads ahead for the
+  // later rows while the earlier ones convert (hinting row i just before
+  // copying row i would overlap with nothing).  Harmless when cached.
   for (int64_t i = 0; i < b; ++i) {
-    const int64_t s = starts[i];
-    const uint8_t *src = ld->base + s * db;
-    // Hint the kernel to read the window ahead; harmless when cached.
+    const uint8_t *src = ld->base + starts[i] * db;
     const uintptr_t a0 = reinterpret_cast<uintptr_t>(src) & ~(page - 1);
     const uintptr_t a1 = reinterpret_cast<uintptr_t>(src + t1 * db);
     madvise(reinterpret_cast<void *>(a0), a1 - a0, MADV_WILLNEED);
+  }
+  for (int64_t i = 0; i < b; ++i) {
+    const int64_t s = starts[i];
+    const uint8_t *src = ld->base + s * db;
     int32_t *dst = out + i * t1;
     if (db == 2) {
       const uint16_t *p = reinterpret_cast<const uint16_t *>(src);
@@ -102,7 +107,12 @@ int tl_gather_async(void *handle, const int64_t *starts, int64_t b,
                     int64_t t1, int32_t *out) {
   auto *ld = static_cast<Loader *>(handle);
   if (!ld || b <= 0 || t1 <= 0) return -1;
-  if (ld->busy.load()) return -3;
+  if (ld->worker.joinable()) {
+    // A finished-but-unjoined worker is still joinable; assigning over it
+    // would std::terminate.  Only a gather actually mid-flight is an error.
+    if (ld->busy.load()) return -3;
+    ld->worker.join();
+  }
   for (int64_t i = 0; i < b; ++i)
     if (starts[i] < 0 || starts[i] + t1 > ld->n_tokens) return -2;
   ld->busy.store(true);

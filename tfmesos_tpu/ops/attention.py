@@ -488,8 +488,9 @@ def _decode_reference(q, k_cache, v_cache, pos, scale):
     q5 = q.reshape(b, kv, g, d)
     s = jnp.einsum("bkgd,bmkd->bkgm", q5, k_cache).astype(jnp.float32)
     s = s * scale
-    bad = jnp.arange(m, dtype=jnp.int32) > pos
-    s = jnp.where(bad[None, None, None], NEG_INF, s)
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    bad = jnp.arange(m, dtype=jnp.int32)[None] > pos[:, None]   # [b, m]
+    s = jnp.where(bad[:, None, None], NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
     o = jnp.einsum("bkgm,bmkd->bkgd", p, v_cache)
     return o.reshape(b, h, d)
@@ -515,9 +516,10 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
         ks_ref, vs_ref, o_ref, o_acc, m_acc, l_acc = rest
     else:
         o_ref, o_acc, m_acc, l_acc = rest
+    bi = pl.program_id(0)
     j = pl.program_id(2)
-    nb = s_ref[0]
-    pos = s_ref[1]
+    nb = s_ref[0, bi]      # per-batch-row block bound (ragged serving)
+    pos = s_ref[1, bi]
 
     @pl.when(j == 0)
     def _init():
@@ -568,8 +570,10 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     ``k_cache``/``v_cache``: [B, M, KV, D] with positions [0..pos] written
     — plain arrays, or int8 ``QTensor``s (per-position scales), in which
     case HBM streams int8 and the scales fold into the score rows;
-    ``pos``: scalar int32 (traced OK — it rides the kernel's scalar
-    prefetch).  Returns [B, H, D].
+    ``pos``: scalar int32, or a [B] vector for RAGGED batches (each row at
+    its own position — the mixed-length serving case); traced OK either
+    way (it rides the kernel's scalar prefetch, bounding each row's block
+    loop independently).  Returns [B, H, D].
 
     The XLA einsum reads all M cache slots every step because ``pos`` is
     traced; this kernel's grid maps the out-of-range m-blocks to the last
@@ -600,8 +604,8 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
             v_cache = v_cache.dequantize(q.dtype)
         return _decode_reference(q, k_cache, v_cache, pos, scale)
 
-    pos = jnp.asarray(pos, jnp.int32)
-    scalars = jnp.stack([pos // block_m + 1, pos])
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    scalars = jnp.stack([pos // block_m + 1, pos])      # [2, B]
     if not quantized and q.dtype != kc.dtype:
         # e.g. bf16 queries over a caller-widened fp32 cache: the kernel's
         # dots need one operand dtype (promote, matching the einsum path).
@@ -616,7 +620,7 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec(
         (1, 1, block_m, d),
-        lambda bi, hi, j, s: (bi, hi, jnp.minimum(j, s[0] - 1), 0),
+        lambda bi, hi, j, s: (bi, hi, jnp.minimum(j, s[0, bi] - 1), 0),
         memory_space=pltpu.VMEM)
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [qt, kt, vt]
@@ -625,7 +629,7 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
         # index map as their values.
         sc_spec = pl.BlockSpec(
             (1, 1, 1, block_m),
-            lambda bi, hi, j, s: (bi, hi, 0, jnp.minimum(j, s[0] - 1)),
+            lambda bi, hi, j, s: (bi, hi, 0, jnp.minimum(j, s[0, bi] - 1)),
             memory_space=pltpu.VMEM)
         in_specs += [sc_spec, sc_spec]
         operands += [k_cache.scales[..., 0].transpose(0, 2, 1)[:, :, None, :],

@@ -322,11 +322,16 @@ class TokenFileDataset:
                 batch = np.stack([self.tokens[s:s + t1] for s in starts])
                 yield {"tokens": batch.astype(np.int32)}
         # Double-buffered native path: step N's gather overlapped with the
-        # consumer's work on step N-1.
-        step = start_step
-        loader.gather_async(starts_for(step), t1)
-        while True:
-            batch = loader.wait()
-            step += 1
+        # consumer's work on step N-1.  close() on GeneratorExit so an
+        # abandoned iterator releases the mmap and joins the worker thread
+        # deterministically, not at GC time.
+        try:
+            step = start_step
             loader.gather_async(starts_for(step), t1)
-            yield {"tokens": batch}
+            while True:
+                batch = loader.wait()
+                step += 1
+                loader.gather_async(starts_for(step), t1)
+                yield {"tokens": batch}
+        finally:
+            loader.close()
