@@ -34,6 +34,14 @@ def main():
                         "(transformer.quantize_params)")
     p.add_argument("--int8-kv", action="store_true", dest="int8_kv",
                    help="store the KV cache as int8 (per-position absmax)")
+    p.add_argument("--ragged", action="store_true",
+                   help="serve a mixed-length batch: random per-row prompt "
+                        "lengths, decoded together (generate prompt_lens=)")
+    p.add_argument("--speculative", action="store_true",
+                   help="greedy speculative decoding with a half-size "
+                        "draft model (output = the target's own greedy "
+                        "continuation; untrained draft => low acceptance, "
+                        "the point is the mechanics)")
     args = p.parse_args()
 
     import jax
@@ -60,10 +68,35 @@ def main():
         jax.random.PRNGKey(args.seed + 1), (args.batch, args.prompt_len), 0,
         cfg.vocab_size, dtype=jnp.int32)
 
-    gen = jax.jit(lambda p_, t_: transformer.generate(
-        cfg, p_, t_, args.new_tokens, rng=jax.random.PRNGKey(args.seed + 2),
-        temperature=args.temperature, top_k=args.top_k,
-        top_p=args.top_p, quantized_cache=args.int8_kv))
+    prompt_lens = None
+    if args.ragged:
+        prompt_lens = jax.random.randint(
+            jax.random.PRNGKey(args.seed + 3), (args.batch,),
+            max(1, args.prompt_len // 4), args.prompt_len + 1,
+            dtype=jnp.int32)
+        print("ragged prompt lens:", np.asarray(prompt_lens).tolist())
+
+    if args.speculative:
+        if args.temperature > 0:
+            print("note: speculative decoding is greedy; ignoring "
+                  "--temperature", file=sys.stderr)
+        draft_cfg = transformer.TransformerConfig(
+            vocab_size=cfg.vocab_size, d_model=cfg.d_model // 2,
+            n_layers=max(1, cfg.n_layers // 2), n_heads=cfg.n_heads,
+            d_ff=cfg.d_ff // 2, max_seq_len=cfg.max_seq_len,
+            dtype=cfg.dtype)
+        draft_params = transformer.init_params(
+            draft_cfg, jax.random.PRNGKey(args.seed + 4))
+        gen = jax.jit(lambda p_, t_: transformer.speculative_generate(
+            cfg, p_, draft_cfg, draft_params, t_, args.new_tokens,
+            prompt_lens=prompt_lens))
+    else:
+        gen = jax.jit(lambda p_, t_: transformer.generate(
+            cfg, p_, t_, args.new_tokens,
+            rng=jax.random.PRNGKey(args.seed + 2),
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, quantized_cache=args.int8_kv,
+            prompt_lens=prompt_lens))
     out = gen(params, prompt)  # compile + warm
     jax.block_until_ready(out)
     t0 = time.perf_counter()
@@ -72,8 +105,9 @@ def main():
     dt = time.perf_counter() - t0
     print(f"generated {args.batch}x{args.new_tokens} tokens in {dt:.3f}s "
           f"({args.batch * args.new_tokens / dt:.0f} tok/s incl. prefill)")
-    print("sample:", np.asarray(out[0, args.prompt_len:
-                                    args.prompt_len + 16]).tolist())
+    start = (int(np.asarray(prompt_lens)[0]) if prompt_lens is not None
+             else args.prompt_len)
+    print("sample:", np.asarray(out[0, start:start + 16]).tolist())
     return 0
 
 
