@@ -38,10 +38,11 @@ def main():
                    help="serve a mixed-length batch: random per-row prompt "
                         "lengths, decoded together (generate prompt_lens=)")
     p.add_argument("--speculative", action="store_true",
-                   help="greedy speculative decoding with a half-size "
-                        "draft model (output = the target's own greedy "
-                        "continuation; untrained draft => low acceptance, "
-                        "the point is the mechanics)")
+                   help="speculative decoding with a half-size draft model "
+                        "(temperature 0: exactly the target's greedy "
+                        "continuation; >0: rejection-sampled, distributed "
+                        "as target-only sampling; untrained draft => low "
+                        "acceptance, the point is the mechanics)")
     args = p.parse_args()
 
     import jax
@@ -77,9 +78,6 @@ def main():
         print("ragged prompt lens:", np.asarray(prompt_lens).tolist())
 
     if args.speculative:
-        if args.temperature > 0:
-            print("note: speculative decoding is greedy; ignoring "
-                  "--temperature", file=sys.stderr)
         draft_cfg = transformer.TransformerConfig(
             vocab_size=cfg.vocab_size, d_model=cfg.d_model // 2,
             n_layers=max(1, cfg.n_layers // 2), n_heads=cfg.n_heads,
@@ -89,7 +87,9 @@ def main():
             draft_cfg, jax.random.PRNGKey(args.seed + 4))
         gen = jax.jit(lambda p_, t_: transformer.speculative_generate(
             cfg, p_, draft_cfg, draft_params, t_, args.new_tokens,
-            prompt_lens=prompt_lens))
+            prompt_lens=prompt_lens, temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p,
+            rng=jax.random.PRNGKey(args.seed + 2)))
     else:
         gen = jax.jit(lambda p_, t_: transformer.generate(
             cfg, p_, t_, args.new_tokens,

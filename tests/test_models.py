@@ -1211,3 +1211,54 @@ def test_ragged_sharded_decode_matches_per_row():
     for i in range(4):
         np.testing.assert_allclose(np.asarray(lg[i, -1]), ref_logits[i],
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_speculative_sampling_distribution():
+    """Speculative SAMPLING correctness (Leviathan): with an unrelated
+    draft, committed-token marginals must match target-only sampling.
+    Token 1 checks the closed-form prefill distribution; tokens 2-3 (from
+    the rejection-sampling rounds) check empirically against generate()'s
+    own sampling under a different RNG stream."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=16, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        max_seq_len=32, dtype=jnp.float32)
+    draft = transformer.TransformerConfig(
+        vocab_size=16, d_model=8, n_layers=1, n_heads=1, d_ff=16,
+        max_seq_len=32, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = transformer.init_params(draft, jax.random.PRNGKey(9))
+    B = 2048
+    prompt = jnp.tile(jnp.array([[3, 7, 1, 12]], jnp.int32), (B, 1))
+    spec = np.asarray(transformer.speculative_generate(
+        cfg, params, draft, dparams, prompt, 3, n_draft=2,
+        temperature=1.0, rng=jax.random.PRNGKey(5)))
+
+    logits = transformer.forward(cfg, params, prompt[:1])
+    pt = np.asarray(jax.nn.softmax(
+        transformer.filter_logits(logits[0, -1], 1.0), -1))
+    emp = np.bincount(spec[:, 4], minlength=16) / B
+    assert np.max(np.abs(emp - pt)) < 0.04
+
+    ref = np.asarray(transformer.generate(
+        cfg, params, prompt, 3, temperature=1.0,
+        rng=jax.random.PRNGKey(11)))
+    for idx in (5, 6):
+        es = np.bincount(spec[:, idx], minlength=16) / B
+        er = np.bincount(ref[:, idx], minlength=16) / B
+        assert np.max(np.abs(es - er)) < 0.05, idx
+
+
+def test_speculative_sampling_self_draft_full_acceptance():
+    """Draft == target: every proposal is accepted (ratio 1), so rounds
+    commit n_draft+1 tokens each; output stays finite and in-vocab."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=32, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        max_seq_len=64, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 6), 0,
+                              cfg.vocab_size)
+    out = np.asarray(transformer.speculative_generate(
+        cfg, params, cfg, params, toks, 10, n_draft=3, temperature=0.7,
+        top_k=8, rng=jax.random.PRNGKey(2)))
+    assert out.shape == (4, 16)
+    assert ((out >= 0) & (out < cfg.vocab_size)).all()

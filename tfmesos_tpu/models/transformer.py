@@ -805,24 +805,30 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     return logits, {"k": new_k, "v": new_v}
 
 
-def sample_logits(logits, key, temperature: float = 1.0,
-                  top_k: Optional[int] = None,
-                  top_p: Optional[float] = None):
-    """Sample token ids from ``logits`` [..., V]: greedy when
-    ``temperature <= 0``, else temperature sampling optionally truncated to
-    the ``top_k`` highest-logit tokens and/or the ``top_p`` nucleus (the
-    smallest set of tokens whose probability mass reaches ``top_p``; the
-    argmax token always survives).  Static shapes throughout — sorts and
-    masks, no dynamic gathers — so it scans/jits cleanly.
-    """
+def _check_sampling_args(top_k: Optional[int], top_p: Optional[float]):
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    logits = logits.astype(jnp.float32)
+
+
+def filter_logits(logits, temperature: float = 1.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """Temperature-scale ``logits`` [..., V] and mask everything outside
+    the ``top_k`` highest-logit tokens and/or the ``top_p`` nucleus (the
+    smallest set of tokens whose probability mass reaches ``top_p``; the
+    argmax token always survives) to -inf.  ``softmax`` of the result is
+    the sampling distribution — exposed separately because speculative
+    sampling needs the full distribution, not just a draw.  Requires
+    ``temperature > 0``.  Static shapes throughout — sorts and masks, no
+    dynamic gathers — so it scans/jits cleanly.
+    """
     if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+        raise ValueError("filter_logits needs temperature > 0 (greedy "
+                         "sampling has no distribution to filter)")
+    _check_sampling_args(top_k, top_p)
+    logits = logits.astype(jnp.float32) / temperature
     if top_k is not None and top_k < logits.shape[-1]:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -836,7 +842,21 @@ def sample_logits(logits, key, temperature: float = 1.0,
         threshold = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
                             axis=-1, keepdims=True)
         logits = jnp.where(logits < threshold, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def sample_logits(logits, key, temperature: float = 1.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """Sample token ids from ``logits`` [..., V]: greedy when
+    ``temperature <= 0``, else a categorical draw from
+    ``filter_logits`` (temperature / top-k / top-p nucleus)."""
+    if temperature <= 0.0:
+        _check_sampling_args(top_k, top_p)
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
+            jnp.int32)
+    filtered = filter_logits(logits, temperature, top_k, top_p)
+    return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
 
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
@@ -916,20 +936,29 @@ def _scatter_rows(out, idx, vals, mode: Optional[str] = None):
 def speculative_generate(cfg: TransformerConfig, params,
                          draft_cfg: TransformerConfig, draft_params,
                          prompt, max_new_tokens: int, n_draft: int = 4,
-                         prompt_lens=None):
-    """Greedy speculative decoding: a cheap DRAFT model proposes
-    ``n_draft`` tokens per round, the target model scores them all in ONE
-    chunked decode, and the leading run that matches the target's own
-    greedy choices commits (plus the target's correction token) — between
-    1 and ``n_draft + 1`` tokens per target dispatch.
+                         prompt_lens=None, temperature: float = 0.0,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None, rng=None):
+    """Speculative decoding: a cheap DRAFT model proposes ``n_draft``
+    tokens per round, the target model scores them all in ONE chunked
+    decode, and the leading accepted run commits (plus one
+    correction/bonus token) — between 1 and ``n_draft + 1`` tokens per
+    target dispatch.
 
-    Output is EXACTLY the target model's greedy continuation, whatever the
-    draft proposes (a bad draft only costs speed); both models run on the
-    ragged per-row position machinery, so each batch row accepts at its
-    own rate.  Greedy only — sampling acceptance needs the
-    rejection-sampling correction, which this does not implement.
+    ``temperature <= 0`` (default): greedy — a draft commits while it
+    matches the target's own argmax, and the output is EXACTLY the target
+    model's greedy continuation, whatever the draft proposes (a bad draft
+    only costs speed).  ``temperature > 0``: speculative SAMPLING
+    (Leviathan et al.) — draft token x is accepted with probability
+    ``min(1, p_target(x)/p_draft(x))``; on rejection the correction is
+    drawn from ``norm(max(0, p_target − p_draft))``, and when every draft
+    survives a bonus token is drawn from the target's next distribution.
+    The committed tokens are distributed exactly as target-only sampling
+    under the same temperature/top-k/top-p filtering.
 
-    ``prompt``: [B, Tp]; ``prompt_lens`` as in :func:`generate`.  Returns
+    Both models run on the ragged per-row position machinery, so each
+    batch row accepts at its own rate.  ``prompt``: [B, Tp];
+    ``prompt_lens`` as in :func:`generate`.  Returns
     [B, Tp + max_new_tokens] with row i's continuation at
     ``[lens[i], lens[i] + max_new_tokens)``.
     """
@@ -943,6 +972,9 @@ def speculative_generate(cfg: TransformerConfig, params,
     k = int(n_draft)
     if k < 1:
         raise ValueError(f"n_draft must be >= 1, got {n_draft}")
+    sampling = temperature > 0.0
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
     # Slack: a row can overshoot to committed = max_new + k (pos =
     # lens + max_new + k - 1) and, frozen, keeps verifying k+1-token
     # chunks at that position — writes reach lens + max_new + 2k.
@@ -957,16 +989,29 @@ def speculative_generate(cfg: TransformerConfig, params,
         lens = jnp.full((b,), tp, jnp.int32)
     else:
         lens = jnp.asarray(prompt_lens, jnp.int32)
-    tok = jnp.argmax(jnp.take_along_axis(
-        logits, (lens - 1)[:, None, None], axis=1)[:, 0], -1).astype(jnp.int32)
-    # One committed token exists already (the prefill's argmax).
+    first_logits = jnp.take_along_axis(
+        logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+    rng, key0 = jax.random.split(rng)
+    tok = sample_logits(first_logits, key0, temperature, top_k, top_p)
+    # One committed token exists already (the prefill's sample).
     out = jnp.concatenate(
         [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
     out = _scatter_rows(out, lens, tok)
     limit = lens + max_new_tokens       # first out index past row's region
 
-    def round_(state):
-        cache, draft_cache, tok, pos, committed, out = state
+    def commit(out, pos, a, n_commit, vals):
+        # Commit vals[0..a] right after each row's last committed token.
+        # Masked/overflow entries get an out-of-bounds index and drop —
+        # clipping instead would alias real indices, and duplicate scatter
+        # indices have no defined winner.
+        j = jnp.arange(k + 1, dtype=jnp.int32)[None]
+        idx = pos[:, None] + 1 + j
+        mask = (j < n_commit[:, None]) & (idx < limit[:, None])
+        return _scatter_rows(out, jnp.where(mask, idx, out.shape[1]), vals,
+                             mode="drop")
+
+    def greedy_round(state):
+        cache, draft_cache, tok, pos, committed, out, rng = state
         active = committed < max_new_tokens
 
         # Draft k tokens autoregressively (t=1 ragged steps).
@@ -990,27 +1035,74 @@ def speculative_generate(cfg: TransformerConfig, params,
             [match, jnp.zeros((b, 1), bool)], axis=1).astype(jnp.int32),
             axis=1)                                     # leading-run length
         n_commit = jnp.where(active, a + 1, 0)
-
-        # Commit g[0..a] right after each row's last committed token.
-        # Masked/overflow entries get an out-of-bounds index and drop —
-        # clipping instead would alias real indices, and duplicate scatter
-        # indices have no defined winner.
-        j = jnp.arange(k + 1, dtype=jnp.int32)[None]
-        idx = pos[:, None] + 1 + j
-        mask = (j < n_commit[:, None]) & (idx < limit[:, None])
-        out = _scatter_rows(out, jnp.where(mask, idx, out.shape[1]), g,
-                            mode="drop")
-
+        out = commit(out, pos, a, n_commit, g)
         tok = jnp.where(active,
                         jnp.take_along_axis(g, a[:, None], axis=1)[:, 0],
                         tok)
-        pos = pos + n_commit
-        committed = committed + n_commit
-        return cache, draft_cache, tok, pos, committed, out
+        return (cache, draft_cache, tok, pos + n_commit,
+                committed + n_commit, out, rng)
 
-    state = (cache, draft_cache, tok, lens, jnp.ones((b,), jnp.int32), out)
+    def sampling_round(state):
+        cache, draft_cache, tok, pos, committed, out, rng = state
+        active = committed < max_new_tokens
+        rng, kd, ka, kr = jax.random.split(rng, 4)
+
+        # Draft k sampled tokens, keeping each step's full distribution.
+        def dstep(carry, key):
+            dcache, dtok, dpos = carry
+            lg, dcache = decode_step(draft_cfg, draft_params, dcache,
+                                     dtok[:, None], dpos)
+            f = filter_logits(lg[:, -1], temperature, top_k, top_p)
+            nxt = jax.random.categorical(key, f, axis=-1).astype(jnp.int32)
+            return (dcache, nxt, dpos + 1), (nxt, jax.nn.softmax(f, -1))
+
+        (draft_cache, _, _), (drafts, pd) = jax.lax.scan(
+            dstep, (draft_cache, tok, pos), jax.random.split(kd, k))
+        drafts = jnp.moveaxis(drafts, 0, 1)             # [B, k]
+        pd = jnp.moveaxis(pd, 0, 1)                     # [B, k, V]
+
+        chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
+        lg, cache = decode_step(cfg, params, cache, chunk, pos)
+        pt = jax.nn.softmax(
+            filter_logits(lg, temperature, top_k, top_p), -1)  # [B, k+1, V]
+
+        # Accept x_j with prob min(1, pt(x_j)/pd(x_j)); a = leading run.
+        ptx = jnp.take_along_axis(pt[:, :k], drafts[..., None], -1)[..., 0]
+        pdx = jnp.take_along_axis(pd, drafts[..., None], -1)[..., 0]
+        u = jax.random.uniform(ka, (b, k))
+        acc = u * pdx < ptx         # u < ptx/pdx, robust as pdx -> 0
+        a = jnp.argmin(jnp.concatenate(
+            [acc, jnp.zeros((b, 1), bool)], axis=1).astype(jnp.int32),
+            axis=1)
+
+        # Correction at the rejection index from norm(max(0, pt - pd));
+        # padding pd with zeros at index k makes the all-accepted bonus
+        # draw (from pt_k itself) the same formula.
+        pd_pad = jnp.concatenate(
+            [pd, jnp.zeros((b, 1, pd.shape[-1]), pd.dtype)], axis=1)
+        pt_a = jnp.take_along_axis(pt, a[:, None, None], 1)[:, 0]
+        pd_a = jnp.take_along_axis(pd_pad, a[:, None, None], 1)[:, 0]
+        resid = jnp.maximum(pt_a - pd_a, 0.0)
+        norm = jnp.sum(resid, -1, keepdims=True)
+        dist = jnp.where(norm > 1e-9, resid / jnp.maximum(norm, 1e-9), pt_a)
+        repl = jax.random.categorical(
+            kr, jnp.log(dist + 1e-20), axis=-1).astype(jnp.int32)
+
+        n_commit = jnp.where(active, a + 1, 0)
+        j = jnp.arange(k + 1, dtype=jnp.int32)[None]
+        cand = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+        vals = jnp.where(j == a[:, None], repl[:, None], cand)
+        out = commit(out, pos, a, n_commit, vals)
+        tok = jnp.where(active, repl, tok)
+        return (cache, draft_cache, tok, pos + n_commit,
+                committed + n_commit, out, rng)
+
+    state = (cache, draft_cache, tok, lens, jnp.ones((b,), jnp.int32), out,
+             rng)
     state = jax.lax.while_loop(
-        lambda s: jnp.any(s[4] < max_new_tokens), round_, state)
+        lambda s: jnp.any(s[4] < max_new_tokens),
+        sampling_round if sampling else greedy_round, state)
     return state[5]
 
 
