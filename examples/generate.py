@@ -34,6 +34,9 @@ def main():
                         "(transformer.quantize_params)")
     p.add_argument("--int8-kv", action="store_true", dest="int8_kv",
                    help="store the KV cache as int8 (per-position absmax)")
+    p.add_argument("--beam", type=int, default=None,
+                   help="beam-search width (deterministic; beam=1 == "
+                        "greedy); ignores --temperature/--ragged")
     p.add_argument("--ragged", action="store_true",
                    help="serve a mixed-length batch: random per-row prompt "
                         "lengths, decoded together (generate prompt_lens=)")
@@ -77,7 +80,11 @@ def main():
             dtype=jnp.int32)
         print("ragged prompt lens:", np.asarray(prompt_lens).tolist())
 
-    if args.speculative:
+    if args.beam is not None:
+        gen = jax.jit(lambda p_, t_: transformer.beam_search(
+            cfg, p_, t_, args.new_tokens, beam=args.beam,
+            quantized_cache=args.int8_kv))
+    elif args.speculative:
         draft_cfg = transformer.TransformerConfig(
             vocab_size=cfg.vocab_size, d_model=cfg.d_model // 2,
             n_layers=max(1, cfg.n_layers // 2), n_heads=cfg.n_heads,

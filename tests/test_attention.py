@@ -388,7 +388,8 @@ def test_decode_step_kernel_path_matches_dense(quantized):
     ref_logits, _ = transformer.decode_step(cfg, params, cache, tok, 9)
 
     orig = transformer._decode_kernel_kwargs
-    transformer._decode_kernel_kwargs = lambda cfg_, m, t, sharded: (
+    transformer._decode_kernel_kwargs = (
+        lambda cfg_, m, t, sharded, mesh=None, batch=None:
         {"use_pallas": True, "interpret": True} if t == 1 else None)
     try:
         got_logits, _ = transformer.decode_step(cfg, params, cache, tok, 9)
@@ -414,3 +415,73 @@ def test_flash_decode_ragged_positions():
                        block_m=256)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("pos", [0, 100])
+def test_flash_decode_chunk_matches_reference(pos):
+    """Chunked queries (q [B, t, H, D]): token tt attends cache positions
+    <= pos + tt — the speculative-verify / chunked-prefill case."""
+    from tfmesos_tpu.ops.attention import _decode_reference, flash_decode
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    b, m, h, kv, d, t = 2, 1024, 4, 2, 32, 5
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, m, kv, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, m, kv, d), jnp.float32)
+    ref = _decode_reference(q, kc, vc, pos, d ** -0.5)
+    got = flash_decode(q, kc, vc, pos, use_pallas=True, interpret=True,
+                       block_m=256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_chunk_ragged_and_int8():
+    from tfmesos_tpu.ops.attention import _decode_reference, flash_decode
+    from tfmesos_tpu.ops.quant import quantize_tensor
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    b, m, h, kv, d, t = 2, 512, 4, 2, 32, 3
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, m, kv, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, m, kv, d), jnp.float32)
+    posv = jnp.array([7, 400], jnp.int32)
+    ref = _decode_reference(q, kc, vc, posv, d ** -0.5)
+    got = flash_decode(q, kc, vc, posv, use_pallas=True, interpret=True,
+                       block_m=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    kq, vq = quantize_tensor(kc), quantize_tensor(vc)
+    ref8 = _decode_reference(q, kq.dequantize(jnp.float32),
+                             vq.dequantize(jnp.float32), posv, d ** -0.5)
+    got8 = flash_decode(q, kq, vq, posv, use_pallas=True, interpret=True,
+                        block_m=128)
+    np.testing.assert_allclose(np.asarray(got8), np.asarray(ref8),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_step_chunk_kernel_path_matches_dense():
+    """decode_step on a multi-token chunk (the speculative-verify shape)
+    with the kernel gate forced: logits match the einsum path, uniform
+    and ragged positions."""
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=640, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                cfg.vocab_size)
+    cache0 = transformer.init_cache(cfg, 2, 640)
+    _, cache = transformer.decode_step(cfg, params, cache0, prompt, 0)
+    chunk = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0,
+                               cfg.vocab_size)
+    orig = transformer._decode_kernel_kwargs
+    force = lambda cfg_, m, t, sharded, mesh=None, batch=None: (
+        {"use_pallas": True, "interpret": True})
+    for pos in (9, jnp.array([9, 6], jnp.int32)):
+        ref, _ = transformer.decode_step(cfg, params, cache, chunk, pos)
+        transformer._decode_kernel_kwargs = force
+        try:
+            got, _ = transformer.decode_step(cfg, params, cache, chunk, pos)
+        finally:
+            transformer._decode_kernel_kwargs = orig
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)

@@ -109,3 +109,62 @@ def test_quantized_params_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(restored["embed"].scales),
         np.asarray(qparams["embed"].scales))
+
+
+def test_restore_onto_resized_mesh(tmp_path):
+    """World-resize on restart: train the flagship on a dp4 x tp2 mesh,
+    checkpoint, then restore onto dp2 x tp4 (different shardings, fewer
+    data shards) and keep training — the semi-elastic recovery path the
+    fail-fast policy implies (SURVEY §5: re-provision + restore, not
+    hot-swap).  Orbax restores global arrays to whatever shardings the
+    template carries, so resize is template-driven."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tfmesos_tpu.models import transformer
+    from tfmesos_tpu.parallel.mesh import build_mesh
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32)
+    opt = optax.adamw(3e-3)
+
+    def make(mesh):
+        step = make_train_step(
+            lambda p, b: transformer.loss_fn(cfg, p, b, mesh), opt,
+            mesh=mesh,
+            param_specs=transformer.partition_specs(cfg, mesh))
+        return step
+
+    mesh1 = build_mesh({"dp": 4, "tp": 2})
+    step1 = make(mesh1)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    params, opt_state = step1.place(params, opt.init(params))
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        batch = {"tokens": rng.randint(0, cfg.vocab_size,
+                                       size=(8, 17)).astype(np.int32)}
+        params, opt_state, m1 = step1(params, opt_state, batch)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(3, {"params": params, "opt_state": opt_state})
+
+    # New world: same devices regrouped dp2 x tp4 (in production: fewer
+    # or different hosts after re-provision).
+    mesh2 = build_mesh({"dp": 2, "tp": 4})
+    step2 = make(mesh2)
+    like_p = jax.tree_util.tree_map(jnp.zeros_like, params)
+    like_o = jax.tree_util.tree_map(jnp.zeros_like, opt_state)
+    like_p, like_o = step2.place(like_p, like_o)
+    restored = mgr.restore({"params": like_p, "opt_state": like_o})
+    p2, o2 = restored["params"], restored["opt_state"]
+    # Values survived the resharding bit-exactly...
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(p2["embed"])),
+        np.asarray(jax.device_get(params["embed"])))
+    # ...and training continues on the new mesh.
+    for _ in range(2):
+        batch = {"tokens": rng.randint(0, cfg.vocab_size,
+                                       size=(8, 17)).astype(np.int32)}
+        p2, o2, m2 = step2(p2, o2, batch)
+    assert np.isfinite(float(m2["loss"]))
+    mgr.close()

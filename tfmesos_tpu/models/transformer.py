@@ -657,21 +657,45 @@ def cache_specs(cfg: TransformerConfig, mesh: Mesh,
 
 
 def _decode_kernel_kwargs(cfg: TransformerConfig, m: int, t: int,
-                          sharded: bool):
-    """kwargs for ``flash_decode`` when the single-token kernel applies,
-    else None.  TPU only (a pallas_call under a GSPMD-sharded jit cannot
-    partition, so ``sharded`` decode keeps the einsum); fp or int8
-    QTensor caches (the kernel folds the int8 scales into the score
-    rows); full buffers (rolling-window caches address by slot); m large
-    enough that the O(pos) HBM bound beats the kernel's fixed cost."""
-    if (t == 1 and not sharded and cfg.window is None and m >= 512
-            and jax.default_backend() == "tpu"):
+                          sharded: bool, mesh: Optional[Mesh] = None,
+                          batch: Optional[int] = None):
+    """kwargs for ``flash_decode`` when the cache-bounded kernel applies,
+    else None — single tokens (t=1) and short chunks (speculative verify
+    / chunked prefill; capped so the resident [t·g, block] score rows
+    stay kernel-shaped).  TPU only; fp or int8 QTensor caches (the kernel
+    folds the int8 scales into the score rows); full buffers
+    (rolling-window caches address by slot); m large enough that the
+    O(pos) HBM bound beats the kernel's fixed cost.
+
+    Sharded decode: a pallas_call cannot be GSPMD-partitioned, but with
+    an explicit ``mesh`` whose axes are data + tp (the ``cache_specs``
+    layout) the kernel runs per shard under a shard_map
+    (``sharded_flash_decode``); other meshes keep the einsum."""
+    if (t > 64 or cfg.window is not None or m < 512
+            or jax.default_backend() != "tpu"):
+        return None
+    if not sharded:
+        return {}
+    if mesh is None:
+        return None
+    real = {a for a, s in mesh.shape.items() if s > 1}
+    tp = mesh.shape.get("tp", 1)
+    nd = 1
+    for a in ("dp", "fsdp"):
+        nd *= mesh.shape.get(a, 1)
+    # shard_map needs the batch to divide over the data axes — the GSPMD
+    # einsum has no such constraint, so indivisible batches fall back.
+    if batch is not None and batch % nd:
+        return None
+    if real <= {"dp", "fsdp", "tp"} and cfg.kv_heads % tp == 0:
         return {}
     return None
 
 
+
+
 def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
-                  sharded: bool = False):
+                  sharded: bool = False, mesh: Optional[Mesh] = None):
     """One block over a token chunk with cached history.
 
     ``x``: [B, t, d] (t = chunk length; 1 in steady-state decode);
@@ -681,9 +705,11 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     traced) or [B] vector, as handed to ``_cache_write``.
     A multi-token prefill from an empty cache attends chunk-to-chunk (flash
     kernel when ``sharded=False``; a plain einsum when ``sharded=True`` so
-    GSPMD can partition it — a pallas_call under sharded jit cannot be);
-    steady-state queries run the dense einsum over the cache with an offset
-    causal mask — bandwidth-bound at t=1, no kernel needed.
+    GSPMD can partition it — a pallas_call under sharded jit cannot be).
+    Steady-state (t=1) queries take the flash-decode kernel when
+    ``_decode_kernel_kwargs`` opens the gate — directly, or per shard via
+    ``sharded_flash_decode`` when a mesh is given — and otherwise fall to
+    the dense einsum over the cache with an offset causal mask.
     """
     b, t, _ = x.shape
     m = (ck.values if isinstance(ck, QTensor) else ck).shape[1]
@@ -710,14 +736,22 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
             o = mha_reference(q, k, v, causal=True, window=cfg.window)
         else:
             o = attend(q, k, v, mesh=None, causal=True, window=cfg.window)
-    elif (kernel_kw := _decode_kernel_kwargs(cfg, m, t,
-                                             sharded)) is not None:
-        # Single-token flash-decode kernel: scalar-prefetched block bound
-        # caps per-step HBM traffic at O(pos) cache slots instead of the
-        # full buffer, independently per row (ops/attention.flash_decode).
-        from tfmesos_tpu.ops.attention import flash_decode
-        o = flash_decode(q[:, 0], ck, cv, positions[:, 0],
-                         **kernel_kw)[:, None]
+    elif (kernel_kw := _decode_kernel_kwargs(cfg, m, t, sharded, mesh,
+                                             batch=b)) is not None:
+        # Cache-bounded flash-decode kernel (t=1 steps and short chunks —
+        # speculative verify / chunked prefill): scalar-prefetched block
+        # bound caps per-step HBM traffic at O(pos) cache slots instead of
+        # the full buffer, independently per row
+        # (ops/attention.flash_decode).  Under sharded decode with an
+        # explicit mesh it runs per shard via shard_map (batch + kv-major
+        # tp head blocks).
+        if sharded:
+            from tfmesos_tpu.ops.attention import sharded_flash_decode
+            o = sharded_flash_decode(q, ck, cv, positions[:, 0], mesh,
+                                     **kernel_kw)
+        else:
+            from tfmesos_tpu.ops.attention import flash_decode
+            o = flash_decode(q, ck, cv, positions[:, 0], **kernel_kw)
     else:
         # Grouped einsum over the cache: the KV blocks stream from HBM
         # once at kv_heads width (int8 when quantized) — never
@@ -754,7 +788,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
 
 
 def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
-                sharded: bool = False):
+                sharded: bool = False, mesh: Optional[Mesh] = None):
     """Advance decoding by a token chunk.
 
     ``tokens``: [B, t] (the prompt at prefill, one token per step after);
@@ -770,6 +804,13 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     axes, heads over tp).  ``sharded=False`` (the ``generate`` path) may
     use the Pallas flash kernel for the prefill chunk instead.  sp and pp
     are training-side axes with no decode analogue here.
+
+    Passing the ``mesh`` alongside ``sharded=True`` additionally lets
+    single-token steps AND short chunks (speculative verify / chunked
+    prefill) run the flash-decode kernel per shard (shard_map over the
+    ``cache_specs`` layout: batch axes + tp head blocks) — O(pos)-bounded
+    cache reads on every chip; without a mesh, or when the batch does not
+    divide over the data axes, the sharded path keeps the plain einsum.
 
     Exactness contract: dense and dense-MoE configs reproduce ``forward()``
     logits position by position to numerical tolerance (the two paths use
@@ -795,7 +836,7 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     def body(carry, layer):
         lp, ck, cv = layer
         out, ck, cv = _block_decode(cfg, carry, lp, ck, cv, positions, pos,
-                                    sharded=sharded)
+                                    sharded=sharded, mesh=mesh)
         return out, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -931,6 +972,74 @@ def _scatter_rows(out, idx, vals, mode: Optional[str] = None):
     scatter winner, so masking via OOB indices is the safe form)."""
     return jax.vmap(lambda o, i, v: o.at[i].set(v, mode=mode))(
         out, idx, vals)
+
+
+def beam_search(cfg: TransformerConfig, params, prompt,
+                max_new_tokens: int, beam: int = 4,
+                quantized_cache: bool = False, return_scores: bool = False):
+    """Deterministic beam search: keep the ``beam`` highest-total-logprob
+    continuations, expanding all of them each step in one batched decode
+    (the cache carries B·W rows; parent rows are gathered when beams
+    reorder).  Returns the best sequence per row, [B, Tp + new] (with the
+    per-row best total logprob when ``return_scores``).
+
+    ``beam=1`` reduces to greedy decoding exactly.  Uniform prompts only
+    (compose with ragged serving by bucketing lengths).
+    """
+    b, tp = prompt.shape
+    w = int(beam)
+    if w < 1:
+        raise ValueError(f"beam must be >= 1, got {beam}")
+    if max_new_tokens <= 0:
+        return (prompt, jnp.zeros((b,), jnp.float32)) if return_scores \
+            else prompt
+    depth = tp + max_new_tokens
+    cache = init_cache(cfg, b, depth, quantized=quantized_cache)
+    logits, cache = decode_step(cfg, params, cache, prompt, 0)
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
+
+    # First expansion: top-W tokens of the prefill distribution seed the
+    # beams (no duplicate-beam trick needed — beams differ from step 0).
+    scores, tok = jax.lax.top_k(logp0, w)               # [B, W]
+    tok = tok.astype(jnp.int32)
+    # Tile the cache W times: rows grouped beam-major per batch row
+    # ([b0w0, b0w1, ..., b1w0, ...]) so row index = b*W + w.
+    cache = jax.tree_util.tree_map(lambda x: jnp.repeat(x, w, axis=1),
+                                   cache)
+    hist = jnp.zeros((b, w, max_new_tokens), jnp.int32)
+    hist = hist.at[:, :, 0].set(tok)
+
+    def step(carry, i):
+        cache, tok, scores, hist = carry
+        logits, cache = decode_step(cfg, params, cache,
+                                    tok.reshape(b * w, 1), tp + i)
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), -1)      # [B*W, V]
+        v = logp.shape[-1]
+        total = scores[:, :, None] + logp.reshape(b, w, v)
+        scores, flat = jax.lax.top_k(total.reshape(b, w * v), w)
+        parent = flat // v                              # [B, W]
+        tok = (flat % v).astype(jnp.int32)
+        # Reorder beam state to follow the surviving parents.
+        rows = (jnp.arange(b, dtype=jnp.int32)[:, None] * w
+                + parent).reshape(-1)                   # [B*W] global rows
+        cache = jax.tree_util.tree_map(
+            lambda c: jnp.take(c, rows, axis=1), cache)
+        hist = jnp.take_along_axis(hist, parent[:, :, None], axis=1)
+        hist = jax.lax.dynamic_update_index_in_dim(
+            hist, tok, i + 1, axis=2)
+        return (cache, tok, scores, hist), None
+
+    (cache, tok, scores, hist), _ = jax.lax.scan(
+        step, (cache, tok, scores, hist),
+        jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+    best = jnp.argmax(scores, axis=1)                   # [B]
+    best_hist = jnp.take_along_axis(
+        hist, best[:, None, None], axis=1)[:, 0]        # [B, new]
+    out = jnp.concatenate([prompt, best_hist], axis=1)
+    if return_scores:
+        return out, jnp.take_along_axis(scores, best[:, None], 1)[:, 0]
+    return out
 
 
 def speculative_generate(cfg: TransformerConfig, params,

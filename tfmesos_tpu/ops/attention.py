@@ -478,34 +478,48 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
 
 
 def _decode_reference(q, k_cache, v_cache, pos, scale):
-    """Dense masked attention of one query token over a KV cache (ground
+    """Dense masked attention of a query chunk over a KV cache (ground
     truth / non-TPU path for ``flash_decode``).  Grouped einsum: the cache
-    streams at kv width, q heads grouped kv-major as [kv, g]."""
-    b, h, d = q.shape
+    streams at kv width, q heads grouped kv-major as [kv, g].  ``q`` is
+    [B, H, D] (single token) or [B, t, H, D] (chunk; token tt sees
+    positions <= pos + tt)."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, t, h, d = q.shape
     kv = k_cache.shape[2]
     g = h // kv
     m = k_cache.shape[1]
-    q5 = q.reshape(b, kv, g, d)
-    s = jnp.einsum("bkgd,bmkd->bkgm", q5, k_cache).astype(jnp.float32)
+    q5 = q.reshape(b, t, kv, g, d)
+    s = jnp.einsum("btkgd,bmkd->bkgtm", q5, k_cache).astype(jnp.float32)
     s = s * scale
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
-    bad = jnp.arange(m, dtype=jnp.int32)[None] > pos[:, None]   # [b, m]
-    s = jnp.where(bad[:, None, None], NEG_INF, s)
+    kpos = jnp.arange(m, dtype=jnp.int32)
+    bad = (kpos[None, None] >
+           pos[:, None, None] + jnp.arange(t, dtype=jnp.int32)[None, :,
+                                                               None])
+    s = jnp.where(bad[:, None, None], NEG_INF, s)       # [b,kv,g,t,m]
     p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
-    o = jnp.einsum("bkgm,bmkd->bkgd", p, v_cache)
-    return o.reshape(b, h, d)
+    o = jnp.einsum("bkgtm,bmkd->btkgd", p, v_cache)
+    o = o.reshape(b, t, h, d)
+    return o[:, 0] if squeeze else o
 
 
 def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
-                         scale: float, quantized: bool):
-    """One (batch, kv-head, m-block) grid step of single-token decode.
+                         scale: float, quantized: bool, q_per_kv: int):
+    """One (batch, kv-head, m-block) grid step of cache-bounded decode.
 
-    ``s_ref`` holds the scalar-prefetched pair (n_live_blocks, pos).  Blocks
-    past the bound are skipped AND their index map pins to the last live
-    block, so Mosaic's unchanged-index elision never DMAs them — HBM
-    traffic is O(pos), not O(max_len).  Online softmax accumulates across
-    the m grid dim in VMEM scratch; the normalized output writes once on
-    the final step.
+    The q block carries this kv head's rows for the WHOLE chunk, t-major:
+    row r = chunk token (r // g), group member (r % g) — t = 1 in
+    steady-state decode, t > 1 for speculative verify / chunked prefill.
+    Chunk token tt sees cache positions <= pos_first + tt.
+
+    ``s_ref`` holds the scalar-prefetched per-row pairs (n_live_blocks,
+    first chunk position).  Blocks past the bound are skipped AND their
+    index map pins to the last live block, so Mosaic's unchanged-index
+    elision never DMAs them — HBM traffic is O(pos), not O(max_len).
+    Online softmax accumulates across the m grid dim in VMEM scratch; the
+    normalized output writes once on the final step.
 
     ``quantized``: K/V refs are int8 with per-position fp32 scale refs
     following them.  The scales fold into the score/probability rows
@@ -519,7 +533,7 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
     bi = pl.program_id(0)
     j = pl.program_id(2)
     nb = s_ref[0, bi]      # per-batch-row block bound (ragged serving)
-    pos = s_ref[1, bi]
+    pos = s_ref[1, bi]     # first chunk position for this row
 
     @pl.when(j == 0)
     def _init():
@@ -529,7 +543,7 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
 
     @pl.when(j < nb)
     def _step():
-        q = q_ref[0, 0, :, :]                       # [g, d]
+        q = q_ref[0, 0, :, :]                       # [t*g, d]
         k_blk = k_ref[0, 0, :, :]                   # [bm, d]
         v_blk = v_ref[0, 0, :, :]
         if quantized:
@@ -537,16 +551,19 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
             v_blk = v_blk.astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = s * scale                               # [g, bm]
+        s = s * scale                               # [t*g, bm]
         if quantized:
             s = s * ks_ref[0, 0, 0, :][None, :]     # per-position k scales
         kpos = j * block_m + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(kpos > pos, NEG_INF, s)
+        tt = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // q_per_kv
+        s = jnp.where(kpos > pos + tt, NEG_INF, s)
         m_prev, l_prev, o_prev = m_acc[...], l_acc[...], o_acc[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
+        # A chunk row's window may be empty in this block (its position
+        # is before the block): keep exp(-inf - -inf) out of the math.
+        p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
+        corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
         m_acc[...] = m_new
         l_acc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         if quantized:
@@ -557,7 +574,7 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
-        # Block 0 always holds position 0 <= pos, so l > 0.
+        # Block 0 holds position 0 <= pos + tt for every row, so l > 0.
         o_ref[0, 0, :, :] = (o_acc[...] / l_acc[...]).astype(o_ref.dtype)
 
 
@@ -566,14 +583,17 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
                  interpret: bool = False):
     """Single-token decode attention over a KV cache, bounded at ``pos``.
 
-    ``q``: [B, H, D] (the one new token's heads, kv-major groups);
-    ``k_cache``/``v_cache``: [B, M, KV, D] with positions [0..pos] written
-    — plain arrays, or int8 ``QTensor``s (per-position scales), in which
-    case HBM streams int8 and the scales fold into the score rows;
-    ``pos``: scalar int32, or a [B] vector for RAGGED batches (each row at
-    its own position — the mixed-length serving case); traced OK either
-    way (it rides the kernel's scalar prefetch, bounding each row's block
-    loop independently).  Returns [B, H, D].
+    ``q``: [B, H, D] (one new token's heads, kv-major groups) or
+    [B, t, H, D] (a CHUNK — speculative verify / chunked prefill; chunk
+    token tt attends cache positions <= pos + tt, the cache already
+    holding the chunk's own K/V);
+    ``k_cache``/``v_cache``: [B, M, KV, D] with the attended positions
+    written — plain arrays, or int8 ``QTensor``s (per-position scales),
+    in which case HBM streams int8 and the scales fold into the score
+    rows; ``pos``: scalar int32, or a [B] vector for RAGGED batches (each
+    row at its own position — the mixed-length serving case); traced OK
+    either way (it rides the kernel's scalar prefetch, bounding each
+    row's block loop independently).  Returns q's shape.
 
     The XLA einsum reads all M cache slots every step because ``pos`` is
     traced; this kernel's grid maps the out-of-range m-blocks to the last
@@ -587,9 +607,12 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     quantized = isinstance(k_cache, QTensor)
     kc = k_cache.values if quantized else k_cache
     vc = v_cache.values if quantized else v_cache
-    b, h, d = q.shape
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, t, h, d = q.shape
     m, kv = kc.shape[1], kc.shape[2]
-    _check_gqa_heads(q[:, None], kc, vc)  # heads to axis 2
+    _check_gqa_heads(q, kc, vc)  # heads at axis 2
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     g = h // kv
@@ -602,21 +625,27 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
         if quantized:
             k_cache = k_cache.dequantize(q.dtype)
             v_cache = v_cache.dequantize(q.dtype)
-        return _decode_reference(q, k_cache, v_cache, pos, scale)
+        out = _decode_reference(q, k_cache, v_cache, pos, scale)
+        return out[:, 0] if squeeze else out
 
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
-    scalars = jnp.stack([pos // block_m + 1, pos])      # [2, B]
+    # Bound from each row's LAST chunk position.
+    scalars = jnp.stack([(pos + t - 1) // block_m + 1, pos])    # [2, B]
     if not quantized and q.dtype != kc.dtype:
         # e.g. bf16 queries over a caller-widened fp32 cache: the kernel's
         # dots need one operand dtype (promote, matching the einsum path).
         q = q.astype(jnp.promote_types(q.dtype, kc.dtype))
         kc = kc.astype(q.dtype)
-    qt = q.reshape(b, kv, g, d)
+    # Rows t-major per kv head: row = tt*g + group member (the kernel's
+    # mask derives the token index as row // g).
+    qt = q.reshape(b, t, kv, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, kv, t * g, d)
     # [B, M, KV, D] -> [B, KV, M, D]: (seq, head_dim) trailing for tiling.
     kt = kc.transpose(0, 2, 1, 3)
     vt = vc.transpose(0, 2, 1, 3)
 
-    q_spec = pl.BlockSpec((1, 1, g, d), lambda bi, hi, j, s: (bi, hi, 0, 0),
+    q_spec = pl.BlockSpec((1, 1, t * g, d),
+                          lambda bi, hi, j, s: (bi, hi, 0, 0),
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec(
         (1, 1, block_m, d),
@@ -639,24 +668,56 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
         grid=(b, kv, m // block_m),
         in_specs=in_specs,
         out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((g, d), jnp.float32),
-                        pltpu.VMEM((g, 1), jnp.float32),
-                        pltpu.VMEM((g, 1), jnp.float32)])
+        scratch_shapes=[pltpu.VMEM((t * g, d), jnp.float32),
+                        pltpu.VMEM((t * g, 1), jnp.float32),
+                        pltpu.VMEM((t * g, 1), jnp.float32)])
     out = pl.pallas_call(
         functools.partial(_flash_decode_kernel, block_m=block_m,
-                          scale=float(scale), quantized=quantized),
+                          scale=float(scale), quantized=quantized,
+                          q_per_kv=g),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
-            flops=4 * b * h * m * d,
+            flops=4 * b * t * h * m * d,
             bytes_accessed=(kc.size * kc.dtype.itemsize * 2
                             + 2 * q.size * q.dtype.itemsize),
-            transcendentals=b * h * m),
+            transcendentals=b * t * h * m),
     )(scalars, *operands)
-    return out.reshape(b, h, d)
+    out = out.reshape(b, kv, t, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, t, h, d)
+    return out[:, 0] if squeeze else out
+
+
+def sharded_flash_decode(q, k_cache, v_cache, pos, mesh, **kw):
+    """``flash_decode`` under GSPMD decode: shard_map over the data axes
+    (batch) and tp (kv-major head blocks — the transformer
+    ``cache_specs`` layout), each device running the kernel on its local
+    [b_loc(, t), M, kv_loc, D] block.  Requires tp | kv_heads (the same
+    alignment condition as ``sharded_flash_attention``).  The output
+    stays head-sharded; the caller's output projection contracts it and
+    GSPMD inserts the tp psum exactly as on the einsum path.  ``k_cache``
+    / ``v_cache`` may be int8 ``QTensor``s (specs pair up per leaf);
+    ``q`` may be [B, H, D] or a chunk [B, t, H, D]."""
+    from jax.sharding import PartitionSpec as P
+
+    from tfmesos_tpu.ops.quant import QTensor
+    from tfmesos_tpu.parallel.sharding import data_axes
+
+    batch = data_axes(mesh)
+    heads = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    qspec = (P(batch, heads, None) if q.ndim == 3
+             else P(batch, None, heads, None))
+    cspec = P(batch, None, heads, None)
+    if isinstance(k_cache, QTensor):
+        cspec = QTensor(cspec, P(batch, None, heads, None))
+    fn = jax.shard_map(
+        lambda q_, k_, v_, p_: flash_decode(q_, k_, v_, p_, **kw),
+        mesh=mesh, in_specs=(qspec, cspec, cspec, P(batch)),
+        out_specs=qspec, check_vma=False)
+    return fn(q, k_cache, v_cache, pos)
 
 
 def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
