@@ -1369,3 +1369,31 @@ def test_beam_search_int8_cache_runs():
     o = np.asarray(out)
     assert o.shape == (2, 12)
     assert ((o >= 0) & (o < cfg.vocab_size)).all()
+
+
+def test_generate_shared_prefix_matches_concatenated():
+    """generate(prefix=...) — prefill the shared prefix once at batch 1,
+    broadcast its cache — must equal prepending the prefix to every row,
+    uniform and ragged alike."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    prefix = jax.random.randint(jax.random.PRNGKey(5), (6,),
+                                0, cfg.vocab_size)
+    prompts = jax.random.randint(jax.random.PRNGKey(1), (3, 5),
+                                 0, cfg.vocab_size)
+    full = jnp.concatenate([jnp.broadcast_to(prefix, (3, 6)), prompts],
+                           axis=1)
+    ref = transformer.generate(cfg, params, full, 8)
+    got = transformer.generate(cfg, params, prompts, 8, prefix=prefix)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    lens = jnp.array([2, 5, 3], jnp.int32)
+    ref_r = transformer.generate(cfg, params, full, 8,
+                                 prompt_lens=6 + lens)
+    got_r = transformer.generate(cfg, params, prompts, 8, prefix=prefix,
+                                 prompt_lens=lens)
+    for i, ln in enumerate([2, 5, 3]):
+        np.testing.assert_array_equal(np.asarray(got_r[i, :6 + ln + 8]),
+                                      np.asarray(ref_r[i, :6 + ln + 8]))

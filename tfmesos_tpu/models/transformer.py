@@ -903,7 +903,8 @@ def sample_logits(logits, key, temperature: float = 1.0,
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
-             quantized_cache: bool = False, prompt_lens=None):
+             quantized_cache: bool = False, prompt_lens=None,
+             prefix=None):
     """Autoregressive generation: prefill the prompt in one pass, then one
     fused scan step per token (KV cache; greedy, temperature, top-k and/or
     top-p nucleus sampling — see ``sample_logits``).
@@ -911,37 +912,51 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     ``quantized_cache`` stores K/V as int8 (``init_cache``) — combined
     with ``quantize_params`` this is the full int8 serving config.
 
-    ``prompt``: [B, Tp] int32.  Returns [B, Tp + max_new_tokens].
+    ``prompt``: [B, Tp] int32.  Returns [B, Tp + max_new_tokens]
+    (``[B, T0 + Tp + max_new_tokens]`` with a prefix).
 
     ``prompt_lens`` ([B] int32, optional) serves a RAGGED batch: row i's
     real prompt is ``prompt[i, :prompt_lens[i]]`` (right-padding ignored —
     causal attention plus per-row position bounds keep pad slots
     invisible, and each row's generated tokens overwrite them in the
-    cache).  Row i's continuation lands at ``[lens[i], lens[i] +
-    max_new_tokens)`` of the returned array; later entries are padding.
+    cache).  Row i's continuation lands right after its real prompt in
+    the returned array; later entries are padding.
+
+    ``prefix`` ([T0] int32, optional) is a SHARED prompt prefix (system
+    prompt): prefilled ONCE at batch 1 and its cache broadcast to every
+    row — the prompt-caching serving pattern.  Equivalent to prepending
+    it to every row of ``prompt``, at 1/B the prefix prefill cost.
     """
     b, tp = prompt.shape
     if max_new_tokens <= 0:
         return prompt
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    cache = init_cache(cfg, b, tp + max_new_tokens,
-                       quantized=quantized_cache)
+    t0 = 0 if prefix is None else prefix.shape[0]
+    cache = init_cache(cfg, 1 if prefix is not None else b,
+                       t0 + tp + max_new_tokens, quantized=quantized_cache)
 
     def sample(logits, key):
         return sample_logits(logits, key, temperature, top_k, top_p)
 
-    logits, cache = decode_step(cfg, params, cache, prompt, 0)
+    if prefix is not None:
+        _, cache = decode_step(cfg, params, cache, prefix[None, :], 0)
+        # The prefix K/V is position-exact for every row: broadcast it.
+        cache = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, b, axis=1), cache)
+        logits, cache = decode_step(cfg, params, cache, prompt, t0)
+    else:
+        logits, cache = decode_step(cfg, params, cache, prompt, 0)
     rng, key = jax.random.split(rng)
     if prompt_lens is None:
         next_logits = logits[:, -1]
-        pos0 = jnp.asarray(tp, jnp.int32)
+        pos0 = jnp.asarray(t0 + tp, jnp.int32)
     else:
         lens = jnp.asarray(prompt_lens, jnp.int32)
         # Row i's next token follows its LAST REAL token, not the padding.
         next_logits = jnp.take_along_axis(
             logits, (lens - 1)[:, None, None], axis=1)[:, 0]
-        pos0 = lens
+        pos0 = t0 + lens
     tok = sample(next_logits, key)
 
     def body(carry, _):
@@ -956,12 +971,15 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         length=max_new_tokens - 1)
     generated = jnp.concatenate(
         [jnp.moveaxis(toks, 0, 1), tok[:, None]], axis=1)
+    lead = (jnp.broadcast_to(prefix, (b, t0)),) if prefix is not None else ()
     if prompt_lens is None:
-        return jnp.concatenate([prompt, generated], axis=1)
+        return jnp.concatenate([*lead, prompt, generated], axis=1)
     # Scatter each row's continuation right after its real prompt.
     out = jnp.concatenate(
-        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
-    idx = lens[:, None] + jnp.arange(max_new_tokens, dtype=jnp.int32)[None]
+        [*lead, prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)],
+        axis=1)
+    idx = (t0 + lens)[:, None] + jnp.arange(max_new_tokens,
+                                            dtype=jnp.int32)[None]
     return _scatter_rows(out, idx, generated)
 
 
