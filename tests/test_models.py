@@ -1397,3 +1397,51 @@ def test_generate_shared_prefix_matches_concatenated():
     for i, ln in enumerate([2, 5, 3]):
         np.testing.assert_array_equal(np.asarray(got_r[i, :6 + ln + 8]),
                                       np.asarray(ref_r[i, :6 + ln + 8]))
+
+
+def test_speculative_int8_cache_exactness():
+    """Speculative with an int8 TARGET cache equals int8-cache greedy
+    generate bitwise (committed positions quantize identically)."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = transformer.init_params(SPEC_DRAFT, jax.random.PRNGKey(7))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                              cfg.vocab_size)
+    ref = transformer.generate(cfg, params, toks, 10, quantized_cache=True)
+    spec = transformer.speculative_generate(
+        cfg, params, SPEC_DRAFT, dparams, toks, 10, n_draft=3,
+        quantized_cache=True)
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(ref))
+
+
+def test_generate_stop_token():
+    """stop_token freezes rows at their first stop emission (tail filled
+    with the stop token, early exit when all rows stop); tokens before
+    the stop are identical to a run without it."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (3, 7), 0,
+                              cfg.vocab_size)
+    plain = np.asarray(transformer.generate(cfg, params, toks, 12))
+    gen_part = plain[:, 7:]
+    absent = next(v for v in range(64)
+                  if v not in set(gen_part.ravel().tolist()))
+    same = np.asarray(transformer.generate(cfg, params, toks, 12,
+                                           stop_token=absent))
+    np.testing.assert_array_equal(same, plain)
+
+    stop = int(gen_part[0, 4])
+    out = np.asarray(transformer.generate(cfg, params, toks, 12,
+                                          stop_token=stop))
+    for i in range(3):
+        row = out[i, 7:]
+        hits = np.where(gen_part[i] == stop)[0]
+        cut = hits[0] if len(hits) else 11
+        np.testing.assert_array_equal(row[:cut + 1],
+                                      gen_part[i][:cut + 1])
+        if len(hits):
+            assert (row[cut:] == stop).all()

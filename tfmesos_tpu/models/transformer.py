@@ -904,7 +904,7 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              quantized_cache: bool = False, prompt_lens=None,
-             prefix=None):
+             prefix=None, stop_token: Optional[int] = None):
     """Autoregressive generation: prefill the prompt in one pass, then one
     fused scan step per token (KV cache; greedy, temperature, top-k and/or
     top-p nucleus sampling — see ``sample_logits``).
@@ -926,6 +926,11 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     prompt): prefilled ONCE at batch 1 and its cache broadcast to every
     row — the prompt-caching serving pattern.  Equivalent to prepending
     it to every row of ``prompt``, at 1/B the prefix prefill cost.
+
+    ``stop_token``: rows that emit it freeze (their tail fills with the
+    stop token), and decoding EXITS EARLY once every row has stopped —
+    tokens up to each row's first stop are identical to a run without
+    ``stop_token``.
     """
     b, tp = prompt.shape
     if max_new_tokens <= 0:
@@ -959,18 +964,47 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         pos0 = t0 + lens
     tok = sample(next_logits, key)
 
-    def body(carry, _):
-        cache, tok, pos, rng = carry
-        logits, cache = decode_step(cfg, params, cache, tok[:, None], pos)
-        rng, key = jax.random.split(rng)
-        nxt = sample(logits[:, -1], key)
-        return (cache, nxt, pos + 1, rng), tok
+    if stop_token is None:
+        def body(carry, _):
+            cache, tok, pos, rng = carry
+            logits, cache = decode_step(cfg, params, cache, tok[:, None],
+                                        pos)
+            rng, key = jax.random.split(rng)
+            nxt = sample(logits[:, -1], key)
+            return (cache, nxt, pos + 1, rng), tok
 
-    (cache, tok, _, _), toks = jax.lax.scan(
-        body, (cache, tok, pos0, rng), None,
-        length=max_new_tokens - 1)
-    generated = jnp.concatenate(
-        [jnp.moveaxis(toks, 0, 1), tok[:, None]], axis=1)
+        (cache, tok, _, _), toks = jax.lax.scan(
+            body, (cache, tok, pos0, rng), None,
+            length=max_new_tokens - 1)
+        generated = jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1), tok[:, None]], axis=1)
+    else:
+        # while_loop instead of scan: exit as soon as every row stopped
+        # (short answers don't pay for max_new_tokens steps).  Frozen
+        # rows keep emitting the stop token.
+        stop = jnp.asarray(stop_token, jnp.int32)
+        gen0 = jnp.full((b, max_new_tokens), stop, jnp.int32)
+        gen0 = jax.lax.dynamic_update_slice(gen0, tok[:, None], (0, 0))
+        done0 = tok == stop
+
+        def cond(state):
+            i = state[4]
+            return (i < max_new_tokens - 1) & ~jnp.all(state[5])
+
+        def wbody(state):
+            cache, tok, pos, rng, i, done, gen = state
+            logits, cache = decode_step(cfg, params, cache, tok[:, None],
+                                        pos)
+            rng, key = jax.random.split(rng)
+            nxt = jnp.where(done, stop, sample(logits[:, -1], key))
+            gen = jax.lax.dynamic_update_slice(gen, nxt[:, None], (0, i + 1))
+            return (cache, nxt, pos + 1, rng, i + 1, done | (nxt == stop),
+                    gen)
+
+        state = (cache, tok, pos0, rng, jnp.asarray(0, jnp.int32), done0,
+                 gen0)
+        state = jax.lax.while_loop(cond, wbody, state)
+        generated = state[6]
     lead = (jnp.broadcast_to(prefix, (b, t0)),) if prefix is not None else ()
     if prompt_lens is None:
         return jnp.concatenate([*lead, prompt, generated], axis=1)
@@ -1065,7 +1099,8 @@ def speculative_generate(cfg: TransformerConfig, params,
                          prompt, max_new_tokens: int, n_draft: int = 4,
                          prompt_lens=None, temperature: float = 0.0,
                          top_k: Optional[int] = None,
-                         top_p: Optional[float] = None, rng=None):
+                         top_p: Optional[float] = None, rng=None,
+                         quantized_cache: bool = False):
     """Speculative decoding: a cheap DRAFT model proposes ``n_draft``
     tokens per round, the target model scores them all in ONE chunked
     decode, and the leading accepted run commits (plus one
@@ -1106,7 +1141,9 @@ def speculative_generate(cfg: TransformerConfig, params,
     # lens + max_new + k - 1) and, frozen, keeps verifying k+1-token
     # chunks at that position — writes reach lens + max_new + 2k.
     depth = tp + max_new_tokens + 2 * k + 1
-    cache = init_cache(cfg, b, depth)
+    # ``quantized_cache`` applies to the TARGET cache (where the bytes
+    # are); the draft is small by construction and stays fp.
+    cache = init_cache(cfg, b, depth, quantized=quantized_cache)
     draft_cache = init_cache(draft_cfg, b, depth)
 
     logits, cache = decode_step(cfg, params, cache, prompt, 0)
