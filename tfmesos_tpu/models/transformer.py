@@ -964,13 +964,15 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         pos0 = t0 + lens
     tok = sample(next_logits, key)
 
+    def step_once(cache, tok, pos, rng):
+        logits, cache = decode_step(cfg, params, cache, tok[:, None], pos)
+        rng, key = jax.random.split(rng)
+        return cache, sample(logits[:, -1], key), rng
+
     if stop_token is None:
         def body(carry, _):
             cache, tok, pos, rng = carry
-            logits, cache = decode_step(cfg, params, cache, tok[:, None],
-                                        pos)
-            rng, key = jax.random.split(rng)
-            nxt = sample(logits[:, -1], key)
+            cache, nxt, rng = step_once(cache, tok, pos, rng)
             return (cache, nxt, pos + 1, rng), tok
 
         (cache, tok, _, _), toks = jax.lax.scan(
@@ -980,8 +982,12 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
             [jnp.moveaxis(toks, 0, 1), tok[:, None]], axis=1)
     else:
         # while_loop instead of scan: exit as soon as every row stopped
-        # (short answers don't pay for max_new_tokens steps).  Frozen
-        # rows keep emitting the stop token.
+        # (short answers don't pay for max_new_tokens steps).  The REAL
+        # sampled token keeps feeding the model — only the recorded
+        # output freezes — so cache/RNG state stays bit-identical to a
+        # stop-free run and the before-the-stop equality guarantee is
+        # unconditional (frozen rows feeding synthetic stop tokens could
+        # otherwise perturb batch statistics, e.g. capacity-MoE routing).
         stop = jnp.asarray(stop_token, jnp.int32)
         gen0 = jnp.full((b, max_new_tokens), stop, jnp.int32)
         gen0 = jax.lax.dynamic_update_slice(gen0, tok[:, None], (0, 0))
@@ -993,11 +999,9 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
 
         def wbody(state):
             cache, tok, pos, rng, i, done, gen = state
-            logits, cache = decode_step(cfg, params, cache, tok[:, None],
-                                        pos)
-            rng, key = jax.random.split(rng)
-            nxt = jnp.where(done, stop, sample(logits[:, -1], key))
-            gen = jax.lax.dynamic_update_slice(gen, nxt[:, None], (0, i + 1))
+            cache, nxt, rng = step_once(cache, tok, pos, rng)
+            rec = jnp.where(done, stop, nxt)
+            gen = jax.lax.dynamic_update_slice(gen, rec[:, None], (0, i + 1))
             return (cache, nxt, pos + 1, rng, i + 1, done | (nxt == stop),
                     gen)
 
