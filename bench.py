@@ -621,6 +621,11 @@ def main():
     dec = attempts(bench_decode, "decode bench", n=1)
     if dec:
         out["decode_tokens_per_sec"] = round(max(dec), 1)
+    lat = attempts(lambda: bench_decode(batch=1), "decode latency bench",
+                   n=1)
+    if lat:
+        # Single-stream serving latency: ms per generated token at B=1.
+        out["decode_latency_ms_per_token"] = round(1000.0 / max(lat), 3)
     dec8 = attempts(lambda: bench_decode(quantized=True),
                     "int8 decode bench", n=1)
     if dec8:

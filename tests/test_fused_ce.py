@@ -239,3 +239,25 @@ def test_fused_ce_on_dp_mesh_matches_single_device():
             jax.tree_util.tree_flatten_with_path(g_ref)[0]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=1e-5, err_msg=str(pa))
+
+
+def test_lm_z_loss_consistent_across_paths():
+    """cfg.z_loss (LM-head logit stabilizer) must produce the same loss on
+    the unfused, fused-dense, dp-sharded, and tp vocab-parallel routes,
+    and actually move the objective."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, z_loss=1e-3)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                TINY.vocab_size)
+    batch = {"tokens": tokens}
+    base = float(transformer.loss_fn(
+        dataclasses.replace(cfg, fused_ce=False), params, batch)[0])
+    for mesh in (None, build_mesh({"dp": 8}), build_mesh({"dp": 4, "tp": 2})):
+        got = float(jax.jit(lambda p, b, m=mesh: transformer.loss_fn(
+            cfg, p, b, m)[0])(params, batch))
+        np.testing.assert_allclose(got, base, rtol=1e-5)
+    plain = float(transformer.loss_fn(
+        dataclasses.replace(cfg, z_loss=0.0), params, batch)[0])
+    assert base > plain

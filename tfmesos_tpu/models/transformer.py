@@ -112,6 +112,11 @@ class TransformerConfig:
     # GSPMD to partition the chunks); False disables fusion everywhere.
     fused_ce: Optional[bool] = None
     ce_chunk: int = 2048
+    # LM-head z-loss (PaLM-style logit-drift stabilizer): adds
+    # z_loss · mean(logsumexp(logits)²) to the objective.  All four CE
+    # paths (unfused, fused-dense, dp-sharded, tp vocab-parallel)
+    # implement it identically.
+    z_loss: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -1321,17 +1326,21 @@ def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
         # accumulate dw in fp32 and return it at the param dtype.
         if mode == "tp":
             loss = vocab_parallel_cross_entropy(
-                x, params["head"], tokens[:, 1:], mesh, chunk=cfg.ce_chunk)
+                x, params["head"], tokens[:, 1:], mesh,
+                z_loss=cfg.z_loss, chunk=cfg.ce_chunk)
         elif mode == "dp":
             loss = data_parallel_fused_cross_entropy(
-                x, params["head"], tokens[:, 1:], mesh, chunk=cfg.ce_chunk)
+                x, params["head"], tokens[:, 1:], mesh,
+                cfg.z_loss, cfg.ce_chunk)
         else:
             loss = fused_linear_cross_entropy(
-                x, params["head"], tokens[:, 1:], chunk=cfg.ce_chunk)
+                x, params["head"], tokens[:, 1:], z_loss=cfg.z_loss,
+                chunk=cfg.ce_chunk)
     else:
         logits, aux = forward(cfg, params, tokens[:, :-1], mesh,
                               return_aux=True)
-        loss = cross_entropy_loss(logits, tokens[:, 1:])
+        loss = cross_entropy_loss(logits, tokens[:, 1:],
+                                  z_loss=cfg.z_loss)
     metrics = {"perplexity": jnp.exp(loss)}
     if cfg.n_experts:
         # Under pp the aux rides the pipeline per microbatch (gpipe-style
