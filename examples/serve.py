@@ -1,0 +1,117 @@
+"""Offline batch serving on the flagship transformer.
+
+The reference stops at training jobs; this is the inference-side workload
+shape: read prompts (JSONL, one ``{"tokens": [...]}`` per line), serve
+them in ragged mixed-length batches (right-padded per batch, per-row
+positions — docs/SERVING.md), and write continuations back as JSONL.
+Runs standalone or as a Mode-B task under the scheduler:
+
+    tfrun -w 1 -s 0 -- python examples/serve.py --tiny --out /tmp/out.jsonl
+
+Without ``--input``, a seeded synthetic workload (mixed prompt lengths)
+stands in — this container has no egress, and untrained weights produce
+token soup anyway; the point is the serving mechanics and throughput.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", type=str, default=None,
+                   help="JSONL of {\"tokens\": [...]} prompts; synthetic "
+                        "when absent")
+    p.add_argument("--out", type=str, default=None,
+                   help="output JSONL path (default stdout)")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--n-prompts", type=int, default=24, dest="n_prompts",
+                   help="synthetic workload size (ignored with --input)")
+    p.add_argument("--new-tokens", type=int, default=32, dest="new_tokens")
+    p.add_argument("--stop-token", type=int, default=None, dest="stop_token")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--int8", action="store_true")
+    p.add_argument("--int8-kv", action="store_true", dest="int8_kv")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tiny", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tfmesos_tpu import runtime
+    from tfmesos_tpu.models import transformer
+
+    runtime.initialize()
+    if args.tiny:
+        cfg = transformer.TransformerConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq_len=256, dtype=jnp.float32)
+    else:
+        cfg = transformer.TransformerConfig(
+            vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+            max_seq_len=4096, dtype=jnp.bfloat16)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(args.seed))
+    if args.int8:
+        params = jax.jit(
+            lambda p_: transformer.quantize_params(cfg, p_))(params)
+
+    if args.input:
+        with open(args.input) as f:
+            prompts = [json.loads(line)["tokens"] for line in f if line.strip()]
+    else:
+        rng = np.random.RandomState(args.seed)
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               size=rng.randint(4, 33)).tolist()
+                   for _ in range(args.n_prompts)]
+    if not prompts:
+        print("serve: empty workload", file=sys.stderr)
+        return 1
+    limit = cfg.max_seq_len - args.new_tokens
+    if any(len(t) > limit for t in prompts):
+        print(f"serve: a prompt exceeds max_seq_len - new_tokens "
+              f"({limit})", file=sys.stderr)
+        return 1
+
+    # One jitted servant per (padded_len) bucket: pad each batch to its
+    # longest prompt rounded up to a multiple of 8, so a handful of
+    # compiled shapes serves the whole stream.
+    @jax.jit
+    def run(params, batch, lens):
+        return transformer.generate(
+            cfg, params, batch, args.new_tokens, prompt_lens=lens,
+            rng=jax.random.PRNGKey(args.seed + 1),
+            temperature=args.temperature, quantized_cache=args.int8_kv,
+            stop_token=args.stop_token)
+
+    sink = open(args.out, "w") if args.out else sys.stdout
+    served = 0
+    t0 = time.perf_counter()
+    for lo in range(0, len(prompts), args.batch):
+        chunk = prompts[lo:lo + args.batch]
+        lens = np.array([len(t) for t in chunk], np.int32)
+        width = int(-(-max(lens) // 8) * 8)
+        padded = np.zeros((len(chunk), width), np.int32)
+        for i, t in enumerate(chunk):
+            padded[i, :len(t)] = t
+        out = np.asarray(run(params, jnp.asarray(padded),
+                             jnp.asarray(lens)))
+        for i, t in enumerate(chunk):
+            row = out[i, lens[i]:lens[i] + args.new_tokens].tolist()
+            if args.stop_token is not None and args.stop_token in row:
+                row = row[:row.index(args.stop_token) + 1]
+            sink.write(json.dumps({"prompt_len": int(lens[i]),
+                                   "tokens": row}) + "\n")
+        served += len(chunk)
+    dt = time.perf_counter() - t0
+    if sink is not sys.stdout:
+        sink.close()
+    print(f"served {served} prompts ({served * args.new_tokens} tokens) "
+          f"in {dt:.2f}s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

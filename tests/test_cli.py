@@ -1,4 +1,5 @@
 import json
+import os
 
 import pytest
 
@@ -100,3 +101,28 @@ def test_tfrun_runs_transformer_trainer_on_mesh(capfd):
     out = capfd.readouterr().out
     assert "Training elapsed time" in out
     assert "tokens/sec" in out
+
+
+def test_serve_example_end_to_end(tmp_path):
+    """examples/serve.py: ragged JSONL workload in, one continuation per
+    prompt out, stop-token truncation applied."""
+    import json
+    import subprocess
+    import sys
+
+    inp = tmp_path / "prompts.jsonl"
+    rows = [{"tokens": [1, 2, 3]}, {"tokens": list(range(10))},
+            {"tokens": [7] * 5}]
+    inp.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    out = tmp_path / "served.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "examples/serve.py", "--tiny", "--batch", "2",
+         "--new-tokens", "4", "--input", str(inp), "--out", str(out)],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr.decode()
+    served = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(served) == 3
+    assert [r["prompt_len"] for r in served] == [3, 10, 5]
+    assert all(len(r["tokens"]) == 4 for r in served)
