@@ -1445,3 +1445,32 @@ def test_generate_stop_token():
                                       gen_part[i][:cut + 1])
         if len(hits):
             assert (row[cut:] == stop).all()
+
+
+def test_speculative_with_shared_prefix():
+    """prefix + speculative compose: bitwise the target's greedy
+    continuation of prefix+prompt, uniform and ragged."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = transformer.init_params(SPEC_DRAFT, jax.random.PRNGKey(7))
+    prefix = jax.random.randint(jax.random.PRNGKey(5), (6,),
+                                0, cfg.vocab_size)
+    prompts = jax.random.randint(jax.random.PRNGKey(1), (3, 5),
+                                 0, cfg.vocab_size)
+    full = jnp.concatenate([jnp.broadcast_to(prefix, (3, 6)), prompts],
+                           axis=1)
+    ref = transformer.generate(cfg, params, full, 8)
+    got = transformer.speculative_generate(
+        cfg, params, SPEC_DRAFT, dparams, prompts, 8, n_draft=3,
+        prefix=prefix)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    lens = jnp.array([2, 5, 3], jnp.int32)
+    ref_r = transformer.generate(cfg, params, full, 8, prompt_lens=6 + lens)
+    got_r = transformer.speculative_generate(
+        cfg, params, SPEC_DRAFT, dparams, prompts, 8, n_draft=3,
+        prefix=prefix, prompt_lens=lens)
+    for i, ln in enumerate([2, 5, 3]):
+        np.testing.assert_array_equal(np.asarray(got_r[i, :6 + ln + 8]),
+                                      np.asarray(ref_r[i, :6 + ln + 8]))

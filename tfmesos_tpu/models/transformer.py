@@ -1109,7 +1109,7 @@ def speculative_generate(cfg: TransformerConfig, params,
                          prompt_lens=None, temperature: float = 0.0,
                          top_k: Optional[int] = None,
                          top_p: Optional[float] = None, rng=None,
-                         quantized_cache: bool = False):
+                         quantized_cache: bool = False, prefix=None):
     """Speculative decoding: a cheap DRAFT model proposes ``n_draft``
     tokens per round, the target model scores them all in ONE chunked
     decode, and the leading accepted run commits (plus one
@@ -1129,9 +1129,10 @@ def speculative_generate(cfg: TransformerConfig, params,
 
     Both models run on the ragged per-row position machinery, so each
     batch row accepts at its own rate.  ``prompt``: [B, Tp];
-    ``prompt_lens`` as in :func:`generate`.  Returns
-    [B, Tp + max_new_tokens] with row i's continuation at
-    ``[lens[i], lens[i] + max_new_tokens)``.
+    ``prompt_lens`` and ``prefix`` as in :func:`generate` (a shared
+    prefix prefills ONCE per model at batch 1 and broadcasts into both
+    caches).  Returns [B, (T0 +) Tp + max_new_tokens] with row i's
+    continuation right after its real prompt.
     """
     if cfg.window is not None or draft_cfg.window is not None:
         raise ValueError("speculative decoding does not compose with "
@@ -1146,18 +1147,31 @@ def speculative_generate(cfg: TransformerConfig, params,
     sampling = temperature > 0.0
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    t0 = 0 if prefix is None else prefix.shape[0]
     # Slack: a row can overshoot to committed = max_new + k (pos =
     # lens + max_new + k - 1) and, frozen, keeps verifying k+1-token
     # chunks at that position — writes reach lens + max_new + 2k.
-    depth = tp + max_new_tokens + 2 * k + 1
+    depth = t0 + tp + max_new_tokens + 2 * k + 1
     # ``quantized_cache`` applies to the TARGET cache (where the bytes
     # are); the draft is small by construction and stays fp.
-    cache = init_cache(cfg, b, depth, quantized=quantized_cache)
-    draft_cache = init_cache(draft_cfg, b, depth)
+    cb = 1 if prefix is not None else b
+    cache = init_cache(cfg, cb, depth, quantized=quantized_cache)
+    draft_cache = init_cache(draft_cfg, cb, depth)
 
-    logits, cache = decode_step(cfg, params, cache, prompt, 0)
-    _, draft_cache = decode_step(draft_cfg, draft_params, draft_cache,
-                                 prompt, 0)  # fills the draft's cache
+    if prefix is not None:
+        _, cache = decode_step(cfg, params, cache, prefix[None, :], 0)
+        _, draft_cache = decode_step(draft_cfg, draft_params, draft_cache,
+                                     prefix[None, :], 0)
+        bcast = lambda c: jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, b, axis=1), c)
+        cache, draft_cache = bcast(cache), bcast(draft_cache)
+        logits, cache = decode_step(cfg, params, cache, prompt, t0)
+        _, draft_cache = decode_step(draft_cfg, draft_params, draft_cache,
+                                     prompt, t0)
+    else:
+        logits, cache = decode_step(cfg, params, cache, prompt, 0)
+        _, draft_cache = decode_step(draft_cfg, draft_params, draft_cache,
+                                     prompt, 0)  # fills the draft's cache
     if prompt_lens is None:
         lens = jnp.full((b,), tp, jnp.int32)
     else:
@@ -1166,9 +1180,12 @@ def speculative_generate(cfg: TransformerConfig, params,
         logits, (lens - 1)[:, None, None], axis=1)[:, 0]
     rng, key0 = jax.random.split(rng)
     tok = sample_logits(first_logits, key0, temperature, top_k, top_p)
+    lens = t0 + lens                    # absolute positions from here on
     # One committed token exists already (the prefill's sample).
+    lead = (jnp.broadcast_to(prefix, (b, t0)),) if prefix is not None else ()
     out = jnp.concatenate(
-        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
+        [*lead, prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)],
+        axis=1)
     out = _scatter_rows(out, lens, tok)
     limit = lens + max_new_tokens       # first out index past row's region
 
