@@ -943,20 +943,12 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     if rng is None:
         rng = jax.random.PRNGKey(0)
     t0 = 0 if prefix is None else prefix.shape[0]
-    cache = init_cache(cfg, 1 if prefix is not None else b,
-                       t0 + tp + max_new_tokens, quantized=quantized_cache)
 
     def sample(logits, key):
         return sample_logits(logits, key, temperature, top_k, top_p)
 
-    if prefix is not None:
-        _, cache = decode_step(cfg, params, cache, prefix[None, :], 0)
-        # The prefix K/V is position-exact for every row: broadcast it.
-        cache = jax.tree_util.tree_map(
-            lambda x: jnp.repeat(x, b, axis=1), cache)
-        logits, cache = decode_step(cfg, params, cache, prompt, t0)
-    else:
-        logits, cache = decode_step(cfg, params, cache, prompt, 0)
+    logits, cache = _prefill(cfg, params, prompt, t0 + tp + max_new_tokens,
+                             quantized=quantized_cache, prefix=prefix)
     rng, key = jax.random.split(rng)
     if prompt_lens is None:
         next_logits = logits[:, -1]
@@ -1024,6 +1016,23 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     idx = (t0 + lens)[:, None] + jnp.arange(max_new_tokens,
                                             dtype=jnp.int32)[None]
     return _scatter_rows(out, idx, generated)
+
+
+def _prefill(cfg: TransformerConfig, params, prompt, depth: int,
+             quantized: bool = False, prefix=None):
+    """Fresh-cache prefill shared by the generation entry points: with a
+    ``prefix``, prefill it ONCE at batch 1, broadcast the cache to the
+    prompt's batch (the cache batch axis is 1), then prefill the per-row
+    prompt chunk at position t0.  Returns (prompt-chunk logits, cache)."""
+    b = prompt.shape[0]
+    cache = init_cache(cfg, 1 if prefix is not None else b, depth,
+                      quantized=quantized)
+    if prefix is None:
+        return decode_step(cfg, params, cache, prompt, 0)
+    _, cache = decode_step(cfg, params, cache, prefix[None, :], 0)
+    cache = jax.tree_util.tree_map(lambda x: jnp.repeat(x, b, axis=1),
+                                   cache)
+    return decode_step(cfg, params, cache, prompt, prefix.shape[0])
 
 
 def _scatter_rows(out, idx, vals, mode: Optional[str] = None):
@@ -1154,24 +1163,10 @@ def speculative_generate(cfg: TransformerConfig, params,
     depth = t0 + tp + max_new_tokens + 2 * k + 1
     # ``quantized_cache`` applies to the TARGET cache (where the bytes
     # are); the draft is small by construction and stays fp.
-    cb = 1 if prefix is not None else b
-    cache = init_cache(cfg, cb, depth, quantized=quantized_cache)
-    draft_cache = init_cache(draft_cfg, cb, depth)
-
-    if prefix is not None:
-        _, cache = decode_step(cfg, params, cache, prefix[None, :], 0)
-        _, draft_cache = decode_step(draft_cfg, draft_params, draft_cache,
-                                     prefix[None, :], 0)
-        bcast = lambda c: jax.tree_util.tree_map(
-            lambda x: jnp.repeat(x, b, axis=1), c)
-        cache, draft_cache = bcast(cache), bcast(draft_cache)
-        logits, cache = decode_step(cfg, params, cache, prompt, t0)
-        _, draft_cache = decode_step(draft_cfg, draft_params, draft_cache,
-                                     prompt, t0)
-    else:
-        logits, cache = decode_step(cfg, params, cache, prompt, 0)
-        _, draft_cache = decode_step(draft_cfg, draft_params, draft_cache,
-                                     prompt, 0)  # fills the draft's cache
+    logits, cache = _prefill(cfg, params, prompt, depth,
+                             quantized=quantized_cache, prefix=prefix)
+    _, draft_cache = _prefill(draft_cfg, draft_params, prompt, depth,
+                              prefix=prefix)
     if prompt_lens is None:
         lens = jnp.full((b,), tp, jnp.int32)
     else:
