@@ -485,3 +485,43 @@ def test_decode_step_chunk_kernel_path_matches_dense():
             transformer._decode_kernel_kwargs = orig
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_flash_decode_paged_scrambled_pool():
+    """Page-table indirection: the paged kernel over a scrambled pool
+    equals the contiguous-cache reference, scalar and ragged positions,
+    single tokens and chunks."""
+    from tfmesos_tpu.ops.attention import _decode_reference, flash_decode_paged
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    b, h, kv, d, ps, npg = 3, 4, 2, 32, 128, 8
+    m = ps * npg
+    q = jax.random.normal(ks[0], (b, h, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, m, kv, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, m, kv, d), jnp.float32)
+    pool_n = b * npg + 5
+    perm = np.random.RandomState(0).permutation(pool_n)[:b * npg].reshape(
+        b, npg)
+    # Pool layout is [P, KV, page, D] (page/head_dim trailing).
+    k_pool = np.zeros((pool_n, kv, ps, d), np.float32)
+    v_pool = np.zeros((pool_n, kv, ps, d), np.float32)
+    for i in range(b):
+        for j in range(npg):
+            k_pool[perm[i, j]] = np.asarray(
+                kc[i, j * ps:(j + 1) * ps]).transpose(1, 0, 2)
+            v_pool[perm[i, j]] = np.asarray(
+                vc[i, j * ps:(j + 1) * ps]).transpose(1, 0, 2)
+    pt = jnp.asarray(perm, jnp.int32)
+    for pos in (0, 200, jnp.array([5, 700, 1023], jnp.int32)):
+        ref = _decode_reference(q, kc, vc, pos, d ** -0.5)
+        got = flash_decode_paged(q, jnp.asarray(k_pool),
+                                 jnp.asarray(v_pool), pt, pos,
+                                 use_pallas=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    qc = jax.random.normal(ks[0], (b, 4, h, d), jnp.float32)
+    ref = _decode_reference(qc, kc, vc, 300, d ** -0.5)
+    got = flash_decode_paged(qc, jnp.asarray(k_pool), jnp.asarray(v_pool),
+                             pt, 300, use_pallas=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

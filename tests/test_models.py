@@ -1474,3 +1474,66 @@ def test_speculative_with_shared_prefix():
     for i, ln in enumerate([2, 5, 3]):
         np.testing.assert_array_equal(np.asarray(got_r[i, :6 + ln + 8]),
                                       np.asarray(ref_r[i, :6 + ln + 8]))
+
+
+def test_paged_decode_matches_contiguous():
+    """Paged KV cache (pool + page-table indirection, PagedAttention
+    layout): with SCRAMBLED page assignments and ragged positions,
+    decode_step must match the contiguous cache bit-for-tolerance on
+    both the gather reference and the forced kernel path."""
+    import random as pyrandom
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=256, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    lens = [5, 9, 3]
+    b = len(lens)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (b, 12), 0,
+                              cfg.vocab_size)
+    cache = transformer.init_cache(cfg, b, 64)
+    _, cache = transformer.decode_step(cfg, params, cache, toks[:, :9], 0)
+    lens_a = jnp.asarray(lens, jnp.int32)
+    nxt = jnp.take_along_axis(toks, lens_a[:, None], axis=1)
+    lg_ref, cache = transformer.decode_step(cfg, params, cache, nxt, lens_a)
+    nxt2 = jnp.argmax(lg_ref[:, -1:], -1).astype(jnp.int32)
+    lg_ref2, _ = transformer.decode_step(cfg, params, cache, nxt2,
+                                         lens_a + 1)
+
+    alloc = transformer.PageAllocator(n_pages=32, page_size=8)
+    pyrandom.Random(3).shuffle(alloc.free)
+    for i in range(b):
+        alloc.ensure(i, 13)
+    pcache = transformer.init_paged_cache(cfg, 32, page_size=8)
+    pcache["pages"] = alloc.table(range(b))
+    _, pcache = transformer.decode_step(cfg, params, pcache, toks[:, :9], 0)
+    lg_p, pcache = transformer.decode_step(cfg, params, pcache, nxt, lens_a)
+    np.testing.assert_allclose(np.asarray(lg_p), np.asarray(lg_ref),
+                               rtol=2e-4, atol=2e-4)
+
+    orig = transformer._decode_kernel_kwargs
+    transformer._decode_kernel_kwargs = (
+        lambda *a, **k: {"use_pallas": True, "interpret": True})
+    try:
+        lg_k, _ = transformer.decode_step(cfg, params, pcache, nxt2,
+                                          lens_a + 1)
+    finally:
+        transformer._decode_kernel_kwargs = orig
+    np.testing.assert_allclose(np.asarray(lg_k), np.asarray(lg_ref2),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_page_allocator_lifecycle():
+    alloc = transformer.PageAllocator(n_pages=4, page_size=8)
+    alloc.ensure(0, 17)             # 3 pages
+    alloc.ensure(1, 8)              # 1 page
+    assert len(alloc.free) == 0
+    with pytest.raises(RuntimeError, match="exhausted"):
+        alloc.ensure(1, 9)
+    t = np.asarray(alloc.table([0, 1]))
+    assert t.shape == (2, 3)
+    assert len(set(t[0].tolist()) | {int(t[1, 0])}) == 4  # all distinct
+    alloc.release(0)
+    assert len(alloc.free) == 3
+    alloc.ensure(1, 24)             # grows with recycled pages
+    assert len(alloc.rows[1]) == 3

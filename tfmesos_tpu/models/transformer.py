@@ -583,6 +583,88 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def init_paged_cache(cfg: TransformerConfig, n_pages: int,
+                     page_size: int = 128, dtype=None) -> Dict[str, Any]:
+    """A PAGED KV cache: one physical pool of ``n_pages`` pages per layer,
+    shared by every sequence — rows map logical cache blocks to pool
+    pages through a ``page_table`` ([B, NP] int32, built by
+    :class:`PageAllocator`), so mixed-length sequences consume memory
+    proportional to their LENGTH, not to a per-row max_len buffer (the
+    PagedAttention serving layout; docs/SERVING.md).
+
+    Pass ``{"k", "v", "pages"}`` (this dict plus the allocator's table
+    under ``"pages"``) to ``decode_step``.  fp caches only; windowed
+    (rolling) configs address by slot and don't page.
+    """
+    if cfg.window is not None:
+        raise ValueError("paged caches do not compose with sliding-window "
+                         "configs (rolling caches address by slot)")
+    if page_size % 8 or page_size > 1024:
+        raise ValueError(f"page_size ({page_size}) must be a multiple of "
+                         f"8 and <= 1024 (the kernel's tile shape)")
+    dtype = dtype or cfg.dtype
+    # (page, head_dim) trailing — the kernel's native layout, so serving
+    # never transposes the shared pool.
+    shape = (cfg.n_layers, n_pages, cfg.kv_heads, page_size, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+class PageAllocator:
+    """Host-side page bookkeeping for :func:`init_paged_cache` (numpy,
+    no jax): a free list over ``n_pages`` and per-row page lists.  The
+    serving loop allocates pages as sequences grow (``ensure``), frees
+    them when requests finish (``release``), and hands ``table()`` to
+    ``decode_step`` each call.  Rows it serves may come and go — that
+    admission control is the caller's loop, as docs/SERVING.md notes."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        import numpy as np
+
+        self._np = np
+        self.page_size = int(page_size)
+        self.free = list(range(n_pages - 1, -1, -1))
+        self.rows: Dict[int, list] = {}
+
+    def ensure(self, row: int, length: int) -> None:
+        """Back positions [0, length) of ``row`` with pages."""
+        need = -(-int(length) // self.page_size)
+        pages = self.rows.setdefault(row, [])
+        while len(pages) < need:
+            if not self.free:
+                raise RuntimeError("page pool exhausted")
+            pages.append(self.free.pop())
+
+    def release(self, row: int) -> None:
+        self.free.extend(reversed(self.rows.pop(row, [])))
+
+    def table(self, rows) -> "jnp.ndarray":
+        """[len(rows), NP] table (NP = longest row's page count; unused
+        entries point at page 0 — never fetched, the per-row block bound
+        stops first)."""
+        np = self._np
+        lists = [self.rows.get(r, []) for r in rows]
+        width = max(1, max((len(p) for p in lists), default=1))
+        t = np.zeros((len(lists), width), np.int32)
+        for i, pages in enumerate(lists):
+            t[i, :len(pages)] = pages
+        return jnp.asarray(t)
+
+
+def _paged_cache_write(pool, chunk, page_table, pos):
+    """Write a [B, t, H, Dh] chunk into the page pool ([P, KV, page, Dh])
+    at logical positions ``pos..pos+t-1`` per row (``pos`` scalar or
+    [B]): one scatter over (page, offset) pairs chased through the
+    table."""
+    b, t = chunk.shape[:2]
+    ps = pool.shape[2]
+    posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    lpos = posv[:, None] + jnp.arange(t, dtype=jnp.int32)[None]   # [B, t]
+    pages = jnp.take_along_axis(page_table, lpos // ps, axis=1)
+    offs = lpos % ps
+    return pool.at[pages.reshape(-1), :, offs.reshape(-1)].set(
+        chunk.reshape(b * t, *chunk.shape[2:]).astype(pool.dtype))
+
+
 def _cache_write(cache, chunk, pos, rolling: bool = False):
     """Insert a [B, t, H, Dh] K or V chunk at position ``pos`` of a cache
     layer, quantizing on the way in when the cache is int8 (the same
@@ -700,7 +782,8 @@ def _decode_kernel_kwargs(cfg: TransformerConfig, m: int, t: int,
 
 
 def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
-                  sharded: bool = False, mesh: Optional[Mesh] = None):
+                  sharded: bool = False, mesh: Optional[Mesh] = None,
+                  pages=None):
     """One block over a token chunk with cached history.
 
     ``x``: [B, t, d] (t = chunk length; 1 in steady-state decode);
@@ -717,7 +800,10 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     the dense einsum over the cache with an offset causal mask.
     """
     b, t, _ = x.shape
-    m = (ck.values if isinstance(ck, QTensor) else ck).shape[1]
+    if pages is not None:
+        m = pages.shape[1] * ck.shape[2]    # logical length (NP x page)
+    else:
+        m = (ck.values if isinstance(ck, QTensor) else ck).shape[1]
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads,
                                                cfg.head_dim)
@@ -729,8 +815,12 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     q = rope(q, pos_row, cfg.rope_theta)
     k = rope(k, pos_row, cfg.rope_theta)
     rolling = cfg.window is not None
-    ck = _cache_write(ck, k, pos, rolling=rolling)
-    cv = _cache_write(cv, v, pos, rolling=rolling)
+    if pages is not None:
+        ck = _paged_cache_write(ck, k, pages, pos)
+        cv = _paged_cache_write(cv, v, pages, pos)
+    else:
+        ck = _cache_write(ck, k, pos, rolling=rolling)
+        cv = _cache_write(cv, v, pos, rolling=rolling)
     kv = cfg.kv_heads
     g = cfg.n_heads // kv
     if t > 1 and isinstance(pos, int) and pos == 0:
@@ -741,6 +831,20 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
             o = mha_reference(q, k, v, causal=True, window=cfg.window)
         else:
             o = attend(q, k, v, mesh=None, causal=True, window=cfg.window)
+    elif pages is not None:
+        # Paged attention: pool-page indirection through the kernel's
+        # scalar-prefetched index maps (TPU), or the gather-the-pages
+        # reference elsewhere.  Single-host path (the pool gather does
+        # not GSPMD-partition).
+        from tfmesos_tpu.ops.attention import (_paged_decode_reference,
+                                               flash_decode_paged)
+        kw = _decode_kernel_kwargs(cfg, m, t, False)
+        if kw is not None:
+            o = flash_decode_paged(q, ck, cv, pages, positions[:, 0], **kw)
+        else:
+            o = _paged_decode_reference(
+                q, ck, cv, pages, positions[:, 0],
+                1.0 / math.sqrt(cfg.head_dim))
     elif (kernel_kw := _decode_kernel_kwargs(cfg, m, t, sharded, mesh,
                                              batch=b)) is not None:
         # Cache-bounded flash-decode kernel (t=1 steps and short chunks —
@@ -838,17 +942,25 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     positions = jnp.broadcast_to(
         (pos_arr[:, None] if ragged else pos_arr) + offs, (b, t))
 
+    pages = cache.get("pages")
+    if pages is not None and sharded:
+        raise ValueError("paged caches are a single-host serving layout; "
+                         "use cache_specs GSPMD decode for multi-chip")
+
     def body(carry, layer):
         lp, ck, cv = layer
         out, ck, cv = _block_decode(cfg, carry, lp, ck, cv, positions, pos,
-                                    sharded=sharded, mesh=mesh)
+                                    sharded=sharded, mesh=mesh, pages=pages)
         return out, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
     logits = x @ _wt(params["head"], cfg.dtype)
-    return logits, {"k": new_k, "v": new_v}
+    out_cache = {"k": new_k, "v": new_v}
+    if pages is not None:
+        out_cache["pages"] = pages
+    return logits, out_cache
 
 
 def _check_sampling_args(top_k: Optional[int], top_p: Optional[float]):

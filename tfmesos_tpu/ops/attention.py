@@ -691,6 +691,119 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     return out[:, 0] if squeeze else out
 
 
+def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale):
+    """Gather-the-pages ground truth: materialize each row's logical cache
+    view from the pool ([P, KV, page, D]) and run the dense masked
+    reference."""
+    b = q.shape[0]
+    kv, ps = k_pool.shape[1], k_pool.shape[2]
+    np_ = page_table.shape[1]
+    gather = lambda pool: pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
+        b, np_ * ps, kv, pool.shape[3])
+    return _decode_reference(q, gather(k_pool), gather(v_pool), pos, scale)
+
+
+def _flash_decode_paged_kernel(s_ref, pt_ref, *rest, block_m: int,
+                               scale: float, q_per_kv: int):
+    """One (batch, kv-head, logical-page) grid step of paged decode: the
+    SAME online-softmax body as ``_flash_decode_kernel`` — only the
+    BlockSpec index maps differ (they chase this row's physical page id
+    through the scalar-prefetched page table, so each row's cache lives
+    in scattered pool pages and rows share one physical pool)."""
+    del pt_ref  # consumed by the index maps
+    _flash_decode_kernel(s_ref, *rest, block_m=block_m, scale=scale,
+                         quantized=False, q_per_kv=q_per_kv)
+
+
+def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
+                       scale: Optional[float] = None,
+                       use_pallas: Optional[bool] = None,
+                       interpret: bool = False):
+    """Decode attention over a PAGED KV cache: each row's logical cache is
+    a list of physical pages in a shared pool (``page_table`` [B, NP]
+    int32 — logical block j of row b lives at
+    ``pool[page_table[b, j]]``), so mixed-length sequences share memory
+    without per-row max_len buffers — the PagedAttention layout, realized
+    on TPU by routing the page id through the kernel's scalar-prefetched
+    BlockSpec index maps (block fetches chase the table; out-of-range
+    blocks pin to the last live page and are never re-fetched).
+
+    ``q``: [B, H, D] or [B, t, H, D]; ``k_pool``/``v_pool``:
+    [P, KV, page, D] (page and head_dim trailing — the pool's NATIVE
+    layout, so no per-call transpose of the shared pool); ``pos``:
+    scalar or [B] int32 — positions [0..pos(+t-1)] must be backed by
+    pages.  Returns q's shape.
+    """
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, t, h, d = q.shape
+    kv, ps = k_pool.shape[1], k_pool.shape[2]
+    if h % kv or v_pool.shape[1] != kv:
+        raise ValueError(
+            f"q heads ({h}) must be a multiple of kv heads "
+            f"({kv}/{v_pool.shape[1]}, which must agree)")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    g = h // kv
+    aligned = ps % 8 == 0 and ps <= 1024
+    if use_pallas is None:
+        on_tpu = jax.default_backend() == "tpu"
+        use_pallas = aligned and (on_tpu or interpret)
+    elif use_pallas and not aligned:
+        raise ValueError(
+            f"flash_decode_paged(use_pallas=True): page_size {ps} is not "
+            f"Mosaic-tileable (needs a multiple of 8, <= 1024)")
+    if not use_pallas:
+        out = _paged_decode_reference(q, k_pool, v_pool, page_table, pos,
+                                      scale)
+        return out[:, 0] if squeeze else out
+
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    scalars = jnp.stack([(pos + t - 1) // ps + 1, pos])     # [2, B]
+    page_table = jnp.asarray(page_table, jnp.int32)
+    if q.dtype != k_pool.dtype:
+        q = q.astype(jnp.promote_types(q.dtype, k_pool.dtype))
+        k_pool = k_pool.astype(q.dtype)
+    qt = q.reshape(b, t, kv, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, kv, t * g, d)
+    kt, vt = k_pool, v_pool     # already (page, head_dim)-trailing
+
+    q_spec = pl.BlockSpec((1, 1, t * g, d),
+                          lambda bi, hi, j, s, pt: (bi, hi, 0, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec(
+        (1, 1, ps, d),
+        lambda bi, hi, j, s, pt: (
+            pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
+        memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kv, page_table.shape[1]),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((t * g, d), jnp.float32),
+                        pltpu.VMEM((t * g, 1), jnp.float32),
+                        pltpu.VMEM((t * g, 1), jnp.float32)])
+    out = pl.pallas_call(
+        functools.partial(_flash_decode_paged_kernel, block_m=ps,
+                          scale=float(scale), q_per_kv=g),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * t * h * page_table.shape[1] * ps * d,
+            bytes_accessed=(k_pool.size * k_pool.dtype.itemsize * 2
+                            + 2 * q.size * q.dtype.itemsize),
+            transcendentals=b * t * h * page_table.shape[1] * ps),
+    )(scalars, page_table, qt, kt, vt)
+    out = out.reshape(b, kv, t, g, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, t, h, d)
+    return out[:, 0] if squeeze else out
+
+
 def sharded_flash_decode(q, k_cache, v_cache, pos, mesh, **kw):
     """``flash_decode`` under GSPMD decode: shard_map over the data axes
     (batch) and tp (kv-major head blocks — the transformer
