@@ -34,6 +34,10 @@ def main():
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--int8", action="store_true")
     p.add_argument("--int8-kv", action="store_true", dest="int8_kv")
+    p.add_argument("--paged", action="store_true",
+                   help="serve from a paged KV cache: one shared page "
+                        "pool, per-batch page allocation/recycling "
+                        "(docs/SERVING.md)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tiny", action="store_true")
     args = p.parse_args()
@@ -79,12 +83,25 @@ def main():
     # longest prompt rounded up to a multiple of 8, so a handful of
     # compiled shapes serves the whole stream.
     @jax.jit
-    def run(params, batch, lens):
+    def run(params, batch, lens, cache=None):
         return transformer.generate(
             cfg, params, batch, args.new_tokens, prompt_lens=lens,
             rng=jax.random.PRNGKey(args.seed + 1),
             temperature=args.temperature, quantized_cache=args.int8_kv,
-            stop_token=args.stop_token)
+            stop_token=args.stop_token, cache=cache)
+
+    alloc = pool = None
+    if args.paged:
+        if args.int8_kv:
+            print("serve: --paged is fp-only; ignoring --int8-kv",
+                  file=sys.stderr)
+        # Pool sized for one batch at max shape; pages recycle between
+        # batches (a long-lived server would grow rows incrementally).
+        page = 64
+        per_row = -(-(limit + args.new_tokens) // page)
+        alloc = transformer.PageAllocator(args.batch * per_row, page)
+        pool = transformer.init_paged_cache(cfg, args.batch * per_row,
+                                            page_size=page)
 
     sink = open(args.out, "w") if args.out else sys.stdout
     served = 0
@@ -96,8 +113,19 @@ def main():
         padded = np.zeros((len(chunk), width), np.int32)
         for i, t in enumerate(chunk):
             padded[i, :len(t)] = t
-        out = np.asarray(run(params, jnp.asarray(padded),
-                             jnp.asarray(lens)))
+        if alloc is not None:
+            # Pages must back the PADDED prompt region (prefill writes
+            # the whole chunk) plus the continuation.
+            for i in range(len(chunk)):
+                alloc.ensure(i, width + args.new_tokens)
+            cache = dict(pool, pages=alloc.table(range(len(chunk))))
+            out = np.asarray(run(params, jnp.asarray(padded),
+                                 jnp.asarray(lens), cache))
+            for i in range(len(chunk)):
+                alloc.release(i)
+        else:
+            out = np.asarray(run(params, jnp.asarray(padded),
+                                 jnp.asarray(lens)))
         for i, t in enumerate(chunk):
             row = out[i, lens[i]:lens[i] + args.new_tokens].tolist()
             if args.stop_token is not None and args.stop_token in row:

@@ -1021,7 +1021,7 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              quantized_cache: bool = False, prompt_lens=None,
-             prefix=None, stop_token: Optional[int] = None):
+             prefix=None, stop_token: Optional[int] = None, cache=None):
     """Autoregressive generation: prefill the prompt in one pass, then one
     fused scan step per token (KV cache; greedy, temperature, top-k and/or
     top-p nucleus sampling — see ``sample_logits``).
@@ -1048,6 +1048,10 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     stop token), and decoding EXITS EARLY once every row has stopped —
     tokens up to each row's first stop are identical to a run without
     ``stop_token``.
+
+    ``cache``: a caller-managed cache — notably a PAGED one
+    (``init_paged_cache`` + a :class:`PageAllocator` table under
+    ``"pages"``), whose pages must back every position the run touches.
     """
     b, tp = prompt.shape
     if max_new_tokens <= 0:
@@ -1060,7 +1064,8 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         return sample_logits(logits, key, temperature, top_k, top_p)
 
     logits, cache = _prefill(cfg, params, prompt, t0 + tp + max_new_tokens,
-                             quantized=quantized_cache, prefix=prefix)
+                             quantized=quantized_cache, prefix=prefix,
+                             cache=cache)
     rng, key = jax.random.split(rng)
     if prompt_lens is None:
         next_logits = logits[:, -1]
@@ -1131,14 +1136,26 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
 
 
 def _prefill(cfg: TransformerConfig, params, prompt, depth: int,
-             quantized: bool = False, prefix=None):
+             quantized: bool = False, prefix=None, cache=None):
     """Fresh-cache prefill shared by the generation entry points: with a
     ``prefix``, prefill it ONCE at batch 1, broadcast the cache to the
     prompt's batch (the cache batch axis is 1), then prefill the per-row
-    prompt chunk at position t0.  Returns (prompt-chunk logits, cache)."""
+    prompt chunk at position t0.  Returns (prompt-chunk logits, cache).
+
+    ``cache`` (optional) supplies a caller-managed cache instead — a
+    preallocated contiguous one, or a PAGED dict ({"k", "v", "pages"};
+    the caller's allocator must back every position the generation will
+    touch).  Not combinable with ``prefix`` (whose batch-1 broadcast
+    assumes this function owns the buffer)."""
     b = prompt.shape[0]
+    if cache is not None:
+        if prefix is not None:
+            raise ValueError("generate: prefix and a caller-provided "
+                             "cache cannot combine (the prefix broadcast "
+                             "owns the buffer layout)")
+        return decode_step(cfg, params, cache, prompt, 0)
     cache = init_cache(cfg, 1 if prefix is not None else b, depth,
-                      quantized=quantized)
+                       quantized=quantized)
     if prefix is None:
         return decode_step(cfg, params, cache, prompt, 0)
     _, cache = decode_step(cfg, params, cache, prefix[None, :], 0)
