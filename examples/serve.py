@@ -92,16 +92,15 @@ def main():
 
     alloc = pool = None
     if args.paged:
-        if args.int8_kv:
-            print("serve: --paged is fp-only; ignoring --int8-kv",
-                  file=sys.stderr)
         # Pool sized for one batch at max shape; pages recycle between
         # batches (a long-lived server would grow rows incrementally).
+        # --int8-kv composes: the pool stores int8 pages.
         page = 64
         per_row = -(-(limit + args.new_tokens) // page)
         alloc = transformer.PageAllocator(args.batch * per_row, page)
         pool = transformer.init_paged_cache(cfg, args.batch * per_row,
-                                            page_size=page)
+                                            page_size=page,
+                                            quantized=args.int8_kv)
 
     sink = open(args.out, "w") if args.out else sys.stdout
     served = 0

@@ -1561,3 +1561,49 @@ def test_generate_over_paged_cache_matches():
     got = transformer.generate(cfg, params, toks, 8, prompt_lens=lens,
                                cache=pcache)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_int8_paged_generate_matches_contiguous():
+    """int8 page pool (per-position scales folded in-kernel): paged
+    generate equals the contiguous int8-cache run bitwise, and the
+    forced kernel path matches the gather reference."""
+    import random as pyrandom
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=256, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (3, 9), 0,
+                              cfg.vocab_size)
+    lens = jnp.array([4, 9, 6], jnp.int32)
+    ref = transformer.generate(cfg, params, toks, 8, prompt_lens=lens,
+                               quantized_cache=True)
+    alloc = transformer.PageAllocator(n_pages=24, page_size=8)
+    pyrandom.Random(5).shuffle(alloc.free)
+    for i in range(3):
+        alloc.ensure(i, 17)
+    pcache = transformer.init_paged_cache(cfg, 24, page_size=8,
+                                          quantized=True)
+    pcache["pages"] = alloc.table(range(3))
+    got = transformer.generate(cfg, params, toks, 8, prompt_lens=lens,
+                               cache=pcache)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    cache2 = transformer.init_paged_cache(cfg, 24, page_size=8,
+                                          quantized=True)
+    alloc2 = transformer.PageAllocator(24, 8)
+    for i in range(3):
+        alloc2.ensure(i, 17)
+    cache2["pages"] = alloc2.table(range(3))
+    _, cache2 = transformer.decode_step(cfg, params, cache2, toks, 0)
+    nxt = jnp.take_along_axis(toks, lens[:, None], axis=1)
+    ref_lg, _ = transformer.decode_step(cfg, params, cache2, nxt, lens)
+    orig = transformer._decode_kernel_kwargs
+    transformer._decode_kernel_kwargs = (
+        lambda *a, **k: {"use_pallas": True, "interpret": True})
+    try:
+        got_lg, _ = transformer.decode_step(cfg, params, cache2, nxt, lens)
+    finally:
+        transformer._decode_kernel_kwargs = orig
+    np.testing.assert_allclose(np.asarray(got_lg), np.asarray(ref_lg),
+                               rtol=2e-4, atol=2e-4)

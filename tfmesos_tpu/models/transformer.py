@@ -584,7 +584,8 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
 
 
 def init_paged_cache(cfg: TransformerConfig, n_pages: int,
-                     page_size: int = 128, dtype=None) -> Dict[str, Any]:
+                     page_size: int = 128, dtype=None,
+                     quantized: bool = False) -> Dict[str, Any]:
     """A PAGED KV cache: one physical pool of ``n_pages`` pages per layer,
     shared by every sequence — rows map logical cache blocks to pool
     pages through a ``page_table`` ([B, NP] int32, built by
@@ -593,8 +594,10 @@ def init_paged_cache(cfg: TransformerConfig, n_pages: int,
     PagedAttention serving layout; docs/SERVING.md).
 
     Pass ``{"k", "v", "pages"}`` (this dict plus the allocator's table
-    under ``"pages"``) to ``decode_step``.  fp caches only; windowed
-    (rolling) configs address by slot and don't page.
+    under ``"pages"``) to ``decode_step``.  ``quantized=True`` stores the
+    pool as int8 with per-position scales (the paged kernel folds them
+    into the score rows, so HBM streams int8 pages).  Windowed (rolling)
+    configs address by slot and don't page.
     """
     if cfg.window is not None:
         raise ValueError("paged caches do not compose with sliding-window "
@@ -602,6 +605,24 @@ def init_paged_cache(cfg: TransformerConfig, n_pages: int,
     if page_size % 8 or page_size > 1024:
         raise ValueError(f"page_size ({page_size}) must be a multiple of "
                          f"8 and <= 1024 (the kernel's tile shape)")
+    if quantized:
+        if dtype is not None:
+            raise ValueError("init_paged_cache: dtype and quantized=True "
+                             "conflict (an int8 pool's dtypes are fixed)")
+        shape = (cfg.n_layers, n_pages, cfg.kv_heads, page_size,
+                 cfg.head_dim)
+
+        def buf():
+            # Scales are LANE-MAJOR ([..., 1, page] — positions on the
+            # trailing dim), deviating from QTensor's usual trailing-1
+            # convention, so the kernel consumes them without a per-call
+            # transpose of pool-capacity-sized data.  flash_decode_paged
+            # and its reference are the only consumers.
+            return QTensor(jnp.zeros(shape, jnp.int8),
+                           jnp.ones(shape[:-2] + (1, page_size),
+                                    jnp.float32))
+
+        return {"k": buf(), "v": buf()}
     dtype = dtype or cfg.dtype
     # (page, head_dim) trailing — the kernel's native layout, so serving
     # never transposes the shared pool.
@@ -651,18 +672,30 @@ class PageAllocator:
 
 
 def _paged_cache_write(pool, chunk, page_table, pos):
-    """Write a [B, t, H, Dh] chunk into the page pool ([P, KV, page, Dh])
-    at logical positions ``pos..pos+t-1`` per row (``pos`` scalar or
-    [B]): one scatter over (page, offset) pairs chased through the
-    table."""
+    """Write a [B, t, H, Dh] chunk into the page pool ([P, KV, page, Dh];
+    int8 QTensors quantize per position on the way in) at logical
+    positions ``pos..pos+t-1`` per row (``pos`` scalar or [B]): one
+    scatter over (page, offset) pairs chased through the table."""
     b, t = chunk.shape[:2]
-    ps = pool.shape[2]
+    ps = (pool.values if isinstance(pool, QTensor) else pool).shape[2]
     posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
     lpos = posv[:, None] + jnp.arange(t, dtype=jnp.int32)[None]   # [B, t]
-    pages = jnp.take_along_axis(page_table, lpos // ps, axis=1)
-    offs = lpos % ps
-    return pool.at[pages.reshape(-1), :, offs.reshape(-1)].set(
-        chunk.reshape(b * t, *chunk.shape[2:]).astype(pool.dtype))
+    pages = jnp.take_along_axis(page_table, lpos // ps, axis=1).reshape(-1)
+    offs = (lpos % ps).reshape(-1)
+
+    def put(buf, x):
+        return buf.at[pages, :, offs].set(
+            x.reshape(b * t, *x.shape[2:]).astype(buf.dtype))
+
+    if isinstance(pool, QTensor):
+        from tfmesos_tpu.ops.quant import quantize_int8_reference
+        vals, scale = quantize_int8_reference(chunk)
+        # Scales pool is lane-major [P, KV, 1, page] (see
+        # init_paged_cache): scatter at (page, :, 0, offset).
+        scales = pool.scales.at[pages, :, 0, offs].set(
+            scale.reshape(b * t, scale.shape[2]))
+        return QTensor(put(pool.values, vals), scales)
+    return put(pool, chunk)
 
 
 def _cache_write(cache, chunk, pos, rolling: bool = False):
@@ -801,7 +834,8 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     """
     b, t, _ = x.shape
     if pages is not None:
-        m = pages.shape[1] * ck.shape[2]    # logical length (NP x page)
+        ps_ = (ck.values if isinstance(ck, QTensor) else ck).shape[2]
+        m = pages.shape[1] * ps_            # logical length (NP x page)
     else:
         m = (ck.values if isinstance(ck, QTensor) else ck).shape[1]
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))

@@ -693,8 +693,17 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
 
 def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale):
     """Gather-the-pages ground truth: materialize each row's logical cache
-    view from the pool ([P, KV, page, D]) and run the dense masked
-    reference."""
+    view from the pool ([P, KV, page, D]; int8 QTensors dequantize) and
+    run the dense masked reference."""
+    from tfmesos_tpu.ops.quant import QTensor
+
+    if isinstance(k_pool, QTensor):
+        # Paged pools carry LANE-MAJOR scales ([P, KV, 1, page]); move
+        # them back over the positions to dequantize (test/CPU path —
+        # the kernel consumes the lane-major layout directly).
+        deq = lambda p: (p.values.astype(q.dtype)
+                         * p.scales.transpose(0, 1, 3, 2).astype(q.dtype))
+        k_pool, v_pool = deq(k_pool), deq(v_pool)
     b = q.shape[0]
     kv, ps = k_pool.shape[1], k_pool.shape[2]
     np_ = page_table.shape[1]
@@ -704,7 +713,8 @@ def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale):
 
 
 def _flash_decode_paged_kernel(s_ref, pt_ref, *rest, block_m: int,
-                               scale: float, q_per_kv: int):
+                               scale: float, quantized: bool,
+                               q_per_kv: int):
     """One (batch, kv-head, logical-page) grid step of paged decode: the
     SAME online-softmax body as ``_flash_decode_kernel`` — only the
     BlockSpec index maps differ (they chase this row's physical page id
@@ -712,7 +722,7 @@ def _flash_decode_paged_kernel(s_ref, pt_ref, *rest, block_m: int,
     in scattered pool pages and rows share one physical pool)."""
     del pt_ref  # consumed by the index maps
     _flash_decode_kernel(s_ref, *rest, block_m=block_m, scale=scale,
-                         quantized=False, q_per_kv=q_per_kv)
+                         quantized=quantized, q_per_kv=q_per_kv)
 
 
 def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
@@ -730,19 +740,27 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
 
     ``q``: [B, H, D] or [B, t, H, D]; ``k_pool``/``v_pool``:
     [P, KV, page, D] (page and head_dim trailing — the pool's NATIVE
-    layout, so no per-call transpose of the shared pool); ``pos``:
-    scalar or [B] int32 — positions [0..pos(+t-1)] must be backed by
-    pages.  Returns q's shape.
+    layout, so no per-call transpose of the shared pool), plain arrays
+    or int8 ``QTensor``s (LANE-MAJOR scales [P, KV, 1, page], as
+    ``init_paged_cache`` builds them; HBM streams int8 and the
+    per-position scales fold into the score rows in-kernel);
+    ``pos``: scalar or [B] int32 — positions [0..pos(+t-1)] must be
+    backed by pages.  Returns q's shape.
     """
+    from tfmesos_tpu.ops.quant import QTensor
+
+    quantized = isinstance(k_pool, QTensor)
+    kp = k_pool.values if quantized else k_pool
+    vp = v_pool.values if quantized else v_pool
     squeeze = q.ndim == 3
     if squeeze:
         q = q[:, None]
     b, t, h, d = q.shape
-    kv, ps = k_pool.shape[1], k_pool.shape[2]
-    if h % kv or v_pool.shape[1] != kv:
+    kv, ps = kp.shape[1], kp.shape[2]
+    if h % kv or vp.shape[1] != kv:
         raise ValueError(
             f"q heads ({h}) must be a multiple of kv heads "
-            f"({kv}/{v_pool.shape[1]}, which must agree)")
+            f"({kv}/{vp.shape[1]}, which must agree)")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     g = h // kv
@@ -762,12 +780,11 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
     scalars = jnp.stack([(pos + t - 1) // ps + 1, pos])     # [2, B]
     page_table = jnp.asarray(page_table, jnp.int32)
-    if q.dtype != k_pool.dtype:
-        q = q.astype(jnp.promote_types(q.dtype, k_pool.dtype))
-        k_pool = k_pool.astype(q.dtype)
+    if not quantized and q.dtype != kp.dtype:
+        q = q.astype(jnp.promote_types(q.dtype, kp.dtype))
+        kp = kp.astype(q.dtype)
     qt = q.reshape(b, t, kv, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, kv, t * g, d)
-    kt, vt = k_pool, v_pool     # already (page, head_dim)-trailing
 
     q_spec = pl.BlockSpec((1, 1, t * g, d),
                           lambda bi, hi, j, s, pt: (bi, hi, 0, 0),
@@ -777,17 +794,30 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
         lambda bi, hi, j, s, pt: (
             pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
         memory_space=pltpu.VMEM)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qt, kp, vp]     # pools already (page, head_dim)-trailing
+    if quantized:
+        # Scales as [P, KV, 1, page]: positions on the lane dim, same
+        # page-chasing index map as their values.
+        sc_spec = pl.BlockSpec(
+            (1, 1, 1, ps),
+            lambda bi, hi, j, s, pt: (
+                pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
+            memory_space=pltpu.VMEM)
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_pool.scales, v_pool.scales]  # already lane-major
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kv, page_table.shape[1]),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((t * g, d), jnp.float32),
                         pltpu.VMEM((t * g, 1), jnp.float32),
                         pltpu.VMEM((t * g, 1), jnp.float32)])
     out = pl.pallas_call(
         functools.partial(_flash_decode_paged_kernel, block_m=ps,
-                          scale=float(scale), q_per_kv=g),
+                          scale=float(scale), quantized=quantized,
+                          q_per_kv=g),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
@@ -795,10 +825,10 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * t * h * page_table.shape[1] * ps * d,
-            bytes_accessed=(k_pool.size * k_pool.dtype.itemsize * 2
+            bytes_accessed=(kp.size * kp.dtype.itemsize * 2
                             + 2 * q.size * q.dtype.itemsize),
             transcendentals=b * t * h * page_table.shape[1] * ps),
-    )(scalars, page_table, qt, kt, vt)
+    )(scalars, page_table, *operands)
     out = out.reshape(b, kv, t, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, t, h, d)
     return out[:, 0] if squeeze else out
