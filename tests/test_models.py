@@ -1607,3 +1607,32 @@ def test_int8_paged_generate_matches_contiguous():
         transformer._decode_kernel_kwargs = orig
     np.testing.assert_allclose(np.asarray(got_lg), np.asarray(ref_lg),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_speculative_over_paged_cache():
+    """Speculative decoding with a paged TARGET cache (verify chunks write
+    and read through the page table) is bitwise the plain speculative
+    run."""
+    import random as pyrandom
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=256, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = transformer.init_params(SPEC_DRAFT, jax.random.PRNGKey(7))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                              cfg.vocab_size)
+    k, new = 3, 10
+    ref = transformer.speculative_generate(cfg, params, SPEC_DRAFT,
+                                           dparams, toks, new, n_draft=k)
+    depth = 9 + new + 2 * k + 1
+    alloc = transformer.PageAllocator(n_pages=16, page_size=8)
+    pyrandom.Random(2).shuffle(alloc.free)
+    for i in range(2):
+        alloc.ensure(i, depth)
+    pcache = transformer.init_paged_cache(cfg, 16, page_size=8)
+    pcache["pages"] = alloc.table(range(2))
+    got = transformer.speculative_generate(
+        cfg, params, SPEC_DRAFT, dparams, toks, new, n_draft=k,
+        cache=pcache)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))

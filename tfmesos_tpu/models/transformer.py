@@ -1281,7 +1281,8 @@ def speculative_generate(cfg: TransformerConfig, params,
                          prompt_lens=None, temperature: float = 0.0,
                          top_k: Optional[int] = None,
                          top_p: Optional[float] = None, rng=None,
-                         quantized_cache: bool = False, prefix=None):
+                         quantized_cache: bool = False, prefix=None,
+                         cache=None):
     """Speculative decoding: a cheap DRAFT model proposes ``n_draft``
     tokens per round, the target model scores them all in ONE chunked
     decode, and the leading accepted run commits (plus one
@@ -1324,10 +1325,13 @@ def speculative_generate(cfg: TransformerConfig, params,
     # lens + max_new + k - 1) and, frozen, keeps verifying k+1-token
     # chunks at that position — writes reach lens + max_new + 2k.
     depth = t0 + tp + max_new_tokens + 2 * k + 1
-    # ``quantized_cache`` applies to the TARGET cache (where the bytes
-    # are); the draft is small by construction and stays fp.
+    # ``quantized_cache``/caller-provided ``cache`` (e.g. a paged pool —
+    # its pages must back depth-many positions) apply to the TARGET cache
+    # (where the bytes are); the draft is small by construction and stays
+    # an internal fp buffer.
     logits, cache = _prefill(cfg, params, prompt, depth,
-                             quantized=quantized_cache, prefix=prefix)
+                             quantized=quantized_cache, prefix=prefix,
+                             cache=cache)
     _, draft_cache = _prefill(draft_cfg, draft_params, prompt, depth,
                               prefix=prefix)
     if prompt_lens is None:
