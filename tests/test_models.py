@@ -1625,7 +1625,7 @@ def test_speculative_over_paged_cache():
     k, new = 3, 10
     ref = transformer.speculative_generate(cfg, params, SPEC_DRAFT,
                                            dparams, toks, new, n_draft=k)
-    depth = 9 + new + 2 * k + 1
+    depth = transformer.speculative_cache_depth(9, new, k)
     alloc = transformer.PageAllocator(n_pages=16, page_size=8)
     pyrandom.Random(2).shuffle(alloc.free)
     for i in range(2):
@@ -1636,3 +1636,34 @@ def test_speculative_over_paged_cache():
         cfg, params, SPEC_DRAFT, dparams, toks, new, n_draft=k,
         cache=pcache)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_speculative_stop_token():
+    """stop_token in speculative decoding: rows freeze once a committed
+    token is the stop (loop exits early); tokens up to each row's first
+    stop equal the target's greedy continuation, and an absent stop
+    changes nothing."""
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq_len=256, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = transformer.init_params(SPEC_DRAFT, jax.random.PRNGKey(7))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (3, 7), 0,
+                              cfg.vocab_size)
+    plain = np.asarray(transformer.generate(cfg, params, toks, 12))
+    gen = plain[:, 7:]
+    stop = int(gen[0, 4])
+    spec = np.asarray(transformer.speculative_generate(
+        cfg, params, SPEC_DRAFT, dparams, toks, 12, n_draft=3,
+        stop_token=stop))
+    for i in range(3):
+        hits = np.where(gen[i] == stop)[0]
+        cut = hits[0] if len(hits) else 11
+        np.testing.assert_array_equal(spec[i, 7:7 + cut + 1],
+                                      gen[i][:cut + 1])
+    absent = next(v for v in range(64)
+                  if v not in set(gen.ravel().tolist()))
+    spec2 = np.asarray(transformer.speculative_generate(
+        cfg, params, SPEC_DRAFT, dparams, toks, 12, n_draft=3,
+        stop_token=absent))
+    np.testing.assert_array_equal(spec2, plain)

@@ -1275,6 +1275,14 @@ def beam_search(cfg: TransformerConfig, params, prompt,
     return out
 
 
+def speculative_cache_depth(prompt_len: int, max_new_tokens: int,
+                            n_draft: int, prefix_len: int = 0) -> int:
+    """Cache positions ``speculative_generate`` may touch (its overshoot
+    slack included): size contiguous caches — or back paged rows
+    (``PageAllocator.ensure``) — with AT LEAST this many positions."""
+    return prefix_len + prompt_len + max_new_tokens + 2 * n_draft + 1
+
+
 def speculative_generate(cfg: TransformerConfig, params,
                          draft_cfg: TransformerConfig, draft_params,
                          prompt, max_new_tokens: int, n_draft: int = 4,
@@ -1282,7 +1290,7 @@ def speculative_generate(cfg: TransformerConfig, params,
                          top_k: Optional[int] = None,
                          top_p: Optional[float] = None, rng=None,
                          quantized_cache: bool = False, prefix=None,
-                         cache=None):
+                         cache=None, stop_token: Optional[int] = None):
     """Speculative decoding: a cheap DRAFT model proposes ``n_draft``
     tokens per round, the target model scores them all in ONE chunked
     decode, and the leading accepted run commits (plus one
@@ -1306,6 +1314,16 @@ def speculative_generate(cfg: TransformerConfig, params,
     prefix prefills ONCE per model at batch 1 and broadcasts into both
     caches).  Returns [B, (T0 +) Tp + max_new_tokens] with row i's
     continuation right after its real prompt.
+
+    ``cache``: a caller-managed TARGET cache (e.g. a paged pool); it
+    must back at least :func:`speculative_cache_depth` positions per
+    row.  ``stop_token``: rows freeze once a committed token is the
+    stop and the loop exits when all rows have stopped; tokens up to
+    each row's FIRST stop equal a stop-free run, but — unlike
+    :func:`generate`, which fills the tail with the stop token — the
+    tail after the stop is UNSPECIFIED (same-round overshoot tokens,
+    then zeros); truncate at the first stop as ``examples/serve.py``
+    does.
     """
     if cfg.window is not None or draft_cfg.window is not None:
         raise ValueError("speculative decoding does not compose with "
@@ -1324,7 +1342,7 @@ def speculative_generate(cfg: TransformerConfig, params,
     # Slack: a row can overshoot to committed = max_new + k (pos =
     # lens + max_new + k - 1) and, frozen, keeps verifying k+1-token
     # chunks at that position — writes reach lens + max_new + 2k.
-    depth = t0 + tp + max_new_tokens + 2 * k + 1
+    depth = speculative_cache_depth(tp, max_new_tokens, k, prefix_len=t0)
     # ``quantized_cache``/caller-provided ``cache`` (e.g. a paged pool —
     # its pages must back depth-many positions) apply to the TARGET cache
     # (where the bytes are); the draft is small by construction and stays
@@ -1362,6 +1380,20 @@ def speculative_generate(cfg: TransformerConfig, params,
         return _scatter_rows(out, jnp.where(mask, idx, out.shape[1]), vals,
                              mode="drop")
 
+    def advance(committed, n_commit, vals):
+        # ``stop_token``: a row whose committed run contains the stop
+        # freezes (its quota fills) — the loop exits once every row has
+        # stopped.  Tokens after a row's first stop within the same
+        # round's commit are unspecified; truncate at the stop (as
+        # examples/serve.py does).
+        nxt = committed + n_commit
+        if stop_token is None:
+            return nxt
+        j = jnp.arange(k + 1, dtype=jnp.int32)[None]
+        hit = jnp.any((vals == stop_token) & (j < n_commit[:, None]),
+                      axis=1)
+        return jnp.where(hit, max_new_tokens, nxt)
+
     def greedy_round(state):
         cache, draft_cache, tok, pos, committed, out, rng = state
         active = committed < max_new_tokens
@@ -1392,7 +1424,7 @@ def speculative_generate(cfg: TransformerConfig, params,
                         jnp.take_along_axis(g, a[:, None], axis=1)[:, 0],
                         tok)
         return (cache, draft_cache, tok, pos + n_commit,
-                committed + n_commit, out, rng)
+                advance(committed, n_commit, g), out, rng)
 
     def sampling_round(state):
         cache, draft_cache, tok, pos, committed, out, rng = state
@@ -1448,10 +1480,13 @@ def speculative_generate(cfg: TransformerConfig, params,
         out = commit(out, pos, a, n_commit, vals)
         tok = jnp.where(active, repl, tok)
         return (cache, draft_cache, tok, pos + n_commit,
-                committed + n_commit, out, rng)
+                advance(committed, n_commit, vals), out, rng)
 
-    state = (cache, draft_cache, tok, lens, jnp.ones((b,), jnp.int32), out,
-             rng)
+    committed0 = jnp.ones((b,), jnp.int32)
+    if stop_token is not None:
+        committed0 = jnp.where(tok == stop_token, max_new_tokens,
+                               committed0)
+    state = (cache, draft_cache, tok, lens, committed0, out, rng)
     state = jax.lax.while_loop(
         lambda s: jnp.any(s[4] < max_new_tokens),
         sampling_round if sampling else greedy_round, state)
