@@ -103,9 +103,11 @@ def test_tfrun_runs_transformer_trainer_on_mesh(capfd):
     assert "tokens/sec" in out
 
 
-def test_serve_example_end_to_end(tmp_path):
+@pytest.mark.parametrize("paged", [False, True])
+def test_serve_example_end_to_end(tmp_path, paged):
     """examples/serve.py: ragged JSONL workload in, one continuation per
-    prompt out, stop-token truncation applied."""
+    prompt out, stop-token truncation applied; --paged serves the same
+    workload from the page pool."""
     import json
     import subprocess
     import sys
@@ -118,7 +120,8 @@ def test_serve_example_end_to_end(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, "examples/serve.py", "--tiny", "--batch", "2",
-         "--new-tokens", "4", "--input", str(inp), "--out", str(out)],
+         "--new-tokens", "4", "--input", str(inp), "--out", str(out)]
+        + (["--paged"] if paged else []),
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         env=env, capture_output=True, timeout=240)
     assert proc.returncode == 0, proc.stderr.decode()

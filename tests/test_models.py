@@ -1667,3 +1667,29 @@ def test_speculative_stop_token():
         cfg, params, SPEC_DRAFT, dparams, toks, 12, n_draft=3,
         stop_token=absent))
     np.testing.assert_array_equal(spec2, plain)
+
+
+def test_page_allocator_randomized_stress():
+    """Random ensure/release traffic: rows never share pages, frees
+    recycle, and capacity accounting stays exact."""
+    import random as pyrandom
+
+    rng = pyrandom.Random(0)
+    alloc = transformer.PageAllocator(n_pages=64, page_size=8)
+    live = set()
+    for step in range(300):
+        if live and rng.random() < 0.4:
+            row = rng.choice(sorted(live))
+            alloc.release(row)
+            live.discard(row)
+        else:
+            row = rng.randrange(16)
+            need = rng.randrange(1, 60)
+            try:
+                alloc.ensure(row, need)
+                live.add(row)
+            except RuntimeError:
+                pass  # exhausted: fine, keep trading
+        used = [p for r in alloc.rows.values() for p in r]
+        assert len(used) == len(set(used))          # no sharing
+        assert len(used) + len(alloc.free) == 64    # exact accounting
