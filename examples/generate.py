@@ -81,6 +81,10 @@ def main():
         print("ragged prompt lens:", np.asarray(prompt_lens).tolist())
 
     if args.beam is not None:
+        if prompt_lens is not None:
+            print("note: beam search is uniform-prompt only; ignoring "
+                  "--ragged", file=sys.stderr)
+            prompt_lens = None
         gen = jax.jit(lambda p_, t_: transformer.beam_search(
             cfg, p_, t_, args.new_tokens, beam=args.beam,
             quantized_cache=args.int8_kv))
@@ -96,6 +100,7 @@ def main():
             cfg, p_, draft_cfg, draft_params, t_, args.new_tokens,
             prompt_lens=prompt_lens, temperature=args.temperature,
             top_k=args.top_k, top_p=args.top_p,
+            quantized_cache=args.int8_kv,
             rng=jax.random.PRNGKey(args.seed + 2)))
     else:
         gen = jax.jit(lambda p_, t_: transformer.generate(

@@ -73,6 +73,10 @@ def main():
     if not prompts:
         print("serve: empty workload", file=sys.stderr)
         return 1
+    if any(len(t) == 0 for t in prompts):
+        print("serve: empty prompt rows are not servable (there is no "
+              "position to continue from)", file=sys.stderr)
+        return 1
     limit = cfg.max_seq_len - args.new_tokens
     if any(len(t) > limit for t in prompts):
         print(f"serve: a prompt exceeds max_seq_len - new_tokens "
@@ -92,11 +96,14 @@ def main():
 
     alloc = pool = None
     if args.paged:
-        # Pool sized for one batch at max shape; pages recycle between
-        # batches (a long-lived server would grow rows incrementally).
-        # --int8-kv composes: the pool stores int8 pages.
+        # Pool sized for one batch at max shape — including the bucket
+        # padding (prompts pad up to a multiple of 8, so the written
+        # region can exceed limit+new_tokens by up to 7); pages recycle
+        # between batches (a long-lived server would grow rows
+        # incrementally).  --int8-kv composes: the pool stores int8 pages.
         page = 64
-        per_row = -(-(limit + args.new_tokens) // page)
+        max_width = -(-limit // 8) * 8
+        per_row = -(-(max_width + args.new_tokens) // page)
         alloc = transformer.PageAllocator(args.batch * per_row, page)
         pool = transformer.init_paged_cache(cfg, args.batch * per_row,
                                             page_size=page,

@@ -1088,11 +1088,15 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
     ``"pages"``), whose pages must back every position the run touches.
     """
     b, tp = prompt.shape
+    t0 = 0 if prefix is None else prefix.shape[0]
     if max_new_tokens <= 0:
-        return prompt
+        # Keep the documented [B, T0 + Tp] shape in the degenerate case.
+        if prefix is None:
+            return prompt
+        return jnp.concatenate(
+            [jnp.broadcast_to(prefix, (b, t0)), prompt], axis=1)
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    t0 = 0 if prefix is None else prefix.shape[0]
 
     def sample(logits, key):
         return sample_logits(logits, key, temperature, top_k, top_p)
@@ -1331,7 +1335,12 @@ def speculative_generate(cfg: TransformerConfig, params,
                          "be ragged)")
     b, tp = prompt.shape
     if max_new_tokens <= 0:
-        return prompt
+        # Keep the documented [B, T0 + Tp] shape in the degenerate case.
+        if prefix is None:
+            return prompt
+        return jnp.concatenate(
+            [jnp.broadcast_to(prefix, (b, prefix.shape[0])), prompt],
+            axis=1)
     k = int(n_draft)
     if k < 1:
         raise ValueError(f"n_draft must be >= 1, got {n_draft}")
@@ -1369,11 +1378,11 @@ def speculative_generate(cfg: TransformerConfig, params,
     out = _scatter_rows(out, lens, tok)
     limit = lens + max_new_tokens       # first out index past row's region
 
-    def commit(out, pos, a, n_commit, vals):
-        # Commit vals[0..a] right after each row's last committed token.
-        # Masked/overflow entries get an out-of-bounds index and drop —
-        # clipping instead would alias real indices, and duplicate scatter
-        # indices have no defined winner.
+    def commit(out, pos, n_commit, vals):
+        # Commit the first n_commit vals right after each row's last
+        # committed token.  Masked/overflow entries get an out-of-bounds
+        # index and drop — clipping instead would alias real indices, and
+        # duplicate scatter indices have no defined winner.
         j = jnp.arange(k + 1, dtype=jnp.int32)[None]
         idx = pos[:, None] + 1 + j
         mask = (j < n_commit[:, None]) & (idx < limit[:, None])
@@ -1419,7 +1428,7 @@ def speculative_generate(cfg: TransformerConfig, params,
             [match, jnp.zeros((b, 1), bool)], axis=1).astype(jnp.int32),
             axis=1)                                     # leading-run length
         n_commit = jnp.where(active, a + 1, 0)
-        out = commit(out, pos, a, n_commit, g)
+        out = commit(out, pos, n_commit, g)
         tok = jnp.where(active,
                         jnp.take_along_axis(g, a[:, None], axis=1)[:, 0],
                         tok)
@@ -1477,7 +1486,7 @@ def speculative_generate(cfg: TransformerConfig, params,
         cand = jnp.concatenate(
             [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
         vals = jnp.where(j == a[:, None], repl[:, None], cand)
-        out = commit(out, pos, a, n_commit, vals)
+        out = commit(out, pos, n_commit, vals)
         tok = jnp.where(active, repl, tok)
         return (cache, draft_cache, tok, pos + n_commit,
                 advance(committed, n_commit, vals), out, rng)
