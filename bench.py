@@ -333,55 +333,75 @@ def bench_decode_long_context(batch=4, max_len=16384, prompt_len=1024,
     return loop_with(kernel_gate), loop_with(einsum_gate)
 
 
-def bench_attention(b=4, t=2048, h=8, d=128, reps=10):
-    """Flash-kernel vs XLA-reference attention, fwd+bwd, at the BASELINE.md
-    comparison shape (B4/T2048/H8/D128 bf16 causal).
+def _timed_attention_fwdbwd(attn, b, t, h, d, reps):
+    """Chained-scan fwd+bwd timing of one attention callable, ms per call.
 
-    Chained-scan protocol: ``reps`` dependent grad steps inside one jit,
-    timed region ends in a host fetch (the remote-attach relay acks
-    ``block_until_ready`` early, so independent calls mis-time).  Returns
-    (flash_ms, xla_ms) per fwd+bwd call.
-    """
+    ``reps`` dependent grad steps inside one jit; the timed region ends in
+    a host fetch (the remote-attach relay acks ``block_until_ready`` early,
+    so independent calls mis-time).  Differentiates w.r.t. q AND k AND v:
+    the flash custom_vjp always runs both backward kernels, so a q-only
+    cotangent would let autodiff dead-code the reference's dk/dv paths and
+    bias the comparison.  dq+dk+dv are q-shaped, so their sum chains the
+    scan."""
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from tfmesos_tpu.ops.attention import flash_attention, mha_reference
 
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (b, t, h, d), jnp.bfloat16)
     k = jax.random.normal(ks[1], (b, t, h, d), jnp.bfloat16)
     v = jax.random.normal(ks[2], (b, t, h, d), jnp.bfloat16)
 
-    def timed(attn):
-        # Differentiate w.r.t. q AND k AND v: the flash custom_vjp always
-        # runs both backward kernels, so a q-only cotangent would let
-        # autodiff dead-code the reference's dk/dv paths and bias the
-        # comparison.  dq+dk+dv are q-shaped, so their sum chains the scan.
-        g = jax.grad(lambda q_, k_, v_: jnp.sum(
-            attn(q_, k_, v_).astype(jnp.float32) ** 2), argnums=(0, 1, 2))
+    g = jax.grad(lambda q_, k_, v_: jnp.sum(
+        attn(q_, k_, v_).astype(jnp.float32) ** 2), argnums=(0, 1, 2))
 
-        @jax.jit
-        def chain(q0):
-            def body(c, _):
-                dq, dk, dv = g(c, k, v)
-                return (dq + dk + dv).astype(jnp.bfloat16), None
-            out, _ = lax.scan(body, q0, None, length=reps)
-            return out
+    @jax.jit
+    def chain(q0):
+        def body(c, _):
+            dq, dk, dv = g(c, k, v)
+            return (dq + dk + dv).astype(jnp.bfloat16), None
+        out, _ = lax.scan(body, q0, None, length=reps)
+        return out
 
+    out = chain(q)
+    float(np.asarray(out[0, 0, 0, 0]))  # warm + drain
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
         out = chain(q)
-        float(np.asarray(out[0, 0, 0, 0]))  # warm + drain
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = chain(q)
-            float(np.asarray(out[0, 0, 0, 0]))
-            best = min(best, (time.perf_counter() - t0) / reps)
-        return best * 1000
+        float(np.asarray(out[0, 0, 0, 0]))
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best * 1000
 
-    flash_ms = timed(lambda q_, k_, v_: flash_attention(q_, k_, v_,
-                                                        causal=True))
-    xla_ms = timed(lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=True))
+
+def bench_attention(b=4, t=2048, h=8, d=128, reps=10):
+    """Flash-kernel vs XLA-reference attention, fwd+bwd, at the BASELINE.md
+    comparison shape (B4/T2048/H8/D128 bf16 causal).  Returns
+    (flash_ms, xla_ms) per fwd+bwd call."""
+    from tfmesos_tpu.ops.attention import flash_attention, mha_reference
+
+    flash_ms = _timed_attention_fwdbwd(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True),
+        b, t, h, d, reps)
+    xla_ms = _timed_attention_fwdbwd(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=True),
+        b, t, h, d, reps)
     return flash_ms, xla_ms
+
+
+def bench_attention_blocks(b=4, t=2048, h=8, d=128, reps=10):
+    """Flash fwd+bwd per block_q choice — the recorded number BASELINE.md
+    asks for before re-raising the default from 512.  Same chained-scan
+    protocol as bench_attention; returns {"bq512": ms, "bq1024": ms}."""
+    from tfmesos_tpu.ops.attention import flash_attention
+
+    def timed(bq):
+        return round(_timed_attention_fwdbwd(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True,
+                                               block_q=bq),
+            b, t, h, d, reps), 3)
+
+    return {"bq512": timed(512), "bq1024": timed(1024)}
 
 
 def bench_bandwidth(sizes=None):
@@ -603,9 +623,13 @@ def main():
         print(json.dumps(out), flush=True)
         return
     # The headline metric is in hand; the remaining probes each pay a heavy
-    # XLA compile.  Print a parseable line NOW so an external timeout still
-    # leaves a result — the final full line below supersedes it.
-    print(json.dumps(dict(out, partial=True)), flush=True)
+    # XLA compile.  Flush a parseable partial line after EVERY section so a
+    # relay wedge mid-suite keeps whatever hardware data had landed (round 3
+    # protected only the headline) — the final full line supersedes them all.
+    def flush_partial():
+        print(json.dumps(dict(out, partial=True)), flush=True)
+
+    flush_partial()
 
     # One attempt each: compile dominates wall-clock for these, and each
     # attempt already takes best-of-`iters` timings internally.
@@ -614,22 +638,27 @@ def main():
         toks, mfu = max(tr)
         out["transformer_tokens_per_sec"] = round(toks, 1)
         out["mfu_transformer"] = round(mfu, 4)
+        flush_partial()
     dense = attempts(bench_transformer_dense, "dense-mfu bench", n=1)
     if dense:
         _, mfu = max(dense)
         out["mfu_dense"] = round(mfu, 4)
+        flush_partial()
     dec = attempts(bench_decode, "decode bench", n=1)
     if dec:
         out["decode_tokens_per_sec"] = round(max(dec), 1)
+        flush_partial()
     lat = attempts(lambda: bench_decode(batch=1), "decode latency bench",
                    n=1)
     if lat:
         # Single-stream serving latency: ms per generated token at B=1.
         out["decode_latency_ms_per_token"] = round(1000.0 / max(lat), 3)
+        flush_partial()
     dec8 = attempts(lambda: bench_decode(quantized=True),
                     "int8 decode bench", n=1)
     if dec8:
         out["decode_int8_tokens_per_sec"] = round(max(dec8), 1)
+        flush_partial()
     dec8kv = attempts(
         lambda: bench_decode(quantized=True, quantized_cache=True,
                              prompt_len=1024, new_tokens=128),
@@ -638,6 +667,7 @@ def main():
         # Long-prompt config: at 1k+ cached positions the cache bytes rival
         # the weights', which is where the int8 KV cache earns its keep.
         out["decode_int8_kv_tokens_per_sec"] = round(max(dec8kv), 1)
+        flush_partial()
     longctx = attempts(bench_decode_long_context, "long-context decode bench",
                        n=1)
     if longctx:
@@ -646,12 +676,20 @@ def main():
         out["decode_longctx_einsum_tokens_per_sec"] = round(einsum_tok, 1)
         out["decode_longctx_kernel_speedup"] = round(
             kern_tok / einsum_tok, 3)
+        flush_partial()
     attn = attempts(bench_attention, "attention kernel bench", n=1)
     if attn:
         flash_ms, xla_ms = attn[0]
         out["flash_attn_fwdbwd_ms"] = round(flash_ms, 3)
         out["xla_attn_fwdbwd_ms"] = round(xla_ms, 3)
         out["flash_attn_speedup"] = round(xla_ms / flash_ms, 3)
+        flush_partial()
+    blocks = attempts(bench_attention_blocks, "attention block sweep", n=1)
+    if blocks:
+        # Settles the round-2 block_q question (BASELINE.md:95-99) with a
+        # recorded per-block number instead of an unconfirmed default bump.
+        out["flash_attn_block_sweep_ms"] = blocks[0]
+        flush_partial()
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:
         out.update(bw[0])
