@@ -404,6 +404,35 @@ def bench_attention_blocks(b=4, t=2048, h=8, d=128, reps=10):
     return {"bq512": timed(512), "bq1024": timed(1024)}
 
 
+def bench_serving_continuous(n_requests=32, rows=8):
+    """Continuous-batching serving throughput: requests/s for a prompt
+    stream admitted into a persistent paged decode
+    (serving.ContinuousBatcher), flagship config."""
+    import jax
+    import jax.numpy as jnp
+    from tfmesos_tpu.models import transformer
+    from tfmesos_tpu.serving import ContinuousBatcher, Request
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+        max_seq_len=1024, dtype=jnp.bfloat16)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    def reqs(n):
+        return [Request(prompt=rng.integers(0, cfg.vocab_size, size=(64,))
+                        .astype(np.int32), max_new_tokens=64)
+                for _ in range(n)]
+
+    batcher = ContinuousBatcher(cfg, params, rows=rows, max_len=1024)
+    list(batcher.run(reqs(2)))  # warm the compiles outside the timed region
+    t0 = time.perf_counter()
+    done = list(batcher.run(reqs(n_requests)))
+    dt = time.perf_counter() - t0
+    assert len(done) == n_requests
+    return n_requests / dt
+
+
 def bench_bandwidth(sizes=None):
     """Achieved bandwidth vs roofline.
 
@@ -689,6 +718,10 @@ def main():
         # Settles the round-2 block_q question (BASELINE.md:95-99) with a
         # recorded per-block number instead of an unconfirmed default bump.
         out["flash_attn_block_sweep_ms"] = blocks[0]
+        flush_partial()
+    sv = attempts(bench_serving_continuous, "continuous serving bench", n=1)
+    if sv:
+        out["serving_requests_per_sec"] = round(sv[0], 2)
         flush_partial()
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:

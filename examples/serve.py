@@ -38,9 +38,17 @@ def main():
                    help="serve from a paged KV cache: one shared page "
                         "pool, per-batch page allocation/recycling "
                         "(docs/SERVING.md)")
+    p.add_argument("--continuous", action="store_true",
+                   help="continuous batching: admit prompts into a "
+                        "RUNNING paged decode as rows free up "
+                        "(serving.ContinuousBatcher; --batch sets the "
+                        "concurrent-row count)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tiny", action="store_true")
     args = p.parse_args()
+    if args.paged and args.continuous:
+        p.error("--paged and --continuous are distinct serving modes: "
+                "--continuous already serves from a paged pool (pick one)")
 
     import jax
     import jax.numpy as jnp
@@ -93,6 +101,33 @@ def main():
             rng=jax.random.PRNGKey(args.seed + 1),
             temperature=args.temperature, quantized_cache=args.int8_kv,
             stop_token=args.stop_token, cache=cache)
+
+    if args.continuous:
+        from tfmesos_tpu.serving import ContinuousBatcher, Request
+
+        reqs = [Request(prompt=np.asarray(t, np.int32),
+                        max_new_tokens=args.new_tokens,
+                        stop_token=args.stop_token) for t in prompts]
+        batcher = ContinuousBatcher(
+            cfg, params, rows=args.batch, page_size=64,
+            temperature=args.temperature,
+            rng=jax.random.PRNGKey(args.seed + 1),
+            quantized_cache=args.int8_kv)
+        sink = open(args.out, "w") if args.out else sys.stdout
+        served = 0
+        t0 = time.perf_counter()
+        for c in batcher.run(reqs):
+            sink.write(json.dumps({"rid": c.rid,
+                                   "prompt_len": int(c.request.prompt.size),
+                                   "tokens": c.tokens}) + "\n")
+            served += 1
+        dt = time.perf_counter() - t0
+        if sink is not sys.stdout:
+            sink.close()
+        print(f"served {served} prompts continuously in {dt:.2f}s "
+              f"(peak pages {batcher.peak_pages_used}/{batcher.n_pages})",
+              file=sys.stderr)
+        return 0
 
     alloc = pool = None
     if args.paged:

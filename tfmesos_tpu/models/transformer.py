@@ -658,14 +658,33 @@ class PageAllocator:
     def release(self, row: int) -> None:
         self.free.extend(reversed(self.rows.pop(row, [])))
 
-    def table(self, rows) -> "jnp.ndarray":
-        """[len(rows), NP] table (NP = longest row's page count; unused
-        entries point at page 0 — never fetched, the per-row block bound
-        stops first)."""
+    def reserve_page(self) -> int:
+        """Permanently take one page out of circulation and return its id
+        (serving uses this as a write sink for inactive decode rows)."""
+        if not self.free:
+            raise RuntimeError("page pool exhausted")
+        return self.free.pop()
+
+    def free_count(self) -> int:
+        return len(self.free)
+
+    def allocated(self, row: int) -> int:
+        """Pages currently backing ``row``."""
+        return len(self.rows.get(row, []))
+
+    def table(self, rows, width: Optional[int] = None,
+              fill: int = 0) -> "jnp.ndarray":
+        """[len(rows), NP] table.  NP defaults to the longest listed row's
+        page count; pass ``width`` to fix the shape (one compiled decode
+        shape for a whole serving run).  Unused entries hold ``fill`` —
+        never FETCHED (the per-row block bound stops first), but batched
+        decode steps WRITE one position per row each step, so continuous
+        serving points them at a reserved sink page."""
         np = self._np
         lists = [self.rows.get(r, []) for r in rows]
-        width = max(1, max((len(p) for p in lists), default=1))
-        t = np.zeros((len(lists), width), np.int32)
+        if width is None:
+            width = max(1, max((len(p) for p in lists), default=1))
+        t = np.full((len(lists), width), fill, np.int32)
         for i, pages in enumerate(lists):
             t[i, :len(pages)] = pages
         return jnp.asarray(t)

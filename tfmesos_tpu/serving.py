@@ -1,0 +1,344 @@
+"""Continuous batching over the paged KV cache.
+
+The reference framework stops at training jobs (its serving story is
+"run a session somewhere"); this module is the inference-side scheduler
+the paged cache layout exists for: a persistent page pool plus an
+admission loop that feeds new prompts into a RUNNING batched decode —
+rows free on stop-token, arrivals prefill into freed rows, and
+:class:`~tfmesos_tpu.models.transformer.PageAllocator` state persists
+across the whole stream (docs/SERVING.md).  Offline batch serving
+(``examples/serve.py`` without ``--continuous``) allocates and releases
+pages per closed batch; this loop keeps the decode step hot and bounds
+memory by LIVE tokens, not by batch-max shapes.
+
+Determinism contract: a request's tokens depend only on (its prompt,
+its ``rid``-folded sampling key) — never on what else is in flight.
+Greedy streams are bit-identical to a per-request
+:func:`~tfmesos_tpu.models.transformer.generate` call; sampled streams
+are invariant to batching/staggering because every row draws from its
+own fold of the batcher RNG (``fold_in(rng, rid)`` then per-step
+``fold_in(key, step)``), not from a shared stream.  The folds happen
+IN-GRAPH from ``rid``/``step`` vectors, so the host loop issues no
+per-row dispatches.
+
+Two compiled shapes serve everything: one decode step at ``[rows, 1]``
+with a fixed-width page table, and one prefill per prompt-length bucket
+(lengths round up to ``prefill_bucket``).  Admission reserves each
+request's WORST-CASE page count against the pool up front, while the
+allocator backs pages incrementally as the row grows — so memory use is
+length-proportional but mid-flight pool exhaustion is impossible by
+construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
+                                            decode_step, init_paged_cache,
+                                            sample_logits)
+
+__all__ = ["Request", "Completion", "ContinuousBatcher"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: ``prompt`` is a 1-D int32 token array."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    stop_token: Optional[int] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32)
+        if self.prompt.ndim != 1 or self.prompt.size == 0:
+            raise ValueError("Request.prompt must be a non-empty 1-D "
+                             "token array (there is no position to "
+                             "continue from otherwise)")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"Request.max_new_tokens must be >= 1, got "
+                             f"{self.max_new_tokens}")
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request: ``tokens`` are the generated continuation
+    (including the stop token when one was emitted), ``rid`` the
+    admission-order id the batcher assigned."""
+
+    rid: int
+    request: Request
+    tokens: List[int]
+
+
+@dataclasses.dataclass
+class _Row:
+    """Host-side state of one in-flight row."""
+
+    rid: int
+    req: Request
+    pos: int            # next cache position to write (= current length)
+    step: int           # tokens generated so far
+    last: int           # last emitted token (feeds the next decode step)
+    out: List[int]
+    worst_pages: int    # admission-time reservation
+
+
+class ContinuousBatcher:
+    """Admit a stream of :class:`Request`\\ s into a persistent paged
+    decode of ``rows`` concurrent sequences.
+
+    ``n_pages`` sizes the shared pool (default: fully backs
+    ``rows x max_len``; smaller pools oversubscribe and admission waits
+    for pages instead).  ``temperature``/``top_k``/``top_p`` fix the
+    sampling config for the whole batcher (greedy at temperature 0);
+    ``rng`` takes either key flavor (raw uint32 pair or typed
+    ``jax.random.key``) — it is only ever folded in-graph.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params, rows: int = 8,
+                 max_len: Optional[int] = None, page_size: int = 64,
+                 n_pages: Optional[int] = None, prefill_bucket: int = 64,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, rng=None,
+                 quantized_cache: bool = False):
+        if rows < 1:
+            raise ValueError(f"rows must be >= 1, got {rows}")
+        self.cfg = cfg
+        self.params = params
+        self.rows = rows
+        self.max_len = int(max_len or cfg.max_seq_len)
+        if self.max_len > cfg.max_seq_len:
+            raise ValueError(f"max_len ({self.max_len}) exceeds the "
+                             f"config's max_seq_len ({cfg.max_seq_len})")
+        self.page_size = int(page_size)
+        self.np_max = -(-self.max_len // self.page_size)
+        self.n_pages = int(n_pages or rows * self.np_max)
+        self.prefill_bucket = int(prefill_bucket)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self._rng = jax.random.PRNGKey(0) if rng is None else rng
+        self.alloc = PageAllocator(self.n_pages, self.page_size)
+        # Inactive decode rows still execute the batched paged scatter —
+        # their table entries must point somewhere writable that no live
+        # request owns.  Reserve one pool page as that sink.
+        self._sink_page = self.alloc.reserve_page()
+        self.pool = init_paged_cache(cfg, self.n_pages, self.page_size,
+                                     quantized=quantized_cache)
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode = self._make_decode()
+        self._next_rid = 0
+        self._table_cache = None        # device table; rebuilt when dirty
+        self.peak_pages_used = 0        # observability: high-water mark
+
+    # -- compiled shapes --------------------------------------------------
+
+    def _sample(self, last, rids, steps):
+        """[n, V] logits -> [n] int32 tokens; sampling keys are folded
+        in-graph per (rid, step) so the host loop never dispatches
+        per-row fold_ins and either PRNG key flavor works."""
+        if self.temperature <= 0.0:
+            return jnp.argmax(last.astype(jnp.float32), axis=-1).astype(
+                jnp.int32)
+
+        def one(l, r, s):
+            key = jax.random.fold_in(jax.random.fold_in(self._rng, r), s)
+            return sample_logits(l, key, self.temperature, self.top_k,
+                                 self.top_p)
+
+        return jax.vmap(one)(last, rids, steps)
+
+    def _make_decode(self):
+        @partial(jax.jit, donate_argnums=1)
+        def fn(params, pool, table, toks, positions, rids, steps):
+            cache = dict(pool, pages=table)
+            logits, cache = decode_step(self.cfg, params, cache,
+                                        toks[:, None], positions)
+            nxt = self._sample(logits[:, -1], rids, steps)
+            return {"k": cache["k"], "v": cache["v"]}, nxt
+
+        return fn
+
+    def _prefill_fn(self, width: int):
+        """Jitted single-row prefill at one padded-width bucket."""
+        if width not in self._prefill_fns:
+            @partial(jax.jit, donate_argnums=1)
+            def fn(params, pool, table, prompt, length, rid):
+                cache = dict(pool, pages=table)
+                logits, cache = decode_step(self.cfg, params, cache, prompt,
+                                            0)
+                last = jnp.take_along_axis(
+                    logits, (length - 1)[:, None, None], axis=1)[:, 0]
+                nxt = self._sample(last, rid, jnp.zeros_like(rid))
+                return {"k": cache["k"], "v": cache["v"]}, nxt[0]
+
+            self._prefill_fns[width] = fn
+        return self._prefill_fns[width]
+
+    # -- host-side bookkeeping --------------------------------------------
+
+    def _worst_pages(self, req: Request) -> int:
+        width = -(-req.prompt.size // self.prefill_bucket) * \
+            self.prefill_bucket
+        need_len = max(width, req.prompt.size + req.max_new_tokens - 1)
+        if need_len > self.max_len:
+            raise ValueError(
+                f"request needs {need_len} cache positions (prompt "
+                f"{req.prompt.size} padded to {width}, plus "
+                f"{req.max_new_tokens} new tokens) > max_len "
+                f"({self.max_len})")
+        return -(-need_len // self.page_size)
+
+    def _reserve_headroom(self, active: Dict[int, _Row]) -> int:
+        """Free pages not spoken for by in-flight rows' reservations."""
+        outstanding = sum(row.worst_pages - self.alloc.allocated(r)
+                          for r, row in active.items())
+        return self.alloc.free_count() - outstanding
+
+    def _ensure(self, row: int, length: int) -> None:
+        before = self.alloc.allocated(row)
+        self.alloc.ensure(row, length)
+        if self.alloc.allocated(row) != before:
+            self._table_cache = None
+        used = self.n_pages - self.alloc.free_count()
+        if used > self.peak_pages_used:
+            self.peak_pages_used = used
+
+    def _release(self, row: int) -> None:
+        self.alloc.release(row)
+        self._table_cache = None
+
+    def _table(self) -> jnp.ndarray:
+        """Fixed-shape [rows, np_max] device table, rebuilt only when the
+        allocation actually changed (page-boundary growth, admission,
+        release) — not every token."""
+        if self._table_cache is None:
+            self._table_cache = self.alloc.table(
+                range(self.rows), width=self.np_max, fill=self._sink_page)
+        return self._table_cache
+
+    # -- the loop ---------------------------------------------------------
+
+    def run(self, requests: Iterable[Request]) -> Iterator[Completion]:
+        """Serve ``requests`` (any iterable — a generator staggers
+        arrivals naturally), yielding :class:`Completion`\\ s in FINISH
+        order.  Pulls from the iterable lazily: a request is consumed
+        only when a row and pages are available for it.  Abandoning the
+        iterator early releases every in-flight row's pages."""
+        source = iter(requests)
+        pending: deque = deque()
+        active: Dict[int, _Row] = {}
+        free_rows = list(range(self.rows))
+        exhausted = False
+
+        def pull():
+            nonlocal exhausted
+            if not pending and not exhausted:
+                try:
+                    pending.append(next(source))
+                except StopIteration:
+                    exhausted = True
+
+        try:
+            while True:
+                # Admit while a row is free and the pool can take the
+                # newcomer's worst case.
+                while free_rows:
+                    pull()
+                    if not pending:
+                        break
+                    worst = self._worst_pages(pending[0])
+                    if worst > self._reserve_headroom(active):
+                        if not active:
+                            raise RuntimeError(
+                                f"request needs {worst} pages but the pool "
+                                f"only has {self.alloc.free_count()} free "
+                                f"({self.n_pages} total) — raise n_pages")
+                        break   # wait for an in-flight row to finish
+                    req = pending.popleft()
+                    rid = self._next_rid
+                    self._next_rid += 1
+                    row = free_rows.pop()
+                    done = self._admit(row, rid, req, active)
+                    if done is not None:
+                        self._finish(row, active, free_rows)
+                        yield done
+                if not active:
+                    pull()
+                    if not pending and exhausted:
+                        return
+                    continue
+                yield from self._step(active, free_rows)
+        finally:
+            # A consumer that stops early (break / close) must not leak
+            # the in-flight rows' pages.
+            for row in list(active):
+                self._finish(row, active, free_rows)
+
+    def _admit(self, row: int, rid: int, req: Request,
+               active: Dict[int, _Row]) -> Optional[Completion]:
+        """Prefill ``req`` into ``row``; returns a Completion when the
+        very first token already finishes the request."""
+        length = req.prompt.size
+        width = -(-length // self.prefill_bucket) * self.prefill_bucket
+        worst = self._worst_pages(req)
+        self._ensure(row, width)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :length] = req.prompt
+        self.pool, tok = self._prefill_fn(width)(
+            self.params, self.pool, self._table()[row:row + 1],
+            jnp.asarray(padded), jnp.asarray([length], jnp.int32),
+            jnp.asarray([rid], jnp.int32))
+        tok = int(tok)
+        state = _Row(rid=rid, req=req, pos=length, step=1, last=tok,
+                     out=[tok], worst_pages=worst)
+        active[row] = state
+        if tok == req.stop_token or req.max_new_tokens == 1:
+            return Completion(rid=rid, request=req, tokens=list(state.out))
+        return None
+
+    def _step(self, active: Dict[int, _Row],
+              free_rows: List[int]) -> Iterator[Completion]:
+        """One batched decode step over every active row."""
+        toks = np.zeros((self.rows,), np.int32)
+        positions = np.zeros((self.rows,), np.int32)
+        rids = np.zeros((self.rows,), np.int32)
+        steps = np.zeros((self.rows,), np.int32)
+        for r, row in active.items():
+            self._ensure(r, row.pos + 1)    # this step writes `pos`
+            toks[r] = row.last
+            positions[r] = row.pos
+            rids[r] = row.rid
+            steps[r] = row.step
+        self.pool, nxt = self._decode(
+            self.params, self.pool, self._table(), jnp.asarray(toks),
+            jnp.asarray(positions), jnp.asarray(rids), jnp.asarray(steps))
+        nxt = np.asarray(nxt)
+        for r in list(active):
+            row = active[r]
+            tok = int(nxt[r])
+            row.out.append(tok)
+            row.step += 1
+            row.pos += 1
+            row.last = tok
+            if tok == row.req.stop_token or row.step >= \
+                    row.req.max_new_tokens:
+                done = Completion(rid=row.rid, request=row.req,
+                                  tokens=list(row.out))
+                self._finish(r, active, free_rows)
+                yield done
+
+    def _finish(self, row: int, active: Dict[int, _Row],
+                free_rows: List[int]) -> None:
+        active.pop(row, None)
+        self._release(row)
+        free_rows.append(row)
