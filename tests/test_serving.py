@@ -107,6 +107,9 @@ def test_pool_occupancy_bounded_and_recycled(setup):
             for p in _prompts(cfg, 12, seed=7)]
     batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
                                 page_size=16, prefill_bucket=16)
+    # Default pool backs rows x max_len of LIVE data — the sink page is
+    # extra, so worst-case requests on every row still run concurrently.
+    assert batcher.n_pages == 2 * batcher.np_max + 1
     n_done = sum(1 for _ in batcher.run(reqs))
     assert n_done == len(reqs)
     # All pages returned to the pool (only the sink page stays reserved).

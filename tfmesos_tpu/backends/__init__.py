@@ -76,6 +76,7 @@ def first_fit(tasks, offer: Offer) -> List:
         if task.fits(offer):
             task.take_from(offer)
             task.offered = True
+            task.offer_id = offer.id
             task.agent_id = offer.agent_id
             task.hostname = offer.hostname
             placed.append(task)

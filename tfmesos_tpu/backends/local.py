@@ -99,8 +99,23 @@ class LocalBackend(ResourceBackend):
             with self._lock:
                 for i in range(3):
                     self._in_use[i] += used[i]
-            proc = subprocess.Popen(argv, shell=bool(info["command"].get("shell")),
-                                    env=env, start_new_session=True)
+            try:
+                proc = subprocess.Popen(argv,
+                                        shell=bool(info["command"].get("shell")),
+                                        env=env, start_new_session=True)
+            except OSError as e:
+                # A spawn failure (bad interpreter, ENOENT, EMFILE...) must
+                # feed the failure policy, not vanish into a log line with
+                # the task stuck offered=True until start_timeout.
+                with self._lock:
+                    for i in range(3):
+                        self._in_use[i] -= used[i]
+                self.log.warning("local launch of %s failed: %s",
+                                 task_id[:8], e)
+                self._scheduler.on_status(TaskStatus(
+                    task_id, "TASK_DROPPED", message=f"launch failed: {e}",
+                    agent_id="local"))
+                continue
             self._procs[task_id] = proc
             self.log.info("launched local task %s pid=%d", task_id[:8], proc.pid)
             self._scheduler.on_status(TaskStatus(task_id, "TASK_RUNNING",

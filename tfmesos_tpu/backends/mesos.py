@@ -283,14 +283,27 @@ class MesosBackend(ResourceBackend):
         elif etype == "ERROR":
             self._scheduler.on_error(event.get("error", {}).get("message",
                                                                 "unknown"))
-        elif etype in ("HEARTBEAT", "RESCIND"):
+        elif etype == "RESCIND":
+            # An outstanding offer was withdrawn.  If tasks were placed on
+            # it and their launch never confirmed, the scheduler synthesizes
+            # terminal statuses so the two-phase policy revives them instead
+            # of idling until start_timeout (the reference ignored rescinds,
+            # scheduler.py: no offerRescinded handler — a stale-offer launch
+            # on a busy cluster would hang its bring-up).
+            offer_id = event.get("rescind", {}).get("offer_id", {}).get(
+                "value")
+            if offer_id:
+                self._scheduler.on_rescind(offer_id)
+        elif etype == "HEARTBEAT":
             pass
         else:
             self.log.debug("ignoring event %s", etype)
 
     # -- calls -------------------------------------------------------------
 
-    def _call(self, body: Dict[str, Any]) -> None:
+    def _call(self, body: Dict[str, Any]) -> int:
+        """POST one scheduler call; returns the HTTP status (2xx = the
+        master took it)."""
         body = dict(body)
         if self.framework_id:
             body["framework_id"] = {"value": self.framework_id}
@@ -306,21 +319,40 @@ class MesosBackend(ResourceBackend):
             if resp.status not in (200, 202):
                 self.log.warning("call %s failed: HTTP %d %r",
                                  body.get("type"), resp.status, data[:200])
+            return resp.status
         finally:
             conn.close()
 
     def launch(self, offer: Offer, task_infos: Sequence[dict]) -> None:
-        self._call({
-            "type": "ACCEPT",
-            "accept": {
-                "offer_ids": [{"value": offer.id}],
-                "operations": [{
-                    "type": "LAUNCH",
-                    "launch": {"task_infos": list(task_infos)},
-                }],
-                "filters": {"refuse_seconds": 5.0},
-            },
-        })
+        # A rejected or unreachable ACCEPT must not leave the placed tasks
+        # in offered=True limbo (they would idle until start_timeout):
+        # synthesize a terminal status per task so on_status routes them
+        # through the normal two-phase revive/abort policy.
+        task_ids = [info["task_id"]["value"] for info in task_infos]
+        try:
+            status = self._call({
+                "type": "ACCEPT",
+                "accept": {
+                    "offer_ids": [{"value": offer.id}],
+                    "operations": [{
+                        "type": "LAUNCH",
+                        "launch": {"task_infos": list(task_infos)},
+                    }],
+                    "filters": {"refuse_seconds": 5.0},
+                },
+            })
+        except Exception as e:
+            self._drop_launch(task_ids, f"ACCEPT failed: {e}")
+            return
+        if status not in (200, 202):
+            self._drop_launch(task_ids, f"ACCEPT rejected: HTTP {status}")
+
+    def _drop_launch(self, task_ids: List[str], why: str) -> None:
+        self.log.warning("launch of %d task(s) failed (%s); reporting "
+                         "TASK_DROPPED", len(task_ids), why)
+        for tid in task_ids:
+            self._scheduler.on_status(TaskStatus(tid, "TASK_DROPPED",
+                                                 message=why))
 
     def decline(self, offer: Offer, refuse_seconds: float = 5.0) -> None:
         self._call({

@@ -169,41 +169,49 @@ class TPUMesosScheduler:
                           self.containerizer_type, version)
 
     def on_offers(self, offers: List[Offer]) -> None:
-        """Offer matching (reference resourceOffers, scheduler.py:223-277)."""
+        """Offer matching (reference resourceOffers, scheduler.py:223-277).
+
+        State decisions and TaskInfo rendering happen under ``_lock``;
+        the backend calls they produce (HTTP POSTs on Mesos, up to 30s
+        each) run OUTSIDE it, so a slow master never stalls ``on_status``
+        processing on the subscribe thread.
+        """
+        to_decline: List[tuple] = []        # (offer, refuse_seconds)
+        to_launch: List[tuple] = []         # (offer, infos, placed)
+        suppress = False
         with self._lock:
             if self._fatal or self._stopped:
+                to_decline = [(o, 5.0) for o in offers]
+            elif all(task.offered for task in self.tasks):
+                suppress = True
+                to_decline = [(o, FOREVER) for o in offers]
+            elif self.gang_scheduling and not self._gang_fits(offers):
+                # TPU slice atomicity: refuse partial placement; short
+                # refusal so re-offers accumulate into a big enough batch.
+                to_decline = [(o, 1.0) for o in offers]
+            else:
                 for offer in offers:
-                    self.backend.decline(offer)
-                return
-            if all(task.offered for task in self.tasks):
-                self.backend.suppress()
-                for offer in offers:
-                    self.backend.decline(offer, refuse_seconds=FOREVER)
-                return
-
-            if self.gang_scheduling and not self._gang_fits(offers):
-                # TPU slice atomicity: refuse partial placement; short refusal
-                # so re-offers accumulate into a big enough batch.
-                for offer in offers:
-                    self.backend.decline(offer, refuse_seconds=1.0)
-                return
-
-            for offer in offers:
-                placed = first_fit(self.tasks, offer)
-                if not placed:
-                    self.backend.decline(offer)
-                    continue
-                infos = [t.to_task_info(offer, self.addr, self.token,
-                                        containerizer_type=self.containerizer_type,
-                                        force_pull_image=self.force_pull_image,
-                                        env=self.env,
-                                        token_file=self._token_file,
-                                        secret_token=(self.token_transport
-                                                      == "secret"))
-                         for t in placed]
-                self.log.info("launching %d task(s) on %s: %s",
-                              len(placed), offer.hostname, placed)
-                self.backend.launch(offer, infos)
+                    placed = first_fit(self.tasks, offer)
+                    if not placed:
+                        to_decline.append((offer, 5.0))
+                        continue
+                    infos = [t.to_task_info(offer, self.addr, self.token,
+                                            containerizer_type=self.containerizer_type,
+                                            force_pull_image=self.force_pull_image,
+                                            env=self.env,
+                                            token_file=self._token_file,
+                                            secret_token=(self.token_transport
+                                                          == "secret"))
+                             for t in placed]
+                    to_launch.append((offer, infos, placed))
+        if suppress:
+            self.backend.suppress()
+        for offer, refuse_seconds in to_decline:
+            self.backend.decline(offer, refuse_seconds=refuse_seconds)
+        for offer, infos, placed in to_launch:
+            self.log.info("launching %d task(s) on %s: %s",
+                          len(placed), offer.hostname, placed)
+            self.backend.launch(offer, infos)
 
     def _gang_fits(self, offers: List[Offer]) -> bool:
         """Would the *entire* remaining task set fit across this offer batch?"""
@@ -224,8 +232,11 @@ class TPUMesosScheduler:
     def on_status(self, status: TaskStatus) -> None:
         """Two-phase failure policy (reference statusUpdate,
         scheduler.py:384-420)."""
+        # The ack and revive are HTTP POSTs on Mesos — keep them outside
+        # the lock (a slow master must not stall other status processing).
+        self.backend.acknowledge(status)
+        revive = False
         with self._lock:
-            self.backend.acknowledge(status)
             task = self._find_task(status.task_id)
             if task is None:
                 if status.terminal and status.state != "TASK_FINISHED":
@@ -234,6 +245,7 @@ class TPUMesosScheduler:
                     self.log.info("status for unknown task %s: %s",
                                   status.task_id, status.state)
                 return
+            task.last_state = status.state
             if not status.terminal:
                 return
             if status.state == "TASK_FINISHED":
@@ -242,38 +254,69 @@ class TPUMesosScheduler:
                 self.log.info("task finished: %s (%d done in job %s)",
                               task, self.job_finished[task.job_name], task.job_name)
                 return
-            if self.started or self._broadcasting:
+            elif self.started or self._broadcasting:
                 # Post-start (or mid-broadcast, when peers may already be
                 # acting on their config): fail fast, whole-cluster abort
                 # (reference: scheduler.py:394-401).
                 self._set_fatal(f"task {task} terminated after cluster start: "
                                 f"{status.state} {status.message}")
-                return
-            # Pre-start: revive with a fresh uuid up to MAX_FAILURE_COUNT
-            # (reference: scheduler.py:404-434).
-            key = f"{task.job_name}:{task.task_index}"
-            self.task_failure_count[key] = self.task_failure_count.get(key, 0) + 1
-            if self.task_failure_count[key] >= MAX_FAILURE_COUNT:
-                self._set_fatal(f"task {task} failed {MAX_FAILURE_COUNT} times "
-                                f"during bring-up: {status.state} {status.message}")
-                return
-            self.log.warning("reviving task %s after %s (%s), attempt %d",
-                             task, status.state, status.message,
-                             self.task_failure_count[key] + 1)
-            task.reset()
+            else:
+                # Pre-start: revive with a fresh uuid up to MAX_FAILURE_COUNT
+                # (reference: scheduler.py:404-434).
+                key = f"{task.job_name}:{task.task_index}"
+                self.task_failure_count[key] = \
+                    self.task_failure_count.get(key, 0) + 1
+                if self.task_failure_count[key] >= MAX_FAILURE_COUNT:
+                    self._set_fatal(
+                        f"task {task} failed {MAX_FAILURE_COUNT} times "
+                        f"during bring-up: {status.state} {status.message}")
+                else:
+                    self.log.warning("reviving task %s after %s (%s), "
+                                     "attempt %d", task, status.state,
+                                     status.message,
+                                     self.task_failure_count[key] + 1)
+                    task.reset()
+                    revive = True
+        if revive:
             self.backend.revive()
+
+    def on_rescind(self, offer_id: str) -> None:
+        """An outstanding offer was withdrawn by the master.  Tasks placed
+        on it whose launch never confirmed (no TASK_RUNNING seen) are
+        synthesized TASK_DROPPED so the two-phase policy revives them —
+        without this they would sit offered=True until ``start_timeout``.
+        The reference ignored rescinds entirely (no offerRescinded
+        handler); on a busy cluster a stale-offer launch then hung
+        bring-up."""
+        to_drop: List[str] = []
+        with self._lock:
+            for task in self.tasks:
+                if (task.offer_id == offer_id and task.offered
+                        and not task.initialized
+                        and task.last_state != "TASK_RUNNING"):
+                    to_drop.append(task.id)
+        for tid in to_drop:
+            # The ACCEPT may have raced the rescind server-side; a KILL for
+            # a task that never launched is a no-op, and one that did
+            # launch must die anyway (its id is about to go stale).
+            self.backend.kill(tid)
+            self.on_status(TaskStatus(
+                tid, "TASK_DROPPED",
+                message=f"offer {offer_id} rescinded before launch "
+                        f"confirmed"))
 
     def on_agent_lost(self, agent_id: str) -> None:
         """Reference slaveLost/executorLost (scheduler.py:445-453)."""
         with self._lock:
             if self.started:
                 self._set_fatal(f"agent lost: {agent_id}")
-            else:
-                for task in self.tasks:
-                    if task.agent_id == agent_id and not task.initialized:
-                        self.on_status(TaskStatus(task.id, "TASK_LOST",
-                                                  message="agent lost",
-                                                  agent_id=agent_id))
+                return
+            lost = [task.id for task in self.tasks
+                    if task.agent_id == agent_id and not task.initialized]
+        for tid in lost:
+            self.on_status(TaskStatus(tid, "TASK_LOST",
+                                      message="agent lost",
+                                      agent_id=agent_id))
 
     def on_error(self, message: str) -> None:
         self._set_fatal(f"backend error: {message}")

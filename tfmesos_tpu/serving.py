@@ -121,7 +121,9 @@ class ContinuousBatcher:
                              f"config's max_seq_len ({cfg.max_seq_len})")
         self.page_size = int(page_size)
         self.np_max = -(-self.max_len // self.page_size)
-        self.n_pages = int(n_pages or rows * self.np_max)
+        # +1: one page is reserved as the inactive-row write sink below,
+        # so the default still fully backs rows x max_len of live data.
+        self.n_pages = int(n_pages or rows * self.np_max + 1)
         self.prefill_bucket = int(prefill_bucket)
         self.temperature = temperature
         self.top_k = top_k
@@ -268,7 +270,7 @@ class ContinuousBatcher:
                     rid = self._next_rid
                     self._next_rid += 1
                     row = free_rows.pop()
-                    done = self._admit(row, rid, req, active)
+                    done = self._admit(row, rid, req, worst, active)
                     if done is not None:
                         self._finish(row, active, free_rows)
                         yield done
@@ -284,13 +286,13 @@ class ContinuousBatcher:
             for row in list(active):
                 self._finish(row, active, free_rows)
 
-    def _admit(self, row: int, rid: int, req: Request,
+    def _admit(self, row: int, rid: int, req: Request, worst: int,
                active: Dict[int, _Row]) -> Optional[Completion]:
-        """Prefill ``req`` into ``row``; returns a Completion when the
-        very first token already finishes the request."""
+        """Prefill ``req`` into ``row``; ``worst`` is the page reservation
+        run() admitted it under.  Returns a Completion when the very
+        first token already finishes the request."""
         length = req.prompt.size
         width = -(-length // self.prefill_bucket) * self.prefill_bucket
-        worst = self._worst_pages(req)
         self._ensure(row, width)
         padded = np.zeros((1, width), np.int32)
         padded[0, :length] = req.prompt

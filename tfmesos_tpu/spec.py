@@ -134,6 +134,8 @@ class Task:
 
         self.id: str = str(uuid.uuid4())
         self.offered: bool = False
+        self.offer_id: Optional[str] = None    # offer this attempt was placed on
+        self.last_state: Optional[str] = None  # latest backend status state
         self.agent_id: Optional[str] = None
         self.hostname: Optional[str] = None
         self.addr: Optional[str] = None        # task's control addr, set at registration
@@ -149,6 +151,8 @@ class Task:
         """Revive with a fresh identity (reference: scheduler.py:422-430)."""
         self.id = str(uuid.uuid4())
         self.offered = False
+        self.offer_id = None
+        self.last_state = None
         self.agent_id = None
         self.hostname = None
         self.addr = None
