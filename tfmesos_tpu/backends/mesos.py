@@ -351,8 +351,15 @@ class MesosBackend(ResourceBackend):
         self.log.warning("launch of %d task(s) failed (%s); reporting "
                          "TASK_DROPPED", len(task_ids), why)
         for tid in task_ids:
-            self._scheduler.on_status(TaskStatus(tid, "TASK_DROPPED",
-                                                 message=why))
+            try:
+                self._scheduler.on_status(TaskStatus(tid, "TASK_DROPPED",
+                                                     message=why))
+            except Exception as e:
+                # on_status's follow-up REVIVE can hit the same unreachable
+                # master; EVERY task must still get its drop (or the rest
+                # stay in the offered=True limbo this path exists to clear).
+                self.log.warning("drop of %s partially failed: %s",
+                                 tid[:8], e)
 
     def decline(self, offer: Offer, refuse_seconds: float = 5.0) -> None:
         self._call({

@@ -114,8 +114,13 @@ def _slice_groups(devices, num_slices: Optional[int]):
         return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
 
     def slice_id(d):
+        # CPU devices may advertise slice_index (always 0 — there is no
+        # ICI), which would collapse a multi-process CPU runtime into one
+        # "slice"; the process boundary is the meaningful domain there.
         v = getattr(d, "slice_index", None)
-        return d.process_index if v is None else v
+        if v is None or getattr(d, "platform", "") == "cpu":
+            return d.process_index
+        return v
 
     ids = sorted({slice_id(d) for d in devices})
     groups = [[d for d in devices if slice_id(d) == s] for s in ids]
@@ -156,6 +161,7 @@ def build_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
     groups = _slice_groups(devices, num_slices)
     dcn = MeshSpec(dict(dcn_axes))
     has_identity = any(getattr(d, "slice_index", None) is not None
+                       and getattr(d, "platform", "") != "cpu"
                        for d in devices)
     if num_slices is None and not has_identity and len(groups) == 1 \
             and dcn.size > 1 and len(devices) % dcn.size == 0:

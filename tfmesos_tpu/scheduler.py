@@ -131,6 +131,7 @@ class TPUMesosScheduler:
 
         self._lock = threading.RLock()
         self.started = False
+        self._registered_once = False
         self._broadcasting = False
         self._stopped = False
         self._fatal: Optional[str] = None
@@ -156,6 +157,18 @@ class TPUMesosScheduler:
 
     def on_registered(self, info: Dict[str, Any]) -> None:
         self.log.info("backend registered: %s", info)
+        with self._lock:
+            rejoin = self._registered_once
+            self._registered_once = True
+            unplaced = any(not t.offered for t in self.tasks)
+        if rejoin and unplaced:
+            # Re-subscription after a stream break: a REVIVE issued while
+            # the master was unreachable may have been lost, and FOREVER
+            # decline filters survive failover — re-open the offer tap.
+            try:
+                self.backend.revive()
+            except Exception as e:
+                self.log.warning("re-registration revive failed: %s", e)
         version = info.get("master_version")
         if self.containerizer_type is None and version:
             # Reference semantics (scheduler.py:378-382): Mesos >= 1.0 uses
@@ -278,7 +291,15 @@ class TPUMesosScheduler:
                     task.reset()
                     revive = True
         if revive:
-            self.backend.revive()
+            try:
+                self.backend.revive()
+            except Exception as e:
+                # Task state is already reset; a failed REVIVE POST (master
+                # unreachable) must not unwind the event thread.  The
+                # re-registration hook in on_registered re-issues it once
+                # the subscribe stream reconnects.
+                self.log.warning("revive call failed (will retry on "
+                                 "re-registration): %s", e)
 
     def on_rescind(self, offer_id: str) -> None:
         """An outstanding offer was withdrawn by the master.  Tasks placed
@@ -298,12 +319,18 @@ class TPUMesosScheduler:
         for tid in to_drop:
             # The ACCEPT may have raced the rescind server-side; a KILL for
             # a task that never launched is a no-op, and one that did
-            # launch must die anyway (its id is about to go stale).
-            self.backend.kill(tid)
-            self.on_status(TaskStatus(
-                tid, "TASK_DROPPED",
-                message=f"offer {offer_id} rescinded before launch "
-                        f"confirmed"))
+            # launch must die anyway (its id is about to go stale).  Each
+            # task's drop is independently guarded: one failed HTTP call
+            # must not strand the rest in offered=True limbo.
+            try:
+                self.backend.kill(tid)
+                self.on_status(TaskStatus(
+                    tid, "TASK_DROPPED",
+                    message=f"offer {offer_id} rescinded before launch "
+                            f"confirmed"))
+            except Exception as e:
+                self.log.warning("rescind drop of %s partially failed: %s",
+                                 tid[:8], e)
 
     def on_agent_lost(self, agent_id: str) -> None:
         """Reference slaveLost/executorLost (scheduler.py:445-453)."""
