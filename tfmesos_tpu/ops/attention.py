@@ -908,14 +908,14 @@ def attend(q, k, v, mesh=None, causal: bool = True,
     per-head, so GQA inputs are broadcast up for it here."""
     _check_gqa_heads(q, k, v)
     if mesh is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1:
-        if window is not None:
-            raise ValueError(
-                "sliding-window attention does not compose with sequence "
-                "parallelism yet; drop the sp axis or the window")
+        # Sliding windows compose with both sp paths: Ulysses attends the
+        # full sequence after its all-to-all (window passes through to the
+        # kernel), and the ring's owner-index arithmetic bounds the window
+        # exactly across shards (einsum inner).
         if sp_impl == "ulysses":
             from tfmesos_tpu.parallel.ulysses import ulysses_attention
             return ulysses_attention(q, k, v, mesh, causal=causal,
-                                     scale=scale)
+                                     scale=scale, window=window)
         if k.shape[2] != q.shape[2]:
             rep = q.shape[2] // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
@@ -924,7 +924,8 @@ def attend(q, k, v, mesh=None, causal: bool = True,
             raise ValueError(f"sp_impl must be 'ring' or 'ulysses', "
                              f"got {sp_impl!r}")
         from tfmesos_tpu.parallel.ring_attention import ring_attention
-        return ring_attention(q, k, v, mesh, causal=causal, scale=scale)
+        return ring_attention(q, k, v, mesh, causal=causal, scale=scale,
+                              window=window)
     if mesh is not None:
         return sharded_flash_attention(q, k, v, mesh, causal=causal,
                                        scale=scale, window=window, **kw)

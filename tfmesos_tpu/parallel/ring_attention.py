@@ -40,12 +40,16 @@ from tfmesos_tpu.parallel.sharding import data_axes
 
 
 def ring_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         window: Optional[int] = None):
     """The per-device body; call inside ``shard_map`` with ``axis`` in scope.
 
     Shapes (local): q/k/v ``[B, T/sp, H, D]``.  At ring step ``i`` this
     device holds the K/V shard originally owned by ``(my_index - i) mod sp``,
-    so global causal masking only needs the owner index.
+    so global causal masking only needs the owner index.  A sliding
+    ``window`` (causal only) tightens the same global-position mask: the
+    owner index gives every held key its global position, so the window
+    bound is exact across shards with no extra communication.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -66,7 +70,10 @@ def ring_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
         if causal:
             kpos = src * tk + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-            s = jnp.where((kpos > qpos)[None, None], float("-inf"), s)
+            bad = kpos > qpos
+            if window is not None:
+                bad = bad | (kpos < qpos - (window - 1))
+            s = jnp.where(bad[None, None], float("-inf"), s)
         blockmax = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, blockmax)
         # Fully-masked blocks leave m_new at -inf; subtract a finite proxy so
@@ -182,7 +189,7 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
                    scale: Optional[float] = None, impl: Optional[str] = None,
-                   interpret: bool = False):
+                   interpret: bool = False, window: Optional[int] = None):
     """Sharded entry point: q/k/v are global ``[B, T, H, D]`` arrays (or
     tracers under jit) with T sharded over ``axis``.
 
@@ -190,14 +197,37 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
     no (non-trivial) ``axis`` — so model code calls this unconditionally.
     ``impl=None`` auto-selects: Pallas-inner ring on TPU (or when
     ``interpret``), the einsum ring elsewhere.
+
+    ``window`` (causal only): sliding-window attention, exact across
+    shards — the owner-index arithmetic that bounds causal visibility
+    also bounds the window, per step.  Runs the einsum inner (the Mosaic
+    flash kernels have no cross-shard offset-window form, so
+    ``impl="flash"`` with a window is rejected).
     """
     if impl not in (None, "flash", "xla"):
         raise ValueError(f"impl must be None, 'flash', or 'xla'; got {impl!r}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if axis not in mesh.shape or mesh.shape[axis] == 1:
+        # Trivial-axis fallback: an ordinary single-device call, where the
+        # Pallas kernel handles windows natively (its q/k blocks share one
+        # global origin) — the offset-window limitation below is specific
+        # to the cross-shard ring inner.
         from tfmesos_tpu.ops.attention import flash_attention
         use_pallas = {None: None, "flash": True, "xla": False}[impl]
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               interpret=interpret, use_pallas=use_pallas)
+                               interpret=interpret, use_pallas=use_pallas,
+                               window=window)
+    if window is not None:
+        if impl == "flash":
+            raise ValueError(
+                "ring_attention(impl='flash') does not support a sliding "
+                "window (the Mosaic inner kernels have no offset-window "
+                "form); use impl='xla' or impl=None")
+        impl = "xla"
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     local_t = q.shape[1] // mesh.shape[axis]
@@ -218,7 +248,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
                                               float(scale), bool(interpret))
     else:
         body = lambda q_, k_, v_: ring_attention_local(
-            q_, k_, v_, axis=axis, causal=causal, scale=scale)
+            q_, k_, v_, axis=axis, causal=causal, scale=scale, window=window)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

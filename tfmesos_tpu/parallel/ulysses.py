@@ -39,14 +39,16 @@ from tfmesos_tpu.parallel.sharding import data_axes
 def ulysses_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
                             scale: Optional[float] = None,
                             interpret: bool = False,
-                            use_pallas: Optional[bool] = None):
+                            use_pallas: Optional[bool] = None,
+                            window: Optional[int] = None):
     """Per-device body (call inside ``shard_map`` with ``axis`` in scope).
 
     Local shapes ``[B, T/sp, H, D]`` in, same out.  ``all_to_all`` with
     ``tiled=True`` splits the head dim across the group and concatenates
     the gathered sequence shards — after the hop each device holds
     ``[B, T, H/sp, D]`` and attention is an ordinary single-device call
-    (the Pallas flash kernel on TPU).
+    (the Pallas flash kernel on TPU) — so a sliding ``window`` passes
+    straight through to it.
     """
     from tfmesos_tpu.ops.attention import flash_attention
 
@@ -67,7 +69,8 @@ def ulysses_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
         kv = jax.lax.all_to_all(jnp.stack((k, v)), axis, split_axis=3,
                                 concat_axis=2, tiled=True)
         o = flash_attention(qh, kv[0], kv[1], causal=causal, scale=scale,
-                            interpret=interpret, use_pallas=use_pallas)
+                            interpret=interpret, use_pallas=use_pallas,
+                            window=window)
         return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 
@@ -81,7 +84,8 @@ def ulysses_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
                              concat_axis=2, tiled=True)
     qh, kh, vh = qkv[0], qkv[1], qkv[2]
     o = flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                        interpret=interpret, use_pallas=use_pallas)
+                        interpret=interpret, use_pallas=use_pallas,
+                        window=window)
     return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
                               tiled=True)
 
@@ -89,7 +93,8 @@ def ulysses_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                       causal: bool = True, scale: Optional[float] = None,
                       interpret: bool = False,
-                      use_pallas: Optional[bool] = None):
+                      use_pallas: Optional[bool] = None,
+                      window: Optional[int] = None):
     """Sharded entry point: q/k/v are global ``[B, T, H, D]`` arrays with T
     sharded over ``axis``; falls back to plain flash/reference attention
     when the mesh has no (non-trivial) ``axis``."""
@@ -97,13 +102,14 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
 
     if axis not in mesh.shape or mesh.shape[axis] == 1:
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               interpret=interpret, use_pallas=use_pallas)
+                               interpret=interpret, use_pallas=use_pallas,
+                               window=window)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     spec = P(data_axes(mesh), axis, None, None)
     body = lambda q_, k_, v_: ulysses_attention_local(
         q_, k_, v_, axis=axis, causal=causal, scale=scale,
-        interpret=interpret, use_pallas=use_pallas)
+        interpret=interpret, use_pallas=use_pallas, window=window)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -319,18 +319,22 @@ class TPUMesosScheduler:
         for tid in to_drop:
             # The ACCEPT may have raced the rescind server-side; a KILL for
             # a task that never launched is a no-op, and one that did
-            # launch must die anyway (its id is about to go stale).  Each
-            # task's drop is independently guarded: one failed HTTP call
-            # must not strand the rest in offered=True limbo.
+            # launch must die anyway (its id is about to go stale).  kill
+            # and drop are guarded SEPARATELY: a failed kill POST must not
+            # skip the synthetic terminal status (the drop is what clears
+            # the offered=True limbo), and neither failure may strand the
+            # remaining rescinded tasks.
             try:
                 self.backend.kill(tid)
+            except Exception as e:
+                self.log.warning("rescind kill of %s failed: %s", tid[:8], e)
+            try:
                 self.on_status(TaskStatus(
                     tid, "TASK_DROPPED",
                     message=f"offer {offer_id} rescinded before launch "
                             f"confirmed"))
             except Exception as e:
-                self.log.warning("rescind drop of %s partially failed: %s",
-                                 tid[:8], e)
+                self.log.warning("rescind drop of %s failed: %s", tid[:8], e)
 
     def on_agent_lost(self, agent_id: str) -> None:
         """Reference slaveLost/executorLost (scheduler.py:445-453)."""
