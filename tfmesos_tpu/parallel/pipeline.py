@@ -6,13 +6,18 @@ stages whose parameters are *stacked* along a leading dim and sharded over
 one) — and microbatches flow through the ring with one ``ppermute`` hop per
 tick.  All devices run every tick (SPMD).
 
-Two schedules:
+Three schedules:
 
 * ``"gpipe"`` — fill/drain; bubble fraction (S−1)/(M+S−1).
 * ``"circular"`` — interleaved virtual stages: each device holds ``v``
   round-robin layer chunks and every microbatch laps the ring ``v`` times,
   shrinking the bubble to ≈(S−1)/(M·v) at the cost of v× more ppermute hops
   (tiny activations vs. the per-chunk matmuls they overlap with).
+* 1F1B — same bubble as gpipe but forward and backward interleaved in one
+  loop, bounding the live activation stash at S microbatch inputs instead
+  of M.  Lives in :func:`pipeline_train_1f1b` (a fused train-step entry
+  point) because autodiff of a forward-only schedule necessarily replays
+  all-forwards-then-all-backwards.
 
 Composes with dp/fsdp (activations stay sharded on their batch dims) AND
 with tp: the stage body runs inside the full-mesh ``shard_map``, so it may
@@ -45,6 +50,273 @@ def stage_sharding_tree(stacked_params: Any, mesh: Mesh, axis: str = "pp") -> An
     return jax.tree_util.tree_map(
         lambda p: NamedSharding(mesh, P(axis, *([None] * (p.ndim - 1)))),
         stacked_params)
+
+
+def _schedule_1f1b(n_stages: int, m: int):
+    """Greedy 1F1B timetable, computed at trace time (all sizes static).
+
+    Returns ``(kind, mb)`` int arrays of shape [T, S]: at tick t stage s
+    performs kind 0=idle / 1=forward / 2=backward on microbatch mb.  The
+    policy is the classic one: stage s keeps at most ``S - s`` microbatches
+    in flight (its warmup depth), then strictly alternates one-forward /
+    one-backward — same bubble as gpipe, peak activation stash S slots
+    instead of m.
+    """
+    import numpy as np
+
+    last = n_stages - 1
+    next_f = [0] * n_stages
+    next_b = [0] * n_stages
+    f_done = [[-1] * m for _ in range(n_stages)]
+    b_done = [[-1] * m for _ in range(n_stages)]
+    kinds, mbs = [], []
+    t = 0
+    while any(nb < m for nb in next_b):
+        # The last stage never runs a separate forward tick: its backward
+        # recomputes the chunk inside the loss vjp anyway, so a standalone
+        # forward would be discarded work.  Its "forward" is the ARRIVAL
+        # of the previous stage's output (immediate for a 1-stage
+        # pipeline, whose stage-0 input is always at hand).
+        while next_f[last] < m and (
+                last == 0 or 0 <= f_done[last - 1][next_f[last]] < t):
+            f_done[last][next_f[last]] = (
+                t if last == 0 else f_done[last - 1][next_f[last]] + 1)
+            next_f[last] += 1
+        krow, mrow = [], []
+        for s in range(n_stages):
+            i, j = next_b[s], next_f[s]
+            can_b = i < m and (
+                (s == last and 0 <= f_done[s][i] <= t)
+                or (s < last and 0 <= b_done[s + 1][i] < t))
+            can_f = s < last and j < m and (s == 0
+                                            or 0 <= f_done[s - 1][j] < t)
+            inflight = next_f[s] - next_b[s]
+            if can_b and (inflight >= n_stages - s or not can_f):
+                krow.append(2)
+                mrow.append(i)
+                b_done[s][i] = t
+                next_b[s] += 1
+            elif can_f and inflight < n_stages - s:
+                krow.append(1)
+                mrow.append(j)
+                f_done[s][j] = t
+                next_f[s] += 1
+            else:
+                krow.append(0)
+                mrow.append(0)
+        kinds.append(krow)
+        mbs.append(mrow)
+        t += 1
+        if t > 4 * (m + n_stages) + 8:   # safety: schedule must terminate
+            raise AssertionError("1f1b schedule did not converge")
+    return np.asarray(kinds, np.int32), np.asarray(mbs, np.int32)
+
+
+def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
+                        loss_fn: Callable[[Any, Any], Any],
+                        stacked_params: Any, x, targets, mesh: Mesh,
+                        axis: str = "pp",
+                        num_microbatches: Optional[int] = None,
+                        param_partition: Optional[Any] = None):
+    """One fused forward+backward pipeline pass on the 1F1B schedule.
+
+    ``pipeline_apply`` is forward-only — under ``jax.grad`` autodiff
+    replays its reverse, which is gpipe's all-forwards-then-all-backwards
+    with every microbatch's activations live.  1F1B's point is the
+    bounded stash, and that is only expressible with forward and backward
+    interleaved in ONE loop — hence a training-step entry point rather
+    than a ``schedule=`` flag.
+
+    ``stage_fn(chunk_params, h) -> h`` as in ``pipeline_apply`` (manual
+    non-pp collectives allowed); ``loss_fn(h_out, target_mb) -> scalar``
+    (a per-microbatch MEAN, so the microbatch average equals the full
+    batch loss).  Returns ``(loss, grads, dx)``: the mean loss, fp32
+    parameter gradients with the stacked params' structure and sharding,
+    and the gradient w.r.t. ``x`` (for an embedding layer upstream).
+    ``targets`` are constants — no cotangent flows to them.
+
+    Memory: backward recomputes its chunk from the stashed stage INPUT
+    (standard 1F1B remat), so each stage holds at most S microbatch
+    inputs — peak stash O(S), independent of the microbatch count m.
+    Each tick runs one chunk of work per device; idle bubble ticks match
+    gpipe's (S-1 fill + S-1 drain at the same m).
+    """
+    if axis not in mesh.shape:
+        raise ValueError(f"pipeline_train_1f1b: mesh {dict(mesh.shape)} has "
+                         f"no {axis!r} axis (a size-1 axis is fine)")
+    n_stages = mesh.shape[axis]
+    m = num_microbatches or max(n_stages, 1)
+    d_axis_names = data_axes(mesh) or ()
+    dp_size = 1
+    for a in d_axis_names:
+        dp_size *= mesh.shape[a]
+    if x.shape[0] % (m * dp_size):
+        raise ValueError(f"batch {x.shape[0]} not divisible into {m} "
+                         f"microbatches x {dp_size} data shards")
+    if targets.shape[0] != x.shape[0]:
+        raise ValueError(f"targets batch {targets.shape[0]} != x batch "
+                         f"{x.shape[0]}")
+    n_chunks = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_chunks != max(n_stages, 1):
+        raise ValueError(f"1f1b runs one chunk per stage: stacked params "
+                         f"have {n_chunks} chunks for {n_stages} stages "
+                         f"(interleaved virtual stages are a circular-"
+                         f"schedule feature)")
+
+    kinds_np, mbs_np = _schedule_1f1b(max(n_stages, 1), m)
+    ticks = kinds_np.shape[0]
+
+    def local(params, xs, ts):
+        stage = jax.lax.axis_index(axis) if n_stages > 1 else 0
+        b_loc = xs.shape[0]
+        micro = xs.reshape(m, b_loc // m, *xs.shape[1:])
+        tmicro = ts.reshape(m, b_loc // m, *ts.shape[1:])
+        mb_shape = micro.shape[1:]
+        kinds = jnp.asarray(kinds_np)
+        mbs = jnp.asarray(mbs_np)
+        chunk_p = jax.tree_util.tree_map(lambda p: p[0], params)
+        slots = max(n_stages, 1)
+
+        def tick(t, carry):
+            (h_buf, g_buf, dparams, dx, loss_acc, recv_f, recv_g) = carry
+            kind = kinds[t, stage]
+            mb = mbs[t, stage]
+            slot = mb % slots
+            # File the values that arrived over the ring: what they are is
+            # the neighbour's op last tick, read from the same table.
+            prev_s = (stage - 1) % slots
+            next_s = (stage + 1) % slots
+            if n_stages > 1:
+                up_kind = jnp.where(t > 0, kinds[t - 1, prev_s], 0)
+                up_mb = mbs[jnp.maximum(t - 1, 0), prev_s]
+                h_buf = jnp.where(
+                    (up_kind == 1) & (stage > 0),
+                    jax.lax.dynamic_update_index_in_dim(
+                        h_buf, recv_f, up_mb % slots, 0), h_buf)
+                dn_kind = jnp.where(t > 0, kinds[t - 1, next_s], 0)
+                dn_mb = mbs[jnp.maximum(t - 1, 0), next_s]
+                g_buf = jnp.where(
+                    (dn_kind == 2) & (stage < slots - 1),
+                    jax.lax.dynamic_update_index_in_dim(
+                        g_buf, recv_g, dn_mb % slots, 0), g_buf)
+
+            z_send = jnp.zeros(mb_shape, xs.dtype)
+
+            def do_idle(_):
+                return (h_buf, dparams, dx, loss_acc, z_send, z_send)
+
+            def do_fwd(_):
+                # Compute one chunk forward; stash the chunk INPUT (the
+                # 1F1B remat residual) and send the output down the ring.
+                inject = jax.lax.dynamic_index_in_dim(micro, mb, 0,
+                                                      keepdims=False)
+                h_in = jnp.where(
+                    stage == 0, inject,
+                    jax.lax.dynamic_index_in_dim(h_buf, slot, 0,
+                                                 keepdims=False))
+                h_out = stage_fn(chunk_p, h_in)
+                return (jax.lax.dynamic_update_index_in_dim(h_buf, h_in,
+                                                            slot, 0),
+                        dparams, dx, loss_acc, h_out, z_send)
+
+            def do_bwd(_):
+                # Recompute this chunk from the stashed input and vjp it.
+                # The last stage seeds from the loss (cotangent 1/m);
+                # earlier stages consume the cotangent off the ring.
+                # Stage 0's stash IS the microbatch input — read it from
+                # the (always-resident) batch, not the buffer, so the
+                # 1-stage pipeline needs no forward ticks at all.
+                inject = jax.lax.dynamic_index_in_dim(micro, mb, 0,
+                                                      keepdims=False)
+                h_stash = jnp.where(
+                    stage == 0, inject,
+                    jax.lax.dynamic_index_in_dim(h_buf, slot, 0,
+                                                 keepdims=False))
+                tgt = jax.lax.dynamic_index_in_dim(tmicro, mb, 0,
+                                                   keepdims=False)
+                g_in = jax.lax.dynamic_index_in_dim(g_buf, slot, 0,
+                                                    keepdims=False)
+
+                def last_chunk(_):
+                    def f(p, h):
+                        return loss_fn(stage_fn(p, h), tgt)
+                    lval, vjp = jax.vjp(f, chunk_p, h_stash)
+                    # Seed in the loss's own dtype (bf16 stages produce
+                    # bf16 losses); accumulate in fp32.
+                    dp, dh = vjp(jnp.asarray(1.0 / m, lval.dtype))
+                    return lval.astype(jnp.float32), dp, dh
+
+                def mid_chunk(_):
+                    _, vjp = jax.vjp(stage_fn, chunk_p, h_stash)
+                    dp, dh = vjp(g_in)
+                    return jnp.zeros((), jnp.float32), dp, dh
+
+                lval, dp, dh = jax.lax.cond(stage == slots - 1,
+                                            last_chunk, mid_chunk, None)
+                new_dparams = jax.tree_util.tree_map(
+                    lambda acc, g: acc + g.astype(jnp.float32), dparams, dp)
+                new_dx = jnp.where(
+                    stage == 0,
+                    jax.lax.dynamic_update_index_in_dim(
+                        dx, dh.astype(dx.dtype), mb, 0), dx)
+                return (h_buf, new_dparams, new_dx, loss_acc + lval,
+                        z_send, dh.astype(xs.dtype))
+
+            (h_buf, dparams, dx, loss_acc, send_f, send_g) = jax.lax.switch(
+                kind, (do_idle, do_fwd, do_bwd), None)
+            if n_stages > 1:
+                recv_f = ppermute_shift(send_f, axis, 1)
+                recv_g = ppermute_shift(send_g, axis, -1)
+            return (h_buf, g_buf, dparams, dx, loss_acc, recv_f, recv_g)
+
+        h_buf0 = jnp.zeros((slots,) + mb_shape, xs.dtype)
+        g_buf0 = jnp.zeros((slots,) + mb_shape, xs.dtype)
+        dparams0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape[1:], jnp.float32), params)
+        dx0 = jnp.zeros((m,) + mb_shape, jnp.float32)
+        z = jnp.zeros(mb_shape, xs.dtype)
+        carry = (h_buf0, g_buf0, dparams0, dx0,
+                 jnp.zeros((), jnp.float32), z, z)
+        carry = jax.lax.fori_loop(0, ticks, tick, carry)
+        _, _, dparams, dx, loss_acc, _, _ = carry
+        if n_stages > 1:
+            # Loss lives on the last stage, dx on stage 0; pp-broadcast
+            # both so the caller sees pp-replicated outputs.  dparams stay
+            # per-stage (that IS their sharding).
+            loss = jax.lax.psum(
+                jnp.where(stage == slots - 1, loss_acc, 0.0), axis)
+            dx = jax.lax.psum(
+                jnp.where(stage == 0, dx, jnp.zeros_like(dx)), axis)
+        else:
+            loss = loss_acc
+        loss = loss / m
+        if d_axis_names:
+            # Each data shard ran its own batch slice: the global loss is
+            # the shard mean, and so are the parameter grads (each shard
+            # holds d(local mean)/dp; the mean of those is d(global
+            # mean)/dp).  dx stays per-shard (it IS the local slice) but
+            # rescales to global-mean semantics: d(local mean)/dx is
+            # dp_size times d(global mean)/dx.
+            loss = jax.lax.pmean(loss, d_axis_names)
+            dparams = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, d_axis_names), dparams)
+            dx = dx / dp_size
+        dparams = jax.tree_util.tree_map(lambda g: g[None], dparams)
+        return loss, dparams, dx.reshape(b_loc, *xs.shape[1:])
+
+    if param_partition is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
+    else:
+        param_specs = jax.tree_util.tree_map(
+            lambda p, spec: P(axis, *spec), stacked_params, param_partition)
+    x_spec = P(data_axes(mesh), *([None] * (x.ndim - 1)))
+    t_spec = P(data_axes(mesh), *([None] * (targets.ndim - 1)))
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(param_specs, x_spec, t_spec),
+                       out_specs=(P(), param_specs, x_spec),
+                       check_vma=False)
+    return fn(stacked_params, x, targets)
 
 
 def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
