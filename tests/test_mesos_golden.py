@@ -1,0 +1,219 @@
+"""Golden-payload conformance for the Mesos v1 scheduler API (VERDICT r3
+next #9): the exact SUBSCRIBE/ACCEPT JSON — TaskInfo included, down to
+the SECRET env-var shape — is frozen as golden files and structurally
+validated against the v1 API message shapes, so protocol drift is caught
+without a live master.
+
+Interpreter path and PYTHONPATH are normalized to placeholders before
+comparison; task ids and tokens are NOT normalized — tests must pin them
+to fixed dummy constants (never real uuids or secrets).  To
+intentionally change the wire shape, regenerate with::
+
+    TPUMESOS_REGEN_GOLDEN=1 python -m pytest tests/test_mesos_golden.py
+
+and review the golden diff like any other code change.
+"""
+
+import json
+import os
+import sys
+from numbers import Number
+from pathlib import Path
+
+import pytest
+
+from tfmesos_tpu.backends.mesos import MesosBackend
+from tfmesos_tpu.spec import Offer, Task
+
+GOLDEN_DIR = Path(__file__).parent / "golden"
+
+
+def _offer(chips_resource="tpus"):
+    return Offer(id="offer-1", agent_id="agent-1", hostname="tpu-vm-1",
+                 cpus=8.0, mem=8192.0, chips=8,
+                 chips_resource=chips_resource)
+
+
+def _task(chips=4):
+    t = Task("worker", 0, cpus=2.0, mem=1024.0, chips=chips)
+    t.id = "task-uuid-0000"
+    return t
+
+
+def _normalize(obj):
+    """Replace run-volatile values with stable placeholders."""
+    s = json.dumps(obj)
+    s = s.replace(json.dumps(sys.executable)[1:-1], "<PYTHON>")
+    s = s.replace(json.dumps(":".join(sys.path))[1:-1], "<PYTHONPATH>")
+    return json.loads(s)
+
+
+def _check_golden(name: str, payload):
+    payload = _normalize(payload)
+    path = GOLDEN_DIR / f"{name}.json"
+    if os.environ.get("TPUMESOS_REGEN_GOLDEN") == "1":
+        GOLDEN_DIR.mkdir(exist_ok=True)
+        path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+    if not path.exists():
+        raise AssertionError(
+            f"{path} is missing — goldens live in git; bootstrap with "
+            f"TPUMESOS_REGEN_GOLDEN=1 and COMMIT the file (a test that "
+            f"writes its own golden on miss would pass vacuously)")
+    golden = json.loads(path.read_text())
+    assert payload == golden, (
+        f"wire payload drifted from {path.name}; if intentional, regenerate "
+        f"with TPUMESOS_REGEN_GOLDEN=1 and review the diff")
+
+
+# -- minimal structural validators for the v1 API message shapes -----------
+
+
+def _require(cond, msg):
+    assert cond, f"v1 schema violation: {msg}"
+
+
+def _validate_env_var(var):
+    _require(isinstance(var.get("name"), str) and var["name"],
+             f"environment variable needs a name: {var}")
+    if var.get("type") == "SECRET":
+        # Environment.Variable with a Secret of type VALUE: the value
+        # rides base64 in secret.value.data and there must be NO plain
+        # "value" field alongside it.
+        _require("value" not in var,
+                 "SECRET variable must not carry a plaintext value")
+        secret = var.get("secret")
+        _require(isinstance(secret, dict) and secret.get("type") == "VALUE",
+                 f"SECRET variable needs secret.type VALUE: {var}")
+        data = secret.get("value", {}).get("data")
+        _require(isinstance(data, str) and data,
+                 "secret.value.data must be non-empty base64")
+        import base64
+        base64.b64decode(data, validate=True)   # raises if not base64
+    else:
+        _require(isinstance(var.get("value"), str),
+                 f"plain variable needs a string value: {var}")
+
+
+def _validate_task_info(ti):
+    _require(isinstance(ti.get("name"), str), "TaskInfo.name")
+    for key in ("task_id", "agent_id"):
+        _require(isinstance(ti.get(key, {}).get("value"), str)
+                 and ti[key]["value"], f"TaskInfo.{key}.value")
+    _require(isinstance(ti.get("resources"), list) and ti["resources"],
+             "TaskInfo.resources")
+    for res in ti["resources"]:
+        _require(res.get("type") == "SCALAR"
+                 and isinstance(res.get("scalar", {}).get("value"), Number),
+                 f"resource must be SCALAR with numeric value: {res}")
+        _require(isinstance(res.get("name"), str), f"resource name: {res}")
+    cmd = ti.get("command")
+    _require(isinstance(cmd, dict) and isinstance(cmd.get("value"), str),
+             "TaskInfo.command.value")
+    for var in cmd.get("environment", {}).get("variables", []):
+        _validate_env_var(var)
+    if "container" in ti:
+        c = ti["container"]
+        _require(c.get("type") in ("DOCKER", "MESOS"), "container.type")
+        if c["type"] == "DOCKER":
+            _require(isinstance(c.get("docker", {}).get("image"), str),
+                     "container.docker.image")
+        else:
+            _require(isinstance(
+                c.get("mesos", {}).get("image", {}).get("docker", {})
+                .get("name"), str), "container.mesos.image.docker.name")
+        for vol in c.get("volumes", []):
+            _require(vol.get("mode") in ("RO", "RW")
+                     and isinstance(vol.get("container_path"), str)
+                     and isinstance(vol.get("host_path"), str),
+                     f"volume shape: {vol}")
+
+
+def _validate_call(call, expected_type, needs_framework_id=True):
+    _require(call.get("type") == expected_type, f"Call.type {call}")
+    if needs_framework_id:
+        _require(isinstance(call.get("framework_id", {}).get("value"), str),
+                 "Call.framework_id.value")
+    if expected_type == "ACCEPT":
+        acc = call["accept"]
+        _require(all(isinstance(o.get("value"), str)
+                     for o in acc["offer_ids"]), "accept.offer_ids")
+        for op in acc["operations"]:
+            _require(op.get("type") == "LAUNCH", "operation type")
+            for ti in op["launch"]["task_infos"]:
+                _validate_task_info(ti)
+        _require(isinstance(acc.get("filters", {}).get("refuse_seconds"),
+                            Number), "accept.filters.refuse_seconds")
+    if expected_type == "SUBSCRIBE":
+        fi = call["subscribe"]["framework_info"]
+        for key in ("user", "name"):
+            _require(isinstance(fi.get(key), str) and fi[key],
+                     f"framework_info.{key}")
+        _require(isinstance(fi.get("roles"), list) and fi["roles"],
+                 "framework_info.roles")
+        _require(isinstance(fi.get("failover_timeout"), Number),
+                 "framework_info.failover_timeout")
+
+
+# -- the golden tests -------------------------------------------------------
+
+
+def _backend(framework_id=None):
+    b = MesosBackend("127.0.0.1:5050", framework_name="golden-fw",
+                     role="tpu", user="svc-tpumesos")
+    b.framework_id = framework_id
+    return b
+
+
+def test_golden_subscribe_fresh():
+    body = _backend()._subscribe_body()
+    _validate_call(body, "SUBSCRIBE", needs_framework_id=False)
+    _check_golden("subscribe_fresh", body)
+
+
+def test_golden_subscribe_failover():
+    body = _backend(framework_id="FW-1")._subscribe_body()
+    _validate_call(body, "SUBSCRIBE")
+    assert body["subscribe"]["framework_info"]["id"] == {"value": "FW-1"}
+    _check_golden("subscribe_failover", body)
+
+
+def test_golden_accept_env_token_tpus():
+    """The default launch shape: env-var token, tpus chips resource."""
+    backend = _backend(framework_id="FW-1")
+    ti = _task().to_task_info(_offer(), "10.0.0.1:7077", token="tok-abc",
+                              env={"FOO": "bar"})
+    body = backend._with_envelope(backend._accept_body(_offer(), [ti]))
+    _validate_call(body, "ACCEPT")
+    res = {r["name"]: r["scalar"]["value"] for r in ti["resources"]}
+    assert res == {"cpus": 2.0, "mem": 1024.0, "tpus": 4.0}
+    _check_golden("accept_env_token_tpus", body)
+
+
+def test_golden_accept_secret_token_docker():
+    """SECRET-typed token variable + DOCKER containerizer + volumes —
+    the maximal TaskInfo shape."""
+    backend = _backend(framework_id="FW-1")
+    task = _task(chips=0)
+    task.volumes = {"/data": "/mnt/data"}
+    ti = task.to_task_info(_offer("gpus"), "10.0.0.1:7077",
+                           token="tok-secret", docker_image="tpu/img:1",
+                           containerizer_type="DOCKER",
+                           force_pull_image=True, secret_token=True)
+    body = backend._with_envelope(backend._accept_body(_offer(), [ti]))
+    _validate_call(body, "ACCEPT")
+    secret_vars = [v for v in ti["command"]["environment"]["variables"]
+                   if v.get("type") == "SECRET"]
+    assert len(secret_vars) == 1
+    _check_golden("accept_secret_token_docker", body)
+
+
+def test_golden_accept_mesos_containerizer():
+    backend = _backend(framework_id="FW-1")
+    ti = _task(chips=8).to_task_info(
+        _offer(), "10.0.0.1:7077", token="tok-abc",
+        docker_image="tpu/img:2", containerizer_type="MESOS",
+        token_file="/tmp/tokenfile")
+    body = backend._with_envelope(backend._accept_body(_offer(), [ti]))
+    _validate_call(body, "ACCEPT")
+    assert ti["container"]["type"] == "MESOS"
+    _check_golden("accept_mesos_containerizer", body)

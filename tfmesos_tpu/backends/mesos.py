@@ -174,7 +174,9 @@ class MesosBackend(ResourceBackend):
                     pass
                 self._conn = None
 
-    def _stream_once(self) -> None:
+    def _subscribe_body(self) -> Dict[str, Any]:
+        """The v1 SUBSCRIBE call payload (golden-tested against the API
+        shape in tests/test_mesos_golden.py)."""
         body: Dict[str, Any] = {
             "type": "SUBSCRIBE",
             "subscribe": {
@@ -191,6 +193,25 @@ class MesosBackend(ResourceBackend):
             body["framework_id"] = {"value": self.framework_id}
             body["subscribe"]["framework_info"]["id"] = {
                 "value": self.framework_id}
+        return body
+
+    def _accept_body(self, offer: Offer,
+                     task_infos: Sequence[dict]) -> Dict[str, Any]:
+        """The v1 ACCEPT call payload (golden-tested)."""
+        return {
+            "type": "ACCEPT",
+            "accept": {
+                "offer_ids": [{"value": offer.id}],
+                "operations": [{
+                    "type": "LAUNCH",
+                    "launch": {"task_infos": list(task_infos)},
+                }],
+                "filters": {"refuse_seconds": 5.0},
+            },
+        }
+
+    def _stream_once(self) -> None:
+        body = self._subscribe_body()
         conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
         self._conn = conn
         conn.request("POST", API_PATH, body=json.dumps(body),
@@ -301,12 +322,18 @@ class MesosBackend(ResourceBackend):
 
     # -- calls -------------------------------------------------------------
 
-    def _call(self, body: Dict[str, Any]) -> int:
-        """POST one scheduler call; returns the HTTP status (2xx = the
-        master took it)."""
+    def _with_envelope(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """The call envelope every non-SUBSCRIBE POST carries (golden-
+        tested: the goldens freeze exactly what goes on the wire)."""
         body = dict(body)
         if self.framework_id:
             body["framework_id"] = {"value": self.framework_id}
+        return body
+
+    def _call(self, body: Dict[str, Any]) -> int:
+        """POST one scheduler call; returns the HTTP status (2xx = the
+        master took it)."""
+        body = self._with_envelope(body)
         headers = {"Content-Type": "application/json"}
         if self.stream_id:
             headers["Mesos-Stream-Id"] = self.stream_id
@@ -330,17 +357,7 @@ class MesosBackend(ResourceBackend):
         # through the normal two-phase revive/abort policy.
         task_ids = [info["task_id"]["value"] for info in task_infos]
         try:
-            status = self._call({
-                "type": "ACCEPT",
-                "accept": {
-                    "offer_ids": [{"value": offer.id}],
-                    "operations": [{
-                        "type": "LAUNCH",
-                        "launch": {"task_infos": list(task_infos)},
-                    }],
-                    "filters": {"refuse_seconds": 5.0},
-                },
-            })
+            status = self._call(self._accept_body(offer, task_infos))
         except Exception as e:
             self._drop_launch(task_ids, f"ACCEPT failed: {e}")
             return
