@@ -44,9 +44,11 @@ def main():
                         "all_to_all path when the mesh has an ep axis")
     p.add_argument("--top-k", type=int, default=1, dest="top_k",
                    help="experts per token on the switch path")
-    p.add_argument("--pp-schedule", choices=["gpipe", "circular"],
+    p.add_argument("--pp-schedule", choices=["gpipe", "circular", "1f1b"],
                    default="gpipe", dest="pp_schedule",
-                   help="pipeline schedule when the mesh has a pp axis")
+                   help="pipeline schedule when the mesh has a pp axis "
+                        "(1f1b: fused fwd+bwd step with an O(pp) "
+                        "activation stash; dense configs, pp x dp only)")
     p.add_argument("--virtual-stages", type=int, default=1,
                    dest="virtual_stages",
                    help="interleaved chunks per pp device (circular only)")
@@ -83,13 +85,17 @@ def main():
 
     ctx = runtime.initialize()
     mesh = ctx.mesh(parse_mesh(args.mesh))
+    # 1f1b is a TRAIN-step schedule (transformer.train_step_1f1b below);
+    # forward-only paths (eval/generation) keep gpipe.
+    fwd_schedule = "gpipe" if args.pp_schedule == "1f1b" \
+        else args.pp_schedule
     if args.tiny:
         cfg = transformer.TransformerConfig(
             vocab_size=256, d_model=64, n_layers=2,
             n_heads=max(4, 2 * mesh.shape.get("tp", 1)), d_ff=128,
             max_seq_len=args.seq_len, dtype=jnp.float32,
             n_experts=args.moe, top_k=args.top_k, moe_impl="switch",
-            pp_schedule=args.pp_schedule, n_kv_heads=args.kv_heads,
+            pp_schedule=fwd_schedule, n_kv_heads=args.kv_heads,
             pp_virtual_stages=args.virtual_stages, sp_impl=args.sp_impl)
         seq_len = min(args.seq_len, 64 * max(1, mesh.shape.get("sp", 1)))
     else:
@@ -97,7 +103,7 @@ def main():
             vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
             max_seq_len=args.seq_len, n_experts=args.moe,
             top_k=args.top_k, moe_impl="switch",
-            pp_schedule=args.pp_schedule, n_kv_heads=args.kv_heads,
+            pp_schedule=fwd_schedule, n_kv_heads=args.kv_heads,
             pp_virtual_stages=args.virtual_stages, sp_impl=args.sp_impl)
         seq_len = args.seq_len
     if ctx.is_chief:
@@ -121,10 +127,17 @@ def main():
     opt = optax.adamw(lr, weight_decay=0.01)
     if args.grad_clip > 0:
         opt = optax.chain(optax.clip_by_global_norm(args.grad_clip), opt)
+    grads_fn = None
+    if args.pp_schedule == "1f1b" and mesh.shape.get("pp", 1) > 1:
+        def grads_fn(p_, b_):
+            loss, grads = transformer.train_step_1f1b(cfg, p_, b_, mesh)
+            return grads, loss, {"perplexity": jnp.exp(loss)}
+
     step = make_train_step(
         lambda p_, b_: transformer.loss_fn(cfg, p_, b_, mesh), opt, mesh=mesh,
         param_specs=transformer.partition_specs(cfg, mesh),
-        batch_spec_tree=NamedSharding(mesh, batch_spec(mesh, extra_dims=1)))
+        batch_spec_tree=NamedSharding(mesh, batch_spec(mesh, extra_dims=1)),
+        grads_fn=grads_fn)
     params, opt_state = step.place(params, opt.init(params))
 
     start_step = 0

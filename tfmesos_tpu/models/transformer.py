@@ -1597,6 +1597,84 @@ def loss_fn(cfg: TransformerConfig, params, batch, mesh: Optional[Mesh] = None):
     return loss, metrics
 
 
+def train_step_1f1b(cfg: TransformerConfig, params, batch,
+                    mesh: Mesh, num_microbatches: Optional[int] = None):
+    """One fused 1F1B forward+backward pass of the LM objective on a
+    pp x dp/fsdp mesh: returns ``(loss, grads)`` with ``grads`` matching
+    ``params``' structure (fp32), ready for any optax update.
+
+    This is the memory-bounded alternative to ``jax.grad(loss_fn)`` over
+    the gpipe/circular pipeline: the live activation stash is one chunk
+    input per pipeline slot (O(pp), not O(microbatches)) because forward
+    and backward interleave inside ``pipeline_train_1f1b``'s single loop.
+    The embedding differentiates through the returned dx, and the final
+    norm + unembedding head ride as tail params of the loss stage.
+
+    Scope: dense configs on pp (+ dp/fsdp) meshes.  tp/sp stage bodies and
+    MoE aux-loss plumbing stay with the gpipe/circular schedules
+    (``loss_fn``); interleaved virtual stages are circular-only.
+    """
+    pp = mesh.shape.get("pp", 1)
+    real = {a for a, s in mesh.shape.items() if s > 1}
+    if not real <= {"pp", "dp", "fsdp"}:
+        raise ValueError(
+            f"train_step_1f1b supports pp x dp/fsdp meshes; got "
+            f"{dict(mesh.shape)} (tp/sp/ep stage bodies stay with "
+            f"pp_schedule='gpipe'/'circular')")
+    if cfg.n_experts:
+        raise ValueError("train_step_1f1b does not carry MoE router aux "
+                         "losses; use pp_schedule='gpipe'/'circular'")
+    if cfg.pp_virtual_stages != 1:
+        raise ValueError("interleaved virtual stages are circular-only; "
+                         "train_step_1f1b runs one chunk per stage")
+    if cfg.n_layers % max(pp, 1):
+        raise ValueError(f"{cfg.n_layers} layers not divisible into "
+                         f"{pp} pipeline stages")
+    from tfmesos_tpu.parallel.pipeline import pipeline_train_1f1b
+
+    tokens = batch["tokens"]
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    per = cfg.n_layers // max(pp, 1)
+    stacked = jax.tree_util.tree_map(
+        lambda p: p.reshape(max(pp, 1), per, *p.shape[1:]),
+        params["layers"])
+
+    def stage_fn(stage_params, h):
+        pos = jnp.broadcast_to(jnp.arange(h.shape[1], dtype=jnp.int32),
+                               h.shape[:2])
+        body = lambda c, lp: _block(cfg, None, c, lp, pos)
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        out, _ = jax.lax.scan(body, h, stage_params)
+        return out
+
+    def tail_loss(tail, h, tgt_mb):
+        # Fused head+CE: never materializes the [mb, T, vocab] logits —
+        # the same bounded-memory route loss_fn takes, which matters
+        # doubly on the schedule whose point is the O(pp) stash.
+        x = rms_norm(h, tail["norm_f"].astype(cfg.dtype))
+        return fused_linear_cross_entropy(x, tail["head"], tgt_mb,
+                                          z_loss=cfg.z_loss,
+                                          chunk=cfg.ce_chunk)
+
+    x, vjp_embed = jax.vjp(
+        lambda e: _embed_lookup(e, inp, cfg.dtype), params["embed"])
+    tail = {"norm_f": params["norm_f"], "head": params["head"]}
+    loss, g_stacked, g_tail, dx = pipeline_train_1f1b(
+        stage_fn, tail_loss, stacked, x, tgt, mesh,
+        num_microbatches=num_microbatches, tail_params=tail)
+    (g_embed,) = vjp_embed(dx.astype(x.dtype))
+    grads = {
+        "embed": jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), g_embed),
+        "layers": jax.tree_util.tree_map(
+            lambda g: g.reshape(cfg.n_layers, *g.shape[2:]), g_stacked),
+        "norm_f": g_tail["norm_f"],
+        "head": g_tail["head"],
+    }
+    return loss, grads
+
+
 def _quantized_spec(s: P) -> QTensor:
     """The PartitionSpec pair for a QTensor leaf: ``values`` takes the
     weight's spec, ``scales`` the same minus the last dim (their trailing

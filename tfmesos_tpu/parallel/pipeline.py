@@ -113,11 +113,12 @@ def _schedule_1f1b(n_stages: int, m: int):
 
 
 def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
-                        loss_fn: Callable[[Any, Any], Any],
+                        loss_fn: Callable[..., Any],
                         stacked_params: Any, x, targets, mesh: Mesh,
                         axis: str = "pp",
                         num_microbatches: Optional[int] = None,
-                        param_partition: Optional[Any] = None):
+                        param_partition: Optional[Any] = None,
+                        tail_params: Any = None):
     """One fused forward+backward pipeline pass on the 1F1B schedule.
 
     ``pipeline_apply`` is forward-only — under ``jax.grad`` autodiff
@@ -134,6 +135,13 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
     parameter gradients with the stacked params' structure and sharding,
     and the gradient w.r.t. ``x`` (for an embedding layer upstream).
     ``targets`` are constants — no cotangent flows to them.
+
+    ``tail_params`` (optional) are weights used INSIDE the loss — a final
+    norm and unembedding head, say.  The loss contract becomes
+    ``loss_fn(tail_params, h_out, target_mb)``, the tail is replicated
+    into every stage (only the last differentiates it), and the return
+    grows to ``(loss, grads, tail_grads, dx)`` with replicated fp32
+    ``tail_grads``.
 
     Memory: backward recomputes its chunk from the stashed stage INPUT
     (standard 1F1B remat), so each stage holds at most S microbatch
@@ -166,7 +174,7 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
     kinds_np, mbs_np = _schedule_1f1b(max(n_stages, 1), m)
     ticks = kinds_np.shape[0]
 
-    def local(params, xs, ts):
+    def local(params, tail, xs, ts):
         stage = jax.lax.axis_index(axis) if n_stages > 1 else 0
         b_loc = xs.shape[0]
         micro = xs.reshape(m, b_loc // m, *xs.shape[1:])
@@ -178,7 +186,8 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
         slots = max(n_stages, 1)
 
         def tick(t, carry):
-            (h_buf, g_buf, dparams, dx, loss_acc, recv_f, recv_g) = carry
+            (h_buf, g_buf, dparams, dtail, dx, loss_acc, recv_f,
+             recv_g) = carry
             kind = kinds[t, stage]
             mb = mbs[t, stage]
             slot = mb % slots
@@ -203,7 +212,7 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
             z_send = jnp.zeros(mb_shape, xs.dtype)
 
             def do_idle(_):
-                return (h_buf, dparams, dx, loss_acc, z_send, z_send)
+                return (h_buf, dparams, dtail, dx, loss_acc, z_send, z_send)
 
             def do_fwd(_):
                 # Compute one chunk forward; stash the chunk INPUT (the
@@ -217,7 +226,7 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                 h_out = stage_fn(chunk_p, h_in)
                 return (jax.lax.dynamic_update_index_in_dim(h_buf, h_in,
                                                             slot, 0),
-                        dparams, dx, loss_acc, h_out, z_send)
+                        dparams, dtail, dx, loss_acc, h_out, z_send)
 
             def do_bwd(_):
                 # Recompute this chunk from the stashed input and vjp it.
@@ -238,53 +247,73 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                                                     keepdims=False)
 
                 def last_chunk(_):
-                    def f(p, h):
-                        return loss_fn(stage_fn(p, h), tgt)
-                    lval, vjp = jax.vjp(f, chunk_p, h_stash)
-                    # Seed in the loss's own dtype (bf16 stages produce
-                    # bf16 losses); accumulate in fp32.
-                    dp, dh = vjp(jnp.asarray(1.0 / m, lval.dtype))
-                    return lval.astype(jnp.float32), dp, dh
+                    if tail_params is None:
+                        def f(p, h):
+                            return loss_fn(stage_fn(p, h), tgt)
+                        lval, vjp = jax.vjp(f, chunk_p, h_stash)
+                        # Seed in the loss's own dtype (bf16 stages produce
+                        # bf16 losses); accumulate in fp32.
+                        dp, dh = vjp(jnp.asarray(1.0 / m, lval.dtype))
+                        dtl = zero_tail
+                    else:
+                        def f(p, h, tl):
+                            return loss_fn(tl, stage_fn(p, h), tgt)
+                        lval, vjp = jax.vjp(f, chunk_p, h_stash, tail)
+                        dp, dh, dtl = vjp(jnp.asarray(1.0 / m, lval.dtype))
+                        # fp32 like the other accumulators — and both cond
+                        # branches must agree on dtypes (zero_tail is fp32).
+                        dtl = jax.tree_util.tree_map(
+                            lambda g: g.astype(jnp.float32), dtl)
+                    return lval.astype(jnp.float32), dp, dh, dtl
 
                 def mid_chunk(_):
                     _, vjp = jax.vjp(stage_fn, chunk_p, h_stash)
                     dp, dh = vjp(g_in)
-                    return jnp.zeros((), jnp.float32), dp, dh
+                    return jnp.zeros((), jnp.float32), dp, dh, zero_tail
 
-                lval, dp, dh = jax.lax.cond(stage == slots - 1,
-                                            last_chunk, mid_chunk, None)
+                lval, dp, dh, dtl = jax.lax.cond(stage == slots - 1,
+                                                 last_chunk, mid_chunk, None)
                 new_dparams = jax.tree_util.tree_map(
                     lambda acc, g: acc + g.astype(jnp.float32), dparams, dp)
+                new_dtail = jax.tree_util.tree_map(
+                    lambda acc, g: acc + g.astype(jnp.float32), dtail, dtl)
                 new_dx = jnp.where(
                     stage == 0,
                     jax.lax.dynamic_update_index_in_dim(
                         dx, dh.astype(dx.dtype), mb, 0), dx)
-                return (h_buf, new_dparams, new_dx, loss_acc + lval,
-                        z_send, dh.astype(xs.dtype))
+                return (h_buf, new_dparams, new_dtail, new_dx,
+                        loss_acc + lval, z_send, dh.astype(xs.dtype))
 
-            (h_buf, dparams, dx, loss_acc, send_f, send_g) = jax.lax.switch(
-                kind, (do_idle, do_fwd, do_bwd), None)
+            (h_buf, dparams, dtail, dx, loss_acc, send_f,
+             send_g) = jax.lax.switch(kind, (do_idle, do_fwd, do_bwd), None)
             if n_stages > 1:
                 recv_f = ppermute_shift(send_f, axis, 1)
                 recv_g = ppermute_shift(send_g, axis, -1)
-            return (h_buf, g_buf, dparams, dx, loss_acc, recv_f, recv_g)
+            return (h_buf, g_buf, dparams, dtail, dx, loss_acc, recv_f,
+                    recv_g)
 
         h_buf0 = jnp.zeros((slots,) + mb_shape, xs.dtype)
         g_buf0 = jnp.zeros((slots,) + mb_shape, xs.dtype)
         dparams0 = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape[1:], jnp.float32), params)
+        zero_tail = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), tail)
         dx0 = jnp.zeros((m,) + mb_shape, jnp.float32)
         z = jnp.zeros(mb_shape, xs.dtype)
-        carry = (h_buf0, g_buf0, dparams0, dx0,
+        carry = (h_buf0, g_buf0, dparams0, zero_tail, dx0,
                  jnp.zeros((), jnp.float32), z, z)
         carry = jax.lax.fori_loop(0, ticks, tick, carry)
-        _, _, dparams, dx, loss_acc, _, _ = carry
+        _, _, dparams, dtail, dx, loss_acc, _, _ = carry
         if n_stages > 1:
-            # Loss lives on the last stage, dx on stage 0; pp-broadcast
-            # both so the caller sees pp-replicated outputs.  dparams stay
-            # per-stage (that IS their sharding).
+            # Loss and tail grads live on the last stage, dx on stage 0;
+            # pp-broadcast them so the caller sees pp-replicated outputs.
+            # dparams stay per-stage (that IS their sharding).
             loss = jax.lax.psum(
                 jnp.where(stage == slots - 1, loss_acc, 0.0), axis)
+            dtail = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(
+                    jnp.where(stage == slots - 1, g, jnp.zeros_like(g)),
+                    axis), dtail)
             dx = jax.lax.psum(
                 jnp.where(stage == 0, dx, jnp.zeros_like(dx)), axis)
         else:
@@ -300,9 +329,11 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
             loss = jax.lax.pmean(loss, d_axis_names)
             dparams = jax.tree_util.tree_map(
                 lambda g: jax.lax.pmean(g, d_axis_names), dparams)
+            dtail = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, d_axis_names), dtail)
             dx = dx / dp_size
         dparams = jax.tree_util.tree_map(lambda g: g[None], dparams)
-        return loss, dparams, dx.reshape(b_loc, *xs.shape[1:])
+        return loss, dparams, dtail, dx.reshape(b_loc, *xs.shape[1:])
 
     if param_partition is None:
         param_specs = jax.tree_util.tree_map(
@@ -312,11 +343,15 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
             lambda p, spec: P(axis, *spec), stacked_params, param_partition)
     x_spec = P(data_axes(mesh), *([None] * (x.ndim - 1)))
     t_spec = P(data_axes(mesh), *([None] * (targets.ndim - 1)))
+    tail_specs = jax.tree_util.tree_map(lambda _: P(), tail_params)
     fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(param_specs, x_spec, t_spec),
-                       out_specs=(P(), param_specs, x_spec),
+                       in_specs=(param_specs, tail_specs, x_spec, t_spec),
+                       out_specs=(P(), param_specs, tail_specs, x_spec),
                        check_vma=False)
-    return fn(stacked_params, x, targets)
+    loss, grads, tail_grads, dx = fn(stacked_params, tail_params, x, targets)
+    if tail_params is None:
+        return loss, grads, dx
+    return loss, grads, tail_grads, dx
 
 
 def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,

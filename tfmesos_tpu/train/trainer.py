@@ -43,7 +43,8 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
                     postprocess: Optional[Callable] = None,
                     steps_per_call: int = 1,
                     grad_accum: int = 1,
-                    scan_unroll: int = 1):
+                    scan_unroll: int = 1,
+                    grads_fn: Optional[Callable] = None):
     """Build the jit'd train step.
 
     ``loss_fn(params, batch) -> (loss, metrics)``.  With a mesh, params/opt
@@ -73,9 +74,25 @@ def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation,
     microbatch, a slightly different objective.  Returned metrics are
     microbatch means.  The per-step batch dim must divide evenly (and stay
     divisible by the data-axis size).
+
+    ``grads_fn(params, batch) -> (grads, loss, metrics)`` replaces the
+    default ``jax.value_and_grad(loss_fn)`` pass for schedules autodiff
+    cannot express — e.g. ``transformer.train_step_1f1b``'s fused-1F1B
+    pipeline pass.  Exclusive with ``grad_accum`` (such passes microbatch
+    internally); ``loss_fn`` is ignored when given.
     """
 
+    if grads_fn is not None and grad_accum != 1:
+        raise ValueError("grads_fn and grad_accum are exclusive: a custom "
+                         "gradient pass (e.g. the 1F1B pipeline step) does "
+                         "its own microbatching")
+
     def grads_and_metrics(params, batch):
+        if grads_fn is not None:
+            # Custom gradient pass — e.g. transformer.train_step_1f1b,
+            # whose fused fwd+bwd schedule jax.value_and_grad cannot
+            # express.  Contract: (grads, loss, metrics).
+            return grads_fn(params, batch)
         if grad_accum == 1:
             (loss, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, batch)
