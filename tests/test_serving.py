@@ -188,6 +188,52 @@ def test_typed_prng_key_accepted(setup):
     assert len(done) == 3
 
 
+@pytest.mark.parametrize("prefix_len", [16, 11, 21])
+def test_shared_prefix_matches_generate(setup, prefix_len):
+    """Prefix page sharing (page_size 16: aligned, sub-page, and
+    full+tail cases): rows reference the shared prefix pages read-only,
+    and greedy outputs are token-identical to generate(prefix=...)."""
+    cfg, params = setup
+    rng = np.random.RandomState(17)
+    prefix = rng.randint(0, cfg.vocab_size, size=prefix_len).astype(np.int32)
+    reqs = [Request(prompt=p, max_new_tokens=3 + (i % 4))
+            for i, p in enumerate(_prompts(cfg, 6, seed=18))]
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=96,
+                                page_size=16, prefill_bucket=16,
+                                prefix=prefix)
+    done = {c.rid: c for c in batcher.run(reqs)}
+    assert len(done) == len(reqs)
+    for rid, req in enumerate(reqs):
+        out = transformer.generate(
+            cfg, params, jnp.asarray(req.prompt[None]),
+            req.max_new_tokens, temperature=0.0,
+            prefix=jnp.asarray(prefix))
+        want = np.asarray(out)[0, prefix_len + req.prompt.size:].tolist()
+        assert done[rid].tokens == want, f"request {rid} diverged"
+    # Shared pages survive the whole stream; own pages all recycled
+    # (pool keeps sink + reserved prefix pages out of circulation).
+    n_reserved = -(-prefix_len // 16)
+    assert batcher.alloc.free_count() == batcher.n_pages - 1 - n_reserved
+    assert batcher.alloc.rows == {}
+
+
+def test_shared_prefix_validation(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="non-empty"):
+        ContinuousBatcher(cfg, params, rows=1, max_len=64, page_size=16,
+                          prefix=np.zeros((0,), np.int32))
+    with pytest.raises(ValueError, match="no room"):
+        ContinuousBatcher(cfg, params, rows=1, max_len=32, page_size=16,
+                          prefix=np.zeros((32,), np.int32))
+    b = ContinuousBatcher(cfg, params, rows=1, max_len=48, page_size=16,
+                          prefill_bucket=16,
+                          prefix=np.zeros((16,), np.int32))
+    too_long = Request(prompt=np.arange(20, dtype=np.int32),
+                       max_new_tokens=30)
+    with pytest.raises(ValueError, match="prefix 16"):
+        list(b.run([too_long]))
+
+
 def test_int8_kv_pool_composes(setup):
     """quantized_cache=True serves from an int8 page pool; outputs stay
     close to (not necessarily identical to) the fp path."""

@@ -102,6 +102,16 @@ class ContinuousBatcher:
     sampling config for the whole batcher (greedy at temperature 0);
     ``rng`` takes either key flavor (raw uint32 pair or typed
     ``jax.random.key``) — it is only ever folded in-graph.
+
+    ``prefix`` (1-D int32, optional) is a SHARED prompt prefix (system
+    prompt), prefilled ONCE into reserved pool pages that every row's
+    page table references read-only — the paged analogue of
+    ``generate(prefix=...)``, at zero per-row HBM for the shared part.
+    A partial last page (prefix length not a page multiple) is COPIED
+    into each admitted row's first own page so per-row writes never
+    touch shared pages.  ``max_len`` still bounds the TOTAL sequence
+    (prefix + prompt + new tokens); request positions and outputs are
+    unchanged — the prefix is invisible except in attention.
     """
 
     def __init__(self, cfg: TransformerConfig, params, rows: int = 8,
@@ -109,7 +119,7 @@ class ContinuousBatcher:
                  n_pages: Optional[int] = None, prefill_bucket: int = 64,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, rng=None,
-                 quantized_cache: bool = False):
+                 quantized_cache: bool = False, prefix=None):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         self.cfg = cfg
@@ -121,9 +131,18 @@ class ContinuousBatcher:
                              f"config's max_seq_len ({cfg.max_seq_len})")
         self.page_size = int(page_size)
         self.np_max = -(-self.max_len // self.page_size)
-        # +1: one page is reserved as the inactive-row write sink below,
-        # so the default still fully backs rows x max_len of live data.
-        self.n_pages = int(n_pages or rows * self.np_max + 1)
+        # Default pool: every row's worst case (max_len minus whatever a
+        # shared prefix covers read-only) + the prefix's reserved pages +
+        # one inactive-row write sink — so the default always fully backs
+        # rows x max_len of live data, prefix or not.
+        prefix_np = None if prefix is None else np.asarray(prefix, np.int32)
+        n_prefix_pages = (0 if prefix_np is None
+                          else -(-int(prefix_np.size) // self.page_size))
+        shared_full = (0 if prefix_np is None else
+                       (int(prefix_np.size) // self.page_size)
+                       * self.page_size)
+        own_max = -(-(self.max_len - shared_full) // self.page_size)
+        self.n_pages = int(n_pages or rows * own_max + n_prefix_pages + 1)
         self.prefill_bucket = int(prefill_bucket)
         self.temperature = temperature
         self.top_k = top_k
@@ -136,11 +155,58 @@ class ContinuousBatcher:
         self._sink_page = self.alloc.reserve_page()
         self.pool = init_paged_cache(cfg, self.n_pages, self.page_size,
                                      quantized=quantized_cache)
+        self.prefix_len = 0
+        self._shared_pages: List[int] = []   # full prefix pages, read-only
+        self._shared_len = 0                 # positions they cover
+        self._tail_template: Optional[int] = None  # partial-page template
         self._prefill_fns: Dict[int, Any] = {}
         self._decode = self._make_decode()
         self._next_rid = 0
         self._table_cache = None        # device table; rebuilt when dirty
         self.peak_pages_used = 0        # observability: high-water mark
+        if prefix_np is not None:
+            self._init_prefix(prefix_np)
+
+    def _init_prefix(self, prefix: np.ndarray) -> None:
+        """Reserve pages for the shared prefix and prefill it once."""
+        if prefix.ndim != 1 or prefix.size == 0:
+            raise ValueError("prefix must be a non-empty 1-D token array")
+        if prefix.size >= self.max_len:
+            raise ValueError(f"prefix ({prefix.size} tokens) leaves no "
+                             f"room under max_len ({self.max_len})")
+        self.prefix_len = int(prefix.size)
+        full = self.prefix_len // self.page_size
+        tail = self.prefix_len % self.page_size
+        n_reserve = full + (1 if tail else 0)
+        pages = [self.alloc.reserve_page() for _ in range(n_reserve)]
+        table = np.full((1, self.np_max), self._sink_page, np.int32)
+        table[0, :n_reserve] = pages
+
+        @partial(jax.jit, donate_argnums=1)
+        def prefill_prefix(params, pool, t, toks):
+            cache = dict(pool, pages=t)
+            _, cache = decode_step(self.cfg, params, cache, toks, 0)
+            return {"k": cache["k"], "v": cache["v"]}
+
+        self.pool = prefill_prefix(self.params, self.pool,
+                                   jnp.asarray(table), jnp.asarray(
+                                       prefix[None]))
+        if tail:
+            # The last prefix page is only partially shared: keep it as a
+            # TEMPLATE, copied into each admitted row's first own page
+            # (copy-on-write) so row writes never touch shared state.
+            self._tail_template = pages[-1]
+            self._shared_pages = pages[:-1]
+        else:
+            self._shared_pages = pages
+        self._shared_len = len(self._shared_pages) * self.page_size
+
+        @partial(jax.jit, donate_argnums=0)
+        def copy_page(pool, src, dst):
+            return jax.tree_util.tree_map(
+                lambda buf: buf.at[:, dst].set(buf[:, src]), pool)
+
+        self._copy_page = copy_page
 
     # -- compiled shapes --------------------------------------------------
 
@@ -176,8 +242,12 @@ class ContinuousBatcher:
             @partial(jax.jit, donate_argnums=1)
             def fn(params, pool, table, prompt, length, rid):
                 cache = dict(pool, pages=table)
+                # With a shared prefix the chunk prefills AT OFFSET
+                # prefix_len: rope positions, causal bounds, and page
+                # writes all follow (token tt of the chunk sees cache
+                # positions <= prefix_len + tt).
                 logits, cache = decode_step(self.cfg, params, cache, prompt,
-                                            0)
+                                            self.prefix_len)
                 last = jnp.take_along_axis(
                     logits, (length - 1)[:, None, None], axis=1)[:, 0]
                 nxt = self._sample(last, rid, jnp.zeros_like(rid))
@@ -189,16 +259,18 @@ class ContinuousBatcher:
     # -- host-side bookkeeping --------------------------------------------
 
     def _worst_pages(self, req: Request) -> int:
+        """Worst-case OWN pages (beyond the shared prefix pages)."""
         width = -(-req.prompt.size // self.prefill_bucket) * \
             self.prefill_bucket
-        need_len = max(width, req.prompt.size + req.max_new_tokens - 1)
+        need_len = self.prefix_len + max(
+            width, req.prompt.size + req.max_new_tokens - 1)
         if need_len > self.max_len:
             raise ValueError(
-                f"request needs {need_len} cache positions (prompt "
-                f"{req.prompt.size} padded to {width}, plus "
-                f"{req.max_new_tokens} new tokens) > max_len "
-                f"({self.max_len})")
-        return -(-need_len // self.page_size)
+                f"request needs {need_len} cache positions (prefix "
+                f"{self.prefix_len} + prompt {req.prompt.size} padded to "
+                f"{width}, plus {req.max_new_tokens} new tokens) > "
+                f"max_len ({self.max_len})")
+        return -(-(need_len - self._shared_len) // self.page_size)
 
     def _reserve_headroom(self, active: Dict[int, _Row]) -> int:
         """Free pages not spoken for by in-flight rows' reservations."""
@@ -207,8 +279,10 @@ class ContinuousBatcher:
         return self.alloc.free_count() - outstanding
 
     def _ensure(self, row: int, length: int) -> None:
+        """Back ABSOLUTE positions [0, length): the shared prefix pages
+        cover [0, _shared_len); the row's own allocation covers the rest."""
         before = self.alloc.allocated(row)
-        self.alloc.ensure(row, length)
+        self.alloc.ensure(row, max(0, length - self._shared_len))
         if self.alloc.allocated(row) != before:
             self._table_cache = None
         used = self.n_pages - self.alloc.free_count()
@@ -224,8 +298,24 @@ class ContinuousBatcher:
         allocation actually changed (page-boundary growth, admission,
         release) — not every token."""
         if self._table_cache is None:
-            self._table_cache = self.alloc.table(
-                range(self.rows), width=self.np_max, fill=self._sink_page)
+            if not self._shared_pages:
+                self._table_cache = self.alloc.table(
+                    range(self.rows), width=self.np_max,
+                    fill=self._sink_page)
+            else:
+                # Rows WITH allocations see [shared prefix pages | own
+                # pages]; rows without stay all-sink (an inactive row
+                # writes its garbage step at position 0 — that must never
+                # land on a shared page).
+                t = np.full((self.rows, self.np_max), self._sink_page,
+                            np.int32)
+                ns = len(self._shared_pages)
+                for r in range(self.rows):
+                    own = self.alloc.rows.get(r)
+                    if own:
+                        t[r, :ns] = self._shared_pages
+                        t[r, ns:ns + len(own)] = own
+                self._table_cache = jnp.asarray(t)
         return self._table_cache
 
     # -- the loop ---------------------------------------------------------
@@ -293,7 +383,12 @@ class ContinuousBatcher:
         first token already finishes the request."""
         length = req.prompt.size
         width = -(-length // self.prefill_bucket) * self.prefill_bucket
-        self._ensure(row, width)
+        self._ensure(row, self.prefix_len + width)
+        if self._tail_template is not None:
+            # Copy-on-write: the partially-shared prefix page becomes this
+            # row's first own page before any row write can land in it.
+            self.pool = self._copy_page(
+                self.pool, self._tail_template, self.alloc.rows[row][0])
         padded = np.zeros((1, width), np.int32)
         padded[0, :length] = req.prompt
         self.pool, tok = self._prefill_fn(width)(
@@ -301,8 +396,8 @@ class ContinuousBatcher:
             jnp.asarray(padded), jnp.asarray([length], jnp.int32),
             jnp.asarray([rid], jnp.int32))
         tok = int(tok)
-        state = _Row(rid=rid, req=req, pos=length, step=1, last=tok,
-                     out=[tok], worst_pages=worst)
+        state = _Row(rid=rid, req=req, pos=self.prefix_len + length, step=1,
+                     last=tok, out=[tok], worst_pages=worst)
         active[row] = state
         if tok == req.stop_token or req.max_new_tokens == 1:
             return Completion(rid=rid, request=req, tokens=list(state.out))
