@@ -136,6 +136,16 @@ def test_sampled_streams_invariant_to_batching(setup):
     assert outs[0] == outs[1]
 
 
+def test_completion_timing_metrics(setup):
+    cfg, params = setup
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                                page_size=16, prefill_bucket=16)
+    reqs = [Request(prompt=p, max_new_tokens=5)
+            for p in _prompts(cfg, 3, seed=21)]
+    for c in batcher.run(reqs):
+        assert 0.0 < c.ttft_s <= c.total_s
+
+
 def test_admission_validation(setup):
     cfg, params = setup
     with pytest.raises(ValueError, match="non-empty"):

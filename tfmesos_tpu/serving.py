@@ -33,6 +33,7 @@ construction.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from functools import partial
 from typing import Any, Dict, Iterable, Iterator, List, Optional
@@ -72,11 +73,15 @@ class Request:
 class Completion:
     """A finished request: ``tokens`` are the generated continuation
     (including the stop token when one was emitted), ``rid`` the
-    admission-order id the batcher assigned."""
+    admission-order id the batcher assigned.  ``ttft_s`` is wall time
+    from admission (prefill start) to the first token; ``total_s`` to
+    the last."""
 
     rid: int
     request: Request
     tokens: List[int]
+    ttft_s: float = 0.0
+    total_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -90,6 +95,8 @@ class _Row:
     last: int           # last emitted token (feeds the next decode step)
     out: List[int]
     worst_pages: int    # admission-time reservation
+    t_admit: float = 0.0    # perf_counter at prefill start
+    t_first: float = 0.0    # ... at first-token availability
 
 
 class ContinuousBatcher:
@@ -381,6 +388,7 @@ class ContinuousBatcher:
         """Prefill ``req`` into ``row``; ``worst`` is the page reservation
         run() admitted it under.  Returns a Completion when the very
         first token already finishes the request."""
+        t_admit = time.perf_counter()
         length = req.prompt.size
         width = -(-length // self.prefill_bucket) * self.prefill_bucket
         self._ensure(row, self.prefix_len + width)
@@ -395,12 +403,14 @@ class ContinuousBatcher:
             self.params, self.pool, self._table()[row:row + 1],
             jnp.asarray(padded), jnp.asarray([length], jnp.int32),
             jnp.asarray([rid], jnp.int32))
-        tok = int(tok)
+        tok = int(tok)                  # host sync: first token is real
+        now = time.perf_counter()
         state = _Row(rid=rid, req=req, pos=self.prefix_len + length, step=1,
-                     last=tok, out=[tok], worst_pages=worst)
+                     last=tok, out=[tok], worst_pages=worst,
+                     t_admit=t_admit, t_first=now)
         active[row] = state
         if tok == req.stop_token or req.max_new_tokens == 1:
-            return Completion(rid=rid, request=req, tokens=list(state.out))
+            return self._completion(state)
         return None
 
     def _step(self, active: Dict[int, _Row],
@@ -429,10 +439,16 @@ class ContinuousBatcher:
             row.last = tok
             if tok == row.req.stop_token or row.step >= \
                     row.req.max_new_tokens:
-                done = Completion(rid=row.rid, request=row.req,
-                                  tokens=list(row.out))
+                done = self._completion(row)
                 self._finish(r, active, free_rows)
                 yield done
+
+    def _completion(self, row: _Row) -> Completion:
+        now = time.perf_counter()
+        return Completion(rid=row.rid, request=row.req,
+                          tokens=list(row.out),
+                          ttft_s=row.t_first - row.t_admit,
+                          total_s=now - row.t_admit)
 
     def _finish(self, row: int, active: Dict[int, _Row],
                 free_rows: List[int]) -> None:
