@@ -175,10 +175,20 @@ def test_window_validation():
         flash_attention(q, q, q, causal=False, window=8)
     with pytest.raises(ValueError, match="window"):
         flash_attention(q, q, q, causal=True, window=0)
-    from tfmesos_tpu.ops.attention import attend
+    # window x sp COMPOSES as of round 4 (ring owner-index masking /
+    # Ulysses pass-through) — equivalence is tested in
+    # tests/test_parallel.py::test_attend_window_sp_composition; here just
+    # assert the former hard-error path now runs.
+    from tfmesos_tpu.ops.attention import attend, mha_reference
     from tfmesos_tpu.parallel.mesh import build_mesh
-    with pytest.raises(ValueError, match="sequence parallelism"):
-        attend(q, q, q, mesh=build_mesh({"sp": 8}), window=8)
+    import numpy as np
+    qr = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 2, 16),
+                           jnp.float32)
+    out = attend(qr, qr, qr, mesh=build_mesh({"sp": 8}), window=8)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(mha_reference(qr, qr, qr, causal=True, window=8)),
+        rtol=2e-5, atol=2e-5)
 
 
 def test_attend_mqa_on_tp_mesh_repeats_to_shard():

@@ -160,6 +160,29 @@ def test_admission_validation(setup):
         list(batcher.run([big]))
 
 
+def test_oversized_request_drains_inflight_before_raising(setup):
+    """A malformed arrival mid-stream must not discard valid in-flight
+    work: already-admitted requests complete and yield first, THEN the
+    ValueError surfaces."""
+    cfg, params = setup
+    good = [Request(prompt=p, max_new_tokens=6)
+            for p in _prompts(cfg, 2, seed=23)]
+    huge = Request(prompt=np.arange(40, dtype=np.int32) % cfg.vocab_size,
+                   max_new_tokens=60)
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                                page_size=16, prefill_bucket=16)
+    done = []
+    with pytest.raises(ValueError, match="max_len"):
+        for c in batcher.run([*good, huge,
+                              Request(prompt=good[0].prompt,
+                                      max_new_tokens=2)]):
+            done.append(c)
+    assert sorted(c.rid for c in done) == [0, 1]    # both good ones landed
+    for c in done:
+        assert c.tokens == _offline(cfg, params, c.request)
+    assert batcher.alloc.rows == {}                 # nothing leaked
+
+
 def test_pool_too_small_raises_not_hangs(setup):
     cfg, params = setup
     # 3 usable pages (4 minus sink) but the request's worst case needs 4.

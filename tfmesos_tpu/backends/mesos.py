@@ -368,6 +368,16 @@ class MesosBackend(ResourceBackend):
         self.log.warning("launch of %d task(s) failed (%s); reporting "
                          "TASK_DROPPED", len(task_ids), why)
         for tid in task_ids:
+            # The failure may be AMBIGUOUS (e.g. the ACCEPT was delivered
+            # but its response timed out): the task might actually be
+            # launching.  Kill the soon-to-be-stale id first — a no-op if
+            # it never ran, and it stops a zombie from holding resources
+            # if it did.  Guarded separately: a failed kill must not skip
+            # the drop, and neither may strand the remaining tasks.
+            try:
+                self.kill(tid)
+            except Exception as e:
+                self.log.warning("kill of %s failed: %s", tid[:8], e)
             try:
                 self._scheduler.on_status(TaskStatus(tid, "TASK_DROPPED",
                                                      message=why))

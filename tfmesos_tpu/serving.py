@@ -332,12 +332,16 @@ class ContinuousBatcher:
         arrivals naturally), yielding :class:`Completion`\\ s in FINISH
         order.  Pulls from the iterable lazily: a request is consumed
         only when a row and pages are available for it.  Abandoning the
-        iterator early releases every in-flight row's pages."""
+        iterator early releases every in-flight row's pages.  An invalid
+        request (longer than ``max_len`` allows) raises — but only AFTER
+        every already-admitted request has drained and yielded, so one
+        malformed arrival never discards valid in-flight work."""
         source = iter(requests)
         pending: deque = deque()
         active: Dict[int, _Row] = {}
         free_rows = list(range(self.rows))
         exhausted = False
+        bad_request: Optional[Exception] = None
 
         def pull():
             nonlocal exhausted
@@ -351,11 +355,15 @@ class ContinuousBatcher:
             while True:
                 # Admit while a row is free and the pool can take the
                 # newcomer's worst case.
-                while free_rows:
+                while free_rows and bad_request is None:
                     pull()
                     if not pending:
                         break
-                    worst = self._worst_pages(pending[0])
+                    try:
+                        worst = self._worst_pages(pending[0])
+                    except ValueError as e:
+                        bad_request = e     # raise after draining
+                        break
                     if worst > self._reserve_headroom(active):
                         if not active:
                             raise RuntimeError(
@@ -372,6 +380,8 @@ class ContinuousBatcher:
                         self._finish(row, active, free_rows)
                         yield done
                 if not active:
+                    if bad_request is not None:
+                        raise bad_request
                     pull()
                     if not pending and exhausted:
                         return
