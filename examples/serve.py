@@ -43,12 +43,21 @@ def main():
                         "RUNNING paged decode as rows free up "
                         "(serving.ContinuousBatcher; --batch sets the "
                         "concurrent-row count)")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   dest="prefill_chunk",
+                   help="chunked prefill (with --continuous): write "
+                        "prompts in chunks of this many tokens, "
+                        "interleaved with decode steps — bounds the "
+                        "stall a long prompt imposes on decoding rows")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tiny", action="store_true")
     args = p.parse_args()
     if args.paged and args.continuous:
         p.error("--paged and --continuous are distinct serving modes: "
                 "--continuous already serves from a paged pool (pick one)")
+    if args.prefill_chunk is not None and not args.continuous:
+        p.error("--prefill-chunk is a continuous-batching feature; "
+                "add --continuous")
 
     import jax
     import jax.numpy as jnp
@@ -112,7 +121,8 @@ def main():
             cfg, params, rows=args.batch, page_size=64,
             temperature=args.temperature,
             rng=jax.random.PRNGKey(args.seed + 1),
-            quantized_cache=args.int8_kv)
+            quantized_cache=args.int8_kv,
+            prefill_chunk=args.prefill_chunk)
         sink = open(args.out, "w") if args.out else sys.stdout
         served = 0
         t0 = time.perf_counter()

@@ -97,6 +97,11 @@ class _Row:
     worst_pages: int    # admission-time reservation
     t_admit: float = 0.0    # perf_counter at prefill start
     t_first: float = 0.0    # ... at first-token availability
+    # Chunked-prefill state (prefill_chunk mode): the padded prompt and
+    # how much of it has been written; rows decode only once filled.
+    padded: Optional[np.ndarray] = None
+    filled: int = 0
+    decoding: bool = True
 
 
 class ContinuousBatcher:
@@ -109,6 +114,18 @@ class ContinuousBatcher:
     sampling config for the whole batcher (greedy at temperature 0);
     ``rng`` takes either key flavor (raw uint32 pair or typed
     ``jax.random.key``) — it is only ever folded in-graph.
+
+    ``prefill_chunk`` (optional) turns on CHUNKED PREFILL: instead of
+    prefilling a whole prompt in one call (stalling every decoding row
+    for the full prompt length), admission writes the prompt in
+    fixed-size chunks interleaved one-per-tick with the batched decode
+    step — the stall per decoded token is bounded by one chunk's
+    compute, whatever the prompt length.  Chunks of <= 64 ride the
+    chunked flash-decode kernel on TPU.  The chunk size becomes the
+    prompt padding bucket.  Note the chunked path runs every chunk
+    through cache-attention (not the fused self-attention prefill), so
+    greedy outputs can differ from the unchunked batcher only by
+    float-tie argmax flips.
 
     ``prefix`` (1-D int32, optional) is a SHARED prompt prefix (system
     prompt), prefilled ONCE into reserved pool pages that every row's
@@ -126,7 +143,8 @@ class ContinuousBatcher:
                  n_pages: Optional[int] = None, prefill_bucket: int = 64,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, rng=None,
-                 quantized_cache: bool = False, prefix=None):
+                 quantized_cache: bool = False, prefix=None,
+                 prefill_chunk: Optional[int] = None):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         self.cfg = cfg
@@ -150,6 +168,12 @@ class ContinuousBatcher:
                        * self.page_size)
         own_max = -(-(self.max_len - shared_full) // self.page_size)
         self.n_pages = int(n_pages or rows * own_max + n_prefix_pages + 1)
+        if prefill_chunk is not None:
+            if prefill_chunk < 1 or prefill_chunk % 8:
+                raise ValueError(f"prefill_chunk ({prefill_chunk}) must be "
+                                 f"a positive multiple of 8")
+            prefill_bucket = prefill_chunk
+        self.prefill_chunk = prefill_chunk
         self.prefill_bucket = int(prefill_bucket)
         self.temperature = temperature
         self.top_k = top_k
@@ -168,8 +192,12 @@ class ContinuousBatcher:
         self._tail_template: Optional[int] = None  # partial-page template
         self._prefill_fns: Dict[int, Any] = {}
         self._decode = self._make_decode()
+        self._chunk_prefill = (self._make_chunk_prefill()
+                               if prefill_chunk is not None else None)
         self._next_rid = 0
         self._table_cache = None        # device table; rebuilt when dirty
+        self._table_cache_np = None     # host master copy of the table
+        self._masked_cache = None       # (filling_rows, device table)
         self.peak_pages_used = 0        # observability: high-water mark
         if prefix_np is not None:
             self._init_prefix(prefix_np)
@@ -243,6 +271,23 @@ class ContinuousBatcher:
 
         return fn
 
+    def _make_chunk_prefill(self):
+        """Jitted one-chunk prefill: writes chunk tokens at a TRACED
+        offset (so one compile serves every chunk of every request) and
+        samples the first token when this chunk contains the prompt's
+        last position (cap_idx in range; callers ignore it otherwise)."""
+        @partial(jax.jit, donate_argnums=1)
+        def fn(params, pool, table, chunk, pos, cap_idx, rid):
+            cache = dict(pool, pages=table)
+            logits, cache = decode_step(self.cfg, params, cache, chunk, pos)
+            cap = jnp.clip(cap_idx, 0, chunk.shape[1] - 1)
+            last = jnp.take_along_axis(
+                logits, cap[:, None, None], axis=1)[:, 0]
+            nxt = self._sample(last, rid, jnp.zeros_like(rid))
+            return {"k": cache["k"], "v": cache["v"]}, nxt[0]
+
+        return fn
+
     def _prefill_fn(self, width: int):
         """Jitted single-row prefill at one padded-width bucket."""
         if width not in self._prefill_fns:
@@ -291,39 +336,44 @@ class ContinuousBatcher:
         before = self.alloc.allocated(row)
         self.alloc.ensure(row, max(0, length - self._shared_len))
         if self.alloc.allocated(row) != before:
-            self._table_cache = None
+            self._table_cache = self._table_cache_np = None
+            self._masked_cache = None
         used = self.n_pages - self.alloc.free_count()
         if used > self.peak_pages_used:
             self.peak_pages_used = used
 
     def _release(self, row: int) -> None:
         self.alloc.release(row)
-        self._table_cache = None
+        self._table_cache = self._table_cache_np = None
+        self._masked_cache = None
 
     def _table(self) -> jnp.ndarray:
         """Fixed-shape [rows, np_max] device table, rebuilt only when the
         allocation actually changed (page-boundary growth, admission,
         release) — not every token."""
         if self._table_cache is None:
-            if not self._shared_pages:
-                self._table_cache = self.alloc.table(
-                    range(self.rows), width=self.np_max,
-                    fill=self._sink_page)
-            else:
-                # Rows WITH allocations see [shared prefix pages | own
-                # pages]; rows without stay all-sink (an inactive row
-                # writes its garbage step at position 0 — that must never
-                # land on a shared page).
-                t = np.full((self.rows, self.np_max), self._sink_page,
-                            np.int32)
-                ns = len(self._shared_pages)
-                for r in range(self.rows):
-                    own = self.alloc.rows.get(r)
-                    if own:
-                        t[r, :ns] = self._shared_pages
-                        t[r, ns:ns + len(own)] = own
-                self._table_cache = jnp.asarray(t)
+            self._table_cache = jnp.asarray(self._table_np())
         return self._table_cache
+
+    def _table_np(self) -> np.ndarray:
+        """Host master copy of the table (chunked prefill masks per-step
+        variants off it)."""
+        if self._table_cache_np is None:
+            # Rows WITH allocations see [shared prefix pages | own pages];
+            # rows without stay all-sink (an inactive row writes its
+            # garbage step at position 0 — that must never land on a
+            # shared or live page).
+            t = np.full((self.rows, self.np_max), self._sink_page,
+                        np.int32)
+            ns = len(self._shared_pages)
+            for r in range(self.rows):
+                own = self.alloc.rows.get(r)
+                if own:
+                    if ns:
+                        t[r, :ns] = self._shared_pages
+                    t[r, ns:ns + len(own)] = own
+            self._table_cache_np = t
+        return self._table_cache_np
 
     # -- the loop ---------------------------------------------------------
 
@@ -386,7 +436,14 @@ class ContinuousBatcher:
                     if not pending and exhausted:
                         return
                     continue
-                yield from self._step(active, free_rows)
+                if self._chunk_prefill is not None:
+                    done_row = self._advance_prefill(active)
+                    if done_row is not None:
+                        done = self._completion(active[done_row])
+                        self._finish(done_row, active, free_rows)
+                        yield done
+                if any(row.decoding for row in active.values()):
+                    yield from self._step(active, free_rows)
         finally:
             # A consumer that stops early (break / close) must not leak
             # the in-flight rows' pages.
@@ -409,6 +466,15 @@ class ContinuousBatcher:
                 self.pool, self._tail_template, self.alloc.rows[row][0])
         padded = np.zeros((1, width), np.int32)
         padded[0, :length] = req.prompt
+        if self._chunk_prefill is not None:
+            # Chunked mode: no model call here — the run loop advances one
+            # chunk per tick, interleaved with the batched decode step.
+            state = _Row(rid=rid, req=req, pos=self.prefix_len + length,
+                         step=1, last=0, out=[], worst_pages=worst,
+                         t_admit=t_admit, padded=padded, filled=0,
+                         decoding=False)
+            active[row] = state
+            return None
         self.pool, tok = self._prefill_fn(width)(
             self.params, self.pool, self._table()[row:row + 1],
             jnp.asarray(padded), jnp.asarray([length], jnp.int32),
@@ -423,24 +489,75 @@ class ContinuousBatcher:
             return self._completion(state)
         return None
 
+    def _advance_prefill(self, active: Dict[int, _Row]) -> Optional[int]:
+        """Write ONE chunk of the oldest still-prefilling row; flips the
+        row to decoding once its whole padded prompt is in.  Returns the
+        row id when that row just finished a request outright (first
+        token == stop, or max_new_tokens == 1)."""
+        filling = [(row.rid, r) for r, row in active.items()
+                   if not row.decoding]
+        if not filling:
+            return None
+        _, r = min(filling)
+        row = active[r]
+        c = self.prefill_chunk
+        chunk = row.padded[:, row.filled:row.filled + c]
+        length = row.req.prompt.size
+        cap = length - 1 - row.filled       # in-range only on last chunk
+        self.pool, tok = self._chunk_prefill(
+            self.params, self.pool, self._table()[r:r + 1],
+            jnp.asarray(chunk),
+            jnp.asarray(self.prefix_len + row.filled, jnp.int32),
+            jnp.asarray([cap], jnp.int32),
+            jnp.asarray([row.rid], jnp.int32))
+        row.filled += c
+        if row.filled < row.padded.shape[1]:
+            return None
+        tok = int(tok)                      # the capture chunk's sample
+        row.t_first = time.perf_counter()
+        row.last = tok
+        row.out.append(tok)
+        row.decoding = True
+        if tok == row.req.stop_token or row.req.max_new_tokens == 1:
+            return r
+        return None
+
     def _step(self, active: Dict[int, _Row],
               free_rows: List[int]) -> Iterator[Completion]:
-        """One batched decode step over every active row."""
+        """One batched decode step over every DECODING row (chunked
+        prefill keeps still-filling rows out: their table rows mask to
+        the sink so the batched scatter cannot touch their pages)."""
         toks = np.zeros((self.rows,), np.int32)
         positions = np.zeros((self.rows,), np.int32)
         rids = np.zeros((self.rows,), np.int32)
         steps = np.zeros((self.rows,), np.int32)
-        for r, row in active.items():
+        decoding = {r: row for r, row in active.items() if row.decoding}
+        for r, row in decoding.items():
             self._ensure(r, row.pos + 1)    # this step writes `pos`
             toks[r] = row.last
             positions[r] = row.pos
             rids[r] = row.rid
             steps[r] = row.step
+        if len(decoding) == len(active):
+            table = self._table()
+        else:
+            # Masked variant (still-filling rows -> sink), cached until
+            # the allocation OR the filling set changes — steady-state
+            # admission must not re-upload the table every token.
+            filling = frozenset(r for r, row in active.items()
+                                if not row.decoding)
+            if self._masked_cache is None or \
+                    self._masked_cache[0] != filling:
+                t = self._table_np().copy()
+                for r in filling:
+                    t[r, :] = self._sink_page
+                self._masked_cache = (filling, jnp.asarray(t))
+            table = self._masked_cache[1]
         self.pool, nxt = self._decode(
-            self.params, self.pool, self._table(), jnp.asarray(toks),
+            self.params, self.pool, table, jnp.asarray(toks),
             jnp.asarray(positions), jnp.asarray(rids), jnp.asarray(steps))
         nxt = np.asarray(nxt)
-        for r in list(active):
+        for r in list(decoding):
             row = active[r]
             tok = int(nxt[r])
             row.out.append(tok)
