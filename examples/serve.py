@@ -18,6 +18,8 @@ import json
 import sys
 import time
 
+SPEC_N_DRAFT = 4    # draft tokens per speculative round (--speculative)
+
 
 def main():
     p = argparse.ArgumentParser()
@@ -43,6 +45,12 @@ def main():
                         "RUNNING paged decode as rows free up "
                         "(serving.ContinuousBatcher; --batch sets the "
                         "concurrent-row count)")
+    p.add_argument("--speculative", action="store_true",
+                   help="speculative continuous batching (greedy, with "
+                        f"--continuous): a half-size draft proposes "
+                        f"{SPEC_N_DRAFT} tokens per tick, the target "
+                        "verifies them in one ragged chunk — outputs "
+                        "identical to target-only serving")
     p.add_argument("--prefill-chunk", type=int, default=None,
                    dest="prefill_chunk",
                    help="chunked prefill (with --continuous): write "
@@ -58,6 +66,16 @@ def main():
     if args.prefill_chunk is not None and not args.continuous:
         p.error("--prefill-chunk is a continuous-batching feature; "
                 "add --continuous")
+    if args.speculative:
+        if not args.continuous:
+            p.error("--speculative here is a continuous-batching "
+                    "feature; add --continuous (offline speculative "
+                    "serving lives in examples/generate.py)")
+        if args.temperature > 0:
+            p.error("--speculative continuous serving is greedy-only")
+        if args.prefill_chunk is not None:
+            p.error("--speculative does not compose with --prefill-chunk "
+                    "yet")
 
     import jax
     import jax.numpy as jnp
@@ -114,15 +132,40 @@ def main():
     if args.continuous:
         from tfmesos_tpu.serving import ContinuousBatcher, Request
 
+        # Continuous mode has its own (tighter) length bound: prompts pad
+        # to the prefill bucket, and speculative rounds overshoot by
+        # n_draft on both the cache depth and the write high-water mark.
+        nd = SPEC_N_DRAFT if args.speculative else 0
+        bucket = args.prefill_chunk or 64
+        ml = cfg.max_seq_len - nd
+        climit = min((ml - nd) // bucket * bucket,
+                     ml - nd - args.new_tokens + 1)
+        if any(len(t) > climit for t in prompts):
+            print(f"serve: a prompt exceeds the continuous-serving limit "
+                  f"({climit} tokens at new-tokens={args.new_tokens}"
+                  f"{', speculative' if args.speculative else ''})",
+                  file=sys.stderr)
+            return 1
         reqs = [Request(prompt=np.asarray(t, np.int32),
                         max_new_tokens=args.new_tokens,
                         stop_token=args.stop_token) for t in prompts]
+        draft_cfg = draft_params = None
+        if args.speculative:
+            draft_cfg = transformer.TransformerConfig(
+                vocab_size=cfg.vocab_size, d_model=cfg.d_model // 2,
+                n_layers=max(1, cfg.n_layers // 2), n_heads=cfg.n_heads,
+                d_ff=cfg.d_ff // 2, max_seq_len=cfg.max_seq_len,
+                dtype=cfg.dtype)
+            draft_params = transformer.init_params(
+                draft_cfg, jax.random.PRNGKey(args.seed + 4))
         batcher = ContinuousBatcher(
-            cfg, params, rows=args.batch, page_size=64,
+            cfg, params, rows=args.batch, page_size=64, max_len=ml,
             temperature=args.temperature,
             rng=jax.random.PRNGKey(args.seed + 1),
             quantized_cache=args.int8_kv,
-            prefill_chunk=args.prefill_chunk)
+            prefill_chunk=args.prefill_chunk,
+            draft_cfg=draft_cfg, draft_params=draft_params,
+            n_draft=SPEC_N_DRAFT)
         sink = open(args.out, "w") if args.out else sys.stdout
         served = 0
         t0 = time.perf_counter()

@@ -201,6 +201,82 @@ def test_chunked_prefill_timing_and_stop(setup):
     assert 0.0 < done[0].ttft_s <= done[0].total_s
 
 
+@pytest.fixture(scope="module")
+def draft_setup():
+    cfg = transformer.TransformerConfig(
+        vocab_size=97, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        max_seq_len=128, dtype=jnp.float32)
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(5))
+
+
+@pytest.mark.parametrize("perfect_draft", [False, True])
+def test_speculative_batcher_matches_plain(setup, draft_setup,
+                                           perfect_draft):
+    """Speculative continuous batching (greedy): outputs equal the
+    target-only batcher's for ANY draft — an unrelated weak draft and a
+    perfect one (draft == target, every proposal accepted)."""
+    cfg, params = setup
+    dcfg, dparams = (cfg, params) if perfect_draft else draft_setup
+    reqs = lambda: [Request(prompt=p, max_new_tokens=2 + (i % 6))
+                    for i, p in enumerate(_prompts(cfg, 7, seed=37))]
+    kw = dict(rows=3, max_len=64, page_size=16, prefill_bucket=16)
+    plain = ContinuousBatcher(cfg, params, **kw)
+    want = {c.rid: c.tokens for c in plain.run(reqs())}
+    spec = ContinuousBatcher(cfg, params, draft_cfg=dcfg,
+                             draft_params=dparams, n_draft=3, **kw)
+    rounds = {"n": 0}
+    inner = spec._spec_round
+
+    def counting(*a):
+        rounds["n"] += 1
+        return inner(*a)
+
+    spec._spec_round = counting
+    got = {c.rid: c.tokens for c in spec.run(reqs())}
+    for rid in want:
+        _assert_tokens_match_modulo_ties(
+            cfg, params, None, reqs()[rid].prompt, got[rid], want[rid])
+    assert spec.alloc.rows == {}
+    if perfect_draft:
+        # Every proposal accepted: each round commits k+1 tokens per row,
+        # so the whole stream needs far fewer rounds than tokens.
+        total_tokens = sum(len(t) for t in want.values())
+        assert rounds["n"] < total_tokens / 2
+
+
+def test_speculative_batcher_stop_token(setup, draft_setup):
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    probe = Request(prompt=_prompts(cfg, 1, seed=41)[0], max_new_tokens=10)
+    ref = _offline(cfg, params, probe)
+    stop = ref[min(3, len(ref) - 1)]
+    req = Request(prompt=probe.prompt, max_new_tokens=10, stop_token=stop)
+    b = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                          prefill_bucket=16, draft_cfg=dcfg,
+                          draft_params=dparams, n_draft=4)
+    done = list(b.run([req]))
+    assert done[0].tokens == _offline(cfg, params, req)
+    assert done[0].tokens[-1] == stop
+
+
+def test_speculative_batcher_validation(setup, draft_setup):
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    base = dict(rows=1, max_len=64, page_size=16, draft_cfg=dcfg,
+                draft_params=dparams)
+    with pytest.raises(ValueError, match="greedy-only"):
+        ContinuousBatcher(cfg, params, temperature=0.5, **base)
+    with pytest.raises(ValueError, match="prefix/prefill_chunk"):
+        ContinuousBatcher(cfg, params,
+                          prefix=np.zeros((4,), np.int32), **base)
+    with pytest.raises(ValueError, match="come together"):
+        ContinuousBatcher(cfg, params, rows=1, draft_cfg=dcfg)
+    with pytest.raises(ValueError, match="cover max_len"):
+        ContinuousBatcher(cfg, params, rows=1, max_len=128,
+                          page_size=16, draft_cfg=dcfg,
+                          draft_params=dparams, n_draft=4)
+
+
 def test_completion_timing_metrics(setup):
     cfg, params = setup
     batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,

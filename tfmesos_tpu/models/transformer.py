@@ -1298,6 +1298,23 @@ def beam_search(cfg: TransformerConfig, params, prompt,
     return out
 
 
+def greedy_accept_counts(drafts, g):
+    """Greedy speculative acceptance: given draft proposals [B, k] and
+    the target's greedy tokens over the verify chunk [B, k+1], return
+    the per-row commit count — the leading run of draft==target matches
+    plus one (the target's correction, or its bonus token when every
+    proposal matched).  Shared by ``speculative_generate`` and the
+    continuous batcher's speculative rounds (the subtle bit is the
+    argmin-over-[match|False] form: it returns the FIRST mismatch index,
+    or k when there is none)."""
+    k = drafts.shape[1]
+    match = drafts == g[:, :k]
+    a = jnp.argmin(jnp.concatenate(
+        [match, jnp.zeros((match.shape[0], 1), bool)],
+        axis=1).astype(jnp.int32), axis=1)
+    return a + 1
+
+
 def speculative_cache_depth(prompt_len: int, max_new_tokens: int,
                             n_draft: int, prefix_len: int = 0) -> int:
     """Cache positions ``speculative_generate`` may touch (its overshoot
@@ -1442,11 +1459,9 @@ def speculative_generate(cfg: TransformerConfig, params,
         chunk = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, k+1]
         lg, cache = decode_step(cfg, params, cache, chunk, pos)
         g = jnp.argmax(lg, -1).astype(jnp.int32)        # [B, k+1] greedy
-        match = drafts == g[:, :k]                      # [B, k]
-        a = jnp.argmin(jnp.concatenate(
-            [match, jnp.zeros((b, 1), bool)], axis=1).astype(jnp.int32),
-            axis=1)                                     # leading-run length
-        n_commit = jnp.where(active, a + 1, 0)
+        counts = greedy_accept_counts(drafts, g)
+        a = counts - 1                                  # leading-run length
+        n_commit = jnp.where(active, counts, 0)
         out = commit(out, pos, n_commit, g)
         tok = jnp.where(active,
                         jnp.take_along_axis(g, a[:, None], axis=1)[:, 0],

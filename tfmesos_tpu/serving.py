@@ -44,8 +44,9 @@ import jax
 import jax.numpy as jnp
 
 from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
-                                            decode_step, init_paged_cache,
-                                            sample_logits)
+                                            decode_step,
+                                            greedy_accept_counts,
+                                            init_paged_cache, sample_logits)
 
 __all__ = ["Request", "Completion", "ContinuousBatcher"]
 
@@ -115,6 +116,17 @@ class ContinuousBatcher:
     ``rng`` takes either key flavor (raw uint32 pair or typed
     ``jax.random.key``) — it is only ever folded in-graph.
 
+    ``draft_cfg``/``draft_params`` (optional, greedy only) turn on
+    SPECULATIVE decoding inside the batcher: every tick, the draft
+    proposes ``n_draft`` tokens per row (batched t=1 steps on its own
+    contiguous cache) and the target verifies them in ONE ragged chunk
+    over the paged pool — rows commit their leading accepted run plus
+    the target's correction, so each tick emits 1..n_draft+1 tokens per
+    row instead of exactly 1.  Greedy outputs equal the target-only
+    batcher's (modulo float-tie argmax forks).  Composes with stop
+    tokens, staggered admission, and int8 target pools; not (yet) with
+    ``prefix``, ``prefill_chunk``, or sampling.
+
     ``prefill_chunk`` (optional) turns on CHUNKED PREFILL: instead of
     prefilling a whole prompt in one call (stalling every decoding row
     for the full prompt length), admission writes the prompt in
@@ -144,7 +156,9 @@ class ContinuousBatcher:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, rng=None,
                  quantized_cache: bool = False, prefix=None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 draft_cfg: Optional[TransformerConfig] = None,
+                 draft_params=None, n_draft: int = 4):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         self.cfg = cfg
@@ -194,6 +208,32 @@ class ContinuousBatcher:
         self._decode = self._make_decode()
         self._chunk_prefill = (self._make_chunk_prefill()
                                if prefill_chunk is not None else None)
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        self.n_draft = int(n_draft)
+        if (draft_cfg is None) != (draft_params is None):
+            raise ValueError("draft_cfg and draft_params come together")
+        if draft_cfg is not None:
+            if self.temperature > 0.0:
+                raise ValueError("speculative continuous batching is "
+                                 "greedy-only for now (temperature 0)")
+            if prefix is not None or prefill_chunk is not None:
+                raise ValueError("speculative mode does not compose with "
+                                 "prefix/prefill_chunk yet")
+            if self.n_draft < 1:
+                raise ValueError(f"n_draft must be >= 1, got {n_draft}")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError("draft and target must share a vocab")
+            depth = self.max_len + self.n_draft
+            if draft_cfg.max_seq_len < depth:
+                raise ValueError(
+                    f"draft max_seq_len ({draft_cfg.max_seq_len}) must "
+                    f"cover max_len + n_draft ({depth}) — rows can "
+                    f"overshoot by a draft run")
+            from tfmesos_tpu.models.transformer import init_cache
+            self._draft_cache = init_cache(draft_cfg, rows, depth)
+            self._draft_prefills: Dict[int, Any] = {}
+            self._spec_round = self._make_spec_round()
         self._next_rid = 0
         self._table_cache = None        # device table; rebuilt when dirty
         self._table_cache_np = None     # host master copy of the table
@@ -271,6 +311,55 @@ class ContinuousBatcher:
 
         return fn
 
+    def _make_spec_round(self):
+        """Jitted greedy speculative round: k batched draft steps on the
+        draft's contiguous cache, then one ragged (k+1)-token target
+        verify over the paged pool.  Returns the target's greedy tokens
+        [rows, k+1] and each row's commit count (leading accepted run +
+        correction)."""
+        k = self.n_draft
+
+        @partial(jax.jit, donate_argnums=(1, 3))
+        def fn(params, pool, dparams, dcache, table, toks, positions):
+            def dstep(carry, _):
+                dc, dtok, dpos = carry
+                lg, dc = decode_step(self.draft_cfg, dparams, dc,
+                                     dtok[:, None], dpos)
+                nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+                return (dc, nxt, dpos + 1), nxt
+
+            (dcache, _, _), drafts = jax.lax.scan(
+                dstep, (dcache, toks, positions), None, length=k)
+            drafts = jnp.moveaxis(drafts, 0, 1)             # [rows, k]
+            chunk = jnp.concatenate([toks[:, None], drafts], axis=1)
+            cache = dict(pool, pages=table)
+            lg, cache = decode_step(self.cfg, params, cache, chunk,
+                                    positions)
+            g = jnp.argmax(lg, -1).astype(jnp.int32)        # [rows, k+1]
+            n_commit = greedy_accept_counts(drafts, g)
+            return ({"k": cache["k"], "v": cache["v"]}, dcache, g,
+                    n_commit)
+
+        return fn
+
+    def _draft_prefill_fn(self, width: int):
+        """Jitted draft prefill of one row (sliced out of the batched
+        draft cache at a traced row index)."""
+        if width not in self._draft_prefills:
+            @partial(jax.jit, donate_argnums=1)
+            def fn(dparams, dcache, prompt, row):
+                rowc = jax.tree_util.tree_map(
+                    lambda x: jax.lax.dynamic_slice_in_dim(x, row, 1, 1),
+                    dcache)
+                _, rowc = decode_step(self.draft_cfg, dparams, rowc,
+                                      prompt, 0)
+                return jax.tree_util.tree_map(
+                    lambda full, rc: jax.lax.dynamic_update_slice_in_dim(
+                        full, rc, row, 1), dcache, rowc)
+
+            self._draft_prefills[width] = fn
+        return self._draft_prefills[width]
+
     def _make_chunk_prefill(self):
         """Jitted one-chunk prefill: writes chunk tokens at a TRACED
         offset (so one compile serves every chunk of every request) and
@@ -316,6 +405,10 @@ class ContinuousBatcher:
             self.prefill_bucket
         need_len = self.prefix_len + max(
             width, req.prompt.size + req.max_new_tokens - 1)
+        if self.draft_cfg is not None:
+            # A speculative round at the final position still verifies a
+            # (k+1)-token chunk: its writes overshoot by up to n_draft.
+            need_len += self.n_draft
         if need_len > self.max_len:
             raise ValueError(
                 f"request needs {need_len} cache positions (prefix "
@@ -443,7 +536,10 @@ class ContinuousBatcher:
                         self._finish(done_row, active, free_rows)
                         yield done
                 if any(row.decoding for row in active.values()):
-                    yield from self._step(active, free_rows)
+                    if self.draft_cfg is not None:
+                        yield from self._step_spec(active, free_rows)
+                    else:
+                        yield from self._step(active, free_rows)
         finally:
             # A consumer that stops early (break / close) must not leak
             # the in-flight rows' pages.
@@ -479,6 +575,10 @@ class ContinuousBatcher:
             self.params, self.pool, self._table()[row:row + 1],
             jnp.asarray(padded), jnp.asarray([length], jnp.int32),
             jnp.asarray([rid], jnp.int32))
+        if self.draft_cfg is not None:
+            self._draft_cache = self._draft_prefill_fn(width)(
+                self.draft_params, self._draft_cache, jnp.asarray(padded),
+                jnp.asarray(row, jnp.int32))
         tok = int(tok)                  # host sync: first token is real
         now = time.perf_counter()
         state = _Row(rid=rid, req=req, pos=self.prefix_len + length, step=1,
@@ -566,6 +666,49 @@ class ContinuousBatcher:
             row.last = tok
             if tok == row.req.stop_token or row.step >= \
                     row.req.max_new_tokens:
+                done = self._completion(row)
+                self._finish(r, active, free_rows)
+                yield done
+
+    def _step_spec(self, active: Dict[int, _Row],
+                   free_rows: List[int]) -> Iterator[Completion]:
+        """One speculative round over every decoding row: commit each
+        row's leading accepted run + correction (1..n_draft+1 tokens)."""
+        toks = np.zeros((self.rows,), np.int32)
+        positions = np.zeros((self.rows,), np.int32)
+        decoding = {r: row for r, row in active.items() if row.decoding}
+        for r, row in decoding.items():
+            # The verify chunk writes positions [pos, pos + n_draft].
+            self._ensure(r, row.pos + self.n_draft + 1)
+            toks[r] = row.last
+            positions[r] = row.pos
+        # Speculative mode excludes prefill_chunk (__init__), so every
+        # active row is decoding — no still-filling rows to sink-mask.
+        assert len(decoding) == len(active)
+        table = self._table()
+        self.pool, self._draft_cache, g, n_commit = self._spec_round(
+            self.params, self.pool, self.draft_params, self._draft_cache,
+            table, jnp.asarray(toks), jnp.asarray(positions))
+        g = np.asarray(g)
+        n_commit = np.asarray(n_commit)
+        for r in list(decoding):
+            row = active[r]
+            emit = list(g[r, :int(n_commit[r])])
+            # Quota and stop truncation: either way the row FINISHES, so
+            # the committed-stream/cache consistency question is moot.
+            remaining = row.req.max_new_tokens - row.step
+            emit = emit[:remaining]
+            if row.req.stop_token is not None and \
+                    row.req.stop_token in emit:
+                emit = emit[:emit.index(row.req.stop_token) + 1]
+            row.out.extend(int(t) for t in emit)
+            row.step += len(emit)
+            row.pos += len(emit)
+            row.last = int(emit[-1]) if emit else row.last
+            if (row.step >= row.req.max_new_tokens
+                    or (row.req.stop_token is not None
+                        and row.out and row.out[-1]
+                        == row.req.stop_token)):
                 done = self._completion(row)
                 self._finish(r, active, free_rows)
                 yield done
