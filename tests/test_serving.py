@@ -259,6 +259,31 @@ def test_speculative_batcher_stop_token(setup, draft_setup):
     assert done[0].tokens[-1] == stop
 
 
+@pytest.mark.parametrize("prefix_len", [16, 13, 21])
+def test_speculative_batcher_with_shared_prefix(setup, draft_setup,
+                                                prefix_len):
+    """prefix x speculative: the draft carries the broadcast prefix in
+    its cache, the target its shared pages — outputs still equal the
+    (prefix-sharing) target-only batcher's.  Covers aligned, tail-only,
+    and full+tail prefix page layouts (page_size 16)."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    prefix = np.random.RandomState(43).randint(
+        0, cfg.vocab_size, size=prefix_len).astype(np.int32)
+    reqs = lambda: [Request(prompt=p, max_new_tokens=3 + (i % 4))
+                    for i, p in enumerate(_prompts(cfg, 5, seed=44))]
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_bucket=16,
+              prefix=prefix)
+    plain = ContinuousBatcher(cfg, params, **kw)
+    want = {c.rid: c.tokens for c in plain.run(reqs())}
+    spec = ContinuousBatcher(cfg, params, draft_cfg=dcfg,
+                             draft_params=dparams, n_draft=3, **kw)
+    got = {c.rid: c.tokens for c in spec.run(reqs())}
+    for rid in want:
+        _assert_tokens_match_modulo_ties(
+            cfg, params, prefix, reqs()[rid].prompt, got[rid], want[rid])
+
+
 def test_speculative_batcher_validation(setup, draft_setup):
     cfg, params = setup
     dcfg, dparams = draft_setup
@@ -266,9 +291,8 @@ def test_speculative_batcher_validation(setup, draft_setup):
                 draft_params=dparams)
     with pytest.raises(ValueError, match="greedy-only"):
         ContinuousBatcher(cfg, params, temperature=0.5, **base)
-    with pytest.raises(ValueError, match="prefix/prefill_chunk"):
-        ContinuousBatcher(cfg, params,
-                          prefix=np.zeros((4,), np.int32), **base)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatcher(cfg, params, prefill_chunk=16, **base)
     with pytest.raises(ValueError, match="come together"):
         ContinuousBatcher(cfg, params, rows=1, draft_cfg=dcfg)
     with pytest.raises(ValueError, match="cover max_len"):

@@ -124,8 +124,10 @@ class ContinuousBatcher:
     the target's correction, so each tick emits 1..n_draft+1 tokens per
     row instead of exactly 1.  Greedy outputs equal the target-only
     batcher's (modulo float-tie argmax forks).  Composes with stop
-    tokens, staggered admission, and int8 target pools; not (yet) with
-    ``prefix``, ``prefill_chunk``, or sampling.
+    tokens, staggered admission, int8 target pools, and shared
+    prefixes (the draft prefills the prefix once and broadcasts it to
+    every row of its cache); not (yet) with ``prefill_chunk`` or
+    sampling.
 
     ``prefill_chunk`` (optional) turns on CHUNKED PREFILL: instead of
     prefilling a whole prompt in one call (stalling every decoding row
@@ -217,9 +219,9 @@ class ContinuousBatcher:
             if self.temperature > 0.0:
                 raise ValueError("speculative continuous batching is "
                                  "greedy-only for now (temperature 0)")
-            if prefix is not None or prefill_chunk is not None:
+            if prefill_chunk is not None:
                 raise ValueError("speculative mode does not compose with "
-                                 "prefix/prefill_chunk yet")
+                                 "prefill_chunk yet")
             if self.n_draft < 1:
                 raise ValueError(f"n_draft must be >= 1, got {n_draft}")
             if draft_cfg.vocab_size != cfg.vocab_size:
@@ -266,6 +268,21 @@ class ContinuousBatcher:
         self.pool = prefill_prefix(self.params, self.pool,
                                    jnp.asarray(table), jnp.asarray(
                                        prefix[None]))
+        if self.draft_cfg is not None:
+            # The draft conditions on the full context too: prefill the
+            # prefix once at batch 1 and broadcast it to every row of the
+            # draft's contiguous cache.
+            @partial(jax.jit, donate_argnums=1)
+            def draft_prefix(dparams, dcache, toks):
+                row = jax.tree_util.tree_map(lambda x: x[:, :1], dcache)
+                _, row = decode_step(self.draft_cfg, dparams, row, toks, 0)
+                return jax.tree_util.tree_map(
+                    lambda full, rc: jnp.broadcast_to(
+                        rc, full.shape).astype(full.dtype), dcache, row)
+
+            self._draft_cache = draft_prefix(
+                self.draft_params, self._draft_cache,
+                jnp.asarray(prefix[None]))
         if tail:
             # The last prefix page is only partially shared: keep it as a
             # TEMPLATE, copied into each admitted row's first own page
@@ -351,8 +368,11 @@ class ContinuousBatcher:
                 rowc = jax.tree_util.tree_map(
                     lambda x: jax.lax.dynamic_slice_in_dim(x, row, 1, 1),
                     dcache)
+                # With a shared prefix the draft's prompt chunk prefills
+                # at the same offset the target's does (the prefix is
+                # already resident in every draft cache row).
                 _, rowc = decode_step(self.draft_cfg, dparams, rowc,
-                                      prompt, 0)
+                                      prompt, self.prefix_len)
                 return jax.tree_util.tree_map(
                     lambda full, rc: jax.lax.dynamic_update_slice_in_dim(
                         full, rc, row, 1), dcache, rowc)
@@ -675,7 +695,12 @@ class ContinuousBatcher:
         """One speculative round over every decoding row: commit each
         row's leading accepted run + correction (1..n_draft+1 tokens)."""
         toks = np.zeros((self.rows,), np.int32)
-        positions = np.zeros((self.rows,), np.int32)
+        # Rows with no live request still run the jitted round: park their
+        # positions at max_len (within the draft cache's +n_draft slack,
+        # clamped onto the sink page in the paged target) so their dummy
+        # draft writes can never clobber the broadcast prefix at positions
+        # 0..n_draft-1 of a draft-cache row a future request will reuse.
+        positions = np.full((self.rows,), self.max_len, np.int32)
         decoding = {r: row for r, row in active.items() if row.decoding}
         for r, row in decoding.items():
             # The verify chunk writes positions [pos, pos + n_draft].
