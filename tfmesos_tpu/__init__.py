@@ -17,7 +17,7 @@ from contextlib import contextmanager
 from tfmesos_tpu.spec import Job, normalize_jobs
 from tfmesos_tpu.scheduler import ClusterError, RemoteError, TPUMesosScheduler
 
-__VERSION__ = "0.3.0"
+__VERSION__ = "0.4.0"
 
 __all__ = ["cluster", "Job", "TPUMesosScheduler", "ClusterError",
            "RemoteError", "__VERSION__"]
