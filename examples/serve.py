@@ -46,11 +46,13 @@ def main():
                         "(serving.ContinuousBatcher; --batch sets the "
                         "concurrent-row count)")
     p.add_argument("--speculative", action="store_true",
-                   help="speculative continuous batching (greedy, with "
+                   help="speculative continuous batching (with "
                         f"--continuous): a half-size draft proposes "
                         f"{SPEC_N_DRAFT} tokens per tick, the target "
-                        "verifies them in one ragged chunk — outputs "
-                        "identical to target-only serving")
+                        "verifies them in one ragged chunk — greedy "
+                        "outputs identical to target-only serving; "
+                        "sampling is rejection-corrected to the "
+                        "target's exact distribution")
     p.add_argument("--prefill-chunk", type=int, default=None,
                    dest="prefill_chunk",
                    help="chunked prefill (with --continuous): write "
@@ -71,8 +73,6 @@ def main():
             p.error("--speculative here is a continuous-batching "
                     "feature; add --continuous (offline speculative "
                     "serving lives in examples/generate.py)")
-        if args.temperature > 0:
-            p.error("--speculative continuous serving is greedy-only")
         if args.prefill_chunk is not None:
             p.error("--speculative does not compose with --prefill-chunk "
                     "yet")

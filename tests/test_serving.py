@@ -284,13 +284,41 @@ def test_speculative_batcher_with_shared_prefix(setup, draft_setup,
             cfg, params, prefix, reqs()[rid].prompt, got[rid], want[rid])
 
 
+def test_speculative_batcher_sampled_invariance_and_prefix_equality(
+        setup, draft_setup):
+    """Sampled speculative rounds: every draw derives from (rid,
+    token-index) key folds, so (a) outputs are invariant to row packing,
+    and (b) with a PERFECT draft (pd == pt) the first 1 + n_draft tokens
+    reproduce the plain sampled batcher's exactly (same proposal keys;
+    the bonus token is the first salted-stream divergence)."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    k = 3
+    mk = lambda: [Request(prompt=p, max_new_tokens=6)
+                  for p in _prompts(cfg, 5, seed=51)]
+    kw = dict(max_len=64, page_size=16, prefill_bucket=16,
+              temperature=0.8, top_k=20, rng=jax.random.PRNGKey(9))
+    outs = []
+    for rows in (1, 4):
+        b = ContinuousBatcher(cfg, params, rows=rows, draft_cfg=dcfg,
+                              draft_params=dparams, n_draft=k, **kw)
+        outs.append({c.rid: c.tokens for c in b.run(mk())})
+    assert outs[0] == outs[1]
+
+    plain = ContinuousBatcher(cfg, params, rows=2, **kw)
+    want = {c.rid: c.tokens for c in plain.run(mk())}
+    perfect = ContinuousBatcher(cfg, params, rows=2, draft_cfg=cfg,
+                                draft_params=params, n_draft=k, **kw)
+    got = {c.rid: c.tokens for c in perfect.run(mk())}
+    for rid in want:
+        assert got[rid][:1 + k] == want[rid][:1 + k], rid
+
+
 def test_speculative_batcher_validation(setup, draft_setup):
     cfg, params = setup
     dcfg, dparams = draft_setup
     base = dict(rows=1, max_len=64, page_size=16, draft_cfg=dcfg,
                 draft_params=dparams)
-    with pytest.raises(ValueError, match="greedy-only"):
-        ContinuousBatcher(cfg, params, temperature=0.5, **base)
     with pytest.raises(ValueError, match="prefill_chunk"):
         ContinuousBatcher(cfg, params, prefill_chunk=16, **base)
     with pytest.raises(ValueError, match="come together"):

@@ -1315,6 +1315,35 @@ def greedy_accept_counts(drafts, g):
     return a + 1
 
 
+def rejection_accept(drafts, pd, pt, u):
+    """Speculative rejection sampling's accept/correct math, shared by
+    ``speculative_generate`` and the continuous batcher's sampled rounds.
+
+    ``drafts`` [B, k] proposals, ``pd`` [B, k, V] their draft
+    distributions, ``pt`` [B, k+1, V] the target's (filtered)
+    distributions over the verify chunk, ``u`` [B, k] uniform draws.
+    Accept proposal j iff ``u_j < pt(x_j)/pd(x_j)`` (computed as
+    ``u*pd < pt``, robust as pd → 0); ``a`` is the first rejection index
+    (k when all accepted).  Returns ``(a, dist)`` where ``dist`` [B, V]
+    is the correction distribution at index a — norm(max(0, pt − pd)),
+    with pd zero-padded at index k so the all-accepted bonus draw (from
+    pt_k itself) falls out of the same formula."""
+    b, k = drafts.shape
+    ptx = jnp.take_along_axis(pt[:, :k], drafts[..., None], -1)[..., 0]
+    pdx = jnp.take_along_axis(pd, drafts[..., None], -1)[..., 0]
+    acc = u * pdx < ptx
+    a = jnp.argmin(jnp.concatenate(
+        [acc, jnp.zeros((b, 1), bool)], axis=1).astype(jnp.int32), axis=1)
+    pd_pad = jnp.concatenate(
+        [pd, jnp.zeros((b, 1, pd.shape[-1]), pd.dtype)], axis=1)
+    pt_a = jnp.take_along_axis(pt, a[:, None, None], 1)[:, 0]
+    pd_a = jnp.take_along_axis(pd_pad, a[:, None, None], 1)[:, 0]
+    resid = jnp.maximum(pt_a - pd_a, 0.0)
+    norm = jnp.sum(resid, -1, keepdims=True)
+    dist = jnp.where(norm > 1e-9, resid / jnp.maximum(norm, 1e-9), pt_a)
+    return a, dist
+
+
 def speculative_cache_depth(prompt_len: int, max_new_tokens: int,
                             n_draft: int, prefix_len: int = 0) -> int:
     """Cache positions ``speculative_generate`` may touch (its overshoot
@@ -1493,25 +1522,11 @@ def speculative_generate(cfg: TransformerConfig, params,
         pt = jax.nn.softmax(
             filter_logits(lg, temperature, top_k, top_p), -1)  # [B, k+1, V]
 
-        # Accept x_j with prob min(1, pt(x_j)/pd(x_j)); a = leading run.
-        ptx = jnp.take_along_axis(pt[:, :k], drafts[..., None], -1)[..., 0]
-        pdx = jnp.take_along_axis(pd, drafts[..., None], -1)[..., 0]
+        # Accept x_j with prob min(1, pt(x_j)/pd(x_j)); correct at the
+        # first rejection from norm(max(0, pt − pd)) — rejection_accept
+        # carries the shared math.
         u = jax.random.uniform(ka, (b, k))
-        acc = u * pdx < ptx         # u < ptx/pdx, robust as pdx -> 0
-        a = jnp.argmin(jnp.concatenate(
-            [acc, jnp.zeros((b, 1), bool)], axis=1).astype(jnp.int32),
-            axis=1)
-
-        # Correction at the rejection index from norm(max(0, pt - pd));
-        # padding pd with zeros at index k makes the all-accepted bonus
-        # draw (from pt_k itself) the same formula.
-        pd_pad = jnp.concatenate(
-            [pd, jnp.zeros((b, 1, pd.shape[-1]), pd.dtype)], axis=1)
-        pt_a = jnp.take_along_axis(pt, a[:, None, None], 1)[:, 0]
-        pd_a = jnp.take_along_axis(pd_pad, a[:, None, None], 1)[:, 0]
-        resid = jnp.maximum(pt_a - pd_a, 0.0)
-        norm = jnp.sum(resid, -1, keepdims=True)
-        dist = jnp.where(norm > 1e-9, resid / jnp.maximum(norm, 1e-9), pt_a)
+        a, dist = rejection_accept(drafts, pd, pt, u)
         repl = jax.random.categorical(
             kr, jnp.log(dist + 1e-20), axis=-1).astype(jnp.int32)
 

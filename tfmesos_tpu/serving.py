@@ -46,7 +46,8 @@ import jax.numpy as jnp
 from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
                                             decode_step,
                                             greedy_accept_counts,
-                                            init_paged_cache, sample_logits)
+                                            init_paged_cache,
+                                            rejection_accept, sample_logits)
 
 __all__ = ["Request", "Completion", "ContinuousBatcher"]
 
@@ -116,18 +117,22 @@ class ContinuousBatcher:
     ``rng`` takes either key flavor (raw uint32 pair or typed
     ``jax.random.key``) — it is only ever folded in-graph.
 
-    ``draft_cfg``/``draft_params`` (optional, greedy only) turn on
-    SPECULATIVE decoding inside the batcher: every tick, the draft
-    proposes ``n_draft`` tokens per row (batched t=1 steps on its own
-    contiguous cache) and the target verifies them in ONE ragged chunk
-    over the paged pool — rows commit their leading accepted run plus
-    the target's correction, so each tick emits 1..n_draft+1 tokens per
-    row instead of exactly 1.  Greedy outputs equal the target-only
-    batcher's (modulo float-tie argmax forks).  Composes with stop
-    tokens, staggered admission, int8 target pools, and shared
-    prefixes (the draft prefills the prefix once and broadcasts it to
-    every row of its cache); not (yet) with ``prefill_chunk`` or
-    sampling.
+    ``draft_cfg``/``draft_params`` (optional) turn on SPECULATIVE
+    decoding inside the batcher: every tick, the draft proposes
+    ``n_draft`` tokens per row (batched t=1 steps on its own contiguous
+    cache) and the target verifies them in ONE ragged chunk over the
+    paged pool — rows commit their leading accepted run plus the
+    target's correction, so each tick emits 1..n_draft+1 tokens per row
+    instead of exactly 1.  Greedy outputs equal the target-only
+    batcher's (modulo float-tie argmax forks); with ``temperature > 0``
+    the round is Leviathan-style rejection sampling (accept with
+    min(1, pt/pd), corrections from norm(max(0, pt − pd))) whose draws
+    all derive from per-(rid, token-index) key folds — so sampled
+    speculative streams stay invariant to row packing, and committed
+    tokens are distributed exactly as target-only sampling.  Composes
+    with stop tokens, staggered admission, int8 target pools, and
+    shared prefixes (the draft prefills the prefix once and broadcasts
+    it to every row of its cache); not (yet) with ``prefill_chunk``.
 
     ``prefill_chunk`` (optional) turns on CHUNKED PREFILL: instead of
     prefilling a whole prompt in one call (stalling every decoding row
@@ -216,9 +221,6 @@ class ContinuousBatcher:
         if (draft_cfg is None) != (draft_params is None):
             raise ValueError("draft_cfg and draft_params come together")
         if draft_cfg is not None:
-            if self.temperature > 0.0:
-                raise ValueError("speculative continuous batching is "
-                                 "greedy-only for now (temperature 0)")
             if prefill_chunk is not None:
                 raise ValueError("speculative mode does not compose with "
                                  "prefill_chunk yet")
@@ -329,33 +331,82 @@ class ContinuousBatcher:
         return fn
 
     def _make_spec_round(self):
-        """Jitted greedy speculative round: k batched draft steps on the
+        """Jitted speculative round: k batched draft steps on the
         draft's contiguous cache, then one ragged (k+1)-token target
-        verify over the paged pool.  Returns the target's greedy tokens
-        [rows, k+1] and each row's commit count (leading accepted run +
-        correction)."""
+        verify over the paged pool.  Returns the commit candidates
+        [rows, k+1] and each row's commit count.
+
+        Greedy (temperature 0): candidates are the target's greedy
+        tokens, count = leading draft==target run + 1.  Sampling:
+        Leviathan rejection — proposal j draws with key fold(rid,
+        step+j) (the SAME stream the non-speculative batcher uses, so a
+        perfect draft reproduces its proposals), acceptance uses an
+        independent salted fold, and the correction/bonus at the
+        rejection index draws from norm(max(0, pt − pd)) with another
+        salted fold — every draw a pure function of (rid, token index),
+        hence invariant to row packing."""
         k = self.n_draft
+        T, tk_, tp_ = self.temperature, self.top_k, self.top_p
+        sampling = T > 0.0
+        if sampling:
+            from tfmesos_tpu.models.transformer import filter_logits
+
+        def keyf(rid, s):
+            return jax.random.fold_in(jax.random.fold_in(self._rng, rid),
+                                      s)
 
         @partial(jax.jit, donate_argnums=(1, 3))
-        def fn(params, pool, dparams, dcache, table, toks, positions):
-            def dstep(carry, _):
+        def fn(params, pool, dparams, dcache, table, toks, positions,
+               rids, steps):
+            b = toks.shape[0]
+
+            def dstep(carry, j):
                 dc, dtok, dpos = carry
                 lg, dc = decode_step(self.draft_cfg, dparams, dc,
                                      dtok[:, None], dpos)
-                nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
-                return (dc, nxt, dpos + 1), nxt
+                if not sampling:
+                    nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+                    return (dc, nxt, dpos + 1), (nxt, jnp.zeros(()))
+                f = filter_logits(lg[:, -1], T, tk_, tp_)
+                nxt = jax.vmap(
+                    lambda fr, r, s: jax.random.categorical(
+                        keyf(r, s + j), fr).astype(jnp.int32))(
+                    f, rids, steps)
+                return (dc, nxt, dpos + 1), (nxt, jax.nn.softmax(f, -1))
 
-            (dcache, _, _), drafts = jax.lax.scan(
-                dstep, (dcache, toks, positions), None, length=k)
+            (dcache, _, _), (drafts, pd) = jax.lax.scan(
+                dstep, (dcache, toks, positions),
+                jnp.arange(k, dtype=jnp.int32))
             drafts = jnp.moveaxis(drafts, 0, 1)             # [rows, k]
             chunk = jnp.concatenate([toks[:, None], drafts], axis=1)
             cache = dict(pool, pages=table)
             lg, cache = decode_step(self.cfg, params, cache, chunk,
                                     positions)
-            g = jnp.argmax(lg, -1).astype(jnp.int32)        # [rows, k+1]
-            n_commit = greedy_accept_counts(drafts, g)
-            return ({"k": cache["k"], "v": cache["v"]}, dcache, g,
-                    n_commit)
+            pool_out = {"k": cache["k"], "v": cache["v"]}
+            if not sampling:
+                g = jnp.argmax(lg, -1).astype(jnp.int32)    # [rows, k+1]
+                return pool_out, dcache, g, greedy_accept_counts(drafts, g)
+
+            pd = jnp.moveaxis(pd, 0, 1)                     # [rows, k, V]
+            pt = jax.nn.softmax(filter_logits(lg, T, tk_, tp_), -1)
+            u = jax.vmap(lambda r, s: jax.vmap(
+                lambda j: jax.random.uniform(
+                    jax.random.fold_in(keyf(r, s + j), 1)))(
+                jnp.arange(k, dtype=jnp.int32)))(rids, steps)
+            # Accept/correct via the shared rejection math
+            # (transformer.rejection_accept — same code path
+            # speculative_generate's sampling_round runs).
+            a, dist = rejection_accept(drafts, pd, pt, u)
+            repl = jax.vmap(
+                lambda dr, r, s, ar: jax.random.categorical(
+                    jax.random.fold_in(keyf(r, s + ar), 2),
+                    jnp.log(dr + 1e-20)).astype(jnp.int32))(
+                dist, rids, steps, a)
+            j = jnp.arange(k + 1, dtype=jnp.int32)[None]
+            cand = jnp.concatenate(
+                [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            vals = jnp.where(j == a[:, None], repl[:, None], cand)
+            return pool_out, dcache, vals, a + 1
 
         return fn
 
@@ -701,19 +752,24 @@ class ContinuousBatcher:
         # draft writes can never clobber the broadcast prefix at positions
         # 0..n_draft-1 of a draft-cache row a future request will reuse.
         positions = np.full((self.rows,), self.max_len, np.int32)
+        rids = np.zeros((self.rows,), np.int32)
+        steps = np.zeros((self.rows,), np.int32)
         decoding = {r: row for r, row in active.items() if row.decoding}
         for r, row in decoding.items():
             # The verify chunk writes positions [pos, pos + n_draft].
             self._ensure(r, row.pos + self.n_draft + 1)
             toks[r] = row.last
             positions[r] = row.pos
+            rids[r] = row.rid
+            steps[r] = row.step
         # Speculative mode excludes prefill_chunk (__init__), so every
         # active row is decoding — no still-filling rows to sink-mask.
         assert len(decoding) == len(active)
         table = self._table()
         self.pool, self._draft_cache, g, n_commit = self._spec_round(
             self.params, self.pool, self.draft_params, self._draft_cache,
-            table, jnp.asarray(toks), jnp.asarray(positions))
+            table, jnp.asarray(toks), jnp.asarray(positions),
+            jnp.asarray(rids), jnp.asarray(steps))
         g = np.asarray(g)
         n_commit = np.asarray(n_commit)
         for r in list(decoding):
