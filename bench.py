@@ -430,7 +430,8 @@ def bench_serving_continuous(n_requests=32, rows=8):
     done = list(batcher.run(reqs(n_requests)))
     dt = time.perf_counter() - t0
     assert len(done) == n_requests
-    return n_requests / dt
+    mean_ttft_ms = 1000.0 * sum(c.ttft_s for c in done) / n_requests
+    return n_requests / dt, mean_ttft_ms
 
 
 def bench_bandwidth(sizes=None):
@@ -721,7 +722,9 @@ def main():
         flush_partial()
     sv = attempts(bench_serving_continuous, "continuous serving bench", n=1)
     if sv:
-        out["serving_requests_per_sec"] = round(sv[0], 2)
+        rps, ttft_ms = sv[0]
+        out["serving_requests_per_sec"] = round(rps, 2)
+        out["serving_mean_ttft_ms"] = round(ttft_ms, 2)
         flush_partial()
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:
