@@ -73,9 +73,6 @@ def main():
             p.error("--speculative here is a continuous-batching "
                     "feature; add --continuous (offline speculative "
                     "serving lives in examples/generate.py)")
-        if args.prefill_chunk is not None:
-            p.error("--speculative does not compose with --prefill-chunk "
-                    "yet")
 
     import jax
     import jax.numpy as jnp

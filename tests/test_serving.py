@@ -314,13 +314,61 @@ def test_speculative_batcher_sampled_invariance_and_prefix_equality(
         assert got[rid][:1 + k] == want[rid][:1 + k], rid
 
 
+def test_sampled_speculative_chunked_invariance(setup, draft_setup):
+    """Sampled x speculative x chunked: the key schedule stays a pure
+    function of (rid, token index), so row packing cannot change
+    outputs even with chunked prefill interleaving."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    mk = lambda: [Request(prompt=p, max_new_tokens=5)
+                  for p in _prompts(cfg, 5, seed=57)]
+    outs = []
+    for rows in (1, 3):
+        b = ContinuousBatcher(cfg, params, rows=rows, max_len=64,
+                              page_size=16, prefill_chunk=8,
+                              temperature=0.8, top_k=20,
+                              rng=jax.random.PRNGKey(13),
+                              draft_cfg=dcfg, draft_params=dparams,
+                              n_draft=3)
+        outs.append({c.rid: c.tokens for c in b.run(mk())})
+    assert outs[0] == outs[1]
+
+
+@pytest.mark.parametrize("with_prefix", [False, True])
+def test_speculative_with_chunked_prefill(setup, draft_setup,
+                                          with_prefix):
+    """The full composition: speculative rounds x chunked prefill (x
+    prefix).  Greedy outputs must match the plain (unchunked,
+    non-speculative) batcher's modulo float ties; still-filling rows
+    sink-mask during spec rounds and the draft's chunks advance in
+    lockstep."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    rng = np.random.RandomState(53)
+    prefix = (rng.randint(0, cfg.vocab_size, size=11).astype(np.int32)
+              if with_prefix else None)
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (3, 13, 19, 8, 16)]
+    mk = lambda: [Request(prompt=p, max_new_tokens=3 + (i % 4))
+                  for i, p in enumerate(prompts)]
+    kw = dict(rows=3, max_len=96, page_size=16, prefix=prefix)
+    plain = ContinuousBatcher(cfg, params, prefill_bucket=8, **kw)
+    want = {c.rid: c.tokens for c in plain.run(mk())}
+    combo = ContinuousBatcher(cfg, params, prefill_chunk=8,
+                              draft_cfg=dcfg, draft_params=dparams,
+                              n_draft=3, **kw)
+    got = {c.rid: c.tokens for c in combo.run(mk())}
+    for rid in want:
+        _assert_tokens_match_modulo_ties(
+            cfg, params, prefix, prompts[rid], got[rid], want[rid])
+    assert combo.alloc.rows == {}
+
+
 def test_speculative_batcher_validation(setup, draft_setup):
     cfg, params = setup
     dcfg, dparams = draft_setup
     base = dict(rows=1, max_len=64, page_size=16, draft_cfg=dcfg,
                 draft_params=dparams)
-    with pytest.raises(ValueError, match="prefill_chunk"):
-        ContinuousBatcher(cfg, params, prefill_chunk=16, **base)
     with pytest.raises(ValueError, match="come together"):
         ContinuousBatcher(cfg, params, rows=1, draft_cfg=dcfg)
     with pytest.raises(ValueError, match="cover max_len"):

@@ -132,7 +132,8 @@ class ContinuousBatcher:
     tokens are distributed exactly as target-only sampling.  Composes
     with stop tokens, staggered admission, int8 target pools, and
     shared prefixes (the draft prefills the prefix once and broadcasts
-    it to every row of its cache); not (yet) with ``prefill_chunk``.
+    it to every row of its cache), and chunked prefill (the draft's
+    chunks advance in lockstep with the target's).
 
     ``prefill_chunk`` (optional) turns on CHUNKED PREFILL: instead of
     prefilling a whole prompt in one call (stalling every decoding row
@@ -221,9 +222,6 @@ class ContinuousBatcher:
         if (draft_cfg is None) != (draft_params is None):
             raise ValueError("draft_cfg and draft_params come together")
         if draft_cfg is not None:
-            if prefill_chunk is not None:
-                raise ValueError("speculative mode does not compose with "
-                                 "prefill_chunk yet")
             if self.n_draft < 1:
                 raise ValueError(f"n_draft must be >= 1, got {n_draft}")
             if draft_cfg.vocab_size != cfg.vocab_size:
@@ -236,8 +234,8 @@ class ContinuousBatcher:
                     f"overshoot by a draft run")
             from tfmesos_tpu.models.transformer import init_cache
             self._draft_cache = init_cache(draft_cfg, rows, depth)
-            self._draft_prefills: Dict[int, Any] = {}
             self._spec_round = self._make_spec_round()
+            self._draft_chunk = self._make_draft_chunk()
         self._next_rid = 0
         self._table_cache = None        # device table; rebuilt when dirty
         self._table_cache_np = None     # host master copy of the table
@@ -410,26 +408,23 @@ class ContinuousBatcher:
 
         return fn
 
-    def _draft_prefill_fn(self, width: int):
-        """Jitted draft prefill of one row (sliced out of the batched
-        draft cache at a traced row index)."""
-        if width not in self._draft_prefills:
-            @partial(jax.jit, donate_argnums=1)
-            def fn(dparams, dcache, prompt, row):
-                rowc = jax.tree_util.tree_map(
-                    lambda x: jax.lax.dynamic_slice_in_dim(x, row, 1, 1),
-                    dcache)
-                # With a shared prefix the draft's prompt chunk prefills
-                # at the same offset the target's does (the prefix is
-                # already resident in every draft cache row).
-                _, rowc = decode_step(self.draft_cfg, dparams, rowc,
-                                      prompt, self.prefix_len)
-                return jax.tree_util.tree_map(
-                    lambda full, rc: jax.lax.dynamic_update_slice_in_dim(
-                        full, rc, row, 1), dcache, rowc)
+    def _make_draft_chunk(self):
+        """Jitted DRAFT prompt writer at a traced (row, offset): serves
+        both the whole-prompt prefill (offset prefix_len — the prefix is
+        already resident in every draft cache row) and chunked
+        prefill's per-chunk advance.  One compile per chunk width."""
+        @partial(jax.jit, donate_argnums=1)
+        def fn(dparams, dcache, chunk, row, pos):
+            rowc = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_slice_in_dim(x, row, 1, 1),
+                dcache)
+            _, rowc = decode_step(self.draft_cfg, dparams, rowc, chunk,
+                                  pos)
+            return jax.tree_util.tree_map(
+                lambda full, rc: jax.lax.dynamic_update_slice_in_dim(
+                    full, rc, row, 1), dcache, rowc)
 
-            self._draft_prefills[width] = fn
-        return self._draft_prefills[width]
+        return fn
 
     def _make_chunk_prefill(self):
         """Jitted one-chunk prefill: writes chunk tokens at a TRACED
@@ -518,6 +513,25 @@ class ContinuousBatcher:
         if self._table_cache is None:
             self._table_cache = jnp.asarray(self._table_np())
         return self._table_cache
+
+    def _decode_table(self, active: Dict[int, _Row],
+                      decoding: Dict[int, _Row]) -> jnp.ndarray:
+        """The batched step's device table: the plain cached table when
+        every active row decodes; otherwise a masked variant with
+        still-filling rows' entries pinned to the sink (their chunked
+        prefill owns their pages), cached until the allocation OR the
+        filling set changes — steady-state admission must not re-upload
+        the table every token."""
+        if len(decoding) == len(active):
+            return self._table()
+        filling = frozenset(r for r, row in active.items()
+                            if not row.decoding)
+        if self._masked_cache is None or self._masked_cache[0] != filling:
+            t = self._table_np().copy()
+            for r in filling:
+                t[r, :] = self._sink_page
+            self._masked_cache = (filling, jnp.asarray(t))
+        return self._masked_cache[1]
 
     def _table_np(self) -> np.ndarray:
         """Host master copy of the table (chunked prefill masks per-step
@@ -647,9 +661,10 @@ class ContinuousBatcher:
             jnp.asarray(padded), jnp.asarray([length], jnp.int32),
             jnp.asarray([rid], jnp.int32))
         if self.draft_cfg is not None:
-            self._draft_cache = self._draft_prefill_fn(width)(
+            self._draft_cache = self._draft_chunk(
                 self.draft_params, self._draft_cache, jnp.asarray(padded),
-                jnp.asarray(row, jnp.int32))
+                jnp.asarray(row, jnp.int32),
+                jnp.asarray(self.prefix_len, jnp.int32))
         tok = int(tok)                  # host sync: first token is real
         now = time.perf_counter()
         state = _Row(rid=rid, req=req, pos=self.prefix_len + length, step=1,
@@ -681,6 +696,13 @@ class ContinuousBatcher:
             jnp.asarray(self.prefix_len + row.filled, jnp.int32),
             jnp.asarray([cap], jnp.int32),
             jnp.asarray([row.rid], jnp.int32))
+        if self.draft_cfg is not None:
+            # The draft's prompt chunks advance in lockstep so it is
+            # ready to propose the moment the row flips to decoding.
+            self._draft_cache = self._draft_chunk(
+                self.draft_params, self._draft_cache, jnp.asarray(chunk),
+                jnp.asarray(r, jnp.int32),
+                jnp.asarray(self.prefix_len + row.filled, jnp.int32))
         row.filled += c
         if row.filled < row.padded.shape[1]:
             return None
@@ -709,21 +731,7 @@ class ContinuousBatcher:
             positions[r] = row.pos
             rids[r] = row.rid
             steps[r] = row.step
-        if len(decoding) == len(active):
-            table = self._table()
-        else:
-            # Masked variant (still-filling rows -> sink), cached until
-            # the allocation OR the filling set changes — steady-state
-            # admission must not re-upload the table every token.
-            filling = frozenset(r for r, row in active.items()
-                                if not row.decoding)
-            if self._masked_cache is None or \
-                    self._masked_cache[0] != filling:
-                t = self._table_np().copy()
-                for r in filling:
-                    t[r, :] = self._sink_page
-                self._masked_cache = (filling, jnp.asarray(t))
-            table = self._masked_cache[1]
+        table = self._decode_table(active, decoding)
         self.pool, nxt = self._decode(
             self.params, self.pool, table, jnp.asarray(toks),
             jnp.asarray(positions), jnp.asarray(rids), jnp.asarray(steps))
@@ -762,10 +770,7 @@ class ContinuousBatcher:
             positions[r] = row.pos
             rids[r] = row.rid
             steps[r] = row.step
-        # Speculative mode excludes prefill_chunk (__init__), so every
-        # active row is decoding — no still-filling rows to sink-mask.
-        assert len(decoding) == len(active)
-        table = self._table()
+        table = self._decode_table(active, decoding)
         self.pool, self._draft_cache, g, n_commit = self._spec_round(
             self.params, self.pool, self.draft_params, self._draft_cache,
             table, jnp.asarray(toks), jnp.asarray(positions),
