@@ -286,6 +286,70 @@ def test_pipeline_1f1b_matches_sequential(pp, dp, mb):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_pipeline_1f1b_with_manual_tp_stage():
+    """1F1B's docstring promise: stage bodies may use manual non-pp
+    collectives.  A Megatron-style column-split FFN stage (w1 sharded
+    over tp, psum after the row-parallel w2) must reproduce sequential
+    autodiff of the full-width math."""
+    from tfmesos_tpu.parallel.pipeline import pipeline_train_1f1b
+
+    mesh = build_mesh({"pp": 2, "tp": 2, "dp": 2})
+    dim, ffn, mb = 8, 16, 4
+    key = jax.random.PRNGKey(17)
+    stages = []
+    for _ in range(2):
+        k1, k2, key = jax.random.split(key, 3)
+        stages.append({
+            "w1": jax.random.normal(k1, (dim, ffn)) / np.sqrt(dim),
+            "w2": jax.random.normal(k2, (ffn, dim)) / np.sqrt(ffn)})
+    stacked = stack_stage_params(stages)
+
+    from tfmesos_tpu.parallel.collectives import (broadcast_replicated_grad,
+                                                  psum_replicated_grad)
+
+    def stage_tp(p, h):
+        # Megatron f/g pair: 1F1B differentiates the stage INSIDE the
+        # shard_map, so the collectives must carry their own transposes —
+        # f (identity fwd / psum bwd) where the replicated h fans out
+        # into per-shard columns, g (psum fwd / identity bwd) after the
+        # row-parallel w2.  Plain lax.psum would double-count over tp.
+        hin = broadcast_replicated_grad(h, "tp")
+        part = jnp.tanh(hin @ p["w1"])
+        return h + psum_replicated_grad(part @ p["w2"], "tp")
+
+    def stage_full(p, h):
+        return h + jnp.tanh(h @ p["w1"]) @ p["w2"]
+
+    def loss_fn(h, t):
+        return jnp.mean((h - t) ** 2)
+
+    kx, kt = jax.random.split(key)
+    x = jax.random.normal(kx, (mb * 2, dim))
+    tgt = jax.random.normal(kt, (mb * 2, dim))
+
+    ref_l, (ref_g, ref_dx) = jax.value_and_grad(
+        lambda s, x_: loss_fn(
+            stage_full(jax.tree_util.tree_map(lambda p: p[1], s),
+                       stage_full(jax.tree_util.tree_map(
+                           lambda p: p[0], s), x_)), tgt),
+        argnums=(0, 1))(stacked, x)
+
+    partition = {"w1": P(None, "tp"), "w2": P("tp", None)}
+    got_l, got_g, got_dx = jax.jit(
+        lambda s, x_, t_: pipeline_train_1f1b(
+            stage_tp, loss_fn, s, x_, t_, mesh, num_microbatches=mb,
+            param_partition=partition))(stacked, x, tgt)
+
+    np.testing.assert_allclose(float(got_l), float(ref_l), rtol=1e-5)
+    for leaf_got, leaf_ref in zip(jax.tree_util.tree_leaves(got_g),
+                                  jax.tree_util.tree_leaves(ref_g)):
+        np.testing.assert_allclose(np.asarray(leaf_got),
+                                   np.asarray(leaf_ref),
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_dx), np.asarray(ref_dx),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_pipeline_1f1b_validation():
     from tfmesos_tpu.parallel.pipeline import pipeline_train_1f1b
 

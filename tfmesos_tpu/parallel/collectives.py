@@ -40,6 +40,50 @@ def reduce_scatter(x, axis: AxisName, *, axis_index: int = 0):
                                 tiled=True)
 
 
+def broadcast_replicated_grad(x, axis: AxisName):
+    """Identity forward, ``psum`` backward — the input-side twin of
+    :func:`psum_replicated_grad` (Megatron's *f* operator to its *g*).
+
+    Use it where a tp-replicated activation FANS OUT into per-shard
+    compute (e.g. ``h @ w1_columns``): each shard's backward produces
+    only its columns' contribution to dL/dh, and the psum in the
+    transpose reassembles the full cotangent.  Needed only when the
+    stage is differentiated with ``jax.vjp`` inside a ``shard_map``
+    (1F1B); outer differentiation through the shard_map inserts the
+    same transpose automatically."""
+    @jax.custom_vjp
+    def _bcast(v):
+        return v
+
+    _bcast.defvjp(lambda v: (v, None),
+                  lambda _, g: (jax.lax.psum(g, axis),))
+    return _bcast(x)
+
+
+def psum_replicated_grad(x, axis: AxisName):
+    """``psum`` whose backward is the IDENTITY — for manual-collective
+    stage bodies that are differentiated with ``jax.vjp`` INSIDE a
+    ``shard_map`` (the 1F1B pipeline's in-loop backward).
+
+    Math: for y = Σ_i x_i computed on every shard, dL/dx_i = dL/dy —
+    the identity — whenever downstream consumes y uniformly across the
+    axis (the Megatron row-parallel case, where the cotangent is
+    replicated).  Plain ``lax.psum``'s transpose under
+    ``check_vma=False`` manual mode cannot assume the cotangent is
+    replicated and inserts another psum, scaling gradients by the axis
+    size; differentiating THROUGH the shard_map from outside (the
+    gpipe/circular route) does not hit this, which is why those
+    schedules use plain psum.
+    """
+    @jax.custom_vjp
+    def _psum(v):
+        return jax.lax.psum(v, axis)
+
+    _psum.defvjp(lambda v: (jax.lax.psum(v, axis), None),
+                 lambda _, g: (g,))
+    return _psum(x)
+
+
 def ppermute_shift(x, axis: str, shift: int = 1):
     """Rotate values around a ring axis (the building block of ring attention
     and pipeline transfer); ``shift=+1`` sends to the next-higher index."""

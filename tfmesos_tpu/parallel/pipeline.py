@@ -20,12 +20,18 @@ Three schedules:
   all-forwards-then-all-backwards.
 
 Composes with dp/fsdp (activations stay sharded on their batch dims) AND
-with tp: the stage body runs inside the full-mesh ``shard_map``, so it may
-freely use ``jax.lax.psum(..., "tp")``-style collectives, and
+with tp: the stage body runs inside the full-mesh ``shard_map``, and
 ``param_partition`` shards each stage's weights over non-pp axes
 (Megatron-style column/row splits).  What a stage must NOT do is open a
-nested ``shard_map`` — write manual-collective stage bodies instead
-(models/transformer.py:_block_manual_tp is the worked example).
+nested ``shard_map`` — write manual-collective stage bodies instead.
+Under gpipe/circular (differentiated from OUTSIDE the shard_map) plain
+``jax.lax.psum(..., "tp")`` collectives are fine
+(models/transformer.py:_block_manual_tp is the worked example); under
+1F1B the backward runs ``jax.vjp`` INSIDE the shard_map, where plain
+psum's transpose double-counts — use the Megatron f/g pair
+``collectives.broadcast_replicated_grad`` /
+``collectives.psum_replicated_grad`` there (see
+:func:`pipeline_train_1f1b`).
 """
 
 from __future__ import annotations
@@ -128,8 +134,16 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
     interleaved in ONE loop — hence a training-step entry point rather
     than a ``schedule=`` flag.
 
-    ``stage_fn(chunk_params, h) -> h`` as in ``pipeline_apply`` (manual
-    non-pp collectives allowed); ``loss_fn(h_out, target_mb) -> scalar``
+    ``stage_fn(chunk_params, h) -> h`` as in ``pipeline_apply``.  Manual
+    non-pp collectives are allowed, with one 1F1B-specific rule: the
+    backward runs ``jax.vjp`` INSIDE the shard_map, where a plain
+    ``lax.psum``'s transpose double-counts over its axis — use the
+    Megatron f/g pair ``collectives.broadcast_replicated_grad`` (where a
+    replicated activation fans out into per-shard compute) and
+    ``collectives.psum_replicated_grad`` (after row-parallel matmuls),
+    which carry their own transposes (tested:
+    ``test_pipeline_1f1b_with_manual_tp_stage``).
+    ``loss_fn(h_out, target_mb) -> scalar``
     (a per-microbatch MEAN, so the microbatch average equals the full
     batch loss).  Returns ``(loss, grads, dx)``: the mean loss, fp32
     parameter gradients with the stacked params' structure and sharding,
