@@ -409,16 +409,21 @@ def test_pipeline_1f1b_bf16_and_pp1():
                                rtol=1e-4, atol=1e-5)
 
 
-def test_transformer_train_step_1f1b_matches_loss_fn():
+@pytest.mark.parametrize("axes,kv_heads", [
+    ({"pp": 4, "dp": 2}, None),
+    ({"pp": 2, "tp": 2, "dp": 2}, None),     # manual-tp stages, f/g AD
+    ({"pp": 2, "tp": 2, "dp": 2}, 2),        # ... with GQA at kv width
+])
+def test_transformer_train_step_1f1b_matches_loss_fn(axes, kv_heads):
     """Model-level 1F1B: the fused schedule reproduces jax.grad of the
     plain (non-pp) loss_fn — embedding, per-layer, final-norm, and head
-    grads all match."""
+    grads all match — including Megatron manual-tp stages."""
     from tfmesos_tpu.models import transformer
 
-    mesh = build_mesh({"pp": 4, "dp": 2})
+    mesh = build_mesh(axes)
     cfg = transformer.TransformerConfig(
         vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
-        max_seq_len=16, dtype=jnp.float32)
+        max_seq_len=16, dtype=jnp.float32, n_kv_heads=kv_heads)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     tokens = np.random.RandomState(0).randint(
         0, cfg.vocab_size, size=(8, 17)).astype(np.int32)
@@ -454,9 +459,9 @@ def test_transformer_train_step_1f1b_validation():
         max_seq_len=16, dtype=jnp.float32)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     batch = {"tokens": jnp.zeros((4, 17), jnp.int32)}
-    with pytest.raises(ValueError, match="pp x dp/fsdp"):
+    with pytest.raises(ValueError, match="pp x tp x dp/fsdp"):
         transformer.train_step_1f1b(cfg, params, batch,
-                                    build_mesh({"pp": 4, "tp": 2}))
+                                    build_mesh({"pp": 4, "sp": 2}))
     moe = transformer.TransformerConfig(
         vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
         max_seq_len=16, dtype=jnp.float32, n_experts=2, top_k=1)

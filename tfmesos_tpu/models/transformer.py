@@ -354,8 +354,26 @@ def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None,
     return out, aux
 
 
+def _dense_tp_attn_partition() -> Dict[str, P]:
+    """Per-leaf NON-leading-dim PartitionSpecs for a manual-tp stage's
+    attention half (Megatron column/row splits) — shared by the
+    gpipe/circular pp path and the 1F1B train step so the two tables
+    cannot drift."""
+    return {
+        "attn_norm": P(None, None), "mlp_norm": P(None, None),
+        "wq": P(None, None, "tp"), "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"), "wo": P(None, "tp", None),
+    }
+
+
+def _dense_tp_mlp_partition() -> Dict[str, P]:
+    return {"w_gate": P(None, None, "tp"), "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None)}
+
+
 def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
-                     tp_axis: str = "tp", ep_axis: Optional[str] = None):
+                     tp_axis: str = "tp", ep_axis: Optional[str] = None,
+                     inbody_ad: bool = False):
     """Megatron-style block with MANUAL tp collectives, for use inside a
     pipeline stage (nested shard_map is not allowed there, explicit psum
     is).  ``lp`` leaves arrive as local tp shards: wq/wk/wv column-sharded
@@ -365,12 +383,30 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     after each row-parallel matmul — the textbook 2-collectives-per-block
     tp pattern.  With experts, the FFN half runs the manual-collective MoE
     (``_ffn`` with tp/ep axes: expert widths tp-sharded, experts
-    ep-sharded).  Returns (x, aux)."""
+    ep-sharded).  Returns (x, aux).
+
+    ``inbody_ad=True`` (dense configs; the 1F1B train step) swaps the
+    collectives for the Megatron f/g pair that carry their own
+    transposes — required when the stage is differentiated with
+    ``jax.vjp`` INSIDE the shard_map, where plain psum's transpose
+    double-counts over tp (parallel/collectives.py)."""
     tp = jax.lax.axis_size(tp_axis)
     heads_loc = cfg.n_heads // tp
     kv_loc = cfg.kv_heads // tp
     b, t, _ = x.shape
-    h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
+    if inbody_ad:
+        from tfmesos_tpu.parallel.collectives import (
+            broadcast_replicated_grad, psum_replicated_grad)
+        if cfg.n_experts:
+            raise ValueError("inbody_ad manual-tp blocks are dense-only "
+                             "(the MoE collectives still assume outer "
+                             "differentiation)")
+        fan = lambda v_: broadcast_replicated_grad(v_, tp_axis)
+        red = lambda v_: psum_replicated_grad(v_, tp_axis)
+    else:
+        fan = lambda v_: v_
+        red = lambda v_: jax.lax.psum(v_, tp_axis)
+    h = fan(rms_norm(x, lp["attn_norm"].astype(cfg.dtype)))
     q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
     k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, kv_loc, cfg.head_dim)
     v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, kv_loc, cfg.head_dim)
@@ -378,14 +414,13 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     k = rope(k, positions, cfg.rope_theta)
     o = attend(q, k, v, mesh=None, causal=True,
                window=cfg.window)  # local heads
-    x = x + jax.lax.psum(o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype),
-                         tp_axis)
+    x = x + red(o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype))
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     if cfg.n_experts:
         ffn, aux = _ffn(cfg, None, lp, h, ep_axis=ep_axis, tp_axis=tp_axis)
         return x + ffn, aux
-    ffn = _mlp(cfg, lp, h)                        # local d_ff shard
-    return x + jax.lax.psum(ffn, tp_axis), _zero_aux()
+    ffn = _mlp(cfg, lp, fan(h))                   # local d_ff shard
+    return x + red(ffn), _zero_aux()
 
 
 def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
@@ -464,12 +499,7 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
                     f"aligned; lower tp or raise kv_heads")
             stage_block = lambda c, lp_, pos: _block_manual_tp(
                 cfg, c, lp_, pos, ep_axis=ep_axis)
-            partition = {
-                "attn_norm": P(None, None),
-                "mlp_norm": P(None, None),
-                "wq": P(None, None, "tp"), "wk": P(None, None, "tp"),
-                "wv": P(None, None, "tp"), "wo": P(None, "tp", None),
-            }
+            partition = _dense_tp_attn_partition()
             if cfg.n_experts:
                 # Per-expert Megatron: FFN widths shard over tp, whole
                 # experts over ep (when present); the router replicates so
@@ -484,9 +514,7 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
                                      s_up=P(None, None, "tp"),
                                      s_down=P(None, "tp", None))
             else:
-                partition.update(w_gate=P(None, None, "tp"),
-                                 w_up=P(None, None, "tp"),
-                                 w_down=P(None, "tp", None))
+                partition.update(_dense_tp_mlp_partition())
         else:
             stage_block = lambda c, lp_, pos: _block(cfg, None, c, lp_, pos,
                                                      ep_axis=ep_axis)
@@ -1640,17 +1668,27 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     The embedding differentiates through the returned dx, and the final
     norm + unembedding head ride as tail params of the loss stage.
 
-    Scope: dense configs on pp (+ dp/fsdp) meshes.  tp/sp stage bodies and
-    MoE aux-loss plumbing stay with the gpipe/circular schedules
+    Scope: dense configs on pp x tp (+ dp/fsdp) meshes.  tp stages run
+    the manual-collective Megatron block with the in-body-AD f/g
+    collectives (the loss tail computes the full-vocab CE per tp device
+    — no vocab-parallel CE under 1F1B yet).  sp stage bodies and MoE
+    aux-loss plumbing stay with the gpipe/circular schedules
     (``loss_fn``); interleaved virtual stages are circular-only.
     """
     pp = mesh.shape.get("pp", 1)
+    tp = mesh.shape.get("tp", 1)
     real = {a for a, s in mesh.shape.items() if s > 1}
-    if not real <= {"pp", "dp", "fsdp"}:
+    if not real <= {"pp", "tp", "dp", "fsdp"}:
         raise ValueError(
-            f"train_step_1f1b supports pp x dp/fsdp meshes; got "
-            f"{dict(mesh.shape)} (tp/sp/ep stage bodies stay with "
+            f"train_step_1f1b supports pp x tp x dp/fsdp meshes; got "
+            f"{dict(mesh.shape)} (sp/ep stage bodies stay with "
             f"pp_schedule='gpipe'/'circular')")
+    if tp > 1 and cfg.kv_heads % tp:
+        raise ValueError(f"1f1b x tp needs tp ({tp}) to divide kv_heads "
+                         f"({cfg.kv_heads})")
+    if tp > 1 and cfg.d_ff % tp:
+        raise ValueError(f"1f1b x tp needs tp ({tp}) to divide d_ff "
+                         f"({cfg.d_ff}) for the Megatron FFN split")
     if cfg.n_experts:
         raise ValueError("train_step_1f1b does not carry MoE router aux "
                          "losses; use pp_schedule='gpipe'/'circular'")
@@ -1669,10 +1707,23 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         lambda p: p.reshape(max(pp, 1), per, *p.shape[1:]),
         params["layers"])
 
+    partition = None
+    if tp > 1:
+        # forward_hidden's dense tp partition table (shared helpers);
+        # stages run the manual Megatron block with in-body-AD
+        # collectives.
+        partition = {**_dense_tp_attn_partition(),
+                     **_dense_tp_mlp_partition()}
+
     def stage_fn(stage_params, h):
         pos = jnp.broadcast_to(jnp.arange(h.shape[1], dtype=jnp.int32),
                                h.shape[:2])
-        body = lambda c, lp: _block(cfg, None, c, lp, pos)
+        if tp > 1:
+            body = lambda c, lp: (_block_manual_tp(cfg, c, lp, pos,
+                                                   inbody_ad=True)[0],
+                                  None)
+        else:
+            body = lambda c, lp: _block(cfg, None, c, lp, pos)
         if cfg.remat:
             body = jax.checkpoint(body)
         out, _ = jax.lax.scan(body, h, stage_params)
@@ -1692,7 +1743,8 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     tail = {"norm_f": params["norm_f"], "head": params["head"]}
     loss, g_stacked, g_tail, dx = pipeline_train_1f1b(
         stage_fn, tail_loss, stacked, x, tgt, mesh,
-        num_microbatches=num_microbatches, tail_params=tail)
+        num_microbatches=num_microbatches, tail_params=tail,
+        param_partition=partition)
     (g_embed,) = vjp_embed(dx.astype(x.dtype))
     grads = {
         "embed": jax.tree_util.tree_map(
