@@ -409,12 +409,14 @@ def test_pipeline_1f1b_bf16_and_pp1():
                                rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("axes,kv_heads", [
-    ({"pp": 4, "dp": 2}, None),
-    ({"pp": 2, "tp": 2, "dp": 2}, None),     # manual-tp stages, f/g AD
-    ({"pp": 2, "tp": 2, "dp": 2}, 2),        # ... with GQA at kv width
+@pytest.mark.parametrize("axes,kv_heads,vocab", [
+    ({"pp": 4, "dp": 2}, None, 64),
+    ({"pp": 2, "tp": 2, "dp": 2}, None, 64),  # tp + vocab-parallel tail
+    ({"pp": 2, "tp": 2, "dp": 2}, 2, 64),     # ... with GQA at kv width
+    ({"pp": 2, "tp": 2, "dp": 2}, None, 65),  # odd vocab: replicated tail
 ])
-def test_transformer_train_step_1f1b_matches_loss_fn(axes, kv_heads):
+def test_transformer_train_step_1f1b_matches_loss_fn(axes, kv_heads,
+                                                     vocab):
     """Model-level 1F1B: the fused schedule reproduces jax.grad of the
     plain (non-pp) loss_fn — embedding, per-layer, final-norm, and head
     grads all match — including Megatron manual-tp stages."""
@@ -422,7 +424,7 @@ def test_transformer_train_step_1f1b_matches_loss_fn(axes, kv_heads):
 
     mesh = build_mesh(axes)
     cfg = transformer.TransformerConfig(
-        vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+        vocab_size=vocab, d_model=32, n_layers=4, n_heads=4, d_ff=64,
         max_seq_len=16, dtype=jnp.float32, n_kv_heads=kv_heads)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     tokens = np.random.RandomState(0).randint(

@@ -32,6 +32,7 @@ from tfmesos_tpu.ops.attention import attend, mha_reference
 from tfmesos_tpu.ops.layers import (cross_entropy_loss,
                                     data_parallel_fused_cross_entropy,
                                     fused_linear_cross_entropy, rms_norm,
+                                    vocab_parallel_ce_inbody,
                                     rope, swiglu,
                                     vocab_parallel_cross_entropy)
 from tfmesos_tpu.ops.quant import QTensor, quantize_tensor
@@ -1670,10 +1671,13 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
 
     Scope: dense configs on pp x tp (+ dp/fsdp) meshes.  tp stages run
     the manual-collective Megatron block with the in-body-AD f/g
-    collectives (the loss tail computes the full-vocab CE per tp device
-    — no vocab-parallel CE under 1F1B yet).  sp stage bodies and MoE
-    aux-loss plumbing stay with the gpipe/circular schedules
-    (``loss_fn``); interleaved virtual stages are circular-only.
+    collectives, and the loss tail is the in-body VOCAB-PARALLEL fused
+    CE (``ops/layers.vocab_parallel_ce_inbody``: the unembedding shards
+    over tp, no device holds more than a [chunk, V/tp] logits block —
+    fwd or bwd); a vocab that does not divide over tp falls back to the
+    replicated fused-CE tail, as ``loss_fn`` does.  sp stage bodies and MoE aux-loss plumbing stay with
+    the gpipe/circular schedules (``loss_fn``); interleaved virtual
+    stages are circular-only.
     """
     pp = mesh.shape.get("pp", 1)
     tp = mesh.shape.get("tp", 1)
@@ -1689,6 +1693,7 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     if tp > 1 and cfg.d_ff % tp:
         raise ValueError(f"1f1b x tp needs tp ({tp}) to divide d_ff "
                          f"({cfg.d_ff}) for the Megatron FFN split")
+
     if cfg.n_experts:
         raise ValueError("train_step_1f1b does not carry MoE router aux "
                          "losses; use pp_schedule='gpipe'/'circular'")
@@ -1732,8 +1737,15 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     def tail_loss(tail, h, tgt_mb):
         # Fused head+CE: never materializes the [mb, T, vocab] logits —
         # the same bounded-memory route loss_fn takes, which matters
-        # doubly on the schedule whose point is the O(pp) stash.
+        # doubly on the schedule whose point is the O(pp) stash.  Under
+        # tp the head arrives vocab-sharded and the in-body
+        # vocab-parallel CE psums the softmax statistics explicitly
+        # (its custom VJP keeps the in-loop backward collective-safe).
         x = rms_norm(h, tail["norm_f"].astype(cfg.dtype))
+        if vocab_parallel_tail:
+            return vocab_parallel_ce_inbody(x, tail["head"], tgt_mb,
+                                            "tp", cfg.z_loss,
+                                            cfg.ce_chunk)
         return fused_linear_cross_entropy(x, tail["head"], tgt_mb,
                                           z_loss=cfg.z_loss,
                                           chunk=cfg.ce_chunk)
@@ -1741,10 +1753,17 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     x, vjp_embed = jax.vjp(
         lambda e: _embed_lookup(e, inp, cfg.dtype), params["embed"])
     tail = {"norm_f": params["norm_f"], "head": params["head"]}
+    # Vocab-parallel tail only when the vocab divides over tp; otherwise
+    # keep the replicated fused-CE tail (same fallback rule as
+    # _fused_ce_mode's tp branch — an indivisible vocab must not refuse
+    # a config the replicated tail trains fine).
+    vocab_parallel_tail = tp > 1 and cfg.vocab_size % tp == 0
+    tail_partition = ({"norm_f": P(None), "head": P(None, "tp")}
+                      if vocab_parallel_tail else None)
     loss, g_stacked, g_tail, dx = pipeline_train_1f1b(
         stage_fn, tail_loss, stacked, x, tgt, mesh,
         num_microbatches=num_microbatches, tail_params=tail,
-        param_partition=partition)
+        param_partition=partition, tail_partition=tail_partition)
     (g_embed,) = vjp_embed(dx.astype(x.dtype))
     grads = {
         "embed": jax.tree_util.tree_map(

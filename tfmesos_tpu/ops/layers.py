@@ -192,33 +192,14 @@ def _vp_fwd(x, w, labels, mesh, axis, z_loss, chunk):
     batch, nb = _vp_batch_axes(mesh)
 
     def local(xl, wl, ll):
-        xs, ls, n_loc = _flce_flatten(xl, ll, chunk)
-        wc = wl.astype(xl.dtype)
-        vloc = wl.shape[-1]
-        voff = jax.lax.axis_index(axis) * vloc
-
-        def body(acc, inp):
-            xc, lc = inp
-            logits = (xc @ wc).astype(jnp.float32)      # [c, Vloc]
-            m = jax.lax.pmax(jnp.max(logits, axis=-1), axis)
-            se = jax.lax.psum(
-                jnp.sum(jnp.exp(logits - m[:, None]), axis=-1), axis)
-            logz = m + jnp.log(se)
-            mine = (lc >= voff) & (lc < voff + vloc)
-            idx = jnp.clip(lc - voff, 0, vloc - 1)
-            picked = jax.lax.psum(
-                jnp.where(mine, jnp.take_along_axis(
-                    logits, idx[:, None], axis=-1)[:, 0], 0.0), axis)
-            s = jnp.sum(logz - picked)
-            if z_loss:
-                s = s + z_loss * jnp.sum(logz ** 2)
-            return acc + s, logz
-
-        total, logzs = jax.lax.scan(body, jnp.zeros((), jnp.float32),
-                                    (xs, ls))
+        # Per-shard math shared with the in-body variant (_vpi_fwd
+        # returns the LOCAL token mean); equal-sized data shards make
+        # the mean-of-means the global mean.
+        loss_loc, (_, _, _, logzs) = _vpi_fwd(xl, wl, ll, axis, z_loss,
+                                              chunk)
         if batch:
-            total = jax.lax.psum(total, batch)          # global token sum
-        return total / (n_loc * nb), logzs
+            loss_loc = jax.lax.psum(loss_loc, batch) / nb
+        return loss_loc, logzs
 
     loss, logzs = jax.shard_map(
         local, mesh=mesh,
@@ -232,35 +213,13 @@ def _vp_bwd(mesh, axis, z_loss, chunk, res, g):
     batch, nb = _vp_batch_axes(mesh)
 
     def local(xl, wl, ll, logzs_l, gl):
-        xs, ls, n_loc = _flce_flatten(xl, ll, chunk)
-        wc = wl.astype(xl.dtype)
-        vloc = wl.shape[-1]
-        voff = jax.lax.axis_index(axis) * vloc
-        scale = gl / (n_loc * nb)
-
-        def body(dw_acc, inp):
-            xc, lc, logz = inp
-            logits = (xc @ wc).astype(jnp.float32)
-            p = jnp.exp(logits - logz[:, None])         # local softmax cols
-            if z_loss:
-                p = p * (1.0 + (2.0 * z_loss) * logz)[:, None]
-            mine = (lc >= voff) & (lc < voff + vloc)
-            idx = jnp.clip(lc - voff, 0, vloc - 1)
-            onehot = (jax.nn.one_hot(idx, vloc, dtype=jnp.float32)
-                      * mine[:, None].astype(jnp.float32))
-            dlogits = ((p - onehot) * scale).astype(xl.dtype)
-            # dx needs every vocab shard's path: psum over tp.
-            dx_c = jax.lax.psum(dlogits @ wc.T, axis)
-            dw_acc = dw_acc + jax.lax.dot_general(
-                xc, dlogits, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return dw_acc, dx_c
-
-        dw, dxs = jax.lax.scan(
-            body, jnp.zeros(wl.shape, jnp.float32), (xs, ls, logzs_l))
+        # Shared per-shard bwd body; dw stays fp32 until after the
+        # cross-data-shard psum (accumulate wide, cast once).
+        dx, dw = _vpi_grads(axis, z_loss, chunk, (xl, wl, ll, logzs_l),
+                            gl / nb)
         if batch:
             dw = jax.lax.psum(dw, batch)                # all tokens' sum
-        return dxs.reshape(xl.shape).astype(xl.dtype), dw.astype(wl.dtype)
+        return dx, dw.astype(wl.dtype)
 
     dx, dw = jax.shard_map(
         local, mesh=mesh,
@@ -272,6 +231,93 @@ def _vp_bwd(mesh, axis, z_loss, chunk, res, g):
 
 
 vocab_parallel_cross_entropy.defvjp(_vp_fwd, _vp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def vocab_parallel_ce_inbody(x, w, labels, axis: str = "tp",
+                             z_loss: float = 0.0, chunk: int = 2048):
+    """``vocab_parallel_cross_entropy``'s per-shard body as a standalone
+    custom-VJP, for callers ALREADY INSIDE a ``shard_map`` with ``axis``
+    manual — the 1F1B pipeline's loss tail.  ``w`` is this device's
+    [d, V/tp] vocab shard, ``x``/``labels`` the local microbatch.  All
+    tp collectives are written out explicitly in BOTH directions
+    (softmax statistics psums forward, the dx psum backward), so the
+    in-body ``jax.vjp`` the 1F1B backward runs never transposes a
+    collective.  Returns the LOCAL token-mean loss; cross-data-shard
+    averaging is the caller's (the pipeline pmean-reduces loss and
+    grads over the data axes itself)."""
+    loss, _ = _vpi_fwd(x, w, labels, axis, z_loss, chunk)
+    return loss
+
+
+def _vpi_fwd(x, w, labels, axis, z_loss, chunk):
+    """Per-shard fwd body — also the inner engine of the shard_map'd
+    ``vocab_parallel_cross_entropy`` (one implementation of the math)."""
+    xs, ls, n_loc = _flce_flatten(x, labels, chunk)
+    wc = w.astype(x.dtype)
+    vloc = w.shape[-1]
+    voff = jax.lax.axis_index(axis) * vloc
+
+    def body(acc, inp):
+        xc, lc = inp
+        logits = (xc @ wc).astype(jnp.float32)          # [c, Vloc]
+        m = jax.lax.pmax(jnp.max(logits, axis=-1), axis)
+        se = jax.lax.psum(
+            jnp.sum(jnp.exp(logits - m[:, None]), axis=-1), axis)
+        logz = m + jnp.log(se)
+        mine = (lc >= voff) & (lc < voff + vloc)
+        idx = jnp.clip(lc - voff, 0, vloc - 1)
+        picked = jax.lax.psum(
+            jnp.where(mine, jnp.take_along_axis(
+                logits, idx[:, None], axis=-1)[:, 0], 0.0), axis)
+        s = jnp.sum(logz - picked)
+        if z_loss:
+            s = s + z_loss * jnp.sum(logz ** 2)
+        return acc + s, logz
+
+    total, logzs = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (xs, ls))
+    return total / n_loc, (x, w, labels, logzs)
+
+
+def _vpi_grads(axis, z_loss, chunk, res, g):
+    """Per-shard bwd body; returns (dx at x's dtype, dw in fp32) so the
+    shard_map'd wrapper can psum dw across data shards BEFORE casting."""
+    x, w, labels, logzs = res
+    xs, ls, n_loc = _flce_flatten(x, labels, chunk)
+    wc = w.astype(x.dtype)
+    vloc = w.shape[-1]
+    voff = jax.lax.axis_index(axis) * vloc
+    scale = g / n_loc
+
+    def body(dw_acc, inp):
+        xc, lc, logz = inp
+        logits = (xc @ wc).astype(jnp.float32)
+        p = jnp.exp(logits - logz[:, None])             # local softmax cols
+        if z_loss:
+            p = p * (1.0 + (2.0 * z_loss) * logz)[:, None]
+        mine = (lc >= voff) & (lc < voff + vloc)
+        idx = jnp.clip(lc - voff, 0, vloc - 1)
+        onehot = (jax.nn.one_hot(idx, vloc, dtype=jnp.float32)
+                  * mine[:, None].astype(jnp.float32))
+        dlogits = ((p - onehot) * scale).astype(x.dtype)
+        dx_c = jax.lax.psum(dlogits @ wc.T, axis)       # every vocab shard
+        dw_acc = dw_acc + jax.lax.dot_general(
+            xc, dlogits, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dw_acc, dx_c
+
+    dw, dxs = jax.lax.scan(
+        body, jnp.zeros(w.shape, jnp.float32), (xs, ls, logzs))
+    return dxs.reshape(x.shape).astype(x.dtype), dw
+
+
+def _vpi_bwd(axis, z_loss, chunk, res, g):
+    dx, dw = _vpi_grads(axis, z_loss, chunk, res, g)
+    return dx, dw.astype(res[1].dtype), None
+
+
+vocab_parallel_ce_inbody.defvjp(_vpi_fwd, _vpi_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -124,7 +124,8 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                         axis: str = "pp",
                         num_microbatches: Optional[int] = None,
                         param_partition: Optional[Any] = None,
-                        tail_params: Any = None):
+                        tail_params: Any = None,
+                        tail_partition: Optional[Any] = None):
     """One fused forward+backward pipeline pass on the 1F1B schedule.
 
     ``pipeline_apply`` is forward-only — under ``jax.grad`` autodiff
@@ -152,10 +153,13 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
 
     ``tail_params`` (optional) are weights used INSIDE the loss — a final
     norm and unembedding head, say.  The loss contract becomes
-    ``loss_fn(tail_params, h_out, target_mb)``, the tail is replicated
-    into every stage (only the last differentiates it), and the return
-    grows to ``(loss, grads, tail_grads, dx)`` with replicated fp32
-    ``tail_grads``.
+    ``loss_fn(tail_params, h_out, target_mb)``, the tail rides into
+    every stage (only the last differentiates it), and the return grows
+    to ``(loss, grads, tail_grads, dx)`` with fp32 ``tail_grads``.
+    ``tail_partition`` (optional) gives per-leaf PartitionSpecs for the
+    tail — e.g. a vocab-sharded unembedding consumed by an in-body
+    vocab-parallel CE (``ops/layers.vocab_parallel_ce_inbody``); leaves
+    default to replicated, and tail grads keep the same specs.
 
     Memory: backward recomputes its chunk from the stashed stage INPUT
     (standard 1F1B remat), so each stage holds at most S microbatch
@@ -357,7 +361,12 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
             lambda p, spec: P(axis, *spec), stacked_params, param_partition)
     x_spec = P(data_axes(mesh), *([None] * (x.ndim - 1)))
     t_spec = P(data_axes(mesh), *([None] * (targets.ndim - 1)))
-    tail_specs = jax.tree_util.tree_map(lambda _: P(), tail_params)
+    if tail_partition is None:
+        tail_specs = jax.tree_util.tree_map(lambda _: P(), tail_params)
+    else:
+        tail_specs = jax.tree_util.tree_map(
+            lambda _, s: s, tail_params, tail_partition,
+            is_leaf=lambda n: isinstance(n, P))
     fn = jax.shard_map(local, mesh=mesh,
                        in_specs=(param_specs, tail_specs, x_spec, t_spec),
                        out_specs=(P(), param_specs, tail_specs, x_spec),
