@@ -16,3 +16,34 @@ os.environ.setdefault("TPUMESOS_LOGLEVEL", "WARNING")
 from tfmesos_tpu.utils.platform import force_platform  # noqa: E402
 
 force_platform("cpu", min_host_devices=8)
+
+# The suite compiles thousands of tiny XLA programs in ONE pytest
+# process, and every loaded executable costs ~3-4 kernel memory maps that
+# jax's in-memory caches keep alive forever.  At vm.max_map_count's
+# default 65530 the process hits the ceiling a few thousand executables
+# in, and the next native mmap fails as a SIGSEGV in whatever
+# compile/deserialize happens to run — observed as rc=139 at a
+# DETERMINISTIC test deep in the full run (while any subset passes).
+# Two-part fix:
+#   1. a persistent on-disk compilation cache, so recompiles are cheap
+#      deserializes (and reruns skip native compilation entirely);
+#   2. jax.clear_caches() after every test module, releasing each
+#      module's executables (and their maps) — the disk cache makes the
+#      cross-module recompiles it causes nearly free.
+import gc  # noqa: E402
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("TPUMESOS_TEST_CACHE",
+                                 "/tmp/tpumesos-jax-test-cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_executable_maps():
+    yield
+    jax.clear_caches()
+    gc.collect()
