@@ -134,7 +134,9 @@ def main():
         # n_draft on both the cache depth and the write high-water mark.
         nd = SPEC_N_DRAFT if args.speculative else 0
         bucket = args.prefill_chunk or 64
-        ml = cfg.max_seq_len - nd
+        # -1 in spec mode: the draft's backfill step writes one past the
+        # proposals (ContinuousBatcher's depth check).
+        ml = cfg.max_seq_len - (nd + 1 if nd else 0)
         climit = min((ml - nd) // bucket * bucket,
                      ml - nd - args.new_tokens + 1)
         if any(len(t) > climit for t in prompts):

@@ -362,21 +362,58 @@ def test_accept_rejection_budget_exhausts_into_fatal(master):
     backend.stop()
 
 
-def test_rescind_of_unconfirmed_launch_drops_and_revives(master):
+def test_rescind_of_unconfirmed_launch_requeues_without_budget(master):
     """RESCIND for an offer whose tasks never reached TASK_RUNNING kills
-    the (possibly phantom) launch and routes through the revive path."""
+    the (possibly phantom) launch and re-queues placement — WITHOUT
+    consuming the two-phase failure budget (rescinds are offer churn,
+    not task failures; three of them must not abort bring-up)."""
+    s, backend = _scheduler_on(master,
+                               [Job(name="w", num=1, cpus=1, mem=64)])
+    for i in range(4):      # > MAX_FAILURE_COUNT churn cycles
+        master.push({"type": "OFFERS",
+                     "offers": {"offers": [mesos_offer(f"o-r{i}", cpus=4)]}})
+        deadline = time.time() + 5
+        while not s.tasks[0].offered and time.time() < deadline:
+            time.sleep(0.02)
+        stale_id = s.tasks[0].id
+        master.push({"type": "RESCIND",
+                     "rescind": {"offer_id": {"value": f"o-r{i}"}}})
+        deadline = time.time() + 5
+        while s.tasks[0].id == stale_id and time.time() < deadline:
+            time.sleep(0.02)
+        assert s.tasks[0].id != stale_id
+        assert not s.tasks[0].offered
+    master.wait_call("KILL")
+    master.wait_call("REVIVE")
+    assert s._fatal is None                 # churn never became fatal
+    assert s.task_failure_count == {}       # budget untouched
+    backend.stop()
+
+
+def test_heartbeat_retries_failed_revive(master):
+    """A REVIVE rejected while the subscribe stream stays healthy must be
+    re-issued on the master heartbeat — otherwise FOREVER decline filters
+    keep the offer tap closed until start_timeout."""
+    master.call_responses["REVIVE"] = [500, 500]
     s, backend = _scheduler_on(master,
                                [Job(name="w", num=1, cpus=1, mem=64)])
     master.push({"type": "OFFERS",
-                 "offers": {"offers": [mesos_offer("o-r", cpus=4)]}})
-    master.wait_call("ACCEPT")
-    stale_id = s.tasks[0].id
-    master.push({"type": "RESCIND",
-                 "rescind": {"offer_id": {"value": "o-r"}}})
-    master.wait_call("KILL")
-    master.wait_call("REVIVE")
-    assert s.tasks[0].id != stale_id
-    assert not s.tasks[0].offered
+                 "offers": {"offers": [mesos_offer(cpus=4)]}})
+    accept = master.wait_call("ACCEPT")
+    tid = accept["accept"]["operations"][0]["launch"]["task_infos"][0][
+        "task_id"]["value"]
+    master.push({"type": "UPDATE", "update": {"status": {
+        "task_id": {"value": tid}, "state": "TASK_FAILED",
+        "agent_id": {"value": "agent-1"}}}})
+    master.wait_call("REVIVE")              # first attempt (rejected 500)
+    master.push({"type": "HEARTBEAT"})
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if sum(1 for c in master.calls if c.get("type") == "REVIVE") >= 2:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("heartbeat did not retry the revive")
     backend.stop()
 
 

@@ -316,7 +316,9 @@ class MesosBackend(ResourceBackend):
             if offer_id:
                 self._scheduler.on_rescind(offer_id)
         elif etype == "HEARTBEAT":
-            pass
+            # Liveness backstop: a failed/rejected REVIVE while the stream
+            # stays healthy would otherwise leave the offer tap closed.
+            self._scheduler.on_heartbeat()
         else:
             self.log.debug("ignoring event %s", etype)
 

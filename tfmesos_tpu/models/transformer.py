@@ -1501,7 +1501,10 @@ def speculative_generate(cfg: TransformerConfig, params,
         cache, draft_cache, tok, pos, committed, out, rng = state
         active = committed < max_new_tokens
 
-        # Draft k tokens autoregressively (t=1 ragged steps).
+        # Draft k tokens autoregressively (t=1 ragged steps).  k+1 scan
+        # steps: the extra one writes the last proposal's K/V at pos+k
+        # (proposal discarded), so a fully-accepted round never leaves a
+        # hole the draft would condition on for the rest of the row.
         def dstep(carry, _):
             dcache, dtok, dpos = carry
             lg, dcache = decode_step(draft_cfg, draft_params, dcache,
@@ -1510,8 +1513,8 @@ def speculative_generate(cfg: TransformerConfig, params,
             return (dcache, nxt, dpos + 1), nxt
 
         (draft_cache, _, _), drafts = jax.lax.scan(
-            dstep, (draft_cache, tok, pos), None, length=k)
-        drafts = jnp.moveaxis(drafts, 0, 1)             # [B, k]
+            dstep, (draft_cache, tok, pos), None, length=k + 1)
+        drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]      # [B, k]
 
         # Target scores the whole drafted chunk in one ragged decode.
         chunk = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, k+1]
@@ -1541,10 +1544,12 @@ def speculative_generate(cfg: TransformerConfig, params,
             nxt = jax.random.categorical(key, f, axis=-1).astype(jnp.int32)
             return (dcache, nxt, dpos + 1), (nxt, jax.nn.softmax(f, -1))
 
+        # k+1 steps for the same backfill-the-last-slot reason as the
+        # greedy round; the extra proposal and its distribution drop.
         (draft_cache, _, _), (drafts, pd) = jax.lax.scan(
-            dstep, (draft_cache, tok, pos), jax.random.split(kd, k))
-        drafts = jnp.moveaxis(drafts, 0, 1)             # [B, k]
-        pd = jnp.moveaxis(pd, 0, 1)                     # [B, k, V]
+            dstep, (draft_cache, tok, pos), jax.random.split(kd, k + 1))
+        drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]      # [B, k]
+        pd = jnp.moveaxis(pd, 0, 1)[:, :k]              # [B, k, V]
 
         chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
         lg, cache = decode_step(cfg, params, cache, chunk, pos)

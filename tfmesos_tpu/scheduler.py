@@ -304,37 +304,58 @@ class TPUMesosScheduler:
     def on_rescind(self, offer_id: str) -> None:
         """An outstanding offer was withdrawn by the master.  Tasks placed
         on it whose launch never confirmed (no TASK_RUNNING seen) are
-        synthesized TASK_DROPPED so the two-phase policy revives them —
-        without this they would sit offered=True until ``start_timeout``.
-        The reference ignored rescinds entirely (no offerRescinded
-        handler); on a busy cluster a stale-offer launch then hung
+        RE-QUEUED for placement — without this they would sit
+        offered=True until ``start_timeout``.  Rescinds are ordinary
+        offer churn on a busy master, not task failures: they do NOT
+        consume the two-phase failure budget (three rescinds of one
+        slot's placements must not abort a cluster where nothing ever
+        crashed).  The reference ignored rescinds entirely (no
+        offerRescinded handler); a stale-offer launch then hung its
         bring-up."""
-        to_drop: List[str] = []
+        to_requeue: List[str] = []
+        revive = False
         with self._lock:
             for task in self.tasks:
                 if (task.offer_id == offer_id and task.offered
                         and not task.initialized
                         and task.last_state != "TASK_RUNNING"):
-                    to_drop.append(task.id)
-        for tid in to_drop:
+                    to_requeue.append(task.id)
+                    self.log.warning(
+                        "offer %s rescinded before launch of %s confirmed; "
+                        "re-queuing placement", offer_id, task)
+                    task.reset()
+                    revive = True
+        for tid in to_requeue:
             # The ACCEPT may have raced the rescind server-side; a KILL for
             # a task that never launched is a no-op, and one that did
-            # launch must die anyway (its id is about to go stale).  kill
-            # and drop are guarded SEPARATELY: a failed kill POST must not
-            # skip the synthetic terminal status (the drop is what clears
-            # the offered=True limbo), and neither failure may strand the
-            # remaining rescinded tasks.
+            # launch must die anyway (its id is now stale).  Guarded: one
+            # failed HTTP call must not strand the remaining tasks.
             try:
                 self.backend.kill(tid)
             except Exception as e:
                 self.log.warning("rescind kill of %s failed: %s", tid[:8], e)
+        if revive:
             try:
-                self.on_status(TaskStatus(
-                    tid, "TASK_DROPPED",
-                    message=f"offer {offer_id} rescinded before launch "
-                            f"confirmed"))
+                self.backend.revive()
             except Exception as e:
-                self.log.warning("rescind drop of %s failed: %s", tid[:8], e)
+                self.log.warning("revive call failed (heartbeat will "
+                                 "retry): %s", e)
+
+    def on_heartbeat(self) -> None:
+        """Master heartbeat (~15s): the liveness backstop for a REVIVE
+        that failed or was rejected while the subscribe stream stayed
+        healthy — with FOREVER decline filters active after suppression,
+        nothing else would ever re-open the offer tap for an unplaced
+        task (bring-up would idle into start_timeout)."""
+        with self._lock:
+            need = (not self._stopped and self._fatal is None
+                    and not self.started
+                    and any(not t.offered for t in self.tasks))
+        if need:
+            try:
+                self.backend.revive()
+            except Exception as e:
+                self.log.warning("heartbeat revive failed: %s", e)
 
     def on_agent_lost(self, agent_id: str) -> None:
         """Reference slaveLost/executorLost (scheduler.py:445-453)."""

@@ -226,11 +226,13 @@ class ContinuousBatcher:
                 raise ValueError(f"n_draft must be >= 1, got {n_draft}")
             if draft_cfg.vocab_size != cfg.vocab_size:
                 raise ValueError("draft and target must share a vocab")
-            depth = self.max_len + self.n_draft
+            # +1: the backfill draft step writes one past the proposals,
+            # and parked rows sit at position max_len.
+            depth = self.max_len + self.n_draft + 1
             if draft_cfg.max_seq_len < depth:
                 raise ValueError(
                     f"draft max_seq_len ({draft_cfg.max_seq_len}) must "
-                    f"cover max_len + n_draft ({depth}) — rows can "
+                    f"cover max_len + n_draft + 1 ({depth}) — rows can "
                     f"overshoot by a draft run")
             from tfmesos_tpu.models.transformer import init_cache
             self._draft_cache = init_cache(draft_cfg, rows, depth)
@@ -372,10 +374,16 @@ class ContinuousBatcher:
                     f, rids, steps)
                 return (dc, nxt, dpos + 1), (nxt, jax.nn.softmax(f, -1))
 
+            # k+1 steps: the extra step writes the LAST proposal's K/V
+            # at pos+k (its proposal is discarded) — otherwise a fully
+            # accepted round advances past pos+k with that draft-cache
+            # slot never written, and the draft conditions on a hole for
+            # the rest of the request (silent acceptance-rate decay on
+            # exactly the requests where the draft is best).
             (dcache, _, _), (drafts, pd) = jax.lax.scan(
                 dstep, (dcache, toks, positions),
-                jnp.arange(k, dtype=jnp.int32))
-            drafts = jnp.moveaxis(drafts, 0, 1)             # [rows, k]
+                jnp.arange(k + 1, dtype=jnp.int32))
+            drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]      # [rows, k]
             chunk = jnp.concatenate([toks[:, None], drafts], axis=1)
             cache = dict(pool, pages=table)
             lg, cache = decode_step(self.cfg, params, cache, chunk,
@@ -385,7 +393,7 @@ class ContinuousBatcher:
                 g = jnp.argmax(lg, -1).astype(jnp.int32)    # [rows, k+1]
                 return pool_out, dcache, g, greedy_accept_counts(drafts, g)
 
-            pd = jnp.moveaxis(pd, 0, 1)                     # [rows, k, V]
+            pd = jnp.moveaxis(pd, 0, 1)[:, :k]              # [rows, k, V]
             pt = jax.nn.softmax(filter_logits(lg, T, tk_, tp_), -1)
             u = jax.vmap(lambda r, s: jax.vmap(
                 lambda j: jax.random.uniform(
