@@ -244,6 +244,32 @@ def test_speculative_batcher_matches_plain(setup, draft_setup,
         assert rounds["n"] < total_tokens / 2
 
 
+def test_speculative_perfect_draft_minimal_rounds(setup):
+    """Regression for the draft-cache backfill: with draft == target,
+    EVERY round must commit k+1 tokens — the pre-fix hole at pos+k made
+    round 2+ propose from a corrupted context, silently inflating the
+    round count.  rows=1, one request: the count is exact."""
+    cfg, params = setup
+    k, max_new = 3, 13
+    b = ContinuousBatcher(cfg, params, rows=1, max_len=64, page_size=16,
+                          prefill_bucket=16, draft_cfg=cfg,
+                          draft_params=params, n_draft=k)
+    rounds = {"n": 0}
+    inner = b._spec_round
+
+    def counting(*a):
+        rounds["n"] += 1
+        return inner(*a)
+
+    b._spec_round = counting
+    req = Request(prompt=_prompts(cfg, 1, seed=61)[0],
+                  max_new_tokens=max_new)
+    done = list(b.run([req]))
+    assert done[0].tokens == _offline(cfg, params, req)
+    # 1 token from prefill + ceil((max_new-1)/(k+1)) perfect rounds.
+    assert rounds["n"] == -(-(max_new - 1) // (k + 1))
+
+
 def test_speculative_batcher_stop_token(setup, draft_setup):
     cfg, params = setup
     dcfg, dparams = draft_setup
