@@ -21,6 +21,52 @@ def test_dryrun_multichip_in_process():
         sys.path.remove(REPO)
 
 
+def test_entry_raises_instead_of_hanging_on_wedged_relay(monkeypatch):
+    """With no backend initialized and the probe reporting a hang, entry()
+    must raise rather than proceed into a backend init that would wedge."""
+    monkeypatch.delenv("TPUMESOS_ENTRY_SKIP_PROBE", raising=False)
+    monkeypatch.setenv("TPUMESOS_ENTRY_PROBE_ATTEMPTS", "1")
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+        import __graft_entry__ as g
+        monkeypatch.setattr(g, "_backend_already_initialized", lambda: False)
+        monkeypatch.setattr(
+            bench, "_probe_device_once",
+            lambda timeout_s: f"device probe hung for {timeout_s:.0f}s")
+        try:
+            g.entry()
+        except RuntimeError as e:
+            assert "relay wedged" in str(e)
+        else:
+            raise AssertionError("entry() did not raise on a dead probe")
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_entry_skips_probe_once_backend_is_live(monkeypatch):
+    """conftest already initialized the CPU backend; entry() must not spend
+    a subprocess probe (which would be pure overhead) and must return a
+    jittable (fn, args)."""
+    monkeypatch.delenv("TPUMESOS_ENTRY_SKIP_PROBE", raising=False)
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+        import __graft_entry__ as g
+        import jax
+        jax.devices()  # ensure a live backend regardless of test order
+
+        def _boom(timeout_s):
+            raise AssertionError("probe ran despite live backend")
+
+        monkeypatch.setattr(bench, "_probe_device_once", _boom)
+        assert g._backend_already_initialized()
+        fn, args = g.entry()
+        assert callable(fn) and len(args) == 2
+    finally:
+        sys.path.remove(REPO)
+
+
 def test_dryrun_multichip_reexecs_when_backend_pinned():
     """Initialize a 1-device backend first; dryrun_multichip(8) must detect
     the shortfall and re-exec into a clean child interpreter that forces the
