@@ -390,6 +390,32 @@ def test_speculative_with_chunked_prefill(setup, draft_setup,
     assert combo.alloc.rows == {}
 
 
+def test_speculative_draft_pool_tracks_live_tokens(setup, draft_setup):
+    """The draft's K/V is paged like the target's: occupancy is bounded
+    by in-flight rows' worst case, everything recycles at stream end,
+    and a shared prefix holds reserved draft pages instead of a per-row
+    broadcast copy."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    prefix = np.random.RandomState(71).randint(
+        0, cfg.vocab_size, size=13).astype(np.int32)
+    reqs = [Request(prompt=p, max_new_tokens=4)
+            for p in _prompts(cfg, 6, seed=72)]
+    b = ContinuousBatcher(cfg, params, rows=2, max_len=96, page_size=16,
+                          prefill_bucket=16, prefix=prefix,
+                          draft_cfg=dcfg, draft_params=dparams, n_draft=3)
+    done = list(b.run(reqs))
+    assert len(done) == len(reqs)
+    for side in (b.t_side, b.d_side):
+        # All own pages recycled; sink + prefix reservations persist.
+        n_reserved = -(-13 // 16)
+        assert side.alloc.rows == {}
+        assert side.alloc.free_count() == side.n_pages - 1 - n_reserved
+        # High-water mark stayed within 2 concurrent worst cases.
+        per_row_worst = -(-(96 - 0) // 16)      # tail page is own (COW)
+        assert side.peak <= 2 * per_row_worst + 1 + n_reserved
+
+
 def test_speculative_batcher_validation(setup, draft_setup):
     cfg, params = setup
     dcfg, dparams = draft_setup

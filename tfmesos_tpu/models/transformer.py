@@ -728,7 +728,13 @@ def _paged_cache_write(pool, chunk, page_table, pos):
     ps = (pool.values if isinstance(pool, QTensor) else pool).shape[2]
     posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
     lpos = posv[:, None] + jnp.arange(t, dtype=jnp.int32)[None]   # [B, t]
-    pages = jnp.take_along_axis(page_table, lpos // ps, axis=1).reshape(-1)
+    # Clamp the block index explicitly: serving parks inactive rows at
+    # position max_len, whose block can be one past the table width when
+    # max_len is a page multiple.  A parked row's whole table row is the
+    # sink page, so the clamped entry is still the sink — but make that a
+    # guarantee of this code, not of out-of-bounds gather semantics.
+    blk = jnp.minimum(lpos // ps, page_table.shape[1] - 1)
+    pages = jnp.take_along_axis(page_table, blk, axis=1).reshape(-1)
     offs = (lpos % ps).reshape(-1)
 
     def put(buf, x):

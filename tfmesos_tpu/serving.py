@@ -96,7 +96,8 @@ class _Row:
     step: int           # tokens generated so far
     last: int           # last emitted token (feeds the next decode step)
     out: List[int]
-    worst_pages: int    # admission-time reservation
+    worst_pages: int    # admission-time reservation (target pool)
+    worst_draft: int = 0    # ... and the draft pool's, in speculative mode
     t_admit: float = 0.0    # perf_counter at prefill start
     t_first: float = 0.0    # ... at first-token availability
     # Chunked-prefill state (prefill_chunk mode): the padded prompt and
@@ -104,6 +105,180 @@ class _Row:
     padded: Optional[np.ndarray] = None
     filled: int = 0
     decoding: bool = True
+
+
+class _ShardedAlloc:
+    """``PageAllocator``'s surface over per-shard sub-pools: rows are
+    partitioned into ``n_shards`` contiguous groups (shard = row //
+    rows_per_shard — the layout ``PartitionSpec("dp")`` gives a sharded
+    axis), each group allocating from its own shard of the physical
+    pool, and every page id handed out is LOCAL to its shard.  With
+    ``n_shards=1`` this is exactly one PageAllocator.  Reservations
+    (``reserve_page``) are taken symmetrically in every shard and must
+    land on the same local id — so a single id names the sink or a
+    shared-prefix page in every shard's sub-pool."""
+
+    def __init__(self, n_pages_per_shard: int, page_size: int,
+                 n_shards: int = 1, rows_per_shard: int = 0):
+        self.page_size = int(page_size)
+        self.n_shards = int(n_shards)
+        self.rows_per_shard = int(rows_per_shard)
+        self.shards = [PageAllocator(n_pages_per_shard, page_size)
+                       for _ in range(self.n_shards)]
+
+    def shard_of(self, row: int) -> int:
+        return row // self.rows_per_shard if self.n_shards > 1 else 0
+
+    @property
+    def rows(self) -> Dict[int, list]:
+        """Merged row → local-page-list view (global row ids never
+        collide across shards)."""
+        out: Dict[int, list] = {}
+        for a in self.shards:
+            out.update(a.rows)
+        return out
+
+    @property
+    def free(self) -> list:
+        """All shards' free local ids, concatenated (sizing/tests)."""
+        return [p for a in self.shards for p in a.free]
+
+    def ensure(self, row: int, length: int) -> None:
+        self.shards[self.shard_of(row)].ensure(row, length)
+
+    def release(self, row: int) -> None:
+        self.shards[self.shard_of(row)].release(row)
+
+    def allocated(self, row: int) -> int:
+        return self.shards[self.shard_of(row)].allocated(row)
+
+    def free_count(self, shard: Optional[int] = None) -> int:
+        if shard is not None:
+            return self.shards[shard].free_count()
+        return sum(a.free_count() for a in self.shards)
+
+    def reserve_page(self) -> int:
+        ids = [a.reserve_page() for a in self.shards]
+        assert all(i == ids[0] for i in ids), \
+            "asymmetric reservation — shards must reserve in lockstep"
+        return ids[0]
+
+
+class _PagedSide:
+    """Host-side state of ONE paged pool — the target's, or (speculative
+    mode) the draft's: the per-shard allocator, reserved sink/prefix
+    pages, the device pool, and the cached page tables the jitted steps
+    consume.  Table entries are LOCAL page ids (see
+    :class:`_ShardedAlloc`); a row with no allocation is all-sink."""
+
+    def __init__(self, n_pages: int, page_size: int, rows: int,
+                 np_max: int, n_shards: int = 1):
+        if n_pages % n_shards:
+            raise ValueError(f"n_pages ({n_pages}) must divide over "
+                             f"{n_shards} mesh data shards")
+        if rows % n_shards:
+            raise ValueError(f"rows ({rows}) must divide over "
+                             f"{n_shards} mesh data shards")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.rows = int(rows)
+        self.np_max = int(np_max)
+        self.alloc = _ShardedAlloc(n_pages // n_shards, page_size,
+                                   n_shards, rows // n_shards)
+        # Inactive decode rows still execute the batched paged scatter —
+        # their table entries must point somewhere writable that no live
+        # request owns.  Reserve one pool page (per shard) as that sink.
+        self.sink = self.alloc.reserve_page()
+        self.pool = None                  # device arrays, set by owner
+        self.shared_pages: List[int] = []  # full prefix pages, read-only
+        self.shared_len = 0                # positions they cover
+        self.tail_template: Optional[int] = None  # partial-page template
+        self.peak = 0                      # observability: high-water mark
+        self._cache = None        # device table; rebuilt when dirty
+        self._cache_np = None     # host master copy of the table
+        self._masked = None       # (filling_rows, device table)
+
+    def ensure(self, row: int, length: int) -> None:
+        """Back ABSOLUTE positions [0, length): the shared prefix pages
+        cover [0, shared_len); the row's own allocation covers the rest."""
+        before = self.alloc.allocated(row)
+        self.alloc.ensure(row, max(0, length - self.shared_len))
+        if self.alloc.allocated(row) != before:
+            self._cache = self._cache_np = self._masked = None
+        used = self.n_pages - self.alloc.free_count()
+        if used > self.peak:
+            self.peak = used
+
+    def release(self, row: int) -> None:
+        self.alloc.release(row)
+        self._cache = self._cache_np = self._masked = None
+
+    def headroom(self, active: Dict[int, _Row], worst_of,
+                 shard: int) -> int:
+        """Free pages in ``shard`` not spoken for by in-flight rows'
+        admission reservations (``worst_of(row)`` — worst_pages or
+        worst_draft)."""
+        outstanding = sum(
+            worst_of(row) - self.alloc.allocated(r)
+            for r, row in active.items()
+            if self.alloc.shard_of(r) == shard)
+        return self.alloc.free_count(shard) - outstanding
+
+    def table_np(self) -> np.ndarray:
+        """Host master copy of the table (chunked prefill masks per-step
+        variants off it)."""
+        if self._cache_np is None:
+            # Rows WITH allocations see [shared prefix pages | own pages];
+            # rows without stay all-sink (an inactive row writes its
+            # garbage step at position 0 — that must never land on a
+            # shared or live page).
+            t = np.full((self.rows, self.np_max), self.sink, np.int32)
+            ns = len(self.shared_pages)
+            rows_map = self.alloc.rows
+            for r in range(self.rows):
+                own = rows_map.get(r)
+                if own:
+                    if ns:
+                        t[r, :ns] = self.shared_pages
+                    t[r, ns:ns + len(own)] = own
+            self._cache_np = t
+        return self._cache_np
+
+    def table(self) -> jnp.ndarray:
+        """Fixed-shape [rows, np_max] device table, rebuilt only when the
+        allocation actually changed (page-boundary growth, admission,
+        release) — not every token."""
+        if self._cache is None:
+            self._cache = jnp.asarray(self.table_np())
+        return self._cache
+
+    def decode_table(self, active: Dict[int, _Row],
+                     decoding: Dict[int, _Row]) -> jnp.ndarray:
+        """The batched step's device table: the plain cached table when
+        every active row decodes; otherwise a masked variant with
+        still-filling rows' entries pinned to the sink (their chunked
+        prefill owns their pages), cached until the allocation OR the
+        filling set changes — steady-state admission must not re-upload
+        the table every token."""
+        if len(decoding) == len(active):
+            return self.table()
+        filling = frozenset(r for r, row in active.items()
+                            if not row.decoding)
+        if self._masked is None or self._masked[0] != filling:
+            t = self.table_np().copy()
+            for r in filling:
+                t[r, :] = self.sink
+            self._masked = (filling, jnp.asarray(t))
+        return self._masked[1]
+
+
+@partial(jax.jit, donate_argnums=0)
+def _copy_page(pool, src, dst):
+    """Copy pool page ``src`` into page ``dst`` on every layer and leaf
+    (K and V; int8 QTensors copy values and scales alike) — the
+    copy-on-write step behind partially-shared prefix tail pages."""
+    return jax.tree_util.tree_map(
+        lambda buf: buf.at[:, dst].set(buf[:, src]), pool)
 
 
 class ContinuousBatcher:
@@ -119,8 +294,11 @@ class ContinuousBatcher:
 
     ``draft_cfg``/``draft_params`` (optional) turn on SPECULATIVE
     decoding inside the batcher: every tick, the draft proposes
-    ``n_draft`` tokens per row (batched t=1 steps on its own contiguous
-    cache) and the target verifies them in ONE ragged chunk over the
+    ``n_draft`` tokens per row (batched t=1 steps over its OWN paged
+    pool — draft HBM tracks live tokens exactly like the target's, and
+    a shared prefix occupies shared draft pages once instead of a
+    per-row broadcast; ``draft_n_pages`` sizes it, default fully
+    backed) and the target verifies them in ONE ragged chunk over the
     paged pool — rows commit their leading accepted run plus the
     target's correction, so each tick emits 1..n_draft+1 tokens per row
     instead of exactly 1.  Greedy outputs equal the target-only
@@ -166,7 +344,8 @@ class ContinuousBatcher:
                  quantized_cache: bool = False, prefix=None,
                  prefill_chunk: Optional[int] = None,
                  draft_cfg: Optional[TransformerConfig] = None,
-                 draft_params=None, n_draft: int = 4):
+                 draft_params=None, n_draft: int = 4,
+                 draft_n_pages: Optional[int] = None):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         self.cfg = cfg
@@ -201,17 +380,11 @@ class ContinuousBatcher:
         self.top_k = top_k
         self.top_p = top_p
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
-        self.alloc = PageAllocator(self.n_pages, self.page_size)
-        # Inactive decode rows still execute the batched paged scatter —
-        # their table entries must point somewhere writable that no live
-        # request owns.  Reserve one pool page as that sink.
-        self._sink_page = self.alloc.reserve_page()
-        self.pool = init_paged_cache(cfg, self.n_pages, self.page_size,
-                                     quantized=quantized_cache)
+        self.t_side = _PagedSide(self.n_pages, self.page_size, rows,
+                                 self.np_max)
+        self.t_side.pool = init_paged_cache(
+            cfg, self.n_pages, self.page_size, quantized=quantized_cache)
         self.prefix_len = 0
-        self._shared_pages: List[int] = []   # full prefix pages, read-only
-        self._shared_len = 0                 # positions they cover
-        self._tail_template: Optional[int] = None  # partial-page template
         self._prefill_fns: Dict[int, Any] = {}
         self._decode = self._make_decode()
         self._chunk_prefill = (self._make_chunk_prefill()
@@ -221,6 +394,7 @@ class ContinuousBatcher:
         self.n_draft = int(n_draft)
         if (draft_cfg is None) != (draft_params is None):
             raise ValueError("draft_cfg and draft_params come together")
+        self.d_side: Optional[_PagedSide] = None
         if draft_cfg is not None:
             if self.n_draft < 1:
                 raise ValueError(f"n_draft must be >= 1, got {n_draft}")
@@ -234,20 +408,56 @@ class ContinuousBatcher:
                     f"draft max_seq_len ({draft_cfg.max_seq_len}) must "
                     f"cover max_len + n_draft + 1 ({depth}) — rows can "
                     f"overshoot by a draft run")
-            from tfmesos_tpu.models.transformer import init_cache
-            self._draft_cache = init_cache(draft_cfg, rows, depth)
+            # The draft's K/V is PAGED like the target's (same pool/table
+            # layout, its own allocator): admitted requests never exceed
+            # max_len positions even with the verify overshoot (_worst_pages
+            # validates that), so the draft table is np_max wide too, and
+            # draft HBM tracks LIVE tokens instead of a rows x
+            # (max_len + n_draft + 1) worst-case buffer.  Parked free rows
+            # write at position max_len through all-sink table rows (the
+            # clamped block gather lands on the sink page).
+            self.n_draft_pages = int(draft_n_pages
+                                     or rows * own_max + n_prefix_pages + 1)
+            self.d_side = _PagedSide(self.n_draft_pages, self.page_size,
+                                     rows, self.np_max)
+            self.d_side.pool = init_paged_cache(
+                draft_cfg, self.n_draft_pages, self.page_size)
             self._spec_round = self._make_spec_round()
             self._draft_chunk = self._make_draft_chunk()
         self._next_rid = 0
-        self._table_cache = None        # device table; rebuilt when dirty
-        self._table_cache_np = None     # host master copy of the table
-        self._masked_cache = None       # (filling_rows, device table)
-        self.peak_pages_used = 0        # observability: high-water mark
         if prefix_np is not None:
             self._init_prefix(prefix_np)
 
+    # Back-compat accessors: the paged-side refactor (draft paging) moved
+    # the target pool's state into ``t_side``; callers and tests keep the
+    # original names.
+    @property
+    def pool(self):
+        return self.t_side.pool
+
+    @pool.setter
+    def pool(self, v):
+        self.t_side.pool = v
+
+    @property
+    def alloc(self) -> _ShardedAlloc:
+        return self.t_side.alloc
+
+    @property
+    def peak_pages_used(self) -> int:
+        return self.t_side.peak
+
+    @property
+    def _sink_page(self) -> int:
+        return self.t_side.sink
+
     def _init_prefix(self, prefix: np.ndarray) -> None:
-        """Reserve pages for the shared prefix and prefill it once."""
+        """Reserve pages for the shared prefix and prefill it once —
+        into the target pool, and (speculative mode) into the draft's
+        paged pool the same way: both sides then reference the prefix
+        read-only, with a partially-filled last page kept as a
+        copy-on-write TEMPLATE copied into each admitted row's first own
+        page so row writes never touch shared state."""
         if prefix.ndim != 1 or prefix.size == 0:
             raise ValueError("prefix must be a non-empty 1-D token array")
         if prefix.size >= self.max_len:
@@ -257,50 +467,29 @@ class ContinuousBatcher:
         full = self.prefix_len // self.page_size
         tail = self.prefix_len % self.page_size
         n_reserve = full + (1 if tail else 0)
-        pages = [self.alloc.reserve_page() for _ in range(n_reserve)]
-        table = np.full((1, self.np_max), self._sink_page, np.int32)
-        table[0, :n_reserve] = pages
+        sides = [(self.t_side, self.cfg, self.params)]
+        if self.d_side is not None:
+            sides.append((self.d_side, self.draft_cfg, self.draft_params))
+        for side, cfg, params in sides:
+            pages = [side.alloc.reserve_page() for _ in range(n_reserve)]
+            table = np.full((1, side.np_max), side.sink, np.int32)
+            table[0, :n_reserve] = pages
 
-        @partial(jax.jit, donate_argnums=1)
-        def prefill_prefix(params, pool, t, toks):
-            cache = dict(pool, pages=t)
-            _, cache = decode_step(self.cfg, params, cache, toks, 0)
-            return {"k": cache["k"], "v": cache["v"]}
-
-        self.pool = prefill_prefix(self.params, self.pool,
-                                   jnp.asarray(table), jnp.asarray(
-                                       prefix[None]))
-        if self.draft_cfg is not None:
-            # The draft conditions on the full context too: prefill the
-            # prefix once at batch 1 and broadcast it to every row of the
-            # draft's contiguous cache.
             @partial(jax.jit, donate_argnums=1)
-            def draft_prefix(dparams, dcache, toks):
-                row = jax.tree_util.tree_map(lambda x: x[:, :1], dcache)
-                _, row = decode_step(self.draft_cfg, dparams, row, toks, 0)
-                return jax.tree_util.tree_map(
-                    lambda full, rc: jnp.broadcast_to(
-                        rc, full.shape).astype(full.dtype), dcache, row)
+            def prefill_prefix(params, pool, t, toks, cfg=cfg):
+                cache = dict(pool, pages=t)
+                _, cache = decode_step(cfg, params, cache, toks, 0)
+                return {"k": cache["k"], "v": cache["v"]}
 
-            self._draft_cache = draft_prefix(
-                self.draft_params, self._draft_cache,
-                jnp.asarray(prefix[None]))
-        if tail:
-            # The last prefix page is only partially shared: keep it as a
-            # TEMPLATE, copied into each admitted row's first own page
-            # (copy-on-write) so row writes never touch shared state.
-            self._tail_template = pages[-1]
-            self._shared_pages = pages[:-1]
-        else:
-            self._shared_pages = pages
-        self._shared_len = len(self._shared_pages) * self.page_size
-
-        @partial(jax.jit, donate_argnums=0)
-        def copy_page(pool, src, dst):
-            return jax.tree_util.tree_map(
-                lambda buf: buf.at[:, dst].set(buf[:, src]), pool)
-
-        self._copy_page = copy_page
+            side.pool = prefill_prefix(params, side.pool,
+                                       jnp.asarray(table),
+                                       jnp.asarray(prefix[None]))
+            if tail:
+                side.tail_template = pages[-1]
+                side.shared_pages = pages[:-1]
+            else:
+                side.shared_pages = pages
+            side.shared_len = len(side.shared_pages) * self.page_size
 
     # -- compiled shapes --------------------------------------------------
 
@@ -331,10 +520,11 @@ class ContinuousBatcher:
         return fn
 
     def _make_spec_round(self):
-        """Jitted speculative round: k batched draft steps on the
-        draft's contiguous cache, then one ragged (k+1)-token target
-        verify over the paged pool.  Returns the commit candidates
-        [rows, k+1] and each row's commit count.
+        """Jitted speculative round: k batched draft steps over the
+        draft's OWN paged pool (its page table fixed across the scan —
+        the caller pre-ensures pages for the round's writes), then one
+        ragged (k+1)-token target verify over the target pool.  Returns
+        the commit candidates [rows, k+1] and each row's commit count.
 
         Greedy (temperature 0): candidates are the target's greedy
         tokens, count = leading draft==target run + 1.  Sampling:
@@ -356,14 +546,16 @@ class ContinuousBatcher:
                                       s)
 
         @partial(jax.jit, donate_argnums=(1, 3))
-        def fn(params, pool, dparams, dcache, table, toks, positions,
-               rids, steps):
+        def fn(params, pool, dparams, dpool, table, dtable, toks,
+               positions, rids, steps):
             b = toks.shape[0]
 
             def dstep(carry, j):
                 dc, dtok, dpos = carry
-                lg, dc = decode_step(self.draft_cfg, dparams, dc,
+                lg, dc = decode_step(self.draft_cfg, dparams,
+                                     dict(dc, pages=dtable),
                                      dtok[:, None], dpos)
+                dc = {"k": dc["k"], "v": dc["v"]}
                 if not sampling:
                     nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
                     return (dc, nxt, dpos + 1), (nxt, jnp.zeros(()))
@@ -380,8 +572,9 @@ class ContinuousBatcher:
             # slot never written, and the draft conditions on a hole for
             # the rest of the request (silent acceptance-rate decay on
             # exactly the requests where the draft is best).
-            (dcache, _, _), (drafts, pd) = jax.lax.scan(
-                dstep, (dcache, toks, positions),
+            (dpool, _, _), (drafts, pd) = jax.lax.scan(
+                dstep, ({"k": dpool["k"], "v": dpool["v"]}, toks,
+                        positions),
                 jnp.arange(k + 1, dtype=jnp.int32))
             drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]      # [rows, k]
             chunk = jnp.concatenate([toks[:, None], drafts], axis=1)
@@ -391,7 +584,7 @@ class ContinuousBatcher:
             pool_out = {"k": cache["k"], "v": cache["v"]}
             if not sampling:
                 g = jnp.argmax(lg, -1).astype(jnp.int32)    # [rows, k+1]
-                return pool_out, dcache, g, greedy_accept_counts(drafts, g)
+                return pool_out, dpool, g, greedy_accept_counts(drafts, g)
 
             pd = jnp.moveaxis(pd, 0, 1)[:, :k]              # [rows, k, V]
             pt = jax.nn.softmax(filter_logits(lg, T, tk_, tp_), -1)
@@ -412,25 +605,22 @@ class ContinuousBatcher:
             cand = jnp.concatenate(
                 [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
             vals = jnp.where(j == a[:, None], repl[:, None], cand)
-            return pool_out, dcache, vals, a + 1
+            return pool_out, dpool, vals, a + 1
 
         return fn
 
     def _make_draft_chunk(self):
-        """Jitted DRAFT prompt writer at a traced (row, offset): serves
-        both the whole-prompt prefill (offset prefix_len — the prefix is
-        already resident in every draft cache row) and chunked
-        prefill's per-chunk advance.  One compile per chunk width."""
+        """Jitted DRAFT prompt writer over the draft's paged pool: serves
+        both the whole-prompt prefill (offset prefix_len — the prefix
+        pages are shared, so only the prompt is written) and chunked
+        prefill's per-chunk advance.  The caller passes the row's own
+        1-row page table; one compile per chunk width."""
         @partial(jax.jit, donate_argnums=1)
-        def fn(dparams, dcache, chunk, row, pos):
-            rowc = jax.tree_util.tree_map(
-                lambda x: jax.lax.dynamic_slice_in_dim(x, row, 1, 1),
-                dcache)
-            _, rowc = decode_step(self.draft_cfg, dparams, rowc, chunk,
-                                  pos)
-            return jax.tree_util.tree_map(
-                lambda full, rc: jax.lax.dynamic_update_slice_in_dim(
-                    full, rc, row, 1), dcache, rowc)
+        def fn(dparams, dpool, t, chunk, pos):
+            cache = dict(dpool, pages=t)
+            _, cache = decode_step(self.draft_cfg, dparams, cache, chunk,
+                                   pos)
+            return {"k": cache["k"], "v": cache["v"]}
 
         return fn
 
@@ -473,15 +663,17 @@ class ContinuousBatcher:
 
     # -- host-side bookkeeping --------------------------------------------
 
-    def _worst_pages(self, req: Request) -> int:
-        """Worst-case OWN pages (beyond the shared prefix pages)."""
+    def _worst_pages(self, req: Request) -> tuple:
+        """Worst-case OWN pages beyond the shared prefix pages, per side:
+        ``(target, draft)`` (draft 0 without speculative mode)."""
         width = -(-req.prompt.size // self.prefill_bucket) * \
             self.prefill_bucket
         need_len = self.prefix_len + max(
             width, req.prompt.size + req.max_new_tokens - 1)
         if self.draft_cfg is not None:
             # A speculative round at the final position still verifies a
-            # (k+1)-token chunk: its writes overshoot by up to n_draft.
+            # (k+1)-token chunk: its writes overshoot by up to n_draft
+            # (and the draft's k+1 scan steps write the same positions).
             need_len += self.n_draft
         if need_len > self.max_len:
             raise ValueError(
@@ -489,77 +681,53 @@ class ContinuousBatcher:
                 f"{self.prefix_len} + prompt {req.prompt.size} padded to "
                 f"{width}, plus {req.max_new_tokens} new tokens) > "
                 f"max_len ({self.max_len})")
-        return -(-(need_len - self._shared_len) // self.page_size)
+        wt = -(-(need_len - self.t_side.shared_len) // self.page_size)
+        wd = 0
+        if self.d_side is not None:
+            wd = -(-(need_len - self.d_side.shared_len) // self.page_size)
+        return wt, wd
 
-    def _reserve_headroom(self, active: Dict[int, _Row]) -> int:
-        """Free pages not spoken for by in-flight rows' reservations."""
-        outstanding = sum(row.worst_pages - self.alloc.allocated(r)
-                          for r, row in active.items())
-        return self.alloc.free_count() - outstanding
-
-    def _ensure(self, row: int, length: int) -> None:
-        """Back ABSOLUTE positions [0, length): the shared prefix pages
-        cover [0, _shared_len); the row's own allocation covers the rest."""
-        before = self.alloc.allocated(row)
-        self.alloc.ensure(row, max(0, length - self._shared_len))
-        if self.alloc.allocated(row) != before:
-            self._table_cache = self._table_cache_np = None
-            self._masked_cache = None
-        used = self.n_pages - self.alloc.free_count()
-        if used > self.peak_pages_used:
-            self.peak_pages_used = used
-
-    def _release(self, row: int) -> None:
-        self.alloc.release(row)
-        self._table_cache = self._table_cache_np = None
-        self._masked_cache = None
-
-    def _table(self) -> jnp.ndarray:
-        """Fixed-shape [rows, np_max] device table, rebuilt only when the
-        allocation actually changed (page-boundary growth, admission,
-        release) — not every token."""
-        if self._table_cache is None:
-            self._table_cache = jnp.asarray(self._table_np())
-        return self._table_cache
-
-    def _decode_table(self, active: Dict[int, _Row],
-                      decoding: Dict[int, _Row]) -> jnp.ndarray:
-        """The batched step's device table: the plain cached table when
-        every active row decodes; otherwise a masked variant with
-        still-filling rows' entries pinned to the sink (their chunked
-        prefill owns their pages), cached until the allocation OR the
-        filling set changes — steady-state admission must not re-upload
-        the table every token."""
-        if len(decoding) == len(active):
-            return self._table()
-        filling = frozenset(r for r, row in active.items()
-                            if not row.decoding)
-        if self._masked_cache is None or self._masked_cache[0] != filling:
-            t = self._table_np().copy()
-            for r in filling:
-                t[r, :] = self._sink_page
-            self._masked_cache = (filling, jnp.asarray(t))
-        return self._masked_cache[1]
-
-    def _table_np(self) -> np.ndarray:
-        """Host master copy of the table (chunked prefill masks per-step
-        variants off it)."""
-        if self._table_cache_np is None:
-            # Rows WITH allocations see [shared prefix pages | own pages];
-            # rows without stay all-sink (an inactive row writes its
-            # garbage step at position 0 — that must never land on a
-            # shared or live page).
-            t = np.full((self.rows, self.np_max), self._sink_page,
-                        np.int32)
-            ns = len(self._shared_pages)
-            for r in range(self.rows):
-                own = self.alloc.rows.get(r)
-                if own:
-                    if ns:
-                        t[r, :ns] = self._shared_pages
-                    t[r, ns:ns + len(own)] = own
-            self._table_cache_np = t
-        return self._table_cache_np
+    def _admit_row(self, free_rows: List[int], active: Dict[int, _Row],
+                   wt: int, wd: int) -> Optional[int]:
+        """Pop a free row whose shard's pool(s) can take both worst-case
+        reservations, preferring the shard with the most target headroom
+        (load balance across mesh data shards; with one shard this is
+        just a headroom check).  ``None`` means wait for in-flight rows
+        to release pages.  Raises when some free row's shard has NO
+        in-flight work and still can't fit — waiting would deadlock."""
+        best = None
+        empty_shard = None
+        ht_by_shard: Dict[int, int] = {}
+        ok_by_shard: Dict[int, bool] = {}
+        for i, r in enumerate(free_rows):
+            s = self.t_side.alloc.shard_of(r)
+            if s not in ok_by_shard:     # headroom is a per-SHARD fact
+                ht = self.t_side.headroom(active,
+                                          lambda x: x.worst_pages, s)
+                ok = wt <= ht
+                if ok and self.d_side is not None:
+                    ok = wd <= self.d_side.headroom(
+                        active, lambda x: x.worst_draft, s)
+                ht_by_shard[s], ok_by_shard[s] = ht, ok
+            if ok_by_shard[s]:
+                if best is None or ht_by_shard[s] > best[1]:
+                    best = (i, ht_by_shard[s])
+            elif not any(self.t_side.alloc.shard_of(rr) == s
+                         for rr in active):
+                empty_shard = s
+        if best is not None:
+            return free_rows.pop(best[0])
+        if empty_shard is not None:
+            s = empty_shard
+            free_t = self.t_side.alloc.free_count(s)
+            free_d = (0 if self.d_side is None
+                      else self.d_side.alloc.free_count(s))
+            raise RuntimeError(
+                f"request needs {wt} target pages (+ {wd} draft) but "
+                f"shard {s} only has {free_t} target / {free_d} draft "
+                f"free with nothing in flight to wait for — raise "
+                f"n_pages")
+        return None
 
     # -- the loop ---------------------------------------------------------
 
@@ -596,22 +764,17 @@ class ContinuousBatcher:
                     if not pending:
                         break
                     try:
-                        worst = self._worst_pages(pending[0])
+                        wt, wd = self._worst_pages(pending[0])
                     except ValueError as e:
                         bad_request = e     # raise after draining
                         break
-                    if worst > self._reserve_headroom(active):
-                        if not active:
-                            raise RuntimeError(
-                                f"request needs {worst} pages but the pool "
-                                f"only has {self.alloc.free_count()} free "
-                                f"({self.n_pages} total) — raise n_pages")
+                    row = self._admit_row(free_rows, active, wt, wd)
+                    if row is None:
                         break   # wait for an in-flight row to finish
                     req = pending.popleft()
                     rid = self._next_rid
                     self._next_rid += 1
-                    row = free_rows.pop()
-                    done = self._admit(row, rid, req, worst, active)
+                    done = self._admit(row, rid, req, wt, wd, active)
                     if done is not None:
                         self._finish(row, active, free_rows)
                         yield done
@@ -639,44 +802,55 @@ class ContinuousBatcher:
             for row in list(active):
                 self._finish(row, active, free_rows)
 
-    def _admit(self, row: int, rid: int, req: Request, worst: int,
+    def _ensure_sides(self, row: int, length: int) -> None:
+        """Back ABSOLUTE positions [0, length) of ``row`` on the target
+        (and, speculative mode, draft) side.  The first time a row gains
+        own pages, a partially-shared prefix tail page is copied into its
+        first own page (copy-on-write) before any row write can land in
+        it."""
+        sides = ([self.t_side] if self.d_side is None
+                 else [self.t_side, self.d_side])
+        for side in sides:
+            fresh = side.alloc.allocated(row) == 0
+            side.ensure(row, length)
+            if (side.tail_template is not None and fresh
+                    and side.alloc.allocated(row)):
+                side.pool = _copy_page(side.pool, side.tail_template,
+                                       side.alloc.rows[row][0])
+
+    def _admit(self, row: int, rid: int, req: Request, wt: int, wd: int,
                active: Dict[int, _Row]) -> Optional[Completion]:
-        """Prefill ``req`` into ``row``; ``worst`` is the page reservation
-        run() admitted it under.  Returns a Completion when the very
-        first token already finishes the request."""
+        """Prefill ``req`` into ``row``; ``wt``/``wd`` are the per-side
+        page reservations run() admitted it under.  Returns a Completion
+        when the very first token already finishes the request."""
         t_admit = time.perf_counter()
         length = req.prompt.size
         width = -(-length // self.prefill_bucket) * self.prefill_bucket
-        self._ensure(row, self.prefix_len + width)
-        if self._tail_template is not None:
-            # Copy-on-write: the partially-shared prefix page becomes this
-            # row's first own page before any row write can land in it.
-            self.pool = self._copy_page(
-                self.pool, self._tail_template, self.alloc.rows[row][0])
+        self._ensure_sides(row, self.prefix_len + width)
         padded = np.zeros((1, width), np.int32)
         padded[0, :length] = req.prompt
         if self._chunk_prefill is not None:
             # Chunked mode: no model call here — the run loop advances one
             # chunk per tick, interleaved with the batched decode step.
             state = _Row(rid=rid, req=req, pos=self.prefix_len + length,
-                         step=1, last=0, out=[], worst_pages=worst,
-                         t_admit=t_admit, padded=padded, filled=0,
-                         decoding=False)
+                         step=1, last=0, out=[], worst_pages=wt,
+                         worst_draft=wd, t_admit=t_admit, padded=padded,
+                         filled=0, decoding=False)
             active[row] = state
             return None
         self.pool, tok = self._prefill_fn(width)(
-            self.params, self.pool, self._table()[row:row + 1],
+            self.params, self.pool, self.t_side.table()[row:row + 1],
             jnp.asarray(padded), jnp.asarray([length], jnp.int32),
             jnp.asarray([rid], jnp.int32))
-        if self.draft_cfg is not None:
-            self._draft_cache = self._draft_chunk(
-                self.draft_params, self._draft_cache, jnp.asarray(padded),
-                jnp.asarray(row, jnp.int32),
+        if self.d_side is not None:
+            self.d_side.pool = self._draft_chunk(
+                self.draft_params, self.d_side.pool,
+                self.d_side.table()[row:row + 1], jnp.asarray(padded),
                 jnp.asarray(self.prefix_len, jnp.int32))
         tok = int(tok)                  # host sync: first token is real
         now = time.perf_counter()
         state = _Row(rid=rid, req=req, pos=self.prefix_len + length, step=1,
-                     last=tok, out=[tok], worst_pages=worst,
+                     last=tok, out=[tok], worst_pages=wt, worst_draft=wd,
                      t_admit=t_admit, t_first=now)
         active[row] = state
         if tok == req.stop_token or req.max_new_tokens == 1:
@@ -699,17 +873,17 @@ class ContinuousBatcher:
         length = row.req.prompt.size
         cap = length - 1 - row.filled       # in-range only on last chunk
         self.pool, tok = self._chunk_prefill(
-            self.params, self.pool, self._table()[r:r + 1],
+            self.params, self.pool, self.t_side.table()[r:r + 1],
             jnp.asarray(chunk),
             jnp.asarray(self.prefix_len + row.filled, jnp.int32),
             jnp.asarray([cap], jnp.int32),
             jnp.asarray([row.rid], jnp.int32))
-        if self.draft_cfg is not None:
+        if self.d_side is not None:
             # The draft's prompt chunks advance in lockstep so it is
             # ready to propose the moment the row flips to decoding.
-            self._draft_cache = self._draft_chunk(
-                self.draft_params, self._draft_cache, jnp.asarray(chunk),
-                jnp.asarray(r, jnp.int32),
+            self.d_side.pool = self._draft_chunk(
+                self.draft_params, self.d_side.pool,
+                self.d_side.table()[r:r + 1], jnp.asarray(chunk),
                 jnp.asarray(self.prefix_len + row.filled, jnp.int32))
         row.filled += c
         if row.filled < row.padded.shape[1]:
@@ -734,12 +908,12 @@ class ContinuousBatcher:
         steps = np.zeros((self.rows,), np.int32)
         decoding = {r: row for r, row in active.items() if row.decoding}
         for r, row in decoding.items():
-            self._ensure(r, row.pos + 1)    # this step writes `pos`
+            self._ensure_sides(r, row.pos + 1)  # this step writes `pos`
             toks[r] = row.last
             positions[r] = row.pos
             rids[r] = row.rid
             steps[r] = row.step
-        table = self._decode_table(active, decoding)
+        table = self.t_side.decode_table(active, decoding)
         self.pool, nxt = self._decode(
             self.params, self.pool, table, jnp.asarray(toks),
             jnp.asarray(positions), jnp.asarray(rids), jnp.asarray(steps))
@@ -772,16 +946,18 @@ class ContinuousBatcher:
         steps = np.zeros((self.rows,), np.int32)
         decoding = {r: row for r, row in active.items() if row.decoding}
         for r, row in decoding.items():
-            # The verify chunk writes positions [pos, pos + n_draft].
-            self._ensure(r, row.pos + self.n_draft + 1)
+            # The verify chunk writes positions [pos, pos + n_draft] (and
+            # the draft's k+1 scan steps write the same range of ITS pool).
+            self._ensure_sides(r, row.pos + self.n_draft + 1)
             toks[r] = row.last
             positions[r] = row.pos
             rids[r] = row.rid
             steps[r] = row.step
-        table = self._decode_table(active, decoding)
-        self.pool, self._draft_cache, g, n_commit = self._spec_round(
-            self.params, self.pool, self.draft_params, self._draft_cache,
-            table, jnp.asarray(toks), jnp.asarray(positions),
+        table = self.t_side.decode_table(active, decoding)
+        dtable = self.d_side.decode_table(active, decoding)
+        self.pool, self.d_side.pool, g, n_commit = self._spec_round(
+            self.params, self.pool, self.draft_params, self.d_side.pool,
+            table, dtable, jnp.asarray(toks), jnp.asarray(positions),
             jnp.asarray(rids), jnp.asarray(steps))
         g = np.asarray(g)
         n_commit = np.asarray(n_commit)
@@ -817,5 +993,7 @@ class ContinuousBatcher:
     def _finish(self, row: int, active: Dict[int, _Row],
                 free_rows: List[int]) -> None:
         active.pop(row, None)
-        self._release(row)
+        self.t_side.release(row)
+        if self.d_side is not None:
+            self.d_side.release(row)
         free_rows.append(row)
