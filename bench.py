@@ -434,6 +434,47 @@ def bench_serving_continuous(n_requests=32, rows=8):
     return n_requests / dt, mean_ttft_ms
 
 
+def bench_serving_continuous_mesh(n_requests=32, rows=8):
+    """Multi-chip continuous serving: the same stream through a dp x tp
+    mesh over every visible device (pool pages sharded over dp, heads
+    over tp) — requests/s should scale with dp on real slices.  Its own
+    bench section so a mesh failure cannot discard the single-device
+    serving numbers."""
+    import jax
+    import jax.numpy as jnp
+    from tfmesos_tpu.models import transformer
+    from tfmesos_tpu.parallel.mesh import build_mesh
+    from tfmesos_tpu.serving import ContinuousBatcher, Request
+
+    n = jax.device_count()
+    if n < 2:
+        return None
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+        max_seq_len=1024, dtype=jnp.bfloat16)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    def reqs(k):
+        return [Request(prompt=rng.integers(0, cfg.vocab_size, size=(64,))
+                        .astype(np.int32), max_new_tokens=64)
+                for _ in range(k)]
+
+    tp = 2 if cfg.n_heads % 2 == 0 and n % 2 == 0 else 1
+    dp = n // tp
+    mesh = build_mesh({"dp": dp, "tp": tp},
+                      devices=jax.devices()[:dp * tp])
+    mrows = -(-rows // dp) * dp         # smallest multiple of dp >= rows
+    mb = ContinuousBatcher(cfg, params, rows=mrows, max_len=1024,
+                           mesh=mesh)
+    list(mb.run(reqs(2)))   # warm the compiles outside the timed region
+    t0 = time.perf_counter()
+    done = list(mb.run(reqs(n_requests)))
+    dt = time.perf_counter() - t0
+    assert len(done) == n_requests
+    return n_requests / dt
+
+
 def bench_bandwidth(sizes=None):
     """Achieved bandwidth vs roofline.
 
@@ -725,6 +766,11 @@ def main():
         rps, ttft_ms = sv[0]
         out["serving_requests_per_sec"] = round(rps, 2)
         out["serving_mean_ttft_ms"] = round(ttft_ms, 2)
+        flush_partial()
+    msv = attempts(bench_serving_continuous_mesh,
+                   "mesh continuous serving bench", n=1)
+    if msv and msv[0] is not None:  # >1 visible device: dp x tp serving
+        out["serving_mesh_requests_per_sec"] = round(msv[0], 2)
         flush_partial()
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:

@@ -59,9 +59,16 @@ def main():
                         "prompts in chunks of this many tokens, "
                         "interleaved with decode steps — bounds the "
                         "stall a long prompt imposes on decoding rows")
+    p.add_argument("--mesh", type=str, default=None,
+                   help="multi-chip continuous serving (with "
+                        "--continuous): comma-separated mesh axes, e.g. "
+                        "dp=2,tp=2 — pool pages shard over dp, heads "
+                        "over tp; --batch rows must divide over dp")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tiny", action="store_true")
     args = p.parse_args()
+    if args.mesh is not None and not args.continuous:
+        p.error("--mesh is a continuous-batching feature; add --continuous")
     if args.paged and args.continuous:
         p.error("--paged and --continuous are distinct serving modes: "
                 "--continuous already serves from a paged pool (pick one)")
@@ -157,6 +164,11 @@ def main():
                 dtype=cfg.dtype)
             draft_params = transformer.init_params(
                 draft_cfg, jax.random.PRNGKey(args.seed + 4))
+        mesh = None
+        if args.mesh is not None:
+            from tfmesos_tpu.cli import parse_mesh
+            from tfmesos_tpu.parallel.mesh import build_mesh
+            mesh = build_mesh(parse_mesh(args.mesh))
         batcher = ContinuousBatcher(
             cfg, params, rows=args.batch, page_size=64, max_len=ml,
             temperature=args.temperature,
@@ -164,7 +176,7 @@ def main():
             quantized_cache=args.int8_kv,
             prefill_chunk=args.prefill_chunk,
             draft_cfg=draft_cfg, draft_params=draft_params,
-            n_draft=SPEC_N_DRAFT)
+            n_draft=SPEC_N_DRAFT, mesh=mesh)
         sink = open(args.out, "w") if args.out else sys.stdout
         served = 0
         t0 = time.perf_counter()

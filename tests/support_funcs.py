@@ -217,3 +217,43 @@ def train_chunk(ctx, params, k, lr, seed):
         y = jax.make_array_from_callback((bs,), data_sh, lambda idx: yb[idx])
         w, loss = step(w, x, y)
     return {"w": np.asarray(w).tolist(), "loss": float(loss)}
+
+
+def _cb_workload():
+    """The continuous-batching cross-process workload, shared by the
+    task-side entry point and the test's single-host reference."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tfmesos_tpu.models import transformer
+    from tfmesos_tpu.serving import Request
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=64, dtype=jnp.float32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(6))
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, 128, size=n).astype(np.int32)
+               for n in (3, 9, 6, 12)]
+    reqs = [Request(prompt=p, max_new_tokens=2 + (i % 3))
+            for i, p in enumerate(prompts)]
+    kw = dict(rows=2, max_len=32, page_size=8, prefill_bucket=8)
+    return cfg, params, reqs, kw
+
+
+def continuous_batching_mesh(ctx, axes):
+    """Multi-chip continuous batching across the cross-process mesh: every
+    process runs the identical admission loop, decode rides the dp x tp
+    sharded paged pool (shard-local page tables), and host-read tokens are
+    replicated — each process must yield the same completions."""
+    import jax
+    from tfmesos_tpu.parallel.mesh import build_mesh
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    cfg, params, reqs, kw = _cb_workload()
+    b = ContinuousBatcher(cfg, params, mesh=build_mesh(axes), **kw)
+    done = {c.rid: c.tokens for c in b.run(reqs)}
+    return {"process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+            "tokens": {str(k): [int(t) for t in v]
+                       for k, v in sorted(done.items())}}

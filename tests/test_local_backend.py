@@ -169,3 +169,38 @@ def test_mode_a_task_killed_mid_dispatch_raises_cluster_error():
     result = supervise(run_attempt, max_restarts=2, restart_wait=0.1)
     assert result.value == "recovered"
     assert result.attempts == 2
+
+
+def test_cross_process_continuous_batching():
+    """Multi-chip SERVING end to end (VERDICT r4 next #1): the
+    ContinuousBatcher admission loop running identically on 2 processes x
+    4 devices with decode sharded dp x tp over per-shard paged pools.
+    Both processes must yield identical token streams, equal to a
+    single-host no-mesh batcher's run in THIS process."""
+    import support_funcs
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    jobs = Job(name="worker", num=2, cpus=1.0, mem=1024.0)
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    with cluster(jobs, backend=LocalBackend(), quiet=True,
+                 start_timeout=180.0, env=env) as c:
+        rs = c.run_all("support_funcs:continuous_batching_mesh",
+                       {"dp": 2, "tp": 4})
+    assert len(rs) == 2
+    for r in rs:
+        assert r["process_count"] == 2 and r["device_count"] == 8, r
+    # Both processes run ONE global program — exact equality is required.
+    assert rs[0]["tokens"] == rs[1]["tokens"]
+    # vs the single-host no-mesh batcher, tp=4's partial-sum order can
+    # legitimately fork greedy argmax at float ties — use the
+    # tie-tolerant comparator, like the in-process mesh tests.
+    from test_serving import _assert_tokens_match_modulo_ties
+
+    cfg, params, reqs, kw = support_funcs._cb_workload()
+    plain = ContinuousBatcher(cfg, params, **kw)
+    want = {str(cc.rid): cc.tokens for cc in plain.run(reqs)}
+    assert rs[0]["tokens"].keys() == want.keys()
+    for rid, req in enumerate(reqs):
+        _assert_tokens_match_modulo_ties(
+            cfg, params, None, req.prompt, rs[0]["tokens"][str(rid)],
+            want[str(rid)])

@@ -830,6 +830,124 @@ def cache_specs(cfg: TransformerConfig, mesh: Mesh,
     return {"k": spec, "v": spec}
 
 
+def paged_cache_specs(cfg: TransformerConfig, mesh: Mesh,
+                      quantized: bool = False) -> Dict[str, Any]:
+    """PartitionSpecs for a PAGED pool ([L, P, KV, page, Dh]): the PAGE
+    axis over the data axes — each data shard owns a sub-pool that its
+    rows' page tables index with shard-LOCAL ids (serving's allocator
+    maintains that invariant) — and kv heads over tp.  Place the pool
+    (and params per ``partition_specs``) with these and jit
+    ``decode_step(..., sharded=True, mesh=mesh)``: the page
+    gather/scatter then runs per shard inside a shard_map island
+    (``_sharded_paged_step``) while everything around it stays plain
+    GSPMD einsums.  ``quantized=True`` mirrors an int8
+    ``init_paged_cache`` (lane-major scales share the values' spec)."""
+    from tfmesos_tpu.parallel.sharding import data_axes
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and (cfg.kv_heads % tp or cfg.n_heads % tp):
+        raise ValueError(
+            f"paged_cache_specs: tp ({tp}) must divide kv_heads "
+            f"({cfg.kv_heads}) and n_heads ({cfg.n_heads}) to shard the "
+            f"pool's head axis")
+    spec = _filter_spec(P(None, data_axes(mesh), "tp", None, None), mesh)
+    if quantized:
+        # Lane-major scales [L, P, KV, 1, page]: same sharded dims, and
+        # the trailing entries are already None.
+        spec = QTensor(values=spec, scales=spec)
+    return {"k": spec, "v": spec}
+
+
+def _check_sharded_paged(cfg: TransformerConfig, mesh: Optional[Mesh],
+                         batch: int, n_pages: int):
+    """Validate a sharded paged decode call; returns (data_axes_prod, tp)."""
+    if mesh is None:
+        raise ValueError(
+            "sharded paged decode needs the mesh: place the pool per "
+            "paged_cache_specs and pass decode_step(..., sharded=True, "
+            "mesh=mesh)")
+    real = {a for a, s in mesh.shape.items() if s > 1}
+    if not real <= {"dp", "fsdp", "tp"}:
+        raise ValueError(
+            f"sharded paged decode runs on data (dp/fsdp) x tp meshes; "
+            f"got axes {sorted(real)}")
+    nd = 1
+    for a in ("dp", "fsdp"):
+        nd *= mesh.shape.get(a, 1)
+    tp = mesh.shape.get("tp", 1)
+    if cfg.kv_heads % tp or cfg.n_heads % tp:
+        raise ValueError(
+            f"tp ({tp}) must divide kv_heads ({cfg.kv_heads}) and "
+            f"n_heads ({cfg.n_heads})")
+    if batch % nd:
+        raise ValueError(
+            f"batch ({batch}) must divide over the data axes ({nd})")
+    if n_pages % nd:
+        raise ValueError(
+            f"pool pages ({n_pages}) must divide over the data axes "
+            f"({nd}) — each shard owns an equal sub-pool")
+    return nd, tp
+
+
+def _sharded_paged_step(cfg: TransformerConfig, mesh: Mesh, q, k, v, ck,
+                        cv, pages, positions, attend: bool = True):
+    """Paged write + paged attention as ONE shard_map island over the
+    ``paged_cache_specs`` layout.  Each data shard owns a sub-pool whose
+    pages its rows' table entries index LOCALLY, so the gather/scatter
+    indirection never crosses shards; heads shard over tp with GQA
+    grouping preserved per shard (tp divides both head counts).  No
+    collective runs inside — the tp output reduction stays with GSPMD
+    at the surrounding wo matmul.  ``attend=False`` (prefill from an
+    empty cache: the chunk attends only to itself) writes the pages and
+    lets the caller compute self-attention as a plain partitionable
+    einsum."""
+    from tfmesos_tpu.parallel.sharding import data_axes
+
+    da = data_axes(mesh)
+    qkv = _filter_spec(P(da, None, "tp", None), mesh)
+    pool = _filter_spec(P(da, "tp", None, None), mesh)
+    if isinstance(ck, QTensor):
+        pool = QTensor(values=pool, scales=pool)
+    tbl = _filter_spec(P(da, None), mesh)
+
+    def write(ck, cv, k, v, pages, posv):
+        ck = _paged_cache_write(ck, k, pages, posv)
+        cv = _paged_cache_write(cv, v, pages, posv)
+        return ck, cv
+
+    if not attend:
+        def local(q, k, v, ck, cv, pages, positions):
+            ck, cv = write(ck, cv, k, v, pages, positions[:, 0])
+            return ck, cv
+
+        fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(qkv, qkv, qkv, pool, pool, tbl, tbl),
+                       out_specs=(pool, pool), check_vma=False)
+        ck, cv = fn(q, k, v, ck, cv, pages, positions)
+        return None, ck, cv
+
+    t = q.shape[1]
+    ps_ = (ck.values if isinstance(ck, QTensor) else ck).shape[2]
+    m = pages.shape[1] * ps_
+    kernel_kw = _decode_kernel_kwargs(cfg, m, t, False)
+
+    def local(q, k, v, ck, cv, pages, positions):
+        posv = positions[:, 0]
+        ck, cv = write(ck, cv, k, v, pages, posv)
+        from tfmesos_tpu.ops.attention import (_paged_decode_reference,
+                                               flash_decode_paged)
+        if kernel_kw is not None:
+            o = flash_decode_paged(q, ck, cv, pages, posv, **kernel_kw)
+        else:
+            o = _paged_decode_reference(q, ck, cv, pages, posv,
+                                        1.0 / math.sqrt(cfg.head_dim))
+        return o, ck, cv
+
+    fn = jax.shard_map(local, mesh=mesh,
+                   in_specs=(qkv, qkv, qkv, pool, pool, tbl, tbl),
+                   out_specs=(qkv, pool, pool), check_vma=False)
+    return fn(q, k, v, ck, cv, pages, positions)
+
+
 def _decode_kernel_kwargs(cfg: TransformerConfig, m: int, t: int,
                           sharded: bool, mesh: Optional[Mesh] = None,
                           batch: Optional[int] = None):
@@ -903,7 +1021,17 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     q = rope(q, pos_row, cfg.rope_theta)
     k = rope(k, pos_row, cfg.rope_theta)
     rolling = cfg.window is not None
-    if pages is not None:
+    self_attn_prefill = t > 1 and isinstance(pos, int) and pos == 0
+    o_paged = None
+    if pages is not None and sharded:
+        # Multi-chip serving: write + paged attention per shard (the page
+        # indirection cannot be GSPMD-partitioned; everything around it
+        # stays plain einsums).  Prefill-from-empty writes in the island
+        # and attends chunk-to-chunk outside it.
+        o_paged, ck, cv = _sharded_paged_step(
+            cfg, mesh, q, k, v, ck, cv, pages, positions,
+            attend=not self_attn_prefill)
+    elif pages is not None:
         ck = _paged_cache_write(ck, k, pages, pos)
         cv = _paged_cache_write(cv, v, pages, pos)
     else:
@@ -919,6 +1047,8 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
             o = mha_reference(q, k, v, causal=True, window=cfg.window)
         else:
             o = attend(q, k, v, mesh=None, causal=True, window=cfg.window)
+    elif o_paged is not None:
+        o = o_paged
     elif pages is not None:
         # Paged attention: pool-page indirection through the kernel's
         # scalar-prefetched index maps (TPU), or the gather-the-pages
@@ -1032,8 +1162,13 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
 
     pages = cache.get("pages")
     if pages is not None and sharded:
-        raise ValueError("paged caches are a single-host serving layout; "
-                         "use cache_specs GSPMD decode for multi-chip")
+        # Multi-chip paged serving: pool placed per paged_cache_specs
+        # (pages over the data axes with shard-local table ids, kv heads
+        # over tp); validated once here, executed per layer as a
+        # shard_map island (_sharded_paged_step).
+        n_pool = (cache["k"].values if isinstance(cache["k"], QTensor)
+                  else cache["k"]).shape[1]
+        _check_sharded_paged(cfg, mesh, b, n_pool)
 
     def body(carry, layer):
         lp, ck, cv = layer

@@ -325,6 +325,22 @@ class ContinuousBatcher:
     greedy outputs can differ from the unchunked batcher only by
     float-tie argmax flips.
 
+    ``mesh`` (optional) makes the WHOLE serving loop multi-chip: a
+    data (dp/fsdp) x tp ``jax.sharding.Mesh`` — possibly spanning
+    processes — over which every model call runs sharded.  Rows are
+    partitioned into contiguous blocks, one per data shard; each shard
+    owns an equal sub-pool of pages (target AND draft) that its rows'
+    tables index with shard-LOCAL ids, so the page gather/scatter stays
+    a per-shard shard_map island while the matmuls partition under
+    GSPMD (heads/ff over tp).  Admission stays host-global and
+    deterministic: on a multi-process mesh every process runs the same
+    loop and reads the same replicated token outputs.  Prefill, chunked
+    prefill, speculative rounds, prefix sharing, and int8 pools all
+    ride the same path; outputs are token-identical to the no-mesh
+    batcher (modulo float-tie argmax forks from tp partial-sum order).
+    ``rows`` must divide over the data axes, tp must divide both
+    models' head counts.
+
     ``prefix`` (1-D int32, optional) is a SHARED prompt prefix (system
     prompt), prefilled ONCE into reserved pool pages that every row's
     page table references read-only — the paged analogue of
@@ -345,12 +361,33 @@ class ContinuousBatcher:
                  prefill_chunk: Optional[int] = None,
                  draft_cfg: Optional[TransformerConfig] = None,
                  draft_params=None, n_draft: int = 4,
-                 draft_n_pages: Optional[int] = None):
+                 draft_n_pages: Optional[int] = None, mesh=None):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         self.cfg = cfg
         self.params = params
         self.rows = rows
+        self.mesh = mesh
+        self.n_shards = 1
+        self._tp = 1
+        if mesh is not None:
+            real = {a for a, s in mesh.shape.items() if s > 1}
+            if not real <= {"dp", "fsdp", "tp"}:
+                raise ValueError(
+                    f"ContinuousBatcher meshes are data (dp/fsdp) x tp; "
+                    f"got axes {sorted(real)}")
+            for a in ("dp", "fsdp"):
+                self.n_shards *= mesh.shape.get(a, 1)
+            self._tp = mesh.shape.get("tp", 1)
+            if rows % self.n_shards:
+                raise ValueError(
+                    f"rows ({rows}) must divide over the mesh data axes "
+                    f"({self.n_shards}) — each data shard serves an equal "
+                    f"row block")
+            if cfg.kv_heads % self._tp or cfg.n_heads % self._tp:
+                raise ValueError(
+                    f"tp ({self._tp}) must divide kv_heads "
+                    f"({cfg.kv_heads}) and n_heads ({cfg.n_heads})")
         self.max_len = int(max_len or cfg.max_seq_len)
         if self.max_len > cfg.max_seq_len:
             raise ValueError(f"max_len ({self.max_len}) exceeds the "
@@ -368,7 +405,12 @@ class ContinuousBatcher:
                        (int(prefix_np.size) // self.page_size)
                        * self.page_size)
         own_max = -(-(self.max_len - shared_full) // self.page_size)
-        self.n_pages = int(n_pages or rows * own_max + n_prefix_pages + 1)
+        # Default pool: per data shard, its row block's worst case plus
+        # the shard's own prefix + sink reservations (reservations are
+        # PER SHARD — every sub-pool carries the prefix and a sink).
+        per_shard = ((rows // self.n_shards) * own_max
+                     + n_prefix_pages + 1)
+        self.n_pages = int(n_pages or self.n_shards * per_shard)
         if prefill_chunk is not None:
             if prefill_chunk < 1 or prefill_chunk % 8:
                 raise ValueError(f"prefill_chunk ({prefill_chunk}) must be "
@@ -381,9 +423,14 @@ class ContinuousBatcher:
         self.top_p = top_p
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
         self.t_side = _PagedSide(self.n_pages, self.page_size, rows,
-                                 self.np_max)
+                                 self.np_max, n_shards=self.n_shards)
         self.t_side.pool = init_paged_cache(
             cfg, self.n_pages, self.page_size, quantized=quantized_cache)
+        if mesh is not None:
+            from tfmesos_tpu.models.transformer import partition_specs
+            self.params = self._place(params, partition_specs(cfg, mesh))
+        self._init_side_device_state(self.t_side, cfg,
+                                     quantized=quantized_cache)
         self.prefix_len = 0
         self._prefill_fns: Dict[int, Any] = {}
         self._decode = self._make_decode()
@@ -416,12 +463,23 @@ class ContinuousBatcher:
             # (max_len + n_draft + 1) worst-case buffer.  Parked free rows
             # write at position max_len through all-sink table rows (the
             # clamped block gather lands on the sink page).
+            if mesh is not None and (draft_cfg.kv_heads % self._tp
+                                     or draft_cfg.n_heads % self._tp):
+                raise ValueError(
+                    f"tp ({self._tp}) must divide the DRAFT's kv_heads "
+                    f"({draft_cfg.kv_heads}) and n_heads "
+                    f"({draft_cfg.n_heads}) too")
             self.n_draft_pages = int(draft_n_pages
-                                     or rows * own_max + n_prefix_pages + 1)
+                                     or self.n_shards * per_shard)
             self.d_side = _PagedSide(self.n_draft_pages, self.page_size,
-                                     rows, self.np_max)
+                                     rows, self.np_max,
+                                     n_shards=self.n_shards)
             self.d_side.pool = init_paged_cache(
                 draft_cfg, self.n_draft_pages, self.page_size)
+            if mesh is not None:
+                self.draft_params = self._place(
+                    draft_params, partition_specs(draft_cfg, mesh))
+            self._init_side_device_state(self.d_side, draft_cfg)
             self._spec_round = self._make_spec_round()
             self._draft_chunk = self._make_draft_chunk()
         self._next_rid = 0
@@ -451,6 +509,52 @@ class ContinuousBatcher:
     def _sink_page(self) -> int:
         return self.t_side.sink
 
+    def _place(self, tree, specs):
+        """Place ``tree`` onto the mesh per a PartitionSpec tree —
+        through ``place_tree`` so host-identical values assemble into
+        global arrays even when the mesh spans processes."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from tfmesos_tpu.parallel.sharding import place_tree
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda n: isinstance(n, P))
+        return place_tree(self.mesh, tree, shardings)
+
+    def _init_side_device_state(self, side: _PagedSide, cfg,
+                                quantized: bool = False) -> None:
+        """Mesh mode: place the side's pool per ``paged_cache_specs`` and
+        build its shard-aware copy-on-write page-copy fn (each shard
+        copies the — symmetrically reserved — template page onto its own
+        slot of a per-shard destination vector; shards not admitting the
+        row scribble their sink).  Single-host mode keeps the plain
+        module-level copy."""
+        if self.mesh is None:
+            side.copy = (lambda pool, src, dst:
+                         _copy_page(pool, int(src), int(dst[0])))
+            return
+        from jax.sharding import PartitionSpec as P
+        from tfmesos_tpu.models.transformer import paged_cache_specs
+        from tfmesos_tpu.parallel.sharding import data_axes
+        specs = paged_cache_specs(cfg, self.mesh, quantized=quantized)
+        side.pool = self._place(side.pool, specs)
+        mesh = self.mesh
+        da = data_axes(mesh)
+
+        @partial(jax.jit, donate_argnums=0)
+        def copy(pool, src, dst):
+            def local(pool, src, dst):
+                return jax.tree_util.tree_map(
+                    lambda buf: buf.at[:, dst[0]].set(buf[:, src[0]]),
+                    pool)
+            return jax.shard_map(local, mesh=mesh,
+                             in_specs=(specs, P(), P(da)),
+                             out_specs=specs, check_vma=False)(
+                pool, src, dst)
+
+        side.copy = (lambda pool, src, dst, _c=copy:
+                     _c(pool, jnp.asarray([src], jnp.int32),
+                        jnp.asarray(dst, jnp.int32)))
+
     def _init_prefix(self, prefix: np.ndarray) -> None:
         """Reserve pages for the shared prefix and prefill it once —
         into the target pool, and (speculative mode) into the draft's
@@ -470,20 +574,28 @@ class ContinuousBatcher:
         sides = [(self.t_side, self.cfg, self.params)]
         if self.d_side is not None:
             sides.append((self.d_side, self.draft_cfg, self.draft_params))
+        sharded = self.mesh is not None
         for side, cfg, params in sides:
             pages = [side.alloc.reserve_page() for _ in range(n_reserve)]
-            table = np.full((1, side.np_max), side.sink, np.int32)
-            table[0, :n_reserve] = pages
+            # One prefill row PER SHARD, all with the same tokens and the
+            # same (symmetric) local page ids: every shard's sub-pool gets
+            # its own copy of the prefix, which its rows then reference
+            # read-only.
+            table = np.full((self.n_shards, side.np_max), side.sink,
+                            np.int32)
+            table[:, :n_reserve] = pages
+            toks = np.tile(prefix[None], (self.n_shards, 1))
 
             @partial(jax.jit, donate_argnums=1)
             def prefill_prefix(params, pool, t, toks, cfg=cfg):
                 cache = dict(pool, pages=t)
-                _, cache = decode_step(cfg, params, cache, toks, 0)
+                _, cache = decode_step(cfg, params, cache, toks, 0,
+                                       sharded=sharded, mesh=self.mesh)
                 return {"k": cache["k"], "v": cache["v"]}
 
             side.pool = prefill_prefix(params, side.pool,
                                        jnp.asarray(table),
-                                       jnp.asarray(prefix[None]))
+                                       jnp.asarray(toks))
             if tail:
                 side.tail_template = pages[-1]
                 side.shared_pages = pages[:-1]
@@ -492,6 +604,17 @@ class ContinuousBatcher:
             side.shared_len = len(side.shared_pages) * self.page_size
 
     # -- compiled shapes --------------------------------------------------
+
+    def _host_read(self, x):
+        """Replicate a jit output the HOST loop reads (tokens, commit
+        counts): on a (possibly multi-process) mesh a sharded global
+        array is not fully addressable from every host, and the loop
+        must see identical values on every process."""
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P()))
 
     def _sample(self, last, rids, steps):
         """[n, V] logits -> [n] int32 tokens; sampling keys are folded
@@ -509,13 +632,16 @@ class ContinuousBatcher:
         return jax.vmap(one)(last, rids, steps)
 
     def _make_decode(self):
+        sharded = self.mesh is not None
+
         @partial(jax.jit, donate_argnums=1)
         def fn(params, pool, table, toks, positions, rids, steps):
             cache = dict(pool, pages=table)
             logits, cache = decode_step(self.cfg, params, cache,
-                                        toks[:, None], positions)
+                                        toks[:, None], positions,
+                                        sharded=sharded, mesh=self.mesh)
             nxt = self._sample(logits[:, -1], rids, steps)
-            return {"k": cache["k"], "v": cache["v"]}, nxt
+            return {"k": cache["k"], "v": cache["v"]}, self._host_read(nxt)
 
         return fn
 
@@ -537,6 +663,7 @@ class ContinuousBatcher:
         hence invariant to row packing."""
         k = self.n_draft
         T, tk_, tp_ = self.temperature, self.top_k, self.top_p
+        sharded = self.mesh is not None
         sampling = T > 0.0
         if sampling:
             from tfmesos_tpu.models.transformer import filter_logits
@@ -554,7 +681,8 @@ class ContinuousBatcher:
                 dc, dtok, dpos = carry
                 lg, dc = decode_step(self.draft_cfg, dparams,
                                      dict(dc, pages=dtable),
-                                     dtok[:, None], dpos)
+                                     dtok[:, None], dpos,
+                                     sharded=sharded, mesh=self.mesh)
                 dc = {"k": dc["k"], "v": dc["v"]}
                 if not sampling:
                     nxt = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
@@ -580,11 +708,13 @@ class ContinuousBatcher:
             chunk = jnp.concatenate([toks[:, None], drafts], axis=1)
             cache = dict(pool, pages=table)
             lg, cache = decode_step(self.cfg, params, cache, chunk,
-                                    positions)
+                                    positions, sharded=sharded,
+                                    mesh=self.mesh)
             pool_out = {"k": cache["k"], "v": cache["v"]}
             if not sampling:
                 g = jnp.argmax(lg, -1).astype(jnp.int32)    # [rows, k+1]
-                return pool_out, dpool, g, greedy_accept_counts(drafts, g)
+                return (pool_out, dpool, self._host_read(g),
+                        self._host_read(greedy_accept_counts(drafts, g)))
 
             pd = jnp.moveaxis(pd, 0, 1)[:, :k]              # [rows, k, V]
             pt = jax.nn.softmax(filter_logits(lg, T, tk_, tp_), -1)
@@ -605,7 +735,8 @@ class ContinuousBatcher:
             cand = jnp.concatenate(
                 [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
             vals = jnp.where(j == a[:, None], repl[:, None], cand)
-            return pool_out, dpool, vals, a + 1
+            return (pool_out, dpool, self._host_read(vals),
+                    self._host_read(a + 1))
 
         return fn
 
@@ -613,37 +744,64 @@ class ContinuousBatcher:
         """Jitted DRAFT prompt writer over the draft's paged pool: serves
         both the whole-prompt prefill (offset prefix_len — the prefix
         pages are shared, so only the prompt is written) and chunked
-        prefill's per-chunk advance.  The caller passes the row's own
-        1-row page table; one compile per chunk width."""
+        prefill's per-chunk advance.  The caller passes a one-hot
+        n_shards-row batch (``_one_hot_call``); one compile per chunk
+        width."""
+        sharded = self.mesh is not None
+
         @partial(jax.jit, donate_argnums=1)
         def fn(dparams, dpool, t, chunk, pos):
             cache = dict(dpool, pages=t)
             _, cache = decode_step(self.draft_cfg, dparams, cache, chunk,
-                                   pos)
+                                   pos, sharded=sharded, mesh=self.mesh)
             return {"k": cache["k"], "v": cache["v"]}
 
         return fn
+
+    def _one_hot_call(self, side: _PagedSide, row: int, chunk: np.ndarray):
+        """(shard, [nd, w] tokens, [nd, np] table) for a per-row model
+        call batched one row per mesh data shard: the admitted row's
+        tokens and table ride its shard's slot; every other shard's slot
+        is an all-sink dummy whose writes land on that shard's sink page
+        (and whose sampled token is discarded).  With one shard this is
+        exactly the old single-row call."""
+        nd = self.n_shards
+        s = side.alloc.shard_of(row)
+        table = np.full((nd, side.np_max), side.sink, np.int32)
+        table[s] = side.table_np()[row]
+        toks = np.zeros((nd, chunk.shape[1]), np.int32)
+        toks[s] = chunk[0]
+        return s, jnp.asarray(toks), jnp.asarray(table)
 
     def _make_chunk_prefill(self):
         """Jitted one-chunk prefill: writes chunk tokens at a TRACED
         offset (so one compile serves every chunk of every request) and
         samples the first token when this chunk contains the prompt's
-        last position (cap_idx in range; callers ignore it otherwise)."""
+        last position (cap_idx in range; callers ignore it otherwise).
+        Batched one row per mesh data shard (``_one_hot_call``); returns
+        the [nd] sampled-token vector, the caller indexes its shard."""
+        sharded = self.mesh is not None
+
         @partial(jax.jit, donate_argnums=1)
         def fn(params, pool, table, chunk, pos, cap_idx, rid):
             cache = dict(pool, pages=table)
-            logits, cache = decode_step(self.cfg, params, cache, chunk, pos)
+            logits, cache = decode_step(self.cfg, params, cache, chunk,
+                                        pos, sharded=sharded,
+                                        mesh=self.mesh)
             cap = jnp.clip(cap_idx, 0, chunk.shape[1] - 1)
             last = jnp.take_along_axis(
                 logits, cap[:, None, None], axis=1)[:, 0]
             nxt = self._sample(last, rid, jnp.zeros_like(rid))
-            return {"k": cache["k"], "v": cache["v"]}, nxt[0]
+            return {"k": cache["k"], "v": cache["v"]}, self._host_read(nxt)
 
         return fn
 
     def _prefill_fn(self, width: int):
-        """Jitted single-row prefill at one padded-width bucket."""
+        """Jitted prefill at one padded-width bucket, batched one row per
+        mesh data shard (``_one_hot_call``)."""
         if width not in self._prefill_fns:
+            sharded = self.mesh is not None
+
             @partial(jax.jit, donate_argnums=1)
             def fn(params, pool, table, prompt, length, rid):
                 cache = dict(pool, pages=table)
@@ -652,11 +810,13 @@ class ContinuousBatcher:
                 # writes all follow (token tt of the chunk sees cache
                 # positions <= prefix_len + tt).
                 logits, cache = decode_step(self.cfg, params, cache, prompt,
-                                            self.prefix_len)
+                                            self.prefix_len,
+                                            sharded=sharded, mesh=self.mesh)
                 last = jnp.take_along_axis(
                     logits, (length - 1)[:, None, None], axis=1)[:, 0]
                 nxt = self._sample(last, rid, jnp.zeros_like(rid))
-                return {"k": cache["k"], "v": cache["v"]}, nxt[0]
+                return {"k": cache["k"], "v": cache["v"]}, \
+                    self._host_read(nxt)
 
             self._prefill_fns[width] = fn
         return self._prefill_fns[width]
@@ -815,8 +975,9 @@ class ContinuousBatcher:
             side.ensure(row, length)
             if (side.tail_template is not None and fresh
                     and side.alloc.allocated(row)):
-                side.pool = _copy_page(side.pool, side.tail_template,
-                                       side.alloc.rows[row][0])
+                dst = np.full((self.n_shards,), side.sink, np.int32)
+                dst[side.alloc.shard_of(row)] = side.alloc.rows[row][0]
+                side.pool = side.copy(side.pool, side.tail_template, dst)
 
     def _admit(self, row: int, rid: int, req: Request, wt: int, wd: int,
                active: Dict[int, _Row]) -> Optional[Completion]:
@@ -838,16 +999,20 @@ class ContinuousBatcher:
                          filled=0, decoding=False)
             active[row] = state
             return None
+        s, toks, table = self._one_hot_call(self.t_side, row, padded)
+        lengths = np.ones((self.n_shards,), np.int32)
+        lengths[s] = length
+        rids = np.zeros((self.n_shards,), np.int32)
+        rids[s] = rid
         self.pool, tok = self._prefill_fn(width)(
-            self.params, self.pool, self.t_side.table()[row:row + 1],
-            jnp.asarray(padded), jnp.asarray([length], jnp.int32),
-            jnp.asarray([rid], jnp.int32))
+            self.params, self.pool, table, toks,
+            jnp.asarray(lengths), jnp.asarray(rids))
         if self.d_side is not None:
+            _, dtoks, dtable = self._one_hot_call(self.d_side, row, padded)
             self.d_side.pool = self._draft_chunk(
-                self.draft_params, self.d_side.pool,
-                self.d_side.table()[row:row + 1], jnp.asarray(padded),
+                self.draft_params, self.d_side.pool, dtable, dtoks,
                 jnp.asarray(self.prefix_len, jnp.int32))
-        tok = int(tok)                  # host sync: first token is real
+        tok = int(np.asarray(tok)[s])   # host sync: first token is real
         now = time.perf_counter()
         state = _Row(rid=rid, req=req, pos=self.prefix_len + length, step=1,
                      last=tok, out=[tok], worst_pages=wt, worst_draft=wd,
@@ -872,23 +1037,26 @@ class ContinuousBatcher:
         chunk = row.padded[:, row.filled:row.filled + c]
         length = row.req.prompt.size
         cap = length - 1 - row.filled       # in-range only on last chunk
+        s, ctoks, table = self._one_hot_call(self.t_side, r, chunk)
+        caps = np.full((self.n_shards,), -1, np.int32)
+        caps[s] = cap
+        rids = np.zeros((self.n_shards,), np.int32)
+        rids[s] = row.rid
         self.pool, tok = self._chunk_prefill(
-            self.params, self.pool, self.t_side.table()[r:r + 1],
-            jnp.asarray(chunk),
+            self.params, self.pool, table, ctoks,
             jnp.asarray(self.prefix_len + row.filled, jnp.int32),
-            jnp.asarray([cap], jnp.int32),
-            jnp.asarray([row.rid], jnp.int32))
+            jnp.asarray(caps), jnp.asarray(rids))
         if self.d_side is not None:
             # The draft's prompt chunks advance in lockstep so it is
             # ready to propose the moment the row flips to decoding.
+            _, dtoks, dtable = self._one_hot_call(self.d_side, r, chunk)
             self.d_side.pool = self._draft_chunk(
-                self.draft_params, self.d_side.pool,
-                self.d_side.table()[r:r + 1], jnp.asarray(chunk),
+                self.draft_params, self.d_side.pool, dtable, dtoks,
                 jnp.asarray(self.prefix_len + row.filled, jnp.int32))
         row.filled += c
         if row.filled < row.padded.shape[1]:
             return None
-        tok = int(tok)                      # the capture chunk's sample
+        tok = int(np.asarray(tok)[s])       # the capture chunk's sample
         row.t_first = time.perf_counter()
         row.last = tok
         row.out.append(tok)
