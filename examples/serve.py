@@ -59,6 +59,11 @@ def main():
                         "prompts in chunks of this many tokens, "
                         "interleaved with decode steps — bounds the "
                         "stall a long prompt imposes on decoding rows")
+    p.add_argument("--overlap", action="store_true",
+                   help="double-buffered decode (with --continuous): "
+                        "dispatch tick t+1 before syncing tick t's "
+                        "tokens — hides per-token host round-trips; "
+                        "token streams identical to non-overlap")
     p.add_argument("--mesh", type=str, default=None,
                    help="multi-chip continuous serving (with "
                         "--continuous): comma-separated mesh axes, e.g. "
@@ -69,6 +74,12 @@ def main():
     args = p.parse_args()
     if args.mesh is not None and not args.continuous:
         p.error("--mesh is a continuous-batching feature; add --continuous")
+    if args.overlap and not args.continuous:
+        p.error("--overlap is a continuous-batching feature; "
+                "add --continuous")
+    if args.overlap and args.speculative:
+        p.error("--overlap does not compose with --speculative "
+                "(speculative commit counts are decided on device)")
     if args.paged and args.continuous:
         p.error("--paged and --continuous are distinct serving modes: "
                 "--continuous already serves from a paged pool (pick one)")
@@ -144,8 +155,11 @@ def main():
         # -1 in spec mode: the draft's backfill step writes one past the
         # proposals (ContinuousBatcher's depth check).
         ml = cfg.max_seq_len - (nd + 1 if nd else 0)
-        climit = min((ml - nd) // bucket * bucket,
-                     ml - nd - args.new_tokens + 1)
+        # Overlap + stop: a stop surfaces one tick late, so admission
+        # reserves one extra cache position past the stop.
+        ov = 1 if args.overlap and args.stop_token is not None else 0
+        climit = min((ml - nd - ov) // bucket * bucket,
+                     ml - nd - ov - args.new_tokens + 1)
         if any(len(t) > climit for t in prompts):
             print(f"serve: a prompt exceeds the continuous-serving limit "
                   f"({climit} tokens at new-tokens={args.new_tokens}"
@@ -176,7 +190,7 @@ def main():
             quantized_cache=args.int8_kv,
             prefill_chunk=args.prefill_chunk,
             draft_cfg=draft_cfg, draft_params=draft_params,
-            n_draft=SPEC_N_DRAFT, mesh=mesh)
+            n_draft=SPEC_N_DRAFT, mesh=mesh, overlap=args.overlap)
         sink = open(args.out, "w") if args.out else sys.stdout
         served = 0
         t0 = time.perf_counter()

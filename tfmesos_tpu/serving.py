@@ -196,7 +196,7 @@ class _PagedSide:
         self.peak = 0                      # observability: high-water mark
         self._cache = None        # device table; rebuilt when dirty
         self._cache_np = None     # host master copy of the table
-        self._masked = None       # (filling_rows, device table)
+        self._masked = None       # (masked_rows, device table)
 
     def ensure(self, row: int, length: int) -> None:
         """Back ABSOLUTE positions [0, length): the shared prefix pages
@@ -255,20 +255,20 @@ class _PagedSide:
     def decode_table(self, active: Dict[int, _Row],
                      decoding: Dict[int, _Row]) -> jnp.ndarray:
         """The batched step's device table: the plain cached table when
-        every active row decodes; otherwise a masked variant with
-        still-filling rows' entries pinned to the sink (their chunked
-        prefill owns their pages), cached until the allocation OR the
-        filling set changes — steady-state admission must not re-upload
-        the table every token."""
+        every active row participates; otherwise a masked variant with
+        non-participating rows' entries pinned to the sink (still-filling
+        rows' chunked prefill owns their pages; overlap mode's
+        quota-finished rows await retire), cached until the allocation OR
+        the masked set changes — steady-state admission must not
+        re-upload the table every token."""
         if len(decoding) == len(active):
             return self.table()
-        filling = frozenset(r for r, row in active.items()
-                            if not row.decoding)
-        if self._masked is None or self._masked[0] != filling:
+        masked = frozenset(r for r in active if r not in decoding)
+        if self._masked is None or self._masked[0] != masked:
             t = self.table_np().copy()
-            for r in filling:
+            for r in masked:
                 t[r, :] = self.sink
-            self._masked = (filling, jnp.asarray(t))
+            self._masked = (masked, jnp.asarray(t))
         return self._masked[1]
 
 
@@ -325,6 +325,16 @@ class ContinuousBatcher:
     greedy outputs can differ from the unchunked batcher only by
     float-tie argmax flips.
 
+    ``overlap=True`` double-buffers the decode loop: tick t+1 is
+    dispatched BEFORE tick t's tokens are synced to the host (rows feed
+    the previous dispatch's device output straight back in), so the
+    device never idles on a per-token host round-trip — the dominant
+    serving cost when dispatch latency is high.  Stop tokens and
+    admission act one tick late (a stopped row's extra tick writes one
+    reserved position past the stop and is discarded); token streams
+    are identical to ``overlap=False``.  Not composable with
+    speculative decoding (commit counts are decided on device).
+
     ``mesh`` (optional) makes the WHOLE serving loop multi-chip: a
     data (dp/fsdp) x tp ``jax.sharding.Mesh`` — possibly spanning
     processes — over which every model call runs sharded.  Rows are
@@ -361,9 +371,19 @@ class ContinuousBatcher:
                  prefill_chunk: Optional[int] = None,
                  draft_cfg: Optional[TransformerConfig] = None,
                  draft_params=None, n_draft: int = 4,
-                 draft_n_pages: Optional[int] = None, mesh=None):
+                 draft_n_pages: Optional[int] = None, mesh=None,
+                 overlap: bool = False):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
+        if overlap and draft_cfg is not None:
+            raise ValueError(
+                "overlap=True does not compose with speculative decoding "
+                "yet: a speculative tick's commit count (and therefore "
+                "every row's next position) is decided on device, so the "
+                "host cannot pre-build tick t+1's tables without syncing "
+                "tick t")
+        self.overlap = bool(overlap)
+        self._inflight = None   # overlap mode: (device nxt, {row: rid})
         self.cfg = cfg
         self.params = params
         self.rows = rows
@@ -634,6 +654,26 @@ class ContinuousBatcher:
     def _make_decode(self):
         sharded = self.mesh is not None
 
+        if self.overlap:
+            # Double-buffered tick: rows that were in the PREVIOUS
+            # dispatch take their input token straight from its device
+            # output (``prev``) — the host never waits on it — while
+            # freshly (re)admitted rows take the host-supplied token.
+            @partial(jax.jit, donate_argnums=1)
+            def fn(params, pool, table, toks, prev, use_dev, positions,
+                   rids, steps):
+                merged = jnp.where(use_dev, prev, toks)
+                cache = dict(pool, pages=table)
+                logits, cache = decode_step(self.cfg, params, cache,
+                                            merged[:, None], positions,
+                                            sharded=sharded,
+                                            mesh=self.mesh)
+                nxt = self._sample(logits[:, -1], rids, steps)
+                return ({"k": cache["k"], "v": cache["v"]},
+                        self._host_read(nxt))
+
+            return fn
+
         @partial(jax.jit, donate_argnums=1)
         def fn(params, pool, table, toks, positions, rids, steps):
             cache = dict(pool, pages=table)
@@ -835,6 +875,11 @@ class ContinuousBatcher:
             # (k+1)-token chunk: its writes overshoot by up to n_draft
             # (and the draft's k+1 scan steps write the same positions).
             need_len += self.n_draft
+        if self.overlap and req.stop_token is not None:
+            # A stop is detected one tick late: the already-dispatched
+            # extra tick writes one position past the stop (quota
+            # endings are host-predicted and never overshoot).
+            need_len += 1
         if need_len > self.max_len:
             raise ValueError(
                 f"request needs {need_len} cache positions (prefix "
@@ -954,11 +999,14 @@ class ContinuousBatcher:
                 if any(row.decoding for row in active.values()):
                     if self.draft_cfg is not None:
                         yield from self._step_spec(active, free_rows)
+                    elif self.overlap:
+                        yield from self._step_overlap(active, free_rows)
                     else:
                         yield from self._step(active, free_rows)
         finally:
             # A consumer that stops early (break / close) must not leak
-            # the in-flight rows' pages.
+            # the in-flight rows' pages (or a stale overlap dispatch).
+            self._inflight = None
             for row in list(active):
                 self._finish(row, active, free_rows)
 
@@ -1095,6 +1143,81 @@ class ContinuousBatcher:
             row.last = tok
             if tok == row.req.stop_token or row.step >= \
                     row.req.max_new_tokens:
+                done = self._completion(row)
+                self._finish(r, active, free_rows)
+                yield done
+
+    def _step_overlap(self, active: Dict[int, _Row],
+                      free_rows: List[int]) -> Iterator[Completion]:
+        """One OVERLAP tick: dispatch the next batched decode step
+        without waiting for the previous one — rows in the previous
+        dispatch feed its device output straight back in (``use_dev``),
+        so the device never idles on a host round-trip — then retire the
+        previous dispatch (host bookkeeping one tick late).
+
+        Deterministic state (pos, step) advances at dispatch;
+        token-dependent state (out, last, stop detection) at retire.  A
+        stop token therefore surfaces one tick late: the extra dispatched
+        tick writes one position past the stop into the row's own pages
+        (reserved by ``_worst_pages``'s +1) and its output is discarded
+        by the rid-checked ticket.  Quota endings are host-predicted and
+        never overshoot.  Token streams are IDENTICAL to the
+        non-overlapping batcher's — same ops, same inputs, only the sync
+        point moves."""
+        dispatch = {r: row for r, row in active.items()
+                    if row.decoding and row.step < row.req.max_new_tokens}
+        prev = self._inflight
+        if dispatch:
+            toks = np.zeros((self.rows,), np.int32)
+            use_dev = np.zeros((self.rows,), bool)
+            positions = np.zeros((self.rows,), np.int32)
+            rids = np.zeros((self.rows,), np.int32)
+            steps = np.zeros((self.rows,), np.int32)
+            prev_ticket = {} if prev is None else prev[1]
+            for r, row in dispatch.items():
+                self._ensure_sides(r, min(row.pos + 1, self.max_len))
+                if prev_ticket.get(r) == row.rid:
+                    use_dev[r] = True   # token = previous tick's output
+                else:
+                    toks[r] = row.last  # fresh admission / chunk flip
+                positions[r] = row.pos
+                rids[r] = row.rid
+                steps[r] = row.step
+            table = self.t_side.decode_table(active, dispatch)
+            prev_nxt = (prev[0] if prev is not None
+                        else jnp.zeros((self.rows,), jnp.int32))
+            self.pool, nxt = self._decode(
+                self.params, self.pool, table, jnp.asarray(toks),
+                prev_nxt, jnp.asarray(use_dev), jnp.asarray(positions),
+                jnp.asarray(rids), jnp.asarray(steps))
+            self._inflight = (nxt,
+                              {r: row.rid for r, row in dispatch.items()})
+            for row in dispatch.values():
+                row.pos += 1
+                row.step += 1
+        else:
+            self._inflight = None
+        if prev is not None:
+            yield from self._retire(prev, active, free_rows)
+
+    def _retire(self, inflight, active: Dict[int, _Row],
+                free_rows: List[int]) -> Iterator[Completion]:
+        """Sync ONE overlap dispatch (a tick behind the newest) and do
+        its token-dependent bookkeeping.  Tickets carry the rid each row
+        was dispatched under: a row that stopped at the previous retire
+        (or was re-admitted since) fails the rid check and its garbage
+        output is dropped."""
+        nxt, ticket = inflight
+        nxt = np.asarray(nxt)       # host sync: one tick behind dispatch
+        for r, rid in ticket.items():
+            row = active.get(r)
+            if row is None or row.rid != rid:
+                continue            # overshoot tick of a finished row
+            tok = int(nxt[r])
+            row.out.append(tok)
+            row.last = tok
+            if (tok == row.req.stop_token
+                    or len(row.out) >= row.req.max_new_tokens):
                 done = self._completion(row)
                 self._finish(r, active, free_rows)
                 yield done
