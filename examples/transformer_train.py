@@ -89,12 +89,18 @@ def main():
     # forward-only paths (eval/generation) keep gpipe.
     fwd_schedule = "gpipe" if args.pp_schedule == "1f1b" \
         else args.pp_schedule
+    # 1F1B differentiates INSIDE the stage shard_map, which the dense
+    # top-k MoE supports (in-body-AD f/g collectives); switch dispatch
+    # stays with the outer-AD schedules.  On a pp-less mesh the 1F1B
+    # step never runs (see grads_fn guard below), so switch stays.
+    moe_impl = ("dense" if args.pp_schedule == "1f1b"
+                and mesh.shape.get("pp", 1) > 1 else "switch")
     if args.tiny:
         cfg = transformer.TransformerConfig(
             vocab_size=256, d_model=64, n_layers=2,
             n_heads=max(4, 2 * mesh.shape.get("tp", 1)), d_ff=128,
             max_seq_len=args.seq_len, dtype=jnp.float32,
-            n_experts=args.moe, top_k=args.top_k, moe_impl="switch",
+            n_experts=args.moe, top_k=args.top_k, moe_impl=moe_impl,
             pp_schedule=fwd_schedule, n_kv_heads=args.kv_heads,
             pp_virtual_stages=args.virtual_stages, sp_impl=args.sp_impl)
         seq_len = min(args.seq_len, 64 * max(1, mesh.shape.get("sp", 1)))
@@ -102,7 +108,7 @@ def main():
         cfg = transformer.TransformerConfig(
             vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
             max_seq_len=args.seq_len, n_experts=args.moe,
-            top_k=args.top_k, moe_impl="switch",
+            top_k=args.top_k, moe_impl=moe_impl,
             pp_schedule=fwd_schedule, n_kv_heads=args.kv_heads,
             pp_virtual_stages=args.virtual_stages, sp_impl=args.sp_impl)
         seq_len = args.seq_len

@@ -461,16 +461,63 @@ def test_transformer_train_step_1f1b_validation():
         max_seq_len=16, dtype=jnp.float32)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     batch = {"tokens": jnp.zeros((4, 17), jnp.int32)}
-    with pytest.raises(ValueError, match="pp x tp x dp/fsdp"):
+    with pytest.raises(ValueError, match="pp x tp x ep x dp/fsdp"):
         transformer.train_step_1f1b(cfg, params, batch,
                                     build_mesh({"pp": 4, "sp": 2}))
-    moe = transformer.TransformerConfig(
+    switch = transformer.TransformerConfig(
         vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
-        max_seq_len=16, dtype=jnp.float32, n_experts=2, top_k=1)
-    with pytest.raises(ValueError, match="router aux"):
+        max_seq_len=16, dtype=jnp.float32, n_experts=2, top_k=1,
+        moe_impl="switch")
+    with pytest.raises(ValueError, match="dense top-k"):
         transformer.train_step_1f1b(
-            moe, transformer.init_params(moe, jax.random.PRNGKey(1)),
+            switch, transformer.init_params(switch, jax.random.PRNGKey(1)),
             batch, build_mesh({"pp": 4, "dp": 2}))
+    with pytest.raises(ValueError, match="needs n_experts"):
+        transformer.train_step_1f1b(cfg, params, batch,
+                                    build_mesh({"pp": 4, "ep": 2}))
+
+
+@pytest.mark.parametrize("axes,n_experts,top_k,shared", [
+    ({"pp": 2, "ep": 2, "dp": 2}, 2, 1, 0),
+    ({"pp": 2, "ep": 2, "dp": 2}, 4, 2, 1),
+    ({"pp": 2, "tp": 2, "ep": 2}, 4, 2, 1),
+])
+def test_transformer_train_step_1f1b_moe_matches_gpipe(axes, n_experts,
+                                                       top_k, shared):
+    """1F1B x MoE (VERDICT r4 next #4): router aux losses ride the tick
+    loop as per-stage scalar aux terms seeded alongside the loss vjp
+    (with the in-body-AD f/g collectives over ep and tp), so loss and
+    EVERY gradient — router included — match jax.grad of loss_fn on the
+    SAME mesh (the gpipe schedule, whose per-microbatch aux estimator
+    1F1B reproduces exactly)."""
+    from tfmesos_tpu.models import transformer
+
+    mesh = build_mesh(axes)
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq_len=16, dtype=jnp.float32, n_experts=n_experts,
+        top_k=top_k, n_shared_experts=shared)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(3))
+    b = 4 * axes.get("dp", 1)
+    tokens = np.random.RandomState(2).randint(
+        0, cfg.vocab_size, size=(b, 17)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    got_l, got_g = jax.jit(lambda p, bt: transformer.train_step_1f1b(
+        cfg, p, bt, mesh))(params, batch)
+    ref_l, ref_g = jax.value_and_grad(
+        lambda p: transformer.loss_fn(cfg, p, batch, mesh)[0])(params)
+    np.testing.assert_allclose(float(got_l), float(ref_l), rtol=1e-5)
+    assert float(jnp.sum(jnp.abs(got_g["layers"]["router"]))) > 0, \
+        "router got no gradient through the 1F1B aux seed"
+    for key, a, b_ in zip(
+            [jax.tree_util.keystr(k) for k, _ in
+             jax.tree_util.tree_flatten_with_path(got_g)[0]],
+            jax.tree_util.tree_leaves(got_g),
+            jax.tree_util.tree_leaves(ref_g)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            rtol=2e-4, atol=1e-5, err_msg=key)
 
 
 def test_pipeline_single_stage_shortcut():

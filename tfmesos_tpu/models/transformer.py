@@ -263,7 +263,7 @@ def _moe_switch(cfg: TransformerConfig, mesh, lp, h):
 
 
 def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None,
-         tp_axis: Optional[str] = None):
+         tp_axis: Optional[str] = None, inbody_ad: bool = False):
     """Top-k routed MoE, computed densely over the expert axis.
 
     Every expert processes every token and the router mask zeroes the
@@ -281,7 +281,15 @@ def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None,
     column-sharded [e_loc, d, f/tp], e_down row-sharded [e_loc, f/tp, d]);
     the e_down contraction then yields a partial sum and the same psum
     covers both axes.
-    """
+
+    ``inbody_ad=True`` (the 1F1B train step, which runs ``jax.vjp``
+    INSIDE the stage's shard_map) swaps the collectives for the Megatron
+    f/g pair: the per-shard-divergent compute (expert einsums and the
+    sliced mask) sits between a ``broadcast_replicated_grad`` fan-in and
+    a ``psum_replicated_grad`` reduction, so the transposes sum partial
+    cotangents exactly once; the router logits and aux losses stay in
+    the replicated domain OUTSIDE the fan, where every shard computes
+    identical values and identical gradients."""
     e = cfg.n_experts
     logits = (h @ lp["router"].astype(cfg.dtype)).astype(jnp.float32)  # [B,T,E]
     top_vals, top_idx = jax.lax.top_k(logits, cfg.top_k)
@@ -289,18 +297,28 @@ def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None,
     # mask[b,t,e] = gate weight if e is among the top-k for (b,t), else 0
     onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
     mask = (onehot * gates[..., None]).sum(axis=-2)
+    psum_axes = tuple(a for a in (ep_axis, tp_axis) if a is not None)
+    if inbody_ad and psum_axes:
+        from tfmesos_tpu.parallel.collectives import (
+            broadcast_replicated_grad, psum_replicated_grad)
+        fan = lambda v: broadcast_replicated_grad(v, psum_axes)
+        red = lambda v: psum_replicated_grad(v, psum_axes)
+    else:
+        fan = lambda v: v
+        red = ((lambda v: jax.lax.psum(v, psum_axes)) if psum_axes
+               else (lambda v: v))
+    h_l = fan(h)
+    mask = fan(mask)
     if ep_axis is not None:
         eg = lp["e_gate"]
         e_loc = (eg.values if isinstance(eg, QTensor) else eg).shape[0]
         idx = jax.lax.axis_index(ep_axis)
         mask = jax.lax.dynamic_slice_in_dim(mask, idx * e_loc, e_loc, axis=-1)
-    g = jax.nn.silu(jnp.einsum("btd,edf->btef", h, _wt(lp["e_gate"], cfg.dtype)))
-    u = jnp.einsum("btd,edf->btef", h, _wt(lp["e_up"], cfg.dtype))
+    g = jax.nn.silu(jnp.einsum("btd,edf->btef", h_l,
+                               _wt(lp["e_gate"], cfg.dtype)))
+    u = jnp.einsum("btd,edf->btef", h_l, _wt(lp["e_up"], cfg.dtype))
     y = jnp.einsum("btef,efd->bted", g * u, _wt(lp["e_down"], cfg.dtype))
-    out = jnp.einsum("bted,bte->btd", y, mask.astype(cfg.dtype))
-    psum_axes = tuple(a for a in (ep_axis, tp_axis) if a is not None)
-    if psum_axes:
-        out = jax.lax.psum(out, psum_axes)
+    out = red(jnp.einsum("bted,bte->btd", y, mask.astype(cfg.dtype)))
     probs = jax.nn.softmax(logits, axis=-1)
     f = jnp.sum(onehot, axis=(0, 1, 2)) / (onehot.shape[0] * onehot.shape[1]
                                            * cfg.top_k)
@@ -314,18 +332,25 @@ def _moe(cfg: TransformerConfig, lp, h, ep_axis: Optional[str] = None,
 
 
 def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None,
-         tp_axis: Optional[str] = None):
+         tp_axis: Optional[str] = None, inbody_ad: bool = False):
     """The block's feed-forward dispatch (dense / switch / dense-MoE) —
     shared by the train and decode paths so they cannot drift.
 
     ``ep_axis``/``tp_axis`` select the manual-collective MoE forms for use
     inside a pipeline stage's shard_map body (tokens replicated over
     ep/tp, expert weights ep-sharded and/or width-sharded over tp,
-    outputs psum'd)."""
+    outputs psum'd).  ``inbody_ad=True`` (1F1B) swaps the collectives for
+    the transpose-carrying f/g pair — dense top-k MoE only (the switch
+    dispatch path still assumes outer differentiation)."""
     if not cfg.n_experts:
         return _mlp(cfg, lp, h), _zero_aux()
     if ep_axis is not None or tp_axis is not None:
         if cfg.moe_impl == "switch":
+            if inbody_ad:
+                raise ValueError(
+                    "moe_impl='switch' does not support in-body AD (1F1B);"
+                    " use the dense top-k MoE or pp_schedule="
+                    "'gpipe'/'circular'")
             from tfmesos_tpu.parallel.moe import switch_moe_replicated_local
             b, t, d = h.shape
             out, aux = switch_moe_replicated_local(
@@ -335,7 +360,8 @@ def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None,
                 tp_axis=tp_axis)
             out = out.reshape(b, t, d)
         else:
-            out, aux = _moe(cfg, lp, h, ep_axis=ep_axis, tp_axis=tp_axis)
+            out, aux = _moe(cfg, lp, h, ep_axis=ep_axis, tp_axis=tp_axis,
+                            inbody_ad=inbody_ad)
     elif cfg.moe_impl == "switch":
         # Same model function with or without a mesh (switch_moe falls back
         # to its single-device reference when the ep axis is absent).
@@ -345,12 +371,20 @@ def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None,
     if cfg.n_shared_experts:
         # Always-on shared expert(s): dense FFN added to the routed output.
         # The shared weights replicate over ep; under manual tp their width
-        # shards like the dense MLP's, so the partial needs its own psum.
-        shared = swiglu(h, _wt(lp["s_gate"], cfg.dtype),
+        # shards like the dense MLP's, so the partial needs its own psum
+        # (the f/g pair under in-body AD, fanning h over tp alone — the
+        # shared compute is replicated over ep).
+        h_s = h
+        if inbody_ad and tp_axis is not None:
+            from tfmesos_tpu.parallel.collectives import (
+                broadcast_replicated_grad, psum_replicated_grad)
+            h_s = broadcast_replicated_grad(h, tp_axis)
+        shared = swiglu(h_s, _wt(lp["s_gate"], cfg.dtype),
                         _wt(lp["s_up"], cfg.dtype),
                         _wt(lp["s_down"], cfg.dtype))
         if tp_axis is not None:
-            shared = jax.lax.psum(shared, tp_axis)
+            shared = (psum_replicated_grad(shared, tp_axis) if inbody_ad
+                      else jax.lax.psum(shared, tp_axis))
         out = out + shared
     return out, aux
 
@@ -370,6 +404,38 @@ def _dense_tp_attn_partition() -> Dict[str, P]:
 def _dense_tp_mlp_partition() -> Dict[str, P]:
     return {"w_gate": P(None, None, "tp"), "w_up": P(None, None, "tp"),
             "w_down": P(None, "tp", None)}
+
+
+def _moe_param_partition(ep_axis: Optional[str],
+                         tp_axis: Optional[str]) -> Dict[str, P]:
+    """Per-leaf NON-leading-dim specs for the MoE FFN half: whole experts
+    over ep, per-expert Megatron FFN widths over tp, router replicated
+    (every device routes over all E experts) — shared by the
+    gpipe/circular pp route and the 1F1B train step so the tables cannot
+    drift."""
+    return {
+        "router": P(None, None, None),
+        "e_gate": P(None, ep_axis, None, tp_axis),
+        "e_up": P(None, ep_axis, None, tp_axis),
+        "e_down": P(None, ep_axis, tp_axis, None),
+    }
+
+
+def _shared_expert_partition(tp_axis: Optional[str]) -> Dict[str, P]:
+    """Shared (always-on) experts: width-sharded over tp like the dense
+    MLP, replicated over ep — shared by both pp routes."""
+    return {"s_gate": P(None, None, tp_axis), "s_up": P(None, None, tp_axis),
+            "s_down": P(None, tp_axis, None)}
+
+
+def _replicated_attn_partition() -> Dict[str, P]:
+    """Attention half fully replicated (the ep-only stage layout: only
+    expert weights shard) — shared by both pp routes."""
+    return {
+        "attn_norm": P(None, None), "mlp_norm": P(None, None),
+        "wq": P(None, None, None), "wk": P(None, None, None),
+        "wv": P(None, None, None), "wo": P(None, None, None),
+    }
 
 
 def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
@@ -398,10 +464,6 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     if inbody_ad:
         from tfmesos_tpu.parallel.collectives import (
             broadcast_replicated_grad, psum_replicated_grad)
-        if cfg.n_experts:
-            raise ValueError("inbody_ad manual-tp blocks are dense-only "
-                             "(the MoE collectives still assume outer "
-                             "differentiation)")
         fan = lambda v_: broadcast_replicated_grad(v_, tp_axis)
         red = lambda v_: psum_replicated_grad(v_, tp_axis)
     else:
@@ -418,14 +480,17 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     x = x + red(o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype))
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     if cfg.n_experts:
-        ffn, aux = _ffn(cfg, None, lp, h, ep_axis=ep_axis, tp_axis=tp_axis)
+        # The MoE half fans/reduces internally (over ep AND tp — the f/g
+        # pair when inbody_ad, plain psum otherwise).
+        ffn, aux = _ffn(cfg, None, lp, h, ep_axis=ep_axis, tp_axis=tp_axis,
+                        inbody_ad=inbody_ad)
         return x + ffn, aux
     ffn = _mlp(cfg, lp, fan(h))                   # local d_ff shard
     return x + red(ffn), _zero_aux()
 
 
 def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
-           ep_axis: Optional[str] = None):
+           ep_axis: Optional[str] = None, inbody_ad: bool = False):
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
@@ -441,7 +506,7 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
                window=cfg.window)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
-    ffn, aux = _ffn(cfg, mesh, lp, h, ep_axis=ep_axis)
+    ffn, aux = _ffn(cfg, mesh, lp, h, ep_axis=ep_axis, inbody_ad=inbody_ad)
     return x + ffn, aux
 
 
@@ -503,17 +568,10 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
             partition = _dense_tp_attn_partition()
             if cfg.n_experts:
                 # Per-expert Megatron: FFN widths shard over tp, whole
-                # experts over ep (when present); the router replicates so
-                # every device routes over all E experts.
-                partition.update(
-                    router=P(None, None, None),
-                    e_gate=P(None, ep_axis, None, "tp"),
-                    e_up=P(None, ep_axis, None, "tp"),
-                    e_down=P(None, ep_axis, "tp", None))
+                # experts over ep (when present).
+                partition.update(_moe_param_partition(ep_axis, "tp"))
                 if cfg.n_shared_experts:
-                    partition.update(s_gate=P(None, None, "tp"),
-                                     s_up=P(None, None, "tp"),
-                                     s_down=P(None, "tp", None))
+                    partition.update(_shared_expert_partition("tp"))
             else:
                 partition.update(_dense_tp_mlp_partition())
         else:
@@ -524,18 +582,11 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
             partition = None
             if ep_axis:
                 partition = {
-                    "attn_norm": P(None, None), "mlp_norm": P(None, None),
-                    "wq": P(None, None, None), "wk": P(None, None, None),
-                    "wv": P(None, None, None), "wo": P(None, None, None),
-                    "router": P(None, None, None),
-                    "e_gate": P(None, "ep", None, None),
-                    "e_up": P(None, "ep", None, None),
-                    "e_down": P(None, "ep", None, None),
+                    **_replicated_attn_partition(),
+                    **_moe_param_partition(ep_axis, None),
                 }
                 if cfg.n_shared_experts:
-                    partition.update(s_gate=P(None, None, None),
-                                     s_up=P(None, None, None),
-                                     s_down=P(None, None, None))
+                    partition.update(_shared_expert_partition(None))
         if cfg.remat:
             stage_block = jax.checkpoint(stage_block)
 
@@ -1815,23 +1866,30 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     The embedding differentiates through the returned dx, and the final
     norm + unembedding head ride as tail params of the loss stage.
 
-    Scope: dense configs on pp x tp (+ dp/fsdp) meshes.  tp stages run
-    the manual-collective Megatron block with the in-body-AD f/g
-    collectives, and the loss tail is the in-body VOCAB-PARALLEL fused
-    CE (``ops/layers.vocab_parallel_ce_inbody``: the unembedding shards
-    over tp, no device holds more than a [chunk, V/tp] logits block —
-    fwd or bwd); a vocab that does not divide over tp falls back to the
-    replicated fused-CE tail, as ``loss_fn`` does.  sp stage bodies and MoE aux-loss plumbing stay with
-    the gpipe/circular schedules (``loss_fn``); interleaved virtual
-    stages are circular-only.
+    Scope: dense AND dense-top-k-MoE configs on pp x tp x ep (+ dp/fsdp)
+    meshes.  tp stages run the manual-collective Megatron block with the
+    in-body-AD f/g collectives, and the loss tail is the in-body
+    VOCAB-PARALLEL fused CE (``ops/layers.vocab_parallel_ce_inbody``:
+    the unembedding shards over tp, no device holds more than a
+    [chunk, V/tp] logits block — fwd or bwd); a vocab that does not
+    divide over tp falls back to the replicated fused-CE tail, as
+    ``loss_fn`` does.  MoE stages shard whole experts over ep (and
+    per-expert FFN widths over tp) with the in-body-AD f/g collectives,
+    and carry the router aux losses as per-stage scalar aux terms seeded
+    alongside the loss vjp (``pipeline_train_1f1b(stage_aux=True)``) —
+    the same layer-mean estimator the gpipe route uses, so grads match
+    ``jax.grad(loss_fn)`` on the same mesh.  ``moe_impl='switch'`` and
+    sp stage bodies stay with the gpipe/circular schedules;
+    interleaved virtual stages are circular-only.
     """
     pp = mesh.shape.get("pp", 1)
     tp = mesh.shape.get("tp", 1)
+    ep = mesh.shape.get("ep", 1)
     real = {a for a, s in mesh.shape.items() if s > 1}
-    if not real <= {"pp", "tp", "dp", "fsdp"}:
+    if not real <= {"pp", "tp", "dp", "fsdp", "ep"}:
         raise ValueError(
-            f"train_step_1f1b supports pp x tp x dp/fsdp meshes; got "
-            f"{dict(mesh.shape)} (sp/ep stage bodies stay with "
+            f"train_step_1f1b supports pp x tp x ep x dp/fsdp meshes; got "
+            f"{dict(mesh.shape)} (sp stage bodies stay with "
             f"pp_schedule='gpipe'/'circular')")
     if tp > 1 and cfg.kv_heads % tp:
         raise ValueError(f"1f1b x tp needs tp ({tp}) to divide kv_heads "
@@ -1839,10 +1897,16 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     if tp > 1 and cfg.d_ff % tp:
         raise ValueError(f"1f1b x tp needs tp ({tp}) to divide d_ff "
                          f"({cfg.d_ff}) for the Megatron FFN split")
-
-    if cfg.n_experts:
-        raise ValueError("train_step_1f1b does not carry MoE router aux "
-                         "losses; use pp_schedule='gpipe'/'circular'")
+    if ep > 1 and not cfg.n_experts:
+        raise ValueError("an ep axis needs n_experts > 0")
+    if cfg.n_experts and cfg.n_experts % max(ep, 1):
+        raise ValueError(f"ep ({ep}) must divide n_experts "
+                         f"({cfg.n_experts})")
+    if cfg.n_experts and cfg.moe_impl == "switch":
+        raise ValueError("train_step_1f1b runs the dense top-k MoE "
+                         "(moe_impl='switch' assumes outer "
+                         "differentiation); use pp_schedule="
+                         "'gpipe'/'circular' for switch dispatch")
     if cfg.pp_virtual_stages != 1:
         raise ValueError("interleaved virtual stages are circular-only; "
                          "train_step_1f1b runs one chunk per stage")
@@ -1858,27 +1922,52 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         lambda p: p.reshape(max(pp, 1), per, *p.shape[1:]),
         params["layers"])
 
+    ep_axis = "ep" if (cfg.n_experts and ep > 1) else None
     partition = None
     if tp > 1:
         # forward_hidden's dense tp partition table (shared helpers);
         # stages run the manual Megatron block with in-body-AD
         # collectives.
         partition = {**_dense_tp_attn_partition(),
-                     **_dense_tp_mlp_partition()}
+                     **(_moe_param_partition(ep_axis, "tp")
+                        if cfg.n_experts else _dense_tp_mlp_partition())}
+        if cfg.n_shared_experts:
+            partition.update(_shared_expert_partition("tp"))
+    elif ep_axis:
+        partition = {
+            **_replicated_attn_partition(),
+            **_moe_param_partition(ep_axis, None),
+        }
+        if cfg.n_shared_experts:
+            partition.update(_shared_expert_partition(None))
+
+    # MoE stages return a pre-weighted scalar aux loss (their layers'
+    # summed router terms, normalized by n_layers so the sum over stages
+    # is the model's layer-mean aux — the same estimator loss_fn's gpipe
+    # route uses); pipeline_train_1f1b seeds it alongside the loss vjp.
+    stage_aux = bool(cfg.n_experts)
 
     def stage_fn(stage_params, h):
         pos = jnp.broadcast_to(jnp.arange(h.shape[1], dtype=jnp.int32),
                                h.shape[:2])
         if tp > 1:
-            body = lambda c, lp: (_block_manual_tp(cfg, c, lp, pos,
-                                                   inbody_ad=True)[0],
-                                  None)
+            body = lambda c, lp: _block_manual_tp(cfg, c, lp, pos,
+                                                  ep_axis=ep_axis,
+                                                  inbody_ad=True)
         else:
-            body = lambda c, lp: _block(cfg, None, c, lp, pos)
+            body = lambda c, lp: _block(cfg, None, c, lp, pos,
+                                        ep_axis=ep_axis,
+                                        inbody_ad=ep_axis is not None)
         if cfg.remat:
             body = jax.checkpoint(body)
-        out, _ = jax.lax.scan(body, h, stage_params)
-        return out
+        out, layer_aux = jax.lax.scan(body, h, stage_params)
+        if not stage_aux:
+            return out
+        aux = (cfg.router_aux_weight
+               * jnp.sum(layer_aux["load_balance_loss"])
+               + cfg.router_z_weight * jnp.sum(layer_aux["z_loss"])
+               ) / cfg.n_layers
+        return out, aux.astype(jnp.float32)
 
     def tail_loss(tail, h, tgt_mb):
         # Fused head+CE: never materializes the [mb, T, vocab] logits —
@@ -1909,7 +1998,8 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     loss, g_stacked, g_tail, dx = pipeline_train_1f1b(
         stage_fn, tail_loss, stacked, x, tgt, mesh,
         num_microbatches=num_microbatches, tail_params=tail,
-        param_partition=partition, tail_partition=tail_partition)
+        param_partition=partition, tail_partition=tail_partition,
+        stage_aux=stage_aux)
     (g_embed,) = vjp_embed(dx.astype(x.dtype))
     grads = {
         "embed": jax.tree_util.tree_map(

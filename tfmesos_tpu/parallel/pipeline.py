@@ -125,7 +125,8 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                         num_microbatches: Optional[int] = None,
                         param_partition: Optional[Any] = None,
                         tail_params: Any = None,
-                        tail_partition: Optional[Any] = None):
+                        tail_partition: Optional[Any] = None,
+                        stage_aux: bool = False):
     """One fused forward+backward pipeline pass on the 1F1B schedule.
 
     ``pipeline_apply`` is forward-only — under ``jax.grad`` autodiff
@@ -160,6 +161,16 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
     tail — e.g. a vocab-sharded unembedding consumed by an in-body
     vocab-parallel CE (``ops/layers.vocab_parallel_ce_inbody``); leaves
     default to replicated, and tail grads keep the same specs.
+
+    ``stage_aux=True`` changes the stage contract to
+    ``stage_fn(chunk_params, h) -> (h, aux)`` where ``aux`` is a SCALAR
+    auxiliary loss the stage contributes to the objective (e.g. MoE
+    router load-balance/z losses, pre-weighted and normalized so the sum
+    over stages is the model's aux term).  Each chunk's aux joins the
+    loss at its BACKWARD tick: the vjp seeds the aux output with the
+    same 1/m cotangent as the main loss, so router gradients flow even
+    though no cotangent arrives from downstream stages, and the
+    returned loss includes every stage's aux (summed over pp).
 
     Memory: backward recomputes its chunk from the stashed stage INPUT
     (standard 1F1B remat), so each stage holds at most S microbatch
@@ -235,6 +246,8 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
             def do_fwd(_):
                 # Compute one chunk forward; stash the chunk INPUT (the
                 # 1F1B remat residual) and send the output down the ring.
+                # (The aux scalar is recomputed — and differentiated — at
+                # the chunk's backward tick; forward drops it.)
                 inject = jax.lax.dynamic_index_in_dim(micro, mb, 0,
                                                       keepdims=False)
                 h_in = jnp.where(
@@ -242,6 +255,8 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                     jax.lax.dynamic_index_in_dim(h_buf, slot, 0,
                                                  keepdims=False))
                 h_out = stage_fn(chunk_p, h_in)
+                if stage_aux:
+                    h_out = h_out[0]
                 return (jax.lax.dynamic_update_index_in_dim(h_buf, h_in,
                                                             slot, 0),
                         dparams, dtail, dx, loss_acc, h_out, z_send)
@@ -264,18 +279,28 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                 g_in = jax.lax.dynamic_index_in_dim(g_buf, slot, 0,
                                                     keepdims=False)
 
+                def apply_stage(p, h):
+                    """(h_out, aux): aux is 0 for plain stages, so one
+                    code path serves both contracts."""
+                    out = stage_fn(p, h)
+                    if stage_aux:
+                        return out[0], out[1].astype(jnp.float32)
+                    return out, jnp.zeros((), jnp.float32)
+
                 def last_chunk(_):
                     if tail_params is None:
                         def f(p, h):
-                            return loss_fn(stage_fn(p, h), tgt)
+                            out, aux = apply_stage(p, h)
+                            return loss_fn(out, tgt).astype(jnp.float32) \
+                                + aux
                         lval, vjp = jax.vjp(f, chunk_p, h_stash)
-                        # Seed in the loss's own dtype (bf16 stages produce
-                        # bf16 losses); accumulate in fp32.
                         dp, dh = vjp(jnp.asarray(1.0 / m, lval.dtype))
                         dtl = zero_tail
                     else:
                         def f(p, h, tl):
-                            return loss_fn(tl, stage_fn(p, h), tgt)
+                            out, aux = apply_stage(p, h)
+                            return loss_fn(tl, out, tgt).astype(
+                                jnp.float32) + aux
                         lval, vjp = jax.vjp(f, chunk_p, h_stash, tail)
                         dp, dh, dtl = vjp(jnp.asarray(1.0 / m, lval.dtype))
                         # fp32 like the other accumulators — and both cond
@@ -285,9 +310,15 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                     return lval.astype(jnp.float32), dp, dh, dtl
 
                 def mid_chunk(_):
-                    _, vjp = jax.vjp(stage_fn, chunk_p, h_stash)
-                    dp, dh = vjp(g_in)
-                    return jnp.zeros((), jnp.float32), dp, dh, zero_tail
+                    (_, aux), vjp = jax.vjp(apply_stage, chunk_p, h_stash)
+                    # The aux output takes the SAME 1/m seed as the loss:
+                    # router grads flow from this stage's own aux term
+                    # even though no loss cotangent arrives from the ring.
+                    dp, dh = vjp((g_in, jnp.asarray(1.0 / m, jnp.float32)))
+                    # Raw aux into the accumulator — the final /m turns the
+                    # sum over microbatches into the mean, exactly as the
+                    # last stage's lval.
+                    return aux, dp, dh, zero_tail
 
                 lval, dp, dh, dtl = jax.lax.cond(stage == slots - 1,
                                                  last_chunk, mid_chunk, None)
@@ -323,11 +354,13 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
         carry = jax.lax.fori_loop(0, ticks, tick, carry)
         _, _, dparams, dtail, dx, loss_acc, _, _ = carry
         if n_stages > 1:
-            # Loss and tail grads live on the last stage, dx on stage 0;
-            # pp-broadcast them so the caller sees pp-replicated outputs.
-            # dparams stay per-stage (that IS their sharding).
-            loss = jax.lax.psum(
-                jnp.where(stage == slots - 1, loss_acc, 0.0), axis)
+            # Every stage's loss_acc contributes (mid stages hold their
+            # own aux terms; 0 for plain stages, so this reduces to the
+            # last-stage-only extraction for dense models); tail grads
+            # live on the last stage, dx on stage 0 — pp-broadcast them
+            # so the caller sees pp-replicated outputs.  dparams stay
+            # per-stage (that IS their sharding).
+            loss = jax.lax.psum(loss_acc, axis)
             dtail = jax.tree_util.tree_map(
                 lambda g: jax.lax.psum(
                     jnp.where(stage == slots - 1, g, jnp.zeros_like(g)),
