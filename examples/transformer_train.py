@@ -97,7 +97,11 @@ def main():
                 and mesh.shape.get("pp", 1) > 1 else "switch")
     if args.tiny:
         cfg = transformer.TransformerConfig(
-            vocab_size=256, d_model=64, n_layers=2,
+            vocab_size=256, d_model=64,
+            # Enough layers for the requested pipeline chunking (pp x
+            # virtual stages), else the tiny default.
+            n_layers=max(2, mesh.shape.get("pp", 1)
+                         * args.virtual_stages),
             n_heads=max(4, 2 * mesh.shape.get("tp", 1)), d_ff=128,
             max_seq_len=args.seq_len, dtype=jnp.float32,
             n_experts=args.moe, top_k=args.top_k, moe_impl=moe_impl,

@@ -350,6 +350,79 @@ def test_pipeline_1f1b_with_manual_tp_stage():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("pp,v,mb,dp", [(2, 2, 4, 2), (4, 2, 8, 1),
+                                        (2, 4, 8, 1)])
+def test_pipeline_1f1b_interleaved_matches_sequential(pp, v, mb, dp):
+    """Interleaved 1F1B (VERDICT r4 next #5): v chunks per device on the
+    round-robin layout (device d owns chunks d, d+pp, ...), every
+    microbatch lapping the ring v times — loss, per-chunk grads (in the
+    caller's GLOBAL chunk order), and dx all match direct autodiff of
+    the sequential chunk chain."""
+    from tfmesos_tpu.parallel.pipeline import pipeline_train_1f1b
+
+    mesh = build_mesh({"pp": pp, "dp": dp},
+                      devices=jax.devices()[:pp * dp])
+    rng = np.random.RandomState(7)
+    dim, n_chunks = 8, pp * v
+    stages = [{"w": jnp.asarray(rng.randn(dim, dim) / 4, jnp.float32),
+               "b": jnp.zeros((dim,), jnp.float32)}
+              for _ in range(n_chunks)]
+    stacked = stack_stage_params(stages)
+    stage = lambda p, h: jnp.tanh(h @ p["w"] + p["b"])
+    lossf = lambda h, t: jnp.mean((h - t) ** 2)
+    b = mb * dp
+    x = jnp.asarray(rng.randn(b, dim), jnp.float32)
+    t = jnp.asarray(rng.randn(b, dim), jnp.float32)
+    l1, g1, dx1 = jax.jit(lambda s, x_, t_: pipeline_train_1f1b(
+        stage, lossf, s, x_, t_, mesh, num_microbatches=mb,
+        virtual_stages=v))(stacked, x, t)
+
+    def ref(s, x_):
+        h = x_
+        for i in range(n_chunks):
+            h = stage(jax.tree_util.tree_map(lambda p: p[i], s), h)
+        return lossf(h, t)
+
+    rl, rg = jax.value_and_grad(ref)(stacked, x)
+    rdx = jax.grad(lambda x_: ref(stacked, x_))(x)
+    assert abs(float(l1) - float(rl)) < 1e-5
+    for a, b_ in zip(jax.tree_util.tree_leaves(g1),
+                     jax.tree_util.tree_leaves(rg)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx1), np.asarray(rdx),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_transformer_train_step_1f1b_interleaved():
+    """Model-level interleaved 1F1B: pp=2 x pp_virtual_stages=2 (one
+    layer per chunk) reproduces jax.grad of the plain loss_fn."""
+    from tfmesos_tpu.models import transformer
+
+    mesh = build_mesh({"pp": 2, "dp": 2}, devices=jax.devices()[:4])
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+        max_seq_len=16, dtype=jnp.float32, pp_virtual_stages=2)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(8, 17)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(tokens)}
+    got_l, got_g = jax.jit(lambda p, b: transformer.train_step_1f1b(
+        cfg, p, b, mesh, num_microbatches=4))(params, batch)
+    ref_l, ref_g = jax.value_and_grad(
+        lambda p: transformer.loss_fn(
+            cfg, p, batch)[0])(params)
+    np.testing.assert_allclose(float(got_l), float(ref_l), rtol=1e-5)
+    for key, a, b_ in zip(
+            [jax.tree_util.keystr(k) for k, _ in
+             jax.tree_util.tree_flatten_with_path(got_g)[0]],
+            jax.tree_util.tree_leaves(got_g),
+            jax.tree_util.tree_leaves(ref_g)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32),
+            rtol=2e-4, atol=1e-5, err_msg=key)
+
+
 def test_pipeline_1f1b_validation():
     from tfmesos_tpu.parallel.pipeline import pipeline_train_1f1b
 
@@ -357,7 +430,7 @@ def test_pipeline_1f1b_validation():
     stacked = stack_stage_params(
         [{"w": jnp.eye(4)} for _ in range(2)])      # 2 chunks, 4 stages
     x = jnp.ones((8, 4))
-    with pytest.raises(ValueError, match="one chunk per stage"):
+    with pytest.raises(ValueError, match="chunk"):
         pipeline_train_1f1b(lambda p, h: h @ p["w"],
                             lambda h, t: jnp.mean(h), stacked, x, x, mesh)
     with pytest.raises(ValueError, match="no 'pp' axis"):

@@ -1878,9 +1878,12 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     and carry the router aux losses as per-stage scalar aux terms seeded
     alongside the loss vjp (``pipeline_train_1f1b(stage_aux=True)``) —
     the same layer-mean estimator the gpipe route uses, so grads match
-    ``jax.grad(loss_fn)`` on the same mesh.  ``moe_impl='switch'`` and
-    sp stage bodies stay with the gpipe/circular schedules;
-    interleaved virtual stages are circular-only.
+    ``jax.grad(loss_fn)`` on the same mesh.  ``cfg.pp_virtual_stages > 1``
+    runs the INTERLEAVED 1F1B timetable (device d owns layer chunks d,
+    d+pp, ...; every microbatch laps the ring v times), shrinking the
+    bubble for v x more ppermute hops at the same per-chunk stash rule.
+    ``moe_impl='switch'`` and sp stage bodies stay with the
+    gpipe/circular schedules.
     """
     pp = mesh.shape.get("pp", 1)
     tp = mesh.shape.get("tp", 1)
@@ -1907,19 +1910,21 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
                          "(moe_impl='switch' assumes outer "
                          "differentiation); use pp_schedule="
                          "'gpipe'/'circular' for switch dispatch")
-    if cfg.pp_virtual_stages != 1:
-        raise ValueError("interleaved virtual stages are circular-only; "
-                         "train_step_1f1b runs one chunk per stage")
-    if cfg.n_layers % max(pp, 1):
+    v = cfg.pp_virtual_stages
+    if v > 1 and pp < 2:
+        raise ValueError("pp_virtual_stages > 1 needs a real pp axis")
+    n_chunks = max(pp, 1) * v
+    if cfg.n_layers % n_chunks:
         raise ValueError(f"{cfg.n_layers} layers not divisible into "
-                         f"{pp} pipeline stages")
+                         f"{n_chunks} pipeline chunks "
+                         f"({pp} stages x {v} virtual)")
     from tfmesos_tpu.parallel.pipeline import pipeline_train_1f1b
 
     tokens = batch["tokens"]
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
-    per = cfg.n_layers // max(pp, 1)
+    per = cfg.n_layers // n_chunks
     stacked = jax.tree_util.tree_map(
-        lambda p: p.reshape(max(pp, 1), per, *p.shape[1:]),
+        lambda p: p.reshape(n_chunks, per, *p.shape[1:]),
         params["layers"])
 
     ep_axis = "ep" if (cfg.n_experts and ep > 1) else None
@@ -1999,7 +2004,7 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         stage_fn, tail_loss, stacked, x, tgt, mesh,
         num_microbatches=num_microbatches, tail_params=tail,
         param_partition=partition, tail_partition=tail_partition,
-        stage_aux=stage_aux)
+        stage_aux=stage_aux, virtual_stages=v)
     (g_embed,) = vjp_embed(dx.astype(x.dtype))
     grads = {
         "embed": jax.tree_util.tree_map(

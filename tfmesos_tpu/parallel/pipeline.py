@@ -58,64 +58,81 @@ def stage_sharding_tree(stacked_params: Any, mesh: Mesh, axis: str = "pp") -> An
         stacked_params)
 
 
-def _schedule_1f1b(n_stages: int, m: int):
+def _schedule_1f1b(n_stages: int, m: int, v: int = 1):
     """Greedy 1F1B timetable, computed at trace time (all sizes static).
 
-    Returns ``(kind, mb)`` int arrays of shape [T, S]: at tick t stage s
-    performs kind 0=idle / 1=forward / 2=backward on microbatch mb.  The
-    policy is the classic one: stage s keeps at most ``S - s`` microbatches
-    in flight (its warmup depth), then strictly alternates one-forward /
-    one-backward — same bubble as gpipe, peak activation stash S slots
-    instead of m.
+    Returns ``(kind, mb, lap)`` int arrays of shape [T, S]: at tick t
+    device s performs kind 0=idle / 1=forward / 2=backward on microbatch
+    mb of its LOCAL chunk ``lap`` (global virtual chunk = lap*S + s; lap
+    is always 0 at v=1).  The policy generalizes the classic one: device
+    d keeps at most ``(S - d) + (v - 1)*S`` microbatch-chunks in flight
+    (its interleaved warmup depth), prefers the ready backward with the
+    lowest microbatch (deepest chunk on ties), and fills with the ready
+    forward with the lowest microbatch (earliest chunk on ties).  At
+    v=1 this is exactly the classic schedule: same bubble as gpipe,
+    peak stash S microbatch inputs.  At v>1 every microbatch laps the
+    ring v times (chunk c feeds chunk c+1, always one device to the
+    right), cutting the bubble by ~v for v x more ppermute hops.
     """
     import numpy as np
 
-    last = n_stages - 1
-    next_f = [0] * n_stages
-    next_b = [0] * n_stages
-    f_done = [[-1] * m for _ in range(n_stages)]
-    b_done = [[-1] * m for _ in range(n_stages)]
-    kinds, mbs = [], []
+    n_virt = n_stages * v
+    last = n_virt - 1
+    next_f = [0] * n_virt
+    next_b = [0] * n_virt
+    f_done = [[-1] * m for _ in range(n_virt)]
+    b_done = [[-1] * m for _ in range(n_virt)]
+    kinds, mbs, laps = [], [], []
     t = 0
     while any(nb < m for nb in next_b):
-        # The last stage never runs a separate forward tick: its backward
-        # recomputes the chunk inside the loss vjp anyway, so a standalone
-        # forward would be discarded work.  Its "forward" is the ARRIVAL
-        # of the previous stage's output (immediate for a 1-stage
-        # pipeline, whose stage-0 input is always at hand).
+        # The last VIRTUAL chunk never runs a separate forward tick: its
+        # backward recomputes the chunk inside the loss vjp anyway, so a
+        # standalone forward would be discarded work.  Its "forward" is
+        # the ARRIVAL of the previous chunk's output (immediate for a
+        # 1-chunk pipeline, whose chunk-0 input is always at hand).
         while next_f[last] < m and (
                 last == 0 or 0 <= f_done[last - 1][next_f[last]] < t):
             f_done[last][next_f[last]] = (
                 t if last == 0 else f_done[last - 1][next_f[last]] + 1)
             next_f[last] += 1
-        krow, mrow = [], []
-        for s in range(n_stages):
-            i, j = next_b[s], next_f[s]
-            can_b = i < m and (
-                (s == last and 0 <= f_done[s][i] <= t)
-                or (s < last and 0 <= b_done[s + 1][i] < t))
-            can_f = s < last and j < m and (s == 0
-                                            or 0 <= f_done[s - 1][j] < t)
-            inflight = next_f[s] - next_b[s]
-            if can_b and (inflight >= n_stages - s or not can_f):
-                krow.append(2)
-                mrow.append(i)
-                b_done[s][i] = t
-                next_b[s] += 1
-            elif can_f and inflight < n_stages - s:
-                krow.append(1)
-                mrow.append(j)
-                f_done[s][j] = t
-                next_f[s] += 1
-            else:
-                krow.append(0)
-                mrow.append(0)
+        krow = [0] * n_stages
+        mrow = [0] * n_stages
+        lrow = [0] * n_stages
+        for d in range(n_stages):
+            chunks = [lap * n_stages + d for lap in range(v)]
+            ready_b, ready_f = [], []
+            for c in chunks:
+                i, j = next_b[c], next_f[c]
+                if i < m and (
+                        (c == last and 0 <= f_done[c][i] <= t)
+                        or (c < last and 0 <= b_done[c + 1][i] < t)):
+                    ready_b.append(c)
+                # Per-chunk in-flight stays under S so the mb%S stash
+                # slots of one chunk never collide.
+                if (c < last and j < m
+                        and (c == 0 or 0 <= f_done[c - 1][j] < t)
+                        and j - next_b[c] < n_stages):
+                    ready_f.append(c)
+            inflight = sum(next_f[c] - next_b[c] for c in chunks)
+            depth = (n_stages - d) + (v - 1) * n_stages
+            if ready_b and (inflight >= depth or not ready_f):
+                c = min(ready_b, key=lambda c_: (next_b[c_], -c_))
+                krow[d], mrow[d], lrow[d] = 2, next_b[c], c // n_stages
+                b_done[c][next_b[c]] = t
+                next_b[c] += 1
+            elif ready_f and inflight < depth:
+                c = min(ready_f, key=lambda c_: (next_f[c_], c_))
+                krow[d], mrow[d], lrow[d] = 1, next_f[c], c // n_stages
+                f_done[c][next_f[c]] = t
+                next_f[c] += 1
         kinds.append(krow)
         mbs.append(mrow)
+        laps.append(lrow)
         t += 1
-        if t > 4 * (m + n_stages) + 8:   # safety: schedule must terminate
+        if t > 4 * v * (m + n_virt) + 8:  # safety: must terminate
             raise AssertionError("1f1b schedule did not converge")
-    return np.asarray(kinds, np.int32), np.asarray(mbs, np.int32)
+    return (np.asarray(kinds, np.int32), np.asarray(mbs, np.int32),
+            np.asarray(laps, np.int32))
 
 
 def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
@@ -126,7 +143,8 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                         param_partition: Optional[Any] = None,
                         tail_params: Any = None,
                         tail_partition: Optional[Any] = None,
-                        stage_aux: bool = False):
+                        stage_aux: bool = False,
+                        virtual_stages: int = 1):
     """One fused forward+backward pipeline pass on the 1F1B schedule.
 
     ``pipeline_apply`` is forward-only — under ``jax.grad`` autodiff
@@ -172,11 +190,16 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
     though no cotangent arrives from downstream stages, and the
     returned loss includes every stage's aux (summed over pp).
 
-    Memory: backward recomputes its chunk from the stashed stage INPUT
-    (standard 1F1B remat), so each stage holds at most S microbatch
-    inputs — peak stash O(S), independent of the microbatch count m.
-    Each tick runs one chunk of work per device; idle bubble ticks match
-    gpipe's (S-1 fill + S-1 drain at the same m).
+    ``virtual_stages=v`` (> 1) runs the INTERLEAVED timetable: device d
+    owns chunks d, d+S, ..., every microbatch laps the ring v times, and
+    each tick is 1/v the compute — shrinking the fill/drain bubble's
+    wall-clock share by ~v for v x more (activation-sized) ppermute
+    hops.  Stage-chunk grads return in the caller's GLOBAL chunk order.
+
+    Memory: backward recomputes its chunk from the stashed chunk INPUT
+    (standard 1F1B remat); each device holds at most S microbatch
+    inputs PER LOCAL CHUNK (buffers of v*S slots — at v=1 the classic
+    O(S) stash), independent of the microbatch count m.
     """
     if axis not in mesh.shape:
         raise ValueError(f"pipeline_train_1f1b: mesh {dict(mesh.shape)} has "
@@ -193,14 +216,31 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
     if targets.shape[0] != x.shape[0]:
         raise ValueError(f"targets batch {targets.shape[0]} != x batch "
                          f"{x.shape[0]}")
+    v = int(virtual_stages)
+    if v < 1:
+        raise ValueError("virtual_stages must be >= 1")
+    if v > 1 and n_stages < 2:
+        raise ValueError("interleaved virtual stages need a real pp axis "
+                         "(n_stages >= 2); v chunks on one device is just "
+                         "a deeper stage")
     n_chunks = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-    if n_chunks != max(n_stages, 1):
-        raise ValueError(f"1f1b runs one chunk per stage: stacked params "
-                         f"have {n_chunks} chunks for {n_stages} stages "
-                         f"(interleaved virtual stages are a circular-"
-                         f"schedule feature)")
+    if n_chunks != max(n_stages, 1) * v:
+        raise ValueError(f"1f1b runs {v} chunk(s) per stage: stacked "
+                         f"params have {n_chunks} chunks for {n_stages} "
+                         f"stages x virtual_stages={v}")
+    if v > 1:
+        # Interleaved layout: global chunk c runs on device c % S at
+        # local index (lap) c // S.  Contiguous pp sharding gives device
+        # d the local block [d*v, (d+1)*v), so permute global order
+        # [c] -> [ (c % S)*v + c // S ] — same move as the circular
+        # schedule — and inverse-permute the returned grads.
+        perm = jnp.asarray([(i % n_stages) * v + i // n_stages
+                            for i in range(n_stages * v)]).argsort()
+        inv_perm = jnp.argsort(perm)
+        stacked_params = jax.tree_util.tree_map(
+            lambda p: jnp.take(p, perm, axis=0), stacked_params)
 
-    kinds_np, mbs_np = _schedule_1f1b(max(n_stages, 1), m)
+    kinds_np, mbs_np, laps_np = _schedule_1f1b(max(n_stages, 1), m, v)
     ticks = kinds_np.shape[0]
 
     def local(params, tail, xs, ts):
@@ -211,7 +251,7 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
         mb_shape = micro.shape[1:]
         kinds = jnp.asarray(kinds_np)
         mbs = jnp.asarray(mbs_np)
-        chunk_p = jax.tree_util.tree_map(lambda p: p[0], params)
+        laps = jnp.asarray(laps_np)
         slots = max(n_stages, 1)
 
         def tick(t, carry):
@@ -219,24 +259,48 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
              recv_g) = carry
             kind = kinds[t, stage]
             mb = mbs[t, stage]
-            slot = mb % slots
+            lap = laps[t, stage]
+            slot = lap * slots + mb % slots
+            if v == 1:
+                # lap is constantly 0: slice once, outside the hot loop's
+                # dataflow, instead of a per-tick O(params) gather.
+                chunk_p = jax.tree_util.tree_map(lambda p: p[0], params)
+            else:
+                chunk_p = jax.tree_util.tree_map(
+                    lambda p: jax.lax.dynamic_index_in_dim(
+                        p, lap, 0, keepdims=False), params)
             # File the values that arrived over the ring: what they are is
             # the neighbour's op last tick, read from the same table.
+            # Chunk c always feeds chunk c+1 one device to the right (c-1
+            # one left for cotangents); crossing the ring seam bumps the
+            # receiving lap (device 0 receives lap l as chunk lap l+1,
+            # device S-1 receives backward lap l as chunk lap l-1).
             prev_s = (stage - 1) % slots
             next_s = (stage + 1) % slots
             if n_stages > 1:
                 up_kind = jnp.where(t > 0, kinds[t - 1, prev_s], 0)
                 up_mb = mbs[jnp.maximum(t - 1, 0), prev_s]
+                up_lap = laps[jnp.maximum(t - 1, 0), prev_s] + \
+                    jnp.where(stage == 0, 1, 0)
+                up_ok = (up_kind == 1) & ((stage > 0) | (up_lap < v))
                 h_buf = jnp.where(
-                    (up_kind == 1) & (stage > 0),
+                    up_ok,
                     jax.lax.dynamic_update_index_in_dim(
-                        h_buf, recv_f, up_mb % slots, 0), h_buf)
+                        h_buf, recv_f,
+                        jnp.minimum(up_lap, v - 1) * slots
+                        + up_mb % slots, 0), h_buf)
                 dn_kind = jnp.where(t > 0, kinds[t - 1, next_s], 0)
                 dn_mb = mbs[jnp.maximum(t - 1, 0), next_s]
+                dn_lap = laps[jnp.maximum(t - 1, 0), next_s] - \
+                    jnp.where(stage == slots - 1, 1, 0)
+                dn_ok = (dn_kind == 2) & ((stage < slots - 1)
+                                          | (dn_lap >= 0))
                 g_buf = jnp.where(
-                    (dn_kind == 2) & (stage < slots - 1),
+                    dn_ok,
                     jax.lax.dynamic_update_index_in_dim(
-                        g_buf, recv_g, dn_mb % slots, 0), g_buf)
+                        g_buf, recv_g,
+                        jnp.maximum(dn_lap, 0) * slots
+                        + dn_mb % slots, 0), g_buf)
 
             z_send = jnp.zeros(mb_shape, xs.dtype)
 
@@ -251,7 +315,7 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                 inject = jax.lax.dynamic_index_in_dim(micro, mb, 0,
                                                       keepdims=False)
                 h_in = jnp.where(
-                    stage == 0, inject,
+                    (stage == 0) & (lap == 0), inject,
                     jax.lax.dynamic_index_in_dim(h_buf, slot, 0,
                                                  keepdims=False))
                 h_out = stage_fn(chunk_p, h_in)
@@ -271,7 +335,7 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                 inject = jax.lax.dynamic_index_in_dim(micro, mb, 0,
                                                       keepdims=False)
                 h_stash = jnp.where(
-                    stage == 0, inject,
+                    (stage == 0) & (lap == 0), inject,
                     jax.lax.dynamic_index_in_dim(h_buf, slot, 0,
                                                  keepdims=False))
                 tgt = jax.lax.dynamic_index_in_dim(tmicro, mb, 0,
@@ -320,14 +384,22 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                     # last stage's lval.
                     return aux, dp, dh, zero_tail
 
-                lval, dp, dh, dtl = jax.lax.cond(stage == slots - 1,
-                                                 last_chunk, mid_chunk, None)
-                new_dparams = jax.tree_util.tree_map(
-                    lambda acc, g: acc + g.astype(jnp.float32), dparams, dp)
+                lval, dp, dh, dtl = jax.lax.cond(
+                    (stage == slots - 1) & (lap == v - 1),
+                    last_chunk, mid_chunk, None)
+
+                def acc_at_lap(acc, g):
+                    cur = jax.lax.dynamic_index_in_dim(acc, lap, 0,
+                                                       keepdims=False)
+                    return jax.lax.dynamic_update_index_in_dim(
+                        acc, cur + g.astype(jnp.float32), lap, 0)
+
+                new_dparams = jax.tree_util.tree_map(acc_at_lap, dparams,
+                                                     dp)
                 new_dtail = jax.tree_util.tree_map(
                     lambda acc, g: acc + g.astype(jnp.float32), dtail, dtl)
                 new_dx = jnp.where(
-                    stage == 0,
+                    (stage == 0) & (lap == 0),
                     jax.lax.dynamic_update_index_in_dim(
                         dx, dh.astype(dx.dtype), mb, 0), dx)
                 return (h_buf, new_dparams, new_dtail, new_dx,
@@ -341,10 +413,10 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
             return (h_buf, g_buf, dparams, dtail, dx, loss_acc, recv_f,
                     recv_g)
 
-        h_buf0 = jnp.zeros((slots,) + mb_shape, xs.dtype)
-        g_buf0 = jnp.zeros((slots,) + mb_shape, xs.dtype)
+        h_buf0 = jnp.zeros((v * slots,) + mb_shape, xs.dtype)
+        g_buf0 = jnp.zeros((v * slots,) + mb_shape, xs.dtype)
         dparams0 = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape[1:], jnp.float32), params)
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
         zero_tail = jax.tree_util.tree_map(
             lambda p: jnp.zeros(jnp.shape(p), jnp.float32), tail)
         dx0 = jnp.zeros((m,) + mb_shape, jnp.float32)
@@ -383,7 +455,6 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
             dtail = jax.tree_util.tree_map(
                 lambda g: jax.lax.pmean(g, d_axis_names), dtail)
             dx = dx / dp_size
-        dparams = jax.tree_util.tree_map(lambda g: g[None], dparams)
         return loss, dparams, dtail, dx.reshape(b_loc, *xs.shape[1:])
 
     if param_partition is None:
@@ -405,6 +476,11 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                        out_specs=(P(), param_specs, tail_specs, x_spec),
                        check_vma=False)
     loss, grads, tail_grads, dx = fn(stacked_params, tail_params, x, targets)
+    if v > 1:
+        # Grads came back in the interleaved (permuted) chunk order;
+        # restore the caller's global layer order.
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.take(g, inv_perm, axis=0), grads)
     if tail_params is None:
         return loss, grads, dx
     return loss, grads, tail_grads, dx
