@@ -404,6 +404,44 @@ def bench_attention_blocks(b=4, t=2048, h=8, d=128, reps=10):
     return {"bq512": timed(512), "bq1024": timed(1024)}
 
 
+def bench_ring_window(t=8192, window=1024, reps=10):
+    """Ring attention with a sliding window across every visible device:
+    the Pallas offset-window inner (per-step kernels skip k-blocks
+    outside the window — O(T·W) work ring-wide) vs the einsum inner.
+    Needs >1 device (an sp axis); returns (flash_ms, einsum_ms) or None."""
+    import jax
+    import jax.numpy as jnp
+    from tfmesos_tpu.parallel.mesh import build_mesh
+    from tfmesos_tpu.parallel.ring_attention import ring_attention
+
+    n = jax.device_count()
+    if n < 2 or t % n:
+        return None
+    mesh = build_mesh({"sp": n})
+    b, h, d = 1, 8, 128
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    q = jax.random.normal(kq, (b, t, h, d), dt)
+    k = jax.random.normal(kk, (b, t, h, d), dt)
+    v = jax.random.normal(kv, (b, t, h, d), dt)
+
+    def timed(impl):
+        fn = jax.jit(lambda q_, k_, v_: ring_attention(
+            q_, k_, v_, mesh, causal=True, window=window, impl=impl))
+        jax.block_until_ready(fn(q, k, v))       # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1000.0
+
+    return timed("flash"), timed("xla")
+
+
 def bench_serving_continuous(n_requests=32, rows=8):
     """Continuous-batching serving throughput: requests/s for a prompt
     stream admitted into a persistent paged decode
@@ -782,6 +820,13 @@ def main():
                    "mesh continuous serving bench", n=1)
     if msv and msv[0] is not None:  # >1 visible device: dp x tp serving
         out["serving_mesh_requests_per_sec"] = round(msv[0], 2)
+        flush_partial()
+    rw = attempts(bench_ring_window, "ring window bench", n=1)
+    if rw and rw[0] is not None:    # >1 visible device: sp ring
+        flash_ms, xla_ms = rw[0]
+        out["ring_window_flash_ms"] = round(flash_ms, 3)
+        out["ring_window_einsum_ms"] = round(xla_ms, 3)
+        out["ring_window_flash_speedup"] = round(xla_ms / flash_ms, 3)
         flush_partial()
     bw = attempts(bench_bandwidth, "bandwidth bench", n=1)
     if bw:

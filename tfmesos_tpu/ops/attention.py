@@ -84,6 +84,12 @@ class _FlashCfg(NamedTuple):
     interpret: bool
     q_per_kv: int = 1  # GQA group size (q heads per kv head); 1 = MHA
     window: Optional[int] = None  # sliding window (causal only); None = full
+    # Static GLOBAL offset of the query block's positions relative to the
+    # key block's (query i is global position i + q_offset; key j is j).
+    # Ring attention sets it to step * shard_len so causal/window masks
+    # and block bounds are exact across shards; 0 = the ordinary
+    # same-origin call.
+    q_offset: int = 0
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
@@ -106,11 +112,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
     if cfg.causal:
         # Blocks strictly above the diagonal contribute nothing: bound the
         # loop instead of masking them (halves the FLOPs on average).
-        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * bq, bk))
+        nk = jnp.minimum(nk, pl.cdiv((qi + 1) * bq + cfg.q_offset, bk))
         if cfg.window is not None:
             # Sliding window: blocks entirely below every query's window
             # start also contribute nothing — total work is O(T·W).
-            lo = jnp.maximum(0, (qi * bq - (cfg.window - 1)) // bk)
+            lo = jnp.maximum(
+                0, (qi * bq + cfg.q_offset - (cfg.window - 1)) // bk)
 
     def body(j, carry):
         o, m, l = carry
@@ -120,7 +127,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
                                 preferred_element_type=jnp.float32)  # [bq, bk]
         s = s * cfg.scale
         if cfg.causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            qpos = (qi * bq + cfg.q_offset
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
             kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             bad = kpos > qpos
             if cfg.window is not None:
@@ -149,11 +157,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: _FlashCfg,
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     o, m, l = jax.lax.fori_loop(lo, nk, body, (o0, m0, l0))
-    o_ref[0, 0, :, :] = (o / l).astype(o_ref.dtype)
-    # Per-query logsumexp of the SCALED scores: the backward pass reuses it
-    # instead of re-sweeping Q.K^T (causal rows always hit the diagonal, so
-    # l > 0 here).
-    lse_ref[0, 0, :, :] = m + jnp.log(l)
+    if cfg.window is not None:
+        # With an offset window a whole q row (or the whole block: lo >=
+        # nk) can see NO key in this shard: emit a clean zero/-inf
+        # partial instead of 0/0 NaNs, so the ring's lse merge drops it.
+        empty = l == 0.0
+        o_ref[0, 0, :, :] = jnp.where(
+            empty, 0.0, o / jnp.where(empty, 1.0, l)).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = jnp.where(
+            empty, NEG_INF, m + jnp.log(jnp.where(empty, 1.0, l)))
+    else:
+        o_ref[0, 0, :, :] = (o / l).astype(o_ref.dtype)
+        # Per-query logsumexp of the SCALED scores: the backward pass
+        # reuses it instead of re-sweeping Q.K^T (causal rows always hit
+        # the diagonal, so l > 0 here).
+        lse_ref[0, 0, :, :] = m + jnp.log(l)
 
 
 def _flash_forward(cfg: _FlashCfg, q, k, v):
@@ -223,7 +241,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = s * cfg.scale
         p = jnp.exp(s - lse)            # [bq, bk] fp32
         if cfg.causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            qpos = (qi * bq + cfg.q_offset
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
             kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             bad = kpos > qpos
             if cfg.window is not None:
@@ -239,9 +258,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if cfg.causal:
         # Blocks strictly above the causal diagonal (or entirely below the
         # sliding window) contribute nothing.
-        live = j * bk <= (qi + 1) * bq - 1
+        live = j * bk <= (qi + 1) * bq - 1 + cfg.q_offset
         if cfg.window is not None:
-            live = live & ((j + 1) * bk - 1 >= qi * bq - (cfg.window - 1))
+            live = live & ((j + 1) * bk - 1
+                           >= qi * bq + cfg.q_offset - (cfg.window - 1))
         pl.when(live)(_step)
     else:
         _step()
@@ -280,7 +300,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = s * cfg.scale
         p = jnp.exp(s - lse)       # [bq, bk] fp32
         if cfg.causal:
-            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            qpos = (i * bq + cfg.q_offset
+                    + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
             kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             bad = kpos > qpos
             if cfg.window is not None:
@@ -299,9 +320,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if cfg.causal:
         # q-blocks strictly before the diagonal (or beyond the window's
         # reach of this k-block) see none of it.
-        live = (i + 1) * bq - 1 >= ki * bk
+        live = (i + 1) * bq - 1 + cfg.q_offset >= ki * bk
         if cfg.window is not None:
-            live = live & (i * bq <= (ki + 1) * bk - 1 + (cfg.window - 1))
+            live = live & (i * bq + cfg.q_offset
+                           <= (ki + 1) * bk - 1 + (cfg.window - 1))
         pl.when(live)(_step)
     else:
         _step()
@@ -910,8 +932,9 @@ def attend(q, k, v, mesh=None, causal: bool = True,
     if mesh is not None and "sp" in mesh.shape and mesh.shape["sp"] > 1:
         # Sliding windows compose with both sp paths: Ulysses attends the
         # full sequence after its all-to-all (window passes through to the
-        # kernel), and the ring's owner-index arithmetic bounds the window
-        # exactly across shards (einsum inner).
+        # kernel), and the ring bounds the window exactly across shards
+        # on either inner (Pallas via per-step q_offset kernels, einsum
+        # via owner-index masks).
         if sp_impl == "ulysses":
             from tfmesos_tpu.parallel.ulysses import ulysses_attention
             return ulysses_attention(q, k, v, mesh, causal=causal,

@@ -94,12 +94,13 @@ def ring_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
     return out.astype(q.dtype)
 
 
-def _flash_cfg(q, scale, causal, interpret):
+def _flash_cfg(q, scale, causal, interpret, window=None, q_offset=0):
     from tfmesos_tpu.ops import attention as A
     t = q.shape[1]
     return A._FlashCfg(causal=causal, scale=scale,
                        block_q=A._pick_block(t), block_k=A._pick_block(t),
-                       interpret=bool(interpret))
+                       interpret=bool(interpret), window=window,
+                       q_offset=int(q_offset))
 
 
 def _merge(o_acc, lse_acc, o_i, lse_i):
@@ -114,27 +115,45 @@ def _merge(o_acc, lse_acc, o_i, lse_i):
     return o_acc * w_a + o_i * w_i, lse_new
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash(q, k, v, axis, causal, scale, interpret):
-    return _ring_flash_fwd(q, k, v, axis, causal, scale, interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis, causal, scale, interpret, window):
+    return _ring_flash_fwd(q, k, v, axis, causal, scale, interpret,
+                           window)[0]
 
 
-def _ring_flash_fwd(q, k, v, axis, causal, scale, interpret):
+def _step_cfg(q, scale, causal, interpret, window, step):
+    """Ring step cfg: with a sliding window every step runs the CAUSAL
+    kernel with a static q_offset of step * shard_len — the same
+    global-position arithmetic the einsum inner uses, so far-behind
+    shards' k-blocks are SKIPPED by the kernel's window bound (O(T·W)
+    work across shards, not just within one).  Without a window, steps
+    past the first keep the full (causal=False) kernel and mask
+    invisible shards wholesale, as before."""
+    if window is None:
+        return _flash_cfg(q, scale, causal if step == 0 else False,
+                          interpret)
+    return _flash_cfg(q, scale, True, interpret, window=window,
+                      q_offset=step * q.shape[1])
+
+
+def _ring_flash_fwd(q, k, v, axis, causal, scale, interpret, window):
     from tfmesos_tpu.ops import attention as A
     sp = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     of = jnp.float32
 
-    o, lse = A._flash_forward(_flash_cfg(q, scale, causal, interpret),
-                              q, k, v)          # step 0: own shard, causal
+    o, lse = A._flash_forward(
+        _step_cfg(q, scale, causal, interpret, window, 0),
+        q, k, v)                            # step 0: own shard, causal
     o = o.astype(of)
-    cfg_full = _flash_cfg(q, scale, False, interpret)
     kr, vr = k, v
     for step in range(1, sp):
         kr = ppermute_shift(kr, axis, 1)
         vr = ppermute_shift(vr, axis, 1)
         src = (idx - step) % sp  # owner of the shard we now hold
-        o_i, lse_i = A._flash_forward(cfg_full, q, kr, vr)
+        o_i, lse_i = A._flash_forward(
+            _step_cfg(q, scale, causal, interpret, window, step), q, kr,
+            vr)
         if causal:
             visible = src < idx  # else: entirely in our future, masked
             lse_i = jnp.where(visible, lse_i, -jnp.inf)
@@ -146,7 +165,7 @@ def _ring_flash_fwd(q, k, v, axis, causal, scale, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd(axis, causal, scale, interpret, res, g):
+def _ring_flash_bwd(axis, causal, scale, interpret, window, res, g):
     """Re-rotate K/V and run the Mosaic backward per shard with the stored
     GLOBAL logsumexp (p = exp(s·scale − lse) is then already normalized over
     the full ring, so per-shard contributions just sum).  dk/dv accumulators
@@ -158,9 +177,8 @@ def _ring_flash_bwd(axis, causal, scale, interpret, res, g):
     idx = jax.lax.axis_index(axis)
 
     dq, dk, dv = A._mha_bwd_pallas(
-        _flash_cfg(q, scale, causal, interpret), q, k, v, out, lse, g,
-        out_dtype=jnp.float32)
-    cfg_full = _flash_cfg(q, scale, False, interpret)
+        _step_cfg(q, scale, causal, interpret, window, 0), q, k, v, out,
+        lse, g, out_dtype=jnp.float32)
     kr, vr = k, v
     for step in range(1, sp):
         kr = ppermute_shift(kr, axis, 1)
@@ -168,8 +186,9 @@ def _ring_flash_bwd(axis, causal, scale, interpret, res, g):
         dk = ppermute_shift(dk, axis, 1)
         dv = ppermute_shift(dv, axis, 1)
         src = (idx - step) % sp
-        dqc, dkc, dvc = A._mha_bwd_pallas(cfg_full, q, kr, vr, out, lse, g,
-                                          out_dtype=jnp.float32)
+        dqc, dkc, dvc = A._mha_bwd_pallas(
+            _step_cfg(q, scale, causal, interpret, window, step), q, kr,
+            vr, out, lse, g, out_dtype=jnp.float32)
         if causal:
             visible = (src < idx).astype(jnp.float32)
             dqc = dqc * visible
@@ -200,9 +219,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
 
     ``window`` (causal only): sliding-window attention, exact across
     shards — the owner-index arithmetic that bounds causal visibility
-    also bounds the window, per step.  Runs the einsum inner (the Mosaic
-    flash kernels have no cross-shard offset-window form, so
-    ``impl="flash"`` with a window is rejected).
+    also bounds the window, per step.  Both inners support it: the
+    Pallas ring runs every step's kernels with a static ``q_offset`` of
+    step x shard_len (the offset-window form), whose block bounds SKIP
+    k-blocks outside the window — O(T·W) work across the whole ring —
+    while the einsum inner masks by global position.
     """
     if impl not in (None, "flash", "xla"):
         raise ValueError(f"impl must be None, 'flash', or 'xla'; got {impl!r}")
@@ -212,22 +233,13 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
     if axis not in mesh.shape or mesh.shape[axis] == 1:
-        # Trivial-axis fallback: an ordinary single-device call, where the
-        # Pallas kernel handles windows natively (its q/k blocks share one
-        # global origin) — the offset-window limitation below is specific
-        # to the cross-shard ring inner.
+        # Trivial-axis fallback: an ordinary single-device call (the
+        # kernel's q/k blocks share one global origin: q_offset = 0).
         from tfmesos_tpu.ops.attention import flash_attention
         use_pallas = {None: None, "flash": True, "xla": False}[impl]
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                interpret=interpret, use_pallas=use_pallas,
                                window=window)
-    if window is not None:
-        if impl == "flash":
-            raise ValueError(
-                "ring_attention(impl='flash') does not support a sliding "
-                "window (the Mosaic inner kernels have no offset-window "
-                "form); use impl='xla' or impl=None")
-        impl = "xla"
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     local_t = q.shape[1] // mesh.shape[axis]
@@ -244,8 +256,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
                 f"{local_t} has no Mosaic-legal block tiling")
     spec = P(data_axes(mesh), axis, None, None)
     if impl == "flash":
-        body = lambda q_, k_, v_: _ring_flash(q_, k_, v_, axis, bool(causal),
-                                              float(scale), bool(interpret))
+        body = lambda q_, k_, v_: _ring_flash(
+            q_, k_, v_, axis, bool(causal), float(scale), bool(interpret),
+            None if window is None else int(window))
     else:
         body = lambda q_, k_, v_: ring_attention_local(
             q_, k_, v_, axis=axis, causal=causal, scale=scale, window=window)
