@@ -398,3 +398,53 @@ def test_run_on_duplicate_ranks_rejected():
         # The rejection happens before any send: the channel stays usable.
         assert [r["rank"] for r in c.run_on([1, 0], "support_funcs:ping", "x")] \
             == [1, 0]
+
+
+def test_heartbeat_revive_gated_on_offer_flow():
+    """The heartbeat revive backstop fires only on EVIDENCE the offer tap
+    is closed (advisor r4): while offers keep arriving (e.g. gang
+    scheduling's short declines) no revive churns the master's filters;
+    a silent heartbeat interval (or a failed revive POST) re-opens."""
+    s, b = _scheduler([Job(name="worker", num=1, cpus=4.0, mem=1024)])
+    s.on_offers([offer("small", cpus=1.0)])     # declined, task unplaced
+    base = b.revive_count
+    s.on_heartbeat()                            # offers flowed: no revive
+    assert b.revive_count == base
+    s.on_heartbeat()                            # silent interval: revive
+    assert b.revive_count == base + 1
+    s.on_offers([offer("small2", cpus=1.0)])    # flow resumes
+    s.on_heartbeat()
+    assert b.revive_count == base + 1
+
+
+def test_launch_dropped_when_task_reset_between_placement_and_launch():
+    """Advisor r4: a terminal status on another thread can reset() a
+    placed task between TaskInfo rendering (under the lock) and the
+    backend.launch call (outside it); the stale launch must be dropped —
+    injected deterministically via a decline callback that fires the
+    terminal status in the window."""
+
+    class RacingBackend(FakeBackend):
+        def decline(self, offer_, refuse_seconds=5.0):
+            super().decline(offer_, refuse_seconds)
+            if self.scheduler is not None and not self.raced:
+                self.raced = True
+                # The placed task's CURRENT id — exactly what a reaper
+                # thread would report a terminal state for.
+                tid = self.scheduler.tasks[0].id
+                self.scheduler.on_status(TaskStatus(tid, "TASK_FAILED"))
+
+    backend = RacingBackend()
+    backend.raced = False
+    s, b = _scheduler([Job(name="worker", num=1, cpus=4.0, mem=1024)],
+                      backend=backend)
+    # Offer A is useless (declined — the injection point); offer B fits.
+    s.on_offers([offer("useless", cpus=1.0), offer("fits", cpus=8.0)])
+    assert b.launched == []                     # stale launch dropped
+    assert ("fits", 1.0) in b.declined          # offer B given back
+    assert not s.tasks[0].offered               # re-queued for placement
+    assert s.task_failure_count == {"worker:0": 1}  # the injected failure
+    # The next good offer launches under the task's FRESH id.
+    s.on_offers([offer("retry", cpus=8.0)])
+    assert len(b.launched) == 1
+    assert b.launched[0][1] == [s.tasks[0].id]

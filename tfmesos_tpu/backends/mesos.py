@@ -401,7 +401,12 @@ class MesosBackend(ResourceBackend):
         self._call({"type": "SUPPRESS"})
 
     def revive(self) -> None:
-        self._call({"type": "REVIVE"})
+        # Raise on rejection: REVIVE is the liveness backstop's lever, and
+        # the scheduler's heartbeat gating only retries failures it can
+        # SEE (a silently-dropped 500 would close the offer tap for good).
+        status = self._call({"type": "REVIVE"})
+        if status not in (200, 202):
+            raise RuntimeError(f"REVIVE rejected: HTTP {status}")
 
     def kill(self, task_id: str) -> None:
         self._call({"type": "KILL", "kill": {"task_id": {"value": task_id}}})

@@ -135,6 +135,13 @@ class TPUMesosScheduler:
         self._broadcasting = False
         self._stopped = False
         self._fatal: Optional[str] = None
+        # Heartbeat-revive gating: the backstop only fires on EVIDENCE the
+        # offer tap is closed (a revive POST failed, or no offer arrived
+        # since the last heartbeat) — an unconditional ~15s revive would
+        # clear every decline filter and churn re-offers on a busy master
+        # while gang scheduling's short declines are deliberate.
+        self._revive_failed = False
+        self._offers_since_beat = False
         self.task_failure_count: Dict[str, int] = {}
         self.job_finished: Dict[str, int] = {}
         self._listen: Optional[socket.socket] = None
@@ -165,10 +172,7 @@ class TPUMesosScheduler:
             # Re-subscription after a stream break: a REVIVE issued while
             # the master was unreachable may have been lost, and FOREVER
             # decline filters survive failover — re-open the offer tap.
-            try:
-                self.backend.revive()
-            except Exception as e:
-                self.log.warning("re-registration revive failed: %s", e)
+            self._revive_backend("re-registration")
         version = info.get("master_version")
         if self.containerizer_type is None and version:
             # Reference semantics (scheduler.py:378-382): Mesos >= 1.0 uses
@@ -190,9 +194,10 @@ class TPUMesosScheduler:
         processing on the subscribe thread.
         """
         to_decline: List[tuple] = []        # (offer, refuse_seconds)
-        to_launch: List[tuple] = []         # (offer, infos, placed)
+        to_launch: List[tuple] = []         # (offer, infos, placed, ids)
         suppress = False
         with self._lock:
+            self._offers_since_beat = True
             if self._fatal or self._stopped:
                 to_decline = [(o, 5.0) for o in offers]
             elif all(task.offered for task in self.tasks):
@@ -216,15 +221,50 @@ class TPUMesosScheduler:
                                             secret_token=(self.token_transport
                                                           == "secret"))
                              for t in placed]
-                    to_launch.append((offer, infos, placed))
+                    to_launch.append((offer, infos, placed,
+                                      [t.id for t in placed]))
         if suppress:
             self.backend.suppress()
         for offer, refuse_seconds in to_decline:
             self.backend.decline(offer, refuse_seconds=refuse_seconds)
-        for offer, infos, placed in to_launch:
+        for offer, infos, placed, ids in to_launch:
+            with self._lock:
+                # A terminal status on another thread (LocalBackend's
+                # reaper) can reset() a placed task between rendering and
+                # this launch; launching the stale batch would spawn
+                # processes under ids the scheduler no longer tracks.
+                stale = [t for t, tid in zip(placed, ids) if t.id != tid]
+                if stale:
+                    for t, tid in zip(placed, ids):
+                        if t.id == tid and t.offered:
+                            # Un-place the still-valid batchmates (nothing
+                            # launched); the next offer re-places them.
+                            t.offered = False
+                            t.offer_id = t.agent_id = t.hostname = None
+            if stale:
+                self.log.warning(
+                    "dropping launch on %s: %d task(s) reset between "
+                    "placement and launch", offer.hostname, len(stale))
+                self.backend.decline(offer, refuse_seconds=1.0)
+                continue
             self.log.info("launching %d task(s) on %s: %s",
                           len(placed), offer.hostname, placed)
             self.backend.launch(offer, infos)
+            with self._lock:
+                # The reset can also race the launch call itself (the
+                # pre-check only narrows the window): a task reset DURING
+                # backend.launch leaves a process running under an id the
+                # scheduler no longer tracks — terminal statuses for
+                # unknown ids are ignored, so it would leak.  Kill it.
+                dead = [tid for t, tid in zip(placed, ids) if t.id != tid]
+            for tid in dead:
+                self.log.warning("task %s reset during launch; killing the "
+                                 "stale process", tid[:8])
+                try:
+                    self.backend.kill(tid)
+                except Exception as e:
+                    self.log.warning("stale-launch kill of %s failed: %s",
+                                     tid[:8], e)
 
     def _gang_fits(self, offers: List[Offer]) -> bool:
         """Would the *entire* remaining task set fit across this offer batch?"""
@@ -291,15 +331,11 @@ class TPUMesosScheduler:
                     task.reset()
                     revive = True
         if revive:
-            try:
-                self.backend.revive()
-            except Exception as e:
-                # Task state is already reset; a failed REVIVE POST (master
-                # unreachable) must not unwind the event thread.  The
-                # re-registration hook in on_registered re-issues it once
-                # the subscribe stream reconnects.
-                self.log.warning("revive call failed (will retry on "
-                                 "re-registration): %s", e)
+            # Task state is already reset; a failed REVIVE POST (master
+            # unreachable) must not unwind the event thread — the
+            # heartbeat backstop and the re-registration hook re-issue it
+            # (_revive_backend tracks the failure for them).
+            self._revive_backend("post-status")
 
     def on_rescind(self, offer_id: str) -> None:
         """An outstanding offer was withdrawn by the master.  Tasks placed
@@ -335,27 +371,41 @@ class TPUMesosScheduler:
             except Exception as e:
                 self.log.warning("rescind kill of %s failed: %s", tid[:8], e)
         if revive:
-            try:
-                self.backend.revive()
-            except Exception as e:
-                self.log.warning("revive call failed (heartbeat will "
-                                 "retry): %s", e)
+            self._revive_backend("rescind")
+
+    def _revive_backend(self, context: str) -> None:
+        """One revive POST with failure tracking: a failed POST arms the
+        heartbeat backstop (``on_heartbeat``) to retry."""
+        try:
+            self.backend.revive()
+            with self._lock:
+                self._revive_failed = False
+        except Exception as e:
+            with self._lock:
+                self._revive_failed = True
+            self.log.warning("%s revive failed: %s", context, e)
 
     def on_heartbeat(self) -> None:
         """Master heartbeat (~15s): the liveness backstop for a REVIVE
         that failed or was rejected while the subscribe stream stayed
         healthy — with FOREVER decline filters active after suppression,
         nothing else would ever re-open the offer tap for an unplaced
-        task (bring-up would idle into start_timeout)."""
+        task (bring-up would idle into start_timeout).
+
+        Gated on EVIDENCE the tap is closed: a prior revive POST failed,
+        or no offer arrived since the last heartbeat.  While offers are
+        flowing normally (gang scheduling's short declines included) an
+        unconditional revive would clear every decline filter ~15s and
+        spam re-offers on a busy master."""
         with self._lock:
             need = (not self._stopped and self._fatal is None
                     and not self.started
-                    and any(not t.offered for t in self.tasks))
+                    and any(not t.offered for t in self.tasks)
+                    and (self._revive_failed
+                         or not self._offers_since_beat))
+            self._offers_since_beat = False
         if need:
-            try:
-                self.backend.revive()
-            except Exception as e:
-                self.log.warning("heartbeat revive failed: %s", e)
+            self._revive_backend("heartbeat")
 
     def on_agent_lost(self, agent_id: str) -> None:
         """Reference slaveLost/executorLost (scheduler.py:445-453)."""
