@@ -202,9 +202,12 @@ def main():
         dt = time.perf_counter() - t0
         if sink is not sys.stdout:
             sink.close()
+        rate = batcher.acceptance_rate
+        spec_note = ("" if rate is None
+                     else f", draft acceptance {rate:.0%}")
         print(f"served {served} prompts continuously in {dt:.2f}s "
-              f"(peak pages {batcher.peak_pages_used}/{batcher.n_pages})",
-              file=sys.stderr)
+              f"(peak pages {batcher.peak_pages_used}/{batcher.n_pages}"
+              f"{spec_note})", file=sys.stderr)
         return 0
 
     alloc = pool = None

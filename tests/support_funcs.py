@@ -241,19 +241,22 @@ def _cb_workload():
     return cfg, params, reqs, kw
 
 
-def continuous_batching_mesh(ctx, axes):
+def continuous_batching_mesh(ctx, axes, overlap=False):
     """Multi-chip continuous batching across the cross-process mesh: every
     process runs the identical admission loop, decode rides the dp x tp
     sharded paged pool (shard-local page tables), and host-read tokens are
-    replicated — each process must yield the same completions."""
+    replicated — each process must yield the same completions.
+    ``overlap=True`` additionally double-buffers the decode dispatch."""
     import jax
     from tfmesos_tpu.parallel.mesh import build_mesh
     from tfmesos_tpu.serving import ContinuousBatcher
 
     cfg, params, reqs, kw = _cb_workload()
-    b = ContinuousBatcher(cfg, params, mesh=build_mesh(axes), **kw)
+    b = ContinuousBatcher(cfg, params, mesh=build_mesh(axes),
+                          overlap=overlap, **kw)
     done = {c.rid: c.tokens for c in b.run(reqs)}
     return {"process_count": jax.process_count(),
             "device_count": jax.device_count(),
             "tokens": {str(k): [int(t) for t in v]
                        for k, v in sorted(done.items())}}
+

@@ -186,11 +186,16 @@ def test_cross_process_continuous_batching():
                  start_timeout=180.0, env=env) as c:
         rs = c.run_all("support_funcs:continuous_batching_mesh",
                        {"dp": 2, "tp": 4})
+        # The overlap (double-buffered) loop over the SAME cross-process
+        # mesh: still lockstep, still the same tokens.
+        ov = c.run("support_funcs:continuous_batching_mesh",
+                   {"dp": 2, "tp": 4}, overlap=True)
     assert len(rs) == 2
     for r in rs:
         assert r["process_count"] == 2 and r["device_count"] == 8, r
     # Both processes run ONE global program — exact equality is required.
     assert rs[0]["tokens"] == rs[1]["tokens"]
+    assert ov["tokens"] == rs[0]["tokens"]
     # vs the single-host no-mesh batcher, tp=4's partial-sum order can
     # legitimately fork greedy argmax at float ties — use the
     # tie-tolerant comparator, like the in-process mesh tests.

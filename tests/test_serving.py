@@ -237,6 +237,10 @@ def test_speculative_batcher_matches_plain(setup, draft_setup,
         _assert_tokens_match_modulo_ties(
             cfg, params, None, reqs()[rid].prompt, got[rid], want[rid])
     assert spec.alloc.rows == {}
+    rate = spec.acceptance_rate
+    assert rate is not None and 0.0 <= rate <= 1.0
+    if perfect_draft:
+        assert rate == 1.0
     if perfect_draft:
         # Every proposal accepted: each round commits k+1 tokens per row,
         # so the whole stream needs far fewer rounds than tokens.
@@ -268,6 +272,9 @@ def test_speculative_perfect_draft_minimal_rounds(setup):
     assert done[0].tokens == _offline(cfg, params, req)
     # 1 token from prefill + ceil((max_new-1)/(k+1)) perfect rounds.
     assert rounds["n"] == -(-(max_new - 1) // (k + 1))
+    # A perfect draft accepts EVERY proposal: rate exactly 1.0 (the
+    # final round's quota truncation happens host-side, after commit).
+    assert b.acceptance_rate == 1.0
 
 
 def test_speculative_batcher_stop_token(setup, draft_setup):

@@ -503,8 +503,26 @@ class ContinuousBatcher:
             self._spec_round = self._make_spec_round()
             self._draft_chunk = self._make_draft_chunk()
         self._next_rid = 0
+        # Speculative observability (see acceptance_rate).
+        self.spec_rounds = 0        # jitted rounds executed
+        self.spec_row_rounds = 0    # row-rounds (rows decoding per round)
+        self.spec_committed = 0     # tokens committed across them
         if prefix_np is not None:
             self._init_prefix(prefix_np)
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        """Fraction of DRAFT proposals accepted: every row-round commits
+        its accepted run plus exactly one non-draft token (the
+        correction, or the bonus after a full accept), so accepted
+        drafts = committed - row_rounds over row_rounds x n_draft
+        opportunities.  1.0 = every proposal accepted (perfect draft);
+        0.0 = the draft never helped; None before any speculative round
+        ran (or without a draft)."""
+        if self.d_side is None or not self.spec_row_rounds:
+            return None
+        return ((self.spec_committed - self.spec_row_rounds)
+                / (self.spec_row_rounds * self.n_draft))
 
     # Back-compat accessors: the paged-side refactor (draft paging) moved
     # the target pool's state into ``t_side``; callers and tests keep the
@@ -1252,6 +1270,11 @@ class ContinuousBatcher:
             jnp.asarray(rids), jnp.asarray(steps))
         g = np.asarray(g)
         n_commit = np.asarray(n_commit)
+        # Observability: the acceptance rate is THE speculative-serving
+        # health number (a weak draft only costs rate, never correctness).
+        self.spec_rounds += 1
+        self.spec_committed += int(sum(int(n_commit[r]) for r in decoding))
+        self.spec_row_rounds += len(decoding)
         for r in list(decoding):
             row = active[r]
             emit = list(g[r, :int(n_commit[r])])
