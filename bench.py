@@ -404,11 +404,13 @@ def bench_attention_blocks(b=4, t=2048, h=8, d=128, reps=10):
     return {"bq512": timed(512), "bq1024": timed(1024)}
 
 
-def bench_ring_window(t=8192, window=1024, reps=10):
+def bench_ring_window(t=8192, window=1024, reps=10, interpret=False,
+                      h=8, d=128):
     """Ring attention with a sliding window across every visible device:
     the Pallas offset-window inner (per-step kernels skip k-blocks
     outside the window — O(T·W) work ring-wide) vs the einsum inner.
-    Needs >1 device (an sp axis); returns (flash_ms, einsum_ms) or None."""
+    Needs >1 device (an sp axis); returns (flash_ms, einsum_ms) or None.
+    ``interpret=True`` is the CI smoke path (Mosaic interpreter off-TPU)."""
     import jax
     import jax.numpy as jnp
     from tfmesos_tpu.parallel.mesh import build_mesh
@@ -418,7 +420,7 @@ def bench_ring_window(t=8192, window=1024, reps=10):
     if n < 2 or t % n:
         return None
     mesh = build_mesh({"sp": n})
-    b, h, d = 1, 8, 128
+    b = 1
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
     dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
@@ -428,7 +430,8 @@ def bench_ring_window(t=8192, window=1024, reps=10):
 
     def timed(impl):
         fn = jax.jit(lambda q_, k_, v_: ring_attention(
-            q_, k_, v_, mesh, causal=True, window=window, impl=impl))
+            q_, k_, v_, mesh, causal=True, window=window, impl=impl,
+            interpret=interpret))
         jax.block_until_ready(fn(q, k, v))       # compile
         best = float("inf")
         for _ in range(3):
@@ -442,27 +445,44 @@ def bench_ring_window(t=8192, window=1024, reps=10):
     return timed("flash"), timed("xla")
 
 
-def bench_serving_continuous(n_requests=32, rows=8):
-    """Continuous-batching serving throughput: requests/s for a prompt
-    stream admitted into a persistent paged decode
-    (serving.ContinuousBatcher), flagship config."""
+def _serving_bench_setup(tiny: bool):
+    """(cfg, params, reqs-maker, max_len) for the serving benches —
+    flagship config, or a CI-affordable tiny one."""
     import jax
     import jax.numpy as jnp
     from tfmesos_tpu.models import transformer
-    from tfmesos_tpu.serving import ContinuousBatcher, Request
+    from tfmesos_tpu.serving import Request
 
-    cfg = transformer.TransformerConfig(
-        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
-        max_seq_len=1024, dtype=jnp.bfloat16)
+    if tiny:
+        cfg = transformer.TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            max_seq_len=128, dtype=jnp.float32)
+        max_len, plen, new = 64, 8, 4
+    else:
+        cfg = transformer.TransformerConfig(
+            vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+            max_seq_len=1024, dtype=jnp.bfloat16)
+        max_len, plen, new = 1024, 64, 64
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
 
     def reqs(n):
-        return [Request(prompt=rng.integers(0, cfg.vocab_size, size=(64,))
-                        .astype(np.int32), max_new_tokens=64)
+        return [Request(prompt=rng.integers(0, cfg.vocab_size, size=(plen,))
+                        .astype(np.int32), max_new_tokens=new)
                 for _ in range(n)]
 
-    batcher = ContinuousBatcher(cfg, params, rows=rows, max_len=1024)
+    return cfg, params, reqs, max_len
+
+
+def bench_serving_continuous(n_requests=32, rows=8, tiny=False):
+    """Continuous-batching serving throughput: requests/s for a prompt
+    stream admitted into a persistent paged decode
+    (serving.ContinuousBatcher) — flagship config, or the tiny CI smoke
+    config with ``tiny=True``."""
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    cfg, params, reqs, max_len = _serving_bench_setup(tiny)
+    batcher = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len)
     list(batcher.run(reqs(2)))  # warm the compiles outside the timed region
     t0 = time.perf_counter()
     done = list(batcher.run(reqs(n_requests)))
@@ -473,7 +493,7 @@ def bench_serving_continuous(n_requests=32, rows=8):
     # Overlap mode: tick t+1 dispatched before tick t's tokens sync —
     # the win is one host round-trip per generated token, which through
     # this environment's relay is the dominant serving cost.
-    ob = ContinuousBatcher(cfg, params, rows=rows, max_len=1024,
+    ob = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len,
                            overlap=True)
     list(ob.run(reqs(2)))
     t0 = time.perf_counter()
@@ -482,38 +502,26 @@ def bench_serving_continuous(n_requests=32, rows=8):
     return n_requests / dt, mean_ttft_ms, overlap_rps
 
 
-def bench_serving_continuous_mesh(n_requests=32, rows=8):
+def bench_serving_continuous_mesh(n_requests=32, rows=8, tiny=False):
     """Multi-chip continuous serving: the same stream through a dp x tp
     mesh over every visible device (pool pages sharded over dp, heads
     over tp) — requests/s should scale with dp on real slices.  Its own
     bench section so a mesh failure cannot discard the single-device
     serving numbers."""
     import jax
-    import jax.numpy as jnp
-    from tfmesos_tpu.models import transformer
     from tfmesos_tpu.parallel.mesh import build_mesh
-    from tfmesos_tpu.serving import ContinuousBatcher, Request
+    from tfmesos_tpu.serving import ContinuousBatcher
 
     n = jax.device_count()
     if n < 2:
         return None
-    cfg = transformer.TransformerConfig(
-        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
-        max_seq_len=1024, dtype=jnp.bfloat16)
-    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-    rng = np.random.default_rng(0)
-
-    def reqs(k):
-        return [Request(prompt=rng.integers(0, cfg.vocab_size, size=(64,))
-                        .astype(np.int32), max_new_tokens=64)
-                for _ in range(k)]
-
+    cfg, params, reqs, max_len = _serving_bench_setup(tiny)
     tp = 2 if cfg.n_heads % 2 == 0 and n % 2 == 0 else 1
     dp = n // tp
     mesh = build_mesh({"dp": dp, "tp": tp},
                       devices=jax.devices()[:dp * tp])
     mrows = -(-rows // dp) * dp         # smallest multiple of dp >= rows
-    mb = ContinuousBatcher(cfg, params, rows=mrows, max_len=1024,
+    mb = ContinuousBatcher(cfg, params, rows=mrows, max_len=max_len,
                            mesh=mesh)
     list(mb.run(reqs(2)))   # warm the compiles outside the timed region
     t0 = time.perf_counter()

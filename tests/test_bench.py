@@ -69,3 +69,23 @@ def test_decode_long_context_bench_smoke():
         batch=1, max_len=512, prompt_len=32, new_tokens=4)
     assert np.isfinite(kern) and kern > 0
     assert np.isfinite(einsum) and einsum > 0
+
+
+def test_serving_bench_smoke():
+    rps, ttft_ms, overlap_rps = bench.bench_serving_continuous(
+        n_requests=3, rows=2, tiny=True)
+    assert rps > 0 and ttft_ms > 0 and overlap_rps > 0
+
+
+def test_serving_mesh_bench_smoke():
+    rps = bench.bench_serving_continuous_mesh(n_requests=3, rows=2,
+                                              tiny=True)
+    assert rps is not None and rps > 0   # 8 virtual devices: dp x tp ran
+
+
+def test_ring_window_bench_smoke():
+    out = bench.bench_ring_window(t=64, window=16, reps=1, interpret=True,
+                                  h=2, d=16)
+    assert out is not None
+    flash_ms, xla_ms = out
+    assert flash_ms > 0 and xla_ms > 0
