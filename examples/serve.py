@@ -77,9 +77,6 @@ def main():
     if args.overlap and not args.continuous:
         p.error("--overlap is a continuous-batching feature; "
                 "add --continuous")
-    if args.overlap and args.speculative:
-        p.error("--overlap does not compose with --speculative "
-                "(speculative commit counts are decided on device)")
     if args.paged and args.continuous:
         p.error("--paged and --continuous are distinct serving modes: "
                 "--continuous already serves from a paged pool (pick one)")
@@ -155,9 +152,13 @@ def main():
         # -1 in spec mode: the draft's backfill step writes one past the
         # proposals (ContinuousBatcher's depth check).
         ml = cfg.max_seq_len - (nd + 1 if nd else 0)
-        # Overlap + stop: a stop surfaces one tick late, so admission
-        # reserves one extra cache position past the stop.
-        ov = 1 if args.overlap and args.stop_token is not None else 0
+        # Overlap endings surface late, so admission reserves extra cache
+        # positions: a full overshoot round in speculative mode, one
+        # position for a plain stop.
+        ov = 0
+        if args.overlap:
+            ov = ((nd + 1) if args.speculative
+                  else (1 if args.stop_token is not None else 0))
         climit = min((ml - nd - ov) // bucket * bucket,
                      ml - nd - ov - args.new_tokens + 1)
         if any(len(t) > climit for t in prompts):

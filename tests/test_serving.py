@@ -505,17 +505,21 @@ def test_mesh_batcher_token_identical(mesh_setup, axes, variant):
 
 @pytest.mark.parametrize("variant", [
     "base", "staggered", "stop", "sampled", "chunked", "prefix", "mesh",
+    "spec", "spec_sampled", "spec_stop",
 ])
-def test_overlap_batcher_token_identical(setup, mesh_setup, variant):
+def test_overlap_batcher_token_identical(setup, mesh_setup, draft_setup,
+                                         variant):
     """overlap=True (tick t+1 dispatched before tick t's host sync) must
     produce IDENTICAL token streams to the plain batcher across the
     matrix — stop tokens act one tick late but the overshoot tick's
-    output is discarded, sampled keys are unchanged, and the mesh path
-    composes."""
+    output is discarded, sampled keys are unchanged, the mesh path
+    composes, and SPECULATIVE rounds carry token/position/step on
+    device (commit counts never round-trip before the next dispatch)."""
     if variant == "mesh":
         cfg, params, _, _ = mesh_setup
     else:
         cfg, params = setup
+    dcfg, dparams = draft_setup
     rng = np.random.RandomState(67)
     prompts = [rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
                for n in (3, 8, 13, 19, 16, 5)]
@@ -531,7 +535,14 @@ def test_overlap_batcher_token_identical(setup, mesh_setup, variant):
                                      size=13).astype(np.int32))
     elif variant == "mesh":
         kw.update(mesh=_mesh({"dp": 2, "tp": 2}))
-    elif variant == "stop":
+    elif variant == "spec":
+        kw.update(draft_cfg=dcfg, draft_params=dparams, n_draft=3)
+    elif variant == "spec_sampled":
+        kw.update(draft_cfg=dcfg, draft_params=dparams, n_draft=3,
+                  temperature=0.8, top_k=20, rng=jax.random.PRNGKey(9))
+    elif variant in ("stop", "spec_stop"):
+        if variant == "spec_stop":
+            kw.update(draft_cfg=dcfg, draft_params=dparams, n_draft=4)
         # Find a token each prompt actually emits so stops trigger.
         probe = ContinuousBatcher(cfg, params, **kw)
         outs = {c.rid: c.tokens for c in probe.run(mk())}
@@ -564,13 +575,24 @@ def test_overlap_batcher_token_identical(setup, mesh_setup, variant):
         assert side.alloc.rows == {}        # nothing leaked
 
 
-def test_overlap_rejects_speculative(setup, draft_setup):
+def test_overlap_speculative_perfect_draft(setup):
+    """overlap x speculative with a PERFECT draft: acceptance rate is
+    exactly 1.0 and outputs equal the offline reference — the
+    device-carried position/step stream stays consistent through full
+    (k+1)-token commits round after round."""
     cfg, params = setup
-    dcfg, dparams = draft_setup
-    with pytest.raises(ValueError, match="overlap=True does not compose"):
-        ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
-                          overlap=True, draft_cfg=dcfg,
-                          draft_params=dparams)
+    b = ContinuousBatcher(cfg, params, rows=1, max_len=64, page_size=16,
+                          prefill_bucket=16, draft_cfg=cfg,
+                          draft_params=params, n_draft=3, overlap=True)
+    req = Request(prompt=_prompts(cfg, 1, seed=61)[0], max_new_tokens=13)
+    done = list(b.run([req]))
+    assert done[0].tokens == _offline(cfg, params, req)
+    assert b.acceptance_rate == 1.0
+    # Exactly the minimal retired-round count — the overshoot dispatch
+    # (issued before the quota finish surfaced) must never be retired
+    # into the counters.
+    assert b.spec_rounds == -(-(13 - 1) // (3 + 1))
+    assert b.alloc.rows == {}
 
 
 def test_mesh_batcher_validation(mesh_setup):

@@ -332,8 +332,12 @@ class ContinuousBatcher:
     serving cost when dispatch latency is high.  Stop tokens and
     admission act one tick late (a stopped row's extra tick writes one
     reserved position past the stop and is discarded); token streams
-    are identical to ``overlap=False``.  Not composable with
-    speculative decoding (commit counts are decided on device).
+    are identical to ``overlap=False``.  Composes with SPECULATIVE
+    decoding: continuing rows' token/position/step ride on device
+    (commit counts are computed in-graph), the host's view lags one
+    retire behind for page backing, and ANY ending — quota included —
+    surfaces one round late with the overshoot round's up-to-
+    ``n_draft+1`` extra positions reserved per row.
 
     ``mesh`` (optional) makes the WHOLE serving loop multi-chip: a
     data (dp/fsdp) x tp ``jax.sharding.Mesh`` — possibly spanning
@@ -375,15 +379,13 @@ class ContinuousBatcher:
                  overlap: bool = False):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
-        if overlap and draft_cfg is not None:
-            raise ValueError(
-                "overlap=True does not compose with speculative decoding "
-                "yet: a speculative tick's commit count (and therefore "
-                "every row's next position) is decided on device, so the "
-                "host cannot pre-build tick t+1's tables without syncing "
-                "tick t")
         self.overlap = bool(overlap)
-        self._inflight = None   # overlap mode: (device nxt, {row: rid})
+        # Overlap mode: (device outputs of the in-flight dispatch,
+        # {row: rid} ticket).  Speculative overlap additionally carries
+        # the device-side (positions, steps) the next round continues
+        # from — commit counts are decided in-graph, so the host's
+        # row.pos/step view lags one retire behind.
+        self._inflight = None
         self.cfg = cfg
         self.params = params
         self.rows = rows
@@ -730,9 +732,8 @@ class ContinuousBatcher:
             return jax.random.fold_in(jax.random.fold_in(self._rng, rid),
                                       s)
 
-        @partial(jax.jit, donate_argnums=(1, 3))
-        def fn(params, pool, dparams, dpool, table, dtable, toks,
-               positions, rids, steps):
+        def body(params, pool, dparams, dpool, table, dtable, toks,
+                 positions, rids, steps):
             b = toks.shape[0]
 
             def dstep(carry, j):
@@ -771,8 +772,7 @@ class ContinuousBatcher:
             pool_out = {"k": cache["k"], "v": cache["v"]}
             if not sampling:
                 g = jnp.argmax(lg, -1).astype(jnp.int32)    # [rows, k+1]
-                return (pool_out, dpool, self._host_read(g),
-                        self._host_read(greedy_accept_counts(drafts, g)))
+                return pool_out, dpool, g, greedy_accept_counts(drafts, g)
 
             pd = jnp.moveaxis(pd, 0, 1)[:, :k]              # [rows, k, V]
             pt = jax.nn.softmax(filter_logits(lg, T, tk_, tp_), -1)
@@ -793,10 +793,45 @@ class ContinuousBatcher:
             cand = jnp.concatenate(
                 [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
             vals = jnp.where(j == a[:, None], repl[:, None], cand)
-            return (pool_out, dpool, self._host_read(vals),
-                    self._host_read(a + 1))
+            return pool_out, dpool, vals, a + 1
 
-        return fn
+        if not self.overlap:
+            @partial(jax.jit, donate_argnums=(1, 3))
+            def fn(params, pool, dparams, dpool, table, dtable, toks,
+                   positions, rids, steps):
+                pool_out, dpool_out, g, counts = body(
+                    params, pool, dparams, dpool, table, dtable, toks,
+                    positions, rids, steps)
+                return (pool_out, dpool_out, self._host_read(g),
+                        self._host_read(counts))
+
+            return fn
+
+        # Overlap variant: rows that were in the PREVIOUS round continue
+        # from its DEVICE outputs — the last committed token is
+        # prev_g[r, prev_nc-1], and positions/steps advance by prev_nc,
+        # all computed in-graph (commit counts never round-trip to the
+        # host before the next dispatch).  Freshly admitted rows take
+        # host values; the merged positions/steps return as the carry
+        # for round t+1.
+        @partial(jax.jit, donate_argnums=(1, 3))
+        def fn_ov(params, pool, dparams, dpool, table, dtable, toks,
+                  positions, rids, steps, use_dev, prev_g, prev_nc,
+                  prev_pos, prev_steps):
+            last_idx = jnp.maximum(prev_nc - 1, 0)
+            dev_tok = jnp.take_along_axis(prev_g, last_idx[:, None],
+                                          axis=1)[:, 0]
+            toks = jnp.where(use_dev, dev_tok, toks)
+            positions = jnp.where(use_dev, prev_pos + prev_nc, positions)
+            steps = jnp.where(use_dev, prev_steps + prev_nc, steps)
+            pool_out, dpool_out, g, counts = body(
+                params, pool, dparams, dpool, table, dtable, toks,
+                positions, rids, steps)
+            return (pool_out, dpool_out, self._host_read(g),
+                    self._host_read(counts), self._host_read(positions),
+                    self._host_read(steps))
+
+        return fn_ov
 
     def _make_draft_chunk(self):
         """Jitted DRAFT prompt writer over the draft's paged pool: serves
@@ -893,11 +928,19 @@ class ContinuousBatcher:
             # (k+1)-token chunk: its writes overshoot by up to n_draft
             # (and the draft's k+1 scan steps write the same positions).
             need_len += self.n_draft
-        if self.overlap and req.stop_token is not None:
-            # A stop is detected one tick late: the already-dispatched
-            # extra tick writes one position past the stop (quota
-            # endings are host-predicted and never overshoot).
-            need_len += 1
+        if self.overlap:
+            if self.draft_cfg is not None:
+                # Speculative overlap: ANY ending (quota included —
+                # commit counts are decided on device) surfaces one
+                # ROUND late, and the overshoot round writes up to
+                # n_draft+1 positions past the end.
+                need_len += self.n_draft + 1
+            elif req.stop_token is not None:
+                # A stop is detected one tick late: the already-
+                # dispatched extra tick writes one position past the
+                # stop (quota endings are host-predicted and never
+                # overshoot).
+                need_len += 1
         if need_len > self.max_len:
             raise ValueError(
                 f"request needs {need_len} cache positions (prefix "
@@ -1015,7 +1058,10 @@ class ContinuousBatcher:
                         self._finish(done_row, active, free_rows)
                         yield done
                 if any(row.decoding for row in active.values()):
-                    if self.draft_cfg is not None:
+                    if self.draft_cfg is not None and self.overlap:
+                        yield from self._step_spec_overlap(active,
+                                                           free_rows)
+                    elif self.draft_cfg is not None:
                         yield from self._step_spec(active, free_rows)
                     elif self.overlap:
                         yield from self._step_overlap(active, free_rows)
@@ -1275,11 +1321,20 @@ class ContinuousBatcher:
         self.spec_rounds += 1
         self.spec_committed += int(sum(int(n_commit[r]) for r in decoding))
         self.spec_row_rounds += len(decoding)
-        for r in list(decoding):
+        yield from self._commit_rows(g, n_commit, list(decoding), active,
+                                     free_rows)
+
+    def _commit_rows(self, g, nc, rows, active: Dict[int, _Row],
+                     free_rows: List[int]) -> Iterator[Completion]:
+        """Commit one speculative round's outputs to ``rows`` — ONE code
+        path for the sync (_step_spec) and overlap (_retire_spec) loops,
+        so their truncation/finish semantics cannot diverge.  Quota and
+        stop truncation: either way the row FINISHES, so the committed-
+        stream/cache (and overlap device-carry) consistency question is
+        moot."""
+        for r in rows:
             row = active[r]
-            emit = list(g[r, :int(n_commit[r])])
-            # Quota and stop truncation: either way the row FINISHES, so
-            # the committed-stream/cache consistency question is moot.
+            emit = list(g[r, :int(nc[r])])
             remaining = row.req.max_new_tokens - row.step
             emit = emit[:remaining]
             if row.req.stop_token is not None and \
@@ -1296,6 +1351,76 @@ class ContinuousBatcher:
                 done = self._completion(row)
                 self._finish(r, active, free_rows)
                 yield done
+
+    def _step_spec_overlap(self, active: Dict[int, _Row],
+                           free_rows: List[int]) -> Iterator[Completion]:
+        """One OVERLAP speculative round: dispatch round t WITHOUT
+        syncing round t-1 — continuing rows' token/position/step carry
+        on device (commit counts are computed in-graph), the host's
+        row.pos/step view lags one retire behind and only backs pages
+        (worst case: the un-retired round advanced n_draft+1 and this
+        round writes n_draft+1 more).  Endings (stop AND quota — counts
+        are device-decided) surface one round late; the overshoot
+        round's output is dropped by the rid-checked ticket and its
+        writes land in the row's reserved overshoot pages / the sink."""
+        k1 = self.n_draft + 1
+        dispatch = {r: row for r, row in active.items()
+                    if row.decoding and row.step < row.req.max_new_tokens}
+        prev = self._inflight
+        if dispatch:
+            toks = np.zeros((self.rows,), np.int32)
+            positions = np.full((self.rows,), self.max_len, np.int32)
+            steps = np.zeros((self.rows,), np.int32)
+            rids = np.zeros((self.rows,), np.int32)
+            use_dev = np.zeros((self.rows,), bool)
+            prev_ticket = {} if prev is None else prev[4]
+            for r, row in dispatch.items():
+                self._ensure_sides(r, min(row.pos + 2 * k1, self.max_len))
+                if prev_ticket.get(r) == row.rid:
+                    use_dev[r] = True   # continue from device carry
+                else:
+                    toks[r] = row.last
+                    positions[r] = row.pos
+                    steps[r] = row.step
+                rids[r] = row.rid
+            table = self.t_side.decode_table(active, dispatch)
+            dtable = self.d_side.decode_table(active, dispatch)
+            if prev is None:
+                z = jnp.zeros((self.rows,), jnp.int32)
+                carry = (jnp.zeros((self.rows, k1), jnp.int32), z, z, z)
+            else:
+                carry = prev[:4]
+            (self.pool, self.d_side.pool, g, nc, pos_d,
+             steps_d) = self._spec_round(
+                self.params, self.pool, self.draft_params,
+                self.d_side.pool, table, dtable, jnp.asarray(toks),
+                jnp.asarray(positions), jnp.asarray(rids),
+                jnp.asarray(steps), jnp.asarray(use_dev), *carry)
+            self._inflight = (g, nc, pos_d, steps_d,
+                              {r: row.rid for r, row in dispatch.items()})
+        else:
+            self._inflight = None
+        if prev is not None:
+            yield from self._retire_spec(prev, active, free_rows)
+
+    def _retire_spec(self, inflight, active: Dict[int, _Row],
+                     free_rows: List[int]) -> Iterator[Completion]:
+        """Sync ONE overlap speculative round (a round behind the
+        newest) and do its token-dependent bookkeeping — the same commit
+        semantics as _step_spec, rid-gated so a finished row's overshoot
+        round is dropped.  Truncation (quota or stop) only ever happens
+        on a FINISHING row, so continuing rows advance by exactly the
+        device-side commit count and the host view stays consistent
+        with the in-graph position/step carry."""
+        g, nc, _, _, ticket = inflight
+        g = np.asarray(g)       # host sync: one round behind dispatch
+        nc = np.asarray(nc)
+        live = [r for r, rid in ticket.items()
+                if r in active and active[r].rid == rid]
+        self.spec_rounds += 1
+        self.spec_row_rounds += len(live)
+        self.spec_committed += int(sum(int(nc[r]) for r in live))
+        yield from self._commit_rows(g, nc, live, active, free_rows)
 
     def _completion(self, row: _Row) -> Completion:
         now = time.perf_counter()
