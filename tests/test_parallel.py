@@ -451,6 +451,59 @@ def test_transformer_train_step_1f1b_interleaved():
             rtol=2e-4, atol=1e-5, err_msg=key)
 
 
+@pytest.mark.parametrize("axes,n_experts,kv_heads", [
+    ({"pp": 2, "sp": 2, "dp": 2}, 0, None),
+    ({"pp": 2, "sp": 4}, 0, 2),             # GQA broadcast in the sp form
+    ({"pp": 2, "sp": 2, "ep": 2}, 2, None),  # MoE aux pmean'd over sp
+])
+def test_pipeline_sp_stages_match_reference(axes, n_experts, kv_heads):
+    """pp x sp: the SEQUENCE shards over sp inside pipeline stages (ring
+    attention under gpipe's lockstep ticks, K/V all_gather under 1F1B's
+    divergent branches — a ppermute's global participant set would
+    deadlock there), with global rope positions and an sp-reduced loss
+    tail.  Both schedules' loss and grads match: gpipe vs the non-pp
+    reference, 1F1B vs gpipe on the same mesh (the MoE aux estimator is
+    per-shard under sp, so same-mesh comparison is the exact one)."""
+    from tfmesos_tpu.models import transformer
+
+    n = 1
+    for s in axes.values():
+        n *= s
+    mesh = build_mesh(axes, devices=jax.devices()[:n])
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+        n_kv_heads=kv_heads, d_ff=64, max_seq_len=32, dtype=jnp.float32,
+        n_experts=n_experts, top_k=1 if n_experts else 0)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    b = 4 * axes.get("dp", 1)
+    toks = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(b, 33)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(toks)}
+
+    gp_l, gp_g = jax.jit(jax.value_and_grad(
+        lambda p: transformer.loss_fn(cfg, p, batch, mesh)[0]))(params)
+    if not n_experts:
+        # Dense: gpipe x sp equals the meshless reference exactly.
+        ref_l, ref_g = jax.value_and_grad(
+            lambda p: transformer.loss_fn(cfg, p, batch)[0])(params)
+        np.testing.assert_allclose(float(gp_l), float(ref_l), rtol=1e-5)
+        for a, b_ in zip(jax.tree_util.tree_leaves(gp_g),
+                         jax.tree_util.tree_leaves(ref_g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=1e-5)
+
+    f_l, f_g = jax.jit(lambda p, bt: transformer.train_step_1f1b(
+        cfg, p, bt, mesh))(params, batch)
+    np.testing.assert_allclose(float(f_l), float(gp_l), rtol=1e-5)
+    for key, a, b_ in zip(
+            [jax.tree_util.keystr(k) for k, _ in
+             jax.tree_util.tree_flatten_with_path(f_g)[0]],
+            jax.tree_util.tree_leaves(f_g),
+            jax.tree_util.tree_leaves(gp_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=1e-5, err_msg=key)
+
+
 def test_pipeline_1f1b_validation():
     from tfmesos_tpu.parallel.pipeline import pipeline_train_1f1b
 
@@ -562,9 +615,9 @@ def test_transformer_train_step_1f1b_validation():
         max_seq_len=16, dtype=jnp.float32)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     batch = {"tokens": jnp.zeros((4, 17), jnp.int32)}
-    with pytest.raises(ValueError, match="pp x tp x ep x dp/fsdp"):
-        transformer.train_step_1f1b(cfg, params, batch,
-                                    build_mesh({"pp": 4, "sp": 2}))
+    with pytest.raises(ValueError, match="1f1b x sp x tp"):
+        transformer.train_step_1f1b(
+            cfg, params, batch, build_mesh({"pp": 2, "sp": 2, "tp": 2}))
     switch = transformer.TransformerConfig(
         vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
         max_seq_len=16, dtype=jnp.float32, n_experts=2, top_k=1,
@@ -988,3 +1041,27 @@ def test_transformer_sp_ulysses_matches_single_device():
     got = jax.jit(lambda p, t: tf_m.forward(cfg, p, t, mesh))(params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_sp_keeps_switch_moe_sequence_replicated():
+    """Switch MoE's capacity dropping is a FULL-sequence competition:
+    under pp x sp the sequence must stay replicated (sp inert), keeping
+    outputs identical to the sp=1 mesh rather than deciding drops per
+    T/sp shard."""
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, n_experts=2, top_k=1,
+        moe_impl="switch")
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    toks = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(4, 33)).astype(np.int32)
+    batch = {"tokens": jnp.asarray(toks)}
+    mesh_sp = build_mesh({"pp": 2, "sp": 2, "dp": 2})
+    mesh_1 = build_mesh({"pp": 2, "dp": 2}, devices=jax.devices()[:4])
+    l_sp, _ = jax.jit(lambda p: transformer.loss_fn(
+        cfg, p, batch, mesh_sp))(params)
+    l_1, _ = jax.jit(lambda p: transformer.loss_fn(
+        cfg, p, batch, mesh_1))(params)
+    np.testing.assert_allclose(float(l_sp), float(l_1), rtol=1e-6)

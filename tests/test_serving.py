@@ -505,7 +505,7 @@ def test_mesh_batcher_token_identical(mesh_setup, axes, variant):
 
 @pytest.mark.parametrize("variant", [
     "base", "staggered", "stop", "sampled", "chunked", "prefix", "mesh",
-    "spec", "spec_sampled", "spec_stop",
+    "spec", "spec_sampled", "spec_stop", "spec_mesh",
 ])
 def test_overlap_batcher_token_identical(setup, mesh_setup, draft_setup,
                                          variant):
@@ -515,11 +515,11 @@ def test_overlap_batcher_token_identical(setup, mesh_setup, draft_setup,
     output is discarded, sampled keys are unchanged, the mesh path
     composes, and SPECULATIVE rounds carry token/position/step on
     device (commit counts never round-trip before the next dispatch)."""
-    if variant == "mesh":
-        cfg, params, _, _ = mesh_setup
+    if variant in ("mesh", "spec_mesh"):
+        cfg, params, dcfg, dparams = mesh_setup
     else:
         cfg, params = setup
-    dcfg, dparams = draft_setup
+        dcfg, dparams = draft_setup
     rng = np.random.RandomState(67)
     prompts = [rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
                for n in (3, 8, 13, 19, 16, 5)]
@@ -535,6 +535,9 @@ def test_overlap_batcher_token_identical(setup, mesh_setup, draft_setup,
                                      size=13).astype(np.int32))
     elif variant == "mesh":
         kw.update(mesh=_mesh({"dp": 2, "tp": 2}))
+    elif variant == "spec_mesh":
+        kw.update(mesh=_mesh({"dp": 2, "tp": 2}), draft_cfg=dcfg,
+                  draft_params=dparams, n_draft=3)
     elif variant == "spec":
         kw.update(draft_cfg=dcfg, draft_params=dparams, n_draft=3)
     elif variant == "spec_sampled":

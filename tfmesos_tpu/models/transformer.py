@@ -489,8 +489,48 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     return x + red(ffn), _zero_aux()
 
 
+def _sp_gather_attention(cfg: TransformerConfig, q, k, v, axis: str):
+    """Sequence-parallel attention by K/V all_gather: the local q shard
+    attends the FULL gathered sequence with global-position masks.
+
+    This is the sp form for stage bodies that run inside DIVERGENT
+    control flow (the 1F1B tick's ``lax.switch``): an all_gather lowers
+    to a SUBGROUP collective over the sp group — like the tp psums the
+    fused schedule already runs in branches — whereas the einsum ring's
+    ``ppermute`` lowers with a global participant set and deadlocks
+    when pipeline stages take different branches.  Trades the ring's
+    overlapped O(T/sp) K/V residency for one gather; q/dq stay sharded
+    and the all_gather transposes to a reduce_scatter, so in-body vjp
+    sums per-shard dK/dV contributions exactly once."""
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    tq = q.shape[1]
+    kg = jax.lax.all_gather(k, axis, axis=1, tiled=True)    # [B, T, H, D]
+    vg = jax.lax.all_gather(v, axis, axis=1, tiled=True)
+    tk = kg.shape[1]
+    idx = jax.lax.axis_index(axis)
+    qpos = idx * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   kg.astype(jnp.float32))
+    bad = kpos > qpos
+    if cfg.window is not None:
+        bad = bad | (kpos < qpos - (cfg.window - 1))
+    s = jnp.where(bad[None, None], float("-inf"), s)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
 def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
-           ep_axis: Optional[str] = None, inbody_ad: bool = False):
+           ep_axis: Optional[str] = None, inbody_ad: bool = False,
+           sp_axis: Optional[str] = None):
+    """One transformer block.  ``sp_axis`` selects the MANUAL
+    sequence-parallel form for use inside a pipeline stage's shard_map
+    body (a nested shard_map is not allowed there): activations arrive
+    as local sequence shards and ``positions`` must already be GLOBAL.
+    Attention runs the einsum ring (``ring_attention_local``) under
+    outer AD, or the K/V-gather form under ``inbody_ad`` (the 1F1B
+    tick's branches — see ``_sp_gather_attention``)."""
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
@@ -498,12 +538,27 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
     v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.kv_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    # GQA (kv_heads < n_heads) flows through attend() at kv width: the
-    # flash kernels map q head h -> kv head h // (H/KV) in their index
-    # maps, so training never materializes the repeated K/V; the sp impls
-    # broadcast up internally.
-    o = attend(q, k, v, mesh=mesh, causal=True, sp_impl=cfg.sp_impl,
-               window=cfg.window)
+    if sp_axis is not None:
+        if cfg.kv_heads != cfg.n_heads:
+            # The manual sp forms match q/k head-for-head; broadcast
+            # GQA's narrow K/V up (the local shard is T/sp long — cheap).
+            g = cfg.n_heads // cfg.kv_heads
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
+        if inbody_ad:
+            o = _sp_gather_attention(cfg, q, k, v, sp_axis)
+        else:
+            from tfmesos_tpu.parallel.ring_attention import (
+                ring_attention_local)
+            o = ring_attention_local(q, k, v, axis=sp_axis, causal=True,
+                                     window=cfg.window)
+    else:
+        # GQA (kv_heads < n_heads) flows through attend() at kv width:
+        # the flash kernels map q head h -> kv head h // (H/KV) in their
+        # index maps, so training never materializes the repeated K/V;
+        # the sp impls broadcast up internally.
+        o = attend(q, k, v, mesh=mesh, causal=True, sp_impl=cfg.sp_impl,
+                   window=cfg.window)
     x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, aux = _ffn(cfg, mesh, lp, h, ep_axis=ep_axis, inbody_ad=inbody_ad)
@@ -557,6 +612,18 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
         # not allowed inside the pipeline's own shard_map.
         ep = mesh.shape.get("ep", 1)
         ep_axis = "ep" if (cfg.n_experts and ep > 1) else None
+        # pp x sp: shard the SEQUENCE over sp inside stages — the
+        # einsum-ring attention of _block(sp_axis=...) with global rope
+        # positions.  tp stages keep the old sequence-replicated layout
+        # (their manual blocks have no sp form), as do a sequence that
+        # does not divide over sp and switch MoE (its capacity-based
+        # token dropping is a FULL-sequence competition — deciding it
+        # per T/sp shard would silently change which tokens drop).
+        sp = mesh.shape.get("sp", 1)
+        sp_axis = ("sp" if (sp > 1 and t % sp == 0 and tp == 1
+                            and not (cfg.n_experts
+                                     and cfg.moe_impl == "switch"))
+                   else None)
         if tp > 1:
             if cfg.kv_heads % tp:
                 raise ValueError(
@@ -576,7 +643,8 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
                 partition.update(_dense_tp_mlp_partition())
         else:
             stage_block = lambda c, lp_, pos: _block(cfg, None, c, lp_, pos,
-                                                     ep_axis=ep_axis)
+                                                     ep_axis=ep_axis,
+                                                     sp_axis=sp_axis)
             # Expert weights shard over ep inside the stage (the router
             # stays replicated so every device routes over all E experts).
             partition = None
@@ -597,8 +665,13 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
         with_aux = _zero_aux() if cfg.n_experts else False
 
         def stage_fn(stage_params, h):
-            pos = jnp.broadcast_to(jnp.arange(h.shape[1], dtype=jnp.int32),
-                                   h.shape[:2])
+            pos = jnp.arange(h.shape[1], dtype=jnp.int32)
+            if sp_axis is not None:
+                # Local shard i holds global positions
+                # [i*t_loc, (i+1)*t_loc): rope and the ring's causal
+                # bounds both follow the global index.
+                pos = pos + jax.lax.axis_index(sp_axis) * h.shape[1]
+            pos = jnp.broadcast_to(pos, h.shape[:2])
 
             def body(carry, lp):
                 out, layer_aux = stage_block(carry, lp, pos)
@@ -612,7 +685,7 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
                            param_partition=partition,
                            schedule=cfg.pp_schedule,
                            virtual_stages=cfg.pp_virtual_stages,
-                           with_aux=with_aux)
+                           with_aux=with_aux, seq_axis=sp_axis)
         if with_aux is not False:
             x, aux = x
     else:
@@ -1882,18 +1955,31 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     runs the INTERLEAVED 1F1B timetable (device d owns layer chunks d,
     d+pp, ...; every microbatch laps the ring v times), shrinking the
     bubble for v x more ppermute hops at the same per-chunk stash rule.
-    ``moe_impl='switch'`` and sp stage bodies stay with the
-    gpipe/circular schedules.
+    sp shards the SEQUENCE inside stages: attention is the K/V
+    all_gather form (``_sp_gather_attention`` — a ppermute ring's global
+    participant set would deadlock in the tick's divergent branches),
+    weights and the loss tail fan/reduce over sp with the f/g pair, and
+    router aux averages per shard.  ``moe_impl='switch'`` stays with the
+    gpipe/circular schedules, as does sp x tp (the manual Megatron
+    blocks have no sp form).
     """
     pp = mesh.shape.get("pp", 1)
     tp = mesh.shape.get("tp", 1)
     ep = mesh.shape.get("ep", 1)
+    sp = mesh.shape.get("sp", 1)
     real = {a for a, s in mesh.shape.items() if s > 1}
-    if not real <= {"pp", "tp", "dp", "fsdp", "ep"}:
+    if not real <= {"pp", "tp", "dp", "fsdp", "ep", "sp"}:
         raise ValueError(
-            f"train_step_1f1b supports pp x tp x ep x dp/fsdp meshes; got "
-            f"{dict(mesh.shape)} (sp stage bodies stay with "
-            f"pp_schedule='gpipe'/'circular')")
+            f"train_step_1f1b supports pp x tp x ep x sp x dp/fsdp "
+            f"meshes; got {dict(mesh.shape)}")
+    if sp > 1 and tp > 1:
+        raise ValueError("1f1b x sp x tp is not supported: the manual "
+                         "Megatron stage blocks have no sequence-"
+                         "parallel form (drop one axis)")
+    if sp > 1 and (batch["tokens"].shape[1] - 1) % sp:
+        raise ValueError(
+            f"sequence length {batch['tokens'].shape[1] - 1} must divide "
+            f"over sp ({sp})")
     if tp > 1 and cfg.kv_heads % tp:
         raise ValueError(f"1f1b x tp needs tp ({tp}) to divide kv_heads "
                          f"({cfg.kv_heads})")
@@ -1928,6 +2014,7 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         params["layers"])
 
     ep_axis = "ep" if (cfg.n_experts and ep > 1) else None
+    sp_axis = "sp" if sp > 1 else None
     partition = None
     if tp > 1:
         # forward_hidden's dense tp partition table (shared helpers);
@@ -1953,8 +2040,20 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     stage_aux = bool(cfg.n_experts)
 
     def stage_fn(stage_params, h):
-        pos = jnp.broadcast_to(jnp.arange(h.shape[1], dtype=jnp.int32),
-                               h.shape[:2])
+        if sp_axis is not None:
+            # Sequence shards: weights are REPLICATED over sp but consumed
+            # by per-shard-divergent (local-token) compute — fan them
+            # through the f operator so the in-body vjp psums their
+            # partial gradients over sp exactly once.
+            from tfmesos_tpu.parallel.collectives import (
+                broadcast_replicated_grad)
+            stage_params = jax.tree_util.tree_map(
+                lambda w: broadcast_replicated_grad(w, sp_axis),
+                stage_params)
+        pos = jnp.arange(h.shape[1], dtype=jnp.int32)
+        if sp_axis is not None:
+            pos = pos + jax.lax.axis_index(sp_axis) * h.shape[1]
+        pos = jnp.broadcast_to(pos, h.shape[:2])
         if tp > 1:
             body = lambda c, lp: _block_manual_tp(cfg, c, lp, pos,
                                                   ep_axis=ep_axis,
@@ -1962,7 +2061,9 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         else:
             body = lambda c, lp: _block(cfg, None, c, lp, pos,
                                         ep_axis=ep_axis,
-                                        inbody_ad=ep_axis is not None)
+                                        inbody_ad=(ep_axis is not None
+                                                   or sp_axis is not None),
+                                        sp_axis=sp_axis)
         if cfg.remat:
             body = jax.checkpoint(body)
         out, layer_aux = jax.lax.scan(body, h, stage_params)
@@ -1972,6 +2073,13 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
                * jnp.sum(layer_aux["load_balance_loss"])
                + cfg.router_z_weight * jnp.sum(layer_aux["z_loss"])
                ) / cfg.n_layers
+        if sp_axis is not None:
+            # Per-shard (local-token) router statistics: average over sp
+            # with the transpose-carrying reduction so the 1/m aux seed
+            # flows back at 1/sp per shard, not sp-times over.
+            from tfmesos_tpu.parallel.collectives import (
+                psum_replicated_grad)
+            aux = psum_replicated_grad(aux, sp_axis) / sp
         return out, aux.astype(jnp.float32)
 
     def tail_loss(tail, h, tgt_mb):
@@ -1981,14 +2089,26 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         # tp the head arrives vocab-sharded and the in-body
         # vocab-parallel CE psums the softmax statistics explicitly
         # (its custom VJP keeps the in-loop backward collective-safe).
+        # Under sp the tail weights fan (f operator) into per-shard
+        # compute and the local-token mean reduces over sp with the
+        # identity-transpose psum, so each shard's backward sees the
+        # 1/sp-scaled seed exactly once.
+        if sp_axis is not None:
+            from tfmesos_tpu.parallel.collectives import (
+                broadcast_replicated_grad, psum_replicated_grad)
+            tail = jax.tree_util.tree_map(
+                lambda w: broadcast_replicated_grad(w, sp_axis), tail)
         x = rms_norm(h, tail["norm_f"].astype(cfg.dtype))
         if vocab_parallel_tail:
             return vocab_parallel_ce_inbody(x, tail["head"], tgt_mb,
                                             "tp", cfg.z_loss,
                                             cfg.ce_chunk)
-        return fused_linear_cross_entropy(x, tail["head"], tgt_mb,
+        loss = fused_linear_cross_entropy(x, tail["head"], tgt_mb,
                                           z_loss=cfg.z_loss,
                                           chunk=cfg.ce_chunk)
+        if sp_axis is not None:
+            loss = psum_replicated_grad(loss, sp_axis) / sp
+        return loss
 
     x, vjp_embed = jax.vjp(
         lambda e: _embed_lookup(e, inp, cfg.dtype), params["embed"])
@@ -2004,7 +2124,7 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         stage_fn, tail_loss, stacked, x, tgt, mesh,
         num_microbatches=num_microbatches, tail_params=tail,
         param_partition=partition, tail_partition=tail_partition,
-        stage_aux=stage_aux, virtual_stages=v)
+        stage_aux=stage_aux, virtual_stages=v, seq_axis=sp_axis)
     (g_embed,) = vjp_embed(dx.astype(x.dtype))
     grads = {
         "embed": jax.tree_util.tree_map(

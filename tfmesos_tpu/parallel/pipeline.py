@@ -144,7 +144,8 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
                         tail_params: Any = None,
                         tail_partition: Optional[Any] = None,
                         stage_aux: bool = False,
-                        virtual_stages: int = 1):
+                        virtual_stages: int = 1,
+                        seq_axis: Optional[str] = None):
     """One fused forward+backward pipeline pass on the 1F1B schedule.
 
     ``pipeline_apply`` is forward-only — under ``jax.grad`` autodiff
@@ -463,8 +464,12 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
     else:
         param_specs = jax.tree_util.tree_map(
             lambda p, spec: P(axis, *spec), stacked_params, param_partition)
-    x_spec = P(data_axes(mesh), *([None] * (x.ndim - 1)))
-    t_spec = P(data_axes(mesh), *([None] * (targets.ndim - 1)))
+    # seq_axis shards dim 1 (sequence): stage bodies see local shards and
+    # handle the axis manually (einsum-ring attention, f/g-fanned weights,
+    # an sp-reduced loss tail — see transformer.train_step_1f1b).
+    x_spec = P(data_axes(mesh), seq_axis, *([None] * (x.ndim - 2)))
+    t_spec = P(data_axes(mesh), seq_axis,
+               *([None] * (targets.ndim - 2)))
     if tail_partition is None:
         tail_specs = jax.tree_util.tree_map(lambda _: P(), tail_params)
     else:
@@ -491,7 +496,8 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
                    num_microbatches: Optional[int] = None,
                    param_partition: Optional[Any] = None,
                    schedule: str = "gpipe", virtual_stages: int = 1,
-                   with_aux: bool = False):
+                   with_aux: bool = False,
+                   seq_axis: Optional[str] = None):
     """Run ``x`` through the stage pipeline; returns the final activations.
 
     ``stage_fn(params, h) -> h`` applies ONE stage chunk (same activation
@@ -515,6 +521,14 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
     aux pytree's *structure* (any pytree, values ignored) as ``with_aux``;
     ``with_aux=True`` infers it by abstractly evaluating ``stage_fn``,
     which only works for stage bodies free of manual collectives.
+
+    ``seq_axis`` (optional) shards the activations' dim 1 (sequence)
+    over that mesh axis: stage bodies then see LOCAL sequence shards
+    and must handle the axis manually (e.g. the einsum-ring attention
+    of ``models/transformer._block(sp_axis=...)`` with global rope
+    positions); aux scalars additionally pmean over it (per-shard
+    router statistics are an estimator of the full-sequence ones, like
+    the microbatch estimator).
     """
     n_stages = mesh.shape[axis]
     if schedule not in ("gpipe", "circular"):
@@ -630,12 +644,16 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
         aux_mean = jax.tree_util.tree_map(
             lambda a: jax.lax.psum(a, axis) / (m * n_stages * v), aux_acc)
         # Average over the data shards (each ring works its own batch
-        # shard); any remaining axis (tp/ep) already holds identical values
-        # — stage bodies pmean/psum their collectives internally — so the
+        # shard) and over seq_axis shards when the sequence is split
+        # (per-shard router statistics estimate the full-sequence ones);
+        # any remaining axis (tp/ep) already holds identical values —
+        # stage bodies pmean/psum their collectives internally — so the
         # replicated out_spec is sound.
-        if d_axis_names:
+        red_axes = tuple(d_axis_names) + (
+            (seq_axis,) if seq_axis else ())
+        if red_axes:
             aux_mean = jax.tree_util.tree_map(
-                lambda a: jax.lax.pmean(a, d_axis_names), aux_mean)
+                lambda a: jax.lax.pmean(a, red_axes), aux_mean)
         return out, aux_mean
 
     if param_partition is None:
@@ -645,8 +663,10 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
         param_specs = jax.tree_util.tree_map(
             lambda p, spec: P(axis, *spec), stacked_params, param_partition)
     # Activations shard over the data axes (each pipeline ring works on its
-    # batch shard) and replicate over pp/tp, where the ring/psum handle them.
-    x_spec = P(data_axes(mesh), *([None] * (x.ndim - 1)))
+    # batch shard) — plus the sequence dim over seq_axis when given — and
+    # replicate over pp/tp, where the ring/psum handle them.
+    x_spec = P(data_axes(mesh), seq_axis, *([None] * (x.ndim - 2)))
+    sp_size = mesh.shape.get(seq_axis, 1) if seq_axis else 1
     if with_aux:
         if aux_proto is None:
             # Infer the aux structure abstractly (collective-free stages
@@ -654,8 +674,9 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
             aux_proto = jax.eval_shape(
                 lambda p, h: stage_fn(
                     jax.tree_util.tree_map(lambda q: q[0], p), h)[1],
-                stacked_params, jnp.zeros((x.shape[0] // (m * dp_size),)
-                                          + x.shape[1:], x.dtype))
+                stacked_params,
+                jnp.zeros((x.shape[0] // (m * dp_size),
+                           x.shape[1] // sp_size) + x.shape[2:], x.dtype))
         out_specs = (x_spec, jax.tree_util.tree_map(lambda _: P(), aux_proto))
     else:
         out_specs = x_spec
