@@ -36,6 +36,10 @@ def main():
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--int8", action="store_true")
     p.add_argument("--int8-kv", action="store_true", dest="int8_kv")
+    p.add_argument("--int8-draft-kv", action="store_true",
+                   dest="int8_draft_kv",
+                   help="store the speculative draft's page pool int8 "
+                        "(with --continuous --speculative)")
     p.add_argument("--paged", action="store_true",
                    help="serve from a paged KV cache: one shared page "
                         "pool, per-batch page allocation/recycling "
@@ -74,6 +78,8 @@ def main():
     args = p.parse_args()
     if args.mesh is not None and not args.continuous:
         p.error("--mesh is a continuous-batching feature; add --continuous")
+    if args.int8_draft_kv and not args.speculative:
+        p.error("--int8-draft-kv needs --continuous --speculative")
     if args.overlap and not args.continuous:
         p.error("--overlap is a continuous-batching feature; "
                 "add --continuous")
@@ -191,7 +197,8 @@ def main():
             quantized_cache=args.int8_kv,
             prefill_chunk=args.prefill_chunk,
             draft_cfg=draft_cfg, draft_params=draft_params,
-            n_draft=SPEC_N_DRAFT, mesh=mesh, overlap=args.overlap)
+            n_draft=SPEC_N_DRAFT, mesh=mesh, overlap=args.overlap,
+            draft_quantized_cache=args.int8_draft_kv)
         sink = open(args.out, "w") if args.out else sys.stdout
         served = 0
         t0 = time.perf_counter()

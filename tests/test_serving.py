@@ -785,6 +785,32 @@ def test_tpu_shaped_serving_geometry(setup):
         assert side.peak <= side.n_pages        # never oversubscribed
 
 
+def test_int8_draft_pool_composes(setup, draft_setup):
+    """draft_quantized_cache=True serves draft proposals from an int8
+    page pool (halving draft HBM); outputs stay valid and the combo
+    with an int8 TARGET pool and the overlap loop also runs."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    reqs = lambda: [Request(prompt=p, max_new_tokens=4)
+                    for p in _prompts(cfg, 4, seed=91)]
+    b = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                          prefill_bucket=16, draft_cfg=dcfg,
+                          draft_params=dparams, n_draft=3,
+                          draft_quantized_cache=True)
+    done = {c.rid: c for c in b.run(reqs())}
+    assert len(done) == 4
+    for c in done.values():
+        assert all(0 <= t < cfg.vocab_size for t in c.tokens)
+    assert b.d_side.alloc.rows == {}
+    # Full quantized stack: int8 target + int8 draft + overlap.
+    b2 = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                           prefill_bucket=16, draft_cfg=dcfg,
+                           draft_params=dparams, n_draft=3,
+                           quantized_cache=True,
+                           draft_quantized_cache=True, overlap=True)
+    assert len(list(b2.run(reqs()))) == 4
+
+
 def test_int8_kv_pool_composes(setup):
     """quantized_cache=True serves from an int8 page pool; outputs stay
     close to (not necessarily identical to) the fp path."""

@@ -298,7 +298,9 @@ class ContinuousBatcher:
     pool — draft HBM tracks live tokens exactly like the target's, and
     a shared prefix occupies shared draft pages once instead of a
     per-row broadcast; ``draft_n_pages`` sizes it, default fully
-    backed) and the target verifies them in ONE ragged chunk over the
+    backed; ``draft_quantized_cache=True`` stores it int8 like the
+    target's ``quantized_cache``) and the target verifies them in ONE
+    ragged chunk over the
     paged pool — rows commit their leading accepted run plus the
     target's correction, so each tick emits 1..n_draft+1 tokens per row
     instead of exactly 1.  Greedy outputs equal the target-only
@@ -376,7 +378,8 @@ class ContinuousBatcher:
                  draft_cfg: Optional[TransformerConfig] = None,
                  draft_params=None, n_draft: int = 4,
                  draft_n_pages: Optional[int] = None, mesh=None,
-                 overlap: bool = False):
+                 overlap: bool = False,
+                 draft_quantized_cache: bool = False):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         self.overlap = bool(overlap)
@@ -497,11 +500,13 @@ class ContinuousBatcher:
                                      rows, self.np_max,
                                      n_shards=self.n_shards)
             self.d_side.pool = init_paged_cache(
-                draft_cfg, self.n_draft_pages, self.page_size)
+                draft_cfg, self.n_draft_pages, self.page_size,
+                quantized=draft_quantized_cache)
             if mesh is not None:
                 self.draft_params = self._place(
                     draft_params, partition_specs(draft_cfg, mesh))
-            self._init_side_device_state(self.d_side, draft_cfg)
+            self._init_side_device_state(self.d_side, draft_cfg,
+                                         quantized=draft_quantized_cache)
             self._spec_round = self._make_spec_round()
             self._draft_chunk = self._make_draft_chunk()
         self._next_rid = 0
