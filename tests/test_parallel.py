@@ -455,6 +455,8 @@ def test_transformer_train_step_1f1b_interleaved():
     ({"pp": 2, "sp": 2, "dp": 2}, 0, None),
     ({"pp": 2, "sp": 4}, 0, 2),             # GQA broadcast in the sp form
     ({"pp": 2, "sp": 2, "ep": 2}, 2, None),  # MoE aux pmean'd over sp
+    ({"pp": 2, "tp": 2, "sp": 2}, 0, None),  # full 4D: local heads x seq
+    ({"pp": 2, "tp": 2, "sp": 2}, 0, 2),     # ... with GQA
 ])
 def test_pipeline_sp_stages_match_reference(axes, n_experts, kv_heads):
     """pp x sp: the SEQUENCE shards over sp inside pipeline stages (ring
@@ -615,9 +617,10 @@ def test_transformer_train_step_1f1b_validation():
         max_seq_len=16, dtype=jnp.float32)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     batch = {"tokens": jnp.zeros((4, 17), jnp.int32)}
-    with pytest.raises(ValueError, match="1f1b x sp x tp"):
+    with pytest.raises(ValueError, match="must divide over sp"):
         transformer.train_step_1f1b(
-            cfg, params, batch, build_mesh({"pp": 2, "sp": 2, "tp": 2}))
+            cfg, params, {"tokens": jnp.zeros((4, 18), jnp.int32)},
+            build_mesh({"pp": 2, "sp": 2, "dp": 2}))
     switch = transformer.TransformerConfig(
         vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
         max_seq_len=16, dtype=jnp.float32, n_experts=2, top_k=1,

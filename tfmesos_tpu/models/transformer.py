@@ -440,7 +440,8 @@ def _replicated_attn_partition() -> Dict[str, P]:
 
 def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
                      tp_axis: str = "tp", ep_axis: Optional[str] = None,
-                     inbody_ad: bool = False):
+                     inbody_ad: bool = False,
+                     sp_axis: Optional[str] = None):
     """Megatron-style block with MANUAL tp collectives, for use inside a
     pipeline stage (nested shard_map is not allowed there, explicit psum
     is).  ``lp`` leaves arrive as local tp shards: wq/wk/wv column-sharded
@@ -475,8 +476,13 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, kv_loc, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    o = attend(q, k, v, mesh=None, causal=True,
-               window=cfg.window)  # local heads
+    if sp_axis is not None:
+        # tp x sp: local HEADS x local SEQUENCE, positions global (the
+        # caller offsets them) — see _sp_attend.
+        o = _sp_attend(cfg, q, k, v, sp_axis, inbody_ad)
+    else:
+        o = attend(q, k, v, mesh=None, causal=True,
+                   window=cfg.window)  # local heads
     x = x + red(o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype))
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     if cfg.n_experts:
@@ -504,8 +510,14 @@ def _sp_gather_attention(cfg: TransformerConfig, q, k, v, axis: str):
     sums per-shard dK/dV contributions exactly once."""
     scale = 1.0 / math.sqrt(cfg.head_dim)
     tq = q.shape[1]
-    kg = jax.lax.all_gather(k, axis, axis=1, tiled=True)    # [B, T, H, D]
+    # Gather the NARROW (kv-width) K/V and broadcast GQA groups locally
+    # afterwards: 1/g the collective bytes and gathered residency.
+    kg = jax.lax.all_gather(k, axis, axis=1, tiled=True)    # [B, T, KV, D]
     vg = jax.lax.all_gather(v, axis, axis=1, tiled=True)
+    g = q.shape[2] // kg.shape[2]
+    if g > 1:
+        kg = jnp.repeat(kg, g, axis=2)
+        vg = jnp.repeat(vg, g, axis=2)
     tk = kg.shape[1]
     idx = jax.lax.axis_index(axis)
     qpos = idx * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
@@ -519,6 +531,25 @@ def _sp_gather_attention(cfg: TransformerConfig, q, k, v, axis: str):
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
     return o.astype(q.dtype)
+
+
+def _sp_attend(cfg: TransformerConfig, q, k, v, sp_axis: str,
+               inbody_ad: bool):
+    """Manual sequence-parallel attention dispatch, shared by the dense
+    and manual-tp stage blocks (q/k/v may be tp-local head shards): the
+    K/V-gather form under in-body AD (1F1B's divergent branches; GQA
+    broadcasts AFTER the gather), the einsum ring under outer AD
+    (lockstep gpipe ticks; the ring helper matches heads one-for-one,
+    so GQA broadcasts before the hops)."""
+    if inbody_ad:
+        return _sp_gather_attention(cfg, q, k, v, sp_axis)
+    g = q.shape[2] // k.shape[2]
+    if g > 1:
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    from tfmesos_tpu.parallel.ring_attention import ring_attention_local
+    return ring_attention_local(q, k, v, axis=sp_axis, causal=True,
+                                window=cfg.window)
 
 
 def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
@@ -539,19 +570,7 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     if sp_axis is not None:
-        if cfg.kv_heads != cfg.n_heads:
-            # The manual sp forms match q/k head-for-head; broadcast
-            # GQA's narrow K/V up (the local shard is T/sp long — cheap).
-            g = cfg.n_heads // cfg.kv_heads
-            k = jnp.repeat(k, g, axis=2)
-            v = jnp.repeat(v, g, axis=2)
-        if inbody_ad:
-            o = _sp_gather_attention(cfg, q, k, v, sp_axis)
-        else:
-            from tfmesos_tpu.parallel.ring_attention import (
-                ring_attention_local)
-            o = ring_attention_local(q, k, v, axis=sp_axis, causal=True,
-                                     window=cfg.window)
+        o = _sp_attend(cfg, q, k, v, sp_axis, inbody_ad)
     else:
         # GQA (kv_heads < n_heads) flows through attend() at kv width:
         # the flash kernels map q head h -> kv head h // (H/KV) in their
@@ -612,15 +631,15 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
         # not allowed inside the pipeline's own shard_map.
         ep = mesh.shape.get("ep", 1)
         ep_axis = "ep" if (cfg.n_experts and ep > 1) else None
-        # pp x sp: shard the SEQUENCE over sp inside stages — the
-        # einsum-ring attention of _block(sp_axis=...) with global rope
-        # positions.  tp stages keep the old sequence-replicated layout
-        # (their manual blocks have no sp form), as do a sequence that
-        # does not divide over sp and switch MoE (its capacity-based
-        # token dropping is a FULL-sequence competition — deciding it
-        # per T/sp shard would silently change which tokens drop).
+        # pp x sp: shard the SEQUENCE over sp inside stages — manual
+        # ring/gather attention with global rope positions (dense tp
+        # stages compose: local heads x local sequence).  The sequence
+        # stays replicated when it does not divide over sp and for
+        # switch MoE (its capacity-based token dropping is a
+        # FULL-sequence competition — deciding it per T/sp shard would
+        # silently change which tokens drop).
         sp = mesh.shape.get("sp", 1)
-        sp_axis = ("sp" if (sp > 1 and t % sp == 0 and tp == 1
+        sp_axis = ("sp" if (sp > 1 and t % sp == 0
                             and not (cfg.n_experts
                                      and cfg.moe_impl == "switch"))
                    else None)
@@ -631,7 +650,7 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
                     f"({cfg.kv_heads}) so the local head grouping stays "
                     f"aligned; lower tp or raise kv_heads")
             stage_block = lambda c, lp_, pos: _block_manual_tp(
-                cfg, c, lp_, pos, ep_axis=ep_axis)
+                cfg, c, lp_, pos, ep_axis=ep_axis, sp_axis=sp_axis)
             partition = _dense_tp_attn_partition()
             if cfg.n_experts:
                 # Per-expert Megatron: FFN widths shard over tp, whole
@@ -1955,13 +1974,13 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
     runs the INTERLEAVED 1F1B timetable (device d owns layer chunks d,
     d+pp, ...; every microbatch laps the ring v times), shrinking the
     bubble for v x more ppermute hops at the same per-chunk stash rule.
-    sp shards the SEQUENCE inside stages: attention is the K/V
-    all_gather form (``_sp_gather_attention`` — a ppermute ring's global
-    participant set would deadlock in the tick's divergent branches),
-    weights and the loss tail fan/reduce over sp with the f/g pair, and
-    router aux averages per shard.  ``moe_impl='switch'`` stays with the
-    gpipe/circular schedules, as does sp x tp (the manual Megatron
-    blocks have no sp form).
+    sp shards the SEQUENCE inside stages — composing with tp into the
+    full pp x tp x sp x dp stack (local heads x local sequence):
+    attention is the K/V all_gather form (``_sp_gather_attention`` — a
+    ppermute ring's global participant set would deadlock in the tick's
+    divergent branches), weights and the loss tail fan/reduce over sp
+    with the f/g pair, and router aux averages per shard.
+    ``moe_impl='switch'`` stays with the gpipe/circular schedules.
     """
     pp = mesh.shape.get("pp", 1)
     tp = mesh.shape.get("tp", 1)
@@ -1972,10 +1991,6 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         raise ValueError(
             f"train_step_1f1b supports pp x tp x ep x sp x dp/fsdp "
             f"meshes; got {dict(mesh.shape)}")
-    if sp > 1 and tp > 1:
-        raise ValueError("1f1b x sp x tp is not supported: the manual "
-                         "Megatron stage blocks have no sequence-"
-                         "parallel form (drop one axis)")
     if sp > 1 and (batch["tokens"].shape[1] - 1) % sp:
         raise ValueError(
             f"sequence length {batch['tokens'].shape[1] - 1} must divide "
@@ -2057,7 +2072,8 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
         if tp > 1:
             body = lambda c, lp: _block_manual_tp(cfg, c, lp, pos,
                                                   ep_axis=ep_axis,
-                                                  inbody_ad=True)
+                                                  inbody_ad=True,
+                                                  sp_axis=sp_axis)
         else:
             body = lambda c, lp: _block(cfg, None, c, lp, pos,
                                         ep_axis=ep_axis,
@@ -2100,12 +2116,13 @@ def train_step_1f1b(cfg: TransformerConfig, params, batch,
                 lambda w: broadcast_replicated_grad(w, sp_axis), tail)
         x = rms_norm(h, tail["norm_f"].astype(cfg.dtype))
         if vocab_parallel_tail:
-            return vocab_parallel_ce_inbody(x, tail["head"], tgt_mb,
+            loss = vocab_parallel_ce_inbody(x, tail["head"], tgt_mb,
                                             "tp", cfg.z_loss,
                                             cfg.ce_chunk)
-        loss = fused_linear_cross_entropy(x, tail["head"], tgt_mb,
-                                          z_loss=cfg.z_loss,
-                                          chunk=cfg.ce_chunk)
+        else:
+            loss = fused_linear_cross_entropy(x, tail["head"], tgt_mb,
+                                              z_loss=cfg.z_loss,
+                                              chunk=cfg.ce_chunk)
         if sp_axis is not None:
             loss = psum_replicated_grad(loss, sp_axis) / sp
         return loss
