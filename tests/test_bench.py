@@ -89,3 +89,14 @@ def test_ring_window_bench_smoke():
     assert out is not None
     flash_ms, xla_ms = out
     assert flash_ms > 0 and xla_ms > 0
+
+
+def test_pipeline_bubble_stats_static():
+    # Bubble-bound regime (deep pipe, few microbatches): interleaving
+    # must strictly beat v=1 wall-clock at equal work.
+    out = bench.pipeline_bubble_stats(pp=8, m=8)
+    assert 0.0 < out["pipeline_bubble_v2"] < out["pipeline_bubble_v1"]
+    assert out["pipeline_interleave_speedup"] > 1.1
+    # Amortized regime: the ratio honestly collapses toward 1.
+    flat = bench.pipeline_bubble_stats(pp=4, m=16)
+    assert 0.95 < flat["pipeline_interleave_speedup"] < 1.1

@@ -72,7 +72,10 @@ def _schedule_1f1b(n_stages: int, m: int, v: int = 1):
     v=1 this is exactly the classic schedule: same bubble as gpipe,
     peak stash S microbatch inputs.  At v>1 every microbatch laps the
     ring v times (chunk c feeds chunk c+1, always one device to the
-    right), cutting the bubble by ~v for v x more ppermute hops.
+    right), shrinking the FILL/DRAIN bubble for v x more ppermute hops
+    — worth ~1.2x wall at bubble-bound shapes (deep pipe, few
+    microbatches; bench.pipeline_bubble_stats measures this timetable
+    statically), and ~nothing once m >> pp amortizes the fill.
     """
     import numpy as np
 
