@@ -63,6 +63,10 @@ def main():
                         "prompts in chunks of this many tokens, "
                         "interleaved with decode steps — bounds the "
                         "stall a long prompt imposes on decoding rows")
+    p.add_argument("--multi-step", type=int, default=1, dest="multi_step",
+                   help="decode K tokens per dispatch in continuous mode "
+                        "(one host sync per [rows, K] block; stops act "
+                        "at block granularity, token streams identical)")
     p.add_argument("--overlap", action="store_true",
                    help="double-buffered decode (with --continuous): "
                         "dispatch tick t+1 before syncing tick t's "
@@ -80,6 +84,13 @@ def main():
         p.error("--mesh is a continuous-batching feature; add --continuous")
     if args.int8_draft_kv and not args.speculative:
         p.error("--int8-draft-kv needs --continuous --speculative")
+    if args.multi_step != 1 and not args.continuous:
+        p.error("--multi-step is a continuous-batching feature; "
+                "add --continuous")
+    if args.multi_step != 1 and args.speculative:
+        p.error("--multi-step does not compose with --speculative (a "
+                "speculative round already commits multiple tokens per "
+                "dispatch)")
     if args.overlap and not args.continuous:
         p.error("--overlap is a continuous-batching feature; "
                 "add --continuous")
@@ -198,7 +209,8 @@ def main():
             prefill_chunk=args.prefill_chunk,
             draft_cfg=draft_cfg, draft_params=draft_params,
             n_draft=SPEC_N_DRAFT, mesh=mesh, overlap=args.overlap,
-            draft_quantized_cache=args.int8_draft_kv)
+            draft_quantized_cache=args.int8_draft_kv,
+            multi_step=args.multi_step)
         sink = open(args.out, "w") if args.out else sys.stdout
         served = 0
         t0 = time.perf_counter()

@@ -72,9 +72,10 @@ def test_decode_long_context_bench_smoke():
 
 
 def test_serving_bench_smoke():
-    rps, ttft_ms, overlap_rps = bench.bench_serving_continuous(
-        n_requests=3, rows=2, tiny=True)
+    rps, ttft_ms, overlap_rps, ms_rps, mso_rps = \
+        bench.bench_serving_continuous(n_requests=3, rows=2, tiny=True)
     assert rps > 0 and ttft_ms > 0 and overlap_rps > 0
+    assert ms_rps > 0 and mso_rps > 0
 
 
 def test_serving_mesh_bench_smoke():

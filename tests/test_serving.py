@@ -823,3 +823,92 @@ def test_int8_kv_pool_composes(setup):
     assert len(done) == 3
     for c in done.values():
         assert all(0 <= t < cfg.vocab_size for t in c.tokens)
+
+
+@pytest.mark.parametrize("variant", [
+    "base", "staggered", "stop", "sampled", "chunked", "prefix", "mesh",
+    "overlap", "overlap_stop", "overlap_mesh",
+])
+@pytest.mark.parametrize("k", [2, 4])
+def test_multistep_batcher_token_identical(setup, mesh_setup, variant, k):
+    """multi_step=K (K decode steps fused into one dispatch, one host
+    sync per [rows, K] token block) must produce IDENTICAL token streams
+    to the single-step batcher across the matrix: stops and quota
+    endings mid-block discard the rest of the block, in-block overshoot
+    writes stay inside the reservation clamp or land on sink columns,
+    sampled keys fold per (rid, step) exactly as before, and the mesh +
+    overlap paths compose."""
+    if variant in ("mesh", "overlap_mesh"):
+        cfg, params, _, _ = mesh_setup
+    else:
+        cfg, params = setup
+    rng = np.random.RandomState(31)
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (3, 8, 13, 19, 16, 5)]
+    mk = lambda: [Request(prompt=p, max_new_tokens=2 + (i % 5))
+                  for i, p in enumerate(prompts)]
+    kw = dict(rows=4, max_len=96, page_size=16, prefill_bucket=16)
+    mkw = {}
+    if variant == "sampled":
+        kw.update(temperature=0.8, top_k=20, rng=jax.random.PRNGKey(3))
+    elif variant == "chunked":
+        kw.update(prefill_chunk=8)
+    elif variant == "prefix":
+        kw.update(prefix=rng.randint(0, cfg.vocab_size,
+                                     size=13).astype(np.int32))
+    elif variant in ("mesh", "overlap_mesh"):
+        mkw.update(mesh=_mesh({"dp": 2, "tp": 2}))
+    if variant.startswith("overlap"):
+        mkw.update(overlap=True)
+    if variant in ("stop", "overlap_stop"):
+        probe = ContinuousBatcher(cfg, params, **kw)
+        outs = {c.rid: c.tokens for c in probe.run(mk())}
+        stops = {rid: t[min(1, len(t) - 1)] for rid, t in outs.items()}
+        mk = lambda: [Request(prompt=p, max_new_tokens=2 + (i % 5),
+                              stop_token=stops[i])
+                      for i, p in enumerate(prompts)]
+    if variant == "staggered":
+        kw["rows"] = 2
+
+        def feed(reqs, done):
+            for r in reqs:
+                assert len(done) <= len(reqs)   # pull stays lazy
+                yield r
+    else:
+        feed = lambda reqs, done: iter(reqs)
+    plain = ContinuousBatcher(cfg, params, **kw)
+    want = {}
+    for c in plain.run(feed(mk(), want)):
+        want[c.rid] = c.tokens
+    mb = ContinuousBatcher(cfg, params, multi_step=k, **kw, **mkw)
+    got = {}
+    for c in mb.run(feed(mk(), got)):
+        got[c.rid] = c.tokens
+    if variant in ("mesh", "overlap_mesh"):
+        for rid in want:
+            _assert_tokens_match_modulo_ties(
+                cfg, params, kw.get("prefix"), prompts[rid], got[rid],
+                want[rid])
+    else:
+        assert got == want
+    assert mb._inflight is None             # loop drained
+    assert mb.t_side.alloc.rows == {}       # nothing leaked
+    # Reservation invariant held throughout: the pool high-water mark
+    # never exceeded sink + prefix + (concurrent rows x the largest
+    # admission reservation) — if a multi-step block ever ensured past
+    # its _Row.limit clamp, a row's allocations would exceed its
+    # reservation and the high-water mark would break this bound.
+    worst = max(mb._worst_pages(q)[0] for q in mk())
+    n_prefix = len(mb.t_side.shared_pages) + (
+        1 if mb.t_side.tail_template is not None else 0)
+    assert mb.peak_pages_used <= 1 + n_prefix + kw["rows"] * worst
+
+
+def test_multistep_validation(setup, draft_setup):
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    with pytest.raises(ValueError, match="multi_step"):
+        ContinuousBatcher(cfg, params, multi_step=0)
+    with pytest.raises(ValueError, match="speculative"):
+        ContinuousBatcher(cfg, params, multi_step=2, draft_cfg=dcfg,
+                          draft_params=dparams)

@@ -105,6 +105,12 @@ class _Row:
     padded: Optional[np.ndarray] = None
     filled: int = 0
     decoding: bool = True
+    # Absolute position cap the admission reservation covers: multi-step
+    # blocks clamp their ensure() calls here so a row's allocations can
+    # never exceed its reservation (the headroom() accounting depends on
+    # allocated <= worst); in-block overshoot writes past it land on
+    # sink columns of the table instead.
+    limit: int = 0
 
 
 class _ShardedAlloc:
@@ -379,9 +385,18 @@ class ContinuousBatcher:
                  draft_params=None, n_draft: int = 4,
                  draft_n_pages: Optional[int] = None, mesh=None,
                  overlap: bool = False,
-                 draft_quantized_cache: bool = False):
+                 draft_quantized_cache: bool = False,
+                 multi_step: int = 1):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
+        if multi_step < 1:
+            raise ValueError(f"multi_step must be >= 1, got {multi_step}")
+        if multi_step > 1 and draft_cfg is not None:
+            raise ValueError(
+                "multi_step does not compose with speculative decoding — "
+                "a speculative round already commits up to n_draft+1 "
+                "tokens per dispatch; use one or the other")
+        self.multi_step = int(multi_step)
         self.overlap = bool(overlap)
         # Overlap mode: (device outputs of the in-flight dispatch,
         # {row: rid} ticket).  Speculative overlap additionally carries
@@ -677,36 +692,59 @@ class ContinuousBatcher:
         return jax.vmap(one)(last, rids, steps)
 
     def _make_decode(self):
+        """K decode steps fused into ONE dispatch (``lax.scan``): the host
+        syncs a [rows, K] token block instead of one [rows] vector per
+        token, so the per-dispatch + device-to-host round-trip cost —
+        the dominant serving cost on remote-attached runtimes, and a real
+        tax everywhere — amortizes over K tokens.  Stops and quota
+        endings are detected at block granularity: in-block steps past a
+        row's end compute garbage the host discards, and their cache
+        writes land either inside the row's reservation-clamped own
+        pages or on sink columns (the ensure() clamp at ``_Row.limit``
+        guarantees allocations never exceed the admission reservation).
+        Token streams are IDENTICAL across K: the scan body runs the
+        same decode_step + per-(rid, step)-folded sample ops in the same
+        order, only the host sync point moves.  ``multi_step=1`` is the
+        classic per-token tick (a length-1 scan)."""
         sharded = self.mesh is not None
+        K = self.multi_step
+        max_len = self.max_len
+
+        def block(params, pool, table, tok0, positions, rids, steps):
+            def body(carry, _):
+                pool, tok, pos, stp = carry
+                cache = dict(pool, pages=table)
+                logits, cache = decode_step(
+                    self.cfg, params, cache, tok[:, None],
+                    jnp.minimum(pos, max_len), sharded=sharded,
+                    mesh=self.mesh)
+                nxt = self._sample(logits[:, -1], rids, stp)
+                pool = {"k": cache["k"], "v": cache["v"]}
+                return (pool, nxt, pos + 1, stp + 1), nxt
+
+            (pool, _, _, _), toks_all = jax.lax.scan(
+                body, (pool, tok0, positions, steps), None, length=K)
+            return pool, toks_all.T                         # [rows, K]
 
         if self.overlap:
-            # Double-buffered tick: rows that were in the PREVIOUS
-            # dispatch take their input token straight from its device
-            # output (``prev``) — the host never waits on it — while
-            # freshly (re)admitted rows take the host-supplied token.
+            # Double-buffered blocks: rows in the previous dispatch chain
+            # from its device-resident LAST token; the host never waits
+            # on it before dispatching the next block.
             @partial(jax.jit, donate_argnums=1)
             def fn(params, pool, table, toks, prev, use_dev, positions,
                    rids, steps):
-                merged = jnp.where(use_dev, prev, toks)
-                cache = dict(pool, pages=table)
-                logits, cache = decode_step(self.cfg, params, cache,
-                                            merged[:, None], positions,
-                                            sharded=sharded,
-                                            mesh=self.mesh)
-                nxt = self._sample(logits[:, -1], rids, steps)
-                return ({"k": cache["k"], "v": cache["v"]},
-                        self._host_read(nxt))
+                merged = jnp.where(use_dev, prev[:, -1], toks)
+                pool, out = block(params, pool, table, merged, positions,
+                                  rids, steps)
+                return pool, self._host_read(out)
 
             return fn
 
         @partial(jax.jit, donate_argnums=1)
         def fn(params, pool, table, toks, positions, rids, steps):
-            cache = dict(pool, pages=table)
-            logits, cache = decode_step(self.cfg, params, cache,
-                                        toks[:, None], positions,
-                                        sharded=sharded, mesh=self.mesh)
-            nxt = self._sample(logits[:, -1], rids, steps)
-            return {"k": cache["k"], "v": cache["v"]}, self._host_read(nxt)
+            pool, out = block(params, pool, table, toks, positions, rids,
+                              steps)
+            return pool, self._host_read(out)
 
         return fn
 
@@ -922,8 +960,10 @@ class ContinuousBatcher:
     # -- host-side bookkeeping --------------------------------------------
 
     def _worst_pages(self, req: Request) -> tuple:
-        """Worst-case OWN pages beyond the shared prefix pages, per side:
-        ``(target, draft)`` (draft 0 without speculative mode)."""
+        """Worst-case OWN pages beyond the shared prefix pages, per side,
+        plus the absolute position cap the reservation covers:
+        ``(target, draft, need_len)`` (draft 0 without speculative
+        mode)."""
         width = -(-req.prompt.size // self.prefill_bucket) * \
             self.prefill_bucket
         need_len = self.prefix_len + max(
@@ -941,10 +981,13 @@ class ContinuousBatcher:
                 # n_draft+1 positions past the end.
                 need_len += self.n_draft + 1
             elif req.stop_token is not None:
-                # A stop is detected one tick late: the already-
-                # dispatched extra tick writes one position past the
-                # stop (quota endings are host-predicted and never
-                # overshoot).
+                # A stop is detected one block late: reserve one position
+                # past the stop so the overshoot write can land in an own
+                # page.  With multi_step > 1 the overshoot can reach K-1
+                # further positions (and quota overruns up to K-1 exist
+                # too) — those are NOT reserved here: the ensure() clamp
+                # at _Row.limit keeps allocations within this
+                # reservation, and writes past it land on sink columns.
                 need_len += 1
         if need_len > self.max_len:
             raise ValueError(
@@ -956,7 +999,7 @@ class ContinuousBatcher:
         wd = 0
         if self.d_side is not None:
             wd = -(-(need_len - self.d_side.shared_len) // self.page_size)
-        return wt, wd
+        return wt, wd, need_len
 
     def _admit_row(self, free_rows: List[int], active: Dict[int, _Row],
                    wt: int, wd: int) -> Optional[int]:
@@ -1029,13 +1072,18 @@ class ContinuousBatcher:
         try:
             while True:
                 # Admit while a row is free and the pool can take the
-                # newcomer's worst case.
+                # newcomer's worst case.  Prefills DISPATCH inside the
+                # loop but their first-token fetches are deferred to one
+                # burst sync after it — admitting W requests costs one
+                # device-to-host round-trip, not W (the round-trip is
+                # the dominant per-call cost on remote-attached hosts).
+                burst = []
                 while free_rows and bad_request is None:
                     pull()
                     if not pending:
                         break
                     try:
-                        wt, wd = self._worst_pages(pending[0])
+                        wt, wd, need = self._worst_pages(pending[0])
                     except ValueError as e:
                         bad_request = e     # raise after draining
                         break
@@ -1045,7 +1093,15 @@ class ContinuousBatcher:
                     req = pending.popleft()
                     rid = self._next_rid
                     self._next_rid += 1
-                    done = self._admit(row, rid, req, wt, wd, active)
+                    res = self._admit_dispatch(row, rid, req, wt, wd,
+                                               need, active)
+                    if res is not None:
+                        burst.append(res)
+                for row, state, tok, s in burst:
+                    # The async transfers have been in flight since each
+                    # dispatch; these fetches mostly find the data ready.
+                    done = self._admit_finalize(state,
+                                                int(np.asarray(tok)[s]))
                     if done is not None:
                         self._finish(row, active, free_rows)
                         yield done
@@ -1096,11 +1152,15 @@ class ContinuousBatcher:
                 dst[side.alloc.shard_of(row)] = side.alloc.rows[row][0]
                 side.pool = side.copy(side.pool, side.tail_template, dst)
 
-    def _admit(self, row: int, rid: int, req: Request, wt: int, wd: int,
-               active: Dict[int, _Row]) -> Optional[Completion]:
-        """Prefill ``req`` into ``row``; ``wt``/``wd`` are the per-side
-        page reservations run() admitted it under.  Returns a Completion
-        when the very first token already finishes the request."""
+    def _admit_dispatch(self, row: int, rid: int, req: Request, wt: int,
+                        wd: int, need: int,
+                        active: Dict[int, _Row]) -> Optional[tuple]:
+        """Reserve + DISPATCH ``req``'s prefill into ``row`` without the
+        first-token host sync; ``wt``/``wd``/``need`` are the per-side
+        page reservations (and the position cap they cover) run()
+        admitted it under.  Returns ``(row, state, device_token, shard)``
+        for run()'s burst finalize — ``None`` in chunked mode, which
+        makes no model call here."""
         t_admit = time.perf_counter()
         length = req.prompt.size
         width = -(-length // self.prefill_bucket) * self.prefill_bucket
@@ -1113,7 +1173,7 @@ class ContinuousBatcher:
             state = _Row(rid=rid, req=req, pos=self.prefix_len + length,
                          step=1, last=0, out=[], worst_pages=wt,
                          worst_draft=wd, t_admit=t_admit, padded=padded,
-                         filled=0, decoding=False)
+                         filled=0, decoding=False, limit=need)
             active[row] = state
             return None
         s, toks, table = self._one_hot_call(self.t_side, row, padded)
@@ -1129,13 +1189,21 @@ class ContinuousBatcher:
             self.d_side.pool = self._draft_chunk(
                 self.draft_params, self.d_side.pool, dtable, dtoks,
                 jnp.asarray(self.prefix_len, jnp.int32))
-        tok = int(np.asarray(tok)[s])   # host sync: first token is real
-        now = time.perf_counter()
+        tok.copy_to_host_async()    # transfer overlaps later dispatches
         state = _Row(rid=rid, req=req, pos=self.prefix_len + length, step=1,
-                     last=tok, out=[tok], worst_pages=wt, worst_draft=wd,
-                     t_admit=t_admit, t_first=now)
+                     last=0, out=[], worst_pages=wt, worst_draft=wd,
+                     t_admit=t_admit, limit=need)
         active[row] = state
-        if tok == req.stop_token or req.max_new_tokens == 1:
+        return row, state, tok, s
+
+    def _admit_finalize(self, state: _Row,
+                        tok: int) -> Optional[Completion]:
+        """Record a burst-synced first token; Completion when it already
+        finishes the request."""
+        state.t_first = time.perf_counter()
+        state.last = tok
+        state.out = [tok]
+        if tok == state.req.stop_token or state.req.max_new_tokens == 1:
             return self._completion(state)
         return None
 
@@ -1184,16 +1252,24 @@ class ContinuousBatcher:
 
     def _step(self, active: Dict[int, _Row],
               free_rows: List[int]) -> Iterator[Completion]:
-        """One batched decode step over every DECODING row (chunked
-        prefill keeps still-filling rows out: their table rows mask to
-        the sink so the batched scatter cannot touch their pages)."""
+        """One K-step block (``multi_step``; K=1 = classic per-token
+        tick): a single dispatch decodes K tokens per decoding row and
+        the host syncs one [rows, K] block.  Rows that stop (or exhaust
+        quota) mid-block have their remaining in-block tokens discarded
+        here; the corresponding device writes landed inside the row's
+        reservation (ensure clamped at ``row.limit``) or on sink
+        columns, so no live state was touched.  Admission and
+        chunked-prefill advance happen between blocks.  (Chunked prefill
+        keeps still-filling rows out: their table rows mask to the sink
+        so the batched scatter cannot touch their pages.)"""
+        K = self.multi_step
         toks = np.zeros((self.rows,), np.int32)
         positions = np.zeros((self.rows,), np.int32)
         rids = np.zeros((self.rows,), np.int32)
         steps = np.zeros((self.rows,), np.int32)
         decoding = {r: row for r, row in active.items() if row.decoding}
         for r, row in decoding.items():
-            self._ensure_sides(r, row.pos + 1)  # this step writes `pos`
+            self._ensure_sides(r, min(row.pos + K, row.limit))
             toks[r] = row.last
             positions[r] = row.pos
             rids[r] = row.rid
@@ -1202,37 +1278,40 @@ class ContinuousBatcher:
         self.pool, nxt = self._decode(
             self.params, self.pool, table, jnp.asarray(toks),
             jnp.asarray(positions), jnp.asarray(rids), jnp.asarray(steps))
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)               # ONE host sync per K tokens
         for r in list(decoding):
             row = active[r]
-            tok = int(nxt[r])
-            row.out.append(tok)
-            row.step += 1
-            row.pos += 1
-            row.last = tok
-            if tok == row.req.stop_token or row.step >= \
-                    row.req.max_new_tokens:
-                done = self._completion(row)
-                self._finish(r, active, free_rows)
-                yield done
+            for j in range(K):
+                tok = int(nxt[r, j])
+                row.out.append(tok)
+                row.step += 1
+                row.pos += 1
+                row.last = tok
+                if tok == row.req.stop_token or row.step >= \
+                        row.req.max_new_tokens:
+                    done = self._completion(row)
+                    self._finish(r, active, free_rows)
+                    yield done
+                    break
 
     def _step_overlap(self, active: Dict[int, _Row],
                       free_rows: List[int]) -> Iterator[Completion]:
-        """One OVERLAP tick: dispatch the next batched decode step
-        without waiting for the previous one — rows in the previous
-        dispatch feed its device output straight back in (``use_dev``),
-        so the device never idles on a host round-trip — then retire the
-        previous dispatch (host bookkeeping one tick late).
-
-        Deterministic state (pos, step) advances at dispatch;
-        token-dependent state (out, last, stop detection) at retire.  A
-        stop token therefore surfaces one tick late: the extra dispatched
-        tick writes one position past the stop into the row's own pages
-        (reserved by ``_worst_pages``'s +1) and its output is discarded
-        by the rid-checked ticket.  Quota endings are host-predicted and
-        never overshoot.  Token streams are IDENTICAL to the
-        non-overlapping batcher's — same ops, same inputs, only the sync
-        point moves."""
+        """One OVERLAP K-block tick (K=1 = the classic double-buffered
+        tick): dispatch the next K-step block without waiting for the
+        previous one — rows in the previous dispatch chain from its
+        device-resident LAST token (``use_dev``), so the device never
+        idles on a host round-trip — then retire the previous block
+        (host bookkeeping one block late).  Deterministic state (pos,
+        step) advances at dispatch; token-dependent state (out, last,
+        stop detection) at retire.  Stops surface one block late: the
+        extra dispatched block's writes stay inside the row's
+        reservation clamp or on sink columns and its tokens fail the
+        rid-checked ticket.  Quota gating at dispatch uses
+        dispatched-token counts, so a block may overrun a quota by up to
+        K-1 tokens; retire truncates.  Token streams are IDENTICAL to
+        the non-overlapping batcher's — same ops, same inputs, only the
+        sync point moves."""
+        K = self.multi_step
         dispatch = {r: row for r, row in active.items()
                     if row.decoding and row.step < row.req.max_new_tokens}
         prev = self._inflight
@@ -1244,9 +1323,9 @@ class ContinuousBatcher:
             steps = np.zeros((self.rows,), np.int32)
             prev_ticket = {} if prev is None else prev[1]
             for r, row in dispatch.items():
-                self._ensure_sides(r, min(row.pos + 1, self.max_len))
+                self._ensure_sides(r, min(row.pos + K, row.limit))
                 if prev_ticket.get(r) == row.rid:
-                    use_dev[r] = True   # token = previous tick's output
+                    use_dev[r] = True   # token = prev block's last output
                 else:
                     toks[r] = row.last  # fresh admission / chunk flip
                 positions[r] = row.pos
@@ -1254,16 +1333,17 @@ class ContinuousBatcher:
                 steps[r] = row.step
             table = self.t_side.decode_table(active, dispatch)
             prev_nxt = (prev[0] if prev is not None
-                        else jnp.zeros((self.rows,), jnp.int32))
+                        else jnp.zeros((self.rows, K), jnp.int32))
             self.pool, nxt = self._decode(
                 self.params, self.pool, table, jnp.asarray(toks),
                 prev_nxt, jnp.asarray(use_dev), jnp.asarray(positions),
                 jnp.asarray(rids), jnp.asarray(steps))
+            nxt.copy_to_host_async()    # transfer overlaps the block
             self._inflight = (nxt,
                               {r: row.rid for r, row in dispatch.items()})
             for row in dispatch.values():
-                row.pos += 1
-                row.step += 1
+                row.pos += K
+                row.step += K
         else:
             self._inflight = None
         if prev is not None:
@@ -1271,25 +1351,26 @@ class ContinuousBatcher:
 
     def _retire(self, inflight, active: Dict[int, _Row],
                 free_rows: List[int]) -> Iterator[Completion]:
-        """Sync ONE overlap dispatch (a tick behind the newest) and do
-        its token-dependent bookkeeping.  Tickets carry the rid each row
-        was dispatched under: a row that stopped at the previous retire
-        (or was re-admitted since) fails the rid check and its garbage
-        output is dropped."""
+        """Sync ONE overlap K-block (a block behind the newest) and do
+        its token-dependent bookkeeping; rows that stopped at the
+        previous retire (or were re-admitted since) fail the rid check
+        and their block is dropped."""
         nxt, ticket = inflight
-        nxt = np.asarray(nxt)       # host sync: one tick behind dispatch
+        nxt = np.asarray(nxt)           # host sync: one block behind
         for r, rid in ticket.items():
             row = active.get(r)
             if row is None or row.rid != rid:
-                continue            # overshoot tick of a finished row
-            tok = int(nxt[r])
-            row.out.append(tok)
-            row.last = tok
-            if (tok == row.req.stop_token
-                    or len(row.out) >= row.req.max_new_tokens):
-                done = self._completion(row)
-                self._finish(r, active, free_rows)
-                yield done
+                continue                # overshoot block of a freed row
+            for j in range(self.multi_step):
+                tok = int(nxt[r, j])
+                row.out.append(tok)
+                row.last = tok
+                if (tok == row.req.stop_token
+                        or len(row.out) >= row.req.max_new_tokens):
+                    done = self._completion(row)
+                    self._finish(r, active, free_rows)
+                    yield done
+                    break
 
     def _step_spec(self, active: Dict[int, _Row],
                    free_rows: List[int]) -> Iterator[Completion]:
@@ -1401,6 +1482,8 @@ class ContinuousBatcher:
                 self.d_side.pool, table, dtable, jnp.asarray(toks),
                 jnp.asarray(positions), jnp.asarray(rids),
                 jnp.asarray(steps), jnp.asarray(use_dev), *carry)
+            g.copy_to_host_async()      # transfers overlap the round
+            nc.copy_to_host_async()
             self._inflight = (g, nc, pos_d, steps_d,
                               {r: row.rid for r, row in dispatch.items()})
         else:
