@@ -33,18 +33,37 @@ from tfmesos_tpu.ops.layers import (cross_entropy_loss,
                                     data_parallel_fused_cross_entropy,
                                     fused_linear_cross_entropy, rms_norm,
                                     vocab_parallel_ce_inbody,
-                                    rope, swiglu,
+                                    rope,
                                     vocab_parallel_cross_entropy)
 from tfmesos_tpu.ops.quant import QTensor, quantize_tensor
 
 
 def _wt(p, dtype):
-    """Weight-on-use: dequantize an int8 :class:`QTensor` (the convert+scale
-    fuses into the consuming matmul — HBM streams int8) or cast a plain
-    array to the compute dtype."""
+    """Weight-on-use: dequantize an int8 :class:`QTensor` or cast a plain
+    array to the compute dtype.  Matmul call sites should prefer
+    :func:`_qmm` — round-5 chip measurement showed XLA materializing the
+    scale*convert product from this form instead of fusing it into the
+    dot, costing MORE bandwidth than bf16 weights; kept for the einsum
+    sites (MoE experts) where the activation fold does not apply
+    directly."""
     if isinstance(p, QTensor):
         return p.dequantize(dtype)
     return p.astype(dtype)
+
+
+def _qmm(x, p, dtype):
+    """``x @ W`` for a plain or int8 weight.  A QTensor's per-input-
+    channel scales ([K, 1], K the contraction dim) commute across the
+    dot, so they fold into the (tiny) activation — ``(x * s) @ values``
+    — and the remaining pure int8->dtype convert DOES fuse into the
+    matmul, leaving HBM reading the int8 bytes only.  Measured on a v5e
+    chip at decode shapes (M=8, K=N=2048): 0.14 ms vs 0.34 ms for
+    ``x @ dequantize(W)`` and 0.36 ms for bf16 weights — the form that
+    makes int8 weights actually FASTER than bf16, not just smaller."""
+    if isinstance(p, QTensor):
+        s = p.scales.reshape(p.scales.shape[:-2] + (-1,)).astype(dtype)
+        return (x * s) @ p.values.astype(dtype)
+    return x @ p.astype(dtype)
 
 
 def _embed_lookup(p, tokens, dtype):
@@ -229,8 +248,10 @@ def quantize_params(cfg: TransformerConfig, params) -> Dict[str, Any]:
 
 
 def _mlp(cfg: TransformerConfig, lp, h):
-    return swiglu(h, _wt(lp["w_gate"], cfg.dtype),
-                  _wt(lp["w_up"], cfg.dtype), _wt(lp["w_down"], cfg.dtype))
+    # Unrolled swiglu so int8 weights ride the activation-folded _qmm.
+    g = jax.nn.silu(_qmm(h, lp["w_gate"], cfg.dtype))
+    return _qmm(g * _qmm(h, lp["w_up"], cfg.dtype), lp["w_down"],
+                cfg.dtype)
 
 
 def _zero_aux():
@@ -379,9 +400,11 @@ def _ffn(cfg: TransformerConfig, mesh, lp, h, ep_axis: Optional[str] = None,
             from tfmesos_tpu.parallel.collectives import (
                 broadcast_replicated_grad, psum_replicated_grad)
             h_s = broadcast_replicated_grad(h, tp_axis)
-        shared = swiglu(h_s, _wt(lp["s_gate"], cfg.dtype),
-                        _wt(lp["s_up"], cfg.dtype),
-                        _wt(lp["s_down"], cfg.dtype))
+        # Unrolled swiglu so int8 shared-expert weights ride the
+        # activation-folded _qmm (same reason as _mlp).
+        g_s = jax.nn.silu(_qmm(h_s, lp["s_gate"], cfg.dtype))
+        shared = _qmm(g_s * _qmm(h_s, lp["s_up"], cfg.dtype),
+                      lp["s_down"], cfg.dtype)
         if tp_axis is not None:
             shared = (psum_replicated_grad(shared, tp_axis) if inbody_ad
                       else jax.lax.psum(shared, tp_axis))
@@ -471,9 +494,9 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
         fan = lambda v_: v_
         red = lambda v_: jax.lax.psum(v_, tp_axis)
     h = fan(rms_norm(x, lp["attn_norm"].astype(cfg.dtype)))
-    q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, heads_loc, cfg.head_dim)
-    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, kv_loc, cfg.head_dim)
-    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, kv_loc, cfg.head_dim)
+    q = _qmm(h, lp["wq"], cfg.dtype).reshape(b, t, heads_loc, cfg.head_dim)
+    k = _qmm(h, lp["wk"], cfg.dtype).reshape(b, t, kv_loc, cfg.head_dim)
+    v = _qmm(h, lp["wv"], cfg.dtype).reshape(b, t, kv_loc, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     if sp_axis is not None:
@@ -483,7 +506,7 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     else:
         o = attend(q, k, v, mesh=None, causal=True,
                    window=cfg.window)  # local heads
-    x = x + red(o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype))
+    x = x + red(_qmm(o.reshape(b, t, -1), lp["wo"], cfg.dtype))
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     if cfg.n_experts:
         # The MoE half fans/reduces internally (over ep AND tp — the f/g
@@ -564,9 +587,9 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
     tick's branches — see ``_sp_gather_attention``)."""
     b, t, d = x.shape
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
-    q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, cfg.kv_heads, cfg.head_dim)
-    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.kv_heads, cfg.head_dim)
+    q = _qmm(h, lp["wq"], cfg.dtype).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = _qmm(h, lp["wk"], cfg.dtype).reshape(b, t, cfg.kv_heads, cfg.head_dim)
+    v = _qmm(h, lp["wv"], cfg.dtype).reshape(b, t, cfg.kv_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     if sp_axis is not None:
@@ -578,7 +601,7 @@ def _block(cfg: TransformerConfig, mesh: Optional[Mesh], x, lp, positions,
         # the sp impls broadcast up internally.
         o = attend(q, k, v, mesh=mesh, causal=True, sp_impl=cfg.sp_impl,
                    window=cfg.window)
-    x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
+    x = x + _qmm(o.reshape(b, t, -1), lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, aux = _ffn(cfg, mesh, lp, h, ep_axis=ep_axis, inbody_ad=inbody_ad)
     return x + ffn, aux
@@ -589,7 +612,7 @@ def forward(cfg: TransformerConfig, params, tokens, mesh: Optional[Mesh] = None,
     """tokens [B, T] int32 → logits [B, T, V] (plus per-layer-averaged router
     aux metrics when ``return_aux``)."""
     x, aux = forward_hidden(cfg, params, tokens, mesh)
-    logits = x @ _wt(params["head"], cfg.dtype)
+    logits = _qmm(x, params["head"], cfg.dtype)
     return (logits, aux) if return_aux else logits
 
 
@@ -1154,12 +1177,12 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     else:
         m = (ck.values if isinstance(ck, QTensor) else ck).shape[1]
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
-    q = (h @ _wt(lp["wq"], cfg.dtype)).reshape(b, t, cfg.n_heads,
-                                               cfg.head_dim)
-    k = (h @ _wt(lp["wk"], cfg.dtype)).reshape(b, t, cfg.kv_heads,
-                                               cfg.head_dim)
-    v = (h @ _wt(lp["wv"], cfg.dtype)).reshape(b, t, cfg.kv_heads,
-                                               cfg.head_dim)
+    q = _qmm(h, lp["wq"], cfg.dtype).reshape(b, t, cfg.n_heads,
+                                             cfg.head_dim)
+    k = _qmm(h, lp["wk"], cfg.dtype).reshape(b, t, cfg.kv_heads,
+                                             cfg.head_dim)
+    v = _qmm(h, lp["wv"], cfg.dtype).reshape(b, t, cfg.kv_heads,
+                                             cfg.head_dim)
     pos_row = positions                                 # [b, t]
     q = rope(q, pos_row, cfg.rope_theta)
     k = rope(k, pos_row, cfg.rope_theta)
@@ -1251,7 +1274,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
         s = jnp.where(bad[:, None, None], -jnp.inf, s)
         probs = jax.nn.softmax(s, axis=-1).astype(cv_r.dtype)
         o = jnp.einsum("bkgtm,bmkd->btkgd", probs, cv_r)
-    x = x + o.reshape(b, t, -1) @ _wt(lp["wo"], cfg.dtype)
+    x = x + _qmm(o.reshape(b, t, -1), lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, _ = _ffn(cfg, None, lp, h)
     return x + ffn, ck, cv
@@ -1322,7 +1345,7 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
-    logits = x @ _wt(params["head"], cfg.dtype)
+    logits = _qmm(x, params["head"], cfg.dtype)
     out_cache = {"k": new_k, "v": new_v}
     if pages is not None:
         out_cache["pages"] = pages

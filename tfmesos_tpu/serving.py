@@ -1079,6 +1079,14 @@ class ContinuousBatcher:
                 # the dominant per-call cost on remote-attached hosts).
                 burst = []
                 while free_rows and bad_request is None:
+                    if not pending and not exhausted and burst:
+                        # pull() may BLOCK in next(source) (a staggered
+                        # stream): settle the in-flight admissions first
+                        # so their first tokens (and any instant
+                        # completions) are not held hostage to the next
+                        # arrival — this also keeps t_first honest.
+                        yield from self._finalize_burst(burst, active,
+                                                        free_rows)
                     pull()
                     if not pending:
                         break
@@ -1097,14 +1105,7 @@ class ContinuousBatcher:
                                                need, active)
                     if res is not None:
                         burst.append(res)
-                for row, state, tok, s in burst:
-                    # The async transfers have been in flight since each
-                    # dispatch; these fetches mostly find the data ready.
-                    done = self._admit_finalize(state,
-                                                int(np.asarray(tok)[s]))
-                    if done is not None:
-                        self._finish(row, active, free_rows)
-                        yield done
+                yield from self._finalize_burst(burst, active, free_rows)
                 if not active:
                     if bad_request is not None:
                         raise bad_request
@@ -1206,6 +1207,19 @@ class ContinuousBatcher:
         if tok == state.req.stop_token or state.req.max_new_tokens == 1:
             return self._completion(state)
         return None
+
+    def _finalize_burst(self, burst: list, active: Dict[int, _Row],
+                        free_rows: List[int]) -> Iterator[Completion]:
+        """Drain a dispatch burst: fetch each admission's first token
+        (the async transfers have been in flight since dispatch, so
+        these mostly find the data ready) and yield any instant
+        completions.  Clears ``burst`` in place."""
+        for row, state, tok, s in burst:
+            done = self._admit_finalize(state, int(np.asarray(tok)[s]))
+            if done is not None:
+                self._finish(row, active, free_rows)
+                yield done
+        burst.clear()
 
     def _advance_prefill(self, active: Dict[int, _Row]) -> Optional[int]:
         """Write ONE chunk of the oldest still-prefilling row; flips the
