@@ -117,13 +117,19 @@ def test_serve_example_end_to_end(tmp_path, paged):
             {"tokens": [7] * 5}]
     inp.write_text("".join(json.dumps(r) + "\n" for r in rows))
     out = tmp_path / "served.jsonl"
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # The example runs as a direct subprocess (sys.path[0] = examples/),
+    # so the package root must ride PYTHONPATH — the scheduler forwards
+    # sys.path for its workers (spec.py:195), but this path bypasses it.
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [repo] + os.environ.get("PYTHONPATH", "").split(
+                       os.pathsep)).rstrip(os.pathsep))
     proc = subprocess.run(
         [sys.executable, "examples/serve.py", "--tiny", "--batch", "2",
          "--new-tokens", "4", "--input", str(inp), "--out", str(out)]
         + (["--paged"] if paged else []),
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        env=env, capture_output=True, timeout=240)
+        cwd=repo, env=env, capture_output=True, timeout=240)
     assert proc.returncode == 0, proc.stderr.decode()
     served = [json.loads(line) for line in out.read_text().splitlines()]
     assert len(served) == 3
