@@ -308,11 +308,24 @@ def test_cross_attention_gradient():
 
 
 def _decode_inputs(b=2, m=1024, h=8, kv=2, d=64, dtype=jnp.float32, seed=0):
+    # Caches in the kernel-native [B, KV, M, D] layout (init_cache's,
+    # minus the layer dim).
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
     q = jax.random.normal(ks[0], (b, h, d), dtype)
-    kc = jax.random.normal(ks[1], (b, m, kv, d), dtype)
-    vc = jax.random.normal(ks[2], (b, m, kv, d), dtype)
+    kc = jax.random.normal(ks[1], (b, kv, m, d), dtype)
+    vc = jax.random.normal(ks[2], (b, kv, m, d), dtype)
     return q, kc, vc
+
+
+def _lane_major_quant(c):
+    """int8-quantize a [B, KV, M, D] cache slice into the cache's
+    LANE-MAJOR QTensor form (scales [B, KV, 1, M]); also returns the
+    dequantized array for references."""
+    from tfmesos_tpu.ops.quant import QTensor, quantize_tensor
+
+    qt = quantize_tensor(c)     # per-position scales [B, KV, M, 1]
+    lane = QTensor(qt.values, jnp.swapaxes(qt.scales, -1, -2))
+    return lane, qt.dequantize(jnp.float32)
 
 
 @pytest.mark.parametrize("pos", [0, 5, 511, 512, 700, 1023])
@@ -366,12 +379,10 @@ def test_flash_decode_int8_cache(pos):
     into the score/probability rows — bit-identical to dequantize-then-
     attend."""
     from tfmesos_tpu.ops.attention import _decode_reference, flash_decode
-    from tfmesos_tpu.ops.quant import quantize_tensor
     q, kc, vc = _decode_inputs()
-    kq, vq = quantize_tensor(kc), quantize_tensor(vc)
-    ref = _decode_reference(q, kq.dequantize(jnp.float32),
-                            vq.dequantize(jnp.float32), pos,
-                            q.shape[-1] ** -0.5)
+    kq, kd = _lane_major_quant(kc)
+    vq, vd = _lane_major_quant(vc)
+    ref = _decode_reference(q, kd, vd, pos, q.shape[-1] ** -0.5)
     got = flash_decode(q, kq, vq, pos, use_pallas=True, interpret=True,
                        block_m=256)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -420,7 +431,7 @@ def test_flash_decode_ragged_positions():
         ri = _decode_reference(q[i:i + 1], kc[i:i + 1], vc[i:i + 1], p,
                                q.shape[-1] ** -0.5)
         np.testing.assert_allclose(np.asarray(ref[i:i + 1]), np.asarray(ri),
-                                   rtol=1e-6)
+                                   rtol=1e-6, atol=1e-6)
     got = flash_decode(q, kc, vc, posv, use_pallas=True, interpret=True,
                        block_m=256)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -435,8 +446,8 @@ def test_flash_decode_chunk_matches_reference(pos):
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     b, m, h, kv, d, t = 2, 1024, 4, 2, 32, 5
     q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
-    kc = jax.random.normal(ks[1], (b, m, kv, d), jnp.float32)
-    vc = jax.random.normal(ks[2], (b, m, kv, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, kv, m, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, kv, m, d), jnp.float32)
     ref = _decode_reference(q, kc, vc, pos, d ** -0.5)
     got = flash_decode(q, kc, vc, pos, use_pallas=True, interpret=True,
                        block_m=256)
@@ -446,21 +457,20 @@ def test_flash_decode_chunk_matches_reference(pos):
 
 def test_flash_decode_chunk_ragged_and_int8():
     from tfmesos_tpu.ops.attention import _decode_reference, flash_decode
-    from tfmesos_tpu.ops.quant import quantize_tensor
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     b, m, h, kv, d, t = 2, 512, 4, 2, 32, 3
     q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
-    kc = jax.random.normal(ks[1], (b, m, kv, d), jnp.float32)
-    vc = jax.random.normal(ks[2], (b, m, kv, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, kv, m, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, kv, m, d), jnp.float32)
     posv = jnp.array([7, 400], jnp.int32)
     ref = _decode_reference(q, kc, vc, posv, d ** -0.5)
     got = flash_decode(q, kc, vc, posv, use_pallas=True, interpret=True,
                        block_m=128)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
-    kq, vq = quantize_tensor(kc), quantize_tensor(vc)
-    ref8 = _decode_reference(q, kq.dequantize(jnp.float32),
-                             vq.dequantize(jnp.float32), posv, d ** -0.5)
+    kq, kd = _lane_major_quant(kc)
+    vq, vd = _lane_major_quant(vc)
+    ref8 = _decode_reference(q, kd, vd, posv, d ** -0.5)
     got8 = flash_decode(q, kq, vq, posv, use_pallas=True, interpret=True,
                         block_m=128)
     np.testing.assert_allclose(np.asarray(got8), np.asarray(ref8),
@@ -507,8 +517,8 @@ def test_flash_decode_paged_scrambled_pool():
     b, h, kv, d, ps, npg = 3, 4, 2, 32, 128, 8
     m = ps * npg
     q = jax.random.normal(ks[0], (b, h, d), jnp.float32)
-    kc = jax.random.normal(ks[1], (b, m, kv, d), jnp.float32)
-    vc = jax.random.normal(ks[2], (b, m, kv, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, kv, m, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, kv, m, d), jnp.float32)
     pool_n = b * npg + 5
     perm = np.random.RandomState(0).permutation(pool_n)[:b * npg].reshape(
         b, npg)
@@ -517,10 +527,8 @@ def test_flash_decode_paged_scrambled_pool():
     v_pool = np.zeros((pool_n, kv, ps, d), np.float32)
     for i in range(b):
         for j in range(npg):
-            k_pool[perm[i, j]] = np.asarray(
-                kc[i, j * ps:(j + 1) * ps]).transpose(1, 0, 2)
-            v_pool[perm[i, j]] = np.asarray(
-                vc[i, j * ps:(j + 1) * ps]).transpose(1, 0, 2)
+            k_pool[perm[i, j]] = np.asarray(kc[i, :, j * ps:(j + 1) * ps])
+            v_pool[perm[i, j]] = np.asarray(vc[i, :, j * ps:(j + 1) * ps])
     pt = jnp.asarray(perm, jnp.int32)
     for pos in (0, 200, jnp.array([5, 700, 1023], jnp.int32)):
         ref = _decode_reference(q, kc, vc, pos, d ** -0.5)

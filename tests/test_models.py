@@ -778,10 +778,11 @@ def test_rolling_window_cache_is_window_sized_and_exact():
     cfg = dataclasses.replace(TINY, window=4, max_seq_len=32)
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
     cache = transformer.init_cache(cfg, 2, 32)
-    assert cache["k"].shape == (cfg.n_layers, 2, 4, 2, 16)  # 4 slots only
+    # [L, B, KV, M, Dh] with M = 4 slots only
+    assert cache["k"].shape == (cfg.n_layers, 2, 2, 4, 16)
 
     q8 = transformer.init_cache(cfg, 2, 32, quantized=True)
-    assert q8["k"].values.shape[2] == 4
+    assert q8["k"].values.shape[3] == 4
 
     # March a 24-token teacher-forced stream through the rolling cache and
     # compare each step's logits to the windowed full-sequence forward.
@@ -1027,7 +1028,8 @@ def test_gqa_decode_matches_forward_and_cache_shrinks():
     full = transformer.forward(GQA, params, tokens)
 
     cache = transformer.init_cache(GQA, 2, 16)
-    assert cache["k"].shape == (2, 2, 16, 2, GQA.head_dim)  # kv_heads=2
+    # [L, B, KV, M, Dh] — kv_heads=2
+    assert cache["k"].shape == (2, 2, 2, 16, GQA.head_dim)
 
     logits, cache = transformer.decode_step(GQA, params, cache, tokens, 0)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(full),

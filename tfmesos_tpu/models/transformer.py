@@ -742,14 +742,22 @@ def forward_hidden(cfg: TransformerConfig, params, tokens,
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                dtype=None, quantized: bool = False) -> Dict[str, Any]:
-    """KV cache for autoregressive decoding: per-layer stacked K/V buffers
-    (consumed by the same ``lax.scan`` over layers the forward uses).
+    """KV cache for autoregressive decoding: stacked
+    [L, B, KV, M, head_dim] K/V buffers — kv-head-major with (seq,
+    head_dim) trailing, the flash-decode kernel's native tiling, so
+    decode never transposes cache-sized data.  The layer scan CARRIES
+    the stacked buffers and each step writes its token slot in place at
+    its layer index (``_cache_write``); per-step HBM traffic is the slot
+    write plus what attention actually reads — never a restack of the
+    whole buffer.
 
-    ``quantized=True`` stores the cache as int8 :class:`QTensor`s with one
-    fp32 absmax scale per (layer, batch, position, head) — long-context
-    decode streams the whole cache every step, so halving its bytes vs
-    bf16 is the long-prompt analogue of weight-only int8.  Writes quantize
-    the incoming K/V chunk; reads dequantize at the attention einsum.
+    ``quantized=True`` stores the cache as int8 :class:`QTensor`s with
+    one fp32 absmax scale per (layer, batch, head, position), held
+    LANE-MAJOR ([L, B, KV, 1, M] — positions on the trailing dim, as the
+    kernel consumes them) — long-context decode streams the whole cache
+    every step, so halving its bytes vs bf16 is the long-prompt analogue
+    of weight-only int8.  Writes quantize the incoming K/V chunk; reads
+    fold the scales in-kernel (or dequantize at the attention einsum).
 
     With sliding-window attention (``cfg.window``) the buffer is a ROLLING
     cache of ``window`` slots (slot = position mod window): a position's
@@ -759,22 +767,21 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     """
     if cfg.window is not None:
         max_len = min(max_len, cfg.window)
+    shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.head_dim)
     if quantized:
         if dtype is not None:
             raise ValueError("init_cache: dtype and quantized=True conflict "
                              "(an int8 cache's dtypes are fixed)")
-        shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
 
         def buf():
             # Distinct buffers for k and v, matching the fp path — aliasing
             # one QTensor for both halves would break if decode ever donates
             # the cache (the same buffer donated twice).
             return QTensor(jnp.zeros(shape, jnp.int8),
-                           jnp.ones(shape[:-1] + (1,), jnp.float32))
+                           jnp.ones(shape[:-2] + (1, max_len), jnp.float32))
 
         return {"k": buf(), "v": buf()}
     dtype = dtype or cfg.dtype
-    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -885,13 +892,16 @@ class PageAllocator:
         return jnp.asarray(t)
 
 
-def _paged_cache_write(pool, chunk, page_table, pos):
-    """Write a [B, t, H, Dh] chunk into the page pool ([P, KV, page, Dh];
-    int8 QTensors quantize per position on the way in) at logical
-    positions ``pos..pos+t-1`` per row (``pos`` scalar or [B]): one
-    scatter over (page, offset) pairs chased through the table."""
+def _paged_cache_write(pool, chunk, li, page_table, pos):
+    """Write a [B, t, H, Dh] chunk into layer ``li`` of the STACKED page
+    pool ([L, P, KV, page, Dh]; int8 QTensors quantize per position on
+    the way in) at logical positions ``pos..pos+t-1`` per row (``pos``
+    scalar or [B]): one scatter over (page, offset) pairs chased through
+    the table.  The pool is a layer-scan CARRY, so the scatter updates
+    it in place — per-step traffic is the written slots, never the
+    pool."""
     b, t = chunk.shape[:2]
-    ps = (pool.values if isinstance(pool, QTensor) else pool).shape[2]
+    ps = (pool.values if isinstance(pool, QTensor) else pool).shape[3]
     posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
     lpos = posv[:, None] + jnp.arange(t, dtype=jnp.int32)[None]   # [B, t]
     # Clamp the block index explicitly: serving parks inactive rows at
@@ -904,24 +914,27 @@ def _paged_cache_write(pool, chunk, page_table, pos):
     offs = (lpos % ps).reshape(-1)
 
     def put(buf, x):
-        return buf.at[pages, :, offs].set(
+        return buf.at[li, pages, :, offs].set(
             x.reshape(b * t, *x.shape[2:]).astype(buf.dtype))
 
     if isinstance(pool, QTensor):
         from tfmesos_tpu.ops.quant import quantize_int8_reference
         vals, scale = quantize_int8_reference(chunk)
-        # Scales pool is lane-major [P, KV, 1, page] (see
-        # init_paged_cache): scatter at (page, :, 0, offset).
-        scales = pool.scales.at[pages, :, 0, offs].set(
+        # Scales pool is lane-major [L, P, KV, 1, page] (see
+        # init_paged_cache): scatter at (layer, page, :, 0, offset).
+        scales = pool.scales.at[li, pages, :, 0, offs].set(
             scale.reshape(b * t, scale.shape[2]))
         return QTensor(put(pool.values, vals), scales)
     return put(pool, chunk)
 
 
-def _cache_write(cache, chunk, pos, rolling: bool = False):
-    """Insert a [B, t, H, Dh] K or V chunk at position ``pos`` of a cache
-    layer, quantizing on the way in when the cache is int8 (the same
-    per-row absmax rule as weight quantization — ops/quant.py).
+def _cache_write(cache, chunk, li, pos, rolling: bool = False):
+    """Insert a [B, t, H, Dh] K or V chunk at position ``pos`` of layer
+    ``li`` of the STACKED cache ([L, B, KV, M, Dh]), quantizing on the
+    way in when the cache is int8 (the same per-row absmax rule as
+    weight quantization — ops/quant.py).  The cache is a layer-scan
+    CARRY and every path below is an indexed in-place update on the full
+    buffer — one slot's traffic per step, never a buffer restack.
 
     ``pos`` may be a [B] vector (ragged serving: each row writes at its
     own position — a vmapped per-row dynamic slice; non-rolling caches
@@ -933,66 +946,106 @@ def _cache_write(cache, chunk, pos, rolling: bool = False):
     scatter.  Non-rolling caches keep the plain dynamic-slice write (which
     supports traced multi-token positions — the buffer never wraps).
     """
-    m = (cache.values if isinstance(cache, QTensor) else cache).shape[1]
+    m = (cache.values if isinstance(cache, QTensor) else cache).shape[3]
     t = chunk.shape[1]
     ragged = getattr(pos, "ndim", 0) == 1
     if ragged and rolling:
         raise ValueError("ragged positions do not compose with rolling "
                          "(windowed) caches")
 
-    def put(buf, x):
+    def _put(buf, x, axis):
+        """Write ``x`` (shaped like ``buf[li]``, t positions on ``axis``
+        of the full buffer) at ``pos`` of layer ``li`` — values and
+        scales share every branch; only the position axis differs (3 for
+        [L, B, KV, M, Dh'] values, 4 for [L, B, KV, 1, M] scales)."""
+        def start(p, rank, ax):
+            s = [0] * rank
+            s[0], s[ax] = li, p
+            return tuple(s)
+
         if ragged:
+            # Per row b: buf[:, b] gets its row's chunk at its own
+            # position (the batch dim drops, shifting the axis by one).
             return jax.vmap(
                 lambda b_, x_, p_: jax.lax.dynamic_update_slice(
-                    b_, x_, (p_,) + (0,) * (b_.ndim - 1)))(buf, x, pos)
+                    b_, x_[None], start(p_, b_.ndim, axis - 1)),
+                in_axes=(1, 0, 0), out_axes=1)(buf, x, pos)
         if not rolling:
-            return jax.lax.dynamic_update_slice(buf, x, (0, pos, 0, 0))
+            return jax.lax.dynamic_update_slice(
+                buf, x[None], start(pos, buf.ndim, axis))
         if t == 1:
-            return jax.lax.dynamic_update_slice(buf, x, (0, pos % m, 0, 0))
+            return jax.lax.dynamic_update_slice(
+                buf, x[None], start(pos % m, buf.ndim, axis))
         if not isinstance(pos, int):
             raise ValueError("multi-token rolling-cache writes need a "
                              "static position (prefill); decode rolls one "
                              "token at a time")
         if pos + t <= m:
-            return jax.lax.dynamic_update_slice(buf, x, (0, pos, 0, 0))
-        keep = x[:, -m:]
-        idx = (jnp.arange(pos + t - keep.shape[1], pos + t)) % m
-        return buf.at[:, idx].set(keep)
+            return jax.lax.dynamic_update_slice(
+                buf, x[None], start(pos, buf.ndim, axis))
+        # Wrapping prefill (one-time): modular scatter on the layer slice,
+        # written back whole — chunk-sized work at a static position.
+        keep = jax.lax.slice_in_dim(x, max(0, t - m), t, axis=axis - 1)
+        idx = (jnp.arange(pos + t - keep.shape[axis - 1], pos + t)) % m
+        lay = jax.lax.dynamic_index_in_dim(buf, li, 0, keepdims=False)
+        lay = lay.at[(slice(None),) * (axis - 1) + (idx,)].set(keep)
+        return jax.lax.dynamic_update_slice(
+            buf, lay[None], start(0, buf.ndim, axis))
+
+    def put(buf, x):
+        # x [B, t, KV, Dh'] -> head-major [B, KV, t, Dh'] (a chunk-sized
+        # transpose; the cache itself is already head-major).
+        return _put(buf, x.transpose(0, 2, 1, 3).astype(buf.dtype), 3)
+
+    def put_scales(buf, s):
+        # s [B, t, KV, 1] -> lane-major [B, KV, 1, t] (positions on the
+        # trailing dim, matching the [L, B, KV, 1, M] scales buffer).
+        return _put(buf, s.transpose(0, 2, 3, 1), 4)
 
     if isinstance(cache, QTensor):
         from tfmesos_tpu.ops.quant import quantize_int8_reference
         vals, scale = quantize_int8_reference(chunk)
-        return QTensor(put(cache.values, vals), put(cache.scales, scale))
-    return put(cache, chunk.astype(cache.dtype))
+        return QTensor(put(cache.values, vals),
+                       put_scales(cache.scales, scale))
+    return put(cache, chunk)
 
 
-def _cache_read(cache, dtype):
-    """The [B, M, H, Dh] view attention consumes; int8 caches dequantize
-    here (the convert+scale fuses into the einsum, so HBM streams int8);
-    fp caches pass through at their own dtype (a caller-widened fp32
-    cache keeps fp32 attention math, as before)."""
-    return cache.dequantize(dtype) if isinstance(cache, QTensor) else cache
+def _cache_read(cache, li, dtype):
+    """The [B, KV, M, Dh] view of layer ``li`` that einsum attention
+    consumes; int8 caches dequantize here (the convert+scale fuses into
+    the einsum, so HBM streams int8); fp caches pass through at their own
+    dtype (a caller-widened fp32 cache keeps fp32 attention math, as
+    before).  Kernel paths never call this — they read the stacked
+    buffer directly at the layer index."""
+    from tfmesos_tpu.ops.attention import _dequant_lane_major
+
+    take = lambda a: jax.lax.dynamic_index_in_dim(a, li, 0, keepdims=False)
+    if isinstance(cache, QTensor):
+        return _dequant_lane_major(
+            QTensor(take(cache.values), take(cache.scales)), dtype)
+    return take(cache)
 
 
 def cache_specs(cfg: TransformerConfig, mesh: Mesh,
                 quantized: bool = False) -> Dict[str, Any]:
-    """PartitionSpecs for the KV cache: batch over the data axes, heads over
-    tp — the decode analogue of ``partition_specs``.  Place the cache (and
-    params) with these and jit ``decode_step(..., sharded=True)``: every op
-    is then a plain einsum, so GSPMD inserts the tp collectives — no manual
-    decode variant needed.  With GQA the cache's head axis is ``kv_heads``,
-    so tp must divide it.  ``quantized=True`` mirrors an int8
-    ``init_cache``: each leaf becomes a QTensor of specs (scales share the
-    values' spec minus the head_dim entry)."""
+    """PartitionSpecs for the KV cache ([L, B, KV, M, Dh]): batch over the
+    data axes, heads over tp — the decode analogue of ``partition_specs``.
+    Place the cache (and params) with these and jit
+    ``decode_step(..., sharded=True)``: every op is then a plain einsum,
+    so GSPMD inserts the tp collectives — no manual decode variant
+    needed.  With GQA the cache's head axis is ``kv_heads``, so tp must
+    divide it.  ``quantized=True`` mirrors an int8 ``init_cache``: each
+    leaf becomes a QTensor of specs (the lane-major scales
+    [L, B, KV, 1, M] shard on the same leading dims)."""
     from tfmesos_tpu.parallel.sharding import data_axes
     tp = mesh.shape.get("tp", 1)
     if tp > 1 and cfg.kv_heads % tp:
         raise ValueError(
             f"cache_specs: tp ({tp}) must divide kv_heads "
             f"({cfg.kv_heads}) to shard the KV cache's head axis")
-    spec = _filter_spec(P(None, data_axes(mesh), None, "tp", None), mesh)
+    spec = _filter_spec(P(None, data_axes(mesh), "tp", None, None), mesh)
     if quantized:
-        spec = _quantized_spec(spec)
+        spec = QTensor(values=spec, scales=spec)
     return {"k": spec, "v": spec}
 
 
@@ -1055,9 +1108,12 @@ def _check_sharded_paged(cfg: TransformerConfig, mesh: Optional[Mesh],
 
 
 def _sharded_paged_step(cfg: TransformerConfig, mesh: Mesh, q, k, v, ck,
-                        cv, pages, positions, attend: bool = True):
+                        cv, li, pages, positions, attend: bool = True):
     """Paged write + paged attention as ONE shard_map island over the
-    ``paged_cache_specs`` layout.  Each data shard owns a sub-pool whose
+    ``paged_cache_specs`` layout ([L, P, KV, page, Dh] pools, carried
+    whole with ``li`` the layer index — writes scatter in place at the
+    index and the kernel reads through its scalar prefetch, exactly as
+    on the single-host path).  Each data shard owns a sub-pool whose
     pages its rows' table entries index LOCALLY, so the gather/scatter
     indirection never crosses shards; heads shard over tp with GQA
     grouping preserved per shard (tp divides both head counts).  No
@@ -1070,48 +1126,51 @@ def _sharded_paged_step(cfg: TransformerConfig, mesh: Mesh, q, k, v, ck,
 
     da = data_axes(mesh)
     qkv = _filter_spec(P(da, None, "tp", None), mesh)
-    pool = _filter_spec(P(da, "tp", None, None), mesh)
+    pool = _filter_spec(P(None, da, "tp", None, None), mesh)
     if isinstance(ck, QTensor):
         pool = QTensor(values=pool, scales=pool)
     tbl = _filter_spec(P(da, None), mesh)
+    li = jnp.asarray(li, jnp.int32)
 
-    def write(ck, cv, k, v, pages, posv):
-        ck = _paged_cache_write(ck, k, pages, posv)
-        cv = _paged_cache_write(cv, v, pages, posv)
+    def write(ck, cv, k, v, li, pages, posv):
+        ck = _paged_cache_write(ck, k, li, pages, posv)
+        cv = _paged_cache_write(cv, v, li, pages, posv)
         return ck, cv
 
     if not attend:
-        def local(q, k, v, ck, cv, pages, positions):
-            ck, cv = write(ck, cv, k, v, pages, positions[:, 0])
+        def local(q, k, v, ck, cv, li, pages, positions):
+            ck, cv = write(ck, cv, k, v, li, pages, positions[:, 0])
             return ck, cv
 
         fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(qkv, qkv, qkv, pool, pool, tbl, tbl),
+                       in_specs=(qkv, qkv, qkv, pool, pool, P(), tbl, tbl),
                        out_specs=(pool, pool), check_vma=False)
-        ck, cv = fn(q, k, v, ck, cv, pages, positions)
+        ck, cv = fn(q, k, v, ck, cv, li, pages, positions)
         return None, ck, cv
 
     t = q.shape[1]
-    ps_ = (ck.values if isinstance(ck, QTensor) else ck).shape[2]
+    ps_ = (ck.values if isinstance(ck, QTensor) else ck).shape[3]
     m = pages.shape[1] * ps_
     kernel_kw = _decode_kernel_kwargs(cfg, m, t, False)
 
-    def local(q, k, v, ck, cv, pages, positions):
+    def local(q, k, v, ck, cv, li, pages, positions):
         posv = positions[:, 0]
-        ck, cv = write(ck, cv, k, v, pages, posv)
+        ck, cv = write(ck, cv, k, v, li, pages, posv)
         from tfmesos_tpu.ops.attention import (_paged_decode_reference,
                                                flash_decode_paged)
         if kernel_kw is not None:
-            o = flash_decode_paged(q, ck, cv, pages, posv, **kernel_kw)
+            o = flash_decode_paged(q, ck, cv, pages, posv, layer=li,
+                                   **kernel_kw)
         else:
             o = _paged_decode_reference(q, ck, cv, pages, posv,
-                                        1.0 / math.sqrt(cfg.head_dim))
+                                        1.0 / math.sqrt(cfg.head_dim),
+                                        layer=li)
         return o, ck, cv
 
     fn = jax.shard_map(local, mesh=mesh,
-                   in_specs=(qkv, qkv, qkv, pool, pool, tbl, tbl),
+                   in_specs=(qkv, qkv, qkv, pool, pool, P(), tbl, tbl),
                    out_specs=(qkv, pool, pool), check_vma=False)
-    return fn(q, k, v, ck, cv, pages, positions)
+    return fn(q, k, v, ck, cv, li, pages, positions)
 
 
 def _decode_kernel_kwargs(cfg: TransformerConfig, m: int, t: int,
@@ -1152,16 +1211,20 @@ def _decode_kernel_kwargs(cfg: TransformerConfig, m: int, t: int,
 
 
 
-def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
-                  sharded: bool = False, mesh: Optional[Mesh] = None,
+def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, li, positions,
+                  pos, sharded: bool = False, mesh: Optional[Mesh] = None,
                   pages=None):
     """One block over a token chunk with cached history.
 
     ``x``: [B, t, d] (t = chunk length; 1 in steady-state decode);
-    ``ck``/``cv``: [B, M, H, Dh] this layer's cache; ``positions``:
-    [B, t] per-row global positions of the chunk (rows differ in the
-    ragged case); ``pos``: first chunk position — scalar (python int or
-    traced) or [B] vector, as handed to ``_cache_write``.
+    ``ck``/``cv``: the STACKED cache ([L, B, KV, M, Dh], or the paged
+    pool [L, P, KV, page, Dh]) carried through the layer scan, with
+    ``li`` this block's layer index — writes update one slot in place at
+    the index and the kernels read O(pos) at the index through their
+    scalar prefetch, so the full buffer is never restacked or sliced;
+    ``positions``: [B, t] per-row global positions of the chunk (rows
+    differ in the ragged case); ``pos``: first chunk position — scalar
+    (python int or traced) or [B] vector, as handed to ``_cache_write``.
     A multi-token prefill from an empty cache attends chunk-to-chunk (flash
     kernel when ``sharded=False``; a plain einsum when ``sharded=True`` so
     GSPMD can partition it — a pallas_call under sharded jit cannot be).
@@ -1172,10 +1235,10 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
     """
     b, t, _ = x.shape
     if pages is not None:
-        ps_ = (ck.values if isinstance(ck, QTensor) else ck).shape[2]
+        ps_ = (ck.values if isinstance(ck, QTensor) else ck).shape[3]
         m = pages.shape[1] * ps_            # logical length (NP x page)
     else:
-        m = (ck.values if isinstance(ck, QTensor) else ck).shape[1]
+        m = (ck.values if isinstance(ck, QTensor) else ck).shape[3]
     h = rms_norm(x, lp["attn_norm"].astype(cfg.dtype))
     q = _qmm(h, lp["wq"], cfg.dtype).reshape(b, t, cfg.n_heads,
                                              cfg.head_dim)
@@ -1195,14 +1258,14 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
         # stays plain einsums).  Prefill-from-empty writes in the island
         # and attends chunk-to-chunk outside it.
         o_paged, ck, cv = _sharded_paged_step(
-            cfg, mesh, q, k, v, ck, cv, pages, positions,
+            cfg, mesh, q, k, v, ck, cv, li, pages, positions,
             attend=not self_attn_prefill)
     elif pages is not None:
-        ck = _paged_cache_write(ck, k, pages, pos)
-        cv = _paged_cache_write(cv, v, pages, pos)
+        ck = _paged_cache_write(ck, k, li, pages, pos)
+        cv = _paged_cache_write(cv, v, li, pages, pos)
     else:
-        ck = _cache_write(ck, k, pos, rolling=rolling)
-        cv = _cache_write(cv, v, pos, rolling=rolling)
+        ck = _cache_write(ck, k, li, pos, rolling=rolling)
+        cv = _cache_write(cv, v, li, pos, rolling=rolling)
     kv = cfg.kv_heads
     g = cfg.n_heads // kv
     if t > 1 and isinstance(pos, int) and pos == 0:
@@ -1224,11 +1287,12 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
                                                flash_decode_paged)
         kw = _decode_kernel_kwargs(cfg, m, t, False)
         if kw is not None:
-            o = flash_decode_paged(q, ck, cv, pages, positions[:, 0], **kw)
+            o = flash_decode_paged(q, ck, cv, pages, positions[:, 0],
+                                   layer=li, **kw)
         else:
             o = _paged_decode_reference(
                 q, ck, cv, pages, positions[:, 0],
-                1.0 / math.sqrt(cfg.head_dim))
+                1.0 / math.sqrt(cfg.head_dim), layer=li)
     elif (kernel_kw := _decode_kernel_kwargs(cfg, m, t, sharded, mesh,
                                              batch=b)) is not None:
         # Cache-bounded flash-decode kernel (t=1 steps and short chunks —
@@ -1241,18 +1305,19 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
         if sharded:
             from tfmesos_tpu.ops.attention import sharded_flash_decode
             o = sharded_flash_decode(q, ck, cv, positions[:, 0], mesh,
-                                     **kernel_kw)
+                                     layer=li, **kernel_kw)
         else:
             from tfmesos_tpu.ops.attention import flash_decode
-            o = flash_decode(q, ck, cv, positions[:, 0], **kernel_kw)
+            o = flash_decode(q, ck, cv, positions[:, 0], layer=li,
+                             **kernel_kw)
     else:
-        # Grouped einsum over the cache: the KV blocks stream from HBM
-        # once at kv_heads width (int8 when quantized) — never
-        # materialized at n_heads.
-        ck_r = _cache_read(ck, cfg.dtype)
-        cv_r = _cache_read(cv, cfg.dtype)
+        # Grouped einsum over this layer's cache slice: the KV blocks
+        # stream from HBM once at kv_heads width (int8 when quantized) —
+        # never materialized at n_heads.
+        ck_r = _cache_read(ck, li, cfg.dtype)
+        cv_r = _cache_read(cv, li, cfg.dtype)
         q5 = q.reshape(b, t, kv, g, cfg.head_dim)
-        s = jnp.einsum("btkgd,bmkd->bkgtm", q5, ck_r).astype(jnp.float32)
+        s = jnp.einsum("btkgd,bkmd->bkgtm", q5, ck_r).astype(jnp.float32)
         s = s / math.sqrt(cfg.head_dim)
         if cfg.window is not None:
             # Rolling cache: slot j holds global position p - ((p - j) % M)
@@ -1273,7 +1338,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, positions, pos,
             bad = kpos[None] > positions[:, :, None]    # [b, t, m]
         s = jnp.where(bad[:, None, None], -jnp.inf, s)
         probs = jax.nn.softmax(s, axis=-1).astype(cv_r.dtype)
-        o = jnp.einsum("bkgtm,bmkd->btkgd", probs, cv_r)
+        o = jnp.einsum("bkgtm,bkmd->btkgd", probs, cv_r)
     x = x + _qmm(o.reshape(b, t, -1), lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, _ = _ffn(cfg, None, lp, h)
@@ -1336,14 +1401,22 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
                   else cache["k"]).shape[1]
         _check_sharded_paged(cfg, mesh, b, n_pool)
 
+    # The cache is the scan CARRY, not xs/ys: each layer writes its token
+    # slot in place at its index and the attention kernels read O(pos) at
+    # the index.  Scanning the cache through xs/ys instead would restack
+    # the ENTIRE [L, ...] buffer every step — ~2 GB of HBM traffic per
+    # token at max_len=16k, an order of magnitude over the einsum's own
+    # read cost (measured round 5).
     def body(carry, layer):
-        lp, ck, cv = layer
-        out, ck, cv = _block_decode(cfg, carry, lp, ck, cv, positions, pos,
-                                    sharded=sharded, mesh=mesh, pages=pages)
-        return out, (ck, cv)
+        x, ck, cv = carry
+        li, lp = layer
+        x, ck, cv = _block_decode(cfg, x, lp, ck, cv, li, positions, pos,
+                                  sharded=sharded, mesh=mesh, pages=pages)
+        return (x, ck, cv), None
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (jnp.arange(cfg.n_layers, dtype=jnp.int32), params["layers"]))
     x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
     logits = _qmm(x, params["head"], cfg.dtype)
     out_cache = {"k": new_k, "v": new_v}

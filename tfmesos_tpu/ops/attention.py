@@ -504,16 +504,17 @@ def _decode_reference(q, k_cache, v_cache, pos, scale):
     truth / non-TPU path for ``flash_decode``).  Grouped einsum: the cache
     streams at kv width, q heads grouped kv-major as [kv, g].  ``q`` is
     [B, H, D] (single token) or [B, t, H, D] (chunk; token tt sees
-    positions <= pos + tt)."""
+    positions <= pos + tt); the cache is the kernel-native
+    [B, KV, M, D] (seq and head_dim trailing)."""
     squeeze = q.ndim == 3
     if squeeze:
         q = q[:, None]
     b, t, h, d = q.shape
-    kv = k_cache.shape[2]
+    kv = k_cache.shape[1]
     g = h // kv
-    m = k_cache.shape[1]
+    m = k_cache.shape[2]
     q5 = q.reshape(b, t, kv, g, d)
-    s = jnp.einsum("btkgd,bmkd->bkgtm", q5, k_cache).astype(jnp.float32)
+    s = jnp.einsum("btkgd,bkmd->bkgtm", q5, k_cache).astype(jnp.float32)
     s = s * scale
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
     kpos = jnp.arange(m, dtype=jnp.int32)
@@ -522,7 +523,7 @@ def _decode_reference(q, k_cache, v_cache, pos, scale):
                                                                None])
     s = jnp.where(bad[:, None, None], NEG_INF, s)       # [b,kv,g,t,m]
     p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
-    o = jnp.einsum("bkgtm,bmkd->btkgd", p, v_cache)
+    o = jnp.einsum("bkgtm,bkmd->btkgd", p, v_cache)
     o = o.reshape(b, t, h, d)
     return o[:, 0] if squeeze else o
 
@@ -536,12 +537,17 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
     steady-state decode, t > 1 for speculative verify / chunked prefill.
     Chunk token tt sees cache positions <= pos_first + tt.
 
-    ``s_ref`` holds the scalar-prefetched per-row pairs (n_live_blocks,
-    first chunk position).  Blocks past the bound are skipped AND their
-    index map pins to the last live block, so Mosaic's unchanged-index
-    elision never DMAs them — HBM traffic is O(pos), not O(max_len).
-    Online softmax accumulates across the m grid dim in VMEM scratch; the
-    normalized output writes once on the final step.
+    ``s_ref`` holds the scalar-prefetched per-row triples (n_live_blocks,
+    first chunk position, layer index).  Blocks past the bound are skipped
+    AND their index map pins to the last live block, so Mosaic's
+    unchanged-index elision never DMAs them — HBM traffic is O(pos), not
+    O(max_len).  Online softmax accumulates across the m grid dim in VMEM
+    scratch; the normalized output writes once on the final step.
+
+    K/V refs are blocks of the STACKED cache ([L, ..., block_m, d] — the
+    layer index rides row 2 of the scalar prefetch into the index maps),
+    so decoding never materializes a per-layer slice: the scan over
+    layers reads O(pos) from the full buffer directly.
 
     ``quantized``: K/V refs are int8 with per-position fp32 scale refs
     following them.  The scales fold into the score/probability rows
@@ -566,8 +572,8 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
     @pl.when(j < nb)
     def _step():
         q = q_ref[0, 0, :, :]                       # [t*g, d]
-        k_blk = k_ref[0, 0, :, :]                   # [bm, d]
-        v_blk = v_ref[0, 0, :, :]
+        k_blk = k_ref[0, 0, 0, :, :]                # [bm, d]
+        v_blk = v_ref[0, 0, 0, :, :]
         if quantized:
             k_blk = k_blk.astype(q.dtype)           # VMEM convert, not HBM
             v_blk = v_blk.astype(jnp.float32)
@@ -575,7 +581,7 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
                                 preferred_element_type=jnp.float32)
         s = s * scale                               # [t*g, bm]
         if quantized:
-            s = s * ks_ref[0, 0, 0, :][None, :]     # per-position k scales
+            s = s * ks_ref[0, 0, 0, 0, :][None, :]  # per-position k scales
         kpos = j * block_m + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         tt = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // q_per_kv
@@ -589,7 +595,7 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
         m_acc[...] = m_new
         l_acc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         if quantized:
-            p = p * vs_ref[0, 0, 0, :][None, :]     # per-position v scales
+            p = p * vs_ref[0, 0, 0, 0, :][None, :]  # per-position v scales
         o_acc[...] = o_prev * corr + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -600,22 +606,59 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
         o_ref[0, 0, :, :] = (o_acc[...] / l_acc[...]).astype(o_ref.dtype)
 
 
+def _dequant_lane_major(qt_leaf, dtype):
+    """Dequantize a lane-major QTensor cache slice (values [..., M, D],
+    scales [..., 1, M]): move the per-position scales back over the seq
+    dim and multiply (test/CPU path — the kernel streams int8)."""
+    return (qt_leaf.values.astype(dtype)
+            * jnp.swapaxes(qt_leaf.scales, -1, -2).astype(dtype))
+
+
+def _stacked_cache(k_cache, v_cache, layer):
+    """Normalize a decode cache to its STACKED form: returns
+    (kc, vc, k_scales, v_scales, layer_idx, quantized) with kc/vc
+    [L, ..., M|page, D] and lane-major scales [L, ..., 1, M|page] (None
+    when not quantized).  A 4-D cache is lifted to L=1 (``layer`` must
+    then be None/0)."""
+    from tfmesos_tpu.ops.quant import QTensor
+
+    quantized = isinstance(k_cache, QTensor)
+    kc = k_cache.values if quantized else k_cache
+    vc = v_cache.values if quantized else v_cache
+    ks = k_cache.scales if quantized else None
+    vs = v_cache.scales if quantized else None
+    if kc.ndim == 4:
+        if layer is not None and not (isinstance(layer, int) and layer == 0):
+            raise ValueError("layer index needs a stacked 5-D cache")
+        kc, vc = kc[None], vc[None]
+        if quantized:
+            ks, vs = ks[None], vs[None]
+        layer = 0
+    layer = jnp.asarray(0 if layer is None else layer, jnp.int32)
+    return kc, vc, ks, vs, layer, quantized
+
+
 def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
                  block_m: int = 512, use_pallas: Optional[bool] = None,
-                 interpret: bool = False):
+                 interpret: bool = False, layer=None):
     """Single-token decode attention over a KV cache, bounded at ``pos``.
 
     ``q``: [B, H, D] (one new token's heads, kv-major groups) or
     [B, t, H, D] (a CHUNK — speculative verify / chunked prefill; chunk
     token tt attends cache positions <= pos + tt, the cache already
     holding the chunk's own K/V);
-    ``k_cache``/``v_cache``: [B, M, KV, D] with the attended positions
-    written — plain arrays, or int8 ``QTensor``s (per-position scales),
-    in which case HBM streams int8 and the scales fold into the score
-    rows; ``pos``: scalar int32, or a [B] vector for RAGGED batches (each
-    row at its own position — the mixed-length serving case); traced OK
-    either way (it rides the kernel's scalar prefetch, bounding each
-    row's block loop independently).  Returns q's shape.
+    ``k_cache``/``v_cache``: the kernel-native layout [B, KV, M, D]
+    ((seq, head_dim) trailing — no per-call transpose of cache-sized
+    data), or the STACKED [L, B, KV, M, D] buffer with ``layer`` the
+    (traced OK) layer index — the ``decode_step`` layer scan passes the
+    whole cache and the index rides the scalar prefetch, so no per-layer
+    slice is ever materialized.  Plain arrays, or int8 ``QTensor``s with
+    LANE-MAJOR scales ([(L,) B, KV, 1, M], as ``init_cache`` builds
+    them), in which case HBM streams int8 and the scales fold into the
+    score rows; ``pos``: scalar int32, or a [B] vector for RAGGED
+    batches (each row at its own position — the mixed-length serving
+    case); traced OK either way (it rides the kernel's scalar prefetch,
+    bounding each row's block loop independently).  Returns q's shape.
 
     The XLA einsum reads all M cache slots every step because ``pos`` is
     traced; this kernel's grid maps the out-of-range m-blocks to the last
@@ -624,17 +667,14 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     position 2k and paying for 32k.  GQA runs at cache width: the score
     block is [g, block_m] per kv head, no materialized repeat.
     """
-    from tfmesos_tpu.ops.quant import QTensor
-
-    quantized = isinstance(k_cache, QTensor)
-    kc = k_cache.values if quantized else k_cache
-    vc = v_cache.values if quantized else v_cache
+    kc, vc, ksc, vsc, li, quantized = _stacked_cache(k_cache, v_cache,
+                                                     layer)
     squeeze = q.ndim == 3
     if squeeze:
         q = q[:, None]
     b, t, h, d = q.shape
-    m, kv = kc.shape[1], kc.shape[2]
-    _check_gqa_heads(q, kc, vc)  # heads at axis 2
+    kv, m = kc.shape[2], kc.shape[3]
+    _check_gqa_heads(q, kc, vc)     # heads at axis 2 of the stacked cache
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     g = h // kv
@@ -644,15 +684,21 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
         on_tpu = jax.default_backend() == "tpu"
         use_pallas = aligned and (on_tpu or interpret)
     if not use_pallas:
+        take = lambda a: jax.lax.dynamic_index_in_dim(a, li, 0,
+                                                      keepdims=False)
+        k_l, v_l = take(kc), take(vc)
         if quantized:
-            k_cache = k_cache.dequantize(q.dtype)
-            v_cache = v_cache.dequantize(q.dtype)
-        out = _decode_reference(q, k_cache, v_cache, pos, scale)
+            from tfmesos_tpu.ops.quant import QTensor
+            k_l = _dequant_lane_major(QTensor(k_l, take(ksc)), q.dtype)
+            v_l = _dequant_lane_major(QTensor(v_l, take(vsc)), q.dtype)
+        out = _decode_reference(q, k_l, v_l, pos, scale)
         return out[:, 0] if squeeze else out
 
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
-    # Bound from each row's LAST chunk position.
-    scalars = jnp.stack([(pos + t - 1) // block_m + 1, pos])    # [2, B]
+    # Per-row (block bound from the LAST chunk position, first position,
+    # layer index) — all three ride the scalar prefetch.
+    scalars = jnp.stack([(pos + t - 1) // block_m + 1, pos,
+                         jnp.broadcast_to(li, (b,))])           # [3, B]
     if not quantized and q.dtype != kc.dtype:
         # e.g. bf16 queries over a caller-widened fp32 cache: the kernel's
         # dots need one operand dtype (promote, matching the einsum path).
@@ -662,29 +708,27 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     # mask derives the token index as row // g).
     qt = q.reshape(b, t, kv, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, kv, t * g, d)
-    # [B, M, KV, D] -> [B, KV, M, D]: (seq, head_dim) trailing for tiling.
-    kt = kc.transpose(0, 2, 1, 3)
-    vt = vc.transpose(0, 2, 1, 3)
 
     q_spec = pl.BlockSpec((1, 1, t * g, d),
                           lambda bi, hi, j, s: (bi, hi, 0, 0),
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec(
-        (1, 1, block_m, d),
-        lambda bi, hi, j, s: (bi, hi, jnp.minimum(j, s[0, bi] - 1), 0),
+        (1, 1, 1, block_m, d),
+        lambda bi, hi, j, s: (s[2, 0], bi, hi,
+                              jnp.minimum(j, s[0, bi] - 1), 0),
         memory_space=pltpu.VMEM)
     in_specs = [q_spec, kv_spec, kv_spec]
-    operands = [qt, kt, vt]
+    operands = [qt, kc, vc]
     if quantized:
-        # Scales as [B, KV, 1, M]: positions on the lane dim, same pinned
-        # index map as their values.
+        # Scales stay stacked lane-major [L, B, KV, 1, M]: positions on
+        # the lane dim, same pinned index map as their values.
         sc_spec = pl.BlockSpec(
-            (1, 1, 1, block_m),
-            lambda bi, hi, j, s: (bi, hi, 0, jnp.minimum(j, s[0, bi] - 1)),
+            (1, 1, 1, 1, block_m),
+            lambda bi, hi, j, s: (s[2, 0], bi, hi, 0,
+                                  jnp.minimum(j, s[0, bi] - 1)),
             memory_space=pltpu.VMEM)
         in_specs += [sc_spec, sc_spec]
-        operands += [k_cache.scales[..., 0].transpose(0, 2, 1)[:, :, None, :],
-                     v_cache.scales[..., 0].transpose(0, 2, 1)[:, :, None, :]]
+        operands += [ksc, vsc]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, kv, m // block_m),
@@ -704,7 +748,7 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * t * h * m * d,
-            bytes_accessed=(kc.size * kc.dtype.itemsize * 2
+            bytes_accessed=(kc[0].size * kc.dtype.itemsize * 2
                             + 2 * q.size * q.dtype.itemsize),
             transcendentals=b * t * h * m),
     )(scalars, *operands)
@@ -713,24 +757,29 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     return out[:, 0] if squeeze else out
 
 
-def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale):
+def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale,
+                            layer=None):
     """Gather-the-pages ground truth: materialize each row's logical cache
-    view from the pool ([P, KV, page, D]; int8 QTensors dequantize) and
-    run the dense masked reference."""
+    view from the pool ([P, KV, page, D], or the stacked
+    [L, P, KV, page, D] with ``layer``; int8 QTensors dequantize) and run
+    the dense masked reference."""
     from tfmesos_tpu.ops.quant import QTensor
 
-    if isinstance(k_pool, QTensor):
+    kc, vc, ksc, vsc, li, quantized = _stacked_cache(k_pool, v_pool, layer)
+    take = lambda a: jax.lax.dynamic_index_in_dim(a, li, 0, keepdims=False)
+    k_pool, v_pool = take(kc), take(vc)
+    if quantized:
         # Paged pools carry LANE-MAJOR scales ([P, KV, 1, page]); move
         # them back over the positions to dequantize (test/CPU path —
         # the kernel consumes the lane-major layout directly).
-        deq = lambda p: (p.values.astype(q.dtype)
-                         * p.scales.transpose(0, 1, 3, 2).astype(q.dtype))
-        k_pool, v_pool = deq(k_pool), deq(v_pool)
+        k_pool = _dequant_lane_major(QTensor(k_pool, take(ksc)), q.dtype)
+        v_pool = _dequant_lane_major(QTensor(v_pool, take(vsc)), q.dtype)
     b = q.shape[0]
     kv, ps = k_pool.shape[1], k_pool.shape[2]
     np_ = page_table.shape[1]
-    gather = lambda pool: pool[page_table].transpose(0, 1, 3, 2, 4).reshape(
-        b, np_ * ps, kv, pool.shape[3])
+    # [B, NP, KV, page, D] -> the contiguous [B, KV, NP*page, D] view.
+    gather = lambda pool: pool[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, kv, np_ * ps, pool.shape[3])
     return _decode_reference(q, gather(k_pool), gather(v_pool), pos, scale)
 
 
@@ -750,7 +799,7 @@ def _flash_decode_paged_kernel(s_ref, pt_ref, *rest, block_m: int,
 def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
                        scale: Optional[float] = None,
                        use_pallas: Optional[bool] = None,
-                       interpret: bool = False):
+                       interpret: bool = False, layer=None):
     """Decode attention over a PAGED KV cache: each row's logical cache is
     a list of physical pages in a shared pool (``page_table`` [B, NP]
     int32 — logical block j of row b lives at
@@ -762,27 +811,23 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
 
     ``q``: [B, H, D] or [B, t, H, D]; ``k_pool``/``v_pool``:
     [P, KV, page, D] (page and head_dim trailing — the pool's NATIVE
-    layout, so no per-call transpose of the shared pool), plain arrays
-    or int8 ``QTensor``s (LANE-MAJOR scales [P, KV, 1, page], as
-    ``init_paged_cache`` builds them; HBM streams int8 and the
+    layout, so no per-call transpose of the shared pool), or the STACKED
+    [L, P, KV, page, D] pool with ``layer`` the (traced OK) layer index
+    — the layer scan passes the whole pool and the index rides the
+    scalar prefetch, so no per-layer slice is materialized.  Plain
+    arrays or int8 ``QTensor``s (LANE-MAJOR scales [(L,) P, KV, 1,
+    page], as ``init_paged_cache`` builds them; HBM streams int8 and the
     per-position scales fold into the score rows in-kernel);
     ``pos``: scalar or [B] int32 — positions [0..pos(+t-1)] must be
     backed by pages.  Returns q's shape.
     """
-    from tfmesos_tpu.ops.quant import QTensor
-
-    quantized = isinstance(k_pool, QTensor)
-    kp = k_pool.values if quantized else k_pool
-    vp = v_pool.values if quantized else v_pool
+    kp, vp, ksc, vsc, li, quantized = _stacked_cache(k_pool, v_pool, layer)
     squeeze = q.ndim == 3
     if squeeze:
         q = q[:, None]
     b, t, h, d = q.shape
-    kv, ps = kp.shape[1], kp.shape[2]
-    if h % kv or vp.shape[1] != kv:
-        raise ValueError(
-            f"q heads ({h}) must be a multiple of kv heads "
-            f"({kv}/{vp.shape[1]}, which must agree)")
+    kv, ps = kp.shape[2], kp.shape[3]
+    _check_gqa_heads(q, kp, vp)     # kv heads at axis 2 of the pool
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     g = h // kv
@@ -796,11 +841,12 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
             f"Mosaic-tileable (needs a multiple of 8, <= 1024)")
     if not use_pallas:
         out = _paged_decode_reference(q, k_pool, v_pool, page_table, pos,
-                                      scale)
+                                      scale, layer=layer)
         return out[:, 0] if squeeze else out
 
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
-    scalars = jnp.stack([(pos + t - 1) // ps + 1, pos])     # [2, B]
+    scalars = jnp.stack([(pos + t - 1) // ps + 1, pos,
+                         jnp.broadcast_to(li, (b,))])           # [3, B]
     page_table = jnp.asarray(page_table, jnp.int32)
     if not quantized and q.dtype != kp.dtype:
         q = q.astype(jnp.promote_types(q.dtype, kp.dtype))
@@ -812,22 +858,22 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
                           lambda bi, hi, j, s, pt: (bi, hi, 0, 0),
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec(
-        (1, 1, ps, d),
+        (1, 1, 1, ps, d),
         lambda bi, hi, j, s, pt: (
-            pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
+            s[2, 0], pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
         memory_space=pltpu.VMEM)
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [qt, kp, vp]     # pools already (page, head_dim)-trailing
     if quantized:
-        # Scales as [P, KV, 1, page]: positions on the lane dim, same
+        # Scales as [L, P, KV, 1, page]: positions on the lane dim, same
         # page-chasing index map as their values.
         sc_spec = pl.BlockSpec(
-            (1, 1, 1, ps),
+            (1, 1, 1, 1, ps),
             lambda bi, hi, j, s, pt: (
-                pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
+                s[2, 0], pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
             memory_space=pltpu.VMEM)
         in_specs += [sc_spec, sc_spec]
-        operands += [k_pool.scales, v_pool.scales]  # already lane-major
+        operands += [ksc, vsc]                      # already lane-major
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kv, page_table.shape[1]),
@@ -847,7 +893,7 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * t * h * page_table.shape[1] * ps * d,
-            bytes_accessed=(kp.size * kp.dtype.itemsize * 2
+            bytes_accessed=(kp[0].size * kp.dtype.itemsize * 2
                             + 2 * q.size * q.dtype.itemsize),
             transcendentals=b * t * h * page_table.shape[1] * ps),
     )(scalars, page_table, *operands)
@@ -856,16 +902,17 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     return out[:, 0] if squeeze else out
 
 
-def sharded_flash_decode(q, k_cache, v_cache, pos, mesh, **kw):
+def sharded_flash_decode(q, k_cache, v_cache, pos, mesh, layer=None, **kw):
     """``flash_decode`` under GSPMD decode: shard_map over the data axes
     (batch) and tp (kv-major head blocks — the transformer
     ``cache_specs`` layout), each device running the kernel on its local
-    [b_loc(, t), M, kv_loc, D] block.  Requires tp | kv_heads (the same
-    alignment condition as ``sharded_flash_attention``).  The output
+    [L, b_loc, kv_loc, M, D] cache block.  Requires tp | kv_heads (the
+    same alignment condition as ``sharded_flash_attention``).  The output
     stays head-sharded; the caller's output projection contracts it and
     GSPMD inserts the tp psum exactly as on the einsum path.  ``k_cache``
-    / ``v_cache`` may be int8 ``QTensor``s (specs pair up per leaf);
-    ``q`` may be [B, H, D] or a chunk [B, t, H, D]."""
+    / ``v_cache`` are the STACKED [L, B, KV, M, D] buffers (lane-major
+    int8 ``QTensor``s pair up per leaf; ``layer`` selects the layer
+    in-kernel); ``q`` may be [B, H, D] or a chunk [B, t, H, D]."""
     from jax.sharding import PartitionSpec as P
 
     from tfmesos_tpu.ops.quant import QTensor
@@ -875,14 +922,16 @@ def sharded_flash_decode(q, k_cache, v_cache, pos, mesh, **kw):
     heads = "tp" if mesh.shape.get("tp", 1) > 1 else None
     qspec = (P(batch, heads, None) if q.ndim == 3
              else P(batch, None, heads, None))
-    cspec = P(batch, None, heads, None)
+    cspec = P(None, batch, heads, None, None)
     if isinstance(k_cache, QTensor):
-        cspec = QTensor(cspec, P(batch, None, heads, None))
+        cspec = QTensor(cspec, P(None, batch, heads, None, None))
+    li = jnp.asarray(0 if layer is None else layer, jnp.int32)
     fn = jax.shard_map(
-        lambda q_, k_, v_, p_: flash_decode(q_, k_, v_, p_, **kw),
-        mesh=mesh, in_specs=(qspec, cspec, cspec, P(batch)),
+        lambda q_, k_, v_, p_, l_: flash_decode(q_, k_, v_, p_, layer=l_,
+                                                **kw),
+        mesh=mesh, in_specs=(qspec, cspec, cspec, P(batch), P()),
         out_specs=qspec, check_vma=False)
-    return fn(q, k_cache, v_cache, pos)
+    return fn(q, k_cache, v_cache, pos, li)
 
 
 def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
