@@ -543,3 +543,45 @@ def test_flash_decode_paged_scrambled_pool():
                              pt, 300, use_pallas=True, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_paged_deferred_self():
+    """Deferred-write decode (self_kv): the pool holds positions < pos
+    with stale garbage AT pos; the kernel must attend pool[0..pos-1] +
+    the uncommitted self chunk, matching the committed-pool reference —
+    scalar and ragged positions, including pos=0 (self only)."""
+    from tfmesos_tpu.ops.attention import (_decode_reference,
+                                           _paged_decode_reference,
+                                           flash_decode_paged)
+
+    ks = jax.random.split(jax.random.PRNGKey(7), 5)
+    b, h, kv, d, ps, npg = 3, 4, 2, 32, 128, 4
+    m = ps * npg
+    q = jax.random.normal(ks[0], (b, h, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, kv, m, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, kv, m, d), jnp.float32)
+    k_self = jax.random.normal(ks[3], (b, 1, kv, d), jnp.float32)
+    v_self = jax.random.normal(ks[4], (b, 1, kv, d), jnp.float32)
+    pt = jnp.asarray(np.arange(b * npg, dtype=np.int32).reshape(b, npg))
+    pool = lambda c: c.reshape(b, kv, npg, ps, d).transpose(
+        0, 2, 1, 3, 4).reshape(b * npg, kv, ps, d)
+    k_pool, v_pool = pool(kc), pool(vc)
+    for pos in (0, 5, 200, jnp.array([0, 130, 511], jnp.int32)):
+        posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+        # Committed ground truth: self written at each row's position.
+        put = jax.vmap(lambda c_, s_, p_: jax.lax.dynamic_update_slice(
+            c_, s_[:, None], (0, p_, 0)))
+        ref = _decode_reference(q, put(kc, k_self[:, 0], posv),
+                                put(vc, v_self[:, 0], posv), pos,
+                                d ** -0.5)
+        got = flash_decode_paged(q, k_pool, v_pool, pt, pos,
+                                 use_pallas=True, interpret=True,
+                                 self_kv=(k_self, v_self))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        # The gather-the-pages reference path takes the same self route.
+        got_ref = _paged_decode_reference(q, k_pool, v_pool, pt, pos,
+                                          d ** -0.5,
+                                          self_kv=(k_self, v_self))
+        np.testing.assert_allclose(np.asarray(got_ref), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)

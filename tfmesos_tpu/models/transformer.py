@@ -930,6 +930,36 @@ def _paged_cache_write(pool, chunk, li, page_table, pos):
     return put(pool, chunk)
 
 
+def _paged_cache_write_all(pool, chunks, page_table, pos):
+    """Commit ALL layers' deferred single-token chunks ([L, B, 1, KV, Dh],
+    stacked by the decode layer scan) in ONE scatter per pool leaf —
+    2L scatters per token become 2 (one scatter op costs ~0.5 ms on TPU
+    regardless of payload, so the op COUNT is the serving decode's write
+    cost).  Same index math (sink clamp included) and same per-row
+    absmax int8 rule as the per-layer ``_paged_cache_write``."""
+    L, b, t, kvh, dh = chunks.shape
+    ps = (pool.values if isinstance(pool, QTensor) else pool).shape[3]
+    posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    blk = jnp.minimum(posv // ps, page_table.shape[1] - 1)
+    pages = jnp.take_along_axis(page_table, blk[:, None], axis=1)[:, 0]
+    offs = posv % ps
+    x = chunks[:, :, 0]                         # [L, B, KV, Dh]
+
+    def put(buf, x):
+        # Advanced indices (pages, offs) around the slices front the
+        # batch dim: updates arrive [B, L, KV, Dh'].
+        return buf.at[:, pages, :, offs].set(
+            x.transpose(1, 0, 2, 3).astype(buf.dtype))
+
+    if isinstance(pool, QTensor):
+        from tfmesos_tpu.ops.quant import quantize_int8_reference
+        vals, scale = quantize_int8_reference(x)
+        scales = pool.scales.at[:, pages, :, 0, offs].set(
+            scale[..., 0].transpose(1, 0, 2))
+        return QTensor(put(pool.values, vals), scales)
+    return put(pool, x)
+
+
 def _cache_write(cache, chunk, li, pos, rolling: bool = False):
     """Insert a [B, t, H, Dh] K or V chunk at position ``pos`` of layer
     ``li`` of the STACKED cache ([L, B, KV, M, Dh]), quantizing on the
@@ -1290,6 +1320,14 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, li, positions,
     rolling = cfg.window is not None
     self_attn_prefill = t > 1 and isinstance(pos, int) and pos == 0
     o_paged = None
+    # Single-host t=1 paged steps DEFER their pool commit: one XLA
+    # scatter costs ~0.5 ms regardless of size (measured, v5e), so the
+    # per-layer write-then-attend order would spend 2L scatters per
+    # token.  Instead the chunk rides into attention as a SELF operand
+    # (kernel: a one-slot block accumulated at the last grid step;
+    # reference: written into the gathered view) and decode_step commits
+    # ALL layers' chunks in one scatter per pool leaf after the scan.
+    defer = pages is not None and not sharded and t == 1
     if pages is not None and sharded:
         # Multi-chip serving: write + paged attention per shard (the page
         # indirection cannot be GSPMD-partitioned; everything around it
@@ -1299,8 +1337,9 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, li, positions,
             cfg, mesh, q, k, v, ck, cv, li, pages, positions,
             attend=not self_attn_prefill)
     elif pages is not None:
-        ck = _paged_cache_write(ck, k, li, pages, pos)
-        cv = _paged_cache_write(cv, v, li, pages, pos)
+        if not defer:
+            ck = _paged_cache_write(ck, k, li, pages, pos)
+            cv = _paged_cache_write(cv, v, li, pages, pos)
     else:
         ck = _cache_write(ck, k, li, pos, rolling=rolling)
         cv = _cache_write(cv, v, li, pos, rolling=rolling)
@@ -1332,14 +1371,26 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, li, positions,
         # not GSPMD-partition).
         from tfmesos_tpu.ops.attention import (_paged_decode_reference,
                                                flash_decode_paged)
+        self_kv = None
+        if defer:
+            # int8 pools: quantize-dequantize the chunk so the self
+            # operand matches a committed slot bit for bit.
+            if isinstance(ck, QTensor):
+                from tfmesos_tpu.ops.quant import quantize_int8_reference
+                rq = lambda c: (lambda v_, s_: (v_.astype(cfg.dtype)
+                                                * s_.astype(cfg.dtype)))(
+                    *quantize_int8_reference(c))
+                self_kv = (rq(k), rq(v))
+            else:
+                self_kv = (k, v)
         kw = _decode_kernel_kwargs(cfg, m, t, False)
         if kw is not None:
             o = flash_decode_paged(q, ck, cv, pages, positions[:, 0],
-                                   layer=li, **kw)
+                                   layer=li, self_kv=self_kv, **kw)
         else:
             o = _paged_decode_reference(
                 q, ck, cv, pages, positions[:, 0],
-                1.0 / math.sqrt(cfg.head_dim), layer=li)
+                1.0 / math.sqrt(cfg.head_dim), layer=li, self_kv=self_kv)
     elif (kernel_kw := _decode_kernel_kwargs(cfg, m, t, sharded, mesh,
                                              batch=b)) is not None:
         # Cache-bounded flash-decode kernel (t=1 steps and short chunks —
@@ -1389,7 +1440,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, li, positions,
     x = x + _qmm(o.reshape(b, t, -1), lp["wo"], cfg.dtype)
     h = rms_norm(x, lp["mlp_norm"].astype(cfg.dtype))
     ffn, _ = _ffn(cfg, None, lp, h)
-    return x + ffn, ck, cv
+    return x + ffn, ck, cv, ((k, v) if defer else None)
 
 
 def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
@@ -1457,18 +1508,24 @@ def decode_step(cfg: TransformerConfig, params, cache, tokens, pos,
     def body(carry, layer):
         x, ck, cv = carry
         li, lp = layer
-        x, ck, cv = _block_decode(cfg, x, lp, ck, cv, li, positions, pos,
-                                  sharded=sharded, mesh=mesh, pages=pages)
-        return (x, ck, cv), None
+        x, ck, cv, chunks = _block_decode(cfg, x, lp, ck, cv, li,
+                                          positions, pos, sharded=sharded,
+                                          mesh=mesh, pages=pages)
+        return (x, ck, cv), chunks
 
     # Long-buffer decode gains ~40% from a 2-wide unroll (cross-layer DMA
     # overlap; 1759 -> 2497 tok/s at max_len=16k on the v5e) while short
     # buffers LOSE ~6% to it and m=4k is a wash — gate on the static
     # buffer length.  unroll=4 loses the win again (VMEM pressure).
-    (x, new_k, new_v), _ = jax.lax.scan(
+    (x, new_k, new_v), chunks = jax.lax.scan(
         body, (x, cache["k"], cache["v"]),
         (jnp.arange(cfg.n_layers, dtype=jnp.int32), params["layers"]),
         unroll=2 if _cache_logical_len(cache["k"], pages) >= 8192 else 1)
+    if chunks is not None:
+        # Deferred single-token paged writes (see _block_decode): commit
+        # every layer's chunk in one scatter per pool leaf.
+        new_k = _paged_cache_write_all(new_k, chunks[0], pages, pos)
+        new_v = _paged_cache_write_all(new_v, chunks[1], pages, pos)
     x = rms_norm(x, params["norm_f"].astype(cfg.dtype))
     logits = _qmm(x, params["head"], cfg.dtype)
     out_cache = {"k": new_k, "v": new_v}

@@ -529,7 +529,8 @@ def _decode_reference(q, k_cache, v_cache, pos, scale):
 
 
 def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
-                         scale: float, quantized: bool, q_per_kv: int):
+                         scale: float, quantized: bool, q_per_kv: int,
+                         self_attend: bool = False):
     """One (batch, kv-head, m-block) grid step of cache-bounded decode.
 
     The q block carries this kv head's rows for the WHOLE chunk, t-major:
@@ -553,11 +554,21 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
     following them.  The scales fold into the score/probability rows
     (k: s·kscale after the dot; v: (p·vscale)·v_int8), so the cache
     streams from HBM at int8 width — the dequantize never touches HBM.
+
+    ``self_attend`` (deferred-write decode, t = 1): the CURRENT token's
+    K/V has not been committed to the cache — it arrives as a one-slot
+    fp operand pair accumulated into the online softmax at the last grid
+    step (the caller passes the EXCLUSIVE bound/position, so the stale
+    cache slot at the token's own position is never read).
     """
+    it = list(rest)
     if quantized:
-        ks_ref, vs_ref, o_ref, o_acc, m_acc, l_acc = rest
-    else:
-        o_ref, o_acc, m_acc, l_acc = rest
+        ks_ref, vs_ref = it[0], it[1]
+        it = it[2:]
+    if self_attend:
+        kself_ref, vself_ref = it[0], it[1]
+        it = it[2:]
+    o_ref, o_acc, m_acc, l_acc = it
     bi = pl.program_id(0)
     j = pl.program_id(2)
     nb = s_ref[0, bi]      # per-batch-row block bound (ragged serving)
@@ -600,9 +611,34 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    if self_attend:
+        @pl.when(j == pl.num_programs(2) - 1)
+        def _self():
+            # The uncommitted current token: a one-slot fp block,
+            # accumulated like any other (always attended — a token
+            # sees its own position).
+            q = q_ref[0, 0, :, :]                   # [g, d] (t = 1)
+            ks = kself_ref[0, 0, :, :]              # [1, d]
+            vs = vself_ref[0, 0, :, :].astype(jnp.float32)
+            s = jax.lax.dot_general(q, ks.astype(q.dtype),
+                                    (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale                           # [g, 1]
+            m_prev, l_prev, o_prev = m_acc[...], l_acc[...], o_acc[...]
+            m_new = jnp.maximum(m_prev, s)
+            p = jnp.exp(s - m_new)
+            corr = jnp.where(m_prev == NEG_INF, 0.0,
+                             jnp.exp(m_prev - m_new))
+            m_acc[...] = m_new
+            l_acc[...] = l_prev * corr + p
+            o_acc[...] = o_prev * corr + jax.lax.dot_general(
+                p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
     @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
-        # Block 0 holds position 0 <= pos + tt for every row, so l > 0.
+        # Every row has at least one attended slot (block 0 holds
+        # position 0, or the self block contributes), so l > 0.
         o_ref[0, 0, :, :] = (o_acc[...] / l_acc[...]).astype(o_ref.dtype)
 
 
@@ -764,11 +800,13 @@ def flash_decode(q, k_cache, v_cache, pos, scale: Optional[float] = None,
 
 
 def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale,
-                            layer=None):
+                            layer=None, self_kv=None):
     """Gather-the-pages ground truth: materialize each row's logical cache
     view from the pool ([P, KV, page, D], or the stacked
     [L, P, KV, page, D] with ``layer``; int8 QTensors dequantize) and run
-    the dense masked reference."""
+    the dense masked reference.  ``self_kv`` (deferred-write decode,
+    t = 1): the current token's [B, 1, KV, D] K/V is written into each
+    row's view at its own position — the pool slot there is stale."""
     from tfmesos_tpu.ops.quant import QTensor
 
     kc, vc, ksc, vsc, li, quantized = _stacked_cache(k_pool, v_pool, layer)
@@ -786,12 +824,21 @@ def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale,
     # [B, NP, KV, page, D] -> the contiguous [B, KV, NP*page, D] view.
     gather = lambda pool: pool[page_table].transpose(0, 2, 1, 3, 4).reshape(
         b, kv, np_ * ps, pool.shape[3])
-    return _decode_reference(q, gather(k_pool), gather(v_pool), pos, scale)
+    k_view, v_view = gather(k_pool), gather(v_pool)
+    if self_kv is not None:
+        posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+        put = lambda view, c: jax.vmap(
+            lambda v_, c_, p_: jax.lax.dynamic_update_slice(
+                v_, c_[:, None].astype(v_.dtype), (0, p_, 0)))(
+            view, c[:, 0], posv)
+        k_view = put(k_view, self_kv[0])
+        v_view = put(v_view, self_kv[1])
+    return _decode_reference(q, k_view, v_view, pos, scale)
 
 
 def _flash_decode_paged_kernel(s_ref, pt_ref, *rest, block_m: int,
                                scale: float, quantized: bool,
-                               q_per_kv: int):
+                               q_per_kv: int, self_attend: bool = False):
     """One (batch, kv-head, logical-page) grid step of paged decode: the
     SAME online-softmax body as ``_flash_decode_kernel`` — only the
     BlockSpec index maps differ (they chase this row's physical page id
@@ -799,13 +846,14 @@ def _flash_decode_paged_kernel(s_ref, pt_ref, *rest, block_m: int,
     in scattered pool pages and rows share one physical pool)."""
     del pt_ref  # consumed by the index maps
     _flash_decode_kernel(s_ref, *rest, block_m=block_m, scale=scale,
-                         quantized=quantized, q_per_kv=q_per_kv)
+                         quantized=quantized, q_per_kv=q_per_kv,
+                         self_attend=self_attend)
 
 
 def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
                        scale: Optional[float] = None,
                        use_pallas: Optional[bool] = None,
-                       interpret: bool = False, layer=None):
+                       interpret: bool = False, layer=None, self_kv=None):
     """Decode attention over a PAGED KV cache: each row's logical cache is
     a list of physical pages in a shared pool (``page_table`` [B, NP]
     int32 — logical block j of row b lives at
@@ -834,6 +882,10 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     b, t, h, d = q.shape
     kv, ps = kp.shape[2], kp.shape[3]
     _check_gqa_heads(q, kp, vp)     # kv heads at axis 2 of the pool
+    if self_kv is not None and t != 1:
+        raise ValueError("self_kv (deferred-write decode) is a "
+                         "single-token path; chunks commit their writes "
+                         "before attending")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     g = h // kv
@@ -847,11 +899,20 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
             f"Mosaic-tileable (needs a multiple of 8, <= 1024)")
     if not use_pallas:
         out = _paged_decode_reference(q, k_pool, v_pool, page_table, pos,
-                                      scale, layer=layer)
+                                      scale, layer=layer, self_kv=self_kv)
         return out[:, 0] if squeeze else out
 
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
-    scalars = jnp.stack([(pos + t - 1) // ps + 1, pos,
+    if self_kv is None:
+        nb = (pos + t - 1) // ps + 1
+        bound = pos
+    else:
+        # Deferred writes: the pool holds positions < pos only — bound
+        # the block loop and the mask EXCLUSIVELY; the current token
+        # rides the self operands instead of its (stale) cache slot.
+        nb = -(-pos // ps)              # ceil(pos / ps); 0 when pos == 0
+        bound = pos - 1
+    scalars = jnp.stack([nb, bound,
                          jnp.broadcast_to(li, (b,))])           # [3, B]
     page_table = jnp.asarray(page_table, jnp.int32)
     if not quantized and q.dtype != kp.dtype:
@@ -866,7 +927,8 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     kv_spec = pl.BlockSpec(
         (1, 1, 1, ps, d),
         lambda bi, hi, j, s, pt: (
-            s[2, 0], pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
+            s[2, 0], pt[bi, jnp.maximum(jnp.minimum(j, s[0, bi] - 1), 0)],
+            hi, 0, 0),
         memory_space=pltpu.VMEM)
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [qt, kp, vp]     # pools already (page, head_dim)-trailing
@@ -876,10 +938,23 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
         sc_spec = pl.BlockSpec(
             (1, 1, 1, 1, ps),
             lambda bi, hi, j, s, pt: (
-                s[2, 0], pt[bi, jnp.minimum(j, s[0, bi] - 1)], hi, 0, 0),
+                s[2, 0],
+                pt[bi, jnp.maximum(jnp.minimum(j, s[0, bi] - 1), 0)],
+                hi, 0, 0),
             memory_space=pltpu.VMEM)
         in_specs += [sc_spec, sc_spec]
         operands += [ksc, vsc]                      # already lane-major
+    if self_kv is not None:
+        # [B, 1, KV, D] model-layout chunks -> [B, KV, 1, D] one-slot
+        # fp blocks (int8 pools: the caller pre-quantize-dequantizes so
+        # numerics match a committed slot exactly).
+        kself, vself = (c.transpose(0, 2, 1, 3).astype(q.dtype)
+                        for c in self_kv)
+        self_spec = pl.BlockSpec((1, 1, 1, d),
+                                 lambda bi, hi, j, s, pt: (bi, hi, 0, 0),
+                                 memory_space=pltpu.VMEM)
+        in_specs += [self_spec, self_spec]
+        operands += [kself, vself]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kv, page_table.shape[1]),
@@ -891,7 +966,7 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     out = pl.pallas_call(
         functools.partial(_flash_decode_paged_kernel, block_m=ps,
                           scale=float(scale), quantized=quantized,
-                          q_per_kv=g),
+                          q_per_kv=g, self_attend=self_kv is not None),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,

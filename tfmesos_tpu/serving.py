@@ -258,23 +258,49 @@ class _PagedSide:
             self._cache = jnp.asarray(self.table_np())
         return self._cache
 
+    def bucket_width(self) -> int:
+        """Smallest power-of-two table width covering every allocated
+        row (shared prefix pages + own pages), capped at ``np_max``.
+        The paged kernel's grid iterates the TABLE WIDTH per (row, kv
+        head) — skipped entries still cost a grid step through the
+        scalar-prefetched index map — so dispatching at the worst-case
+        width makes short-lived requests on a long-max_len pool pay for
+        context they don't have (measured 3.4x on an 8k pool early in
+        generation, v5e round 5).  Power-of-two bucketing bounds the
+        jit cache at log2(np_max) decode variants.  Safety: every
+        decoding row's reads (kernel block bound <= its allocation) and
+        writes stay inside the slice, and the width is STRICTLY greater
+        than the widest allocation, so an overrun row's clamped
+        out-of-reservation write (quota-finished mid-block) hits a
+        column past its own pages — sink — never its last live page
+        (at the np_max cap the pre-bucketing invariant already held)."""
+        ns = len(self.shared_pages)
+        occ = max((ns + len(p) for p in self.alloc.rows.values() if p),
+                  default=1)
+        return min(1 << occ.bit_length(), self.np_max)
+
     def decode_table(self, active: Dict[int, _Row],
                      decoding: Dict[int, _Row]) -> jnp.ndarray:
-        """The batched step's device table: the plain cached table when
-        every active row participates; otherwise a masked variant with
-        non-participating rows' entries pinned to the sink (still-filling
-        rows' chunked prefill owns their pages; overlap mode's
-        quota-finished rows await retire), cached until the allocation OR
-        the masked set changes — steady-state admission must not
-        re-upload the table every token."""
-        if len(decoding) == len(active):
-            return self.table()
-        masked = frozenset(r for r in active if r not in decoding)
-        if self._masked is None or self._masked[0] != masked:
-            t = self.table_np().copy()
-            for r in masked:
-                t[r, :] = self.sink
-            self._masked = (masked, jnp.asarray(t))
+        """The batched step's device table, sliced to ``bucket_width``
+        columns: the plain cached table when every active row
+        participates; otherwise a masked variant with non-participating
+        rows' entries pinned to the sink (still-filling rows' chunked
+        prefill owns their pages; overlap mode's quota-finished rows
+        await retire).  Cached keyed on (masked set, width) until the
+        allocation changes — steady-state decode must neither re-upload
+        nor re-slice the table every block."""
+        w = self.bucket_width()
+        masked = (frozenset() if len(decoding) == len(active)
+                  else frozenset(r for r in active if r not in decoding))
+        if self._masked is None or self._masked[0] != (masked, w):
+            if masked:
+                t = self.table_np().copy()
+                for r in masked:
+                    t[r, :] = self.sink
+                t = t[:, :w]
+            else:
+                t = self.table_np()[:, :w]
+            self._masked = ((masked, w), jnp.asarray(t))
         return self._masked[1]
 
 
