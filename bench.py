@@ -494,10 +494,10 @@ def bench_ring_window(t=8192, window=1024, reps=10, interpret=False,
 
 
 def _serving_bench_setup(tiny: bool, max_len=None, plen=None, new=None):
-    """(cfg, params, reqs-maker, max_len) for the serving benches —
-    flagship config (with optional max_len/prompt/continuation
+    """(cfg, params, reqs-maker, max_len, new-tokens) for the serving
+    benches — flagship config (with optional max_len/prompt/continuation
     overrides, so every serving bench shares ONE protocol), or a
-    CI-affordable tiny one."""
+    CI-affordable tiny one (which fixes its own sizes)."""
     import jax
     import jax.numpy as jnp
     from tfmesos_tpu.models import transformer
@@ -522,7 +522,7 @@ def _serving_bench_setup(tiny: bool, max_len=None, plen=None, new=None):
                         .astype(np.int32), max_new_tokens=new)
                 for _ in range(n)]
 
-    return cfg, params, reqs, max_len
+    return cfg, params, reqs, max_len, new
 
 
 def bench_serving_continuous(n_requests=32, rows=8, tiny=False):
@@ -532,7 +532,7 @@ def bench_serving_continuous(n_requests=32, rows=8, tiny=False):
     config with ``tiny=True``."""
     from tfmesos_tpu.serving import ContinuousBatcher
 
-    cfg, params, reqs, max_len = _serving_bench_setup(tiny)
+    cfg, params, reqs, max_len, _ = _serving_bench_setup(tiny)
     batcher = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len)
     list(batcher.run(reqs(2)))  # warm the compiles outside the timed region
     t0 = time.perf_counter()
@@ -573,19 +573,20 @@ def bench_serving_continuous(n_requests=32, rows=8, tiny=False):
 
 
 def bench_serving_longctx(n_requests=8, rows=4, max_len=8192,
-                          plen=512, new=128):
+                          plen=512, new=128, tiny=False):
     """Continuous batching at LONG context — the regime the kernel-native
     carried cache, bucketed decode tables, and deferred pool commits
     were built for (an 8k-slot paged pool per row).  Reports generated
     tokens/s across the stream and mean TTFT, with multi_step=16 +
     overlap (the production setting); same protocol/scaffolding as the
-    headline serving bench (``_serving_bench_setup``)."""
+    headline serving bench (``_serving_bench_setup``; ``tiny=True`` is
+    the CI smoke — same call path at toy sizes)."""
     from tfmesos_tpu.serving import ContinuousBatcher
 
-    cfg, params, reqs, max_len = _serving_bench_setup(
-        False, max_len=max_len, plen=plen, new=new)
+    cfg, params, reqs, max_len, new = _serving_bench_setup(
+        tiny, max_len=max_len, plen=plen, new=new)
     b = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len,
-                          multi_step=16, overlap=True)
+                          multi_step=2 if tiny else 16, overlap=True)
     list(b.run(reqs(2)))    # warm the compiles outside the timed region
     t0 = time.perf_counter()
     done = list(b.run(reqs(n_requests)))
@@ -608,7 +609,7 @@ def bench_serving_continuous_mesh(n_requests=32, rows=8, tiny=False):
     n = jax.device_count()
     if n < 2:
         return None
-    cfg, params, reqs, max_len = _serving_bench_setup(tiny)
+    cfg, params, reqs, max_len, _ = _serving_bench_setup(tiny)
     tp = 2 if cfg.n_heads % 2 == 0 and n % 2 == 0 else 1
     dp = n // tp
     mesh = build_mesh({"dp": dp, "tp": tp},

@@ -78,6 +78,14 @@ def test_serving_bench_smoke():
     assert ms_rps > 0 and mso_rps > 0
 
 
+def test_serving_longctx_bench_smoke():
+    # Same call path as the TPU long-context section (bucketed tables,
+    # deferred commits, multi_step + overlap) at toy sizes.
+    tok_s, ttft_ms = bench.bench_serving_longctx(
+        n_requests=3, rows=2, tiny=True)
+    assert tok_s > 0 and ttft_ms > 0
+
+
 def test_serving_mesh_bench_smoke():
     rps = bench.bench_serving_continuous_mesh(n_requests=3, rows=2,
                                               tiny=True)

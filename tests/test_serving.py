@@ -912,3 +912,25 @@ def test_multistep_validation(setup, draft_setup):
     with pytest.raises(ValueError, match="speculative"):
         ContinuousBatcher(cfg, params, multi_step=2, draft_cfg=dcfg,
                           draft_params=dparams)
+
+
+def test_bucket_width_invariants():
+    """The decode-table bucket width is a power of two STRICTLY above the
+    widest allocation (so an overrun row's clamped write lands past its
+    own pages — on the sink), capped at np_max."""
+    from tfmesos_tpu.serving import _PagedSide
+
+    side = _PagedSide(n_pages=65, page_size=16, rows=4, np_max=64)
+    assert side.bucket_width() == 2            # empty: strictly > 1
+    side.ensure(0, 16)                         # 1 page
+    assert side.bucket_width() == 2            # strictly > 1
+    side.ensure(1, 64)                         # 4 pages
+    assert side.bucket_width() == 8            # strictly > 4 (pow2)
+    side.ensure(1, 65)                         # 5 pages
+    assert side.bucket_width() == 8
+    side.ensure(2, 16 * 33)                    # 33 pages -> 64 (cap hits)
+    assert side.bucket_width() == 64           # min(pow2 > 33, np_max)
+    side.release(2)
+    assert side.bucket_width() == 8            # shrinks with the workload
+    # Widths always slice within the table.
+    assert side.bucket_width() <= side.np_max
