@@ -528,6 +528,39 @@ def _decode_reference(q, k_cache, v_cache, pos, scale):
     return o[:, 0] if squeeze else o
 
 
+def _decode_block_scores(q, k_blk, scale, ks_row=None):
+    """[rows, block] score tile of one K block (int8 blocks convert in
+    VMEM; per-position k scales fold post-dot) — shared by the linear
+    and paged (kv-folded) decode kernels so their math cannot diverge."""
+    s = jax.lax.dot_general(q, k_blk.astype(q.dtype),
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * scale
+    if ks_row is not None:
+        s = s * ks_row[None, :]
+    return s
+
+
+def _decode_accumulate(s, v_blk, acc, vs_row=None):
+    """One online-softmax accumulation of a score tile against its V
+    block: returns the updated (m, l, o) triple.  Handles all-masked
+    tiles (exp(-inf - -inf) guarded) and the int8 per-position v-scale
+    fold — the single definition both decode kernels run."""
+    m_prev, l_prev, o_prev = acc
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
+    corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    if vs_row is not None:
+        p = p * vs_row[None, :]
+    if v_blk.dtype == jnp.int8:
+        v_blk = v_blk.astype(jnp.float32)
+    o_new = o_prev * corr + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
 def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
                          scale: float, quantized: bool, q_per_kv: int,
                          self_attend: bool = False):
@@ -583,33 +616,16 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
     @pl.when(j < nb)
     def _step():
         q = q_ref[0, 0, :, :]                       # [t*g, d]
-        k_blk = k_ref[0, 0, 0, :, :]                # [bm, d]
-        v_blk = v_ref[0, 0, 0, :, :]
-        if quantized:
-            k_blk = k_blk.astype(q.dtype)           # VMEM convert, not HBM
-            v_blk = v_blk.astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * scale                               # [t*g, bm]
-        if quantized:
-            s = s * ks_ref[0, 0, 0, 0, :][None, :]  # per-position k scales
+        s = _decode_block_scores(
+            q, k_ref[0, 0, 0, :, :], scale,
+            ks_ref[0, 0, 0, 0, :] if quantized else None)
         kpos = j * block_m + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         tt = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // q_per_kv
         s = jnp.where(kpos > pos + tt, NEG_INF, s)
-        m_prev, l_prev, o_prev = m_acc[...], l_acc[...], o_acc[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        # A chunk row's window may be empty in this block (its position
-        # is before the block): keep exp(-inf - -inf) out of the math.
-        p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
-        corr = jnp.where(m_prev == NEG_INF, 0.0, jnp.exp(m_prev - m_new))
-        m_acc[...] = m_new
-        l_acc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        if quantized:
-            p = p * vs_ref[0, 0, 0, 0, :][None, :]  # per-position v scales
-        o_acc[...] = o_prev * corr + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        m_acc[...], l_acc[...], o_acc[...] = _decode_accumulate(
+            s, v_ref[0, 0, 0, :, :], (m_acc[...], l_acc[...], o_acc[...]),
+            vs_ref[0, 0, 0, 0, :] if quantized else None)
 
     if self_attend:
         @pl.when(j == pl.num_programs(2) - 1)
@@ -618,22 +634,10 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
             # accumulated like any other (always attended — a token
             # sees its own position).
             q = q_ref[0, 0, :, :]                   # [g, d] (t = 1)
-            ks = kself_ref[0, 0, :, :]              # [1, d]
-            vs = vself_ref[0, 0, :, :].astype(jnp.float32)
-            s = jax.lax.dot_general(q, ks.astype(q.dtype),
-                                    (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            s = s * scale                           # [g, 1]
-            m_prev, l_prev, o_prev = m_acc[...], l_acc[...], o_acc[...]
-            m_new = jnp.maximum(m_prev, s)
-            p = jnp.exp(s - m_new)
-            corr = jnp.where(m_prev == NEG_INF, 0.0,
-                             jnp.exp(m_prev - m_new))
-            m_acc[...] = m_new
-            l_acc[...] = l_prev * corr + p
-            o_acc[...] = o_prev * corr + jax.lax.dot_general(
-                p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            s = _decode_block_scores(q, kself_ref[0, 0, :, :], scale)
+            m_acc[...], l_acc[...], o_acc[...] = _decode_accumulate(
+                s, vself_ref[0, 0, :, :],
+                (m_acc[...], l_acc[...], o_acc[...]))
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
@@ -836,18 +840,81 @@ def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale,
     return _decode_reference(q, k_view, v_view, pos, scale)
 
 
-def _flash_decode_paged_kernel(s_ref, pt_ref, *rest, block_m: int,
-                               scale: float, quantized: bool,
-                               q_per_kv: int, self_attend: bool = False):
-    """One (batch, kv-head, logical-page) grid step of paged decode: the
-    SAME online-softmax body as ``_flash_decode_kernel`` — only the
-    BlockSpec index maps differ (they chase this row's physical page id
-    through the scalar-prefetched page table, so each row's cache lives
-    in scattered pool pages and rows share one physical pool)."""
+def _flash_decode_paged_kernel(s_ref, pt_ref, q_ref, k_ref, v_ref, *rest,
+                               block_m: int, scale: float, quantized: bool,
+                               q_per_kv: int, kv: int,
+                               self_attend: bool = False):
+    """One (batch, logical-page) grid step of paged decode with ALL kv
+    heads FOLDED into the block: grid iterations cost ~2.3 µs each even
+    when the per-row bound skips their DMA (the scalar-table index map
+    defeats cheap elision — measured, v5e round 5), so iterating pages
+    once per head multiplied that overhead by KV.  One iteration now
+    fetches a page's whole [KV, page, d] slab (contiguous in the pool
+    layout) and runs the same online-softmax body per head against
+    per-head slices of the shared scratch.
+
+    Index maps chase this row's physical page id through the
+    scalar-prefetched page table, so each row's cache lives in scattered
+    pool pages and rows share one physical pool; ``s_ref`` rows are
+    (n_live_blocks, position bound, layer index), as in
+    ``_flash_decode_kernel``, whose per-head math (including the
+    quantized scale folds and the deferred-write ``self_attend`` block)
+    this kernel reproduces slice for slice."""
     del pt_ref  # consumed by the index maps
-    _flash_decode_kernel(s_ref, *rest, block_m=block_m, scale=scale,
-                         quantized=quantized, q_per_kv=q_per_kv,
-                         self_attend=self_attend)
+    it = list(rest)
+    ks_ref = vs_ref = kself_ref = vself_ref = None
+    if quantized:
+        ks_ref, vs_ref = it[0], it[1]
+        it = it[2:]
+    if self_attend:
+        kself_ref, vself_ref = it[0], it[1]
+        it = it[2:]
+    o_ref, o_acc, m_acc, l_acc = it
+    bi = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = s_ref[0, bi]
+    pos = s_ref[1, bi]
+    tg = q_ref.shape[2]                         # t * g rows per head
+
+    @pl.when(j == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+
+    @pl.when(j < nb)
+    def _step():
+        kpos0 = j * block_m
+        for h in range(kv):
+            sl = slice(h * tg, (h + 1) * tg)
+            q = q_ref[0, h, :, :]               # [tg, d]
+            s = _decode_block_scores(
+                q, k_ref[0, 0, h, :, :], scale,
+                ks_ref[0, 0, h, 0, :] if quantized else None)
+            kpos = kpos0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            tt = jax.lax.broadcasted_iota(jnp.int32, s.shape,
+                                          0) // q_per_kv
+            s = jnp.where(kpos > pos + tt, NEG_INF, s)
+            m_acc[sl], l_acc[sl], o_acc[sl] = _decode_accumulate(
+                s, v_ref[0, 0, h, :, :], (m_acc[sl], l_acc[sl], o_acc[sl]),
+                vs_ref[0, 0, h, 0, :] if quantized else None)
+
+    if self_attend:
+        @pl.when(j == pl.num_programs(1) - 1)
+        def _self():
+            for h in range(kv):
+                sl = slice(h * tg, (h + 1) * tg)
+                q = q_ref[0, h, :, :]
+                s = _decode_block_scores(q, kself_ref[0, h, :, :], scale)
+                m_acc[sl], l_acc[sl], o_acc[sl] = _decode_accumulate(
+                    s, vself_ref[0, h, :, :],
+                    (m_acc[sl], l_acc[sl], o_acc[sl]))
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        for h in range(kv):
+            sl = slice(h * tg, (h + 1) * tg)
+            o_ref[0, h, :, :] = (o_acc[sl] / l_acc[sl]).astype(o_ref.dtype)
 
 
 def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
@@ -889,14 +956,20 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     g = h // kv
-    aligned = ps % 8 == 0 and ps <= 1024
+    # Blocks carry a page's whole [KV, page, d] slab (kv-folded grid), so
+    # the guard bounds VMEM too: K + V slabs, double-buffered, must
+    # leave room for scratch in the ~16 MB core budget.
+    slab = kv * ps * d * kp.dtype.itemsize
+    aligned = ps % 8 == 0 and ps <= 1024 and 4 * slab <= 8 * 2 ** 20
     if use_pallas is None:
         on_tpu = jax.default_backend() == "tpu"
         use_pallas = aligned and (on_tpu or interpret)
     elif use_pallas and not aligned:
         raise ValueError(
-            f"flash_decode_paged(use_pallas=True): page_size {ps} is not "
-            f"Mosaic-tileable (needs a multiple of 8, <= 1024)")
+            f"flash_decode_paged(use_pallas=True): page_size {ps} with "
+            f"{kv} kv heads x d={d} is not kernel-eligible (page must be "
+            f"a multiple of 8, <= 1024, and the kv-folded K/V slabs must "
+            f"fit VMEM)")
     if not use_pallas:
         out = _paged_decode_reference(q, k_pool, v_pool, page_table, pos,
                                       scale, layer=layer, self_kv=self_kv)
@@ -921,14 +994,19 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     qt = q.reshape(b, t, kv, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, kv, t * g, d)
 
-    q_spec = pl.BlockSpec((1, 1, t * g, d),
-                          lambda bi, hi, j, s, pt: (bi, hi, 0, 0),
+    # KV heads are FOLDED into the block (grid (b, page), not
+    # (b, kv, page)): a grid iteration costs ~2.3 us even when skipped,
+    # so per-head page loops multiplied pure overhead by KV.  One
+    # iteration fetches a page's whole [KV, page, d] slab — contiguous
+    # in the pool layout, so the DMA stays one dense block.
+    q_spec = pl.BlockSpec((1, kv, t * g, d),
+                          lambda bi, j, s, pt: (bi, 0, 0, 0),
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec(
-        (1, 1, 1, ps, d),
-        lambda bi, hi, j, s, pt: (
+        (1, 1, kv, ps, d),
+        lambda bi, j, s, pt: (
             s[2, 0], pt[bi, jnp.maximum(jnp.minimum(j, s[0, bi] - 1), 0)],
-            hi, 0, 0),
+            0, 0, 0),
         memory_space=pltpu.VMEM)
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [qt, kp, vp]     # pools already (page, head_dim)-trailing
@@ -936,11 +1014,11 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
         # Scales as [L, P, KV, 1, page]: positions on the lane dim, same
         # page-chasing index map as their values.
         sc_spec = pl.BlockSpec(
-            (1, 1, 1, 1, ps),
-            lambda bi, hi, j, s, pt: (
+            (1, 1, kv, 1, ps),
+            lambda bi, j, s, pt: (
                 s[2, 0],
                 pt[bi, jnp.maximum(jnp.minimum(j, s[0, bi] - 1), 0)],
-                hi, 0, 0),
+                0, 0, 0),
             memory_space=pltpu.VMEM)
         in_specs += [sc_spec, sc_spec]
         operands += [ksc, vsc]                      # already lane-major
@@ -950,28 +1028,29 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
         # numerics match a committed slot exactly).
         kself, vself = (c.transpose(0, 2, 1, 3).astype(q.dtype)
                         for c in self_kv)
-        self_spec = pl.BlockSpec((1, 1, 1, d),
-                                 lambda bi, hi, j, s, pt: (bi, hi, 0, 0),
+        self_spec = pl.BlockSpec((1, kv, 1, d),
+                                 lambda bi, j, s, pt: (bi, 0, 0, 0),
                                  memory_space=pltpu.VMEM)
         in_specs += [self_spec, self_spec]
         operands += [kself, vself]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, kv, page_table.shape[1]),
+        grid=(b, page_table.shape[1]),
         in_specs=in_specs,
         out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((t * g, d), jnp.float32),
-                        pltpu.VMEM((t * g, 1), jnp.float32),
-                        pltpu.VMEM((t * g, 1), jnp.float32)])
+        scratch_shapes=[pltpu.VMEM((kv * t * g, d), jnp.float32),
+                        pltpu.VMEM((kv * t * g, 1), jnp.float32),
+                        pltpu.VMEM((kv * t * g, 1), jnp.float32)])
     out = pl.pallas_call(
         functools.partial(_flash_decode_paged_kernel, block_m=ps,
                           scale=float(scale), quantized=quantized,
-                          q_per_kv=g, self_attend=self_kv is not None),
+                          q_per_kv=g, kv=kv,
+                          self_attend=self_kv is not None),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * t * h * page_table.shape[1] * ps * d,
             bytes_accessed=(kp[0].size * kp.dtype.itemsize * 2
