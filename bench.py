@@ -122,7 +122,6 @@ def bench_mnist_replica(steps=2000, warmup=100):
     k = 20
     step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt, mesh=mesh,
                            steps_per_call=k)
-    params, opt_state = step.place(params, opt.init(params))
 
     ds = datalib.SyntheticMNIST()
     # Reference batch 100, rounded so it shards evenly over the chips —
@@ -136,10 +135,23 @@ def bench_mnist_replica(steps=2000, warmup=100):
             mesh, {key: np.stack([m[key] for m in ms]) for key in ms[0]},
             batch_dim=1)
 
-    batch = stacked_batch()
-    for _ in range(max(1, warmup // k)):
-        params, opt_state, metrics = step(params, opt_state, batch)
-    float(metrics["loss"])  # drain the warmup chain with a real host fetch
+    # jaxlib 0.4.x CPU: executing THIS program (donated params + fused
+    # scan + multi-device all-reduce on virtual host devices) after a
+    # persistent-compilation-cache DESERIALIZE corrupts the native heap
+    # (malloc abort / SIGSEGV mid-run; a cold compile of the identical
+    # program is fine, and no other program in the suite trips it).
+    # Compile it fresh every time: the cache is disabled around the
+    # compiling calls and the caller's setting restored after.
+    cache_prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        params, opt_state = step.place(params, opt.init(params))
+        batch = stacked_batch()
+        for _ in range(max(1, warmup // k)):
+            params, opt_state, metrics = step(params, opt_state, batch)
+        float(metrics["loss"])  # drain the warmup chain with a real fetch
+    finally:
+        jax.config.update("jax_enable_compilation_cache", cache_prev)
     calls = max(1, steps // k)
     t0 = time.perf_counter()
     for _ in range(calls):
@@ -625,6 +637,67 @@ def bench_serving_continuous_mesh(n_requests=32, rows=8, tiny=False):
     return n_requests / dt
 
 
+def bench_fleet_serving(n_requests=32, replicas=2, rows=4, tiny=True,
+                        max_new_tokens=8, workers=16):
+    """Online fleet serving: requests/s and mean TTFT through the full
+    front door — gateway + admission + router + N ``LocalBackend``
+    CPU replicas (co-located replicas cannot share one TPU, so the
+    multi-replica path is measured on CPU; what this metric tracks is
+    the FLEET overhead trajectory — wire hops, routing, admission —
+    on top of the per-replica serving numbers above).  The model is the
+    tiny CI config by default: fleet costs are model-independent, and a
+    flagship-on-CPU replica would measure XLA CPU, not the gateway."""
+    import threading
+
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    rng = np.random.default_rng(0)
+    fleet = FleetServer(replicas=replicas, rows=rows, tiny=tiny,
+                        max_len=64 if tiny else None,
+                        page_size=16 if tiny else None,
+                        prefill_bucket=16 if tiny else None,
+                        workers=workers,
+                        max_queue=max(64, 2 * n_requests),
+                        start_timeout=300.0)
+    fleet.start()
+    try:
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+
+        def run_batch(n):
+            # Prompts come from the main thread: numpy Generators are
+            # not thread-safe, and the workers only send/wait.
+            prompts = [rng.integers(0, 97, size=(8,)).astype(np.int32)
+                       for _ in range(n)]
+            results = [None] * n
+
+            def one(i):
+                results[i] = client.generate(prompts[i], max_new_tokens)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return results
+
+        # Warm every replica's compile outside the timed region: with
+        # least-outstanding routing, 2*replicas concurrent requests
+        # land on every replica.
+        run_batch(2 * replicas)
+        t0 = time.perf_counter()
+        results = run_batch(n_requests)
+        dt = time.perf_counter() - t0
+        done = [r for r in results if r is not None]
+        assert len(done) == n_requests
+        ttft = sum(r["ttft_ms"] for r in done) / len(done)
+        client.close()
+        return n_requests / dt, ttft
+    finally:
+        fleet.stop()
+
+
 def bench_bandwidth(sizes=None):
     """Achieved bandwidth vs roofline.
 
@@ -948,6 +1021,14 @@ def main():
                    "mesh continuous serving bench", n=1)
     if msv and msv[0] is not None:  # >1 visible device: dp x tp serving
         out["serving_mesh_requests_per_sec"] = round(msv[0], 2)
+        flush_partial()
+    fl = attempts(bench_fleet_serving, "fleet serving bench", n=1)
+    if fl:
+        # Gateway + 2 local CPU replicas: the online multi-replica path
+        # (fleet subsystem) — tracks fleet overhead, not chip speed.
+        rps, ttft_ms = fl[0]
+        out["fleet_requests_per_sec"] = round(rps, 2)
+        out["fleet_mean_ttft_ms"] = round(ttft_ms, 2)
         flush_partial()
     rw = attempts(bench_ring_window, "ring window bench", n=1)
     if rw and rw[0] is not None:    # >1 visible device: sp ring

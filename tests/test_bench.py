@@ -10,6 +10,7 @@ only "runs and returns finite values" is asserted.
 """
 
 import numpy as np
+import pytest
 
 import bench
 
@@ -40,10 +41,36 @@ def test_decode_bench_smoke():
 
 
 def test_mnist_bench_smoke():
-    steps, loss, mfu = bench.bench_mnist_replica(steps=40, warmup=20)
-    assert np.isfinite(steps) and steps > 0
-    assert np.isfinite(loss)
-    assert 0 <= mfu < 1
+    """Runs in a CLEAN subprocess with the persistent compilation cache
+    off: jaxlib 0.4.x CPU leaves the native heap latently corrupted
+    after deserializing cached multi-device executables, and THIS
+    workload's allocation pattern is what trips it (malloc abort /
+    SIGSEGV that killed entire suite runs at this test).  By the time
+    this test runs, the suite process has live cache-deserialized
+    executables, so in-process isolation is impossible — the subprocess
+    asserts the same quantities from a pristine heap."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import json\n"
+        "from tfmesos_tpu.utils.platform import force_platform\n"
+        "force_platform('cpu', min_host_devices=8)\n"
+        "import bench\n"
+        "s, l, m = bench.bench_mnist_replica(steps=40, warmup=20)\n"
+        "print(json.dumps({'steps': s, 'loss': l, 'mfu': m}))\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                          capture_output=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr.decode()
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert np.isfinite(out["steps"]) and out["steps"] > 0
+    assert np.isfinite(out["loss"])
+    assert 0 <= out["mfu"] < 1
 
 
 def test_decode_bench_int8_smoke():
@@ -109,3 +136,15 @@ def test_pipeline_bubble_stats_static():
     # Amortized regime: the ratio honestly collapses toward 1.
     flat = bench.pipeline_bubble_stats(pp=4, m=16)
     assert 0.95 < flat["pipeline_interleave_speedup"] < 1.1
+
+
+@pytest.mark.slow
+def test_fleet_bench_smoke():
+    """The fleet serving bench (gateway + 2 LocalBackend CPU replicas)
+    runs end to end and returns finite numbers.  Marked slow: it pays a
+    full fleet bring-up that tests/test_fleet.py already exercises in
+    tier-1; this guards the driver's unattended bench.py run."""
+    rps, ttft_ms = bench.bench_fleet_serving(
+        n_requests=4, replicas=2, rows=2, tiny=True, workers=4)
+    assert np.isfinite(rps) and rps > 0
+    assert np.isfinite(ttft_ms) and ttft_ms > 0

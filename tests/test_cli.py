@@ -135,3 +135,64 @@ def test_serve_example_end_to_end(tmp_path, paged):
     assert len(served) == 3
     assert [r["prompt_len"] for r in served] == [3, 10, 5]
     assert all(len(r["tokens"]) == 4 for r in served)
+
+
+def test_serve_parser_round_trip():
+    """tfserve's full flag surface (fleet PR): replica count, per-replica
+    chips/mem/cpus, gateway port, and the admission knobs must all
+    round-trip through the parser."""
+    from tfmesos_tpu.cli import build_serve_parser
+
+    args = build_serve_parser().parse_args([
+        "-R", "3", "-m", "zk://zk/mesos", "-n", "myfleet",
+        "-Cr", "2.5", "-Gr", "4", "-Mr", "2048",
+        "-p", "9000", "--gateway-host", "127.0.0.1",
+        "--rows", "16", "--max-len", "2048", "--max-queue", "32",
+        "--rate", "100", "--burst", "20", "--workers", "4",
+        "--retries", "1", "--tiny", "--metrics-interval", "5", "-v"])
+    assert args.replicas == 3 and args.master == "zk://zk/mesos"
+    assert args.replica_cpus == 2.5 and args.replica_chips == 4
+    assert args.replica_mem == 2048.0
+    assert args.gateway_port == 9000
+    assert args.gateway_host == "127.0.0.1"
+    assert args.rows == 16 and args.max_len == 2048
+    assert args.max_queue == 32 and args.rate == 100.0
+    assert args.burst == 20.0 and args.workers == 4 and args.retries == 1
+    assert args.tiny and args.verbose and args.metrics_interval == 5.0
+
+
+def test_serve_parser_defaults():
+    from tfmesos_tpu.cli import build_serve_parser
+
+    args = build_serve_parser().parse_args([])
+    assert args.replicas == 2 and args.gateway_port == 8780
+    assert args.rows == 8 and args.max_queue == 256
+    assert args.rate is None and args.burst is None
+    assert args.replica_chips == 0 and args.replica_mem == 1024.0
+    assert not args.tiny and args.master is None
+
+
+def test_serve_main_rejects_bad_counts(capfd):
+    from tfmesos_tpu.cli import serve_main
+
+    assert serve_main(["--replicas", "0"]) == 2
+    assert "--replicas" in capfd.readouterr().err
+    assert serve_main(["--rows", "0"]) == 2
+    assert "--rows" in capfd.readouterr().err
+
+
+def test_replica_parser_round_trip():
+    """The replica process's own flags (what FleetServer's Mode-B cmd
+    drives) must round-trip too."""
+    from tfmesos_tpu.fleet.replica import build_parser as replica_parser
+
+    args = replica_parser().parse_args([
+        "--registry", "127.0.0.1:7000", "--port", "7001", "--rows", "8",
+        "--max-len", "64", "--page-size", "16", "--prefill-bucket", "16",
+        "--multi-step", "4", "--tiny", "--seed", "3",
+        "--heartbeat-interval", "0.1"])
+    assert args.registry == "127.0.0.1:7000" and args.port == 7001
+    assert args.rows == 8 and args.max_len == 64
+    assert args.page_size == 16 and args.prefill_bucket == 16
+    assert args.multi_step == 4 and args.tiny and args.seed == 3
+    assert args.heartbeat_interval == 0.1

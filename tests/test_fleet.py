@@ -1,0 +1,562 @@
+"""Fleet serving gateway (tfmesos_tpu/fleet/): unit tests over stub
+replicas (no JAX — the fleet machinery is model-agnostic), then the
+end-to-end acceptance path: a gateway fronting 2 ``LocalBackend``-
+launched batcher replicas on CPU must serve concurrent requests to the
+exact offline-greedy completions, retry onto the survivor when a
+replica is killed mid-stream, shed with explicit Overloaded rejections
+past the ingress bound (never a hang), and keep its metrics snapshot
+consistent throughout."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.fleet.admission import (AdmissionController, Overloaded,
+                                         RateLimited, TokenBucket)
+from tfmesos_tpu.fleet.client import (CallTimeout, ConnectionLost,
+                                      FleetClient, MuxConnection)
+from tfmesos_tpu.fleet.gateway import Gateway
+from tfmesos_tpu.fleet.metrics import FleetMetrics
+from tfmesos_tpu.fleet.registry import DEAD, DRAINING, ReplicaRegistry
+from tfmesos_tpu.fleet.replica import ReplicaServer
+from tfmesos_tpu.fleet.router import Router, RoutingError
+
+
+def _wait(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# -- admission --------------------------------------------------------------
+
+
+def test_token_bucket_refill_and_burst():
+    t = [0.0]
+    tb = TokenBucket(rate=10.0, burst=2, clock=lambda: t[0])
+    assert tb.try_acquire() and tb.try_acquire()
+    assert not tb.try_acquire()         # burst spent
+    t[0] += 0.1                         # refills exactly one token
+    assert tb.try_acquire()
+    assert not tb.try_acquire()
+    t[0] += 100.0                       # refill caps at burst
+    assert tb.try_acquire() and tb.try_acquire()
+    assert not tb.try_acquire()
+
+
+def test_admission_queue_bound_sheds():
+    adm = AdmissionController(max_queue=2)
+    adm.admit("a")
+    adm.admit("b")
+    with pytest.raises(Overloaded):
+        adm.admit("c")
+    assert adm.get(timeout=0.1) == "a"  # a pop frees a slot
+    adm.admit("c")
+    assert adm.depth() == 2
+
+
+def test_admission_rate_limit_sheds_with_distinct_kind():
+    adm = AdmissionController(max_queue=16, rate=1.0, burst=1)
+    adm.admit("a")
+    with pytest.raises(RateLimited) as e:
+        adm.admit("b")
+    assert e.value.kind == "rate_limited"
+    assert isinstance(e.value, Overloaded)   # one except-clause catches both
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def test_metrics_snapshot_and_report_line():
+    m = FleetMetrics()
+    m.inc("admitted", 3)
+    m.inc("shed_queue")
+    for v in (5.0, 10.0, 400.0):
+        m.observe("ttft_ms", v)
+    m.observe("ttft_ms", None)          # non-numeric samples are dropped
+    m.register_gauge("queue_depth", lambda: 7)
+    snap = m.snapshot()
+    assert snap["counters"] == {"admitted": 3, "shed_queue": 1}
+    assert snap["gauges"]["queue_depth"] == 7
+    h = snap["histograms"]["ttft_ms"]
+    assert h["count"] == 3 and h["max"] == 400.0
+    assert h["p50"] == 10.0             # bucket upper edge of the median
+    line = m.report_line()
+    assert "admitted=3" in line and "queue_depth=7" in line
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_registry_heartbeat_lifecycle_and_eviction():
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=0.25, dead_after=0.6,
+                          evict_after=1.5, sweep_interval=0.05).start()
+    try:
+        sock = wire.connect(reg.addr)
+        wire.send_msg(sock, {"op": "hello", "addr": "10.0.0.1:7",
+                             "capacity": 4}, token)
+        assert _wait(lambda: len(reg.alive()) == 1)
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "10.0.0.1:7",
+                             "outstanding": 3}, token)
+        assert _wait(lambda: reg.alive() and reg.alive()[0].outstanding == 3)
+        # Stop heartbeating (socket stays open): alive -> draining ->
+        # dead -> evicted on the sweep timeouts alone.
+        assert _wait(lambda: any(r["state"] == DRAINING
+                                 for r in reg.snapshot()), timeout=2.0)
+        assert _wait(lambda: any(r["state"] == DEAD
+                                 for r in reg.snapshot()), timeout=2.0)
+        assert _wait(lambda: not reg.snapshot(), timeout=3.0)
+        # A heartbeat after eviction re-registers from scratch.
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "10.0.0.1:7",
+                             "capacity": 4}, token)
+        assert _wait(lambda: len(reg.alive()) == 1)
+        sock.close()
+    finally:
+        reg.stop()
+
+
+def test_registry_heartbeat_eof_marks_dead_immediately():
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=5.0, dead_after=10.0,
+                          evict_after=20.0, sweep_interval=0.05).start()
+    try:
+        sock = wire.connect(reg.addr)
+        wire.send_msg(sock, {"op": "hello", "addr": "10.0.0.2:7"}, token)
+        assert _wait(lambda: len(reg.alive()) == 1)
+        sock.close()    # the process died: its heartbeat conn goes EOF
+        # Dead well before the 10s heartbeat timeout could fire.
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()] == [DEAD],
+                     timeout=2.0)
+    finally:
+        reg.stop()
+
+
+def test_registry_rejects_wrong_token_and_drain_excludes():
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=30.0, dead_after=60.0,
+                          sweep_interval=0.05).start()
+    try:
+        bad = wire.connect(reg.addr)
+        wire.send_msg(bad, {"op": "hello", "addr": "evil:1"},
+                      "wrong-token")
+        good = wire.connect(reg.addr)
+        wire.send_msg(good, {"op": "hello", "addr": "10.0.0.3:7"}, token)
+        assert _wait(lambda: len(reg.alive()) == 1)
+        assert reg.alive()[0].addr == "10.0.0.3:7"   # evil never joined
+        wire.send_msg(good, {"op": "drain", "addr": "10.0.0.3:7"}, token)
+        assert _wait(lambda: not reg.alive())        # draining != routable
+        assert reg.snapshot()[0]["state"] == DRAINING
+        bad.close()
+        good.close()
+    finally:
+        reg.stop()
+
+
+# -- stub replicas (no JAX) -------------------------------------------------
+
+
+def _stub_replica(token, registry_addr, tokens, delay=0.0):
+    """A ReplicaServer whose handler replies canned tokens — the fleet
+    path minus the model."""
+
+    def handler(msg, reply):
+        def work():
+            if delay:
+                time.sleep(delay)
+            reply({"op": "completion", "id": msg.get("id"),
+                   "tokens": list(tokens), "ttft_ms": 1.0,
+                   "total_ms": 2.0})
+
+        threading.Thread(target=work, daemon=True).start()
+
+    return ReplicaServer(handler, token=token, capacity=4,
+                         registry_addr=registry_addr,
+                         heartbeat_interval=0.05).start()
+
+
+@pytest.fixture()
+def stub_fleet():
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=0.5, dead_after=1.0,
+                          evict_after=5.0, sweep_interval=0.05).start()
+    servers = []
+    try:
+        yield token, reg, servers
+    finally:
+        for s in servers:
+            s.stop()
+        reg.stop()
+
+
+def test_mux_connection_concurrent_calls_and_timeout(stub_fleet):
+    token, reg, servers = stub_fleet
+    servers.append(_stub_replica(token, reg.addr, tokens=(7,), delay=0.05))
+    mux = MuxConnection(servers[0].addr, token)
+    out = [None] * 8
+
+    def one(i):
+        out[i] = mux.call({"op": "generate", "prompt": [i]}, timeout=10.0)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert all(r["tokens"] == [7] for r in out)
+    with pytest.raises(CallTimeout):
+        # Slow handler vs a tiny deadline: the call times out cleanly.
+        mux.call({"op": "generate", "prompt": [0]}, timeout=0.01)
+    mux.close()
+    with pytest.raises(ConnectionLost):
+        mux.call({"op": "generate"}, timeout=1.0)
+
+
+def test_router_balances_across_replicas(stub_fleet):
+    token, reg, servers = stub_fleet
+    servers.append(_stub_replica(token, reg.addr, tokens=(1,), delay=0.2))
+    servers.append(_stub_replica(token, reg.addr, tokens=(2,), delay=0.2))
+    assert reg.wait_for(2, timeout=5.0)
+    router = Router(reg, FleetMetrics(), token=token)
+    try:
+        results = [None] * 6
+
+        def one(i):
+            results[i] = router.route({"op": "generate", "prompt": [i]})
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)    # let each call register its slot so the
+            # next pick() sees real outstanding counts (p2c balances on
+            # them)
+        for t in threads:
+            t.join(timeout=10.0)
+        served_by = {tuple(r["tokens"]) for r in results}
+        # Least-outstanding p2c must use BOTH replicas for 6 concurrent
+        # slow requests — a single-replica pile-up is a routing bug.
+        assert served_by == {(1,), (2,)}
+    finally:
+        router.close()
+
+
+def test_router_retries_on_dead_replica_and_gives_up(stub_fleet):
+    token, reg, servers = stub_fleet
+    # A "replica" that is just a closed port, registered FIRST (ties in
+    # least-outstanding break by registration order, so the first route
+    # deterministically tries it).
+    dead_sock = wire.bind_ephemeral("127.0.0.1")
+    dead_addr = wire.sock_addr(dead_sock, advertise_host="127.0.0.1")
+    dead_sock.close()
+    feeder = wire.connect(reg.addr)
+    wire.send_msg(feeder, {"op": "hello", "addr": dead_addr}, token)
+    assert _wait(lambda: len(reg.alive()) == 1)
+    servers.append(_stub_replica(token, reg.addr, tokens=(9,)))
+    assert reg.wait_for(2, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        reply = router.route({"op": "generate", "prompt": [1]})
+        assert reply["tokens"] == [9]           # failover to the survivor
+        assert metrics.get("retries") >= 1
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()
+                              if r["addr"] == dead_addr] == [DEAD])
+        # Kill the survivor too: the bounded retry loop must FAIL, not
+        # hang.
+        servers[0].stop()
+        reg.mark_dead(servers[0].addr)
+        with pytest.raises(RoutingError):
+            router.route({"op": "generate", "prompt": [2]})
+    finally:
+        router.close()
+        feeder.close()
+
+
+def test_router_retries_on_mid_request_eof(stub_fleet):
+    token, reg, servers = stub_fleet
+
+    # A replica that accepts, reads the request, then slams the
+    # connection — the shape of a process dying mid-stream.
+    flaky_listen = wire.bind_ephemeral("127.0.0.1")
+    flaky_addr = wire.sock_addr(flaky_listen, advertise_host="127.0.0.1")
+
+    def flaky():
+        while True:
+            try:
+                conn, _ = flaky_listen.accept()
+            except OSError:
+                return
+            try:
+                conn.recv(65536)
+                conn.close()
+            except OSError:
+                pass
+
+    threading.Thread(target=flaky, daemon=True).start()
+    feeder = wire.connect(reg.addr)
+    wire.send_msg(feeder, {"op": "hello", "addr": flaky_addr}, token)
+    assert _wait(lambda: len(reg.alive()) == 1)
+    servers.append(_stub_replica(token, reg.addr, tokens=(5,)))
+    assert reg.wait_for(2, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        reply = router.route({"op": "generate", "prompt": [1]})
+        assert reply["tokens"] == [5]
+        assert metrics.get("retries") >= 1
+    finally:
+        router.close()
+        feeder.close()
+        flaky_listen.close()
+
+
+def test_gateway_over_stub_replicas(stub_fleet):
+    token, reg, servers = stub_fleet
+    servers.append(_stub_replica(token, reg.addr, tokens=(4, 2)))
+    assert reg.wait_for(1, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=2).start()
+    try:
+        client = FleetClient(gw.addr, token)
+        out = client.generate([1, 2, 3], max_new_tokens=2)
+        assert out["tokens"] == [4, 2]
+        snap = client.metrics()
+        assert snap["counters"]["received"] == 1
+        assert snap["counters"]["admitted"] == 1
+        assert snap["counters"]["completed"] == 1
+        assert snap["gauges"]["replicas_alive"] == 1
+        # Unauthenticated clients never reach the handler.
+        intruder = wire.connect(gw.addr)
+        wire.send_msg(intruder, {"op": "generate"}, "wrong-token")
+        with pytest.raises((OSError, wire.WireError)):
+            for _ in range(10):
+                wire.recv_msg(intruder, "wrong-token")
+        intruder.close()
+        client.close()
+    finally:
+        gw.stop()
+
+
+# -- end to end: gateway + 2 LocalBackend-launched batcher replicas --------
+
+
+N_E2E_REPLICAS = 2
+E2E_ROWS = 4
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Gateway + registry + 2 tiny-model replicas launched as Mode-B
+    tasks through LocalBackend (CPU subprocesses)."""
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    fs = FleetServer(replicas=N_E2E_REPLICAS, rows=E2E_ROWS, tiny=True,
+                     max_len=64, page_size=16, prefill_bucket=16,
+                     workers=8, max_queue=64, request_timeout=300.0,
+                     start_timeout=240.0)
+    fs.start()
+    yield fs
+    fs.stop()
+
+
+@pytest.fixture(scope="module")
+def tiny_offline():
+    """The replicas' exact model (tiny_model is deterministic from its
+    seed), plus the offline greedy reference continuation."""
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.fleet.replica import tiny_model
+    from tfmesos_tpu.models import transformer
+
+    cfg, params = tiny_model(seed=0)
+
+    def offline(prompt, max_new_tokens, stop_token=None):
+        out = transformer.generate(
+            cfg, params, jnp.asarray(np.asarray(prompt, np.int32)[None]),
+            max_new_tokens, temperature=0.0, stop_token=stop_token)
+        row = np.asarray(out)[0, len(prompt):].tolist()
+        if stop_token is not None and stop_token in row:
+            row = row[:row.index(stop_token) + 1]
+        return row
+
+    return cfg, offline
+
+
+def _e2e_prompts(cfg, n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size,
+                        size=rng.randint(3, 16)).astype(np.int32)
+            for _ in range(n)]
+
+
+def test_fleet_serves_concurrent_requests_correctly(fleet, tiny_offline):
+    """Acceptance: >= 16 concurrent requests through the gateway come
+    back with the exact offline-greedy completions, and the metrics
+    ledger balances."""
+    cfg, offline = tiny_offline
+    prompts = _e2e_prompts(cfg, 16, seed=1)
+    wants = [2 + (i % 5) for i in range(16)]
+    client = fleet.client(timeout=300.0)
+    results = [None] * 16
+    errors = []
+
+    def one(i):
+        try:
+            results[i] = client.generate(prompts[i], wants[i])
+        except Exception as e:   # collected, not raised mid-thread
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    assert not errors, errors
+    assert all(not t.is_alive() for t in threads)
+    for i in range(16):
+        assert results[i]["tokens"] == offline(prompts[i], wants[i]), \
+            f"request {i} diverged from offline generation"
+        assert results[i]["ttft_ms"] >= 0.0
+        assert results[i]["total_ms"] >= results[i]["ttft_ms"]
+    snap = fleet.snapshot()
+    c = snap["counters"]
+    assert c["received"] == c["admitted"] + c.get("shed_queue", 0) + \
+        c.get("shed_rate_limited", 0)
+    assert c["admitted"] == c["completed"] + c.get("failed", 0)
+    assert c["completed"] >= 16
+    assert c.get("shed_queue", 0) == 0
+    assert snap["histograms"]["ttft_ms"]["count"] == c["completed"]
+    client.close()
+
+
+def test_fleet_overload_sheds_explicitly(fleet, tiny_offline):
+    """Acceptance: driving the ingress queue past its bound yields
+    explicit Overloaded rejections — and never a hang.  Uses its own
+    gateway (1 worker, queue bound 2) over the SAME live replicas."""
+    cfg, _ = tiny_offline
+    metrics = FleetMetrics()
+    router = Router(fleet.registry, metrics, token=fleet.token,
+                    request_timeout=300.0)
+    adm = AdmissionController(max_queue=2)
+    gw = Gateway(router, adm, metrics, token=fleet.token,
+                 workers=1).start()
+    prompts = _e2e_prompts(cfg, 32, seed=2)
+    client = FleetClient(gw.addr, fleet.token, timeout=300.0)
+    done, shed, failures = [], [], []
+
+    def one(i):
+        try:
+            done.append(client.generate(prompts[i], 4))
+        except Overloaded:
+            shed.append(i)
+        except Exception as e:
+            failures.append((i, e))
+
+    try:
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        assert all(not t.is_alive() for t in threads), "a request hung"
+        assert not failures, failures
+        assert len(done) + len(shed) == 32
+        assert shed, "queue bound 2 with 1 worker must shed a 32-burst"
+        assert done, "some requests must still be served while shedding"
+        c = metrics.snapshot()["counters"]
+        assert c["received"] == 32
+        assert c["admitted"] == len(done)
+        assert c["shed_queue"] == len(shed)
+        assert c["completed"] == len(done)
+        client.close()
+    finally:
+        gw.stop()
+
+
+def test_fleet_replica_death_mid_stream_retries_on_survivor(
+        fleet, tiny_offline):
+    """Acceptance: SIGKILL one replica while requests are in flight —
+    every request still completes correctly (retried on the survivor)
+    and the retry/death counters record it.  Runs LAST in this module:
+    it permanently takes one replica down."""
+    import os
+    import signal as _signal
+
+    cfg, offline = tiny_offline
+    prompts = _e2e_prompts(cfg, 12, seed=3)
+    want = 48                           # long enough to be in flight
+    client = fleet.client(timeout=300.0)
+    results = [None] * 12
+    errors = []
+
+    def one(i):
+        try:
+            results[i] = client.generate(prompts[i], want)
+        except Exception as e:
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+
+    # Wait until BOTH replicas have requests in flight (router-side
+    # outstanding counts), then kill one whole task process group (the
+    # Mode-B wrapper AND the replica under it) — whichever dies has
+    # work mid-stream, so the failover path must fire.
+    def both_busy():
+        addrs = [r.addr for r in fleet.registry.alive()]
+        return len(addrs) == 2 and all(
+            fleet.router.outstanding(a) > 0 for a in addrs)
+
+    assert _wait(both_busy, timeout=60.0), "work never spread over both"
+    procs = fleet.scheduler.backend._procs
+    victim = next(p for p in procs.values() if p.poll() is None)
+    os.killpg(victim.pid, _signal.SIGKILL)
+    for t in threads:
+        t.join(timeout=300.0)
+    assert all(not t.is_alive() for t in threads)
+    assert not errors, errors
+    for i in range(12):
+        assert results[i]["tokens"] == offline(prompts[i], want), \
+            f"request {i} diverged after failover"
+    # The death was observed and at least one request failed over.
+    assert fleet.metrics.get("retries") >= 1
+    assert _wait(lambda: len(fleet.registry.alive()) == 1, timeout=10.0)
+    snap = fleet.snapshot()
+    c = snap["counters"]
+    assert c["admitted"] == c["completed"] + c.get("failed", 0)
+    assert c.get("replicas_died", 0) >= 1
+    client.close()
+
+
+def test_fleet_rejects_unservable_request(fleet):
+    """A prompt that can never fit max_len comes back as an explicit
+    bad_request error from the replica, not a hang or a dead loop."""
+    from tfmesos_tpu.fleet.client import RequestFailed
+
+    client = fleet.client(timeout=60.0)
+    with pytest.raises(RequestFailed) as e:
+        client.generate(list(range(1, 60)), max_new_tokens=40)
+    assert e.value.kind == "bad_request"
+    client.close()
+
+
+def test_fleet_gateway_requires_token(fleet):
+    """The front door speaks only the authenticated protocol."""
+    sock = wire.connect(fleet.addr, timeout=5.0)
+    wire.send_msg(sock, {"op": "generate", "prompt": [1],
+                         "max_new_tokens": 1}, "not-the-token")
+    sock.settimeout(2.0)
+    with pytest.raises((OSError, wire.WireError)):
+        wire.recv_msg(sock, "not-the-token")
+    sock.close()

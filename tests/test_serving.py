@@ -934,3 +934,71 @@ def test_bucket_width_invariants():
     assert side.bucket_width() == 8            # shrinks with the workload
     # Widths always slice within the table.
     assert side.bucket_width() <= side.np_max
+
+
+def test_incremental_submission_matches_offline(setup):
+    """The online front door's path: submit() from another thread while
+    serve() decodes; streams must match offline generation exactly, and
+    close() must drain and end the loop."""
+    import threading
+    import time
+
+    cfg, params = setup
+    reqs = [Request(prompt=p, max_new_tokens=3 + (i % 5))
+            for i, p in enumerate(_prompts(cfg, 8, seed=11))]
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                                page_size=16, prefill_bucket=16)
+    done = {}
+
+    def consume():
+        for c in batcher.serve():
+            done[c.rid] = c
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    for i, req in enumerate(reqs):
+        batcher.submit(req)
+        if i % 3 == 0:
+            time.sleep(0.05)    # arrivals land mid-decode, not up front
+    batcher.close()
+    t.join(timeout=300.0)
+    assert not t.is_alive(), "serve() failed to drain after close()"
+    assert len(done) == len(reqs)
+    for rid, req in enumerate(reqs):
+        assert done[rid].request is req
+        assert done[rid].tokens == _offline(cfg, params, req), \
+            f"submitted request {rid} diverged from offline generation"
+    with pytest.raises(RuntimeError):
+        batcher.submit(reqs[0])     # the stream is closed
+
+
+def test_submission_close_before_serve_and_validate(setup):
+    cfg, params = setup
+    batcher = ContinuousBatcher(cfg, params, rows=1, max_len=32,
+                                page_size=16, prefill_bucket=16)
+    # validate() pre-checks what run() would raise only after draining.
+    batcher.validate(Request(prompt=np.asarray([1, 2, 3], np.int32),
+                             max_new_tokens=4))
+    with pytest.raises(ValueError):
+        batcher.validate(Request(
+            prompt=(np.arange(30, dtype=np.int32) % cfg.vocab_size),
+            max_new_tokens=30))
+    # close() before serve(): the loop ends immediately instead of
+    # blocking forever on an idle queue.
+    batcher.close()
+    assert list(batcher.serve()) == []
+
+
+def test_submission_queue_type_checks(setup):
+    from tfmesos_tpu.serving import SubmissionQueue
+
+    sq = SubmissionQueue()
+    with pytest.raises(TypeError):
+        sq.submit([1, 2, 3])        # raw arrays must be wrapped first
+    sq.submit(Request(prompt=np.asarray([1], np.int32), max_new_tokens=1))
+    sq.close()
+    assert sq.closed
+    sq.close()                      # idempotent
+    with pytest.raises(RuntimeError):
+        sq.submit(Request(prompt=np.asarray([1], np.int32),
+                          max_new_tokens=1))

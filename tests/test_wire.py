@@ -100,3 +100,107 @@ def test_load_token_prefers_file(tmp_path):
     assert load_token(env) == "file-token"
     assert load_token({"TPUMESOS_TOKEN": "env-token"}) == "env-token"
     assert load_token({}) == ""
+
+
+# -- fuzz / edge cases (fleet PR: the gateway multiplies the number of
+# -- long-lived framed connections, so the decoder's edges get exhaustive
+# -- coverage) --------------------------------------------------------------
+
+
+def test_framer_every_two_part_split_boundary():
+    """Partial frames split at EVERY byte boundary must decode
+    identically to one contiguous feed."""
+    token = wire.new_token()
+    msgs = [{"op": "generate", "prompt": [1, 2, 3]}, "x" * 40, [7, [8]]]
+    stream = b"".join(wire.encode(m, token) for m in msgs)
+    for i in range(1, len(stream)):
+        framer = wire.Framer(token)
+        out = framer.feed(stream[:i])
+        out.extend(framer.feed(stream[i:]))
+        assert out == msgs, f"diverged when split at byte {i}"
+
+
+def test_framer_three_part_splits_around_header():
+    """Splits inside the 4-byte length prefix AND inside the tag of the
+    same frame (the double-partial case a byte-at-a-time feed can miss
+    interacting)."""
+    token = wire.new_token()
+    msg = {"k": "v" * 17}
+    stream = wire.encode(msg, token) * 2
+    for i in range(1, 4):
+        for j in range(i + 1, min(len(stream), i + 40)):
+            framer = wire.Framer(token)
+            out = framer.feed(stream[:i])
+            out.extend(framer.feed(stream[i:j]))
+            out.extend(framer.feed(stream[j:]))
+            assert out == [msg, msg], f"diverged at splits ({i}, {j})"
+
+
+def test_oversized_length_prefix_rejected_before_buffering():
+    """A length prefix over MAX_FRAME must raise immediately — both in
+    the incremental decoder and the blocking reader — not allocate."""
+    import struct
+
+    huge = struct.pack(">I", wire.MAX_FRAME + 1)
+    framer = wire.Framer()
+    with pytest.raises(wire.WireError, match="exceeds limit"):
+        framer.feed(huge)
+    c, s = _pair()
+    c.sendall(huge + b"\x00" * 64)
+    with pytest.raises(wire.WireError, match="exceeds limit"):
+        wire.recv_msg(s)
+    c.close(); s.close()
+
+
+def test_frame_shorter_than_tag_rejected():
+    """A frame whose payload cannot even hold the 32-byte auth tag is
+    malformed, not silently truncated."""
+    import struct
+
+    for n in (0, 1, wire.TAG_SIZE - 1):
+        frame = struct.pack(">I", n) + b"\x01" * n
+        framer = wire.Framer()
+        with pytest.raises(wire.WireError, match="shorter than auth tag"):
+            framer.feed(frame)
+
+
+def test_framer_wrong_token_rejected_incrementally():
+    """Wrong-token rejection through the incremental path, fed one byte
+    at a time — the tag check must fire exactly when the frame
+    completes."""
+    frame = wire.encode({"a": 1}, "right-token")
+    framer = wire.Framer("wrong-token")
+    with pytest.raises(wire.WireError, match="bad auth tag"):
+        for i in range(len(frame)):
+            framer.feed(frame[i:i + 1])
+
+
+def test_recv_msg_wrong_token_then_socket_reusable_for_framer():
+    """recv_msg with the wrong token rejects the frame; a fresh frame
+    with the right token on the same socket still decodes (the gateway
+    logs-and-drops per connection, so the decoder must not poison
+    unrelated state)."""
+    token = wire.new_token()
+    c, s = _pair()
+    wire.send_msg(c, "nope", "other-token")
+    with pytest.raises(wire.WireError):
+        wire.recv_msg(s, token)
+    wire.send_msg(c, "yes", token)
+    assert wire.recv_msg(s, token) == "yes"
+    c.close(); s.close()
+
+
+def test_non_utf8_body_rejected():
+    """A correct tag over a non-JSON body is still a WireError (never a
+    raw UnicodeDecodeError escaping to callers)."""
+    import hashlib
+    import hmac as hmac_mod
+    import struct
+
+    token = "t"
+    body = b"\xff\xfe{bad"
+    tag = hmac_mod.new(token.encode(), body, hashlib.sha256).digest()
+    frame = struct.pack(">I", len(tag) + len(body)) + tag + body
+    framer = wire.Framer(token)
+    with pytest.raises(wire.WireError, match="bad JSON body"):
+        framer.feed(frame)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import selectors
 import sys
 import time
@@ -158,6 +159,116 @@ def forward_map(worker_logs: str, nworker: int, collector_addr: str) -> Dict[str
             raise ValueError(f"bad --worker-logs entry {tok!r}; want indices or '*'")
         out[f"worker:{tok}"] = collector_addr
     return out
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    """``tfserve`` — the online-serving entry point: gateway + N batcher
+    replicas scheduled as Mode-B tasks (fleet subsystem,
+    docs/SERVING.md "Online serving & the fleet gateway")."""
+    p = argparse.ArgumentParser(
+        prog="tfserve",
+        description="Serve a model online: a fleet gateway fronting N "
+                    "continuous-batching replicas scheduled via Mesos "
+                    "(or locally).")
+    p.add_argument("-R", "--replicas", type=int, default=2,
+                   help="number of serving replicas")
+    p.add_argument("-m", "--master", type=str, default=None,
+                   help="Mesos master (host:port or zk://...); default env "
+                        "MESOS_MASTER, else local backend")
+    p.add_argument("-n", "--name", type=str, default=None,
+                   help="framework name")
+    p.add_argument("-Cr", "--replica-cpus", type=float, default=1.0,
+                   help="CPUs per replica task")
+    p.add_argument("-Gr", "--replica-chips", type=int, default=0,
+                   help="TPU chips per replica task")
+    p.add_argument("-Mr", "--replica-mem", type=float, default=1024.0,
+                   help="MB of memory per replica task")
+    p.add_argument("-p", "--gateway-port", type=int, default=8780,
+                   help="gateway listen port (0 = OS-assigned)")
+    p.add_argument("--gateway-host", type=str, default="0.0.0.0")
+    p.add_argument("--rows", type=int, default=8,
+                   help="concurrent decode rows per replica")
+    p.add_argument("--max-len", type=int, default=None,
+                   help="per-request cache positions (default: model max)")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="ingress queue bound; past it requests shed with "
+                        "an explicit Overloaded rejection")
+    p.add_argument("--rate", type=float, default=None,
+                   help="token-bucket admission rate, requests/s "
+                        "(default: unlimited)")
+    p.add_argument("--burst", type=float, default=None,
+                   help="token-bucket burst size (default: max(1, rate))")
+    p.add_argument("--workers", type=int, default=8,
+                   help="gateway dispatcher threads")
+    p.add_argument("--retries", type=int, default=2,
+                   help="max failovers to a different replica per request")
+    p.add_argument("--tiny", action="store_true",
+                   help="serve the tiny CI model (dev/demo)")
+    p.add_argument("--metrics-interval", type=float, default=10.0,
+                   help="seconds between fleet metrics log lines "
+                        "(0 disables)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    args = build_serve_parser().parse_args(argv)
+    if args.replicas < 1:
+        print(f"tfserve: --replicas must be >= 1, got {args.replicas}",
+              file=sys.stderr)
+        return 2
+    if args.rows < 1:
+        print(f"tfserve: --rows must be >= 1, got {args.rows}",
+              file=sys.stderr)
+        return 2
+
+    from tfmesos_tpu.fleet.launcher import FleetServer
+    from tfmesos_tpu.scheduler import ClusterError
+
+    # Clients must present the cluster token: honor an operator-supplied
+    # one (the standard TPUMESOS_TOKEN / TPUMESOS_TOKEN_FILE contract);
+    # otherwise mint one and leave it in a mode-0600 file the operator
+    # can point clients at.
+    token = wire.load_token() or None
+    fleet = FleetServer(
+        replicas=args.replicas, rows=args.rows, tiny=args.tiny,
+        max_len=args.max_len, master=args.master,
+        replica_cpus=args.replica_cpus, replica_mem=args.replica_mem,
+        replica_chips=args.replica_chips,
+        gateway_host=args.gateway_host, gateway_port=args.gateway_port,
+        workers=args.workers, max_queue=args.max_queue, rate=args.rate,
+        burst=args.burst, max_retries=args.retries,
+        report_interval=args.metrics_interval or None,
+        quiet=not args.verbose, token=token)
+    try:
+        fleet.start()
+    except (ClusterError, ValueError, RuntimeError) as e:
+        print(f"tfserve: fleet bring-up failed: {e}", file=sys.stderr)
+        return 1
+    token_file = None
+    if token is None:
+        import tempfile
+
+        fd, token_file = tempfile.mkstemp(prefix="tfserve-token-")
+        with os.fdopen(fd, "w") as f:   # mkstemp creates mode 0600
+            f.write(fleet.token)
+        print(f"tfserve: client token file {token_file} (clients set "
+              f"{wire.TOKEN_FILE_ENV}={token_file})", flush=True)
+    print(f"tfserve: gateway on {fleet.addr} fronting {args.replicas} "
+          f"replica(s); ctrl-c to stop", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("tfserve: shutting down", file=sys.stderr)
+    finally:
+        fleet.stop()
+        if token_file is not None:
+            try:
+                os.unlink(token_file)
+            except OSError:
+                pass
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,52 @@
+"""Fleet serving gateway: online multi-replica inference.
+
+The reference framework is control-plane-only (its examples end at
+training) and our serving stack is a powerful but single-process
+``ContinuousBatcher``.  This package is the layer between the scheduler
+and the batcher — a thin replica-management abstraction in the spirit of
+TF-Replicator (PAPERS.md) over the existing execution engine:
+
+* :mod:`~tfmesos_tpu.fleet.registry` — replica liveness via heartbeats
+  over the authenticated wire protocol (alive → draining → dead →
+  evicted).
+* :mod:`~tfmesos_tpu.fleet.router` — least-outstanding-requests routing
+  with power-of-two-choices sampling, plus bounded retry-with-backoff
+  onto a different replica when a connection dies mid-request.
+* :mod:`~tfmesos_tpu.fleet.admission` — backpressure: a bounded ingress
+  queue, queue-depth shedding with explicit ``Overloaded`` rejections,
+  and a token-bucket rate limiter.
+* :mod:`~tfmesos_tpu.fleet.gateway` — the threaded TCP front door that
+  accepts client requests, routes them, and relays completions back.
+* :mod:`~tfmesos_tpu.fleet.metrics` — counters + latency histograms
+  (TTFT, tokens/s, queue depth, shed/retry counts) as a JSON snapshot
+  and a periodic log line.
+* :mod:`~tfmesos_tpu.fleet.replica` — the replica process: a
+  ``ContinuousBatcher`` behind a TCP server, fed through the batcher's
+  incremental submission API; launched as a Mode-B task through the
+  backend abstraction (so ``LocalBackend`` runs whole fleets on CPU).
+* :mod:`~tfmesos_tpu.fleet.launcher` — ``FleetServer``: one object that
+  brings the whole thing up (registry + gateway + N scheduled replicas)
+  and tears it down.
+
+Everything here except :mod:`replica` is jax-free — the gateway process
+never touches an accelerator.
+"""
+
+from __future__ import annotations
+
+from tfmesos_tpu.fleet.admission import (AdmissionController, Overloaded,
+                                         RateLimited, TokenBucket)
+from tfmesos_tpu.fleet.client import (ConnectionLost, FleetClient,
+                                      MuxConnection, RequestFailed)
+from tfmesos_tpu.fleet.gateway import Gateway
+from tfmesos_tpu.fleet.launcher import FleetServer
+from tfmesos_tpu.fleet.metrics import FleetMetrics
+from tfmesos_tpu.fleet.registry import ReplicaInfo, ReplicaRegistry
+from tfmesos_tpu.fleet.router import Router, RoutingError
+
+__all__ = [
+    "AdmissionController", "Overloaded", "RateLimited", "TokenBucket",
+    "ConnectionLost", "FleetClient", "MuxConnection", "RequestFailed",
+    "Gateway", "FleetServer", "FleetMetrics", "ReplicaInfo",
+    "ReplicaRegistry", "Router", "RoutingError",
+]

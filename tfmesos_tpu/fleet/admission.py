@@ -1,0 +1,111 @@
+"""Admission control and backpressure for the fleet gateway.
+
+Overload policy, in order of application:
+
+1. **Token-bucket rate limiter** (optional): a sustained requests/s cap
+   with a burst allowance.  Over-rate arrivals are rejected with
+   :class:`RateLimited` before they cost anything downstream.
+2. **Bounded ingress queue**: accepted requests wait here for a
+   dispatcher; when the queue is full the arrival is rejected with
+   :class:`Overloaded`.
+
+Both rejections are EXPLICIT wire replies — the contract is "never a
+hang": a client always gets either a completion or an immediate
+overload signal it can back off on.  (The alternative — unbounded
+queueing — converts overload into unbounded latency, which at serving
+scale is indistinguishable from an outage.)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = ["Overloaded", "RateLimited", "TokenBucket",
+           "AdmissionController"]
+
+
+class Overloaded(Exception):
+    """Explicit shed: the ingress queue is at its bound."""
+
+    kind = "overloaded"
+
+
+class RateLimited(Overloaded):
+    """Explicit shed: the token bucket is empty."""
+
+    kind = "rate_limited"
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``
+    capacity; ``try_acquire`` never blocks (admission sheds instead of
+    queueing over-rate work)."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock=time.monotonic):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else max(1.0, rate))
+        if self.burst < 1.0:
+            raise ValueError(f"burst must be >= 1, got {self.burst}")
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class AdmissionController:
+    """Bounded ingress queue + optional rate limiter.
+
+    The gateway's connection threads call :meth:`admit` (which raises
+    on shed); its dispatcher workers call :meth:`get`.  ``depth()`` is
+    exported as the ``queue_depth`` gauge.
+    """
+
+    def __init__(self, max_queue: int = 64, rate: Optional[float] = None,
+                 burst: Optional[float] = None):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+        self.bucket = TokenBucket(rate, burst) if rate is not None else None
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=self.max_queue)
+
+    def admit(self, item: Any) -> None:
+        """Enqueue ``item`` or raise — never blocks the caller's
+        connection thread."""
+        if self.bucket is not None and not self.bucket.try_acquire():
+            raise RateLimited(
+                f"rate limit exceeded ({self.bucket.rate:g} req/s, "
+                f"burst {self.bucket.burst:g})")
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            raise Overloaded(
+                f"ingress queue full ({self.max_queue} requests "
+                f"waiting)") from None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Next admitted item, or ``None`` on timeout (workers poll so
+        shutdown never needs queue poisoning)."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def depth(self) -> int:
+        return self._q.qsize()

@@ -1,0 +1,208 @@
+"""Multiplexed request/reply over the authenticated wire protocol.
+
+One persistent connection carries many in-flight requests, matched by a
+connection-local ``id`` the sender assigns — the transport both sides of
+the fleet share: the router uses :class:`MuxConnection` to talk to
+replicas (its ``outstanding`` count is what least-outstanding routing
+balances on), and :class:`FleetClient` wraps the same machinery for
+callers talking to the gateway.
+
+Failure model: when the peer closes or the socket errors, EVERY pending
+call fails promptly with :class:`ConnectionLost` — nothing blocks until
+a timeout just because a replica died (the router turns that into a
+retry on a different replica).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.fleet.admission import Overloaded, RateLimited
+from tfmesos_tpu.utils.logging import get_logger
+
+__all__ = ["ConnectionLost", "CallTimeout", "RequestFailed",
+           "MuxConnection", "FleetClient"]
+
+
+class ConnectionLost(OSError):
+    """The peer went away (EOF, reset, or bad frame) with calls pending."""
+
+
+class CallTimeout(TimeoutError):
+    """No reply within the caller's deadline (the connection is still up)."""
+
+
+class RequestFailed(RuntimeError):
+    """The peer replied with an error (``kind`` names which)."""
+
+    def __init__(self, message: str, kind: str = "error"):
+        super().__init__(message)
+        self.kind = kind
+
+
+class MuxConnection:
+    """Thread-safe multiplexed calls over one authenticated socket.
+
+    ``call()`` may be invoked from any number of threads; a reader
+    thread dispatches replies to waiters by ``id``.  ``outstanding`` is
+    the number of calls awaiting replies — the router's load signal.
+    """
+
+    def __init__(self, addr: str, token: str = "",
+                 connect_timeout: float = 10.0):
+        self.addr = addr
+        self._token = token
+        self._sock = wire.connect(addr, timeout=connect_timeout)
+        # Idle mux connections are normal (a replica with no traffic);
+        # per-call deadlines live in call(), not on the socket.
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._slots: Dict[int, list] = {}   # id -> [Event, reply|None]
+        self._next_id = 0
+        self._closed = False
+        self._error: Optional[str] = None
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name=f"mux-{addr}", daemon=True)
+        self._reader.start()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    def call(self, msg: Dict[str, Any],
+             timeout: Optional[float] = None) -> Any:
+        """Send ``msg`` (its ``id`` field is overwritten with ours) and
+        block for the matching reply."""
+        with self._lock:
+            if self._closed:
+                raise ConnectionLost(self._error or "connection closed")
+            self._next_id += 1
+            mid = self._next_id
+            slot = [threading.Event(), None]
+            self._slots[mid] = slot
+        out = dict(msg)
+        out["id"] = mid
+        try:
+            with self._send_lock:
+                wire.send_msg(self._sock, out, self._token)
+        except OSError as e:
+            with self._lock:
+                self._slots.pop(mid, None)
+            self._fail(f"send failed: {e}")
+            raise ConnectionLost(str(e)) from e
+        if not slot[0].wait(timeout):
+            with self._lock:
+                self._slots.pop(mid, None)
+                # The reply may have raced the timeout (the reader
+                # stores it under this lock) — honor it if so.
+                if slot[1] is not None:
+                    return slot[1]
+            raise CallTimeout(f"no reply from {self.addr} "
+                              f"within {timeout}s")
+        if slot[1] is None:     # woken by _fail, not by a reply
+            raise ConnectionLost(self._error or "connection closed")
+        return slot[1]
+
+    def _read_loop(self) -> None:
+        framer = wire.Framer(self._token)
+        try:
+            for msg in wire.iter_msgs(self._sock, framer):
+                if not isinstance(msg, dict):
+                    continue
+                with self._lock:
+                    # The reply lands under the lock so a caller whose
+                    # wait() just timed out still finds it (its own pop
+                    # serializes after this one).
+                    slot = self._slots.pop(msg.get("id"), None)
+                    if slot is not None:
+                        slot[1] = msg
+                if slot is not None:
+                    slot[0].set()
+            self._fail("EOF from peer")
+        except (OSError, wire.WireError) as e:
+            self._fail(str(e))
+
+    def _fail(self, why: str) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._error = why
+            pending: List[list] = list(self._slots.values())
+            self._slots.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for slot in pending:    # wake every waiter; slot[1] stays None
+            slot[0].set()
+
+    def close(self) -> None:
+        self._fail("closed by caller")
+
+
+class FleetClient:
+    """Caller-side handle on a fleet gateway.
+
+    Thread-safe: many threads may ``generate()`` concurrently over the
+    one multiplexed connection.  Overload rejections surface as
+    :class:`~tfmesos_tpu.fleet.admission.Overloaded` — the explicit
+    backpressure signal callers are expected to handle (back off,
+    retry later, or spill).
+    """
+
+    def __init__(self, addr: str, token: str = "", timeout: float = 120.0,
+                 connect_timeout: float = 10.0):
+        self.addr = addr
+        self.timeout = timeout
+        self.log = get_logger("tfmesos_tpu.fleet.client")
+        self._mux = MuxConnection(addr, token,
+                                  connect_timeout=connect_timeout)
+
+    def generate(self, prompt, max_new_tokens: int,
+                 stop_token: Optional[int] = None,
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+        """One generation request; returns the completion dict
+        (``tokens``, ``ttft_ms``, ``total_ms``).  Raises ``Overloaded``
+        on shed, :class:`RequestFailed` on any other error reply."""
+        reply = self._mux.call(
+            {"op": "generate", "prompt": [int(t) for t in prompt],
+             "max_new_tokens": int(max_new_tokens),
+             "stop_token": stop_token},
+            timeout=timeout if timeout is not None else self.timeout)
+        if isinstance(reply, dict) and reply.get("op") == "completion":
+            return reply
+        kind = reply.get("kind", "error") if isinstance(reply, dict) else "error"
+        error = reply.get("error", repr(reply)) if isinstance(reply, dict) \
+            else repr(reply)
+        if kind == "rate_limited":
+            raise RateLimited(error)
+        if kind == "overloaded":
+            raise Overloaded(error)
+        raise RequestFailed(error, kind=kind)
+
+    def metrics(self, timeout: float = 10.0) -> Dict[str, Any]:
+        """The gateway's live metrics snapshot."""
+        reply = self._mux.call({"op": "metrics"}, timeout=timeout)
+        return reply.get("snapshot", {})
+
+    @property
+    def outstanding(self) -> int:
+        return self._mux.outstanding
+
+    def close(self) -> None:
+        self._mux.close()
+
+    def __enter__(self) -> "FleetClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,219 @@
+"""The fleet's TCP front door.
+
+A threaded accept loop feeds per-connection reader threads; readers
+apply admission control inline (so a shed costs one queue check, not a
+dispatcher slot) and admitted requests wait in the bounded ingress
+queue for one of ``workers`` dispatcher threads, which route them to a
+replica and relay the completion back on the client's connection.
+
+Wire surface (all frames HMAC-authenticated with the cluster token):
+
+* ``{"op": "generate", "id", "prompt", "max_new_tokens", "stop_token"}``
+  → ``{"op": "completion", "id", "tokens", "ttft_ms", "total_ms"}`` or
+  ``{"op": "error", "id", "kind", "error"}`` with ``kind`` one of
+  ``overloaded`` / ``rate_limited`` (admission shed — back off),
+  ``unavailable`` (no replica within the retry budget), ``bad_request``.
+* ``{"op": "metrics", "id"}`` → ``{"op": "metrics", "id", "snapshot"}``.
+* ``{"op": "ping", "id"}`` → ``{"op": "pong", "id"}``.
+
+Clients multiplex: many requests may be in flight per connection, and
+completions return in FINISH order, matched by ``id`` — the same
+streaming shape the replicas themselves speak.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, Optional, Set
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.fleet.admission import AdmissionController, Overloaded, RateLimited
+from tfmesos_tpu.fleet.metrics import FleetMetrics
+from tfmesos_tpu.fleet.router import Router
+from tfmesos_tpu.utils.logging import get_logger
+
+__all__ = ["Gateway"]
+
+
+class _Client:
+    """One accepted client connection: a socket plus a write lock (the
+    reader thread and any dispatcher may reply concurrently)."""
+
+    def __init__(self, sock: socket.socket, token: str):
+        self.sock = sock
+        self._token = token
+        self._lock = threading.Lock()
+
+    def send(self, msg: Dict[str, Any]) -> None:
+        """Best-effort reply; a vanished client is not an error the
+        serving path should care about."""
+        try:
+            with self._lock:
+                wire.send_msg(self.sock, msg, self._token)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Gateway:
+    """Accepts streaming requests, admits, routes, relays completions."""
+
+    def __init__(self, router: Router, admission: AdmissionController,
+                 metrics: FleetMetrics, token: str = "",
+                 host: str = "127.0.0.1", port: int = 0, workers: int = 8,
+                 registry=None):
+        self.router = router
+        self.admission = admission
+        self.metrics = metrics
+        self.token = token
+        self.host = host
+        self.port = int(port)
+        self.workers = int(workers)
+        self.registry = registry if registry is not None else router.registry
+        self.log = get_logger("tfmesos_tpu.fleet.gateway")
+        self.addr: Optional[str] = None
+        self._listen: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads = []
+        self._clients: Set[_Client] = set()
+        self._clients_lock = threading.Lock()
+        metrics.register_gauge("queue_depth", admission.depth)
+        metrics.register_gauge("replicas_alive",
+                               lambda: len(self.registry.alive()))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Gateway":
+        self._listen = wire.bind_ephemeral(self.host, port=self.port)
+        advertise = None if self.host in ("0.0.0.0", "::") else self.host
+        self.addr = wire.sock_addr(self._listen, advertise_host=advertise)
+        self.log.info("fleet gateway listening on %s (%d workers, queue "
+                      "bound %d)", self.addr, self.workers,
+                      self.admission.max_queue)
+        t = threading.Thread(target=self._accept_loop,
+                             name="gateway-accept", daemon=True)
+        t.start()
+        self._threads = [t]
+        for i in range(self.workers):
+            w = threading.Thread(target=self._worker, name=f"gateway-w{i}",
+                                 daemon=True)
+            w.start()
+            self._threads.append(w)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listen is not None:
+            try:
+                self._listen.close()
+            except OSError:
+                pass
+        with self._clients_lock:
+            clients = list(self._clients)
+            self._clients.clear()
+        for client in clients:
+            client.close()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self.router.close()
+
+    # -- ingress -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listen.accept()
+            except OSError:
+                return
+            client = _Client(sock, self.token)
+            with self._clients_lock:
+                self._clients.add(client)
+            threading.Thread(target=self._serve_client, args=(client,),
+                             name="gateway-conn", daemon=True).start()
+
+    def _serve_client(self, client: _Client) -> None:
+        framer = wire.Framer(self.token)
+        sock = client.sock
+        try:
+            sock.settimeout(None)
+            for msg in wire.iter_msgs(sock, framer):
+                self._handle(client, msg)
+        except wire.WireError as e:
+            self.log.warning("dropping client connection: %s", e)
+        except OSError:
+            pass
+        finally:
+            client.close()
+            with self._clients_lock:
+                self._clients.discard(client)
+
+    def _handle(self, client: _Client, msg: Any) -> None:
+        if not isinstance(msg, dict):
+            return
+        op = msg.get("op")
+        cid = msg.get("id")
+        if op == "ping":
+            client.send({"op": "pong", "id": cid})
+            return
+        if op == "metrics":
+            client.send({"op": "metrics", "id": cid,
+                         "snapshot": self.metrics.snapshot()})
+            return
+        if op != "generate":
+            client.send({"op": "error", "id": cid, "kind": "bad_request",
+                         "error": f"unknown op {op!r}"})
+            return
+        self.metrics.inc("received")
+        forward = {"op": "generate", "prompt": msg.get("prompt"),
+                   "max_new_tokens": msg.get("max_new_tokens"),
+                   "stop_token": msg.get("stop_token")}
+        try:
+            self.admission.admit((client, cid, forward))
+        except RateLimited as e:
+            self.metrics.inc("shed_rate_limited")
+            client.send({"op": "error", "id": cid, "kind": e.kind,
+                         "error": str(e)})
+        except Overloaded as e:
+            self.metrics.inc("shed_queue")
+            client.send({"op": "error", "id": cid, "kind": e.kind,
+                         "error": str(e)})
+        else:
+            self.metrics.inc("admitted")
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            item = self.admission.get(timeout=0.2)
+            if item is None:
+                continue
+            client, cid, forward = item
+            try:
+                reply = self.router.route(forward)
+            except Exception as e:
+                # Any routing failure (RoutingError or unexpected)
+                # becomes an explicit client error; a gateway worker
+                # must survive everything.
+                self.metrics.inc("failed")
+                client.send({"op": "error", "id": cid,
+                             "kind": "unavailable", "error": str(e)})
+                continue
+            out = dict(reply) if isinstance(reply, dict) else {
+                "op": "error", "kind": "internal",
+                "error": f"malformed replica reply {reply!r}"}
+            out["id"] = cid
+            if out.get("op") == "completion":
+                self.metrics.inc("completed")
+                self.metrics.inc("tokens_out",
+                                 len(out.get("tokens") or ()))
+                self.metrics.observe("ttft_ms", out.get("ttft_ms"))
+                self.metrics.observe("latency_ms", out.get("total_ms"))
+            else:
+                self.metrics.inc("failed")
+            client.send(out)

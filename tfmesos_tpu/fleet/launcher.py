@@ -1,0 +1,213 @@
+"""Fleet bring-up: registry + gateway + N scheduled batcher replicas.
+
+``FleetServer`` is the one-object front: it generates a cluster token,
+starts the registry and gateway locally, then launches the replicas as
+**Mode-B tasks through the backend abstraction** — ``LocalBackend``
+(the default with no master) runs whole fleets as CPU subprocesses for
+development and CI; a Mesos master runs them on TPU agents with
+per-replica chip/mem reservations.  The scheduler, registry, and
+gateway share ONE token, delivered to replicas over the scheduler's
+existing transport (mode-0600 token file for co-located backends), so
+every hop of the serving path is authenticated with the same secret.
+
+Replica death is a SERVING event here, not a cluster event: the
+scheduler's fail-fast policy is for training meshes (which cannot
+hot-swap members); the fleet instead routes around dead replicas and
+keeps serving on the survivors.  Replica auto-restart rides the same
+Job machinery a future PR can point at ``task_spec``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.fleet.admission import AdmissionController
+from tfmesos_tpu.fleet.client import FleetClient
+from tfmesos_tpu.fleet.gateway import Gateway
+from tfmesos_tpu.fleet.metrics import FleetMetrics
+from tfmesos_tpu.fleet.registry import ReplicaRegistry
+from tfmesos_tpu.fleet.router import Router
+from tfmesos_tpu.scheduler import ClusterError, TPUMesosScheduler
+from tfmesos_tpu.spec import Job
+from tfmesos_tpu.utils.logging import get_logger
+
+__all__ = ["FleetServer"]
+
+
+class FleetServer:
+    """Bring up (and tear down) a whole serving fleet."""
+
+    def __init__(self, replicas: int = 2, rows: int = 4,
+                 tiny: bool = False, seed: int = 0,
+                 max_len: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 prefill_bucket: Optional[int] = None,
+                 multi_step: int = 1,
+                 backend=None, master: Optional[str] = None,
+                 replica_cpus: float = 1.0, replica_mem: float = 1024.0,
+                 replica_chips: int = 0,
+                 gateway_host: str = "127.0.0.1", gateway_port: int = 0,
+                 workers: int = 8, max_queue: int = 64,
+                 rate: Optional[float] = None,
+                 burst: Optional[float] = None,
+                 max_retries: int = 2, request_timeout: float = 120.0,
+                 start_timeout: float = 300.0,
+                 heartbeat_interval: float = 0.3,
+                 report_interval: Optional[float] = None,
+                 quiet: bool = True, token: Optional[str] = None):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.replicas = int(replicas)
+        self.rows = int(rows)
+        self.tiny = bool(tiny)
+        self.seed = int(seed)
+        self.max_len = max_len
+        self.page_size = page_size
+        self.prefill_bucket = prefill_bucket
+        self.multi_step = int(multi_step)
+        self.backend = backend
+        self.master = master
+        self.replica_cpus = float(replica_cpus)
+        self.replica_mem = float(replica_mem)
+        self.replica_chips = int(replica_chips)
+        self.gateway_host = gateway_host
+        self.gateway_port = int(gateway_port)
+        self.workers = int(workers)
+        self.max_queue = int(max_queue)
+        self.rate = rate
+        self.burst = burst
+        self.max_retries = int(max_retries)
+        self.request_timeout = float(request_timeout)
+        self.start_timeout = float(start_timeout)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.report_interval = report_interval
+        self.quiet = quiet
+        self.log = get_logger("tfmesos_tpu.fleet", quiet=quiet)
+
+        # An explicit token lets external clients authenticate (tfserve
+        # resolves one from the standard TPUMESOS_TOKEN/_FILE contract);
+        # by default each bring-up mints its own.
+        self._token = token
+        self.token: Optional[str] = None
+        self.metrics: Optional[FleetMetrics] = None
+        self.registry: Optional[ReplicaRegistry] = None
+        self.router: Optional[Router] = None
+        self.admission: Optional[AdmissionController] = None
+        self.gateway: Optional[Gateway] = None
+        self.scheduler: Optional[TPUMesosScheduler] = None
+        self._started = False
+
+    # -- bring-up ----------------------------------------------------------
+
+    def _replica_cmd(self) -> str:
+        parts = [sys.executable, "-m", "tfmesos_tpu.fleet.replica",
+                 "--registry", self.registry.addr,
+                 "--rows", str(self.rows),
+                 "--seed", str(self.seed),
+                 "--heartbeat-interval", str(self.heartbeat_interval)]
+        if self.tiny:
+            parts.append("--tiny")
+        if self.max_len is not None:
+            parts += ["--max-len", str(self.max_len)]
+        if self.page_size is not None:
+            parts += ["--page-size", str(self.page_size)]
+        if self.prefill_bucket is not None:
+            parts += ["--prefill-bucket", str(self.prefill_bucket)]
+        if self.multi_step != 1:
+            parts += ["--multi-step", str(self.multi_step)]
+        return " ".join(parts)
+
+    def start(self) -> "FleetServer":
+        self.token = self._token or wire.new_token()
+        self.metrics = FleetMetrics()
+        try:
+            # Liveness thresholds scale with the heartbeat cadence: a
+            # slower (perfectly legal) interval must not make healthy
+            # replicas flap alive -> draining between beats.
+            hb = self.heartbeat_interval
+            self.registry = ReplicaRegistry(
+                token=self.token, metrics=self.metrics,
+                suspect_after=max(1.5, 5.0 * hb),
+                dead_after=max(3.0, 10.0 * hb),
+                evict_after=max(10.0, 20.0 * hb)).start()
+            self.router = Router(self.registry, self.metrics,
+                                 token=self.token,
+                                 max_retries=self.max_retries,
+                                 request_timeout=self.request_timeout)
+            self.admission = AdmissionController(max_queue=self.max_queue,
+                                                 rate=self.rate,
+                                                 burst=self.burst)
+            self.gateway = Gateway(self.router, self.admission,
+                                   self.metrics, token=self.token,
+                                   host=self.gateway_host,
+                                   port=self.gateway_port,
+                                   workers=self.workers).start()
+            job = Job(name="replica", num=self.replicas,
+                      cpus=self.replica_cpus, mem=self.replica_mem,
+                      chips=self.replica_chips, cmd=self._replica_cmd())
+            self.scheduler = TPUMesosScheduler(
+                [job], backend=self.backend, master=self.master,
+                quiet=self.quiet, start_timeout=self.start_timeout,
+                token=self.token)
+            self.scheduler.start()
+            self._wait_replicas()
+        except Exception:
+            self.stop()
+            raise
+        self._started = True
+        if self.report_interval:
+            self.metrics.start_reporter(self.log, self.report_interval)
+        self.log.info("fleet up: gateway %s, %d replica(s)", self.addr,
+                      self.replicas)
+        return self
+
+    def _wait_replicas(self) -> None:
+        import time
+
+        deadline = time.monotonic() + self.start_timeout
+        while time.monotonic() < deadline:
+            if len(self.registry.alive()) >= self.replicas:
+                return
+            # finished() raises ClusterError if a replica task already
+            # died fatally — surface that instead of idling to timeout.
+            self.scheduler.finished()
+            time.sleep(0.1)
+        raise ClusterError(
+            f"only {len(self.registry.alive())}/{self.replicas} replicas "
+            f"heartbeating after {self.start_timeout:.0f}s")
+
+    # -- surface -----------------------------------------------------------
+
+    @property
+    def addr(self) -> Optional[str]:
+        return self.gateway.addr if self.gateway is not None else None
+
+    def client(self, timeout: float = 120.0) -> FleetClient:
+        return FleetClient(self.addr, self.token, timeout=timeout)
+
+    def snapshot(self) -> dict:
+        return self.metrics.snapshot() if self.metrics is not None else {}
+
+    # -- teardown ----------------------------------------------------------
+
+    def stop(self) -> None:
+        self._started = False
+        if self.metrics is not None:
+            self.metrics.stop_reporter()
+        if self.gateway is not None:
+            self.gateway.stop()
+            self.gateway = None
+        if self.scheduler is not None:
+            self.scheduler.stop()
+            self.scheduler = None
+        if self.registry is not None:
+            self.registry.stop()
+            self.registry = None
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

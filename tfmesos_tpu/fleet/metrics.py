@@ -1,0 +1,174 @@
+"""Fleet observability: thread-safe counters, latency histograms, gauges.
+
+One ``FleetMetrics`` instance is shared by the gateway, router, and
+admission controller; everything it exports is a plain-JSON
+``snapshot()`` (served over the wire by the gateway's ``metrics`` op and
+recorded by ``bench.py`` as the ``fleet_*`` metrics) plus an optional
+periodic one-line log report.  No external metrics dependency — the
+control plane stays stdlib-only, like the rest of the framework.
+
+Consistency contract (asserted by the end-to-end tests): after the
+gateway drains, ``received == admitted + shed_queue + shed_rate_limited``
+and ``admitted == completed + failed``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["Histogram", "FleetMetrics"]
+
+# Bucket upper bounds in milliseconds — wide enough for CPU dev replicas
+# (seconds) and TPU serving (single-digit ms) alike.
+DEFAULT_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                      500.0, 1000.0, 2000.0, 5000.0, 10000.0, 30000.0,
+                      60000.0, float("inf"))
+
+
+class Histogram:
+    """Fixed-bucket latency histogram; percentiles report the upper edge
+    of the bucket the rank falls in (the standard Prometheus-style
+    estimate — cheap, monotone, and honest about its resolution)."""
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS_MS):
+        self.buckets = tuple(buckets)
+        self._counts = [0] * len(self.buckets)
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v > self._max:
+                self._max = v
+            for i, edge in enumerate(self.buckets):
+                if v <= edge:
+                    self._counts[i] += 1
+                    break
+
+    def _percentile(self, p: float) -> float:
+        rank = p * self._count
+        seen = 0
+        for i, edge in enumerate(self.buckets):
+            seen += self._counts[i]
+            if seen >= rank:
+                return edge if edge != float("inf") else self._max
+        return self._max
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            if not self._count:
+                return {"count": 0}
+            return {
+                "count": self._count,
+                "mean": round(self._sum / self._count, 3),
+                "p50": self._percentile(0.50),
+                "p90": self._percentile(0.90),
+                "p99": self._percentile(0.99),
+                "max": round(self._max, 3),
+            }
+
+
+class FleetMetrics:
+    """Named counters + histograms + pull-style gauges with one JSON
+    ``snapshot()`` and an optional periodic log line."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._reporter: Optional[threading.Thread] = None
+        self._reporter_stop = threading.Event()
+
+    # -- counters ----------------------------------------------------------
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    # -- histograms --------------------------------------------------------
+
+    def observe(self, name: str, value) -> None:
+        """Record one latency sample; non-numeric values are dropped (a
+        replica may omit a timing field rather than lie about it)."""
+        if not isinstance(value, (int, float)):
+            return
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = Histogram()
+        hist.observe(value)
+
+    # -- gauges ------------------------------------------------------------
+
+    def register_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        """``fn`` is sampled at snapshot time (queue depth, replicas
+        alive, ...); it must be cheap and never raise."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            counters = dict(self._counters)
+            hists = dict(self._hists)
+            gauges = dict(self._gauges)
+        out = {
+            "counters": counters,
+            "gauges": {},
+            "histograms": {name: h.snapshot() for name, h in hists.items()},
+        }
+        for name, fn in gauges.items():
+            try:
+                out["gauges"][name] = fn()
+            except Exception:  # pragma: no cover - gauge must not break export
+                out["gauges"][name] = None
+        return out
+
+    def report_line(self) -> str:
+        """One log-friendly line: every counter and gauge, plus the
+        headline latency numbers."""
+        snap = self.snapshot()
+        parts: List[str] = []
+        for name in sorted(snap["counters"]):
+            parts.append(f"{name}={snap['counters'][name]}")
+        for name in sorted(snap["gauges"]):
+            parts.append(f"{name}={snap['gauges'][name]}")
+        for name in sorted(snap["histograms"]):
+            h = snap["histograms"][name]
+            if h.get("count"):
+                parts.append(f"{name}_p50={h['p50']}")
+        return "fleet: " + " ".join(parts)
+
+    def start_reporter(self, log, interval: float = 10.0) -> None:
+        """Log ``report_line()`` every ``interval`` seconds until
+        :meth:`stop_reporter` (daemon thread; idempotent)."""
+        if self._reporter is not None:
+            return
+        self._reporter_stop.clear()
+
+        def loop() -> None:
+            while not self._reporter_stop.wait(interval):
+                log.info("%s", self.report_line())
+
+        self._reporter = threading.Thread(target=loop, name="fleet-metrics",
+                                          daemon=True)
+        self._reporter.start()
+
+    def stop_reporter(self) -> None:
+        if self._reporter is None:
+            return
+        self._reporter_stop.set()
+        self._reporter.join(timeout=2.0)
+        self._reporter = None

@@ -1,0 +1,233 @@
+"""Replica registry: who is serving, how loaded, and are they alive.
+
+Replicas dial the registry and stream heartbeats over the authenticated
+wire protocol (the same HMAC framing the rendezvous uses — an
+unauthenticated process cannot register itself into the serving path).
+Liveness is graded, not boolean:
+
+* ``alive``    — heartbeating; eligible for new requests.
+* ``draining`` — heartbeats stale (or the replica announced a drain);
+  no NEW requests are routed, in-flight ones may still finish.
+* ``dead``     — hard heartbeat timeout, heartbeat-connection EOF (the
+  usual signal of process death, since the connection lives inside the
+  replica), or the router observed a connection failure.  Dead entries
+  are EVICTED from the table after a grace window.
+
+A dead/draining replica that heartbeats again is revived to alive —
+so a transient network blip (or an overeager router ``mark_dead``)
+self-heals instead of requiring operator action.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.utils.logging import get_logger
+
+__all__ = ["ALIVE", "DRAINING", "DEAD", "ReplicaInfo", "ReplicaRegistry"]
+
+ALIVE = "alive"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    """One serving replica as the registry sees it."""
+
+    addr: str               # host:port the replica serves requests on
+    capacity: int = 0       # concurrent rows it can decode
+    outstanding: int = 0    # its own in-flight count, self-reported
+    state: str = ALIVE
+    last_beat: float = 0.0  # monotonic time of the last heartbeat
+
+
+class ReplicaRegistry:
+    """Heartbeat listener + liveness sweeper over a replica table."""
+
+    def __init__(self, token: str = "", host: str = "127.0.0.1",
+                 suspect_after: float = 1.5, dead_after: float = 3.0,
+                 evict_after: float = 10.0, sweep_interval: float = 0.2,
+                 metrics=None):
+        self.token = token
+        self.host = host
+        self.suspect_after = float(suspect_after)
+        self.dead_after = float(dead_after)
+        self.evict_after = float(evict_after)
+        self.sweep_interval = float(sweep_interval)
+        self.metrics = metrics
+        self.log = get_logger("tfmesos_tpu.fleet.registry")
+        self.addr: Optional[str] = None
+        self._listen: Optional[socket.socket] = None
+        self._table: Dict[str, ReplicaInfo] = {}
+        self._conns: Dict[str, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicaRegistry":
+        self._listen = wire.bind_ephemeral(self.host)
+        advertise = None if self.host in ("0.0.0.0", "::") else self.host
+        self.addr = wire.sock_addr(self._listen, advertise_host=advertise)
+        self.log.info("replica registry listening on %s", self.addr)
+        t = threading.Thread(target=self._accept_loop,
+                             name="registry-accept", daemon=True)
+        t.start()
+        s = threading.Thread(target=self._sweep_loop,
+                             name="registry-sweep", daemon=True)
+        s.start()
+        self._threads = [t, s]
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listen is not None:
+            try:
+                self._listen.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # -- heartbeat intake --------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return      # listener closed
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             name="registry-conn", daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        framer = wire.Framer(self.token)
+        addr: Optional[str] = None
+        try:
+            for msg in wire.iter_msgs(conn, framer):
+                addr = self._on_msg(msg, conn) or addr
+        except wire.WireError as e:
+            self.log.warning("rejecting heartbeat connection: %s", e)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if addr is not None and not self._stop.is_set():
+                # The heartbeat connection lives INSIDE the replica
+                # process; its EOF is the earliest death signal we get —
+                # far ahead of the heartbeat timeout.  (A reconnecting
+                # replica re-registers through a new connection, which
+                # replaces this one in _conns first.)
+                with self._lock:
+                    stale = self._conns.get(addr) is conn
+                    if stale:
+                        del self._conns[addr]
+                if stale:
+                    self.mark_dead(addr, why="heartbeat connection closed")
+
+    def _on_msg(self, msg, conn: socket.socket) -> Optional[str]:
+        if not isinstance(msg, dict):
+            return None
+        addr = msg.get("addr")
+        op = msg.get("op")
+        if not addr or op not in ("hello", "heartbeat", "drain"):
+            self.log.warning("unexpected registry message: %r", msg)
+            return None
+        with self._lock:
+            rep = self._table.get(addr)
+            if op == "drain":
+                if rep is not None and rep.state == ALIVE:
+                    rep.state = DRAINING
+                    self.log.info("replica %s draining", addr)
+                return addr
+            if rep is None:
+                rep = self._table[addr] = ReplicaInfo(addr=addr)
+                self.log.info("replica %s registered", addr)
+            if rep.state != ALIVE:
+                self.log.info("replica %s revived (%s -> alive)",
+                              addr, rep.state)
+                rep.state = ALIVE
+            if "capacity" in msg:
+                rep.capacity = int(msg["capacity"])
+            if "outstanding" in msg:
+                rep.outstanding = int(msg["outstanding"])
+            rep.last_beat = time.monotonic()
+            self._conns[addr] = conn
+        return addr
+
+    # -- liveness sweeping -------------------------------------------------
+
+    def _sweep_loop(self) -> None:
+        while not self._stop.wait(self.sweep_interval):
+            now = time.monotonic()
+            with self._lock:
+                for addr, rep in list(self._table.items()):
+                    age = now - rep.last_beat
+                    if age > self.evict_after:
+                        del self._table[addr]
+                        self._conns.pop(addr, None)
+                        self.log.info("replica %s evicted (%s, last beat "
+                                      "%.1fs ago)", addr, rep.state, age)
+                    elif age > self.dead_after and rep.state != DEAD:
+                        rep.state = DEAD
+                        self.log.warning("replica %s dead (no heartbeat "
+                                         "for %.1fs)", addr, age)
+                        if self.metrics is not None:
+                            self.metrics.inc("replicas_died")
+                    elif age > self.suspect_after and rep.state == ALIVE:
+                        rep.state = DRAINING
+                        self.log.warning("replica %s draining (heartbeat "
+                                         "stale %.1fs)", addr, age)
+
+    # -- queries / writes --------------------------------------------------
+
+    def alive(self) -> List[ReplicaInfo]:
+        """Replicas eligible for NEW requests (copies, race-free)."""
+        with self._lock:
+            return [dataclasses.replace(r) for r in self._table.values()
+                    if r.state == ALIVE]
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [dataclasses.asdict(r) for r in self._table.values()]
+
+    def mark_dead(self, addr: str, why: str = "reported by router") -> None:
+        """Out-of-band death report (router connection failure).  The
+        next heartbeat revives the entry if the replica is in fact
+        fine."""
+        with self._lock:
+            rep = self._table.get(addr)
+            if rep is None or rep.state == DEAD:
+                return
+            rep.state = DEAD
+        self.log.warning("replica %s marked dead: %s", addr, why)
+        if self.metrics is not None:
+            self.metrics.inc("replicas_died")
+
+    def wait_for(self, n: int, timeout: float = 60.0) -> bool:
+        """Block until ``n`` replicas are alive (fleet bring-up)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.alive()) >= n:
+                return True
+            if self._stop.wait(0.05):
+                return False
+        return len(self.alive()) >= n

@@ -1,0 +1,406 @@
+"""A serving replica: one ``ContinuousBatcher`` behind a TCP server.
+
+This is the process the fleet launcher schedules N of as Mode-B tasks
+(``python -m tfmesos_tpu.fleet.replica --registry HOST:PORT ...``): it
+builds the model, starts the batcher's incremental serve loop on a
+dedicated thread, accepts multiplexed ``generate`` requests over the
+authenticated wire protocol, and streams each completion back on the
+connection it arrived on as soon as the batcher finishes it — requests
+from many gateway workers interleave into ONE continuous batch, which
+is the entire point of fronting the batcher with a fleet.
+
+The cluster token arrives through the standard task env contract
+(``TPUMESOS_TOKEN_FILE`` / ``TPUMESOS_TOKEN``, resolved by
+:func:`tfmesos_tpu.wire.load_token`), so only processes launched by our
+scheduler can join the serving path.
+
+Liveness: a heartbeat thread dials the registry and streams
+``{op: heartbeat, addr, capacity, outstanding}`` on a persistent
+connection; the connection dying IS the registry's earliest death
+signal.  On SIGTERM the replica announces a drain, stops accepting, and
+exits.
+
+:class:`ReplicaServer` itself is model-agnostic — it serves whatever
+``handler(msg, reply)`` it is given, which keeps the whole fleet
+machinery unit-testable without JAX (see ``tests/test_fleet.py``'s stub
+replicas).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import socket
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.utils.logging import get_logger
+
+__all__ = ["ReplicaServer", "BatcherServing", "tiny_model",
+           "flagship_model", "build_parser", "main"]
+
+
+class ReplicaServer:
+    """Threaded request server + registry heartbeater.
+
+    ``handler(msg, reply)`` serves one ``generate`` message; it may call
+    ``reply(dict)`` synchronously or later from another thread (the
+    batcher's completion loop).  ``reply`` is single-shot and maintains
+    the server's outstanding count.
+    """
+
+    def __init__(self, handler: Callable[[Dict[str, Any], Callable], None],
+                 token: str = "", capacity: int = 0,
+                 host: str = "127.0.0.1", port: int = 0,
+                 registry_addr: Optional[str] = None,
+                 heartbeat_interval: float = 0.3,
+                 advertise_host: Optional[str] = None):
+        self.handler = handler
+        self.token = token
+        self.capacity = int(capacity)
+        self.host = host
+        self.port = int(port)
+        self.registry_addr = registry_addr
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.advertise_host = advertise_host
+        self.log = get_logger("tfmesos_tpu.fleet.replica")
+        self.addr: Optional[str] = None
+        self._listen: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns: set = set()
+        self._outstanding = 0
+        self._olock = threading.Lock()
+
+    @property
+    def outstanding(self) -> int:
+        with self._olock:
+            return self._outstanding
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicaServer":
+        self._listen = wire.bind_ephemeral(self.host, port=self.port)
+        advertise = self.advertise_host or (
+            None if self.host in ("0.0.0.0", "::") else self.host)
+        self.addr = wire.sock_addr(self._listen, advertise_host=advertise)
+        self.log.info("replica serving on %s (capacity %d)", self.addr,
+                      self.capacity)
+        t = threading.Thread(target=self._accept_loop,
+                             name="replica-accept", daemon=True)
+        t.start()
+        self._threads = [t]
+        if self.registry_addr:
+            hb = threading.Thread(target=self._heartbeat_loop,
+                                  name="replica-heartbeat", daemon=True)
+            hb.start()
+            self._threads.append(hb)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listen is not None:
+            try:
+                self._listen.close()
+            except OSError:
+                pass
+        with self._olock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:  # unblock reader threads; peers see EOF
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # -- request serving ---------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return
+            with self._olock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="replica-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        framer = wire.Framer(self.token)
+        send_lock = threading.Lock()
+        try:
+            conn.settimeout(None)
+            for msg in wire.iter_msgs(conn, framer):
+                self._handle(conn, send_lock, msg)
+        except wire.WireError as e:
+            self.log.warning("rejecting connection: %s", e)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._olock:
+                self._conns.discard(conn)
+
+    def _send(self, conn: socket.socket, lock: threading.Lock,
+              msg: Dict[str, Any]) -> None:
+        try:
+            with lock:
+                wire.send_msg(conn, msg, self.token)
+        except OSError:
+            pass    # peer gone; its requests died with it
+
+    def _handle(self, conn: socket.socket, send_lock: threading.Lock,
+                msg: Any) -> None:
+        if not isinstance(msg, dict):
+            return
+        op = msg.get("op")
+        if op == "ping":
+            self._send(conn, send_lock, {"op": "pong", "id": msg.get("id")})
+            return
+        if op != "generate":
+            self._send(conn, send_lock,
+                       {"op": "error", "id": msg.get("id"),
+                        "kind": "bad_request",
+                        "error": f"unknown op {op!r}"})
+            return
+        with self._olock:
+            self._outstanding += 1
+        done = threading.Event()    # single-shot guard
+
+        def reply(out: Dict[str, Any]) -> None:
+            if done.is_set():
+                return
+            done.set()
+            with self._olock:
+                self._outstanding -= 1
+            self._send(conn, send_lock, out)
+
+        try:
+            self.handler(msg, reply)
+        except Exception as e:      # handler bug: fail THIS request only
+            self.log.exception("handler failed: %s", e)
+            reply({"op": "error", "id": msg.get("id"), "kind": "internal",
+                   "error": repr(e)})
+
+    # -- heartbeats --------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            sock = None
+            try:
+                sock = wire.connect(self.registry_addr, timeout=5.0)
+                wire.send_msg(sock, {"op": "hello", "addr": self.addr,
+                                     "capacity": self.capacity}, self.token)
+                while not self._stop.wait(self.heartbeat_interval):
+                    wire.send_msg(sock,
+                                  {"op": "heartbeat", "addr": self.addr,
+                                   "capacity": self.capacity,
+                                   "outstanding": self.outstanding},
+                                  self.token)
+                # Graceful exit: tell the registry we are draining so it
+                # stops routing to us before the process dies.
+                wire.send_msg(sock, {"op": "drain", "addr": self.addr},
+                              self.token)
+            except OSError as e:
+                self.log.warning("registry %s unreachable: %s; retrying",
+                                 self.registry_addr, e)
+                self._stop.wait(self.heartbeat_interval)
+            finally:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+
+class BatcherServing:
+    """Bridge from the request/reply surface to the batcher's
+    incremental submission API: ``submit()`` registers a completion
+    callback keyed by request identity, a dedicated thread drains
+    ``batcher.serve()`` and fires callbacks in finish order."""
+
+    def __init__(self, batcher):
+        self.batcher = batcher
+        self._callbacks: Dict[int, Callable] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "BatcherServing":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="batcher-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        try:
+            for comp in self.batcher.serve():
+                with self._lock:
+                    cb = self._callbacks.pop(id(comp.request), None)
+                if cb is not None:
+                    cb(comp, None)
+        except BaseException as e:  # loop died: fail every waiter loudly
+            with self._lock:
+                cbs = list(self._callbacks.values())
+                self._callbacks.clear()
+            for cb in cbs:
+                cb(None, f"batcher serve loop died: {e!r}")
+            raise
+
+    def submit(self, request, on_done: Callable) -> None:
+        """``on_done(completion, error)``: exactly one of the two is
+        set."""
+        with self._lock:
+            self._callbacks[id(request)] = on_done
+        self.batcher.submit(request)
+
+    def close(self) -> None:
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+
+def batcher_handler(serving: BatcherServing) -> Callable:
+    """The model-backed ``ReplicaServer`` handler: validate, submit,
+    stream the completion back when the batcher finishes it."""
+    import numpy as np
+
+    from tfmesos_tpu.serving import Request
+
+    batcher = serving.batcher
+
+    def handler(msg: Dict[str, Any], reply: Callable) -> None:
+        mid = msg.get("id")
+        try:
+            req = Request(
+                prompt=np.asarray(msg.get("prompt"), np.int32),
+                max_new_tokens=int(msg.get("max_new_tokens") or 0),
+                stop_token=msg.get("stop_token"))
+            # Reject un-servable requests NOW with an explicit error —
+            # run()'s own invalid-request path raises only after the
+            # stream drains, which would take the whole replica down.
+            batcher.validate(req)
+        except (TypeError, ValueError) as e:
+            reply({"op": "error", "id": mid, "kind": "bad_request",
+                   "error": str(e)})
+            return
+
+        def on_done(comp, err) -> None:
+            if comp is None:
+                reply({"op": "error", "id": mid, "kind": "internal",
+                       "error": err or "request dropped"})
+                return
+            reply({"op": "completion", "id": mid,
+                   "tokens": [int(t) for t in comp.tokens],
+                   "ttft_ms": round(comp.ttft_s * 1000.0, 3),
+                   "total_ms": round(comp.total_s * 1000.0, 3)})
+
+        serving.submit(req, on_done)
+
+    return handler
+
+
+# -- model presets ----------------------------------------------------------
+
+
+def tiny_model(seed: int = 0):
+    """The CI model: deterministic from ``seed``, so a test (or a peer
+    replica) can reproduce a replica's exact greedy outputs locally."""
+    import jax
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=97, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq_len=128, dtype=jnp.float32)
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
+
+
+def flagship_model(seed: int = 0, max_len: int = 1024):
+    """The flagship serving config (bench.py's 34M d512 transformer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+        max_seq_len=max_len, dtype=jnp.bfloat16)
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
+
+
+# -- process entry ----------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tfmesos_tpu.fleet.replica",
+        description="One fleet serving replica: a ContinuousBatcher "
+                    "behind an authenticated TCP server.")
+    p.add_argument("--registry", type=str, default=None,
+                   help="registry host:port to heartbeat (none = serve "
+                        "unregistered, for direct testing)")
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="listen port (0 = OS-assigned)")
+    p.add_argument("--rows", type=int, default=4,
+                   help="concurrent decode rows (= advertised capacity)")
+    p.add_argument("--max-len", type=int, default=None)
+    p.add_argument("--page-size", type=int, default=64)
+    p.add_argument("--prefill-bucket", type=int, default=64)
+    p.add_argument("--multi-step", type=int, default=1)
+    p.add_argument("--tiny", action="store_true",
+                   help="serve the tiny CI model instead of the flagship")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--heartbeat-interval", type=float, default=0.3)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    token = wire.load_token()
+    log = get_logger("tfmesos_tpu.fleet.replica")
+
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    if args.tiny:
+        cfg, params = tiny_model(args.seed)
+    else:
+        cfg, params = flagship_model(args.seed,
+                                     max_len=args.max_len or 1024)
+    batcher = ContinuousBatcher(
+        cfg, params, rows=args.rows, max_len=args.max_len,
+        page_size=args.page_size, prefill_bucket=args.prefill_bucket,
+        multi_step=args.multi_step)
+    serving = BatcherServing(batcher).start()
+    server = ReplicaServer(
+        batcher_handler(serving), token=token, capacity=args.rows,
+        host=args.host, port=args.port, registry_addr=args.registry,
+        heartbeat_interval=args.heartbeat_interval)
+    server.start()
+    print(f"replica serving on {server.addr}", flush=True)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame) -> None:
+        log.info("signal %d: draining", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    stop.wait()
+    server.stop()
+    serving.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

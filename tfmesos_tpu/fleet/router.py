@@ -1,0 +1,169 @@
+"""Load-aware request routing across the replica fleet.
+
+Replica choice is **least-outstanding-requests with power-of-two-choices
+sampling**: with many alive replicas, sampling two uniformly and taking
+the less-loaded one gets within a constant of full least-loaded routing
+at O(1) cost and — crucially — without the herd behavior of everyone
+chasing the single globally-least-loaded replica between load updates.
+The load signal is the router's OWN outstanding count per replica link
+(what we have in hand is exact and instantaneous; the registry's
+self-reported count lags a heartbeat).
+
+Failure handling is **bounded retry-with-backoff onto a DIFFERENT
+replica**: a connection failure (dial refused, mid-request EOF, bad
+frame) marks the replica dead in the registry, drops its link, and the
+request is retried elsewhere — safe for generation because replica
+outputs are deterministic functions of the request (greedy streams are
+bit-identical across replicas; the dead replica never delivered a
+completion, so nothing double-counts).  After ``max_retries`` failovers
+the request fails with :class:`RoutingError` and the gateway reports it
+to the client explicitly — never a silent hang.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Iterable, Optional
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.fleet.client import CallTimeout, ConnectionLost, MuxConnection
+from tfmesos_tpu.fleet.metrics import FleetMetrics
+from tfmesos_tpu.fleet.registry import ReplicaRegistry
+from tfmesos_tpu.utils.logging import get_logger
+
+__all__ = ["RoutingError", "Router"]
+
+
+class RoutingError(RuntimeError):
+    """No replica could serve the request within the retry budget."""
+
+
+class Router:
+    """Routes one request dict to one replica and returns its reply."""
+
+    def __init__(self, registry: ReplicaRegistry, metrics: FleetMetrics,
+                 token: str = "", max_retries: int = 2,
+                 backoff_s: float = 0.05, request_timeout: float = 120.0,
+                 connect_timeout: float = 10.0,
+                 rng: Optional[random.Random] = None):
+        self.registry = registry
+        self.metrics = metrics
+        self.token = token
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.request_timeout = float(request_timeout)
+        self.connect_timeout = float(connect_timeout)
+        self.log = get_logger("tfmesos_tpu.fleet.router")
+        self._rng = rng or random.Random()
+        self._links: Dict[str, MuxConnection] = {}
+        self._lock = threading.Lock()
+
+    # -- load signal -------------------------------------------------------
+
+    def outstanding(self, addr: str) -> int:
+        with self._lock:
+            link = self._links.get(addr)
+        return link.outstanding if link is not None and not link.closed else 0
+
+    # -- replica choice ----------------------------------------------------
+
+    def pick(self, exclude: Iterable[str] = ()) -> Optional[str]:
+        """Power-of-two-choices over alive replicas not in ``exclude``;
+        ``None`` when no eligible replica exists."""
+        exclude = set(exclude)
+        cands = [r.addr for r in self.registry.alive()
+                 if r.addr not in exclude]
+        if not cands:
+            return None
+        if len(cands) <= 2:
+            return min(cands, key=self.outstanding)
+        a, b = self._rng.sample(cands, 2)
+        return a if self.outstanding(a) <= self.outstanding(b) else b
+
+    # -- link management ---------------------------------------------------
+
+    def _link(self, addr: str) -> MuxConnection:
+        with self._lock:
+            link = self._links.get(addr)
+            if link is not None and not link.closed:
+                return link
+        # Dial OUTSIDE the lock: a black-holed endpoint blocks the dial
+        # for up to connect_timeout, and holding the router-wide lock
+        # through that would stall every worker's pick()/route() on the
+        # healthy replicas too.  A dial race just keeps the first link
+        # registered and closes the loser.
+        link = MuxConnection(addr, self.token,
+                             connect_timeout=self.connect_timeout)
+        with self._lock:
+            existing = self._links.get(addr)
+            if existing is not None and not existing.closed:
+                pass    # lost the race
+            else:
+                self._links[addr] = link
+                return link
+        link.close()
+        return existing
+
+    def _drop_link(self, addr: str) -> None:
+        with self._lock:
+            link = self._links.pop(addr, None)
+        if link is not None:
+            link.close()
+
+    # -- the routing loop --------------------------------------------------
+
+    def route(self, msg: Dict[str, Any]) -> Any:
+        """Send ``msg`` to a replica; on connection failure, retry on a
+        different one (up to ``max_retries`` failovers, exponential
+        backoff)."""
+        tried = set()
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            addr = self.pick(exclude=tried)
+            if addr is None:
+                break       # nothing (left) to try
+            try:
+                link = self._link(addr)
+                return link.call(msg, timeout=self.request_timeout)
+            except CallTimeout as e:
+                # The CONNECTION is still up (per CallTimeout's
+                # contract) — only this request is slow.  Retry it
+                # elsewhere, but do NOT collapse the shared link
+                # (that would abort every other in-flight request on
+                # this replica) and do NOT mark the replica dead.
+                # The eventual late reply finds its slot gone and is
+                # dropped; deterministic generation makes the
+                # duplicated work harmless.
+                last = e
+                tried.add(addr)
+                self.metrics.inc("retries")
+                self.log.warning("request timed out on %s after %.0fs; "
+                                 "retrying on another replica "
+                                 "(attempt %d/%d)", addr,
+                                 self.request_timeout, attempt + 1,
+                                 self.max_retries + 1)
+            except (ConnectionLost, OSError, wire.WireError) as e:
+                last = e
+                tried.add(addr)
+                self._drop_link(addr)
+                self.registry.mark_dead(
+                    addr, why=f"{type(e).__name__}: {e}")
+                self.metrics.inc("retries")
+                self.log.warning("replica %s failed (%s); retrying on "
+                                 "another replica (attempt %d/%d)", addr, e,
+                                 attempt + 1, self.max_retries + 1)
+                time.sleep(self.backoff_s * (2 ** attempt))
+        if last is not None:
+            raise RoutingError(
+                f"no replica could serve the request after trying "
+                f"{sorted(tried)}: {last}") from last
+        raise RoutingError("no alive replicas")
+
+    def close(self) -> None:
+        with self._lock:
+            links = list(self._links.values())
+            self._links.clear()
+        for link in links:
+            link.close()

@@ -69,7 +69,8 @@ class TPUMesosScheduler:
                  role: str = "*", mesh_axes: Optional[Dict[str, int]] = None,
                  gang_scheduling: bool = False,
                  start_timeout: float = 300.0,
-                 token_transport: Optional[str] = None):
+                 token_transport: Optional[str] = None,
+                 token: Optional[str] = None):
         self.task_spec = task_spec
         self.master = master or os.environ.get("MESOS_MASTER")
         # Default framework name mirrors scheduler.py:189-190.
@@ -88,7 +89,10 @@ class TPUMesosScheduler:
         self.env = dict(env or {})
 
         self.log = get_logger("tfmesos_tpu.scheduler", quiet=quiet)
-        self.token = wire.new_token()
+        # One token per bring-up by default; an explicit ``token`` lets
+        # co-resident control-plane services (the fleet's registry and
+        # gateway) share a single cluster secret with the tasks.
+        self.token = token or wire.new_token()
 
         # Expand Jobs into the task table (reference: scheduler.py:201-217).
         # Creation order — jobs in declared order, indices ascending — IS the

@@ -33,6 +33,8 @@ construction.
 from __future__ import annotations
 
 import dataclasses
+import queue as _queue
+import threading
 import time
 from collections import deque
 from functools import partial
@@ -49,7 +51,66 @@ from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
                                             init_paged_cache,
                                             rejection_accept, sample_logits)
 
-__all__ = ["Request", "Completion", "ContinuousBatcher"]
+__all__ = ["Request", "Completion", "ContinuousBatcher",
+           "SubmissionQueue"]
+
+# SubmissionQueue.poll's end-of-stream marker (distinct from None, which
+# means "nothing available right now, more may come").
+_CLOSED = object()
+
+
+class SubmissionQueue:
+    """Thread-safe incremental :class:`Request` source for
+    :meth:`ContinuousBatcher.run` — the online front door's adapter
+    around the loop's internal ``pull()``.
+
+    Any thread may :meth:`submit` at any time; :meth:`close` marks the
+    end of the stream (submissions after it raise).  The run loop polls
+    NON-blocking while rows are decoding — an empty queue never stalls
+    in-flight requests the way a blocking iterable would — and blocks
+    only when the batcher is otherwise idle.
+    """
+
+    def __init__(self) -> None:
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._closed = False
+        self._lock = threading.Lock()
+
+    def submit(self, request: "Request") -> None:
+        if not isinstance(request, Request):
+            raise TypeError(f"submit() takes a Request, got "
+                            f"{type(request).__name__}")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("submission queue is closed")
+            self._q.put(request)
+
+    def close(self) -> None:
+        """End the stream: the serve loop drains what was submitted and
+        returns.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(_CLOSED)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def poll(self, block: bool):
+        """Next request; ``None`` when empty (and more may come), the
+        ``_CLOSED`` sentinel at end of stream.  ``block=True`` waits for
+        one of the two."""
+        try:
+            item = self._q.get(block=block)
+        except _queue.Empty:
+            return None
+        if item is _CLOSED:
+            self._q.put(_CLOSED)    # keep re-polls (and peers) terminal
+            return _CLOSED
+        return item
 
 
 @dataclasses.dataclass
@@ -551,6 +612,10 @@ class ContinuousBatcher:
             self._spec_round = self._make_spec_round()
             self._draft_chunk = self._make_draft_chunk()
         self._next_rid = 0
+        # Incremental submission (see submit()/serve()); lazily built so
+        # plain run(iterable) batchers never pay for it.
+        self._submissions: Optional[SubmissionQueue] = None
+        self._submissions_lock = threading.Lock()
         # Speculative observability (see acceptance_rate).
         self.spec_rounds = 0        # jitted rounds executed
         self.spec_row_rounds = 0    # row-rounds (rows decoding per round)
@@ -1069,31 +1134,84 @@ class ContinuousBatcher:
                 f"n_pages")
         return None
 
+    # -- incremental (online) submission ----------------------------------
+
+    def validate(self, req: Request) -> None:
+        """Raise ``ValueError`` if ``req`` can never be served by this
+        batcher (prefix + padded prompt + new tokens exceed max_len).
+        Online front doors call this at ingress so an un-servable
+        request is rejected immediately instead of via run()'s
+        drain-then-raise path."""
+        self._worst_pages(req)
+
+    def _submission_source(self) -> SubmissionQueue:
+        with self._submissions_lock:
+            if self._submissions is None:
+                self._submissions = SubmissionQueue()
+            return self._submissions
+
+    def submit(self, request: Request) -> None:
+        """Thread-safe online admission: queue ``request`` for the
+        :meth:`serve` loop.  May be called from any thread, before or
+        while serve() runs; raises after :meth:`close`."""
+        self._submission_source().submit(request)
+
+    def close(self) -> None:
+        """End the online stream: serve() drains everything submitted
+        and returns (or, called before serve(), makes it return
+        immediately).  Idempotent."""
+        self._submission_source().close()
+
+    def serve(self) -> Iterator[Completion]:
+        """:meth:`run` over the incremental submission queue: yields
+        Completions in finish order as submit()ted requests finish,
+        decoding continuously while the queue is empty, blocking only
+        when fully idle, and returning once :meth:`close` is called and
+        the stream drains.  One serve() loop per batcher."""
+        return self.run(self._submission_source())
+
     # -- the loop ---------------------------------------------------------
 
     def run(self, requests: Iterable[Request]) -> Iterator[Completion]:
         """Serve ``requests`` (any iterable — a generator staggers
-        arrivals naturally), yielding :class:`Completion`\\ s in FINISH
-        order.  Pulls from the iterable lazily: a request is consumed
-        only when a row and pages are available for it.  Abandoning the
+        arrivals naturally — or a :class:`SubmissionQueue` for online
+        thread-safe submission, see :meth:`serve`), yielding
+        :class:`Completion`\\ s in FINISH order.  Pulls from the
+        iterable lazily: a request is consumed only when a row and
+        pages are available for it.  Abandoning the
         iterator early releases every in-flight row's pages.  An invalid
         request (longer than ``max_len`` allows) raises — but only AFTER
         every already-admitted request has drained and yielded, so one
         malformed arrival never discards valid in-flight work."""
-        source = iter(requests)
+        incremental = isinstance(requests, SubmissionQueue)
+        source = None if incremental else iter(requests)
         pending: deque = deque()
         active: Dict[int, _Row] = {}
         free_rows = list(range(self.rows))
         exhausted = False
         bad_request: Optional[Exception] = None
 
-        def pull():
+        def pull(block=True):
+            # ``block`` only matters for a SubmissionQueue source: the
+            # admission loop polls non-blocking so an empty online queue
+            # never stalls rows that are mid-decode, while the idle
+            # branch blocks (there is nothing else to do).  Iterables
+            # keep their original semantics — next() blocks when the
+            # generator does.
             nonlocal exhausted
-            if not pending and not exhausted:
-                try:
-                    pending.append(next(source))
-                except StopIteration:
+            if pending or exhausted:
+                return
+            if incremental:
+                item = requests.poll(block)
+                if item is _CLOSED:
                     exhausted = True
+                elif item is not None:
+                    pending.append(item)
+                return
+            try:
+                pending.append(next(source))
+            except StopIteration:
+                exhausted = True
 
         try:
             while True:
@@ -1105,15 +1223,17 @@ class ContinuousBatcher:
                 # the dominant per-call cost on remote-attached hosts).
                 burst = []
                 while free_rows and bad_request is None:
-                    if not pending and not exhausted and burst:
+                    if not pending and not exhausted and burst \
+                            and not incremental:
                         # pull() may BLOCK in next(source) (a staggered
                         # stream): settle the in-flight admissions first
                         # so their first tokens (and any instant
                         # completions) are not held hostage to the next
                         # arrival — this also keeps t_first honest.
+                        # (A SubmissionQueue source never blocks here.)
                         yield from self._finalize_burst(burst, active,
                                                         free_rows)
-                    pull()
+                    pull(block=False)
                     if not pending:
                         break
                     try:

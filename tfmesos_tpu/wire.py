@@ -134,6 +134,19 @@ class Framer:
         return out
 
 
+def iter_msgs(sock: socket.socket, framer: "Framer"):
+    """Decoded messages from a blocking socket, until EOF ends the
+    generator — the shared read loop of every long-lived control
+    connection (fleet gateway/replica/registry/mux client).  A bad
+    frame raises :class:`WireError`; socket errors propagate."""
+    while True:
+        data = sock.recv(65536)
+        if not data:
+            return
+        for msg in framer.feed(data):
+            yield msg
+
+
 def connect(addr: str, timeout: Optional[float] = 30.0) -> socket.socket:
     """Dial a ``host:port`` string (the form used throughout the control plane)."""
     host, port = addr.rsplit(":", 1)
@@ -142,12 +155,13 @@ def connect(addr: str, timeout: Optional[float] = 30.0) -> socket.socket:
     return sock
 
 
-def bind_ephemeral(host: str = "0.0.0.0") -> socket.socket:
+def bind_ephemeral(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
     """Bind a listening socket on an OS-assigned port (reference pattern at
-    scheduler.py:325-328 / server.py:18-21)."""
+    scheduler.py:325-328 / server.py:18-21).  ``port`` pins a specific
+    port instead (the fleet gateway's stable front-door address)."""
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    sock.bind((host, 0))
+    sock.bind((host, port))
     sock.listen(128)
     return sock
 
