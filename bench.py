@@ -584,6 +584,124 @@ def bench_serving_continuous(n_requests=32, rows=8, tiny=False):
             multistep_overlap_rps)
 
 
+def bench_serving_prefix_cache(n_requests=16, rows=4, tiny=False):
+    """Cross-request prefix caching on a shared-system-prompt workload
+    (the dominant online pattern: one system/few-shot prompt, distinct
+    user tails): mean TTFT with the prefix WARM in the cache vs COLD
+    full prefill, plus warm throughput and the observed hit rate.  The
+    correctness bar rides along: warm completions must EQUAL the
+    cold-prefill completions."""
+    from tfmesos_tpu.serving import ContinuousBatcher, Request
+
+    if tiny:
+        cfg, params, _, max_len, _ = _serving_bench_setup(True)
+        page, sys_len, tail_len, new = 16, 40, 8, 4
+    else:
+        cfg, params, _, max_len, _ = _serving_bench_setup(False)
+        page, sys_len, tail_len, new = 64, 448, 64, 32
+    rng = np.random.default_rng(7)
+    system = rng.integers(0, cfg.vocab_size, size=(sys_len,)).astype(np.int32)
+
+    def reqs(n, seed=1):
+        r2 = np.random.default_rng(seed)
+        return [Request(prompt=np.concatenate(
+                    [system, r2.integers(0, cfg.vocab_size,
+                                         size=(tail_len,)).astype(np.int32)]),
+                    max_new_tokens=new)
+                for _ in range(n)]
+
+    cold = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len,
+                             page_size=page, prefill_bucket=page)
+    list(cold.run(reqs(2, seed=99)))    # warm the compiles only
+    cold_done = sorted((c.rid, c) for c in cold.run(reqs(n_requests)))
+    cold_ttft = 1000.0 * sum(c.ttft_s for _, c in cold_done) / n_requests
+
+    warm = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len,
+                             page_size=page, prefill_bucket=page,
+                             prefix_cache_pages=4 * (sys_len // page + 2))
+    # Prime: compiles AND publishes the system prefix into the cache —
+    # with a DISTINCT tail seed, so the measured stream hits only on
+    # the shared system pages (a same-seed prime would make request 0
+    # a byte-identical full-prompt hit and flatter the warm TTFT).
+    list(warm.run(reqs(2, seed=99)))
+    list(warm.run(reqs(1, seed=98)))
+    t0 = time.perf_counter()
+    warm_done = sorted((c.rid, c) for c in warm.run(reqs(n_requests)))
+    dt = time.perf_counter() - t0
+    warm_ttft = 1000.0 * sum(c.ttft_s for _, c in warm_done) / n_requests
+    assert [c.tokens for _, c in warm_done] == \
+        [c.tokens for _, c in cold_done], \
+        "prefix-cached completions diverged from cold prefill"
+    st = warm.prefix_cache_stats()
+    hit_rate = st["hits"] / max(1, st["hits"] + st["misses"])
+    return warm_ttft, cold_ttft, n_requests / dt, hit_rate
+
+
+def bench_fleet_prefix_affinity(n_requests=24, replicas=2, rows=4,
+                                n_prefixes=2, max_new_tokens=6,
+                                workers=8):
+    """Prefix-affinity routing through the full fleet front door:
+    replicas run cross-request prefix caches and advertise them on
+    heartbeats; the gateway steers each shared system prompt to the
+    replica already holding it.  Reports the affinity hit rate (routing
+    decisions that found a cached favorite) and warm requests/s."""
+    import threading
+
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    rng = np.random.default_rng(3)
+    page = 16
+    systems = [rng.integers(0, 97, size=(2 * page,)).astype(np.int32)
+               for _ in range(n_prefixes)]
+    fleet = FleetServer(replicas=replicas, rows=rows, tiny=True,
+                        max_len=64, page_size=page, prefill_bucket=page,
+                        prefix_cache_pages=32, workers=workers,
+                        max_queue=max(64, 2 * n_requests),
+                        start_timeout=300.0)
+    fleet.start()
+    try:
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+
+        def run_batch(prompts):
+            results = [None] * len(prompts)
+
+            def one(i):
+                results[i] = client.generate(prompts[i], max_new_tokens)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return results
+
+        def prompts(n, seed):
+            r2 = np.random.default_rng(seed)
+            return [np.concatenate(
+                        [systems[i % n_prefixes],
+                         r2.integers(0, 97, size=(4,)).astype(np.int32)])
+                    for i in range(n)]
+
+        # Prime: compiles + seeds every replica's cache, then give the
+        # heartbeats a beat to advertise the summaries.
+        run_batch(prompts(2 * replicas, seed=5))
+        time.sleep(3.0 * fleet.heartbeat_interval + 0.2)
+        t0 = time.perf_counter()
+        results = run_batch(prompts(n_requests, seed=6))
+        dt = time.perf_counter() - t0
+        assert all(r is not None for r in results)
+        snap = fleet.snapshot()["counters"]
+        hits = snap.get("affinity_hits", 0)
+        misses = snap.get("affinity_misses", 0)
+        hit_rate = hits / max(1, hits + misses)
+        client.close()
+        return hit_rate, n_requests / dt
+    finally:
+        fleet.stop()
+
+
 def bench_serving_longctx(n_requests=8, rows=4, max_len=8192,
                           plen=512, new=128, tiny=False):
     """Continuous batching at LONG context — the regime the kernel-native
@@ -1010,6 +1128,17 @@ def main():
         out["serving_multistep_overlap_requests_per_sec"] = round(
             mso_rps, 2)
         flush_partial()
+    psv = attempts(bench_serving_prefix_cache,
+                   "prefix-cache serving bench", n=1)
+    if psv:
+        # Shared-system-prompt workload: warm (prefix cached) vs cold
+        # TTFT, with warm completions asserted equal to cold prefill.
+        warm_ttft, cold_ttft, rps, hit_rate = psv[0]
+        out["serving_prefix_hit_ttft_ms"] = round(warm_ttft, 2)
+        out["serving_prefix_cold_ttft_ms"] = round(cold_ttft, 2)
+        out["serving_prefix_requests_per_sec"] = round(rps, 2)
+        out["serving_prefix_cache_hit_rate"] = round(hit_rate, 3)
+        flush_partial()
     lsv = attempts(bench_serving_longctx, "long-context serving bench",
                    n=1)
     if lsv:
@@ -1029,6 +1158,14 @@ def main():
         rps, ttft_ms = fl[0]
         out["fleet_requests_per_sec"] = round(rps, 2)
         out["fleet_mean_ttft_ms"] = round(ttft_ms, 2)
+        flush_partial()
+    fa = attempts(bench_fleet_prefix_affinity,
+                  "fleet prefix-affinity bench", n=1)
+    if fa:
+        # Shared prefixes steered to the replica already caching them.
+        hit_rate, rps = fa[0]
+        out["fleet_prefix_affinity_hit_rate"] = round(hit_rate, 3)
+        out["fleet_prefix_requests_per_sec"] = round(rps, 2)
         flush_partial()
     rw = attempts(bench_ring_window, "ring window bench", n=1)
     if rw and rw[0] is not None:    # >1 visible device: sp ring

@@ -57,6 +57,12 @@ def main():
                         "outputs identical to target-only serving; "
                         "sampling is rejection-corrected to the "
                         "target's exact distribution")
+    p.add_argument("--prefix-cache", type=int, default=0,
+                   metavar="PAGES", dest="prefix_cache",
+                   help="cross-request prefix cache budget in pool pages "
+                        "per shard (with --continuous; 0 disables): "
+                        "prompts sharing page-aligned leading chunks "
+                        "prefill only their uncached tails")
     p.add_argument("--prefill-chunk", type=int, default=None,
                    dest="prefill_chunk",
                    help="chunked prefill (with --continuous): write "
@@ -210,7 +216,8 @@ def main():
             draft_cfg=draft_cfg, draft_params=draft_params,
             n_draft=SPEC_N_DRAFT, mesh=mesh, overlap=args.overlap,
             draft_quantized_cache=args.int8_draft_kv,
-            multi_step=args.multi_step)
+            multi_step=args.multi_step,
+            prefix_cache_pages=args.prefix_cache)
         sink = open(args.out, "w") if args.out else sys.stdout
         served = 0
         t0 = time.perf_counter()
@@ -225,9 +232,13 @@ def main():
         rate = batcher.acceptance_rate
         spec_note = ("" if rate is None
                      else f", draft acceptance {rate:.0%}")
+        pst = batcher.prefix_cache_stats()
+        pfx_note = ("" if pst is None else
+                    f", prefix cache {pst['hits']}/{pst['hits'] + pst['misses']} hits "
+                    f"({pst['hit_tokens']} tokens reused)")
         print(f"served {served} prompts continuously in {dt:.2f}s "
               f"(peak pages {batcher.peak_pages_used}/{batcher.n_pages}"
-              f"{spec_note})", file=sys.stderr)
+              f"{spec_note}{pfx_note})", file=sys.stderr)
         return 0
 
     alloc = pool = None

@@ -148,3 +148,20 @@ def test_fleet_bench_smoke():
         n_requests=4, replicas=2, rows=2, tiny=True, workers=4)
     assert np.isfinite(rps) and rps > 0
     assert np.isfinite(ttft_ms) and ttft_ms > 0
+
+
+def test_serving_prefix_cache_bench_smoke():
+    """Warm-vs-cold shared-prefix protocol runs end to end at tiny size
+    and asserts warm == cold completions internally."""
+    warm_ttft, cold_ttft, rps, hit_rate = bench.bench_serving_prefix_cache(
+        n_requests=3, rows=2, tiny=True)
+    assert warm_ttft > 0 and cold_ttft > 0 and rps > 0
+    assert 0.0 < hit_rate <= 1.0
+
+
+@pytest.mark.slow
+def test_fleet_prefix_affinity_bench_smoke():
+    """Fleet prefix-affinity protocol over 2 local CPU replicas."""
+    hit_rate, rps = bench.bench_fleet_prefix_affinity(
+        n_requests=6, replicas=2, rows=2, workers=4)
+    assert 0.0 <= hit_rate <= 1.0 and rps > 0

@@ -346,6 +346,103 @@ def test_gateway_over_stub_replicas(stub_fleet):
         gw.stop()
 
 
+# -- prefix-affinity routing (stub replicas, no JAX) ------------------------
+
+
+def _summary_for(prompt, page=16):
+    """What a replica caching ``prompt``'s full chunks would advertise."""
+    from tfmesos_tpu import prefixhash
+
+    return {"page": page, "first": page, "seed": "",
+            "hashes": [d.hex()
+                       for d in prefixhash.prompt_digests(prompt, page)]}
+
+
+def test_replica_heartbeat_carries_prefix_summary(stub_fleet):
+    """ReplicaServer's extra_info rides every heartbeat and lands on
+    the registry's ReplicaInfo.prefix — the channel prefix-affinity
+    routing reads."""
+    token, reg, servers = stub_fleet
+    summ = _summary_for(list(range(32)))
+    server = ReplicaServer(lambda msg, reply: reply({}), token=token,
+                           capacity=4, registry_addr=reg.addr,
+                           heartbeat_interval=0.05,
+                           extra_info=lambda: {"prefix_cache": summ})
+    servers.append(server.start())
+    assert _wait(lambda: reg.alive()
+                 and reg.alive()[0].prefix == summ)
+    assert reg.alive()[0].capacity == 4
+
+
+def test_router_prefix_affinity_longest_match_and_fallback(stub_fleet):
+    """pick(prompt=...) prefers the replica advertising the longest
+    chunk-chain match, falls back to p2c when nothing matches, and
+    skips a saturated favorite instead of piling onto it."""
+    token, reg, servers = stub_fleet
+    prompt_a = list(range(100, 148))            # 3 chunks of 16
+    prompt_b = list(range(500, 532))            # disjoint prefix
+    # Replica "deep" caches all of prompt_a, "shallow" only 1 chunk.
+    deep = ReplicaServer(
+        lambda m, r: r({}), token=token, capacity=4,
+        registry_addr=reg.addr, heartbeat_interval=0.05,
+        extra_info=lambda: {"prefix_cache": _summary_for(prompt_a)})
+    shallow_summ = _summary_for(prompt_a[:16])
+    shallow = ReplicaServer(
+        lambda m, r: r({}), token=token, capacity=4,
+        registry_addr=reg.addr, heartbeat_interval=0.05,
+        extra_info=lambda: {"prefix_cache": shallow_summ})
+    servers.extend([deep.start(), shallow.start()])
+    assert _wait(lambda: len([r for r in reg.alive()
+                              if r.prefix is not None]) == 2)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    try:
+        for _ in range(6):      # deterministic, not a p2c coin flip
+            assert router.pick(prompt=prompt_a) == deep.addr
+        assert metrics.get("affinity_hits") == 6
+        # The shallow replica still wins prompts only IT has.
+        assert router.pick(prompt=prompt_a[:16]) in (deep.addr,
+                                                     shallow.addr)
+        # No replica caches prompt_b: p2c fallback, counted as a miss.
+        before = metrics.get("affinity_misses")
+        assert router.pick(prompt=prompt_b) in (deep.addr, shallow.addr)
+        assert metrics.get("affinity_misses") == before + 1
+        # Prompts shorter than one chunk can never match.
+        assert router.pick(prompt=prompt_a[:8]) in (deep.addr,
+                                                    shallow.addr)
+        # Saturated favorite: outstanding >= capacity diverts to p2c
+        # over the remaining replicas.
+        real_outstanding = router.outstanding
+        router.outstanding = (
+            lambda addr: 4 if addr == deep.addr else 0)
+        assert router.pick(prompt=prompt_a) == shallow.addr
+        router.outstanding = real_outstanding
+        # Excluded favorite (failed once): affinity respects exclude.
+        assert router.pick(exclude=[deep.addr],
+                           prompt=prompt_a) == shallow.addr
+    finally:
+        router.close()
+
+
+def test_router_affinity_ignores_malformed_summaries(stub_fleet):
+    token, reg, servers = stub_fleet
+    bad = ReplicaServer(
+        lambda m, r: r({}), token=token, capacity=2,
+        registry_addr=reg.addr, heartbeat_interval=0.05,
+        extra_info=lambda: {"prefix_cache": {"page": "x",
+                                             "hashes": ["zz"]}})
+    ok = ReplicaServer(lambda m, r: r({}), token=token, capacity=2,
+                       registry_addr=reg.addr, heartbeat_interval=0.05)
+    servers.extend([bad.start(), ok.start()])
+    assert _wait(lambda: len(reg.alive()) == 2)
+    router = Router(reg, FleetMetrics(), token=token)
+    try:
+        # Malformed advertisement must not break routing — p2c covers.
+        assert router.pick(prompt=list(range(32))) in (bad.addr, ok.addr)
+    finally:
+        router.close()
+
+
 # -- end to end: gateway + 2 LocalBackend-launched batcher replicas --------
 
 
@@ -356,11 +453,14 @@ E2E_ROWS = 4
 @pytest.fixture(scope="module")
 def fleet():
     """Gateway + registry + 2 tiny-model replicas launched as Mode-B
-    tasks through LocalBackend (CPU subprocesses)."""
+    tasks through LocalBackend (CPU subprocesses).  Replicas run the
+    cross-request prefix cache, so every exactness assertion in this
+    module also exercises warm-hit serving."""
     from tfmesos_tpu.fleet.launcher import FleetServer
 
     fs = FleetServer(replicas=N_E2E_REPLICAS, rows=E2E_ROWS, tiny=True,
                      max_len=64, page_size=16, prefill_bucket=16,
+                     prefix_cache_pages=16,
                      workers=8, max_queue=64, request_timeout=300.0,
                      start_timeout=240.0)
     fs.start()
@@ -481,6 +581,52 @@ def test_fleet_overload_sheds_explicitly(fleet, tiny_offline):
         client.close()
     finally:
         gw.stop()
+
+
+def test_fleet_prefix_affinity_end_to_end(fleet, tiny_offline):
+    """Acceptance: shared-system-prompt requests through the live fleet
+    (a) come back exactly equal to offline generation even when served
+    from WARM cached pages, (b) lead replicas to advertise their cache
+    summaries on heartbeats, and (c) get steered by prefix-affinity
+    routing (affinity_hits counts it)."""
+    cfg, offline = tiny_offline
+    rng = np.random.RandomState(11)
+    system = rng.randint(0, cfg.vocab_size, size=32).astype(np.int32)
+    prompts = [np.concatenate(
+                   [system, np.random.RandomState(40 + i).randint(
+                       0, cfg.vocab_size, size=4).astype(np.int32)])
+               for i in range(8)]
+    client = fleet.client(timeout=300.0)
+    # Prime: publishes the system prefix into some replica's cache...
+    first = client.generate(prompts[0], 6)
+    assert first["tokens"] == offline(prompts[0], 6)
+    # ... whose summary must reach the registry on a heartbeat.
+    assert _wait(lambda: any(
+        isinstance(r.prefix, dict) and r.prefix.get("hashes")
+        for r in fleet.registry.alive()), timeout=30.0), \
+        "no replica advertised a prefix-cache summary"
+    results = [None] * 8
+    errors = []
+
+    def one(i):
+        try:
+            results[i] = client.generate(prompts[i], 6)
+        except Exception as e:
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    assert not errors, errors
+    for i in range(8):
+        assert results[i]["tokens"] == offline(prompts[i], 6), \
+            f"warm request {i} diverged from offline generation"
+    c = fleet.snapshot()["counters"]
+    assert c.get("affinity_hits", 0) >= 1, \
+        "prefix-affinity routing never fired"
+    client.close()
 
 
 def test_fleet_replica_death_mid_stream_retries_on_survivor(

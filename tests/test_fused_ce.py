@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tfmesos_tpu.compat import shard_map
 from tfmesos_tpu.models import transformer
 from tfmesos_tpu.ops.layers import cross_entropy_loss, fused_linear_cross_entropy
 from tfmesos_tpu.parallel.mesh import build_mesh
@@ -267,7 +268,7 @@ def test_vocab_parallel_ce_inbody_matches_reference(z_loss):
         dx, dw = vjp(jnp.ones((), jnp.float32))
         return loss, dx, dw
 
-    loss, dx, dw = jax.shard_map(
+    loss, dx, dw = shard_map(
         local, mesh=mesh, in_specs=(P(), P(None, "tp"), P()),
         out_specs=(P(), P(), P(None, "tp")), check_vma=False)(x, w, labels)
     np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tfmesos_tpu.compat import shard_map
 from tfmesos_tpu.parallel import MeshSpec, build_mesh, mesh_from_jobs
 from tfmesos_tpu.parallel import collectives as col
 from tfmesos_tpu.parallel.pipeline import (pipeline_apply, stack_stage_params,
@@ -74,7 +75,7 @@ def test_collectives_roundtrip():
                 col.axis_index("dp").reshape(1, 1))
 
     x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
-    s, m, rolled, idx = jax.jit(jax.shard_map(
+    s, m, rolled, idx = jax.jit(shard_map(
         f, mesh=mesh, in_specs=P("dp"),
         out_specs=(P("dp"), P("dp"), P("dp"), P("dp")), check_vma=False))(x)
     np.testing.assert_allclose(s, np.full((8, 1), 28.0))

@@ -1,0 +1,74 @@
+"""Chunk-chain hashing (tfmesos_tpu/prefixhash.py): the jax-free
+contract shared by the serving prefix cache and the fleet router's
+prefix-affinity matcher.  The chain property — digest j commits to every
+token in chunks 0..j — is what makes a replica's advertised digest set
+sufficient for longest-prefix matching at the gateway."""
+
+import numpy as np
+import pytest
+
+from tfmesos_tpu.prefixhash import (chunk_digest, match_depth,
+                                    prompt_digests, token_bytes)
+
+
+def test_chain_commits_to_every_earlier_token():
+    a = np.arange(64, dtype=np.int32)
+    d1 = prompt_digests(a, 16)
+    assert len(d1) == 4
+    # Same leading chunks -> same leading digests; a one-token change in
+    # chunk 0 changes EVERY digest after it.
+    b = a.copy()
+    b[3] += 1
+    d2 = prompt_digests(b, 16)
+    assert all(x != y for x, y in zip(d1, d2))
+    # A change in chunk 2 leaves chunks 0-1 shared.
+    c = a.copy()
+    c[40] += 1
+    d3 = prompt_digests(c, 16)
+    assert d3[:2] == d1[:2] and d3[2] != d1[2] and d3[3] != d1[3]
+
+
+def test_partial_chunks_are_dropped():
+    a = np.arange(40, dtype=np.int32)
+    assert len(prompt_digests(a, 16)) == 2      # 40 = 2 full + 8 partial
+    assert len(prompt_digests(a[:15], 16)) == 0
+
+
+def test_first_chunk_width_and_seed_shift_the_grid():
+    """A constant prefix tail of ``off`` tokens narrows chunk 0 to
+    ``page - off`` and seeds the chain — the batcher and the gateway
+    must land on identical digests for the same effective stream."""
+    page = 16
+    tail = np.arange(1000, 1005, dtype=np.int32)        # off = 5
+    prompt = np.arange(64, dtype=np.int32)
+    seed = chunk_digest(b"", tail)
+    d = prompt_digests(prompt, page, first=page - 5, seed=seed)
+    # Manual chain: chunk 0 = tail + prompt[:11] worth of positions.
+    h = chunk_digest(seed, prompt[:11])
+    assert d[0] == h
+    assert d[1] == chunk_digest(h, prompt[11:27])
+    # Without the seed the chain is different from position 0.
+    assert prompt_digests(prompt, page, first=page - 5)[0] != d[0]
+
+
+def test_match_depth_longest_leading_run():
+    a = np.arange(64, dtype=np.int32)
+    d = prompt_digests(a, 16)
+    adv = {x.hex() for x in d[:3]}
+    assert match_depth(d, adv) == 3
+    assert match_depth(d, set()) == 0
+    assert match_depth(d, {d[1].hex()}) == 0    # no leading run
+    assert match_depth(d, [x.hex() for x in d]) == 4
+    assert match_depth(d, d[:2]) == 2           # raw bytes accepted too
+
+
+def test_token_bytes_canonical_across_dtypes():
+    assert token_bytes([1, 2, 3]) == token_bytes(
+        np.asarray([1, 2, 3], np.int64))
+    assert token_bytes(np.asarray([1, 2, 3], np.int32)[::-1][::-1]) == \
+        token_bytes([1, 2, 3])
+
+
+def test_bad_page_rejected():
+    with pytest.raises(ValueError):
+        prompt_digests([1, 2, 3], 0)

@@ -1002,3 +1002,357 @@ def test_submission_queue_type_checks(setup):
     with pytest.raises(RuntimeError):
         sq.submit(Request(prompt=np.asarray([1], np.int32),
                           max_new_tokens=1))
+
+
+# -- cross-request prefix caching (COW page sharing) ------------------------
+
+
+def _shared_prefix_reqs(cfg, n, sys_len=36, tail0=5, new=4, seed=21):
+    """A shared-system-prompt stream: one ``sys_len``-token system
+    prompt + distinct user tails of varying length."""
+    rng = np.random.RandomState(seed)
+    system = rng.randint(0, cfg.vocab_size, size=sys_len).astype(np.int32)
+    return [Request(prompt=np.concatenate(
+                [system, np.random.RandomState(seed + 1 + i).randint(
+                    0, cfg.vocab_size, size=tail0 + i).astype(np.int32)]),
+                max_new_tokens=new)
+            for i in range(n)]
+
+
+def _tokens_in_order(batcher, reqs):
+    return [t for _, t in sorted((c.rid, c.tokens)
+                                 for c in batcher.run(reqs))]
+
+
+def test_prefix_cache_exact_vs_cold(setup):
+    """Warm (prefix-cached) completions must EQUAL cold-prefill
+    completions — the exact-output-equivalence bar — and the pool
+    accounting must balance after the drain."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_bucket=16)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    assert warm.prefix_cache_active
+    want = _tokens_in_order(cold, _shared_prefix_reqs(cfg, 6))
+    got = _tokens_in_order(warm, _shared_prefix_reqs(cfg, 6))
+    assert got == want
+    st = warm.prefix_cache_stats()
+    # 36-token system prompt over 16-token pages: 2 full shared chunks;
+    # request 0 publishes them, 1..5 map them read-only.
+    assert st["hits"] == 5 and st["misses"] == 1
+    assert st["hit_pages"] == 10 and st["inserted"] >= 2
+    # A second stream hits on EVERY request (the pages stayed resident).
+    assert _tokens_in_order(warm, _shared_prefix_reqs(cfg, 6)) == want
+    st = warm.prefix_cache_stats()
+    assert st["hits"] == 11
+    # After the drain every reference is dropped: retained == cached,
+    # and free + cached + sink accounts for the whole pool.
+    assert st["retained_pages"] == st["cached_pages"]
+    assert len(warm.alloc.free) + st["cached_pages"] + 1 == warm.n_pages
+    assert warm.alloc.rows == {}
+
+
+def test_prefix_cache_cow_on_page_aligned_full_hit(setup):
+    """A page-aligned full-prompt hit must COW its deepest page (the
+    one-token logits rewrite would otherwise write shared state) and
+    stay exact."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_bucket=16)
+    prompt = np.random.RandomState(3).randint(
+        0, cfg.vocab_size, size=48).astype(np.int32)   # exactly 3 pages
+    mk = lambda: [Request(prompt=prompt, max_new_tokens=20)]
+    cold = ContinuousBatcher(cfg, params, **kw)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    want = _tokens_in_order(cold, mk())
+    assert _tokens_in_order(warm, mk()) == want     # miss, publishes
+    assert _tokens_in_order(warm, mk()) == want     # full hit -> COW
+    st = warm.prefix_cache_stats()
+    assert st["cow_copies"] == 1
+    assert st["hits"] == 1 and st["hit_tokens"] == 47
+
+
+def test_prefix_cache_eviction_under_pressure_never_deadlocks(setup):
+    """DISTINCT prompts past the pool's capacity: retained zero-ref
+    pages must be evicted on demand (admission headroom counts them as
+    free), so the stream completes instead of deadlocking, and outputs
+    stay exact."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    reqs = lambda: [Request(prompt=np.random.RandomState(50 + i).randint(
+                        0, cfg.vocab_size, size=33 + (i % 3)).astype(
+                            np.int32), max_new_tokens=4)
+                    for i in range(10)]
+    cold = ContinuousBatcher(cfg, params, **kw)
+    # Budget far past what the default pool can retain: eviction, not
+    # the budget, must be what keeps admission alive.
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=64, **kw)
+    want = _tokens_in_order(cold, reqs())
+    assert _tokens_in_order(warm, reqs()) == want
+    st = warm.prefix_cache_stats()
+    assert st["evicted"] > 0, "pool pressure must trigger LRU eviction"
+    assert len(warm.alloc.free) + st["cached_pages"] + 1 == warm.n_pages
+    # Pool pages the batcher thinks are USED (incl. resident cache)
+    # never exceeded the physical pool.
+    assert warm.peak_pages_used <= warm.n_pages
+
+
+def test_prefix_cache_budget_caps_residency(setup):
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=2, **kw)
+    reqs = [Request(prompt=np.random.RandomState(80 + i).randint(
+                0, cfg.vocab_size, size=36).astype(np.int32),
+                max_new_tokens=3)
+            for i in range(5)]
+    assert len(list(warm.run(reqs))) == 5
+    st = warm.prefix_cache_stats()
+    assert st["cached_pages"] <= 2
+    assert st["evicted"] + st["skipped"] > 0
+
+
+def test_prefix_cache_bypasses_are_explicit(setup, draft_setup):
+    """Speculative decoding and quantized pools don't share pages — but
+    the bypass must be DISCOVERABLE, and serving must stay correct."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    spec = ContinuousBatcher(cfg, params, draft_cfg=dcfg,
+                             draft_params=dparams, n_draft=2,
+                             prefix_cache_pages=8, **kw)
+    assert not spec.prefix_cache_active
+    assert spec.prefix_cache_bypass_reason == "speculative decoding"
+    assert spec.prefix_cache_stats() is None
+    q = ContinuousBatcher(cfg, params, quantized_cache=True,
+                          prefix_cache_pages=8, **kw)
+    assert not q.prefix_cache_active
+    assert q.prefix_cache_bypass_reason == "quantized kv cache"
+    # Bypassed batchers still serve the shared-prefix stream correctly.
+    reqs = _shared_prefix_reqs(cfg, 3, sys_len=20, new=3)
+    assert len(list(spec.run(reqs))) == 3
+
+
+def test_prefix_cache_with_chunked_prefill(setup):
+    """prefill_chunk mode: a hit skips straight to the uncached tail on
+    the chunk grid; outputs equal the cache-off chunked batcher's."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_chunk=16)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    want = _tokens_in_order(cold, _shared_prefix_reqs(cfg, 5))
+    assert _tokens_in_order(warm, _shared_prefix_reqs(cfg, 5)) == want
+    st = warm.prefix_cache_stats()
+    # Chunked publication waits for fill COMPLETION, so request 1 (in
+    # flight alongside request 0) can also miss: >= 3 hits of 5.
+    assert st["hits"] >= 3 and st["hit_pages"] >= 6
+    # The second stream hits on every request.
+    assert _tokens_in_order(warm, _shared_prefix_reqs(cfg, 5)) == want
+    assert warm.prefix_cache_stats()["hits"] >= st["hits"] + 5
+
+
+def test_prefix_cache_with_overlap_and_multistep(setup):
+    cfg, params = setup
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_bucket=16,
+              overlap=True, multi_step=2)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    want = _tokens_in_order(cold, _shared_prefix_reqs(cfg, 5, new=6))
+    assert _tokens_in_order(warm,
+                            _shared_prefix_reqs(cfg, 5, new=6)) == want
+    assert warm.prefix_cache_stats()["hits"] >= 4
+
+
+@pytest.mark.parametrize("prefix_len", [16, 11])
+def test_prefix_cache_composes_with_global_prefix(setup, prefix_len):
+    """The static batcher-level ``prefix`` and the dynamic prefix cache
+    stack: cacheable chunks start AFTER the prefix's full pages, the
+    chain is seeded with its partial tail, and outputs still equal the
+    cache-off batcher's."""
+    cfg, params = setup
+    rng = np.random.RandomState(17)
+    prefix = rng.randint(0, cfg.vocab_size,
+                         size=prefix_len).astype(np.int32)
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_bucket=16,
+              prefix=prefix)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    want = _tokens_in_order(cold, _shared_prefix_reqs(cfg, 5, sys_len=30))
+    assert _tokens_in_order(warm,
+                            _shared_prefix_reqs(cfg, 5, sys_len=30)) == want
+    assert warm.prefix_cache_stats()["hits"] >= 4
+    assert _tokens_in_order(warm,
+                            _shared_prefix_reqs(cfg, 5, sys_len=30)) == want
+
+
+def test_prefix_cache_refcounts_protect_inflight_pages(setup):
+    """While a hit row is mid-decode its mapped pages are referenced
+    and must survive allocation pressure from other admissions."""
+    cfg, params = setup
+    warm = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                             page_size=16, prefill_bucket=16,
+                             prefix_cache_pages=64)
+    # Interleave one long-running shared-prefix request with churning
+    # distinct prompts that force eviction; the shared rows' outputs
+    # must match the cache-off reference.
+    cold = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                             page_size=16, prefill_bucket=16)
+    rng = np.random.RandomState(4)
+    shared = _shared_prefix_reqs(cfg, 3, sys_len=32, new=12, seed=91)
+    churn = [Request(prompt=np.random.RandomState(200 + i).randint(
+                 0, cfg.vocab_size, size=34).astype(np.int32),
+                 max_new_tokens=2)
+             for i in range(6)]
+    mix = lambda: [shared[0], churn[0], shared[1], churn[1], churn[2],
+                   shared[2], churn[3], churn[4], churn[5]]
+    want = _tokens_in_order(cold, [dataclasses_replace_req(r)
+                                   for r in mix()])
+    got = _tokens_in_order(warm, [dataclasses_replace_req(r)
+                                  for r in mix()])
+    assert got == want
+
+
+def dataclasses_replace_req(r):
+    """Fresh Request (run() consumes requests once; rid-keyed results
+    need distinct objects per run)."""
+    return Request(prompt=r.prompt.copy(),
+                   max_new_tokens=r.max_new_tokens,
+                   stop_token=r.stop_token)
+
+
+def test_paged_side_tables_dirty_after_cow_remap():
+    """Regression (stale-device-table audit): every page-mapping
+    mutation — cached-prefix acquire, COW remap, release — must
+    invalidate the host master table, the device table, AND the masked
+    decode variants.  A stale device table after a COW remap silently
+    decodes against freed pages."""
+    import types
+
+    from tfmesos_tpu.prefixhash import prompt_digests
+    from tfmesos_tpu.serving import _PagedSide, _PrefixCache, _Row
+
+    side = _PagedSide(n_pages=8, page_size=4, rows=2, np_max=4)
+    pc = _PrefixCache(side, page_size=4, first=4, seed=b"", budget=8)
+    digs = prompt_digests(np.arange(8, dtype=np.int32), 4)
+    # Row 0 prefills two full pages and publishes them.
+    side.ensure(0, 8)
+    own0 = list(side.alloc.rows[0])
+    pc.insert_row(0, 0, digs, types.SimpleNamespace(worst_pages=4))
+    assert side.row_cached[0] == own0 and side.alloc.rows[0] == []
+    t_before = np.asarray(side.table())
+    assert list(t_before[1]) == [side.sink] * 4
+    # Row 1 maps the cached pages read-only: the DEVICE table must
+    # rebuild (row 1 now references row 0's published pages).
+    nodes = pc.match(0, digs)
+    assert [n.page for n in nodes] == own0
+    pc.acquire(1, nodes)
+    t_mapped = np.asarray(side.table())
+    assert list(t_mapped[1][:2]) == own0
+    # COW remap: drop the deepest cached page, back it with a fresh own
+    # page instead — the device table must show the OWN copy, and the
+    # masked decode-table variant must rebuild too.
+    masked_before = np.asarray(side.decode_table(
+        {0: None, 1: None}, {0: None}))       # row 1 masked to sink
+    cow = pc.unmap_last(1)
+    side.ensure(1, 8)
+    own1 = side.alloc.rows[1][0]
+    assert own1 != cow.page
+    pc.release_nodes(1, [cow])
+    t_cow = np.asarray(side.table())
+    assert list(t_cow[1][:2]) == [own0[0], own1]
+    masked_after = np.asarray(side.decode_table(
+        {0: None, 1: None}, {0: None}))
+    assert list(masked_after[1]) == [side.sink] * masked_after.shape[1]
+    assert masked_after.shape == masked_before.shape
+    # Release drops the references and invalidates again.
+    side.release(1)
+    assert list(np.asarray(side.table())[1]) == [side.sink] * 4
+    assert all(n.ref == 1 for n in nodes[:-1])  # row 0 still holds its refs
+
+
+@pytest.mark.parametrize("axes", [{"dp": 2}, {"dp": 2, "tp": 2}])
+def test_prefix_cache_with_mesh(mesh_setup, axes):
+    """Per-shard tries under a data x tp mesh: pages are shard-pinned,
+    so hits only count on the shard holding them — and admission
+    PREFERS that shard.  Outputs equal the single-device cache-off
+    batcher's."""
+    cfg, params, _, _ = mesh_setup
+    kw = dict(rows=4, max_len=96, page_size=16, prefill_bucket=16)
+    reqs = lambda: _shared_prefix_reqs(cfg, 6, sys_len=36, seed=61)
+    plain = ContinuousBatcher(cfg, params, **kw)
+    want = _tokens_in_order(plain, reqs())
+    warm = ContinuousBatcher(cfg, params, mesh=_mesh(axes),
+                             prefix_cache_pages=8, **kw)
+    assert warm.prefix_cache_active
+    got = _tokens_in_order(warm, reqs())
+    for i, (g, w) in enumerate(zip(got, want)):
+        _assert_tokens_match_modulo_ties(
+            cfg, params, None, reqs()[i].prompt, g, w)
+    st = warm.prefix_cache_stats()
+    assert st["hits"] >= 4, st
+    # Shard-affine admission: the system prompt's pages live on ONE
+    # shard (each trie is per shard, and hits steer admission there).
+    assert _tokens_in_order(warm, reqs()) == got
+    st2 = warm.prefix_cache_stats()
+    assert st2["hits"] >= st["hits"] + 5
+
+
+def test_prefix_cache_warm_admission_never_overcommits(setup):
+    """Regression (review): a warm plan's zero-ref cached pages were
+    counted BOTH as reclaimable headroom and as the plan's page saving
+    — double-counting that over-admitted and crashed the serve loop
+    with 'page pool exhausted' under pool pressure.  A distinct
+    pressure request racing a warm re-request must serve cleanly (or
+    wait), never crash."""
+    cfg, params = setup
+    warm = ContinuousBatcher(cfg, params, rows=2, max_len=80,
+                             page_size=16, prefill_bucket=16, n_pages=8,
+                             prefix_cache_pages=8)
+    cached_prompt = np.random.RandomState(5).randint(
+        0, cfg.vocab_size, size=49).astype(np.int32)
+    # Publish 3 pages (49 tokens -> 3 full chunks), leaving free=4.
+    first = list(warm.run([Request(prompt=cached_prompt,
+                                   max_new_tokens=4)]))
+    assert len(first) == 1
+    st = warm.prefix_cache_stats()
+    assert st["cached_pages"] == 3 and st["retained_pages"] == 3
+    # Pressure (distinct 60-token prompt, wt=5) + warm re-request
+    # (wt=5, save=3): with the double-count both admit into a 4-free
+    # pool and ensure() blows up mid-flight.
+    pressure = Request(prompt=np.random.RandomState(6).randint(
+        0, cfg.vocab_size, size=60).astype(np.int32), max_new_tokens=20)
+    rewarm = Request(prompt=cached_prompt.copy(), max_new_tokens=20)
+    done = list(warm.run([pressure, rewarm]))
+    assert len(done) == 2
+    cold = ContinuousBatcher(cfg, params, rows=2, max_len=80,
+                             page_size=16, prefill_bucket=16)
+    want = [c.tokens for _, c in
+            sorted((c.rid, c) for c in cold.run(
+                [Request(prompt=pressure.prompt.copy(),
+                         max_new_tokens=20),
+                 Request(prompt=cached_prompt.copy(),
+                         max_new_tokens=20)]))]
+    assert [c.tokens for _, c in sorted((c.rid, c) for c in done)] == want
+
+
+def test_prefix_cache_cow_falls_back_on_tight_pool(setup):
+    """Regression (review): a COW full hit needs one fresh page ON TOP
+    of referencing every cached page, which on a tight pool can exceed
+    headroom even though the same request fits cold — admission must
+    retry a SHALLOWER plan (down to cold) instead of raising 'page
+    pool exhausted' for a servable workload."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16,
+              n_pages=5)
+    prompt = np.random.RandomState(9).randint(
+        0, cfg.vocab_size, size=48).astype(np.int32)   # exactly 3 pages
+    mk = lambda: [Request(prompt=prompt.copy(), max_new_tokens=16)]
+    cold = ContinuousBatcher(cfg, params, **kw)
+    want = _tokens_in_order(cold, mk())
+    assert _tokens_in_order(cold, mk()) == want     # pool serves it cold
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    assert _tokens_in_order(warm, mk()) == want     # publishes 3 pages
+    # The full-hit COW plan (4 pages incl. the copy) cannot fit the
+    # 5-page pool; the shallower 2-page plan must serve it instead.
+    assert _tokens_in_order(warm, mk()) == want
+    st = warm.prefix_cache_stats()
+    assert st["cow_copies"] == 0 and st["hits"] == 1
+    assert st["hit_pages"] == 2     # trimmed from the full 3-page match

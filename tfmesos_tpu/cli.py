@@ -202,6 +202,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="gateway dispatcher threads")
     p.add_argument("--retries", type=int, default=2,
                    help="max failovers to a different replica per request")
+    p.add_argument("--prefix-cache", type=int, default=64,
+                   metavar="PAGES", dest="prefix_cache",
+                   help="per-replica cross-request prefix cache budget "
+                        "in KV pool pages per mesh data shard (0 "
+                        "disables); warm shared-system-prompt requests "
+                        "prefill only their uncached tail, and the "
+                        "gateway routes shared prefixes to the replica "
+                        "already holding them (prefix-affinity)")
     p.add_argument("--tiny", action="store_true",
                    help="serve the tiny CI model (dev/demo)")
     p.add_argument("--metrics-interval", type=float, default=10.0,
@@ -238,6 +246,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         gateway_host=args.gateway_host, gateway_port=args.gateway_port,
         workers=args.workers, max_queue=args.max_queue, rate=args.rate,
         burst=args.burst, max_retries=args.retries,
+        prefix_cache_pages=args.prefix_cache,
         report_interval=args.metrics_interval or None,
         quiet=not args.verbose, token=token)
     try:

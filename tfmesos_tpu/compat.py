@@ -1,0 +1,43 @@
+"""Version compatibility shims over the jax API surface.
+
+One import site per symbol: modules that need ``shard_map`` import it
+from here instead of feeling out the jax version themselves.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map`` (and its replication-check keyword was renamed
+``check_rep`` -> ``check_vma``) across the 0.4.x -> 0.6 line.  The
+codebase is written against the NEW surface (``jax.shard_map`` with
+``check_vma=``); on a 0.4.x jax this adapter maps the call onto the
+experimental entry point so every mesh/shard_map path traces instead of
+dying with ``AttributeError: module 'jax' has no attribute
+'shard_map'``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "axis_size"]
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax 0.4.x: experimental entry point, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:  # jax 0.4.x: axis sizes live on the core axis env (static ints)
+    def axis_size(axis):
+        if isinstance(axis, (tuple, list)):
+            n = 1
+            for a in axis:
+                n *= jax.core.axis_frame(a)
+            return n
+        return jax.core.axis_frame(axis)

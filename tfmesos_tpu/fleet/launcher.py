@@ -45,6 +45,7 @@ class FleetServer:
                  page_size: Optional[int] = None,
                  prefill_bucket: Optional[int] = None,
                  multi_step: int = 1,
+                 prefix_cache_pages: int = 0,
                  backend=None, master: Optional[str] = None,
                  replica_cpus: float = 1.0, replica_mem: float = 1024.0,
                  replica_chips: int = 0,
@@ -67,6 +68,7 @@ class FleetServer:
         self.page_size = page_size
         self.prefill_bucket = prefill_bucket
         self.multi_step = int(multi_step)
+        self.prefix_cache_pages = int(prefix_cache_pages)
         self.backend = backend
         self.master = master
         self.replica_cpus = float(replica_cpus)
@@ -117,6 +119,8 @@ class FleetServer:
             parts += ["--prefill-bucket", str(self.prefill_bucket)]
         if self.multi_step != 1:
             parts += ["--multi-step", str(self.multi_step)]
+        if self.prefix_cache_pages:
+            parts += ["--prefix-cache-pages", str(self.prefix_cache_pages)]
         return " ".join(parts)
 
     def start(self) -> "FleetServer":

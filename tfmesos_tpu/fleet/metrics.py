@@ -10,6 +10,11 @@ control plane stays stdlib-only, like the rest of the framework.
 Consistency contract (asserted by the end-to-end tests): after the
 gateway drains, ``received == admitted + shed_queue + shed_rate_limited``
 and ``admitted == completed + failed``.
+
+Prefix-affinity routing adds ``affinity_hits``/``affinity_misses``: one
+of the two per routing decision over a prompt-bearing request —
+``hits / (hits + misses)`` is the fleet's prefix-affinity hit rate
+(``fleet_prefix_affinity_hit_rate`` in bench.py).
 """
 
 from __future__ import annotations

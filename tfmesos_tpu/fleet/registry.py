@@ -45,6 +45,11 @@ class ReplicaInfo:
     outstanding: int = 0    # its own in-flight count, self-reported
     state: str = ALIVE
     last_beat: float = 0.0  # monotonic time of the last heartbeat
+    # Prefix-cache summary piggybacked on heartbeats ({page, first,
+    # seed, hashes} per serving.prefix_cache_summary) — what the
+    # router's prefix-affinity choice matches prompts against.  None
+    # until the replica advertises one.
+    prefix: Optional[dict] = None
 
 
 class ReplicaRegistry:
@@ -169,6 +174,8 @@ class ReplicaRegistry:
                 rep.capacity = int(msg["capacity"])
             if "outstanding" in msg:
                 rep.outstanding = int(msg["outstanding"])
+            if isinstance(msg.get("prefix_cache"), dict):
+                rep.prefix = msg["prefix_cache"]
             rep.last_beat = time.monotonic()
             self._conns[addr] = conn
         return addr

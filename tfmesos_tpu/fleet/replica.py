@@ -56,7 +56,8 @@ class ReplicaServer:
                  host: str = "127.0.0.1", port: int = 0,
                  registry_addr: Optional[str] = None,
                  heartbeat_interval: float = 0.3,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 extra_info: Optional[Callable[[], Dict[str, Any]]] = None):
         self.handler = handler
         self.token = token
         self.capacity = int(capacity)
@@ -65,6 +66,11 @@ class ReplicaServer:
         self.registry_addr = registry_addr
         self.heartbeat_interval = float(heartbeat_interval)
         self.advertise_host = advertise_host
+        # Extra fields merged into every heartbeat (must be cheap and
+        # never raise) — the batcher's prefix-cache summary rides here
+        # so the gateway's prefix-affinity routing knows what this
+        # replica has resident.
+        self.extra_info = extra_info
         self.log = get_logger("tfmesos_tpu.fleet.replica")
         self.addr: Optional[str] = None
         self._listen: Optional[socket.socket] = None
@@ -200,11 +206,19 @@ class ReplicaServer:
                 wire.send_msg(sock, {"op": "hello", "addr": self.addr,
                                      "capacity": self.capacity}, self.token)
                 while not self._stop.wait(self.heartbeat_interval):
-                    wire.send_msg(sock,
-                                  {"op": "heartbeat", "addr": self.addr,
-                                   "capacity": self.capacity,
-                                   "outstanding": self.outstanding},
-                                  self.token)
+                    beat = {"op": "heartbeat", "addr": self.addr,
+                            "capacity": self.capacity,
+                            "outstanding": self.outstanding}
+                    if self.extra_info is not None:
+                        try:
+                            beat.update(self.extra_info())
+                        except Exception:
+                            # A broken callback costs its fields, never
+                            # the heartbeat — losing the beat would get
+                            # a healthy replica marked dead.
+                            self.log.exception("heartbeat extra_info "
+                                               "failed; beat sent bare")
+                    wire.send_msg(sock, beat, self.token)
                 # Graceful exit: tell the registry we are draining so it
                 # stops routing to us before the process dies.
                 wire.send_msg(sock, {"op": "drain", "addr": self.addr},
@@ -357,6 +371,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--page-size", type=int, default=64)
     p.add_argument("--prefill-bucket", type=int, default=64)
     p.add_argument("--multi-step", type=int, default=1)
+    p.add_argument("--prefix-cache-pages", type=int, default=0,
+                   help="cross-request prefix cache budget in pool pages "
+                        "per mesh data shard (0 disables); cached "
+                        "summaries are advertised on registry heartbeats "
+                        "for prefix-affinity routing")
     p.add_argument("--tiny", action="store_true",
                    help="serve the tiny CI model instead of the flagship")
     p.add_argument("--seed", type=int, default=0)
@@ -379,12 +398,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     batcher = ContinuousBatcher(
         cfg, params, rows=args.rows, max_len=args.max_len,
         page_size=args.page_size, prefill_bucket=args.prefill_bucket,
-        multi_step=args.multi_step)
+        multi_step=args.multi_step,
+        prefix_cache_pages=args.prefix_cache_pages)
     serving = BatcherServing(batcher).start()
+    extra = None
+    if batcher.prefix_cache_active:
+        extra = lambda: {"prefix_cache": batcher.prefix_cache_summary()}
     server = ReplicaServer(
         batcher_handler(serving), token=token, capacity=args.rows,
         host=args.host, port=args.port, registry_addr=args.registry,
-        heartbeat_interval=args.heartbeat_interval)
+        heartbeat_interval=args.heartbeat_interval, extra_info=extra)
     server.start()
     print(f"replica serving on {server.addr}", flush=True)
 

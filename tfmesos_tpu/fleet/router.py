@@ -1,10 +1,24 @@
 """Load-aware request routing across the replica fleet.
 
-Replica choice is **least-outstanding-requests with power-of-two-choices
-sampling**: with many alive replicas, sampling two uniformly and taking
-the less-loaded one gets within a constant of full least-loaded routing
-at O(1) cost and — crucially — without the herd behavior of everyone
-chasing the single globally-least-loaded replica between load updates.
+Replica choice is **prefix-affinity first, then
+least-outstanding-requests with power-of-two-choices sampling**:
+
+* Affinity: replicas running a cross-request prefix cache advertise
+  their resident chunk digests on registry heartbeats; the router
+  hashes the incoming prompt's leading page-aligned chunks
+  (:mod:`tfmesos_tpu.prefixhash` — the same chain both sides compute)
+  and prefers the replica with the LONGEST match, so requests sharing
+  a system/few-shot prefix concentrate where the prefix's KV pages
+  already live and prefill only their tails.  A saturated favorite
+  (outstanding >= its advertised capacity) is skipped — affinity must
+  never turn into a hot-spot pile-up.
+* Fallback (no summaries, no match, favorite saturated): p2c — with
+  many alive replicas, sampling two uniformly and taking the
+  less-loaded one gets within a constant of full least-loaded routing
+  at O(1) cost and — crucially — without the herd behavior of everyone
+  chasing the single globally-least-loaded replica between load
+  updates.
+
 The load signal is the router's OWN outstanding count per replica link
 (what we have in hand is exact and instantaneous; the registry's
 self-reported count lags a heartbeat).
@@ -27,7 +41,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, Optional
 
-from tfmesos_tpu import wire
+from tfmesos_tpu import prefixhash, wire
 from tfmesos_tpu.fleet.client import CallTimeout, ConnectionLost, MuxConnection
 from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import ReplicaRegistry
@@ -69,17 +83,60 @@ class Router:
 
     # -- replica choice ----------------------------------------------------
 
-    def pick(self, exclude: Iterable[str] = ()) -> Optional[str]:
-        """Power-of-two-choices over alive replicas not in ``exclude``;
+    def _affinity_pick(self, cands, prompt) -> Optional[str]:
+        """The unsaturated replica whose advertised prefix-cache
+        summary matches the most leading chunks of ``prompt`` (ties:
+        least outstanding); ``None`` when nothing matches."""
+        best = None
+        digests: Dict[tuple, list] = {}     # one hash pass per geometry
+        for r in cands:
+            summ = r.prefix
+            if not isinstance(summ, dict) or not summ.get("hashes"):
+                continue
+            try:
+                key = (int(summ.get("page") or 0),
+                       int(summ.get("first") or 0),
+                       str(summ.get("seed") or ""))
+                if key[0] < 1:
+                    continue
+                if key not in digests:
+                    digests[key] = prefixhash.prompt_digests(
+                        prompt, key[0], key[1], bytes.fromhex(key[2]))
+                depth = prefixhash.match_depth(digests[key],
+                                               summ["hashes"])
+            except (ValueError, TypeError):
+                continue        # malformed summary: ignore, p2c covers
+            if not depth:
+                continue
+            out = self.outstanding(r.addr)
+            if r.capacity > 0 and out >= r.capacity:
+                continue        # saturated favorite: fall back, don't pile
+            score = (depth, -out)
+            if best is None or score > best[0]:
+                best = (score, r.addr)
+        return best[1] if best is not None else None
+
+    def pick(self, exclude: Iterable[str] = (),
+             prompt=None) -> Optional[str]:
+        """Prefix-affinity choice when ``prompt`` is given and some
+        replica advertises a matching cache summary, else
+        power-of-two-choices over alive replicas not in ``exclude``;
         ``None`` when no eligible replica exists."""
         exclude = set(exclude)
-        cands = [r.addr for r in self.registry.alive()
+        cands = [r for r in self.registry.alive()
                  if r.addr not in exclude]
         if not cands:
             return None
-        if len(cands) <= 2:
-            return min(cands, key=self.outstanding)
-        a, b = self._rng.sample(cands, 2)
+        if prompt is not None and len(prompt):
+            fav = self._affinity_pick(cands, prompt)
+            self.metrics.inc("affinity_hits" if fav is not None
+                             else "affinity_misses")
+            if fav is not None:
+                return fav
+        addrs = [r.addr for r in cands]
+        if len(addrs) <= 2:
+            return min(addrs, key=self.outstanding)
+        a, b = self._rng.sample(addrs, 2)
         return a if self.outstanding(a) <= self.outstanding(b) else b
 
     # -- link management ---------------------------------------------------
@@ -120,8 +177,9 @@ class Router:
         backoff)."""
         tried = set()
         last: Optional[BaseException] = None
+        prompt = msg.get("prompt") if isinstance(msg, dict) else None
         for attempt in range(self.max_retries + 1):
-            addr = self.pick(exclude=tried)
+            addr = self.pick(exclude=tried, prompt=prompt)
             if addr is None:
                 break       # nothing (left) to try
             try:

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tfmesos_tpu.compat import axis_size, shard_map
 from tfmesos_tpu.ops.attention import attend, mha_reference
 from tfmesos_tpu.ops.layers import (cross_entropy_loss,
                                     data_parallel_fused_cross_entropy,
@@ -483,7 +484,7 @@ def _block_manual_tp(cfg: TransformerConfig, x, lp, positions,
     transposes — required when the stage is differentiated with
     ``jax.vjp`` INSIDE the shard_map, where plain psum's transpose
     double-counts over tp (parallel/collectives.py)."""
-    tp = jax.lax.axis_size(tp_axis)
+    tp = axis_size(tp_axis)
     heads_loc = cfg.n_heads // tp
     kv_loc = cfg.kv_heads // tp
     b, t, _ = x.shape
@@ -849,15 +850,29 @@ class PageAllocator:
         self.page_size = int(page_size)
         self.free = list(range(n_pages - 1, -1, -1))
         self.rows: Dict[int, list] = {}
+        # Optional allocation-pressure hook: called with the free list
+        # empty, returns True after putting at least one page back on it
+        # (the serving prefix cache reclaims zero-ref cached pages this
+        # way — retained pages stay resident until someone actually
+        # needs the HBM, never blocking an allocation that could be
+        # served by evicting).
+        self.reclaim = None
+
+    def _take(self) -> int:
+        if not self.free:
+            while self.reclaim is not None and self.reclaim():
+                if self.free:
+                    break
+            if not self.free:
+                raise RuntimeError("page pool exhausted")
+        return self.free.pop()
 
     def ensure(self, row: int, length: int) -> None:
         """Back positions [0, length) of ``row`` with pages."""
         need = -(-int(length) // self.page_size)
         pages = self.rows.setdefault(row, [])
         while len(pages) < need:
-            if not self.free:
-                raise RuntimeError("page pool exhausted")
-            pages.append(self.free.pop())
+            pages.append(self._take())
 
     def release(self, row: int) -> None:
         self.free.extend(reversed(self.rows.pop(row, [])))
@@ -865,9 +880,7 @@ class PageAllocator:
     def reserve_page(self) -> int:
         """Permanently take one page out of circulation and return its id
         (serving uses this as a write sink for inactive decode rows)."""
-        if not self.free:
-            raise RuntimeError("page pool exhausted")
-        return self.free.pop()
+        return self._take()
 
     def free_count(self) -> int:
         return len(self.free)
@@ -1174,7 +1187,7 @@ def _sharded_paged_step(cfg: TransformerConfig, mesh: Mesh, q, k, v, ck,
             ck, cv = write(ck, cv, k, v, li, pages, positions[:, 0])
             return ck, cv
 
-        fn = jax.shard_map(local, mesh=mesh,
+        fn = shard_map(local, mesh=mesh,
                        in_specs=(qkv, qkv, qkv, pool, pool, P(), tbl, tbl),
                        out_specs=(pool, pool), check_vma=False)
         ck, cv = fn(q, k, v, ck, cv, li, pages, positions)
@@ -1198,7 +1211,7 @@ def _sharded_paged_step(cfg: TransformerConfig, mesh: Mesh, q, k, v, ck,
                                         layer=li)
         return o, ck, cv
 
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = shard_map(local, mesh=mesh,
                    in_specs=(qkv, qkv, qkv, pool, pool, P(), tbl, tbl),
                    out_specs=(qkv, pool, pool), check_vma=False)
     return fn(q, k, v, ck, cv, li, pages, positions)

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tfmesos_tpu.compat import shard_map
+
 NEG_INF = float("-inf")
 
 
@@ -1086,7 +1088,7 @@ def sharded_flash_decode(q, k_cache, v_cache, pos, mesh, layer=None, **kw):
     if isinstance(k_cache, QTensor):
         cspec = QTensor(cspec, P(None, batch, heads, None, None))
     li = jnp.asarray(0 if layer is None else layer, jnp.int32)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q_, k_, v_, p_, l_: flash_decode(q_, k_, v_, p_, layer=l_,
                                                 **kw),
         mesh=mesh, in_specs=(qspec, cspec, cspec, P(batch), P()),
@@ -1117,7 +1119,7 @@ def sharded_flash_attention(q, k, v, mesh, causal: bool = False,
     spec = P(batch, None, heads, None)
     if batch is None and heads is None:
         return flash_attention(q, k, v, causal=causal, scale=scale, **kw)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal,
                                            scale=scale, **kw),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tfmesos_tpu.compat import shard_map
+
 
 def rms_norm(x, weight, eps: float = 1e-6):
     xf = x.astype(jnp.float32)
@@ -201,7 +203,7 @@ def _vp_fwd(x, w, labels, mesh, axis, z_loss, chunk):
             loss_loc = jax.lax.psum(loss_loc, batch) / nb
         return loss_loc, logzs
 
-    loss, logzs = jax.shard_map(
+    loss, logzs = shard_map(
         local, mesh=mesh,
         in_specs=(P(batch, None, None), P(None, axis), P(batch, None)),
         out_specs=(P(), P(batch, None)), check_vma=False)(x, w, labels)
@@ -221,7 +223,7 @@ def _vp_bwd(mesh, axis, z_loss, chunk, res, g):
             dw = jax.lax.psum(dw, batch)                # all tokens' sum
         return dx, dw.astype(wl.dtype)
 
-    dx, dw = jax.shard_map(
+    dx, dw = shard_map(
         local, mesh=mesh,
         in_specs=(P(batch, None, None), P(None, axis), P(batch, None),
                   P(batch, None), P()),
@@ -362,7 +364,7 @@ def _dp_fwd(x, w, labels, mesh, z_loss, chunk):
             total = jax.lax.psum(total, batch)          # global token sum
         return total / (n_loc * nb), logzs
 
-    loss, logzs = jax.shard_map(
+    loss, logzs = shard_map(
         local, mesh=mesh,
         in_specs=(P(batch, None, None), P(None, None), P(batch, None)),
         out_specs=(P(), P(batch, None)), check_vma=False)(x, w, labels)
@@ -398,7 +400,7 @@ def _dp_bwd(mesh, z_loss, chunk, res, g):
             dw = jax.lax.psum(dw, batch)                # all tokens' sum
         return dxs.reshape(xl.shape).astype(xl.dtype), dw.astype(wl.dtype)
 
-    dx, dw = jax.shard_map(
+    dx, dw = shard_map(
         local, mesh=mesh,
         in_specs=(P(batch, None, None), P(None, None), P(batch, None),
                   P(batch, None), P()),

@@ -14,6 +14,8 @@ from typing import Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
+from tfmesos_tpu.compat import axis_size
+
 AxisName = Union[str, Sequence[str]]
 
 
@@ -87,7 +89,7 @@ def psum_replicated_grad(x, axis: AxisName):
 def ppermute_shift(x, axis: str, shift: int = 1):
     """Rotate values around a ring axis (the building block of ring attention
     and pipeline transfer); ``shift=+1`` sends to the next-higher index."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -96,8 +98,8 @@ def axis_index(axis: str):
     return jax.lax.axis_index(axis)
 
 
-def axis_size(axis: str) -> int:
-    return jax.lax.axis_size(axis)
+# axis_size is re-exported from tfmesos_tpu.compat (imported above): the
+# jax-version-portable size of a named mesh axis.
 
 
 def barrier(axis: AxisName):

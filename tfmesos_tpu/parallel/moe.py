@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tfmesos_tpu.compat import axis_size, shard_map
+
 
 def _routing(x, router_w, n_experts: int, capacity: int, top_k: int = 1
              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
@@ -114,7 +116,7 @@ def switch_moe_local(x, router_w, w_gate, w_up, w_down, axis: str = "ep",
     experts local [E/ep, d, f]; two all_to_all hops move token blocks to
     their expert owners and back.  Returns (out, aux) with aux scalars
     averaged over the ``axis`` group (callers pmean the data axes)."""
-    ep = jax.lax.axis_size(axis)
+    ep = axis_size(axis)
     n_loc, d = x.shape
     e_loc = w_gate.shape[0]
     e = e_loc * ep
@@ -176,7 +178,7 @@ def switch_moe_replicated_local(x, router_w, w_gate, w_up, w_down,
                                     return_aux=True)
     n, d = x.shape
     e_loc = w_gate.shape[0]
-    e = e_loc * (jax.lax.axis_size(ep_axis) if ep_axis else 1)
+    e = e_loc * (axis_size(ep_axis) if ep_axis else 1)
     capacity = _capacity(n, e, capacity_factor, top_k)
     combine, aux = _routing(x, router_w, e, capacity, top_k)  # [n, E, C]
     if ep_axis:
@@ -217,7 +219,7 @@ def switch_moe(x, router_w, w_gate, w_up, w_down, mesh: Mesh,
             aux = {k: jax.lax.pmean(v, batch_names) for k, v in aux.items()}
         return out, aux
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(dspec, P(None, None), espec, espec, espec),
         out_specs=(dspec, {k: P() for k in ("load_balance_loss", "z_loss",

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tfmesos_tpu.compat import shard_map
 from tfmesos_tpu.parallel.collectives import ppermute_shift
 from tfmesos_tpu.parallel.sharding import data_axes
 
@@ -479,7 +480,7 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, Any], Any],
         tail_specs = jax.tree_util.tree_map(
             lambda _, s: s, tail_params, tail_partition,
             is_leaf=lambda n: isinstance(n, P))
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = shard_map(local, mesh=mesh,
                        in_specs=(param_specs, tail_specs, x_spec, t_spec),
                        out_specs=(P(), param_specs, tail_specs, x_spec),
                        check_vma=False)
@@ -683,7 +684,7 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params: Any,
         out_specs = (x_spec, jax.tree_util.tree_map(lambda _: P(), aux_proto))
     else:
         out_specs = x_spec
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = shard_map(local, mesh=mesh,
                        in_specs=(param_specs, x_spec), out_specs=out_specs,
                        check_vma=False)
     return fn(stacked_params, x)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tfmesos_tpu.compat import axis_size, shard_map
 from tfmesos_tpu.parallel.collectives import ppermute_shift
 from tfmesos_tpu.parallel.sharding import data_axes
 
@@ -53,7 +54,7 @@ def ring_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    sp = jax.lax.axis_size(axis)
+    sp = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -138,7 +139,7 @@ def _step_cfg(q, scale, causal, interpret, window, step):
 
 def _ring_flash_fwd(q, k, v, axis, causal, scale, interpret, window):
     from tfmesos_tpu.ops import attention as A
-    sp = jax.lax.axis_size(axis)
+    sp = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     of = jnp.float32
 
@@ -173,7 +174,7 @@ def _ring_flash_bwd(axis, causal, scale, interpret, window, res, g):
     is back on its owner."""
     from tfmesos_tpu.ops import attention as A
     q, k, v, out, lse = res
-    sp = jax.lax.axis_size(axis)
+    sp = axis_size(axis)
     idx = jax.lax.axis_index(axis)
 
     dq, dk, dv = A._mha_bwd_pallas(
@@ -262,6 +263,6 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
     else:
         body = lambda q_, k_, v_: ring_attention_local(
             q_, k_, v_, axis=axis, causal=causal, scale=scale, window=window)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tfmesos_tpu.compat import axis_size, shard_map
 from tfmesos_tpu.parallel.sharding import data_axes
 
 
@@ -52,7 +53,7 @@ def ulysses_attention_local(q, k, v, axis: str = "sp", causal: bool = True,
     """
     from tfmesos_tpu.ops.attention import flash_attention
 
-    sp = jax.lax.axis_size(axis)
+    sp = axis_size(axis)
     h, hk = q.shape[2], k.shape[2]
     if h % sp:
         raise ValueError(f"ulysses needs heads ({h}) divisible by the sp "
@@ -110,6 +111,6 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     body = lambda q_, k_, v_: ulysses_attention_local(
         q_, k_, v_, axis=axis, causal=causal, scale=scale,
         interpret=interpret, use_pallas=use_pallas, window=window)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

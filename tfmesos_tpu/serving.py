@@ -45,6 +45,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from tfmesos_tpu import prefixhash as _ph
+from tfmesos_tpu.compat import shard_map
 from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
                                             decode_step,
                                             greedy_accept_counts,
@@ -174,6 +176,27 @@ class _Row:
     limit: int = 0
 
 
+@dataclasses.dataclass
+class _PrefixPlan:
+    """Admission-time decision to serve a request's leading prompt
+    pages from the prefix cache: map ``nodes``' pages read-only and
+    prefill only from ``tail_start`` on.  ``cow`` marks the
+    page-aligned full hit, where the one-token logits chunk must write
+    INTO the deepest cached page — that page is first copied into a
+    freshly reserved own page (copy-on-write) so shared state is never
+    written."""
+
+    nodes: list
+    cow: bool
+    tail_start: int     # first ABSOLUTE position the prefill writes
+
+    @property
+    def save(self) -> int:
+        """Own-page reservations the mapping saves (a COW hit re-backs
+        its deepest page with an own copy)."""
+        return len(self.nodes) - (1 if self.cow else 0)
+
+
 class _ShardedAlloc:
     """``PageAllocator``'s surface over per-shard sub-pools: rows are
     partitioned into ``n_shards`` contiguous groups (shard = row //
@@ -261,53 +284,82 @@ class _PagedSide:
         self.shared_len = 0                # positions they cover
         self.tail_template: Optional[int] = None  # partial-page template
         self.peak = 0                      # observability: high-water mark
+        # Cross-request prefix cache (set by the owning batcher): pages
+        # a row references READ-ONLY between the global shared prefix
+        # and its own allocation (row table = [shared | cached | own]).
+        self.pcache = None                        # _PrefixCache or None
+        self.row_cached: Dict[int, List[int]] = {}
         self._cache = None        # device table; rebuilt when dirty
         self._cache_np = None     # host master copy of the table
         self._masked = None       # (masked_rows, device table)
 
+    def dirty(self) -> None:
+        """Invalidate every derived table (host master, device copy,
+        masked variants) after ANY page-mapping change — allocation
+        growth, release, cached-prefix (re)mapping, COW remap.  One
+        choke point so a new mapping path cannot forget one of the
+        three caches (stale device tables are silent wrong-output
+        bugs)."""
+        self._cache = self._cache_np = self._masked = None
+
     def ensure(self, row: int, length: int) -> None:
         """Back ABSOLUTE positions [0, length): the shared prefix pages
-        cover [0, shared_len); the row's own allocation covers the rest."""
+        cover [0, shared_len), mapped cached-prefix pages the next
+        ``len(row_cached[row]) * page_size``; the row's own allocation
+        covers the rest."""
         before = self.alloc.allocated(row)
-        self.alloc.ensure(row, max(0, length - self.shared_len))
+        covered = self.shared_len + self.page_size * len(
+            self.row_cached.get(row, ()))
+        self.alloc.ensure(row, max(0, length - covered))
         if self.alloc.allocated(row) != before:
-            self._cache = self._cache_np = self._masked = None
+            self.dirty()
         used = self.n_pages - self.alloc.free_count()
         if used > self.peak:
             self.peak = used
 
     def release(self, row: int) -> None:
+        if self.pcache is not None:
+            self.pcache.release_row(row)
         self.alloc.release(row)
-        self._cache = self._cache_np = self._masked = None
+        self.dirty()
 
     def headroom(self, active: Dict[int, _Row], worst_of,
                  shard: int) -> int:
         """Free pages in ``shard`` not spoken for by in-flight rows'
         admission reservations (``worst_of(row)`` — worst_pages or
-        worst_draft)."""
+        worst_draft).  Zero-ref cached-prefix pages count as free: the
+        allocator reclaims them on demand (LRU eviction), so they must
+        not block admission."""
         outstanding = sum(
             worst_of(row) - self.alloc.allocated(r)
             for r, row in active.items()
             if self.alloc.shard_of(r) == shard)
-        return self.alloc.free_count(shard) - outstanding
+        reclaimable = (self.pcache.reclaimable(shard)
+                       if self.pcache is not None else 0)
+        return self.alloc.free_count(shard) + reclaimable - outstanding
 
     def table_np(self) -> np.ndarray:
         """Host master copy of the table (chunked prefill masks per-step
         variants off it)."""
         if self._cache_np is None:
-            # Rows WITH allocations see [shared prefix pages | own pages];
-            # rows without stay all-sink (an inactive row writes its
-            # garbage step at position 0 — that must never land on a
-            # shared or live page).
+            # Rows WITH allocations see [shared prefix pages |
+            # cached-prefix pages | own pages]; rows without stay
+            # all-sink (an inactive row writes its garbage step at
+            # position 0 — that must never land on a shared or live
+            # page).
             t = np.full((self.rows, self.np_max), self.sink, np.int32)
             ns = len(self.shared_pages)
             rows_map = self.alloc.rows
             for r in range(self.rows):
-                own = rows_map.get(r)
-                if own:
+                own = rows_map.get(r) or []
+                cached = self.row_cached.get(r) or []
+                if own or cached:
                     if ns:
                         t[r, :ns] = self.shared_pages
-                    t[r, ns:ns + len(own)] = own
+                    nc = len(cached)
+                    if nc:
+                        t[r, ns:ns + nc] = cached
+                    t[r, ns + nc:ns + nc + len(own)] = own
             self._cache_np = t
         return self._cache_np
 
@@ -336,7 +388,11 @@ class _PagedSide:
         column past its own pages — sink — never its last live page
         (at the np_max cap the pre-bucketing invariant already held)."""
         ns = len(self.shared_pages)
-        occ = max((ns + len(p) for p in self.alloc.rows.values() if p),
+        rows_map = self.alloc.rows
+        occ = max((ns + len(self.row_cached.get(r, ()))
+                   + len(rows_map.get(r, ()))
+                   for r in set(rows_map) | set(self.row_cached)
+                   if rows_map.get(r) or self.row_cached.get(r)),
                   default=1)
         return min(1 << occ.bit_length(), self.np_max)
 
@@ -363,6 +419,261 @@ class _PagedSide:
                 t = self.table_np()[:, :w]
             self._masked = ((masked, w), jnp.asarray(t))
         return self._masked[1]
+
+
+class _PrefixNode:
+    """One cached page-aligned chunk: a trie node owning one resident
+    pool page.  ``ref`` counts the live rows referencing the page
+    read-only; a zero-ref node keeps its page RESIDENT (that is the
+    cache) until the LRU evictor reclaims it under allocation
+    pressure or the budget."""
+
+    __slots__ = ("digest", "page", "ref", "parent", "children", "last",
+                 "shard")
+
+    def __init__(self, digest: bytes, page: int, parent, last: int,
+                 shard: int):
+        self.digest = digest
+        self.page = page
+        self.ref = 1
+        self.parent = parent        # _PrefixNode or None (root level)
+        self.children: Dict[bytes, "_PrefixNode"] = {}
+        self.last = last            # LRU tick of the last touch
+        self.shard = shard
+
+
+class _PrefixCache:
+    """Cross-request prefix cache over ONE :class:`_PagedSide`: a hash
+    trie per mesh data shard (pages are shard-pinned, so a cached page
+    is only reachable from rows of its own shard) mapping chain digests
+    of page-aligned prompt chunks (:mod:`tfmesos_tpu.prefixhash`) to
+    resident pool pages with refcounts.
+
+    Lifecycle: admission walks the trie for the longest cached prefix
+    and maps those pages read-only into the row's table (``acquire`` —
+    refcount++); the prefill writes only the uncached tail, after which
+    the tail's full prompt pages are PUBLISHED into the trie
+    (``insert_row`` — ownership moves from the row's allocator list to
+    the cache, the row keeping a reference).  ``release_row`` drops the
+    references when the request finishes; zero-ref pages stay resident
+    and are reclaimed lazily — the allocator's ``reclaim`` hook evicts
+    LRU leaves only when an allocation would otherwise fail, and
+    ``budget`` caps total cached pages per shard at insert time.
+
+    Thread safety: all mutation happens on the batcher's serve loop;
+    ``summary()``/``stats()`` are read from the replica heartbeat
+    thread, so every public method takes the lock.
+    """
+
+    def __init__(self, side: _PagedSide, page_size: int, first: int,
+                 seed: bytes, budget: int, n_shards: int = 1):
+        self.side = side
+        self.page_size = int(page_size)
+        self.first = int(first)     # width of chunk 0 (page - prefix tail)
+        self.seed = seed            # chain seed (constant prefix tail)
+        self.budget = int(budget)   # max cached pages PER SHARD
+        self.n_shards = int(n_shards)
+        self.roots: List[Dict[bytes, _PrefixNode]] = [
+            {} for _ in range(self.n_shards)]
+        self.row_nodes: Dict[int, List[_PrefixNode]] = {}
+        # O(1) occupancy counters (the admission hot path reads these
+        # per shard per attempt — walking the trie there would be
+        # O(cached pages) per tick): total resident nodes, and nodes at
+        # ref 0 (= reclaimable; a referenced descendant keeps every
+        # ancestor referenced, so zero-ref <=> evictable).
+        self._n_nodes = [0] * self.n_shards
+        self._n_zero = [0] * self.n_shards
+        self._tick = 0
+        self._lock = threading.Lock()
+        self._stats = {"hits": 0, "misses": 0, "hit_pages": 0,
+                       "hit_tokens": 0, "inserted": 0, "evicted": 0,
+                       "cow_copies": 0, "skipped": 0}
+        side.pcache = self
+        for s, alloc in enumerate(side.alloc.shards):
+            alloc.reclaim = partial(self._reclaim_cb, s)
+
+    # -- trie walks (call under the lock) ---------------------------------
+
+    def _walk(self, shard: int):
+        stack = list(self.roots[shard].values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n
+
+    def _match(self, shard: int, digests) -> List[_PrefixNode]:
+        level = self.roots[shard]
+        path: List[_PrefixNode] = []
+        for d in digests:
+            node = level.get(d)
+            if node is None:
+                break
+            path.append(node)
+            level = node.children
+        return path
+
+    def match(self, shard: int, digests) -> List[_PrefixNode]:
+        """Longest cached path for ``digests`` (read-only; refs are
+        taken by ``acquire`` once admission commits to the row)."""
+        with self._lock:
+            return self._match(shard, digests)
+
+    # -- row mapping -------------------------------------------------------
+
+    def acquire(self, row: int, nodes: List[_PrefixNode]) -> None:
+        """Map ``nodes``' pages read-only into ``row``'s table
+        (refcount++ each) — the row's table becomes
+        [shared | these pages | own]."""
+        with self._lock:
+            self._tick += 1
+            for n in nodes:
+                n.ref += 1
+                if n.ref == 1:
+                    self._n_zero[n.shard] -= 1
+                n.last = self._tick
+            self.row_nodes[row] = list(nodes)
+            self.side.row_cached[row] = [n.page for n in nodes]
+        self.side.dirty()
+
+    def unmap_last(self, row: int) -> _PrefixNode:
+        """Drop the DEEPEST mapped page from ``row``'s table (the
+        copy-on-write remap: its content moves into a freshly reserved
+        own page); the node's reference is still held — release it via
+        ``release_nodes`` once the copy has been dispatched so the
+        evictor cannot reclaim the source mid-copy."""
+        with self._lock:
+            node = self.row_nodes[row][-1]
+            self.side.row_cached[row].pop()
+        self.side.dirty()
+        return node
+
+    def _drop_ref(self, n: _PrefixNode) -> None:
+        n.ref -= 1
+        if n.ref == 0:
+            self._n_zero[n.shard] += 1
+        n.last = self._tick
+
+    def release_nodes(self, row: int, nodes) -> None:
+        with self._lock:
+            self._tick += 1
+            held = self.row_nodes.get(row, [])
+            for n in nodes:
+                self._drop_ref(n)
+                held.remove(n)
+
+    def release_row(self, row: int) -> None:
+        """The row finished: drop every reference it holds.  Pages stay
+        resident (zero-ref = the reusable cache) up to the budget."""
+        with self._lock:
+            self._tick += 1
+            for n in self.row_nodes.pop(row, []):
+                self._drop_ref(n)
+            self.side.row_cached.pop(row, None)
+
+    def insert_row(self, row: int, shard: int, digests, state) -> None:
+        """Publish ``row``'s freshly prefilled full prompt pages into
+        the trie: ownership of the leading own pages moves to the cache
+        (the row keeps referencing them at the SAME table slots, so no
+        table rebuild is needed), extending the path the row already
+        holds.  Stops at the first chunk already published by a
+        concurrent twin (its pages stay own — never two owners for one
+        trie node) or when the per-shard budget cannot be met by
+        evicting."""
+        with self._lock:
+            self._tick += 1
+            held = self.row_nodes.setdefault(row, [])
+            own = self.side.alloc.rows.get(row, [])
+            cached = self.side.row_cached.setdefault(row, [])
+            level = (held[-1].children if held else self.roots[shard])
+            moved = 0
+            for d in digests[len(held):]:
+                if not own:
+                    break
+                if d in level:
+                    break       # a twin published this chunk first
+                while (self._size(shard) >= self.budget
+                       and self._evict_one(shard)):
+                    pass
+                if self._size(shard) >= self.budget:
+                    self._stats["skipped"] += 1
+                    break
+                node = _PrefixNode(d, own.pop(0),
+                                   held[-1] if held else None,
+                                   self._tick, shard)
+                level[d] = node
+                self._n_nodes[shard] += 1
+                held.append(node)
+                cached.append(node.page)
+                level = node.children
+                moved += 1
+            self._stats["inserted"] += moved
+        # The row's remaining claim on the pool is unchanged — the
+        # moved pages still back its positions — so its reservation
+        # shrinks with its allocation to keep headroom() exact.
+        state.worst_pages -= moved
+
+    # -- eviction ----------------------------------------------------------
+
+    def _size(self, shard: int) -> int:
+        return self._n_nodes[shard]
+
+    def reclaimable(self, shard: int) -> int:
+        """Pages reclaimable on demand: zero-ref nodes (a referenced
+        descendant would keep its ancestors referenced too, so a
+        zero-ref subtree is entirely evictable).  O(1) — the admission
+        path reads this per shard per attempt."""
+        return self._n_zero[shard]
+
+    def _evict_one(self, shard: int) -> bool:
+        """Reclaim the LRU zero-ref LEAF (deepest-first keeps every
+        remaining node's chain valid); its page returns to the shard's
+        free list.  Caller holds the lock."""
+        best = None
+        for n in self._walk(shard):
+            if n.ref == 0 and not n.children:
+                if best is None or n.last < best.last:
+                    best = n
+        if best is None:
+            return False
+        level = (best.parent.children if best.parent is not None
+                 else self.roots[shard])
+        del level[best.digest]
+        self._n_nodes[shard] -= 1
+        self._n_zero[shard] -= 1
+        self.side.alloc.shards[shard].free.append(best.page)
+        self._stats["evicted"] += 1
+        return True
+
+    def _reclaim_cb(self, shard: int) -> bool:
+        with self._lock:
+            return self._evict_one(shard)
+
+    # -- accounting / export ----------------------------------------------
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[name] += n
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._stats)
+            out["cached_pages"] = sum(self._n_nodes)
+            out["retained_pages"] = sum(self._n_zero)
+        return out
+
+    def summary(self, max_entries: int = 64) -> Dict[str, Any]:
+        """Wire-facing cache summary for registry heartbeats: the chunk
+        geometry plus the most-recently-touched chain digests, which is
+        what the gateway's prefix-affinity router matches incoming
+        prompts against (fleet/router.py)."""
+        with self._lock:
+            nodes = [n for s in range(self.n_shards)
+                     for n in self._walk(s)]
+            nodes.sort(key=lambda n: n.last, reverse=True)
+            return {"page": self.page_size, "first": self.first,
+                    "seed": self.seed.hex(),
+                    "hashes": [n.digest.hex()
+                               for n in nodes[:max_entries]]}
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -459,6 +770,27 @@ class ContinuousBatcher:
     touch shared pages.  ``max_len`` still bounds the TOTAL sequence
     (prefix + prompt + new tokens); request positions and outputs are
     unchanged — the prefix is invisible except in attention.
+
+    ``prefix_cache_pages`` (> 0 enables; the value caps resident cached
+    pages per mesh data shard) turns on the CROSS-REQUEST PREFIX CACHE:
+    full page-aligned prompt chunks are published into a per-shard hash
+    trie after prefill, and later requests sharing a leading prompt run
+    map those pages read-only (refcounted) and prefill only the
+    uncached tail — TTFT for a warm shared system prompt drops to the
+    tail's compute.  A page-aligned full hit copies its deepest page
+    copy-on-write before the one-token logits rewrite; finished
+    requests leave zero-ref pages RESIDENT, reclaimed LRU-first only
+    under allocation pressure (admission headroom counts them as free,
+    so the cache can never deadlock admission).  Unlike the static
+    ``prefix`` above, nothing needs declaring up front — any shared
+    system/few-shot prompt is discovered at admission.  Greedy warm
+    completions match cold-prefill completions exactly up to float-tie
+    argmax flips (the tail prefill runs cache-attention, like chunked
+    prefill; bit-identical in practice on the CPU test config).
+    Composes with ``prefill_chunk``, ``overlap``, ``multi_step``,
+    ``mesh``, and ``prefix``; speculative decoding and
+    ``quantized_cache`` BYPASS sharing explicitly
+    (``prefix_cache_bypass_reason``).
     """
 
     def __init__(self, cfg: TransformerConfig, params, rows: int = 8,
@@ -473,9 +805,13 @@ class ContinuousBatcher:
                  draft_n_pages: Optional[int] = None, mesh=None,
                  overlap: bool = False,
                  draft_quantized_cache: bool = False,
-                 multi_step: int = 1):
+                 multi_step: int = 1,
+                 prefix_cache_pages: int = 0):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
+        if prefix_cache_pages < 0:
+            raise ValueError(f"prefix_cache_pages must be >= 0, got "
+                             f"{prefix_cache_pages}")
         if multi_step < 1:
             raise ValueError(f"multi_step must be >= 1, got {multi_step}")
         if multi_step > 1 and draft_cfg is not None:
@@ -622,6 +958,49 @@ class ContinuousBatcher:
         self.spec_committed = 0     # tokens committed across them
         if prefix_np is not None:
             self._init_prefix(prefix_np)
+        # Cross-request prefix cache (prefix_cache_pages > 0 enables;
+        # the value caps resident cached pages PER SHARD).  Modes whose
+        # pages the cache cannot share bitwise-safely BYPASS explicitly
+        # (prefix_cache_bypass_reason says why, tests assert it):
+        # speculative decoding would need coupled draft-pool sharing,
+        # and an int8 pool's tail-repcompute path is not bit-stable
+        # against the cold fused prefill.
+        self._pcache: Optional[_PrefixCache] = None
+        self._tail_prefill = None
+        self.prefix_cache_bypass_reason: Optional[str] = None
+        if prefix_cache_pages:
+            if draft_cfg is not None:
+                self.prefix_cache_bypass_reason = "speculative decoding"
+            elif quantized_cache:
+                self.prefix_cache_bypass_reason = "quantized kv cache"
+            else:
+                off = self.prefix_len - self.t_side.shared_len
+                seed = (b"" if not off else _ph.chunk_digest(
+                    b"", prefix_np[self.t_side.shared_len:]))
+                self._pcache = _PrefixCache(
+                    self.t_side, self.page_size, self.page_size - off,
+                    seed, prefix_cache_pages, n_shards=self.n_shards)
+                self._tail_prefill = (self._chunk_prefill
+                                      or self._make_chunk_prefill())
+
+    @property
+    def prefix_cache_active(self) -> bool:
+        return self._pcache is not None
+
+    def prefix_cache_stats(self) -> Optional[Dict[str, int]]:
+        """Hit/miss/eviction counters plus current occupancy of the
+        cross-request prefix cache (None when disabled or bypassed).
+        Thread-safe — the replica heartbeat reads it live."""
+        return None if self._pcache is None else self._pcache.stats()
+
+    def prefix_cache_summary(self,
+                             max_entries: int = 64) -> Optional[dict]:
+        """Wire-facing summary of what the prefix cache holds (chunk
+        geometry + recent chain digests) — piggybacked on registry
+        heartbeats so the fleet router can steer shared-prefix traffic
+        here (prefix-affinity routing).  None when disabled."""
+        return (None if self._pcache is None
+                else self._pcache.summary(max_entries))
 
     @property
     def acceptance_rate(self) -> Optional[float]:
@@ -697,7 +1076,7 @@ class ContinuousBatcher:
                 return jax.tree_util.tree_map(
                     lambda buf: buf.at[:, dst[0]].set(buf[:, src[0]]),
                     pool)
-            return jax.shard_map(local, mesh=mesh,
+            return shard_map(local, mesh=mesh,
                              in_specs=(specs, P(), P(da)),
                              out_specs=specs, check_vma=False)(
                 pool, src, dst)
@@ -1092,39 +1471,132 @@ class ContinuousBatcher:
             wd = -(-(need_len - self.d_side.shared_len) // self.page_size)
         return wt, wd, need_len
 
+    def _req_digests(self, req: Request) -> list:
+        """Chain digests of ``req``'s complete page-aligned prompt
+        chunks (memoized on the request, keyed by the chunk geometry so
+        a request replayed into a differently-paged batcher rehashes —
+        without the memo, a request waiting for a row would rehash its
+        prompt every admission tick)."""
+        pc = self._pcache
+        key = (pc.page_size, pc.first, pc.seed)
+        memo = getattr(req, "_pfx_digests", None)
+        if memo is None or memo[0] != key:
+            memo = (key, _ph.prompt_digests(req.prompt, pc.page_size,
+                                            pc.first, pc.seed))
+            req._pfx_digests = memo
+        return memo[1]
+
+    def _prefix_plan(self, req: Request, shard: int,
+                     max_nodes: Optional[int] = None
+                     ) -> Optional[_PrefixPlan]:
+        """The longest USABLE cached prefix for ``req`` on ``shard``
+        (capped at ``max_nodes`` — _admit_row retries shallower when a
+        deep plan doesn't fit the shard's headroom): the trie match,
+        trimmed until the uncached tail's padded prefill window fits
+        inside the page table (``np_max * page_size`` positions — the
+        allocation itself is clamped at the reservation by
+        _admit_cached's ensure, and pad writes past it land in
+        reserved-but-unread positions or on sink columns, exactly like
+        the cold path's prompt padding) and, in chunked mode, starts on
+        the chunk grid.  A page-aligned full hit keeps its deepest page
+        and marks it COW: the one-token logits chunk rewrites position
+        E-1 inside a private copy."""
+        digs = self._req_digests(req)
+        if not digs:
+            return None
+        nodes = self._pcache.match(shard, digs)
+        if not nodes:
+            return None
+        E = self.prefix_len + int(req.prompt.size)
+        sl = self.t_side.shared_len
+        ps, bucket = self.page_size, self.prefill_bucket
+        n = len(nodes)
+        if max_nodes is not None:
+            n = min(n, max_nodes)
+            if not n:
+                return None
+        if self.prefill_chunk is not None:
+            c = self.prefill_chunk
+            while n and (sl + n * ps > E - 1
+                         or (sl + n * ps - self.prefix_len) % c):
+                n -= 1
+            return (_PrefixPlan(nodes[:n], False, sl + n * ps)
+                    if n else None)
+        while n:
+            cow = sl + n * ps >= E
+            ts = E - 1 if cow else sl + n * ps
+            w = -(-(E - ts) // bucket) * bucket
+            if ts + w <= self.np_max * ps:
+                return _PrefixPlan(nodes[:n], cow, ts)
+            n -= 1
+        return None
+
     def _admit_row(self, free_rows: List[int], active: Dict[int, _Row],
-                   wt: int, wd: int) -> Optional[int]:
+                   wt: int, wd: int, req: Request) -> tuple:
         """Pop a free row whose shard's pool(s) can take both worst-case
-        reservations, preferring the shard with the most target headroom
-        (load balance across mesh data shards; with one shard this is
-        just a headroom check).  ``None`` means wait for in-flight rows
-        to release pages.  Raises when some free row's shard has NO
-        in-flight work and still can't fit — waiting would deadlock."""
+        reservations, preferring the shard with the longest cached
+        prefix for ``req`` (pages are shard-pinned, so a hit is only a
+        hit on its own shard), then the most target headroom (load
+        balance across mesh data shards; with one shard and no cache
+        this is just a headroom check).  Returns ``(row, plan)``;
+        ``(None, None)`` means wait for in-flight rows to release
+        pages.  Raises when some free row's shard has NO in-flight work
+        and still can't fit — waiting would deadlock."""
         best = None
         empty_shard = None
-        ht_by_shard: Dict[int, int] = {}
-        ok_by_shard: Dict[int, bool] = {}
+        by_shard: Dict[int, tuple] = {}     # s -> (ok, headroom, plan)
         for i, r in enumerate(free_rows):
             s = self.t_side.alloc.shard_of(r)
-            if s not in ok_by_shard:     # headroom is a per-SHARD fact
+            if s not in by_shard:        # headroom is a per-SHARD fact
                 ht = self.t_side.headroom(active,
                                           lambda x: x.worst_pages, s)
-                ok = wt <= ht
+                plan = (self._prefix_plan(req, s)
+                        if self._pcache is not None else None)
+                while True:
+                    wt_s = wt - (plan.save if plan is not None else 0)
+                    ht_s = ht
+                    if plan is not None:
+                        # headroom() counts zero-ref cached pages as
+                        # reclaimable, but accepting THIS plan
+                        # references its nodes — they can no longer be
+                        # evicted to satisfy the same admission.
+                        # Discounting wt by plan.save AND counting
+                        # those pages reclaimable would double-count
+                        # them and over-admit (a "page pool exhausted"
+                        # crash out of the serve loop, exactly what
+                        # reservations exist to prevent).
+                        ht_s -= sum(1 for n in plan.nodes if n.ref == 0)
+                    ok = wt_s <= ht_s
+                    if ok or plan is None:
+                        break
+                    # A deep plan that doesn't fit (the COW full hit
+                    # needs a fresh copy page ON TOP of referencing
+                    # every reclaimable cached page) must not condemn
+                    # the request: retry shallower — down to the plain
+                    # cold admission, which evicts the unused cached
+                    # pages on demand.
+                    depth = len(plan.nodes) - 1
+                    plan = (self._prefix_plan(req, s, max_nodes=depth)
+                            if depth else None)
                 if ok and self.d_side is not None:
                     ok = wd <= self.d_side.headroom(
                         active, lambda x: x.worst_draft, s)
-                ht_by_shard[s], ok_by_shard[s] = ht, ok
-            if ok_by_shard[s]:
-                if best is None or ht_by_shard[s] > best[1]:
-                    best = (i, ht_by_shard[s])
+                by_shard[s] = (ok, ht, plan)
+            ok, ht, plan = by_shard[s]
+            if ok:
+                key = (plan.save if plan is not None else 0, ht)
+                if best is None or key > best[1]:
+                    best = (i, key, plan)
             elif not any(self.t_side.alloc.shard_of(rr) == s
                          for rr in active):
                 empty_shard = s
         if best is not None:
-            return free_rows.pop(best[0])
+            return free_rows.pop(best[0]), best[2]
         if empty_shard is not None:
             s = empty_shard
             free_t = self.t_side.alloc.free_count(s)
+            if self._pcache is not None:
+                free_t += self._pcache.reclaimable(s)
             free_d = (0 if self.d_side is None
                       else self.d_side.alloc.free_count(s))
             raise RuntimeError(
@@ -1132,7 +1604,7 @@ class ContinuousBatcher:
                 f"shard {s} only has {free_t} target / {free_d} draft "
                 f"free with nothing in flight to wait for — raise "
                 f"n_pages")
-        return None
+        return None, None
 
     # -- incremental (online) submission ----------------------------------
 
@@ -1241,14 +1713,15 @@ class ContinuousBatcher:
                     except ValueError as e:
                         bad_request = e     # raise after draining
                         break
-                    row = self._admit_row(free_rows, active, wt, wd)
+                    row, plan = self._admit_row(free_rows, active, wt,
+                                                wd, pending[0])
                     if row is None:
                         break   # wait for an in-flight row to finish
                     req = pending.popleft()
                     rid = self._next_rid
                     self._next_rid += 1
                     res = self._admit_dispatch(row, rid, req, wt, wd,
-                                               need, active)
+                                               need, active, plan)
                     if res is not None:
                         burst.append(res)
                 yield from self._finalize_burst(burst, active, free_rows)
@@ -1293,36 +1766,65 @@ class ContinuousBatcher:
         for side in sides:
             fresh = side.alloc.allocated(row) == 0
             side.ensure(row, length)
+            # A row holding CACHED prefix pages skips the template copy:
+            # its first cacheable page (which embeds the template
+            # content) came from the cache — its first OWN page covers a
+            # later position range entirely.
             if (side.tail_template is not None and fresh
+                    and not side.row_cached.get(row)
                     and side.alloc.allocated(row)):
                 dst = np.full((self.n_shards,), side.sink, np.int32)
                 dst[side.alloc.shard_of(row)] = side.alloc.rows[row][0]
                 side.pool = side.copy(side.pool, side.tail_template, dst)
 
     def _admit_dispatch(self, row: int, rid: int, req: Request, wt: int,
-                        wd: int, need: int,
-                        active: Dict[int, _Row]) -> Optional[tuple]:
+                        wd: int, need: int, active: Dict[int, _Row],
+                        plan: Optional[_PrefixPlan] = None
+                        ) -> Optional[tuple]:
         """Reserve + DISPATCH ``req``'s prefill into ``row`` without the
         first-token host sync; ``wt``/``wd``/``need`` are the per-side
         page reservations (and the position cap they cover) run()
-        admitted it under.  Returns ``(row, state, device_token, shard)``
-        for run()'s burst finalize — ``None`` in chunked mode, which
-        makes no model call here."""
+        admitted it under, ``plan`` the prefix-cache mapping it chose
+        the row's shard for.  Returns ``(row, state, device_token,
+        shard)`` for run()'s burst finalize — ``None`` in chunked mode,
+        which makes no model call here."""
         t_admit = time.perf_counter()
         length = req.prompt.size
         width = -(-length // self.prefill_bucket) * self.prefill_bucket
-        self._ensure_sides(row, self.prefix_len + width)
-        padded = np.zeros((1, width), np.int32)
-        padded[0, :length] = req.prompt
+        if plan is not None:
+            # Map the cached prefix pages read-only BEFORE any ensure()
+            # call: the references protect them from the LRU evictor
+            # while this admission allocates its own pages.
+            self._pcache.acquire(row, plan.nodes)
+            self._pcache.count("hits")
+            self._pcache.count("hit_pages", len(plan.nodes))
+            self._pcache.count("hit_tokens",
+                               plan.tail_start - self.prefix_len)
+            wt -= plan.save
+        elif self._pcache is not None and self._req_digests(req):
+            self._pcache.count("misses")
         if self._chunk_prefill is not None:
-            # Chunked mode: no model call here — the run loop advances one
-            # chunk per tick, interleaved with the batched decode step.
+            # Chunked mode: no model call here — the run loop advances
+            # one chunk per tick, interleaved with the batched decode
+            # step.  On a cache hit, filling starts AT THE TAIL (the
+            # mapped pages already hold chunks [0, filled)).
+            self._ensure_sides(row, self.prefix_len + width)
+            padded = np.zeros((1, width), np.int32)
+            padded[0, :length] = req.prompt
             state = _Row(rid=rid, req=req, pos=self.prefix_len + length,
                          step=1, last=0, out=[], worst_pages=wt,
                          worst_draft=wd, t_admit=t_admit, padded=padded,
-                         filled=0, decoding=False, limit=need)
+                         filled=(0 if plan is None
+                                 else plan.tail_start - self.prefix_len),
+                         decoding=False, limit=need)
             active[row] = state
             return None
+        if plan is not None:
+            return self._admit_cached(row, rid, req, wt, wd, need,
+                                      active, plan, t_admit)
+        self._ensure_sides(row, self.prefix_len + width)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :length] = req.prompt
         s, toks, table = self._one_hot_call(self.t_side, row, padded)
         lengths = np.ones((self.n_shards,), np.int32)
         lengths[s] = length
@@ -1341,7 +1843,71 @@ class ContinuousBatcher:
                      last=0, out=[], worst_pages=wt, worst_draft=wd,
                      t_admit=t_admit, limit=need)
         active[row] = state
+        self._pcache_insert(row, state)
         return row, state, tok, s
+
+    def _admit_cached(self, row: int, rid: int, req: Request, wt: int,
+                      wd: int, need: int, active: Dict[int, _Row],
+                      plan: _PrefixPlan, t_admit: float) -> tuple:
+        """Admission with a mapped cached prefix: prefill ONLY the
+        uncached tail at its true offset (the jitted traced-offset
+        chunk writer — one compile per tail-width bucket) and sample
+        the first token from the prompt's last position.  A
+        page-aligned full hit first copies the deepest cached page into
+        a fresh own page (``_copy_page`` copy-on-write) so the
+        last-token rewrite never touches shared state."""
+        side = self.t_side
+        E = self.prefix_len + int(req.prompt.size)
+        if plan.cow:
+            cow_node = plan.nodes[-1]
+            src = cow_node.page
+            self._pcache.unmap_last(row)
+            side.ensure(row, side.shared_len
+                        + len(plan.nodes) * self.page_size)
+            dst = np.full((self.n_shards,), side.sink, np.int32)
+            dst[side.alloc.shard_of(row)] = side.alloc.rows[row][0]
+            side.pool = side.copy(side.pool, src, dst)
+            # The reference protected the source page through the
+            # ensure() above (eviction runs under allocation pressure);
+            # the copy is dispatched, so it can be dropped now.
+            self._pcache.release_nodes(row, [cow_node])
+            self._pcache.count("cow_copies")
+        ts = plan.tail_start
+        tlen = E - ts
+        w = -(-tlen // self.prefill_bucket) * self.prefill_bucket
+        # Clamp the allocation at the reservation: pad positions past
+        # ``need`` write reserved-but-unread slots or sink columns (the
+        # cold path's prompt padding behaves identically), and
+        # allocations beyond ``worst_pages`` would corrupt headroom().
+        self._ensure_sides(row, min(ts + w, need))
+        padded = np.zeros((1, w), np.int32)
+        padded[0, :tlen] = req.prompt[req.prompt.size - tlen:]
+        s, toks, table = self._one_hot_call(side, row, padded)
+        caps = np.full((self.n_shards,), -1, np.int32)
+        caps[s] = tlen - 1
+        rids = np.zeros((self.n_shards,), np.int32)
+        rids[s] = rid
+        self.pool, tok = self._tail_prefill(
+            self.params, self.pool, table, toks,
+            jnp.asarray(ts, jnp.int32), jnp.asarray(caps),
+            jnp.asarray(rids))
+        tok.copy_to_host_async()    # transfer overlaps later dispatches
+        state = _Row(rid=rid, req=req, pos=E, step=1, last=0, out=[],
+                     worst_pages=wt, worst_draft=wd, t_admit=t_admit,
+                     limit=need)
+        active[row] = state
+        self._pcache_insert(row, state)
+        return row, state, tok, s
+
+    def _pcache_insert(self, row: int, state: _Row) -> None:
+        """Publish ``row``'s freshly prefilled full prompt pages into
+        the prefix cache (no-op without one)."""
+        if self._pcache is None:
+            return
+        digs = self._req_digests(state.req)
+        if digs:
+            self._pcache.insert_row(
+                row, self.t_side.alloc.shard_of(row), digs, state)
 
     def _admit_finalize(self, state: _Row,
                         tok: int) -> Optional[Completion]:
@@ -1406,6 +1972,10 @@ class ContinuousBatcher:
         row.last = tok
         row.out.append(tok)
         row.decoding = True
+        # Publish the now fully-dispatched prompt pages; chunked mode
+        # must wait until here — at admission the chunks had not been
+        # written, and a concurrent hit would have mapped garbage.
+        self._pcache_insert(r, row)
         if tok == row.req.stop_token or row.req.max_new_tokens == 1:
             return r
         return None
