@@ -219,6 +219,32 @@ def train_chunk(ctx, params, k, lr, seed):
     return {"w": np.asarray(w).tolist(), "loss": float(loss)}
 
 
+def train_chunk_numpy(ctx, params, k, lr, seed):
+    """A dispatched chunk of k softmax-regression SGD steps in PURE numpy
+    (no jax — runs under extra_config={"no_jax": True}): bitwise
+    deterministic given (params, seed), so chaos tests can assert a
+    kill-recover-resume run reaches EXACTLY the loss of an uninterrupted
+    one.  Every rank computes the same update; rank 0's result is the
+    driver's."""
+    import numpy as np
+
+    w = np.asarray(params["w"], np.float32)
+    rng = np.random.RandomState(seed)
+    loss = None
+    for _ in range(k):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randint(0, 4, size=8)
+        z = x @ w
+        z -= z.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        loss = float(-np.mean(np.log(p[np.arange(8), y] + 1e-12)))
+        g = p
+        g[np.arange(8), y] -= 1.0
+        w = w - lr * (x.T @ (g / 8.0))
+    return {"w": w.tolist(), "loss": loss}
+
+
 def _cb_workload():
     """The continuous-batching cross-process workload, shared by the
     task-side entry point and the test's single-host reference."""

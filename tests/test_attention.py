@@ -585,3 +585,63 @@ def test_flash_decode_paged_deferred_self():
                                           self_kv=(k_self, v_self))
         np.testing.assert_allclose(np.asarray(got_ref), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+    # int8 pools: the caller (transformer decode_step) pre-quantize-
+    # dequantizes the self chunk, so the self operand matches a committed
+    # slot up to rounding; the kernel's in-VMEM scale folds must agree
+    # with dequantize-then-attend over the same pool.
+    from tfmesos_tpu.ops.quant import (QTensor, quantize_int8_reference,
+                                       quantize_tensor)
+
+    qt_k, qt_v = quantize_tensor(kc), quantize_tensor(vc)
+    kd, vd = qt_k.dequantize(jnp.float32), qt_v.dequantize(jnp.float32)
+    lane = lambda qt: (   # [B,KV,M,1] scales -> pooled lane-major [P,KV,1,ps]
+        qt.scales[..., 0].reshape(b, kv, npg, ps).transpose(0, 2, 1, 3)
+        .reshape(b * npg, kv, ps)[:, :, None, :])
+    k_pool8 = QTensor(pool(qt_k.values), jnp.asarray(lane(qt_k)))
+    v_pool8 = QTensor(pool(qt_v.values), jnp.asarray(lane(qt_v)))
+    rq = lambda c: (lambda v_, s_: v_.astype(jnp.float32)
+                    * s_.astype(jnp.float32))(*quantize_int8_reference(c))
+    k_self8, v_self8 = rq(k_self), rq(v_self)
+    for pos in (5, jnp.array([0, 130, 511], jnp.int32)):
+        posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+        put = jax.vmap(lambda c_, s_, p_: jax.lax.dynamic_update_slice(
+            c_, s_[:, None], (0, p_, 0)))
+        ref8 = _decode_reference(q, put(kd, k_self8[:, 0], posv),
+                                 put(vd, v_self8[:, 0], posv), pos,
+                                 d ** -0.5)
+        got8 = flash_decode_paged(q, k_pool8, v_pool8, pt, pos,
+                                  use_pallas=True, interpret=True,
+                                  self_kv=(k_self8, v_self8))
+        np.testing.assert_allclose(np.asarray(got8), np.asarray(ref8),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_stacked_cache_static_zero_layer_with_4d_cache():
+    """A statically-zero layer index — python 0, numpy int32(0), a 0-d
+    concrete array — over a 4-D (single-layer) cache must be accepted via
+    the L=1 lift (operator.index), not spuriously rejected; a nonzero or
+    traced index still needs the stacked 5-D cache."""
+    from tfmesos_tpu.ops.attention import _decode_reference, flash_decode
+
+    q, kc, vc = _decode_inputs(m=256)
+    ref = _decode_reference(q, kc, vc, 100, q.shape[-1] ** -0.5)
+    # Kernel path once (the scalar-prefetch consumer of the index) ...
+    got = flash_decode(q, kc, vc, 100, layer=np.int32(0), use_pallas=True,
+                       interpret=True, block_m=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # ... and the cheap reference path for the other statically-zero forms.
+    for zero in (0, np.int64(0), jnp.asarray(0, jnp.int32)):
+        got = flash_decode(q, kc, vc, 100, layer=zero, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    for bad in (1, np.int32(2)):
+        with pytest.raises(ValueError, match="stacked 5-D cache"):
+            flash_decode(q, kc, vc, 100, layer=bad, use_pallas=False)
+
+    def traced(li):
+        return flash_decode(q, kc, vc, 100, layer=li, use_pallas=False)
+
+    with pytest.raises(ValueError, match="stacked 5-D cache"):
+        jax.jit(traced)(jnp.asarray(0, jnp.int32))

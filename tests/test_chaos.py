@@ -1,0 +1,593 @@
+"""Deterministic fault injection (tfmesos_tpu/chaos.py) and what it
+proves: elastic gang recovery with generation fencing, the sliding-window
+restart budget, checkpoint-coordinated resume, and the wire/registry
+chaos hooks.  Everything here is seeded/counted — same plan, same faults,
+same recovery — so the asserts are exact, not "it probably survived"."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.chaos import Fault, FaultPlan
+from tfmesos_tpu.scheduler import ClusterError, TPUMesosScheduler
+from tfmesos_tpu.spec import Job, Offer, TaskStatus
+
+from test_scheduler import FakeBackend
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan mechanics
+
+
+def test_faultplan_counters_nth_count_target():
+    plan = FaultPlan([
+        Fault("drop", "registry.heartbeat", nth=2, count=3, target="repA"),
+        Fault("drop", "registry.heartbeat", nth=1, target="repB"),
+    ], seed=0)
+    # repA: beat 1 passes, 2-4 dropped, 5 passes again.
+    got = [plan.on_heartbeat("repA:1") for _ in range(5)]
+    assert got == [False, True, True, True, False]
+    # repB counts independently of repA's stream.
+    assert plan.on_heartbeat("repB:1") is True
+    assert plan.on_heartbeat("repB:1") is False
+    assert len([f for f in plan.fired if f[2] == "drop"]) == 4
+
+
+def test_faultplan_kill_task_on_nth_event():
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    try:
+        plan = FaultPlan([Fault("kill_task", "scheduler.dispatch", nth=3,
+                                victim="w:0")], seed=0)
+        plan.observe_launch("w:0", "tid-1", proc.pid)
+        plan.event("scheduler.dispatch")
+        plan.event("scheduler.dispatch")
+        assert proc.poll() is None
+        plan.event("scheduler.dispatch")
+        assert proc.wait(timeout=10.0) == -signal.SIGKILL
+        assert ("scheduler.dispatch", "", "kill_task", 3) in plan.fired
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_faultplan_target_counts_cumulative_across_keys():
+    """A target-filtered fault owns ONE counter over every key its
+    substring matches: "the 2nd worker launch" is the 2nd launch of any
+    worker — and it fires exactly once, not once per matching key."""
+    plan = FaultPlan([Fault("drop", "backend.launch", nth=2,
+                            target="worker")], seed=0)
+    assert plan.event("backend.launch", key="worker:0") == []
+    assert plan.event("backend.launch", key="ps:0") == []       # no match
+    due = plan.event("backend.launch", key="worker:1")          # 2nd match
+    assert [f.action for f in due] == ["drop"]
+    assert plan.event("backend.launch", key="worker:0") == []   # spent
+    assert [f for f in plan.fired if f[2] == "drop"] == \
+        [("backend.launch", "worker:1", "drop", 2)]
+
+
+def test_faultplan_seeded_delays_deterministic():
+    draws = [FaultPlan([Fault("delay", "wire.send", delay_s=None)],
+                       seed=42).faults[0].delay_s for _ in range(2)]
+    assert draws[0] == draws[1]
+
+
+# ---------------------------------------------------------------------------
+# Wire chaos: sever / delay / truncate / drop on live connections
+
+
+def _tcp_pair():
+    listen = wire.bind_ephemeral("127.0.0.1")
+    client = wire.connect(wire.sock_addr(listen, advertise_host="127.0.0.1"))
+    server, _ = listen.accept()
+    listen.close()
+    return client, server
+
+
+def test_wire_chaos_delay_then_sever():
+    client, server = _tcp_pair()
+    plan = FaultPlan([Fault("delay", "wire.send", nth=1, delay_s=0.2),
+                      Fault("sever", "wire.send", nth=2)], seed=1)
+    try:
+        with plan.installed():
+            t0 = time.monotonic()
+            wire.send_msg(client, {"x": 1}, "tok")      # delayed, delivered
+            assert time.monotonic() - t0 >= 0.2
+            assert wire.recv_msg(server, "tok") == {"x": 1}
+            with pytest.raises(OSError, match="severed"):
+                wire.send_msg(client, {"x": 2}, "tok")
+        # The peer observes a clean EOF mid-stream.
+        with pytest.raises(wire.WireError, match="closed"):
+            wire.recv_msg(server, "tok")
+    finally:
+        for s in (client, server):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_wire_chaos_truncate_and_drop():
+    client, server = _tcp_pair()
+    plan = FaultPlan([Fault("drop", "wire.send", nth=1),
+                      Fault("truncate", "wire.send", nth=3)], seed=2)
+    try:
+        with plan.installed():
+            wire.send_msg(client, "lost", "t")          # dropped: never sent
+            wire.send_msg(client, "kept", "t")
+            assert wire.recv_msg(server, "t") == "kept"
+            with pytest.raises(OSError, match="truncated"):
+                wire.send_msg(client, {"big": "x" * 4096}, "t")
+        # The receiver sees a partial frame then EOF — framing detects it.
+        with pytest.raises(wire.WireError, match="mid-frame"):
+            wire.recv_msg(server, "t")
+    finally:
+        for s in (client, server):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_wire_chaos_uninstall_restores_plain_path():
+    plan = FaultPlan([Fault("sever", "wire.send", nth=1)], seed=3)
+    plan.install()
+    plan.uninstall()
+    client, server = _tcp_pair()
+    try:
+        wire.send_msg(client, "fine", "t")
+        assert wire.recv_msg(server, "t") == "fine"
+    finally:
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Registry chaos: dropped heartbeats decay liveness; resumed beats revive
+
+
+def test_registry_heartbeat_drop_decays_then_revives():
+    from tfmesos_tpu.fleet.registry import ALIVE, DEAD, ReplicaRegistry
+
+    plan = FaultPlan([Fault("drop", "registry.heartbeat", nth=3, count=40,
+                            target="10.9.9.9")], seed=4)
+    reg = ReplicaRegistry(token="t", suspect_after=0.25, dead_after=0.6,
+                          evict_after=600.0, sweep_interval=0.05,
+                          chaos=plan).start()
+    stop = threading.Event()
+
+    def beat():
+        sock = wire.connect(reg.addr)
+        try:
+            while not stop.is_set():
+                wire.send_msg(sock, {"op": "heartbeat", "addr": "10.9.9.9:1",
+                                     "capacity": 4}, "t")
+                stop.wait(0.05)
+        except OSError:
+            pass
+        finally:
+            sock.close()
+
+    t = threading.Thread(target=beat, daemon=True)
+    t.start()
+
+    def state():
+        snap = {r["addr"]: r["state"] for r in reg.snapshot()}
+        return snap.get("10.9.9.9:1")
+
+    def wait_state(want, timeout=30.0):  # generous: CI hosts contend
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if state() == want:
+                return True
+            time.sleep(0.02)
+        return False
+
+    try:
+        assert wait_state(ALIVE), state()          # first 2 beats arrive
+        # Beats 3..42 dropped (~2s of silence) -> draining -> dead.
+        assert wait_state(DEAD), state()
+        # The fault window ends; beats arrive again -> revived, no
+        # operator action (the registry contract).
+        assert wait_state(ALIVE), state()
+    finally:
+        stop.set()
+        t.join(timeout=2.0)
+        reg.stop()
+    drops = [f for f in plan.fired if f[2] == "drop"]
+    assert len(drops) == 40
+
+
+# ---------------------------------------------------------------------------
+# Elastic gang recovery + generation fencing (in-process, FakeBackend)
+
+
+class GenFakeBackend(FakeBackend):
+    """Handshaking fake backend whose simulated tasks are generation-aware:
+    they register with the TPUMESOS_GENERATION their launch env carried and
+    stamp every Mode-A reply with the broadcast generation — the real node
+    runtime's contract (server.py).  ``stale_reply_next`` makes each task
+    prepend one zombie reply (gen - 1, SAME call id) to its next result, the
+    exact frame a surviving task of a torn-down gang would flush."""
+
+    def __init__(self):
+        super().__init__(handshake=False)
+        self.stale_reply_next = False
+
+    def launch(self, offer, task_infos):
+        self.launched.append(
+            (offer.id, [i["task_id"]["value"] for i in task_infos]))
+        for info in task_infos:
+            t = threading.Thread(target=self._gen_task, args=(info,),
+                                 daemon=True)
+            t.start()
+            self.threads.append(t)
+
+    def _gen_task(self, info):
+        env = {v["name"]: v["value"]
+               for v in info["command"]["environment"]["variables"]}
+        gen = int(env.get("TPUMESOS_GENERATION", "0"))
+        task_id = info["task_id"]["value"]
+        try:
+            sock = wire.connect(self.scheduler.addr)
+            wire.send_msg(sock, {"op": "register", "task_id": task_id,
+                                 "addr": "127.0.0.1:9999", "coord_port": 1,
+                                 "gen": gen}, self.scheduler.token)
+            config = wire.recv_msg(sock, self.scheduler.token)
+            wire.send_msg(sock, "ok", self.scheduler.token)
+            bgen = int(config.get("generation", 0))
+            assert bgen == gen, (bgen, gen)
+            while True:
+                msg = wire.recv_msg(sock, self.scheduler.token)
+                if not isinstance(msg, dict) or msg.get("op") == "shutdown":
+                    return
+                if msg.get("op") != "run":
+                    continue
+                if self.stale_reply_next:
+                    wire.send_msg(sock, {"op": "result",
+                                         "call_id": msg["call_id"],
+                                         "gen": bgen - 1, "ok": True,
+                                         "value": "zombie"},
+                                  self.scheduler.token)
+                wire.send_msg(sock, {"op": "result",
+                                     "call_id": msg["call_id"], "gen": bgen,
+                                     "ok": True,
+                                     "value": f"g{bgen}r{config['rank']}"},
+                              self.scheduler.token)
+        except (OSError, wire.WireError):
+            return
+
+
+def _offer(cpus=16.0):
+    return Offer(id=f"o{time.monotonic_ns()}", agent_id="agent-x",
+                 hostname="h", cpus=cpus, mem=8192.0, chips=0)
+
+
+def _start_elastic(num=2, **kw):
+    """A started elastic Mode-A cluster over GenFakeBackend, with an offer
+    feeder that keeps re-placing unoffered tasks (as a live master would).
+    Returns (scheduler, backend, stop_feeding)."""
+    backend = GenFakeBackend()
+    kw.setdefault("max_cluster_restarts", 3)
+    s = TPUMesosScheduler([Job(name="worker", num=num, cpus=1.0, mem=10.0)],
+                          backend=backend, quiet=True, start_timeout=15.0,
+                          restart_policy="elastic", restart_backoff=0.02,
+                          restart_backoff_max=0.1, restart_jitter=0.0,
+                          restart_seed=0, **kw)
+    stop = threading.Event()
+
+    def feed():
+        while not stop.is_set():
+            try:
+                if (s.addr and s.addr != "127.0.0.1:0"
+                        and any(not t.offered for t in s.tasks)):
+                    s.on_offers([_offer()])
+            except Exception:       # pragma: no cover - defensive
+                pass
+            time.sleep(0.01)
+
+    threading.Thread(target=feed, daemon=True).start()
+    s.start()
+    return s, backend, stop
+
+
+def _fail_current_task(s, idx=0):
+    with s._lock:
+        tid = s.tasks[idx].id
+    s.on_status(TaskStatus(tid, "TASK_FAILED", message="injected failure"))
+
+
+def test_elastic_recovery_reforms_gang_and_bumps_generation():
+    s, b, stop = _start_elastic()
+    try:
+        assert s.run_all("tests.whatever:ignored") == ["g0r0", "g0r1"]
+        old_ids = [t.id for t in s.tasks]
+        _fail_current_task(s, 0)
+        assert s.wait_ready(timeout=30.0)
+        # New gang: fresh generation, fresh task identities, every old
+        # task killed during teardown, config re-broadcast (the fake
+        # tasks assert broadcast gen == launch-env gen themselves).
+        assert s.generation == 1
+        assert s.cluster_restarts == 1
+        assert all(t.id not in old_ids for t in s.tasks)
+        assert set(old_ids) <= set(b.killed)
+        assert s.run_all("tests.whatever:ignored") == ["g1r0", "g1r1"]
+        assert s.restart_stats["recovering"] is False
+    finally:
+        stop.set()
+        s.stop()
+
+
+def test_stale_generation_reply_dropped_never_matched():
+    s, b, stop = _start_elastic()
+    try:
+        _fail_current_task(s, 1)
+        assert s.wait_ready(timeout=30.0)
+        # Every task now prepends a zombie (gen-1, SAME call id) reply to
+        # its real one: the fence must drop the zombies and match only
+        # the current-generation replies.
+        b.stale_reply_next = True
+        assert s.run_all("tests.whatever:ignored") == ["g1r0", "g1r1"]
+        b.stale_reply_next = False
+        # The channel is still clean afterwards (no desync poisoning).
+        assert s.run_all("tests.whatever:ignored") == ["g1r0", "g1r1"]
+    finally:
+        stop.set()
+        s.stop()
+
+
+def test_second_death_in_teardown_window_does_not_revive():
+    """One host loss reports once per task: deaths arriving after a
+    recovery was accepted but before teardown must be ignored — the
+    pre-start revive path would relaunch the gang with zero backoff
+    (for teardown to kill again) and charge the bring-up budget for
+    deaths that already bought the recovery."""
+    s, b, stop = _start_elastic()
+    try:
+        # Hold the scheduler lock so the recovery thread cannot tear
+        # down between the two status deliveries — the window under test.
+        with s._lock:
+            t0, t1 = s.tasks[0].id, s.tasks[1].id
+            s.on_status(TaskStatus(t0, "TASK_FAILED", message="first"))
+            assert s._recovering and not s._recover_teardown_done
+            base_revives = b.revive_count
+            s.on_status(TaskStatus(t1, "TASK_KILLED",
+                                   message="same incident"))
+            assert b.revive_count == base_revives   # no revive issued
+            assert s.task_failure_count == {}       # no bring-up charge
+            assert s.tasks[1].id == t1              # not reset here
+        assert s.wait_ready(timeout=30.0)
+        assert s.cluster_restarts == 1 and s.generation == 1
+    finally:
+        stop.set()
+        s.stop()
+
+
+def test_restart_budget_recharges_after_window():
+    """restart_stats must expire window-aged restarts — a burst long ago
+    does not keep the budget reading exhausted forever."""
+    s, b, stop = _start_elastic(max_cluster_restarts=2, restart_window=0.6)
+    try:
+        _fail_current_task(s, 0)
+        assert s.wait_ready(timeout=30.0)
+        assert s.restart_stats["restart_budget_left"] == 1
+        time.sleep(0.8)                 # the restart ages out of the window
+        assert s.restart_stats["restart_budget_left"] == 2
+    finally:
+        stop.set()
+        s.stop()
+
+
+def test_registry_drain_not_counted_as_heartbeat():
+    """'drain' is operator intent, not liveness: it must neither consume
+    a heartbeat fault's count nor be swallowed by one; 'hello' counts as
+    the first beat."""
+    from tfmesos_tpu.fleet.registry import DRAINING, ReplicaRegistry
+
+    plan = FaultPlan([Fault("drop", "registry.heartbeat", nth=2,
+                            target="r1")], seed=0)
+    reg = ReplicaRegistry(token="t", chaos=plan)    # not started: direct
+    a, peer = socket.socketpair()
+    try:
+        assert reg._on_msg({"op": "hello", "addr": "r1:1"}, a) == "r1:1"
+        assert reg._on_msg({"op": "drain", "addr": "r1:1"}, a) == "r1:1"
+        assert reg.snapshot()[0]["state"] == DRAINING
+        # Beat 2 (not 3 — the drain did not count) is the dropped one,
+        # so the drain's effect survives it.
+        assert reg._on_msg({"op": "heartbeat", "addr": "r1:1"}, a) is None
+        assert reg.snapshot()[0]["state"] == DRAINING
+        assert reg._on_msg({"op": "heartbeat", "addr": "r1:1"}, a) == "r1:1"
+        assert reg.snapshot()[0]["state"] == "alive"
+    finally:
+        a.close()
+        peer.close()
+
+
+def test_stale_generation_registration_dropped():
+    backend = FakeBackend()
+    s = TPUMesosScheduler([Job(name="worker", num=1, cpus=1.0, mem=10.0)],
+                          backend=backend, quiet=True,
+                          restart_policy="elastic")
+    s.generation = 3
+    a, peer = socket.socketpair()
+    try:
+        claimed = s._handle_register(a, {"op": "register",
+                                         "task_id": s.tasks[0].id,
+                                         "addr": "127.0.0.1:9", "gen": 2})
+        assert claimed is True              # connection consumed...
+        assert not s.tasks[0].initialized   # ...but the task NOT adopted
+        assert s.tasks[0].connection is None
+    finally:
+        peer.close()
+    b2, peer2 = socket.socketpair()
+    try:
+        claimed = s._handle_register(b2, {"op": "register",
+                                          "task_id": s.tasks[0].id,
+                                          "addr": "127.0.0.1:9",
+                                          "coord_port": 1, "gen": 3})
+        assert claimed is True
+        assert s.tasks[0].initialized       # current generation: adopted
+    finally:
+        b2.close()
+        peer2.close()
+
+
+def test_restart_budget_exhausted_goes_fatal():
+    s, b, stop = _start_elastic(max_cluster_restarts=2, restart_window=600.0)
+    try:
+        for expect in (1, 2):
+            _fail_current_task(s, 0)
+            assert s.wait_ready(timeout=30.0)
+            assert s.cluster_restarts == expect
+        assert s.restart_stats["restart_budget_left"] == 0
+        # The third post-start failure inside the window must go fatal —
+        # a crash loop is a problem restarts cannot fix.
+        _fail_current_task(s, 0)
+        with pytest.raises(ClusterError, match="budget exhausted"):
+            s.finished()
+        with pytest.raises(ClusterError):
+            s.run_all("tests.whatever:ignored")
+        assert s.generation == 2            # no third generation was formed
+    finally:
+        stop.set()
+        s.stop()
+
+
+def test_fail_fast_policy_unchanged_by_default():
+    """The reference policy survives: without restart_policy="elastic" a
+    post-start death is fatal, never a recovery."""
+    backend = FakeBackend()
+    s = TPUMesosScheduler([Job(name="worker", num=2, cpus=1.0, mem=10.0)],
+                          backend=backend, quiet=True)
+    s.addr = "127.0.0.1:0"
+    backend.start(s)
+    s.on_offers([_offer()])
+    s.started = True
+    _fail_current_task(s, 0)
+    with pytest.raises(ClusterError, match="terminated after cluster start"):
+        s.finished()
+    assert s.generation == 0 and s.cluster_restarts == 0
+
+
+def test_dispatch_during_recovery_raises_retryable_cluster_error():
+    s, b, stop = _start_elastic()
+    try:
+        with s._lock:
+            s._request_recovery("test: hold the gang down")
+        # Mid-recovery dispatches fail fast with a descriptive error (the
+        # driver's cue to wait_ready() + restore), not a hang.
+        with pytest.raises(ClusterError, match="re-forming"):
+            s.run_all("tests.whatever:ignored")
+        assert s.wait_ready(timeout=30.0)
+        assert s.run_all("tests.whatever:ignored") == ["g1r0", "g1r1"]
+    finally:
+        stop.set()
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# End to end on real subprocesses: the headline property
+
+
+@pytest.mark.slow
+def test_e2e_kill_recover_resume_reaches_uninterrupted_loss(tmp_path):
+    """THE chaos property, nothing simulated: a seeded FaultPlan SIGKILLs
+    a worker mid-run; the elastic scheduler re-forms the gang on its own
+    (no driver-side re-bring-up); the driver resumes from its last
+    checkpoint; the final loss and weights EQUAL an uninterrupted run's,
+    bit for bit."""
+    import support_funcs
+    from tfmesos_tpu import Job as TJob, cluster
+    from tfmesos_tpu.backends.local import LocalBackend
+    from tfmesos_tpu.train.checkpoint import CheckpointManager
+
+    total, kill_at_dispatch = 6, 4
+    plan = FaultPlan([Fault("kill_task", "scheduler.dispatch",
+                            nth=kill_at_dispatch, victim="worker:1")], seed=7)
+    recovered = 0
+    out = None
+    with cluster(TJob(name="worker", num=2, cpus=0.5, mem=64.0),
+                 backend=LocalBackend(chaos=plan), quiet=True,
+                 start_timeout=120.0, extra_config={"no_jax": True},
+                 restart_policy="elastic", max_cluster_restarts=3,
+                 restart_backoff=0.05, restart_jitter=0.0, restart_seed=0,
+                 chaos=plan) as c:
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        try:
+            w = np.zeros((16, 4), np.float32).tolist()
+            chunk = 0
+            while chunk < total:
+                try:
+                    out = c.run("support_funcs:train_chunk_numpy",
+                                {"w": w}, 3, 0.1, 1000 + chunk)
+                except ClusterError:
+                    # The gang is re-forming underneath us: wait, then
+                    # resume from the last SAVED step — in-memory progress
+                    # since that save is deliberately discarded, like a
+                    # driver that itself restarted.
+                    assert c.wait_ready(timeout=120.0)
+                    recovered += 1
+                    restored = mgr.restore(
+                        {"w": np.zeros((16, 4), np.float32),
+                         "chunk": np.asarray(0)})
+                    assert restored is not None
+                    w = np.asarray(restored["w"], np.float32).tolist()
+                    chunk = int(restored["chunk"])
+                    continue
+                w = out["w"]
+                chunk += 1
+                mgr.save(chunk, {"w": np.asarray(w, np.float32),
+                                 "chunk": np.asarray(chunk)})
+        finally:
+            mgr.close()
+        stats = c.restart_stats
+    assert recovered == 1
+    assert stats["generation"] == 1 and stats["cluster_restarts"] == 1
+    assert ("scheduler.dispatch", str(kill_at_dispatch), "kill_task",
+            kill_at_dispatch) in plan.fired
+    # The uninterrupted reference: identical math, no cluster, no faults.
+    w_ref = np.zeros((16, 4), np.float32).tolist()
+    ref = None
+    for chunk in range(total):
+        ref = support_funcs.train_chunk_numpy(None, {"w": w_ref}, 3, 0.1,
+                                              1000 + chunk)
+        w_ref = ref["w"]
+    assert out["loss"] == ref["loss"]
+    assert out["w"] == ref["w"]
+
+
+@pytest.mark.slow
+def test_e2e_mode_b_elastic_relaunch(tmp_path):
+    """Elastic recovery for between-graph (cmd) clusters: SIGKILL one
+    generation-0 worker; the scheduler relaunches the WHOLE gang with a
+    bumped TPUMESOS_GENERATION (the workload's cue to resume from its own
+    checkpoint), and finished() spans the recovery."""
+    from tfmesos_tpu import Job as TJob, cluster
+    from tfmesos_tpu.backends.local import LocalBackend
+
+    plan = FaultPlan([], seed=0)        # used only as the pid directory
+    cmd = (sys.executable + " -c \"import os,time; "
+           "time.sleep(600 if os.environ.get('TPUMESOS_GENERATION','0')"
+           "=='0' else 0)\"")
+    with cluster(TJob(name="worker", num=2, cpus=0.5, mem=64.0, cmd=cmd),
+                 backend=LocalBackend(chaos=plan), quiet=True,
+                 start_timeout=120.0, restart_policy="elastic",
+                 max_cluster_restarts=3, restart_backoff=0.05,
+                 restart_jitter=0.0, restart_seed=0) as c:
+        pid = plan.pid("worker:1")
+        assert pid is not None
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.monotonic() + 90.0
+        while not c.finished():         # False throughout the recovery
+            assert time.monotonic() < deadline, "gang never re-finished"
+            time.sleep(0.05)
+        assert c.generation == 1
+        assert c.cluster_restarts == 1

@@ -107,6 +107,106 @@ def test_training_resumes_from_checkpoint_across_restarts(tmp_path):
     assert np.isfinite(result.value)
 
 
+def test_supervise_training_resumes_from_checkpoint(tmp_path):
+    """The checkpoint-coordinated supervisor: a 30-step job dying at step
+    13 on its first attempt resumes from the last save (step 10), re-runs
+    only 20 steps, and — because the default skip-ahead realigns the data
+    stream — finishes with EXACTLY the loss of an uninterrupted run."""
+    from tfmesos_tpu.train.supervisor import supervise_training
+    from tfmesos_tpu.train.trainer import TrainLoop, TrainState
+
+    cfg = mlp.MLPConfig(in_dim=16, hidden=8, n_classes=4)
+    ds = datalib.SyntheticMNIST(n_classes=4, dim=16)
+    opt = optax.sgd(0.1)
+    total_steps, fail_at_draw = 30, 13
+    draws = {}          # attempt -> raw data indices drawn (skip + train)
+
+    def build(attempt, fail=True):
+        params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+        step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt)
+        loop = TrainLoop(step_fn=step,
+                         state=TrainState(params, opt.init(params)),
+                         log_every=1000)
+        gen = ds.batches(32, seed=7)
+        seen = draws.setdefault(attempt, [])
+
+        def batches():
+            # Count every raw draw; die deterministically mid-run on the
+            # first attempt (the 14th batch = after 13 optimizer steps,
+            # 3 past the last save).
+            for n, batch in enumerate(gen):
+                if fail and attempt == 0 and n == fail_at_draw:
+                    raise ClusterError("simulated task death")
+                seen.append(n)
+                yield batch
+
+        return loop, batches()
+
+    mgr = CheckpointManager(str(tmp_path / "sup"))
+    try:
+        r = supervise_training(build, total_steps, mgr, save_every=10,
+                               max_restarts=2, restart_wait=0.01)
+    finally:
+        mgr.close()
+    assert r.attempts == 2 and r.restarts == 1
+    assert r.resumed_steps == [0, 10]
+    assert r.result["start_step"] == 10
+    assert r.result["final_step"] == total_steps
+    assert r.result["restores"] == 1 and r.result["resumed_step"] == 10
+    # Attempt 0 trained on batches 0..12; attempt 1 skipped 0..9 ahead and
+    # trained on 10..29 — steps 11..13 recomputed (past the last save),
+    # none skipped, and the stream stayed aligned step-for-step.
+    assert draws[0] == list(range(13))
+    assert draws[1] == list(range(30))
+
+    # Exact-resume check: an uninterrupted run over the same data reaches
+    # the same loss (the default skip_batches hook realigned the stream).
+    mgr2 = CheckpointManager(str(tmp_path / "ref"))
+    try:
+        ref = supervise_training(
+            lambda a: build(a, fail=False), total_steps, mgr2,
+            save_every=10, max_restarts=0, restart_wait=0.01)
+    finally:
+        mgr2.close()
+    assert ref.restarts == 0 and ref.resumed_steps == [0]
+    assert (r.result["final_metrics"]["loss"]
+            == ref.result["final_metrics"]["loss"])
+
+
+def test_supervise_training_already_complete_is_noop(tmp_path):
+    """A checkpoint at (or past) total_steps runs zero further steps —
+    restarting a finished job must not retrain it."""
+    from tfmesos_tpu.train.supervisor import supervise_training
+    from tfmesos_tpu.train.trainer import TrainLoop, TrainState
+
+    cfg = mlp.MLPConfig(in_dim=16, hidden=8, n_classes=4)
+    ds = datalib.SyntheticMNIST(n_classes=4, dim=16)
+    opt = optax.sgd(0.1)
+
+    def build(attempt):
+        params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+        step = make_train_step(lambda p, b: mlp.loss_fn(cfg, p, b), opt)
+        return (TrainLoop(step_fn=step,
+                          state=TrainState(params, opt.init(params)),
+                          log_every=1000),
+                ds.batches(32, seed=7))
+
+    mgr = CheckpointManager(str(tmp_path / "done"))
+    try:
+        supervise_training(build, 4, mgr, save_every=2, max_restarts=0,
+                           restart_wait=0.01)
+        drawn = []
+        loop, batches = build(0)
+        gen = (drawn.append(1) or b for b in batches)
+        r2 = supervise_training(lambda a: (loop, gen), 4, mgr,
+                                max_restarts=0, restart_wait=0.01)
+    finally:
+        mgr.close()
+    assert r2.resumed_steps == [4]
+    assert r2.result["final_step"] == 4
+    assert drawn == []                  # not a single batch consumed
+
+
 def test_end_to_end_kill_restart_resume(tmp_path):
     """The scenario the supervisor exists for, with nothing simulated: a real
     LocalBackend cluster trains via dispatched chunks while the driver

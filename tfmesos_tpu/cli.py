@@ -74,6 +74,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "TASK_FAILED; bring-up already retries placement 3x "
                         "per attempt). Pair with workload checkpoints for "
                         "resume. Default 0 = fail fast like the reference")
+    p.add_argument("--restart-policy", choices=["fail_fast", "elastic"],
+                   default="fail_fast", dest="restart_policy",
+                   help="post-start failure policy: fail_fast aborts the "
+                        "whole cluster on any task death (the reference "
+                        "behavior); elastic tears down survivors, bumps "
+                        "the gang generation, re-forms from fresh offers "
+                        "with backoff, and re-broadcasts cluster_def — "
+                        "tasks restart their command and should resume "
+                        "from their own checkpoints "
+                        "(docs/FAULT_TOLERANCE.md)")
+    p.add_argument("--max-cluster-restarts", type=int, default=3,
+                   dest="max_cluster_restarts",
+                   help="elastic restart budget: at most N gang "
+                        "re-formations per sliding --restart-window, then "
+                        "fatal (crash loops are a problem restarts cannot "
+                        "fix)")
+    p.add_argument("--restart-window", type=float, default=600.0,
+                   dest="restart_window",
+                   help="seconds of sliding window the elastic restart "
+                        "budget counts over")
     p.add_argument("--mesh", type=str, default=None,
                    help="explicit mesh axes, e.g. dp=4,tp=2; prefix an axis "
                         "with dcn. to span pod slices over the data-center "
@@ -330,6 +350,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                      forward_addresses=forward,
                      extra_config=extra_config, role=args.role,
                      gang_scheduling=args.gang,
+                     restart_policy=args.restart_policy,
+                     max_cluster_restarts=args.max_cluster_restarts,
+                     restart_window=args.restart_window,
                      mesh_axes=mesh_axes) as c:
             while not c.finished():
                 collector.pump(timeout=0.1)

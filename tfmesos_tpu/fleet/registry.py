@@ -58,7 +58,7 @@ class ReplicaRegistry:
     def __init__(self, token: str = "", host: str = "127.0.0.1",
                  suspect_after: float = 1.5, dead_after: float = 3.0,
                  evict_after: float = 10.0, sweep_interval: float = 0.2,
-                 metrics=None):
+                 metrics=None, chaos=None):
         self.token = token
         self.host = host
         self.suspect_after = float(suspect_after)
@@ -66,6 +66,9 @@ class ReplicaRegistry:
         self.evict_after = float(evict_after)
         self.sweep_interval = float(sweep_interval)
         self.metrics = metrics
+        # Optional chaos.FaultPlan: consulted per heartbeat so tests can
+        # drop beats (simulated partitions) without touching the replica.
+        self.chaos = chaos
         self.log = get_logger("tfmesos_tpu.fleet.registry")
         self.addr: Optional[str] = None
         self._listen: Optional[socket.socket] = None
@@ -156,6 +159,13 @@ class ReplicaRegistry:
         if not addr or op not in ("hello", "heartbeat", "drain"):
             self.log.warning("unexpected registry message: %r", msg)
             return None
+        # Beat-bearing messages only ("hello" IS the first beat — the
+        # table code below treats them identically); a "drain" is an
+        # operator intent, not liveness, and must neither count toward
+        # nor be swallowed by heartbeat faults.
+        if (op != "drain" and self.chaos is not None
+                and self.chaos.on_heartbeat(addr)):
+            return None         # chaos drop: the beat never arrived
         with self._lock:
             rep = self._table.get(addr)
             if op == "drain":

@@ -1387,7 +1387,12 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, li, positions,
         self_kv = None
         if defer:
             # int8 pools: quantize-dequantize the chunk so the self
-            # operand matches a committed slot bit for bit.
+            # operand matches a committed slot up to rounding — the
+            # kernel folds a committed slot's fp32 scale into the
+            # probability row post-dot, while the self operand rides in
+            # pre-multiplied, so the two orderings can differ in the
+            # last float ulp even though the int8 values and scales are
+            # identical.
             if isinstance(ck, QTensor):
                 from tfmesos_tpu.ops.quant import quantize_int8_reference
                 rq = lambda c: (lambda v_, s_: (v_.astype(cfg.dtype)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import functools
 import math
+import operator
 from typing import NamedTuple, Optional
 
 import jax
@@ -564,8 +565,7 @@ def _decode_accumulate(s, v_blk, acc, vs_row=None):
 
 
 def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
-                         scale: float, quantized: bool, q_per_kv: int,
-                         self_attend: bool = False):
+                         scale: float, quantized: bool, q_per_kv: int):
     """One (batch, kv-head, m-block) grid step of cache-bounded decode.
 
     The q block carries this kv head's rows for the WHOLE chunk, t-major:
@@ -590,18 +590,15 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
     (k: s·kscale after the dot; v: (p·vscale)·v_int8), so the cache
     streams from HBM at int8 width — the dequantize never touches HBM.
 
-    ``self_attend`` (deferred-write decode, t = 1): the CURRENT token's
-    K/V has not been committed to the cache — it arrives as a one-slot
-    fp operand pair accumulated into the online softmax at the last grid
-    step (the caller passes the EXCLUSIVE bound/position, so the stale
-    cache slot at the token's own position is never read).
+    Deferred-write decode (an uncommitted current token riding in as a
+    self operand) is a PAGED-path feature: only ``decode_step``'s paged
+    single-host steps defer their pool commit, so only
+    ``_flash_decode_paged_kernel`` carries the self block — the linear
+    cache commits before attending and this kernel reads it directly.
     """
     it = list(rest)
     if quantized:
         ks_ref, vs_ref = it[0], it[1]
-        it = it[2:]
-    if self_attend:
-        kself_ref, vself_ref = it[0], it[1]
         it = it[2:]
     o_ref, o_acc, m_acc, l_acc = it
     bi = pl.program_id(0)
@@ -629,22 +626,10 @@ def _flash_decode_kernel(s_ref, q_ref, k_ref, v_ref, *rest, block_m: int,
             s, v_ref[0, 0, 0, :, :], (m_acc[...], l_acc[...], o_acc[...]),
             vs_ref[0, 0, 0, 0, :] if quantized else None)
 
-    if self_attend:
-        @pl.when(j == pl.num_programs(2) - 1)
-        def _self():
-            # The uncommitted current token: a one-slot fp block,
-            # accumulated like any other (always attended — a token
-            # sees its own position).
-            q = q_ref[0, 0, :, :]                   # [g, d] (t = 1)
-            s = _decode_block_scores(q, kself_ref[0, 0, :, :], scale)
-            m_acc[...], l_acc[...], o_acc[...] = _decode_accumulate(
-                s, vself_ref[0, 0, :, :],
-                (m_acc[...], l_acc[...], o_acc[...]))
-
     @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
         # Every row has at least one attended slot (block 0 holds
-        # position 0, or the self block contributes), so l > 0.
+        # position 0), so l > 0.
         o_ref[0, 0, :, :] = (o_acc[...] / l_acc[...]).astype(o_ref.dtype)
 
 
@@ -670,8 +655,17 @@ def _stacked_cache(k_cache, v_cache, layer):
     ks = k_cache.scales if quantized else None
     vs = v_cache.scales if quantized else None
     if kc.ndim == 4:
-        if layer is not None and not (isinstance(layer, int) and layer == 0):
-            raise ValueError("layer index needs a stacked 5-D cache")
+        # Any STATICALLY-zero index is fine with an L=1 lift (python int,
+        # numpy int32, 0-d concrete array — operator.index normalizes
+        # them all); only a nonzero or traced index actually needs the
+        # stacked form.
+        if layer is not None:
+            try:
+                layer = operator.index(layer)
+            except TypeError:
+                layer = None    # traced: cannot prove it selects layer 0
+            if layer != 0:
+                raise ValueError("layer index needs a stacked 5-D cache")
         kc, vc = kc[None], vc[None]
         if quantized:
             ks, vs = ks[None], vs[None]
@@ -860,8 +854,12 @@ def _flash_decode_paged_kernel(s_ref, pt_ref, q_ref, k_ref, v_ref, *rest,
     pool pages and rows share one physical pool; ``s_ref`` rows are
     (n_live_blocks, position bound, layer index), as in
     ``_flash_decode_kernel``, whose per-head math (including the
-    quantized scale folds and the deferred-write ``self_attend`` block)
-    this kernel reproduces slice for slice."""
+    quantized scale folds) this kernel reproduces slice for slice — plus
+    the deferred-write ``self_attend`` block, a paged-only feature: the
+    uncommitted current token's K/V rides in as a one-slot fp operand
+    accumulated at the last grid step (the caller passes the EXCLUSIVE
+    bound/position, so the stale pool slot at the token's own position
+    is never read)."""
     del pt_ref  # consumed by the index maps
     it = list(rest)
     ks_ref = vs_ref = kself_ref = vself_ref = None

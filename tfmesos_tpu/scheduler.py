@@ -15,6 +15,18 @@ This is the analogue of the reference's ``TFMesosScheduler``
   to ``MAX_FAILURE_COUNT`` before the cluster starts (scheduler.py:404-434),
   fail-fast after (scheduler.py:394-401) — the right policy for a TPU mesh,
   which cannot hot-swap members mid-program.
+* ``restart_policy="elastic"`` upgrades the post-start half: instead of
+  aborting the job on a task death or agent loss, the scheduler tears down
+  the survivors, bumps a cluster **generation** id, re-forms the whole gang
+  from fresh offers (exponential backoff + jitter, a sliding-window restart
+  budget before going fatal after all) and re-broadcasts ``cluster_def``.
+  A TPU mesh still cannot hot-swap members mid-program — elasticity here is
+  whole-gang replacement, the TF-Replicator/production-trainer baseline of
+  "workers restart and resume from checkpoint", not pretend PS elasticity.
+  The generation id is fenced through the wire protocol: registrations and
+  Mode-A replies carry it, and stale-generation messages from zombie tasks
+  of a previous gang are logged and dropped, never matched to current state
+  (see docs/FAULT_TOLERANCE.md).
 * ``gang_scheduling=True`` additionally makes placement all-or-nothing across
   an offer batch, matching TPU slice atomicity (a slice's topology fixes the
   process count; partial bring-up is useless).
@@ -22,8 +34,10 @@ This is the analogue of the reference's ``TFMesosScheduler``
 
 from __future__ import annotations
 
+import collections
 import getpass
 import os
+import random
 import selectors
 import socket
 import sys
@@ -70,7 +84,15 @@ class TPUMesosScheduler:
                  gang_scheduling: bool = False,
                  start_timeout: float = 300.0,
                  token_transport: Optional[str] = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 restart_policy: str = "fail_fast",
+                 max_cluster_restarts: int = 3,
+                 restart_window: float = 600.0,
+                 restart_backoff: float = 1.0,
+                 restart_backoff_max: float = 30.0,
+                 restart_jitter: float = 0.1,
+                 restart_seed: Optional[int] = None,
+                 chaos=None):
         self.task_spec = task_spec
         self.master = master or os.environ.get("MESOS_MASTER")
         # Default framework name mirrors scheduler.py:189-190.
@@ -87,6 +109,18 @@ class TPUMesosScheduler:
         self.gang_scheduling = gang_scheduling
         self.start_timeout = start_timeout
         self.env = dict(env or {})
+        if restart_policy not in ("fail_fast", "elastic"):
+            raise ValueError(f"restart_policy must be fail_fast|elastic, "
+                             f"got {restart_policy!r}")
+        self.restart_policy = restart_policy
+        self.max_cluster_restarts = int(max_cluster_restarts)
+        self.restart_window = float(restart_window)
+        self.restart_backoff = float(restart_backoff)
+        self.restart_backoff_max = float(restart_backoff_max)
+        self.restart_jitter = float(restart_jitter)
+        # Seedable jitter so fault-injection tests replay exactly.
+        self._restart_rng = random.Random(restart_seed)
+        self.chaos = chaos
 
         self.log = get_logger("tfmesos_tpu.scheduler", quiet=quiet)
         # One token per bring-up by default; an explicit ``token`` lets
@@ -151,6 +185,21 @@ class TPUMesosScheduler:
         self._listen: Optional[socket.socket] = None
         self.addr: Optional[str] = None
         self._call_id = 0
+
+        # Elastic recovery state.  ``generation`` is the gang epoch: it is
+        # stamped into every launch's env, echoed in registrations and
+        # Mode-A replies, and bumped the moment a recovery is accepted —
+        # the fencing token that keeps zombies of a dead gang from being
+        # mistaken for members of the current one.
+        self.generation = 0
+        self.cluster_restarts = 0           # successful re-formations
+        self._recovering = False
+        self._recover_teardown_done = False
+        self._recover_reason: Optional[str] = None
+        self._recover_event = threading.Event()
+        self._restart_times: collections.deque = collections.deque()
+        self._backoff_exponent = 0
+        self._elastic_thread: Optional[threading.Thread] = None
 
     # -- backend selection -------------------------------------------------
 
@@ -220,7 +269,7 @@ class TPUMesosScheduler:
                     infos = [t.to_task_info(offer, self.addr, self.token,
                                             containerizer_type=self.containerizer_type,
                                             force_pull_image=self.force_pull_image,
-                                            env=self.env,
+                                            env=self._launch_env(),
                                             token_file=self._token_file,
                                             secret_token=(self.token_transport
                                                           == "secret"))
@@ -314,9 +363,23 @@ class TPUMesosScheduler:
             elif self.started or self._broadcasting:
                 # Post-start (or mid-broadcast, when peers may already be
                 # acting on their config): fail fast, whole-cluster abort
-                # (reference: scheduler.py:394-401).
-                self._set_fatal(f"task {task} terminated after cluster start: "
-                                f"{status.state} {status.message}")
+                # (reference: scheduler.py:394-401) — unless the elastic
+                # policy turns this into a gang re-formation.
+                self._post_start_failure(
+                    f"task {task} terminated after cluster start: "
+                    f"{status.state} {status.message}")
+            elif self._recovering and not self._recover_teardown_done:
+                # Recovery accepted but the old gang not yet torn down:
+                # these are the expected deaths of that gang (one host
+                # loss reports once per task).  The pre-start revive path
+                # must NOT run here — it would relaunch tasks with zero
+                # backoff (for teardown to immediately kill) and charge
+                # the bring-up failure budget for deaths that already
+                # bought the recovery.  After teardown, old-gang statuses
+                # carry unknown (reset) ids and are ignored above; new-
+                # gang bring-up failures take the normal revive path.
+                self.log.info("ignoring terminal status for %s during "
+                              "gang teardown: %s", task, status.state)
             else:
                 # Pre-start: revive with a fresh uuid up to MAX_FAILURE_COUNT
                 # (reference: scheduler.py:404-434).
@@ -412,10 +475,11 @@ class TPUMesosScheduler:
             self._revive_backend("heartbeat")
 
     def on_agent_lost(self, agent_id: str) -> None:
-        """Reference slaveLost/executorLost (scheduler.py:445-453)."""
+        """Reference slaveLost/executorLost (scheduler.py:445-453); under
+        the elastic policy a lost agent triggers gang re-formation."""
         with self._lock:
             if self.started:
-                self._set_fatal(f"agent lost: {agent_id}")
+                self._post_start_failure(f"agent lost: {agent_id}")
                 return
             lost = [task.id for task in self.tasks
                     if task.agent_id == agent_id and not task.initialized]
@@ -431,6 +495,200 @@ class TPUMesosScheduler:
         if self._fatal is None:
             self._fatal = message
             self.log.error("fatal: %s", message)
+            # Unblock the elastic thread so it can observe the fatal and
+            # exit instead of waiting for a recovery that will never come.
+            self._recover_event.set()
+
+    # -- elastic recovery --------------------------------------------------
+
+    def _launch_env(self) -> Dict[str, str]:
+        """Per-launch env: the user's plus the current generation, so a
+        task knows which gang epoch launched it (it echoes the value in
+        its registration and every Mode-A reply — the fencing token)."""
+        env = dict(self.env)
+        env["TPUMESOS_GENERATION"] = str(self.generation)
+        return env
+
+    def _post_start_failure(self, why: str) -> None:
+        """A task/agent died after cluster start (lock held): fatal under
+        fail_fast (the reference policy), a recovery request under
+        elastic."""
+        if self.restart_policy != "elastic":
+            self._set_fatal(why)
+        else:
+            self._request_recovery(why)
+
+    def _charge_restart(self, why: str) -> bool:
+        """Spend one unit of the sliding-window restart budget (lock
+        held).  False — and the cluster is fatal — when the window already
+        holds ``max_cluster_restarts`` restarts: a crash loop faster than
+        the window is a real problem restarts cannot fix."""
+        now = time.monotonic()
+        while (self._restart_times
+               and now - self._restart_times[0] > self.restart_window):
+            self._restart_times.popleft()
+        if len(self._restart_times) >= self.max_cluster_restarts:
+            self._set_fatal(
+                f"elastic restart budget exhausted "
+                f"({self.max_cluster_restarts} restarts within "
+                f"{self.restart_window:.0f}s): {why}")
+            return False
+        self._restart_times.append(now)
+        self._backoff_exponent = len(self._restart_times) - 1
+        return True
+
+    def _request_recovery(self, why: str) -> None:
+        """Accept (at most once per incident) a post-start failure as a
+        recovery trigger: charge the budget, bump the generation, flip the
+        cluster un-started, and wake the recovery thread.  Idempotent
+        while a recovery is in flight — one host loss surfaces as many
+        signals (dispatch EOF, TASK_FAILED per task, agent-lost) and must
+        buy exactly one re-formation.  Lock held."""
+        if self._fatal or self._stopped or self._recovering:
+            return
+        if not self._charge_restart(why):
+            return
+        self._recovering = True
+        self._recover_teardown_done = False
+        self._recover_reason = why
+        self.started = False
+        self._broadcasting = False
+        self.generation += 1
+        self.log.warning("elastic recovery -> generation %d: %s",
+                         self.generation, why)
+        self._recover_event.set()
+
+    def _elastic_loop(self) -> None:
+        """The recovery thread: parked on ``_recover_event``, runs one
+        gang re-formation per accepted recovery request."""
+        while True:
+            self._recover_event.wait()
+            with self._lock:
+                if self._stopped or self._fatal is not None:
+                    return
+                self._recover_event.clear()
+                if not self._recovering:
+                    continue
+            try:
+                self._recover()
+            except Exception as e:      # pragma: no cover - defensive
+                with self._lock:
+                    self._set_fatal(f"elastic recovery crashed: {e}")
+                return
+
+    def _recover(self) -> None:
+        """Tear down the old gang and form a new one, retrying (each retry
+        re-charged against the restart budget) until the gang is up, the
+        budget is gone, or the scheduler stops."""
+        while True:
+            with self._lock:
+                if self._stopped or self._fatal is not None:
+                    return
+                backoff = min(
+                    self.restart_backoff * (2 ** self._backoff_exponent),
+                    self.restart_backoff_max)
+                backoff *= 1.0 + self.restart_jitter * self._restart_rng.random()
+                generation = self.generation
+            self.log.warning(
+                "elastic: tearing down generation %d survivors; re-forming "
+                "gang in %.2fs (restart %d)", generation - 1, backoff,
+                len(self._restart_times))
+            self._teardown_tasks()
+            with self._lock:
+                self._recover_teardown_done = True
+            if self._interruptible_sleep(backoff):
+                return
+            with self._lock:
+                if self._stopped or self._fatal is not None:
+                    return
+                # Fresh bring-up budgets for the new gang: the pre-start
+                # revive counter guards ONE bring-up; crash loops across
+                # generations are bounded by the cluster restart window.
+                self.task_failure_count.clear()
+                self.job_finished.clear()
+            self._revive_backend("elastic recovery")
+            try:
+                self._form_gang()
+            except ClusterError as e:
+                with self._lock:
+                    if self._stopped or self._fatal is not None:
+                        return
+                    if not self._charge_restart(f"gang re-formation failed: {e}"):
+                        return
+                self.log.warning("elastic: re-formation failed (%s); "
+                                 "retrying", e)
+                continue
+            with self._lock:
+                # _recovering was already cleared atomically with
+                # started=True inside _start_cluster.
+                self.cluster_restarts += 1
+            self.log.warning("elastic: gang re-formed — generation %d live "
+                             "(%d cluster restart(s) so far)",
+                             generation, self.cluster_restarts)
+            return
+
+    def _teardown_tasks(self) -> None:
+        """Reset every task to a fresh identity and kill whatever of the
+        old gang still runs.  Survivors of a partial failure cannot be
+        kept: the mesh program they were running is gone, and their old
+        connections/ids must never be confused with the new gang's."""
+        with self._lock:
+            old_ids = [t.id for t in self.tasks]
+            for task in self.tasks:
+                task.reset()        # closes the connection, fresh uuid
+        for tid in old_ids:
+            try:
+                self.backend.kill(tid)
+            except Exception as e:
+                self.log.warning("teardown kill of %s failed: %s", tid[:8], e)
+
+    def _interruptible_sleep(self, seconds: float) -> bool:
+        """Sleep in short slices; True when stop/fatal interrupted it."""
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._stopped or self._fatal is not None:
+                    return True
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+        return False
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the cluster is started and not mid-recovery.  True
+        when ready; False on timeout; raises :class:`ClusterError` when
+        the cluster went fatal (budget exhausted, bring-up dead).  The
+        driver-side pairing for elastic mode: catch the
+        :class:`ClusterError` a dispatch raised, ``wait_ready()``, restore
+        your checkpoint, and continue."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._fatal:
+                    raise ClusterError(self._fatal)
+                if self._stopped:
+                    raise ClusterError("scheduler stopped")
+                if self.started and not self._recovering:
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.05)
+
+    @property
+    def restart_stats(self) -> Dict[str, Any]:
+        """Observability counters for the elastic policy."""
+        with self._lock:
+            # Expire window-aged restarts so budget_left reflects what
+            # _charge_restart would actually allow right now.
+            now = time.monotonic()
+            while (self._restart_times
+                   and now - self._restart_times[0] > self.restart_window):
+                self._restart_times.popleft()
+            return {
+                "generation": self.generation,
+                "cluster_restarts": self.cluster_restarts,
+                "recovering": self._recovering,
+                "restart_budget_left": max(
+                    0, self.max_cluster_restarts - len(self._restart_times)),
+            }
 
     def _find_task(self, task_id: str) -> Optional[Task]:
         for task in self.tasks:
@@ -455,7 +713,21 @@ class TPUMesosScheduler:
                 f.write(self.token)
             self._token_file = path
         self.backend.start(self)
+        if self.restart_policy == "elastic":
+            self._elastic_thread = threading.Thread(
+                target=self._elastic_loop, name="elastic-recovery",
+                daemon=True)
+            self._elastic_thread.start()
+        try:
+            self._form_gang()
+        except Exception:
+            self.stop()
+            raise
 
+    def _form_gang(self) -> None:
+        """Run the rendezvous loop until every task registered, then
+        broadcast the cluster config — one gang formation, shared by the
+        initial bring-up and every elastic re-formation."""
         sel = selectors.DefaultSelector()
         sel.register(self._listen, selectors.EVENT_READ, ("accept", None, None))
         deadline = time.monotonic() + self.start_timeout
@@ -464,6 +736,8 @@ class TPUMesosScheduler:
                 with self._lock:
                     if self._fatal:
                         raise ClusterError(self._fatal)
+                    if self._stopped:
+                        raise ClusterError("scheduler stopped during bring-up")
                     if all(t.initialized for t in self.tasks):
                         break
                 if time.monotonic() > deadline:
@@ -501,9 +775,6 @@ class TPUMesosScheduler:
                         if self._handle_register(conn, msg):
                             sel.unregister(conn)
             self._start_cluster()
-        except Exception:
-            self.stop()
-            raise
         finally:
             sel.close()
 
@@ -517,6 +788,21 @@ class TPUMesosScheduler:
         if not (isinstance(msg, dict) and msg.get("op") == "register"):
             self.log.warning("unexpected rendezvous message: %r", msg)
             return False
+        gen = msg.get("gen")
+        if gen is not None:
+            # Generation fence: a zombie of a torn-down gang re-dialing
+            # the rendezvous must never be adopted into the current one.
+            try:
+                gen = int(gen)
+            except (TypeError, ValueError):
+                gen = -1
+            if gen != self.generation:
+                self.log.warning(
+                    "dropping stale-generation registration from task id %s "
+                    "(gen %s, current %d)", msg.get("task_id"), msg.get("gen"),
+                    self.generation)
+                conn.close()
+                return True
         task = self._find_task(msg.get("task_id", ""))
         if task is None:
             self.log.warning("registration from unknown/stale task id %s",
@@ -548,6 +834,7 @@ class TPUMesosScheduler:
             if any(conn is None for _, conn in conns):
                 raise ClusterError("task lost between registration and broadcast")
             cluster_def = self.cluster_def
+            generation = self.generation
 
         world_size = len(self.tasks)
         rank0 = self.tasks[0]
@@ -567,6 +854,7 @@ class TPUMesosScheduler:
                 "cmd": task.cmd,
                 "cwd": os.getcwd(),
                 "cluster_def": cluster_def,
+                "generation": generation,
                 "coordinator": coordinator,
                 "forward_addresses": self.forward_addresses,
                 "extra_config": self.extra_config,
@@ -599,9 +887,24 @@ class TPUMesosScheduler:
                 # still surfaces promptly as EOF/ECONNRESET.
                 conn.settimeout(None)
         with self._lock:
+            if not all(t.initialized for t in self.tasks):
+                # A terminal status raced the tail of the broadcast and
+                # reset a task (the pre-start revive path): this gang is
+                # not whole — better a loud formation failure (retried by
+                # elastic recovery, fatal on initial bring-up) than
+                # declaring a cluster started with a hole in it.
+                raise ClusterError("task lost during config broadcast")
             self.started = True
-        self.log.info("cluster started: %d task(s), coordinator %s",
-                      world_size, coordinator)
+            # Atomically with started=True: a recovery (if this formation
+            # was one) is over the instant the gang is live.  Clearing
+            # _recovering later (on the recovery thread) would leave a
+            # window where a new-gang death hits the post-start branch
+            # but _request_recovery still early-returns on the stale
+            # flag — the incident would be recorded nowhere.
+            self._recovering = False
+            self._recover_reason = None
+        self.log.info("cluster started: %d task(s), generation %d, "
+                      "coordinator %s", world_size, generation, coordinator)
 
     def _default_mesh_axes(self) -> Dict[str, int]:
         """North-star mapping (BASELINE.json / SURVEY §2.7): ps jobs in the
@@ -670,12 +973,21 @@ class TPUMesosScheduler:
 
     def _dispatch(self, func, args, kwargs, ranks) -> List[Any]:
         with self._lock:
-            if not self.started:
-                raise ClusterError("cluster not started")
             if self._fatal:
                 raise ClusterError(self._fatal)
+            if self._recovering:
+                raise ClusterError(
+                    f"cluster re-forming (generation {self.generation}): "
+                    f"{self._recover_reason}")
+            if not self.started:
+                raise ClusterError("cluster not started")
             self._call_id += 1
             call_id = self._call_id
+            generation = self.generation
+        if self.chaos is not None:
+            # Fault-injection trigger point: "kill task i at dispatch N"
+            # is the deterministic stand-in for a mid-training preemption.
+            self.chaos.event("scheduler.dispatch", key=str(call_id))
         spec = _func_spec(func)
         dispatchable = {rank: t for rank, t in enumerate(self.tasks)
                         if t.cmd is None and t.connection is not None}
@@ -694,24 +1006,28 @@ class TPUMesosScheduler:
             mode_a = [dispatchable[r] for r in ranks]  # request order
         if not mode_a:
             raise ClusterError("no in-graph (cmd=None) tasks to dispatch to")
-        msg = {"op": "run", "call_id": call_id, "func": spec,
-               "args": list(args), "kwargs": kwargs}
+        msg = {"op": "run", "call_id": call_id, "gen": generation,
+               "func": spec, "args": list(args), "kwargs": kwargs}
 
         def _fatal_dispatch(why: str) -> ClusterError:
             # A dead peer or desynchronized channel poisons the whole SPMD
             # dispatch path: survivors may hold queued frames for this
             # call_id with no resync protocol, and a partially-delivered
-            # collective would deadlock the mesh.  Mark the cluster fatal so
-            # finished()/run() fail fast and supervise() can restart it.
+            # collective would deadlock the mesh.  Fail-fast marks the
+            # cluster fatal so finished()/run() fail fast and supervise()
+            # can restart it; elastic turns the same signal into a gang
+            # re-formation (the caller still sees ClusterError for THIS
+            # call — it resumes after wait_ready()).
             with self._lock:
-                self._set_fatal(why)
+                self._post_start_failure(why)
             return ClusterError(why)
 
         task = None
         try:
             for task in mode_a:
                 wire.send_msg(task.connection, msg, self.token)
-            replies = self._drain_replies(mode_a, call_id, _fatal_dispatch)
+            replies = self._drain_replies(mode_a, call_id, generation,
+                                          _fatal_dispatch)
         except (OSError, wire.WireError) as e:
             raise _fatal_dispatch(
                 f"task {task} lost during dispatch: {e}") from e
@@ -726,13 +1042,16 @@ class TPUMesosScheduler:
             raise RemoteError("remote failure " + "\n".join(errors))
         return results
 
-    def _drain_replies(self, mode_a, call_id, _fatal_dispatch):
+    def _drain_replies(self, mode_a, call_id, generation, _fatal_dispatch):
         """Collect one reply per task, reading ALL connections concurrently.
 
         A blocking per-rank read would leave the caller stuck on a survivor
         (which may legitimately run for hours) while a dead peer's EOF goes
         unnoticed; a selector surfaces any death — via socket EOF or the
-        status watcher flipping ``_fatal`` — within a poll interval.
+        status watcher flipping ``_fatal`` (or starting a recovery) —
+        within a poll interval.  Replies stamped with a stale generation
+        (a zombie of a previous gang flushing its last result) are logged
+        and dropped, never matched against current call ids.
         """
         replies: Dict[str, dict] = {}
         sel = selectors.DefaultSelector()
@@ -752,6 +1071,10 @@ class TPUMesosScheduler:
                 with self._lock:
                     if self._fatal:
                         raise ClusterError(self._fatal)
+                    if self._recovering:
+                        raise ClusterError(
+                            f"cluster re-forming (generation "
+                            f"{self.generation}): {self._recover_reason}")
                 for key, _ in events:
                     task = key.data
                     try:
@@ -771,6 +1094,13 @@ class TPUMesosScheduler:
                             f"bad frame from {task} during dispatch: {e}"
                         ) from e
                     for reply in msgs:
+                        if (isinstance(reply, dict) and "gen" in reply
+                                and reply["gen"] != generation):
+                            self.log.warning(
+                                "dropping stale-generation reply from %s: "
+                                "gen %r (current %d)", task,
+                                reply.get("gen"), generation)
+                            continue
                         if (task.id in replies
                                 or not (isinstance(reply, dict)
                                         and reply.get("call_id") == call_id)):
@@ -797,6 +1127,10 @@ class TPUMesosScheduler:
         with self._lock:
             if self._fatal:
                 raise ClusterError(self._fatal)
+            if self._recovering:
+                # Mid-recovery nothing is finished: the next generation's
+                # tasks re-run (from their checkpoints) and re-count.
+                return False
             return any(
                 self.job_finished.get(job.name, 0) >= (job.num - job.start)
                 for job in self.task_spec
@@ -813,6 +1147,10 @@ class TPUMesosScheduler:
             if self._stopped:
                 return
             self._stopped = True
+            self._recover_event.set()   # unpark the elastic thread to exit
+        if (self._elastic_thread is not None
+                and self._elastic_thread is not threading.current_thread()):
+            self._elastic_thread.join(timeout=5.0)
         for task in self.tasks:
             if task.connection is not None:
                 try:

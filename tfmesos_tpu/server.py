@@ -47,6 +47,13 @@ def main(argv=None) -> int:
         return 2
     task_id, scheduler_addr = argv
     token = wire.load_token()
+    # The gang generation this task was launched into (elastic recovery
+    # bumps it per re-formation).  Echoed in the registration and every
+    # Mode-A reply so the scheduler can fence out zombies of a dead gang.
+    try:
+        generation = int(os.environ.get("TPUMESOS_GENERATION", "0") or 0)
+    except ValueError:
+        generation = 0
 
     # Our own identity address (reference: server.py:18-21).  The listening
     # socket is identity only; control flows over the dial-back connection.
@@ -62,7 +69,7 @@ def main(argv=None) -> int:
 
     sock = wire.connect(scheduler_addr)
     wire.send_msg(sock, {"op": "register", "task_id": task_id, "addr": addr,
-                         "coord_port": coord_port}, token)
+                         "coord_port": coord_port, "gen": generation}, token)
     # The config broadcast only happens once EVERY task has registered, which
     # can be long after our own registration (peers may still be waiting for
     # resources) — so this wait gets its own generous timeout.
@@ -94,6 +101,7 @@ def _run_executor(sock: socket.socket, config: Dict[str, Any], token: str) -> in
         os.environ[str(key)] = str(value)
     if not ctx.extra_config.get("no_jax"):
         initialize(ctx)
+    generation = int(config.get("generation", 0) or 0)
     sock.settimeout(None)
     while True:
         try:
@@ -110,7 +118,16 @@ def _run_executor(sock: socket.socket, config: Dict[str, Any], token: str) -> in
         if op != "run":
             log.warning("unknown op %r", op)
             continue
-        reply: Dict[str, Any] = {"op": "result", "call_id": msg.get("call_id")}
+        if "gen" in msg and msg["gen"] != generation:
+            # Generation fence, task side: a dispatch stamped for another
+            # gang epoch must not execute here (a half-delivered collective
+            # would deadlock the current mesh).  Drop it; the scheduler's
+            # reply fence handles the mirror-image case.
+            log.warning("dropping stale-generation dispatch (gen %r, ours "
+                        "%d)", msg.get("gen"), generation)
+            continue
+        reply: Dict[str, Any] = {"op": "result", "call_id": msg.get("call_id"),
+                                 "gen": generation}
         try:
             func = _resolve_func(msg["func"])
             value = func(ctx, *msg.get("args", ()), **msg.get("kwargs", {}))

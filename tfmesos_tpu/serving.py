@@ -374,9 +374,10 @@ class _PagedSide:
     def bucket_width(self) -> int:
         """Smallest power-of-two table width covering every allocated
         row (shared prefix pages + own pages), capped at ``np_max``.
-        The paged kernel's grid iterates the TABLE WIDTH per (row, kv
-        head) — skipped entries still cost a grid step through the
-        scalar-prefetched index map — so dispatching at the worst-case
+        The paged kernel's grid iterates the TABLE WIDTH per (row, page)
+        — kv heads are folded into each block, and skipped entries still
+        cost a grid step through the scalar-prefetched index map — so
+        dispatching at the worst-case
         width makes short-lived requests on a long-max_len pool pay for
         context they don't have (measured 3.4x on an 8k pool early in
         generation, v5e round 5).  Power-of-two bucketing bounds the

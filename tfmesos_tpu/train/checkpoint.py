@@ -57,6 +57,19 @@ class CheckpointManager:
             return None
         restored = self._mgr.restore(
             step, args=self._ocp.args.StandardRestore(state_like))
+        # Copy every restored array: Orbax hands back arrays whose buffers
+        # can alias checkpointer-internal memory, and DONATING one of
+        # those to a jitted train step (donate_argnums — every step built
+        # by trainer.make_train_step) intermittently corrupts the values
+        # on this jax/orbax stack (observed as a resumed run silently
+        # diverging from an uninterrupted one).  One defensive device
+        # copy per leaf at restart time is noise next to the restart
+        # itself; jnp.copy preserves shardings for mesh-restored arrays.
+        import jax
+        import jax.numpy as jnp
+        restored = jax.tree_util.tree_map(
+            lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
+            restored)
         log.info("restored checkpoint step=%d", step)
         return restored
 

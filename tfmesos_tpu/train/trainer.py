@@ -312,13 +312,51 @@ class TrainLoop:
 
     ``metrics_path`` appends one JSON line per logged step
     (``{"step": N, "wall_s": ..., **metrics}``) — a machine-readable
-    training curve with no dashboard dependency."""
+    training curve with no dashboard dependency.
+
+    ``checkpoint`` (a :class:`~tfmesos_tpu.train.checkpoint.
+    CheckpointManager`) coordinates restart recovery: :meth:`resume`
+    restores the latest saved ``TrainState`` (step offset included) before
+    a run, and :meth:`run` saves every ``save_every`` global steps —
+    ``save_async=True`` overlaps the Orbax write with the next steps.  The
+    ``restores``/``resumed_step`` counters surface how a supervised job
+    actually recovered (they ride the result dict too)."""
 
     step_fn: Callable
     state: TrainState
     log_every: int = 50
     name: str = "train"
     metrics_path: Optional[str] = None
+    checkpoint: Optional[Any] = None
+    save_every: int = 0
+    save_async: bool = False
+    restores: int = 0
+    resumed_step: int = 0
+
+    def state_dict(self) -> Dict[str, Any]:
+        """The checkpointable form of ``state`` (also the restore
+        template: leaves keep their shapes/dtypes/shardings)."""
+        return {"params": self.state.params,
+                "opt_state": self.state.opt_state,
+                "step": jnp.asarray(self.state.step)}
+
+    def resume(self) -> int:
+        """Restore the latest checkpoint (if any) into ``state`` and
+        return the step to resume from (0 on a cold start).  The caller
+        owns realigning its batch iterator to that step — see
+        ``supervisor.supervise_training`` for the stock skip-ahead."""
+        if self.checkpoint is None:
+            return 0
+        restored = self.checkpoint.restore(self.state_dict())
+        if restored is None:
+            return 0
+        self.state = TrainState(restored["params"], restored["opt_state"],
+                                int(restored["step"]))
+        self.restores += 1
+        self.resumed_step = self.state.step
+        log.info("%s resuming from checkpoint step %d", self.name,
+                 self.state.step)
+        return self.state.step
 
     def run(self, batches: Iterator[Dict[str, Any]], num_steps: int,
             on_metrics: Optional[Callable[[int, Dict], None]] = None) -> Dict[str, Any]:
@@ -333,11 +371,18 @@ class TrainLoop:
             nonlocal params, opt_state, metrics
             batch = next(batches)
             params, opt_state, metrics = self.step_fn(params, opt_state, batch)
+            gstep = self.state.step + i + 1
+            if (self.checkpoint is not None and self.save_every
+                    and gstep % self.save_every == 0):
+                self.checkpoint.save(
+                    gstep, {"params": params, "opt_state": opt_state,
+                            "step": jnp.asarray(gstep)},
+                    wait=not self.save_async)
             if (i + 1) % self.log_every == 0 or i + 1 == num_steps:
                 metrics = {k: float(v) for k, v in metrics.items()}
                 if sink:
                     sink.write(json.dumps(
-                        {"step": self.state.step + i + 1,
+                        {"step": gstep,
                          "wall_s": round(time.perf_counter() - t_start, 3),
                          **metrics}) + "\n")
                     sink.flush()
@@ -360,15 +405,22 @@ class TrainLoop:
             for i in range(traced, num_steps):
                 run_step(i)
             jax.block_until_ready(params)
+            if self.checkpoint is not None and self.save_async:
+                self.checkpoint.wait_until_finished()
         finally:
             if sink:
                 sink.close()
         elapsed = time.perf_counter() - t_start
-        self.state = TrainState(params, opt_state, self.state.step + num_steps)
+        start_step = self.state.step
+        self.state = TrainState(params, opt_state, start_step + num_steps)
         n_dev = max(1, jax.device_count())
         return {
             "elapsed_s": elapsed,
             "steps_per_sec": num_steps / elapsed,
             "steps_per_sec_per_chip": num_steps / elapsed / n_dev,
+            "start_step": start_step,
+            "final_step": self.state.step,
+            "restores": self.restores,
+            "resumed_step": self.resumed_step,
             "final_metrics": metrics,
         }

@@ -39,6 +39,28 @@ class WireError(Exception):
     """Malformed, oversized, or unauthenticated frame."""
 
 
+# Fault-injection hooks (chaos.FaultPlan.install): consulted per framed
+# send/recv when set, so tests can sever/delay/truncate/drop traffic on a
+# live connection deterministically.  ``None`` (the default) costs one
+# attribute load per message.
+_chaos_send = None      # Optional[Callable[[socket, bytes], bool]]
+_chaos_recv = None      # Optional[Callable[[socket], None]]
+
+
+def set_chaos(send=None, recv=None) -> None:
+    """Install (or clear, with Nones) the process-global wire fault hooks.
+
+    ``send(sock, frame) -> bool`` runs before every ``send_msg`` frame
+    hits the socket — it may sleep (delay), raise OSError after closing
+    the socket (sever), write a partial frame then raise (truncate), or
+    return True to silently swallow the frame (drop).  ``recv(sock)``
+    runs before every blocking ``recv_msg`` and may sleep or sever.
+    """
+    global _chaos_send, _chaos_recv
+    _chaos_send = send
+    _chaos_recv = recv
+
+
 def new_token() -> str:
     """Fresh per-cluster auth token (scheduler generates one per bring-up)."""
     return os.urandom(16).hex()
@@ -82,7 +104,11 @@ def _decode_body(payload: bytes, token: str) -> Any:
 
 
 def send_msg(sock: socket.socket, obj: Any, token: str = "") -> None:
-    sock.sendall(encode(obj, token))
+    data = encode(obj, token)
+    hook = _chaos_send    # snapshot: a concurrent uninstall must not
+    if hook is not None and hook(sock, data):   # turn this into a None call
+        return      # frame consumed (chaos drop)
+    sock.sendall(data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -98,6 +124,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def recv_msg(sock: socket.socket, token: str = "") -> Any:
+    hook = _chaos_recv    # snapshot against a concurrent uninstall
+    if hook is not None:
+        hook(sock)
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if length > MAX_FRAME:
         raise WireError(f"frame of {length} bytes exceeds limit")
