@@ -144,10 +144,26 @@ def test_fleet_bench_smoke():
     runs end to end and returns finite numbers.  Marked slow: it pays a
     full fleet bring-up that tests/test_fleet.py already exercises in
     tier-1; this guards the driver's unattended bench.py run."""
-    rps, ttft_ms = bench.bench_fleet_serving(
+    rps, ttft_ms, queue_wait_p50 = bench.bench_fleet_serving(
         n_requests=4, replicas=2, rows=2, tiny=True, workers=4)
     assert np.isfinite(rps) and rps > 0
     assert np.isfinite(ttft_ms) and ttft_ms > 0
+    assert np.isfinite(queue_wait_p50) and queue_wait_p50 >= 0
+
+
+@pytest.mark.slow
+def test_fleet_disagg_bench_smoke():
+    """The disaggregated-vs-unified mixed-workload protocol runs end to
+    end (4 fleet bring-ups worth of subprocesses — slow) and asserts
+    internally that the decode tier beat the unified baseline's
+    inter-token p50 and that both tiers served traffic."""
+    dis_ttft, dis_itl, uni_ttft, uni_itl, kv_mb_s = \
+        bench.bench_fleet_disagg(n_decode=4, decode_new=16, rows=2,
+                                 workers=4)
+    assert all(np.isfinite(v) and v > 0
+               for v in (dis_ttft, dis_itl, uni_ttft, uni_itl))
+    assert dis_itl < uni_itl
+    assert np.isfinite(kv_mb_s) and kv_mb_s > 0
 
 
 def test_serving_prefix_cache_bench_smoke():

@@ -170,6 +170,27 @@ def test_serve_parser_defaults():
     assert args.rate is None and args.burst is None
     assert args.replica_chips == 0 and args.replica_mem == 1024.0
     assert not args.tiny and args.master is None
+    assert args.role is None            # disaggregation is opt-in
+
+
+def test_serve_role_spec_parsing():
+    """tfserve --role: 'prefill:N,decode:M' (both tiers required),
+    loud rejections for every malformed spec."""
+    import pytest
+
+    from tfmesos_tpu.cli import parse_role_spec
+
+    assert parse_role_spec(None) == {}
+    assert parse_role_spec("") == {}
+    assert parse_role_spec("prefill:2,decode:3") == \
+        {"prefill": 2, "decode": 3}
+    assert parse_role_spec(" decode:1 , prefill:1 ") == \
+        {"prefill": 1, "decode": 1}
+    for bad in ("prefill:2", "decode:2", "unified:1,prefill:1,decode:1",
+                "prefill:0,decode:1", "prefill:x,decode:1",
+                "prefill:1,prefill:2,decode:1", "bogus"):
+        with pytest.raises(ValueError):
+            parse_role_spec(bad)
 
 
 def test_serve_main_rejects_bad_counts(capfd):
@@ -179,6 +200,8 @@ def test_serve_main_rejects_bad_counts(capfd):
     assert "--replicas" in capfd.readouterr().err
     assert serve_main(["--rows", "0"]) == 2
     assert "--rows" in capfd.readouterr().err
+    assert serve_main(["--role", "prefill:2"]) == 2
+    assert "--role" in capfd.readouterr().err
 
 
 def test_replica_parser_round_trip():
@@ -190,9 +213,11 @@ def test_replica_parser_round_trip():
         "--registry", "127.0.0.1:7000", "--port", "7001", "--rows", "8",
         "--max-len", "64", "--page-size", "16", "--prefill-bucket", "16",
         "--multi-step", "4", "--tiny", "--seed", "3",
-        "--heartbeat-interval", "0.1"])
+        "--heartbeat-interval", "0.1", "--role", "prefill"])
     assert args.registry == "127.0.0.1:7000" and args.port == 7001
     assert args.rows == 8 and args.max_len == 64
     assert args.page_size == 16 and args.prefill_bucket == 16
     assert args.multi_step == 4 and args.tiny and args.seed == 3
     assert args.heartbeat_interval == 0.1
+    assert args.role == "prefill"
+    assert replica_parser().parse_args([]).role == "unified"

@@ -443,6 +443,402 @@ def test_router_affinity_ignores_malformed_summaries(stub_fleet):
         router.close()
 
 
+# -- disaggregated routing (stub replicas, no JAX) --------------------------
+
+
+def _stub_prefill_replica(token, registry_addr, first_token=7,
+                          body=b"\xaa" * 2048, headroom=100):
+    """A prefill-role ReplicaServer: replies to the prefill op with one
+    raw KV frame; refuses generate like the real prefill handler."""
+
+    def handler(msg, reply):
+        if isinstance(msg, wire.RawFrame) or msg.get("op") != "prefill":
+            reply({"op": "error", "id": (msg.meta if isinstance(
+                msg, wire.RawFrame) else msg).get("id"),
+                "kind": "bad_request", "error": "prefill role"})
+            return
+        reply(wire.RawFrame(
+            {"op": "prefilled", "id": msg.get("id"),
+             "first_token": first_token, "pos": len(msg["prompt"]),
+             "prefill_ms": 1.0}, body))
+
+    return ReplicaServer(
+        handler, token=token, capacity=4, registry_addr=registry_addr,
+        heartbeat_interval=0.05,
+        extra_info=lambda: {"role": "prefill",
+                            "kv_headroom": headroom}).start()
+
+
+def _stub_decode_replica(token, registry_addr, bodies=None, headroom=50):
+    """A decode-role ReplicaServer: accepts only RAW generate frames
+    (the KV import) and echoes the artifact's first token."""
+    bodies = bodies if bodies is not None else []
+
+    def handler(msg, reply):
+        if not isinstance(msg, wire.RawFrame):
+            reply({"op": "error", "id": msg.get("id"),
+                   "kind": "bad_request",
+                   "error": "decode stub wants raw frames"})
+            return
+        bodies.append(msg.body)
+        reply({"op": "completion", "id": msg.meta.get("id"),
+               "tokens": [msg.meta["first_token"], 2, 3],
+               "ttft_ms": 0.5, "total_ms": 9.5})
+
+    server = ReplicaServer(
+        handler, token=token, capacity=4, registry_addr=registry_addr,
+        heartbeat_interval=0.05,
+        extra_info=lambda: {"role": "decode",
+                            "kv_headroom": headroom}).start()
+    return server, bodies
+
+
+def test_registry_role_and_headroom_fields(stub_fleet):
+    """role / kv_headroom heartbeat fields land on ReplicaInfo and in
+    the per-role summary (counts + aggregate outstanding)."""
+    token, reg, servers = stub_fleet
+    sock = wire.connect(reg.addr)
+    wire.send_msg(sock, {"op": "hello", "addr": "10.0.0.9:1",
+                         "capacity": 4, "role": "decode",
+                         "kv_headroom": 42, "outstanding": 3}, token)
+    wire.send_msg(sock, {"op": "hello", "addr": "10.0.0.9:2",
+                         "capacity": 4, "role": "prefill",
+                         "kv_headroom": 17}, token)
+    wire.send_msg(sock, {"op": "hello", "addr": "10.0.0.9:3",
+                         "capacity": 4}, token)
+    assert _wait(lambda: len(reg.alive()) == 3)
+    by_addr = {r.addr: r for r in reg.alive()}
+    assert by_addr["10.0.0.9:1"].role == "decode"
+    assert by_addr["10.0.0.9:1"].kv_headroom == 42
+    assert by_addr["10.0.0.9:2"].role == "prefill"
+    assert by_addr["10.0.0.9:3"].role == "unified"   # never advertised
+    summary = reg.role_summary()
+    assert summary["decode"]["alive"] == 1
+    assert summary["decode"]["outstanding"] == 3
+    assert summary["decode"]["kv_headroom"] == 42
+    assert summary["prefill"]["alive"] == 1
+    assert summary["unified"]["alive"] == 1
+    # A malformed kv_headroom costs the field, never the beat.
+    wire.send_msg(sock, {"op": "heartbeat", "addr": "10.0.0.9:1",
+                         "kv_headroom": "lots", "role": "bogus"}, token)
+    time.sleep(0.1)
+    assert {r.addr for r in reg.alive()} >= {"10.0.0.9:1"}
+    assert by_addr["10.0.0.9:1"].role == "decode"
+    sock.close()
+
+
+def test_disagg_stub_round_trip(stub_fleet):
+    """The tox-lint disagg smoke: gateway → prefill replica → raw-frame
+    KV transfer → decode replica → completion, all stubbed (no JAX).
+    The completion's TTFT is the router-measured prefill phase, its
+    decode_ms the decode replica's own turnaround, and the KV bytes
+    are counted."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_prefill_replica(token, reg.addr))
+    dec, bodies = _stub_decode_replica(token, reg.addr)
+    servers.append(dec)
+    assert _wait(lambda: sorted(r.role for r in reg.alive())
+                 == ["decode", "prefill"])
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=2).start()
+    try:
+        client = FleetClient(gw.addr, token)
+        out = client.generate([1, 2, 3], max_new_tokens=3)
+        assert out["tokens"] == [7, 2, 3]
+        assert out["decode_ms"] == pytest.approx(9.0)
+        assert out["ttft_ms"] > 0 and out["total_ms"] >= out["ttft_ms"]
+        assert bodies == [b"\xaa" * 2048]
+        snap = client.metrics()
+        c = snap["counters"]
+        assert c["disagg_prefills"] == 1 and c["disagg_decodes"] == 1
+        assert c["disagg_requests"] == 1
+        assert c["kv_transfer_bytes"] == 2048
+        assert c["completed"] == 1
+        assert snap["histograms"]["queue_wait_ms"]["count"] == 1
+        roles = snap["gauges"]["roles"]
+        assert roles["prefill"]["alive"] == 1
+        assert roles["decode"]["alive"] == 1
+        client.close()
+    finally:
+        gw.stop()
+
+
+def test_gateway_rejects_misdirected_raw_frame(stub_fleet):
+    """A raw frame sent to the GATEWAY (raw frames are replica-to-
+    replica transport) fails FAST: the public port's framer rejects
+    the raw bit at the length prefix — keeping its pre-auth buffering
+    bound at MAX_FRAME — and drops the connection, so the caller gets
+    ConnectionLost promptly, never a hang until its timeout."""
+    token, reg, servers = stub_fleet
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=1).start()
+    try:
+        mux = MuxConnection(gw.addr, token)
+        with pytest.raises(ConnectionLost):
+            mux.call_raw({"op": "generate", "prompt": [1, 2]},
+                         b"\x00" * 64, timeout=5.0)
+        mux.close()
+    finally:
+        gw.stop()
+
+
+def test_disagg_falls_back_to_unified_when_tier_empty(stub_fleet):
+    """With a prefill tier but NO decode tier (and vice versa) the
+    request falls back to the unified replica — existing deployments
+    are unaffected by role-aware routing."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_prefill_replica(token, reg.addr))
+    servers.append(_stub_replica(token, reg.addr, tokens=(5,)))
+    assert _wait(lambda: len(reg.alive()) == 2)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    try:
+        out = router.route({"op": "generate", "prompt": [1, 2],
+                            "max_new_tokens": 1})
+        assert out["tokens"] == [5]         # the unified replica served
+        assert metrics.get("disagg_prefills") == 0
+        # A LONE tier is a fallback (a tier is down); it is counted.
+        assert metrics.get("disagg_fallback") == 1
+    finally:
+        router.close()
+
+
+def test_disagg_internal_error_retries_then_falls_back_to_unified(
+        stub_fleet):
+    """A transient replica-side failure (kind: internal) must NOT be
+    returned to the client while a healthy unified tier exists — only
+    bad_request is deterministic.  Both phases: a failing prefill
+    replica and a failing decode replica each end at the unified
+    fallback."""
+    token, reg, servers = stub_fleet
+
+    def broken(msg, reply):
+        head = msg.meta if isinstance(msg, wire.RawFrame) else msg
+        reply({"op": "error", "id": head.get("id"), "kind": "internal",
+               "error": "transient device failure"})
+
+    servers.append(ReplicaServer(
+        broken, token=token, capacity=4, registry_addr=reg.addr,
+        heartbeat_interval=0.05,
+        extra_info=lambda: {"role": "prefill", "kv_headroom": 9}).start())
+    dec, _ = _stub_decode_replica(token, reg.addr)
+    servers.append(dec)
+    servers.append(_stub_replica(token, reg.addr, tokens=(6,)))
+    assert _wait(lambda: len(reg.alive()) == 3)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        out = router.route({"op": "generate", "prompt": [1, 2],
+                            "max_new_tokens": 1})
+        assert out["tokens"] == [6]         # unified served, not the error
+        assert metrics.get("disagg_fallback") >= 1
+    finally:
+        router.close()
+    # Decode-phase internal errors fall back the same way.
+    servers[0].stop()
+    reg.mark_dead(servers[0].addr)
+    servers[0] = _stub_prefill_replica(token, reg.addr)
+    dec.stop()
+    reg.mark_dead(dec.addr)
+    servers[1] = ReplicaServer(
+        broken, token=token, capacity=4, registry_addr=reg.addr,
+        heartbeat_interval=0.05,
+        extra_info=lambda: {"role": "decode", "kv_headroom": 9}).start()
+    assert _wait(lambda: sorted(r.role for r in reg.alive())
+                 == ["decode", "prefill", "unified"])
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        out = router.route({"op": "generate", "prompt": [3],
+                            "max_new_tokens": 1})
+        assert out["tokens"] == [6]
+        assert metrics.get("disagg_prefills") == 1  # prefill ran ONCE:
+        assert metrics.get("disagg_fallback") >= 1  # no wasted re-prefill
+    finally:
+        router.close()
+
+
+def test_disagg_decode_bad_request_falls_back_to_unified(stub_fleet):
+    """A decode-tier bad_request (the tiers disagree about the KV
+    artifact — e.g. mismatched --page-size) is deterministic for the
+    ARTIFACT, not the request: the router falls back to the unified
+    tier instead of failing the client outright."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_prefill_replica(token, reg.addr))
+
+    def rejecting(msg, reply):
+        head = msg.meta if isinstance(msg, wire.RawFrame) else msg
+        reply({"op": "error", "id": head.get("id"),
+               "kind": "bad_request",
+               "error": "KV artifact page_size 8 does not match 16"})
+
+    servers.append(ReplicaServer(
+        rejecting, token=token, capacity=4, registry_addr=reg.addr,
+        heartbeat_interval=0.05,
+        extra_info=lambda: {"role": "decode", "kv_headroom": 9}).start())
+    servers.append(_stub_replica(token, reg.addr, tokens=(6,)))
+    assert _wait(lambda: len(reg.alive()) == 3)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        out = router.route({"op": "generate", "prompt": [1, 2],
+                            "max_new_tokens": 1})
+        assert out["tokens"] == [6]         # unified served the request
+        assert metrics.get("disagg_fallback") >= 1
+    finally:
+        router.close()
+
+
+def test_mux_raw_encode_rejection_spares_the_connection(stub_fleet):
+    """A call_raw whose meta overflows MAX_RAW_META is rejected at
+    encode time, BEFORE any bytes hit the socket: the caller gets the
+    WireError, the slot is released (outstanding returns to 0), and
+    the connection keeps serving — an unshippable payload must never
+    read as a dead peer."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_replica(token, reg.addr, tokens=(4,)))
+    mux = MuxConnection(servers[0].addr, token)
+    try:
+        with pytest.raises(wire.WireError):
+            mux.call_raw({"op": "generate",
+                          "pad": "x" * (wire.MAX_RAW_META + 1)},
+                         b"", timeout=5.0)
+        assert mux.outstanding == 0         # the slot did not leak
+        assert not mux.closed
+        out = mux.call({"op": "generate", "prompt": [1]}, timeout=10.0)
+        assert out["tokens"] == [4]
+    finally:
+        mux.close()
+
+
+def test_disagg_oversized_artifact_meta_falls_back_to_unified(
+        stub_fleet):
+    """A KV artifact whose decode meta (prefill manifest + prompt)
+    overflows the raw bounds cannot ship to ANY decode replica: the
+    encode-time WireError is deterministic for the ARTIFACT, so the
+    router falls back to unified without dropping the healthy decode
+    link, marking the replica dead, or re-shipping the doomed bytes."""
+    token, reg, servers = stub_fleet
+    pad = "x" * (wire.MAX_RAW_META - 2048)
+
+    def padded_prefill(msg, reply):
+        reply(wire.RawFrame(
+            {"op": "prefilled", "id": msg.get("id"), "first_token": 7,
+             "pos": len(msg["prompt"]), "prefill_ms": 1.0, "pad": pad},
+            b"\xaa" * 64))
+
+    servers.append(ReplicaServer(
+        padded_prefill, token=token, capacity=4, registry_addr=reg.addr,
+        heartbeat_interval=0.05,
+        extra_info=lambda: {"role": "prefill",
+                            "kv_headroom": 9}).start())
+    dec, bodies = _stub_decode_replica(token, reg.addr)
+    servers.append(dec)
+    servers.append(_stub_replica(token, reg.addr, tokens=(6,)))
+    assert _wait(lambda: len(reg.alive()) == 3)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        # The prompt's tokens push the decode meta past MAX_RAW_META.
+        out = router.route({"op": "generate", "prompt": [7] * 6000,
+                            "max_new_tokens": 1})
+        assert out["tokens"] == [6]         # unified served the request
+        assert not bodies                   # nothing reached the decode tier
+        assert metrics.get("disagg_fallback") >= 1
+        # No retry churn: the artifact was not re-sent within the tier,
+        # and the healthy decode replica was never marked dead.
+        assert metrics.get("retries") == 0
+        assert any(r.addr == dec.addr for r in reg.alive())
+    finally:
+        router.close()
+
+
+def test_disagg_decode_failure_retries_then_falls_back(stub_fleet):
+    """A dead decode replica: the handoff retries onto a live one; with
+    no decode replica left the request falls back to unified."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_prefill_replica(token, reg.addr))
+    # A decode-role "replica" that is just a closed port, with MORE
+    # advertised headroom so the scorer prefers it first.
+    dead_sock = wire.bind_ephemeral("127.0.0.1")
+    dead_addr = wire.sock_addr(dead_sock, advertise_host="127.0.0.1")
+    dead_sock.close()
+    feeder = wire.connect(reg.addr)
+    wire.send_msg(feeder, {"op": "hello", "addr": dead_addr,
+                           "role": "decode", "kv_headroom": 10_000},
+                  token)
+    dec, bodies = _stub_decode_replica(token, reg.addr, headroom=5)
+    servers.append(dec)
+    assert _wait(lambda: len(reg.alive()) == 3)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        out = router.route({"op": "generate", "prompt": [1, 2],
+                            "max_new_tokens": 3})
+        assert out["tokens"] == [7, 2, 3]   # live decode replica served
+        assert metrics.get("retries") >= 1
+        # Now kill the last decode replica: disagg cannot complete and
+        # there is no unified tier -> explicit RoutingError, no hang.
+        dec.stop()
+        reg.mark_dead(dec.addr)
+        reg.mark_dead(dead_addr)
+        with pytest.raises(RoutingError):
+            router.route({"op": "generate", "prompt": [3],
+                          "max_new_tokens": 1})
+    finally:
+        router.close()
+        feeder.close()
+
+
+def test_plain_generate_never_routes_to_role_replicas(stub_fleet):
+    """pick() (the unified path) excludes prefill- and decode-role
+    replicas: the role split must not leak plain prefill work into the
+    decode tier or generates into the prefill tier."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_prefill_replica(token, reg.addr))
+    dec, _ = _stub_decode_replica(token, reg.addr)
+    servers.append(dec)
+    servers.append(_stub_replica(token, reg.addr, tokens=(8,)))
+    assert _wait(lambda: len(reg.alive()) == 3)
+    router = Router(reg, FleetMetrics(), token=token)
+    try:
+        for _ in range(8):
+            assert router.pick() == servers[-1].addr
+        assert router.pick_prefill() == servers[0].addr
+        assert router.pick_decode() == dec.addr
+    finally:
+        router.close()
+
+
+def test_pick_decode_prefers_headroom_and_skips_saturated(stub_fleet):
+    token, reg, servers = stub_fleet
+    feeder = wire.connect(reg.addr)
+    wire.send_msg(feeder, {"op": "hello", "addr": "10.1.1.1:1",
+                           "role": "decode", "kv_headroom": 5,
+                           "capacity": 4}, token)
+    wire.send_msg(feeder, {"op": "hello", "addr": "10.1.1.1:2",
+                           "role": "decode", "kv_headroom": 500,
+                           "capacity": 4}, token)
+    assert _wait(lambda: len(reg.alive()) == 2)
+    router = Router(reg, FleetMetrics(), token=token)
+    try:
+        assert router.pick_decode() == "10.1.1.1:2"     # more headroom
+        # Saturate the favorite: outstanding >= capacity diverts.
+        real = router.outstanding
+        router.outstanding = lambda a: 4 if a == "10.1.1.1:2" else 0
+        assert router.pick_decode() == "10.1.1.1:1"
+        router.outstanding = real
+        assert router.pick_decode(
+            exclude=["10.1.1.1:2"]) == "10.1.1.1:1"
+    finally:
+        router.close()
+        feeder.close()
+
+
 # -- end to end: gateway + 2 LocalBackend-launched batcher replicas --------
 
 

@@ -190,6 +190,151 @@ def test_recv_msg_wrong_token_then_socket_reusable_for_framer():
     c.close(); s.close()
 
 
+def test_raw_frame_roundtrip_socket_and_framer():
+    """A raw frame (JSON meta + binary body) survives the socket
+    path and the incremental decoder, body byte-exact."""
+    token = wire.new_token()
+    meta = {"op": "prefilled", "id": 7, "shape": [2, 3]}
+    body = bytes(range(256)) * 64
+    c, s = _pair()
+    wire.send_raw_msg(c, meta, body, token)
+    got = wire.recv_msg(s, token, allow_raw=True)
+    assert isinstance(got, wire.RawFrame)
+    assert got.meta == meta and got.body == body
+    framer = wire.Framer(token, allow_raw=True)
+    out = framer.feed(wire.encode_raw(meta, body, token))
+    assert len(out) == 1 and out[0].meta == meta and out[0].body == body
+    c.close(); s.close()
+
+
+def test_raw_and_json_frames_interleave_on_one_stream():
+    """Raw and JSON frames mixed on one connection decode in order —
+    neither framing can mis-frame the other (the raw bit partitions
+    the length space)."""
+    token = wire.new_token()
+    framer = wire.Framer(token, allow_raw=True)
+    stream = (wire.encode({"op": "a"}, token)
+              + wire.encode_raw({"op": "raw1"}, b"\x00" * 1000, token)
+              + wire.encode([1, 2], token)
+              + wire.encode_raw({"op": "raw2"}, b"", token)
+              + wire.encode("tail", token))
+    # Whole stream at once, then byte-at-a-time: identical decodes.
+    whole = framer.feed(stream)
+    byte_framer = wire.Framer(token, allow_raw=True)
+    bywise = []
+    for i in range(len(stream)):
+        bywise.extend(byte_framer.feed(stream[i:i + 1]))
+    for out in (whole, bywise):
+        assert [getattr(m, "meta", m) for m in out] == \
+            [{"op": "a"}, {"op": "raw1"}, [1, 2], {"op": "raw2"}, "tail"]
+        assert out[1].body == b"\x00" * 1000 and out[3].body == b""
+
+
+def test_raw_frame_truncated_body_never_misframes():
+    """A raw frame cut anywhere stays pending in the Framer (no
+    partial emit) and fails loudly on the blocking reader when the
+    connection dies mid-frame."""
+    token = wire.new_token()
+    frame = wire.encode_raw({"op": "kv"}, b"\xab" * 512, token)
+    for cut in (3, 4, 10, wire.TAG_SIZE + 4, len(frame) - 1):
+        framer = wire.Framer(token, allow_raw=True)
+        assert framer.feed(frame[:cut]) == []
+        out = framer.feed(frame[cut:])     # completing it decodes fine
+        assert len(out) == 1 and out[0].body == b"\xab" * 512
+    c, s = _pair()
+    c.sendall(frame[:len(frame) - 7])
+    c.close()
+    with pytest.raises(wire.WireError, match="closed mid-frame"):
+        wire.recv_msg(s, token, allow_raw=True)
+    s.close()
+
+
+def test_raw_frame_tampered_tag_and_body_rejected():
+    token = wire.new_token()
+    frame = bytearray(wire.encode_raw({"op": "kv"}, b"payload", token))
+    flipped_tag = bytearray(frame)
+    flipped_tag[4] ^= 0xFF              # inside the 32B tag
+    with pytest.raises(wire.WireError, match="bad auth tag"):
+        wire.Framer(token, allow_raw=True).feed(bytes(flipped_tag))
+    flipped_body = bytearray(frame)
+    flipped_body[-1] ^= 0xFF            # last body byte
+    with pytest.raises(wire.WireError, match="bad auth tag"):
+        wire.Framer(token, allow_raw=True).feed(bytes(flipped_body))
+
+
+def test_raw_frame_wrong_token_rejected():
+    frame = wire.encode_raw({"op": "kv"}, b"x" * 32, "right-token")
+    with pytest.raises(wire.WireError, match="bad auth tag"):
+        wire.Framer("wrong-token", allow_raw=True).feed(frame)
+
+
+def test_raw_frame_oversized_rejected_before_buffering():
+    import struct
+
+    huge = struct.pack(">I", wire.RAW_FLAG | (wire.MAX_RAW_FRAME + 1))
+    with pytest.raises(wire.WireError, match="exceeds limit"):
+        wire.Framer(allow_raw=True).feed(huge)
+    c, s = _pair()
+    c.sendall(huge + b"\x00" * 64)
+    with pytest.raises(wire.WireError, match="exceeds limit"):
+        wire.recv_msg(s, allow_raw=True)
+    c.close(); s.close()
+
+
+def test_raw_frame_rejected_on_default_stream():
+    """Raw decoding is opt-in per stream: a default Framer/recv_msg
+    (gateway, registry, scheduler listeners) rejects the raw bit at
+    the 4-byte length prefix — BEFORE buffering any of the claimed
+    body, so an unauthenticated peer cannot widen the pre-auth memory
+    bound past MAX_FRAME by setting the bit."""
+    token = wire.new_token()
+    frame = wire.encode_raw({"op": "kv"}, b"x" * 128, token)
+    with pytest.raises(wire.WireError, match="not accepted"):
+        wire.Framer(token).feed(frame)
+    # The prefix alone triggers the rejection — no body needed.
+    with pytest.raises(wire.WireError, match="not accepted"):
+        wire.Framer(token).feed(frame[:4])
+    c, s = _pair()
+    c.sendall(frame)
+    with pytest.raises(wire.WireError, match="not accepted"):
+        wire.recv_msg(s, token)
+    c.close(); s.close()
+
+
+def test_raw_frame_bad_meta_rejected_after_auth():
+    """A correctly tagged frame whose meta is not valid JSON is a
+    WireError — and the tag is checked FIRST (an unauthenticated frame
+    never reaches the meta decoder)."""
+    import hashlib
+    import hmac as hmac_mod
+    import struct
+
+    token = "t"
+    inner = struct.pack(">I", 5) + b"\xffnope" + b"body"
+    tag = hmac_mod.new(token.encode(), inner, hashlib.sha256).digest()
+    frame = struct.pack(
+        ">I", wire.RAW_FLAG | (len(tag) + len(inner))) + tag + inner
+    with pytest.raises(wire.WireError, match="bad raw meta"):
+        wire.Framer(token, allow_raw=True).feed(frame)
+    # Same frame, wrong token: rejected at the tag, meta never decoded.
+    with pytest.raises(wire.WireError, match="bad auth tag"):
+        wire.Framer("other", allow_raw=True).feed(frame)
+
+
+def test_raw_frame_meta_length_beyond_payload_rejected():
+    import hashlib
+    import hmac as hmac_mod
+    import struct
+
+    token = "t"
+    inner = struct.pack(">I", 10_000) + b"short"
+    tag = hmac_mod.new(token.encode(), inner, hashlib.sha256).digest()
+    frame = struct.pack(
+        ">I", wire.RAW_FLAG | (len(tag) + len(inner))) + tag + inner
+    with pytest.raises(wire.WireError, match="bad raw meta length"):
+        wire.Framer(token, allow_raw=True).feed(frame)
+
+
 def test_non_utf8_body_rejected():
     """A correct tag over a non-JSON body is still a WireError (never a
     raw UnicodeDecodeError escaping to callers)."""

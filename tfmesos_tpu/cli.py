@@ -191,7 +191,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                     "continuous-batching replicas scheduled via Mesos "
                     "(or locally).")
     p.add_argument("-R", "--replicas", type=int, default=2,
-                   help="number of serving replicas")
+                   help="number of UNIFIED serving replicas (with "
+                        "--role, the unified fallback tier; 0 with "
+                        "--role runs pure disaggregated)")
+    p.add_argument("--role", type=str, default=None, metavar="SPEC",
+                   help="disaggregated role split, e.g. "
+                        "'prefill:2,decode:2': dedicated prefill "
+                        "replicas export KV pages that dedicated "
+                        "decode replicas import, so long prefills "
+                        "never stall decode ticks; --replicas N still "
+                        "adds N unified fallback replicas "
+                        "(docs/SERVING.md, docs/MIGRATION.md)")
     p.add_argument("-m", "--master", type=str, default=None,
                    help="Mesos master (host:port or zk://...); default env "
                         "MESOS_MASTER, else local backend")
@@ -239,11 +249,48 @@ def build_serve_parser() -> argparse.ArgumentParser:
     return p
 
 
+def parse_role_spec(spec: Optional[str]) -> dict:
+    """``'prefill:2,decode:2'`` → ``{"prefill": 2, "decode": 2}``.
+    Both disaggregated tiers must appear (a lone tier cannot serve the
+    prefill→decode handoff); counts must be positive ints."""
+    if not spec:
+        return {}
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        role, _, num = part.partition(":")
+        role = role.strip()
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"bad --role entry {part!r}; want "
+                             f"'prefill:N,decode:M'")
+        try:
+            n = int(num)
+        except ValueError:
+            raise ValueError(f"bad --role count in {part!r}") from None
+        if n < 1:
+            raise ValueError(f"--role count must be >= 1 in {part!r}")
+        if role in out:
+            raise ValueError(f"duplicate --role entry for {role!r}")
+        out[role] = n
+    if set(out) != {"prefill", "decode"}:
+        raise ValueError("--role needs BOTH tiers, e.g. "
+                         "'prefill:2,decode:2'")
+    return out
+
+
 def serve_main(argv: Optional[List[str]] = None) -> int:
     args = build_serve_parser().parse_args(argv)
-    if args.replicas < 1:
-        print(f"tfserve: --replicas must be >= 1, got {args.replicas}",
-              file=sys.stderr)
+    try:
+        roles = parse_role_spec(args.role)
+    except ValueError as e:
+        print(f"tfserve: {e}", file=sys.stderr)
+        return 2
+    min_replicas = 0 if roles else 1
+    if args.replicas < min_replicas:
+        print(f"tfserve: --replicas must be >= {min_replicas}, got "
+              f"{args.replicas}", file=sys.stderr)
         return 2
     if args.rows < 1:
         print(f"tfserve: --rows must be >= 1, got {args.rows}",
@@ -260,6 +307,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     token = wire.load_token() or None
     fleet = FleetServer(
         replicas=args.replicas, rows=args.rows, tiny=args.tiny,
+        prefill_replicas=roles.get("prefill", 0),
+        decode_replicas=roles.get("decode", 0),
         max_len=args.max_len, master=args.master,
         replica_cpus=args.replica_cpus, replica_mem=args.replica_mem,
         replica_chips=args.replica_chips,
@@ -283,8 +332,12 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             f.write(fleet.token)
         print(f"tfserve: client token file {token_file} (clients set "
               f"{wire.TOKEN_FILE_ENV}={token_file})", flush=True)
-    print(f"tfserve: gateway on {fleet.addr} fronting {args.replicas} "
-          f"replica(s); ctrl-c to stop", flush=True)
+    tiers = f"{args.replicas} unified replica(s)"
+    if roles:
+        tiers += (f" + {roles['prefill']} prefill / {roles['decode']} "
+                  f"decode (disaggregated)")
+    print(f"tfserve: gateway on {fleet.addr} fronting {tiers}; "
+          f"ctrl-c to stop", flush=True)
     try:
         while True:
             time.sleep(3600)

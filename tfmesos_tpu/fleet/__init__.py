@@ -28,6 +28,14 @@ TF-Replicator (PAPERS.md) over the existing execution engine:
   brings the whole thing up (registry + gateway + N scheduled replicas)
   and tears it down.
 
+Disaggregated prefill/decode serving (docs/SERVING.md) rides the same
+pieces: replicas advertise ``role: prefill|decode|unified`` (plus
+KV-page headroom) on heartbeats, the router becomes two-tier — prefill
+pick by prefix-affinity/load, decode pick by page headroom — and the
+gateway's generate path orchestrates prefill → raw-frame KV transfer →
+decode with bounded retry, falling back to the unified tier whenever a
+role tier is empty.
+
 Everything here except :mod:`replica` is jax-free — the gateway process
 never touches an accelerator.
 """
@@ -41,7 +49,8 @@ from tfmesos_tpu.fleet.client import (ConnectionLost, FleetClient,
 from tfmesos_tpu.fleet.gateway import Gateway
 from tfmesos_tpu.fleet.launcher import FleetServer
 from tfmesos_tpu.fleet.metrics import FleetMetrics
-from tfmesos_tpu.fleet.registry import ReplicaInfo, ReplicaRegistry
+from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
+                                        ReplicaInfo, ReplicaRegistry)
 from tfmesos_tpu.fleet.router import Router, RoutingError
 
 __all__ = [
@@ -49,4 +58,5 @@ __all__ = [
     "ConnectionLost", "FleetClient", "MuxConnection", "RequestFailed",
     "Gateway", "FleetServer", "FleetMetrics", "ReplicaInfo",
     "ReplicaRegistry", "Router", "RoutingError",
+    "UNIFIED", "PREFILL", "DECODE",
 ]

@@ -80,7 +80,21 @@ class MuxConnection:
     def call(self, msg: Dict[str, Any],
              timeout: Optional[float] = None) -> Any:
         """Send ``msg`` (its ``id`` field is overwritten with ours) and
-        block for the matching reply."""
+        block for the matching reply — a dict, or a
+        :class:`~tfmesos_tpu.wire.RawFrame` when the peer replies in
+        the raw binary framing (a prefill replica's KV export)."""
+        return self._call(msg, None, timeout)
+
+    def call_raw(self, meta: Dict[str, Any], body,
+                 timeout: Optional[float] = None) -> Any:
+        """Like :meth:`call`, but ships ``meta`` + ``body`` as ONE raw
+        binary frame (zero-copy body) — the KV handoff's transport into
+        a decode replica.  The reply is matched by ``meta['id']`` like
+        any other call."""
+        return self._call(meta, body, timeout)
+
+    def _call(self, msg: Dict[str, Any], raw_body,
+              timeout: Optional[float] = None) -> Any:
         with self._lock:
             if self._closed:
                 raise ConnectionLost(self._error or "connection closed")
@@ -92,7 +106,20 @@ class MuxConnection:
         out["id"] = mid
         try:
             with self._send_lock:
-                wire.send_msg(self._sock, out, self._token)
+                if raw_body is not None:
+                    wire.send_raw_msg(self._sock, out, raw_body,
+                                      self._token)
+                else:
+                    wire.send_msg(self._sock, out, self._token)
+        except wire.WireError:
+            # Encode-time rejection (oversized raw meta/frame), raised
+            # BEFORE any bytes hit the socket: the connection is still
+            # good and no other call is disturbed — release the slot
+            # and surface it as deterministic for THIS payload, never
+            # as a dead peer.
+            with self._lock:
+                self._slots.pop(mid, None)
+            raise
         except OSError as e:
             with self._lock:
                 self._slots.pop(mid, None)
@@ -112,16 +139,23 @@ class MuxConnection:
         return slot[1]
 
     def _read_loop(self) -> None:
-        framer = wire.Framer(self._token)
+        # We dialed this peer ourselves; raw replies (a prefill
+        # replica's KV export) are expected on mux links.
+        framer = wire.Framer(self._token, allow_raw=True)
         try:
             for msg in wire.iter_msgs(self._sock, framer):
-                if not isinstance(msg, dict):
+                if isinstance(msg, wire.RawFrame):
+                    mid = (msg.meta.get("id")
+                           if isinstance(msg.meta, dict) else None)
+                elif isinstance(msg, dict):
+                    mid = msg.get("id")
+                else:
                     continue
                 with self._lock:
                     # The reply lands under the lock so a caller whose
                     # wait() just timed out still finds it (its own pop
                     # serializes after this one).
-                    slot = self._slots.pop(msg.get("id"), None)
+                    slot = self._slots.pop(mid, None)
                     if slot is not None:
                         slot[1] = msg
                 if slot is not None:

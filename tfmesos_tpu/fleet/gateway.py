@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Any, Dict, Optional, Set
 
 from tfmesos_tpu import wire
@@ -86,6 +87,9 @@ class Gateway:
         metrics.register_gauge("queue_depth", admission.depth)
         metrics.register_gauge("replicas_alive",
                                lambda: len(self.registry.alive()))
+        # Per-role replica counts + aggregate outstanding/headroom, so
+        # a disaggregated deployment's snapshot shows each tier served.
+        metrics.register_gauge("roles", self.registry.role_summary)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -154,6 +158,11 @@ class Gateway:
                 self._clients.discard(client)
 
     def _handle(self, client: _Client, msg: Any) -> None:
+        # Raw frames never reach here: the gateway's framer rejects
+        # the raw bit at the length prefix (wire.Framer allow_raw
+        # default), which both keeps the public port's pre-auth
+        # buffering bound at MAX_FRAME and fails a misdirected
+        # call_raw fast (connection drop, never a timeout hang).
         if not isinstance(msg, dict):
             return
         op = msg.get("op")
@@ -174,7 +183,8 @@ class Gateway:
                    "max_new_tokens": msg.get("max_new_tokens"),
                    "stop_token": msg.get("stop_token")}
         try:
-            self.admission.admit((client, cid, forward))
+            self.admission.admit((client, cid, forward,
+                                  time.perf_counter()))
         except RateLimited as e:
             self.metrics.inc("shed_rate_limited")
             client.send({"op": "error", "id": cid, "kind": e.kind,
@@ -193,7 +203,13 @@ class Gateway:
             item = self.admission.get(timeout=0.2)
             if item is None:
                 continue
-            client, cid, forward = item
+            client, cid, forward, t_enq = item
+            # Queue wait is ITS OWN histogram, never folded into TTFT:
+            # TTFT measures the serving path (prefill + transfer), and
+            # conflating admission backlog with it would mask exactly
+            # the stalls disaggregation removes.
+            self.metrics.observe("queue_wait_ms",
+                                 (time.perf_counter() - t_enq) * 1000.0)
             try:
                 reply = self.router.route(forward)
             except Exception as e:
@@ -212,7 +228,18 @@ class Gateway:
                 self.metrics.inc("completed")
                 self.metrics.inc("tokens_out",
                                  len(out.get("tokens") or ()))
-                self.metrics.observe("ttft_ms", out.get("ttft_ms"))
+                if "decode_ms" in out:      # disaggregated completions
+                    # Their TTFT is router-measured (route start to
+                    # prefill reply) — a different clock base than the
+                    # replica-measured TTFT of unified completions, so
+                    # it gets its own histogram instead of skewing
+                    # ttft_ms percentiles in a mixed fleet.
+                    self.metrics.observe("disagg_ttft_ms",
+                                         out.get("ttft_ms"))
+                    self.metrics.observe("decode_ms",
+                                         out.get("decode_ms"))
+                else:
+                    self.metrics.observe("ttft_ms", out.get("ttft_ms"))
                 self.metrics.observe("latency_ms", out.get("total_ms"))
             else:
                 self.metrics.inc("failed")

@@ -46,6 +46,8 @@ class FleetServer:
                  prefill_bucket: Optional[int] = None,
                  multi_step: int = 1,
                  prefix_cache_pages: int = 0,
+                 prefill_replicas: int = 0,
+                 decode_replicas: int = 0,
                  backend=None, master: Optional[str] = None,
                  replica_cpus: float = 1.0, replica_mem: float = 1024.0,
                  replica_chips: int = 0,
@@ -58,9 +60,17 @@ class FleetServer:
                  heartbeat_interval: float = 0.3,
                  report_interval: Optional[float] = None,
                  quiet: bool = True, token: Optional[str] = None):
-        if replicas < 1:
-            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if min(replicas, prefill_replicas, decode_replicas) < 0:
+            raise ValueError("replica counts must be >= 0")
+        if (prefill_replicas > 0) != (decode_replicas > 0):
+            raise ValueError(
+                "prefill_replicas and decode_replicas come together — "
+                "a lone tier cannot serve the disaggregated handoff")
+        if replicas + prefill_replicas + decode_replicas < 1:
+            raise ValueError("the fleet needs at least one replica")
         self.replicas = int(replicas)
+        self.prefill_replicas = int(prefill_replicas)
+        self.decode_replicas = int(decode_replicas)
         self.rows = int(rows)
         self.tiny = bool(tiny)
         self.seed = int(seed)
@@ -103,12 +113,14 @@ class FleetServer:
 
     # -- bring-up ----------------------------------------------------------
 
-    def _replica_cmd(self) -> str:
+    def _replica_cmd(self, role: str = "unified") -> str:
         parts = [sys.executable, "-m", "tfmesos_tpu.fleet.replica",
                  "--registry", self.registry.addr,
                  "--rows", str(self.rows),
                  "--seed", str(self.seed),
                  "--heartbeat-interval", str(self.heartbeat_interval)]
+        if role != "unified":
+            parts += ["--role", role]
         if self.tiny:
             parts.append("--tiny")
         if self.max_len is not None:
@@ -148,11 +160,27 @@ class FleetServer:
                                    host=self.gateway_host,
                                    port=self.gateway_port,
                                    workers=self.workers).start()
-            job = Job(name="replica", num=self.replicas,
-                      cpus=self.replica_cpus, mem=self.replica_mem,
-                      chips=self.replica_chips, cmd=self._replica_cmd())
+            jobs = []
+            if self.replicas:
+                jobs.append(Job(name="replica", num=self.replicas,
+                                cpus=self.replica_cpus,
+                                mem=self.replica_mem,
+                                chips=self.replica_chips,
+                                cmd=self._replica_cmd()))
+            if self.prefill_replicas:
+                jobs.append(Job(name="prefill", num=self.prefill_replicas,
+                                cpus=self.replica_cpus,
+                                mem=self.replica_mem,
+                                chips=self.replica_chips,
+                                cmd=self._replica_cmd("prefill")))
+            if self.decode_replicas:
+                jobs.append(Job(name="decode", num=self.decode_replicas,
+                                cpus=self.replica_cpus,
+                                mem=self.replica_mem,
+                                chips=self.replica_chips,
+                                cmd=self._replica_cmd("decode")))
             self.scheduler = TPUMesosScheduler(
-                [job], backend=self.backend, master=self.master,
+                jobs, backend=self.backend, master=self.master,
                 quiet=self.quiet, start_timeout=self.start_timeout,
                 token=self.token)
             self.scheduler.start()
@@ -163,23 +191,30 @@ class FleetServer:
         self._started = True
         if self.report_interval:
             self.metrics.start_reporter(self.log, self.report_interval)
-        self.log.info("fleet up: gateway %s, %d replica(s)", self.addr,
-                      self.replicas)
+        self.log.info("fleet up: gateway %s, %d replica(s) "
+                      "(%d unified / %d prefill / %d decode)", self.addr,
+                      self.total_replicas, self.replicas,
+                      self.prefill_replicas, self.decode_replicas)
         return self
+
+    @property
+    def total_replicas(self) -> int:
+        return self.replicas + self.prefill_replicas + self.decode_replicas
 
     def _wait_replicas(self) -> None:
         import time
 
+        want = self.total_replicas
         deadline = time.monotonic() + self.start_timeout
         while time.monotonic() < deadline:
-            if len(self.registry.alive()) >= self.replicas:
+            if len(self.registry.alive()) >= want:
                 return
             # finished() raises ClusterError if a replica task already
             # died fatally — surface that instead of idling to timeout.
             self.scheduler.finished()
             time.sleep(0.1)
         raise ClusterError(
-            f"only {len(self.registry.alive())}/{self.replicas} replicas "
+            f"only {len(self.registry.alive())}/{want} replicas "
             f"heartbeating after {self.start_timeout:.0f}s")
 
     # -- surface -----------------------------------------------------------

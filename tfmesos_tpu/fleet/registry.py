@@ -29,11 +29,18 @@ from typing import Dict, List, Optional
 from tfmesos_tpu import wire
 from tfmesos_tpu.utils.logging import get_logger
 
-__all__ = ["ALIVE", "DRAINING", "DEAD", "ReplicaInfo", "ReplicaRegistry"]
+__all__ = ["ALIVE", "DRAINING", "DEAD", "UNIFIED", "PREFILL", "DECODE",
+           "ROLES", "ReplicaInfo", "ReplicaRegistry"]
 
 ALIVE = "alive"
 DRAINING = "draining"
 DEAD = "dead"
+
+
+UNIFIED = "unified"
+PREFILL = "prefill"
+DECODE = "decode"
+ROLES = (UNIFIED, PREFILL, DECODE)
 
 
 @dataclasses.dataclass
@@ -50,6 +57,12 @@ class ReplicaInfo:
     # router's prefix-affinity choice matches prompts against.  None
     # until the replica advertises one.
     prefix: Optional[dict] = None
+    # Disaggregated serving: the replica's advertised tier (prefill /
+    # decode / unified — unified when it never says) and its free-KV-
+    # page headroom, both heartbeat fields.  Decode-tier routing places
+    # imported prefills by headroom; -1 = never advertised.
+    role: str = UNIFIED
+    kv_headroom: int = -1
 
 
 class ReplicaRegistry:
@@ -186,6 +199,13 @@ class ReplicaRegistry:
                 rep.outstanding = int(msg["outstanding"])
             if isinstance(msg.get("prefix_cache"), dict):
                 rep.prefix = msg["prefix_cache"]
+            if msg.get("role") in ROLES:
+                rep.role = msg["role"]
+            if "kv_headroom" in msg:
+                try:
+                    rep.kv_headroom = int(msg["kv_headroom"])
+                except (TypeError, ValueError):
+                    pass    # a bad field never costs the beat
             rep.last_beat = time.monotonic()
             self._conns[addr] = conn
         return addr
@@ -225,6 +245,24 @@ class ReplicaRegistry:
     def snapshot(self) -> List[dict]:
         with self._lock:
             return [dataclasses.asdict(r) for r in self._table.values()]
+
+    def role_summary(self) -> Dict[str, dict]:
+        """Per-role replica counts and aggregate self-reported
+        outstanding — exported as the gateway's ``roles`` gauge so
+        fleet metrics (and the disagg bench) can assert each tier
+        actually exists and served traffic."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for rep in self._table.values():
+                d = out.setdefault(rep.role or UNIFIED,
+                                   {"alive": 0, "draining": 0, "dead": 0,
+                                    "outstanding": 0, "kv_headroom": 0})
+                d[rep.state] = d.get(rep.state, 0) + 1
+                if rep.state == ALIVE:
+                    d["outstanding"] += rep.outstanding
+                    if rep.kv_headroom > 0:
+                        d["kv_headroom"] += rep.kv_headroom
+        return out
 
     def mark_dead(self, addr: str, why: str = "reported by router") -> None:
         """Out-of-band death report (router connection failure).  The

@@ -38,8 +38,9 @@ from typing import Any, Callable, Dict, List, Optional
 from tfmesos_tpu import wire
 from tfmesos_tpu.utils.logging import get_logger
 
-__all__ = ["ReplicaServer", "BatcherServing", "tiny_model",
-           "flagship_model", "build_parser", "main"]
+__all__ = ["ReplicaServer", "BatcherServing", "batcher_handler",
+           "prefill_handler", "tiny_model", "flagship_model",
+           "build_parser", "main"]
 
 
 class ReplicaServer:
@@ -137,7 +138,9 @@ class ReplicaServer:
                              name="replica-conn", daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        framer = wire.Framer(self.token)
+        # Replica links legitimately carry multi-MB raw KV frames (the
+        # disaggregated import path) — the one listener that opts in.
+        framer = wire.Framer(self.token, allow_raw=True)
         send_lock = threading.Lock()
         try:
             conn.settimeout(None)
@@ -156,24 +159,35 @@ class ReplicaServer:
                 self._conns.discard(conn)
 
     def _send(self, conn: socket.socket, lock: threading.Lock,
-              msg: Dict[str, Any]) -> None:
+              msg) -> None:
         try:
             with lock:
-                wire.send_msg(conn, msg, self.token)
+                if isinstance(msg, wire.RawFrame):
+                    wire.send_raw_msg(conn, msg.meta, msg.body, self.token)
+                else:
+                    wire.send_msg(conn, msg, self.token)
         except OSError:
             pass    # peer gone; its requests died with it
 
     def _handle(self, conn: socket.socket, send_lock: threading.Lock,
                 msg: Any) -> None:
-        if not isinstance(msg, dict):
+        # Raw binary frames (the disaggregated KV handoff) carry their
+        # op/id in the JSON meta header; the handler receives the
+        # whole RawFrame so the body never copies through a re-encode.
+        if isinstance(msg, wire.RawFrame):
+            head = msg.meta if isinstance(msg.meta, dict) else {}
+        elif isinstance(msg, dict):
+            head = msg
+        else:
             return
-        op = msg.get("op")
+        op = head.get("op")
+        mid = head.get("id")
         if op == "ping":
-            self._send(conn, send_lock, {"op": "pong", "id": msg.get("id")})
+            self._send(conn, send_lock, {"op": "pong", "id": mid})
             return
-        if op != "generate":
+        if op not in ("generate", "prefill"):
             self._send(conn, send_lock,
-                       {"op": "error", "id": msg.get("id"),
+                       {"op": "error", "id": mid,
                         "kind": "bad_request",
                         "error": f"unknown op {op!r}"})
             return
@@ -181,7 +195,7 @@ class ReplicaServer:
             self._outstanding += 1
         done = threading.Event()    # single-shot guard
 
-        def reply(out: Dict[str, Any]) -> None:
+        def reply(out) -> None:
             if done.is_set():
                 return
             done.set()
@@ -193,31 +207,36 @@ class ReplicaServer:
             self.handler(msg, reply)
         except Exception as e:      # handler bug: fail THIS request only
             self.log.exception("handler failed: %s", e)
-            reply({"op": "error", "id": msg.get("id"), "kind": "internal",
+            reply({"op": "error", "id": mid, "kind": "internal",
                    "error": repr(e)})
 
     # -- heartbeats --------------------------------------------------------
+
+    def _merge_extra(self, beat: Dict[str, Any]) -> None:
+        if self.extra_info is None:
+            return
+        try:
+            beat.update(self.extra_info())
+        except Exception:
+            # A broken callback costs its fields, never the heartbeat —
+            # losing the beat would get a healthy replica marked dead.
+            self.log.exception("heartbeat extra_info failed; beat "
+                               "sent bare")
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
             sock = None
             try:
                 sock = wire.connect(self.registry_addr, timeout=5.0)
-                wire.send_msg(sock, {"op": "hello", "addr": self.addr,
-                                     "capacity": self.capacity}, self.token)
+                hello = {"op": "hello", "addr": self.addr,
+                         "capacity": self.capacity}
+                self._merge_extra(hello)    # role must land BEFORE any
+                wire.send_msg(sock, hello, self.token)  # routing decision
                 while not self._stop.wait(self.heartbeat_interval):
                     beat = {"op": "heartbeat", "addr": self.addr,
                             "capacity": self.capacity,
                             "outstanding": self.outstanding}
-                    if self.extra_info is not None:
-                        try:
-                            beat.update(self.extra_info())
-                        except Exception:
-                            # A broken callback costs its fields, never
-                            # the heartbeat — losing the beat would get
-                            # a healthy replica marked dead.
-                            self.log.exception("heartbeat extra_info "
-                                               "failed; beat sent bare")
+                    self._merge_extra(beat)
                     wire.send_msg(sock, beat, self.token)
                 # Graceful exit: tell the registry we are draining so it
                 # stops routing to us before the process dies.
@@ -268,12 +287,17 @@ class BatcherServing:
                 cb(None, f"batcher serve loop died: {e!r}")
             raise
 
-    def submit(self, request, on_done: Callable) -> None:
+    def submit(self, request, on_done: Callable,
+               prefilled: Optional[dict] = None) -> None:
         """``on_done(completion, error)``: exactly one of the two is
-        set."""
+        set.  ``prefilled`` routes the request through the batcher's
+        KV-import admission (disaggregated decode)."""
         with self._lock:
             self._callbacks[id(request)] = on_done
-        self.batcher.submit(request)
+        if prefilled is not None:
+            self.batcher.submit(request, prefilled=prefilled)
+        else:
+            self.batcher.submit(request)
 
     def close(self) -> None:
         self.batcher.close()
@@ -282,26 +306,45 @@ class BatcherServing:
 
 
 def batcher_handler(serving: BatcherServing) -> Callable:
-    """The model-backed ``ReplicaServer`` handler: validate, submit,
-    stream the completion back when the batcher finishes it."""
+    """The model-backed ``ReplicaServer`` handler (decode/unified
+    roles): validate, submit, stream the completion back when the
+    batcher finishes it.  A plain ``generate`` dict takes the local
+    prefill path; a RAW ``generate`` frame (meta + KV body) takes the
+    disaggregated IMPORT path — the payload pages install into the
+    pool and the row enters decode directly."""
     import numpy as np
 
-    from tfmesos_tpu.serving import Request
+    from tfmesos_tpu import serving as serving_mod
+    from tfmesos_tpu.serving import Prefilled, Request
 
     batcher = serving.batcher
 
-    def handler(msg: Dict[str, Any], reply: Callable) -> None:
-        mid = msg.get("id")
+    def handler(msg, reply: Callable) -> None:
+        raw = isinstance(msg, wire.RawFrame)
+        head = msg.meta if raw else msg
+        mid = head.get("id")
+        if head.get("op") == "prefill":
+            reply({"op": "error", "id": mid, "kind": "bad_request",
+                   "error": "this replica does not serve the prefill "
+                            "op (role: decode/unified); route prefill "
+                            "to a prefill-role replica"})
+            return
+        prefilled = None
         try:
             req = Request(
-                prompt=np.asarray(msg.get("prompt"), np.int32),
-                max_new_tokens=int(msg.get("max_new_tokens") or 0),
-                stop_token=msg.get("stop_token"))
-            # Reject un-servable requests NOW with an explicit error —
-            # run()'s own invalid-request path raises only after the
-            # stream drains, which would take the whole replica down.
-            batcher.validate(req)
-        except (TypeError, ValueError) as e:
+                prompt=np.asarray(head.get("prompt"), np.int32),
+                max_new_tokens=int(head.get("max_new_tokens") or 0),
+                stop_token=head.get("stop_token"))
+            if raw:
+                prefilled = serving_mod.unpack_prefilled(head, msg.body)
+                batcher.validate(Prefilled(req, prefilled))
+            else:
+                # Reject un-servable requests NOW with an explicit
+                # error — run()'s own invalid-request path raises only
+                # after the stream drains, which would take the whole
+                # replica down.
+                batcher.validate(req)
+        except (TypeError, ValueError, KeyError) as e:
             reply({"op": "error", "id": mid, "kind": "bad_request",
                    "error": str(e)})
             return
@@ -316,7 +359,78 @@ def batcher_handler(serving: BatcherServing) -> Callable:
                    "ttft_ms": round(comp.ttft_s * 1000.0, 3),
                    "total_ms": round(comp.total_s * 1000.0, 3)})
 
-        serving.submit(req, on_done)
+        serving.submit(req, on_done, prefilled=prefilled)
+
+    return handler
+
+
+def prefill_handler(batcher, max_queue: int = 8) -> Callable:
+    """The prefill-role ``ReplicaServer`` handler: run the prompt
+    through prefill only (``export_kv``) and stream the KV artifact
+    back as ONE raw binary frame.  Prefill runs off the connection's
+    reader thread so a mux peer can pipeline requests; admitted work
+    drains through ONE worker thread off a bounded FIFO queue (exports
+    serialize inside the batcher anyway, so extra threads would only
+    pile up on its lock in unspecified wakeup order), and a full queue
+    answers ``overloaded`` immediately — the router treats that as
+    transient and retries another prefill replica or falls back.
+    ``generate`` is refused — a prefill-role replica never decodes,
+    which is what keeps its tier's admission latency flat."""
+    import queue as _queue
+    import time as _time
+
+    import numpy as np
+
+    from tfmesos_tpu import serving as serving_mod
+    from tfmesos_tpu.serving import Request
+
+    log = get_logger("tfmesos_tpu.fleet.replica")
+    work_q: "_queue.Queue" = _queue.Queue(maxsize=max_queue)
+
+    def drain() -> None:
+        while True:
+            req, mid, reply = work_q.get()
+            try:
+                t0 = _time.perf_counter()
+                art = batcher.export_kv(req)
+                meta, body = serving_mod.pack_prefilled(art)
+                meta.update(op="prefilled", id=mid,
+                            prefill_ms=round(
+                                (_time.perf_counter() - t0) * 1000.0, 3))
+                reply(wire.RawFrame(meta, body))
+            except Exception as e:
+                log.exception("prefill failed: %s", e)
+                reply({"op": "error", "id": mid, "kind": "internal",
+                       "error": repr(e)})
+
+    threading.Thread(target=drain, name="replica-prefill",
+                     daemon=True).start()
+
+    def handler(msg, reply: Callable) -> None:
+        raw = isinstance(msg, wire.RawFrame)
+        head = msg.meta if raw else msg
+        mid = head.get("id")
+        if raw or head.get("op") != "prefill":
+            reply({"op": "error", "id": mid, "kind": "bad_request",
+                   "error": "this replica serves only the prefill op "
+                            "(role: prefill); route generate to a "
+                            "decode or unified replica"})
+            return
+        try:
+            req = Request(
+                prompt=np.asarray(head.get("prompt"), np.int32),
+                max_new_tokens=int(head.get("max_new_tokens") or 0),
+                stop_token=head.get("stop_token"))
+            batcher.validate(req)
+        except (TypeError, ValueError) as e:
+            reply({"op": "error", "id": mid, "kind": "bad_request",
+                   "error": str(e)})
+            return
+        try:
+            work_q.put_nowait((req, mid, reply))
+        except _queue.Full:
+            reply({"op": "error", "id": mid, "kind": "overloaded",
+                   "error": f"prefill queue full ({max_queue} pending)"})
 
     return handler
 
@@ -376,6 +490,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "per mesh data shard (0 disables); cached "
                         "summaries are advertised on registry heartbeats "
                         "for prefix-affinity routing")
+    p.add_argument("--role", choices=("unified", "prefill", "decode"),
+                   default="unified",
+                   help="serving role: 'unified' (default) serves whole "
+                        "requests; 'prefill' only runs prompts through "
+                        "prefill and exports their KV pages; 'decode' "
+                        "additionally imports exported KV and enters "
+                        "rows straight into decode (disaggregated "
+                        "serving, docs/SERVING.md)")
     p.add_argument("--tiny", action="store_true",
                    help="serve the tiny CI model instead of the flagship")
     p.add_argument("--seed", type=int, default=0)
@@ -400,16 +522,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         page_size=args.page_size, prefill_bucket=args.prefill_bucket,
         multi_step=args.multi_step,
         prefix_cache_pages=args.prefix_cache_pages)
-    serving = BatcherServing(batcher).start()
-    extra = None
-    if batcher.prefix_cache_active:
-        extra = lambda: {"prefix_cache": batcher.prefix_cache_summary()}
+    serving = None
+    if args.role == "prefill":
+        # Prefill-role replicas never decode: no serve loop runs, the
+        # handler drives export_kv directly (exports borrow rows).
+        handler = prefill_handler(batcher)
+    else:
+        serving = BatcherServing(batcher).start()
+        handler = batcher_handler(serving)
+
+    def extra() -> Dict[str, Any]:
+        # Heartbeat advert: the tier this replica belongs to and its
+        # live KV headroom (decode-tier routing places imports by it),
+        # plus the prefix-cache summary when one runs.
+        beat: Dict[str, Any] = {"role": args.role,
+                                "kv_headroom": batcher.kv_headroom()}
+        if batcher.prefix_cache_active:
+            beat["prefix_cache"] = batcher.prefix_cache_summary()
+        return beat
+
     server = ReplicaServer(
-        batcher_handler(serving), token=token, capacity=args.rows,
+        handler, token=token, capacity=args.rows,
         host=args.host, port=args.port, registry_addr=args.registry,
         heartbeat_interval=args.heartbeat_interval, extra_info=extra)
     server.start()
-    print(f"replica serving on {server.addr}", flush=True)
+    print(f"replica serving on {server.addr} (role {args.role})",
+          flush=True)
 
     stop = threading.Event()
 
@@ -421,7 +559,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGINT, on_signal)
     stop.wait()
     server.stop()
-    serving.close()
+    if serving is not None:
+        serving.close()
     return 0
 
 

@@ -32,6 +32,22 @@ bit-identical across replicas; the dead replica never delivered a
 completion, so nothing double-counts).  After ``max_retries`` failovers
 the request fails with :class:`RoutingError` and the gateway reports it
 to the client explicitly — never a silent hang.
+
+**Disaggregated (role-aware) routing**: replicas advertise
+``role: prefill|decode|unified`` on heartbeats.  When BOTH a prefill
+tier and a decode tier are alive, a generate request takes the
+two-phase path: (1) pick a prefill replica — prefix-affinity first
+(shared system prompts concentrate where their KV pages live), then
+least-outstanding p2c — and call its ``prefill`` op; (2) forward the
+returned KV artifact (one raw binary frame, never re-encoded) to a
+decode replica picked by KV-page headroom (p2c over heartbeat-
+advertised free pages, saturated replicas skipped), which imports the
+pages and decodes.  Each phase retries onto a different replica within
+the shared ``max_retries`` budget; when a tier is empty — or the
+disaggregated path exhausts its retries — the request FALLS BACK to
+the unified tier, so an all-unified fleet (every existing deployment)
+routes exactly as before.  Plain generates never land on a
+prefill-role replica.
 """
 
 from __future__ import annotations
@@ -39,12 +55,13 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Any, Dict, Iterable, Optional
+from typing import Any, Dict, Iterable, List, Optional
 
 from tfmesos_tpu import prefixhash, wire
 from tfmesos_tpu.fleet.client import CallTimeout, ConnectionLost, MuxConnection
 from tfmesos_tpu.fleet.metrics import FleetMetrics
-from tfmesos_tpu.fleet.registry import ReplicaRegistry
+from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
+                                        ReplicaInfo, ReplicaRegistry)
 from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["RoutingError", "Router"]
@@ -116,15 +133,28 @@ class Router:
                 best = (score, r.addr)
         return best[1] if best is not None else None
 
-    def pick(self, exclude: Iterable[str] = (),
-             prompt=None) -> Optional[str]:
-        """Prefix-affinity choice when ``prompt`` is given and some
-        replica advertises a matching cache summary, else
-        power-of-two-choices over alive replicas not in ``exclude``;
-        ``None`` when no eligible replica exists."""
+    def _alive_by_role(self, roles, exclude=()) -> List[ReplicaInfo]:
         exclude = set(exclude)
-        cands = [r for r in self.registry.alive()
-                 if r.addr not in exclude]
+        return [r for r in self.registry.alive()
+                if r.addr not in exclude
+                and (r.role or UNIFIED) in roles]
+
+    def _load_pick(self, cands) -> Optional[str]:
+        """Least-outstanding with p2c sampling over ``cands``."""
+        addrs = [r.addr for r in cands]
+        if not addrs:
+            return None
+        if len(addrs) <= 2:
+            return min(addrs, key=self.outstanding)
+        a, b = self._rng.sample(addrs, 2)
+        return a if self.outstanding(a) <= self.outstanding(b) else b
+
+    def _pick_role(self, roles, exclude, prompt) -> Optional[str]:
+        """One choice policy for both prompt-bearing tiers:
+        prefix-affinity when ``prompt`` is given and some candidate
+        advertises a matching cache summary, else least-outstanding
+        p2c; ``None`` when no eligible replica exists."""
+        cands = self._alive_by_role(roles, exclude)
         if not cands:
             return None
         if prompt is not None and len(prompt):
@@ -133,11 +163,45 @@ class Router:
                              else "affinity_misses")
             if fav is not None:
                 return fav
-        addrs = [r.addr for r in cands]
-        if len(addrs) <= 2:
-            return min(addrs, key=self.outstanding)
-        a, b = self._rng.sample(addrs, 2)
-        return a if self.outstanding(a) <= self.outstanding(b) else b
+        return self._load_pick(cands)
+
+    def pick(self, exclude: Iterable[str] = (),
+             prompt=None) -> Optional[str]:
+        """The UNIFIED-path choice over alive unified replicas not in
+        ``exclude``.  Prefill-role replicas never appear here (they
+        refuse generate); decode-role replicas are reserved for
+        imported prefills, so the role split cannot silently turn a
+        decode tier back into a unified one."""
+        return self._pick_role((UNIFIED,), exclude, prompt)
+
+    def pick_prefill(self, exclude: Iterable[str] = (),
+                     prompt=None) -> Optional[str]:
+        """The prefill-tier choice: prefix-affinity first (a prompt
+        whose leading chunks are resident on some prefill replica
+        prefills only its tail there), then least-outstanding p2c —
+        the load signal is what spreads long prompts."""
+        return self._pick_role((PREFILL,), exclude, prompt)
+
+    def pick_decode(self, exclude: Iterable[str] = ()) -> Optional[str]:
+        """The decode-tier choice: p2c by advertised KV-page headroom
+        (the imported pages must FIT — load alone would happily pick a
+        replica whose pool is full of long-lived rows), saturated
+        replicas (outstanding >= capacity) skipped, ties broken by the
+        router's own outstanding count."""
+        cands = self._alive_by_role((DECODE,), exclude)
+        if not cands:
+            return None
+        unsat = [r for r in cands
+                 if not (r.capacity > 0
+                         and self.outstanding(r.addr) >= r.capacity)]
+        cands = unsat or cands
+
+        def score(r: ReplicaInfo):
+            return (r.kv_headroom, -self.outstanding(r.addr))
+
+        if len(cands) > 2:
+            cands = self._rng.sample(cands, 2)
+        return max(cands, key=score).addr
 
     # -- link management ---------------------------------------------------
 
@@ -169,14 +233,62 @@ class Router:
         if link is not None:
             link.close()
 
+    # -- failure classification (ONE copy of the retry policy) -------------
+    #
+    # Every phase loop (unified route, disagg prefill, disagg decode)
+    # shares the same taxonomy:
+    #   * CallTimeout — the CONNECTION is still up (per CallTimeout's
+    #     contract), only this request is slow.  Retry it elsewhere, but
+    #     do NOT collapse the shared link (that would abort every other
+    #     in-flight request on this replica) and do NOT mark the replica
+    #     dead.  The eventual late reply finds its slot gone and is
+    #     dropped; deterministic generation makes the duplicate work
+    #     harmless.
+    #   * ConnectionLost / OSError — the transport failed: drop the
+    #     link, mark the replica dead, back off, retry elsewhere.
+    #   * wire.WireError from call()/call_raw() is NEITHER: it is an
+    #     encode-time rejection of the PAYLOAD (oversized raw meta or
+    #     frame), raised before any bytes hit the socket — receive-side
+    #     wire corruption surfaces as ConnectionLost instead.  Each
+    #     phase handles it as deterministic for that payload: never
+    #     drop the (healthy, shared) link, never mark the replica dead,
+    #     never re-ship the identical doomed bytes to another replica.
+
+    def _note_timeout(self, addr: str, tried: set, attempt: int,
+                      what: str) -> None:
+        tried.add(addr)
+        self.metrics.inc("retries")
+        self.log.warning("%s timed out on %s after %.0fs; retrying on "
+                         "another replica (attempt %d/%d)", what, addr,
+                         self.request_timeout, attempt + 1,
+                         self.max_retries + 1)
+
+    def _note_link_failure(self, e: BaseException, addr: str, tried: set,
+                           attempt: int, what: str) -> None:
+        tried.add(addr)
+        self._drop_link(addr)
+        self.registry.mark_dead(addr, why=f"{type(e).__name__}: {e}")
+        self.metrics.inc("retries")
+        self.log.warning("%s replica %s failed (%s); retrying on "
+                         "another replica (attempt %d/%d)", what, addr, e,
+                         attempt + 1, self.max_retries + 1)
+        time.sleep(self.backoff_s * (2 ** attempt))
+
     # -- the routing loop --------------------------------------------------
 
     def route(self, msg: Dict[str, Any]) -> Any:
         """Send ``msg`` to a replica; on connection failure, retry on a
         different one (up to ``max_retries`` failovers, exponential
-        backoff)."""
-        tried = set()
+        backoff).  When both a prefill and a decode tier are alive, a
+        generate request takes the DISAGGREGATED prefill→transfer→
+        decode path first and falls back to the unified tier only when
+        that path cannot serve it."""
         last: Optional[BaseException] = None
+        if isinstance(msg, dict) and msg.get("op") == "generate":
+            out, last = self._route_disagg(msg)
+            if out is not None:
+                return out
+        tried = set()
         prompt = msg.get("prompt") if isinstance(msg, dict) else None
         for attempt in range(self.max_retries + 1):
             addr = self.pick(exclude=tried, prompt=prompt)
@@ -186,38 +298,201 @@ class Router:
                 link = self._link(addr)
                 return link.call(msg, timeout=self.request_timeout)
             except CallTimeout as e:
-                # The CONNECTION is still up (per CallTimeout's
-                # contract) — only this request is slow.  Retry it
-                # elsewhere, but do NOT collapse the shared link
-                # (that would abort every other in-flight request on
-                # this replica) and do NOT mark the replica dead.
-                # The eventual late reply finds its slot gone and is
-                # dropped; deterministic generation makes the
-                # duplicated work harmless.
                 last = e
-                tried.add(addr)
-                self.metrics.inc("retries")
-                self.log.warning("request timed out on %s after %.0fs; "
-                                 "retrying on another replica "
-                                 "(attempt %d/%d)", addr,
-                                 self.request_timeout, attempt + 1,
-                                 self.max_retries + 1)
-            except (ConnectionLost, OSError, wire.WireError) as e:
+                self._note_timeout(addr, tried, attempt, "request")
+            except wire.WireError as e:
+                # Deterministic for this request (it could not even be
+                # encoded): no replica can serve it.
+                raise RoutingError(
+                    f"request not encodable for {addr}: {e}") from e
+            except (ConnectionLost, OSError) as e:
                 last = e
-                tried.add(addr)
-                self._drop_link(addr)
-                self.registry.mark_dead(
-                    addr, why=f"{type(e).__name__}: {e}")
-                self.metrics.inc("retries")
-                self.log.warning("replica %s failed (%s); retrying on "
-                                 "another replica (attempt %d/%d)", addr, e,
-                                 attempt + 1, self.max_retries + 1)
-                time.sleep(self.backoff_s * (2 ** attempt))
+                self._note_link_failure(e, addr, tried, attempt,
+                                        "generate")
         if last is not None:
             raise RoutingError(
                 f"no replica could serve the request after trying "
                 f"{sorted(tried)}: {last}") from last
         raise RoutingError("no alive replicas")
+
+    # -- the disaggregated prefill -> transfer -> decode path --------------
+
+    def _route_disagg(self, msg: Dict[str, Any]) -> tuple:
+        """Try the two-phase path; returns ``(reply, last_error)`` —
+        ``reply`` is ``None`` when the caller should fall back to the
+        unified tier (a tier is empty, or the bounded retries ran out;
+        every such path counts ``disagg_fallback``).  Only an answer
+        DETERMINISTIC for the REQUEST (a completion, or a prefill-phase
+        bad_request — the request itself is invalid) short-circuits the
+        fallback: transient failures (internal errors, timeouts, dead
+        connections) retry onto a different replica and then fall back,
+        and a decode-phase bad_request (the tiers disagree about the
+        artifact, not the request) falls back too — a healthy unified
+        tier must still get its chance."""
+        prompt = msg.get("prompt")
+        if (prompt is None or not len(prompt)) \
+                and self._alive_by_role((UNIFIED,)):
+            # An invalid prompt gets its bad_request from a unified
+            # replica's own validation when one exists; in a PURE
+            # disagg fleet the request stays on this path so the
+            # prefill replica rejects it loudly — never an
+            # "unavailable: no alive replicas" for a malformed request.
+            return None, None
+        # Both tiers must be alive BEFORE phase 1 runs: with no decode
+        # replica the prefill compute (and its KV export) would be pure
+        # waste on the way to the unified fallback.  An all-unified
+        # fleet (neither tier exists) is not a "fallback" — it is the
+        # normal path; a LONE tier is one, and counts.
+        has_prefill = bool(self._alive_by_role((PREFILL,)))
+        has_decode = bool(self._alive_by_role((DECODE,)))
+        if not (has_prefill and has_decode):
+            if has_prefill or has_decode:
+                self.metrics.inc("disagg_fallback")
+            return None, None
+        last: Optional[BaseException] = None
+        ptried: set = set()
+        t0 = time.perf_counter()
+        for attempt in range(self.max_retries + 1):
+            paddr = self.pick_prefill(exclude=ptried, prompt=prompt)
+            if paddr is None:
+                break               # prefill tier exhausted
+            call = {"op": "prefill", "prompt": msg.get("prompt"),
+                    "max_new_tokens": msg.get("max_new_tokens"),
+                    "stop_token": msg.get("stop_token")}
+            try:
+                praw = self._link(paddr).call(
+                    call, timeout=self.request_timeout)
+            except CallTimeout as e:
+                last = e
+                self._note_timeout(paddr, ptried, attempt, "prefill")
+                continue
+            except wire.WireError as e:
+                # The prefill call is the same small JSON dict the
+                # unified path would send — if it cannot encode, no
+                # tier can serve it.
+                raise RoutingError(
+                    f"request not encodable for {paddr}: {e}") from e
+            except (ConnectionLost, OSError) as e:
+                last = e
+                self._note_link_failure(e, paddr, ptried, attempt,
+                                        "prefill")
+                continue
+            if isinstance(praw, dict):
+                if praw.get("kind") == "bad_request":
+                    # Deterministic rejection: retrying elsewhere (or
+                    # on the unified tier) cannot change the answer.
+                    return praw, None
+                # Transient replica-side failure (internal error, pool
+                # exhaustion): another prefill replica may serve it.
+                last = RoutingError(
+                    f"prefill failed on {paddr}: {praw.get('error')}")
+                ptried.add(paddr)
+                self.metrics.inc("retries")
+                continue
+            if not isinstance(praw, wire.RawFrame) \
+                    or not isinstance(praw.meta, dict):
+                last = RoutingError(
+                    f"malformed prefill reply from {paddr}")
+                ptried.add(paddr)
+                continue
+            ttft_ms = (time.perf_counter() - t0) * 1000.0
+            self.metrics.inc("disagg_prefills")
+            out, derr = self._disagg_decode(msg, praw)
+            if out is not None:
+                if isinstance(out, dict) and out.get("op") == "completion":
+                    # The first token exists the moment the prefill
+                    # reply lands; the decode replica's own ttft is the
+                    # import turnaround, not the user-visible one.
+                    dec_total = out.get("total_ms")
+                    dec_ttft = out.get("ttft_ms")
+                    if isinstance(dec_total, (int, float)) and \
+                            isinstance(dec_ttft, (int, float)):
+                        out["decode_ms"] = round(dec_total - dec_ttft, 3)
+                    out["ttft_ms"] = round(ttft_ms, 3)
+                    out["total_ms"] = round(
+                        (time.perf_counter() - t0) * 1000.0, 3)
+                    self.metrics.inc("disagg_requests")
+                return out, None
+            # The decode tier could not take this VALID artifact within
+            # its retry budget: re-running the whole prefill elsewhere
+            # cannot revive a decode replica — fall back without
+            # wasting another prompt's worth of compute.
+            last = derr or last
+            break
+        self.metrics.inc("disagg_fallback")
+        return None, last
+
+    def _disagg_decode(self, msg: Dict[str, Any],
+                       praw: "wire.RawFrame") -> tuple:
+        """Phase 2: forward the KV artifact to a decode replica as one
+        raw frame; bounded retry onto a different decode replica
+        (transient failures — connection loss, timeout, internal
+        errors — retry; a bad_request rejection is deterministic and
+        returns).  Returns ``(reply, last_error)`` with ``reply`` None
+        when the tier is exhausted."""
+        meta = {k: v for k, v in praw.meta.items()
+                if k not in ("op", "id", "prefill_ms")}
+        meta.update(op="generate", prompt=msg.get("prompt"),
+                    max_new_tokens=msg.get("max_new_tokens"),
+                    stop_token=msg.get("stop_token"))
+        last: Optional[BaseException] = None
+        dtried: set = set()
+        for attempt in range(self.max_retries + 1):
+            daddr = self.pick_decode(exclude=dtried)
+            if daddr is None:
+                return None, last
+            try:
+                t0 = time.perf_counter()
+                reply = self._link(daddr).call_raw(
+                    meta, praw.body, timeout=self.request_timeout)
+                self.metrics.observe(
+                    "kv_decode_turnaround_ms",
+                    (time.perf_counter() - t0) * 1000.0)
+                # Counted only on a delivered transfer: a retried or
+                # failed send must not inflate the bench's KV-transfer
+                # throughput.
+                self.metrics.inc("kv_transfer_bytes", len(praw.body))
+            except CallTimeout as e:
+                last = e
+                self._note_timeout(daddr, dtried, attempt,
+                                   "disagg decode")
+                continue
+            except wire.WireError as e:
+                # Deterministic for this ARTIFACT (its meta — prompt +
+                # manifest — or the frame overflows the raw bounds),
+                # not for the request: every decode replica would
+                # reject the identical bytes, but a unified replica
+                # serves the plain generate without them.  Fall back
+                # without touching the healthy link.
+                return None, RoutingError(
+                    f"KV transfer to {daddr} not encodable: {e}")
+            except (ConnectionLost, OSError) as e:
+                last = e
+                self._note_link_failure(e, daddr, dtried, attempt,
+                                        "disagg decode")
+                continue
+            if isinstance(reply, dict) and reply.get("op") == "error":
+                if reply.get("kind") == "bad_request":
+                    # Deterministic for THIS artifact (a config
+                    # mismatch between the tiers), not for the
+                    # request: a unified replica can still serve the
+                    # plain generate, so fall back instead of failing
+                    # the client outright.  No retry within the tier —
+                    # a homogeneous decode tier rejects everywhere,
+                    # and each retry re-ships a multi-MB body.
+                    return None, RoutingError(
+                        f"decode replica {daddr} rejected the KV "
+                        f"artifact: {reply.get('error')}")
+                # Transient decode-side failure: another decode replica
+                # (or the unified fallback) may still serve it.
+                last = RoutingError(
+                    f"decode failed on {daddr}: {reply.get('error')}")
+                dtried.add(daddr)
+                self.metrics.inc("retries")
+                continue
+            self.metrics.inc("disagg_decodes")
+            return reply, None
+        return None, last
 
     def close(self) -> None:
         with self._lock:

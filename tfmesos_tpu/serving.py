@@ -52,9 +52,11 @@ from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
                                             greedy_accept_counts,
                                             init_paged_cache,
                                             rejection_accept, sample_logits)
+from tfmesos_tpu.ops.quant import QTensor
 
 __all__ = ["Request", "Completion", "ContinuousBatcher",
-           "SubmissionQueue"]
+           "SubmissionQueue", "Prefilled", "pack_prefilled",
+           "unpack_prefilled"]
 
 # SubmissionQueue.poll's end-of-stream marker (distinct from None, which
 # means "nothing available right now, more may come").
@@ -78,9 +80,9 @@ class SubmissionQueue:
         self._closed = False
         self._lock = threading.Lock()
 
-    def submit(self, request: "Request") -> None:
-        if not isinstance(request, Request):
-            raise TypeError(f"submit() takes a Request, got "
+    def submit(self, request) -> None:
+        if not isinstance(request, (Request, Prefilled)):
+            raise TypeError(f"submit() takes a Request or Prefilled, got "
                             f"{type(request).__name__}")
         with self._lock:
             if self._closed:
@@ -132,6 +134,90 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(f"Request.max_new_tokens must be >= 1, got "
                              f"{self.max_new_tokens}")
+
+
+@dataclasses.dataclass
+class Prefilled:
+    """One IMPORTED prefill — the disaggregated-serving admission unit:
+    the original :class:`Request` plus the KV artifact a prefill-role
+    batcher exported for it (:meth:`ContinuousBatcher.export_kv`).
+    Submit one with ``submit(request, prefilled=artifact)`` (or put it
+    on a ``run()`` iterable directly): admission installs the artifact's
+    pages into the local pool and the row enters decode with the
+    prefill's first token already emitted — no prefill compute runs on
+    the importing batcher."""
+
+    request: Request
+    artifact: dict
+
+    def __post_init__(self):
+        if not isinstance(self.request, Request):
+            raise TypeError("Prefilled.request must be a Request")
+        if not isinstance(self.artifact, dict):
+            raise TypeError("Prefilled.artifact must be an export_kv() "
+                            "artifact dict")
+
+
+# Artifact array leaves, in their fixed wire order (pack/unpack below).
+_KV_ARRAY_KEYS = ("k", "v", "k_scales", "v_scales")
+# Everything else in the artifact is a small scalar/dict header.
+_KV_META_KEYS = ("version", "page_size", "prefix_len", "shared_len",
+                 "pos", "prompt_len", "first_token", "rid", "quantized",
+                 "model")
+
+
+def pack_prefilled(artifact: dict) -> tuple:
+    """Split an :meth:`~ContinuousBatcher.export_kv` artifact into a
+    small JSON-encodable ``meta`` dict and one contiguous ``body`` buffer —
+    the shape :func:`tfmesos_tpu.wire.send_raw_msg` ships without
+    re-encoding multi-MB tensor data.  The caller may merge transport
+    fields (``op``/``id``/request params) into ``meta`` before
+    sending."""
+    meta = {k: artifact[k] for k in _KV_META_KEYS if k in artifact}
+    specs, parts = [], []
+    for name in _KV_ARRAY_KEYS:
+        a = artifact.get(name)
+        if a is None:
+            continue
+        a = np.ascontiguousarray(a)
+        specs.append({"name": name, "dtype": str(a.dtype),
+                      "shape": list(a.shape)})
+        parts.append(a)
+    meta["arrays"] = specs
+    return meta, b"".join(memoryview(a).cast("B") for a in parts)
+
+
+def unpack_prefilled(meta: dict, body) -> dict:
+    """Inverse of :func:`pack_prefilled`: rebuild the artifact dict from
+    a received raw frame.  Array leaves are zero-copy views into
+    ``body``; malformed frames raise ``ValueError`` (the import
+    admission path rejects them as bad requests)."""
+    art = {k: meta[k] for k in _KV_META_KEYS if k in meta}
+    specs = meta.get("arrays")
+    if not isinstance(specs, (list, tuple)):
+        raise ValueError("prefilled meta carries no array manifest")
+    view = memoryview(body).cast("B")
+    off = 0
+    for spec in specs:
+        try:
+            name = spec["name"]
+            dtype = np.dtype(spec["dtype"])
+            shape = tuple(int(d) for d in spec["shape"])
+        except (TypeError, KeyError, ValueError) as e:
+            raise ValueError(f"bad prefilled array spec {spec!r}") from e
+        if name not in _KV_ARRAY_KEYS:
+            raise ValueError(f"unexpected prefilled array {name!r}")
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        nbytes = count * dtype.itemsize
+        if off + nbytes > len(view):
+            raise ValueError("prefilled body shorter than its manifest")
+        art[name] = np.frombuffer(view, dtype=dtype, count=count,
+                                  offset=off).reshape(shape)
+        off += nbytes
+    if off != len(view):
+        raise ValueError(f"prefilled body has {len(view) - off} trailing "
+                         f"bytes beyond its manifest")
+    return art
 
 
 @dataclasses.dataclass
@@ -677,6 +763,24 @@ class _PrefixCache:
                                for n in nodes[:max_entries]]}
 
 
+@jax.jit
+def _gather_pages(pool, ids):
+    """Gather pool pages ``ids`` (page axis 1) on every layer and leaf —
+    K and V, int8 QTensor values and scales alike: the device side of
+    :meth:`ContinuousBatcher.export_kv`.  One trace per page count."""
+    return jax.tree_util.tree_map(lambda buf: buf[:, ids], pool)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _install_pages(pool, payload, ids):
+    """Scatter an imported page payload (same tree structure as the
+    pool, page axis 1 sized to ``ids``) into pool pages ``ids`` — the
+    device side of the ``submit(prefilled=...)`` import admission.  One
+    trace per page count."""
+    return jax.tree_util.tree_map(
+        lambda buf, src: buf.at[:, ids].set(src), pool, payload)
+
+
 @partial(jax.jit, donate_argnums=0)
 def _copy_page(pool, src, dst):
     """Copy pool page ``src`` into page ``dst`` on every layer and leaf
@@ -792,6 +896,19 @@ class ContinuousBatcher:
     ``mesh``, and ``prefix``; speculative decoding and
     ``quantized_cache`` BYPASS sharing explicitly
     (``prefix_cache_bypass_reason``).
+
+    DISAGGREGATED serving splits the two phases across batchers:
+    :meth:`export_kv` runs a prompt through (chunked) prefill only and
+    returns its paged-KV state as a host artifact; a matching batcher
+    imports it with ``submit(request, prefilled=artifact)`` — pages
+    install into the local pool, the row enters decode directly, and
+    greedy completions equal the unified batcher's token-for-token
+    (sampled ones too, when the batchers share an rng: the artifact
+    carries the sampler's rid fold).  Imported full prompt pages seed
+    the importer's prefix cache like a local prefill's.  Requires a
+    single-shard pool and no speculative draft; int8 pools export/import
+    bit-exactly.  The fleet's prefill/decode role split
+    (docs/SERVING.md "Disaggregated prefill/decode") rides this surface.
     """
 
     def __init__(self, cfg: TransformerConfig, params, rows: int = 8,
@@ -953,6 +1070,12 @@ class ContinuousBatcher:
         # plain run(iterable) batchers never pay for it.
         self._submissions: Optional[SubmissionQueue] = None
         self._submissions_lock = threading.Lock()
+        # Disaggregated serving (export_kv / submit(prefilled=...)):
+        # prefill-only exports serialize on this lock and borrow row 0,
+        # so they must never run concurrently with a serve loop (the
+        # loop owns the rows); _loop_active fences that.
+        self._export_lock = threading.Lock()
+        self._loop_active = False
         # Speculative observability (see acceptance_rate).
         self.spec_rounds = 0        # jitted rounds executed
         self.spec_row_rounds = 0    # row-rounds (rows decoding per round)
@@ -1533,7 +1656,8 @@ class ContinuousBatcher:
         return None
 
     def _admit_row(self, free_rows: List[int], active: Dict[int, _Row],
-                   wt: int, wd: int, req: Request) -> tuple:
+                   wt: int, wd: int, req: Request,
+                   use_cache: bool = True) -> tuple:
         """Pop a free row whose shard's pool(s) can take both worst-case
         reservations, preferring the shard with the longest cached
         prefix for ``req`` (pages are shard-pinned, so a hit is only a
@@ -1552,7 +1676,8 @@ class ContinuousBatcher:
                 ht = self.t_side.headroom(active,
                                           lambda x: x.worst_pages, s)
                 plan = (self._prefix_plan(req, s)
-                        if self._pcache is not None else None)
+                        if self._pcache is not None and use_cache
+                        else None)
                 while True:
                     wt_s = wt - (plan.save if plan is not None else 0)
                     ht_s = ht
@@ -1609,13 +1734,238 @@ class ContinuousBatcher:
 
     # -- incremental (online) submission ----------------------------------
 
-    def validate(self, req: Request) -> None:
-        """Raise ``ValueError`` if ``req`` can never be served by this
-        batcher (prefix + padded prompt + new tokens exceed max_len).
+    def validate(self, req) -> None:
+        """Raise ``ValueError`` if ``req`` (a :class:`Request` or
+        :class:`Prefilled`) can never be served by this batcher
+        (prefix + padded prompt + new tokens exceed max_len; for an
+        import, an artifact whose geometry does not match this pool).
         Online front doors call this at ingress so an un-servable
         request is rejected immediately instead of via run()'s
         drain-then-raise path."""
+        if isinstance(req, Prefilled):
+            self._worst_pages(req.request)
+            self._validate_artifact(req.artifact, req.request)
+            return
         self._worst_pages(req)
+
+    # -- disaggregated serving: KV export / import -------------------------
+
+    def _check_disagg_mode(self, what: str) -> None:
+        if self.d_side is not None:
+            raise ValueError(f"{what} does not compose with speculative "
+                             f"decoding (the draft pool's state would "
+                             f"need coupled transfer)")
+        if self.n_shards != 1:
+            raise ValueError(f"{what} requires a single-shard pool "
+                             f"(mesh data shards pin pages locally)")
+
+    def kv_headroom(self) -> int:
+        """Free KV pool pages this batcher could hand to a new request
+        right now: the free list plus zero-ref cached prefix pages (the
+        allocator reclaims those on demand).  A heartbeat-grade load
+        signal — it does NOT subtract in-flight rows' unallocated
+        reservations — which decode-tier routing uses to place imported
+        prefills where the pages are."""
+        free = self.t_side.alloc.free_count()
+        if self._pcache is not None:
+            free += sum(self._pcache.reclaimable(s)
+                        for s in range(self.n_shards))
+        return free
+
+    def export_kv(self, request: Request) -> dict:
+        """PREFILL-ONLY execution: run ``request``'s prompt through this
+        batcher's (chunked) prefill on a borrowed row and return its
+        paged-KV state as a compact host artifact — per-layer page
+        buffers for every position past the shared prefix (int8 pools
+        export values AND scales bit-exactly), page-table/geometry
+        metadata, and the sampler state (first token, the ``rid`` whose
+        in-graph key folds produced it).  The row's pages are released
+        before returning; a matching batcher imports the artifact with
+        ``submit(request, prefilled=artifact)`` and enters decode
+        directly, token-for-token equivalent to admitting the request
+        here.  Prefix-cache hits apply (a warm shared system prompt
+        prefills only its tail) and the freshly prefilled pages are
+        published for later exports.
+
+        This is the prefill-role replica's serving surface: it must not
+        run concurrently with this batcher's own serve loop (exports
+        borrow row 0); concurrent export_kv calls serialize."""
+        if not isinstance(request, Request):
+            raise TypeError(f"export_kv() takes a Request, got "
+                            f"{type(request).__name__}")
+        self._check_disagg_mode("export_kv")
+        with self._export_lock:
+            if self._loop_active:
+                raise RuntimeError(
+                    "export_kv cannot run concurrently with this "
+                    "batcher's serve loop (prefill-role batchers never "
+                    "start one)")
+            wt, wd, need = self._worst_pages(request)
+            active: Dict[int, _Row] = {}
+            row, plan = self._admit_row([0], active, wt, wd, request)
+            assert row == 0     # nothing in flight: fit, or _admit_row raised
+            rid = self._next_rid
+            self._next_rid += 1
+            try:
+                res = self._admit_dispatch(row, rid, request, wt, wd,
+                                           need, active, plan)
+                state = active[row]
+                if res is not None:
+                    _, st, tok, s = res
+                    st.t_first = time.perf_counter()
+                    first = int(np.asarray(tok)[s])
+                    st.last = first
+                    st.out = [first]
+                else:
+                    # Chunked mode: drive the per-tick chunk writer to
+                    # completion (no decode interleaves here — the whole
+                    # point of a dedicated prefill tier).
+                    while not state.decoding:
+                        if self._advance_prefill(active) is not None:
+                            break
+                return self._export_row(row, state)
+            finally:
+                # Unconditional: a failed dispatch may have allocated
+                # pages before raising, and _finish releases safely
+                # even when the row never became active.
+                self._finish(row, active, [])
+
+    def _export_row(self, row: int, state: _Row) -> dict:
+        """Snapshot ``row``'s post-prefill KV into a host artifact: the
+        pages covering absolute positions [shared_len, pos) — cached
+        prefix pages and own pages alike, in table order — pulled to
+        host in one gather.  Shared-prefix pages are NOT exported: a
+        same-``prefix`` importer already holds identical ones (both
+        sides prefilled the same tokens with the same params)."""
+        side = self.t_side
+        ps = self.page_size
+        ns = len(side.shared_pages)
+        E = state.pos
+        n = -(-(E - side.shared_len) // ps)
+        ids = np.asarray(side.table_np()[row, ns:ns + n], np.int32)
+        kv = _gather_pages(self.pool, jnp.asarray(ids))
+        quantized = isinstance(self.pool["k"], QTensor)
+        art = {
+            "version": 1,
+            "page_size": ps,
+            "prefix_len": self.prefix_len,
+            "shared_len": side.shared_len,
+            "pos": int(E),
+            "prompt_len": int(state.req.prompt.size),
+            "first_token": int(state.out[0]),
+            "rid": int(state.rid),
+            "quantized": quantized,
+            "model": {"n_layers": int(self.cfg.n_layers),
+                      "kv_heads": int(self.cfg.kv_heads),
+                      "head_dim": int(self.cfg.head_dim)},
+        }
+        if quantized:
+            art["k"] = np.asarray(kv["k"].values)
+            art["k_scales"] = np.asarray(kv["k"].scales)
+            art["v"] = np.asarray(kv["v"].values)
+            art["v_scales"] = np.asarray(kv["v"].scales)
+        else:
+            art["k"] = np.asarray(kv["k"])
+            art["v"] = np.asarray(kv["v"])
+        return art
+
+    def _validate_artifact(self, art: dict, req: Request) -> None:
+        """Reject an import whose artifact cannot drop into THIS pool
+        bit-exactly — every mismatch is a loud ``ValueError`` (the
+        fleet's bad_request), never a silently wrong decode."""
+        self._check_disagg_mode("submit(prefilled=...)")
+        if art.get("version") != 1:
+            raise ValueError(f"unknown KV artifact version "
+                             f"{art.get('version')!r}")
+        quantized = isinstance(self.pool["k"], QTensor)
+        for key, want in (("page_size", self.page_size),
+                          ("prefix_len", self.prefix_len),
+                          ("shared_len", self.t_side.shared_len),
+                          ("quantized", quantized)):
+            if art.get(key) != want:
+                raise ValueError(
+                    f"KV artifact {key} {art.get(key)!r} does not match "
+                    f"this batcher's {want!r}")
+        model = art.get("model") or {}
+        for key, want in (("n_layers", int(self.cfg.n_layers)),
+                          ("kv_heads", int(self.cfg.kv_heads)),
+                          ("head_dim", int(self.cfg.head_dim))):
+            if model.get(key) != want:
+                raise ValueError(
+                    f"KV artifact model {key} {model.get(key)!r} does "
+                    f"not match this config's {want}")
+        E = art.get("pos")
+        if E != self.prefix_len + int(req.prompt.size) \
+                or E != art.get("prompt_len", -1) + self.prefix_len:
+            raise ValueError(
+                f"KV artifact covers {E!r} positions; this request needs "
+                f"prefix {self.prefix_len} + prompt {req.prompt.size}")
+        n = -(-(E - self.t_side.shared_len) // self.page_size)
+        pool_k = self.pool["k"].values if quantized else self.pool["k"]
+        want_shape = (int(self.cfg.n_layers), n, int(self.cfg.kv_heads),
+                      self.page_size, int(self.cfg.head_dim))
+        keys = _KV_ARRAY_KEYS if quantized else _KV_ARRAY_KEYS[:2]
+        for key in keys:
+            a = art.get(key)
+            if not isinstance(a, np.ndarray):
+                raise ValueError(f"KV artifact is missing array {key!r}")
+            if key.endswith("_scales"):
+                want = want_shape[:3] + (1, self.page_size)
+                dtype = np.float32
+            else:
+                want = want_shape
+                dtype = np.dtype(pool_k.dtype)
+            if a.shape != want:
+                raise ValueError(f"KV artifact {key} shape {a.shape} != "
+                                 f"expected {want}")
+            if a.dtype != dtype:
+                raise ValueError(f"KV artifact {key} dtype {a.dtype} != "
+                                 f"pool dtype {dtype}")
+
+    def _admit_import(self, row: int, pre: Prefilled, wt: int,
+                      wd: int, need: int, active: Dict[int, _Row]
+                      ) -> tuple:
+        """Admission of an imported prefill: back the payload's
+        positions with own pages, scatter the artifact's page buffers
+        into them, and enter the row straight into decode at the
+        exported position with the exported first token — the
+        disaggregated analogue of _admit_dispatch, with no model call.
+        The imported full prompt pages then seed the prefix cache
+        exactly like a local prefill's (insert_row already refuses a
+        chunk a twin published, so pages never gain two owners)."""
+        t_admit = time.perf_counter()
+        art = pre.artifact
+        req = pre.request
+        side = self.t_side
+        n = art["k"].shape[1]
+        side.ensure(row, side.shared_len + n * self.page_size)
+        ids = side.alloc.rows[row]
+        if art["quantized"]:
+            payload = {
+                "k": QTensor(jnp.asarray(art["k"]),
+                             jnp.asarray(art["k_scales"])),
+                "v": QTensor(jnp.asarray(art["v"]),
+                             jnp.asarray(art["v_scales"])),
+            }
+        else:
+            payload = {"k": jnp.asarray(art["k"]),
+                       "v": jnp.asarray(art["v"])}
+        self.pool = _install_pages(self.pool, payload,
+                                   jnp.asarray(ids, jnp.int32))
+        # The exported rid keeps the row's in-graph sampling folds on
+        # the stream the prefill side started (greedy never reads it;
+        # with equal batcher rngs, sampled disaggregated streams equal
+        # the unified batcher's exactly).  Caveat: rids from DIFFERENT
+        # exporters (or an exporter and this batcher's own counter) can
+        # coincide, correlating the sampled draws of unrelated rows —
+        # deployments sampling across several prefill replicas should
+        # give them distinct seeds/rngs.
+        state = _Row(rid=int(art["rid"]), req=req, pos=int(art["pos"]),
+                     step=1, last=0, out=[], worst_pages=wt,
+                     worst_draft=wd, t_admit=t_admit, limit=need)
+        active[row] = state
+        self._pcache_insert(row, state)
+        return row, state, np.asarray([int(art["first_token"])]), 0
 
     def _submission_source(self) -> SubmissionQueue:
         with self._submissions_lock:
@@ -1623,10 +1973,18 @@ class ContinuousBatcher:
                 self._submissions = SubmissionQueue()
             return self._submissions
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request, prefilled: Optional[dict] = None
+               ) -> None:
         """Thread-safe online admission: queue ``request`` for the
         :meth:`serve` loop.  May be called from any thread, before or
-        while serve() runs; raises after :meth:`close`."""
+        while serve() runs; raises after :meth:`close`.
+
+        ``prefilled`` (an :meth:`export_kv` artifact) switches the
+        request onto the IMPORT path: its KV pages install into the
+        local pool and the row enters decode directly — the decode half
+        of disaggregated serving."""
+        if prefilled is not None:
+            request = Prefilled(request, prefilled)
         self._submission_source().submit(request)
 
     def close(self) -> None:
@@ -1686,6 +2044,12 @@ class ContinuousBatcher:
             except StopIteration:
                 exhausted = True
 
+        # Fences export_kv's row borrowing: taken under _export_lock so
+        # the check-then-borrow in export_kv and this set cannot
+        # interleave (a loop starting mid-export waits the export out;
+        # an export starting after this sees the flag and raises).
+        with self._export_lock:
+            self._loop_active = True
         try:
             while True:
                 # Admit while a row is free and the pool can take the
@@ -1709,20 +2073,39 @@ class ContinuousBatcher:
                     pull(block=False)
                     if not pending:
                         break
+                    item = pending[0]
+                    imported = isinstance(item, Prefilled)
+                    req0 = item.request if imported else item
                     try:
-                        wt, wd, need = self._worst_pages(pending[0])
+                        wt, wd, need = self._worst_pages(req0)
+                        if imported:
+                            self._validate_artifact(item.artifact, req0)
                     except ValueError as e:
                         bad_request = e     # raise after draining
                         break
+                    # Imports skip the prefix-plan mapping: their pages
+                    # arrive in the payload (installing everything, then
+                    # publishing, is what keeps import admission one
+                    # code path with local prefill).
                     row, plan = self._admit_row(free_rows, active, wt,
-                                                wd, pending[0])
+                                                wd, req0,
+                                                use_cache=not imported)
                     if row is None:
                         break   # wait for an in-flight row to finish
-                    req = pending.popleft()
-                    rid = self._next_rid
-                    self._next_rid += 1
-                    res = self._admit_dispatch(row, rid, req, wt, wd,
-                                               need, active, plan)
+                    pending.popleft()
+                    if imported:
+                        # Imports keep their exporter's rid (the
+                        # sampling folds must continue that stream) —
+                        # the local counter is neither consulted nor
+                        # burned.
+                        res = self._admit_import(row, item, wt, wd,
+                                                 need, active)
+                    else:
+                        rid = self._next_rid
+                        self._next_rid += 1
+                        res = self._admit_dispatch(row, rid, item, wt,
+                                                   wd, need, active,
+                                                   plan)
                     if res is not None:
                         burst.append(res)
                 yield from self._finalize_burst(burst, active, free_rows)
@@ -1755,6 +2138,11 @@ class ContinuousBatcher:
             self._inflight = None
             for row in list(active):
                 self._finish(row, active, free_rows)
+            # Dropped only after the rows are released, so an export
+            # admitted the instant the fence clears can never borrow a
+            # row the dying loop still owns.
+            with self._export_lock:
+                self._loop_active = False
 
     def _ensure_sides(self, row: int, length: int) -> None:
         """Back ABSOLUTE positions [0, length) of ``row`` on the target

@@ -15,6 +15,31 @@ Frame layout::
 
 ``len`` counts tag + body.  When ``token`` is empty the tag is still present
 but computed with the empty key, keeping the frame layout static.
+
+RAW frames (disaggregated serving's KV-page transfer) carry multi-MB
+tensor payloads that must not round-trip through a text encoding: the
+length prefix's TOP BIT marks the frame raw (JSON frames cap at
+``MAX_FRAME`` = 64 MiB, so the bit is never set on one — old receivers
+reject a raw frame loudly as oversized instead of mis-framing), and the
+payload is::
+
+    +----------------------+--------------+-----------+------+
+    | 32B HMAC-SHA256 tag  | 4B meta len  | JSON meta | body |
+    +----------------------+--------------+-----------+------+
+
+decoded to a :class:`RawFrame`.  The tag covers everything after it
+(meta length + meta + body) and is verified BEFORE the metadata is
+decoded.  The meta header is JSON on purpose: a pickle header would
+hand arbitrary code execution to any token holder (serve clients get
+the token), where JSON caps the blast radius at request injection —
+the same trust boundary every JSON frame already grants.  The body is
+never copied through an encoder: ``send_raw_msg`` writes the caller's
+buffer straight to the socket.  Raw DECODING is opt-in per stream
+(``Framer(allow_raw=True)`` / ``recv_msg(allow_raw=True)``): only
+links that legitimately carry KV payloads widen their pre-auth
+buffering bound from ``MAX_FRAME`` (64 MiB) to ``MAX_RAW_FRAME``
+(1 GiB); every other listener rejects the raw bit at the 4-byte
+length prefix.
 """
 
 from __future__ import annotations
@@ -30,6 +55,11 @@ from typing import Any, List, Optional
 _LEN = struct.Struct(">I")
 TAG_SIZE = hashlib.sha256().digest_size  # 32
 MAX_FRAME = 64 * 1024 * 1024  # sanity bound; control messages are tiny
+# Raw (binary) frames: top bit of the length prefix set; bound sized for
+# KV-page payloads (whole paged pools are O(100 MB) at serving scale).
+RAW_FLAG = 0x80000000
+MAX_RAW_FRAME = 1 << 30  # 1 GiB
+MAX_RAW_META = 1 << 20   # JSON metadata is a small dict
 
 TOKEN_ENV = "TPUMESOS_TOKEN"
 TOKEN_FILE_ENV = "TPUMESOS_TOKEN_FILE"
@@ -37,6 +67,24 @@ TOKEN_FILE_ENV = "TPUMESOS_TOKEN_FILE"
 
 class WireError(Exception):
     """Malformed, oversized, or unauthenticated frame."""
+
+
+class RawFrame:
+    """A decoded raw binary frame: small ``meta`` (any JSON-encodable
+    object, in practice a dict with ``op``/``id`` like the JSON
+    messages) plus a zero-copy ``body`` (bytes).  Sent with :func:`send_raw_msg`;
+    an ``allow_raw`` ``recv_msg``/``Framer`` yields one wherever a JSON
+    message could appear, so both kinds interleave on one
+    authenticated stream."""
+
+    __slots__ = ("meta", "body")
+
+    def __init__(self, meta: Any, body: bytes):
+        self.meta = meta
+        self.body = body
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"RawFrame(meta={self.meta!r}, body=<{len(self.body)}B>)"
 
 
 # Fault-injection hooks (chaos.FaultPlan.install): consulted per framed
@@ -111,6 +159,68 @@ def send_msg(sock: socket.socket, obj: Any, token: str = "") -> None:
     sock.sendall(data)
 
 
+def _decode_raw(payload: bytes, token: str) -> RawFrame:
+    if len(payload) < TAG_SIZE + _LEN.size:
+        raise WireError("raw frame shorter than tag + meta length")
+    tag, rest = payload[:TAG_SIZE], memoryview(payload)[TAG_SIZE:]
+    if not hmac.compare_digest(tag, _tag(token, rest)):
+        raise WireError("bad auth tag on raw frame")
+    (meta_len,) = _LEN.unpack(rest[:_LEN.size])
+    if meta_len > MAX_RAW_META or _LEN.size + meta_len > len(rest):
+        raise WireError(f"bad raw meta length {meta_len}")
+    # JSON, never pickle: an authenticated peer must not gain code
+    # execution from a crafted meta header (clients hold the token too).
+    try:
+        meta = json.loads(
+            bytes(rest[_LEN.size:_LEN.size + meta_len]).decode("utf-8"))
+    except Exception as e:
+        raise WireError(f"bad raw meta: {e!r}") from e
+    return RawFrame(meta, bytes(rest[_LEN.size + meta_len:]))
+
+
+def encode_raw(meta: Any, body, token: str = "") -> bytes:
+    """One raw frame as contiguous bytes (tests / chaos hooks; the hot
+    path is :func:`send_raw_msg`, which never concatenates the body)."""
+    header, mv = _raw_parts(meta, body, token)
+    return header + bytes(mv)
+
+
+def _raw_parts(meta: Any, body, token: str):
+    """(header bytes, body memoryview) for one raw frame."""
+    meta_b = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    if len(meta_b) > MAX_RAW_META:
+        raise WireError(f"raw meta of {len(meta_b)} bytes exceeds limit")
+    mv = memoryview(body).cast("B") if not isinstance(body, bytes) \
+        else memoryview(body)
+    length = TAG_SIZE + _LEN.size + len(meta_b) + len(mv)
+    if length > MAX_RAW_FRAME:
+        raise WireError(f"raw frame of {length} bytes exceeds limit")
+    ml = _LEN.pack(len(meta_b))
+    mac = hmac.new(token.encode("utf-8"), ml, hashlib.sha256)
+    mac.update(meta_b)
+    mac.update(mv)
+    header = _LEN.pack(RAW_FLAG | length) + mac.digest() + ml + meta_b
+    return header, mv
+
+
+def send_raw_msg(sock: socket.socket, meta: Any, body,
+                 token: str = "") -> None:
+    """Send one raw frame: ``meta`` (JSON-encodable header) + ``body`` (bytes
+    or any buffer), HMAC-tagged like every other frame.  The body goes
+    to the socket straight from the caller's buffer — no text encoding
+    or concatenation of multi-MB payloads."""
+    header, mv = _raw_parts(meta, body, token)
+    hook = _chaos_send    # snapshot against a concurrent uninstall
+    if hook is not None:
+        data = header + bytes(mv)   # chaos-only path; copies are fine
+        if hook(sock, data):
+            return
+        sock.sendall(data)
+        return
+    sock.sendall(header)
+    sock.sendall(mv)
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
     got = 0
@@ -123,11 +233,25 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_msg(sock: socket.socket, token: str = "") -> Any:
+def recv_msg(sock: socket.socket, token: str = "",
+             allow_raw: bool = False) -> Any:
+    """Next message: a decoded JSON object, or (with ``allow_raw``) a
+    :class:`RawFrame`.  Raw framing is OPT-IN per stream: an
+    unauthenticated peer could otherwise claim a ``MAX_RAW_FRAME``
+    (1 GiB) length and force that much buffering before the tag check,
+    so listeners that never expect KV payloads keep the 64 MiB
+    pre-auth bound and reject the raw bit outright."""
     hook = _chaos_recv    # snapshot against a concurrent uninstall
     if hook is not None:
         hook(sock)
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length & RAW_FLAG:
+        if not allow_raw:
+            raise WireError("raw frame not accepted on this stream")
+        length &= ~RAW_FLAG
+        if length > MAX_RAW_FRAME:
+            raise WireError(f"raw frame of {length} bytes exceeds limit")
+        return _decode_raw(_recv_exact(sock, length), token)
     if length > MAX_FRAME:
         raise WireError(f"frame of {length} bytes exceeds limit")
     return _decode_body(_recv_exact(sock, length), token)
@@ -141,9 +265,15 @@ class Framer:
     and pulls complete decoded messages out.
     """
 
-    def __init__(self, token: str = "") -> None:
+    def __init__(self, token: str = "", allow_raw: bool = False) -> None:
         self._token = token
         self._buf = bytearray()
+        # Raw framing is opt-in per stream (see recv_msg): only links
+        # that legitimately carry KV payloads (replica servers, mux
+        # connections) widen their pre-auth buffering bound to
+        # MAX_RAW_FRAME; everything else rejects the raw bit at the
+        # 4-byte prefix, before any body buffers.
+        self._allow_raw = allow_raw
 
     def feed(self, data: bytes) -> List[Any]:
         self._buf.extend(data)
@@ -152,14 +282,24 @@ class Framer:
             if len(self._buf) < _LEN.size:
                 break
             (length,) = _LEN.unpack(bytes(self._buf[: _LEN.size]))
-            if length > MAX_FRAME:
+            raw = bool(length & RAW_FLAG)
+            if raw:
+                if not self._allow_raw:
+                    raise WireError("raw frame not accepted on this "
+                                    "stream")
+                length &= ~RAW_FLAG
+                if length > MAX_RAW_FRAME:
+                    raise WireError(f"raw frame of {length} bytes "
+                                    f"exceeds limit")
+            elif length > MAX_FRAME:
                 raise WireError(f"frame of {length} bytes exceeds limit")
             end = _LEN.size + length
             if len(self._buf) < end:
                 break
             payload = bytes(self._buf[_LEN.size : end])
             del self._buf[:end]
-            out.append(_decode_body(payload, self._token))
+            out.append(_decode_raw(payload, self._token) if raw
+                       else _decode_body(payload, self._token))
         return out
 
 
