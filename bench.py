@@ -17,9 +17,15 @@ printed so an external timeout still leaves a parseable result; the final
 full line supersedes it)
 
 If the accelerator is unreachable (a wedged remote-attach relay hangs jax
-backend init — this lost round 2's entire benchmark), the probe retries a
-few times and then reruns the headline on CPU, emitting a real measured
-value tagged ``"degraded"`` instead of a useless ``value: null``.
+backend init — this lost round 2's entire benchmark), the probe fails
+over to CPU after the FIRST hang by default (round 4 burned 3x120s of
+budget on retries that never cleared), emitting a real measured value
+tagged ``"degraded"`` instead of a useless ``value: null``.  Knobs:
+``TPUMESOS_PROBE_TIMEOUT_S`` (seconds per attempt, default 120) and
+``TPUMESOS_PROBE_RETRIES`` (total attempts, default 1; raise it on hosts
+whose relay claims are known to expire).  The round-2-era names
+``TPUMESOS_BENCH_PROBE_TIMEOUT`` / ``TPUMESOS_BENCH_PROBE_ATTEMPTS``
+are honored as fallbacks.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 baseline is our own round-1 value measured by the driver under this same
@@ -552,6 +558,11 @@ def bench_serving_continuous(n_requests=32, rows=8, tiny=False):
     dt = time.perf_counter() - t0
     assert len(done) == n_requests
     mean_ttft_ms = 1000.0 * sum(c.ttft_s for c in done) / n_requests
+    # Decode-phase inter-token p50 of the BASELINE loop — the number
+    # the pipelined-decode bench (bench_serving_pipeline) is measured
+    # against, recorded here so every round has the un-pipelined
+    # reference even when the pipeline section is skipped.
+    decode_itl_p50_ms = _itl_p50_ms(done)
 
     # Overlap mode: tick t+1 dispatched before tick t's tokens sync —
     # the win is one host round-trip per generated token, which through
@@ -581,7 +592,75 @@ def bench_serving_continuous(n_requests=32, rows=8, tiny=False):
     modone = list(mo.run(reqs(n_requests)))
     multistep_overlap_rps = len(modone) / (time.perf_counter() - t0)
     return (n_requests / dt, mean_ttft_ms, overlap_rps, multistep_rps,
-            multistep_overlap_rps)
+            multistep_overlap_rps, decode_itl_p50_ms)
+
+
+def _itl_p50_ms(completions) -> float:
+    """p50 over per-completion mean decode inter-token gaps (the time
+    AFTER the first token, normalized by the tokens that follow it)."""
+    vals = sorted(1000.0 * (c.total_s - c.ttft_s)
+                  / max(1, len(c.tokens) - 1) for c in completions)
+    return vals[len(vals) // 2]
+
+
+def bench_serving_pipeline(n_requests=16, rows=8, tiny=False):
+    """Pipelined device-resident decode (``pipeline_depth=1``) vs the
+    synchronous loop (``0``) on the SAME request objects in one
+    process: the pipelined batcher feeds block N+1 from the device-side
+    carry and syncs block N's tokens one block behind, so the decode
+    inter-token p50 must be STRICTLY better — and since pipelining only
+    moves the sync point, the outputs are asserted token-identical
+    first (a faster wrong stream is not a result)."""
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    cfg, params, reqs, max_len, _ = _serving_bench_setup(tiny)
+    warm_batch = reqs(2)
+    batch = reqs(n_requests)    # ONE workload, served by both modes
+
+    def run(depth):
+        b = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len,
+                              pipeline_depth=depth)
+        list(b.run(list(warm_batch)))   # compiles outside the timing
+        t0 = time.perf_counter()
+        done = sorted((c.rid, c) for c in b.run(list(batch)))
+        dt = time.perf_counter() - t0
+        assert len(done) == n_requests
+        return ([c.tokens for _, c in done],
+                _itl_p50_ms(c for _, c in done), n_requests / dt)
+
+    base_tokens, base_itl, _ = run(0)
+    pipe_tokens, pipe_itl, pipe_rps = run(1)
+    assert pipe_tokens == base_tokens, \
+        "pipelined completions diverged from the synchronous loop"
+    assert pipe_itl < base_itl, \
+        (f"pipelined decode inter-token p50 {pipe_itl:.3f}ms not "
+         f"strictly better than synchronous {base_itl:.3f}ms")
+    return pipe_itl, base_itl, pipe_rps
+
+
+def bench_serving_warmup(rows=4, tiny=False):
+    """First-request TTFT on a COLD batcher (the request pays the
+    admission-prefill and first-decode compiles) vs a WARMED one
+    (``ContinuousBatcher.warmup()`` built every executable at boot,
+    off the serving path) — the fleet's ``warming`` replica state
+    exists to buy exactly this, so warm must be STRICTLY below cold."""
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    cfg, params, reqs, max_len, _ = _serving_bench_setup(tiny)
+    probe = reqs(1)
+    cold = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len)
+    cold_done = list(cold.run(list(probe)))
+    cold_ttft = 1000.0 * cold_done[0].ttft_s
+    warm = ContinuousBatcher(cfg, params, rows=rows, max_len=max_len)
+    warm_s = warm.warmup()["seconds"]
+    warm_done = list(warm.run(list(probe)))
+    warm_ttft = 1000.0 * warm_done[0].ttft_s
+    assert warm_done[0].tokens == cold_done[0].tokens, \
+        "warmup changed the served stream"
+    assert warm_ttft < cold_ttft, \
+        (f"warmed first-request TTFT {warm_ttft:.1f}ms not strictly "
+         f"below cold {cold_ttft:.1f}ms")
+    return warm_ttft, cold_ttft, warm_s
 
 
 def bench_serving_prefix_cache(n_requests=16, rows=4, tiny=False):
@@ -1003,6 +1082,12 @@ def bench_bandwidth(sizes=None):
         out["allreduce_sweep"] = {label(s): round(g, 2)
                                   for s, g in best_gbps.items()}
     else:
+        # One visible device: there is no inter-chip link to all-reduce
+        # over — say WHY the field is absent instead of a bare null
+        # (round 5 recorded allreduce_gbps: null with no explanation).
+        out["allreduce_skip_reason"] = (
+            f"single visible device ({kind or 'unknown kind'}): no ICI "
+            f"to measure; hbm_gbps triad recorded instead")
         size = max(sizes)  # largest requested payload (default 256MB)
         elems = size // 4
         a = jnp.ones((elems,), jnp.float32)
@@ -1073,11 +1158,14 @@ def _probe_device_once(timeout_s: float) -> Optional[str]:
     return None
 
 
-def _probe_device(attempt_timeout_s: float, attempts: int = 3,
+def _probe_device(attempt_timeout_s: float, attempts: int = 1,
                   retry_sleep_s: float = 30.0) -> Optional[str]:
-    """Retrying probe: a wedged relay often clears when an upstream claim
-    lease expires (round 2 died on one 300s attempt), so spread several
-    shorter attempts over the budget before giving up."""
+    """Optionally-retrying probe.  Default is ONE attempt: round 4
+    spent 3x120s on retries against a relay wedge that never cleared,
+    so the default now fails over to CPU after the first hang and
+    ``TPUMESOS_PROBE_RETRIES`` opts back into spreading shorter
+    attempts over the budget (useful where upstream claim leases are
+    known to expire, as round 2's did)."""
     import sys
     import time as _time
 
@@ -1099,8 +1187,12 @@ def main():
     import traceback
 
     err = _probe_device(
-        float(os.environ.get("TPUMESOS_BENCH_PROBE_TIMEOUT", "120")),
-        attempts=int(os.environ.get("TPUMESOS_BENCH_PROBE_ATTEMPTS", "3")))
+        float(os.environ.get(
+            "TPUMESOS_PROBE_TIMEOUT_S",
+            os.environ.get("TPUMESOS_BENCH_PROBE_TIMEOUT", "120"))),
+        attempts=int(os.environ.get(
+            "TPUMESOS_PROBE_RETRIES",
+            os.environ.get("TPUMESOS_BENCH_PROBE_ATTEMPTS", "1"))))
     degraded = None
     if err is not None:
         # The accelerator is unreachable (round 2 lost its whole benchmark
@@ -1253,13 +1345,34 @@ def main():
         flush_partial()
     sv = attempts(bench_serving_continuous, "continuous serving bench", n=1)
     if sv:
-        rps, ttft_ms, overlap_rps, ms_rps, mso_rps = sv[0]
+        rps, ttft_ms, overlap_rps, ms_rps, mso_rps, itl_p50 = sv[0]
         out["serving_requests_per_sec"] = round(rps, 2)
         out["serving_mean_ttft_ms"] = round(ttft_ms, 2)
         out["serving_overlap_requests_per_sec"] = round(overlap_rps, 2)
         out["serving_multistep_requests_per_sec"] = round(ms_rps, 2)
         out["serving_multistep_overlap_requests_per_sec"] = round(
             mso_rps, 2)
+        out["serving_decode_p50_intertoken_ms"] = round(itl_p50, 3)
+        flush_partial()
+    pl = attempts(bench_serving_pipeline, "pipelined serving bench", n=1)
+    if pl:
+        # pipeline_depth=1 vs 0, same workload/process: token-identical
+        # asserted in-bench, pipelined inter-token p50 strictly better.
+        pipe_itl, base_itl, pipe_rps = pl[0]
+        out["serving_pipeline_decode_p50_intertoken_ms"] = round(
+            pipe_itl, 3)
+        out["serving_pipeline_baseline_p50_intertoken_ms"] = round(
+            base_itl, 3)
+        out["serving_pipeline_requests_per_sec"] = round(pipe_rps, 2)
+        out["serving_pipeline_speedup"] = round(base_itl / pipe_itl, 3)
+        flush_partial()
+    wu = attempts(bench_serving_warmup, "serving warmup probe", n=1)
+    if wu:
+        # Cold vs AOT-warmed first-request TTFT (warm < cold asserted).
+        warm_ttft, cold_ttft, warm_s = wu[0]
+        out["serving_warm_first_ttft_ms"] = round(warm_ttft, 2)
+        out["serving_cold_first_ttft_ms"] = round(cold_ttft, 2)
+        out["serving_warmup_seconds"] = round(warm_s, 2)
         flush_partial()
     psv = attempts(bench_serving_prefix_cache,
                    "prefix-cache serving bench", n=1)

@@ -78,6 +78,19 @@ def main():
                         "dispatch tick t+1 before syncing tick t's "
                         "tokens — hides per-token host round-trips; "
                         "token streams identical to non-overlap")
+    p.add_argument("--pipeline-depth", type=int, default=0,
+                   choices=(0, 1), dest="pipeline_depth",
+                   help="pipelined device-resident decode (with "
+                        "--continuous): 1 feeds each block from the "
+                        "previous block's on-device tokens/positions/"
+                        "steps and syncs one block behind — token "
+                        "streams identical to 0 (the synchronous "
+                        "default); mutually exclusive with --overlap")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile every jitted serving entry point "
+                        "before the stream starts (with --continuous; "
+                        "ContinuousBatcher.warmup) — first-request "
+                        "latency no longer pays the compiles")
     p.add_argument("--mesh", type=str, default=None,
                    help="multi-chip continuous serving (with "
                         "--continuous): comma-separated mesh axes, e.g. "
@@ -99,6 +112,16 @@ def main():
                 "dispatch)")
     if args.overlap and not args.continuous:
         p.error("--overlap is a continuous-batching feature; "
+                "add --continuous")
+    if args.pipeline_depth and not args.continuous:
+        p.error("--pipeline-depth is a continuous-batching feature; "
+                "add --continuous")
+    if args.pipeline_depth and args.overlap:
+        p.error("--pipeline-depth already double-buffers the decode "
+                "loop with a device-resident carry; drop --overlap "
+                "(pick one)")
+    if args.warmup and not args.continuous:
+        p.error("--warmup is a continuous-batching feature; "
                 "add --continuous")
     if args.paged and args.continuous:
         p.error("--paged and --continuous are distinct serving modes: "
@@ -175,13 +198,17 @@ def main():
         # -1 in spec mode: the draft's backfill step writes one past the
         # proposals (ContinuousBatcher's depth check).
         ml = cfg.max_seq_len - (nd + 1 if nd else 0)
-        # Overlap endings surface late, so admission reserves extra cache
-        # positions: a full overshoot round in speculative mode, one
-        # position for a plain stop.
+        # Overlap/pipelined endings surface late, so admission reserves
+        # extra cache positions: a full overshoot round in speculative
+        # mode, one position for a plain stop.  (Speculative decoding
+        # bypasses --pipeline-depth explicitly, so its reservation only
+        # follows --overlap.)
         ov = 0
         if args.overlap:
             ov = ((nd + 1) if args.speculative
                   else (1 if args.stop_token is not None else 0))
+        elif args.pipeline_depth and not args.speculative:
+            ov = 1 if args.stop_token is not None else 0
         climit = min((ml - nd - ov) // bucket * bucket,
                      ml - nd - ov - args.new_tokens + 1)
         if any(len(t) > climit for t in prompts):
@@ -217,7 +244,12 @@ def main():
             n_draft=SPEC_N_DRAFT, mesh=mesh, overlap=args.overlap,
             draft_quantized_cache=args.int8_draft_kv,
             multi_step=args.multi_step,
-            prefix_cache_pages=args.prefix_cache)
+            prefix_cache_pages=args.prefix_cache,
+            pipeline_depth=args.pipeline_depth)
+        if args.warmup:
+            info = batcher.warmup()
+            print(f"warmed {len(info['compiled'])} entry points in "
+                  f"{info['seconds']:.1f}s", file=sys.stderr)
         sink = open(args.out, "w") if args.out else sys.stdout
         served = 0
         t0 = time.perf_counter()

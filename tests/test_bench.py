@@ -99,10 +99,48 @@ def test_decode_long_context_bench_smoke():
 
 
 def test_serving_bench_smoke():
-    rps, ttft_ms, overlap_rps, ms_rps, mso_rps = \
+    rps, ttft_ms, overlap_rps, ms_rps, mso_rps, itl_p50 = \
         bench.bench_serving_continuous(n_requests=3, rows=2, tiny=True)
     assert rps > 0 and ttft_ms > 0 and overlap_rps > 0
     assert ms_rps > 0 and mso_rps > 0
+    assert np.isfinite(itl_p50) and itl_p50 >= 0
+
+
+def test_serving_pipeline_bench_smoke():
+    """The pipelined-vs-synchronous protocol runs end to end at tiny
+    size; token identity is asserted inside the bench.  The strict
+    inter-token improvement is asserted there too — meaningful on the
+    flagship config, noisy at toy sizes, so a tiny-shape inversion only
+    skips (the equivalence matrix in test_serving is the correctness
+    gate; the flagship assert runs in the real bench)."""
+    try:
+        pipe_itl, base_itl, pipe_rps = bench.bench_serving_pipeline(
+            n_requests=4, rows=2, tiny=True)
+    except AssertionError as e:
+        if "not strictly better" in str(e):
+            pytest.skip(f"tiny-shape timing inversion: {e}")
+        raise
+    assert pipe_itl > 0 and base_itl > 0 and pipe_rps > 0
+
+
+def test_serving_warmup_bench_smoke():
+    warm_ttft, cold_ttft, warm_s = bench.bench_serving_warmup(
+        rows=2, tiny=True)
+    assert 0 < warm_ttft < cold_ttft    # also asserted in-bench
+    assert warm_s >= 0
+
+
+def test_bandwidth_single_device_records_skip_reason(monkeypatch):
+    """With one visible device the bench must say WHY allreduce_gbps is
+    absent (r05 recorded a bare null) and fall through to the HBM
+    triad."""
+    import jax
+
+    monkeypatch.setattr(jax, "device_count", lambda: 1)
+    out = bench.bench_bandwidth(sizes=[1 << 16])
+    assert out["allreduce_gbps"] is None
+    assert "no ICI" in out["allreduce_skip_reason"]
+    assert out["hbm_gbps"] is not None and out["hbm_gbps"] > 0
 
 
 def test_serving_longctx_bench_smoke():

@@ -839,6 +839,188 @@ def test_pick_decode_prefers_headroom_and_skips_saturated(stub_fleet):
         feeder.close()
 
 
+# -- the warming state (no JAX) ---------------------------------------------
+
+
+def test_warming_replica_never_routed(stub_fleet):
+    """A replica registered with ``status: warming`` is present in the
+    table but invisible to EVERY router tier — unified, prefill, and
+    decode picks all skip it — and flips routable the moment its beats
+    drop the status (ReplicaServer.set_status(None) after warmup)."""
+    token, reg, servers = stub_fleet
+    warming = ReplicaServer(lambda m, r: r({"op": "completion"}),
+                            token=token, capacity=4,
+                            registry_addr=reg.addr,
+                            heartbeat_interval=0.05,
+                            status="warming").start()
+    servers.append(warming)
+    assert _wait(lambda: any(r["state"] == "warming"
+                             for r in reg.snapshot()))
+    router = Router(reg, FleetMetrics(), token=token)
+    assert router.pick() is None            # warming != routable
+    assert router.pick_prefill() is None
+    assert router.pick_decode() is None
+    assert reg.alive() == []
+    # An alive peer takes ALL the traffic while the other warms.
+    peer = _stub_replica(token, reg.addr, tokens=(3,))
+    servers.append(peer)
+    assert _wait(lambda: len(reg.alive()) == 1)
+    for _ in range(8):
+        assert router.pick() == peer.addr != warming.addr
+    # Warmup returns: the replica flips itself alive by dropping the
+    # status field — no registry-side action needed.
+    warming.set_status(None)
+    assert _wait(lambda: len(reg.alive()) == 2)
+    assert _wait(lambda: router.pick(exclude=(peer.addr,))
+                 == warming.addr)
+
+
+def test_warming_role_tier_falls_back_like_empty(stub_fleet):
+    """A role tier whose only member is warming behaves exactly like an
+    EMPTY tier: the disaggregated path falls back to the unified tier
+    (same rules as a missing tier) instead of waiting on the compile."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_replica(token, reg.addr, tokens=(8, 9)))
+    # A warming prefill replica + an alive decode replica: the prefill
+    # tier is effectively empty, so generate must take the unified path.
+    pre = ReplicaServer(lambda m, r: None, token=token, capacity=4,
+                        registry_addr=reg.addr, heartbeat_interval=0.05,
+                        status="warming",
+                        extra_info=lambda: {"role": "prefill"}).start()
+    servers.append(pre)
+    dec, _ = _stub_decode_replica(token, reg.addr)
+    servers.append(dec)
+    assert _wait(lambda: len(reg.alive()) == 2
+                 and any(r["state"] == "warming" for r in reg.snapshot()))
+    m = FleetMetrics()
+    router = Router(reg, m, token=token)
+    out = router.route({"op": "generate", "prompt": [1, 2],
+                        "max_new_tokens": 2})
+    assert out["tokens"] == [8, 9]          # unified served it
+    assert m.get("disagg_fallback") == 1
+    assert m.get("disagg_prefills") == 0    # warming tier never entered
+
+
+def test_registry_warming_lifecycle_drain_beats_warming():
+    """Direct wire-level state machine: warming on the hello, alive on
+    the first status-free beat, and a drain announcement is terminal
+    against LATE warming beats (an exiting replica must not re-enter
+    the table through its own warmup) while a plain beat still
+    self-heals."""
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=30.0, dead_after=60.0,
+                          sweep_interval=0.05).start()
+    try:
+        sock = wire.connect(reg.addr)
+        wire.send_msg(sock, {"op": "hello", "addr": "w:1", "capacity": 2,
+                             "status": "warming"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == ["warming"])
+        assert reg.alive() == [] and len(reg.warming()) == 1
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "w:1"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == ["alive"])
+        wire.send_msg(sock, {"op": "drain", "addr": "w:1"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == [DRAINING])
+        # Draining beats warming: the late warming beat refreshes
+        # liveness but never revives the entry.
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "w:1",
+                             "status": "warming"}, token)
+        time.sleep(0.2)
+        assert [r["state"] for r in reg.snapshot()] == [DRAINING]
+        # A plain (routable) beat still self-heals — the existing
+        # drain-then-revive semantics are unchanged.
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "w:1"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == ["alive"])
+        # And a drain against a WARMING replica drains it too.
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "w:1",
+                             "status": "warming"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == ["warming"])
+        wire.send_msg(sock, {"op": "drain", "addr": "w:1"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == [DRAINING])
+        sock.close()
+    finally:
+        reg.stop()
+
+
+def test_registry_relaunch_on_reused_addr_shows_warming():
+    """An announced drain dies with the process: once the entry is
+    DEAD, a relaunched replica reusing the same addr that registers
+    with ``status: warming`` must SHOW as warming (gauges, start()'s
+    'still warming' diagnostic) — not stay pinned in the old process's
+    dead/drained state for its whole compile."""
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=30.0, dead_after=60.0,
+                          sweep_interval=0.05).start()
+    try:
+        sock = wire.connect(reg.addr)
+        wire.send_msg(sock, {"op": "hello", "addr": "w:1"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == ["alive"])
+        # Old process announces a drain, then dies (router-observed).
+        wire.send_msg(sock, {"op": "drain", "addr": "w:1"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == [DRAINING])
+        reg.mark_dead("w:1")
+        assert [r["state"] for r in reg.snapshot()] == ["dead"]
+        # Relaunch on the SAME addr: its warming hello must take.
+        wire.send_msg(sock, {"op": "hello", "addr": "w:1",
+                             "status": "warming"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == ["warming"])
+        assert reg.alive() == [] and len(reg.warming()) == 1
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "w:1"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == ["alive"])
+        sock.close()
+    finally:
+        reg.stop()
+
+
+def test_registry_malformed_status_costs_field_not_beat():
+    """A bogus ``status`` value defaults the state to alive and still
+    counts as a beat — exactly like the other optional heartbeat
+    fields (a flaky advertiser must not get a healthy replica marked
+    dead)."""
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=30.0, dead_after=60.0,
+                          sweep_interval=0.05).start()
+    try:
+        sock = wire.connect(reg.addr)
+        for bad in (42, "warm", None, ["warming"]):
+            wire.send_msg(sock, {"op": "heartbeat", "addr": "m:1",
+                                 "status": bad, "outstanding": 7}, token)
+        assert _wait(lambda: reg.alive()
+                     and reg.alive()[0].outstanding == 7)
+        assert [r["state"] for r in reg.snapshot()] == ["alive"]
+        sock.close()
+    finally:
+        reg.stop()
+
+
+def test_fleet_server_replica_cmd_carries_warmup_flags():
+    """FleetServer threads --warmup / --pipeline-depth into the Mode-B
+    replica command line, so EVERY launch of that cmd — boot or a later
+    elastic relaunch — re-warms before taking traffic."""
+    import types
+
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    fs = FleetServer(replicas=1, warmup=True, pipeline_depth=1)
+    fs.registry = types.SimpleNamespace(addr="reg:1")
+    cmd = fs._replica_cmd()
+    assert "--warmup" in cmd.split()
+    assert "--pipeline-depth 1" in cmd
+    fs2 = FleetServer(replicas=1)
+    fs2.registry = types.SimpleNamespace(addr="reg:1")
+    cmd2 = fs2._replica_cmd()
+    assert "--warmup" not in cmd2 and "--pipeline-depth" not in cmd2
+
+
 # -- end to end: gateway + 2 LocalBackend-launched batcher replicas --------
 
 
@@ -1102,3 +1284,94 @@ def test_fleet_gateway_requires_token(fleet):
     with pytest.raises((OSError, wire.WireError)):
         wire.recv_msg(sock, "not-the-token")
     sock.close()
+
+
+@pytest.mark.slow
+def test_fleet_warmup_relaunch_rewarms_before_traffic(tiny_offline):
+    """End to end on the local backend: a --warmup fleet's replica
+    boots through warming -> alive before the gateway opens for it, and
+    a Mode-B RELAUNCH (the exact replica cmd the scheduler runs) goes
+    through the same warming window — never routed while compiling,
+    correct completions the moment it flips alive."""
+    import os
+    import shlex
+    import signal as _signal
+    import subprocess
+
+    from tfmesos_tpu.fleet.launcher import FleetServer
+    from tfmesos_tpu.fleet.registry import ALIVE, WARMING
+
+    cfg, offline = tiny_offline
+    fs = FleetServer(replicas=1, rows=2, tiny=True, max_len=64,
+                     page_size=16, prefill_bucket=16, warmup=True,
+                     request_timeout=300.0, start_timeout=300.0)
+    states = []                 # (addr, state) transitions, in order
+
+    def watch():
+        while fs.registry is None:
+            time.sleep(0.01)
+        while not done.is_set():
+            for r in fs.registry.snapshot():
+                key = (r["addr"], r["state"])
+                if key not in states:
+                    states.append(key)
+            time.sleep(0.01)
+
+    done = threading.Event()
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+    proc = None
+    try:
+        fs.start()      # returns only once the replica is ALIVE (warmed)
+        assert "--warmup" in fs._replica_cmd().split()
+        boot_addr = fs.registry.alive()[0].addr
+        # Boot went through the warming state before alive.  (The
+        # watcher polls on its own cadence — give it a beat to record
+        # the flip start() already observed.)
+        assert _wait(lambda: (boot_addr, ALIVE) in states, timeout=10.0)
+        assert states.index((boot_addr, WARMING)) \
+            < states.index((boot_addr, ALIVE))
+        client = fs.client(timeout=300.0)
+        prompt = _e2e_prompts(cfg, 1, seed=9)[0]
+        assert client.generate(prompt, 4)["tokens"] == offline(prompt, 4)
+
+        # Kill the replica task (process group: wrapper + replica).
+        victim = next(p for p in fs.scheduler.backend._procs.values()
+                      if p.poll() is None)
+        os.killpg(victim.pid, _signal.SIGKILL)
+        assert _wait(lambda: not fs.registry.alive(), timeout=30.0)
+
+        # Mode-B relaunch: the scheduler's own cmd line, re-run as-is.
+        env = dict(os.environ, TPUMESOS_TOKEN=fs.token,
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            shlex.split(fs._replica_cmd()), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        # The relaunch appears as WARMING — and while it warms, no tier
+        # can pick it (the fleet has no alive replica at all now).
+        assert _wait(lambda: fs.registry.warming(), timeout=120.0)
+        new_addr = fs.registry.warming()[0].addr
+        assert new_addr != boot_addr
+        assert fs.router.pick() is None
+        assert fs.router.pick_prefill() is None
+        assert fs.router.pick_decode() is None
+        # It flips alive when warmup returns, and serves correctly.
+        assert _wait(lambda: any(r.addr == new_addr
+                                 for r in fs.registry.alive()),
+                     timeout=120.0)
+        out = client.generate(prompt, 4, timeout=300.0)
+        assert out["tokens"] == offline(prompt, 4)
+        assert _wait(lambda: (new_addr, ALIVE) in states, timeout=10.0)
+        assert states.index((new_addr, WARMING)) \
+            < states.index((new_addr, ALIVE))
+        client.close()
+    finally:
+        done.set()
+        watcher.join(timeout=5.0)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except OSError:
+                pass
+        fs.stop()

@@ -598,6 +598,318 @@ def test_overlap_speculative_perfect_draft(setup):
     assert b.alloc.rows == {}
 
 
+# -- pipelined device-resident decode (pipeline_depth=1) --------------------
+
+
+@pytest.mark.parametrize("variant", [
+    "base", "staggered", "stop", "sampled", "chunked", "multistep",
+    "multistep_stop", "int8",
+])
+def test_pipelined_batcher_token_identical(setup, variant):
+    """pipeline_depth=1 (block N+1 dispatched from the DEVICE-resident
+    carry — tokens, positions, and steps never round-trip to the host
+    between blocks — with block N's tokens synced one block behind)
+    must produce IDENTICAL token streams to the synchronous
+    pipeline_depth=0 loop across the matrix: stops and quotas are
+    detected one block late but the overshoot block's writes land
+    inside the clamped reservation or on sink columns and its tokens
+    fail the rid-checked ticket; sampled (rid, step) key folds are
+    unchanged; chunked prefill flips and mid-stream re-admissions
+    re-enter through the host-merge mask; the int8 pool pair compares
+    int8-to-int8."""
+    cfg, params = setup
+    rng = np.random.RandomState(71)
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (3, 9, 14, 18, 6)]
+    mk = lambda: [Request(prompt=p, max_new_tokens=2 + (i % 5))
+                  for i, p in enumerate(prompts)]
+    kw = dict(rows=3, max_len=96, page_size=16, prefill_bucket=16)
+    if variant == "sampled":
+        kw.update(temperature=0.8, top_k=20, rng=jax.random.PRNGKey(5))
+    elif variant == "chunked":
+        kw.update(prefill_chunk=8)
+    elif variant in ("multistep", "multistep_stop"):
+        kw.update(multi_step=4)
+    elif variant == "int8":
+        kw.update(quantized_cache=True)
+    if variant in ("stop", "multistep_stop"):
+        # Find a token each prompt actually emits so stops trigger (and
+        # land mid-block in the multistep case).
+        probe = ContinuousBatcher(cfg, params, **kw)
+        outs = {c.rid: c.tokens for c in probe.run(mk())}
+        stops = {rid: t[min(1, len(t) - 1)] for rid, t in outs.items()}
+        mk = lambda: [Request(prompt=p, max_new_tokens=2 + (i % 5),
+                              stop_token=stops[i])
+                      for i, p in enumerate(prompts)]
+    if variant == "staggered":
+        # Fewer rows than requests: completions free rows mid-stream and
+        # later requests re-enter the device carry as fresh admissions.
+        kw["rows"] = 2
+
+        def feed(reqs, done):
+            for r in reqs:
+                assert len(done) <= len(reqs)   # pull stays lazy
+                yield r
+    else:
+        feed = lambda reqs, done: iter(reqs)
+    plain = ContinuousBatcher(cfg, params, **kw)
+    want = {}
+    for c in plain.run(feed(mk(), want)):
+        want[c.rid] = c.tokens
+    pb = ContinuousBatcher(cfg, params, pipeline_depth=1, **kw)
+    assert pb._pipelined and pb.pipeline_bypass_reason is None
+    got = {}
+    for c in pb.run(feed(mk(), got)):
+        got[c.rid] = c.tokens
+    assert got == want
+    assert pb._inflight is None and pb._pipe_carry is None  # drained
+    assert pb.alloc.rows == {}                              # no leaks
+
+
+@pytest.mark.parametrize("variant", ["mesh", "pcache"])
+def test_pipelined_batcher_token_identical_heavy(setup, mesh_setup,
+                                                 variant):
+    """The expensive corners of the pipelined equivalence matrix: the
+    dp x tp mesh path (sharded pools, multi-device dispatch) and the
+    cross-request prefix cache (warm admissions map cached pages and
+    enter decode from a host merge)."""
+    if variant == "mesh":
+        cfg, params, _, _ = mesh_setup
+    else:
+        cfg, params = setup
+    rng = np.random.RandomState(73)
+    sys_p = rng.randint(0, cfg.vocab_size, size=32).astype(np.int32)
+    prompts = [np.concatenate([sys_p, rng.randint(
+        0, cfg.vocab_size, size=4 + i).astype(np.int32)])
+        for i in range(4)]
+    mk = lambda: [Request(prompt=p, max_new_tokens=3 + (i % 3))
+                  for i, p in enumerate(prompts)]
+    kw = dict(rows=4, max_len=96, page_size=16, prefill_bucket=16)
+    if variant == "mesh":
+        kw.update(mesh=_mesh({"dp": 2, "tp": 2}))
+    else:
+        kw.update(prefix_cache_pages=16)
+    plain = ContinuousBatcher(cfg, params, **kw)
+    want = [{c.rid: c.tokens for c in plain.run(mk())} for _ in range(2)]
+    pb = ContinuousBatcher(cfg, params, pipeline_depth=1, **kw)
+    got = [{c.rid: c.tokens for c in pb.run(mk())} for _ in range(2)]
+    assert got == want      # pass 2 serves pcache hits where enabled
+    if variant == "pcache":
+        assert pb.prefix_cache_stats()["hits"] > 0
+
+
+def test_pipelined_spec_bypass_reason_and_validation(setup, draft_setup):
+    """Speculative decoding BYPASSES pipelining explicitly — the
+    recorded reason makes the bypass observable (like
+    prefix_cache_bypass_reason) and the spec loop runs unchanged;
+    overlap=True + pipeline_depth=1 is rejected (pick one), as are
+    depths outside {0, 1}."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    b = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                          prefill_bucket=16, draft_cfg=dcfg,
+                          draft_params=dparams, n_draft=3,
+                          pipeline_depth=1)
+    assert b.pipeline_bypass_reason == "speculative decoding"
+    assert not b._pipelined
+    reqs = [Request(prompt=p, max_new_tokens=4)
+            for p in _prompts(cfg, 3, seed=77)]
+    plain = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                              page_size=16, prefill_bucket=16,
+                              draft_cfg=dcfg, draft_params=dparams,
+                              n_draft=3)
+    want = {c.rid: c.tokens for c in plain.run(list(reqs))}
+    got = {c.rid: c.tokens for c in b.run(list(reqs))}
+    assert got == want
+    with pytest.raises(ValueError, match="drop overlap"):
+        ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                          overlap=True, pipeline_depth=1)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                          pipeline_depth=2)
+
+
+# -- ahead-of-time warmup ---------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["plain", "pipelined", "chunked",
+                                  "pcache"])
+def test_warmup_outputs_bit_identical(setup, mode):
+    """warmup() compiles every entry point the mode dispatches against
+    all-sink dummy shapes — no live row, shared-prefix page, or cache
+    state is touched, so a warmed batcher's outputs EQUAL a cold
+    one's.  ``pcache`` is the tfserve DEFAULT config (--prefix-cache
+    64 + --warmup compose), so it must warm and then hit normally."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    if mode == "pipelined":
+        kw.update(pipeline_depth=1)
+    elif mode == "chunked":
+        kw.update(prefill_chunk=16)
+    elif mode == "pcache":
+        kw.update(prefix_cache_pages=16)
+    if mode == "pcache":
+        # Page-aligned shared prefix so the second pass actually hits.
+        rng = np.random.RandomState(83)
+        sys_p = rng.randint(0, cfg.vocab_size, size=16).astype(np.int32)
+        pps = [np.concatenate([sys_p, rng.randint(
+            0, cfg.vocab_size, size=3 + i).astype(np.int32)])
+            for i in range(4)]
+        reqs = lambda: [Request(prompt=p, max_new_tokens=4) for p in pps]
+    else:
+        reqs = lambda: [Request(prompt=p, max_new_tokens=4)
+                        for p in _prompts(cfg, 4, seed=83)]
+    cold = ContinuousBatcher(cfg, params, **kw)
+    want = {c.rid: c.tokens for c in cold.run(reqs())}
+    warm = ContinuousBatcher(cfg, params, **kw)
+    info = warm.warmup()
+    assert info["compiled"] and info["seconds"] >= 0.0
+    assert any(c.startswith("decode[") for c in info["compiled"])
+    got = {c.rid: c.tokens for c in warm.run(reqs())}
+    assert got == want
+    assert warm.alloc.rows == {}    # warmup owns no rows or pages
+    if mode == "pcache":
+        # Warmup left the cache consistent: a second pass HITS and
+        # still equals the cold stream.
+        assert warm.prefix_cache_stats()["cached_pages"] >= 0
+        again = {c.rid: c.tokens for c in warm.run(reqs())}
+        # rids keep counting across runs; the STREAMS must be equal.
+        assert [t for _, t in sorted(again.items())] == \
+            [t for _, t in sorted(want.items())]
+        assert warm.prefix_cache_stats()["hits"] > 0
+
+
+def test_warmup_speculative_covers_spec_round(setup, draft_setup):
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    b = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                          prefill_bucket=16, draft_cfg=dcfg,
+                          draft_params=dparams, n_draft=3)
+    info = b.warmup()
+    assert any(c.startswith("spec_round[") for c in info["compiled"])
+    assert any(c.startswith("draft_chunk[") for c in info["compiled"])
+    req = Request(prompt=_prompts(cfg, 1, seed=87)[0], max_new_tokens=5)
+    plain = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                              page_size=16, prefill_bucket=16,
+                              draft_cfg=dcfg, draft_params=dparams,
+                              n_draft=3)
+    assert [c.tokens for c in b.run([req])] == \
+        [c.tokens for c in plain.run([req])]
+
+
+def test_warmup_refused_while_serving(setup):
+    import threading
+    import time as _time
+
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, params, rows=1, max_len=32, page_size=16,
+                          prefill_bucket=16)
+    t = threading.Thread(target=lambda: list(b.serve()), daemon=True)
+    t.start()
+    deadline = _time.monotonic() + 30.0
+    while not b._loop_active and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert b._loop_active
+    with pytest.raises(RuntimeError, match="warm at boot"):
+        b.warmup()
+    b.close()
+    t.join(timeout=60.0)
+
+
+def test_warmup_covers_every_prefill_width(setup):
+    """Non-chunked admission pads prompts to MULTIPLES of
+    prefill_bucket (not just the base bucket), so warmup must compile
+    every reachable width — a warmed replica's first long prompt must
+    not pay a live XLA trace (the --warmup contract)."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    b = ContinuousBatcher(cfg, params, **kw)
+    info = b.warmup()
+    assert set(b._prefill_fns) == set(b._prefill_widths())
+    assert [c for c in info["compiled"] if c.startswith("prefill[")] == \
+        [f"prefill[{w}]" for w in b._prefill_widths()]
+    assert len(b._prefill_widths()) > 1    # the matrix covers >1 width
+    # A prompt longer than the base bucket (width 32 here) dispatches
+    # an ALREADY-compiled trace: the fn cache must not grow.
+    n = len(b._prefill_fns)
+    long_p = _prompts(cfg, 1, seed=91)[0]
+    long_p = np.tile(long_p, 4)[:20].astype(np.int32)   # pads to 32
+    done = list(b.run([Request(prompt=long_p, max_new_tokens=4)]))
+    assert len(done) == 1 and len(b._prefill_fns) == n
+    cold = ContinuousBatcher(cfg, params, **kw)
+    assert [c.tokens for c in cold.run(
+        [Request(prompt=long_p, max_new_tokens=4)])] == \
+        [c.tokens for c in done]
+    # Decode widths come from the SAME formula live dispatch buckets
+    # with (one source of truth, not a re-derivation).
+    from tfmesos_tpu.serving import _PagedSide
+    np_max = b.t_side.np_max
+    assert b._decode_widths() == sorted(
+        {_PagedSide.width_for(occ, np_max)
+         for occ in range(1, np_max + 1)})
+
+
+def test_warmup_covers_multibucket_tail_prefill(setup):
+    """The prefix-cache TAIL writer retraces per padded tail width
+    (multiples of prefill_bucket), so warmup must cover them all: a
+    warmed replica's first warm-cache hit whose uncached tail spans
+    2+ buckets must NOT pay a live XLA trace."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16,
+              prefix_cache_pages=16)
+    warm = ContinuousBatcher(cfg, params, **kw)
+    info = warm.warmup()
+    assert [c for c in info["compiled"]
+            if c.startswith("chunk_prefill[")] == \
+        [f"chunk_prefill[{w}]" for w in warm._prefill_widths()]
+    rng = np.random.RandomState(71)
+    sys_p = rng.randint(0, cfg.vocab_size, size=16).astype(np.int32)
+    p_seed = np.concatenate([sys_p, rng.randint(
+        0, cfg.vocab_size, size=3).astype(np.int32)])
+    p_hit = np.concatenate([sys_p, rng.randint(
+        0, cfg.vocab_size, size=17).astype(np.int32)])   # tail pads to 32
+    list(warm.run([Request(prompt=p_seed, max_new_tokens=4)]))
+    n = warm._tail_prefill._cache_size()
+    done = list(warm.run([Request(prompt=p_hit, max_new_tokens=4)]))
+    assert warm.prefix_cache_stats()["hits"] >= 1
+    assert warm._tail_prefill._cache_size() == n    # no live retrace
+    plain = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                              page_size=16, prefill_bucket=16)
+    assert [c.tokens for c in plain.run(
+        [Request(prompt=p_hit, max_new_tokens=4)])] == \
+        [c.tokens for c in done]
+
+
+def test_warmup_decode_false_skips_decode_blocks(setup):
+    """A prefill-ROLE replica never decodes: warmup(decode=False) must
+    skip the per-width decode compiles (they only lengthen the warming
+    window on every relaunch) while still warming the prefill surface
+    and the KV export/import scatter."""
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                          prefill_bucket=16)
+    info = b.warmup(decode=False)
+    assert not any(c.startswith(("decode[", "spec_round["))
+                   for c in info["compiled"])
+    assert any(c.startswith("prefill[") for c in info["compiled"])
+    assert "kv_export_import[1]" in info["compiled"]
+    # The mirror for decode-ROLE replicas (only ever import KV):
+    # prefill=False skips the per-width prefill compiles but keeps the
+    # decode blocks and the import scatter.
+    b2 = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                           prefill_bucket=16)
+    info2 = b2.warmup(prefill=False)
+    assert not any(c.startswith(("prefill[", "chunk_prefill[",
+                                 "draft_chunk[")) for c in info2["compiled"])
+    assert any(c.startswith("decode[") for c in info2["compiled"])
+    assert "kv_export_import[1]" in info2["compiled"]
+    # The skipped compiles don't poison the export path: a real
+    # prefill-only export still works on the warmed batcher.
+    req = Request(prompt=_prompts(cfg, 1, seed=93)[0], max_new_tokens=4)
+    art = b.export_kv(req)
+    assert art["pos"] >= req.prompt.size and art["first_token"] >= 0
+
+
 def test_mesh_batcher_validation(mesh_setup):
     cfg, params, _, _ = mesh_setup
     with pytest.raises(ValueError, match="divide over the mesh"):

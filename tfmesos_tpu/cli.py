@@ -240,6 +240,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "prefill only their uncached tail, and the "
                         "gateway routes shared prefixes to the replica "
                         "already holding them (prefix-affinity)")
+    p.add_argument("--pipeline-depth", type=int, default=0,
+                   choices=(0, 1), dest="pipeline_depth",
+                   help="1 pipelines each replica's decode loop with a "
+                        "device-resident carry (dispatch block N+1 "
+                        "before syncing block N's tokens; token "
+                        "streams identical to 0, the synchronous "
+                        "default — docs/SERVING.md)")
+    p.add_argument("--warmup", action="store_true",
+                   help="replicas compile every jitted serving entry "
+                        "point at boot before taking traffic: they "
+                        "register as 'warming' (never routed), warm, "
+                        "then flip alive — and any elastic/Mode-B "
+                        "relaunch re-warms the same way, so a cold "
+                        "replica's first request never pays a compile")
     p.add_argument("--tiny", action="store_true",
                    help="serve the tiny CI model (dev/demo)")
     p.add_argument("--metrics-interval", type=float, default=10.0,
@@ -316,6 +330,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         workers=args.workers, max_queue=args.max_queue, rate=args.rate,
         burst=args.burst, max_retries=args.retries,
         prefix_cache_pages=args.prefix_cache,
+        pipeline_depth=args.pipeline_depth, warmup=args.warmup,
         report_interval=args.metrics_interval or None,
         quiet=not args.verbose, token=token)
     try:

@@ -87,6 +87,11 @@ class Gateway:
         metrics.register_gauge("queue_depth", admission.depth)
         metrics.register_gauge("replicas_alive",
                                lambda: len(self.registry.alive()))
+        # Replicas registered but still compiling (--warmup): present
+        # in the table, invisible to every router tier — surfaced so an
+        # operator can tell "warming fleet" from "missing replicas".
+        metrics.register_gauge("replicas_warming",
+                               lambda: len(self.registry.warming()))
         # Per-role replica counts + aggregate outstanding/headroom, so
         # a disaggregated deployment's snapshot shows each tier served.
         metrics.register_gauge("roles", self.registry.role_summary)

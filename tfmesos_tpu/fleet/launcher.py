@@ -46,6 +46,8 @@ class FleetServer:
                  prefill_bucket: Optional[int] = None,
                  multi_step: int = 1,
                  prefix_cache_pages: int = 0,
+                 pipeline_depth: int = 0,
+                 warmup: bool = False,
                  prefill_replicas: int = 0,
                  decode_replicas: int = 0,
                  backend=None, master: Optional[str] = None,
@@ -79,6 +81,8 @@ class FleetServer:
         self.prefill_bucket = prefill_bucket
         self.multi_step = int(multi_step)
         self.prefix_cache_pages = int(prefix_cache_pages)
+        self.pipeline_depth = int(pipeline_depth)
+        self.warmup = bool(warmup)
         self.backend = backend
         self.master = master
         self.replica_cpus = float(replica_cpus)
@@ -133,6 +137,14 @@ class FleetServer:
             parts += ["--multi-step", str(self.multi_step)]
         if self.prefix_cache_pages:
             parts += ["--prefix-cache-pages", str(self.prefix_cache_pages)]
+        if self.pipeline_depth:
+            parts += ["--pipeline-depth", str(self.pipeline_depth)]
+        if self.warmup:
+            # Every launch of this cmd — boot OR a later elastic/Mode-B
+            # relaunch — registers warming, compiles, then takes
+            # traffic: re-warming is a property of the command line,
+            # not of the first bring-up.
+            parts.append("--warmup")
         return " ".join(parts)
 
     def start(self) -> "FleetServer":
@@ -213,9 +225,12 @@ class FleetServer:
             # died fatally — surface that instead of idling to timeout.
             self.scheduler.finished()
             time.sleep(0.1)
+        warming = len(self.registry.warming())
         raise ClusterError(
             f"only {len(self.registry.alive())}/{want} replicas "
-            f"heartbeating after {self.start_timeout:.0f}s")
+            f"routable after {self.start_timeout:.0f}s"
+            + (f" ({warming} still warming — raise start_timeout for "
+               f"slow compiles)" if warming else ""))
 
     # -- surface -----------------------------------------------------------
 

@@ -5,17 +5,27 @@ wire protocol (the same HMAC framing the rendezvous uses — an
 unauthenticated process cannot register itself into the serving path).
 Liveness is graded, not boolean:
 
+* ``warming``  — registered and heartbeating with ``status: warming``
+  (the replica is still compiling its jitted entry points —
+  ``ContinuousBatcher.warmup``); NOT eligible for requests yet.  The
+  replica flips itself to alive by simply dropping the status field
+  once warmup returns.
 * ``alive``    — heartbeating; eligible for new requests.
 * ``draining`` — heartbeats stale (or the replica announced a drain);
   no NEW requests are routed, in-flight ones may still finish.
+  A drain announcement beats ``warming`` — an exiting replica must
+  never re-enter the routable path through a late warming beat window.
 * ``dead``     — hard heartbeat timeout, heartbeat-connection EOF (the
   usual signal of process death, since the connection lives inside the
   replica), or the router observed a connection failure.  Dead entries
   are EVICTED from the table after a grace window.
 
-A dead/draining replica that heartbeats again is revived to alive —
-so a transient network blip (or an overeager router ``mark_dead``)
-self-heals instead of requiring operator action.
+A dead/draining replica that heartbeats again is revived (to alive, or
+to warming if the beat still says so) — so a transient network blip
+(or an overeager router ``mark_dead``) self-heals instead of requiring
+operator action.  A malformed ``status`` field costs the field, not
+the beat: the beat still counts for liveness and the state defaults to
+alive, exactly like the other optional heartbeat fields.
 """
 
 from __future__ import annotations
@@ -29,9 +39,10 @@ from typing import Dict, List, Optional
 from tfmesos_tpu import wire
 from tfmesos_tpu.utils.logging import get_logger
 
-__all__ = ["ALIVE", "DRAINING", "DEAD", "UNIFIED", "PREFILL", "DECODE",
-           "ROLES", "ReplicaInfo", "ReplicaRegistry"]
+__all__ = ["WARMING", "ALIVE", "DRAINING", "DEAD", "UNIFIED", "PREFILL",
+           "DECODE", "ROLES", "ReplicaInfo", "ReplicaRegistry"]
 
+WARMING = "warming"
 ALIVE = "alive"
 DRAINING = "draining"
 DEAD = "dead"
@@ -63,6 +74,11 @@ class ReplicaInfo:
     # imported prefills by headroom; -1 = never advertised.
     role: str = UNIFIED
     kv_headroom: int = -1
+    # The replica announced a drain (operator intent, not staleness).
+    # While set, a late ``status: warming`` beat must NOT revive the
+    # entry — an exiting replica never re-enters through its own
+    # warmup; only a plain (routable) beat clears it.
+    announced_drain: bool = False
 
 
 class ReplicaRegistry:
@@ -179,20 +195,42 @@ class ReplicaRegistry:
         if (op != "drain" and self.chaos is not None
                 and self.chaos.on_heartbeat(addr)):
             return None         # chaos drop: the beat never arrived
+        # The beat's announced state: ``status: warming`` marks a
+        # replica still compiling (ContinuousBatcher.warmup) — present
+        # and heartbeating, but not routable; anything else (including
+        # a malformed status) costs the FIELD, not the beat, and the
+        # state defaults to alive like every other optional field.
+        target = WARMING if msg.get("status") == WARMING else ALIVE
         with self._lock:
             rep = self._table.get(addr)
             if op == "drain":
-                if rep is not None and rep.state == ALIVE:
+                if rep is not None and rep.state in (ALIVE, WARMING):
                     rep.state = DRAINING
+                    rep.announced_drain = True
                     self.log.info("replica %s draining", addr)
                 return addr
             if rep is None:
-                rep = self._table[addr] = ReplicaInfo(addr=addr)
-                self.log.info("replica %s registered", addr)
-            if rep.state != ALIVE:
-                self.log.info("replica %s revived (%s -> alive)",
-                              addr, rep.state)
-                rep.state = ALIVE
+                rep = self._table[addr] = ReplicaInfo(addr=addr,
+                                                      state=target)
+                self.log.info("replica %s registered (%s)", addr, target)
+            if rep.state == DEAD:
+                # A DEAD entry's beat comes from a NEW process on the
+                # old addr (or a revived one whose drain is moot) — the
+                # announced drain died with the process, so honor the
+                # beat's own status: a relaunched replica on a reused
+                # port must show as warming, not stay pinned dead.
+                rep.announced_drain = False
+            if rep.announced_drain and target == WARMING:
+                # Drain beats warming: an exiting replica's late
+                # warming beat refreshes liveness but never re-enters
+                # the table's routable path.
+                target = rep.state
+            if rep.state != target:
+                self.log.info("replica %s %s -> %s", addr, rep.state,
+                              target)
+                rep.state = target
+            if target == ALIVE:
+                rep.announced_drain = False
             if "capacity" in msg:
                 rep.capacity = int(msg["capacity"])
             if "outstanding" in msg:
@@ -237,10 +275,21 @@ class ReplicaRegistry:
     # -- queries / writes --------------------------------------------------
 
     def alive(self) -> List[ReplicaInfo]:
-        """Replicas eligible for NEW requests (copies, race-free)."""
+        """Replicas eligible for NEW requests (copies, race-free).
+        This is the ONE routability query every router tier goes
+        through — warming replicas are excluded here, so no pick
+        (unified, prefill, or decode) can ever select one."""
         with self._lock:
             return [dataclasses.replace(r) for r in self._table.values()
                     if r.state == ALIVE]
+
+    def warming(self) -> List[ReplicaInfo]:
+        """Replicas registered but still compiling (copies) — present
+        for bring-up accounting and the gateway's gauge, invisible to
+        routing."""
+        with self._lock:
+            return [dataclasses.replace(r) for r in self._table.values()
+                    if r.state == WARMING]
 
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -255,7 +304,8 @@ class ReplicaRegistry:
         with self._lock:
             for rep in self._table.values():
                 d = out.setdefault(rep.role or UNIFIED,
-                                   {"alive": 0, "draining": 0, "dead": 0,
+                                   {"alive": 0, "warming": 0,
+                                    "draining": 0, "dead": 0,
                                     "outstanding": 0, "kv_headroom": 0})
                 d[rep.state] = d.get(rep.state, 0) + 1
                 if rep.state == ALIVE:

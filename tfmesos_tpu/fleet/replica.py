@@ -18,7 +18,11 @@ Liveness: a heartbeat thread dials the registry and streams
 ``{op: heartbeat, addr, capacity, outstanding}`` on a persistent
 connection; the connection dying IS the registry's earliest death
 signal.  On SIGTERM the replica announces a drain, stops accepting, and
-exits.
+exits.  With ``--warmup`` the replica registers with
+``status: warming`` — present but never routed — compiles every jitted
+serving entry point (``ContinuousBatcher.warmup``), and only then
+drops the status to take traffic, so a cold start (boot, elastic
+relaunch, Mode-B restart) never pays its compiles on a live request.
 
 :class:`ReplicaServer` itself is model-agnostic — it serves whatever
 ``handler(msg, reply)`` it is given, which keeps the whole fleet
@@ -58,7 +62,8 @@ class ReplicaServer:
                  registry_addr: Optional[str] = None,
                  heartbeat_interval: float = 0.3,
                  advertise_host: Optional[str] = None,
-                 extra_info: Optional[Callable[[], Dict[str, Any]]] = None):
+                 extra_info: Optional[Callable[[], Dict[str, Any]]] = None,
+                 status: Optional[str] = None):
         self.handler = handler
         self.token = token
         self.capacity = int(capacity)
@@ -72,6 +77,13 @@ class ReplicaServer:
         # so the gateway's prefix-affinity routing knows what this
         # replica has resident.
         self.extra_info = extra_info
+        # Lifecycle status advertised on the hello AND every beat
+        # ("warming" while the batcher compiles its entry points; None
+        # = routable).  It rides the hello so the registry never has a
+        # window where a still-compiling replica looks routable, and
+        # the replica flips itself live by just dropping the field
+        # (set_status(None)) once warmup returns.
+        self._status = status
         self.log = get_logger("tfmesos_tpu.fleet.replica")
         self.addr: Optional[str] = None
         self._listen: Optional[socket.socket] = None
@@ -85,6 +97,12 @@ class ReplicaServer:
     def outstanding(self) -> int:
         with self._olock:
             return self._outstanding
+
+    def set_status(self, status: Optional[str]) -> None:
+        """Change the advertised lifecycle status.  The next beat (one
+        ``heartbeat_interval`` away at most) carries it; flipping to
+        ``None`` is how a warmed replica advertises itself routable."""
+        self._status = status
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -213,6 +231,9 @@ class ReplicaServer:
     # -- heartbeats --------------------------------------------------------
 
     def _merge_extra(self, beat: Dict[str, Any]) -> None:
+        status = self._status
+        if status is not None:
+            beat["status"] = status
         if self.extra_info is None:
             return
         try:
@@ -498,6 +519,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "additionally imports exported KV and enters "
                         "rows straight into decode (disaggregated "
                         "serving, docs/SERVING.md)")
+    p.add_argument("--pipeline-depth", type=int, default=0,
+                   choices=(0, 1), dest="pipeline_depth",
+                   help="1 pipelines the decode loop with a device-"
+                        "resident carry: block N+1 dispatches from the "
+                        "previous block's on-device outputs and block "
+                        "N's tokens sync one block behind — token "
+                        "streams identical to 0 (the default, fully "
+                        "synchronous; docs/SERVING.md)")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile every jitted serving entry point at "
+                        "boot (ContinuousBatcher.warmup) before taking "
+                        "traffic; the replica registers as 'warming' — "
+                        "never routed — and flips itself alive when "
+                        "warmup returns, so a relaunch re-warms before "
+                        "its first request pays a compile")
     p.add_argument("--tiny", action="store_true",
                    help="serve the tiny CI model instead of the flagship")
     p.add_argument("--seed", type=int, default=0)
@@ -521,14 +557,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg, params, rows=args.rows, max_len=args.max_len,
         page_size=args.page_size, prefill_bucket=args.prefill_bucket,
         multi_step=args.multi_step,
-        prefix_cache_pages=args.prefix_cache_pages)
+        prefix_cache_pages=args.prefix_cache_pages,
+        pipeline_depth=args.pipeline_depth)
     serving = None
     if args.role == "prefill":
         # Prefill-role replicas never decode: no serve loop runs, the
         # handler drives export_kv directly (exports borrow rows).
         handler = prefill_handler(batcher)
     else:
-        serving = BatcherServing(batcher).start()
+        # NOT started yet: warmup must run before the serve loop owns
+        # the rows; submissions made while warming just queue.
+        serving = BatcherServing(batcher)
         handler = batcher_handler(serving)
 
     def extra() -> Dict[str, Any]:
@@ -544,8 +583,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     server = ReplicaServer(
         handler, token=token, capacity=args.rows,
         host=args.host, port=args.port, registry_addr=args.registry,
-        heartbeat_interval=args.heartbeat_interval, extra_info=extra)
+        heartbeat_interval=args.heartbeat_interval, extra_info=extra,
+        status="warming" if args.warmup else None)
+    # Register (as warming with --warmup) BEFORE compiling: the fleet's
+    # bring-up accounting sees the replica exists while the router
+    # cannot yet pick it, and a relaunched replica is visibly re-warming
+    # instead of silently absent.
     server.start()
+    if args.warmup:
+        # Role replicas warm only the surface they serve: a prefill
+        # replica never decodes, a decode replica never prefills (it
+        # imports exported KV) — compiling the other role's per-width
+        # executables would only lengthen the warming window re-paid on
+        # every elastic/Mode-B relaunch.
+        info = batcher.warmup(decode=(args.role != "prefill"),
+                              prefill=(args.role != "decode"))
+        log.info("warmup compiled %s in %.1fs", info["compiled"],
+                 info["seconds"])
+        print(f"replica warmed in {info['seconds']:.1f}s "
+              f"({len(info['compiled'])} entry points)", flush=True)
+    if serving is not None:
+        serving.start()
+    server.set_status(None)     # routable: the next beat drops 'warming'
     print(f"replica serving on {server.addr} (role {args.role})",
           flush=True)
 

@@ -48,6 +48,17 @@ disaggregated path exhausts its retries — the request FALLS BACK to
 the unified tier, so an all-unified fleet (every existing deployment)
 routes exactly as before.  Plain generates never land on a
 prefill-role replica.
+
+**Warming replicas** (registered with ``status: warming`` while
+``ContinuousBatcher.warmup`` compiles their entry points) are excluded
+by EVERY pick — ``pick``/``pick_prefill``/``pick_decode`` all candidate
+through ``registry.alive()``, which a warming replica is not in.  A
+tier whose only members are warming behaves exactly like an empty
+tier: the unified path raises :class:`RoutingError`'s "no alive
+replicas" (or retries another tier member), and the disaggregated path
+falls back to unified — the same fallback semantics as above, so a
+re-warming relaunch is indistinguishable from a not-yet-launched
+replica to routing.
 """
 
 from __future__ import annotations

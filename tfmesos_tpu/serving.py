@@ -481,7 +481,15 @@ class _PagedSide:
                    for r in set(rows_map) | set(self.row_cached)
                    if rows_map.get(r) or self.row_cached.get(r)),
                   default=1)
-        return min(1 << occ.bit_length(), self.np_max)
+        return self.width_for(occ, self.np_max)
+
+    @staticmethod
+    def width_for(occ: int, np_max: int) -> int:
+        """The table width dispatched for ``occ`` allocated pages — the
+        ONE bucketing formula, shared with ``ContinuousBatcher.
+        _decode_widths`` so warmup compiles exactly the widths the
+        serve loop will request."""
+        return min(1 << occ.bit_length(), np_max)
 
     def decode_table(self, active: Dict[int, _Row],
                      decoding: Dict[int, _Row]) -> jnp.ndarray:
@@ -850,6 +858,35 @@ class ContinuousBatcher:
     surfaces one round late with the overshoot round's up-to-
     ``n_draft+1`` extra positions reserved per row.
 
+    ``pipeline_depth=1`` PIPELINES the decode loop with a
+    device-resident carry: where ``overlap`` still re-uploads the
+    per-row token/position/step vectors every block, the pipelined loop
+    feeds block N+1 straight from the previous dispatch's device
+    outputs (tokens, positions, AND steps stay on device; the page
+    table and the small host-merge inputs are refreshed only when
+    admission/prefill/finish actually changed the dispatch set) and
+    syncs block N's tokens one block behind via the in-flight async
+    transfer.  Host-side stop/quota detection lags one block; the
+    overshoot block's writes land inside the row's clamped reservation
+    or on sink columns — the exact mid-block-stop discard semantics
+    ``_step`` documents — so token streams are IDENTICAL to
+    ``pipeline_depth=0`` (greedy AND sampled: the (rid, step) key folds
+    are unchanged).  Composes with ``multi_step``, chunked prefill,
+    int8 pools, ``mesh``, ``prefix``, and the prefix cache; speculative
+    decoding BYPASSES explicitly (``pipeline_bypass_reason`` — its
+    overlap mode already carries state on device); ``overlap=True``
+    plus ``pipeline_depth=1`` is rejected (pick one).  ``0`` preserves
+    the synchronous loop exactly.
+
+    :meth:`warmup` compiles every jitted entry point the configured
+    mode can dispatch (admission prefill, chunk prefill, decode block
+    per table-width bucket, speculative round, KV export/import
+    scatter) against dummy all-sink shapes — call it at boot to move
+    first-request compilation off the serving path.  The fleet's
+    ``warming`` replica state rides on it: a replica registers as
+    warming, warms, and only then advertises itself routable
+    (docs/SERVING.md "Warmup & the warming state").
+
     ``mesh`` (optional) makes the WHOLE serving loop multi-chip: a
     data (dp/fsdp) x tp ``jax.sharding.Mesh`` — possibly spanning
     processes — over which every model call runs sharded.  Rows are
@@ -924,7 +961,8 @@ class ContinuousBatcher:
                  overlap: bool = False,
                  draft_quantized_cache: bool = False,
                  multi_step: int = 1,
-                 prefix_cache_pages: int = 0):
+                 prefix_cache_pages: int = 0,
+                 pipeline_depth: int = 0):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         if prefix_cache_pages < 0:
@@ -937,8 +975,30 @@ class ContinuousBatcher:
                 "multi_step does not compose with speculative decoding — "
                 "a speculative round already commits up to n_draft+1 "
                 "tokens per dispatch; use one or the other")
+        if pipeline_depth not in (0, 1):
+            raise ValueError(f"pipeline_depth must be 0 (synchronous "
+                             f"host sync) or 1 (one block of device-"
+                             f"resident lag), got {pipeline_depth}")
+        if pipeline_depth and overlap:
+            raise ValueError(
+                "pipeline_depth=1 already double-buffers the decode loop "
+                "with a device-resident carry; drop overlap=True (use "
+                "overlap alone for speculative double-buffering)")
         self.multi_step = int(multi_step)
         self.overlap = bool(overlap)
+        # Pipelined device-resident decode (pipeline_depth=1): block N+1
+        # is dispatched from the device-side carry — tokens, positions,
+        # AND steps never round-trip to the host between blocks — and
+        # block N's tokens are synced one block behind.  Speculative
+        # decoding bypasses explicitly (a round already carries its
+        # state on device under overlap=True); the recorded reason makes
+        # the bypass observable, like prefix_cache_bypass_reason.
+        self.pipeline_depth = int(pipeline_depth)
+        self.pipeline_bypass_reason: Optional[str] = None
+        if pipeline_depth and draft_cfg is not None:
+            self.pipeline_bypass_reason = "speculative decoding"
+        self._pipe_carry = None     # device (tok, pos, step) carry
+        self._pipe_host = None      # cached host-side dispatch inputs
         # Overlap mode: (device outputs of the in-flight dispatch,
         # {row: rid} ticket).  Speculative overlap additionally carries
         # the device-side (positions, steps) the next round continues
@@ -1110,6 +1170,13 @@ class ContinuousBatcher:
     @property
     def prefix_cache_active(self) -> bool:
         return self._pcache is not None
+
+    @property
+    def _pipelined(self) -> bool:
+        """Pipelined decode is actually in effect (requested AND not
+        bypassed)."""
+        return self.pipeline_depth > 0 and \
+            self.pipeline_bypass_reason is None
 
     def prefix_cache_stats(self) -> Optional[Dict[str, int]]:
         """Hit/miss/eviction counters plus current occupancy of the
@@ -1319,6 +1386,32 @@ class ContinuousBatcher:
             (pool, _, _, _), toks_all = jax.lax.scan(
                 body, (pool, tok0, positions, steps), None, length=K)
             return pool, toks_all.T                         # [rows, K]
+
+        if self._pipelined:
+            # Device-resident pipelined blocks: tokens, positions, AND
+            # steps ride the carry the previous dispatch returned, so a
+            # steady-state block uploads NOTHING — the host merges fresh
+            # admissions in via ``use_host`` (a cached device constant
+            # while the dispatch set is unchanged) and reads block N's
+            # tokens one block behind.  Carries clamp at max_len + K so
+            # a parked (finished) row's garbage positions saturate
+            # instead of overflowing int32 in a long-lived server; live
+            # rows never reach the clamp (their reservations cap pos at
+            # max_len).
+            @partial(jax.jit, donate_argnums=1)
+            def fn(params, pool, table, use_host, toks, positions, steps,
+                   carry_tok, carry_pos, carry_steps, rids):
+                tok0 = jnp.where(use_host, toks, carry_tok)
+                pos0 = jnp.where(use_host, positions, carry_pos)
+                stp0 = jnp.where(use_host, steps, carry_steps)
+                pool, out = block(params, pool, table, tok0, pos0, rids,
+                                  stp0)
+                cap = max_len + K
+                return (pool, self._host_read(out), out[:, -1],
+                        jnp.minimum(pos0 + K, cap),
+                        jnp.minimum(stp0 + K, cap))
+
+            return fn
 
         if self.overlap:
             # Double-buffered blocks: rows in the previous dispatch chain
@@ -1567,7 +1660,7 @@ class ContinuousBatcher:
             # (k+1)-token chunk: its writes overshoot by up to n_draft
             # (and the draft's k+1 scan steps write the same positions).
             need_len += self.n_draft
-        if self.overlap:
+        if self.overlap or self._pipelined:
             if self.draft_cfg is not None:
                 # Speculative overlap: ANY ending (quota included —
                 # commit counts are decided on device) surfaces one
@@ -1575,7 +1668,8 @@ class ContinuousBatcher:
                 # n_draft+1 positions past the end.
                 need_len += self.n_draft + 1
             elif req.stop_token is not None:
-                # A stop is detected one block late: reserve one position
+                # A stop is detected one block late (overlap and
+                # pipelined modes alike): reserve one position
                 # past the stop so the overshoot write can land in an own
                 # page.  With multi_step > 1 the overshoot can reach K-1
                 # further positions (and quota overruns up to K-1 exist
@@ -1747,6 +1841,184 @@ class ContinuousBatcher:
             self._validate_artifact(req.artifact, req.request)
             return
         self._worst_pages(req)
+
+    # -- ahead-of-time warmup ----------------------------------------------
+
+    def _decode_widths(self) -> List[int]:
+        """Every table width ``bucket_width`` can hand the batched
+        step — one jit trace each.  Derived by enumerating occupancies
+        through the SAME ``_PagedSide.width_for`` the live dispatch
+        buckets with, so warmup can never drift from the widths the
+        serve loop actually requests."""
+        np_max = self.t_side.np_max
+        return sorted({_PagedSide.width_for(occ, np_max)
+                       for occ in range(1, np_max + 1)})
+
+    def _prefill_widths(self) -> List[int]:
+        """Every padded prompt width non-chunked admission can dispatch:
+        ``_admit_dispatch`` pads prompts to multiples of
+        ``prefill_bucket``, and ``_worst_pages`` admits only widths
+        whose reservation (``prefix_len + width`` at minimum) fits
+        ``max_len`` — one jit trace each, mirroring the linear
+        ``_prefill_fns`` cache the live path fills lazily.  (Chunked
+        mode has ONE chunk width and doesn't use this.)"""
+        b = self.prefill_bucket
+        cap = ((self.max_len - self.prefix_len) // b) * b
+        return list(range(b, cap + 1, b)) or [b]
+
+    def warmup(self, decode: bool = True,
+               prefill: bool = True) -> Dict[str, Any]:
+        """Compile every jitted entry point this batcher's serving mode
+        dispatches — admission prefill at every reachable padded prompt
+        width (or the single chunked/tail prefill writer), the batched
+        decode block at every bucketed table width (or the speculative
+        round + draft chunk writer with a draft), and the disaggregated
+        KV export/import scatter where the mode supports it — against
+        dummy all-sink shapes, and block until the executables are
+        built.  ``decode=False`` skips the per-width decode/spec-round
+        blocks: a prefill-ROLE fleet replica never decodes, and
+        compiling log2(np_max) executables it cannot dispatch would
+        only lengthen its warming window on every elastic relaunch.
+        ``prefill=False`` is the mirror for decode-ROLE replicas —
+        they only import exported KV (rows enter decode directly;
+        plain generates route to the unified tier), so the per-width
+        prefill/tail/draft-chunk compiles are skipped the same way.
+
+        Every write a warmup call dispatches lands on the sink page
+        (the table is all-sink), so no live row, shared-prefix page, or
+        prefix-cache state is touched: a warmed batcher's outputs are
+        bit-identical to a cold one's.  Call at boot, before
+        :meth:`serve`/:meth:`run` — moving first-request compilation
+        off the serving path is what the fleet's ``warming`` replica
+        state exists for (a replica only advertises itself routable
+        once this returns).  Coverage is every first-request shape the
+        configured mode can dispatch (a mixed spec table-width pair can
+        still compile lazily); non-chunked prefill has one trace per
+        reachable width, so a long-``max_len`` pool that cares about
+        warmup time should serve with ``prefill_chunk`` (one trace).
+
+        Returns ``{"compiled": [...], "seconds": float}``."""
+        t0 = time.perf_counter()
+        compiled: List[str] = []
+        with self._export_lock:
+            if self._loop_active:
+                raise RuntimeError(
+                    "warmup() cannot run while the batcher's serve loop "
+                    "is active — warm at boot, before serve()/run()")
+            nd = self.n_shards
+            zrow = jnp.asarray(np.zeros((nd,), np.int32))
+
+            def sink_table(side):
+                return jnp.asarray(np.full((nd, side.np_max), side.sink,
+                                           np.int32))
+
+            if prefill and self._chunk_prefill is None:
+                for w in self._prefill_widths():
+                    self.pool, tok = self._prefill_fn(w)(
+                        self.params, self.pool, sink_table(self.t_side),
+                        jnp.asarray(np.zeros((nd, w), np.int32)),
+                        jnp.asarray(np.ones((nd,), np.int32)), zrow)
+                    np.asarray(tok)
+                    compiled.append(f"prefill[{w}]")
+            cfn = self._chunk_prefill or self._tail_prefill
+            if prefill and cfn is not None:
+                # The chunk loop always feeds the fixed chunk width,
+                # but the prefix-cache TAIL path dispatches this same
+                # callable at every multiple-of-bucket tail width (one
+                # retrace each, like the live path) — cover them all,
+                # or a warmed replica's first multi-bucket warm-cache
+                # hit pays a live XLA trace.
+                widths = (self._prefill_widths() if self._pcache is not None
+                          else [self.prefill_chunk or self.prefill_bucket])
+                for w in widths:
+                    self.pool, tok = cfn(
+                        self.params, self.pool, sink_table(self.t_side),
+                        jnp.asarray(np.zeros((nd, w), np.int32)),
+                        jnp.asarray(0, jnp.int32),
+                        jnp.asarray(np.full((nd,), -1, np.int32)), zrow)
+                    np.asarray(tok)
+                    compiled.append(f"chunk_prefill[{w}]")
+            zt = jnp.asarray(np.zeros((self.rows,), np.int32))
+            no_host = jnp.asarray(np.zeros((self.rows,), bool))
+            for w in self._decode_widths() if decode else ():
+                table = jnp.asarray(np.full((self.rows, w),
+                                            self.t_side.sink, np.int32))
+                if self.draft_cfg is not None:
+                    dtable = jnp.asarray(np.full(
+                        (self.rows, w), self.d_side.sink, np.int32))
+                    parked = jnp.asarray(np.full(
+                        (self.rows,), self.max_len, np.int32))
+                    if self.overlap:
+                        k1 = self.n_draft + 1
+                        carry = (jnp.zeros((self.rows, k1), jnp.int32),
+                                 zt, zt, zt)
+                        (self.pool, self.d_side.pool, g, nc, _,
+                         _) = self._spec_round(
+                            self.params, self.pool, self.draft_params,
+                            self.d_side.pool, table, dtable, zt, parked,
+                            zt, zt, no_host, *carry)
+                    else:
+                        (self.pool, self.d_side.pool, g,
+                         nc) = self._spec_round(
+                            self.params, self.pool, self.draft_params,
+                            self.d_side.pool, table, dtable, zt, parked,
+                            zt, zt)
+                    np.asarray(nc)
+                    compiled.append(f"spec_round[{w}]")
+                elif self._pipelined:
+                    self.pool, out, _, _, _ = self._decode(
+                        self.params, self.pool, table, no_host, zt, zt,
+                        zt, zt, zt, zt, zt)
+                    np.asarray(out)
+                    compiled.append(f"decode[{w}]")
+                elif self.overlap:
+                    prev = jnp.zeros((self.rows, self.multi_step),
+                                     jnp.int32)
+                    self.pool, out = self._decode(
+                        self.params, self.pool, table, zt, prev, no_host,
+                        zt, zt, zt)
+                    np.asarray(out)
+                    compiled.append(f"decode[{w}]")
+                else:
+                    self.pool, out = self._decode(
+                        self.params, self.pool, table, zt, zt, zt, zt)
+                    np.asarray(out)
+                    compiled.append(f"decode[{w}]")
+            if prefill and self.draft_cfg is not None:
+                # Chunked mode feeds the draft the fixed chunk width;
+                # non-chunked admission feeds it the PADDED PROMPT
+                # width — every multiple-of-bucket trace the live path
+                # would fill lazily.
+                dws = ([self.prefill_chunk] if self._chunk_prefill
+                       is not None else self._prefill_widths())
+                for w in dws:
+                    self.d_side.pool = self._draft_chunk(
+                        self.draft_params, self.d_side.pool,
+                        sink_table(self.d_side),
+                        jnp.asarray(np.zeros((nd, w), np.int32)),
+                        jnp.asarray(self.prefix_len, jnp.int32))
+                    jax.block_until_ready(self.d_side.pool)
+                    compiled.append(f"draft_chunk[{w}]")
+            for side in (self.t_side, self.d_side):
+                if side is None:
+                    continue
+                if side.tail_template is not None or side.pcache is not None:
+                    dst = np.full((nd,), side.sink, np.int32)
+                    side.pool = side.copy(side.pool, side.sink, dst)
+                    jax.block_until_ready(side.pool)
+                    compiled.append("page_copy")
+            if self.d_side is None and self.n_shards == 1:
+                # The disaggregated surface (export gather + import
+                # scatter) — compiled at the one-page count; larger
+                # transfers trace lazily per page count.
+                ids = jnp.asarray([self.t_side.sink], jnp.int32)
+                payload = _gather_pages(self.pool, ids)
+                jax.block_until_ready(payload)
+                self.pool = _install_pages(self.pool, payload, ids)
+                jax.block_until_ready(self.pool)
+                compiled.append("kv_export_import[1]")
+        return {"compiled": compiled,
+                "seconds": round(time.perf_counter() - t0, 3)}
 
     # -- disaggregated serving: KV export / import -------------------------
 
@@ -2128,14 +2400,18 @@ class ContinuousBatcher:
                                                            free_rows)
                     elif self.draft_cfg is not None:
                         yield from self._step_spec(active, free_rows)
+                    elif self._pipelined:
+                        yield from self._step_pipelined(active, free_rows)
                     elif self.overlap:
                         yield from self._step_overlap(active, free_rows)
                     else:
                         yield from self._step(active, free_rows)
         finally:
             # A consumer that stops early (break / close) must not leak
-            # the in-flight rows' pages (or a stale overlap dispatch).
+            # the in-flight rows' pages (or a stale overlap/pipelined
+            # dispatch and its device carry).
             self._inflight = None
+            self._pipe_carry = self._pipe_host = None
             for row in list(active):
                 self._finish(row, active, free_rows)
             # Dropped only after the rows are released, so an export
@@ -2465,6 +2741,81 @@ class ContinuousBatcher:
                 row.step += K
         else:
             self._inflight = None
+        if prev is not None:
+            yield from self._retire(prev, active, free_rows)
+
+    def _step_pipelined(self, active: Dict[int, _Row],
+                        free_rows: List[int]) -> Iterator[Completion]:
+        """One PIPELINED K-block tick (``pipeline_depth=1``): dispatch
+        block N+1 BEFORE syncing block N, like :meth:`_step_overlap`,
+        but with the whole decode carry — last token, positions, AND
+        steps — resident on device: the jitted block returns them as
+        outputs that feed the next dispatch directly, so a steady-state
+        block uploads nothing at all (the overlap path re-uploads four
+        [rows] vectors per block).  Host-side inputs (fresh admissions'
+        token/position/step, the rid vector, the ``use_host`` merge
+        mask) are rebuilt only when the dispatch set actually changed —
+        admission, a finish, a chunked-prefill flip — exactly like the
+        page table, and are cached device constants otherwise.
+
+        Stop/quota detection lags one block; the overshoot block's
+        writes land inside the row's clamped reservation or on sink
+        columns and its tokens fail :meth:`_retire`'s rid-checked
+        ticket — the discard semantics ``_step`` already documents for
+        mid-block stops — so token streams are IDENTICAL to
+        ``pipeline_depth=0`` (same ops, same (rid, step) sample folds,
+        only the sync point moves)."""
+        K = self.multi_step
+        dispatch = {r: row for r, row in active.items()
+                    if row.decoding and row.step < row.req.max_new_tokens}
+        prev = self._inflight
+        if dispatch:
+            prev_ticket = {} if prev is None else prev[1]
+            ticket = {r: row.rid for r, row in dispatch.items()}
+            # Rows entering this block from HOST values: fresh
+            # admissions, chunked-prefill flips, re-admissions into a
+            # freed row — anything the device carry does not cover.
+            fresh = frozenset(r for r, rid in ticket.items()
+                              if prev_ticket.get(r) != rid)
+            for r, row in dispatch.items():
+                self._ensure_sides(r, min(row.pos + K, row.limit))
+            table = self.t_side.decode_table(active, dispatch)
+            key = (tuple(sorted(ticket.items())), fresh)
+            host = self._pipe_host
+            if host is None or host[0] != key:
+                toks = np.zeros((self.rows,), np.int32)
+                use_host = np.zeros((self.rows,), bool)
+                positions = np.zeros((self.rows,), np.int32)
+                steps = np.zeros((self.rows,), np.int32)
+                rids = np.zeros((self.rows,), np.int32)
+                for r, row in dispatch.items():
+                    rids[r] = row.rid
+                    if r in fresh:
+                        use_host[r] = True
+                        toks[r] = row.last
+                        positions[r] = row.pos
+                        steps[r] = row.step
+                host = (key, jnp.asarray(use_host), jnp.asarray(toks),
+                        jnp.asarray(positions), jnp.asarray(steps),
+                        jnp.asarray(rids))
+                self._pipe_host = host
+            carry = self._pipe_carry
+            if carry is None:       # pipeline start: fresh rows only
+                carry = (jnp.zeros((self.rows,), jnp.int32),
+                         jnp.zeros((self.rows,), jnp.int32),
+                         jnp.zeros((self.rows,), jnp.int32))
+            self.pool, nxt, ct, cp, cs = self._decode(
+                self.params, self.pool, table, host[1], host[2], host[3],
+                host[4], carry[0], carry[1], carry[2], host[5])
+            nxt.copy_to_host_async()    # transfer overlaps the block
+            self._pipe_carry = (ct, cp, cs)
+            self._inflight = (nxt, ticket)
+            for row in dispatch.values():
+                row.pos += K
+                row.step += K
+        else:
+            self._inflight = None
+            self._pipe_carry = self._pipe_host = None
         if prev is not None:
             yield from self._retire(prev, active, free_rows)
 
