@@ -890,11 +890,13 @@ def bench_fleet_serving(n_requests=32, replicas=2, rows=4, tiny=True,
         assert len(done) == n_requests
         ttft = sum(r["ttft_ms"] for r in done) / len(done)
         # Admission-queue wait is its OWN histogram (never folded into
-        # TTFT): report its p50 so the gateway backlog is visible
-        # separately from the serving path.
+        # TTFT): report its p50 AND p99 — the autoscaler keys off the
+        # p99 tail, so the signal scaling reacts to must be a
+        # first-class observable, not a median that hides the stalls.
         qw = fleet.snapshot()["histograms"].get("queue_wait_ms", {})
         client.close()
-        return n_requests / dt, ttft, qw.get("p50", 0.0)
+        return (n_requests / dt, ttft, qw.get("p50", 0.0),
+                qw.get("p99", 0.0))
     finally:
         fleet.stop()
 
@@ -1026,6 +1028,104 @@ def bench_fleet_disagg(n_decode=8, decode_new=24, prompt_len=96,
          f"into the decode tier")
     kv_mb_s = c.get("kv_transfer_bytes", 0) / 1e6 / dis_wall
     return dis_ttft, dis_itl, uni_ttft, uni_itl, kv_mb_s
+
+
+def bench_fleet_autoscale(rows=2, max_new_tokens=4, workers=8):
+    """Control-plane reaction benchmarks on a live LocalBackend fleet:
+
+    * ``fleet_scaleup_reaction_s`` — surge start → a NEW replica task
+      launched by the autoscaler is registered and ROUTABLE.  The surge
+      is an injected signal (the chaos.py discipline: the bench
+      measures the fleet's launch→register→alive pipeline, not signal
+      plumbing) and the loop is stepped by hand, so the number is the
+      actuation cost, deterministically triggered.
+    * ``fleet_rollout_downtime_ms`` — a blue-green rollout to a new
+      weights_version runs under CONTINUOUS traffic; every request must
+      succeed (zero Overloaded, zero RoutingError — asserted), so the
+      recorded downtime is 0 by contract and the bench fails loudly the
+      day it is not.
+    """
+    import threading
+
+    from tfmesos_tpu.fleet.autoscaler import (AutoscalerConfig,
+                                              FleetAutoscaler)
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 97, size=(8,)).astype(np.int32)
+               for _ in range(16)]
+    fleet = FleetServer(replicas=1, rows=rows, tiny=True, max_len=64,
+                        page_size=16, prefill_bucket=16, workers=workers,
+                        max_queue=256, min_replicas=1, max_replicas=2,
+                        request_timeout=300.0, start_timeout=300.0)
+    fleet.start()
+    try:
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+        client.generate(prompts[0], 2)      # warm the compile
+
+        def alive():
+            return fleet.registry.role_summary().get(
+                "unified", {}).get("alive", 0)
+
+        # Hand-stepped control loop over an injected signal source.
+        surge = {"queue_wait_p99_ms": 10_000.0, "util": 1.0,
+                 "kv_headroom": None}
+        calm = {"queue_wait_p99_ms": 0.0, "util": 0.0,
+                "kv_headroom": None}
+        sig = {"unified": surge}
+        auto = FleetAutoscaler(
+            fleet, AutoscalerConfig(scale_up_cooldown=0.0,
+                                    scale_down_cooldown=0.0,
+                                    drain_grace=0.2),
+            signals=lambda: dict(sig))
+        t0 = time.perf_counter()
+        deadline = t0 + 300.0
+        while alive() < 2:
+            if time.perf_counter() > deadline:
+                raise RuntimeError("autoscaled replica never routable")
+            auto.step()
+            time.sleep(0.05)
+        reaction_s = time.perf_counter() - t0
+        # Decay: the loop drains the least-loaded replica and kills it
+        # only after its outstanding work flushed.
+        sig["unified"] = calm
+        while fleet.tier_actual("unified") > 1:
+            if time.perf_counter() > deadline:
+                raise RuntimeError("scale-down drain never completed")
+            auto.step()
+            time.sleep(0.05)
+
+        # Blue-green rollout under continuous traffic.
+        stop = threading.Event()
+        failures = []
+
+        def feeder():
+            i = 0
+            while not stop.is_set():
+                try:
+                    client.generate(prompts[i % len(prompts)],
+                                    max_new_tokens, timeout=300.0)
+                except Exception as e:
+                    failures.append(e)
+                    return
+                i += 1
+
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        time.sleep(0.2)                 # traffic in flight first
+        fleet.rollout("v2", bake_s=0.5)
+        stop.set()
+        th.join(timeout=300.0)
+        client.close()
+        assert not failures, \
+            f"rollout failed/shed a request: {failures[0]!r}"
+        versions = fleet.registry.role_summary().get(
+            "unified", {}).get("versions", {})
+        assert list(versions) == ["v2"], versions
+        return reaction_s, 0.0
+    finally:
+        fleet.stop()
 
 
 def bench_bandwidth(sizes=None):
@@ -1401,10 +1501,20 @@ def main():
     if fl:
         # Gateway + 2 local CPU replicas: the online multi-replica path
         # (fleet subsystem) — tracks fleet overhead, not chip speed.
-        rps, ttft_ms, queue_wait_p50 = fl[0]
+        rps, ttft_ms, queue_wait_p50, queue_wait_p99 = fl[0]
         out["fleet_requests_per_sec"] = round(rps, 2)
         out["fleet_mean_ttft_ms"] = round(ttft_ms, 2)
         out["fleet_queue_wait_p50_ms"] = round(queue_wait_p50, 2)
+        out["fleet_queue_wait_p99_ms"] = round(queue_wait_p99, 2)
+        flush_partial()
+    asb = attempts(bench_fleet_autoscale, "fleet autoscale bench", n=1)
+    if asb:
+        # Control-plane reaction: surge start -> new replica routable,
+        # and a blue-green rollout under continuous traffic with ZERO
+        # failed requests asserted in-bench (downtime 0 by contract).
+        reaction_s, downtime_ms = asb[0]
+        out["fleet_scaleup_reaction_s"] = round(reaction_s, 2)
+        out["fleet_rollout_downtime_ms"] = round(downtime_ms, 2)
         flush_partial()
     dg = attempts(bench_fleet_disagg, "disaggregated fleet bench", n=1)
     if dg:

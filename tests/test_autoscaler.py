@@ -1,0 +1,1042 @@
+"""Fleet autoscaler + blue-green rollout (tfmesos_tpu/fleet/autoscaler.py,
+FleetServer.rollout): jax-free control-loop units over a fake fleet with
+injected signals and a fake clock (the chaos.py determinism discipline),
+a stub-replica smoke exercising the full scale-up → warming → routable →
+drain-by-node-id → kill path without a model, dynamic-scheduler units on
+LocalBackend, and the end-to-end acceptance paths: a signal surge grows
+a real CPU fleet through the warming state with zero failed requests,
+and a rollout to a new weights_version completes with every request
+served, the router never selecting the old version after the shift, and
+old-generation stragglers fenced out of re-registration."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.fleet.admission import AdmissionController
+from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig, FleetAutoscaler
+from tfmesos_tpu.fleet.client import FleetClient, RequestFailed
+from tfmesos_tpu.fleet.gateway import Gateway
+from tfmesos_tpu.fleet.metrics import FleetMetrics, Histogram
+from tfmesos_tpu.fleet.registry import (ALIVE, DEAD, DRAINING, WARMING,
+                                        ReplicaInfo, ReplicaRegistry)
+from tfmesos_tpu.fleet.replica import ReplicaServer
+from tfmesos_tpu.fleet.router import Router
+
+
+def _wait(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+SURGE = {"queue_wait_p99_ms": 5000.0, "util": 1.0, "kv_headroom": None}
+CALM = {"queue_wait_p99_ms": 0.0, "util": 0.0, "kv_headroom": None}
+MID = {"queue_wait_p99_ms": 200.0, "util": 0.5, "kv_headroom": None}
+
+
+# -- fakes (no sockets, no model) -------------------------------------------
+
+
+class FakeRegistry:
+    """Just enough registry surface for the control loop."""
+
+    def __init__(self, reps=()):
+        self.reps = list(reps)
+        self.drained = []
+        self.targets = {}
+
+    def members(self, role=None):
+        return [r for r in self.reps
+                if role is None or (r.role or "unified") == role]
+
+    def role_summary(self):
+        out = {}
+        for r in self.reps:
+            d = out.setdefault(r.role or "unified",
+                               {"alive": 0, "warming": 0, "draining": 0,
+                                "dead": 0, "outstanding": 0,
+                                "kv_headroom": 0, "versions": {}})
+            d[r.state] = d.get(r.state, 0) + 1
+            if r.state == ALIVE:
+                d["outstanding"] += r.outstanding
+                if r.kv_headroom > 0:
+                    d["kv_headroom"] += r.kv_headroom
+        return out
+
+    def set_target(self, role, n):
+        self.targets[role] = n
+
+    def begin_drain(self, addr, pinned=True):
+        for r in self.reps:
+            if r.addr == addr:
+                r.state = DRAINING
+                self.drained.append(addr)
+                return True
+        return False
+
+    def clear_drain(self, addr):
+        self.drained = [a for a in self.drained if a != addr]
+        for r in self.reps:
+            if r.addr == addr:
+                r.state = ALIVE
+
+
+class FakeFleet:
+    """The launch/kill surface the autoscaler actuates against."""
+
+    def __init__(self, registry, targets, bounds=(1, 4)):
+        self.registry = registry
+        self.metrics = FleetMetrics()
+        self.targets = dict(targets)
+        self._bounds = tuple(bounds)
+        self.scale_lock = threading.RLock()
+        self.launched = []
+        self.killed = []
+        self.dead_nodes = set()     # tasks already gone from the table
+        self._actual = dict(targets)
+
+    def set_target(self, role, n):
+        self.targets[role] = n
+        self.registry.set_target(role, n)
+
+    def bounds(self, role):
+        return self._bounds
+
+    def launch_replica(self, role, weights_version=None):
+        node = f"{role}:{len(self.launched)}"
+        self.launched.append((role, node))
+        self._actual[role] = self._actual.get(role, 0) + 1
+        return node
+
+    def kill_replica(self, node):
+        if node in self.dead_nodes:
+            return False            # remove_task on a vanished task
+        self.killed.append(node)
+        role = node.split(":", 1)[0]
+        self._actual[role] = self._actual.get(role, 1) - 1
+        return True
+
+    def tier_actual(self, role):
+        return self._actual.get(role, 0)
+
+
+def _rep(addr, role="unified", state=ALIVE, outstanding=0, node="",
+         capacity=4, weights_version=""):
+    return ReplicaInfo(addr=addr, role=role, state=state,
+                       outstanding=outstanding, node=node,
+                       capacity=capacity, weights_version=weights_version)
+
+
+def _auto(fleet, sig, clock, **cfg):
+    config = AutoscalerConfig(**cfg)
+    return FleetAutoscaler(fleet, config,
+                           signals=lambda: {k: dict(v)
+                                            for k, v in sig.items()},
+                           clock=lambda: clock[0])
+
+
+# -- control-loop units -----------------------------------------------------
+
+
+def test_autoscaler_surge_scales_up_with_cooldown_and_hysteresis():
+    reg = FakeRegistry([_rep("a:1")])
+    fleet = FakeFleet(reg, {"unified": 1}, bounds=(1, 4))
+    sig = {"unified": dict(SURGE)}
+    clock = [0.0]
+    auto = _auto(fleet, sig, clock, scale_up_cooldown=5.0,
+                 scale_down_cooldown=30.0)
+    auto.step()                     # surge: target 1 -> 2, one launch
+    assert fleet.targets["unified"] == 2
+    assert [r for r, _ in fleet.launched] == ["unified"]
+    auto.step()                     # same instant: up-cooldown holds it
+    assert fleet.targets["unified"] == 2
+    assert len(fleet.launched) == 1     # converged; no duplicate launch
+    clock[0] = 10.0
+    auto.step()                     # cooldown over, surge persists: -> 3
+    assert fleet.targets["unified"] == 3
+    assert len(fleet.launched) == 2
+    # Hysteresis dead band: a mid-band signal changes NOTHING even with
+    # every cooldown expired — the up and down thresholds never touch.
+    sig["unified"] = dict(MID)
+    clock[0] = 1000.0
+    auto.step()
+    assert fleet.targets["unified"] == 3
+    assert fleet.metrics.get("autoscale_up") == 2
+    assert fleet.metrics.get("autoscale_down") == 0
+
+
+def test_autoscaler_calm_drains_least_loaded_then_kills_after_flush():
+    busy = _rep("a:1", outstanding=3, node="replica:0")
+    idle = _rep("a:2", outstanding=0, node="replica:1")
+    reg = FakeRegistry([busy, idle])
+    fleet = FakeFleet(reg, {"unified": 2})
+    sig = {"unified": dict(CALM)}
+    clock = [100.0]
+    auto = _auto(fleet, sig, clock, scale_down_cooldown=0.0,
+                 drain_grace=1.0, drain_timeout=60.0)
+    auto.step()             # target 2 -> 1; drain the LEAST-loaded
+    assert fleet.targets["unified"] == 1
+    assert reg.drained == ["a:2"]
+    assert idle.state == DRAINING
+    assert not fleet.killed             # grace: outstanding may lag
+    clock[0] = 100.5
+    auto.step()                         # still inside the grace window
+    assert not fleet.killed
+    # In-flight work appears on a beat: the kill must wait for flush.
+    idle.outstanding = 2
+    clock[0] = 105.0
+    auto.step()
+    assert not fleet.killed
+    idle.outstanding = 0
+    clock[0] = 110.0
+    auto.step()                         # flushed + grace passed: reap
+    assert fleet.killed == ["replica:1"]
+    assert fleet.metrics.get("autoscale_kills") == 1
+    # No further drain: actual converged to target.
+    auto.step()
+    assert reg.drained == ["a:2"]
+
+
+def test_autoscaler_drain_timeout_reaps_a_stuck_victim():
+    stuck = _rep("a:1", outstanding=9, node="replica:0")
+    reg = FakeRegistry([stuck, _rep("a:2", outstanding=0,
+                                    node="replica:1")])
+    fleet = FakeFleet(reg, {"unified": 2})
+    sig = {"unified": dict(CALM)}
+    clock = [0.0]
+    auto = _auto(fleet, sig, clock, scale_down_cooldown=0.0,
+                 drain_grace=0.5, drain_timeout=30.0)
+    auto.step()
+    assert reg.drained == ["a:2"]
+    # The victim never flushes (its beats keep reporting outstanding):
+    victim = reg.reps[1]
+    victim.outstanding = 7
+    clock[0] = 29.0
+    auto.step()
+    assert not fleet.killed
+    clock[0] = 31.0
+    auto.step()                         # deadline passed: kill anyway
+    assert fleet.killed == ["replica:1"]
+
+
+def test_autoscaler_victim_death_mid_drain_does_not_spur_a_launch():
+    """A draining victim that dies before its reap already left the
+    scheduler table: its drain record must not ALSO discount actual, or
+    the loop would launch a spurious replica (full warmup churn) and
+    then drain it right back."""
+    a = _rep("a:1", outstanding=0, node="replica:0")
+    b = _rep("a:2", outstanding=1, node="replica:1")
+    reg = FakeRegistry([a, b])
+    fleet = FakeFleet(reg, {"unified": 2})
+    clock = [0.0]
+    auto = _auto(fleet, {"unified": dict(CALM)}, clock,
+                 scale_down_cooldown=0.0, drain_grace=0.5)
+    auto.step()                     # target 2 -> 1, drain a:1
+    assert reg.drained == ["a:1"]
+    # The victim crashes mid-drain: dynamic-death removes its task.
+    a.state = DEAD
+    fleet._actual["unified"] = 1
+    fleet.dead_nodes.add("replica:0")
+    clock[0] = 10.0
+    auto.step()
+    assert fleet.launched == []     # no spurious replacement
+    assert fleet.metrics.get("autoscale_kills") == 1    # reaped as dead
+    clock[0] = 20.0
+    auto.step()                     # converged: 1 task, target 1
+    assert fleet.launched == [] and len(reg.drained) == 1
+
+
+def test_autoscaler_unkillable_victim_releases_the_drain():
+    """A drained victim with no node mapping (malformed beat field, or
+    the task vanished) must be RELEASED, not left pinned-DRAINING
+    forever — a zombie drain would block convergence and get healthy
+    peers drained in its place."""
+    noname = _rep("a:1", outstanding=0, node="")     # never advertised
+    reg = FakeRegistry([noname, _rep("a:2", outstanding=5,
+                                     node="replica:1")])
+    fleet = FakeFleet(reg, {"unified": 2})
+    clock = [0.0]
+    auto = _auto(fleet, {"unified": dict(CALM)}, clock,
+                 scale_down_cooldown=0.0, drain_grace=0.5)
+    auto.step()                         # drains the least-loaded: a:1
+    assert reg.drained == ["a:1"]
+    clock[0] = 10.0
+    auto.step()                         # flushed, but unkillable
+    assert fleet.killed == []
+    assert reg.drained == []            # drain released, not zombified
+    assert noname.state == ALIVE
+    assert fleet.metrics.get("autoscale_kills") == 0
+    assert fleet.metrics.get("autoscale_kill_failures") == 1
+
+
+def test_autoscaler_bounds_clamp_and_never_below_one_alive():
+    reg = FakeRegistry([_rep("a:1")])
+    fleet = FakeFleet(reg, {"unified": 2}, bounds=(1, 2))
+    sig = {"unified": dict(SURGE)}
+    clock = [0.0]
+    auto = _auto(fleet, sig, clock, scale_up_cooldown=0.0,
+                 scale_down_cooldown=0.0)
+    auto.step()
+    assert fleet.targets["unified"] == 2        # max bound holds
+    # Scale-down with only ONE alive member (the other died): target
+    # may shrink but the last alive replica is never drained.
+    fleet2 = FakeFleet(FakeRegistry([_rep("b:1"),
+                                     _rep("b:2", state=WARMING)]),
+                       {"unified": 2})
+    sig2 = {"unified": dict(CALM)}
+    auto2 = _auto(fleet2, sig2, clock, scale_down_cooldown=0.0)
+    auto2.step()
+    assert fleet2.targets["unified"] == 1
+    assert fleet2.registry.drained == []        # invariant held
+    # Min bound: target 1 with calm signals stays 1 — never 0.
+    fleet3 = FakeFleet(FakeRegistry([_rep("c:1")]), {"unified": 1})
+    auto3 = _auto(fleet3, {"unified": dict(CALM)}, clock,
+                  scale_down_cooldown=0.0)
+    auto3.step()
+    assert fleet3.targets["unified"] == 1
+    assert fleet3.registry.drained == []
+
+
+def test_autoscaler_decode_tier_scales_on_kv_headroom():
+    reg = FakeRegistry([_rep("d:1", role="decode", node="decode:0"),
+                        _rep("d:2", role="decode", node="decode:1")])
+    fleet = FakeFleet(reg, {"decode": 2}, bounds=(1, 4))
+    sig = {"decode": {"queue_wait_p99_ms": None, "util": 0.0,
+                      "kv_headroom": 2.0}}
+    clock = [0.0]
+    auto = _auto(fleet, sig, clock, scale_up_cooldown=0.0,
+                 scale_down_cooldown=0.0, kv_headroom_lo=8.0,
+                 kv_headroom_hi=64.0, drain_grace=0.0)
+    auto.step()                     # pages exhausted: scale up
+    assert fleet.targets["decode"] == 3
+    assert fleet.launched and fleet.launched[0][0] == "decode"
+    # Plenty of headroom + idle: scale back down.
+    sig["decode"] = {"queue_wait_p99_ms": None, "util": 0.0,
+                     "kv_headroom": 500.0}
+    clock[0] = 100.0
+    auto.step()
+    assert fleet.targets["decode"] == 2
+    assert reg.drained            # a decode replica is draining
+
+
+def test_autoscaler_converge_relaunches_a_dead_replica():
+    """Self-healing rides convergence: a died task (actual < target)
+    is relaunched on the next tick even with no signal movement."""
+    reg = FakeRegistry([_rep("a:1")])
+    fleet = FakeFleet(reg, {"unified": 2})
+    fleet._actual["unified"] = 1        # one task died
+    auto = _auto(fleet, {"unified": dict(MID)}, [0.0])
+    auto.step()
+    assert fleet.targets["unified"] == 2        # target untouched
+    assert len(fleet.launched) == 1             # replacement launched
+    assert fleet.metrics.get("autoscale_launches") == 1
+
+
+def test_autoscaler_describe_gauge_reports_target_vs_actual():
+    reg = FakeRegistry([_rep("a:1"), _rep("a:2", state=WARMING)])
+    fleet = FakeFleet(reg, {"unified": 2}, bounds=(1, 8))
+    auto = _auto(fleet, {"unified": dict(MID)}, [0.0])
+    d = auto.describe()["unified"]
+    assert d["target"] == 2 and d["actual"] == 2
+    assert d["alive"] == 1 and d["warming"] == 1
+    assert d["min"] == 1 and d["max"] == 8
+    # Registered as the 'autoscaler' gauge on the fleet's metrics.
+    snap = fleet.metrics.snapshot()
+    assert snap["gauges"]["autoscaler"]["unified"]["target"] == 2
+
+
+def test_autoscaler_default_signals_windowed_p99_util_headroom():
+    """The real signal source (--autoscale): windowed queue-wait p99
+    from cumulative histogram diffs, utilization from heartbeat
+    outstanding/capacity, headroom per alive replica."""
+    reg = FakeRegistry([
+        _rep("a:1", outstanding=3, capacity=4),
+        _rep("a:2", outstanding=1, capacity=4),
+        _rep("d:1", role="decode", outstanding=0, capacity=4)])
+    reg.reps[2].kv_headroom = 40
+    fleet = FakeFleet(reg, {"unified": 2, "decode": 1})
+    for _ in range(10):
+        fleet.metrics.observe("queue_wait_ms", 4.0)
+    auto = FleetAutoscaler(fleet, AutoscalerConfig(), clock=lambda: 0.0)
+    sig = auto._default_signals()
+    assert sig["unified"]["queue_wait_p99_ms"] == 5.0   # bucket edge
+    assert sig["unified"]["util"] == pytest.approx(0.5)  # 4 of 8 rows
+    assert sig["unified"]["alive"] == 2
+    # The NEXT tick only sees the new window's samples.
+    for _ in range(5):
+        fleet.metrics.observe("queue_wait_ms", 700.0)
+    sig2 = auto._default_signals()
+    assert sig2["unified"]["queue_wait_p99_ms"] == 1000.0
+    # Decode headroom averages over alive members of that tier.
+    assert sig2["decode"]["kv_headroom"] == pytest.approx(40.0)
+    assert sig2["decode"]["util"] == 0.0
+
+
+def test_histogram_delta_percentile_is_windowed():
+    h = Histogram()
+    for _ in range(100):
+        h.observe(5.0)
+    prev = h.cumulative()
+    assert Histogram.delta_percentile(None, prev, 0.99) == 5.0
+    for _ in range(10):
+        h.observe(900.0)
+    cur = h.cumulative()
+    # Lifetime median still sits in the 5ms bucket...
+    assert Histogram.delta_percentile(None, cur, 0.50) == 5.0
+    # ... but the WINDOW between the two samples holds only the slow
+    # observations — the signal the autoscaler must react to.
+    assert Histogram.delta_percentile(prev, cur, 0.50) == 1000.0
+    # An empty window yields None, never a stale number.
+    assert Histogram.delta_percentile(cur, cur, 0.99) is None
+
+
+# -- the tox-lint smoke: stub replicas, real registry/router, no JAX --------
+
+
+def test_autoscaler_smoke_scaleup_warming_routable_scaledown():
+    """The jax-free autoscaler smoke: fake-signal scale-up launches a
+    stub replica that registers WARMING (invisible to routing), flips
+    alive (routable), then fake-signal decay drains it (pinned: its own
+    alive beats must not revive it) and kills it BY NODE ID."""
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=1.0, dead_after=2.0,
+                          evict_after=10.0, sweep_interval=0.05).start()
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    servers = {}
+
+    class StubFleet:
+        registry = reg
+        targets = {"unified": 1}
+        scale_lock = threading.RLock()
+
+        def __init__(self):
+            self.metrics = metrics
+            self._n = 0
+
+        def set_target(self, role, n):
+            self.targets[role] = n
+
+        def bounds(self, role):
+            return (1, 3)
+
+        def launch_replica(self, role, weights_version=None):
+            node = f"replica:{self._n}"
+            self._n += 1
+            srv = ReplicaServer(
+                lambda m, r: r({"op": "completion"}), token=token,
+                capacity=4, registry_addr=reg.addr,
+                heartbeat_interval=0.05, status=WARMING,
+                extra_info=(lambda n: lambda: {"node": n})(node))
+            servers[node] = srv.start()
+            return node
+
+        def kill_replica(self, node):
+            srv = servers.pop(node, None)
+            if srv is not None:
+                srv.stop()      # heartbeat conn EOF == process death
+            return srv is not None
+
+        def tier_actual(self, role):
+            return len(servers)
+
+    fleet = StubFleet()
+    try:
+        base = fleet.launch_replica("unified")      # the boot replica
+        servers[base].set_status(None)
+        assert _wait(lambda: len(reg.alive()) == 1)
+        base_addr = reg.alive()[0].addr
+        sig = {"unified": dict(SURGE)}
+        auto = FleetAutoscaler(
+            fleet, AutoscalerConfig(scale_up_cooldown=0.0,
+                                    scale_down_cooldown=0.0,
+                                    drain_grace=0.2, drain_timeout=30.0),
+            signals=lambda: {k: dict(v) for k, v in sig.items()})
+        auto.step()                     # surge -> launch a second stub
+        assert fleet.targets["unified"] == 2 and len(servers) == 2
+        new_node = next(n for n in servers if n != base)
+        assert _wait(lambda: len(reg.warming()) == 1)
+        # Warming is NOT routable: every pick lands on the base replica.
+        assert router.pick(exclude=(base_addr,)) is None
+        servers[new_node].set_status(None)          # "warmup returned"
+        assert _wait(lambda: len(reg.alive()) == 2)
+        new_addr = next(r.addr for r in reg.alive()
+                        if r.addr != base_addr)
+        assert _wait(lambda: router.pick(exclude=(base_addr,))
+                     == new_addr)
+        # Decay: drain + kill, BY NODE ID, without touching the peer.
+        sig["unified"] = dict(CALM)
+        deadline = time.monotonic() + 30.0
+        while len(servers) > 1 and time.monotonic() < deadline:
+            auto.step()
+            time.sleep(0.05)
+        assert set(servers) == {base} or set(servers) == {new_node}
+        # The victim's pinned drain held against its own alive beats
+        # (it kept heartbeating until the kill): it never re-entered
+        # routing, and the survivor is still routable.
+        assert _wait(lambda: len(reg.alive()) == 1, timeout=10.0)
+        assert metrics.get("autoscale_drains") == 1
+        assert metrics.get("autoscale_kills") == 1
+    finally:
+        for srv in servers.values():
+            srv.stop()
+        router.close()
+        reg.stop()
+
+
+# -- registry: pinned drain + generation fence ------------------------------
+
+
+def test_registry_pinned_drain_survives_alive_beat_newer_version_resets():
+    """The scale-down drain (begin_drain pinned) must survive the
+    victim's own plain alive AND warming beats while it flushes — but a
+    relaunch on the same addr advertising a NEWER weights_version must
+    reset the stale drain (extends the PR 5 announced_drain cases)."""
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=30.0, dead_after=60.0,
+                          sweep_interval=0.05).start()
+    try:
+        sock = wire.connect(reg.addr)
+        wire.send_msg(sock, {"op": "hello", "addr": "p:1",
+                             "weights_version": "v1",
+                             "outstanding": 2}, token)
+        assert _wait(lambda: len(reg.alive()) == 1)
+        assert reg.begin_drain("p:1", pinned=True)
+        assert [r["state"] for r in reg.snapshot()] == [DRAINING]
+        # A plain (routable) beat refreshes liveness but does NOT
+        # revive a pinned drain — unlike the replica-announced kind.
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "p:1",
+                             "weights_version": "v1",
+                             "outstanding": 0}, token)
+        time.sleep(0.2)
+        assert [r["state"] for r in reg.snapshot()] == [DRAINING]
+        assert reg.alive() == []
+        # ... and the beat's fields still landed (flush observability).
+        assert reg.members()[0].outstanding == 0
+        # A late warming beat cannot revive it either.
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "p:1",
+                             "status": "warming",
+                             "weights_version": "v1"}, token)
+        time.sleep(0.2)
+        assert [r["state"] for r in reg.snapshot()] == [DRAINING]
+        # A MALFORMED weights_version (bool is an int subclass) costs
+        # the field, never the beat — and must NOT coerce to the label
+        # "True" and spuriously reset the pin as a "newer version".
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "p:1",
+                             "weights_version": True}, token)
+        time.sleep(0.2)
+        assert [r["state"] for r in reg.snapshot()] == [DRAINING]
+        assert reg.members()[0].weights_version == "v1"
+        # A beat with a NEWER weights_version is a relaunch on a reused
+        # addr: the stale drain resets and the entry is routable again.
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "p:1",
+                             "weights_version": "v2"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == [ALIVE])
+        assert reg.members()[0].weights_version == "v2"
+        assert not reg.members()[0].drain_pinned
+        sock.close()
+    finally:
+        reg.stop()
+
+
+def test_registry_pinned_drain_dies_with_the_process():
+    """DEAD clears the pin exactly like announced_drain: a beat after
+    death is a NEW process on the reused addr."""
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=30.0, dead_after=60.0,
+                          sweep_interval=0.05).start()
+    try:
+        sock = wire.connect(reg.addr)
+        wire.send_msg(sock, {"op": "hello", "addr": "p:2"}, token)
+        assert _wait(lambda: len(reg.alive()) == 1)
+        reg.begin_drain("p:2", pinned=True)
+        reg.mark_dead("p:2")
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "p:2",
+                             "status": "warming"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == [WARMING])
+        wire.send_msg(sock, {"op": "heartbeat", "addr": "p:2"}, token)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()]
+                     == [ALIVE])
+        sock.close()
+    finally:
+        reg.stop()
+
+
+def test_registry_generation_fence_drops_stale_reregistration():
+    """After fence_generation(G), beats stamped gen < G — a stalled
+    old-generation straggler re-registering after its tier was reaped —
+    are dropped whole: the straggler can never serve stale weights."""
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=0.4, dead_after=0.8,
+                          evict_after=5.0, sweep_interval=0.05).start()
+    try:
+        sock = wire.connect(reg.addr)
+        wire.send_msg(sock, {"op": "hello", "addr": "g:1", "gen": 0,
+                             "weights_version": "v1"}, token)
+        assert _wait(lambda: len(reg.alive()) == 1)
+        reg.fence_generation(1)
+        # The fenced entry's beats no longer land: it goes stale → dead
+        # on the sweeper even though the process keeps beating.
+        for _ in range(6):
+            wire.send_msg(sock, {"op": "heartbeat", "addr": "g:1",
+                                 "gen": 0}, token)
+            time.sleep(0.2)
+        assert _wait(lambda: [r["state"] for r in reg.snapshot()
+                              if r["addr"] == "g:1"] in ([DEAD], []),
+                     timeout=5.0)
+        # Its re-registration (a fresh hello) is dropped too.
+        wire.send_msg(sock, {"op": "hello", "addr": "g:1", "gen": 0,
+                             "weights_version": "v1"}, token)
+        time.sleep(0.3)
+        assert not reg.alive()
+        # A current-generation hello is untouched by the fence.
+        wire.send_msg(sock, {"op": "hello", "addr": "g:2", "gen": 1,
+                             "weights_version": "v2"}, token)
+        assert _wait(lambda: [r.addr for r in reg.alive()] == ["g:2"])
+        # Beats with NO gen (pre-rollout stubs) are never fenced.
+        wire.send_msg(sock, {"op": "hello", "addr": "g:3"}, token)
+        assert _wait(lambda: len(reg.alive()) == 2)
+        sock.close()
+    finally:
+        reg.stop()
+
+
+# -- router version preference ----------------------------------------------
+
+
+def test_router_version_preference_with_fallback():
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=30.0, dead_after=60.0,
+                          sweep_interval=0.05).start()
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    try:
+        sock = wire.connect(reg.addr)
+        wire.send_msg(sock, {"op": "hello", "addr": "v:1",
+                             "weights_version": "v1"}, token)
+        wire.send_msg(sock, {"op": "hello", "addr": "v:2",
+                             "weights_version": "v2"}, token)
+        assert _wait(lambda: len(reg.alive()) == 2)
+        # Version-blind by default: both are candidates.
+        picks = {router.pick(exclude=(a,)) for a in ("v:1", "v:2")}
+        assert picks == {"v:1", "v:2"}
+        # The shift: prefer v2 — v1 is never selected while v2 lives.
+        router.set_preferred_version("v2")
+        for _ in range(8):
+            assert router.pick() == "v:2"
+        # v2 gone: the old version is the FALLBACK, not an outage.
+        reg.mark_dead("v:2")
+        assert _wait(lambda: router.pick() == "v:1")
+        assert metrics.get("version_fallbacks") >= 1
+        router.set_preferred_version(None)
+        sock.close()
+    finally:
+        router.close()
+        reg.stop()
+
+
+# -- gateway rollout op -----------------------------------------------------
+
+
+def test_gateway_rollout_op_drives_the_control_plane():
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=30.0,
+                          dead_after=60.0).start()
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=4), metrics,
+                 token=token, workers=1).start()
+    try:
+        client = FleetClient(gw.addr, token, timeout=10.0)
+        # No control plane attached: explicit bad_request, never a hang.
+        with pytest.raises(RequestFailed) as e:
+            client.rollout("v2", timeout=5.0)
+        assert e.value.kind == "bad_request"
+        calls = []
+        gw.rollout_fn = lambda v: (calls.append(v), {"reaped": 3})[1]
+        out = client.rollout("v2", timeout=5.0)
+        assert out["ok"] and out["weights_version"] == "v2"
+        assert out["reaped"] == 3 and calls == ["v2"]
+        # A missing version is rejected before the control plane runs.
+        with pytest.raises(RequestFailed) as e:
+            client.rollout("", timeout=5.0)
+        assert e.value.kind == "bad_request"
+        # An aborting rollout surfaces as rollout_failed with the cause.
+        def boom(v):
+            raise RuntimeError("new tier never left warming")
+        gw.rollout_fn = boom
+        with pytest.raises(RequestFailed) as e:
+            client.rollout("v3", timeout=5.0)
+        assert e.value.kind == "rollout_failed"
+        assert "warming" in str(e.value)
+        client.close()
+    finally:
+        gw.stop()
+        reg.stop()
+
+
+# -- dynamic scheduler ------------------------------------------------------
+
+
+def test_scheduler_dynamic_add_remove_and_nonfatal_death():
+    """Dynamic mode: an empty scheduler starts immediately; add_task
+    launches a Mode-B task post-start (served by the per-connection
+    rendezvous); remove_task kills it; an uncommanded death is a
+    SERVING event (counted, removed from the table) — never fatal."""
+    from tfmesos_tpu.backends.local import LocalBackend
+    from tfmesos_tpu.scheduler import TPUMesosScheduler
+
+    s = TPUMesosScheduler([], dynamic=True, backend=LocalBackend())
+    s.start()
+    try:
+        assert s.started and s.tasks == []
+        t = s.add_task("replica", cmd="sleep 600")
+        assert t.dynamic and t.generation == 0
+        assert _wait(lambda: t.initialized, timeout=30.0)
+        assert s.tasks_of("replica") == [t]
+        assert s.task_by_index("replica", 0) is t
+        # Commanded removal: table empty, status ignored, not a failure.
+        assert s.remove_task(t.id)
+        assert _wait(lambda: not s.tasks_of("replica"), timeout=10.0)
+        assert s.dynamic_failures.get("replica", 0) == 0
+        # Uncommanded death: counted, removed, and NOT cluster-fatal.
+        t2 = s.add_task("replica", cmd="exit 3")
+        assert t2.task_index == 1           # indices never reuse
+        assert _wait(lambda: s.dynamic_failures.get("replica", 0) == 1,
+                     timeout=30.0)
+        assert not s.tasks_of("replica")
+        assert not s.finished()             # no fatal raised
+        # Generation bump stamps FUTURE launches only.
+        assert s.bump_generation() == 1
+        t3 = s.add_task("replica", cmd="sleep 600")
+        assert t3.generation == 1
+        assert _wait(lambda: t3.initialized, timeout=30.0)
+        s.remove_task(t3.id)
+    finally:
+        s.stop()
+
+
+def test_scheduler_dynamic_rejects_elastic_and_static_misuse():
+    from tfmesos_tpu.scheduler import ClusterError, TPUMesosScheduler
+    from tfmesos_tpu.spec import Job
+
+    with pytest.raises(ValueError):
+        TPUMesosScheduler([], dynamic=True, restart_policy="elastic")
+    with pytest.raises(ValueError):
+        TPUMesosScheduler([])               # empty needs dynamic
+    s = TPUMesosScheduler([Job(name="w", num=1, cmd="true")])
+    with pytest.raises(ClusterError):
+        s.add_task("w", cmd="true")         # static schedulers refuse
+    with pytest.raises(ClusterError):
+        s.remove_task("nope")
+
+
+# -- FleetServer validation (satellite) -------------------------------------
+
+
+def test_fleet_server_validation_names_the_offending_values():
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    with pytest.raises(ValueError, match="replicas=-1"):
+        FleetServer(replicas=-1)
+    with pytest.raises(ValueError, match="prefill_replicas=1"):
+        FleetServer(replicas=1, prefill_replicas=1)
+    with pytest.raises(ValueError, match="decode_replicas=2"):
+        FleetServer(replicas=0, decode_replicas=2)
+    with pytest.raises(ValueError, match="replicas=0"):
+        FleetServer(replicas=0)
+    with pytest.raises(ValueError, match=r"max_replicas \(2\).*"
+                                         r"min_replicas \(3\)"):
+        FleetServer(replicas=3, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match=r"count 5.*\[1, 3\]"):
+        FleetServer(replicas=5, min_replicas=1, max_replicas=3)
+    with pytest.raises(ValueError, match="min_replicas must be >= 1"):
+        FleetServer(replicas=1, min_replicas=0, max_replicas=3)
+    # Valid autoscale bounds default sanely — and PER TIER: each tier's
+    # default ceiling is twice ITS OWN initial count, not the biggest
+    # tier's.
+    fs = FleetServer(replicas=2, autoscale=True)
+    assert (fs.min_replicas, fs.max_replicas) == (1, 4)
+    assert fs.bounds("unified") == (1, 4)
+    fs2 = FleetServer(replicas=2)
+    assert (fs2.min_replicas, fs2.max_replicas) == (1, 2)
+    fs3 = FleetServer(replicas=0, prefill_replicas=4, decode_replicas=1,
+                      autoscale=True)
+    assert fs3.bounds("prefill") == (1, 8)
+    assert fs3.bounds("decode") == (1, 2)
+    # weights_version joins a shell=True command line: the charset is a
+    # security boundary, enforced at the constructor AND at rollout.
+    with pytest.raises(ValueError, match="security boundary"):
+        FleetServer(replicas=1, weights_version="v2 $(touch /tmp/pwn)")
+    with pytest.raises(ValueError, match="security boundary"):
+        FleetServer(replicas=1, weights_version="")
+
+
+# -- end to end on LocalBackend (acceptance) --------------------------------
+
+
+def _tiny_offline():
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.fleet.replica import tiny_model
+    from tfmesos_tpu.models import transformer
+
+    cfg, params = tiny_model(seed=0)
+
+    def offline(prompt, max_new_tokens, stop_token=None):
+        out = transformer.generate(
+            cfg, params, jnp.asarray(np.asarray(prompt, np.int32)[None]),
+            max_new_tokens, temperature=0.0, stop_token=stop_token)
+        row = np.asarray(out)[0, len(prompt):].tolist()
+        if stop_token is not None and stop_token in row:
+            row = row[:row.index(stop_token) + 1]
+        return row
+
+    return cfg, offline
+
+
+def _prompts(cfg, n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size,
+                        size=rng.randint(3, 16)).astype(np.int32)
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def afleet():
+    """ONE warmup fleet shared by the two acceptance e2e tests below
+    (bring-up compiles are the dominant cost, so both phases — the
+    autoscale cycle and the rollout that follows it — ride one fleet;
+    the tests are order-dependent by design, like the test_fleet
+    module's)."""
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    fs = FleetServer(replicas=1, rows=2, tiny=True, max_len=64,
+                     page_size=16, prefill_bucket=16, warmup=True,
+                     weights_version="v1",
+                     min_replicas=1, max_replicas=2,
+                     request_timeout=300.0, start_timeout=300.0)
+    fs.start()
+    yield fs
+    fs.stop()
+
+
+def test_fleet_autoscale_end_to_end(afleet):
+    """Acceptance: an injected queue-wait surge makes the autoscaler
+    launch a replica that registers WARMING (never routed before
+    alive) and absorbs load; on signal decay it drains the
+    least-loaded replica with ZERO failed or shed in-flight
+    requests."""
+    fs = afleet
+    cfg, offline = _tiny_offline()
+    client = fs.client(timeout=300.0)
+    prompts = _prompts(cfg, 10, seed=5)
+    assert client.generate(prompts[0], 4)["tokens"] == \
+        offline(prompts[0], 4)
+    base_addr = fs.registry.alive()[0].addr
+    sig = {"unified": dict(SURGE)}
+    auto = FleetAutoscaler(
+        fs, AutoscalerConfig(scale_up_cooldown=0.0,
+                             scale_down_cooldown=0.0,
+                             drain_grace=0.3, drain_timeout=120.0),
+        signals=lambda: {k: dict(v) for k, v in sig.items()})
+    auto.step()
+    assert fs.targets["unified"] == 2
+    assert fs.tier_actual("unified") == 2
+    # The newcomer registers WARMING: present, never routable.
+    assert _wait(lambda: fs.registry.warming(), timeout=120.0)
+    new_addr = fs.registry.warming()[0].addr
+    assert new_addr != base_addr
+    assert fs.router.pick(exclude=(base_addr,)) is None
+    # Requests keep serving correctly through the warmup window.
+    assert client.generate(prompts[1], 4)["tokens"] == \
+        offline(prompts[1], 4)
+    # It flips alive and absorbs load (both replicas carry work).
+    assert _wait(lambda: any(r.addr == new_addr
+                             for r in fs.registry.alive()),
+                 timeout=240.0)
+    results = [None] * 10
+    errors = []
+
+    def one(i):
+        try:
+            results[i] = client.generate(prompts[i], 16)
+        except Exception as e:
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(10)]
+    for t in threads:
+        t.start()
+    both_busy = [False]
+
+    def watch():
+        while any(t.is_alive() for t in threads):
+            addrs = [r.addr for r in fs.registry.alive()]
+            if len(addrs) == 2 and all(
+                    fs.router.outstanding(a) > 0 for a in addrs):
+                both_busy[0] = True
+            time.sleep(0.01)
+
+    watcher = threading.Thread(target=watch)
+    watcher.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    watcher.join(timeout=10.0)
+    assert not errors, errors
+    for i in range(10):
+        assert results[i]["tokens"] == offline(prompts[i], 16), \
+            f"request {i} diverged on the scaled fleet"
+    assert both_busy[0], "the autoscaled replica never took load"
+    # Signal decay: drain the least-loaded replica, kill after
+    # flush — zero failed, zero shed, nothing in flight dropped.
+    sig["unified"] = dict(CALM)
+    deadline = time.monotonic() + 120.0
+    while fs.tier_actual("unified") > 1 \
+            and time.monotonic() < deadline:
+        auto.step()
+        time.sleep(0.05)
+    assert fs.tier_actual("unified") == 1
+    assert _wait(lambda: len(fs.registry.alive()) == 1, timeout=30.0)
+    # The fleet still serves correctly after the shrink.
+    assert client.generate(prompts[2], 4)["tokens"] == \
+        offline(prompts[2], 4)
+    snap = fs.snapshot()
+    c = snap["counters"]
+    assert c.get("failed", 0) == 0
+    assert c.get("shed_queue", 0) == 0
+    assert c.get("autoscale_launches", 0) >= 1
+    assert c.get("autoscale_kills", 0) == 1
+    gauge = snap["gauges"]["autoscaler"]["unified"]
+    assert gauge["target"] == 1 and gauge["actual"] == 1
+    roles = snap["gauges"]["roles"]["unified"]
+    assert roles["target"] == 1
+    client.close()
+
+
+def test_fleet_rollout_end_to_end(afleet):
+    """Acceptance: rollout() to a new weights_version under continuous
+    traffic — every request served (no Overloaded, no RoutingError),
+    the router never selects an old-version replica after the shift,
+    and an old-generation straggler's re-registration is dropped by
+    the fence instead of serving stale weights.  Runs on the fleet the
+    autoscale test returned to one v1 replica."""
+    fs = afleet
+    cfg, offline = _tiny_offline()
+    client = fs.client(timeout=300.0)
+    prompts = _prompts(cfg, 8, seed=7)
+    wants = [offline(p, 4) for p in prompts]
+    client.generate(prompts[0], 4)          # compile warm
+    old_addrs = {r.addr for r in fs.registry.alive()}
+    assert all(r.weights_version == "v1" for r in fs.registry.alive())
+    stop = threading.Event()
+    errors = []
+    served = [0]
+
+    def feeder():
+        i = 0
+        while not stop.is_set():
+            try:
+                out = client.generate(prompts[i % 8], 4, timeout=300.0)
+                assert out["tokens"] == wants[i % 8], \
+                    f"request {i} diverged mid-rollout"
+            except Exception as e:
+                errors.append(e)
+                return
+            served[0] += 1
+            i += 1
+
+    th = threading.Thread(target=feeder)
+    th.start()
+    time.sleep(0.3)                 # traffic in flight first
+    # Drive the rollout through the GATEWAY control op (the
+    # tfserve-rollout path), not a direct method call.
+    out = client.rollout("v2", timeout=600.0)
+    assert out["ok"] and out["new_version"] == "v2"
+    assert out["old_version"] == "v1" and out["reaped"] == 1
+    stop.set()
+    th.join(timeout=300.0)
+    assert not errors, f"rollout dropped a request: {errors[0]!r}"
+    assert served[0] > 0
+    # After the shift: only new-version replicas are routable, and
+    # the router cannot select an old-version one.
+    alive = fs.registry.alive()
+    assert alive and all(r.weights_version == "v2" for r in alive)
+    assert not (old_addrs & {r.addr for r in alive})
+    for _ in range(8):
+        pick = fs.router.pick()
+        assert pick not in old_addrs
+    # Completions from the new tier stay exact (same seed weights).
+    assert client.generate(prompts[0], 4)["tokens"] == wants[0]
+    c = fs.snapshot()["counters"]
+    assert c.get("failed", 0) == 0 and c.get("shed_queue", 0) == 0
+    assert c.get("rollouts", 0) == 1
+    # The straggler: a reaped-generation replica re-registering
+    # (gen 0 < fence) is DROPPED — stale weights can never serve.
+    zombie = wire.connect(fs.registry.addr)
+    wire.send_msg(zombie, {"op": "hello", "addr": "zombie:1",
+                           "gen": 0, "weights_version": "v1",
+                           "role": "unified"}, fs.token)
+    time.sleep(0.5)
+    assert "zombie:1" not in {r.addr for r in fs.registry.members()}
+    # ... while a current-generation hello still lands (the fence,
+    # not a closed door, is what blocked the zombie).
+    wire.send_msg(zombie, {"op": "hello", "addr": "fresh:1",
+                           "gen": out["generation"],
+                           "weights_version": "v2"}, fs.token)
+    assert _wait(lambda: "fresh:1" in
+                 {r.addr for r in fs.registry.members()})
+    zombie.close()
+    client.close()
+
+
+@pytest.mark.slow
+def test_fleet_rollout_aborts_when_new_tier_never_leaves_warming():
+    """Failure mode: the new tier cannot become routable → the rollout
+    ABORTS (new tasks reaped, RolloutError), the old version keeps
+    serving, and the router preference never shifted."""
+    from tfmesos_tpu.fleet.launcher import FleetServer, RolloutError
+
+    cfg, offline = _tiny_offline()
+    fs = FleetServer(replicas=1, rows=2, tiny=True, max_len=64,
+                     page_size=16, prefill_bucket=16,
+                     weights_version="v1",
+                     request_timeout=300.0, start_timeout=300.0)
+    fs.start()
+    try:
+        client = fs.client(timeout=300.0)
+        prompt = _prompts(cfg, 1, seed=9)[0]
+        want = offline(prompt, 4)
+        assert client.generate(prompt, 4)["tokens"] == want
+        # Sabotage the new tier: an unlaunchable replica cmd.
+        real_cmd = fs._replica_cmd
+
+        def broken_cmd(role="unified", weights_version=None):
+            if weights_version == "v2":
+                return "exit 7"
+            return real_cmd(role, weights_version)
+
+        fs._replica_cmd = broken_cmd
+        with pytest.raises(RolloutError, match="aborted"):
+            fs.rollout("v2", warm_timeout=5.0, bake_s=0.0)
+        fs._replica_cmd = real_cmd
+        # No downtime: the old tier never stopped serving, the version
+        # never shifted, and the failed tasks were reaped.
+        assert fs.weights_version == "v1"
+        assert fs.router._preferred_version is None
+        assert _wait(lambda: fs.tier_actual("unified") == 1,
+                     timeout=30.0)
+        assert client.generate(prompt, 4)["tokens"] == want
+        assert fs.snapshot()["counters"].get("rollouts_aborted") == 1
+        client.close()
+    finally:
+        fs.stop()

@@ -182,11 +182,12 @@ def test_fleet_bench_smoke():
     runs end to end and returns finite numbers.  Marked slow: it pays a
     full fleet bring-up that tests/test_fleet.py already exercises in
     tier-1; this guards the driver's unattended bench.py run."""
-    rps, ttft_ms, queue_wait_p50 = bench.bench_fleet_serving(
+    rps, ttft_ms, queue_wait_p50, queue_wait_p99 = bench.bench_fleet_serving(
         n_requests=4, replicas=2, rows=2, tiny=True, workers=4)
     assert np.isfinite(rps) and rps > 0
     assert np.isfinite(ttft_ms) and ttft_ms > 0
     assert np.isfinite(queue_wait_p50) and queue_wait_p50 >= 0
+    assert np.isfinite(queue_wait_p99) and queue_wait_p99 >= queue_wait_p50
 
 
 @pytest.mark.slow
@@ -211,6 +212,17 @@ def test_serving_prefix_cache_bench_smoke():
         n_requests=3, rows=2, tiny=True)
     assert warm_ttft > 0 and cold_ttft > 0 and rps > 0
     assert 0.0 < hit_rate <= 1.0
+
+
+@pytest.mark.slow
+def test_fleet_autoscale_bench_smoke():
+    """The autoscale/rollout control-plane bench: injected surge →
+    autoscaled replica routable, then a zero-downtime rollout under
+    continuous traffic (zero failures asserted in-bench)."""
+    reaction_s, downtime_ms = bench.bench_fleet_autoscale(rows=2,
+                                                          workers=4)
+    assert np.isfinite(reaction_s) and reaction_s > 0
+    assert downtime_ms == 0.0
 
 
 @pytest.mark.slow

@@ -204,6 +204,40 @@ def test_serve_main_rejects_bad_counts(capfd):
     assert "--role" in capfd.readouterr().err
 
 
+def test_serve_parser_autoscale_and_rollout_flags():
+    """tfserve's autoscaler/rollout surface (fleet autoscaler PR):
+    --autoscale with per-tier bounds, the boot weights version, and the
+    'tfserve rollout' subcommand parser."""
+    from tfmesos_tpu.cli import build_rollout_parser, build_serve_parser
+
+    args = build_serve_parser().parse_args([
+        "--autoscale", "--min-replicas", "2", "--max-replicas", "6",
+        "--weights-version", "2025w31"])
+    assert args.autoscale
+    assert args.min_replicas == 2 and args.max_replicas == 6
+    assert args.weights_version == "2025w31"
+    defaults = build_serve_parser().parse_args([])
+    assert not defaults.autoscale
+    assert defaults.min_replicas is None and defaults.max_replicas is None
+    assert defaults.weights_version == "v0"
+    ro = build_rollout_parser().parse_args(
+        ["-g", "gw:8780", "--version", "v2", "--timeout", "60"])
+    assert ro.gateway == "gw:8780"
+    assert ro.weights_version == "v2" and ro.timeout == 60.0
+
+
+def test_serve_main_rollout_requires_token(capfd, monkeypatch):
+    """'tfserve rollout' without a cluster token fails loudly with the
+    env-contract hint instead of dialing unauthenticated."""
+    from tfmesos_tpu import wire
+    from tfmesos_tpu.cli import serve_main
+
+    monkeypatch.delenv(wire.TOKEN_ENV, raising=False)
+    monkeypatch.delenv(wire.TOKEN_FILE_ENV, raising=False)
+    assert serve_main(["rollout", "-g", "h:1", "--version", "v2"]) == 2
+    assert wire.TOKEN_ENV in capfd.readouterr().err
+
+
 def test_replica_parser_round_trip():
     """The replica process's own flags (what FleetServer's Mode-B cmd
     drives) must round-trip too."""

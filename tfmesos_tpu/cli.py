@@ -254,6 +254,29 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "then flip alive — and any elastic/Mode-B "
                         "relaunch re-warms the same way, so a cold "
                         "replica's first request never pays a compile")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the fleet autoscaler: a control loop that "
+                        "grows/shrinks each tier from live load "
+                        "signals (queue-wait p99 for prompt tiers, KV "
+                        "headroom for decode) within --min/--max-"
+                        "replicas, launching with --warmup semantics "
+                        "and shrinking by drain-then-kill "
+                        "(docs/SERVING.md 'Autoscaling')")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   dest="min_replicas",
+                   help="autoscale floor per tier (default 1; a "
+                        "routable tier never scales to zero)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   dest="max_replicas",
+                   help="autoscale ceiling per tier (default: twice "
+                        "the initial count)")
+    p.add_argument("--weights-version", type=str, default="v0",
+                   dest="weights_version",
+                   help="weights version label the boot replicas "
+                        "advertise; 'tfserve rollout --version NEW' "
+                        "later replaces the fleet blue-green with zero "
+                        "downtime (docs/SERVING.md 'Blue-green "
+                        "rollout')")
     p.add_argument("--tiny", action="store_true",
                    help="serve the tiny CI model (dev/demo)")
     p.add_argument("--metrics-interval", type=float, default=10.0,
@@ -294,7 +317,76 @@ def parse_role_spec(spec: Optional[str]) -> dict:
     return out
 
 
+def build_rollout_parser() -> argparse.ArgumentParser:
+    """``tfserve rollout`` — drive a blue-green weight rollout on a
+    RUNNING fleet through the gateway's authenticated control op."""
+    p = argparse.ArgumentParser(
+        prog="tfserve rollout",
+        description="Shift a running fleet to a new weights version "
+                    "with zero downtime: launch a new-version replica "
+                    "set, warm it, shift routing, drain and reap the "
+                    "old tier (docs/SERVING.md 'Blue-green rollout').")
+    p.add_argument("-g", "--gateway", type=str, required=True,
+                   metavar="HOST:PORT", help="the running gateway")
+    p.add_argument("--version", type=str, required=True,
+                   dest="weights_version",
+                   help="the new weights version label")
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="seconds to wait for completion (a rollout "
+                        "spans a full tier warmup plus the old tier's "
+                        "drain)")
+    return p
+
+
+def rollout_main(argv: List[str]) -> int:
+    args = build_rollout_parser().parse_args(argv)
+    from tfmesos_tpu.fleet.client import (CallTimeout, FleetClient,
+                                          RequestFailed)
+
+    token = wire.load_token()
+    if not token:
+        print(f"tfserve rollout: no cluster token — set "
+              f"{wire.TOKEN_ENV} or {wire.TOKEN_FILE_ENV} (tfserve "
+              f"printed the token file at startup)", file=sys.stderr)
+        return 2
+    client = None
+    try:
+        # Inside the try: FleetClient dials the gateway in its
+        # constructor, so an unreachable host must land in the OSError
+        # branch below, not escape as a traceback.
+        client = FleetClient(args.gateway, token, timeout=args.timeout)
+        out = client.rollout(args.weights_version, timeout=args.timeout)
+    except RequestFailed as e:
+        print(f"tfserve rollout: {e.kind}: {e}", file=sys.stderr)
+        return 1
+    except CallTimeout as e:
+        # Before the generic OSError branch (CallTimeout IS an OSError
+        # subclass): no reply within --timeout means the rollout may
+        # STILL BE RUNNING server-side, not that the gateway is down.
+        print(f"tfserve rollout: no reply within {args.timeout:.0f}s — "
+              f"the rollout may still be in progress; watch the "
+              f"gateway's roles gauge (versions) and raise --timeout "
+              f"({e})", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"tfserve rollout: cannot reach gateway "
+              f"{args.gateway}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        if client is not None:
+            client.close()
+    print(f"tfserve rollout: fleet now serves weights_version "
+          f"{out.get('new_version')} (was {out.get('old_version')}; "
+          f"{out.get('replicas')} replica(s) launched, "
+          f"{out.get('reaped')} reaped, generation fence "
+          f"{out.get('generation')})", flush=True)
+    return 0
+
+
 def serve_main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "rollout":
+        return rollout_main(argv[1:])
     args = build_serve_parser().parse_args(argv)
     try:
         roles = parse_role_spec(args.role)
@@ -323,6 +415,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         replicas=args.replicas, rows=args.rows, tiny=args.tiny,
         prefill_replicas=roles.get("prefill", 0),
         decode_replicas=roles.get("decode", 0),
+        weights_version=args.weights_version,
+        autoscale=args.autoscale,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
         max_len=args.max_len, master=args.master,
         replica_cpus=args.replica_cpus, replica_mem=args.replica_mem,
         replica_chips=args.replica_chips,
@@ -351,6 +447,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     if roles:
         tiers += (f" + {roles['prefill']} prefill / {roles['decode']} "
                   f"decode (disaggregated)")
+    if args.autoscale:
+        tiers += (f", autoscaling within [{fleet.min_replicas}, "
+                  f"{fleet.max_replicas}]")
     print(f"tfserve: gateway on {fleet.addr} fronting {tiers}; "
           f"ctrl-c to stop", flush=True)
     try:

@@ -25,8 +25,14 @@ TF-Replicator (PAPERS.md) over the existing execution engine:
   incremental submission API; launched as a Mode-B task through the
   backend abstraction (so ``LocalBackend`` runs whole fleets on CPU).
 * :mod:`~tfmesos_tpu.fleet.launcher` — ``FleetServer``: one object that
-  brings the whole thing up (registry + gateway + N scheduled replicas)
-  and tears it down.
+  brings the whole thing up (registry + gateway + dynamically-launched
+  replicas) and tears it down, plus the blue-green
+  ``FleetServer.rollout`` control op.
+* :mod:`~tfmesos_tpu.fleet.autoscaler` — the control-plane feedback
+  loop that grows and shrinks each tier from live load signals
+  (queue-wait p99 for prompt tiers, KV headroom for decode) within
+  min/max bounds, with hysteresis, per-tier cooldowns, drain-then-kill
+  scale-down, and a never-below-one-alive invariant.
 
 Disaggregated prefill/decode serving (docs/SERVING.md) rides the same
 pieces: replicas advertise ``role: prefill|decode|unified`` (plus
@@ -44,10 +50,11 @@ from __future__ import annotations
 
 from tfmesos_tpu.fleet.admission import (AdmissionController, Overloaded,
                                          RateLimited, TokenBucket)
+from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig, FleetAutoscaler
 from tfmesos_tpu.fleet.client import (ConnectionLost, FleetClient,
                                       MuxConnection, RequestFailed)
 from tfmesos_tpu.fleet.gateway import Gateway
-from tfmesos_tpu.fleet.launcher import FleetServer
+from tfmesos_tpu.fleet.launcher import FleetServer, RolloutError
 from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
                                         ReplicaInfo, ReplicaRegistry)
@@ -55,6 +62,7 @@ from tfmesos_tpu.fleet.router import Router, RoutingError
 
 __all__ = [
     "AdmissionController", "Overloaded", "RateLimited", "TokenBucket",
+    "AutoscalerConfig", "FleetAutoscaler", "RolloutError",
     "ConnectionLost", "FleetClient", "MuxConnection", "RequestFailed",
     "Gateway", "FleetServer", "FleetMetrics", "ReplicaInfo",
     "ReplicaRegistry", "Router", "RoutingError",

@@ -1,0 +1,351 @@
+"""Fleet autoscaler: a feedback loop that sizes each serving tier.
+
+The fleet is no longer a launch-time constant: this control loop reads
+per-tier load signals every tick and converges each tier's replica
+count toward a target inside ``[min_replicas, max_replicas]`` bounds —
+the replica-membership-as-runtime-property stance of TF-Replicator
+(PAPERS.md), applied to serving.
+
+Signals (all already flowing before this module existed):
+
+* **Prompt-bearing tiers** (unified, prefill) scale on the WINDOWED p99
+  of the gateway's ``queue_wait_ms`` histogram — the interval between
+  two control ticks, not the lifetime percentile, so the loop reacts to
+  load that exists now rather than chasing a surge that ended minutes
+  ago — plus tier utilization (self-reported outstanding / advertised
+  capacity from registry heartbeats).
+* **The decode tier** scales on aggregate KV-page headroom per alive
+  replica (the heartbeat field decode routing already places by):
+  decode replicas run out of *pages*, not CPU, long before their row
+  counts saturate.
+
+Actuation goes through the fleet's dynamic launcher:
+
+* **Scale up** launches ONE new Mode-B replica task per tick (the same
+  command line the tier booted with, ``--warmup`` included, so the
+  newcomer registers ``warming`` and never takes traffic cold).
+* **Scale down** picks the least-loaded alive replica and announces a
+  PINNED drain at the registry (``begin_drain`` — drain-for-scale-down:
+  the healthy victim keeps heartbeating plain alive beats while its
+  in-flight work flushes, and those beats must not revive it), then
+  kills the task only once its outstanding count reaches zero (or the
+  drain deadline passes).  In-flight requests are never shed.
+* **Convergence doubles as self-healing**: a replica task that dies is
+  dropped from the scheduler's table, actual falls below target, and
+  the next tick relaunches it — one per tick, so a crash loop churns at
+  the control cadence, not as fast as fork can go.
+
+Stability guards: hysteresis (the up and down thresholds form a dead
+band), separate per-tier cooldowns for each direction, at most one
+pending drain per tier, and a hard invariant that a routable tier is
+never drained below one alive replica no matter what the signals say.
+Every decision lands in the log and in the ``autoscaler`` gauge
+(target / actual / last_action per tier).
+
+Determinism for tests: the clock and the signal source are both
+injectable (the ``chaos.py`` discipline) — a fake-signal test drives
+``step()`` by hand and asserts the exact launch/drain/kill sequence,
+no timing races involved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from tfmesos_tpu.fleet.metrics import Histogram
+from tfmesos_tpu.fleet.registry import ALIVE, DEAD, DECODE
+from tfmesos_tpu.utils.logging import get_logger
+
+__all__ = ["AutoscalerConfig", "FleetAutoscaler"]
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    """Knobs of the control loop (docs/SERVING.md "Autoscaling")."""
+
+    #: seconds between control ticks (the loop's cadence).
+    interval: float = 1.0
+    #: prompt tiers scale UP when the windowed queue-wait p99 crosses
+    #: this; the matching ``lo`` bound arms scale-down — the gap between
+    #: them is the hysteresis dead band that keeps the loop from
+    #: flapping on a noisy signal.
+    queue_wait_hi_ms: float = 500.0
+    queue_wait_lo_ms: float = 50.0
+    #: tier utilization (self-reported outstanding / advertised
+    #: capacity) bounds, same dead-band structure.
+    util_hi: float = 0.75
+    util_lo: float = 0.25
+    #: decode tier: scale UP when average free KV pages per alive
+    #: replica dip below ``lo``; ``hi`` (with low utilization) arms
+    #: scale-down.
+    kv_headroom_lo: float = 8.0
+    kv_headroom_hi: float = 64.0
+    #: per-tier cooldowns, one per direction: growing again right after
+    #: growing is cheap to allow, shrinking is deliberately slower.
+    scale_up_cooldown: float = 5.0
+    scale_down_cooldown: float = 30.0
+    #: a draining victim gets this long to flush its in-flight work
+    #: before the kill goes through anyway.
+    drain_timeout: float = 120.0
+    #: minimum drain age before the kill: the victim's outstanding
+    #: count is heartbeat-lagged, so a just-announced drain must not
+    #: read a stale zero and kill mid-request.
+    drain_grace: float = 1.0
+
+
+class FleetAutoscaler:
+    """The per-tier feedback loop over a :class:`FleetServer`.
+
+    ``fleet`` must expose the dynamic-fleet surface (``registry``,
+    ``metrics``, ``targets``, ``set_target``, ``bounds``,
+    ``launch_replica``, ``kill_replica``, ``tier_actual``,
+    ``scale_lock``) — tests drive the loop against a stub fleet of
+    jax-free replicas through exactly the same surface.
+    """
+
+    def __init__(self, fleet, config: Optional[AutoscalerConfig] = None,
+                 signals: Optional[Callable[[], Dict[str, Dict[str, Any]]]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.fleet = fleet
+        self.config = config or AutoscalerConfig()
+        self._signals = signals or self._default_signals
+        self._clock = clock
+        self.log = get_logger("tfmesos_tpu.fleet.autoscaler")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Windowed-percentile state: the previous tick's cumulative
+        # queue-wait histogram sample.
+        self._prev_queue_wait: Optional[tuple] = None
+        self._last_up: Dict[str, float] = {}
+        self._last_down: Dict[str, float] = {}
+        # addr -> {role, node, since, deadline}: drains in flight.
+        self._draining: Dict[str, Dict[str, Any]] = {}
+        self._last_action: Dict[str, str] = {}
+        if getattr(fleet, "metrics", None) is not None:
+            fleet.metrics.register_gauge("autoscaler", self.describe)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetAutoscaler":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-autoscaler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval):
+            try:
+                self.step()
+            except Exception:
+                # One broken tick must not kill the control loop; the
+                # fleet keeps serving at its current size either way.
+                self.log.exception("autoscaler tick failed")
+
+    # -- signals -----------------------------------------------------------
+
+    def _default_signals(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tier signal dict from the live registry + metrics: the
+        windowed queue-wait p99 (global — one ingress queue feeds every
+        tier), per-tier utilization, and per-tier average KV headroom
+        per alive replica."""
+        cur = self.fleet.metrics.hist_cumulative("queue_wait_ms")
+        qw_p99 = None
+        if cur is not None:
+            qw_p99 = Histogram.delta_percentile(self._prev_queue_wait,
+                                                cur, 0.99)
+            self._prev_queue_wait = cur
+        out: Dict[str, Dict[str, Any]] = {}
+        summary = self.fleet.registry.role_summary()
+        for role in self.fleet.targets:
+            d = summary.get(role, {})
+            alive = d.get("alive", 0)
+            capacity = sum(r.capacity for r in self.fleet.registry.members(role)
+                           if r.state == ALIVE)
+            outstanding = d.get("outstanding", 0)
+            util = (outstanding / capacity) if capacity > 0 else 0.0
+            headroom = (d.get("kv_headroom", 0) / alive) if alive else None
+            out[role] = {"queue_wait_p99_ms": qw_p99, "util": util,
+                         "kv_headroom": headroom, "alive": alive,
+                         "warming": d.get("warming", 0)}
+        return out
+
+    # -- the control tick --------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> None:
+        """One control tick: retarget each tier from its signals, then
+        converge actuals (launch, drain, reap).  Public so tests (and
+        the bench) can drive the loop deterministically."""
+        now = self._clock() if now is None else now
+        with self.fleet.scale_lock:
+            signals = self._signals()
+            for role in list(self.fleet.targets):
+                self._retarget(role, signals.get(role) or {}, now)
+                self._converge(role, now)
+            self._reap_drained(now)
+
+    def _retarget(self, role: str, sig: Dict[str, Any], now: float) -> None:
+        cfg = self.config
+        target = self.fleet.targets[role]
+        lo, hi = self.fleet.bounds(role)
+        if role == DECODE:
+            # Decode replicas exhaust KV pages, not rows: headroom is
+            # the binding resource.
+            headroom = sig.get("kv_headroom")
+            util = sig.get("util") or 0.0
+            up = headroom is not None and headroom < cfg.kv_headroom_lo
+            down = (headroom is not None
+                    and headroom > cfg.kv_headroom_hi
+                    and util <= cfg.util_lo)
+        else:
+            qw = sig.get("queue_wait_p99_ms")
+            util = sig.get("util") or 0.0
+            up = ((qw is not None and qw > cfg.queue_wait_hi_ms)
+                  or util > cfg.util_hi)
+            down = ((qw is None or qw < cfg.queue_wait_lo_ms)
+                    and util < cfg.util_lo)
+        desired = target
+        if up and now - self._last_up.get(role, -1e18) >= cfg.scale_up_cooldown:
+            desired = target + 1
+        elif (down and not up
+              and now - self._last_down.get(role, -1e18)
+              >= cfg.scale_down_cooldown):
+            desired = target - 1
+        # Bounds, and the hard floor: a routable tier never targets 0.
+        desired = max(1, max(lo, min(hi, desired)))
+        if desired == target:
+            return
+        direction = "up" if desired > target else "down"
+        if direction == "up":
+            self._last_up[role] = now
+        else:
+            self._last_down[role] = now
+        self._last_action[role] = f"{direction}:{target}->{desired}"
+        self.fleet.set_target(role, desired)
+        self.fleet.metrics.inc(f"autoscale_{direction}")
+        self.log.info(
+            "autoscaler: %s tier target %d -> %d (queue_wait_p99=%s "
+            "util=%.2f kv_headroom=%s)", role, target, desired,
+            sig.get("queue_wait_p99_ms"), sig.get("util") or 0.0,
+            sig.get("kv_headroom"))
+
+    def _converge(self, role: str, now: float) -> None:
+        """Drive actual toward target: launch when short (one per tick —
+        self-healing of crashed replicas rides this same path), start a
+        pinned drain on the least-loaded alive replica when over."""
+        target = self.fleet.targets[role]
+        pending = [(a, d) for a, d in self._draining.items()
+                   if d["role"] == role]
+        # Only LIVE draining victims discount "actual": a victim that
+        # died mid-drain already left the scheduler's table (the
+        # dynamic-death handler removed it), so subtracting its drain
+        # record too would undercount the tier and launch a spurious
+        # replica — full churn (warmup, then another drain) for
+        # nothing.  The pending list itself still gates one-drain-at-
+        # a-time below until _reap_drained clears the record.
+        members = {r.addr: r for r in self.fleet.registry.members(role)}
+        live_draining = sum(
+            1 for a, _ in pending
+            if a in members and members[a].state != DEAD)
+        actual = self.fleet.tier_actual(role) - live_draining
+        if actual < target:
+            node = self.fleet.launch_replica(role)
+            self._last_action[role] = f"launch:{node}"
+            self.fleet.metrics.inc("autoscale_launches")
+            self.log.info("autoscaler: %s tier %d/%d — launched %s "
+                          "(registers warming, routed only once alive)",
+                          role, actual, target, node)
+            return
+        if actual <= target or pending:
+            return      # converged, or a drain is already in flight
+        alive = [r for r in self.fleet.registry.members(role)
+                 if r.state == ALIVE]
+        if len(alive) < 2:
+            # Invariant: never drain a routable tier below one alive
+            # replica — even when target says shrink, the LAST alive
+            # member waits until its warming replacement (or a peer)
+            # is routable.
+            return
+        victim = min(alive, key=lambda r: (r.outstanding, r.addr))
+        if not self.fleet.registry.begin_drain(victim.addr, pinned=True):
+            return
+        self._draining[victim.addr] = {
+            "role": role, "node": victim.node, "since": now,
+            "deadline": now + self.config.drain_timeout}
+        self._last_action[role] = f"drain:{victim.addr}"
+        self.fleet.metrics.inc("autoscale_drains")
+        self.log.info("autoscaler: %s tier %d/%d — draining least-loaded "
+                      "%s (outstanding %d; kill after flush)", role,
+                      actual, target, victim.addr, victim.outstanding)
+
+    def _reap_drained(self, now: float) -> None:
+        """Kill drained victims whose in-flight work has flushed — BOTH
+        load signals must read zero: the victim's self-reported
+        outstanding (heartbeat-lagged, hence the grace window) and the
+        router's own count of requests it still has in flight there (a
+        request dispatched right after the victim's last beat is
+        invisible to the heartbeat signal) — or whose drain deadline
+        passed."""
+        router = getattr(self.fleet, "router", None)
+        for addr, d in list(self._draining.items()):
+            rep = next((r for r in self.fleet.registry.members(d["role"])
+                        if r.addr == addr), None)
+            in_flight = router.outstanding(addr) if router is not None \
+                else 0
+            flushed = (rep is None or rep.state == DEAD
+                       or (rep.outstanding <= 0 and in_flight <= 0
+                           and now - d["since"] >= self.config.drain_grace))
+            if not flushed and now < d["deadline"]:
+                continue
+            del self._draining[addr]
+            killed = bool(d["node"]) and self.fleet.kill_replica(d["node"])
+            if killed or rep is None or rep.state == DEAD:
+                self.fleet.metrics.inc("autoscale_kills")
+                self._last_action[d["role"]] = f"kill:{addr}"
+                self.log.info("autoscaler: reaped drained replica %s "
+                              "(%s)", addr,
+                              "flushed" if flushed else "drain timeout")
+            else:
+                # The victim cannot be mapped back to a killable task
+                # (no node advertised, or the task vanished): release
+                # the pinned drain so its next routable beat revives it
+                # — a zombie stuck DRAINING forever would block
+                # convergence and get healthy peers drained in its
+                # place.
+                self.fleet.registry.clear_drain(addr)
+                self.fleet.metrics.inc("autoscale_kill_failures")
+                self._last_action[d["role"]] = f"kill_failed:{addr}"
+                self.log.warning(
+                    "autoscaler: cannot kill drained replica %s (node "
+                    "%r unknown to the scheduler); drain released",
+                    addr, d["node"])
+
+    # -- observability -----------------------------------------------------
+
+    def describe(self) -> Dict[str, Dict[str, Any]]:
+        """The ``autoscaler`` gauge: what the loop believes, per tier."""
+        out: Dict[str, Dict[str, Any]] = {}
+        summary = self.fleet.registry.role_summary()
+        for role, target in self.fleet.targets.items():
+            d = summary.get(role, {})
+            lo, hi = self.fleet.bounds(role)
+            out[role] = {
+                "target": target,
+                "actual": self.fleet.tier_actual(role),
+                "alive": d.get("alive", 0),
+                "warming": d.get("warming", 0),
+                "draining": len([x for x in self._draining.values()
+                                 if x["role"] == role]),
+                "min": lo, "max": hi,
+                "last_action": self._last_action.get(role, ""),
+            }
+        return out

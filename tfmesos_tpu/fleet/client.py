@@ -228,6 +228,24 @@ class FleetClient:
         reply = self._mux.call({"op": "metrics"}, timeout=timeout)
         return reply.get("snapshot", {})
 
+    def rollout(self, weights_version: str,
+                timeout: float = 900.0) -> Dict[str, Any]:
+        """Drive a blue-green weight rollout through the gateway's
+        control op and block until it completes (a rollout spans a full
+        tier's warmup plus the old tier's drain — size ``timeout``
+        accordingly).  Returns the gateway's summary dict; raises
+        :class:`RequestFailed` (kind ``rollout_failed``) on abort."""
+        reply = self._mux.call({"op": "rollout",
+                                "weights_version": str(weights_version)},
+                               timeout=timeout)
+        if isinstance(reply, dict) and reply.get("op") == "rollout":
+            return reply
+        kind = reply.get("kind", "error") if isinstance(reply, dict) \
+            else "error"
+        error = reply.get("error", repr(reply)) if isinstance(reply, dict) \
+            else repr(reply)
+        raise RequestFailed(error, kind=kind)
+
     @property
     def outstanding(self) -> int:
         return self._mux.outstanding

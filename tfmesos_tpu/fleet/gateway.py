@@ -15,6 +15,12 @@ Wire surface (all frames HMAC-authenticated with the cluster token):
   ``unavailable`` (no replica within the retry budget), ``bad_request``.
 * ``{"op": "metrics", "id"}`` → ``{"op": "metrics", "id", "snapshot"}``.
 * ``{"op": "ping", "id"}`` → ``{"op": "pong", "id"}``.
+* ``{"op": "rollout", "id", "weights_version"}`` → ``{"op": "rollout",
+  "id", "ok": true, ...}`` or ``{"op": "error", "id", "kind":
+  "rollout_failed" | "bad_request", "error"}`` — the blue-green weight
+  rollout control op (``tfserve rollout``), served only when a fleet
+  control plane is attached (``rollout_fn``); runs on its own thread
+  and replies when the rollout completes or aborts.
 
 Clients multiplex: many requests may be in flight per connection, and
 completions return in FINISH order, matched by ``id`` — the same
@@ -79,6 +85,10 @@ class Gateway:
         self.registry = registry if registry is not None else router.registry
         self.log = get_logger("tfmesos_tpu.fleet.gateway")
         self.addr: Optional[str] = None
+        # The fleet control plane's rollout entry point (set by
+        # FleetServer after bring-up): callable(version) -> info dict,
+        # raising on abort.  None = this gateway has no rollout surface.
+        self.rollout_fn = None
         self._listen: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._threads = []
@@ -118,6 +128,10 @@ class Gateway:
 
     def stop(self) -> None:
         self._stop.set()
+        # close() alone does not interrupt a blocked accept(): poke the
+        # listener awake so the accept thread exits NOW instead of
+        # burning its whole join timeout.
+        wire.wake_listener(self._listen)
         if self._listen is not None:
             try:
                 self._listen.close()
@@ -178,6 +192,42 @@ class Gateway:
         if op == "metrics":
             client.send({"op": "metrics", "id": cid,
                          "snapshot": self.metrics.snapshot()})
+            return
+        if op == "rollout":
+            fn = self.rollout_fn
+            version = msg.get("weights_version")
+            if fn is None:
+                client.send({"op": "error", "id": cid,
+                             "kind": "bad_request",
+                             "error": "no rollout control plane attached "
+                                      "to this gateway"})
+                return
+            if not isinstance(version, str) or not version:
+                client.send({"op": "error", "id": cid,
+                             "kind": "bad_request",
+                             "error": "rollout needs a non-empty "
+                                      "weights_version"})
+                return
+
+            def run_rollout() -> None:
+                # Off the reader thread: a rollout takes as long as a
+                # fleet's worth of warmups and drains, and blocking here
+                # would stall every other op on this client connection.
+                try:
+                    info = fn(version)
+                except Exception as e:
+                    client.send({"op": "error", "id": cid,
+                                 "kind": "rollout_failed",
+                                 "error": str(e)})
+                    return
+                out = {"op": "rollout", "id": cid, "ok": True,
+                       "weights_version": version}
+                if isinstance(info, dict):
+                    out.update(info)
+                client.send(out)
+
+            threading.Thread(target=run_rollout, name="gateway-rollout",
+                             daemon=True).start()
             return
         if op != "generate":
             client.send({"op": "error", "id": cid, "kind": "bad_request",

@@ -1,4 +1,4 @@
-"""Fleet bring-up: registry + gateway + N scheduled batcher replicas.
+"""Fleet bring-up: registry + gateway + dynamically-launched replicas.
 
 ``FleetServer`` is the one-object front: it generates a cluster token,
 starts the registry and gateway locally, then launches the replicas as
@@ -10,30 +10,73 @@ gateway share ONE token, delivered to replicas over the scheduler's
 existing transport (mode-0600 token file for co-located backends), so
 every hop of the serving path is authenticated with the same secret.
 
+Replica membership is a RUNTIME property, not a launch-time constant
+(the TF-Replicator stance): the scheduler runs in dynamic mode with an
+initially-empty task table, and each tier converges toward a target
+count — ``launch_replica``/``kill_replica`` grow and shrink it one
+Mode-B task at a time, ``--autoscale`` hands the targets to a
+:class:`~tfmesos_tpu.fleet.autoscaler.FleetAutoscaler` feedback loop,
+and :meth:`FleetServer.rollout` replaces a whole tier's weights
+blue-green with zero downtime (launch new-version replicas, warm them,
+shift the router's version preference, bake, drain, reap — with the
+registry's generation fence keeping reaped-generation stragglers out of
+the serving path forever).
+
 Replica death is a SERVING event here, not a cluster event: the
 scheduler's fail-fast policy is for training meshes (which cannot
-hot-swap members); the fleet instead routes around dead replicas and
-keeps serving on the survivors.  Replica auto-restart rides the same
-Job machinery a future PR can point at ``task_spec``.
+hot-swap members); the fleet instead routes around dead replicas and —
+with the autoscaler on — relaunches them from the convergence loop.
 """
 
 from __future__ import annotations
 
+import re
 import sys
-from typing import Optional
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
 
 from tfmesos_tpu import wire
 from tfmesos_tpu.fleet.admission import AdmissionController
+from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig, FleetAutoscaler
 from tfmesos_tpu.fleet.client import FleetClient
 from tfmesos_tpu.fleet.gateway import Gateway
 from tfmesos_tpu.fleet.metrics import FleetMetrics
-from tfmesos_tpu.fleet.registry import ReplicaRegistry
+from tfmesos_tpu.fleet.registry import (ALIVE, DEAD, DECODE, PREFILL,
+                                        UNIFIED, ReplicaRegistry)
 from tfmesos_tpu.fleet.router import Router
-from tfmesos_tpu.scheduler import ClusterError, TPUMesosScheduler
-from tfmesos_tpu.spec import Job
+from tfmesos_tpu.scheduler import (MAX_FAILURE_COUNT, ClusterError,
+                                   TPUMesosScheduler)
 from tfmesos_tpu.utils.logging import get_logger
 
-__all__ = ["FleetServer"]
+__all__ = ["FleetServer", "RolloutError"]
+
+#: tier role -> the scheduler job name its Mode-B tasks launch under.
+TIER_JOBS = {UNIFIED: "replica", PREFILL: "prefill", DECODE: "decode"}
+
+#: weights_version labels join the replica COMMAND LINE, which Mode-B
+#: agents execute with shell=True — the charset is a hard security
+#: boundary, not cosmetics: a serve-token holder drives rollout through
+#: the gateway op, and PR 4's hardening promise (a token cannot become
+#: code execution) must hold for this surface too.
+_VERSION_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+
+
+def validate_weights_version(version: str) -> str:
+    version = str(version)
+    # fullmatch, not match-with-$: '$' would accept a trailing newline,
+    # which shell=True treats as a command terminator.
+    if not _VERSION_RE.fullmatch(version):
+        raise ValueError(
+            f"weights_version {version!r} is not a valid label: want "
+            f"1-64 chars of [A-Za-z0-9._-] starting alphanumeric (it "
+            f"joins the replica command line, so the charset is a "
+            f"security boundary)")
+    return version
+
+
+class RolloutError(RuntimeError):
+    """A blue-green rollout aborted (the old version kept serving)."""
 
 
 class FleetServer:
@@ -50,6 +93,11 @@ class FleetServer:
                  warmup: bool = False,
                  prefill_replicas: int = 0,
                  decode_replicas: int = 0,
+                 weights_version: str = "v0",
+                 autoscale: bool = False,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 autoscale_config: Optional[AutoscalerConfig] = None,
                  backend=None, master: Optional[str] = None,
                  replica_cpus: float = 1.0, replica_mem: float = 1024.0,
                  replica_chips: int = 0,
@@ -63,16 +111,60 @@ class FleetServer:
                  report_interval: Optional[float] = None,
                  quiet: bool = True, token: Optional[str] = None):
         if min(replicas, prefill_replicas, decode_replicas) < 0:
-            raise ValueError("replica counts must be >= 0")
+            raise ValueError(
+                f"replica counts must be >= 0, got replicas={replicas} "
+                f"prefill_replicas={prefill_replicas} "
+                f"decode_replicas={decode_replicas}")
         if (prefill_replicas > 0) != (decode_replicas > 0):
             raise ValueError(
-                "prefill_replicas and decode_replicas come together — "
-                "a lone tier cannot serve the disaggregated handoff")
+                f"prefill_replicas and decode_replicas come together — "
+                f"a lone tier cannot serve the disaggregated handoff "
+                f"(got prefill_replicas={prefill_replicas}, "
+                f"decode_replicas={decode_replicas})")
         if replicas + prefill_replicas + decode_replicas < 1:
-            raise ValueError("the fleet needs at least one replica")
+            raise ValueError(
+                f"the fleet needs at least one replica, got "
+                f"replicas={replicas} + prefill_replicas="
+                f"{prefill_replicas} + decode_replicas={decode_replicas}")
         self.replicas = int(replicas)
         self.prefill_replicas = int(prefill_replicas)
         self.decode_replicas = int(decode_replicas)
+        initial = {UNIFIED: self.replicas, PREFILL: self.prefill_replicas,
+                   DECODE: self.decode_replicas}
+        # Autoscale bounds are PER TIER: an explicit --max-replicas
+        # applies to every tier, but the default ceiling is twice EACH
+        # tier's own initial count (a decode tier booted at 1 must not
+        # inherit a 4-replica prefill tier's headroom), and a
+        # non-autoscaled fleet's ceiling is exactly what was asked for.
+        self.min_replicas = 1 if min_replicas is None else int(min_replicas)
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1 (a routable tier can never "
+                f"scale to zero), got {self.min_replicas}")
+        self._tier_max: Dict[str, int] = {}
+        for role, n in initial.items():
+            if not n:
+                continue
+            if max_replicas is not None:
+                self._tier_max[role] = int(max_replicas)
+            else:
+                self._tier_max[role] = max(2 * n, n + 1) if autoscale \
+                    else n
+        self.max_replicas = max(self._tier_max.values())
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})")
+        for role, n in initial.items():
+            if n and not (self.min_replicas <= n
+                          <= self._tier_max[role]):
+                raise ValueError(
+                    f"initial {role} tier count {n} lies outside the "
+                    f"autoscale bounds [{self.min_replicas}, "
+                    f"{self._tier_max[role]}]")
+        self.weights_version = validate_weights_version(weights_version)
+        self.autoscale = bool(autoscale)
+        self.autoscale_config = autoscale_config
         self.rows = int(rows)
         self.tiny = bool(tiny)
         self.seed = int(seed)
@@ -113,18 +205,32 @@ class FleetServer:
         self.admission: Optional[AdmissionController] = None
         self.gateway: Optional[Gateway] = None
         self.scheduler: Optional[TPUMesosScheduler] = None
+        self.autoscaler: Optional[FleetAutoscaler] = None
+        #: per-tier replica targets — what the control plane WANTS; the
+        #: convergence loops (autoscaler, _wait_replicas) drive actuals
+        #: toward these.
+        self.targets: Dict[str, int] = {}
+        #: serializes every scaling decision: autoscaler ticks and
+        #: rollouts are mutually exclusive (a rollout must not race the
+        #: loop retargeting the tier it is replacing).
+        self.scale_lock = threading.RLock()
         self._started = False
 
     # -- bring-up ----------------------------------------------------------
 
-    def _replica_cmd(self, role: str = "unified") -> str:
+    def _replica_cmd(self, role: str = UNIFIED,
+                     weights_version: Optional[str] = None) -> str:
+        version = self.weights_version if weights_version is None \
+            else weights_version
         parts = [sys.executable, "-m", "tfmesos_tpu.fleet.replica",
                  "--registry", self.registry.addr,
                  "--rows", str(self.rows),
                  "--seed", str(self.seed),
                  "--heartbeat-interval", str(self.heartbeat_interval)]
-        if role != "unified":
+        if role != UNIFIED:
             parts += ["--role", role]
+        if version:
+            parts += ["--weights-version", version]
         if self.tiny:
             parts.append("--tiny")
         if self.max_len is not None:
@@ -140,10 +246,10 @@ class FleetServer:
         if self.pipeline_depth:
             parts += ["--pipeline-depth", str(self.pipeline_depth)]
         if self.warmup:
-            # Every launch of this cmd — boot OR a later elastic/Mode-B
-            # relaunch — registers warming, compiles, then takes
-            # traffic: re-warming is a property of the command line,
-            # not of the first bring-up.
+            # Every launch of this cmd — boot, an autoscale-up, OR a
+            # later elastic/Mode-B relaunch — registers warming,
+            # compiles, then takes traffic: re-warming is a property of
+            # the command line, not of the first bring-up.
             parts.append("--warmup")
         return " ".join(parts)
 
@@ -172,31 +278,27 @@ class FleetServer:
                                    host=self.gateway_host,
                                    port=self.gateway_port,
                                    workers=self.workers).start()
-            jobs = []
-            if self.replicas:
-                jobs.append(Job(name="replica", num=self.replicas,
-                                cpus=self.replica_cpus,
-                                mem=self.replica_mem,
-                                chips=self.replica_chips,
-                                cmd=self._replica_cmd()))
-            if self.prefill_replicas:
-                jobs.append(Job(name="prefill", num=self.prefill_replicas,
-                                cpus=self.replica_cpus,
-                                mem=self.replica_mem,
-                                chips=self.replica_chips,
-                                cmd=self._replica_cmd("prefill")))
-            if self.decode_replicas:
-                jobs.append(Job(name="decode", num=self.decode_replicas,
-                                cpus=self.replica_cpus,
-                                mem=self.replica_mem,
-                                chips=self.replica_chips,
-                                cmd=self._replica_cmd("decode")))
+            # The scheduler starts EMPTY in dynamic mode: the task table
+            # is a runtime property, and every replica — boot ones
+            # included — goes through the same launch_replica path the
+            # autoscaler and rollouts use.
             self.scheduler = TPUMesosScheduler(
-                jobs, backend=self.backend, master=self.master,
+                [], dynamic=True, backend=self.backend, master=self.master,
                 quiet=self.quiet, start_timeout=self.start_timeout,
                 token=self.token)
             self.scheduler.start()
+            for role, n in ((UNIFIED, self.replicas),
+                            (PREFILL, self.prefill_replicas),
+                            (DECODE, self.decode_replicas)):
+                if n:
+                    self.set_target(role, n)
+                    for _ in range(n):
+                        self.launch_replica(role)
             self._wait_replicas()
+            self.gateway.rollout_fn = self.rollout
+            if self.autoscale:
+                self.autoscaler = FleetAutoscaler(
+                    self, self.autoscale_config).start()
         except Exception:
             self.stop()
             raise
@@ -204,33 +306,243 @@ class FleetServer:
         if self.report_interval:
             self.metrics.start_reporter(self.log, self.report_interval)
         self.log.info("fleet up: gateway %s, %d replica(s) "
-                      "(%d unified / %d prefill / %d decode)", self.addr,
+                      "(%d unified / %d prefill / %d decode)%s", self.addr,
                       self.total_replicas, self.replicas,
-                      self.prefill_replicas, self.decode_replicas)
+                      self.prefill_replicas, self.decode_replicas,
+                      f", autoscaling within [{self.min_replicas}, "
+                      f"{self.max_replicas}]" if self.autoscale else "")
         return self
 
     @property
     def total_replicas(self) -> int:
         return self.replicas + self.prefill_replicas + self.decode_replicas
 
-    def _wait_replicas(self) -> None:
-        import time
+    # -- dynamic tier management -------------------------------------------
 
-        want = self.total_replicas
+    def set_target(self, role: str, n: int) -> None:
+        """Record one tier's wanted replica count (mirrored into the
+        registry so the ``roles`` gauge shows target vs actual)."""
+        self.targets[role] = int(n)
+        self.registry.set_target(role, int(n))
+
+    def bounds(self, role: str) -> Tuple[int, int]:
+        """The autoscale bounds this tier's target must stay within
+        (the floor is fleet-wide, the ceiling per tier)."""
+        return self.min_replicas, self._tier_max.get(role,
+                                                     self.max_replicas)
+
+    def launch_replica(self, role: str,
+                       weights_version: Optional[str] = None) -> str:
+        """Launch ONE new Mode-B replica task for ``role`` and return
+        its node id ("job:index") — with ``--warmup`` on the cmd line it
+        registers ``warming`` and never takes traffic cold."""
+        job = TIER_JOBS[role]
+        task = self.scheduler.add_task(
+            job, cmd=self._replica_cmd(role, weights_version),
+            cpus=self.replica_cpus, mem=self.replica_mem,
+            chips=self.replica_chips)
+        return f"{job}:{task.task_index}"
+
+    def kill_replica(self, node: str) -> bool:
+        """Kill one replica task by its node id ("job:index")."""
+        job, _, idx = node.rpartition(":")
+        try:
+            task = self.scheduler.task_by_index(job, int(idx))
+        except ValueError:
+            return False
+        if task is None:
+            return False
+        return self.scheduler.remove_task(task.id)
+
+    def tier_actual(self, role: str) -> int:
+        """Live tasks launched for one tier (registered or not) — the
+        convergence loops' notion of "actual"."""
+        return len(self.scheduler.tasks_of(TIER_JOBS[role]))
+
+    def _alive_of(self, role: str,
+                  weights_version: Optional[str] = None) -> int:
+        return sum(1 for r in self.registry.members(role)
+                   if r.state == ALIVE
+                   and (weights_version is None
+                        or r.weights_version == weights_version))
+
+    def _drain_and_flush(self, reps, drain_timeout: float) -> None:
+        """ONE copy of the reap discipline both rollout paths share:
+        pinned drains on every given replica (healthy members keep
+        heartbeating while their in-flight work finishes), then wait
+        until BOTH flush signals read zero for all of them — the
+        heartbeat-reported outstanding AND the router's own in-flight
+        count (a request dispatched after the last beat is invisible
+        to the first) — or the drain deadline passes."""
+        addrs = [r.addr for r in reps]
+        for r in reps:
+            self.registry.begin_drain(r.addr, pinned=True)
+        deadline = time.monotonic() + float(drain_timeout)
+        while addrs and time.monotonic() < deadline:
+            table = {m.addr: m for m in self.registry.members()}
+            busy = any(
+                (table.get(a) is not None and table[a].state != DEAD
+                 and table[a].outstanding > 0)
+                or self.router.outstanding(a) > 0
+                for a in addrs)
+            if not busy:
+                return
+            time.sleep(0.05)
+
+    def _wait_replicas(self) -> None:
+        """Target-based bring-up: every tier must reach its target alive
+        count.  Boot crashes are relaunched (the convergence discipline)
+        up to the scheduler's per-task failure budget scaled by the
+        tier size — a crash-looping replica cmd still fails the
+        bring-up loudly instead of idling to timeout."""
         deadline = time.monotonic() + self.start_timeout
         while time.monotonic() < deadline:
-            if len(self.registry.alive()) >= want:
-                return
-            # finished() raises ClusterError if a replica task already
-            # died fatally — surface that instead of idling to timeout.
+            # finished() raises ClusterError on backend-fatal errors —
+            # surface those instead of idling to timeout.
             self.scheduler.finished()
+            if all(self._alive_of(role) >= n
+                   for role, n in self.targets.items()):
+                return
+            for role, n in self.targets.items():
+                job = TIER_JOBS[role]
+                fails = self.scheduler.dynamic_failures.get(job, 0)
+                if fails >= MAX_FAILURE_COUNT * n:
+                    raise ClusterError(
+                        f"replica job {job!r} failed {fails} times "
+                        f"during fleet bring-up")
+                for _ in range(n - self.tier_actual(role)):
+                    self.log.warning("bring-up relaunch of a crashed "
+                                     "%s replica", role)
+                    self.launch_replica(role)
             time.sleep(0.1)
         warming = len(self.registry.warming())
+        counts = {role: self._alive_of(role) for role in self.targets}
         raise ClusterError(
-            f"only {len(self.registry.alive())}/{want} replicas "
-            f"routable after {self.start_timeout:.0f}s"
+            f"replicas routable after {self.start_timeout:.0f}s: "
+            f"{counts} of targets {self.targets}"
             + (f" ({warming} still warming — raise start_timeout for "
                f"slow compiles)" if warming else ""))
+
+    # -- blue-green rollout ------------------------------------------------
+
+    def rollout(self, weights_version: str, bake_s: float = 1.0,
+                warm_timeout: Optional[float] = None,
+                drain_timeout: float = 120.0) -> dict:
+        """Replace every tier's weights blue-green with zero downtime:
+
+        1. bump the scheduler generation (PR 3's fencing epoch) and
+           launch a full NEW-version replica set next to the old one —
+           same per-tier targets, same cmd line (``--warmup`` included,
+           so the new tier warms before it can be routed);
+        2. wait until every tier's new-version alive count reaches its
+           target — if that never happens the rollout ABORTS: the new
+           tasks are reaped and the old version keeps serving;
+        3. the SHIFT: one atomic router update prefers the new
+           weights_version (the old tier stays registered as fallback
+           through the bake window, so the shift itself cannot shed);
+        4. after ``bake_s``, drain the old tier (pinned drains — the
+           healthy old replicas keep heartbeating while their in-flight
+           work flushes, and those beats must not revive them), wait
+           for the flush, kill the old tasks, and raise the registry's
+           generation fence so a stalled old-generation straggler can
+           never re-register and serve stale weights.
+
+        Returns a summary dict; raises :class:`RolloutError` on abort.
+        """
+        version = validate_weights_version(weights_version)
+        if self.scheduler is None or self.registry is None:
+            raise RuntimeError("fleet not started")
+        with self.scale_lock:
+            old_version = self.weights_version
+            if version == old_version:
+                raise ValueError(
+                    f"fleet already serves weights_version {version!r}")
+            gen = self.scheduler.bump_generation()
+            warm_timeout = self.start_timeout if warm_timeout is None \
+                else float(warm_timeout)
+            new_nodes: List[Tuple[str, str]] = []
+            for role, target in self.targets.items():
+                for _ in range(target):
+                    new_nodes.append(
+                        (role, self.launch_replica(role, version)))
+            self.log.info(
+                "rollout %s -> %s: %d new-version replica(s) launched "
+                "(generation %d); old tier keeps serving", old_version,
+                version, len(new_nodes), gen)
+            deadline = time.monotonic() + warm_timeout
+            while time.monotonic() < deadline:
+                self.scheduler.finished()
+                if all(self._alive_of(role, version) >= target
+                       for role, target in self.targets.items()):
+                    break
+                time.sleep(0.1)
+            else:
+                # Abort: the new tier never left warming (or its tasks
+                # kept dying).  Reap it; the old version never stopped
+                # serving, so this is a no-downtime failure.  Routing
+                # is version-blind BEFORE the shift, so any new-version
+                # replica that did reach ALIVE may already carry
+                # traffic — drain those and wait for the flush before
+                # the kill, exactly like the post-shift reap path.
+                new_set = {node for _, node in new_nodes}
+                self._drain_and_flush(
+                    [r for r in self.registry.members()
+                     if r.node in new_set and r.state == ALIVE],
+                    drain_timeout)
+                for _, node in new_nodes:
+                    self.kill_replica(node)
+                self.metrics.inc("rollouts_aborted")
+                raise RolloutError(
+                    f"rollout to {version!r} aborted: new tier not "
+                    f"routable within {warm_timeout:.0f}s "
+                    f"({len(self.registry.warming())} still warming, "
+                    f"{len(new_set)} launched); {old_version!r} keeps "
+                    f"serving")
+            # The shift point: one atomic preference update.  From the
+            # next pick on, the router selects old-version replicas only
+            # if NO new-version replica is routable.
+            self.router.set_preferred_version(version)
+            self.weights_version = version
+            self.metrics.inc("rollouts")
+            self.log.info("rollout shift: router now prefers "
+                          "weights_version %s (old %s is fallback for "
+                          "%.1fs bake)", version, old_version, bake_s)
+            if bake_s:
+                time.sleep(bake_s)
+            # Drain the old tier: pinned — these replicas are healthy
+            # and keep heartbeating while their last requests flush.
+            # The drain set is computed NOW, not at rollout start: a
+            # replica that registered during the warm wait (an
+            # autoscaler launch racing the scale lock) is old-version
+            # fallback traffic too and must flush before the reap.
+            old_members = [r for r in self.registry.members()
+                           if (r.role or UNIFIED) in self.targets
+                           and r.weights_version != version
+                           and r.state != DEAD]
+            self._drain_and_flush(old_members, drain_timeout)
+            # Reap every old-generation task of the managed tiers (the
+            # registry's node field maps members back; the scheduler
+            # table diff catches launched-but-never-registered ones).
+            new_set = {node for _, node in new_nodes}
+            reaped = 0
+            for role in self.targets:
+                job = TIER_JOBS[role]
+                for t in self.scheduler.tasks_of(job):
+                    node = f"{job}:{t.task_index}"
+                    if node not in new_set:
+                        self.scheduler.remove_task(t.id)
+                        reaped += 1
+            # The fence: beats of generations before this rollout are
+            # dropped from here on — a SIGSTOP'd straggler that wakes up
+            # tomorrow cannot re-register and serve stale weights.
+            self.registry.fence_generation(gen)
+            self.log.info(
+                "rollout to %s complete: %d old replica(s) drained and "
+                "reaped, registry fenced at generation %d", version,
+                reaped, gen)
+            return {"old_version": old_version, "new_version": version,
+                    "replicas": len(new_nodes), "reaped": reaped,
+                    "generation": gen}
 
     # -- surface -----------------------------------------------------------
 
@@ -242,12 +554,18 @@ class FleetServer:
         return FleetClient(self.addr, self.token, timeout=timeout)
 
     def snapshot(self) -> dict:
+        """The fleet metrics snapshot; the ``roles`` gauge carries each
+        tier's target vs actual counts and weights_version distribution,
+        and ``autoscaler`` (when scaling) the control loop's beliefs."""
         return self.metrics.snapshot() if self.metrics is not None else {}
 
     # -- teardown ----------------------------------------------------------
 
     def stop(self) -> None:
         self._started = False
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+            self.autoscaler = None
         if self.metrics is not None:
             self.metrics.stop_reporter()
         if self.gateway is not None:

@@ -57,13 +57,12 @@ class Histogram:
                     break
 
     def _percentile(self, p: float) -> float:
-        rank = p * self._count
-        seen = 0
-        for i, edge in enumerate(self.buckets):
-            seen += self._counts[i]
-            if seen >= rank:
-                return edge if edge != float("inf") else self._max
-        return self._max
+        # One copy of the rank walk (delta_percentile); the lifetime
+        # snapshot substitutes the tracked max for the +inf bucket.
+        out = Histogram.delta_percentile(
+            None, (self.buckets, tuple(self._counts), self._count), p,
+            inf_value=self._max)
+        return self._max if out is None else out
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -77,6 +76,46 @@ class Histogram:
                 "p99": self._percentile(0.99),
                 "max": round(self._max, 3),
             }
+
+    def cumulative(self) -> tuple:
+        """``(buckets, counts, count)`` — the raw cumulative state, so
+        a control loop can diff two samples and compute percentiles
+        over JUST the interval between them (Prometheus-style windowed
+        p99: a lifetime histogram would never decay and the autoscaler
+        would chase load that ended minutes ago)."""
+        with self._lock:
+            return (self.buckets, tuple(self._counts), self._count)
+
+    @staticmethod
+    def delta_percentile(prev: Optional[tuple], cur: tuple, p: float,
+                         inf_value: Optional[float] = None
+                         ) -> Optional[float]:
+        """Percentile of the samples observed BETWEEN two
+        :meth:`cumulative` snapshots (``prev`` may be ``None`` for
+        since-birth); ``None`` when the window holds no samples.  A
+        rank landing in the +inf bucket reports ``inf_value`` when the
+        caller tracks a true max (the lifetime snapshot), else the
+        last finite bucket edge."""
+        buckets, counts, total = cur
+        if prev is not None:
+            pbuckets, pcounts, ptotal = prev
+            if pbuckets == buckets:
+                counts = tuple(c - q for c, q in zip(counts, pcounts))
+                total = total - ptotal
+        if total <= 0:
+            return None
+        rank = p * total
+        seen = 0
+        last_finite = 0.0
+        for edge, n in zip(buckets, counts):
+            seen += n
+            if edge != float("inf"):
+                last_finite = edge
+            if seen >= rank:
+                if edge == float("inf"):
+                    break
+                return edge
+        return last_finite if inf_value is None else inf_value
 
 
 class FleetMetrics:
@@ -113,6 +152,22 @@ class FleetMetrics:
             if hist is None:
                 hist = self._hists[name] = Histogram()
         hist.observe(value)
+
+    def hist_cumulative(self, name: str) -> Optional[tuple]:
+        """The named histogram's :meth:`Histogram.cumulative` state, or
+        ``None`` before its first observation — the autoscaler samples
+        this per tick to compute windowed percentiles."""
+        with self._lock:
+            hist = self._hists.get(name)
+        return hist.cumulative() if hist is not None else None
+
+    def percentile(self, name: str, p: float) -> Optional[float]:
+        """Lifetime percentile of one histogram (``None`` when it has
+        no samples yet)."""
+        cur = self.hist_cumulative(name)
+        if cur is None:
+            return None
+        return Histogram.delta_percentile(None, cur, p)
 
     # -- gauges ------------------------------------------------------------
 
@@ -153,7 +208,11 @@ class FleetMetrics:
         for name in sorted(snap["histograms"]):
             h = snap["histograms"][name]
             if h.get("count"):
+                # p50 AND p99: the autoscaler keys off tail latency, so
+                # the tail must be a first-class observable, not a
+                # median that hides the very stalls scaling reacts to.
                 parts.append(f"{name}_p50={h['p50']}")
+                parts.append(f"{name}_p99={h['p99']}")
         return "fleet: " + " ".join(parts)
 
     def start_reporter(self, log, interval: float = 10.0) -> None:

@@ -79,6 +79,27 @@ class ReplicaInfo:
     # entry — an exiting replica never re-enters through its own
     # warmup; only a plain (routable) beat clears it.
     announced_drain: bool = False
+    # Drain-for-scale-down vs drain-for-death: a PINNED drain is set by
+    # the control plane (autoscaler shrink, rollout reap) on a replica
+    # that is still healthy and heartbeating — its plain alive beats
+    # refresh liveness but must NOT revive it to routable while its
+    # outstanding work flushes.  The pin dies with the process (a beat
+    # after DEAD is a new process) or is reset by a beat carrying a
+    # weights_version DIFFERENT from the one pinned (a relaunch with
+    # upgraded weights on a reused addr must not inherit a stale drain).
+    drain_pinned: bool = False
+    pinned_version: str = ""
+    # Blue-green rollout identity, both heartbeat fields: the weights
+    # version this replica serves (rides the hello and every beat — the
+    # router's version-preference tier keys off it) and the launch
+    # generation it was fenced into (PR 3's epoch, via
+    # TPUMESOS_GENERATION); -1 / "" = never advertised.
+    weights_version: str = ""
+    gen: int = -1
+    # The scheduler-side identity ("job:index") of the Mode-B task this
+    # replica runs under — how the control plane maps a registry addr
+    # back to a killable task.
+    node: str = ""
 
 
 class ReplicaRegistry:
@@ -103,6 +124,15 @@ class ReplicaRegistry:
         self._listen: Optional[socket.socket] = None
         self._table: Dict[str, ReplicaInfo] = {}
         self._conns: Dict[str, socket.socket] = {}
+        # Generation fence floor: beats stamped with a gen BELOW this
+        # are dropped entirely — a straggler of a reaped rollout
+        # generation can never re-register and serve stale weights.
+        self._min_gen: int = 0
+        self._fence_logged: set = set()
+        # Per-role replica targets (what the control plane WANTS), shown
+        # next to actuals in role_summary so the roles gauge reads as
+        # target-vs-actual at a glance.
+        self._targets: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -125,6 +155,10 @@ class ReplicaRegistry:
 
     def stop(self) -> None:
         self._stop.set()
+        # close() alone does not interrupt a blocked accept(): poke the
+        # listener awake so the accept thread exits NOW instead of
+        # burning its whole join timeout.
+        wire.wake_listener(self._listen)
         if self._listen is not None:
             try:
                 self._listen.close()
@@ -195,6 +229,26 @@ class ReplicaRegistry:
         if (op != "drain" and self.chaos is not None
                 and self.chaos.on_heartbeat(addr)):
             return None         # chaos drop: the beat never arrived
+        # Optional rollout-identity fields, parsed up front: the
+        # generation fence must see ``gen`` before the beat can touch
+        # the table, and the pinned-drain reset keys off the beat's
+        # ``weights_version``.  Malformed values cost the field, never
+        # the beat.
+        gen: Optional[int] = None
+        if "gen" in msg:
+            try:
+                gen = int(msg["gen"])
+            except (TypeError, ValueError):
+                gen = None
+        wv: Optional[str] = None
+        raw_wv = msg.get("weights_version")
+        # bool is an int subclass: True must cost the FIELD (like any
+        # malformed value), not coerce to the version label "True" —
+        # which could spuriously match the relaunch-with-new-weights
+        # heuristic and clear a pinned scale-down drain.
+        if (isinstance(raw_wv, (str, int, float))
+                and not isinstance(raw_wv, bool)):
+            wv = str(raw_wv)
         # The beat's announced state: ``status: warming`` marks a
         # replica still compiling (ContinuousBatcher.warmup) — present
         # and heartbeating, but not routable; anything else (including
@@ -202,6 +256,20 @@ class ReplicaRegistry:
         # state defaults to alive like every other optional field.
         target = WARMING if msg.get("status") == WARMING else ALIVE
         with self._lock:
+            if gen is not None and gen < self._min_gen:
+                # Generation fence (blue-green rollout): this process
+                # belongs to a reaped generation — its beats (hello
+                # included: a straggler RE-REGISTERING) are dropped
+                # whole, so it can never re-enter the table and serve
+                # stale weights.  Its entry, if any, goes stale → dead
+                # → evicted on the sweeper's clocks.
+                if addr not in self._fence_logged:
+                    self._fence_logged.add(addr)
+                    self.log.warning(
+                        "dropping fenced beat from %s (generation %d < "
+                        "fence %d): stale-weights straggler", addr, gen,
+                        self._min_gen)
+                return None
             rep = self._table.get(addr)
             if op == "drain":
                 if rep is not None and rep.state in (ALIVE, WARMING):
@@ -220,10 +288,28 @@ class ReplicaRegistry:
                 # beat's own status: a relaunched replica on a reused
                 # port must show as warming, not stay pinned dead.
                 rep.announced_drain = False
+                rep.drain_pinned = False
+            if (rep.drain_pinned and wv is not None
+                    and wv != rep.pinned_version):
+                # A scale-down drain pins the weights version it was
+                # announced against; a beat advertising a DIFFERENT
+                # version is a relaunch with upgraded weights on a
+                # reused addr — the stale drain must not survive it.
+                self.log.info("replica %s drain reset by weights_version "
+                              "%s (pinned at %s)", addr, wv,
+                              rep.pinned_version)
+                rep.drain_pinned = False
+                rep.announced_drain = False
             if rep.announced_drain and target == WARMING:
                 # Drain beats warming: an exiting replica's late
                 # warming beat refreshes liveness but never re-enters
                 # the table's routable path.
+                target = rep.state
+            if rep.drain_pinned and target == ALIVE:
+                # Drain-for-scale-down: the replica is healthy and
+                # still heartbeating plain (routable) beats while its
+                # outstanding work flushes — liveness refreshes, but
+                # the control plane's drain is not its to clear.
                 target = rep.state
             if rep.state != target:
                 self.log.info("replica %s %s -> %s", addr, rep.state,
@@ -231,6 +317,12 @@ class ReplicaRegistry:
                 rep.state = target
             if target == ALIVE:
                 rep.announced_drain = False
+            if gen is not None:
+                rep.gen = gen
+            if wv is not None:
+                rep.weights_version = wv
+            if isinstance(msg.get("node"), str):
+                rep.node = msg["node"]
             if "capacity" in msg:
                 rep.capacity = int(msg["capacity"])
             if "outstanding" in msg:
@@ -291,6 +383,14 @@ class ReplicaRegistry:
             return [dataclasses.replace(r) for r in self._table.values()
                     if r.state == WARMING]
 
+    def members(self, role: Optional[str] = None) -> List[ReplicaInfo]:
+        """Every table entry (copies), optionally filtered to one tier —
+        the control plane's membership query (any state, unlike
+        ``alive()``)."""
+        with self._lock:
+            return [dataclasses.replace(r) for r in self._table.values()
+                    if role is None or (r.role or UNIFIED) == role]
+
     def snapshot(self) -> List[dict]:
         with self._lock:
             return [dataclasses.asdict(r) for r in self._table.values()]
@@ -306,13 +406,87 @@ class ReplicaRegistry:
                 d = out.setdefault(rep.role or UNIFIED,
                                    {"alive": 0, "warming": 0,
                                     "draining": 0, "dead": 0,
-                                    "outstanding": 0, "kv_headroom": 0})
+                                    "outstanding": 0, "kv_headroom": 0,
+                                    "versions": {}})
                 d[rep.state] = d.get(rep.state, 0) + 1
                 if rep.state == ALIVE:
                     d["outstanding"] += rep.outstanding
                     if rep.kv_headroom > 0:
                         d["kv_headroom"] += rep.kv_headroom
+                    # Weights-version distribution of the ROUTABLE tier
+                    # members — what an operator watches converge during
+                    # a blue-green rollout.
+                    v = rep.weights_version or ""
+                    d["versions"][v] = d["versions"].get(v, 0) + 1
+            for role, target in self._targets.items():
+                d = out.setdefault(role, {"alive": 0, "warming": 0,
+                                          "draining": 0, "dead": 0,
+                                          "outstanding": 0,
+                                          "kv_headroom": 0,
+                                          "versions": {}})
+                d["target"] = target
         return out
+
+    def set_target(self, role: str, n: Optional[int]) -> None:
+        """Record the control plane's WANTED replica count for one tier
+        (``None`` clears it); surfaces as ``target`` in
+        :meth:`role_summary` next to the actual counts."""
+        with self._lock:
+            if n is None:
+                self._targets.pop(role, None)
+            else:
+                self._targets[role] = int(n)
+
+    def begin_drain(self, addr: str, pinned: bool = True) -> bool:
+        """Control-plane drain (autoscaler scale-down, rollout reap):
+        the replica leaves the routable path NOW, in-flight work may
+        finish.  ``pinned`` (the scale-down default) survives the
+        replica's own plain alive beats — a healthy replica being
+        shrunk away keeps heartbeating and must not revive itself; the
+        pin is recorded against the replica's current weights_version
+        so a relaunch with NEWER weights on the same addr resets it.
+        False when the addr is unknown."""
+        with self._lock:
+            rep = self._table.get(addr)
+            if rep is None:
+                return False
+            if rep.state in (ALIVE, WARMING):
+                rep.state = DRAINING
+            rep.announced_drain = True
+            if pinned:
+                rep.drain_pinned = True
+                rep.pinned_version = rep.weights_version
+        self.log.info("replica %s draining (%s)", addr,
+                      "scale-down, pinned" if pinned else "announced")
+        return True
+
+    def clear_drain(self, addr: str) -> None:
+        """Cancel a control-plane drain: the next routable beat revives
+        the entry.  The autoscaler releases a drain this way when the
+        victim cannot be mapped back to a killable task — a replica
+        stuck pinned-DRAINING forever would block tier convergence."""
+        with self._lock:
+            rep = self._table.get(addr)
+            if rep is None:
+                return
+            rep.drain_pinned = False
+            rep.announced_drain = False
+        self.log.info("replica %s drain cleared", addr)
+
+    def fence_generation(self, min_gen: int) -> None:
+        """Raise the generation fence floor: beats (re-registrations
+        included) stamped with ``gen < min_gen`` are dropped whole from
+        here on — PR 3's fencing epoch applied to the serving path, so
+        a straggler of a reaped rollout generation can never serve
+        stale weights.  Monotone: the floor never lowers."""
+        with self._lock:
+            raised = min_gen > self._min_gen
+            if raised:
+                self._min_gen = int(min_gen)
+                self._fence_logged.clear()
+        if raised:
+            self.log.info("registry generation fence raised to %d",
+                          min_gen)
 
     def mark_dead(self, addr: str, why: str = "reported by router") -> None:
         """Out-of-band death report (router connection failure).  The

@@ -33,6 +33,7 @@ replicas).
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import socket
 import sys
@@ -126,6 +127,12 @@ class ReplicaServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # close() alone does not interrupt a blocked accept(): poke the
+        # listener awake so the accept thread exits NOW instead of
+        # burning its whole join timeout (this is also what keeps a
+        # SIGTERM'd replica's exit prompt — the drain the fleet waits
+        # on rides the process death).
+        wire.wake_listener(self._listen)
         if self._listen is not None:
             try:
                 self._listen.close()
@@ -538,6 +545,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve the tiny CI model instead of the flagship")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--heartbeat-interval", type=float, default=0.3)
+    p.add_argument("--weights-version", type=str, default="",
+                   dest="weights_version",
+                   help="weights version label this replica serves; "
+                        "rides the registry hello and every heartbeat "
+                        "so the router's version-preference tier and "
+                        "the blue-green rollout can tell generations "
+                        "of the model apart (docs/SERVING.md)")
     return p
 
 
@@ -545,6 +559,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     token = wire.load_token()
     log = get_logger("tfmesos_tpu.fleet.replica")
+
+    # Control-plane identity, both from the Mode-B task env contract:
+    # the launch generation (PR 3's fencing epoch — the registry drops
+    # beats of reaped rollout generations) and the scheduler-side task
+    # name ("job:index"), which is how the autoscaler maps this
+    # replica's registry entry back to a killable task.
+    try:
+        generation = int(os.environ.get("TPUMESOS_GENERATION", "0") or 0)
+    except ValueError:
+        generation = 0
+    job = os.environ.get("TPUMESOS_JOB_NAME", "")
+    idx = os.environ.get("TPUMESOS_TASK_INDEX", "")
+    node = f"{job}:{idx}" if job and idx != "" else ""
 
     from tfmesos_tpu.serving import ContinuousBatcher
 
@@ -573,9 +600,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     def extra() -> Dict[str, Any]:
         # Heartbeat advert: the tier this replica belongs to and its
         # live KV headroom (decode-tier routing places imports by it),
-        # plus the prefix-cache summary when one runs.
+        # the rollout identity (weights_version + launch generation +
+        # task node), plus the prefix-cache summary when one runs.
         beat: Dict[str, Any] = {"role": args.role,
-                                "kv_headroom": batcher.kv_headroom()}
+                                "kv_headroom": batcher.kv_headroom(),
+                                "gen": generation}
+        if args.weights_version:
+            beat["weights_version"] = args.weights_version
+        if node:
+            beat["node"] = node
         if batcher.prefix_cache_active:
             beat["prefix_cache"] = batcher.prefix_cache_summary()
         return beat

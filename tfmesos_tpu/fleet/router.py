@@ -101,6 +101,14 @@ class Router:
         self._rng = rng or random.Random()
         self._links: Dict[str, MuxConnection] = {}
         self._lock = threading.Lock()
+        # Blue-green rollout: when set, every tier's candidate set is
+        # narrowed to replicas advertising THIS weights_version whenever
+        # at least one such replica is routable — the shift point of
+        # FleetServer.rollout().  Replicas of other versions remain the
+        # FALLBACK (the old tier keeps serving through the bake window
+        # if the new tier empties), so the shift itself can never cause
+        # an outage.  One attribute write = the atomic shift.
+        self._preferred_version: Optional[str] = None
 
     # -- load signal -------------------------------------------------------
 
@@ -144,11 +152,34 @@ class Router:
                 best = (score, r.addr)
         return best[1] if best is not None else None
 
+    def set_preferred_version(self, version: Optional[str]) -> None:
+        """Shift routing to prefer replicas serving ``version`` (the
+        blue-green cutover); ``None`` restores version-blind routing.
+        Takes effect on the next pick — no in-flight request moves."""
+        self._preferred_version = version
+        self.log.info("router weights_version preference -> %r", version)
+
     def _alive_by_role(self, roles, exclude=()) -> List[ReplicaInfo]:
+        """Alive candidates of the given tiers, version-preference
+        applied on top: with a preferred weights_version set, replicas
+        advertising it crowd out every other version whenever at least
+        one is routable; otherwise (new tier empty or draining away)
+        the full candidate set remains the fallback."""
         exclude = set(exclude)
-        return [r for r in self.registry.alive()
-                if r.addr not in exclude
-                and (r.role or UNIFIED) in roles]
+        cands = [r for r in self.registry.alive()
+                 if r.addr not in exclude
+                 and (r.role or UNIFIED) in roles]
+        pref = self._preferred_version
+        if pref:
+            preferred = [r for r in cands if r.weights_version == pref]
+            if preferred:
+                return preferred
+            if cands:
+                # Served by the non-preferred fallback: visible in the
+                # counters so a stuck rollout (bake window over, old
+                # version still serving) cannot hide.
+                self.metrics.inc("version_fallbacks")
+        return cands
 
     def _load_pick(self, cands) -> Optional[str]:
         """Least-outstanding with p2c sampling over ``cands``."""

@@ -92,6 +92,7 @@ class TPUMesosScheduler:
                  restart_backoff_max: float = 30.0,
                  restart_jitter: float = 0.1,
                  restart_seed: Optional[int] = None,
+                 dynamic: bool = False,
                  chaos=None):
         self.task_spec = task_spec
         self.master = master or os.environ.get("MESOS_MASTER")
@@ -112,6 +113,18 @@ class TPUMesosScheduler:
         if restart_policy not in ("fail_fast", "elastic"):
             raise ValueError(f"restart_policy must be fail_fast|elastic, "
                              f"got {restart_policy!r}")
+        # Dynamic mode (the serving fleet): the task table is a runtime
+        # property — add_task()/remove_task() grow and shrink it after
+        # start(), registrations are served continuously instead of
+        # through one gang barrier, and a task death is a SERVING event
+        # (the control loop re-converges), never a cluster-fatal one.
+        # Elastic recovery is whole-gang replacement and has no meaning
+        # over a membership that changes one task at a time.
+        self.dynamic = bool(dynamic)
+        if self.dynamic and restart_policy == "elastic":
+            raise ValueError("dynamic task management and elastic gang "
+                             "recovery are mutually exclusive: a dynamic "
+                             "fleet has no gang to re-form")
         self.restart_policy = restart_policy
         self.max_cluster_restarts = int(max_cluster_restarts)
         self.restart_window = float(restart_window)
@@ -164,8 +177,15 @@ class TPUMesosScheduler:
         self.token_transport = token_transport
         self._token_file: Optional[str] = None
 
-        if not self.tasks:
+        if not self.tasks and not self.dynamic:
             raise ValueError("job spec expands to zero tasks")
+        # Per-job index counters and bring-up failure counts for tasks
+        # added at runtime (dynamic mode).
+        self._dyn_index: Dict[str, int] = {}
+        for task in self.tasks:
+            self._dyn_index[task.job_name] = max(
+                self._dyn_index.get(task.job_name, 0), task.task_index + 1)
+        self.dynamic_failures: Dict[str, int] = {}
 
         self._lock = threading.RLock()
         self.started = False
@@ -200,6 +220,7 @@ class TPUMesosScheduler:
         self._restart_times: collections.deque = collections.deque()
         self._backoff_exponent = 0
         self._elastic_thread: Optional[threading.Thread] = None
+        self._dynamic_thread: Optional[threading.Thread] = None
 
     # -- backend selection -------------------------------------------------
 
@@ -269,7 +290,7 @@ class TPUMesosScheduler:
                     infos = [t.to_task_info(offer, self.addr, self.token,
                                             containerizer_type=self.containerizer_type,
                                             force_pull_image=self.force_pull_image,
-                                            env=self._launch_env(),
+                                            env=self._launch_env(t),
                                             token_file=self._token_file,
                                             secret_token=(self.token_transport
                                                           == "secret"))
@@ -353,6 +374,22 @@ class TPUMesosScheduler:
                 return
             task.last_state = status.state
             if not status.terminal:
+                return
+            if getattr(task, "dynamic", False):
+                # A dynamic (serving) task's death is a SERVING event:
+                # drop it from the table — the fleet routes around it and
+                # the autoscaler re-converges the tier — never a
+                # cluster-fatal or a revive charge.  (Tasks removed via
+                # remove_task() report under an id no longer in the
+                # table and land in the unknown-id branch above.)
+                self.tasks.remove(task)
+                if status.state == "TASK_FINISHED":
+                    self.log.info("dynamic task finished: %s", task)
+                else:
+                    self.dynamic_failures[task.job_name] = \
+                        self.dynamic_failures.get(task.job_name, 0) + 1
+                    self.log.warning("dynamic task %s terminated: %s %s",
+                                     task, status.state, status.message)
                 return
             if status.state == "TASK_FINISHED":
                 self.job_finished[task.job_name] = \
@@ -466,7 +503,7 @@ class TPUMesosScheduler:
         spam re-offers on a busy master."""
         with self._lock:
             need = (not self._stopped and self._fatal is None
-                    and not self.started
+                    and (not self.started or self.dynamic)
                     and any(not t.offered for t in self.tasks)
                     and (self._revive_failed
                          or not self._offers_since_beat))
@@ -501,12 +538,19 @@ class TPUMesosScheduler:
 
     # -- elastic recovery --------------------------------------------------
 
-    def _launch_env(self) -> Dict[str, str]:
-        """Per-launch env: the user's plus the current generation, so a
-        task knows which gang epoch launched it (it echoes the value in
-        its registration and every Mode-A reply — the fencing token)."""
+    def _launch_env(self, task=None) -> Dict[str, str]:
+        """Per-launch env: the user's plus the generation, so a task
+        knows which gang epoch launched it (it echoes the value in its
+        registration and every Mode-A reply — the fencing token).
+        Dynamic tasks carry THEIR OWN launch generation (stamped at
+        add_task time): a blue-green rollout bumps the cluster
+        generation while old-generation fallback replicas are still
+        legitimately being (re)offered, and those must not silently
+        inherit the new epoch."""
         env = dict(self.env)
-        env["TPUMESOS_GENERATION"] = str(self.generation)
+        gen = getattr(task, "generation", None) if task is not None else None
+        env["TPUMESOS_GENERATION"] = str(
+            self.generation if gen is None else gen)
         return env
 
     def _post_start_failure(self, why: str) -> None:
@@ -696,6 +740,179 @@ class TPUMesosScheduler:
                 return task
         return None
 
+    # -- dynamic task management (serving fleets) --------------------------
+
+    def add_task(self, job_name: str, cmd: str, cpus: float = 1.0,
+                 mem: float = 1024.0, chips: int = 0) -> Task:
+        """Launch ONE new Mode-B task at runtime (dynamic mode only):
+        the task enters the table with the NEXT index for its job, the
+        offer tap re-opens, and its registration is served by the
+        dynamic rendezvous.  The cluster generation current NOW is
+        stamped on the task — a later rollout bump must not re-brand a
+        launch that predates it."""
+        if not self.dynamic:
+            raise ClusterError("add_task requires dynamic=True")
+        with self._lock:
+            if self._stopped:
+                raise ClusterError("scheduler stopped")
+            if self._fatal:
+                raise ClusterError(self._fatal)
+            index = self._dyn_index.get(job_name, 0)
+            self._dyn_index[job_name] = index + 1
+            task = Task(job_name, index, cpus=cpus, mem=mem,
+                        chips=chips, cmd=cmd, volumes=self.volumes)
+            task.dynamic = True
+            task.generation = self.generation
+            self.tasks.append(task)
+        self.log.info("dynamic task added: %s (generation %d)", task,
+                      task.generation)
+        self._revive_backend("add_task")
+        return task
+
+    def remove_task(self, task_id: str) -> bool:
+        """Kill ONE task at runtime and forget it (dynamic mode only).
+        Its terminal status then reports under an id no longer in the
+        table and is ignored — deliberate: the removal was OUR
+        decision, not a failure to react to."""
+        if not self.dynamic:
+            raise ClusterError("remove_task requires dynamic=True")
+        with self._lock:
+            task = self._find_task(task_id)
+            if task is not None:
+                self.tasks.remove(task)
+        if task is None:
+            return False
+        self.log.info("dynamic task removed: %s", task)
+        try:
+            self.backend.kill(task_id)
+        except Exception as e:
+            self.log.warning("dynamic kill of %s failed: %s",
+                             task_id[:8], e)
+        return True
+
+    def tasks_of(self, job_name: str) -> List[Task]:
+        """Live tasks of one job (dynamic tiers poll this to converge
+        actual toward target)."""
+        with self._lock:
+            return [t for t in self.tasks if t.job_name == job_name]
+
+    def task_by_index(self, job_name: str, task_index: int) -> Optional[Task]:
+        with self._lock:
+            for t in self.tasks:
+                if t.job_name == job_name and t.task_index == task_index:
+                    return t
+        return None
+
+    def bump_generation(self) -> int:
+        """Advance the fencing epoch (a blue-green rollout's shift
+        token): tasks added AFTER the bump launch — and register — with
+        the new generation; stragglers of older generations can be
+        fenced at the registry."""
+        with self._lock:
+            self.generation += 1
+            return self.generation
+
+    def _dynamic_accept_loop(self) -> None:
+        """Post-start rendezvous: accept registrations forever and hand
+        each dynamic task its config per-connection — a Mode-B serving
+        task only needs its OWN config to exec, so there is no gang
+        barrier here."""
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return          # listener closed (stop())
+            with self._lock:
+                stopped = self._stopped
+            if stopped:
+                # The shutdown poke (wire.wake_listener), not a task.
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            threading.Thread(target=self._dynamic_handshake, args=(conn,),
+                             name="dynamic-register", daemon=True).start()
+
+    def _dynamic_config(self, task: Task) -> Dict[str, Any]:
+        """The per-task config a dynamic registration receives — the
+        same shape the gang broadcast sends, with membership computed
+        from the live table (Mode-B serving tasks only read the env
+        contract and ``cmd``)."""
+        with self._lock:
+            world = len(self.tasks)
+            try:
+                rank = self.tasks.index(task)
+            except ValueError:
+                rank = 0
+            cluster_def: Dict[str, List[str]] = {}
+            for t in self.tasks:
+                cluster_def.setdefault(t.job_name, []).append(t.addr or "")
+            gen = getattr(task, "generation", self.generation)
+        return {
+            "job_name": task.job_name, "task_index": task.task_index,
+            "rank": rank, "world_size": world, "cpus": task.cpus,
+            "mem": task.mem, "chips": task.chips, "cmd": task.cmd,
+            "cwd": os.getcwd(), "cluster_def": cluster_def,
+            "generation": gen, "coordinator": "",
+            "forward_addresses": self.forward_addresses,
+            "extra_config": self.extra_config, "protocol": self.protocol,
+            "mesh_axes": self.mesh_axes or {}, "env": self.env,
+        }
+
+    def _dynamic_handshake(self, conn: socket.socket) -> None:
+        """Serve ONE dynamic registration: validate (unknown/stale ids
+        and stale generations dropped, exactly like the gang path),
+        send the config, await the ack, mark the task initialized."""
+        try:
+            conn.settimeout(30.0)
+            msg = wire.recv_msg(conn, self.token)
+            if not (isinstance(msg, dict) and msg.get("op") == "register"):
+                self.log.warning("unexpected dynamic rendezvous "
+                                 "message: %r", msg)
+                return
+            task_id = msg.get("task_id", "")
+            with self._lock:
+                task = self._find_task(task_id)
+                expect_gen = (getattr(task, "generation", self.generation)
+                              if task is not None else None)
+            if task is None:
+                self.log.warning("dynamic registration from unknown/stale "
+                                 "task id %s", task_id)
+                return
+            gen = msg.get("gen")
+            if gen is not None:
+                try:
+                    gen = int(gen)
+                except (TypeError, ValueError):
+                    gen = -1
+                if gen != expect_gen:
+                    self.log.warning(
+                        "dropping stale-generation dynamic registration "
+                        "from task id %s (gen %s, expected %s)", task_id,
+                        msg.get("gen"), expect_gen)
+                    return
+            wire.send_msg(conn, self._dynamic_config(task), self.token)
+            ack = wire.recv_msg(conn, self.token)
+            if ack != "ok":
+                self.log.warning("dynamic task %s failed to ack: %r",
+                                 task, ack)
+                return
+            with self._lock:
+                task.addr = msg.get("addr")
+                task.initialized = True
+            self.log.info("dynamic task registered: %s", task)
+        except (OSError, wire.WireError) as e:
+            self.log.warning("dynamic registration failed: %s", e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     # -- bring-up ----------------------------------------------------------
 
     def start(self) -> None:
@@ -723,6 +940,14 @@ class TPUMesosScheduler:
         except Exception:
             self.stop()
             raise
+        if self.dynamic:
+            # From here on registrations are served continuously: tasks
+            # added by add_task() dial the same rendezvous address and
+            # get their config per-connection, no gang barrier.
+            t = threading.Thread(target=self._dynamic_accept_loop,
+                                 name="dynamic-rendezvous", daemon=True)
+            t.start()
+            self._dynamic_thread = t
 
     def _form_gang(self) -> None:
         """Run the rendezvous loop until every task registered, then
@@ -827,6 +1052,14 @@ class TPUMesosScheduler:
         where a socket error in _start_tf_cluster aborts bring-up).
         """
         with self._lock:
+            if not self.tasks:
+                # Dynamic mode may start with an EMPTY table: there is no
+                # gang to broadcast to; tasks added later get their
+                # config per-registration from the dynamic rendezvous.
+                self.started = True
+                self.log.info("cluster started empty (dynamic): tasks "
+                              "join at runtime via add_task()")
+                return
             self._broadcasting = True
             # Snapshot connections under the lock: the revive path can close
             # and null task.connection from the status-watcher thread.
@@ -1151,6 +1384,16 @@ class TPUMesosScheduler:
         if (self._elastic_thread is not None
                 and self._elastic_thread is not threading.current_thread()):
             self._elastic_thread.join(timeout=5.0)
+        if self._dynamic_thread is not None and self._listen is not None:
+            # close() alone does not interrupt a blocked accept():
+            # poke the rendezvous awake so the dynamic accept loop
+            # exits NOW instead of burning its whole join timeout.
+            wire.wake_listener(self._listen)
+            try:
+                self._listen.close()
+            except OSError:
+                pass
+            self._dynamic_thread.join(timeout=5.0)
         for task in self.tasks:
             if task.connection is not None:
                 try:

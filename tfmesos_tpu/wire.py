@@ -335,6 +335,30 @@ def bind_ephemeral(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
     return sock
 
 
+def wake_listener(sock: Optional[socket.socket]) -> None:
+    """Wake a thread blocked in ``accept()`` on this listening socket.
+
+    ``close()`` alone does NOT interrupt a blocked ``accept`` on Linux —
+    the syscall stays parked until the next real connection, so every
+    ``stop()`` that merely closed its listener used to burn the full
+    thread-join timeout (2-5s per component; whole seconds of pure
+    teardown per fleet test).  A throwaway self-connection makes the
+    accept return; the loop re-checks its stop flag and exits.  Call
+    AFTER setting the stop flag and BEFORE closing the socket."""
+    if sock is None:
+        return
+    try:
+        host, port = sock.getsockname()[:2]
+        if host == "0.0.0.0":
+            host = "127.0.0.1"
+        elif host == "::":
+            host = "::1"
+        poke = socket.create_connection((host, port), timeout=0.5)
+        poke.close()
+    except OSError:
+        pass
+
+
 def sock_addr(sock: socket.socket, advertise_host: Optional[str] = None) -> str:
     host, port = sock.getsockname()[:2]
     if advertise_host:
