@@ -1,0 +1,173 @@
+"""Priority-class admission (tfmesos_tpu/fleet/admission.py): per-class
+bounded queues, weighted-fair dispatch, and the shed-ordering contract —
+all jax-free (fake clocks where time matters), so the WFQ policy is
+asserted deterministically, not probabilistically."""
+
+import threading
+
+import pytest
+
+from tfmesos_tpu.fleet.admission import (AdmissionController, Overloaded,
+                                         PriorityClass, RateLimited)
+
+
+def _classes():
+    return [PriorityClass("interactive", weight=4.0, rank=1),
+            PriorityClass("background", weight=1.0, rank=0)]
+
+
+def test_wfq_weighted_share_in_dispatch_order():
+    """With both queues saturated, dispatch interleaves ~weight-
+    proportionally: a weight-4 class gets ~4 of every 5 slots — never
+    strict priority (which would starve) and never FIFO (which would
+    let the flood win)."""
+    adm = AdmissionController(max_queue=64, classes=_classes())
+    for i in range(20):
+        adm.admit(("bg", i), cls="background")
+    for i in range(20):
+        adm.admit(("hi", i), cls="interactive")
+    first10 = [adm.get(timeout=0)[0] for _ in range(10)]
+    # 4:1 service ratio => 8 of the first 10 are interactive.
+    assert first10.count("hi") == 8, first10
+    # FIFO within each class.
+    order = [adm.get(timeout=0) for _ in range(30)]
+    hi = [item for item in first10 if item[0] == "hi"] + \
+        [item for item in order if item[0] == "hi"]
+    assert [i for _, i in hi] == sorted(i for _, i in hi)
+
+
+def test_wfq_starvation_bound():
+    """A background item enqueued into an interactive flood is served
+    within ~weight-ratio dispatches of its arrival — the WFQ no-
+    starvation guarantee, deterministically."""
+    adm = AdmissionController(max_queue=256, classes=_classes())
+    adm.admit("victim", cls="background")
+    for i in range(100):
+        adm.admit(i, cls="interactive")
+    served_before = 0
+    while True:
+        item = adm.get(timeout=0)
+        if item == "victim":
+            break
+        served_before += 1
+    # weight 4 vs 1: at most ~4 interactive dispatches may precede it.
+    assert served_before <= 4, served_before
+
+
+def test_continuous_flood_cannot_starve_the_other_class():
+    """Interleaved steady-state: keep the interactive queue topped up
+    while background holds one item — background still gets its ~1/5
+    share over a window instead of waiting for the flood to end."""
+    adm = AdmissionController(max_queue=256, classes=_classes())
+    bg_served = 0
+    adm.admit("bg0", cls="background")
+    for step in range(50):
+        adm.admit(step, cls="interactive")     # flood never lets up
+        item = adm.get(timeout=0)
+        if isinstance(item, str):
+            bg_served += 1
+            adm.admit(f"bg{step}", cls="background")
+    assert 50 // 5 - 2 <= bg_served, bg_served
+
+
+def test_per_class_queue_bounds_and_shed_counters():
+    """One class at its bound sheds THERE, without costing the other
+    class capacity; the per-class shed counters record it."""
+    classes = [PriorityClass("interactive", weight=4.0, rank=1,
+                             max_queue=8),
+               PriorityClass("background", weight=1.0, rank=0,
+                             max_queue=2)]
+    adm = AdmissionController(max_queue=8, classes=classes)
+    adm.admit("b1", cls="background")
+    adm.admit("b2", cls="background")
+    with pytest.raises(Overloaded) as e:
+        adm.admit("b3", cls="background")
+    assert "background" in str(e.value)
+    for i in range(8):          # interactive capacity is untouched
+        adm.admit(i, cls="interactive")
+    with pytest.raises(Overloaded):
+        adm.admit(9, cls="interactive")
+    sheds = adm.shed_counts()
+    assert sheds["background"] == (1, 0)
+    assert sheds["interactive"] == (1, 0)
+    assert adm.class_depths() == {"interactive": 8, "background": 2}
+    assert adm.depth() == 10
+
+
+def test_shed_does_not_burn_a_token():
+    """Regression (PR 7 satellite): the queue-full check must run
+    BEFORE the token bucket debit — an Overloaded shed used to also
+    burn a token, double-penalizing clients exactly when the gateway
+    was overloaded."""
+    t = [0.0]
+    adm = AdmissionController(max_queue=1, rate=10.0, burst=1.0,
+                              clock=lambda: t[0])
+    adm.admit("a")                      # spends the single burst token
+    t[0] += 0.1                         # refills exactly one token
+    with pytest.raises(Overloaded) as e:
+        adm.admit("b")                  # queue full: shed...
+    assert not isinstance(e.value, RateLimited)
+    assert adm.get(timeout=0) == "a"
+    adm.admit("b")                      # ...without having burned the token
+    assert adm.depth() == 1
+
+
+def test_rate_limit_still_sheds_after_capacity_check():
+    t = [0.0]
+    adm = AdmissionController(max_queue=8, rate=1.0, burst=1.0,
+                              clock=lambda: t[0])
+    adm.admit("a")
+    with pytest.raises(RateLimited):
+        adm.admit("b")
+    sheds = adm.shed_counts()
+    assert sheds["default"] == (0, 1)
+
+
+def test_unlabeled_and_unknown_labels_ride_the_first_class():
+    adm = AdmissionController(max_queue=8, classes=_classes())
+    assert adm.resolve(None).name == "interactive"
+    assert adm.resolve("no-such-tenant").name == "interactive"
+    assert adm.resolve("background").rank == 0
+    assert adm.resolve("interactive").rank == 1
+    adm.admit("x")                      # unlabeled admits fine
+    assert adm.class_depths()["interactive"] == 1
+
+
+def test_single_class_degenerates_to_fifo():
+    adm = AdmissionController(max_queue=4)
+    for i in range(4):
+        adm.admit(i)
+    assert [adm.get(timeout=0) for _ in range(4)] == [0, 1, 2, 3]
+    assert adm.get(timeout=0.01) is None
+
+
+def test_get_blocks_until_admit_and_respects_timeout():
+    adm = AdmissionController(max_queue=4, classes=_classes())
+    out = []
+
+    def worker():
+        out.append(adm.get(timeout=5.0))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    adm.admit("late", cls="background")
+    t.join(timeout=5.0)
+    assert out == ["late"]
+
+
+def test_class_validation():
+    with pytest.raises(ValueError):
+        PriorityClass("", weight=1.0)
+    with pytest.raises(ValueError):
+        PriorityClass("x", weight=0.0)
+    # NaN poisons every WFQ tag comparison; inf's zero tag increment
+    # would starve every other class — both must be rejected up front.
+    with pytest.raises(ValueError):
+        PriorityClass("x", weight=float("nan"))
+    with pytest.raises(ValueError):
+        PriorityClass("x", weight=float("inf"))
+    with pytest.raises(ValueError):
+        PriorityClass("x", max_queue=0)
+    with pytest.raises(ValueError):
+        AdmissionController(classes=[PriorityClass("a"),
+                                     PriorityClass("a")])

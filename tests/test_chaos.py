@@ -591,3 +591,112 @@ def test_e2e_mode_b_elastic_relaunch(tmp_path):
             time.sleep(0.05)
         assert c.generation == 1
         assert c.cluster_restarts == 1
+
+
+# ---------------------------------------------------------------------------
+# Drain migration under injected faults (stub fleet, no JAX): a seeded
+# fault mid-KV-transfer must end in completed-elsewhere or a loud
+# deterministic failure — never a hung client or a dropped request.
+
+
+#: the request every migration chaos test routes: long enough for one
+#: full page-aligned chunk, so the victim's advertised prefix summary
+#: steers the router's FIRST pick to it deterministically (affinity
+#: beats p2c — with three alive replicas the p2c sample is random).
+_MIG_PROMPT = list(range(16))
+
+
+def _migration_stub_fleet():
+    """Registry + a drain-migration victim (always answers generate
+    with a suspended KV export; advertises prefix affinity for
+    ``_MIG_PROMPT`` so the first pick lands on it deterministically) +
+    two resume-capable survivors, in a deterministic registration order
+    (the router's resume tie-breaks follow it)."""
+    from test_fleet import (_stub_resume_replica, _stub_suspending_replica,
+                            _summary_for, _suspended_meta, _wait)
+
+    from tfmesos_tpu.fleet.registry import ReplicaRegistry
+
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=0.5, dead_after=1.0,
+                          evict_after=5.0, sweep_interval=0.05).start()
+    servers = []
+    victim = _stub_suspending_replica(
+        token, reg.addr, _suspended_meta(), body=b"\xab" * 2048,
+        prefix_summary=_summary_for(np.asarray(_MIG_PROMPT, np.int32)))
+    servers.append(victim)
+    assert _wait(lambda: len(reg.alive()) == 1)
+    t1, got1 = _stub_resume_replica(token, reg.addr)
+    servers.append(t1)
+    assert _wait(lambda: len(reg.alive()) == 2)
+    t2, got2 = _stub_resume_replica(token, reg.addr)
+    servers.append(t2)
+    assert reg.wait_for(3, timeout=5.0)
+    return token, reg, servers, victim, (t1, got1), (t2, got2)
+
+
+@pytest.mark.parametrize("action", ["sever", "truncate", "drop"])
+def test_migration_kv_transfer_fault_completes_elsewhere(action):
+    """The suspended artifact's raw KV frame to the first resume target
+    is severed / truncated / silently dropped mid-transfer: the router
+    classifies the failure (link loss -> mark dead; drop -> call
+    timeout), retries the SAME artifact on the second survivor, and the
+    caller gets the resumed completion — the fault costs a retry, never
+    the request."""
+    from tfmesos_tpu.fleet.metrics import FleetMetrics
+    from tfmesos_tpu.fleet.router import Router
+
+    token, reg, servers, victim, (t1, got1), (t2, got2) = \
+        _migration_stub_fleet()
+    plan = FaultPlan([Fault(action, "wire.send", target=t1.addr, nth=1)],
+                     seed=11)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01,
+                    request_timeout=2.0)
+    try:
+        with plan.installed():
+            out = router.route({"op": "generate",
+                                "prompt": list(_MIG_PROMPT),
+                                "max_new_tokens": 4})
+        assert out["tokens"] == [4, 9, 2, 5]    # resumed mid-stream
+        assert not got1 and len(got2) == 1      # completed ELSEWHERE
+        assert [f[2] for f in plan.fired] == [action]
+        assert metrics.get("migration_exports") == 1
+        assert metrics.get("migration_resumes") == 1
+        assert metrics.get("retries") >= 1
+    finally:
+        router.close()
+        for s in servers:
+            s.stop()
+        reg.stop()
+
+
+def test_migration_victim_link_severed_reruns_elsewhere():
+    """The victim's link dies the moment the drain-migration touches it
+    (the process-kill stand-in, via the iter_msgs recv hook): the
+    router marks it dead and the request RE-RUNS deterministically on a
+    survivor — completed elsewhere, nothing lost, nothing hung."""
+    from tfmesos_tpu.fleet.metrics import FleetMetrics
+    from tfmesos_tpu.fleet.router import Router
+
+    token, reg, servers, victim, (t1, got1), (t2, got2) = \
+        _migration_stub_fleet()
+    plan = FaultPlan([Fault("sever", "wire.recv", target=victim.addr,
+                            nth=1)], seed=12)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01,
+                    request_timeout=2.0)
+    try:
+        with plan.installed():
+            out = router.route({"op": "generate",
+                                "prompt": list(_MIG_PROMPT),
+                                "max_new_tokens": 2})
+        assert out["tokens"] == [9]             # plain re-run path
+        assert not got1 or not got2             # no double raw import
+        assert ("wire.recv", victim.addr, "sever", 1) in plan.fired
+        assert metrics.get("retries") >= 1
+    finally:
+        router.close()
+        for s in servers:
+            s.stop()
+        reg.stop()

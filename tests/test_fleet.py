@@ -1207,6 +1207,66 @@ def test_fleet_prefix_affinity_end_to_end(fleet, tiny_offline):
     client.close()
 
 
+def test_fleet_drain_migration_no_lost_requests(fleet, tiny_offline):
+    """e2e drain-migrate-kill slice over the live fixture fleet: pin a
+    control-plane drain on the replica that actually has work in
+    flight, ask it to migrate — every request still completes with the
+    EXACT offline-greedy stream (resumed mid-stream on the survivor, or
+    deterministically re-run), zero failures.  The drain is released
+    afterwards so the fixture fleet is unchanged for later tests."""
+    cfg, offline = tiny_offline
+    prompts = _e2e_prompts(cfg, 6, seed=17)
+    wants = [24 + (i % 4) for i in range(6)]
+    client = fleet.client(timeout=300.0)
+    for p in prompts[:2]:                   # compiles off the hot window
+        client.generate(p, 2)
+    results = [None] * 6
+    errors = []
+
+    def one(i):
+        try:
+            results[i] = client.generate(prompts[i], wants[i],
+                                         timeout=300.0)
+        except Exception as e:              # collected, not raised
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+    victim = None
+    try:
+        for t in threads:
+            t.start()
+        # The victim must be a replica with router-visible in-flight
+        # work, or the migration would have nothing to move.
+        assert _wait(lambda: any(
+            fleet.router.outstanding(r.addr) > 0
+            for r in fleet.registry.alive()), timeout=30.0)
+        victim = max(fleet.registry.alive(),
+                     key=lambda r: fleet.router.outstanding(r.addr)).addr
+        assert fleet.registry.begin_drain(victim, pinned=True)
+        assert fleet.request_migration(victim)
+    finally:
+        for t in threads:
+            t.join(timeout=300.0)
+    assert not errors, errors
+    assert all(not t.is_alive() for t in threads)
+    for i in range(6):
+        assert results[i]["tokens"] == offline(prompts[i], wants[i]), \
+            f"request {i} diverged across the migration"
+    c = fleet.snapshot()["counters"]
+    assert c.get("migrations_requested", 0) >= 1
+    # The victim actually handed work back, and nothing was failed.
+    assert c.get("migration_exports", 0) >= 1
+    assert c.get("migration_resumes", 0) \
+        + c.get("migration_reruns", 0) >= 1
+    # Restore the fixture: release the drain; the victim's next beat
+    # revives it.
+    fleet.registry.clear_drain(victim)
+    assert _wait(lambda: len(fleet.registry.alive()) == N_E2E_REPLICAS,
+                 timeout=30.0)
+    client.close()
+
+
+
 def test_fleet_replica_death_mid_stream_retries_on_survivor(
         fleet, tiny_offline):
     """Acceptance: SIGKILL one replica while requests are in flight —
@@ -1375,3 +1435,225 @@ def test_fleet_warmup_relaunch_rewarms_before_traffic(tiny_offline):
             except OSError:
                 pass
         fs.stop()
+
+
+# -- drain migration: suspended replies re-placed by the router -------------
+# (stub replicas, no JAX — the re-placement policy is model-agnostic)
+
+
+def _suspended_meta(gen=0, version="", step=3, tokens=(4, 9, 2)):
+    """A suspended-export meta header shaped like the replica's (the
+    router treats everything but op/id/gen/weights_version as opaque
+    artifact state to forward)."""
+    return {"op": "suspended", "gen": gen, "weights_version": version,
+            "version": 1, "page_size": 16, "prefix_len": 0,
+            "shared_len": 0, "pos": 5, "prompt_len": 3,
+            "first_token": tokens[0], "step": step,
+            "tokens": list(tokens), "rid": 0, "quantized": False,
+            "arrays": []}
+
+
+def _stub_suspending_replica(token, registry_addr, meta, body=None,
+                             version=None, prefix_summary=None):
+    """A drain-migration victim: answers every generate with a
+    ``suspended`` reply — a raw artifact frame when ``body`` is given,
+    else the plain requeue marker.  ``prefix_summary`` lets a test
+    steer the router's FIRST pick here deterministically (affinity
+    beats p2c) when more than two replicas are alive."""
+
+    def handler(msg, reply):
+        mid = (msg.meta if isinstance(msg, wire.RawFrame) else msg).get("id")
+        if body is not None:
+            reply(wire.RawFrame(dict(meta, id=mid), body))
+        else:
+            reply(dict(meta, id=mid, requeue=True))
+
+    def extra():
+        beat = {}
+        if version:
+            beat["weights_version"] = version
+        if prefix_summary is not None:
+            beat["prefix_cache"] = prefix_summary
+        return beat
+
+    return ReplicaServer(handler, token=token, capacity=4,
+                         registry_addr=registry_addr,
+                         heartbeat_interval=0.05, extra_info=extra).start()
+
+
+def _stub_resume_replica(token, registry_addr, version=None, got=None):
+    """A migration target: resumes raw generate imports (completion =
+    the artifact's tokens + one more) and serves plain generates with
+    canned tokens (the rerun path)."""
+    got = got if got is not None else []
+
+    def handler(msg, reply):
+        if isinstance(msg, wire.RawFrame):
+            got.append(msg)
+            reply({"op": "completion", "id": msg.meta.get("id"),
+                   "tokens": list(msg.meta.get("tokens") or ()) + [5],
+                   "ttft_ms": 0.5, "total_ms": 2.0})
+            return
+        reply({"op": "completion", "id": msg.get("id"), "tokens": [9],
+               "ttft_ms": 1.0, "total_ms": 2.0})
+
+    extra = (lambda: {"weights_version": version}) if version else None
+    server = ReplicaServer(handler, token=token, capacity=4,
+                           registry_addr=registry_addr,
+                           heartbeat_interval=0.05,
+                           extra_info=extra).start()
+    return server, got
+
+
+def test_router_resumes_suspended_export_on_survivor(stub_fleet):
+    """The tox-lint migration smoke: a victim's suspended KV export is
+    re-placed on a same-version survivor as one raw frame (artifact
+    state forwarded verbatim, transport fields rebuilt), and the caller
+    sees one completion continuing the suspended stream."""
+    token, reg, servers = stub_fleet
+    body = b"\xbb" * 512
+    servers.append(_stub_suspending_replica(
+        token, reg.addr, _suspended_meta(version="v1"), body=body,
+        version="v1"))
+    assert _wait(lambda: len(reg.alive()) == 1)
+    dec, got = _stub_resume_replica(token, reg.addr, version="v1")
+    servers.append(dec)
+    assert reg.wait_for(2, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        out = router.route({"op": "generate", "prompt": [1, 2, 3],
+                            "max_new_tokens": 8})
+        assert out["tokens"] == [4, 9, 2, 5]    # resumed, not re-run
+        assert len(got) == 1
+        meta = got[0].meta
+        assert meta["op"] == "generate"
+        assert meta["prompt"] == [1, 2, 3]
+        assert meta["max_new_tokens"] == 8
+        assert meta["step"] == 3 and meta["tokens"] == [4, 9, 2]
+        assert "gen" not in meta and "weights_version" not in meta
+        assert got[0].body == body
+        assert metrics.get("migration_exports") == 1
+        assert metrics.get("migration_resumes") == 1
+        assert metrics.get("migration_reruns") == 0
+    finally:
+        router.close()
+
+
+def test_router_requeue_marker_reruns_elsewhere(stub_fleet):
+    """A suspended reply WITHOUT an artifact (nothing resumable) makes
+    the router re-run the whole request on a survivor — lossless via
+    determinism, never an error to the client."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_suspending_replica(
+        token, reg.addr, {"op": "suspended", "gen": 0}))
+    assert _wait(lambda: len(reg.alive()) == 1)
+    dec, got = _stub_resume_replica(token, reg.addr)
+    servers.append(dec)
+    assert reg.wait_for(2, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        out = router.route({"op": "generate", "prompt": [7],
+                            "max_new_tokens": 2})
+        assert out["tokens"] == [9]             # re-run, plain path
+        assert not got                          # no raw resume attempted
+        assert metrics.get("migration_exports") == 1
+        assert metrics.get("migration_reruns") == 1
+    finally:
+        router.close()
+
+
+def test_router_fences_stale_suspended_export(stub_fleet):
+    """A suspended export stamped with a reaped (fenced) generation is
+    NEVER re-imported — the zombie's stale-weights KV cannot land; the
+    request re-runs on a survivor instead."""
+    token, reg, servers = stub_fleet
+    reg.fence_generation(5)
+    servers.append(_stub_suspending_replica(
+        token, reg.addr, _suspended_meta(gen=3, version="v1"),
+        body=b"\xcc" * 64, version="v1"))
+    assert _wait(lambda: len(reg.alive()) == 1)
+    dec, got = _stub_resume_replica(token, reg.addr, version="v1")
+    servers.append(dec)
+    assert reg.wait_for(2, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        out = router.route({"op": "generate", "prompt": [7],
+                            "max_new_tokens": 2})
+        assert out["tokens"] == [9]             # re-run, never resumed
+        assert not got
+        assert metrics.get("migration_fenced") == 1
+        assert metrics.get("migration_resumes") == 0
+    finally:
+        router.close()
+
+
+def test_router_resume_requires_matching_weights_version(stub_fleet):
+    """KV pages computed under one weights_version must never feed a
+    decode under another: with no same-version survivor the router
+    re-runs the request instead of resuming onto mismatched weights."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_suspending_replica(
+        token, reg.addr, _suspended_meta(version="v1"),
+        body=b"\xdd" * 64, version="v1"))
+    assert _wait(lambda: len(reg.alive()) == 1)
+    dec, got = _stub_resume_replica(token, reg.addr, version="v2")
+    servers.append(dec)
+    assert reg.wait_for(2, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        out = router.route({"op": "generate", "prompt": [7],
+                            "max_new_tokens": 2})
+        assert out["tokens"] == [9]             # re-run on the v2 tier
+        assert not got
+        assert metrics.get("migration_reruns") == 1
+    finally:
+        router.close()
+
+
+def test_gateway_priority_classes_rank_and_metrics(stub_fleet):
+    """The gateway maps the request's class label to the class table:
+    the class RANK rides to the replica (batcher preemption), the shed
+    and queue-wait metrics split per class, and unlabeled requests take
+    the first-listed class."""
+    from tfmesos_tpu.fleet.admission import PriorityClass
+
+    token, reg, servers = stub_fleet
+    seen = []
+
+    def handler(msg, reply):
+        seen.append(msg.get("priority"))
+        reply({"op": "completion", "id": msg.get("id"), "tokens": [1],
+               "ttft_ms": 1.0, "total_ms": 2.0})
+
+    servers.append(ReplicaServer(handler, token=token, capacity=4,
+                                 registry_addr=reg.addr,
+                                 heartbeat_interval=0.05).start())
+    assert reg.wait_for(1, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    adm = AdmissionController(
+        max_queue=8,
+        classes=[PriorityClass("interactive", weight=4.0, rank=1),
+                 PriorityClass("background", weight=1.0, rank=0)])
+    gw = Gateway(router, adm, metrics, token=token, workers=2).start()
+    try:
+        client = FleetClient(gw.addr, token)
+        client.generate([1], 1)                         # unlabeled
+        client.generate([1], 1, priority="background")
+        client.generate([1], 1, priority="interactive")
+        client.generate([1], 1, priority="no-such-class")
+        assert seen.count(1) == 3 and seen.count(0) == 1
+        snap = client.metrics()
+        hists = snap["histograms"]
+        assert hists["queue_wait_ms"]["count"] == 4
+        assert hists["queue_wait_ms_interactive"]["count"] == 3
+        assert hists["queue_wait_ms_background"]["count"] == 1
+        assert snap["gauges"]["queue_depths"] == {
+            "interactive": 0, "background": 0}
+        client.close()
+    finally:
+        gw.stop()

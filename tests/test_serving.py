@@ -1668,3 +1668,191 @@ def test_prefix_cache_cow_falls_back_on_tight_pool(setup):
     st = warm.prefix_cache_stats()
     assert st["cow_copies"] == 0 and st["hits"] == 1
     assert st["hit_pages"] == 2     # trimmed from the full 3-page match
+
+
+# -- priority preemption & suspend/resume (docs/SERVING.md "Priorities,
+# preemption & migration") --------------------------------------------------
+
+
+def _preempt_variant_kw(variant):
+    """The equivalence-matrix configs the suspend/resume contract must
+    hold across (greedy/sampled, int8 kv pool, chunked prefill, prefix
+    cache)."""
+    import jax
+
+    kw = dict(rows=1, max_len=64, page_size=16, prefill_bucket=16)
+    if variant == "sampled":
+        kw.update(temperature=0.8, top_k=20, rng=jax.random.PRNGKey(7))
+    elif variant == "int8":
+        kw.update(quantized_cache=True)
+    elif variant == "chunked":
+        kw.update(prefill_chunk=16)
+    elif variant == "pcache":
+        kw.update(prefix_cache_pages=8)
+    return kw
+
+
+@pytest.mark.parametrize("variant",
+                         ["greedy", "sampled", "int8", "chunked",
+                          "pcache"])
+def test_preempt_resume_token_identical(setup, variant):
+    """THE preemption/migration acceptance: with rows=1, a higher-
+    priority arrival deterministically SUSPENDS the resident row (its
+    KV exports, its pages free); preempt_all() then hands every
+    in-flight request back as a Suspended artifact, which a SECOND
+    batcher (the migration target) resumes — and every stream equals
+    the uninterrupted same-rid reference exactly, across the matrix
+    configs."""
+    import threading
+    import time as _time
+
+    from tfmesos_tpu.serving import Prefilled, Suspended
+
+    cfg, params = setup
+    kw = _preempt_variant_kw(variant)
+    rng = np.random.RandomState(31)
+    pA, pB = (rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
+              for n in (9, 7))
+    # Reference: same admission order, same rids, equal priorities —
+    # no preemption, rows=1 serves A to completion, then B.
+    refb = ContinuousBatcher(cfg, params, **kw)
+    refs = {c.rid: c.tokens for c in refb.run(
+        [Request(prompt=pA.copy(), max_new_tokens=12),
+         Request(prompt=pB.copy(), max_new_tokens=24)])}
+
+    b1 = ContinuousBatcher(cfg, params, **kw)
+    A = Request(prompt=pA.copy(), max_new_tokens=12, priority=0)
+    B = Request(prompt=pB.copy(), max_new_tokens=24, priority=5)
+    streams, susp = {}, []
+
+    def drive():
+        for c in b1.serve():
+            if isinstance(c, Suspended):
+                susp.append(c)
+            else:
+                streams[c.rid] = c.tokens
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    b1.submit(A)        # rid 0, admitted first
+    b1.submit(B)        # rid 1, outranks A -> suspends it mid-stream
+    deadline = _time.monotonic() + 120.0
+    while b1.preemptions < 1:
+        assert _time.monotonic() < deadline, "preemption never happened"
+        _time.sleep(0.005)
+    # Drain-migration: everything still in flight (B mid-decode, A
+    # parked) comes back as Suspended artifacts.
+    b1.preempt_all()
+    b1.close()
+    t.join(timeout=300.0)
+    assert not t.is_alive()
+    assert b1.preemptions >= 1
+    arts = {s.rid: s for s in susp}
+    assert arts, "preempt_all returned nothing to migrate"
+    assert all(s.artifact is not None for s in susp), susp
+    # A was suspended mid-stream: its artifact carries emitted tokens.
+    assert arts[0].artifact["step"] > 1
+    assert arts[0].artifact["tokens"] == \
+        refs[0][:arts[0].artifact["step"]]
+    # The migration target: a fresh batcher importing the artifacts.
+    b2 = ContinuousBatcher(cfg, params, **{**kw, "rows": 2})
+    for c in b2.run([Prefilled(s.request, s.artifact)
+                     for _, s in sorted(arts.items())]):
+        streams[c.rid] = c.tokens
+    assert streams == refs, f"{variant}: resumed streams diverged"
+
+
+def test_preempt_strictness_and_parked_resume(setup):
+    """Equal priorities never preempt (anti-thrash), and a preempted
+    row RESUMES locally — token-identically — once the outranking work
+    finishes."""
+    import threading
+    import time as _time
+
+    cfg, params = setup
+    kw = dict(rows=1, max_len=64, page_size=16, prefill_bucket=16)
+    rng = np.random.RandomState(33)
+    pA, pB = (rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
+              for n in (8, 6))
+    refb = ContinuousBatcher(cfg, params, **kw)
+    refs = {c.rid: c.tokens for c in refb.run(
+        [Request(prompt=pA.copy(), max_new_tokens=10),
+         Request(prompt=pB.copy(), max_new_tokens=4)])}
+
+    b = ContinuousBatcher(cfg, params, **kw)
+    done = {}
+
+    def drive():
+        for c in b.serve():
+            done[c.rid] = c
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    b.submit(Request(prompt=pA.copy(), max_new_tokens=10, priority=3))
+    b.submit(Request(prompt=pB.copy(), max_new_tokens=4, priority=5))
+    deadline = _time.monotonic() + 120.0
+    while b.resumes < 1:
+        assert _time.monotonic() < deadline, "parked row never resumed"
+        _time.sleep(0.005)
+    b.close()
+    t.join(timeout=300.0)
+    assert b.preemptions == 1 and b.resumes == 1
+    assert {rid: c.tokens for rid, c in done.items()} == refs
+    # Equal priorities: FIFO, no suspension.
+    b3 = ContinuousBatcher(cfg, params, **kw)
+    out = {c.rid: c.tokens for c in b3.run(
+        [Request(prompt=pA.copy(), max_new_tokens=10, priority=5),
+         Request(prompt=pB.copy(), max_new_tokens=4, priority=5)])}
+    assert b3.preemptions == 0
+    assert out == refs
+
+
+def test_suspended_artifact_validation(setup):
+    """A mid-stream artifact that does not match its request (or was
+    tampered with) is rejected LOUDLY at import — never a silently
+    wrong resumed stream."""
+    import threading
+    import time as _time
+
+    from tfmesos_tpu.serving import Prefilled, Suspended
+
+    cfg, params = setup
+    kw = dict(rows=1, max_len=64, page_size=16, prefill_bucket=16)
+    rng = np.random.RandomState(35)
+    p, pB = (rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
+             for n in (9, 6))
+    b = ContinuousBatcher(cfg, params, **kw)
+    req = Request(prompt=p, max_new_tokens=12, priority=0)
+    susp = []
+
+    def drive():
+        for c in b.serve():
+            if isinstance(c, Suspended):
+                susp.append(c)
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    b.submit(req)
+    # An outranking arrival suspends req deterministically mid-stream
+    # (the same trigger test_preempt_resume_token_identical relies on).
+    b.submit(Request(prompt=pB, max_new_tokens=24, priority=5))
+    deadline = _time.monotonic() + 120.0
+    while b.preemptions < 1:
+        assert _time.monotonic() < deadline, "preemption never happened"
+        _time.sleep(0.005)
+    b.preempt_all()
+    b.close()
+    t.join(timeout=300.0)
+    art = next(s.artifact for s in susp if s.request is req)
+    assert art is not None and art["step"] > 1
+    b2 = ContinuousBatcher(cfg, params, **kw)
+    b2.validate(Prefilled(req, art))            # the real one imports
+    bad = dict(art, tokens=list(art["tokens"][:-1]))
+    with pytest.raises(ValueError):
+        b2.validate(Prefilled(req, bad))        # tokens/step mismatch
+    bad = dict(art, step=art["step"] + 1)
+    with pytest.raises(ValueError):
+        b2.validate(Prefilled(req, bad))        # pos/step mismatch
+    with pytest.raises(ValueError):             # "finished" artifact
+        b2.validate(Prefilled(
+            Request(prompt=p, max_new_tokens=art["step"]), art))

@@ -221,8 +221,24 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-len", type=int, default=None,
                    help="per-request cache positions (default: model max)")
     p.add_argument("--max-queue", type=int, default=256,
-                   help="ingress queue bound; past it requests shed with "
-                        "an explicit Overloaded rejection")
+                   help="ingress queue bound (per class); past it "
+                        "requests shed with an explicit Overloaded "
+                        "rejection")
+    p.add_argument("--classes", type=str, default=None, metavar="SPEC",
+                   help="admission priority classes, highest first, "
+                        "e.g. 'interactive:8,background:1' "
+                        "(name:weight[:queue_bound]): each class gets "
+                        "its own bounded ingress queue served "
+                        "weighted-fair, and outranking requests may "
+                        "preempt lower-class rows inside the replicas; "
+                        "unlabeled requests ride the FIRST class "
+                        "(docs/SERVING.md 'Priorities, preemption & "
+                        "migration')")
+    p.add_argument("--no-migrate", action="store_false", dest="migrate",
+                   default=True,
+                   help="disable drain migration: scale-downs and "
+                        "rollouts wait for in-flight work instead of "
+                        "suspending it and resuming on survivors")
     p.add_argument("--rate", type=float, default=None,
                    help="token-bucket admission rate, requests/s "
                         "(default: unlimited)")
@@ -317,6 +333,108 @@ def parse_role_spec(spec: Optional[str]) -> dict:
     return out
 
 
+def parse_class_spec(spec: Optional[str]):
+    """``'interactive:8,background:1'`` → PriorityClass list, listed
+    highest-priority FIRST: the first class is the default for
+    unlabeled requests and gets the highest preemption rank; each entry
+    is ``name:weight[:queue_bound]``."""
+    from tfmesos_tpu.fleet.admission import PriorityClass
+
+    if not spec:
+        return None
+    entries = [part.strip() for part in spec.split(",") if part.strip()]
+    out = []
+    for i, part in enumerate(entries):
+        bits = part.split(":")
+        if len(bits) not in (2, 3) or not bits[0]:
+            raise ValueError(f"bad --classes entry {part!r}; want "
+                             f"name:weight[:queue_bound]")
+        try:
+            weight = float(bits[1])
+            maxq = int(bits[2]) if len(bits) == 3 else None
+        except ValueError:
+            raise ValueError(f"bad --classes numbers in {part!r}") from None
+        try:
+            out.append(PriorityClass(name=bits[0], weight=weight,
+                                     rank=len(entries) - 1 - i,
+                                     max_queue=maxq))
+        except ValueError as e:
+            raise ValueError(f"bad --classes entry {part!r}: {e}") from None
+    if len({c.name for c in out}) != len(out):
+        raise ValueError("duplicate class name in --classes")
+    return out
+
+
+def build_submit_parser() -> argparse.ArgumentParser:
+    """``tfserve submit`` — send one generation request to a RUNNING
+    fleet gateway (smoke/debug surface; real clients use
+    ``fleet.client.FleetClient``)."""
+    p = argparse.ArgumentParser(
+        prog="tfserve submit",
+        description="Submit one generation request to a running fleet "
+                    "gateway and print the completion.")
+    p.add_argument("-g", "--gateway", type=str, required=True,
+                   metavar="HOST:PORT", help="the running gateway")
+    p.add_argument("--prompt", type=str, required=True,
+                   help="comma-separated prompt token ids, e.g. '1,2,3'")
+    p.add_argument("-n", "--max-new-tokens", type=int, default=16,
+                   dest="max_new_tokens")
+    p.add_argument("--stop-token", type=int, default=None,
+                   dest="stop_token")
+    p.add_argument("--priority", type=str, default=None,
+                   help="admission class label (e.g. 'background'); "
+                        "unlabeled requests ride the fleet's default "
+                        "class")
+    p.add_argument("--timeout", type=float, default=300.0)
+    return p
+
+
+def submit_main(argv: List[str]) -> int:
+    args = build_submit_parser().parse_args(argv)
+    from tfmesos_tpu.fleet.admission import Overloaded
+    from tfmesos_tpu.fleet.client import FleetClient, RequestFailed
+
+    token = wire.load_token()
+    if not token:
+        print(f"tfserve submit: no cluster token — set {wire.TOKEN_ENV} "
+              f"or {wire.TOKEN_FILE_ENV} (tfserve printed the token "
+              f"file at startup)", file=sys.stderr)
+        return 2
+    try:
+        prompt = [int(t) for t in args.prompt.split(",") if t.strip()]
+    except ValueError:
+        print(f"tfserve submit: bad --prompt {args.prompt!r}; want "
+              f"comma-separated ints", file=sys.stderr)
+        return 2
+    if not prompt:
+        print("tfserve submit: --prompt is empty", file=sys.stderr)
+        return 2
+    client = None
+    try:
+        client = FleetClient(args.gateway, token, timeout=args.timeout)
+        out = client.generate(prompt, args.max_new_tokens,
+                              stop_token=args.stop_token,
+                              priority=args.priority)
+    except Overloaded as e:
+        print(f"tfserve submit: shed ({e.kind}): {e} — back off and "
+              f"retry", file=sys.stderr)
+        return 1
+    except RequestFailed as e:
+        print(f"tfserve submit: {e.kind}: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"tfserve submit: cannot reach gateway {args.gateway}: "
+              f"{e}", file=sys.stderr)
+        return 1
+    finally:
+        if client is not None:
+            client.close()
+    print(json.dumps({"tokens": out.get("tokens"),
+                      "ttft_ms": out.get("ttft_ms"),
+                      "total_ms": out.get("total_ms")}))
+    return 0
+
+
 def build_rollout_parser() -> argparse.ArgumentParser:
     """``tfserve rollout`` — drive a blue-green weight rollout on a
     RUNNING fleet through the gateway's authenticated control op."""
@@ -387,9 +505,12 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "rollout":
         return rollout_main(argv[1:])
+    if argv and argv[0] == "submit":
+        return submit_main(argv[1:])
     args = build_serve_parser().parse_args(argv)
     try:
         roles = parse_role_spec(args.role)
+        classes = parse_class_spec(args.classes)
     except ValueError as e:
         print(f"tfserve: {e}", file=sys.stderr)
         return 2
@@ -425,6 +546,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         gateway_host=args.gateway_host, gateway_port=args.gateway_port,
         workers=args.workers, max_queue=args.max_queue, rate=args.rate,
         burst=args.burst, max_retries=args.retries,
+        priority_classes=classes, migrate_on_drain=args.migrate,
         prefix_cache_pages=args.prefix_cache,
         pipeline_depth=args.pipeline_depth, warmup=args.warmup,
         report_interval=args.metrics_interval or None,

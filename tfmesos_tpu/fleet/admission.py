@@ -2,12 +2,26 @@
 
 Overload policy, in order of application:
 
-1. **Token-bucket rate limiter** (optional): a sustained requests/s cap
-   with a burst allowance.  Over-rate arrivals are rejected with
-   :class:`RateLimited` before they cost anything downstream.
-2. **Bounded ingress queue**: accepted requests wait here for a
-   dispatcher; when the queue is full the arrival is rejected with
-   :class:`Overloaded`.
+1. **Per-class bounded queues**: every request belongs to a priority
+   class (the ``priority``/``tenant`` label on the wire, mapped here);
+   each class has its own queue bound, and a full class sheds with
+   :class:`Overloaded` WITHOUT touching any other class's capacity — a
+   background flood fills the background queue and sheds there, while
+   interactive arrivals keep being admitted.
+2. **Token-bucket rate limiter** (optional): a sustained requests/s cap
+   with a burst allowance, checked only AFTER the queue-capacity check
+   so a shed never burns a token (an overloaded gateway must not
+   double-penalize clients).  Over-rate arrivals are rejected with
+   :class:`RateLimited`.
+
+Dispatch is **weighted fair queueing** across the classes: each
+admitted item gets a virtual-time finish tag ``max(vnow, class_last) +
+1/weight`` and :meth:`AdmissionController.get` always serves the
+smallest tag — so a class with weight ``w`` is guaranteed ~``w/Σw`` of
+dispatcher throughput whenever it has work, and no class can starve
+another no matter how hard it floods (the flood's tags race ahead of
+the victim's).  With one class (the default) this degenerates to the
+original FIFO queue exactly.
 
 Both rejections are EXPLICIT wire replies — the contract is "never a
 hang": a client always gets either a completion or an immediate
@@ -18,12 +32,14 @@ scale is indistinguishable from an outage.)
 
 from __future__ import annotations
 
-import queue
+import dataclasses
+import math
 import threading
 import time
-from typing import Any, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["Overloaded", "RateLimited", "TokenBucket",
+__all__ = ["Overloaded", "RateLimited", "TokenBucket", "PriorityClass",
            "AdmissionController"]
 
 
@@ -69,43 +85,173 @@ class TokenBucket:
             return False
 
 
+@dataclasses.dataclass
+class PriorityClass:
+    """One admission class (docs/SERVING.md "Priorities, preemption &
+    migration").
+
+    ``weight`` is the WFQ share (a weight-8 class gets ~8x the
+    dispatcher throughput of a weight-1 class under contention);
+    ``rank`` is the PREEMPTION priority forwarded to replicas (higher
+    rank may suspend lower-rank resident rows under allocation
+    pressure) — the two are deliberately separate knobs: fair-share is
+    about throughput under sustained load, preemption about latency of
+    the next arrival.  ``max_queue`` bounds this class's own ingress
+    queue (``None`` = the controller default)."""
+
+    name: str
+    weight: float = 1.0
+    rank: int = 0
+    max_queue: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("priority class needs a non-empty name")
+        # Finite AND positive: a NaN weight poisons every WFQ tag
+        # comparison (dispatch order degrades to dict order) and an
+        # inf weight's zero tag increment would starve every other
+        # class — both break the no-starvation guarantee silently.
+        if not math.isfinite(self.weight) or self.weight <= 0:
+            raise ValueError(f"class {self.name!r} weight must be a "
+                             f"finite positive number, got {self.weight}")
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(f"class {self.name!r} max_queue must be "
+                             f">= 1, got {self.max_queue}")
+
+
+class _ClassQ:
+    """One class's live state: spec + queue + WFQ tag + shed counters."""
+
+    __slots__ = ("spec", "q", "last_tag", "shed_queue", "shed_rate",
+                 "admitted")
+
+    def __init__(self, spec: PriorityClass):
+        self.spec = spec
+        self.q: deque = deque()     # (finish_tag, seq, item)
+        self.last_tag = 0.0
+        self.shed_queue = 0
+        self.shed_rate = 0
+        self.admitted = 0
+
+
 class AdmissionController:
-    """Bounded ingress queue + optional rate limiter.
+    """Per-class bounded queues + WFQ dispatch + optional rate limiter.
 
     The gateway's connection threads call :meth:`admit` (which raises
     on shed); its dispatcher workers call :meth:`get`.  ``depth()`` is
-    exported as the ``queue_depth`` gauge.
+    exported as the ``queue_depth`` gauge, :meth:`class_depths` as the
+    per-class one.  Without ``classes`` this is exactly the original
+    single-FIFO controller.
     """
 
     def __init__(self, max_queue: int = 64, rate: Optional[float] = None,
-                 burst: Optional[float] = None):
+                 burst: Optional[float] = None,
+                 classes: Optional[List[PriorityClass]] = None,
+                 clock=time.monotonic):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_queue = int(max_queue)
-        self.bucket = TokenBucket(rate, burst) if rate is not None else None
-        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=self.max_queue)
+        self.bucket = TokenBucket(rate, burst, clock=clock) \
+            if rate is not None else None
+        specs = list(classes) if classes else [PriorityClass("default")]
+        names = [c.name for c in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate priority class names in {names}")
+        self._classes: Dict[str, _ClassQ] = {
+            c.name: _ClassQ(c) for c in specs}
+        # Unlabeled (and unknown-label) traffic maps to the FIRST
+        # listed class — operators list highest-priority first, so
+        # adding a background tier never degrades existing clients.
+        self._default = specs[0].name
+        self._cond = threading.Condition()
+        self._vtime = 0.0           # virtual time = last dispatched tag
+        self._seq = 0               # FIFO tiebreak within equal tags
 
-    def admit(self, item: Any) -> None:
-        """Enqueue ``item`` or raise — never blocks the caller's
-        connection thread."""
-        if self.bucket is not None and not self.bucket.try_acquire():
-            raise RateLimited(
-                f"rate limit exceeded ({self.bucket.rate:g} req/s, "
-                f"burst {self.bucket.burst:g})")
-        try:
-            self._q.put_nowait(item)
-        except queue.Full:
-            raise Overloaded(
-                f"ingress queue full ({self.max_queue} requests "
-                f"waiting)") from None
+    # -- class resolution --------------------------------------------------
+
+    def resolve(self, label: Optional[str]) -> PriorityClass:
+        """The class a request labeled ``label`` belongs to (the
+        default class for ``None`` or an unknown label — a typo'd
+        tenant must be served, just without special treatment)."""
+        c = self._classes.get(label) if isinstance(label, str) else None
+        if c is None:
+            c = self._classes[self._default]
+        return c.spec
+
+    @property
+    def class_names(self) -> List[str]:
+        return list(self._classes)
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, item: Any, cls: Optional[str] = None) -> None:
+        """Enqueue ``item`` under class ``cls`` or raise — never blocks
+        the caller's connection thread.  Capacity is checked BEFORE the
+        token bucket is debited: a shed must not also burn a token
+        (double-penalizing clients exactly when the gateway is already
+        overloaded)."""
+        spec = self.resolve(cls)
+        c = self._classes[spec.name]
+        bound = spec.max_queue if spec.max_queue is not None \
+            else self.max_queue
+        with self._cond:
+            if len(c.q) >= bound:
+                c.shed_queue += 1
+                raise Overloaded(
+                    f"ingress queue full for class {spec.name!r} "
+                    f"({bound} requests waiting)")
+            if self.bucket is not None and not self.bucket.try_acquire():
+                c.shed_rate += 1
+                raise RateLimited(
+                    f"rate limit exceeded ({self.bucket.rate:g} req/s, "
+                    f"burst {self.bucket.burst:g})")
+            # WFQ virtual-time finish tag: service owed to this class so
+            # far (its last tag) or global virtual now, whichever is
+            # later, plus this item's 1/weight of service.
+            tag = max(self._vtime, c.last_tag) + 1.0 / spec.weight
+            c.last_tag = tag
+            self._seq += 1
+            c.q.append((tag, self._seq, item))
+            c.admitted += 1
+            self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Any]:
-        """Next admitted item, or ``None`` on timeout (workers poll so
-        shutdown never needs queue poisoning)."""
-        try:
-            return self._q.get(timeout=timeout)
-        except queue.Empty:
-            return None
+        """Next admitted item in WFQ order (smallest finish tag wins;
+        FIFO within a class), or ``None`` on timeout — workers poll so
+        shutdown never needs queue poisoning."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                best = None
+                for c in self._classes.values():
+                    if c.q and (best is None or c.q[0][:2] < best.q[0][:2]):
+                        best = c
+                if best is not None:
+                    tag, _, item = best.q.popleft()
+                    if tag > self._vtime:
+                        self._vtime = tag
+                    return item
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                if not self._cond.wait(remaining):
+                    return None
+
+    # -- observability -----------------------------------------------------
 
     def depth(self) -> int:
-        return self._q.qsize()
+        with self._cond:
+            return sum(len(c.q) for c in self._classes.values())
+
+    def class_depths(self) -> Dict[str, int]:
+        """Per-class queue depths (the gateway's ``queue_depths``
+        gauge)."""
+        with self._cond:
+            return {name: len(c.q) for name, c in self._classes.items()}
+
+    def shed_counts(self) -> Dict[str, Tuple[int, int]]:
+        """Per-class ``(queue sheds, rate sheds)`` since start."""
+        with self._cond:
+            return {name: (c.shed_queue, c.shed_rate)
+                    for name, c in self._classes.items()}

@@ -27,9 +27,12 @@ Actuation goes through the fleet's dynamic launcher:
 * **Scale down** picks the least-loaded alive replica and announces a
   PINNED drain at the registry (``begin_drain`` — drain-for-scale-down:
   the healthy victim keeps heartbeating plain alive beats while its
-  in-flight work flushes, and those beats must not revive it), then
-  kills the task only once its outstanding count reaches zero (or the
-  drain deadline passes).  In-flight requests are never shed.
+  in-flight work flushes, and those beats must not revive it), asks it
+  to MIGRATE its in-flight rows (suspend → the router re-places each
+  exported KV artifact on a surviving replica, resuming mid-stream;
+  docs/SERVING.md "Priorities, preemption & migration"), then kills
+  the task only once its outstanding count reaches zero (or the drain
+  deadline passes).  In-flight requests are never shed.
 * **Convergence doubles as self-healing**: a replica task that dies is
   dropped from the scheduler's table, actual falls below target, and
   the next tick relaunches it — one per tick, so a crash loop churns at
@@ -278,6 +281,18 @@ class FleetAutoscaler:
         victim = min(alive, key=lambda r: (r.outstanding, r.addr))
         if not self.fleet.registry.begin_drain(victim.addr, pinned=True):
             return
+        # Drain-migrate-kill: ask the victim to suspend its in-flight
+        # rows so the router re-places them on surviving replicas — the
+        # drain flushes promptly and a deadline kill cannot lose work.
+        # Best-effort (stub fleets in tests have no migration surface).
+        migrate = getattr(self.fleet, "request_migration", None)
+        if migrate is not None:
+            try:
+                migrate(victim.addr)
+            except Exception:
+                self.log.exception("migrate request to %s failed; its "
+                                   "in-flight work drains normally",
+                                   victim.addr)
         self._draining[victim.addr] = {
             "role": role, "node": victim.node, "since": now,
             "deadline": now + self.config.drain_timeout}

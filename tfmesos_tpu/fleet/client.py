@@ -203,15 +203,21 @@ class FleetClient:
 
     def generate(self, prompt, max_new_tokens: int,
                  stop_token: Optional[int] = None,
-                 timeout: Optional[float] = None) -> Dict[str, Any]:
+                 timeout: Optional[float] = None,
+                 priority: Optional[str] = None) -> Dict[str, Any]:
         """One generation request; returns the completion dict
         (``tokens``, ``ttft_ms``, ``total_ms``).  Raises ``Overloaded``
-        on shed, :class:`RequestFailed` on any other error reply."""
+        on shed, :class:`RequestFailed` on any other error reply.
+        ``priority`` names the gateway admission class this request
+        rides in (e.g. ``"background"``); unlabeled requests take the
+        fleet's default (first-listed) class."""
+        msg = {"op": "generate", "prompt": [int(t) for t in prompt],
+               "max_new_tokens": int(max_new_tokens),
+               "stop_token": stop_token}
+        if priority is not None:
+            msg["priority"] = str(priority)
         reply = self._mux.call(
-            {"op": "generate", "prompt": [int(t) for t in prompt],
-             "max_new_tokens": int(max_new_tokens),
-             "stop_token": stop_token},
-            timeout=timeout if timeout is not None else self.timeout)
+            msg, timeout=timeout if timeout is not None else self.timeout)
         if isinstance(reply, dict) and reply.get("op") == "completion":
             return reply
         kind = reply.get("kind", "error") if isinstance(reply, dict) else "error"

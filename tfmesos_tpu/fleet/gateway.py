@@ -8,11 +8,18 @@ replica and relay the completion back on the client's connection.
 
 Wire surface (all frames HMAC-authenticated with the cluster token):
 
-* ``{"op": "generate", "id", "prompt", "max_new_tokens", "stop_token"}``
-  → ``{"op": "completion", "id", "tokens", "ttft_ms", "total_ms"}`` or
-  ``{"op": "error", "id", "kind", "error"}`` with ``kind`` one of
-  ``overloaded`` / ``rate_limited`` (admission shed — back off),
-  ``unavailable`` (no replica within the retry budget), ``bad_request``.
+* ``{"op": "generate", "id", "prompt", "max_new_tokens", "stop_token",
+  "priority"}`` → ``{"op": "completion", "id", "tokens", "ttft_ms",
+  "total_ms"}`` or ``{"op": "error", "id", "kind", "error"}`` with
+  ``kind`` one of ``overloaded`` / ``rate_limited`` (admission shed —
+  back off), ``unavailable`` (no replica within the retry budget),
+  ``bad_request``.  ``priority`` (optional; ``tenant`` is an alias) is
+  the CLASS LABEL: it selects the weighted-fair admission queue the
+  request waits in, and the class's preemption rank rides to the
+  replica so a higher class can suspend lower-class resident rows under
+  allocation pressure (docs/SERVING.md "Priorities, preemption &
+  migration").  Unlabeled requests take the first-listed (default)
+  class.
 * ``{"op": "metrics", "id"}`` → ``{"op": "metrics", "id", "snapshot"}``.
 * ``{"op": "ping", "id"}`` → ``{"op": "pong", "id"}``.
 * ``{"op": "rollout", "id", "weights_version"}`` → ``{"op": "rollout",
@@ -95,6 +102,10 @@ class Gateway:
         self._clients: Set[_Client] = set()
         self._clients_lock = threading.Lock()
         metrics.register_gauge("queue_depth", admission.depth)
+        # Per-class depths: under a background flood the operator must
+        # be able to see WHICH class is backed up (one global depth
+        # reads as "overloaded" even while interactive sails through).
+        metrics.register_gauge("queue_depths", admission.class_depths)
         metrics.register_gauge("replicas_alive",
                                lambda: len(self.registry.alive()))
         # Replicas registered but still compiling (--warmup): present
@@ -234,18 +245,32 @@ class Gateway:
                          "error": f"unknown op {op!r}"})
             return
         self.metrics.inc("received")
+        # The class label ("priority"; "tenant" is an alias) picks the
+        # weighted-fair admission queue; the class's preemption RANK —
+        # not the label — rides to the replica, so batcher-side
+        # preemption and gateway-side fair-share stay one coherent
+        # policy defined in one place (the class table).
+        label = msg.get("priority")
+        if not isinstance(label, str):
+            label = msg.get("tenant")
+        spec = self.admission.resolve(
+            label if isinstance(label, str) else None)
         forward = {"op": "generate", "prompt": msg.get("prompt"),
                    "max_new_tokens": msg.get("max_new_tokens"),
-                   "stop_token": msg.get("stop_token")}
+                   "stop_token": msg.get("stop_token"),
+                   "priority": spec.rank}
         try:
             self.admission.admit((client, cid, forward,
-                                  time.perf_counter()))
+                                  time.perf_counter(), spec.name),
+                                 cls=spec.name)
         except RateLimited as e:
             self.metrics.inc("shed_rate_limited")
+            self.metrics.inc(f"shed_rate_limited_{spec.name}")
             client.send({"op": "error", "id": cid, "kind": e.kind,
                          "error": str(e)})
         except Overloaded as e:
             self.metrics.inc("shed_queue")
+            self.metrics.inc(f"shed_queue_{spec.name}")
             client.send({"op": "error", "id": cid, "kind": e.kind,
                          "error": str(e)})
         else:
@@ -258,13 +283,16 @@ class Gateway:
             item = self.admission.get(timeout=0.2)
             if item is None:
                 continue
-            client, cid, forward, t_enq = item
+            client, cid, forward, t_enq, cls = item
             # Queue wait is ITS OWN histogram, never folded into TTFT:
             # TTFT measures the serving path (prefill + transfer), and
             # conflating admission backlog with it would mask exactly
-            # the stalls disaggregation removes.
-            self.metrics.observe("queue_wait_ms",
-                                 (time.perf_counter() - t_enq) * 1000.0)
+            # the stalls disaggregation removes.  The per-class variant
+            # is what the priority bench (and an SLO dashboard) reads —
+            # the global one stays the autoscaler's signal.
+            wait_ms = (time.perf_counter() - t_enq) * 1000.0
+            self.metrics.observe("queue_wait_ms", wait_ms)
+            self.metrics.observe(f"queue_wait_ms_{cls}", wait_ms)
             try:
                 reply = self.router.route(forward)
             except Exception as e:

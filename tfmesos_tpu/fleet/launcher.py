@@ -37,7 +37,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from tfmesos_tpu import wire
-from tfmesos_tpu.fleet.admission import AdmissionController
+from tfmesos_tpu.fleet.admission import AdmissionController, PriorityClass
 from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig, FleetAutoscaler
 from tfmesos_tpu.fleet.client import FleetClient
 from tfmesos_tpu.fleet.gateway import Gateway
@@ -105,6 +105,8 @@ class FleetServer:
                  workers: int = 8, max_queue: int = 64,
                  rate: Optional[float] = None,
                  burst: Optional[float] = None,
+                 priority_classes: Optional[List[PriorityClass]] = None,
+                 migrate_on_drain: bool = True,
                  max_retries: int = 2, request_timeout: float = 120.0,
                  start_timeout: float = 300.0,
                  heartbeat_interval: float = 0.3,
@@ -186,6 +188,17 @@ class FleetServer:
         self.max_queue = int(max_queue)
         self.rate = rate
         self.burst = burst
+        #: admission priority classes (weighted-fair queues at the
+        #: gateway + preemption ranks inside the replicas); None = one
+        #: default class, the pre-priority behavior exactly.
+        self.priority_classes = list(priority_classes) \
+            if priority_classes else None
+        #: drain-migrate-kill: when a drain is pinned (autoscaler
+        #: scale-down, rollout reap), ask the victim to SUSPEND its
+        #: in-flight rows so the router re-places them on survivors —
+        #: instead of waiting for them to finish (or worse, flushing
+        #: them).  False restores plain drain-then-kill.
+        self.migrate_on_drain = bool(migrate_on_drain)
         self.max_retries = int(max_retries)
         self.request_timeout = float(request_timeout)
         self.start_timeout = float(start_timeout)
@@ -270,9 +283,9 @@ class FleetServer:
                                  token=self.token,
                                  max_retries=self.max_retries,
                                  request_timeout=self.request_timeout)
-            self.admission = AdmissionController(max_queue=self.max_queue,
-                                                 rate=self.rate,
-                                                 burst=self.burst)
+            self.admission = AdmissionController(
+                max_queue=self.max_queue, rate=self.rate,
+                burst=self.burst, classes=self.priority_classes)
             self.gateway = Gateway(self.router, self.admission,
                                    self.metrics, token=self.token,
                                    host=self.gateway_host,
@@ -366,17 +379,40 @@ class FleetServer:
                    and (weights_version is None
                         or r.weights_version == weights_version))
 
+    def request_migration(self, addr: str) -> bool:
+        """Ask one (already drained) replica to SUSPEND its in-flight
+        rows — the victim answers each pending generate with a
+        ``suspended`` export the router re-places on a survivor, so the
+        drain flushes in one round-trip instead of a full generation's
+        tail latency, and a kill-after-timeout can no longer lose work.
+        Best-effort: any failure just leaves the plain drain-then-kill
+        behavior (the victim keeps finishing its rows)."""
+        if not self.migrate_on_drain or self.router is None:
+            return False
+        try:
+            self.router.control(addr, {"op": "migrate"}, timeout=30.0)
+        except Exception as e:
+            self.log.warning("migrate request to %s failed (%s); its "
+                             "in-flight work drains normally", addr, e)
+            return False
+        self.metrics.inc("migrations_requested")
+        return True
+
     def _drain_and_flush(self, reps, drain_timeout: float) -> None:
         """ONE copy of the reap discipline both rollout paths share:
         pinned drains on every given replica (healthy members keep
-        heartbeating while their in-flight work finishes), then wait
-        until BOTH flush signals read zero for all of them — the
-        heartbeat-reported outstanding AND the router's own in-flight
-        count (a request dispatched after the last beat is invisible
-        to the first) — or the drain deadline passes."""
+        heartbeating while their in-flight work finishes), ask each to
+        migrate its in-flight rows away (drain-migrate-kill; see
+        :meth:`request_migration`), then wait until BOTH flush signals
+        read zero for all of them — the heartbeat-reported outstanding
+        AND the router's own in-flight count (a request dispatched
+        after the last beat is invisible to the first) — or the drain
+        deadline passes."""
         addrs = [r.addr for r in reps]
         for r in reps:
             self.registry.begin_drain(r.addr, pinned=True)
+        for r in reps:
+            self.request_migration(r.addr)
         deadline = time.monotonic() + float(drain_timeout)
         while addrs and time.monotonic() < deadline:
             table = {m.addr: m for m in self.registry.members()}

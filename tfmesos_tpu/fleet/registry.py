@@ -488,6 +488,23 @@ class ReplicaRegistry:
             self.log.info("registry generation fence raised to %d",
                           min_gen)
 
+    def gen_allowed(self, gen) -> bool:
+        """Whether a launch generation is at or above the fence floor —
+        the router consults this before re-placing a drain-migration's
+        suspended KV export, so a reaped-generation zombie's artifact
+        can never land on a live replica (the serving-path twin of the
+        heartbeat fence above).  Unknown/malformed generations pass:
+        the fence rejects provably stale state, absence of a stamp is
+        a version-blind deployment."""
+        if gen is None:
+            return True
+        try:
+            g = int(gen)
+        except (TypeError, ValueError):
+            return True
+        with self._lock:
+            return g >= self._min_gen
+
     def mark_dead(self, addr: str, why: str = "reported by router") -> None:
         """Out-of-band death report (router connection failure).  The
         next heartbeat revives the entry if the replica is in fact

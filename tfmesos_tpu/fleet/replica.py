@@ -210,7 +210,11 @@ class ReplicaServer:
         if op == "ping":
             self._send(conn, send_lock, {"op": "pong", "id": mid})
             return
-        if op not in ("generate", "prefill"):
+        # "migrate" is the drain-migration control op (the fleet's
+        # control plane asks this replica to suspend its in-flight rows
+        # so the router can re-place them) — authenticated like every
+        # frame, and handler-interpreted like generate/prefill.
+        if op not in ("generate", "prefill", "migrate"):
             self._send(conn, send_lock,
                        {"op": "error", "id": mid,
                         "kind": "bad_request",
@@ -318,8 +322,11 @@ class BatcherServing:
     def submit(self, request, on_done: Callable,
                prefilled: Optional[dict] = None) -> None:
         """``on_done(completion, error)``: exactly one of the two is
-        set.  ``prefilled`` routes the request through the batcher's
-        KV-import admission (disaggregated decode)."""
+        set — ``completion`` may also be a
+        :class:`~tfmesos_tpu.serving.Suspended` (drain migration gave
+        the request back instead of finishing it).  ``prefilled``
+        routes the request through the batcher's KV-import admission
+        (disaggregated decode, or a migrated resume)."""
         with self._lock:
             self._callbacks[id(request)] = on_done
         if prefilled is not None:
@@ -333,17 +340,29 @@ class BatcherServing:
             self._thread.join(timeout=30.0)
 
 
-def batcher_handler(serving: BatcherServing) -> Callable:
+def batcher_handler(serving: BatcherServing, generation: int = 0,
+                    weights_version: str = "") -> Callable:
     """The model-backed ``ReplicaServer`` handler (decode/unified
     roles): validate, submit, stream the completion back when the
     batcher finishes it.  A plain ``generate`` dict takes the local
     prefill path; a RAW ``generate`` frame (meta + KV body) takes the
     disaggregated IMPORT path — the payload pages install into the
-    pool and the row enters decode directly."""
+    pool and the row enters decode directly (mid-stream suspended
+    artifacts resume exactly where they stopped).
+
+    A ``migrate`` control message asks the batcher to SUSPEND every
+    in-flight request: each pending generate then gets a ``suspended``
+    reply instead of a completion — a raw frame carrying the row's
+    resumable KV artifact (stamped with this replica's launch
+    ``generation`` so the registry fence can reject a zombie's export,
+    and its ``weights_version`` so the router resumes onto matching
+    weights), or a plain requeue marker when the request held no
+    exportable state.  The router re-places either form on a surviving
+    replica; the client sees one completion, never the move."""
     import numpy as np
 
     from tfmesos_tpu import serving as serving_mod
-    from tfmesos_tpu.serving import Prefilled, Request
+    from tfmesos_tpu.serving import Prefilled, Request, Suspended
 
     batcher = serving.batcher
 
@@ -351,6 +370,13 @@ def batcher_handler(serving: BatcherServing) -> Callable:
         raw = isinstance(msg, wire.RawFrame)
         head = msg.meta if raw else msg
         mid = head.get("id")
+        if head.get("op") == "migrate":
+            # Ack immediately: the suspensions themselves surface as
+            # the in-flight requests' own replies on the next loop
+            # tick, and the drain waits on outstanding reaching zero.
+            batcher.preempt_all()
+            reply({"op": "migrated", "id": mid})
+            return
         if head.get("op") == "prefill":
             reply({"op": "error", "id": mid, "kind": "bad_request",
                    "error": "this replica does not serve the prefill "
@@ -359,10 +385,12 @@ def batcher_handler(serving: BatcherServing) -> Callable:
             return
         prefilled = None
         try:
+            prio = head.get("priority")
             req = Request(
                 prompt=np.asarray(head.get("prompt"), np.int32),
                 max_new_tokens=int(head.get("max_new_tokens") or 0),
-                stop_token=head.get("stop_token"))
+                stop_token=head.get("stop_token"),
+                priority=int(prio) if prio is not None else 0)
             if raw:
                 prefilled = serving_mod.unpack_prefilled(head, msg.body)
                 batcher.validate(Prefilled(req, prefilled))
@@ -381,6 +409,17 @@ def batcher_handler(serving: BatcherServing) -> Callable:
             if comp is None:
                 reply({"op": "error", "id": mid, "kind": "internal",
                        "error": err or "request dropped"})
+                return
+            if isinstance(comp, Suspended):
+                if comp.artifact is None:
+                    reply({"op": "suspended", "id": mid, "requeue": True,
+                           "gen": generation,
+                           "weights_version": weights_version})
+                    return
+                meta, body = serving_mod.pack_prefilled(comp.artifact)
+                meta.update(op="suspended", id=mid, gen=generation,
+                            weights_version=weights_version)
+                reply(wire.RawFrame(meta, body))
                 return
             reply({"op": "completion", "id": mid,
                    "tokens": [int(t) for t in comp.tokens],
@@ -438,6 +477,12 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
         raw = isinstance(msg, wire.RawFrame)
         head = msg.meta if raw else msg
         mid = head.get("id")
+        if not raw and head.get("op") == "migrate":
+            # Exports are synchronous — a prefill replica holds no
+            # resident rows to suspend; ack so a tier-blind drain can
+            # migrate every member the same way.
+            reply({"op": "migrated", "id": mid})
+            return
         if raw or head.get("op") != "prefill":
             reply({"op": "error", "id": mid, "kind": "bad_request",
                    "error": "this replica serves only the prefill op "
@@ -445,10 +490,12 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
                             "decode or unified replica"})
             return
         try:
+            prio = head.get("priority")
             req = Request(
                 prompt=np.asarray(head.get("prompt"), np.int32),
                 max_new_tokens=int(head.get("max_new_tokens") or 0),
-                stop_token=head.get("stop_token"))
+                stop_token=head.get("stop_token"),
+                priority=int(prio) if prio is not None else 0)
             batcher.validate(req)
         except (TypeError, ValueError) as e:
             reply({"op": "error", "id": mid, "kind": "bad_request",
@@ -595,7 +642,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # NOT started yet: warmup must run before the serve loop owns
         # the rows; submissions made while warming just queue.
         serving = BatcherServing(batcher)
-        handler = batcher_handler(serving)
+        handler = batcher_handler(serving, generation=generation,
+                                  weights_version=args.weights_version)
 
     def extra() -> Dict[str, Any]:
         # Heartbeat advert: the tier this replica belongs to and its

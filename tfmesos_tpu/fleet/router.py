@@ -49,6 +49,23 @@ the unified tier, so an all-unified fleet (every existing deployment)
 routes exactly as before.  Plain generates never land on a
 prefill-role replica.
 
+**Drain migration (suspended replies)**: a replica being drained away
+(autoscaler scale-down, blue-green reap — ``tfserve``'s
+drain-migrate-kill) answers its in-flight generates with ``suspended``
+instead of a completion: a raw HMAC frame carrying the row's resumable
+KV artifact (pages + mid-stream sampler state), or a plain requeue
+marker when the request held no exportable state.  The router
+RE-PLACES either form transparently: an artifact resumes on a replica
+advertising the SAME ``weights_version`` (resuming old-weights KV
+under new weights would be a silently wrong stream — the one failure
+mode this path must never have) whose launch generation passes the
+registry fence (a reaped-rollout zombie's export can never land);
+anything else — requeue, fence rejection, version mismatch, artifact
+rejection — falls back to RE-RUNNING the whole request on another
+replica, which is equally lossless because nothing was delivered and
+completions are deterministic.  The client sees one completion, never
+the move; ``migration_*`` counters make each path observable.
+
 **Warming replicas** (registered with ``status: warming`` while
 ``ContinuousBatcher.warmup`` compiles their entry points) are excluded
 by EVERY pick — ``pick``/``pick_prefill``/``pick_decode`` all candidate
@@ -224,13 +241,21 @@ class Router:
         the load signal is what spreads long prompts."""
         return self._pick_role((PREFILL,), exclude, prompt)
 
-    def pick_decode(self, exclude: Iterable[str] = ()) -> Optional[str]:
+    def pick_decode(self, exclude: Iterable[str] = (),
+                    weights_version: Optional[str] = None
+                    ) -> Optional[str]:
         """The decode-tier choice: p2c by advertised KV-page headroom
         (the imported pages must FIT — load alone would happily pick a
         replica whose pool is full of long-lived rows), saturated
         replicas (outstanding >= capacity) skipped, ties broken by the
-        router's own outstanding count."""
+        router's own outstanding count.  ``weights_version`` narrows
+        the tier to replicas serving those exact weights — a suspended
+        mid-stream artifact must never resume under different weights
+        (same rule as :meth:`_pick_resume`)."""
         cands = self._alive_by_role((DECODE,), exclude)
+        if weights_version:
+            cands = [r for r in cands
+                     if r.weights_version == weights_version]
         if not cands:
             return None
         unsat = [r for r in cands
@@ -246,6 +271,14 @@ class Router:
         return max(cands, key=score).addr
 
     # -- link management ---------------------------------------------------
+
+    def control(self, addr: str, msg: Dict[str, Any],
+                timeout: float = 30.0) -> Any:
+        """One control call straight to a known replica over the shared
+        mux link (the fleet's ``migrate`` request rides this) — no
+        pick, no retry: control targets a SPECIFIC replica by
+        design."""
+        return self._link(addr).call(msg, timeout=timeout)
 
     def _link(self, addr: str) -> MuxConnection:
         with self._lock:
@@ -316,6 +349,111 @@ class Router:
                          attempt + 1, self.max_retries + 1)
         time.sleep(self.backoff_s * (2 ** attempt))
 
+    # -- drain migration: suspended replies re-place elsewhere -------------
+
+    @staticmethod
+    def _suspended_of(reply) -> Optional[tuple]:
+        """``(meta, body_or_None)`` when ``reply`` is a drained
+        replica's ``suspended`` answer (raw frame = resumable artifact,
+        dict = requeue marker); ``None`` for every normal reply."""
+        if isinstance(reply, wire.RawFrame) \
+                and isinstance(reply.meta, dict) \
+                and reply.meta.get("op") == "suspended":
+            return reply.meta, reply.body
+        if isinstance(reply, dict) and reply.get("op") == "suspended":
+            return reply, None
+        return None
+
+    def _pick_resume(self, tried, weights_version) -> Optional[str]:
+        """A unified-tier replica a suspended artifact may RESUME on:
+        same advertised weights_version (KV pages computed under one
+        set of weights must never feed a decode under another — resume
+        onto a mismatch would be a silently wrong stream), not already
+        tried.  ``None`` = no eligible target; the caller re-runs the
+        request instead."""
+        cands = self._alive_by_role((UNIFIED,), exclude=tried)
+        if weights_version:
+            cands = [r for r in cands
+                     if r.weights_version == weights_version]
+        return self._load_pick(cands)
+
+    def _resume_elsewhere(self, msg: Dict[str, Any], meta: dict,
+                          body, tried: set) -> Optional[Any]:
+        """Re-place one suspended export: retry the artifact onto
+        eligible replicas within the shared budget; ``None`` means the
+        caller should fall back to re-running the plain request (the
+        equally-lossless path — nothing was delivered).  A resume
+        target that is itself being drained can answer suspended again;
+        the freshest artifact keeps moving until the budget runs out."""
+        if body is None:
+            return None                     # requeue marker: just re-run
+        gen = meta.get("gen")
+        if not self.registry.gen_allowed(gen):
+            # The victim belongs to a reaped (fenced) generation: its
+            # KV pages are stale-weights state and must never land.
+            self.metrics.inc("migration_fenced")
+            self.log.warning("dropping suspended export from a fenced "
+                             "generation (%r); re-running the request",
+                             gen)
+            return None
+        wv = meta.get("weights_version")
+        wv = wv if isinstance(wv, str) and wv else ""
+        call = {k: v for k, v in meta.items()
+                if k not in ("op", "id", "gen", "weights_version")}
+        call.update(op="generate", prompt=msg.get("prompt"),
+                    max_new_tokens=msg.get("max_new_tokens"),
+                    stop_token=msg.get("stop_token"),
+                    priority=msg.get("priority"))
+        for attempt in range(self.max_retries + 1):
+            addr = self._pick_resume(tried, wv)
+            if addr is None:
+                break
+            try:
+                reply = self._link(addr).call_raw(
+                    call, body, timeout=self.request_timeout)
+            except CallTimeout as e:
+                self._note_timeout(addr, tried, attempt, "resume")
+                continue
+            except wire.WireError:
+                # The artifact cannot even be encoded for the wire:
+                # deterministic for the PAYLOAD — re-run instead.
+                return None
+            except (ConnectionLost, OSError) as e:
+                self._note_link_failure(e, addr, tried, attempt,
+                                        "resume")
+                continue
+            s = self._suspended_of(reply)
+            if s is not None:
+                # The resume target is being drained too: carry the
+                # FRESHEST artifact onward (it holds more tokens).
+                tried.add(addr)
+                self.metrics.inc("migration_exports")
+                meta2, body2 = s
+                if body2 is None or not self.registry.gen_allowed(
+                        meta2.get("gen")):
+                    return None
+                call = {k: v for k, v in meta2.items()
+                        if k not in ("op", "id", "gen",
+                                     "weights_version")}
+                call.update(op="generate", prompt=msg.get("prompt"),
+                            max_new_tokens=msg.get("max_new_tokens"),
+                            stop_token=msg.get("stop_token"),
+                            priority=msg.get("priority"))
+                body = body2
+                continue
+            if isinstance(reply, dict) and reply.get("op") == "error":
+                if reply.get("kind") == "bad_request":
+                    # Deterministic for THIS artifact (geometry/config
+                    # mismatch): re-running the request still works.
+                    self.metrics.inc("migration_rejected")
+                    return None
+                tried.add(addr)
+                self.metrics.inc("retries")
+                continue
+            self.metrics.inc("migration_resumes")
+            return reply
+        return None
+
     # -- the routing loop --------------------------------------------------
 
     def route(self, msg: Dict[str, Any]) -> Any:
@@ -324,7 +462,11 @@ class Router:
         backoff).  When both a prefill and a decode tier are alive, a
         generate request takes the DISAGGREGATED prefill→transfer→
         decode path first and falls back to the unified tier only when
-        that path cannot serve it."""
+        that path cannot serve it.  A ``suspended`` reply (the replica
+        is being drain-migrated away) re-places the request — resuming
+        its exported KV artifact on a same-version survivor, or
+        re-running it from scratch — before the retry budget is ever
+        charged a failure."""
         last: Optional[BaseException] = None
         if isinstance(msg, dict) and msg.get("op") == "generate":
             out, last = self._route_disagg(msg)
@@ -338,10 +480,11 @@ class Router:
                 break       # nothing (left) to try
             try:
                 link = self._link(addr)
-                return link.call(msg, timeout=self.request_timeout)
+                reply = link.call(msg, timeout=self.request_timeout)
             except CallTimeout as e:
                 last = e
                 self._note_timeout(addr, tried, attempt, "request")
+                continue
             except wire.WireError as e:
                 # Deterministic for this request (it could not even be
                 # encoded): no replica can serve it.
@@ -351,6 +494,22 @@ class Router:
                 last = e
                 self._note_link_failure(e, addr, tried, attempt,
                                         "generate")
+                continue
+            s = self._suspended_of(reply)
+            if s is None:
+                return reply
+            # Drain migration: the replica gave the request back.  The
+            # victim is excluded (it is leaving), the artifact resumes
+            # elsewhere — or the loop continues and re-runs the plain
+            # request on a survivor, losing nothing either way.
+            tried.add(addr)
+            self.metrics.inc("migration_exports")
+            out = self._resume_elsewhere(msg, s[0], s[1], tried)
+            if out is not None:
+                return out
+            self.metrics.inc("migration_reruns")
+            last = RoutingError(
+                f"replica {addr} suspended the request mid-stream")
         if last is not None:
             raise RoutingError(
                 f"no replica could serve the request after trying "
@@ -400,7 +559,8 @@ class Router:
                 break               # prefill tier exhausted
             call = {"op": "prefill", "prompt": msg.get("prompt"),
                     "max_new_tokens": msg.get("max_new_tokens"),
-                    "stop_token": msg.get("stop_token")}
+                    "stop_token": msg.get("stop_token"),
+                    "priority": msg.get("priority")}
             try:
                 praw = self._link(paddr).call(
                     call, timeout=self.request_timeout)
@@ -476,11 +636,18 @@ class Router:
                 if k not in ("op", "id", "prefill_ms")}
         meta.update(op="generate", prompt=msg.get("prompt"),
                     max_new_tokens=msg.get("max_new_tokens"),
-                    stop_token=msg.get("stop_token"))
+                    stop_token=msg.get("stop_token"),
+                    priority=msg.get("priority"))
         last: Optional[BaseException] = None
         dtried: set = set()
+        # A mid-stream artifact adopted from a drained decode replica
+        # pins its weights_version: pages decoded under one set of
+        # weights must only continue under the same (fresh prefill
+        # artifacts carry no pin — the tier shares the fleet version).
+        art_wv: Optional[str] = None
         for attempt in range(self.max_retries + 1):
-            daddr = self.pick_decode(exclude=dtried)
+            daddr = self.pick_decode(exclude=dtried,
+                                     weights_version=art_wv)
             if daddr is None:
                 return None, last
             try:
@@ -512,6 +679,31 @@ class Router:
                 last = e
                 self._note_link_failure(e, daddr, dtried, attempt,
                                         "disagg decode")
+                continue
+            s = self._suspended_of(reply)
+            if s is not None:
+                # The decode replica is being drain-migrated: adopt its
+                # fresher suspended artifact (it holds the tokens
+                # decoded so far) and retry on another decode replica —
+                # or, on a requeue/fenced export, retry the ORIGINAL
+                # prefill artifact, which re-decodes deterministically.
+                dtried.add(daddr)
+                self.metrics.inc("migration_exports")
+                meta2, body2 = s
+                if body2 is not None \
+                        and self.registry.gen_allowed(meta2.get("gen")):
+                    meta = {k: v for k, v in meta2.items()
+                            if k not in ("op", "id", "gen",
+                                         "weights_version")}
+                    meta.update(op="generate", prompt=msg.get("prompt"),
+                                max_new_tokens=msg.get("max_new_tokens"),
+                                stop_token=msg.get("stop_token"),
+                                priority=msg.get("priority"))
+                    praw = wire.RawFrame(meta2, body2)
+                    wv2 = meta2.get("weights_version")
+                    art_wv = wv2 if isinstance(wv2, str) and wv2 else None
+                last = RoutingError(
+                    f"decode replica {daddr} suspended the request")
                 continue
             if isinstance(reply, dict) and reply.get("op") == "error":
                 if reply.get("kind") == "bad_request":

@@ -54,7 +54,7 @@ from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
                                             rejection_accept, sample_logits)
 from tfmesos_tpu.ops.quant import QTensor
 
-__all__ = ["Request", "Completion", "ContinuousBatcher",
+__all__ = ["Request", "Completion", "Suspended", "ContinuousBatcher",
            "SubmissionQueue", "Prefilled", "pack_prefilled",
            "unpack_prefilled"]
 
@@ -119,11 +119,18 @@ class SubmissionQueue:
 
 @dataclasses.dataclass
 class Request:
-    """One generation request: ``prompt`` is a 1-D int32 token array."""
+    """One generation request: ``prompt`` is a 1-D int32 token array.
+    ``priority`` is the preemption rank (higher = more important):
+    under allocation pressure the batcher may SUSPEND the
+    lowest-priority resident row to admit a strictly-higher-priority
+    arrival, parking its KV state for later resumption — resumed
+    streams are token-identical to uninterrupted ones
+    (docs/SERVING.md "Priorities, preemption & migration")."""
 
     prompt: np.ndarray
     max_new_tokens: int
     stop_token: Optional[int] = None
+    priority: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32)
@@ -134,6 +141,7 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(f"Request.max_new_tokens must be >= 1, got "
                              f"{self.max_new_tokens}")
+        self.priority = int(self.priority)
 
 
 @dataclasses.dataclass
@@ -161,9 +169,12 @@ class Prefilled:
 # Artifact array leaves, in their fixed wire order (pack/unpack below).
 _KV_ARRAY_KEYS = ("k", "v", "k_scales", "v_scales")
 # Everything else in the artifact is a small scalar/dict header.
+# ``step``/``tokens`` carry a SUSPENDED request's mid-stream sampler
+# state (tokens emitted so far); a fresh prefill export has step 1 and
+# tokens == [first_token], so one artifact shape serves both.
 _KV_META_KEYS = ("version", "page_size", "prefix_len", "shared_len",
                  "pos", "prompt_len", "first_token", "rid", "quantized",
-                 "model")
+                 "model", "step", "tokens")
 
 
 def pack_prefilled(artifact: dict) -> tuple:
@@ -233,6 +244,28 @@ class Completion:
     tokens: List[int]
     ttft_s: float = 0.0
     total_s: float = 0.0
+
+
+@dataclasses.dataclass
+class Suspended:
+    """An in-flight request the batcher gave BACK instead of finishing —
+    yielded by :meth:`ContinuousBatcher.serve`/``run`` after
+    :meth:`ContinuousBatcher.preempt_all` (the drain-migration path).
+
+    ``artifact`` is an :meth:`~ContinuousBatcher.export_kv`-shaped dict
+    carrying the row's KV pages AND its mid-stream sampler state
+    (``step``, ``tokens``): re-admitting it anywhere via
+    ``submit(request, prefilled=artifact)`` resumes the stream
+    token-identically to an uninterrupted run.  ``artifact`` is ``None``
+    when the request held no resumable state (still queued, still
+    prefilling, or a serving mode without per-row export) — the caller
+    re-runs it from scratch, which is lossless too: nothing was
+    delivered, and completions are deterministic functions of the
+    request."""
+
+    rid: int
+    request: Request
+    artifact: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -1136,6 +1169,15 @@ class ContinuousBatcher:
         # loop owns the rows); _loop_active fences that.
         self._export_lock = threading.Lock()
         self._loop_active = False
+        # Priority preemption / migration (docs/SERVING.md "Priorities,
+        # preemption & migration"): artifacts of rows suspended under
+        # allocation pressure, waiting for a free row to resume through
+        # the import path; the event asks the serve loop to suspend
+        # EVERYTHING (drain-migration) and yield Suspended items.
+        self._parked: deque = deque()
+        self._preempt_event = threading.Event()
+        self.preemptions = 0        # rows suspended for a higher class
+        self.resumes = 0            # parked rows re-admitted locally
         # Speculative observability (see acceptance_rate).
         self.spec_rounds = 0        # jitted rounds executed
         self.spec_row_rounds = 0    # row-rounds (rows decoding per round)
@@ -1177,6 +1219,31 @@ class ContinuousBatcher:
         bypassed)."""
         return self.pipeline_depth > 0 and \
             self.pipeline_bypass_reason is None
+
+    @property
+    def preemptible(self) -> bool:
+        """Whether this batcher can SUSPEND a resident row (priority
+        preemption, per-row drain migration): requires the same
+        single-shard, non-speculative pool as the disaggregated
+        export/import surface (a suspended request IS a KV export), and
+        a host-synchronous decode loop — overlap/pipelined modes carry
+        in-flight device state the host view lags behind, so their rows
+        cannot be snapshotted between blocks.  Non-preemptible batchers
+        still honor :meth:`preempt_all`, by REQUEUEING every in-flight
+        request (lossless through deterministic re-execution) instead
+        of exporting it."""
+        return (self.d_side is None and self.n_shards == 1
+                and not self.overlap and not self._pipelined)
+
+    def preempt_all(self) -> None:
+        """Ask the serve loop to give back EVERY in-flight request as a
+        :class:`Suspended` item on its next tick — the victim side of
+        cross-replica drain migration: suspended artifacts re-placed on
+        another replica (``submit(request, prefilled=artifact)``) resume
+        token-identically; requests with no resumable state requeue with
+        ``artifact=None``.  Thread-safe; a no-op until the serve loop
+        runs (an idle loop processes it on its next submission)."""
+        self._preempt_event.set()
 
     def prefix_cache_stats(self) -> Optional[Dict[str, int]]:
         """Hit/miss/eviction counters plus current occupancy of the
@@ -2125,6 +2192,12 @@ class ContinuousBatcher:
             "pos": int(E),
             "prompt_len": int(state.req.prompt.size),
             "first_token": int(state.out[0]),
+            # Mid-stream sampler state: a SUSPENDED row carries the
+            # tokens it already emitted (step > 1) so the importer
+            # resumes exactly where this row stopped; a fresh prefill
+            # export is the step-1 degenerate case.
+            "step": int(state.step),
+            "tokens": [int(t) for t in state.out],
             "rid": int(state.rid),
             "quantized": quantized,
             "model": {"n_layers": int(self.cfg.n_layers),
@@ -2166,12 +2239,44 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"KV artifact model {key} {model.get(key)!r} does "
                     f"not match this config's {want}")
+        # Mid-stream (suspended) artifacts carry step/tokens; a fresh
+        # prefill export is step 1.  Every inconsistency is a loud
+        # rejection — resuming from mismatched state would be a
+        # silently wrong stream, the one failure mode this surface
+        # must never have.
+        try:
+            step = int(art.get("step", 1))
+        except (TypeError, ValueError):
+            raise ValueError(f"KV artifact step {art.get('step')!r} is "
+                             f"not an int") from None
+        if step < 1:
+            raise ValueError(f"KV artifact step {step} must be >= 1")
+        toks = art.get("tokens")
+        if step > 1 or toks is not None:
+            if not isinstance(toks, (list, tuple)) or len(toks) != step:
+                raise ValueError(
+                    f"KV artifact tokens must list exactly step "
+                    f"({step}) emitted tokens, got {toks!r}")
+            if int(toks[0]) != int(art.get("first_token", -1)):
+                raise ValueError("KV artifact tokens[0] does not match "
+                                 "its first_token")
+        if step > 1:
+            if step >= req.max_new_tokens:
+                raise ValueError(
+                    f"suspended KV artifact already emitted {step} of "
+                    f"{req.max_new_tokens} tokens — a finished request "
+                    f"is never suspended")
+            if req.stop_token is not None \
+                    and int(toks[-1]) == int(req.stop_token):
+                raise ValueError("suspended KV artifact ends at the "
+                                 "stop token — nothing to resume")
         E = art.get("pos")
-        if E != self.prefix_len + int(req.prompt.size) \
-                or E != art.get("prompt_len", -1) + self.prefix_len:
+        if E != self.prefix_len + int(req.prompt.size) + step - 1 \
+                or art.get("prompt_len", -1) != int(req.prompt.size):
             raise ValueError(
                 f"KV artifact covers {E!r} positions; this request needs "
-                f"prefix {self.prefix_len} + prompt {req.prompt.size}")
+                f"prefix {self.prefix_len} + prompt {req.prompt.size} "
+                f"(+ {step - 1} resumed tokens)")
         n = -(-(E - self.t_side.shared_len) // self.page_size)
         pool_k = self.pool["k"].values if quantized else self.pool["k"]
         want_shape = (int(self.cfg.n_layers), n, int(self.cfg.kv_heads),
@@ -2232,8 +2337,18 @@ class ContinuousBatcher:
         # coincide, correlating the sampled draws of unrelated rows —
         # deployments sampling across several prefill replicas should
         # give them distinct seeds/rngs.
+        #
+        # A SUSPENDED artifact (step > 1) resumes mid-stream: the row
+        # re-enters decode with the exported emitted-token list, last
+        # token, and step — the (rid, step) sample folds continue on
+        # exactly the stream the suspension interrupted, so the resumed
+        # completion is token-identical to an uninterrupted run.
+        step = int(art.get("step", 1))
+        toks = [int(t) for t in (art.get("tokens") or ())]
+        resumed = step > 1
         state = _Row(rid=int(art["rid"]), req=req, pos=int(art["pos"]),
-                     step=1, last=0, out=[], worst_pages=wt,
+                     step=step, last=(toks[-1] if resumed else 0),
+                     out=(list(toks) if resumed else []), worst_pages=wt,
                      worst_draft=wd, t_admit=t_admit, limit=need)
         active[row] = state
         self._pcache_insert(row, state)
@@ -2324,6 +2439,15 @@ class ContinuousBatcher:
             self._loop_active = True
         try:
             while True:
+                if self._preempt_event.is_set():
+                    # Drain-migration: every in-flight request (resident
+                    # rows, parked artifacts, queued arrivals) is given
+                    # back as a Suspended item for re-placement
+                    # elsewhere; the loop itself keeps serving whatever
+                    # arrives after.
+                    yield from self._preempt_everything(
+                        pending, active, free_rows,
+                        requests if incremental else None)
                 # Admit while a row is free and the pool can take the
                 # newcomer's worst case.  Prefills DISPATCH inside the
                 # loop but their first-token fetches are deferred to one
@@ -2331,6 +2455,46 @@ class ContinuousBatcher:
                 # device-to-host round-trip, not W (the round-trip is
                 # the dominant per-call cost on remote-attached hosts).
                 burst = []
+                # Parked (preempted) artifacts resume FIRST: they
+                # arrived before anything still queued, so a sustained
+                # same-class arrival stream must not starve them.  A
+                # strictly-OUTRANKING queued arrival still goes first
+                # (the gate below — and past it, the preemption rule
+                # itself); one eager pull makes such an arrival visible.
+                if self._parked and incremental and not pending \
+                        and not exhausted:
+                    pull(block=False)
+                while free_rows and self._parked and bad_request is None:
+                    pre = self._parked[0]
+                    if pending:
+                        h = pending[0]
+                        hreq = h.request if isinstance(h, Prefilled) \
+                            else h
+                        if hreq.priority > pre.request.priority:
+                            break
+                    try:
+                        wt, wd, need = self._worst_pages(pre.request)
+                        row, _ = self._admit_row(free_rows, active, wt,
+                                                 wd, pre.request,
+                                                 use_cache=False)
+                    except RuntimeError:
+                        # The resume can never fit this pool (e.g. the
+                        # original admission rode a prefix-cache plan
+                        # the full-page import cannot): fall back to a
+                        # from-scratch re-run through the normal path —
+                        # deterministic, the waiter's callback intact —
+                        # instead of killing the serve loop.
+                        # (_maybe_preempt's fit check keeps this
+                        # unreachable in practice.)
+                        self._parked.popleft()
+                        pending.appendleft(pre.request)
+                        continue
+                    if row is None:
+                        break       # resume once pages free up
+                    self._parked.popleft()
+                    self.resumes += 1
+                    burst.append(self._admit_import(row, pre, wt, wd,
+                                                    need, active))
                 while free_rows and bad_request is None:
                     if not pending and not exhausted and burst \
                             and not incremental:
@@ -2363,6 +2527,13 @@ class ContinuousBatcher:
                                                 wd, req0,
                                                 use_cache=not imported)
                     if row is None:
+                        # Allocation pressure: a strictly-higher-
+                        # priority head may suspend the lowest-priority
+                        # resident row (its pages free, its artifact
+                        # parks for resumption) and retry.
+                        if self._maybe_preempt(req0.priority, active,
+                                               free_rows):
+                            continue
                         break   # wait for an in-flight row to finish
                     pending.popleft()
                     if imported:
@@ -2380,10 +2551,31 @@ class ContinuousBatcher:
                                                    plan)
                     if res is not None:
                         burst.append(res)
+                # Every row busy: an incremental arrival of strictly
+                # higher priority must not wait a full request behind
+                # lower-priority residents — one eager non-blocking
+                # pull (pending stays <= 1, preserving the lazy-pull
+                # bound) makes it visible, and a successful preemption
+                # loops back to admit it before the next decode block.
+                if (not free_rows and incremental and self.preemptible
+                        and bad_request is None):
+                    if not pending and not exhausted:
+                        pull(block=False)
+                    if pending:
+                        it0 = pending[0]
+                        r0 = it0.request if isinstance(it0, Prefilled) \
+                            else it0
+                        if self._maybe_preempt(r0.priority, active,
+                                               free_rows):
+                            yield from self._finalize_burst(
+                                burst, active, free_rows)
+                            continue
                 yield from self._finalize_burst(burst, active, free_rows)
                 if not active:
                     if bad_request is not None:
                         raise bad_request
+                    if self._parked:
+                        continue    # resume parked work before idling
                     pull()
                     if not pending and exhausted:
                         return
@@ -2412,6 +2604,7 @@ class ContinuousBatcher:
             # dispatch and its device carry).
             self._inflight = None
             self._pipe_carry = self._pipe_host = None
+            self._parked.clear()    # pages already released at suspend
             for row in list(active):
                 self._finish(row, active, free_rows)
             # Dropped only after the rows are released, so an export
@@ -2579,6 +2772,11 @@ class ContinuousBatcher:
         """Record a burst-synced first token; Completion when it already
         finishes the request."""
         state.t_first = time.perf_counter()
+        if state.out:
+            # Resumed suspended import: the stream up to the suspension
+            # point is already in place (and a finished row is never
+            # suspended, so no instant completion here either).
+            return None
         state.last = tok
         state.out = [tok]
         if tok == state.req.stop_token or state.req.max_new_tokens == 1:
@@ -2979,6 +3177,108 @@ class ContinuousBatcher:
         self.spec_row_rounds += len(live)
         self.spec_committed += int(sum(int(nc[r]) for r in live))
         yield from self._commit_rows(g, nc, live, active, free_rows)
+
+    # -- priority preemption / drain migration ----------------------------
+
+    def _suspendable(self, state: _Row) -> bool:
+        """A row whose mid-stream state can be snapshotted right now:
+        it is decoding (a still-filling chunked prefill has no complete
+        KV to export), its first token has been fetched (an un-settled
+        admission burst entry has not), and the mode supports per-row
+        export at all."""
+        return (self.preemptible and state.decoding and bool(state.out)
+                and state.t_first > 0)
+
+    def _suspend_row(self, r: int, active: Dict[int, _Row],
+                     free_rows: List[int]) -> dict:
+        """Snapshot row ``r`` into a resumable KV artifact (pages past
+        the shared prefix + sampler state incl. the emitted tokens) and
+        release it — a suspended request IS a KV export, re-admitted
+        through ``submit(prefilled=...)`` here or on any matching
+        batcher."""
+        state = active[r]
+        art = self._export_row(r, state)
+        self._finish(r, active, free_rows)
+        return art
+
+    def _resume_fits(self, req: Request) -> bool:
+        """Whether ``req``'s suspended artifact could EVER re-import
+        into this pool: the import backs every position with own pages
+        (no prefix-cache discount — the pages arrive in the payload),
+        so a row admitted only thanks to a deep cache plan on a tight
+        pool must not be suspended locally — its resume would exceed
+        the pool outright and the parked artifact could never land."""
+        side = self.t_side
+        reserved = 1 + len(side.shared_pages) \
+            + (1 if side.tail_template is not None else 0)
+        return self._worst_pages(req)[0] <= side.n_pages - reserved
+
+    def _maybe_preempt(self, priority: int, active: Dict[int, _Row],
+                       free_rows: List[int]) -> bool:
+        """Suspend the lowest-priority suspendable row STRICTLY below
+        ``priority`` (ties: the newest) and park its artifact for local
+        resumption; False when no such victim exists.  Strictness is
+        the anti-thrash rule: equal-priority work never preempts, and a
+        parked row can only displace classes below its own."""
+        if not self.preemptible:
+            return False
+        victims = [(row.req.priority, -row.rid, r)
+                   for r, row in active.items()
+                   if self._suspendable(row)
+                   and row.req.priority < priority
+                   and self._resume_fits(row.req)]
+        if not victims:
+            return False
+        _, _, r = min(victims)
+        req = active[r].req
+        art = self._suspend_row(r, active, free_rows)
+        self._parked.append(Prefilled(req, art))
+        self.preemptions += 1
+        return True
+
+    def _preempt_everything(self, pending: deque, active: Dict[int, _Row],
+                            free_rows: List[int],
+                            source: Optional[SubmissionQueue]
+                            ) -> Iterator[Suspended]:
+        """:meth:`preempt_all`'s loop side — drain migration: yield a
+        :class:`Suspended` for EVERY in-flight request.  Resident
+        suspendable rows carry their KV artifact; everything else
+        (still-filling rows, queued arrivals, modes without per-row
+        export) requeues with ``artifact=None`` — lossless either way,
+        since nothing was delivered and completions are deterministic.
+        Parked artifacts and not-yet-admitted imports keep theirs."""
+        if source is not None:
+            # Queued arrivals must resolve too — a drained replica dies
+            # soon after, and a dangling submitter would hang forever.
+            while True:
+                item = source.poll(False)
+                if item is None or item is _CLOSED:
+                    break
+                pending.append(item)
+        # Stale overlap/pipeline device state dies with its rows.
+        self._inflight = None
+        self._pipe_carry = self._pipe_host = None
+        for r in sorted(active):
+            state = active[r]
+            art = (self._export_row(r, state)
+                   if self._suspendable(state) else None)
+            req = state.req
+            rid = state.rid
+            self._finish(r, active, free_rows)
+            yield Suspended(rid=rid, request=req, artifact=art)
+        while self._parked:
+            pre = self._parked.popleft()
+            yield Suspended(rid=int(pre.artifact.get("rid", -1)),
+                            request=pre.request, artifact=pre.artifact)
+        while pending:
+            item = pending.popleft()
+            if isinstance(item, Prefilled):
+                yield Suspended(rid=int(item.artifact.get("rid", -1)),
+                                request=item.request,
+                                artifact=item.artifact)
+            else:
+                yield Suspended(rid=-1, request=item, artifact=None)
+        self._preempt_event.clear()
 
     def _completion(self, row: _Row) -> Completion:
         now = time.perf_counter()

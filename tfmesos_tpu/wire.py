@@ -307,8 +307,17 @@ def iter_msgs(sock: socket.socket, framer: "Framer"):
     """Decoded messages from a blocking socket, until EOF ends the
     generator — the shared read loop of every long-lived control
     connection (fleet gateway/replica/registry/mux client).  A bad
-    frame raises :class:`WireError`; socket errors propagate."""
+    frame raises :class:`WireError`; socket errors propagate.
+
+    Consults the chaos recv hook per blocking read, like
+    :func:`recv_msg` — so fault plans can sever/delay the fleet's
+    long-lived links (mux connections, heartbeat streams, the
+    drain-migration KV handoff) mid-stream, not just the scheduler's
+    one-shot recv paths."""
     while True:
+        hook = _chaos_recv      # snapshot against a concurrent uninstall
+        if hook is not None:
+            hook(sock)
         data = sock.recv(65536)
         if not data:
             return
