@@ -1291,6 +1291,259 @@ def bench_fleet_priority(n_interactive=16, rows=3, workers=8,
         fleet.stop()
 
 
+def bench_fleet_soak(rows=2, workers=8, slow_delay_s=0.25,
+                     n_timed=16, soak_probe_deadline_ms=60.0,
+                     seed=20):
+    """Seeded chaos soak: a live 3-replica CPU fleet driven through a
+    GRAY failure (one replica alive-per-heartbeat but slow on every
+    dispatch — chaos ``slow_task``), a SIGKILL + autoscaler-tick
+    self-heal, a link sever, and a blue-green rollout, under continuous
+    two-class deadline-carrying traffic.  In-bench asserts (the PR's
+    acceptance criteria):
+
+    * ``fleet_soak_lost_requests`` == 0 — every feeder request
+      completes (failover, migration, and the rollout are lossless);
+    * deadline conformance — every deadline-carrying reply (completion
+      OR deadline_exceeded error) lands within deadline + epsilon,
+      and the short-deadline probes against long decodes come back as
+      explicit ``deadline_exceeded`` about at their deadline (the
+      in-batcher cancel), never as a late completion;
+    * ``fleet_soak_retry_amplification`` <= 1.5 — attempts per
+      completed request stay bounded through all of the above (the
+      retry budget's job);
+    * the slow replica is breaker-isolated (state OPEN, latency
+      outlier) while the registry still reports it ALIVE — and the
+      CONTROL arm (same seed, same fault, breakers disabled) shows the
+      interactive p99 degrading toward the injected delay, proving the
+      mechanism and not the workload.
+    """
+    import threading
+
+    from tfmesos_tpu.backends.local import LocalBackend
+    from tfmesos_tpu.chaos import Fault, FaultPlan
+    from tfmesos_tpu.fleet.admission import PriorityClass
+    from tfmesos_tpu.fleet.autoscaler import (AutoscalerConfig,
+                                              FleetAutoscaler)
+    from tfmesos_tpu.fleet.client import FleetClient, RequestFailed
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 97, size=(8,)).astype(np.int32)
+               for _ in range(16)]
+    classes = [PriorityClass("interactive", weight=8.0, rank=1),
+               PriorityClass("background", weight=1.0, rank=0)]
+    eps_s = 2.0                     # CPU-scale scheduling epsilon
+
+    def p99(vals):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+    def build(breakers):
+        plan = FaultPlan([], seed=seed)
+        fleet = FleetServer(
+            replicas=3, rows=rows, tiny=True, max_len=64, page_size=16,
+            prefill_bucket=16, workers=workers, max_queue=256,
+            priority_classes=classes, breakers=breakers,
+            min_replicas=1, max_replicas=3,
+            request_timeout=300.0, start_timeout=300.0,
+            backend=LocalBackend(chaos=plan))
+        fleet.start()
+        # The gray victim is chosen deterministically; its fault is
+        # appended post-start (addresses exist only now) with an
+        # explicit delay so the plan stays seed-reproducible.
+        victim = sorted(r.addr for r in fleet.registry.alive())[0]
+        plan.faults.append(Fault("slow_task", "wire.send", nth=1,
+                                 target=victim, delay_s=slow_delay_s))
+        plan.install()
+        return plan, fleet, victim
+
+    def timed_interactive(client, n):
+        walls = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            client.generate(prompts[i % len(prompts)], 2,
+                            priority="interactive", timeout=300.0,
+                            deadline_ms=120000.0)
+            walls.append((time.perf_counter() - t0) * 1000.0)
+        return walls
+
+    # ---- main arm: breakers ON, the full chaos timeline ----
+    plan, fleet, victim = build(breakers=True)
+    lost, completions = [], []
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def feeder(priority, new_tokens):
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+        i = 0
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                client.generate(prompts[i % len(prompts)], new_tokens,
+                                priority=priority, timeout=300.0,
+                                deadline_ms=120000.0)
+                with lock:
+                    completions.append(
+                        (time.perf_counter() - t0, 120.0))
+            except Exception as e:  # noqa: BLE001 - every loss recorded
+                if not stop.is_set():
+                    with lock:
+                        lost.append(e)
+                    return
+            i += 1
+        client.close()
+
+    try:
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+        client.generate(prompts[0], 2)              # warm the compiles
+        feeders = [
+            threading.Thread(target=feeder, args=("interactive", 2),
+                             daemon=True),
+            threading.Thread(target=feeder, args=("interactive", 2),
+                             daemon=True),
+            threading.Thread(target=feeder, args=("background", 8),
+                             daemon=True),
+        ]
+        for f in feeders:
+            f.start()
+
+        # Phase A — gray failure: traffic feeds the latency EWMAs until
+        # the victim's breaker trips on the outlier, while its
+        # heartbeats keep it ALIVE in the registry the whole time.
+        deadline = time.perf_counter() + 300.0
+        while victim not in fleet.router.breakers.open_addrs():
+            assert time.perf_counter() < deadline, \
+                "slow replica never breaker-isolated"
+            assert not lost, f"request lost in gray phase: {lost[0]!r}"
+            time.sleep(0.05)
+        assert victim in [r.addr for r in fleet.registry.alive()], \
+            "victim must be heartbeat-alive while breaker-open " \
+            "(that is what makes the failure gray)"
+        on_p99 = p99(timed_interactive(client, n_timed))
+
+        # Deadline probes: long decodes against a deadline far shorter
+        # than they need — the reply must be an explicit
+        # deadline_exceeded about AT the deadline (in-batcher cancel /
+        # router fail-fast), and any completion must beat it.
+        probe_violations = 0
+        for i in range(4):
+            t0 = time.perf_counter()
+            try:
+                client.generate(prompts[i], 48,
+                                deadline_ms=soak_probe_deadline_ms,
+                                timeout=300.0)
+                wall_s = time.perf_counter() - t0
+                if wall_s > soak_probe_deadline_ms / 1000.0 + eps_s:
+                    probe_violations += 1    # post-deadline completion
+            except RequestFailed as e:
+                wall_s = time.perf_counter() - t0
+                if e.kind != "deadline_exceeded" \
+                        or wall_s > soak_probe_deadline_ms / 1000.0 \
+                        + eps_s:
+                    probe_violations += 1
+        assert probe_violations == 0, \
+            f"{probe_violations} deadline probes violated conformance"
+
+        # Phase B — hard churn: SIGKILL a healthy (non-victim) replica
+        # whole (process group — a real death, in-flight work fails
+        # over); hand-stepped autoscaler ticks relaunch it (crash
+        # self-heal).  The death must be OBSERVED (task table or
+        # registry) before convergence is waited on, or the wait would
+        # trivially pass against the pre-kill state.
+        members = {r.addr: r for r in fleet.registry.members()}
+        dead_node = next(r.node for a, r in sorted(members.items())
+                         if a != victim and r.node)
+        assert plan.kill(dead_node), f"no pid for {dead_node}"
+        deadline = time.perf_counter() + 300.0
+        while fleet.tier_actual("unified") >= 3 \
+                and len(fleet.registry.alive()) >= 3:
+            assert time.perf_counter() < deadline, \
+                "SIGKILLed replica never observed dead"
+            time.sleep(0.05)
+        calm = {"queue_wait_p99_ms": 0.0, "util": 0.5,
+                "kv_headroom": None}
+        auto = FleetAutoscaler(
+            fleet, AutoscalerConfig(scale_up_cooldown=0.0,
+                                    scale_down_cooldown=0.0),
+            signals=lambda: {"unified": dict(calm)})
+        deadline = time.perf_counter() + 300.0
+        while fleet.tier_actual("unified") < 3 \
+                or len(fleet.registry.alive()) < 3:
+            assert time.perf_counter() < deadline, \
+                "autoscaler never relaunched the killed replica"
+            auto.step()
+            time.sleep(0.1)
+
+        # A one-shot link sever against a healthy replica: the router
+        # drops the link, retries elsewhere, the heartbeat revives it.
+        other = next(a for a in sorted(
+            r.addr for r in fleet.registry.alive()) if a != victim)
+        plan.faults.append(Fault("sever", "wire.send", nth=1,
+                                 target=other, delay_s=0.0))
+
+        # Phase C — blue-green rollout under the same traffic.
+        fleet.rollout("v2", bake_s=0.3)
+        stop.set()
+        for f in feeders:
+            f.join(timeout=300.0)
+        assert not lost, f"request lost in soak: {lost[0]!r}"
+        # Deadline conformance over the whole soak: no completion came
+        # back after its (generous) deadline + epsilon.
+        late = [w for w, dl in completions if w > dl + eps_s]
+        assert not late, f"{len(late)} completions beat their deadline"
+
+        c = fleet.snapshot()["counters"]
+        completed = c.get("completed", 1)
+        amplification = (completed + c.get("retries", 0)) \
+            / max(1, completed)
+        assert amplification <= 1.5, \
+            f"retry amplification {amplification:.3f} > 1.5"
+        n_requests = len(completions)
+        client.close()
+    finally:
+        stop.set()
+        plan.uninstall()
+        fleet.stop()
+
+    # ---- control arm: breakers OFF, same seed, same gray fault ----
+    plan, fleet, victim = build(breakers=False)
+    try:
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+        client.generate(prompts[0], 2)              # warm the compiles
+        # Background pressure so p2c spreads the timed requests over
+        # the whole tier (idle fleets always pick the least-loaded).
+        stop = threading.Event()
+
+        def pressure():
+            i = 0
+            while not stop.is_set():
+                try:
+                    client.generate(prompts[i % len(prompts)], 8,
+                                    priority="background",
+                                    timeout=300.0)
+                except Exception:   # noqa: BLE001 - ambient load only
+                    return
+                i += 1
+
+        bg = threading.Thread(target=pressure, daemon=True)
+        bg.start()
+        control_walls = timed_interactive(client, 3 * n_timed)
+        stop.set()
+        bg.join(timeout=300.0)
+        control_p99 = p99(control_walls)
+        client.close()
+    finally:
+        stop.set()
+        plan.uninstall()
+        fleet.stop()
+    assert control_p99 > on_p99, \
+        (f"control (no breakers) p99 {control_p99:.1f}ms not above "
+         f"breakered p99 {on_p99:.1f}ms — isolation unproven")
+    assert max(control_walls) >= slow_delay_s * 1000.0, \
+        "control arm never even touched the slow replica"
+    return 0, amplification, on_p99, control_p99, n_requests
+
+
 def bench_bandwidth(sizes=None):
     """Achieved bandwidth vs roofline.
 
@@ -1691,6 +1944,21 @@ def main():
             unloaded_p99, 2)
         out["fleet_background_p99_ttft_ms"] = round(bg_p99, 2)
         out["fleet_migration_lost_requests"] = int(lost)
+        flush_partial()
+    sk = attempts(bench_fleet_soak, "fleet chaos soak", n=1)
+    if sk:
+        # Failure containment under seeded chaos: zero lost requests
+        # and bounded retry amplification through a gray-slow replica
+        # (breaker-isolated while heartbeat-alive), a SIGKILL +
+        # autoscaler self-heal, a link sever, and a rollout — with the
+        # breaker-disabled control arm's p99 degradation recorded next
+        # to the protected p99 (in-bench asserted strictly worse).
+        lost, amplification, on_p99, control_p99, n_soak = sk[0]
+        out["fleet_soak_lost_requests"] = int(lost)
+        out["fleet_soak_retry_amplification"] = round(amplification, 3)
+        out["fleet_soak_p99_ms"] = round(on_p99, 2)
+        out["fleet_soak_nobreaker_p99_ms"] = round(control_p99, 2)
+        out["fleet_soak_requests"] = int(n_soak)
         flush_partial()
     dg = attempts(bench_fleet_disagg, "disaggregated fleet bench", n=1)
     if dg:

@@ -217,6 +217,16 @@ _HEAVY_MULTICHIP = {
     "test_mesh_batcher_token_identical[axes3-sampled]",
     "test_overlap_batcher_token_identical[stop]",
     "test_overlap_batcher_token_identical[spec_sampled]",
+    # Budget headroom offsetting PR 8's new containment/deadline tests
+    # (all tier-1): sibling-covered preempt-matrix variants move to the
+    # full suite — greedy + sampled keep the resume-stream contract in
+    # tier-1, and the int8/chunked/pcache axes stay covered by the
+    # warmup/prefix/multistep families above; the second mesh prefix-
+    # cache variant rides along.
+    "test_preempt_resume_token_identical[int8]",
+    "test_preempt_resume_token_identical[chunked]",
+    "test_preempt_resume_token_identical[pcache]",
+    "test_prefix_cache_with_mesh[axes1]",
 }
 
 

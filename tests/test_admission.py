@@ -88,8 +88,8 @@ def test_per_class_queue_bounds_and_shed_counters():
     with pytest.raises(Overloaded):
         adm.admit(9, cls="interactive")
     sheds = adm.shed_counts()
-    assert sheds["background"] == (1, 0)
-    assert sheds["interactive"] == (1, 0)
+    assert sheds["background"] == (1, 0, 0)
+    assert sheds["interactive"] == (1, 0, 0)
     assert adm.class_depths() == {"interactive": 8, "background": 2}
     assert adm.depth() == 10
 
@@ -120,7 +120,7 @@ def test_rate_limit_still_sheds_after_capacity_check():
     with pytest.raises(RateLimited):
         adm.admit("b")
     sheds = adm.shed_counts()
-    assert sheds["default"] == (0, 1)
+    assert sheds["default"] == (0, 1, 0)
 
 
 def test_unlabeled_and_unknown_labels_ride_the_first_class():

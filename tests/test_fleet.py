@@ -1216,7 +1216,12 @@ def test_fleet_drain_migration_no_lost_requests(fleet, tiny_offline):
     afterwards so the fixture fleet is unchanged for later tests."""
     cfg, offline = tiny_offline
     prompts = _e2e_prompts(cfg, 6, seed=17)
-    wants = [24 + (i % 4) for i in range(6)]
+    # Long decodes (but still within the 64-position budget for the
+    # longest prompt): after a warm module run a 24-token request could
+    # FINISH inside the observe->drain->migrate window, leaving the
+    # migrate nothing to move — the work must comfortably outlive that
+    # window for the export path to be deterministic, not a coin flip.
+    wants = [36 + (i % 4) for i in range(6)]
     client = fleet.client(timeout=300.0)
     for p in prompts[:2]:                   # compiles off the hot window
         client.generate(p, 2)
@@ -1235,10 +1240,12 @@ def test_fleet_drain_migration_no_lost_requests(fleet, tiny_offline):
     try:
         for t in threads:
             t.start()
-        # The victim must be a replica with router-visible in-flight
-        # work, or the migration would have nothing to move.
+        # The victim must be a replica with SEVERAL router-visible
+        # in-flight requests (>= 2, not just the first to hit the
+        # wire), or the migration may race their completions and have
+        # nothing to move.
         assert _wait(lambda: any(
-            fleet.router.outstanding(r.addr) > 0
+            fleet.router.outstanding(r.addr) >= 2
             for r in fleet.registry.alive()), timeout=30.0)
         victim = max(fleet.registry.alive(),
                      key=lambda r: fleet.router.outstanding(r.addr)).addr
@@ -1247,6 +1254,11 @@ def test_fleet_drain_migration_no_lost_requests(fleet, tiny_offline):
     finally:
         for t in threads:
             t.join(timeout=300.0)
+        if victim is not None:
+            # Restore the fixture even when an assert below fails: a
+            # still-pinned drain would cascade into every later test
+            # in this module (they expect N_E2E_REPLICAS routable).
+            fleet.registry.clear_drain(victim)
     assert not errors, errors
     assert all(not t.is_alive() for t in threads)
     for i in range(6):
@@ -1258,9 +1270,8 @@ def test_fleet_drain_migration_no_lost_requests(fleet, tiny_offline):
     assert c.get("migration_exports", 0) >= 1
     assert c.get("migration_resumes", 0) \
         + c.get("migration_reruns", 0) >= 1
-    # Restore the fixture: release the drain; the victim's next beat
+    # The drain was released in the finally; the victim's next beat
     # revives it.
-    fleet.registry.clear_drain(victim)
     assert _wait(lambda: len(fleet.registry.alive()) == N_E2E_REPLICAS,
                  timeout=30.0)
     client.close()

@@ -1670,6 +1670,22 @@ def test_prefix_cache_cow_falls_back_on_tight_pool(setup):
     assert st["hit_pages"] == 2     # trimmed from the full 3-page match
 
 
+
+def _wait_first_admission(b, deadline_s=120.0):
+    """Block until the batcher has ADMITTED the first submission (rid
+    assigned).  The class-aware admission order (PR 8) rank-orders
+    everything pending at pull time — a preemption test must land its
+    low-priority request BEFORE the outranking one is even submitted,
+    or the batcher would simply admit them in rank order and never
+    need to preempt."""
+    import time as _time
+
+    deadline = _time.monotonic() + deadline_s
+    while b._next_rid == 0:
+        assert _time.monotonic() < deadline, "first request never admitted"
+        _time.sleep(0.005)
+
+
 # -- priority preemption & suspend/resume (docs/SERVING.md "Priorities,
 # preemption & migration") --------------------------------------------------
 
@@ -1735,6 +1751,7 @@ def test_preempt_resume_token_identical(setup, variant):
     t = threading.Thread(target=drive, daemon=True)
     t.start()
     b1.submit(A)        # rid 0, admitted first
+    _wait_first_admission(b1)   # A resident BEFORE B exists
     b1.submit(B)        # rid 1, outranks A -> suspends it mid-stream
     deadline = _time.monotonic() + 120.0
     while b1.preemptions < 1:
@@ -1789,6 +1806,7 @@ def test_preempt_strictness_and_parked_resume(setup):
     t = threading.Thread(target=drive, daemon=True)
     t.start()
     b.submit(Request(prompt=pA.copy(), max_new_tokens=10, priority=3))
+    _wait_first_admission(b)    # pA resident BEFORE the outranker
     b.submit(Request(prompt=pB.copy(), max_new_tokens=4, priority=5))
     deadline = _time.monotonic() + 120.0
     while b.resumes < 1:
@@ -1833,6 +1851,7 @@ def test_suspended_artifact_validation(setup):
     t = threading.Thread(target=drive, daemon=True)
     t.start()
     b.submit(req)
+    _wait_first_admission(b)    # req resident BEFORE the outranker
     # An outranking arrival suspends req deterministically mid-stream
     # (the same trigger test_preempt_resume_token_identical relies on).
     b.submit(Request(prompt=pB, max_new_tokens=24, priority=5))
@@ -1856,3 +1875,125 @@ def test_suspended_artifact_validation(setup):
     with pytest.raises(ValueError):             # "finished" artifact
         b2.validate(Prefilled(
             Request(prompt=p, max_new_tokens=art["step"]), art))
+
+
+# -- end-to-end deadlines & class-aware admission order ----------------------
+# (docs/SERVING.md "Deadlines & failure containment")
+
+
+def test_deadline_expired_arrival_shed_before_prefill(setup):
+    """An arrival whose deadline passed while it waited is shed at the
+    admission gate — an Expired in the stream, no prefill dispatched,
+    and the live request behind it unaffected."""
+    import time as _time
+
+    from tfmesos_tpu.serving import Expired
+
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, params, rows=2)
+    ps = _prompts(cfg, 2, seed=5)
+    doomed = Request(prompt=ps[0], max_new_tokens=8, deadline_ms=1.0)
+    live = Request(prompt=ps[1], max_new_tokens=4)
+    _time.sleep(0.01)           # the 1ms budget is long gone
+    out = list(b.run([doomed, live]))
+    exp = [c for c in out if isinstance(c, Expired)]
+    comps = [c for c in out if isinstance(c, Completion)]
+    assert len(exp) == 1 and exp[0].request is doomed
+    assert exp[0].rid == -1     # never admitted: no rid was burned
+    assert len(comps) == 1 and comps[0].request is live
+    assert comps[0].tokens == _offline(cfg, params, live)
+    assert b.deadline_cancels == 1
+
+
+def test_deadline_cancels_resident_row_and_frees_slot(setup):
+    """THE in-batcher deadline acceptance, rows=1: a resident decoding
+    row whose deadline passes is cancelled like a finished one — pages
+    freed immediately, Expired yielded — and the next request admits
+    into the freed slot and completes exactly.  The expiry is forced
+    deterministically (the deadline attribute is host state the loop
+    re-reads every tick), not timed."""
+    import threading
+    import time as _time
+
+    from tfmesos_tpu.serving import Expired
+
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, params, rows=1)
+    ps = _prompts(cfg, 2, seed=6)
+    doomed = Request(prompt=ps[0], max_new_tokens=64,
+                     deadline_ms=3_600_000.0)      # far future, for now
+    live = Request(prompt=ps[1], max_new_tokens=6)
+    out = []
+
+    def drive():
+        for c in b.serve():
+            out.append(c)
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    b.submit(doomed)
+    deadline = _time.monotonic() + 120.0
+    while b._next_rid == 0:     # admitted (rid assigned) ...
+        assert _time.monotonic() < deadline, "never admitted"
+        _time.sleep(0.005)
+    doomed.deadline = 0.0       # ... then the client's budget "expires"
+    b.submit(live)
+    b.close()
+    t.join(timeout=300.0)
+    assert not t.is_alive()
+    exp = [c for c in out if isinstance(c, Expired)]
+    comps = [c for c in out if isinstance(c, Completion)]
+    assert len(exp) == 1 and exp[0].rid == 0 \
+        and exp[0].request is doomed
+    assert b.deadline_cancels == 1
+    # The freed slot served the live request to an exact completion.
+    assert len(comps) == 1 and comps[0].request is live
+    assert comps[0].tokens == _offline(cfg, params, live)
+    # Stream order: the cancel surfaced before (or without) any tokens
+    # of the live request — dead work did not outlive its deadline.
+    assert out.index(exp[0]) < out.index(comps[0])
+
+
+def test_deadline_validation(setup):
+    with pytest.raises(ValueError):
+        Request(prompt=np.asarray([1, 2], np.int32), max_new_tokens=2,
+                deadline_ms=0.0)
+    with pytest.raises(ValueError):
+        Request(prompt=np.asarray([1, 2], np.int32), max_new_tokens=2,
+                deadline_ms=-5.0)
+    r = Request(prompt=np.asarray([1, 2], np.int32), max_new_tokens=2)
+    assert r.deadline is None and not r.expired
+
+
+def test_batcher_admission_orders_by_class_rank(setup):
+    """Satellite (ROADMAP item 3 follow-up): pulled arrivals admit by
+    priority rank — FIFO within a rank — matching the WFQ gateway's
+    dispatch discipline instead of pure submission FIFO.  rid is
+    assigned at admission, so the rid each request got IS the admission
+    order."""
+    cfg, params = setup
+    b = ContinuousBatcher(cfg, params, rows=1)
+    ps = _prompts(cfg, 4, seed=7)
+    reqs = [Request(prompt=ps[0], max_new_tokens=2, priority=0),
+            Request(prompt=ps[1], max_new_tokens=2, priority=5),
+            Request(prompt=ps[2], max_new_tokens=2, priority=5),
+            Request(prompt=ps[3], max_new_tokens=2, priority=0)]
+    for r in reqs:
+        b.submit(r)
+    b.close()
+    comps = [c for c in b.serve() if isinstance(c, Completion)]
+    rid_of = {id(c.request): c.rid for c in comps}
+    # Both rank-5 requests admit first (their own submission order
+    # kept), then the rank-0 ones (theirs kept too).
+    assert rid_of[id(reqs[1])] == 0
+    assert rid_of[id(reqs[2])] == 1
+    assert rid_of[id(reqs[0])] == 2
+    assert rid_of[id(reqs[3])] == 3
+    # Single-rank traffic stays exact FIFO (the degenerate case every
+    # pre-priority test in this file keeps asserting implicitly).
+    b2 = ContinuousBatcher(cfg, params, rows=1)
+    for r in [Request(prompt=p, max_new_tokens=2) for p in ps]:
+        b2.submit(r)
+    b2.close()
+    order = [c.rid for c in b2.serve()]
+    assert order == [0, 1, 2, 3]

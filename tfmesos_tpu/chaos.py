@@ -48,8 +48,16 @@ log = get_logger("tfmesos_tpu.chaos")
 #: Actions a fault can take when its trigger fires.  ``kill_task`` /
 #: ``drop_agent`` execute from ANY site (the trigger is just a counter);
 #: ``sever`` / ``delay`` / ``truncate`` / ``drop`` are interpreted by the
-#: hook site that observed the event (wire or registry).
-ACTIONS = ("kill_task", "drop_agent", "sever", "delay", "truncate", "drop")
+#: hook site that observed the event (wire or registry).  ``slow_task``
+#: is the GRAY-FAILURE generator: from its ``nth`` matching event ON it
+#: stays live forever (``count`` is ignored — a slow task stays slow)
+#: and injects a ``delay_s`` sleep into every matching event, e.g. every
+#: ``wire.send`` toward one replica's addr — the process is alive, its
+#: heartbeats are on time, and every dispatch is deterministically slow;
+#: exactly the failure a circuit breaker (not a liveness registry) must
+#: catch.
+ACTIONS = ("kill_task", "drop_agent", "sever", "delay", "truncate",
+           "drop", "slow_task")
 
 
 @dataclass
@@ -61,7 +69,10 @@ class Fault:
     "time" for a fixed-delay timer armed at install).
     ``nth``    — fires on the nth matching event (1-based); with
     ``count`` > 1 it stays live for that many consecutive matching events
-    (e.g. drop 5 heartbeats in a row).  Each fault keeps its OWN counter
+    (e.g. drop 5 heartbeats in a row).  ``slow_task`` ignores ``count``:
+    once armed at its nth event it delays EVERY later matching event
+    (``fired`` records only the arming, so a long soak cannot bloat it).
+    Each fault keeps its OWN counter
     of matching events, cumulative across every key its target matches.
     ``target`` — optional substring filter against the event's key (a task
     name ``job:index`` for launches, ``host:port`` peers for wire events,
@@ -211,7 +222,14 @@ class FaultPlan:
                 # means the 2nd launch of ANY worker, not per-task (and
                 # fires exactly once, not once per matching key).
                 n = self._fault_hits[i] = self._fault_hits.get(i, 0) + 1
-                if f.nth <= n < f.nth + f.count:
+                if f.action == "slow_task":
+                    # Persistent gray failure: armed at the nth event,
+                    # live forever after.
+                    if n >= f.nth:
+                        due.append(f)
+                        if n == f.nth:
+                            self.fired.append((site, key, f.action, n))
+                elif f.nth <= n < f.nth + f.count:
                     due.append(f)
                     self.fired.append((site, key, f.action, n))
         for f in due:
@@ -233,19 +251,31 @@ class FaultPlan:
                 return
             log.warning("chaos: dropping agent (site %s)", site)
             backend.chaos_drop_agent()
-        elif f.action == "delay":
+        elif f.action in ("delay", "slow_task"):
+            # slow_task: the same seeded, deterministic sleep as delay,
+            # just applied to every matching event once armed.
             time.sleep(f.delay_s or 0.0)
         # sever/truncate/drop are interpreted by the observing hook.
 
     def kill(self, name: str) -> bool:
-        """SIGKILL the registered pid of task ``job:index`` — the
-        preemption/oom stand-in.  Returns False when the task was never
-        observed (or already reaped)."""
+        """SIGKILL the registered task ``job:index`` — the
+        preemption/oom stand-in.  Kills the whole PROCESS GROUP when
+        the pid leads one (LocalBackend launches tasks with
+        start_new_session, and a Mode-B shell=True command's python
+        lives UNDER the registered sh pid — killing only the wrapper
+        would orphan the real task alive, a death that never
+        happened), falling back to the single pid otherwise.  Returns
+        False when the task was never observed (or already reaped)."""
         pid = self.pid(name)
         if pid is None:
             log.warning("chaos: kill_task %r: no registered pid", name)
             return False
         log.warning("chaos: SIGKILL task %s (pid %d)", name, pid)
+        try:
+            os.killpg(pid, signal.SIGKILL)
+            return True
+        except (ProcessLookupError, PermissionError):
+            pass
         try:
             os.kill(pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):

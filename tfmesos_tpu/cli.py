@@ -239,6 +239,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="disable drain migration: scale-downs and "
                         "rollouts wait for in-flight work instead of "
                         "suspending it and resuming on survivors")
+    p.add_argument("--no-breakers", action="store_false",
+                   dest="breakers", default=True,
+                   help="disable the router's per-replica circuit "
+                        "breakers (consecutive-failure and latency-"
+                        "outlier tripping with half-open probe "
+                        "recovery — the gray-failure containment; "
+                        "docs/SERVING.md 'Deadlines & failure "
+                        "containment')")
     p.add_argument("--rate", type=float, default=None,
                    help="token-bucket admission rate, requests/s "
                         "(default: unlimited)")
@@ -385,6 +393,16 @@ def build_submit_parser() -> argparse.ArgumentParser:
                    help="admission class label (e.g. 'background'); "
                         "unlabeled requests ride the fleet's default "
                         "class")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   dest="deadline_ms",
+                   help="end-to-end deadline in ms from gateway "
+                        "receipt: expired work is shed in the "
+                        "admission queue, failed fast by the router, "
+                        "and cancelled inside the replicas (an "
+                        "explicit deadline_exceeded error, never a "
+                        "late answer); default: no deadline — the "
+                        "fleet's flat request timeout applies "
+                        "(docs/MIGRATION.md)")
     p.add_argument("--timeout", type=float, default=300.0)
     return p
 
@@ -414,7 +432,8 @@ def submit_main(argv: List[str]) -> int:
         client = FleetClient(args.gateway, token, timeout=args.timeout)
         out = client.generate(prompt, args.max_new_tokens,
                               stop_token=args.stop_token,
-                              priority=args.priority)
+                              priority=args.priority,
+                              deadline_ms=args.deadline_ms)
     except Overloaded as e:
         print(f"tfserve submit: shed ({e.kind}): {e} — back off and "
               f"retry", file=sys.stderr)
@@ -547,6 +566,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         workers=args.workers, max_queue=args.max_queue, rate=args.rate,
         burst=args.burst, max_retries=args.retries,
         priority_classes=classes, migrate_on_drain=args.migrate,
+        breakers=args.breakers,
         prefix_cache_pages=args.prefix_cache,
         pipeline_depth=args.pipeline_depth, warmup=args.warmup,
         report_interval=args.metrics_interval or None,

@@ -48,11 +48,14 @@ never touches an accelerator.
 
 from __future__ import annotations
 
-from tfmesos_tpu.fleet.admission import (AdmissionController, Overloaded,
+from tfmesos_tpu.fleet.admission import (AdmissionController,
+                                         DeadlineExceeded, Overloaded,
                                          RateLimited, TokenBucket)
 from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig, FleetAutoscaler
 from tfmesos_tpu.fleet.client import (ConnectionLost, FleetClient,
                                       MuxConnection, RequestFailed)
+from tfmesos_tpu.fleet.containment import (BreakerBoard, BreakerConfig,
+                                           RetryBudget)
 from tfmesos_tpu.fleet.gateway import Gateway
 from tfmesos_tpu.fleet.launcher import FleetServer, RolloutError
 from tfmesos_tpu.fleet.metrics import FleetMetrics
@@ -61,8 +64,10 @@ from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
 from tfmesos_tpu.fleet.router import Router, RoutingError
 
 __all__ = [
-    "AdmissionController", "Overloaded", "RateLimited", "TokenBucket",
+    "AdmissionController", "Overloaded", "RateLimited",
+    "DeadlineExceeded", "TokenBucket",
     "AutoscalerConfig", "FleetAutoscaler", "RolloutError",
+    "BreakerBoard", "BreakerConfig", "RetryBudget",
     "ConnectionLost", "FleetClient", "MuxConnection", "RequestFailed",
     "Gateway", "FleetServer", "FleetMetrics", "ReplicaInfo",
     "ReplicaRegistry", "Router", "RoutingError",

@@ -2,13 +2,23 @@
 
 Overload policy, in order of application:
 
-1. **Per-class bounded queues**: every request belongs to a priority
+1. **Deadline sheds**: a request whose end-to-end deadline has already
+   passed is rejected with :class:`DeadlineExceeded` BEFORE the
+   capacity check and BEFORE the token bucket is consulted — expired
+   work must cost the fleet nothing, not a queue slot and not a rate
+   token (the client gave up; serving it would be pure waste).  The
+   same check runs again at DISPATCH (:meth:`AdmissionController.get`):
+   a request that expired while waiting in its class queue is shed
+   there with the per-class ``shed_deadline`` counter and handed to the
+   ``on_expired`` callback so the gateway can still answer the client
+   explicitly.
+2. **Per-class bounded queues**: every request belongs to a priority
    class (the ``priority``/``tenant`` label on the wire, mapped here);
    each class has its own queue bound, and a full class sheds with
    :class:`Overloaded` WITHOUT touching any other class's capacity — a
    background flood fills the background queue and sheds there, while
    interactive arrivals keep being admitted.
-2. **Token-bucket rate limiter** (optional): a sustained requests/s cap
+3. **Token-bucket rate limiter** (optional): a sustained requests/s cap
    with a burst allowance, checked only AFTER the queue-capacity check
    so a shed never burns a token (an overloaded gateway must not
    double-penalize clients).  Over-rate arrivals are rejected with
@@ -39,8 +49,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["Overloaded", "RateLimited", "TokenBucket", "PriorityClass",
-           "AdmissionController"]
+__all__ = ["Overloaded", "RateLimited", "DeadlineExceeded", "TokenBucket",
+           "PriorityClass", "AdmissionController"]
 
 
 class Overloaded(Exception):
@@ -53,6 +63,14 @@ class RateLimited(Overloaded):
     """Explicit shed: the token bucket is empty."""
 
     kind = "rate_limited"
+
+
+class DeadlineExceeded(Exception):
+    """Explicit shed: the request's end-to-end deadline already passed.
+    Deliberately NOT an :class:`Overloaded` — the fleet is not asking
+    the client to back off, it is telling it this request is dead."""
+
+    kind = "deadline_exceeded"
 
 
 class TokenBucket:
@@ -123,14 +141,15 @@ class _ClassQ:
     """One class's live state: spec + queue + WFQ tag + shed counters."""
 
     __slots__ = ("spec", "q", "last_tag", "shed_queue", "shed_rate",
-                 "admitted")
+                 "shed_deadline", "admitted")
 
     def __init__(self, spec: PriorityClass):
         self.spec = spec
-        self.q: deque = deque()     # (finish_tag, seq, item)
+        self.q: deque = deque()     # (finish_tag, seq, item, deadline)
         self.last_tag = 0.0
         self.shed_queue = 0
         self.shed_rate = 0
+        self.shed_deadline = 0
         self.admitted = 0
 
 
@@ -163,9 +182,15 @@ class AdmissionController:
         # listed class — operators list highest-priority first, so
         # adding a background tier never degrades existing clients.
         self._default = specs[0].name
+        self._clock = clock
         self._cond = threading.Condition()
         self._vtime = 0.0           # virtual time = last dispatched tag
         self._seq = 0               # FIFO tiebreak within equal tags
+        # Called (outside the lock) with each item shed at DISPATCH
+        # time because its deadline passed while queued — the gateway
+        # hooks this to send the client its explicit
+        # ``deadline_exceeded`` error instead of a silent drop.
+        self.on_expired: Optional[Any] = None
 
     # -- class resolution --------------------------------------------------
 
@@ -184,10 +209,17 @@ class AdmissionController:
 
     # -- admission ---------------------------------------------------------
 
-    def admit(self, item: Any, cls: Optional[str] = None) -> None:
+    def admit(self, item: Any, cls: Optional[str] = None,
+              deadline: Optional[float] = None) -> None:
         """Enqueue ``item`` under class ``cls`` or raise — never blocks
-        the caller's connection thread.  Capacity is checked BEFORE the
-        token bucket is debited: a shed must not also burn a token
+        the caller's connection thread.  ``deadline`` is an absolute
+        clock reading (the controller's ``clock``, monotonic by
+        default) past which the request is dead: an already-expired
+        arrival sheds FIRST — before the capacity check and before the
+        token bucket, which must not be debited for work nobody will
+        wait for — and a queued item that expires before dispatch is
+        shed by :meth:`get`.  Capacity is checked BEFORE the token
+        bucket is debited: a shed must not also burn a token
         (double-penalizing clients exactly when the gateway is already
         overloaded)."""
         spec = self.resolve(cls)
@@ -195,6 +227,11 @@ class AdmissionController:
         bound = spec.max_queue if spec.max_queue is not None \
             else self.max_queue
         with self._cond:
+            if deadline is not None and self._clock() >= deadline:
+                c.shed_deadline += 1
+                raise DeadlineExceeded(
+                    f"request deadline expired before admission "
+                    f"(class {spec.name!r})")
             if len(c.q) >= bound:
                 c.shed_queue += 1
                 raise Overloaded(
@@ -211,15 +248,32 @@ class AdmissionController:
             tag = max(self._vtime, c.last_tag) + 1.0 / spec.weight
             c.last_tag = tag
             self._seq += 1
-            c.q.append((tag, self._seq, item))
+            c.q.append((tag, self._seq, item, deadline))
             c.admitted += 1
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Any]:
         """Next admitted item in WFQ order (smallest finish tag wins;
         FIFO within a class), or ``None`` on timeout — workers poll so
-        shutdown never needs queue poisoning."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        shutdown never needs queue poisoning.  Items whose deadline
+        passed while queued are shed here, BEFORE dispatch: each counts
+        its class's ``shed_deadline`` and is handed to ``on_expired``
+        (outside the lock), and the walk continues to the next live
+        item — expired work never reaches a router worker."""
+        item, expired = self._get(timeout)
+        cb = self.on_expired
+        if cb is not None:
+            for it in expired:
+                try:
+                    cb(it)
+                except Exception:   # pragma: no cover - gateway's duty
+                    pass
+        return item
+
+    def _get(self, timeout: Optional[float]) -> tuple:
+        poll_deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        expired = []
         with self._cond:
             while True:
                 best = None
@@ -227,16 +281,20 @@ class AdmissionController:
                     if c.q and (best is None or c.q[0][:2] < best.q[0][:2]):
                         best = c
                 if best is not None:
-                    tag, _, item = best.q.popleft()
+                    tag, _, item, dl = best.q.popleft()
                     if tag > self._vtime:
                         self._vtime = tag
-                    return item
-                remaining = None if deadline is None \
-                    else deadline - time.monotonic()
+                    if dl is not None and self._clock() >= dl:
+                        best.shed_deadline += 1
+                        expired.append(item)
+                        continue
+                    return item, expired
+                remaining = None if poll_deadline is None \
+                    else poll_deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
-                    return None
+                    return None, expired
                 if not self._cond.wait(remaining):
-                    return None
+                    return None, expired
 
     # -- observability -----------------------------------------------------
 
@@ -250,8 +308,9 @@ class AdmissionController:
         with self._cond:
             return {name: len(c.q) for name, c in self._classes.items()}
 
-    def shed_counts(self) -> Dict[str, Tuple[int, int]]:
-        """Per-class ``(queue sheds, rate sheds)`` since start."""
+    def shed_counts(self) -> Dict[str, Tuple[int, int, int]]:
+        """Per-class ``(queue sheds, rate sheds, deadline sheds)``
+        since start."""
         with self._cond:
-            return {name: (c.shed_queue, c.shed_rate)
+            return {name: (c.shed_queue, c.shed_rate, c.shed_deadline)
                     for name, c in self._classes.items()}

@@ -204,18 +204,29 @@ class FleetClient:
     def generate(self, prompt, max_new_tokens: int,
                  stop_token: Optional[int] = None,
                  timeout: Optional[float] = None,
-                 priority: Optional[str] = None) -> Dict[str, Any]:
+                 priority: Optional[str] = None,
+                 deadline_ms: Optional[float] = None) -> Dict[str, Any]:
         """One generation request; returns the completion dict
         (``tokens``, ``ttft_ms``, ``total_ms``).  Raises ``Overloaded``
         on shed, :class:`RequestFailed` on any other error reply.
         ``priority`` names the gateway admission class this request
         rides in (e.g. ``"background"``); unlabeled requests take the
-        fleet's default (first-listed) class."""
+        fleet's default (first-listed) class.  ``deadline_ms`` is the
+        END-TO-END budget from gateway receipt: expired work is shed
+        in the admission queue, failed fast by the router, and
+        cancelled inside the replicas (surfacing here as
+        :class:`RequestFailed` with kind ``deadline_exceeded``); no
+        deadline preserves the flat server-side timeout behavior."""
         msg = {"op": "generate", "prompt": [int(t) for t in prompt],
                "max_new_tokens": int(max_new_tokens),
                "stop_token": stop_token}
         if priority is not None:
             msg["priority"] = str(priority)
+        if deadline_ms is not None:
+            if not deadline_ms > 0:
+                raise ValueError(f"deadline_ms must be > 0, got "
+                                 f"{deadline_ms}")
+            msg["deadline_ms"] = float(deadline_ms)
         reply = self._mux.call(
             msg, timeout=timeout if timeout is not None else self.timeout)
         if isinstance(reply, dict) and reply.get("op") == "completion":

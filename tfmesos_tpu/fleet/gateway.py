@@ -9,11 +9,21 @@ replica and relay the completion back on the client's connection.
 Wire surface (all frames HMAC-authenticated with the cluster token):
 
 * ``{"op": "generate", "id", "prompt", "max_new_tokens", "stop_token",
-  "priority"}`` → ``{"op": "completion", "id", "tokens", "ttft_ms",
-  "total_ms"}`` or ``{"op": "error", "id", "kind", "error"}`` with
-  ``kind`` one of ``overloaded`` / ``rate_limited`` (admission shed —
-  back off), ``unavailable`` (no replica within the retry budget),
-  ``bad_request``.  ``priority`` (optional; ``tenant`` is an alias) is
+  "priority", "deadline_ms"}`` → ``{"op": "completion", "id", "tokens",
+  "ttft_ms", "total_ms"}`` or ``{"op": "error", "id", "kind", "error"}``
+  with ``kind`` one of ``overloaded`` / ``rate_limited`` (admission
+  shed — back off), ``unavailable`` (no replica within the retry
+  budget), ``bad_request``, ``deadline_exceeded`` (the request's
+  end-to-end budget ran out — shed in the admission queue, failed fast
+  by the router, or cancelled inside a replica's batcher; never
+  retried).  ``deadline_ms`` (optional) is the request's END-TO-END
+  budget in milliseconds from gateway receipt: the gateway stamps an
+  absolute deadline, the WFQ queues shed expired work before dispatch,
+  the router slices the remainder across its phases, and the replica's
+  batcher cancels an expired resident row and frees its pages — no
+  deadline preserves the flat ``request_timeout`` behavior exactly
+  (docs/SERVING.md "Deadlines & failure containment").
+  ``priority`` (optional; ``tenant`` is an alias) is
   the CLASS LABEL: it selects the weighted-fair admission queue the
   request waits in, and the class's preemption rank rides to the
   replica so a higher class can suspend lower-class resident rows under
@@ -42,7 +52,9 @@ import time
 from typing import Any, Dict, Optional, Set
 
 from tfmesos_tpu import wire
-from tfmesos_tpu.fleet.admission import AdmissionController, Overloaded, RateLimited
+from tfmesos_tpu.fleet.admission import (AdmissionController,
+                                         DeadlineExceeded, Overloaded,
+                                         RateLimited)
 from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.router import Router
 from tfmesos_tpu.utils.logging import get_logger
@@ -116,6 +128,16 @@ class Gateway:
         # Per-role replica counts + aggregate outstanding/headroom, so
         # a disaggregated deployment's snapshot shows each tier served.
         metrics.register_gauge("roles", self.registry.role_summary)
+        # Failure containment (docs/SERVING.md "Deadlines & failure
+        # containment"): breaker state and the retry-budget level are
+        # the on-call's first two questions during a brown-out, so they
+        # ride the snapshot AND the periodic report line.
+        metrics.register_gauge("breakers", router.breaker_summary)
+        metrics.register_gauge("retry_budget", router.retry_budget_level)
+        # Items that expired while queued still owe the client an
+        # explicit answer — the controller hands them back here from
+        # whichever worker's get() swept them.
+        admission.on_expired = self._queue_expired
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -255,14 +277,31 @@ class Gateway:
             label = msg.get("tenant")
         spec = self.admission.resolve(
             label if isinstance(label, str) else None)
+        # End-to-end deadline: the client ships a RELATIVE budget
+        # (clocks do not agree across hosts); the gateway stamps the
+        # absolute expiry the whole serving path measures against.
+        # A malformed or non-positive value costs the field, never the
+        # request — no deadline is today's flat-timeout behavior.
+        dl = msg.get("deadline_ms")
+        deadline = None
+        if isinstance(dl, (int, float)) and not isinstance(dl, bool) \
+                and dl > 0:
+            deadline = time.monotonic() + float(dl) / 1000.0
         forward = {"op": "generate", "prompt": msg.get("prompt"),
                    "max_new_tokens": msg.get("max_new_tokens"),
                    "stop_token": msg.get("stop_token"),
                    "priority": spec.rank}
+        if deadline is not None:
+            forward["deadline"] = deadline
         try:
             self.admission.admit((client, cid, forward,
                                   time.perf_counter(), spec.name),
-                                 cls=spec.name)
+                                 cls=spec.name, deadline=deadline)
+        except DeadlineExceeded as e:
+            self.metrics.inc("shed_deadline")
+            self.metrics.inc(f"shed_deadline_{spec.name}")
+            client.send({"op": "error", "id": cid, "kind": e.kind,
+                         "error": str(e)})
         except RateLimited as e:
             self.metrics.inc("shed_rate_limited")
             self.metrics.inc(f"shed_rate_limited_{spec.name}")
@@ -275,6 +314,20 @@ class Gateway:
                          "error": str(e)})
         else:
             self.metrics.inc("admitted")
+
+    def _queue_expired(self, item) -> None:
+        """One admitted request expired while waiting in its class
+        queue (AdmissionController.get shed it before dispatch): the
+        client still gets its explicit answer, and the books stay
+        consistent — it was admitted, so it counts as failed too."""
+        client, cid, _forward, _t_enq, cls = item
+        self.metrics.inc("shed_deadline")
+        self.metrics.inc(f"shed_deadline_{cls}")
+        self.metrics.inc("failed")
+        client.send({"op": "error", "id": cid,
+                     "kind": "deadline_exceeded",
+                     "error": "request deadline expired while queued "
+                              "at the gateway"})
 
     # -- dispatch ----------------------------------------------------------
 
@@ -326,4 +379,9 @@ class Gateway:
                 self.metrics.observe("latency_ms", out.get("total_ms"))
             else:
                 self.metrics.inc("failed")
+                if out.get("kind") == "deadline_exceeded":
+                    # Router fail-fast or an in-batcher cancel: either
+                    # way the deadline did its job — visible as its own
+                    # counter, not buried in generic failures.
+                    self.metrics.inc("deadline_exceeded")
             client.send(out)

@@ -107,6 +107,7 @@ class FleetServer:
                  burst: Optional[float] = None,
                  priority_classes: Optional[List[PriorityClass]] = None,
                  migrate_on_drain: bool = True,
+                 breakers: bool = True,
                  max_retries: int = 2, request_timeout: float = 120.0,
                  start_timeout: float = 300.0,
                  heartbeat_interval: float = 0.3,
@@ -199,6 +200,10 @@ class FleetServer:
         #: instead of waiting for them to finish (or worse, flushing
         #: them).  False restores plain drain-then-kill.
         self.migrate_on_drain = bool(migrate_on_drain)
+        #: per-replica circuit breakers in the router (consecutive-
+        #: failure + latency-outlier tripping); False is the bench's
+        #: control arm and an operator escape hatch, never the default.
+        self.breakers = bool(breakers)
         self.max_retries = int(max_retries)
         self.request_timeout = float(request_timeout)
         self.start_timeout = float(start_timeout)
@@ -282,7 +287,8 @@ class FleetServer:
             self.router = Router(self.registry, self.metrics,
                                  token=self.token,
                                  max_retries=self.max_retries,
-                                 request_timeout=self.request_timeout)
+                                 request_timeout=self.request_timeout,
+                                 breakers=self.breakers)
             self.admission = AdmissionController(
                 max_queue=self.max_queue, rate=self.rate,
                 burst=self.burst, classes=self.priority_classes)

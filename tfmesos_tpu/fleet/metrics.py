@@ -9,7 +9,11 @@ control plane stays stdlib-only, like the rest of the framework.
 
 Consistency contract (asserted by the end-to-end tests): after the
 gateway drains, ``received == admitted + shed_queue + shed_rate_limited``
-and ``admitted == completed + failed``.
+and ``admitted == completed + failed`` — deadline-carrying traffic adds
+``shed_deadline`` (requests shed for an expired end-to-end deadline,
+at admission or while queued; the queued ones were admitted and so
+count under ``failed`` too) and ``deadline_exceeded`` (deadline errors
+relayed from the router/replicas, a subset of ``failed``).
 
 Prefix-affinity routing adds ``affinity_hits``/``affinity_misses``: one
 of the two per routing decision over a prompt-bearing request —

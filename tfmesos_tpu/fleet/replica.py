@@ -324,7 +324,9 @@ class BatcherServing:
         """``on_done(completion, error)``: exactly one of the two is
         set — ``completion`` may also be a
         :class:`~tfmesos_tpu.serving.Suspended` (drain migration gave
-        the request back instead of finishing it).  ``prefilled``
+        the request back instead of finishing it) or an
+        :class:`~tfmesos_tpu.serving.Expired` (the batcher cancelled
+        it because its end-to-end deadline passed).  ``prefilled``
         routes the request through the batcher's KV-import admission
         (disaggregated decode, or a migrated resume)."""
         with self._lock:
@@ -338,6 +340,17 @@ class BatcherServing:
         self.batcher.close()
         if self._thread is not None:
             self._thread.join(timeout=30.0)
+
+
+def _deadline_ms(head) -> Optional[float]:
+    """The remaining end-to-end budget the router forwarded (ms), or
+    None — a malformed or non-positive value costs the field, never
+    the request (the fleet's standard optional-field discipline)."""
+    dl = head.get("deadline_ms")
+    if isinstance(dl, (int, float)) and not isinstance(dl, bool) \
+            and dl > 0:
+        return float(dl)
+    return None
 
 
 def batcher_handler(serving: BatcherServing, generation: int = 0,
@@ -362,7 +375,7 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
     import numpy as np
 
     from tfmesos_tpu import serving as serving_mod
-    from tfmesos_tpu.serving import Prefilled, Request, Suspended
+    from tfmesos_tpu.serving import Expired, Prefilled, Request, Suspended
 
     batcher = serving.batcher
 
@@ -390,7 +403,8 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                 prompt=np.asarray(head.get("prompt"), np.int32),
                 max_new_tokens=int(head.get("max_new_tokens") or 0),
                 stop_token=head.get("stop_token"),
-                priority=int(prio) if prio is not None else 0)
+                priority=int(prio) if prio is not None else 0,
+                deadline_ms=_deadline_ms(head))
             if raw:
                 prefilled = serving_mod.unpack_prefilled(head, msg.body)
                 batcher.validate(Prefilled(req, prefilled))
@@ -409,6 +423,15 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
             if comp is None:
                 reply({"op": "error", "id": mid, "kind": "internal",
                        "error": err or "request dropped"})
+                return
+            if isinstance(comp, Expired):
+                # The batcher cancelled the row (deadline passed):
+                # explicit, deterministic, and never retried — the
+                # router treats deadline_exceeded as final.
+                reply({"op": "error", "id": mid,
+                       "kind": "deadline_exceeded",
+                       "error": "request deadline expired in the "
+                                "batcher; row cancelled"})
                 return
             if isinstance(comp, Suspended):
                 if comp.artifact is None:
@@ -457,6 +480,15 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
     def drain() -> None:
         while True:
             req, mid, reply = work_q.get()
+            if req.expired:
+                # The deadline passed while queued: shed without
+                # burning a prompt's worth of prefill compute.
+                batcher.deadline_cancels += 1
+                reply({"op": "error", "id": mid,
+                       "kind": "deadline_exceeded",
+                       "error": "request deadline expired in the "
+                                "prefill queue"})
+                continue
             try:
                 t0 = _time.perf_counter()
                 art = batcher.export_kv(req)
@@ -495,7 +527,8 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
                 prompt=np.asarray(head.get("prompt"), np.int32),
                 max_new_tokens=int(head.get("max_new_tokens") or 0),
                 stop_token=head.get("stop_token"),
-                priority=int(prio) if prio is not None else 0)
+                priority=int(prio) if prio is not None else 0,
+                deadline_ms=_deadline_ms(head))
             batcher.validate(req)
         except (TypeError, ValueError) as e:
             reply({"op": "error", "id": mid, "kind": "bad_request",

@@ -66,6 +66,33 @@ replica, which is equally lossless because nothing was delivered and
 completions are deterministic.  The client sees one completion, never
 the move; ``migration_*`` counters make each path observable.
 
+**Failure containment** (docs/SERVING.md "Deadlines & failure
+containment"): three mechanisms bound every failure's blast radius:
+
+* **End-to-end deadlines**: a request forwarded with a ``deadline``
+  (absolute, gateway-stamped) fails FAST with ``deadline_exceeded``
+  the moment its budget runs out — at the loop head, never mid-retry —
+  and every wire call's timeout is a SLICE of the remaining budget
+  (non-final attempts keep half back for a retry, the disagg prefill
+  phase keeps three quarters back for decode) instead of the flat
+  ``request_timeout``, so one hung replica can never consume the whole
+  budget.  The remaining budget is re-stamped onto the wire as
+  ``deadline_ms`` per attempt, so the replica's own in-batcher cancel
+  works from the same (shrinking) clock.
+* **Fleet retry budget** (:class:`~tfmesos_tpu.fleet.containment.
+  RetryBudget`): every failover debits a token-ratio budget refilled by
+  delivered completions — under a brown-out the fleet degrades to ~1
+  attempt per request instead of multiplying its own load, and an
+  exhausted budget converts retryable errors into fast deterministic
+  failures (``retry_budget_exhausted`` counter).
+* **Per-replica circuit breakers** (:class:`~tfmesos_tpu.fleet.
+  containment.BreakerBoard`): consecutive failures OR a success-latency
+  EWMA far above the peer median trip a replica out of every candidate
+  set — the latter is the first mechanism that catches a GRAY failure,
+  a replica the heartbeat registry still reports alive but that serves
+  100x slow.  Half-open single-probe recovery; state exported through
+  ``describe()`` and the gateway's ``breakers`` gauge.
+
 **Warming replicas** (registered with ``status: warming`` while
 ``ContinuousBatcher.warmup`` compiles their entry points) are excluded
 by EVERY pick — ``pick``/``pick_prefill``/``pick_decode`` all candidate
@@ -87,6 +114,8 @@ from typing import Any, Dict, Iterable, List, Optional
 
 from tfmesos_tpu import prefixhash, wire
 from tfmesos_tpu.fleet.client import CallTimeout, ConnectionLost, MuxConnection
+from tfmesos_tpu.fleet.containment import (BreakerBoard, BreakerConfig,
+                                           RetryBudget)
 from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
                                         ReplicaInfo, ReplicaRegistry)
@@ -106,7 +135,10 @@ class Router:
                  token: str = "", max_retries: int = 2,
                  backoff_s: float = 0.05, request_timeout: float = 120.0,
                  connect_timeout: float = 10.0,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 breakers: bool = True,
+                 breaker_config: Optional[BreakerConfig] = None,
+                 retry_budget: Optional[RetryBudget] = None):
         self.registry = registry
         self.metrics = metrics
         self.token = token
@@ -118,6 +150,12 @@ class Router:
         self._rng = rng or random.Random()
         self._links: Dict[str, MuxConnection] = {}
         self._lock = threading.Lock()
+        # Failure containment (module docstring): per-replica circuit
+        # breakers (None = disabled — the bench's control arm) and the
+        # fleet-wide retry budget.
+        self.breakers: Optional[BreakerBoard] = \
+            BreakerBoard(breaker_config) if breakers else None
+        self.budget = retry_budget or RetryBudget()
         # Blue-green rollout: when set, every tier's candidate set is
         # narrowed to replicas advertising THIS weights_version whenever
         # at least one such replica is routable — the shift point of
@@ -190,13 +228,129 @@ class Router:
         if pref:
             preferred = [r for r in cands if r.weights_version == pref]
             if preferred:
-                return preferred
+                return self._breaker_filter(preferred)
             if cands:
                 # Served by the non-preferred fallback: visible in the
                 # counters so a stuck rollout (bake window over, old
                 # version still serving) cannot hide.
                 self.metrics.inc("version_fallbacks")
+        return self._breaker_filter(cands)
+
+    def _breaker_filter(self, cands: List[ReplicaInfo]
+                        ) -> List[ReplicaInfo]:
+        """Drop candidates whose circuit breaker is open (a half-open
+        breaker with no probe in flight stays eligible — the next pick
+        of it IS the probe).  When EVERY candidate is tripped the full
+        set comes back: an all-open tier means the breakers have no
+        healthy alternative to offer, and failing every request fast
+        would turn a brown-out into a self-inflicted outage — the
+        ``breaker_saturated`` counter makes that state visible."""
+        if self.breakers is None or not cands:
+            return cands
+        allowed = [r for r in cands if self.breakers.eligible(r.addr)]
+        if allowed:
+            if len(allowed) < len(cands):
+                self.metrics.inc("breaker_skips",
+                                 len(cands) - len(allowed))
+            return allowed
+        self.metrics.inc("breaker_saturated")
         return cands
+
+    # -- containment hooks (breakers + budget + deadlines) -----------------
+
+    def _breaker_dispatch(self, addr: str) -> bool:
+        """True when THIS dispatch claimed the breaker's half-open
+        probe — threaded back into the outcome records so only the
+        sanctioned probe can close or re-open the breaker."""
+        if self.breakers is not None:
+            return self.breakers.on_dispatch(addr)
+        return False
+
+    def _breaker_ok(self, addr: str, t0: float,
+                    probe: bool = False) -> None:
+        if self.breakers is not None:
+            self.breakers.record_success(
+                addr, (time.monotonic() - t0) * 1000.0, probe=probe)
+
+    def _breaker_fail(self, addr: str, probe: bool = False) -> None:
+        if self.breakers is not None:
+            self.breakers.record_failure(addr, probe=probe)
+
+    def _charge_retry(self) -> bool:
+        """Debit the fleet retry budget for one failover; False means
+        the budget is exhausted — the caller fails fast instead of
+        retrying (brown-out containment: the fleet must not multiply
+        its own load when most requests are already failing)."""
+        if self.budget.try_retry():
+            return True
+        self.metrics.inc("retry_budget_exhausted")
+        self.log.warning("retry budget exhausted; failing fast instead "
+                         "of retrying")
+        return False
+
+    def breaker_summary(self):
+        """The gateway's ``breakers`` gauge (None = breakers off)."""
+        return self.breakers.summary() if self.breakers is not None \
+            else None
+
+    def retry_budget_level(self) -> float:
+        """The gateway's ``retry_budget`` gauge: 0..1 of budget left."""
+        return round(self.budget.level(), 3)
+
+    def describe(self) -> Dict[str, Any]:
+        """Containment state: per-replica breaker detail plus the
+        retry-budget level — the on-call's brown-out snapshot."""
+        return {
+            "breakers": (self.breakers.describe()
+                         if self.breakers is not None else {}),
+            "retry_budget": self.budget.level(),
+        }
+
+    @staticmethod
+    def _deadline_of(msg) -> Optional[float]:
+        """The gateway-stamped ABSOLUTE deadline riding the forward
+        dict (``time.monotonic`` base — same process as the gateway;
+        it never crosses the wire, see :meth:`_wire_msg`)."""
+        if not isinstance(msg, dict):
+            return None
+        dl = msg.get("deadline")
+        return float(dl) if isinstance(dl, (int, float)) \
+            and not isinstance(dl, bool) else None
+
+    def _expired_reply(self, what: str) -> Dict[str, Any]:
+        self.metrics.inc("deadline_expired_route")
+        return {"op": "error", "kind": "deadline_exceeded",
+                "error": f"request deadline expired {what}"}
+
+    def _wire_msg(self, msg: Dict[str, Any],
+                  deadline: Optional[float]) -> Dict[str, Any]:
+        """The dict that actually goes on the wire: the internal
+        absolute ``deadline`` stripped (a monotonic reading means
+        nothing on another host's clock) and the REMAINING budget
+        re-stamped as ``deadline_ms`` — recomputed per attempt, so a
+        retry hands the replica only what is actually left."""
+        if deadline is None and "deadline" not in msg:
+            return msg
+        out = {k: v for k, v in msg.items() if k != "deadline"}
+        if deadline is not None:
+            out["deadline_ms"] = round(
+                max(1.0, (deadline - time.monotonic()) * 1000.0), 3)
+        return out
+
+    def _call_timeout(self, deadline: Optional[float],
+                      final_attempt: bool = True,
+                      share: float = 1.0) -> float:
+        """The per-call wire timeout a phase may spend: the flat
+        ``request_timeout`` without a deadline; with one, a slice of
+        the remaining budget — non-final attempts keep half back for a
+        retry, and the disagg prefill phase passes ``share`` to keep
+        most of the budget for its decode phase."""
+        if deadline is None:
+            return self.request_timeout
+        rem = (deadline - time.monotonic()) * share
+        if not final_attempt:
+            rem *= 0.5
+        return min(self.request_timeout, max(0.05, rem))
 
     def _load_pick(self, cands) -> Optional[str]:
         """Least-outstanding with p2c sampling over ``cands``."""
@@ -330,24 +484,60 @@ class Router:
     #     never re-ship the identical doomed bytes to another replica.
 
     def _note_timeout(self, addr: str, tried: set, attempt: int,
-                      what: str) -> None:
+                      what: str, clipped: bool = False,
+                      probe: bool = False) -> bool:
+        """Returns whether the caller may retry (the fleet retry budget
+        gates every failover — see module docstring).  ``clipped=True``
+        for timeouts on a DEADLINE-CLIPPED slice: a call cut short by
+        the request's own budget says nothing about the replica's
+        health (charging the breaker would let short-deadline traffic
+        trip healthy replicas), and the retries it permits are bounded
+        by the DEADLINE — the loop-head expiry check ends them — not by
+        the fleet budget, which must keep its runway for real
+        failures."""
         tried.add(addr)
+        if not clipped:
+            self._breaker_fail(addr, probe)
+            if not self._charge_retry():
+                return False
         self.metrics.inc("retries")
-        self.log.warning("%s timed out on %s after %.0fs; retrying on "
+        self.log.warning("%s timed out on %s; retrying on "
                          "another replica (attempt %d/%d)", what, addr,
-                         self.request_timeout, attempt + 1,
-                         self.max_retries + 1)
+                         attempt + 1, self.max_retries + 1)
+        return True
 
     def _note_link_failure(self, e: BaseException, addr: str, tried: set,
-                           attempt: int, what: str) -> None:
+                           attempt: int, what: str,
+                           probe: bool = False) -> bool:
+        """Like :meth:`_note_timeout` for transport failures: the link
+        drops and the replica is marked dead REGARDLESS of the budget's
+        answer (the death is a fact either way); only the retry itself
+        is budget-gated."""
         tried.add(addr)
         self._drop_link(addr)
+        self._breaker_fail(addr, probe)
         self.registry.mark_dead(addr, why=f"{type(e).__name__}: {e}")
+        if not self._charge_retry():
+            return False
         self.metrics.inc("retries")
         self.log.warning("%s replica %s failed (%s); retrying on "
                          "another replica (attempt %d/%d)", what, addr, e,
                          attempt + 1, self.max_retries + 1)
         time.sleep(self.backoff_s * (2 ** attempt))
+        return True
+
+    def _note_replica_error(self, addr: str, tried: set,
+                            err: "RoutingError",
+                            probe: bool = False) -> bool:
+        """One transient replica-side error reply (internal failure,
+        pool exhaustion): breaker + budget bookkeeping shared by every
+        phase loop.  Returns whether the caller may retry."""
+        tried.add(addr)
+        self._breaker_fail(addr, probe)
+        if not self._charge_retry():
+            return False
+        self.metrics.inc("retries")
+        return True
 
     # -- drain migration: suspended replies re-place elsewhere -------------
 
@@ -398,58 +588,82 @@ class Router:
             return None
         wv = meta.get("weights_version")
         wv = wv if isinstance(wv, str) and wv else ""
-        call = {k: v for k, v in meta.items()
-                if k not in ("op", "id", "gen", "weights_version")}
-        call.update(op="generate", prompt=msg.get("prompt"),
-                    max_new_tokens=msg.get("max_new_tokens"),
-                    stop_token=msg.get("stop_token"),
-                    priority=msg.get("priority"))
+        deadline = self._deadline_of(msg)
+
+        def build_call(m):
+            out = {k: v for k, v in m.items()
+                   if k not in ("op", "id", "gen", "weights_version")}
+            out.update(op="generate", prompt=msg.get("prompt"),
+                       max_new_tokens=msg.get("max_new_tokens"),
+                       stop_token=msg.get("stop_token"),
+                       priority=msg.get("priority"))
+            return out
+
+        call = build_call(meta)
         for attempt in range(self.max_retries + 1):
+            if deadline is not None and time.monotonic() >= deadline:
+                return self._expired_reply("while resuming its "
+                                           "migrated state")
             addr = self._pick_resume(tried, wv)
             if addr is None:
                 break
+            rprobe = self._breaker_dispatch(addr)
+            t0 = time.monotonic()
+            timeout = self._call_timeout(deadline,
+                                         attempt >= self.max_retries)
             try:
                 reply = self._link(addr).call_raw(
-                    call, body, timeout=self.request_timeout)
-            except CallTimeout as e:
-                self._note_timeout(addr, tried, attempt, "resume")
+                    self._wire_msg(call, deadline), body,
+                    timeout=timeout)
+            except CallTimeout:
+                if not self._note_timeout(
+                        addr, tried, attempt, "resume",
+                        clipped=timeout < self.request_timeout,
+                        probe=rprobe):
+                    return None
                 continue
             except wire.WireError:
                 # The artifact cannot even be encoded for the wire:
                 # deterministic for the PAYLOAD — re-run instead.
                 return None
             except (ConnectionLost, OSError) as e:
-                self._note_link_failure(e, addr, tried, attempt,
-                                        "resume")
+                if not self._note_link_failure(e, addr, tried, attempt,
+                                               "resume", probe=rprobe):
+                    return None
                 continue
             s = self._suspended_of(reply)
             if s is not None:
                 # The resume target is being drained too: carry the
                 # FRESHEST artifact onward (it holds more tokens).
+                # Healthy outcome for the breaker (see route()).
+                self._breaker_ok(addr, t0, rprobe)
                 tried.add(addr)
                 self.metrics.inc("migration_exports")
                 meta2, body2 = s
                 if body2 is None or not self.registry.gen_allowed(
                         meta2.get("gen")):
                     return None
-                call = {k: v for k, v in meta2.items()
-                        if k not in ("op", "id", "gen",
-                                     "weights_version")}
-                call.update(op="generate", prompt=msg.get("prompt"),
-                            max_new_tokens=msg.get("max_new_tokens"),
-                            stop_token=msg.get("stop_token"),
-                            priority=msg.get("priority"))
+                call = build_call(meta2)
                 body = body2
                 continue
             if isinstance(reply, dict) and reply.get("op") == "error":
+                if reply.get("kind") == "deadline_exceeded":
+                    # The replica's own in-batcher cancel fired: final
+                    # for the request, not a resume failure.
+                    return reply
                 if reply.get("kind") == "bad_request":
                     # Deterministic for THIS artifact (geometry/config
                     # mismatch): re-running the request still works.
                     self.metrics.inc("migration_rejected")
                     return None
-                tried.add(addr)
-                self.metrics.inc("retries")
+                if not self._note_replica_error(
+                        addr, tried, RoutingError(
+                            f"resume failed on {addr}: "
+                            f"{reply.get('error')}"),
+                        probe=rprobe):
+                    return None
                 continue
+            self._breaker_ok(addr, t0, rprobe)
             self.metrics.inc("migration_resumes")
             return reply
         return None
@@ -468,22 +682,46 @@ class Router:
         re-running it from scratch — before the retry budget is ever
         charged a failure."""
         last: Optional[BaseException] = None
+        deadline = self._deadline_of(msg)
         if isinstance(msg, dict) and msg.get("op") == "generate":
             out, last = self._route_disagg(msg)
             if out is not None:
                 return out
         tried = set()
+        deadline_cut = False
         prompt = msg.get("prompt") if isinstance(msg, dict) else None
         for attempt in range(self.max_retries + 1):
+            if deadline is not None and time.monotonic() >= deadline:
+                # Fail fast, at the loop head: the client has given up,
+                # and every further attempt (including the first) would
+                # be pure waste — this is what keeps retries from
+                # burning TPU time on expired work.
+                return self._expired_reply("before a replica could "
+                                           "serve it")
             addr = self.pick(exclude=tried, prompt=prompt)
             if addr is None:
                 break       # nothing (left) to try
+            probe = self._breaker_dispatch(addr)
+            t0 = time.monotonic()
+            timeout = self._call_timeout(deadline,
+                                         attempt >= self.max_retries)
             try:
                 link = self._link(addr)
-                reply = link.call(msg, timeout=self.request_timeout)
+                reply = link.call(self._wire_msg(msg, deadline),
+                                  timeout=timeout)
             except CallTimeout as e:
                 last = e
-                self._note_timeout(addr, tried, attempt, "request")
+                if timeout < self.request_timeout:
+                    # The call was cut short by the DEADLINE slice, not
+                    # the flat timeout: if the loop ends here, the
+                    # deadline — not replica availability — is the root
+                    # cause, and the client error must say so.
+                    deadline_cut = True
+                if not self._note_timeout(
+                        addr, tried, attempt, "request",
+                        clipped=timeout < self.request_timeout,
+                        probe=probe):
+                    break
                 continue
             except wire.WireError as e:
                 # Deterministic for this request (it could not even be
@@ -492,16 +730,46 @@ class Router:
                     f"request not encodable for {addr}: {e}") from e
             except (ConnectionLost, OSError) as e:
                 last = e
-                self._note_link_failure(e, addr, tried, attempt,
-                                        "generate")
+                if not self._note_link_failure(e, addr, tried, attempt,
+                                               "generate", probe=probe):
+                    break
                 continue
             s = self._suspended_of(reply)
             if s is None:
+                if isinstance(reply, dict) \
+                        and reply.get("op") == "error":
+                    if reply.get("kind") in ("bad_request",
+                                             "deadline_exceeded"):
+                        # Deterministic rejection: FINAL for the
+                        # request, but not a success — it must neither
+                        # refill the retry budget (which refills on
+                        # delivered completions only, or a brown-out
+                        # failing fast would keep re-arming its own
+                        # retries) nor feed the breaker's success EWMA
+                        # (a fast rejection would dilute a gray-slow
+                        # replica's average and delay its isolation).
+                        return reply
+                    # Transient replica-side failure: breaker food, and
+                    # another replica may still serve it.
+                    err = RoutingError(
+                        f"request failed on {addr}: "
+                        f"{reply.get('error')}")
+                    last = err
+                    if not self._note_replica_error(addr, tried, err,
+                                                    probe=probe):
+                        break
+                    continue
+                self._breaker_ok(addr, t0, probe)
+                self.budget.on_success()
                 return reply
             # Drain migration: the replica gave the request back.  The
             # victim is excluded (it is leaving), the artifact resumes
             # elsewhere — or the loop continues and re-runs the plain
-            # request on a survivor, losing nothing either way.
+            # request on a survivor, losing nothing either way.  The
+            # prompt reply is a HEALTHY outcome for the breaker (a
+            # drain is control-plane intent, not a failure — and a
+            # half-open probe answered with `suspended` must not wedge).
+            self._breaker_ok(addr, t0, probe)
             tried.add(addr)
             self.metrics.inc("migration_exports")
             out = self._resume_elsewhere(msg, s[0], s[1], tried)
@@ -510,6 +778,9 @@ class Router:
             self.metrics.inc("migration_reruns")
             last = RoutingError(
                 f"replica {addr} suspended the request mid-stream")
+        if deadline_cut and isinstance(last, CallTimeout):
+            return self._expired_reply(
+                "in flight (every budget slice timed out)")
         if last is not None:
             raise RoutingError(
                 f"no replica could serve the request after trying "
@@ -551,9 +822,13 @@ class Router:
                 self.metrics.inc("disagg_fallback")
             return None, None
         last: Optional[BaseException] = None
+        deadline = self._deadline_of(msg)
         ptried: set = set()
         t0 = time.perf_counter()
         for attempt in range(self.max_retries + 1):
+            if deadline is not None and time.monotonic() >= deadline:
+                return self._expired_reply("before prefill could "
+                                           "run"), None
             paddr = self.pick_prefill(exclude=ptried, prompt=prompt)
             if paddr is None:
                 break               # prefill tier exhausted
@@ -561,12 +836,23 @@ class Router:
                     "max_new_tokens": msg.get("max_new_tokens"),
                     "stop_token": msg.get("stop_token"),
                     "priority": msg.get("priority")}
+            pprobe = self._breaker_dispatch(paddr)
+            tp = time.monotonic()
+            # The prefill phase spends at most a quarter of the
+            # remaining budget: decode is the long phase, and a hung
+            # prefill replica must leave it a real slice.
+            timeout = self._call_timeout(
+                deadline, attempt >= self.max_retries, share=0.25)
             try:
                 praw = self._link(paddr).call(
-                    call, timeout=self.request_timeout)
+                    self._wire_msg(call, deadline), timeout=timeout)
             except CallTimeout as e:
                 last = e
-                self._note_timeout(paddr, ptried, attempt, "prefill")
+                if not self._note_timeout(
+                        paddr, ptried, attempt, "prefill",
+                        clipped=timeout < self.request_timeout,
+                        probe=pprobe):
+                    break
                 continue
             except wire.WireError as e:
                 # The prefill call is the same small JSON dict the
@@ -576,20 +862,25 @@ class Router:
                     f"request not encodable for {paddr}: {e}") from e
             except (ConnectionLost, OSError) as e:
                 last = e
-                self._note_link_failure(e, paddr, ptried, attempt,
-                                        "prefill")
+                if not self._note_link_failure(e, paddr, ptried,
+                                               attempt, "prefill",
+                                               probe=pprobe):
+                    break
                 continue
             if isinstance(praw, dict):
-                if praw.get("kind") == "bad_request":
+                if praw.get("kind") in ("bad_request",
+                                        "deadline_exceeded"):
                     # Deterministic rejection: retrying elsewhere (or
                     # on the unified tier) cannot change the answer.
                     return praw, None
                 # Transient replica-side failure (internal error, pool
                 # exhaustion): another prefill replica may serve it.
-                last = RoutingError(
+                err = RoutingError(
                     f"prefill failed on {paddr}: {praw.get('error')}")
-                ptried.add(paddr)
-                self.metrics.inc("retries")
+                last = err
+                if not self._note_replica_error(paddr, ptried, err,
+                                                probe=pprobe):
+                    break
                 continue
             if not isinstance(praw, wire.RawFrame) \
                     or not isinstance(praw.meta, dict):
@@ -597,6 +888,7 @@ class Router:
                     f"malformed prefill reply from {paddr}")
                 ptried.add(paddr)
                 continue
+            self._breaker_ok(paddr, tp, pprobe)
             ttft_ms = (time.perf_counter() - t0) * 1000.0
             self.metrics.inc("disagg_prefills")
             out, derr = self._disagg_decode(msg, praw)
@@ -614,6 +906,7 @@ class Router:
                     out["total_ms"] = round(
                         (time.perf_counter() - t0) * 1000.0, 3)
                     self.metrics.inc("disagg_requests")
+                    self.budget.on_success()
                 return out, None
             # The decode tier could not take this VALID artifact within
             # its retry budget: re-running the whole prefill elsewhere
@@ -638,6 +931,7 @@ class Router:
                     max_new_tokens=msg.get("max_new_tokens"),
                     stop_token=msg.get("stop_token"),
                     priority=msg.get("priority"))
+        deadline = self._deadline_of(msg)
         last: Optional[BaseException] = None
         dtried: set = set()
         # A mid-stream artifact adopted from a drained decode replica
@@ -646,14 +940,22 @@ class Router:
         # artifacts carry no pin — the tier shares the fleet version).
         art_wv: Optional[str] = None
         for attempt in range(self.max_retries + 1):
+            if deadline is not None and time.monotonic() >= deadline:
+                return self._expired_reply("before decode could "
+                                           "run"), None
             daddr = self.pick_decode(exclude=dtried,
                                      weights_version=art_wv)
             if daddr is None:
                 return None, last
+            dprobe = self._breaker_dispatch(daddr)
+            timeout = self._call_timeout(deadline,
+                                         attempt >= self.max_retries)
             try:
                 t0 = time.perf_counter()
+                tm = time.monotonic()
                 reply = self._link(daddr).call_raw(
-                    meta, praw.body, timeout=self.request_timeout)
+                    self._wire_msg(meta, deadline), praw.body,
+                    timeout=timeout)
                 self.metrics.observe(
                     "kv_decode_turnaround_ms",
                     (time.perf_counter() - t0) * 1000.0)
@@ -663,8 +965,11 @@ class Router:
                 self.metrics.inc("kv_transfer_bytes", len(praw.body))
             except CallTimeout as e:
                 last = e
-                self._note_timeout(daddr, dtried, attempt,
-                                   "disagg decode")
+                if not self._note_timeout(
+                        daddr, dtried, attempt, "disagg decode",
+                        clipped=timeout < self.request_timeout,
+                        probe=dprobe):
+                    return None, last
                 continue
             except wire.WireError as e:
                 # Deterministic for this ARTIFACT (its meta — prompt +
@@ -677,8 +982,10 @@ class Router:
                     f"KV transfer to {daddr} not encodable: {e}")
             except (ConnectionLost, OSError) as e:
                 last = e
-                self._note_link_failure(e, daddr, dtried, attempt,
-                                        "disagg decode")
+                if not self._note_link_failure(e, daddr, dtried,
+                                               attempt, "disagg decode",
+                                               probe=dprobe):
+                    return None, last
                 continue
             s = self._suspended_of(reply)
             if s is not None:
@@ -687,6 +994,8 @@ class Router:
                 # decoded so far) and retry on another decode replica —
                 # or, on a requeue/fenced export, retry the ORIGINAL
                 # prefill artifact, which re-decodes deterministically.
+                # Healthy outcome for the breaker (see route()).
+                self._breaker_ok(daddr, tm, dprobe)
                 dtried.add(daddr)
                 self.metrics.inc("migration_exports")
                 meta2, body2 = s
@@ -706,6 +1015,11 @@ class Router:
                     f"decode replica {daddr} suspended the request")
                 continue
             if isinstance(reply, dict) and reply.get("op") == "error":
+                if reply.get("kind") == "deadline_exceeded":
+                    # The decode replica's in-batcher cancel fired:
+                    # final for the request — falling back to unified
+                    # would only burn more time on expired work.
+                    return reply, None
                 if reply.get("kind") == "bad_request":
                     # Deterministic for THIS artifact (a config
                     # mismatch between the tiers), not for the
@@ -719,11 +1033,14 @@ class Router:
                         f"artifact: {reply.get('error')}")
                 # Transient decode-side failure: another decode replica
                 # (or the unified fallback) may still serve it.
-                last = RoutingError(
+                err = RoutingError(
                     f"decode failed on {daddr}: {reply.get('error')}")
-                dtried.add(daddr)
-                self.metrics.inc("retries")
+                last = err
+                if not self._note_replica_error(daddr, dtried, err,
+                                                probe=dprobe):
+                    return None, last
                 continue
+            self._breaker_ok(daddr, tm, dprobe)
             self.metrics.inc("disagg_decodes")
             return reply, None
         return None, last

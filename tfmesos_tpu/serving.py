@@ -54,9 +54,9 @@ from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
                                             rejection_accept, sample_logits)
 from tfmesos_tpu.ops.quant import QTensor
 
-__all__ = ["Request", "Completion", "Suspended", "ContinuousBatcher",
-           "SubmissionQueue", "Prefilled", "pack_prefilled",
-           "unpack_prefilled"]
+__all__ = ["Request", "Completion", "Suspended", "Expired",
+           "ContinuousBatcher", "SubmissionQueue", "Prefilled",
+           "pack_prefilled", "unpack_prefilled"]
 
 # SubmissionQueue.poll's end-of-stream marker (distinct from None, which
 # means "nothing available right now, more may come").
@@ -125,12 +125,22 @@ class Request:
     lowest-priority resident row to admit a strictly-higher-priority
     arrival, parking its KV state for later resumption — resumed
     streams are token-identical to uninterrupted ones
-    (docs/SERVING.md "Priorities, preemption & migration")."""
+    (docs/SERVING.md "Priorities, preemption & migration").
+
+    ``deadline_ms`` is the request's remaining END-TO-END budget at
+    construction time (the fleet forwards the shrinking remainder hop
+    by hop — absolute clock readings mean nothing across hosts): the
+    batcher sheds an arrival whose deadline already passed without
+    burning a prefill, and CANCELS an expired resident row like a
+    finished one — pages freed immediately, an :class:`Expired` yielded
+    in the completion stream — so work the client has abandoned never
+    occupies a decode slot.  ``None`` (the default) never expires."""
 
     prompt: np.ndarray
     max_new_tokens: int
     stop_token: Optional[int] = None
     priority: int = 0
+    deadline_ms: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32)
@@ -142,6 +152,20 @@ class Request:
             raise ValueError(f"Request.max_new_tokens must be >= 1, got "
                              f"{self.max_new_tokens}")
         self.priority = int(self.priority)
+        self.deadline: Optional[float] = None
+        if self.deadline_ms is not None:
+            if not self.deadline_ms > 0:
+                raise ValueError(f"Request.deadline_ms must be > 0, got "
+                                 f"{self.deadline_ms}")
+            self.deadline = (time.perf_counter()
+                             + float(self.deadline_ms) / 1000.0)
+
+    @property
+    def expired(self) -> bool:
+        """Whether the end-to-end deadline has passed (always False
+        without one)."""
+        return (self.deadline is not None
+                and time.perf_counter() >= self.deadline)
 
 
 @dataclasses.dataclass
@@ -266,6 +290,20 @@ class Suspended:
     rid: int
     request: Request
     artifact: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class Expired:
+    """A request the batcher CANCELLED because its end-to-end deadline
+    passed — yielded in the completion stream wherever the Completion
+    would have gone (docs/SERVING.md "Deadlines & failure
+    containment").  A resident row's pages are freed the moment it
+    expires (dead work never occupies a decode slot); a queued arrival
+    is shed before its prefill ever dispatches.  ``rid`` is -1 when the
+    request never reached admission."""
+
+    rid: int
+    request: Request
 
 
 @dataclasses.dataclass
@@ -1178,6 +1216,10 @@ class ContinuousBatcher:
         self._preempt_event = threading.Event()
         self.preemptions = 0        # rows suspended for a higher class
         self.resumes = 0            # parked rows re-admitted locally
+        # End-to-end deadlines: arrivals shed expired + resident rows
+        # cancelled mid-decode (pages freed, Expired yielded) — the
+        # replica-side half of fleet deadline conformance.
+        self.deadline_cancels = 0
         # Speculative observability (see acceptance_rate).
         self.spec_rounds = 0        # jitted rounds executed
         self.spec_row_rounds = 0    # row-rounds (rows decoding per round)
@@ -2409,22 +2451,48 @@ class ContinuousBatcher:
         exhausted = False
         bad_request: Optional[Exception] = None
 
+        def rank_of(item):
+            return (item.request if isinstance(item, Prefilled)
+                    else item).priority
+
+        def rank_insert(item):
+            # Class-aware admission order (the batcher-side twin of the
+            # gateway's WFQ): pending stays sorted by priority rank,
+            # FIFO within a rank — an outranking arrival admits before
+            # earlier lower-class ones, and single-class traffic keeps
+            # the exact FIFO of old.  Stable: insert BEHIND every item
+            # of equal-or-higher rank.
+            p = rank_of(item)
+            i = len(pending)
+            while i > 0 and rank_of(pending[i - 1]) < p:
+                i -= 1
+            pending.insert(i, item)
+
         def pull(block=True):
             # ``block`` only matters for a SubmissionQueue source: the
             # admission loop polls non-blocking so an empty online queue
             # never stalls rows that are mid-decode, while the idle
-            # branch blocks (there is nothing else to do).  Iterables
-            # keep their original semantics — next() blocks when the
-            # generator does.
+            # branch blocks (there is nothing else to do).  An
+            # incremental pull drains EVERYTHING already submitted (the
+            # items are in host memory either way, and admission cannot
+            # rank-order arrivals it has not seen); iterables keep their
+            # original lazy one-at-a-time semantics — next() blocks when
+            # the generator does, and a generator's order is its order.
             nonlocal exhausted
-            if pending or exhausted:
+            if exhausted:
                 return
             if incremental:
-                item = requests.poll(block)
-                if item is _CLOSED:
-                    exhausted = True
-                elif item is not None:
-                    pending.append(item)
+                want_block = block and not pending
+                while True:
+                    item = requests.poll(want_block)
+                    want_block = False
+                    if item is _CLOSED:
+                        exhausted = True
+                        return
+                    if item is None:
+                        return
+                    rank_insert(item)
+            if pending:
                 return
             try:
                 pending.append(next(source))
@@ -2454,6 +2522,11 @@ class ContinuousBatcher:
                 # burst sync after it — admitting W requests costs one
                 # device-to-host round-trip, not W (the round-trip is
                 # the dominant per-call cost on remote-attached hosts).
+                # End-to-end deadlines: cancel expired resident rows
+                # NOW, before admission — their pages free this tick,
+                # so dead work never holds a decode slot a live arrival
+                # could take.
+                yield from self._cancel_expired(active, free_rows)
                 burst = []
                 # Parked (preempted) artifacts resume FIRST: they
                 # arrived before anything still queued, so a sustained
@@ -2461,11 +2534,19 @@ class ContinuousBatcher:
                 # strictly-OUTRANKING queued arrival still goes first
                 # (the gate below — and past it, the preemption rule
                 # itself); one eager pull makes such an arrival visible.
-                if self._parked and incremental and not pending \
-                        and not exhausted:
+                if self._parked and incremental:
                     pull(block=False)
                 while free_rows and self._parked and bad_request is None:
                     pre = self._parked[0]
+                    if pre.request.expired:
+                        # The client gave up while the artifact was
+                        # parked: drop it without re-importing.
+                        self._parked.popleft()
+                        self.deadline_cancels += 1
+                        yield Expired(rid=int(pre.artifact.get("rid",
+                                                               -1)),
+                                      request=pre.request)
+                        continue
                     if pending:
                         h = pending[0]
                         hreq = h.request if isinstance(h, Prefilled) \
@@ -2512,6 +2593,18 @@ class ContinuousBatcher:
                     item = pending[0]
                     imported = isinstance(item, Prefilled)
                     req0 = item.request if imported else item
+                    if req0.expired:
+                        # Shed BEFORE any prefill work (or import)
+                        # dispatches: the deadline passed while the
+                        # request waited, and serving it would burn
+                        # device time nobody is waiting for.
+                        pending.popleft()
+                        self.deadline_cancels += 1
+                        yield Expired(
+                            rid=(int(item.artifact.get("rid", -1))
+                                 if imported else -1),
+                            request=req0)
+                        continue
                     try:
                         wt, wd, need = self._worst_pages(req0)
                         if imported:
@@ -2559,8 +2652,7 @@ class ContinuousBatcher:
                 # loops back to admit it before the next decode block.
                 if (not free_rows and incremental and self.preemptible
                         and bad_request is None):
-                    if not pending and not exhausted:
-                        pull(block=False)
+                    pull(block=False)
                     if pending:
                         it0 = pending[0]
                         r0 = it0.request if isinstance(it0, Prefilled) \
@@ -3177,6 +3269,27 @@ class ContinuousBatcher:
         self.spec_row_rounds += len(live)
         self.spec_committed += int(sum(int(nc[r]) for r in live))
         yield from self._commit_rows(g, nc, live, active, free_rows)
+
+    # -- end-to-end deadlines ----------------------------------------------
+
+    def _cancel_expired(self, active: Dict[int, _Row],
+                        free_rows: List[int]) -> Iterator["Expired"]:
+        """Cancel every resident row whose deadline has passed —
+        exactly like a finish (pages released, row freed for the next
+        admission) except an :class:`Expired` is yielded instead of a
+        Completion.  Lag modes (overlap/pipelined) may have one more
+        block in flight for the row; its writes land inside the clamped
+        reservation or on sink columns and its tokens fail the
+        rid-checked retire ticket, the same discard semantics a
+        mid-block stop already has."""
+        expired = [r for r, row in active.items()
+                   if row.req.deadline is not None and row.req.expired]
+        for r in expired:
+            row = active[r]
+            self.deadline_cancels += 1
+            rid, req = row.rid, row.req
+            self._finish(r, active, free_rows)
+            yield Expired(rid=rid, request=req)
 
     # -- priority preemption / drain migration ----------------------------
 
