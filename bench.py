@@ -55,6 +55,14 @@ import numpy as np
 # vs_baseline accordingly.
 BASELINE_SELF = 10429.09
 
+
+def _p99(vals):
+    """Rank-index p99 shared by the fleet benches (priority, soak,
+    trace overhead) — ONE estimator, so the benches cannot silently
+    disagree about rounding."""
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
 # Published peak bf16 matmul throughput per chip and HBM bandwidth, by
 # device kind string (jax.devices()[0].device_kind).
 PEAK_BF16 = {
@@ -1174,9 +1182,7 @@ def bench_fleet_priority(n_interactive=16, rows=3, workers=8,
         client.generate(prompts[0], 2)          # warm the compiles
         client.generate(prompts[1], background_new)
 
-        def p99(vals):
-            vals = sorted(vals)
-            return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+        p99 = _p99
 
         def timed_batch(n, priority):
             walls = []
@@ -1334,9 +1340,7 @@ def bench_fleet_soak(rows=2, workers=8, slow_delay_s=0.25,
                PriorityClass("background", weight=1.0, rank=0)]
     eps_s = 2.0                     # CPU-scale scheduling epsilon
 
-    def p99(vals):
-        vals = sorted(vals)
-        return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+    p99 = _p99
 
     def build(breakers):
         plan = FaultPlan([], seed=seed)
@@ -1345,6 +1349,11 @@ def bench_fleet_soak(rows=2, workers=8, slow_delay_s=0.25,
             prefill_bucket=16, workers=workers, max_queue=256,
             priority_classes=classes, breakers=breakers,
             min_replicas=1, max_replicas=3,
+            # Pure tail-based retention: no head sampling, the slow
+            # threshold well under the injected delay — the gray
+            # failure's traces must retain themselves.
+            trace_sample=0.0,
+            trace_slow_ms=slow_delay_s * 1000.0 * 0.6,
             request_timeout=300.0, start_timeout=300.0,
             backend=LocalBackend(chaos=plan))
         fleet.start()
@@ -1492,6 +1501,34 @@ def bench_fleet_soak(rows=2, workers=8, slow_delay_s=0.25,
         late = [w for w, dl in completions if w > dl + eps_s]
         assert not late, f"{len(late)} completions beat their deadline"
 
+        # Tracing attribution (PR 10 acceptance): the injected
+        # slow_task delay is VISIBLE in the slow replica's traced
+        # spans — a retained trace holds a router attempt toward the
+        # victim carrying (at least) the injected delay, with the
+        # chaos firing recorded on the same trace.  The gray failure
+        # becomes attributable, not just breaker-detected.
+        slow_attempt_ms = 0.0
+        traced_fault = False
+        for rec in fleet.tracebook.slowest(100):
+            spans = rec.get("spans") or ()
+            has_fault = any(s.get("component") == "chaos"
+                            and s.get("action") == "slow_task"
+                            and victim in str(s.get("key", ""))
+                            for s in spans)
+            for s in spans:
+                if s.get("component") == "router" \
+                        and s.get("addr") == victim \
+                        and s.get("dur", 0.0) >= slow_delay_s * 900.0:
+                    slow_attempt_ms = max(slow_attempt_ms,
+                                          float(s["dur"]))
+                    traced_fault = traced_fault or has_fault
+        assert slow_attempt_ms > 0.0, \
+            "injected slow_task delay not visible in any traced span " \
+            "toward the slow replica"
+        assert traced_fault, \
+            "chaos slow_task firing not attributed inside the trace"
+        traces_detailed = fleet.tracebook.describe()["detailed"]
+
         c = fleet.snapshot()["counters"]
         completed = c.get("completed", 1)
         amplification = (completed + c.get("retries", 0)) \
@@ -1541,7 +1578,118 @@ def bench_fleet_soak(rows=2, workers=8, slow_delay_s=0.25,
          f"breakered p99 {on_p99:.1f}ms — isolation unproven")
     assert max(control_walls) >= slow_delay_s * 1000.0, \
         "control arm never even touched the slow replica"
-    return 0, amplification, on_p99, control_p99, n_requests
+    return (0, amplification, on_p99, control_p99, n_requests,
+            slow_attempt_ms, traces_detailed)
+
+
+def bench_fleet_trace_overhead(n_requests=240, workers=4, threads=2,
+                               handler_delay_s=0.01, best_of=3):
+    """Tracing overhead bound (PR 10 acceptance): the same seeded stub
+    workload — jax-free; the gateway/router/tracing machinery IS the
+    system under test — run with tracing at summary-only vs FULL span
+    detail on every request; the detailed arm's p99 must land within
+    5% of summary-only (+1ms absolute epsilon absorbing CPU scheduler
+    noise at these few-ms latencies).  Arms alternate order and each
+    takes its best-of-``best_of`` p99 — at this scale the scheduler's
+    tail jitter is bigger than any real software cost, and only the
+    min is a stable estimator of it.  Records
+    ``fleet_trace_overhead_pct``."""
+    import threading as _threading
+
+    from tfmesos_tpu import wire as _wire
+    from tfmesos_tpu.fleet.admission import AdmissionController
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.gateway import Gateway
+    from tfmesos_tpu.fleet.metrics import FleetMetrics
+    from tfmesos_tpu.fleet.registry import ReplicaRegistry
+    from tfmesos_tpu.fleet.replica import ReplicaServer
+    from tfmesos_tpu.fleet.router import Router
+    from tfmesos_tpu.fleet.tracing import TraceBook
+
+    p99 = _p99
+
+    def arm(sample, detail):
+        token = _wire.new_token()
+        reg = ReplicaRegistry(token=token, suspect_after=1.0,
+                              dead_after=2.0, evict_after=10.0).start()
+        servers = []
+
+        def handler(msg, reply):
+            def work():
+                time.sleep(handler_delay_s)
+                reply({"op": "completion", "id": msg.get("id"),
+                       "tokens": [1, 2], "ttft_ms": 1.0,
+                       "total_ms": 2.0})
+
+            _threading.Thread(target=work, daemon=True).start()
+
+        for _ in range(2):
+            servers.append(ReplicaServer(
+                handler, token=token, capacity=64,
+                registry_addr=reg.addr,
+                heartbeat_interval=0.1).start())
+        deadline = time.perf_counter() + 30.0
+        while len(reg.alive()) < 2 and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        metrics = FleetMetrics()
+        router = Router(reg, metrics, token=token)
+        book = TraceBook(sample=sample, slow_ms=1e9)
+        gw = Gateway(router, AdmissionController(max_queue=1024),
+                     metrics, token=token, workers=workers,
+                     tracebook=book).start()
+        walls = []
+        lock = _threading.Lock()
+
+        def feeder():
+            client = FleetClient(gw.addr, token, timeout=60.0)
+            for _ in range(n_requests // threads):
+                t0 = time.perf_counter()
+                client.generate([1, 2, 3, 4], 2,
+                                trace=(True if detail else None),
+                                timeout=60.0)
+                dt = (time.perf_counter() - t0) * 1000.0
+                with lock:
+                    walls.append(dt)
+            client.close()
+
+        try:
+            ts = [_threading.Thread(target=feeder)
+                  for _ in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120.0)
+            assert len(walls) == (n_requests // threads) * threads
+            if detail:
+                # The detailed arm must actually have traced in detail.
+                assert book.describe()["detailed"] == len(walls)
+        finally:
+            gw.stop()
+            for s in servers:
+                s.stop()
+            reg.stop()
+        return p99(walls)
+
+    # Best-of-N per arm, orders alternating so host drift (page cache,
+    # cpu governor, background load) cannot masquerade as tracing
+    # cost: at few-ms stub latencies one unlucky scheduler stall is
+    # bigger than the entire software path under test.
+    summaries, details = [], []
+    for i in range(best_of):
+        if i % 2 == 0:
+            summaries.append(arm(0.0, False))
+            details.append(arm(1.0, True))
+        else:
+            details.append(arm(1.0, True))
+            summaries.append(arm(0.0, False))
+    p99_summary = min(summaries)
+    p99_detail = min(details)
+    overhead_pct = (p99_detail - p99_summary) / p99_summary * 100.0
+    assert p99_detail <= p99_summary * 1.05 + 1.0, \
+        (f"tracing overhead unbounded: detailed p99 {p99_detail:.2f}ms "
+         f"vs summary-only p99 {p99_summary:.2f}ms "
+         f"({overhead_pct:+.1f}%)")
+    return overhead_pct, p99_summary, p99_detail
 
 
 def bench_bandwidth(sizes=None):
@@ -1953,12 +2101,30 @@ def main():
         # autoscaler self-heal, a link sever, and a rollout — with the
         # breaker-disabled control arm's p99 degradation recorded next
         # to the protected p99 (in-bench asserted strictly worse).
-        lost, amplification, on_p99, control_p99, n_soak = sk[0]
+        (lost, amplification, on_p99, control_p99, n_soak,
+         slow_attempt_ms, traces_detailed) = sk[0]
         out["fleet_soak_lost_requests"] = int(lost)
         out["fleet_soak_retry_amplification"] = round(amplification, 3)
         out["fleet_soak_p99_ms"] = round(on_p99, 2)
         out["fleet_soak_nobreaker_p99_ms"] = round(control_p99, 2)
         out["fleet_soak_requests"] = int(n_soak)
+        # Tracing attribution (PR 10): the injected gray-failure delay
+        # as seen INSIDE a retained trace's router span toward the
+        # slow replica, plus how many traces kept full detail under
+        # tail-based retention.
+        out["fleet_trace_slow_attempt_ms"] = round(slow_attempt_ms, 2)
+        out["fleet_trace_detailed_retained"] = int(traces_detailed)
+        flush_partial()
+    tro = attempts(bench_fleet_trace_overhead, "trace overhead bench",
+                   n=1)
+    if tro:
+        # Tracing overhead bound: full-detail-on-every-request p99 vs
+        # summary-only p99 on the same seeded stub workload (asserted
+        # within 5% + 1ms in-bench).
+        overhead_pct, p99_sum, p99_det = tro[0]
+        out["fleet_trace_overhead_pct"] = round(overhead_pct, 2)
+        out["fleet_trace_summary_p99_ms"] = round(p99_sum, 3)
+        out["fleet_trace_detail_p99_ms"] = round(p99_det, 3)
         flush_partial()
     dg = attempts(bench_fleet_disagg, "disaggregated fleet bench", n=1)
     if dg:

@@ -238,6 +238,45 @@ def test_serve_main_rollout_requires_token(capfd, monkeypatch):
     assert wire.TOKEN_ENV in capfd.readouterr().err
 
 
+def test_serve_parser_observability_flags_and_subcommands(capfd,
+                                                          monkeypatch):
+    """tfserve's observability surface (PR 10): the tracing/metrics
+    flags parse with safe defaults, the 'tfserve trace'/'metrics'
+    subcommand parsers round-trip, and both subcommands refuse to dial
+    unauthenticated."""
+    from tfmesos_tpu import wire
+    from tfmesos_tpu.cli import (build_metrics_parser, build_serve_parser,
+                                 build_submit_parser, build_trace_parser,
+                                 serve_main)
+
+    args = build_serve_parser().parse_args(
+        ["--metrics-port", "9100", "--trace-sample", "0.2",
+         "--trace-slow-ms", "250"])
+    assert args.metrics_port == 9100
+    assert args.trace_sample == 0.2 and args.trace_slow_ms == 250.0
+    defaults = build_serve_parser().parse_args([])
+    assert defaults.metrics_port is None       # endpoint is opt-in
+    assert defaults.trace_sample == 0.05
+    assert defaults.trace_slow_ms == 1000.0
+    assert build_submit_parser().parse_args(
+        ["-g", "h:1", "--prompt", "1", "--trace"]).trace
+    tp = build_trace_parser().parse_args(
+        ["-g", "gw:8780", "--slowest", "5"])
+    assert tp.gateway == "gw:8780" and tp.slowest == 5
+    assert build_trace_parser().parse_args(
+        ["-g", "g:1", "--id", "abc"]).trace_id == "abc"
+    assert build_trace_parser().parse_args(["-g", "g:1",
+                                            "--failed"]).failed
+    mp = build_metrics_parser().parse_args(["-g", "gw:8780", "--json"])
+    assert mp.gateway == "gw:8780" and mp.json
+    monkeypatch.delenv(wire.TOKEN_ENV, raising=False)
+    monkeypatch.delenv(wire.TOKEN_FILE_ENV, raising=False)
+    assert serve_main(["trace", "-g", "h:1"]) == 2
+    assert wire.TOKEN_ENV in capfd.readouterr().err
+    assert serve_main(["metrics", "-g", "h:1"]) == 2
+    assert wire.TOKEN_ENV in capfd.readouterr().err
+
+
 def test_replica_parser_round_trip():
     """The replica process's own flags (what FleetServer's Mode-B cmd
     drives) must round-trip too."""

@@ -1997,3 +1997,51 @@ def test_batcher_admission_orders_by_class_rank(setup):
     b2.close()
     order = [c.rid for c in b2.serve()]
     assert order == [0, 1, 2, 3]
+
+
+def test_batcher_trace_events_and_flight_recorder(setup):
+    """Requests carrying a TraceContext get the batcher's per-request
+    events (admit, prefill/decode phase spans); the flight recorder
+    logs per-block decode timing in BOTH step modes (sync and
+    pipelined) — and token streams are unchanged by tracing."""
+    from tfmesos_tpu.fleet.tracing import TraceContext
+
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    ps = _prompts(cfg, 3, seed=11)
+
+    reqs, traces = [], []
+    for p in ps:
+        r = Request(prompt=p, max_new_tokens=4)
+        tr = TraceContext(detailed=True)
+        r.trace = tr
+        reqs.append(r)
+        traces.append(tr)
+    batcher = ContinuousBatcher(cfg, params, **kw)
+    done = {c.rid: c for c in batcher.run(reqs)}
+    assert len(done) == len(reqs)
+    for rid, (req, tr) in enumerate(zip(reqs, traces)):
+        assert done[rid].tokens == _offline(cfg, params, req)
+        spans = tr.export()
+        names = [(s["component"], s["name"]) for s in spans]
+        assert ("batcher", "admit") in names
+        assert ("batcher", "prefill") in names
+        assert ("batcher", "decode") in names
+        dec = next(s for s in spans if s["name"] == "decode")
+        assert dec["tokens"] == 4 and dec["dur"] >= 0.0
+        adm = next(s for s in spans if s["name"] == "admit")
+        assert adm["prompt_len"] == int(req.prompt.size)
+    blocks = [e for e in batcher.flight.snapshot()
+              if e["name"] == "decode.block"]
+    assert blocks and all(e["mode"] == "sync" and e["dur"] >= 0.0
+                          and e["k"] == 1 for e in blocks)
+
+    # Pipelined loop: same stream, per-block entries tagged pipelined.
+    piped = ContinuousBatcher(cfg, params, pipeline_depth=1, **kw)
+    reqs2 = [Request(prompt=p, max_new_tokens=4) for p in ps]
+    done2 = {c.rid: c for c in piped.run(reqs2)}
+    assert [done2[r].tokens for r in sorted(done2)] \
+        == [done[r].tokens for r in sorted(done)]
+    pblocks = [e for e in piped.flight.snapshot()
+               if e["name"] == "decode.block"]
+    assert pblocks and all(e["mode"] == "pipelined" for e in pblocks)

@@ -242,6 +242,25 @@ class FaultPlan:
         self._execute(f, site="time", key=f.target or "")
 
     def _execute(self, f: Fault, site: str, key: str) -> None:
+        # Attribution: every firing lands on the ACTIVE request trace
+        # (thread-local — the router activates one around its routing
+        # loop) plus the chaos flight recorder, so a soak anomaly maps
+        # to the exact injected fault instead of "something was slow".
+        # Lazy import: chaos must stay importable without the fleet
+        # package.
+        try:
+            from tfmesos_tpu.fleet import tracing as _tracing
+            attrs = {"site": site, "key": key, "action": f.action}
+            if f.action in ("delay", "slow_task"):
+                attrs["delay_s"] = f.delay_s
+            if _tracing.current() is not None:
+                # cur_event copies into the chaos flight recorder too.
+                _tracing.cur_event("chaos", "fault", **attrs)
+            else:
+                _tracing.flight("chaos").record(
+                    dict(attrs, name="fault"))
+        except Exception:       # tracing must never break injection
+            pass
         if f.action == "kill_task":
             self.kill(f.victim or f.target or key)
         elif f.action == "drop_agent":

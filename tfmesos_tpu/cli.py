@@ -306,6 +306,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-interval", type=float, default=10.0,
                    help="seconds between fleet metrics log lines "
                         "(0 disables)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   dest="metrics_port",
+                   help="serve Prometheus exposition on this loopback "
+                        "port (GET /metrics, stdlib HTTP; "
+                        "/metrics.json for the raw snapshot); default: "
+                        "no endpoint — the snapshot stays reachable "
+                        "through the gateway's authenticated metrics "
+                        "op ('tfserve metrics')")
+    p.add_argument("--trace-sample", type=float, default=0.05,
+                   dest="trace_sample",
+                   help="fraction of requests whose trace keeps FULL "
+                        "span detail (every request keeps a summary; "
+                        "failed/shed/deadline-exceeded/slow requests "
+                        "keep detail regardless — tail-based "
+                        "retention, docs/SERVING.md 'Observability')")
+    p.add_argument("--trace-slow-ms", type=float, default=1000.0,
+                   dest="trace_slow_ms",
+                   help="requests slower than this keep full span "
+                        "detail even when unsampled (the tail rule's "
+                        "latency threshold; replicas apply it "
+                        "hop-locally too)")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -403,6 +424,11 @@ def build_submit_parser() -> argparse.ArgumentParser:
                         "late answer); default: no deadline — the "
                         "fleet's flat request timeout applies "
                         "(docs/MIGRATION.md)")
+    p.add_argument("--trace", action="store_true",
+                   help="ask the fleet to keep FULL span detail for "
+                        "this request's trace; the printed trace_id "
+                        "feeds 'tfserve trace -g GW --id ID' (every "
+                        "request gets a summary trace regardless)")
     p.add_argument("--timeout", type=float, default=300.0)
     return p
 
@@ -433,7 +459,8 @@ def submit_main(argv: List[str]) -> int:
         out = client.generate(prompt, args.max_new_tokens,
                               stop_token=args.stop_token,
                               priority=args.priority,
-                              deadline_ms=args.deadline_ms)
+                              deadline_ms=args.deadline_ms,
+                              trace=args.trace or None)
     except Overloaded as e:
         print(f"tfserve submit: shed ({e.kind}): {e} — back off and "
               f"retry", file=sys.stderr)
@@ -450,7 +477,148 @@ def submit_main(argv: List[str]) -> int:
             client.close()
     print(json.dumps({"tokens": out.get("tokens"),
                       "ttft_ms": out.get("ttft_ms"),
-                      "total_ms": out.get("total_ms")}))
+                      "total_ms": out.get("total_ms"),
+                      "trace_id": out.get("trace_id")}))
+    return 0
+
+
+def build_trace_parser() -> argparse.ArgumentParser:
+    """``tfserve trace`` — fetch request traces from a RUNNING fleet
+    gateway and print human-readable waterfalls (docs/SERVING.md
+    "Observability")."""
+    p = argparse.ArgumentParser(
+        prog="tfserve trace",
+        description="Fetch request traces from a running fleet "
+                    "gateway: one waterfall by id, the N slowest, the "
+                    "newest failures, or the recent summaries.")
+    p.add_argument("-g", "--gateway", type=str, required=True,
+                   metavar="HOST:PORT", help="the running gateway")
+    group = p.add_mutually_exclusive_group()
+    group.add_argument("--id", type=str, default=None, dest="trace_id",
+                       help="one trace by id (as printed on every "
+                            "completion/error reply)")
+    group.add_argument("--slowest", type=int, default=None, metavar="N",
+                       help="the N slowest known traces")
+    group.add_argument("--failed", action="store_true",
+                       help="the newest failed/shed/deadline-exceeded "
+                            "traces")
+    p.add_argument("--limit", type=int, default=20,
+                   help="max records for the summary/failed listings")
+    p.add_argument("--timeout", type=float, default=10.0)
+    return p
+
+
+def trace_main(argv: List[str]) -> int:
+    args = build_trace_parser().parse_args(argv)
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.tracing import format_waterfall
+
+    token = wire.load_token()
+    if not token:
+        print(f"tfserve trace: no cluster token — set {wire.TOKEN_ENV} "
+              f"or {wire.TOKEN_FILE_ENV} (tfserve printed the token "
+              f"file at startup)", file=sys.stderr)
+        return 2
+    client = None
+    try:
+        client = FleetClient(args.gateway, token, timeout=args.timeout)
+        traces = client.trace(trace_id=args.trace_id,
+                              slowest=args.slowest, failed=args.failed,
+                              limit=args.limit, timeout=args.timeout)
+    except OSError as e:
+        print(f"tfserve trace: cannot reach gateway {args.gateway}: "
+              f"{e}", file=sys.stderr)
+        return 1
+    finally:
+        if client is not None:
+            client.close()
+    if not traces:
+        what = (f"trace {args.trace_id!r}" if args.trace_id
+                else "matching traces")
+        print(f"tfserve trace: no {what} in the gateway's book (the "
+              f"book is bounded — detail is retained for sampled, "
+              f"failed, and slow requests)", file=sys.stderr)
+        return 1
+    if args.trace_id or args.slowest or args.failed:
+        for rec in traces:
+            print(format_waterfall(rec), flush=True)
+            print()
+    else:
+        for rec in traces:     # summary listing: one line each
+            summ = rec.get("summary") or {}
+            extra = " ".join(f"{k}={v}" for k, v in sorted(summ.items()))
+            print(f"{rec.get('trace_id')}  {rec.get('status'):<20} "
+                  f"{rec.get('total_ms', 0):>10.1f}ms  "
+                  f"{'detail' if rec.get('detailed') else 'summary':<7} "
+                  f"{extra}", flush=True)
+    return 0
+
+
+def build_metrics_parser() -> argparse.ArgumentParser:
+    """``tfserve metrics`` — fetch the gateway snapshot and
+    pretty-print it (until now the JSON snapshot was only reachable
+    from bench code)."""
+    p = argparse.ArgumentParser(
+        prog="tfserve metrics",
+        description="Fetch a running fleet gateway's metrics snapshot "
+                    "and print counters/gauges/histograms as tables.")
+    p.add_argument("-g", "--gateway", type=str, required=True,
+                   metavar="HOST:PORT", help="the running gateway")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw JSON snapshot instead of tables")
+    p.add_argument("--timeout", type=float, default=10.0)
+    return p
+
+
+def metrics_main(argv: List[str]) -> int:
+    args = build_metrics_parser().parse_args(argv)
+    from tfmesos_tpu.fleet.client import FleetClient
+
+    token = wire.load_token()
+    if not token:
+        print(f"tfserve metrics: no cluster token — set "
+              f"{wire.TOKEN_ENV} or {wire.TOKEN_FILE_ENV} (tfserve "
+              f"printed the token file at startup)", file=sys.stderr)
+        return 2
+    client = None
+    try:
+        client = FleetClient(args.gateway, token, timeout=args.timeout)
+        snap = client.metrics(timeout=args.timeout)
+    except OSError as e:
+        print(f"tfserve metrics: cannot reach gateway {args.gateway}: "
+              f"{e}", file=sys.stderr)
+        return 1
+    finally:
+        if client is not None:
+            client.close()
+    if args.json:
+        print(json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    hists = snap.get("histograms") or {}
+    if counters:
+        print("counters:")
+        width = max(len(k) for k in counters)
+        for name in sorted(counters):
+            print(f"  {name:<{width}}  {counters[name]}")
+    if gauges:
+        print("gauges:")
+        width = max(len(k) for k in gauges)
+        for name in sorted(gauges):
+            print(f"  {name:<{width}}  {gauges[name]}")
+    if hists:
+        print("histograms:")
+        width = max(len(k) for k in hists)
+        cols = ("count", "mean", "p50", "p90", "p99", "max")
+        head = "".join(f"{c:>10}" for c in cols)
+        print(f"  {'':<{width}}{head}")
+        for name in sorted(hists):
+            h = hists[name]
+            row = "".join(f"{h.get(c, ''):>10}" for c in cols)
+            print(f"  {name:<{width}}{row}")
+    if not (counters or gauges or hists):
+        print("tfserve metrics: empty snapshot")
     return 0
 
 
@@ -526,6 +694,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         return rollout_main(argv[1:])
     if argv and argv[0] == "submit":
         return submit_main(argv[1:])
+    if argv and argv[0] == "trace":
+        return trace_main(argv[1:])
+    if argv and argv[0] == "metrics":
+        return metrics_main(argv[1:])
     args = build_serve_parser().parse_args(argv)
     try:
         roles = parse_role_spec(args.role)
@@ -570,6 +742,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         prefix_cache_pages=args.prefix_cache,
         pipeline_depth=args.pipeline_depth, warmup=args.warmup,
         report_interval=args.metrics_interval or None,
+        metrics_port=args.metrics_port,
+        trace_sample=args.trace_sample,
+        trace_slow_ms=args.trace_slow_ms,
         quiet=not args.verbose, token=token)
     try:
         fleet.start()

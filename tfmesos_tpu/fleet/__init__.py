@@ -18,8 +18,13 @@ TF-Replicator (PAPERS.md) over the existing execution engine:
 * :mod:`~tfmesos_tpu.fleet.gateway` — the threaded TCP front door that
   accepts client requests, routes them, and relays completions back.
 * :mod:`~tfmesos_tpu.fleet.metrics` — counters + latency histograms
-  (TTFT, tokens/s, queue depth, shed/retry counts) as a JSON snapshot
-  and a periodic log line.
+  (TTFT, tokens/s, queue depth, shed/retry counts) as a JSON snapshot,
+  a periodic log line, and Prometheus exposition behind an optional
+  stdlib HTTP endpoint.
+* :mod:`~tfmesos_tpu.fleet.tracing` — end-to-end request tracing:
+  per-request trace ids on the wire, per-component flight recorders,
+  tail-based retention in the gateway's trace book, and the ``tfserve
+  trace`` waterfall.
 * :mod:`~tfmesos_tpu.fleet.replica` — the replica process: a
   ``ContinuousBatcher`` behind a TCP server, fed through the batcher's
   incremental submission API; launched as a Mode-B task through the
@@ -62,6 +67,8 @@ from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
                                         ReplicaInfo, ReplicaRegistry)
 from tfmesos_tpu.fleet.router import Router, RoutingError
+from tfmesos_tpu.fleet.tracing import (FlightRecorder, TraceBook,
+                                       TraceContext, format_waterfall)
 
 __all__ = [
     "AdmissionController", "Overloaded", "RateLimited",
@@ -71,5 +78,6 @@ __all__ = [
     "ConnectionLost", "FleetClient", "MuxConnection", "RequestFailed",
     "Gateway", "FleetServer", "FleetMetrics", "ReplicaInfo",
     "ReplicaRegistry", "Router", "RoutingError",
+    "FlightRecorder", "TraceBook", "TraceContext", "format_waterfall",
     "UNIFIED", "PREFILL", "DECODE",
 ]

@@ -205,18 +205,25 @@ class FleetClient:
                  stop_token: Optional[int] = None,
                  timeout: Optional[float] = None,
                  priority: Optional[str] = None,
-                 deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+                 deadline_ms: Optional[float] = None,
+                 trace=None) -> Dict[str, Any]:
         """One generation request; returns the completion dict
-        (``tokens``, ``ttft_ms``, ``total_ms``).  Raises ``Overloaded``
-        on shed, :class:`RequestFailed` on any other error reply.
-        ``priority`` names the gateway admission class this request
-        rides in (e.g. ``"background"``); unlabeled requests take the
-        fleet's default (first-listed) class.  ``deadline_ms`` is the
-        END-TO-END budget from gateway receipt: expired work is shed
-        in the admission queue, failed fast by the router, and
-        cancelled inside the replicas (surfacing here as
+        (``tokens``, ``ttft_ms``, ``total_ms``, ``trace_id``).  Raises
+        ``Overloaded`` on shed, :class:`RequestFailed` on any other
+        error reply.  ``priority`` names the gateway admission class
+        this request rides in (e.g. ``"background"``); unlabeled
+        requests take the fleet's default (first-listed) class.
+        ``deadline_ms`` is the END-TO-END budget from gateway receipt:
+        expired work is shed in the admission queue, failed fast by the
+        router, and cancelled inside the replicas (surfacing here as
         :class:`RequestFailed` with kind ``deadline_exceeded``); no
-        deadline preserves the flat server-side timeout behavior."""
+        deadline preserves the flat server-side timeout behavior.
+        ``trace`` asks the fleet to retain FULL span detail for this
+        request's trace: ``True`` under a gateway-minted id, a string
+        to supply the trace id yourself; every request is
+        summary-traced regardless, and the reply's ``trace_id`` (also
+        set on raised ``Overloaded``/``RequestFailed`` exceptions)
+        fetches the waterfall via :meth:`trace` / ``tfserve trace``."""
         msg = {"op": "generate", "prompt": [int(t) for t in prompt],
                "max_new_tokens": int(max_new_tokens),
                "stop_token": stop_token}
@@ -227,6 +234,8 @@ class FleetClient:
                 raise ValueError(f"deadline_ms must be > 0, got "
                                  f"{deadline_ms}")
             msg["deadline_ms"] = float(deadline_ms)
+        if trace is not None and trace is not False:
+            msg["trace"] = str(trace) if isinstance(trace, str) else True
         reply = self._mux.call(
             msg, timeout=timeout if timeout is not None else self.timeout)
         if isinstance(reply, dict) and reply.get("op") == "completion":
@@ -234,11 +243,33 @@ class FleetClient:
         kind = reply.get("kind", "error") if isinstance(reply, dict) else "error"
         error = reply.get("error", repr(reply)) if isinstance(reply, dict) \
             else repr(reply)
+        tid = reply.get("trace_id") if isinstance(reply, dict) else None
         if kind == "rate_limited":
-            raise RateLimited(error)
-        if kind == "overloaded":
-            raise Overloaded(error)
-        raise RequestFailed(error, kind=kind)
+            exc: Exception = RateLimited(error)
+        elif kind == "overloaded":
+            exc = Overloaded(error)
+        else:
+            exc = RequestFailed(error, kind=kind)
+        exc.trace_id = tid
+        raise exc
+
+    def trace(self, trace_id: Optional[str] = None,
+              slowest: Optional[int] = None, failed: bool = False,
+              limit: int = 20, timeout: float = 10.0) -> list:
+        """Fetch trace records from the gateway's book: one by id (full
+        waterfall), the N ``slowest``, the newest ``failed``, or the
+        recent summaries (docs/SERVING.md "Observability")."""
+        msg: Dict[str, Any] = {"op": "trace", "limit": int(limit)}
+        if trace_id:
+            msg["trace_id"] = str(trace_id)
+        elif slowest:
+            msg["slowest"] = int(slowest)
+        elif failed:
+            msg["failed"] = True
+        reply = self._mux.call(msg, timeout=timeout)
+        if isinstance(reply, dict):
+            return reply.get("traces") or []
+        return []
 
     def metrics(self, timeout: float = 10.0) -> Dict[str, Any]:
         """The gateway's live metrics snapshot."""

@@ -30,7 +30,17 @@ Wire surface (all frames HMAC-authenticated with the cluster token):
   allocation pressure (docs/SERVING.md "Priorities, preemption &
   migration").  Unlabeled requests take the first-listed (default)
   class.
+  ``trace`` (optional) asks for FULL span detail on this request's
+  trace: ``true`` under a gateway-minted id, a string to supply the
+  trace id; every request gets an always-on summary trace regardless,
+  and every reply (completion or error) carries its ``trace_id`` —
+  fetch the waterfall later with the ``trace`` op (docs/SERVING.md
+  "Observability").
 * ``{"op": "metrics", "id"}`` → ``{"op": "metrics", "id", "snapshot"}``.
+* ``{"op": "trace", "id", "trace_id"? | "slowest": N? | "failed":
+  true?, "limit"?}`` → ``{"op": "trace", "id", "traces": [...]}`` —
+  one trace by id (full record), the N slowest, the newest failures,
+  or the recent summaries (``tfserve trace``).
 * ``{"op": "ping", "id"}`` → ``{"op": "pong", "id"}``.
 * ``{"op": "rollout", "id", "weights_version"}`` → ``{"op": "rollout",
   "id", "ok": true, ...}`` or ``{"op": "error", "id", "kind":
@@ -57,6 +67,7 @@ from tfmesos_tpu.fleet.admission import (AdmissionController,
                                          RateLimited)
 from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.router import Router
+from tfmesos_tpu.fleet.tracing import TraceBook
 from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["Gateway"]
@@ -93,10 +104,15 @@ class Gateway:
     def __init__(self, router: Router, admission: AdmissionController,
                  metrics: FleetMetrics, token: str = "",
                  host: str = "127.0.0.1", port: int = 0, workers: int = 8,
-                 registry=None):
+                 registry=None, tracebook: Optional[TraceBook] = None):
         self.router = router
         self.admission = admission
         self.metrics = metrics
+        # Request tracing is on-by-default at SUMMARY level (every
+        # request finishes into the book); span DETAIL is tail-retained
+        # per the book's sample/slow/failure rules (docs/SERVING.md
+        # "Observability").
+        self.tracebook = tracebook if tracebook is not None else TraceBook()
         self.token = token
         self.host = host
         self.port = int(port)
@@ -134,6 +150,9 @@ class Gateway:
         # ride the snapshot AND the periodic report line.
         metrics.register_gauge("breakers", router.breaker_summary)
         metrics.register_gauge("retry_budget", router.retry_budget_level)
+        # Trace book occupancy + lifetime finish/detail counts — the
+        # "is tracing actually retaining anything" sanity gauge.
+        metrics.register_gauge("traces", self.tracebook.describe)
         # Items that expired while queued still owe the client an
         # explicit answer — the controller hands them back here from
         # whichever worker's get() swept them.
@@ -226,6 +245,29 @@ class Gateway:
             client.send({"op": "metrics", "id": cid,
                          "snapshot": self.metrics.snapshot()})
             return
+        if op == "trace":
+            # Authenticated read of the trace book: one trace by id,
+            # the N slowest, the N newest failures, or the recent
+            # summaries — the `tfserve trace` surface.
+            book = self.tracebook
+            limit = msg.get("limit")
+            limit = int(limit) if isinstance(limit, (int, float)) \
+                and not isinstance(limit, bool) and limit > 0 else 20
+            tid = msg.get("trace_id")
+            if isinstance(tid, str) and tid:
+                rec = book.get(tid)
+                traces = [rec] if rec is not None else []
+            elif msg.get("failed"):
+                traces = book.failed(limit)
+            elif msg.get("slowest"):
+                n = msg.get("slowest")
+                traces = book.slowest(int(n) if isinstance(n, (int, float))
+                                      and not isinstance(n, bool)
+                                      and n > 0 else 5)
+            else:
+                traces = book.recent(limit)
+            client.send({"op": "trace", "id": cid, "traces": traces})
+            return
         if op == "rollout":
             fn = self.rollout_fn
             version = msg.get("weights_version")
@@ -267,6 +309,14 @@ class Gateway:
                          "error": f"unknown op {op!r}"})
             return
         self.metrics.inc("received")
+        # Tracing begins at receipt: a client-supplied string is the
+        # trace id (and asks for full detail), any other truthy value
+        # asks for detail under a gateway-minted id, absence still gets
+        # the always-on summary + tail-based retention.
+        traw = msg.get("trace")
+        tr = self.tracebook.begin(
+            trace_id=traw if isinstance(traw, str) and traw else None,
+            want_detail=bool(traw))
         # The class label ("priority"; "tenant" is an alias) picks the
         # weighted-fair admission queue; the class's preemption RANK —
         # not the label — rides to the replica, so batcher-side
@@ -277,6 +327,10 @@ class Gateway:
             label = msg.get("tenant")
         spec = self.admission.resolve(
             label if isinstance(label, str) else None)
+        prompt = msg.get("prompt")
+        tr.event("gateway", "recv", cls=spec.name, rank=spec.rank,
+                 prompt_len=(len(prompt)
+                             if isinstance(prompt, (list, tuple)) else 0))
         # End-to-end deadline: the client ships a RELATIVE budget
         # (clocks do not agree across hosts); the gateway stamps the
         # absolute expiry the whole serving path measures against.
@@ -290,44 +344,61 @@ class Gateway:
         forward = {"op": "generate", "prompt": msg.get("prompt"),
                    "max_new_tokens": msg.get("max_new_tokens"),
                    "stop_token": msg.get("stop_token"),
-                   "priority": spec.rank}
+                   "priority": spec.rank,
+                   # Internal (stripped before the wire, like
+                   # "deadline"): the router records its attempts here
+                   # and stitches replica hop spans back in.
+                   "_trace": tr}
         if deadline is not None:
             forward["deadline"] = deadline
         try:
             self.admission.admit((client, cid, forward,
-                                  time.perf_counter(), spec.name),
+                                  time.perf_counter(), spec.name, tr),
                                  cls=spec.name, deadline=deadline)
         except DeadlineExceeded as e:
             self.metrics.inc("shed_deadline")
             self.metrics.inc(f"shed_deadline_{spec.name}")
+            tr.event("admission", "shed", kind=e.kind, cls=spec.name)
+            self.tracebook.finish(tr, e.kind, cls=spec.name)
             client.send({"op": "error", "id": cid, "kind": e.kind,
-                         "error": str(e)})
+                         "error": str(e), "trace_id": tr.trace_id})
         except RateLimited as e:
             self.metrics.inc("shed_rate_limited")
             self.metrics.inc(f"shed_rate_limited_{spec.name}")
+            tr.event("admission", "shed", kind=e.kind, cls=spec.name)
+            self.tracebook.finish(tr, e.kind, cls=spec.name)
             client.send({"op": "error", "id": cid, "kind": e.kind,
-                         "error": str(e)})
+                         "error": str(e), "trace_id": tr.trace_id})
         except Overloaded as e:
             self.metrics.inc("shed_queue")
             self.metrics.inc(f"shed_queue_{spec.name}")
+            tr.event("admission", "shed", kind=e.kind, cls=spec.name)
+            self.tracebook.finish(tr, e.kind, cls=spec.name)
             client.send({"op": "error", "id": cid, "kind": e.kind,
-                         "error": str(e)})
+                         "error": str(e), "trace_id": tr.trace_id})
         else:
             self.metrics.inc("admitted")
+            tr.event("admission", "enqueue", cls=spec.name)
 
     def _queue_expired(self, item) -> None:
         """One admitted request expired while waiting in its class
         queue (AdmissionController.get shed it before dispatch): the
         client still gets its explicit answer, and the books stay
         consistent — it was admitted, so it counts as failed too."""
-        client, cid, _forward, _t_enq, cls = item
+        client, cid, _forward, t_enq, cls, tr = item
         self.metrics.inc("shed_deadline")
         self.metrics.inc(f"shed_deadline_{cls}")
         self.metrics.inc("failed")
+        tr.add("admission", "queue_wait", tr.rel_ms(t_enq),
+               (time.perf_counter() - t_enq) * 1000.0, cls=cls,
+               expired=True)
+        self.tracebook.finish(tr, "deadline_exceeded", cls=cls,
+                              where="queued")
         client.send({"op": "error", "id": cid,
                      "kind": "deadline_exceeded",
                      "error": "request deadline expired while queued "
-                              "at the gateway"})
+                              "at the gateway",
+                     "trace_id": tr.trace_id})
 
     # -- dispatch ----------------------------------------------------------
 
@@ -336,7 +407,7 @@ class Gateway:
             item = self.admission.get(timeout=0.2)
             if item is None:
                 continue
-            client, cid, forward, t_enq, cls = item
+            client, cid, forward, t_enq, cls, tr = item
             # Queue wait is ITS OWN histogram, never folded into TTFT:
             # TTFT measures the serving path (prefill + transfer), and
             # conflating admission backlog with it would mask exactly
@@ -346,6 +417,10 @@ class Gateway:
             wait_ms = (time.perf_counter() - t_enq) * 1000.0
             self.metrics.observe("queue_wait_ms", wait_ms)
             self.metrics.observe(f"queue_wait_ms_{cls}", wait_ms)
+            # The WFQ dequeue closes the queue-wait span — the first
+            # hop of every waterfall.
+            tr.add("admission", "queue_wait", tr.rel_ms(t_enq), wait_ms,
+                   cls=cls)
             try:
                 reply = self.router.route(forward)
             except Exception as e:
@@ -353,13 +428,22 @@ class Gateway:
                 # becomes an explicit client error; a gateway worker
                 # must survive everything.
                 self.metrics.inc("failed")
+                self.tracebook.finish(tr, "unavailable", cls=cls,
+                                      error=str(e)[:200])
                 client.send({"op": "error", "id": cid,
-                             "kind": "unavailable", "error": str(e)})
+                             "kind": "unavailable", "error": str(e),
+                             "trace_id": tr.trace_id})
                 continue
             out = dict(reply) if isinstance(reply, dict) else {
                 "op": "error", "kind": "internal",
                 "error": f"malformed replica reply {reply!r}"}
             out["id"] = cid
+            out["trace_id"] = tr.trace_id
+            # Belt-and-braces: the router absorbs piggybacked replica
+            # spans into the trace and pops them, but a reply that
+            # bypassed absorption must not leak span payloads to the
+            # client.
+            out.pop("trace", None)
             if out.get("op") == "completion":
                 self.metrics.inc("completed")
                 self.metrics.inc("tokens_out",
@@ -377,6 +461,10 @@ class Gateway:
                 else:
                     self.metrics.observe("ttft_ms", out.get("ttft_ms"))
                 self.metrics.observe("latency_ms", out.get("total_ms"))
+                self.tracebook.finish(
+                    tr, "completed", cls=cls,
+                    tokens=len(out.get("tokens") or ()),
+                    ttft_ms=out.get("ttft_ms"))
             else:
                 self.metrics.inc("failed")
                 if out.get("kind") == "deadline_exceeded":
@@ -384,4 +472,6 @@ class Gateway:
                     # way the deadline did its job — visible as its own
                     # counter, not buried in generic failures.
                     self.metrics.inc("deadline_exceeded")
+                self.tracebook.finish(
+                    tr, str(out.get("kind") or "error"), cls=cls)
             client.send(out)

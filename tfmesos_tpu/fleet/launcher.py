@@ -45,6 +45,7 @@ from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (ALIVE, DEAD, DECODE, PREFILL,
                                         UNIFIED, ReplicaRegistry)
 from tfmesos_tpu.fleet.router import Router
+from tfmesos_tpu.fleet.tracing import TraceBook
 from tfmesos_tpu.scheduler import (MAX_FAILURE_COUNT, ClusterError,
                                    TPUMesosScheduler)
 from tfmesos_tpu.utils.logging import get_logger
@@ -112,6 +113,9 @@ class FleetServer:
                  start_timeout: float = 300.0,
                  heartbeat_interval: float = 0.3,
                  report_interval: Optional[float] = None,
+                 metrics_port: Optional[int] = None,
+                 trace_sample: float = 0.05,
+                 trace_slow_ms: float = 1000.0,
                  quiet: bool = True, token: Optional[str] = None):
         if min(replicas, prefill_replicas, decode_replicas) < 0:
             raise ValueError(
@@ -209,6 +213,15 @@ class FleetServer:
         self.start_timeout = float(start_timeout)
         self.heartbeat_interval = float(heartbeat_interval)
         self.report_interval = report_interval
+        #: optional stdlib-HTTP Prometheus exposition (GET /metrics on
+        #: loopback); None = no endpoint, the pre-PR-10 behavior.
+        self.metrics_port = metrics_port
+        #: request-tracing knobs (docs/SERVING.md "Observability"):
+        #: head-sample fraction retained with FULL span detail, and the
+        #: slower-than-this tail threshold that retains detail
+        #: regardless — failures/sheds/deadline-exceeded always retain.
+        self.trace_sample = float(trace_sample)
+        self.trace_slow_ms = float(trace_slow_ms)
         self.quiet = quiet
         self.log = get_logger("tfmesos_tpu.fleet", quiet=quiet)
 
@@ -218,6 +231,8 @@ class FleetServer:
         self._token = token
         self.token: Optional[str] = None
         self.metrics: Optional[FleetMetrics] = None
+        self.tracebook: Optional[TraceBook] = None
+        self._metrics_http = None
         self.registry: Optional[ReplicaRegistry] = None
         self.router: Optional[Router] = None
         self.admission: Optional[AdmissionController] = None
@@ -292,11 +307,20 @@ class FleetServer:
             self.admission = AdmissionController(
                 max_queue=self.max_queue, rate=self.rate,
                 burst=self.burst, classes=self.priority_classes)
+            self.tracebook = TraceBook(sample=self.trace_sample,
+                                       slow_ms=self.trace_slow_ms)
             self.gateway = Gateway(self.router, self.admission,
                                    self.metrics, token=self.token,
                                    host=self.gateway_host,
                                    port=self.gateway_port,
-                                   workers=self.workers).start()
+                                   workers=self.workers,
+                                   tracebook=self.tracebook).start()
+            if self.metrics_port is not None:
+                self._metrics_http = self.metrics.start_http_server(
+                    self.metrics_port)
+                self.log.info(
+                    "prometheus exposition on :%d/metrics",
+                    self._metrics_http.server_address[1])
             # The scheduler starts EMPTY in dynamic mode: the task table
             # is a runtime property, and every replica — boot ones
             # included — goes through the same launch_replica path the
@@ -610,6 +634,10 @@ class FleetServer:
             self.autoscaler = None
         if self.metrics is not None:
             self.metrics.stop_reporter()
+        if self._metrics_http is not None:
+            self._metrics_http.shutdown()
+            self._metrics_http.server_close()
+            self._metrics_http = None
         if self.gateway is not None:
             self.gateway.stop()
             self.gateway = None

@@ -23,10 +23,42 @@ of the two per routing decision over a prompt-bearing request —
 
 from __future__ import annotations
 
+import re as _re
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 __all__ = ["Histogram", "FleetMetrics"]
+
+# Prometheus metric names must match [a-zA-Z_:][a-zA-Z0-9_:]*; our
+# counter/histogram names are lowercase identifiers already, but class
+# labels are user input ("queue_wait_ms_<class>") — sanitize, never
+# trust.
+_PROM_BAD = _re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    out = "fleet_" + _PROM_BAD.sub("_", str(name))
+    return out if not out[6:7].isdigit() else "fleet__" + out[6:]
+
+
+def _prom_label(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _prom_num(v) -> str:
+    f = float(v)
+    if f != f:
+        # Valid exposition literal — a NaN gauge must cost its sample's
+        # accuracy, never the whole scrape (int(nan) would raise here).
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
 
 # Bucket upper bounds in milliseconds — wide enough for CPU dev replicas
 # (seconds) and TPU serving (single-digit ms) alike.
@@ -50,6 +82,12 @@ class Histogram:
 
     def observe(self, value: float) -> None:
         v = float(value)
+        if v != v:
+            # NaN: it would increment _count while landing in NO bucket
+            # (every `v <= edge` comparison is False), silently shifting
+            # every percentile's rank — drop it, the same way
+            # FleetMetrics.observe drops non-numerics.
+            return
         with self._lock:
             self._count += 1
             self._sum += v
@@ -89,6 +127,16 @@ class Histogram:
         would chase load that ended minutes ago)."""
         with self._lock:
             return (self.buckets, tuple(self._counts), self._count)
+
+    def state(self) -> tuple:
+        """``(buckets, counts, count, sum)`` — :meth:`cumulative` plus
+        the running sum, the full tuple Prometheus exposition needs
+        (``cumulative``'s 3-tuple shape is an API the autoscaler
+        diffs; this one carries the extra field instead of changing
+        it)."""
+        with self._lock:
+            return (self.buckets, tuple(self._counts), self._count,
+                    self._sum)
 
     @staticmethod
     def delta_percentile(prev: Optional[tuple], cur: tuple, p: float,
@@ -199,6 +247,101 @@ class FleetMetrics:
             except Exception:  # pragma: no cover - gauge must not break export
                 out["gauges"][name] = None
         return out
+
+    def prometheus_text(self) -> str:
+        """The whole metrics surface in Prometheus exposition format
+        (text/plain version 0.0.4): counters and numeric gauges as-is,
+        dict-valued gauges flattened one level into ``{key="..."}``
+        labels (numeric leaves only), histograms as CUMULATIVE
+        ``_bucket{le="..."}`` series plus ``_sum``/``_count`` — served
+        by the optional stdlib HTTP exporter (``tfserve
+        --metrics-port``).  Names are prefixed ``fleet_`` and
+        sanitized; a raising gauge costs its series, never the
+        scrape."""
+        with self._lock:
+            counters = dict(self._counters)
+            hists = dict(self._hists)
+            gauges = dict(self._gauges)
+        lines: List[str] = []
+
+        def emit(name: str, kind: str, samples) -> None:
+            lines.append(f"# TYPE {name} {kind}")
+            for labels, value in samples:
+                lines.append(f"{name}{labels} {_prom_num(value)}")
+
+        for name in sorted(counters):
+            emit(_prom_name(name) + "_total", "counter",
+                 [("", counters[name])])
+        for name in sorted(gauges):
+            try:
+                val = gauges[name]()
+            except Exception:   # pragma: no cover - gauge must not break
+                continue
+            gname = _prom_name(name)
+            if isinstance(val, bool):
+                continue
+            if isinstance(val, (int, float)):
+                emit(gname, "gauge", [("", val)])
+            elif isinstance(val, dict):
+                samples = [(f'{{key="{_prom_label(k)}"}}', v)
+                           for k, v in sorted(val.items())
+                           if isinstance(v, (int, float))
+                           and not isinstance(v, bool)]
+                if samples:
+                    emit(gname, "gauge", samples)
+        for name in sorted(hists):
+            buckets, counts, count, total = hists[name].state()
+            hname = _prom_name(name)
+            lines.append(f"# TYPE {hname} histogram")
+            seen = 0
+            for edge, n in zip(buckets, counts):
+                seen += n
+                le = "+Inf" if edge == float("inf") else _prom_num(edge)
+                lines.append(f'{hname}_bucket{{le="{le}"}} {seen}')
+            lines.append(f"{hname}_sum {_prom_num(total)}")
+            lines.append(f"{hname}_count {count}")
+        return "\n".join(lines) + "\n"
+
+    def start_http_server(self, port: int, host: str = "127.0.0.1"):
+        """Serve ``GET /metrics`` (Prometheus text) and ``GET
+        /metrics.json`` (the snapshot) on a daemon thread — stdlib
+        ``http.server`` only, like the rest of the control plane.
+        Returns the server; call its ``shutdown()`` to stop.  Metrics
+        are operational telemetry, not completions, so this read-only
+        endpoint is unauthenticated by design — bind it to loopback
+        (the default) or a scrape-only network."""
+        import http.server
+        import json
+
+        metrics = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):         # noqa: N802 - stdlib casing
+                if self.path.split("?")[0] == "/metrics.json":
+                    body = json.dumps(metrics.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] in ("/", "/metrics"):
+                    body = metrics.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass    # scrapes are not log events
+
+        server = http.server.ThreadingHTTPServer((host, int(port)),
+                                                 Handler)
+        server.daemon_threads = True
+        t = threading.Thread(target=server.serve_forever,
+                             name="fleet-metrics-http", daemon=True)
+        t.start()
+        return server
 
     def report_line(self) -> str:
         """One log-friendly line: every counter and gauge, plus the

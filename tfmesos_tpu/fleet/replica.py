@@ -41,11 +41,36 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from tfmesos_tpu import wire
+from tfmesos_tpu.fleet import tracing
 from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["ReplicaServer", "BatcherServing", "batcher_handler",
            "prefill_handler", "tiny_model", "flagship_model",
            "build_parser", "main"]
+
+
+def _hop_trace(head) -> Optional["tracing.TraceContext"]:
+    """The replica-side hop context for a request carrying a
+    ``trace_id``: spans are offsets from THIS moment (receipt) and
+    piggyback on the reply — absolute clocks never cross the wire.
+    A malformed field costs the trace, never the request."""
+    tid = head.get("trace_id")
+    if not isinstance(tid, str) or not tid:
+        return None
+    slow = head.get("trace_slow_ms")
+    return tracing.TraceContext(
+        trace_id=tid, detailed=bool(head.get("trace_detail")),
+        slow_ms=(float(slow) if isinstance(slow, (int, float))
+                 and not isinstance(slow, bool) and slow > 0 else None))
+
+
+def _attach_trace(out: Dict[str, Any], tr, failed: bool = False
+                  ) -> Dict[str, Any]:
+    """Piggyback the hop's spans on a reply dict per the tail rule:
+    detail was requested, the hop failed, or the hop ran slow."""
+    if tr is not None and tr.should_export(failed=failed):
+        out["trace"] = tr.export()
+    return out
 
 
 class ReplicaServer:
@@ -396,6 +421,9 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                             "op (role: decode/unified); route prefill "
                             "to a prefill-role replica"})
             return
+        tr = _hop_trace(head)
+        if tr is not None:
+            tr.event("replica", "recv", op="generate", raw=raw)
         prefilled = None
         try:
             prio = head.get("priority")
@@ -405,6 +433,7 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                 stop_token=head.get("stop_token"),
                 priority=int(prio) if prio is not None else 0,
                 deadline_ms=_deadline_ms(head))
+            req.trace = tr      # the batcher records its events here
             if raw:
                 prefilled = serving_mod.unpack_prefilled(head, msg.body)
                 batcher.validate(Prefilled(req, prefilled))
@@ -415,39 +444,51 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                 # replica down.
                 batcher.validate(req)
         except (TypeError, ValueError, KeyError) as e:
-            reply({"op": "error", "id": mid, "kind": "bad_request",
-                   "error": str(e)})
+            reply(_attach_trace(
+                {"op": "error", "id": mid, "kind": "bad_request",
+                 "error": str(e)}, tr, failed=True))
             return
 
         def on_done(comp, err) -> None:
             if comp is None:
-                reply({"op": "error", "id": mid, "kind": "internal",
-                       "error": err or "request dropped"})
+                reply(_attach_trace(
+                    {"op": "error", "id": mid, "kind": "internal",
+                     "error": err or "request dropped"}, tr,
+                    failed=True))
                 return
             if isinstance(comp, Expired):
                 # The batcher cancelled the row (deadline passed):
                 # explicit, deterministic, and never retried — the
                 # router treats deadline_exceeded as final.
-                reply({"op": "error", "id": mid,
-                       "kind": "deadline_exceeded",
-                       "error": "request deadline expired in the "
-                                "batcher; row cancelled"})
+                reply(_attach_trace(
+                    {"op": "error", "id": mid,
+                     "kind": "deadline_exceeded",
+                     "error": "request deadline expired in the "
+                              "batcher; row cancelled"}, tr,
+                    failed=True))
                 return
             if isinstance(comp, Suspended):
                 if comp.artifact is None:
-                    reply({"op": "suspended", "id": mid, "requeue": True,
-                           "gen": generation,
-                           "weights_version": weights_version})
+                    reply(_attach_trace(
+                        {"op": "suspended", "id": mid, "requeue": True,
+                         "gen": generation,
+                         "weights_version": weights_version}, tr,
+                        failed=True))
                     return
                 meta, body = serving_mod.pack_prefilled(comp.artifact)
                 meta.update(op="suspended", id=mid, gen=generation,
                             weights_version=weights_version)
+                # A migration hop's spans always piggyback (failed=True
+                # here just means "always export"): the router stitches
+                # the victim's suspend into the one waterfall.
+                _attach_trace(meta, tr, failed=True)
                 reply(wire.RawFrame(meta, body))
                 return
-            reply({"op": "completion", "id": mid,
-                   "tokens": [int(t) for t in comp.tokens],
-                   "ttft_ms": round(comp.ttft_s * 1000.0, 3),
-                   "total_ms": round(comp.total_s * 1000.0, 3)})
+            reply(_attach_trace(
+                {"op": "completion", "id": mid,
+                 "tokens": [int(t) for t in comp.tokens],
+                 "ttft_ms": round(comp.ttft_s * 1000.0, 3),
+                 "total_ms": round(comp.total_s * 1000.0, 3)}, tr))
 
         serving.submit(req, on_done, prefilled=prefilled)
 
@@ -479,28 +520,39 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
 
     def drain() -> None:
         while True:
-            req, mid, reply = work_q.get()
+            req, mid, reply, t_enq = work_q.get()
+            tr = getattr(req, "trace", None)
+            if tr is not None:
+                tr.add("replica", "prefill_queue", tr.rel_ms(t_enq),
+                       (_time.perf_counter() - t_enq) * 1000.0)
             if req.expired:
                 # The deadline passed while queued: shed without
                 # burning a prompt's worth of prefill compute.
                 batcher.deadline_cancels += 1
-                reply({"op": "error", "id": mid,
-                       "kind": "deadline_exceeded",
-                       "error": "request deadline expired in the "
-                                "prefill queue"})
+                reply(_attach_trace(
+                    {"op": "error", "id": mid,
+                     "kind": "deadline_exceeded",
+                     "error": "request deadline expired in the "
+                              "prefill queue"}, tr, failed=True))
                 continue
             try:
                 t0 = _time.perf_counter()
                 art = batcher.export_kv(req)
                 meta, body = serving_mod.pack_prefilled(art)
+                prefill_ms = round(
+                    (_time.perf_counter() - t0) * 1000.0, 3)
                 meta.update(op="prefilled", id=mid,
-                            prefill_ms=round(
-                                (_time.perf_counter() - t0) * 1000.0, 3))
+                            prefill_ms=prefill_ms)
+                if tr is not None:
+                    tr.add("replica", "prefill_export", tr.rel_ms(t0),
+                           prefill_ms)
+                    _attach_trace(meta, tr)
                 reply(wire.RawFrame(meta, body))
             except Exception as e:
                 log.exception("prefill failed: %s", e)
-                reply({"op": "error", "id": mid, "kind": "internal",
-                       "error": repr(e)})
+                reply(_attach_trace(
+                    {"op": "error", "id": mid, "kind": "internal",
+                     "error": repr(e)}, tr, failed=True))
 
     threading.Thread(target=drain, name="replica-prefill",
                      daemon=True).start()
@@ -521,6 +573,9 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
                             "(role: prefill); route generate to a "
                             "decode or unified replica"})
             return
+        tr = _hop_trace(head)
+        if tr is not None:
+            tr.event("replica", "recv", op="prefill")
         try:
             prio = head.get("priority")
             req = Request(
@@ -529,16 +584,20 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
                 stop_token=head.get("stop_token"),
                 priority=int(prio) if prio is not None else 0,
                 deadline_ms=_deadline_ms(head))
+            req.trace = tr
             batcher.validate(req)
         except (TypeError, ValueError) as e:
-            reply({"op": "error", "id": mid, "kind": "bad_request",
-                   "error": str(e)})
+            reply(_attach_trace(
+                {"op": "error", "id": mid, "kind": "bad_request",
+                 "error": str(e)}, tr, failed=True))
             return
         try:
-            work_q.put_nowait((req, mid, reply))
+            work_q.put_nowait((req, mid, reply, _time.perf_counter()))
         except _queue.Full:
-            reply({"op": "error", "id": mid, "kind": "overloaded",
-                   "error": f"prefill queue full ({max_queue} pending)"})
+            reply(_attach_trace(
+                {"op": "error", "id": mid, "kind": "overloaded",
+                 "error": f"prefill queue full ({max_queue} pending)"},
+                tr, failed=True))
 
     return handler
 

@@ -113,6 +113,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional
 
 from tfmesos_tpu import prefixhash, wire
+from tfmesos_tpu.fleet import tracing
 from tfmesos_tpu.fleet.client import CallTimeout, ConnectionLost, MuxConnection
 from tfmesos_tpu.fleet.containment import (BreakerBoard, BreakerConfig,
                                            RetryBudget)
@@ -250,10 +251,16 @@ class Router:
         allowed = [r for r in cands if self.breakers.eligible(r.addr)]
         if allowed:
             if len(allowed) < len(cands):
-                self.metrics.inc("breaker_skips",
-                                 len(cands) - len(allowed))
+                skipped = len(cands) - len(allowed)
+                self.metrics.inc("breaker_skips", skipped)
+                tracing.cur_event(
+                    "router", "breaker_skip", skipped=skipped,
+                    addrs=",".join(sorted(
+                        r.addr for r in cands if r not in allowed)))
             return allowed
         self.metrics.inc("breaker_saturated")
+        tracing.cur_event("router", "breaker_saturated",
+                          candidates=len(cands))
         return cands
 
     # -- containment hooks (breakers + budget + deadlines) -----------------
@@ -282,8 +289,11 @@ class Router:
         retrying (brown-out containment: the fleet must not multiply
         its own load when most requests are already failing)."""
         if self.budget.try_retry():
+            tracing.cur_event("router", "budget_debit",
+                              level=round(self.budget.level(), 3))
             return True
         self.metrics.inc("retry_budget_exhausted")
+        tracing.cur_event("router", "budget_exhausted")
         self.log.warning("retry budget exhausted; failing fast instead "
                          "of retrying")
         return False
@@ -307,6 +317,29 @@ class Router:
         }
 
     @staticmethod
+    def _trace_attempt(name: str, att0: Optional[float], addr: str,
+                       outcome: str, reply=None, **attrs) -> None:
+        """Close one attempt span on the current trace: duration from
+        ``att0`` (captured before the wire call), the replica picked,
+        and the outcome taxonomy.  When the reply piggybacked the
+        replica's hop spans they are POPPED off it (the client must not
+        receive span payloads) and stitched in re-anchored at the
+        attempt's start — hop-local durations on our timeline."""
+        tr = tracing.current()
+        if tr is None or att0 is None:
+            return
+        spans = None
+        if isinstance(reply, dict):
+            spans = reply.pop("trace", None)
+        elif isinstance(reply, wire.RawFrame) \
+                and isinstance(reply.meta, dict):
+            spans = reply.meta.pop("trace", None)
+        if spans:
+            tr.absorb(spans, att0, addr=addr)
+        tr.add("router", name, att0, tr.elapsed_ms() - att0,
+               addr=addr, outcome=outcome, **attrs)
+
+    @staticmethod
     def _deadline_of(msg) -> Optional[float]:
         """The gateway-stamped ABSOLUTE deadline riding the forward
         dict (``time.monotonic`` base — same process as the gateway;
@@ -319,6 +352,7 @@ class Router:
 
     def _expired_reply(self, what: str) -> Dict[str, Any]:
         self.metrics.inc("deadline_expired_route")
+        tracing.cur_event("router", "deadline_expired", what=what)
         return {"op": "error", "kind": "deadline_exceeded",
                 "error": f"request deadline expired {what}"}
 
@@ -328,13 +362,27 @@ class Router:
         absolute ``deadline`` stripped (a monotonic reading means
         nothing on another host's clock) and the REMAINING budget
         re-stamped as ``deadline_ms`` — recomputed per attempt, so a
-        retry hands the replica only what is actually left."""
-        if deadline is None and "deadline" not in msg:
+        retry hands the replica only what is actually left.  The
+        internal ``_trace`` CONTEXT is stripped the same way (it is a
+        live object, not wire data); what crosses instead is the
+        ``trace_id`` plus the detail/slow-threshold knobs, so the
+        replica's hop spans come back attributable — hop-LOCAL offsets
+        only, absolute clocks never cross the wire."""
+        tr = tracing.current()
+        if deadline is None and tr is None \
+                and "deadline" not in msg and "_trace" not in msg:
             return msg
-        out = {k: v for k, v in msg.items() if k != "deadline"}
+        out = {k: v for k, v in msg.items()
+               if k not in ("deadline", "_trace")}
         if deadline is not None:
             out["deadline_ms"] = round(
                 max(1.0, (deadline - time.monotonic()) * 1000.0), 3)
+        if tr is not None:
+            out["trace_id"] = tr.trace_id
+            if tr.detailed:
+                out["trace_detail"] = True
+            if tr.slow_ms is not None:
+                out["trace_slow_ms"] = tr.slow_ms
         return out
 
     def _call_timeout(self, deadline: Optional[float],
@@ -501,6 +549,8 @@ class Router:
             if not self._charge_retry():
                 return False
         self.metrics.inc("retries")
+        tracing.cur_event("router", "retry", cause="timeout", addr=addr,
+                          what=what, clipped=clipped)
         self.log.warning("%s timed out on %s; retrying on "
                          "another replica (attempt %d/%d)", what, addr,
                          attempt + 1, self.max_retries + 1)
@@ -520,6 +570,9 @@ class Router:
         if not self._charge_retry():
             return False
         self.metrics.inc("retries")
+        tracing.cur_event("router", "retry", cause="link_failure",
+                          addr=addr, what=what,
+                          error=f"{type(e).__name__}")
         self.log.warning("%s replica %s failed (%s); retrying on "
                          "another replica (attempt %d/%d)", what, addr, e,
                          attempt + 1, self.max_retries + 1)
@@ -537,6 +590,8 @@ class Router:
         if not self._charge_retry():
             return False
         self.metrics.inc("retries")
+        tracing.cur_event("router", "retry", cause="replica_error",
+                          addr=addr, error=str(err)[:200])
         return True
 
     # -- drain migration: suspended replies re-place elsewhere -------------
@@ -592,7 +647,8 @@ class Router:
 
         def build_call(m):
             out = {k: v for k, v in m.items()
-                   if k not in ("op", "id", "gen", "weights_version")}
+                   if k not in ("op", "id", "gen", "weights_version",
+                                "trace")}
             out.update(op="generate", prompt=msg.get("prompt"),
                        max_new_tokens=msg.get("max_new_tokens"),
                        stop_token=msg.get("stop_token"),
@@ -608,6 +664,7 @@ class Router:
             if addr is None:
                 break
             rprobe = self._breaker_dispatch(addr)
+            att0 = tracing.cur_elapsed()
             t0 = time.monotonic()
             timeout = self._call_timeout(deadline,
                                          attempt >= self.max_retries)
@@ -616,6 +673,8 @@ class Router:
                     self._wire_msg(call, deadline), body,
                     timeout=timeout)
             except CallTimeout:
+                self._trace_attempt("resume", att0, addr, "timeout",
+                                    clipped=timeout < self.request_timeout)
                 if not self._note_timeout(
                         addr, tried, attempt, "resume",
                         clipped=timeout < self.request_timeout,
@@ -627,6 +686,8 @@ class Router:
                 # deterministic for the PAYLOAD — re-run instead.
                 return None
             except (ConnectionLost, OSError) as e:
+                self._trace_attempt("resume", att0, addr,
+                                    "link_failure")
                 if not self._note_link_failure(e, addr, tried, attempt,
                                                "resume", probe=rprobe):
                     return None
@@ -636,6 +697,8 @@ class Router:
                 # The resume target is being drained too: carry the
                 # FRESHEST artifact onward (it holds more tokens).
                 # Healthy outcome for the breaker (see route()).
+                self._trace_attempt("resume", att0, addr, "suspended",
+                                    reply=reply)
                 self._breaker_ok(addr, t0, rprobe)
                 tried.add(addr)
                 self.metrics.inc("migration_exports")
@@ -647,6 +710,9 @@ class Router:
                 body = body2
                 continue
             if isinstance(reply, dict) and reply.get("op") == "error":
+                self._trace_attempt("resume", att0, addr, "error_reply",
+                                    reply=reply,
+                                    kind=str(reply.get("kind")))
                 if reply.get("kind") == "deadline_exceeded":
                     # The replica's own in-batcher cancel fired: final
                     # for the request, not a resume failure.
@@ -655,6 +721,8 @@ class Router:
                     # Deterministic for THIS artifact (geometry/config
                     # mismatch): re-running the request still works.
                     self.metrics.inc("migration_rejected")
+                    tracing.cur_event("router", "migration_rejected",
+                                      addr=addr)
                     return None
                 if not self._note_replica_error(
                         addr, tried, RoutingError(
@@ -663,8 +731,10 @@ class Router:
                         probe=rprobe):
                     return None
                 continue
+            self._trace_attempt("resume", att0, addr, "ok", reply=reply)
             self._breaker_ok(addr, t0, rprobe)
             self.metrics.inc("migration_resumes")
+            tracing.cur_event("router", "migration_resume", addr=addr)
             return reply
         return None
 
@@ -680,7 +750,19 @@ class Router:
         is being drain-migrated away) re-places the request — resuming
         its exported KV artifact on a same-version survivor, or
         re-running it from scratch — before the retry budget is ever
-        charged a failure."""
+        charged a failure.
+
+        A ``_trace`` context riding ``msg`` (the gateway attaches one
+        per request) is ACTIVATED thread-locally for the whole routing
+        loop: every attempt records a span with its outcome taxonomy,
+        deep helpers (breaker filter, budget charges, chaos firings)
+        attribute themselves to it, and replica-piggybacked hop spans
+        are stitched back in at each attempt's start offset."""
+        tr = msg.get("_trace") if isinstance(msg, dict) else None
+        with tracing.activate(tr):
+            return self._route(msg)
+
+    def _route(self, msg: Dict[str, Any]) -> Any:
         last: Optional[BaseException] = None
         deadline = self._deadline_of(msg)
         if isinstance(msg, dict) and msg.get("op") == "generate":
@@ -702,6 +784,7 @@ class Router:
             if addr is None:
                 break       # nothing (left) to try
             probe = self._breaker_dispatch(addr)
+            att0 = tracing.cur_elapsed()
             t0 = time.monotonic()
             timeout = self._call_timeout(deadline,
                                          attempt >= self.max_retries)
@@ -711,6 +794,8 @@ class Router:
                                   timeout=timeout)
             except CallTimeout as e:
                 last = e
+                self._trace_attempt("attempt", att0, addr, "timeout",
+                                    clipped=timeout < self.request_timeout)
                 if timeout < self.request_timeout:
                     # The call was cut short by the DEADLINE slice, not
                     # the flat timeout: if the loop ends here, the
@@ -730,6 +815,8 @@ class Router:
                     f"request not encodable for {addr}: {e}") from e
             except (ConnectionLost, OSError) as e:
                 last = e
+                self._trace_attempt("attempt", att0, addr,
+                                    "link_failure")
                 if not self._note_link_failure(e, addr, tried, attempt,
                                                "generate", probe=probe):
                     break
@@ -738,6 +825,9 @@ class Router:
             if s is None:
                 if isinstance(reply, dict) \
                         and reply.get("op") == "error":
+                    self._trace_attempt(
+                        "attempt", att0, addr, "error_reply",
+                        reply=reply, kind=str(reply.get("kind")))
                     if reply.get("kind") in ("bad_request",
                                              "deadline_exceeded"):
                         # Deterministic rejection: FINAL for the
@@ -759,6 +849,8 @@ class Router:
                                                     probe=probe):
                         break
                     continue
+                self._trace_attempt("attempt", att0, addr, "ok",
+                                    reply=reply)
                 self._breaker_ok(addr, t0, probe)
                 self.budget.on_success()
                 return reply
@@ -769,6 +861,8 @@ class Router:
             # prompt reply is a HEALTHY outcome for the breaker (a
             # drain is control-plane intent, not a failure — and a
             # half-open probe answered with `suspended` must not wedge).
+            self._trace_attempt("attempt", att0, addr, "suspended",
+                                reply=reply)
             self._breaker_ok(addr, t0, probe)
             tried.add(addr)
             self.metrics.inc("migration_exports")
@@ -776,6 +870,7 @@ class Router:
             if out is not None:
                 return out
             self.metrics.inc("migration_reruns")
+            tracing.cur_event("router", "migration_rerun", addr=addr)
             last = RoutingError(
                 f"replica {addr} suspended the request mid-stream")
         if deadline_cut and isinstance(last, CallTimeout):
@@ -837,6 +932,7 @@ class Router:
                     "stop_token": msg.get("stop_token"),
                     "priority": msg.get("priority")}
             pprobe = self._breaker_dispatch(paddr)
+            patt0 = tracing.cur_elapsed()
             tp = time.monotonic()
             # The prefill phase spends at most a quarter of the
             # remaining budget: decode is the long phase, and a hung
@@ -848,6 +944,8 @@ class Router:
                     self._wire_msg(call, deadline), timeout=timeout)
             except CallTimeout as e:
                 last = e
+                self._trace_attempt("prefill", patt0, paddr, "timeout",
+                                    clipped=timeout < self.request_timeout)
                 if not self._note_timeout(
                         paddr, ptried, attempt, "prefill",
                         clipped=timeout < self.request_timeout,
@@ -862,12 +960,17 @@ class Router:
                     f"request not encodable for {paddr}: {e}") from e
             except (ConnectionLost, OSError) as e:
                 last = e
+                self._trace_attempt("prefill", patt0, paddr,
+                                    "link_failure")
                 if not self._note_link_failure(e, paddr, ptried,
                                                attempt, "prefill",
                                                probe=pprobe):
                     break
                 continue
             if isinstance(praw, dict):
+                self._trace_attempt("prefill", patt0, paddr,
+                                    "error_reply", reply=praw,
+                                    kind=str(praw.get("kind")))
                 if praw.get("kind") in ("bad_request",
                                         "deadline_exceeded"):
                     # Deterministic rejection: retrying elsewhere (or
@@ -888,6 +991,8 @@ class Router:
                     f"malformed prefill reply from {paddr}")
                 ptried.add(paddr)
                 continue
+            self._trace_attempt("prefill", patt0, paddr, "ok",
+                                reply=praw)
             self._breaker_ok(paddr, tp, pprobe)
             ttft_ms = (time.perf_counter() - t0) * 1000.0
             self.metrics.inc("disagg_prefills")
@@ -915,6 +1020,7 @@ class Router:
             last = derr or last
             break
         self.metrics.inc("disagg_fallback")
+        tracing.cur_event("router", "disagg_fallback")
         return None, last
 
     def _disagg_decode(self, msg: Dict[str, Any],
@@ -926,7 +1032,7 @@ class Router:
         returns).  Returns ``(reply, last_error)`` with ``reply`` None
         when the tier is exhausted."""
         meta = {k: v for k, v in praw.meta.items()
-                if k not in ("op", "id", "prefill_ms")}
+                if k not in ("op", "id", "prefill_ms", "trace")}
         meta.update(op="generate", prompt=msg.get("prompt"),
                     max_new_tokens=msg.get("max_new_tokens"),
                     stop_token=msg.get("stop_token"),
@@ -948,6 +1054,7 @@ class Router:
             if daddr is None:
                 return None, last
             dprobe = self._breaker_dispatch(daddr)
+            datt0 = tracing.cur_elapsed()
             timeout = self._call_timeout(deadline,
                                          attempt >= self.max_retries)
             try:
@@ -965,6 +1072,8 @@ class Router:
                 self.metrics.inc("kv_transfer_bytes", len(praw.body))
             except CallTimeout as e:
                 last = e
+                self._trace_attempt("decode", datt0, daddr, "timeout",
+                                    clipped=timeout < self.request_timeout)
                 if not self._note_timeout(
                         daddr, dtried, attempt, "disagg decode",
                         clipped=timeout < self.request_timeout,
@@ -982,6 +1091,8 @@ class Router:
                     f"KV transfer to {daddr} not encodable: {e}")
             except (ConnectionLost, OSError) as e:
                 last = e
+                self._trace_attempt("decode", datt0, daddr,
+                                    "link_failure")
                 if not self._note_link_failure(e, daddr, dtried,
                                                attempt, "disagg decode",
                                                probe=dprobe):
@@ -995,6 +1106,8 @@ class Router:
                 # or, on a requeue/fenced export, retry the ORIGINAL
                 # prefill artifact, which re-decodes deterministically.
                 # Healthy outcome for the breaker (see route()).
+                self._trace_attempt("decode", datt0, daddr, "suspended",
+                                    reply=reply)
                 self._breaker_ok(daddr, tm, dprobe)
                 dtried.add(daddr)
                 self.metrics.inc("migration_exports")
@@ -1003,7 +1116,7 @@ class Router:
                         and self.registry.gen_allowed(meta2.get("gen")):
                     meta = {k: v for k, v in meta2.items()
                             if k not in ("op", "id", "gen",
-                                         "weights_version")}
+                                         "weights_version", "trace")}
                     meta.update(op="generate", prompt=msg.get("prompt"),
                                 max_new_tokens=msg.get("max_new_tokens"),
                                 stop_token=msg.get("stop_token"),
@@ -1015,6 +1128,9 @@ class Router:
                     f"decode replica {daddr} suspended the request")
                 continue
             if isinstance(reply, dict) and reply.get("op") == "error":
+                self._trace_attempt("decode", datt0, daddr,
+                                    "error_reply", reply=reply,
+                                    kind=str(reply.get("kind")))
                 if reply.get("kind") == "deadline_exceeded":
                     # The decode replica's in-batcher cancel fired:
                     # final for the request — falling back to unified
@@ -1040,6 +1156,8 @@ class Router:
                                                 probe=dprobe):
                     return None, last
                 continue
+            self._trace_attempt("decode", datt0, daddr, "ok",
+                                reply=reply)
             self._breaker_ok(daddr, tm, dprobe)
             self.metrics.inc("disagg_decodes")
             return reply, None

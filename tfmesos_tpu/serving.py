@@ -47,6 +47,7 @@ import jax.numpy as jnp
 
 from tfmesos_tpu import prefixhash as _ph
 from tfmesos_tpu.compat import shard_map
+from tfmesos_tpu.fleet.tracing import FlightRecorder
 from tfmesos_tpu.models.transformer import (PageAllocator, TransformerConfig,
                                             decode_step,
                                             greedy_accept_counts,
@@ -152,6 +153,12 @@ class Request:
             raise ValueError(f"Request.max_new_tokens must be >= 1, got "
                              f"{self.max_new_tokens}")
         self.priority = int(self.priority)
+        # Request tracing (docs/SERVING.md "Observability"): the fleet
+        # replica attaches the hop's TraceContext here; the batcher
+        # records its per-request events (admit, preempt, suspend,
+        # resume, deadline cancel, finish) onto it when present.  None
+        # (the default) costs nothing.
+        self.trace = None
         self.deadline: Optional[float] = None
         if self.deadline_ms is not None:
             if not self.deadline_ms > 0:
@@ -1224,6 +1231,12 @@ class ContinuousBatcher:
         self.spec_rounds = 0        # jitted rounds executed
         self.spec_row_rounds = 0    # row-rounds (rows decoding per round)
         self.spec_committed = 0     # tokens committed across them
+        # The batcher's flight recorder (docs/SERVING.md
+        # "Observability"): a bounded ring of recent component events —
+        # notably per-block decode timing from every step mode,
+        # pipelined included — that survives even when no request-level
+        # trace was retained.
+        self.flight = FlightRecorder(256)
         if prefix_np is not None:
             self._init_prefix(prefix_np)
         # Cross-request prefix cache (prefix_cache_pages > 0 enables;
@@ -2355,6 +2368,9 @@ class ContinuousBatcher:
         t_admit = time.perf_counter()
         art = pre.artifact
         req = pre.request
+        self._trace_event(req, "import", rid=int(art.get("rid", -1)),
+                          row=row, pos=int(art.get("pos", 0)),
+                          resumed=int(art.get("step", 1)) > 1)
         side = self.t_side
         n = art["k"].shape[1]
         side.ensure(row, side.shared_len + n * self.page_size)
@@ -2543,6 +2559,8 @@ class ContinuousBatcher:
                         # parked: drop it without re-importing.
                         self._parked.popleft()
                         self.deadline_cancels += 1
+                        self._trace_event(pre.request, "deadline_cancel",
+                                          where="parked")
                         yield Expired(rid=int(pre.artifact.get("rid",
                                                                -1)),
                                       request=pre.request)
@@ -2574,6 +2592,7 @@ class ContinuousBatcher:
                         break       # resume once pages free up
                     self._parked.popleft()
                     self.resumes += 1
+                    self._trace_event(pre.request, "resume")
                     burst.append(self._admit_import(row, pre, wt, wd,
                                                     need, active))
                 while free_rows and bad_request is None:
@@ -2600,6 +2619,8 @@ class ContinuousBatcher:
                         # device time nobody is waiting for.
                         pending.popleft()
                         self.deadline_cancels += 1
+                        self._trace_event(req0, "deadline_cancel",
+                                          where="queued")
                         yield Expired(
                             rid=(int(item.artifact.get("rid", -1))
                                  if imported else -1),
@@ -2739,6 +2760,9 @@ class ContinuousBatcher:
         shard)`` for run()'s burst finalize — ``None`` in chunked mode,
         which makes no model call here."""
         t_admit = time.perf_counter()
+        self._trace_event(req, "admit", rid=rid, row=row,
+                          prompt_len=int(req.prompt.size),
+                          cached=plan is not None)
         length = req.prompt.size
         width = -(-length // self.prefill_bucket) * self.prefill_bucket
         if plan is not None:
@@ -2960,10 +2984,15 @@ class ContinuousBatcher:
             rids[r] = row.rid
             steps[r] = row.step
         table = self.t_side.decode_table(active, decoding)
+        tb0 = time.perf_counter()
         self.pool, nxt = self._decode(
             self.params, self.pool, table, jnp.asarray(toks),
             jnp.asarray(positions), jnp.asarray(rids), jnp.asarray(steps))
         nxt = np.asarray(nxt)               # ONE host sync per K tokens
+        self.flight.record(
+            {"name": "decode.block", "mode": "sync",
+             "dur": round((time.perf_counter() - tb0) * 1000.0, 3),
+             "rows": len(decoding), "k": K})
         for r in list(decoding):
             row = active[r]
             for j in range(K):
@@ -3116,7 +3145,16 @@ class ContinuousBatcher:
         previous retire (or were re-admitted since) fail the rid check
         and their block is dropped."""
         nxt, ticket = inflight
+        tb0 = time.perf_counter()
         nxt = np.asarray(nxt)           # host sync: one block behind
+        # The lagged-block sync time IS the pipelined loop's per-block
+        # cost (dispatch is a non-blocking enqueue): one flight entry
+        # per block, like _step's synchronous one.
+        self.flight.record(
+            {"name": "decode.block",
+             "mode": "pipelined" if self._pipelined else "overlap",
+             "dur": round((time.perf_counter() - tb0) * 1000.0, 3),
+             "rows": len(ticket), "k": self.multi_step})
         for r, rid in ticket.items():
             row = active.get(r)
             if row is None or row.rid != rid:
@@ -3288,6 +3326,8 @@ class ContinuousBatcher:
             row = active[r]
             self.deadline_cancels += 1
             rid, req = row.rid, row.req
+            self._trace_event(req, "deadline_cancel", rid=rid,
+                              where="resident", step=row.step)
             self._finish(r, active, free_rows)
             yield Expired(rid=rid, request=req)
 
@@ -3344,6 +3384,8 @@ class ContinuousBatcher:
             return False
         _, _, r = min(victims)
         req = active[r].req
+        self._trace_event(req, "preempt", by_priority=priority,
+                          priority=req.priority)
         art = self._suspend_row(r, active, free_rows)
         self._parked.append(Prefilled(req, art))
         self.preemptions += 1
@@ -3377,6 +3419,8 @@ class ContinuousBatcher:
                    if self._suspendable(state) else None)
             req = state.req
             rid = state.rid
+            self._trace_event(req, "suspend", rid=rid,
+                              exported=art is not None)
             self._finish(r, active, free_rows)
             yield Suspended(rid=rid, request=req, artifact=art)
         while self._parked:
@@ -3393,8 +3437,27 @@ class ContinuousBatcher:
                 yield Suspended(rid=-1, request=item, artifact=None)
         self._preempt_event.clear()
 
+    @staticmethod
+    def _trace_event(req: Request, name: str, **attrs) -> None:
+        """One batcher event on the request's trace (no-op without
+        one — local runs cost nothing)."""
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            tr.event("batcher", name, **attrs)
+
     def _completion(self, row: _Row) -> Completion:
         now = time.perf_counter()
+        tr = getattr(row.req, "trace", None)
+        if tr is not None:
+            # The two phase spans every waterfall wants: admission ->
+            # first token (prefill + queue-for-burst) and first token
+            # -> finish (decode), from the row's own perf_counter
+            # stamps — hop-local by construction.
+            tr.span_between("batcher", "prefill", row.t_admit,
+                            max(row.t_first, row.t_admit), rid=row.rid)
+            tr.span_between("batcher", "decode",
+                            max(row.t_first, row.t_admit), now,
+                            rid=row.rid, tokens=len(row.out))
         return Completion(rid=row.rid, request=row.req,
                           tokens=list(row.out),
                           ttft_s=row.t_first - row.t_admit,
