@@ -1582,6 +1582,53 @@ def bench_fleet_soak(rows=2, workers=8, slow_delay_s=0.25,
             slow_attempt_ms, traces_detailed)
 
 
+def bench_fleet_sim(replicas=1000, n_requests=1_000_000, seed=0):
+    """Fleet-simulator scale + fidelity bench (docs/SIMULATOR.md).
+
+    Two in-bench asserts:
+
+    * SCALE — the ``scale`` scenario (the REAL admission/router/
+      containment/registry code on the virtual clock, 1000 simulated
+      replicas, >= 1M requests, zero lost) completes in under 60s of
+      CPU, recording ``sim_events_per_sec`` and
+      ``sim_replicas_per_wallclock_sec`` (simulated replica-seconds
+      per wall second) so per-request control-plane cost regressions
+      surface as a throughput drop.
+    * FIDELITY — the ``soak-replay`` scenario replays the seeded
+      ``bench_fleet_soak`` chaos timeline and must reproduce its
+      qualitative outcomes: the gray-slow replica breaker-isolated
+      (latency outlier) while heartbeat-alive, zero lost requests,
+      retry amplification <= 1.5, conformant deadline probes.
+    """
+    from tfmesos_tpu.fleet.sim import run_scenario
+
+    w0 = time.perf_counter()
+    c0 = time.process_time()
+    out = run_scenario("scale", n_requests=n_requests,
+                       replicas=replicas, seed=seed)
+    wall_s = time.perf_counter() - w0
+    cpu_s = time.process_time() - c0
+    assert out["requests"] >= n_requests, out["requests"]
+    assert out["lost"] == 0, f"{out['lost']} requests lost in the sim"
+    assert min(wall_s, cpu_s) < 60.0, \
+        (f"{replicas}-replica / {n_requests}-request scenario took "
+         f"{wall_s:.1f}s wall / {cpu_s:.1f}s CPU (budget: 60s)")
+
+    fid = run_scenario("soak-replay", seed=20)
+    assert fid["victim_isolated"], "gray replica never breaker-isolated"
+    assert fid["victim_alive_while_isolated"], \
+        "victim not heartbeat-alive while isolated (not a gray failure)"
+    assert fid["victim_trip_reason"] == "latency_outlier", \
+        fid["victim_trip_reason"]
+    assert fid["lost"] == 0, f"{fid['lost']} requests lost in soak replay"
+    assert fid["retry_amplification"] <= 1.5, fid["retry_amplification"]
+    assert fid["probes_conformant"], fid["probe_outcomes"]
+    return (out["sim_events_per_sec"],
+            out["sim_replicas_per_wallclock_sec"], wall_s,
+            out["requests"], out["sim_seconds"],
+            fid["retry_amplification"])
+
+
 def bench_fleet_trace_overhead(n_requests=240, workers=4, threads=2,
                                handler_delay_s=0.01, best_of=3):
     """Tracing overhead bound (PR 10 acceptance): the same seeded stub
@@ -2114,6 +2161,20 @@ def main():
         # tail-based retention.
         out["fleet_trace_slow_attempt_ms"] = round(slow_attempt_ms, 2)
         out["fleet_trace_detailed_retained"] = int(traces_detailed)
+        flush_partial()
+    sm = attempts(bench_fleet_sim, "fleet simulator bench", n=1)
+    if sm:
+        # Virtual-clock fleet simulator: the real control plane driven
+        # at 1000-replica / 1M-request scale in seconds of CPU, plus
+        # the soak-replay fidelity gate (gray-failure isolation, zero
+        # lost, bounded amplification — asserted in-bench).
+        (events_ps, replica_s_ps, wall_s, n_sim, sim_s, fid_amp) = sm[0]
+        out["sim_events_per_sec"] = round(events_ps, 1)
+        out["sim_replicas_per_wallclock_sec"] = round(replica_s_ps, 1)
+        out["fleet_sim_wall_s"] = round(wall_s, 2)
+        out["fleet_sim_requests"] = int(n_sim)
+        out["fleet_sim_virtual_seconds"] = round(sim_s, 1)
+        out["fleet_sim_soak_amplification"] = round(fid_amp, 3)
         flush_partial()
     tro = attempts(bench_fleet_trace_overhead, "trace overhead bench",
                    n=1)

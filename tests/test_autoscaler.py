@@ -397,6 +397,70 @@ def test_histogram_delta_percentile_is_windowed():
     assert Histogram.delta_percentile(cur, cur, 0.99) is None
 
 
+def test_histogram_delta_percentile_edge_cases():
+    """The autoscaler's key signal, exercised directly at its edges
+    (until now these paths were only hit indirectly through autoscaler
+    tests)."""
+    # Empty histogram: cumulative is the zero state, every percentile
+    # of it is None.
+    h = Histogram()
+    cur = h.cumulative()
+    assert cur[2] == 0
+    assert Histogram.delta_percentile(None, cur, 0.99) is None
+    # Single-bucket histogram (inf only): every rank lands in the inf
+    # bucket — the last finite edge defaults to 0.0 without an
+    # inf_value, and inf_value (the tracked max) wins when supplied.
+    hb = Histogram(buckets=(float("inf"),))
+    hb.observe(123.0)
+    cur = hb.cumulative()
+    assert Histogram.delta_percentile(None, cur, 0.99) == 0.0
+    assert Histogram.delta_percentile(None, cur, 0.99,
+                                      inf_value=123.0) == 123.0
+    # All observations beyond the last finite edge ("all-inf"): the
+    # rank walk must terminate and report the last finite edge (the
+    # honest "at least this much" answer), not raise or return inf.
+    h2 = Histogram(buckets=(1.0, float("inf")))
+    for _ in range(10):
+        h2.observe(50.0)
+    cur2 = h2.cumulative()
+    assert Histogram.delta_percentile(None, cur2, 0.5) == 1.0
+    assert Histogram.delta_percentile(None, cur2, 0.5,
+                                      inf_value=50.0) == 50.0
+    # Window wrap: a prev sample whose BUCKETS differ (a histogram
+    # replaced between ticks) cannot be subtracted — the delta falls
+    # back to since-birth of cur rather than producing negative
+    # counts.
+    other = Histogram(buckets=(2.0, float("inf")))
+    other.observe(1.0)
+    assert Histogram.delta_percentile(other.cumulative(), cur2,
+                                      0.5) == 1.0
+    # A prev ahead of cur in count with EQUAL buckets (a reset/wrapped
+    # window) yields an empty-or-negative total -> None, never a bogus
+    # percentile.
+    h3 = Histogram(buckets=(1.0, float("inf")))
+    h3.observe(0.5)
+    assert Histogram.delta_percentile(cur2, h3.cumulative(), 0.5) is None
+
+
+def test_histogram_cumulative_snapshot_is_immutable_and_consistent():
+    h = Histogram(buckets=(1.0, 10.0, float("inf")))
+    for v in (0.5, 5.0, 100.0):
+        h.observe(v)
+    buckets, counts, count = h.cumulative()
+    assert buckets == (1.0, 10.0, float("inf"))
+    assert counts == (1, 1, 1)
+    assert count == 3
+    # The snapshot is a value, not a view: later observations must not
+    # mutate an already-taken sample (the autoscaler stores prev
+    # across ticks).
+    h.observe(0.1)
+    assert counts == (1, 1, 1)
+    # NaN observations are dropped entirely (they would shift every
+    # rank while landing in no bucket).
+    h.observe(float("nan"))
+    assert h.cumulative()[2] == 4
+
+
 # -- the tox-lint smoke: stub replicas, real registry/router, no JAX --------
 
 

@@ -255,6 +255,20 @@ def test_fleet_priority_bench_smoke():
 
 
 @pytest.mark.slow
+def test_fleet_sim_bench_smoke():
+    """bench_fleet_sim's protocol at small size: the scale scenario
+    (real control plane, virtual clock) completes losslessly and the
+    soak-replay fidelity gate holds — all asserted inside the bench."""
+    (events_ps, replica_s_ps, wall_s, n, sim_s, fid_amp) = \
+        bench.bench_fleet_sim(replicas=100, n_requests=20_000)
+    assert n == 20_000
+    assert events_ps > 0 and replica_s_ps > 0
+    assert sim_s > 0
+    assert fid_amp <= 1.5
+    assert wall_s < 60.0
+
+
+@pytest.mark.slow
 def test_fleet_soak_bench_smoke():
     """The chaos-soak protocol end to end at small size: gray-slow
     replica breaker-isolated while heartbeat-alive, SIGKILL +

@@ -397,14 +397,14 @@ def test_registry_drain_not_counted_as_heartbeat():
     reg = ReplicaRegistry(token="t", chaos=plan)    # not started: direct
     a, peer = socket.socketpair()
     try:
-        assert reg._on_msg({"op": "hello", "addr": "r1:1"}, a) == "r1:1"
-        assert reg._on_msg({"op": "drain", "addr": "r1:1"}, a) == "r1:1"
+        assert reg.observe({"op": "hello", "addr": "r1:1"}, a) == "r1:1"
+        assert reg.observe({"op": "drain", "addr": "r1:1"}, a) == "r1:1"
         assert reg.snapshot()[0]["state"] == DRAINING
         # Beat 2 (not 3 — the drain did not count) is the dropped one,
         # so the drain's effect survives it.
-        assert reg._on_msg({"op": "heartbeat", "addr": "r1:1"}, a) is None
+        assert reg.observe({"op": "heartbeat", "addr": "r1:1"}, a) is None
         assert reg.snapshot()[0]["state"] == DRAINING
-        assert reg._on_msg({"op": "heartbeat", "addr": "r1:1"}, a) == "r1:1"
+        assert reg.observe({"op": "heartbeat", "addr": "r1:1"}, a) == "r1:1"
         assert reg.snapshot()[0]["state"] == "alive"
     finally:
         a.close()

@@ -277,6 +277,97 @@ def test_serve_parser_observability_flags_and_subcommands(capfd,
     assert wire.TOKEN_ENV in capfd.readouterr().err
 
 
+def test_trace_json_export(capfd, monkeypatch):
+    """`tfserve trace -g GW --json` prints the raw records as one JSON
+    array — the machine-readable export the simulator replays."""
+    from tfmesos_tpu import wire
+    from tfmesos_tpu.cli import build_trace_parser, trace_main
+    from tfmesos_tpu.fleet import client as fleet_client
+
+    assert build_trace_parser().parse_args(
+        ["-g", "g:1", "--json"]).as_json
+    records = [{"trace_id": "t1", "status": "completed",
+                "total_ms": 12.5, "ts": 1.0,
+                "summary": {"cls": "interactive", "tokens": 4}}]
+
+    class StubClient:
+        def __init__(self, *a, **k):
+            pass
+
+        def trace(self, **kwargs):
+            return records
+
+        def close(self):
+            pass
+
+    monkeypatch.setenv(wire.TOKEN_ENV, "secret")
+    monkeypatch.setattr(fleet_client, "FleetClient", StubClient)
+    assert trace_main(["-g", "h:1", "--json"]) == 0
+    out = capfd.readouterr().out
+    assert json.loads(out) == records
+    # An empty book is a valid export for a pipeline, not an error.
+    records2, records = records, []
+    assert trace_main(["-g", "h:1", "--json"]) == 0
+    assert json.loads(capfd.readouterr().out) == []
+    records = records2  # noqa: F841
+
+
+def test_simulate_subcommand(capfd):
+    """`tfserve simulate`: a named scenario runs jax-free and prints
+    per-class percentiles; --sweep prints one block per value; errors
+    surface as rc=2 with a message."""
+    from tfmesos_tpu.cli import serve_main
+
+    assert serve_main(["simulate", "steady", "--requests", "300",
+                       "--replicas", "2", "--seed", "5"]) == 0
+    out = capfd.readouterr().out
+    assert "scenario steady" in out
+    assert "class interactive" in out and "p99=" in out
+
+    assert serve_main(["simulate", "steady", "--requests", "200",
+                       "--replicas", "2", "--seed", "5",
+                       "--sweep", "breaker.latency_factor=2,8"]) == 0
+    out = capfd.readouterr().out
+    assert "breaker.latency_factor=2" in out
+    assert "breaker.latency_factor=8" in out
+
+    assert serve_main(["simulate", "steady", "--requests", "100",
+                       "--replicas", "2", "--json"]) == 0
+    parsed = json.loads(capfd.readouterr().out)
+    assert parsed["requests"] == 100 and parsed["lost"] == 0
+
+    assert serve_main(["simulate", "steady", "--requests", "50",
+                       "--replicas", "2",
+                       "--set", "no.such.knob=1"]) == 2
+    assert "unknown sweep path" in capfd.readouterr().err
+    assert serve_main(["simulate", "steady", "--set", "broken"]) == 2
+    assert "PATH=VALUE" in capfd.readouterr().err
+
+
+def test_simulate_replay_round_trip(tmp_path, capfd):
+    """A trace export written by `tfserve trace --json` replays as a
+    simulate workload (--replay), latency model fitted from it."""
+    from tfmesos_tpu.cli import serve_main
+
+    records = []
+    for i in range(60):
+        records.append({"trace_id": f"t{i}", "status": "completed",
+                        "total_ms": 80.0, "ts": 100.0 + 0.02 * i,
+                        "summary": {"cls": "interactive", "tokens": 8,
+                                    "ttft_ms": 16.0}})
+    path = tmp_path / "export.json"
+    path.write_text(json.dumps(records))
+    assert serve_main(["simulate", "steady", "--replicas", "2",
+                       "--replay", str(path), "--json"]) == 0
+    parsed = json.loads(capfd.readouterr().out)
+    assert parsed["requests"] == 60 and parsed["lost"] == 0
+    empty = tmp_path / "empty.json"
+    empty.write_text("[]")
+    assert serve_main(["simulate", "steady", "--replay",
+                       str(empty)]) == 2
+    assert "no replayable" in capfd.readouterr().err
+
+
 def test_replica_parser_round_trip():
     """The replica process's own flags (what FleetServer's Mode-B cmd
     drives) must round-trip too."""

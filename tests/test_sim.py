@@ -1,0 +1,413 @@
+"""Trace-driven fleet simulator (tfmesos_tpu/fleet/sim.py + workload.py):
+jax-free.  The centerpiece is the FIDELITY GATE — the ``soak-replay``
+scenario replays bench_fleet_soak's seeded chaos timeline (gray-slow
+replica, SIGKILL + autoscaler self-heal, link sever, blue-green
+rollout) through the REAL admission/router/containment/registry code on
+the virtual clock and must reproduce the soak's qualitative outcomes
+(breaker isolation while heartbeat-alive, zero lost requests, retry
+amplification <= 1.5, conformant deadline probes) with ZERO real
+sleeping — asserted via the sleep-trap fixture, so a policy regression
+or a clock-injection regression fails CI deterministically in seconds.
+Plus: engine/virtual-clock units, workload synthesis determinism, trace
+replay + latency-model fitting, sweep-path overrides, a disaggregated
+two-tier sim run, and a slow-marked 1000-replica scale test."""
+
+import json
+import random
+import time
+
+import pytest
+
+from tfmesos_tpu.fleet.registry import UNIFIED
+from tfmesos_tpu.fleet.sim import (FleetSim, ReplicaModel, SimConfig,
+                                   SimEngine, VirtualClock,
+                                   apply_override, parse_sweep,
+                                   run_scenario, run_sweep)
+from tfmesos_tpu.fleet.workload import (Request, SyntheticWorkload,
+                                        fit_replica_model,
+                                        load_trace_export,
+                                        replay_from_traces)
+
+
+@pytest.fixture
+def sleep_trap(monkeypatch):
+    """Fail the test if ANY real time.sleep executes while a sim runs —
+    the no-real-sleeping contract of the virtual clock (a missed clock
+    injection would land here)."""
+    calls = []
+
+    def trap(seconds):
+        calls.append(seconds)
+        raise AssertionError(
+            f"real time.sleep({seconds}) during a simulation — some "
+            f"component is not running on the virtual clock")
+
+    monkeypatch.setattr(time, "sleep", trap)
+    return calls
+
+
+# -- engine units ------------------------------------------------------------
+
+
+def test_virtual_clock_and_event_order():
+    eng = SimEngine(seed=0)
+    seen = []
+    eng.at(2.0, lambda: seen.append(("b", eng.clock.now)))
+    eng.at(1.0, lambda: seen.append(("a", eng.clock.now)))
+    eng.at(1.0, lambda: seen.append(("a2", eng.clock.now)))
+    eng.run()
+    assert seen == [("a", 1.0), ("a2", 1.0), ("b", 2.0)]
+    assert eng.clock() == 2.0
+
+
+def test_engine_fiber_sleep_is_virtual(sleep_trap):
+    eng = SimEngine(seed=0)
+    out = []
+
+    def body():
+        eng.sleep(5.0)
+        out.append(eng.clock.now)
+
+    eng.spawn(body, name="t")
+    eng.run()
+    eng.stop_fibers()
+    assert out == [5.0]
+
+
+def test_engine_run_until_and_stop():
+    eng = SimEngine(seed=0)
+    ticks = []
+
+    def tick():
+        ticks.append(eng.clock.now)
+        eng.after(1.0, tick)
+
+    eng.after(1.0, tick)
+    eng.run(until=3.5)
+    assert ticks == [1.0, 2.0, 3.0]
+    assert eng.clock() == 3.5
+    eng.run(stop=lambda: len(ticks) >= 5)
+    assert len(ticks) == 5
+
+
+def test_engine_fast_forward_only_when_clear():
+    eng = SimEngine(seed=0)
+    eng.at(10.0, lambda: None)
+    assert not eng.fast_forward(11.0)    # an earlier event exists
+    assert eng.fast_forward(10.0)        # heap[0] is not earlier
+    assert eng.clock() == 10.0
+
+
+def test_engine_fiber_crash_surfaces():
+    eng = SimEngine(seed=0)
+
+    def body():
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        eng.spawn(body, name="crash")
+
+
+# -- workload synthesis & replay ---------------------------------------------
+
+
+def test_synthetic_workload_deterministic_per_seed():
+    mk = lambda seed: list(SyntheticWorkload(  # noqa: E731
+        n_requests=50, rate=100.0, seed=seed,
+        class_mix={"a": 1.0, "b": 3.0}, deadline_ms=500.0))
+    one, two, other = mk(7), mk(7), mk(8)
+    assert one == two
+    assert one != other
+    assert len(one) == 50
+    assert all(r.deadline_ms == 500.0 for r in one)
+    assert all(one[i].at < one[i + 1].at for i in range(49))
+    assert {r.cls for r in one} == {"a", "b"}
+    # tenant skew: the 3x class dominates
+    assert sum(r.cls == "b" for r in one) > sum(r.cls == "a" for r in one)
+
+
+def test_synthetic_workload_validation():
+    with pytest.raises(ValueError):
+        SyntheticWorkload(n_requests=0, rate=1.0)
+    with pytest.raises(ValueError):
+        SyntheticWorkload(n_requests=1, rate=0.0)
+    with pytest.raises(ValueError):
+        SyntheticWorkload(n_requests=1, rate=1.0, class_mix={"a": 0.0})
+
+
+def _fake_trace_records():
+    return [
+        {"trace_id": "t1", "status": "completed", "total_ms": 120.0,
+         "ts": 1000.0, "summary": {"cls": "interactive", "tokens": 10,
+                                   "ttft_ms": 20.0},
+         "spans": [{"component": "gateway", "name": "recv",
+                    "prompt_len": 96}]},
+        {"trace_id": "t2", "status": "completed", "total_ms": 220.0,
+         "ts": 1000.5, "summary": {"cls": "background", "tokens": 20,
+                                   "ttft_ms": 20.0}},
+        {"trace_id": "t3", "status": "deadline_exceeded",
+         "total_ms": 60.0, "ts": 1000.2, "summary": {"cls": "interactive"}},
+    ]
+
+
+def test_replay_from_traces_orders_and_classes():
+    reqs = replay_from_traces(_fake_trace_records())
+    assert len(reqs) == 3
+    assert reqs[0].at == 0.0                    # re-anchored at t=0
+    assert [r.cls for r in reqs] == ["interactive", "interactive",
+                                     "background"]
+    assert reqs[0].prompt_len == 96             # from the recv span
+    assert reqs[0].new_tokens == 10
+    assert abs(reqs[2].at - 0.5) < 1e-9
+    # speedup compresses the arrival timeline
+    fast = replay_from_traces(_fake_trace_records(), speedup=5.0)
+    assert abs(fast[2].at - 0.1) < 1e-9
+
+
+def test_fit_replica_model_from_traces():
+    fit = fit_replica_model(_fake_trace_records())
+    # medians over the two completed records: ttft 20ms; per-token
+    # (120-20)/10=10 and (220-20)/20=10.
+    assert fit["prefill_base_ms"] == 20.0
+    assert fit["decode_ms_per_token"] == 10.0
+    assert fit_replica_model([]) == {}
+
+
+def test_load_trace_export_array_and_jsonl(tmp_path):
+    recs = _fake_trace_records()
+    arr = tmp_path / "arr.json"
+    arr.write_text(json.dumps(recs))
+    jl = tmp_path / "lines.json"
+    jl.write_text("\n".join(json.dumps(r) for r in recs))
+    assert load_trace_export(str(arr)) == recs
+    assert load_trace_export(str(jl)) == recs
+
+
+# -- sweep-path overrides ----------------------------------------------------
+
+
+def test_apply_override_paths():
+    cfg = SimConfig()
+    apply_override(cfg, "breaker.latency_factor", "8")
+    assert cfg.breaker.latency_factor == 8.0
+    apply_override(cfg, "autoscaler.queue_wait_hi_ms", "200")
+    assert cfg.autoscaler.queue_wait_hi_ms == 200.0
+    apply_override(cfg, "admission.max_queue", "256")
+    assert cfg.max_queue == 256
+    apply_override(cfg, "budget.token_ratio", "0.5")
+    assert cfg.budget_token_ratio == 0.5
+    apply_override(cfg, "router.max_retries", "4")
+    assert cfg.max_retries == 4
+    apply_override(cfg, "model.decode_ms_per_token", "7.5")
+    assert cfg.model.decode_ms_per_token == 7.5
+    apply_override(cfg, "replicas", "9")
+    assert cfg.replicas == 9
+    for bad in ("nope.nope", "breaker.nope", "breaker.a.b", "zzz"):
+        with pytest.raises(ValueError):
+            apply_override(cfg, bad, "1")
+
+
+def test_parse_sweep():
+    assert parse_sweep("breaker.latency_factor=2,4,8") == \
+        ("breaker.latency_factor", ["2", "4", "8"])
+    for bad in ("x", "=1,2", "a="):
+        with pytest.raises(ValueError):
+            parse_sweep(bad)
+
+
+# -- scenarios ---------------------------------------------------------------
+
+
+def test_steady_scenario_completes_and_is_deterministic(sleep_trap):
+    one = run_scenario("steady", n_requests=600, replicas=3, seed=11)
+    two = run_scenario("steady", n_requests=600, replicas=3, seed=11)
+    assert one["requests"] == 600
+    assert one["lost"] == 0
+    assert one["completed"] + sum(
+        sum(v) for v in one["shed"].values()) == 600
+    # Same seed, same virtual timeline: wall-clock keys aside, the
+    # results are identical — what makes every scenario a regression
+    # gate.
+    for k in ("completed", "failed", "retries", "sim_seconds",
+              "classes", "shed", "deadline_errors"):
+        assert one[k] == two[k], k
+    assert one["classes"]["interactive"]["count"] > 0
+
+
+def test_sim_runs_real_wfq_admission(sleep_trap):
+    # A 10x background flood against the weight-8 interactive class:
+    # the REAL WFQ keeps interactive p99 well under background p99.
+    wl = SyntheticWorkload(
+        n_requests=1200, rate=600.0, seed=5,
+        class_mix={"interactive": 1.0, "background": 10.0},
+        prompt_len=32, new_tokens=16)
+    out = run_scenario("steady", replicas=2, seed=5, workload=wl)
+    classes = out["classes"]
+    assert classes["interactive"]["p99_ms"] <= classes["background"]["p99_ms"]
+
+
+def test_sweep_rows_share_seed_and_differ_by_knob(sleep_trap):
+    rows = run_sweep("steady", "model.decode_ms_per_token", ["2", "20"],
+                     n_requests=300, replicas=2, seed=3)
+    assert [v for v, _ in rows] == ["2", "20"]
+    fast, slow = rows[0][1], rows[1][1]
+    assert fast["requests"] == slow["requests"] == 300
+    assert fast["classes"]["background"]["p99_ms"] \
+        < slow["classes"]["background"]["p99_ms"]
+
+
+def test_surge_scenario_scales_up_with_real_autoscaler(sleep_trap):
+    out = run_scenario("surge", n_requests=2400, replicas=2, seed=4)
+    assert out["lost"] == 0
+    assert out["autoscaled_to"] > 2, \
+        "4x surge never grew the tier through the real autoscaler"
+    traj = out["autoscaler_trajectory"]
+    assert traj[0]["unified"]["actual"] == 2
+    assert traj[-1]["unified"]["actual"] == out["autoscaled_to"]
+
+
+def test_disagg_two_phase_routing_in_sim(sleep_trap):
+    # A prefill tier + decode tier and no unified replicas: the REAL
+    # router's disaggregated orchestration (prefill -> raw-frame KV
+    # handoff -> decode) must serve every request in the sim too.
+    cfg = SimConfig(replicas=0, prefill_replicas=2, decode_replicas=2,
+                    seed=9)
+    wl = SyntheticWorkload(n_requests=200, rate=200.0, seed=9,
+                           class_mix={"interactive": 1.0})
+    out = run_scenario("steady", cfg=cfg, workload=wl, seed=9)
+    assert out["lost"] == 0
+    assert out["completed"] + sum(
+        sum(v) for v in out["shed"].values()) == 200
+
+
+def test_replay_workload_drives_sim(sleep_trap):
+    reqs = replay_from_traces(_fake_trace_records() * 40)
+    fit = fit_replica_model(_fake_trace_records())
+    out = run_scenario("steady", replicas=2, seed=1, workload=reqs,
+                       model_fit=fit)
+    assert out["requests"] == len(reqs)
+    assert out["lost"] == 0
+
+
+# -- THE FIDELITY GATE -------------------------------------------------------
+
+
+def test_soak_replay_fidelity_gate(sleep_trap):
+    """bench_fleet_soak's seeded chaos timeline through the real
+    control plane on the virtual clock: the simulator must reproduce
+    the soak's qualitative outcomes, with zero real sleeping."""
+    out = run_scenario("soak-replay", seed=20)
+    # Gray containment: breaker open on the latency outlier while the
+    # registry still reports the victim ALIVE.
+    assert out["victim_isolated"], "slow replica never breaker-isolated"
+    assert out["victim_alive_while_isolated"], \
+        "victim must be heartbeat-alive while breaker-open (that is " \
+        "what makes the failure gray)"
+    assert out["victim_trip_reason"] == "latency_outlier", \
+        out["victim_trip_reason"]
+    # Lossless across SIGKILL + self-heal + sever + rollout.
+    assert out["lost"] == 0, f"lost {out['lost']} requests"
+    assert out["healed"], "autoscaler never relaunched the killed replica"
+    # Bounded retry amplification (the retry budget's job).
+    assert out["retry_amplification"] <= 1.5, out["retry_amplification"]
+    # Deadline probes: explicit deadline_exceeded at ~the deadline.
+    assert out["probes_conformant"], out["probe_outcomes"]
+    assert out["conformance_violations"] == 0
+    # The rollout's drain-migration actually moved in-flight work.
+    assert out["migration_reruns"] >= 1
+
+
+def test_soak_replay_deterministic(sleep_trap):
+    one = run_scenario("soak-replay", seed=20)
+    two = run_scenario("soak-replay", seed=20)
+    for k in ("completed", "retries", "retry_amplification",
+              "sim_seconds", "victim", "probe_outcomes"):
+        assert one[k] == two[k], k
+
+
+def test_soak_replay_control_arm_no_breakers(sleep_trap):
+    """The control arm of the bench: same seed, same gray fault,
+    breakers disabled — the victim is never isolated and interactive
+    latency degrades toward the injected delay (proving the mechanism,
+    not the workload)."""
+    on = run_scenario("soak-replay", seed=20)
+    off = run_scenario("soak-replay", seed=20,
+                       overrides=[("breakers", "false")])
+    assert off["breakers"] is None
+    assert not off["victim_isolated"]
+    assert off["lost"] == 0             # slow is not lost
+    assert off["interactive_p99_ms"] > on["interactive_p99_ms"], \
+        (off["interactive_p99_ms"], on["interactive_p99_ms"])
+
+
+# -- direct FleetSim drive ---------------------------------------------------
+
+
+def test_fleet_sim_kill_marks_dead_and_retries(sleep_trap):
+    cfg = SimConfig(replicas=2, seed=2, workers=2)
+    sim = FleetSim(cfg)
+    a = sim.add_replica(UNIFIED)
+    b = sim.add_replica(UNIFIED)
+    sim.start_workers()
+    wl = [Request(at=0.01 * i, cls=None, prompt_len=8, new_tokens=4)
+          for i in range(40)]
+    sim.feed(wl)
+    # Kill one replica mid-run: in-flight calls fail over, the
+    # registry learns through mark_dead/sweep, nothing is lost.
+    sim.engine.at(0.15, lambda: sim.kill(a))
+    sim.engine.run(stop=sim.drained)
+    assert sim.lost == []
+    assert sim.completed == 40
+    dead = [r for r in sim.registry.members() if r.addr == a.addr]
+    assert not dead or dead[0].state in ("dead",)
+    assert b.served > 0
+    sim.stop()
+
+
+def test_fleet_sim_deadline_shed_in_queue(sleep_trap):
+    # One slow replica, deadlines far shorter than the backlog: some
+    # requests expire IN the WFQ queue and take the explicit
+    # deadline_exceeded path (admission's dispatch-time shed).
+    cfg = SimConfig(replicas=1, capacity=1, seed=3, workers=1,
+                    model=ReplicaModel(decode_ms_per_token=20.0))
+    sim = FleetSim(cfg)
+    sim.add_replica(UNIFIED)
+    sim.start_workers()
+    wl = [Request(at=0.001 * i, cls=None, prompt_len=4, new_tokens=16,
+                  deadline_ms=100.0) for i in range(30)]
+    sim.feed(wl)
+    sim.engine.run(stop=sim.drained)
+    assert sim.expired_in_queue + sim.deadline_errors > 0
+    assert sim.conformance_violations == 0
+    assert sim.lost == []
+    sim.stop()
+
+
+def test_virtual_clock_threads_through_every_component(sleep_trap):
+    """The multi-layer clock refactor, asserted end-to-end: after a
+    sim run, every latency the control plane recorded is VIRTUAL
+    (seconds of wall time would show up as tiny millisecond readings;
+    virtual service times are tens of ms)."""
+    clock = VirtualClock(100.0)
+    assert clock() == 100.0
+    out = run_scenario("steady", n_requests=400, replicas=2, seed=6)
+    lat = out["classes"]["background"]
+    assert lat["p50_ms"] and lat["p50_ms"] >= 10.0, \
+        "latencies not measured on the virtual clock"
+    assert out["sim_seconds"] > 1.0
+
+
+@pytest.mark.slow
+def test_scale_1000_replicas(sleep_trap):
+    """The scale claim at CI-affordable size: 1000 simulated replicas,
+    50k requests through the real control plane, zero lost, at a
+    throughput floor that catches per-request cost regressions."""
+    t0 = time.perf_counter()
+    out = run_scenario("scale", n_requests=50_000, replicas=1000, seed=0)
+    wall = time.perf_counter() - t0
+    assert out["lost"] == 0
+    assert out["completed"] + sum(
+        sum(v) for v in out["shed"].values()) == 50_000
+    assert len(random.sample(range(1000), 2)) == 2   # sanity: stdlib rng
+    assert out["sim_events_per_sec"] > 5000, out["sim_events_per_sec"]
+    assert wall < 30.0, f"50k-request scale smoke took {wall:.1f}s"

@@ -504,6 +504,12 @@ def build_trace_parser() -> argparse.ArgumentParser:
                             "traces")
     p.add_argument("--limit", type=int, default=20,
                    help="max records for the summary/failed listings")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the raw trace records as one JSON array "
+                        "instead of waterfalls — the machine-readable "
+                        "export `tfserve simulate --replay` consumes "
+                        "(docs/SIMULATOR.md), and offline-analysis "
+                        "input generally")
     p.add_argument("--timeout", type=float, default=10.0)
     return p
 
@@ -532,6 +538,11 @@ def trace_main(argv: List[str]) -> int:
     finally:
         if client is not None:
             client.close()
+    if args.as_json:
+        # Machine-readable export, empty result included (an empty
+        # book is a valid export, not an error for a pipeline).
+        print(json.dumps(traces), flush=True)
+        return 0
     if not traces:
         what = (f"trace {args.trace_id!r}" if args.trace_id
                 else "matching traces")
@@ -551,6 +562,143 @@ def trace_main(argv: List[str]) -> int:
                   f"{rec.get('total_ms', 0):>10.1f}ms  "
                   f"{'detail' if rec.get('detailed') else 'summary':<7} "
                   f"{extra}", flush=True)
+    return 0
+
+
+def build_simulate_parser() -> argparse.ArgumentParser:
+    """``tfserve simulate`` — run a named fleet-simulator scenario
+    (docs/SIMULATOR.md): the real control plane on a virtual clock
+    against simulated replicas, with optional policy-constant
+    sweeps."""
+    from tfmesos_tpu.fleet.sim import SCENARIOS
+
+    p = argparse.ArgumentParser(
+        prog="tfserve simulate",
+        description="Run a fleet-simulator scenario: the REAL "
+                    "admission/router/containment/autoscaler code on a "
+                    "virtual clock against simulated replicas — "
+                    "1000-replica fleets and millions of requests in "
+                    "seconds of CPU (docs/SIMULATOR.md).")
+    p.add_argument("scenario", choices=sorted(SCENARIOS),
+                   help="named scenario to run")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="override the scenario's replica count")
+    p.add_argument("--requests", type=int, default=None,
+                   help="override the scenario's request count")
+    p.add_argument("--seed", type=int, default=None,
+                   help="workload/chaos seed (scenarios are "
+                        "deterministic per seed)")
+    p.add_argument("--set", action="append", default=[], dest="sets",
+                   metavar="PATH=VALUE",
+                   help="fix one policy constant by path (e.g. "
+                        "breaker.latency_factor=8, "
+                        "autoscaler.queue_wait_hi_ms=200, "
+                        "admission.max_queue=256); repeatable")
+    p.add_argument("--sweep", type=str, default=None,
+                   metavar="PATH=V1,V2,...",
+                   help="run the scenario once per value of one "
+                        "policy constant and print a comparison table "
+                        "(e.g. breaker.latency_factor=2,4,8)")
+    p.add_argument("--replay", type=str, default=None, metavar="FILE",
+                   help="replay a recorded `tfserve trace -g GW "
+                        "--json` export as the workload; per-hop "
+                        "timings seed the replica latency model")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print raw result dict(s) as JSON")
+    return p
+
+
+_SIM_COLUMNS = (
+    ("requests", "requests"), ("completed", "completed"),
+    ("lost", "lost"), ("retry_amplification", "amplif"),
+    ("queue_wait_p99_ms", "qwait_p99"),
+    ("sim_events_per_sec", "events/s"), ("sim_seconds", "sim_s"),
+)
+
+
+def _sim_summary_lines(res: dict) -> List[str]:
+    lines = ["  " + "  ".join(f"{label}={res.get(key)}"
+                              for key, label in _SIM_COLUMNS)]
+    for cls, d in sorted((res.get("classes") or {}).items()):
+        lines.append(f"  class {cls:<14s} count={d.get('count'):>8} "
+                     f"p50={d.get('p50_ms')}ms p90={d.get('p90_ms')}ms "
+                     f"p99={d.get('p99_ms')}ms")
+    shed = res.get("shed") or {}
+    if any(any(v) for v in shed.values()):
+        lines.append("  shed (queue, rate, deadline) per class: "
+                     + " ".join(f"{k}={v}" for k, v in sorted(shed.items())))
+    traj = res.get("autoscaler_trajectory")
+    if traj:
+        lines.append(f"  autoscaler: {len(traj)} ticks, last={traj[-1]}")
+    for k in ("victim", "victim_isolated", "victim_alive_while_isolated",
+              "victim_trip_reason", "healed", "probes_conformant",
+              "migration_reruns"):
+        if k in res:
+            lines.append(f"  {k}={res[k]}")
+    return lines
+
+
+def simulate_main(argv: List[str]) -> int:
+    args = build_simulate_parser().parse_args(argv)
+    from tfmesos_tpu.fleet.sim import parse_sweep, run_scenario, run_sweep
+    from tfmesos_tpu.fleet.workload import (fit_replica_model,
+                                            load_trace_export,
+                                            replay_from_traces)
+
+    overrides = []
+    for spec in args.sets:
+        if "=" not in spec:
+            print(f"tfserve simulate: --set needs PATH=VALUE, got "
+                  f"{spec!r}", file=sys.stderr)
+            return 2
+        path, _, value = spec.partition("=")
+        overrides.append((path.strip(), value))
+    kwargs: Dict[str, object] = {}
+    if args.replicas is not None:
+        kwargs["replicas"] = args.replicas
+    if args.requests is not None:
+        kwargs["n_requests"] = args.requests
+    if args.seed is not None:
+        kwargs["seed"] = args.seed
+    if args.replay:
+        try:
+            records = load_trace_export(args.replay)
+        except (OSError, ValueError) as e:
+            print(f"tfserve simulate: cannot load trace export "
+                  f"{args.replay}: {e}", file=sys.stderr)
+            return 2
+        workload = replay_from_traces(records)
+        if not workload:
+            print(f"tfserve simulate: {args.replay} holds no replayable "
+                  f"trace records", file=sys.stderr)
+            return 2
+        kwargs["workload"] = workload
+        kwargs["n_requests"] = len(workload)
+        kwargs["model_fit"] = fit_replica_model(records)
+    try:
+        if args.sweep:
+            path, values = parse_sweep(args.sweep)
+            rows = run_sweep(args.scenario, path, values,
+                             overrides=overrides, **kwargs)
+            if args.as_json:
+                print(json.dumps({v: r for v, r in rows}))
+                return 0
+            print(f"sweep {path} over {args.scenario}:")
+            for value, res in rows:
+                print(f"{path}={value}")
+                for line in _sim_summary_lines(res):
+                    print(line)
+            return 0
+        res = run_scenario(args.scenario, overrides=overrides, **kwargs)
+    except ValueError as e:
+        print(f"tfserve simulate: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(res))
+        return 0
+    print(f"scenario {args.scenario} (wall {res.get('wall_s')}s):")
+    for line in _sim_summary_lines(res):
+        print(line)
     return 0
 
 
@@ -698,6 +846,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         return trace_main(argv[1:])
     if argv and argv[0] == "metrics":
         return metrics_main(argv[1:])
+    if argv and argv[0] == "simulate":
+        return simulate_main(argv[1:])
     args = build_serve_parser().parse_args(argv)
     try:
         roles = parse_role_spec(args.role)

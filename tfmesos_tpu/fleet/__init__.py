@@ -38,6 +38,14 @@ TF-Replicator (PAPERS.md) over the existing execution engine:
   (queue-wait p99 for prompt tiers, KV headroom for decode) within
   min/max bounds, with hysteresis, per-tier cooldowns, drain-then-kill
   scale-down, and a never-below-one-alive invariant.
+* :mod:`~tfmesos_tpu.fleet.sim` / :mod:`~tfmesos_tpu.fleet.workload` —
+  the trace-driven fleet simulator (docs/SIMULATOR.md): a virtual-clock
+  discrete-event harness that runs the REAL admission/router/
+  containment/registry/autoscaler code against simulated replicas —
+  1000-replica fleets and millions of requests in seconds of CPU —
+  with synthesized or trace-replayed workloads, named scenarios
+  (``tfserve simulate``), policy-constant sweeps, and a seeded
+  soak-replay fidelity gate in tier-1.
 
 Disaggregated prefill/decode serving (docs/SERVING.md) rides the same
 pieces: replicas advertise ``role: prefill|decode|unified`` (plus
@@ -67,8 +75,14 @@ from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
                                         ReplicaInfo, ReplicaRegistry)
 from tfmesos_tpu.fleet.router import Router, RoutingError
+from tfmesos_tpu.fleet.sim import (FleetSim, ReplicaModel, SimConfig,
+                                   SimEngine, VirtualClock,
+                                   run_scenario, run_sweep)
 from tfmesos_tpu.fleet.tracing import (FlightRecorder, TraceBook,
                                        TraceContext, format_waterfall)
+from tfmesos_tpu.fleet.workload import (Request, SyntheticWorkload,
+                                        fit_replica_model,
+                                        replay_from_traces)
 
 __all__ = [
     "AdmissionController", "Overloaded", "RateLimited",
@@ -79,5 +93,9 @@ __all__ = [
     "Gateway", "FleetServer", "FleetMetrics", "ReplicaInfo",
     "ReplicaRegistry", "Router", "RoutingError",
     "FlightRecorder", "TraceBook", "TraceContext", "format_waterfall",
+    "FleetSim", "ReplicaModel", "SimConfig", "SimEngine",
+    "VirtualClock", "run_scenario", "run_sweep",
+    "Request", "SyntheticWorkload", "fit_replica_model",
+    "replay_from_traces",
     "UNIFIED", "PREFILL", "DECODE",
 ]

@@ -67,7 +67,17 @@ __all__ = ["AutoscalerConfig", "FleetAutoscaler"]
 
 @dataclasses.dataclass
 class AutoscalerConfig:
-    """Knobs of the control loop (docs/SERVING.md "Autoscaling")."""
+    """Knobs of the control loop (docs/SERVING.md "Autoscaling").
+
+    Every field is sweepable by path in the fleet simulator
+    (``tfserve simulate surge --sweep autoscaler.queue_wait_hi_ms=
+    200,500,2000`` — docs/SIMULATOR.md), which is where these defaults
+    earn their values: the ``surge`` scenario (4x arrival-rate step
+    against a 4-replica tier) converges to the new steady size without
+    overshoot at the hysteresis band below, while a narrowed band
+    (``queue_wait_lo_ms`` close to ``hi``) visibly flaps
+    launch/drain/launch on the same trace, and a widened one rides the
+    surge out without scaling at all."""
 
     #: seconds between control ticks (the loop's cadence).
     interval: float = 1.0

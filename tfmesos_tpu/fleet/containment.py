@@ -41,11 +41,24 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["RetryBudget", "BreakerConfig", "CircuitBreaker",
-           "BreakerBoard", "CLOSED", "OPEN", "HALF_OPEN"]
+           "BreakerBoard", "CLOSED", "OPEN", "HALF_OPEN",
+           "DEFAULT_MAX_TOKENS", "DEFAULT_TOKEN_RATIO"]
 
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
+
+#: Retry-budget defaults (sweepable as ``budget.max_tokens`` /
+#: ``budget.token_ratio`` in ``tfserve simulate``).  10 tokens of
+#: runway absorbs a short failure burst without throttling; a 0.1
+#: refill per delivered completion means sustained retries above ~10%
+#: of throughput drain the budget and failovers stop — the simulator's
+#: ``soak-replay`` scenario holds retry amplification under 1.5
+#: through a replica death at these values, and a brown-out sweep
+#: (``budget.token_ratio=0.05,0.1,0.5``) shows 0.5 re-arming the storm
+#: while 0.05 starves legitimate failovers.
+DEFAULT_MAX_TOKENS = 10.0
+DEFAULT_TOKEN_RATIO = 0.1
 
 
 class RetryBudget:
@@ -61,7 +74,8 @@ class RetryBudget:
     refill would re-arm the storm on a schedule).
     """
 
-    def __init__(self, max_tokens: float = 10.0, token_ratio: float = 0.1):
+    def __init__(self, max_tokens: float = DEFAULT_MAX_TOKENS,
+                 token_ratio: float = DEFAULT_TOKEN_RATIO):
         if max_tokens <= 0 or token_ratio <= 0:
             raise ValueError(
                 f"max_tokens and token_ratio must be > 0, got "
@@ -100,7 +114,18 @@ class BreakerConfig:
     ``latency_floor_ms`` so microsecond-scale jitter can never trip)
     trips too — the gray-failure detector.  An open breaker waits
     ``cooldown_s`` before its single half-open probe; every failed probe
-    doubles the wait up to ``max_cooldown_s``."""
+    doubles the wait up to ``max_cooldown_s``.
+
+    Every threshold here is sweepable by path in the fleet simulator
+    (``tfserve simulate soak-replay --sweep breaker.latency_factor=
+    2,4,8`` — docs/SIMULATOR.md): ``latency_factor=4`` is the value at
+    which the ``soak-replay`` scenario isolates a 20x-slow gray replica
+    within its traffic warmup while a healthy fleet's natural p99/p50
+    spread (~2-3x under bursty arrivals) never trips; 2 flaps on load
+    skew, 8 lets the gray replica serve for multiples of the detection
+    window.  ``failures=3`` / ``cooldown_s=2`` come from the same
+    scenario's SIGKILL phase: the dead replica is out of every
+    candidate set before the heartbeat sweeper even marks it."""
 
     failures: int = 3
     cooldown_s: float = 2.0
@@ -174,6 +199,18 @@ class BreakerBoard:
         self.trips = 0
         self.latency_trips = 0
         self.recoveries = 0
+        # Count of breakers NOT in CLOSED state: the router's per-pick
+        # filter short-circuits to "everyone eligible" while this is 0
+        # (the overwhelmingly common state) instead of querying every
+        # candidate — O(1) instead of O(replicas) per request.
+        self._nonclosed = 0
+
+    def all_closed(self) -> bool:
+        """True while every breaker is CLOSED — read lock-free (a
+        single int; a stale read costs one pick a full filter pass,
+        never a wrong routing decision, since the filter re-checks
+        every candidate under the lock)."""
+        return self._nonclosed == 0
 
     def _get(self, addr: str) -> CircuitBreaker:
         b = self._breakers.get(addr)
@@ -231,6 +268,8 @@ class BreakerBoard:
     # -- outcome records ---------------------------------------------------
 
     def _trip(self, b: CircuitBreaker, now: float, reason: str) -> None:
+        if b.state == CLOSED:
+            self._nonclosed += 1
         b.state = OPEN
         b.cooldown = (self.config.cooldown_s if not b.cooldown
                       else min(2.0 * b.cooldown,
@@ -271,6 +310,7 @@ class BreakerBoard:
                     b.ewma_ms = 0.0
                     b.samples = 0
                 b.state = CLOSED
+                self._nonclosed -= 1
                 b.probing_since = 0.0
                 b.reason = ""
                 self.recoveries += 1

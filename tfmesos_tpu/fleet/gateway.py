@@ -104,10 +104,18 @@ class Gateway:
     def __init__(self, router: Router, admission: AdmissionController,
                  metrics: FleetMetrics, token: str = "",
                  host: str = "127.0.0.1", port: int = 0, workers: int = 8,
-                 registry=None, tracebook: Optional[TraceBook] = None):
+                 registry=None, tracebook: Optional[TraceBook] = None,
+                 clock=time.monotonic):
         self.router = router
         self.admission = admission
         self.metrics = metrics
+        # The deadline time base.  Injectable, and shared with the
+        # router/admission clocks by the caller: the absolute deadline
+        # stamped here is compared against the SAME clock at every
+        # later checkpoint (WFQ shed, router loop head, timeout
+        # slices) — stamping from a different clock than the checks
+        # read would silently stretch or shrink every budget.
+        self._clock = clock
         # Request tracing is on-by-default at SUMMARY level (every
         # request finishes into the book); span DETAIL is tail-retained
         # per the book's sample/slow/failure rules (docs/SERVING.md
@@ -340,7 +348,7 @@ class Gateway:
         deadline = None
         if isinstance(dl, (int, float)) and not isinstance(dl, bool) \
                 and dl > 0:
-            deadline = time.monotonic() + float(dl) / 1000.0
+            deadline = self._clock() + float(dl) / 1000.0
         forward = {"op": "generate", "prompt": msg.get("prompt"),
                    "max_new_tokens": msg.get("max_new_tokens"),
                    "stop_token": msg.get("stop_token"),

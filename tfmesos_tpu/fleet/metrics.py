@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import re as _re
 import threading
+from bisect import bisect_left
 from typing import Callable, Dict, List, Optional, Sequence
 
 __all__ = ["Histogram", "FleetMetrics"]
@@ -73,7 +74,13 @@ class Histogram:
     estimate — cheap, monotone, and honest about its resolution)."""
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS_MS):
-        self.buckets = tuple(buckets)
+        self.buckets = tuple(sorted(buckets))
+        if not self.buckets or self.buckets[-1] != float("inf"):
+            # Every histogram needs the +inf terminator: the bisect in
+            # observe() indexes the bucket for ANY sample, so a
+            # caller-supplied bucket list without it would crash the
+            # metrics path on the first out-of-range observation.
+            self.buckets += (float("inf"),)
         self._counts = [0] * len(self.buckets)
         self._count = 0
         self._sum = 0.0
@@ -93,10 +100,11 @@ class Histogram:
             self._sum += v
             if v > self._max:
                 self._max = v
-            for i, edge in enumerate(self.buckets):
-                if v <= edge:
-                    self._counts[i] += 1
-                    break
+            # Buckets are sorted ascending (inf last): binary search
+            # for the first edge >= v — this runs several times per
+            # served request, so O(log buckets) matters at simulator
+            # and fleet scale.
+            self._counts[bisect_left(self.buckets, v)] += 1
 
     def _percentile(self, p: float) -> float:
         # One copy of the rank walk (delta_percentile); the lifetime
@@ -204,6 +212,17 @@ class FleetMetrics:
             if hist is None:
                 hist = self._hists[name] = Histogram()
         hist.observe(value)
+
+    def hist(self, name: str) -> Histogram:
+        """The named histogram itself (created on first use) — hot
+        paths that observe the same series per request hold this
+        handle instead of paying the registry lock + lookup each
+        time."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+        return h
 
     def hist_cumulative(self, name: str) -> Optional[tuple]:
         """The named histogram's :meth:`Histogram.cumulative` state, or

@@ -103,12 +103,19 @@ class ReplicaInfo:
 
 
 class ReplicaRegistry:
-    """Heartbeat listener + liveness sweeper over a replica table."""
+    """Heartbeat listener + liveness sweeper over a replica table.
+
+    ``clock`` is injectable (the chaos/autoscaler determinism
+    discipline): production runs on ``time.monotonic``; the fleet
+    simulator (:mod:`tfmesos_tpu.fleet.sim`) runs the same table code
+    on a virtual clock, delivering beats through :meth:`observe` and
+    driving liveness with :meth:`sweep` instead of the listener/sweeper
+    threads (``start()`` is never called there — no sockets exist)."""
 
     def __init__(self, token: str = "", host: str = "127.0.0.1",
                  suspect_after: float = 1.5, dead_after: float = 3.0,
                  evict_after: float = 10.0, sweep_interval: float = 0.2,
-                 metrics=None, chaos=None):
+                 metrics=None, chaos=None, clock=time.monotonic):
         self.token = token
         self.host = host
         self.suspect_after = float(suspect_after)
@@ -119,11 +126,25 @@ class ReplicaRegistry:
         # Optional chaos.FaultPlan: consulted per heartbeat so tests can
         # drop beats (simulated partitions) without touching the replica.
         self.chaos = chaos
+        self._clock = clock
         self.log = get_logger("tfmesos_tpu.fleet.registry")
         self.addr: Optional[str] = None
         self._listen: Optional[socket.socket] = None
         self._table: Dict[str, ReplicaInfo] = {}
         self._conns: Dict[str, socket.socket] = {}
+        # Membership version + cached routable views: bumped ONLY when
+        # the set a router pick iterates could change (entry add/evict,
+        # state or role transition) — NOT on per-beat field refreshes
+        # (outstanding, kv_headroom), which the cached entries reflect
+        # live.  This is what keeps routing O(1) per request at
+        # 1000-replica scale instead of copying the whole table per
+        # pick (see alive_view).
+        self._version = 0
+        self._views: Dict[tuple, tuple] = {}
+        # Count of entries advertising a prefix-cache summary: the
+        # router skips its O(replicas) affinity scan entirely while
+        # this is zero (the common non-prefix-cache deployment).
+        self._prefix_count = 0
         # Generation fence floor: beats stamped with a gen BELOW this
         # are dropped entirely — a straggler of a reaped rollout
         # generation can never re-register and serve stale weights.
@@ -191,7 +212,7 @@ class ReplicaRegistry:
         addr: Optional[str] = None
         try:
             for msg in wire.iter_msgs(conn, framer):
-                addr = self._on_msg(msg, conn) or addr
+                addr = self.observe(msg, conn) or addr
         except wire.WireError as e:
             self.log.warning("rejecting heartbeat connection: %s", e)
         except OSError:
@@ -214,7 +235,13 @@ class ReplicaRegistry:
                 if stale:
                     self.mark_dead(addr, why="heartbeat connection closed")
 
-    def _on_msg(self, msg, conn: socket.socket) -> Optional[str]:
+    def observe(self, msg,
+                conn: Optional[socket.socket] = None) -> Optional[str]:
+        """Apply one registry message (``hello`` / ``heartbeat`` /
+        ``drain``) to the table.  The wire path calls this per received
+        frame; the fleet simulator calls it directly with ``conn=None``
+        — beats from simulated replicas run the exact same table
+        logic, fences and all."""
         if not isinstance(msg, dict):
             return None
         addr = msg.get("addr")
@@ -275,11 +302,13 @@ class ReplicaRegistry:
                 if rep is not None and rep.state in (ALIVE, WARMING):
                     rep.state = DRAINING
                     rep.announced_drain = True
+                    self._version += 1
                     self.log.info("replica %s draining", addr)
                 return addr
             if rep is None:
                 rep = self._table[addr] = ReplicaInfo(addr=addr,
                                                       state=target)
+                self._version += 1
                 self.log.info("replica %s registered (%s)", addr, target)
             if rep.state == DEAD:
                 # A DEAD entry's beat comes from a NEW process on the
@@ -315,6 +344,7 @@ class ReplicaRegistry:
                 self.log.info("replica %s %s -> %s", addr, rep.state,
                               target)
                 rep.state = target
+                self._version += 1
             if target == ALIVE:
                 rep.announced_drain = False
             if gen is not None:
@@ -328,41 +358,57 @@ class ReplicaRegistry:
             if "outstanding" in msg:
                 rep.outstanding = int(msg["outstanding"])
             if isinstance(msg.get("prefix_cache"), dict):
+                if rep.prefix is None:
+                    self._prefix_count += 1
                 rep.prefix = msg["prefix_cache"]
-            if msg.get("role") in ROLES:
+            if msg.get("role") in ROLES and rep.role != msg["role"]:
                 rep.role = msg["role"]
+                self._version += 1
             if "kv_headroom" in msg:
                 try:
                     rep.kv_headroom = int(msg["kv_headroom"])
                 except (TypeError, ValueError):
                     pass    # a bad field never costs the beat
-            rep.last_beat = time.monotonic()
-            self._conns[addr] = conn
+            rep.last_beat = self._clock()
+            if conn is not None:
+                self._conns[addr] = conn
         return addr
 
     # -- liveness sweeping -------------------------------------------------
 
     def _sweep_loop(self) -> None:
         while not self._stop.wait(self.sweep_interval):
-            now = time.monotonic()
-            with self._lock:
-                for addr, rep in list(self._table.items()):
-                    age = now - rep.last_beat
-                    if age > self.evict_after:
-                        del self._table[addr]
-                        self._conns.pop(addr, None)
-                        self.log.info("replica %s evicted (%s, last beat "
-                                      "%.1fs ago)", addr, rep.state, age)
-                    elif age > self.dead_after and rep.state != DEAD:
-                        rep.state = DEAD
-                        self.log.warning("replica %s dead (no heartbeat "
-                                         "for %.1fs)", addr, age)
-                        if self.metrics is not None:
-                            self.metrics.inc("replicas_died")
-                    elif age > self.suspect_after and rep.state == ALIVE:
-                        rep.state = DRAINING
-                        self.log.warning("replica %s draining (heartbeat "
-                                         "stale %.1fs)", addr, age)
+            self.sweep()
+
+    def sweep(self, now: Optional[float] = None) -> None:
+        """One liveness pass over the table (stale → draining → dead →
+        evicted).  The sweeper thread runs this every
+        ``sweep_interval``; the fleet simulator calls it directly per
+        virtual tick."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            for addr, rep in list(self._table.items()):
+                age = now - rep.last_beat
+                if age > self.evict_after:
+                    del self._table[addr]
+                    self._conns.pop(addr, None)
+                    if rep.prefix is not None:
+                        self._prefix_count -= 1
+                    self._version += 1
+                    self.log.info("replica %s evicted (%s, last beat "
+                                  "%.1fs ago)", addr, rep.state, age)
+                elif age > self.dead_after and rep.state != DEAD:
+                    rep.state = DEAD
+                    self._version += 1
+                    self.log.warning("replica %s dead (no heartbeat "
+                                     "for %.1fs)", addr, age)
+                    if self.metrics is not None:
+                        self.metrics.inc("replicas_died")
+                elif age > self.suspect_after and rep.state == ALIVE:
+                    rep.state = DRAINING
+                    self._version += 1
+                    self.log.warning("replica %s draining (heartbeat "
+                                     "stale %.1fs)", addr, age)
 
     # -- queries / writes --------------------------------------------------
 
@@ -374,6 +420,40 @@ class ReplicaRegistry:
         with self._lock:
             return [dataclasses.replace(r) for r in self._table.values()
                     if r.state == ALIVE]
+
+    def alive_view(self, roles: tuple) -> List[ReplicaInfo]:
+        """The ALIVE members of the given tiers as a CACHED list of
+        live table entries — the router's per-request candidate set,
+        O(1) amortized at any fleet size.  The list is rebuilt only
+        when membership could have changed (state/role transitions,
+        adds, evictions — the version bumps above); per-beat field
+        refreshes (outstanding, kv_headroom, prefix, weights_version)
+        show through the shared entries immediately.  Contract: the
+        returned list and its entries are SHARED — callers filter into
+        new lists and never mutate (the router does exactly that)."""
+        # Lock-free cache hit: dict.get and the int compare are atomic
+        # under the GIL, views are REPLACED (never mutated in place),
+        # and a stale read costs at worst one pick a one-version-old
+        # list — the same staleness any pick already tolerates between
+        # heartbeats.  This runs several times per routed request.
+        hit = self._views.get(roles)
+        if hit is not None and hit[0] == self._version:
+            return hit[1]
+        with self._lock:
+            hit = self._views.get(roles)
+            if hit is not None and hit[0] == self._version:
+                return hit[1]
+            view = [r for r in self._table.values()
+                    if r.state == ALIVE and (r.role or UNIFIED) in roles]
+            self._views[roles] = (self._version, view)
+            return view
+
+    def has_prefix_summaries(self) -> bool:
+        """Whether ANY table entry advertises a prefix-cache summary —
+        the O(1) gate in front of the router's O(candidates) affinity
+        scan (a fleet with no prefix caches must not pay the scan on
+        every prompt-bearing request)."""
+        return self._prefix_count > 0
 
     def warming(self) -> List[ReplicaInfo]:
         """Replicas registered but still compiling (copies) — present
@@ -452,6 +532,7 @@ class ReplicaRegistry:
                 return False
             if rep.state in (ALIVE, WARMING):
                 rep.state = DRAINING
+                self._version += 1
             rep.announced_drain = True
             if pinned:
                 rep.drain_pinned = True
@@ -514,14 +595,15 @@ class ReplicaRegistry:
             if rep is None or rep.state == DEAD:
                 return
             rep.state = DEAD
+            self._version += 1
         self.log.warning("replica %s marked dead: %s", addr, why)
         if self.metrics is not None:
             self.metrics.inc("replicas_died")
 
     def wait_for(self, n: int, timeout: float = 60.0) -> bool:
         """Block until ``n`` replicas are alive (fleet bring-up)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
             if len(self.alive()) >= n:
                 return True
             if self._stop.wait(0.05):

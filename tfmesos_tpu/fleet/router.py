@@ -139,7 +139,9 @@ class Router:
                  rng: Optional[random.Random] = None,
                  breakers: bool = True,
                  breaker_config: Optional[BreakerConfig] = None,
-                 retry_budget: Optional[RetryBudget] = None):
+                 retry_budget: Optional[RetryBudget] = None,
+                 clock=time.monotonic, sleep=time.sleep,
+                 link_factory=None):
         self.registry = registry
         self.metrics = metrics
         self.token = token
@@ -149,13 +151,26 @@ class Router:
         self.connect_timeout = float(connect_timeout)
         self.log = get_logger("tfmesos_tpu.fleet.router")
         self._rng = rng or random.Random()
+        # Injectable time base (the chaos/autoscaler discipline,
+        # finished): EVERY clock reading on the routing path — deadline
+        # checks, timeout slices, breaker latency samples, retry
+        # backoff — goes through these two, so the same code runs on
+        # time.monotonic in production and on the fleet simulator's
+        # virtual clock with zero real sleeping (docs/SIMULATOR.md).
+        self._clock = clock
+        self._sleep = sleep
+        # link_factory(addr) -> MuxConnection-shaped transport: the
+        # simulator substitutes virtual links; production dials TCP.
+        self._link_factory = link_factory or (
+            lambda addr: MuxConnection(
+                addr, self.token, connect_timeout=self.connect_timeout))
         self._links: Dict[str, MuxConnection] = {}
         self._lock = threading.Lock()
         # Failure containment (module docstring): per-replica circuit
         # breakers (None = disabled — the bench's control arm) and the
         # fleet-wide retry budget.
         self.breakers: Optional[BreakerBoard] = \
-            BreakerBoard(breaker_config) if breakers else None
+            BreakerBoard(breaker_config, clock=clock) if breakers else None
         self.budget = retry_budget or RetryBudget()
         # Blue-green rollout: when set, every tier's candidate set is
         # narrowed to replicas advertising THIS weights_version whenever
@@ -169,8 +184,10 @@ class Router:
     # -- load signal -------------------------------------------------------
 
     def outstanding(self, addr: str) -> int:
-        with self._lock:
-            link = self._links.get(addr)
+        # Lock-free read: dict.get is atomic under the GIL and a
+        # racing link swap costs at worst one stale load sample on one
+        # pick — this runs twice per p2c choice, so it must be cheap.
+        link = self._links.get(addr)
         return link.outstanding if link is not None and not link.closed else 0
 
     # -- replica choice ----------------------------------------------------
@@ -220,11 +237,24 @@ class Router:
         applied on top: with a preferred weights_version set, replicas
         advertising it crowd out every other version whenever at least
         one is routable; otherwise (new tier empty or draining away)
-        the full candidate set remains the fallback."""
-        exclude = set(exclude)
-        cands = [r for r in self.registry.alive()
-                 if r.addr not in exclude
-                 and (r.role or UNIFIED) in roles]
+        the full candidate set remains the fallback.
+
+        The no-exclusions common case reads the registry's CACHED
+        per-tier view (``alive_view`` — O(1) amortized, the change
+        that makes 1000-replica routing feasible); retries (non-empty
+        ``exclude``) filter it, and registries without the cache (test
+        stubs) fall back to the original full scan."""
+        view = getattr(self.registry, "alive_view", None)
+        if view is not None:
+            cands = view(tuple(roles))
+            if exclude:
+                exclude = set(exclude)
+                cands = [r for r in cands if r.addr not in exclude]
+        else:
+            exclude = set(exclude)
+            cands = [r for r in self.registry.alive()
+                     if r.addr not in exclude
+                     and (r.role or UNIFIED) in roles]
         pref = self._preferred_version
         if pref:
             preferred = [r for r in cands if r.weights_version == pref]
@@ -246,7 +276,8 @@ class Router:
         healthy alternative to offer, and failing every request fast
         would turn a brown-out into a self-inflicted outage — the
         ``breaker_saturated`` counter makes that state visible."""
-        if self.breakers is None or not cands:
+        if self.breakers is None or not cands \
+                or self.breakers.all_closed():
             return cands
         allowed = [r for r in cands if self.breakers.eligible(r.addr)]
         if allowed:
@@ -277,7 +308,7 @@ class Router:
                     probe: bool = False) -> None:
         if self.breakers is not None:
             self.breakers.record_success(
-                addr, (time.monotonic() - t0) * 1000.0, probe=probe)
+                addr, (self._clock() - t0) * 1000.0, probe=probe)
 
     def _breaker_fail(self, addr: str, probe: bool = False) -> None:
         if self.breakers is not None:
@@ -376,7 +407,7 @@ class Router:
                if k not in ("deadline", "_trace")}
         if deadline is not None:
             out["deadline_ms"] = round(
-                max(1.0, (deadline - time.monotonic()) * 1000.0), 3)
+                max(1.0, (deadline - self._clock()) * 1000.0), 3)
         if tr is not None:
             out["trace_id"] = tr.trace_id
             if tr.detailed:
@@ -395,19 +426,28 @@ class Router:
         most of the budget for its decode phase."""
         if deadline is None:
             return self.request_timeout
-        rem = (deadline - time.monotonic()) * share
+        rem = (deadline - self._clock()) * share
         if not final_attempt:
             rem *= 0.5
         return min(self.request_timeout, max(0.05, rem))
 
     def _load_pick(self, cands) -> Optional[str]:
-        """Least-outstanding with p2c sampling over ``cands``."""
-        addrs = [r.addr for r in cands]
-        if not addrs:
+        """Least-outstanding with p2c sampling over ``cands`` — O(1)
+        regardless of tier size (two index draws, never a full-list
+        materialization: at 1000 replicas an O(n) pick would dominate
+        every request)."""
+        n = len(cands)
+        if not n:
             return None
-        if len(addrs) <= 2:
-            return min(addrs, key=self.outstanding)
-        a, b = self._rng.sample(addrs, 2)
+        if n <= 2:
+            return min((r.addr for r in cands), key=self.outstanding)
+        # Two distinct uniform indices without rng.sample's setup cost.
+        rr = self._rng.randrange
+        i = rr(n)
+        j = rr(n - 1)
+        if j >= i:
+            j += 1
+        a, b = cands[i].addr, cands[j].addr
         return a if self.outstanding(a) <= self.outstanding(b) else b
 
     def _pick_role(self, roles, exclude, prompt) -> Optional[str]:
@@ -419,11 +459,20 @@ class Router:
         if not cands:
             return None
         if prompt is not None and len(prompt):
-            fav = self._affinity_pick(cands, prompt)
-            self.metrics.inc("affinity_hits" if fav is not None
-                             else "affinity_misses")
-            if fav is not None:
-                return fav
+            # The O(candidates) affinity scan runs only when some
+            # replica actually advertises a prefix-cache summary
+            # (registry-counted, O(1)); otherwise the request counts a
+            # miss straight away — a no-prefix-cache fleet must not pay
+            # the scan per prompt-bearing request at 1000 replicas.
+            have = getattr(self.registry, "has_prefix_summaries", None)
+            if have is None or have():
+                fav = self._affinity_pick(cands, prompt)
+                self.metrics.inc("affinity_hits" if fav is not None
+                                 else "affinity_misses")
+                if fav is not None:
+                    return fav
+            else:
+                self.metrics.inc("affinity_misses")
         return self._load_pick(cands)
 
     def pick(self, exclude: Iterable[str] = (),
@@ -492,8 +541,7 @@ class Router:
         # through that would stall every worker's pick()/route() on the
         # healthy replicas too.  A dial race just keeps the first link
         # registered and closes the loser.
-        link = MuxConnection(addr, self.token,
-                             connect_timeout=self.connect_timeout)
+        link = self._link_factory(addr)
         with self._lock:
             existing = self._links.get(addr)
             if existing is not None and not existing.closed:
@@ -576,7 +624,7 @@ class Router:
         self.log.warning("%s replica %s failed (%s); retrying on "
                          "another replica (attempt %d/%d)", what, addr, e,
                          attempt + 1, self.max_retries + 1)
-        time.sleep(self.backoff_s * (2 ** attempt))
+        self._sleep(self.backoff_s * (2 ** attempt))
         return True
 
     def _note_replica_error(self, addr: str, tried: set,
@@ -657,7 +705,7 @@ class Router:
 
         call = build_call(meta)
         for attempt in range(self.max_retries + 1):
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and self._clock() >= deadline:
                 return self._expired_reply("while resuming its "
                                            "migrated state")
             addr = self._pick_resume(tried, wv)
@@ -665,7 +713,7 @@ class Router:
                 break
             rprobe = self._breaker_dispatch(addr)
             att0 = tracing.cur_elapsed()
-            t0 = time.monotonic()
+            t0 = self._clock()
             timeout = self._call_timeout(deadline,
                                          attempt >= self.max_retries)
             try:
@@ -759,6 +807,10 @@ class Router:
         attribute themselves to it, and replica-piggybacked hop spans
         are stitched back in at each attempt's start offset."""
         tr = msg.get("_trace") if isinstance(msg, dict) else None
+        if tr is None and tracing.current() is None:
+            # Nothing to activate and nothing to restore: skip the
+            # context manager on the untraced hot path.
+            return self._route(msg)
         with tracing.activate(tr):
             return self._route(msg)
 
@@ -773,7 +825,7 @@ class Router:
         deadline_cut = False
         prompt = msg.get("prompt") if isinstance(msg, dict) else None
         for attempt in range(self.max_retries + 1):
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and self._clock() >= deadline:
                 # Fail fast, at the loop head: the client has given up,
                 # and every further attempt (including the first) would
                 # be pure waste — this is what keeps retries from
@@ -785,7 +837,7 @@ class Router:
                 break       # nothing (left) to try
             probe = self._breaker_dispatch(addr)
             att0 = tracing.cur_elapsed()
-            t0 = time.monotonic()
+            t0 = self._clock()
             timeout = self._call_timeout(deadline,
                                          attempt >= self.max_retries)
             try:
@@ -919,9 +971,9 @@ class Router:
         last: Optional[BaseException] = None
         deadline = self._deadline_of(msg)
         ptried: set = set()
-        t0 = time.perf_counter()
+        t0 = self._clock()
         for attempt in range(self.max_retries + 1):
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and self._clock() >= deadline:
                 return self._expired_reply("before prefill could "
                                            "run"), None
             paddr = self.pick_prefill(exclude=ptried, prompt=prompt)
@@ -933,7 +985,7 @@ class Router:
                     "priority": msg.get("priority")}
             pprobe = self._breaker_dispatch(paddr)
             patt0 = tracing.cur_elapsed()
-            tp = time.monotonic()
+            tp = self._clock()
             # The prefill phase spends at most a quarter of the
             # remaining budget: decode is the long phase, and a hung
             # prefill replica must leave it a real slice.
@@ -994,7 +1046,7 @@ class Router:
             self._trace_attempt("prefill", patt0, paddr, "ok",
                                 reply=praw)
             self._breaker_ok(paddr, tp, pprobe)
-            ttft_ms = (time.perf_counter() - t0) * 1000.0
+            ttft_ms = (self._clock() - t0) * 1000.0
             self.metrics.inc("disagg_prefills")
             out, derr = self._disagg_decode(msg, praw)
             if out is not None:
@@ -1009,7 +1061,7 @@ class Router:
                         out["decode_ms"] = round(dec_total - dec_ttft, 3)
                     out["ttft_ms"] = round(ttft_ms, 3)
                     out["total_ms"] = round(
-                        (time.perf_counter() - t0) * 1000.0, 3)
+                        (self._clock() - t0) * 1000.0, 3)
                     self.metrics.inc("disagg_requests")
                     self.budget.on_success()
                 return out, None
@@ -1046,7 +1098,7 @@ class Router:
         # artifacts carry no pin — the tier shares the fleet version).
         art_wv: Optional[str] = None
         for attempt in range(self.max_retries + 1):
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and self._clock() >= deadline:
                 return self._expired_reply("before decode could "
                                            "run"), None
             daddr = self.pick_decode(exclude=dtried,
@@ -1058,14 +1110,13 @@ class Router:
             timeout = self._call_timeout(deadline,
                                          attempt >= self.max_retries)
             try:
-                t0 = time.perf_counter()
-                tm = time.monotonic()
+                tm = t0 = self._clock()
                 reply = self._link(daddr).call_raw(
                     self._wire_msg(meta, deadline), praw.body,
                     timeout=timeout)
                 self.metrics.observe(
                     "kv_decode_turnaround_ms",
-                    (time.perf_counter() - t0) * 1000.0)
+                    (self._clock() - t0) * 1000.0)
                 # Counted only on a delivered transfer: a retried or
                 # failed send must not inflate the bench's KV-transfer
                 # throughput.

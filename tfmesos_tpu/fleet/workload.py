@@ -1,0 +1,204 @@
+"""Workloads for the fleet simulator: synthesized and trace-replayed.
+
+Two sources feed :mod:`tfmesos_tpu.fleet.sim` (docs/SIMULATOR.md):
+
+* :class:`SyntheticWorkload` — a seeded generator of request arrivals:
+  a Poisson (or fixed-interval) arrival process, lognormal prompt and
+  decode-length distributions, a weighted priority-class mix (tenant
+  skew is just an uneven mix), and optional per-request deadlines.
+  Same seed, same stream — byte-for-byte, which is what makes every
+  simulator scenario a deterministic regression gate.
+
+* :func:`replay_from_traces` — a recorded ``tfserve trace -g GW
+  --json`` export replayed as a workload: each retained trace record
+  becomes one request, re-arriving at its recorded wall-clock offset
+  with its recorded class and token counts, and
+  :func:`fit_replica_model` distills the records' per-hop timings
+  (TTFT, decode tail) into the latency-model parameters the simulated
+  replicas run on.  The replay is an arrival/shape replay, not a
+  byte-level one — see docs/SIMULATOR.md "Fidelity contract" for what
+  is and is not preserved.
+
+Everything here is stdlib-only and jax-free, like the rest of the
+control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Any, Dict, Iterable, Iterator, List, NamedTuple, Optional
+
+__all__ = ["Request", "SyntheticWorkload", "replay_from_traces",
+           "fit_replica_model", "load_trace_export"]
+
+
+class Request(NamedTuple):
+    """One simulated arrival.  ``at`` is the absolute virtual-clock
+    arrival time in seconds (ignored by closed-loop drivers);
+    ``cls`` is the priority-class label (None = the default class)."""
+
+    at: float
+    cls: Optional[str]
+    prompt_len: int
+    new_tokens: int
+    deadline_ms: Optional[float] = None
+
+
+def _clamped_lognormal(rng: random.Random, median: float, sigma: float,
+                       lo: int, hi: int) -> int:
+    if median <= 0:
+        return lo
+    v = rng.lognormvariate(0.0, sigma) * median if sigma > 0 else median
+    return max(lo, min(hi, int(round(v))))
+
+
+class SyntheticWorkload:
+    """Seeded arrival stream (iterable of :class:`Request`).
+
+    ``rate`` is mean arrivals/second of virtual time: Poisson
+    (exponential gaps) by default, fixed-interval with
+    ``deterministic=True``.  ``class_mix`` maps class label ->
+    relative weight of TRAFFIC (distinct from the class's WFQ service
+    weight — a background tenant may emit 10x the traffic of the
+    interactive one precisely to test that WFQ holds); ``None`` labels
+    ride the fleet's default class.
+    """
+
+    def __init__(self, n_requests: int, rate: float, seed: int = 0,
+                 class_mix: Optional[Dict[Optional[str], float]] = None,
+                 prompt_len: int = 64, prompt_sigma: float = 0.5,
+                 new_tokens: int = 16, new_tokens_sigma: float = 0.5,
+                 max_prompt_len: int = 2048, max_new_tokens: int = 512,
+                 deadline_ms: Optional[float] = None,
+                 deterministic: bool = False, start_at: float = 0.0):
+        if n_requests < 1:
+            raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.n_requests = int(n_requests)
+        self.rate = float(rate)
+        self.seed = int(seed)
+        mix = class_mix or {None: 1.0}
+        total = float(sum(mix.values()))
+        if total <= 0:
+            raise ValueError(f"class_mix weights must sum > 0: {mix}")
+        self._labels = list(mix)
+        self._weights = [mix[k] / total for k in self._labels]
+        self.prompt_len = int(prompt_len)
+        self.prompt_sigma = float(prompt_sigma)
+        self.new_tokens = int(new_tokens)
+        self.new_tokens_sigma = float(new_tokens_sigma)
+        self.max_prompt_len = int(max_prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_ms = deadline_ms
+        self.deterministic = bool(deterministic)
+        self.start_at = float(start_at)
+
+    def __iter__(self) -> Iterator[Request]:
+        rng = random.Random(self.seed)
+        t = self.start_at
+        gap = 1.0 / self.rate
+        for _ in range(self.n_requests):
+            t += gap if self.deterministic else rng.expovariate(self.rate)
+            cls = rng.choices(self._labels, weights=self._weights)[0]
+            yield Request(
+                at=t, cls=cls,
+                prompt_len=_clamped_lognormal(
+                    rng, self.prompt_len, self.prompt_sigma, 1,
+                    self.max_prompt_len),
+                new_tokens=_clamped_lognormal(
+                    rng, self.new_tokens, self.new_tokens_sigma, 1,
+                    self.max_new_tokens),
+                deadline_ms=self.deadline_ms)
+
+
+# -- trace replay ------------------------------------------------------------
+
+
+def load_trace_export(path: str) -> List[dict]:
+    """Parse a ``tfserve trace -g GW --json`` export file: either one
+    JSON array or JSON-lines, each element a trace record dict."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    if text[0] == "[":
+        records = json.loads(text)
+    else:
+        records = [json.loads(line) for line in text.splitlines() if line]
+    return [r for r in records if isinstance(r, dict)]
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def replay_from_traces(records: Iterable[dict],
+                       speedup: float = 1.0,
+                       deadline_ms: Optional[float] = None
+                       ) -> List[Request]:
+    """Turn trace records into a replayable arrival list: each record
+    re-arrives at its recorded wall-clock offset (``ts``, compressed
+    by ``speedup``) with its recorded class and token counts.  Records
+    are replayed in timestamp order; the first arrival lands at t=0.
+    Prompt length comes from the retained ``gateway.recv`` span when
+    the record kept detail, else a small default — the export's
+    summary records carry class/latency/tokens but not the prompt."""
+    rows = []
+    for rec in records:
+        ts = _num(rec.get("ts"))
+        if ts is None:
+            continue
+        summary = rec.get("summary") or {}
+        cls = summary.get("cls")
+        tokens = _num(summary.get("tokens"))
+        prompt_len = None
+        for span in rec.get("spans") or ():
+            if isinstance(span, dict) and span.get("name") == "recv":
+                prompt_len = _num(span.get("prompt_len"))
+                break
+        rows.append((ts, cls if isinstance(cls, str) else None,
+                     int(prompt_len) if prompt_len else 16,
+                     int(tokens) if tokens and tokens > 0 else 8))
+    rows.sort(key=lambda r: r[0])
+    if not rows:
+        return []
+    t0 = rows[0][0]
+    scale = 1.0 / max(1e-9, float(speedup))
+    return [Request(at=(ts - t0) * scale, cls=cls, prompt_len=pl,
+                    new_tokens=nt, deadline_ms=deadline_ms)
+            for ts, cls, pl, nt in rows]
+
+
+def fit_replica_model(records: Iterable[dict]) -> Dict[str, Any]:
+    """Distill recorded traces into latency-model parameters for the
+    simulated replicas: median TTFT (the prefill estimate) and median
+    per-token decode time, from completed records carrying ``ttft_ms``
+    + ``total_ms`` + a token count.  Returns a possibly-empty dict of
+    ``{"prefill_base_ms", "decode_ms_per_token"}`` — callers lay the
+    fitted values over :class:`tfmesos_tpu.fleet.sim.ReplicaModel`
+    defaults and keep whatever the traces could not determine."""
+    ttfts: List[float] = []
+    per_tok: List[float] = []
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("status") != "completed":
+            continue
+        summary = rec.get("summary") or {}
+        ttft = _num(summary.get("ttft_ms"))
+        total = _num(rec.get("total_ms"))
+        tokens = _num(summary.get("tokens"))
+        if ttft is not None and ttft >= 0:
+            ttfts.append(ttft)
+        if total is not None and ttft is not None and tokens \
+                and tokens > 0 and total > ttft:
+            per_tok.append((total - ttft) / tokens)
+    out: Dict[str, Any] = {}
+    if ttfts:
+        ttfts.sort()
+        out["prefill_base_ms"] = round(ttfts[len(ttfts) // 2], 3)
+    if per_tok:
+        per_tok.sort()
+        out["decode_ms_per_token"] = round(per_tok[len(per_tok) // 2], 3)
+    return out
