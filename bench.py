@@ -1629,6 +1629,280 @@ def bench_fleet_sim(replicas=1000, n_requests=1_000_000, seed=0):
             fid["retry_amplification"])
 
 
+def _gateway_flood(addr, token, n_conns, prompt, max_new_tokens=4,
+                   timeout_s=180.0):
+    """Selector-driven N-connection client harness: open ``n_conns``
+    sockets to one gateway, send one STREAMED generate on each, and
+    drive every reply with ONE loop (the client-side mirror of the
+    event-loop server — a thread per connection on the client would
+    measure client thread scheduling, not the front door).  Returns
+    ``(ttfts_ms, completed, failed)`` where TTFT is send-to-first-
+    token-frame per connection, measured while ALL connections are in
+    flight."""
+    import selectors
+    import socket as socket_mod
+
+    from tfmesos_tpu import wire
+
+    class _Conn:
+        __slots__ = ("sock", "framer", "t0", "ttft_ms", "done", "ok")
+
+    sel = selectors.DefaultSelector()
+    host, port = addr.rsplit(":", 1)
+    conns = []
+    for i in range(n_conns):
+        s = socket_mod.create_connection((host, int(port)), timeout=30.0)
+        st = _Conn()
+        st.sock, st.framer = s, wire.Framer(token)
+        st.t0 = st.ttft_ms = None
+        st.done = st.ok = False
+        conns.append(st)
+    # Every link is OPEN before the first request goes out: the claim
+    # is concurrent connections, not sequential reuse.
+    for i, st in enumerate(conns):
+        frame = wire.encode(
+            {"op": "generate", "id": i, "prompt": prompt,
+             "max_new_tokens": max_new_tokens, "stream": True}, token)
+        st.sock.sendall(frame)
+        st.t0 = time.perf_counter()
+        st.sock.setblocking(False)
+        sel.register(st.sock, selectors.EVENT_READ, st)
+    remaining = n_conns
+    deadline = time.monotonic() + timeout_s
+
+    def finish(st, ok):
+        nonlocal remaining
+        if st.done:
+            return
+        st.done, st.ok = True, ok
+        remaining -= 1
+        try:
+            sel.unregister(st.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            st.sock.close()
+        except OSError:
+            pass
+
+    while remaining and time.monotonic() < deadline:
+        for key, _ in sel.select(timeout=1.0):
+            st = key.data
+            try:
+                data = st.sock.recv(65536)
+            except BlockingIOError:
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                finish(st, False)
+                continue
+            try:
+                msgs = st.framer.feed(data)
+            except wire.WireError:
+                finish(st, False)
+                continue
+            for msg in msgs:
+                op = msg.get("op") if isinstance(msg, dict) else None
+                if st.ttft_ms is None and op in ("tokens", "completion",
+                                                 "error"):
+                    st.ttft_ms = (time.perf_counter() - st.t0) * 1000.0
+                if op in ("completion", "error"):
+                    finish(st, op == "completion")
+                    break
+    for st in conns:
+        if not st.done:
+            finish(st, False)
+    sel.close()
+    ttfts = [st.ttft_ms for st in conns if st.ttft_ms is not None]
+    completed = sum(1 for st in conns if st.ok)
+    return ttfts, completed, n_conns - completed
+
+
+def bench_fleet_gateway_concurrency(n_conns=1100, kill_threads=8,
+                                    kill_requests=30, workers=32,
+                                    seed=11):
+    """Front-door scale bench (ROADMAP item 2 acceptance;
+    docs/SERVING.md "Front-door scaling").  jax-free — the event-loop
+    gateway/registry/router/mux machinery IS the system under test;
+    replicas are stub handlers replying streamed canned tokens.
+
+    Two phases, both asserted in-bench:
+
+    * CONCURRENCY — ``n_conns`` (>= 1000) simultaneous client
+      connections against ONE gateway (one selector thread server-side)
+      each issue a streamed generate; every one must complete and the
+      p99 send-to-first-token TTFT must stay bounded (< 10s) with all
+      links in flight — the thread-per-connection front door could not
+      hold 1000 links at all.  Records
+      ``fleet_gateway_concurrent_connections`` (= connections that
+      completed) and ``fleet_gateway_flood_p99_ttft_ms``.
+    * KILL SOAK — continuous traffic from ``kill_threads`` clients
+      across TWO gateways sharing the one registry/router view; one
+      gateway is hard-killed mid-traffic (sockets slam shut, no
+      deregistration — the SIGKILL shape).  Clients fail over and
+      REPLAY idempotent in-flight requests on the survivor: zero lost
+      requests asserted, and the post-kill p99 TTFT must hold within
+      2x of the pre-kill p99 (+500ms CPU-scheduler epsilon).  Records
+      ``fleet_gateway_prekill_p99_ttft_ms`` /
+      ``fleet_gateway_kill_p99_ttft_ms`` /
+      ``fleet_gateway_lost_requests``.
+    """
+    import threading
+
+    from tfmesos_tpu import wire
+    from tfmesos_tpu.fleet.admission import AdmissionController
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.gateway import Gateway
+    from tfmesos_tpu.fleet.metrics import FleetMetrics
+    from tfmesos_tpu.fleet.registry import ReplicaRegistry
+    from tfmesos_tpu.fleet.replica import ReplicaServer
+    from tfmesos_tpu.fleet.router import Router
+
+    try:                            # headroom for ~2x n_conns fds
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = 4 * n_conns + 512
+        if soft < want:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want, hard), hard))
+            soft = min(want, hard)
+        n_conns = min(n_conns, max(64, (soft - 512) // 4))
+    except (ImportError, ValueError, OSError):
+        pass
+
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=2.0, dead_after=5.0,
+                          evict_after=20.0, sweep_interval=0.2).start()
+
+    def stub(tokens):
+        # Synchronous streamed replies (no thread per request): a
+        # `tokens` partial first — the TTFT marker — then the final
+        # completion.  The front door, not replica compute, is what
+        # this bench loads.
+        def handler(msg, reply):
+            mid = msg.get("id")
+            if msg.get("stream"):
+                reply.partial({"op": "tokens", "id": mid, "off": 0,
+                               "tokens": list(tokens)})
+            reply({"op": "completion", "id": mid,
+                   "tokens": list(tokens), "ttft_ms": 1.0,
+                   "total_ms": 2.0})
+
+        return ReplicaServer(handler, token=token, capacity=4096,
+                             registry_addr=reg.addr,
+                             heartbeat_interval=0.2).start()
+
+    reps = [stub((7, 3)) for _ in range(3)]
+    assert reg.wait_for(3, timeout=10.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, request_timeout=120.0)
+    admission = AdmissionController(max_queue=max(4096, 2 * n_conns))
+    gws = [Gateway(router, admission, metrics, token=token,
+                   workers=workers, registry=reg,
+                   close_router=False).start() for _ in range(2)]
+    rng = np.random.default_rng(seed)
+    prompt = [int(t) for t in rng.integers(0, 97, size=(8,))]
+    p99 = _p99
+    try:
+        # ---- phase 1: the connection flood against ONE gateway ----
+        ttfts, completed, failed = _gateway_flood(
+            gws[0].addr, token, n_conns, prompt)
+        flood_p99 = p99(ttfts) if ttfts else float("inf")
+        assert completed == n_conns, \
+            (f"only {completed}/{n_conns} concurrent connections "
+             f"served ({failed} failed)")
+        assert flood_p99 < 10_000.0, \
+            (f"p99 TTFT {flood_p99:.0f}ms unbounded at {n_conns} "
+             f"concurrent connections")
+
+        # ---- phase 2: SIGKILL one of two gateways mid-traffic ----
+        addrs = [g.addr for g in gws]
+        kill_at = [None]
+        lost = [0]
+        pre_walls, post_walls = [], []
+        wlock = threading.Lock()
+        start_evt = threading.Event()
+
+        end_at = [None]
+
+        def client_body(k):
+            # Alternate initial gateway per client so both front doors
+            # carry traffic when the kill lands.
+            order = addrs if k % 2 == 0 else addrs[::-1]
+            client = FleetClient(order, token, timeout=60.0)
+            try:
+                start_evt.wait(10.0)
+                done = 0
+                while done < kill_requests * 20:
+                    with wlock:
+                        if end_at[0] is not None \
+                                and time.perf_counter() >= end_at[0]:
+                            break
+                    done += 1
+                    first = [None]
+                    t0 = time.perf_counter()
+                    try:
+                        client.generate(
+                            prompt, 4, timeout=60.0,
+                            on_tokens=lambda t: first.__setitem__(
+                                0, first[0] or time.perf_counter()))
+                    except Exception:
+                        with wlock:
+                            lost[0] += 1
+                        continue
+                    tf = first[0] or time.perf_counter()
+                    wall = (tf - t0) * 1000.0
+                    with wlock:
+                        ka = kill_at[0]
+                        if ka is None or tf < ka:
+                            pre_walls.append(wall)      # finished pre-kill
+                        elif t0 >= ka:
+                            post_walls.append(wall)     # started post-kill
+                        # requests SPANNING the kill (in flight when the
+                        # gateway died — the failover-replayed ones) are
+                        # counted for losslessness but excluded from both
+                        # steady-state percentiles.
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=client_body, args=(k,),
+                                    daemon=True)
+                   for k in range(kill_threads)]
+        for t in threads:
+            t.start()
+        start_evt.set()
+        # Let pre-kill traffic accumulate, then slam gateway 0 shut,
+        # then keep the traffic running for the post-kill window.
+        time.sleep(1.2)
+        with wlock:
+            kill_at[0] = time.perf_counter()
+        gws[0].kill()
+        with wlock:
+            end_at[0] = time.perf_counter() + 1.5
+        for t in threads:
+            t.join(timeout=120.0)
+        assert lost[0] == 0, \
+            f"{lost[0]} idempotent requests lost across the gateway kill"
+        assert pre_walls and post_walls, \
+            (f"kill landed outside the traffic window "
+             f"({len(pre_walls)} pre / {len(post_walls)} post)")
+        pre_p99, post_p99 = p99(pre_walls), p99(post_walls)
+        assert post_p99 <= max(2.0 * pre_p99, pre_p99 + 500.0), \
+            (f"p99 TTFT did not hold across the gateway kill: "
+             f"{post_p99:.0f}ms post vs {pre_p99:.0f}ms pre")
+        return (completed, flood_p99, pre_p99, post_p99, lost[0])
+    finally:
+        for g in gws:
+            if not g.killed:
+                g.stop()
+        router.close()
+        for r in reps:
+            r.stop()
+        reg.stop()
+
+
 def bench_fleet_trace_overhead(n_requests=240, workers=4, threads=2,
                                handler_delay_s=0.01, best_of=3):
     """Tracing overhead bound (PR 10 acceptance): the same seeded stub
@@ -2175,6 +2449,21 @@ def main():
         out["fleet_sim_requests"] = int(n_sim)
         out["fleet_sim_virtual_seconds"] = round(sim_s, 1)
         out["fleet_sim_soak_amplification"] = round(fid_amp, 3)
+        flush_partial()
+    gc = attempts(bench_fleet_gateway_concurrency,
+                  "gateway concurrency bench", n=1)
+    if gc:
+        # Front-door scale (ROADMAP item 2): >= 1000 concurrent client
+        # connections on ONE event-loop gateway with bounded p99
+        # first-token latency, and a two-gateway kill soak where p99
+        # TTFT holds and zero idempotent requests are lost across the
+        # client failover — all asserted in-bench.
+        conns, flood_p99, pre_p99, post_p99, gw_lost = gc[0]
+        out["fleet_gateway_concurrent_connections"] = int(conns)
+        out["fleet_gateway_flood_p99_ttft_ms"] = round(flood_p99, 2)
+        out["fleet_gateway_prekill_p99_ttft_ms"] = round(pre_p99, 2)
+        out["fleet_gateway_kill_p99_ttft_ms"] = round(post_p99, 2)
+        out["fleet_gateway_lost_requests"] = int(gw_lost)
         flush_partial()
     tro = attempts(bench_fleet_trace_overhead, "trace overhead bench",
                    n=1)

@@ -269,6 +269,23 @@ def test_fleet_sim_bench_smoke():
 
 
 @pytest.mark.slow
+def test_fleet_gateway_concurrency_bench_smoke():
+    """bench_fleet_gateway_concurrency's protocol at reduced scale
+    (jax-free stubs; the event-loop gateway is the system under test):
+    every concurrent connection served with bounded p99, and the
+    two-gateway kill soak loses zero idempotent requests — asserted
+    inside the bench.  The full >= 1000-connection figure is the
+    bench run's."""
+    (conns, flood_p99, pre_p99, post_p99, lost) = \
+        bench.bench_fleet_gateway_concurrency(
+            n_conns=220, kill_threads=4, workers=8)
+    assert conns == 220
+    assert np.isfinite(flood_p99) and flood_p99 > 0
+    assert np.isfinite(pre_p99) and np.isfinite(post_p99)
+    assert lost == 0
+
+
+@pytest.mark.slow
 def test_fleet_soak_bench_smoke():
     """The chaos-soak protocol end to end at small size: gray-slow
     replica breaker-isolated while heartbeat-alive, SIGKILL +

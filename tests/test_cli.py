@@ -277,6 +277,73 @@ def test_serve_parser_observability_flags_and_subcommands(capfd,
     assert wire.TOKEN_ENV in capfd.readouterr().err
 
 
+def test_serve_parser_gateways_flag_and_subcommand(capfd, monkeypatch):
+    """tfserve's multi-gateway surface: --gateways parses (default 1),
+    serve_main rejects a non-positive count, the 'tfserve gateways'
+    subcommand parser round-trips and refuses to dial
+    unauthenticated."""
+    from tfmesos_tpu import wire
+    from tfmesos_tpu.cli import (build_gateways_parser,
+                                 build_serve_parser, serve_main)
+
+    assert build_serve_parser().parse_args([]).gateways == 1
+    assert build_serve_parser().parse_args(
+        ["--gateways", "3"]).gateways == 3
+    assert build_serve_parser().parse_args(["-G", "2"]).gateways == 2
+    assert serve_main(["--gateways", "0", "--tiny"]) == 2
+    assert "--gateways" in capfd.readouterr().err
+    gp = build_gateways_parser().parse_args(["-g", "gw:8780"])
+    assert gp.gateway == "gw:8780"
+    monkeypatch.delenv(wire.TOKEN_ENV, raising=False)
+    monkeypatch.delenv(wire.TOKEN_FILE_ENV, raising=False)
+    assert serve_main(["gateways", "-g", "h:1"]) == 2
+    assert wire.TOKEN_ENV in capfd.readouterr().err
+
+
+def test_gateways_subcommand_lists_live_fleet(capfd, monkeypatch):
+    """`tfserve gateways -g ANY` against a LIVE pair of event-loop
+    gateways sharing one registry: every registered front door prints,
+    queried through either of them (discovery is gateway-agnostic)."""
+    from tfmesos_tpu import wire
+    from tfmesos_tpu.cli import serve_main
+    from tfmesos_tpu.fleet.admission import AdmissionController
+    from tfmesos_tpu.fleet.gateway import Gateway
+    from tfmesos_tpu.fleet.metrics import FleetMetrics
+    from tfmesos_tpu.fleet.registry import ReplicaRegistry
+    from tfmesos_tpu.fleet.router import Router
+
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token).start()
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gws = [Gateway(router, AdmissionController(max_queue=4), metrics,
+                   token=token, workers=1, registry=reg,
+                   close_router=False).start() for _ in range(2)]
+    try:
+        monkeypatch.setenv(wire.TOKEN_ENV, token)
+        for door in gws:
+            assert serve_main(["gateways", "-g", door.addr]) == 0
+            out = capfd.readouterr().out.split()
+            assert sorted(out) == sorted(g.addr for g in gws)
+    finally:
+        for g in gws:
+            g.stop()
+        router.close()
+        reg.stop()
+
+
+def test_simulate_multi_gateway_scenario(capfd):
+    """The multi-gateway sim scenario is reachable from the CLI and
+    reports its failover outcome."""
+    from tfmesos_tpu.cli import serve_main
+
+    assert serve_main(["simulate", "multi-gateway", "--requests",
+                       "400", "--json"]) == 0
+    res = json.loads(capfd.readouterr().out)
+    assert res["gateways"] == 3
+    assert res["lost"] == 0
+
+
 def test_trace_json_export(capfd, monkeypatch):
     """`tfserve trace -g GW --json` prints the raw records as one JSON
     array — the machine-readable export the simulator replays."""

@@ -1039,7 +1039,16 @@ def fleet():
     fs = FleetServer(replicas=N_E2E_REPLICAS, rows=E2E_ROWS, tiny=True,
                      max_len=64, page_size=16, prefill_bucket=16,
                      prefix_cache_pages=16,
-                     workers=8, max_queue=64, request_timeout=300.0,
+                     # TWO front doors over the one registry/router
+                     # view: every e2e assertion in this module also
+                     # exercises the multi-gateway topology (clients
+                     # carry both addrs and could fail over).  Workers
+                     # are PER GATEWAY — 4+4 keeps total dispatch
+                     # width at the single-gateway suite's 8 (the
+                     # SIGKILL test's mass-failover debit is sized to
+                     # the retry budget at that width).
+                     gateways=2,
+                     workers=4, max_queue=64, request_timeout=300.0,
                      start_timeout=240.0)
     fs.start()
     yield fs
@@ -1276,6 +1285,47 @@ def test_fleet_drain_migration_no_lost_requests(fleet, tiny_offline):
                  timeout=30.0)
     client.close()
 
+
+
+def test_fleet_streaming_matches_offline_and_is_incremental(
+        fleet, tiny_offline):
+    """E2E per-token streaming on the real batcher: the streamed
+    chunks concatenate to EXACTLY the offline-greedy completion, and
+    they arrive incrementally (first chunk strictly before the final
+    reply — the batcher flushes per decode block, not at the end)."""
+    cfg, offline = tiny_offline
+    prompt = _e2e_prompts(cfg, 1, seed=9)[0]
+    want = 24
+    client = fleet.client(timeout=300.0)
+    chunks, stamps = [], []
+    out = client.generate(
+        prompt, want,
+        on_tokens=lambda t: (chunks.append(list(t)),
+                             stamps.append(time.monotonic())))
+    t_done = time.monotonic()
+    ref = offline(prompt, want)
+    assert out["tokens"] == ref
+    assert [t for c in chunks for t in c] == ref, \
+        "streamed chunks diverged from the completion"
+    assert len(chunks) >= 2, \
+        f"tokens arrived in {len(chunks)} chunk(s) — not incremental"
+    assert stamps[0] < t_done, "first chunk not ahead of completion"
+    client.close()
+
+
+def test_fleet_multi_gateway_both_doors_serve(fleet, tiny_offline):
+    """Both front doors of the module fleet serve identical
+    completions over the one shared registry/router view, and each
+    hands out the full discovery set."""
+    cfg, offline = tiny_offline
+    prompt = _e2e_prompts(cfg, 1, seed=10)[0]
+    assert len(fleet.addrs) == 2
+    refs = offline(prompt, 4)
+    for addr in fleet.addrs:
+        client = FleetClient(addr, fleet.token, timeout=300.0)
+        assert client.generate(prompt, 4)["tokens"] == refs
+        assert sorted(client.gateways()) == sorted(fleet.addrs)
+        client.close()
 
 
 def test_fleet_replica_death_mid_stream_retries_on_survivor(
@@ -1666,5 +1716,328 @@ def test_gateway_priority_classes_rank_and_metrics(stub_fleet):
         assert snap["gauges"]["queue_depths"] == {
             "interactive": 0, "background": 0}
         client.close()
+    finally:
+        gw.stop()
+
+
+# -- front-door scaling: streaming, multi-gateway, failover (no JAX) --------
+#
+# docs/SERVING.md "Front-door scaling": the event-loop gateway, per-
+# token incremental replies, the `gateways` discovery op, and the
+# FleetClient failover that replays idempotent in-flight requests when
+# its gateway dies mid-stream.
+
+
+def _stub_streaming_replica(token, registry_addr, chunks, tokens,
+                            delay=0.05):
+    """Replies `chunks` as op:tokens partial frames (with their stream
+    offsets), `delay` apart, then the final completion with the full
+    `tokens` list — the replica-side shape of per-token streaming."""
+
+    def handler(msg, reply):
+        def work():
+            mid = msg.get("id")
+            if msg.get("stream"):
+                off = 0
+                for c in chunks:
+                    reply.partial({"op": "tokens", "id": mid,
+                                   "off": off, "tokens": list(c)})
+                    off += len(c)
+                    time.sleep(delay)
+            else:
+                time.sleep(delay * len(chunks))
+            reply({"op": "completion", "id": mid,
+                   "tokens": list(tokens), "ttft_ms": 1.0,
+                   "total_ms": 2.0})
+
+        threading.Thread(target=work, daemon=True).start()
+
+    return ReplicaServer(handler, token=token, capacity=8,
+                         registry_addr=registry_addr,
+                         heartbeat_interval=0.05).start()
+
+
+def test_streaming_tokens_arrive_before_completion(stub_fleet):
+    """op:tokens partials flow replica -> router -> gateway -> client
+    in order, BEFORE the final completion — and concatenate to exactly
+    the completion's full token list."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_streaming_replica(
+        token, reg.addr, chunks=[(4,), (2, 9)], tokens=(4, 2, 9)))
+    assert reg.wait_for(1, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=2, registry=reg).start()
+    try:
+        client = FleetClient(gw.addr, token)
+        got, stamps = [], []
+        out = client.generate(
+            [1, 2], max_new_tokens=3,
+            on_tokens=lambda t: (got.append(list(t)),
+                                 stamps.append(time.monotonic())))
+        t_done = time.monotonic()
+        assert out["tokens"] == [4, 2, 9]
+        assert got == [[4], [2, 9]]
+        # The first chunk landed a real delay ahead of the completion:
+        # streaming, not a post-hoc replay of the final reply.
+        assert stamps[0] < t_done - 0.03
+        assert metrics.get("stream_chunks") == 2
+        client.close()
+    finally:
+        gw.stop()
+
+
+def test_streaming_offset_dedup_across_retry(stub_fleet):
+    """A replica that streams a prefix then DIES mid-request: the
+    retry re-streams from offset 0 on the survivor, and the gateway's
+    offset de-dup hands the client each token exactly once."""
+    token, reg, servers = stub_fleet
+
+    # Dies after streaming its first chunk — the router retries on the
+    # healthy streaming replica, which re-streams from 0.
+    def dying_handler(msg, reply):
+        def work():
+            if msg.get("stream"):
+                reply.partial({"op": "tokens", "id": msg.get("id"),
+                               "off": 0, "tokens": [4]})
+            time.sleep(0.05)
+            # Slam every connection: mid-request EOF.
+            dying.stop()
+
+        threading.Thread(target=work, daemon=True).start()
+
+    dying = ReplicaServer(dying_handler, token=token, capacity=8,
+                          registry_addr=reg.addr,
+                          heartbeat_interval=0.05).start()
+    assert reg.wait_for(1, timeout=5.0)
+    survivor = _stub_streaming_replica(
+        token, reg.addr, chunks=[(4,), (2, 9)], tokens=(4, 2, 9),
+        delay=0.02)
+    servers.append(survivor)
+    assert reg.wait_for(2, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=2, registry=reg).start()
+    try:
+        client = FleetClient(gw.addr, token)
+        got = []
+        # Drive until the dying replica actually took one (it may take
+        # a few requests for p2c to pick it first).
+        for _ in range(8):
+            got.clear()
+            out = client.generate([1], max_new_tokens=3, timeout=30.0,
+                                  on_tokens=lambda t: got.extend(t))
+            assert out["tokens"] == [4, 2, 9]
+            assert got == [4, 2, 9], \
+                f"streamed tokens duplicated or lost: {got}"
+            if metrics.get("retries") >= 1:
+                break
+        assert metrics.get("retries") >= 1, \
+            "the dying replica never took a request; test proved nothing"
+        client.close()
+    finally:
+        gw.stop()
+
+
+def test_gateways_discovery_op_and_registry(stub_fleet):
+    """N gateways register with the shared registry; the `gateways` op
+    on ANY of them returns the full set; a graceful stop deregisters,
+    a kill does not (stale entries are the client's to skip)."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_replica(token, reg.addr, tokens=(1,)))
+    assert reg.wait_for(1, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    adm = AdmissionController(max_queue=8)
+    gws = [Gateway(router, adm, metrics, token=token, workers=1,
+                   registry=reg, close_router=False).start()
+           for _ in range(3)]
+    try:
+        client = FleetClient(gws[1].addr, token)
+        assert sorted(client.gateways()) == sorted(g.addr for g in gws)
+        assert sorted(reg.gateway_addrs()) == sorted(g.addr
+                                                     for g in gws)
+        client.close()
+        gws[2].stop()                   # graceful: deregisters
+        assert sorted(reg.gateway_addrs()) == sorted(
+            g.addr for g in gws[:2])
+        gws[1].kill()                   # SIGKILL shape: stays listed
+        assert sorted(reg.gateway_addrs()) == sorted(
+            g.addr for g in gws[:2])
+    finally:
+        for g in gws:
+            if not g.killed and g._threads:
+                g.stop()
+        router.close()
+
+
+def test_client_failover_replays_inflight_request(stub_fleet):
+    """The acceptance failure mode: a client's gateway is hard-killed
+    with a request IN FLIGHT — the FleetClient re-resolves and replays
+    it on the survivor; the caller sees one completion, streamed
+    tokens exactly-once."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_streaming_replica(
+        token, reg.addr, chunks=[(5,), (6,)], tokens=(5, 6),
+        delay=0.25))
+    assert reg.wait_for(1, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    adm = AdmissionController(max_queue=16)
+    gws = [Gateway(router, adm, metrics, token=token, workers=2,
+                   registry=reg, close_router=False).start()
+           for _ in range(2)]
+    try:
+        client = FleetClient([g.addr for g in gws], token)
+        res: dict = {"toks": []}
+
+        def call():
+            try:
+                res["out"] = client.generate(
+                    [3], max_new_tokens=2, timeout=30.0,
+                    on_tokens=lambda t: res["toks"].extend(t))
+            except Exception as e:      # surfaced in the main thread
+                res["err"] = e
+
+        t = threading.Thread(target=call)
+        t.start()
+        time.sleep(0.1)                 # request is mid-stream now
+        victim = next(g for g in gws if g.addr == client.addr)
+        victim.kill()
+        t.join(timeout=30.0)
+        assert "err" not in res, res.get("err")
+        assert res["out"]["tokens"] == [5, 6]
+        assert res["toks"] == [5, 6], \
+            f"failover replay duplicated/lost streamed tokens: " \
+            f"{res['toks']}"
+        assert client.addr != victim.addr   # moved to the survivor
+        client.close()
+    finally:
+        for g in gws:
+            if not g.killed:
+                g.stop()
+        router.close()
+
+
+def test_client_all_gateways_dead_fails_explicitly(stub_fleet):
+    """Failover is bounded: with every gateway gone the client raises
+    ConnectionLost — never a hang, never an unbounded retry loop."""
+    token, reg, servers = stub_fleet
+    servers.append(_stub_replica(token, reg.addr, tokens=(1,)))
+    assert reg.wait_for(1, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=1, registry=reg,
+                 close_router=False).start()
+    client = FleetClient(gw.addr, token)
+    assert client.generate([1], 1)["tokens"] == [1]
+    gw.kill()
+    try:
+        with pytest.raises(ConnectionLost):
+            client.generate([1], 1, timeout=5.0)
+    finally:
+        client.close()
+        router.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_mux_reader_death_fails_calls_promptly(stub_fleet):
+    """Satellite: a reader-thread DEATH (a bug, not a clean EOF) fails
+    every outstanding call immediately with the distinguishable
+    ReaderDied — callers must not ride their full per-call timeout."""
+    from tfmesos_tpu.fleet.client import ReaderDied
+
+    token, reg, servers = stub_fleet
+    servers.append(_stub_replica(token, reg.addr, tokens=(7,),
+                                 delay=30.0))   # generate never replies
+    mux = MuxConnection(servers[0].addr, token)
+    results: dict = {}
+
+    def call():
+        t0 = time.monotonic()
+        try:
+            mux.call({"op": "generate", "prompt": [1]}, timeout=60.0)
+            results["outcome"] = "reply"
+        except ReaderDied:
+            results["outcome"] = "reader_died"
+        except ConnectionLost:
+            results["outcome"] = "connection_lost"
+        results["waited_s"] = time.monotonic() - t0
+
+    t = threading.Thread(target=call)
+    t.start()
+    assert _wait(lambda: mux.outstanding == 1)   # call in flight
+    # Inject the reader bug: the reader pops the reply slot from
+    # _slots under the lock — swap the dict for one whose pop raises.
+    # The next reply it processes (a pong, answered instantly by
+    # ReplicaServer itself) then kills the reader thread with an
+    # exception outside its (OSError, WireError) arms.
+    class _Boom(dict):
+        def pop(self, *a, **kw):
+            raise RuntimeError("injected reader bug")
+
+    with mux._lock:
+        mux._slots = _Boom(mux._slots)
+    with pytest.raises((ReaderDied, CallTimeout)):
+        mux.call({"op": "ping"}, timeout=5.0)
+    t.join(timeout=10.0)
+    assert results.get("outcome") == "reader_died", results
+    assert results["waited_s"] < 10.0, \
+        f"caller rode {results['waited_s']:.1f}s instead of failing fast"
+    # A fresh call on the dead mux fails distinguishably too.
+    with pytest.raises(ReaderDied):
+        mux.call({"op": "ping"}, timeout=1.0)
+    mux.close()
+
+
+def test_client_close_cancels_never_replays(stub_fleet):
+    """close() racing an in-flight generate is a CANCELLATION, not a
+    gateway death: the call fails with ConnectionLost, is never
+    replayed, and the closed client refuses later calls instead of
+    silently re-dialing."""
+    token, reg, servers = stub_fleet
+    served = []
+
+    def handler(msg, reply):
+        def work():
+            served.append(msg.get("id"))
+            time.sleep(0.4)
+            reply({"op": "completion", "id": msg.get("id"),
+                   "tokens": [1], "ttft_ms": 1.0, "total_ms": 2.0})
+
+        threading.Thread(target=work, daemon=True).start()
+
+    servers.append(ReplicaServer(handler, token=token, capacity=8,
+                                 registry_addr=reg.addr,
+                                 heartbeat_interval=0.05).start())
+    assert reg.wait_for(1, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=2, registry=reg).start()
+    try:
+        client = FleetClient(gw.addr, token)
+        res: dict = {}
+
+        def call():
+            try:
+                client.generate([1], 1, timeout=30.0)
+                res["outcome"] = "reply"
+            except ConnectionLost:
+                res["outcome"] = "connection_lost"
+
+        t = threading.Thread(target=call)
+        t.start()
+        assert _wait(lambda: len(served) == 1)  # in flight
+        client.close()
+        t.join(timeout=10.0)
+        assert res.get("outcome") == "connection_lost", res
+        assert len(served) == 1, "cancelled call was replayed"
+        with pytest.raises(ConnectionLost):
+            client.generate([1], 1, timeout=1.0)
     finally:
         gw.stop()

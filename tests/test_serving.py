@@ -2045,3 +2045,69 @@ def test_batcher_trace_events_and_flight_recorder(setup):
     pblocks = [e for e in piped.flight.snapshot()
                if e["name"] == "decode.block"]
     assert pblocks and all(e["mode"] == "pipelined" for e in pblocks)
+
+
+# -- per-token incremental streaming (Request.on_tokens) ---------------------
+
+
+def test_streaming_callback_chunks_match_stream(setup):
+    """Request.on_tokens receives contiguous, correctly-offset chunks
+    whose concatenation is a PREFIX of the completion (rows finishing
+    inside a block keep their tail for the Completion), token streams
+    byte-identical to non-streaming, and a raising callback costs its
+    stream, never the request."""
+    cfg, params = setup
+    reqs = [Request(prompt=p, max_new_tokens=5 + (i % 6))
+            for i, p in enumerate(_prompts(cfg, 6, seed=7))]
+    got = {i: [] for i in range(len(reqs))}
+    offs = {i: [] for i in range(len(reqs))}
+    for i, r in enumerate(reqs):
+        def cb(chunk, off, i=i):
+            assert off == len(got[i]), \
+                f"req {i}: chunk offset {off} != streamed {len(got[i])}"
+            got[i].extend(chunk)
+            offs[i].append(off)
+        r.on_tokens = cb
+    # One request's consumer is broken: its stream is disarmed, the
+    # request still completes exactly.
+    def boom(chunk, off):
+        got[3].extend(chunk)
+        raise RuntimeError("broken consumer")
+    reqs[3].on_tokens = boom
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                                page_size=16, prefill_bucket=16)
+    done = {c.rid: c for c in batcher.run(reqs)}
+    assert len(done) == len(reqs)
+    for rid, req in enumerate(reqs):
+        ref = _offline(cfg, params, req)
+        assert done[rid].tokens == ref, f"req {rid} diverged"
+        streamed = got[rid]
+        assert streamed == ref[:len(streamed)], \
+            f"req {rid}: streamed {streamed} not a prefix of {ref}"
+        if rid == 3:
+            assert len(streamed) <= len(ref)    # disarmed after raise
+        else:
+            # At least the first token streamed ahead of completion.
+            assert len(streamed) >= 1
+
+
+def test_streaming_multi_step_and_chunked_prefill(setup):
+    """Streaming composes with multi_step blocks (chunks arrive K at a
+    time) and chunked prefill — streams still equal offline."""
+    cfg, params = setup
+    for kw in ({"multi_step": 3}, {"prefill_chunk": 8}):
+        reqs = [Request(prompt=p, max_new_tokens=7)
+                for p in _prompts(cfg, 3, seed=11)]
+        got = {id(r): [] for r in reqs}
+        for r in reqs:
+            r.on_tokens = lambda c, off, r=r: got[id(r)].extend(c)
+        b = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                              page_size=16,
+                              **(dict(prefill_bucket=16, **kw)
+                                 if "prefill_chunk" not in kw else kw))
+        done = {id(c.request): c for c in b.run(reqs)}
+        for r in reqs:
+            ref = _offline(cfg, params, r)
+            assert done[id(r)].tokens == ref
+            assert got[id(r)] == ref[:len(got[id(r)])]
+            assert len(got[id(r)]) >= 1

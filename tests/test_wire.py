@@ -1,5 +1,6 @@
 import socket
 import threading
+import time
 
 import pytest
 
@@ -349,3 +350,273 @@ def test_non_utf8_body_rejected():
     framer = wire.Framer(token)
     with pytest.raises(wire.WireError, match="bad JSON body"):
         framer.feed(frame)
+
+
+# -- the event-loop serve core (WireServer) ----------------------------------
+#
+# One selector thread serves EVERY connection of a listener (the
+# front-door scaling core, docs/SERVING.md "Front-door scaling"); these
+# tests drive it with plain threaded clients — proving old clients talk
+# to the new server unchanged — and with hostile peers (slow-loris,
+# half-open, slow readers) that must cost one connection, never the
+# loop.
+
+
+def _echo_server(token, allow_raw=False, **kw):
+    def handler(conn, msg):
+        if isinstance(msg, wire.RawFrame):
+            conn.send_raw(dict(msg.meta, echoed=True), msg.body)
+        else:
+            conn.send({"echo": msg})
+
+    return wire.WireServer(handler, token=token, allow_raw=allow_raw,
+                           **kw).start()
+
+
+def test_wire_server_echo_smoke():
+    """Threaded-client wire compatibility: send_msg/recv_msg against the
+    event loop round-trips JSON frames, HMAC discipline intact (a
+    wrong-token frame drops the connection, the right-token peer is
+    untouched)."""
+    token = wire.new_token()
+    srv = _echo_server(token)
+    try:
+        c = wire.connect(srv.addr)
+        for i in range(50):
+            wire.send_msg(c, {"op": "ping", "i": i}, token)
+        for i in range(50):
+            assert wire.recv_msg(c, token) == {
+                "echo": {"op": "ping", "i": i}}
+        # An unauthenticated peer is dropped at its first frame...
+        bad = wire.connect(srv.addr)
+        wire.send_msg(bad, {"op": "x"}, "wrong-token")
+        with pytest.raises((OSError, wire.WireError)):
+            for _ in range(10):
+                wire.recv_msg(bad, "wrong-token")
+        bad.close()
+        # ...and the healthy connection never noticed.
+        wire.send_msg(c, "still-here", token)
+        assert wire.recv_msg(c, token) == {"echo": "still-here"}
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_wire_server_slow_loris_partial_frames():
+    """A peer dribbling one frame a byte at a time (and stalling
+    mid-frame) holds only its own Framer buffer: concurrent clients get
+    served at full speed the whole while, and the dribbled frame
+    decodes once it completes."""
+    token = wire.new_token()
+    srv = _echo_server(token)
+    try:
+        loris = wire.connect(srv.addr)
+        frame = wire.encode({"op": "slow"}, token)
+        for b in frame[:-1]:
+            loris.sendall(bytes([b]))
+            # A fast client round-trips BETWEEN the loris bytes.
+        fast = wire.connect(srv.addr)
+        t0 = time.monotonic()
+        wire.send_msg(fast, {"op": "fast"}, token)
+        assert wire.recv_msg(fast, token) == {"echo": {"op": "fast"}}
+        assert time.monotonic() - t0 < 2.0
+        fast.close()
+        loris.sendall(frame[-1:])       # frame completes -> decoded
+        assert wire.recv_msg(loris, token) == {"echo": {"op": "slow"}}
+        loris.close()
+    finally:
+        srv.stop()
+
+
+def test_wire_server_half_open_peer_does_not_wedge_loop():
+    """A peer that sends half a frame and then goes silent (the
+    SIGKILLed-host shape) just sits as one idle connection; an aborted
+    peer (RST) is reaped.  Either way the loop keeps serving."""
+    token = wire.new_token()
+    srv = _echo_server(token)
+    try:
+        half = wire.connect(srv.addr)
+        half.sendall(wire.encode({"op": "never"}, token)[:7])
+        # Abortive close (RST instead of FIN): the loop must reap it.
+        rst = wire.connect(srv.addr)
+        rst.sendall(wire.encode({"op": "x"}, token)[:3])
+        rst.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                       __import__("struct").pack("ii", 1, 0))
+        rst.close()
+        deadline = time.monotonic() + 5.0
+        fast = wire.connect(srv.addr)
+        wire.send_msg(fast, {"op": "alive"}, token)
+        assert wire.recv_msg(fast, token) == {"echo": {"op": "alive"}}
+        fast.close()
+        while time.monotonic() < deadline:
+            if len(srv.connections()) <= 1:     # rst + fast reaped
+                break
+            time.sleep(0.02)
+        assert len(srv.connections()) <= 1
+        half.close()
+    finally:
+        srv.stop()
+
+
+def test_wire_server_backpressure_drops_slow_reader_only():
+    """A peer that never reads its replies fills its bounded write
+    buffer and gets DROPPED — the loop and every other client keep
+    going (an unbounded buffer would let one slow reader OOM the
+    gateway; a blocking send would wedge every connection)."""
+    token = wire.new_token()
+    payload = "x" * 65536
+    srv = _echo_server(token, max_buffer=256 * 1024)
+    try:
+        slow = wire.connect(srv.addr)
+        slow.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        # Pump requests without ever reading replies: the echo replies
+        # accumulate in the server-side buffer past max_buffer.
+        dropped = False
+        try:
+            for _ in range(200):
+                wire.send_msg(slow, {"op": "flood", "pad": payload},
+                              token)
+        except OSError:
+            dropped = True      # server closed us mid-pump
+        # Either the pump already saw the close, or the next read does.
+        if not dropped:
+            slow.settimeout(5.0)
+            with pytest.raises((OSError, wire.WireError)):
+                while True:
+                    wire.recv_msg(slow, token)
+        slow.close()
+        # The loop survived and other clients are unaffected.
+        fine = wire.connect(srv.addr)
+        wire.send_msg(fine, {"op": "ok"}, token)
+        assert wire.recv_msg(fine, token) == {"echo": {"op": "ok"}}
+        fine.close()
+    finally:
+        srv.stop()
+
+
+def test_wire_server_oversized_preauth_frame_rejected_at_prefix():
+    """The 64 MiB pre-auth bound holds on the event loop: a length
+    prefix over MAX_FRAME (or the raw bit on a non-allow_raw server)
+    drops the connection at the 4-byte prefix — nothing buffers."""
+    import struct as struct_mod
+
+    token = wire.new_token()
+    srv = _echo_server(token)               # allow_raw=False
+    try:
+        for prefix in (struct_mod.pack(">I", wire.MAX_FRAME + 1),
+                       struct_mod.pack(
+                           ">I", wire.RAW_FLAG | (1 << 29))):
+            c = wire.connect(srv.addr)
+            c.sendall(prefix)
+            c.settimeout(5.0)
+            with pytest.raises((OSError, wire.WireError)):
+                wire.recv_msg(c, token)     # server closed on us
+            c.close()
+        ok = wire.connect(srv.addr)
+        wire.send_msg(ok, "fine", token)
+        assert wire.recv_msg(ok, token) == {"echo": "fine"}
+        ok.close()
+    finally:
+        srv.stop()
+
+
+def test_wire_server_interleaved_raw_and_json_frames():
+    """An allow_raw WireServer (replica-link shape) decodes raw and
+    JSON frames interleaved on one connection, in order, bodies
+    byte-exact — same contract as the threaded reader."""
+    token = wire.new_token()
+    srv = _echo_server(token, allow_raw=True)
+    try:
+        c = wire.connect(srv.addr)
+        body = bytes(range(256)) * 32
+        wire.send_msg(c, {"op": "a"}, token)
+        wire.send_raw_msg(c, {"op": "kv", "id": 1}, body, token)
+        wire.send_msg(c, {"op": "b"}, token)
+        assert wire.recv_msg(c, token, allow_raw=True) == {
+            "echo": {"op": "a"}}
+        raw = wire.recv_msg(c, token, allow_raw=True)
+        assert isinstance(raw, wire.RawFrame)
+        assert raw.meta == {"op": "kv", "id": 1, "echoed": True}
+        assert raw.body == body
+        assert wire.recv_msg(c, token, allow_raw=True) == {
+            "echo": {"op": "b"}}
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_wire_server_wake_listener_unblocks_selector_on_stop():
+    """Regression: wake_listener must still unblock the selector loop
+    after the stop flag is set (the fleet-wide stop discipline) — even
+    with the internal waker disabled, the accept poke alone gets the
+    loop to re-check its flag and exit promptly."""
+    token = wire.new_token()
+    srv = _echo_server(token)
+    try:
+        srv._wake = lambda: None            # waker out of the picture
+        srv._stop.set()
+        t0 = time.monotonic()
+        wire.wake_listener(srv._listen)
+        srv._thread.join(timeout=3.0)
+        assert not srv._thread.is_alive()
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        srv._thread = None
+        srv.stop()                          # idempotent cleanup
+
+
+def test_wire_server_connection_flood():
+    """The point of the event loop: hundreds of concurrent client
+    connections on ONE serve thread, every request answered.  (The
+    full-scale 1000+ figure is bench_fleet_gateway_concurrency's.)"""
+    token = wire.new_token()
+    srv = _echo_server(token)
+    socks = []
+    try:
+        n = 256
+        for i in range(n):
+            s = wire.connect(srv.addr, timeout=10.0)
+            socks.append(s)
+            wire.send_msg(s, {"i": i}, token)
+        for i, s in enumerate(socks):
+            assert wire.recv_msg(s, token) == {"echo": {"i": i}}
+        # Threads in this process stayed O(1): the server side of the
+        # flood is the selector loop, not 256 readers.
+        server_threads = [t for t in threading.enumerate()
+                          if t.name == "wire-server"]
+        assert len(server_threads) == 1
+    finally:
+        for s in socks:
+            s.close()
+        srv.stop()
+
+
+def test_wire_server_send_from_many_threads_ordered_per_connection():
+    """conn.send is thread-safe: replies queued from many worker
+    threads all land, each frame intact (the gateway's worker pool
+    replies through exactly this path)."""
+    token = wire.new_token()
+    got = []
+
+    def handler(conn, msg):
+        # Fan the reply work out to threads, like gateway workers.
+        def work(k):
+            for j in range(10):
+                conn.send({"k": k, "j": j})
+
+        for k in range(4):
+            threading.Thread(target=work, args=(k,), daemon=True).start()
+
+    srv = wire.WireServer(handler, token=token).start()
+    try:
+        c = wire.connect(srv.addr)
+        wire.send_msg(c, {"op": "go"}, token)
+        c.settimeout(10.0)
+        for _ in range(40):
+            got.append(wire.recv_msg(c, token))
+        per_k = {k: [m["j"] for m in got if m["k"] == k]
+                 for k in range(4)}
+        assert all(v == list(range(10)) for v in per_k.values())
+        c.close()
+    finally:
+        srv.stop()

@@ -216,6 +216,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("-p", "--gateway-port", type=int, default=8780,
                    help="gateway listen port (0 = OS-assigned)")
     p.add_argument("--gateway-host", type=str, default="0.0.0.0")
+    p.add_argument("-G", "--gateways", type=int, default=1,
+                   help="number of stateless gateway front doors over "
+                        "the one registry/router view (the first on "
+                        "--gateway-port, the rest OS-assigned): each "
+                        "is an event-loop process thread serving "
+                        "thousands of connections, clients discover "
+                        "the set via 'tfserve gateways' and fail over "
+                        "between them (docs/SERVING.md 'Front-door "
+                        "scaling')")
     p.add_argument("--rows", type=int, default=8,
                    help="concurrent decode rows per replica")
     p.add_argument("--max-len", type=int, default=None,
@@ -702,6 +711,49 @@ def simulate_main(argv: List[str]) -> int:
     return 0
 
 
+def build_gateways_parser() -> argparse.ArgumentParser:
+    """``tfserve gateways`` — list a fleet's registered front doors
+    (client-side discovery for multi-gateway failover)."""
+    p = argparse.ArgumentParser(
+        prog="tfserve gateways",
+        description="List the fleet's registered gateway addresses "
+                    "(the `gateways` discovery op ANY gateway serves).")
+    p.add_argument("-g", "--gateway", type=str, required=True,
+                   metavar="HOST:PORT",
+                   help="any running gateway of the fleet")
+    p.add_argument("--timeout", type=float, default=10.0)
+    return p
+
+
+def gateways_main(argv: List[str]) -> int:
+    args = build_gateways_parser().parse_args(argv)
+    from tfmesos_tpu.fleet.client import FleetClient
+
+    token = wire.load_token()
+    if not token:
+        print(f"tfserve gateways: no cluster token — set "
+              f"{wire.TOKEN_ENV} or {wire.TOKEN_FILE_ENV} (tfserve "
+              f"printed the token file at startup)", file=sys.stderr)
+        return 2
+    try:
+        client = FleetClient(args.gateway, token, timeout=args.timeout)
+        try:
+            addrs = client.gateways(timeout=args.timeout)
+        finally:
+            client.close()
+    except OSError as e:
+        print(f"tfserve gateways: cannot reach gateway "
+              f"{args.gateway}: {e}", file=sys.stderr)
+        return 1
+    if not addrs:
+        print("tfserve gateways: none registered (single-gateway "
+              "fleet predating discovery, or the registry restarted)")
+        return 0
+    for addr in addrs:
+        print(addr)
+    return 0
+
+
 def build_metrics_parser() -> argparse.ArgumentParser:
     """``tfserve metrics`` — fetch the gateway snapshot and
     pretty-print it (until now the JSON snapshot was only reachable
@@ -846,6 +898,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         return trace_main(argv[1:])
     if argv and argv[0] == "metrics":
         return metrics_main(argv[1:])
+    if argv and argv[0] == "gateways":
+        return gateways_main(argv[1:])
     if argv and argv[0] == "simulate":
         return simulate_main(argv[1:])
     args = build_serve_parser().parse_args(argv)
@@ -862,6 +916,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         return 2
     if args.rows < 1:
         print(f"tfserve: --rows must be >= 1, got {args.rows}",
+              file=sys.stderr)
+        return 2
+    if args.gateways < 1:
+        print(f"tfserve: --gateways must be >= 1, got {args.gateways}",
               file=sys.stderr)
         return 2
 
@@ -885,6 +943,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         replica_cpus=args.replica_cpus, replica_mem=args.replica_mem,
         replica_chips=args.replica_chips,
         gateway_host=args.gateway_host, gateway_port=args.gateway_port,
+        gateways=args.gateways,
         workers=args.workers, max_queue=args.max_queue, rate=args.rate,
         burst=args.burst, max_retries=args.retries,
         priority_classes=classes, migrate_on_drain=args.migrate,
@@ -917,7 +976,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     if args.autoscale:
         tiers += (f", autoscaling within [{fleet.min_replicas}, "
                   f"{fleet.max_replicas}]")
-    print(f"tfserve: gateway on {fleet.addr} fronting {tiers}; "
+    doors = fleet.addr if args.gateways == 1 else \
+        f"{args.gateways} gateways ({', '.join(fleet.addrs)})"
+    print(f"tfserve: gateway on {doors} fronting {tiers}; "
           f"ctrl-c to stop", flush=True)
     try:
         while True:

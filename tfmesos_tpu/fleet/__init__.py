@@ -15,8 +15,11 @@ TF-Replicator (PAPERS.md) over the existing execution engine:
 * :mod:`~tfmesos_tpu.fleet.admission` — backpressure: a bounded ingress
   queue, queue-depth shedding with explicit ``Overloaded`` rejections,
   and a token-bucket rate limiter.
-* :mod:`~tfmesos_tpu.fleet.gateway` — the threaded TCP front door that
-  accepts client requests, routes them, and relays completions back.
+* :mod:`~tfmesos_tpu.fleet.gateway` — the event-loop TCP front door
+  (one selector thread per gateway, a worker pool for dispatch) that
+  accepts client requests, routes them, and relays completions back —
+  streamed per token when asked; N stateless gateways may front one
+  fleet (docs/SERVING.md "Front-door scaling").
 * :mod:`~tfmesos_tpu.fleet.metrics` — counters + latency histograms
   (TTFT, tokens/s, queue depth, shed/retry counts) as a JSON snapshot,
   a periodic log line, and Prometheus exposition behind an optional

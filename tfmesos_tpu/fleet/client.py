@@ -10,24 +10,51 @@ callers talking to the gateway.
 Failure model: when the peer closes or the socket errors, EVERY pending
 call fails promptly with :class:`ConnectionLost` — nothing blocks until
 a timeout just because a replica died (the router turns that into a
-retry on a different replica).
+retry on a different replica).  A reader-thread death that is NOT a
+clean transport failure (a bug, an unexpected decode path) fails them
+just as promptly with the distinguishable :class:`ReaderDied` — callers
+must never ride their full per-call timeout because the thread that
+would have delivered the reply is gone.
+
+Streaming: a reply stream may interleave PARTIAL frames
+(``{"op": "tokens", "id", "off", "tokens"}``) before the final
+completion — the per-token streaming path (docs/SERVING.md "Front-door
+scaling").  Partials dispatch to the call's ``on_partial`` callback
+without resolving it; the matching final reply resolves it as always.
+
+Multi-gateway failover: :class:`FleetClient` accepts a LIST of gateway
+addresses.  ``generate`` is idempotent (completions are deterministic
+functions of the request and nothing was delivered when a gateway died
+mid-call), so a :class:`ConnectionLost` mid-generate re-resolves the
+gateway list (the ``gateways`` discovery op) and REPLAYS the request on
+a surviving gateway; streamed tokens are de-duplicated by offset, so
+the caller's ``on_tokens`` sees each token exactly once even across a
+replay.  Non-idempotent ops (``rollout``) never replay.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 from tfmesos_tpu import wire
 from tfmesos_tpu.fleet.admission import Overloaded, RateLimited
 from tfmesos_tpu.utils.logging import get_logger
 
-__all__ = ["ConnectionLost", "CallTimeout", "RequestFailed",
+__all__ = ["ConnectionLost", "ReaderDied", "CallTimeout", "RequestFailed",
            "MuxConnection", "FleetClient"]
 
 
 class ConnectionLost(OSError):
     """The peer went away (EOF, reset, or bad frame) with calls pending."""
+
+
+class ReaderDied(ConnectionLost):
+    """The mux reader thread died on an UNEXPECTED error (not a clean
+    EOF / socket failure): every outstanding call fails immediately
+    with this — distinguishable from an ordinary peer death, because it
+    names a client-side bug rather than replica health (the router must
+    not mark a replica dead for it)."""
 
 
 class CallTimeout(TimeoutError):
@@ -60,10 +87,12 @@ class MuxConnection:
         self._sock.settimeout(None)
         self._lock = threading.Lock()
         self._send_lock = threading.Lock()
-        self._slots: Dict[int, list] = {}   # id -> [Event, reply|None]
+        # id -> [Event, reply|None, on_partial|None]
+        self._slots: Dict[int, list] = {}
         self._next_id = 0
         self._closed = False
         self._error: Optional[str] = None
+        self._reader_died = False
         self._reader = threading.Thread(target=self._read_loop,
                                         name=f"mux-{addr}", daemon=True)
         self._reader.start()
@@ -77,30 +106,42 @@ class MuxConnection:
         with self._lock:
             return len(self._slots)
 
+    def _lost(self) -> ConnectionLost:
+        why = self._error or "connection closed"
+        return ReaderDied(why) if self._reader_died \
+            else ConnectionLost(why)
+
     def call(self, msg: Dict[str, Any],
-             timeout: Optional[float] = None) -> Any:
+             timeout: Optional[float] = None,
+             on_partial: Optional[Callable[[Any], None]] = None) -> Any:
         """Send ``msg`` (its ``id`` field is overwritten with ours) and
         block for the matching reply — a dict, or a
         :class:`~tfmesos_tpu.wire.RawFrame` when the peer replies in
-        the raw binary framing (a prefill replica's KV export)."""
-        return self._call(msg, None, timeout)
+        the raw binary framing (a prefill replica's KV export).
+        ``on_partial`` receives any PARTIAL frames (``op: tokens``)
+        matched to this call before the final reply — the streaming
+        path; it runs on the reader thread and must not block."""
+        return self._call(msg, None, timeout, on_partial)
 
     def call_raw(self, meta: Dict[str, Any], body,
-                 timeout: Optional[float] = None) -> Any:
+                 timeout: Optional[float] = None,
+                 on_partial: Optional[Callable[[Any], None]] = None
+                 ) -> Any:
         """Like :meth:`call`, but ships ``meta`` + ``body`` as ONE raw
         binary frame (zero-copy body) — the KV handoff's transport into
         a decode replica.  The reply is matched by ``meta['id']`` like
         any other call."""
-        return self._call(meta, body, timeout)
+        return self._call(meta, body, timeout, on_partial)
 
     def _call(self, msg: Dict[str, Any], raw_body,
-              timeout: Optional[float] = None) -> Any:
+              timeout: Optional[float] = None,
+              on_partial: Optional[Callable[[Any], None]] = None) -> Any:
         with self._lock:
             if self._closed:
-                raise ConnectionLost(self._error or "connection closed")
+                raise self._lost()
             self._next_id += 1
             mid = self._next_id
-            slot = [threading.Event(), None]
+            slot = [threading.Event(), None, on_partial]
             self._slots[mid] = slot
         out = dict(msg)
         out["id"] = mid
@@ -135,7 +176,7 @@ class MuxConnection:
             raise CallTimeout(f"no reply from {self.addr} "
                               f"within {timeout}s")
         if slot[1] is None:     # woken by _fail, not by a reply
-            raise ConnectionLost(self._error or "connection closed")
+            raise self._lost()
         return slot[1]
 
     def _read_loop(self) -> None:
@@ -145,11 +186,24 @@ class MuxConnection:
         try:
             for msg in wire.iter_msgs(self._sock, framer):
                 if isinstance(msg, wire.RawFrame):
-                    mid = (msg.meta.get("id")
-                           if isinstance(msg.meta, dict) else None)
+                    head = msg.meta if isinstance(msg.meta, dict) else {}
                 elif isinstance(msg, dict):
-                    mid = msg.get("id")
+                    head = msg
                 else:
+                    continue
+                mid = head.get("id")
+                if head.get("op") == "tokens":
+                    # A streaming PARTIAL: dispatch to the call's
+                    # callback WITHOUT resolving the slot — the final
+                    # completion still lands through the normal path.
+                    with self._lock:
+                        slot = self._slots.get(mid)
+                        cb = slot[2] if slot is not None else None
+                    if cb is not None:
+                        try:
+                            cb(msg)
+                        except Exception:
+                            pass    # a broken consumer costs its stream
                     continue
                 with self._lock:
                     # The reply lands under the lock so a caller whose
@@ -163,15 +217,28 @@ class MuxConnection:
             self._fail("EOF from peer")
         except (OSError, wire.WireError) as e:
             self._fail(str(e))
+        except BaseException as e:  # noqa: BLE001 - reader must not die
+            # An unexpected reader death (a bug, not the transport):
+            # waiters would otherwise ride their FULL per-call timeout
+            # for replies nobody can deliver anymore.  Fail them all
+            # NOW, distinguishably.
+            self._fail(f"reader thread died: {e!r}", died=True)
+            raise
 
-    def _fail(self, why: str) -> None:
+    def _fail(self, why: str, died: bool = False) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             self._error = why
+            self._reader_died = died
             pending: List[list] = list(self._slots.values())
             self._slots.clear()
+        # shutdown before close: when _fail comes from close()/a send
+        # error, the reader thread is still blocked in recv and close()
+        # alone would leave it parked (and the peer unaware) until the
+        # kernel's recv reference drains.
+        wire.shutdown_socket(self._sock)
         try:
             self._sock.close()
         except OSError:
@@ -184,29 +251,142 @@ class MuxConnection:
 
 
 class FleetClient:
-    """Caller-side handle on a fleet gateway.
+    """Caller-side handle on a fleet gateway (or a SET of them).
 
     Thread-safe: many threads may ``generate()`` concurrently over the
     one multiplexed connection.  Overload rejections surface as
     :class:`~tfmesos_tpu.fleet.admission.Overloaded` — the explicit
     backpressure signal callers are expected to handle (back off,
     retry later, or spill).
-    """
 
-    def __init__(self, addr: str, token: str = "", timeout: float = 120.0,
-                 connect_timeout: float = 10.0):
-        self.addr = addr
+    ``addr`` may be one ``host:port`` or a list of them (a
+    multi-gateway fleet, ``tfserve --gateways N``): the client connects
+    to the first reachable gateway, refreshes the full set through the
+    ``gateways`` discovery op, and — when its gateway dies mid-stream —
+    fails over by REPLAYING idempotent in-flight ``generate`` calls on
+    a survivor (streamed tokens de-duplicated by offset, so
+    ``on_tokens`` sees each token exactly once).  ``max_failovers``
+    bounds the replays per call; 0 disables failover entirely (the
+    single-gateway behavior of old)."""
+
+    def __init__(self, addr: Union[str, Sequence[str]], token: str = "",
+                 timeout: float = 120.0, connect_timeout: float = 10.0,
+                 max_failovers: int = 2):
+        addrs = [addr] if isinstance(addr, str) else list(addr)
+        if not addrs:
+            raise ValueError("FleetClient needs at least one gateway "
+                             "address")
+        self.addr = addrs[0]
         self.timeout = timeout
+        self.connect_timeout = float(connect_timeout)
+        self.max_failovers = int(max_failovers)
+        self._token = token
         self.log = get_logger("tfmesos_tpu.fleet.client")
-        self._mux = MuxConnection(addr, token,
-                                  connect_timeout=connect_timeout)
+        self._mlock = threading.Lock()
+        self._addrs: List[str] = addrs
+        self._mux: Optional[MuxConnection] = None
+        self._closed = False
+        # Dial eagerly (constructor-raises-on-unreachable is the
+        # contract tests and tfserve rely on), trying each address.
+        self._connection()
+
+    # -- connection management ---------------------------------------------
+
+    @property
+    def addrs(self) -> List[str]:
+        """The currently known gateway set (discovery-refreshed)."""
+        with self._mlock:
+            return list(self._addrs)
+
+    def _connection(self) -> MuxConnection:
+        """The live mux, dialing down the known-gateway list if the
+        current one is gone.  Raises the last dial error when every
+        address fails.  Dials happen OUTSIDE the lock: a blocked
+        connect (up to connect_timeout per dead address) must not
+        stall every other caller — including close() — on the lock; a
+        dial race keeps the first registered connection and closes the
+        loser."""
+        with self._mlock:
+            if self._closed:
+                raise ConnectionLost("client closed")
+            mux = self._mux
+            if mux is not None and not mux.closed:
+                return mux
+            addrs = list(self._addrs)
+        last: Optional[Exception] = None
+        for a in addrs:
+            try:
+                mux = MuxConnection(a, self._token,
+                                    connect_timeout=self.connect_timeout)
+            except OSError as e:
+                last = e
+                continue
+            with self._mlock:
+                if self._closed:
+                    mux.close()
+                    raise ConnectionLost("client closed")
+                cur = self._mux
+                if cur is not None and not cur.closed:
+                    mux.close()     # lost the race; use the winner
+                    return cur
+                self._mux = mux
+                self.addr = a
+            return mux
+        raise ConnectionLost(
+            f"no gateway reachable among {addrs}: {last}")
+
+    def _drop(self, mux: MuxConnection) -> None:
+        """Forget a dead connection and rotate its address to the back
+        of the list so the next dial tries a different gateway first."""
+        with self._mlock:
+            if self._mux is mux:
+                self._mux = None
+            if mux.addr in self._addrs and len(self._addrs) > 1:
+                self._addrs.remove(mux.addr)
+                self._addrs.append(mux.addr)
+        mux.close()
+
+    def _refresh_gateways(self) -> None:
+        """Best-effort discovery: merge the gateway's own view of the
+        fleet's front doors into ours (new gateways become failover
+        targets without a client restart)."""
+        try:
+            mux = self._connection()
+            reply = mux.call({"op": "gateways"}, timeout=5.0)
+        except Exception:
+            return
+        if not isinstance(reply, dict):
+            return
+        got = reply.get("gateways")
+        if not isinstance(got, list):
+            return
+        fresh = [a for a in got if isinstance(a, str) and a]
+        if not fresh:
+            return
+        with self._mlock:
+            known = set(self._addrs)
+            self._addrs.extend(a for a in fresh if a not in known)
+
+    def gateways(self, timeout: float = 10.0) -> List[str]:
+        """The fleet's registered gateway addresses (the ``gateways``
+        discovery op — ``tfserve gateways``)."""
+        reply = self._connection().call({"op": "gateways"},
+                                        timeout=timeout)
+        if isinstance(reply, dict) and isinstance(
+                reply.get("gateways"), list):
+            return [a for a in reply["gateways"] if isinstance(a, str)]
+        return []
+
+    # -- requests ----------------------------------------------------------
 
     def generate(self, prompt, max_new_tokens: int,
                  stop_token: Optional[int] = None,
                  timeout: Optional[float] = None,
                  priority: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
-                 trace=None) -> Dict[str, Any]:
+                 trace=None,
+                 on_tokens: Optional[Callable[[List[int]], None]] = None
+                 ) -> Dict[str, Any]:
         """One generation request; returns the completion dict
         (``tokens``, ``ttft_ms``, ``total_ms``, ``trace_id``).  Raises
         ``Overloaded`` on shed, :class:`RequestFailed` on any other
@@ -223,7 +403,12 @@ class FleetClient:
         to supply the trace id yourself; every request is
         summary-traced regardless, and the reply's ``trace_id`` (also
         set on raised ``Overloaded``/``RequestFailed`` exceptions)
-        fetches the waterfall via :meth:`trace` / ``tfserve trace``."""
+        fetches the waterfall via :meth:`trace` / ``tfserve trace``.
+        ``on_tokens(new_tokens)`` streams the completion INCREMENTALLY:
+        called (from the reader thread — do not block) with each fresh
+        chunk as the replica's batcher emits it, exactly-once per token
+        even across a mid-stream gateway failover; the returned
+        completion still carries the full list."""
         msg = {"op": "generate", "prompt": [int(t) for t in prompt],
                "max_new_tokens": int(max_new_tokens),
                "stop_token": stop_token}
@@ -236,9 +421,71 @@ class FleetClient:
             msg["deadline_ms"] = float(deadline_ms)
         if trace is not None and trace is not False:
             msg["trace"] = str(trace) if isinstance(trace, str) else True
-        reply = self._mux.call(
-            msg, timeout=timeout if timeout is not None else self.timeout)
+
+        on_partial = None
+        if on_tokens is not None:
+            msg["stream"] = True
+            # Exactly-once across retries/replays: a replayed request
+            # re-streams from offset 0 (deterministic completions), so
+            # only tokens past the high-water mark reach the caller.
+            seen = [0]
+            lock = threading.Lock()
+
+            def on_partial(frame) -> None:
+                toks = frame.get("tokens")
+                if not isinstance(toks, list) or not toks:
+                    return
+                off = frame.get("off")
+                off = int(off) if isinstance(off, (int, float)) \
+                    and not isinstance(off, bool) else 0
+                with lock:
+                    start = max(0, seen[0] - off)
+                    new = toks[start:]
+                    if not new:
+                        return
+                    seen[0] = max(seen[0], off + len(toks))
+                    # Deliver INSIDE the lock: it is this stream's own
+                    # lock (never contended across requests), and
+                    # releasing first would let a failover's new reader
+                    # overtake a preempted old one — out-of-order
+                    # chunks at the caller.
+                    on_tokens([int(t) for t in new])
+
+        timeout = timeout if timeout is not None else self.timeout
+        reply = None
+        for attempt in range(self.max_failovers + 1):
+            mux = self._connection()
+            try:
+                reply = mux.call(msg, timeout=timeout,
+                                 on_partial=on_partial)
+                break
+            except ConnectionLost as e:
+                # The gateway died with this call in flight (or before
+                # it could be sent).  generate is idempotent — nothing
+                # was delivered, completions are deterministic, and
+                # streamed tokens de-dup by offset — so REPLAY it on a
+                # surviving gateway.  A deliberate client close() is
+                # NOT a gateway death: never replay a cancelled call.
+                if self._closed:
+                    raise
+                self._drop(mux)
+                if attempt >= self.max_failovers:
+                    raise
+                self.log.warning(
+                    "gateway %s lost mid-request (%s); failing over "
+                    "(attempt %d/%d)", mux.addr, e, attempt + 1,
+                    self.max_failovers)
+                self._refresh_gateways()
         if isinstance(reply, dict) and reply.get("op") == "completion":
+            if on_partial is not None:
+                # The final completion carries the FULL token list;
+                # feeding it through the same offset de-dup emits
+                # exactly the not-yet-streamed tail (a row that
+                # finishes inside a decode block streams its last
+                # chunk only here — and an old non-streaming replica
+                # degenerates to one on_tokens call with everything).
+                on_partial({"tokens": reply.get("tokens") or [],
+                            "off": 0})
             return reply
         kind = reply.get("kind", "error") if isinstance(reply, dict) else "error"
         error = reply.get("error", repr(reply)) if isinstance(reply, dict) \
@@ -266,14 +513,15 @@ class FleetClient:
             msg["slowest"] = int(slowest)
         elif failed:
             msg["failed"] = True
-        reply = self._mux.call(msg, timeout=timeout)
+        reply = self._connection().call(msg, timeout=timeout)
         if isinstance(reply, dict):
             return reply.get("traces") or []
         return []
 
     def metrics(self, timeout: float = 10.0) -> Dict[str, Any]:
         """The gateway's live metrics snapshot."""
-        reply = self._mux.call({"op": "metrics"}, timeout=timeout)
+        reply = self._connection().call({"op": "metrics"},
+                                        timeout=timeout)
         return reply.get("snapshot", {})
 
     def rollout(self, weights_version: str,
@@ -282,10 +530,12 @@ class FleetClient:
         control op and block until it completes (a rollout spans a full
         tier's warmup plus the old tier's drain — size ``timeout``
         accordingly).  Returns the gateway's summary dict; raises
-        :class:`RequestFailed` (kind ``rollout_failed``) on abort."""
-        reply = self._mux.call({"op": "rollout",
-                                "weights_version": str(weights_version)},
-                               timeout=timeout)
+        :class:`RequestFailed` (kind ``rollout_failed``) on abort.
+        NEVER replayed on failover: a rollout is not idempotent (the
+        second attempt would race the first's drains)."""
+        reply = self._connection().call(
+            {"op": "rollout", "weights_version": str(weights_version)},
+            timeout=timeout)
         if isinstance(reply, dict) and reply.get("op") == "rollout":
             return reply
         kind = reply.get("kind", "error") if isinstance(reply, dict) \
@@ -296,10 +546,20 @@ class FleetClient:
 
     @property
     def outstanding(self) -> int:
-        return self._mux.outstanding
+        with self._mlock:
+            mux = self._mux
+        return mux.outstanding if mux is not None else 0
 
     def close(self) -> None:
-        self._mux.close()
+        """Terminal: in-flight calls fail with ConnectionLost (never
+        replayed — a cancelled call must not resurrect the
+        connection), and later calls raise instead of re-dialing."""
+        with self._mlock:
+            self._closed = True
+            mux = self._mux
+            self._mux = None
+        if mux is not None:
+            mux.close()
 
     def __enter__(self) -> "FleetClient":
         return self

@@ -1,19 +1,32 @@
 """The fleet's TCP front door.
 
-A threaded accept loop feeds per-connection reader threads; readers
-apply admission control inline (so a shed costs one queue check, not a
+The I/O plane is a :class:`~tfmesos_tpu.wire.WireServer` — ONE
+selector-driven event loop carries every client connection (accept,
+incremental Framer reads, buffered non-blocking writes), which is what
+lifts the concurrent-connection ceiling from "one OS thread per client"
+to "one fd per client" (docs/SERVING.md "Front-door scaling").  Request
+EXECUTION keeps the worker-pool handoff: the loop-thread handler only
+applies admission control (a shed costs one queue check, not a
 dispatcher slot) and admitted requests wait in the bounded ingress
 queue for one of ``workers`` dispatcher threads, which route them to a
-replica and relay the completion back on the client's connection.
+replica and relay the completion back through the connection's
+thread-safe buffered ``send``.
+
+A fleet may run N gateways over ONE shared registry/router view
+(``tfserve --gateways N``): each is stateless — any gateway can serve
+any client — and registers its address for the ``gateways`` discovery
+op, which clients use to find failover targets
+(:class:`~tfmesos_tpu.fleet.client.FleetClient` replays idempotent
+in-flight requests on a survivor when its gateway dies mid-stream).
 
 Wire surface (all frames HMAC-authenticated with the cluster token):
 
 * ``{"op": "generate", "id", "prompt", "max_new_tokens", "stop_token",
-  "priority", "deadline_ms"}`` → ``{"op": "completion", "id", "tokens",
-  "ttft_ms", "total_ms"}`` or ``{"op": "error", "id", "kind", "error"}``
-  with ``kind`` one of ``overloaded`` / ``rate_limited`` (admission
-  shed — back off), ``unavailable`` (no replica within the retry
-  budget), ``bad_request``, ``deadline_exceeded`` (the request's
+  "priority", "deadline_ms", "stream"}`` → ``{"op": "completion", "id",
+  "tokens", "ttft_ms", "total_ms"}`` or ``{"op": "error", "id", "kind",
+  "error"}`` with ``kind`` one of ``overloaded`` / ``rate_limited``
+  (admission shed — back off), ``unavailable`` (no replica within the
+  retry budget), ``bad_request``, ``deadline_exceeded`` (the request's
   end-to-end budget ran out — shed in the admission queue, failed fast
   by the router, or cancelled inside a replica's batcher; never
   retried).  ``deadline_ms`` (optional) is the request's END-TO-END
@@ -30,6 +43,13 @@ Wire surface (all frames HMAC-authenticated with the cluster token):
   allocation pressure (docs/SERVING.md "Priorities, preemption &
   migration").  Unlabeled requests take the first-listed (default)
   class.
+  ``stream`` (optional) asks for PER-TOKEN incremental replies: the
+  completion's tokens are flushed as the replica's batcher emits them,
+  as interleaved ``{"op": "tokens", "id", "off", "tokens"}`` frames
+  (``off`` = tokens already streamed — the de-dup key across retries
+  and failovers), followed by the usual final completion carrying the
+  FULL list.  Old clients that never set it see exactly the old
+  one-reply protocol.
   ``trace`` (optional) asks for FULL span detail on this request's
   trace: ``true`` under a gateway-minted id, a string to supply the
   trace id; every request gets an always-on summary trace regardless,
@@ -37,6 +57,10 @@ Wire surface (all frames HMAC-authenticated with the cluster token):
   fetch the waterfall later with the ``trace`` op (docs/SERVING.md
   "Observability").
 * ``{"op": "metrics", "id"}`` → ``{"op": "metrics", "id", "snapshot"}``.
+* ``{"op": "gateways", "id"}`` → ``{"op": "gateways", "id",
+  "gateways": [addr, ...]}`` — the registered front doors of this
+  fleet (client-side discovery for multi-gateway failover;
+  ``tfserve gateways``).
 * ``{"op": "trace", "id", "trace_id"? | "slowest": N? | "failed":
   true?, "limit"?}`` → ``{"op": "trace", "id", "traces": [...]}`` —
   one trace by id (full record), the N slowest, the newest failures,
@@ -56,10 +80,9 @@ streaming shape the replicas themselves speak.
 
 from __future__ import annotations
 
-import socket
 import threading
 import time
-from typing import Any, Dict, Optional, Set
+from typing import Any, Dict, Optional
 
 from tfmesos_tpu import wire
 from tfmesos_tpu.fleet.admission import (AdmissionController,
@@ -73,31 +96,6 @@ from tfmesos_tpu.utils.logging import get_logger
 __all__ = ["Gateway"]
 
 
-class _Client:
-    """One accepted client connection: a socket plus a write lock (the
-    reader thread and any dispatcher may reply concurrently)."""
-
-    def __init__(self, sock: socket.socket, token: str):
-        self.sock = sock
-        self._token = token
-        self._lock = threading.Lock()
-
-    def send(self, msg: Dict[str, Any]) -> None:
-        """Best-effort reply; a vanished client is not an error the
-        serving path should care about."""
-        try:
-            with self._lock:
-                wire.send_msg(self.sock, msg, self._token)
-        except OSError:
-            pass
-
-    def close(self) -> None:
-        try:
-            self.sock.close()
-        except OSError:
-            pass
-
-
 class Gateway:
     """Accepts streaming requests, admits, routes, relays completions."""
 
@@ -105,7 +103,7 @@ class Gateway:
                  metrics: FleetMetrics, token: str = "",
                  host: str = "127.0.0.1", port: int = 0, workers: int = 8,
                  registry=None, tracebook: Optional[TraceBook] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, close_router: bool = True):
         self.router = router
         self.admission = admission
         self.metrics = metrics
@@ -126,17 +124,21 @@ class Gateway:
         self.port = int(port)
         self.workers = int(workers)
         self.registry = registry if registry is not None else router.registry
+        # N gateways share ONE router; only the last one standing may
+        # close it.  False = the fleet launcher owns the router's
+        # lifecycle (multi-gateway); True (default) keeps the
+        # single-gateway teardown of old.
+        self._close_router = bool(close_router)
         self.log = get_logger("tfmesos_tpu.fleet.gateway")
         self.addr: Optional[str] = None
         # The fleet control plane's rollout entry point (set by
         # FleetServer after bring-up): callable(version) -> info dict,
         # raising on abort.  None = this gateway has no rollout surface.
         self.rollout_fn = None
-        self._listen: Optional[socket.socket] = None
+        self._server: Optional[wire.WireServer] = None
         self._stop = threading.Event()
         self._threads = []
-        self._clients: Set[_Client] = set()
-        self._clients_lock = threading.Lock()
+        self.killed = False
         metrics.register_gauge("queue_depth", admission.depth)
         # Per-class depths: under a background flood the operator must
         # be able to see WHICH class is backed up (one global depth
@@ -169,79 +171,62 @@ class Gateway:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "Gateway":
-        self._listen = wire.bind_ephemeral(self.host, port=self.port)
-        advertise = None if self.host in ("0.0.0.0", "::") else self.host
-        self.addr = wire.sock_addr(self._listen, advertise_host=advertise)
+        self._server = wire.WireServer(
+            self._handle, token=self.token, host=self.host,
+            port=self.port, name="gateway",
+            advertise_host=(None if self.host in ("0.0.0.0", "::")
+                            else self.host)).start()
+        self.addr = self._server.addr
         self.log.info("fleet gateway listening on %s (%d workers, queue "
-                      "bound %d)", self.addr, self.workers,
-                      self.admission.max_queue)
-        t = threading.Thread(target=self._accept_loop,
-                             name="gateway-accept", daemon=True)
-        t.start()
-        self._threads = [t]
+                      "bound %d, event-loop I/O)", self.addr,
+                      self.workers, self.admission.max_queue)
+        self._threads = []
         for i in range(self.workers):
             w = threading.Thread(target=self._worker, name=f"gateway-w{i}",
                                  daemon=True)
             w.start()
             self._threads.append(w)
+        # Register this front door for client-side discovery (the
+        # `gateways` op): stateless gateways over one registry view are
+        # interchangeable, so any of them can hand out the full set.
+        if hasattr(self.registry, "register_gateway"):
+            self.registry.register_gateway(self.addr)
         return self
 
     def stop(self) -> None:
+        """Graceful stop: deregister from discovery, close the event
+        loop (clients see EOF), join the workers."""
+        if hasattr(self.registry, "unregister_gateway") \
+                and self.addr is not None:
+            self.registry.unregister_gateway(self.addr)
+        self._shutdown()
+        if self._close_router:
+            self.router.close()
+
+    def kill(self) -> None:
+        """Abrupt death (the bench's gateway 'SIGKILL'): connections
+        slam shut mid-stream, nothing deregisters — exactly what peers
+        of a SIGKILLed process observe.  The shared router/registry are
+        untouched (they belong to the surviving gateways)."""
+        self.killed = True
+        self._shutdown()
+
+    def _shutdown(self) -> None:
         self._stop.set()
-        # close() alone does not interrupt a blocked accept(): poke the
-        # listener awake so the accept thread exits NOW instead of
-        # burning its whole join timeout.
-        wire.wake_listener(self._listen)
-        if self._listen is not None:
-            try:
-                self._listen.close()
-            except OSError:
-                pass
-        with self._clients_lock:
-            clients = list(self._clients)
-            self._clients.clear()
-        for client in clients:
-            client.close()
+        if self._server is not None:
+            self._server.stop()
         for t in self._threads:
             t.join(timeout=2.0)
-        self.router.close()
+        self._threads = []
 
-    # -- ingress -----------------------------------------------------------
+    # -- ingress (runs on the event-loop thread: admit, never block) -------
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                sock, _ = self._listen.accept()
-            except OSError:
-                return
-            client = _Client(sock, self.token)
-            with self._clients_lock:
-                self._clients.add(client)
-            threading.Thread(target=self._serve_client, args=(client,),
-                             name="gateway-conn", daemon=True).start()
-
-    def _serve_client(self, client: _Client) -> None:
-        framer = wire.Framer(self.token)
-        sock = client.sock
-        try:
-            sock.settimeout(None)
-            for msg in wire.iter_msgs(sock, framer):
-                self._handle(client, msg)
-        except wire.WireError as e:
-            self.log.warning("dropping client connection: %s", e)
-        except OSError:
-            pass
-        finally:
-            client.close()
-            with self._clients_lock:
-                self._clients.discard(client)
-
-    def _handle(self, client: _Client, msg: Any) -> None:
-        # Raw frames never reach here: the gateway's framer rejects
-        # the raw bit at the length prefix (wire.Framer allow_raw
-        # default), which both keeps the public port's pre-auth
-        # buffering bound at MAX_FRAME and fails a misdirected
-        # call_raw fast (connection drop, never a timeout hang).
+    def _handle(self, client: "wire.WireConn", msg: Any) -> None:
+        # Raw frames never reach here: the gateway's WireServer rejects
+        # the raw bit at the length prefix (allow_raw defaults False),
+        # which both keeps the public port's pre-auth buffering bound
+        # at MAX_FRAME and fails a misdirected call_raw fast
+        # (connection drop, never a timeout hang).
         if not isinstance(msg, dict):
             return
         op = msg.get("op")
@@ -252,6 +237,15 @@ class Gateway:
         if op == "metrics":
             client.send({"op": "metrics", "id": cid,
                          "snapshot": self.metrics.snapshot()})
+            return
+        if op == "gateways":
+            reg = self.registry
+            if hasattr(reg, "gateway_addrs"):
+                addrs = reg.gateway_addrs()
+            else:
+                addrs = [self.addr] if self.addr else []
+            client.send({"op": "gateways", "id": cid,
+                         "gateways": addrs})
             return
         if op == "trace":
             # Authenticated read of the trace book: one trace by id,
@@ -293,9 +287,9 @@ class Gateway:
                 return
 
             def run_rollout() -> None:
-                # Off the reader thread: a rollout takes as long as a
-                # fleet's worth of warmups and drains, and blocking here
-                # would stall every other op on this client connection.
+                # Off the event-loop thread: a rollout takes as long as
+                # a fleet's worth of warmups and drains, and blocking
+                # here would stall EVERY connection, not just one.
                 try:
                     info = fn(version)
                 except Exception as e:
@@ -357,6 +351,11 @@ class Gateway:
                    # "deadline"): the router records its attempts here
                    # and stitches replica hop spans back in.
                    "_trace": tr}
+        if msg.get("stream"):
+            # Per-token streaming: the flag rides to the replica (whose
+            # batcher flushes token frames per block) and the worker
+            # installs the de-duplicating relay at dispatch.
+            forward["stream"] = True
         if deadline is not None:
             forward["deadline"] = deadline
         try:
@@ -410,6 +409,34 @@ class Gateway:
 
     # -- dispatch ----------------------------------------------------------
 
+    def _stream_relay(self, client: "wire.WireConn", cid):
+        """The per-request partial-frame relay: forwards each replica
+        ``tokens`` frame to the client re-keyed to ITS request id,
+        de-duplicated by stream offset — a retried/resumed attempt
+        re-streams from 0 (deterministic completions), and only tokens
+        past the high-water mark go out, each exactly once."""
+        sent = [0]
+
+        def emit(frame) -> None:
+            if isinstance(frame, wire.RawFrame):
+                return              # never a token frame
+            toks = frame.get("tokens")
+            if not isinstance(toks, list) or not toks:
+                return
+            off = frame.get("off")
+            off = int(off) if isinstance(off, (int, float)) \
+                and not isinstance(off, bool) else 0
+            prev = sent[0]
+            new = toks[max(0, prev - off):]
+            if not new or off + len(toks) <= prev:
+                return
+            sent[0] = off + len(toks)
+            self.metrics.inc("stream_chunks")
+            client.send({"op": "tokens", "id": cid,
+                         "off": prev, "tokens": new})
+
+        return emit
+
     def _worker(self) -> None:
         while not self._stop.is_set():
             item = self.admission.get(timeout=0.2)
@@ -429,6 +456,8 @@ class Gateway:
             # hop of every waterfall.
             tr.add("admission", "queue_wait", tr.rel_ms(t_enq), wait_ms,
                    cls=cls)
+            if forward.get("stream"):
+                forward["_emit"] = self._stream_relay(client, cid)
             try:
                 reply = self.router.route(forward)
             except Exception as e:

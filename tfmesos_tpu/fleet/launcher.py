@@ -103,6 +103,7 @@ class FleetServer:
                  replica_cpus: float = 1.0, replica_mem: float = 1024.0,
                  replica_chips: int = 0,
                  gateway_host: str = "127.0.0.1", gateway_port: int = 0,
+                 gateways: int = 1,
                  workers: int = 8, max_queue: int = 64,
                  rate: Optional[float] = None,
                  burst: Optional[float] = None,
@@ -189,6 +190,16 @@ class FleetServer:
         self.replica_chips = int(replica_chips)
         self.gateway_host = gateway_host
         self.gateway_port = int(gateway_port)
+        #: horizontal front-door scale (docs/SERVING.md "Front-door
+        #: scaling"): N stateless gateways over ONE shared
+        #: registry/router/admission view.  The first listens on
+        #: ``gateway_port``, the rest on OS-assigned ports; all
+        #: register for the ``gateways`` discovery op, and
+        #: FleetClient fails over between them.
+        self.n_gateways = int(gateways)
+        if self.n_gateways < 1:
+            raise ValueError(
+                f"gateways must be >= 1, got {gateways}")
         self.workers = int(workers)
         self.max_queue = int(max_queue)
         self.rate = rate
@@ -237,6 +248,8 @@ class FleetServer:
         self.router: Optional[Router] = None
         self.admission: Optional[AdmissionController] = None
         self.gateway: Optional[Gateway] = None
+        #: every running front door (``gateway`` is ``gateways[0]``).
+        self.gateways: List[Gateway] = []
         self.scheduler: Optional[TPUMesosScheduler] = None
         self.autoscaler: Optional[FleetAutoscaler] = None
         #: per-tier replica targets — what the control plane WANTS; the
@@ -309,12 +322,22 @@ class FleetServer:
                 burst=self.burst, classes=self.priority_classes)
             self.tracebook = TraceBook(sample=self.trace_sample,
                                        slow_ms=self.trace_slow_ms)
-            self.gateway = Gateway(self.router, self.admission,
-                                   self.metrics, token=self.token,
-                                   host=self.gateway_host,
-                                   port=self.gateway_port,
-                                   workers=self.workers,
-                                   tracebook=self.tracebook).start()
+            # N stateless gateways over the ONE registry/router/
+            # admission/tracebook view: any gateway serves any client,
+            # so the set is purely a connection-capacity and failure-
+            # isolation multiplier.  The shared router's lifecycle is
+            # the launcher's (close_router=False) — a stopping gateway
+            # must not tear down its siblings' replica links.
+            self.gateways = []
+            for i in range(self.n_gateways):
+                gw = Gateway(self.router, self.admission, self.metrics,
+                             token=self.token, host=self.gateway_host,
+                             port=self.gateway_port if i == 0 else 0,
+                             workers=self.workers, registry=self.registry,
+                             tracebook=self.tracebook,
+                             close_router=False).start()
+                self.gateways.append(gw)
+            self.gateway = self.gateways[0]
             if self.metrics_port is not None:
                 self._metrics_http = self.metrics.start_http_server(
                     self.metrics_port)
@@ -338,7 +361,8 @@ class FleetServer:
                     for _ in range(n):
                         self.launch_replica(role)
             self._wait_replicas()
-            self.gateway.rollout_fn = self.rollout
+            for gw in self.gateways:
+                gw.rollout_fn = self.rollout
             if self.autoscale:
                 self.autoscaler = FleetAutoscaler(
                     self, self.autoscale_config).start()
@@ -348,8 +372,10 @@ class FleetServer:
         self._started = True
         if self.report_interval:
             self.metrics.start_reporter(self.log, self.report_interval)
-        self.log.info("fleet up: gateway %s, %d replica(s) "
-                      "(%d unified / %d prefill / %d decode)%s", self.addr,
+        self.log.info("fleet up: gateway%s %s, %d replica(s) "
+                      "(%d unified / %d prefill / %d decode)%s",
+                      "s" if self.n_gateways > 1 else "",
+                      ", ".join(self.addrs),
                       self.total_replicas, self.replicas,
                       self.prefill_replicas, self.decode_replicas,
                       f", autoscaling within [{self.min_replicas}, "
@@ -616,8 +642,17 @@ class FleetServer:
     def addr(self) -> Optional[str]:
         return self.gateway.addr if self.gateway is not None else None
 
+    @property
+    def addrs(self) -> List[str]:
+        """Every front door's address (multi-gateway deployments)."""
+        return [gw.addr for gw in self.gateways if gw.addr]
+
     def client(self, timeout: float = 120.0) -> FleetClient:
-        return FleetClient(self.addr, self.token, timeout=timeout)
+        """A client over EVERY gateway: it spreads nothing (one
+        connection at a time) but fails over to a surviving gateway —
+        replaying idempotent in-flight generates — when its own dies."""
+        return FleetClient(self.addrs or [self.addr], self.token,
+                           timeout=timeout)
 
     def snapshot(self) -> dict:
         """The fleet metrics snapshot; the ``roles`` gauge carries each
@@ -638,9 +673,16 @@ class FleetServer:
             self._metrics_http.shutdown()
             self._metrics_http.server_close()
             self._metrics_http = None
-        if self.gateway is not None:
-            self.gateway.stop()
-            self.gateway = None
+        for gw in self.gateways:
+            if not gw.killed:
+                gw.stop()
+        self.gateways = []
+        self.gateway = None
+        # The gateways share the router (close_router=False); its
+        # links close exactly once, here.
+        if self.router is not None:
+            self.router.close()
+            self.router = None
         if self.scheduler is not None:
             self.scheduler.stop()
             self.scheduler = None

@@ -31,7 +31,6 @@ alive, exactly like the other optional heartbeat fields.
 from __future__ import annotations
 
 import dataclasses
-import socket
 import threading
 import time
 from typing import Dict, List, Optional
@@ -129,9 +128,14 @@ class ReplicaRegistry:
         self._clock = clock
         self.log = get_logger("tfmesos_tpu.fleet.registry")
         self.addr: Optional[str] = None
-        self._listen: Optional[socket.socket] = None
+        self._server: Optional[wire.WireServer] = None
         self._table: Dict[str, ReplicaInfo] = {}
-        self._conns: Dict[str, socket.socket] = {}
+        self._conns: Dict[str, object] = {}
+        # Registered fleet front doors (the `gateways` discovery op):
+        # each Gateway registers its addr at start and removes it on a
+        # GRACEFUL stop — a killed gateway stays listed (discovery is
+        # best-effort; client failover skips dead entries itself).
+        self._gateways: Dict[str, bool] = {}
         # Membership version + cached routable views: bumped ONLY when
         # the set a router pick iterates could change (entry add/evict,
         # state or role transition) — NOT on per-beat field refreshes
@@ -161,87 +165,73 @@ class ReplicaRegistry:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "ReplicaRegistry":
-        self._listen = wire.bind_ephemeral(self.host)
-        advertise = None if self.host in ("0.0.0.0", "::") else self.host
-        self.addr = wire.sock_addr(self._listen, advertise_host=advertise)
-        self.log.info("replica registry listening on %s", self.addr)
-        t = threading.Thread(target=self._accept_loop,
-                             name="registry-accept", daemon=True)
-        t.start()
+        # The intake is a WireServer event loop: every heartbeat
+        # connection of the whole fleet rides ONE selector thread
+        # instead of one blocked-in-recv thread per replica — at
+        # 1000-replica scale the thread-per-connection registry was the
+        # second front-door ceiling after the gateway (docs/SERVING.md
+        # "Front-door scaling").
+        self._server = wire.WireServer(
+            self._on_msg, token=self.token, host=self.host,
+            name="registry", on_close=self._on_conn_close,
+            advertise_host=(None if self.host in ("0.0.0.0", "::")
+                            else self.host)).start()
+        self.addr = self._server.addr
+        self.log.info("replica registry listening on %s (event-loop "
+                      "I/O)", self.addr)
         s = threading.Thread(target=self._sweep_loop,
                              name="registry-sweep", daemon=True)
         s.start()
-        self._threads = [t, s]
+        self._threads = [s]
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        # close() alone does not interrupt a blocked accept(): poke the
-        # listener awake so the accept thread exits NOW instead of
-        # burning its whole join timeout.
-        wire.wake_listener(self._listen)
-        if self._listen is not None:
-            try:
-                self._listen.close()
-            except OSError:
-                pass
+        if self._server is not None:
+            self._server.stop()
         with self._lock:
-            conns = list(self._conns.values())
             self._conns.clear()
-        for conn in conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
         for t in self._threads:
             t.join(timeout=2.0)
 
     # -- heartbeat intake --------------------------------------------------
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._listen.accept()
-            except OSError:
-                return      # listener closed
-            threading.Thread(target=self._conn_loop, args=(conn,),
-                             name="registry-conn", daemon=True).start()
+    def _on_msg(self, conn, msg) -> None:
+        """Event-loop handler: apply one frame to the table.  A bad
+        frame (wrong token, oversize) never reaches here — the
+        WireServer's Framer rejects it and drops the connection, same
+        pre-auth discipline as the threaded loop had."""
+        addr = self.observe(msg, conn)
+        if addr is not None:
+            # Remember which replica this connection speaks for, so its
+            # EOF can be attributed (the earliest death signal).
+            conn.replica_addr = addr
 
-    def _conn_loop(self, conn: socket.socket) -> None:
-        framer = wire.Framer(self.token)
-        addr: Optional[str] = None
-        try:
-            for msg in wire.iter_msgs(conn, framer):
-                addr = self.observe(msg, conn) or addr
-        except wire.WireError as e:
-            self.log.warning("rejecting heartbeat connection: %s", e)
-        except OSError:
-            pass
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            if addr is not None and not self._stop.is_set():
-                # The heartbeat connection lives INSIDE the replica
-                # process; its EOF is the earliest death signal we get —
-                # far ahead of the heartbeat timeout.  (A reconnecting
-                # replica re-registers through a new connection, which
-                # replaces this one in _conns first.)
-                with self._lock:
-                    stale = self._conns.get(addr) is conn
-                    if stale:
-                        del self._conns[addr]
-                if stale:
-                    self.mark_dead(addr, why="heartbeat connection closed")
+    def _on_conn_close(self, conn) -> None:
+        if self._stop.is_set():
+            return
+        addr = getattr(conn, "replica_addr", None)
+        if addr is None:
+            return
+        # The heartbeat connection lives INSIDE the replica process;
+        # its EOF is the earliest death signal we get — far ahead of
+        # the heartbeat timeout.  (A reconnecting replica re-registers
+        # through a new connection, which replaces this one in _conns
+        # first.)
+        with self._lock:
+            stale = self._conns.get(addr) is conn
+            if stale:
+                del self._conns[addr]
+        if stale:
+            self.mark_dead(addr, why="heartbeat connection closed")
 
-    def observe(self, msg,
-                conn: Optional[socket.socket] = None) -> Optional[str]:
+    def observe(self, msg, conn=None) -> Optional[str]:
         """Apply one registry message (``hello`` / ``heartbeat`` /
         ``drain``) to the table.  The wire path calls this per received
-        frame; the fleet simulator calls it directly with ``conn=None``
-        — beats from simulated replicas run the exact same table
-        logic, fences and all."""
+        frame (``conn`` is the event loop's ``WireConn``); the fleet
+        simulator calls it directly with ``conn=None`` — beats from
+        simulated replicas run the exact same table logic, fences and
+        all."""
         if not isinstance(msg, dict):
             return None
         addr = msg.get("addr")
@@ -506,6 +496,26 @@ class ReplicaRegistry:
                                           "versions": {}})
                 d["target"] = target
         return out
+
+    def register_gateway(self, addr: str) -> None:
+        """Record one fleet front door for client-side discovery (the
+        gateway's ``gateways`` op hands the set out; multi-gateway
+        failover dials down it)."""
+        with self._lock:
+            self._gateways[addr] = True
+        self.log.info("gateway %s registered", addr)
+
+    def unregister_gateway(self, addr: str) -> None:
+        """Graceful gateway stop: leave the discovery set.  A KILLED
+        gateway never calls this — its stale entry is harmless
+        (clients skip unreachable addresses while failing over)."""
+        with self._lock:
+            self._gateways.pop(addr, None)
+
+    def gateway_addrs(self) -> List[str]:
+        """The registered front doors, stable order."""
+        with self._lock:
+            return sorted(self._gateways)
 
     def set_target(self, role: str, n: Optional[int]) -> None:
         """Record the control plane's WANTED replica count for one tier

@@ -167,6 +167,12 @@ class ReplicaServer:
             conns = list(self._conns)
             self._conns.clear()
         for conn in conns:  # unblock reader threads; peers see EOF
+            # shutdown BEFORE close: our own reader thread is blocked
+            # in recv on this socket, and close() alone neither wakes
+            # it nor sends the peer its FIN until that recv returns —
+            # a stopping replica's in-flight callers would ride their
+            # full timeouts instead of failing over promptly.
+            wire.shutdown_socket(conn)
             try:
                 conn.close()
             except OSError:
@@ -257,6 +263,16 @@ class ReplicaServer:
                 self._outstanding -= 1
             self._send(conn, send_lock, out)
 
+        def partial(out) -> None:
+            # Streaming side channel: PARTIAL frames (op: tokens) may
+            # precede the single final reply — they share the
+            # connection's send lock but never consume the single-shot
+            # guard or the outstanding count.
+            if done.is_set():
+                return
+            self._send(conn, send_lock, out)
+
+        reply.partial = partial
         try:
             self.handler(msg, reply)
         except Exception as e:      # handler bug: fail THIS request only
@@ -434,6 +450,20 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                 priority=int(prio) if prio is not None else 0,
                 deadline_ms=_deadline_ms(head))
             req.trace = tr      # the batcher records its events here
+            send_partial = getattr(reply, "partial", None)
+            if head.get("stream") and send_partial is not None:
+                # Per-token incremental replies: the batcher's serve
+                # loop flushes each decode block's new tokens through
+                # this callback as ``op: tokens`` frames carrying their
+                # stream OFFSET — the gateway (and a failover replay)
+                # de-duplicates by it, and the final completion still
+                # carries the full list, so non-streaming peers see no
+                # difference (docs/SERVING.md "Front-door scaling").
+                def on_tokens(toks, off, _mid=mid):
+                    send_partial({"op": "tokens", "id": _mid,
+                                  "off": int(off), "tokens": toks})
+
+                req.on_tokens = on_tokens
             if raw:
                 prefilled = serving_mod.unpack_prefilled(head, msg.body)
                 batcher.validate(Prefilled(req, prefilled))

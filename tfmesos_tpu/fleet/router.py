@@ -401,10 +401,11 @@ class Router:
         only, absolute clocks never cross the wire."""
         tr = tracing.current()
         if deadline is None and tr is None \
-                and "deadline" not in msg and "_trace" not in msg:
+                and "deadline" not in msg and "_trace" not in msg \
+                and "_emit" not in msg:
             return msg
         out = {k: v for k, v in msg.items()
-               if k not in ("deadline", "_trace")}
+               if k not in ("deadline", "_trace", "_emit")}
         if deadline is not None:
             out["deadline_ms"] = round(
                 max(1.0, (deadline - self._clock()) * 1000.0), 3)
@@ -693,6 +694,8 @@ class Router:
         wv = wv if isinstance(wv, str) and wv else ""
         deadline = self._deadline_of(msg)
 
+        emit = msg.get("_emit")
+
         def build_call(m):
             out = {k: v for k, v in m.items()
                    if k not in ("op", "id", "gen", "weights_version",
@@ -701,6 +704,12 @@ class Router:
                        max_new_tokens=msg.get("max_new_tokens"),
                        stop_token=msg.get("stop_token"),
                        priority=msg.get("priority"))
+            if msg.get("stream"):
+                # The resume target re-streams from offset 0 (its
+                # imported row carries the already-emitted prefix);
+                # the gateway's offset de-dup keeps the client stream
+                # exactly-once.
+                out["stream"] = True
             return out
 
         call = build_call(meta)
@@ -717,9 +726,14 @@ class Router:
             timeout = self._call_timeout(deadline,
                                          attempt >= self.max_retries)
             try:
-                reply = self._link(addr).call_raw(
-                    self._wire_msg(call, deadline), body,
-                    timeout=timeout)
+                if emit is not None:
+                    reply = self._link(addr).call_raw(
+                        self._wire_msg(call, deadline), body,
+                        timeout=timeout, on_partial=emit)
+                else:
+                    reply = self._link(addr).call_raw(
+                        self._wire_msg(call, deadline), body,
+                        timeout=timeout)
             except CallTimeout:
                 self._trace_attempt("resume", att0, addr, "timeout",
                                     clipped=timeout < self.request_timeout)
@@ -824,6 +838,15 @@ class Router:
         tried = set()
         deadline_cut = False
         prompt = msg.get("prompt") if isinstance(msg, dict) else None
+        # Streaming: the gateway's partial-frame emitter rides the
+        # forward as the internal `_emit` (stripped by _wire_msg); each
+        # attempt's partial token frames pass straight through to it,
+        # and the gateway's offset de-dup makes retries exactly-once.
+        # Passed CONDITIONALLY at every call site — link_factory
+        # substitutes (the simulator's _SimLink, test stubs) do not
+        # accept the on_partial kwarg, and unstreamed routing must not
+        # require them to.
+        emit = msg.get("_emit") if isinstance(msg, dict) else None
         for attempt in range(self.max_retries + 1):
             if deadline is not None and self._clock() >= deadline:
                 # Fail fast, at the loop head: the client has given up,
@@ -842,8 +865,12 @@ class Router:
                                          attempt >= self.max_retries)
             try:
                 link = self._link(addr)
-                reply = link.call(self._wire_msg(msg, deadline),
-                                  timeout=timeout)
+                if emit is not None:
+                    reply = link.call(self._wire_msg(msg, deadline),
+                                      timeout=timeout, on_partial=emit)
+                else:
+                    reply = link.call(self._wire_msg(msg, deadline),
+                                      timeout=timeout)
             except CallTimeout as e:
                 last = e
                 self._trace_attempt("attempt", att0, addr, "timeout",
@@ -1089,6 +1116,9 @@ class Router:
                     max_new_tokens=msg.get("max_new_tokens"),
                     stop_token=msg.get("stop_token"),
                     priority=msg.get("priority"))
+        if msg.get("stream"):
+            meta["stream"] = True
+        emit = msg.get("_emit")
         deadline = self._deadline_of(msg)
         last: Optional[BaseException] = None
         dtried: set = set()
@@ -1111,9 +1141,14 @@ class Router:
                                          attempt >= self.max_retries)
             try:
                 tm = t0 = self._clock()
-                reply = self._link(daddr).call_raw(
-                    self._wire_msg(meta, deadline), praw.body,
-                    timeout=timeout)
+                if emit is not None:
+                    reply = self._link(daddr).call_raw(
+                        self._wire_msg(meta, deadline), praw.body,
+                        timeout=timeout, on_partial=emit)
+                else:
+                    reply = self._link(daddr).call_raw(
+                        self._wire_msg(meta, deadline), praw.body,
+                        timeout=timeout)
                 self.metrics.observe(
                     "kv_decode_turnaround_ms",
                     (self._clock() - t0) * 1000.0)

@@ -565,6 +565,12 @@ class SimConfig:
     decode_replicas: int = 0
     capacity: int = 4
     kv_pages: int = 64
+    # N stateless gateway "fibers" over the ONE registry/router view —
+    # the sim analog of `tfserve --gateways N` (each front door gets
+    # its own AdmissionController + dispatch-worker fibers; arrivals
+    # round-robin across live fronts like clients spreading
+    # connections).  1 = the classic single-gateway topology, exactly.
+    gateways: int = 1
     workers: int = 8
     max_queue: int = DEFAULT_MAX_QUEUE
     rate_limit: Optional[float] = None
@@ -659,6 +665,21 @@ def parse_sweep(spec: str) -> Tuple[str, List[str]]:
 # -- the simulation harness --------------------------------------------------
 
 
+class _SimFront:
+    """One simulated gateway front door: its own WFQ admission
+    controller + idle dispatch-worker deque + alive flag.  Stateless
+    beyond its queues — any front serves any request, which is what
+    makes killing one a pure re-queue event."""
+
+    __slots__ = ("idx", "admission", "idle", "dead")
+
+    def __init__(self, idx: int, admission: AdmissionController):
+        self.idx = idx
+        self.admission = admission
+        self.idle: deque = deque()
+        self.dead = False
+
+
 class FleetSim:
     """One simulated fleet: the real control plane wired to virtual
     replicas.  Also implements the dynamic-fleet surface
@@ -678,10 +699,23 @@ class FleetSim:
         self.transport = SimTransport(eng)
         specs = [PriorityClass(n, weight=w, rank=r)
                  for n, w, r in cfg.classes]
-        self.admission = AdmissionController(
-            max_queue=cfg.max_queue, rate=cfg.rate_limit,
-            classes=specs, clock=eng.clock)
-        self.admission.on_expired = self._queue_expired
+        # Front doors: N stateless gateways over the one registry/
+        # router view (`tfserve --gateways N`).  Each gets its own
+        # AdmissionController (its WFQ queues) + idle-worker deque;
+        # specs are immutable and shared (WFQ state lives in the
+        # controller).  ``self.admission`` stays the FIRST front's
+        # controller — the single-gateway back-compat alias every
+        # existing scenario and test drives.
+        self.fronts: List[_SimFront] = []
+        for i in range(max(1, int(cfg.gateways))):
+            adm = AdmissionController(
+                max_queue=cfg.max_queue, rate=cfg.rate_limit,
+                classes=specs, clock=eng.clock)
+            adm.on_expired = self._queue_expired
+            self.fronts.append(_SimFront(i, adm))
+        self.admission = self.fronts[0].admission
+        self._rr = 0                # round-robin arrival spread
+        self.gateway_failovers = 0  # items replayed off a killed front
         self.budget = RetryBudget(cfg.budget_max_tokens,
                                   cfg.budget_token_ratio)
         self.router = Router(
@@ -712,7 +746,6 @@ class FleetSim:
         self.lost: List[BaseException] = []
         self._eps_s = 0.005
         self._next_rid = 0
-        self._idle: deque = deque()
         self._stopped = False
         # Hot-path histogram handles (one dict lookup per request
         # instead of name formatting + registry locks at 1M-request
@@ -860,18 +893,44 @@ class FleetSim:
             msg["deadline"] = deadline
         return msg, spec, now, deadline
 
-    def submit(self, req: Request, sink: Optional[list] = None) -> bool:
+    def _pick_front(self, front) -> Optional["_SimFront"]:
+        """The front door this arrival dials: an explicit index, or
+        round-robin over the LIVE fronts (clients spreading
+        connections); None when every front is dead."""
+        if front is not None:
+            f = self.fronts[front % len(self.fronts)]
+            return None if f.dead else f
+        n = len(self.fronts)
+        for _ in range(n):
+            f = self.fronts[self._rr % n]
+            self._rr += 1
+            if not f.dead:
+                return f
+        return None
+
+    def submit(self, req: Request, sink: Optional[list] = None,
+               front=None) -> bool:
         """Admit one request (shed bookkeeping mirrors the gateway);
-        True when admitted.  ``sink``, when given, receives ``(reply,
-        end_time)`` at completion — how a caller observes its OWN
-        request's outcome even when a different fiber dispatches it."""
+        truthy (the front served) when admitted.  ``sink``, when
+        given, receives ``(reply, end_time)`` at completion — how a
+        caller observes its OWN request's outcome even when a
+        different fiber dispatches it.  ``front`` pins a specific
+        gateway; default spreads round-robin over live fronts."""
+        f = self._pick_front(front)
         msg, spec, now, deadline = self._build(req)
         self.injected += 1
         m = self.metrics
         m.inc("received")
+        if f is None:
+            # Every front door is dead: the client's dial fails — an
+            # explicit connection error, never a hang.
+            m.inc("failed")
+            self.shed += 1
+            self.finished += 1
+            return False
         item = (msg, spec.name, now, deadline, sink)
         try:
-            self.admission.admit(item, cls=spec.name, deadline=deadline)
+            f.admission.admit(item, cls=spec.name, deadline=deadline)
         except DeadlineExceeded:
             m.inc("shed_deadline")
             self.shed += 1
@@ -889,13 +948,14 @@ class FleetSim:
             self.finished += 1
             return False
         m.inc("admitted")
-        return True
+        return f
 
     def _inject(self, req: Request) -> None:
         """Engine-context arrival: admit, then hand work to an idle
-        dispatch worker."""
-        if self.submit(req) and self._idle:
-            self.engine._resume(self._idle.popleft())
+        dispatch worker of the front that took it."""
+        f = self.submit(req)
+        if f and f.idle:
+            self.engine._resume(f.idle.popleft())
 
     def _queue_expired(self, item: tuple) -> None:
         """A queued request's deadline passed before dispatch — the
@@ -959,19 +1019,69 @@ class FleetSim:
 
     def start_workers(self, n: Optional[int] = None) -> None:
         """The dispatch pool (the gateway's worker-thread analog):
-        fibers that drain the WFQ queue and park when it empties."""
-        for i in range(n if n is not None else self.cfg.workers):
-            self.engine.spawn(self._worker_body, name=f"sim-worker-{i}")
+        PER-FRONT fibers that drain that front's WFQ queue and park
+        when it empties."""
+        per = n if n is not None else self.cfg.workers
+        for f in self.fronts:
+            for i in range(per):
+                self.engine.spawn(
+                    lambda f=f: self._worker_body(f),
+                    name=f"sim-gw{f.idx}-worker-{i}"
+                    if len(self.fronts) > 1 else f"sim-worker-{i}")
 
-    def _worker_body(self) -> None:
+    def _worker_body(self, front: Optional["_SimFront"] = None) -> None:
+        front = front or self.fronts[0]
         eng = self.engine
         while True:
-            item = self.admission.get(timeout=0)
+            if front.dead:
+                eng.park()          # a killed gateway's pool is gone
+                continue
+            item = front.admission.get(timeout=0)
             if item is None:
-                self._idle.append(eng._current)
+                front.idle.append(eng._current)
                 eng.park()
                 continue
             self.dispatch(item)
+
+    def kill_gateway(self, idx: int) -> int:
+        """Hard-kill one front door mid-traffic (the bench's gateway
+        SIGKILL analog): its dispatch pool stops, and every item still
+        QUEUED there is re-admitted on a surviving front — the
+        client-failover replay (idempotent requests, nothing was
+        delivered).  Returns how many items failed over.  Re-admission
+        sheds (a survivor at its bound) surface as explicit
+        ``overloaded`` answers, never silent losses."""
+        f = self.fronts[idx % len(self.fronts)]
+        if f.dead:
+            return 0
+        f.dead = True
+        moved = 0
+        while True:
+            item = f.admission.get(timeout=0)
+            if item is None:
+                break
+            msg, cls, t_enq, deadline, sink = item
+            target = self._pick_front(None)
+            if target is None:
+                self.metrics.inc("failed")
+                self.shed += 1
+                self.finished += 1
+                continue
+            try:
+                target.admission.admit(item, cls=cls, deadline=deadline)
+            except (Overloaded, DeadlineExceeded):
+                self.metrics.inc("shed_queue")
+                self.shed += 1
+                self.finished += 1
+                continue
+            moved += 1
+            if target.idle:
+                self.engine._resume(target.idle.popleft())
+        self.gateway_failovers += moved
+        self.metrics.inc("gateway_failovers", moved)
+        self.log.info("gateway %d killed; %d queued item(s) failed "
+                      "over", idx, moved)
+        return moved
 
     def feed(self, workload) -> None:
         """Schedule an open-arrival workload (lazily: one pending
@@ -1017,7 +1127,9 @@ class FleetSim:
                     break
                 t0 = self.engine.clock.now
                 done += 1
-                if not self.submit(req):
+                # Closed-loop feeders serve what they submit: pin to
+                # front 0 so net flow stays conserved per queue.
+                if not self.submit(req, front=0):
                     continue
                 item = self.admission.get(timeout=0)
                 if item is None:
@@ -1437,11 +1549,96 @@ def scenario_scale(overrides=(), n_requests: int = 1_000_000,
     return out
 
 
+def scenario_multi_gateway(overrides=(), n_requests: int = 6000,
+                           replicas: Optional[int] = None,
+                           seed: Optional[int] = None,
+                           workload=None,
+                           model_fit: Optional[dict] = None,
+                           cfg: Optional[SimConfig] = None
+                           ) -> Dict[str, Any]:
+    """The multi-gateway front door at sim scale (`tfserve --gateways
+    N`): arrivals spread round-robin over N gateway fronts sharing ONE
+    registry/router view; mid-run one front is HARD-KILLED and its
+    queued work fails over to the survivors (the client-replay analog)
+    — the scenario asserts the fleet answers every planned request
+    (zero lost) and reports per-front shed plus the failover count, so
+    ROADMAP item-2 policy constants (per-front queue bounds, worker
+    width) are sweepable at 1000-replica scale."""
+    cfg = _new_cfg(cfg, overrides)
+    if cfg.gateways < 2:
+        # The scenario is ABOUT the multi-front topology: a lone front
+        # has nothing to fail over to.  Loud, so a sweep row labeled
+        # gateways=1 is never silently a 3-front run.
+        if any(p == "gateways" for p, _ in (overrides or ())):
+            raise ValueError(
+                f"the multi-gateway scenario needs gateways >= 2 "
+                f"(got {cfg.gateways}); sweep the steady scenario "
+                f"for a single-front baseline")
+        cfg.gateways = 3
+    if replicas is not None:
+        cfg.replicas = int(replicas)
+    if seed is not None:
+        cfg.seed = int(seed)
+    if model_fit:
+        for k, v in model_fit.items():
+            if hasattr(cfg.model, k):
+                setattr(cfg.model, k, v)
+    # Per-front pools must jointly cover the fleet's concurrency even
+    # AFTER one front dies: size each front's pool for the whole fleet
+    # divided by the surviving fronts.
+    cfg.workers = max(cfg.workers,
+                      min(128, (2 * cfg.replicas * cfg.capacity)
+                          // max(1, cfg.gateways - 1)))
+    sim = FleetSim(cfg)
+    for _ in range(cfg.replicas):
+        sim.add_replica(UNIFIED)
+    if workload is None:
+        _, per_req_s = cfg.model.service_s(64, 16, random.Random(0))
+        # Slightly OVER fleet capacity: queues stay primed, so the
+        # killed front demonstrably holds work that must fail over
+        # (an idle-queue kill would prove nothing).
+        rate = 1.2 * cfg.replicas * cfg.capacity / max(1e-9, per_req_s)
+        workload = SyntheticWorkload(
+            n_requests=n_requests, seed=cfg.seed, rate=rate,
+            class_mix={"interactive": 1.0, "background": 2.0},
+            prompt_len=64, new_tokens=16)
+    else:
+        rate = getattr(workload, "rate", 100.0)
+    sim.feed(workload)
+    sim.start_workers()
+    # SIGKILL one front door mid-traffic: at roughly the arrival
+    # stream's midpoint.
+    n = getattr(workload, "n_requests", n_requests)
+    t_kill = 0.5 * n / max(1e-9, rate)
+    killed_at: List[float] = []
+
+    def kill() -> None:
+        killed_at.append(sim.engine.clock.now)
+        sim.kill_gateway(1)
+
+    sim.engine.at(t_kill, kill)
+    t0 = time.perf_counter()
+    sim.engine.run(stop=sim.drained)
+    wall = time.perf_counter() - t0
+    out = sim.results(wall)
+    out.update({
+        "gateways": len(sim.fronts),
+        "gateway_killed_at": round(killed_at[0], 3) if killed_at
+        else None,
+        "gateway_failovers": sim.gateway_failovers,
+        "per_front_shed": [f.admission.shed_counts()
+                           for f in sim.fronts],
+    })
+    sim.stop()
+    return out
+
+
 SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "steady": scenario_steady,
     "surge": scenario_surge,
     "soak-replay": scenario_soak_replay,
     "scale": scenario_scale,
+    "multi-gateway": scenario_multi_gateway,
 }
 
 

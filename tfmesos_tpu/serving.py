@@ -159,6 +159,15 @@ class Request:
         # resume, deadline cancel, finish) onto it when present.  None
         # (the default) costs nothing.
         self.trace = None
+        # Incremental token streaming (docs/SERVING.md "Front-door
+        # scaling"): ``on_tokens(new_tokens, offset)`` is called from
+        # the serve loop once per decode block with the tokens emitted
+        # since the last call (``offset`` = tokens already streamed).
+        # The Completion still carries the full list — streaming is
+        # additive, and a raising callback costs the stream, never the
+        # request.  None (the default) costs one attribute read per
+        # block.
+        self.on_tokens = None
         self.deadline: Optional[float] = None
         if self.deadline_ms is not None:
             if not self.deadline_ms > 0:
@@ -338,6 +347,10 @@ class _Row:
     # allocated <= worst); in-block overshoot writes past it land on
     # sink columns of the table instead.
     limit: int = 0
+    # Incremental streaming (Request.on_tokens): how many of ``out``'s
+    # tokens have been flushed to the callback so far — the serve loop
+    # pushes the [streamed:] suffix once per block.
+    streamed: int = 0
 
 
 @dataclasses.dataclass
@@ -2684,6 +2697,10 @@ class ContinuousBatcher:
                                 burst, active, free_rows)
                             continue
                 yield from self._finalize_burst(burst, active, free_rows)
+                # Streaming flush point 1: freshly admitted rows' first
+                # tokens (prefill output) go out NOW — the streamed
+                # TTFT is the prefill latency, not prefill + one block.
+                self._flush_streams(active)
                 if not active:
                     if bad_request is not None:
                         raise bad_request
@@ -2711,6 +2728,12 @@ class ContinuousBatcher:
                         yield from self._step_overlap(active, free_rows)
                     else:
                         yield from self._step(active, free_rows)
+                    # Streaming flush point 2: this block's tokens, one
+                    # call per still-resident streaming row (rows that
+                    # FINISHED inside the block already yielded their
+                    # Completion — the full list — so their tail never
+                    # needs a partial).
+                    self._flush_streams(active)
         finally:
             # A consumer that stops early (break / close) must not leak
             # the in-flight rows' pages (or a stale overlap/pipelined
@@ -2725,6 +2748,30 @@ class ContinuousBatcher:
             # row the dying loop still owns.
             with self._export_lock:
                 self._loop_active = False
+
+    def _flush_streams(self, active: Dict[int, "_Row"]) -> None:
+        """Push each streaming row's not-yet-streamed ``out`` suffix to
+        its ``Request.on_tokens`` callback (per-token incremental
+        replies on the serving path).  Token STREAMS are not touched —
+        this only reads ``out`` — so every mode's equivalence contract
+        is unaffected; in the lagged modes (overlap/pipelined) tokens
+        stream when they RETIRE, exactly when the host learns them.  A
+        raising callback is disarmed: a broken consumer costs its
+        stream, never the request or the loop."""
+        for row in active.values():
+            cb = row.req.on_tokens
+            if cb is None:
+                continue
+            n = len(row.out)
+            if n <= row.streamed:
+                continue
+            chunk = [int(t) for t in row.out[row.streamed:n]]
+            off = row.streamed
+            row.streamed = n
+            try:
+                cb(chunk, off)
+            except Exception:
+                row.req.on_tokens = None
 
     def _ensure_sides(self, row: int, length: int) -> None:
         """Back ABSOLUTE positions [0, length) of ``row`` on the target

@@ -48,9 +48,11 @@ import hmac
 import hashlib
 import json
 import os
+import selectors
 import socket
 import struct
-from typing import Any, List, Optional
+import threading
+from typing import Any, Callable, List, Optional
 
 _LEN = struct.Struct(">I")
 TAG_SIZE = hashlib.sha256().digest_size  # 32
@@ -368,6 +370,24 @@ def wake_listener(sock: Optional[socket.socket]) -> None:
         pass
 
 
+def shutdown_socket(sock: Optional[socket.socket]) -> None:
+    """Abortively end a connection another thread may be blocked
+    reading: ``close()`` alone does NOT send the FIN (or wake the
+    reader) while a recv syscall still holds the socket's kernel
+    reference — the connection just sits half-alive until that recv
+    returns, so peers of an in-process ``stop()`` never saw EOF and
+    rode their full timeouts (the recv-side sibling of the
+    ``wake_listener`` accept pathology).  ``shutdown(SHUT_RDWR)``
+    tears the stream down NOW: the local reader unblocks and the peer
+    gets its EOF immediately.  Call before ``close()``."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+
+
 def sock_addr(sock: socket.socket, advertise_host: Optional[str] = None) -> str:
     host, port = sock.getsockname()[:2]
     if advertise_host:
@@ -375,3 +395,370 @@ def sock_addr(sock: socket.socket, advertise_host: Optional[str] = None) -> str:
     elif host in ("0.0.0.0", "::"):
         host = socket.gethostbyname(socket.gethostname())
     return f"{host}:{port}"
+
+
+# -- the event-loop serve core ----------------------------------------------
+#
+# The thread-per-connection accept loops (one OS thread blocked in recv
+# per peer) cap the front door at tens-to-hundreds of concurrent client
+# links — the same shape as the reference's one-scheduler-process
+# rendezvous server.  WireServer multiplexes EVERY connection of one
+# listener onto a single selector-driven thread: the Framer already
+# parses incrementally (byte-at-a-time if it must), so reads are
+# non-blocking feeds, writes are buffered and flushed as the socket
+# drains, and the HMAC / raw-bit / pre-auth-bound discipline is exactly
+# the Framer's.  The threaded connect/send_msg/recv_msg CLIENT api
+# stays for low-fanout links (scheduler rendezvous, heartbeats, mux
+# links to replicas); only listeners that must scale (gateway,
+# registry) ride this.
+
+
+class WireConn:
+    """One accepted connection on a :class:`WireServer`.
+
+    ``send``/``send_raw`` may be called from ANY thread: frames append
+    to a per-connection write buffer and the event loop flushes them as
+    the socket drains — a slow reader therefore never blocks the caller
+    (a gateway worker) or the loop; past ``max_buffer`` of backlog the
+    connection is DROPPED instead (backpressure must bound memory, and
+    a peer that cannot keep up with its own replies is as good as
+    gone).  Handlers may stash per-connection state as plain attributes
+    (the registry keys heartbeat EOFs that way)."""
+
+    def __init__(self, server: "WireServer", sock: socket.socket,
+                 peer: str):
+        self._server = server
+        self._sock = sock
+        self.peer = peer
+        self._framer = Framer(server.token, allow_raw=server.allow_raw)
+        self._out = bytearray()
+        self._wlock = threading.Lock()
+        self._closed = False
+        self._close_after_flush = False
+        self._events = selectors.EVENT_READ
+        self.drop_reason: Optional[str] = None
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def send(self, obj: Any) -> bool:
+        """Queue one JSON frame; False when the connection is (being)
+        dropped.  Best-effort by design: a vanished client is not an
+        error the serving path should care about."""
+        return self._enqueue(encode(obj, self._server.token))
+
+    def send_raw(self, meta: Any, body) -> bool:
+        """Queue one raw binary frame (meta + body, HMAC-tagged)."""
+        header, mv = _raw_parts(meta, body, self._server.token)
+        return self._enqueue(header + bytes(mv))
+
+    def _enqueue(self, frame: bytes) -> bool:
+        hook = _chaos_send     # snapshot against a concurrent uninstall
+        if hook is not None:
+            try:
+                if hook(self._sock, frame):
+                    return True         # chaos drop: frame swallowed
+            except OSError:
+                self._server._request_close(self)   # chaos sever
+                return False
+        with self._wlock:
+            if self._closed:
+                return False
+            self._out += frame
+            over = len(self._out) > self._server.max_buffer
+        if over:
+            self.drop_reason = "write-buffer overflow (slow reader)"
+            self._server._request_close(self)
+            return False
+        self._server._mark_writable(self)
+        return True
+
+    def close(self) -> None:
+        """Flush whatever is already queued, then close (thread-safe)."""
+        self._close_after_flush = True
+        self._server._mark_writable(self)
+
+
+class WireServer:
+    """A selector-driven accept/read/dispatch/write loop over one
+    listening socket — the serve-side core the fleet gateway and the
+    replica registry ride (docs/SERVING.md "Front-door scaling").
+
+    ``handler(conn, msg)`` runs ON THE LOOP THREAD for every decoded
+    message (a dict, or a :class:`RawFrame` when ``allow_raw``) — it
+    must not block; hand real work to a pool and reply later via
+    ``conn.send`` (thread-safe, buffered).  A handler exception, a bad
+    frame (HMAC failure, oversized length, the raw bit on a
+    non-``allow_raw`` stream — all rejected by the Framer at the same
+    pre-auth bounds as the threaded path), or write-buffer overflow
+    drops THAT connection and nothing else.  ``on_close(conn)`` fires
+    once per dropped/closed connection (not at server stop).
+
+    The chaos hooks (:func:`set_chaos`) are consulted exactly like the
+    threaded path's: the send hook per queued frame, the recv hook per
+    read batch — so fault plans reach event-loop links too (a chaos
+    delay sleeps the loop thread; chaos is a test-only surface).
+
+    ``stop()`` wakes the loop through a self-pipe; :func:`wake_listener`
+    on the listening socket also unblocks it (the poke lands as an
+    accept event), so the threaded stop discipline keeps working."""
+
+    def __init__(self, handler: Callable[[WireConn, Any], None],
+                 token: str = "", host: str = "127.0.0.1", port: int = 0,
+                 allow_raw: bool = False, name: str = "wire-server",
+                 max_buffer: int = 64 * 1024 * 1024,
+                 on_close: Optional[Callable[[WireConn], None]] = None,
+                 advertise_host: Optional[str] = None):
+        self.handler = handler
+        self.token = token
+        self.host = host
+        self.port = int(port)
+        self.allow_raw = bool(allow_raw)
+        self.name = name
+        self.max_buffer = int(max_buffer)
+        self.on_close = on_close
+        self.advertise_host = advertise_host
+        self.addr: Optional[str] = None
+        self._listen: Optional[socket.socket] = None
+        self._sel: Optional[selectors.BaseSelector] = None
+        self._waker_r: Optional[socket.socket] = None
+        self._waker_w: Optional[socket.socket] = None
+        self._conns: set = set()
+        self._pending: set = set()          # conns with queued writes
+        self._pending_close: set = set()
+        self._plock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        from tfmesos_tpu.utils.logging import get_logger
+        self.log = get_logger("tfmesos_tpu.wire")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "WireServer":
+        self._listen = bind_ephemeral(self.host, port=self.port)
+        self._listen.setblocking(False)
+        adv = self.advertise_host or (
+            None if self.host in ("0.0.0.0", "::") else self.host)
+        self.addr = sock_addr(self._listen, advertise_host=adv)
+        self._sel = selectors.DefaultSelector()
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
+        self._sel.register(self._listen, selectors.EVENT_READ, "listen")
+        self._sel.register(self._waker_r, selectors.EVENT_READ, "waker")
+        self._thread = threading.Thread(target=self._loop, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the loop and close every connection.  Abrupt by design
+        (peers see the close, in-flight replies may be cut) — which is
+        also what makes it double as the bench's gateway 'SIGKILL'."""
+        self._stop.set()
+        self._wake()
+        # Belt and braces: the waker is the fast path, the accept poke
+        # is the one that must KEEP working (the fleet-wide stop
+        # discipline since the wake_listener fix).
+        wake_listener(self._listen)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def connections(self) -> List[WireConn]:
+        with self._plock:
+            return list(self._conns)
+
+    # -- cross-thread signaling --------------------------------------------
+
+    def _wake(self) -> None:
+        w = self._waker_w
+        if w is None:
+            return
+        try:
+            w.send(b"\0")
+        except OSError:
+            pass
+
+    def _mark_writable(self, conn: WireConn) -> None:
+        with self._plock:
+            self._pending.add(conn)
+        self._wake()
+
+    def _request_close(self, conn: WireConn) -> None:
+        with self._plock:
+            self._pending_close.add(conn)
+        self._wake()
+
+    # -- the loop ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        sel = self._sel
+        try:
+            while not self._stop.is_set():
+                # The waker (and wake_listener's accept poke) are what
+                # actually end the wait; the timeout is only the
+                # backstop if both ever fail.
+                for key, mask in sel.select(timeout=5.0):
+                    tag = key.data
+                    if tag == "listen":
+                        self._accept_ready()
+                    elif tag == "waker":
+                        try:
+                            while self._waker_r.recv(4096):
+                                pass
+                        except OSError:
+                            pass
+                    else:
+                        if mask & selectors.EVENT_READ:
+                            self._read_ready(tag)
+                        if mask & selectors.EVENT_WRITE \
+                                and not tag._closed:
+                            self._flush(tag)
+                self._service_pending()
+        finally:
+            with self._plock:
+                conns = list(self._conns)
+                self._conns.clear()
+                self._pending.clear()
+                self._pending_close.clear()
+            for conn in conns:
+                with conn._wlock:
+                    conn._closed = True
+                try:
+                    conn._sock.close()
+                except OSError:
+                    pass
+            for sock in (self._listen, self._waker_r, self._waker_w):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            try:
+                sel.close()
+            except OSError:
+                pass
+
+    def _service_pending(self) -> None:
+        with self._plock:
+            closes = list(self._pending_close)
+            self._pending_close.clear()
+            pend = list(self._pending)
+            self._pending.clear()
+        for conn in closes:
+            self._close_conn(conn, conn.drop_reason or "closed")
+        for conn in pend:
+            if not conn._closed:
+                self._flush(conn)
+
+    def _accept_ready(self) -> None:
+        while True:
+            try:
+                sock, peer = self._listen.accept()
+            except BlockingIOError:
+                return
+            except OSError:
+                return              # listener closed (stopping)
+            sock.setblocking(False)
+            conn = WireConn(self, sock, f"{peer[0]}:{peer[1]}"
+                            if isinstance(peer, tuple) else str(peer))
+            with self._plock:
+                self._conns.add(conn)
+            try:
+                self._sel.register(sock, selectors.EVENT_READ, conn)
+            except (ValueError, OSError):
+                self._close_conn(conn, "selector register failed")
+
+    def _read_ready(self, conn: WireConn) -> None:
+        hook = _chaos_recv     # snapshot against a concurrent uninstall
+        if hook is not None:
+            try:
+                hook(conn._sock)
+            except OSError as e:
+                self._close_conn(conn, f"chaos: {e}")
+                return
+        try:
+            data = conn._sock.recv(262144)
+        except BlockingIOError:
+            return
+        except OSError as e:
+            self._close_conn(conn, str(e))
+            return
+        if not data:
+            self._close_conn(conn, "eof")
+            return
+        try:
+            msgs = conn._framer.feed(data)
+        except WireError as e:
+            # Same rejection surface as the threaded loops: HMAC
+            # failure, oversize at the 4-byte prefix, the raw bit on a
+            # stream that never opted in — the connection drops, the
+            # pre-auth buffering bound held.
+            self.log.warning("%s: dropping connection from %s: %s",
+                             self.name, conn.peer, e)
+            self._close_conn(conn, f"wire error: {e}")
+            return
+        for msg in msgs:
+            if conn._closed:
+                return
+            try:
+                self.handler(conn, msg)
+            except Exception:
+                self.log.exception("%s: handler failed; dropping "
+                                   "connection from %s", self.name,
+                                   conn.peer)
+                self._close_conn(conn, "handler error")
+                return
+
+    def _flush(self, conn: WireConn) -> None:
+        err: Optional[OSError] = None
+        with conn._wlock:
+            buf = conn._out
+            if buf:
+                try:
+                    n = conn._sock.send(buf)
+                    del buf[:n]
+                except BlockingIOError:
+                    pass
+                except OSError as e:
+                    err = e
+            has_more = bool(buf)
+        if err is not None:
+            self._close_conn(conn, str(err))
+            return
+        want = selectors.EVENT_READ | (selectors.EVENT_WRITE
+                                       if has_more else 0)
+        if want != conn._events:
+            try:
+                self._sel.modify(conn._sock, want, conn)
+                conn._events = want
+            except (KeyError, ValueError, OSError):
+                pass
+        if not has_more and conn._close_after_flush:
+            self._close_conn(conn, "closed")
+
+    def _close_conn(self, conn: WireConn, why: str) -> None:
+        with conn._wlock:
+            if conn._closed:
+                return
+            conn._closed = True
+            conn._out = bytearray()
+        try:
+            self._sel.unregister(conn._sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn._sock.close()
+        except OSError:
+            pass
+        with self._plock:
+            self._conns.discard(conn)
+            self._pending.discard(conn)
+            self._pending_close.discard(conn)
+        if self.on_close is not None:
+            try:
+                self.on_close(conn)
+            except Exception:
+                self.log.exception("%s: on_close callback failed",
+                                   self.name)
