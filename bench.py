@@ -789,6 +789,170 @@ def bench_fleet_prefix_affinity(n_requests=24, replicas=2, rows=4,
         fleet.stop()
 
 
+def bench_fleet_sessions(replicas=2, rows=4, turns=4, n_shared=8,
+                         workers=8, max_new_tokens=8):
+    """The fleet-wide KV economy (docs/SERVING.md "KV tiering &
+    sessions"), both halves asserted in-bench:
+
+    * SESSIONS — a multi-turn conversation on a KV-tiered fleet: each
+      turn's full-history prompt is served twice, once cold (no
+      session label — the whole history prefills) and once resumed
+      (``session=`` — the parked turn's KV imports and only the new
+      tail prefills, routed to the parker by session affinity).
+      Resumed TTFT must be STRICTLY below cold, and the streams
+      TOKEN-IDENTICAL (the uninterrupted-reference equivalence bar).
+    * SHARED PREFIXES as a CLUSTER resource — a common system prompt
+      on a prefix-cached fleet must be prefilled ONCE PER FLEET
+      (router-directed seeding: affinity steers every later request to
+      the replica already holding the pages), asserted by summing
+      per-replica prefix-cache misses off the heartbeat summaries.
+
+    Reports (resumed_ttft_ms, cold_ttft_ms, kv_tier_hit_rate,
+    shared_prefix_prefills, shared_affinity_hit_rate)."""
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.launcher import FleetServer
+
+    from tfmesos_tpu.fleet.kvtier import KVTierStore
+    from tfmesos_tpu.serving import ContinuousBatcher, Request
+
+    page = 16
+    rng = np.random.default_rng(11)
+
+    # -- Part A: session resume vs cold full-history prefill, on the
+    # FLAGSHIP shape (the win IS skipped prefill compute — the tiny
+    # model's prefill is too cheap to measure; fleet costs are covered
+    # by part A2 below) in FLOAT32: the equivalence bar is exact token
+    # equality, and bfloat16 argmax ties can flip between the fused
+    # cold prefill and the resume path's tail chunk writer (the same
+    # documented caveat chunked prefill carries).  One batcher serves
+    # both arms: unlabeled requests prefill the whole history,
+    # session-labeled ones resume from the tier; a priming
+    # conversation of the same turn lengths warms every compile first,
+    # so neither arm's TTFT carries a trace.
+    import jax
+    import jax.numpy as jnp
+    from tfmesos_tpu.models import transformer as _tfm
+
+    max_len = 1024
+    cfg = _tfm.TransformerConfig(
+        vocab_size=8192, d_model=512, n_layers=8, n_heads=8, d_ff=1408,
+        max_seq_len=max_len, dtype=jnp.float32)
+    params = _tfm.init_params(cfg, jax.random.PRNGKey(0))
+    fpage, sys_len, user_len, new = 64, 448, 64, 32
+    tier = KVTierStore(ram_bytes=256 << 20, token="bench")
+    b = ContinuousBatcher(cfg, params, rows=2, max_len=max_len,
+                          page_size=fpage, prefill_bucket=fpage,
+                          kv_tier=tier)
+
+    def conversation(sid, seed, measure):
+        r2 = np.random.default_rng(seed)
+        hist = [int(t) for t in r2.integers(0, cfg.vocab_size,
+                                            size=(sys_len,))]
+        (c,) = list(b.run([Request(np.asarray(hist, np.int32), new,
+                                   session_id=sid)]))
+        res_t, cold_t = [], []
+        for _ in range(turns):
+            hist += [int(t) for t in c.tokens]
+            hist += [int(t) for t in r2.integers(0, cfg.vocab_size,
+                                                 size=(user_len,))]
+            prompt = np.asarray(hist, np.int32)
+            (cold,) = list(b.run([Request(prompt, new)]))
+            (c,) = list(b.run([Request(prompt, new, session_id=sid)]))
+            if measure:
+                assert c.tokens == cold.tokens, \
+                    "resumed stream diverged from the cold reference"
+                cold_t.append(1000.0 * cold.ttft_s)
+                res_t.append(1000.0 * c.ttft_s)
+        return res_t, cold_t
+
+    conversation("prime", seed=98, measure=False)   # compiles only
+    resumed_ttfts, cold_ttfts = conversation("bench", seed=99,
+                                             measure=True)
+    assert tier.stats()["resume"] >= 2 * turns, tier.stats()
+    resumed_med = sorted(resumed_ttfts)[len(resumed_ttfts) // 2]
+    cold_med = sorted(cold_ttfts)[len(cold_ttfts) // 2]
+    assert resumed_med < cold_med, \
+        (f"session resume-from-tier TTFT ({resumed_med:.2f}ms) not "
+         f"below cold full-history prefill ({cold_med:.2f}ms)")
+
+    # -- Part A2: the same contract through the FLEET front door on
+    # the tiny CI model — resumed streams token-identical over the
+    # wire, the tier counters aggregated off heartbeats into the
+    # gateway's kv_tier gauge, and session affinity routing the turn
+    # to the parker.  (Latency is asserted in part A where prefill
+    # compute is measurable; fleet hops would drown a tiny model's.)
+    fleet = FleetServer(replicas=replicas, rows=rows, tiny=True,
+                        max_len=128, page_size=page, prefill_bucket=page,
+                        kv_tier_mb=64, warmup=True, workers=workers,
+                        max_queue=128, start_timeout=300.0)
+    fleet.start()
+    try:
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+        hist = [int(t) for t in rng.integers(0, 97, size=(40,))]
+        out = client.generate(np.asarray(hist, np.int32),
+                              max_new_tokens, session="bench")
+        for _ in range(turns):
+            hist += [int(t) for t in out["tokens"]]
+            hist += [int(t) for t in rng.integers(0, 97, size=(8,))]
+            prompt = np.asarray(hist, np.int32)
+            cold = client.generate(prompt, max_new_tokens)
+            out = client.generate(prompt, max_new_tokens,
+                                  session="bench")
+            assert out["tokens"] == cold["tokens"], \
+                "fleet resumed stream diverged from the cold reference"
+        time.sleep(3.0 * fleet.heartbeat_interval + 0.2)
+        kt = fleet.snapshot()["gauges"].get("kv_tier") or {}
+        hits = kt.get("hits", 0)
+        misses = kt.get("misses", 0)
+        hit_rate = hits / max(1, hits + misses)
+        assert kt.get("resume", 0) >= turns, \
+            f"the fleet tier never resumed: {kt}"
+        client.close()
+    finally:
+        fleet.stop()
+
+    # -- Part B: the shared prefix as a fleet resource.
+    system = rng.integers(0, 97, size=(2 * page,)).astype(np.int32)
+    fleet = FleetServer(replicas=replicas, rows=rows, tiny=True,
+                        max_len=96, page_size=page, prefill_bucket=page,
+                        prefix_cache_pages=32, kv_tier_mb=64,
+                        warmup=True, workers=workers, max_queue=128,
+                        start_timeout=300.0)
+    fleet.start()
+    try:
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+
+        def shared_prompt():
+            return np.concatenate(
+                [system, rng.integers(0, 97, size=(4,)).astype(np.int32)])
+
+        # ONE priming request publishes the prefix somewhere; the next
+        # heartbeat advertises it, and affinity steers everything else
+        # there — the fleet prefills the common prompt exactly once.
+        client.generate(shared_prompt(), max_new_tokens)
+        time.sleep(3.0 * fleet.heartbeat_interval + 0.2)
+        for _ in range(n_shared):
+            client.generate(shared_prompt(), max_new_tokens)
+        time.sleep(3.0 * fleet.heartbeat_interval + 0.2)
+        stats = [(r.prefix or {}).get("stats") or {}
+                 for r in fleet.registry.members()]
+        prefills = sum(s.get("misses", 0) for s in stats)
+        total_hits = sum(s.get("hits", 0) for s in stats)
+        assert prefills == 1, \
+            (f"the shared prefix must prefill ONCE per fleet "
+             f"(router-directed seeding), saw {prefills} cold "
+             f"prefills across {replicas} replicas: {stats}")
+        assert total_hits >= n_shared, stats
+        snap = fleet.snapshot()["counters"]
+        ah = snap.get("affinity_hits", 0)
+        am = snap.get("affinity_misses", 0)
+        aff_rate = ah / max(1, ah + am)
+        client.close()
+    finally:
+        fleet.stop()
+    return resumed_med, cold_med, hit_rate, prefills, aff_rate
+
+
 def bench_serving_longctx(n_requests=8, rows=4, max_len=8192,
                           plen=512, new=128, tiny=False):
     """Continuous batching at LONG context — the regime the kernel-native
@@ -2497,6 +2661,21 @@ def main():
         hit_rate, rps = fa[0]
         out["fleet_prefix_affinity_hit_rate"] = round(hit_rate, 3)
         out["fleet_prefix_requests_per_sec"] = round(rps, 2)
+        flush_partial()
+    ks = attempts(bench_fleet_sessions, "fleet KV-tier sessions bench",
+                  n=1)
+    if ks:
+        # Multi-turn session resume-from-tier vs cold full-history
+        # prefill (streams asserted token-identical in-bench), plus
+        # the shared prefix as a FLEET resource (prefilled once,
+        # router-directed).
+        resumed, cold, hit_rate, prefills, aff = ks[0]
+        out["fleet_session_resume_ttft_ms"] = round(resumed, 2)
+        out["fleet_session_cold_ttft_ms"] = round(cold, 2)
+        out["fleet_session_speedup"] = round(cold / max(1e-9, resumed), 3)
+        out["fleet_kv_tier_hit_rate"] = round(hit_rate, 3)
+        out["fleet_shared_prefix_prefills"] = prefills
+        out["fleet_shared_prefix_affinity_hit_rate"] = round(aff, 3)
         flush_partial()
     rw = attempts(bench_ring_window, "ring window bench", n=1)
     if rw and rw[0] is not None:    # >1 visible device: sp ring

@@ -330,3 +330,24 @@ def test_fleet_trace_overhead_bench_smoke():
         raise
     assert np.isfinite(overhead_pct)
     assert p99_sum > 0 and p99_det > 0
+
+
+@pytest.mark.slow
+def test_fleet_sessions_bench_smoke():
+    """The KV-tier sessions bench protocol at small size: flagship
+    resume-vs-cold (streams asserted token-identical + resumed TTFT
+    strictly below cold inside the bench), the tiny-fleet wire round
+    trip, and the shared-prefix prefilled-once-per-fleet assert.  A
+    pure CPU timing inversion on a loaded host only skips."""
+    try:
+        resumed, cold, hit_rate, prefills, aff = \
+            bench.bench_fleet_sessions(replicas=2, rows=2, turns=2,
+                                       n_shared=4, workers=4)
+    except AssertionError as e:
+        if "not below cold" in str(e):
+            pytest.skip(f"loaded-host timing inversion: {e}")
+        raise
+    assert resumed > 0 and cold > 0
+    assert 0.0 <= hit_rate <= 1.0
+    assert prefills == 1
+    assert 0.0 <= aff <= 1.0

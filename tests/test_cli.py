@@ -452,3 +452,45 @@ def test_replica_parser_round_trip():
     assert args.heartbeat_interval == 0.1
     assert args.role == "prefill"
     assert replica_parser().parse_args([]).role == "unified"
+
+
+def test_serve_parser_kv_tier_flags_and_submit_session():
+    """The KV-tier surface (docs/SERVING.md "KV tiering & sessions"):
+    tfserve --kv-tier-mb/--kv-tier-dir, tfserve submit --session, and
+    the launcher-side dir charset boundary (the dir joins a shell=True
+    command line)."""
+    import pytest
+
+    from tfmesos_tpu.cli import build_serve_parser, build_submit_parser
+    from tfmesos_tpu.fleet.launcher import validate_kv_tier_dir
+
+    args = build_serve_parser().parse_args(
+        ["--kv-tier-mb", "128", "--kv-tier-dir", "/var/tmp/kvtier"])
+    assert args.kv_tier_mb == 128.0
+    assert args.kv_tier_dir == "/var/tmp/kvtier"
+    defaults = build_serve_parser().parse_args([])
+    assert defaults.kv_tier_mb == 0.0 and defaults.kv_tier_dir is None
+    sub = build_submit_parser().parse_args(
+        ["-g", "h:1", "--prompt", "1,2", "--session", "conv-7"])
+    assert sub.session == "conv-7"
+    assert build_submit_parser().parse_args(
+        ["-g", "h:1", "--prompt", "1"]).session is None
+    assert validate_kv_tier_dir("/tmp/ok._-dir") == "/tmp/ok._-dir"
+    for bad in ("-rf /", "a b", "x;rm", "$(boom)", "a\nb", ""):
+        with pytest.raises(ValueError):
+            validate_kv_tier_dir(bad)
+
+
+def test_simulate_sessions_scenario_cli(capfd):
+    """`tfserve simulate sessions` runs end to end and reports the
+    tier hit rate."""
+    from tfmesos_tpu.cli import serve_main
+
+    rc = serve_main(["simulate", "sessions", "--requests", "120",
+                     "--replicas", "2", "--seed", "3", "--json"])
+    out, _ = capfd.readouterr()
+    assert rc == 0
+    res = json.loads(out.strip().splitlines()[-1])
+    assert res["lost"] == 0
+    assert res["kv_tier_hit_rate"] > 0
+    assert res["sessions_parked"] > 0

@@ -2041,3 +2041,82 @@ def test_client_close_cancels_never_replays(stub_fleet):
             client.generate([1], 1, timeout=1.0)
     finally:
         gw.stop()
+
+
+# -- KV tiering & sessions (PR 13; store/router units in test_kvtier) --------
+
+
+def test_session_label_rides_the_wire_to_the_parker(stub_fleet):
+    """client.generate(session=) → gateway forward → router session-
+    affinity pick → replica head: the label crosses every hop intact,
+    and the turn lands on the replica advertising the parked session
+    in its heartbeat kv_tier summary."""
+    token, reg, servers = stub_fleet
+    seen = []
+
+    def handler(msg, reply):
+        seen.append(dict(msg))
+        reply({"op": "completion", "id": msg.get("id"),
+               "tokens": [7], "ttft_ms": 1.0, "total_ms": 2.0})
+
+    parker = ReplicaServer(
+        handler, token=token, capacity=4, registry_addr=reg.addr,
+        heartbeat_interval=0.05,
+        extra_info=lambda: {"kv_tier": {"sessions": ["conv-1"],
+                                        "counters": {"park": 1}}}
+    ).start()
+    servers.append(parker)
+    servers.append(_stub_replica(token, reg.addr, tokens=(9,)))
+    assert reg.wait_for(2, timeout=5.0)
+    assert _wait(lambda: any(
+        isinstance(r.kv_tier, dict) for r in reg.members()))
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=2).start()
+    try:
+        client = FleetClient(gw.addr, token)
+        for _ in range(4):
+            out = client.generate([1, 2, 3], max_new_tokens=2,
+                                  session="conv-1")
+            assert out["tokens"] == [7]     # the parker, every time
+        assert all(m.get("session") == "conv-1" for m in seen)
+        assert len(seen) == 4
+        assert metrics.get("session_affinity_hits") == 4
+        # The fleet aggregate rides the metrics snapshot (and from
+        # there the Prometheus exposition).
+        snap = client.metrics()
+        assert snap["gauges"]["kv_tier"]["replicas"] == 1
+        assert snap["gauges"]["kv_tier"]["park"] == 1
+        client.close()
+    finally:
+        gw.stop()
+
+
+def test_session_request_survives_parker_death(stub_fleet):
+    """Chaos mid-resume: the parker dies before the turn lands — the
+    router's session pick must fall back to a survivor (cold
+    re-prefill, deterministic) instead of wedging on the dead
+    favorite."""
+    token, reg, servers = stub_fleet
+    parker = ReplicaServer(
+        lambda m, r: r({"op": "completion", "id": m.get("id"),
+                        "tokens": [7], "ttft_ms": 1.0, "total_ms": 2.0}),
+        token=token, capacity=4, registry_addr=reg.addr,
+        heartbeat_interval=0.05,
+        extra_info=lambda: {"kv_tier": {"sessions": ["conv-1"]}}).start()
+    servers.append(parker)
+    survivor = _stub_replica(token, reg.addr, tokens=(9,))
+    servers.append(survivor)
+    assert reg.wait_for(2, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token, backoff_s=0.01)
+    try:
+        assert router.pick(session="conv-1") == parker.addr
+        parker.stop()           # SIGKILL shape: the session is gone
+        assert _wait(lambda: len(reg.alive()) == 1)
+        reply = router.route({"op": "generate", "prompt": [1],
+                              "session": "conv-1"})
+        assert reply["tokens"] == [9]       # served cold elsewhere
+    finally:
+        router.close()

@@ -1,0 +1,306 @@
+"""The tiered KV store (tfmesos_tpu/fleet/kvtier.py) and its fleet
+surface — all jax-free: the bounded RAM→disk store with HMAC-framed
+disk entries and weights-version fencing, the registry's kv_tier
+heartbeat field + fleet aggregate, and the router's session-affinity /
+tier-prefix-affinity picks.  The batcher-side halves (spill on trie
+eviction, promote on admission, session park/resume token equivalence)
+live in tests/test_serving.py."""
+
+import os
+
+import pytest
+
+from tfmesos_tpu import prefixhash
+from tfmesos_tpu.fleet.kvtier import KVTierFull, KVTierStore
+from tfmesos_tpu.fleet.metrics import FleetMetrics
+from tfmesos_tpu.fleet.registry import ReplicaRegistry
+from tfmesos_tpu.fleet.router import Router
+
+
+# -- the store ---------------------------------------------------------------
+
+
+def test_spill_promote_round_trip(tmp_path):
+    """The memory-hierarchy move at store level: RAM-tier pressure
+    SPILLS the LRU entries to disk (HMAC-framed files), and a later
+    get finds them there — verified, promoted back into RAM, and
+    byte-identical to what was stored."""
+    store = KVTierStore(ram_bytes=3000, disk_dir=str(tmp_path),
+                        disk_bytes=1 << 20, token="tok")
+    bodies = {f"d{i}": bytes([i]) * 1000 for i in range(5)}
+    for key, body in bodies.items():
+        store.put("prefix", key, {"i": key}, body)
+    st = store.stats()
+    # 5 KB of entries over a 3 KB RAM budget: at least two demoted.
+    assert st["ram_bytes_used"] <= 3000
+    assert st["demotions"] >= 2 and st["evictions"] == 0
+    for key, body in bodies.items():
+        got = store.get("prefix", key)
+        assert got is not None, f"{key} lost in the spill"
+        meta, out = got
+        assert out == body and meta["i"] == key
+    # Disk hits promoted back into RAM (hot again), nothing corrupt.
+    st = store.stats()
+    assert st["hits"] == 5 and st["corrupt"] == 0
+
+
+def test_ram_lru_eviction_order_without_disk():
+    store = KVTierStore(ram_bytes=2500, token="t")
+    for i in range(3):
+        store.put("prefix", f"k{i}", {}, bytes([i]) * 1000)
+    store.get("prefix", "k1")               # touch: k1 is now MRU
+    store.put("prefix", "k3", {}, b"x" * 1000)
+    # k0 and k2 were LRU; with no disk tier they are gone for good.
+    assert store.get("prefix", "k1") is not None
+    assert store.get("prefix", "k3") is not None
+    assert store.get("prefix", "k0") is None
+    assert store.stats()["evictions"] >= 1
+
+
+def test_park_rejection_is_explicit_never_a_hang():
+    """An artifact larger than every budget is REJECTED with
+    KVTierFull (counted park_rejected) — the serving path turns that
+    into a completed-but-unparked request, never a block or a silent
+    drop."""
+    store = KVTierStore(ram_bytes=1000, token="t")
+    with pytest.raises(KVTierFull):
+        store.park("s1", {}, b"y" * 5000)
+    st = store.stats()
+    assert st["park_rejected"] == 1 and st["park"] == 0
+    # A fitting park still lands.
+    store.park("s1", {}, b"y" * 500)
+    assert store.stats()["park"] == 1
+    assert store.resume("s1") is not None
+
+
+def test_disk_corruption_reads_as_miss(tmp_path):
+    """A flipped bit in a disk entry fails the HMAC tag: the read is a
+    counted MISS (never an exception, never wrong KV) and the poisoned
+    file is removed."""
+    store = KVTierStore(ram_bytes=0, disk_dir=str(tmp_path),
+                        disk_bytes=1 << 20, token="tok")
+    store.park("conv", {"n": 1}, b"payload" * 100)
+    (path,) = [str(p) for p in tmp_path.iterdir()
+               if p.name.endswith(".kvt")]
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    open(path, "wb").write(bytes(blob))
+    assert store.resume("conv") is None
+    st = store.stats()
+    assert st["corrupt"] == 1 and st["misses"] == 1
+    assert not os.path.exists(path), "poisoned entry must be removed"
+
+
+def test_truncated_disk_entry_reads_as_miss(tmp_path):
+    """A crash mid-park leaves either the old entry (atomic rename) or
+    a short file — a short one fails the tag and reads as a miss."""
+    store = KVTierStore(ram_bytes=0, disk_dir=str(tmp_path),
+                        disk_bytes=1 << 20, token="tok")
+    store.park("conv", {"n": 1}, b"payload" * 100)
+    (path,) = [str(p) for p in tmp_path.iterdir()
+               if p.name.endswith(".kvt")]
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:len(blob) // 3])
+    assert store.resume("conv") is None
+    assert store.stats()["corrupt"] == 1
+
+
+def test_weights_version_fence_on_resume(tmp_path):
+    """A v2-stamped reader must MISS a v1-parked artifact (the shared
+    disk dir survives a rollout; stale-weights KV must not): counted
+    version_miss, the turn re-prefills cold."""
+    v1 = KVTierStore(ram_bytes=0, disk_dir=str(tmp_path),
+                     disk_bytes=1 << 20, token="tok",
+                     stamp={"weights_version": "v1"})
+    v1.park("conv", {"n": 1}, b"old-weights-kv")
+    v2 = KVTierStore(ram_bytes=10000, disk_dir=str(tmp_path),
+                     token="tok", stamp={"weights_version": "v2"})
+    assert v2.resume("conv") is None
+    assert v2.stats()["version_miss"] == 1
+    # The SAME version still resumes (cross-process, via the dir).
+    v1b = KVTierStore(ram_bytes=10000, disk_dir=str(tmp_path),
+                      token="tok", stamp={"weights_version": "v1"})
+    got = v1b.resume("conv")
+    assert got is not None and got[1] == b"old-weights-kv"
+
+
+def test_cross_process_session_share_via_disk(tmp_path):
+    """Two stores over ONE directory (co-located replicas): B resumes
+    what A parked — the cross-replica half of the session contract."""
+    a = KVTierStore(ram_bytes=64, disk_dir=str(tmp_path), token="tok",
+                    disk_bytes=1 << 20)
+    a.park("conv", {"covered": 7}, b"kv-bytes" * 50)   # RAM-overflow -> disk
+    b = KVTierStore(ram_bytes=10000, disk_dir=str(tmp_path),
+                    token="tok")
+    got = b.resume("conv")
+    assert got is not None
+    assert got[0]["covered"] == 7 and got[1] == b"kv-bytes" * 50
+    # A wrong-token reader sees only corruption-shaped misses.
+    evil = KVTierStore(ram_bytes=10000, disk_dir=str(tmp_path),
+                       token="other")
+    assert evil.resume("conv") is None
+
+
+def test_chaos_fault_mid_spill_keeps_store_consistent(tmp_path,
+                                                      monkeypatch):
+    """A disk fault mid park/resume transfer (os.replace raising — the
+    crash/full-disk shape): the write fails, the entry is dropped as a
+    counted eviction, nothing hangs, and the store keeps serving."""
+    store = KVTierStore(ram_bytes=1500, disk_dir=str(tmp_path),
+                        disk_bytes=1 << 20, token="tok")
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(os, "replace", boom)
+    store.put("prefix", "a", {}, b"a" * 1000)
+    store.put("prefix", "b", {}, b"b" * 1000)   # evicts a; spill FAILS
+    st = store.stats()
+    assert st["evictions"] >= 1 and st["demotions"] == 0
+    assert store.get("prefix", "b") is not None
+    monkeypatch.setattr(os, "replace", real_replace)
+    store.put("prefix", "c", {}, b"c" * 1000)   # healthy again: spills
+    assert store.stats()["demotions"] >= 1
+
+
+def test_store_validation():
+    with pytest.raises(ValueError):
+        KVTierStore(ram_bytes=-1)
+    with pytest.raises(ValueError):
+        KVTierStore(ram_bytes=0)                # nowhere to store
+    store = KVTierStore(ram_bytes=100)
+    with pytest.raises(ValueError):
+        store.put("weights", "k", {}, b"x")     # unknown kind
+
+
+def test_summary_lists_sessions_and_prefix_geometry(tmp_path):
+    store = KVTierStore(ram_bytes=1 << 20, disk_dir=str(tmp_path),
+                        token="tok")
+    store.prefix_geometry = {"page": 16, "first": 16, "seed": ""}
+    store.park("conv-1", {}, b"kv" * 10)
+    store.put_prefix("ab" * 16, {}, b"pg" * 10)
+    summ = store.summary()
+    assert summ["sessions"] == ["conv-1"]
+    assert summ["prefix"]["hashes"] == ["ab" * 16]
+    assert summ["prefix"]["page"] == 16
+    assert summ["counters"]["park"] == 1
+    assert summ["counters"]["spills"] == 1
+    assert summ["ram_bytes_used"] > 0
+
+
+# -- registry + router surface ----------------------------------------------
+
+
+def _registry():
+    clock = [0.0]
+    reg = ReplicaRegistry(clock=lambda: clock[0])
+    return reg, clock
+
+
+def _beat(reg, addr, **extra):
+    msg = {"op": "heartbeat", "addr": addr, "capacity": 4,
+           "outstanding": 0}
+    msg.update(extra)
+    reg.observe(msg)
+
+
+def test_registry_kv_tier_field_and_aggregate():
+    reg, _ = _registry()
+    _beat(reg, "a:1", kv_tier={"sessions": ["s1", "s2"],
+                               "counters": {"hits": 3, "misses": 1,
+                                            "park": 2},
+                               "ram_bytes_used": 1000})
+    _beat(reg, "b:1", kv_tier={"sessions": ["s9"],
+                               "counters": {"hits": 1, "park": 1},
+                               "ram_bytes_used": 500})
+    _beat(reg, "c:1")                       # no tier: not aggregated
+    agg = reg.kv_tier_summary()
+    assert agg["replicas"] == 2
+    assert agg["sessions"] == 3
+    assert agg["hits"] == 4 and agg["misses"] == 1 and agg["park"] == 3
+    assert agg["ram_bytes_used"] == 1500
+    # Malformed field costs the field, never the beat.
+    _beat(reg, "a:1", kv_tier="nope")
+    assert len(reg.alive()) == 3
+
+
+def test_router_session_affinity_pick():
+    """A session-labeled request routes to the replica advertising the
+    parked session; saturation and absence fall back to p2c — and the
+    parker's DEATH falls back too (the chaos-mid-resume shape: the
+    turn re-prefills cold on a survivor, never hangs)."""
+    reg, _ = _registry()
+    router = Router(reg, FleetMetrics())
+    _beat(reg, "parker:1", kv_tier={"sessions": ["conv"]})
+    _beat(reg, "other:1")
+    for _ in range(6):
+        assert router.pick(session="conv") == "parker:1"
+    m = router.metrics
+    assert m.get("session_affinity_hits") == 6
+    # Unknown session: normal p2c (counted miss, never an error).
+    assert router.pick(session="nope") in ("parker:1", "other:1")
+    assert m.get("session_affinity_misses") == 1
+    # The parker dies: the session pick must fall back, not wedge.
+    reg.mark_dead("parker:1")
+    for _ in range(4):
+        assert router.pick(session="conv") == "other:1"
+
+
+def test_router_tier_prefix_affinity():
+    """Spilled (tier-resident) prefix digests advertised via kv_tier
+    attract matching prompts like device-resident ones — promotion
+    back to device pages happens at admission — with device summaries
+    winning ties."""
+    reg, _ = _registry()
+    router = Router(reg, FleetMetrics())
+    page, first, seed = 4, 4, b""
+    prompt = list(range(12))
+    digs = [d.hex() for d in
+            prefixhash.prompt_digests(prompt, page, first, seed)]
+    summ = {"page": page, "first": first, "seed": "", "hashes": digs}
+    _beat(reg, "tiered:1", kv_tier={"sessions": [], "prefix": summ})
+    _beat(reg, "plain:1")
+    assert router.pick(prompt=prompt) == "tiered:1"
+    # Device summary at the same depth beats the tier summary.
+    _beat(reg, "device:1", prefix_cache=summ)
+    assert router.pick(prompt=prompt) == "device:1"
+
+
+def test_tier_prefix_enables_affinity_scan_gate():
+    """has_prefix_summaries() must count a kv_tier prefix advert too —
+    the O(1) gate would otherwise skip the affinity scan entirely in a
+    fleet whose only prefix digests are tier-resident."""
+    reg, _ = _registry()
+    assert not reg.has_prefix_summaries()
+    _beat(reg, "a:1", kv_tier={"sessions": [],
+                               "prefix": {"page": 4, "first": 4,
+                                          "seed": "", "hashes": ["ff"]}})
+    assert reg.has_prefix_summaries()
+
+
+def test_disk_write_failure_on_park_is_loud(tmp_path, monkeypatch):
+    """A straight-to-disk park whose WRITE fails (ENOSPC shape) must be
+    as loud as a capacity rejection — park_rejected, never a success
+    counter for an entry that was not stored."""
+    store = KVTierStore(ram_bytes=0, disk_dir=str(tmp_path),
+                        disk_bytes=1 << 20, token="t")
+
+    def boom(src, dst):
+        raise OSError("no space left on device")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(KVTierFull):
+        store.park("conv", {}, b"x" * 100)
+    st = store.stats()
+    assert st["park_rejected"] == 1 and st["park"] == 0
+    assert store.resume("conv") is None
+
+
+def test_session_meta_history_counts_against_the_budget():
+    """The hard bound covers body + serialized meta: a huge parked
+    history cannot sneak past a small RAM budget inside the meta."""
+    store = KVTierStore(ram_bytes=2000, token="t")
+    with pytest.raises(KVTierFull):
+        store.park("conv", {"history": list(range(4000))}, b"x" * 100)
+    assert store.stats()["park_rejected"] == 1

@@ -2111,3 +2111,166 @@ def test_streaming_multi_step_and_chunked_prefill(setup):
             assert done[id(r)].tokens == ref
             assert got[id(r)] == ref[:len(got[id(r)])]
             assert len(got[id(r)]) >= 1
+
+
+# -- the KV tier: prefix spill/promote + session park/resume -----------------
+# (store-level and fleet-routing tests live in tests/test_kvtier.py;
+# these cover the batcher halves: eviction-seam spill, admission
+# promotion, and the session park/resume equivalence contract.)
+
+
+def _tier(**kw):
+    from tfmesos_tpu.fleet.kvtier import KVTierStore
+    kw.setdefault("ram_bytes", 8 << 20)
+    kw.setdefault("token", "t")
+    return KVTierStore(**kw)
+
+
+def test_session_park_resume_token_identical(setup):
+    """A multi-turn conversation resumed from the tier must be
+    TOKEN-IDENTICAL to a cold full-history prefill — the uninterrupted
+    reference — turn after turn, with the pool accounting balanced
+    after the drain."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=128, page_size=16, prefill_bucket=16)
+    tier = _tier()
+    warm = ContinuousBatcher(cfg, params, kv_tier=tier, **kw)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    assert warm.kv_tier_bypass_reason is None
+    rng = np.random.RandomState(3)
+    hist = list(rng.randint(0, cfg.vocab_size, size=24))
+    (c,) = list(warm.run([Request(np.asarray(hist, np.int32), 6,
+                                  session_id="conv")]))
+    for turn in range(3):
+        hist += list(c.tokens) + list(rng.randint(0, cfg.vocab_size,
+                                                  size=5 + turn))
+        prompt = np.asarray(hist, np.int32)
+        (ref,) = list(cold.run([Request(prompt, 6)]))
+        (c,) = list(warm.run([Request(prompt, 6, session_id="conv")]))
+        assert c.tokens == ref.tokens, f"turn {turn} diverged"
+    st = tier.stats()
+    assert st["park"] == 4 and st["resume"] == 3, st
+    assert warm.alloc.rows == {}
+    assert len(warm.alloc.free) == warm.n_pages - 1     # sink only
+
+
+def test_session_miss_paths_fall_back_cold(setup):
+    """Every session-miss shape — unknown id, a prompt that does not
+    extend the parked history, and a version-fenced store — re-prefills
+    COLD and stays exact (deterministic re-prefill, never stale KV)."""
+    from tfmesos_tpu.fleet.kvtier import KVTierStore
+    cfg, params = setup
+    kw = dict(rows=2, max_len=128, page_size=16, prefill_bucket=16)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    rng = np.random.RandomState(5)
+    p1 = rng.randint(0, cfg.vocab_size, size=20).astype(np.int32)
+    other = rng.randint(0, cfg.vocab_size, size=30).astype(np.int32)
+
+    tier = _tier()
+    warm = ContinuousBatcher(cfg, params, kv_tier=tier, **kw)
+    (c1,) = list(warm.run([Request(p1, 4, session_id="conv")]))
+    # A prompt that DIVERGES from the parked history: cold, correct.
+    (got,) = list(warm.run([Request(other, 4, session_id="conv")]))
+    (ref,) = list(cold.run([Request(other, 4)]))
+    assert got.tokens == ref.tokens
+    st = tier.stats()
+    assert st["resume"] == 0 and st["hits"] >= 1    # hit, then rejected
+
+    # Version fence: the rollout shape — park under v1, resume as v2
+    # (same RAM dict would not survive a real relaunch; use the disk
+    # tier like the deployment does).
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        t1 = KVTierStore(ram_bytes=0, disk_dir=d, disk_bytes=1 << 20,
+                         token="t", stamp={"weights_version": "v1"})
+        w1 = ContinuousBatcher(cfg, params, kv_tier=t1, **kw)
+        (c1,) = list(w1.run([Request(p1, 4, session_id="conv")]))
+        p2 = np.concatenate([p1, np.asarray(c1.tokens, np.int32),
+                             rng.randint(0, cfg.vocab_size,
+                                         size=4).astype(np.int32)])
+        t2 = KVTierStore(ram_bytes=0, disk_dir=d, disk_bytes=1 << 20,
+                         token="t", stamp={"weights_version": "v2"})
+        w2 = ContinuousBatcher(cfg, params, kv_tier=t2, **kw)
+        (got,) = list(w2.run([Request(p2, 4, session_id="conv")]))
+        (ref,) = list(cold.run([Request(p2, 4)]))
+        assert got.tokens == ref.tokens
+        assert t2.stats()["version_miss"] == 1
+        assert t2.stats()["resume"] == 0
+
+
+def test_kv_tier_spill_promote_exact_with_reclaim_accounting(setup):
+    """The eviction-callback seam under allocation pressure: evicted
+    prefix pages SPILL to the tier and PROMOTE back on the next
+    matching admission — outputs exact, and the reclaim accounting
+    still prevents the PR 2 over-admission crash (headroom must keep
+    treating zero-ref pages as reclaimable with the spill hook
+    installed)."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    reqs = lambda: [Request(prompt=np.random.RandomState(50 + i).randint(
+                        0, cfg.vocab_size, size=33 + (i % 3)).astype(
+                            np.int32), max_new_tokens=4)
+                    for i in range(10)]
+    cold = ContinuousBatcher(cfg, params, **kw)
+    tier = _tier()
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=64,
+                             kv_tier=tier, **kw)
+    want = _tokens_in_order(cold, reqs())
+    assert _tokens_in_order(warm, reqs()) == want
+    st = warm.prefix_cache_stats()
+    ts = tier.stats()
+    assert st["evicted"] > 0, "pressure must trigger LRU eviction"
+    assert ts["spills"] == st["evicted"], "every eviction must spill"
+    # Second pass: spilled chains promote back into the trie and the
+    # stream stays exact — the spill seam never corrupted a page.
+    assert _tokens_in_order(warm, reqs()) == want
+    ts = tier.stats()
+    st = warm.prefix_cache_stats()
+    assert ts["promotions"] > 0 and st["promoted"] == ts["promotions"]
+    # The over-admission regression: pool accounting balanced, peak
+    # within the physical pool, every row released.
+    assert len(warm.alloc.free) + st["cached_pages"] + 1 == warm.n_pages
+    assert warm.peak_pages_used <= warm.n_pages
+    assert warm.alloc.rows == {}
+
+
+def test_kv_tier_park_rejection_explicit(setup):
+    """A tier too small for the artifact REJECTS the park (counted)
+    and the completion is untouched — and the next turn simply
+    re-prefills cold."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=128, page_size=16, prefill_bucket=16)
+    tier = _tier(ram_bytes=64)              # nothing real fits
+    warm = ContinuousBatcher(cfg, params, kv_tier=tier, **kw)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    rng = np.random.RandomState(9)
+    p1 = rng.randint(0, cfg.vocab_size, size=30).astype(np.int32)
+    (c1,) = list(warm.run([Request(p1, 5, session_id="conv")]))
+    (ref1,) = list(cold.run([Request(p1, 5)]))
+    assert c1.tokens == ref1.tokens
+    st = tier.stats()
+    assert st["park_rejected"] == 1 and st["park"] == 0
+    p2 = np.concatenate([p1, np.asarray(c1.tokens, np.int32)])
+    (c2,) = list(warm.run([Request(p2, 4, session_id="conv")]))
+    (ref2,) = list(cold.run([Request(p2, 4)]))
+    assert c2.tokens == ref2.tokens         # cold resume, still exact
+
+
+def test_kv_tier_bypasses_are_explicit(setup, draft_setup):
+    """Modes the single-shard export/import scatter cannot serve
+    BYPASS the tier discoverably (the bypass-registry discipline) and
+    serving stays correct."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    spec = ContinuousBatcher(cfg, params, draft_cfg=dcfg,
+                             draft_params=dparams, kv_tier=_tier(), **kw)
+    assert spec.kv_tier_bypass_reason == "speculative decoding"
+    q = ContinuousBatcher(cfg, params, quantized_cache=True,
+                          kv_tier=_tier(), **kw)
+    assert q.kv_tier_bypass_reason == "quantized kv cache"
+    # Bypassed batchers still serve session-labeled requests (cold).
+    p = np.random.RandomState(2).randint(0, cfg.vocab_size,
+                                         size=9).astype(np.int32)
+    (c,) = list(q.run([Request(p, 3, session_id="s")]))
+    assert len(c.tokens) == 3

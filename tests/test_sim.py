@@ -439,3 +439,101 @@ def test_scale_1000_replicas(sleep_trap):
     assert len(random.sample(range(1000), 2)) == 2   # sanity: stdlib rng
     assert out["sim_events_per_sec"] > 5000, out["sim_events_per_sec"]
     assert wall < 30.0, f"50k-request scale smoke took {wall:.1f}s"
+
+
+# -- KV tiering & sessions (PR 13) -------------------------------------------
+
+
+def test_sessions_scenario_park_resume_at_scale(sleep_trap):
+    """The ``sessions`` scenario: multi-turn conversations resume from
+    the host-shared tier (later turns prefill only their tails), a
+    mid-run replica kill loses nothing (the tier is host-shared), and
+    resumed turns are strictly cheaper than cold full-history
+    prefills.  Deterministic per seed."""
+    out = run_scenario("sessions", n_requests=800, replicas=3,
+                       turns=4, seed=7)
+    assert out["lost"] == 0
+    assert out["completed"] == out["requests"]
+    # 4 turns -> at most 3/4 of turns can resume; most of them must.
+    assert 0.5 < out["kv_tier_hit_rate"] <= 0.75
+    assert out["resumed_ttft_mean_ms"] < out["cold_ttft_mean_ms"]
+    assert out["sessions_parked"] == 200
+    two = run_scenario("sessions", n_requests=800, replicas=3,
+                       turns=4, seed=7)
+    for k in ("completed", "kv_tier_hit_rate", "resumed_ttft_mean_ms",
+              "sim_seconds"):
+        assert two[k] == out[k], k
+
+
+def test_sessions_version_fence_in_sim(sleep_trap):
+    """A session parked under v1 must NOT resume on a v2 replica: the
+    sim tier's version check mirrors the store's stamp fence."""
+    cfg = SimConfig(replicas=1, workers=4, seed=3)
+    sim = FleetSim(cfg)
+    sim.add_replica(UNIFIED, weights_version="v1")
+    sim.start_workers()
+    sim.feed([Request(at=0.0, cls=None, prompt_len=32, new_tokens=8,
+                      session="c")])
+    sim.engine.run(stop=sim.drained)
+    assert sim.transport.session_stats["park"] == 1
+    # Roll the fleet: v2 replica takes over, the parked v1 entry must
+    # read as a version miss (cold re-prefill, never stale KV).
+    v2 = sim.add_replica(UNIFIED, weights_version="v2")
+    sim.router.set_preferred_version("v2")
+    sim.feed([Request(at=sim.engine.clock.now + 0.1, cls=None,
+                      prompt_len=96, new_tokens=8, session="c")])
+    sim.engine.run(stop=sim.drained)
+    st = sim.transport.session_stats
+    assert st["version_miss"] == 1 and st["resume"] == 0
+    assert sim.lost == []
+    assert v2.served >= 1
+    sim.stop()
+
+
+def test_sim_migration_carries_artifact_bytes(sleep_trap):
+    """Drain migration in the sim now answers with a RAW-FRAME KV
+    artifact (sized from the replica model) that the router's real
+    ``_resume_elsewhere`` re-places on a same-version survivor —
+    counted ``migration_resumes``, not the requeue-marker re-run path
+    PR 11 stopped at — and the resumed call decodes only its
+    remaining tokens."""
+    cfg = SimConfig(replicas=2, workers=0, seed=5)
+    sim = FleetSim(cfg)
+    victim = sim.add_replica(UNIFIED)
+    survivor = sim.add_replica(UNIFIED)
+    eng = sim.engine
+    results = []
+
+    def body():
+        sink = []
+        f = sim.submit(Request(at=0.0, cls=None, prompt_len=64,
+                               new_tokens=200, deadline_ms=None),
+                       sink=sink)
+        assert f
+        item = sim.admission.get(timeout=0)
+        results.append(sim.dispatch(item))
+
+    # Pin the first pick onto the victim by making the survivor look
+    # loaded at dispatch time, then migrate the victim mid-request.
+    eng.spawn(body, name="caller")
+    eng.at(0.001, lambda: sim.request_migration(victim.addr))
+    eng.run(stop=lambda: len(results) == 1)
+    reply = results[0]
+    assert isinstance(reply, dict) and reply.get("op") == "completion"
+    resumes = sim.metrics.get("migration_resumes")
+    reruns = sim.metrics.get("migration_reruns")
+    assert resumes >= 1, (resumes, reruns)
+    assert reruns == 0
+    assert sim.metrics.get("migration_exports") >= 1
+    sim.stop()
+
+
+def test_sessions_scenario_rejects_nothing_and_sweeps():
+    """The scenario is addressable from the sweep surface like every
+    other (``tfserve simulate sessions --sweep model...``)."""
+    rows = run_sweep("sessions", "model.prefill_ms_per_token",
+                     ["0.05", "0.4"], n_requests=200, replicas=2,
+                     turns=2, seed=1)
+    assert len(rows) == 2
+    for _, res in rows:
+        assert res["lost"] == 0

@@ -280,6 +280,25 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "before syncing block N's tokens; token "
                         "streams identical to 0, the synchronous "
                         "default — docs/SERVING.md)")
+    p.add_argument("--kv-tier-mb", type=float, default=0.0,
+                   dest="kv_tier_mb", metavar="MB",
+                   help="per-replica host-RAM KV tier budget in MB (0 "
+                        "disables, the default — zero behavior "
+                        "change): prefix pages evicted from the device "
+                        "pool spill into it and promote back on the "
+                        "next hit, and 'tfserve submit --session ID' "
+                        "requests park their conversation KV between "
+                        "turns, resuming with only the new tail "
+                        "prefilled (docs/SERVING.md 'KV tiering & "
+                        "sessions')")
+    p.add_argument("--kv-tier-dir", type=str, default=None,
+                   dest="kv_tier_dir", metavar="DIR",
+                   help="disk tier directory shared by the host's "
+                        "replicas (bounded at 4x the RAM budget; "
+                        "HMAC-framed entries, stale-version entries "
+                        "read as misses); default with --kv-tier-mb: "
+                        "a per-run temp directory, so co-located "
+                        "replicas resume each other's parked sessions")
     p.add_argument("--warmup", action="store_true",
                    help="replicas compile every jitted serving entry "
                         "point at boot before taking traffic: they "
@@ -438,6 +457,14 @@ def build_submit_parser() -> argparse.ArgumentParser:
                         "this request's trace; the printed trace_id "
                         "feeds 'tfserve trace -g GW --id ID' (every "
                         "request gets a summary trace regardless)")
+    p.add_argument("--session", type=str, default=None,
+                   help="multi-turn session id: on a KV-tiered fleet "
+                        "(tfserve --kv-tier-mb) the finished request's "
+                        "KV parks under this id, and a later submit "
+                        "whose --prompt extends the conversation "
+                        "(prior prompt + returned tokens + new turn) "
+                        "resumes from it, prefilling only the tail "
+                        "(docs/SERVING.md 'KV tiering & sessions')")
     p.add_argument("--timeout", type=float, default=300.0)
     return p
 
@@ -469,7 +496,8 @@ def submit_main(argv: List[str]) -> int:
                               stop_token=args.stop_token,
                               priority=args.priority,
                               deadline_ms=args.deadline_ms,
-                              trace=args.trace or None)
+                              trace=args.trace or None,
+                              session=args.session)
     except Overloaded as e:
         print(f"tfserve submit: shed ({e.kind}): {e} — back off and "
               f"retry", file=sys.stderr)
@@ -949,7 +977,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         priority_classes=classes, migrate_on_drain=args.migrate,
         breakers=args.breakers,
         prefix_cache_pages=args.prefix_cache,
-        pipeline_depth=args.pipeline_depth, warmup=args.warmup,
+        pipeline_depth=args.pipeline_depth,
+        kv_tier_mb=args.kv_tier_mb, kv_tier_dir=args.kv_tier_dir,
+        warmup=args.warmup,
         report_interval=args.metrics_interval or None,
         metrics_port=args.metrics_port,
         trace_sample=args.trace_sample,

@@ -28,6 +28,12 @@ TF-Replicator (PAPERS.md) over the existing execution engine:
   per-request trace ids on the wire, per-component flight recorders,
   tail-based retention in the gateway's trace book, and the ``tfserve
   trace`` waterfall.
+* :mod:`~tfmesos_tpu.fleet.kvtier` — the tiered KV store (bounded
+  host-RAM → disk, HMAC-framed disk entries, weights-version fencing):
+  prefix pages evicted from the device pool spill into it and promote
+  back on the next hit, and session-labeled requests park their
+  conversation KV between turns (docs/SERVING.md "KV tiering &
+  sessions").
 * :mod:`~tfmesos_tpu.fleet.replica` — the replica process: a
   ``ContinuousBatcher`` behind a TCP server, fed through the batcher's
   incremental submission API; launched as a Mode-B task through the
@@ -73,6 +79,7 @@ from tfmesos_tpu.fleet.client import (ConnectionLost, FleetClient,
 from tfmesos_tpu.fleet.containment import (BreakerBoard, BreakerConfig,
                                            RetryBudget)
 from tfmesos_tpu.fleet.gateway import Gateway
+from tfmesos_tpu.fleet.kvtier import KVTierFull, KVTierStore
 from tfmesos_tpu.fleet.launcher import FleetServer, RolloutError
 from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
@@ -93,7 +100,8 @@ __all__ = [
     "AutoscalerConfig", "FleetAutoscaler", "RolloutError",
     "BreakerBoard", "BreakerConfig", "RetryBudget",
     "ConnectionLost", "FleetClient", "MuxConnection", "RequestFailed",
-    "Gateway", "FleetServer", "FleetMetrics", "ReplicaInfo",
+    "Gateway", "FleetServer", "FleetMetrics", "KVTierFull",
+    "KVTierStore", "ReplicaInfo",
     "ReplicaRegistry", "Router", "RoutingError",
     "FlightRecorder", "TraceBook", "TraceContext", "format_waterfall",
     "FleetSim", "ReplicaModel", "SimConfig", "SimEngine",

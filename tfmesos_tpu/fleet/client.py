@@ -385,6 +385,7 @@ class FleetClient:
                  priority: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
                  trace=None,
+                 session: Optional[str] = None,
                  on_tokens: Optional[Callable[[List[int]], None]] = None
                  ) -> Dict[str, Any]:
         """One generation request; returns the completion dict
@@ -404,6 +405,14 @@ class FleetClient:
         summary-traced regardless, and the reply's ``trace_id`` (also
         set on raised ``Overloaded``/``RequestFailed`` exceptions)
         fetches the waterfall via :meth:`trace` / ``tfserve trace``.
+        ``session`` names a multi-turn conversation: on a KV-tiered
+        fleet (``tfserve --kv-tier-mb``) the finished request's KV
+        parks under the id and a later call whose prompt EXTENDS the
+        conversation (prior prompt + returned tokens + the new turn)
+        resumes from it — prefilling only the new tail — routed to the
+        replica holding the parked state (session affinity).  The
+        completion is byte-identical either way; the label is purely a
+        latency hint (docs/SERVING.md "KV tiering & sessions").
         ``on_tokens(new_tokens)`` streams the completion INCREMENTALLY:
         called (from the reader thread — do not block) with each fresh
         chunk as the replica's batcher emits it, exactly-once per token
@@ -421,6 +430,11 @@ class FleetClient:
             msg["deadline_ms"] = float(deadline_ms)
         if trace is not None and trace is not False:
             msg["trace"] = str(trace) if isinstance(trace, str) else True
+        if session is not None:
+            if not isinstance(session, str) or not session:
+                raise ValueError(f"session must be a non-empty string, "
+                                 f"got {session!r}")
+            msg["session"] = session
 
         on_partial = None
         if on_tokens is not None:

@@ -163,6 +163,13 @@ class Gateway:
         # Trace book occupancy + lifetime finish/detail counts — the
         # "is tracing actually retaining anything" sanity gauge.
         metrics.register_gauge("traces", self.tracebook.describe)
+        # Fleet-wide KV-tier aggregate (summed per-replica heartbeat
+        # counters: hits/misses/spills/promotions/park/resume + tier
+        # occupancy) — the memory-hierarchy gauge, flattened into the
+        # Prometheus exposition like every dict gauge.
+        if hasattr(self.registry, "kv_tier_summary"):
+            metrics.register_gauge("kv_tier",
+                                   self.registry.kv_tier_summary)
         # Items that expired while queued still owe the client an
         # explicit answer — the controller hands them back here from
         # whichever worker's get() swept them.
@@ -356,6 +363,13 @@ class Gateway:
             # batcher flushes token frames per block) and the worker
             # installs the de-duplicating relay at dispatch.
             forward["stream"] = True
+        sid = msg.get("session")
+        if isinstance(sid, str) and sid:
+            # Multi-turn session label (docs/SERVING.md "KV tiering &
+            # sessions"): the router steers it at the replica holding
+            # the parked KV, and the replica's batcher parks/resumes
+            # under it.  Malformed values cost the field.
+            forward["session"] = sid
         if deadline is not None:
             forward["deadline"] = deadline
         try:

@@ -1,0 +1,512 @@
+"""Tiered KV store: bounded host-RAM → disk spill for serving state.
+
+The device-resident serving caches are the top of a memory hierarchy —
+PR 2's prefix cache keeps hot pages in the KV pool, and the suspend/
+resume unit (PR 7) made any request's mid-stream KV a portable
+artifact.  This module is the next two levels down, jax-free and
+stdlib-only, so the whole control plane can reason about it without an
+accelerator:
+
+* a bounded **host-RAM tier** (LRU) holding opaque ``(meta, body)``
+  blobs keyed by ``(kind, key)`` — prefix pages evicted from the device
+  pool park here and promote back on the next hit;
+* an optional bounded **disk tier** under ``disk_dir``: RAM-evicted
+  entries SPILL to files instead of vanishing, and — because the files
+  are plain HMAC-framed blobs — replicas sharing one host can share the
+  directory, which is what lets a parked *session* resume on any
+  same-``weights_version`` replica of the host.
+
+Two kinds ride the same store:
+
+* ``"prefix"`` — one spilled prefix-cache page per entry, keyed by its
+  chain digest (:mod:`tfmesos_tpu.prefixhash`): content-addressed, so a
+  promoted page is bit-identical to the one evicted.
+* ``"session"`` — a whole conversation's KV artifact
+  (:func:`tfmesos_tpu.serving.pack_prefilled` shape) keyed by the
+  client's ``session_id``, parked between turns and resumed as a
+  leading-KV import + tail prefill (docs/SERVING.md "KV tiering &
+  sessions").
+
+Integrity and fencing:
+
+* disk entries are framed exactly like the wire's raw frames — a
+  32-byte HMAC tag (keyed by the cluster token) over
+  ``meta_len + meta_json + body``, verified BEFORE the meta decodes; a
+  tag mismatch (bit rot, a crash mid-write, tampering) is treated as a
+  MISS and the file removed, never an exception on the serving path;
+* entries carry the writer's ``stamp`` (``weights_version`` +
+  generation); a reader stamped with a DIFFERENT weights_version
+  misses (``version_miss`` counter) — stale-weights KV can never feed
+  a decode after a rollout, the same fence drain migration enforces.
+
+Capacity is a hard bound, not advisory: an entry that can never fit
+(larger than both budgets) raises :class:`KVTierFull` — the batcher
+turns a session park into an explicit rejected-park counter and the
+request completes normally; nothing ever blocks waiting for space.
+
+Counter semantics (``stats()``/``summary()``; surfaced fleet-wide as
+the gateway's ``kv_tier`` gauge): ``hits``/``misses`` count every
+lookup; ``spills`` device-evicted prefix pages parked into the tier;
+``demotions`` RAM→disk moves; ``evictions`` entries dropped
+entirely; ``park`` successful session parks and ``park_rejected``
+explicit capacity rejections; ``corrupt`` disk tag mismatches;
+``version_miss`` stamp fences.  ``resume`` (validated session resumes)
+and ``promotions`` (tier pages re-installed into device pool pages)
+are counted by the batcher, which is the only layer that can tell a
+usable artifact from a stale one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import struct
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from tfmesos_tpu.utils.logging import get_logger
+
+__all__ = ["KVTierFull", "KVTierStore"]
+
+_TAG_LEN = 32
+_LEN = struct.Struct(">I")
+
+#: entry kinds; anything else is rejected loudly at put().
+KINDS = ("prefix", "session")
+
+
+class KVTierFull(RuntimeError):
+    """The entry can NEVER fit the tier's budgets (explicit rejection,
+    never a hang — the caller completes without parking)."""
+
+
+def _tag(token: str, payload: bytes) -> bytes:
+    return hmac.new(token.encode("utf-8"), payload,
+                    hashlib.sha256).digest()
+
+
+class KVTierStore:
+    """Bounded two-level (host-RAM → disk) blob store.
+
+    ``ram_bytes`` bounds the in-memory tier (by body + serialized-meta
+    bytes — session metas embed the conversation history, so meta is
+    not always small).  ``disk_dir`` (optional) enables the disk tier,
+    bounded by
+    ``disk_bytes`` (default 4x RAM); the directory may be SHARED by
+    replicas of one host — files are HMAC-framed with the cluster
+    ``token`` and stamped with the writer's ``weights_version``, so a
+    foreign or stale entry reads as a miss, never as wrong KV.
+
+    Thread-safe: the batcher's serve loop writes, the replica heartbeat
+    thread reads ``summary()``.
+    """
+
+    def __init__(self, ram_bytes: int, disk_dir: Optional[str] = None,
+                 disk_bytes: Optional[int] = None, token: str = "",
+                 stamp: Optional[Dict[str, Any]] = None):
+        if ram_bytes < 0:
+            raise ValueError(f"ram_bytes must be >= 0, got {ram_bytes}")
+        self.ram_bytes = int(ram_bytes)
+        self.disk_dir = disk_dir
+        self.disk_bytes = (int(disk_bytes) if disk_bytes is not None
+                           else 4 * self.ram_bytes)
+        if disk_dir is not None and self.disk_bytes <= 0:
+            raise ValueError(f"disk_bytes must be > 0 with a disk tier, "
+                             f"got {self.disk_bytes}")
+        if self.ram_bytes == 0 and disk_dir is None:
+            raise ValueError("a KV tier needs ram_bytes > 0 or a "
+                             "disk_dir (both bounds zero stores nothing)")
+        self.token = token
+        #: writer identity merged into every entry's meta; a reader
+        #: whose stamp names a DIFFERENT weights_version misses.
+        self.stamp = dict(stamp or {})
+        #: the prefix-page chunk geometry this store's "prefix" entries
+        #: were cut with ({page, first, seed}) — set by the owning
+        #: batcher; rides summary() so the router can match prompts
+        #: against spilled (tier-resident) digests too.
+        self.prefix_geometry: Optional[Dict[str, Any]] = None
+        self.log = get_logger("tfmesos_tpu.fleet.kvtier")
+        if disk_dir is not None:
+            os.makedirs(disk_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        # (kind, key) -> (meta, body, cost); LRU order, most recent
+        # last.  ``cost`` = body + serialized-meta bytes: session metas
+        # embed the full conversation history, so budgeting the body
+        # alone would let the advertised hard bound drift.
+        self._ram: "OrderedDict[Tuple[str, str], tuple]" = OrderedDict()
+        self._ram_used = 0
+        # Incremental disk-occupancy estimate (own writes/deletes);
+        # reconciled against a real scandir only when a write thinks
+        # it is over budget — a shared dir's foreign entries surface
+        # there, and the common-case put stays O(1).
+        self._disk_used = 0
+        if disk_dir is not None:
+            self._disk_used = sum(s for _, _, s in self._disk_usage())
+        # Disk entries THIS process wrote (filename -> (kind, key,
+        # size)) — summary() lists own spilled keys without a scandir
+        # per heartbeat; cross-process entries are still readable (get
+        # stats the filesystem), they just don't ride our summary.
+        self._disk_keys: "OrderedDict[str, Tuple[str, str, int]]" = \
+            OrderedDict()
+        self._stats = {"hits": 0, "misses": 0, "spills": 0,
+                       "demotions": 0, "evictions": 0, "park": 0,
+                       "park_rejected": 0, "resume": 0, "promotions": 0,
+                       "corrupt": 0, "version_miss": 0}
+
+    # -- counters ----------------------------------------------------------
+
+    def count(self, name: str, n: int = 1) -> None:
+        """Bump one counter (the batcher records ``resume`` and
+        ``promotions`` here — only it can tell a usable hit)."""
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + n
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._stats)
+            out["ram_bytes_used"] = self._ram_used
+            out["ram_entries"] = len(self._ram)
+            out["disk_bytes_used"] = sum(
+                s for _, _, s in self._disk_keys.values())
+            out["disk_entries"] = len(self._disk_keys)
+        return out
+
+    # -- disk framing ------------------------------------------------------
+
+    def _path(self, kind: str, key: str) -> str:
+        name = hashlib.sha256(
+            f"{kind}\x00{key}".encode("utf-8")).hexdigest()
+        return os.path.join(self.disk_dir, f"{name}.kvt")
+
+    def _disk_write(self, kind: str, key: str, meta: dict,
+                    body: bytes) -> bool:
+        """Write one HMAC-framed entry atomically (tmp + rename — a
+        crash mid-write leaves either the old entry or a tag-failing
+        partial, never a silently wrong one).  False on any OS error:
+        spilling is best-effort, the eviction itself must stand."""
+        path = self._path(kind, key)
+        mb = json.dumps(meta).encode("utf-8")
+        payload = _LEN.pack(len(mb)) + mb + body
+        try:
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(_tag(self.token, payload))
+                f.write(payload)
+            os.replace(tmp, path)
+        except OSError as e:
+            self.log.warning("kv tier disk write failed for %s/%s: %s",
+                             kind, key, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        name = os.path.basename(path)
+        old = self._disk_keys.pop(name, None)
+        if old is not None:
+            self._disk_used -= old[2]
+        total = _TAG_LEN + len(payload)
+        self._disk_keys[name] = (kind, key, total)
+        self._disk_used += total
+        return True
+
+    def _disk_read(self, kind: str, key: str
+                   ) -> Optional[Tuple[dict, bytes]]:
+        """Read + verify one disk entry; a missing file is a miss, a
+        tag mismatch (corruption, crash mid-write, tampering) is a
+        COUNTED miss and the poisoned file is removed."""
+        path = self._path(kind, key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        ok = len(blob) > _TAG_LEN + _LEN.size and hmac.compare_digest(
+            blob[:_TAG_LEN], _tag(self.token, blob[_TAG_LEN:]))
+        meta: Any = None
+        if ok:
+            (mlen,) = _LEN.unpack_from(blob, _TAG_LEN)
+            off = _TAG_LEN + _LEN.size
+            if off + mlen <= len(blob):
+                try:
+                    meta = json.loads(blob[off:off + mlen])
+                except ValueError:
+                    meta = None
+        if not ok or not isinstance(meta, dict):
+            self._stats["corrupt"] += 1
+            self.log.warning("kv tier disk entry for %s/%s failed its "
+                             "integrity tag; treating as a miss", kind,
+                             key)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            old = self._disk_keys.pop(os.path.basename(path), None)
+            if old is not None:
+                self._disk_used -= old[2]
+            return None
+        return meta, blob[_TAG_LEN + _LEN.size + mlen:]
+
+    def _disk_usage(self) -> List[Tuple[float, str, int]]:
+        """(mtime, path, size) of every entry in the shared dir."""
+        out = []
+        try:
+            with os.scandir(self.disk_dir) as it:
+                for e in it:
+                    if not e.name.endswith(".kvt"):
+                        continue
+                    try:
+                        st = e.stat()
+                    except OSError:
+                        continue
+                    out.append((st.st_mtime, e.path, st.st_size))
+        except OSError:
+            pass
+        return out
+
+    def _disk_make_room(self, need: int) -> bool:
+        """Evict oldest disk entries until ``need`` more bytes fit the
+        disk budget; False when ``need`` alone exceeds it.  O(1) while
+        the incremental estimate says there is room; the full scandir
+        (which also reconciles the estimate against foreign entries in
+        a shared dir) runs only under pressure."""
+        if need > self.disk_bytes:
+            return False
+        if self._disk_used + need <= self.disk_bytes:
+            return True
+        entries = sorted(self._disk_usage())
+        used = sum(s for _, _, s in entries)
+        while entries and used + need > self.disk_bytes:
+            _, path, size = entries.pop(0)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._disk_keys.pop(os.path.basename(path), None)
+            self._stats["evictions"] += 1
+            used -= size
+        self._disk_used = used
+        return used + need <= self.disk_bytes
+
+    # -- the RAM tier ------------------------------------------------------
+
+    def _ram_evict_one(self) -> None:
+        """Drop the LRU RAM entry, spilling it to the disk tier when
+        one is configured (the memory-hierarchy move: RAM pressure
+        demotes, it never destroys — unless there is nowhere down)."""
+        (kind, key), (meta, body, cost) = self._ram.popitem(last=False)
+        self._ram_used -= cost
+        if self.disk_dir is not None and \
+                self._disk_make_room(cost + 256) and \
+                self._disk_write(kind, key, meta, body):
+            self._stats["demotions"] += 1
+        else:
+            self._stats["evictions"] += 1
+
+    def _ram_put(self, kind: str, key: str, meta: dict,
+                 body: bytes, cost: Optional[int] = None) -> None:
+        if cost is None:
+            cost = len(body) + len(json.dumps(meta))
+        old = self._ram.pop((kind, key), None)
+        if old is not None:
+            self._ram_used -= old[2]
+        self._ram[(kind, key)] = (meta, body, cost)
+        self._ram_used += cost
+        self._ram.move_to_end((kind, key))
+        while self._ram_used > self.ram_bytes and len(self._ram) > 1:
+            self._ram_evict_one()
+        if self._ram_used > self.ram_bytes:
+            # The sole entry alone overflows RAM: demote it straight to
+            # disk (put() pre-checked that SOME tier can hold it).
+            self._ram_evict_one()
+
+    # -- public surface ----------------------------------------------------
+
+    def put(self, kind: str, key: str, meta: Dict[str, Any],
+            body: bytes) -> None:
+        """Store one entry (replacing any same-key one).  Raises
+        :class:`KVTierFull` when the body can never fit either tier's
+        budget — an explicit rejection, never a hang or a silent
+        drop."""
+        if kind not in KINDS:
+            raise ValueError(f"unknown kv tier kind {kind!r} "
+                             f"(have: {KINDS})")
+        body = bytes(body)
+        meta = dict(meta)
+        meta.update(self.stamp)
+        # Budget by the FULL entry cost (body + serialized meta): a
+        # session meta embeds the whole conversation history, and a
+        # hard bound that ignored it would drift with history length.
+        cost = len(body) + len(json.dumps(meta))
+        fits_ram = cost <= self.ram_bytes
+        fits_disk = (self.disk_dir is not None
+                     and cost + 256 <= self.disk_bytes)
+        if not fits_ram and not fits_disk:
+            raise KVTierFull(
+                f"{kind} entry {key!r} ({cost} bytes incl. meta) "
+                f"exceeds the tier budgets (ram {self.ram_bytes}, disk "
+                f"{self.disk_bytes if self.disk_dir else 0})")
+        with self._lock:
+            if fits_ram:
+                self._ram_put(kind, key, meta, body, cost=cost)
+            else:
+                # Straight to disk; drop any stale RAM twin.
+                old = self._ram.pop((kind, key), None)
+                if old is not None:
+                    self._ram_used -= old[2]
+                if not self._disk_make_room(cost + 256) \
+                        or not self._disk_write(kind, key, meta, body):
+                    # An OS-level write failure must be as loud as a
+                    # capacity rejection — a silent drop would count a
+                    # successful park that never happened.
+                    raise KVTierFull(
+                        f"{kind} entry {key!r} cannot be stored in the "
+                        f"disk tier ({self.disk_bytes} bytes budget, "
+                        f"or the write failed)")
+
+    def _stamp_ok(self, meta: dict) -> bool:
+        """Weights-version fence: an entry stamped with a DIFFERENT
+        version than this reader's stamp is stale KV and must miss.
+        Unstamped entries (or an unstamped reader) pass — the fence
+        rejects provably stale state, like the registry's."""
+        want = self.stamp.get("weights_version")
+        have = meta.get("weights_version")
+        if want and have and str(have) != str(want):
+            return False
+        return True
+
+    def get(self, kind: str, key: str
+            ) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        """``(meta, body)`` or ``None``.  A disk hit promotes the entry
+        back into the RAM tier (it is hot again)."""
+        with self._lock:
+            hit = self._ram.get((kind, key))
+            if hit is not None:
+                if not self._stamp_ok(hit[0]):
+                    self._stats["version_miss"] += 1
+                    self._stats["misses"] += 1
+                    return None
+                self._ram.move_to_end((kind, key))
+                self._stats["hits"] += 1
+                return hit[0], hit[1]
+            if self.disk_dir is not None:
+                got = self._disk_read(kind, key)
+                if got is not None:
+                    if not self._stamp_ok(got[0]):
+                        self._stats["version_miss"] += 1
+                        self._stats["misses"] += 1
+                        return None
+                    self._stats["hits"] += 1
+                    cost = len(got[1]) + len(json.dumps(got[0]))
+                    if cost <= self.ram_bytes:
+                        self._ram_put(kind, key, got[0], got[1],
+                                      cost=cost)
+                    return got
+            self._stats["misses"] += 1
+            return None
+
+    def delete(self, kind: str, key: str) -> None:
+        with self._lock:
+            old = self._ram.pop((kind, key), None)
+            if old is not None:
+                self._ram_used -= old[2]
+            if self.disk_dir is not None:
+                path = self._path(kind, key)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                dold = self._disk_keys.pop(os.path.basename(path), None)
+                if dold is not None:
+                    self._disk_used -= dold[2]
+
+    # -- kind-specific sugar ----------------------------------------------
+
+    def would_accept(self, nbytes: int) -> bool:
+        """Whether an entry of roughly ``nbytes`` could EVER be stored
+        (O(1); eviction makes room for anything that fits a budget).
+        The batcher pre-checks this before paying a device-to-host
+        gather for a spill the tier would only reject."""
+        return (nbytes <= self.ram_bytes
+                or (self.disk_dir is not None
+                    and nbytes + 256 <= self.disk_bytes))
+
+
+    def put_prefix(self, digest_hex: str, meta: Dict[str, Any],
+                   body: bytes) -> None:
+        """Park one evicted prefix-cache page (content-addressed by its
+        chain digest).  A full tier just declines — spilling a page the
+        tier cannot hold must not fail the eviction that freed it."""
+        try:
+            self.put("prefix", digest_hex, meta, body)
+        except KVTierFull:
+            self.count("evictions")
+            return
+        self.count("spills")
+
+    def get_prefix(self, digest_hex: str
+                   ) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        return self.get("prefix", digest_hex)
+
+    def park(self, session_id: str, meta: Dict[str, Any],
+             body: bytes) -> None:
+        """Park one session's KV artifact between turns.  Raises
+        :class:`KVTierFull` (counted ``park_rejected``) when it cannot
+        fit — the caller's completion is unaffected."""
+        try:
+            self.put("session", session_id, meta, body)
+        except KVTierFull:
+            self.count("park_rejected")
+            raise
+        self.count("park")
+
+    def resume(self, session_id: str
+               ) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        """The parked artifact for ``session_id`` (counts hit/miss;
+        the batcher counts ``resume`` only after validating it)."""
+        return self.get("session", session_id)
+
+    # -- wire-facing summary ----------------------------------------------
+
+    def summary(self, max_entries: int = 32) -> Dict[str, Any]:
+        """Heartbeat payload: recent parked session ids (the router's
+        session-affinity key), the spilled prefix digests in the
+        device cache's summary shape (so the router's prefix-affinity
+        matcher can steer shared prompts at TIER-resident pages too),
+        plus counters and occupancy."""
+        with self._lock:
+            sessions: List[str] = []
+            hashes: List[str] = []
+            for (kind, key) in reversed(self._ram):
+                if len(sessions) >= max_entries \
+                        and len(hashes) >= max_entries:
+                    break
+                if kind == "session" and len(sessions) < max_entries:
+                    sessions.append(key)
+                elif kind == "prefix" and len(hashes) < max_entries:
+                    hashes.append(key)
+            for _, (kind, key, _s) in reversed(self._disk_keys.items()):
+                if len(sessions) >= max_entries \
+                        and len(hashes) >= max_entries:
+                    break
+                if kind == "session" and key not in sessions \
+                        and len(sessions) < max_entries:
+                    sessions.append(key)
+                elif kind == "prefix" and key not in hashes \
+                        and len(hashes) < max_entries:
+                    hashes.append(key)
+            out: Dict[str, Any] = {
+                "sessions": sessions,
+                "counters": dict(self._stats),
+                "ram_bytes_used": self._ram_used,
+            }
+            geom = self.prefix_geometry
+        if geom and hashes:
+            out["prefix"] = {"page": geom.get("page"),
+                             "first": geom.get("first"),
+                             "seed": geom.get("seed"),
+                             "hashes": hashes}
+        return out

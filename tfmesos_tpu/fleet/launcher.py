@@ -62,6 +62,23 @@ TIER_JOBS = {UNIFIED: "replica", PREFILL: "prefill", DECODE: "decode"}
 #: code execution) must hold for this surface too.
 _VERSION_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
 
+#: the KV-tier disk directory joins the same shell=True command line —
+#: same boundary (conservative path charset, no whitespace, no shell
+#: metacharacters, and no leading '-' that argparse would eat as a
+#: flag).
+_KV_DIR_RE = re.compile(r"[A-Za-z0-9/._~][A-Za-z0-9/._~+-]{0,255}")
+
+
+def validate_kv_tier_dir(path: str) -> str:
+    path = str(path)
+    if not _KV_DIR_RE.fullmatch(path):
+        raise ValueError(
+            f"kv_tier_dir {path!r} is not a safe path: want 1-256 "
+            f"chars of [A-Za-z0-9/._~+-] not starting with '-' or '+' "
+            f"(it joins the replica command line, so the charset is a "
+            f"security boundary)")
+    return path
+
 
 def validate_weights_version(version: str) -> str:
     version = str(version)
@@ -91,6 +108,8 @@ class FleetServer:
                  multi_step: int = 1,
                  prefix_cache_pages: int = 0,
                  pipeline_depth: int = 0,
+                 kv_tier_mb: float = 0.0,
+                 kv_tier_dir: Optional[str] = None,
                  warmup: bool = False,
                  prefill_replicas: int = 0,
                  decode_replicas: int = 0,
@@ -182,6 +201,18 @@ class FleetServer:
         self.multi_step = int(multi_step)
         self.prefix_cache_pages = int(prefix_cache_pages)
         self.pipeline_depth = int(pipeline_depth)
+        #: tiered KV store per replica (docs/SERVING.md "KV tiering &
+        #: sessions"): a >0 RAM budget turns it on; with no explicit
+        #: disk dir the launcher mints ONE host-shared temp directory
+        #: so every co-located replica can resume any sibling's parked
+        #: sessions (removed on stop).  0/None = off: zero behavior
+        #: change.
+        if kv_tier_mb < 0:
+            raise ValueError(f"kv_tier_mb must be >= 0, got {kv_tier_mb}")
+        self.kv_tier_mb = float(kv_tier_mb)
+        self.kv_tier_dir = (validate_kv_tier_dir(kv_tier_dir)
+                            if kv_tier_dir is not None else None)
+        self._kv_tier_tmp: Optional[str] = None
         self.warmup = bool(warmup)
         self.backend = backend
         self.master = master
@@ -291,6 +322,13 @@ class FleetServer:
             parts += ["--prefix-cache-pages", str(self.prefix_cache_pages)]
         if self.pipeline_depth:
             parts += ["--pipeline-depth", str(self.pipeline_depth)]
+        if self.kv_tier_mb > 0:
+            parts += ["--kv-tier-mb", str(self.kv_tier_mb)]
+            tier_dir = self.kv_tier_dir or self._kv_tier_tmp
+            if tier_dir:
+                parts += ["--kv-tier-dir", tier_dir]
+        elif self.kv_tier_dir:
+            parts += ["--kv-tier-dir", self.kv_tier_dir]
         if self.warmup:
             # Every launch of this cmd — boot, an autoscale-up, OR a
             # later elastic/Mode-B relaunch — registers warming,
@@ -302,6 +340,16 @@ class FleetServer:
     def start(self) -> "FleetServer":
         self.token = self._token or wire.new_token()
         self.metrics = FleetMetrics()
+        if self.kv_tier_mb > 0 and self.kv_tier_dir is None \
+                and self._kv_tier_tmp is None:
+            import tempfile
+
+            # One HOST-shared disk tier for every co-located replica:
+            # parked sessions resume on any same-version sibling, the
+            # cross-replica half of the session contract.  mkdtemp is
+            # mode 0700 and the entries are HMAC-framed with the
+            # cluster token, so a foreign write reads as corruption.
+            self._kv_tier_tmp = tempfile.mkdtemp(prefix="tfserve-kvtier-")
         try:
             # Liveness thresholds scale with the heartbeat cadence: a
             # slower (perfectly legal) interval must not make healthy
@@ -689,6 +737,11 @@ class FleetServer:
         if self.registry is not None:
             self.registry.stop()
             self.registry = None
+        if self._kv_tier_tmp is not None:
+            import shutil
+
+            shutil.rmtree(self._kv_tier_tmp, ignore_errors=True)
+            self._kv_tier_tmp = None
 
     def __enter__(self) -> "FleetServer":
         return self.start()

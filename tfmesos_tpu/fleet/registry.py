@@ -33,7 +33,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from tfmesos_tpu import wire
 from tfmesos_tpu.utils.logging import get_logger
@@ -67,6 +67,12 @@ class ReplicaInfo:
     # router's prefix-affinity choice matches prompts against.  None
     # until the replica advertises one.
     prefix: Optional[dict] = None
+    # KV-tier summary (fleet/kvtier.py), another heartbeat field:
+    # parked session ids (the router's session-affinity key), spilled
+    # prefix digests in the same summary shape as ``prefix`` (so the
+    # affinity matcher can steer shared prompts at TIER-resident pages
+    # too), plus counters/occupancy for the gateway's kv_tier gauge.
+    kv_tier: Optional[dict] = None
     # Disaggregated serving: the replica's advertised tier (prefill /
     # decode / unified — unified when it never says) and its free-KV-
     # page headroom, both heartbeat fields.  Decode-tier routing places
@@ -99,6 +105,17 @@ class ReplicaInfo:
     # replica runs under — how the control plane maps a registry addr
     # back to a killable task.
     node: str = ""
+
+
+def _advertises_prefix(rep: "ReplicaInfo") -> int:
+    """1 when this entry carries prompt-matchable prefix digests — a
+    device prefix-cache summary OR a KV tier's spilled-page summary —
+    the quantity the router's O(1) affinity-scan gate counts."""
+    if rep.prefix is not None:
+        return 1
+    if isinstance(rep.kv_tier, dict) and rep.kv_tier.get("prefix"):
+        return 1
+    return 0
 
 
 class ReplicaRegistry:
@@ -347,10 +364,14 @@ class ReplicaRegistry:
                 rep.capacity = int(msg["capacity"])
             if "outstanding" in msg:
                 rep.outstanding = int(msg["outstanding"])
+            before = _advertises_prefix(rep)
             if isinstance(msg.get("prefix_cache"), dict):
-                if rep.prefix is None:
-                    self._prefix_count += 1
                 rep.prefix = msg["prefix_cache"]
+            if isinstance(msg.get("kv_tier"), dict):
+                # A tier advertising spilled prefix digests joins the
+                # affinity-scan gate the same way a device summary does.
+                rep.kv_tier = msg["kv_tier"]
+            self._prefix_count += _advertises_prefix(rep) - before
             if msg.get("role") in ROLES and rep.role != msg["role"]:
                 rep.role = msg["role"]
                 self._version += 1
@@ -382,8 +403,7 @@ class ReplicaRegistry:
                 if age > self.evict_after:
                     del self._table[addr]
                     self._conns.pop(addr, None)
-                    if rep.prefix is not None:
-                        self._prefix_count -= 1
+                    self._prefix_count -= _advertises_prefix(rep)
                     self._version += 1
                     self.log.info("replica %s evicted (%s, last beat "
                                   "%.1fs ago)", addr, rep.state, age)
@@ -496,6 +516,36 @@ class ReplicaRegistry:
                                           "versions": {}})
                 d["target"] = target
         return out
+
+    def kv_tier_summary(self) -> Dict[str, Any]:
+        """Fleet-wide KV-tier aggregate (the gateway's ``kv_tier``
+        gauge, reachable through ``tfserve metrics`` and the Prometheus
+        exposition): summed counters
+        (``kv_tier_{hits,misses,spills,promotions,park,resume}`` and
+        friends), total occupancy, parked-session count, and how many
+        replicas run a tier at all."""
+        agg: Dict[str, Any] = {"replicas": 0, "sessions": 0,
+                               "ram_bytes_used": 0}
+        with self._lock:
+            for rep in self._table.values():
+                kt = rep.kv_tier
+                if not isinstance(kt, dict):
+                    continue
+                agg["replicas"] += 1
+                sess = kt.get("sessions")
+                if isinstance(sess, list):
+                    agg["sessions"] += len(sess)
+                used = kt.get("ram_bytes_used")
+                if isinstance(used, (int, float)) \
+                        and not isinstance(used, bool):
+                    agg["ram_bytes_used"] += int(used)
+                counters = kt.get("counters")
+                if isinstance(counters, dict):
+                    for k, v in counters.items():
+                        if isinstance(v, (int, float)) \
+                                and not isinstance(v, bool):
+                            agg[k] = agg.get(k, 0) + int(v)
+        return agg
 
     def register_gateway(self, addr: str) -> None:
         """Record one fleet front door for client-side discovery (the

@@ -443,12 +443,19 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
         prefilled = None
         try:
             prio = head.get("priority")
+            # Session label (docs/SERVING.md "KV tiering & sessions"):
+            # with a KV tier attached, the batcher parks this request's
+            # finished KV under the id and resumes a later turn from
+            # it.  Malformed values cost the field, never the request.
+            sid = head.get("session")
             req = Request(
                 prompt=np.asarray(head.get("prompt"), np.int32),
                 max_new_tokens=int(head.get("max_new_tokens") or 0),
                 stop_token=head.get("stop_token"),
                 priority=int(prio) if prio is not None else 0,
-                deadline_ms=_deadline_ms(head))
+                deadline_ms=_deadline_ms(head),
+                session_id=(str(sid) if isinstance(sid, str) and sid
+                            else None))
             req.trace = tr      # the batcher records its events here
             send_partial = getattr(reply, "partial", None)
             if head.get("stream") and send_partial is not None:
@@ -687,6 +694,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "per mesh data shard (0 disables); cached "
                         "summaries are advertised on registry heartbeats "
                         "for prefix-affinity routing")
+    p.add_argument("--kv-tier-mb", type=float, default=0.0,
+                   dest="kv_tier_mb",
+                   help="host-RAM KV tier budget in MB (0 disables): "
+                        "prefix pages evicted from the device pool "
+                        "spill here (promoting back on the next hit) "
+                        "and session-labeled requests park their KV "
+                        "between turns (docs/SERVING.md 'KV tiering & "
+                        "sessions')")
+    p.add_argument("--kv-tier-dir", type=str, default=None,
+                   dest="kv_tier_dir",
+                   help="disk tier directory (default: none — RAM "
+                        "only); RAM-evicted entries spill into "
+                        "HMAC-framed files, and replicas of one host "
+                        "sharing the directory can resume each "
+                        "other's parked sessions (bounded at 4x the "
+                        "RAM budget)")
     p.add_argument("--role", choices=("unified", "prefill", "decode"),
                    default="unified",
                    help="serving role: 'unified' (default) serves whole "
@@ -749,12 +772,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         cfg, params = flagship_model(args.seed,
                                      max_len=args.max_len or 1024)
+    kv_tier = None
+    if args.kv_tier_mb > 0 or args.kv_tier_dir:
+        from tfmesos_tpu.fleet.kvtier import KVTierStore
+
+        # The store is stamped with this replica's rollout identity:
+        # a parked artifact from another weights_version (a pre-rollout
+        # entry in a shared disk dir) reads as a miss, never stale KV.
+        kv_tier = KVTierStore(
+            ram_bytes=int(max(0.0, args.kv_tier_mb) * 1e6),
+            disk_dir=args.kv_tier_dir, token=token,
+            stamp={"weights_version": args.weights_version,
+                   "gen": generation})
     batcher = ContinuousBatcher(
         cfg, params, rows=args.rows, max_len=args.max_len,
         page_size=args.page_size, prefill_bucket=args.prefill_bucket,
         multi_step=args.multi_step,
         prefix_cache_pages=args.prefix_cache_pages,
-        pipeline_depth=args.pipeline_depth)
+        pipeline_depth=args.pipeline_depth, kv_tier=kv_tier)
     serving = None
     if args.role == "prefill":
         # Prefill-role replicas never decode: no serve loop runs, the
@@ -781,6 +816,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             beat["node"] = node
         if batcher.prefix_cache_active:
             beat["prefix_cache"] = batcher.prefix_cache_summary()
+        if batcher.kv_tier is not None \
+                and batcher.kv_tier_bypass_reason is None:
+            # Tier summary: parked session ids (the router's session-
+            # affinity key), spilled prefix digests (tier-resident
+            # affinity), counters and occupancy for the fleet gauge.
+            beat["kv_tier"] = batcher.kv_tier.summary()
         return beat
 
     server = ReplicaServer(

@@ -192,37 +192,75 @@ class Router:
 
     # -- replica choice ----------------------------------------------------
 
+    @staticmethod
+    def _summary_depth(summ, prompt, digests: Dict[tuple, list]) -> int:
+        """Leading-chunk match depth of ``prompt`` against one
+        prefix-cache-shaped summary ({page, first, seed, hashes});
+        0 on no match or a malformed summary.  ``digests`` memoizes
+        one hash pass per chunk geometry across candidates."""
+        if not isinstance(summ, dict) or not summ.get("hashes"):
+            return 0
+        try:
+            key = (int(summ.get("page") or 0),
+                   int(summ.get("first") or 0),
+                   str(summ.get("seed") or ""))
+            if key[0] < 1:
+                return 0
+            if key not in digests:
+                digests[key] = prefixhash.prompt_digests(
+                    prompt, key[0], key[1], bytes.fromhex(key[2]))
+            return prefixhash.match_depth(digests[key], summ["hashes"])
+        except (ValueError, TypeError):
+            return 0            # malformed summary: ignore, p2c covers
+
     def _affinity_pick(self, cands, prompt) -> Optional[str]:
-        """The unsaturated replica whose advertised prefix-cache
-        summary matches the most leading chunks of ``prompt`` (ties:
-        least outstanding); ``None`` when nothing matches."""
+        """The unsaturated replica whose advertised prefix digests
+        match the most leading chunks of ``prompt`` — DEVICE-resident
+        pages (the heartbeat prefix-cache summary) first, then
+        TIER-resident ones (the KV tier's spilled-page summary: the
+        pages promote back into the pool on admission, so steering the
+        prompt there still skips the prefill).  Ties: device beats
+        tier, then least outstanding; ``None`` when nothing matches."""
         best = None
         digests: Dict[tuple, list] = {}     # one hash pass per geometry
         for r in cands:
-            summ = r.prefix
-            if not isinstance(summ, dict) or not summ.get("hashes"):
-                continue
-            try:
-                key = (int(summ.get("page") or 0),
-                       int(summ.get("first") or 0),
-                       str(summ.get("seed") or ""))
-                if key[0] < 1:
-                    continue
-                if key not in digests:
-                    digests[key] = prefixhash.prompt_digests(
-                        prompt, key[0], key[1], bytes.fromhex(key[2]))
-                depth = prefixhash.match_depth(digests[key],
-                                               summ["hashes"])
-            except (ValueError, TypeError):
-                continue        # malformed summary: ignore, p2c covers
+            dev = self._summary_depth(r.prefix, prompt, digests)
+            tier = 0
+            if isinstance(r.kv_tier, dict):
+                tier = self._summary_depth(r.kv_tier.get("prefix"),
+                                           prompt, digests)
+            depth = max(dev, tier)
             if not depth:
                 continue
             out = self.outstanding(r.addr)
             if r.capacity > 0 and out >= r.capacity:
                 continue        # saturated favorite: fall back, don't pile
-            score = (depth, -out)
+            score = (depth, 1 if dev >= tier else 0, -out)
             if best is None or score > best[0]:
                 best = (score, r.addr)
+        return best[1] if best is not None else None
+
+    def _session_pick(self, cands, session: str) -> Optional[str]:
+        """The unsaturated replica advertising ``session`` in its KV
+        tier's parked-session list (ties: least outstanding) — a
+        resumed turn lands where the conversation's KV is parked and
+        prefills only the new tail.  ``None`` sends the request down
+        the normal affinity/p2c path (a shared disk tier may still
+        serve the resume there; a full miss re-prefills cold — always
+        correct)."""
+        best = None
+        for r in cands:
+            kt = r.kv_tier
+            if not isinstance(kt, dict):
+                continue
+            sess = kt.get("sessions")
+            if not isinstance(sess, (list, tuple)) or session not in sess:
+                continue
+            out = self.outstanding(r.addr)
+            if r.capacity > 0 and out >= r.capacity:
+                continue        # saturated: don't pile onto the parker
+            if best is None or out < best[0]:
+                best = (out, r.addr)
         return best[1] if best is not None else None
 
     def set_preferred_version(self, version: Optional[str]) -> None:
@@ -451,14 +489,23 @@ class Router:
         a, b = cands[i].addr, cands[j].addr
         return a if self.outstanding(a) <= self.outstanding(b) else b
 
-    def _pick_role(self, roles, exclude, prompt) -> Optional[str]:
+    def _pick_role(self, roles, exclude, prompt,
+                   session: Optional[str] = None) -> Optional[str]:
         """One choice policy for both prompt-bearing tiers:
-        prefix-affinity when ``prompt`` is given and some candidate
-        advertises a matching cache summary, else least-outstanding
-        p2c; ``None`` when no eligible replica exists."""
+        session-affinity first (the replica holding the conversation's
+        parked KV), then prefix-affinity when ``prompt`` is given and
+        some candidate advertises a matching cache summary, else
+        least-outstanding p2c; ``None`` when no eligible replica
+        exists."""
         cands = self._alive_by_role(roles, exclude)
         if not cands:
             return None
+        if session:
+            fav = self._session_pick(cands, session)
+            self.metrics.inc("session_affinity_hits" if fav is not None
+                             else "session_affinity_misses")
+            if fav is not None:
+                return fav
         if prompt is not None and len(prompt):
             # The O(candidates) affinity scan runs only when some
             # replica actually advertises a prefix-cache summary
@@ -477,13 +524,16 @@ class Router:
         return self._load_pick(cands)
 
     def pick(self, exclude: Iterable[str] = (),
-             prompt=None) -> Optional[str]:
+             prompt=None, session: Optional[str] = None
+             ) -> Optional[str]:
         """The UNIFIED-path choice over alive unified replicas not in
         ``exclude``.  Prefill-role replicas never appear here (they
         refuse generate); decode-role replicas are reserved for
         imported prefills, so the role split cannot silently turn a
-        decode tier back into a unified one."""
-        return self._pick_role((UNIFIED,), exclude, prompt)
+        decode tier back into a unified one.  ``session`` steers a
+        multi-turn conversation at the replica advertising its parked
+        KV (session affinity)."""
+        return self._pick_role((UNIFIED,), exclude, prompt, session)
 
     def pick_prefill(self, exclude: Iterable[str] = (),
                      prompt=None) -> Optional[str]:
@@ -838,6 +888,8 @@ class Router:
         tried = set()
         deadline_cut = False
         prompt = msg.get("prompt") if isinstance(msg, dict) else None
+        session = msg.get("session") if isinstance(msg, dict) else None
+        session = session if isinstance(session, str) and session else None
         # Streaming: the gateway's partial-frame emitter rides the
         # forward as the internal `_emit` (stripped by _wire_msg); each
         # attempt's partial token frames pass straight through to it,
@@ -855,7 +907,8 @@ class Router:
                 # burning TPU time on expired work.
                 return self._expired_reply("before a replica could "
                                            "serve it")
-            addr = self.pick(exclude=tried, prompt=prompt)
+            addr = self.pick(exclude=tried, prompt=prompt,
+                             session=session)
             if addr is None:
                 break       # nothing (left) to try
             probe = self._breaker_dispatch(addr)
@@ -976,6 +1029,13 @@ class Router:
         artifact, not the request) falls back too — a healthy unified
         tier must still get its chance."""
         prompt = msg.get("prompt")
+        if isinstance(msg.get("session"), str) and msg["session"] \
+                and self._alive_by_role((UNIFIED,)):
+            # Sessions ride the unified tier: their parked KV lives in
+            # a unified replica's tier, and the disaggregated handoff
+            # has no park/resume surface — only a PURE disagg fleet
+            # serves a session-labeled request through it (cold).
+            return None, None
         if (prompt is None or not len(prompt)) \
                 and self._alive_by_role((UNIFIED,)):
             # An invalid prompt gets its bad_request from a unified

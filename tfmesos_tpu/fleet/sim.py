@@ -293,12 +293,16 @@ class ReplicaModel:
     ``decode_ms_per_token * new_tokens``; ``jitter`` is a lognormal
     sigma applied multiplicatively (0 = deterministic).  Replay fits
     these from recorded traces (:func:`~tfmesos_tpu.fleet.workload.
-    fit_replica_model`)."""
+    fit_replica_model`).  ``kv_bytes_per_token`` sizes the raw-frame
+    KV artifacts the sim's drain migration and session park/resume
+    carry (per cached position; the tiny CI model's pages work out to
+    ~0.5 KB/token, flagship configs far more)."""
 
     prefill_base_ms: float = 4.0
     prefill_ms_per_token: float = 0.05
     decode_ms_per_token: float = 2.0
     jitter: float = 0.0
+    kv_bytes_per_token: float = 512.0
 
     def service_s(self, prompt_len: int, new_tokens: int,
                   rng: random.Random) -> Tuple[float, float]:
@@ -426,6 +430,16 @@ class SimTransport:
     def __init__(self, engine: SimEngine):
         self.engine = engine
         self.replicas: Dict[str, SimReplica] = {}
+        # The sim's KV-tier model (docs/SERVING.md "KV tiering &
+        # sessions"): one HOST-SHARED session tier (the disk-dir
+        # deployment — replicas of the host resume each other's parked
+        # sessions, and a replica death does not lose it), mapping
+        # session id -> (covered tokens, weights_version).  A resume
+        # only counts when the versions match — the rollout fence.
+        self.session_tier: Dict[str, Tuple[int, str]] = {}
+        self.session_stats = {"hits": 0, "misses": 0, "park": 0,
+                              "resume": 0, "version_miss": 0,
+                              "ttft_hit_ms": 0.0, "ttft_cold_ms": 0.0}
 
     def link(self, addr: str) -> _SimLink:
         rep = self.replicas.get(addr)
@@ -446,16 +460,37 @@ class SimTransport:
                                                 f"mid-request"))
 
     def suspend_pending(self, rep: SimReplica) -> None:
-        """Drain migration: every in-flight call answers ``suspended``
-        (requeue marker — the router re-runs it elsewhere, losing
-        nothing) and the replica's rows free immediately."""
+        """Drain migration: every in-flight generate answers
+        ``suspended`` carrying a RAW-FRAME KV artifact sized from the
+        replica model (``kv_bytes_per_token`` × the positions decoded
+        so far) — the router re-places it on a same-version survivor
+        through its real ``_resume_elsewhere`` path, exactly like a
+        live replica's export (PR 11 carried only the requeue-marker
+        re-run path).  Calls with no generate shape (control ops)
+        still answer the plain requeue marker.  The replica's rows
+        free immediately either way."""
         now = self.engine.clock.now
         rep._servers = [now] * rep.capacity
         rep._inflight = []
         pending, rep._pending = rep._pending, []
         for rec in pending:
-            if not rec[0]:
-                rec[0] = True
+            if rec[0]:
+                continue
+            rec[0] = True
+            msg = rec[2] if len(rec) > 2 else None
+            if isinstance(msg, dict) and msg.get("op") == "generate":
+                prompt = msg.get("prompt")
+                plen = len(prompt) if prompt is not None else 0
+                want = int(msg.get("max_new_tokens") or 1)
+                done = max(1, want // 2)    # suspended mid-stream
+                body = bytes(min(64 << 20, int(
+                    (plen + done) * rep.model.kv_bytes_per_token)))
+                meta = {"op": "suspended", "gen": rep.gen,
+                        "weights_version": rep.weights_version,
+                        "resumed_tokens": done}
+                self.engine._resume(rec[1], wire.RawFrame(meta, body),
+                                    None)
+            else:
                 self.engine._resume(rec[1], {"op": "suspended"}, None)
 
     def call(self, link: _SimLink, msg: Dict[str, Any],
@@ -475,9 +510,41 @@ class SimTransport:
         prompt_len = len(prompt) if prompt is not None else 0
         new_tokens = int(msg.get("max_new_tokens") or 1)
         rng = eng.rng
-        ttft_s, total_s = rep.model.service_s(prompt_len, new_tokens, rng)
+        # Session tier (KV tiering & sessions): a session-labeled
+        # generate whose conversation is parked in the host tier
+        # prefills only the new TAIL — the parked coverage's positions
+        # import instead of recomputing.  Version mismatch (a parked
+        # v1 artifact after a v2 rollout) is a counted miss: the turn
+        # re-prefills cold, never stale KV.
+        sid = msg.get("session")
+        sid = sid if isinstance(sid, str) and sid else None
+        session_hit = False
+        eff_prompt = prompt_len
+        if sid is not None and op == "generate":
+            st = self.session_stats
+            ent = self.session_tier.get(sid)
+            if ent is not None and 0 < ent[0] < prompt_len:
+                if ent[1] == rep.weights_version:
+                    session_hit = True
+                    eff_prompt = prompt_len - ent[0]
+                    st["hits"] += 1
+                    st["resume"] += 1
+                else:
+                    st["version_miss"] += 1
+                    st["misses"] += 1
+            else:
+                st["misses"] += 1
+        ttft_s, total_s = rep.model.service_s(eff_prompt, new_tokens, rng)
+        resumed = msg.get("resumed_tokens")
         if op == "prefill":
             total_s = ttft_s            # prefill tier: no decode tail
+        elif isinstance(resumed, int) and resumed > 0:
+            # A drain-migration artifact re-imported mid-stream: the
+            # survivor decodes only the REMAINING tokens — no prefill
+            # re-run (that is the whole point of carrying the bytes).
+            remaining = max(1, new_tokens - resumed)
+            total_s = rep.model.decode_ms_per_token * remaining / 1000.0
+            ttft_s = min(ttft_s, total_s)
         elif rep.role == DECODE:
             total_s = max(0.0, total_s - ttft_s)    # imported prefill
             ttft_s = 0.0
@@ -514,6 +581,17 @@ class SimTransport:
                          "ttft_ms": round(
                              (start + ttft_s - now) * 1000.0, 3),
                          "total_ms": round((finish - now) * 1000.0, 3)}
+                if sid is not None and op == "generate":
+                    # Park the finished conversation's coverage (the
+                    # last emitted token is the next turn's tail
+                    # input, like the real artifact's history).
+                    self.session_tier[sid] = (
+                        prompt_len + new_tokens - 1,
+                        rep.weights_version)
+                    st = self.session_stats
+                    st["park"] += 1
+                    st["ttft_hit_ms" if session_hit
+                       else "ttft_cold_ms"] += reply["ttft_ms"]
         rep.served += 1
         t_wake = finish
         exc: Optional[BaseException] = None
@@ -527,7 +605,7 @@ class SimTransport:
                 raise exc
             return reply
         me = eng._current
-        rec = [False, me]
+        rec = [False, me, msg]
         rep._pending.append(rec)
 
         def wake() -> None:
@@ -888,6 +966,8 @@ class FleetSim:
             "op": "generate", "prompt": self._prompt(req.prompt_len),
             "max_new_tokens": req.new_tokens, "stop_token": None,
             "priority": spec.rank}
+        if getattr(req, "session", None):
+            msg["session"] = req.session
         if req.deadline_ms is not None and req.deadline_ms > 0:
             deadline = now + req.deadline_ms / 1000.0
             msg["deadline"] = deadline
@@ -1476,6 +1556,7 @@ def scenario_soak_replay(overrides=(), n_per_feeder: int = 120,
         "probe_outcomes": probe_outcomes,
         "probes_conformant": all(p == "ok" for p in probe_outcomes),
         "migration_reruns": sim.metrics.get("migration_reruns"),
+        "migration_resumes": sim.metrics.get("migration_resumes"),
         "interactive_p99_ms": (sorted(walls)[
             max(0, int(0.99 * len(walls)) - 1)] if walls else None),
     })
@@ -1633,12 +1714,121 @@ def scenario_multi_gateway(overrides=(), n_requests: int = 6000,
     return out
 
 
+class _SessionWorkload:
+    """Multi-turn conversations as an open-arrival stream: ``sessions``
+    concurrent conversations of ``turns`` turns each, every turn's
+    prompt the FULL history so far (prior prompt + reply + the new
+    user tokens) — the workload shape the KV tier exists for.  Turn
+    rounds interleave across sessions (round-robin with Poisson gaps),
+    so a session's turns never arrive back-to-back and the tier must
+    actually hold the parked state across interleaved traffic."""
+
+    def __init__(self, sessions: int, turns: int, rate: float,
+                 seed: int = 0, user_tokens: int = 32,
+                 reply_tokens: int = 16, cls: str = "interactive"):
+        if sessions < 1 or turns < 1:
+            raise ValueError(f"sessions ({sessions}) and turns "
+                             f"({turns}) must be >= 1")
+        self.sessions = int(sessions)
+        self.turns = int(turns)
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.user_tokens = int(user_tokens)
+        self.reply_tokens = int(reply_tokens)
+        self.cls = cls
+        self.n_requests = self.sessions * self.turns
+
+    def __iter__(self):
+        rng = random.Random(self.seed)
+        t = 0.0
+        per_turn = self.user_tokens + self.reply_tokens
+        for k in range(self.turns):
+            plen = k * per_turn + self.user_tokens
+            for s in range(self.sessions):
+                t += rng.expovariate(self.rate)
+                yield Request(at=t, cls=self.cls, prompt_len=plen,
+                              new_tokens=self.reply_tokens,
+                              session=f"s{s}")
+
+
+def scenario_sessions(overrides=(), n_requests: Optional[int] = None,
+                      replicas: Optional[int] = None,
+                      seed: Optional[int] = None,
+                      turns: int = 6, sessions: Optional[int] = None,
+                      workload=None, model_fit: Optional[dict] = None,
+                      cfg: Optional[SimConfig] = None
+                      ) -> Dict[str, Any]:
+    """Session park/resume at scale (docs/SERVING.md "KV tiering &
+    sessions"): thousands of multi-turn conversations whose later
+    turns resume from the host-shared KV tier and prefill only the new
+    tail, with one replica HARD-KILLED mid-run — parked sessions
+    survive it (the tier is host-shared, the disk-dir deployment) and
+    keep resuming on the survivors.  Reports the tier hit rate and the
+    mean resumed vs cold-turn TTFT; the regression contract (asserted
+    in tests/test_sim.py): zero lost requests across the kill, and
+    resumed turns strictly cheaper than cold full-history prefills."""
+    cfg = _new_cfg(cfg, overrides)
+    if replicas is not None:
+        cfg.replicas = int(replicas)
+    if seed is not None:
+        cfg.seed = int(seed)
+    if model_fit:
+        for k, v in model_fit.items():
+            if hasattr(cfg.model, k):
+                setattr(cfg.model, k, v)
+    # Long-history prefills are the cost the tier removes — make the
+    # per-token prefill cost visible against the base.
+    if not any(p.startswith("model.") for p, _ in (overrides or ())):
+        cfg.model = dataclasses.replace(cfg.model,
+                                        prefill_ms_per_token=0.2)
+    cfg.workers = max(cfg.workers,
+                      min(256, 2 * cfg.replicas * cfg.capacity))
+    sim = FleetSim(cfg)
+    reps = [sim.add_replica(UNIFIED) for _ in range(cfg.replicas)]
+    if workload is None:
+        n_sessions = int(sessions) if sessions is not None else (
+            max(1, int(n_requests) // max(1, turns))
+            if n_requests is not None else 500)
+        _, per_req_s = cfg.model.service_s(
+            (turns // 2) * 48 + 32, 16, random.Random(0))
+        rate = 0.6 * cfg.replicas * cfg.capacity / max(1e-9, per_req_s)
+        workload = _SessionWorkload(n_sessions, turns, rate,
+                                    seed=cfg.seed)
+    sim.feed(workload)
+    sim.start_workers()
+    # Hard-kill one replica at roughly the stream's midpoint: parked
+    # sessions must keep resuming on the survivors.
+    n = getattr(workload, "n_requests", 0)
+    rate = getattr(workload, "rate", 100.0)
+    if len(reps) > 1 and n:
+        sim.engine.at(0.5 * n / max(1e-9, rate),
+                      lambda: sim.kill(reps[0]))
+    t0 = time.perf_counter()
+    sim.engine.run(stop=sim.drained)
+    wall = time.perf_counter() - t0
+    out = sim.results(wall)
+    st = sim.transport.session_stats
+    hits, misses = st["hits"], st["misses"]
+    out.update({
+        "session_tier": dict(st),
+        "kv_tier_hit_rate": round(hits / max(1, hits + misses), 4),
+        "sessions_parked": len(sim.transport.session_tier),
+        "resumed_ttft_mean_ms": round(
+            st["ttft_hit_ms"] / max(1, st["resume"]), 3),
+        "cold_ttft_mean_ms": round(
+            st["ttft_cold_ms"] / max(1, st["park"] - st["resume"]), 3),
+    })
+    sim.stop()
+    return out
+
+
 SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "steady": scenario_steady,
     "surge": scenario_surge,
     "soak-replay": scenario_soak_replay,
     "scale": scenario_scale,
     "multi-gateway": scenario_multi_gateway,
+    "sessions": scenario_sessions,
 }
 
 

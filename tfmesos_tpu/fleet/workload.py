@@ -36,13 +36,17 @@ __all__ = ["Request", "SyntheticWorkload", "replay_from_traces",
 class Request(NamedTuple):
     """One simulated arrival.  ``at`` is the absolute virtual-clock
     arrival time in seconds (ignored by closed-loop drivers);
-    ``cls`` is the priority-class label (None = the default class)."""
+    ``cls`` is the priority-class label (None = the default class).
+    ``session`` labels a multi-turn conversation — the sim's KV-tier
+    model resumes a later turn from the parked coverage, like the real
+    fleet's ``tfserve submit --session`` (docs/SERVING.md)."""
 
     at: float
     cls: Optional[str]
     prompt_len: int
     new_tokens: int
     deadline_ms: Optional[float] = None
+    session: Optional[str] = None
 
 
 def _clamped_lognormal(rng: random.Random, median: float, sigma: float,

@@ -135,13 +135,21 @@ class Request:
     burning a prefill, and CANCELS an expired resident row like a
     finished one — pages freed immediately, an :class:`Expired` yielded
     in the completion stream — so work the client has abandoned never
-    occupies a decode slot.  ``None`` (the default) never expires."""
+    occupies a decode slot.  ``None`` (the default) never expires.
+
+    ``session_id`` (optional) names a multi-turn CONVERSATION: on a
+    batcher with a KV tier (``kv_tier=``), the finished request's KV
+    parks in the tier under this id, and a later request whose prompt
+    EXTENDS the parked history resumes from it — the parked pages
+    import and only the new tail prefills, token-identical to a cold
+    full-history prefill (docs/SERVING.md "KV tiering & sessions")."""
 
     prompt: np.ndarray
     max_new_tokens: int
     stop_token: Optional[int] = None
     priority: int = 0
     deadline_ms: Optional[float] = None
+    session_id: Optional[str] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32)
@@ -153,6 +161,8 @@ class Request:
             raise ValueError(f"Request.max_new_tokens must be >= 1, got "
                              f"{self.max_new_tokens}")
         self.priority = int(self.priority)
+        if self.session_id is not None:
+            self.session_id = str(self.session_id)
         # Request tracing (docs/SERVING.md "Observability"): the fleet
         # replica attaches the hop's TraceContext here; the batcher
         # records its per-request events (admit, preempt, suspend,
@@ -212,9 +222,13 @@ _KV_ARRAY_KEYS = ("k", "v", "k_scales", "v_scales")
 # ``step``/``tokens`` carry a SUSPENDED request's mid-stream sampler
 # state (tokens emitted so far); a fresh prefill export has step 1 and
 # tokens == [first_token], so one artifact shape serves both.
+# ``history`` is the SESSION-park addition (the full conversation —
+# prompt + every emitted token — the artifact's pages cover, which is
+# what a resume validates the new turn's prompt against); absent on
+# plain prefill/suspend artifacts.
 _KV_META_KEYS = ("version", "page_size", "prefix_len", "shared_len",
                  "pos", "prompt_len", "first_token", "rid", "quantized",
-                 "model", "step", "tokens")
+                 "model", "step", "tokens", "history")
 
 
 def pack_prefilled(artifact: dict) -> tuple:
@@ -235,7 +249,17 @@ def pack_prefilled(artifact: dict) -> tuple:
                       "shape": list(a.shape)})
         parts.append(a)
     meta["arrays"] = specs
-    return meta, b"".join(memoryview(a).cast("B") for a in parts)
+
+    def buf(a):
+        # Zero-copy for buffer-protocol dtypes; extension dtypes
+        # (bfloat16) reject memoryview and copy through tobytes —
+        # frombuffer on the unpack side reads either encoding.
+        try:
+            return memoryview(a).cast("B")
+        except (ValueError, TypeError):
+            return a.tobytes()
+
+    return meta, b"".join(buf(a) for a in parts)
 
 
 def unpack_prefilled(meta: dict, body) -> dict:
@@ -671,9 +695,17 @@ class _PrefixCache:
         self._n_zero = [0] * self.n_shards
         self._tick = 0
         self._lock = threading.Lock()
+        # Eviction-callback seam (the KV-tier spill hook, and anything
+        # else that wants the page's content before it returns to the
+        # free list): called as ``on_evict(shard, digest, page)``
+        # BEFORE the page frees, while its pool content is still the
+        # published chunk.  A raising callback costs the spill, never
+        # the eviction — reclaim must always make progress, or the
+        # allocation pressure that triggered it deadlocks admission.
+        self.on_evict = None
         self._stats = {"hits": 0, "misses": 0, "hit_pages": 0,
                        "hit_tokens": 0, "inserted": 0, "evicted": 0,
-                       "cow_copies": 0, "skipped": 0}
+                       "cow_copies": 0, "skipped": 0, "promoted": 0}
         side.pcache = self
         for s, alloc in enumerate(side.alloc.shards):
             alloc.reclaim = partial(self._reclaim_cb, s)
@@ -821,6 +853,11 @@ class _PrefixCache:
                     best = n
         if best is None:
             return False
+        if self.on_evict is not None:
+            try:
+                self.on_evict(shard, best.digest, best.page)
+            except Exception:
+                pass    # the spill is best-effort; the eviction stands
         level = (best.parent.children if best.parent is not None
                  else self.roots[shard])
         del level[best.digest]
@@ -833,6 +870,46 @@ class _PrefixCache:
     def _reclaim_cb(self, shard: int) -> bool:
         with self._lock:
             return self._evict_one(shard)
+
+    def insert_chain(self, shard: int, parent_digests, digest: bytes,
+                     page: int) -> bool:
+        """Insert ONE already-resident page as a zero-ref trie node
+        under the path ``parent_digests`` — the KV-tier PROMOTION path:
+        the caller took ``page`` off the shard's free list and
+        scattered the tier's stored content into it; on True the cache
+        owns it (zero-ref ⇒ reclaimable, so headroom accounting is
+        unchanged: free lost one page, reclaimable gained one).  False
+        (parent path gone, a twin already published the chunk, or the
+        budget cannot be met) — the caller returns the page to the
+        free list."""
+        with self._lock:
+            self._tick += 1
+            # Budget FIRST: evicting after the walk could reclaim a
+            # zero-ref leaf on the very parent path just validated.
+            while (self._size(shard) >= self.budget
+                   and self._evict_one(shard)):
+                pass
+            if self._size(shard) >= self.budget:
+                self._stats["skipped"] += 1
+                return False
+            level = self.roots[shard]
+            parent = None
+            for d in parent_digests:
+                node = level.get(d)
+                if node is None:
+                    return False
+                parent = node
+                level = node.children
+            if digest in level:
+                return False        # already resident (a twin won)
+            node = _PrefixNode(digest, int(page), parent, self._tick,
+                               shard)
+            node.ref = 0            # resident, unreferenced — the cache
+            self._n_zero[shard] += 1
+            level[digest] = node
+            self._n_nodes[shard] += 1
+            self._stats["promoted"] += 1
+            return True
 
     # -- accounting / export ----------------------------------------------
 
@@ -856,10 +933,15 @@ class _PrefixCache:
             nodes = [n for s in range(self.n_shards)
                      for n in self._walk(s)]
             nodes.sort(key=lambda n: n.last, reverse=True)
+            # ``stats`` rides along for fleet-wide accounting (the
+            # shared-prefix bench sums misses across replicas to assert
+            # a common prompt prefilled once per FLEET); the router's
+            # matcher only reads the geometry + hashes.
             return {"page": self.page_size, "first": self.first,
                     "seed": self.seed.hex(),
                     "hashes": [n.digest.hex()
-                               for n in nodes[:max_entries]]}
+                               for n in nodes[:max_entries]],
+                    "stats": dict(self._stats)}
 
 
 @jax.jit
@@ -1053,7 +1135,8 @@ class ContinuousBatcher:
                  draft_quantized_cache: bool = False,
                  multi_step: int = 1,
                  prefix_cache_pages: int = 0,
-                 pipeline_depth: int = 0):
+                 pipeline_depth: int = 0,
+                 kv_tier=None):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         if prefix_cache_pages < 0:
@@ -1276,6 +1359,37 @@ class ContinuousBatcher:
                     seed, prefix_cache_pages, n_shards=self.n_shards)
                 self._tail_prefill = (self._chunk_prefill
                                       or self._make_chunk_prefill())
+        # Tiered KV store (fleet/kvtier.py; docs/SERVING.md "KV tiering
+        # & sessions"): prefix pages evicted from the device pool SPILL
+        # into it (promoting back on the next matching admission), and
+        # finished session-labeled requests PARK their KV artifacts in
+        # it for leading-KV resumption next turn.  Modes whose per-row
+        # state the single-shard export/import scatter cannot move
+        # BYPASS explicitly (kv_tier_bypass_reason — same discipline as
+        # the other bypass registries).
+        self.kv_tier = kv_tier
+        self.kv_tier_bypass_reason: Optional[str] = None
+        if kv_tier is not None:
+            if draft_cfg is not None:
+                self.kv_tier_bypass_reason = "speculative decoding"
+            elif self.n_shards != 1:
+                self.kv_tier_bypass_reason = "mesh data sharding"
+            elif quantized_cache:
+                # Session resume re-prefills its tail through the
+                # chunk writer, whose int8 path is not bit-stable
+                # against the cold fused prefill — the equivalence bar
+                # (resumed == cold, token-identical) could not hold.
+                self.kv_tier_bypass_reason = "quantized kv cache"
+            else:
+                if self._tail_prefill is None:
+                    self._tail_prefill = (self._chunk_prefill
+                                          or self._make_chunk_prefill())
+                if self._pcache is not None:
+                    self._pcache.on_evict = self._spill_page
+                    kv_tier.prefix_geometry = {
+                        "page": self.page_size,
+                        "first": self._pcache.first,
+                        "seed": self._pcache.seed.hex()}
 
     @property
     def prefix_cache_active(self) -> bool:
@@ -2063,7 +2177,13 @@ class ContinuousBatcher:
                 # retrace each, like the live path) — cover them all,
                 # or a warmed replica's first multi-bucket warm-cache
                 # hit pays a live XLA trace.
-                widths = (self._prefill_widths() if self._pcache is not None
+                # Session resume dispatches the same writer at every
+                # tail width too, so a KV tier widens the set the same
+                # way the prefix cache does.
+                tiered = (self.kv_tier is not None
+                          and self.kv_tier_bypass_reason is None)
+                widths = (self._prefill_widths()
+                          if self._pcache is not None or tiered
                           else [self.prefill_chunk or self.prefill_bucket])
                 for w in widths:
                     self.pool, tok = cfn(
@@ -2145,13 +2265,22 @@ class ContinuousBatcher:
             if self.d_side is None and self.n_shards == 1:
                 # The disaggregated surface (export gather + import
                 # scatter) — compiled at the one-page count; larger
-                # transfers trace lazily per page count.
-                ids = jnp.asarray([self.t_side.sink], jnp.int32)
-                payload = _gather_pages(self.pool, ids)
-                jax.block_until_ready(payload)
-                self.pool = _install_pages(self.pool, payload, ids)
-                jax.block_until_ready(self.pool)
-                compiled.append("kv_export_import[1]")
+                # transfers trace lazily per page count.  A KV tier
+                # buckets its session park/resume transfers to
+                # power-of-two counts, so warm those too — log2(np_max)
+                # traces, and a resumed turn's TTFT never carries one.
+                counts = [1]
+                if self.kv_tier is not None \
+                        and self.kv_tier_bypass_reason is None:
+                    counts = sorted({self._pow2(c) for c in
+                                     range(1, self.t_side.np_max + 1)})
+                for c in counts:
+                    ids = jnp.asarray([self.t_side.sink] * c, jnp.int32)
+                    payload = _gather_pages(self.pool, ids)
+                    jax.block_until_ready(payload)
+                    self.pool = _install_pages(self.pool, payload, ids)
+                    jax.block_until_ready(self.pool)
+                    compiled.append(f"kv_export_import[{c}]")
         return {"compiled": compiled,
                 "seconds": round(time.perf_counter() - t0, 3)}
 
@@ -2208,6 +2337,7 @@ class ContinuousBatcher:
                     "batcher's serve loop (prefill-role batchers never "
                     "start one)")
             wt, wd, need = self._worst_pages(request)
+            self._tier_promote(request)
             active: Dict[int, _Row] = {}
             row, plan = self._admit_row([0], active, wt, wd, request)
             assert row == 0     # nothing in flight: fit, or _admit_row raised
@@ -2237,20 +2367,39 @@ class ContinuousBatcher:
                 # even when the row never became active.
                 self._finish(row, active, [])
 
-    def _export_row(self, row: int, state: _Row) -> dict:
+    @staticmethod
+    def _pow2(n: int) -> int:
+        """Smallest power of two >= n (the tier transfer bucket: the
+        gather/scatter jits trace per page count, and bucketing bounds
+        the compile set at log2 like the decode-table widths)."""
+        return 1 << max(0, int(n) - 1).bit_length()
+
+    def _export_row(self, row: int, state: _Row,
+                    pad_pow2: bool = False) -> dict:
         """Snapshot ``row``'s post-prefill KV into a host artifact: the
         pages covering absolute positions [shared_len, pos) — cached
         prefix pages and own pages alike, in table order — pulled to
         host in one gather.  Shared-prefix pages are NOT exported: a
         same-``prefix`` importer already holds identical ones (both
-        sides prefilled the same tokens with the same params)."""
+        sides prefilled the same tokens with the same params).
+        ``pad_pow2`` buckets the GATHER's page count to a power of two
+        (padding with sink reads, sliced off host-side) so the tier's
+        park path dispatches log2(np_max) compiled gathers instead of
+        one per exact count; the artifact itself is unchanged."""
         side = self.t_side
         ps = self.page_size
         ns = len(side.shared_pages)
         E = state.pos
         n = -(-(E - side.shared_len) // ps)
         ids = np.asarray(side.table_np()[row, ns:ns + n], np.int32)
+        if pad_pow2:
+            m = self._pow2(n)
+            if m > n:
+                ids = np.concatenate(
+                    [ids, np.full((m - n,), side.sink, np.int32)])
         kv = _gather_pages(self.pool, jnp.asarray(ids))
+        if pad_pow2 and len(ids) > n:
+            kv = jax.tree_util.tree_map(lambda a: a[:, :n], kv)
         quantized = isinstance(self.pool["k"], QTensor)
         art = {
             "version": 1,
@@ -2424,6 +2573,303 @@ class ContinuousBatcher:
         active[row] = state
         self._pcache_insert(row, state)
         return row, state, np.asarray([int(art["first_token"])]), 0
+
+    # -- the KV tier: prefix spill/promote + session park/resume -----------
+
+    @property
+    def _tier_active(self) -> bool:
+        return (self.kv_tier is not None
+                and self.kv_tier_bypass_reason is None)
+
+    def _tier_geom(self) -> Dict[str, Any]:
+        """The geometry stamped on every spilled prefix page and
+        checked on promotion — a tier entry cut for a different pool
+        layout or model must read as a miss, never install."""
+        return {"page_size": self.page_size,
+                "n_layers": int(self.cfg.n_layers),
+                "kv_heads": int(self.cfg.kv_heads),
+                "head_dim": int(self.cfg.head_dim),
+                "dtype": str(np.dtype(self.pool["k"].dtype))}
+
+    def _spill_page(self, shard: int, digest: bytes, page: int) -> None:
+        """The prefix cache's eviction callback: gather the evicted
+        page's content to host and park it in the KV tier,
+        content-addressed by its chain digest — the device→host spill
+        of the memory hierarchy.  Runs on the serve-loop thread (the
+        eviction happens under its allocation pressure) while the page
+        still holds the published chunk; any failure costs the spill,
+        never the eviction."""
+        tier = self.kv_tier
+        if tier is None:
+            return
+        # Pre-check the tier's hard bounds BEFORE paying the device-
+        # to-host gather: a page that can never fit must not cost a
+        # blocking transfer on the reclaim path (which runs mid-
+        # admission, under the cache lock).
+        nbytes = (2 * int(self.cfg.n_layers) * int(self.cfg.kv_heads)
+                  * self.page_size * int(self.cfg.head_dim)
+                  * np.dtype(self.pool["k"].dtype).itemsize)
+        accept = getattr(tier, "would_accept", None)
+        if accept is not None and not accept(nbytes + 512):
+            tier.count("evictions")
+            return
+        kv = _gather_pages(self.pool, jnp.asarray([int(page)], jnp.int32))
+        k = np.ascontiguousarray(np.asarray(kv["k"]))
+        v = np.ascontiguousarray(np.asarray(kv["v"]))
+        meta = dict(self._tier_geom())
+        meta["k_bytes"] = int(k.nbytes)
+        tier.put_prefix(digest.hex(), meta,
+                        k.tobytes() + v.tobytes())
+
+    def _tier_page_payload(self, meta: dict, body: bytes):
+        """Rebuild one spilled page's ``{"k", "v"}`` device payload
+        (shape [layers, 1, kv_heads, page, dim]); None when the entry
+        was cut for a different geometry or is malformed."""
+        geom = self._tier_geom()
+        if any(meta.get(k) != geom[k] for k in geom):
+            return None
+        shape = (int(self.cfg.n_layers), 1, int(self.cfg.kv_heads),
+                 self.page_size, int(self.cfg.head_dim))
+        dtype = np.dtype(geom["dtype"])
+        kb = meta.get("k_bytes")
+        count = int(np.prod(shape, dtype=np.int64))
+        if not isinstance(kb, int) or kb != count * dtype.itemsize \
+                or len(body) != 2 * kb:
+            return None
+        k = np.frombuffer(body, dtype=dtype, count=count).reshape(shape)
+        v = np.frombuffer(body, dtype=dtype, count=count,
+                          offset=kb).reshape(shape)
+        return {"k": jnp.asarray(k), "v": jnp.asarray(v)}
+
+    def _tier_promote(self, req: Request) -> None:
+        """Opportunistic tier→device promotion at admission: for each
+        of ``req``'s prompt chunks just past the trie's longest match,
+        a tier hit installs the spilled page into a FREE pool page and
+        re-inserts it as a zero-ref trie node — the normal prefix-plan
+        path then maps it like any resident hit.  Free pages only
+        (promotion never evicts resident cache to make room — that
+        would just rotate the working set through the tier); checked
+        once per request (memoized), so a queued arrival does not
+        re-scan the tier every admission tick."""
+        if not self._tier_active or self._pcache is None:
+            return
+        if getattr(req, "_tier_checked", False):
+            return
+        req._tier_checked = True
+        digs = self._req_digests(req)
+        if not digs:
+            return
+        pc = self._pcache
+        alloc = self.t_side.alloc.shards[0]
+        n = len(pc.match(0, digs))
+        while n < len(digs):
+            d = digs[n]
+            got = self.kv_tier.get_prefix(d.hex())
+            if got is None:
+                break
+            payload = self._tier_page_payload(got[0], got[1])
+            if payload is None or not alloc.free:
+                break
+            page = alloc.free.pop()
+            if not pc.insert_chain(0, digs[:n], d, page):
+                alloc.free.append(page)
+                break
+            self.pool = _install_pages(self.pool, payload,
+                                       jnp.asarray([page], jnp.int32))
+            self.kv_tier.count("promotions")
+            self._trace_event(req, "tier_promote", digest=d.hex()[:16],
+                              depth=n + 1)
+            n += 1
+
+    def _validate_session(self, art: dict, req: Request) -> None:
+        """Reject a parked session artifact that cannot resume THIS
+        request bit-exactly (every mismatch → ``ValueError`` → the
+        lookup treats it as a miss and the turn re-prefills cold —
+        deterministic, never stale KV)."""
+        if art.get("version") != 1:
+            raise ValueError(f"unknown session artifact version "
+                             f"{art.get('version')!r}")
+        for key, want in (("page_size", self.page_size),
+                          ("prefix_len", self.prefix_len),
+                          ("shared_len", self.t_side.shared_len),
+                          ("quantized", False)):
+            if art.get(key) != want:
+                raise ValueError(
+                    f"session artifact {key} {art.get(key)!r} does not "
+                    f"match this batcher's {want!r}")
+        model = art.get("model") or {}
+        for key, want in (("n_layers", int(self.cfg.n_layers)),
+                          ("kv_heads", int(self.cfg.kv_heads)),
+                          ("head_dim", int(self.cfg.head_dim))):
+            if model.get(key) != want:
+                raise ValueError(
+                    f"session artifact model {key} {model.get(key)!r} "
+                    f"does not match this config's {want}")
+        hist = art.get("history")
+        if not isinstance(hist, (list, tuple)) or len(hist) < 2:
+            raise ValueError("session artifact carries no usable "
+                             "history")
+        if req.prompt.size < len(hist):
+            raise ValueError(
+                f"request prompt ({req.prompt.size} tokens) does not "
+                f"extend the parked history ({len(hist)} tokens)")
+        if not np.array_equal(req.prompt[:len(hist)],
+                              np.asarray(hist, np.int32)):
+            raise ValueError("request prompt diverges from the parked "
+                             "session history")
+        covered = len(hist) - 1     # the last token is the tail's input
+        E_art = art.get("pos")
+        if E_art != self.prefix_len + covered:
+            raise ValueError(
+                f"session artifact covers {E_art!r} positions; its "
+                f"history implies {self.prefix_len + covered}")
+        ps = self.page_size
+        n = -(-(E_art - self.t_side.shared_len) // ps)
+        want_shape = (int(self.cfg.n_layers), n, int(self.cfg.kv_heads),
+                      ps, int(self.cfg.head_dim))
+        dtype = np.dtype(self.pool["k"].dtype)
+        for key in ("k", "v"):
+            a = art.get(key)
+            if not isinstance(a, np.ndarray) or a.shape != want_shape \
+                    or a.dtype != dtype:
+                raise ValueError(
+                    f"session artifact {key} is not a "
+                    f"{want_shape}/{dtype} array")
+        # The tail's padded prefill window must fit the page table
+        # (same bound the prefix-plan trimmer enforces).
+        E = self.prefix_len + int(req.prompt.size)
+        w = -(-(E - E_art) // self.prefill_bucket) * self.prefill_bucket
+        if E_art + w > self.np_max * ps:
+            raise ValueError("session tail window exceeds the page "
+                             "table; resuming cold instead")
+
+    def _session_lookup(self, req: Request) -> Optional[dict]:
+        """The usable parked artifact for ``req.session_id``, or None
+        (no tier, no entry, stale weights, corrupt, or it does not
+        cover this prompt — every miss path means a cold full-history
+        prefill, which is always correct).  Memoized per request so a
+        queued arrival does not re-read the tier every tick."""
+        if not self._tier_active or not req.session_id:
+            return None
+        memo = getattr(req, "_session_art", None)
+        if memo is not None:
+            return memo[0]
+        art = None
+        got = self.kv_tier.resume(req.session_id)
+        if got is not None:
+            try:
+                art = unpack_prefilled(dict(got[0]), got[1])
+                self._validate_session(art, req)
+            except ValueError:
+                art = None
+        if art is not None:
+            self.kv_tier.count("resume")
+        req._session_art = (art,)
+        return art
+
+    def _admit_session(self, row: int, rid: int, req: Request, wt: int,
+                       wd: int, need: int, active: Dict[int, _Row],
+                       art: dict) -> tuple:
+        """Admission of a session RESUME: install the parked artifact's
+        pages (they back the conversation so far) and prefill only the
+        new turn's tail at its true offset — the cross-turn analogue of
+        a prefix-cache hit, built from the import scatter plus the
+        traced-offset chunk writer.  Returns the burst tuple like
+        ``_admit_dispatch``."""
+        t_admit = time.perf_counter()
+        side = self.t_side
+        n = art["k"].shape[1]
+        self._trace_event(req, "session_resume", rid=rid, row=row,
+                          session=str(req.session_id),
+                          covered=int(art["pos"]))
+        # The artifact's first own page embeds any shared-prefix tail
+        # template (the parking row's copy), so the plain ensure is
+        # right — no template re-copy, exactly like _admit_import.
+        side.ensure(row, side.shared_len + n * self.page_size)
+        ids = list(side.alloc.rows[row])
+        # Bucket the install to a power-of-two page count (pad slots
+        # scatter zeros onto the sink page — a write dump by
+        # construction) so resume dispatches one of log2(np_max)
+        # compiled scatters, never a fresh trace on the TTFT path.
+        m = self._pow2(n)
+        k, v = art["k"], art["v"]
+        if m > n:
+            pad = np.zeros(k.shape[:1] + (m - n,) + k.shape[2:],
+                           k.dtype)
+            k = np.concatenate([k, pad], axis=1)
+            v = np.concatenate([v, pad], axis=1)
+            ids = ids[:n] + [side.sink] * (m - n)
+        payload = {"k": jnp.asarray(k), "v": jnp.asarray(v)}
+        self.pool = _install_pages(self.pool, payload,
+                                   jnp.asarray(ids, jnp.int32))
+        E = self.prefix_len + int(req.prompt.size)
+        ts = int(art["pos"])
+        tlen = E - ts
+        w = -(-tlen // self.prefill_bucket) * self.prefill_bucket
+        # Clamp at the reservation: pad writes past ``need`` land on
+        # reserved-but-unread slots or sink columns (the cold path's
+        # prompt padding discipline).
+        side.ensure(row, min(ts + w, need))
+        padded = np.zeros((1, w), np.int32)
+        padded[0, :tlen] = req.prompt[req.prompt.size - tlen:]
+        s, toks, table = self._one_hot_call(side, row, padded)
+        caps = np.full((self.n_shards,), -1, np.int32)
+        caps[s] = tlen - 1
+        rids = np.zeros((self.n_shards,), np.int32)
+        rids[s] = rid
+        self.pool, tok = self._tail_prefill(
+            self.params, self.pool, table, toks,
+            jnp.asarray(ts, jnp.int32), jnp.asarray(caps),
+            jnp.asarray(rids))
+        tok.copy_to_host_async()    # transfer overlaps later dispatches
+        state = _Row(rid=rid, req=req, pos=E, step=1, last=0, out=[],
+                     worst_pages=wt, worst_draft=wd, t_admit=t_admit,
+                     limit=need)
+        active[row] = state
+        self._pcache_insert(row, state)
+        return row, state, tok, s
+
+    def _park_session(self, r: int, state: _Row) -> None:
+        """Park a FINISHED session-labeled row's KV in the tier (called
+        before its pages release): the artifact is the row's export
+        plus the full conversation history, so the next turn can resume
+        from it on this replica — or, through a shared disk tier, on
+        any same-weights replica of the host.  Only host-synchronous
+        single-shard modes park (``preemptible`` — the lagged modes'
+        host view overshoots at finish); everything else just misses
+        next turn, which re-prefills cold and stays correct.  A full
+        tier is an explicit rejected park, never a failed request."""
+        if not self._tier_active or not self.preemptible:
+            return
+        sid = state.req.session_id
+        if not sid or not state.out or state.t_first <= 0:
+            return
+        try:
+            art = self._export_row(r, state, pad_pow2=True)
+            art["history"] = ([int(t) for t in state.req.prompt]
+                              + [int(t) for t in state.out])
+            meta, body = pack_prefilled(art)
+        except Exception:
+            return      # parking is best-effort; the completion stands
+        try:
+            self.kv_tier.park(str(sid), meta, body)
+        except Exception:
+            # KVTierFull (counted park_rejected by the store) or an
+            # unexpected failure: explicit and observable, and the
+            # request's completion is unaffected.
+            return
+        self._trace_event(state.req, "session_park", session=str(sid),
+                          bytes=len(body))
+
+    def _finish_completed(self, r: int, active: Dict[int, _Row],
+                          free_rows: List[int]) -> None:
+        """Finish a COMPLETED row: park its session KV (when labeled
+        and parkable) before the pages release, then the normal
+        finish."""
+        state = active.get(r)
+        if state is not None:
+            self._park_session(r, state)
+        self._finish(r, active, free_rows)
 
     def _submission_source(self) -> SubmissionQueue:
         with self._submissions_lock:
@@ -2646,13 +3092,27 @@ class ContinuousBatcher:
                     except ValueError as e:
                         bad_request = e     # raise after draining
                         break
-                    # Imports skip the prefix-plan mapping: their pages
-                    # arrive in the payload (installing everything, then
-                    # publishing, is what keeps import admission one
-                    # code path with local prefill).
-                    row, plan = self._admit_row(free_rows, active, wt,
-                                                wd, req0,
-                                                use_cache=not imported)
+                    # KV tier: a usable parked session artifact takes
+                    # the resume path instead of prefilling the whole
+                    # history — checked FIRST, because a resume
+                    # installs those positions from the artifact and
+                    # promoting their spilled prefix pages too would
+                    # be a second, unused device install.  Otherwise,
+                    # promote any spilled prefix pages this prompt
+                    # could map (they re-enter the trie as zero-ref
+                    # nodes, so the prefix plan below sees them).
+                    sess_art = (None if imported
+                                else self._session_lookup(req0))
+                    if not imported and sess_art is None:
+                        self._tier_promote(req0)
+                    # Imports (and session resumes) skip the
+                    # prefix-plan mapping: their pages arrive in the
+                    # payload (installing everything, then publishing,
+                    # is what keeps import admission one code path
+                    # with local prefill).
+                    row, plan = self._admit_row(
+                        free_rows, active, wt, wd, req0,
+                        use_cache=not imported and sess_art is None)
                     if row is None:
                         # Allocation pressure: a strictly-higher-
                         # priority head may suspend the lowest-priority
@@ -2670,6 +3130,12 @@ class ContinuousBatcher:
                         # burned.
                         res = self._admit_import(row, item, wt, wd,
                                                  need, active)
+                    elif sess_art is not None:
+                        rid = self._next_rid
+                        self._next_rid += 1
+                        res = self._admit_session(row, rid, item, wt,
+                                                  wd, need, active,
+                                                  sess_art)
                     else:
                         rid = self._next_rid
                         self._next_rid += 1
@@ -2714,7 +3180,8 @@ class ContinuousBatcher:
                     done_row = self._advance_prefill(active)
                     if done_row is not None:
                         done = self._completion(active[done_row])
-                        self._finish(done_row, active, free_rows)
+                        self._finish_completed(done_row, active,
+                                               free_rows)
                         yield done
                 if any(row.decoding for row in active.values()):
                     if self.draft_cfg is not None and self.overlap:
@@ -2955,7 +3422,7 @@ class ContinuousBatcher:
         for row, state, tok, s in burst:
             done = self._admit_finalize(state, int(np.asarray(tok)[s]))
             if done is not None:
-                self._finish(row, active, free_rows)
+                self._finish_completed(row, active, free_rows)
                 yield done
         burst.clear()
 
@@ -3051,7 +3518,7 @@ class ContinuousBatcher:
                 if tok == row.req.stop_token or row.step >= \
                         row.req.max_new_tokens:
                     done = self._completion(row)
-                    self._finish(r, active, free_rows)
+                    self._finish_completed(r, active, free_rows)
                     yield done
                     break
 
