@@ -724,6 +724,190 @@ def bench_serving_prefix_cache(n_requests=16, rows=4, tiny=False):
     return warm_ttft, cold_ttft, n_requests / dt, hit_rate
 
 
+def bench_serving_spec_compose(n_requests=12, rows=4, tiny=False,
+                               decode_new=24, migrate_requests=6,
+                               strict=True):
+    """Speculative decoding composed with the fast path (the bypass
+    burn-down, ROADMAP item 6) — three arms:
+
+    * ``serving_spec_warm_ttft_ms`` vs ``serving_spec_cold_ttft_ms`` —
+      a SPECULATIVE batcher on the shared-system-prompt workload with
+      the prefix cache warm (twin target+draft pages mapped read-only,
+      only the tail prefilled through both writers) vs cold full
+      prefill; warm asserted STRICTLY below cold, streams asserted
+      EQUAL (a faster wrong stream is not a result).
+    * ``serving_spec_decode_p50_intertoken_ms`` vs the non-speculative
+      baseline on the same workload — measured with a PERFECT draft
+      (draft == target): every round commits n_draft+1 tokens for one
+      dispatch+sync.  RECORDED, not asserted: speculative decoding
+      wins where decode is bandwidth/dispatch-bound (the accelerator
+      regime); on this compute-bound CPU host a perfect draft costs
+      ~2x target FLOPs per committed token, so wall-clock favors the
+      baseline here by construction — the number tracks the overhead
+      honestly (``serving_spec_acceptance_rate`` rides along, 1.0 for
+      the perfect draft).
+    * ``serving_spec_migration_lost_requests`` — a live 2-replica
+      CPU fleet serving with drafts drain-MIGRATES one replica while
+      spec requests are mid-decode: suspended rows move as KV exports
+      CARRYING the draft-side payload and resume on the survivor;
+      asserted zero lost with every stream equal to the local
+      speculative reference.
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.launcher import FleetServer
+    from tfmesos_tpu.fleet.replica import tiny_draft_model, tiny_model
+    from tfmesos_tpu.models import transformer
+    from tfmesos_tpu.serving import ContinuousBatcher, Request
+
+    n_draft = 4
+    if tiny:
+        cfg, params, _, max_len, _ = _serving_bench_setup(True)
+        page, sys_len, tail_len, new = 16, 40, 8, 4
+        dcfg = transformer.TransformerConfig(
+            vocab_size=cfg.vocab_size, d_model=16, n_layers=1,
+            n_heads=2, d_ff=32, max_seq_len=max_len + n_draft + 1,
+            dtype=jnp.float32)
+    else:
+        cfg, params, _, max_len, _ = _serving_bench_setup(False)
+        page, sys_len, tail_len, new = 64, 448, 64, 16
+        dcfg = transformer.TransformerConfig(
+            vocab_size=cfg.vocab_size, d_model=128, n_layers=2,
+            n_heads=4, d_ff=352, max_seq_len=max_len + n_draft + 1,
+            dtype=jnp.bfloat16)
+    dparams = transformer.init_params(dcfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(7)
+    system = rng.integers(0, cfg.vocab_size,
+                          size=(sys_len,)).astype(np.int32)
+
+    def reqs(n, seed=1, mnt=new):
+        r2 = np.random.default_rng(seed)
+        return [Request(prompt=np.concatenate(
+                    [system, r2.integers(0, cfg.vocab_size,
+                                         size=(tail_len,))
+                     .astype(np.int32)]), max_new_tokens=mnt)
+                for _ in range(n)]
+
+    spec_kw = dict(rows=rows, max_len=max_len, page_size=page,
+                   prefill_bucket=page, draft_cfg=dcfg,
+                   draft_params=dparams, n_draft=n_draft)
+    # Arm 1: spec + prefix cache, warm vs cold TTFT (streams equal).
+    cold = ContinuousBatcher(cfg, params, **spec_kw)
+    list(cold.run(reqs(2, seed=99)))        # compiles only
+    cold_done = sorted((c.rid, c) for c in cold.run(reqs(n_requests)))
+    cold_ttft = 1000.0 * sum(c.ttft_s
+                             for _, c in cold_done) / n_requests
+    warm = ContinuousBatcher(cfg, params,
+                             prefix_cache_pages=4 * (sys_len // page
+                                                     + 2), **spec_kw)
+    list(warm.run(reqs(2, seed=99)))        # compiles + publishes
+    list(warm.run(reqs(1, seed=98)))        # distinct tail: shared hit
+    warm_done = sorted((c.rid, c) for c in warm.run(reqs(n_requests)))
+    warm_ttft = 1000.0 * sum(c.ttft_s
+                             for _, c in warm_done) / n_requests
+    assert [c.tokens for _, c in warm_done] == \
+        [c.tokens for _, c in cold_done], \
+        "spec prefix-cached completions diverged from spec cold prefill"
+    # ``strict=False`` (the tiny CI smoke) keeps every CORRECTNESS
+    # assert but lets the two timing wins pass un-asserted — toy
+    # shapes invert timings; the flagship bench asserts both.
+    assert not strict or warm_ttft < cold_ttft, \
+        (f"spec+prefix warm TTFT {warm_ttft:.1f}ms not strictly below "
+         f"spec cold TTFT {cold_ttft:.1f}ms")
+
+    # Arm 2: spec inter-token p50 vs the non-spec baseline (perfect
+    # draft = the ceiling; acceptance_rate rides along).  The perfect
+    # draft IS the target config, whose max_seq_len must cover the
+    # verify overshoot — both arms serve at the reduced max_len so
+    # they measure the same workload.
+    ml2 = max_len - n_draft - 1
+    base = ContinuousBatcher(cfg, params, rows=rows, max_len=ml2,
+                             page_size=page, prefill_bucket=page)
+    list(base.run(reqs(2, seed=97)))
+    base_done = list(base.run(reqs(n_requests, seed=3)))
+    base_itl = _itl_p50_ms(base_done)
+    perfect = ContinuousBatcher(cfg, params, rows=rows, max_len=ml2,
+                                page_size=page, prefill_bucket=page,
+                                draft_cfg=cfg, draft_params=params,
+                                n_draft=n_draft)
+    list(perfect.run(reqs(2, seed=97)))
+    spec_done = list(perfect.run(reqs(n_requests, seed=3)))
+    spec_itl = _itl_p50_ms(spec_done)
+    accept = perfect.acceptance_rate or 0.0
+    # No strict assert here (see the docstring): the CPU host is
+    # compute-bound, where a perfect draft pays 2x FLOPs per token —
+    # the recorded pair is the honest comparison, and the round-count
+    # collapse is what the acceptance rate evidences.
+    assert accept > 0.9, \
+        f"perfect draft acceptance {accept:.3f} — the spec round is broken"
+
+    # Arm 3: mid-stream drain migration of a SPEC fleet, zero lost.
+    fleet = FleetServer(replicas=2, rows=2, tiny=True, max_len=64,
+                        page_size=16, prefill_bucket=16, draft=True,
+                        n_draft=3, workers=8, max_queue=64,
+                        request_timeout=300.0, start_timeout=300.0)
+    fleet.start()
+    try:
+        tcfg, tparams = tiny_model(seed=0)
+        tdcfg, tdparams = tiny_draft_model(max_len=64, n_draft=3)
+        ref_b = ContinuousBatcher(tcfg, tparams, rows=2, max_len=64,
+                                  page_size=16, prefill_bucket=16,
+                                  draft_cfg=tdcfg, draft_params=tdparams,
+                                  n_draft=3)
+        r2 = np.random.default_rng(11)
+        prompts = [r2.integers(0, tcfg.vocab_size,
+                               size=(9,)).astype(np.int32)
+                   for _ in range(migrate_requests)]
+        refs = {c.rid: c.tokens for c in ref_b.run(
+            [Request(prompt=p.copy(), max_new_tokens=decode_new)
+             for p in prompts])}
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+        client.generate(prompts[0], 2)      # warm replica compiles
+        results = [None] * migrate_requests
+        errors = []
+
+        def one(i):
+            try:
+                results[i] = client.generate(prompts[i], decode_new,
+                                             timeout=300.0)
+            except Exception as e:
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(migrate_requests)]
+        for t in threads:
+            t.start()
+        # Migrate whichever replica has work in flight, MID-decode.
+        deadline = time.perf_counter() + 120.0
+        victim = None
+        while victim is None and time.perf_counter() < deadline:
+            busy = [r for r in fleet.registry.alive()
+                    if r.outstanding > 0]
+            victim = busy[0].addr if busy else None
+            time.sleep(0.02)
+        assert victim is not None, "no replica ever reported work"
+        fleet.request_migration(victim)
+        for t in threads:
+            t.join(timeout=300.0)
+        assert not errors, f"spec request lost in migration: {errors[0]!r}"
+        for i in range(migrate_requests):
+            assert results[i]["tokens"] == refs[i], \
+                f"migrated spec request {i} diverged from the reference"
+        c = fleet.snapshot()["counters"]
+        moved = (c.get("migration_resumes", 0)
+                 + c.get("migration_reruns", 0))
+        assert moved >= 1, f"migration never moved a request: {c}"
+        resumes = int(c.get("migration_resumes", 0))
+        client.close()
+    finally:
+        fleet.stop()
+    return (warm_ttft, cold_ttft, spec_itl, base_itl, accept, resumes)
+
+
 def bench_fleet_prefix_affinity(n_requests=24, replicas=2, rows=4,
                                 n_prefixes=2, max_new_tokens=6,
                                 workers=8):
@@ -2533,6 +2717,26 @@ def main():
         out["serving_prefix_cold_ttft_ms"] = round(cold_ttft, 2)
         out["serving_prefix_requests_per_sec"] = round(rps, 2)
         out["serving_prefix_cache_hit_rate"] = round(hit_rate, 3)
+        flush_partial()
+    sc = attempts(bench_serving_spec_compose,
+                  "speculative composition bench", n=1)
+    if sc:
+        # Spec composed with the fast path (the bypass burn-down):
+        # spec+prefix warm TTFT strictly below spec cold (streams
+        # equal), spec inter-token p50 vs the non-spec baseline
+        # (perfect-draft ceiling), and a live spec fleet drain-migrated
+        # mid-stream with ZERO lost requests (asserted in-bench).
+        warm_ttft, cold_ttft, spec_itl, base_itl, accept, resumes = sc[0]
+        out["serving_spec_warm_ttft_ms"] = round(warm_ttft, 2)
+        out["serving_spec_cold_ttft_ms"] = round(cold_ttft, 2)
+        out["serving_spec_prefix_speedup"] = round(
+            cold_ttft / warm_ttft, 3)
+        out["serving_spec_decode_p50_intertoken_ms"] = round(spec_itl, 3)
+        out["serving_spec_baseline_p50_intertoken_ms"] = round(
+            base_itl, 3)
+        out["serving_spec_acceptance_rate"] = round(accept, 3)
+        out["serving_spec_migration_lost_requests"] = 0
+        out["serving_spec_migration_resumes"] = int(resumes)
         flush_partial()
     lsv = attempts(bench_serving_longctx, "long-context serving bench",
                    n=1)

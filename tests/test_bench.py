@@ -123,6 +123,25 @@ def test_serving_pipeline_bench_smoke():
     assert pipe_itl > 0 and base_itl > 0 and pipe_rps > 0
 
 
+@pytest.mark.slow
+def test_serving_spec_compose_bench_smoke():
+    """The spec-composition protocol end to end at tiny size,
+    ``strict=False``: every CORRECTNESS assert stays hard (warm spec
+    streams equal cold, perfect-draft acceptance ~1.0, zero lost
+    requests and reference-exact streams through the mid-decode fleet
+    migration), while the strict TIMING win (spec+prefix warm TTFT <
+    cold) is asserted only at flagship scale — toy shapes invert
+    timings."""
+    warm_ttft, cold_ttft, spec_itl, base_itl, accept, resumes = \
+        bench.bench_serving_spec_compose(
+            n_requests=4, rows=2, tiny=True, decode_new=24,
+            migrate_requests=4, strict=False)
+    assert warm_ttft > 0 and cold_ttft > 0
+    assert spec_itl > 0 and base_itl > 0
+    assert 0.0 <= accept <= 1.0
+    assert resumes >= 0
+
+
 def test_serving_warmup_bench_smoke():
     warm_ttft, cold_ttft, warm_s = bench.bench_serving_warmup(
         rows=2, tiny=True)

@@ -481,6 +481,32 @@ def test_serve_parser_kv_tier_flags_and_submit_session():
             validate_kv_tier_dir(bad)
 
 
+def test_serve_parser_draft_flags():
+    """tfserve --draft/--n-draft (speculative decoding fleet-wide) and
+    the launcher/replica passthrough: the flags reach the Mode-B
+    replica command line so every launch — boot or elastic relaunch —
+    serves speculatively."""
+    import types
+
+    from tfmesos_tpu.cli import build_serve_parser
+    from tfmesos_tpu.fleet.launcher import FleetServer
+    from tfmesos_tpu.fleet.replica import build_parser
+
+    args = build_serve_parser().parse_args(["--draft", "--n-draft", "6"])
+    assert args.draft and args.n_draft == 6
+    defaults = build_serve_parser().parse_args([])
+    assert not defaults.draft and defaults.n_draft == 4
+    fs = FleetServer(replicas=1, draft=True, n_draft=6)
+    fs.registry = types.SimpleNamespace(addr="reg:1")
+    cmd = fs._replica_cmd()
+    assert "--draft" in cmd.split() and "--n-draft 6" in cmd
+    fs2 = FleetServer(replicas=1)
+    fs2.registry = types.SimpleNamespace(addr="reg:1")
+    assert "--draft" not in fs2._replica_cmd()
+    rargs = build_parser().parse_args(["--draft", "--n-draft", "6"])
+    assert rargs.draft and rargs.n_draft == 6
+
+
 def test_simulate_sessions_scenario_cli(capfd):
     """`tfserve simulate sessions` runs end to end and reports the
     tier hit rate."""

@@ -209,25 +209,23 @@ def test_import_bypasses_prefix_cache_explicitly_when_quantized(setup):
 # -- gates and validation ----------------------------------------------------
 
 
-def test_export_mode_gates(setup):
-    """Speculative batchers refuse export/import (coupled draft-pool
-    state), and export_kv cannot race a live serve loop."""
-    cfg, params = setup
+def _draft(max_len=64, n_draft=2, seed=1):
     draft_cfg = transformer.TransformerConfig(
         vocab_size=97, d_model=16, n_layers=1, n_heads=2, d_ff=32,
-        max_seq_len=128 + 8, dtype=jnp.float32)
-    draft_params = transformer.init_params(draft_cfg,
-                                           jax.random.PRNGKey(1))
-    spec = _mk(cfg, params, draft_cfg=draft_cfg,
-               draft_params=draft_params, n_draft=2)
+        max_seq_len=max_len + n_draft + 8, dtype=jnp.float32)
+    return dict(draft_cfg=draft_cfg,
+                draft_params=transformer.init_params(
+                    draft_cfg, jax.random.PRNGKey(seed)),
+                n_draft=n_draft)
+
+
+def test_export_mode_gates(setup):
+    """export_kv cannot race a live serve loop (speculative batchers
+    now COMPOSE with export/import — the paired draft payload — so the
+    old spec gate is gone; the bypass-registry audit enforces it stays
+    gone)."""
+    cfg, params = setup
     req = _reqs(cfg, 1)[0]
-    with pytest.raises(ValueError, match="speculative"):
-        spec.export_kv(req)
-    plain = _mk(cfg, params)
-    art = plain.export_kv(req)
-    with pytest.raises(ValueError, match="speculative"):
-        spec.validate(Prefilled(req, art))
-    # A running serve loop owns the rows: export must refuse, loudly.
     b = _mk(cfg, params)
     b.submit(Request(prompt=req.prompt, max_new_tokens=2))
     it = b.serve()
@@ -238,6 +236,90 @@ def test_export_mode_gates(setup):
     list(it)
     assert not b._loop_active   # drained: exports are legal again
     b.export_kv(req)
+
+
+# -- speculative decoding x disaggregation (the bypass burn-down) ------------
+
+
+def test_disagg_spec_matches_unified_spec(setup):
+    """Spec exporter → raw wire → spec importer equals the unified
+    SPECULATIVE batcher token-for-token: the artifact's paired draft
+    payload (dk/dv + the draft header) restores the draft cache
+    bit-exactly, so every later round proposes and commits
+    identically."""
+    cfg, params = setup
+    kw = _draft()
+    reqs = _reqs(cfg, 6, seed=11, stop_every=3)
+    unified = _mk(cfg, params, **kw)
+    ref = {c.rid: c.tokens for c in unified.run(reqs)}
+    pre = _mk(cfg, params, rows=2, **kw)
+    art0 = pre.export_kv(_reqs(cfg, 1, seed=11)[0])
+    assert isinstance(art0.get("dk"), np.ndarray) \
+        and art0["draft"]["n_draft"] == 2
+    got = _run_disagg(pre, _mk(cfg, params, **kw), reqs)
+    for i in range(len(reqs)):
+        assert got[i] == ref[i], f"request {i} diverged (spec)"
+
+
+def test_disagg_spec_int8_target_pool(setup):
+    """Spec + int8 TARGET pool export/import: quantized target pages
+    (values + scales) and the f32 draft payload both move bit-exactly."""
+    cfg, params = setup
+    kw = _draft(seed=2)
+    reqs = _reqs(cfg, 4, seed=12)
+    unified = _mk(cfg, params, quantized_cache=True, **kw)
+    ref = {c.rid: c.tokens for c in unified.run(reqs)}
+    got = _run_disagg(
+        _mk(cfg, params, rows=2, quantized_cache=True, **kw),
+        _mk(cfg, params, quantized_cache=True, **kw), reqs)
+    for i in range(len(reqs)):
+        assert got[i] == ref[i], f"request {i} diverged (spec int8)"
+
+
+def test_draftless_prefill_feeds_spec_decode_tier(setup):
+    """A DRAFT-LESS prefill tier feeding draft-equipped decode
+    replicas: a fresh (step-1) artifact without a draft payload imports
+    by rebuilding the draft's prompt KV with exactly the chunk write a
+    local spec admission dispatches — completions equal the unified
+    speculative batcher's."""
+    cfg, params = setup
+    kw = _draft(seed=3)
+    reqs = _reqs(cfg, 5, seed=13)
+    unified = _mk(cfg, params, **kw)
+    ref = {c.rid: c.tokens for c in unified.run(reqs)}
+    got = _run_disagg(_mk(cfg, params, rows=2),   # no draft on prefill
+                      _mk(cfg, params, **kw), reqs)
+    for i in range(len(reqs)):
+        assert got[i] == ref[i], f"request {i} diverged (draftless pre)"
+
+
+def test_spec_artifact_validation(setup):
+    """Mismatches are loud: a spec artifact is rejected by a draft-less
+    importer, a MID-STREAM artifact without draft state is rejected by
+    a spec importer, and draft-geometry mismatches (n_draft) reject."""
+    cfg, params = setup
+    kw = _draft(seed=4)
+    # Fixed 10-token prompt: the tampered pos below stays inside the
+    # same page, so the draft check (not a shape check) is what fires.
+    req = Request(prompt=(np.arange(1, 11, dtype=np.int32) % 97),
+                  max_new_tokens=4)
+    spec = _mk(cfg, params, **kw)
+    art = spec.export_kv(req)
+    plain = _mk(cfg, params)
+    with pytest.raises(ValueError, match="draft"):
+        plain.validate(Prefilled(req, art))
+    other = _mk(cfg, params, **dict(_draft(seed=4), n_draft=3))
+    with pytest.raises(ValueError, match="n_draft"):
+        other.validate(Prefilled(req, art))
+    # A mid-stream (suspended-shaped) artifact with the draft payload
+    # stripped: a spec importer cannot rebuild mid-stream draft state.
+    bad = {k: v for k, v in art.items()
+           if k not in ("dk", "dv", "draft")}
+    bad["step"], bad["tokens"] = 2, [art["first_token"], 3]
+    bad["pos"] = art["pos"] + 1
+    req2 = Request(prompt=req.prompt.copy(), max_new_tokens=9)
+    with pytest.raises(ValueError, match="draft"):
+        spec.validate(Prefilled(req2, bad))
 
 
 def test_artifact_validation_rejects_mismatches(setup):

@@ -527,6 +527,63 @@ def test_registry_role_and_headroom_fields(stub_fleet):
     sock.close()
 
 
+def test_registry_spec_field_and_fleet_acceptance_rate(stub_fleet):
+    """The spec observability satellite, jax-free: the ``spec``
+    heartbeat field lands on ReplicaInfo, and spec_summary() (the
+    gateway's ``spec`` gauge) aggregates the fleet-wide draft
+    acceptance rate from the per-replica sums — (committed −
+    row_rounds) / (row_rounds × n_draft), so replicas weigh by their
+    actual traffic.  A draft-less fleet omits the rate entirely (no
+    poisoned gauge), and a malformed field costs the field, never the
+    beat."""
+    token, reg, servers = stub_fleet
+    assert reg.spec_summary() == {"replicas": 0, "rounds": 0,
+                                  "committed": 0}
+    sock = wire.connect(reg.addr)
+    # Replica 1: 10 row-rounds x 4 proposals, 30 committed -> 20/40.
+    wire.send_msg(sock, {"op": "hello", "addr": "10.0.1.1:1",
+                         "capacity": 4,
+                         "spec": {"acceptance_rate": 0.5, "rounds": 6,
+                                  "row_rounds": 10, "committed": 30,
+                                  "n_draft": 4}}, token)
+    # Replica 2: 10 x 4, 50 committed -> 40/40 (perfect draft).
+    wire.send_msg(sock, {"op": "hello", "addr": "10.0.1.1:2",
+                         "capacity": 4,
+                         "spec": {"acceptance_rate": 1.0, "rounds": 2,
+                                  "row_rounds": 10, "committed": 50,
+                                  "n_draft": 4}}, token)
+    wire.send_msg(sock, {"op": "hello", "addr": "10.0.1.1:3",
+                         "capacity": 4}, token)      # no draft
+    assert _wait(lambda: len(reg.alive()) == 3)
+    by_addr = {r.addr: r for r in reg.alive()}
+    assert by_addr["10.0.1.1:1"].spec["n_draft"] == 4
+    assert by_addr["10.0.1.1:3"].spec is None
+    agg = reg.spec_summary()
+    assert agg["replicas"] == 2
+    assert agg["rounds"] == 8 and agg["committed"] == 80
+    assert agg["acceptance_rate"] == 0.75       # (80 - 20) / 80
+    # Malformed spec field: field lost, beat kept, aggregate intact.
+    wire.send_msg(sock, {"op": "heartbeat", "addr": "10.0.1.1:1",
+                         "spec": "nope"}, token)
+    time.sleep(0.1)
+    assert {r.addr for r in reg.alive()} >= {"10.0.1.1:1"}
+    assert reg.spec_summary()["replicas"] == 2
+    # ATOMIC folding: a replica advertising committed counts but a
+    # malformed row_rounds must contribute NOTHING to the rate — a
+    # numerator without its denominator would inflate the gauge past
+    # 1.0 (the mixed-version-fleet shape).
+    wire.send_msg(sock, {"op": "hello", "addr": "10.0.1.1:4",
+                         "capacity": 4,
+                         "spec": {"rounds": 9, "committed": 500,
+                                  "row_rounds": "lots",
+                                  "n_draft": 4}}, token)
+    assert _wait(lambda: len(reg.alive()) == 4)
+    agg = reg.spec_summary()
+    assert agg["replicas"] == 3 and agg["committed"] == 80
+    assert agg["acceptance_rate"] == 0.75       # unchanged
+    sock.close()
+
+
 def test_disagg_stub_round_trip(stub_fleet):
     """The tox-lint disagg smoke: gateway → prefill replica → raw-frame
     KV transfer → decode replica → completion, all stubbed (no JAX).

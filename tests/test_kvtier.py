@@ -44,6 +44,51 @@ def test_spill_promote_round_trip(tmp_path):
     assert st["hits"] == 5 and st["corrupt"] == 0
 
 
+def test_draft_artifact_round_trip(tmp_path):
+    """A speculative batcher's TWIN-PAGE entries (body = target k+v
+    then draft k+v, meta carrying both sides' byte counts and the
+    draft geometry) ride the store as one opaque blob: demoted to an
+    HMAC-framed disk file under RAM pressure and read back
+    byte-identical with the paired meta intact — the store never needs
+    to know a draft exists, which is what keeps the tier jax-free.
+    Paired SESSION artifacts (pack_prefilled shape with dk/dv leaves)
+    park and resume the same way."""
+    store = KVTierStore(ram_bytes=4000, disk_dir=str(tmp_path),
+                        disk_bytes=1 << 20, token="tok")
+    bodies = {}
+    for i in range(4):
+        tk = bytes([i]) * 600           # target k+v halves
+        dk = bytes([0x80 + i]) * 200    # the smaller draft twin
+        body = tk + tk + dk + dk
+        meta = {"k_bytes": len(tk), "dk_bytes": len(dk),
+                "draft": {"n_layers": 1, "kv_heads": 2, "head_dim": 8,
+                          "dtype": "float32"}}
+        store.put_prefix(f"d{i}", meta, body)
+        bodies[f"d{i}"] = (meta, body)
+    st = store.stats()
+    assert st["spills"] == 4 and st["demotions"] >= 1
+    for key, (meta, body) in bodies.items():
+        got = store.get_prefix(key)
+        assert got is not None, f"{key} lost"
+        assert got[1] == body
+        assert got[0]["dk_bytes"] == meta["dk_bytes"]
+        assert got[0]["draft"] == meta["draft"]
+    # A paired session artifact (the spec park shape): meta lists the
+    # dk/dv array manifest, the one concatenated body holds all four.
+    sess_meta = {"version": 1, "step": 3, "tokens": [7, 8, 9],
+                 "draft": {"n_draft": 4, "quantized": False},
+                 "arrays": [{"name": n, "dtype": "float32",
+                             "shape": [1, 2, 2, 4, 8]}
+                            for n in ("k", "v", "dk", "dv")]}
+    sess_body = b"".join(bytes([i]) * 256 for i in range(4))
+    store.park("conv", sess_meta, sess_body)
+    got = store.resume("conv")
+    assert got is not None and got[1] == sess_body
+    assert [a["name"] for a in got[0]["arrays"]] == ["k", "v", "dk",
+                                                    "dv"]
+    assert got[0]["draft"]["n_draft"] == 4
+
+
 def test_ram_lru_eviction_order_without_disk():
     store = KVTierStore(ram_bytes=2500, token="t")
     for i in range(3):

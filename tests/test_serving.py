@@ -1423,24 +1423,98 @@ def test_prefix_cache_budget_caps_residency(setup):
 
 
 def test_prefix_cache_bypasses_are_explicit(setup, draft_setup):
-    """Speculative decoding and quantized pools don't share pages — but
-    the bypass must be DISCOVERABLE, and serving must stay correct."""
+    """Quantized pools (target OR draft) don't share pages — the
+    bypass must be DISCOVERABLE, and serving must stay correct.
+    Speculative decoding now COMPOSES (the burn-down: its trie couples
+    target pages with draft-pool twins), so a spec batcher's cache is
+    ACTIVE — the audit test keeps 'speculative decoding' out of the
+    reachable set for good."""
     cfg, params = setup
     dcfg, dparams = draft_setup
     kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
     spec = ContinuousBatcher(cfg, params, draft_cfg=dcfg,
                              draft_params=dparams, n_draft=2,
                              prefix_cache_pages=8, **kw)
-    assert not spec.prefix_cache_active
-    assert spec.prefix_cache_bypass_reason == "speculative decoding"
-    assert spec.prefix_cache_stats() is None
+    assert spec.prefix_cache_active
+    assert spec.prefix_cache_bypass_reason is None
     q = ContinuousBatcher(cfg, params, quantized_cache=True,
                           prefix_cache_pages=8, **kw)
     assert not q.prefix_cache_active
     assert q.prefix_cache_bypass_reason == "quantized kv cache"
+    dq = ContinuousBatcher(cfg, params, draft_cfg=dcfg,
+                           draft_params=dparams, n_draft=2,
+                           draft_quantized_cache=True,
+                           prefix_cache_pages=8, **kw)
+    assert not dq.prefix_cache_active
+    assert dq.prefix_cache_bypass_reason == "quantized kv cache"
     # Bypassed batchers still serve the shared-prefix stream correctly.
     reqs = _shared_prefix_reqs(cfg, 3, sys_len=20, new=3)
-    assert len(list(spec.run(reqs))) == 3
+    assert len(list(q.run(reqs))) == 3
+
+
+def test_spec_prefix_cache_exact_vs_cold(setup, draft_setup):
+    """Spec + prefix cache (the burn-down's headline composition):
+    warm speculative completions EQUAL cold speculative completions —
+    both pools' twin pages map read-only, only the uncached tail
+    prefills (target tail writer + draft chunk writer) — and BOTH
+    pools' accounting balances after the drain."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_bucket=16,
+              draft_cfg=dcfg, draft_params=dparams, n_draft=3)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    want = _tokens_in_order(cold, _shared_prefix_reqs(cfg, 5))
+    assert _tokens_in_order(warm, _shared_prefix_reqs(cfg, 5)) == want
+    st = warm.prefix_cache_stats()
+    assert st["hits"] == 4 and st["misses"] == 1
+    # A second stream hits on EVERY request (twin pages stay resident).
+    assert _tokens_in_order(warm, _shared_prefix_reqs(cfg, 5)) == want
+    st = warm.prefix_cache_stats()
+    assert st["hits"] == 9
+    # Each node holds a page on BOTH pools: free + cached + sink
+    # accounts for each pool exactly.
+    assert warm.alloc.rows == {} and warm.d_side.alloc.rows == {}
+    assert len(warm.alloc.free) + st["cached_pages"] + 1 == warm.n_pages
+    assert len(warm.d_side.alloc.free) + st["cached_pages"] + 1 \
+        == warm.n_draft_pages
+
+
+def test_spec_prefix_cache_cow_full_hit(setup, draft_setup):
+    """A page-aligned full-prompt hit on a SPEC batcher must COW the
+    deepest page on BOTH pools (the one-token rewrite and the draft
+    round's scan both write E-1) and stay exact."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_bucket=16,
+              draft_cfg=dcfg, draft_params=dparams, n_draft=3)
+    prompt = np.random.RandomState(3).randint(
+        0, cfg.vocab_size, size=48).astype(np.int32)   # exactly 3 pages
+    mk = lambda: [Request(prompt=prompt, max_new_tokens=12)]
+    cold = ContinuousBatcher(cfg, params, **kw)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    want = _tokens_in_order(cold, mk())
+    assert _tokens_in_order(warm, mk()) == want     # miss, publishes
+    assert _tokens_in_order(warm, mk()) == want     # full hit -> COW
+    st = warm.prefix_cache_stats()
+    assert st["cow_copies"] == 1 and st["hits"] == 1
+
+
+def test_spec_prefix_cache_with_chunked_prefill(setup, draft_setup):
+    """Spec + prefix cache + chunked prefill: a hit skips straight to
+    the uncached tail on the chunk grid for BOTH pools (the draft's
+    chunks advance from the tail), outputs equal the cache-off spec
+    chunked batcher's."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    kw = dict(rows=2, max_len=96, page_size=16, prefill_chunk=16,
+              draft_cfg=dcfg, draft_params=dparams, n_draft=3)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=8, **kw)
+    want = _tokens_in_order(cold, _shared_prefix_reqs(cfg, 4))
+    assert _tokens_in_order(warm, _shared_prefix_reqs(cfg, 4)) == want
+    assert _tokens_in_order(warm, _shared_prefix_reqs(cfg, 4)) == want
+    assert warm.prefix_cache_stats()["hits"] >= 4
 
 
 def test_prefix_cache_with_chunked_prefill(setup):
@@ -1693,7 +1767,8 @@ def _wait_first_admission(b, deadline_s=120.0):
 def _preempt_variant_kw(variant):
     """The equivalence-matrix configs the suspend/resume contract must
     hold across (greedy/sampled, int8 kv pool, chunked prefill, prefix
-    cache)."""
+    cache, SPECULATIVE decoding incl. its int8-target composition —
+    the bypass burn-down's preemption arm)."""
     import jax
 
     kw = dict(rows=1, max_len=64, page_size=16, prefill_bucket=16)
@@ -1705,12 +1780,22 @@ def _preempt_variant_kw(variant):
         kw.update(prefill_chunk=16)
     elif variant == "pcache":
         kw.update(prefix_cache_pages=8)
+    elif variant in ("spec", "spec_int8"):
+        dcfg = transformer.TransformerConfig(
+            vocab_size=97, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+            max_seq_len=128, dtype=jnp.float32)
+        kw.update(draft_cfg=dcfg,
+                  draft_params=transformer.init_params(
+                      dcfg, jax.random.PRNGKey(5)),
+                  n_draft=3)
+        if variant == "spec_int8":
+            kw.update(quantized_cache=True)
     return kw
 
 
 @pytest.mark.parametrize("variant",
                          ["greedy", "sampled", "int8", "chunked",
-                          "pcache"])
+                          "pcache", "spec", "spec_int8"])
 def test_preempt_resume_token_identical(setup, variant):
     """THE preemption/migration acceptance: with rows=1, a higher-
     priority arrival deterministically SUSPENDS the resident row (its
@@ -2259,13 +2344,20 @@ def test_kv_tier_park_rejection_explicit(setup):
 def test_kv_tier_bypasses_are_explicit(setup, draft_setup):
     """Modes the single-shard export/import scatter cannot serve
     BYPASS the tier discoverably (the bypass-registry discipline) and
-    serving stays correct."""
+    serving stays correct.  Speculative decoding now COMPOSES (spec
+    parks carry the paired draft payload) — only quantized pools
+    (either side) still bypass."""
     cfg, params = setup
     dcfg, dparams = draft_setup
     kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
     spec = ContinuousBatcher(cfg, params, draft_cfg=dcfg,
                              draft_params=dparams, kv_tier=_tier(), **kw)
-    assert spec.kv_tier_bypass_reason == "speculative decoding"
+    assert spec.kv_tier_bypass_reason is None
+    dq = ContinuousBatcher(cfg, params, draft_cfg=dcfg,
+                           draft_params=dparams,
+                           draft_quantized_cache=True, kv_tier=_tier(),
+                           **kw)
+    assert dq.kv_tier_bypass_reason == "quantized kv cache"
     q = ContinuousBatcher(cfg, params, quantized_cache=True,
                           kv_tier=_tier(), **kw)
     assert q.kv_tier_bypass_reason == "quantized kv cache"
@@ -2274,3 +2366,182 @@ def test_kv_tier_bypasses_are_explicit(setup, draft_setup):
                                          size=9).astype(np.int32)
     (c,) = list(q.run([Request(p, 3, session_id="s")]))
     assert len(c.tokens) == 3
+
+
+def _spec_kw(max_len=128, n_draft=3):
+    """A draft whose max_seq_len covers max_len + n_draft + 1 (the
+    verify overshoot) — session tests run at max_len 128, past the
+    module draft fixture's 128 cap."""
+    dcfg = transformer.TransformerConfig(
+        vocab_size=97, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        max_seq_len=max_len + n_draft + 8, dtype=jnp.float32)
+    return dict(draft_cfg=dcfg,
+                draft_params=transformer.init_params(
+                    dcfg, jax.random.PRNGKey(5)),
+                n_draft=n_draft)
+
+
+def test_spec_session_park_resume_token_identical(setup):
+    """A SPECULATIVE multi-turn conversation resumed from the tier —
+    parked draft payload installed, draft tail written in lockstep —
+    must be token-identical to the cold full-history speculative
+    prefill, turn after turn, with BOTH pools balanced after the
+    drain."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=128, page_size=16, prefill_bucket=16,
+              **_spec_kw())
+    tier = _tier()
+    warm = ContinuousBatcher(cfg, params, kv_tier=tier, **kw)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    assert warm.kv_tier_bypass_reason is None
+    rng = np.random.RandomState(3)
+    hist = list(rng.randint(0, cfg.vocab_size, size=24))
+    (c,) = list(warm.run([Request(np.asarray(hist, np.int32), 6,
+                                  session_id="conv")]))
+    for turn in range(3):
+        hist += list(c.tokens) + list(rng.randint(0, cfg.vocab_size,
+                                                  size=5 + turn))
+        prompt = np.asarray(hist, np.int32)
+        (ref,) = list(cold.run([Request(prompt, 6)]))
+        (c,) = list(warm.run([Request(prompt, 6, session_id="conv")]))
+        assert c.tokens == ref.tokens, f"turn {turn} diverged (spec)"
+    st = tier.stats()
+    assert st["park"] == 4 and st["resume"] == 3, st
+    assert warm.alloc.rows == {} and warm.d_side.alloc.rows == {}
+
+
+def test_session_park_resume_lagged_modes(setup):
+    """PR 13 follow-up regression: the lagged decode modes
+    (overlap / pipeline_depth=1) used to silently MISS parking — their
+    host view overshoots at finish — so every next turn re-prefilled
+    cold.  The export now clamps to the committed boundary
+    (_export_row(final=True)), so parking works in EVERY mode and
+    resumed turns stay token-identical to the cold full-history
+    prefill."""
+    cfg, params = setup
+    base = dict(rows=2, max_len=128, page_size=16, prefill_bucket=16)
+    cold = ContinuousBatcher(cfg, params, **base)
+    for mode_kw in ({"pipeline_depth": 1}, {"overlap": True}):
+        tier = _tier()
+        warm = ContinuousBatcher(cfg, params, kv_tier=tier, **base,
+                                 **mode_kw)
+        rng = np.random.RandomState(5)
+        hist = list(rng.randint(0, cfg.vocab_size, size=20))
+        (c,) = list(warm.run([Request(np.asarray(hist, np.int32), 6,
+                                      session_id="s")]))
+        for turn in range(2):
+            hist += list(c.tokens) + list(rng.randint(
+                0, cfg.vocab_size, size=4))
+            prompt = np.asarray(hist, np.int32)
+            (ref,) = list(cold.run([Request(prompt, 6)]))
+            (c,) = list(warm.run([Request(prompt, 6, session_id="s")]))
+            assert c.tokens == ref.tokens, (mode_kw, turn)
+        st = tier.stats()
+        # The regression: parks/resumes were silently 0 before.
+        assert st["park"] == 3 and st["resume"] == 2, (mode_kw, st)
+
+
+def test_spec_tier_spill_promote_twin_pages(setup):
+    """Spec + prefix cache + KV tier under allocation pressure: an
+    evicted trie node spills its TARGET page and draft TWIN as one
+    entry; the next matching admission promotes both back into free
+    pool pages — streams exact, both pools' accounting balanced."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16,
+              **_spec_kw(max_len=64))
+    reqs = lambda: [Request(prompt=np.random.RandomState(50 + i).randint(
+                        0, cfg.vocab_size, size=33 + (i % 3)).astype(
+                            np.int32), max_new_tokens=4)
+                    for i in range(10)]
+    cold = ContinuousBatcher(cfg, params, **kw)
+    tier = _tier()
+    warm = ContinuousBatcher(cfg, params, prefix_cache_pages=64,
+                             kv_tier=tier, **kw)
+    want = _tokens_in_order(cold, reqs())
+    assert _tokens_in_order(warm, reqs()) == want
+    st = warm.prefix_cache_stats()
+    ts = tier.stats()
+    assert st["evicted"] > 0 and ts["spills"] == st["evicted"]
+    assert _tokens_in_order(warm, reqs()) == want
+    ts = tier.stats()
+    st = warm.prefix_cache_stats()
+    assert ts["promotions"] > 0 and st["promoted"] == ts["promotions"]
+    assert len(warm.alloc.free) + st["cached_pages"] + 1 == warm.n_pages
+    assert len(warm.d_side.alloc.free) + st["cached_pages"] + 1 \
+        == warm.n_draft_pages
+    assert warm.alloc.rows == {} and warm.d_side.alloc.rows == {}
+
+
+def test_spec_tier_entries_fenced_from_draftless_peers(setup):
+    """A spec batcher's twin-page tier entries are geometry-fenced: a
+    draft-less batcher sharing the same store reads them as misses
+    (never installs half an entry), and vice versa — serving stays
+    exact on both."""
+    cfg, params = setup
+    tier = _tier()
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16)
+    reqs = lambda: [Request(prompt=np.random.RandomState(70 + i).randint(
+                        0, cfg.vocab_size, size=33).astype(np.int32),
+                        max_new_tokens=3)
+                    for i in range(8)]
+    cold = ContinuousBatcher(cfg, params, **kw)
+    want = _tokens_in_order(cold, reqs())
+    spec = ContinuousBatcher(cfg, params, prefix_cache_pages=64,
+                             kv_tier=tier, **dict(kw, **_spec_kw(64)))
+    assert _tokens_in_order(spec, reqs()) == want
+    assert tier.stats()["spills"] > 0
+    plain = ContinuousBatcher(cfg, params, prefix_cache_pages=64,
+                              kv_tier=tier, **kw)
+    assert _tokens_in_order(plain, reqs()) == want
+    # The plain batcher promoted NOTHING from the spec-cut entries.
+    assert plain.prefix_cache_stats()["promoted"] == 0
+
+
+# -- the bypass-registry audit (the burn-down, enforced) ---------------------
+
+
+def test_bypass_registry_audit(setup):
+    """Enumerate EVERY ``*_bypass_reason`` value reachable from a
+    ContinuousBatcher config through the one pure helper __init__
+    itself uses, and fail on any value outside the documented
+    allowlist — the burn-down is enforceable, not aspirational.  Also
+    pins the burn-down itself: 'speculative decoding' is no longer
+    reachable in the prefix_cache or kv_tier registries."""
+    import itertools
+
+    from tfmesos_tpu.serving import (BYPASS_ALLOWLIST,
+                                     compute_bypass_reasons)
+
+    reachable = {k: set() for k in BYPASS_ALLOWLIST}
+    for spec_on, shards, q, dq, pd in itertools.product(
+            (False, True), (1, 2, 4), (False, True), (False, True),
+            (0, 1)):
+        reasons = compute_bypass_reasons(
+            speculative=spec_on, n_shards=shards, quantized_cache=q,
+            draft_quantized_cache=dq, pipeline_depth=pd)
+        assert set(reasons) == set(BYPASS_ALLOWLIST)
+        for reg, val in reasons.items():
+            if val is not None:
+                reachable[reg].add(val)
+    for reg, vals in reachable.items():
+        extra = vals - set(BYPASS_ALLOWLIST[reg])
+        assert not extra, (
+            f"bypass registry {reg!r} reaches undocumented reasons "
+            f"{sorted(extra)} — add a burn-down plan or remove the "
+            f"bypass (BYPASS_ALLOWLIST is the contract)")
+    # The burn-down, pinned: spec composes with the prefix cache and
+    # the KV tier now.
+    assert "speculative decoding" not in reachable["prefix_cache"]
+    assert "speculative decoding" not in reachable["kv_tier"]
+    # And __init__ really uses the helper (spot-check: a live batcher's
+    # attributes equal the helper's output for its config).
+    cfg, params = setup
+    kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16,
+              prefix_cache_pages=8)
+    b = ContinuousBatcher(cfg, params, quantized_cache=True,
+                          kv_tier=_tier(), pipeline_depth=1, **kw)
+    want = compute_bypass_reasons(quantized_cache=True,
+                                  pipeline_depth=1)
+    assert b.prefix_cache_bypass_reason == want["prefix_cache"]
+    assert b.kv_tier_bypass_reason == want["kv_tier"]
+    assert b.pipeline_bypass_reason == want["pipeline"]

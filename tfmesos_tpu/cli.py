@@ -280,6 +280,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "before syncing block N's tokens; token "
                         "streams identical to 0, the synchronous "
                         "default — docs/SERVING.md)")
+    p.add_argument("--draft", action="store_true",
+                   help="replicas serve with a DRAFT companion model "
+                        "(speculative decoding): each tick commits "
+                        "1..n_draft+1 tokens instead of exactly 1 — "
+                        "the single-stream latency lever — and it "
+                        "composes with --prefix-cache, --kv-tier-mb, "
+                        "disagg roles, and migration; the fleet-wide "
+                        "draft acceptance rate is the 'spec' gauge in "
+                        "'tfserve metrics' (docs/SERVING.md "
+                        "'Speculative decoding & composition')")
+    p.add_argument("--n-draft", type=int, default=4, dest="n_draft",
+                   metavar="K",
+                   help="draft proposals per speculative round "
+                        "(with --draft)")
     p.add_argument("--kv-tier-mb", type=float, default=0.0,
                    dest="kv_tier_mb", metavar="MB",
                    help="per-replica host-RAM KV tier budget in MB (0 "
@@ -978,6 +992,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         breakers=args.breakers,
         prefix_cache_pages=args.prefix_cache,
         pipeline_depth=args.pipeline_depth,
+        draft=args.draft, n_draft=args.n_draft,
         kv_tier_mb=args.kv_tier_mb, kv_tier_dir=args.kv_tier_dir,
         warmup=args.warmup,
         report_interval=args.metrics_interval or None,

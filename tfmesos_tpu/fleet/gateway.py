@@ -170,6 +170,12 @@ class Gateway:
         if hasattr(self.registry, "kv_tier_summary"):
             metrics.register_gauge("kv_tier",
                                    self.registry.kv_tier_summary)
+        # Speculative decoding fleet-wide: replicas serving with a
+        # draft and their aggregate acceptance rate — the biggest
+        # single-stream latency lever's health number, visible through
+        # `tfserve metrics` and Prometheus like every dict gauge.
+        if hasattr(self.registry, "spec_summary"):
+            metrics.register_gauge("spec", self.registry.spec_summary)
         # Items that expired while queued still owe the client an
         # explicit answer — the controller hands them back here from
         # whichever worker's get() swept them.

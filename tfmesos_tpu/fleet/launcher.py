@@ -108,6 +108,8 @@ class FleetServer:
                  multi_step: int = 1,
                  prefix_cache_pages: int = 0,
                  pipeline_depth: int = 0,
+                 draft: bool = False,
+                 n_draft: int = 4,
                  kv_tier_mb: float = 0.0,
                  kv_tier_dir: Optional[str] = None,
                  warmup: bool = False,
@@ -201,6 +203,17 @@ class FleetServer:
         self.multi_step = int(multi_step)
         self.prefix_cache_pages = int(prefix_cache_pages)
         self.pipeline_depth = int(pipeline_depth)
+        #: speculative decoding per replica (replicas serve with the
+        #: preset draft companion model; the acceptance rate rides
+        #: heartbeats into the gateway's ``spec`` gauge).  Composes
+        #: with the prefix cache, the KV tier, migration, and the
+        #: disagg role split — the bypass registry enforces what
+        #: doesn't (docs/SERVING.md "Speculative decoding &
+        #: composition").
+        self.draft = bool(draft)
+        self.n_draft = int(n_draft)
+        if self.draft and self.n_draft < 1:
+            raise ValueError(f"n_draft must be >= 1, got {n_draft}")
         #: tiered KV store per replica (docs/SERVING.md "KV tiering &
         #: sessions"): a >0 RAM budget turns it on; with no explicit
         #: disk dir the launcher mints ONE host-shared temp directory
@@ -322,6 +335,8 @@ class FleetServer:
             parts += ["--prefix-cache-pages", str(self.prefix_cache_pages)]
         if self.pipeline_depth:
             parts += ["--pipeline-depth", str(self.pipeline_depth)]
+        if self.draft:
+            parts += ["--draft", "--n-draft", str(self.n_draft)]
         if self.kv_tier_mb > 0:
             parts += ["--kv-tier-mb", str(self.kv_tier_mb)]
             tier_dir = self.kv_tier_dir or self._kv_tier_tmp

@@ -73,6 +73,13 @@ class ReplicaInfo:
     # affinity matcher can steer shared prompts at TIER-resident pages
     # too), plus counters/occupancy for the gateway's kv_tier gauge.
     kv_tier: Optional[dict] = None
+    # Speculative-decoding summary piggybacked on heartbeats
+    # ({acceptance_rate, rounds, row_rounds, committed, n_draft}) —
+    # the draft acceptance rate is THE spec-serving health number, and
+    # this is how it becomes visible fleet-wide (the gateway's ``spec``
+    # gauge aggregates it).  None until a draft-equipped replica
+    # advertises one.
+    spec: Optional[dict] = None
     # Disaggregated serving: the replica's advertised tier (prefill /
     # decode / unified — unified when it never says) and its free-KV-
     # page headroom, both heartbeat fields.  Decode-tier routing places
@@ -371,6 +378,8 @@ class ReplicaRegistry:
                 # A tier advertising spilled prefix digests joins the
                 # affinity-scan gate the same way a device summary does.
                 rep.kv_tier = msg["kv_tier"]
+            if isinstance(msg.get("spec"), dict):
+                rep.spec = msg["spec"]
             self._prefix_count += _advertises_prefix(rep) - before
             if msg.get("role") in ROLES and rep.role != msg["role"]:
                 rep.role = msg["role"]
@@ -545,6 +554,52 @@ class ReplicaRegistry:
                         if isinstance(v, (int, float)) \
                                 and not isinstance(v, bool):
                             agg[k] = agg.get(k, 0) + int(v)
+        return agg
+
+    def spec_summary(self) -> Dict[str, Any]:
+        """Fleet-wide speculative-decoding aggregate (the gateway's
+        ``spec`` gauge, reachable through ``tfserve metrics`` and the
+        Prometheus exposition): how many replicas serve with a draft,
+        summed round/commit counters, and the fleet-wide draft
+        ACCEPTANCE RATE — accepted proposals over proposal
+        opportunities, recomputed from the per-replica sums so
+        replicas with different traffic weigh by their actual rounds.
+        ``acceptance_rate`` is present only once a speculative round
+        has run somewhere (a dict-gauge key that would be None is
+        omitted rather than poisoning the exposition)."""
+        agg: Dict[str, Any] = {"replicas": 0, "rounds": 0,
+                               "committed": 0}
+        row_rounds = 0
+        opportunities = 0
+
+        def _int(v):
+            return (int(v) if isinstance(v, int)
+                    and not isinstance(v, bool) and v >= 0 else None)
+
+        with self._lock:
+            for rep in self._table.values():
+                sp = rep.spec
+                if not isinstance(sp, dict):
+                    continue
+                agg["replicas"] += 1
+                # A replica's counters fold in ATOMICALLY or not at
+                # all: summing a malformed replica's committed into
+                # the numerator while its row_rounds drop out of the
+                # denominator would inflate the fleet rate past 1.0
+                # (the mixed-version-fleet shape).
+                vals = [_int(sp.get(k)) for k in
+                        ("rounds", "committed", "row_rounds",
+                         "n_draft")]
+                if any(v is None for v in vals):
+                    continue
+                rounds, committed, rr, nd = vals
+                agg["rounds"] += rounds
+                agg["committed"] += committed
+                row_rounds += rr
+                opportunities += rr * nd
+        if opportunities > 0:
+            agg["acceptance_rate"] = round(
+                (agg["committed"] - row_rounds) / opportunities, 4)
         return agg
 
     def register_gateway(self, addr: str) -> None:

@@ -46,6 +46,7 @@ from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["ReplicaServer", "BatcherServing", "batcher_handler",
            "prefill_handler", "tiny_model", "flagship_model",
+           "tiny_draft_model", "flagship_draft_model",
            "build_parser", "main"]
 
 
@@ -669,6 +670,37 @@ def flagship_model(seed: int = 0, max_len: int = 1024):
     return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
 
 
+def tiny_draft_model(seed: int = 5, max_len: int = 128, n_draft: int = 4):
+    """The tiny model's DRAFT companion (speculative decoding):
+    deterministic from ``seed`` with the tiny vocab, its max_seq_len
+    covering the verify overshoot (max_len + n_draft + 1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=97, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        max_seq_len=max_len + n_draft + 1, dtype=jnp.float32)
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
+
+
+def flagship_draft_model(seed: int = 1, max_len: int = 1024,
+                         n_draft: int = 4):
+    """The flagship's DRAFT companion: a ~16x-smaller transformer on
+    the flagship vocab — cheap enough that a speculative round's k
+    draft steps cost less than the target tokens they replace."""
+    import jax
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=8192, d_model=128, n_layers=2, n_heads=4, d_ff=352,
+        max_seq_len=max_len + n_draft + 1, dtype=jnp.bfloat16)
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
+
+
 # -- process entry ----------------------------------------------------------
 
 
@@ -726,6 +758,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "N's tokens sync one block behind — token "
                         "streams identical to 0 (the default, fully "
                         "synchronous; docs/SERVING.md)")
+    p.add_argument("--draft", action="store_true",
+                   help="serve with a DRAFT model (speculative "
+                        "decoding): each tick the draft proposes "
+                        "--n-draft tokens and the target verifies them "
+                        "in one chunk, so a row commits 1..n+1 tokens "
+                        "per dispatch; composes with the prefix cache, "
+                        "KV export/import, preemption/migration, and "
+                        "the KV tier, and the acceptance rate rides "
+                        "heartbeats into the gateway's 'spec' gauge")
+    p.add_argument("--n-draft", type=int, default=4, dest="n_draft",
+                   help="draft proposals per speculative round "
+                        "(with --draft)")
     p.add_argument("--warmup", action="store_true",
                    help="compile every jitted serving entry point at "
                         "boot (ContinuousBatcher.warmup) before taking "
@@ -772,6 +816,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         cfg, params = flagship_model(args.seed,
                                      max_len=args.max_len or 1024)
+    draft_cfg = draft_params = None
+    if args.draft:
+        max_len = args.max_len or int(cfg.max_seq_len)
+        if args.tiny:
+            draft_cfg, draft_params = tiny_draft_model(
+                max_len=max_len, n_draft=args.n_draft)
+        else:
+            draft_cfg, draft_params = flagship_draft_model(
+                seed=args.seed + 1, max_len=max_len,
+                n_draft=args.n_draft)
     kv_tier = None
     if args.kv_tier_mb > 0 or args.kv_tier_dir:
         from tfmesos_tpu.fleet.kvtier import KVTierStore
@@ -789,7 +843,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         page_size=args.page_size, prefill_bucket=args.prefill_bucket,
         multi_step=args.multi_step,
         prefix_cache_pages=args.prefix_cache_pages,
-        pipeline_depth=args.pipeline_depth, kv_tier=kv_tier)
+        pipeline_depth=args.pipeline_depth, kv_tier=kv_tier,
+        draft_cfg=draft_cfg, draft_params=draft_params,
+        n_draft=args.n_draft)
     serving = None
     if args.role == "prefill":
         # Prefill-role replicas never decode: no serve loop runs, the
@@ -822,6 +878,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             # affinity key), spilled prefix digests (tier-resident
             # affinity), counters and occupancy for the fleet gauge.
             beat["kv_tier"] = batcher.kv_tier.summary()
+        if batcher.d_side is not None:
+            # Speculative health: the draft acceptance rate (None
+            # before the first round) plus the raw sums the registry's
+            # spec_summary() re-aggregates fleet-wide.
+            beat["spec"] = {
+                "acceptance_rate": batcher.acceptance_rate,
+                "rounds": batcher.spec_rounds,
+                "row_rounds": batcher.spec_row_rounds,
+                "committed": batcher.spec_committed,
+                "n_draft": batcher.n_draft,
+            }
         return beat
 
     server = ReplicaServer(

@@ -57,11 +57,64 @@ from tfmesos_tpu.ops.quant import QTensor
 
 __all__ = ["Request", "Completion", "Suspended", "Expired",
            "ContinuousBatcher", "SubmissionQueue", "Prefilled",
-           "pack_prefilled", "unpack_prefilled"]
+           "pack_prefilled", "unpack_prefilled",
+           "BYPASS_ALLOWLIST", "compute_bypass_reasons"]
 
 # SubmissionQueue.poll's end-of-stream marker (distinct from None, which
 # means "nothing available right now, more may come").
 _CLOSED = object()
+
+#: THE bypass registry's documented allowlist: every reason string a
+#: ``*_bypass_reason`` attribute is allowed to carry, per registry.
+#: The burn-down is ENFORCED, not aspirational — the audit test
+#: (tests/test_serving.py::test_bypass_registry_audit) enumerates every
+#: reachable :class:`ContinuousBatcher` config through
+#: :func:`compute_bypass_reasons` and fails on any value not listed
+#: here, so a new bypass cannot land silently and a removed one cannot
+#: regress.  History: "speculative decoding" was burned out of the
+#: ``prefix_cache`` and ``kv_tier`` registries (spec rows are
+#: first-class citizens of the paged-KV machinery now); the two
+#: remaining spec gaps are constructor REJECTIONS, not bypasses
+#: (spec+multi_step, spec overlap+pipeline_depth — see __init__).
+BYPASS_ALLOWLIST = {
+    # An int8 pool's tail-recompute path (chunk writer) is not
+    # bit-stable against the cold fused prefill, so shared pages could
+    # break the warm==cold equivalence bar; the draft pool's int8 mode
+    # shares the same writer, hence the same reason.
+    "prefix_cache": ("quantized kv cache",),
+    # Mesh data shards pin pages locally (no single-shard scatter to
+    # move), and the int8 tail recompute above breaks resume==cold.
+    "kv_tier": ("mesh data sharding", "quantized kv cache"),
+    # Speculative overlap already carries its round state on device;
+    # composing it with the pipelined carry is the documented remaining
+    # gap (ROADMAP item 6 out-of-scope note).
+    "pipeline": ("speculative decoding",),
+}
+
+
+def compute_bypass_reasons(*, speculative: bool = False,
+                           n_shards: int = 1,
+                           quantized_cache: bool = False,
+                           draft_quantized_cache: bool = False,
+                           pipeline_depth: int = 0
+                           ) -> Dict[str, Optional[str]]:
+    """The ``*_bypass_reason`` values a :class:`ContinuousBatcher`
+    built from these mode flags records — ONE pure function, used by
+    ``__init__`` itself, so the bypass-registry audit test can
+    enumerate every reachable config without building batchers.  Keys
+    mirror :data:`BYPASS_ALLOWLIST`; ``None`` = the feature composes."""
+    quant = quantized_cache or (speculative and draft_quantized_cache)
+    out: Dict[str, Optional[str]] = {
+        "prefix_cache": None, "kv_tier": None, "pipeline": None}
+    if quant:
+        out["prefix_cache"] = "quantized kv cache"
+    if n_shards != 1:
+        out["kv_tier"] = "mesh data sharding"
+    elif quant:
+        out["kv_tier"] = "quantized kv cache"
+    if pipeline_depth and speculative:
+        out["pipeline"] = "speculative decoding"
+    return out
 
 
 class SubmissionQueue:
@@ -217,18 +270,29 @@ class Prefilled:
 
 
 # Artifact array leaves, in their fixed wire order (pack/unpack below).
-_KV_ARRAY_KEYS = ("k", "v", "k_scales", "v_scales")
+# ``dk``/``dv`` (+ scales) are the DRAFT pool's paired payload on a
+# speculative batcher's exports — per-layer draft pages covering the
+# same positions as the target's, so a spec row is suspendable,
+# migratable, disagg-importable, and KV-tier-parkable like any other.
+_KV_ARRAY_KEYS = ("k", "v", "k_scales", "v_scales",
+                  "dk", "dv", "dk_scales", "dv_scales")
 # Everything else in the artifact is a small scalar/dict header.
 # ``step``/``tokens`` carry a SUSPENDED request's mid-stream sampler
 # state (tokens emitted so far); a fresh prefill export has step 1 and
-# tokens == [first_token], so one artifact shape serves both.
+# tokens == [first_token], so one artifact shape serves both.  For a
+# SPECULATIVE row this (rid, step, tokens) triple is the entire spec
+# sampler state too: draft proposals and acceptance/correction draws
+# are pure per-(rid, step+j) key folds, so there is no separate draft
+# rng position to carry — resuming at ``step`` continues the exact
+# streams.  ``draft`` is the draft-side geometry header
+# (layers/heads/dim, quantized flag, n_draft) paired with dk/dv.
 # ``history`` is the SESSION-park addition (the full conversation —
 # prompt + every emitted token — the artifact's pages cover, which is
 # what a resume validates the new turn's prompt against); absent on
 # plain prefill/suspend artifacts.
 _KV_META_KEYS = ("version", "page_size", "prefix_len", "shared_len",
                  "pos", "prompt_len", "first_token", "rid", "quantized",
-                 "model", "step", "tokens", "history")
+                 "model", "step", "tokens", "history", "draft")
 
 
 def pack_prefilled(artifact: dict) -> tuple:
@@ -633,16 +697,18 @@ class _PagedSide:
 
 class _PrefixNode:
     """One cached page-aligned chunk: a trie node owning one resident
-    pool page.  ``ref`` counts the live rows referencing the page
-    read-only; a zero-ref node keeps its page RESIDENT (that is the
-    cache) until the LRU evictor reclaims it under allocation
-    pressure or the budget."""
+    pool page — and, on a speculative batcher, its DRAFT-pool twin
+    (``dpage``): the two pools cover the same token chunk, so they
+    share one refcount and live or die together.  ``ref`` counts the
+    live rows referencing the page read-only; a zero-ref node keeps
+    its page(s) RESIDENT (that is the cache) until the LRU evictor
+    reclaims it under allocation pressure or the budget."""
 
     __slots__ = ("digest", "page", "ref", "parent", "children", "last",
-                 "shard")
+                 "shard", "dpage")
 
     def __init__(self, digest: bytes, page: int, parent, last: int,
-                 shard: int):
+                 shard: int, dpage: Optional[int] = None):
         self.digest = digest
         self.page = page
         self.ref = 1
@@ -650,6 +716,7 @@ class _PrefixNode:
         self.children: Dict[bytes, "_PrefixNode"] = {}
         self.last = last            # LRU tick of the last touch
         self.shard = shard
+        self.dpage = dpage          # draft-pool twin (speculative mode)
 
 
 class _PrefixCache:
@@ -670,14 +737,25 @@ class _PrefixCache:
     LRU leaves only when an allocation would otherwise fail, and
     ``budget`` caps total cached pages per shard at insert time.
 
+    Twin-pool mode (``dside`` — a speculative batcher's draft pool):
+    every node couples one target page with one draft page covering
+    the same chunk, under ONE refcount.  Acquire maps both into the
+    row's tables, publish moves both sides' leading own pages, COW
+    remaps both deepest pages, and eviction frees both — the budget
+    counts NODES (so it caps ``budget`` pages per shard on EACH
+    side).  Either side's allocation pressure can trigger the
+    reclaim, which always frees a page on both.
+
     Thread safety: all mutation happens on the batcher's serve loop;
     ``summary()``/``stats()`` are read from the replica heartbeat
     thread, so every public method takes the lock.
     """
 
     def __init__(self, side: _PagedSide, page_size: int, first: int,
-                 seed: bytes, budget: int, n_shards: int = 1):
+                 seed: bytes, budget: int, n_shards: int = 1,
+                 dside: Optional[_PagedSide] = None):
         self.side = side
+        self.dside = dside
         self.page_size = int(page_size)
         self.first = int(first)     # width of chunk 0 (page - prefix tail)
         self.seed = seed            # chain seed (constant prefix tail)
@@ -697,11 +775,12 @@ class _PrefixCache:
         self._lock = threading.Lock()
         # Eviction-callback seam (the KV-tier spill hook, and anything
         # else that wants the page's content before it returns to the
-        # free list): called as ``on_evict(shard, digest, page)``
-        # BEFORE the page frees, while its pool content is still the
-        # published chunk.  A raising callback costs the spill, never
-        # the eviction — reclaim must always make progress, or the
-        # allocation pressure that triggered it deadlocks admission.
+        # free list): called as ``on_evict(shard, digest, page,
+        # dpage)`` (dpage None without a draft twin) BEFORE the pages
+        # free, while their pool content is still the published chunk.
+        # A raising callback costs the spill, never the eviction —
+        # reclaim must always make progress, or the allocation
+        # pressure that triggered it deadlocks admission.
         self.on_evict = None
         self._stats = {"hits": 0, "misses": 0, "hit_pages": 0,
                        "hit_tokens": 0, "inserted": 0, "evicted": 0,
@@ -709,6 +788,18 @@ class _PrefixCache:
         side.pcache = self
         for s, alloc in enumerate(side.alloc.shards):
             alloc.reclaim = partial(self._reclaim_cb, s)
+        if dside is not None:
+            # Draft-side pressure evicts through the SAME trie (one
+            # eviction frees a page on both sides), and the draft's
+            # headroom() counts the shared zero-ref nodes reclaimable.
+            dside.pcache = self
+            for s, alloc in enumerate(dside.alloc.shards):
+                alloc.reclaim = partial(self._reclaim_cb, s)
+
+    def _dirty(self) -> None:
+        self.side.dirty()
+        if self.dside is not None:
+            self.dside.dirty()
 
     # -- trie walks (call under the lock) ---------------------------------
 
@@ -741,7 +832,7 @@ class _PrefixCache:
     def acquire(self, row: int, nodes: List[_PrefixNode]) -> None:
         """Map ``nodes``' pages read-only into ``row``'s table
         (refcount++ each) — the row's table becomes
-        [shared | these pages | own]."""
+        [shared | these pages | own] — on BOTH pools in twin mode."""
         with self._lock:
             self._tick += 1
             for n in nodes:
@@ -751,18 +842,23 @@ class _PrefixCache:
                 n.last = self._tick
             self.row_nodes[row] = list(nodes)
             self.side.row_cached[row] = [n.page for n in nodes]
-        self.side.dirty()
+            if self.dside is not None:
+                self.dside.row_cached[row] = [n.dpage for n in nodes]
+        self._dirty()
 
     def unmap_last(self, row: int) -> _PrefixNode:
-        """Drop the DEEPEST mapped page from ``row``'s table (the
-        copy-on-write remap: its content moves into a freshly reserved
-        own page); the node's reference is still held — release it via
-        ``release_nodes`` once the copy has been dispatched so the
-        evictor cannot reclaim the source mid-copy."""
+        """Drop the DEEPEST mapped page (both pools' twins in twin
+        mode) from ``row``'s table (the copy-on-write remap: its
+        content moves into a freshly reserved own page); the node's
+        reference is still held — release it via ``release_nodes``
+        once the copy has been dispatched so the evictor cannot
+        reclaim the source mid-copy."""
         with self._lock:
             node = self.row_nodes[row][-1]
             self.side.row_cached[row].pop()
-        self.side.dirty()
+            if self.dside is not None:
+                self.dside.row_cached[row].pop()
+        self._dirty()
         return node
 
     def _drop_ref(self, n: _PrefixNode) -> None:
@@ -781,31 +877,39 @@ class _PrefixCache:
 
     def release_row(self, row: int) -> None:
         """The row finished: drop every reference it holds.  Pages stay
-        resident (zero-ref = the reusable cache) up to the budget."""
+        resident (zero-ref = the reusable cache) up to the budget.
+        Idempotent — in twin mode BOTH sides' release() paths call
+        here, and the second call finds nothing left to drop."""
         with self._lock:
             self._tick += 1
             for n in self.row_nodes.pop(row, []):
                 self._drop_ref(n)
             self.side.row_cached.pop(row, None)
+            if self.dside is not None:
+                self.dside.row_cached.pop(row, None)
 
     def insert_row(self, row: int, shard: int, digests, state) -> None:
         """Publish ``row``'s freshly prefilled full prompt pages into
         the trie: ownership of the leading own pages moves to the cache
         (the row keeps referencing them at the SAME table slots, so no
         table rebuild is needed), extending the path the row already
-        holds.  Stops at the first chunk already published by a
-        concurrent twin (its pages stay own — never two owners for one
-        trie node) or when the per-shard budget cannot be met by
-        evicting."""
+        holds — both pools' pages move together in twin mode.  Stops
+        at the first chunk already published by a concurrent twin (its
+        pages stay own — never two owners for one trie node) or when
+        the per-shard budget cannot be met by evicting."""
         with self._lock:
             self._tick += 1
             held = self.row_nodes.setdefault(row, [])
             own = self.side.alloc.rows.get(row, [])
+            down = (self.dside.alloc.rows.get(row, [])
+                    if self.dside is not None else None)
             cached = self.side.row_cached.setdefault(row, [])
+            dcached = (self.dside.row_cached.setdefault(row, [])
+                       if self.dside is not None else None)
             level = (held[-1].children if held else self.roots[shard])
             moved = 0
             for d in digests[len(held):]:
-                if not own:
+                if not own or (down is not None and not down):
                     break
                 if d in level:
                     break       # a twin published this chunk first
@@ -817,18 +921,25 @@ class _PrefixCache:
                     break
                 node = _PrefixNode(d, own.pop(0),
                                    held[-1] if held else None,
-                                   self._tick, shard)
+                                   self._tick, shard,
+                                   dpage=(down.pop(0)
+                                          if down is not None else None))
                 level[d] = node
                 self._n_nodes[shard] += 1
                 held.append(node)
                 cached.append(node.page)
+                if dcached is not None:
+                    dcached.append(node.dpage)
                 level = node.children
                 moved += 1
             self._stats["inserted"] += moved
         # The row's remaining claim on the pool is unchanged — the
         # moved pages still back its positions — so its reservation
-        # shrinks with its allocation to keep headroom() exact.
+        # shrinks with its allocation to keep headroom() exact (per
+        # side: the draft twin's reservation shrinks identically).
         state.worst_pages -= moved
+        if self.dside is not None:
+            state.worst_draft -= moved
 
     # -- eviction ----------------------------------------------------------
 
@@ -844,8 +955,8 @@ class _PrefixCache:
 
     def _evict_one(self, shard: int) -> bool:
         """Reclaim the LRU zero-ref LEAF (deepest-first keeps every
-        remaining node's chain valid); its page returns to the shard's
-        free list.  Caller holds the lock."""
+        remaining node's chain valid); its page — and its draft twin —
+        return to their shards' free lists.  Caller holds the lock."""
         best = None
         for n in self._walk(shard):
             if n.ref == 0 and not n.children:
@@ -855,7 +966,7 @@ class _PrefixCache:
             return False
         if self.on_evict is not None:
             try:
-                self.on_evict(shard, best.digest, best.page)
+                self.on_evict(shard, best.digest, best.page, best.dpage)
             except Exception:
                 pass    # the spill is best-effort; the eviction stands
         level = (best.parent.children if best.parent is not None
@@ -864,6 +975,8 @@ class _PrefixCache:
         self._n_nodes[shard] -= 1
         self._n_zero[shard] -= 1
         self.side.alloc.shards[shard].free.append(best.page)
+        if self.dside is not None:
+            self.dside.alloc.shards[shard].free.append(best.dpage)
         self._stats["evicted"] += 1
         return True
 
@@ -872,16 +985,17 @@ class _PrefixCache:
             return self._evict_one(shard)
 
     def insert_chain(self, shard: int, parent_digests, digest: bytes,
-                     page: int) -> bool:
-        """Insert ONE already-resident page as a zero-ref trie node
-        under the path ``parent_digests`` — the KV-tier PROMOTION path:
-        the caller took ``page`` off the shard's free list and
-        scattered the tier's stored content into it; on True the cache
-        owns it (zero-ref ⇒ reclaimable, so headroom accounting is
-        unchanged: free lost one page, reclaimable gained one).  False
-        (parent path gone, a twin already published the chunk, or the
-        budget cannot be met) — the caller returns the page to the
-        free list."""
+                     page: int, dpage: Optional[int] = None) -> bool:
+        """Insert ONE already-resident page (plus its draft twin in
+        twin mode) as a zero-ref trie node under the path
+        ``parent_digests`` — the KV-tier PROMOTION path: the caller
+        took ``page`` (and ``dpage``) off the shard's free list(s) and
+        scattered the tier's stored content into them; on True the
+        cache owns them (zero-ref ⇒ reclaimable, so headroom
+        accounting is unchanged: free lost one page per side,
+        reclaimable gained one).  False (parent path gone, a twin
+        already published the chunk, or the budget cannot be met) —
+        the caller returns the page(s) to the free list(s)."""
         with self._lock:
             self._tick += 1
             # Budget FIRST: evicting after the walk could reclaim a
@@ -903,7 +1017,9 @@ class _PrefixCache:
             if digest in level:
                 return False        # already resident (a twin won)
             node = _PrefixNode(digest, int(page), parent, self._tick,
-                               shard)
+                               shard,
+                               dpage=(None if dpage is None
+                                      else int(dpage)))
             node.ref = 0            # resident, unreferenced — the cache
             self._n_zero[shard] += 1
             level[digest] = node
@@ -1103,9 +1219,13 @@ class ContinuousBatcher:
     argmax flips (the tail prefill runs cache-attention, like chunked
     prefill; bit-identical in practice on the CPU test config).
     Composes with ``prefill_chunk``, ``overlap``, ``multi_step``,
-    ``mesh``, and ``prefix``; speculative decoding and
-    ``quantized_cache`` BYPASS sharing explicitly
-    (``prefix_cache_bypass_reason``).
+    ``mesh``, ``prefix``, and SPECULATIVE decoding — a spec batcher's
+    trie couples every target page with its draft-pool twin (one
+    refcount, COW on both deepest pages, twin publish after prefill),
+    so a warm hit maps BOTH pools and prefills only the uncached tail
+    through each side's chunk writer; ``quantized_cache`` (either
+    pool's) BYPASSES sharing explicitly
+    (``prefix_cache_bypass_reason``, see ``BYPASS_ALLOWLIST``).
 
     DISAGGREGATED serving splits the two phases across batchers:
     :meth:`export_kv` runs a prompt through (chunked) prefill only and
@@ -1116,8 +1236,14 @@ class ContinuousBatcher:
     (sampled ones too, when the batchers share an rng: the artifact
     carries the sampler's rid fold).  Imported full prompt pages seed
     the importer's prefix cache like a local prefill's.  Requires a
-    single-shard pool and no speculative draft; int8 pools export/import
-    bit-exactly.  The fleet's prefill/decode role split
+    single-shard pool; int8 pools export/import bit-exactly.  A
+    SPECULATIVE batcher's artifact carries the draft pool's paired
+    payload (``dk``/``dv`` + the ``draft`` header) over the same
+    positions — spec rows export, import, suspend, migrate, and park
+    like any other — and a fresh (step-1) artifact from a draft-less
+    prefill tier imports into a spec batcher by rebuilding the draft's
+    prompt KV locally (the same chunk write a local spec admission
+    dispatches).  The fleet's prefill/decode role split
     (docs/SERVING.md "Disaggregated prefill/decode") rides this surface.
     """
 
@@ -1166,11 +1292,10 @@ class ContinuousBatcher:
         # block N's tokens are synced one block behind.  Speculative
         # decoding bypasses explicitly (a round already carries its
         # state on device under overlap=True); the recorded reason makes
-        # the bypass observable, like prefix_cache_bypass_reason.
+        # the bypass observable, like prefix_cache_bypass_reason.  The
+        # ``*_bypass_reason`` registries themselves are computed after
+        # the mesh parse below (the shard count participates).
         self.pipeline_depth = int(pipeline_depth)
-        self.pipeline_bypass_reason: Optional[str] = None
-        if pipeline_depth and draft_cfg is not None:
-            self.pipeline_bypass_reason = "speculative decoding"
         self._pipe_carry = None     # device (tok, pos, step) carry
         self._pipe_host = None      # cached host-side dispatch inputs
         # Overlap mode: (device outputs of the in-flight dispatch,
@@ -1203,6 +1328,16 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"tp ({self._tp}) must divide kv_heads "
                     f"({cfg.kv_heads}) and n_heads ({cfg.n_heads})")
+        # All three ``*_bypass_reason`` registries come from ONE pure
+        # helper (compute_bypass_reasons) so the audit test can
+        # enumerate every reachable value against BYPASS_ALLOWLIST.
+        self._bypass = compute_bypass_reasons(
+            speculative=draft_cfg is not None, n_shards=self.n_shards,
+            quantized_cache=quantized_cache,
+            draft_quantized_cache=draft_quantized_cache,
+            pipeline_depth=pipeline_depth)
+        self.pipeline_bypass_reason: Optional[str] = \
+            self._bypass["pipeline"]
         self.max_len = int(max_len or cfg.max_seq_len)
         if self.max_len > cfg.max_seq_len:
             raise ValueError(f"max_len ({self.max_len}) exceeds the "
@@ -1336,51 +1471,44 @@ class ContinuousBatcher:
         if prefix_np is not None:
             self._init_prefix(prefix_np)
         # Cross-request prefix cache (prefix_cache_pages > 0 enables;
-        # the value caps resident cached pages PER SHARD).  Modes whose
+        # the value caps resident cached pages PER SHARD — per POOL in
+        # speculative mode, where every trie node couples a target page
+        # with its draft-pool twin under one refcount).  Modes whose
         # pages the cache cannot share bitwise-safely BYPASS explicitly
-        # (prefix_cache_bypass_reason says why, tests assert it):
-        # speculative decoding would need coupled draft-pool sharing,
-        # and an int8 pool's tail-repcompute path is not bit-stable
-        # against the cold fused prefill.
+        # (prefix_cache_bypass_reason, from BYPASS_ALLOWLIST): an int8
+        # pool's tail-recompute path is not bit-stable against the cold
+        # fused prefill (target or draft side alike).
         self._pcache: Optional[_PrefixCache] = None
         self._tail_prefill = None
         self.prefix_cache_bypass_reason: Optional[str] = None
         if prefix_cache_pages:
-            if draft_cfg is not None:
-                self.prefix_cache_bypass_reason = "speculative decoding"
-            elif quantized_cache:
-                self.prefix_cache_bypass_reason = "quantized kv cache"
-            else:
+            self.prefix_cache_bypass_reason = \
+                self._bypass["prefix_cache"]
+            if self.prefix_cache_bypass_reason is None:
                 off = self.prefix_len - self.t_side.shared_len
                 seed = (b"" if not off else _ph.chunk_digest(
                     b"", prefix_np[self.t_side.shared_len:]))
                 self._pcache = _PrefixCache(
                     self.t_side, self.page_size, self.page_size - off,
-                    seed, prefix_cache_pages, n_shards=self.n_shards)
+                    seed, prefix_cache_pages, n_shards=self.n_shards,
+                    dside=self.d_side)
                 self._tail_prefill = (self._chunk_prefill
                                       or self._make_chunk_prefill())
         # Tiered KV store (fleet/kvtier.py; docs/SERVING.md "KV tiering
         # & sessions"): prefix pages evicted from the device pool SPILL
         # into it (promoting back on the next matching admission), and
         # finished session-labeled requests PARK their KV artifacts in
-        # it for leading-KV resumption next turn.  Modes whose per-row
-        # state the single-shard export/import scatter cannot move
-        # BYPASS explicitly (kv_tier_bypass_reason — same discipline as
-        # the other bypass registries).
+        # it for leading-KV resumption next turn.  A speculative
+        # batcher's spills and parks carry the draft pool's paired
+        # payload, so spec sessions resume like any other.  Modes whose
+        # per-row state the single-shard export/import scatter cannot
+        # move BYPASS explicitly (kv_tier_bypass_reason — same
+        # discipline as the other bypass registries).
         self.kv_tier = kv_tier
         self.kv_tier_bypass_reason: Optional[str] = None
         if kv_tier is not None:
-            if draft_cfg is not None:
-                self.kv_tier_bypass_reason = "speculative decoding"
-            elif self.n_shards != 1:
-                self.kv_tier_bypass_reason = "mesh data sharding"
-            elif quantized_cache:
-                # Session resume re-prefills its tail through the
-                # chunk writer, whose int8 path is not bit-stable
-                # against the cold fused prefill — the equivalence bar
-                # (resumed == cold, token-identical) could not hold.
-                self.kv_tier_bypass_reason = "quantized kv cache"
-            else:
+            self.kv_tier_bypass_reason = self._bypass["kv_tier"]
+            if self.kv_tier_bypass_reason is None:
                 if self._tail_prefill is None:
                     self._tail_prefill = (self._chunk_prefill
                                           or self._make_chunk_prefill())
@@ -1406,15 +1534,17 @@ class ContinuousBatcher:
     def preemptible(self) -> bool:
         """Whether this batcher can SUSPEND a resident row (priority
         preemption, per-row drain migration): requires the same
-        single-shard, non-speculative pool as the disaggregated
-        export/import surface (a suspended request IS a KV export), and
-        a host-synchronous decode loop — overlap/pipelined modes carry
-        in-flight device state the host view lags behind, so their rows
-        cannot be snapshotted between blocks.  Non-preemptible batchers
-        still honor :meth:`preempt_all`, by REQUEUEING every in-flight
-        request (lossless through deterministic re-execution) instead
-        of exporting it."""
-        return (self.d_side is None and self.n_shards == 1
+        single-shard pool as the disaggregated export/import surface
+        (a suspended request IS a KV export — a speculative batcher's
+        export carries the draft pool's paired payload, so spec rows
+        suspend like any other), and a host-synchronous decode loop —
+        overlap/pipelined modes carry in-flight device state the host
+        view lags behind, so their rows cannot be snapshotted between
+        blocks.  Non-preemptible batchers still honor
+        :meth:`preempt_all`, by REQUEUEING every in-flight request
+        (lossless through deterministic re-execution) instead of
+        exporting it."""
+        return (self.n_shards == 1
                 and not self.overlap and not self._pipelined)
 
     def preempt_all(self) -> None:
@@ -2021,21 +2151,27 @@ class ContinuousBatcher:
                 plan = (self._prefix_plan(req, s)
                         if self._pcache is not None and use_cache
                         else None)
+                hd = (self.d_side.headroom(
+                          active, lambda x: x.worst_draft, s)
+                      if self.d_side is not None else None)
                 while True:
-                    wt_s = wt - (plan.save if plan is not None else 0)
-                    ht_s = ht
-                    if plan is not None:
-                        # headroom() counts zero-ref cached pages as
-                        # reclaimable, but accepting THIS plan
-                        # references its nodes — they can no longer be
-                        # evicted to satisfy the same admission.
-                        # Discounting wt by plan.save AND counting
-                        # those pages reclaimable would double-count
-                        # them and over-admit (a "page pool exhausted"
-                        # crash out of the serve loop, exactly what
-                        # reservations exist to prevent).
-                        ht_s -= sum(1 for n in plan.nodes if n.ref == 0)
-                    ok = wt_s <= ht_s
+                    save = plan.save if plan is not None else 0
+                    zref = (sum(1 for n in plan.nodes if n.ref == 0)
+                            if plan is not None else 0)
+                    # headroom() counts zero-ref cached pages as
+                    # reclaimable, but accepting THIS plan references
+                    # its nodes — they can no longer be evicted to
+                    # satisfy the same admission.  Discounting wt by
+                    # plan.save AND counting those pages reclaimable
+                    # would double-count them and over-admit (a "page
+                    # pool exhausted" crash out of the serve loop,
+                    # exactly what reservations exist to prevent).
+                    ok = (wt - save) <= ht - zref
+                    if ok and hd is not None:
+                        # Twin-pool plans save the SAME page count on
+                        # the draft side (coupled nodes), and the same
+                        # zero-ref double-count adjustment applies.
+                        ok = (wd - save) <= hd - zref
                     if ok or plan is None:
                         break
                     # A deep plan that doesn't fit (the COW full hit
@@ -2047,9 +2183,6 @@ class ContinuousBatcher:
                     depth = len(plan.nodes) - 1
                     plan = (self._prefix_plan(req, s, max_nodes=depth)
                             if depth else None)
-                if ok and self.d_side is not None:
-                    ok = wd <= self.d_side.headroom(
-                        active, lambda x: x.worst_draft, s)
                 by_shard[s] = (ok, ht, plan)
             ok, ht, plan = by_shard[s]
             if ok:
@@ -2262,13 +2395,16 @@ class ContinuousBatcher:
                     side.pool = side.copy(side.pool, side.sink, dst)
                     jax.block_until_ready(side.pool)
                     compiled.append("page_copy")
-            if self.d_side is None and self.n_shards == 1:
+            if self.n_shards == 1:
                 # The disaggregated surface (export gather + import
                 # scatter) — compiled at the one-page count; larger
                 # transfers trace lazily per page count.  A KV tier
                 # buckets its session park/resume transfers to
                 # power-of-two counts, so warm those too — log2(np_max)
                 # traces, and a resumed turn's TTFT never carries one.
+                # A speculative batcher's exports carry the DRAFT
+                # pool's paired payload, so its gather/scatter pair is
+                # warmed at the same counts.
                 counts = [1]
                 if self.kv_tier is not None \
                         and self.kv_tier_bypass_reason is None:
@@ -2280,6 +2416,14 @@ class ContinuousBatcher:
                     jax.block_until_ready(payload)
                     self.pool = _install_pages(self.pool, payload, ids)
                     jax.block_until_ready(self.pool)
+                    if self.d_side is not None:
+                        dids = jnp.asarray([self.d_side.sink] * c,
+                                           jnp.int32)
+                        dpayload = _gather_pages(self.d_side.pool, dids)
+                        jax.block_until_ready(dpayload)
+                        self.d_side.pool = _install_pages(
+                            self.d_side.pool, dpayload, dids)
+                        jax.block_until_ready(self.d_side.pool)
                     compiled.append(f"kv_export_import[{c}]")
         return {"compiled": compiled,
                 "seconds": round(time.perf_counter() - t0, 3)}
@@ -2287,10 +2431,9 @@ class ContinuousBatcher:
     # -- disaggregated serving: KV export / import -------------------------
 
     def _check_disagg_mode(self, what: str) -> None:
-        if self.d_side is not None:
-            raise ValueError(f"{what} does not compose with speculative "
-                             f"decoding (the draft pool's state would "
-                             f"need coupled transfer)")
+        # Speculative batchers compose: their exports carry the draft
+        # pool's paired payload (dk/dv + the ``draft`` header) and the
+        # spec sampler state is already the (rid, step, tokens) triple.
         if self.n_shards != 1:
             raise ValueError(f"{what} requires a single-shard pool "
                              f"(mesh data shards pin pages locally)")
@@ -2374,32 +2517,74 @@ class ContinuousBatcher:
         the compile set at log2 like the decode-table widths)."""
         return 1 << max(0, int(n) - 1).bit_length()
 
-    def _export_row(self, row: int, state: _Row,
-                    pad_pow2: bool = False) -> dict:
-        """Snapshot ``row``'s post-prefill KV into a host artifact: the
-        pages covering absolute positions [shared_len, pos) — cached
-        prefix pages and own pages alike, in table order — pulled to
-        host in one gather.  Shared-prefix pages are NOT exported: a
-        same-``prefix`` importer already holds identical ones (both
-        sides prefilled the same tokens with the same params).
-        ``pad_pow2`` buckets the GATHER's page count to a power of two
-        (padding with sink reads, sliced off host-side) so the tier's
-        park path dispatches log2(np_max) compiled gathers instead of
-        one per exact count; the artifact itself is unchanged."""
-        side = self.t_side
-        ps = self.page_size
+    def _draft_geom(self) -> Dict[str, Any]:
+        """The draft-side geometry contract: stamped on every export's
+        ``draft`` header and checked field-for-field at every
+        import/resume site — ONE source, so a new header field cannot
+        be added and forgotten in a validator.  (``_tier_geom``'s
+        draft sub-dict is deliberately different: spilled PAGES need
+        the dtype and not n_draft.)"""
+        return {"n_layers": int(self.draft_cfg.n_layers),
+                "kv_heads": int(self.draft_cfg.kv_heads),
+                "head_dim": int(self.draft_cfg.head_dim),
+                "quantized": isinstance(self.d_side.pool["k"], QTensor),
+                "n_draft": int(self.n_draft)}
+
+    def _side_page_export(self, side: _PagedSide, pool, row: int,
+                          n: int, pad_pow2: bool):
+        """Gather ``row``'s pages covering [shared_len, shared_len +
+        n*page_size) from ``pool`` to host — one side of an export.
+        ``pad_pow2`` buckets the gather's page count to a power of two
+        (padding with sink reads, sliced off host-side)."""
         ns = len(side.shared_pages)
-        E = state.pos
-        n = -(-(E - side.shared_len) // ps)
         ids = np.asarray(side.table_np()[row, ns:ns + n], np.int32)
         if pad_pow2:
             m = self._pow2(n)
             if m > n:
                 ids = np.concatenate(
                     [ids, np.full((m - n,), side.sink, np.int32)])
-        kv = _gather_pages(self.pool, jnp.asarray(ids))
+        kv = _gather_pages(pool, jnp.asarray(ids))
         if pad_pow2 and len(ids) > n:
             kv = jax.tree_util.tree_map(lambda a: a[:, :n], kv)
+        return kv
+
+    def _export_row(self, row: int, state: _Row,
+                    pad_pow2: bool = False,
+                    final: bool = False) -> dict:
+        """Snapshot ``row``'s post-prefill KV into a host artifact: the
+        pages covering absolute positions [shared_len, pos) — cached
+        prefix pages and own pages alike, in table order — pulled to
+        host in one gather.  A speculative batcher's artifact carries
+        the DRAFT pool's paired payload over the same positions
+        (``dk``/``dv`` + the ``draft`` geometry header), so a spec row
+        moves whole.  Shared-prefix pages are NOT exported: a
+        same-``prefix`` importer already holds identical ones (both
+        sides prefilled the same tokens with the same params).
+        ``pad_pow2`` buckets the GATHER's page count to a power of two
+        (padding with sink reads, sliced off host-side) so the tier's
+        park path dispatches log2(np_max) compiled gathers instead of
+        one per exact count; the artifact itself is unchanged.
+
+        ``final=True`` exports a FINISHED row at its COMMITTED
+        boundary: the lagged decode modes (overlap/pipelined, spec
+        rounds mid-flight) advance ``pos``/``step`` at dispatch, so a
+        finished row's host view can overshoot the committed stream by
+        the in-flight block — but every position below
+        ``prefix + prompt + len(out) - 1`` was written exactly once
+        with the true token sequence (positions only move forward), so
+        clamping there exports exactly the resumable state.  This is
+        what lets session parking work in every decode mode instead of
+        silently missing cold in the lagged ones."""
+        side = self.t_side
+        ps = self.page_size
+        E = state.pos
+        step = int(state.step)
+        toks = [int(t) for t in state.out]
+        if final:
+            step = len(toks)
+            E = self.prefix_len + int(state.req.prompt.size) + step - 1
+        n = -(-(E - side.shared_len) // ps)
+        kv = self._side_page_export(side, self.pool, row, n, pad_pow2)
         quantized = isinstance(self.pool["k"], QTensor)
         art = {
             "version": 1,
@@ -2412,9 +2597,13 @@ class ContinuousBatcher:
             # Mid-stream sampler state: a SUSPENDED row carries the
             # tokens it already emitted (step > 1) so the importer
             # resumes exactly where this row stopped; a fresh prefill
-            # export is the step-1 degenerate case.
-            "step": int(state.step),
-            "tokens": [int(t) for t in state.out],
+            # export is the step-1 degenerate case.  For speculative
+            # rows this triple is the whole spec sampler state too:
+            # draft proposals and acceptance draws are pure
+            # per-(rid, step+j) key folds — no separate draft rng
+            # position exists to carry.
+            "step": step,
+            "tokens": toks,
             "rid": int(state.rid),
             "quantized": quantized,
             "model": {"n_layers": int(self.cfg.n_layers),
@@ -2429,6 +2618,22 @@ class ContinuousBatcher:
         else:
             art["k"] = np.asarray(kv["k"])
             art["v"] = np.asarray(kv["v"])
+        if self.d_side is not None:
+            # The paired draft-side payload: same positions, the draft
+            # pool's pages (draft shared_len equals the target's — both
+            # sides prefilled the same prefix at the same page size).
+            dkv = self._side_page_export(self.d_side, self.d_side.pool,
+                                         row, n, pad_pow2)
+            art["draft"] = self._draft_geom()
+            dquant = art["draft"]["quantized"]
+            if dquant:
+                art["dk"] = np.asarray(dkv["k"].values)
+                art["dk_scales"] = np.asarray(dkv["k"].scales)
+                art["dv"] = np.asarray(dkv["v"].values)
+                art["dv_scales"] = np.asarray(dkv["v"].scales)
+            else:
+                art["dk"] = np.asarray(dkv["k"])
+                art["dv"] = np.asarray(dkv["v"])
         return art
 
     def _validate_artifact(self, art: dict, req: Request) -> None:
@@ -2496,14 +2701,27 @@ class ContinuousBatcher:
                 f"(+ {step - 1} resumed tokens)")
         n = -(-(E - self.t_side.shared_len) // self.page_size)
         pool_k = self.pool["k"].values if quantized else self.pool["k"]
-        want_shape = (int(self.cfg.n_layers), n, int(self.cfg.kv_heads),
-                      self.page_size, int(self.cfg.head_dim))
-        keys = _KV_ARRAY_KEYS if quantized else _KV_ARRAY_KEYS[:2]
-        for key in keys:
+        self._check_payload_arrays(art, quantized, n, self.cfg, pool_k)
+        self._validate_artifact_draft(art, n, step)
+
+    def _check_payload_arrays(self, art: dict, quantized: bool, n: int,
+                              mcfg, pool_k, prefix: str = "") -> None:
+        """ONE shape/dtype contract for one side's page payload:
+        ``prefix`` '' checks ``k``/``v`` (+ scales) against the target
+        config, ``'d'`` checks ``dk``/``dv`` against the draft's — the
+        two sides' validators cannot silently diverge."""
+        want_shape = (int(mcfg.n_layers), n, int(mcfg.kv_heads),
+                      self.page_size, int(mcfg.head_dim))
+        names = (("k", "v", "k_scales", "v_scales") if quantized
+                 else ("k", "v"))
+        side = "draft " if prefix else ""
+        for name in names:
+            key = prefix + name
             a = art.get(key)
             if not isinstance(a, np.ndarray):
-                raise ValueError(f"KV artifact is missing array {key!r}")
-            if key.endswith("_scales"):
+                raise ValueError(f"KV artifact is missing {side}array "
+                                 f"{key!r}")
+            if name.endswith("_scales"):
                 want = want_shape[:3] + (1, self.page_size)
                 dtype = np.float32
             else:
@@ -2514,7 +2732,49 @@ class ContinuousBatcher:
                                  f"expected {want}")
             if a.dtype != dtype:
                 raise ValueError(f"KV artifact {key} dtype {a.dtype} != "
-                                 f"pool dtype {dtype}")
+                                 f"{side}pool dtype {dtype}")
+
+    def _validate_artifact_draft(self, art: dict, n: int,
+                                 step: int) -> None:
+        """The draft half of :meth:`_validate_artifact`.  A draft-less
+        batcher rejects artifacts carrying a draft payload (resuming a
+        spec row without its draft state would fork sampled streams —
+        loud beats subtly different); a speculative batcher requires a
+        matching draft payload for MID-STREAM artifacts, but accepts a
+        fresh (step-1) prefill export without one: the import rebuilds
+        the draft's prompt KV with exactly the chunk write a local spec
+        admission dispatches, which is what lets a draft-less prefill
+        tier feed draft-equipped decode replicas."""
+        draft = art.get("draft")
+        has_payload = isinstance(art.get("dk"), np.ndarray)
+        if self.d_side is None:
+            if draft is not None or has_payload:
+                raise ValueError(
+                    "KV artifact carries a draft-side payload but this "
+                    "batcher has no draft model (speculative exports "
+                    "resume on speculative batchers)")
+            return
+        if not has_payload:
+            if step > 1:
+                raise ValueError(
+                    "suspended KV artifact has no draft-side payload; "
+                    "a speculative batcher cannot rebuild mid-stream "
+                    "draft state bit-exactly")
+            return      # fresh prefill: the import rebuilds the draft
+        if not isinstance(draft, dict):
+            raise ValueError("KV artifact has draft arrays but no "
+                             "'draft' geometry header")
+        geom = self._draft_geom()
+        for key, want in geom.items():
+            if draft.get(key) != want:
+                raise ValueError(
+                    f"KV artifact draft {key} {draft.get(key)!r} does "
+                    f"not match this batcher's {want!r}")
+        dquant = geom["quantized"]
+        dpool_k = (self.d_side.pool["k"].values if dquant
+                   else self.d_side.pool["k"])
+        self._check_payload_arrays(art, dquant, n, self.draft_cfg,
+                                   dpool_k, prefix="d")
 
     def _admit_import(self, row: int, pre: Prefilled, wt: int,
                       wd: int, need: int, active: Dict[int, _Row]
@@ -2549,6 +2809,8 @@ class ContinuousBatcher:
                        "v": jnp.asarray(art["v"])}
         self.pool = _install_pages(self.pool, payload,
                                    jnp.asarray(ids, jnp.int32))
+        if self.d_side is not None:
+            self._admit_import_draft(row, req, art, n, need)
         # The exported rid keeps the row's in-graph sampling folds on
         # the stream the prefill side started (greedy never reads it;
         # with equal batcher rngs, sampled disaggregated streams equal
@@ -2574,6 +2836,61 @@ class ContinuousBatcher:
         self._pcache_insert(row, state)
         return row, state, np.asarray([int(art["first_token"])]), 0
 
+    def _admit_import_draft(self, row: int, req: Request, art: dict,
+                            n: int, need: int) -> None:
+        """The draft half of :meth:`_admit_import`: scatter the
+        artifact's paired draft payload into own draft pages — or, for
+        a fresh (step-1) export from a draft-less prefill tier, rebuild
+        the draft's prompt KV with EXACTLY the chunk write a local spec
+        admission dispatches (same widths, same offsets), so the draft
+        cache is bit-identical to a local admission's."""
+        dside = self.d_side
+        if isinstance(art.get("dk"), np.ndarray):
+            dside.ensure(row, dside.shared_len + n * self.page_size)
+            dids = dside.alloc.rows[row]
+            if art["draft"]["quantized"]:
+                dpayload = {
+                    "k": QTensor(jnp.asarray(art["dk"]),
+                                 jnp.asarray(art["dk_scales"])),
+                    "v": QTensor(jnp.asarray(art["dv"]),
+                                 jnp.asarray(art["dv_scales"])),
+                }
+            else:
+                dpayload = {"k": jnp.asarray(art["dk"]),
+                            "v": jnp.asarray(art["dv"])}
+            dside.pool = _install_pages(dside.pool, dpayload,
+                                        jnp.asarray(dids, jnp.int32))
+            return
+        # Rebuild (validated: only fresh step-1 artifacts reach here).
+        length = int(req.prompt.size)
+        bucket = self.prefill_chunk or self.prefill_bucket
+        width = -(-length // bucket) * bucket
+        fresh = dside.alloc.allocated(row) == 0
+        dside.ensure(row, min(self.prefix_len + width, need))
+        if dside.tail_template is not None and fresh \
+                and not dside.row_cached.get(row) \
+                and dside.alloc.allocated(row):
+            dst = np.full((self.n_shards,), dside.sink, np.int32)
+            dst[dside.alloc.shard_of(row)] = dside.alloc.rows[row][0]
+            dside.pool = dside.copy(dside.pool, dside.tail_template, dst)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :length] = req.prompt
+        if self._chunk_prefill is not None:
+            # Chunked admission writes the draft chunk by chunk; mirror
+            # it so the rebuilt cache is bit-identical.
+            c = self.prefill_chunk
+            for off in range(0, width, c):
+                _, dtoks, dtable = self._one_hot_call(
+                    dside, row, padded[:, off:off + c])
+                dside.pool = self._draft_chunk(
+                    self.draft_params, dside.pool, dtable, dtoks,
+                    jnp.asarray(self.prefix_len + off, jnp.int32))
+        else:
+            _, dtoks, dtable = self._one_hot_call(dside, row, padded)
+            dside.pool = self._draft_chunk(
+                self.draft_params, dside.pool, dtable, dtoks,
+                jnp.asarray(self.prefix_len, jnp.int32))
+
     # -- the KV tier: prefix spill/promote + session park/resume -----------
 
     @property
@@ -2584,21 +2901,36 @@ class ContinuousBatcher:
     def _tier_geom(self) -> Dict[str, Any]:
         """The geometry stamped on every spilled prefix page and
         checked on promotion — a tier entry cut for a different pool
-        layout or model must read as a miss, never install."""
-        return {"page_size": self.page_size,
-                "n_layers": int(self.cfg.n_layers),
-                "kv_heads": int(self.cfg.kv_heads),
-                "head_dim": int(self.cfg.head_dim),
-                "dtype": str(np.dtype(self.pool["k"].dtype))}
+        layout or model must read as a miss, never install.  The
+        ``draft`` sub-geometry (None without one) makes a speculative
+        batcher's twin-page spills unreadable by draft-less peers and
+        vice versa."""
+        geom: Dict[str, Any] = {
+            "page_size": self.page_size,
+            "n_layers": int(self.cfg.n_layers),
+            "kv_heads": int(self.cfg.kv_heads),
+            "head_dim": int(self.cfg.head_dim),
+            "dtype": str(np.dtype(self.pool["k"].dtype)),
+            "draft": None}
+        if self.d_side is not None:
+            geom["draft"] = {
+                "n_layers": int(self.draft_cfg.n_layers),
+                "kv_heads": int(self.draft_cfg.kv_heads),
+                "head_dim": int(self.draft_cfg.head_dim),
+                "dtype": str(np.dtype(self.d_side.pool["k"].dtype))}
+        return geom
 
-    def _spill_page(self, shard: int, digest: bytes, page: int) -> None:
+    def _spill_page(self, shard: int, digest: bytes, page: int,
+                    dpage: Optional[int] = None) -> None:
         """The prefix cache's eviction callback: gather the evicted
         page's content to host and park it in the KV tier,
         content-addressed by its chain digest — the device→host spill
-        of the memory hierarchy.  Runs on the serve-loop thread (the
-        eviction happens under its allocation pressure) while the page
-        still holds the published chunk; any failure costs the spill,
-        never the eviction."""
+        of the memory hierarchy.  In speculative mode the node's DRAFT
+        twin rides the same entry (body = target k+v then draft k+v),
+        so a promotion restores both pools.  Runs on the serve-loop
+        thread (the eviction happens under its allocation pressure)
+        while the pages still hold the published chunk; any failure
+        costs the spill, never the eviction."""
         tier = self.kv_tier
         if tier is None:
             return
@@ -2609,6 +2941,11 @@ class ContinuousBatcher:
         nbytes = (2 * int(self.cfg.n_layers) * int(self.cfg.kv_heads)
                   * self.page_size * int(self.cfg.head_dim)
                   * np.dtype(self.pool["k"].dtype).itemsize)
+        if self.d_side is not None:
+            nbytes += (2 * int(self.draft_cfg.n_layers)
+                       * int(self.draft_cfg.kv_heads) * self.page_size
+                       * int(self.draft_cfg.head_dim)
+                       * np.dtype(self.d_side.pool["k"].dtype).itemsize)
         accept = getattr(tier, "would_accept", None)
         if accept is not None and not accept(nbytes + 512):
             tier.count("evictions")
@@ -2618,13 +2955,22 @@ class ContinuousBatcher:
         v = np.ascontiguousarray(np.asarray(kv["v"]))
         meta = dict(self._tier_geom())
         meta["k_bytes"] = int(k.nbytes)
-        tier.put_prefix(digest.hex(), meta,
-                        k.tobytes() + v.tobytes())
+        body = k.tobytes() + v.tobytes()
+        if self.d_side is not None and dpage is not None:
+            dkv = _gather_pages(self.d_side.pool,
+                                jnp.asarray([int(dpage)], jnp.int32))
+            dk = np.ascontiguousarray(np.asarray(dkv["k"]))
+            dv = np.ascontiguousarray(np.asarray(dkv["v"]))
+            meta["dk_bytes"] = int(dk.nbytes)
+            body += dk.tobytes() + dv.tobytes()
+        tier.put_prefix(digest.hex(), meta, body)
 
     def _tier_page_payload(self, meta: dict, body: bytes):
-        """Rebuild one spilled page's ``{"k", "v"}`` device payload
-        (shape [layers, 1, kv_heads, page, dim]); None when the entry
-        was cut for a different geometry or is malformed."""
+        """Rebuild one spilled page's device payload(s): ``(target,
+        draft)`` — each a ``{"k", "v"}`` tree of shape [layers, 1,
+        kv_heads, page, dim], draft None without one; None (the whole
+        result) when the entry was cut for a different geometry or is
+        malformed."""
         geom = self._tier_geom()
         if any(meta.get(k) != geom[k] for k in geom):
             return None
@@ -2633,13 +2979,32 @@ class ContinuousBatcher:
         dtype = np.dtype(geom["dtype"])
         kb = meta.get("k_bytes")
         count = int(np.prod(shape, dtype=np.int64))
-        if not isinstance(kb, int) or kb != count * dtype.itemsize \
-                or len(body) != 2 * kb:
+        want = 2 * count * dtype.itemsize
+        if not isinstance(kb, int) or 2 * kb != want \
+                or len(body) < want:
             return None
         k = np.frombuffer(body, dtype=dtype, count=count).reshape(shape)
         v = np.frombuffer(body, dtype=dtype, count=count,
                           offset=kb).reshape(shape)
-        return {"k": jnp.asarray(k), "v": jnp.asarray(v)}
+        target = {"k": jnp.asarray(k), "v": jnp.asarray(v)}
+        if self.d_side is None:
+            if len(body) != want:
+                return None
+            return target, None
+        dshape = (int(self.draft_cfg.n_layers), 1,
+                  int(self.draft_cfg.kv_heads), self.page_size,
+                  int(self.draft_cfg.head_dim))
+        ddtype = np.dtype(geom["draft"]["dtype"])
+        dkb = meta.get("dk_bytes")
+        dcount = int(np.prod(dshape, dtype=np.int64))
+        if not isinstance(dkb, int) or dkb != dcount * ddtype.itemsize \
+                or len(body) != want + 2 * dkb:
+            return None
+        dk = np.frombuffer(body, dtype=ddtype, count=dcount,
+                           offset=want).reshape(dshape)
+        dv = np.frombuffer(body, dtype=ddtype, count=dcount,
+                           offset=want + dkb).reshape(dshape)
+        return target, {"k": jnp.asarray(dk), "v": jnp.asarray(dv)}
 
     def _tier_promote(self, req: Request) -> None:
         """Opportunistic tier→device promotion at admission: for each
@@ -2661,21 +3026,32 @@ class ContinuousBatcher:
             return
         pc = self._pcache
         alloc = self.t_side.alloc.shards[0]
+        dalloc = (self.d_side.alloc.shards[0]
+                  if self.d_side is not None else None)
         n = len(pc.match(0, digs))
         while n < len(digs):
             d = digs[n]
             got = self.kv_tier.get_prefix(d.hex())
             if got is None:
                 break
-            payload = self._tier_page_payload(got[0], got[1])
-            if payload is None or not alloc.free:
+            payloads = self._tier_page_payload(got[0], got[1])
+            if payloads is None or not alloc.free \
+                    or (dalloc is not None and not dalloc.free):
                 break
+            payload, dpayload = payloads
             page = alloc.free.pop()
-            if not pc.insert_chain(0, digs[:n], d, page):
+            dpage = dalloc.free.pop() if dalloc is not None else None
+            if not pc.insert_chain(0, digs[:n], d, page, dpage):
                 alloc.free.append(page)
+                if dalloc is not None:
+                    dalloc.free.append(dpage)
                 break
             self.pool = _install_pages(self.pool, payload,
                                        jnp.asarray([page], jnp.int32))
+            if dpayload is not None:
+                self.d_side.pool = _install_pages(
+                    self.d_side.pool, dpayload,
+                    jnp.asarray([dpage], jnp.int32))
             self.kv_tier.count("promotions")
             self._trace_event(req, "tier_promote", digest=d.hex()[:16],
                               depth=n + 1)
@@ -2735,6 +3111,36 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"session artifact {key} is not a "
                     f"{want_shape}/{dtype} array")
+        # Speculative sessions: the parked artifact must carry (or not
+        # carry) a draft payload matching THIS batcher — a mismatch is
+        # a miss (the caller re-prefills cold), never a half-resume.
+        draft = art.get("draft")
+        if self.d_side is None:
+            if draft is not None or isinstance(art.get("dk"),
+                                               np.ndarray):
+                raise ValueError("session artifact carries a draft "
+                                 "payload this batcher has no draft "
+                                 "model for")
+        else:
+            # The tier bypasses quantized pools, so _draft_geom()'s
+            # quantized field is necessarily False here.
+            for key, want in self._draft_geom().items():
+                if not isinstance(draft, dict) \
+                        or draft.get(key) != want:
+                    raise ValueError(
+                        f"session artifact draft geometry does not "
+                        f"match this batcher ({key})")
+            dshape = (int(self.draft_cfg.n_layers), n,
+                      int(self.draft_cfg.kv_heads), ps,
+                      int(self.draft_cfg.head_dim))
+            ddtype = np.dtype(self.d_side.pool["k"].dtype)
+            for key in ("dk", "dv"):
+                a = art.get(key)
+                if not isinstance(a, np.ndarray) \
+                        or a.shape != dshape or a.dtype != ddtype:
+                    raise ValueError(
+                        f"session artifact {key} is not a "
+                        f"{dshape}/{ddtype} array")
         # The tail's padded prefill window must fit the page table
         # (same bound the prefix-plan trimmer enforces).
         E = self.prefix_len + int(req.prompt.size)
@@ -2791,17 +3197,29 @@ class ContinuousBatcher:
         # scatter zeros onto the sink page — a write dump by
         # construction) so resume dispatches one of log2(np_max)
         # compiled scatters, never a fresh trace on the TTFT path.
-        m = self._pow2(n)
-        k, v = art["k"], art["v"]
-        if m > n:
-            pad = np.zeros(k.shape[:1] + (m - n,) + k.shape[2:],
-                           k.dtype)
-            k = np.concatenate([k, pad], axis=1)
-            v = np.concatenate([v, pad], axis=1)
-            ids = ids[:n] + [side.sink] * (m - n)
-        payload = {"k": jnp.asarray(k), "v": jnp.asarray(v)}
-        self.pool = _install_pages(self.pool, payload,
-                                   jnp.asarray(ids, jnp.int32))
+        def pow2_install(pool, sink, page_ids, k, v):
+            m = self._pow2(n)
+            page_ids = list(page_ids)
+            if m > n:
+                pad = np.zeros(k.shape[:1] + (m - n,) + k.shape[2:],
+                               k.dtype)
+                k = np.concatenate([k, pad], axis=1)
+                v = np.concatenate([v, pad], axis=1)
+                page_ids = page_ids[:n] + [sink] * (m - n)
+            payload = {"k": jnp.asarray(k), "v": jnp.asarray(v)}
+            return _install_pages(pool, payload,
+                                  jnp.asarray(page_ids, jnp.int32))
+
+        self.pool = pow2_install(self.pool, side.sink, ids,
+                                 art["k"], art["v"])
+        if self.d_side is not None:
+            # The paired draft payload backs the same positions of the
+            # draft pool (validated present and shape-matched).
+            dside = self.d_side
+            dside.ensure(row, dside.shared_len + n * self.page_size)
+            dside.pool = pow2_install(dside.pool, dside.sink,
+                                      dside.alloc.rows[row],
+                                      art["dk"], art["dv"])
         E = self.prefix_len + int(req.prompt.size)
         ts = int(art["pos"])
         tlen = E - ts
@@ -2821,6 +3239,16 @@ class ContinuousBatcher:
             self.params, self.pool, table, toks,
             jnp.asarray(ts, jnp.int32), jnp.asarray(caps),
             jnp.asarray(rids))
+        if self.d_side is not None:
+            # The draft's tail advances in lockstep (same tokens, same
+            # offset) so the next spec round proposes from a complete
+            # draft cache.
+            dside = self.d_side
+            dside.ensure(row, min(ts + w, need))
+            _, dtoks, dtable = self._one_hot_call(dside, row, padded)
+            dside.pool = self._draft_chunk(
+                self.draft_params, dside.pool, dtable, dtoks,
+                jnp.asarray(ts, jnp.int32))
         tok.copy_to_host_async()    # transfer overlaps later dispatches
         state = _Row(rid=rid, req=req, pos=E, step=1, last=0, out=[],
                      worst_pages=wt, worst_draft=wd, t_admit=t_admit,
@@ -2834,18 +3262,20 @@ class ContinuousBatcher:
         before its pages release): the artifact is the row's export
         plus the full conversation history, so the next turn can resume
         from it on this replica — or, through a shared disk tier, on
-        any same-weights replica of the host.  Only host-synchronous
-        single-shard modes park (``preemptible`` — the lagged modes'
-        host view overshoots at finish); everything else just misses
-        next turn, which re-prefills cold and stays correct.  A full
-        tier is an explicit rejected park, never a failed request."""
-        if not self._tier_active or not self.preemptible:
+        any same-weights replica of the host.  EVERY decode mode parks
+        — the lagged ones (overlap/pipelined, spec) export at the
+        COMMITTED boundary (``_export_row(final=True)`` clamps the
+        overshooting host view to ``prefix + prompt + len(out) - 1``,
+        below which every position holds the true stream), fixing the
+        PR 13 gap where they silently missed cold.  A full tier is an
+        explicit rejected park, never a failed request."""
+        if not self._tier_active:
             return
         sid = state.req.session_id
         if not sid or not state.out or state.t_first <= 0:
             return
         try:
-            art = self._export_row(r, state, pad_pow2=True)
+            art = self._export_row(r, state, pad_pow2=True, final=True)
             art["history"] = ([int(t) for t in state.req.prompt]
                               + [int(t) for t in state.out])
             meta, body = pack_prefilled(art)
@@ -3289,6 +3719,10 @@ class ContinuousBatcher:
             self._pcache.count("hit_tokens",
                                plan.tail_start - self.prefix_len)
             wt -= plan.save
+            if self.d_side is not None:
+                # Coupled nodes: the draft-side reservation shrinks by
+                # the same mapped-page count.
+                wd -= plan.save
         elif self._pcache is not None and self._req_digests(req):
             self._pcache.count("misses")
         if self._chunk_prefill is not None:
@@ -3355,9 +3789,19 @@ class ContinuousBatcher:
             dst = np.full((self.n_shards,), side.sink, np.int32)
             dst[side.alloc.shard_of(row)] = side.alloc.rows[row][0]
             side.pool = side.copy(side.pool, src, dst)
-            # The reference protected the source page through the
+            if self.d_side is not None:
+                # The deepest page's DRAFT twin gets the same one-token
+                # rewrite at E-1 (the spec round's draft scan writes
+                # it), so it is copied-on-write symmetrically.
+                dside = self.d_side
+                dside.ensure(row, dside.shared_len
+                             + len(plan.nodes) * self.page_size)
+                ddst = np.full((self.n_shards,), dside.sink, np.int32)
+                ddst[dside.alloc.shard_of(row)] = dside.alloc.rows[row][0]
+                dside.pool = dside.copy(dside.pool, cow_node.dpage, ddst)
+            # The reference protected the source page(s) through the
             # ensure() above (eviction runs under allocation pressure);
-            # the copy is dispatched, so it can be dropped now.
+            # the copies are dispatched, so it can be dropped now.
             self._pcache.release_nodes(row, [cow_node])
             self._pcache.count("cow_copies")
         ts = plan.tail_start
@@ -3379,6 +3823,16 @@ class ContinuousBatcher:
             self.params, self.pool, table, toks,
             jnp.asarray(ts, jnp.int32), jnp.asarray(caps),
             jnp.asarray(rids))
+        if self.d_side is not None:
+            # The draft pool's tail: the same uncached suffix written
+            # at the same offset through the draft chunk writer — its
+            # cached prefix pages (the twins mapped above) already
+            # cover [shared_len, ts).
+            _, dtoks, dtable = self._one_hot_call(self.d_side, row,
+                                                  padded)
+            self.d_side.pool = self._draft_chunk(
+                self.draft_params, self.d_side.pool, dtable, dtoks,
+                jnp.asarray(ts, jnp.int32))
         tok.copy_to_host_async()    # transfer overlaps later dispatches
         state = _Row(rid=rid, req=req, pos=E, step=1, last=0, out=[],
                      worst_pages=wt, worst_draft=wd, t_admit=t_admit,
@@ -3680,7 +4134,10 @@ class ContinuousBatcher:
                 if (tok == row.req.stop_token
                         or len(row.out) >= row.req.max_new_tokens):
                     done = self._completion(row)
-                    self._finish(r, active, free_rows)
+                    # _finish_completed parks session KV first: the
+                    # export clamps to the committed boundary, so the
+                    # lagged host view cannot overshoot the artifact.
+                    self._finish_completed(r, active, free_rows)
                     yield done
                     break
 
@@ -3747,7 +4204,7 @@ class ContinuousBatcher:
                         and row.out and row.out[-1]
                         == row.req.stop_token)):
                 done = self._completion(row)
-                self._finish(r, active, free_rows)
+                self._finish_completed(r, active, free_rows)
                 yield done
 
     def _step_spec_overlap(self, active: Dict[int, _Row],
@@ -3878,7 +4335,15 @@ class ContinuousBatcher:
         side = self.t_side
         reserved = 1 + len(side.shared_pages) \
             + (1 if side.tail_template is not None else 0)
-        return self._worst_pages(req)[0] <= side.n_pages - reserved
+        wt, wd, _ = self._worst_pages(req)
+        if wt > side.n_pages - reserved:
+            return False
+        if self.d_side is not None:
+            dside = self.d_side
+            dreserved = 1 + len(dside.shared_pages) \
+                + (1 if dside.tail_template is not None else 0)
+            return wd <= dside.n_pages - dreserved
+        return True
 
     def _maybe_preempt(self, priority: int, active: Dict[int, _Row],
                        free_rows: List[int]) -> bool:
