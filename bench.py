@@ -1484,6 +1484,239 @@ def bench_fleet_autoscale(rows=2, max_new_tokens=4, workers=8):
         fleet.stop()
 
 
+def bench_fleet_multimodel(rows=2, max_new_tokens=4, workers=8):
+    """Many models, one fleet (docs/SERVING.md "Model catalog") on a
+    live LocalBackend fleet, every contract asserted in-bench:
+
+    * ``fleet_multimodel_trade_reaction_s`` — a two-model hotness flip
+      on a FIXED replica budget: the hand-stepped ModelTrader (injected
+      signals, the chaos.py discipline — the bench measures the
+      drain→launch→register→alive pipeline, not signal plumbing) must
+      TRADE a cold model's replica away and stand the hot model's
+      second replica up; continuous two-tenant traffic rides through
+      the whole trade with ZERO failed/shed requests (asserted).
+    * ``fleet_multimodel_pool_cold_start_ttft_ms`` vs ``..._relaunch_
+      cold_start_ttft_ms`` — a scale-to-zero model's FIRST request:
+      warm-pool adoption (a weight install on a pre-warmed,
+      pre-compiled process) vs the pool-exhausted path (trade a slot +
+      cold process launch + compile); pool STRICTLY below relaunch
+      asserted.
+    * ``fleet_multimodel_swap_ms`` — ``swap_adapter`` under continuous
+      traffic: every request during the swap is SERVED (zero
+      downtime), every stream equals exactly ONE delta version's
+      reference (token-identical per version — never a mix), and
+      every request submitted after the fleet-wide ack streams the NEW
+      version.
+    * billing-grade metering: ``metering_{prompt,decode}_tokens_
+      <tenant>_<model>`` counters present for every pair that carried
+      traffic (they ride the snapshot AND the Prometheus exposition).
+    """
+    import threading
+
+    from tfmesos_tpu.fleet.admission import PriorityClass
+    from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig
+    from tfmesos_tpu.fleet.catalog import (ModelSpec, ModelTrader,
+                                           TraderConfig, model_key)
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.launcher import FleetServer
+    from tfmesos_tpu.fleet.replica import tiny_model
+
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 97, size=(6,)).astype(np.int32)
+               for _ in range(8)]
+    fleet = FleetServer(
+        models=[ModelSpec("alpha", replicas=2, seed=0),
+                ModelSpec("beta", replicas=1, seed=1),
+                ModelSpec("gamma", replicas=0, seed=2),
+                ModelSpec("delta", replicas=0, seed=3)],
+        warm_pool=1, tiny=True, rows=rows, workers=workers,
+        max_queue=256,
+        priority_classes=[PriorityClass("tenantA", weight=2.0, rank=1),
+                          PriorityClass("tenantB", weight=1.0, rank=0)],
+        request_timeout=300.0, start_timeout=300.0)
+    fleet.start()
+    out = {}
+    try:
+        # The built-in trader thread would race the hand-stepped one
+        # below; its demand hook (the router's cold-start path) stays
+        # live — stopping the thread stops the TICKS, not the surface.
+        fleet.trader.stop()
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+        for model, tenant in (("alpha", "tenantA"), ("beta", "tenantB")):
+            client.generate(prompts[0], 2, model=model,
+                            priority=tenant)     # warm the compiles
+
+        def alive(model):
+            return [r for r in fleet.registry.members(model=model)
+                    if r.state == "alive"]
+
+        # -- cold start #1: through the warm pool (a weight install).
+        # The pool slot is the budget's only slack, so this must come
+        # FIRST — once it is consumed, every later reallocation is a
+        # genuine trade.
+        t0 = time.perf_counter()
+        client.generate(prompts[1], max_new_tokens, model="gamma",
+                        priority="tenantA")
+        pool_ttft_ms = 1000.0 * (time.perf_counter() - t0)
+        assert fleet.metrics.get("model_adoptions") == 1
+        # Wait out the adopter's identity flip (heartbeat-lagged) so
+        # a later demand cannot see a stale pool member.
+        deadline = time.perf_counter() + 60.0
+        while fleet.registry.has_pool():
+            if time.perf_counter() > deadline:
+                raise RuntimeError("adopted replica still advertises "
+                                   "warm_pool")
+            time.sleep(0.05)
+
+        # -- the hotness flip, under continuous two-tenant traffic.
+        # Dead-band signals on alpha, beta HOT, budget full, pool
+        # gone: the ONLY way beta can grow is a TRADE — alpha (the
+        # sole model above its live bound) drain-MIGRATES one replica
+        # away mid-traffic and beta's second one launches in its slot.
+        DEAD = {"queue_wait_p99_ms": 100.0, "util": 0.4, "samples": 5}
+        sig = {model_key("alpha"): dict(DEAD),
+               model_key("beta"): dict(DEAD)}
+        trader = ModelTrader(
+            fleet, fleet.catalog,
+            AutoscalerConfig(scale_up_cooldown=0.0,
+                             scale_down_cooldown=0.0, drain_grace=0.2),
+            trader_config=TraderConfig(trade_cooldown_s=0.2,
+                                       zero_after_ticks=10 ** 6),
+            signals=lambda: {k: dict(v) for k, v in sig.items()})
+        stop = threading.Event()
+        failures = []
+
+        def feeder(model, tenant):
+            i = 0
+            while not stop.is_set():
+                try:
+                    client.generate(prompts[i % len(prompts)],
+                                    max_new_tokens, model=model,
+                                    priority=tenant, timeout=300.0)
+                except Exception as e:
+                    failures.append(e)
+                    return
+                i += 1
+
+        threads = [threading.Thread(target=feeder, args=a, daemon=True)
+                   for a in (("alpha", "tenantA"), ("beta", "tenantB"))]
+        for th in threads:
+            th.start()
+        time.sleep(0.4)                  # traffic in flight first
+        sig[model_key("beta")] = {"queue_wait_p99_ms": 10_000.0,
+                                  "util": 1.0, "samples": 50}
+        t0 = time.perf_counter()
+        deadline = t0 + 300.0
+        while len(alive("beta")) < 2:
+            if time.perf_counter() > deadline:
+                raise RuntimeError("traded beta replica never routable")
+            trader.step()
+            time.sleep(0.05)
+        reaction_s = time.perf_counter() - t0
+        assert fleet.metrics.get("model_trades") >= 1
+        # Converge the trade's victim side: alpha's drained replica
+        # migrates its in-flight rows (the feeder keeps hammering it)
+        # and is reaped — lossless, per the feeder assertion below.
+        sig[model_key("beta")] = dict(DEAD)
+        while fleet.tier_actual(model_key("alpha")) > 1:
+            if time.perf_counter() > deadline:
+                raise RuntimeError("traded-away replica never reaped")
+            trader.step()
+            time.sleep(0.05)
+
+        # -- cold start #2: pool exhausted — the demand must TRADE a
+        # slot from a cold model (beta, the only one above its live
+        # bound now) and cold-LAUNCH a process (fork + jax import +
+        # compile): the expensive path the warm pool exists to avoid.
+        t0 = time.perf_counter()
+        client.generate(prompts[2], max_new_tokens, model="delta",
+                        priority="tenantB")
+        relaunch_ttft_ms = 1000.0 * (time.perf_counter() - t0)
+        assert pool_ttft_ms < relaunch_ttft_ms, \
+            (f"warm-pool cold start ({pool_ttft_ms:.0f}ms) not below "
+             f"cold relaunch ({relaunch_ttft_ms:.0f}ms)")
+
+        # -- adapter hot-swap under the same continuous traffic.
+        cfg_t, params_t = tiny_model(1)      # beta's preset (seed 1)
+        embed = np.asarray(params_t["embed"])
+        delta = {"embed": (0.5 * np.random.default_rng(9)
+                           .standard_normal(embed.shape)
+                           ).astype(embed.dtype)}
+        probe = prompts[3]
+        ref_old = client.generate(probe, max_new_tokens, model="beta",
+                                  priority="tenantB")["tokens"]
+        swap_records = []
+        swap_stop = threading.Event()
+
+        def swap_feeder():
+            while not swap_stop.is_set():
+                t_submit = time.perf_counter()
+                try:
+                    r = client.generate(probe, max_new_tokens,
+                                        model="beta",
+                                        priority="tenantB",
+                                        timeout=300.0)
+                except Exception as e:
+                    failures.append(e)
+                    return
+                swap_records.append((t_submit, r["tokens"]))
+
+        th_swap = threading.Thread(target=swap_feeder, daemon=True)
+        th_swap.start()
+        time.sleep(0.3)
+        t0 = time.perf_counter()
+        client.swap_adapter("beta", "lora1", delta)
+        t_ack = time.perf_counter()
+        swap_ms = 1000.0 * (t_ack - t0)
+        time.sleep(0.5)                 # post-ack traffic
+        swap_stop.set()
+        stop.set()
+        th_swap.join(timeout=300.0)
+        for th in threads:
+            th.join(timeout=300.0)
+        assert not failures, \
+            f"lost/shed a request across trade or swap: {failures[0]!r}"
+        ref_new = client.generate(probe, max_new_tokens, model="beta",
+                                  priority="tenantB")["tokens"]
+        assert ref_new != ref_old, \
+            "adapter delta did not change the stream (delta too small)"
+        for t_submit, toks in swap_records:
+            assert toks in (ref_old, ref_new), \
+                f"stream matches NEITHER delta version: {toks}"
+            if t_submit > t_ack:
+                assert toks == ref_new, \
+                    "request submitted after the swap ack streamed the "\
+                    "OLD delta version"
+        assert any(r.adapter_version == "lora1"
+                   for r in alive("beta")), "adapter_version never "\
+            "rode a heartbeat into the registry"
+
+        # -- billing-grade per-tenant x model metering.
+        counters = client.metrics()["counters"]
+        for tenant, model in (("tenantA", "alpha"), ("tenantB", "beta"),
+                              ("tenantA", "gamma"),
+                              ("tenantB", "delta")):
+            for kind in ("prompt", "decode"):
+                key = f"metering_{kind}_tokens_{tenant}_{model}"
+                assert counters.get(key, 0) > 0, f"no meter {key}"
+        client.close()
+        out = {
+            "fleet_multimodel_trade_reaction_s": round(reaction_s, 2),
+            "fleet_multimodel_pool_cold_start_ttft_ms":
+                round(pool_ttft_ms, 1),
+            "fleet_multimodel_relaunch_cold_start_ttft_ms":
+                round(relaunch_ttft_ms, 1),
+            "fleet_multimodel_swap_ms": round(swap_ms, 1),
+            "fleet_multimodel_lost_requests": len(failures),
+            "fleet_multimodel_metered_pairs": sum(
+                1 for k in counters
+                if k.startswith("metering_prompt_tokens_")),
+        }
+        return out
+    finally:
+        fleet.stop()
+
+
 def bench_fleet_priority(n_interactive=16, rows=3, workers=8,
                          flood_threads=3, interactive_new=2,
                          background_new=24):
@@ -2880,6 +3113,15 @@ def main():
         out["fleet_kv_tier_hit_rate"] = round(hit_rate, 3)
         out["fleet_shared_prefix_prefills"] = prefills
         out["fleet_shared_prefix_affinity_hit_rate"] = round(aff, 3)
+        flush_partial()
+    mm = attempts(bench_fleet_multimodel, "fleet multi-model bench",
+                  n=1)
+    if mm:
+        # Model catalog: cross-model trading under a fixed budget,
+        # warm-pool cold start vs cold relaunch, adapter hot-swap
+        # under traffic, per-tenant x model metering — all asserted
+        # in-bench.
+        out.update(mm[0])
         flush_partial()
     rw = attempts(bench_ring_window, "ring window bench", n=1)
     if rw and rw[0] is not None:    # >1 visible device: sp ring

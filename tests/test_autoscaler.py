@@ -1084,10 +1084,10 @@ def test_fleet_rollout_aborts_when_new_tier_never_leaves_warming():
         # Sabotage the new tier: an unlaunchable replica cmd.
         real_cmd = fs._replica_cmd
 
-        def broken_cmd(role="unified", weights_version=None):
+        def broken_cmd(role="unified", weights_version=None, **kw):
             if weights_version == "v2":
                 return "exit 7"
-            return real_cmd(role, weights_version)
+            return real_cmd(role, weights_version, **kw)
 
         fs._replica_cmd = broken_cmd
         with pytest.raises(RolloutError, match="aborted"):

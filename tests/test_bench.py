@@ -370,3 +370,18 @@ def test_fleet_sessions_bench_smoke():
     assert 0.0 <= hit_rate <= 1.0
     assert prefills == 1
     assert 0.0 <= aff <= 1.0
+
+
+@pytest.mark.slow
+def test_fleet_multimodel_bench_smoke():
+    """The model-catalog bench protocol end to end: warm-pool cold
+    start strictly below cold relaunch, a budget-tight trade under
+    continuous two-tenant traffic with zero lost requests, adapter
+    hot-swap token-identical per delta version, and the per-tenant x
+    model meters — all asserted inside the bench itself."""
+    out = bench.bench_fleet_multimodel(rows=2, workers=4)
+    assert out["fleet_multimodel_lost_requests"] == 0
+    assert out["fleet_multimodel_trade_reaction_s"] > 0
+    assert out["fleet_multimodel_pool_cold_start_ttft_ms"] < \
+        out["fleet_multimodel_relaunch_cold_start_ttft_ms"]
+    assert out["fleet_multimodel_metered_pairs"] >= 4

@@ -1,0 +1,735 @@
+"""Model catalog, cross-model trading, adapter packing, and the
+per-model routing/metering path (tfmesos_tpu/fleet/catalog.py +
+friends) — all jax-free: the catalog machinery is model-agnostic, so
+stub replicas stand in for batchers exactly like tests/test_fleet.py's.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.fleet.admission import AdmissionController, Overloaded
+from tfmesos_tpu.fleet.catalog import (POOL_KEY, ModelCatalog, ModelSpec,
+                                       ModelTrader, TraderConfig,
+                                       decode_adapter_fields,
+                                       encode_adapter_fields, model_key,
+                                       pack_adapter, split_key,
+                                       unpack_adapter, validate_model_id)
+from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig
+from tfmesos_tpu.fleet.client import FleetClient, RequestFailed
+from tfmesos_tpu.fleet.gateway import Gateway
+from tfmesos_tpu.fleet.metrics import FleetMetrics
+from tfmesos_tpu.fleet.registry import (ALIVE, ReplicaInfo,
+                                        ReplicaRegistry)
+from tfmesos_tpu.fleet.replica import ReplicaServer
+from tfmesos_tpu.fleet.router import Router, RoutingError
+
+
+def _wait(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# -- model-id validation (the security boundary) ----------------------------
+
+
+def test_model_id_validation_boundary():
+    """model_id joins a shell=True command line and Prometheus metric
+    names: the charset gate must reject every smuggling shape, at
+    fullmatch (a trailing newline is a shell command terminator)."""
+    assert validate_model_id("chat-7b.v2") == "chat-7b.v2"
+    assert validate_model_id("A" * 64) == "A" * 64
+    for bad in ("", "a" * 65, "-lead", ".lead", "has space", "a;rm -rf",
+                "a\nb", "v1\n", "a/b", "a$(x)", "a`x`", "a|b", 'a"b',
+                None, 7):
+        with pytest.raises((ValueError, TypeError)):
+            validate_model_id(bad)
+
+
+def test_catalog_resolve_default_and_unknown():
+    cat = ModelCatalog([ModelSpec("chat", replicas=2, seed=0),
+                        ModelSpec("code", replicas=1, seed=1)])
+    assert cat.default_id == "chat"
+    assert cat.resolve(None) == "chat"      # model-less -> default
+    assert cat.resolve("") == "chat"
+    assert cat.resolve("code") == "code"
+    with pytest.raises(KeyError):
+        cat.resolve("nope")                 # unknown is an error, not
+    with pytest.raises(ValueError):         # the default (billing!)
+        ModelCatalog([])
+    with pytest.raises(ValueError):
+        ModelCatalog([ModelSpec("a"), ModelSpec("a")])
+    with pytest.raises(ValueError):
+        ModelSpec("ok;", replicas=1)
+    with pytest.raises(ValueError):
+        ModelSpec("ok", replicas=2, floor=3)
+
+
+def test_model_key_split_round_trip():
+    assert split_key(model_key("m1")) == ("m1", "unified")
+    assert split_key("unified") == (None, "unified")
+    assert split_key("m.v2/decode") == ("m.v2", "decode")
+    assert split_key(POOL_KEY) == ("_pool", "unified")
+
+
+# -- registry robustness (satellite: malformed field costs the field) -------
+
+
+def test_registry_malformed_model_id_costs_field_not_beat():
+    """A malformed model_id (wrong type, shell metacharacters, over-
+    length) on a heartbeat must cost the FIELD, never the beat — the
+    PR 4/5 optional-field convention — and the charset check holds at
+    this ingress too (a replica cannot smuggle an arbitrary label into
+    Prometheus metric names by heartbeating it)."""
+    reg = ReplicaRegistry(clock=lambda: 0.0)
+    reg.observe({"op": "hello", "addr": "a:1", "capacity": 4,
+                 "model_id": "good.v1", "warm_pool": False})
+    rep = reg.members()[0]
+    assert rep.model_id == "good.v1" and rep.state == ALIVE
+    for bad in (7, None, ["x"], "a;rm", "a\nb", "b" * 65, "-lead"):
+        reg.observe({"op": "heartbeat", "addr": "a:1", "outstanding": 3,
+                     "model_id": bad})
+        rep = reg.members()[0]
+        assert rep.model_id == "good.v1", bad   # field kept
+        assert rep.outstanding == 3             # the beat still landed
+        rep = reg.members()[0]
+    # A VALID new id still updates (adoption), and "" clears.
+    reg.observe({"op": "heartbeat", "addr": "a:1", "model_id": "other"})
+    assert reg.members()[0].model_id == "other"
+    # warm_pool only honors the literal True/False, and the O(1) pool
+    # gate follows the transitions.
+    assert not reg.has_pool()
+    reg.observe({"op": "heartbeat", "addr": "a:1", "warm_pool": "yes"})
+    assert not reg.members()[0].warm_pool
+    reg.observe({"op": "heartbeat", "addr": "a:1", "warm_pool": True})
+    assert reg.members()[0].warm_pool and reg.has_pool()
+    reg.observe({"op": "heartbeat", "addr": "a:1", "warm_pool": False})
+    assert not reg.has_pool()
+    # adapter_version: same charset discipline, "" allowed (base).
+    reg.observe({"op": "heartbeat", "addr": "a:1",
+                 "adapter_version": "lora1"})
+    assert reg.members()[0].adapter_version == "lora1"
+    reg.observe({"op": "heartbeat", "addr": "a:1",
+                 "adapter_version": "bad;"})
+    assert reg.members()[0].adapter_version == "lora1"
+    reg.observe({"op": "heartbeat", "addr": "a:1",
+                 "adapter_version": ""})
+    assert reg.members()[0].adapter_version == ""
+
+
+def test_registry_model_summary_and_members_filter():
+    reg = ReplicaRegistry(clock=lambda: 0.0)
+    reg.observe({"op": "hello", "addr": "a:1", "model_id": "m1",
+                 "outstanding": 2})
+    reg.observe({"op": "hello", "addr": "a:2", "model_id": "m1",
+                 "adapter_version": "d1"})
+    reg.observe({"op": "hello", "addr": "b:1", "model_id": "m2"})
+    reg.observe({"op": "hello", "addr": "p:1", "warm_pool": True})
+    assert {r.addr for r in reg.members(model="m1")} == {"a:1", "a:2"}
+    summ = reg.model_summary()
+    assert summ["m1"]["alive"] == 2 and summ["m1"]["outstanding"] == 2
+    assert summ["m1"]["adapters"] == {"": 1, "d1": 1}
+    assert summ["m2"]["alive"] == 1
+    assert summ["(pool)"]["alive"] == 1
+
+
+# -- router: the model tier ------------------------------------------------
+
+
+def _mk_router(reg):
+    return Router(reg, FleetMetrics(), max_retries=1,
+                  link_factory=lambda addr: _FakeLink(addr))
+
+
+class _FakeLink:
+    def __init__(self, addr):
+        self.addr = addr
+        self.closed = False
+        self.outstanding = 0
+
+    def call(self, msg, timeout=None):
+        return {"op": "completion", "tokens": [1], "ttft_ms": 1.0,
+                "total_ms": 1.0, "addr": self.addr}
+
+    def call_raw(self, meta, body, timeout=None):
+        return self.call(meta, timeout)
+
+    def close(self):
+        self.closed = True
+
+
+def test_router_model_tier_and_pool_exclusion():
+    reg = ReplicaRegistry(clock=lambda: 0.0)
+    reg.observe({"op": "hello", "addr": "m1:1", "model_id": "m1",
+                 "capacity": 4})
+    reg.observe({"op": "hello", "addr": "m2:1", "model_id": "m2",
+                 "capacity": 4})
+    reg.observe({"op": "hello", "addr": "pool:1", "warm_pool": True,
+                 "capacity": 4})
+    router = _mk_router(reg)
+    # Exact-match model tier: never another model's replica, never the
+    # pool.
+    for _ in range(16):
+        assert router.pick(model="m1") == "m1:1"
+        assert router.pick(model="m2") == "m2:1"
+        # Model-less picks exclude the undedicated pool member.
+        assert router.pick() in ("m1:1", "m2:1")
+    assert router.pick(model="m3") is None
+    with pytest.raises(RoutingError) as e:
+        router.route({"op": "generate", "prompt": [1], "_model": "m3"})
+    assert "m3" in str(e.value)
+    # The routed reply comes from the model's own replica, and the
+    # wire message carries the model for the replica's cross-check.
+    out = router.route({"op": "generate", "prompt": [1],
+                        "_model": "m2"})
+    assert out["addr"] == "m2:1"
+
+
+def test_model_less_fleet_routes_exactly_as_before():
+    """No model fields anywhere: the candidate set is the full alive
+    view (no filtering pass runs — has_pool gates it off), and a
+    forward without _model hits the zero-copy _wire_msg fast path."""
+    reg = ReplicaRegistry(clock=lambda: 0.0)
+    for i in range(3):
+        reg.observe({"op": "hello", "addr": f"r:{i}", "capacity": 4})
+    router = _mk_router(reg)
+    view = reg.alive_view(("unified",))
+    assert router._alive_by_role(("unified",)) is view  # no copy made
+    msg = {"op": "generate", "prompt": [1]}
+    assert router._wire_msg(msg, None) is msg           # untouched
+    assert router.pick() in {f"r:{i}" for i in range(3)}
+
+
+def test_router_await_model_demands_and_routes():
+    """A request for a scaled-to-zero model fires the demand hook once
+    and waits for the replica instead of failing."""
+    reg = ReplicaRegistry(clock=lambda: time.monotonic())
+    router = _mk_router(reg)
+    demands = []
+
+    def demand(model):
+        demands.append(model)
+        # The "trader": a replica of the model appears shortly after.
+        reg.observe({"op": "hello", "addr": "cold:1", "model_id": "m9",
+                     "capacity": 4})
+        return True
+
+    router.on_model_demand = demand
+    router.model_wait_s = 5.0
+    out = router.route({"op": "generate", "prompt": [1],
+                        "_model": "m9"})
+    assert out["op"] == "completion" and demands == ["m9"]
+    assert router.metrics.get("model_cold_waits") == 1
+
+
+def test_router_resume_requires_matching_model_and_adapter():
+    """_pick_resume narrows to the artifact's model AND adapter
+    version — KV computed under one delta must never continue under
+    another."""
+    reg = ReplicaRegistry(clock=lambda: 0.0)
+    reg.observe({"op": "hello", "addr": "old:1", "model_id": "m1",
+                 "weights_version": "v1", "adapter_version": "d1",
+                 "capacity": 4})
+    reg.observe({"op": "hello", "addr": "new:1", "model_id": "m1",
+                 "weights_version": "v1", "adapter_version": "d2",
+                 "capacity": 4})
+    reg.observe({"op": "hello", "addr": "oth:1", "model_id": "m2",
+                 "weights_version": "v1", "adapter_version": "d1",
+                 "capacity": 4})
+    router = _mk_router(reg)
+    assert router._pick_resume(set(), "v1", model="m1",
+                               adapter="d1") == "old:1"
+    assert router._pick_resume(set(), "v1", model="m1",
+                               adapter="d3") is None
+    assert router._pick_resume(set(), "v1", model="m2",
+                               adapter="d1") == "oth:1"
+    # Old exports without the stamps keep the old (version-only) rule.
+    assert router._pick_resume(set(), "v1") in ("old:1", "new:1",
+                                                "oth:1")
+
+
+# -- admission: per-tenant+per-model quotas ---------------------------------
+
+
+def test_admission_model_quota_sheds_per_class_and_model():
+    from tfmesos_tpu.fleet.admission import PriorityClass
+
+    adm = AdmissionController(max_queue=16, classes=[
+        PriorityClass("tenantA", weight=1.0, rank=0, model_quota=2),
+        PriorityClass("tenantB", weight=1.0, rank=0)])
+    adm.admit("a1", cls="tenantA", model="m1")
+    adm.admit("a2", cls="tenantA", model="m1")
+    with pytest.raises(Overloaded):     # tenantA's m1 slots are full
+        adm.admit("a3", cls="tenantA", model="m1")
+    # ...but the same tenant's OTHER model still admits, and another
+    # tenant's m1 is untouched (no quota configured there).
+    adm.admit("a4", cls="tenantA", model="m2")
+    for i in range(5):
+        adm.admit(f"b{i}", cls="tenantB", model="m1")
+    assert adm.quota_shed_counts() == {"tenantA": 1, "tenantB": 0}
+    # Dispatch frees quota slots.
+    got = adm.get(timeout=0.1)
+    assert got is not None
+    adm.admit("a5", cls="tenantA", model="m1")
+    # Model-less admission never touches the quota book.
+    adm.admit("a6", cls="tenantA")
+
+
+# -- adapter wire format ----------------------------------------------------
+
+
+def test_adapter_pack_unpack_round_trip_and_b64():
+    np = pytest.importorskip("numpy")
+    delta = {"layers/wq": np.arange(12, dtype=np.float32).reshape(3, 4),
+             "embed": np.ones((2, 2), np.float16)}
+    meta, body = pack_adapter(delta)
+    out = unpack_adapter(meta, body)
+    assert set(out) == set(delta)
+    for k in delta:
+        assert out[k].dtype == delta[k].dtype
+        assert (out[k] == delta[k]).all()
+    # The gateway-hop base64 shape decodes to the identical frame.
+    fields = encode_adapter_fields(delta)
+    meta2, body2 = decode_adapter_fields(fields)
+    assert body2 == body and meta2["adapter"]["paths"] == \
+        meta["adapter"]["paths"]
+    # Malformed manifests are loud.
+    with pytest.raises(ValueError):
+        unpack_adapter(meta, body[:-1])         # sizes do not tile
+    with pytest.raises(ValueError):
+        unpack_adapter({"adapter": {}}, body)
+    bad = dict(fields)
+    bad["sizes"] = [1]
+    with pytest.raises(ValueError):
+        decode_adapter_fields(bad)
+    with pytest.raises(ValueError):
+        pack_adapter({})
+    # A zero-itemsize dtype in a hostile manifest must be a ValueError,
+    # never a ZeroDivisionError escaping the handler's catch.
+    hostile = {"adapter": {"paths": ["p"], "shapes": [[0]],
+                           "dtypes": ["V0"], "sizes": [len(body)]}}
+    with pytest.raises(ValueError):
+        unpack_adapter(hostile, body)
+
+
+# -- the trader (stub fleet, fake clock/signals) ----------------------------
+
+
+class _StubTradeFleet:
+    """The trader's fleet surface over an in-memory registry — the
+    FakeFleet of tests/test_autoscaler.py extended with the catalog
+    surface (tier_members / replica_budget / adopt_replica)."""
+
+    def __init__(self, reg, targets, budget):
+        self.registry = reg
+        self.metrics = FleetMetrics()
+        self.targets = dict(targets)
+        self.replica_budget = budget
+        self.scale_lock = threading.RLock()
+        self.launched = []
+        self.adopted = []
+        self.killed = []
+        self._actual = dict(targets)
+        self.adopt_ok = True
+
+    def set_target(self, key, n):
+        self.targets[key] = n
+
+    def bounds(self, key):
+        return (0, self.replica_budget)
+
+    def tier_members(self, key):
+        from tfmesos_tpu.fleet.catalog import filter_members
+
+        _, role = split_key(key)
+        return filter_members(self.registry.members(role), key)
+
+    def launch_replica(self, key, weights_version=None):
+        node = f"{key}:{len(self.launched)}"
+        self.launched.append(key)
+        self._actual[key] = self._actual.get(key, 0) + 1
+        return node
+
+    def adopt_replica(self, addr, model_id):
+        if not self.adopt_ok:
+            return False
+        self.adopted.append((addr, model_id))
+        key = model_key(model_id)
+        self._actual[key] = self._actual.get(key, 0) + 1
+        self._actual[POOL_KEY] = self._actual.get(POOL_KEY, 1) - 1
+        for r in self.registry.reps:
+            if r.addr == addr:
+                r.warm_pool = False
+                r.model_id = model_id
+        return True
+
+    def kill_replica(self, node):
+        self.killed.append(node)
+        return True
+
+    def tier_actual(self, key):
+        return self._actual.get(key, 0)
+
+
+class _TradeRegistry:
+    def __init__(self, reps=()):
+        self.reps = list(reps)
+        self.drained = []
+
+    def members(self, role=None, model=None):
+        return [r for r in self.reps
+                if (role is None or (r.role or "unified") == role)
+                and (model is None or r.model_id == model)]
+
+    def begin_drain(self, addr, pinned=True):
+        for r in self.reps:
+            if r.addr == addr:
+                r.state = "draining"
+                self.drained.append(addr)
+                return True
+        return False
+
+    def clear_drain(self, addr):
+        pass
+
+    def set_target(self, key, n):
+        pass
+
+
+def _rep(addr, model_id="", state=ALIVE, outstanding=0, pool=False,
+         node="", kv_tier=None):
+    return ReplicaInfo(addr=addr, state=state, outstanding=outstanding,
+                       capacity=4, model_id=model_id, warm_pool=pool,
+                       node=node or addr, kv_tier=kv_tier)
+
+
+def _trader(fleet, catalog, sig, clock, **tcfg):
+    cfg = AutoscalerConfig(scale_up_cooldown=0.0,
+                           scale_down_cooldown=0.0)
+    return ModelTrader(fleet, catalog, cfg,
+                       trader_config=TraderConfig(**tcfg),
+                       signals=lambda: {k: dict(v)
+                                        for k, v in sig.items()},
+                       clock=lambda: clock[0])
+
+
+HOT = {"queue_wait_p99_ms": 5000.0, "util": 1.0, "samples": 50}
+#: inside the hysteresis dead band: traffic-bearing but neither
+#: scale-up- nor scale-down-worthy on its own — the only way it
+#: shrinks is a TRADE.
+WARM = {"queue_wait_p99_ms": 100.0, "util": 0.4, "samples": 5}
+IDLE = {"queue_wait_p99_ms": None, "util": 0.0, "samples": 0}
+
+
+def test_trader_trades_coldest_to_hottest_at_budget():
+    """Budget full + one hot model: the trader decrements the COLDEST
+    model's target and increments the hot one's — one trade per tick,
+    cooldown-gated (no thrash)."""
+    ka, kb = model_key("a"), model_key("b")
+    cat = ModelCatalog([ModelSpec("a", replicas=3),
+                        ModelSpec("b", replicas=1)])
+    reg = _TradeRegistry([_rep(f"a:{i}", "a") for i in range(3)]
+                         + [_rep("b:0", "b")])
+    fleet = _StubTradeFleet(reg, {ka: 3, kb: 1}, budget=4)
+    sig = {ka: dict(WARM), kb: dict(HOT)}
+    clock = [100.0]
+    tr = _trader(fleet, cat, sig, clock, trade_cooldown_s=5.0)
+    # The first tick-driven trade waits out one cooldown from
+    # construction (bring-up queue spikes read as hotness everywhere).
+    clock[0] += 10.0
+    tr.step()
+    assert fleet.targets == {ka: 2, kb: 2}
+    assert fleet.metrics.get("model_trades") == 1
+    # The convergence side already actuated: a drain on one of a's
+    # replicas and a launch (no pool here) for b.
+    assert len(reg.drained) == 1 and reg.drained[0].startswith("a:")
+    assert kb in fleet.launched
+    # Same instant, still hot: the trade cooldown holds — no churn.
+    tr.step()
+    assert fleet.metrics.get("model_trades") == 1
+    clock[0] += 10.0
+    tr.step()
+    assert fleet.metrics.get("model_trades") == 2
+    assert fleet.targets == {ka: 1, kb: 3}
+    # a is at its live bound (1, traffic-bearing): no further victim.
+    clock[0] += 10.0
+    tr.step()
+    assert fleet.targets == {ka: 1, kb: 3}
+    assert fleet.metrics.get("model_trade_blocked") >= 1
+
+
+def test_trader_scale_to_zero_then_demand_adopts_from_pool():
+    ka = model_key("a")
+    cat = ModelCatalog([ModelSpec("a", replicas=1, scale_to_zero=True)])
+    reg = _TradeRegistry([_rep("a:0", "a"), _rep("p:0", pool=True)])
+    fleet = _StubTradeFleet(reg, {ka: 1, POOL_KEY: 1}, budget=2)
+    sig = {ka: dict(IDLE), POOL_KEY: {"alive": 1}}
+    clock = [0.0]
+    tr = _trader(fleet, cat, sig, clock, zero_after_ticks=3)
+    for i in range(2):
+        clock[0] += 1.0
+        tr.step()
+    assert fleet.targets[ka] == 1       # not idle long enough yet
+    clock[0] += 1.0
+    tr.step()                           # third zero-traffic tick
+    assert fleet.targets[ka] == 0
+    assert fleet.metrics.get("model_scale_to_zero") == 1
+    assert reg.drained == ["a:0"]       # the LAST replica drains away
+    # Reap it so actuals match the zero target.
+    fleet._actual[ka] = 0
+    reg.reps = [r for r in reg.reps if r.addr != "a:0"]
+    # Demand (the router's cold-start hook): target back to 1, and the
+    # warm-pool member adopts IMMEDIATELY — no cold launch.
+    assert tr.demand("a")
+    assert fleet.targets[ka] == 1
+    assert fleet.adopted == [("p:0", "a")]
+    assert fleet.launched == []
+    assert fleet.metrics.get("model_cold_starts") == 1
+    assert fleet.metrics.get("model_adoptions") == 1
+    assert tr.demand("unknown-model") is False
+
+
+def test_trader_victim_tiebreak_prefers_parked_disk_sessions():
+    """Satellite (PR 13 follow-up): among equally-cold models, trade
+    away the one whose sessions are parked on a shared DISK tier —
+    nothing resumable is lost with its replica."""
+    ka, kb, kc = model_key("a"), model_key("b"), model_key("c")
+    cat = ModelCatalog([ModelSpec("a", replicas=1),
+                        ModelSpec("b", replicas=2),
+                        ModelSpec("c", replicas=2)])
+    disk_tier = {"disk": True, "sessions": ["s1", "s2", "s3"]}
+    ram_tier = {"disk": False, "sessions": ["s4", "s5", "s6"]}
+    reg = _TradeRegistry([
+        _rep("a:0", "a"),
+        _rep("b:0", "b", kv_tier=ram_tier), _rep("b:1", "b"),
+        _rep("c:0", "c", kv_tier=disk_tier), _rep("c:1", "c")])
+    fleet = _StubTradeFleet(reg, {ka: 1, kb: 2, kc: 2}, budget=5)
+    # b and c are equally cold (identical signals); only c's sessions
+    # sit on a DISK tier.
+    sig = {ka: dict(HOT), kb: dict(WARM), kc: dict(WARM)}
+    clock = [100.0]
+    tr = _trader(fleet, cat, sig, clock)
+    clock[0] += 10.0    # past the bring-up trade cooldown
+    tr.step()
+    assert fleet.targets[kc] == 1       # c gave the replica up
+    assert fleet.targets[kb] == 2
+    assert fleet.targets[ka] == 2
+
+
+# -- gateway + stub replicas: model routing, metering, cold start -----------
+
+
+def _model_stub(token, registry_addr, model_id, tokens, pool=False,
+                seed_tokens=None):
+    """A stub replica advertising a model_id (and optionally warm-pool
+    membership); its handler serves canned completions, acks adopt by
+    flipping its advertised identity, and acks swap_adapter raw
+    frames."""
+    state = {"model_id": model_id, "pool": pool,
+             "adapter_version": "", "swaps": []}
+
+    def handler(msg, reply):
+        raw = isinstance(msg, wire.RawFrame)
+        head = msg.meta if raw else msg
+        op = head.get("op")
+        if op == "adopt":
+            state["model_id"] = head.get("model_id")
+            state["pool"] = False
+            reply({"op": "adopted", "id": head.get("id"),
+                   "model_id": state["model_id"]})
+            return
+        if op == "swap_adapter":
+            state["swaps"].append(bytes(msg.body))
+            state["adapter_version"] = head.get("adapter_version")
+            reply({"op": "adapter_swapped", "id": head.get("id"),
+                   "adapter_version": state["adapter_version"]})
+            return
+        want = head.get("model")
+        if isinstance(want, str) and want \
+                and want != state["model_id"]:
+            reply({"op": "error", "id": head.get("id"),
+                   "kind": "wrong_model",
+                   "error": f"serving {state['model_id']}"})
+            return
+        reply({"op": "completion", "id": head.get("id"),
+               "tokens": list(tokens), "ttft_ms": 1.0, "total_ms": 2.0})
+
+    def extra():
+        beat = {"adapter_version": state["adapter_version"]}
+        if state["model_id"]:
+            beat["model_id"] = state["model_id"]
+        beat["warm_pool"] = state["pool"]
+        return beat
+
+    server = ReplicaServer(handler, token=token, capacity=4,
+                           registry_addr=registry_addr,
+                           heartbeat_interval=0.05, extra_info=extra)
+    server.model_state = state
+    return server.start()
+
+
+@pytest.fixture()
+def catalog_fleet():
+    token = wire.new_token()
+    reg = ReplicaRegistry(token=token, suspect_after=0.5, dead_after=1.0,
+                          evict_after=5.0, sweep_interval=0.05).start()
+    servers = []
+    try:
+        yield token, reg, servers
+    finally:
+        for s in servers:
+            s.stop()
+        reg.stop()
+
+
+def test_gateway_catalog_routing_and_metering(catalog_fleet):
+    """End-to-end over the wire, jax-free: model labels resolve
+    against the catalog (absent -> default, unknown -> bad_request,
+    bad charset -> bad_request), each model's requests land on ITS
+    replicas, and billing-grade per-tenant x model token meters land
+    in the snapshot (and therefore the Prometheus exposition)."""
+    token, reg, servers = catalog_fleet
+    servers.append(_model_stub(token, reg.addr, "chat", (1, 1)))
+    servers.append(_model_stub(token, reg.addr, "code", (2, 2)))
+    assert _wait(lambda: len(reg.alive()) == 2)
+    assert _wait(lambda: all(r.model_id for r in reg.alive()))
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=2)
+    gw.catalog = ModelCatalog([ModelSpec("chat", replicas=1),
+                               ModelSpec("code", replicas=1, seed=1)])
+    gw.start()
+    try:
+        client = FleetClient(gw.addr, token)
+        assert client.generate([5, 6, 7], 2)["tokens"] == [1, 1]
+        assert client.generate([5, 6], 2, model="code",
+                               priority="tenantX")["tokens"] == [2, 2]
+        assert client.generate([5], 2, model="chat")["tokens"] == [1, 1]
+        with pytest.raises(RequestFailed) as e:
+            client.generate([5], 2, model="never-listed")
+        assert e.value.kind == "bad_request"
+        with pytest.raises(RequestFailed) as e:
+            client.generate([5], 2, model="bad;id")
+        assert e.value.kind == "bad_request"
+        counters = client.metrics()["counters"]
+        # Unlabeled tenant rides the default class; model-less rides
+        # the default model — both metered.
+        assert counters["metering_prompt_tokens_default_chat"] == 4
+        assert counters["metering_decode_tokens_default_chat"] == 4
+        assert counters["metering_prompt_tokens_default_code"] == 2
+        assert counters["metering_decode_tokens_default_code"] == 2
+        snap = client.metrics()
+        assert snap["gauges"]["models"]["chat"]["alive"] == 1
+        # The Prometheus surface carries the meters (sanitized names).
+        text = metrics.prometheus_text()
+        assert "fleet_metering_decode_tokens_default_code_total 2" \
+            in text
+        client.close()
+    finally:
+        gw.stop()
+
+
+def test_warm_pool_adoption_serves_cold_model(catalog_fleet):
+    """The scale-to-zero cold start, jax-free end to end: a request
+    for a model with NO replica fires the router's demand hook, the
+    trader adopts the warm-pool stub, and the request completes — no
+    error, no client retry."""
+    token, reg, servers = catalog_fleet
+    servers.append(_model_stub(token, reg.addr, "hot", (3,)))
+    pool = _model_stub(token, reg.addr, "", (9,), pool=True)
+    servers.append(pool)
+    assert _wait(lambda: len(reg.alive()) == 2)
+    assert _wait(lambda: reg.has_pool())
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=token)
+    cat = ModelCatalog([ModelSpec("hot", replicas=1),
+                        ModelSpec("cold", replicas=0, seed=1)])
+
+    class _Fleet:
+        registry = reg
+        scale_lock = threading.RLock()
+        targets = {model_key("hot"): 1, POOL_KEY: 1}
+        replica_budget = 2
+
+        def __init__(self):
+            self.metrics = metrics
+
+        def set_target(self, key, n):
+            self.targets[key] = n
+
+        def bounds(self, key):
+            return (0, 2)
+
+        def tier_members(self, key):
+            from tfmesos_tpu.fleet.catalog import filter_members
+
+            _, role = split_key(key)
+            return filter_members(reg.members(role), key)
+
+        def tier_actual(self, key):
+            return len([r for r in self.tier_members(key)
+                        if r.state != "dead"])
+
+        def adopt_replica(self, addr, model_id):
+            spec = cat.get(model_id)
+            reply = router.control(
+                addr, {"op": "adopt", "model_id": spec.model_id,
+                       "seed": spec.seed}, timeout=10.0)
+            return isinstance(reply, dict) \
+                and reply.get("op") == "adopted"
+
+        def launch_replica(self, key, weights_version=None):
+            raise AssertionError("cold start must ADOPT, not launch")
+
+        def kill_replica(self, node):
+            return True
+
+    trader = ModelTrader(_Fleet(), cat)
+    router.on_model_demand = trader.demand
+    router.model_wait_s = 10.0
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=token, workers=2)
+    gw.catalog = cat
+    gw.start()
+    try:
+        client = FleetClient(gw.addr, token, timeout=30.0)
+        out = client.generate([1, 2], 1, model="cold")
+        assert out["tokens"] == [9]     # served by the adopted stub
+        assert pool.model_state["model_id"] == "cold"
+        assert metrics.get("model_cold_waits") == 1
+        assert metrics.get("model_cold_starts") == 1
+        # The hot model's replica never served it.
+        assert client.generate([1], 1, model="hot")["tokens"] == [3]
+        client.close()
+    finally:
+        gw.stop()
+
+
+def test_router_control_raw_ships_adapter_frame(catalog_fleet):
+    """The adapter delta crosses the replica link as ONE raw HMAC
+    frame, byte-identical, and the ack round-trips."""
+    np = pytest.importorskip("numpy")
+    token, reg, servers = catalog_fleet
+    stub = _model_stub(token, reg.addr, "m1", (1,))
+    servers.append(stub)
+    assert _wait(lambda: len(reg.alive()) == 1)
+    router = Router(reg, FleetMetrics(), token=token)
+    meta, body = pack_adapter({"layers/wq": np.ones((4, 4),
+                                                    np.float32)})
+    call = dict(meta)
+    call.update(op="swap_adapter", model_id="m1",
+                adapter_version="d1")
+    reply = router.control_raw(stub.addr, call, body, timeout=10.0)
+    assert reply["op"] == "adapter_swapped"
+    assert reply["adapter_version"] == "d1"
+    assert stub.model_state["swaps"] == [body]
+    # The new adapter version rides the next heartbeat into the table.
+    assert _wait(lambda: reg.members()[0].adapter_version == "d1")
+    router.close()

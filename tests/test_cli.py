@@ -520,3 +520,71 @@ def test_simulate_sessions_scenario_cli(capfd):
     assert res["lost"] == 0
     assert res["kv_tier_hit_rate"] > 0
     assert res["sessions_parked"] > 0
+
+
+def test_parse_model_spec():
+    from tfmesos_tpu.cli import parse_model_spec
+
+    assert parse_model_spec(None) is None
+    assert parse_model_spec("") is None
+    specs = parse_model_spec("chat:2,code:1:7,draft:0")
+    assert [(s.model_id, s.replicas, s.seed) for s in specs] == \
+        [("chat", 2, 0), ("code", 1, 7), ("draft", 0, 2)]
+    for bad in ("chat", "chat:x", "chat:1:2:3", ":1", "a:1,a:2",
+                "bad;id:1", "ok:1,b\nb:1", ","):
+        with pytest.raises(ValueError):
+            parse_model_spec(bad)
+
+
+def test_serve_parser_model_catalog_flags():
+    from tfmesos_tpu.cli import build_serve_parser
+
+    args = build_serve_parser().parse_args(
+        ["--models", "chat:2,code:0", "--warm-pool", "1",
+         "--model-budget", "4", "--tiny"])
+    assert args.models == "chat:2,code:0"
+    assert args.warm_pool == 1 and args.model_budget == 4
+    # --models and --role are mutually exclusive at serve_main.
+    from tfmesos_tpu.cli import serve_main
+
+    assert serve_main(["--models", "chat:1", "--role", "prefill:1,decode:1",
+                       "--tiny"]) == 2
+    assert serve_main(["--models", "bad;id:1", "--tiny"]) == 2
+    # Constructor-level flag validation is a clean exit 2, no traceback.
+    assert serve_main(["--warm-pool", "1", "--tiny"]) == 2
+    assert serve_main(["--models", "chat:3", "--model-budget", "2",
+                       "--tiny"]) == 2
+
+
+def test_swap_adapter_parser_and_submit_model_flag():
+    from tfmesos_tpu.cli import (build_submit_parser,
+                                 build_swap_adapter_parser)
+
+    args = build_swap_adapter_parser().parse_args(
+        ["-g", "h:1", "--model", "chat", "--version", "lora1",
+         "--npz", "/tmp/d.npz"])
+    assert args.model == "chat" and args.adapter_version == "lora1"
+    s = build_submit_parser().parse_args(
+        ["-g", "h:1", "--prompt", "1,2", "--model", "code"])
+    assert s.model == "code"
+
+
+def test_simulate_multi_model_scenario_cli(capfd):
+    """`tfserve simulate multi-model` runs end to end and the trader
+    constants are sweepable by dotted path from the CLI."""
+    from tfmesos_tpu.cli import serve_main
+
+    rc = serve_main(["simulate", "multi-model", "--requests", "1500",
+                     "--seed", "3", "--json"])
+    out, _ = capfd.readouterr()
+    assert rc == 0
+    res = json.loads(out.strip().splitlines()[-1])
+    assert res["failed"] == 0 and res["lost"] == 0
+    assert res["trades"] >= 1
+    assert res["cold_start"]["completed"]
+    rc = serve_main(["simulate", "multi-model", "--requests", "600",
+                     "--seed", "3",
+                     "--sweep", "trader.trade_cooldown_s=2,20"])
+    out, _ = capfd.readouterr()
+    assert rc == 0
+    assert "trader.trade_cooldown_s" in out

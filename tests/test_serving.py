@@ -2545,3 +2545,169 @@ def test_bypass_registry_audit(setup):
     assert b.prefix_cache_bypass_reason == want["prefix_cache"]
     assert b.kv_tier_bypass_reason == want["kv_tier"]
     assert b.pipeline_bypass_reason == want["pipeline"]
+
+
+# -- adapter hot-swap / warm-pool adoption (PR 15) ---------------------------
+
+
+def _fold(params, delta):
+    """Offline reference of a LoRA-style fold: params with each
+    path's delta added (dict copies along the paths, jax leaves —
+    the same arithmetic _apply_weight_update performs)."""
+    def clone(node):
+        return ({k: clone(v) for k, v in node.items()}
+                if isinstance(node, dict) else node)
+
+    new = clone(params)
+    for path, arr in delta.items():
+        keys = path.split("/")
+        node = new
+        for k in keys[:-1]:
+            node = node[k]
+        leaf = node[keys[-1]]
+        node[keys[-1]] = leaf + jnp.asarray(arr).astype(leaf.dtype)
+    return new
+
+
+def _first_2d_path(params):
+    """Some real param path to perturb (+ its leaf), as 'a/b/...'."""
+    flat = []
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, prefix + (k,))
+        else:
+            flat.append((prefix, node))
+
+    walk(params, ())
+    flat.sort(key=lambda kv: "/".join(kv[0]))
+    path, leaf = flat[0]
+    return "/".join(path), leaf
+
+
+def test_swap_adapter_fence_streams_token_identical(setup):
+    """The adapter hot-swap contract end to end at the batcher: a
+    delta queued while rows are RESIDENT applies only after they
+    finish (in-flight streams complete on the OLD weights), new
+    admissions wait behind the fence and serve the NEW weights — every
+    stream token-identical to an offline run under exactly one delta
+    version."""
+    cfg, params = setup
+    path, leaf = _first_2d_path(params)
+    rng = np.random.RandomState(5)
+    delta = {path: (0.5 * rng.standard_normal(np.asarray(leaf).shape)
+                    ).astype(np.asarray(leaf).dtype)}
+    folded = _fold(params, delta)
+    prompts = _prompts(cfg, 3, seed=11)
+    req_a = Request(prompt=prompts[0], max_new_tokens=10)   # long
+    req_b = Request(prompt=prompts[1], max_new_tokens=2)    # short
+    req_c = Request(prompt=prompts[2], max_new_tokens=6)    # post-swap
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                                page_size=16, prefill_bucket=16)
+    gen = batcher.serve()
+    batcher.submit(req_a)
+    batcher.submit(req_b)
+    first = next(gen)
+    assert first.request is req_b       # the short one lands first
+    # req_a is still mid-decode: queue the swap NOW.  It must not
+    # apply (nor fire its callback) until req_a's stream finishes.
+    applied = []
+    batcher.swap_adapter(delta, "lora1",
+                         on_applied=lambda: applied.append(
+                             batcher.adapter_version))
+    batcher.submit(req_c)               # waits behind the fence
+    second = next(gen)
+    assert second.request is req_a
+    third = next(gen)
+    assert third.request is req_c
+    batcher.close()
+    assert list(gen) == []
+    # In-flight finished on the OLD delta; post-swap serves the NEW.
+    assert first.tokens == _offline(cfg, params, req_b)
+    assert second.tokens == _offline(cfg, params, req_a)
+    assert third.tokens == _offline(cfg, folded, req_c)
+    assert third.tokens != _offline(cfg, params, req_c)
+    assert applied == ["lora1"]
+    assert batcher.adapter_version == "lora1"
+    assert batcher.weight_swaps == 1
+
+
+def test_swap_adapter_validation_and_direct_apply(setup):
+    cfg, params = setup
+    path, leaf = _first_2d_path(params)
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                                page_size=16, prefill_bucket=16)
+    shape = np.asarray(leaf).shape
+    with pytest.raises(ValueError):
+        batcher.swap_adapter({}, "v")               # empty delta
+    with pytest.raises(ValueError):
+        batcher.swap_adapter({path: np.zeros(shape)}, "")   # no label
+    with pytest.raises(ValueError):
+        batcher.swap_adapter({"nope/nope": np.zeros((2, 2))}, "v")
+    with pytest.raises(ValueError):                 # shape mismatch
+        batcher.swap_adapter({path: np.zeros((1, 1, 7))}, "v")
+    interior = path.rsplit("/", 1)[0] if "/" in path else None
+    if interior:                        # interior node, not a leaf
+        with pytest.raises(ValueError):
+            batcher.swap_adapter({interior: np.zeros((2, 2))}, "v")
+    with pytest.raises(ValueError):     # empty path
+        batcher.swap_adapter({"": np.zeros((2, 2))}, "v")
+    # Validation failures left the weights untouched.
+    assert batcher.adapter_version == "" and batcher.weight_swaps == 0
+    # No serve loop: the fold applies synchronously (the prefill-role
+    # / direct-use path) and the next run serves the folded weights.
+    delta = {path: np.full(shape, 0.03,
+                           dtype=np.asarray(leaf).dtype)}
+    batcher.swap_adapter(delta, "d1")
+    assert batcher.adapter_version == "d1"
+    req = Request(prompt=_prompts(cfg, 1, seed=3)[0], max_new_tokens=5)
+    done = list(batcher.run([req]))
+    assert done[0].tokens == _offline(cfg, _fold(params, delta), req)
+
+
+def test_set_weights_installs_other_model(setup):
+    """The warm-pool adoption path: set_weights replaces the FULL tree
+    (same shapes — nothing recompiles) and subsequent streams equal
+    the other model's offline run; the adapter label resets to base."""
+    cfg, params = setup
+    other = transformer.init_params(cfg, jax.random.PRNGKey(42))
+    path, leaf = _first_2d_path(params)
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                                page_size=16, prefill_bucket=16)
+    batcher.swap_adapter(
+        {path: np.full(np.asarray(leaf).shape, 0.02,
+                       dtype=np.asarray(leaf).dtype)}, "d1")
+    assert batcher.adapter_version == "d1"
+    batcher.set_weights(other, version="v0@other")
+    assert batcher.adapter_version == ""    # full install = base state
+    req = Request(prompt=_prompts(cfg, 1, seed=7)[0], max_new_tokens=6)
+    done = list(batcher.run([req]))
+    assert done[0].tokens == _offline(cfg, other, req)
+    assert batcher.weight_swaps == 2
+
+
+def test_swap_adapter_flushes_prefix_cache(setup):
+    """KV computed under the old delta is WRONG under the new one: the
+    fold flushes the prefix trie, so a warm repeat after the swap
+    re-prefills and equals the folded offline run (stale pages would
+    silently corrupt it)."""
+    cfg, params = setup
+    path, leaf = _first_2d_path(params)
+    shape = np.asarray(leaf).shape
+    prompt = _prompts(cfg, 1, seed=13)[0]
+    batcher = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                                page_size=16, prefill_bucket=16,
+                                prefix_cache_pages=8)
+    req1 = Request(prompt=prompt, max_new_tokens=4)
+    list(batcher.run([req1]))           # warms the trie
+    stats = batcher.prefix_cache_stats()
+    assert stats and stats["cached_pages"] > 0
+    delta = {path: np.full(shape, 0.04,
+                           dtype=np.asarray(leaf).dtype)}
+    batcher.swap_adapter(delta, "d2")
+    stats = batcher.prefix_cache_stats()
+    assert stats["cached_pages"] == 0   # flushed, not spilled
+    req2 = Request(prompt=prompt, max_new_tokens=4)
+    done = list(batcher.run([req2]))
+    assert done[0].tokens == _offline(cfg, _fold(params, delta), req2)

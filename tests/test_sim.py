@@ -537,3 +537,63 @@ def test_sessions_scenario_rejects_nothing_and_sweeps():
     assert len(rows) == 2
     for _, res in rows:
         assert res["lost"] == 0
+
+
+# -- the model catalog at sim scale (PR 15) ----------------------------------
+
+
+def test_multi_model_scenario_trades_without_thrash(sleep_trap):
+    """The ``multi-model`` scenario: skewed two-model traffic flips
+    hotness mid-run against a FIXED replica budget and the REAL
+    ModelTrader must converge — the heated model ends with more
+    replicas than it booted, the idle model scales to zero, a late
+    request for it cold-starts through the warm pool, trades stay
+    BOUNDED (no thrash), and nothing is lost.  Deterministic per
+    seed."""
+    out = run_scenario("multi-model", n_requests=6000, seed=7)
+    assert out["failed"] == 0 and out["lost"] == 0
+    # The post-flip hot model booted 1 replica; trading must have
+    # grown it within the fixed budget.
+    assert out["post_flip_hot_actual"] > 1
+    assert out["trades"] >= 1
+    # Convergence, not thrash: a flapping trader would churn a trade
+    # per cooldown window for the whole run (dozens at this length).
+    assert out["trades"] <= 6
+    assert out["scale_to_zero"] >= 1
+    # The scaled-to-zero model's late request completed through the
+    # warm-pool demand path — never an error.
+    assert out["cold_start"]["completed"]
+    assert out["cold_starts"] >= 1
+    two = run_scenario("multi-model", n_requests=6000, seed=7)
+    for k in ("completed", "trades", "post_flip_hot_actual",
+              "scale_to_zero", "sim_seconds"):
+        assert two[k] == out[k], k
+
+
+def test_multi_model_sweep_reaches_trader_constants(sleep_trap):
+    """``--sweep trader.zero_after_ticks=...`` (and every other
+    catalog/trader constant) resolves by dotted path — the promoted-
+    constant discipline of PR 11 extended to the new knobs."""
+    rows = run_sweep("multi-model", "trader.zero_after_ticks",
+                     ["4", "1000000"], n_requests=1500, seed=3)
+    assert len(rows) == 2
+    for _, res in rows:
+        assert res["failed"] == 0 and res["lost"] == 0
+    # The knob is live: an effectively-infinite idle threshold never
+    # scales the idle model to zero, the small one does.
+    assert rows[0][1]["scale_to_zero"] >= 1
+    assert rows[1][1]["scale_to_zero"] == 0
+
+
+def test_apply_override_trader_and_catalog_paths():
+    cfg = SimConfig()
+    apply_override(cfg, "trader.trade_cooldown_s", "9.5")
+    assert cfg.trader.trade_cooldown_s == 9.5
+    apply_override(cfg, "trader.zero_after_ticks", "4")
+    assert cfg.trader.zero_after_ticks == 4
+    apply_override(cfg, "catalog.warm_pool", "2")
+    assert cfg.warm_pool == 2
+    apply_override(cfg, "catalog.budget", "7")
+    assert cfg.model_budget == 7
+    with pytest.raises(ValueError):
+        apply_override(cfg, "trader.nope", "1")

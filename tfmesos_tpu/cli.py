@@ -233,10 +233,36 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="ingress queue bound (per class); past it "
                         "requests shed with an explicit Overloaded "
                         "rejection")
+    p.add_argument("--models", type=str, default=None, metavar="SPEC",
+                   help="model catalog, e.g. 'chat:2,code:1,draft:0' "
+                        "(model_id:replicas[:seed]) — the fleet serves "
+                        "MANY models on one replica budget: replicas "
+                        "declare their model, the router routes by it "
+                        "(unlabeled requests ride the FIRST entry), "
+                        "and the trader reallocates replicas between "
+                        "models on relative queue pressure, scaling "
+                        "idle models to zero; a :0 entry starts scaled "
+                        "to zero and cold-starts through --warm-pool "
+                        "(docs/SERVING.md 'Model catalog')")
+    p.add_argument("--warm-pool", type=int, default=0,
+                   dest="warm_pool", metavar="N",
+                   help="with --models: N pre-warmed UNDEDICATED "
+                        "replicas that adopt a model at assignment "
+                        "time — a scaled-to-zero model's first request "
+                        "costs a weight install, not a process launch "
+                        "plus compile")
+    p.add_argument("--model-budget", type=int, default=None,
+                   dest="model_budget", metavar="N",
+                   help="with --models: the fleet-wide replica budget "
+                        "the trader reallocates within (default: the "
+                        "catalog's boot counts + --warm-pool)")
     p.add_argument("--classes", type=str, default=None, metavar="SPEC",
                    help="admission priority classes, highest first, "
                         "e.g. 'interactive:8,background:1' "
-                        "(name:weight[:queue_bound]): each class gets "
+                        "(name:weight[:queue_bound[:model_quota]] — "
+                        "model_quota bounds one model's queued slots "
+                        "within the class on a --models fleet): each "
+                        "class gets "
                         "its own bounded ingress queue served "
                         "weighted-fair, and outranking requests may "
                         "preempt lower-class rows inside the replicas; "
@@ -404,6 +430,42 @@ def parse_role_spec(spec: Optional[str]) -> dict:
     return out
 
 
+def parse_model_spec(spec: Optional[str]):
+    """``'chat:2,code:1:7,draft:0'`` → ModelSpec list
+    (``model_id:replicas[:seed]``, seed defaulting to the entry's
+    index so two entries are two distinct models).  The FIRST entry is
+    the default for model-less requests; ``:0`` entries boot scaled to
+    zero (cold-started through the warm pool on first demand)."""
+    from tfmesos_tpu.fleet.catalog import ModelSpec
+
+    if not spec:
+        return None
+    out = []
+    for i, part in enumerate(p.strip() for p in spec.split(",")
+                             if p.strip()):
+        bits = part.split(":")
+        if len(bits) not in (2, 3) or not bits[0]:
+            raise ValueError(f"bad --models entry {part!r}; want "
+                             f"model_id:replicas[:seed]")
+        try:
+            replicas = int(bits[1])
+            seed = int(bits[2]) if len(bits) == 3 else i
+        except ValueError:
+            raise ValueError(
+                f"bad --models numbers in {part!r}") from None
+        try:
+            out.append(ModelSpec(model_id=bits[0], replicas=replicas,
+                                 seed=seed))
+        except ValueError as e:
+            raise ValueError(f"bad --models entry {part!r}: {e}") \
+                from None
+    if not out:
+        raise ValueError("--models is empty")
+    if len({s.model_id for s in out}) != len(out):
+        raise ValueError("duplicate model_id in --models")
+    return out
+
+
 def parse_class_spec(spec: Optional[str]):
     """``'interactive:8,background:1'`` → PriorityClass list, listed
     highest-priority FIRST: the first class is the default for
@@ -417,18 +479,20 @@ def parse_class_spec(spec: Optional[str]):
     out = []
     for i, part in enumerate(entries):
         bits = part.split(":")
-        if len(bits) not in (2, 3) or not bits[0]:
+        if len(bits) not in (2, 3, 4) or not bits[0]:
             raise ValueError(f"bad --classes entry {part!r}; want "
-                             f"name:weight[:queue_bound]")
+                             f"name:weight[:queue_bound[:model_quota]]")
         try:
             weight = float(bits[1])
-            maxq = int(bits[2]) if len(bits) == 3 else None
+            maxq = int(bits[2]) if len(bits) >= 3 else None
+            quota = int(bits[3]) if len(bits) == 4 else None
         except ValueError:
             raise ValueError(f"bad --classes numbers in {part!r}") from None
         try:
             out.append(PriorityClass(name=bits[0], weight=weight,
                                      rank=len(entries) - 1 - i,
-                                     max_queue=maxq))
+                                     max_queue=maxq,
+                                     model_quota=quota))
         except ValueError as e:
             raise ValueError(f"bad --classes entry {part!r}: {e}") from None
     if len({c.name for c in out}) != len(out):
@@ -479,6 +543,12 @@ def build_submit_parser() -> argparse.ArgumentParser:
                         "(prior prompt + returned tokens + new turn) "
                         "resumes from it, prefilling only the tail "
                         "(docs/SERVING.md 'KV tiering & sessions')")
+    p.add_argument("--model", type=str, default=None,
+                   help="catalog model this request targets (tfserve "
+                        "--models); absent rides the fleet's DEFAULT "
+                        "(first-listed) entry — a scaled-to-zero "
+                        "model's request cold-starts it through the "
+                        "warm pool (docs/SERVING.md 'Model catalog')")
     p.add_argument("--timeout", type=float, default=300.0)
     return p
 
@@ -511,7 +581,8 @@ def submit_main(argv: List[str]) -> int:
                               priority=args.priority,
                               deadline_ms=args.deadline_ms,
                               trace=args.trace or None,
-                              session=args.session)
+                              session=args.session,
+                              model=args.model)
     except Overloaded as e:
         print(f"tfserve submit: shed ({e.kind}): {e} — back off and "
               f"retry", file=sys.stderr)
@@ -864,6 +935,79 @@ def metrics_main(argv: List[str]) -> int:
     return 0
 
 
+def build_swap_adapter_parser() -> argparse.ArgumentParser:
+    """``tfserve swap-adapter`` — hot-swap a LoRA-style weight delta
+    onto every replica of one catalog model with zero downtime
+    (docs/SERVING.md 'Model catalog')."""
+    p = argparse.ArgumentParser(
+        prog="tfserve swap-adapter",
+        description="Fold a weight delta (an .npz of param-path -> "
+                    "array entries) into one catalog model's replicas "
+                    "between generations: in-flight requests finish on "
+                    "the old delta, streams stay token-identical per "
+                    "delta version, zero downtime.")
+    p.add_argument("-g", "--gateway", type=str, required=True,
+                   metavar="HOST:PORT", help="the running gateway")
+    p.add_argument("--model", type=str, required=True,
+                   help="the catalog model_id to swap")
+    p.add_argument("--version", type=str, required=True,
+                   dest="adapter_version",
+                   help="label of the resulting adapter state (same "
+                        "charset as model ids)")
+    p.add_argument("--npz", type=str, required=True,
+                   help=".npz file whose entries map param paths "
+                        "(e.g. 'layers/wq') to delta arrays added "
+                        "onto the matching leaves")
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="seconds to wait (the swap waits for every "
+                        "replica's in-flight generations)")
+    return p
+
+
+def swap_adapter_main(argv: List[str]) -> int:
+    args = build_swap_adapter_parser().parse_args(argv)
+    from tfmesos_tpu.fleet.client import FleetClient, RequestFailed
+
+    token = wire.load_token()
+    if not token:
+        print(f"tfserve swap-adapter: no cluster token — set "
+              f"{wire.TOKEN_ENV} or {wire.TOKEN_FILE_ENV} (tfserve "
+              f"printed the token file at startup)", file=sys.stderr)
+        return 2
+    try:
+        import numpy as np
+
+        with np.load(args.npz) as z:
+            delta = {k: z[k] for k in z.files}
+    except (OSError, ValueError) as e:
+        print(f"tfserve swap-adapter: cannot load {args.npz}: {e}",
+              file=sys.stderr)
+        return 2
+    if not delta:
+        print(f"tfserve swap-adapter: {args.npz} holds no arrays",
+              file=sys.stderr)
+        return 2
+    client = None
+    try:
+        client = FleetClient(args.gateway, token, timeout=args.timeout)
+        out = client.swap_adapter(args.model, args.adapter_version,
+                                  delta, timeout=args.timeout)
+    except RequestFailed as e:
+        print(f"tfserve swap-adapter: {e.kind}: {e}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"tfserve swap-adapter: cannot reach gateway "
+              f"{args.gateway}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        if client is not None:
+            client.close()
+    print(f"tfserve swap-adapter: model {out.get('model_id')} now "
+          f"serves adapter {out.get('adapter_version')} on "
+          f"{out.get('replicas')} replica(s)", flush=True)
+    return 0
+
+
 def build_rollout_parser() -> argparse.ArgumentParser:
     """``tfserve rollout`` — drive a blue-green weight rollout on a
     RUNNING fleet through the gateway's authenticated control op."""
@@ -930,53 +1074,18 @@ def rollout_main(argv: List[str]) -> int:
     return 0
 
 
-def serve_main(argv: Optional[List[str]] = None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    if argv and argv[0] == "rollout":
-        return rollout_main(argv[1:])
-    if argv and argv[0] == "submit":
-        return submit_main(argv[1:])
-    if argv and argv[0] == "trace":
-        return trace_main(argv[1:])
-    if argv and argv[0] == "metrics":
-        return metrics_main(argv[1:])
-    if argv and argv[0] == "gateways":
-        return gateways_main(argv[1:])
-    if argv and argv[0] == "simulate":
-        return simulate_main(argv[1:])
-    args = build_serve_parser().parse_args(argv)
-    try:
-        roles = parse_role_spec(args.role)
-        classes = parse_class_spec(args.classes)
-    except ValueError as e:
-        print(f"tfserve: {e}", file=sys.stderr)
-        return 2
-    min_replicas = 0 if roles else 1
-    if args.replicas < min_replicas:
-        print(f"tfserve: --replicas must be >= {min_replicas}, got "
-              f"{args.replicas}", file=sys.stderr)
-        return 2
-    if args.rows < 1:
-        print(f"tfserve: --rows must be >= 1, got {args.rows}",
-              file=sys.stderr)
-        return 2
-    if args.gateways < 1:
-        print(f"tfserve: --gateways must be >= 1, got {args.gateways}",
-              file=sys.stderr)
-        return 2
-
+def _build_fleet(args, models, roles, classes, token):
+    """Construct the FleetServer from parsed ``tfserve`` args; its
+    constructor ValueErrors (bad flag combinations) surface to the
+    caller for the clean exit-2 path."""
     from tfmesos_tpu.fleet.launcher import FleetServer
-    from tfmesos_tpu.scheduler import ClusterError
 
-    # Clients must present the cluster token: honor an operator-supplied
-    # one (the standard TPUMESOS_TOKEN / TPUMESOS_TOKEN_FILE contract);
-    # otherwise mint one and leave it in a mode-0600 file the operator
-    # can point clients at.
-    token = wire.load_token() or None
-    fleet = FleetServer(
+    return FleetServer(
         replicas=args.replicas, rows=args.rows, tiny=args.tiny,
         prefill_replicas=roles.get("prefill", 0),
         decode_replicas=roles.get("decode", 0),
+        models=models, warm_pool=args.warm_pool,
+        model_budget=args.model_budget,
         weights_version=args.weights_version,
         autoscale=args.autoscale,
         min_replicas=args.min_replicas,
@@ -1000,6 +1109,65 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         trace_sample=args.trace_sample,
         trace_slow_ms=args.trace_slow_ms,
         quiet=not args.verbose, token=token)
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "rollout":
+        return rollout_main(argv[1:])
+    if argv and argv[0] == "swap-adapter":
+        return swap_adapter_main(argv[1:])
+    if argv and argv[0] == "submit":
+        return submit_main(argv[1:])
+    if argv and argv[0] == "trace":
+        return trace_main(argv[1:])
+    if argv and argv[0] == "metrics":
+        return metrics_main(argv[1:])
+    if argv and argv[0] == "gateways":
+        return gateways_main(argv[1:])
+    if argv and argv[0] == "simulate":
+        return simulate_main(argv[1:])
+    args = build_serve_parser().parse_args(argv)
+    try:
+        roles = parse_role_spec(args.role)
+        classes = parse_class_spec(args.classes)
+        models = parse_model_spec(args.models)
+    except ValueError as e:
+        print(f"tfserve: {e}", file=sys.stderr)
+        return 2
+    if models and roles:
+        print("tfserve: --models runs unified tiers; drop --role",
+              file=sys.stderr)
+        return 2
+    min_replicas = 0 if (roles or models) else 1
+    if args.replicas < min_replicas:
+        print(f"tfserve: --replicas must be >= {min_replicas}, got "
+              f"{args.replicas}", file=sys.stderr)
+        return 2
+    if args.rows < 1:
+        print(f"tfserve: --rows must be >= 1, got {args.rows}",
+              file=sys.stderr)
+        return 2
+    if args.gateways < 1:
+        print(f"tfserve: --gateways must be >= 1, got {args.gateways}",
+              file=sys.stderr)
+        return 2
+
+    from tfmesos_tpu.scheduler import ClusterError
+
+    # Clients must present the cluster token: honor an operator-supplied
+    # one (the standard TPUMESOS_TOKEN / TPUMESOS_TOKEN_FILE contract);
+    # otherwise mint one and leave it in a mode-0600 file the operator
+    # can point clients at.
+    token = wire.load_token() or None
+    try:
+        fleet = _build_fleet(args, models, roles, classes, token)
+    except ValueError as e:
+        # Constructor validation (bad flag combinations: --warm-pool
+        # without --models, a budget below the boot footprint, ...) is
+        # an ARGUMENT error: one clean line, exit 2, never a traceback.
+        print(f"tfserve: {e}", file=sys.stderr)
+        return 2
     try:
         fleet.start()
     except (ClusterError, ValueError, RuntimeError) as e:
@@ -1015,6 +1183,11 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         print(f"tfserve: client token file {token_file} (clients set "
               f"{wire.TOKEN_FILE_ENV}={token_file})", flush=True)
     tiers = f"{args.replicas} unified replica(s)"
+    if models:
+        tiers = (f"{len(models)} catalog model(s) on a "
+                 f"{fleet.replica_budget}-replica budget"
+                 + (f" + {args.warm_pool} warm-pool"
+                    if args.warm_pool else ""))
     if roles:
         tiers += (f" + {roles['prefill']} prefill / {roles['decode']} "
                   f"decode (disaggregated)")

@@ -124,16 +124,25 @@ class PriorityClass:
     pressure) — the two are deliberately separate knobs: fair-share is
     about throughput under sustained load, preemption about latency of
     the next arrival.  ``max_queue`` bounds this class's own ingress
-    queue (``None`` = the controller default)."""
+    queue (``None`` = the controller default).  ``model_quota`` bounds
+    how many of the queued slots ONE model may hold within this class
+    (the per-tenant+per-model quota of the model catalog,
+    docs/SERVING.md "Model catalog"): a tenant flooding one model
+    sheds there without starving its own traffic to other models;
+    ``None`` = unlimited, the pre-catalog behavior exactly."""
 
     name: str
     weight: float = 1.0
     rank: int = 0
     max_queue: Optional[int] = None
+    model_quota: Optional[int] = None
 
     def __post_init__(self):
         if not self.name:
             raise ValueError("priority class needs a non-empty name")
+        if self.model_quota is not None and self.model_quota < 1:
+            raise ValueError(f"class {self.name!r} model_quota must be "
+                             f">= 1, got {self.model_quota}")
         # Finite AND positive: a NaN weight poisons every WFQ tag
         # comparison (dispatch order degrades to dict order) and an
         # inf weight's zero tag increment would starve every other
@@ -150,16 +159,31 @@ class _ClassQ:
     """One class's live state: spec + queue + WFQ tag + shed counters."""
 
     __slots__ = ("spec", "q", "last_tag", "shed_queue", "shed_rate",
-                 "shed_deadline", "admitted")
+                 "shed_deadline", "shed_quota", "admitted",
+                 "model_counts")
 
     def __init__(self, spec: PriorityClass):
         self.spec = spec
-        self.q: deque = deque()     # (finish_tag, seq, item, deadline)
+        # (finish_tag, seq, item, deadline, model)
+        self.q: deque = deque()
         self.last_tag = 0.0
         self.shed_queue = 0
         self.shed_rate = 0
         self.shed_deadline = 0
+        self.shed_quota = 0
         self.admitted = 0
+        # model -> queued count (the per-tenant+per-model quota's
+        # live book; decremented as items dequeue or expire).
+        self.model_counts: Dict[str, int] = {}
+
+    def _model_out(self, model: Optional[str]) -> None:
+        if model is None:
+            return
+        n = self.model_counts.get(model, 0) - 1
+        if n > 0:
+            self.model_counts[model] = n
+        else:
+            self.model_counts.pop(model, None)
 
 
 class AdmissionController:
@@ -220,7 +244,8 @@ class AdmissionController:
     # -- admission ---------------------------------------------------------
 
     def admit(self, item: Any, cls: Optional[str] = None,
-              deadline: Optional[float] = None) -> None:
+              deadline: Optional[float] = None,
+              model: Optional[str] = None) -> None:
         """Enqueue ``item`` under class ``cls`` or raise — never blocks
         the caller's connection thread.  ``deadline`` is an absolute
         clock reading (the controller's ``clock``, monotonic by
@@ -247,6 +272,17 @@ class AdmissionController:
                 raise Overloaded(
                     f"ingress queue full for class {spec.name!r} "
                     f"({bound} requests waiting)")
+            if model is not None and spec.model_quota is not None \
+                    and c.model_counts.get(model, 0) >= spec.model_quota:
+                # Per-tenant+per-model quota (checked AFTER the class
+                # bound — one consistent shed order — and BEFORE the
+                # token bucket, which a shed must never debit): this
+                # tenant's flood of ONE model sheds without touching
+                # its own slots for other models or any other class.
+                c.shed_quota += 1
+                raise Overloaded(
+                    f"model quota full for class {spec.name!r} / model "
+                    f"{model!r} ({spec.model_quota} queued)")
             if self.bucket is not None and not self.bucket.try_acquire():
                 c.shed_rate += 1
                 raise RateLimited(
@@ -258,7 +294,9 @@ class AdmissionController:
             tag = max(self._vtime, c.last_tag) + 1.0 / spec.weight
             c.last_tag = tag
             self._seq += 1
-            c.q.append((tag, self._seq, item, deadline))
+            c.q.append((tag, self._seq, item, deadline, model))
+            if model is not None:
+                c.model_counts[model] = c.model_counts.get(model, 0) + 1
             c.admitted += 1
             self._cond.notify()
 
@@ -298,7 +336,8 @@ class AdmissionController:
                     if c.q and (best is None or c.q[0][:2] < best.q[0][:2]):
                         best = c
                 if best is not None:
-                    tag, _, item, dl = best.q.popleft()
+                    tag, _, item, dl, model = best.q.popleft()
+                    best._model_out(model)
                     if tag > self._vtime:
                         self._vtime = tag
                     if dl is not None and self._clock() >= dl:
@@ -330,4 +369,10 @@ class AdmissionController:
         since start."""
         with self._cond:
             return {name: (c.shed_queue, c.shed_rate, c.shed_deadline)
+                    for name, c in self._classes.items()}
+
+    def quota_shed_counts(self) -> Dict[str, int]:
+        """Per-class sheds from the per-tenant+per-model quota."""
+        with self._cond:
+            return {name: c.shed_quota
                     for name, c in self._classes.items()}

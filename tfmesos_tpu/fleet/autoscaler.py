@@ -206,11 +206,33 @@ class FleetAutoscaler:
                 self._converge(role, now)
             self._reap_drained(now)
 
+    def _members(self, role: str):
+        """This tier's registry members.  A fleet exposing
+        ``tier_members`` (the model-catalog launcher, the simulator)
+        resolves composite ``"<model>/<role>"`` keys there; plain
+        fleets keep the direct registry query."""
+        tm = getattr(self.fleet, "tier_members", None)
+        if tm is not None:
+            return tm(role)
+        return self.fleet.registry.members(role)
+
+    def _scale_up(self, role: str) -> str:
+        """Actuate one tier scale-up; the model trader overrides this
+        to prefer warm-pool adoption over a cold launch."""
+        return self.fleet.launch_replica(role)
+
+    def _allow_zero(self, role: str) -> bool:
+        """Whether this tier may drain its LAST alive replica (the
+        scale-to-zero policy); the base loop never does."""
+        return False
+
     def _retarget(self, role: str, sig: Dict[str, Any], now: float) -> None:
         cfg = self.config
         target = self.fleet.targets[role]
         lo, hi = self.fleet.bounds(role)
-        if role == DECODE:
+        # Composite per-(model, tier) keys ("m/decode") resolve their
+        # ROLE by suffix — '/' is outside the model-id charset.
+        if role.rsplit("/", 1)[-1] == DECODE:
             # Decode replicas exhaust KV pages, not rows: headroom is
             # the binding resource.
             headroom = sig.get("kv_headroom")
@@ -265,13 +287,13 @@ class FleetAutoscaler:
         # replica — full churn (warmup, then another drain) for
         # nothing.  The pending list itself still gates one-drain-at-
         # a-time below until _reap_drained clears the record.
-        members = {r.addr: r for r in self.fleet.registry.members(role)}
+        members = {r.addr: r for r in self._members(role)}
         live_draining = sum(
             1 for a, _ in pending
             if a in members and members[a].state != DEAD)
         actual = self.fleet.tier_actual(role) - live_draining
         if actual < target:
-            node = self.fleet.launch_replica(role)
+            node = self._scale_up(role)
             self._last_action[role] = f"launch:{node}"
             self.fleet.metrics.inc("autoscale_launches")
             self.log.info("autoscaler: %s tier %d/%d — launched %s "
@@ -280,13 +302,15 @@ class FleetAutoscaler:
             return
         if actual <= target or pending:
             return      # converged, or a drain is already in flight
-        alive = [r for r in self.fleet.registry.members(role)
-                 if r.state == ALIVE]
-        if len(alive) < 2:
+        alive = [r for r in members.values() if r.state == ALIVE]
+        if len(alive) < 2 and not (self._allow_zero(role)
+                                   and target < 1):
             # Invariant: never drain a routable tier below one alive
             # replica — even when target says shrink, the LAST alive
             # member waits until its warming replacement (or a peer)
-            # is routable.
+            # is routable.  Scale-to-zero tiers (the model trader's
+            # idle models) opt out: their last replica drains away and
+            # the next request cold-starts through the warm pool.
             return
         victim = min(alive, key=lambda r: (r.outstanding, r.addr))
         if not self.fleet.registry.begin_drain(victim.addr, pinned=True):
@@ -322,7 +346,7 @@ class FleetAutoscaler:
         passed."""
         router = getattr(self.fleet, "router", None)
         for addr, d in list(self._draining.items()):
-            rep = next((r for r in self.fleet.registry.members(d["role"])
+            rep = next((r for r in self._members(d["role"])
                         if r.addr == addr), None)
             in_flight = router.outstanding(addr) if router is not None \
                 else 0

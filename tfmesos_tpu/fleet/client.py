@@ -386,6 +386,7 @@ class FleetClient:
                  deadline_ms: Optional[float] = None,
                  trace=None,
                  session: Optional[str] = None,
+                 model: Optional[str] = None,
                  on_tokens: Optional[Callable[[List[int]], None]] = None
                  ) -> Dict[str, Any]:
         """One generation request; returns the completion dict
@@ -435,6 +436,15 @@ class FleetClient:
                 raise ValueError(f"session must be a non-empty string, "
                                  f"got {session!r}")
             msg["session"] = session
+        if model is not None:
+            # Model-catalog label (docs/SERVING.md "Model catalog"):
+            # names the catalog entry this request targets; absent
+            # rides the fleet's DEFAULT entry, so model-less callers
+            # need no change against a catalog fleet.
+            if not isinstance(model, str) or not model:
+                raise ValueError(f"model must be a non-empty string, "
+                                 f"got {model!r}")
+            msg["model"] = model
 
         on_partial = None
         if on_tokens is not None:
@@ -556,6 +566,32 @@ class FleetClient:
             else "error"
         error = reply.get("error", repr(reply)) if isinstance(reply, dict) \
             else repr(reply)
+        raise RequestFailed(error, kind=kind)
+
+    def swap_adapter(self, model_id: str, adapter_version: str,
+                     delta: Dict[str, Any],
+                     timeout: float = 900.0) -> Dict[str, Any]:
+        """Hot-swap a LoRA-style weight delta onto every replica of
+        one catalog model through the gateway's control op and block
+        until every replica has folded it (in-flight requests finish
+        on the old delta first — size ``timeout`` for a generation's
+        tail).  ``delta`` maps param paths to numpy arrays; it ships
+        base64 to the gateway and as raw HMAC frames to the replicas.
+        NEVER replayed on failover (like rollout — the second attempt
+        would race the first's folds)."""
+        from tfmesos_tpu.fleet.catalog import encode_adapter_fields
+
+        reply = self._connection().call(
+            {"op": "swap_adapter", "model_id": str(model_id),
+             "adapter_version": str(adapter_version),
+             "delta": encode_adapter_fields(delta)},
+            timeout=timeout)
+        if isinstance(reply, dict) and reply.get("op") == "swap_adapter":
+            return reply
+        kind = reply.get("kind", "error") if isinstance(reply, dict) \
+            else "error"
+        error = reply.get("error", repr(reply)) \
+            if isinstance(reply, dict) else repr(reply)
         raise RequestFailed(error, kind=kind)
 
     @property

@@ -135,6 +135,15 @@ class Gateway:
         # FleetServer after bring-up): callable(version) -> info dict,
         # raising on abort.  None = this gateway has no rollout surface.
         self.rollout_fn = None
+        # Model catalog (docs/SERVING.md "Model catalog"), both set by
+        # FleetServer on catalog fleets: the catalog resolves/validates
+        # the request's ``model`` label (absent -> the default entry;
+        # unknown -> bad_request), and swap_adapter_fn is the adapter
+        # hot-swap control plane (callable(model_id, version, meta,
+        # body) -> info dict).  None = model-less fleet: a ``model``
+        # label is charset-checked and forwarded as-is.
+        self.catalog = None
+        self.swap_adapter_fn = None
         self._server: Optional[wire.WireServer] = None
         self._stop = threading.Event()
         self._threads = []
@@ -176,6 +185,11 @@ class Gateway:
         # `tfserve metrics` and Prometheus like every dict gauge.
         if hasattr(self.registry, "spec_summary"):
             metrics.register_gauge("spec", self.registry.spec_summary)
+        # Per-model replica counts + adapter-version distribution (the
+        # model catalog's membership gauge).
+        if hasattr(self.registry, "model_summary"):
+            metrics.register_gauge("models",
+                                   self.registry.model_summary)
         # Items that expired while queued still owe the client an
         # explicit answer — the controller hands them back here from
         # whichever worker's get() swept them.
@@ -319,6 +333,58 @@ class Gateway:
             threading.Thread(target=run_rollout, name="gateway-rollout",
                              daemon=True).start()
             return
+        if op == "swap_adapter":
+            # Adapter hot-swap control op (docs/SERVING.md "Model
+            # catalog").  The public port rejects raw frames at the
+            # length prefix, so the delta arrives base64 in JSON and
+            # the control plane re-ships it to the replicas as raw
+            # HMAC frames.  Validation here is an INGRESS boundary:
+            # model_id/adapter_version are charset-checked before they
+            # touch anything.
+            from tfmesos_tpu.fleet.catalog import decode_adapter_fields
+            from tfmesos_tpu.fleet.registry import validate_model_id
+
+            fn = self.swap_adapter_fn
+            if fn is None:
+                client.send({"op": "error", "id": cid,
+                             "kind": "bad_request",
+                             "error": "no model catalog attached to "
+                                      "this gateway"})
+                return
+            try:
+                model_id = validate_model_id(msg.get("model_id"))
+                version = validate_model_id(msg.get("adapter_version"))
+                meta, body = decode_adapter_fields(msg.get("delta"))
+            except (TypeError, ValueError) as e:
+                client.send({"op": "error", "id": cid,
+                             "kind": "bad_request", "error": str(e)})
+                return
+
+            def run_swap() -> None:
+                # Off the event-loop thread: the swap waits for every
+                # replica's in-flight generations to finish on the old
+                # delta, and blocking here would stall EVERY
+                # connection.
+                try:
+                    info = fn(model_id, version, meta, body)
+                except KeyError as e:
+                    client.send({"op": "error", "id": cid,
+                                 "kind": "bad_request",
+                                 "error": str(e)})
+                    return
+                except Exception as e:
+                    client.send({"op": "error", "id": cid,
+                                 "kind": "swap_failed",
+                                 "error": str(e)})
+                    return
+                out = {"op": "swap_adapter", "id": cid, "ok": True}
+                if isinstance(info, dict):
+                    out.update(info)
+                client.send(out)
+
+            threading.Thread(target=run_swap, name="gateway-swap",
+                             daemon=True).start()
+            return
         if op != "generate":
             client.send({"op": "error", "id": cid, "kind": "bad_request",
                          "error": f"unknown op {op!r}"})
@@ -342,8 +408,42 @@ class Gateway:
             label = msg.get("tenant")
         spec = self.admission.resolve(
             label if isinstance(label, str) else None)
+        # The model tier (docs/SERVING.md "Model catalog"): the label
+        # is charset-validated at THIS ingress (it reaches Prometheus
+        # metric names and the routing filter), resolved against the
+        # catalog when one is attached — absent rides the default
+        # entry, unknown is an explicit bad_request (there are no
+        # weights to serve it, and billing it to the default would be
+        # silently wrong).  Model-less fleets forward a validated
+        # label as-is and route by exact replica match.
+        from tfmesos_tpu.fleet.registry import MODEL_ID_RE
+
+        mraw = msg.get("model")
+        model = None
+        if mraw is not None:
+            if not (isinstance(mraw, str)
+                    and MODEL_ID_RE.fullmatch(mraw)):
+                self.metrics.inc("failed")
+                self.tracebook.finish(tr, "bad_request", cls=spec.name)
+                client.send({"op": "error", "id": cid,
+                             "kind": "bad_request",
+                             "error": f"invalid model label {mraw!r}",
+                             "trace_id": tr.trace_id})
+                return
+            model = mraw
+        if self.catalog is not None:
+            try:
+                model = self.catalog.resolve(model)
+            except KeyError as e:
+                self.metrics.inc("failed")
+                self.tracebook.finish(tr, "bad_request", cls=spec.name)
+                client.send({"op": "error", "id": cid,
+                             "kind": "bad_request", "error": str(e),
+                             "trace_id": tr.trace_id})
+                return
         prompt = msg.get("prompt")
         tr.event("gateway", "recv", cls=spec.name, rank=spec.rank,
+                 model=model or "",
                  prompt_len=(len(prompt)
                              if isinstance(prompt, (list, tuple)) else 0))
         # End-to-end deadline: the client ships a RELATIVE budget
@@ -376,12 +476,18 @@ class Gateway:
             # the parked KV, and the replica's batcher parks/resumes
             # under it.  Malformed values cost the field.
             forward["session"] = sid
+        if model is not None:
+            # Internal like "deadline"/"_trace": the router's model
+            # tier filters on it (and re-stamps it onto the wire as
+            # ``model`` for the replica's own cross-check).
+            forward["_model"] = model
         if deadline is not None:
             forward["deadline"] = deadline
         try:
             self.admission.admit((client, cid, forward,
                                   time.perf_counter(), spec.name, tr),
-                                 cls=spec.name, deadline=deadline)
+                                 cls=spec.name, deadline=deadline,
+                                 model=model)
         except DeadlineExceeded as e:
             self.metrics.inc("shed_deadline")
             self.metrics.inc(f"shed_deadline_{spec.name}")
@@ -472,6 +578,13 @@ class Gateway:
             wait_ms = (time.perf_counter() - t_enq) * 1000.0
             self.metrics.observe("queue_wait_ms", wait_ms)
             self.metrics.observe(f"queue_wait_ms_{cls}", wait_ms)
+            model = forward.get("_model")
+            if model:
+                # The per-MODEL queue-wait histogram is the model
+                # trader's relative-pressure signal (windowed p99 per
+                # model is what decides who trades replicas to whom).
+                self.metrics.observe(f"queue_wait_ms_model_{model}",
+                                     wait_ms)
             # The WFQ dequeue closes the queue-wait span — the first
             # hop of every waterfall.
             tr.add("admission", "queue_wait", tr.rel_ms(t_enq), wait_ms,
@@ -503,8 +616,19 @@ class Gateway:
             out.pop("trace", None)
             if out.get("op") == "completion":
                 self.metrics.inc("completed")
-                self.metrics.inc("tokens_out",
-                                 len(out.get("tokens") or ()))
+                n_out = len(out.get("tokens") or ())
+                self.metrics.inc("tokens_out", n_out)
+                # Billing-grade metering: prompt and decode tokens per
+                # tenant-class x model (docs/SERVING.md "Model
+                # catalog").  Plain counters, so they ride the
+                # snapshot AND the Prometheus exposition (names
+                # sanitized there); counted only on DELIVERED
+                # completions — failed work is not billable.
+                suffix = f"{cls}_{model}" if model else cls
+                self.metrics.inc(f"metering_prompt_tokens_{suffix}",
+                                 len(forward.get("prompt") or ()))
+                self.metrics.inc(f"metering_decode_tokens_{suffix}",
+                                 n_out)
                 if "decode_ms" in out:      # disaggregated completions
                     # Their TTFT is router-measured (route start to
                     # prefill reply) — a different clock base than the

@@ -123,6 +123,10 @@ class KVTierStore:
         #: writer identity merged into every entry's meta; a reader
         #: whose stamp names a DIFFERENT weights_version misses.
         self.stamp = dict(stamp or {})
+        # The base weights_version :meth:`restamp` composes adapter
+        # labels onto (the stamp dict itself is REPLACED atomically —
+        # readers under the lock see old or new whole, never a mix).
+        self._base_wv = self.stamp.get("weights_version")
         #: the prefix-page chunk geometry this store's "prefix" entries
         #: were cut with ({page, first, seed}) — set by the owning
         #: batcher; rides summary() so the router can match prompts
@@ -367,6 +371,29 @@ class KVTierStore:
                         f"disk tier ({self.disk_bytes} bytes budget, "
                         f"or the write failed)")
 
+    def restamp(self, weights_version: Optional[str] = None,
+                adapter: str = "") -> None:
+        """Re-identify the store's writer/reader stamp after an online
+        weight change: ``weights_version`` replaces the base label
+        (``None`` keeps it — the adapter-fold case), a non-empty
+        ``adapter`` composes as ``"<base>+<adapter>"``.  Entries
+        written under the OLD stamp become version misses (cold
+        re-prefill, never stale KV) and new writes carry the new one.
+        The batcher calls this from its weight-update fence
+        (``swap_adapter`` / ``set_weights``)."""
+        with self._lock:
+            if weights_version is not None:
+                self._base_wv = str(weights_version)
+            wv = self._base_wv
+            if adapter:
+                wv = f"{wv or ''}+{adapter}"
+            stamp = dict(self.stamp)
+            if wv:
+                stamp["weights_version"] = wv
+            else:
+                stamp.pop("weights_version", None)
+            self.stamp = stamp
+
     def _stamp_ok(self, meta: dict) -> bool:
         """Weights-version fence: an entry stamped with a DIFFERENT
         version than this reader's stamp is stale KV and must miss.
@@ -502,6 +529,11 @@ class KVTierStore:
                 "sessions": sessions,
                 "counters": dict(self._stats),
                 "ram_bytes_used": self._ram_used,
+                # Whether parked state survives this replica (a
+                # host-shared disk tier) — the model trader's victim
+                # tie-break reads it: trading away a replica whose
+                # sessions are parked on disk loses nothing resumable.
+                "disk": self.disk_dir is not None,
             }
             geom = self.prefix_geometry
         if geom and hashes:

@@ -39,11 +39,18 @@ from typing import Dict, List, Optional, Tuple
 from tfmesos_tpu import wire
 from tfmesos_tpu.fleet.admission import AdmissionController, PriorityClass
 from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig, FleetAutoscaler
+from tfmesos_tpu.fleet.catalog import (POOL, POOL_KEY, ModelCatalog,
+                                       ModelSpec, ModelTrader,
+                                       TraderConfig, filter_members,
+                                       model_key, pack_adapter,
+                                       split_key)
 from tfmesos_tpu.fleet.client import FleetClient
 from tfmesos_tpu.fleet.gateway import Gateway
 from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (ALIVE, DEAD, DECODE, PREFILL,
-                                        UNIFIED, ReplicaRegistry)
+                                        UNIFIED, WARMING,
+                                        ReplicaRegistry,
+                                        validate_model_id)
 from tfmesos_tpu.fleet.router import Router
 from tfmesos_tpu.fleet.tracing import TraceBook
 from tfmesos_tpu.scheduler import (MAX_FAILURE_COUNT, ClusterError,
@@ -115,6 +122,10 @@ class FleetServer:
                  warmup: bool = False,
                  prefill_replicas: int = 0,
                  decode_replicas: int = 0,
+                 models: Optional[List[ModelSpec]] = None,
+                 warm_pool: int = 0,
+                 model_budget: Optional[int] = None,
+                 trader_config: Optional[TraderConfig] = None,
                  weights_version: str = "v0",
                  autoscale: bool = False,
                  min_replicas: Optional[int] = None,
@@ -150,7 +161,45 @@ class FleetServer:
                 f"a lone tier cannot serve the disaggregated handoff "
                 f"(got prefill_replicas={prefill_replicas}, "
                 f"decode_replicas={decode_replicas})")
-        if replicas + prefill_replicas + decode_replicas < 1:
+        # Model catalog (docs/SERVING.md "Model catalog"): with
+        # ``models``, the catalog entries size the fleet (each entry's
+        # own ``replicas``), a ``warm_pool`` of undedicated pre-warmed
+        # replicas caps cold-start TTFT, and every replica count lives
+        # under ONE fleet-wide ``model_budget`` the trader reallocates
+        # within.  ``replicas`` (the single-model knob) is ignored,
+        # and the disaggregated role split is per-model routing only —
+        # launching per-model role tiers is a later PR.
+        self.catalog: Optional[ModelCatalog] = None
+        self.warm_pool = int(warm_pool)
+        self.trader_config = trader_config
+        self.trader: Optional[ModelTrader] = None
+        self.replica_budget: Optional[int] = None
+        if self.warm_pool < 0:
+            raise ValueError(f"warm_pool must be >= 0, got {warm_pool}")
+        if models:
+            if prefill_replicas or decode_replicas:
+                raise ValueError(
+                    "a model catalog runs unified tiers; drop "
+                    "prefill_replicas/decode_replicas")
+            self.catalog = ModelCatalog(models)
+            boot = sum(s.replicas for s in self.catalog)
+            if boot + self.warm_pool < 1:
+                raise ValueError(
+                    "the catalog fleet needs at least one replica: "
+                    "every entry boots 0 and warm_pool is 0")
+            self.replica_budget = int(model_budget) \
+                if model_budget is not None else boot + self.warm_pool
+            if self.replica_budget < max(1, boot + self.warm_pool):
+                raise ValueError(
+                    f"model_budget ({self.replica_budget}) is below "
+                    f"the boot footprint ({boot} model replicas + "
+                    f"{self.warm_pool} warm pool)")
+            replicas = 0
+        elif self.warm_pool or model_budget is not None:
+            raise ValueError("warm_pool/model_budget need a model "
+                             "catalog (models=[...])")
+        if self.catalog is None \
+                and replicas + prefill_replicas + decode_replicas < 1:
             raise ValueError(
                 f"the fleet needs at least one replica, got "
                 f"replicas={replicas} + prefill_replicas="
@@ -179,7 +228,13 @@ class FleetServer:
             else:
                 self._tier_max[role] = max(2 * n, n + 1) if autoscale \
                     else n
-        self.max_replicas = max(self._tier_max.values())
+        if self.catalog is not None:
+            # Per-(model, tier) bounds are the trader's business: each
+            # key may range [0, budget] — floors and scale-to-zero live
+            # in the catalog entries, the ceiling is the shared budget.
+            self.max_replicas = self.replica_budget
+        else:
+            self.max_replicas = max(self._tier_max.values())
         if self.max_replicas < self.min_replicas:
             raise ValueError(
                 f"max_replicas ({self.max_replicas}) must be >= "
@@ -304,12 +359,19 @@ class FleetServer:
         #: rollouts are mutually exclusive (a rollout must not race the
         #: loop retargeting the tier it is replacing).
         self.scale_lock = threading.RLock()
+        #: node id -> target key ("role", or "model/role" / POOL_KEY in
+        #: catalog mode): how per-(model, tier) actuals are counted
+        #: when every model's tasks share one scheduler job.  Updated
+        #: at launch and on warm-pool adoption.
+        self._node_keys: Dict[str, str] = {}
         self._started = False
 
     # -- bring-up ----------------------------------------------------------
 
     def _replica_cmd(self, role: str = UNIFIED,
-                     weights_version: Optional[str] = None) -> str:
+                     weights_version: Optional[str] = None,
+                     model: Optional[ModelSpec] = None,
+                     pool: bool = False) -> str:
         version = self.weights_version if weights_version is None \
             else weights_version
         parts = [sys.executable, "-m", "tfmesos_tpu.fleet.replica",
@@ -317,6 +379,13 @@ class FleetServer:
                  "--rows", str(self.rows),
                  "--seed", str(self.seed),
                  "--heartbeat-interval", str(self.heartbeat_interval)]
+        if model is not None:
+            # model_id is validated at catalog construction — the same
+            # shell=True boundary as weights_version.
+            parts += ["--model-id", model.model_id,
+                      "--model-seed", str(model.seed)]
+        if pool:
+            parts += ["--warm-pool"]
         if role != UNIFIED:
             parts += ["--role", role]
         if version:
@@ -416,17 +485,44 @@ class FleetServer:
                 quiet=self.quiet, start_timeout=self.start_timeout,
                 token=self.token)
             self.scheduler.start()
-            for role, n in ((UNIFIED, self.replicas),
-                            (PREFILL, self.prefill_replicas),
-                            (DECODE, self.decode_replicas)):
-                if n:
-                    self.set_target(role, n)
-                    for _ in range(n):
-                        self.launch_replica(role)
+            if self.catalog is not None:
+                # Per-(model, tier) targets + the warm pool, all under
+                # one budget.  Entries booting 0 replicas start scaled
+                # to zero and cold-start through the pool on demand.
+                for spec in self.catalog:
+                    key = model_key(spec.model_id)
+                    self.set_target(key, spec.replicas)
+                    for _ in range(spec.replicas):
+                        self.launch_replica(key)
+                if self.warm_pool:
+                    self.set_target(POOL_KEY, self.warm_pool)
+                    for _ in range(self.warm_pool):
+                        self.launch_replica(POOL_KEY)
+            else:
+                for role, n in ((UNIFIED, self.replicas),
+                                (PREFILL, self.prefill_replicas),
+                                (DECODE, self.decode_replicas)):
+                    if n:
+                        self.set_target(role, n)
+                        for _ in range(n):
+                            self.launch_replica(role)
             self._wait_replicas()
             for gw in self.gateways:
                 gw.rollout_fn = self.rollout
-            if self.autoscale:
+                gw.catalog = self.catalog
+                if self.catalog is not None:
+                    gw.swap_adapter_fn = self._swap_adapter_packed
+            if self.catalog is not None:
+                # The trader IS the catalog fleet's control loop: it
+                # reallocates the budget between models, scales idle
+                # ones to zero, and answers the router's cold-start
+                # demands from the warm pool.
+                self.trader = ModelTrader(
+                    self, self.catalog, self.autoscale_config,
+                    trader_config=self.trader_config).start()
+                self.autoscaler = self.trader
+                self.router.on_model_demand = self.trader.demand
+            elif self.autoscale:
                 self.autoscaler = FleetAutoscaler(
                     self, self.autoscale_config).start()
         except Exception:
@@ -457,26 +553,46 @@ class FleetServer:
         self.targets[role] = int(n)
         self.registry.set_target(role, int(n))
 
-    def bounds(self, role: str) -> Tuple[int, int]:
+    def bounds(self, key: str) -> Tuple[int, int]:
         """The autoscale bounds this tier's target must stay within
-        (the floor is fleet-wide, the ceiling per tier)."""
-        return self.min_replicas, self._tier_max.get(role,
+        (the floor is fleet-wide, the ceiling per tier).  Composite
+        per-(model, tier) keys range [0, budget] — their floors and
+        scale-to-zero policy live in the catalog entries the trader
+        enforces."""
+        model, _ = split_key(key)
+        if model is not None:
+            return 0, self.replica_budget or self.max_replicas
+        return self.min_replicas, self._tier_max.get(key,
                                                      self.max_replicas)
 
-    def launch_replica(self, role: str,
+    def launch_replica(self, key: str,
                        weights_version: Optional[str] = None) -> str:
-        """Launch ONE new Mode-B replica task for ``role`` and return
-        its node id ("job:index") — with ``--warmup`` on the cmd line it
-        registers ``warming`` and never takes traffic cold."""
+        """Launch ONE new Mode-B replica task for ``key`` — a plain
+        role, a composite ``"<model>/<role>"``, or the warm pool's
+        :data:`POOL_KEY` — and return its node id ("job:index"); with
+        ``--warmup`` on the cmd line it registers ``warming`` and
+        never takes traffic cold."""
+        model, role = split_key(key)
+        spec = None
+        pool = model == POOL
+        if model is not None and not pool:
+            spec = self.catalog.get(model)
         job = TIER_JOBS[role]
         task = self.scheduler.add_task(
-            job, cmd=self._replica_cmd(role, weights_version),
+            job, cmd=self._replica_cmd(role, weights_version,
+                                       model=spec, pool=pool),
             cpus=self.replica_cpus, mem=self.replica_mem,
             chips=self.replica_chips)
-        return f"{job}:{task.task_index}"
+        node = f"{job}:{task.task_index}"
+        self._node_keys[node] = key
+        return node
 
     def kill_replica(self, node: str) -> bool:
         """Kill one replica task by its node id ("job:index")."""
+        # The node->key book entry dies with the task either way — a
+        # churning trader (trade = kill + relaunch per cooldown) must
+        # not grow the book, and tier_actual scans it per tick.
+        self._node_keys.pop(node, None)
         job, _, idx = node.rpartition(":")
         try:
             task = self.scheduler.task_by_index(job, int(idx))
@@ -486,17 +602,138 @@ class FleetServer:
             return False
         return self.scheduler.remove_task(task.id)
 
-    def tier_actual(self, role: str) -> int:
+    def tier_actual(self, key: str) -> int:
         """Live tasks launched for one tier (registered or not) — the
-        convergence loops' notion of "actual"."""
-        return len(self.scheduler.tasks_of(TIER_JOBS[role]))
+        convergence loops' notion of "actual".  Composite keys count
+        through the node->key map intersected with the scheduler's
+        live task table (all models share one job)."""
+        model, role = split_key(key)
+        job = TIER_JOBS[role]
+        if model is None:
+            return len(self.scheduler.tasks_of(job))
+        live = {f"{job}:{t.task_index}"
+                for t in self.scheduler.tasks_of(job)}
+        return sum(1 for node, k in self._node_keys.items()
+                   if k == key and node in live)
 
-    def _alive_of(self, role: str,
+    def tier_members(self, key: str):
+        """Registry members of one target key (the trader's
+        membership query): role-filtered by the registry, model/pool-
+        filtered here."""
+        model, role = split_key(key)
+        return filter_members(self.registry.members(role), key)
+
+    def adopt_replica(self, addr: str, model_id: str,
+                      timeout: float = 60.0) -> bool:
+        """Assign a warm-pool replica a catalog model via the
+        ``adopt`` control op (a weight install on a pre-warmed
+        process — the cold-start path that skips launch + compile).
+        Updates the node->key book immediately so the trader's actuals
+        follow without waiting a heartbeat."""
+        spec = self.catalog.get(model_id)
+        try:
+            reply = self.router.control(
+                addr, {"op": "adopt", "model_id": spec.model_id,
+                       "seed": spec.seed}, timeout=timeout)
+        except Exception as e:
+            self.log.warning("adoption of %s for model %s failed: %s",
+                             addr, model_id, e)
+            return False
+        if not isinstance(reply, dict) or reply.get("op") != "adopted":
+            self.log.warning("adoption of %s for model %s rejected: %r",
+                             addr, model_id, reply)
+            return False
+        node = next((r.node for r in self.registry.members()
+                     if r.addr == addr and r.node), None)
+        if node is not None:
+            self._node_keys[node] = model_key(model_id)
+        return True
+
+    def swap_adapter(self, model_id: str, adapter_version: str,
+                     delta=None, packed: Optional[Tuple[dict, bytes]]
+                     = None, timeout: float = 120.0) -> dict:
+        """Hot-swap a LoRA-style weight delta onto EVERY alive replica
+        of one model: the delta ships as ONE raw HMAC frame per
+        replica (``swap_adapter`` op), each batcher folds it behind
+        its weight-update fence (in-flight requests finish on the old
+        delta; zero downtime), and the call returns once every replica
+        acked.  ``delta`` is a param-path -> array dict (packed here);
+        ``packed`` supplies pre-encoded ``(meta, body)`` instead (the
+        gateway op's path — no numpy on the gateway).  Raises on an
+        unknown model, a replica rejection, or a partial failure —
+        a fleet serving two delta versions of one model would break
+        the token-identical-streams contract, so partial application
+        is an ERROR, not a success."""
+        if self.catalog is None:
+            raise RuntimeError("swap_adapter needs a model catalog")
+        spec = self.catalog.get(model_id)     # KeyError on unknown
+        adapter_version = validate_model_id(adapter_version)
+        if packed is None:
+            if delta is None:
+                raise ValueError("swap_adapter needs delta or packed")
+            packed = pack_adapter(delta)
+        meta, body = packed
+        members = self.registry.members(model=spec.model_id)
+        if any(r.state == WARMING for r in members):
+            # A warming replica would turn ALIVE on BASE weights right
+            # after the swap acked — one model serving two weight
+            # states, the exact partial-application state documented
+            # as an error.  Fail up front; the operator retries once
+            # the tier settles.
+            raise RuntimeError(
+                f"model {model_id!r} has replica(s) still warming; "
+                f"they would come up on the old weights — retry the "
+                f"swap once the tier is fully routable")
+        targets = [r for r in members if r.state == ALIVE]
+        if not targets:
+            raise RuntimeError(
+                f"no alive replica serves model {model_id!r} (scaled "
+                f"to zero? the swap applies at the next cold start "
+                f"only if re-issued)")
+        failures = []
+        for r in targets:
+            call = dict(meta)
+            call.update(op="swap_adapter", model_id=spec.model_id,
+                        adapter_version=adapter_version)
+            try:
+                reply = self.router.control_raw(r.addr, call, body,
+                                                timeout=timeout)
+            except Exception as e:
+                failures.append(f"{r.addr}: {e}")
+                continue
+            if not isinstance(reply, dict) \
+                    or reply.get("op") != "adapter_swapped":
+                err = reply.get("error") if isinstance(reply, dict) \
+                    else repr(reply)
+                failures.append(f"{r.addr}: {err}")
+        if failures:
+            raise RuntimeError(
+                f"adapter swap {adapter_version!r} on model "
+                f"{model_id!r} failed on {len(failures)}/"
+                f"{len(targets)} replica(s): {'; '.join(failures)}")
+        self.metrics.inc("adapter_swaps")
+        self.log.info("adapter %s swapped onto %d replica(s) of model "
+                      "%s", adapter_version, len(targets), model_id)
+        return {"model_id": spec.model_id,
+                "adapter_version": adapter_version,
+                "replicas": len(targets)}
+
+    def _alive_of(self, key: str,
                   weights_version: Optional[str] = None) -> int:
-        return sum(1 for r in self.registry.members(role)
+        model, role = split_key(key)
+        members = filter_members(self.registry.members(role), key)
+        return sum(1 for r in members
                    if r.state == ALIVE
                    and (weights_version is None
                         or r.weights_version == weights_version))
+
+    def _swap_adapter_packed(self, model_id: str, adapter_version: str,
+                             meta: dict, body: bytes) -> dict:
+        """The gateway op's entry point: the delta arrived base64 over
+        the public port (which rejects raw frames pre-auth) and ships
+        onward to the replicas as raw HMAC frames."""
+        return self.swap_adapter(model_id, adapter_version,
+                                 packed=(meta, body))
 
     def request_migration(self, addr: str) -> bool:
         """Ask one (already drained) replica to SUSPEND its in-flight
@@ -558,17 +795,17 @@ class FleetServer:
             if all(self._alive_of(role) >= n
                    for role, n in self.targets.items()):
                 return
-            for role, n in self.targets.items():
-                job = TIER_JOBS[role]
+            for key, n in self.targets.items():
+                job = TIER_JOBS[split_key(key)[1]]
                 fails = self.scheduler.dynamic_failures.get(job, 0)
-                if fails >= MAX_FAILURE_COUNT * n:
+                if fails >= MAX_FAILURE_COUNT * max(1, n):
                     raise ClusterError(
                         f"replica job {job!r} failed {fails} times "
                         f"during fleet bring-up")
-                for _ in range(n - self.tier_actual(role)):
+                for _ in range(n - self.tier_actual(key)):
                     self.log.warning("bring-up relaunch of a crashed "
-                                     "%s replica", role)
-                    self.launch_replica(role)
+                                     "%s replica", key)
+                    self.launch_replica(key)
             time.sleep(0.1)
         warming = len(self.registry.warming())
         counts = {role: self._alive_of(role) for role in self.targets}
@@ -670,8 +907,9 @@ class FleetServer:
             # replica that registered during the warm wait (an
             # autoscaler launch racing the scale lock) is old-version
             # fallback traffic too and must flush before the reap.
+            managed_roles = {split_key(k)[1] for k in self.targets}
             old_members = [r for r in self.registry.members()
-                           if (r.role or UNIFIED) in self.targets
+                           if (r.role or UNIFIED) in managed_roles
                            and r.weights_version != version
                            and r.state != DEAD]
             self._drain_and_flush(old_members, drain_timeout)
@@ -680,8 +918,7 @@ class FleetServer:
             # table diff catches launched-but-never-registered ones).
             new_set = {node for _, node in new_nodes}
             reaped = 0
-            for role in self.targets:
-                job = TIER_JOBS[role]
+            for job in {TIER_JOBS[r] for r in managed_roles}:
                 for t in self.scheduler.tasks_of(job):
                     node = f"{job}:{t.task_index}"
                     if node not in new_set:

@@ -31,6 +31,7 @@ alive, exactly like the other optional heartbeat fields.
 from __future__ import annotations
 
 import dataclasses
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -39,7 +40,8 @@ from tfmesos_tpu import wire
 from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["WARMING", "ALIVE", "DRAINING", "DEAD", "UNIFIED", "PREFILL",
-           "DECODE", "ROLES", "ReplicaInfo", "ReplicaRegistry"]
+           "DECODE", "ROLES", "MODEL_ID_RE", "validate_model_id",
+           "ReplicaInfo", "ReplicaRegistry"]
 
 WARMING = "warming"
 ALIVE = "alive"
@@ -51,6 +53,30 @@ UNIFIED = "unified"
 PREFILL = "prefill"
 DECODE = "decode"
 ROLES = (UNIFIED, PREFILL, DECODE)
+
+#: model ids share ``weights_version``'s charset and for the same
+#: reason: the label joins a ``shell=True`` Mode-B replica command
+#: line (``--model-id``) and becomes a Prometheus metric-name
+#: component, so the charset is a SECURITY boundary, not cosmetics.
+#: fullmatch, never match-with-$ ('$' would accept a trailing newline
+#: that shell=True reads as a command terminator).
+MODEL_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+
+
+def validate_model_id(model_id: str) -> str:
+    """The one model-id gate every ingress shares (catalog, CLI,
+    gateway op, replica argv); raises ``ValueError`` with the charset
+    spelled out."""
+    if not isinstance(model_id, str):
+        raise TypeError(f"model_id must be a string, got "
+                        f"{type(model_id).__name__}")
+    if not MODEL_ID_RE.fullmatch(model_id):
+        raise ValueError(
+            f"model_id {model_id!r} is not a valid label: want 1-64 "
+            f"chars of [A-Za-z0-9._-] starting alphanumeric (it joins "
+            f"the replica command line and Prometheus metric names, so "
+            f"the charset is a security boundary)")
+    return model_id
 
 
 @dataclasses.dataclass
@@ -112,6 +138,17 @@ class ReplicaInfo:
     # replica runs under — how the control plane maps a registry addr
     # back to a killable task.
     node: str = ""
+    # Model catalog (docs/SERVING.md "Model catalog"), all heartbeat
+    # fields: the model this replica serves ("" = model-less — the
+    # single-model fleet of old, or a warm-pool member awaiting
+    # adoption), whether it is an undedicated WARM-POOL member (alive
+    # and pre-warmed but excluded from every router pick until the
+    # trader assigns it a model), and the last adapter delta folded
+    # into its weights ("" = base weights) — a suspended mid-stream
+    # export may only resume under the SAME adapter version.
+    model_id: str = ""
+    warm_pool: bool = False
+    adapter_version: str = ""
 
 
 def _advertises_prefix(rep: "ReplicaInfo") -> int:
@@ -173,6 +210,10 @@ class ReplicaRegistry:
         # router skips its O(replicas) affinity scan entirely while
         # this is zero (the common non-prefix-cache deployment).
         self._prefix_count = 0
+        # Count of warm-pool members: the router's O(1) gate in front
+        # of its pool-exclusion filter (a fleet without a warm pool
+        # must not pay a per-pick scan for it).
+        self._pool_count = 0
         # Generation fence floor: beats stamped with a gen BELOW this
         # are dropped entirely — a straggler of a reaped rollout
         # generation can never re-register and serve stale weights.
@@ -384,6 +425,29 @@ class ReplicaRegistry:
             if msg.get("role") in ROLES and rep.role != msg["role"]:
                 rep.role = msg["role"]
                 self._version += 1
+            # Model-catalog fields.  A malformed model_id costs the
+            # FIELD, not the beat (the PR 4/5 optional-field
+            # convention) — and the charset check is load-bearing: the
+            # value reaches Prometheus metric names and trade logs, so
+            # a replica cannot smuggle an arbitrary string into the
+            # table by heartbeating it.
+            raw_model = msg.get("model_id")
+            if isinstance(raw_model, str) \
+                    and (raw_model == ""
+                         or MODEL_ID_RE.fullmatch(raw_model)) \
+                    and rep.model_id != raw_model:
+                rep.model_id = raw_model
+                self._version += 1      # per-model views change
+            if "warm_pool" in msg:
+                pool = msg.get("warm_pool") is True
+                if rep.warm_pool != pool:
+                    rep.warm_pool = pool
+                    self._pool_count += 1 if pool else -1
+                    self._version += 1
+            raw_av = msg.get("adapter_version")
+            if isinstance(raw_av, str) \
+                    and (raw_av == "" or MODEL_ID_RE.fullmatch(raw_av)):
+                rep.adapter_version = raw_av
             if "kv_headroom" in msg:
                 try:
                     rep.kv_headroom = int(msg["kv_headroom"])
@@ -413,6 +477,8 @@ class ReplicaRegistry:
                     del self._table[addr]
                     self._conns.pop(addr, None)
                     self._prefix_count -= _advertises_prefix(rep)
+                    if rep.warm_pool:
+                        self._pool_count -= 1
                     self._version += 1
                     self.log.info("replica %s evicted (%s, last beat "
                                   "%.1fs ago)", addr, rep.state, age)
@@ -482,13 +548,41 @@ class ReplicaRegistry:
             return [dataclasses.replace(r) for r in self._table.values()
                     if r.state == WARMING]
 
-    def members(self, role: Optional[str] = None) -> List[ReplicaInfo]:
-        """Every table entry (copies), optionally filtered to one tier —
-        the control plane's membership query (any state, unlike
-        ``alive()``)."""
+    def members(self, role: Optional[str] = None,
+                model: Optional[str] = None) -> List[ReplicaInfo]:
+        """Every table entry (copies), optionally filtered to one tier
+        and/or one model — the control plane's membership query (any
+        state, unlike ``alive()``)."""
         with self._lock:
             return [dataclasses.replace(r) for r in self._table.values()
-                    if role is None or (r.role or UNIFIED) == role]
+                    if (role is None or (r.role or UNIFIED) == role)
+                    and (model is None or r.model_id == model)]
+
+    def has_pool(self) -> bool:
+        """Whether ANY table entry is a warm-pool member — the O(1)
+        gate in front of the router's pool-exclusion filter."""
+        return self._pool_count > 0
+
+    def model_summary(self) -> Dict[str, dict]:
+        """Per-model replica counts, aggregate outstanding, and
+        adapter-version distribution — the gateway's ``models`` gauge
+        (docs/SERVING.md "Model catalog").  Warm-pool members land
+        under the ``(pool)`` row; model-less replicas under ``""``
+        only when any exist (a model-less fleet reports one anonymous
+        row, a catalog fleet none)."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for rep in self._table.values():
+                label = "(pool)" if rep.warm_pool else rep.model_id
+                d = out.setdefault(label, {
+                    "alive": 0, "warming": 0, "draining": 0, "dead": 0,
+                    "outstanding": 0, "adapters": {}})
+                d[rep.state] = d.get(rep.state, 0) + 1
+                if rep.state == ALIVE:
+                    d["outstanding"] += rep.outstanding
+                    av = rep.adapter_version or ""
+                    d["adapters"][av] = d["adapters"].get(av, 0) + 1
+        return out
 
     def snapshot(self) -> List[dict]:
         with self._lock:

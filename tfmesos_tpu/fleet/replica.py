@@ -244,9 +244,12 @@ class ReplicaServer:
             return
         # "migrate" is the drain-migration control op (the fleet's
         # control plane asks this replica to suspend its in-flight rows
-        # so the router can re-place them) — authenticated like every
-        # frame, and handler-interpreted like generate/prefill.
-        if op not in ("generate", "prefill", "migrate"):
+        # so the router can re-place them); "adopt" assigns a warm-pool
+        # replica its model, "swap_adapter" ships a weight delta as one
+        # raw frame — authenticated like every frame, and
+        # handler-interpreted like generate/prefill.
+        if op not in ("generate", "prefill", "migrate", "adopt",
+                      "swap_adapter"):
             self._send(conn, send_lock,
                        {"op": "error", "id": mid,
                         "kind": "bad_request",
@@ -395,8 +398,48 @@ def _deadline_ms(head) -> Optional[float]:
     return None
 
 
+def _handle_swap_adapter(batcher, msg, reply: Callable) -> None:
+    """Serve one ``swap_adapter`` raw frame (the adapter hot-swap,
+    docs/SERVING.md "Model catalog"): unpack the HMAC-verified delta,
+    queue the fold behind the batcher's weight-update fence, and reply
+    once it has APPLIED — in-flight requests finish on the old delta
+    first, so the ack means "every stream from here on runs the new
+    version".  Shared by the decode/unified and prefill handlers (a
+    prefill batcher has no serve loop, so its fold applies — and
+    replies — synchronously)."""
+    from tfmesos_tpu.fleet import catalog as catalog_mod
+
+    head = msg.meta if isinstance(msg, wire.RawFrame) else msg
+    mid = head.get("id")
+    if not isinstance(msg, wire.RawFrame):
+        reply({"op": "error", "id": mid, "kind": "bad_request",
+               "error": "swap_adapter ships its delta as a raw frame"})
+        return
+    try:
+        from tfmesos_tpu.fleet.registry import validate_model_id
+        version = validate_model_id(head.get("adapter_version"))
+        delta = catalog_mod.unpack_adapter(head, msg.body)
+    except (TypeError, ValueError) as e:
+        reply({"op": "error", "id": mid, "kind": "bad_request",
+               "error": str(e)})
+        return
+
+    def applied() -> None:
+        reply({"op": "adapter_swapped", "id": mid,
+               "adapter_version": version,
+               "swaps": batcher.weight_swaps})
+
+    try:
+        batcher.swap_adapter(delta, version, on_applied=applied)
+    except ValueError as e:
+        reply({"op": "error", "id": mid, "kind": "bad_request",
+               "error": str(e)})
+
+
 def batcher_handler(serving: BatcherServing, generation: int = 0,
-                    weights_version: str = "") -> Callable:
+                    weights_version: str = "",
+                    model_state: Optional[Dict[str, Any]] = None,
+                    adopt_fn: Optional[Callable] = None) -> Callable:
     """The model-backed ``ReplicaServer`` handler (decode/unified
     roles): validate, submit, stream the completion back when the
     batcher finishes it.  A plain ``generate`` dict takes the local
@@ -432,11 +475,41 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
             batcher.preempt_all()
             reply({"op": "migrated", "id": mid})
             return
+        if head.get("op") == "swap_adapter":
+            _handle_swap_adapter(batcher, msg, reply)
+            return
+        if head.get("op") == "adopt":
+            # Warm-pool adoption (docs/SERVING.md "Model catalog"):
+            # install one catalog model's weights on this pre-warmed,
+            # undedicated replica.  The closure comes from main() —
+            # it knows the preset family and updates the heartbeat's
+            # model identity once the install applies.
+            if adopt_fn is None:
+                reply({"op": "error", "id": mid, "kind": "bad_request",
+                       "error": "this replica has no model-adoption "
+                                "surface (started without a warm-pool "
+                                "role)"})
+            else:
+                adopt_fn(head, reply)
+            return
         if head.get("op") == "prefill":
             reply({"op": "error", "id": mid, "kind": "bad_request",
                    "error": "this replica does not serve the prefill "
                             "op (role: decode/unified); route prefill "
                             "to a prefill-role replica"})
+            return
+        want_model = head.get("model")
+        if isinstance(want_model, str) and want_model \
+                and model_state is not None \
+                and model_state.get("model_id") != want_model:
+            # A pick racing a warm-pool adoption (or a stale routing
+            # view): answering with THIS replica's weights would be
+            # silently wrong.  Transient (not bad_request) — the
+            # router retries another replica of the right model.
+            reply({"op": "error", "id": mid, "kind": "wrong_model",
+                   "error": f"this replica serves model "
+                            f"{model_state.get('model_id') or '(none)'!r}"
+                            f", not {want_model!r}"})
             return
         tr = _hop_trace(head)
         if tr is not None:
@@ -506,16 +579,25 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                     failed=True))
                 return
             if isinstance(comp, Suspended):
+                # Model-catalog identity on the export: the router may
+                # only resume this mid-stream KV on a replica serving
+                # the SAME model and adapter delta.
+                model_id = (model_state or {}).get("model_id") or ""
+                adapter = getattr(batcher, "adapter_version", "")
                 if comp.artifact is None:
-                    reply(_attach_trace(
-                        {"op": "suspended", "id": mid, "requeue": True,
-                         "gen": generation,
-                         "weights_version": weights_version}, tr,
-                        failed=True))
+                    out = {"op": "suspended", "id": mid, "requeue": True,
+                           "gen": generation,
+                           "weights_version": weights_version}
+                    if model_id:
+                        out["model_id"] = model_id
+                    reply(_attach_trace(out, tr, failed=True))
                     return
                 meta, body = serving_mod.pack_prefilled(comp.artifact)
                 meta.update(op="suspended", id=mid, gen=generation,
-                            weights_version=weights_version)
+                            weights_version=weights_version,
+                            adapter_version=adapter)
+                if model_id:
+                    meta["model_id"] = model_id
                 # A migration hop's spans always piggyback (failed=True
                 # here just means "always export"): the router stitches
                 # the victim's suspend into the one waterfall.
@@ -604,6 +686,13 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
             # resident rows to suspend; ack so a tier-blind drain can
             # migrate every member the same way.
             reply({"op": "migrated", "id": mid})
+            return
+        if head.get("op") == "swap_adapter":
+            # Prefill replicas compute KV with the weights too: an
+            # adapter swap must land tier-wide.  No serve loop here,
+            # so the fold applies synchronously under the export lock
+            # (exports queue behind it).
+            _handle_swap_adapter(batcher, msg, reply)
             return
         if raw or head.get("op") != "prefill":
             reply({"op": "error", "id": mid, "kind": "bad_request",
@@ -781,6 +870,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve the tiny CI model instead of the flagship")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--heartbeat-interval", type=float, default=0.3)
+    p.add_argument("--model-id", type=str, default="",
+                   dest="model_id",
+                   help="model-catalog identity this replica serves "
+                        "(rides every heartbeat — the router's "
+                        "per-model tier keys off it); charset-"
+                        "validated like --weights-version "
+                        "(docs/SERVING.md 'Model catalog')")
+    p.add_argument("--model-seed", type=int, default=None,
+                   dest="model_seed",
+                   help="weight seed of the catalog model (default: "
+                        "--seed); two catalog entries with different "
+                        "seeds ARE different models")
+    p.add_argument("--warm-pool", action="store_true",
+                   dest="warm_pool",
+                   help="register as an UNDEDICATED warm-pool member: "
+                        "pre-warmed and alive but excluded from every "
+                        "router pick until the fleet's model trader "
+                        "assigns a model via the 'adopt' control op "
+                        "(a weight install — no relaunch, no "
+                        "recompile)")
     p.add_argument("--weights-version", type=str, default="",
                    dest="weights_version",
                    help="weights version label this replica serves; "
@@ -811,10 +920,30 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from tfmesos_tpu.serving import ContinuousBatcher
 
+    # Model-catalog identity: --model-id names the catalog entry this
+    # replica serves (seeded by --model-seed), --warm-pool starts it
+    # UNDEDICATED (default weights, adopted later).  The id is
+    # charset-validated here too — every ingress is a boundary, and
+    # argv arrived through a shell=True command line.
+    if args.model_id:
+        from tfmesos_tpu.fleet.registry import validate_model_id
+
+        try:
+            args.model_id = validate_model_id(args.model_id)
+        except ValueError as e:
+            print(f"replica: {e}", file=sys.stderr)
+            return 2
+    model_state: Dict[str, Any] = {
+        "model_id": args.model_id or "",
+        "warm_pool": bool(args.warm_pool),
+        "pool_capable": bool(args.warm_pool),
+    }
+    build_seed = args.model_seed if args.model_seed is not None \
+        else args.seed
     if args.tiny:
-        cfg, params = tiny_model(args.seed)
+        cfg, params = tiny_model(build_seed)
     else:
-        cfg, params = flagship_model(args.seed,
+        cfg, params = flagship_model(build_seed,
                                      max_len=args.max_len or 1024)
     draft_cfg = draft_params = None
     if args.draft:
@@ -833,10 +962,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         # The store is stamped with this replica's rollout identity:
         # a parked artifact from another weights_version (a pre-rollout
         # entry in a shared disk dir) reads as a miss, never stale KV.
+        # The MODEL composes into the stamp — two models' replicas may
+        # share one host disk tier, and a session parked by model A
+        # must read as a version miss to model B, never as its KV.
+        wv_stamp = args.weights_version
+        if args.model_id:
+            wv_stamp = f"{args.weights_version or 'v0'}@{args.model_id}"
         kv_tier = KVTierStore(
             ram_bytes=int(max(0.0, args.kv_tier_mb) * 1e6),
             disk_dir=args.kv_tier_dir, token=token,
-            stamp={"weights_version": args.weights_version,
+            stamp={"weights_version": wv_stamp,
                    "gen": generation})
     batcher = ContinuousBatcher(
         cfg, params, rows=args.rows, max_len=args.max_len,
@@ -846,6 +981,56 @@ def main(argv: Optional[List[str]] = None) -> int:
         pipeline_depth=args.pipeline_depth, kv_tier=kv_tier,
         draft_cfg=draft_cfg, draft_params=draft_params,
         n_draft=args.n_draft)
+    def adopt_fn(head, reply) -> None:
+        """The ``adopt`` control op: install one catalog model's
+        weights on this (pre-warmed, undedicated) replica.  Same
+        preset family and max_len as the boot build, so shapes are
+        identical and nothing recompiles — the whole point of the
+        warm pool."""
+        from tfmesos_tpu.fleet.registry import validate_model_id
+
+        mid = head.get("id")
+        try:
+            model_id = validate_model_id(head.get("model_id"))
+            seed = int(head.get("seed") or 0)
+        except (TypeError, ValueError) as e:
+            reply({"op": "error", "id": mid, "kind": "bad_request",
+                   "error": str(e)})
+            return
+        # Adoption is a WARM-POOL-ONLY transition: a replica already
+        # serving (or mid-install for) a model refuses — the trader's
+        # pool view is heartbeat-lagged, so two rapid cold starts
+        # could otherwise hand one pool member to BOTH models, and
+        # reassigning a dedicated replica would serve wrong_model
+        # errors until the identity flip rides a beat.  The refusal
+        # makes the trader fall through to the next candidate (or a
+        # cold launch).
+        if model_state["model_id"] or model_state.get("adopting"):
+            reply({"op": "error", "id": mid, "kind": "bad_request",
+                   "error": f"already serving model "
+                            f"{model_state['model_id'] or '(adopting)'!r}"
+                            f"; adoption is a warm-pool-only "
+                            f"transition"})
+            return
+        model_state["adopting"] = True
+        if args.tiny:
+            _, new_params = tiny_model(seed)
+        else:
+            _, new_params = flagship_model(seed,
+                                           max_len=args.max_len or 1024)
+
+        def applied() -> None:
+            model_state["model_id"] = model_id
+            model_state["warm_pool"] = False
+            model_state["adopting"] = False
+            log.info("adopted model %s (seed %d)", model_id, seed)
+            reply({"op": "adopted", "id": mid, "model_id": model_id})
+
+        batcher.set_weights(
+            new_params,
+            version=f"{args.weights_version or 'v0'}@{model_id}",
+            on_applied=applied)
+
     serving = None
     if args.role == "prefill":
         # Prefill-role replicas never decode: no serve loop runs, the
@@ -856,7 +1041,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the rows; submissions made while warming just queue.
         serving = BatcherServing(batcher)
         handler = batcher_handler(serving, generation=generation,
-                                  weights_version=args.weights_version)
+                                  weights_version=args.weights_version,
+                                  model_state=model_state,
+                                  adopt_fn=adopt_fn)
 
     def extra() -> Dict[str, Any]:
         # Heartbeat advert: the tier this replica belongs to and its
@@ -870,6 +1057,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             beat["weights_version"] = args.weights_version
         if node:
             beat["node"] = node
+        # Model-catalog identity: the served model (set at launch, or
+        # by a later adoption), warm-pool membership (always sent once
+        # pool-capable, so an adoption's False overwrites the table's
+        # True), and the last adapter delta folded in.
+        if model_state["model_id"]:
+            beat["model_id"] = model_state["model_id"]
+        if model_state["pool_capable"]:
+            beat["warm_pool"] = bool(model_state["warm_pool"])
+        # Sent even when "" — a fold followed by a full weight swap
+        # resets it, and the table must follow, not keep the old label.
+        beat["adapter_version"] = getattr(batcher, "adapter_version",
+                                          "")
         if batcher.prefix_cache_active:
             beat["prefix_cache"] = batcher.prefix_cache_summary()
         if batcher.kv_tier is not None \

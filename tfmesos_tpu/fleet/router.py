@@ -180,6 +180,18 @@ class Router:
         # if the new tier empties), so the shift itself can never cause
         # an outage.  One attribute write = the atomic shift.
         self._preferred_version: Optional[str] = None
+        # Model catalog (docs/SERVING.md "Model catalog"): requests
+        # carrying a model ride a per-MODEL tier above the role tiers —
+        # every candidate set narrows to replicas advertising that
+        # model_id (never a fallback: serving model A's request with
+        # model B's weights would be silently wrong).  When a model has
+        # NO routable replica (scaled to zero), the hook below asks the
+        # control plane to cold-start it (warm-pool adoption or a
+        # launch) and the request WAITS — bounded by its deadline and
+        # ``model_wait_s`` — instead of failing, so scale-to-zero is an
+        # economy measure, not an availability hole.
+        self.on_model_demand = None
+        self.model_wait_s = 30.0
 
     # -- load signal -------------------------------------------------------
 
@@ -270,12 +282,18 @@ class Router:
         self._preferred_version = version
         self.log.info("router weights_version preference -> %r", version)
 
-    def _alive_by_role(self, roles, exclude=()) -> List[ReplicaInfo]:
-        """Alive candidates of the given tiers, version-preference
-        applied on top: with a preferred weights_version set, replicas
-        advertising it crowd out every other version whenever at least
-        one is routable; otherwise (new tier empty or draining away)
-        the full candidate set remains the fallback.
+    def _alive_by_role(self, roles, exclude=(),
+                       model: Optional[str] = None) -> List[ReplicaInfo]:
+        """Alive candidates of the given tiers, the model tier and
+        version-preference applied on top: a ``model``-carrying
+        request narrows to replicas advertising exactly that
+        ``model_id`` (no fallback — wrong weights are worse than
+        unavailable); model-less requests exclude warm-pool members
+        (undedicated replicas must never take traffic).  With a
+        preferred weights_version set, replicas advertising it crowd
+        out every other version whenever at least one is routable;
+        otherwise (new tier empty or draining away) the full candidate
+        set remains the fallback.
 
         The no-exclusions common case reads the registry's CACHED
         per-tier view (``alive_view`` — O(1) amortized, the change
@@ -293,6 +311,17 @@ class Router:
             cands = [r for r in self.registry.alive()
                      if r.addr not in exclude
                      and (r.role or UNIFIED) in roles]
+        if model:
+            cands = [r for r in cands
+                     if getattr(r, "model_id", "") == model]
+        else:
+            # Warm-pool members are invisible to model-less picks too;
+            # the registry's O(1) count gates the scan so fleets
+            # without a pool (every deployment of old) pay nothing.
+            has_pool = getattr(self.registry, "has_pool", None)
+            if has_pool is not None and has_pool():
+                cands = [r for r in cands
+                         if not getattr(r, "warm_pool", False)]
         pref = self._preferred_version
         if pref:
             preferred = [r for r in cands if r.weights_version == pref]
@@ -440,10 +469,16 @@ class Router:
         tr = tracing.current()
         if deadline is None and tr is None \
                 and "deadline" not in msg and "_trace" not in msg \
-                and "_emit" not in msg:
+                and "_emit" not in msg and "_model" not in msg:
             return msg
         out = {k: v for k, v in msg.items()
-               if k not in ("deadline", "_trace", "_emit")}
+               if k not in ("deadline", "_trace", "_emit", "_model")}
+        if "_model" in msg:
+            # The resolved model id DOES cross the wire (as ``model``):
+            # the replica cross-checks it against the model it serves,
+            # so a pick racing a warm-pool adoption can never silently
+            # answer with another model's weights.
+            out["model"] = msg["_model"]
         if deadline is not None:
             out["deadline_ms"] = round(
                 max(1.0, (deadline - self._clock()) * 1000.0), 3)
@@ -490,14 +525,16 @@ class Router:
         return a if self.outstanding(a) <= self.outstanding(b) else b
 
     def _pick_role(self, roles, exclude, prompt,
-                   session: Optional[str] = None) -> Optional[str]:
+                   session: Optional[str] = None,
+                   model: Optional[str] = None) -> Optional[str]:
         """One choice policy for both prompt-bearing tiers:
         session-affinity first (the replica holding the conversation's
         parked KV), then prefix-affinity when ``prompt`` is given and
         some candidate advertises a matching cache summary, else
         least-outstanding p2c; ``None`` when no eligible replica
-        exists."""
-        cands = self._alive_by_role(roles, exclude)
+        exists.  ``model`` nests the model tier ABOVE everything:
+        affinity, p2c, and version preference all operate inside it."""
+        cands = self._alive_by_role(roles, exclude, model=model)
         if not cands:
             return None
         if session:
@@ -524,27 +561,32 @@ class Router:
         return self._load_pick(cands)
 
     def pick(self, exclude: Iterable[str] = (),
-             prompt=None, session: Optional[str] = None
-             ) -> Optional[str]:
+             prompt=None, session: Optional[str] = None,
+             model: Optional[str] = None) -> Optional[str]:
         """The UNIFIED-path choice over alive unified replicas not in
         ``exclude``.  Prefill-role replicas never appear here (they
         refuse generate); decode-role replicas are reserved for
         imported prefills, so the role split cannot silently turn a
         decode tier back into a unified one.  ``session`` steers a
         multi-turn conversation at the replica advertising its parked
-        KV (session affinity)."""
-        return self._pick_role((UNIFIED,), exclude, prompt, session)
+        KV (session affinity); ``model`` narrows to that model's
+        replicas (the model tier)."""
+        return self._pick_role((UNIFIED,), exclude, prompt, session,
+                               model)
 
     def pick_prefill(self, exclude: Iterable[str] = (),
-                     prompt=None) -> Optional[str]:
+                     prompt=None,
+                     model: Optional[str] = None) -> Optional[str]:
         """The prefill-tier choice: prefix-affinity first (a prompt
         whose leading chunks are resident on some prefill replica
         prefills only its tail there), then least-outstanding p2c —
         the load signal is what spreads long prompts."""
-        return self._pick_role((PREFILL,), exclude, prompt)
+        return self._pick_role((PREFILL,), exclude, prompt,
+                               model=model)
 
     def pick_decode(self, exclude: Iterable[str] = (),
-                    weights_version: Optional[str] = None
+                    weights_version: Optional[str] = None,
+                    model: Optional[str] = None
                     ) -> Optional[str]:
         """The decode-tier choice: p2c by advertised KV-page headroom
         (the imported pages must FIT — load alone would happily pick a
@@ -554,7 +596,7 @@ class Router:
         the tier to replicas serving those exact weights — a suspended
         mid-stream artifact must never resume under different weights
         (same rule as :meth:`_pick_resume`)."""
-        cands = self._alive_by_role((DECODE,), exclude)
+        cands = self._alive_by_role((DECODE,), exclude, model=model)
         if weights_version:
             cands = [r for r in cands
                      if r.weights_version == weights_version]
@@ -581,6 +623,13 @@ class Router:
         pick, no retry: control targets a SPECIFIC replica by
         design."""
         return self._link(addr).call(msg, timeout=timeout)
+
+    def control_raw(self, addr: str, meta: Dict[str, Any], body,
+                    timeout: float = 30.0) -> Any:
+        """One RAW-frame control call straight to a known replica (the
+        adapter hot-swap's delta ships this way — HMAC-tagged bytes on
+        the existing mux link, never re-encoded)."""
+        return self._link(addr).call_raw(meta, body, timeout=timeout)
 
     def _link(self, addr: str) -> MuxConnection:
         with self._lock:
@@ -708,17 +757,24 @@ class Router:
             return reply, None
         return None
 
-    def _pick_resume(self, tried, weights_version) -> Optional[str]:
+    def _pick_resume(self, tried, weights_version,
+                     model: Optional[str] = None,
+                     adapter: Optional[str] = None) -> Optional[str]:
         """A unified-tier replica a suspended artifact may RESUME on:
-        same advertised weights_version (KV pages computed under one
-        set of weights must never feed a decode under another — resume
-        onto a mismatch would be a silently wrong stream), not already
-        tried.  ``None`` = no eligible target; the caller re-runs the
-        request instead."""
-        cands = self._alive_by_role((UNIFIED,), exclude=tried)
+        same advertised weights_version — and, when the export stamped
+        them, same model_id and adapter_version — because KV pages
+        computed under one set of weights must never feed a decode
+        under another (resume onto a mismatch would be a silently
+        wrong stream), not already tried.  ``None`` = no eligible
+        target; the caller re-runs the request instead."""
+        cands = self._alive_by_role((UNIFIED,), exclude=tried,
+                                    model=model)
         if weights_version:
             cands = [r for r in cands
                      if r.weights_version == weights_version]
+        if adapter is not None:
+            cands = [r for r in cands
+                     if getattr(r, "adapter_version", "") == adapter]
         return self._load_pick(cands)
 
     def _resume_elsewhere(self, msg: Dict[str, Any], meta: dict,
@@ -742,6 +798,12 @@ class Router:
             return None
         wv = meta.get("weights_version")
         wv = wv if isinstance(wv, str) and wv else ""
+        art_model = meta.get("model_id")
+        art_model = art_model if isinstance(art_model, str) \
+            and art_model else None
+        art_adapter = meta.get("adapter_version")
+        art_adapter = art_adapter if isinstance(art_adapter, str) \
+            else None
         deadline = self._deadline_of(msg)
 
         emit = msg.get("_emit")
@@ -767,7 +829,8 @@ class Router:
             if deadline is not None and self._clock() >= deadline:
                 return self._expired_reply("while resuming its "
                                            "migrated state")
-            addr = self._pick_resume(tried, wv)
+            addr = self._pick_resume(tried, wv, model=art_model,
+                                     adapter=art_adapter)
             if addr is None:
                 break
             rprobe = self._breaker_dispatch(addr)
@@ -890,6 +953,9 @@ class Router:
         prompt = msg.get("prompt") if isinstance(msg, dict) else None
         session = msg.get("session") if isinstance(msg, dict) else None
         session = session if isinstance(session, str) and session else None
+        model = msg.get("_model") if isinstance(msg, dict) else None
+        model = model if isinstance(model, str) and model else None
+        demanded = False
         # Streaming: the gateway's partial-frame emitter rides the
         # forward as the internal `_emit` (stripped by _wire_msg); each
         # attempt's partial token frames pass straight through to it,
@@ -908,7 +974,18 @@ class Router:
                 return self._expired_reply("before a replica could "
                                            "serve it")
             addr = self.pick(exclude=tried, prompt=prompt,
-                             session=session)
+                             session=session, model=model)
+            if addr is None and model is not None and not demanded \
+                    and not tried and self.on_model_demand is not None:
+                # Scale-to-zero cold start: no replica serves this
+                # model RIGHT NOW.  Ask the control plane to assign
+                # one (warm-pool adoption, or a launch) and WAIT for
+                # it to become routable — bounded by the request's own
+                # deadline and model_wait_s, so a model the trader
+                # cannot place still fails explicitly, never hangs.
+                demanded = True
+                addr = self._await_model(model, deadline, prompt,
+                                         session)
             if addr is None:
                 break       # nothing (left) to try
             probe = self._breaker_dispatch(addr)
@@ -1012,7 +1089,36 @@ class Router:
             raise RoutingError(
                 f"no replica could serve the request after trying "
                 f"{sorted(tried)}: {last}") from last
-        raise RoutingError("no alive replicas")
+        raise RoutingError(
+            f"no alive replicas serving model {model!r}" if model
+            else "no alive replicas")
+
+    def _await_model(self, model: str, deadline: Optional[float],
+                     prompt, session) -> Optional[str]:
+        """Fire the cold-start demand hook once and poll for a
+        routable replica of ``model``.  Returns the first pick, or
+        ``None`` when the wait budget (the request deadline, capped at
+        ``model_wait_s``) runs out."""
+        t0 = self._clock()
+        self.metrics.inc("model_cold_waits")
+        tracing.cur_event("router", "model_cold_start", model=model)
+        try:
+            if not self.on_model_demand(model):
+                return None     # unknown model / nothing to free
+        except Exception:
+            self.log.exception("model demand hook failed for %r", model)
+            return None
+        limit = t0 + self.model_wait_s
+        if deadline is not None:
+            limit = min(limit, deadline)
+        while self._clock() < limit:
+            addr = self.pick(prompt=prompt, session=session, model=model)
+            if addr is not None:
+                self.metrics.observe("model_cold_wait_ms",
+                                     (self._clock() - t0) * 1000.0)
+                return addr
+            self._sleep(0.05)
+        return None
 
     # -- the disaggregated prefill -> transfer -> decode path --------------
 
@@ -1029,15 +1135,17 @@ class Router:
         artifact, not the request) falls back too — a healthy unified
         tier must still get its chance."""
         prompt = msg.get("prompt")
+        model = msg.get("_model")
+        model = model if isinstance(model, str) and model else None
         if isinstance(msg.get("session"), str) and msg["session"] \
-                and self._alive_by_role((UNIFIED,)):
+                and self._alive_by_role((UNIFIED,), model=model):
             # Sessions ride the unified tier: their parked KV lives in
             # a unified replica's tier, and the disaggregated handoff
             # has no park/resume surface — only a PURE disagg fleet
             # serves a session-labeled request through it (cold).
             return None, None
         if (prompt is None or not len(prompt)) \
-                and self._alive_by_role((UNIFIED,)):
+                and self._alive_by_role((UNIFIED,), model=model):
             # An invalid prompt gets its bad_request from a unified
             # replica's own validation when one exists; in a PURE
             # disagg fleet the request stays on this path so the
@@ -1049,8 +1157,8 @@ class Router:
         # waste on the way to the unified fallback.  An all-unified
         # fleet (neither tier exists) is not a "fallback" — it is the
         # normal path; a LONE tier is one, and counts.
-        has_prefill = bool(self._alive_by_role((PREFILL,)))
-        has_decode = bool(self._alive_by_role((DECODE,)))
+        has_prefill = bool(self._alive_by_role((PREFILL,), model=model))
+        has_decode = bool(self._alive_by_role((DECODE,), model=model))
         if not (has_prefill and has_decode):
             if has_prefill or has_decode:
                 self.metrics.inc("disagg_fallback")
@@ -1063,7 +1171,8 @@ class Router:
             if deadline is not None and self._clock() >= deadline:
                 return self._expired_reply("before prefill could "
                                            "run"), None
-            paddr = self.pick_prefill(exclude=ptried, prompt=prompt)
+            paddr = self.pick_prefill(exclude=ptried, prompt=prompt,
+                                      model=model)
             if paddr is None:
                 break               # prefill tier exhausted
             call = {"op": "prefill", "prompt": msg.get("prompt"),
@@ -1180,6 +1289,8 @@ class Router:
             meta["stream"] = True
         emit = msg.get("_emit")
         deadline = self._deadline_of(msg)
+        model = msg.get("_model")
+        model = model if isinstance(model, str) and model else None
         last: Optional[BaseException] = None
         dtried: set = set()
         # A mid-stream artifact adopted from a drained decode replica
@@ -1192,7 +1303,8 @@ class Router:
                 return self._expired_reply("before decode could "
                                            "run"), None
             daddr = self.pick_decode(exclude=dtried,
-                                     weights_version=art_wv)
+                                     weights_version=art_wv,
+                                     model=model)
             if daddr is None:
                 return None, last
             dprobe = self._breaker_dispatch(daddr)

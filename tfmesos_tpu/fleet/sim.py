@@ -69,6 +69,9 @@ from tfmesos_tpu.fleet.admission import (AdmissionController,
                                          DeadlineExceeded, Overloaded,
                                          PriorityClass, RateLimited)
 from tfmesos_tpu.fleet.autoscaler import AutoscalerConfig, FleetAutoscaler
+from tfmesos_tpu.fleet.catalog import (POOL, ModelCatalog, ModelSpec,
+                                       ModelTrader, TraderConfig,
+                                       model_key, split_key)
 from tfmesos_tpu.fleet.client import CallTimeout, ConnectionLost
 from tfmesos_tpu.fleet.containment import BreakerConfig, RetryBudget
 from tfmesos_tpu.fleet.metrics import FleetMetrics
@@ -326,14 +329,15 @@ class SimReplica:
     __slots__ = ("addr", "role", "capacity", "model", "weights_version",
                  "gen", "node", "warm_until", "down", "removed",
                  "migrating", "slow_factor", "error_rate", "sever_next",
-                 "drop_beats", "kv_pages", "served", "_servers",
-                 "_inflight", "_pending")
+                 "drop_beats", "kv_pages", "served", "model_id", "pool",
+                 "_servers", "_inflight", "_pending")
 
     def __init__(self, addr: str, role: str = UNIFIED, capacity: int = 4,
                  model: Optional[ReplicaModel] = None,
                  weights_version: str = "v1", gen: int = 0,
                  node: str = "", warm_until: float = 0.0,
-                 kv_pages: int = 64):
+                 kv_pages: int = 64, model_id: str = "",
+                 pool: bool = False):
         self.addr = addr
         self.role = role
         self.capacity = int(capacity)
@@ -351,6 +355,10 @@ class SimReplica:
         self.drop_beats = False
         self.kv_pages = int(kv_pages)
         self.served = 0
+        # Model catalog: the catalog model this replica serves, and
+        # warm-pool membership (undedicated; adoption flips both).
+        self.model_id = model_id
+        self.pool = bool(pool)
         self._servers = [0.0] * self.capacity     # per-slot free-at
         self._inflight: List[float] = []          # finish times
         self._pending: List[list] = []            # live call records
@@ -643,6 +651,16 @@ class SimConfig:
     decode_replicas: int = 0
     capacity: int = 4
     kv_pages: int = 64
+    # Model catalog (the multi-model scenario): (model_id, boot
+    # replicas) entries, a warm pool of undedicated replicas, the
+    # fleet-wide budget the trader reallocates within (None = boot
+    # footprint), and the trader's knobs — all sweepable
+    # (``catalog.warm_pool``, ``catalog.budget``, ``trader.*``).
+    models: Tuple[Tuple[str, int], ...] = ()
+    warm_pool: int = 0
+    model_budget: Optional[int] = None
+    trader: "TraderConfig" = dataclasses.field(
+        default_factory=lambda: TraderConfig())
     # N stateless gateway "fibers" over the ONE registry/router view —
     # the sim analog of `tfserve --gateways N` (each front door gets
     # its own AdmissionController + dispatch-worker fibers; arrivals
@@ -681,6 +699,7 @@ _OVERRIDE_ROOTS = {
     "breaker": lambda cfg: cfg.breaker,
     "autoscaler": lambda cfg: cfg.autoscaler,
     "model": lambda cfg: cfg.model,
+    "trader": lambda cfg: cfg.trader,
 }
 _OVERRIDE_ALIASES = {
     "admission.max_queue": "max_queue",
@@ -690,6 +709,8 @@ _OVERRIDE_ALIASES = {
     "router.max_retries": "max_retries",
     "router.backoff_s": "backoff_s",
     "router.request_timeout": "request_timeout",
+    "catalog.warm_pool": "warm_pool",
+    "catalog.budget": "model_budget",
 }
 
 
@@ -803,10 +824,11 @@ class FleetSim:
             breaker_config=cfg.breaker, retry_budget=self.budget,
             clock=eng.clock, sleep=eng.sleep,
             link_factory=self.transport.link)
-        # Dynamic-fleet surface for the real autoscaler.
+        # Dynamic-fleet surface for the real autoscaler / trader.
         self.targets: Dict[str, int] = {}
         self.scale_lock = threading.RLock()
         self.autoscaler: Optional[FleetAutoscaler] = None
+        self.replica_budget: Optional[int] = None
         self.trajectory: List[dict] = []
         # Bookkeeping.  ``planned`` is the number of requests the
         # scenario intends to submit — the completion predicate
@@ -846,7 +868,8 @@ class FleetSim:
                     capacity: Optional[int] = None,
                     model: Optional[ReplicaModel] = None,
                     weights_version: Optional[str] = None,
-                    warm_s: float = 0.0) -> SimReplica:
+                    warm_s: float = 0.0, model_id: str = "",
+                    pool: bool = False) -> SimReplica:
         self._next_rid += 1
         i = self._next_rid
         rep = SimReplica(
@@ -855,7 +878,8 @@ class FleetSim:
             model=model or self.cfg.model,
             weights_version=weights_version or self.cfg.weights_version,
             node=f"sim:{i}", kv_pages=self.cfg.kv_pages,
-            warm_until=self.engine.clock.now + warm_s)
+            warm_until=self.engine.clock.now + warm_s,
+            model_id=model_id, pool=pool)
         self.transport.replicas[rep.addr] = rep
         self._beat(rep)
         return rep
@@ -871,6 +895,12 @@ class FleetSim:
                 "outstanding": rep.outstanding(now), "role": rep.role,
                 "node": rep.node,
                 "weights_version": rep.weights_version, "gen": rep.gen}
+            if rep.model_id:
+                msg["model_id"] = rep.model_id
+            if rep.pool or rep.model_id:
+                # Like the real replica: pool-capable processes always
+                # send the flag, so an adoption's False overwrites.
+                msg["warm_pool"] = rep.pool
             if rep.role == DECODE:
                 msg["kv_headroom"] = max(
                     0, rep.kv_pages - rep.outstanding(now))
@@ -901,10 +931,14 @@ class FleetSim:
     def bounds(self, role: str) -> Tuple[int, int]:
         return (self.cfg.min_replicas, self.cfg.max_replicas)
 
-    def launch_replica(self, role: str,
+    def launch_replica(self, key: str,
                        weights_version: Optional[str] = None) -> str:
+        model, role = split_key(key)
         rep = self.add_replica(role=role, warm_s=self.cfg.warmup_s,
-                               weights_version=weights_version)
+                               weights_version=weights_version,
+                               model_id=(model if model not in
+                                         (None, POOL) else ""),
+                               pool=model == POOL)
         return rep.node
 
     def kill_replica(self, node: str) -> bool:
@@ -915,10 +949,44 @@ class FleetSim:
                 return True
         return False
 
-    def tier_actual(self, role: str) -> int:
-        return sum(1 for r in self.transport.replicas.values()
-                   if not r.down and not r.removed
-                   and (r.role or UNIFIED) == role)
+    def tier_actual(self, key: str) -> int:
+        model, role = split_key(key)
+        out = 0
+        for r in self.transport.replicas.values():
+            if r.down or r.removed or (r.role or UNIFIED) != role:
+                continue
+            if model == POOL:
+                out += 1 if r.pool else 0
+            elif model is not None:
+                out += 1 if r.model_id == model else 0
+            else:
+                out += 1
+        return out
+
+    def tier_members(self, key: str):
+        from tfmesos_tpu.fleet.catalog import filter_members
+        model, role = split_key(key)
+        return filter_members(self.registry.members(role), key)
+
+    def adopt_replica(self, addr: str, model_id: str) -> bool:
+        """The sim's warm-pool adoption: flip the replica's model
+        identity (the real path installs weights — here it is
+        instantaneous) and inject one immediate beat so routing views
+        follow without waiting a heartbeat interval."""
+        rep = self.transport.replicas.get(addr)
+        if rep is None or rep.down or rep.removed or not rep.pool:
+            return False
+        rep.model_id = model_id
+        rep.pool = False
+        self.registry.observe({
+            "op": "heartbeat", "addr": rep.addr,
+            "capacity": rep.capacity,
+            "outstanding": rep.outstanding(self.engine.clock.now),
+            "role": rep.role, "node": rep.node,
+            "weights_version": rep.weights_version, "gen": rep.gen,
+            "model_id": model_id, "warm_pool": False})
+        self.metrics.inc("sim_adoptions")
+        return True
 
     def request_migration(self, addr: str) -> None:
         rep = self.transport.replicas.get(addr)
@@ -933,6 +1001,20 @@ class FleetSim:
                                           clock=self.engine.clock)
         self._auto_tick()
         return self.autoscaler
+
+    def enable_trader(self, catalog: ModelCatalog) -> ModelTrader:
+        """Attach the REAL model trader (the per-(model, tier)
+        generalization of the autoscaler) on the virtual clock, wire
+        the router's cold-start demand hook to it, and schedule its
+        ticks — the multi-model scenario's control plane."""
+        self.replica_budget = self.cfg.model_budget
+        trader = ModelTrader(self, catalog, self.cfg.autoscaler,
+                             trader_config=self.cfg.trader,
+                             clock=self.engine.clock)
+        self.autoscaler = trader
+        self.router.on_model_demand = trader.demand
+        self._auto_tick()
+        return trader
 
     def _auto_tick(self) -> None:
         if self._stopped or self.autoscaler is None:
@@ -968,6 +1050,8 @@ class FleetSim:
             "priority": spec.rank}
         if getattr(req, "session", None):
             msg["session"] = req.session
+        if getattr(req, "model", None):
+            msg["_model"] = req.model
         if req.deadline_ms is not None and req.deadline_ms > 0:
             deadline = now + req.deadline_ms / 1000.0
             msg["deadline"] = deadline
@@ -1060,6 +1144,11 @@ class FleetSim:
         self._h_queue_wait.observe(wait_ms)
         if cls_h is not None:
             cls_h[0].observe(wait_ms)
+        mlabel = msg.get("_model")
+        if mlabel:
+            # The per-model queue-wait histogram — the trader's
+            # relative-pressure signal, same as the real gateway's.
+            m.hist(f"queue_wait_ms_model_{mlabel}").observe(wait_ms)
         try:
             reply = self.router.route(msg)
         except Exception as e:  # noqa: BLE001 - every loss recorded
@@ -1287,6 +1376,7 @@ def _new_cfg(base: Optional[SimConfig], overrides) -> SimConfig:
     cfg.model = dataclasses.replace(cfg.model)
     cfg.breaker = dataclasses.replace(cfg.breaker)
     cfg.autoscaler = dataclasses.replace(cfg.autoscaler)
+    cfg.trader = dataclasses.replace(cfg.trader)
     for path, value in overrides or ():
         apply_override(cfg, path, value)
     return cfg
@@ -1822,6 +1912,153 @@ def scenario_sessions(overrides=(), n_requests: Optional[int] = None,
     return out
 
 
+def scenario_multi_model(overrides=(), n_requests: int = 24000,
+                         replicas: Optional[int] = None,
+                         seed: Optional[int] = None,
+                         workload=None,
+                         model_fit: Optional[dict] = None,
+                         cfg: Optional[SimConfig] = None
+                         ) -> Dict[str, Any]:
+    """The model catalog at sim scale (docs/SERVING.md "Model
+    catalog"): skewed two-model traffic whose hotness FLIPS mid-run
+    against a fixed fleet-wide replica budget, plus one idle model and
+    a warm pool.  The REAL :class:`~tfmesos_tpu.fleet.catalog.
+    ModelTrader` must (a) scale the idle model to zero (freeing its
+    budget slot), (b) TRADE replicas from the cooling model to the
+    heating one after the flip — without thrashing them back and
+    forth — and (c) cold-start the zeroed model through the warm pool
+    when a late request demands it.  The regression contract
+    (tests/test_sim.py): the post-flip hot model ends with MORE
+    replicas than it booted, trades stay bounded, the cold start
+    completes, zero lost requests — deterministic per seed.  Sweep the
+    trading constants with ``--sweep trader.zero_after_ticks=4,8,16``
+    or ``--sweep trader.trade_cooldown_s=0,5,20``."""
+    cfg = _new_cfg(cfg, overrides)
+    if seed is not None:
+        cfg.seed = int(seed)
+    if model_fit:
+        for k, v in model_fit.items():
+            if hasattr(cfg.model, k):
+                setattr(cfg.model, k, v)
+    if not cfg.models:
+        cfg.models = (("alpha", 3), ("beta", 1), ("gamma", 1))
+    if replicas is not None:
+        # --replicas scales the FIRST (hot) model's boot count.
+        first = cfg.models[0]
+        cfg.models = ((first[0], int(replicas)),) + cfg.models[1:]
+    if cfg.warm_pool == 0:
+        cfg.warm_pool = 1
+    boot = sum(n for _, n in cfg.models)
+    if cfg.model_budget is None:
+        cfg.model_budget = boot + cfg.warm_pool
+    # Trading reacts at the tick cadence; the scenario's phases span
+    # tens of virtual seconds, so the default cooldowns fit.
+    cfg.autoscale = True
+    cfg.workers = max(cfg.workers,
+                      min(256, 2 * cfg.model_budget * cfg.capacity))
+    sim = FleetSim(cfg)
+    catalog = ModelCatalog([
+        ModelSpec(mid, replicas=n, seed=i)
+        for i, (mid, n) in enumerate(cfg.models)])
+    for i, (mid, n) in enumerate(cfg.models):
+        key = model_key(mid)
+        sim.set_target(key, n)
+        for _ in range(n):
+            sim.launch_replica(key)
+    from tfmesos_tpu.fleet.catalog import POOL_KEY
+    sim.set_target(POOL_KEY, cfg.warm_pool)
+    for _ in range(cfg.warm_pool):
+        sim.launch_replica(POOL_KEY)
+    sim.enable_trader(catalog)
+    hot, cold = cfg.models[0][0], cfg.models[1][0]
+    idle = cfg.models[2][0] if len(cfg.models) > 2 else None
+    if workload is None:
+        _, per_req_s = cfg.model.service_s(64, 16, random.Random(0))
+        # Saturate the HOT model's boot allocation so its pressure is
+        # unambiguous; the cold model idles along at a trickle.
+        hot_rate = 1.1 * cfg.models[0][1] * cfg.capacity \
+            / max(1e-9, per_req_s)
+        cold_rate = 0.1 * hot_rate
+        n_half = n_requests // 2
+        mk = SyntheticWorkload
+        phase1 = [
+            mk(n_requests=int(n_half * 0.9), seed=cfg.seed,
+               rate=hot_rate, prompt_len=64, new_tokens=16,
+               model=hot),
+            mk(n_requests=max(1, int(n_half * 0.1)), seed=cfg.seed + 1,
+               rate=cold_rate, prompt_len=64, new_tokens=16,
+               model=cold),
+        ]
+        t_flip = max(max(r.at for r in w) for w in phase1)
+        phase2 = [
+            mk(n_requests=int(n_half * 0.9), seed=cfg.seed + 2,
+               rate=hot_rate, prompt_len=64, new_tokens=16,
+               model=cold, start_at=t_flip),
+            mk(n_requests=max(1, int(n_half * 0.1)), seed=cfg.seed + 3,
+               rate=cold_rate, prompt_len=64, new_tokens=16,
+               model=hot, start_at=t_flip),
+        ]
+        for w in phase1 + phase2:
+            sim.feed(w)
+    else:
+        t_flip = None
+        sim.feed(workload)
+    sim.start_workers()
+    t0 = time.perf_counter()
+    sim.engine.run(stop=sim.drained)
+    # Allocation is read the moment traffic drains — before idleness
+    # scales everything back to zero.
+    post_flip_hot_actual = sim.tier_actual(model_key(cold))
+    # COLD START: one late request for the scaled-to-zero idle model
+    # must route through the demand hook -> warm-pool adoption and
+    # COMPLETE, never error.
+    cold_start: Dict[str, Any] = {}
+    if idle is not None:
+        sink: list = []
+        req = Request(at=0.0, cls=None, prompt_len=16, new_tokens=4,
+                      model=idle)
+        t_demand = sim.engine.clock.now
+
+        def probe() -> None:
+            if not sim.submit(req, sink=sink):
+                return
+            while not sink:
+                item = sim.admission.get(timeout=0)
+                if item is not None:
+                    sim.dispatch(item)
+                else:
+                    sim.engine.sleep(0.01)
+
+        sim.engine.spawn(probe, name="sim-cold-start")
+        sim.engine.run(until=sim.engine.clock.now + 60.0,
+                       stop=lambda: bool(sink))
+        reply = sink[0][0] if sink else None
+        cold_start = {
+            "completed": bool(isinstance(reply, dict)
+                              and reply.get("op") == "completion"),
+            "wait_s": round(sim.engine.clock.now - t_demand, 3),
+        }
+    wall = time.perf_counter() - t0
+    out = sim.results(wall)
+    out.update({
+        "hot_then_cold": (hot, cold),
+        "flip_at": round(t_flip, 3) if t_flip is not None else None,
+        "trades": sim.metrics.get("model_trades"),
+        "trade_blocked": sim.metrics.get("model_trade_blocked"),
+        "scale_to_zero": sim.metrics.get("model_scale_to_zero"),
+        "adoptions": sim.metrics.get("sim_adoptions"),
+        "cold_starts": sim.metrics.get("model_cold_starts"),
+        "final_actual": {mid: sim.tier_actual(model_key(mid))
+                         for mid, _ in cfg.models},
+        "post_flip_hot_actual": post_flip_hot_actual,
+        "pool_actual": sim.tier_actual(POOL_KEY),
+        "budget": cfg.model_budget,
+        "cold_start": cold_start,
+    })
+    sim.stop()
+    return out
+
+
 SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "steady": scenario_steady,
     "surge": scenario_surge,
@@ -1829,6 +2066,7 @@ SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "scale": scenario_scale,
     "multi-gateway": scenario_multi_gateway,
     "sessions": scenario_sessions,
+    "multi-model": scenario_multi_model,
 }
 
 

@@ -47,6 +47,11 @@ class Request(NamedTuple):
     new_tokens: int
     deadline_ms: Optional[float] = None
     session: Optional[str] = None
+    #: model-catalog label (docs/SERVING.md "Model catalog"): the
+    #: sim's gateway analog stamps it onto the forward like the real
+    #: one, so the router's per-model tier and the trader's per-model
+    #: pressure signals run in simulation too.  None = the default.
+    model: Optional[str] = None
 
 
 def _clamped_lognormal(rng: random.Random, median: float, sigma: float,
@@ -75,7 +80,8 @@ class SyntheticWorkload:
                  new_tokens: int = 16, new_tokens_sigma: float = 0.5,
                  max_prompt_len: int = 2048, max_new_tokens: int = 512,
                  deadline_ms: Optional[float] = None,
-                 deterministic: bool = False, start_at: float = 0.0):
+                 deterministic: bool = False, start_at: float = 0.0,
+                 model: Optional[str] = None):
         if n_requests < 1:
             raise ValueError(f"n_requests must be >= 1, got {n_requests}")
         if rate <= 0:
@@ -98,6 +104,7 @@ class SyntheticWorkload:
         self.deadline_ms = deadline_ms
         self.deterministic = bool(deterministic)
         self.start_at = float(start_at)
+        self.model = model
 
     def __iter__(self) -> Iterator[Request]:
         rng = random.Random(self.seed)
@@ -114,7 +121,7 @@ class SyntheticWorkload:
                 new_tokens=_clamped_lognormal(
                     rng, self.new_tokens, self.new_tokens_sigma, 1,
                     self.max_new_tokens),
-                deadline_ms=self.deadline_ms)
+                deadline_ms=self.deadline_ms, model=self.model)
 
 
 # -- trace replay ------------------------------------------------------------

@@ -63,6 +63,11 @@ __all__ = ["Request", "Completion", "Suspended", "Expired",
 # SubmissionQueue.poll's end-of-stream marker (distinct from None, which
 # means "nothing available right now, more may come").
 _CLOSED = object()
+#: SubmissionQueue wake-up sentinel (see SubmissionQueue.kick): wakes
+#: an idle-blocked serve loop without submitting work, so a queued
+#: weight update (swap_adapter / set_weights) applies promptly on an
+#: otherwise idle batcher instead of waiting for the next request.
+_KICK = object()
 
 #: THE bypass registry's documented allowlist: every reason string a
 #: ``*_bypass_reason`` attribute is allowed to carry, per registry.
@@ -152,6 +157,15 @@ class SubmissionQueue:
             self._closed = True
             self._q.put(_CLOSED)
 
+    def kick(self) -> None:
+        """Wake a blocked serve loop WITHOUT submitting work (the
+        weight-update path: an idle loop must notice a queued
+        swap_adapter/set_weights now, not at the next request).
+        Harmless after close."""
+        with self._lock:
+            if not self._closed:
+                self._q.put(_KICK)
+
     @property
     def closed(self) -> bool:
         with self._lock:
@@ -160,15 +174,21 @@ class SubmissionQueue:
     def poll(self, block: bool):
         """Next request; ``None`` when empty (and more may come), the
         ``_CLOSED`` sentinel at end of stream.  ``block=True`` waits for
-        one of the two."""
-        try:
-            item = self._q.get(block=block)
-        except _queue.Empty:
-            return None
-        if item is _CLOSED:
-            self._q.put(_CLOSED)    # keep re-polls (and peers) terminal
-            return _CLOSED
-        return item
+        one of the two.  Wake-up kicks are swallowed here (they exist
+        only to end a blocking poll early)."""
+        while True:
+            try:
+                item = self._q.get(block=block)
+            except _queue.Empty:
+                return None
+            if item is _KICK:
+                if block:
+                    return None     # woken: let the loop re-check state
+                continue
+            if item is _CLOSED:
+                self._q.put(_CLOSED)  # keep re-polls (and peers) terminal
+                return _CLOSED
+            return item
 
 
 @dataclasses.dataclass
@@ -984,6 +1004,35 @@ class _PrefixCache:
         with self._lock:
             return self._evict_one(shard)
 
+    def clear(self) -> int:
+        """Drop EVERY cached node, returning its page (and draft twin)
+        to the free lists — the weight-swap invalidation: pages
+        prefilled under the OLD weights must neither map into new rows
+        nor spill to the KV tier, so the eviction callback is
+        deliberately NOT fired.  Only legal with no resident rows
+        (every node at ref 0); the batcher's weight-update fence
+        guarantees that.  Returns the number of nodes dropped."""
+        with self._lock:
+            if self.row_nodes:
+                raise RuntimeError(
+                    "prefix cache clear with live row references — the "
+                    "weight-update fence must drain resident rows first")
+            dropped = 0
+            for shard in range(self.n_shards):
+                for n in self._walk(shard):
+                    self.side.alloc.shards[shard].free.append(n.page)
+                    if self.dside is not None:
+                        self.dside.alloc.shards[shard].free.append(
+                            n.dpage)
+                    dropped += 1
+                self.roots[shard] = {}
+                self._n_nodes[shard] = 0
+                self._n_zero[shard] = 0
+            self._stats["evicted"] += dropped
+        if dropped:
+            self._dirty()
+        return dropped
+
     def insert_chain(self, shard: int, parent_digests, digest: bytes,
                      page: int, dpage: Optional[int] = None) -> bool:
         """Insert ONE already-resident page (plus its draft twin in
@@ -1452,6 +1501,28 @@ class ContinuousBatcher:
         # EVERYTHING (drain-migration) and yield Suspended items.
         self._parked: deque = deque()
         self._preempt_event = threading.Event()
+        # Online weight updates (docs/SERVING.md "Model catalog"):
+        # queued LoRA-style adapter folds (swap_adapter) and full
+        # weight swaps (set_weights, the warm-pool adoption path),
+        # applied by the serve loop BETWEEN generations — admission
+        # gates while one is pending, resident rows finish on the old
+        # weights, then the update folds and admission resumes: every
+        # stream is token-identical to an offline run under exactly
+        # one weights state.  The prefix cache flushes and the KV tier
+        # restamps at apply time (old-weights KV must never feed a
+        # new-weights decode).
+        self._weight_updates: deque = deque()
+        self._weights_lock = threading.Lock()
+        #: label of the last adapter delta folded in ("" = base
+        #: weights) — rides heartbeats and suspended exports so the
+        #: router only ever resumes mid-stream KV under the same
+        #: delta.
+        self.adapter_version = ""
+        self.weight_swaps = 0       # updates applied (folds + sets)
+        #: optional hook fired (from the serve loop) after each update
+        #: applies: ``on_weights_applied(kind, version)`` — the
+        #: replica process uses it to refresh heartbeat fields.
+        self.on_weights_applied = None
         self.preemptions = 0        # rows suspended for a higher class
         self.resumes = 0            # parked rows re-admitted locally
         # End-to-end deadlines: arrivals shed expired + resident rows
@@ -1556,6 +1627,150 @@ class ContinuousBatcher:
         ``artifact=None``.  Thread-safe; a no-op until the serve loop
         runs (an idle loop processes it on its next submission)."""
         self._preempt_event.set()
+
+    # -- online weight updates (adapter hot-swap / warm-pool adoption) ------
+
+    def swap_adapter(self, delta: Dict[str, Any], version: str,
+                     on_applied=None) -> None:
+        """Fold a LoRA-style weight DELTA into the serving params with
+        zero downtime: ``delta`` maps ``/``-joined param paths (e.g.
+        ``"layers/wq"``) to arrays added onto the matching leaves.
+        Validated NOW (unknown path / shape mismatch raises
+        ``ValueError``); applied by the serve loop once every resident
+        row has finished — new admissions wait behind the fence, so
+        in-flight requests finish on the OLD delta and every stream is
+        token-identical to an offline run under exactly one delta
+        version.  ``version`` labels the resulting cumulative state
+        (:attr:`adapter_version`); ``on_applied()`` fires from the
+        serve loop after the fold (the replica replies to the control
+        op from it).  On a batcher with no serve loop (prefill role,
+        direct use) the fold applies synchronously."""
+        if not isinstance(version, str) or not version:
+            raise ValueError("adapter version must be a non-empty "
+                             "string")
+        resolved = self._resolve_delta(delta)
+        self._queue_weight_update(("fold", resolved, version,
+                                   on_applied))
+
+    def set_weights(self, params, version: str = "",
+                    on_applied=None) -> None:
+        """Replace the FULL parameter tree (the warm-pool adoption
+        path: a pre-warmed replica installs another model's weights —
+        same config/shapes, so nothing recompiles).  Same fence and
+        invalidation discipline as :meth:`swap_adapter`; ``version``
+        feeds the KV tier's restamp so entries parked under the old
+        weights read as version misses, never stale KV."""
+        self._queue_weight_update(("set", params, str(version or ""),
+                                   on_applied))
+
+    def _resolve_delta(self, delta: Dict[str, Any]):
+        """Validate a path->array delta against the live param tree;
+        returns ``[(key_path_tuple, np_array), ...]``."""
+        if not isinstance(delta, dict) or not delta:
+            raise ValueError("adapter delta must be a non-empty dict "
+                             "of param-path -> array")
+        resolved = []
+        for path in sorted(delta):
+            keys = tuple(k for k in str(path).split("/") if k)
+            node = self.params
+            for k in keys:
+                if not isinstance(node, dict) or k not in node:
+                    raise ValueError(
+                        f"adapter delta names unknown param path "
+                        f"{path!r}")
+                node = node[k]
+            if not keys or isinstance(node, dict):
+                # An empty path or an interior tree node is not a
+                # foldable leaf — reject with the documented error,
+                # not an AttributeError on .shape below.
+                raise ValueError(
+                    f"adapter delta path {path!r} does not name a "
+                    f"param array (it is "
+                    f"{'empty' if not keys else 'an interior node'})")
+            arr = np.asarray(delta[path])
+            if tuple(arr.shape) != tuple(node.shape):
+                raise ValueError(
+                    f"adapter delta shape mismatch at {path!r}: delta "
+                    f"{tuple(arr.shape)} vs param {tuple(node.shape)}")
+            resolved.append((keys, arr))
+        return resolved
+
+    def _queue_weight_update(self, update) -> None:
+        with self._export_lock:
+            if self._loop_active:
+                # The serve loop owns the rows: it applies the update
+                # at its next between-generations point; kick wakes an
+                # idle-blocked loop so the apply never waits for
+                # traffic.
+                with self._weights_lock:
+                    self._weight_updates.append(update)
+                src = self._submissions
+                if src is not None:
+                    src.kick()
+            else:
+                # No loop (prefill role, direct export use): apply in
+                # place, serialized against export_kv by the lock.
+                self._apply_weight_update(update)
+
+    def _apply_pending_weight_updates(self) -> None:
+        while True:
+            with self._weights_lock:
+                if not self._weight_updates:
+                    return
+                update = self._weight_updates.popleft()
+            self._apply_weight_update(update)
+
+    def _apply_weight_update(self, update) -> None:
+        kind, payload, version, cb = update
+        if kind == "fold":
+            new = self.params
+            for keys, arr in payload:
+                # Copy-on-write along the path only; the fold stays on
+                # device for single-host batchers.
+                node = new = dict(new)
+                for k in keys[:-1]:
+                    child = dict(node[k])
+                    node[k] = child
+                    node = child
+                leaf = node[keys[-1]]
+                node[keys[-1]] = leaf + jnp.asarray(arr).astype(
+                    leaf.dtype)
+            self.adapter_version = version
+        else:
+            new = payload
+            self.adapter_version = ""
+        if self.mesh is not None:
+            from tfmesos_tpu.models.transformer import partition_specs
+            new = self._place(new, partition_specs(self.cfg, self.mesh))
+        self.params = new
+        # The weights changed: every cached KV artifact computed under
+        # the old ones is now WRONG for new decodes.  Flush the prefix
+        # trie (no spill — stale pages must not enter the tier) and
+        # restamp the KV tier so parked sessions/spilled pages from
+        # before the update read as version misses (cold re-prefill,
+        # never a silently wrong stream).
+        if self._pcache is not None:
+            self._pcache.clear()
+        if self.kv_tier is not None \
+                and self.kv_tier_bypass_reason is None:
+            restamp = getattr(self.kv_tier, "restamp", None)
+            if restamp is not None:
+                if kind == "fold":
+                    restamp(adapter=version)
+                else:
+                    restamp(weights_version=version or None, adapter="")
+        self.weight_swaps += 1
+        hook = self.on_weights_applied
+        if hook is not None:
+            try:
+                hook(kind, version)
+            except Exception:
+                pass    # observer hook: never costs the update
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass    # a broken waiter costs its reply, not the loop
 
     def prefix_cache_stats(self) -> Optional[Dict[str, int]]:
         """Hit/miss/eviction counters plus current occupancy of the
@@ -3484,7 +3699,11 @@ class ContinuousBatcher:
                     self._trace_event(pre.request, "resume")
                     burst.append(self._admit_import(row, pre, wt, wd,
                                                     need, active))
-                while free_rows and bad_request is None:
+                while free_rows and bad_request is None \
+                        and not self._weight_updates:
+                    # (A pending weight update gates NEW admissions —
+                    # resident rows and parked resumes finish on the
+                    # old weights first; see _apply_weight_update.)
                     if not pending and not exhausted and burst \
                             and not incremental:
                         # pull() may BLOCK in next(source) (a staggered
@@ -3581,7 +3800,8 @@ class ContinuousBatcher:
                 # bound) makes it visible, and a successful preemption
                 # loops back to admit it before the next decode block.
                 if (not free_rows and incremental and self.preemptible
-                        and bad_request is None):
+                        and bad_request is None
+                        and not self._weight_updates):
                     pull(block=False)
                     if pending:
                         it0 = pending[0]
@@ -3602,6 +3822,12 @@ class ContinuousBatcher:
                         raise bad_request
                     if self._parked:
                         continue    # resume parked work before idling
+                    if self._weight_updates:
+                        # Between generations, nothing resident: THE
+                        # weight-update point — fold/replace, flush
+                        # stale KV caches, then resume admission.
+                        self._apply_pending_weight_updates()
+                        continue
                     pull()
                     if not pending and exhausted:
                         return
@@ -3642,9 +3868,13 @@ class ContinuousBatcher:
                 self._finish(row, active, free_rows)
             # Dropped only after the rows are released, so an export
             # admitted the instant the fence clears can never borrow a
-            # row the dying loop still owns.
+            # row the dying loop still owns.  Weight updates still
+            # queued apply HERE (under the same lock a new
+            # swap_adapter would take) so their waiters always get
+            # their callback — a dying loop must not strand a swap.
             with self._export_lock:
                 self._loop_active = False
+                self._apply_pending_weight_updates()
 
     def _flush_streams(self, active: Dict[int, "_Row"]) -> None:
         """Push each streaming row's not-yet-streamed ``out`` suffix to
