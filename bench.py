@@ -1386,6 +1386,186 @@ def bench_fleet_disagg(n_decode=8, decode_new=24, prompt_len=96,
     return dis_ttft, dis_itl, uni_ttft, uni_itl, kv_mb_s
 
 
+def bench_fleet_gang(n_requests=6, gang_size=2, rows=4, decode_new=24,
+                     workers=8):
+    """Gang replicas (docs/SERVING.md "Gang replicas") behind the same
+    gateway: each replica is ``gang_size`` member tasks forming one
+    leader-coordinated mesh, routed as ONE ``ReplicaInfo``.  Three
+    phases on LocalBackend CPU gangs:
+
+    * token identity + inter-token p50 — the SAME greedy-decode prompts
+      stream through a ``gang_size``-member gang fleet and a
+      single-process fleet; every stream is asserted token-identical
+      (the leader owns sampling; members mirror-execute and digest-ack),
+      and ``fleet_gang_itl_p50_ms`` vs ``fleet_single_itl_p50_ms``
+      tracks the leader's dispatch fan-out overhead (on CPU the members
+      add no compute — real slices flip the comparison).
+    * ``fleet_gang_reform_s`` — SIGKILL one MEMBER task mid-decode: the
+      gang dies whole (member death = gang death), in-flight work fails
+      over to the surviving gang via router replay (zero lost requests
+      asserted, streams still token-identical), and the launcher
+      re-forms the gang under a fresh generation; the number is
+      kill -> both replicas routable again.
+    * gang drain-migration — a pinned drain + migrate of a busy gang
+      mid-decode must move its in-flight work losslessly (zero lost,
+      token-identical), exactly like a single-process replica's drain.
+    """
+    import threading
+
+    from tfmesos_tpu.chaos import FaultPlan
+    from tfmesos_tpu.fleet.client import FleetClient
+    from tfmesos_tpu.fleet.launcher import FleetServer
+    from tfmesos_tpu.backends.local import LocalBackend
+
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 97, size=(8,)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def itl_p50(rs):
+        vals = sorted((r["total_ms"] - r["ttft_ms"])
+                      / max(1, decode_new - 1) for r in rs)
+        return vals[len(vals) // 2]
+
+    def run_single():
+        fleet = FleetServer(replicas=1, rows=rows, tiny=True, max_len=64,
+                            page_size=16, prefill_bucket=16,
+                            workers=workers, max_queue=256,
+                            request_timeout=300.0, start_timeout=300.0)
+        fleet.start()
+        try:
+            client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+            client.generate(prompts[0], 2)      # warm the compile
+            res = [client.generate(p, decode_new, timeout=300.0)
+                   for p in prompts]
+            client.close()
+            return res
+        finally:
+            fleet.stop()
+
+    single_res = run_single()
+    single_itl = itl_p50(single_res)
+
+    plan = FaultPlan([], seed=5)
+    fleet = FleetServer(replicas=2, gang_size=gang_size, rows=rows,
+                        tiny=True, max_len=64, page_size=16,
+                        prefill_bucket=16, workers=workers, max_queue=256,
+                        request_timeout=300.0, start_timeout=300.0,
+                        backend=LocalBackend(chaos=plan))
+    fleet.start()
+    try:
+        client = FleetClient(fleet.addr, fleet.token, timeout=300.0)
+
+        def run_batch(reqs, results, errors):
+            def one(i):
+                try:
+                    results[i] = client.generate(reqs[i], decode_new,
+                                                 timeout=300.0)
+                except Exception as e:
+                    errors.append((i, e))
+            threads = [threading.Thread(target=one, args=(i,),
+                                        daemon=True)
+                       for i in range(len(reqs))]
+            for t in threads:
+                t.start()
+            return threads
+
+        # Warm BOTH gangs' compiles: with least-outstanding routing,
+        # 2*replicas concurrent requests land on every gang.
+        warm = [None] * 4
+        for t in run_batch([prompts[0]] * 4, warm, []):
+            t.join(timeout=300.0)
+
+        gang_res = [client.generate(p, decode_new, timeout=300.0)
+                    for p in prompts]
+        for i, (g, s) in enumerate(zip(gang_res, single_res)):
+            assert g["tokens"] == s["tokens"], \
+                (f"gang stream {i} diverged from the single-host "
+                 f"reference: {g['tokens']} vs {s['tokens']}")
+        gang_itl = itl_p50(gang_res)
+
+        # --- phase 2: SIGKILL one gang MEMBER mid-decode -------------
+        with fleet._gang_lock:
+            gangs = dict(fleet._gangs)
+        assert len(gangs) == 2, f"expected 2 gangs, got {list(gangs)}"
+        gid, info = sorted(gangs.items())[0]
+        member_node = None
+        for t in fleet.scheduler.tasks_of("replica"):
+            node = f"{t.job_name}:{t.task_index}"
+            if getattr(t, "gang", None) == gid \
+                    and node != info["leader_node"]:
+                member_node = node
+        assert member_node is not None, f"gang {gid} has no member task"
+
+        old_addrs = {r.addr for r in fleet.registry.alive()}
+        results = [None] * n_requests
+        errors = []
+        threads = run_batch(prompts, results, errors)
+        deadline = time.perf_counter() + 120.0
+        while time.perf_counter() < deadline:
+            if any(r.outstanding > 0 for r in fleet.registry.alive()):
+                break
+            time.sleep(0.01)
+        t_kill = time.perf_counter()
+        plan.kill(member_node)
+        # Re-formed means a FRESH leader addr is routable again — the
+        # dead gang's leader lingers in alive() until the registry sees
+        # its heartbeat drop, so counting addrs alone would read the
+        # pre-kill fleet as already re-formed.
+        reform_s = None
+        deadline = time.perf_counter() + 300.0
+        while time.perf_counter() < deadline:
+            addrs = {r.addr for r in fleet.registry.alive()}
+            if len(addrs) == 2 and addrs - old_addrs:
+                reform_s = time.perf_counter() - t_kill
+                break
+            time.sleep(0.05)
+        assert reform_s is not None, "gang never re-formed after the kill"
+        for t in threads:
+            t.join(timeout=300.0)
+        assert not errors, \
+            f"request lost across gang-member kill: {errors[0]!r}"
+        for i, r in enumerate(results):
+            assert r is not None, f"request {i} never completed"
+            assert r["tokens"] == single_res[i]["tokens"], \
+                f"stream {i} diverged across gang failover"
+        c = fleet.snapshot()["counters"]
+        assert c.get("gang_reforms", 0) >= 1, \
+            f"launcher never re-formed the gang: {c}"
+
+        # --- phase 3: drain-migrate a busy gang ----------------------
+        # Warm the re-formed gang's compile first (least-outstanding
+        # routing lands concurrent requests on it), so the drain's
+        # suspended work has a live, warm candidate to resume on.
+        warm2 = [None] * 4
+        for t in run_batch([prompts[0]] * 4, warm2, []):
+            t.join(timeout=300.0)
+        results2 = [None] * n_requests
+        errors2 = []
+        threads2 = run_batch(prompts, results2, errors2)
+        victim = None
+        deadline = time.perf_counter() + 120.0
+        while victim is None and time.perf_counter() < deadline:
+            busy = [r for r in fleet.registry.alive()
+                    if r.outstanding > 0]
+            victim = busy[0].addr if busy else None
+            time.sleep(0.01)
+        assert victim is not None, "no gang ever reported work"
+        assert fleet.registry.begin_drain(victim, pinned=True)
+        fleet.request_migration(victim)
+        for t in threads2:
+            t.join(timeout=300.0)
+        assert not errors2, \
+            f"request lost in gang drain-migration: {errors2[0]!r}"
+        for i, r in enumerate(results2):
+            assert r is not None, f"drained request {i} never completed"
+            assert r["tokens"] == single_res[i]["tokens"], \
+                f"stream {i} diverged across gang drain-migration"
+        client.close()
+    finally:
+        fleet.stop()
+    return gang_itl, single_itl, reform_s
+
+
 def bench_fleet_autoscale(rows=2, max_new_tokens=4, workers=8):
     """Control-plane reaction benchmarks on a live LocalBackend fleet:
 
@@ -3122,6 +3302,17 @@ def main():
         # under traffic, per-tenant x model metering — all asserted
         # in-bench.
         out.update(mm[0])
+        flush_partial()
+    gg = attempts(bench_fleet_gang, "fleet gang replica bench", n=1)
+    if gg:
+        # One model sharded across a gang of member tasks, served as
+        # ONE replica: streams asserted token-identical to a
+        # single-process fleet, zero lost requests across a mid-decode
+        # gang-member SIGKILL and across a gang drain-migration.
+        gang_itl, single_itl, reform_s = gg[0]
+        out["fleet_gang_itl_p50_ms"] = round(gang_itl, 3)
+        out["fleet_single_itl_p50_ms"] = round(single_itl, 3)
+        out["fleet_gang_reform_s"] = round(reform_s, 2)
         flush_partial()
     rw = attempts(bench_ring_window, "ring window bench", n=1)
     if rw and rw[0] is not None:    # >1 visible device: sp ring

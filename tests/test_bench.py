@@ -385,3 +385,17 @@ def test_fleet_multimodel_bench_smoke():
     assert out["fleet_multimodel_pool_cold_start_ttft_ms"] < \
         out["fleet_multimodel_relaunch_cold_start_ttft_ms"]
     assert out["fleet_multimodel_metered_pairs"] >= 4
+
+
+@pytest.mark.slow
+def test_fleet_gang_bench_smoke():
+    """The gang-replica bench protocol at small size: a 2-member gang
+    behind the gateway streams token-identical to a single-process
+    fleet, a mid-decode gang-member SIGKILL loses nothing (the gang
+    dies whole, re-forms, in-flight work replays on the survivor), and
+    a gang drain-migration loses nothing — all asserted inside the
+    bench itself."""
+    gang_itl, single_itl, reform_s = bench.bench_fleet_gang(
+        n_requests=4, gang_size=2, rows=2, decode_new=16, workers=4)
+    assert gang_itl > 0 and single_itl > 0
+    assert reform_s > 0

@@ -733,3 +733,65 @@ def test_router_control_raw_ships_adapter_frame(catalog_fleet):
     # The new adapter version rides the next heartbeat into the table.
     assert _wait(lambda: reg.members()[0].adapter_version == "d1")
     router.close()
+
+
+def test_gang_model_costs_n_slots_under_the_budget():
+    """A gang replica is N member tasks — under the shared replica
+    budget it costs N SLOTS.  Growing a hot gang model at a full
+    budget frees enough victims for the WHOLE gang, all-or-nothing:
+    a trade that freed only half the slots would shrink victims for
+    no growth at all."""
+    ka, kb = model_key("a"), model_key("b")
+    cat = ModelCatalog([ModelSpec("a", replicas=3),
+                        ModelSpec("b", replicas=1, gang_size=2)])
+    reg = _TradeRegistry([_rep(f"a:{i}", "a") for i in range(3)]
+                         + [_rep("b:0", "b")])
+    # Budget 5 slots: a holds 3 (three singles), b holds 2 (one gang).
+    fleet = _StubTradeFleet(reg, {ka: 3, kb: 1}, budget=5)
+    sig = {ka: dict(WARM), kb: dict(HOT)}
+    clock = [100.0]
+    tr = _trader(fleet, cat, sig, clock, trade_cooldown_s=5.0)
+    clock[0] += 10.0
+    tr.step()
+    # One more b gang needs 2 slots: TWO of a's singles drain in the
+    # same trade (victims repeat per freed replica, down to a's live
+    # bound of 1).
+    assert fleet.targets == {ka: 1, kb: 2}
+    assert fleet.metrics.get("model_trades") == 1
+    # The drain ACTUATION stays one-in-flight-per-tier (the convergence
+    # loop's churn bound: drain, reap, then the next victim); the
+    # TARGET math moved both slots in the single trade above.
+    for _ in range(6):
+        if len(reg.drained) == 2:
+            break
+        clock[0] += 10.0
+        tr.step()
+    assert fleet.targets == {ka: 1, kb: 2}      # no second trade
+    assert len(reg.drained) == 2
+    assert all(a.startswith("a:") for a in reg.drained)
+
+
+def test_gang_trade_blocks_whole_when_slots_cannot_be_freed():
+    """If the fleet cannot free a gang's FULL slot need, nothing
+    shrinks — no victim drains for growth that never happens."""
+    ka, kb = model_key("a"), model_key("b")
+    cat = ModelCatalog([ModelSpec("a", replicas=1, floor=1),
+                        ModelSpec("b", replicas=1, gang_size=3)])
+    reg = _TradeRegistry([_rep("a:0", "a"), _rep("b:0", "b")])
+    # Budget 4: a holds 1 slot, b holds 3.  One more b gang needs 3
+    # slots but only a's single (floored at min_replicas=1) exists.
+    fleet = _StubTradeFleet(reg, {ka: 1, kb: 1}, budget=4)
+    sig = {ka: dict(WARM), kb: dict(HOT)}
+    clock = [100.0]
+    tr = _trader(fleet, cat, sig, clock, trade_cooldown_s=5.0)
+    clock[0] += 10.0
+    tr.step()
+    assert fleet.targets == {ka: 1, kb: 1}      # nothing moved
+    assert reg.drained == []
+    assert fleet.metrics.get("model_trades") in (None, 0)
+
+
+def test_model_spec_gang_size_validation():
+    with pytest.raises(ValueError):
+        ModelSpec("a", gang_size=0)
+    assert ModelSpec("a", gang_size=2).gang_size == 2

@@ -349,3 +349,61 @@ def test_session_meta_history_counts_against_the_budget():
     with pytest.raises(KVTierFull):
         store.park("conv", {"history": list(range(4000))}, b"x" * 100)
     assert store.stats()["park_rejected"] == 1
+
+
+# -- gang artifacts ----------------------------------------------------------
+
+
+def test_gang_shard_pack_round_trips_whole():
+    """A gang's per-member KV exports fold into ONE tier artifact and
+    split back in rank order — the sharded state parks and re-imports
+    WHOLE, with the outer stamp mirroring shard 0's fence fields."""
+    from tfmesos_tpu.fleet.kvtier import pack_gang_shards, unpack_gang_shards
+
+    shards = [({"rank": 0, "weights_version": "v2", "model_id": "m",
+                "adapter_version": "a1"}, b"leader-kv"),
+              ({"rank": 1, "weights_version": "v2"}, b""),
+              ({"rank": 2, "weights_version": "v2"}, b"shard-two-kv")]
+    meta, body = pack_gang_shards(shards)
+    assert meta["gang_size"] == 3
+    assert meta["weights_version"] == "v2"
+    assert meta["model_id"] == "m" and meta["adapter_version"] == "a1"
+    assert body == b"leader-kvshard-two-kv"
+    out = unpack_gang_shards(meta, body)
+    assert [(m["rank"] if "rank" in m else None, b) for m, b in out] \
+        == [(0, b"leader-kv"), (1, b""), (2, b"shard-two-kv")]
+    with pytest.raises(ValueError):
+        pack_gang_shards([])
+
+
+def test_gang_shard_corruption_reads_as_error_never_a_smaller_gang():
+    from tfmesos_tpu.fleet.kvtier import pack_gang_shards, unpack_gang_shards
+
+    meta, body = pack_gang_shards([({"rank": 0}, b"aaaa"),
+                                   ({"rank": 1}, b"bbbb")])
+    # Truncated or padded bodies are corruption, not a resize.
+    with pytest.raises(ValueError):
+        unpack_gang_shards(meta, body[:-1])
+    with pytest.raises(ValueError):
+        unpack_gang_shards(meta, body + b"x")
+    # A torn meta (lens/metas shorter than the declared size, negative
+    # lens, missing keys) never yields shards.
+    bad = dict(meta)
+    bad["shard_lens"] = [4]
+    with pytest.raises(ValueError):
+        unpack_gang_shards(bad, body)
+    bad = dict(meta)
+    bad["shard_lens"] = [-4, 12]
+    with pytest.raises(ValueError):
+        unpack_gang_shards(bad, body)
+    with pytest.raises(ValueError):
+        unpack_gang_shards({"shard_meta": [], "shard_lens": []}, b"")
+    # The artifact also round-trips through the tier store like any
+    # session (park/resume treats it as one opaque entry).
+    store = KVTierStore(ram_bytes=1 << 16, token="t")
+    store.park("gang:replica/g1", meta, body)
+    got = store.resume("gang:replica/g1")
+    assert got is not None
+    gmeta, gbody = got[0], got[1]
+    assert unpack_gang_shards(gmeta, gbody) == [
+        ({"rank": 0}, b"aaaa"), ({"rank": 1}, b"bbbb")]

@@ -2711,3 +2711,31 @@ def test_swap_adapter_flushes_prefix_cache(setup):
     req2 = Request(prompt=prompt, max_new_tokens=4)
     done = list(batcher.run([req2]))
     assert done[0].tokens == _offline(cfg, _fold(params, delta), req2)
+
+
+def test_rid_seed_gives_disjoint_rid_streams(setup):
+    """Fleet regression (PR 4 caveat): two replicas seeded from different
+    node ids must mint disjoint rids, so traces and KV-export keys from
+    different gang members never collide at the gateway."""
+    from tfmesos_tpu.fleet.replica import rid_seed_for_node
+    cfg, params = setup
+    seeds = [rid_seed_for_node(n) for n in ("replica:0", "replica:1")]
+    assert seeds[0] != seeds[1]
+    rids = []
+    for seed in seeds:
+        b = ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                              page_size=16, prefill_bucket=16,
+                              rid_seed=seed)
+        reqs = [Request(prompt=p, max_new_tokens=2)
+                for p in _prompts(cfg, 3, seed=5)]
+        done = list(b.run(reqs))
+        assert sorted(c.rid for c in done) == [seed, seed + 1, seed + 2]
+        rids.extend(c.rid for c in done)
+    assert len(set(rids)) == len(rids)      # globally disjoint
+    with pytest.raises(ValueError):
+        ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                          page_size=16, prefill_bucket=16,
+                          rid_seed=2 ** 30)
+    with pytest.raises(ValueError):
+        ContinuousBatcher(cfg, params, rows=2, max_len=64,
+                          page_size=16, prefill_bucket=16, rid_seed=-1)

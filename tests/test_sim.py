@@ -597,3 +597,69 @@ def test_apply_override_trader_and_catalog_paths():
     assert cfg.model_budget == 7
     with pytest.raises(ValueError):
         apply_override(cfg, "trader.nope", "1")
+
+
+def test_gang_scenario_member_kill_reforms_lossless(sleep_trap):
+    """The ``gang`` scenario: a unified tier of pod-slice gangs, one
+    member hard-killed mid-run — the gang dies WHOLE (never a smaller
+    gang), its in-flight work replays on the survivors with zero lost
+    requests, and after ``gang_reform_s`` the fleet ends with the
+    booted gang count again.  Deterministic per seed."""
+    out = run_scenario("gang", n_requests=400, replicas=3, seed=7)
+    assert out["lost"] == 0 and out["failed"] == 0
+    assert out["completed"] == out["requests"]
+    assert out["gang_size"] == 4
+    assert out["gang_deaths"] == 1
+    assert out["gang_reforms"] == 1
+    assert out["gangs_actual"] == 3             # whole again
+    gs = out["gang_summary"]
+    assert gs["gangs"] == 3 and gs["members"] == 12 and gs["live"] == 12
+    two = run_scenario("gang", n_requests=400, replicas=3, seed=7)
+    for k in ("completed", "gang_deaths", "gang_reforms",
+              "sim_seconds"):
+        assert two[k] == out[k], k
+
+
+def test_gang_model_divides_per_token_costs_only():
+    from tfmesos_tpu.fleet.sim import gang_model
+
+    base = ReplicaModel(prefill_ms_per_token=10.0,
+                        decode_ms_per_token=4.0)
+    g = gang_model(base, 4, 0.85)
+    assert g.prefill_ms_per_token == pytest.approx(10.0 / 3.4)
+    assert g.decode_ms_per_token == pytest.approx(4.0 / 3.4)
+    # The per-request base and the whole-artifact KV bytes do NOT
+    # shrink — the slice speeds up compute, not the fixed costs.
+    assert g.prefill_base_ms == base.prefill_base_ms
+    assert g.kv_bytes_per_token == base.kv_bytes_per_token
+    # A 1-gang is the single-process model, and efficiency never makes
+    # a gang SLOWER than one process.
+    assert gang_model(base, 1, 0.85) is base
+    assert gang_model(base, 2, 0.1).decode_ms_per_token \
+        == base.decode_ms_per_token
+
+
+def test_gang_sweep_and_cross_host_knob(sleep_trap):
+    """``--sweep gang_size=...`` flows through apply_override into the
+    gang scenario, and the sessions scenario's cross_host_resume knob
+    models gang-parked sharded sessions landing on a different host
+    (1.0 = today's host-shared tier, exactly the pre-knob behavior)."""
+    rows = run_sweep("gang", "gang_size", ["2", "8"],
+                     n_requests=300, replicas=2, seed=3)
+    assert len(rows) == 2
+    for val, res in rows:
+        assert res["lost"] == 0
+        assert res["gang_size"] == int(val)
+    # The bigger slice decodes faster under the same offered load.
+    assert rows[1][1]["classes"]["interactive"]["p50_ms"] \
+        <= rows[0][1]["classes"]["interactive"]["p50_ms"]
+
+    full = run_scenario("sessions", [("cross_host_resume", "1.0")],
+                        n_requests=400, replicas=3, turns=4, seed=7)
+    assert full["session_tier"]["cross_host_miss"] == 0
+    lossy = run_scenario("sessions", [("cross_host_resume", "0.5")],
+                         n_requests=400, replicas=3, turns=4, seed=7)
+    assert lossy["cross_host_resume"] == 0.5
+    assert lossy["session_tier"]["cross_host_miss"] > 0
+    assert lossy["kv_tier_hit_rate"] < full["kv_tier_hit_rate"]
+    assert lossy["lost"] == 0

@@ -244,6 +244,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "idle models to zero; a :0 entry starts scaled "
                         "to zero and cold-starts through --warm-pool "
                         "(docs/SERVING.md 'Model catalog')")
+    p.add_argument("--gang-size", type=int, default=1,
+                   dest="gang_size", metavar="N",
+                   help="members per UNIFIED replica: each replica is "
+                        "an N-task GANG (one model sharded across a "
+                        "pod slice) placed all-or-nothing and routed "
+                        "as ONE replica via its leader; a member's "
+                        "death tears the gang down and re-forms it "
+                        "whole; 1 = classic single-process replicas "
+                        "(docs/SERVING.md 'Gang replicas')")
     p.add_argument("--warm-pool", type=int, default=0,
                    dest="warm_pool", metavar="N",
                    help="with --models: N pre-warmed UNDEDICATED "
@@ -1084,7 +1093,8 @@ def _build_fleet(args, models, roles, classes, token):
         replicas=args.replicas, rows=args.rows, tiny=args.tiny,
         prefill_replicas=roles.get("prefill", 0),
         decode_replicas=roles.get("decode", 0),
-        models=models, warm_pool=args.warm_pool,
+        models=models, gang_size=args.gang_size,
+        warm_pool=args.warm_pool,
         model_budget=args.model_budget,
         weights_version=args.weights_version,
         autoscale=args.autoscale,

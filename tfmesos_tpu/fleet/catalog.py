@@ -112,13 +112,17 @@ class ModelSpec:
     ``floor`` is the priority floor — trading never shrinks an ACTIVE
     (traffic-bearing) model below it; ``scale_to_zero`` allows an IDLE
     model to drop to zero replicas (its parked sessions stay in the KV
-    tier)."""
+    tier); ``gang_size`` shards each replica of this model across N
+    gang-member tasks (one pod slice presenting as one routable
+    replica) — under the shared budget a gang replica costs N SLOTS,
+    not one."""
 
     model_id: str
     replicas: int = 1
     seed: int = 0
     floor: int = 0
     scale_to_zero: bool = True
+    gang_size: int = 1
 
     def __post_init__(self):
         self.model_id = validate_model_id(self.model_id)
@@ -132,6 +136,10 @@ class ModelSpec:
             raise ValueError(
                 f"model {self.model_id!r}: floor ({self.floor}) "
                 f"exceeds its boot replicas ({self.replicas})")
+        if self.gang_size < 1:
+            raise ValueError(
+                f"model {self.model_id!r}: gang_size must be >= 1, "
+                f"got {self.gang_size}")
 
 
 class ModelCatalog:
@@ -356,34 +364,73 @@ class ModelTrader(FleetAutoscaler):
         if hot:
             # One growth decision per tick, hottest model first — the
             # same one-step-per-tick convergence cadence as the base
-            # loop, which is what bounds trade thrash.
+            # loop, which is what bounds trade thrash.  Budget math is
+            # in SLOTS (member tasks), not replicas: a gang replica of
+            # size N costs N slots, so growing a gang model may need
+            # SEVERAL victims' slots in one trade.
             hot.sort(reverse=True)
             _, _, key = hot[0]
-            total = sum(desired.values())
-            if budget is None or total < budget:
+            need = self._slot_cost(key)
+            total = self._slots(desired)
+            if budget is None or total + need <= budget:
                 desired[key] += 1
                 self._last_up[key] = now
                 self._last_action[key] = "up"
                 self.fleet.metrics.inc("autoscale_up")
             elif now - self._last_trade >= tcfg.trade_cooldown_s:
-                victim = self._free_slot(desired, key, signals)
-                if victim is not None:
-                    desired[victim] -= 1
+                victims = self._free_slots(desired, key, signals,
+                                           need, budget)
+                if victims is not None:
+                    for victim in victims:
+                        desired[victim] -= 1
+                        self._last_down[victim] = now
+                        self._last_action[victim] = f"trade_to:{key}"
                     desired[key] += 1
                     self._last_trade = now
                     self._last_up[key] = now
-                    self._last_down[victim] = now
-                    self._last_action[key] = f"trade_from:{victim}"
-                    self._last_action[victim] = f"trade_to:{key}"
+                    self._last_action[key] = \
+                        f"trade_from:{','.join(victims)}"
                     self.fleet.metrics.inc("model_trades")
                     self.log.info(
-                        "trader: budget tight (%d/%s) — trading one "
-                        "replica %s -> %s", total, budget, victim, key)
+                        "trader: budget tight (%d/%s) — trading %d "
+                        "replica slot(s) %s -> %s", total, budget,
+                        len(victims), victims, key)
                 else:
                     self.fleet.metrics.inc("model_trade_blocked")
         for key, n in desired.items():
             if n != fleet.targets.get(key):
                 fleet.set_target(key, n)
+
+    def _slot_cost(self, key: str) -> int:
+        """Budget slots ONE replica of ``key`` occupies: the model's
+        gang size (a pod-slice replica is N member tasks), 1 for the
+        warm pool and plain tiers."""
+        model, _ = split_key(key)
+        if model in (None, POOL):
+            return 1
+        return int(getattr(self.catalog.get(model),
+                           "gang_size", 1) or 1)
+
+    def _slots(self, desired: Dict[str, int]) -> int:
+        return sum(n * self._slot_cost(k) for k, n in desired.items())
+
+    def _free_slots(self, desired: Dict[str, int], hot_key: str,
+                    signals: Dict[str, Dict[str, Any]], need: int,
+                    budget: int) -> Optional[List[str]]:
+        """Victim keys (one entry per shrunk replica, keys may repeat)
+        whose freed slots make room for one more ``hot_key`` replica
+        of ``need`` slots — or None when the fleet cannot free enough.
+        All-or-nothing: a gang trade that frees only HALF its slots
+        would shrink victims for no growth at all."""
+        work = dict(desired)
+        victims: List[str] = []
+        while self._slots(work) + need > budget:
+            victim = self._free_slot(work, hot_key, signals)
+            if victim is None:
+                return None
+            work[victim] -= 1
+            victims.append(victim)
+        return victims
 
     def _free_slot(self, desired: Dict[str, int], hot_key: str,
                    signals: Dict[str, Dict[str, Any]]
@@ -498,17 +545,19 @@ class ModelTrader(FleetAutoscaler):
             self._idle_ticks[key] = 0
             if self.fleet.targets.get(key, 0) < 1:
                 budget = getattr(self.fleet, "replica_budget", None)
-                total = sum(self.fleet.targets.values())
-                if budget is not None and total >= budget:
-                    victim = self._free_slot(
+                need = self._slot_cost(key)
+                total = self._slots(self.fleet.targets)
+                if budget is not None and total + need > budget:
+                    victims = self._free_slots(
                         dict(self.fleet.targets), key,
-                        self._peek_signals())
-                    if victim is None:
+                        self._peek_signals(), need, budget)
+                    if victims is None:
                         self.fleet.metrics.inc("model_trade_blocked")
                         return False
-                    self.fleet.set_target(
-                        victim, self.fleet.targets[victim] - 1)
-                    self._last_down[victim] = self._clock()
+                    for victim in victims:
+                        self.fleet.set_target(
+                            victim, self.fleet.targets[victim] - 1)
+                        self._last_down[victim] = self._clock()
                     self.fleet.metrics.inc("model_trades")
                 self.fleet.set_target(key, max(1, spec.floor))
                 self.fleet.metrics.inc("model_cold_starts")

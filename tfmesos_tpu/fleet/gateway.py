@@ -190,6 +190,12 @@ class Gateway:
         if hasattr(self.registry, "model_summary"):
             metrics.register_gauge("models",
                                    self.registry.model_summary)
+        # Gang replicas fleet-wide: gang count, member slots, joined
+        # members, and degraded gangs (fewer joined than the mesh
+        # needs) — flat numerics, so the Prometheus exposition carries
+        # every field.
+        if hasattr(self.registry, "gang_summary"):
+            metrics.register_gauge("gangs", self.registry.gang_summary)
         # Items that expired while queued still owe the client an
         # explicit answer — the controller hands them back here from
         # whichever worker's get() swept them.

@@ -69,7 +69,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from tfmesos_tpu.utils.logging import get_logger
 
-__all__ = ["KVTierFull", "KVTierStore"]
+__all__ = ["KVTierFull", "KVTierStore", "pack_gang_shards",
+           "unpack_gang_shards"]
 
 _TAG_LEN = 32
 _LEN = struct.Struct(">I")
@@ -86,6 +87,63 @@ class KVTierFull(RuntimeError):
 def _tag(token: str, payload: bytes) -> bytes:
     return hmac.new(token.encode("utf-8"), payload,
                     hashlib.sha256).digest()
+
+
+def pack_gang_shards(shards: List[Tuple[Dict[str, Any], bytes]]
+                     ) -> Tuple[Dict[str, Any], bytes]:
+    """Fold one gang replica's per-member KV exports into ONE tier
+    artifact: the gang's sharded state parks and re-imports WHOLE —
+    never one member's shard alone, which would resume as silently
+    wrong KV on a gang of a different shape.  The combined meta
+    carries the gang size, each shard's own meta, and the byte splits;
+    the body is the shard bodies concatenated in rank order."""
+    if not shards:
+        raise ValueError("pack_gang_shards needs at least one shard")
+    metas: List[Dict[str, Any]] = []
+    lens: List[int] = []
+    parts: List[bytes] = []
+    for meta, body in shards:
+        metas.append(dict(meta))
+        lens.append(len(body))
+        parts.append(bytes(body))
+    out_meta: Dict[str, Any] = {"gang_size": len(shards),
+                                "shard_meta": metas,
+                                "shard_lens": lens}
+    # The outer stamp mirrors shard 0's: one gang, one weights_version
+    # (the batcher's export stamps every shard identically).
+    for k in ("weights_version", "model_id", "adapter_version"):
+        if k in metas[0]:
+            out_meta[k] = metas[0][k]
+    return out_meta, b"".join(parts)
+
+
+def unpack_gang_shards(meta: Dict[str, Any], body: bytes
+                       ) -> List[Tuple[Dict[str, Any], bytes]]:
+    """Split a :func:`pack_gang_shards` artifact back into rank-order
+    ``(meta, body)`` shards.  Raises ``ValueError`` on any shape
+    mismatch — a torn or truncated gang artifact must read as
+    corruption, never as a smaller gang."""
+    try:
+        size = int(meta["gang_size"])
+        metas = list(meta["shard_meta"])
+        lens = [int(n) for n in meta["shard_lens"]]
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"not a gang artifact: {e}")
+    if size < 1 or len(metas) != size or len(lens) != size \
+            or any(n < 0 for n in lens):
+        raise ValueError(
+            f"gang artifact shape mismatch: size={size}, "
+            f"{len(metas)} metas, {len(lens)} lens")
+    if sum(lens) != len(body):
+        raise ValueError(
+            f"gang artifact truncated: {sum(lens)} bytes declared, "
+            f"{len(body)} present")
+    shards: List[Tuple[Dict[str, Any], bytes]] = []
+    off = 0
+    for rank in range(size):
+        shards.append((dict(metas[rank]), body[off:off + lens[rank]]))
+        off += lens[rank]
+    return shards
 
 
 class KVTierStore:

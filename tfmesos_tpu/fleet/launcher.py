@@ -123,6 +123,7 @@ class FleetServer:
                  prefill_replicas: int = 0,
                  decode_replicas: int = 0,
                  models: Optional[List[ModelSpec]] = None,
+                 gang_size: int = 1,
                  warm_pool: int = 0,
                  model_budget: Optional[int] = None,
                  trader_config: Optional[TraderConfig] = None,
@@ -161,6 +162,20 @@ class FleetServer:
                 f"a lone tier cannot serve the disaggregated handoff "
                 f"(got prefill_replicas={prefill_replicas}, "
                 f"decode_replicas={decode_replicas})")
+        # Gang replicas (docs/SERVING.md "Gang replicas"): each unified
+        # "replica" is N member tasks forming one pod-slice mesh,
+        # scheduled as an atomic gang and routed as ONE replica (the
+        # leader).  gang_size=1 is the classic single-process fleet —
+        # zero behavior change.  Role-split tiers stay single-process
+        # (the disaggregated handoff is a per-request hop, not a mesh).
+        self.gang_size = int(gang_size)
+        if self.gang_size < 1:
+            raise ValueError(
+                f"gang_size must be >= 1, got {gang_size}")
+        if self.gang_size > 1 and (prefill_replicas or decode_replicas):
+            raise ValueError(
+                "gang replicas serve the unified tier; drop "
+                "prefill_replicas/decode_replicas or gang_size")
         # Model catalog (docs/SERVING.md "Model catalog"): with
         # ``models``, the catalog entries size the fleet (each entry's
         # own ``replicas``), a ``warm_pool`` of undedicated pre-warmed
@@ -182,7 +197,9 @@ class FleetServer:
                     "a model catalog runs unified tiers; drop "
                     "prefill_replicas/decode_replicas")
             self.catalog = ModelCatalog(models)
-            boot = sum(s.replicas for s in self.catalog)
+            # Budget math is in SLOTS (member tasks): a gang replica
+            # of size N occupies N of them.
+            boot = sum(s.replicas * s.gang_size for s in self.catalog)
             if boot + self.warm_pool < 1:
                 raise ValueError(
                     "the catalog fleet needs at least one replica: "
@@ -364,6 +381,12 @@ class FleetServer:
         #: when every model's tasks share one scheduler job.  Updated
         #: at launch and on warm-pool adoption.
         self._node_keys: Dict[str, str] = {}
+        #: gang id -> {key, job, size, task_ids, leader_node,
+        #: weights_version}.  The gang book: popped on the FIRST member
+        #: death (or a deliberate kill) so sibling deaths and racing
+        #: reforms dedup to exactly one action per gang.
+        self._gangs: Dict[str, dict] = {}
+        self._gang_lock = threading.Lock()
         self._started = False
 
     # -- bring-up ----------------------------------------------------------
@@ -484,6 +507,10 @@ class FleetServer:
                 [], dynamic=True, backend=self.backend, master=self.master,
                 quiet=self.quiet, start_timeout=self.start_timeout,
                 token=self.token)
+            # A gang member's death is the GANG's death: the scheduler
+            # reports it (off its status thread) and the fleet tears
+            # down the siblings and re-forms the gang whole.
+            self.scheduler.on_dynamic_death = self._on_dynamic_death
             self.scheduler.start()
             if self.catalog is not None:
                 # Per-(model, tier) targets + the warm pool, all under
@@ -565,13 +592,31 @@ class FleetServer:
         return self.min_replicas, self._tier_max.get(key,
                                                      self.max_replicas)
 
+    def gang_size_for(self, key: str) -> int:
+        """How many member tasks one replica of ``key`` launches as:
+        the catalog entry's ``gang_size`` for model keys, the fleet's
+        for the unified tier, and always 1 for role-split tiers and
+        the warm pool (a pool replica has no model to shard yet)."""
+        model, role = split_key(key)
+        if model == POOL:
+            return 1
+        if model is not None:
+            return int(getattr(self.catalog.get(model),
+                               "gang_size", 1) or 1)
+        return self.gang_size if role == UNIFIED else 1
+
     def launch_replica(self, key: str,
                        weights_version: Optional[str] = None) -> str:
-        """Launch ONE new Mode-B replica task for ``key`` — a plain
+        """Launch ONE new Mode-B replica for ``key`` — a plain
         role, a composite ``"<model>/<role>"``, or the warm pool's
         :data:`POOL_KEY` — and return its node id ("job:index"); with
         ``--warmup`` on the cmd line it registers ``warming`` and
-        never takes traffic cold."""
+        never takes traffic cold.  With a gang size > 1 the "replica"
+        is a whole gang (N tasks, one routable leader) and the node id
+        is the LEADER's."""
+        size = self.gang_size_for(key)
+        if size > 1:
+            return self.launch_gang(key, weights_version, size)
         model, role = split_key(key)
         spec = None
         pool = model == POOL
@@ -587,12 +632,56 @@ class FleetServer:
         self._node_keys[node] = key
         return node
 
+    def launch_gang(self, key: str,
+                    weights_version: Optional[str] = None,
+                    size: Optional[int] = None) -> str:
+        """Launch one GANG replica for ``key``: N identical member
+        cmds enter the scheduler as an atomic all-or-nothing gang
+        (the gang env contract — id/size/rank — is stamped by
+        ``add_gang``), rank 0 leads and registers as the one routable
+        node this method returns."""
+        size = self.gang_size_for(key) if size is None else int(size)
+        model, role = split_key(key)
+        spec = None
+        if model is not None and model != POOL:
+            spec = self.catalog.get(model)
+        job = TIER_JOBS[role]
+        cmd = self._replica_cmd(role, weights_version, model=spec)
+        members = self.scheduler.add_gang(
+            job, [cmd] * size, cpus=self.replica_cpus,
+            mem=self.replica_mem, chips=self.replica_chips)
+        gang_id = members[0].gang
+        node = f"{job}:{members[0].task_index}"
+        with self._gang_lock:
+            self._gangs[gang_id] = {
+                "key": key, "job": job, "size": size,
+                "task_ids": [t.id for t in members],
+                "leader_node": node,
+                "weights_version": weights_version}
+        self._node_keys[node] = key
+        return node
+
     def kill_replica(self, node: str) -> bool:
-        """Kill one replica task by its node id ("job:index")."""
+        """Kill one replica by its node id ("job:index").  A gang
+        leader's node kills the WHOLE gang — members without a leader
+        are not a smaller replica, they are debris."""
         # The node->key book entry dies with the task either way — a
         # churning trader (trade = kill + relaunch per cooldown) must
         # not grow the book, and tier_actual scans it per tick.
         self._node_keys.pop(node, None)
+        with self._gang_lock:
+            gang_id = next(
+                (g for g, info in self._gangs.items()
+                 if info["leader_node"] == node), None)
+            info = self._gangs.pop(gang_id, None) if gang_id else None
+        if info is not None:
+            # remove_task pulls each member from the table BEFORE the
+            # kill, so the sibling deaths report under unknown ids and
+            # never re-enter the gang-death path.
+            killed = False
+            for tid in info["task_ids"]:
+                killed = self.scheduler.remove_task(tid) or killed
+            return killed
         job, _, idx = node.rpartition(":")
         try:
             task = self.scheduler.task_by_index(job, int(idx))
@@ -602,15 +691,64 @@ class FleetServer:
             return False
         return self.scheduler.remove_task(task.id)
 
+    def _on_dynamic_death(self, task) -> None:
+        """Scheduler death hook (on its own thread, never the status
+        thread): a gang member died, so tear the gang down whole and
+        re-form it under a FRESH generation and a fresh gang id — the
+        double fence that makes a zombie member of the dead gang
+        unroutable forever (its gang_lookup never resolves, and the
+        new leader rejects joins of any other (gang, generation))."""
+        gang_id = getattr(task, "gang", None)
+        if gang_id is None:
+            return
+        with self._gang_lock:
+            info = self._gangs.pop(gang_id, None)
+        if info is None:
+            return      # sibling already took the gang down
+        self._node_keys.pop(info["leader_node"], None)
+        for tid in info["task_ids"]:
+            if tid == task.id:
+                continue
+            try:
+                self.scheduler.remove_task(tid)
+            except Exception as e:
+                self.log.warning("gang %s sibling %s teardown failed: "
+                                 "%s", gang_id, tid, e)
+        if not self._started or self.scheduler is None:
+            return
+        try:
+            self.scheduler.bump_generation()
+            node = self.launch_gang(info["key"],
+                                    info.get("weights_version"),
+                                    info["size"])
+            if self.metrics is not None:
+                self.metrics.inc("gang_reforms")
+            self.log.warning(
+                "gang %s lost a member; torn down and re-forming as "
+                "%s (leader %s)", gang_id, info["key"], node)
+        except Exception:
+            self.log.exception("gang %s re-form failed; the "
+                               "convergence loop will retry", gang_id)
+
     def tier_actual(self, key: str) -> int:
         """Live tasks launched for one tier (registered or not) — the
-        convergence loops' notion of "actual".  Composite keys count
-        through the node->key map intersected with the scheduler's
-        live task table (all models share one job)."""
+        convergence loops' notion of "actual".  A gang counts as ONE
+        unit (its N member tasks are one replica).  Composite keys
+        count through the node->key map intersected with the
+        scheduler's live task table (all models share one job)."""
         model, role = split_key(key)
         job = TIER_JOBS[role]
         if model is None:
-            return len(self.scheduler.tasks_of(job))
+            loose, gangs = 0, set()
+            for t in self.scheduler.tasks_of(job):
+                gang_id = getattr(t, "gang", None)
+                if gang_id is None:
+                    loose += 1
+                else:
+                    gangs.add(gang_id)
+            return loose + len(gangs)
+        # Only gang LEADERS enter the node->key book, so the
+        # intersection already counts a gang once.
         live = {f"{job}:{t.task_index}"
                 for t in self.scheduler.tasks_of(job)}
         return sum(1 for node, k in self._node_keys.items()
@@ -917,9 +1055,23 @@ class FleetServer:
             # registry's node field maps members back; the scheduler
             # table diff catches launched-but-never-registered ones).
             new_set = {node for _, node in new_nodes}
+            # Gang-aware reap: a NEW gang's members carry node ids that
+            # never entered new_nodes (only the leader did) — keep any
+            # task whose gang's leader is new; reap old gangs whole and
+            # drop their book entries so no death hook re-forms them.
+            with self._gang_lock:
+                keep_gangs = {g for g, info in self._gangs.items()
+                              if info["leader_node"] in new_set}
+                for g in [g for g, info in self._gangs.items()
+                          if g not in keep_gangs
+                          and info["job"] in {TIER_JOBS[r]
+                                              for r in managed_roles}]:
+                    del self._gangs[g]
             reaped = 0
             for job in {TIER_JOBS[r] for r in managed_roles}:
                 for t in self.scheduler.tasks_of(job):
+                    if getattr(t, "gang", None) in keep_gangs:
+                        continue
                     node = f"{job}:{t.task_index}"
                     if node not in new_set:
                         self.scheduler.remove_task(t.id)
@@ -984,8 +1136,13 @@ class FleetServer:
             self.router.close()
             self.router = None
         if self.scheduler is not None:
+            # Teardown kills are deliberate: no gang death hook may
+            # re-form what stop() is reaping.
+            self.scheduler.on_dynamic_death = None
             self.scheduler.stop()
             self.scheduler = None
+        with self._gang_lock:
+            self._gangs.clear()
         if self.registry is not None:
             self.registry.stop()
             self.registry = None

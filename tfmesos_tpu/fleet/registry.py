@@ -149,6 +149,18 @@ class ReplicaInfo:
     model_id: str = ""
     warm_pool: bool = False
     adapter_version: str = ""
+    # Gang replicas (docs/SERVING.md "Gang replicas"), all heartbeat
+    # fields carried in one ``gang`` dict on the LEADER's beats: the
+    # gang's launch label (scheduler add_gang identity), how many
+    # member tasks form the mesh (1 = the single-process replica of
+    # old), how many members are currently joined to the leader
+    # (-1 = never advertised), and the leader's member-rendezvous
+    # address — what ``gang_lookup`` hands a booting member.  The
+    # fleet routes to the LEADER only; members never register here.
+    gang_id: str = ""
+    gang_size: int = 1
+    gang_live: int = -1
+    gang_coord: str = ""
 
 
 def _advertises_prefix(rep: "ReplicaInfo") -> int:
@@ -266,6 +278,17 @@ class ReplicaRegistry:
         frame (wrong token, oversize) never reaches here — the
         WireServer's Framer rejects it and drops the connection, same
         pre-auth discipline as the threaded loop had."""
+        if isinstance(msg, dict) and msg.get("op") == "gang_lookup":
+            # Member rendezvous: a booting gang member polls for its
+            # leader's coordination address (the leader advertises it
+            # in the ``gang`` field of its beats).  Served on the
+            # heartbeat socket — the one address every launched task
+            # already knows.
+            try:
+                conn.send(self.gang_lookup(msg.get("gang_id")))
+            except Exception as e:
+                self.log.warning("gang_lookup reply failed: %s", e)
+            return
         addr = self.observe(msg, conn)
         if addr is not None:
             # Remember which replica this connection speaks for, so its
@@ -453,6 +476,32 @@ class ReplicaRegistry:
                     rep.kv_headroom = int(msg["kv_headroom"])
                 except (TypeError, ValueError):
                     pass    # a bad field never costs the beat
+            raw_gang = msg.get("gang")
+            if isinstance(raw_gang, dict):
+                # Gang identity rides the leader's beats as one dict;
+                # each sub-field is optional and a malformed sub-field
+                # costs THAT field, never the beat (the PR 4/5
+                # convention).  live is clamped to [0, size] — a leader
+                # cannot advertise more joined members than the gang
+                # has.
+                gid = raw_gang.get("id")
+                if isinstance(gid, str) and len(gid) <= 128:
+                    rep.gang_id = gid
+                try:
+                    size = int(raw_gang["size"])
+                    if size >= 1:
+                        rep.gang_size = size
+                except (KeyError, TypeError, ValueError):
+                    pass
+                try:
+                    live = int(raw_gang["live"])
+                    if live >= 0:
+                        rep.gang_live = min(live, rep.gang_size)
+                except (KeyError, TypeError, ValueError):
+                    pass
+                coord = raw_gang.get("coord")
+                if isinstance(coord, str) and len(coord) <= 128:
+                    rep.gang_coord = coord
             rep.last_beat = self._clock()
             if conn is not None:
                 self._conns[addr] = conn
@@ -600,8 +649,16 @@ class ReplicaRegistry:
                                    {"alive": 0, "warming": 0,
                                     "draining": 0, "dead": 0,
                                     "outstanding": 0, "kv_headroom": 0,
-                                    "versions": {}})
+                                    "versions": {}, "gangs": 0,
+                                    "gang_members": 0, "gang_live": 0})
                 d[rep.state] = d.get(rep.state, 0) + 1
+                if rep.gang_size > 1:
+                    # Gang replicas: one table entry = one leader = N
+                    # member tasks; the member-liveness sum is what an
+                    # operator watches during a re-form.
+                    d["gangs"] += 1
+                    d["gang_members"] += rep.gang_size
+                    d["gang_live"] += max(0, rep.gang_live)
                 if rep.state == ALIVE:
                     d["outstanding"] += rep.outstanding
                     if rep.kv_headroom > 0:
@@ -616,9 +673,58 @@ class ReplicaRegistry:
                                           "draining": 0, "dead": 0,
                                           "outstanding": 0,
                                           "kv_headroom": 0,
-                                          "versions": {}})
+                                          "versions": {}, "gangs": 0,
+                                          "gang_members": 0,
+                                          "gang_live": 0})
                 d["target"] = target
         return out
+
+    def gang_lookup(self, gang_id) -> Dict[str, Any]:
+        """Resolve one gang's leader-coordination address and launch
+        generation (the member-rendezvous reply).  ``found`` stays
+        False until the leader's first coord-bearing beat lands — a
+        booting member polls."""
+        out: Dict[str, Any] = {"op": "gang_info",
+                               "gang_id": gang_id if isinstance(
+                                   gang_id, str) else "",
+                               "found": False}
+        if not isinstance(gang_id, str) or not gang_id:
+            return out
+        with self._lock:
+            for rep in self._table.values():
+                if (rep.gang_id == gang_id and rep.gang_coord
+                        and rep.state != DEAD):
+                    out.update(found=True, coord=rep.gang_coord,
+                               gen=rep.gen, size=rep.gang_size)
+                    break
+        return out
+
+    def gang_summary(self) -> Dict[str, Any]:
+        """Fleet-wide gang aggregate (the gateway's ``gangs`` gauge —
+        a FLAT numeric dict, because the Prometheus exposition only
+        flattens one label level): how many gang replicas the table
+        holds, their summed member slots, how many members are
+        currently joined, and how many gangs run degraded (fewer
+        members joined than the mesh needs — the window between a
+        member death and the teardown/re-form)."""
+        agg = {"gangs": 0, "members": 0, "live": 0, "warming": 0,
+               "degraded": 0}
+        with self._lock:
+            for rep in self._table.values():
+                if rep.gang_size <= 1 or rep.state == DEAD:
+                    # A dead gang is debris awaiting eviction, not a
+                    # serving gang the gauge should count.
+                    continue
+                agg["gangs"] += 1
+                agg["members"] += rep.gang_size
+                live = max(0, rep.gang_live)
+                agg["live"] += live
+                if rep.state == WARMING:
+                    agg["warming"] += 1
+                if rep.state in (ALIVE, WARMING) \
+                        and live < rep.gang_size:
+                    agg["degraded"] += 1
+        return agg
 
     def kv_tier_summary(self) -> Dict[str, Any]:
         """Fleet-wide KV-tier aggregate (the gateway's ``kv_tier``

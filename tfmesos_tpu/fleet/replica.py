@@ -790,6 +790,128 @@ def flagship_draft_model(seed: int = 1, max_len: int = 1024,
     return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
 
 
+# -- batcher assembly --------------------------------------------------------
+
+
+def rid_seed_for_node(node: str) -> int:
+    """Per-replica request-id stream base, derived from the fleet node
+    id ("job:index").  Sampled draws are pure (rid, step) key folds, so
+    two replicas whose rids collide would draw IDENTICAL sampling
+    streams — cross-exporter sampled artifacts must never share one
+    (the PR 4 caveat, now closed).  A 20-bit CRC of the node id shifted
+    10 bits gives distinct nodes disjoint 1024-rid blocks, stays int32-
+    safe with ~2^30 of increment headroom, and leaves the node-less
+    (direct/test) replica at the historical 0 base."""
+    if not node:
+        return 0
+    import zlib
+
+    return (zlib.crc32(node.encode("utf-8")) & 0xFFFFF) << 10
+
+
+def build_batcher(args, token: str, generation: int, node: str = "",
+                  with_kv_tier: bool = True):
+    """Assemble the model + ContinuousBatcher one serving process runs —
+    shared by the single-process replica, the gang LEADER (which owns
+    the gang's batcher), and gang MEMBERS (which mirror-execute with an
+    identical build, minus the KV tier: parking a session N times over
+    would corrupt the economy's accounting).  Split out of ``main()``
+    so one process == one replica is an entry-point choice, not a
+    structural assumption."""
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    build_seed = args.model_seed if args.model_seed is not None \
+        else args.seed
+    if args.tiny:
+        cfg, params = tiny_model(build_seed)
+    else:
+        cfg, params = flagship_model(build_seed,
+                                     max_len=args.max_len or 1024)
+    draft_cfg = draft_params = None
+    if args.draft:
+        max_len = args.max_len or int(cfg.max_seq_len)
+        if args.tiny:
+            draft_cfg, draft_params = tiny_draft_model(
+                max_len=max_len, n_draft=args.n_draft)
+        else:
+            draft_cfg, draft_params = flagship_draft_model(
+                seed=args.seed + 1, max_len=max_len,
+                n_draft=args.n_draft)
+    kv_tier = None
+    if with_kv_tier and (args.kv_tier_mb > 0 or args.kv_tier_dir):
+        from tfmesos_tpu.fleet.kvtier import KVTierStore
+
+        # The store is stamped with this replica's rollout identity:
+        # a parked artifact from another weights_version (a pre-rollout
+        # entry in a shared disk dir) reads as a miss, never stale KV.
+        # The MODEL composes into the stamp — two models' replicas may
+        # share one host disk tier, and a session parked by model A
+        # must read as a version miss to model B, never as its KV.
+        wv_stamp = args.weights_version
+        if args.model_id:
+            wv_stamp = f"{args.weights_version or 'v0'}@{args.model_id}"
+        kv_tier = KVTierStore(
+            ram_bytes=int(max(0.0, args.kv_tier_mb) * 1e6),
+            disk_dir=args.kv_tier_dir, token=token,
+            stamp={"weights_version": wv_stamp,
+                   "gen": generation})
+    return ContinuousBatcher(
+        cfg, params, rows=args.rows, max_len=args.max_len,
+        page_size=args.page_size, prefill_bucket=args.prefill_bucket,
+        multi_step=args.multi_step,
+        prefix_cache_pages=args.prefix_cache_pages,
+        pipeline_depth=args.pipeline_depth, kv_tier=kv_tier,
+        draft_cfg=draft_cfg, draft_params=draft_params,
+        n_draft=args.n_draft, rid_seed=rid_seed_for_node(node))
+
+
+def _gang_member_main(args, token: str, spec, generation: int) -> int:
+    """A gang MEMBER process (rank >= 1): no serve socket, no registry
+    heartbeat — its whole life is the leader's dispatch loop (see
+    :mod:`tfmesos_tpu.fleet.gang`).  Mirror-executes each dispatched
+    request on an identical batcher build and acks the token digest;
+    exits when the leader does (a gang lives and dies whole)."""
+    from tfmesos_tpu.fleet import gang as gang_mod
+
+    gid, size, rank = spec
+    log = get_logger("tfmesos_tpu.fleet.gang")
+    if not args.registry:
+        print("gang member needs --registry for leader rendezvous",
+              file=sys.stderr)
+        return 2
+    batcher = build_batcher(args, token, generation,
+                            with_kv_tier=False)
+
+    import numpy as np
+
+    from tfmesos_tpu.serving import Request
+
+    def execute(head) -> List[int]:
+        req = Request(
+            prompt=np.asarray(head.get("prompt"), np.int32),
+            max_new_tokens=int(head.get("max_new_tokens") or 0),
+            stop_token=head.get("stop_token"))
+        comps = list(batcher.run([req]))
+        return [int(t) for t in comps[0].tokens] if comps else []
+
+    if args.warmup:
+        info = batcher.warmup(decode=True, prefill=True)
+        log.info("gang member rank %d warmed in %.1fs", rank,
+                 info["seconds"])
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+    signal.signal(signal.SIGINT, lambda s, f: stop.set())
+    member = gang_mod.GangMember(gid, size, rank, generation,
+                                 args.registry, token=token,
+                                 execute=execute)
+    print(f"gang member rank {rank}/{size} serving gang {gid}",
+          flush=True)
+    reason = member.run(stop)
+    log.info("gang member rank %d exiting: %s (%d served)", rank,
+             reason, member.served)
+    return 0 if reason == "stopped" else 1
+
+
 # -- process entry ----------------------------------------------------------
 
 
@@ -918,7 +1040,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     idx = os.environ.get("TPUMESOS_TASK_INDEX", "")
     node = f"{job}:{idx}" if job and idx != "" else ""
 
-    from tfmesos_tpu.serving import ContinuousBatcher
+    # Gang identity (docs/SERVING.md "Gang replicas"): when this
+    # process was launched as one task of an N-task gang, rank 0 is
+    # the LEADER — the one process that owns the fleet identity below —
+    # and every other rank is a member whose whole life is the leader's
+    # dispatch loop.
+    from tfmesos_tpu.fleet import gang as gang_mod
+
+    gang_spec = gang_mod.read_gang_env()
+    if gang_spec is not None and gang_spec[2] > 0:
+        return _gang_member_main(args, token, gang_spec, generation)
 
     # Model-catalog identity: --model-id names the catalog entry this
     # replica serves (seeded by --model-seed), --warm-pool starts it
@@ -938,49 +1069,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "warm_pool": bool(args.warm_pool),
         "pool_capable": bool(args.warm_pool),
     }
-    build_seed = args.model_seed if args.model_seed is not None \
-        else args.seed
-    if args.tiny:
-        cfg, params = tiny_model(build_seed)
-    else:
-        cfg, params = flagship_model(build_seed,
-                                     max_len=args.max_len or 1024)
-    draft_cfg = draft_params = None
-    if args.draft:
-        max_len = args.max_len or int(cfg.max_seq_len)
-        if args.tiny:
-            draft_cfg, draft_params = tiny_draft_model(
-                max_len=max_len, n_draft=args.n_draft)
-        else:
-            draft_cfg, draft_params = flagship_draft_model(
-                seed=args.seed + 1, max_len=max_len,
-                n_draft=args.n_draft)
-    kv_tier = None
-    if args.kv_tier_mb > 0 or args.kv_tier_dir:
-        from tfmesos_tpu.fleet.kvtier import KVTierStore
+    batcher = build_batcher(args, token, generation, node=node)
 
-        # The store is stamped with this replica's rollout identity:
-        # a parked artifact from another weights_version (a pre-rollout
-        # entry in a shared disk dir) reads as a miss, never stale KV.
-        # The MODEL composes into the stamp — two models' replicas may
-        # share one host disk tier, and a session parked by model A
-        # must read as a version miss to model B, never as its KV.
-        wv_stamp = args.weights_version
-        if args.model_id:
-            wv_stamp = f"{args.weights_version or 'v0'}@{args.model_id}"
-        kv_tier = KVTierStore(
-            ram_bytes=int(max(0.0, args.kv_tier_mb) * 1e6),
-            disk_dir=args.kv_tier_dir, token=token,
-            stamp={"weights_version": wv_stamp,
-                   "gen": generation})
-    batcher = ContinuousBatcher(
-        cfg, params, rows=args.rows, max_len=args.max_len,
-        page_size=args.page_size, prefill_bucket=args.prefill_bucket,
-        multi_step=args.multi_step,
-        prefix_cache_pages=args.prefix_cache_pages,
-        pipeline_depth=args.pipeline_depth, kv_tier=kv_tier,
-        draft_cfg=draft_cfg, draft_params=draft_params,
-        n_draft=args.n_draft)
     def adopt_fn(head, reply) -> None:
         """The ``adopt`` control op: install one catalog model's
         weights on this (pre-warmed, undedicated) replica.  Same
@@ -1045,6 +1135,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   model_state=model_state,
                                   adopt_fn=adopt_fn)
 
+    stop = threading.Event()
+    leader = None
+    if gang_spec is not None:
+        # Rank 0 leads: it owns the batcher, the serve socket, and the
+        # registry heartbeat; the gang coordination server fans each
+        # generate to the members and verifies their token digests.  A
+        # member loss breaks the gang — stop fires, the process exits,
+        # and the fleet tears down and re-forms the gang whole.
+        if args.role == "prefill":
+            print("gang replicas serve the decode/unified path; "
+                  "--role prefill cannot lead a gang", file=sys.stderr)
+            return 2
+        leader = gang_mod.GangLeader(
+            gang_spec[0], gang_spec[1], generation=generation,
+            token=token, host=args.host,
+            on_break=lambda rank: stop.set())
+        leader.start()
+        handler = gang_mod.leader_handler(handler, leader)
+
     def extra() -> Dict[str, Any]:
         # Heartbeat advert: the tier this replica belongs to and its
         # live KV headroom (decode-tier routing places imports by it),
@@ -1088,13 +1197,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "committed": batcher.spec_committed,
                 "n_draft": batcher.n_draft,
             }
+        if leader is not None:
+            # Gang identity + member liveness: what role_summary / the
+            # gangs gauge report, and what gang_lookup serves booting
+            # members (the registry-mediated rendezvous).
+            beat["gang"] = leader.gang_info()
         return beat
 
     server = ReplicaServer(
         handler, token=token, capacity=args.rows,
         host=args.host, port=args.port, registry_addr=args.registry,
         heartbeat_interval=args.heartbeat_interval, extra_info=extra,
-        status="warming" if args.warmup else None)
+        status="warming" if (args.warmup or leader is not None)
+        else None)
     # Register (as warming with --warmup) BEFORE compiling: the fleet's
     # bring-up accounting sees the replica exists while the router
     # cannot yet pick it, and a relaunched replica is visibly re-warming
@@ -1114,11 +1229,23 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"({len(info['compiled'])} entry points)", flush=True)
     if serving is not None:
         serving.start()
+    if leader is not None:
+        # Never routed while forming: the leader stays 'warming' until
+        # every member has joined.  A gang that cannot form exits
+        # nonzero — the scheduler reports the death and the fleet
+        # re-forms the gang whole rather than serving degraded.
+        if not leader.wait_formed(timeout=300.0) or leader.broken:
+            log.error("gang %s never formed (%d/%d live); exiting",
+                      leader.gang_id, leader.live, leader.size)
+            server.stop()
+            leader.stop()
+            return 1
+        print(f"gang {leader.gang_id} formed "
+              f"({leader.size} members, generation {generation})",
+              flush=True)
     server.set_status(None)     # routable: the next beat drops 'warming'
     print(f"replica serving on {server.addr} (role {args.role})",
           flush=True)
-
-    stop = threading.Event()
 
     def on_signal(signum, frame) -> None:
         log.info("signal %d: draining", signum)
@@ -1127,10 +1254,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
     stop.wait()
+    broken = leader is not None and leader.broken
     server.stop()
+    if leader is not None:
+        leader.stop()
     if serving is not None:
         serving.close()
-    return 0
+    # A gang break exits nonzero: the death must read as a failure to
+    # the scheduler's dynamic accounting, not a graceful finish.
+    return 1 if broken else 0
 
 
 if __name__ == "__main__":

@@ -319,6 +319,22 @@ class ReplicaModel:
         return ttft / 1000.0, total / 1000.0
 
 
+def gang_model(base: ReplicaModel, size: int,
+               efficiency: float) -> ReplicaModel:
+    """The latency model of a GANG replica: N members SPMD-execute
+    each batch, so per-token compute divides by the slice's effective
+    speedup (``size × efficiency`` — collectives eat the rest); the
+    per-request base overhead and the whole-artifact KV bytes do not
+    shrink (the gang's sharded export parks as one artifact)."""
+    if size <= 1:
+        return base
+    speed = max(1.0, size * efficiency)
+    return dataclasses.replace(
+        base,
+        prefill_ms_per_token=base.prefill_ms_per_token / speed,
+        decode_ms_per_token=base.decode_ms_per_token / speed)
+
+
 class SimReplica:
     """One simulated replica: a ``capacity``-server FIFO queue over a
     latency model, plus the failure-script knobs the scenarios twist
@@ -330,6 +346,7 @@ class SimReplica:
                  "gen", "node", "warm_until", "down", "removed",
                  "migrating", "slow_factor", "error_rate", "sever_next",
                  "drop_beats", "kv_pages", "served", "model_id", "pool",
+                 "gang_size", "gang_live",
                  "_servers", "_inflight", "_pending")
 
     def __init__(self, addr: str, role: str = UNIFIED, capacity: int = 4,
@@ -337,7 +354,7 @@ class SimReplica:
                  weights_version: str = "v1", gen: int = 0,
                  node: str = "", warm_until: float = 0.0,
                  kv_pages: int = 64, model_id: str = "",
-                 pool: bool = False):
+                 pool: bool = False, gang_size: int = 1):
         self.addr = addr
         self.role = role
         self.capacity = int(capacity)
@@ -359,6 +376,11 @@ class SimReplica:
         # warm-pool membership (undedicated; adoption flips both).
         self.model_id = model_id
         self.pool = bool(pool)
+        # Gang replicas: >1 means this sim replica stands for a whole
+        # N-member pod-slice gang (one routable leader); its beats
+        # carry the gang field the real registry parses.
+        self.gang_size = max(1, int(gang_size))
+        self.gang_live = self.gang_size
         self._servers = [0.0] * self.capacity     # per-slot free-at
         self._inflight: List[float] = []          # finish times
         self._pending: List[list] = []            # live call records
@@ -444,10 +466,18 @@ class SimTransport:
         # sessions, and a replica death does not lose it), mapping
         # session id -> (covered tokens, weights_version).  A resume
         # only counts when the versions match — the rollout fence.
-        self.session_tier: Dict[str, Tuple[int, str]] = {}
+        self.session_tier: Dict[str, Tuple[int, str, str]] = {}
         self.session_stats = {"hits": 0, "misses": 0, "park": 0,
                               "resume": 0, "version_miss": 0,
+                              "cross_host_miss": 0,
                               "ttft_hit_ms": 0.0, "ttft_cold_ms": 0.0}
+        # Cross-host placement knob (gang-parked sharded sessions):
+        # the probability a parked artifact resumes on a replica OTHER
+        # than its parker — 1.0 is the host-shared disk tier (today's
+        # behavior, everything resumable), lower models fleets whose
+        # gang artifacts live host-local and a cross-host landing
+        # re-prefills cold.
+        self.cross_host_resume = 1.0
 
     def link(self, addr: str) -> _SimLink:
         rep = self.replicas.get(addr)
@@ -532,7 +562,15 @@ class SimTransport:
             st = self.session_stats
             ent = self.session_tier.get(sid)
             if ent is not None and 0 < ent[0] < prompt_len:
-                if ent[1] == rep.weights_version:
+                parker = ent[2] if len(ent) > 2 else ""
+                if parker and parker != rep.addr \
+                        and self.cross_host_resume < 1.0 \
+                        and rng.random() >= self.cross_host_resume:
+                    # Landed off the parker's host and the artifact
+                    # did not travel: a counted cold re-prefill.
+                    st["cross_host_miss"] += 1
+                    st["misses"] += 1
+                elif ent[1] == rep.weights_version:
                     session_hit = True
                     eff_prompt = prompt_len - ent[0]
                     st["hits"] += 1
@@ -595,7 +633,7 @@ class SimTransport:
                     # input, like the real artifact's history).
                     self.session_tier[sid] = (
                         prompt_len + new_tokens - 1,
-                        rep.weights_version)
+                        rep.weights_version, rep.addr)
                     st = self.session_stats
                     st["park"] += 1
                     st["ttft_hit_ms" if session_hit
@@ -667,6 +705,18 @@ class SimConfig:
     # round-robin across live fronts like clients spreading
     # connections).  1 = the classic single-gateway topology, exactly.
     gateways: int = 1
+    # Gang replicas (the ``gang`` scenario; sweep ``gang_size=2,4,8``):
+    # each unified replica stands for an N-member pod-slice gang —
+    # per-token compute divides by size × gang_efficiency, a member's
+    # death is the gang's death, and the fleet re-forms it whole after
+    # gang_reform_s (launch + rendezvous + re-warm).
+    gang_size: int = 1
+    gang_efficiency: float = 0.85
+    gang_reform_s: float = 2.0
+    # Cross-host resume probability for parked sessions (the sessions
+    # scenario's gang-parked-shard knob; sweep ``cross_host_resume=
+    # 1.0,0.5,0.0``).  1.0 = the host-shared tier, exactly.
+    cross_host_resume: float = 1.0
     workers: int = 8
     max_queue: int = DEFAULT_MAX_QUEUE
     rate_limit: Optional[float] = None
@@ -869,17 +919,22 @@ class FleetSim:
                     model: Optional[ReplicaModel] = None,
                     weights_version: Optional[str] = None,
                     warm_s: float = 0.0, model_id: str = "",
-                    pool: bool = False) -> SimReplica:
+                    pool: bool = False,
+                    gang_size: Optional[int] = None) -> SimReplica:
         self._next_rid += 1
         i = self._next_rid
+        size = self.cfg.gang_size if gang_size is None else int(gang_size)
+        base = model or self.cfg.model
+        if size > 1:
+            base = gang_model(base, size, self.cfg.gang_efficiency)
         rep = SimReplica(
             addr=f"sim-{role[:3]}-{i}", role=role,
             capacity=capacity if capacity is not None else self.cfg.capacity,
-            model=model or self.cfg.model,
+            model=base,
             weights_version=weights_version or self.cfg.weights_version,
             node=f"sim:{i}", kv_pages=self.cfg.kv_pages,
             warm_until=self.engine.clock.now + warm_s,
-            model_id=model_id, pool=pool)
+            model_id=model_id, pool=pool, gang_size=size)
         self.transport.replicas[rep.addr] = rep
         self._beat(rep)
         return rep
@@ -901,6 +956,13 @@ class FleetSim:
                 # Like the real replica: pool-capable processes always
                 # send the flag, so an adoption's False overwrites.
                 msg["warm_pool"] = rep.pool
+            if rep.gang_size > 1:
+                # The leader-only gang beat field the real registry
+                # parses into ReplicaInfo.gang_* / gang_summary().
+                msg["gang"] = {"id": f"sim/{rep.node}",
+                               "size": rep.gang_size,
+                               "live": rep.gang_live,
+                               "coord": rep.addr}
             if rep.role == DECODE:
                 msg["kv_headroom"] = max(
                     0, rep.kv_pages - rep.outstanding(now))
@@ -915,6 +977,32 @@ class FleetSim:
         notices through the router's mark_dead or the sweep."""
         rep.down = True
         self.transport.fail_pending(rep)
+
+    def kill_gang_member(self, rep: SimReplica) -> Optional[SimReplica]:
+        """SIGKILL one MEMBER of a gang replica: the gang dies whole
+        (the leader tears down; pending calls fail now and replay on
+        survivors), and the fleet re-forms it — a fresh gang, fresh
+        rendezvous, re-warm — after ``cfg.gang_reform_s``.  Returns
+        the dying replica (the re-formed one appears asynchronously)."""
+        if rep.gang_size <= 1 or rep.down or rep.removed:
+            return None
+        rep.gang_live = rep.gang_size - 1
+        self.kill(rep)
+        rep.removed = True
+        self.metrics.inc("gang_deaths")
+        role, size = rep.role, rep.gang_size
+        wv, mid = rep.weights_version, rep.model_id
+
+        def reform() -> None:
+            if self._stopped:
+                return
+            self.add_replica(role=role, gang_size=size,
+                             warm_s=self.cfg.warmup_s,
+                             weights_version=wv, model_id=mid)
+            self.metrics.inc("gang_reforms")
+
+        self.engine.after(self.cfg.gang_reform_s, reform)
+        return rep
 
     def _schedule_sweep(self) -> None:
         if self._stopped:
@@ -1874,6 +1962,10 @@ def scenario_sessions(overrides=(), n_requests: Optional[int] = None,
     cfg.workers = max(cfg.workers,
                       min(256, 2 * cfg.replicas * cfg.capacity))
     sim = FleetSim(cfg)
+    # The cross-host placement knob (gang-parked sharded sessions):
+    # below 1.0, a resume landing off the parker's host re-prefills
+    # cold — sweep it to price host-local vs shared artifact stores.
+    sim.transport.cross_host_resume = float(cfg.cross_host_resume)
     reps = [sim.add_replica(UNIFIED) for _ in range(cfg.replicas)]
     if workload is None:
         n_sessions = int(sessions) if sessions is not None else (
@@ -1907,6 +1999,80 @@ def scenario_sessions(overrides=(), n_requests: Optional[int] = None,
             st["ttft_hit_ms"] / max(1, st["resume"]), 3),
         "cold_ttft_mean_ms": round(
             st["ttft_cold_ms"] / max(1, st["park"] - st["resume"]), 3),
+        "cross_host_resume": cfg.cross_host_resume,
+    })
+    sim.stop()
+    return out
+
+
+def scenario_gang(overrides=(), n_requests: int = 4000,
+                  replicas: Optional[int] = None,
+                  seed: Optional[int] = None,
+                  workload=None, model_fit: Optional[dict] = None,
+                  cfg: Optional[SimConfig] = None) -> Dict[str, Any]:
+    """Gang replicas at sim scale (docs/SERVING.md "Gang replicas"):
+    a unified tier of N-member pod-slice gangs under steady open
+    arrivals, with one gang MEMBER hard-killed mid-run — the gang
+    dies whole, re-forms after ``gang_reform_s`` (rendezvous +
+    re-warm), and its in-flight work replays on the survivors.  The
+    regression contract (tests/test_sim.py): zero lost requests
+    across the member kill, the fleet ends with the booted gang count
+    again, and a gang fleet's decode tail beats the single-process
+    fleet of equal replica count (that is what the slice buys).
+    Sweep the slice shape with ``--sweep gang_size=2,4,8`` or the
+    collective tax with ``--sweep gang_efficiency=0.6,0.85,1.0``."""
+    cfg = _new_cfg(cfg, overrides)
+    if replicas is not None:
+        cfg.replicas = int(replicas)
+    if seed is not None:
+        cfg.seed = int(seed)
+    if model_fit:
+        for k, v in model_fit.items():
+            if hasattr(cfg.model, k):
+                setattr(cfg.model, k, v)
+    if cfg.gang_size <= 1:
+        cfg.gang_size = 4
+    cfg.workers = max(cfg.workers,
+                      min(256, 2 * cfg.replicas * cfg.capacity))
+    sim = FleetSim(cfg)
+    reps = [sim.add_replica(UNIFIED, gang_size=cfg.gang_size)
+            for _ in range(cfg.replicas)]
+    if workload is None:
+        # Rate from the SINGLE-PROCESS model: the same offered load a
+        # non-gang fleet of this shape would see, so the gang's
+        # speedup shows up as latency headroom, not as an easier run.
+        _, per_req_s = cfg.model.service_s(64, 16, random.Random(0))
+        workload = SyntheticWorkload(
+            n_requests=n_requests, seed=cfg.seed,
+            rate=0.7 * cfg.replicas * cfg.capacity
+            / max(1e-9, per_req_s),
+            class_mix={"interactive": 1.0, "background": 2.0},
+            prompt_len=64, new_tokens=16)
+    sim.feed(workload)
+    sim.start_workers()
+    n = getattr(workload, "n_requests", 0)
+    rate = getattr(workload, "rate", 100.0)
+    if n:
+        # Mid-stream member SIGKILL: the gang death + whole re-form.
+        sim.engine.at(0.5 * n / max(1e-9, rate),
+                      lambda: sim.kill_gang_member(reps[0]))
+    t0 = time.perf_counter()
+    sim.engine.run(stop=sim.drained)
+    if n:
+        # Let the re-form land (it may trail the last arrival): the
+        # scenario's contract is that the fleet ENDS whole again.
+        sim.engine.run(until=sim.engine.clock.now + cfg.gang_reform_s
+                       + cfg.warmup_s + 3 * cfg.hb_interval)
+    wall = time.perf_counter() - t0
+    out = sim.results(wall)
+    out.update({
+        "gang_size": cfg.gang_size,
+        "gang_efficiency": cfg.gang_efficiency,
+        "gang_reform_s": cfg.gang_reform_s,
+        "gang_deaths": sim.metrics.get("gang_deaths"),
+        "gang_reforms": sim.metrics.get("gang_reforms"),
+        "gangs_actual": sim.tier_actual(UNIFIED),
+        "gang_summary": sim.registry.gang_summary(),
     })
     sim.stop()
     return out
@@ -2067,6 +2233,7 @@ SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "multi-gateway": scenario_multi_gateway,
     "sessions": scenario_sessions,
     "multi-model": scenario_multi_model,
+    "gang": scenario_gang,
 }
 
 

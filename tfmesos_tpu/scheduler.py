@@ -186,6 +186,12 @@ class TPUMesosScheduler:
             self._dyn_index[task.job_name] = max(
                 self._dyn_index.get(task.job_name, 0), task.task_index + 1)
         self.dynamic_failures: Dict[str, int] = {}
+        # Dynamic-death notification (the fleet's gang manager): called
+        # with the dead Task AFTER it left the table, on a fresh thread —
+        # the callback tears down siblings via remove_task/backend.kill,
+        # which must never run on the status-processing thread.
+        self.on_dynamic_death = None
+        self._gang_seq = 0
 
         self._lock = threading.RLock()
         self.started = False
@@ -282,8 +288,9 @@ class TPUMesosScheduler:
                 # refusal so re-offers accumulate into a big enough batch.
                 to_decline = [(o, 1.0) for o in offers]
             else:
+                batch_tasks = self._batch_order(offers)
                 for offer in offers:
-                    placed = first_fit(self.tasks, offer)
+                    placed = first_fit(batch_tasks, offer)
                     if not placed:
                         to_decline.append((offer, 5.0))
                         continue
@@ -340,6 +347,49 @@ class TPUMesosScheduler:
                     self.log.warning("stale-launch kill of %s failed: %s",
                                      tid[:8], e)
 
+    def _batch_order(self, offers: List[Offer]) -> List:
+        """Gang-atomic placement order for one offer batch (lock held).
+
+        Dynamic tasks added via :meth:`add_gang` carry a ``gang`` label;
+        a gang is placed ALL-OR-NOTHING within a batch: a reservation
+        pass checks each gang's unplaced members against the batch's
+        free capacity (in the same greedy order the real ``first_fit``
+        loop will use), admits gangs that wholly fit, and withholds the
+        rest for a later, bigger batch — a gang may legitimately split
+        ACROSS offers (hosts) within the batch, never across batches.
+        Admitted gang members sort first so loose tasks cannot eat the
+        capacity the reservation just verified."""
+        loose = [t for t in self.tasks
+                 if getattr(t, "gang", None) is None]
+        gangs: Dict[str, List] = {}
+        for t in self.tasks:
+            g = getattr(t, "gang", None)
+            if g is not None and not t.offered:
+                gangs.setdefault(g, []).append(t)
+        if not gangs:
+            return loose
+        free = [[o.cpus, o.mem, o.chips] for o in offers]
+        admitted: List = []
+        for gid, members in gangs.items():
+            trial = [slot[:] for slot in free]
+            for t in members:
+                for slot in trial:
+                    if (slot[0] >= t.cpus and slot[1] >= t.mem
+                            and slot[2] >= t.chips):
+                        slot[0] -= t.cpus
+                        slot[1] -= t.mem
+                        slot[2] -= t.chips
+                        break
+                else:
+                    self.log.info(
+                        "withholding gang %s from this offer batch: "
+                        "%d member(s) do not all fit", gid, len(members))
+                    break
+            else:
+                free = trial
+                admitted.extend(members)
+        return admitted + loose
+
     def _gang_fits(self, offers: List[Offer]) -> bool:
         """Would the *entire* remaining task set fit across this offer batch?"""
         free = [[o.cpus, o.mem, o.chips] for o in offers]
@@ -390,6 +440,15 @@ class TPUMesosScheduler:
                         self.dynamic_failures.get(task.job_name, 0) + 1
                     self.log.warning("dynamic task %s terminated: %s %s",
                                      task, status.state, status.message)
+                    cb = self.on_dynamic_death
+                    if cb is not None:
+                        # Off-thread: the callback (gang teardown) kills
+                        # sibling tasks — backend HTTP it must not run
+                        # on the status thread or under our lock.
+                        threading.Thread(
+                            target=self._fire_dynamic_death,
+                            args=(cb, task), daemon=True,
+                            name="tpumesos-dyn-death").start()
                 return
             if status.state == "TASK_FINISHED":
                 self.job_finished[task.job_name] = \
@@ -551,6 +610,9 @@ class TPUMesosScheduler:
         gen = getattr(task, "generation", None) if task is not None else None
         env["TPUMESOS_GENERATION"] = str(
             self.generation if gen is None else gen)
+        extra = getattr(task, "extra_env", None) if task is not None else None
+        if extra:
+            env.update(extra)
         return env
 
     def _post_start_failure(self, why: str) -> None:
@@ -734,6 +796,12 @@ class TPUMesosScheduler:
                     0, self.max_cluster_restarts - len(self._restart_times)),
             }
 
+    def _fire_dynamic_death(self, cb, task) -> None:
+        try:
+            cb(task)
+        except Exception as e:
+            self.log.warning("on_dynamic_death(%s) raised: %s", task, e)
+
     def _find_task(self, task_id: str) -> Optional[Task]:
         for task in self.tasks:
             if task.id == task_id:
@@ -743,31 +811,79 @@ class TPUMesosScheduler:
     # -- dynamic task management (serving fleets) --------------------------
 
     def add_task(self, job_name: str, cmd: str, cpus: float = 1.0,
-                 mem: float = 1024.0, chips: int = 0) -> Task:
+                 mem: float = 1024.0, chips: int = 0,
+                 env: Optional[Dict[str, str]] = None) -> Task:
         """Launch ONE new Mode-B task at runtime (dynamic mode only):
         the task enters the table with the NEXT index for its job, the
         offer tap re-opens, and its registration is served by the
         dynamic rendezvous.  The cluster generation current NOW is
         stamped on the task — a later rollout bump must not re-brand a
-        launch that predates it."""
+        launch that predates it.  ``env`` rides the launch env on top
+        of the scheduler-wide one (gang identity travels this way)."""
         if not self.dynamic:
             raise ClusterError("add_task requires dynamic=True")
         with self._lock:
-            if self._stopped:
-                raise ClusterError("scheduler stopped")
-            if self._fatal:
-                raise ClusterError(self._fatal)
-            index = self._dyn_index.get(job_name, 0)
-            self._dyn_index[job_name] = index + 1
-            task = Task(job_name, index, cpus=cpus, mem=mem,
-                        chips=chips, cmd=cmd, volumes=self.volumes)
-            task.dynamic = True
-            task.generation = self.generation
-            self.tasks.append(task)
+            task = self._add_task_locked(job_name, cmd, cpus, mem,
+                                         chips, env)
         self.log.info("dynamic task added: %s (generation %d)", task,
                       task.generation)
         self._revive_backend("add_task")
         return task
+
+    def _add_task_locked(self, job_name, cmd, cpus, mem, chips,
+                         env) -> Task:
+        if self._stopped:
+            raise ClusterError("scheduler stopped")
+        if self._fatal:
+            raise ClusterError(self._fatal)
+        index = self._dyn_index.get(job_name, 0)
+        self._dyn_index[job_name] = index + 1
+        task = Task(job_name, index, cpus=cpus, mem=mem,
+                    chips=chips, cmd=cmd, volumes=self.volumes)
+        task.dynamic = True
+        task.generation = self.generation
+        if env:
+            task.extra_env = dict(env)
+        self.tasks.append(task)
+        return task
+
+    def add_gang(self, job_name: str, cmds: List[str], cpus: float = 1.0,
+                 mem: float = 1024.0, chips: int = 0,
+                 envs: Optional[List[Dict[str, str]]] = None) -> List[Task]:
+        """Launch N tasks as ONE atomic gang (dynamic mode only): all
+        members enter the table under a single lock hold — one launch
+        generation, one gang label — and the offer loop places the
+        gang all-or-nothing within an offer batch (it may span hosts,
+        never epochs).  Returns the member tasks in rank order; the
+        per-member ``envs`` dicts carry rank/size/coordination env."""
+        if not self.dynamic:
+            raise ClusterError("add_gang requires dynamic=True")
+        if not cmds:
+            raise ValueError("add_gang needs at least one member cmd")
+        if envs is not None and len(envs) != len(cmds):
+            raise ValueError("envs must match cmds one-to-one")
+        with self._lock:
+            self._gang_seq += 1
+            gang_id = f"{job_name}/g{self._gang_seq}"
+            members = []
+            for rank, cmd in enumerate(cmds):
+                env = dict(envs[rank]) if envs else {}
+                # The gang contract rides the launch env: every member
+                # learns its identity from these three variables (the
+                # caller cannot stamp them — the gang id is minted
+                # under this very lock hold).
+                env["TPUMESOS_GANG_ID"] = gang_id
+                env["TPUMESOS_GANG_SIZE"] = str(len(cmds))
+                env["TPUMESOS_GANG_RANK"] = str(rank)
+                task = self._add_task_locked(
+                    job_name, cmd, cpus, mem, chips, env)
+                task.gang = gang_id
+                members.append(task)
+            gen = members[0].generation
+        self.log.info("dynamic gang added: %s x%d (generation %d)",
+                      gang_id, len(members), gen)
+        self._revive_backend("add_gang")
+        return members
 
     def remove_task(self, task_id: str) -> bool:
         """Kill ONE task at runtime and forget it (dynamic mode only).

@@ -1311,9 +1311,15 @@ class ContinuousBatcher:
                  multi_step: int = 1,
                  prefix_cache_pages: int = 0,
                  pipeline_depth: int = 0,
-                 kv_tier=None):
+                 kv_tier=None,
+                 rid_seed: int = 0):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
+        if not 0 <= int(rid_seed) < 2 ** 30:
+            # rids land in int32 arrays and key the (rid, step) sampling
+            # folds; the seed must leave increment headroom below 2^31.
+            raise ValueError(f"rid_seed must be in [0, 2^30), got "
+                             f"{rid_seed}")
         if prefix_cache_pages < 0:
             raise ValueError(f"prefix_cache_pages must be >= 0, got "
                              f"{prefix_cache_pages}")
@@ -1483,7 +1489,13 @@ class ContinuousBatcher:
                                          quantized=draft_quantized_cache)
             self._spec_round = self._make_spec_round()
             self._draft_chunk = self._make_draft_chunk()
-        self._next_rid = 0
+        # Request-id stream base.  Sampled draws are pure (rid, step) key
+        # folds, so two EXPORTERS whose rids collide would share an rng
+        # stream across artifacts (the PR 4 caveat); per-replica seeding
+        # (derived from the fleet node id) keeps exporter streams
+        # disjoint.  Imports still continue the exporter's rid — that is
+        # the point of the fold.
+        self._next_rid = int(rid_seed)
         # Incremental submission (see submit()/serve()); lazily built so
         # plain run(iterable) batchers never pay for it.
         self._submissions: Optional[SubmissionQueue] = None
