@@ -646,6 +646,73 @@ def bench_serving_pipeline(n_requests=16, rows=8, tiny=False):
     return pipe_itl, base_itl, pipe_rps
 
 
+def bench_decode_paged_call(tiny=False, reps=30):
+    """Per-call paged-attention decode latency + launches-per-block —
+    the device floor BASELINE.md round 5 localized (~0.54 ms/launch x
+    8 launches per 16-step block) promoted to first-class bench keys
+    so the floor is tracked across rounds instead of living in prose.
+
+    Measures one jitted ``flash_decode_paged`` call at t=1 (the
+    synchronous steady-state step) and at t=8 (the FUSED multi-row
+    step a speculative verify dispatches: 8 decode rows retired
+    through ONE launch per layer), plus the analytic launches a
+    16-token block costs per mode
+    (``ContinuousBatcher.paged_launches_per_block``) — the fused path
+    asserted at <= 2, the acceptance bar."""
+    import jax
+    import jax.numpy as jnp
+    from tfmesos_tpu.models import transformer
+    from tfmesos_tpu.ops.attention import flash_decode_paged
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    if tiny:
+        b, kv, g, d, ps, npg = 2, 2, 2, 16, 16, 4
+    else:
+        b, kv, g, d, ps, npg = 4, 4, 2, 64, 64, 16
+    h = kv * g
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    key = jax.random.PRNGKey(0)
+    kq, kk, kvv = jax.random.split(key, 3)
+    pool_k = jax.random.normal(kk, (b * npg + 1, kv, ps, d), dt)
+    pool_v = jax.random.normal(kvv, (b * npg + 1, kv, ps, d), dt)
+    table = jnp.arange(b * npg, dtype=jnp.int32).reshape(b, npg)
+    pos = jnp.full((b,), (npg - 1) * ps, jnp.int32)
+
+    def timed(t):
+        q = jax.random.normal(kq, (b, t, h, d), dt)
+        self_kv = (jax.random.normal(kk, (b, t, kv, d), dt),
+                   jax.random.normal(kvv, (b, t, kv, d), dt))
+        fn = jax.jit(lambda q_, s_: flash_decode_paged(
+            q_, pool_k, pool_v, table, pos, self_kv=s_))
+        jax.block_until_ready(fn(q, self_kv))    # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(q, self_kv)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1000.0
+
+    call_ms, fused_ms = timed(1), timed(8)
+
+    cfg, params, _, max_len, _ = _serving_bench_setup(True)
+    sync = ContinuousBatcher(cfg, params, rows=2, max_len=max_len)
+    dcfg = transformer.TransformerConfig(
+        vocab_size=cfg.vocab_size, d_model=16, n_layers=1, n_heads=2,
+        d_ff=32, max_seq_len=max_len + 8, dtype=jnp.float32)
+    dparams = transformer.init_params(dcfg, jax.random.PRNGKey(1))
+    spec = ContinuousBatcher(cfg, params, rows=2, max_len=max_len,
+                             draft_cfg=dcfg, draft_params=dparams,
+                             n_draft=7)
+    sync_lpb = sync.paged_launches_per_block(16)
+    fused_lpb = spec.paged_launches_per_block(16)
+    assert fused_lpb <= 2, \
+        (f"fused path costs {fused_lpb} paged launches per 16-step "
+         f"block — the acceptance bar is <= 2")
+    return call_ms, fused_ms, sync_lpb, fused_lpb
+
+
 def bench_serving_warmup(rows=4, tiny=False):
     """First-request TTFT on a COLD batcher (the request pays the
     admission-prefill and first-decode compiles) vs a WARMED one
@@ -3099,6 +3166,18 @@ def main():
         out["serving_multistep_overlap_requests_per_sec"] = round(
             mso_rps, 2)
         out["serving_decode_p50_intertoken_ms"] = round(itl_p50, 3)
+        flush_partial()
+    pc = attempts(bench_decode_paged_call, "paged decode call bench", n=1)
+    if pc:
+        # The paged-decode device floor as first-class keys: per-call
+        # kernel latency (t=1 sync step vs t=8 fused multi-row step)
+        # and the analytic launches per 16-token block per mode (fused
+        # <= 2 asserted in-bench — BASELINE.md's 8-launch floor).
+        call_ms, fused_ms, sync_lpb, fused_lpb = pc[0]
+        out["decode_paged_call_ms"] = round(call_ms, 3)
+        out["decode_paged_fused_call_ms"] = round(fused_ms, 3)
+        out["decode_paged_launches_per_block_sync"] = int(sync_lpb)
+        out["decode_paged_launches_per_block_fused"] = int(fused_lpb)
         flush_partial()
     pl = attempts(bench_serving_pipeline, "pipelined serving bench", n=1)
     if pl:

@@ -106,20 +106,17 @@ def main():
     if args.multi_step != 1 and not args.continuous:
         p.error("--multi-step is a continuous-batching feature; "
                 "add --continuous")
-    if args.multi_step != 1 and args.speculative:
-        p.error("--multi-step does not compose with --speculative (a "
-                "speculative round already commits multiple tokens per "
-                "dispatch)")
     if args.overlap and not args.continuous:
         p.error("--overlap is a continuous-batching feature; "
                 "add --continuous")
     if args.pipeline_depth and not args.continuous:
         p.error("--pipeline-depth is a continuous-batching feature; "
                 "add --continuous")
-    if args.pipeline_depth and args.overlap:
-        p.error("--pipeline-depth already double-buffers the decode "
-                "loop with a device-resident carry; drop --overlap "
-                "(pick one)")
+    # --multi-step with --speculative and --pipeline-depth with
+    # --overlap both construct now: the batcher composes the former (R
+    # fused speculative rounds per dispatch) and records an enforced
+    # bypass for the latter (overlap_bypass_reason) — see
+    # serving.BYPASS_ALLOWLIST.
     if args.warmup and not args.continuous:
         p.error("--warmup is a continuous-batching feature; "
                 "add --continuous")

@@ -617,6 +617,73 @@ def test_flash_decode_paged_deferred_self():
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("ps,kv,g,quantized,self_t", [
+    # (page_size, kv heads, q_per_kv, int8 pools, fused self rows; 0 =
+    # committed t=1 step).  Sweeps the head-blocked grid (kv=1..4 hits
+    # head_block 1, 2, and 4 under the VMEM guard) and the fused
+    # multi-row step (K=4/8 — the speculative-verify shape).
+    (16, 1, 4, False, 0),
+    (16, 2, 2, False, 1),
+    (16, 2, 2, False, 8),
+    (32, 4, 1, False, 4),
+    (16, 2, 2, True, 1),
+    (16, 2, 2, True, 8),
+    (32, 4, 2, True, 4),
+    (128, 2, 2, False, 8),
+])
+def test_flash_decode_paged_equivalence_matrix(ps, kv, g, quantized,
+                                               self_t):
+    """The restructured paged kernel (head-parallel grid + fused
+    multi-row steps) vs the gather-the-pages reference across the
+    config matrix: every cell must agree on the SAME pool — the
+    bit-exactness bar every serving caller (int8, GQA, self_kv
+    deferred decode, spec verify chunks) rides on."""
+    from tfmesos_tpu.ops.attention import (_paged_decode_reference,
+                                           flash_decode_paged)
+    from tfmesos_tpu.ops.quant import (QTensor, quantize_int8_reference,
+                                       quantize_tensor)
+
+    ks = jax.random.split(jax.random.PRNGKey(11), 5)
+    b, d, npg = 2, 32, 4
+    h, m = kv * g, ps * npg
+    t = max(1, self_t)
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, kv, m, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, kv, m, d), jnp.float32)
+    pool = lambda c: c.reshape(b, kv, npg, ps, d).transpose(
+        0, 2, 1, 3, 4).reshape(b * npg, kv, ps, d)
+    if quantized:
+        qt_k, qt_v = quantize_tensor(kc), quantize_tensor(vc)
+        lane = lambda qt: (qt.scales[..., 0].reshape(b, kv, npg, ps)
+                           .transpose(0, 2, 1, 3)
+                           .reshape(b * npg, kv, ps)[:, :, None, :])
+        k_pool = QTensor(pool(qt_k.values), jnp.asarray(lane(qt_k)))
+        v_pool = QTensor(pool(qt_v.values), jnp.asarray(lane(qt_v)))
+    else:
+        k_pool, v_pool = pool(kc), pool(vc)
+    pt = jnp.asarray(np.arange(b * npg, dtype=np.int32).reshape(b, npg))
+    if self_t:
+        rq = lambda c: (lambda v_, s_: v_.astype(jnp.float32)
+                        * s_.astype(jnp.float32))(
+            *quantize_int8_reference(c)) if quantized else c
+        self_kv = (rq(jax.random.normal(ks[3], (b, t, kv, d),
+                                        jnp.float32)),
+                   rq(jax.random.normal(ks[4], (b, t, kv, d),
+                                        jnp.float32)))
+    else:
+        self_kv = None
+    hi = m - t if self_t else m - t - 1
+    for pos in (jnp.array([0 if self_t else 1, hi], jnp.int32),
+                min(ps + 1, hi)):
+        ref = _paged_decode_reference(q, k_pool, v_pool, pt, pos,
+                                      d ** -0.5, self_kv=self_kv)
+        got = flash_decode_paged(q, k_pool, v_pool, pt, pos,
+                                 use_pallas=True, interpret=True,
+                                 self_kv=self_kv)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
 def test_stacked_cache_static_zero_layer_with_4d_cache():
     """A statically-zero layer index — python 0, numpy int32(0), a 0-d
     concrete array — over a 4-D (single-layer) cache must be accepted via

@@ -106,6 +106,19 @@ def test_serving_bench_smoke():
     assert np.isfinite(itl_p50) and itl_p50 >= 0
 
 
+def test_decode_paged_call_bench_smoke():
+    """The paged-call floor microbench end to end at tiny size: finite
+    per-call latencies for the sync (t=1) and fused (t=8) launches,
+    and the launches-per-block keys — fused <= 2 is asserted INSIDE
+    the bench (the acceptance bar), sync stays the 1-launch-per-token
+    analytic 16."""
+    call_ms, fused_ms, sync_lpb, fused_lpb = \
+        bench.bench_decode_paged_call(tiny=True, reps=3)
+    assert call_ms > 0 and fused_ms > 0
+    assert sync_lpb == 16
+    assert fused_lpb == 2
+
+
 def test_serving_pipeline_bench_smoke():
     """The pipelined-vs-synchronous protocol runs end to end at tiny
     size; token identity is asserted inside the bench.  The strict

@@ -702,8 +702,9 @@ def test_pipelined_spec_bypass_reason_and_validation(setup, draft_setup):
     """Speculative decoding BYPASSES pipelining explicitly — the
     recorded reason makes the bypass observable (like
     prefix_cache_bypass_reason) and the spec loop runs unchanged;
-    overlap=True + pipeline_depth=1 is rejected (pick one), as are
-    depths outside {0, 1}."""
+    overlap=True + pipeline_depth=1 is a recorded BYPASS now (the
+    pipelined carry already double-buffers, so overlap collapses),
+    and depths outside {0, 1} stay rejected."""
     cfg, params = setup
     dcfg, dparams = draft_setup
     b = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
@@ -721,9 +722,20 @@ def test_pipelined_spec_bypass_reason_and_validation(setup, draft_setup):
     want = {c.rid: c.tokens for c in plain.run(list(reqs))}
     got = {c.rid: c.tokens for c in b.run(list(reqs))}
     assert got == want
-    with pytest.raises(ValueError, match="drop overlap"):
-        ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
-                          overlap=True, pipeline_depth=1)
+    ov = ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
+                           prefill_bucket=16, overlap=True,
+                           pipeline_depth=1)
+    assert ov.overlap_bypass_reason == "pipelined decode carry"
+    assert ov.overlap is False and ov._pipelined
+    # The pipelined carry still lags the host view: not suspendable.
+    assert ov.suspend_bypass_reason == "lagged decode carry"
+    assert not ov.preemptible
+    # Greedy speculative decode is lossless, so the spec `want` doubles
+    # as the plain-greedy ground truth the pipelined run must match.
+    got = {c.rid: c.tokens for c in ov.run(
+        [Request(prompt=p, max_new_tokens=4)
+         for p in _prompts(cfg, 3, seed=77)])}
+    assert got == want
     with pytest.raises(ValueError, match="pipeline_depth"):
         ContinuousBatcher(cfg, params, rows=2, max_len=64, page_size=16,
                           pipeline_depth=2)
@@ -1221,9 +1233,40 @@ def test_multistep_validation(setup, draft_setup):
     dcfg, dparams = draft_setup
     with pytest.raises(ValueError, match="multi_step"):
         ContinuousBatcher(cfg, params, multi_step=0)
-    with pytest.raises(ValueError, match="speculative"):
-        ContinuousBatcher(cfg, params, multi_step=2, draft_cfg=dcfg,
-                          draft_params=dparams)
+    # spec+multi_step COMPOSES synchronously now: R in-graph rounds per
+    # dispatch, R = ceil(multi_step / (n_draft+1)).
+    kw = dict(rows=2, max_len=64, page_size=16, draft_cfg=dcfg,
+              draft_params=dparams, n_draft=3)
+    b = ContinuousBatcher(cfg, params, multi_step=8, **kw)
+    assert b.multi_step_bypass_reason is None
+    assert b._spec_rounds == 2
+    # ... but under speculative overlap the round carry supersedes it.
+    ov = ContinuousBatcher(cfg, params, multi_step=8, overlap=True, **kw)
+    assert ov.multi_step_bypass_reason == \
+        "speculative overlap round carry"
+    assert ov._spec_rounds == 1
+
+
+def test_spec_multistep_token_identical(setup, draft_setup):
+    """spec+multi_step (R fused rounds per dispatch) streams
+    token-identical to the R=1 speculative batcher — the composition
+    acceptance bar, greedy and sampled."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    prompts = _prompts(cfg, 3, seed=311)
+    for T in (0.0, 0.8):
+        kw = dict(rows=2, max_len=64, page_size=16, prefill_bucket=16,
+                  draft_cfg=dcfg, draft_params=dparams, n_draft=3,
+                  temperature=T)
+        reqs = lambda: [Request(prompt=p, max_new_tokens=9,
+                                stop_token=None) for p in prompts]
+        base = ContinuousBatcher(cfg, params, **kw)
+        want = {c.rid: c.tokens for c in base.run(reqs())}
+        fused = ContinuousBatcher(cfg, params, multi_step=8, **kw)
+        assert fused._spec_rounds == 2
+        got = {c.rid: c.tokens for c in fused.run(reqs())}
+        assert got == want
+        assert fused.spec_committed == base.spec_committed
 
 
 def test_bucket_width_invariants():
@@ -2513,12 +2556,13 @@ def test_bypass_registry_audit(setup):
                                      compute_bypass_reasons)
 
     reachable = {k: set() for k in BYPASS_ALLOWLIST}
-    for spec_on, shards, q, dq, pd in itertools.product(
+    for spec_on, shards, q, dq, pd, ov, ms in itertools.product(
             (False, True), (1, 2, 4), (False, True), (False, True),
-            (0, 1)):
+            (0, 1), (False, True), (1, 2, 8)):
         reasons = compute_bypass_reasons(
             speculative=spec_on, n_shards=shards, quantized_cache=q,
-            draft_quantized_cache=dq, pipeline_depth=pd)
+            draft_quantized_cache=dq, pipeline_depth=pd, overlap=ov,
+            multi_step=ms)
         assert set(reasons) == set(BYPASS_ALLOWLIST)
         for reg, val in reasons.items():
             if val is not None:
@@ -2533,6 +2577,15 @@ def test_bypass_registry_audit(setup):
     # the KV tier now.
     assert "speculative decoding" not in reachable["prefix_cache"]
     assert "speculative decoding" not in reachable["kv_tier"]
+    # The former constructor REJECTIONS are enumerable mode gates now:
+    # each is reachable with exactly its documented reason, and
+    # spec+multi_step (sync) reaches NO reason — it composes.
+    assert reachable["overlap"] == {"pipelined decode carry"}
+    assert reachable["multi_step"] == {"speculative overlap round carry"}
+    assert reachable["suspend"] == {"mesh data sharding",
+                                    "lagged decode carry"}
+    sync_ms = compute_bypass_reasons(speculative=True, multi_step=8)
+    assert sync_ms["multi_step"] is None
     # And __init__ really uses the helper (spot-check: a live batcher's
     # attributes equal the helper's output for its config).
     cfg, params = setup
@@ -2545,6 +2598,11 @@ def test_bypass_registry_audit(setup):
     assert b.prefix_cache_bypass_reason == want["prefix_cache"]
     assert b.kv_tier_bypass_reason == want["kv_tier"]
     assert b.pipeline_bypass_reason == want["pipeline"]
+    assert b.overlap_bypass_reason == want["overlap"]
+    assert b.multi_step_bypass_reason == want["multi_step"]
+    assert b.suspend_bypass_reason == want["suspend"]
+    # The suspend gate IS the preemptible property.
+    assert b.preemptible == (b.suspend_bypass_reason is None)
 
 
 # -- adapter hot-swap / warm-pool adoption (PR 15) ---------------------------

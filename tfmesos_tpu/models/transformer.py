@@ -944,31 +944,36 @@ def _paged_cache_write(pool, chunk, li, page_table, pos):
 
 
 def _paged_cache_write_all(pool, chunks, page_table, pos):
-    """Commit ALL layers' deferred single-token chunks ([L, B, 1, KV, Dh],
-    stacked by the decode layer scan) in ONE scatter per pool leaf —
-    2L scatters per token become 2 (one scatter op costs ~0.5 ms on TPU
-    regardless of payload, so the op COUNT is the serving decode's write
-    cost).  Same index math (sink clamp included) and same per-row
-    absmax int8 rule as the per-layer ``_paged_cache_write``."""
+    """Commit ALL layers' deferred chunks ([L, B, t, KV, Dh], stacked by
+    the decode layer scan) in ONE scatter per pool leaf — 2L scatters
+    per step become 2 (one scatter op costs ~0.5 ms on TPU regardless
+    of payload, so the op COUNT is the serving decode's write cost).
+    t = 1 is the steady-state deferred token; t > 1 the fused
+    multi-row step (speculative verify / chunked-prefill tails), whose
+    per-token (page, offset) pairs chase the table exactly like the
+    per-layer ``_paged_cache_write`` — same index math (sink clamp
+    included) and same per-row absmax int8 rule."""
     L, b, t, kvh, dh = chunks.shape
     ps = (pool.values if isinstance(pool, QTensor) else pool).shape[3]
     posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
-    blk = jnp.minimum(posv // ps, page_table.shape[1] - 1)
-    pages = jnp.take_along_axis(page_table, blk[:, None], axis=1)[:, 0]
-    offs = posv % ps
-    x = chunks[:, :, 0]                         # [L, B, KV, Dh]
+    lpos = posv[:, None] + jnp.arange(t, dtype=jnp.int32)[None]   # [B, t]
+    blk = jnp.minimum(lpos // ps, page_table.shape[1] - 1)
+    pages = jnp.take_along_axis(page_table, blk, axis=1).reshape(-1)
+    offs = (lpos % ps).reshape(-1)
+    # [L, B, t, KV, Dh] -> [B*t, L, KV, Dh] update rows, (page, offset)
+    # indexed per (row, token).
+    x = chunks.transpose(1, 2, 0, 3, 4).reshape(b * t, L, kvh, dh)
 
     def put(buf, x):
         # Advanced indices (pages, offs) around the slices front the
-        # batch dim: updates arrive [B, L, KV, Dh'].
-        return buf.at[:, pages, :, offs].set(
-            x.transpose(1, 0, 2, 3).astype(buf.dtype))
+        # row dim: updates arrive [B*t, L, KV, Dh'].
+        return buf.at[:, pages, :, offs].set(x.astype(buf.dtype))
 
     if isinstance(pool, QTensor):
         from tfmesos_tpu.ops.quant import quantize_int8_reference
         vals, scale = quantize_int8_reference(x)
         scales = pool.scales.at[:, pages, :, 0, offs].set(
-            scale[..., 0].transpose(1, 0, 2))
+            scale[..., 0])
         return QTensor(put(pool.values, vals), scales)
     return put(pool, x)
 
@@ -1333,14 +1338,19 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, li, positions,
     rolling = cfg.window is not None
     self_attn_prefill = t > 1 and isinstance(pos, int) and pos == 0
     o_paged = None
-    # Single-host t=1 paged steps DEFER their pool commit: one XLA
-    # scatter costs ~0.5 ms regardless of size (measured, v5e), so the
+    # Single-host paged steps DEFER their pool commit: one XLA scatter
+    # costs ~0.5 ms regardless of size (measured, v5e), so the
     # per-layer write-then-attend order would spend 2L scatters per
-    # token.  Instead the chunk rides into attention as a SELF operand
-    # (kernel: a one-slot block accumulated at the last grid step;
-    # reference: written into the gathered view) and decode_step commits
-    # ALL layers' chunks in one scatter per pool leaf after the scan.
-    defer = pages is not None and not sharded and t == 1
+    # step.  Instead the chunk rides into attention as a SELF operand
+    # (kernel: a [head_block, t, d] block accumulated at the last page
+    # step, causal across the chunk's own tokens; reference: written
+    # into the gathered view) and decode_step commits ALL layers'
+    # chunks in one scatter per pool leaf after the scan.  t > 1 is the
+    # fused multi-row step (speculative verify / chunked-prefill
+    # tails): t rows retire through ONE attention launch per layer and
+    # one commit pair per dispatch, instead of per-layer write-then-
+    # attend scatters.
+    defer = pages is not None and not sharded
     if pages is not None and sharded:
         # Multi-chip serving: write + paged attention per shard (the page
         # indirection cannot be GSPMD-partitioned; everything around it
@@ -1350,9 +1360,7 @@ def _block_decode(cfg: TransformerConfig, x, lp, ck, cv, li, positions,
             cfg, mesh, q, k, v, ck, cv, li, pages, positions,
             attend=not self_attn_prefill)
     elif pages is not None:
-        if not defer:
-            ck = _paged_cache_write(ck, k, li, pages, pos)
-            cv = _paged_cache_write(cv, v, li, pages, pos)
+        pass    # single-host paged: deferred — decode_step commits
     else:
         ck = _cache_write(ck, k, li, pos, rolling=rolling)
         cv = _cache_write(cv, v, li, pos, rolling=rolling)

@@ -26,6 +26,35 @@ from tfmesos_tpu.compat import shard_map
 
 NEG_INF = float("-inf")
 
+#: Paged-decode launch accounting (bench_decode_paged_call and the
+#: launches-per-block assertions): Python-level call counts, bumped once
+#: per ``flash_decode_paged`` invocation.  Under ``jit`` a call site
+#: counts once per TRACE (a ``lax.scan`` body traces once however many
+#: steps it runs), so measure eager/microbench call sequences — the
+#: serving-level launches-per-block number comes from
+#: ``ContinuousBatcher.paged_launches_per_block`` instead, which knows
+#: the dispatch structure.
+PAGED_CALL_STATS = {"calls": 0, "kernel_calls": 0}
+
+#: Per-core VMEM bytes the paged kernel's K + V slabs may claim
+#: (double-buffered pair of each, leaving headroom for q, the self
+#: operands and the softmax scratch in the ~16 MB core budget).
+_PAGED_VMEM_BUDGET = 8 * 2 ** 20
+
+
+def _paged_head_block(kv: int, ps: int, d: int, itemsize: int) -> int:
+    """Heads per paged-kernel grid cell: the largest divisor of ``kv``
+    whose [head_block, page, d] K + V slabs, double-buffered, fit
+    :data:`_PAGED_VMEM_BUDGET` — every head in one cell when it fits
+    (head grid dimension 1, the common case), falling back to smaller
+    head blocks for huge page x head_dim products rather than losing
+    the kernel eligibility outright."""
+    for hb in range(kv, 0, -1):
+        if kv % hb == 0 and 4 * hb * ps * d * itemsize <= \
+                _PAGED_VMEM_BUDGET:
+            return hb
+    return 1
+
 
 def _check_gqa_heads(q, k, v):
     """Every attention path shares one clear failure for bad GQA shapes
@@ -804,9 +833,12 @@ def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale,
     """Gather-the-pages ground truth: materialize each row's logical cache
     view from the pool ([P, KV, page, D], or the stacked
     [L, P, KV, page, D] with ``layer``; int8 QTensors dequantize) and run
-    the dense masked reference.  ``self_kv`` (deferred-write decode,
-    t = 1): the current token's [B, 1, KV, D] K/V is written into each
-    row's view at its own position — the pool slot there is stale."""
+    the dense masked reference.  ``self_kv`` (deferred-write decode):
+    the uncommitted chunk's [B, t, KV, D] K/V is written into each row's
+    view at its own positions [pos, pos + t - 1] — the pool slots there
+    are stale (t = 1 in steady-state decode; t > 1 is the FUSED
+    multi-row step: a speculative verify chunk or chunked-prefill tail
+    attending before its commit)."""
     from tfmesos_tpu.ops.quant import QTensor
 
     kc, vc, ksc, vsc, li, quantized = _stacked_cache(k_pool, v_pool, layer)
@@ -829,8 +861,8 @@ def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale,
         posv = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
         put = lambda view, c: jax.vmap(
             lambda v_, c_, p_: jax.lax.dynamic_update_slice(
-                v_, c_[:, None].astype(v_.dtype), (0, p_, 0)))(
-            view, c[:, 0], posv)
+                v_, c_.astype(v_.dtype), (0, p_, 0)))(
+            view, c.transpose(0, 2, 1, 3), posv)
         k_view = put(k_view, self_kv[0])
         v_view = put(v_view, self_kv[1])
     return _decode_reference(q, k_view, v_view, pos, scale)
@@ -838,28 +870,42 @@ def _paged_decode_reference(q, k_pool, v_pool, page_table, pos, scale,
 
 def _flash_decode_paged_kernel(s_ref, pt_ref, q_ref, k_ref, v_ref, *rest,
                                block_m: int, scale: float, quantized: bool,
-                               q_per_kv: int, kv: int,
+                               q_per_kv: int, head_block: int,
                                self_attend: bool = False):
-    """One (batch, logical-page) grid step of paged decode with ALL kv
-    heads FOLDED into the block: grid iterations cost ~2.3 µs each even
-    when the per-row bound skips their DMA (the scalar-table index map
-    defeats cheap elision — measured, v5e round 5), so iterating pages
-    once per head multiplied that overhead by KV.  One iteration now
-    fetches a page's whole [KV, page, d] slab (contiguous in the pool
-    layout) and runs the same online-softmax body per head against
-    per-head slices of the shared scratch.
+    """One (batch, head-block, logical-page) grid step of paged decode.
+
+    Grid iterations cost ~2.3 µs each even when the per-row bound skips
+    their DMA (the scalar-table index map defeats cheap elision —
+    measured, v5e round 5), so KV heads are FOLDED into the block in
+    slabs of ``head_block`` heads: one iteration fetches a page's
+    [head_block, page, d] slab (contiguous in the pool layout) and runs
+    the online-softmax body per head against per-head slices of the
+    shared scratch.  The head-block dimension is PARALLEL
+    (``dimension_semantics`` — head blocks share no accumulator state,
+    so Mosaic may split them across megacore) while pages stay
+    sequential for the scratch accumulation; when one slab holds every
+    head (the ``_paged_head_block`` common case) the head dimension is
+    size 1 and the layout degenerates to the fully kv-folded grid.
 
     Index maps chase this row's physical page id through the
     scalar-prefetched page table, so each row's cache lives in scattered
     pool pages and rows share one physical pool; ``s_ref`` rows are
     (n_live_blocks, position bound, layer index), as in
     ``_flash_decode_kernel``, whose per-head math (including the
-    quantized scale folds) this kernel reproduces slice for slice — plus
-    the deferred-write ``self_attend`` block, a paged-only feature: the
-    uncommitted current token's K/V rides in as a one-slot fp operand
-    accumulated at the last grid step (the caller passes the EXCLUSIVE
-    bound/position, so the stale pool slot at the token's own position
-    is never read)."""
+    quantized scale folds) this kernel reproduces slice for slice.
+
+    ``self_attend`` (deferred-write decode, a paged-only feature): the
+    uncommitted chunk's K/V rides in as a [head_block, t, d] fp operand
+    accumulated at the last page step.  The pool bound is then
+    EXCLUSIVE and token-independent — ``kpos > bound`` with
+    bound = pos - 1, because the pool only holds committed positions
+    < pos and the slots at [pos, pos + t - 1] are stale for EVERY chunk
+    token — and the intra-chunk causal structure lives in the self
+    block instead (chunk token tt attends self slots ss <= tt).  This
+    is the FUSED multi-row step: a t-token chunk (speculative verify /
+    chunked-prefill tail) retires t decode rows through ONE launch per
+    layer, the page table scalar-prefetched once for the whole chunk
+    instead of once per step."""
     del pt_ref  # consumed by the index maps
     it = list(rest)
     ks_ref = vs_ref = kself_ref = vself_ref = None
@@ -871,9 +917,9 @@ def _flash_decode_paged_kernel(s_ref, pt_ref, q_ref, k_ref, v_ref, *rest,
         it = it[2:]
     o_ref, o_acc, m_acc, l_acc = it
     bi = pl.program_id(0)
-    j = pl.program_id(1)
+    j = pl.program_id(2)
     nb = s_ref[0, bi]
-    pos = s_ref[1, bi]
+    bound = s_ref[1, bi]
     tg = q_ref.shape[2]                         # t * g rows per head
 
     @pl.when(j == 0)
@@ -885,34 +931,47 @@ def _flash_decode_paged_kernel(s_ref, pt_ref, q_ref, k_ref, v_ref, *rest,
     @pl.when(j < nb)
     def _step():
         kpos0 = j * block_m
-        for h in range(kv):
+        for h in range(head_block):
             sl = slice(h * tg, (h + 1) * tg)
             q = q_ref[0, h, :, :]               # [tg, d]
             s = _decode_block_scores(
                 q, k_ref[0, 0, h, :, :], scale,
                 ks_ref[0, 0, h, 0, :] if quantized else None)
             kpos = kpos0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            tt = jax.lax.broadcasted_iota(jnp.int32, s.shape,
-                                          0) // q_per_kv
-            s = jnp.where(kpos > pos + tt, NEG_INF, s)
+            if self_attend:
+                # Committed positions only, for every chunk token: the
+                # chunk's own span is stale in the pool and rides the
+                # self block, which carries the causal mask.
+                s = jnp.where(kpos > bound, NEG_INF, s)
+            else:
+                tt = jax.lax.broadcasted_iota(jnp.int32, s.shape,
+                                              0) // q_per_kv
+                s = jnp.where(kpos > bound + tt, NEG_INF, s)
             m_acc[sl], l_acc[sl], o_acc[sl] = _decode_accumulate(
                 s, v_ref[0, 0, h, :, :], (m_acc[sl], l_acc[sl], o_acc[sl]),
                 vs_ref[0, 0, h, 0, :] if quantized else None)
 
     if self_attend:
-        @pl.when(j == pl.num_programs(1) - 1)
+        @pl.when(j == pl.num_programs(2) - 1)
         def _self():
-            for h in range(kv):
+            for h in range(head_block):
                 sl = slice(h * tg, (h + 1) * tg)
                 q = q_ref[0, h, :, :]
                 s = _decode_block_scores(q, kself_ref[0, h, :, :], scale)
+                # Intra-chunk causality: self slot ss holds chunk token
+                # ss's K/V, and row tt attends slots <= tt (t = 1 masks
+                # nothing — the single-token deferred step unchanged).
+                ss = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                tt = jax.lax.broadcasted_iota(jnp.int32, s.shape,
+                                              0) // q_per_kv
+                s = jnp.where(ss > tt, NEG_INF, s)
                 m_acc[sl], l_acc[sl], o_acc[sl] = _decode_accumulate(
                     s, vself_ref[0, h, :, :],
                     (m_acc[sl], l_acc[sl], o_acc[sl]))
 
-    @pl.when(j == pl.num_programs(1) - 1)
+    @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
-        for h in range(kv):
+        for h in range(head_block):
             sl = slice(h * tg, (h + 1) * tg)
             o_ref[0, h, :, :] = (o_acc[sl] / l_acc[sl]).astype(o_ref.dtype)
 
@@ -941,7 +1000,17 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     per-position scales fold into the score rows in-kernel);
     ``pos``: scalar or [B] int32 — positions [0..pos(+t-1)] must be
     backed by pages.  Returns q's shape.
+
+    ``self_kv`` (deferred-write decode): the uncommitted chunk's
+    ([B, t, KV, D], [B, t, KV, D]) K/V attends as a SELF operand while
+    the pool still holds only positions < pos — t = 1 is the
+    steady-state deferred step, t > 1 the FUSED multi-row step
+    (speculative verify / chunked-prefill tails): t decode rows retire
+    through one launch per layer, the page table prefetched once for
+    the chunk (int8 pools: pre-quantize-dequantize the chunk so its
+    numerics match a committed slot).
     """
+    PAGED_CALL_STATS["calls"] += 1
     kp, vp, ksc, vsc, li, quantized = _stacked_cache(k_pool, v_pool, layer)
     squeeze = q.ndim == 3
     if squeeze:
@@ -949,27 +1018,25 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     b, t, h, d = q.shape
     kv, ps = kp.shape[2], kp.shape[3]
     _check_gqa_heads(q, kp, vp)     # kv heads at axis 2 of the pool
-    if self_kv is not None and t != 1:
-        raise ValueError("self_kv (deferred-write decode) is a "
-                         "single-token path; chunks commit their writes "
-                         "before attending")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     g = h // kv
-    # Blocks carry a page's whole [KV, page, d] slab (kv-folded grid), so
-    # the guard bounds VMEM too: K + V slabs, double-buffered, must
-    # leave room for scratch in the ~16 MB core budget.
-    slab = kv * ps * d * kp.dtype.itemsize
-    aligned = ps % 8 == 0 and ps <= 1024 and 4 * slab <= 8 * 2 ** 20
+    # Blocks carry a page's [head_block, page, d] slab per grid cell
+    # (head-blocked grid, head_block | kv): eligibility only requires
+    # the SINGLE-head slab to fit the VMEM budget — _paged_head_block
+    # then folds as many heads per cell as the budget allows (all of
+    # them in the common case), so big kv x page x d products shrink
+    # the head block instead of losing the kernel.
+    aligned = (ps % 8 == 0 and ps <= 1024
+               and 4 * ps * d * kp.dtype.itemsize <= _PAGED_VMEM_BUDGET)
     if use_pallas is None:
         on_tpu = jax.default_backend() == "tpu"
         use_pallas = aligned and (on_tpu or interpret)
     elif use_pallas and not aligned:
         raise ValueError(
             f"flash_decode_paged(use_pallas=True): page_size {ps} with "
-            f"{kv} kv heads x d={d} is not kernel-eligible (page must be "
-            f"a multiple of 8, <= 1024, and the kv-folded K/V slabs must "
-            f"fit VMEM)")
+            f"d={d} is not kernel-eligible (page must be a multiple of "
+            f"8, <= 1024, and one head's K/V slabs must fit VMEM)")
     if not use_pallas:
         out = _paged_decode_reference(q, k_pool, v_pool, page_table, pos,
                                       scale, layer=layer, self_kv=self_kv)
@@ -994,19 +1061,25 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
     qt = q.reshape(b, t, kv, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, kv, t * g, d)
 
-    # KV heads are FOLDED into the block (grid (b, page), not
-    # (b, kv, page)): a grid iteration costs ~2.3 us even when skipped,
-    # so per-head page loops multiplied pure overhead by KV.  One
-    # iteration fetches a page's whole [KV, page, d] slab — contiguous
-    # in the pool layout, so the DMA stays one dense block.
-    q_spec = pl.BlockSpec((1, kv, t * g, d),
-                          lambda bi, j, s, pt: (bi, 0, 0, 0),
+    PAGED_CALL_STATS["kernel_calls"] += 1
+    # KV heads are FOLDED into the block in head_block slabs (grid
+    # (b, kv // head_block, page)): a grid iteration costs ~2.3 us even
+    # when skipped, so per-head page loops multiplied pure overhead by
+    # KV.  One iteration fetches a page's [head_block, page, d] slab —
+    # contiguous in the pool layout, so the DMA stays one dense block —
+    # and the head dimension is PARALLEL: blocks share no accumulator,
+    # so when VMEM forces head_block < kv the per-slab work spreads
+    # across megacore instead of serializing inside one cell.
+    head_block = _paged_head_block(kv, ps, d, kp.dtype.itemsize)
+    n_hb = kv // head_block
+    q_spec = pl.BlockSpec((1, head_block, t * g, d),
+                          lambda bi, hi, j, s, pt: (bi, hi, 0, 0),
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec(
-        (1, 1, kv, ps, d),
-        lambda bi, j, s, pt: (
+        (1, 1, head_block, ps, d),
+        lambda bi, hi, j, s, pt: (
             s[2, 0], pt[bi, jnp.maximum(jnp.minimum(j, s[0, bi] - 1), 0)],
-            0, 0, 0),
+            hi, 0, 0),
         memory_space=pltpu.VMEM)
     in_specs = [q_spec, kv_spec, kv_spec]
     operands = [qt, kp, vp]     # pools already (page, head_dim)-trailing
@@ -1014,48 +1087,65 @@ def flash_decode_paged(q, k_pool, v_pool, page_table, pos,
         # Scales as [L, P, KV, 1, page]: positions on the lane dim, same
         # page-chasing index map as their values.
         sc_spec = pl.BlockSpec(
-            (1, 1, kv, 1, ps),
-            lambda bi, j, s, pt: (
+            (1, 1, head_block, 1, ps),
+            lambda bi, hi, j, s, pt: (
                 s[2, 0],
                 pt[bi, jnp.maximum(jnp.minimum(j, s[0, bi] - 1), 0)],
-                0, 0, 0),
+                hi, 0, 0),
             memory_space=pltpu.VMEM)
         in_specs += [sc_spec, sc_spec]
         operands += [ksc, vsc]                      # already lane-major
     if self_kv is not None:
-        # [B, 1, KV, D] model-layout chunks -> [B, KV, 1, D] one-slot
-        # fp blocks (int8 pools: the caller pre-quantize-dequantizes so
+        # [B, t, KV, D] model-layout chunks -> [B, KV, t, D] t-slot fp
+        # blocks (int8 pools: the caller pre-quantize-dequantizes so
         # numerics match a committed slot exactly).
         kself, vself = (c.transpose(0, 2, 1, 3).astype(q.dtype)
                         for c in self_kv)
-        self_spec = pl.BlockSpec((1, kv, 1, d),
-                                 lambda bi, j, s, pt: (bi, 0, 0, 0),
+        self_spec = pl.BlockSpec((1, head_block, t, d),
+                                 lambda bi, hi, j, s, pt: (bi, hi, 0, 0),
                                  memory_space=pltpu.VMEM)
         in_specs += [self_spec, self_spec]
         operands += [kself, vself]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, page_table.shape[1]),
+        grid=(b, n_hb, page_table.shape[1]),
         in_specs=in_specs,
         out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((kv * t * g, d), jnp.float32),
-                        pltpu.VMEM((kv * t * g, 1), jnp.float32),
-                        pltpu.VMEM((kv * t * g, 1), jnp.float32)])
+        scratch_shapes=[pltpu.VMEM((head_block * t * g, d), jnp.float32),
+                        pltpu.VMEM((head_block * t * g, 1), jnp.float32),
+                        pltpu.VMEM((head_block * t * g, 1), jnp.float32)])
+    # Static cost estimate for the head-blocked grid.  bytes_accessed
+    # charges the slabs this call can actually DMA — b rows x live
+    # pages x one K + one V [KV, page, d] slab — never the WHOLE pool
+    # (the old estimate charged pool bytes: a 1000-page pool serving 4
+    # rows x 16 live pages overstated the traffic ~30x and mis-ranked
+    # the kernel for the XLA scheduler).  flops/transcendentals use the
+    # per-row block bound when ``pos`` is concrete (direct calls,
+    # tests, benches); under jit the bound is traced and the TABLE
+    # width is the static ceiling — the in-kernel bound still skips the
+    # dead iterations either way.
+    np_ = page_table.shape[1]
+    try:
+        est_nb = int(jnp.max(nb))
+    except jax.errors.ConcretizationTypeError:
+        est_nb = np_
+    est_nb = max(1, min(est_nb, np_))
+    slab_bytes = kv * ps * d * kp.dtype.itemsize
     out = pl.pallas_call(
         functools.partial(_flash_decode_paged_kernel, block_m=ps,
                           scale=float(scale), quantized=quantized,
-                          q_per_kv=g, kv=kv,
+                          q_per_kv=g, head_block=head_block,
                           self_attend=self_kv is not None),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
-            flops=4 * b * t * h * page_table.shape[1] * ps * d,
-            bytes_accessed=(kp[0].size * kp.dtype.itemsize * 2
+            flops=4 * b * t * h * est_nb * ps * d,
+            bytes_accessed=(2 * b * est_nb * slab_bytes
                             + 2 * q.size * q.dtype.itemsize),
-            transcendentals=b * t * h * page_table.shape[1] * ps),
+            transcendentals=b * t * h * est_nb * ps),
     )(scalars, page_table, *operands)
     out = out.reshape(b, kv, t, g, d).transpose(0, 2, 1, 3, 4).reshape(
         b, t, h, d)

@@ -78,9 +78,11 @@ _KICK = object()
 #: here, so a new bypass cannot land silently and a removed one cannot
 #: regress.  History: "speculative decoding" was burned out of the
 #: ``prefix_cache`` and ``kv_tier`` registries (spec rows are
-#: first-class citizens of the paged-KV machinery now); the two
-#: remaining spec gaps are constructor REJECTIONS, not bypasses
-#: (spec+multi_step, spec overlap+pipeline_depth — see __init__).
+#: first-class citizens of the paged-KV machinery now), and the former
+#: constructor REJECTIONS became composition or enforced entries here:
+#: spec+multi_step now COMPOSES (R spec rounds per dispatch — see
+#: ``_make_spec_round``), overlap+pipeline and suspend-under-lag are
+#: enforced bypasses below.
 BYPASS_ALLOWLIST = {
     # An int8 pool's tail-recompute path (chunk writer) is not
     # bit-stable against the cold fused prefill, so shared pages could
@@ -90,10 +92,35 @@ BYPASS_ALLOWLIST = {
     # Mesh data shards pin pages locally (no single-shard scatter to
     # move), and the int8 tail recompute above breaks resume==cold.
     "kv_tier": ("mesh data sharding", "quantized kv cache"),
-    # Speculative overlap already carries its round state on device;
-    # composing it with the pipelined carry is the documented remaining
-    # gap (ROADMAP item 6 out-of-scope note).
+    # Speculative overlap already carries its round state on device —
+    # measured equal-or-better than the pipelined carry would be on
+    # the same workload (bench_serving_spec_compose's overlap arm vs
+    # bench_serving_pipeline: both remove the per-block host sync, and
+    # a spec round retires up to n_draft+1 tokens per sync where the
+    # pipelined carry retires multi_step) — so pipeline_depth on a
+    # speculative batcher records this instead of double-carrying.
     "pipeline": ("speculative decoding",),
+    # pipeline_depth=1's device-resident carry already removes the
+    # host round-trip overlap double-buffers away (measured: the
+    # pipelined inter-token p50 is asserted strictly below the
+    # synchronous loop's in bench_serving_pipeline, the same sync
+    # overlap hides), so overlap under an active pipeline is redundant
+    # — recorded, not rejected.
+    "overlap": ("pipelined decode carry",),
+    # Speculative overlap rounds already fuse n_draft+1 tokens per
+    # dispatch AND hide the host sync behind the next round
+    # (bench_serving_spec_compose measures the round itself at one
+    # verify launch per layer); folding extra sync rounds under the
+    # in-graph carry would lag commits R rounds behind the host for
+    # no additional sync savings, so multi_step collapses to the
+    # round's natural width there.
+    "multi_step": ("speculative overlap round carry",),
+    # Per-row suspend/export needs a host-synchronous row snapshot;
+    # overlap/pipelined modes carry in-flight device state the host
+    # view lags one block behind (the lag IS the measured win:
+    # bench_serving_pipeline's p50 gap), and mesh data shards pin
+    # pages locally like the kv_tier/export surface.
+    "suspend": ("mesh data sharding", "lagged decode carry"),
 }
 
 
@@ -101,7 +128,9 @@ def compute_bypass_reasons(*, speculative: bool = False,
                            n_shards: int = 1,
                            quantized_cache: bool = False,
                            draft_quantized_cache: bool = False,
-                           pipeline_depth: int = 0
+                           pipeline_depth: int = 0,
+                           overlap: bool = False,
+                           multi_step: int = 1
                            ) -> Dict[str, Optional[str]]:
     """The ``*_bypass_reason`` values a :class:`ContinuousBatcher`
     built from these mode flags records — ONE pure function, used by
@@ -110,7 +139,8 @@ def compute_bypass_reasons(*, speculative: bool = False,
     mirror :data:`BYPASS_ALLOWLIST`; ``None`` = the feature composes."""
     quant = quantized_cache or (speculative and draft_quantized_cache)
     out: Dict[str, Optional[str]] = {
-        "prefix_cache": None, "kv_tier": None, "pipeline": None}
+        "prefix_cache": None, "kv_tier": None, "pipeline": None,
+        "overlap": None, "multi_step": None, "suspend": None}
     if quant:
         out["prefix_cache"] = "quantized kv cache"
     if n_shards != 1:
@@ -119,6 +149,19 @@ def compute_bypass_reasons(*, speculative: bool = False,
         out["kv_tier"] = "quantized kv cache"
     if pipeline_depth and speculative:
         out["pipeline"] = "speculative decoding"
+    # Effective lag modes AFTER the cross-bypasses above: overlap
+    # yields to an ACTIVE pipeline (non-spec), and the pipeline itself
+    # yields to speculation.
+    pipelined = bool(pipeline_depth) and not speculative
+    if pipelined and overlap:
+        out["overlap"] = "pipelined decode carry"
+    overlap_eff = overlap and out["overlap"] is None
+    if speculative and multi_step > 1 and overlap_eff:
+        out["multi_step"] = "speculative overlap round carry"
+    if n_shards != 1:
+        out["suspend"] = "mesh data sharding"
+    elif overlap_eff or pipelined:
+        out["suspend"] = "lagged decode carry"
     return out
 
 
@@ -1212,9 +1255,21 @@ class ContinuousBatcher:
     are unchanged).  Composes with ``multi_step``, chunked prefill,
     int8 pools, ``mesh``, ``prefix``, and the prefix cache; speculative
     decoding BYPASSES explicitly (``pipeline_bypass_reason`` — its
-    overlap mode already carries state on device); ``overlap=True``
-    plus ``pipeline_depth=1`` is rejected (pick one).  ``0`` preserves
-    the synchronous loop exactly.
+    overlap mode already carries state on device), and ``overlap=True``
+    plus ``pipeline_depth=1`` records ``overlap_bypass_reason`` (the
+    pipelined carry already double-buffers) with overlap collapsing to
+    off.  ``0`` preserves the synchronous loop exactly.
+
+    ``multi_step`` composes with speculative decoding synchronously: R
+    = ceil(multi_step / (n_draft+1)) rounds fuse into ONE dispatch,
+    chained in-graph from each round's commit counts, committed
+    round-by-round on the host.  Under speculative ``overlap`` the
+    round carry supersedes it (``multi_step_bypass_reason``).  The
+    ``suspend`` registry gates :attr:`preemptible` the same enumerable
+    way: per-row suspend/export needs the host-synchronous single-shard
+    loop, so overlap/pipelined (lagged carry) and mesh-sharded
+    batchers record ``suspend_bypass_reason`` and requeue on
+    preemption instead of exporting.
 
     :meth:`warmup` compiles every jitted entry point the configured
     mode can dispatch (admission prefill, chunk prefill, decode block
@@ -1325,20 +1380,10 @@ class ContinuousBatcher:
                              f"{prefix_cache_pages}")
         if multi_step < 1:
             raise ValueError(f"multi_step must be >= 1, got {multi_step}")
-        if multi_step > 1 and draft_cfg is not None:
-            raise ValueError(
-                "multi_step does not compose with speculative decoding — "
-                "a speculative round already commits up to n_draft+1 "
-                "tokens per dispatch; use one or the other")
         if pipeline_depth not in (0, 1):
             raise ValueError(f"pipeline_depth must be 0 (synchronous "
                              f"host sync) or 1 (one block of device-"
                              f"resident lag), got {pipeline_depth}")
-        if pipeline_depth and overlap:
-            raise ValueError(
-                "pipeline_depth=1 already double-buffers the decode loop "
-                "with a device-resident carry; drop overlap=True (use "
-                "overlap alone for speculative double-buffering)")
         self.multi_step = int(multi_step)
         self.overlap = bool(overlap)
         # Pipelined device-resident decode (pipeline_depth=1): block N+1
@@ -1383,16 +1428,40 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"tp ({self._tp}) must divide kv_heads "
                     f"({cfg.kv_heads}) and n_heads ({cfg.n_heads})")
-        # All three ``*_bypass_reason`` registries come from ONE pure
+        # All ``*_bypass_reason`` registries come from ONE pure
         # helper (compute_bypass_reasons) so the audit test can
         # enumerate every reachable value against BYPASS_ALLOWLIST.
         self._bypass = compute_bypass_reasons(
             speculative=draft_cfg is not None, n_shards=self.n_shards,
             quantized_cache=quantized_cache,
             draft_quantized_cache=draft_quantized_cache,
-            pipeline_depth=pipeline_depth)
+            pipeline_depth=pipeline_depth,
+            overlap=overlap, multi_step=multi_step)
         self.pipeline_bypass_reason: Optional[str] = \
             self._bypass["pipeline"]
+        # overlap+pipeline and spec-overlap+multi_step are BYPASSES
+        # now, not constructor rejections: the requested flag is
+        # recorded with its measured reason and the effective mode
+        # collapses to the carry that already covers it.
+        self.overlap_bypass_reason: Optional[str] = \
+            self._bypass["overlap"]
+        self.multi_step_bypass_reason: Optional[str] = \
+            self._bypass["multi_step"]
+        self.suspend_bypass_reason: Optional[str] = \
+            self._bypass["suspend"]
+        if self.overlap_bypass_reason is not None:
+            self.overlap = False
+        # Speculative multi_step>1: under overlap the round carry
+        # supersedes it (bypass above); synchronously it composes as R
+        # fused rounds per dispatch (see _make_spec_round).
+        if draft_cfg is not None:
+            if self.multi_step_bypass_reason is not None:
+                self._spec_rounds = 1
+            else:
+                self._spec_rounds = max(
+                    1, -(-self.multi_step // max(1, n_draft + 1)))
+        else:
+            self._spec_rounds = 0
         self.max_len = int(max_len or cfg.max_seq_len)
         if self.max_len > cfg.max_seq_len:
             raise ValueError(f"max_len ({self.max_len}) exceeds the "
@@ -1626,9 +1695,25 @@ class ContinuousBatcher:
         blocks.  Non-preemptible batchers still honor
         :meth:`preempt_all`, by REQUEUEING every in-flight request
         (lossless through deterministic re-execution) instead of
-        exporting it."""
-        return (self.n_shards == 1
-                and not self.overlap and not self._pipelined)
+        exporting it.  The gate IS the registry: ``suspend``'s
+        bypass-reason entry (None = suspendable), so the audit test
+        enumerates exactly when rows can be snapshotted."""
+        return self.suspend_bypass_reason is None
+
+    def paged_launches_per_block(self, block_tokens: int = 16) -> int:
+        """Paged-attention kernel launches PER LAYER needed to retire
+        ``block_tokens`` decode tokens of one row under this batcher's
+        mode — the device-floor metric bench_decode_paged_call tracks
+        (BASELINE.md's "8 launches x ~0.54 ms" block cost).  Analytic
+        rather than counter-sampled because jit traces the kernel call
+        once per compiled step regardless of how many times the XLA
+        loop replays it.  Synchronous decode pays one launch per token;
+        a speculative round retires up to n_draft+1 tokens through ONE
+        fused (t=n_draft+1) verify launch, so 16-token blocks need
+        ceil(16 / (n_draft+1)) launches — <= 2 at n_draft >= 7."""
+        if self.draft_cfg is not None:
+            return -(-int(block_tokens) // (self.n_draft + 1))
+        return int(block_tokens)
 
     def preempt_all(self) -> None:
         """Ask the serve loop to give back EVERY in-flight request as a
@@ -2132,14 +2217,43 @@ class ContinuousBatcher:
             return pool_out, dpool, vals, a + 1
 
         if not self.overlap:
+            # multi_step>1 composes with synchronous speculation as R =
+            # ceil(multi_step/(k+1)) rounds fused in ONE dispatch: each
+            # round chains from the previous round's last-committed
+            # token/positions IN-GRAPH (the same take_along_axis chain
+            # the overlap carry uses), so the host syncs once per R
+            # rounds.  Rows that finish (stop/quota) mid-dispatch keep
+            # executing later rounds on device; their writes land on
+            # sink-clamped table columns and the host discards their
+            # tokens at commit — the same overrun argument the plain
+            # multi_step path documents at _worst_pages.
+            R = max(1, self._spec_rounds)
+
             @partial(jax.jit, donate_argnums=(1, 3))
             def fn(params, pool, dparams, dpool, table, dtable, toks,
                    positions, rids, steps):
-                pool_out, dpool_out, g, counts = body(
-                    params, pool, dparams, dpool, table, dtable, toks,
-                    positions, rids, steps)
-                return (pool_out, dpool_out, self._host_read(g),
-                        self._host_read(counts))
+                if R == 1:
+                    pool_out, dpool_out, g, counts = body(
+                        params, pool, dparams, dpool, table, dtable,
+                        toks, positions, rids, steps)
+                    return (pool_out, dpool_out, self._host_read(g),
+                            self._host_read(counts))
+                gs, ns = [], []
+                for _ in range(R):
+                    pool, dpool, g, counts = body(
+                        params, pool, dparams, dpool, table, dtable,
+                        toks, positions, rids, steps)
+                    gs.append(g)
+                    ns.append(counts)
+                    last = jnp.maximum(counts - 1, 0)
+                    toks = jnp.take_along_axis(
+                        g, last[:, None], axis=1)[:, 0]
+                    positions = positions + counts
+                    steps = steps + counts
+                # [R, rows, k+1] / [R, rows] — _step_spec commits
+                # round-by-round so quota/stop truncation stays exact.
+                return (pool, dpool, self._host_read(jnp.stack(gs)),
+                        self._host_read(jnp.stack(ns)))
 
             return fn
 
@@ -4385,8 +4499,12 @@ class ContinuousBatcher:
 
     def _step_spec(self, active: Dict[int, _Row],
                    free_rows: List[int]) -> Iterator[Completion]:
-        """One speculative round over every decoding row: commit each
-        row's leading accepted run + correction (1..n_draft+1 tokens)."""
+        """One speculative dispatch over every decoding row: commit
+        each row's leading accepted run + correction (1..n_draft+1
+        tokens) — times R in-graph rounds when multi_step composes
+        (R = _spec_rounds > 1), committed round-by-round so stop/quota
+        truncation is exact per round."""
+        R = max(1, self._spec_rounds)
         toks = np.zeros((self.rows,), np.int32)
         # Rows with no live request still run the jitted round: park their
         # positions at max_len (within the draft cache's +n_draft slack,
@@ -4399,8 +4517,13 @@ class ContinuousBatcher:
         decoding = {r: row for r, row in active.items() if row.decoding}
         for r, row in decoding.items():
             # The verify chunk writes positions [pos, pos + n_draft] (and
-            # the draft's k+1 scan steps write the same range of ITS pool).
-            self._ensure_sides(r, row.pos + self.n_draft + 1)
+            # the draft's k+1 scan steps write the same range of ITS
+            # pool); R fused rounds extend the worst case to
+            # R*(n_draft+1), clamped at limit — past-limit writes land
+            # on sink-clamped columns and their tokens are discarded at
+            # commit (same overrun argument as plain multi_step).
+            self._ensure_sides(r, min(row.pos + R * (self.n_draft + 1),
+                                      row.limit))
             toks[r] = row.last
             positions[r] = row.pos
             rids[r] = row.rid
@@ -4413,13 +4536,20 @@ class ContinuousBatcher:
             jnp.asarray(rids), jnp.asarray(steps))
         g = np.asarray(g)
         n_commit = np.asarray(n_commit)
+        if R == 1:
+            g, n_commit = g[None], n_commit[None]   # [R=1, rows, ...]
         # Observability: the acceptance rate is THE speculative-serving
         # health number (a weak draft only costs rate, never correctness).
-        self.spec_rounds += 1
-        self.spec_committed += int(sum(int(n_commit[r]) for r in decoding))
-        self.spec_row_rounds += len(decoding)
-        yield from self._commit_rows(g, n_commit, list(decoding), active,
-                                     free_rows)
+        self.spec_rounds += R
+        for i in range(R):
+            live = [r for r in decoding if r in active]
+            if not live:
+                break
+            self.spec_committed += int(sum(int(n_commit[i, r])
+                                           for r in live))
+            self.spec_row_rounds += len(live)
+            yield from self._commit_rows(g[i], n_commit[i], live, active,
+                                         free_rows)
 
     def _commit_rows(self, g, nc, rows, active: Dict[int, _Row],
                      free_rows: List[int]) -> Iterator[Completion]:
