@@ -379,6 +379,104 @@ def test_autoscaler_default_signals_windowed_p99_util_headroom():
     assert sig2["decode"]["util"] == 0.0
 
 
+def test_autoscaler_kv_tier_pressure_blocks_down_and_thrash_scales_up():
+    """KV-tier occupancy + hit rate are first-class inputs next to
+    queue wait: a saturated tier blocks scale-down (the victim's tier
+    RAM would evict parked sessions), and saturated + THRASHING — a
+    low windowed hit rate says traffic wants what's being evicted —
+    arms scale-up even with a calm queue."""
+    reg = FakeRegistry([_rep("a:1"), _rep("a:2")])
+    fleet = FakeFleet(reg, {"unified": 2}, bounds=(1, 4))
+    sig = {"unified": dict(CALM, kv_occupancy=0.95, kv_hit_rate=0.6)}
+    clock = [100.0]
+    auto = _auto(fleet, sig, clock, scale_up_cooldown=0.0,
+                 scale_down_cooldown=0.0)
+    auto.step()                     # calm queue, but the tier is full
+    assert fleet.targets["unified"] == 2    # down blocked
+    assert not reg.drained
+    sig["unified"]["kv_hit_rate"] = 0.05    # now thrashing too
+    clock[0] = 200.0
+    auto.step()
+    assert fleet.targets["unified"] == 3    # scale-up armed
+    assert fleet.metrics.get("autoscale_up") == 1
+    # Tier cool again: calm queue resumes normal scale-down.
+    sig["unified"] = dict(CALM, kv_occupancy=0.1, kv_hit_rate=0.9)
+    clock[0] = 300.0
+    auto.step()
+    assert fleet.targets["unified"] == 2
+    # Absent signals (no tiered replicas) never block or arm anything.
+    sig["unified"] = dict(CALM, kv_occupancy=None, kv_hit_rate=None)
+    clock[0] = 400.0
+    auto.step()
+    assert fleet.targets["unified"] == 1
+
+
+def test_autoscaler_kv_role_tier_stays_pinned():
+    """Dedicated KV-role holders emit no queue-wait or utilization
+    signal, so the loop would only ever shrink them — and every
+    shrink throws away parked copies.  The tier never retargets
+    (plain and composite model/kv keys both), but convergence still
+    relaunches a crashed holder."""
+    reg = FakeRegistry([_rep("k:1", role="kv"), _rep("a:1")])
+    fleet = FakeFleet(reg, {"kv": 1, "m/kv": 1, "unified": 1},
+                      bounds=(1, 4))
+    sig = {"kv": dict(CALM), "m/kv": dict(SURGE), "unified": dict(MID)}
+    clock = [100.0]
+    auto = _auto(fleet, sig, clock, scale_up_cooldown=0.0,
+                 scale_down_cooldown=0.0)
+    auto.step()
+    assert fleet.targets["kv"] == 1 and fleet.targets["m/kv"] == 1
+    assert not reg.drained
+    # Crash relaunch (convergence) still covers the pinned tier.
+    fleet._actual["kv"] = 0
+    clock[0] = 200.0
+    auto.step()
+    assert ("kv", "kv:0") in fleet.launched
+    assert fleet.targets["kv"] == 1
+
+
+def test_autoscaler_default_signals_kv_occupancy_and_windowed_hit_rate():
+    """The real signal source reads the registry's fleet KV aggregate:
+    occupancy = used/budget, hit rate windowed across ticks with
+    counter deltas clamped at zero (a dying replica's counters leaving
+    the aggregate must not read as negative traffic)."""
+    agg = {"replicas": 2, "sessions": 4, "ram_bytes_used": 900,
+           "ram_bytes": 1000, "hits": 100, "misses": 100}
+
+    class KvRegistry(FakeRegistry):
+        def kv_tier_summary(self):
+            return dict(agg)
+
+    reg = KvRegistry([_rep("a:1"), _rep("a:2")])
+    fleet = FakeFleet(reg, {"unified": 2})
+    auto = FleetAutoscaler(fleet, AutoscalerConfig(), clock=lambda: 0.0)
+    sig = auto._default_signals()["unified"]
+    assert sig["kv_occupancy"] == pytest.approx(0.9)
+    # First tick windows from zero — counters start at replica boot,
+    # so the lifetime rate IS the first window.
+    assert sig["kv_hit_rate"] == pytest.approx(0.5)
+    agg.update(hits=130, misses=170)        # +30 hits, +70 misses
+    sig = auto._default_signals()["unified"]
+    assert sig["kv_hit_rate"] == pytest.approx(0.3)
+    # A replica dies; its counters leave the aggregate.  The clamped
+    # window reports no traffic, not negative traffic.
+    agg.update(replicas=1, hits=60, misses=80, ram_bytes_used=400,
+               ram_bytes=500)
+    sig = auto._default_signals()["unified"]
+    assert sig["kv_hit_rate"] is None
+    assert sig["kv_occupancy"] == pytest.approx(0.8)
+    # No tiered replicas at all: both signals go silent.
+    agg.update(replicas=0)
+    sig = auto._default_signals()["unified"]
+    assert sig["kv_occupancy"] is None and sig["kv_hit_rate"] is None
+    # A registry without the aggregate (plain fleets) is fine too.
+    plain = FleetAutoscaler(FakeFleet(FakeRegistry([_rep("a:1")]),
+                                      {"unified": 1}),
+                            AutoscalerConfig(), clock=lambda: 0.0)
+    sig = plain._default_signals()["unified"]
+    assert sig["kv_occupancy"] is None and sig["kv_hit_rate"] is None
+
+
 def test_histogram_delta_percentile_is_windowed():
     h = Histogram()
     for _ in range(100):

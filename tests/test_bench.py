@@ -153,6 +153,27 @@ def test_serving_spec_compose_bench_smoke():
     assert spec_itl > 0 and base_itl > 0
     assert 0.0 <= accept <= 1.0
     assert resumes >= 0
+    # The fused-spec path's launch economics hold at this tiny shape
+    # too: a 16-step block through the multi-step verify costs <= 2
+    # paged launches, against the synchronous analytic 16 — the same
+    # keys bench_decode_paged_call promotes to first-class metrics.
+    import jax
+    import jax.numpy as jnp
+
+    from tfmesos_tpu.models import transformer
+    from tfmesos_tpu.serving import ContinuousBatcher
+
+    cfg, params, _, max_len, _ = bench._serving_bench_setup(True)
+    dcfg = transformer.TransformerConfig(
+        vocab_size=cfg.vocab_size, d_model=16, n_layers=1, n_heads=2,
+        d_ff=32, max_seq_len=max_len + 8, dtype=jnp.float32)
+    dparams = transformer.init_params(dcfg, jax.random.PRNGKey(1))
+    spec = ContinuousBatcher(cfg, params, rows=2, max_len=max_len,
+                             draft_cfg=dcfg, draft_params=dparams,
+                             n_draft=7)
+    assert spec.paged_launches_per_block(16) <= 2
+    sync = ContinuousBatcher(cfg, params, rows=2, max_len=max_len)
+    assert sync.paged_launches_per_block(16) == 16
 
 
 def test_serving_warmup_bench_smoke():
@@ -383,6 +404,29 @@ def test_fleet_sessions_bench_smoke():
     assert 0.0 <= hit_rate <= 1.0
     assert prefills == 1
     assert 0.0 <= aff <= 1.0
+
+
+@pytest.mark.slow
+def test_fleet_fabric_bench_smoke():
+    """The KV-fabric bench protocol at small size: direct peer
+    streaming vs the relay fallback on the real wire stack (strictly
+    faster asserted inside the bench), and a kv_replication=2 fleet
+    riding out a parker SIGKILL with every session resuming
+    token-identical on a survivor — zero lost, at least one forwarded
+    fabric fetch.  A pure CPU timing inversion on a loaded host only
+    skips."""
+    try:
+        direct_mb_s, relay_mb_s, resumed, fetch_hits = \
+            bench.bench_fleet_fabric(replicas=3, rows=2, workers=4,
+                                     n_sessions=4, n_transfers=8,
+                                     artifact_mb=0.5)
+    except AssertionError as e:
+        if "not above the relay fallback" in str(e):
+            pytest.skip(f"loaded-host timing inversion: {e}")
+        raise
+    assert direct_mb_s > relay_mb_s > 0
+    assert resumed == 4
+    assert fetch_hits >= 1
 
 
 @pytest.mark.slow

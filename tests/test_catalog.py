@@ -522,6 +522,67 @@ def test_trader_victim_tiebreak_prefers_parked_disk_sessions():
     assert fleet.targets[ka] == 2
 
 
+def test_trader_victim_pick_spares_the_actively_resuming_tier():
+    """Among equally-cold models the windowed KV-tier hit rate breaks
+    the tie BEFORE the parked-disk count: a model actively RESUMING
+    parked sessions pays real cold re-prefills if its replica drains,
+    so the trade takes the model whose tier sits idle."""
+    ka, kb, kc = model_key("a"), model_key("b"), model_key("c")
+    cat = ModelCatalog([ModelSpec("a", replicas=1),
+                        ModelSpec("b", replicas=2),
+                        ModelSpec("c", replicas=2)])
+    reg = _TradeRegistry([
+        _rep("a:0", "a"),
+        _rep("b:0", "b"), _rep("b:1", "b"),
+        _rep("c:0", "c"), _rep("c:1", "c")])
+    fleet = _StubTradeFleet(reg, {ka: 1, kb: 2, kc: 2}, budget=5)
+    # b and c identical on queue signals; b's tier is resuming hot,
+    # c's sits idle.
+    sig = {ka: dict(HOT),
+           kb: dict(WARM, kv_hit_rate=0.9),
+           kc: dict(WARM, kv_hit_rate=0.0)}
+    clock = [100.0]
+    tr = _trader(fleet, cat, sig, clock)
+    clock[0] += 10.0    # past the bring-up trade cooldown
+    tr.step()
+    assert fleet.targets[kc] == 1       # idle tier gave the replica up
+    assert fleet.targets[kb] == 2
+    assert fleet.targets[ka] == 2
+
+
+def test_trader_model_signals_window_kv_hit_rate_per_model():
+    """The built-in signal source windows each model's tier hit rate
+    from its members' heartbeat counters: deltas across ticks, clamped
+    at zero when a dying member's counters leave the sum, and the
+    off-tick PEEK never advances the window."""
+    ka = model_key("a")
+    cat = ModelCatalog([ModelSpec("a", replicas=2)])
+    tier0 = {"counters": {"hits": 10, "misses": 30}}
+    tier1 = {"counters": {"hits": 5, "misses": 5}}
+    reg = _TradeRegistry([_rep("a:0", "a", kv_tier=tier0),
+                          _rep("a:1", "a", kv_tier=tier1)])
+    fleet = _StubTradeFleet(reg, {ka: 2}, budget=3)
+    cfg = AutoscalerConfig()
+    tr = ModelTrader(fleet, cat, cfg, trader_config=TraderConfig(),
+                     clock=lambda: 0.0)
+    # The first tick only opens the window (a just-traded-in model
+    # must not be judged on another tenant's leftover counters).
+    assert tr._model_signals()[ka]["kv_hit_rate"] is None
+    tier0["counters"] = {"hits": 10, "misses": 70}  # +40 misses
+    # The PEEK sees the delta but must not consume the window...
+    assert tr._model_signals(advance=False)[ka]["kv_hit_rate"] \
+        == pytest.approx(0.0)
+    # ...so the real tick still sees it.
+    assert tr._model_signals()[ka]["kv_hit_rate"] == pytest.approx(0.0)
+    # A member dies; its counters leave the sum.  Clamped: no traffic,
+    # never negative.
+    reg.reps = [r for r in reg.reps if r.addr != "a:0"]
+    assert tr._model_signals()[ka]["kv_hit_rate"] is None
+    # Fresh traffic on the survivor re-opens the window.
+    tier1["counters"] = {"hits": 25, "misses": 5}
+    assert tr._model_signals()[ka]["kv_hit_rate"] == pytest.approx(1.0)
+
+
 # -- gateway + stub replicas: model routing, metering, cold start -----------
 
 

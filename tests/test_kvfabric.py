@@ -1,0 +1,550 @@
+"""The cross-host KV fabric (tfmesos_tpu/fleet/kvtier.py KVFabric +
+the registry's kv_peers/kv_locate placement ops) — all jax-free and
+zero-socket: replicated session parking with ack semantics, the
+registry-driven forwarded resume that survives parker death, fence and
+torn-gang rejection on peer fetch, and the chaos ``partition`` fault
+that drops frames between one peer pair while both stay
+registry-alive.  The serving-path halves (kv_stage staging, the
+router's brokered direct streams) live in tests/test_fleet.py and the
+fabric bench."""
+
+import threading
+
+import pytest
+
+from tfmesos_tpu import chaos, wire
+from tfmesos_tpu.fleet.kvtier import (KVFabric, KVTierFull, KVTierStore,
+                                      pack_gang_shards, rendezvous_order)
+from tfmesos_tpu.fleet.registry import ReplicaRegistry
+
+
+def _registry():
+    clock = [0.0]
+    reg = ReplicaRegistry(clock=lambda: clock[0])
+    return reg, clock
+
+
+class FabricNet:
+    """An in-process fabric mesh with ZERO sockets: ``rpc`` routes by
+    addr straight to each peer fabric's wire handlers, and the real
+    registry serves ``kv_peers``/``kv_locate`` exactly as the
+    heartbeat socket would — so every placement decision under test is
+    the production code path, only the transport is stubbed (the
+    chaos.py injectability discipline)."""
+
+    REG = "reg:0"
+
+    def __init__(self):
+        self.reg, self.clock = _registry()
+        self.fabrics = {}
+        self.roles = {}
+        self.dead = set()
+        self.rpc_log = []
+
+    def rpc(self, addr, meta, body=None, timeout=10.0):
+        self.rpc_log.append((addr, meta.get("op")))
+        if addr == self.REG:
+            if meta["op"] == "kv_peers":
+                return self.reg.kv_peers()
+            return self.reg.kv_locate(meta.get("kind"), meta.get("key"))
+        if addr in self.dead or addr not in self.fabrics:
+            raise ConnectionRefusedError(f"{addr} is down")
+        peer = self.fabrics[addr]
+        if meta.get("op") == "kv_put":
+            return peer.handle_put(wire.RawFrame(meta, body or b""))
+        return peer.handle_fetch(meta)
+
+    def add(self, addr, replication=2, wv="v1", role=None,
+            ram=1 << 20, disk_dir=None, disk_bytes=None):
+        stamp = {} if wv is None else {"weights_version": wv}
+        store = KVTierStore(ram_bytes=ram, disk_dir=disk_dir,
+                            disk_bytes=disk_bytes, token="tok",
+                            stamp=stamp)
+        fab = KVFabric(store, token="tok", self_addr=addr,
+                       registry_addr=self.REG,
+                       replication=replication, rpc=self.rpc,
+                       peer_ttl=0.0)
+        self.fabrics[addr] = fab
+        if role:
+            self.roles[addr] = role
+        self.beat(addr)
+        return fab
+
+    def beat(self, addr):
+        fab = self.fabrics[addr]
+        msg = {"op": "heartbeat", "addr": addr, "capacity": 4,
+               "outstanding": 0, "kv_tier": fab.summary()}
+        wv = fab.store.stamp.get("weights_version")
+        if wv:
+            msg["weights_version"] = wv
+        role = self.roles.get(addr)
+        if role:
+            msg["role"] = role
+        self.reg.observe(msg)
+
+    def beat_all(self):
+        for addr in self.fabrics:
+            if addr not in self.dead:
+                self.beat(addr)
+
+    def kill(self, addr):
+        """SIGKILL semantics: the process stops answering dials NOW,
+        and the registry marks it dead one sweep later."""
+        self.dead.add(addr)
+        self.clock[0] += 10.0
+        self.beat_all()
+        self.reg.sweep()
+
+
+# -- replicated parking ------------------------------------------------------
+
+
+def test_replicated_park_lands_a_peer_copy():
+    """A park with replication=2 acknowledges only after the artifact
+    lands on the primary PLUS one rendezvous-picked peer — both stores
+    hold byte-identical copies carrying the parker's fence stamp."""
+    net = FabricNet()
+    a = net.add("a:1", replication=2)
+    b = net.add("b:1", replication=2)
+    a.park("conv", {"n": 1}, b"kv-bytes" * 50)
+    got = b.store.get("session", "conv")
+    assert got is not None, "no peer copy landed"
+    meta, body = got
+    assert body == b"kv-bytes" * 50
+    # The copy carries the ORIGINAL writer's stamp (handle_put never
+    # re-stamps), so the peer's own fence judges the right version.
+    assert meta["weights_version"] == "v1"
+    st = a.store.stats()
+    assert st["park_replicated"] == 1
+    assert st["fabric_push"] == 1 and st.get("fabric_push_fail", 0) == 0
+    assert b.store.stats()["fabric_store"] == 1
+
+
+def test_park_degrades_loudly_when_every_peer_push_fails():
+    """Peers exist but none accepts the copy: the park still succeeds
+    locally (availability is never traded for a replication error) and
+    ``park_degraded`` counts the broken promise."""
+    net = FabricNet()
+    a = net.add("a:1", replication=2)
+    net.add("b:1", replication=2)
+    net.dead.add("b:1")             # dials fail, registry still lists it
+    a.park("conv", {}, b"x" * 100)
+    assert a.store.resume("conv") is not None
+    st = a.store.stats()
+    assert st["park_degraded"] == 1 and st.get("park_replicated", 0) == 0
+    assert st["fabric_push_fail"] == 1
+
+
+def test_replication_one_never_touches_the_wire():
+    net = FabricNet()
+    a = net.add("a:1", replication=1)
+    net.add("b:1", replication=1)
+    net.rpc_log.clear()
+    a.park("conv", {}, b"x" * 10)
+    assert net.rpc_log == []        # the pre-fabric behavior, exactly
+    st = a.store.stats()
+    assert st.get("fabric_push", 0) == 0
+
+
+def test_replication_validates():
+    with pytest.raises(ValueError):
+        KVFabric(KVTierStore(ram_bytes=1000, token="t"),
+                 replication=0)
+
+
+def test_kv_role_holders_are_preferred_push_targets():
+    """Dedicated KV-role peers sort FIRST in the replica target order:
+    parking lands on hosts whose whole job is parking before any
+    serving replica spends tier RAM on a copy."""
+    net = FabricNet()
+    a = net.add("a:1", replication=2)
+    net.add("b:1", replication=2)
+    net.add("kv:1", replication=1, role="kv")
+    targets = a._replica_targets("conv")
+    assert targets[0] == "kv:1"
+    a.park("conv", {}, b"x" * 20)
+    assert net.fabrics["kv:1"].store.get("session", "conv") is not None
+    assert net.fabrics["b:1"].store.get("session", "conv") is None
+
+
+# -- host-loss-proof resume --------------------------------------------------
+
+
+def test_parker_death_forwards_the_surviving_copy():
+    """The tentpole contract: a session parked with replication=2
+    survives SIGKILL of its parking host — the registry's placement
+    map names the surviving holder and a THIRD replica's resume
+    imports the copy byte-identical, fence intact."""
+    net = FabricNet()
+    a = net.add("a:1", replication=2)
+    b = net.add("b:1", replication=2)
+    c = net.add("c:1", replication=2)
+    body = b"gang-of-one-kv" * 99
+    a.park("conv", {"tokens": [1, 2, 3]}, body)
+    net.beat_all()                  # advertise the placement map
+    net.kill("a:1")
+    # Resume from the survivor that did NOT get the rendezvous copy —
+    # the interesting path is the cross-host forward, not a local hit.
+    other = c if b.store.get("session", "conv") else b
+    got = other.resume("conv")
+    assert got is not None, "surviving copy was not forwarded"
+    meta, out = got
+    assert out == body and meta["tokens"] == [1, 2, 3]
+    assert meta["weights_version"] == "v1"
+    st = other.store.stats()
+    assert st["fabric_fetch_hit"] == 1
+    # The import landed in the importer's LOCAL tier: the next resume
+    # is local.
+    assert other.store.resume("conv") is not None
+
+
+def test_scale_to_zero_resume_through_kv_role_holder():
+    """Every serving replica of the parker's generation can die: a
+    copy parked on a dedicated KV-role holder still resumes — the
+    holder exists precisely so artifacts outlive serving capacity."""
+    net = FabricNet()
+    a = net.add("a:1", replication=2)
+    net.add("kv:1", replication=1, role="kv")
+    a.park("conv", {}, b"z" * 64)
+    net.beat_all()
+    net.kill("a:1")
+    late = net.add("late:1", replication=2)
+    got = late.resume("conv")
+    assert got is not None and got[1] == b"z" * 64
+
+
+def test_empty_locate_falls_back_to_rendezvous_probes():
+    """The placement map is heartbeat-fed and TRUNCATED (summary caps
+    its advertised lists), so an empty locate is not proof of loss:
+    the fetch probes the rendezvous heads — the same peers a
+    replicated park would have chosen — before giving up."""
+    net = FabricNet()
+    a = net.add("a:1", replication=2)
+    b = net.add("b:1", replication=2)
+    c = net.add("c:1", replication=2)
+    a.park("conv", {}, b"q" * 32)
+    # No fresh beats: the registry's map never saw the park.
+    assert net.reg.kv_locate("session", "conv")["addrs"] == []
+    holder = "b:1" if b.store.get("session", "conv") else "c:1"
+    got = c.resume("conv") if holder == "b:1" else b.resume("conv")
+    assert got is not None and got[1] == b"q" * 32
+
+
+def test_resume_returns_none_when_every_copy_died():
+    net = FabricNet()
+    a = net.add("a:1", replication=1)       # local-only park
+    c = net.add("c:1", replication=2)
+    a.park("conv", {}, b"x" * 16)
+    net.beat_all()
+    net.kill("a:1")
+    assert c.resume("conv") is None         # loud miss, never a hang
+
+
+# -- fencing & torn gangs on the fetch path ----------------------------------
+
+
+def test_stale_fence_holder_copy_is_rejected():
+    """A stale-fence replica offering an old-version artifact: the
+    fetched copy installs un-restamped, the importer's OWN fence
+    rejects it on the re-read, and the poisoned copy is deleted —
+    counted ``fabric_reject_stale``, never stale KV."""
+    net = FabricNet()
+    old = net.add("old:1", replication=1, wv="v1")
+    new = net.add("new:1", replication=2, wv="v2")
+    old.park("conv", {}, b"stale-kv" * 10)
+    net.beat_all()
+    assert new.resume("conv") is None
+    st = new.store.stats()
+    assert st["fabric_reject_stale"] == 1
+    assert st.get("fabric_fetch_hit", 0) == 0
+    assert new.store.get("session", "conv") is None     # not cached
+
+
+def test_torn_gang_artifact_rejected_whole():
+    """Gang-sharded artifacts re-import WHOLE or not at all: a holder
+    serving a truncated gang body is rejected loudly
+    (``fabric_reject_torn``) — the fetch never surfaces a smaller
+    gang."""
+    net = FabricNet()
+    h = net.add("h:1", replication=1)
+    c = net.add("c:1", replication=2)
+    meta, body = pack_gang_shards([({"rank": 0}, b"aaaa"),
+                                   ({"rank": 1}, b"bbbb")])
+    meta["weights_version"] = "v1"
+    # Install the artifact TORN on the holder (bypassing park so the
+    # corruption is on the wire-serving side).
+    h.store.put("session", "gang:conv", meta, body[:-2], stamp=False)
+    net.beat_all()
+    assert c.resume("gang:conv") is None
+    assert c.store.stats()["fabric_reject_torn"] == 1
+    # An intact copy on another holder still resumes.
+    h2 = net.add("h2:1", replication=1)
+    h2.store.put("session", "gang:conv", meta, body, stamp=False)
+    net.beat_all()
+    got = c.resume("gang:conv")
+    assert got is not None and got[1] == body
+
+
+def test_gang_round_trip_with_missing_shard_rejects_not_shrinks():
+    """Satellite: dropping one gang member's shard from the packed
+    meta (keeping the advertised ``gang_size``) must reject the whole
+    artifact — the unpack NEVER yields a smaller gang."""
+    from tfmesos_tpu.fleet.kvtier import unpack_gang_shards
+
+    shards = [({"rank": r}, bytes([r]) * (8 + r)) for r in range(3)]
+    meta, body = pack_gang_shards(shards)
+    assert [m for m, _ in unpack_gang_shards(meta, body)] \
+        == [{"rank": 0}, {"rank": 1}, {"rank": 2}]
+    torn = dict(meta)
+    torn["shard_meta"] = [meta["shard_meta"][0], meta["shard_meta"][2]]
+    torn["shard_lens"] = [meta["shard_lens"][0], meta["shard_lens"][2]]
+    with pytest.raises(ValueError):
+        unpack_gang_shards(torn, body[:8] + body[8 + 9:])
+    # Even with a self-consistent smaller body, the advertised
+    # gang_size pins the contract: 2 shards claiming to be a 3-gang
+    # reject.
+    with pytest.raises(ValueError):
+        unpack_gang_shards(torn, body)
+
+
+def test_holder_disk_corruption_mid_fetch_is_a_miss_and_removed(
+        tmp_path):
+    """Satellite: a holder whose DISK copy rotted serves a clean miss
+    mid-fetch (``handle_fetch`` reads through the store's integrity
+    tag), counts ``corrupt``, and removes the poisoned file."""
+    import os
+
+    net = FabricNet()
+    h = net.add("h:1", replication=1, ram=0, disk_dir=str(tmp_path),
+                disk_bytes=1 << 20)
+    c = net.add("c:1", replication=2)
+    h.park("conv", {}, b"payload" * 100)
+    net.beat_all()
+    (path,) = [str(p) for p in tmp_path.iterdir()
+               if p.name.endswith(".kvt")]
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    open(path, "wb").write(bytes(blob))
+    assert c.resume("conv") is None
+    hst = h.store.stats()
+    assert hst["corrupt"] == 1
+    assert not os.path.exists(path), "poisoned entry must be removed"
+    assert c.store.stats()["fabric_fetch_miss"] >= 1
+
+
+# -- the wire handlers -------------------------------------------------------
+
+
+def test_handle_put_validates_and_reports_capacity():
+    # Fence-free store (a dedicated KV-role holder): what lands must
+    # keep the WRITER's stamp, not pick one up from the holder.
+    net = FabricNet()
+    a = net.add("a:1", replication=1, ram=2000, wv=None, role="kv")
+    bad = a.handle_put(wire.RawFrame({"op": "kv_put", "kind": "nope",
+                                      "key": "k", "meta": {}}, b""))
+    assert bad["kind"] == "bad_request"
+    bad = a.handle_put(wire.RawFrame({"op": "kv_put", "kind": "session",
+                                      "key": "", "meta": {}}, b""))
+    assert bad["kind"] == "bad_request"
+    full = a.handle_put(wire.RawFrame(
+        {"op": "kv_put", "kind": "session", "key": "big",
+         "meta": {}}, b"x" * 50_000))
+    assert full["kind"] == "kv_tier_full"
+    ok = a.handle_put(wire.RawFrame(
+        {"op": "kv_put", "kind": "session", "key": "s",
+         "meta": {"weights_version": "v9"}}, b"x" * 100))
+    assert ok["op"] == "kv_put_ok"
+    # Never re-stamped: the original writer's fence survives the hop.
+    assert a.store.get("session", "s")[0]["weights_version"] == "v9"
+
+
+def test_handle_fetch_reads_raw_store_and_terminates_locate_loops():
+    """``handle_fetch`` answers from the RAW store — it must NEVER
+    re-fetch through the fabric, or two replicas that both miss would
+    locate each other forever."""
+    net = FabricNet()
+    a = net.add("a:1", replication=2)
+    b = net.add("b:1", replication=2)
+    # Both advertise the session (stale maps), neither holds it.
+    for addr in ("a:1", "b:1"):
+        net.reg.observe({"op": "heartbeat", "addr": addr, "capacity": 4,
+                         "outstanding": 0, "weights_version": "v1",
+                         "kv_tier": {"sessions": ["ghost"],
+                                     "counters": {}}})
+    assert a.handle_fetch({"op": "kv_fetch", "kind": "session",
+                           "key": "ghost"})["op"] == "kv_miss"
+    assert a.resume("ghost") is None        # terminates, no recursion
+    assert b.resume("ghost") is None
+    bad = a.handle_fetch({"op": "kv_fetch", "kind": "x", "key": "k"})
+    assert bad["kind"] == "bad_request"
+
+
+# -- registry placement ops --------------------------------------------------
+
+
+def test_registry_kv_peers_lists_tiered_and_kv_role_first():
+    net = FabricNet()
+    net.add("b:1", replication=2)
+    net.add("a:1", replication=2)
+    net.add("kv:1", replication=1, role="kv")
+    net.reg.observe({"op": "heartbeat", "addr": "plain:1",
+                     "capacity": 4, "outstanding": 0})  # no tier
+    reply = net.reg.kv_peers()
+    addrs = [p["addr"] for p in reply["peers"]]
+    assert addrs[0] == "kv:1"               # dedicated holders first
+    assert set(addrs) == {"kv:1", "a:1", "b:1"}
+    assert all(p.get("weights_version") is not None
+               for p in reply["peers"])
+
+
+def test_registry_kv_locate_matches_sessions_and_prefixes():
+    net = FabricNet()
+    a = net.add("a:1", replication=1)
+    a.park("conv", {}, b"x" * 10)
+    a.store.prefix_geometry = {"page": 8, "first": 16, "seed": 0}
+    a.store.put_prefix("deadbeef", {}, b"y" * 10)
+    net.beat("a:1")
+    assert net.reg.kv_locate("session", "conv")["addrs"] == ["a:1"]
+    assert net.reg.kv_locate("prefix", "deadbeef")["addrs"] == ["a:1"]
+    assert net.reg.kv_locate("session", "nope")["addrs"] == []
+    assert net.reg.kv_locate("session", "")["addrs"] == []
+
+
+def test_rendezvous_order_is_deterministic_and_key_dependent():
+    addrs = [f"r{i}:1" for i in range(8)]
+    a = rendezvous_order("conv-a", addrs)
+    assert a == rendezvous_order("conv-a", list(reversed(addrs)))
+    assert sorted(a) == sorted(addrs)
+    # Different keys spread across different heads (placement, not a
+    # single hot holder).
+    heads = {rendezvous_order(f"conv-{i}", addrs)[0] for i in range(64)}
+    assert len(heads) > 1
+
+
+# -- chaos: the partition fault ----------------------------------------------
+
+
+class _TaggedSock:
+    """A socket double a fabric dialer would tag: ``getpeername``
+    names the dialed peer (what chaos's wire hooks read) and
+    ``wire.tag_socket`` records the LOCAL advertised endpoint — the
+    two halves of a partition fault's pair key."""
+
+    def __init__(self, peer, ident=None):
+        host, _, port = peer.rpartition(":")
+        self._peer = (host, int(port))
+        if ident:
+            wire.tag_socket(self, ident)
+
+    def getpeername(self):
+        return self._peer
+
+
+def test_partition_fault_drops_frames_between_one_peer_pair():
+    """Satellite: ``partition`` drops frames between a SPECIFIC peer
+    pair while both stay registry-alive — frames between the pair
+    drop (in either direction, persistently), traffic to anyone else
+    flows, and heartbeats are untouched."""
+    plan = chaos.FaultPlan([chaos.Fault(
+        "partition", "wire.send", target="a:1|b:1")])
+    with plan.installed():
+        # a:1 -> b:1 matches the pair: the frame is dropped — and
+        # keeps dropping (a partition persists until it heals, unlike
+        # a count-limited drop).
+        sock = _TaggedSock("b:1", ident="a:1")
+        assert plan.on_wire_send(sock, b"frame") is True
+        assert plan.on_wire_send(sock, b"frame") is True
+        # The reverse direction is the same pair: also dropped.
+        assert plan.on_wire_send(
+            _TaggedSock("a:1", ident="b:1"), b"frame") is True
+        # a:1 -> c:1 is not the pair: only the named link is severed.
+        assert plan.on_wire_send(
+            _TaggedSock("c:1", ident="a:1"), b"frame") is False
+        # Untagged sockets (no advertised endpoint — e.g. heartbeat
+        # links) never form a pair key, so they never match.
+        assert plan.on_wire_send(_TaggedSock("b:1"), b"frame") is False
+        # Both endpoints stay registry-alive: partition is not a
+        # heartbeat drop.
+        assert plan.on_heartbeat("a:1") is False
+        assert plan.on_heartbeat("b:1") is False
+    assert ("wire.send", "a:1|b:1", "partition", 1) in plan.fired
+
+
+def test_partition_fault_degrades_parks_without_losing_the_primary():
+    """The same fault driven through a fabric rpc: pushes to the
+    partitioned peer fail, the park lands locally (degraded, counted),
+    and the pair heals when the plan uninstalls."""
+    net = FabricNet()
+    a = net.add("a:1", replication=2)
+    net.add("b:1", replication=2)
+    real_rpc = net.rpc
+    plan = chaos.FaultPlan([chaos.Fault(
+        "partition", "wire.send", target="a:1|b:1")])
+
+    def rpc(addr, meta, body=None, timeout=10.0):
+        # What wire.send_msg does on a tagged fabric link, minus the
+        # socket: consult the installed hook; a consumed frame means
+        # the peer never answers.
+        hook = wire._chaos_send
+        if hook is not None \
+                and hook(_TaggedSock(addr, ident="a:1"), b"frame"):
+            raise ConnectionResetError(f"partitioned from {addr}")
+        return real_rpc(addr, meta, body, timeout)
+
+    a._rpc = rpc
+    with plan.installed():
+        a.park("conv", {}, b"x" * 40)
+    st = a.store.stats()
+    assert st["park_degraded"] == 1
+    assert st["fabric_push_fail"] == 1
+    assert a.store.resume("conv") is not None
+    # Both sides stayed registry-alive throughout.
+    assert {"a:1", "b:1"} <= {p["addr"]
+                              for p in net.reg.kv_peers()["peers"]}
+    # Healed (plan uninstalled): the next park replicates again.
+    a.park("conv2", {}, b"y" * 40)
+    assert a.store.stats()["park_replicated"] == 1
+    assert net.fabrics["b:1"].store.get("session", "conv2") is not None
+
+
+# -- concurrency (satellite) -------------------------------------------------
+
+
+def test_concurrent_park_and_fetch_of_same_digest():
+    """Racing parks and fetches of ONE digest never corrupt the store
+    or deadlock: every reader sees either a miss or one complete
+    (meta, body) pair from some writer — never a torn mix."""
+    store = KVTierStore(ram_bytes=1 << 20, token="t")
+    bodies = {i: bytes([i]) * 512 for i in range(8)}
+    errors = []
+    seen = []
+
+    def writer(i):
+        try:
+            for _ in range(50):
+                store.put("prefix", "digest", {"writer": i}, bodies[i])
+        except Exception as e:      # pragma: no cover - the assertion
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(200):
+                got = store.get("prefix", "digest")
+                if got is not None:
+                    seen.append(got)
+        except Exception as e:      # pragma: no cover - the assertion
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    threads += [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert seen, "readers observed no committed write"
+    for meta, body in seen:
+        assert body == bodies[meta["writer"]], "torn read"
+    st = store.stats()
+    assert st["ram_bytes_used"] <= 1 << 20

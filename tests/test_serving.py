@@ -2484,6 +2484,119 @@ def test_session_park_resume_lagged_modes(setup):
         assert st["park"] == 3 and st["resume"] == 2, (mode_kw, st)
 
 
+def _fabric_trio(replication=2):
+    """Three replicas on an in-process fabric mesh (test_kvfabric's
+    zero-socket harness): real KVFabric + KVTierStore per node, real
+    registry placement, stubbed transport."""
+    from test_kvfabric import FabricNet
+    net = FabricNet()
+    fabs = {n: net.add(n, replication=replication, ram=8 << 20)
+            for n in ("a:1", "b:1", "c:1")}
+    return net, fabs
+
+
+def test_fabric_host_loss_resume_token_identical(setup):
+    """The seeded cross-host e2e: a conversation parked on replica A
+    (replication=2 → one rendezvous-picked peer copy), host A DIES,
+    and the next turn lands on the survivor WITHOUT the copy — the
+    batcher's session lookup misses locally, the fabric locates the
+    surviving copy through the registry and fetches it from the peer,
+    and the resumed turn is TOKEN-IDENTICAL to the cold full-history
+    reference.  Greedy AND sampled: with equal batcher rngs the
+    (rid, step) sample folds continue the exact stream the cold
+    reference draws, so host loss is invisible at the token level."""
+    cfg, params = setup
+    kw = dict(rows=2, max_len=128, page_size=16, prefill_bucket=16)
+    for samp in ({}, dict(temperature=0.8, top_k=20)):
+        net, fabs = _fabric_trio()
+        skw = lambda seed: (dict(samp, rng=jax.random.PRNGKey(seed))
+                            if samp else {})
+        parker = ContinuousBatcher(cfg, params, kv_tier=fabs["a:1"],
+                                   **kw, **skw(7))
+        assert parker.kv_tier_bypass_reason is None
+        rng = np.random.RandomState(11)
+        hist = list(rng.randint(0, cfg.vocab_size, size=24))
+        (c,) = list(parker.run([Request(np.asarray(hist, np.int32), 6,
+                                        session_id="conv")]))
+        assert fabs["a:1"].store.stats()["park_replicated"] == 1, samp
+        net.kill("a:1")     # survivors' beats advertise the placement
+        holder = ("b:1" if fabs["b:1"].store.get("session", "conv")
+                  else "c:1")
+        resumer_addr = "c:1" if holder == "b:1" else "b:1"
+        hist += list(c.tokens) + list(rng.randint(0, cfg.vocab_size,
+                                                  size=5))
+        prompt = np.asarray(hist, np.int32)
+        # The resumer and the cold reference are both fresh batchers
+        # with the same rng: same rid (0), same sample folds.
+        cold = ContinuousBatcher(cfg, params, **kw, **skw(9))
+        (ref,) = list(cold.run([Request(prompt, 6)]))
+        resumer = ContinuousBatcher(cfg, params,
+                                    kv_tier=fabs[resumer_addr],
+                                    **kw, **skw(9))
+        (c2,) = list(resumer.run([Request(prompt, 6,
+                                          session_id="conv")]))
+        assert c2.tokens == ref.tokens, \
+            f"host-loss resume diverged (sampled={bool(samp)})"
+        st = fabs[resumer_addr].store.stats()
+        assert st["fabric_fetch_hit"] == 1, (samp, st)
+        assert st["resume"] == 1, (samp, st)
+
+
+def test_fabric_gang_host_loss_resume_round_trips_whole(setup):
+    """Gang-sharded host loss: each rank's parked session artifact
+    folds into ONE gang artifact (pack_gang_shards) that replicates
+    through the fabric; after the parker dies, a survivor fetches the
+    copy (shape-checked whole — fabric_reject_torn covers the torn
+    case in tests/test_kvfabric.py), splits it back into rank shards,
+    and EVERY rank's resumed turn is token-identical to the cold
+    full-history reference."""
+    from tfmesos_tpu.fleet.kvtier import (KVTierStore, pack_gang_shards,
+                                          unpack_gang_shards)
+    cfg, params = setup
+    kw = dict(rows=2, max_len=128, page_size=16, prefill_bucket=16)
+    ranks = 2
+    rng = np.random.RandomState(13)
+    hist = list(rng.randint(0, cfg.vocab_size, size=20))
+    prompt1 = np.asarray(hist, np.int32)
+    # Turn 1 on the gang: each rank parks locally; the leader folds
+    # the per-rank artifacts into one gang artifact and parks THAT
+    # through the fabric (replication=2 → a peer copy).
+    shards = []
+    toks1 = None
+    for r in range(ranks):
+        store = KVTierStore(ram_bytes=8 << 20, token="tok")
+        b = ContinuousBatcher(cfg, params, kv_tier=store, **kw)
+        (c,) = list(b.run([Request(prompt1, 6, session_id="g")]))
+        toks1 = c.tokens    # same math every rank in this tiny config
+        meta, body = store.resume("g")
+        shards.append((dict(meta, rank=r), body))
+    gmeta, gbody = pack_gang_shards(shards)
+    net, fabs = _fabric_trio()
+    fabs["a:1"].park("g", gmeta, gbody)
+    assert fabs["a:1"].store.stats()["park_replicated"] == 1
+    net.kill("a:1")
+    holder = "b:1" if fabs["b:1"].store.get("session", "g") else "c:1"
+    resumer_addr = "c:1" if holder == "b:1" else "b:1"
+    got = fabs[resumer_addr].resume("g")
+    assert got is not None, "gang artifact did not survive host loss"
+    assert fabs[resumer_addr].store.stats()["fabric_fetch_hit"] == 1
+    back = unpack_gang_shards(dict(got[0]), got[1])
+    assert [m["rank"] for m, _ in back] == list(range(ranks))
+    # Turn 2: every rank resumes from its own shard of the fetched
+    # copy and must match the cold full-history reference.
+    hist += list(toks1) + list(rng.randint(0, cfg.vocab_size, size=4))
+    prompt2 = np.asarray(hist, np.int32)
+    cold = ContinuousBatcher(cfg, params, **kw)
+    (ref,) = list(cold.run([Request(prompt2, 6)]))
+    for r, (smeta, sbody) in enumerate(back):
+        store = KVTierStore(ram_bytes=8 << 20, token="tok")
+        store.park("g", dict(smeta), sbody)
+        b = ContinuousBatcher(cfg, params, kv_tier=store, **kw)
+        (c2,) = list(b.run([Request(prompt2, 6, session_id="g")]))
+        assert c2.tokens == ref.tokens, f"rank {r} diverged"
+        assert store.stats()["resume"] == 1
+
+
 def test_spec_tier_spill_promote_twin_pages(setup):
     """Spec + prefix cache + KV tier under allocation pressure: an
     evicted trie node spills its TARGET page and draft TWIN as one

@@ -465,6 +465,52 @@ def test_sessions_scenario_park_resume_at_scale(sleep_trap):
         assert two[k] == out[k], k
 
 
+def test_sessions_cross_host_placement_replication_survives_the_kill(
+        sleep_trap):
+    """The fabric fidelity contract: with per-host tiers and K-way
+    rendezvous placement (``kv_replication`` — the REAL fabric's
+    placement function), replication=2 rides out the scenario's
+    mid-run hard kill with ZERO host-loss misses (surviving copies
+    forward, at a wire cost, not a recompute), while replication=1
+    loses every session parked only on the dead host."""
+    r2 = run_scenario("sessions", [("kv_replication", "2")],
+                      n_requests=800, replicas=3, turns=4, seed=7)
+    assert r2["kv_replication"] == 2
+    assert r2["lost"] == 0
+    st2 = r2["session_tier"]
+    assert st2["host_loss_miss"] == 0
+    assert st2["forwarded"] > 0         # resumes landed off-parker
+    # Forwarded resumes pay the wire, not a re-prefill: still strictly
+    # cheaper than cold full-history turns.
+    assert r2["resumed_ttft_mean_ms"] < r2["cold_ttft_mean_ms"]
+    r1 = run_scenario("sessions", [("kv_replication", "1")],
+                      n_requests=800, replicas=3, turns=4, seed=7)
+    st1 = r1["session_tier"]
+    assert st1["host_loss_miss"] > 0    # sole copy died with its host
+    assert r1["lost"] == 0              # lossy tier, never lost work
+    assert r1["kv_tier_hit_rate"] < r2["kv_tier_hit_rate"]
+    # Deterministic per seed, like every scenario.
+    again = run_scenario("sessions", [("kv_replication", "2")],
+                         n_requests=800, replicas=3, turns=4, seed=7)
+    assert again["kv_tier_hit_rate"] == r2["kv_tier_hit_rate"]
+    assert again["session_tier"] == st2
+
+
+def test_sessions_kv_replication_sweep(sleep_trap):
+    """``--sweep kv_replication=1,3`` prices the placement policy on
+    the virtual clock: more copies, fewer host-loss misses."""
+    rows = run_sweep("sessions", "kv_replication", ["1", "3"],
+                     n_requests=400, replicas=3, turns=4, seed=7)
+    assert len(rows) == 2
+    for val, res in rows:
+        assert res["kv_replication"] == int(val)
+        assert res["lost"] == 0
+    assert rows[1][1]["session_tier"]["host_loss_miss"] \
+        <= rows[0][1]["session_tier"]["host_loss_miss"]
+    assert rows[1][1]["kv_tier_hit_rate"] \
+        >= rows[0][1]["kv_tier_hit_rate"]
+
+
 def test_sessions_version_fence_in_sim(sleep_trap):
     """A session parked under v1 must NOT resume on a v2 replica: the
     sim tier's version check mirrors the store's stamp fence."""

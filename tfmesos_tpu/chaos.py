@@ -55,9 +55,17 @@ log = get_logger("tfmesos_tpu.chaos")
 #: ``wire.send`` toward one replica's addr — the process is alive, its
 #: heartbeats are on time, and every dispatch is deterministically slow;
 #: exactly the failure a circuit breaker (not a liveness registry) must
-#: catch.
+#: catch.  ``partition`` is the FABRIC-SPLIT generator: persistent like
+#: ``slow_task``, it silently drops every frame between one specific
+#: peer PAIR (``target="addrA|addrB"`` — both advertised ``host:port``
+#: endpoints, either order) while leaving all other traffic — registry
+#: heartbeats included — untouched, so both peers stay registry-alive
+#: through the split.  It matches only sockets the sender TAGGED with
+#: its own advertised addr (``wire.tag_socket`` — replica-to-replica
+#: fabric RPC and direct KV pushes do), because an untagged socket
+#: cannot prove which pair it belongs to.
 ACTIONS = ("kill_task", "drop_agent", "sever", "delay", "truncate",
-           "drop", "slow_task")
+           "drop", "slow_task", "partition")
 
 
 @dataclass
@@ -77,7 +85,9 @@ class Fault:
     ``target`` — optional substring filter against the event's key (a task
     name ``job:index`` for launches, ``host:port`` peers for wire events,
     the replica addr for heartbeats); when set, only matching events
-    advance the fault's counter.
+    advance the fault's counter.  A ``partition`` fault's target is the
+    peer PAIR ``"addrA|addrB"`` (advertised endpoints, either order):
+    only frames between those two tagged endpoints match.
     ``victim`` — for ``kill_task``: the ``job:index`` task to SIGKILL
     (defaults to ``target``).
     ``delay_s`` — sleep length for ``delay`` actions and the timer delay
@@ -215,15 +225,22 @@ class FaultPlan:
             for i, f in enumerate(self.faults):
                 if f.site != site:
                     continue
-                if f.target and (not key or f.target not in key):
+                if f.action == "partition":
+                    # Pair semantics: BOTH endpoints of the fault's
+                    # ``target`` ("A|B") must appear in the event key
+                    # (the tagged sender + the dialed peer), so only
+                    # traffic between that specific pair matches.
+                    if not _pair_match(f.target, key):
+                        continue
+                elif f.target and (not key or f.target not in key):
                     continue
                 # Per-fault matched-event counter — cumulative across all
                 # keys the target matches, so "the 2nd worker launch"
                 # means the 2nd launch of ANY worker, not per-task (and
                 # fires exactly once, not once per matching key).
                 n = self._fault_hits[i] = self._fault_hits.get(i, 0) + 1
-                if f.action == "slow_task":
-                    # Persistent gray failure: armed at the nth event,
+                if f.action in ("slow_task", "partition"):
+                    # Persistent failures: armed at the nth event,
                     # live forever after.
                     if n >= f.nth:
                         due.append(f)
@@ -305,8 +322,9 @@ class FaultPlan:
 
     def on_wire_send(self, sock, data: bytes) -> bool:
         """wire.send_msg hook: returns True when the frame was consumed
-        (dropped); raises OSError for sever/truncate."""
-        for f in self.event("wire.send", key=_peer(sock)):
+        (dropped — ``drop`` and armed ``partition`` faults); raises
+        OSError for sever/truncate."""
+        for f in self.event("wire.send", key=_pair_key(sock)):
             if f.action == "sever":
                 _close(sock)
                 raise OSError("chaos: connection severed (wire.send)")
@@ -316,13 +334,13 @@ class FaultPlan:
                 finally:
                     _close(sock)
                 raise OSError("chaos: frame truncated (wire.send)")
-            if f.action == "drop":
+            if f.action in ("drop", "partition"):
                 return True
         return False
 
     def on_wire_recv(self, sock) -> None:
         """wire.recv_msg hook: raises OSError for sever."""
-        for f in self.event("wire.recv", key=_peer(sock)):
+        for f in self.event("wire.recv", key=_pair_key(sock)):
             if f.action == "sever":
                 _close(sock)
                 raise OSError("chaos: connection severed (wire.recv)")
@@ -343,6 +361,28 @@ def _peer(sock) -> str:
     if isinstance(name, tuple) and len(name) >= 2:
         return f"{name[0]}:{name[1]}"
     return str(name)       # AF_UNIX sockets name a path (or nothing)
+
+
+def _pair_key(sock) -> str:
+    """The wire event key: ``"<tagged local ident>|<dialed peer>"`` for
+    sockets a named endpoint tagged (wire.tag_socket — the fabric's
+    replica-to-replica links), the dialed peer alone otherwise.  The
+    peer stays a SUBSTRING of the composite key, so plain
+    ``target="host:port"`` faults keep matching tagged traffic too."""
+    from tfmesos_tpu import wire
+    peer = _peer(sock)
+    ident = wire.sock_ident(sock)
+    return f"{ident}|{peer}" if ident else peer
+
+
+def _pair_match(target: Optional[str], key: str) -> bool:
+    """Whether a ``partition`` fault's ``"A|B"`` pair both appear in
+    the event key (either order; each endpoint a substring, matching
+    the rest of chaos's target semantics)."""
+    if not target or not key:
+        return False
+    parts = [p for p in target.split("|") if p]
+    return len(parts) == 2 and all(p in key for p in parts)
 
 
 def _close(sock) -> None:

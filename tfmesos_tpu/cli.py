@@ -348,6 +348,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "read as misses); default with --kv-tier-mb: "
                         "a per-run temp directory, so co-located "
                         "replicas resume each other's parked sessions")
+    p.add_argument("--kv-replication", type=int, default=1,
+                   dest="kv_replication", metavar="K",
+                   help="K-way replicated session parking on the "
+                        "cross-host KV fabric (1 disables, the "
+                        "default): a park acknowledges only after the "
+                        "artifact lands on the parker PLUS K-1 peers, "
+                        "so a parked session survives its parking "
+                        "host's death and resumes token-identical "
+                        "elsewhere (docs/SERVING.md 'Cross-host KV "
+                        "fabric')")
+    p.add_argument("--kv-replicas", type=int, default=0,
+                   dest="kv_replicas", metavar="N",
+                   help="dedicated KV-role replicas (storage-only "
+                        "fabric peers that never serve tokens): "
+                        "replicated parks land there first, so "
+                        "artifacts survive every serving replica of a "
+                        "model scaling to zero; needs --kv-tier-mb")
     p.add_argument("--warmup", action="store_true",
                    help="replicas compile every jitted serving entry "
                         "point at boot before taking traffic: they "
@@ -1113,6 +1130,8 @@ def _build_fleet(args, models, roles, classes, token):
         pipeline_depth=args.pipeline_depth,
         draft=args.draft, n_draft=args.n_draft,
         kv_tier_mb=args.kv_tier_mb, kv_tier_dir=args.kv_tier_dir,
+        kv_replication=args.kv_replication,
+        kv_replicas=args.kv_replicas,
         warmup=args.warmup,
         report_interval=args.metrics_interval or None,
         metrics_port=args.metrics_port,

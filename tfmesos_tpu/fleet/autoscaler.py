@@ -59,7 +59,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from tfmesos_tpu.fleet.metrics import Histogram
-from tfmesos_tpu.fleet.registry import ALIVE, DEAD, DECODE
+from tfmesos_tpu.fleet.registry import ALIVE, DEAD, DECODE, KV
 from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["AutoscalerConfig", "FleetAutoscaler"]
@@ -96,6 +96,17 @@ class AutoscalerConfig:
     #: scale-down.
     kv_headroom_lo: float = 8.0
     kv_headroom_hi: float = 64.0
+    #: fleet KV-tier occupancy (RAM-tier bytes used / budget, summed
+    #: over every tiered replica): above this the tier is evicting
+    #: parked artifacts, so it BLOCKS scale-down (a drained replica's
+    #: tier capacity evicts more) and — together with the hit-rate
+    #: floor below — arms scale-up even when queue wait looks calm.
+    kv_tier_occupancy_hi: float = 0.9
+    #: windowed KV-tier hit-rate floor: a saturated tier whose
+    #: between-ticks hit rate sits below this is THRASHING (traffic
+    #: still wants what eviction throws away) — more replicas mean
+    #: more aggregate tier RAM, so that combination scales up.
+    kv_tier_hit_rate_lo: float = 0.2
     #: per-tier cooldowns, one per direction: growing again right after
     #: growing is cheap to allow, shrinking is deliberately slower.
     scale_up_cooldown: float = 5.0
@@ -132,6 +143,10 @@ class FleetAutoscaler:
         # Windowed-percentile state: the previous tick's cumulative
         # queue-wait histogram sample.
         self._prev_queue_wait: Optional[tuple] = None
+        # Windowed KV-tier state: the previous tick's fleet-wide
+        # counter aggregate, so hit rate is between-ticks (the same
+        # react-to-now discipline as the queue-wait window).
+        self._prev_kv: Optional[Dict[str, Any]] = None
         self._last_up: Dict[str, float] = {}
         self._last_down: Dict[str, float] = {}
         # addr -> {role, node, since, deadline}: drains in flight.
@@ -177,6 +192,27 @@ class FleetAutoscaler:
             qw_p99 = Histogram.delta_percentile(self._prev_queue_wait,
                                                 cur, 0.99)
             self._prev_queue_wait = cur
+        # KV-tier occupancy + windowed hit rate (fleet-wide, like the
+        # queue-wait window — every tiered replica feeds one session
+        # economy).  Counter deltas clamp at zero: a dying replica's
+        # counters leave the aggregate, which must not read as
+        # negative traffic.
+        kv_occ = kv_hit = None
+        kvsum = getattr(self.fleet.registry, "kv_tier_summary", None)
+        if kvsum is not None:
+            cur_kv = kvsum()
+            if cur_kv.get("replicas"):
+                budget = cur_kv.get("ram_bytes") or 0
+                if budget > 0:
+                    kv_occ = cur_kv.get("ram_bytes_used", 0) / budget
+                prev = self._prev_kv or {}
+                hits = max(0, cur_kv.get("hits", 0)
+                           - prev.get("hits", 0))
+                misses = max(0, cur_kv.get("misses", 0)
+                             - prev.get("misses", 0))
+                if hits + misses > 0:
+                    kv_hit = hits / (hits + misses)
+                self._prev_kv = cur_kv
         out: Dict[str, Dict[str, Any]] = {}
         summary = self.fleet.registry.role_summary()
         for role in self.fleet.targets:
@@ -189,7 +225,8 @@ class FleetAutoscaler:
             headroom = (d.get("kv_headroom", 0) / alive) if alive else None
             out[role] = {"queue_wait_p99_ms": qw_p99, "util": util,
                          "kv_headroom": headroom, "alive": alive,
-                         "warming": d.get("warming", 0)}
+                         "warming": d.get("warming", 0),
+                         "kv_occupancy": kv_occ, "kv_hit_rate": kv_hit}
         return out
 
     # -- the control tick --------------------------------------------------
@@ -232,6 +269,13 @@ class FleetAutoscaler:
         lo, hi = self.fleet.bounds(role)
         # Composite per-(model, tier) keys ("m/decode") resolve their
         # ROLE by suffix — '/' is outside the model-id charset.
+        if role.rsplit("/", 1)[-1] == KV:
+            # The dedicated KV tier stays pinned at its boot size:
+            # storage-only holders produce no queue-wait or
+            # utilization signal, so the loop would only ever shrink
+            # them — and every shrink throws away parked copies.
+            # Convergence (crash relaunch) still runs for the tier.
+            return
         if role.rsplit("/", 1)[-1] == DECODE:
             # Decode replicas exhaust KV pages, not rows: headroom is
             # the binding resource.
@@ -248,6 +292,21 @@ class FleetAutoscaler:
                   or util > cfg.util_hi)
             down = ((qw is None or qw < cfg.queue_wait_lo_ms)
                     and util < cfg.util_lo)
+            # KV-tier pressure (first-class next to queue wait): a
+            # saturated tier THRASHING — evicting artifacts the
+            # windowed hit rate says traffic still wants — scales up
+            # (more replicas = more aggregate tier RAM), and a merely
+            # saturated one blocks scale-down (the drained victim's
+            # tier capacity would evict more parked sessions).
+            kv_occ = sig.get("kv_occupancy")
+            kv_hit = sig.get("kv_hit_rate")
+            tier_hot = (kv_occ is not None
+                        and kv_occ > cfg.kv_tier_occupancy_hi)
+            if tier_hot:
+                down = False
+                if kv_hit is not None \
+                        and kv_hit < cfg.kv_tier_hit_rate_lo:
+                    up = True
         desired = target
         if up and now - self._last_up.get(role, -1e18) >= cfg.scale_up_cooldown:
             desired = target + 1

@@ -243,6 +243,12 @@ class ModelTrader(FleetAutoscaler):
         #: previous cumulative per-model queue-wait samples (windowed
         #: percentiles, the autoscaler discipline).
         self._prev_qw: Dict[str, tuple] = {}
+        #: previous cumulative per-model KV-tier (hits, misses) sums —
+        #: the windowed tier hit rate rides next to queue wait as a
+        #: victim-pick input: a model actively resuming parked
+        #: sessions is a costly trade victim even when its queue
+        #: looks calm.
+        self._prev_kv_model: Dict[str, Tuple[int, int]] = {}
         # The first TICK-driven trade waits out one cooldown from
         # construction: bring-up queue-wait spikes (everything queues
         # while the fleet warms) read as hotness on every model at
@@ -288,6 +294,27 @@ class ModelTrader(FleetAutoscaler):
                     sig["samples"] = cur[2] - (prev[2] if prev else 0)
                     if advance:
                         self._prev_qw[key] = cur
+            # Windowed per-model KV-tier hit rate from the members'
+            # heartbeat counter sums.  Deltas clamp at zero — a dying
+            # member's counters leave the sum, which must not read as
+            # negative tier traffic.
+            sig["kv_hit_rate"] = None
+            kv_hits = kv_misses = 0
+            for r in members:
+                kt = getattr(r, "kv_tier", None)
+                if isinstance(kt, dict):
+                    c = kt.get("counters")
+                    if isinstance(c, dict):
+                        kv_hits += int(c.get("hits", 0) or 0)
+                        kv_misses += int(c.get("misses", 0) or 0)
+            prev_kv = self._prev_kv_model.get(key)
+            if prev_kv is not None:
+                dh = max(0, kv_hits - prev_kv[0])
+                dm = max(0, kv_misses - prev_kv[1])
+                if dh + dm > 0:
+                    sig["kv_hit_rate"] = dh / (dh + dm)
+            if advance:
+                self._prev_kv_model[key] = (kv_hits, kv_misses)
             out[key] = sig
         return out
 
@@ -469,9 +496,14 @@ class ModelTrader(FleetAutoscaler):
             sig = signals.get(key) or {}
             qw = sig.get("queue_wait_p99_ms")
             samples = sig.get("samples") or 0
+            kv_hit = sig.get("kv_hit_rate")
             score = (
                 0 if not samples else 1,    # traffic-less models first
                 qw if qw is not None else 0.0,
+                # Windowed tier hit rate: a model actively RESUMING
+                # parked sessions pays real cold re-prefills if its
+                # replica drains — prefer victims whose tier sits idle.
+                kv_hit if kv_hit is not None else 0.0,
                 -self._parked_disk_sessions(key),  # satellite: prefer
                 key,                               # parked-on-disk
             )

@@ -64,13 +64,15 @@ import json
 import os
 import struct
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+from tfmesos_tpu import wire
 from tfmesos_tpu.utils.logging import get_logger
 
-__all__ = ["KVTierFull", "KVTierStore", "pack_gang_shards",
-           "unpack_gang_shards"]
+__all__ = ["KVTierFull", "KVTierStore", "KVFabric", "fabric_rpc",
+           "rendezvous_order", "pack_gang_shards", "unpack_gang_shards"]
 
 _TAG_LEN = 32
 _LEN = struct.Struct(">I")
@@ -388,17 +390,22 @@ class KVTierStore:
     # -- public surface ----------------------------------------------------
 
     def put(self, kind: str, key: str, meta: Dict[str, Any],
-            body: bytes) -> None:
+            body: bytes, stamp: bool = True) -> None:
         """Store one entry (replacing any same-key one).  Raises
         :class:`KVTierFull` when the body can never fit either tier's
         budget — an explicit rejection, never a hang or a silent
-        drop."""
+        drop.  ``stamp=False`` preserves the meta's EXISTING writer
+        stamp instead of merging ours — a fabric-replicated artifact
+        must keep its original weights_version/gen fence, or a stale
+        copy re-stamped by a fresh holder would stop reading as
+        stale."""
         if kind not in KINDS:
             raise ValueError(f"unknown kv tier kind {kind!r} "
                              f"(have: {KINDS})")
         body = bytes(body)
         meta = dict(meta)
-        meta.update(self.stamp)
+        if stamp:
+            meta.update(self.stamp)
         # Budget by the FULL entry cost (body + serialized meta): a
         # session meta embeds the whole conversation history, and a
         # hard bound that ignored it would drift with history length.
@@ -587,6 +594,7 @@ class KVTierStore:
                 "sessions": sessions,
                 "counters": dict(self._stats),
                 "ram_bytes_used": self._ram_used,
+                "ram_bytes": self.ram_bytes,
                 # Whether parked state survives this replica (a
                 # host-shared disk tier) — the model trader's victim
                 # tie-break reads it: trading away a replica whose
@@ -600,3 +608,361 @@ class KVTierStore:
                              "seed": geom.get("seed"),
                              "hashes": hashes}
         return out
+
+
+# -- the cross-host fabric ---------------------------------------------------
+
+
+def rendezvous_order(key: str, addrs: List[str]) -> List[str]:
+    """Deterministic per-key peer preference (highest-random-weight /
+    rendezvous hashing): every replica computes the SAME order from the
+    same alive set, so the parker's replica picks and a later resumer's
+    locate agree on where copies should live without any coordinator."""
+    return sorted(addrs, key=lambda a: hashlib.sha256(
+        f"{key}\x00{a}".encode("utf-8")).hexdigest())
+
+
+def fabric_rpc(addr: str, meta: Dict[str, Any], body: Optional[bytes] = None,
+               token: str = "", timeout: float = 10.0,
+               self_addr: str = "") -> Any:
+    """One synchronous request/reply exchange with a peer replica over
+    a fresh authenticated connection: JSON frame without a ``body``,
+    raw HMAC frame with one; the single reply may be either kind.  The
+    socket is tagged with the CALLER's advertised addr so chaos
+    ``partition`` faults can match the peer pair."""
+    sock = wire.connect(addr, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        if self_addr:
+            wire.tag_socket(sock, self_addr)
+        if body is None:
+            wire.send_msg(sock, meta, token)
+        else:
+            wire.send_raw_msg(sock, meta, body, token)
+        return wire.recv_msg(sock, token, allow_raw=True)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class KVFabric:
+    """The cross-host face of one replica's :class:`KVTierStore`:
+    K-way replicated session parking plus peer fetch on miss, so a
+    parked conversation survives the loss of the host that parked it
+    (docs/SERVING.md "Cross-host KV fabric").
+
+    Wraps a local store and presents the SAME surface the batcher
+    binds (``park``/``resume``/``put_prefix``/``summary``/``count``/
+    ...), delegating everything it does not override.  What it adds:
+
+    * ``park`` — local park first (the primary copy; capacity
+      rejections propagate exactly as before), then SYNCHRONOUS pushes
+      of the stamped artifact to ``replication - 1`` peers in
+      rendezvous order (``kv_put`` raw frames over :func:`fabric_rpc`).
+      The park returns only after the push attempts complete: with at
+      least one peer copy landed it is ``park_replicated``; with
+      eligible peers that all failed it is ``park_degraded`` (counted,
+      logged — the local copy stands, so availability is never traded
+      for a replication error the counters already surface).
+    * ``resume``/``fetch`` — on a local miss, ask the registry WHERE
+      the artifact lives (``kv_locate`` over the heartbeat-advertised
+      placement map — this is what forwards surviving copies after
+      parker death or scale-to-zero), ``kv_fetch`` it from a holder,
+      and install it WITHOUT re-stamping (``put(stamp=False)``) so the
+      local store's weights_version fence judges the ORIGINAL writer's
+      stamp: a stale-fence peer's old-version artifact reads as a
+      ``version_miss``, never as wrong KV.  Gang-sharded artifacts are
+      shape-checked (:func:`unpack_gang_shards`) before install — a
+      torn gang is rejected loudly, never imported smaller.
+
+    ``rpc`` and ``peers`` are injectable (the chaos/simulator
+    discipline): tests and the sim substitute in-process fabrics with
+    zero sockets.  ``peers()`` returns dicts with at least ``addr``
+    (plus optional ``role``/``weights_version``); the default source
+    asks the registry's ``kv_peers`` op and caches for ``peer_ttl``.
+    """
+
+    def __init__(self, store: KVTierStore, token: str = "",
+                 self_addr: str = "", registry_addr: Optional[str] = None,
+                 replication: int = 2, rpc=None, peers=None,
+                 clock=time.monotonic, peer_ttl: float = 1.0,
+                 push_timeout: float = 10.0):
+        if replication < 1:
+            raise ValueError(f"replication must be >= 1, "
+                             f"got {replication}")
+        self.store = store
+        self.token = token
+        self.self_addr = self_addr
+        self.registry_addr = registry_addr
+        self.replication = int(replication)
+        self._rpc = rpc or (lambda addr, meta, body=None, timeout=10.0:
+                            fabric_rpc(addr, meta, body, token=self.token,
+                                       timeout=timeout,
+                                       self_addr=self.self_addr))
+        self._peer_source = peers
+        self._clock = clock
+        self.peer_ttl = float(peer_ttl)
+        self.push_timeout = float(push_timeout)
+        self._peer_cache: Tuple[float, List[Dict[str, Any]]] = (-1e18, [])
+        self.log = get_logger("tfmesos_tpu.fleet.kvfabric")
+
+    # -- delegation: the fabric IS the batcher's kv tier -------------------
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.store, name)
+
+    @property
+    def prefix_geometry(self) -> Optional[Dict[str, Any]]:
+        return self.store.prefix_geometry
+
+    @prefix_geometry.setter
+    def prefix_geometry(self, geom: Optional[Dict[str, Any]]) -> None:
+        # The batcher ASSIGNS this; plain __getattr__ delegation would
+        # strand the write on the wrapper and hide it from summary().
+        self.store.prefix_geometry = geom
+
+    # -- peer placement ----------------------------------------------------
+
+    def peers(self) -> List[Dict[str, Any]]:
+        """Alive fabric peers (self excluded), from the injected source
+        or the registry's ``kv_peers`` op (TTL-cached: park runs on the
+        batcher loop and must not pay a registry round trip per
+        session)."""
+        if self._peer_source is not None:
+            raw = list(self._peer_source())
+        else:
+            if self.registry_addr is None:
+                return []
+            t, cached = self._peer_cache
+            if self._clock() - t < self.peer_ttl:
+                raw = cached
+            else:
+                try:
+                    reply = self._rpc(self.registry_addr,
+                                      {"op": "kv_peers"},
+                                      timeout=self.push_timeout)
+                    raw = reply.get("peers") or [] \
+                        if isinstance(reply, dict) else []
+                except (OSError, wire.WireError) as e:
+                    self.log.warning("kv_peers lookup failed: %s", e)
+                    raw = cached    # stale beats empty mid-blip
+                self._peer_cache = (self._clock(), raw)
+        out = []
+        for p in raw:
+            if isinstance(p, dict) and p.get("addr") \
+                    and p["addr"] != self.self_addr:
+                out.append(p)
+        return out
+
+    def _replica_targets(self, key: str) -> List[str]:
+        """The rendezvous-ordered peer addrs eligible to hold a copy of
+        ``key``: dedicated KV-role peers first (they exist to hold
+        state), then same-weights_version peers (any other version
+        would fence the copy on its own reads), unstamped peers last."""
+        wv = self.store.stamp.get("weights_version")
+        kv_role, same, rest = [], [], []
+        for p in self.peers():
+            pwv = p.get("weights_version")
+            if p.get("role") == "kv":
+                kv_role.append(p["addr"])
+            elif not wv or not pwv or str(pwv) == str(wv):
+                same.append(p["addr"])
+            else:
+                rest.append(p["addr"])
+        return (rendezvous_order(key, kv_role)
+                + rendezvous_order(key, same)
+                + rendezvous_order(key, rest))
+
+    # -- replicated park ---------------------------------------------------
+
+    def park(self, session_id: str, meta: Dict[str, Any],
+             body: bytes) -> None:
+        self.store.park(session_id, meta, body)
+        if self.replication <= 1:
+            return
+        # Push the STAMPED artifact (what the local tier actually
+        # holds), so every copy carries the same fence.
+        smeta = dict(meta)
+        smeta.update(self.store.stamp)
+        self._replicate("session", session_id, smeta, body)
+
+    def _replicate(self, kind: str, key: str, meta: Dict[str, Any],
+                   body: bytes) -> int:
+        """Synchronously land ``replication - 1`` copies on peers
+        (bounded: one attempt per peer, rendezvous order, stop when
+        enough landed).  Returns the number of peer copies made;
+        counts ``park_replicated`` / ``park_degraded``."""
+        want = self.replication - 1
+        targets = self._replica_targets(key)
+        landed = 0
+        for addr in targets:
+            if landed >= want:
+                break
+            self.store.count("fabric_push")
+            try:
+                reply = self._rpc(
+                    addr, {"op": "kv_put", "kind": kind, "key": key,
+                           "meta": meta},
+                    body, timeout=self.push_timeout)
+            except (OSError, wire.WireError) as e:
+                self.store.count("fabric_push_fail")
+                self.log.warning("fabric push of %s/%s to %s failed: %s",
+                                 kind, key, addr, e)
+                continue
+            if isinstance(reply, dict) and reply.get("op") == "kv_put_ok":
+                landed += 1
+                self.store.count("fabric_push_bytes", len(body))
+            else:
+                self.store.count("fabric_push_fail")
+                self.log.warning("fabric push of %s/%s to %s rejected: "
+                                 "%r", kind, key, addr, reply)
+        if landed >= min(want, len(targets)) and landed > 0:
+            self.store.count("park_replicated")
+        elif targets:
+            self.store.count("park_degraded")
+            self.log.warning(
+                "fabric park of %s/%s degraded: %d/%d peer copies "
+                "landed (%d peers eligible)", kind, key, landed, want,
+                len(targets))
+        return landed
+
+    # -- remote fetch on miss ----------------------------------------------
+
+    def resume(self, session_id: str
+               ) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        got = self.store.resume(session_id)
+        if got is not None:
+            return got
+        return self.fetch("session", session_id)
+
+    def fetch(self, kind: str, key: str
+              ) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        """Locate-and-fetch one artifact from a surviving holder; None
+        when no holder has a usable copy.  The fetched copy installs
+        un-restamped and re-reads through the LOCAL store, so the
+        weights_version fence applies exactly as it does to local
+        entries."""
+        holders = self.locate(kind, key)
+        if not holders:
+            # The placement map is heartbeat-fed and truncated (a
+            # replica advertises only its most recent entries), so an
+            # empty locate is not proof of loss: probe the rendezvous
+            # heads — the same peers a replicated park would have
+            # chosen — before giving up.  Bounded: replication + 1
+            # probes, not a fleet sweep.
+            holders = self._replica_targets(key)[:self.replication + 1]
+        for addr in holders:
+            if addr == self.self_addr:
+                continue
+            self.store.count("fabric_fetch")
+            try:
+                reply = self._rpc(addr,
+                                  {"op": "kv_fetch", "kind": kind,
+                                   "key": key},
+                                  timeout=self.push_timeout)
+            except (OSError, wire.WireError) as e:
+                self.store.count("fabric_fetch_fail")
+                self.log.warning("fabric fetch of %s/%s from %s failed: "
+                                 "%s", kind, key, addr, e)
+                continue
+            if not isinstance(reply, wire.RawFrame) \
+                    or not isinstance(reply.meta, dict) \
+                    or reply.meta.get("op") != "kv_artifact":
+                self.store.count("fabric_fetch_miss")
+                continue
+            ameta = reply.meta.get("meta")
+            if not isinstance(ameta, dict):
+                self.store.count("fabric_fetch_miss")
+                continue
+            if "gang_size" in ameta:
+                # Gang-sharded artifacts re-import WHOLE or not at all:
+                # a torn/truncated gang must reject loudly here, never
+                # surface as a smaller gang to the importer.
+                try:
+                    unpack_gang_shards(ameta, reply.body)
+                except ValueError as e:
+                    self.store.count("fabric_reject_torn")
+                    self.log.warning(
+                        "fabric fetch of %s/%s from %s returned a torn "
+                        "gang artifact (%s); rejecting", kind, key,
+                        addr, e)
+                    continue
+            try:
+                self.store.put(kind, key, ameta, reply.body, stamp=False)
+            except KVTierFull:
+                self.store.count("fabric_fetch_fail")
+                return None     # nowhere to land it locally
+            got = self.store.get(kind, key)
+            if got is None:
+                # The local fence rejected the copy (stale-fence holder
+                # offering old-version state): drop it and keep looking
+                # — another holder may have a current copy.
+                self.store.count("fabric_reject_stale")
+                self.store.delete(kind, key)
+                continue
+            self.store.count("fabric_fetch_hit")
+            self.store.count("fabric_fetch_bytes", len(reply.body))
+            return got
+        return None
+
+    def locate(self, kind: str, key: str) -> List[str]:
+        """Holder addrs for one artifact, from the registry's
+        placement map (``kv_locate`` — built from the session/prefix
+        lists every replica's heartbeat already advertises)."""
+        if self.registry_addr is None:
+            return []
+        try:
+            reply = self._rpc(self.registry_addr,
+                              {"op": "kv_locate", "kind": kind,
+                               "key": key},
+                              timeout=self.push_timeout)
+        except (OSError, wire.WireError) as e:
+            self.log.warning("kv_locate of %s/%s failed: %s", kind,
+                             key, e)
+            return []
+        if isinstance(reply, dict) and isinstance(reply.get("addrs"),
+                                                  list):
+            return [a for a in reply["addrs"] if isinstance(a, str)]
+        return []
+
+    # -- wire ops the owning replica serves --------------------------------
+
+    def handle_put(self, msg: "wire.RawFrame") -> Dict[str, Any]:
+        """Serve one peer's ``kv_put``: install the artifact WITHOUT
+        re-stamping (the original writer's fence must survive the
+        hop)."""
+        meta = msg.meta
+        kind = meta.get("kind")
+        key = meta.get("key")
+        ameta = meta.get("meta")
+        if kind not in KINDS or not isinstance(key, str) or not key \
+                or not isinstance(ameta, dict):
+            return {"op": "error", "kind": "bad_request",
+                    "error": "malformed kv_put"}
+        try:
+            self.store.put(kind, key, ameta, msg.body, stamp=False)
+        except KVTierFull as e:
+            return {"op": "error", "kind": "kv_tier_full",
+                    "error": str(e)}
+        self.store.count("fabric_store")
+        return {"op": "kv_put_ok", "kind": kind, "key": key}
+
+    def handle_fetch(self, msg: Dict[str, Any]) -> Any:
+        """Serve one peer's ``kv_fetch``: the artifact as a raw frame,
+        or an explicit miss.  Reads via the RAW store (no fabric
+        re-fetch — a locate loop between two replicas that both miss
+        must terminate here)."""
+        kind = msg.get("kind")
+        key = msg.get("key")
+        if kind not in KINDS or not isinstance(key, str) or not key:
+            return {"op": "error", "kind": "bad_request",
+                    "error": "malformed kv_fetch"}
+        got = self.store.get(kind, key)
+        if got is None:
+            return {"op": "kv_miss", "kind": kind, "key": key}
+        meta, body = got
+        self.store.count("fabric_serve")
+        return wire.RawFrame({"op": "kv_artifact", "kind": kind,
+                              "key": key, "meta": meta}, body)

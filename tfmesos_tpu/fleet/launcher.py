@@ -47,8 +47,8 @@ from tfmesos_tpu.fleet.catalog import (POOL, POOL_KEY, ModelCatalog,
 from tfmesos_tpu.fleet.client import FleetClient
 from tfmesos_tpu.fleet.gateway import Gateway
 from tfmesos_tpu.fleet.metrics import FleetMetrics
-from tfmesos_tpu.fleet.registry import (ALIVE, DEAD, DECODE, PREFILL,
-                                        UNIFIED, WARMING,
+from tfmesos_tpu.fleet.registry import (ALIVE, DEAD, DECODE, KV,
+                                        PREFILL, UNIFIED, WARMING,
                                         ReplicaRegistry,
                                         validate_model_id)
 from tfmesos_tpu.fleet.router import Router
@@ -60,7 +60,8 @@ from tfmesos_tpu.utils.logging import get_logger
 __all__ = ["FleetServer", "RolloutError"]
 
 #: tier role -> the scheduler job name its Mode-B tasks launch under.
-TIER_JOBS = {UNIFIED: "replica", PREFILL: "prefill", DECODE: "decode"}
+TIER_JOBS = {UNIFIED: "replica", PREFILL: "prefill", DECODE: "decode",
+             KV: "kv"}
 
 #: weights_version labels join the replica COMMAND LINE, which Mode-B
 #: agents execute with shell=True — the charset is a hard security
@@ -119,6 +120,8 @@ class FleetServer:
                  n_draft: int = 4,
                  kv_tier_mb: float = 0.0,
                  kv_tier_dir: Optional[str] = None,
+                 kv_replication: int = 1,
+                 kv_replicas: int = 0,
                  warmup: bool = False,
                  prefill_replicas: int = 0,
                  decode_replicas: int = 0,
@@ -298,6 +301,33 @@ class FleetServer:
         self.kv_tier_dir = (validate_kv_tier_dir(kv_tier_dir)
                             if kv_tier_dir is not None else None)
         self._kv_tier_tmp: Optional[str] = None
+        #: cross-host KV fabric (docs/SERVING.md "Cross-host KV
+        #: fabric"): replication is the K-way parking factor each
+        #: replica's fabric wrapper enforces (1 = local-only, the
+        #: pre-fabric behavior exactly); kv_replicas boots that many
+        #: dedicated KV-role holders — storage-only peers that park
+        #: sessions/prefixes but never serve tokens, so artifacts
+        #: survive every serving replica scaling to zero.  Both join
+        #: the shell=True replica command line, so both are validated
+        #: as ints here (str(int) emits [0-9]+ only — charset-safe).
+        self.kv_replication = int(kv_replication)
+        if not 1 <= self.kv_replication <= 8:
+            raise ValueError(
+                f"kv_replication must be in [1, 8], got {kv_replication}")
+        self.kv_replicas = int(kv_replicas)
+        if self.kv_replicas < 0:
+            raise ValueError(
+                f"kv_replicas must be >= 0, got {kv_replicas}")
+        if self.kv_replicas and self.kv_tier_mb <= 0:
+            raise ValueError(
+                "dedicated KV-role replicas hold tier artifacts — they "
+                "need kv_tier_mb > 0")
+        if self.kv_replicas:
+            # The kv tier is pinned at its boot size: the autoscaler's
+            # signals (queue wait, utilization) never move for a
+            # storage-only holder, so letting the loop retarget it
+            # would only ever shrink it.
+            self._tier_max[KV] = self.kv_replicas
         self.warmup = bool(warmup)
         self.backend = backend
         self.master = master
@@ -436,6 +466,8 @@ class FleetServer:
                 parts += ["--kv-tier-dir", tier_dir]
         elif self.kv_tier_dir:
             parts += ["--kv-tier-dir", self.kv_tier_dir]
+        if self.kv_replication > 1:
+            parts += ["--kv-replication", str(self.kv_replication)]
         if self.warmup:
             # Every launch of this cmd — boot, an autoscale-up, OR a
             # later elastic/Mode-B relaunch — registers warming,
@@ -533,6 +565,13 @@ class FleetServer:
                         self.set_target(role, n)
                         for _ in range(n):
                             self.launch_replica(role)
+            if self.kv_replicas:
+                # Dedicated KV holders ride the same launch/convergence
+                # path as serving tiers (a crashed holder relaunches),
+                # but capacity-0: the router never routes tokens at one.
+                self.set_target(KV, self.kv_replicas)
+                for _ in range(self.kv_replicas):
+                    self.launch_replica(KV)
             self._wait_replicas()
             for gw in self.gateways:
                 gw.rollout_fn = self.rollout
@@ -883,8 +922,22 @@ class FleetServer:
         behavior (the victim keeps finishing its rows)."""
         if not self.migrate_on_drain or self.router is None:
             return False
+        msg: dict = {"op": "migrate"}
         try:
-            self.router.control(addr, {"op": "migrate"}, timeout=30.0)
+            # Broker a direct-stream target up front: the victim pushes
+            # each suspended artifact straight at the survivor (one
+            # bounded attempt) and the router adopts by reference —
+            # artifact bytes cross the wire once instead of twice.  No
+            # eligible survivor (or an old victim binary) just leaves
+            # the relay path: the suspended RawFrames flow through the
+            # router exactly as before.
+            target = self.router.migration_target(addr)
+            if target:
+                msg["push_to"] = target
+        except Exception:
+            pass
+        try:
+            self.router.control(addr, msg, timeout=30.0)
         except Exception as e:
             self.log.warning("migrate request to %s failed (%s); its "
                              "in-flight work drains normally", addr, e)

@@ -40,7 +40,7 @@ from tfmesos_tpu import wire
 from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["WARMING", "ALIVE", "DRAINING", "DEAD", "UNIFIED", "PREFILL",
-           "DECODE", "ROLES", "MODEL_ID_RE", "validate_model_id",
+           "DECODE", "KV", "ROLES", "MODEL_ID_RE", "validate_model_id",
            "ReplicaInfo", "ReplicaRegistry"]
 
 WARMING = "warming"
@@ -52,7 +52,12 @@ DEAD = "dead"
 UNIFIED = "unified"
 PREFILL = "prefill"
 DECODE = "decode"
-ROLES = (UNIFIED, PREFILL, DECODE)
+#: dedicated KV-fabric replicas: jax-free artifact holders (a
+#: KVTierStore behind the replica wire surface, no batcher) that park
+#: other replicas' sessions — never routable for generate/prefill (no
+#: router tier picks the role), but first-choice fabric targets.
+KV = "kv"
+ROLES = (UNIFIED, PREFILL, DECODE, KV)
 
 #: model ids share ``weights_version``'s charset and for the same
 #: reason: the label joins a ``shell=True`` Mode-B replica command
@@ -288,6 +293,23 @@ class ReplicaRegistry:
                 conn.send(self.gang_lookup(msg.get("gang_id")))
             except Exception as e:
                 self.log.warning("gang_lookup reply failed: %s", e)
+            return
+        if isinstance(msg, dict) and msg.get("op") in ("kv_peers",
+                                                       "kv_locate"):
+            # KV-fabric placement queries, served on the heartbeat
+            # socket like gang_lookup: ``kv_peers`` lists replication
+            # targets, ``kv_locate`` resolves which hosts currently
+            # advertise an artifact (the registry-driven placement map
+            # that lets a resume find surviving copies after the
+            # parker died).
+            try:
+                if msg["op"] == "kv_peers":
+                    conn.send(self.kv_peers())
+                else:
+                    conn.send(self.kv_locate(msg.get("kind"),
+                                             msg.get("key")))
+            except Exception as e:
+                self.log.warning("%s reply failed: %s", msg["op"], e)
             return
         addr = self.observe(msg, conn)
         if addr is not None:
@@ -721,8 +743,12 @@ class ReplicaRegistry:
                 agg["live"] += live
                 if rep.state == WARMING:
                     agg["warming"] += 1
-                if rep.state in (ALIVE, WARMING) \
-                        and live < rep.gang_size:
+                elif rep.state == ALIVE and live < rep.gang_size:
+                    # Only an ALIVE gang with members missing is
+                    # degraded.  A re-forming gang (WARMING with
+                    # live < size) already counts under ``warming`` —
+                    # counting it degraded too would double-book the
+                    # whole re-form window.
                     agg["degraded"] += 1
         return agg
 
@@ -734,7 +760,7 @@ class ReplicaRegistry:
         friends), total occupancy, parked-session count, and how many
         replicas run a tier at all."""
         agg: Dict[str, Any] = {"replicas": 0, "sessions": 0,
-                               "ram_bytes_used": 0}
+                               "ram_bytes_used": 0, "ram_bytes": 0}
         with self._lock:
             for rep in self._table.values():
                 kt = rep.kv_tier
@@ -744,10 +770,11 @@ class ReplicaRegistry:
                 sess = kt.get("sessions")
                 if isinstance(sess, list):
                     agg["sessions"] += len(sess)
-                used = kt.get("ram_bytes_used")
-                if isinstance(used, (int, float)) \
-                        and not isinstance(used, bool):
-                    agg["ram_bytes_used"] += int(used)
+                for field in ("ram_bytes_used", "ram_bytes"):
+                    used = kt.get(field)
+                    if isinstance(used, (int, float)) \
+                            and not isinstance(used, bool):
+                        agg[field] += int(used)
                 counters = kt.get("counters")
                 if isinstance(counters, dict):
                     for k, v in counters.items():
@@ -755,6 +782,69 @@ class ReplicaRegistry:
                                 and not isinstance(v, bool):
                             agg[k] = agg.get(k, 0) + int(v)
         return agg
+
+    def kv_peers(self) -> Dict[str, Any]:
+        """The KV fabric's replication-target list: every routable
+        replica that runs a KV tier, plus every dedicated KV-role
+        replica (tier or not — a booting KV holder is still a valid
+        push target).  Dedicated holders sort first so ``KVFabric``
+        prefers parking on hosts whose whole job is parking.  Reply is
+        a plain dict served on the heartbeat socket (see ``_on_msg``)."""
+        peers: List[dict] = []
+        with self._lock:
+            for rep in self._table.values():
+                if rep.state not in (ALIVE, DRAINING):
+                    continue
+                role = rep.role or UNIFIED
+                if role != KV and not isinstance(rep.kv_tier, dict):
+                    continue
+                peers.append({"addr": rep.addr, "role": role,
+                              "weights_version":
+                                  rep.weights_version or ""})
+        peers.sort(key=lambda p: (p["role"] != KV, p["addr"]))
+        return {"op": "kv_peers", "peers": peers}
+
+    def kv_locate(self, kind, key) -> Dict[str, Any]:
+        """Resolve which hosts currently advertise one artifact — the
+        placement map a resume walks after its parker died.  Built
+        from the same heartbeat-carried ``kv_tier`` summaries the
+        gateway gauges read: a holder that died stops advertising
+        within one sweep, so forwarding never dials a corpse for long.
+        Session keys match the advertised ``sessions`` list; prefix
+        keys the ``prefix.hashes`` list.  Reply always carries an
+        ``addrs`` list (possibly empty) — ``KVFabric.locate`` reads
+        exactly that key."""
+        out: Dict[str, Any] = {"op": "kv_addrs",
+                               "kind": kind if isinstance(kind, str)
+                               else "",
+                               "key": key if isinstance(key, str)
+                               else "",
+                               "addrs": []}
+        if not isinstance(kind, str) or not isinstance(key, str) \
+                or not key:
+            return out
+        with self._lock:
+            for rep in self._table.values():
+                if rep.state not in (ALIVE, DRAINING):
+                    continue
+                kt = rep.kv_tier
+                if not isinstance(kt, dict):
+                    continue
+                if kind == "session":
+                    held = kt.get("sessions")
+                else:
+                    pfx = kt.get("prefix")
+                    held = pfx.get("hashes") if isinstance(
+                        pfx, dict) else None
+                if isinstance(held, list) and key in held:
+                    out["addrs"].append(rep.addr)
+        # Dedicated KV holders first, mirroring kv_peers: they are the
+        # cheapest hosts to serve a fetch (no decode work competing).
+        with self._lock:
+            kv_addrs = {r.addr for r in self._table.values()
+                        if (r.role or UNIFIED) == KV}
+        out["addrs"].sort(key=lambda a: (a not in kv_addrs, a))
+        return out
 
     def spec_summary(self) -> Dict[str, Any]:
         """Fleet-wide speculative-decoding aggregate (the gateway's

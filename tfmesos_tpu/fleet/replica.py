@@ -45,8 +45,8 @@ from tfmesos_tpu.fleet import tracing
 from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["ReplicaServer", "BatcherServing", "batcher_handler",
-           "prefill_handler", "tiny_model", "flagship_model",
-           "tiny_draft_model", "flagship_draft_model",
+           "prefill_handler", "fabric_handler", "tiny_model",
+           "flagship_model", "tiny_draft_model", "flagship_draft_model",
            "build_parser", "main"]
 
 
@@ -247,9 +247,13 @@ class ReplicaServer:
         # so the router can re-place them); "adopt" assigns a warm-pool
         # replica its model, "swap_adapter" ships a weight delta as one
         # raw frame — authenticated like every frame, and
-        # handler-interpreted like generate/prefill.
+        # handler-interpreted like generate/prefill.  The kv_* ops are
+        # the cross-host KV fabric's surface: "kv_put" lands a peer's
+        # replicated park, "kv_fetch" serves a peer's resume, and
+        # "kv_stage" lands a direct peer-to-peer KV stream ahead of
+        # the router's small generate call referencing it.
         if op not in ("generate", "prefill", "migrate", "adopt",
-                      "swap_adapter"):
+                      "swap_adapter", "kv_put", "kv_fetch", "kv_stage"):
             self._send(conn, send_lock,
                        {"op": "error", "id": mid,
                         "kind": "bad_request",
@@ -439,7 +443,10 @@ def _handle_swap_adapter(batcher, msg, reply: Callable) -> None:
 def batcher_handler(serving: BatcherServing, generation: int = 0,
                     weights_version: str = "",
                     model_state: Optional[Dict[str, Any]] = None,
-                    adopt_fn: Optional[Callable] = None) -> Callable:
+                    adopt_fn: Optional[Callable] = None,
+                    token: str = "",
+                    self_addr: Optional[Callable[[], str]] = None
+                    ) -> Callable:
     """The model-backed ``ReplicaServer`` handler (decode/unified
     roles): validate, submit, stream the completion back when the
     batcher finishes it.  A plain ``generate`` dict takes the local
@@ -457,21 +464,76 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
     weights), or a plain requeue marker when the request held no
     exportable state.  The router re-places either form on a surviving
     replica; the client sees one completion, never the move."""
+    import time as _time
+
     import numpy as np
 
     from tfmesos_tpu import serving as serving_mod
     from tfmesos_tpu.serving import Expired, Prefilled, Request, Suspended
 
     batcher = serving.batcher
+    log = get_logger("tfmesos_tpu.fleet.replica")
+    # Direct-stream staging area (docs/SERVING.md "Cross-host KV
+    # fabric"): a peer lands a KV artifact here as one ``kv_stage`` raw
+    # frame, the router's later small ``generate`` call references it
+    # by ``kv_ref`` — the bytes never transit the control plane.
+    # Bounded and TTL'd so an abandoned transfer (router died between
+    # broker and generate) cannot pin replica RAM.
+    _staged: Dict[str, tuple] = {}
+    _stage_lock = threading.Lock()
+    _stage_max = 8
+    _stage_ttl_s = 120.0
+    # Direct-push target for drain migration: the migrate control op
+    # may name the survivor the router already picked (``push_to``), in
+    # which case each Suspended artifact streams peer-to-peer as a
+    # kv_stage frame and only a small ``pushed`` suspended reply rides
+    # back through the control plane.
+    _push_state: Dict[str, Any] = {"to": None}
+
+    def _push_stage(addr: str, smeta: Dict[str, Any],
+                    body: bytes) -> Any:
+        from tfmesos_tpu.fleet.kvtier import fabric_rpc
+
+        return fabric_rpc(addr, smeta, body, token=token, timeout=30.0,
+                          self_addr=self_addr() if self_addr else "")
 
     def handler(msg, reply: Callable) -> None:
         raw = isinstance(msg, wire.RawFrame)
         head = msg.meta if raw else msg
         mid = head.get("id")
+        if head.get("op") == "kv_stage":
+            if not raw:
+                reply({"op": "error", "id": mid, "kind": "bad_request",
+                       "error": "kv_stage ships its artifact as a raw "
+                                "frame"})
+                return
+            xfer = head.get("xfer")
+            if not isinstance(xfer, str) or not xfer:
+                reply({"op": "error", "id": mid, "kind": "bad_request",
+                       "error": "kv_stage needs a string xfer id"})
+                return
+            now = _time.monotonic()
+            with _stage_lock:
+                for k in [k for k, (t, _m, _b) in _staged.items()
+                          if now - t > _stage_ttl_s]:
+                    del _staged[k]
+                if len(_staged) >= _stage_max:
+                    reply({"op": "error", "id": mid,
+                           "kind": "overloaded",
+                           "error": f"kv stage full ({_stage_max} "
+                                    f"transfers pending)"})
+                    return
+                _staged[xfer] = (now, dict(head), msg.body)
+            reply({"op": "kv_staged", "id": mid, "xfer": xfer,
+                   "bytes": len(msg.body)})
+            return
         if head.get("op") == "migrate":
             # Ack immediately: the suspensions themselves surface as
             # the in-flight requests' own replies on the next loop
             # tick, and the drain waits on outstanding reaching zero.
+            pt = head.get("push_to")
+            _push_state["to"] = pt if isinstance(pt, str) and pt \
+                else None
             batcher.preempt_all()
             reply({"op": "migrated", "id": mid})
             return
@@ -498,6 +560,28 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                             "op (role: decode/unified); route prefill "
                             "to a prefill-role replica"})
             return
+        staged_body = None
+        if not raw and head.get("kv_ref") is not None:
+            # Direct-streamed generate: the KV artifact already landed
+            # here as a kv_stage frame; the router's small call names
+            # it.  The staged meta merged under the call's own fields
+            # reconstructs exactly the raw-frame head the relay path
+            # would have delivered.
+            kv_ref = head.get("kv_ref")
+            with _stage_lock:
+                ent = _staged.pop(kv_ref, None) \
+                    if isinstance(kv_ref, str) else None
+            if ent is None:
+                reply({"op": "error", "id": mid, "kind": "bad_request",
+                       "error": f"unknown kv_ref {kv_ref!r}: staged "
+                                f"transfer expired or never landed"})
+                return
+            _t0, smeta, staged_body = ent
+            merged = {k: v for k, v in smeta.items()
+                      if k not in ("op", "id", "xfer", "trace",
+                                   "prefill_ms")}
+            merged.update(head)
+            head = merged
         want_model = head.get("model")
         if isinstance(want_model, str) and want_model \
                 and model_state is not None \
@@ -547,6 +631,10 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                 req.on_tokens = on_tokens
             if raw:
                 prefilled = serving_mod.unpack_prefilled(head, msg.body)
+                batcher.validate(Prefilled(req, prefilled))
+            elif staged_body is not None:
+                prefilled = serving_mod.unpack_prefilled(head,
+                                                         staged_body)
                 batcher.validate(Prefilled(req, prefilled))
             else:
                 # Reject un-servable requests NOW with an explicit
@@ -598,6 +686,35 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                             adapter_version=adapter)
                 if model_id:
                     meta["model_id"] = model_id
+                pt = _push_state["to"]
+                if pt:
+                    # Drain migration with a brokered survivor: stream
+                    # the artifact peer-to-peer and hand the router only
+                    # a small reference.  One bounded attempt — a failed
+                    # push falls back to the relay frame below, so the
+                    # fast path never costs correctness.
+                    xfer = f"mig-{mid}"
+                    smeta = dict(meta)
+                    smeta.update(op="kv_stage", xfer=xfer)
+                    ack = None
+                    try:
+                        ack = _push_stage(pt, smeta, body)
+                    except (OSError, wire.WireError) as e:
+                        log.warning("direct KV push of %s to %s failed:"
+                                    " %s; relaying through the router",
+                                    xfer, pt, e)
+                    if isinstance(ack, dict) \
+                            and ack.get("op") == "kv_staged":
+                        out = {"op": "suspended", "id": mid,
+                               "pushed": True, "xfer": xfer,
+                               "push_to": pt, "bytes": len(body),
+                               "gen": generation,
+                               "weights_version": weights_version,
+                               "adapter_version": adapter}
+                        if model_id:
+                            out["model_id"] = model_id
+                        reply(_attach_trace(out, tr, failed=True))
+                        return
                 # A migration hop's spans always piggyback (failed=True
                 # here just means "always export"): the router stitches
                 # the victim's suspend into the one waterfall.
@@ -615,7 +732,9 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
     return handler
 
 
-def prefill_handler(batcher, max_queue: int = 8) -> Callable:
+def prefill_handler(batcher, max_queue: int = 8, token: str = "",
+                    self_addr: Optional[Callable[[], str]] = None
+                    ) -> Callable:
     """The prefill-role ``ReplicaServer`` handler: run the prompt
     through prefill only (``export_kv``) and stream the KV artifact
     back as ONE raw binary frame.  Prefill runs off the connection's
@@ -640,7 +759,7 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
 
     def drain() -> None:
         while True:
-            req, mid, reply, t_enq = work_q.get()
+            req, mid, reply, t_enq, push = work_q.get()
             tr = getattr(req, "trace", None)
             if tr is not None:
                 tr.add("replica", "prefill_queue", tr.rel_ms(t_enq),
@@ -667,6 +786,38 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
                     tr.add("replica", "prefill_export", tr.rel_ms(t0),
                            prefill_ms)
                     _attach_trace(meta, tr)
+                if push is not None:
+                    # Direct disagg streaming: the router already
+                    # picked the decode replica and brokered its addr;
+                    # land the KV there as one kv_stage frame and hand
+                    # the router only a small reference.  One bounded
+                    # attempt — on any failure the full raw frame
+                    # relays through the router exactly as before.
+                    daddr, xfer = push
+                    smeta = dict(meta)
+                    smeta.update(op="kv_stage", xfer=xfer)
+                    ack = None
+                    try:
+                        from tfmesos_tpu.fleet.kvtier import fabric_rpc
+
+                        ack = fabric_rpc(
+                            daddr, smeta, body, token=token,
+                            timeout=30.0,
+                            self_addr=self_addr() if self_addr else "")
+                    except (OSError, wire.WireError) as e:
+                        log.warning("direct KV push of %s to %s "
+                                    "failed: %s; relaying through the "
+                                    "router", xfer, daddr, e)
+                    if isinstance(ack, dict) \
+                            and ack.get("op") == "kv_staged":
+                        out = {"op": "prefilled", "id": mid,
+                               "pushed": True, "xfer": xfer,
+                               "bytes": len(body),
+                               "prefill_ms": prefill_ms}
+                        if tr is not None:
+                            _attach_trace(out, tr)
+                        reply(out)
+                        continue
                 reply(wire.RawFrame(meta, body))
             except Exception as e:
                 log.exception("prefill failed: %s", e)
@@ -718,13 +869,66 @@ def prefill_handler(batcher, max_queue: int = 8) -> Callable:
                 {"op": "error", "id": mid, "kind": "bad_request",
                  "error": str(e)}, tr, failed=True))
             return
+        push = None
+        pt, xf = head.get("push_to"), head.get("xfer")
+        if isinstance(pt, str) and pt and isinstance(xf, str) and xf:
+            push = (pt, xf)
         try:
-            work_q.put_nowait((req, mid, reply, _time.perf_counter()))
+            work_q.put_nowait((req, mid, reply, _time.perf_counter(),
+                               push))
         except _queue.Full:
             reply(_attach_trace(
                 {"op": "error", "id": mid, "kind": "overloaded",
                  "error": f"prefill queue full ({max_queue} pending)"},
                 tr, failed=True))
+
+    return handler
+
+
+def fabric_handler(fabric, inner: Optional[Callable] = None) -> Callable:
+    """Wrap a replica handler with the KV fabric's wire surface
+    (docs/SERVING.md "Cross-host KV fabric"): ``kv_put`` lands a peer's
+    replicated park, ``kv_fetch`` serves a peer's resume from this
+    host's tier.  Everything else delegates to ``inner``; with no
+    ``inner`` (a dedicated ``--role kv`` replica) other ops are refused
+    — a KV holder never decodes.  Jax-free by construction, so the
+    dedicated holder process never imports the model stack."""
+
+    def handler(msg, reply: Callable) -> None:
+        raw = isinstance(msg, wire.RawFrame)
+        head = msg.meta if raw else msg
+        op = head.get("op")
+        mid = head.get("id")
+        if op == "kv_put":
+            if not raw:
+                reply({"op": "error", "id": mid, "kind": "bad_request",
+                       "error": "kv_put ships its artifact as a raw "
+                                "frame"})
+                return
+            out = fabric.handle_put(msg)
+            if isinstance(out, dict):
+                out.setdefault("id", mid)
+            reply(out)
+            return
+        if op == "kv_fetch":
+            out = fabric.handle_fetch(head)
+            if isinstance(out, wire.RawFrame):
+                out.meta.setdefault("id", mid)
+            elif isinstance(out, dict):
+                out.setdefault("id", mid)
+            reply(out)
+            return
+        if inner is not None:
+            inner(msg, reply)
+            return
+        if op == "migrate":
+            # A KV holder has no rows to suspend; ack so a tier-blind
+            # drain completes the same way everywhere.
+            reply({"op": "migrated", "id": mid})
+            return
+        reply({"op": "error", "id": mid, "kind": "bad_request",
+               "error": f"this replica holds KV state only (role: "
+                        f"kv); it does not serve {op!r}"})
 
     return handler
 
@@ -912,6 +1116,61 @@ def _gang_member_main(args, token: str, spec, generation: int) -> int:
     return 0 if reason == "stopped" else 1
 
 
+def _kv_holder_main(args, token: str, generation: int,
+                    node: str = "") -> int:
+    """A dedicated ``--role kv`` replica: a bare KV tier behind the
+    replica wire surface — no model, no batcher, no JAX import.  Its
+    whole job is holding other replicas' parked artifacts (fabric
+    pushes land here first, and resumes fetch from here), so a fleet
+    can scale its serving replicas to zero without losing one parked
+    session (docs/SERVING.md "Cross-host KV fabric")."""
+    from tfmesos_tpu.fleet.kvtier import KVFabric, KVTierStore
+
+    log = get_logger("tfmesos_tpu.fleet.replica")
+    if args.kv_tier_mb <= 0 and not args.kv_tier_dir:
+        print("--role kv needs a tier to hold (--kv-tier-mb and/or "
+              "--kv-tier-dir)", file=sys.stderr)
+        return 2
+    # An EMPTY stamp on purpose: the holder stores many replicas'
+    # artifacts verbatim (kv_put installs without re-stamping) and must
+    # never fence a read by its OWN identity — fencing belongs to the
+    # importer, which judges the original writer's stamp.
+    store = KVTierStore(ram_bytes=int(max(0.0, args.kv_tier_mb) * 1e6),
+                        disk_dir=args.kv_tier_dir, token=token,
+                        stamp={})
+    fabric = KVFabric(store, token=token, registry_addr=args.registry,
+                      replication=1)
+    handler = fabric_handler(fabric)
+
+    def extra() -> Dict[str, Any]:
+        beat: Dict[str, Any] = {"role": "kv", "gen": generation,
+                                "kv_tier": store.summary()}
+        if args.weights_version:
+            beat["weights_version"] = args.weights_version
+        if node:
+            beat["node"] = node
+        return beat
+
+    server = ReplicaServer(
+        handler, token=token, capacity=0, host=args.host,
+        port=args.port, registry_addr=args.registry,
+        heartbeat_interval=args.heartbeat_interval, extra_info=extra)
+    server.start()
+    fabric.self_addr = server.addr or ""
+    print(f"replica serving on {server.addr} (role kv)", flush=True)
+    stop = threading.Event()
+
+    def on_signal(signum, frame) -> None:
+        log.info("signal %d: draining", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    stop.wait()
+    server.stop()
+    return 0
+
+
 # -- process entry ----------------------------------------------------------
 
 
@@ -953,14 +1212,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "sharing the directory can resume each "
                         "other's parked sessions (bounded at 4x the "
                         "RAM budget)")
-    p.add_argument("--role", choices=("unified", "prefill", "decode"),
+    p.add_argument("--role", choices=("unified", "prefill", "decode",
+                                      "kv"),
                    default="unified",
                    help="serving role: 'unified' (default) serves whole "
                         "requests; 'prefill' only runs prompts through "
                         "prefill and exports their KV pages; 'decode' "
                         "additionally imports exported KV and enters "
                         "rows straight into decode (disaggregated "
-                        "serving, docs/SERVING.md)")
+                        "serving, docs/SERVING.md); 'kv' serves NO "
+                        "model at all — a jax-free dedicated holder "
+                        "for the cross-host KV fabric's replicated "
+                        "parks (needs a tier via --kv-tier-mb/-dir)")
+    p.add_argument("--kv-replication", type=int, default=1,
+                   dest="kv_replication",
+                   help="K-way replicated session parking (default 1 = "
+                        "local only): a park lands on this replica "
+                        "PLUS K-1 fabric peers before it counts as "
+                        "replicated, so a parked session survives "
+                        "SIGKILL of its parking host and resumes from "
+                        "a surviving copy (docs/SERVING.md 'Cross-host "
+                        "KV fabric'); needs --registry and a KV tier")
     p.add_argument("--pipeline-depth", type=int, default=0,
                    choices=(0, 1), dest="pipeline_depth",
                    help="1 pipelines the decode loop with a device-"
@@ -1040,6 +1312,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     idx = os.environ.get("TPUMESOS_TASK_INDEX", "")
     node = f"{job}:{idx}" if job and idx != "" else ""
 
+    if not 1 <= args.kv_replication <= 8:
+        print("replica: --kv-replication must be in [1, 8]",
+              file=sys.stderr)
+        return 2
+    if args.role == "kv":
+        # Dedicated fabric holder: jax-free, no batcher build at all.
+        return _kv_holder_main(args, token, generation, node)
+
     # Gang identity (docs/SERVING.md "Gang replicas"): when this
     # process was launched as one task of an N-task gang, rank 0 is
     # the LEADER — the one process that owns the fleet identity below —
@@ -1070,6 +1350,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "pool_capable": bool(args.warm_pool),
     }
     batcher = build_batcher(args, token, generation, node=node)
+
+    # The fabric face of the local KV tier (docs/SERVING.md "Cross-host
+    # KV fabric"): replicated parks and locate-driven peer fetch on
+    # miss.  The batcher's tier reference is swapped for the wrapper —
+    # every park/resume from here on goes through the fabric, and the
+    # replica additionally serves kv_put/kv_fetch for its peers.
+    fabric = None
+    srv_cell: List[Any] = []
+    if args.registry and batcher.kv_tier is not None \
+            and batcher.kv_tier_bypass_reason is None:
+        from tfmesos_tpu.fleet.kvtier import KVFabric
+
+        fabric = KVFabric(batcher.kv_tier, token=token,
+                          registry_addr=args.registry,
+                          replication=args.kv_replication)
+        batcher.kv_tier = fabric
 
     def adopt_fn(head, reply) -> None:
         """The ``adopt`` control op: install one catalog model's
@@ -1121,11 +1417,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             version=f"{args.weights_version or 'v0'}@{model_id}",
             on_applied=applied)
 
+    def _self_addr() -> str:
+        # Late-bound: the server (and its addr) exist only after the
+        # handler is built.  Used to tag direct-push sockets so chaos
+        # partition faults can match the peer pair.
+        return srv_cell[0].addr or "" if srv_cell else ""
+
     serving = None
     if args.role == "prefill":
         # Prefill-role replicas never decode: no serve loop runs, the
         # handler drives export_kv directly (exports borrow rows).
-        handler = prefill_handler(batcher)
+        handler = prefill_handler(batcher, token=token,
+                                  self_addr=_self_addr)
     else:
         # NOT started yet: warmup must run before the serve loop owns
         # the rows; submissions made while warming just queue.
@@ -1133,7 +1436,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         handler = batcher_handler(serving, generation=generation,
                                   weights_version=args.weights_version,
                                   model_state=model_state,
-                                  adopt_fn=adopt_fn)
+                                  adopt_fn=adopt_fn, token=token,
+                                  self_addr=_self_addr)
 
     stop = threading.Event()
     leader = None
@@ -1153,6 +1457,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             on_break=lambda rank: stop.set())
         leader.start()
         handler = gang_mod.leader_handler(handler, leader)
+    if fabric is not None:
+        # Outside the gang wrap on purpose: a kv_put/kv_fetch is a
+        # host-local tier operation, never gang-dispatched.
+        handler = fabric_handler(fabric, handler)
 
     def extra() -> Dict[str, Any]:
         # Heartbeat advert: the tier this replica belongs to and its
@@ -1210,11 +1518,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         heartbeat_interval=args.heartbeat_interval, extra_info=extra,
         status="warming" if (args.warmup or leader is not None)
         else None)
+    srv_cell.append(server)
     # Register (as warming with --warmup) BEFORE compiling: the fleet's
     # bring-up accounting sees the replica exists while the router
     # cannot yet pick it, and a relaunched replica is visibly re-warming
     # instead of silently absent.
     server.start()
+    if fabric is not None:
+        fabric.self_addr = server.addr or ""
     if args.warmup:
         # Role replicas warm only the surface they serve: a prefill
         # replica never decodes, a decode replica never prefills (it

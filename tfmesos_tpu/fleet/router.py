@@ -107,6 +107,8 @@ replica to routing.
 
 from __future__ import annotations
 
+import itertools
+import os
 import random
 import threading
 import time
@@ -118,6 +120,15 @@ from tfmesos_tpu.fleet.client import CallTimeout, ConnectionLost, MuxConnection
 from tfmesos_tpu.fleet.containment import (BreakerBoard, BreakerConfig,
                                            RetryBudget)
 from tfmesos_tpu.fleet.metrics import FleetMetrics
+
+#: process-wide transfer-id stream for direct peer-to-peer KV pushes
+#: (the pid prefix keeps two gateway processes' ids from colliding in
+#: one decode replica's staging area).
+_XFER_SEQ = itertools.count(1)
+
+
+def _new_xfer_id() -> str:
+    return f"xf-{os.getpid()}-{next(_XFER_SEQ)}"
 from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED,
                                         ReplicaInfo, ReplicaRegistry)
 from tfmesos_tpu.utils.logging import get_logger
@@ -786,7 +797,11 @@ class Router:
         target that is itself being drained can answer suspended again;
         the freshest artifact keeps moving until the budget runs out."""
         if body is None:
-            return None                     # requeue marker: just re-run
+            # Either a plain requeue marker (just re-run) or a DIRECT-
+            # PUSHED export: the victim already streamed its artifact
+            # peer-to-peer to the brokered survivor, and only the small
+            # reference rides the control plane.
+            return self._resume_pushed(msg, meta, tried)
         gen = meta.get("gen")
         if not self.registry.gen_allowed(gen):
             # The victim belongs to a reaped (fenced) generation: its
@@ -912,6 +927,105 @@ class Router:
             tracing.cur_event("router", "migration_resume", addr=addr)
             return reply
         return None
+
+    def _resume_pushed(self, msg: Dict[str, Any], meta: dict,
+                       tried: set) -> Optional[Any]:
+        """Resume a DIRECT-PUSHED migration export: the victim already
+        landed its artifact on the brokered survivor as a ``kv_stage``
+        frame, so the resume is one small ``generate`` call carrying
+        only the ``kv_ref``.  Single bounded attempt — the stage lives
+        on exactly one host; any failure returns ``None`` and the
+        caller re-runs the request from scratch (equally lossless, the
+        stage just expires)."""
+        addr = meta.get("push_to")
+        xfer = meta.get("xfer")
+        if not meta.get("pushed") or not isinstance(addr, str) \
+                or not addr or not isinstance(xfer, str) or not xfer:
+            return None                     # requeue marker: just re-run
+        if not self.registry.gen_allowed(meta.get("gen")):
+            self.metrics.inc("migration_fenced")
+            self.log.warning("dropping pushed export from a fenced "
+                             "generation (%r); re-running the request",
+                             meta.get("gen"))
+            return None
+        deadline = self._deadline_of(msg)
+        if deadline is not None and self._clock() >= deadline:
+            return self._expired_reply("while resuming its migrated "
+                                       "state")
+        emit = msg.get("_emit")
+        call = {"op": "generate", "kv_ref": xfer,
+                "prompt": msg.get("prompt"),
+                "max_new_tokens": msg.get("max_new_tokens"),
+                "stop_token": msg.get("stop_token"),
+                "priority": msg.get("priority")}
+        if msg.get("stream"):
+            call["stream"] = True
+        rprobe = self._breaker_dispatch(addr)
+        t0 = self._clock()
+        timeout = self._call_timeout(deadline, True)
+        try:
+            if emit is not None:
+                reply = self._link(addr).call(
+                    self._wire_msg(call, deadline), timeout=timeout,
+                    on_partial=emit)
+            else:
+                reply = self._link(addr).call(
+                    self._wire_msg(call, deadline), timeout=timeout)
+        except CallTimeout:
+            self.metrics.inc("migration_push_failed")
+            return None
+        except wire.WireError:
+            return None
+        except (ConnectionLost, OSError):
+            self._drop_link(addr)
+            self.metrics.inc("migration_push_failed")
+            return None
+        s = self._suspended_of(reply)
+        if s is not None:
+            # The survivor is itself being drained: carry the freshest
+            # artifact onward through the standard resume machinery.
+            self._breaker_ok(addr, t0, rprobe)
+            tried.add(addr)
+            self.metrics.inc("migration_exports")
+            meta2, body2 = s
+            if body2 is not None:
+                if not self.registry.gen_allowed(meta2.get("gen")):
+                    return None
+                return self._resume_elsewhere(msg, meta2, body2, tried)
+            return self._resume_pushed(msg, meta2, tried)
+        if isinstance(reply, dict) and reply.get("op") == "error":
+            if reply.get("kind") == "deadline_exceeded":
+                return reply
+            # unknown kv_ref (stage expired), wrong model, anything
+            # else: deterministic for the PUSH, not the request.
+            self.metrics.inc("migration_rejected")
+            return None
+        self._breaker_ok(addr, t0, rprobe)
+        self.metrics.inc("migration_resumes")
+        self.metrics.inc("migration_direct")
+        tracing.cur_event("router", "migration_resume", addr=addr,
+                          direct=True)
+        return reply
+
+    def migration_target(self, victim_addr: str) -> Optional[str]:
+        """The survivor a drain-migration victim should DIRECT-PUSH its
+        suspended artifacts to: same model / weights_version / adapter
+        as the victim (the fencing rules a relay resume enforces apply
+        identically), picked by load.  ``None`` when no eligible
+        survivor exists — the migrate op then runs without a push
+        target and every artifact relays through the router exactly as
+        before."""
+        rep = None
+        for r in self.registry.members():
+            if r.addr == victim_addr:
+                rep = r
+                break
+        if rep is None:
+            return None
+        return self._pick_resume(
+            {victim_addr}, rep.weights_version or "",
+            model=rep.model_id or None,
+            adapter=getattr(rep, "adapter_version", "") or None)
 
     # -- the routing loop --------------------------------------------------
 
@@ -1179,6 +1293,17 @@ class Router:
                     "max_new_tokens": msg.get("max_new_tokens"),
                     "stop_token": msg.get("stop_token"),
                     "priority": msg.get("priority")}
+            # Direct peer streaming (docs/SERVING.md "Cross-host KV
+            # fabric"): broker the decode address UP FRONT so the
+            # prefill replica can push its KV straight there — bytes
+            # never transit the router.  A saturated/empty pick just
+            # omits the broker fields and the reply relays as before.
+            xfer = daddr0 = None
+            daddr0 = self.pick_decode(model=model)
+            if daddr0 is not None:
+                xfer = _new_xfer_id()
+                call["push_to"] = daddr0
+                call["xfer"] = xfer
             pprobe = self._breaker_dispatch(paddr)
             patt0 = tracing.cur_elapsed()
             tp = self._clock()
@@ -1215,7 +1340,10 @@ class Router:
                                                probe=pprobe):
                     break
                 continue
-            if isinstance(praw, dict):
+            pushed = (isinstance(praw, dict)
+                      and praw.get("op") == "prefilled"
+                      and praw.get("pushed") and xfer is not None)
+            if isinstance(praw, dict) and not pushed:
                 self._trace_attempt("prefill", patt0, paddr,
                                     "error_reply", reply=praw,
                                     kind=str(praw.get("kind")))
@@ -1233,8 +1361,8 @@ class Router:
                                                 probe=pprobe):
                     break
                 continue
-            if not isinstance(praw, wire.RawFrame) \
-                    or not isinstance(praw.meta, dict):
+            if not pushed and (not isinstance(praw, wire.RawFrame)
+                               or not isinstance(praw.meta, dict)):
                 last = RoutingError(
                     f"malformed prefill reply from {paddr}")
                 ptried.add(paddr)
@@ -1244,7 +1372,11 @@ class Router:
             self._breaker_ok(paddr, tp, pprobe)
             ttft_ms = (self._clock() - t0) * 1000.0
             self.metrics.inc("disagg_prefills")
-            out, derr = self._disagg_decode(msg, praw)
+            if pushed:
+                out, derr = self._disagg_decode_pushed(msg, praw,
+                                                       daddr0)
+            else:
+                out, derr = self._disagg_decode(msg, praw)
             if out is not None:
                 if isinstance(out, dict) and out.get("op") == "completion":
                     # The first token exists the moment the prefill
@@ -1272,15 +1404,19 @@ class Router:
         return None, last
 
     def _disagg_decode(self, msg: Dict[str, Any],
-                       praw: "wire.RawFrame") -> tuple:
+                       praw: "wire.RawFrame",
+                       art_wv: Optional[str] = None) -> tuple:
         """Phase 2: forward the KV artifact to a decode replica as one
         raw frame; bounded retry onto a different decode replica
         (transient failures — connection loss, timeout, internal
         errors — retry; a bad_request rejection is deterministic and
         returns).  Returns ``(reply, last_error)`` with ``reply`` None
-        when the tier is exhausted."""
+        when the tier is exhausted.  ``art_wv`` pre-pins the artifact's
+        weights_version (a suspended mid-stream export adopted by the
+        pushed path arrives already pinned)."""
         meta = {k: v for k, v in praw.meta.items()
-                if k not in ("op", "id", "prefill_ms", "trace")}
+                if k not in ("op", "id", "prefill_ms", "trace", "gen",
+                             "weights_version")}
         meta.update(op="generate", prompt=msg.get("prompt"),
                     max_new_tokens=msg.get("max_new_tokens"),
                     stop_token=msg.get("stop_token"),
@@ -1297,7 +1433,6 @@ class Router:
         # pins its weights_version: pages decoded under one set of
         # weights must only continue under the same (fresh prefill
         # artifacts carry no pin — the tier shares the fleet version).
-        art_wv: Optional[str] = None
         for attempt in range(self.max_retries + 1):
             if deadline is not None and self._clock() >= deadline:
                 return self._expired_reply("before decode could "
@@ -1420,6 +1555,98 @@ class Router:
             self.metrics.inc("disagg_decodes")
             return reply, None
         return None, last
+
+    def _disagg_decode_pushed(self, msg: Dict[str, Any],
+                              pref: Dict[str, Any],
+                              daddr: str) -> tuple:
+        """Phase 2 after a DIRECT peer push: the KV artifact already
+        sits staged on ``daddr`` (the prefill replica streamed it there
+        and acked ``pushed``), so the decode call is one small dict
+        naming the ``kv_ref``.  Single bounded attempt — the stage
+        lives on exactly one host; any failure returns ``(None, err)``
+        and the caller falls back to the unified tier, the stage just
+        expires."""
+        xfer = pref.get("xfer")
+        nbytes = pref.get("bytes")
+        emit = msg.get("_emit")
+        deadline = self._deadline_of(msg)
+        if deadline is not None and self._clock() >= deadline:
+            return self._expired_reply("before decode could run"), None
+        call = {"op": "generate", "kv_ref": xfer,
+                "prompt": msg.get("prompt"),
+                "max_new_tokens": msg.get("max_new_tokens"),
+                "stop_token": msg.get("stop_token"),
+                "priority": msg.get("priority")}
+        if msg.get("stream"):
+            call["stream"] = True
+        dprobe = self._breaker_dispatch(daddr)
+        datt0 = tracing.cur_elapsed()
+        timeout = self._call_timeout(deadline, True)
+        try:
+            tm = t0 = self._clock()
+            if emit is not None:
+                reply = self._link(daddr).call(
+                    self._wire_msg(call, deadline), timeout=timeout,
+                    on_partial=emit)
+            else:
+                reply = self._link(daddr).call(
+                    self._wire_msg(call, deadline), timeout=timeout)
+            self.metrics.observe("kv_decode_turnaround_ms",
+                                 (self._clock() - t0) * 1000.0)
+            # The bytes moved peer-to-peer (the prefill replica's ack
+            # counted them); recorded only once the referencing decode
+            # call DELIVERED, mirroring the relay path's discipline.
+            if isinstance(nbytes, int) and nbytes > 0:
+                self.metrics.inc("kv_transfer_bytes", nbytes)
+                self.metrics.inc("kv_direct_bytes", nbytes)
+            self.metrics.inc("kv_direct_transfers")
+        except CallTimeout as e:
+            self._trace_attempt("decode", datt0, daddr, "timeout",
+                                clipped=timeout < self.request_timeout)
+            return None, e
+        except wire.WireError as e:
+            return None, RoutingError(
+                f"pushed decode call to {daddr} not encodable: {e}")
+        except (ConnectionLost, OSError) as e:
+            self._trace_attempt("decode", datt0, daddr, "link_failure")
+            self._drop_link(daddr)
+            self.registry.mark_dead(daddr, why="pushed decode link "
+                                               "failure")
+            return None, e
+        s = self._suspended_of(reply)
+        if s is not None:
+            # The decode replica is being drained: adopt its fresher
+            # suspended artifact through the standard relay machinery
+            # (it carries the tokens decoded so far).
+            self._trace_attempt("decode", datt0, daddr, "suspended",
+                                reply=reply)
+            self._breaker_ok(daddr, tm, dprobe)
+            self.metrics.inc("migration_exports")
+            meta2, body2 = s
+            if body2 is not None \
+                    and self.registry.gen_allowed(meta2.get("gen")):
+                wv2 = meta2.get("weights_version")
+                wv2 = wv2 if isinstance(wv2, str) and wv2 else None
+                return self._disagg_decode(
+                    msg, wire.RawFrame(meta2, body2), art_wv=wv2)
+            return None, RoutingError(
+                f"decode replica {daddr} suspended the pushed request")
+        if isinstance(reply, dict) and reply.get("op") == "error":
+            self._trace_attempt("decode", datt0, daddr, "error_reply",
+                                reply=reply,
+                                kind=str(reply.get("kind")))
+            if reply.get("kind") == "deadline_exceeded":
+                return reply, None
+            # unknown kv_ref (stage expired/evicted), artifact
+            # mismatch, transient failure: the stage is single-homed,
+            # so every path falls back to the unified tier.
+            return None, RoutingError(
+                f"pushed decode on {daddr} failed: "
+                f"{reply.get('error')}")
+        self._trace_attempt("decode", datt0, daddr, "ok", reply=reply)
+        self._breaker_ok(daddr, tm, dprobe)
+        self.metrics.inc("disagg_decodes")
+        return reply, None
 
     def close(self) -> None:
         with self._lock:

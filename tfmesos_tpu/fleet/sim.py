@@ -74,6 +74,7 @@ from tfmesos_tpu.fleet.catalog import (POOL, ModelCatalog, ModelSpec,
                                        model_key, split_key)
 from tfmesos_tpu.fleet.client import CallTimeout, ConnectionLost
 from tfmesos_tpu.fleet.containment import BreakerConfig, RetryBudget
+from tfmesos_tpu.fleet.kvtier import rendezvous_order
 from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED, WARMING,
                                         ReplicaRegistry)
@@ -466,10 +467,11 @@ class SimTransport:
         # sessions, and a replica death does not lose it), mapping
         # session id -> (covered tokens, weights_version).  A resume
         # only counts when the versions match — the rollout fence.
-        self.session_tier: Dict[str, Tuple[int, str, str]] = {}
+        self.session_tier: Dict[str, Tuple[int, str, Any]] = {}
         self.session_stats = {"hits": 0, "misses": 0, "park": 0,
                               "resume": 0, "version_miss": 0,
                               "cross_host_miss": 0,
+                              "host_loss_miss": 0, "forwarded": 0,
                               "ttft_hit_ms": 0.0, "ttft_cold_ms": 0.0}
         # Cross-host placement knob (gang-parked sharded sessions):
         # the probability a parked artifact resumes on a replica OTHER
@@ -478,6 +480,17 @@ class SimTransport:
         # gang artifacts live host-local and a cross-host landing
         # re-prefills cold.
         self.cross_host_resume = 1.0
+        # Cross-host KV fabric placement (docs/SERVING.md "Cross-host
+        # KV fabric"): 0 keeps the host-shared tier above (a kill
+        # loses nothing), K >= 1 switches to per-host tiers with
+        # K-way rendezvous-placed parking — an artifact lives on
+        # exactly K copy hosts (the real fabric's placement function,
+        # so the sim prices the same copy sets the fleet would pick),
+        # a resume landing off every copy host forwards the bytes for
+        # ``kv_forward_ms`` of TTFT, and a kill loses only sessions
+        # whose EVERY copy host died (``host_loss_miss``).
+        self.kv_replication = 0
+        self.kv_forward_ms = 2.0
 
     def link(self, addr: str) -> _SimLink:
         rep = self.replicas.get(addr)
@@ -557,13 +570,27 @@ class SimTransport:
         sid = msg.get("session")
         sid = sid if isinstance(sid, str) and sid else None
         session_hit = False
+        session_forward = False
         eff_prompt = prompt_len
         if sid is not None and op == "generate":
             st = self.session_stats
             ent = self.session_tier.get(sid)
             if ent is not None and 0 < ent[0] < prompt_len:
-                parker = ent[2] if len(ent) > 2 else ""
-                if parker and parker != rep.addr \
+                holders = ent[2] if len(ent) > 2 else ()
+                if isinstance(holders, str):
+                    holders = (holders,) if holders else ()
+                alive = tuple(
+                    a for a in holders
+                    if a in self.replicas and not self.replicas[a].down
+                    and not self.replicas[a].removed)
+                if self.kv_replication >= 1 and not alive:
+                    # Fabric placement model: every copy host died
+                    # with the artifact — K-way parking was the only
+                    # defense, and K was too small.
+                    st["host_loss_miss"] += 1
+                    st["misses"] += 1
+                elif self.kv_replication < 1 and holders \
+                        and rep.addr not in holders \
                         and self.cross_host_resume < 1.0 \
                         and rng.random() >= self.cross_host_resume:
                     # Landed off the parker's host and the artifact
@@ -572,15 +599,25 @@ class SimTransport:
                     st["misses"] += 1
                 elif ent[1] == rep.weights_version:
                     session_hit = True
+                    session_forward = (self.kv_replication >= 1
+                                       and rep.addr not in alive)
                     eff_prompt = prompt_len - ent[0]
                     st["hits"] += 1
                     st["resume"] += 1
+                    if session_forward:
+                        st["forwarded"] += 1
                 else:
                     st["version_miss"] += 1
                     st["misses"] += 1
             else:
                 st["misses"] += 1
         ttft_s, total_s = rep.model.service_s(eff_prompt, new_tokens, rng)
+        if session_forward:
+            # The artifact streams over from a surviving copy host
+            # before the tail prefill: a wire cost, not a recompute.
+            fwd = self.kv_forward_ms / 1000.0
+            ttft_s += fwd
+            total_s += fwd
         resumed = msg.get("resumed_tokens")
         if op == "prefill":
             total_s = ttft_s            # prefill tier: no decode tail
@@ -631,9 +668,18 @@ class SimTransport:
                     # Park the finished conversation's coverage (the
                     # last emitted token is the next turn's tail
                     # input, like the real artifact's history).
+                    holders: Any = rep.addr
+                    if self.kv_replication >= 1:
+                        peers = [a for a, h in sorted(
+                                     self.replicas.items())
+                                 if not h.down and not h.removed
+                                 and a != rep.addr]
+                        holders = (rep.addr,) + tuple(
+                            rendezvous_order(sid, peers)
+                            [:max(0, self.kv_replication - 1)])
                     self.session_tier[sid] = (
                         prompt_len + new_tokens - 1,
-                        rep.weights_version, rep.addr)
+                        rep.weights_version, holders)
                     st = self.session_stats
                     st["park"] += 1
                     st["ttft_hit_ms" if session_hit
@@ -717,6 +763,14 @@ class SimConfig:
     # scenario's gang-parked-shard knob; sweep ``cross_host_resume=
     # 1.0,0.5,0.0``).  1.0 = the host-shared tier, exactly.
     cross_host_resume: float = 1.0
+    # Cross-host KV fabric placement policy (the sessions scenario;
+    # sweep ``kv_replication=1,2,3`` and ``kv_forward_ms`` to tune the
+    # replication factor and forwarding constant on the virtual
+    # clock): 0 = the host-shared disk tier above, exactly.  K >= 1
+    # switches to per-host tiers with K-way rendezvous-placed parking
+    # — a kill loses only sessions whose every copy host died.
+    kv_replication: int = 0
+    kv_forward_ms: float = 2.0
     workers: int = 8
     max_queue: int = DEFAULT_MAX_QUEUE
     rate_limit: Optional[float] = None
@@ -1966,6 +2020,8 @@ def scenario_sessions(overrides=(), n_requests: Optional[int] = None,
     # below 1.0, a resume landing off the parker's host re-prefills
     # cold — sweep it to price host-local vs shared artifact stores.
     sim.transport.cross_host_resume = float(cfg.cross_host_resume)
+    sim.transport.kv_replication = int(cfg.kv_replication)
+    sim.transport.kv_forward_ms = float(cfg.kv_forward_ms)
     reps = [sim.add_replica(UNIFIED) for _ in range(cfg.replicas)]
     if workload is None:
         n_sessions = int(sessions) if sessions is not None else (
@@ -2000,6 +2056,7 @@ def scenario_sessions(overrides=(), n_requests: Optional[int] = None,
         "cold_ttft_mean_ms": round(
             st["ttft_cold_ms"] / max(1, st["park"] - st["resume"]), 3),
         "cross_host_resume": cfg.cross_host_resume,
+        "kv_replication": cfg.kv_replication,
     })
     sim.stop()
     return out

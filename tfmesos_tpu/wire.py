@@ -52,6 +52,7 @@ import selectors
 import socket
 import struct
 import threading
+import weakref
 from typing import Any, Callable, List, Optional
 
 _LEN = struct.Struct(">I")
@@ -109,6 +110,34 @@ def set_chaos(send=None, recv=None) -> None:
     global _chaos_send, _chaos_recv
     _chaos_send = send
     _chaos_recv = recv
+
+
+# Socket identity tags (chaos ``partition`` faults): a dialer that acts
+# on behalf of a named endpoint (a replica's fabric RPC, a direct KV
+# push) tags its socket with that endpoint's ADVERTISED addr, so the
+# chaos hooks can match "frames between peers A and B" without the
+# ephemeral local port lying about who is talking.  ``socket.socket``
+# is slotted (no arbitrary attributes), hence the side table; weak keys
+# let closed sockets vanish without bookkeeping.
+_sock_idents: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def tag_socket(sock, ident: str) -> None:
+    """Record that ``sock`` speaks for the endpoint ``ident``
+    (``host:port``).  Best-effort: untaggable objects (test doubles
+    without weakref support) are ignored."""
+    try:
+        _sock_idents[sock] = str(ident)
+    except TypeError:
+        pass
+
+
+def sock_ident(sock) -> Optional[str]:
+    """The advertised endpoint ``sock`` was tagged with, or None."""
+    try:
+        return _sock_idents.get(sock)
+    except TypeError:
+        return None
 
 
 def new_token() -> str:
