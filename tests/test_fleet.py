@@ -91,6 +91,34 @@ def test_metrics_snapshot_and_report_line():
     assert "admitted=3" in line and "queue_depth=7" in line
 
 
+def test_metrics_http_server_port_in_use_falls_back():
+    """With N gateway processes on one host only the first wins a fixed
+    --metrics-port; the rest fall back to an OS-assigned port and
+    REPORT it (the metrics_http_port gauge) instead of dying unscraped."""
+    import json
+    import urllib.request
+
+    m1, m2 = FleetMetrics(), FleetMetrics()
+    s1 = m1.start_http_server(0)
+    s2 = None
+    try:
+        taken = s1.server_address[1]
+        assert m1.snapshot()["gauges"]["metrics_http_port"] == taken
+        s2 = m2.start_http_server(taken)    # in use: must not raise
+        bound = s2.server_address[1]
+        assert bound not in (0, taken)
+        assert m2.snapshot()["gauges"]["metrics_http_port"] == bound
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{bound}/metrics.json",
+                timeout=5.0) as resp:
+            snap = json.loads(resp.read())
+        assert snap["gauges"]["metrics_http_port"] == bound
+    finally:
+        s1.shutdown()
+        if s2 is not None:
+            s2.shutdown()
+
+
 # -- registry ---------------------------------------------------------------
 
 
@@ -1998,6 +2026,85 @@ def test_client_all_gateways_dead_fails_explicitly(stub_fleet):
     finally:
         client.close()
         router.close()
+
+
+def test_gateway_processes_discovery_and_sigkill_failover(stub_fleet):
+    """Tentpole acceptance at the OS-PROCESS level: two real gateway
+    processes (``python -m tfmesos_tpu.fleet.gateway``) lease into the
+    shared registry (one lease PER PROCESS, keyed by each process's
+    private scrape addr), the client discovers both public doors, and
+    a SIGKILL of the serving process mid-stream replays the in-flight
+    request on the survivor — one completion, tokens exactly-once."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    token, reg, servers = stub_fleet
+    servers.append(_stub_streaming_replica(
+        token, reg.addr, chunks=[(5,), (6,)], tokens=(5, 6),
+        delay=0.25))
+    assert reg.wait_for(1, timeout=5.0)
+    env = dict(os.environ, TPUMESOS_TOKEN=token)
+    env.pop("TPUMESOS_TOKEN_FILE", None)
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-m", "tfmesos_tpu.fleet.gateway",
+             "--registry", reg.addr, "--host", "127.0.0.1",
+             "--port", "0", "--workers", "2"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    procs = []
+    try:
+        # Spawn one at a time so the public-addr -> pid mapping is
+        # known (the deterministic-kill handle below).
+        procs.append(spawn())
+        assert _wait(lambda: len(reg.gateway_addrs()) == 1,
+                     timeout=30.0), "first gateway never leased"
+        addr_a = reg.gateway_addrs()[0]
+        procs.append(spawn())
+        assert _wait(lambda: len(reg.gateway_addrs()) == 2,
+                     timeout=30.0), "second gateway never leased"
+        addrs = reg.gateway_addrs()
+        addr_b = next(a for a in addrs if a != addr_a)
+        assert len(reg.gateway_leases()) == 2   # one lease per process
+        client = FleetClient([addr_a, addr_b], token)
+        # The answering process serves `gateways` from its SIDECAR's
+        # mirrored view — give its poll loop a beat to converge.
+        assert _wait(lambda: sorted(client.gateways()) == sorted(addrs),
+                     timeout=30.0), client.gateways()
+        res: dict = {"toks": []}
+
+        def call():
+            try:
+                res["out"] = client.generate(
+                    [3], max_new_tokens=2, timeout=60.0,
+                    on_tokens=lambda t: res["toks"].extend(t))
+            except Exception as e:
+                res["err"] = e
+
+        t = threading.Thread(target=call)
+        t.start()
+        assert _wait(lambda: bool(res["toks"]) or "out" in res,
+                     timeout=30.0)       # request is mid-stream now
+        os.kill(procs[0].pid, signal.SIGKILL)   # the serving process
+        t.join(timeout=60.0)
+        assert "err" not in res, res.get("err")
+        assert res["out"]["tokens"] == [5, 6]
+        assert res["toks"] == [5, 6], \
+            f"process kill duplicated/lost streamed tokens: " \
+            f"{res['toks']}"
+        assert client.addr == addr_b    # moved to the survivor process
+        client.close()
+    finally:
+        for p in procs:
+            p.terminate()
+            try:
+                p.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 @pytest.mark.filterwarnings(

@@ -1,0 +1,426 @@
+"""HTTP/1.1 + SSE ingress (tfmesos_tpu/fleet/http.py): jax-free.
+
+Two layers.  The PARSE layer runs :class:`HttpIngress` on a bare
+``WireServer`` in front of a fake gateway (``handle_ingress`` echoes or
+streams canned frames), so the hostile-input battery, the pre-auth byte
+bounds, and the slow-loris sweep are tested without any fleet at all —
+the echo and SSE-stream smokes here are the tox lint-env gate for the
+HTTP edge.  The FLEET layer fronts a real ``Gateway`` over a stub
+streaming replica and asserts the acceptance contracts: the SSE token
+sequence equals the wire stream token-for-token, error kinds map to
+HTTP statuses, and a client that disconnects mid-stream releases the
+replica-side row through the router's one-way cancel."""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from tfmesos_tpu import wire
+from tfmesos_tpu.fleet.admission import AdmissionController
+from tfmesos_tpu.fleet.client import FleetClient
+from tfmesos_tpu.fleet.gateway import Gateway
+from tfmesos_tpu.fleet.http import HttpIngress
+from tfmesos_tpu.fleet.metrics import FleetMetrics
+from tfmesos_tpu.fleet.registry import ReplicaRegistry
+from tfmesos_tpu.fleet.replica import ReplicaServer
+from tfmesos_tpu.fleet.router import Router
+
+TOKEN = "http-test-token"
+
+
+def _wait(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _split_addr(addr):
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+# -- parse layer: HttpIngress over a fake gateway ---------------------------
+
+
+class _EchoGateway:
+    """The ingress's downstream contract, minus the fleet: non-stream
+    requests echo the prompt back as the completion; streamed ones get
+    two partial frames (with stream offsets) before the full list."""
+
+    def handle_ingress(self, reply, msg):
+        toks = list(msg.get("prompt", []))[:int(msg["max_new_tokens"])]
+
+        def work():
+            if msg.get("stream"):
+                mid = len(toks) // 2
+                reply.send({"op": "tokens", "id": msg.get("id"),
+                            "off": 0, "tokens": toks[:mid]})
+                reply.send({"op": "tokens", "id": msg.get("id"),
+                            "off": mid, "tokens": toks[mid:]})
+            reply.send({"op": "completion", "id": msg.get("id"),
+                        "tokens": toks, "ttft_ms": 1.0, "total_ms": 2.0})
+
+        threading.Thread(target=work, daemon=True).start()
+
+
+@pytest.fixture
+def http_edge():
+    """A WireServer carrying ONLY the HTTP ingress (tight byte bounds so
+    the battery can overflow them cheaply), fronting _EchoGateway."""
+    srv = wire.WireServer(lambda conn, msg: None, token=TOKEN,
+                          name="http-test")
+    srv.add_ingress(HttpIngress(_EchoGateway(), max_header=1024,
+                                max_body=2048, header_timeout=0.4,
+                                body_timeout=0.4))
+    srv.start()
+    try:
+        yield _split_addr(srv.ingress_addrs[0])
+    finally:
+        srv.stop()
+
+
+def _http(addr, method, path, body=None, headers=()):
+    conn = http.client.HTTPConnection(*addr, timeout=5.0)
+    try:
+        hdrs = dict(headers)
+        payload = None
+        if body is not None:
+            payload = json.dumps(body).encode()
+            hdrs.setdefault("Content-Type", "application/json")
+        conn.request(method, path, body=payload, headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, resp.getheaders(), resp.read()
+    finally:
+        conn.close()
+
+
+def _sse_events(raw_body):
+    """Parse an SSE byte stream into its decoded `data:` payloads."""
+    events = []
+    for block in raw_body.decode("utf-8").split("\n\n"):
+        for line in block.splitlines():
+            if line.startswith("data: "):
+                data = line[len("data: "):]
+                events.append(data if data == "[DONE]"
+                              else json.loads(data))
+    return events
+
+
+def test_http_echo_smoke(http_edge):
+    """The tox lint-env smoke: healthz answers, and a non-streamed
+    completion round-trips JSON-in/JSON-out through the ingress."""
+    status, _, body = _http(http_edge, "GET", "/healthz")
+    assert status == 200 and json.loads(body) == {"ok": True}
+    status, headers, body = _http(
+        http_edge, "POST", "/v1/completions",
+        body={"prompt": [5, 6, 7], "max_tokens": 8})
+    assert status == 200
+    out = json.loads(body)
+    assert out["object"] == "completion"
+    assert out["tokens"] == [5, 6, 7]
+    assert ("Connection", "close") in headers
+    # A string prompt is the demo-model convention: its UTF-8 bytes.
+    status, _, body = _http(http_edge, "POST", "/v1/completions",
+                            body={"prompt": "hi", "max_tokens": 8})
+    assert status == 200
+    assert json.loads(body)["tokens"] == [104, 105]
+
+
+def test_http_sse_stream_smoke(http_edge):
+    """The tox lint-env smoke: stream=true answers text/event-stream;
+    token frames carry offsets, exactly once, then done + [DONE]."""
+    conn = http.client.HTTPConnection(*http_edge, timeout=5.0)
+    try:
+        conn.request("POST", "/v1/completions",
+                     body=json.dumps({"prompt": [1, 2, 3, 4],
+                                      "max_tokens": 8, "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        events = _sse_events(resp.read())
+    finally:
+        conn.close()
+    assert events[-1] == "[DONE]"
+    done = events[-2]
+    assert done.get("done") is True and done.get("n_tokens") == 4
+    streamed = []
+    for ev in events[:-2]:
+        assert ev["off"] == len(streamed), ev
+        streamed.extend(ev["tokens"])
+    assert streamed == [1, 2, 3, 4]
+    assert len(events) >= 4      # at least two partial frames
+
+
+HOSTILE = [
+    # (raw request bytes, expected status) — every line a distinct way
+    # a hostile or broken peer can hold the head/body contract wrong.
+    (b"GARBAGE\r\n\r\n", 400),
+    (b"GET /healthz HTTP/2.0\r\n\r\n", 400),
+    (b"GET /healthz HTTP/1.1\r\nBad Header: x\r\n\r\n", 400),
+    (b"GET /healthz HTTP/1.1\r\nnocolon\r\n\r\n", 400),
+    (b"GET /nope HTTP/1.1\r\n\r\n", 404),
+    (b"GET /v1/completions HTTP/1.1\r\n\r\n", 405),
+    (b"POST /v1/completions HTTP/1.1\r\n\r\n", 411),
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: abc\r\n\r\n",
+     400),
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: 0\r\n\r\n",
+     400),
+    (b"POST /v1/completions HTTP/1.1\r\n"
+     b"Transfer-Encoding: chunked\r\nContent-Length: 2\r\n\r\n", 400),
+    # Declared size over the pre-auth bound: rejected BEFORE any body
+    # byte is sent (the fixture's max_body is 2048).
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: 4096\r\n\r\n",
+     413),
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: 2\r\n\r\n"
+     b"not-json-and-longer", 400),
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: 8\r\n\r\n"
+     b"not json", 400),
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: 4\r\n\r\nnull",
+     400),
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: 14\r\n\r\n"
+     b'{"prompt": []}', 400),
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: 17\r\n\r\n"
+     b'{"prompt": ["x"]}', 400),
+    (b"POST /v1/completions HTTP/1.1\r\nContent-Length: 34\r\n\r\n"
+     b'{"prompt": [1], "max_tokens": -1}\n', 400),
+]
+
+
+def test_http_hostile_input_battery(http_edge):
+    """Every malformed/hostile request gets its explicit status and the
+    connection closes — never a hang, never a buffered oversize."""
+    for raw, want in HOSTILE:
+        with socket.create_connection(http_edge, timeout=5.0) as s:
+            s.sendall(raw)
+            s.settimeout(5.0)
+            buf = b""
+            while b"\r\n" not in buf:
+                chunk = s.recv(4096)
+                assert chunk, f"closed without a status for {raw[:40]!r}"
+                buf += chunk
+            status = int(buf.split(b" ", 2)[1])
+            assert status == want, \
+                f"{raw[:60]!r}: got {status}, wanted {want}"
+            # Drain to EOF: one request per connection, always closed.
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+
+
+def test_http_header_overflow_431(http_edge):
+    """A request head past max_header (1 KiB here) is rejected while
+    still incomplete — the pre-auth bound on buffered header bytes."""
+    with socket.create_connection(http_edge, timeout=5.0) as s:
+        s.sendall(b"GET /healthz HTTP/1.1\r\nX-Pad: " + b"a" * 2048)
+        s.settimeout(5.0)
+        buf = b""
+        while b"\r\n" not in buf:
+            chunk = s.recv(4096)
+            assert chunk, "closed without a 431 status line"
+            buf += chunk
+        assert buf.split(b" ", 2)[1] == b"431"
+
+
+def test_http_slow_loris_swept(http_edge):
+    """A peer that trickles its head or its body is closed by the event
+    loop's deadline sweep (0.4s in this fixture), not held forever."""
+    # Stalled mid-head: no complete request line ever arrives.
+    with socket.create_connection(http_edge, timeout=5.0) as s:
+        s.sendall(b"POST /v1/comp")      # ...and never finishes
+        s.settimeout(5.0)
+        t0 = time.monotonic()
+        assert s.recv(4096) == b"", "loris head was not swept"
+        assert time.monotonic() - t0 < 4.0
+    # Stalled mid-body: head complete, Content-Length never satisfied.
+    with socket.create_connection(http_edge, timeout=5.0) as s:
+        s.sendall(b"POST /v1/completions HTTP/1.1\r\n"
+                  b"Content-Length: 64\r\n\r\n{")
+        s.settimeout(5.0)
+        t0 = time.monotonic()
+        assert s.recv(4096) == b"", "loris body was not swept"
+        assert time.monotonic() - t0 < 4.0
+
+
+# -- fleet layer: HttpIngress on a real Gateway -----------------------------
+
+
+def _stub_streaming_replica(registry_addr, chunks, tokens, delay=0.05,
+                            cancels=None):
+    """Streams `chunks` as op:tokens partials `delay` apart, then the
+    full-list completion; a router ``cancel`` op is recorded in
+    `cancels` and — like the real batcher expiring the row — answers
+    the in-flight request with its final deadline_exceeded error and
+    stops streaming (the released-row observable)."""
+    cancelled = threading.Event()
+    inflight = {}
+
+    def handler(msg, reply):
+        if msg.get("op") == "cancel":
+            if cancels is not None:
+                cancels.append(msg)
+            cancelled.set()
+            fin = inflight.pop(msg.get("target"), None)
+            if fin is not None:
+                fin({"op": "error", "id": msg.get("target"),
+                     "kind": "deadline_exceeded",
+                     "error": "row released after client disconnect"})
+            reply({"op": "cancelled", "id": msg.get("id"),
+                   "found": fin is not None})
+            return
+
+        def work():
+            mid = msg.get("id")
+            off = 0
+            if msg.get("stream"):
+                inflight[mid] = reply
+                for c in chunks:
+                    if cancelled.is_set():
+                        return      # row released: decode stops here
+                    reply.partial({"op": "tokens", "id": mid,
+                                   "off": off, "tokens": list(c)})
+                    off += len(c)
+                    time.sleep(delay)
+                inflight.pop(mid, None)
+            else:
+                time.sleep(delay)
+            reply({"op": "completion", "id": mid,
+                   "tokens": list(tokens), "ttft_ms": 1.0,
+                   "total_ms": 2.0})
+
+        threading.Thread(target=work, daemon=True).start()
+
+    return ReplicaServer(handler, token=TOKEN, capacity=8,
+                         registry_addr=registry_addr,
+                         heartbeat_interval=0.05).start()
+
+
+@pytest.fixture
+def http_fleet():
+    """A real Gateway (http_port=0) over one stub streaming replica."""
+    reg = ReplicaRegistry(token=TOKEN, suspect_after=1.0,
+                          dead_after=2.0, evict_after=10.0).start()
+    cancels = []
+    rep = _stub_streaming_replica(
+        reg.addr, chunks=[(7,), (8,), (9,)], tokens=(7, 8, 9),
+        delay=0.15, cancels=cancels)
+    assert reg.wait_for(1, timeout=5.0)
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=TOKEN)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=TOKEN, workers=2, registry=reg,
+                 close_router=False, http_port=0).start()
+    try:
+        yield gw, metrics, cancels
+    finally:
+        gw.stop()
+        router.close()
+        rep.stop()
+        reg.stop()
+
+
+def test_http_completion_matches_wire(http_fleet):
+    """The adapter is a gateway client, not a second front door: the
+    HTTP completion equals the wire client's, and both paths meter."""
+    gw, metrics, _ = http_fleet
+    client = FleetClient(gw.addr, TOKEN)
+    try:
+        want = client.generate([1, 2], max_new_tokens=4,
+                               timeout=10.0)["tokens"]
+    finally:
+        client.close()
+    status, _, body = _http(_split_addr(gw.http_addr), "POST",
+                            "/v1/completions",
+                            body={"prompt": [1, 2], "max_tokens": 4})
+    assert status == 200
+    assert json.loads(body)["tokens"] == want == [7, 8, 9]
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("http_requests", 0) >= 1
+    assert snap.get("completed", 0) >= 2
+
+
+def test_http_sse_equals_wire_stream(http_fleet):
+    """Acceptance: the SSE event sequence for a streamed completion
+    carries the same tokens, in order, as the wire stream."""
+    gw, _, _ = http_fleet
+    wire_toks = []
+    client = FleetClient(gw.addr, TOKEN)
+    try:
+        out = client.generate([1], max_new_tokens=4, timeout=10.0,
+                              on_tokens=lambda t: wire_toks.extend(t))
+    finally:
+        client.close()
+    assert wire_toks == out["tokens"] == [7, 8, 9]
+    conn = http.client.HTTPConnection(*_split_addr(gw.http_addr),
+                                      timeout=10.0)
+    try:
+        conn.request("POST", "/v1/completions",
+                     body=json.dumps({"prompt": [1], "max_tokens": 4,
+                                      "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        events = _sse_events(resp.read())
+    finally:
+        conn.close()
+    assert events[-1] == "[DONE]"
+    sse_toks = [t for ev in events[:-2] for t in ev["tokens"]]
+    assert sse_toks == wire_toks, \
+        f"SSE stream diverged from wire stream: {sse_toks}"
+
+
+def test_http_error_kind_maps_to_status():
+    """A routed error surfaces as its HTTP status: a fleet with no
+    replica at all answers 503 (kind: unavailable), trace id intact."""
+    reg = ReplicaRegistry(token=TOKEN).start()
+    metrics = FleetMetrics()
+    router = Router(reg, metrics, token=TOKEN, max_retries=0)
+    gw = Gateway(router, AdmissionController(max_queue=8), metrics,
+                 token=TOKEN, workers=1, registry=reg,
+                 close_router=False, http_port=0).start()
+    try:
+        status, _, body = _http(_split_addr(gw.http_addr), "POST",
+                                "/v1/completions",
+                                body={"prompt": [1], "max_tokens": 2})
+        assert status == 503
+        err = json.loads(body)["error"]
+        assert err["type"] == "unavailable"
+        assert err.get("trace_id")
+    finally:
+        gw.stop()
+        router.close()
+        reg.stop()
+
+
+def test_http_sse_disconnect_releases_row(http_fleet):
+    """Acceptance: an SSE client that walks away mid-stream releases
+    the replica-side row — the router's disconnect probe fires the
+    one-way cancel, and the replica stops streaming."""
+    gw, _, cancels = http_fleet
+    with socket.create_connection(_split_addr(gw.http_addr),
+                                  timeout=5.0) as s:
+        body = json.dumps({"prompt": [1], "max_tokens": 4,
+                           "stream": True}).encode()
+        s.sendall(b"POST /v1/completions HTTP/1.1\r\n"
+                  b"Content-Type: application/json\r\n"
+                  + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                  + body)
+        s.settimeout(5.0)
+        buf = b""
+        while b"data: " not in buf:     # first token frame is out
+            chunk = s.recv(4096)
+            assert chunk, f"stream closed early: {buf!r}"
+            buf += chunk
+        # Walk away mid-stream.
+    assert _wait(lambda: len(cancels) >= 1, timeout=5.0), \
+        "client disconnect never cancelled the replica-side row"
+    assert cancels[0].get("op") == "cancel"

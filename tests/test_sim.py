@@ -709,3 +709,170 @@ def test_gang_sweep_and_cross_host_knob(sleep_trap):
     assert lossy["session_tier"]["cross_host_miss"] > 0
     assert lossy["kv_tier_hit_rate"] < full["kv_tier_hit_rate"]
     assert lossy["lost"] == 0
+
+
+# -- diurnal workload + 10k-scale scenario ----------------------------------
+
+
+def test_diurnal_workload_deterministic_and_shaped():
+    """Same seed -> byte-identical arrival stream; the sinusoidal
+    envelope actually shapes it (the peak half-period carries more
+    arrivals than the trough half); bursts densify their windows."""
+    from tfmesos_tpu.fleet.workload import DiurnalWorkload
+
+    def draw():
+        return list(DiurnalWorkload(
+            2000, base_rate=50.0, seed=11, period_s=200.0,
+            peak_ratio=4.0, phase=0.0, bursts=2, burst_ratio=3.0,
+            burst_duration_s=5.0,
+            class_mix={"interactive": 3.0, "background": 1.0}))
+
+    a, b = draw(), draw()
+    assert [(r.at, r.cls, r.prompt_len, r.new_tokens) for r in a] \
+        == [(r.at, r.cls, r.prompt_len, r.new_tokens) for r in b]
+    assert all(a[i].at <= a[i + 1].at for i in range(len(a) - 1))
+    assert {r.cls for r in a} == {"interactive", "background"}
+    n_int = sum(1 for r in a if r.cls == "interactive")
+    assert 0.6 < n_int / len(a) < 0.9       # ~3:1 mix
+    # envelope(t) peaks over [0, period/2) with phase 0 and troughs
+    # over [period/2, period): the first full period must be lopsided.
+    wl = DiurnalWorkload(4000, base_rate=50.0, seed=3, period_s=100.0,
+                         peak_ratio=8.0, phase=0.0)
+    arr = [r.at for r in wl]
+    peak_half = sum(1 for t in arr if t % 100.0 < 50.0)
+    trough_half = sum(1 for t in arr if t % 100.0 >= 50.0)
+    assert peak_half > 1.5 * trough_half, (peak_half, trough_half)
+
+
+def test_diurnal_workload_burst_majorant_exact():
+    """The piecewise-constant thinning majorant is EXACT: the realized
+    in-burst arrival rate tracks burst_ratio x the out-of-burst rate
+    (a leaky bound here would under-sample bursts), and rate_at
+    agrees with the declared envelope algebra."""
+    from tfmesos_tpu.fleet.workload import DiurnalWorkload
+
+    wl = DiurnalWorkload(20000, base_rate=100.0, seed=5,
+                         period_s=1e9,      # flat envelope: sin ~ 0
+                         peak_ratio=1.0, bursts=3, burst_ratio=5.0,
+                         burst_duration_s=10.0)
+    rng = random.Random(5)
+    windows = wl._burst_windows(rng, 20000 / 100.0)
+    assert wl.rate_at(windows[0][0], windows) == \
+        pytest.approx(5.0 * wl.rate_at(windows[0][1] + 1e-6, windows),
+                      rel=1e-6)
+    arr = [r.at for r in wl]
+    span = arr[-1]
+    in_w = sum(1 for t in arr
+               if any(lo <= t < hi for lo, hi in windows))
+    w_len = sum(min(hi, span) - min(lo, span) for lo, hi in windows)
+    out_rate = (len(arr) - in_w) / max(1e-9, span - w_len)
+    in_rate = in_w / max(1e-9, w_len)
+    assert 3.5 < in_rate / out_rate < 6.5, (in_rate, out_rate)
+
+
+def test_fit_diurnal_recovers_envelope():
+    """fit_diurnal round-trips a synthetic diurnal trace: the fitted
+    peak_ratio and phase land near the generating constants."""
+    from tfmesos_tpu.fleet.workload import DiurnalWorkload, fit_diurnal
+
+    # base 40/s, mean envelope 2.5x -> ~100/s: 20k arrivals span
+    # ~200s, i.e. one full cycle (what the fitter assumes it caught).
+    wl = DiurnalWorkload(20000, base_rate=40.0, seed=9,
+                         period_s=200.0, peak_ratio=4.0, phase=0.0)
+    records = [{"ts": r.at} for r in wl]
+    # The export caught one full cycle; tell the fitter the period.
+    fit = fit_diurnal(records, period_s=200.0)
+    assert fit["period_s"] == 200.0
+    assert 2.0 < fit["peak_ratio"] < 8.0
+    # phase 0 peaks at t = period/4 = 50; the fitted phase must put
+    # the crest within a bin or two of that.
+    import math
+    crest = (math.pi / 2 - fit["phase"]) * 200.0 / (2 * math.pi)
+    assert abs(crest % 200.0 - 50.0) < 20.0, fit
+    assert fit_diurnal([]) == {}
+    assert fit_diurnal([{"ts": 1.0}]) == {}
+
+
+def test_hb_shards_same_outcome_as_per_replica_beats(sleep_trap):
+    """Sharded heartbeats are an EVENT-COUNT optimization, not a
+    behavior change: same completions, zero lost, and a replica that
+    stops beating inside a shard still goes dead and gets evicted."""
+    plain = run_scenario("steady", n_requests=400, replicas=4, seed=21)
+    sharded = run_scenario("steady", [("hb_shards", "2")],
+                           n_requests=400, replicas=4, seed=21)
+    assert sharded["lost"] == 0
+    assert sharded["completed"] == plain["completed"] == 400
+    # Liveness detection through a shard: a silenced member is marked
+    # dead by the same suspect/dead sweep cadence.
+    cfg = SimConfig(replicas=3, seed=4, workers=2, hb_shards=2)
+    sim = FleetSim(cfg)
+    reps = [sim.add_replica(UNIFIED) for _ in range(3)]
+    sim.start_workers()
+    sim.feed([Request(at=0.01 * i, cls=None, prompt_len=8,
+                      new_tokens=4) for i in range(30)])
+    sim.engine.at(0.2, lambda: sim.kill(reps[0]))
+    sim.engine.run(stop=sim.drained)
+    assert sim.lost == []
+    assert sim.completed == 30
+    dead = [r for r in sim.registry.members()
+            if r.addr == reps[0].addr]
+    assert not dead or dead[0].state == "dead"
+    sim.stop()
+
+
+def test_sim_kv_placement_loaded_diverts_from_hot_tiers(sleep_trap):
+    """The placement=loaded knob mirrors KVFabric._order's occupancy
+    buckets: on a balanced fleet it matches rendezvous exactly (stable
+    sort on equal buckets), and under skew it diverts the peer copy
+    off the loaded tier rendezvous would have picked."""
+    cfg = SimConfig(replicas=5, seed=6, workers=2, kv_replication=2)
+    sim = FleetSim(cfg)
+    reps = [sim.add_replica(UNIFIED) for _ in range(5)]
+    tr = sim.transport
+    tr.kv_replication = 2       # scenarios wire this from cfg
+    sid = "sess-42"
+    balanced = tr._place(sid, reps[0].addr)
+    tr.kv_placement = "loaded"
+    assert tr._place(sid, reps[0].addr) == balanced, \
+        "loaded placement must equal rendezvous on a balanced fleet"
+    # Skew: rendezvous's pick is nearly full, everyone else is empty.
+    tr._tier_load[balanced[1]] = reps[1].kv_pages
+    skewed = tr._place(sid, reps[0].addr)
+    assert skewed[0] == balanced[0] == reps[0].addr   # parker pinned
+    assert skewed[1] != balanced[1], \
+        "a full tier still won the peer copy under placement=loaded"
+    sim.stop()
+
+
+def test_sessions_kv_placement_sweep(sleep_trap):
+    """`--sweep kv_placement=rendezvous,loaded` flows through the
+    sessions scenario: both arms run lossless, record their knob, and
+    publish the copy-occupancy telemetry the sweep compares."""
+    rows = run_sweep("sessions", "kv_placement",
+                     ["rendezvous", "loaded"],
+                     [("kv_replication", "2")],
+                     n_requests=300, replicas=3, turns=3, seed=8)
+    assert len(rows) == 2
+    for val, res in rows:
+        assert res["lost"] == 0
+        assert res["kv_placement"] == val
+        assert res["kv_copy_load_max"] >= res["kv_copy_load_mean"] > 0
+
+
+def test_scenario_diurnal_smoke_deterministic(sleep_trap):
+    """The 10k-replica scenario, scaled down to CI size: a diurnal
+    workload over sharded heartbeats and the slower 10k cadence runs
+    lossless, publishes the floor key, and is deterministic per seed."""
+    out = run_scenario("diurnal", n_requests=600, replicas=40, seed=17)
+    again = run_scenario("diurnal", n_requests=600, replicas=40,
+                         seed=17)
+    assert out["lost"] == 0
+    assert out["completed"] > 0
+    assert out["completed"] == again["completed"]
+    assert out["shed"] == again["shed"]
+    assert out["sim_events_per_sec_10k"] == out["sim_events_per_sec"]
+    assert out["hb_shards"] == 64
+    # The slow 10k cadence holds unless overridden per knob.
+    slow = run_scenario("diurnal", [("hb_interval", "1.0")],
+                        n_requests=200, replicas=10, seed=17)
+    assert slow["lost"] == 0

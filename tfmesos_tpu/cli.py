@@ -225,6 +225,21 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "the set via 'tfserve gateways' and fail over "
                         "between them (docs/SERVING.md 'Front-door "
                         "scaling')")
+    p.add_argument("--gateway-processes", type=int, default=0,
+                   dest="gateway_processes",
+                   help="run N gateway OS PROCESSES instead of "
+                        "in-process gateway threads: they share "
+                        "--gateway-port via SO_REUSEPORT where the "
+                        "platform has it, else take per-process ports "
+                        "behind the 'gateways' discovery op; 0 = "
+                        "in-process (docs/SERVING.md 'Multi-process "
+                        "gateways')")
+    p.add_argument("--http-port", type=int, default=None,
+                   dest="http_port",
+                   help="serve an OpenAI-style HTTP/1.1 edge (POST "
+                        "/v1/completions, stream: true = SSE) next to "
+                        "the wire port; default off (docs/SERVING.md "
+                        "'HTTP/SSE edge')")
     p.add_argument("--rows", type=int, default=8,
                    help="concurrent decode rows per replica")
     p.add_argument("--max-len", type=int, default=None,
@@ -1122,6 +1137,8 @@ def _build_fleet(args, models, roles, classes, token):
         replica_chips=args.replica_chips,
         gateway_host=args.gateway_host, gateway_port=args.gateway_port,
         gateways=args.gateways,
+        gateway_processes=args.gateway_processes,
+        http_port=args.http_port,
         workers=args.workers, max_queue=args.max_queue, rate=args.rate,
         burst=args.burst, max_retries=args.retries,
         priority_classes=classes, migrate_on_drain=args.migrate,
@@ -1181,6 +1198,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         print(f"tfserve: --gateways must be >= 1, got {args.gateways}",
               file=sys.stderr)
         return 2
+    if args.gateway_processes < 0:
+        print(f"tfserve: --gateway-processes must be >= 0, got "
+              f"{args.gateway_processes}", file=sys.stderr)
+        return 2
 
     from tfmesos_tpu.scheduler import ClusterError
 
@@ -1223,8 +1244,15 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     if args.autoscale:
         tiers += (f", autoscaling within [{fleet.min_replicas}, "
                   f"{fleet.max_replicas}]")
-    doors = fleet.addr if args.gateways == 1 else \
-        f"{args.gateways} gateways ({', '.join(fleet.addrs)})"
+    if args.gateway_processes:
+        doors = (f"{args.gateway_processes} gateway process(es) "
+                 f"({', '.join(fleet.addrs)})")
+    elif args.gateways == 1:
+        doors = fleet.addr
+    else:
+        doors = f"{args.gateways} gateways ({', '.join(fleet.addrs)})"
+    if fleet.http_addr:
+        doors += f" + http {fleet.http_addr}"
     print(f"tfserve: gateway on {doors} fronting {tiers}; "
           f"ctrl-c to stop", flush=True)
     try:

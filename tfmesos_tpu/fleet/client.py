@@ -133,6 +133,22 @@ class MuxConnection:
         any other call."""
         return self._call(meta, body, timeout, on_partial)
 
+    def notify(self, msg: Dict[str, Any]) -> bool:
+        """Fire-and-forget one-way send: ``msg`` goes out with ``id`` 0
+        (call ids start at 1, so the peer's reply — if it sends one —
+        matches no slot and the reader drops it).  Used for advisory
+        control traffic like mid-stream ``cancel``: best-effort by
+        design, so send failures report ``False`` instead of raising —
+        a cancel that can't reach a dying peer costs nothing."""
+        out = dict(msg)
+        out["id"] = 0
+        try:
+            with self._send_lock:
+                wire.send_msg(self._sock, out, self._token)
+            return True
+        except (OSError, wire.WireError):
+            return False
+
     def _call(self, msg: Dict[str, Any], raw_body,
               timeout: Optional[float] = None,
               on_partial: Optional[Callable[[Any], None]] = None) -> Any:

@@ -688,15 +688,20 @@ class KVFabric:
                  self_addr: str = "", registry_addr: Optional[str] = None,
                  replication: int = 2, rpc=None, peers=None,
                  clock=time.monotonic, peer_ttl: float = 1.0,
-                 push_timeout: float = 10.0):
+                 push_timeout: float = 10.0,
+                 placement: str = "rendezvous"):
         if replication < 1:
             raise ValueError(f"replication must be >= 1, "
                              f"got {replication}")
+        if placement not in ("rendezvous", "loaded"):
+            raise ValueError(f"placement must be 'rendezvous' or "
+                             f"'loaded', got {placement!r}")
         self.store = store
         self.token = token
         self.self_addr = self_addr
         self.registry_addr = registry_addr
         self.replication = int(replication)
+        self.placement = placement
         self._rpc = rpc or (lambda addr, meta, body=None, timeout=10.0:
                             fabric_rpc(addr, meta, body, token=self.token,
                                        timeout=timeout,
@@ -756,24 +761,52 @@ class KVFabric:
                 out.append(p)
         return out
 
+    def _order(self, key: str, peers: List[Dict[str, Any]]
+               ) -> List[str]:
+        """One eligibility class's candidate order.  Pure rendezvous by
+        default (deterministic hash spread — every fabric node computes
+        the same order, which is what makes locate-free probing work).
+        ``placement='loaded'`` re-scores the SAME rendezvous candidates
+        by their heartbeat-advertised tier occupancy, quantized to
+        coarse buckets so placement only deviates from the hash order
+        when a peer's tier is materially fuller — parks drift away from
+        nearly-full peers without shredding the deterministic probe
+        order that fetch-on-miss relies on."""
+        ranked = rendezvous_order(key, [p["addr"] for p in peers])
+        if self.placement != "loaded":
+            return ranked
+        occ: Dict[str, Any] = {p["addr"]: p.get("occupancy")
+                               for p in peers}
+
+        def bucket(addr: str) -> int:
+            o = occ.get(addr)
+            if not isinstance(o, (int, float)) or o != o or o < 0:
+                return 0    # unknown load reads as empty, not as full
+            return min(int(float(o) * 4.0), 4)
+
+        rank = {a: i for i, a in enumerate(ranked)}
+        return sorted(ranked, key=lambda a: (bucket(a), rank[a]))
+
     def _replica_targets(self, key: str) -> List[str]:
-        """The rendezvous-ordered peer addrs eligible to hold a copy of
-        ``key``: dedicated KV-role peers first (they exist to hold
-        state), then same-weights_version peers (any other version
-        would fence the copy on its own reads), unstamped peers last."""
+        """The ordered peer addrs eligible to hold a copy of ``key``:
+        dedicated KV-role peers first (they exist to hold state), then
+        same-weights_version peers (any other version would fence the
+        copy on its own reads), unstamped peers last — each class
+        ordered by :meth:`_order` (rendezvous, optionally
+        load-scored)."""
         wv = self.store.stamp.get("weights_version")
         kv_role, same, rest = [], [], []
         for p in self.peers():
             pwv = p.get("weights_version")
             if p.get("role") == "kv":
-                kv_role.append(p["addr"])
+                kv_role.append(p)
             elif not wv or not pwv or str(pwv) == str(wv):
-                same.append(p["addr"])
+                same.append(p)
             else:
-                rest.append(p["addr"])
-        return (rendezvous_order(key, kv_role)
-                + rendezvous_order(key, same)
-                + rendezvous_order(key, rest))
+                rest.append(p)
+        return (self._order(key, kv_role)
+                + self._order(key, same)
+                + self._order(key, rest))
 
     # -- replicated park ---------------------------------------------------
 
@@ -836,6 +869,24 @@ class KVFabric:
         if got is not None:
             return got
         return self.fetch("session", session_id)
+
+    def get_prefix(self, digest_hex: str
+                   ) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        """Prefix fetch-through: a spilled prefix page missing from the
+        LOCAL tier rides the same locate/fetch surface sessions use, so
+        a shared system prompt prefilled once per fleet survives its
+        host dying — any replica that spilled (or fabric-received) the
+        page serves it, and the fetched copy installs locally for the
+        next hit.  Content-addressed by chain digest, so a copy from
+        ANY holder is the right bytes; the weights_version fence still
+        applies on the local re-read."""
+        got = self.store.get_prefix(digest_hex)
+        if got is not None:
+            return got
+        got = self.fetch("prefix", digest_hex)
+        if got is not None:
+            self.store.count("fabric_prefix_fetches")
+        return got
 
     def fetch(self, kind: str, key: str
               ) -> Optional[Tuple[Dict[str, Any], bytes]]:

@@ -30,7 +30,9 @@ with the autoscaler on — relaunches them from the convergence loop.
 
 from __future__ import annotations
 
+import os
 import re
+import subprocess
 import sys
 import threading
 import time
@@ -140,6 +142,8 @@ class FleetServer:
                  replica_chips: int = 0,
                  gateway_host: str = "127.0.0.1", gateway_port: int = 0,
                  gateways: int = 1,
+                 gateway_processes: int = 0,
+                 http_port: Optional[int] = None,
                  workers: int = 8, max_queue: int = 64,
                  rate: Optional[float] = None,
                  burst: Optional[float] = None,
@@ -346,6 +350,32 @@ class FleetServer:
         if self.n_gateways < 1:
             raise ValueError(
                 f"gateways must be >= 1, got {gateways}")
+        #: multi-PROCESS front door (docs/SERVING.md "Multi-process
+        #: gateways"): > 0 replaces the in-process gateway threads with
+        #: N ``python -m tfmesos_tpu.fleet.gateway`` OS processes, each
+        #: running its own WireServer/admission/router over a registry-
+        #: client sidecar's mirrored view.  They share ONE public port
+        #: via SO_REUSEPORT where the platform has it, else fall back
+        #: to per-process ports behind the ``gateways`` discovery op.
+        #: 0 = in-process mode, the pre-PR behavior exactly.
+        self.gateway_processes = int(gateway_processes)
+        if self.gateway_processes < 0:
+            raise ValueError(
+                f"gateway_processes must be >= 0, got {gateway_processes}")
+        if self.gateway_processes and self.catalog is not None:
+            # The trader answers cold-start demand through the SHARED
+            # in-process router; a subprocess gateway's private router
+            # has no trader to ask, so a catalog fleet would silently
+            # lose scale-from-zero.  Refuse loudly instead.
+            raise ValueError(
+                "gateway_processes and a model catalog are mutually "
+                "exclusive: catalog cold-start demand rides the "
+                "in-process router")
+        #: HTTP/1.1 + SSE ingress (docs/SERVING.md "HTTP/SSE edge"):
+        #: None = off (the pre-PR wire-only surface).  In-process mode
+        #: gives the port to the FIRST gateway; in subprocess mode the
+        #: first gateway process carries it.
+        self.http_port = None if http_port is None else int(http_port)
         self.workers = int(workers)
         self.max_queue = int(max_queue)
         self.rate = rate
@@ -396,6 +426,10 @@ class FleetServer:
         self.gateway: Optional[Gateway] = None
         #: every running front door (``gateway`` is ``gateways[0]``).
         self.gateways: List[Gateway] = []
+        #: gateway OS processes (subprocess mode); empty in-process.
+        self._gateway_procs: list = []
+        #: the HTTP/SSE edge address once bound (either mode).
+        self.http_addr: Optional[str] = None
         self.scheduler: Optional[TPUMesosScheduler] = None
         self.autoscaler: Optional[FleetAutoscaler] = None
         #: per-tier replica targets — what the control plane WANTS; the
@@ -476,6 +510,172 @@ class FleetServer:
             parts.append("--warmup")
         return " ".join(parts)
 
+    def _gateway_cmd(self, port: int, reuseport: bool,
+                     http_port: Optional[int]) -> List[str]:
+        """One gateway process's argv (exec'd directly, never through a
+        shell): the wire listener address plus the same admission/
+        routing constants every in-process gateway gets.  The cluster
+        token rides the environment (``TPUMESOS_TOKEN``), never the
+        command line."""
+        parts = [sys.executable, "-m", "tfmesos_tpu.fleet.gateway",
+                 "--registry", self.registry.addr,
+                 "--host", self.gateway_host,
+                 "--port", str(int(port)),
+                 "--workers", str(self.workers),
+                 "--max-queue", str(self.max_queue),
+                 "--max-retries", str(self.max_retries),
+                 "--request-timeout", str(self.request_timeout)]
+        if reuseport:
+            parts.append("--reuseport")
+        if self.rate is not None:
+            parts += ["--rate", str(self.rate)]
+        if self.burst is not None:
+            parts += ["--burst", str(self.burst)]
+        if http_port is not None:
+            parts += ["--http-port", str(int(http_port)),
+                      "--http-host", self.gateway_host]
+        return parts
+
+    def _start_gateway_procs(self) -> None:
+        """Launch ``gateway_processes`` front-door OS processes.  They
+        share ONE public port via SO_REUSEPORT where the platform has
+        it (the kernel load-balances accepts); elsewhere each takes an
+        OS-assigned port and clients discover the set through the
+        ``gateways`` op.  Either way every process leases a discovery
+        entry in the central registry, which is also how this method
+        knows bring-up finished."""
+        n = self.gateway_processes
+        reuseport = wire.reuseport_available()
+        shared_port = 0
+        if reuseport:
+            shared_port = self.gateway_port
+            if not shared_port:
+                # Pick the shared port up front: bind-with-REUSEPORT,
+                # read, close.  The tiny close-to-spawn window is the
+                # standard ephemeral-port race; a loser fails loudly
+                # at bind and the bring-up wait reports it.
+                probe = wire.bind_ephemeral(self.gateway_host, 0,
+                                            reuseport=True)
+                shared_port = probe.getsockname()[1]
+                probe.close()
+        env = dict(os.environ)
+        env["TPUMESOS_TOKEN"] = self.token
+        env.pop("TPUMESOS_TOKEN_FILE", None)
+        sink = subprocess.DEVNULL if self.quiet else None
+        for i in range(n):
+            if reuseport:
+                port = shared_port
+            else:
+                port = self.gateway_port if i == 0 else 0
+            cmd = self._gateway_cmd(
+                port, reuseport,
+                self.http_port if i == 0 else None)
+            self._gateway_procs.append(subprocess.Popen(
+                cmd, env=env, stdout=sink, stderr=sink))
+        # Every process holds its OWN lease (keyed by its private
+        # scrape addr), so N leases = N processes up even when
+        # SO_REUSEPORT collapses the public discovery set to one addr.
+        deadline = time.monotonic() + min(self.start_timeout, 30.0)
+        while time.monotonic() < deadline:
+            dead = [p for p in self._gateway_procs
+                    if p.poll() is not None]
+            if dead:
+                raise ClusterError(
+                    f"{len(dead)} gateway process(es) died during "
+                    f"bring-up (first exit code "
+                    f"{dead[0].returncode})")
+            if len(self.registry.gateway_leases()) >= n:
+                break
+            time.sleep(0.05)
+        else:
+            raise ClusterError(
+                f"only {len(self.registry.gateway_leases())} of {n} "
+                f"gateway lease(s) registered within the bring-up "
+                f"window")
+        if self.http_port:
+            self.http_addr = f"{self.gateway_host}:{self.http_port}"
+        # Fleet-level scrape: the launcher's own /metrics (and
+        # fleet_snapshot()) fold every gateway process's raw state in
+        # at scrape time.
+        self.metrics.fanin = self._scrape_gateway_raws
+        self.log.info(
+            "%d gateway process(es) up%s", n,
+            f" sharing :{shared_port} via SO_REUSEPORT" if reuseport
+            else " on per-process ports (no SO_REUSEPORT; clients "
+                 "discover via the gateways op)")
+
+    def _wait_gateway_mirrors(self, timeout: float = 15.0) -> None:
+        """Block until every gateway process's sidecar mirror can route
+        to as many alive replicas as the central registry lists RIGHT
+        NOW — without this, a client's first request races the mirror's
+        poll cadence and sheds with "no alive replicas" on a fleet
+        that is, in fact, up."""
+        want = len(self.registry.alive())
+        if not want:
+            return
+        pending = set(self.registry.gateway_leases())
+        deadline = time.monotonic() + timeout
+        while pending and time.monotonic() < deadline:
+            for addr in sorted(pending):
+                try:
+                    sock = wire.connect(addr, timeout=2.0)
+                    try:
+                        sock.settimeout(2.0)
+                        wire.send_msg(sock, {"op": "status"}, self.token)
+                        reply = wire.recv_msg(sock, self.token)
+                    finally:
+                        sock.close()
+                except (OSError, wire.WireError):
+                    continue
+                alive = reply.get("alive") if isinstance(reply, dict) \
+                    else None
+                if isinstance(alive, int) and alive >= want:
+                    pending.discard(addr)
+            if pending:
+                time.sleep(0.05)
+        if pending:
+            raise ClusterError(
+                f"{len(pending)} gateway process(es) never mirrored "
+                f"the {want} alive replica(s) within {timeout:.0f}s")
+
+    def _scrape_gateway_raws(self) -> List[dict]:
+        """Every gateway process's mergeable metrics state (``metrics``
+        op with ``raw: true`` against each process's PRIVATE scrape
+        listener — the shared REUSEPORT public addr would land on a
+        kernel-chosen process); an unreachable process costs its
+        contribution, never the scrape."""
+        raws: List[dict] = []
+        registry = self.registry
+        if registry is None:
+            return raws
+        for addr in registry.gateway_leases():
+            try:
+                sock = wire.connect(addr, timeout=2.0)
+                try:
+                    sock.settimeout(2.0)
+                    wire.send_msg(sock, {"op": "metrics", "raw": True},
+                                  self.token)
+                    reply = wire.recv_msg(sock, self.token)
+                finally:
+                    sock.close()
+            except (OSError, wire.WireError):
+                continue
+            raw = reply.get("raw") if isinstance(reply, dict) else None
+            if isinstance(raw, dict):
+                raws.append(raw)
+        return raws
+
+    def fleet_snapshot(self) -> dict:
+        """The FLEET-level metrics snapshot: in subprocess-gateway mode
+        this merges every gateway process's counters/histograms into
+        the launcher's own registry at scrape time; otherwise it is
+        :meth:`snapshot` exactly."""
+        if self.metrics is None:
+            return {}
+        if self.metrics.fanin is None:
+            return self.metrics.snapshot()
+        return self.metrics.merged().snapshot()
+
     def start(self) -> "FleetServer":
         self.token = self._token or wire.new_token()
         self.metrics = FleetMetrics()
@@ -515,16 +715,28 @@ class FleetServer:
             # isolation multiplier.  The shared router's lifecycle is
             # the launcher's (close_router=False) — a stopping gateway
             # must not tear down its siblings' replica links.
-            self.gateways = []
-            for i in range(self.n_gateways):
-                gw = Gateway(self.router, self.admission, self.metrics,
-                             token=self.token, host=self.gateway_host,
-                             port=self.gateway_port if i == 0 else 0,
-                             workers=self.workers, registry=self.registry,
-                             tracebook=self.tracebook,
-                             close_router=False).start()
-                self.gateways.append(gw)
-            self.gateway = self.gateways[0]
+            if self.gateway_processes:
+                # Multi-PROCESS front door: N OS processes, each with
+                # its own WireServer loop, admission WFQ, and router
+                # over a registry-sidecar view — the in-process Gateway
+                # objects (and their shared-object wiring: rollout_fn,
+                # catalog, swap_adapter) do not exist in this mode.
+                self._start_gateway_procs()
+            else:
+                self.gateways = []
+                for i in range(self.n_gateways):
+                    gw = Gateway(self.router, self.admission, self.metrics,
+                                 token=self.token, host=self.gateway_host,
+                                 port=self.gateway_port if i == 0 else 0,
+                                 workers=self.workers,
+                                 registry=self.registry,
+                                 tracebook=self.tracebook,
+                                 close_router=False,
+                                 http_port=self.http_port
+                                 if i == 0 else None).start()
+                    self.gateways.append(gw)
+                self.gateway = self.gateways[0]
+                self.http_addr = self.gateway.http_addr
             if self.metrics_port is not None:
                 self._metrics_http = self.metrics.start_http_server(
                     self.metrics_port)
@@ -573,6 +785,8 @@ class FleetServer:
                 for _ in range(self.kv_replicas):
                     self.launch_replica(KV)
             self._wait_replicas()
+            if self.gateway_processes:
+                self._wait_gateway_mirrors()
             for gw in self.gateways:
                 gw.rollout_fn = self.rollout
                 gw.catalog = self.catalog
@@ -1145,12 +1359,22 @@ class FleetServer:
 
     @property
     def addr(self) -> Optional[str]:
-        return self.gateway.addr if self.gateway is not None else None
+        if self.gateway is not None:
+            return self.gateway.addr
+        addrs = self.addrs
+        return addrs[0] if addrs else None
 
     @property
     def addrs(self) -> List[str]:
-        """Every front door's address (multi-gateway deployments)."""
-        return [gw.addr for gw in self.gateways if gw.addr]
+        """Every front door's address (multi-gateway deployments).  In
+        subprocess mode this is the central registry's leased discovery
+        set — with SO_REUSEPORT all N processes share one address, so
+        one entry stands for the whole set."""
+        if self.gateways:
+            return [gw.addr for gw in self.gateways if gw.addr]
+        if self._gateway_procs and self.registry is not None:
+            return sorted(self.registry.gateway_addrs())
+        return []
 
     def client(self, timeout: float = 120.0) -> FleetClient:
         """A client over EVERY gateway: it spreads nothing (one
@@ -1183,6 +1407,22 @@ class FleetServer:
                 gw.stop()
         self.gateways = []
         self.gateway = None
+        self.http_addr = None
+        if self.metrics is not None:
+            self.metrics.fanin = None
+        for proc in self._gateway_procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self._gateway_procs:
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        self._gateway_procs = []
         # The gateways share the router (close_router=False); its
         # links close exactly once, here.
         if self.router is not None:

@@ -146,6 +146,44 @@ class Histogram:
             return (self.buckets, tuple(self._counts), self._count,
                     self._sum)
 
+    def raw(self) -> Dict[str, object]:
+        """JSON-safe raw state for cross-process aggregation: bucket
+        edges (``None`` stands in for +inf so strict JSON round-trips),
+        per-bucket counts, total count/sum, and the tracked max.  The
+        inverse of :meth:`merge`."""
+        with self._lock:
+            return {
+                "buckets": [None if e == float("inf") else e
+                            for e in self.buckets],
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": self._sum,
+                "max": self._max,
+            }
+
+    def merge(self, state: Dict[str, object]) -> None:
+        """Fold another histogram's :meth:`raw` state — typically from a
+        different gateway process — into this one.  Matching bucket
+        layouts add elementwise; a foreign layout re-buckets each count
+        at its upper edge (conservative: samples can only move to a
+        wider bucket, so merged percentiles never under-report)."""
+        edges = tuple(float("inf") if e is None else float(e)
+                      for e in state.get("buckets", ()))
+        counts = [int(n) for n in state.get("counts", ())]
+        with self._lock:
+            if edges == self.buckets and len(counts) == len(self._counts):
+                for i, n in enumerate(counts):
+                    self._counts[i] += n
+            else:
+                for edge, n in zip(edges, counts):
+                    if n:
+                        self._counts[bisect_left(self.buckets, edge)] += n
+            self._count += int(state.get("count", 0))
+            self._sum += float(state.get("sum", 0.0))
+            m = state.get("max", 0.0)
+            if isinstance(m, (int, float)) and m > self._max:
+                self._max = float(m)
+
     @staticmethod
     def delta_percentile(prev: Optional[tuple], cur: tuple, p: float,
                          inf_value: Optional[float] = None
@@ -187,6 +225,12 @@ class FleetMetrics:
         self._counters: Dict[str, int] = {}
         self._hists: Dict[str, Histogram] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
+        self._gauge_acc: Dict[str, float] = {}
+        #: optional scrape-time fan-in (docs/SERVING.md "Multi-process
+        #: gateways"): a callable returning the OTHER processes'
+        #: :meth:`raw_state` dicts.  When set, the HTTP exporter serves
+        #: the fleet-level merge instead of this process alone.
+        self.fanin: Optional[Callable[[], List[dict]]] = None
         self._reporter: Optional[threading.Thread] = None
         self._reporter_stop = threading.Event()
 
@@ -267,6 +311,68 @@ class FleetMetrics:
                 out["gauges"][name] = None
         return out
 
+    def raw_state(self) -> Dict[str, dict]:
+        """Mergeable raw export: counters, sampled gauge values, and
+        per-histogram :meth:`Histogram.raw` states.  This is what a
+        gateway process ships over the wire (``metrics`` op with
+        ``raw: true``) so the launcher-side scrape can fan N processes
+        into one registry via :meth:`merge_raw` — ``snapshot()`` only
+        carries percentile estimates, which cannot be aggregated."""
+        with self._lock:
+            counters = dict(self._counters)
+            hists = dict(self._hists)
+            gauges = dict(self._gauges)
+        out: Dict[str, dict] = {"counters": counters, "gauges": {},
+                                "histograms": {}}
+        for name, fn in gauges.items():
+            try:
+                out["gauges"][name] = fn()
+            except Exception:  # pragma: no cover - gauge must not break export
+                out["gauges"][name] = None
+        for name, h in hists.items():
+            out["histograms"][name] = h.raw()
+        return out
+
+    def merge_raw(self, raw: Dict[str, dict]) -> None:
+        """Fold one process's :meth:`raw_state` into this registry:
+        counters add, histograms bucket-merge, and numeric gauges
+        accumulate as SUMS across every merge (right for queue depths
+        and inflight counts; per-process identity gauges like bound
+        ports belong in the per-process scrape, not the fan-in)."""
+        for name, n in (raw.get("counters") or {}).items():
+            try:
+                self.inc(name, int(n))
+            except (TypeError, ValueError):
+                continue
+        for name, st in (raw.get("histograms") or {}).items():
+            if isinstance(st, dict):
+                self.hist(name).merge(st)
+        for name, val in (raw.get("gauges") or {}).items():
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            with self._lock:
+                self._gauge_acc[name] = self._gauge_acc.get(name, 0) + val
+            self.register_gauge(
+                name, lambda n=name: self._gauge_acc.get(n, 0))
+
+    def merged(self) -> "FleetMetrics":
+        """One fleet-level registry: this process's own raw state folded
+        with whatever :attr:`fanin` returns (each entry a peer
+        process's :meth:`raw_state`).  A peer that fails to scrape
+        costs its contribution, never the merge."""
+        out = FleetMetrics()
+        out.merge_raw(self.raw_state())
+        raws: List[dict] = []
+        if self.fanin is not None:
+            try:
+                raws = list(self.fanin() or [])
+            except Exception:  # pragma: no cover - scrape must not break export
+                raws = []
+        for raw in raws:
+            if isinstance(raw, dict):
+                out.merge_raw(raw)
+        return out
+
     def prometheus_text(self) -> str:
         """The whole metrics surface in Prometheus exposition format
         (text/plain version 0.0.4): counters and numeric gauges as-is,
@@ -336,11 +442,16 @@ class FleetMetrics:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):         # noqa: N802 - stdlib casing
+                # Fan-in happens at scrape time: with `fanin` set this
+                # endpoint serves the fleet-level merge of every
+                # gateway process, not this process alone.
+                src = metrics.merged() if metrics.fanin is not None \
+                    else metrics
                 if self.path.split("?")[0] == "/metrics.json":
-                    body = json.dumps(metrics.snapshot()).encode()
+                    body = json.dumps(src.snapshot()).encode()
                     ctype = "application/json"
                 elif self.path.split("?")[0] in ("/", "/metrics"):
-                    body = metrics.prometheus_text().encode()
+                    body = src.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 else:
                     self.send_error(404)
@@ -354,9 +465,22 @@ class FleetMetrics:
             def log_message(self, *args) -> None:
                 pass    # scrapes are not log events
 
-        server = http.server.ThreadingHTTPServer((host, int(port)),
-                                                 Handler)
+        try:
+            server = http.server.ThreadingHTTPServer((host, int(port)),
+                                                     Handler)
+        except OSError:
+            if not port:
+                raise
+            # The requested port is taken — with N gateway processes on
+            # one host only the first wins a fixed --metrics-port, and
+            # silently dying here would leave N-1 processes unscraped.
+            # Fall back to an OS-assigned port; the `metrics_http_port`
+            # gauge below tells scrapers (and `tfserve metrics`) where
+            # this process actually landed.
+            server = http.server.ThreadingHTTPServer((host, 0), Handler)
         server.daemon_threads = True
+        bound_port = int(server.server_address[1])
+        self.register_gauge("metrics_http_port", lambda p=bound_port: p)
         t = threading.Thread(target=server.serve_forever,
                              name="fleet-metrics-http", daemon=True)
         t.start()

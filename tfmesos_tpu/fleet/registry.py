@@ -213,7 +213,18 @@ class ReplicaRegistry:
         # each Gateway registers its addr at start and removes it on a
         # GRACEFUL stop — a killed gateway stays listed (discovery is
         # best-effort; client failover skips dead entries itself).
-        self._gateways: Dict[str, bool] = {}
+        # Front-door discovery set.  Values carry liveness: ``None`` is
+        # a PERMANENT entry (registered in-process by the launcher —
+        # its stop() unregisters it); a float is an EXPIRY deadline for
+        # a wire-registered gateway process, refreshed by its periodic
+        # ``register_gateway`` frames and swept like a heartbeat — a
+        # SIGKILLed gateway process falls out of discovery on its own.
+        # Keyed by the LEASE key — the process's private scrape addr
+        # when it has one, else the public addr — because with
+        # SO_REUSEPORT N processes share ONE public addr and each still
+        # needs its own lease (and its own metrics scrape target).
+        # Values are (public_addr, expiry-or-None).
+        self._gateways: Dict[str, tuple] = {}
         # Membership version + cached routable views: bumped ONLY when
         # the set a router pick iterates could change (entry add/evict,
         # state or role transition) — NOT on per-beat field refreshes
@@ -310,6 +321,42 @@ class ReplicaRegistry:
                                              msg.get("key")))
             except Exception as e:
                 self.log.warning("%s reply failed: %s", msg["op"], e)
+            return
+        if isinstance(msg, dict) and msg.get("op") == "registry_view":
+            # The multi-process gateway sidecar's poll: the whole table
+            # as heartbeat-shaped dicts it replays into its local
+            # registry, plus the gateway discovery set.  Served on the
+            # heartbeat socket like every other read — a gateway
+            # process is just one more wire peer.
+            try:
+                conn.send(self.registry_view())
+            except Exception as e:
+                self.log.warning("registry_view reply failed: %s", e)
+            return
+        if isinstance(msg, dict) and msg.get("op") == "register_gateway":
+            # A gateway PROCESS leasing itself into discovery; always
+            # TTL'd (clamped) — only the in-process launcher path may
+            # create permanent entries, so a wire peer can never park
+            # an unreapable address in the discovery set.
+            gaddr = msg.get("addr")
+            if isinstance(gaddr, str) and gaddr and len(gaddr) <= 256:
+                raw_ttl = msg.get("ttl")
+                try:
+                    ttl = float(raw_ttl) if raw_ttl is not None else 10.0
+                except (TypeError, ValueError):
+                    ttl = 10.0
+                scrape = msg.get("scrape")
+                if not (isinstance(scrape, str) and scrape
+                        and len(scrape) <= 256):
+                    scrape = None
+                self.register_gateway(gaddr,
+                                      ttl=max(1.0, min(ttl, 300.0)),
+                                      scrape=scrape)
+                try:
+                    conn.send({"op": "gateway_registered", "addr": gaddr})
+                except Exception as e:
+                    self.log.warning("register_gateway reply failed: %s",
+                                     e)
             return
         addr = self.observe(msg, conn)
         if addr is not None:
@@ -457,16 +504,22 @@ class ReplicaRegistry:
                 rep.capacity = int(msg["capacity"])
             if "outstanding" in msg:
                 rep.outstanding = int(msg["outstanding"])
-            before = _advertises_prefix(rep)
-            if isinstance(msg.get("prefix_cache"), dict):
-                rep.prefix = msg["prefix_cache"]
-            if isinstance(msg.get("kv_tier"), dict):
-                # A tier advertising spilled prefix digests joins the
-                # affinity-scan gate the same way a device summary does.
-                rep.kv_tier = msg["kv_tier"]
-            if isinstance(msg.get("spec"), dict):
-                rep.spec = msg["spec"]
-            self._prefix_count += _advertises_prefix(rep) - before
+            if "prefix_cache" in msg or "kv_tier" in msg \
+                    or "spec" in msg:
+                # Prefix-advertisement accounting only when the beat
+                # could change it — the plain liveness beat (the 10k-
+                # replica steady state) skips both scans.
+                before = _advertises_prefix(rep)
+                if isinstance(msg.get("prefix_cache"), dict):
+                    rep.prefix = msg["prefix_cache"]
+                if isinstance(msg.get("kv_tier"), dict):
+                    # A tier advertising spilled prefix digests joins
+                    # the affinity-scan gate the same way a device
+                    # summary does.
+                    rep.kv_tier = msg["kv_tier"]
+                if isinstance(msg.get("spec"), dict):
+                    rep.spec = msg["spec"]
+                self._prefix_count += _advertises_prefix(rep) - before
             if msg.get("role") in ROLES and rep.role != msg["role"]:
                 rep.role = msg["role"]
                 self._version += 1
@@ -565,6 +618,11 @@ class ReplicaRegistry:
                     self._version += 1
                     self.log.warning("replica %s draining (heartbeat "
                                      "stale %.1fs)", addr, age)
+            for key in [k for k, (_, exp) in self._gateways.items()
+                        if exp is not None and exp <= now]:
+                gaddr = self._gateways.pop(key)[0]
+                self.log.warning("gateway %s lease expired (process "
+                                 "gone?); leaving discovery", gaddr)
 
     # -- queries / writes --------------------------------------------------
 
@@ -798,11 +856,72 @@ class ReplicaRegistry:
                 role = rep.role or UNIFIED
                 if role != KV and not isinstance(rep.kv_tier, dict):
                     continue
-                peers.append({"addr": rep.addr, "role": role,
-                              "weights_version":
-                                  rep.weights_version or ""})
+                peer = {"addr": rep.addr, "role": role,
+                        "weights_version": rep.weights_version or ""}
+                # Heartbeat-advertised tier fullness (0.0..1.0+), the
+                # load signal behind ``placement=loaded``: parks drift
+                # away from peers whose RAM tier is nearly full.
+                kt = rep.kv_tier
+                if isinstance(kt, dict):
+                    used = kt.get("ram_bytes_used")
+                    cap = kt.get("ram_bytes")
+                    if isinstance(used, (int, float)) \
+                            and isinstance(cap, (int, float)) \
+                            and not isinstance(used, bool) \
+                            and not isinstance(cap, bool) and cap > 0:
+                        peer["occupancy"] = round(float(used)
+                                                  / float(cap), 4)
+                peers.append(peer)
         peers.sort(key=lambda p: (p["role"] != KV, p["addr"]))
         return {"op": "kv_peers", "peers": peers}
+
+    def registry_view(self) -> Dict[str, Any]:
+        """The whole table as HEARTBEAT-SHAPED dicts (plus each entry's
+        current ``state`` and the gateway discovery set) — the
+        multi-process gateway sidecar polls this and REPLAYS every
+        entry into its process-local registry through the normal
+        ``observe``/``mark_dead`` surface, so each gateway process
+        routes off the same states and fences the central table holds
+        without any shared memory.  Optional fields appear only when
+        the replica advertised them, mirroring real beats."""
+        reps: List[Dict[str, Any]] = []
+        with self._lock:
+            for rep in self._table.values():
+                d: Dict[str, Any] = {
+                    "op": "heartbeat", "addr": rep.addr,
+                    "state": rep.state, "capacity": rep.capacity,
+                    "outstanding": rep.outstanding, "role": rep.role,
+                }
+                if rep.state == WARMING:
+                    d["status"] = WARMING
+                if rep.weights_version:
+                    d["weights_version"] = rep.weights_version
+                if rep.gen >= 0:
+                    d["gen"] = rep.gen
+                if rep.node:
+                    d["node"] = rep.node
+                if rep.kv_headroom >= 0:
+                    d["kv_headroom"] = rep.kv_headroom
+                if isinstance(rep.prefix, dict):
+                    d["prefix_cache"] = rep.prefix
+                if isinstance(rep.kv_tier, dict):
+                    d["kv_tier"] = rep.kv_tier
+                if isinstance(rep.spec, dict):
+                    d["spec"] = rep.spec
+                if rep.model_id:
+                    d["model_id"] = rep.model_id
+                if rep.warm_pool:
+                    d["warm_pool"] = True
+                if rep.adapter_version:
+                    d["adapter_version"] = rep.adapter_version
+                if rep.gang_id or rep.gang_size > 1:
+                    d["gang"] = {"id": rep.gang_id,
+                                 "size": rep.gang_size,
+                                 "live": rep.gang_live,
+                                 "coord": rep.gang_coord}
+                reps.append(d)
+        return {"op": "registry_view", "replicas": reps,
+                "gateways": self.gateway_addrs()}
 
     def kv_locate(self, kind, key) -> Dict[str, Any]:
         """Resolve which hosts currently advertise one artifact — the
@@ -892,25 +1011,69 @@ class ReplicaRegistry:
                 (agg["committed"] - row_rounds) / opportunities, 4)
         return agg
 
-    def register_gateway(self, addr: str) -> None:
+    def register_gateway(self, addr: str,
+                         ttl: Optional[float] = None,
+                         scrape: Optional[str] = None) -> None:
         """Record one fleet front door for client-side discovery (the
         gateway's ``gateways`` op hands the set out; multi-gateway
-        failover dials down it)."""
+        failover dials down it).  ``ttl`` (seconds) makes the entry
+        LEASED — a gateway PROCESS re-registers over the wire on every
+        sidecar poll, so a killed process expires out of discovery
+        instead of lingering; ``None`` (the in-process default) is
+        permanent until :meth:`unregister_gateway`.  ``scrape`` is the
+        process's PRIVATE per-process wire address (metrics scrape +
+        lease identity): with SO_REUSEPORT every process shares one
+        public ``addr``, so the scrape addr is what keeps N leases
+        distinct."""
+        key = scrape or addr
         with self._lock:
-            self._gateways[addr] = True
-        self.log.info("gateway %s registered", addr)
+            known = key in self._gateways
+            self._gateways[key] = (
+                addr, None if ttl is None
+                else self._clock() + float(ttl))
+        if not known:
+            self.log.info(
+                "gateway %s registered%s%s", addr,
+                "" if ttl is None else f" (ttl {ttl:.0f}s)",
+                f" scrape {scrape}" if scrape else "")
 
     def unregister_gateway(self, addr: str) -> None:
         """Graceful gateway stop: leave the discovery set.  A KILLED
         gateway never calls this — its stale entry is harmless
         (clients skip unreachable addresses while failing over)."""
         with self._lock:
-            self._gateways.pop(addr, None)
+            self._gateways = {k: v for k, v in self._gateways.items()
+                              if k != addr and v[0] != addr}
+
+    def set_gateways(self, addrs: List[str]) -> None:
+        """Replace the discovery set wholesale — the gateway sidecar
+        syncing the CENTRAL registry's view into its process-local
+        table, so any gateway process answers the ``gateways`` op with
+        the full fleet set (entries here are mirror copies; liveness is
+        the central registry's job)."""
+        with self._lock:
+            self._gateways = {a: (a, None) for a in addrs
+                              if isinstance(a, str) and a}
 
     def gateway_addrs(self) -> List[str]:
-        """The registered front doors, stable order."""
+        """The registered front doors, stable order, deduplicated
+        (SO_REUSEPORT processes share one public addr); expired leases
+        excluded — the sweeper reaps them, this just never hands one
+        out in the window before it runs."""
+        now = self._clock()
         with self._lock:
-            return sorted(self._gateways)
+            return sorted({a for a, exp in self._gateways.values()
+                           if exp is None or exp > now})
+
+    def gateway_leases(self) -> List[str]:
+        """One dialable address PER GATEWAY PROCESS (the scrape addr
+        when the lease carries one, else the public addr) — what the
+        launcher's metrics fan-in walks, and how bring-up counts
+        processes that share a REUSEPORT public addr."""
+        now = self._clock()
+        with self._lock:
+            return sorted(k for k, (_, exp) in self._gateways.items()
+                          if exp is None or exp > now)
 
     def set_target(self, role: str, n: Optional[int]) -> None:
         """Record the control plane's WANTED replica count for one tier

@@ -252,8 +252,12 @@ class ReplicaServer:
         # replicated park, "kv_fetch" serves a peer's resume, and
         # "kv_stage" lands a direct peer-to-peer KV stream ahead of
         # the router's small generate call referencing it.
+        # "cancel" is the advisory client-disconnect op: one-way from
+        # the router (id 0), it asks the batcher to release the row of
+        # an in-flight streamed request whose client is gone.
         if op not in ("generate", "prefill", "migrate", "adopt",
-                      "swap_adapter", "kv_put", "kv_fetch", "kv_stage"):
+                      "swap_adapter", "kv_put", "kv_fetch", "kv_stage",
+                      "cancel"):
             self._send(conn, send_lock,
                        {"op": "error", "id": mid,
                         "kind": "bad_request",
@@ -281,6 +285,11 @@ class ReplicaServer:
             self._send(conn, send_lock, out)
 
         reply.partial = partial
+        # Per-connection identity for the in-flight registry: a cancel
+        # names its target by the mux call id, which is only unique PER
+        # ROUTER CONNECTION — keying on (conn, id) keeps two routers'
+        # colliding ids from cross-cancelling each other's requests.
+        reply.conn_key = id(conn)
         try:
             self.handler(msg, reply)
         except Exception as e:      # handler bug: fail THIS request only
@@ -489,6 +498,15 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
     # kv_stage frame and only a small ``pushed`` suspended reply rides
     # back through the control plane.
     _push_state: Dict[str, Any] = {"to": None}
+    # In-flight requests keyed by (connection identity, call id): the
+    # advisory ``cancel`` op (sent by the router when a streaming
+    # client disconnects) looks its target up here and stamps the live
+    # Request's deadline into the past — the batcher's own per-tick
+    # expiry check then cancels the row, frees its pages, and resolves
+    # the pending generate as deadline_exceeded.  Keyed per connection
+    # because call ids are only unique per router link.
+    _inflight: Dict[tuple, Any] = {}
+    _inflight_lock = threading.Lock()
 
     def _push_stage(addr: str, smeta: Dict[str, Any],
                     body: bytes) -> Any:
@@ -501,6 +519,19 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
         raw = isinstance(msg, wire.RawFrame)
         head = msg.meta if raw else msg
         mid = head.get("id")
+        if head.get("op") == "cancel":
+            target = head.get("target")
+            key = (getattr(reply, "conn_key", None), target)
+            with _inflight_lock:
+                req = _inflight.get(key)
+            if req is not None:
+                req.deadline = _time.perf_counter()
+            # The router sends cancels one-way (id 0) and drops this
+            # reply as unmatched; answering anyway keeps the server's
+            # outstanding count balanced and gives tests a surface.
+            reply({"op": "cancelled", "id": mid,
+                   "found": req is not None})
+            return
         if head.get("op") == "kv_stage":
             if not raw:
                 reply({"op": "error", "id": mid, "kind": "bad_request",
@@ -648,7 +679,13 @@ def batcher_handler(serving: BatcherServing, generation: int = 0,
                  "error": str(e)}, tr, failed=True))
             return
 
+        ckey = (getattr(reply, "conn_key", None), mid)
+        with _inflight_lock:
+            _inflight[ckey] = req
+
         def on_done(comp, err) -> None:
+            with _inflight_lock:
+                _inflight.pop(ckey, None)
             if comp is None:
                 reply(_attach_trace(
                     {"op": "error", "id": mid, "kind": "internal",
@@ -1139,7 +1176,7 @@ def _kv_holder_main(args, token: str, generation: int,
                         disk_dir=args.kv_tier_dir, token=token,
                         stamp={})
     fabric = KVFabric(store, token=token, registry_addr=args.registry,
-                      replication=1)
+                      replication=1, placement=args.kv_placement)
     handler = fabric_handler(fabric)
 
     def extra() -> Dict[str, Any]:
@@ -1233,6 +1270,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "SIGKILL of its parking host and resumes from "
                         "a surviving copy (docs/SERVING.md 'Cross-host "
                         "KV fabric'); needs --registry and a KV tier")
+    p.add_argument("--kv-placement", choices=("rendezvous", "loaded"),
+                   default="rendezvous", dest="kv_placement",
+                   help="fabric peer choice for replicated parks: "
+                        "'rendezvous' (default) is pure hash-ordered "
+                        "(deterministic, ignores load); 'loaded' "
+                        "re-scores the rendezvous candidates by their "
+                        "heartbeat KV-tier occupancy so parks avoid "
+                        "peers whose tiers are nearly full "
+                        "(docs/SERVING.md 'Cross-host KV fabric')")
     p.add_argument("--pipeline-depth", type=int, default=0,
                    choices=(0, 1), dest="pipeline_depth",
                    help="1 pipelines the decode loop with a device-"
@@ -1364,7 +1410,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         fabric = KVFabric(batcher.kv_tier, token=token,
                           registry_addr=args.registry,
-                          replication=args.kv_replication)
+                          replication=args.kv_replication,
+                          placement=args.kv_placement)
         batcher.kv_tier = fabric
 
     def adopt_fn(head, reply) -> None:

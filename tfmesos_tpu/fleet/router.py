@@ -855,9 +855,12 @@ class Router:
                                          attempt >= self.max_retries)
             try:
                 if emit is not None:
-                    reply = self._link(addr).call_raw(
+                    rlink = self._link(addr)
+                    reply = rlink.call_raw(
                         self._wire_msg(call, deadline), body,
-                        timeout=timeout, on_partial=emit)
+                        timeout=timeout,
+                        on_partial=self._cancel_on_disconnect(emit,
+                                                              rlink))
                 else:
                     reply = self._link(addr).call_raw(
                         self._wire_msg(call, deadline), body,
@@ -965,9 +968,10 @@ class Router:
         timeout = self._call_timeout(deadline, True)
         try:
             if emit is not None:
-                reply = self._link(addr).call(
+                plink = self._link(addr)
+                reply = plink.call(
                     self._wire_msg(call, deadline), timeout=timeout,
-                    on_partial=emit)
+                    on_partial=self._cancel_on_disconnect(emit, plink))
             else:
                 reply = self._link(addr).call(
                     self._wire_msg(call, deadline), timeout=timeout)
@@ -1026,6 +1030,48 @@ class Router:
             {victim_addr}, rep.weights_version or "",
             model=rep.model_id or None,
             adapter=getattr(rep, "adapter_version", "") or None)
+
+    # -- client-disconnect cancel propagation ------------------------------
+
+    def _cancel_on_disconnect(self, emit, link):
+        """Wrap a streaming partial emitter so a client that vanished
+        mid-stream releases its replica row instead of decoding to the
+        bitter end.  The gateway's relay exposes an ``emit.cancelled``
+        probe (true once the client connection is closed); on the first
+        partial frame that finds it true, send ONE fire-and-forget
+        ``cancel`` op back down the same link (the frame's ``id`` is
+        the replica-side call id) and swallow all further frames.
+        Best-effort by design: an emitter without the probe, or a link
+        without :meth:`notify` (sim/test stubs), passes through
+        unchanged, and a lost cancel merely costs the tokens the
+        request would have decoded anyway."""
+        if emit is None:
+            return emit
+        cancelled = getattr(emit, "cancelled", None)
+        notify = getattr(link, "notify", None)
+        if cancelled is None or notify is None:
+            return emit
+        state = {"sent": False}
+
+        def wrapped(frame):
+            if cancelled():
+                if not state["sent"]:
+                    state["sent"] = True
+                    head = frame.meta \
+                        if isinstance(getattr(frame, "meta", None), dict) \
+                        else frame
+                    target = head.get("id") \
+                        if isinstance(head, dict) else None
+                    if target is not None:
+                        try:
+                            notify({"op": "cancel", "target": target})
+                        except Exception:
+                            pass    # advisory: never disturb the stream
+                self.metrics.inc("stream_cancelled_frames")
+                return              # the client is gone; drop the frame
+            emit(frame)
+
+        return wrapped
 
     # -- the routing loop --------------------------------------------------
 
@@ -1110,8 +1156,10 @@ class Router:
             try:
                 link = self._link(addr)
                 if emit is not None:
-                    reply = link.call(self._wire_msg(msg, deadline),
-                                      timeout=timeout, on_partial=emit)
+                    reply = link.call(
+                        self._wire_msg(msg, deadline), timeout=timeout,
+                        on_partial=self._cancel_on_disconnect(emit,
+                                                              link))
                 else:
                     reply = link.call(self._wire_msg(msg, deadline),
                                       timeout=timeout)
@@ -1449,9 +1497,12 @@ class Router:
             try:
                 tm = t0 = self._clock()
                 if emit is not None:
-                    reply = self._link(daddr).call_raw(
+                    dlink = self._link(daddr)
+                    reply = dlink.call_raw(
                         self._wire_msg(meta, deadline), praw.body,
-                        timeout=timeout, on_partial=emit)
+                        timeout=timeout,
+                        on_partial=self._cancel_on_disconnect(emit,
+                                                              dlink))
                 else:
                     reply = self._link(daddr).call_raw(
                         self._wire_msg(meta, deadline), praw.body,
@@ -1585,9 +1636,10 @@ class Router:
         try:
             tm = t0 = self._clock()
             if emit is not None:
-                reply = self._link(daddr).call(
+                dlink = self._link(daddr)
+                reply = dlink.call(
                     self._wire_msg(call, deadline), timeout=timeout,
-                    on_partial=emit)
+                    on_partial=self._cancel_on_disconnect(emit, dlink))
             else:
                 reply = self._link(daddr).call(
                     self._wire_msg(call, deadline), timeout=timeout)

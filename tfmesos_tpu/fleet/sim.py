@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import dataclasses
 import heapq
+import logging
 import random
 import threading
 import time
@@ -79,7 +80,8 @@ from tfmesos_tpu.fleet.metrics import FleetMetrics
 from tfmesos_tpu.fleet.registry import (DECODE, PREFILL, UNIFIED, WARMING,
                                         ReplicaRegistry)
 from tfmesos_tpu.fleet.router import Router
-from tfmesos_tpu.fleet.workload import Request, SyntheticWorkload
+from tfmesos_tpu.fleet.workload import (DiurnalWorkload, Request,
+                                        SyntheticWorkload)
 from tfmesos_tpu.utils.logging import get_logger
 
 __all__ = ["VirtualClock", "SimEngine", "ReplicaModel", "SimReplica",
@@ -491,6 +493,30 @@ class SimTransport:
         # whose EVERY copy host died (``host_loss_miss``).
         self.kv_replication = 0
         self.kv_forward_ms = 2.0
+        # Copy-placement policy for K-way parking: "rendezvous" is the
+        # pure hash ranking, "loaded" stable-sorts that ranking by each
+        # candidate's coarse tier occupancy (copies held / kv_pages,
+        # quantized to 5 buckets — KVFabric._order's exact rule) so hot
+        # tiers shed new copies while near-empty ones keep their hash
+        # affinity.
+        self.kv_placement = "rendezvous"
+        self._tier_load: Dict[str, int] = {}
+
+    def _place(self, sid: str, parker: str) -> Tuple[str, ...]:
+        """Pick the K-way copy set for a parked session: the parker
+        plus the first K-1 peers under the configured placement."""
+        peers = [a for a, h in sorted(self.replicas.items())
+                 if not h.down and not h.removed and a != parker]
+        ranked = rendezvous_order(sid, peers)
+        if self.kv_placement == "loaded":
+            load = self._tier_load
+            ranked = sorted(
+                ranked,
+                key=lambda a: min(4, int(
+                    4 * load.get(a, 0)
+                    / max(1, self.replicas[a].kv_pages))))
+        return (parker,) + tuple(
+            ranked[:max(0, self.kv_replication - 1)])
 
     def link(self, addr: str) -> _SimLink:
         rep = self.replicas.get(addr)
@@ -670,13 +696,16 @@ class SimTransport:
                     # input, like the real artifact's history).
                     holders: Any = rep.addr
                     if self.kv_replication >= 1:
-                        peers = [a for a, h in sorted(
-                                     self.replicas.items())
-                                 if not h.down and not h.removed
-                                 and a != rep.addr]
-                        holders = (rep.addr,) + tuple(
-                            rendezvous_order(sid, peers)
-                            [:max(0, self.kv_replication - 1)])
+                        holders = self._place(sid, rep.addr)
+                        load = self._tier_load
+                        prev = self.session_tier.get(sid)
+                        if prev is not None and len(prev) > 2 \
+                                and not isinstance(prev[2], str):
+                            for a in prev[2]:   # re-park replaces copies
+                                if load.get(a, 0) > 0:
+                                    load[a] -= 1
+                        for a in holders:
+                            load[a] = load.get(a, 0) + 1
                     self.session_tier[sid] = (
                         prompt_len + new_tokens - 1,
                         rep.weights_version, holders)
@@ -771,6 +800,11 @@ class SimConfig:
     # — a kill loses only sessions whose every copy host died.
     kv_replication: int = 0
     kv_forward_ms: float = 2.0
+    # Copy-placement policy when K >= 1 (sweep ``kv_placement=
+    # rendezvous,loaded``): "loaded" stable-sorts the rendezvous
+    # ranking by tier occupancy before truncating to K-1 copies —
+    # KVFabric's ``placement=loaded`` knob priced on the virtual clock.
+    kv_placement: str = "rendezvous"
     workers: int = 8
     max_queue: int = DEFAULT_MAX_QUEUE
     rate_limit: Optional[float] = None
@@ -791,6 +825,13 @@ class SimConfig:
     backoff_s: float = 0.05
     request_timeout: float = 60.0
     hb_interval: float = 0.5
+    # Heartbeat sharding (the diurnal 10k-replica scenario): 0 keeps
+    # one timer event per replica per beat — the classic behavior,
+    # exactly.  N > 0 batches replicas into N self-rescheduling shard
+    # beats, collapsing the event heap's dominant term at 10k replicas
+    # (10k events/sim-second -> N) without changing what the registry
+    # observes.  Opt-in because it quantizes beat phases per shard.
+    hb_shards: int = 0
     suspect_after: float = 1.5
     dead_after: float = 3.0
     evict_after: float = 10.0
@@ -963,6 +1004,13 @@ class FleetSim:
                      f"latency_ms_{s.name}")
             for s in specs}
         self._prompts: Dict[int, tuple] = {}
+        # Heartbeat sharding (cfg.hb_shards): None = one timer event
+        # per replica per beat; else N shard lists, each driven by one
+        # self-rescheduling event that beats every live member.
+        n_sh = max(0, int(cfg.hb_shards))
+        self._hb_shards: Optional[List[List[SimReplica]]] = (
+            [[] for _ in range(n_sh)] if n_sh else None)
+        self._hb_live = [False] * n_sh
         # The liveness sweep is always on; heartbeats are per-replica.
         self._schedule_sweep()
 
@@ -990,40 +1038,76 @@ class FleetSim:
             warm_until=self.engine.clock.now + warm_s,
             model_id=model_id, pool=pool, gang_size=size)
         self.transport.replicas[rep.addr] = rep
-        self._beat(rep)
+        if self._hb_shards is not None:
+            # Sharded beats: register NOW (scenarios wait on the
+            # registry seeing the replica), then join a shard whose
+            # one event beats every member each interval.
+            if not rep.drop_beats:
+                self._send_beat(rep)
+            idx = i % len(self._hb_shards)
+            self._hb_shards[idx].append(rep)
+            if not self._hb_live[idx]:
+                self._hb_live[idx] = True
+                self.engine.after(self.cfg.hb_interval,
+                                  lambda: self._shard_beat(idx))
+        else:
+            self._beat(rep)
         return rep
 
     def _beat(self, rep: SimReplica) -> None:
         if rep.removed or rep.down or self._stopped:
             return      # a dead replica stops beating; the sweep notices
-        now = self.engine.clock.now
         if not rep.drop_beats:
-            msg: Dict[str, Any] = {
-                "op": "heartbeat", "addr": rep.addr,
-                "capacity": rep.capacity,
-                "outstanding": rep.outstanding(now), "role": rep.role,
-                "node": rep.node,
-                "weights_version": rep.weights_version, "gen": rep.gen}
-            if rep.model_id:
-                msg["model_id"] = rep.model_id
-            if rep.pool or rep.model_id:
-                # Like the real replica: pool-capable processes always
-                # send the flag, so an adoption's False overwrites.
-                msg["warm_pool"] = rep.pool
-            if rep.gang_size > 1:
-                # The leader-only gang beat field the real registry
-                # parses into ReplicaInfo.gang_* / gang_summary().
-                msg["gang"] = {"id": f"sim/{rep.node}",
-                               "size": rep.gang_size,
-                               "live": rep.gang_live,
-                               "coord": rep.addr}
-            if rep.role == DECODE:
-                msg["kv_headroom"] = max(
-                    0, rep.kv_pages - rep.outstanding(now))
-            if now < rep.warm_until:
-                msg["status"] = WARMING
-            self.registry.observe(msg)
+            self._send_beat(rep)
         self.engine.after(self.cfg.hb_interval, lambda: self._beat(rep))
+
+    def _shard_beat(self, idx: int) -> None:
+        if self._stopped:
+            return
+        shard = [r for r in self._hb_shards[idx]
+                 if not r.removed and not r.down]
+        self._hb_shards[idx] = shard
+        if not shard:
+            self._hb_live[idx] = False
+            return      # re-armed when the shard gains a replica
+        for rep in shard:
+            if not rep.drop_beats:
+                self._send_beat(rep)
+        # Logical-event accounting: this ONE heap pop carried
+        # len(shard) beats that per-replica mode pops individually —
+        # credit them so ``sim_events_per_sec`` means the same thing
+        # at every ``hb_shards`` setting.
+        self.engine.events += len(shard) - 1
+        self.engine.after(self.cfg.hb_interval,
+                          lambda: self._shard_beat(idx))
+
+    def _send_beat(self, rep: SimReplica) -> None:
+        now = self.engine.clock.now
+        msg: Dict[str, Any] = {
+            "op": "heartbeat", "addr": rep.addr,
+            "capacity": rep.capacity,
+            "outstanding": rep.outstanding(now), "role": rep.role,
+            "node": rep.node,
+            "weights_version": rep.weights_version, "gen": rep.gen}
+        if rep.model_id:
+            msg["model_id"] = rep.model_id
+        if rep.pool or rep.model_id:
+            # Like the real replica: pool-capable processes always
+            # send the flag, so an adoption's False overwrites.
+            msg["warm_pool"] = rep.pool
+        if rep.gang_size > 1:
+            # The leader-only gang beat field the real registry
+            # parses into ReplicaInfo.gang_* / gang_summary().
+            msg["gang"] = {"id": f"sim/{rep.node}",
+                           "size": rep.gang_size,
+                           "live": rep.gang_live,
+                           "coord": rep.addr}
+        if rep.role == DECODE:
+            msg["kv_headroom"] = max(
+                0, rep.kv_pages - rep.outstanding(now))
+        if now < rep.warm_until:
+            msg["status"] = WARMING
+        self.registry.observe(msg)
 
     def kill(self, rep: SimReplica) -> None:
         """Hard death (the SIGKILL analog): beats stop, in-flight
@@ -1862,6 +1946,93 @@ def scenario_scale(overrides=(), n_requests: int = 1_000_000,
     return out
 
 
+def scenario_diurnal(overrides=(), n_requests: int = 1_000_000,
+                     replicas: Optional[int] = None,
+                     seed: Optional[int] = None,
+                     workload=None, model_fit: Optional[dict] = None,
+                     cfg: Optional[SimConfig] = None) -> Dict[str, Any]:
+    """The million-user front door's day at 10x the scale proof:
+    10,000 replicas, >= 1M requests riding a sinusoidal day/night
+    envelope with seeded flash crowds (:class:`~tfmesos_tpu.fleet.
+    workload.DiurnalWorkload` — fit the constants from a real
+    ``tfserve trace --json`` export with ``fit_diurnal``), heartbeats
+    SHARDED (``cfg.hb_shards``) so the event heap prices requests,
+    not 10k timer pops per sim-second.  Byte-for-byte deterministic
+    per seed; gateway counts, trader constants and admission bounds
+    all sweepable.  Publishes ``sim_events_per_sec_10k`` — the
+    10x-replica hot-path floor benched next to ``sim_events_per_sec``
+    (the scale scenario's 45k events/s contract)."""
+    cfg = _new_cfg(cfg, overrides)
+    cfg.replicas = int(replicas) if replicas is not None else 10_000
+    if seed is not None:
+        cfg.seed = int(seed)
+    if not any(p == "workers" for p, _ in (overrides or ())):
+        cfg.workers = 64      # the scale scenario's measured sweet spot
+    if not any(p == "max_queue" for p, _ in (overrides or ())):
+        cfg.max_queue = 4096
+    # A 10k fleet beats and sweeps SLOWER than a 3-replica one (real
+    # fleets stretch liveness cadence with size): per-sim-second table
+    # work is replicas/hb_interval observes plus a full-table sweep
+    # every sweep_interval — at the scale scenario's cadence that is
+    # 10k observes + 5 sweeps per sim-second of pure bookkeeping wall.
+    # Each constant stays individually sweepable.
+    for path, v in (("hb_interval", 5.0), ("suspect_after", 7.5),
+                    ("dead_after", 15.0), ("evict_after", 60.0),
+                    ("sweep_interval", 2.0)):
+        if not any(p == path for p, _ in (overrides or ())):
+            setattr(cfg, path, v)
+    if cfg.hb_shards <= 0:
+        # Per-replica beats are 2k heap events per sim-second of pure
+        # timer churn at this scale; 64 shard beats carry the same
+        # registry observations.
+        cfg.hb_shards = 64
+    cfg.model = dataclasses.replace(cfg.model, jitter=0.0)
+    if model_fit:
+        for k, v in model_fit.items():
+            if hasattr(cfg.model, k):
+                setattr(cfg.model, k, v)
+    sim = FleetSim(cfg)
+    # 10k one-line "registered" INFO records are pure handler wall (and
+    # unreadable output) at bring-up — quiet the registry logger for
+    # the bulk registration only.
+    reg_log = logging.getLogger("tfmesos_tpu.fleet.registry")
+    old_level = reg_log.level
+    reg_log.setLevel(logging.WARNING)
+    try:
+        for _ in range(cfg.replicas):
+            sim.add_replica(UNIFIED)
+    finally:
+        reg_log.setLevel(old_level)
+    if workload is None:
+        _, per_req_s = cfg.model.service_s(16, 8, random.Random(0))
+        # MEAN arrivals at the dispatcher pool's saturation point (the
+        # scale scenario's pump) so the envelope swings the pool from
+        # trough slack to crest overload — two full day/night cycles
+        # plus four flash crowds across the stream.
+        peak = 4.0
+        pump = cfg.workers / max(1e-9, per_req_s)
+        base = pump / (1.0 + (peak - 1.0) / 2.0)
+        span = n_requests / pump
+        workload = DiurnalWorkload(
+            n_requests, base, seed=cfg.seed,
+            period_s=max(1.0, span / 2.0), peak_ratio=peak,
+            bursts=4, burst_ratio=3.0,
+            burst_duration_s=max(0.5, span / 50.0),
+            class_mix={"interactive": 4.0, "background": 1.0},
+            prompt_len=16, prompt_sigma=0.0,
+            new_tokens=8, new_tokens_sigma=0.0)
+    sim.feed(workload)
+    sim.start_workers()
+    t0 = time.perf_counter()
+    sim.engine.run(stop=sim.drained)
+    wall = time.perf_counter() - t0
+    out = sim.results(wall)
+    out["sim_events_per_sec_10k"] = out.get("sim_events_per_sec")
+    out["hb_shards"] = cfg.hb_shards
+    sim.stop()
+    return out
+
+
 def scenario_multi_gateway(overrides=(), n_requests: int = 6000,
                            replicas: Optional[int] = None,
                            seed: Optional[int] = None,
@@ -2022,6 +2193,7 @@ def scenario_sessions(overrides=(), n_requests: Optional[int] = None,
     sim.transport.cross_host_resume = float(cfg.cross_host_resume)
     sim.transport.kv_replication = int(cfg.kv_replication)
     sim.transport.kv_forward_ms = float(cfg.kv_forward_ms)
+    sim.transport.kv_placement = str(cfg.kv_placement)
     reps = [sim.add_replica(UNIFIED) for _ in range(cfg.replicas)]
     if workload is None:
         n_sessions = int(sessions) if sessions is not None else (
@@ -2057,7 +2229,15 @@ def scenario_sessions(overrides=(), n_requests: Optional[int] = None,
             st["ttft_cold_ms"] / max(1, st["park"] - st["resume"]), 3),
         "cross_host_resume": cfg.cross_host_resume,
         "kv_replication": cfg.kv_replication,
+        "kv_placement": cfg.kv_placement,
     })
+    # The placement sweep's figure of merit: how evenly the K-way
+    # copies landed across surviving tiers (max vs mean copies held).
+    load = sim.transport._tier_load
+    if load:
+        out["kv_copy_load_max"] = max(load.values())
+        out["kv_copy_load_mean"] = round(
+            sum(load.values()) / len(load), 2)
     sim.stop()
     return out
 
@@ -2287,6 +2467,7 @@ SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "surge": scenario_surge,
     "soak-replay": scenario_soak_replay,
     "scale": scenario_scale,
+    "diurnal": scenario_diurnal,
     "multi-gateway": scenario_multi_gateway,
     "sessions": scenario_sessions,
     "multi-model": scenario_multi_model,

@@ -52,6 +52,7 @@ import selectors
 import socket
 import struct
 import threading
+import time
 import weakref
 from typing import Any, Callable, List, Optional
 
@@ -361,15 +362,45 @@ def connect(addr: str, timeout: Optional[float] = 30.0) -> socket.socket:
     host, port = addr.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)), timeout=timeout)
     sock.settimeout(timeout)
+    _nodelay(sock)
     return sock
 
 
-def bind_ephemeral(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
+def _nodelay(sock: socket.socket) -> None:
+    """Disable Nagle.  Every wire exchange is a small framed request
+    waiting on a small framed reply — exactly the pattern where Nagle
+    batching + the peer's delayed ACK serializes into ~40ms stalls
+    per round trip.  Best-effort: a transport without the option
+    (e.g. AF_UNIX) just skips it."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except (OSError, AttributeError):
+        pass
+
+
+def reuseport_available() -> bool:
+    """Whether this platform supports ``SO_REUSEPORT`` (N processes
+    sharing one listening port, the kernel load-balancing accepts) —
+    the multi-process gateway's preferred deployment shape."""
+    return hasattr(socket, "SO_REUSEPORT")
+
+
+def bind_ephemeral(host: str = "0.0.0.0", port: int = 0,
+                   reuseport: bool = False) -> socket.socket:
     """Bind a listening socket on an OS-assigned port (reference pattern at
     scheduler.py:325-328 / server.py:18-21).  ``port`` pins a specific
-    port instead (the fleet gateway's stable front-door address)."""
+    port instead (the fleet gateway's stable front-door address).
+    ``reuseport`` additionally sets ``SO_REUSEPORT`` so N gateway
+    PROCESSES can share the pinned port (raises ``OSError`` where the
+    platform lacks it — callers fall back to per-process ports behind
+    the ``gateways`` discovery op)."""
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if reuseport:
+        if not reuseport_available():
+            sock.close()
+            raise OSError("SO_REUSEPORT is not available on this platform")
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
     sock.bind((host, port))
     sock.listen(128)
     return sock
@@ -452,7 +483,19 @@ class WireConn:
     connection is DROPPED instead (backpressure must bound memory, and
     a peer that cannot keep up with its own replies is as good as
     gone).  Handlers may stash per-connection state as plain attributes
-    (the registry keys heartbeat EOFs that way)."""
+    (the registry keys heartbeat EOFs that way).
+
+    A connection accepted on an INGRESS listener (``add_ingress``)
+    carries a ``protocol`` object instead of the HMAC framer: raw
+    socket bytes go to ``protocol.data_received(data)`` (an exception
+    drops the connection — the protocol's rejection surface), replies
+    go out through ``send_bytes``, and on drop/close the protocol's own
+    ``on_close()`` fires INSTEAD of the server's ``on_close`` hook (an
+    ingress peer must never be mistaken for a wire peer — the registry
+    keys replica EOFs off that hook).  ``deadline`` (a monotonic
+    timestamp, maintained via ``server._watch``) is the slow-loris
+    bound: a connection that blows past it is swept closed by the
+    loop."""
 
     def __init__(self, server: "WireServer", sock: socket.socket,
                  peer: str):
@@ -466,6 +509,8 @@ class WireConn:
         self._close_after_flush = False
         self._events = selectors.EVENT_READ
         self.drop_reason: Optional[str] = None
+        self.protocol: Optional[Any] = None
+        self.deadline: Optional[float] = None
 
     @property
     def closed(self) -> bool:
@@ -481,6 +526,12 @@ class WireConn:
         """Queue one raw binary frame (meta + body, HMAC-tagged)."""
         header, mv = _raw_parts(meta, body, self._server.token)
         return self._enqueue(header + bytes(mv))
+
+    def send_bytes(self, data: bytes) -> bool:
+        """Queue pre-encoded bytes verbatim (no framing, no HMAC) —
+        the ingress-protocol reply path (HTTP responses, SSE frames).
+        Same buffering/overflow discipline as ``send``."""
+        return self._enqueue(bytes(data))
 
     def _enqueue(self, frame: bytes) -> bool:
         hook = _chaos_send     # snapshot against a concurrent uninstall
@@ -538,7 +589,8 @@ class WireServer:
                  allow_raw: bool = False, name: str = "wire-server",
                  max_buffer: int = 64 * 1024 * 1024,
                  on_close: Optional[Callable[[WireConn], None]] = None,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 reuseport: bool = False):
         self.handler = handler
         self.token = token
         self.host = host
@@ -548,6 +600,7 @@ class WireServer:
         self.max_buffer = int(max_buffer)
         self.on_close = on_close
         self.advertise_host = advertise_host
+        self.reuseport = bool(reuseport)
         self.addr: Optional[str] = None
         self._listen: Optional[socket.socket] = None
         self._sel: Optional[selectors.BaseSelector] = None
@@ -559,13 +612,35 @@ class WireServer:
         self._plock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Ingress listeners: (factory, host, port) requested pre-start;
+        # bound sockets + addrs filled in by start().
+        self._ingress: List[tuple] = []
+        self._ingress_socks: List[socket.socket] = []
+        self.ingress_addrs: List[str] = []
+        # Connections with a live slow-loris deadline (loop-thread only).
+        self._timed: set = set()
         from tfmesos_tpu.utils.logging import get_logger
         self.log = get_logger("tfmesos_tpu.wire")
 
     # -- lifecycle ---------------------------------------------------------
 
+    def add_ingress(self, factory: Callable[[WireConn], Any],
+                    host: str = "127.0.0.1", port: int = 0) -> None:
+        """Register an EXTRA listener on the same event loop whose
+        accepted connections speak a caller-defined protocol instead of
+        the HMAC wire framing (the HTTP/SSE edge).  ``factory(conn)``
+        runs per accept and returns the protocol object: raw bytes go
+        to ``protocol.data_received(data)`` (raise to drop the
+        connection), replies ride ``conn.send_bytes``, and
+        ``protocol.on_close()`` (optional) fires when the connection
+        dies.  Must be called BEFORE ``start()``."""
+        if self._thread is not None:
+            raise RuntimeError("add_ingress must precede start()")
+        self._ingress.append((factory, host, int(port)))
+
     def start(self) -> "WireServer":
-        self._listen = bind_ephemeral(self.host, port=self.port)
+        self._listen = bind_ephemeral(self.host, port=self.port,
+                                      reuseport=self.reuseport)
         self._listen.setblocking(False)
         adv = self.advertise_host or (
             None if self.host in ("0.0.0.0", "::") else self.host)
@@ -575,6 +650,15 @@ class WireServer:
         self._waker_r.setblocking(False)
         self._sel.register(self._listen, selectors.EVENT_READ, "listen")
         self._sel.register(self._waker_r, selectors.EVENT_READ, "waker")
+        for factory, host, port in self._ingress:
+            sock = bind_ephemeral(host, port=port)
+            sock.setblocking(False)
+            self._ingress_socks.append(sock)
+            self.ingress_addrs.append(sock_addr(
+                sock, advertise_host=adv if host in ("0.0.0.0", "::")
+                else host))
+            self._sel.register(sock, selectors.EVENT_READ,
+                               ("ingress", sock, factory))
         self._thread = threading.Thread(target=self._loop, name=self.name,
                                         daemon=True)
         self._thread.start()
@@ -619,7 +703,23 @@ class WireServer:
             self._pending_close.add(conn)
         self._wake()
 
+    def _watch(self, conn: WireConn) -> None:
+        """Track ``conn`` in the deadline sweep (loop thread only —
+        ingress protocols run their parse on the loop thread)."""
+        self._timed.add(conn)
+
     # -- the loop ----------------------------------------------------------
+
+    def _sweep_timed(self) -> None:
+        if not self._timed:
+            return
+        now = time.monotonic()
+        for conn in list(self._timed):
+            if conn._closed or conn.deadline is None:
+                self._timed.discard(conn)
+            elif now > conn.deadline:
+                self._timed.discard(conn)
+                self._close_conn(conn, "ingress deadline (slow peer)")
 
     def _loop(self) -> None:
         sel = self._sel
@@ -627,8 +727,11 @@ class WireServer:
             while not self._stop.is_set():
                 # The waker (and wake_listener's accept poke) are what
                 # actually end the wait; the timeout is only the
-                # backstop if both ever fail.
-                for key, mask in sel.select(timeout=5.0):
+                # backstop if both ever fail — except while ingress
+                # connections carry slow-loris deadlines, when the wait
+                # shortens so the sweep stays timely.
+                timeout = 0.25 if self._timed else 5.0
+                for key, mask in sel.select(timeout=timeout):
                     tag = key.data
                     if tag == "listen":
                         self._accept_ready()
@@ -638,6 +741,8 @@ class WireServer:
                                 pass
                         except OSError:
                             pass
+                    elif isinstance(tag, tuple) and tag[0] == "ingress":
+                        self._accept_ready(listen=tag[1], factory=tag[2])
                     else:
                         if mask & selectors.EVENT_READ:
                             self._read_ready(tag)
@@ -645,6 +750,7 @@ class WireServer:
                                 and not tag._closed:
                             self._flush(tag)
                 self._service_pending()
+                self._sweep_timed()
         finally:
             with self._plock:
                 conns = list(self._conns)
@@ -658,7 +764,8 @@ class WireServer:
                     conn._sock.close()
                 except OSError:
                     pass
-            for sock in (self._listen, self._waker_r, self._waker_w):
+            for sock in ([self._listen, self._waker_r, self._waker_w]
+                         + self._ingress_socks):
                 if sock is not None:
                     try:
                         sock.close()
@@ -681,17 +788,31 @@ class WireServer:
             if not conn._closed:
                 self._flush(conn)
 
-    def _accept_ready(self) -> None:
+    def _accept_ready(self, listen: Optional[socket.socket] = None,
+                      factory: Optional[Callable] = None) -> None:
+        listen = listen if listen is not None else self._listen
         while True:
             try:
-                sock, peer = self._listen.accept()
+                sock, peer = listen.accept()
             except BlockingIOError:
                 return
             except OSError:
                 return              # listener closed (stopping)
             sock.setblocking(False)
+            _nodelay(sock)
             conn = WireConn(self, sock, f"{peer[0]}:{peer[1]}"
                             if isinstance(peer, tuple) else str(peer))
+            if factory is not None:
+                try:
+                    conn.protocol = factory(conn)
+                except Exception:
+                    self.log.exception("%s: ingress factory failed",
+                                       self.name)
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
             with self._plock:
                 self._conns.add(conn)
             try:
@@ -716,6 +837,18 @@ class WireServer:
             return
         if not data:
             self._close_conn(conn, "eof")
+            return
+        proto = conn.protocol
+        if proto is not None:
+            # Ingress connection: the protocol object parses its own
+            # framing under its own byte bounds; raising is its
+            # rejection surface (malformed request, oversized body).
+            try:
+                proto.data_received(data)
+            except Exception as e:
+                self.log.warning("%s: dropping ingress connection from "
+                                 "%s: %s", self.name, conn.peer, e)
+                self._close_conn(conn, f"ingress error: {e}")
             return
         try:
             msgs = conn._framer.feed(data)
@@ -785,7 +918,19 @@ class WireServer:
             self._conns.discard(conn)
             self._pending.discard(conn)
             self._pending_close.discard(conn)
-        if self.on_close is not None:
+        self._timed.discard(conn)
+        if conn.protocol is not None:
+            # Ingress connections notify their OWN protocol, never the
+            # server-level hook: that hook carries wire-peer semantics
+            # (the registry attributes replica EOFs through it).
+            cb = getattr(conn.protocol, "on_close", None)
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    self.log.exception("%s: ingress on_close failed",
+                                       self.name)
+        elif self.on_close is not None:
             try:
                 self.on_close(conn)
             except Exception:
