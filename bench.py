@@ -646,6 +646,96 @@ def bench_serving_pipeline(n_requests=16, rows=8, tiny=False):
     return pipe_itl, base_itl, pipe_rps
 
 
+def bench_serving_fused_prefill(n_interactive=12, n_long=8, rows=4,
+                                tiny=False, best_of=3):
+    """Stall-free fused scheduling (docs/SERVING.md) vs the phase-split
+    chunked tick on the SAME long-prompt-interference workload: short
+    interactive requests decode while long prompts chunk in behind
+    them.  Phase-split pays a separate chunk dispatch ahead of every
+    decode block; the fused tick folds the budgeted chunk slots INTO
+    the decode dispatch, so the interactive decode inter-token p99
+    must be STRICTLY better fused — and since fusion only moves where
+    the chunk rides, the streams are asserted token-identical first
+    (a faster diverged stream is not a result).  The gap population is
+    REAL per-token stream timestamps (``Request.on_tokens`` fires at
+    every tick's flush), pooled across the interactive requests —
+    interfered ticks are a large fraction of that pool, so the p99
+    reads the stalled tick's duration, not one scheduler hiccup — and
+    the reported number is the median of per-run p99s over
+    ``best_of`` runs per mode."""
+    from tfmesos_tpu.serving import ContinuousBatcher, Request
+
+    cfg, params, _, max_len, _ = _serving_bench_setup(tiny)
+    chunk = 8 if tiny else 64
+    short_new = 24 if tiny else 48
+    long_chunks = 7 if tiny else 5      # tiny max_len 64: 56 + 2 fits
+    rng = np.random.default_rng(7)
+    shorts = [rng.integers(0, cfg.vocab_size, size=(chunk,))
+              .astype(np.int32) for _ in range(n_interactive)]
+    longs = [rng.integers(0, cfg.vocab_size, size=(long_chunks * chunk,))
+             .astype(np.int32) for _ in range(n_long)]
+
+    def mk():
+        # Shorts fill the rows first; each long admits as a row frees,
+        # so there is (nearly) always a prompt chunking while the
+        # resident shorts decode — the stall the fused tick removes.
+        items = [Request(prompt=p.copy(), max_new_tokens=short_new)
+                 for p in shorts[:rows]]
+        rest = [Request(prompt=p.copy(), max_new_tokens=short_new)
+                for p in shorts[rows:]]
+        for i, p in enumerate(longs):
+            items.append(Request(prompt=p.copy(), max_new_tokens=2))
+            items.extend(rest[2 * i:2 * (i + 1)])
+        items.extend(rest[2 * n_long:])
+        return items
+
+    n_total = n_interactive + n_long
+    interactive_idx = {i for i, r in enumerate(mk())
+                       if r.max_new_tokens == short_new}
+
+    def run(fused):
+        kw = dict(rows=rows, max_len=max_len, prefill_chunk=chunk,
+                  fused_prefill=fused)
+        tokens, p99s, dt = None, [], 1.0
+        for _ in range(best_of):
+            b = ContinuousBatcher(cfg, params, **kw)
+            b.warmup()      # the whole grid AOT, incl. fused [w,S]
+            items = mk()
+            stamps = [[] for _ in items]
+            for i in interactive_idx:
+                def cb(toks, off, acc=stamps[i]):
+                    acc.append(time.perf_counter())
+                items[i].on_tokens = cb
+            t0 = time.perf_counter()
+            done = {c.rid: c for c in b.run(items)}
+            dt = time.perf_counter() - t0
+            assert len(done) == n_total
+            if fused:
+                assert b.fused_ticks > 0 and b.fused_chunk_tokens > 0, \
+                    "fused batcher never fused a chunk into a tick"
+            # rid assignment follows pull order — map completions back
+            # to workload positions through the sorted rid sequence.
+            tokens = [done[rid].tokens for rid in sorted(done)]
+            gaps = sorted(1000.0 * (b2 - a)
+                          for acc in stamps
+                          for a, b2 in zip(acc, acc[1:]))
+            assert len(gaps) >= 50, \
+                "too few streamed gaps to read a p99 from"
+            p99s.append(gaps[min(len(gaps) - 1,
+                                 int(0.99 * len(gaps)))])
+        return tokens, sorted(p99s)[len(p99s) // 2], n_total / dt
+
+    split_tokens, split_p99, _ = run(False)
+    fused_tokens, fused_p99, fused_rps = run(True)
+    assert fused_tokens == split_tokens, \
+        "fused completions diverged from the phase-split tick"
+    assert fused_p99 < split_p99, \
+        (f"interactive inter-token p99 under long-prompt interference "
+         f"not strictly better fused: {fused_p99:.3f}ms vs phase-split "
+         f"{split_p99:.3f}ms")
+    return fused_p99, split_p99, fused_rps
+
+
 def bench_decode_paged_call(tiny=False, reps=30):
     """Per-call paged-attention decode latency + launches-per-block —
     the device floor BASELINE.md round 5 localized (~0.54 ms/launch x
@@ -2624,6 +2714,113 @@ def bench_fleet_sim(replicas=1000, n_requests=1_000_000, seed=0):
             fid["retry_amplification"], eps_10k)
 
 
+def bench_fleet_offline_lane(n_requests=1200, replicas=3, seed=13):
+    """The OFFLINE lane (ROADMAP 6b): the ``offline-lane`` scenario's
+    lane-on arm vs the lane-off baseline on the same seed — a diurnal
+    interactive envelope whose trough leaves slots idle, plus a
+    deadline-less batch backlog submitted through the strict-priority
+    ``batch`` class.  In-bench asserts: fleet utilization STRICTLY
+    higher with the lane on, interactive p99 held within the PR 7
+    epsilon convention (1.5x + a small absolute floor), ZERO requests
+    lost in either arm, and the whole batch backlog completes."""
+    from tfmesos_tpu.fleet.sim import run_sweep
+
+    rows = dict(run_sweep("offline-lane", "batch_lane",
+                          ["false", "true"],
+                          n_requests=n_requests, replicas=replicas,
+                          seed=seed))
+    off, on = rows["false"], rows["true"]
+    assert on["lost"] == 0 and off["lost"] == 0, \
+        f"offline-lane arms lost requests: on={on['lost']} " \
+        f"off={off['lost']}"
+    assert on["utilization"] > off["utilization"], \
+        (f"batch lane did not raise fleet utilization: "
+         f"{on['utilization']:.4f} (on) vs {off['utilization']:.4f} "
+         f"(off)")
+    on_p99 = on["classes"]["interactive"]["p99_ms"]
+    off_p99 = off["classes"]["interactive"]["p99_ms"]
+    assert on_p99 <= max(1.5 * off_p99, off_p99 + 150.0), \
+        (f"interactive p99 not held with the batch lane on: "
+         f"{on_p99:.1f}ms vs {off_p99:.1f}ms baseline")
+    n_batch = on["batch_planned"]
+    assert n_batch > 0 and on["classes"]["batch"]["count"] == n_batch, \
+        "the batch backlog did not complete through the lane"
+    return (on["utilization"], off["utilization"], on_p99, off_p99,
+            on.get("batch_deferrals", 0), n_batch)
+
+
+def bench_http_keepalive(n_requests=200):
+    """HTTP ingress connection reuse, before/after: requests/s for
+    ``n_requests`` sequential POST /v1/completions over ONE kept-alive
+    connection vs a fresh connection per request (the pre-keep-alive
+    behavior — every request paid connect + teardown).  Echo gateway,
+    no fleet, no jax: the delta is pure connection-lifecycle cost."""
+    import json as json_mod
+    import socket as socket_mod
+    import threading
+
+    from tfmesos_tpu import wire
+    from tfmesos_tpu.fleet.http import HttpIngress
+
+    class _Echo:
+        def handle_ingress(self, reply, msg):
+            toks = list(msg.get("prompt", []))
+            threading.Thread(
+                target=lambda: reply.send(
+                    {"op": "completion", "id": msg.get("id"),
+                     "tokens": toks, "ttft_ms": 1.0, "total_ms": 2.0}),
+                daemon=True).start()
+
+    body = json_mod.dumps({"prompt": [1, 2, 3],
+                           "max_tokens": 4}).encode()
+    raw = (b"POST /v1/completions HTTP/1.1\r\n"
+           b"Content-Type: application/json\r\n"
+           + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+
+    def read_response(s, buf):
+        while b"\r\n\r\n" not in buf:
+            buf += s.recv(65536)
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        clen = 0
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.strip().lower() == b"content-length":
+                clen = int(v.strip())
+        while len(rest) < clen:
+            rest += s.recv(65536)
+        return rest[clen:]
+
+    srv = wire.WireServer(lambda conn, msg: None, token="bench",
+                          name="http-bench")
+    srv.add_ingress(HttpIngress(_Echo()))
+    srv.start()
+    try:
+        host, _, port = srv.ingress_addrs[0].rpartition(":")
+        addr = (host, int(port))
+        # AFTER: one connection, n_requests ride it back to back.
+        with socket_mod.create_connection(addr, timeout=30.0) as s:
+            s.settimeout(30.0)
+            buf = b""
+            read_response(s, s.sendall(raw) or buf)   # warm
+            t0 = time.perf_counter()
+            buf = b""
+            for _ in range(n_requests):
+                s.sendall(raw)
+                buf = read_response(s, buf)
+            keep_rps = n_requests / (time.perf_counter() - t0)
+        # BEFORE: a fresh connection (connect + close) per request.
+        t0 = time.perf_counter()
+        for _ in range(n_requests):
+            with socket_mod.create_connection(addr, timeout=30.0) as s:
+                s.settimeout(30.0)
+                s.sendall(raw)
+                read_response(s, b"")
+        close_rps = n_requests / (time.perf_counter() - t0)
+    finally:
+        srv.stop()
+    return keep_rps, close_rps
+
+
 def _gateway_flood(addr, token, n_conns, prompt, max_new_tokens=4,
                    timeout_s=180.0):
     """Selector-driven N-connection client harness: open ``n_conns``
@@ -3611,6 +3808,18 @@ def main():
         out["serving_pipeline_requests_per_sec"] = round(pipe_rps, 2)
         out["serving_pipeline_speedup"] = round(base_itl / pipe_itl, 3)
         flush_partial()
+    fp = attempts(bench_serving_fused_prefill,
+                  "fused prefill serving bench", n=1)
+    if fp:
+        # Fused prefill+decode ticks vs the phase-split chunked tick:
+        # token-identical asserted in-bench, interactive inter-token
+        # p99 under long-prompt interference strictly better fused.
+        fused_p99, split_p99, fused_rps = fp[0]
+        out["serving_fused_itl_p99_ms"] = round(fused_p99, 3)
+        out["serving_fused_split_itl_p99_ms"] = round(split_p99, 3)
+        out["serving_fused_speedup"] = round(split_p99 / fused_p99, 3)
+        out["serving_fused_requests_per_sec"] = round(fused_rps, 2)
+        flush_partial()
     wu = attempts(bench_serving_warmup, "serving warmup probe", n=1)
     if wu:
         # Cold vs AOT-warmed first-request TTFT (warm < cold asserted).
@@ -3733,6 +3942,30 @@ def main():
         # 10k-replica diurnal replay (sharded heartbeats, day/night
         # envelope): the hot-path floor held at 10x replica count.
         out["sim_events_per_sec_10k"] = round(eps_10k, 1)
+        flush_partial()
+    ol = attempts(bench_fleet_offline_lane, "offline lane bench", n=1)
+    if ol:
+        # The offline lane: utilization strictly higher with the batch
+        # lane on, interactive p99 held, zero lost, backlog complete —
+        # all asserted in-bench.
+        on_util, off_util, on_p99, off_p99, deferrals, n_batch = ol[0]
+        out["fleet_offline_utilization"] = round(on_util, 4)
+        out["fleet_offline_baseline_utilization"] = round(off_util, 4)
+        out["fleet_offline_interactive_p99_ms"] = round(on_p99, 2)
+        out["fleet_offline_baseline_interactive_p99_ms"] = round(
+            off_p99, 2)
+        out["fleet_offline_batch_completed"] = int(n_batch)
+        out["fleet_offline_batch_deferrals"] = int(deferrals)
+        out["fleet_offline_lost_requests"] = 0
+        flush_partial()
+    ka = attempts(bench_http_keepalive, "http keep-alive bench", n=1)
+    if ka:
+        # Before/after connection reuse on the HTTP ingress: one
+        # kept-alive connection vs a fresh connect per request.
+        keep_rps, close_rps = ka[0]
+        out["http_keepalive_requests_per_sec"] = round(keep_rps, 1)
+        out["http_per_conn_requests_per_sec"] = round(close_rps, 1)
+        out["http_keepalive_speedup"] = round(keep_rps / close_rps, 3)
         flush_partial()
     gc = attempts(bench_fleet_gateway_concurrency,
                   "gateway concurrency bench", n=1)

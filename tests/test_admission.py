@@ -171,3 +171,34 @@ def test_class_validation():
     with pytest.raises(ValueError):
         AdmissionController(classes=[PriorityClass("a"),
                                      PriorityClass("a")])
+
+
+def test_batch_class_strict_background_priority():
+    """The offline lane (docs/SERVING.md "Offline lane"): a batch=True
+    class dispatches ONLY when every non-batch queue is empty — strict
+    priority BELOW the WFQ fair-share, so batch backlog can never
+    dilute an interactive class's service share the way a second WFQ
+    class would."""
+    classes = [PriorityClass("interactive", weight=4.0, rank=1),
+               PriorityClass("background", weight=1.0, rank=0),
+               PriorityClass("batch", weight=1.0, rank=-1, batch=True)]
+    adm = AdmissionController(max_queue=64, classes=classes)
+    for i in range(6):
+        adm.admit(("batch", i), cls="batch")
+    for i in range(4):
+        adm.admit(("bg", i), cls="background")
+    for i in range(4):
+        adm.admit(("hi", i), cls="interactive")
+    # Every non-batch item drains before the FIRST batch dispatch.
+    first8 = [adm.get(timeout=0)[0] for _ in range(8)]
+    assert "batch" not in first8, first8
+    # Non-batch queues empty -> the lane opens, FIFO within it.
+    assert adm.get(timeout=0) == ("batch", 0)
+    assert adm.get(timeout=0) == ("batch", 1)
+    # An interactive arrival mid-drain CLOSES the lane instantly: the
+    # very next dispatch is the interactive item, not batch item 2.
+    adm.admit(("hi", 99), cls="interactive")
+    assert adm.get(timeout=0) == ("hi", 99)
+    assert adm.get(timeout=0) == ("batch", 2)
+    # Depth/shed accounting covers the batch class like any other.
+    assert adm.class_depths()["batch"] == 3

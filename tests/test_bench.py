@@ -137,6 +137,45 @@ def test_serving_pipeline_bench_smoke():
 
 
 @pytest.mark.slow
+def test_serving_fused_prefill_bench_smoke():
+    """The fused-vs-phase-split protocol (``serving_fused_*`` keys)
+    end to end at tiny size: token identity and the fused-tick
+    counters are asserted inside the bench; the strict inter-token p99
+    win holds at this shape too (per-token stream timestamps make the
+    stalled tick the p99's population, not an outlier), but a timing
+    inversion only skips — test_serving's fused matrix is the
+    correctness gate, the flagship assert runs in the real bench."""
+    try:
+        fused_p99, split_p99, fused_rps = \
+            bench.bench_serving_fused_prefill(tiny=True)
+    except AssertionError as e:
+        if "not strictly better" in str(e):
+            pytest.skip(f"tiny-shape timing inversion: {e}")
+        raise
+    assert 0 < fused_p99 < split_p99 and fused_rps > 0
+
+
+@pytest.mark.slow
+def test_fleet_offline_lane_bench_smoke():
+    """The offline-lane bench (``fleet_offline_*`` keys) end to end at
+    CI size: utilization strictly higher with the batch lane on,
+    interactive p99 held, zero lost, backlog complete — all asserted
+    inside the bench; the smoke pins shapes and directions."""
+    on_util, off_util, on_p99, off_p99, deferrals, n_batch = \
+        bench.bench_fleet_offline_lane(n_requests=600, replicas=3,
+                                       seed=13)
+    assert 0 < off_util < on_util <= 1.0
+    assert on_p99 > 0 and off_p99 > 0
+    assert n_batch == 300 and deferrals >= 0
+
+
+def test_http_keepalive_bench_smoke():
+    """Connection-reuse before/after rps: both arms finite, jax-free."""
+    keep_rps, close_rps = bench.bench_http_keepalive(n_requests=20)
+    assert keep_rps > 0 and close_rps > 0
+
+
+@pytest.mark.slow
 def test_serving_spec_compose_bench_smoke():
     """The spec-composition protocol end to end at tiny size,
     ``strict=False``: every CORRECTNESS assert stays hard (warm spec

@@ -125,12 +125,117 @@ def test_http_echo_smoke(http_edge):
     out = json.loads(body)
     assert out["object"] == "completion"
     assert out["tokens"] == [5, 6, 7]
-    assert ("Connection", "close") in headers
+    # HTTP/1.1 default: non-streamed completions keep the connection.
+    assert ("Connection", "keep-alive") in headers
     # A string prompt is the demo-model convention: its UTF-8 bytes.
     status, _, body = _http(http_edge, "POST", "/v1/completions",
                             body={"prompt": "hi", "max_tokens": 8})
     assert status == 200
     assert json.loads(body)["tokens"] == [104, 105]
+
+
+def _raw_post(s, body_obj, extra_headers=b""):
+    body = json.dumps(body_obj).encode()
+    s.sendall(b"POST /v1/completions HTTP/1.1\r\n"
+              b"Content-Type: application/json\r\n"
+              + extra_headers
+              + f"Content-Length: {len(body)}\r\n\r\n".encode()
+              + body)
+
+
+def _read_one_response(s, buf):
+    """Read exactly one framed response off `s` (plus whatever was
+    already buffered in `buf`); returns (status, head, body, leftover)."""
+    while b"\r\n\r\n" not in buf:
+        chunk = s.recv(4096)
+        assert chunk, f"connection closed mid-head: {buf!r}"
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        name, _, val = line.partition(b":")
+        if name.strip().lower() == b"content-length":
+            clen = int(val.strip())
+    while len(rest) < clen:
+        chunk = s.recv(4096)
+        assert chunk, "connection closed mid-body"
+        rest += chunk
+    status = int(head.split(b" ", 2)[1])
+    return status, head, rest[:clen], rest[clen:]
+
+
+def test_http_keep_alive_reuses_connection(http_edge):
+    """Satellite contract: several POST /v1/completions round-trips
+    ride ONE connection; an explicit Connection: close then ends it."""
+    with socket.create_connection(http_edge, timeout=5.0) as s:
+        s.settimeout(5.0)
+        buf = b""
+        for i in range(3):
+            _raw_post(s, {"prompt": [i, i + 1], "max_tokens": 8})
+            status, head, body, buf = _read_one_response(s, buf)
+            assert status == 200
+            assert b"connection: keep-alive" in head.lower()
+            assert json.loads(body)["tokens"] == [i, i + 1]
+        # Opting out mid-connection: the reply closes the stream.
+        _raw_post(s, {"prompt": [9], "max_tokens": 8},
+                  extra_headers=b"Connection: close\r\n")
+        status, head, body, buf = _read_one_response(s, buf)
+        assert status == 200
+        assert b"connection: close" in head.lower()
+        assert json.loads(body)["tokens"] == [9]
+        assert s.recv(4096) == b"", "server kept a closed connection"
+
+
+def test_http_keep_alive_pipelined_requests(http_edge):
+    """Bytes past Content-Length are the NEXT request, not a protocol
+    error: two completions written back-to-back both answer in order."""
+    with socket.create_connection(http_edge, timeout=5.0) as s:
+        s.settimeout(5.0)
+        _raw_post(s, {"prompt": [1, 2], "max_tokens": 8})
+        _raw_post(s, {"prompt": [3, 4], "max_tokens": 8})
+        buf = b""
+        status, _, body, buf = _read_one_response(s, buf)
+        assert status == 200 and json.loads(body)["tokens"] == [1, 2]
+        status, _, body, buf = _read_one_response(s, buf)
+        assert status == 200 and json.loads(body)["tokens"] == [3, 4]
+
+
+def test_http_keep_alive_idle_swept(http_edge):
+    """An idle kept-alive connection is re-armed on the header deadline
+    (0.4s in this fixture) and swept — parked peers don't pin conns."""
+    with socket.create_connection(http_edge, timeout=5.0) as s:
+        s.settimeout(5.0)
+        _raw_post(s, {"prompt": [1], "max_tokens": 8})
+        status, head, _, buf = _read_one_response(s, b"")
+        assert status == 200
+        assert b"connection: keep-alive" in head.lower()
+        assert buf == b""            # nothing further was sent
+        t0 = time.monotonic()
+        assert s.recv(4096) == b"", "idle keep-alive conn not swept"
+        assert time.monotonic() - t0 < 4.0
+
+
+def test_http_keep_alive_1_0_default_close(http_edge):
+    """HTTP/1.0 semantics: close unless the peer asks to keep alive."""
+    with socket.create_connection(http_edge, timeout=5.0) as s:
+        s.settimeout(5.0)
+        s.sendall(b"GET /healthz HTTP/1.0\r\n\r\n")
+        status, head, body, _ = _read_one_response(s, b"")
+        assert status == 200 and json.loads(body) == {"ok": True}
+        assert b"connection: close" in head.lower()
+        assert s.recv(4096) == b""
+    with socket.create_connection(http_edge, timeout=5.0) as s:
+        s.settimeout(5.0)
+        s.sendall(b"GET /healthz HTTP/1.0\r\n"
+                  b"Connection: keep-alive\r\n\r\n")
+        status, head, body, buf = _read_one_response(s, b"")
+        assert status == 200 and json.loads(body) == {"ok": True}
+        assert b"connection: keep-alive" in head.lower()
+        # Still usable for a second request.
+        s.sendall(b"GET /healthz HTTP/1.0\r\n"
+                  b"Connection: keep-alive\r\n\r\n")
+        status, _, body, _ = _read_one_response(s, buf)
+        assert status == 200 and json.loads(body) == {"ok": True}
 
 
 def test_http_sse_stream_smoke(http_edge):

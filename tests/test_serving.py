@@ -2699,6 +2699,14 @@ def test_bypass_registry_audit(setup):
                                     "lagged decode carry"}
     sync_ms = compute_bypass_reasons(speculative=True, multi_step=8)
     assert sync_ms["multi_step"] is None
+    # Fused prefill+decode ticks: every documented reason reachable,
+    # nothing else; int8 / multi_step / prefix-cache configs compose
+    # (reason None), and the lagged + sharded + spec modes bypass.
+    assert reachable["fused_prefill"] == {"mesh data sharding",
+                                          "speculative decoding",
+                                          "lagged decode carry"}
+    assert compute_bypass_reasons(quantized_cache=True,
+                                  multi_step=8)["fused_prefill"] is None
     # And __init__ really uses the helper (spot-check: a live batcher's
     # attributes equal the helper's output for its config).
     cfg, params = setup
@@ -2716,6 +2724,140 @@ def test_bypass_registry_audit(setup):
     assert b.suspend_bypass_reason == want["suspend"]
     # The suspend gate IS the preemptible property.
     assert b.preemptible == (b.suspend_bypass_reason is None)
+    # Fused spot-check: a live fused batcher records the helper's
+    # fused_prefill verdict (None here — the mode is active).
+    bf = ContinuousBatcher(cfg, params, fused_prefill=True,
+                           prefill_chunk=16,
+                           **{k: v for k, v in kw.items()
+                              if k != "prefix_cache_pages"})
+    assert bf.fused_prefill_bypass_reason is None
+    bs = ContinuousBatcher(cfg, params, fused_prefill=True,
+                           prefill_chunk=16, overlap=True,
+                           rows=2, max_len=64, page_size=16,
+                           prefill_bucket=16)
+    want = compute_bypass_reasons(overlap=True)
+    assert bs.fused_prefill_bypass_reason == want["fused_prefill"] \
+        == "lagged decode carry"
+
+
+# -- stall-free fused scheduling (PR 20) -------------------------------------
+
+
+@pytest.mark.parametrize("variant",
+                         ["greedy", "sampled", "int8", "pcache",
+                          "multistep", "budget", "spec"])
+def test_fused_tick_token_identical(setup, variant):
+    """THE fused-tick acceptance: fused_prefill=True (one dispatch per
+    tick covering the decode block PLUS budgeted prefill chunk slots)
+    produces IDENTICAL token streams to the phase-split chunked
+    batcher across the mode matrix — greedy/sampled, int8 kv pool,
+    prefix cache, multi_step, a clipped token budget, and the
+    speculative config (which takes the enforced BYPASS route, reason
+    recorded, never a constructor rejection)."""
+    cfg, params = setup
+    rng = np.random.RandomState(41)
+    # Staggered lengths: the long prompts are still chunking while the
+    # short ones decode, so fused ticks genuinely mix both lanes.
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (3, 21, 13, 34, 16, 5)]
+    mk = lambda: [Request(prompt=p.copy(), max_new_tokens=2 + (i % 5))
+                  for i, p in enumerate(prompts)]
+    kw = dict(rows=4, max_len=96, page_size=16, prefill_bucket=16,
+              prefill_chunk=8)
+    fkw = {}
+    if variant == "sampled":
+        kw.update(temperature=0.8, top_k=20, rng=jax.random.PRNGKey(3))
+    elif variant == "int8":
+        kw.update(quantized_cache=True)
+    elif variant == "pcache":
+        kw.update(prefix_cache_pages=16,
+                  prefix=rng.randint(0, cfg.vocab_size,
+                                     size=13).astype(np.int32))
+    elif variant == "multistep":
+        kw.update(multi_step=4)
+    elif variant == "budget":
+        # Clip to ONE chunk slot per tick: rows*K + one chunk.
+        fkw.update(tokens_per_tick=4 + 8)
+    elif variant == "spec":
+        kw.update(**_spec_kw(max_len=96))
+    plain = ContinuousBatcher(cfg, params, **kw)
+    want = {c.rid: c.tokens for c in plain.run(mk())}
+    fb = ContinuousBatcher(cfg, params, fused_prefill=True, **kw, **fkw)
+    got = {c.rid: c.tokens for c in fb.run(mk())}
+    assert got == want, f"{variant}: fused stream diverged"
+    assert fb._inflight is None
+    assert fb.t_side.alloc.rows == {}
+    if variant == "spec":
+        # The bypass lane: recorded reason, zero fused dispatches,
+        # streams still identical (the phase-split path served them).
+        assert fb.fused_prefill_bypass_reason == "speculative decoding"
+        assert fb.fused_ticks == 0
+    else:
+        assert fb.fused_prefill_bypass_reason is None
+        # The analytic win was exercised: fused dispatches really
+        # coalesced prefill chunk tokens alongside live decode rows.
+        assert fb.fused_ticks > 0
+        assert fb.fused_chunk_tokens > 0
+        assert fb.fused_decode_tokens > 0
+        assert fb.fused_tokens_per_tick() \
+            >= kw["rows"] * kw.get("multi_step", 1)
+
+
+def test_fused_prefill_requires_chunked():
+    """fused_prefill without prefill_chunk is a config error (chunked
+    prefill IS the lane being fused), not a silent no-op."""
+    with pytest.raises(ValueError, match="fused_prefill"):
+        ContinuousBatcher(None, None, fused_prefill=True)
+
+
+def test_offline_lane_batch_row_preempted_within_tick(setup):
+    """Offline-lane acceptance at the batcher: a ``batch``-class row
+    (rank below every interactive class, forwarded as a negative
+    priority) SUSPENDS within one tick of an interactive arrival via
+    the existing preemption machinery, the interactive stream
+    completes first, and the batch stream resumes token-identically."""
+    import threading
+    import time as _time
+
+    cfg, params = setup
+    kw = dict(rows=1, max_len=64, page_size=16, prefill_bucket=16)
+    rng = np.random.RandomState(47)
+    pBatch, pInter = (rng.randint(0, cfg.vocab_size,
+                                  size=n).astype(np.int32)
+                      for n in (9, 6))
+    refb = ContinuousBatcher(cfg, params, **kw)
+    refs = {c.rid: c.tokens for c in refb.run(
+        [Request(prompt=pBatch.copy(), max_new_tokens=24),
+         Request(prompt=pInter.copy(), max_new_tokens=4)])}
+
+    b = ContinuousBatcher(cfg, params, **kw)
+    order, done = [], {}
+
+    def drive():
+        for c in b.serve():
+            order.append(c.rid)
+            done[c.rid] = c.tokens
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    # Batch lane = rank floor(min interactive rank) - 1 → priority -1.
+    b.submit(Request(prompt=pBatch.copy(), max_new_tokens=24,
+                     priority=-1))
+    _wait_first_admission(b)    # batch row resident and decoding
+    b.submit(Request(prompt=pInter.copy(), max_new_tokens=4,
+                     priority=0))
+    deadline = _time.monotonic() + 120.0
+    while b.resumes < 1:
+        assert _time.monotonic() < deadline, "batch row never yielded"
+        _time.sleep(0.005)
+    b.close()
+    t.join(timeout=300.0)
+    assert not t.is_alive()
+    # One suspend, one resume — and the interactive request finished
+    # BEFORE the (earlier-admitted, longer) batch row.
+    assert b.preemptions == 1 and b.resumes == 1
+    assert order == [1, 0]
+    assert done == refs
 
 
 # -- adapter hot-swap / warm-pool adoption (PR 15) ---------------------------

@@ -19,6 +19,7 @@ import json
 import os
 import selectors
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -293,6 +294,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "unlabeled requests ride the FIRST class "
                         "(docs/SERVING.md 'Priorities, preemption & "
                         "migration')")
+    p.add_argument("--batch-lane", action="store_true", dest="batch_lane",
+                   help="add a deadline-less 'batch' priority class "
+                        "BELOW every interactive class: batch rows fill "
+                        "idle decode slots and leftover tick budget, "
+                        "dispatch only when every interactive queue is "
+                        "empty, and yield within one tick to an "
+                        "interactive arrival via preemption; submit "
+                        "with 'tfserve batch' (docs/SERVING.md "
+                        "'Offline lane')")
     p.add_argument("--no-migrate", action="store_false", dest="migrate",
                    default=True,
                    help="disable drain migration: scale-downs and "
@@ -330,6 +340,29 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "before syncing block N's tokens; token "
                         "streams identical to 0, the synchronous "
                         "default — docs/SERVING.md)")
+    p.add_argument("--fused-prefill", action="store_true",
+                   dest="fused_prefill",
+                   help="stall-free decode ticks: fuse a token-budgeted "
+                        "slice of prefill chunk tokens into the SAME "
+                        "device dispatch as the decode rows (Sarathi-"
+                        "style), so admitting a long prompt no longer "
+                        "stalls live streams; token streams identical "
+                        "to the phase-split default (docs/SERVING.md "
+                        "'Stall-free fused scheduling')")
+    p.add_argument("--tokens-per-tick", type=int, default=None,
+                   dest="tokens_per_tick", metavar="T",
+                   help="with --fused-prefill: the per-tick token "
+                        "budget shared by decode rows and fused "
+                        "prefill chunks (default: rows + one chunk)")
+    p.add_argument("--kv-placement", type=str, default="rendezvous",
+                   dest="kv_placement",
+                   choices=("rendezvous", "loaded"),
+                   help="replicated-park peer placement policy on the "
+                        "cross-host KV fabric: 'rendezvous' (pure "
+                        "HRW, the default) or 'loaded' (occupancy-"
+                        "bucketed HRW that steers parks away from "
+                        "full peers; tune via 'tfserve simulate "
+                        "sessions --sweep kv_placement=...')")
     p.add_argument("--draft", action="store_true",
                    help="replicas serve with a DRAFT companion model "
                         "(speculative decoding): each tick commits "
@@ -643,6 +676,137 @@ def submit_main(argv: List[str]) -> int:
                       "total_ms": out.get("total_ms"),
                       "trace_id": out.get("trace_id")}))
     return 0
+
+
+def build_batch_parser() -> argparse.ArgumentParser:
+    """``tfserve batch`` — submit deadline-less offline work on the
+    fleet's ``batch`` class (``tfserve --batch-lane``) and collect the
+    completions."""
+    p = argparse.ArgumentParser(
+        prog="tfserve batch",
+        description="Submit one or more deadline-less generation "
+                    "requests on the fleet's 'batch' priority class "
+                    "and print one JSON line per completion as each "
+                    "finishes.  Batch work fills idle capacity and "
+                    "yields to interactive traffic, so expect high "
+                    "and variable latency — that is the contract.")
+    p.add_argument("-g", "--gateway", type=str, required=True,
+                   metavar="HOST:PORT", help="the running gateway")
+    p.add_argument("--prompt", type=str, action="append", default=[],
+                   metavar="IDS",
+                   help="comma-separated prompt token ids, e.g. "
+                        "'1,2,3'; repeatable — each occurrence is one "
+                        "batch request")
+    p.add_argument("--file", type=str, default=None,
+                   help="read additional prompts from this file, one "
+                        "comma-separated prompt per line (blank lines "
+                        "and '#' comments skipped)")
+    p.add_argument("-n", "--max-new-tokens", type=int, default=16,
+                   dest="max_new_tokens")
+    p.add_argument("--stop-token", type=int, default=None,
+                   dest="stop_token")
+    p.add_argument("--model", type=str, default=None,
+                   help="catalog model the requests target (tfserve "
+                        "--models); absent rides the fleet's default "
+                        "entry")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="in-flight batch submissions (the lane itself "
+                        "yields to interactive work regardless of "
+                        "this)")
+    p.add_argument("--class", type=str, default="batch", dest="klass",
+                   metavar="NAME",
+                   help="priority class label to submit under "
+                        "(default 'batch' — the --batch-lane class)")
+    p.add_argument("--timeout", type=float, default=3600.0,
+                   help="per-request client timeout in seconds "
+                        "(generous: batch work waits out interactive "
+                        "bursts by design)")
+    return p
+
+
+def batch_main(argv: List[str]) -> int:
+    args = build_batch_parser().parse_args(argv)
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tfmesos_tpu.fleet.admission import Overloaded
+    from tfmesos_tpu.fleet.client import FleetClient, RequestFailed
+
+    token = wire.load_token()
+    if not token:
+        print(f"tfserve batch: no cluster token — set {wire.TOKEN_ENV} "
+              f"or {wire.TOKEN_FILE_ENV} (tfserve printed the token "
+              f"file at startup)", file=sys.stderr)
+        return 2
+    specs = list(args.prompt)
+    if args.file:
+        try:
+            with open(args.file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        specs.append(line)
+        except OSError as e:
+            print(f"tfserve batch: cannot read --file {args.file!r}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+    prompts = []
+    for spec in specs:
+        try:
+            prompt = [int(t) for t in spec.split(",") if t.strip()]
+        except ValueError:
+            print(f"tfserve batch: bad prompt {spec!r}; want "
+                  f"comma-separated ints", file=sys.stderr)
+            return 2
+        if not prompt:
+            print(f"tfserve batch: empty prompt {spec!r}",
+                  file=sys.stderr)
+            return 2
+        prompts.append(prompt)
+    if not prompts:
+        print("tfserve batch: no prompts (--prompt and/or --file)",
+              file=sys.stderr)
+        return 2
+    if args.concurrency < 1:
+        print("tfserve batch: --concurrency must be >= 1",
+              file=sys.stderr)
+        return 2
+
+    # One shared client (thread-safe over the multiplexed connection);
+    # batch requests carry NO deadline — deadline-less is the class
+    # contract, the work waits out interactive bursts instead of
+    # being shed.
+    plock = threading.Lock()
+    failures = [0]
+
+    def one(item):
+        idx, prompt = item
+        try:
+            out = client.generate(prompt, args.max_new_tokens,
+                                  stop_token=args.stop_token,
+                                  priority=args.klass,
+                                  model=args.model)
+            row = {"index": idx, "tokens": out.get("tokens"),
+                   "total_ms": out.get("total_ms")}
+        except (Overloaded, RequestFailed, OSError) as e:
+            failures[0] += 1
+            row = {"index": idx, "error": str(e),
+                   "kind": getattr(e, "kind", "io")}
+        with plock:
+            print(json.dumps(row), flush=True)
+
+    client = None
+    try:
+        client = FleetClient(args.gateway, token, timeout=args.timeout)
+        with ThreadPoolExecutor(max_workers=args.concurrency) as ex:
+            list(ex.map(one, enumerate(prompts)))
+    except OSError as e:
+        print(f"tfserve batch: cannot reach gateway {args.gateway}: "
+              f"{e}", file=sys.stderr)
+        return 1
+    finally:
+        if client is not None:
+            client.close()
+    return 1 if failures[0] else 0
 
 
 def build_trace_parser() -> argparse.ArgumentParser:
@@ -1145,10 +1309,14 @@ def _build_fleet(args, models, roles, classes, token):
         breakers=args.breakers,
         prefix_cache_pages=args.prefix_cache,
         pipeline_depth=args.pipeline_depth,
+        fused_prefill=args.fused_prefill,
+        tokens_per_tick=args.tokens_per_tick,
+        batch_lane=args.batch_lane,
         draft=args.draft, n_draft=args.n_draft,
         kv_tier_mb=args.kv_tier_mb, kv_tier_dir=args.kv_tier_dir,
         kv_replication=args.kv_replication,
         kv_replicas=args.kv_replicas,
+        kv_placement=args.kv_placement,
         warmup=args.warmup,
         report_interval=args.metrics_interval or None,
         metrics_port=args.metrics_port,
@@ -1165,6 +1333,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         return swap_adapter_main(argv[1:])
     if argv and argv[0] == "submit":
         return submit_main(argv[1:])
+    if argv and argv[0] == "batch":
+        return batch_main(argv[1:])
     if argv and argv[0] == "trace":
         return trace_main(argv[1:])
     if argv and argv[0] == "metrics":

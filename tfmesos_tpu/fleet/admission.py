@@ -129,13 +129,24 @@ class PriorityClass:
     (the per-tenant+per-model quota of the model catalog,
     docs/SERVING.md "Model catalog"): a tenant flooding one model
     sheds there without starving its own traffic to other models;
-    ``None`` = unlimited, the pre-catalog behavior exactly."""
+    ``None`` = unlimited, the pre-catalog behavior exactly.
+
+    ``batch`` marks the OFFLINE lane (docs/SERVING.md "Offline lane"):
+    a batch class dispatches only when every non-batch queue is EMPTY —
+    strict background priority BELOW the WFQ fair-share, so batch work
+    soaks up idle dispatcher capacity without ever consuming a share an
+    interactive class could have used.  Batch classes are deadline-less
+    by convention (submitters omit ``deadline_ms``) and should carry a
+    ``rank`` below every interactive class so resident batch rows yield
+    their decode slots to the first interactive arrival via the
+    replica's preemption machinery."""
 
     name: str
     weight: float = 1.0
     rank: int = 0
     max_queue: Optional[int] = None
     model_quota: Optional[int] = None
+    batch: bool = False
 
     def __post_init__(self):
         if not self.name:
@@ -331,10 +342,23 @@ class AdmissionController:
         expired = []
         with self._cond:
             while True:
+                # WFQ over the non-batch classes first; the offline
+                # lane (batch=True classes) is served ONLY when every
+                # non-batch queue is empty — strict background
+                # priority, so batch backlog can never dilute an
+                # interactive class's fair share.
                 best = None
                 for c in self._classes.values():
-                    if c.q and (best is None or c.q[0][:2] < best.q[0][:2]):
+                    if c.spec.batch or not c.q:
+                        continue
+                    if best is None or c.q[0][:2] < best.q[0][:2]:
                         best = c
+                if best is None:
+                    for c in self._classes.values():
+                        if not c.spec.batch or not c.q:
+                            continue
+                        if best is None or c.q[0][:2] < best.q[0][:2]:
+                            best = c
                 if best is not None:
                     tag, _, item, dl, model = best.q.popleft()
                     best._model_out(model)

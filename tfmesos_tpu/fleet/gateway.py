@@ -498,6 +498,12 @@ class Gateway:
                    # "deadline"): the router records its attempts here
                    # and stitches replica hop spans back in.
                    "_trace": tr}
+        if getattr(spec, "batch", False):
+            # Internal routing hint: batch-lane work seeks IDLE
+            # capacity, so the router prefers replicas with free
+            # slots over the plain p2c draw (docs/SERVING.md
+            # "Offline lane").
+            forward["_background"] = True
         if msg.get("stream"):
             # Per-token streaming: the flag rides to the replica (whose
             # batcher flushes token frames per block) and the worker

@@ -36,12 +36,18 @@ Error mapping: admission/routing error kinds become HTTP statuses
 ``bad_request`` -> 400, else 500).  Mid-stream errors arrive as a final
 SSE ``error`` event — the status line already went out.
 
-Connections are one-request-per-connection (``Connection: close`` on
-every response): the simplest correct thing at this layer, and load
-tools pool connections anyway.  A client disconnect mid-stream is
-observed by the token relay (``closed`` below) and cancels the
-replica-side row through the router's one-way ``cancel`` op — a
-walked-away user stops billing and frees pages within a decode tick.
+Connections are KEPT ALIVE across requests (HTTP/1.1 default;
+HTTP/1.0 opts in with ``Connection: keep-alive``, either side opts out
+with ``Connection: close``): after a JSON response the parser re-arms
+for the next request head on the same socket, so a load tool's pooled
+connection pays the TCP+dial cost once, not per request.  SSE streams
+and error responses stay terminal — a stream has no delimiter to
+re-sync past, and an error leaves parser state ambiguous.  An idle
+keep-alive connection is swept by the same header-deadline discipline
+as a fresh one.  A client disconnect mid-stream is observed by the
+token relay (``closed`` below) and cancels the replica-side row
+through the router's one-way ``cancel`` op — a walked-away user stops
+billing and frees pages within a decode tick.
 """
 
 from __future__ import annotations
@@ -89,12 +95,13 @@ _KIND_STATUS = {
 
 def _response_bytes(status: int, body_obj: Any,
                     content_type: str = "application/json",
-                    extra: Tuple[Tuple[str, str], ...] = ()) -> bytes:
+                    extra: Tuple[Tuple[str, str], ...] = (),
+                    keep: bool = False) -> bytes:
     body = json.dumps(body_obj).encode("utf-8")
     head = (f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}\r\n"
             f"Content-Type: {content_type}\r\n"
             f"Content-Length: {len(body)}\r\n"
-            f"Connection: close\r\n"
+            f"Connection: {'keep-alive' if keep else 'close'}\r\n"
             + "".join(f"{k}: {v}\r\n" for k, v in extra)
             + "\r\n")
     return head.encode("latin-1") + body
@@ -132,9 +139,14 @@ class _HttpReply:
     it serializes under its own lock; the byte writes ride
     ``WireConn.send_bytes`` which is thread-safe and buffered."""
 
-    def __init__(self, conn, stream: bool):
+    def __init__(self, conn, stream: bool, keep: bool = False,
+                 on_done=None):
         self._conn = conn
         self.stream = bool(stream)
+        # Keep-alive: a non-stream completion re-arms the connection
+        # for the next request via ``on_done`` instead of closing.
+        self._keep = bool(keep) and not self.stream
+        self._on_done = on_done
         self.peer = getattr(conn, "peer", "http")
         self._lock = threading.Lock()
         self._started = False       # SSE status line sent
@@ -218,7 +230,13 @@ class _HttpReply:
         else:
             body = {"object": "completion", "tokens": toks}
             body.update(meta)
-            ok = self._conn.send_bytes(_response_bytes(200, body))
+            ok = self._conn.send_bytes(
+                _response_bytes(200, body, keep=self._keep))
+            if ok and self._keep and self._on_done is not None:
+                # Connection reuse: hand the socket back to the parser
+                # for the next request instead of closing.
+                self._on_done()
+                return ok
         self._conn.close()
         return ok
 
@@ -264,8 +282,12 @@ class HttpIngress:
 
 class _HttpConn:
     """Per-connection incremental HTTP/1.1 parser (request head ->
-    Content-Length body -> dispatch), one request per connection.
-    Runs entirely on the event-loop thread; rejection is either an
+    Content-Length body -> dispatch), KEPT ALIVE across requests: a
+    finished JSON response re-arms the parser for the next head on the
+    same socket (bytes a pipelining client sent early are held,
+    bounded, until then).  Parsing runs on the event-loop thread and —
+    for the re-arm after a worker-thread reply — on that worker, so
+    every state transition holds ``_plock``.  Rejection is either an
     explicit error response + close, or a raise (the loop drops the
     connection)."""
 
@@ -275,8 +297,13 @@ class _HttpConn:
         self._buf = bytearray()
         self._state = "head"
         self._need = 0
+        self._keep = False          # this request's keep-alive verdict
         self._headers: Dict[str, str] = {}
         self._reply: Optional[_HttpReply] = None
+        # RLock: a reply that completes synchronously inside
+        # _dispatch (an admission rejection on the loop thread)
+        # re-enters through _request_done.
+        self._plock = threading.RLock()
         # Slow-loris bound on the request head, swept by the loop.
         conn.deadline = time.monotonic() + ingress.header_timeout
         conn._server._watch(conn)
@@ -284,33 +311,69 @@ class _HttpConn:
     # -- WireServer protocol interface -------------------------------------
 
     def data_received(self, data: bytes) -> None:
-        if self._state == "done":
-            return                  # pipelined extras: ignored, conn closing
-        self._buf += data
-        if self._state == "head":
-            idx = self._buf.find(b"\r\n\r\n")
-            if idx < 0:
-                if len(self._buf) > self.ingress.max_header:
-                    self._reject(431, "request head exceeds "
-                                      f"{self.ingress.max_header} bytes")
+        with self._plock:
+            self._buf += data
+            if self._state == "done":
+                # A reply is in flight: hold the pipelined next
+                # request (bounded) until _request_done re-arms.
+                if len(self._buf) > (self.ingress.max_header
+                                     + self.ingress.max_body):
+                    self.conn.close()
                 return
-            head = bytes(self._buf[:idx])
-            del self._buf[:idx + 4]
-            try:
-                self._parse_head(head)
-            except _BadRequest as e:
-                self._reject(e.status, str(e))
-                return
-        if self._state == "body":
-            if len(self._buf) > self._need:
-                self._reject(400, "body longer than Content-Length")
-                return
-            if len(self._buf) == self._need:
-                body = bytes(self._buf)
-                self._buf = bytearray()
+            self._process()
+
+    def _process(self) -> None:
+        """Drive the parse over whatever is buffered (``_plock``
+        held).  Loops so a keep-alive healthz — or a pipelined next
+        request — completes without waiting for more socket bytes."""
+        while True:
+            if self._state == "head":
+                idx = self._buf.find(b"\r\n\r\n")
+                if idx < 0:
+                    if len(self._buf) > self.ingress.max_header:
+                        self._reject(431,
+                                     "request head exceeds "
+                                     f"{self.ingress.max_header} bytes")
+                    return
+                head = bytes(self._buf[:idx])
+                del self._buf[:idx + 4]
+                try:
+                    self._parse_head(head)
+                except _BadRequest as e:
+                    self._reject(e.status, str(e))
+                    return
+                continue    # head may have answered and re-armed
+            if self._state == "body":
+                if len(self._buf) < self._need:
+                    return
+                # Slice EXACTLY the declared body; trailing bytes are
+                # the pipelined next request, not an error.
+                body = bytes(self._buf[:self._need])
+                del self._buf[:self._need]
                 self._state = "done"
                 self.conn.deadline = None
                 self._dispatch(body)
+            return
+
+    def _request_done(self) -> None:
+        """A keep-alive response finished (worker thread or loop):
+        re-arm for the next request and drain anything pipelined."""
+        with self._plock:
+            if self.conn.closed or self._state != "done":
+                return
+            self._next_request()
+            self._process()
+
+    def _next_request(self) -> None:
+        """Reset per-request parser state (``_plock`` held)."""
+        self._state = "head"
+        self._need = 0
+        self._keep = False
+        self._headers = {}
+        self._reply = None
+        self.conn.deadline = (time.monotonic()
+                              + self.ingress.header_timeout)
+        self.conn._server._watch(self.conn)
 
     def on_close(self) -> None:
         # Nothing to release here: the reply shim reads conn.closed,
@@ -342,9 +405,16 @@ class _HttpConn:
                 raise _BadRequest(400, f"malformed header {ln[:80]!r}")
             headers[name.lower()] = value.strip()
         self._headers = headers
+        # Keep-alive verdict: HTTP/1.1 defaults on, HTTP/1.0 defaults
+        # off; either side's ``Connection: close`` wins.
+        conn_tok = headers.get("connection", "").lower()
+        if parts[2] == "HTTP/1.1":
+            self._keep = "close" not in conn_tok
+        else:
+            self._keep = "keep-alive" in conn_tok
         path = path.split("?", 1)[0]
         if path == "/healthz":
-            self._respond(200, {"ok": True})
+            self._respond(200, {"ok": True}, keep=self._keep)
             return
         if path != "/v1/completions":
             raise _BadRequest(404, f"unknown path {path[:80]!r}")
@@ -386,7 +456,9 @@ class _HttpConn:
         except _BadRequest as e:
             self._reject(e.status, str(e))
             return
-        self._reply = _HttpReply(self.conn, stream=bool(msg.get("stream")))
+        self._reply = _HttpReply(self.conn, stream=bool(msg.get("stream")),
+                                 keep=self._keep,
+                                 on_done=self._request_done)
         # Same internal submit path as a wire client's generate: the
         # gateway's admission/tracing/routing/metering see no
         # difference, and every reply rides the shim back out as HTTP.
@@ -438,7 +510,17 @@ class _HttpConn:
 
     # -- responses ---------------------------------------------------------
 
-    def _respond(self, status: int, body_obj: Any) -> None:
+    def _respond(self, status: int, body_obj: Any,
+                 keep: bool = False) -> None:
+        """Answer in-parse (``_plock`` held): healthz keeps the
+        connection when the client does; rejections always close —
+        after a parse error the stream position is ambiguous."""
+        if keep:
+            if self.conn.send_bytes(
+                    _response_bytes(status, body_obj, keep=True)):
+                self._state = "done"
+                self._next_request()
+                return
         self._state = "done"
         self.conn.deadline = None
         self.conn.send_bytes(_response_bytes(status, body_obj))

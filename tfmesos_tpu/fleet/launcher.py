@@ -118,11 +118,14 @@ class FleetServer:
                  multi_step: int = 1,
                  prefix_cache_pages: int = 0,
                  pipeline_depth: int = 0,
+                 fused_prefill: bool = False,
+                 tokens_per_tick: Optional[int] = None,
                  draft: bool = False,
                  n_draft: int = 4,
                  kv_tier_mb: float = 0.0,
                  kv_tier_dir: Optional[str] = None,
                  kv_replication: int = 1,
+                 kv_placement: str = "rendezvous",
                  kv_replicas: int = 0,
                  warmup: bool = False,
                  prefill_replicas: int = 0,
@@ -148,6 +151,7 @@ class FleetServer:
                  rate: Optional[float] = None,
                  burst: Optional[float] = None,
                  priority_classes: Optional[List[PriorityClass]] = None,
+                 batch_lane: bool = False,
                  migrate_on_drain: bool = True,
                  breakers: bool = True,
                  max_retries: int = 2, request_timeout: float = 120.0,
@@ -282,6 +286,19 @@ class FleetServer:
         self.multi_step = int(multi_step)
         self.prefix_cache_pages = int(prefix_cache_pages)
         self.pipeline_depth = int(pipeline_depth)
+        #: stall-free fused scheduling per replica (docs/SERVING.md
+        #: "Stall-free fused scheduling"): one dispatch per tick covers
+        #: the decode block AND a budgeted batch of prefill chunk
+        #: slots.  Default off; modes the fused program cannot cover
+        #: bypass inside the batcher with a recorded reason.  Both
+        #: values join the shell=True replica command line, so both are
+        #: validated as ints/bools here (str(int) is charset-safe).
+        self.fused_prefill = bool(fused_prefill)
+        self.tokens_per_tick = (None if tokens_per_tick is None
+                                else int(tokens_per_tick))
+        if self.tokens_per_tick is not None and self.tokens_per_tick < 1:
+            raise ValueError(f"tokens_per_tick must be >= 1, got "
+                             f"{tokens_per_tick}")
         #: speculative decoding per replica (replicas serve with the
         #: preset draft companion model; the acceptance rate rides
         #: heartbeats into the gateway's ``spec`` gauge).  Composes
@@ -318,6 +335,17 @@ class FleetServer:
         if not 1 <= self.kv_replication <= 8:
             raise ValueError(
                 f"kv_replication must be in [1, 8], got {kv_replication}")
+        #: replica-copy placement policy for the KV fabric (PR 18's sim
+        #: knob promoted to production): "rendezvous" = pure HRW hash;
+        #: "loaded" = HRW within occupancy buckets, so loaded peers
+        #: shed copy traffic (tuned via ``tfserve simulate sessions
+        #: --sweep kv.placement=rendezvous,loaded``).  Validated against
+        #: the closed set here because it joins the shell=True replica
+        #: command line.
+        if kv_placement not in ("rendezvous", "loaded"):
+            raise ValueError(f"kv_placement must be 'rendezvous' or "
+                             f"'loaded', got {kv_placement!r}")
+        self.kv_placement = kv_placement
         self.kv_replicas = int(kv_replicas)
         if self.kv_replicas < 0:
             raise ValueError(
@@ -385,6 +413,23 @@ class FleetServer:
         #: default class, the pre-priority behavior exactly.
         self.priority_classes = list(priority_classes) \
             if priority_classes else None
+        #: the OFFLINE lane (docs/SERVING.md "Offline lane"): appends a
+        #: deadline-less ``batch`` class that dispatches only when
+        #: every interactive queue is empty (strict background at the
+        #: gateway's WFQ) and ranks BELOW every other class, so its
+        #: resident rows yield their decode slots to the first
+        #: interactive arrival via the replicas' preemption machinery.
+        self.batch_lane = bool(batch_lane)
+        if self.batch_lane:
+            specs = (list(self.priority_classes)
+                     if self.priority_classes
+                     else [PriorityClass("interactive", weight=1.0,
+                                         rank=0)])
+            if not any(c.name == "batch" for c in specs):
+                floor = min(c.rank for c in specs)
+                specs.append(PriorityClass("batch", weight=1.0,
+                                           rank=floor - 1, batch=True))
+            self.priority_classes = specs
         #: drain-migrate-kill: when a drain is pinned (autoscaler
         #: scale-down, rollout reap), ask the victim to SUSPEND its
         #: in-flight rows so the router re-places them on survivors —
@@ -491,6 +536,10 @@ class FleetServer:
             parts += ["--prefix-cache-pages", str(self.prefix_cache_pages)]
         if self.pipeline_depth:
             parts += ["--pipeline-depth", str(self.pipeline_depth)]
+        if self.fused_prefill:
+            parts.append("--fused-prefill")
+        if self.tokens_per_tick is not None:
+            parts += ["--tokens-per-tick", str(self.tokens_per_tick)]
         if self.draft:
             parts += ["--draft", "--n-draft", str(self.n_draft)]
         if self.kv_tier_mb > 0:
@@ -502,6 +551,10 @@ class FleetServer:
             parts += ["--kv-tier-dir", self.kv_tier_dir]
         if self.kv_replication > 1:
             parts += ["--kv-replication", str(self.kv_replication)]
+        if self.kv_placement != "rendezvous":
+            # Validated against the closed set at construction (the
+            # same shell=True boundary as the ints above).
+            parts += ["--kv-placement", self.kv_placement]
         if self.warmup:
             # Every launch of this cmd — boot, an autoscale-up, OR a
             # later elastic/Mode-B relaunch — registers warming,

@@ -1102,6 +1102,12 @@ def build_batcher(args, token: str, generation: int, node: str = "",
         multi_step=args.multi_step,
         prefix_cache_pages=args.prefix_cache_pages,
         pipeline_depth=args.pipeline_depth, kv_tier=kv_tier,
+        # Fused scheduling serves in chunked mode (the bucket doubles
+        # as the chunk width — the batcher couples them anyway).
+        prefill_chunk=(args.prefill_bucket if getattr(
+            args, "fused_prefill", False) else None),
+        fused_prefill=getattr(args, "fused_prefill", False),
+        tokens_per_tick=getattr(args, "tokens_per_tick", None),
         draft_cfg=draft_cfg, draft_params=draft_params,
         n_draft=args.n_draft, rid_seed=rid_seed_for_node(node))
 
@@ -1228,6 +1234,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--page-size", type=int, default=64)
     p.add_argument("--prefill-bucket", type=int, default=64)
     p.add_argument("--multi-step", type=int, default=1)
+    p.add_argument("--fused-prefill", action="store_true",
+                   dest="fused_prefill",
+                   help="stall-free fused scheduling: serve in chunked-"
+                        "prefill mode (chunk width = --prefill-bucket) "
+                        "with each tick's chunk slots fused into the "
+                        "SAME device dispatch as the decode block, so "
+                        "decoding rows never stall behind a long "
+                        "prompt's prefill (docs/SERVING.md 'Stall-free "
+                        "fused scheduling'); modes the fused program "
+                        "cannot cover fall back with a recorded "
+                        "bypass reason")
+    p.add_argument("--tokens-per-tick", type=int, default=None,
+                   dest="tokens_per_tick",
+                   help="fused tick token budget (default: rows x "
+                        "multi_step + one chunk): decode rows spend "
+                        "multi_step each, the leftover coalesces "
+                        "still-filling rows' chunks into the dispatch")
     p.add_argument("--prefix-cache-pages", type=int, default=0,
                    help="cross-request prefix cache budget in pool pages "
                         "per mesh data shard (0 disables); cached "

@@ -480,10 +480,12 @@ class Router:
         tr = tracing.current()
         if deadline is None and tr is None \
                 and "deadline" not in msg and "_trace" not in msg \
-                and "_emit" not in msg and "_model" not in msg:
+                and "_emit" not in msg and "_model" not in msg \
+                and "_background" not in msg:
             return msg
         out = {k: v for k, v in msg.items()
-               if k not in ("deadline", "_trace", "_emit", "_model")}
+               if k not in ("deadline", "_trace", "_emit", "_model",
+                            "_background")}
         if "_model" in msg:
             # The resolved model id DOES cross the wire (as ``model``):
             # the replica cross-checks it against the model it serves,
@@ -537,17 +539,27 @@ class Router:
 
     def _pick_role(self, roles, exclude, prompt,
                    session: Optional[str] = None,
-                   model: Optional[str] = None) -> Optional[str]:
+                   model: Optional[str] = None,
+                   background: bool = False) -> Optional[str]:
         """One choice policy for both prompt-bearing tiers:
         session-affinity first (the replica holding the conversation's
         parked KV), then prefix-affinity when ``prompt`` is given and
         some candidate advertises a matching cache summary, else
         least-outstanding p2c; ``None`` when no eligible replica
         exists.  ``model`` nests the model tier ABOVE everything:
-        affinity, p2c, and version preference all operate inside it."""
+        affinity, p2c, and version preference all operate inside it.
+        ``background`` (batch-lane work) narrows to replicas with FREE
+        slots when any exist: p2c alone can draw two saturated
+        replicas while an idle one sits empty, queueing deadline-less
+        work exactly where interactive load is hot."""
         cands = self._alive_by_role(roles, exclude, model=model)
         if not cands:
             return None
+        if background:
+            idle = [r for r in cands
+                    if not (r.capacity > 0
+                            and self.outstanding(r.addr) >= r.capacity)]
+            cands = idle or cands
         if session:
             fav = self._session_pick(cands, session)
             self.metrics.inc("session_affinity_hits" if fav is not None
@@ -573,7 +585,8 @@ class Router:
 
     def pick(self, exclude: Iterable[str] = (),
              prompt=None, session: Optional[str] = None,
-             model: Optional[str] = None) -> Optional[str]:
+             model: Optional[str] = None,
+             background: bool = False) -> Optional[str]:
         """The UNIFIED-path choice over alive unified replicas not in
         ``exclude``.  Prefill-role replicas never appear here (they
         refuse generate); decode-role replicas are reserved for
@@ -583,7 +596,7 @@ class Router:
         KV (session affinity); ``model`` narrows to that model's
         replicas (the model tier)."""
         return self._pick_role((UNIFIED,), exclude, prompt, session,
-                               model)
+                               model, background=background)
 
     def pick_prefill(self, exclude: Iterable[str] = (),
                      prompt=None,
@@ -1115,6 +1128,8 @@ class Router:
         session = session if isinstance(session, str) and session else None
         model = msg.get("_model") if isinstance(msg, dict) else None
         model = model if isinstance(model, str) and model else None
+        background = bool(msg.get("_background")) \
+            if isinstance(msg, dict) else False
         demanded = False
         # Streaming: the gateway's partial-frame emitter rides the
         # forward as the internal `_emit` (stripped by _wire_msg); each
@@ -1134,7 +1149,8 @@ class Router:
                 return self._expired_reply("before a replica could "
                                            "serve it")
             addr = self.pick(exclude=tried, prompt=prompt,
-                             session=session, model=model)
+                             session=session, model=model,
+                             background=background)
             if addr is None and model is not None and not demanded \
                     and not tried and self.on_model_demand is not None:
                 # Scale-to-zero cold start: no replica serves this

@@ -348,8 +348,8 @@ class SimReplica:
     __slots__ = ("addr", "role", "capacity", "model", "weights_version",
                  "gen", "node", "warm_until", "down", "removed",
                  "migrating", "slow_factor", "error_rate", "sever_next",
-                 "drop_beats", "kv_pages", "served", "model_id", "pool",
-                 "gang_size", "gang_live",
+                 "drop_beats", "kv_pages", "served", "busy_s",
+                 "model_id", "pool", "gang_size", "gang_live",
                  "_servers", "_inflight", "_pending")
 
     def __init__(self, addr: str, role: str = UNIFIED, capacity: int = 4,
@@ -375,6 +375,9 @@ class SimReplica:
         self.drop_beats = False
         self.kv_pages = int(kv_pages)
         self.served = 0
+        # Slot-seconds actually spent serving (the utilization gauge's
+        # numerator; deadline cancels shrink it via release_to).
+        self.busy_s = 0.0
         # Model catalog: the catalog model this replica serves, and
         # warm-pool membership (undedicated; adoption flips both).
         self.model_id = model_id
@@ -403,6 +406,7 @@ class SimReplica:
         finish = start + service_s
         heapq.heappush(self._servers, finish)
         heapq.heappush(self._inflight, finish)
+        self.busy_s += service_s
         return start, finish
 
     def release_to(self, finish: float, t: float) -> None:
@@ -410,6 +414,7 @@ class SimReplica:
         :meth:`occupy` just returned) to end at ``t`` instead — an
         in-batcher deadline cancel frees THAT row early, never some
         other in-flight request's slot."""
+        shrunk = False
         for heap in (self._servers, self._inflight):
             try:
                 heap.remove(finish)
@@ -417,6 +422,9 @@ class SimReplica:
                 continue
             heapq.heapify(heap)
             heapq.heappush(heap, t)
+            shrunk = True
+        if shrunk:
+            self.busy_s -= max(0.0, finish - t)
 
 
 # -- the virtual transport ---------------------------------------------------
@@ -808,8 +816,21 @@ class SimConfig:
     workers: int = 8
     max_queue: int = DEFAULT_MAX_QUEUE
     rate_limit: Optional[float] = None
+    # (name, weight, rank) entries, optionally (name, weight, rank,
+    # batch): a truthy 4th element marks the deadline-less BATCH class
+    # (dispatches only when every non-batch queue is empty — the
+    # offline lane, docs/SERVING.md).
     classes: Tuple[Tuple[str, float, int], ...] = (
         ("interactive", 8.0, 1), ("background", 1.0, 0))
+    # The offline lane (`tfserve --batch-lane`): True appends a
+    # deadline-less 'batch' class below every listed class.
+    batch_lane: bool = False
+    # Interactive-vs-batch budget split (sweep ``batch_slot_frac=
+    # 0.25,0.5,0.75,1.0``): the fraction of the fleet's aggregate
+    # decode slots batch-lane work may occupy at once — the sim analog
+    # of batch rows taking only idle slots and leftover tick budget,
+    # yielding the rest to interactive arrivals.  1.0 = no reserve.
+    batch_slot_frac: float = 0.5
     model: ReplicaModel = dataclasses.field(default_factory=ReplicaModel)
     breaker: BreakerConfig = dataclasses.field(
         default_factory=BreakerConfig)
@@ -894,6 +915,17 @@ def apply_override(cfg: SimConfig, path: str, value) -> None:
             _coerce(old, value) if isinstance(value, str) else value)
 
 
+def swept(overrides, field: str) -> bool:
+    """True when any override path targets ``field``, ALIASES RESOLVED
+    (``admission.max_queue`` targets ``max_queue``) — scenarios use
+    this to lay in scale defaults without clobbering a sweep's
+    explicit choice of the same constant."""
+    for p, _ in (overrides or ()):
+        if p == field or _OVERRIDE_ALIASES.get(p) == field:
+            return True
+    return False
+
+
 def parse_sweep(spec: str) -> Tuple[str, List[str]]:
     """``"breaker.latency_factor=2,4,8"`` -> ``("breaker.
     latency_factor", ["2", "4", "8"])``."""
@@ -941,8 +973,17 @@ class FleetSim:
             dead_after=cfg.dead_after, evict_after=cfg.evict_after,
             sweep_interval=cfg.sweep_interval, metrics=self.metrics)
         self.transport = SimTransport(eng)
-        specs = [PriorityClass(n, weight=w, rank=r)
-                 for n, w, r in cfg.classes]
+        specs = [PriorityClass(c[0], weight=c[1], rank=c[2],
+                               batch=bool(c[3]) if len(c) > 3 else False)
+                 for c in cfg.classes]
+        if cfg.batch_lane and not any(s.batch for s in specs):
+            # The offline lane (mirrors FleetServer's --batch-lane):
+            # a deadline-less batch class ranked below everything.
+            floor = min(s.rank for s in specs) if specs else 0
+            specs.append(PriorityClass("batch", weight=1.0,
+                                       rank=floor - 1, batch=True))
+        self._batch_cls = {s.name for s in specs if s.batch}
+        self._batch_busy = 0
         # Front doors: N stateless gateways over the one registry/
         # router view (`tfserve --gateways N`).  Each gets its own
         # AdmissionController (its WFQ queues) + idle-worker deque;
@@ -1274,6 +1315,10 @@ class FleetSim:
             "op": "generate", "prompt": self._prompt(req.prompt_len),
             "max_new_tokens": req.new_tokens, "stop_token": None,
             "priority": spec.rank}
+        if getattr(spec, "batch", False):
+            # Mirrors the gateway: the router prefers replicas with
+            # free slots for batch-lane work.
+            msg["_background"] = True
         if getattr(req, "session", None):
             msg["session"] = req.session
         if getattr(req, "model", None):
@@ -1359,12 +1404,53 @@ class FleetSim:
             sink.append(({"op": "error", "kind": "deadline_exceeded"},
                          self.engine.clock.now))
 
+    def _batch_cap(self) -> int:
+        """Concurrent batch-lane dispatches the budget split allows:
+        ``batch_slot_frac`` of the live fleet's aggregate slots — the
+        sim analog of batch rows taking only idle decode slots and
+        leftover tick budget (docs/SERVING.md "Offline lane")."""
+        total = sum(r.capacity for r in self.transport.replicas.values()
+                    if not (r.down or r.removed))
+        return max(1, int(self.cfg.batch_slot_frac * total))
+
+    def _requeue_batch(self, item: tuple) -> None:
+        """Re-admit a budget-deferred batch item (engine context); a
+        front at its bound sheds it explicitly, never silently."""
+        _, cls, _, deadline, sink = item
+        f = self._pick_front(None)
+        if f is None:
+            self.metrics.inc("failed")
+            self.shed += 1
+            self.finished += 1
+            return
+        try:
+            f.admission.admit(item, cls=cls, deadline=deadline)
+        except (Overloaded, DeadlineExceeded):
+            self.metrics.inc("shed_queue")
+            self.shed += 1
+            self.finished += 1
+            if sink is not None:
+                sink.append(({"op": "error", "kind": "overloaded"},
+                             self.engine.clock.now))
+            return
+        if f.idle:
+            self.engine._resume(f.idle.popleft())
+
     def dispatch(self, item: tuple) -> Any:
         """Fiber-context: one request through the real router, with
         the gateway worker's metric bookkeeping."""
         msg, cls, t_enq, deadline, sink = item
         eng = self.engine
         m = self.metrics
+        is_batch = cls in self._batch_cls
+        if is_batch and self._batch_busy >= self._batch_cap():
+            # The lane is at its slot split: requeue shortly and free
+            # this worker for interactive items NOW — a parked batch
+            # item must never hold a dispatcher an interactive
+            # arrival needs (the preemption analog at the front).
+            m.inc("batch_deferrals")
+            eng.after(0.01, lambda: self._requeue_batch(item))
+            return None
         cls_h = self._cls_hist.get(cls)
         wait_ms = (eng.clock.now - t_enq) * 1000.0
         self._h_queue_wait.observe(wait_ms)
@@ -1375,6 +1461,8 @@ class FleetSim:
             # The per-model queue-wait histogram — the trader's
             # relative-pressure signal, same as the real gateway's.
             m.hist(f"queue_wait_ms_model_{mlabel}").observe(wait_ms)
+        if is_batch:
+            self._batch_busy += 1
         try:
             reply = self.router.route(msg)
         except Exception as e:  # noqa: BLE001 - every loss recorded
@@ -1384,6 +1472,9 @@ class FleetSim:
             if sink is not None:
                 sink.append((None, eng.clock.now))
             return None
+        finally:
+            if is_batch:
+                self._batch_busy -= 1
         end = eng.clock.now
         if isinstance(reply, dict) and reply.get("op") == "completion":
             m.inc("completed")
@@ -1574,6 +1665,18 @@ class FleetSim:
             "retry_budget": self.router.retry_budget_level(),
             "classes": {},
         }
+        # Fleet utilization: slot-seconds served over slot-seconds
+        # offered (static-fleet gauge; a replica's whole lifetime
+        # counts as offered — the offline lane's win is THIS number
+        # rising while interactive latency holds).
+        span = self.engine.clock.now
+        offered = sum(r.capacity for r in self.transport.replicas.values()
+                      if not r.removed) * span
+        if offered > 0:
+            busy = sum(r.busy_s for r in self.transport.replicas.values())
+            out["utilization"] = round(min(1.0, busy / offered), 4)
+        if self._batch_cls:
+            out["batch_deferrals"] = m.get("batch_deferrals")
         for name, (_, _, lat_name) in self._cls_hist.items():
             cur = m.hist_cumulative(lat_name)
             if cur is None:
@@ -1966,9 +2069,13 @@ def scenario_diurnal(overrides=(), n_requests: int = 1_000_000,
     cfg.replicas = int(replicas) if replicas is not None else 10_000
     if seed is not None:
         cfg.seed = int(seed)
-    if not any(p == "workers" for p, _ in (overrides or ())):
+    if not swept(overrides, "workers"):
         cfg.workers = 64      # the scale scenario's measured sweet spot
-    if not any(p == "max_queue" for p, _ in (overrides or ())):
+    if not swept(overrides, "max_queue"):
+        # ALIAS-AWARE guard (swept, not a raw path scan): a
+        # ``--sweep admission.max_queue=...`` row must keep its bound
+        # — the raw scan saw only "admission.max_queue" and silently
+        # clobbered every row back to 4096.
         cfg.max_queue = 4096
     # A 10k fleet beats and sweeps SLOWER than a 3-replica one (real
     # fleets stretch liveness cadence with size): per-sim-second table
@@ -1979,7 +2086,7 @@ def scenario_diurnal(overrides=(), n_requests: int = 1_000_000,
     for path, v in (("hb_interval", 5.0), ("suspect_after", 7.5),
                     ("dead_after", 15.0), ("evict_after", 60.0),
                     ("sweep_interval", 2.0)):
-        if not any(p == path for p, _ in (overrides or ())):
+        if not swept(overrides, path):
             setattr(cfg, path, v)
     if cfg.hb_shards <= 0:
         # Per-replica beats are 2k heap events per sim-second of pure
@@ -2029,6 +2136,84 @@ def scenario_diurnal(overrides=(), n_requests: int = 1_000_000,
     out = sim.results(wall)
     out["sim_events_per_sec_10k"] = out.get("sim_events_per_sec")
     out["hb_shards"] = cfg.hb_shards
+    sim.stop()
+    return out
+
+
+def scenario_offline_lane(overrides=(), n_requests: int = 3000,
+                          replicas: Optional[int] = None,
+                          seed: Optional[int] = None,
+                          workload=None,
+                          model_fit: Optional[dict] = None,
+                          cfg: Optional[SimConfig] = None
+                          ) -> Dict[str, Any]:
+    """The OFFLINE lane (ROADMAP 6b, docs/SERVING.md "Offline lane"):
+    interactive arrivals ride a diurnal envelope whose trough leaves
+    decode slots idle, while a deadline-less batch backlog (half the
+    interactive volume, submitted up front) fills them through the
+    strict-priority batch class.  The tunable under sweep is the
+    interactive-vs-batch budget split: ``--sweep batch_slot_frac=
+    0.25,0.5,0.75,1.0`` prices reserve headroom against harvested
+    utilization, and ``--sweep batch_lane=false,true`` is the
+    lane-off baseline the bench asserts against (utilization strictly
+    higher with the lane on, interactive p99 held, zero interactive
+    requests lost)."""
+    cfg = _new_cfg(cfg, overrides)
+    if replicas is not None:
+        cfg.replicas = int(replicas)
+    if seed is not None:
+        cfg.seed = int(seed)
+    if not swept(overrides, "batch_lane"):
+        cfg.batch_lane = True
+    if not swept(overrides, "max_queue"):
+        # The batch backlog arrives up front BY DESIGN — it must fit
+        # the bounded queue, or the scenario measures shed, not the
+        # lane (the bound stays individually sweepable).
+        cfg.max_queue = max(cfg.max_queue, n_requests)
+    if model_fit:
+        for k, v in model_fit.items():
+            if hasattr(cfg.model, k):
+                setattr(cfg.model, k, v)
+    cfg.workers = max(cfg.workers,
+                      min(256, 2 * cfg.replicas * cfg.capacity))
+    sim = FleetSim(cfg)
+    for _ in range(cfg.replicas):
+        sim.add_replica(UNIFIED)
+    n_batch = n_requests // 2
+    if workload is None:
+        _, per_req_s = cfg.model.service_s(16, 8, random.Random(0))
+        # Crest at ~0.9x the fleet's service rate: saturated enough
+        # that the lane must yield, trough idle enough that there is
+        # capacity to harvest.
+        pump = cfg.replicas * cfg.capacity / max(1e-9, per_req_s)
+        base = 0.45 * pump
+        span = n_requests / (base * 1.5)
+        workload = DiurnalWorkload(
+            n_requests, base, seed=cfg.seed,
+            period_s=max(1.0, span), peak_ratio=2.0,
+            class_mix={"interactive": 1.0},
+            prompt_len=16, prompt_sigma=0.0,
+            new_tokens=8, new_tokens_sigma=0.0,
+            deadline_ms=60_000.0)
+    sim.feed(workload)
+    if n_batch and cfg.batch_lane:
+        # The backlog: deadline-less batch arrivals land in the first
+        # slice of the day and wait for idle slots.  Lane OFF is the
+        # no-offline-work baseline — without the class there is no
+        # surface to submit it through.
+        sim.feed(SyntheticWorkload(
+            n_batch, rate=max(1.0, n_batch / 2.0),
+            seed=cfg.seed + 1, class_mix={"batch": 1.0},
+            prompt_len=16, prompt_sigma=0.0,
+            new_tokens=8, new_tokens_sigma=0.0))
+    sim.start_workers()
+    t0 = time.perf_counter()
+    sim.engine.run(stop=sim.drained)
+    wall = time.perf_counter() - t0
+    out = sim.results(wall)
+    out["batch_lane"] = cfg.batch_lane
+    out["batch_slot_frac"] = cfg.batch_slot_frac
+    out["batch_planned"] = n_batch if cfg.batch_lane else 0
     sim.stop()
     return out
 
@@ -2468,6 +2653,7 @@ SCENARIOS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "soak-replay": scenario_soak_replay,
     "scale": scenario_scale,
     "diurnal": scenario_diurnal,
+    "offline-lane": scenario_offline_lane,
     "multi-gateway": scenario_multi_gateway,
     "sessions": scenario_sessions,
     "multi-model": scenario_multi_model,

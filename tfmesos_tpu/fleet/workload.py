@@ -83,6 +83,7 @@ class SyntheticWorkload:
                  new_tokens: int = 16, new_tokens_sigma: float = 0.5,
                  max_prompt_len: int = 2048, max_new_tokens: int = 512,
                  deadline_ms: Optional[float] = None,
+                 deadline_exempt: Optional[Iterable[str]] = None,
                  deterministic: bool = False, start_at: float = 0.0,
                  model: Optional[str] = None):
         if n_requests < 1:
@@ -105,6 +106,11 @@ class SyntheticWorkload:
         self.max_prompt_len = int(max_prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_ms = deadline_ms
+        # Classes whose arrivals carry NO deadline even when
+        # ``deadline_ms`` is set — the batch-class semantics (the
+        # offline lane is deadline-less by convention; its work waits
+        # out interactive bursts instead of being shed).
+        self.deadline_exempt = frozenset(deadline_exempt or ())
         self.deterministic = bool(deterministic)
         self.start_at = float(start_at)
         self.model = model
@@ -124,7 +130,9 @@ class SyntheticWorkload:
                 new_tokens=_clamped_lognormal(
                     rng, self.new_tokens, self.new_tokens_sigma, 1,
                     self.max_new_tokens),
-                deadline_ms=self.deadline_ms, model=self.model)
+                deadline_ms=(None if cls in self.deadline_exempt
+                             else self.deadline_ms),
+                model=self.model)
 
 
 class DiurnalWorkload:
@@ -166,6 +174,7 @@ class DiurnalWorkload:
                  new_tokens: int = 16, new_tokens_sigma: float = 0.5,
                  max_prompt_len: int = 2048, max_new_tokens: int = 512,
                  deadline_ms: Optional[float] = None,
+                 deadline_exempt: Optional[Iterable[str]] = None,
                  start_at: float = 0.0,
                  model: Optional[str] = None):
         if n_requests < 1:
@@ -215,6 +224,10 @@ class DiurnalWorkload:
         self.max_prompt_len = int(max_prompt_len)
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_ms = deadline_ms
+        # Same batch-class exemption as SyntheticWorkload: listed
+        # classes arrive deadline-less (the trough-filling offline
+        # tenant in a phase-shifted mix).
+        self.deadline_exempt = frozenset(deadline_exempt or ())
         self.start_at = float(start_at)
         self.model = model
 
@@ -310,13 +323,16 @@ class DiurnalWorkload:
             if u() * ceiling > rate:
                 continue
             emitted += 1
+            cls = self._pick_class(rng, rel)
             yield Request(
-                at=start_at + rel, cls=self._pick_class(rng, rel),
+                at=start_at + rel, cls=cls,
                 prompt_len=_clamped_lognormal(
                     rng, p_med, p_sig, 1, self.max_prompt_len),
                 new_tokens=_clamped_lognormal(
                     rng, o_med, o_sig, 1, self.max_new_tokens),
-                deadline_ms=self.deadline_ms, model=self.model)
+                deadline_ms=(None if cls in self.deadline_exempt
+                             else self.deadline_ms),
+                model=self.model)
 
 
 # -- trace replay ------------------------------------------------------------

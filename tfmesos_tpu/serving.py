@@ -121,6 +121,17 @@ BYPASS_ALLOWLIST = {
     # bench_serving_pipeline's p50 gap), and mesh data shards pin
     # pages locally like the kv_tier/export surface.
     "suspend": ("mesh data sharding", "lagged decode carry"),
+    # Stall-free fused prefill+decode ticks (one dispatch covers the
+    # decode block AND a budgeted batch of prefill chunk slots).  Mesh
+    # data shards dispatch chunks one-hot per shard (the fused slot
+    # layout has no shard axis to ride); a speculative round's dispatch
+    # is the verify program — its chunk writes advance the DRAFT pool
+    # in lockstep, a second fused surface the single-program layout
+    # does not cover yet (burn-down: fold the chunk writes into
+    # _make_spec_round's body); lagged modes retire a block behind and
+    # a chunk slot's first-token sample is host-synchronous by design.
+    "fused_prefill": ("mesh data sharding", "speculative decoding",
+                      "lagged decode carry"),
 }
 
 
@@ -140,7 +151,8 @@ def compute_bypass_reasons(*, speculative: bool = False,
     quant = quantized_cache or (speculative and draft_quantized_cache)
     out: Dict[str, Optional[str]] = {
         "prefix_cache": None, "kv_tier": None, "pipeline": None,
-        "overlap": None, "multi_step": None, "suspend": None}
+        "overlap": None, "multi_step": None, "suspend": None,
+        "fused_prefill": None}
     if quant:
         out["prefix_cache"] = "quantized kv cache"
     if n_shards != 1:
@@ -162,6 +174,12 @@ def compute_bypass_reasons(*, speculative: bool = False,
         out["suspend"] = "mesh data sharding"
     elif overlap_eff or pipelined:
         out["suspend"] = "lagged decode carry"
+    if n_shards != 1:
+        out["fused_prefill"] = "mesh data sharding"
+    elif speculative:
+        out["fused_prefill"] = "speculative decoding"
+    elif overlap_eff or pipelined:
+        out["fused_prefill"] = "lagged decode carry"
     return out
 
 
@@ -1367,7 +1385,9 @@ class ContinuousBatcher:
                  prefix_cache_pages: int = 0,
                  pipeline_depth: int = 0,
                  kv_tier=None,
-                 rid_seed: int = 0):
+                 rid_seed: int = 0,
+                 fused_prefill: bool = False,
+                 tokens_per_tick: Optional[int] = None):
         if rows < 1:
             raise ValueError(f"rows must be >= 1, got {rows}")
         if not 0 <= int(rid_seed) < 2 ** 30:
@@ -1384,6 +1404,12 @@ class ContinuousBatcher:
             raise ValueError(f"pipeline_depth must be 0 (synchronous "
                              f"host sync) or 1 (one block of device-"
                              f"resident lag), got {pipeline_depth}")
+        if fused_prefill and prefill_chunk is None:
+            raise ValueError("fused_prefill requires prefill_chunk "
+                             "(chunked prefill is the lane being fused)")
+        if tokens_per_tick is not None and tokens_per_tick < 1:
+            raise ValueError(f"tokens_per_tick must be >= 1, got "
+                             f"{tokens_per_tick}")
         self.multi_step = int(multi_step)
         self.overlap = bool(overlap)
         # Pipelined device-resident decode (pipeline_depth=1): block N+1
@@ -1492,6 +1518,28 @@ class ContinuousBatcher:
             prefill_bucket = prefill_chunk
         self.prefill_chunk = prefill_chunk
         self.prefill_bucket = int(prefill_bucket)
+        # Stall-free fused scheduling (docs/SERVING.md "Stall-free
+        # fused scheduling"): one dispatch per tick covers every decode
+        # row's K-step block AND up to (tokens_per_tick - n_decode*K)/c
+        # prefill chunk tokens from still-filling rows — the chunk no
+        # longer rides a separate device call ahead of the block, so
+        # decoding rows stop paying a full chunk stall per tick.  Modes
+        # the single fused program cannot cover BYPASS with a recorded
+        # reason (fused_prefill_bypass_reason — same discipline as the
+        # other registries), falling back to the phase-split tick.
+        self.fused_prefill_bypass_reason: Optional[str] = None
+        if fused_prefill:
+            self.fused_prefill_bypass_reason = \
+                self._bypass["fused_prefill"]
+        self._fused = (fused_prefill
+                       and self.fused_prefill_bypass_reason is None)
+        #: the per-tick token budget the fused dispatch packs to:
+        #: defaults to every row decoding a full block plus one chunk
+        #: (>= the phase-split tick's work, so fusion never slows the
+        #: schedule down; larger budgets coalesce more filling rows).
+        self.tokens_per_tick = int(
+            tokens_per_tick or rows * self.multi_step
+            + (prefill_chunk or 0))
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
@@ -1510,6 +1558,7 @@ class ContinuousBatcher:
         self._decode = self._make_decode()
         self._chunk_prefill = (self._make_chunk_prefill()
                                if prefill_chunk is not None else None)
+        self._fused_step = self._make_fused_step() if self._fused else None
         self.draft_cfg = draft_cfg
         self.draft_params = draft_params
         self.n_draft = int(n_draft)
@@ -1614,6 +1663,10 @@ class ContinuousBatcher:
         self.spec_rounds = 0        # jitted rounds executed
         self.spec_row_rounds = 0    # row-rounds (rows decoding per round)
         self.spec_committed = 0     # tokens committed across them
+        # Fused-tick observability (see fused_tokens_per_tick).
+        self.fused_ticks = 0          # fused prefill+decode dispatches
+        self.fused_chunk_tokens = 0   # prefill tokens they coalesced
+        self.fused_decode_tokens = 0  # decode tokens they covered
         # The batcher's flight recorder (docs/SERVING.md
         # "Observability"): a bounded ring of recent component events —
         # notably per-block decode timing from every step mode,
@@ -1714,6 +1767,23 @@ class ContinuousBatcher:
         if self.draft_cfg is not None:
             return -(-int(block_tokens) // (self.n_draft + 1))
         return int(block_tokens)
+
+    def fused_tokens_per_tick(self, n_decode: Optional[int] = None) -> int:
+        """Tokens ONE device dispatch covers on a tick with ``n_decode``
+        decoding rows (default: all rows) — the analytic twin of
+        :meth:`paged_launches_per_block` for the stall-free scheduler.
+        Phase-split ticks dispatch only the decode block (the prefill
+        chunk rides a SECOND call the decode rows stall behind); a
+        fused tick packs the same block plus however many chunk slots
+        the ``tokens_per_tick`` budget leaves room for — floored at one
+        slot, so a saturated decode set still makes prefill progress
+        exactly like the phase-split tick did."""
+        n = self.rows if n_decode is None else int(n_decode)
+        dt = n * self.multi_step
+        if not self._fused:
+            return dt
+        c = self.prefill_chunk
+        return dt + max(1, (self.tokens_per_tick - dt) // c) * c
 
     def preempt_all(self) -> None:
         """Ask the serve loop to give back EVERY in-flight request as a
@@ -2339,6 +2409,66 @@ class ContinuousBatcher:
 
         return fn
 
+    def _make_fused_step(self):
+        """ONE jitted program per tick over the ragged [decode rows |
+        prefill chunk slots] layout: a budgeted batch of chunk slots
+        (each slot = one still-filling row's next ``prefill_chunk``
+        tokens at its own traced offset — the SAME chunk-writer ops
+        :meth:`_make_chunk_prefill` runs, batched [S, c] instead of
+        one-hot) followed by the decode block's K-step scan, threading
+        one donated pool through both.  Decode rows therefore never
+        stall behind a separate chunk dispatch, and the host syncs ONE
+        result per tick ([rows, K] decode tokens + [S] first-token
+        samples) instead of two.  Slot writes land on each slot row's
+        own pages (dummy slots: all-sink tables, sampled token
+        discarded), decode writes behave exactly as in
+        :meth:`_make_decode` — same ops, same (rid, step) sample folds,
+        so token streams are identical to the phase-split tick.  One
+        compile per (decode table width, slot-count bucket) pair."""
+        sharded = self.mesh is not None
+        K = self.multi_step
+        max_len = self.max_len
+
+        @partial(jax.jit, donate_argnums=1)
+        def fn(params, pool, table, toks, positions, rids, steps,
+               ctable, chunks, cpos, caps, crids):
+            # Chunk slots first (mirroring the phase-split tick's
+            # chunk-then-block order — the sets touch disjoint pages,
+            # but the donated pool threads through in program order).
+            cache = dict(pool, pages=ctable)
+            logits, cache = decode_step(self.cfg, params, cache, chunks,
+                                        cpos, sharded=sharded,
+                                        mesh=self.mesh)
+            pool = {"k": cache["k"], "v": cache["v"]}
+            cap = jnp.clip(caps, 0, chunks.shape[1] - 1)
+            last = jnp.take_along_axis(
+                logits, cap[:, None, None], axis=1)[:, 0]
+            first = self._sample(last, crids, jnp.zeros_like(crids))
+
+            def body(carry, _):
+                pool, tok, pos, stp = carry
+                cache = dict(pool, pages=table)
+                lg, cache = decode_step(
+                    self.cfg, params, cache, tok[:, None],
+                    jnp.minimum(pos, max_len), sharded=sharded,
+                    mesh=self.mesh)
+                nxt = self._sample(lg[:, -1], rids, stp)
+                pool = {"k": cache["k"], "v": cache["v"]}
+                return (pool, nxt, pos + 1, stp + 1), nxt
+
+            (pool, _, _, _), toks_all = jax.lax.scan(
+                body, (pool, toks, positions, steps), None, length=K)
+            return (pool, self._host_read(toks_all.T),
+                    self._host_read(first))
+
+        return fn
+
+    def _fused_slot_buckets(self) -> List[int]:
+        """Every chunk-slot count the fused dispatch can pad to (powers
+        of two up to ``rows`` — at most ``rows`` rows can be filling),
+        for warmup and the live dispatch's shared bucketing."""
+        return sorted({self._pow2(s) for s in range(1, self.rows + 1)})
+
     def _prefill_fn(self, width: int):
         """Jitted prefill at one padded-width bucket, batched one row per
         mesh data shard (``_one_hot_call``)."""
@@ -2713,6 +2843,24 @@ class ContinuousBatcher:
                         self.params, self.pool, table, zt, zt, zt, zt)
                     np.asarray(out)
                     compiled.append(f"decode[{w}]")
+                if self._fused:
+                    # The fused tick's (decode width x slot bucket)
+                    # grid — every shape _step_fused can dispatch.
+                    c = self.prefill_chunk
+                    for S in self._fused_slot_buckets():
+                        ctable = jnp.asarray(np.full(
+                            (S, self.t_side.np_max), self.t_side.sink,
+                            np.int32))
+                        self.pool, out, first = self._fused_step(
+                            self.params, self.pool, table, zt, zt, zt,
+                            zt, ctable,
+                            jnp.asarray(np.zeros((S, c), np.int32)),
+                            jnp.asarray(np.zeros((S,), np.int32)),
+                            jnp.asarray(np.full((S,), -1, np.int32)),
+                            jnp.asarray(np.zeros((S,), np.int32)))
+                        np.asarray(out)
+                        np.asarray(first)
+                        compiled.append(f"fused[{w},{S}]")
             if prefill and self.draft_cfg is not None:
                 # Chunked mode feeds the draft the fixed chunk width;
                 # non-chunked admission feeds it the PADDED PROMPT
@@ -3958,6 +4106,17 @@ class ContinuousBatcher:
                     if not pending and exhausted:
                         return
                     continue
+                if (self._fused
+                        and any(row.decoding for row in active.values())
+                        and any(not row.decoding
+                                for row in active.values())):
+                    # Stall-free tick: decode block + budgeted chunk
+                    # slots in ONE dispatch (see _step_fused).  Ticks
+                    # with only one phase live take the plain paths
+                    # below — there is nothing to fuse.
+                    yield from self._step_fused(active, free_rows)
+                    self._flush_streams(active)
+                    continue
                 if self._chunk_prefill is not None:
                     done_row = self._advance_prefill(active)
                     if done_row is not None:
@@ -4282,6 +4441,100 @@ class ContinuousBatcher:
         if tok == row.req.stop_token or row.req.max_new_tokens == 1:
             return r
         return None
+
+    def _step_fused(self, active: Dict[int, _Row],
+                    free_rows: List[int]) -> Iterator[Completion]:
+        """One FUSED tick: the decode block over every decoding row
+        plus up to ``(tokens_per_tick - n_decode*K) // c`` prefill
+        chunk slots (oldest filling rows first, at most one chunk per
+        row — chunk N+1's attention reads chunk N's cache writes, so a
+        row cannot coalesce with itself), all in ONE dispatch and ONE
+        host sync.  The budget floor is one slot, so a saturated
+        decode set still fills exactly as fast as the phase-split tick;
+        the budget ceiling is what stops a burst of long prompts from
+        monopolizing ticks.  Chunk bookkeeping mirrors
+        :meth:`_advance_prefill` (a row whose last chunk lands here
+        flips to decoding with its sampled first token and joins the
+        NEXT tick's block — tokens are pure (rid, step) functions, so
+        the stream is unchanged); decode commits mirror :meth:`_step`."""
+        K = self.multi_step
+        c = self.prefill_chunk
+        decoding = {r: row for r, row in active.items() if row.decoding}
+        filling = sorted((row.rid, r) for r, row in active.items()
+                         if not row.decoding)
+        slots = max(1, (self.tokens_per_tick - len(decoding) * K) // c)
+        picks = [r for _, r in filling[:slots]]
+        S = self._pow2(len(picks))
+        ctable = np.full((S, self.t_side.np_max), self.t_side.sink,
+                         np.int32)
+        chunks = np.zeros((S, c), np.int32)
+        cpos = np.zeros((S,), np.int32)
+        caps = np.full((S,), -1, np.int32)
+        crids = np.zeros((S,), np.int32)
+        tbl = self.t_side.table_np()
+        for i, r in enumerate(picks):
+            row = active[r]
+            ctable[i] = tbl[r]
+            chunks[i] = row.padded[0, row.filled:row.filled + c]
+            cpos[i] = self.prefix_len + row.filled
+            caps[i] = row.req.prompt.size - 1 - row.filled
+            crids[i] = row.rid
+        toks = np.zeros((self.rows,), np.int32)
+        positions = np.zeros((self.rows,), np.int32)
+        rids = np.zeros((self.rows,), np.int32)
+        steps = np.zeros((self.rows,), np.int32)
+        for r, row in decoding.items():
+            self._ensure_sides(r, min(row.pos + K, row.limit))
+            toks[r] = row.last
+            positions[r] = row.pos
+            rids[r] = row.rid
+            steps[r] = row.step
+        table = self.t_side.decode_table(active, decoding)
+        tb0 = time.perf_counter()
+        self.pool, nxt, first = self._fused_step(
+            self.params, self.pool, table, jnp.asarray(toks),
+            jnp.asarray(positions), jnp.asarray(rids),
+            jnp.asarray(steps), jnp.asarray(ctable),
+            jnp.asarray(chunks), jnp.asarray(cpos), jnp.asarray(caps),
+            jnp.asarray(crids))
+        nxt = np.asarray(nxt)       # ONE sync covers chunks AND block
+        first = np.asarray(first)
+        self.fused_ticks += 1
+        self.fused_chunk_tokens += len(picks) * c
+        self.fused_decode_tokens += len(decoding) * K
+        self.flight.record(
+            {"name": "decode.block", "mode": "fused",
+             "dur": round((time.perf_counter() - tb0) * 1000.0, 3),
+             "rows": len(decoding), "k": K, "chunks": len(picks)})
+        for i, r in enumerate(picks):
+            row = active[r]
+            row.filled += c
+            if row.filled < row.padded.shape[1]:
+                continue
+            tok = int(first[i])     # the capture chunk's sample
+            row.t_first = time.perf_counter()
+            row.last = tok
+            row.out.append(tok)
+            row.decoding = True
+            self._pcache_insert(r, row)
+            if tok == row.req.stop_token or row.req.max_new_tokens == 1:
+                done = self._completion(row)
+                self._finish_completed(r, active, free_rows)
+                yield done
+        for r in list(decoding):
+            row = active[r]
+            for j in range(K):
+                tok = int(nxt[r, j])
+                row.out.append(tok)
+                row.step += 1
+                row.pos += 1
+                row.last = tok
+                if tok == row.req.stop_token or row.step >= \
+                        row.req.max_new_tokens:
+                    done = self._completion(row)
+                    self._finish_completed(r, active, free_rows)
+                    yield done
+                    break
 
     def _step(self, active: Dict[int, _Row],
               free_rows: List[int]) -> Iterator[Completion]:

@@ -704,9 +704,15 @@ class WireServer:
         self._wake()
 
     def _watch(self, conn: WireConn) -> None:
-        """Track ``conn`` in the deadline sweep (loop thread only —
-        ingress protocols run their parse on the loop thread)."""
-        self._timed.add(conn)
+        """Track ``conn`` in the deadline sweep.  Thread-safe: ingress
+        protocols parse on the loop thread, but a keep-alive response
+        finishing on a gateway worker thread re-arms the idle deadline
+        from there.  The wake matters: with no timed conns the loop
+        selects on a 5s backstop, far past the idle keep-alive
+        deadline it must now enforce."""
+        with self._plock:
+            self._timed.add(conn)
+        self._wake()
 
     # -- the loop ----------------------------------------------------------
 
@@ -714,7 +720,9 @@ class WireServer:
         if not self._timed:
             return
         now = time.monotonic()
-        for conn in list(self._timed):
+        with self._plock:
+            timed = list(self._timed)
+        for conn in timed:
             if conn._closed or conn.deadline is None:
                 self._timed.discard(conn)
             elif now > conn.deadline:
